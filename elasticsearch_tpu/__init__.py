@@ -1,0 +1,17 @@
+"""elasticsearch_tpu — a TPU-native distributed search and analytics engine.
+
+A from-scratch re-design of Elasticsearch's capabilities (reference:
+Elasticsearch 8.0.0-SNAPSHOT, surveyed in /root/repo/SURVEY.md) built TPU-first:
+
+- the per-shard scoring/aggregation data plane is JAX/XLA (padded CSR postings,
+  vmapped BM25 impact scoring, ``jax.lax.top_k``, einsum brute-force kNN,
+  segment_sum aggregations) instead of Lucene's CPU hot loops
+  (reference: ``server/.../search/internal/ContextIndexSearcher.java:210-224``);
+- the multi-shard scatter/gather runs as mesh collectives over ICI
+  (``jax.sharding.Mesh`` + ``shard_map``) instead of a TCP fan-out
+  (reference: ``action/search/AbstractSearchAsyncAction.java:70``);
+- the host side (REST, cluster state, translog, storage) is asyncio Python with
+  the same API surface as the reference's REST layer.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,116 @@
+"""Exception hierarchy mirroring the reference's ElasticsearchException tree.
+
+Reference: ``server/src/main/java/org/elasticsearch/ElasticsearchException.java``
+and the REST status mapping in ``rest/RestStatus``-carrying exceptions. Each
+exception carries an HTTP status so the REST layer can render ES-compatible
+error bodies ``{"error": {"type": ..., "reason": ...}, "status": N}``.
+"""
+
+from __future__ import annotations
+
+
+class ElasticsearchError(Exception):
+    """Base error. ``status`` is the HTTP status the REST layer returns."""
+
+    status = 500
+    error_type = "exception"
+
+    def __init__(self, reason: str = "", **metadata):
+        super().__init__(reason)
+        self.reason = reason
+        self.metadata = metadata
+
+    def to_dict(self) -> dict:
+        err = {"type": self.error_type, "reason": self.reason or str(self)}
+        err.update(self.metadata)
+        return {"error": err, "status": self.status}
+
+
+class IndexNotFoundError(ElasticsearchError):
+    status = 404
+    error_type = "index_not_found_exception"
+
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]", index=index)
+        self.index = index
+
+
+class ResourceAlreadyExistsError(ElasticsearchError):
+    status = 400
+    error_type = "resource_already_exists_exception"
+
+
+class DocumentMissingError(ElasticsearchError):
+    status = 404
+    error_type = "document_missing_exception"
+
+
+class VersionConflictError(ElasticsearchError):
+    """Reference: ``index/engine/VersionConflictEngineException.java``."""
+
+    status = 409
+    error_type = "version_conflict_engine_exception"
+
+
+class MapperParsingError(ElasticsearchError):
+    status = 400
+    error_type = "mapper_parsing_exception"
+
+
+class IllegalArgumentError(ElasticsearchError):
+    status = 400
+    error_type = "illegal_argument_exception"
+
+
+class ParsingError(ElasticsearchError):
+    """Query DSL / body parse failure (``common/ParsingException.java``)."""
+
+    status = 400
+    error_type = "parsing_exception"
+
+
+class SearchPhaseExecutionError(ElasticsearchError):
+    status = 500
+    error_type = "search_phase_execution_exception"
+
+
+class ShardNotFoundError(ElasticsearchError):
+    status = 404
+    error_type = "shard_not_found_exception"
+
+
+class NodeNotFoundError(ElasticsearchError):
+    status = 404
+    error_type = "node_not_found_exception"
+
+
+class CircuitBreakingError(ElasticsearchError):
+    """Reference: ``common/breaker/CircuitBreakingException.java`` (429)."""
+
+    status = 429
+    error_type = "circuit_breaking_exception"
+
+
+class ClusterBlockError(ElasticsearchError):
+    status = 503
+    error_type = "cluster_block_exception"
+
+
+class InvalidAliasNameError(ElasticsearchError):
+    status = 400
+    error_type = "invalid_alias_name_exception"
+
+
+class SnapshotError(ElasticsearchError):
+    status = 500
+    error_type = "snapshot_exception"
+
+
+class SnapshotMissingError(ElasticsearchError):
+    status = 404
+    error_type = "snapshot_missing_exception"
+
+
+class PipelineError(ElasticsearchError):
+    status = 400
+    error_type = "pipeline_processing_exception"

@@ -1,0 +1,546 @@
+"""Mappings: field types, document parsing, dynamic mapping.
+
+Re-design of the reference mapper layer (``server/.../index/mapper/``:
+``MapperService.java``, ``DocumentParser.java:52``, ``FieldMapper.java``,
+``MappedFieldType.java``). A mapping is a tree of typed fields; parsing a JSON
+document produces a ``ParsedDocument`` whose per-field values feed the
+TPU-friendly columnar/postings builders in ``segment.py``:
+
+- ``text``      → analyzed terms with positions     (postings → BM25 kernel)
+- ``keyword``   → exact terms + ordinal doc values  (terms agg / sort)
+- numerics/date/boolean → float64 doc values        (range masks / aggs / sort)
+- ``dense_vector`` → fixed-dim float32 rows         (einsum kNN)
+
+Dynamic mapping infers types from JSON values like the reference
+(``DynamicFieldsBuilder``): string → text + ``.keyword`` subfield, int → long,
+float → double ("float" JSON numbers map to double), bool → boolean.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import numbers
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.errors import IllegalArgumentError, MapperParsingError
+from .analysis import AnalysisRegistry, Analyzer, Token
+
+
+# ---------------------------------------------------------------------------
+# Field types
+# ---------------------------------------------------------------------------
+
+NUMERIC_TYPES = {"long", "integer", "short", "byte", "double", "float",
+                 "half_float", "unsigned_long"}
+
+_INT_BOUNDS = {
+    "byte": (-(1 << 7), (1 << 7) - 1),
+    "short": (-(1 << 15), (1 << 15) - 1),
+    "integer": (-(1 << 31), (1 << 31) - 1),
+    "long": (-(1 << 63), (1 << 63) - 1),
+    "unsigned_long": (0, (1 << 64) - 1),
+}
+
+
+class MappedFieldType:
+    """Base resolved field type (reference: ``MappedFieldType.java``)."""
+
+    type_name = "object"
+    has_doc_values = False
+    is_searchable = True
+
+    def __init__(self, name: str, params: Optional[dict] = None):
+        self.name = name
+        self.params = params or {}
+
+    def to_mapping(self) -> dict:
+        out = {"type": self.type_name}
+        out.update({k: v for k, v in self.params.items() if v is not None})
+        return out
+
+    # Parse one JSON leaf value into its indexable form; may raise.
+    def parse_value(self, value: Any) -> Any:
+        return value
+
+
+class TextFieldType(MappedFieldType):
+    type_name = "text"
+
+    def __init__(self, name: str, analyzer: Analyzer,
+                 search_analyzer: Optional[Analyzer] = None,
+                 params: Optional[dict] = None):
+        super().__init__(name, params)
+        self.analyzer = analyzer
+        self.search_analyzer = search_analyzer or analyzer
+
+    def parse_value(self, value):
+        return str(value)
+
+
+class KeywordFieldType(MappedFieldType):
+    type_name = "keyword"
+    has_doc_values = True
+
+    def __init__(self, name: str, ignore_above: int = 2 ** 31 - 1,
+                 normalize_lowercase: bool = False, params: Optional[dict] = None):
+        super().__init__(name, params)
+        self.ignore_above = ignore_above
+        self.normalize_lowercase = normalize_lowercase
+
+    def parse_value(self, value):
+        if isinstance(value, bool):
+            value = "true" if value else "false"
+        s = str(value)
+        if len(s) > self.ignore_above:
+            return None
+        return s.lower() if self.normalize_lowercase else s
+
+
+class NumberFieldType(MappedFieldType):
+    has_doc_values = True
+
+    def __init__(self, name: str, number_type: str, params: Optional[dict] = None):
+        super().__init__(name, params)
+        if number_type not in NUMERIC_TYPES:
+            raise IllegalArgumentError(f"unknown numeric type [{number_type}]")
+        self.type_name = number_type
+
+    def parse_value(self, value):
+        if isinstance(value, bool):
+            raise MapperParsingError(
+                f"failed to parse field [{self.name}] of type [{self.type_name}]: "
+                f"boolean value")
+        try:
+            if self.type_name in _INT_BOUNDS:
+                if isinstance(value, int):
+                    v = value
+                else:
+                    try:
+                        v = int(value)  # exact for integer strings (no f64 loss)
+                    except ValueError:
+                        v = int(float(value))
+                lo, hi = _INT_BOUNDS[self.type_name]
+                if not (lo <= v <= hi):
+                    raise MapperParsingError(
+                        f"value [{value}] out of range for type [{self.type_name}]")
+                return float(v)
+            return float(value)
+        except (TypeError, ValueError) as e:
+            raise MapperParsingError(
+                f"failed to parse field [{self.name}] of type "
+                f"[{self.type_name}]: [{value}]") from e
+
+
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+_DATE_YMD_RE = re.compile(r"^\d{4}-\d{2}-\d{2}$")
+
+
+def parse_date_millis(value: Any, fmt: str = "strict_date_optional_time||epoch_millis") -> float:
+    """Parse a date into epoch milliseconds (UTC). Supports the reference's
+    default ``strict_date_optional_time||epoch_millis`` plus ``epoch_second``."""
+    if isinstance(value, bool):
+        raise MapperParsingError(f"failed to parse date [{value}]")
+    if isinstance(value, numbers.Number):
+        if "epoch_second" in fmt and "epoch_millis" not in fmt:
+            return float(value) * 1000.0
+        return float(value)
+    s = str(value).strip()
+    if re.fullmatch(r"-?\d+", s):
+        if "epoch_second" in fmt and "epoch_millis" not in fmt:
+            return float(s) * 1000.0
+        return float(s)
+    try:
+        if _DATE_YMD_RE.match(s):
+            d = _dt.datetime.strptime(s, "%Y-%m-%d").replace(tzinfo=_dt.timezone.utc)
+        else:
+            d = _dt.datetime.fromisoformat(s)
+            if d.tzinfo is None:
+                d = d.replace(tzinfo=_dt.timezone.utc)
+        return (d - _EPOCH).total_seconds() * 1000.0
+    except ValueError as e:
+        raise MapperParsingError(f"failed to parse date field [{value}]") from e
+
+
+def format_date_millis(millis: float) -> str:
+    d = _EPOCH + _dt.timedelta(milliseconds=millis)
+    return d.strftime("%Y-%m-%dT%H:%M:%S.") + f"{d.microsecond // 1000:03d}Z"
+
+
+class DateFieldType(MappedFieldType):
+    type_name = "date"
+    has_doc_values = True
+
+    def __init__(self, name: str, date_format: str = "strict_date_optional_time||epoch_millis",
+                 params: Optional[dict] = None):
+        super().__init__(name, params)
+        self.format = date_format
+
+    def parse_value(self, value):
+        return parse_date_millis(value, self.format)
+
+
+class BooleanFieldType(MappedFieldType):
+    type_name = "boolean"
+    has_doc_values = True
+
+    def parse_value(self, value):
+        if isinstance(value, bool):
+            return 1.0 if value else 0.0
+        if value in ("true", "True"):
+            return 1.0
+        if value in ("false", "False", ""):
+            return 0.0
+        raise MapperParsingError(f"failed to parse boolean [{value}]")
+
+
+class DenseVectorFieldType(MappedFieldType):
+    """Reference: ``x-pack/plugin/vectors/.../DenseVectorFieldMapper.java:43``.
+    Brute-force scored via a single einsum + top_k on TPU."""
+
+    type_name = "dense_vector"
+    has_doc_values = True
+
+    def __init__(self, name: str, dims: int, similarity: str = "cosine",
+                 params: Optional[dict] = None):
+        super().__init__(name, params)
+        self.dims = int(dims)
+        self.similarity = similarity
+
+    def parse_value(self, value):
+        arr = np.asarray(value, dtype=np.float32)
+        if arr.shape != (self.dims,):
+            raise MapperParsingError(
+                f"the [dims] of field [{self.name}] is [{self.dims}] but found "
+                f"vector of dims [{arr.shape}]")
+        return arr
+
+
+class GeoPointFieldType(MappedFieldType):
+    type_name = "geo_point"
+    has_doc_values = True
+
+    def parse_value(self, value):
+        # Accept {"lat":..,"lon":..}, [lon, lat], "lat,lon", geohash not yet.
+        if isinstance(value, dict):
+            lat, lon = float(value["lat"]), float(value["lon"])
+        elif isinstance(value, (list, tuple)):
+            lon, lat = float(value[0]), float(value[1])
+        elif isinstance(value, str):
+            parts = value.split(",")
+            lat, lon = float(parts[0]), float(parts[1])
+        else:
+            raise MapperParsingError(f"failed to parse geo_point [{value}]")
+        if not (-90 <= lat <= 90) or not (-180 <= lon <= 180):
+            raise MapperParsingError(f"geo_point out of bounds [{value}]")
+        return (lat, lon)
+
+
+class ObjectFieldType(MappedFieldType):
+    type_name = "object"
+    is_searchable = False
+
+
+# ---------------------------------------------------------------------------
+# Parsed document
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ParsedDocument:
+    """Output of document parsing, consumed by the segment writer
+    (analogue of ``ParsedDocument.java`` wrapping LuceneDocument)."""
+
+    doc_id: str
+    source: dict
+    routing: Optional[str] = None
+    # field name -> list of analyzed tokens (text fields)
+    text_tokens: Dict[str, List[Token]] = dc_field(default_factory=dict)
+    # field name -> list of exact terms (keyword fields)
+    keyword_terms: Dict[str, List[str]] = dc_field(default_factory=dict)
+    # field name -> list of float64 values (numeric/date/boolean)
+    numeric_values: Dict[str, List[float]] = dc_field(default_factory=dict)
+    # field name -> float32 vector
+    vectors: Dict[str, np.ndarray] = dc_field(default_factory=dict)
+    # field name -> list of (lat, lon)
+    geo_points: Dict[str, List[Tuple[float, float]]] = dc_field(default_factory=dict)
+    # dynamic mapping updates discovered while parsing (to merge into mapping)
+    dynamic_updates: Dict[str, dict] = dc_field(default_factory=dict)
+
+    def field_names(self) -> List[str]:
+        names = set()
+        for d in (self.text_tokens, self.keyword_terms, self.numeric_values,
+                  self.vectors, self.geo_points):
+            names.update(k for k, v in d.items() if len(v) > 0)
+        return sorted(names)
+
+
+# ---------------------------------------------------------------------------
+# MapperService
+# ---------------------------------------------------------------------------
+
+
+class MapperService:
+    """Holds the resolved mapping for one index and parses documents
+    (reference: ``MapperService.java`` + ``DocumentParser.java:52``).
+
+    ``mappings`` is the ES JSON shape: ``{"properties": {...}}``, optional
+    ``"dynamic"``: true (default) / false / "strict", optional ``"_source"``:
+    ``{"enabled": bool}``.
+    """
+
+    def __init__(self, mappings: Optional[dict] = None,
+                 analysis_registry: Optional[AnalysisRegistry] = None):
+        self.analysis = analysis_registry or AnalysisRegistry()
+        self._fields: Dict[str, MappedFieldType] = {}
+        self._mapping_def: dict = {"properties": {}}
+        self.dynamic: Any = True
+        self.source_enabled = True
+        if mappings:
+            self.merge(mappings)
+
+    # -- mapping management --------------------------------------------------
+
+    def merge(self, mappings: dict) -> None:
+        if not isinstance(mappings, dict):
+            raise MapperParsingError("mapping must be an object")
+        if "dynamic" in mappings:
+            self.dynamic = mappings["dynamic"]
+        if "_source" in mappings:
+            self.source_enabled = bool(mappings["_source"].get("enabled", True))
+        props = mappings.get("properties", {})
+        self._merge_properties("", props)
+        self._rebuild_mapping_def()
+
+    def _merge_properties(self, prefix: str, props: dict) -> None:
+        for name, spec in props.items():
+            if not isinstance(spec, dict):
+                raise MapperParsingError(f"invalid mapping for field [{name}]")
+            full = f"{prefix}{name}"
+            ftype = spec.get("type")
+            if ftype is None and "properties" in spec:
+                ftype = "object"
+            if ftype is None:
+                raise MapperParsingError(f"no type specified for field [{full}]")
+            existing = self._fields.get(full)
+            if existing is not None and existing.type_name != ftype and not (
+                    ftype == "object" and existing.type_name == "object"):
+                raise IllegalArgumentError(
+                    f"mapper [{full}] cannot be changed from type "
+                    f"[{existing.type_name}] to [{ftype}]")
+            if ftype == "object" or ftype == "nested":
+                self._fields[full] = ObjectFieldType(full, {"type": ftype})
+                self._merge_properties(f"{full}.", spec.get("properties", {}))
+                continue
+            self._fields[full] = self._build_field(full, ftype, spec)
+            # multi-fields: "fields": {"raw": {"type": "keyword"}}
+            for sub, subspec in (spec.get("fields") or {}).items():
+                subfull = f"{full}.{sub}"
+                self._fields[subfull] = self._build_field(
+                    subfull, subspec.get("type", "keyword"), subspec)
+
+    def _build_field(self, name: str, ftype: str, spec: dict) -> MappedFieldType:
+        params = {k: v for k, v in spec.items()
+                  if k not in ("type", "properties", "fields")}
+        if ftype == "text":
+            analyzer = self.analysis.get(spec.get("analyzer", "standard"))
+            search_analyzer = (self.analysis.get(spec["search_analyzer"])
+                               if "search_analyzer" in spec else None)
+            return TextFieldType(name, analyzer, search_analyzer, params)
+        if ftype == "keyword":
+            return KeywordFieldType(
+                name, int(spec.get("ignore_above", 2 ** 31 - 1)),
+                spec.get("normalizer") == "lowercase", params)
+        if ftype in NUMERIC_TYPES:
+            return NumberFieldType(name, ftype, params)
+        if ftype == "date":
+            return DateFieldType(
+                name, spec.get("format", "strict_date_optional_time||epoch_millis"),
+                params)
+        if ftype == "boolean":
+            return BooleanFieldType(name, params)
+        if ftype == "dense_vector":
+            if "dims" not in spec:
+                raise MapperParsingError(
+                    f"Missing required parameter [dims] for field [{name}]")
+            return DenseVectorFieldType(name, spec["dims"],
+                                        spec.get("similarity", "cosine"), params)
+        if ftype == "geo_point":
+            return GeoPointFieldType(name, params)
+        raise MapperParsingError(f"No handler for type [{ftype}] declared on field [{name}]")
+
+    def _rebuild_mapping_def(self) -> None:
+        root: dict = {}
+        for name in sorted(self._fields):
+            ft = self._fields[name]
+            parts = name.split(".")
+            # Place under parent's "fields" if parent exists and is a leaf
+            # (multi-field), else nest via "properties".
+            parent = ".".join(parts[:-1])
+            if parent and parent in self._fields and \
+                    not isinstance(self._fields[parent], ObjectFieldType):
+                continue  # rendered inline below as multi-field
+            node = root
+            for p in parts[:-1]:
+                node = node.setdefault(p, {"type": "object", "properties": {}})
+                node = node.setdefault("properties", {})
+            entry = ft.to_mapping()
+            subfields = {
+                n.split(".")[-1]: self._fields[n].to_mapping()
+                for n in self._fields
+                if n.startswith(name + ".") and "." not in n[len(name) + 1:]
+                and not isinstance(ft, ObjectFieldType)}
+            if subfields:
+                entry["fields"] = subfields
+            node[parts[-1]] = entry
+        mapping_def: dict = {"properties": root}
+        if self.dynamic is not True:
+            mapping_def["dynamic"] = self.dynamic
+        if not self.source_enabled:
+            mapping_def["_source"] = {"enabled": False}
+        self._mapping_def = mapping_def
+
+    def mapping_dict(self) -> dict:
+        return self._mapping_def
+
+    def field_type(self, name: str) -> Optional[MappedFieldType]:
+        return self._fields.get(name)
+
+    def field_names(self) -> List[str]:
+        return sorted(self._fields)
+
+    def fields_of_type(self, *type_names: str) -> List[MappedFieldType]:
+        return [f for f in self._fields.values() if f.type_name in type_names]
+
+    # -- document parsing ----------------------------------------------------
+
+    def parse_document(self, doc_id: str, source: dict,
+                       routing: Optional[str] = None) -> ParsedDocument:
+        if not isinstance(source, dict):
+            raise MapperParsingError("document source must be a JSON object")
+        parsed = ParsedDocument(doc_id=doc_id, source=source, routing=routing)
+        self._parse_object("", source, parsed)
+        if parsed.dynamic_updates:
+            self.merge({"properties": parsed.dynamic_updates})
+        return parsed
+
+    def _parse_object(self, prefix: str, obj: dict, parsed: ParsedDocument) -> None:
+        for key, value in obj.items():
+            full = f"{prefix}{key}"
+            if value is None:
+                continue
+            ft = self._fields.get(full)
+            if isinstance(value, dict) and (ft is None or isinstance(ft, ObjectFieldType)):
+                if ft is None:
+                    if self._check_dynamic(full):
+                        self._parse_object(f"{full}.", value, parsed)
+                else:
+                    self._parse_object(f"{full}.", value, parsed)
+                continue
+            if ft is None:
+                ft = self._dynamic_map(full, value, parsed)
+                if ft is None:
+                    continue
+            if isinstance(value, list) and not isinstance(ft, DenseVectorFieldType) \
+                    and not (isinstance(ft, GeoPointFieldType) and value
+                             and isinstance(value[0], numbers.Number)):
+                values = value
+            else:
+                values = [value]
+            for v in values:
+                if v is None:
+                    continue
+                self._index_leaf(ft, full, v, parsed)
+
+    def _maybe_geo(self, full: str, value: dict, parsed: ParsedDocument) -> bool:
+        return False  # dynamic geo detection is off, like the reference default
+
+    def _check_dynamic(self, field: str) -> bool:
+        if self.dynamic == "strict":
+            raise MapperParsingError(
+                f"mapping set to strict, dynamic introduction of [{field}] "
+                f"within [_doc] is not allowed", )
+        return self.dynamic is not False and self.dynamic != "false"
+
+    def _dynamic_map(self, full: str, value: Any,
+                     parsed: ParsedDocument) -> Optional[MappedFieldType]:
+        if not self._check_dynamic(full):
+            return None
+        sample = value[0] if isinstance(value, list) and value else value
+        if sample is None:
+            return None
+        if isinstance(sample, bool):
+            spec = {"type": "boolean"}
+        elif isinstance(sample, int):
+            spec = {"type": "long"}
+        elif isinstance(sample, float):
+            spec = {"type": "double"}
+        elif isinstance(sample, str):
+            spec = {"type": "text", "fields": {"keyword": {
+                "type": "keyword", "ignore_above": 256}}}
+        elif isinstance(sample, list):
+            return None  # empty/odd nested list
+        else:
+            return None
+        # record for merge into the mapping (nested path → nested spec)
+        parts = full.split(".")
+        node = parsed.dynamic_updates
+        for p in parts[:-1]:
+            node = node.setdefault(p, {"type": "object", "properties": {}})
+            node = node.setdefault("properties", {})
+        node[parts[-1]] = spec
+        ft = self._build_field(full, spec["type"], spec)
+        self._fields[full] = ft
+        if "fields" in spec:
+            for sub, subspec in spec["fields"].items():
+                self._fields[f"{full}.{sub}"] = self._build_field(
+                    f"{full}.{sub}", subspec["type"], subspec)
+        return ft
+
+    def _index_leaf(self, ft: MappedFieldType, full: str, value: Any,
+                    parsed: ParsedDocument) -> None:
+        if isinstance(ft, ObjectFieldType):
+            return
+        if isinstance(ft, TextFieldType):
+            text = ft.parse_value(value)
+            toks = parsed.text_tokens.setdefault(full, [])
+            # Lucene places the first token of value N+1 at
+            # last_position + position_increment_gap(100) + 1
+            base_pos = (toks[-1].position + 101) if toks else 0
+            new = ft.analyzer.analyze(text)
+            for t in new:
+                toks.append(Token(t.term, t.position + base_pos,
+                                  t.start_offset, t.end_offset))
+        elif isinstance(ft, KeywordFieldType):
+            v = ft.parse_value(value)
+            if v is not None:
+                parsed.keyword_terms.setdefault(full, []).append(v)
+        elif isinstance(ft, DenseVectorFieldType):
+            parsed.vectors[full] = ft.parse_value(value)
+        elif isinstance(ft, GeoPointFieldType):
+            parsed.geo_points.setdefault(full, []).append(ft.parse_value(value))
+        elif isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType)):
+            parsed.numeric_values.setdefault(full, []).append(ft.parse_value(value))
+        # index multi-fields too
+        for sub_name in list(self._fields):
+            if sub_name.startswith(full + ".") and "." not in sub_name[len(full) + 1:]:
+                sub = self._fields[sub_name]
+                if isinstance(sub, ObjectFieldType) or sub_name == full:
+                    continue
+                if not isinstance(ft, ObjectFieldType) and not isinstance(
+                        sub, (ObjectFieldType,)):
+                    # only leaf multi-fields of leaf parents
+                    if isinstance(sub, KeywordFieldType):
+                        v = sub.parse_value(value)
+                        if v is not None:
+                            parsed.keyword_terms.setdefault(sub_name, []).append(v)
+                    elif isinstance(sub, TextFieldType):
+                        toks = parsed.text_tokens.setdefault(sub_name, [])
+                        base_pos = (toks[-1].position + 101) if toks else 0
+                        for t in sub.analyzer.analyze(str(value)):
+                            toks.append(Token(t.term, t.position + base_pos,
+                                              t.start_offset, t.end_offset))

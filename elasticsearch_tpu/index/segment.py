@@ -1,0 +1,395 @@
+"""Immutable index segments with device-resident postings and doc values.
+
+Re-design of the Lucene segment (the reference's storage unit under
+``index/engine/InternalEngine.java`` — Lucene is a dependency there, see
+SURVEY.md §2.9.1) as TPU-friendly dense arrays:
+
+- text fields   → flat CSR postings ``(doc_ids int32[P], tf float32[P])``
+  with host-side term dictionary / offsets / doc freqs, plus per-doc token
+  counts ``doc_len float32[N]``. Scored eagerly by ``ops/bm25.py``.
+- keyword/numeric/date/boolean fields → (value, doc) pair columns on device
+  for range masks and ``segment_sum`` aggregations; numeric values are stored
+  as float32 *offsets from a per-segment float64 base* so large magnitudes
+  (epoch millis, longs) keep precision on TPU (f64 is not TPU-resident);
+  exact float64 copies stay on the host for sort keys and fetch.
+- dense_vector fields → ``float32[N, D]`` matrices for einsum kNN.
+- term positions stay host-side (numpy CSR) for phrase verification; the
+  candidate set is computed on device first.
+
+A segment is immutable once built; deletes are a host-side liveness bitmask
+(device mask materialized lazily), mirroring Lucene's liveDocs.
+
+All device arrays are padded to power-of-two buckets (``utils/shapes.py``) so
+XLA programs are reused across segments of similar size. Padded doc slots are
+inert: postings never reference them and scatter uses OOB-drop semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.shapes import round_up_pow2
+from .mapping import ParsedDocument
+
+# Deliberately late/lazy jax import so host-only paths (translog replay, etc.)
+# work without touching the device.
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# Per-field data
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TextFieldData:
+    """CSR postings for one text field."""
+
+    term_ids: Dict[str, int]                 # term -> tid
+    df: np.ndarray                           # int32[V] doc freq per term
+    offsets: np.ndarray                      # int64[V+1] into flat postings
+    docs_host: np.ndarray                    # int32[P]
+    tf_host: np.ndarray                      # float32[P]
+    doc_len_host: np.ndarray                 # float32[N]
+    sum_dl: float                            # total tokens in field
+    field_doc_count: int                     # docs that have this field
+    total_term_freq: np.ndarray              # int64[V] sum tf per term
+    pos_offsets: np.ndarray                  # int64[P+1] into pos_flat
+    pos_flat: np.ndarray                     # int32[total positions]
+    docs_dev: jnp.ndarray = None             # int32[P_pad]
+    tf_dev: jnp.ndarray = None               # float32[P_pad]
+    doc_len_dev: jnp.ndarray = None          # float32[N_pad]
+
+    def term_run(self, term: str) -> Tuple[int, int, int]:
+        """(start, length, df) of a term's postings run; absent → (P, 0, 0)."""
+        tid = self.term_ids.get(term)
+        if tid is None:
+            return int(self.docs_host.shape[0]), 0, 0
+        return (int(self.offsets[tid]), int(self.offsets[tid + 1] - self.offsets[tid]),
+                int(self.df[tid]))
+
+    def positions_for(self, term: str, doc: int) -> np.ndarray:
+        """Host-side positions of ``term`` in local doc ``doc`` (for phrase)."""
+        start, length, _ = self.term_run(term)
+        if length == 0:
+            return np.empty(0, np.int32)
+        run = self.docs_host[start:start + length]
+        i = np.searchsorted(run, doc)
+        if i >= length or run[i] != doc:
+            return np.empty(0, np.int32)
+        p = start + i
+        return self.pos_flat[self.pos_offsets[p]:self.pos_offsets[p + 1]]
+
+
+@dataclass
+class KeywordFieldData:
+    """Postings + ordinal doc-values pairs for one keyword field."""
+
+    ord_terms: List[str]                     # ord -> term (sorted)
+    term_ords: Dict[str, int]                # term -> ord
+    df: np.ndarray                           # int32[V]
+    offsets: np.ndarray                      # int64[V+1]
+    docs_host: np.ndarray                    # int32[P] postings doc ids
+    dv_ords_host: np.ndarray                 # int32[M] value ordinal per pair
+    dv_docs_host: np.ndarray                 # int32[M] owning doc per pair
+    docs_dev: jnp.ndarray = None
+    dv_ords_dev: jnp.ndarray = None
+    dv_docs_dev: jnp.ndarray = None
+
+    def term_run(self, term: str) -> Tuple[int, int, int]:
+        o = self.term_ords.get(term)
+        if o is None:
+            return int(self.docs_host.shape[0]), 0, 0
+        return (int(self.offsets[o]), int(self.offsets[o + 1] - self.offsets[o]),
+                int(self.df[o]))
+
+
+@dataclass
+class NumericFieldData:
+    """(value, doc) pair column. Device floats are offsets from ``base``."""
+
+    base: float                              # float64 min value
+    vals_host: np.ndarray                    # float64[M] exact values
+    docs_host: np.ndarray                    # int32[M]
+    vals_off_dev: jnp.ndarray = None         # float32[M_pad] (value - base)
+    docs_dev: jnp.ndarray = None             # int32[M_pad]
+
+
+@dataclass
+class VectorFieldData:
+    matrix_host: np.ndarray                  # float32[N, D]
+    exists: np.ndarray                       # bool[N]
+    matrix_dev: jnp.ndarray = None           # float32[N_pad, D]
+
+
+# ---------------------------------------------------------------------------
+# Segment
+# ---------------------------------------------------------------------------
+
+
+class Segment:
+    """One immutable generation of indexed docs, device arrays attached."""
+
+    def __init__(self, seg_id: str, n_docs: int, doc_uids: List[str],
+                 sources: List[Optional[dict]], seq_nos: np.ndarray,
+                 text_fields: Dict[str, TextFieldData],
+                 keyword_fields: Dict[str, KeywordFieldData],
+                 numeric_fields: Dict[str, NumericFieldData],
+                 vector_fields: Dict[str, VectorFieldData]):
+        self.seg_id = seg_id
+        self.n_docs = n_docs
+        self.n_pad = round_up_pow2(max(n_docs, 1))
+        self.doc_uids = doc_uids
+        self.sources = sources
+        self.seq_nos = seq_nos                      # int64[N]
+        self.text_fields = text_fields
+        self.keyword_fields = keyword_fields
+        self.numeric_fields = numeric_fields
+        self.vector_fields = vector_fields
+        self.live = np.ones(n_docs, dtype=bool)     # host liveness (deletes)
+        self._live_dev: Optional[jnp.ndarray] = None
+        self._uid_to_doc: Dict[str, int] = {u: i for i, u in enumerate(doc_uids)}
+        self._upload()
+
+    # -- device upload -------------------------------------------------------
+
+    def _upload(self) -> None:
+        n_pad = self.n_pad
+        for f in self.text_fields.values():
+            p_pad = round_up_pow2(max(f.docs_host.shape[0], 1))
+            f.docs_dev = jnp.asarray(_pad_to(f.docs_host, p_pad, n_pad), jnp.int32)
+            f.tf_dev = jnp.asarray(_pad_to(f.tf_host, p_pad, 0.0), jnp.float32)
+            f.doc_len_dev = jnp.asarray(_pad_to(f.doc_len_host, n_pad, 0.0),
+                                        jnp.float32)
+        for f in self.keyword_fields.values():
+            p_pad = round_up_pow2(max(f.docs_host.shape[0], 1))
+            m_pad = round_up_pow2(max(f.dv_docs_host.shape[0], 1))
+            f.docs_dev = jnp.asarray(_pad_to(f.docs_host, p_pad, n_pad), jnp.int32)
+            f.dv_ords_dev = jnp.asarray(_pad_to(f.dv_ords_host, m_pad, 0), jnp.int32)
+            f.dv_docs_dev = jnp.asarray(_pad_to(f.dv_docs_host, m_pad, n_pad),
+                                        jnp.int32)
+        for f in self.numeric_fields.values():
+            m_pad = round_up_pow2(max(f.docs_host.shape[0], 1))
+            off = (f.vals_host - f.base).astype(np.float32)
+            f.vals_off_dev = jnp.asarray(_pad_to(off, m_pad, 0.0), jnp.float32)
+            f.docs_dev = jnp.asarray(_pad_to(f.docs_host, m_pad, n_pad), jnp.int32)
+        for f in self.vector_fields.values():
+            d = f.matrix_host.shape[1] if f.matrix_host.size else 0
+            mat = np.zeros((n_pad, d), np.float32)
+            mat[: f.matrix_host.shape[0]] = f.matrix_host
+            f.matrix_dev = jnp.asarray(mat)
+
+    # -- liveness ------------------------------------------------------------
+
+    def delete_doc(self, local_doc: int) -> None:
+        self.live[local_doc] = False
+        self._live_dev = None
+
+    @property
+    def live_dev(self) -> jnp.ndarray:
+        if self._live_dev is None:
+            padded = np.zeros(self.n_pad, dtype=bool)
+            padded[: self.n_docs] = self.live
+            self._live_dev = jnp.asarray(padded)
+        return self._live_dev
+
+    @property
+    def live_count(self) -> int:
+        return int(self.live.sum())
+
+    def find_doc(self, uid: str) -> Optional[int]:
+        d = self._uid_to_doc.get(uid)
+        if d is not None and self.live[d]:
+            return d
+        return None
+
+    # -- stats for idf -------------------------------------------------------
+
+    def field_stats(self, field: str) -> Tuple[float, int]:
+        """(sum_dl, field_doc_count) for avgdl computation."""
+        f = self.text_fields.get(field)
+        if f is None:
+            return 0.0, 0
+        return f.sum_dl, f.field_doc_count
+
+    def term_df(self, field: str, term: str) -> int:
+        f = self.text_fields.get(field)
+        if f is not None:
+            return f.term_run(term)[2]
+        kf = self.keyword_fields.get(field)
+        if kf is not None:
+            return kf.term_run(term)[2]
+        return 0
+
+
+def _pad_to(arr: np.ndarray, size: int, fill) -> np.ndarray:
+    if arr.shape[0] == size:
+        return arr
+    out = np.full(size, fill, dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Builder
+# ---------------------------------------------------------------------------
+
+
+class SegmentBuilder:
+    """Accumulates parsed documents (the in-memory indexing buffer —
+    analogue of Lucene's IndexWriter RAM buffer inside
+    ``index/engine/InternalEngine.java:123``) and freezes them into a
+    :class:`Segment` on refresh."""
+
+    def __init__(self, seg_id: str):
+        self.seg_id = seg_id
+        self.doc_uids: List[str] = []
+        self.sources: List[Optional[dict]] = []
+        self.seq_nos: List[int] = []
+        # field -> term -> list[(doc, tf)] built doc-ascending
+        self._text_postings: Dict[str, Dict[str, List[Tuple[int, int]]]] = {}
+        # field -> term -> doc -> positions
+        self._text_positions: Dict[str, Dict[str, Dict[int, List[int]]]] = {}
+        self._doc_len: Dict[str, Dict[int, int]] = {}
+        self._keyword_postings: Dict[str, Dict[str, List[int]]] = {}
+        self._keyword_values: Dict[str, List[Tuple[int, str]]] = {}  # (doc, term)
+        self._numeric_values: Dict[str, List[Tuple[int, float]]] = {}
+        self._vectors: Dict[str, Dict[int, np.ndarray]] = {}
+
+    def __len__(self) -> int:
+        return len(self.doc_uids)
+
+    @property
+    def n_docs(self) -> int:
+        return len(self.doc_uids)
+
+    def add(self, parsed: ParsedDocument, seq_no: int,
+            store_source: bool = True) -> int:
+        """Index one parsed document; returns its local doc id."""
+        doc = len(self.doc_uids)
+        self.doc_uids.append(parsed.doc_id)
+        self.sources.append(parsed.source if store_source else None)
+        self.seq_nos.append(seq_no)
+
+        for field, tokens in parsed.text_tokens.items():
+            postings = self._text_postings.setdefault(field, {})
+            positions = self._text_positions.setdefault(field, {})
+            per_term_pos: Dict[str, List[int]] = {}
+            for t in tokens:
+                per_term_pos.setdefault(t.term, []).append(t.position)
+            for term, plist in per_term_pos.items():
+                postings.setdefault(term, []).append((doc, len(plist)))
+                positions.setdefault(term, {})[doc] = plist
+            if tokens:
+                self._doc_len.setdefault(field, {})[doc] = len(tokens)
+
+        for field, terms in parsed.keyword_terms.items():
+            postings = self._keyword_postings.setdefault(field, {})
+            values = self._keyword_values.setdefault(field, [])
+            for term in set(terms):
+                postings.setdefault(term, []).append(doc)
+            for term in terms:
+                values.append((doc, term))
+
+        for field, vals in parsed.numeric_values.items():
+            lst = self._numeric_values.setdefault(field, [])
+            for v in vals:
+                lst.append((doc, float(v)))
+
+        for field, vec in parsed.vectors.items():
+            self._vectors.setdefault(field, {})[doc] = vec
+
+        return doc
+
+    def build(self) -> Segment:
+        n = len(self.doc_uids)
+
+        text_fields: Dict[str, TextFieldData] = {}
+        for field, postings in self._text_postings.items():
+            terms_sorted = sorted(postings)
+            term_ids = {t: i for i, t in enumerate(terms_sorted)}
+            v = len(terms_sorted)
+            df = np.zeros(v, np.int32)
+            ttf = np.zeros(v, np.int64)
+            offsets = np.zeros(v + 1, np.int64)
+            total = sum(len(postings[t]) for t in terms_sorted)
+            docs = np.zeros(total, np.int32)
+            tf = np.zeros(total, np.float32)
+            pos_offsets = np.zeros(total + 1, np.int64)
+            pos_chunks: List[List[int]] = []
+            p = 0
+            positions = self._text_positions[field]
+            for i, term in enumerate(terms_sorted):
+                run = postings[term]
+                df[i] = len(run)
+                offsets[i] = p
+                for d, f_ in run:
+                    docs[p] = d
+                    tf[p] = f_
+                    ttf[i] += f_
+                    pos_chunks.append(positions[term][d])
+                    pos_offsets[p + 1] = pos_offsets[p] + f_
+                    p += 1
+                offsets[i + 1] = p
+            pos_flat = (np.concatenate([np.asarray(c, np.int32) for c in pos_chunks])
+                        if pos_chunks else np.empty(0, np.int32))
+            dl_map = self._doc_len.get(field, {})
+            doc_len = np.zeros(n, np.float32)
+            for d, l in dl_map.items():
+                doc_len[d] = l
+            text_fields[field] = TextFieldData(
+                term_ids=term_ids, df=df, offsets=offsets, docs_host=docs,
+                tf_host=tf, doc_len_host=doc_len, sum_dl=float(doc_len.sum()),
+                field_doc_count=len(dl_map), total_term_freq=ttf,
+                pos_offsets=pos_offsets, pos_flat=pos_flat)
+
+        keyword_fields: Dict[str, KeywordFieldData] = {}
+        for field, postings in self._keyword_postings.items():
+            terms_sorted = sorted(postings)
+            term_ords = {t: i for i, t in enumerate(terms_sorted)}
+            v = len(terms_sorted)
+            df = np.zeros(v, np.int32)
+            offsets = np.zeros(v + 1, np.int64)
+            total = sum(len(postings[t]) for t in terms_sorted)
+            docs = np.zeros(total, np.int32)
+            p = 0
+            for i, term in enumerate(terms_sorted):
+                run = postings[term]
+                df[i] = len(run)
+                offsets[i] = p
+                docs[p: p + len(run)] = run
+                p += len(run)
+                offsets[i + 1] = p
+            pairs = self._keyword_values.get(field, [])
+            dv_docs = np.asarray([d for d, _ in pairs], np.int32)
+            dv_ords = np.asarray([term_ords[t] for _, t in pairs], np.int32)
+            keyword_fields[field] = KeywordFieldData(
+                ord_terms=terms_sorted, term_ords=term_ords, df=df,
+                offsets=offsets, docs_host=docs, dv_ords_host=dv_ords,
+                dv_docs_host=dv_docs)
+
+        numeric_fields: Dict[str, NumericFieldData] = {}
+        for field, pairs in self._numeric_values.items():
+            docs = np.asarray([d for d, _ in pairs], np.int32)
+            vals = np.asarray([v for _, v in pairs], np.float64)
+            base = float(vals.min()) if vals.size else 0.0
+            numeric_fields[field] = NumericFieldData(
+                base=base, vals_host=vals, docs_host=docs)
+
+        vector_fields: Dict[str, VectorFieldData] = {}
+        for field, rows in self._vectors.items():
+            dim = next(iter(rows.values())).shape[0]
+            mat = np.zeros((n, dim), np.float32)
+            exists = np.zeros(n, bool)
+            for d, vec in rows.items():
+                mat[d] = vec
+                exists[d] = True
+            vector_fields[field] = VectorFieldData(matrix_host=mat, exists=exists)
+
+        return Segment(self.seg_id, n, list(self.doc_uids), list(self.sources),
+                       np.asarray(self.seq_nos, np.int64), text_fields,
+                       keyword_fields, numeric_fields, vector_fields)

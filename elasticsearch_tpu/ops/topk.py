@@ -1,0 +1,60 @@
+"""Top-k hit selection on device.
+
+Replaces Lucene's ``TopScoreDocCollector`` heap
+(reference: ``search/query/TopDocsCollectorContext.java:215``) with
+``jax.lax.top_k`` over the dense per-segment score array. For large segments a
+two-stage blockwise top-k cuts the sort cost: per-block top-k on the VPU, then
+a final top-k over the small candidate set. Tie-break matches Lucene's
+ascending-doc-id order because ``lax.top_k`` selects the lowest index among
+equal values and block candidates are laid out in doc-id order.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float("-inf")
+
+_BLOCK = 16384          # scores per block in the two-stage path
+_BLOCKWISE_MIN = 1 << 17  # use the two-stage path above this many docs
+
+
+def _topk_kernel(n: int, k: int):
+    use_blocks = n >= _BLOCKWISE_MIN and n % _BLOCK == 0 and k <= _BLOCK
+
+    def kernel(scores, mask):
+        """scores float32[n]; mask bool[n] (False → excluded). Returns
+        (values float32[k], indices int32[k]); excluded slots carry -inf."""
+        masked = jnp.where(mask, scores, NEG_INF)
+        if use_blocks:
+            blocks = masked.reshape(n // _BLOCK, _BLOCK)
+            bvals, bidx = jax.lax.top_k(blocks, k)          # [B, k] each
+            base = (jnp.arange(n // _BLOCK, dtype=jnp.int32) * _BLOCK)[:, None]
+            cand_idx = (bidx.astype(jnp.int32) + base).reshape(-1)
+            cand_vals = bvals.reshape(-1)
+            # Stable global tie-break: candidates are ordered by block, and
+            # within a block top_k returns lowest-index-first for ties, but
+            # across the flattened candidate list equal values from a later
+            # block could sit earlier than a same-valued candidate from an
+            # earlier block only if top_k reordered them — it does not: we
+            # re-sort by (value desc, index asc) explicitly to be safe.
+            order = jnp.lexsort((cand_idx, -cand_vals))
+            cand_vals = cand_vals[order][:k]
+            cand_idx = cand_idx[order][:k]
+            return cand_vals, cand_idx
+        vals, idx = jax.lax.top_k(masked, k)
+        return vals, idx.astype(jnp.int32)
+
+    return jax.jit(kernel)
+
+
+_CACHE: dict = {}
+
+
+def get_topk_kernel(n: int, k: int):
+    key = (n, k)
+    fn = _CACHE.get(key)
+    if fn is None:
+        fn = _CACHE[key] = _topk_kernel(n, k)
+    return fn

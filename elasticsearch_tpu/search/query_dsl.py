@@ -1,0 +1,1023 @@
+"""Query DSL: parse JSON queries and execute them against segments.
+
+Re-design of the reference's query layer (``server/.../index/query/`` — 40+
+``QueryBuilder``s parsed in ``AbstractQueryBuilder.parseInnerQueryBuilder``,
+compiled to Lucene ``Query``s and scored by iterator-based ``BulkScorer``s).
+
+TPU-first execution model: every query evaluates, per segment, to a pair of
+dense device arrays ``(scores float32[N_pad], mask bool[N_pad])`` — eager
+whole-segment scoring (the BM25S insight, see PAPERS.md) instead of doc-at-a-
+time iterators. Compound queries are then pure array algebra:
+
+- ``bool``: AND/OR/NOT on masks, sum of scores over scoring clauses
+  (reference semantics: ``BoolQueryBuilder.java``),
+- ``dis_max``: elementwise max + tie_breaker,
+- ``constant_score``: mask with a constant fill.
+
+This maps the whole query tree onto the VPU with no per-doc control flow, and
+the same arrays feed aggregations (masks) and top-k hit selection downstream.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.errors import IllegalArgumentError, ParsingError
+from ..index.mapping import (
+    BooleanFieldType, DateFieldType, DenseVectorFieldType, KeywordFieldType,
+    MapperService, NumberFieldType, TextFieldType, parse_date_millis)
+from ..index.segment import Segment
+from ..ops.bm25 import DEFAULT_B, DEFAULT_K1, get_bm25_kernel, idf_weight
+from ..ops.masks import get_postings_match_kernel, get_range_mask_kernel
+from ..utils.shapes import round_up_pow2
+
+
+# ---------------------------------------------------------------------------
+# Shard-level execution context
+# ---------------------------------------------------------------------------
+
+
+class ShardContext:
+    """Shard-level stats + segment list for one search. idf/avgdl are
+    cross-segment (Lucene computes them at the IndexSearcher level —
+    ``search/similarity`` stats in ``TermStatistics``)."""
+
+    def __init__(self, segments: List[Segment], mapper: MapperService):
+        self.segments = [s for s in segments if s.n_docs > 0]
+        self.mapper = mapper
+        # Lucene idf uses docCount of the field (docs incl. deleted).
+        self.total_docs = sum(s.n_docs for s in self.segments)
+        self._df_cache: Dict[Tuple[str, str], int] = {}
+        self._field_stats_cache: Dict[str, Tuple[float, int]] = {}
+
+    def term_df(self, field: str, term: str) -> int:
+        key = (field, term)
+        df = self._df_cache.get(key)
+        if df is None:
+            df = sum(s.term_df(field, term) for s in self.segments)
+            self._df_cache[key] = df
+        return df
+
+    def field_avgdl(self, field: str) -> float:
+        stats = self._field_stats_cache.get(field)
+        if stats is None:
+            sum_dl = 0.0
+            doc_count = 0
+            for s in self.segments:
+                sdl, dc = s.field_stats(field)
+                sum_dl += sdl
+                doc_count += dc
+            stats = (sum_dl, doc_count)
+            self._field_stats_cache[field] = stats
+        sum_dl, doc_count = stats
+        return sum_dl / doc_count if doc_count else 1.0
+
+    def field_type(self, name: str):
+        return self.mapper.field_type(name)
+
+
+def _const_result(seg: Segment, score: float, value: bool):
+    n = seg.n_pad
+    if value:
+        mask = jnp.ones(n, jnp.bool_)
+        scores = jnp.full(n, np.float32(score))
+    else:
+        mask = jnp.zeros(n, jnp.bool_)
+        scores = jnp.zeros(n, jnp.float32)
+    return scores, mask
+
+
+# ---------------------------------------------------------------------------
+# Scoring helpers
+# ---------------------------------------------------------------------------
+
+
+def _score_text_terms(ctx: ShardContext, seg: Segment, field: str,
+                      term_weights: Dict[str, float]):
+    """BM25-score a bag of unique terms against one segment's text field.
+    Returns (scores f32[N_pad], matched int32[N_pad], n_unique_terms)."""
+    f = seg.text_fields.get(field)
+    terms = list(term_weights)
+    q = len(terms)
+    if f is None or q == 0:
+        z = jnp.zeros(seg.n_pad, jnp.float32)
+        return z, jnp.zeros(seg.n_pad, jnp.int32), q
+    starts = np.zeros(q, np.int32)
+    lengths = np.zeros(q, np.int32)
+    dfs = np.zeros(q, np.int64)
+    max_len = 1
+    for i, t in enumerate(terms):
+        s, l, _ = f.term_run(t)
+        starts[i], lengths[i] = s, l
+        dfs[i] = ctx.term_df(field, t)
+        max_len = max(max_len, l)
+    L = round_up_pow2(max_len)
+    idf = idf_weight(ctx.total_docs, dfs)
+    weights = np.asarray([term_weights[t] for t in terms], np.float32)
+    avgdl = np.float32(max(ctx.field_avgdl(field), 1e-9))
+    kernel = get_bm25_kernel(seg.n_pad, L)
+    scores, matched = kernel(f.docs_dev, f.tf_dev, f.doc_len_dev, starts,
+                             lengths, idf, weights,
+                             avgdl, np.float32(DEFAULT_K1), np.float32(DEFAULT_B))
+    return scores, matched, q
+
+
+def _keyword_terms_result(ctx: ShardContext, seg: Segment, field: str,
+                          term_weights: Dict[str, float], scored: bool):
+    """Match keyword terms. When ``scored``, per-term score is idf × weight
+    (norms disabled → LegacyBM25 collapses to idf for tf=1; reference:
+    Lucene BM25 with omitNorms, selected by ``KeywordFieldMapper``)."""
+    f = seg.keyword_fields.get(field)
+    terms = list(term_weights)
+    q = len(terms)
+    if f is None or q == 0:
+        return (jnp.zeros(seg.n_pad, jnp.float32),
+                jnp.zeros(seg.n_pad, jnp.int32), q)
+    starts = np.zeros(q, np.int32)
+    lengths = np.zeros(q, np.int32)
+    dfs = np.zeros(q, np.int64)
+    max_len = 1
+    for i, t in enumerate(terms):
+        s, l, _ = f.term_run(t)
+        starts[i], lengths[i] = s, l
+        dfs[i] = ctx.term_df(field, t)
+        max_len = max(max_len, l)
+    L = round_up_pow2(max_len)
+    if scored:
+        idf = idf_weight(ctx.total_docs, dfs)
+        weights = np.asarray([term_weights[t] for t in terms], np.float32)
+        kernel = get_bm25_kernel(seg.n_pad, L)
+        # norms disabled → b=0 and tf=1, so the BM25 kernel reduces to idf
+        scores, matched = kernel(
+            f.docs_dev, jnp.ones(f.docs_dev.shape[0], jnp.float32),
+            jnp.zeros(seg.n_pad, jnp.float32), starts, lengths, idf, weights,
+            np.float32(1.0), np.float32(DEFAULT_K1), np.float32(0.0))
+        return scores, matched, q
+    kernel = get_postings_match_kernel(seg.n_pad, L)
+    matched = kernel(f.docs_dev, starts, lengths)
+    return jnp.zeros(seg.n_pad, jnp.float32), matched, q
+
+
+# ---------------------------------------------------------------------------
+# minimum_should_match (reference: common/lucene/search/Queries.java)
+# ---------------------------------------------------------------------------
+
+_MSM_PART = re.compile(r"^\s*(-?\d+)(%?)\s*$")
+
+
+def resolve_minimum_should_match(spec, clause_count: int) -> int:
+    if spec is None:
+        return 0
+    if isinstance(spec, int):
+        result = spec
+    else:
+        s = str(spec)
+        if "<" in s:
+            # "N<spec" conditional: if clause_count > N apply spec, else all
+            # clauses are required (reference: Queries.calculateMinShouldMatch)
+            chosen = None
+            for part in s.split():
+                if "<" not in part:
+                    continue
+                cond, _, val = part.partition("<")
+                if clause_count > int(cond):
+                    chosen = val
+            if chosen is None:
+                return clause_count
+            s = chosen
+        m = _MSM_PART.match(s)
+        if not m:
+            raise ParsingError(f"invalid minimum_should_match [{spec}]")
+        if m.group(2):
+            pct = int(m.group(1))
+            calc = int(abs(pct) / 100.0 * clause_count)
+            result = calc if pct >= 0 else clause_count - calc
+        else:
+            result = int(m.group(1))
+    if result < 0:
+        result = clause_count + result
+    return max(0, min(result, clause_count))
+
+
+# ---------------------------------------------------------------------------
+# Query tree
+# ---------------------------------------------------------------------------
+
+
+class Query:
+    boost: float = 1.0
+
+    def execute(self, ctx: ShardContext, seg: Segment):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class MatchAllQuery(Query):
+    def __init__(self, boost: float = 1.0):
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        return _const_result(seg, self.boost, True)
+
+
+class MatchNoneQuery(Query):
+    def execute(self, ctx, seg):
+        return _const_result(seg, 0.0, False)
+
+
+class MatchQuery(Query):
+    """Full-text match (reference: ``index/query/MatchQueryBuilder.java``).
+    Analyzes the text with the field's search analyzer; OR semantics by
+    default, ``operator=and`` / ``minimum_should_match`` supported."""
+
+    def __init__(self, field: str, text, operator: str = "or",
+                 minimum_should_match=None, boost: float = 1.0,
+                 analyzer: Optional[str] = None):
+        self.field = field
+        self.text = text
+        self.operator = operator.lower()
+        self.msm = minimum_should_match
+        self.boost = boost
+        self.analyzer = analyzer
+
+    def _analyze(self, ctx: ShardContext) -> List[str]:
+        ft = ctx.field_type(self.field)
+        if isinstance(ft, TextFieldType):
+            analyzer = (ctx.mapper.analysis.get(self.analyzer)
+                        if self.analyzer else ft.search_analyzer)
+            return analyzer.terms(str(self.text))
+        if isinstance(ft, KeywordFieldType):
+            v = ft.parse_value(self.text)  # applies normalizer/ignore_above
+            return [v] if v is not None else []
+        return [str(self.text)]
+
+    def execute(self, ctx, seg):
+        ft = ctx.field_type(self.field)
+        if ft is None:
+            return _const_result(seg, 0.0, False)
+        if isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType)):
+            return TermQuery(self.field, self.text, self.boost).execute(ctx, seg)
+        terms = self._analyze(ctx)
+        if not terms:
+            return _const_result(seg, 0.0, False)
+        weights: Dict[str, float] = {}
+        for t in terms:
+            weights[t] = weights.get(t, 0.0) + 1.0
+        if isinstance(ft, KeywordFieldType):
+            scores, matched, q = _keyword_terms_result(ctx, seg, self.field,
+                                                       weights, scored=True)
+        else:
+            scores, matched, q = _score_text_terms(ctx, seg, self.field, weights)
+        n_required = q if self.operator == "and" else \
+            max(1, resolve_minimum_should_match(self.msm, q))
+        mask = matched >= n_required
+        return scores * np.float32(self.boost), mask
+
+
+class MatchPhraseQuery(Query):
+    """Phrase match (reference: ``MatchPhraseQueryBuilder.java``). Candidate
+    docs are computed on device (AND of terms); exact position adjacency is
+    verified host-side against the segment's position CSR, and BM25 is scored
+    with tf = phrase frequency, matching Lucene's PhraseQuery scoring."""
+
+    def __init__(self, field: str, text, slop: int = 0, boost: float = 1.0):
+        self.field = field
+        self.text = text
+        self.slop = int(slop)
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        ft = ctx.field_type(self.field)
+        if ft is None:
+            return _const_result(seg, 0.0, False)
+        if not isinstance(ft, TextFieldType):
+            return TermQuery(self.field, self.text, self.boost).execute(ctx, seg)
+        terms = ft.search_analyzer.terms(str(self.text))
+        if not terms:
+            return _const_result(seg, 0.0, False)
+        if len(terms) == 1:
+            q = MatchQuery(self.field, self.text, boost=self.boost)
+            return q.execute(ctx, seg)
+        f = seg.text_fields.get(self.field)
+        if f is None:
+            return _const_result(seg, 0.0, False)
+        weights = {t: 1.0 for t in terms}
+        _, matched, q = _score_text_terms(ctx, seg, self.field, weights)
+        cand = np.asarray(matched >= q)[: seg.n_docs].nonzero()[0]
+        scores_host = np.zeros(seg.n_pad, np.float32)
+        mask_host = np.zeros(seg.n_pad, bool)
+        if cand.size:
+            dfs = [ctx.term_df(self.field, t) for t in set(terms)]
+            # Lucene phrase idf: sum of per-term idfs
+            phrase_idf = float(idf_weight(ctx.total_docs, dfs).sum())
+            avgdl = max(ctx.field_avgdl(self.field), 1e-9)
+            k1, b = DEFAULT_K1, DEFAULT_B
+            for d in cand:
+                freq = _phrase_freq(f, terms, int(d), self.slop)
+                if freq > 0:
+                    dl = float(f.doc_len_host[d])
+                    norm = freq + k1 * (1 - b + b * dl / avgdl)
+                    scores_host[d] = phrase_idf * (k1 + 1) * freq / norm
+                    mask_host[d] = True
+        return (jnp.asarray(scores_host * np.float32(self.boost)),
+                jnp.asarray(mask_host))
+
+
+def _phrase_freq(f, terms: List[str], doc: int, slop: int) -> float:
+    """Count phrase occurrences in one doc. slop=0 → exact adjacency; slop>0
+    uses a simplified sloppy match (within-window, order-insensitive pairs),
+    an approximation of Lucene's SloppyPhraseMatcher."""
+    pos_lists = []
+    for i, t in enumerate(terms):
+        p = f.positions_for(t, doc)
+        if p.size == 0:
+            return 0.0
+        pos_lists.append(np.asarray(p, np.int64) - i)
+    if slop == 0:
+        common = pos_lists[0]
+        for p in pos_lists[1:]:
+            common = np.intersect1d(common, p, assume_unique=True)
+            if common.size == 0:
+                return 0.0
+        return float(common.size)
+    count = 0
+    for start in pos_lists[0]:
+        ok = all(np.abs(p - start).min() <= slop for p in pos_lists[1:])
+        if ok:
+            count += 1
+    return float(count)
+
+
+class TermQuery(Query):
+    """Exact term (reference: ``TermQueryBuilder.java``). Text fields score
+    BM25 on the unanalyzed term; keyword fields score idf; numeric/date/bool
+    behave as an equality filter with constant score."""
+
+    def __init__(self, field: str, value, boost: float = 1.0):
+        self.field = field
+        self.value = value
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        ft = ctx.field_type(self.field)
+        if ft is None:
+            return _const_result(seg, 0.0, False)
+        if isinstance(ft, TextFieldType):
+            scores, matched, _ = _score_text_terms(
+                ctx, seg, self.field, {str(self.value): 1.0})
+            return scores * np.float32(self.boost), matched > 0
+        if isinstance(ft, KeywordFieldType):
+            v = ft.parse_value(self.value)
+            scores, matched, _ = _keyword_terms_result(
+                ctx, seg, self.field, {v: 1.0}, scored=True)
+            return scores * np.float32(self.boost), matched > 0
+        if isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType)):
+            val = ft.parse_value(self.value)
+            return _numeric_range_result(seg, self.field, val, val, self.boost)
+        return _const_result(seg, 0.0, False)
+
+
+class TermsQuery(Query):
+    """Terms disjunction, constant score (reference: ``TermsQueryBuilder``
+    rewrites to a constant-score set query)."""
+
+    def __init__(self, field: str, values: List, boost: float = 1.0):
+        self.field = field
+        self.values = values
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        ft = ctx.field_type(self.field)
+        if ft is None or not self.values:
+            return _const_result(seg, 0.0, False)
+        if isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType)):
+            mask = jnp.zeros(seg.n_pad, jnp.bool_)
+            for v in self.values:
+                val = ft.parse_value(v)
+                _, m = _numeric_range_result(seg, self.field, val, val, 1.0)
+                mask = mask | m
+            return jnp.where(mask, np.float32(self.boost), 0.0), mask
+        if isinstance(ft, KeywordFieldType):
+            weights = {}
+            for v in self.values:
+                pv = ft.parse_value(v)
+                if pv is not None:
+                    weights[pv] = 1.0
+            _, matched, _ = _keyword_terms_result(ctx, seg, self.field,
+                                                  weights, scored=False)
+        else:
+            weights = {str(v): 1.0 for v in self.values}
+            _, matched, _ = _score_text_terms(ctx, seg, self.field, weights)
+        mask = matched > 0
+        return jnp.where(mask, np.float32(self.boost), 0.0), mask
+
+
+def _f32_lower_bound(bound: float, inclusive: bool) -> np.float32:
+    """Largest-correct f32 lower bound: inclusive keeps values == bound,
+    exclusive admits only values > bound (bounds are in f32 offset space but
+    computed from exact f64; casts must round conservatively)."""
+    b32 = np.float32(bound)
+    if inclusive:
+        if np.float64(b32) > bound:
+            b32 = np.nextafter(b32, np.float32(-np.inf))
+    else:
+        if np.float64(b32) <= bound:
+            b32 = np.nextafter(b32, np.float32(np.inf))
+    return b32
+
+
+def _f32_upper_bound(bound: float, inclusive: bool) -> np.float32:
+    b32 = np.float32(bound)
+    if inclusive:
+        if np.float64(b32) < bound:
+            b32 = np.nextafter(b32, np.float32(np.inf))
+    else:
+        if np.float64(b32) >= bound:
+            b32 = np.nextafter(b32, np.float32(-np.inf))
+    return b32
+
+
+def _numeric_range_result(seg: Segment, field: str, lo, hi, boost,
+                          include_lo=True, include_hi=True):
+    """Range mask over a numeric field's (value, doc) pairs. Bounds arrive in
+    value space (float64) and are shifted to the segment's f32 offset space
+    with conservative rounding so gt/gte/lt/lte stay exact for values that
+    are exactly representable after the base-offset shift."""
+    nf = seg.numeric_fields.get(field)
+    if nf is None:
+        return _const_result(seg, 0.0, False)
+    lo_off = (np.float32(-3.0e38) if lo is None
+              else _f32_lower_bound(float(lo) - nf.base, include_lo))
+    hi_off = (np.float32(3.0e38) if hi is None
+              else _f32_upper_bound(float(hi) - nf.base, include_hi))
+    kernel = get_range_mask_kernel(seg.n_pad)
+    mask = kernel(nf.vals_off_dev, nf.docs_dev, lo_off, hi_off)
+    scores = jnp.where(mask, np.float32(boost), 0.0)
+    return scores, mask
+
+
+class RangeQuery(Query):
+    """Range (reference: ``RangeQueryBuilder.java``). Constant-score."""
+
+    def __init__(self, field: str, gte=None, gt=None, lte=None, lt=None,
+                 boost: float = 1.0, date_format: Optional[str] = None):
+        self.field = field
+        self.gte, self.gt, self.lte, self.lt = gte, gt, lte, lt
+        self.boost = boost
+        self.date_format = date_format
+
+    def execute(self, ctx, seg):
+        ft = ctx.field_type(self.field)
+        if ft is None:
+            return _const_result(seg, 0.0, False)
+        if isinstance(ft, (NumberFieldType, BooleanFieldType)):
+            lo = self.gte if self.gte is not None else self.gt
+            hi = self.lte if self.lte is not None else self.lt
+            lo_v = float(lo) if lo is not None else None
+            hi_v = float(hi) if hi is not None else None
+            return _numeric_range_result(
+                seg, self.field, lo_v, hi_v, self.boost,
+                include_lo=self.gt is None, include_hi=self.lt is None)
+        if isinstance(ft, DateFieldType):
+            fmt = self.date_format or ft.format
+            lo = self.gte if self.gte is not None else self.gt
+            hi = self.lte if self.lte is not None else self.lt
+            lo_v = parse_date_millis(lo, fmt) if lo is not None else None
+            hi_v = parse_date_millis(hi, fmt) if hi is not None else None
+            return _numeric_range_result(
+                seg, self.field, lo_v, hi_v, self.boost,
+                include_lo=self.gt is None, include_hi=self.lt is None)
+        if isinstance(ft, KeywordFieldType):
+            return self._keyword_range(seg)
+        raise IllegalArgumentError(
+            f"range query not supported on field [{self.field}] of type "
+            f"[{ft.type_name}]")
+
+    def _keyword_range(self, seg):
+        f = seg.keyword_fields.get(self.field)
+        if f is None:
+            return _const_result(seg, 0.0, False)
+        import bisect
+        terms = f.ord_terms
+        lo_ord = 0
+        hi_ord = len(terms) - 1
+        if self.gte is not None:
+            lo_ord = bisect.bisect_left(terms, str(self.gte))
+        elif self.gt is not None:
+            lo_ord = bisect.bisect_right(terms, str(self.gt))
+        if self.lte is not None:
+            hi_ord = bisect.bisect_right(terms, str(self.lte)) - 1
+        elif self.lt is not None:
+            hi_ord = bisect.bisect_left(terms, str(self.lt)) - 1
+        if lo_ord > hi_ord:
+            return _const_result(seg, 0.0, False)
+        kernel = get_range_mask_kernel(seg.n_pad)
+        mask = kernel(f.dv_ords_dev.astype(jnp.float32), f.dv_docs_dev,
+                      np.float32(lo_ord), np.float32(hi_ord))
+        return jnp.where(mask, np.float32(self.boost), 0.0), mask
+
+
+class ExistsQuery(Query):
+    def __init__(self, field: str, boost: float = 1.0):
+        self.field = field
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        exists = np.zeros(seg.n_pad, bool)
+        tf_ = seg.text_fields.get(self.field)
+        if tf_ is not None:
+            exists[: seg.n_docs] |= tf_.doc_len_host > 0
+        kf = seg.keyword_fields.get(self.field)
+        if kf is not None:
+            exists[kf.dv_docs_host] = True
+        nf = seg.numeric_fields.get(self.field)
+        if nf is not None:
+            exists[nf.docs_host] = True
+        vf = seg.vector_fields.get(self.field)
+        if vf is not None:
+            exists[: seg.n_docs] |= vf.exists
+        # also any subfield counts? reference: exists matches docs with any
+        # indexed value for the exact field name only.
+        mask = jnp.asarray(exists)
+        return jnp.where(mask, np.float32(self.boost), 0.0), mask
+
+
+class IdsQuery(Query):
+    def __init__(self, values: List[str], boost: float = 1.0):
+        self.values = [str(v) for v in values]
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        mask = np.zeros(seg.n_pad, bool)
+        for uid in self.values:
+            d = seg.find_doc(uid)
+            if d is not None:
+                mask[d] = True
+        m = jnp.asarray(mask)
+        return jnp.where(m, np.float32(self.boost), 0.0), m
+
+
+class PrefixQuery(Query):
+    """Prefix (reference: ``PrefixQueryBuilder.java``). Terms are sorted at
+    segment build, so a prefix is a contiguous term-id range → its postings
+    are one contiguous flat slice; a single-run mask kernel covers it."""
+
+    def __init__(self, field: str, value: str, boost: float = 1.0):
+        self.field = field
+        self.value = str(value)
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        import bisect
+        ft = ctx.field_type(self.field)
+        value = self.value
+        f = seg.text_fields.get(self.field)
+        if f is not None:
+            # term_ids insertion order is sorted term order (segment build)
+            terms_sorted = list(f.term_ids)
+            offsets = f.offsets
+            docs_dev = f.docs_dev
+        else:
+            kf = seg.keyword_fields.get(self.field)
+            if kf is None:
+                return _const_result(seg, 0.0, False)
+            if isinstance(ft, KeywordFieldType):
+                value = ft.parse_value(value) or value
+            terms_sorted = kf.ord_terms
+            offsets = kf.offsets
+            docs_dev = kf.docs_dev
+        lo = bisect.bisect_left(terms_sorted, value)
+        hi = bisect.bisect_left(terms_sorted,
+                                value[:-1] + chr(ord(value[-1]) + 1)
+                                if value else chr(0x10FFFF))
+        if lo >= hi:
+            return _const_result(seg, 0.0, False)
+        start = int(offsets[lo])
+        length = int(offsets[hi] - offsets[lo])
+        L = round_up_pow2(length)
+        kernel = get_postings_match_kernel(seg.n_pad, L)
+        matched = kernel(docs_dev, np.asarray([start], np.int32),
+                         np.asarray([length], np.int32))
+        mask = matched > 0
+        return jnp.where(mask, np.float32(self.boost), 0.0), mask
+
+
+class WildcardQuery(Query):
+    """Wildcard/regexp: host-side term-dictionary scan → postings union mask
+    (uploads a host-computed doc mask; term dictionaries are host-resident)."""
+
+    def __init__(self, field: str, pattern: str, boost: float = 1.0,
+                 is_regexp: bool = False):
+        self.field = field
+        self.pattern = pattern
+        self.boost = boost
+        if is_regexp:
+            # Lucene regexp is anchored at both ends
+            self._re = re.compile(f"(?:{pattern})\\Z")
+        else:
+            esc = re.escape(pattern).replace(r"\*", ".*").replace(r"\?", ".")
+            self._re = re.compile(f"{esc}\\Z")
+
+    def execute(self, ctx, seg):
+        mask = np.zeros(seg.n_pad, bool)
+        f = seg.text_fields.get(self.field)
+        if f is not None:
+            for term, tid in f.term_ids.items():
+                if self._re.match(term):
+                    s, e = int(f.offsets[tid]), int(f.offsets[tid + 1])
+                    mask[f.docs_host[s:e]] = True
+        kf = seg.keyword_fields.get(self.field)
+        if kf is not None:
+            for term, o in kf.term_ords.items():
+                if self._re.match(term):
+                    s, e = int(kf.offsets[o]), int(kf.offsets[o + 1])
+                    mask[kf.docs_host[s:e]] = True
+        m = jnp.asarray(mask)
+        return jnp.where(m, np.float32(self.boost), 0.0), m
+
+
+class FuzzyQuery(Query):
+    """Fuzzy term matching by Damerau–Levenshtein distance over the term
+    dictionary (host side), constant-score union like wildcard.
+    Reference: ``FuzzyQueryBuilder.java`` (AUTO fuzziness)."""
+
+    def __init__(self, field: str, value: str, fuzziness="AUTO",
+                 prefix_length: int = 0, boost: float = 1.0):
+        self.field = field
+        self.value = str(value)
+        self.boost = boost
+        self.prefix_length = int(prefix_length)
+        if fuzziness in ("AUTO", "auto", None):
+            n = len(self.value)
+            self.max_edits = 0 if n <= 2 else (1 if n <= 5 else 2)
+        else:
+            self.max_edits = int(fuzziness)
+
+    def _matches(self, term: str) -> bool:
+        if self.prefix_length and \
+                term[: self.prefix_length] != self.value[: self.prefix_length]:
+            return False
+        return _edit_distance_le(term, self.value, self.max_edits)
+
+    def execute(self, ctx, seg):
+        mask = np.zeros(seg.n_pad, bool)
+        f = seg.text_fields.get(self.field)
+        if f is not None:
+            for term, tid in f.term_ids.items():
+                if self._matches(term):
+                    s, e = int(f.offsets[tid]), int(f.offsets[tid + 1])
+                    mask[f.docs_host[s:e]] = True
+        kf = seg.keyword_fields.get(self.field)
+        if kf is not None:
+            for term, o in kf.term_ords.items():
+                if self._matches(term):
+                    s, e = int(kf.offsets[o]), int(kf.offsets[o + 1])
+                    mask[kf.docs_host[s:e]] = True
+        m = jnp.asarray(mask)
+        return jnp.where(m, np.float32(self.boost), 0.0), m
+
+
+def _edit_distance_le(a: str, b: str, k: int) -> bool:
+    """Damerau–Levenshtein distance <= k (early-exit banded DP)."""
+    if k == 0:
+        return a == b
+    if abs(len(a) - len(b)) > k:
+        return False
+    prev2: list = []
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        for j, cb in enumerate(b, 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                         prev[j - 1] + (ca != cb))
+            if i > 1 and j > 1 and ca == b[j - 2] and a[i - 2] == cb:
+                cur[j] = min(cur[j], prev2[j - 2] + 1)
+        prev2, prev = prev, cur
+        if min(prev) > k:
+            return False
+    return prev[-1] <= k
+
+
+class BoolQuery(Query):
+    """Boolean composition (reference: ``BoolQueryBuilder.java``): must and
+    should contribute scores; filter and must_not only constrain the mask."""
+
+    def __init__(self, must=None, filter=None, should=None, must_not=None,
+                 minimum_should_match=None, boost: float = 1.0):
+        self.must: List[Query] = must or []
+        self.filter: List[Query] = filter or []
+        self.should: List[Query] = should or []
+        self.must_not: List[Query] = must_not or []
+        self.msm = minimum_should_match
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        n = seg.n_pad
+        scores = jnp.zeros(n, jnp.float32)
+        mask = None
+        for q in self.must:
+            s, m = q.execute(ctx, seg)
+            scores = scores + s
+            mask = m if mask is None else (mask & m)
+        for q in self.filter:
+            _, m = q.execute(ctx, seg)
+            mask = m if mask is None else (mask & m)
+        should_count = None
+        if self.should:
+            should_count = jnp.zeros(n, jnp.int32)
+            for q in self.should:
+                s, m = q.execute(ctx, seg)
+                scores = scores + jnp.where(m, s, 0.0)
+                should_count = should_count + m.astype(jnp.int32)
+        if self.msm is not None:
+            required = resolve_minimum_should_match(self.msm, len(self.should))
+        else:
+            required = 0
+        if not self.must and not self.filter:
+            # no required clauses → at least one should must match, even with
+            # an explicit minimum_should_match of 0 (Lucene Boolean2Scorer)
+            required = max(required, 1)
+        if should_count is not None and required > 0:
+            sm = should_count >= required
+            mask = sm if mask is None else (mask & sm)
+        elif mask is None:
+            # only must_not (or empty): start from all docs
+            mask = jnp.ones(n, jnp.bool_)
+        for q in self.must_not:
+            _, m = q.execute(ctx, seg)
+            mask = mask & ~m
+        scores = jnp.where(mask, scores, 0.0) * np.float32(self.boost)
+        return scores, mask
+
+
+class ConstantScoreQuery(Query):
+    def __init__(self, inner: Query, boost: float = 1.0):
+        self.inner = inner
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        _, mask = self.inner.execute(ctx, seg)
+        return jnp.where(mask, np.float32(self.boost), 0.0), mask
+
+
+class DisMaxQuery(Query):
+    def __init__(self, queries: List[Query], tie_breaker: float = 0.0,
+                 boost: float = 1.0):
+        self.queries = queries
+        self.tie_breaker = float(tie_breaker)
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        n = seg.n_pad
+        best = jnp.zeros(n, jnp.float32)
+        total = jnp.zeros(n, jnp.float32)
+        mask = jnp.zeros(n, jnp.bool_)
+        for q in self.queries:
+            s, m = q.execute(ctx, seg)
+            s = jnp.where(m, s, 0.0)
+            best = jnp.maximum(best, s)
+            total = total + s
+            mask = mask | m
+        scores = best + self.tie_breaker * (total - best)
+        return scores * np.float32(self.boost), mask
+
+
+class BoostingQuery(Query):
+    def __init__(self, positive: Query, negative: Query,
+                 negative_boost: float, boost: float = 1.0):
+        self.positive = positive
+        self.negative = negative
+        self.negative_boost = float(negative_boost)
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        s, m = self.positive.execute(ctx, seg)
+        _, nm = self.negative.execute(ctx, seg)
+        scores = jnp.where(nm, s * np.float32(self.negative_boost), s)
+        return scores * np.float32(self.boost), m
+
+
+class NestedQuery(Query):
+    """v1: nested docs are flattened at index time, so `nested` delegates to
+    its inner query (correct for single-valued nesting; multi-valued cross-
+    object matching semantics are a known gap vs the reference's
+    ``modules/parent-join`` + nested docs)."""
+
+    def __init__(self, path: str, inner: Query, boost: float = 1.0):
+        self.path = path
+        self.inner = inner
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        s, m = self.inner.execute(ctx, seg)
+        return s * np.float32(self.boost), m
+
+
+# ---------------------------------------------------------------------------
+# Parsing (reference: each QueryBuilder's fromXContent)
+# ---------------------------------------------------------------------------
+
+
+def parse_query(spec: dict) -> Query:
+    if not isinstance(spec, dict) or len(spec) != 1:
+        raise ParsingError(
+            "query malformed, expected a single top-level query clause")
+    (qtype, body), = spec.items()
+    parser = _PARSERS.get(qtype)
+    if parser is None:
+        raise ParsingError(f"unknown query [{qtype}]")
+    return parser(body)
+
+
+def _field_body(body: dict, value_key: str):
+    """Handle the `{field: {value_key: v, ...opts}}` and `{field: v}` forms."""
+    if not isinstance(body, dict) or len(body) != 1:
+        raise ParsingError("expected a single field name")
+    (field, spec), = body.items()
+    if isinstance(spec, dict):
+        opts = dict(spec)
+        value = opts.pop(value_key, None)
+        if value is None and value_key == "value":
+            value = opts.pop("query", None)
+        return field, value, opts
+    return field, spec, {}
+
+
+def _parse_match(body):
+    field, value, opts = _field_body(body, "query")
+    return MatchQuery(field, value, opts.get("operator", "or"),
+                      opts.get("minimum_should_match"),
+                      float(opts.get("boost", 1.0)), opts.get("analyzer"))
+
+
+def _parse_match_phrase(body):
+    field, value, opts = _field_body(body, "query")
+    return MatchPhraseQuery(field, value, int(opts.get("slop", 0)),
+                            float(opts.get("boost", 1.0)))
+
+
+def _parse_term(body):
+    field, value, opts = _field_body(body, "value")
+    return TermQuery(field, value, float(opts.get("boost", 1.0)))
+
+
+def _parse_terms(body):
+    opts = dict(body)
+    boost = float(opts.pop("boost", 1.0))
+    if len(opts) != 1:
+        raise ParsingError("[terms] query requires exactly one field")
+    (field, values), = opts.items()
+    if not isinstance(values, list):
+        raise ParsingError("[terms] query requires an array of values")
+    return TermsQuery(field, values, boost)
+
+
+def _parse_range(body):
+    if len(body) != 1:
+        raise ParsingError("[range] query requires exactly one field")
+    (field, spec), = body.items()
+    opts = dict(spec)
+    # legacy from/to support
+    if "from" in opts:
+        opts.setdefault("gte" if opts.pop("include_lower", True) else "gt",
+                        opts.pop("from"))
+    if "to" in opts:
+        opts.setdefault("lte" if opts.pop("include_upper", True) else "lt",
+                        opts.pop("to"))
+    return RangeQuery(field, opts.get("gte"), opts.get("gt"), opts.get("lte"),
+                      opts.get("lt"), float(opts.get("boost", 1.0)),
+                      opts.get("format"))
+
+
+def _parse_bool(body):
+    def clause(name):
+        c = body.get(name)
+        if c is None:
+            return []
+        if isinstance(c, dict):
+            c = [c]
+        return [parse_query(q) for q in c]
+
+    return BoolQuery(clause("must"), clause("filter"), clause("should"),
+                     clause("must_not"), body.get("minimum_should_match"),
+                     float(body.get("boost", 1.0)))
+
+
+def _parse_dis_max(body):
+    return DisMaxQuery([parse_query(q) for q in body.get("queries", [])],
+                       float(body.get("tie_breaker", 0.0)),
+                       float(body.get("boost", 1.0)))
+
+
+def _parse_constant_score(body):
+    return ConstantScoreQuery(parse_query(body["filter"]),
+                              float(body.get("boost", 1.0)))
+
+
+def _parse_exists(body):
+    return ExistsQuery(body["field"], float(body.get("boost", 1.0)))
+
+
+def _parse_ids(body):
+    return IdsQuery(body.get("values", []), float(body.get("boost", 1.0)))
+
+
+def _parse_prefix(body):
+    field, value, opts = _field_body(body, "value")
+    return PrefixQuery(field, value, float(opts.get("boost", 1.0)))
+
+
+def _parse_wildcard(body):
+    field, value, opts = _field_body(body, "value")
+    if value is None:
+        value = opts.pop("wildcard", None)
+    return WildcardQuery(field, value, float(opts.get("boost", 1.0)))
+
+
+def _parse_regexp(body):
+    field, value, opts = _field_body(body, "value")
+    return WildcardQuery(field, value, float(opts.get("boost", 1.0)),
+                         is_regexp=True)
+
+
+def _parse_fuzzy(body):
+    field, value, opts = _field_body(body, "value")
+    return FuzzyQuery(field, value, opts.get("fuzziness", "AUTO"),
+                      int(opts.get("prefix_length", 0)),
+                      float(opts.get("boost", 1.0)))
+
+
+def _parse_boosting(body):
+    return BoostingQuery(parse_query(body["positive"]),
+                         parse_query(body["negative"]),
+                         float(body.get("negative_boost", 0.5)),
+                         float(body.get("boost", 1.0)))
+
+
+def _parse_nested(body):
+    return NestedQuery(body.get("path", ""), parse_query(body["query"]),
+                       float(body.get("boost", 1.0)))
+
+
+def _parse_multi_match(body):
+    fields = body.get("fields") or []
+    text = body.get("query")
+    mtype = body.get("type", "best_fields")
+    tie = float(body.get("tie_breaker", 0.0))
+    queries: List[Query] = []
+    for f in fields:
+        boost = 1.0
+        if "^" in f:
+            f, _, b = f.partition("^")
+            boost = float(b)
+        queries.append(MatchQuery(f, text, body.get("operator", "or"),
+                                  body.get("minimum_should_match"), boost))
+    if not queries:
+        return MatchNoneQuery()
+    if mtype in ("best_fields", "phrase"):
+        return DisMaxQuery(queries, tie, float(body.get("boost", 1.0)))
+    # most_fields: sum of field scores
+    return BoolQuery(should=queries, boost=float(body.get("boost", 1.0)))
+
+
+def _parse_match_all(body):
+    return MatchAllQuery(float((body or {}).get("boost", 1.0)))
+
+
+def _parse_match_none(body):
+    return MatchNoneQuery()
+
+
+_PARSERS = {
+    "match_all": _parse_match_all,
+    "match_none": _parse_match_none,
+    "match": _parse_match,
+    "match_phrase": _parse_match_phrase,
+    "multi_match": _parse_multi_match,
+    "term": _parse_term,
+    "terms": _parse_terms,
+    "range": _parse_range,
+    "bool": _parse_bool,
+    "dis_max": _parse_dis_max,
+    "constant_score": _parse_constant_score,
+    "exists": _parse_exists,
+    "ids": _parse_ids,
+    "prefix": _parse_prefix,
+    "wildcard": _parse_wildcard,
+    "regexp": _parse_regexp,
+    "fuzzy": _parse_fuzzy,
+    "boosting": _parse_boosting,
+    "nested": _parse_nested,
+}
+
+
+def register_query_parser(name: str, parser) -> None:
+    """SPI hook mirroring ``SearchPlugin#getQueries``."""
+    _PARSERS[name] = parser

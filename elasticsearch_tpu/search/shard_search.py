@@ -1,0 +1,133 @@
+"""Shard-level search execution: query phase + hit merge.
+
+Re-design of the reference's shard search entry
+(``search/SearchService.java:378 executeQueryPhase`` →
+``search/query/QueryPhase.java:132`` → per-segment collectors). Here the
+"collector" is data-parallel: every segment is scored eagerly to dense
+(scores, mask) arrays by the query tree (``query_dsl.py``), top-k hits are
+selected on device per segment (``ops/topk.py``), and the tiny per-segment
+candidate lists are merged on the host (score desc, then segment/doc id asc —
+Lucene's tie-break order).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.errors import IllegalArgumentError
+from ..index.mapping import MapperService
+from ..index.segment import Segment
+from ..ops.topk import get_topk_kernel
+from ..utils.shapes import round_up_pow2
+from .query_dsl import ShardContext, parse_query, MatchAllQuery
+
+
+@dataclass
+class ShardHit:
+    doc_id: str
+    score: float
+    seg_idx: int
+    local_doc: int
+    source: Optional[dict]
+    sort_values: Optional[List[Any]] = None
+    seq_no: Optional[int] = None
+
+
+@dataclass
+class ShardSearchResult:
+    total: int
+    total_relation: str
+    hits: List[ShardHit]
+    max_score: Optional[float]
+    aggregations: Optional[Dict[str, Any]] = None
+    profile: Optional[dict] = None
+
+
+class ShardSearcher:
+    """Executes one search request against one shard's segment list."""
+
+    def __init__(self, segments: List[Segment], mapper: MapperService):
+        self.segments = [s for s in segments if s.n_docs > 0]
+        self.mapper = mapper
+        self.ctx = ShardContext(self.segments, mapper)
+
+    def search(self, body: Optional[dict] = None, *, size: int = 10,
+               from_: int = 0, min_score: Optional[float] = None,
+               track_total_hits=True) -> ShardSearchResult:
+        body = body or {}
+        size = int(body.get("size", size))
+        from_ = int(body.get("from", from_))
+        min_score = body.get("min_score", min_score)
+        track_total_hits = body.get("track_total_hits", track_total_hits)
+        query = (parse_query(body["query"]) if body.get("query")
+                 else MatchAllQuery())
+
+        k = size + from_
+        # Dispatch all per-segment device work first, pull results after —
+        # no host sync between segments, so XLA can overlap their kernels
+        # (the reference overlaps segments via per-leaf search threads,
+        # ContextIndexSearcher.java:177).
+        pending = []  # (seg_idx, count_dev, vals_dev|None, idx_dev|None)
+        for seg_idx, seg in enumerate(self.segments):
+            scores, mask = query.execute(self.ctx, seg)
+            mask = mask & seg.live_dev
+            if min_score is not None:
+                mask = mask & (scores >= np.float32(min_score))
+            count_dev = jnp.sum(mask) if track_total_hits is not False else None
+            vals_dev = idx_dev = None
+            if k > 0:
+                kk = min(max(k, 1), seg.n_pad)
+                topk = get_topk_kernel(seg.n_pad, kk)
+                vals_dev, idx_dev = topk(scores, mask)
+            pending.append((seg_idx, count_dev, vals_dev, idx_dev))
+
+        total = 0
+        candidates: List[Tuple[float, int, int]] = []  # (score, seg_idx, doc)
+        max_score = None
+        for seg_idx, count_dev, vals_dev, idx_dev in pending:
+            if count_dev is not None:
+                total += int(count_dev)
+            if vals_dev is not None:
+                vals = np.asarray(vals_dev)
+                idx = np.asarray(idx_dev)
+                valid = vals > float("-inf")
+                for v, d in zip(vals[valid], idx[valid]):
+                    candidates.append((float(v), seg_idx, int(d)))
+
+        # merge: score desc, then (seg_idx, doc) asc — global doc-id order
+        candidates.sort(key=lambda c: (-c[0], c[1], c[2]))
+        if candidates:
+            max_score = candidates[0][0]
+        page = candidates[from_: from_ + size]
+        total_relation = "eq"
+        if track_total_hits is False:
+            total = len(candidates)
+            total_relation = "gte" if total >= k else "eq"
+        elif isinstance(track_total_hits, int) and not isinstance(
+                track_total_hits, bool) and total > track_total_hits:
+            total = track_total_hits
+            total_relation = "gte"
+
+        hits = []
+        for score, seg_idx, d in page:
+            seg = self.segments[seg_idx]
+            hits.append(ShardHit(
+                doc_id=seg.doc_uids[d], score=score, seg_idx=seg_idx,
+                local_doc=d, source=seg.sources[d],
+                seq_no=int(seg.seq_nos[d])))
+        return ShardSearchResult(total=total, total_relation=total_relation,
+                                 hits=hits, max_score=max_score)
+
+    def count(self, body: Optional[dict] = None) -> int:
+        body = body or {}
+        query = (parse_query(body["query"]) if body.get("query")
+                 else MatchAllQuery())
+        total = 0
+        for seg in self.segments:
+            _, mask = query.execute(self.ctx, seg)
+            total += int(jnp.sum(mask & seg.live_dev))
+        return total

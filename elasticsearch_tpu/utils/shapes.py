@@ -1,0 +1,27 @@
+"""Static-shape bucketing helpers.
+
+XLA traces/compiles once per shape; ragged search-time shapes (query term
+count, postings lengths, segment doc counts) are rounded up to power-of-two
+buckets so the compile cache stays small and kernels are reused. This replaces
+the reference's dynamically-sized Java hot loops with a bounded family of
+fixed-shape XLA programs (see SURVEY.md §7 "hard parts" #1).
+"""
+
+from __future__ import annotations
+
+
+def round_up_pow2(n: int, minimum: int = 8) -> int:
+    """Smallest power of two >= max(n, minimum)."""
+    n = max(int(n), minimum)
+    return 1 << (n - 1).bit_length()
+
+
+def round_up_multiple(n: int, multiple: int) -> int:
+    return ((int(n) + multiple - 1) // multiple) * multiple
+
+
+def bucket_length(n: int, minimum: int = 8, maximum: int | None = None) -> int:
+    b = round_up_pow2(n, minimum)
+    if maximum is not None:
+        b = min(b, maximum)
+    return b

@@ -1,0 +1,24 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax is imported.
+
+Mirrors the reference's test-framework bootstrapping (``ESTestCase`` fixing
+seeds and wiring mock transports — ``test/framework/.../ESTestCase.java:178``):
+tests must not depend on real TPU hardware, and sharding/collective tests need
+multiple devices, so we run everything on 8 virtual CPU devices.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_numpy():
+    np.random.seed(42)
+    yield

@@ -1,0 +1,117 @@
+import pytest
+
+from elasticsearch_tpu.index.analysis import (
+    AnalysisRegistry, BUILTIN_ANALYZERS, _porter_stem, standard_tokenizer)
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+
+def test_standard_tokenizer_positions_and_offsets():
+    toks = standard_tokenizer("Hello, World! foo-bar")
+    assert [t.term for t in toks] == ["Hello", "World", "foo", "bar"]
+    assert [t.position for t in toks] == [0, 1, 2, 3]
+    assert toks[0].start_offset == 0 and toks[0].end_offset == 5
+    assert toks[1].start_offset == 7 and toks[1].end_offset == 12
+
+
+def test_standard_analyzer_lowercases():
+    a = BUILTIN_ANALYZERS["standard"]
+    assert a.terms("The QUICK Brown-Fox") == ["the", "quick", "brown", "fox"]
+
+
+def test_whitespace_analyzer_keeps_case_and_punct():
+    a = BUILTIN_ANALYZERS["whitespace"]
+    assert a.terms("Foo Bar,baz") == ["Foo", "Bar,baz"]
+
+
+def test_keyword_analyzer_single_token():
+    a = BUILTIN_ANALYZERS["keyword"]
+    assert a.terms("New York City") == ["New York City"]
+    assert a.terms("") == []
+
+
+def test_stop_analyzer_removes_stopwords():
+    a = BUILTIN_ANALYZERS["stop"]
+    assert a.terms("the quick and the dead") == ["quick", "dead"]
+
+
+def test_english_analyzer_stems():
+    a = BUILTIN_ANALYZERS["english"]
+    assert a.terms("running runs easily") == ["run", "run", "easili"]
+
+
+@pytest.mark.parametrize("word,stem", [
+    ("caresses", "caress"), ("ponies", "poni"), ("cats", "cat"),
+    ("feed", "feed"), ("agreed", "agre"), ("plastered", "plaster"),
+    ("motoring", "motor"), ("sing", "sing"), ("conflated", "conflat"),
+    ("troubled", "troubl"), ("sized", "size"), ("hopping", "hop"),
+    ("falling", "fall"), ("hissing", "hiss"), ("happy", "happi"),
+    ("relational", "relat"), ("conditional", "condit"),
+    ("vietnamization", "vietnam"), ("predication", "predic"),
+    ("operator", "oper"), ("feudalism", "feudal"),
+    ("decisiveness", "decis"), ("hopefulness", "hope"),
+    ("formaliti", "formal"), ("triplicate", "triplic"),
+    ("formative", "form"), ("formalize", "formal"),
+    ("electriciti", "electr"), ("electrical", "electr"),
+    ("hopeful", "hope"), ("goodness", "good"),
+    ("revival", "reviv"), ("allowance", "allow"), ("inference", "infer"),
+    ("airliner", "airlin"), ("gyroscopic", "gyroscop"),
+    ("adjustable", "adjust"), ("defensible", "defens"),
+    ("irritant", "irrit"), ("replacement", "replac"),
+    ("adjustment", "adjust"), ("dependent", "depend"),
+    ("adoption", "adopt"), ("homologou", "homolog"),
+    ("communism", "commun"), ("activate", "activ"),
+    ("angulariti", "angular"), ("homologous", "homolog"),
+    ("effective", "effect"), ("bowdlerize", "bowdler"),
+    ("probate", "probat"), ("rate", "rate"), ("cease", "ceas"),
+    ("controll", "control"), ("roll", "roll"),
+])
+def test_porter_stemmer_reference_vectors(word, stem):
+    # Vectors from Porter's 1980 paper examples.
+    assert _porter_stem(word) == stem
+
+
+def test_custom_analyzer_from_settings():
+    reg = AnalysisRegistry({
+        "filter": {"my_stop": {"type": "stop", "stopwords": ["foo"]}},
+        "analyzer": {
+            "my_an": {"type": "custom", "tokenizer": "standard",
+                      "filter": ["lowercase", "my_stop"]},
+        },
+    })
+    assert reg.get("my_an").terms("Foo BAR") == ["bar"]
+
+
+def test_custom_ngram_tokenizer():
+    reg = AnalysisRegistry({
+        "tokenizer": {"ng": {"type": "edge_ngram", "min_gram": 1, "max_gram": 3}},
+        "analyzer": {"ac": {"tokenizer": "ng", "filter": ["lowercase"]}},
+    })
+    assert reg.get("ac").terms("Quick") == ["q", "qu", "qui"]
+
+
+def test_synonym_filter():
+    reg = AnalysisRegistry({
+        "filter": {"syn": {"type": "synonym", "synonyms": ["car,auto"]}},
+        "analyzer": {"a": {"tokenizer": "standard", "filter": ["lowercase", "syn"]}},
+    })
+    assert reg.get("a").terms("car") == ["car", "auto"]
+
+
+def test_html_strip_char_filter():
+    reg = AnalysisRegistry({
+        "analyzer": {"h": {"tokenizer": "standard", "char_filter": ["html_strip"],
+                           "filter": ["lowercase"]}},
+    })
+    assert reg.get("h").terms("<b>Bold</b> text") == ["bold", "text"]
+
+
+def test_unknown_analyzer_raises():
+    reg = AnalysisRegistry()
+    with pytest.raises(IllegalArgumentError):
+        reg.get("nope")
+
+
+def test_unknown_filter_in_custom_analyzer_raises():
+    with pytest.raises(IllegalArgumentError):
+        AnalysisRegistry({"analyzer": {"x": {"tokenizer": "standard",
+                                             "filter": ["doesnotexist"]}}})

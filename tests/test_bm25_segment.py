@@ -1,0 +1,189 @@
+"""BM25 kernel correctness vs a brute-force host reference implementation."""
+
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.analysis import BUILTIN_ANALYZERS
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.ops.bm25 import get_bm25_kernel, idf_weight, DEFAULT_K1, DEFAULT_B
+from elasticsearch_tpu.ops.topk import get_topk_kernel
+from elasticsearch_tpu.utils.shapes import round_up_pow2
+
+DOCS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the quick brown cat",
+    "lazy dogs sleep all day",
+    "foxes are quick and brown animals",
+    "the dog barks at the cat",
+    "quick quick quick",
+    "a completely unrelated sentence about search engines",
+    "brown bears eat fish",
+]
+
+
+def reference_bm25(docs_terms, query_terms, k1=DEFAULT_K1, b=DEFAULT_B):
+    """Brute-force BM25 matching LegacyBM25Similarity's formula."""
+    n = len(docs_terms)
+    dl = [len(t) for t in docs_terms]
+    docs_with_field = sum(1 for l in dl if l > 0)
+    avgdl = sum(dl) / max(docs_with_field, 1)
+    scores = np.zeros(n)
+    for q in query_terms:
+        df = sum(1 for t in docs_terms if q in t)
+        if df == 0:
+            continue
+        idf = math.log(1 + (n - df + 0.5) / (df + 0.5))
+        for d, terms in enumerate(docs_terms):
+            tf = terms.count(q)
+            if tf == 0:
+                continue
+            norm = tf + k1 * (1 - b + b * dl[d] / avgdl)
+            scores[d] += idf * (k1 + 1) * tf / norm
+    return scores
+
+
+def build_segment(docs=DOCS):
+    svc = MapperService({"properties": {"body": {"type": "text"}}})
+    builder = SegmentBuilder("_0")
+    for i, text in enumerate(docs):
+        parsed = svc.parse_document(str(i), {"body": text})
+        builder.add(parsed, seq_no=i)
+    return builder.build()
+
+
+def run_kernel(seg, query_terms, n_docs):
+    f = seg.text_fields["body"]
+    q = len(query_terms)
+    starts = np.zeros(q, np.int32)
+    lengths = np.zeros(q, np.int32)
+    dfs = np.zeros(q, np.int64)
+    max_len = 1
+    for i, t in enumerate(query_terms):
+        s, l, df = f.term_run(t)
+        starts[i], lengths[i], dfs[i] = s, l, df
+        max_len = max(max_len, l)
+    L = round_up_pow2(max_len)
+    idf = idf_weight(n_docs, dfs)
+    kernel = get_bm25_kernel(seg.n_pad, L)
+    avgdl = np.float32(f.sum_dl / max(f.field_doc_count, 1))
+    scores, matched = kernel(
+        f.docs_dev, f.tf_dev, f.doc_len_dev, starts, lengths, idf,
+        np.ones(q, np.float32), avgdl, np.float32(DEFAULT_K1), np.float32(DEFAULT_B))
+    return np.asarray(scores), np.asarray(matched)
+
+
+@pytest.mark.parametrize("query", [
+    ["quick"], ["quick", "brown"], ["the", "lazy", "dog"],
+    ["missing_term"], ["quick", "missing_term"], ["dog", "cat", "fox"],
+])
+def test_bm25_matches_reference(query):
+    analyzer = BUILTIN_ANALYZERS["standard"]
+    docs_terms = [analyzer.terms(t) for t in DOCS]
+    seg = build_segment()
+    scores, matched = run_kernel(seg, query, seg.n_docs)
+    expected = reference_bm25(docs_terms, query)
+    np.testing.assert_allclose(scores[: len(DOCS)], expected, rtol=1e-5, atol=1e-6)
+    # padded slots untouched
+    assert not scores[len(DOCS):].any()
+    # matched counts distinct matching query terms
+    for d, terms in enumerate(docs_terms):
+        assert matched[d] == sum(1 for q in query if q in terms)
+
+
+def test_matched_counts_duplicate_query_terms_once_with_weights():
+    seg = build_segment()
+    # "quick quick" → one unique term with weight 2
+    f = seg.text_fields["body"]
+    s, l, df = f.term_run("quick")
+    idf = idf_weight(seg.n_docs, [df])
+    kernel = get_bm25_kernel(seg.n_pad, round_up_pow2(l))
+    avgdl = np.float32(f.sum_dl / f.field_doc_count)
+    scores2, matched = kernel(
+        f.docs_dev, f.tf_dev, f.doc_len_dev,
+        np.array([s], np.int32), np.array([l], np.int32), idf,
+        np.array([2.0], np.float32), avgdl,
+        np.float32(DEFAULT_K1), np.float32(DEFAULT_B))
+    scores1, _ = run_kernel(seg, ["quick"], seg.n_docs)
+    np.testing.assert_allclose(np.asarray(scores2), 2 * scores1, rtol=1e-6)
+    assert int(np.asarray(matched).max()) == 1
+
+
+def test_topk_orders_and_breaks_ties_by_doc_id():
+    seg = build_segment()
+    scores, matched = run_kernel(seg, ["quick", "brown"], seg.n_docs)
+    mask = np.zeros(seg.n_pad, bool)
+    mask[: seg.n_docs] = matched[: seg.n_docs] > 0
+    topk = get_topk_kernel(seg.n_pad, 5)
+    vals, idx = topk(scores, mask)
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    order = np.argsort(-scores[: len(DOCS)], kind="stable")
+    expected_idx = [d for d in order if mask[d]][:5]
+    assert list(idx[: len(expected_idx)]) == expected_idx
+    assert all(vals[i] >= vals[i + 1] for i in range(len(expected_idx) - 1))
+
+
+def test_topk_excludes_nonmatching_docs():
+    seg = build_segment()
+    scores, matched = run_kernel(seg, ["fox"], seg.n_docs)
+    mask = np.zeros(seg.n_pad, bool)
+    mask[: seg.n_docs] = matched[: seg.n_docs] > 0
+    topk = get_topk_kernel(seg.n_pad, 8)
+    vals, idx = topk(scores, mask)
+    vals = np.asarray(vals)
+    n_match = int(mask.sum())
+    assert (vals[:n_match] > float("-inf")).all()
+    assert (vals[n_match:] == float("-inf")).all()
+
+
+def test_phrase_positions_available_on_host():
+    seg = build_segment()
+    f = seg.text_fields["body"]
+    # doc 0: "the quick brown fox ..." — "quick" at position 1
+    assert list(f.positions_for("quick", 0)) == [1]
+    assert list(f.positions_for("quick", 5)) == [0, 1, 2]
+    assert list(f.positions_for("quick", 2)) == []
+
+
+def test_keyword_postings_and_ordinals():
+    svc = MapperService({"properties": {"tag": {"type": "keyword"}}})
+    builder = SegmentBuilder("_0")
+    tags = [["a", "b"], ["b"], ["c", "a"], ["b", "b"]]
+    for i, ts in enumerate(tags):
+        builder.add(svc.parse_document(str(i), {"tag": ts}), seq_no=i)
+    seg = builder.build()
+    kf = seg.keyword_fields["tag"]
+    assert kf.ord_terms == ["a", "b", "c"]
+    s, l, df = kf.term_run("b")
+    assert df == 3
+    assert list(kf.docs_host[s: s + l]) == [0, 1, 3]
+    # dv pairs contain duplicates as emitted ("b" twice for doc 3)
+    pairs = sorted(zip(kf.dv_docs_host.tolist(), kf.dv_ords_host.tolist()))
+    assert pairs == [(0, 0), (0, 1), (1, 1), (2, 0), (2, 2), (3, 1), (3, 1)]
+
+
+def test_numeric_docvalues_base_offset():
+    svc = MapperService({"properties": {"ts": {"type": "long"}}})
+    builder = SegmentBuilder("_0")
+    vals = [1700000000123, 1700000000456, 1700000001000]
+    for i, v in enumerate(vals):
+        builder.add(svc.parse_document(str(i), {"ts": v}), seq_no=i)
+    seg = builder.build()
+    nf = seg.numeric_fields["ts"]
+    assert nf.base == 1700000000123.0
+    np.testing.assert_array_equal(nf.vals_host, np.asarray(vals, np.float64))
+    # device offsets are exact because they are small
+    off = np.asarray(nf.vals_off_dev)[:3]
+    np.testing.assert_array_equal(off, [0.0, 333.0, 877.0])
+
+
+def test_segment_deletes_and_find_doc():
+    seg = build_segment()
+    assert seg.find_doc("3") == 3
+    seg.delete_doc(3)
+    assert seg.find_doc("3") is None
+    assert seg.live_count == len(DOCS) - 1
+    live = np.asarray(seg.live_dev)
+    assert not live[3] and live[2] and not live[len(DOCS):].any()

@@ -1,0 +1,158 @@
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError, MapperParsingError)
+from elasticsearch_tpu.index.mapping import (
+    MapperService, parse_date_millis, format_date_millis)
+
+
+def make_service():
+    return MapperService({
+        "properties": {
+            "title": {"type": "text", "fields": {
+                "keyword": {"type": "keyword"}}},
+            "tags": {"type": "keyword"},
+            "views": {"type": "long"},
+            "score": {"type": "double"},
+            "published": {"type": "date"},
+            "active": {"type": "boolean"},
+            "embedding": {"type": "dense_vector", "dims": 4},
+            "author": {"type": "object", "properties": {
+                "name": {"type": "text"},
+                "age": {"type": "integer"},
+            }},
+        }
+    })
+
+
+def test_parse_document_all_field_kinds():
+    svc = make_service()
+    doc = svc.parse_document("1", {
+        "title": "Hello World",
+        "tags": ["a", "b"],
+        "views": 42,
+        "score": 3.5,
+        "published": "2024-06-01T12:00:00Z",
+        "active": True,
+        "embedding": [1, 2, 3, 4],
+        "author": {"name": "Jane Doe", "age": 30},
+    })
+    assert [t.term for t in doc.text_tokens["title"]] == ["hello", "world"]
+    assert doc.keyword_terms["tags"] == ["a", "b"]
+    assert doc.numeric_values["views"] == [42.0]
+    assert doc.numeric_values["score"] == [3.5]
+    assert doc.numeric_values["active"] == [1.0]
+    assert doc.numeric_values["author.age"] == [30.0]
+    assert [t.term for t in doc.text_tokens["author.name"]] == ["jane", "doe"]
+    np.testing.assert_array_equal(doc.vectors["embedding"],
+                                  np.array([1, 2, 3, 4], np.float32))
+    # multi-field
+    assert doc.keyword_terms["title.keyword"] == ["Hello World"]
+
+
+def test_date_parsing_variants():
+    assert parse_date_millis("1970-01-01T00:00:00Z") == 0.0
+    assert parse_date_millis("1970-01-01") == 0.0
+    assert parse_date_millis(1000) == 1000.0
+    assert parse_date_millis("1000") == 1000.0
+    assert parse_date_millis("1970-01-01T00:00:01+00:00") == 1000.0
+    assert format_date_millis(0.0) == "1970-01-01T00:00:00.000Z"
+    with pytest.raises(MapperParsingError):
+        parse_date_millis("not-a-date")
+
+
+def test_dynamic_mapping_infers_types():
+    svc = MapperService()
+    doc = svc.parse_document("1", {"name": "Bob", "age": 7, "pi": 3.14,
+                                   "ok": False, "nested": {"x": 1}})
+    assert [t.term for t in doc.text_tokens["name"]] == ["bob"]
+    assert doc.keyword_terms["name.keyword"] == ["Bob"]
+    assert doc.numeric_values["age"] == [7.0]
+    assert doc.numeric_values["pi"] == [3.14]
+    assert doc.numeric_values["ok"] == [0.0]
+    assert doc.numeric_values["nested.x"] == [1.0]
+    # mapping was updated
+    assert svc.field_type("name").type_name == "text"
+    assert svc.field_type("name.keyword").type_name == "keyword"
+    assert svc.field_type("age").type_name == "long"
+    assert svc.field_type("pi").type_name == "double"
+    assert svc.field_type("ok").type_name == "boolean"
+    assert svc.field_type("nested.x").type_name == "long"
+    props = svc.mapping_dict()["properties"]
+    assert props["age"] == {"type": "long"}
+    assert props["nested"]["properties"]["x"] == {"type": "long"}
+
+
+def test_dynamic_strict_rejects_unknown_field():
+    svc = MapperService({"dynamic": "strict", "properties": {
+        "a": {"type": "keyword"}}})
+    with pytest.raises(MapperParsingError):
+        svc.parse_document("1", {"b": 1})
+
+
+def test_dynamic_false_ignores_unknown_field():
+    svc = MapperService({"dynamic": False, "properties": {
+        "a": {"type": "keyword"}}})
+    doc = svc.parse_document("1", {"a": "x", "b": 1})
+    assert doc.keyword_terms["a"] == ["x"]
+    assert "b" not in doc.numeric_values
+
+
+def test_type_conflict_rejected():
+    svc = make_service()
+    with pytest.raises(IllegalArgumentError):
+        svc.merge({"properties": {"views": {"type": "keyword"}}})
+
+
+def test_numeric_bounds_checked():
+    svc = MapperService({"properties": {"b": {"type": "byte"}}})
+    with pytest.raises(MapperParsingError):
+        svc.parse_document("1", {"b": 1000})
+
+
+def test_dense_vector_dim_mismatch():
+    svc = make_service()
+    with pytest.raises(MapperParsingError):
+        svc.parse_document("1", {"embedding": [1, 2]})
+
+
+def test_ignore_above_drops_long_keywords():
+    svc = MapperService({"properties": {
+        "k": {"type": "keyword", "ignore_above": 3}}})
+    doc = svc.parse_document("1", {"k": ["ab", "abcdef"]})
+    assert doc.keyword_terms["k"] == ["ab"]
+
+
+def test_null_values_skipped():
+    svc = make_service()
+    doc = svc.parse_document("1", {"title": None, "views": None})
+    assert "title" not in doc.text_tokens
+    assert "views" not in doc.numeric_values
+
+
+def test_multivalued_text_position_gap():
+    svc = MapperService({"properties": {"t": {"type": "text"}}})
+    doc = svc.parse_document("1", {"t": ["a b", "c d"]})
+    positions = [t.position for t in doc.text_tokens["t"]]
+    assert positions[0] == 0 and positions[1] == 1
+    assert positions[2] >= positions[1] + 100  # position gap across values
+
+
+def test_geo_point_parsing():
+    svc = MapperService({"properties": {"loc": {"type": "geo_point"}}})
+    d1 = svc.parse_document("1", {"loc": {"lat": 40.7, "lon": -74.0}})
+    d2 = svc.parse_document("2", {"loc": [-74.0, 40.7]})
+    d3 = svc.parse_document("3", {"loc": "40.7,-74.0"})
+    for d in (d1, d2, d3):
+        lat, lon = d.geo_points["loc"][0]
+        assert abs(lat - 40.7) < 1e-9 and abs(lon + 74.0) < 1e-9
+    with pytest.raises(MapperParsingError):
+        svc.parse_document("4", {"loc": {"lat": 91, "lon": 0}})
+
+
+def test_mapping_dict_round_trip():
+    svc = make_service()
+    m = svc.mapping_dict()
+    svc2 = MapperService(m)
+    assert svc2.mapping_dict() == m

@@ -1,0 +1,364 @@
+"""Query DSL semantics tests over single- and multi-segment shards."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import ParsingError
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.query_dsl import (
+    parse_query, resolve_minimum_should_match)
+from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+MAPPING = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "n": {"type": "long"},
+        "ts": {"type": "date"},
+        "flag": {"type": "boolean"},
+    }
+}
+
+CORPUS = [
+    {"body": "the quick brown fox", "tag": "animal", "n": 1,
+     "ts": "2024-01-01", "flag": True},
+    {"body": "the lazy dog sleeps", "tag": "animal", "n": 2,
+     "ts": "2024-02-01", "flag": False},
+    {"body": "quick quick dog", "tag": "pet", "n": 3, "ts": "2024-03-01",
+     "flag": True},
+    {"body": "brown bears eat honey", "tag": "wild", "n": 10,
+     "ts": "2024-04-01", "flag": False},
+    {"body": "search engines rank documents", "tag": "tech", "n": 20,
+     "ts": "2025-01-01", "flag": True},
+    {"body": "the fox and the dog", "tag": "animal", "n": 30,
+     "ts": "2025-02-01", "flag": False},
+]
+
+
+def build_searcher(split=None):
+    """Build a shard; ``split`` optionally breaks the corpus into segments."""
+    svc = MapperService(MAPPING)
+    bounds = split or [len(CORPUS)]
+    segments = []
+    start = 0
+    for seg_no, end in enumerate(bounds):
+        b = SegmentBuilder(f"_{seg_no}")
+        for i in range(start, end):
+            b.add(svc.parse_document(str(i), CORPUS[i]), seq_no=i)
+        segments.append(b.build())
+        start = end
+    return ShardSearcher(segments, svc)
+
+
+def ids(result):
+    return [h.doc_id for h in result.hits]
+
+
+def test_match_or_semantics():
+    s = build_searcher()
+    r = s.search({"query": {"match": {"body": "quick dog"}}})
+    assert set(ids(r)) == {"0", "1", "2", "5"}
+    assert r.total == 4
+
+
+def test_match_and_semantics():
+    s = build_searcher()
+    r = s.search({"query": {"match": {"body": {"query": "quick dog",
+                                               "operator": "and"}}}})
+    assert ids(r) == ["2"]
+
+
+def test_match_minimum_should_match():
+    s = build_searcher()
+    r = s.search({"query": {"match": {"body": {
+        "query": "quick brown fox", "minimum_should_match": 2}}}})
+    assert set(ids(r)) == {"0"}
+
+
+def test_match_scores_rank_higher_tf():
+    s = build_searcher()
+    r = s.search({"query": {"match": {"body": "quick"}}})
+    # doc 2 has tf=2 and is shorter → highest
+    assert ids(r)[0] == "2"
+
+
+def test_multi_segment_scores_equal_single_segment():
+    # idf/avgdl are shard-level, so splitting segments must not change scores
+    s1 = build_searcher()
+    s2 = build_searcher(split=[2, 4, 6])
+    for q in [{"match": {"body": "quick dog"}},
+              {"match": {"body": "the brown fox"}}]:
+        r1 = s1.search({"query": q})
+        r2 = s2.search({"query": q})
+        assert ids(r1) == ids(r2)
+        np.testing.assert_allclose([h.score for h in r1.hits],
+                                   [h.score for h in r2.hits], rtol=1e-5)
+
+
+def test_term_on_keyword_and_text():
+    s = build_searcher()
+    r = s.search({"query": {"term": {"tag": "animal"}}})
+    assert set(ids(r)) == {"0", "1", "5"}
+    r2 = s.search({"query": {"term": {"body": "fox"}}})
+    assert set(ids(r2)) == {"0", "5"}
+    # term is not analyzed: "Fox" doesn't match lowercase postings
+    r3 = s.search({"query": {"term": {"body": "Fox"}}})
+    assert r3.total == 0
+
+
+def test_term_on_numeric_and_bool():
+    s = build_searcher()
+    assert ids(s.search({"query": {"term": {"n": 10}}})) == ["3"]
+    assert set(ids(s.search({"query": {"term": {"flag": True}}}))) == \
+        {"0", "2", "4"}
+
+
+def test_terms_query():
+    s = build_searcher()
+    r = s.search({"query": {"terms": {"tag": ["pet", "tech"]}}})
+    assert set(ids(r)) == {"2", "4"}
+    assert all(h.score == 1.0 for h in r.hits)
+
+
+def test_range_numeric():
+    s = build_searcher()
+    assert set(ids(s.search({"query": {"range": {"n": {"gte": 3, "lt": 30}}}}))) \
+        == {"2", "3", "4"}
+    assert set(ids(s.search({"query": {"range": {"n": {"gt": 20}}}}))) == {"5"}
+
+
+def test_range_date():
+    s = build_searcher()
+    r = s.search({"query": {"range": {"ts": {
+        "gte": "2024-02-01", "lte": "2024-12-31"}}}})
+    assert set(ids(r)) == {"1", "2", "3"}
+
+
+def test_range_keyword_lexicographic():
+    s = build_searcher()
+    r = s.search({"query": {"range": {"tag": {"gte": "pet", "lte": "tech"}}}})
+    assert set(ids(r)) == {"2", "4"}
+
+
+def test_bool_must_filter_must_not_should():
+    s = build_searcher()
+    r = s.search({"query": {"bool": {
+        "must": [{"match": {"body": "dog"}}],
+        "filter": [{"term": {"tag": "animal"}}],
+        "must_not": [{"term": {"n": 30}}],
+    }}})
+    assert ids(r) == ["1"]
+    # should alone → OR
+    r2 = s.search({"query": {"bool": {"should": [
+        {"term": {"tag": "pet"}}, {"term": {"tag": "tech"}}]}}})
+    assert set(ids(r2)) == {"2", "4"}
+    # should with must → optional, boosts score but doesn't filter
+    r3 = s.search({"query": {"bool": {
+        "must": [{"match": {"body": "dog"}}],
+        "should": [{"term": {"tag": "pet"}}]}}})
+    assert set(ids(r3)) == {"1", "2", "5"}
+    assert ids(r3)[0] == "2"  # should clause lifted doc 2
+
+
+def test_bool_minimum_should_match():
+    s = build_searcher()
+    r = s.search({"query": {"bool": {
+        "should": [{"term": {"tag": "animal"}}, {"match": {"body": "fox"}},
+                   {"range": {"n": {"lte": 2}}}],
+        "minimum_should_match": 2}}})
+    assert set(ids(r)) == {"0", "1", "5"}
+
+
+def test_filter_does_not_score():
+    s = build_searcher()
+    r = s.search({"query": {"bool": {"filter": [{"term": {"tag": "animal"}}]}}})
+    assert all(h.score == 0.0 for h in r.hits)
+
+
+def test_exists_query():
+    svc = MapperService(MAPPING)
+    b = SegmentBuilder("_0")
+    b.add(svc.parse_document("0", {"body": "has body"}), 0)
+    b.add(svc.parse_document("1", {"n": 5}), 1)
+    s = ShardSearcher([b.build()], svc)
+    assert ids(s.search({"query": {"exists": {"field": "body"}}})) == ["0"]
+    assert ids(s.search({"query": {"exists": {"field": "n"}}})) == ["1"]
+
+
+def test_ids_query():
+    s = build_searcher()
+    r = s.search({"query": {"ids": {"values": ["1", "3", "99"]}}})
+    assert set(ids(r)) == {"1", "3"}
+
+
+def test_prefix_query_text_and_keyword():
+    s = build_searcher()
+    assert set(ids(s.search({"query": {"prefix": {"body": "qui"}}}))) == {"0", "2"}
+    assert set(ids(s.search({"query": {"prefix": {"tag": "te"}}}))) == {"4"}
+
+
+def test_wildcard_and_regexp():
+    s = build_searcher()
+    assert set(ids(s.search({"query": {"wildcard": {"body": "d*g"}}}))) == \
+        {"1", "2", "5"}
+    assert set(ids(s.search({"query": {"regexp": {"tag": "an.*"}}}))) == \
+        {"0", "1", "5"}
+
+
+def test_fuzzy_query():
+    s = build_searcher()
+    r = s.search({"query": {"fuzzy": {"body": {"value": "quik"}}}})
+    assert set(ids(r)) == {"0", "2"}
+
+
+def test_match_phrase():
+    s = build_searcher()
+    r = s.search({"query": {"match_phrase": {"body": "quick brown"}}})
+    assert ids(r) == ["0"]
+    r2 = s.search({"query": {"match_phrase": {"body": "brown quick"}}})
+    assert r2.total == 0
+    # phrase across multiple segments
+    s2 = build_searcher(split=[2, 4, 6])
+    r3 = s2.search({"query": {"match_phrase": {"body": "the fox"}}})
+    assert ids(r3) == ["5"]
+
+
+def test_match_phrase_with_slop():
+    s = build_searcher()
+    r = s.search({"query": {"match_phrase": {"body": {
+        "query": "quick fox", "slop": 1}}}})
+    assert "0" in ids(r)
+
+
+def test_dis_max_and_constant_score():
+    s = build_searcher()
+    r = s.search({"query": {"dis_max": {"queries": [
+        {"term": {"tag": "pet"}}, {"match": {"body": "dog"}}]}}})
+    assert set(ids(r)) == {"1", "2", "5"}
+    r2 = s.search({"query": {"constant_score": {
+        "filter": {"term": {"tag": "animal"}}, "boost": 2.5}}})
+    assert all(h.score == 2.5 for h in r2.hits)
+
+
+def test_boosting_query():
+    s = build_searcher()
+    r = s.search({"query": {"boosting": {
+        "positive": {"match": {"body": "dog"}},
+        "negative": {"term": {"tag": "pet"}},
+        "negative_boost": 0.1}}})
+    assert set(ids(r)) == {"1", "2", "5"}
+    assert ids(r)[-1] == "2"  # demoted
+
+
+def test_multi_match_best_fields():
+    s = build_searcher()
+    r = s.search({"query": {"multi_match": {
+        "query": "animal dog", "fields": ["body", "tag"]}}})
+    # keyword field analyzes the text as one token "animal dog" → no tag hits,
+    # matching the reference's match-on-keyword semantics
+    assert set(ids(r)) == {"1", "2", "5"}
+    r2 = s.search({"query": {"multi_match": {
+        "query": "animal", "fields": ["body", "tag^2"]}}})
+    assert set(ids(r2)) == {"0", "1", "5"}
+
+
+def test_boost_multiplies_scores():
+    s = build_searcher()
+    r1 = s.search({"query": {"match": {"body": "fox"}}})
+    r2 = s.search({"query": {"match": {"body": {"query": "fox", "boost": 3.0}}}})
+    np.testing.assert_allclose([h.score * 3 for h in r1.hits],
+                               [h.score for h in r2.hits], rtol=1e-6)
+
+
+def test_pagination_and_min_score():
+    s = build_searcher()
+    full = s.search({"query": {"match": {"body": "the dog fox"}}, "size": 10})
+    page = s.search({"query": {"match": {"body": "the dog fox"}},
+                     "from": 1, "size": 2})
+    assert ids(page) == ids(full)[1:3]
+    assert page.total == full.total
+    cutoff = full.hits[1].score
+    strict = s.search({"query": {"match": {"body": "the dog fox"}},
+                       "min_score": cutoff + 1e-6})
+    assert len(strict.hits) == 1 and strict.total == 1
+
+
+def test_deleted_docs_excluded():
+    svc = MapperService(MAPPING)
+    b = SegmentBuilder("_0")
+    for i, doc in enumerate(CORPUS):
+        b.add(svc.parse_document(str(i), doc), i)
+    seg = b.build()
+    seg.delete_doc(0)
+    s = ShardSearcher([seg], svc)
+    r = s.search({"query": {"match": {"body": "fox"}}})
+    assert ids(r) == ["5"]
+
+
+def test_match_all_and_match_none():
+    s = build_searcher()
+    assert s.search({"query": {"match_all": {}}}).total == len(CORPUS)
+    assert s.search({"query": {"match_none": {}}}).total == 0
+
+
+def test_unknown_query_raises():
+    with pytest.raises(ParsingError):
+        parse_query({"definitely_not_a_query": {}})
+
+
+def test_minimum_should_match_resolution():
+    assert resolve_minimum_should_match(None, 5) == 0
+    assert resolve_minimum_should_match(2, 5) == 2
+    assert resolve_minimum_should_match("2", 5) == 2
+    assert resolve_minimum_should_match(-1, 5) == 4
+    assert resolve_minimum_should_match("75%", 4) == 3
+    assert resolve_minimum_should_match("-25%", 4) == 3
+    assert resolve_minimum_should_match("3<90%", 2) == 2
+    assert resolve_minimum_should_match("3<90%", 10) == 9
+    assert resolve_minimum_should_match(10, 3) == 3
+
+
+def test_regexp_is_fully_anchored():
+    s = build_searcher()
+    # "do" must not match "dog"/"documents" (Lucene regexp anchors both ends)
+    assert s.search({"query": {"regexp": {"body": "do"}}}).total == 0
+    assert s.search({"query": {"regexp": {"body": "do.*"}}}).total > 0
+
+
+def test_bool_only_should_with_msm_zero_still_requires_one_match():
+    s = build_searcher()
+    r = s.search({"query": {"bool": {
+        "should": [{"term": {"tag": "pet"}}],
+        "minimum_should_match": 0}}})
+    assert ids(r) == ["2"]
+
+
+def test_large_long_values_exact():
+    from elasticsearch_tpu.index.mapping import MapperService as MS
+    svc = MS({"properties": {"big": {"type": "long"}}})
+    doc = svc.parse_document("1", {"big": "9223372036854775807"})
+    assert doc.numeric_values["big"] == [float(9223372036854775807)]
+
+
+def test_match_on_keyword_applies_normalizer():
+    from elasticsearch_tpu.index.mapping import MapperService as MS
+    from elasticsearch_tpu.index.segment import SegmentBuilder as SB
+    svc = MS({"properties": {"k": {"type": "keyword",
+                                   "normalizer": "lowercase"}}})
+    b = SB("_0")
+    b.add(svc.parse_document("0", {"k": "Foo"}), 0)
+    s = ShardSearcher([b.build()], svc)
+    assert s.search({"query": {"match": {"k": "FOO"}}}).total == 1
+    assert s.search({"query": {"term": {"k": "FOO"}}}).total == 1
+
+
+def test_track_total_hits_variants():
+    s = build_searcher()
+    body = {"query": {"match_all": {}}, "size": 2}
+    exact = s.search(body)
+    assert exact.total == len(CORPUS) and exact.total_relation == "eq"
+    capped = s.search({**body, "track_total_hits": 3})
+    assert capped.total == 3 and capped.total_relation == "gte"
+    off = s.search({**body, "track_total_hits": False})
+    assert off.total_relation in ("eq", "gte")
