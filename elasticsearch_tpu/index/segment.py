@@ -250,6 +250,9 @@ class SegmentBuilder:
         self.doc_uids: List[str] = []
         self.sources: List[Optional[dict]] = []
         self.seq_nos: List[int] = []
+        # local ids deleted before the segment is frozen (doc updated or
+        # removed while still in the buffer); applied to `live` at build()
+        self.deleted: set = set()
         # field -> term -> list[(doc, tf)] built doc-ascending
         self._text_postings: Dict[str, Dict[str, List[Tuple[int, int]]]] = {}
         # field -> term -> doc -> positions
@@ -390,6 +393,9 @@ class SegmentBuilder:
                 exists[d] = True
             vector_fields[field] = VectorFieldData(matrix_host=mat, exists=exists)
 
-        return Segment(self.seg_id, n, list(self.doc_uids), list(self.sources),
-                       np.asarray(self.seq_nos, np.int64), text_fields,
-                       keyword_fields, numeric_fields, vector_fields)
+        seg = Segment(self.seg_id, n, list(self.doc_uids), list(self.sources),
+                      np.asarray(self.seq_nos, np.int64), text_fields,
+                      keyword_fields, numeric_fields, vector_fields)
+        for local in self.deleted:
+            seg.delete_doc(local)
+        return seg

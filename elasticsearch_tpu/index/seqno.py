@@ -1,0 +1,180 @@
+"""Sequence numbers and replication checkpoints.
+
+Re-design of the reference's seq-no subsystem
+(``index/seqno/LocalCheckpointTracker.java``, ``ReplicationTracker.java``,
+``RetentionLease*.java``): every engine operation gets a monotonically
+increasing sequence number; the *local checkpoint* is the highest seq-no
+below which every op has been processed; the *global checkpoint* is the
+minimum local checkpoint across the in-sync replication group and is the
+durable resume point for replica recovery.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+NO_OPS_PERFORMED = -1
+UNASSIGNED_SEQ_NO = -2
+
+
+class LocalCheckpointTracker:
+    """Tracks processed seq-nos; checkpoint advances over contiguous runs."""
+
+    def __init__(self, max_seq_no: int = NO_OPS_PERFORMED,
+                 local_checkpoint: int = NO_OPS_PERFORMED):
+        self._max_seq_no = max_seq_no
+        self._checkpoint = local_checkpoint
+        self._pending: Set[int] = set()
+
+    def generate_seq_no(self) -> int:
+        self._max_seq_no += 1
+        return self._max_seq_no
+
+    def advance_max_seq_no(self, seq_no: int) -> None:
+        """Note a seq-no assigned elsewhere (replica path)."""
+        self._max_seq_no = max(self._max_seq_no, seq_no)
+
+    def mark_processed(self, seq_no: int) -> None:
+        if seq_no <= self._checkpoint:
+            return
+        self._pending.add(seq_no)
+        while self._checkpoint + 1 in self._pending:
+            self._checkpoint += 1
+            self._pending.discard(self._checkpoint)
+
+    @property
+    def checkpoint(self) -> int:
+        return self._checkpoint
+
+    @property
+    def max_seq_no(self) -> int:
+        return self._max_seq_no
+
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+
+@dataclass
+class RetentionLease:
+    """History retention marker (reference: ``RetentionLease.java``): ops at
+    or above ``retaining_seq_no`` must be kept for the lease holder (a
+    recovering replica / CCR follower)."""
+
+    lease_id: str
+    retaining_seq_no: int
+    timestamp_millis: float
+    source: str
+
+
+@dataclass
+class CheckpointState:
+    local_checkpoint: int = UNASSIGNED_SEQ_NO
+    global_checkpoint: int = UNASSIGNED_SEQ_NO
+    in_sync: bool = False
+    tracked: bool = False
+
+
+class ReplicationTracker:
+    """Primary-side view of the replication group
+    (reference: ``ReplicationTracker.java``, ~1.5k LoC): which allocations
+    are in-sync, their local checkpoints, the computed global checkpoint,
+    and retention leases for history."""
+
+    def __init__(self, allocation_id: str, local_tracker: LocalCheckpointTracker,
+                 lease_expiry_millis: float = 12 * 3600 * 1000):
+        self.allocation_id = allocation_id
+        self.local_tracker = local_tracker
+        self.primary_mode = False
+        self.checkpoints: Dict[str, CheckpointState] = {
+            allocation_id: CheckpointState(in_sync=True, tracked=True)}
+        self.leases: Dict[str, RetentionLease] = {}
+        self.lease_expiry_millis = lease_expiry_millis
+        self._global_checkpoint = UNASSIGNED_SEQ_NO
+
+    # -- mode ----------------------------------------------------------------
+
+    def activate_primary_mode(self, local_checkpoint: int) -> None:
+        self.primary_mode = True
+        st = self.checkpoints[self.allocation_id]
+        st.local_checkpoint = local_checkpoint
+        st.in_sync = True
+        st.tracked = True
+        self._recompute_global_checkpoint()
+
+    # -- replication group management ---------------------------------------
+
+    def init_tracking(self, allocation_id: str) -> None:
+        self.checkpoints.setdefault(allocation_id, CheckpointState(tracked=True))
+        self.checkpoints[allocation_id].tracked = True
+
+    def mark_in_sync(self, allocation_id: str, local_checkpoint: int) -> None:
+        st = self.checkpoints.setdefault(allocation_id, CheckpointState())
+        st.local_checkpoint = max(st.local_checkpoint, local_checkpoint)
+        st.in_sync = True
+        st.tracked = True
+        self._recompute_global_checkpoint()
+
+    def remove_allocation(self, allocation_id: str) -> None:
+        if allocation_id != self.allocation_id:
+            self.checkpoints.pop(allocation_id, None)
+            self._recompute_global_checkpoint()
+
+    def update_local_checkpoint(self, allocation_id: str,
+                                local_checkpoint: int) -> None:
+        st = self.checkpoints.get(allocation_id)
+        if st is None:
+            return
+        st.local_checkpoint = max(st.local_checkpoint, local_checkpoint)
+        self._recompute_global_checkpoint()
+
+    def update_global_checkpoint_on_replica(self, global_checkpoint: int) -> None:
+        self._global_checkpoint = max(self._global_checkpoint, global_checkpoint)
+
+    def _recompute_global_checkpoint(self) -> None:
+        in_sync = [st.local_checkpoint for st in self.checkpoints.values()
+                   if st.in_sync]
+        if in_sync and all(cp != UNASSIGNED_SEQ_NO for cp in in_sync):
+            gcp = min(in_sync)
+            self._global_checkpoint = max(self._global_checkpoint, gcp)
+
+    @property
+    def global_checkpoint(self) -> int:
+        return self._global_checkpoint
+
+    def in_sync_allocation_ids(self) -> Set[str]:
+        return {aid for aid, st in self.checkpoints.items() if st.in_sync}
+
+    # -- retention leases ----------------------------------------------------
+
+    def add_lease(self, lease_id: str, retaining_seq_no: int,
+                  source: str) -> RetentionLease:
+        lease = RetentionLease(lease_id, retaining_seq_no,
+                               time.time() * 1000, source)
+        self.leases[lease_id] = lease
+        return lease
+
+    def renew_lease(self, lease_id: str, retaining_seq_no: int) -> None:
+        lease = self.leases.get(lease_id)
+        if lease is not None:
+            lease.retaining_seq_no = max(lease.retaining_seq_no, retaining_seq_no)
+            lease.timestamp_millis = time.time() * 1000
+
+    def remove_lease(self, lease_id: str) -> None:
+        self.leases.pop(lease_id, None)
+
+    def expire_leases(self, now_millis: Optional[float] = None) -> None:
+        now = now_millis if now_millis is not None else time.time() * 1000
+        expired = [lid for lid, l in self.leases.items()
+                   if now - l.timestamp_millis > self.lease_expiry_millis]
+        for lid in expired:
+            del self.leases[lid]
+
+    def min_retained_seq_no(self) -> int:
+        """Ops at/above this must be retained for lease holders; with no
+        leases, retain above the global checkpoint."""
+        floor = self._global_checkpoint + 1
+        if self.leases:
+            floor = min(floor, min(l.retaining_seq_no for l in self.leases.values()))
+        return floor

@@ -1,0 +1,243 @@
+"""Translog: the per-shard durability write-ahead log.
+
+Re-design of the reference translog (``index/translog/Translog.java:99``,
+``TranslogWriter.java``, ``Checkpoint.java``): every accepted operation is
+appended (length-prefixed, CRC32-checksummed record) to the current
+*generation* file and fsynced per the durability policy before the op is
+acknowledged. A checkpoint file tracks the current generation and the last
+committed ("persisted below") sequence number; on restart, operations above
+the commit point are replayed into the engine. Generations roll on flush and
+old generations are trimmed once their ops are both committed and below the
+retention policy.
+
+File layout in ``<dir>/``:
+- ``translog-<gen>.tlog``  — records: [u32 length][payload JSON][u32 crc32]
+- ``translog.ckp``         — JSON checkpoint (atomic rename on update)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Iterator, List, Optional
+
+from ..common.errors import ElasticsearchError
+
+
+class TranslogCorruptedError(ElasticsearchError):
+    status = 500
+    error_type = "translog_corrupted_exception"
+
+
+# Op types (reference: Translog.Operation.Type)
+OP_INDEX = "index"
+OP_DELETE = "delete"
+OP_NOOP = "no_op"
+
+
+@dataclass
+class TranslogOp:
+    op_type: str
+    seq_no: int
+    primary_term: int
+    doc_id: Optional[str] = None
+    source: Optional[dict] = None
+    routing: Optional[str] = None
+    version: int = 1
+    reason: Optional[str] = None  # for no-ops
+
+    def to_dict(self) -> dict:
+        d = {"op": self.op_type, "seq_no": self.seq_no,
+             "primary_term": self.primary_term, "version": self.version}
+        if self.doc_id is not None:
+            d["id"] = self.doc_id
+        if self.source is not None:
+            d["source"] = self.source
+        if self.routing is not None:
+            d["routing"] = self.routing
+        if self.reason is not None:
+            d["reason"] = self.reason
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "TranslogOp":
+        return TranslogOp(op_type=d["op"], seq_no=d["seq_no"],
+                          primary_term=d["primary_term"],
+                          doc_id=d.get("id"), source=d.get("source"),
+                          routing=d.get("routing"),
+                          version=d.get("version", 1), reason=d.get("reason"))
+
+
+_HEADER = struct.Struct("<I")  # record length
+_FOOTER = struct.Struct("<I")  # crc32
+
+
+class Translog:
+    """Append-only generational op log with checkpointed trimming."""
+
+    DURABILITY_REQUEST = "request"  # fsync before every ack (default)
+    DURABILITY_ASYNC = "async"      # fsync on interval / explicit sync
+
+    def __init__(self, directory: str, durability: str = DURABILITY_REQUEST):
+        self.dir = directory
+        self.durability = durability
+        os.makedirs(directory, exist_ok=True)
+        ckp = self._read_checkpoint()
+        if ckp is None:
+            self.generation = 1
+            self.min_retained_gen = 1
+            self.last_committed_seq_no = -1
+            self._write_checkpoint()
+        else:
+            self.generation = ckp["generation"]
+            self.min_retained_gen = ckp.get("min_retained_gen", 1)
+            self.last_committed_seq_no = ckp.get("last_committed_seq_no", -1)
+        self._fh = open(self._gen_path(self.generation), "ab")
+        self._ops_since_sync = 0
+
+    # -- paths / checkpoint --------------------------------------------------
+
+    def _gen_path(self, gen: int) -> str:
+        return os.path.join(self.dir, f"translog-{gen}.tlog")
+
+    def _ckp_path(self) -> str:
+        return os.path.join(self.dir, "translog.ckp")
+
+    def _read_checkpoint(self) -> Optional[dict]:
+        try:
+            with open(self._ckp_path()) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (json.JSONDecodeError, OSError) as e:
+            raise TranslogCorruptedError(
+                f"failed to read translog checkpoint: {e}")
+
+    def _write_checkpoint(self) -> None:
+        tmp = self._ckp_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"generation": self.generation,
+                       "min_retained_gen": self.min_retained_gen,
+                       "last_committed_seq_no": self.last_committed_seq_no}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._ckp_path())
+
+    # -- writes --------------------------------------------------------------
+
+    def add(self, op: TranslogOp) -> None:
+        payload = json.dumps(op.to_dict(), separators=(",", ":"),
+                             ensure_ascii=False).encode()
+        record = _HEADER.pack(len(payload)) + payload + \
+            _FOOTER.pack(zlib.crc32(payload) & 0xFFFFFFFF)
+        self._fh.write(record)
+        self._ops_since_sync += 1
+        if self.durability == self.DURABILITY_REQUEST:
+            self.sync()
+
+    def sync(self) -> None:
+        if self._ops_since_sync:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._ops_since_sync = 0
+
+    def rollover(self) -> int:
+        """Start a new generation (called on flush). Returns new generation."""
+        self.sync()
+        self._fh.close()
+        self.generation += 1
+        self._fh = open(self._gen_path(self.generation), "ab")
+        self._write_checkpoint()
+        return self.generation
+
+    def mark_committed(self, seq_no: int) -> None:
+        """Record that all ops <= seq_no are durably captured in a commit
+        (segment persistence); enables trimming of wholly-committed
+        generations."""
+        self.last_committed_seq_no = max(self.last_committed_seq_no, seq_no)
+        self._write_checkpoint()
+
+    def trim_unneeded_generations(self) -> List[int]:
+        """Delete generations whose every op is <= last_committed_seq_no.
+        The current generation is never deleted."""
+        removed = []
+        for gen in range(self.min_retained_gen, self.generation):
+            max_seq = -1
+            needed = False
+            for op in self._read_gen(gen):
+                max_seq = max(max_seq, op.seq_no)
+                if op.seq_no > self.last_committed_seq_no:
+                    needed = True
+                    break
+            if needed:
+                break
+            try:
+                os.remove(self._gen_path(gen))
+            except FileNotFoundError:
+                pass
+            removed.append(gen)
+            self.min_retained_gen = gen + 1
+        if removed:
+            self._write_checkpoint()
+        return removed
+
+    # -- reads ---------------------------------------------------------------
+
+    def _read_gen(self, gen: int) -> Iterator[TranslogOp]:
+        path = self._gen_path(gen)
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        n = len(data)
+        while pos < n:
+            if pos + _HEADER.size > n:
+                break  # torn tail write — stop at last complete record
+            (length,) = _HEADER.unpack_from(data, pos)
+            end = pos + _HEADER.size + length + _FOOTER.size
+            if end > n:
+                break  # torn record
+            payload = data[pos + _HEADER.size: pos + _HEADER.size + length]
+            (crc,) = _FOOTER.unpack_from(data, end - _FOOTER.size)
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                raise TranslogCorruptedError(
+                    f"translog checksum mismatch in generation {gen} at "
+                    f"offset {pos}")
+            yield TranslogOp.from_dict(json.loads(payload))
+            pos = end
+
+    def read_ops(self, from_seq_no: int = 0,
+                 to_seq_no: Optional[int] = None) -> List[TranslogOp]:
+        """All retained ops with from_seq_no <= seq_no <= to_seq_no, in log
+        order. Used for recovery replay and ops-based peer recovery
+        (reference: ``Translog.Snapshot`` / ``LuceneChangesSnapshot``)."""
+        out = []
+        for gen in range(self.min_retained_gen, self.generation + 1):
+            if gen == self.generation:
+                self.sync()
+            for op in self._read_gen(gen):
+                if op.seq_no >= from_seq_no and \
+                        (to_seq_no is None or op.seq_no <= to_seq_no):
+                    out.append(op)
+        return out
+
+    def total_operations(self) -> int:
+        return sum(1 for gen in range(self.min_retained_gen, self.generation + 1)
+                   for _ in self._read_gen(gen))
+
+    def size_in_bytes(self) -> int:
+        total = 0
+        for gen in range(self.min_retained_gen, self.generation + 1):
+            try:
+                total += os.path.getsize(self._gen_path(gen))
+            except FileNotFoundError:
+                pass
+        return total
+
+    def close(self) -> None:
+        self.sync()
+        self._fh.close()

@@ -1,0 +1,319 @@
+"""Engine tests: CAS versioning, NRT refresh, translog recovery, merges."""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import VersionConflictError
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.seqno import (
+    LocalCheckpointTracker, ReplicationTracker)
+from elasticsearch_tpu.index.translog import (
+    OP_INDEX, Translog, TranslogCorruptedError, TranslogOp)
+from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+MAPPING = {"properties": {"body": {"type": "text"},
+                          "n": {"type": "long"}}}
+
+
+def make_engine(tmp_path, **kw):
+    return Engine(str(tmp_path), MapperService(MAPPING), **kw)
+
+
+def search_ids(engine, body=None):
+    s = ShardSearcher(engine.searchable_segments(), engine.mapper)
+    return [h.doc_id for h in s.search(body or {"query": {"match_all": {}}}).hits]
+
+
+# ---------------------------------------------------------------------------
+# translog unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_translog_append_and_read(tmp_path):
+    t = Translog(str(tmp_path / "tl"))
+    t.add(TranslogOp(OP_INDEX, 0, 1, doc_id="a", source={"x": 1}))
+    t.add(TranslogOp(OP_INDEX, 1, 1, doc_id="b", source={"x": 2}))
+    ops = t.read_ops()
+    assert [o.doc_id for o in ops] == ["a", "b"]
+    assert ops[0].source == {"x": 1}
+    t.close()
+    # reopen continues the same generation
+    t2 = Translog(str(tmp_path / "tl"))
+    assert [o.doc_id for o in t2.read_ops()] == ["a", "b"]
+    t2.close()
+
+
+def test_translog_rollover_and_trim(tmp_path):
+    t = Translog(str(tmp_path / "tl"))
+    for i in range(5):
+        t.add(TranslogOp(OP_INDEX, i, 1, doc_id=str(i), source={}))
+    g1 = t.generation
+    t.rollover()
+    for i in range(5, 8):
+        t.add(TranslogOp(OP_INDEX, i, 1, doc_id=str(i), source={}))
+    assert t.total_operations() == 8
+    t.mark_committed(4)
+    removed = t.trim_unneeded_generations()
+    assert removed == [g1]
+    assert [o.seq_no for o in t.read_ops()] == [5, 6, 7]
+    t.close()
+
+
+def test_translog_detects_corruption(tmp_path):
+    t = Translog(str(tmp_path / "tl"))
+    t.add(TranslogOp(OP_INDEX, 0, 1, doc_id="a", source={"x": 1}))
+    t.close()
+    path = tmp_path / "tl" / "translog-1.tlog"
+    data = bytearray(path.read_bytes())
+    data[6] ^= 0xFF  # flip a payload bit
+    path.write_bytes(bytes(data))
+    t2 = Translog(str(tmp_path / "tl"))
+    with pytest.raises(TranslogCorruptedError):
+        t2.read_ops()
+    t2.close()
+
+
+def test_translog_ignores_torn_tail_write(tmp_path):
+    t = Translog(str(tmp_path / "tl"))
+    t.add(TranslogOp(OP_INDEX, 0, 1, doc_id="a", source={}))
+    t.close()
+    path = tmp_path / "tl" / "translog-1.tlog"
+    with open(path, "ab") as f:
+        f.write(b"\x50\x00\x00\x00partial")  # incomplete record
+    t2 = Translog(str(tmp_path / "tl"))
+    assert [o.doc_id for o in t2.read_ops()] == ["a"]
+    t2.close()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint trackers
+# ---------------------------------------------------------------------------
+
+
+def test_local_checkpoint_tracker_contiguous_advance():
+    t = LocalCheckpointTracker()
+    assert t.checkpoint == -1
+    s0, s1, s2 = t.generate_seq_no(), t.generate_seq_no(), t.generate_seq_no()
+    t.mark_processed(s1)
+    assert t.checkpoint == -1  # gap at 0
+    t.mark_processed(s0)
+    assert t.checkpoint == 1
+    t.mark_processed(s2)
+    assert t.checkpoint == 2
+
+
+def test_replication_tracker_global_checkpoint():
+    lt = LocalCheckpointTracker()
+    rt = ReplicationTracker("alloc-p", lt)
+    rt.activate_primary_mode(5)
+    assert rt.global_checkpoint == 5
+    rt.init_tracking("alloc-r1")
+    rt.mark_in_sync("alloc-r1", 3)
+    assert rt.global_checkpoint == 5  # monotonic: never goes backwards
+    rt.update_local_checkpoint("alloc-r1", 7)
+    rt.update_local_checkpoint("alloc-p", 9)
+    assert rt.global_checkpoint == 7
+    rt.remove_allocation("alloc-r1")
+    assert rt.global_checkpoint == 9
+
+
+def test_retention_leases():
+    lt = LocalCheckpointTracker()
+    rt = ReplicationTracker("a", lt, lease_expiry_millis=1000)
+    rt.activate_primary_mode(10)
+    rt.add_lease("peer-1", 4, "recovery")
+    assert rt.min_retained_seq_no() == 4
+    rt.expire_leases(now_millis=rt.leases["peer-1"].timestamp_millis + 2000)
+    assert "peer-1" not in rt.leases
+    assert rt.min_retained_seq_no() == 11
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+def test_engine_index_refresh_search(tmp_path):
+    e = make_engine(tmp_path)
+    r = e.index("1", {"body": "hello world"})
+    assert r.created and r.version == 1 and r.seq_no == 0
+    assert search_ids(e) == []  # not yet refreshed (NRT semantics)
+    e.refresh()
+    assert search_ids(e) == ["1"]
+    e.close()
+
+
+def test_engine_realtime_get_before_refresh(tmp_path):
+    e = make_engine(tmp_path)
+    e.index("1", {"body": "fresh"})
+    g = e.get("1")
+    assert g.found and g.source == {"body": "fresh"} and g.version == 1
+    e.close()
+
+
+def test_engine_update_and_versioning(tmp_path):
+    e = make_engine(tmp_path)
+    e.index("1", {"body": "v one"})
+    r2 = e.index("1", {"body": "v two"})
+    assert r2.version == 2 and not r2.created
+    e.refresh()
+    ids = search_ids(e, {"query": {"match": {"body": "two"}}})
+    assert ids == ["1"]
+    assert search_ids(e, {"query": {"match": {"body": "one"}}}) == []
+    assert e.doc_count == 1
+    e.close()
+
+
+def test_engine_update_across_refresh(tmp_path):
+    e = make_engine(tmp_path)
+    e.index("1", {"body": "old text"})
+    e.refresh()
+    e.index("1", {"body": "new text"})
+    e.refresh()
+    assert search_ids(e, {"query": {"match": {"body": "old"}}}) == []
+    assert search_ids(e, {"query": {"match": {"body": "new"}}}) == ["1"]
+    e.close()
+
+
+def test_engine_cas_if_seq_no(tmp_path):
+    e = make_engine(tmp_path)
+    r1 = e.index("1", {"body": "a"})
+    with pytest.raises(VersionConflictError):
+        e.index("1", {"body": "b"}, if_seq_no=r1.seq_no + 5,
+                if_primary_term=1)
+    r2 = e.index("1", {"body": "b"}, if_seq_no=r1.seq_no, if_primary_term=1)
+    assert r2.version == 2
+    e.close()
+
+
+def test_engine_create_conflict(tmp_path):
+    e = make_engine(tmp_path)
+    e.index("1", {"body": "a"}, op_type="create")
+    with pytest.raises(VersionConflictError):
+        e.index("1", {"body": "b"}, op_type="create")
+    e.delete("1")
+    e.index("1", {"body": "c"}, op_type="create")  # recreate after delete ok
+    e.close()
+
+
+def test_engine_delete(tmp_path):
+    e = make_engine(tmp_path)
+    e.index("1", {"body": "a"})
+    e.refresh()
+    d = e.delete("1")
+    assert d.found
+    assert not e.get("1").found
+    e.refresh()
+    assert search_ids(e) == []
+    d2 = e.delete("1")
+    assert not d2.found
+    e.close()
+
+
+def test_engine_translog_recovery_after_crash(tmp_path):
+    e = make_engine(tmp_path)
+    e.index("1", {"body": "persisted doc"})
+    e.index("2", {"body": "another doc"})
+    e.delete("1")
+    # simulate crash: no flush, no close
+    e2 = make_engine(tmp_path)
+    assert not e2.get("1").found
+    assert e2.get("2").found
+    assert search_ids(e2) == ["2"]
+    assert e2.tracker.max_seq_no == 2
+    e2.close()
+
+
+def test_engine_flush_commit_and_recover(tmp_path):
+    e = make_engine(tmp_path)
+    e.index("1", {"body": "one", "n": 1})
+    e.index("2", {"body": "two", "n": 2})
+    e.flush()
+    assert e.translog.total_operations() == 0  # trimmed after commit
+    e.index("3", {"body": "three", "n": 3})  # in translog only
+    e2 = make_engine(tmp_path)
+    assert sorted(search_ids(e2)) == ["1", "2", "3"]
+    g = e2.get("2")
+    assert g.source == {"body": "two", "n": 2}
+    e2.close()
+    e.close()
+
+
+def test_engine_recovery_preserves_versions(tmp_path):
+    e = make_engine(tmp_path)
+    e.index("1", {"body": "a"})
+    e.index("1", {"body": "b"})
+    e.flush()
+    e2 = make_engine(tmp_path)
+    r = e2.index("1", {"body": "c"})
+    assert r.version == 3
+    e2.close()
+    e.close()
+
+
+def test_engine_replica_out_of_order_ops(tmp_path):
+    e = make_engine(tmp_path)
+    # replica receives seq 1 (newer) before seq 0 (older) for same doc
+    e.index("1", {"body": "newer"}, seq_no=1, version=2)
+    r = e.index("1", {"body": "older"}, seq_no=0, version=1)
+    assert not r.created
+    assert e.get("1").source == {"body": "newer"}
+    # delete with older seq_no also ignored
+    e.delete("1", seq_no=0)
+    assert e.get("1").found
+    e.close()
+
+
+def test_engine_merge_collapses_segments(tmp_path):
+    e = make_engine(tmp_path, max_segments=3)
+    for i in range(6):
+        e.index(str(i), {"body": f"doc number {i}"})
+        e.refresh()
+    assert len(e.segments) <= 3
+    assert sorted(search_ids(e), key=int) == [str(i) for i in range(6)]
+    e.close()
+
+
+def test_engine_force_merge_prunes_deletes(tmp_path):
+    e = make_engine(tmp_path)
+    for i in range(4):
+        e.index(str(i), {"body": f"doc {i}"})
+    e.refresh()
+    e.delete("0")
+    e.delete("1")
+    e.refresh()
+    e.force_merge()
+    assert len([s for s in e.segments if s.n_docs]) == 1
+    assert e.deleted_count == 0
+    assert sorted(search_ids(e)) == ["2", "3"]
+    # merged docs still GETtable and updatable
+    assert e.get("2").found
+    r = e.index("2", {"body": "updated"})
+    assert r.version == 2
+    e.close()
+
+
+def test_engine_merge_then_flush_then_recover(tmp_path):
+    e = make_engine(tmp_path)
+    for i in range(5):
+        e.index(str(i), {"body": f"text {i}"})
+        e.refresh()
+    e.flush()
+    e.delete("0")
+    e.force_merge()
+    e.flush()
+    e2 = make_engine(tmp_path)
+    assert sorted(search_ids(e2), key=int) == ["1", "2", "3", "4"]
+    e2.close()
+    e.close()
+
+
+def test_engine_noop_advances_checkpoint(tmp_path):
+    e = make_engine(tmp_path)
+    e.index("1", {"body": "a"})
+    e.noop(1, reason="primary term bump")
+    assert e.tracker.checkpoint == 1
+    e.close()
