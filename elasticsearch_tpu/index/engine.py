@@ -50,6 +50,7 @@ class VersionValue:
     location: Optional[Tuple] = None
     source: Optional[dict] = None  # retained for realtime GET from buffer
     routing: Optional[str] = None
+    ts: float = 0.0  # tombstone creation time, for gc_deletes pruning
 
 
 @dataclass
@@ -84,26 +85,33 @@ class Engine:
     def __init__(self, path: str, mapper: MapperService,
                  primary_term: int = 1,
                  translog_durability: str = Translog.DURABILITY_REQUEST,
-                 max_segments: int = 12):
+                 max_segments: int = 12,
+                 gc_deletes_seconds: float = 60.0):
         self.path = path
         self.mapper = mapper
         self.primary_term = primary_term
         self.max_segments = max_segments
+        # tombstone retention window (reference: `index.gc_deletes`)
+        self.gc_deletes_seconds = gc_deletes_seconds
         self.store_dir = os.path.join(path, "store")
         os.makedirs(self.store_dir, exist_ok=True)
 
         self.segments: List[Segment] = []
         self._persisted_segments: Dict[str, str] = {}  # seg_id -> file name
+        self._dirty_segments: set = set()  # persisted segs with changed liveness
         self._next_seg_no = 0
         self.version_map: Dict[str, VersionValue] = {}
         self.tracker = LocalCheckpointTracker()
         self._buffer: SegmentBuilder = None  # type: ignore
-        self._new_buffer()
         self._refresh_listeners: List = []
         self.stats = {"index_total": 0, "delete_total": 0, "refresh_total": 0,
                       "flush_total": 0, "merge_total": 0, "get_total": 0}
 
         self._recover_from_store()
+        # allocate the buffer only after recovery has claimed the persisted
+        # segment ids, so a fresh buffer can never collide with (and shadow)
+        # a recovered segment in the commit point
+        self._new_buffer()
         self.translog = Translog(os.path.join(path, "translog"),
                                  durability=translog_durability)
         self._replay_translog()
@@ -162,7 +170,19 @@ class Engine:
                         location=("segment", seg, local), routing=routing)
                 self.tracker.advance_max_seq_no(data["seq_nos"][local])
                 self.tracker.mark_processed(data["seq_nos"][local])
-        self._committed_seq_no = commit.get("max_seq_no", NO_OPS_PERFORMED)
+        for uid, ts in commit.get("tombstones", {}).items():
+            cur = self.version_map.get(uid)
+            if cur is None or cur.seq_no < ts["seq_no"]:
+                self.version_map[uid] = VersionValue(
+                    version=ts["version"], seq_no=ts["seq_no"],
+                    primary_term=ts.get("primary_term", 1), deleted=True,
+                    ts=ts.get("ts", 0.0))
+        # segments only carry index-op seq-nos; deletes/no-ops below the
+        # committed local checkpoint would otherwise stay pending forever,
+        # pinning the checkpoint (and translog trimming) at a stale value
+        committed_ckpt = commit.get("local_checkpoint", NO_OPS_PERFORMED)
+        self.tracker.fast_forward(committed_ckpt)
+        self._committed_seq_no = committed_ckpt
 
     def _replay_translog(self) -> None:
         """Replay ops above the commit point (reference:
@@ -211,6 +231,11 @@ class Engine:
         else:
             _, seg, local = current.location
             seg.delete_doc(local)
+            # an already-persisted segment's liveness bitmap changed: it must
+            # be re-persisted at the next flush or the delete is lost on
+            # restart (the persisted file still says live=True)
+            if seg.seg_id in self._persisted_segments:
+                self._dirty_segments.add(seg.seg_id)
 
     # ------------------------------------------------------------------
     # index / delete / get
@@ -234,7 +259,10 @@ class Engine:
                 f"(current version [{current.version}])")
         is_replica = seq_no is not None
         if is_replica and current is not None and current.seq_no >= seq_no:
-            # out-of-order replica op; already superseded — no-op
+            # out-of-order replica op; already superseded — record a no-op so
+            # the seq-no still reaches the checkpoint and ops-based recovery
+            # (reference: InternalEngine.noOp / Translog.NoOp)
+            self._note_superseded_op(seq_no, doc_id)
             return IndexResult(seq_no=seq_no, version=current.version,
                                created=False, doc_id=doc_id)
         if seq_no is None:
@@ -251,6 +279,29 @@ class Engine:
         self.stats["index_total"] += 1
         return IndexResult(seq_no=seq_no, version=version, created=created,
                            doc_id=doc_id)
+
+    def _prune_tombstones(self) -> int:
+        """Drop tombstones past the gc_deletes window whose seq-no is fully
+        accounted in the local checkpoint — beyond the window, a stale
+        replica op for them can no longer be told apart anyway (reference
+        semantics: `index.gc_deletes` + LiveVersionMap tombstone pruning)."""
+        cutoff = time.time() - self.gc_deletes_seconds
+        ckpt = self.tracker.checkpoint
+        dead = [uid for uid, vv in self.version_map.items()
+                if vv.deleted and vv.seq_no <= ckpt and vv.ts <= cutoff]
+        for uid in dead:
+            del self.version_map[uid]
+        return len(dead)
+
+    def _note_superseded_op(self, seq_no: int, doc_id: str) -> None:
+        """An out-of-order replica op was skipped: the seq-no must still be
+        accounted (checkpoint advance) and durably represented (translog
+        no-op) or the local checkpoint would stall below it forever."""
+        self.tracker.advance_max_seq_no(seq_no)
+        self.translog.add(TranslogOp(OP_NOOP, seq_no, self.primary_term,
+                                     doc_id=doc_id,
+                                     reason="superseded by newer op"))
+        self.tracker.mark_processed(seq_no)
 
     def _apply_index(self, doc_id, source, seq_no, primary_term, version,
                      routing, add_to_translog: bool) -> None:
@@ -274,6 +325,7 @@ class Engine:
         found = current is not None and not current.deleted
         is_replica = seq_no is not None
         if is_replica and current is not None and current.seq_no >= seq_no:
+            self._note_superseded_op(seq_no, doc_id)
             return DeleteResult(seq_no=seq_no, version=current.version,
                                 found=False, doc_id=doc_id)
         if seq_no is None:
@@ -296,7 +348,7 @@ class Engine:
         # tombstone retained for out-of-order replica ops
         self.version_map[doc_id] = VersionValue(
             version=version, seq_no=seq_no, primary_term=primary_term,
-            deleted=True)
+            deleted=True, ts=time.time())
         if add_to_translog:
             self.translog.add(TranslogOp(OP_DELETE, seq_no, primary_term,
                                          doc_id=doc_id, version=version))
@@ -355,8 +407,11 @@ class Engine:
         Lucene commit + translog trim)."""
         self.refresh()
         for seg in self.segments:
-            if seg.seg_id not in self._persisted_segments:
+            if (seg.seg_id not in self._persisted_segments
+                    or seg.seg_id in self._dirty_segments):
                 self._persist_segment(seg)
+        self._dirty_segments.clear()
+        self._prune_tombstones()
         commit = {
             "segments": [self._persisted_segments[s.seg_id]
                          for s in self.segments],
@@ -365,6 +420,13 @@ class Engine:
             "primary_term": self.primary_term,
             "mapping": self.mapper.mapping_dict(),
             "timestamp": time.time(),
+            # delete tombstones must survive restarts or a redelivered stale
+            # replica op could resurrect a deleted doc (reference: Lucene
+            # soft-delete tombstone docs kept by SoftDeletesPolicy)
+            "tombstones": {
+                uid: {"seq_no": vv.seq_no, "primary_term": vv.primary_term,
+                      "version": vv.version, "ts": vv.ts}
+                for uid, vv in self.version_map.items() if vv.deleted},
         }
         tmp = self._commit_point_path() + ".tmp"
         with open(tmp, "w") as f:
@@ -404,8 +466,15 @@ class Engine:
                             for u in seg.doc_uids],
                 "primary_term": self.primary_term}
         tmp_path = os.path.join(self.store_dir, fname + ".tmp")
-        with gzip.open(tmp_path, "wt") as f:
-            json.dump(data, f)
+        # fsync (after the gzip trailer is written) before the commit point
+        # references this file: a crash after the commit-point fsync must
+        # never find a truncated segment with its ops already trimmed from
+        # the translog
+        with open(tmp_path, "wb") as raw:
+            with gzip.GzipFile(fileobj=raw, mode="wb") as gz:
+                gz.write(json.dumps(data).encode())
+            raw.flush()
+            os.fsync(raw.fileno())
         os.replace(tmp_path, os.path.join(self.store_dir, fname))
         self._persisted_segments[seg.seg_id] = fname
 

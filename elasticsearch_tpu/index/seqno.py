@@ -44,6 +44,19 @@ class LocalCheckpointTracker:
             self._checkpoint += 1
             self._pending.discard(self._checkpoint)
 
+    def fast_forward(self, seq_no: int) -> None:
+        """Restore a persisted checkpoint: everything <= seq_no is known
+        processed (used on recovery; reference: the local checkpoint handed
+        to ``LocalCheckpointTracker``'s constructor from the safe commit)."""
+        if seq_no <= self._checkpoint:
+            return
+        self._checkpoint = seq_no
+        self._max_seq_no = max(self._max_seq_no, seq_no)
+        self._pending = {s for s in self._pending if s > seq_no}
+        while self._checkpoint + 1 in self._pending:
+            self._checkpoint += 1
+            self._pending.discard(self._checkpoint)
+
     @property
     def checkpoint(self) -> int:
         return self._checkpoint

@@ -86,6 +86,10 @@ class Translog:
         self.durability = durability
         os.makedirs(directory, exist_ok=True)
         ckp = self._read_checkpoint()
+        # per-generation max seq-no (checkpointed at rollover) lets trimming
+        # compare two integers instead of re-parsing whole generation files
+        self._gen_max_seq = {int(g): s for g, s in
+                             (ckp or {}).get("gen_max_seq", {}).items()}
         if ckp is None:
             self.generation = 1
             self.min_retained_gen = 1
@@ -121,7 +125,8 @@ class Translog:
         with open(tmp, "w") as f:
             json.dump({"generation": self.generation,
                        "min_retained_gen": self.min_retained_gen,
-                       "last_committed_seq_no": self.last_committed_seq_no}, f)
+                       "last_committed_seq_no": self.last_committed_seq_no,
+                       "gen_max_seq": self._gen_max_seq}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._ckp_path())
@@ -134,6 +139,8 @@ class Translog:
         record = _HEADER.pack(len(payload)) + payload + \
             _FOOTER.pack(zlib.crc32(payload) & 0xFFFFFFFF)
         self._fh.write(record)
+        g = self.generation
+        self._gen_max_seq[g] = max(self._gen_max_seq.get(g, -1), op.seq_no)
         self._ops_since_sync += 1
         if self.durability == self.DURABILITY_REQUEST:
             self.sync()
@@ -165,13 +172,11 @@ class Translog:
         The current generation is never deleted."""
         removed = []
         for gen in range(self.min_retained_gen, self.generation):
-            max_seq = -1
-            needed = False
-            for op in self._read_gen(gen):
-                max_seq = max(max_seq, op.seq_no)
-                if op.seq_no > self.last_committed_seq_no:
-                    needed = True
-                    break
+            if gen in self._gen_max_seq:
+                needed = self._gen_max_seq[gen] > self.last_committed_seq_no
+            else:  # pre-upgrade checkpoint without gen stats: scan once
+                needed = any(op.seq_no > self.last_committed_seq_no
+                             for op in self._read_gen(gen))
             if needed:
                 break
             try:
@@ -179,6 +184,7 @@ class Translog:
             except FileNotFoundError:
                 pass
             removed.append(gen)
+            self._gen_max_seq.pop(gen, None)
             self.min_retained_gen = gen + 1
         if removed:
             self._write_checkpoint()

@@ -317,3 +317,120 @@ def test_engine_noop_advances_checkpoint(tmp_path):
     e.noop(1, reason="primary term bump")
     assert e.tracker.checkpoint == 1
     e.close()
+
+
+# ---------------------------------------------------------------------------
+# durability regressions (restart correctness)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_restart_then_flush_no_seg_id_collision(tmp_path):
+    """A post-restart buffer must not reuse a recovered segment's id —
+    that silently skipped persisting the new docs (data loss)."""
+    e = make_engine(tmp_path)
+    e.index("a", {"body": "one"})
+    e.flush()
+    e.close()
+    e2 = make_engine(tmp_path)
+    e2.index("b", {"body": "two"})
+    e2.flush()
+    e2.close()
+    e3 = make_engine(tmp_path)
+    assert sorted(search_ids(e3)) == ["a", "b"]
+    assert e3.get("b").found
+    assert e3.doc_count == 2
+    e3.close()
+
+
+def test_engine_restart_restores_local_checkpoint(tmp_path):
+    """Deletes leave no segment doc; the committed checkpoint must be
+    restored on recovery or it regresses and pins the translog."""
+    e = make_engine(tmp_path)
+    e.index("a", {"body": "one"})       # seq 0
+    e.delete("a")                       # seq 1
+    e.index("b", {"body": "two"})       # seq 2
+    e.flush()
+    assert e.tracker.checkpoint == 2
+    e.close()
+    e2 = make_engine(tmp_path)
+    assert e2.tracker.checkpoint == 2
+    assert e2.tracker.pending_count() == 0
+    e2.close()
+
+
+def test_engine_replica_out_of_order_op_advances_checkpoint(tmp_path):
+    """A skipped (superseded) replica op must still be accounted in the
+    local checkpoint and appear as a translog no-op."""
+    e = make_engine(tmp_path)
+    e.index("x", {"body": "newer"}, seq_no=1)
+    e.index("x", {"body": "older"}, seq_no=0)   # out of order: skipped
+    assert e.tracker.checkpoint == 1
+    assert e.tracker.pending_count() == 0
+    ops = e.translog.read_ops()
+    assert any(o.op_type == "no_op" and o.seq_no == 0 for o in ops)
+    # same for deletes
+    e.delete("x", seq_no=3)
+    e.delete("x", seq_no=2)
+    assert e.tracker.checkpoint == 3
+    e.close()
+
+
+def test_engine_tombstone_survives_restart(tmp_path):
+    """A stale replica index op redelivered after flush+restart must not
+    resurrect a deleted doc (tombstones persist in the commit point)."""
+    e = make_engine(tmp_path)
+    e.index("a", {"body": "one"}, seq_no=0)
+    e.delete("a", seq_no=1)
+    e.flush()
+    e.close()
+    e2 = make_engine(tmp_path)
+    r = e2.index("a", {"body": "one"}, seq_no=0)   # stale redelivery
+    assert not e2.get("a").found
+    e2.close()
+
+
+def test_engine_delete_in_flushed_segment_survives_restart(tmp_path):
+    """Liveness changes to already-persisted segments must be re-persisted
+    at the next flush (dirty-segment tracking)."""
+    e = make_engine(tmp_path)
+    e.index("a", {"body": "one"})
+    e.index("b", {"body": "two"})
+    e.flush()
+    e.delete("a")
+    e.flush()
+    e.close()
+    e2 = make_engine(tmp_path)
+    assert sorted(search_ids(e2)) == ["b"]
+    assert e2.doc_count == 1
+    e2.close()
+
+
+def test_engine_update_in_flushed_segment_no_duplicate_after_restart(tmp_path):
+    e = make_engine(tmp_path)
+    e.index("x", {"body": "v1"})
+    e.flush()
+    e.index("x", {"body": "v2"})
+    e.flush()
+    e.close()
+    e2 = make_engine(tmp_path)
+    assert search_ids(e2) == ["x"]
+    assert e2.doc_count == 1
+    assert e2.get("x").source == {"body": "v2"}
+    e2.close()
+
+
+def test_engine_tombstones_pruned_after_gc_window(tmp_path):
+    e = Engine(str(tmp_path), MapperService(MAPPING), gc_deletes_seconds=0.0)
+    e.index("a", {"body": "one"})
+    e.delete("a")
+    e.flush()
+    assert not any(vv.deleted for vv in e.version_map.values())
+    e.close()
+    # but inside the window they are retained
+    e2 = Engine(str(tmp_path / "w"), MapperService(MAPPING),
+                gc_deletes_seconds=3600.0)
+    e2.index("a", {"body": "one"})
+    e2.delete("a")
+    e2.flush()
+    assert any(vv.deleted for vv in e2.version_map.values())
+    e2.close()
