@@ -1,0 +1,111 @@
+"""Headline benchmark: batched BM25 top-k QPS, TPU vs CPU reference.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload (BASELINE.md eval config #1 shape, synthetic stand-in for MS MARCO
+since the image has no dataset): Zipf-distributed corpus, batched bag-of-words
+queries, k=10. ``vs_baseline`` is TPU QPS / CPU QPS where the CPU reference is
+a vectorized numpy CSR BM25 (per-term gather + scatter-add + argpartition
+top-k — the same eager-scoring algorithm, honestly tuned for CPU; it stands in
+for Lucene's BulkScorer loop which is not available in this image).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_DOCS = 1 << 18           # 262k docs
+VOCAB = 1 << 16
+AVG_DL = 32
+BATCH = 64                 # queries per dispatch
+N_TERMS = 4                # terms per query
+K = 10
+DF_MIN, DF_MAX = 16, 4096  # query terms drawn from mid-frequency vocab
+TIMED_ITERS = 8
+K1, B = 1.2, 0.75
+
+
+def build_corpus(rng):
+    from elasticsearch_tpu.utils.synth import synthetic_csr_corpus
+    return synthetic_csr_corpus(rng, N_DOCS, VOCAB, AVG_DL, zipf_s=1.2)
+
+
+def sample_queries(rng, corpus, n_batches):
+    eligible = np.flatnonzero((corpus["df"] >= DF_MIN) & (corpus["df"] <= DF_MAX))
+    batches = []
+    for _ in range(n_batches):
+        qs = [[f"t{t}" for t in rng.choice(eligible, N_TERMS, replace=False)]
+              for _ in range(BATCH)]
+        batches.append(qs)
+    return batches
+
+
+def cpu_bm25_search(corpus, batches, k):
+    """Vectorized numpy CSR BM25 + argpartition top-k (CPU reference)."""
+    offsets, docs, tf = corpus["offsets"], corpus["docs"], corpus["tf"]
+    dl = corpus["doc_len"]
+    avgdl = dl.mean()
+    df = corpus["df"]
+    out = []
+    t0 = time.perf_counter()
+    for qs in batches:
+        for terms in qs:
+            scores = np.zeros(N_DOCS, np.float32)
+            for t in terms:
+                tid = int(t[1:])
+                st, en = offsets[tid], offsets[tid + 1]
+                if en == st:
+                    continue
+                run_docs = docs[st:en]
+                run_tf = tf[st:en]
+                idf = np.log(1 + (N_DOCS - df[tid] + 0.5) / (df[tid] + 0.5))
+                norm = run_tf + K1 * (1 - B + B * dl[run_docs] / avgdl)
+                scores[run_docs] += idf * (K1 + 1) * run_tf / norm
+            top = np.argpartition(-scores, k)[:k]
+            out.append(top[np.argsort(-scores[top], kind="stable")])
+    return time.perf_counter() - t0, out
+
+
+def main():
+    rng = np.random.RandomState(1234)
+    corpus = build_corpus(rng)
+    corpus["term_ids"] = {f"t{t}": t for t in range(VOCAB)}
+
+    # ---- CPU reference ----------------------------------------------------
+    cpu_batches = sample_queries(rng, corpus, 2)
+    cpu_s, _ = cpu_bm25_search(corpus, cpu_batches, K)
+    cpu_qps = (2 * BATCH) / cpu_s
+
+    # ---- TPU --------------------------------------------------------------
+    import jax
+    from elasticsearch_tpu.parallel import DistributedSearchPlane, make_search_mesh
+
+    n_dev = len(jax.devices())
+    mesh = make_search_mesh(n_shards=n_dev, n_replicas=1)
+    if n_dev > 1:
+        # split corpus into per-device shards by doc id range
+        raise SystemExit("multi-device bench path not wired yet")
+    plane = DistributedSearchPlane(mesh, [corpus], field="body")
+
+    warm = sample_queries(rng, corpus, 1)[0]
+    plane.search(warm, k=K, Q=N_TERMS, L=DF_MAX)          # compile
+    timed_batches = sample_queries(rng, corpus, TIMED_ITERS)
+    t0 = time.perf_counter()
+    for qs in timed_batches:
+        vals, hits = plane.search(qs, k=K, Q=N_TERMS, L=DF_MAX)
+    tpu_s = time.perf_counter() - t0
+    tpu_qps = (TIMED_ITERS * BATCH) / tpu_s
+
+    print(json.dumps({
+        "metric": "bm25_topk_qps_262k_docs",
+        "value": round(tpu_qps, 1),
+        "unit": "queries/s",
+        "vs_baseline": round(tpu_qps / cpu_qps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -37,42 +37,53 @@ DEFAULT_K1 = 1.2
 DEFAULT_B = 0.75
 
 
+def bm25_score_body(postings_docs, postings_tf, doc_len, starts, lengths, idf,
+                    weights, avgdl, k1, b, *, segment_pad: int, L: int):
+    """Score one segment for a bag of query terms into *dense* per-doc
+    arrays (pure traced body; ``get_bm25_kernel`` jits it). This is the
+    general-query-DSL path — compound queries need dense (scores, mask)
+    algebra; the pure top-k hot path uses the scatter-free kernel in
+    ``ops/sorted_merge.py`` instead.
+
+    postings_docs: int32[P] flat CSR doc ids (runs sorted by doc id).
+    postings_tf:   float32[P] term frequency per posting.
+    doc_len:       float32[N] tokens per doc in this field (padding: 0).
+    starts:        int32[Q] start offset of each term's postings run;
+                   terms absent from the segment use start=P (→ no-op).
+    lengths:       int32[Q] postings run length (0 if absent).
+    idf:           float32[Q] per-term idf from *shard-level* stats (idf
+                   is cross-segment in Lucene, so it cannot be baked into
+                   the segment at build time).
+    weights:       float32[Q] boost × duplicate-count per unique term.
+    avgdl, k1, b:  float32 scalars.
+
+    Returns (scores float32[N], matched int32[N]) where ``matched`` counts
+    distinct query term slots hitting each doc.
+    """
+    P = postings_docs.shape[0]
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]             # [1, L]
+    valid = pos < lengths[:, None]                            # [Q, L]
+    idx = jnp.where(valid, starts[:, None] + pos, P)
+    docs = jnp.take(postings_docs, idx, mode="fill", fill_value=segment_pad)
+    tfs = jnp.take(postings_tf, idx, mode="fill", fill_value=0.0)
+    dl = jnp.take(doc_len, docs, mode="fill", fill_value=0.0)
+    norm = tfs + k1 * (1.0 - b + b * dl / avgdl)
+    contrib = (idf * weights)[:, None] * (k1 + 1.0) * tfs / jnp.maximum(norm, 1e-9)
+    contrib = jnp.where(valid, contrib, 0.0)
+    flat_docs = docs.reshape(-1)
+    scores = jnp.zeros(segment_pad, jnp.float32).at[flat_docs].add(
+        contrib.reshape(-1), mode="drop")
+    matched = jnp.zeros(segment_pad, jnp.int32).at[flat_docs].add(
+        valid.reshape(-1).astype(jnp.int32), mode="drop")
+    return scores, matched
+
+
 def _bm25_kernel(segment_pad: int, L: int):
     def kernel(postings_docs, postings_tf, doc_len, starts, lengths, idf,
                weights, avgdl, k1, b):
-        """Score one segment for a bag of query terms.
-
-        postings_docs: int32[P] flat CSR doc ids (runs sorted by doc id).
-        postings_tf:   float32[P] term frequency per posting.
-        doc_len:       float32[N] tokens per doc in this field (padding: 0).
-        starts:        int32[Q] start offset of each term's postings run;
-                       terms absent from the segment use start=P (→ no-op).
-        lengths:       int32[Q] postings run length (0 if absent).
-        idf:           float32[Q] per-term idf from *shard-level* stats (idf
-                       is cross-segment in Lucene, so it cannot be baked into
-                       the segment at build time).
-        weights:       float32[Q] boost × duplicate-count per unique term.
-        avgdl, k1, b:  float32 scalars.
-
-        Returns (scores float32[N], matched int32[N]) where ``matched`` counts
-        distinct query term slots hitting each doc.
-        """
-        P = postings_docs.shape[0]
-        pos = jnp.arange(L, dtype=jnp.int32)[None, :]             # [1, L]
-        valid = pos < lengths[:, None]                            # [Q, L]
-        idx = jnp.where(valid, starts[:, None] + pos, P)
-        docs = jnp.take(postings_docs, idx, mode="fill", fill_value=segment_pad)
-        tfs = jnp.take(postings_tf, idx, mode="fill", fill_value=0.0)
-        dl = jnp.take(doc_len, docs, mode="fill", fill_value=0.0)
-        norm = tfs + k1 * (1.0 - b + b * dl / avgdl)
-        contrib = (idf * weights)[:, None] * (k1 + 1.0) * tfs / jnp.maximum(norm, 1e-9)
-        contrib = jnp.where(valid, contrib, 0.0)
-        flat_docs = docs.reshape(-1)
-        scores = jnp.zeros(segment_pad, jnp.float32).at[flat_docs].add(
-            contrib.reshape(-1), mode="drop")
-        matched = jnp.zeros(segment_pad, jnp.int32).at[flat_docs].add(
-            valid.reshape(-1).astype(jnp.int32), mode="drop")
-        return scores, matched
+        return bm25_score_body(postings_docs, postings_tf, doc_len, starts,
+                               lengths, idf, weights, avgdl, k1, b,
+                               segment_pad=segment_pad, L=L)
 
     return jax.jit(kernel)
 

@@ -1,0 +1,118 @@
+"""Sorted-merge BM25 top-k: scatter-free, gather-free candidate scoring.
+
+The dense kernel in ``ops/bm25.py`` scatter-adds every posting into a
+[N_docs] score array and top-ks the whole corpus — fine for feeding
+aggregations a dense mask, but wrong for the pure top-k hot path: TPU
+scatters serialize, arbitrary-index gathers from HBM-resident postings
+tables are slow, and ``lax.top_k`` over the corpus costs O(N log N).
+
+This kernel is the document-at-a-time analogue, mapped to what the TPU does
+well (Lucene's equivalent is the postings-cursor heap inside ``BulkScorer`` —
+``search/internal/ContextIndexSearcher.java:210-224``):
+
+1. **dynamic_slice** (a DMA copy, not a gather) pulls each query term's
+   postings run — doc ids + *precomputed impact scores* — into a [Q, L]
+   tile. Impacts are the query-independent part of BM25,
+   ``(k1+1)·tf / (tf + k1·(1-b+b·dl/avgdl))``, materialized per posting at
+   segment-build time (the BM25S eager-scoring idea), so query time does no
+   doc-length lookups at all; only ``idf·boost`` scales at query time.
+2. flatten to [Q*L] and sort by doc id (``lax.sort`` — bitonic, fully
+   vectorized);
+3. segment-reduce duplicate docs with cumsum + group-boundary bookkeeping:
+   a doc matched by multiple terms sums its contributions;
+4. ``lax.top_k`` over the Q*L candidates (≪ corpus size). Any doc with a
+   non-zero score appears in some run, so this is exact.
+
+Tie-break: group totals are emitted at each group's last slot and tail slots
+stay doc-ascending, so equal scores resolve to the lower doc id — Lucene's
+order.
+
+Table padding contract: ``postings_docs``/``postings_impact`` must be padded
+with sentinel ``doc = n_pad`` entries to at least ``max(starts) + L`` so a
+``dynamic_slice`` never clamps into another term's run.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+NEG_INF = float("-inf")
+
+
+def make_impacts(tf: np.ndarray, docs: np.ndarray, doc_len: np.ndarray,
+                 avgdl: float, k1: float, b: float) -> np.ndarray:
+    """Per-posting query-independent BM25 impact (host-side, at build)."""
+    dl = doc_len[docs]
+    return ((k1 + 1.0) * tf / (tf + k1 * (1.0 - b + b * dl / avgdl))
+            ).astype(np.float32)
+
+
+def bm25_topk_merge_body(postings_docs, postings_impact, starts, lengths,
+                         idfw, *, n_pad: int, L: int, k: int,
+                         min_should_match: int = 1):
+    """Score one query against one shard partition, returning (values f32[k],
+    local_doc i32[k]); empty slots carry -inf / n_pad.
+
+    postings_docs:   int32[P'] flat CSR doc ids (padding: n_pad sentinel).
+    postings_impact: float32[P'] precomputed impacts (see make_impacts).
+    starts:          int32[Q] run start offsets (absent terms: any valid
+                     offset with length 0).
+    lengths:         int32[Q] run lengths, clamped to L by the caller.
+    idfw:            float32[Q] idf × boost × duplicate-count per term.
+    min_should_match: minimum distinct matching term slots per doc.
+    """
+    Q = starts.shape[0]
+
+    def slice_run(s):
+        return (lax.dynamic_slice(postings_docs, (s,), (L,)),
+                lax.dynamic_slice(postings_impact, (s,), (L,)))
+
+    docs, imps = jax.vmap(slice_run)(starts)                  # [Q, L]
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    valid = pos < lengths[:, None]
+    docs = jnp.where(valid, docs, n_pad)
+    contrib = jnp.where(valid, imps * idfw[:, None], 0.0)
+
+    flat_docs = docs.reshape(-1)                              # [Q*L]
+    flat_contrib = contrib.reshape(-1)
+    flat_valid = valid.reshape(-1).astype(jnp.float32)
+
+    # sort candidates by doc id (stable); padding (doc=n_pad) sinks to the end
+    sdocs, scontrib, svalid = lax.sort(
+        (flat_docs, flat_contrib, flat_valid), num_keys=1)
+
+    # Segment-reduce groups of equal doc id (contiguous after the sort).
+    # A doc appears in at most Q runs, so every group has <= Q elements:
+    # sum them with Q-1 shifted adds instead of a cumsum difference — the
+    # cumsum trick reconstructs each group's sum with prefix-dependent
+    # rounding, which breaks exact score ties (Lucene tie-break parity
+    # needs identical docs to score bitwise-identically).
+    n = sdocs.shape[0]
+    nxt = jnp.concatenate([sdocs[1:], jnp.full((1,), -2, sdocs.dtype)])
+    is_last = sdocs != nxt
+    gscore = scontrib
+    gcount = svalid
+    for j in range(1, Q):
+        shifted_docs = jnp.concatenate(
+            [jnp.full((j,), -1, sdocs.dtype), sdocs[:-j]])
+        same = shifted_docs == sdocs
+        gscore = gscore + jnp.where(
+            same, jnp.concatenate([jnp.zeros((j,), scontrib.dtype),
+                                   scontrib[:-j]]), 0.0)
+        gcount = gcount + jnp.where(
+            same, jnp.concatenate([jnp.zeros((j,), svalid.dtype),
+                                   svalid[:-j]]), 0.0)
+
+    score = jnp.where(
+        is_last & (sdocs < n_pad) & (gcount >= min_should_match),
+        gscore, NEG_INF)
+    vals, sel = lax.top_k(score, min(k, n))
+    out_docs = jnp.take(sdocs, sel, mode="clip")
+    out_docs = jnp.where(vals > NEG_INF, out_docs, n_pad)
+    if n < k:                       # fewer candidates than requested hits
+        vals = jnp.pad(vals, (0, k - n), constant_values=NEG_INF)
+        out_docs = jnp.pad(out_docs, (0, k - n), constant_values=n_pad)
+    return vals, out_docs.astype(jnp.int32)

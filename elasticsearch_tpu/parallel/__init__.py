@@ -1,0 +1,24 @@
+"""Distributed data plane: device meshes + collective search kernels.
+
+The reference scales by (a) hash-sharding docs across nodes
+(``cluster/routing/OperationRouting.java:242``), (b) scatter-gather
+query-then-fetch over its TCP transport (``action/search/``), and (c)
+replication for read scaling (adaptive replica selection). Here the same
+parallelism axes map onto a ``jax.sharding.Mesh``:
+
+- ``shard`` axis  = data parallelism over document partitions (ES shards);
+  per-shard BM25/kNN runs device-local, global top-k rides ICI collectives
+  (``all_gather`` + ``lax.top_k`` tree reduce) instead of the reference's
+  coordinator-side ``TopDocs.merge`` over TCP.
+- ``replica`` axis = read parallelism: the query *batch* is partitioned over
+  replica groups, each of which holds a full copy of the corpus shards —
+  the mesh analogue of routing different searches to different copies.
+"""
+
+from .mesh import make_search_mesh, search_mesh_axes
+from .dist_search import DistributedSearchPlane, build_bm25_topk_step, build_knn_step
+
+__all__ = [
+    "make_search_mesh", "search_mesh_axes",
+    "DistributedSearchPlane", "build_bm25_topk_step", "build_knn_step",
+]

@@ -1,0 +1,52 @@
+"""Device-mesh construction for the search data plane.
+
+Replaces the reference's static cluster topology (nodes discovered by
+``discovery/PeerFinder.java``, shards placed by
+``BalancedShardsAllocator.java:80``) with an explicit 2-D
+``jax.sharding.Mesh``:
+
+    axes = ("replica", "shard")
+
+``shard`` partitions the corpus (ES primary shards), ``replica`` partitions
+the query stream over full corpus copies (ES replica shards + adaptive
+replica selection). On real hardware, ``shard`` should map to the
+fastest-ICI dimension of the slice since global top-k reduction rides it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+AXIS_REPLICA = "replica"
+AXIS_SHARD = "shard"
+
+
+def search_mesh_axes() -> Tuple[str, str]:
+    return (AXIS_REPLICA, AXIS_SHARD)
+
+
+def make_search_mesh(n_shards: Optional[int] = None, n_replicas: int = 1,
+                     devices: Optional[Sequence] = None) -> Mesh:
+    """Build the (replica, shard) mesh over ``devices``.
+
+    Defaults: all local devices, one replica group. ``n_shards`` defaults to
+    ``len(devices) // n_replicas``. Requires
+    ``n_replicas * n_shards == len(devices)``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_shards is None:
+        if len(devices) % n_replicas:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by {n_replicas} replicas")
+        n_shards = len(devices) // n_replicas
+    need = n_replicas * n_shards
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {n_replicas}x{n_shards} needs {need} devices, "
+            f"have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(n_replicas, n_shards)
+    return Mesh(grid, (AXIS_REPLICA, AXIS_SHARD))

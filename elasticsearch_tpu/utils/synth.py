@@ -1,0 +1,31 @@
+"""Synthetic Zipf CSR corpora for benchmarks and dry runs (stand-in for
+real datasets in a zero-egress image; shapes mirror what SegmentBuilder
+emits — see index/segment.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def synthetic_csr_corpus(rng: np.random.RandomState, n_docs: int, vocab: int,
+                         avg_dl: int, zipf_s: float = 1.2) -> dict:
+    """Zipf-distributed postings for one shard: dict with ``docs`` i32[P]
+    (CSR doc ids, doc-ascending per term run), ``tf`` f32[P], ``offsets``
+    i64[V+1], ``df`` i32[V], ``doc_len`` f32[N]."""
+    lens = np.maximum(1, rng.poisson(avg_dl, n_docs))
+    ranks = rng.zipf(zipf_s, size=int(lens.sum()))
+    terms = np.minimum(ranks - 1, vocab - 1).astype(np.int64)
+    doc_of = np.repeat(np.arange(n_docs, dtype=np.int64), lens)
+    order = np.lexsort((doc_of, terms))
+    terms, doc_of = terms[order], doc_of[order]
+    key = terms * n_docs + doc_of
+    uniq, counts = np.unique(key, return_counts=True)
+    p_terms = (uniq // n_docs).astype(np.int64)
+    p_docs = (uniq % n_docs).astype(np.int32)
+    p_tf = counts.astype(np.float32)
+    offsets = np.zeros(vocab + 1, np.int64)
+    np.add.at(offsets, p_terms + 1, 1)
+    offsets = np.cumsum(offsets)
+    df = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    return dict(docs=p_docs, tf=p_tf, offsets=offsets, df=df,
+                doc_len=lens.astype(np.float32))

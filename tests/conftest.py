@@ -8,11 +8,21 @@ multiple devices, so we run everything on 8 virtual CPU devices.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment points at a real accelerator
+# (the driver's env sets JAX_PLATFORMS to the TPU tunnel, and its
+# sitecustomize registers that backend at interpreter startup — env vars
+# alone don't win): tests need the 8-device virtual mesh and must not
+# depend on hardware, so override through jax.config before any backend
+# initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

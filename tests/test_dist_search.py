@@ -1,0 +1,258 @@
+"""Distributed search plane: shard-parallel BM25 + ICI top-k reduce vs a
+brute-force host reference (mirrors the reference's coordination tests around
+``SearchPhaseController`` merge correctness)."""
+
+import math
+
+import numpy as np
+import pytest
+import jax
+
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.parallel import (
+    DistributedSearchPlane, build_knn_step, make_search_mesh)
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+K1, B = 1.2, 0.75
+
+DOCS = [
+    "the quick brown fox jumps over the lazy dog",
+    "a fast auburn fox leaped over sleeping hounds",
+    "quick thinking saves the day",
+    "the dog sleeps all day long",
+    "brown bears eat fish in the river",
+    "the river runs quick and cold",
+    "lazy afternoons by the river bank",
+    "fox and dog play in the park",
+    "parks have dogs and foxes at dusk",
+    "dusk settles over the quiet park",
+    "quiet quick foxes avoid loud dogs",
+    "loud hounds bark at the brown fox",
+]
+
+
+def _build_shards(n_shards):
+    mapper = MapperService()
+    mapper.merge({"properties": {"body": {"type": "text"}}})
+    segs = []
+    for si in range(n_shards):
+        b = SegmentBuilder(f"s{si}")
+        for di, text in enumerate(DOCS):
+            if di % n_shards == si:
+                parsed = mapper.parse_document(str(di), {"body": text})
+                b.add(parsed, seq_no=di)
+        segs.append(b.build())
+    return mapper, segs
+
+
+def _ref_bm25(query_terms, n_shards):
+    """Brute force with global idf/per-shard avgdl, Lucene formulas."""
+    tokens = [d.split() for d in DOCS]
+    n = len(DOCS)
+    scores = {}
+    df = {}
+    for t in set(query_terms):
+        df[t] = sum(1 for toks in tokens if t in toks)
+    shard_of = {di: di % n_shards for di in range(n)}
+    shard_docs = {}
+    for di in range(n):
+        shard_docs.setdefault(shard_of[di], []).append(di)
+    avgdl = {s: sum(len(tokens[d]) for d in ds) / len(ds)
+             for s, ds in shard_docs.items()}
+    for di, toks in enumerate(tokens):
+        s = 0.0
+        matched = False
+        for t in set(query_terms):
+            tf = toks.count(t)
+            if tf == 0 or df[t] == 0:
+                continue
+            matched = True
+            idf = math.log(1 + (n - df[t] + 0.5) / (df[t] + 0.5))
+            w = query_terms.count(t)
+            dl = len(toks)
+            s += w * idf * (K1 + 1) * tf / (
+                tf + K1 * (1 - B + B * dl / avgdl[shard_of[di]]))
+        if matched:
+            scores[di] = s
+    return scores
+
+
+@pytest.mark.parametrize("n_shards,n_replicas", [(4, 1), (4, 2), (8, 1)])
+def test_dist_bm25_matches_bruteforce(n_shards, n_replicas):
+    mesh = make_search_mesh(n_shards=min(n_shards, 8 // n_replicas),
+                            n_replicas=n_replicas)
+    mapper, segs = _build_shards(n_shards)
+    plane = DistributedSearchPlane.from_segments(mesh, segs, "body")
+    queries = [["quick", "fox"], ["river"], ["dog", "dog", "park"],
+               ["zzz_absent"]]
+    vals, hits = plane.search(queries, k=5)
+    for bi, q in enumerate(queries):
+        ref = _ref_bm25(q, n_shards)
+        expect = sorted(ref.items(), key=lambda kv: -kv[1])[:5]
+        got = []
+        for (shard, local), v in zip(hits[bi], vals[bi]):
+            doc_global = int(segs[shard].doc_uids[local])
+            got.append((doc_global, float(v)))
+        assert len(got) == len(expect), (q, got, expect)
+        for (gd, gv), (ed, ev) in zip(got, expect):
+            assert abs(gv - ev) < 1e-4, (q, got, expect)
+
+
+def test_dist_bm25_batch_replica_consistency():
+    """Same query in different batch slots (different replica groups) must
+    score identically — replica parallelism is read-only scaling."""
+    n_shards = 4
+    mesh = make_search_mesh(n_shards=4, n_replicas=2)
+    mapper, segs = _build_shards(n_shards)
+    plane = DistributedSearchPlane.from_segments(mesh, segs, "body")
+    queries = [["quick", "fox"]] * 4
+    vals, hits = plane.search(queries, k=3)
+    for bi in range(1, 4):
+        np.testing.assert_allclose(vals[bi], vals[0])
+        assert hits[bi] == hits[0]
+
+
+def test_dist_knn_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    n_shards, n_per, dim, k = 8, 16, 8, 5
+    mesh = make_search_mesh(n_shards=8, n_replicas=1)
+    vecs = rng.randn(n_shards, n_per, dim).astype(np.float32)
+    exists = np.ones((n_shards, n_per), bool)
+    exists[0, 3] = False
+    queries = rng.randn(4, dim).astype(np.float32)
+
+    step = build_knn_step(mesh, n_pad=n_per, dim=dim, k=k, n_shards=n_shards)
+    vals, gdocs = step(
+        jax.device_put(vecs, NamedSharding(mesh, P("shard", None, None))),
+        jax.device_put(exists, NamedSharding(mesh, P("shard", None))),
+        jax.device_put(queries, NamedSharding(mesh, P("replica", None))))
+    vals, gdocs = np.asarray(vals), np.asarray(gdocs)
+
+    flat = vecs.reshape(-1, dim)
+    all_scores = queries @ flat.T
+    all_scores[:, np.flatnonzero(~exists.reshape(-1))] = -np.inf
+    for bi in range(queries.shape[0]):
+        order = np.argsort(-all_scores[bi], kind="stable")[:k]
+        np.testing.assert_allclose(vals[bi], all_scores[bi][order], rtol=1e-5)
+        np.testing.assert_array_equal(gdocs[bi], order)
+
+
+def test_sorted_merge_matches_dense_kernel():
+    """The scatter-free sorted-merge kernel must agree with the dense
+    scatter kernel on random CSR postings."""
+    import jax.numpy as jnp
+    from jax import lax
+    from elasticsearch_tpu.ops.bm25 import bm25_score_body
+    from elasticsearch_tpu.ops.sorted_merge import bm25_topk_merge_body
+
+    from elasticsearch_tpu.ops.sorted_merge import make_impacts
+
+    rng = np.random.RandomState(3)
+    n_pad, V, L, Q, k = 64, 32, 16, 4, 10
+    # random postings: each term gets a sorted doc subset
+    runs, offs = [], [0]
+    for t in range(V):
+        nd = rng.randint(0, 14)
+        docs = np.sort(rng.choice(n_pad - 4, nd, replace=False))
+        runs.append((docs, rng.randint(1, 5, nd)))
+        offs.append(offs[-1] + nd)
+    P = offs[-1]
+    pd = np.concatenate([r[0] for r in runs]).astype(np.int32)
+    pt = np.concatenate([r[1] for r in runs]).astype(np.float32)
+    dl = rng.randint(1, 30, n_pad).astype(np.float32)
+    avgdl = np.float32(dl.mean())
+    imp = make_impacts(pt, pd, dl, float(avgdl), 1.2, 0.75)
+    # sentinel-pad the tables by L so dynamic_slice never clamps
+    pd_pad = np.pad(pd, (0, L), constant_values=n_pad)
+    imp_pad = np.pad(imp, (0, L))
+
+    for trial in range(5):
+        tids = rng.choice(V, Q, replace=False)
+        starts = np.asarray([offs[t] for t in tids], np.int32)
+        lengths = np.asarray([offs[t + 1] - offs[t] for t in tids], np.int32)
+        idf = rng.rand(Q).astype(np.float32) + 0.1
+        w = np.ones(Q, np.float32)
+        dense_args = (jnp.asarray(pd), jnp.asarray(pt), jnp.asarray(dl),
+                      jnp.asarray(starts), jnp.asarray(lengths),
+                      jnp.asarray(idf), jnp.asarray(w), avgdl,
+                      jnp.float32(1.2), jnp.float32(0.75))
+        dscores, dmatched = bm25_score_body(*dense_args, segment_pad=n_pad, L=L)
+        masked = jnp.where(dmatched > 0, dscores, -np.inf)
+        evals, eidx = lax.top_k(masked, k)
+        mvals, midx = bm25_topk_merge_body(
+            jnp.asarray(pd_pad), jnp.asarray(imp_pad), jnp.asarray(starts),
+            jnp.asarray(lengths), jnp.asarray(idf * w), n_pad=n_pad, L=L, k=k)
+        np.testing.assert_allclose(np.asarray(mvals), np.asarray(evals),
+                                   rtol=1e-5, atol=1e-6)
+        # per-doc score parity (ordering of float-level near-ties may differ
+        # between scatter and cumsum accumulation; Lucene only defines order
+        # for exact ties)
+        dense = np.asarray(dscores)
+        ev, mv, mi = np.asarray(evals), np.asarray(mvals), np.asarray(midx)
+        for v, d in zip(mv, mi):
+            if v == -np.inf:
+                continue
+            np.testing.assert_allclose(v, dense[d], rtol=1e-5, atol=1e-6)
+
+
+def test_sorted_merge_min_should_match():
+    import jax.numpy as jnp
+    from elasticsearch_tpu.ops.sorted_merge import bm25_topk_merge_body
+
+    from elasticsearch_tpu.ops.sorted_merge import make_impacts
+
+    # docs: term0 -> {0,1}, term1 -> {1,2}
+    pd = np.asarray([0, 1, 1, 2], np.int32)
+    pt = np.ones(4, np.float32)
+    dl = np.ones(8, np.float32)
+    imp = make_impacts(pt, pd, dl, 1.0, 1.2, 0.75)
+    starts = np.asarray([0, 2], np.int32)
+    lengths = np.asarray([2, 2], np.int32)
+    idfw = np.ones(2, np.float32)
+    vals, docs = bm25_topk_merge_body(
+        jnp.asarray(np.pad(pd, (0, 4), constant_values=8)),
+        jnp.asarray(np.pad(imp, (0, 4))),
+        jnp.asarray(starts), jnp.asarray(lengths), jnp.asarray(idfw),
+        n_pad=8, L=4, k=5, min_should_match=2)
+    vals, docs = np.asarray(vals), np.asarray(docs)
+    assert docs[0] == 1 and vals[0] > 0
+    assert (vals[1:] == -np.inf).all()
+
+
+def test_plane_slice_slack_no_foreign_run_bleed():
+    """Regression: a short run near the table end must not have its
+    dynamic_slice clamp into a foreign term's postings."""
+    from elasticsearch_tpu.parallel.dist_search import DistributedSearchPlane
+    # one shard: term 'big' with 54 postings then term 'tail' with 5,
+    # pn + max_df lands exactly on a power of two (59 + 5 = 64)
+    big_docs = np.arange(54, dtype=np.int32)
+    tail_docs = np.asarray([42, 50, 55, 60, 61], np.int32)
+    docs = np.concatenate([big_docs, tail_docs])
+    tf = np.ones(59, np.float32)
+    offsets = np.asarray([0, 54, 59], np.int64)
+    df = np.asarray([54, 5], np.int32)
+    doc_len = np.ones(64, np.float32)
+    shard = dict(term_ids={"big": 0, "tail": 1}, df=df, offsets=offsets,
+                 docs=docs, tf=tf, doc_len=doc_len)
+    mesh = make_search_mesh(n_shards=1, n_replicas=1)
+    plane = DistributedSearchPlane(mesh, [shard], field="body")
+    vals, hits = plane.search([["big", "tail"]], k=10)
+    got_docs = {d for (_, d) in hits[0]}
+    # every 'tail' doc matches; doc 42 matches both terms and must rank first
+    assert {42, 50, 55, 60, 61} <= got_docs
+    assert hits[0][0][1] == 42
+    # explicit L below the longest queried run must refuse, not truncate
+    with pytest.raises(ValueError):
+        plane.search([["big", "tail"]], k=10, L=8)
+
+
+def test_plane_odd_batch_with_replicas():
+    """Batch sizes not divisible by the replica axis are padded internally."""
+    mesh = make_search_mesh(n_shards=4, n_replicas=2)
+    _, segs = _build_shards(4)
+    plane = DistributedSearchPlane.from_segments(mesh, segs, "body")
+    vals, hits = plane.search([["quick", "fox"]], k=3)   # B=1, replicas=2
+    assert len(hits) == 1 and len(hits[0]) == 3
+    vals3, hits3 = plane.search([["quick", "fox"]] * 3, k=3)
+    np.testing.assert_allclose(vals3[0], vals[0])
