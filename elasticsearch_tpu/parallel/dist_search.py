@@ -50,6 +50,26 @@ NEG_INF = float("-inf")
 # ---------------------------------------------------------------------------
 
 
+def _global_topk_reduce(vals, idx, *, s_loc: int, kk: int, n_pad: int):
+    """Shared ICI reduce: globalize local doc ids, merge the device's own
+    shards, then all_gather + top_k over the shard axis. vals/idx are
+    [B_loc, S_loc, kk]; returns ([B_loc, kk], [B_loc, kk])."""
+    b_loc = vals.shape[0]
+    shard0 = lax.axis_index(AXIS_SHARD) * s_loc
+    sid = shard0 + jnp.arange(s_loc, dtype=jnp.int32)
+    gidx = idx + sid[None, :, None] * n_pad
+    vals = vals.reshape(b_loc, s_loc * kk)
+    gidx = gidx.reshape(b_loc, s_loc * kk)
+    if s_loc > 1:
+        vals, sel = lax.top_k(vals, kk)
+        gidx = jnp.take_along_axis(gidx, sel, axis=1)
+    av_all = lax.all_gather(vals, AXIS_SHARD, axis=1, tiled=True)
+    ai_all = lax.all_gather(gidx, AXIS_SHARD, axis=1, tiled=True)
+    gvals, gsel = lax.top_k(av_all, kk)
+    gdocs = jnp.take_along_axis(ai_all, gsel, axis=1)
+    return gvals, gdocs
+
+
 def build_bm25_topk_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
                          n_shards: int, min_should_match: int = 1):
     """Jitted distributed step: batched BM25 scoring + global top-k.
@@ -74,7 +94,8 @@ def build_bm25_topk_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
     kk = min(k, n_pad)
 
     def body(pd, pi, st, ln, idfw):
-        b_loc = st.shape[0]
+        assert st.shape[-1] == Q, (
+            f"starts last dim {st.shape[-1]} != step Q={Q}")
 
         def per_shard(pd_s, pi_s, st_s, ln_s):
             def per_query(st_q, ln_q, iw_q):
@@ -88,21 +109,8 @@ def build_bm25_topk_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
 
         vals, idx = jax.vmap(per_shard, in_axes=(0, 0, 1, 1),
                              out_axes=1)(pd, pi, st, ln)
-        # vals/idx: [B_loc, S_loc, kk] → globalize doc ids, merge locally
-        shard0 = lax.axis_index(AXIS_SHARD) * s_loc
-        sid = shard0 + jnp.arange(s_loc, dtype=jnp.int32)
-        gidx = idx + sid[None, :, None] * n_pad
-        vals = vals.reshape(b_loc, s_loc * kk)
-        gidx = gidx.reshape(b_loc, s_loc * kk)
-        if s_loc > 1:
-            vals, sel = lax.top_k(vals, kk)
-            gidx = jnp.take_along_axis(gidx, sel, axis=1)
-        # ICI reduce: gather candidates from every shard device, final top-k
-        av_all = lax.all_gather(vals, AXIS_SHARD, axis=1, tiled=True)
-        ai_all = lax.all_gather(gidx, AXIS_SHARD, axis=1, tiled=True)
-        gvals, gsel = lax.top_k(av_all, kk)
-        gdocs = jnp.take_along_axis(ai_all, gsel, axis=1)
-        return gvals, gdocs
+        # vals/idx: [B_loc, S_loc, kk]
+        return _global_topk_reduce(vals, idx, s_loc=s_loc, kk=kk, n_pad=n_pad)
 
     shard_corpus = P(AXIS_SHARD, None)
     step = shard_map(
@@ -137,8 +145,6 @@ def build_knn_step(mesh: Mesh, *, n_pad: int, dim: int, k: int,
         raise ValueError(f"unknown similarity [{similarity}]")
 
     def body(vecs, exists, q):
-        b_loc = q.shape[0]
-
         def per_shard(vecs_s, exists_s):
             if similarity == "l2_norm":
                 # -||q - v||² expanded to ride the MXU: 2q·v - ||v||² - ||q||²
@@ -163,19 +169,7 @@ def build_knn_step(mesh: Mesh, *, n_pad: int, dim: int, k: int,
             return vals, idx.astype(jnp.int32)
 
         vals, idx = jax.vmap(per_shard, out_axes=1)(vecs, exists)
-        shard0 = lax.axis_index(AXIS_SHARD) * s_loc
-        sid = shard0 + jnp.arange(s_loc, dtype=jnp.int32)
-        gidx = idx + sid[None, :, None] * n_pad
-        vals = vals.reshape(b_loc, s_loc * kk)
-        gidx = gidx.reshape(b_loc, s_loc * kk)
-        if s_loc > 1:
-            vals, sel = lax.top_k(vals, kk)
-            gidx = jnp.take_along_axis(gidx, sel, axis=1)
-        av_all = lax.all_gather(vals, AXIS_SHARD, axis=1, tiled=True)
-        ai_all = lax.all_gather(gidx, AXIS_SHARD, axis=1, tiled=True)
-        gvals, gsel = lax.top_k(av_all, kk)
-        gdocs = jnp.take_along_axis(ai_all, gsel, axis=1)
-        return gvals, gdocs
+        return _global_topk_reduce(vals, idx, s_loc=s_loc, kk=kk, n_pad=n_pad)
 
     step = shard_map(
         body, mesh=mesh,

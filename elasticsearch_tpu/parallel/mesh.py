@@ -34,8 +34,9 @@ def make_search_mesh(n_shards: Optional[int] = None, n_replicas: int = 1,
     """Build the (replica, shard) mesh over ``devices``.
 
     Defaults: all local devices, one replica group. ``n_shards`` defaults to
-    ``len(devices) // n_replicas``. Requires
-    ``n_replicas * n_shards == len(devices)``.
+    ``len(devices) // n_replicas``. When both axes are given explicitly the
+    first ``n_replicas * n_shards`` devices are used and any excess devices
+    are left idle; raises if fewer are available.
     """
     devices = list(devices if devices is not None else jax.devices())
     if n_shards is None:

@@ -23,7 +23,18 @@ from ..index.mapping import MapperService
 from ..index.segment import Segment
 from ..ops.topk import get_topk_kernel
 from ..utils.shapes import round_up_pow2
+from .aggregations import (AggregationContext, BucketAggregator, TopHitsAgg,
+                           parse_aggs, run_aggregations)
 from .query_dsl import ShardContext, parse_query, MatchAllQuery
+
+
+def _tree_needs_scores(aggs: dict) -> bool:
+    for a in aggs.values():
+        if isinstance(a, TopHitsAgg):
+            return True
+        if isinstance(a, BucketAggregator) and _tree_needs_scores(a.subs):
+            return True
+    return False
 
 
 @dataclass
@@ -65,6 +76,8 @@ class ShardSearcher:
         track_total_hits = body.get("track_total_hits", track_total_hits)
         query = (parse_query(body["query"]) if body.get("query")
                  else MatchAllQuery())
+        aggs_spec = body.get("aggs") or body.get("aggregations")
+        aggs = parse_aggs(aggs_spec) if aggs_spec else None
 
         k = size + from_
         # Dispatch all per-segment device work first, pull results after —
@@ -72,6 +85,7 @@ class ShardSearcher:
         # (the reference overlaps segments via per-leaf search threads,
         # ContextIndexSearcher.java:177).
         pending = []  # (seg_idx, count_dev, vals_dev|None, idx_dev|None)
+        agg_pending = []  # (seg, mask_dev, scores_dev)
         for seg_idx, seg in enumerate(self.segments):
             scores, mask = query.execute(self.ctx, seg)
             mask = mask & seg.live_dev
@@ -84,6 +98,8 @@ class ShardSearcher:
                 topk = get_topk_kernel(seg.n_pad, kk)
                 vals_dev, idx_dev = topk(scores, mask)
             pending.append((seg_idx, count_dev, vals_dev, idx_dev))
+            if aggs is not None:
+                agg_pending.append((seg, mask, scores))
 
         total = 0
         candidates: List[Tuple[float, int, int]] = []  # (score, seg_idx, doc)
@@ -119,8 +135,21 @@ class ShardSearcher:
                 doc_id=seg.doc_uids[d], score=score, seg_idx=seg_idx,
                 local_doc=d, source=seg.sources[d],
                 seq_no=int(seg.seq_nos[d])))
+
+        agg_results = None
+        if aggs is not None:
+            # score arrays only leave the device when a top_hits agg needs them
+            seg_scores = ({seg.seg_id: np.asarray(sc)
+                           for seg, _, sc in agg_pending}
+                          if _tree_needs_scores(aggs) else {})
+            agg_ctx = AggregationContext(self.mapper, shard_ctx=self.ctx,
+                                         seg_scores=seg_scores)
+            seg_masks = [(seg, np.asarray(m)) for seg, m, _ in agg_pending]
+            agg_results = run_aggregations(aggs, agg_ctx, seg_masks)
+
         return ShardSearchResult(total=total, total_relation=total_relation,
-                                 hits=hits, max_score=max_score)
+                                 hits=hits, max_score=max_score,
+                                 aggregations=agg_results)
 
     def count(self, body: Optional[dict] = None) -> int:
         body = body or {}

@@ -1,0 +1,129 @@
+"""Restricted arithmetic expression evaluation.
+
+The reference embeds full scripting languages (Painless —
+``modules/lang-painless``, 41k LoC compiling to JVM bytecode; and
+``lang-expression`` for numeric-only scripts). The TPU-native equivalent
+keeps scripts *compilable*: a small arithmetic grammar parsed with Python's
+``ast`` in eval mode and walked against a whitelist — no attribute access,
+no calls except a math whitelist, no subscripts beyond variables — so the
+same expression tree can later be traced into an XLA program for on-device
+score scripts.
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+from typing import Dict
+
+from ..common.errors import ElasticsearchError
+
+
+class ScriptException(ElasticsearchError):
+    status = 400
+    error_type = "script_exception"
+
+
+_ALLOWED_FUNCS = {
+    "abs": abs, "min": min, "max": max, "round": round,
+    "floor": math.floor, "ceil": math.ceil, "sqrt": math.sqrt,
+    "log": math.log, "log10": math.log10, "exp": math.exp,
+    "pow": math.pow, "sin": math.sin, "cos": math.cos, "tan": math.tan,
+}
+
+_ALLOWED_NODES = (
+    ast.Expression, ast.BinOp, ast.UnaryOp, ast.Constant, ast.Name,
+    ast.Call, ast.Load, ast.Add, ast.Sub, ast.Mult, ast.Div, ast.Mod,
+    ast.Pow, ast.FloorDiv, ast.USub, ast.UAdd, ast.Compare, ast.Lt,
+    ast.LtE, ast.Gt, ast.GtE, ast.Eq, ast.NotEq, ast.IfExp, ast.BoolOp,
+    ast.And, ast.Or, ast.Not,
+)
+
+
+def compile_expression(source: str):
+    """Parse + validate; returns the ast, raising ScriptException on any
+    disallowed construct."""
+    # Painless-style param refs: params.x -> variable x
+    cleaned = source.replace("params.", "")
+    try:
+        tree = ast.parse(cleaned, mode="eval")
+    except SyntaxError as e:
+        raise ScriptException(f"compile error in script [{source}]: {e}")
+    for node in ast.walk(tree):
+        if not isinstance(node, _ALLOWED_NODES):
+            raise ScriptException(
+                f"disallowed construct [{type(node).__name__}] in script "
+                f"[{source}]")
+        if isinstance(node, ast.Call):
+            if not isinstance(node.func, ast.Name) or \
+                    node.func.id not in _ALLOWED_FUNCS:
+                raise ScriptException(
+                    f"disallowed function call in script [{source}]")
+    return tree
+
+
+def evaluate_expression(source: str, params: Dict[str, float]) -> float:
+    tree = compile_expression(source)
+
+    def ev(node):
+        if isinstance(node, ast.Expression):
+            return ev(node.body)
+        if isinstance(node, ast.Constant):
+            if not isinstance(node.value, (int, float, bool)):
+                raise ScriptException(f"non-numeric constant [{node.value}]")
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id in params:
+                return params[node.id]
+            raise ScriptException(f"unknown variable [{node.id}]")
+        if isinstance(node, ast.BinOp):
+            a, b = ev(node.left), ev(node.right)
+            op = type(node.op)
+            try:
+                if op is ast.Add:
+                    return a + b
+                if op is ast.Sub:
+                    return a - b
+                if op is ast.Mult:
+                    return a * b
+                if op is ast.Div:
+                    return a / b
+                if op is ast.Mod:
+                    return a % b
+                if op is ast.Pow:
+                    return a ** b
+                if op is ast.FloorDiv:
+                    return a // b
+            except ZeroDivisionError:
+                raise ScriptException("division by zero in script")
+        if isinstance(node, ast.UnaryOp):
+            v = ev(node.operand)
+            if isinstance(node.op, ast.USub):
+                return -v
+            if isinstance(node.op, ast.UAdd):
+                return +v
+            if isinstance(node.op, ast.Not):
+                return not v
+        if isinstance(node, ast.Compare):
+            left = ev(node.left)
+            for op, comp in zip(node.ops, node.comparators):
+                right = ev(comp)
+                ok = {ast.Lt: left < right, ast.LtE: left <= right,
+                      ast.Gt: left > right, ast.GtE: left >= right,
+                      ast.Eq: left == right, ast.NotEq: left != right}[type(op)]
+                if not ok:
+                    return False
+                left = right
+            return True
+        if isinstance(node, ast.BoolOp):
+            vals = [ev(v) for v in node.values]
+            return all(vals) if isinstance(node.op, ast.And) else any(vals)
+        if isinstance(node, ast.IfExp):
+            return ev(node.body) if ev(node.test) else ev(node.orelse)
+        if isinstance(node, ast.Call):
+            fn = _ALLOWED_FUNCS[node.func.id]
+            return fn(*[ev(a) for a in node.args])
+        raise ScriptException(
+            f"unsupported node [{type(node).__name__}]")  # pragma: no cover
+
+    return ev(tree)

@@ -1,0 +1,275 @@
+"""Aggregation framework tests: metric/bucket/pipeline correctness against
+hand-computed values (mirrors the reference's ``AggregatorTestCase`` /
+``InternalAggregationTestCase`` reduce-correctness strategy)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import ParsingError
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+MAPPING = {"properties": {
+    "body": {"type": "text"},
+    "tag": {"type": "keyword"},
+    "price": {"type": "double"},
+    "qty": {"type": "integer"},
+    "day": {"type": "date"},
+}}
+
+ROWS = [
+    # id, body, tag, price, qty, day
+    ("1", "alpha beta", "a", 10.0, 1, "2024-01-03"),
+    ("2", "alpha", "a", 20.0, 2, "2024-01-15"),
+    ("3", "beta gamma", "b", 30.0, 3, "2024-02-01"),
+    ("4", "gamma", "b", 40.0, 4, "2024-02-20"),
+    ("5", "alpha gamma", "c", 50.0, 5, "2024-03-05"),
+    ("6", "delta", "a", 60.0, 6, "2024-03-30"),
+]
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    mapper = MapperService(MAPPING)
+    # two segments to exercise cross-segment reduce
+    segs = []
+    for half in (ROWS[:3], ROWS[3:]):
+        b = SegmentBuilder(f"_s{len(segs)}")
+        for i, (id_, body, tag, price, qty, day) in enumerate(half):
+            b.add(mapper.parse_document(id_, {
+                "body": body, "tag": tag, "price": price, "qty": qty,
+                "day": day}), seq_no=int(id_))
+        segs.append(b.build())
+    return ShardSearcher(segs, mapper)
+
+
+def agg(searcher, aggs, query=None, size=0):
+    body = {"aggs": aggs, "size": size}
+    if query:
+        body["query"] = query
+    return searcher.search(body).aggregations
+
+
+def test_metric_aggs(searcher):
+    out = agg(searcher, {
+        "p_avg": {"avg": {"field": "price"}},
+        "p_sum": {"sum": {"field": "price"}},
+        "p_min": {"min": {"field": "price"}},
+        "p_max": {"max": {"field": "price"}},
+        "p_count": {"value_count": {"field": "price"}},
+        "p_stats": {"stats": {"field": "price"}},
+    })
+    assert out["p_avg"]["value"] == 35.0
+    assert out["p_sum"]["value"] == 210.0
+    assert out["p_min"]["value"] == 10.0
+    assert out["p_max"]["value"] == 60.0
+    assert out["p_count"]["value"] == 6
+    assert out["p_stats"] == {"count": 6, "sum": 210.0, "min": 10.0,
+                              "max": 60.0, "avg": 35.0}
+
+
+def test_metric_with_query(searcher):
+    out = agg(searcher, {"p_sum": {"sum": {"field": "price"}}},
+              query={"match": {"body": "alpha"}})
+    assert out["p_sum"]["value"] == 10.0 + 20.0 + 50.0
+
+
+def test_extended_stats(searcher):
+    out = agg(searcher, {"es": {"extended_stats": {"field": "qty"}}})
+    v = np.asarray([1, 2, 3, 4, 5, 6], float)
+    assert out["es"]["count"] == 6
+    assert out["es"]["sum_of_squares"] == float((v * v).sum())
+    assert abs(out["es"]["variance"] - v.var()) < 1e-9
+    assert abs(out["es"]["std_deviation"] - v.std()) < 1e-9
+
+
+def test_cardinality(searcher):
+    out = agg(searcher, {
+        "tags": {"cardinality": {"field": "tag"}},
+        "prices": {"cardinality": {"field": "price"}},
+    })
+    assert out["tags"]["value"] == 3
+    assert out["prices"]["value"] == 6
+
+
+def test_percentiles(searcher):
+    out = agg(searcher, {"pct": {"percentiles": {
+        "field": "price", "percents": [50.0, 95.0]}}})
+    assert out["pct"]["values"]["50.0"] == 35.0
+    out = agg(searcher, {"pr": {"percentile_ranks": {
+        "field": "price", "values": [30.0]}}})
+    assert out["pr"]["values"]["30.0"] == pytest.approx(50.0)
+
+
+def test_weighted_avg(searcher):
+    out = agg(searcher, {"w": {"weighted_avg": {
+        "value": {"field": "price"}, "weight": {"field": "qty"}}}})
+    v = np.asarray([10, 20, 30, 40, 50, 60], float)
+    w = np.asarray([1, 2, 3, 4, 5, 6], float)
+    assert out["w"]["value"] == pytest.approx(float((v * w).sum() / w.sum()))
+
+
+def test_terms_agg(searcher):
+    out = agg(searcher, {"tags": {"terms": {"field": "tag"}}})
+    buckets = out["tags"]["buckets"]
+    assert buckets[0] == {"key": "a", "doc_count": 3}
+    assert buckets[1] == {"key": "b", "doc_count": 2}
+    assert buckets[2] == {"key": "c", "doc_count": 1}
+    assert out["tags"]["sum_other_doc_count"] == 0
+
+
+def test_terms_agg_with_subagg(searcher):
+    out = agg(searcher, {"tags": {
+        "terms": {"field": "tag"},
+        "aggs": {"p": {"avg": {"field": "price"}}}}})
+    by_key = {b["key"]: b for b in out["tags"]["buckets"]}
+    assert by_key["a"]["p"]["value"] == pytest.approx((10 + 20 + 60) / 3)
+    assert by_key["b"]["p"]["value"] == pytest.approx(35.0)
+    assert by_key["c"]["p"]["value"] == pytest.approx(50.0)
+
+
+def test_terms_agg_order_by_metric(searcher):
+    out = agg(searcher, {"tags": {
+        "terms": {"field": "tag", "order": {"p": "asc"}},
+        "aggs": {"p": {"avg": {"field": "price"}}}}})
+    assert [b["key"] for b in out["tags"]["buckets"]] == ["a", "b", "c"]
+
+
+def test_terms_numeric(searcher):
+    out = agg(searcher, {"q": {"terms": {"field": "qty", "size": 3}}})
+    assert [b["key"] for b in out["q"]["buckets"]][:1] == [1]
+    assert all(b["doc_count"] == 1 for b in out["q"]["buckets"])
+    assert out["q"]["sum_other_doc_count"] == 3
+
+
+def test_histogram(searcher):
+    out = agg(searcher, {"h": {"histogram": {
+        "field": "price", "interval": 25.0}}})
+    buckets = {b["key"]: b["doc_count"] for b in out["h"]["buckets"]}
+    assert buckets == {0.0: 2, 25.0: 2, 50.0: 2}
+
+
+def test_date_histogram_month(searcher):
+    out = agg(searcher, {"m": {"date_histogram": {
+        "field": "day", "calendar_interval": "month"}}})
+    buckets = out["m"]["buckets"]
+    assert [b["key_as_string"][:7] for b in buckets] == \
+        ["2024-01", "2024-02", "2024-03"]
+    assert [b["doc_count"] for b in buckets] == [2, 2, 2]
+
+
+def test_range_agg(searcher):
+    out = agg(searcher, {"r": {"range": {
+        "field": "price",
+        "ranges": [{"to": 25}, {"from": 25, "to": 45}, {"from": 45}]}}})
+    counts = [b["doc_count"] for b in out["r"]["buckets"]]
+    assert counts == [2, 2, 2]
+
+
+def test_filter_and_filters_agg(searcher):
+    out = agg(searcher, {
+        "alpha_docs": {"filter": {"match": {"body": "alpha"}},
+                       "aggs": {"p": {"sum": {"field": "price"}}}},
+        "groups": {"filters": {"filters": {
+            "ab": {"terms": {"tag": ["a", "b"]}},
+            "c": {"term": {"tag": "c"}}}}},
+    })
+    assert out["alpha_docs"]["doc_count"] == 3
+    assert out["alpha_docs"]["p"]["value"] == 80.0
+    assert out["groups"]["buckets"]["ab"]["doc_count"] == 5
+    assert out["groups"]["buckets"]["c"]["doc_count"] == 1
+
+
+def test_missing_and_global_agg(searcher):
+    out = agg(searcher,
+              {"no_tag": {"missing": {"field": "tag"}},
+               "all": {"global": {},
+                       "aggs": {"n": {"value_count": {"field": "qty"}}}}},
+              query={"term": {"tag": "a"}})
+    assert out["no_tag"]["doc_count"] == 0
+    assert out["all"]["doc_count"] == 6       # ignores the query
+    assert out["all"]["n"]["value"] == 6
+
+
+def test_top_hits(searcher):
+    out = agg(searcher, {"tags": {
+        "terms": {"field": "tag", "size": 1},
+        "aggs": {"top": {"top_hits": {"size": 2}}}}},
+        query={"match": {"body": "alpha"}})
+    b = out["tags"]["buckets"][0]
+    assert b["key"] == "a"
+    hits = b["top"]["hits"]["hits"]
+    assert len(hits) == 2
+    assert {h["_id"] for h in hits} <= {"1", "2"}
+
+
+def test_pipeline_aggs(searcher):
+    out = agg(searcher, {
+        "months": {"date_histogram": {"field": "day",
+                                      "calendar_interval": "month"},
+                   "aggs": {"p": {"sum": {"field": "price"}}}},
+        "best": {"max_bucket": {"buckets_path": "months>p"}},
+        "avg_m": {"avg_bucket": {"buckets_path": "months>p"}},
+        "total": {"sum_bucket": {"buckets_path": "months>p"}},
+    })
+    sums = [b["p"]["value"] for b in out["months"]["buckets"]]
+    assert sums == [30.0, 70.0, 110.0]
+    assert out["best"]["value"] == 110.0
+    assert out["avg_m"]["value"] == pytest.approx(70.0)
+    assert out["total"]["value"] == 210.0
+
+
+def test_cumulative_sum_and_derivative(searcher):
+    out = agg(searcher, {
+        "months": {"date_histogram": {"field": "day",
+                                      "calendar_interval": "month"},
+                   "aggs": {"p": {"sum": {"field": "price"}}}},
+        "cs": {"cumulative_sum": {"buckets_path": "months>p"}},
+        "d": {"derivative": {"buckets_path": "months>p"}},
+    })
+    buckets = out["months"]["buckets"]
+    assert [b["cumulative_sum"]["value"] for b in buckets] == \
+        [30.0, 100.0, 210.0]
+    assert "derivative" not in buckets[0]
+    assert buckets[1]["derivative"]["value"] == 40.0
+    assert buckets[2]["derivative"]["value"] == 40.0
+
+
+def test_bucket_script(searcher):
+    out = agg(searcher, {
+        "months": {"date_histogram": {"field": "day",
+                                      "calendar_interval": "month"},
+                   "aggs": {"p": {"sum": {"field": "price"}},
+                            "q": {"sum": {"field": "qty"}}}},
+        "ratio": {"bucket_script": {
+            "buckets_path": {"p": "months>p", "q": "months>q"},
+            "script": "params.p / params.q"}},
+    })
+    buckets = out["months"]["buckets"]
+    assert buckets[0]["ratio"]["value"] == pytest.approx(30.0 / 3.0)
+    assert buckets[2]["ratio"]["value"] == pytest.approx(110.0 / 11.0)
+
+
+def test_agg_parse_errors(searcher):
+    with pytest.raises(ParsingError):
+        agg(searcher, {"bad": {"unknown_kind": {}}})
+    with pytest.raises(ParsingError):
+        agg(searcher, {"bad": {"avg": {}}})
+    with pytest.raises(ParsingError):
+        agg(searcher, {"bad": {"avg": {"field": "price"},
+                               "aggs": {"x": {"sum": {"field": "qty"}}}}})
+
+
+def test_expression_safety():
+    from elasticsearch_tpu.utils.expressions import (
+        ScriptException, evaluate_expression)
+    assert evaluate_expression("a + b * 2", {"a": 1, "b": 2}) == 5
+    assert evaluate_expression("sqrt(x)", {"x": 16.0}) == 4.0
+    assert evaluate_expression("a if a > b else b", {"a": 1, "b": 2}) == 2
+    for bad in ("__import__('os')", "().__class__", "open('/etc/passwd')",
+                "[1][0]", "x.y"):
+        with pytest.raises(ScriptException):
+            evaluate_expression(bad, {"x": 1})
