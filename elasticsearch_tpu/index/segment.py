@@ -152,6 +152,7 @@ class Segment:
         self.vector_fields = vector_fields
         self.live = np.ones(n_docs, dtype=bool)     # host liveness (deletes)
         self._live_dev: Optional[jnp.ndarray] = None
+        self._fv_columns: Dict[str, np.ndarray] = {}
         self._uid_to_doc: Dict[str, int] = {u: i for i, u in enumerate(doc_uids)}
         self._upload()
 
@@ -206,6 +207,22 @@ class Segment:
         if d is not None and self.live[d]:
             return d
         return None
+
+    # -- doc-values columns --------------------------------------------------
+
+    def numeric_first_value_column(self, field: str) -> np.ndarray:
+        """Dense float64[n_pad] column of the field's first value per doc
+        (NaN where absent); cached. Sort keys, script doc access and
+        function_score all read this."""
+        col = self._fv_columns.get(field)
+        if col is None:
+            col = np.full(self.n_pad, np.nan)
+            f = self.numeric_fields.get(field)
+            if f is not None:
+                # reverse fill keeps the first (lowest-index) pair per doc
+                col[f.docs_host[::-1]] = f.vals_host[::-1]
+            self._fv_columns[field] = col
+        return col
 
     # -- stats for idf -------------------------------------------------------
 

@@ -1,0 +1,218 @@
+"""Fetch phase: turn matched (segment, doc) pairs into response hits.
+
+Re-design of the reference fetch phase (``search/fetch/FetchPhase.java:73``
++ 15 sub-phases under ``search/fetch/subphase/``): _source loading and
+filtering, docvalue_fields, stored fields and highlighting. Fetch is pure
+host work over the tiny top-k result set — nothing here touches the device
+(the reference similarly runs fetch on the much smaller hit list).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..common.errors import IllegalArgumentError, ParsingError
+from ..index.mapping import (DateFieldType, MapperService, format_date_millis)
+from ..index.segment import Segment
+
+
+# ---------------------------------------------------------------------------
+# _source filtering (reference: search/fetch/subphase/FetchSourcePhase.java)
+# ---------------------------------------------------------------------------
+
+
+def _match_any(path: str, patterns: Sequence[str]) -> bool:
+    return any(fnmatch.fnmatchcase(path, p) or path.startswith(p + ".")
+               or fnmatch.fnmatchcase(path.split(".")[0], p)
+               for p in patterns)
+
+
+def _filter_tree(obj: Any, prefix: str, includes, excludes):
+    if not isinstance(obj, dict):
+        return obj
+    out = {}
+    for k, v in obj.items():
+        path = f"{prefix}{k}"
+        if excludes and _match_any(path, excludes):
+            continue
+        if includes:
+            # keep if the path matches, or is an ancestor of a match
+            direct = _match_any(path, includes)
+            ancestor = any(p.startswith(path + ".") for p in includes)
+            if not direct and not ancestor:
+                continue
+            if not direct and ancestor and isinstance(v, dict):
+                v = _filter_tree(v, path + ".", includes, excludes)
+                if not v:
+                    continue
+                out[k] = v
+                continue
+        if isinstance(v, dict):
+            out[k] = _filter_tree(v, path + ".", None, excludes)
+        else:
+            out[k] = v
+    return out
+
+
+def filter_source(source: Optional[dict], spec) -> Optional[dict]:
+    """Apply the request's ``_source`` spec: True/False, "field", ["f1",
+    "f2*"], or {"includes": [...], "excludes": [...]}."""
+    if source is None or spec is True or spec is None:
+        return source
+    if spec is False:
+        return None
+    if isinstance(spec, str):
+        spec = [spec]
+    if isinstance(spec, list):
+        return _filter_tree(source, "", spec, None)
+    if isinstance(spec, dict):
+        inc = spec.get("includes") or spec.get("include")
+        exc = spec.get("excludes") or spec.get("exclude")
+        if isinstance(inc, str):
+            inc = [inc]
+        if isinstance(exc, str):
+            exc = [exc]
+        return _filter_tree(source, "", inc or None, exc or None)
+    raise ParsingError(f"invalid _source spec [{spec}]")
+
+
+# ---------------------------------------------------------------------------
+# docvalue_fields (reference: subphase/FetchDocValuesPhase.java)
+# ---------------------------------------------------------------------------
+
+
+def docvalue_fields(seg: Segment, mapper: MapperService, local_doc: int,
+                    specs: Sequence) -> Dict[str, List[Any]]:
+    out: Dict[str, List[Any]] = {}
+    for spec in specs:
+        if isinstance(spec, dict):
+            field = spec.get("field")
+            fmt = spec.get("format")
+        else:
+            field, fmt = spec, None
+        if field is None:
+            raise ParsingError("docvalue_fields entries require [field]")
+        ft = mapper.field_type(field)
+        vals: List[Any] = []
+        nf = seg.numeric_fields.get(field)
+        if nf is not None:
+            sel = nf.docs_host == local_doc
+            for v in nf.vals_host[sel]:
+                if isinstance(ft, DateFieldType) or fmt in (
+                        "date", "strict_date_optional_time"):
+                    vals.append(format_date_millis(float(v)))
+                elif float(v).is_integer() and ft is not None and \
+                        getattr(ft, "type_name", "") in (
+                            "long", "integer", "short", "byte"):
+                    vals.append(int(v))
+                else:
+                    vals.append(float(v))
+        kf = seg.keyword_fields.get(field)
+        if kf is not None:
+            sel = kf.dv_docs_host == local_doc
+            vals.extend(kf.ord_terms[o] for o in kf.dv_ords_host[sel])
+        if vals:
+            out[field] = vals
+    return out
+
+
+# ---------------------------------------------------------------------------
+# highlight (reference: subphase/highlight/ — unified highlighter)
+# ---------------------------------------------------------------------------
+
+
+def _best_fragments(text: str, spans: List, fragment_size: int,
+                    number_of_fragments: int,
+                    pre: str, post: str) -> List[str]:
+    """Split around matched spans into up-to-N fragments with tags."""
+    if not spans:
+        return []
+    spans.sort()
+    if number_of_fragments == 0:
+        # whole field value as one fragment
+        frags = [(0, len(text), spans)]
+    else:
+        frags = []
+        used: set = set()
+        for start, end in spans:
+            fs = max(0, start - fragment_size // 2)
+            fe = min(len(text), fs + fragment_size)
+            key = fs // max(fragment_size, 1)
+            if key in used:
+                continue
+            used.add(key)
+            inside = [(s, e) for s, e in spans if s >= fs and e <= fe]
+            frags.append((fs, fe, inside))
+            if len(frags) >= number_of_fragments:
+                break
+    out = []
+    for fs, fe, inside in frags:
+        parts = []
+        cur = fs
+        for s, e in inside:
+            parts.append(text[cur:s])
+            parts.append(pre + text[s:e] + post)
+            cur = e
+        parts.append(text[cur:fe])
+        out.append("".join(parts))
+    return out
+
+
+def highlight(mapper: MapperService, source: Optional[dict],
+              highlight_spec: dict,
+              query_terms: Dict[str, set]) -> Dict[str, List[str]]:
+    """Highlight query terms in the hit's source values. The analyzer's
+    token offsets locate match spans; tags wrap them."""
+    if not source:
+        return {}
+    fields_spec = highlight_spec.get("fields", {})
+    if isinstance(fields_spec, list):  # ES also allows a list of singletons
+        merged = {}
+        for f in fields_spec:
+            merged.update(f)
+        fields_spec = merged
+    pre = (highlight_spec.get("pre_tags") or ["<em>"])[0]
+    post = (highlight_spec.get("post_tags") or ["</em>"])[0]
+    out: Dict[str, List[str]] = {}
+    for field, fspec in fields_spec.items():
+        fspec = fspec or {}
+        frag_size = int(fspec.get("fragment_size",
+                                  highlight_spec.get("fragment_size", 100)))
+        n_frags = int(fspec.get("number_of_fragments",
+                                highlight_spec.get("number_of_fragments", 5)))
+        ft = mapper.field_type(field)
+        if ft is None:
+            continue
+        terms = query_terms.get(field, set())
+        if not terms:
+            continue
+        # walk the source path
+        value = source
+        for part in field.split("."):
+            if not isinstance(value, dict) or part not in value:
+                value = None
+                break
+            value = value[part]
+        if value is None:
+            continue
+        values = value if isinstance(value, list) else [value]
+        analyzer = getattr(ft, "search_analyzer", None) or \
+            getattr(ft, "analyzer", None)
+        frags: List[str] = []
+        for v in values:
+            text = str(v)
+            spans = []
+            if analyzer is not None:
+                for tok in analyzer.analyze(text):
+                    if tok.term in terms:
+                        spans.append((tok.start_offset, tok.end_offset))
+            else:  # keyword: whole-value match
+                if text in terms:
+                    spans.append((0, len(text)))
+            frags.extend(_best_fragments(text, spans, frag_size, n_frags,
+                                         pre, post))
+        if frags:
+            out[field] = frags[: n_frags if n_frags > 0 else None]
+    return out

@@ -215,6 +215,12 @@ class Query:
     def execute(self, ctx: ShardContext, seg: Segment):
         raise NotImplementedError
 
+    def collect_highlight_terms(self, ctx: ShardContext,
+                                out: Dict[str, set]) -> None:
+        """Accumulate field -> analyzed terms for the highlighter
+        (reference: highlight phase extracting terms from the query —
+        ``subphase/highlight/``). Default: nothing."""
+
     def __repr__(self):
         return f"{type(self).__name__}({self.__dict__})"
 
@@ -280,6 +286,9 @@ class MatchQuery(Query):
         mask = matched >= n_required
         return scores * np.float32(self.boost), mask
 
+    def collect_highlight_terms(self, ctx, out):
+        out.setdefault(self.field, set()).update(self._analyze(ctx))
+
 
 class MatchPhraseQuery(Query):
     """Phrase match (reference: ``MatchPhraseQueryBuilder.java``). Candidate
@@ -328,6 +337,12 @@ class MatchPhraseQuery(Query):
                     mask_host[d] = True
         return (jnp.asarray(scores_host * np.float32(self.boost)),
                 jnp.asarray(mask_host))
+
+    def collect_highlight_terms(self, ctx, out):
+        ft = ctx.field_type(self.field)
+        if isinstance(ft, TextFieldType):
+            out.setdefault(self.field, set()).update(
+                ft.search_analyzer.terms(str(self.text)))
 
 
 def _phrase_freq(f, terms: List[str], doc: int, slop: int) -> float:
@@ -382,6 +397,9 @@ class TermQuery(Query):
             val = ft.parse_value(self.value)
             return _numeric_range_result(seg, self.field, val, val, self.boost)
         return _const_result(seg, 0.0, False)
+
+    def collect_highlight_terms(self, ctx, out):
+        out.setdefault(self.field, set()).add(str(self.value))
 
 
 class TermsQuery(Query):
@@ -756,6 +774,10 @@ class BoolQuery(Query):
         scores = jnp.where(mask, scores, 0.0) * np.float32(self.boost)
         return scores, mask
 
+    def collect_highlight_terms(self, ctx, out):
+        for q in self.must + self.filter + self.should:
+            q.collect_highlight_terms(ctx, out)
+
 
 class ConstantScoreQuery(Query):
     def __init__(self, inner: Query, boost: float = 1.0):
@@ -765,6 +787,9 @@ class ConstantScoreQuery(Query):
     def execute(self, ctx, seg):
         _, mask = self.inner.execute(ctx, seg)
         return jnp.where(mask, np.float32(self.boost), 0.0), mask
+
+    def collect_highlight_terms(self, ctx, out):
+        self.inner.collect_highlight_terms(ctx, out)
 
 
 class DisMaxQuery(Query):
@@ -788,6 +813,10 @@ class DisMaxQuery(Query):
         scores = best + self.tie_breaker * (total - best)
         return scores * np.float32(self.boost), mask
 
+    def collect_highlight_terms(self, ctx, out):
+        for q in self.queries:
+            q.collect_highlight_terms(ctx, out)
+
 
 class BoostingQuery(Query):
     def __init__(self, positive: Query, negative: Query,
@@ -802,6 +831,9 @@ class BoostingQuery(Query):
         _, nm = self.negative.execute(ctx, seg)
         scores = jnp.where(nm, s * np.float32(self.negative_boost), s)
         return scores * np.float32(self.boost), m
+
+    def collect_highlight_terms(self, ctx, out):
+        self.positive.collect_highlight_terms(ctx, out)
 
 
 class NestedQuery(Query):
@@ -818,6 +850,218 @@ class NestedQuery(Query):
     def execute(self, ctx, seg):
         s, m = self.inner.execute(ctx, seg)
         return s * np.float32(self.boost), m
+
+    def collect_highlight_terms(self, ctx, out):
+        self.inner.collect_highlight_terms(ctx, out)
+
+
+_VECTOR_FN_RE = re.compile(
+    r"(cosineSimilarity|dotProduct|l1norm|l2norm)\s*\(\s*"
+    r"params\.(\w+)\s*,\s*['\"]([\w.]+)['\"]\s*\)")
+
+
+def _vector_similarity(kind: str, qv: np.ndarray, seg: Segment,
+                       field: str):
+    """Whole-segment vector similarity — one einsum/VPU pass (replaces the
+    reference's per-doc script loop,
+    ``x-pack/plugin/vectors/.../query/ScoreScriptUtils.java:112-136``)."""
+    f = seg.vector_fields.get(field)
+    if f is None:
+        return jnp.zeros(seg.n_pad, jnp.float32), jnp.zeros(seg.n_pad, bool)
+    mat = f.matrix_dev                                  # [n_pad, D]
+    q = jnp.asarray(qv, jnp.float32)
+    exists = np.zeros(seg.n_pad, bool)
+    exists[: f.exists.shape[0]] = f.exists
+    exists_dev = jnp.asarray(exists)
+    if kind == "cosineSimilarity":
+        qn = q / jnp.maximum(jnp.linalg.norm(q), 1e-12)
+        mn = mat / jnp.maximum(
+            jnp.linalg.norm(mat, axis=-1, keepdims=True), 1e-12)
+        sim = mn @ qn
+    elif kind == "dotProduct":
+        sim = mat @ q
+    elif kind == "l1norm":
+        sim = jnp.sum(jnp.abs(mat - q[None, :]), axis=-1)
+    else:  # l2norm
+        sim = jnp.linalg.norm(mat - q[None, :], axis=-1)
+    return jnp.where(exists_dev, sim, 0.0), exists_dev
+
+
+class ScriptScoreQuery(Query):
+    """Re-scores an inner query's matches with a sandboxed expression
+    (reference: ``index/query/functionscore/ScriptScoreQueryBuilder`` +
+    the vectors script utilities). Vector calls like
+    ``cosineSimilarity(params.qv, 'embedding')`` compile to whole-segment
+    einsums; ``doc['f'].value`` reads doc-values columns; the remaining
+    arithmetic traces to one fused XLA program per segment."""
+
+    def __init__(self, inner: Query, source: str, params: dict,
+                 min_score: Optional[float] = None, boost: float = 1.0):
+        self.inner = inner
+        self.params = params or {}
+        self.min_score = min_score
+        self.boost = boost
+        # rewrite vector calls + doc access into plain variables
+        self._vector_refs = []   # (var, kind, param_name, field)
+        src = source
+
+        def repl(m):
+            var = f"__vec{len(self._vector_refs)}"
+            self._vector_refs.append((var, m.group(1), m.group(2), m.group(3)))
+            return var
+
+        src = _VECTOR_FN_RE.sub(repl, src)
+        self._doc_refs = []      # (var, field)
+        doc_re = re.compile(r"doc\[['\"]([\w.]+)['\"]\]\.value")
+
+        def drepl(m):
+            var = f"__doc{len(self._doc_refs)}"
+            self._doc_refs.append((var, m.group(1)))
+            return var
+
+        self.source = doc_re.sub(drepl, src)
+
+    def _doc_column(self, seg: Segment, field: str):
+        """Dense [n_pad] f32 column of the field's first value per doc
+        (0 where absent, f32 for device math)."""
+        col = seg.numeric_first_value_column(field)
+        return jnp.asarray(np.nan_to_num(col, nan=0.0).astype(np.float32))
+
+    def execute(self, ctx, seg):
+        from ..utils.expressions import evaluate_expression_vec
+        inner_scores, mask = self.inner.execute(ctx, seg)
+        env: dict = {"_score": inner_scores}
+        for name, v in self.params.items():
+            if not isinstance(v, (list, np.ndarray)):
+                env[name] = float(v)
+        for var, kind, pname, field in self._vector_refs:
+            qv = np.asarray(self.params.get(pname), np.float32)
+            sim, _ = _vector_similarity(kind, qv, seg, field)
+            env[var] = sim
+        for var, field in self._doc_refs:
+            env[var] = self._doc_column(seg, field)
+        scores = evaluate_expression_vec(self.source, env)
+        scores = jnp.broadcast_to(jnp.asarray(scores, jnp.float32),
+                                  (seg.n_pad,)) * np.float32(self.boost)
+        if self.min_score is not None:
+            mask = mask & (scores >= np.float32(self.min_score))
+        return scores, mask
+
+    def collect_highlight_terms(self, ctx, out):
+        self.inner.collect_highlight_terms(ctx, out)
+
+
+class FunctionScoreQuery(Query):
+    """Subset of the reference's function_score
+    (``index/query/functionscore/FunctionScoreQueryBuilder``): script_score,
+    weight, and field_value_factor functions with multiply/sum/replace
+    score modes and boost modes."""
+
+    def __init__(self, inner: Query, functions: List[dict],
+                 score_mode: str = "multiply", boost_mode: str = "multiply",
+                 boost: float = 1.0):
+        self.inner = inner
+        self.functions = functions
+        self.score_mode = score_mode
+        self.boost_mode = boost_mode
+        self.boost = boost
+
+    def _fn_scores(self, ctx, seg, spec, base_scores):
+        if "script_score" in spec:
+            script = spec["script_score"].get("script", {})
+            src = script.get("source") if isinstance(script, dict) else script
+            q = ScriptScoreQuery(MatchAllQuery(), src,
+                                 (script.get("params", {})
+                                  if isinstance(script, dict) else {}))
+            s, _ = q.execute(ctx, seg)
+            return s
+        if "field_value_factor" in spec:
+            fv = spec["field_value_factor"]
+            col = jnp.asarray(np.nan_to_num(
+                seg.numeric_first_value_column(fv["field"]),
+                nan=0.0).astype(np.float32))
+            factor = np.float32(fv.get("factor", 1.0))
+            col = col * factor
+            modifier = fv.get("modifier", "none")
+            if modifier == "log1p":
+                col = jnp.log1p(jnp.maximum(col, 0.0))
+            elif modifier == "sqrt":
+                col = jnp.sqrt(jnp.maximum(col, 0.0))
+            elif modifier == "square":
+                col = col * col
+            elif modifier == "reciprocal":
+                col = 1.0 / jnp.maximum(col, 1e-9)
+            return col
+        if "weight" in spec:
+            return jnp.full(seg.n_pad, np.float32(spec["weight"]))
+        raise ParsingError("unsupported function_score function")
+
+    def execute(self, ctx, seg):
+        base, mask = self.inner.execute(ctx, seg)
+        parts = []   # (scores, applies_mask)
+        for spec in self.functions:
+            filt = spec.get("filter")
+            s = self._fn_scores(ctx, seg, spec, base)
+            if "weight" in spec and "script_score" not in spec and \
+                    "field_value_factor" not in spec:
+                pass  # pure weight function, s already the weight
+            elif "weight" in spec:
+                s = s * np.float32(spec["weight"])
+            if filt is not None:
+                _, fmask = parse_query(filt).execute(ctx, seg)
+            else:
+                fmask = jnp.ones(seg.n_pad, jnp.bool_)
+            parts.append((s, fmask))
+        if not parts:
+            fn_score = jnp.ones(seg.n_pad, jnp.float32)
+        else:
+            # a function whose filter doesn't match a doc is EXCLUDED for
+            # that doc (reference: FunctionScoreQuery per-doc function
+            # subset), not folded in with a 0/1 neutral fill
+            n_match = sum(fm.astype(jnp.int32) for _, fm in parts)
+            if self.score_mode == "sum":
+                fn_score = sum(jnp.where(fm, s, 0.0) for s, fm in parts)
+            elif self.score_mode == "avg":
+                tot = sum(jnp.where(fm, s, 0.0) for s, fm in parts)
+                fn_score = tot / jnp.maximum(n_match, 1)
+            elif self.score_mode == "max":
+                fn_score = parts[0][0]
+                fn_score = jnp.where(parts[0][1], fn_score, -jnp.inf)
+                for s, fm in parts[1:]:
+                    fn_score = jnp.maximum(fn_score, jnp.where(fm, s, -jnp.inf))
+            elif self.score_mode == "min":
+                fn_score = jnp.where(parts[0][1], parts[0][0], jnp.inf)
+                for s, fm in parts[1:]:
+                    fn_score = jnp.minimum(fn_score, jnp.where(fm, s, jnp.inf))
+            elif self.score_mode == "first":
+                fn_score = jnp.full(seg.n_pad, 1.0, jnp.float32)
+                assigned = jnp.zeros(seg.n_pad, jnp.bool_)
+                for s, fm in parts:
+                    take = fm & ~assigned
+                    fn_score = jnp.where(take, s, fn_score)
+                    assigned = assigned | fm
+            else:  # multiply
+                fn_score = jnp.ones(seg.n_pad, jnp.float32)
+                for s, fm in parts:
+                    fn_score = fn_score * jnp.where(fm, s, 1.0)
+            # docs matched by no function: neutral score 1
+            fn_score = jnp.where(n_match > 0, fn_score, 1.0)
+        if self.boost_mode == "replace":
+            out = fn_score
+        elif self.boost_mode == "sum":
+            out = base + fn_score
+        elif self.boost_mode == "avg":
+            out = (base + fn_score) / 2.0
+        elif self.boost_mode == "max":
+            out = jnp.maximum(base, fn_score)
+        elif self.boost_mode == "min":
+            out = jnp.minimum(base, fn_score)
+        else:  # multiply
+            out = base * fn_score
+        return out * np.float32(self.boost), mask
+
+    def collect_highlight_terms(self, ctx, out):
+        self.inner.collect_highlight_terms(ctx, out)
 
 
 # ---------------------------------------------------------------------------
@@ -995,8 +1239,35 @@ def _parse_match_none(body):
     return MatchNoneQuery()
 
 
+def _parse_script_score(body):
+    script = body.get("script", {})
+    src = script.get("source") if isinstance(script, dict) else script
+    if not src:
+        raise ParsingError("[script_score] requires a script")
+    return ScriptScoreQuery(
+        parse_query(body.get("query", {"match_all": {}})), src,
+        script.get("params", {}) if isinstance(script, dict) else {},
+        body.get("min_score"), float(body.get("boost", 1.0)))
+
+
+def _parse_function_score(body):
+    inner = parse_query(body.get("query", {"match_all": {}}))
+    functions = body.get("functions")
+    if functions is None:
+        functions = []
+        for k in ("script_score", "field_value_factor", "weight"):
+            if k in body:
+                functions.append({k: body[k]})
+    return FunctionScoreQuery(inner, functions,
+                              body.get("score_mode", "multiply"),
+                              body.get("boost_mode", "multiply"),
+                              float(body.get("boost", 1.0)))
+
+
 _PARSERS = {
     "match_all": _parse_match_all,
+    "script_score": _parse_script_score,
+    "function_score": _parse_function_score,
     "match_none": _parse_match_none,
     "match": _parse_match,
     "match_phrase": _parse_match_phrase,

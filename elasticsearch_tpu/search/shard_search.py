@@ -1,4 +1,4 @@
-"""Shard-level search execution: query phase + hit merge.
+"""Shard-level search execution: query phase + knn + sort + fetch.
 
 Re-design of the reference's shard search entry
 (``search/SearchService.java:378 executeQueryPhase`` →
@@ -7,8 +7,10 @@ Re-design of the reference's shard search entry
 (scores, mask) arrays by the query tree (``query_dsl.py``), top-k hits are
 selected on device per segment (``ops/topk.py``), and the tiny per-segment
 candidate lists are merged on the host (score desc, then segment/doc id asc —
-Lucene's tie-break order).
-"""
+Lucene's tie-break order). Field sorting builds normalized sort-key columns
+and lexsorts matched docs; ``knn`` runs the brute-force einsum per segment
+and merges with the query's candidates (hybrid score sum, or reciprocal
+rank fusion under ``rank.rrf``)."""
 
 from __future__ import annotations
 
@@ -18,14 +20,19 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..common.errors import IllegalArgumentError
-from ..index.mapping import MapperService
+from ..common.errors import IllegalArgumentError, ParsingError
+from ..index.mapping import (DateFieldType, DenseVectorFieldType,
+                             KeywordFieldType, MapperService, NumberFieldType)
 from ..index.segment import Segment
 from ..ops.topk import get_topk_kernel
 from ..utils.shapes import round_up_pow2
 from .aggregations import (AggregationContext, BucketAggregator, TopHitsAgg,
                            parse_aggs, run_aggregations)
-from .query_dsl import ShardContext, parse_query, MatchAllQuery
+from .fetch import docvalue_fields, filter_source, highlight
+from .query_dsl import (MatchAllQuery, ShardContext, _vector_similarity,
+                        parse_query)
+
+_MISSING_LAST = float("inf")
 
 
 def _tree_needs_scores(aggs: dict) -> bool:
@@ -40,12 +47,14 @@ def _tree_needs_scores(aggs: dict) -> bool:
 @dataclass
 class ShardHit:
     doc_id: str
-    score: float
+    score: Optional[float]
     seg_idx: int
     local_doc: int
     source: Optional[dict]
     sort_values: Optional[List[Any]] = None
     seq_no: Optional[int] = None
+    fields: Optional[Dict[str, List[Any]]] = None
+    highlight: Optional[Dict[str, List[str]]] = None
 
 
 @dataclass
@@ -58,6 +67,19 @@ class ShardSearchResult:
     profile: Optional[dict] = None
 
 
+def _knn_score_transform(similarity: str, sim):
+    """Raw similarity → ES _score (reference: DenseVectorFieldMapper docs /
+    KnnVectorQuery score translation)."""
+    if similarity in ("cosine", "cos"):
+        return (1.0 + sim) / 2.0
+    if similarity == "dot_product":
+        return (1.0 + sim) / 2.0
+    if similarity == "max_inner_product":
+        return jnp.where(sim < 0, 1.0 / (1.0 - sim), sim + 1.0)
+    # l2_norm: sim here is the distance
+    return 1.0 / (1.0 + sim * sim)
+
+
 class ShardSearcher:
     """Executes one search request against one shard's segment list."""
 
@@ -65,6 +87,126 @@ class ShardSearcher:
         self.segments = [s for s in segments if s.n_docs > 0]
         self.mapper = mapper
         self.ctx = ShardContext(self.segments, mapper)
+
+    # ------------------------------------------------------------------
+    # knn
+    # ------------------------------------------------------------------
+
+    def _knn_candidates(self, spec: dict) -> List[Tuple[float, int, int]]:
+        """Brute-force kNN for one knn clause: einsum per segment + top-k
+        (reference: the 8.x ``_knn_search``/``knn`` section; scoring per
+        ``x-pack/plugin/vectors`` brute force, but one matmul per segment
+        instead of a per-doc script loop)."""
+        field = spec.get("field")
+        qv = spec.get("query_vector")
+        if field is None or qv is None:
+            raise ParsingError("knn requires [field] and [query_vector]")
+        k = int(spec.get("k", 10))
+        num_candidates = int(spec.get("num_candidates", max(k, 10)))
+        boost = float(spec.get("boost", 1.0))
+        ft = self.mapper.field_type(field)
+        if not isinstance(ft, DenseVectorFieldType):
+            raise IllegalArgumentError(
+                f"[knn] field [{field}] is not a dense_vector field")
+        sim_kind = {"cosine": "cosineSimilarity", "dot_product": "dotProduct",
+                    "l2_norm": "l2norm",
+                    "max_inner_product": "dotProduct"}[ft.similarity] \
+            if ft.similarity in ("cosine", "dot_product", "l2_norm",
+                                 "max_inner_product") else "cosineSimilarity"
+        filt = spec.get("filter")
+        filter_q = parse_query(filt) if filt else None
+        qv = np.asarray(qv, np.float32)
+
+        pending = []
+        for seg_idx, seg in enumerate(self.segments):
+            sim, exists = _vector_similarity(sim_kind, qv, seg, field)
+            scores = _knn_score_transform(ft.similarity, sim)
+            mask = exists & seg.live_dev
+            if filter_q is not None:
+                _, fm = filter_q.execute(self.ctx, seg)
+                mask = mask & fm
+            kk = min(num_candidates, seg.n_pad)
+            topk = get_topk_kernel(seg.n_pad, kk)
+            vals_dev, idx_dev = topk(jnp.asarray(scores, jnp.float32), mask)
+            pending.append((seg_idx, vals_dev, idx_dev))
+        cands: List[Tuple[float, int, int]] = []
+        for seg_idx, vals_dev, idx_dev in pending:
+            vals = np.asarray(vals_dev)
+            idx = np.asarray(idx_dev)
+            ok = vals > float("-inf")
+            for v, d in zip(vals[ok], idx[ok]):
+                cands.append((float(v) * boost, seg_idx, int(d)))
+        cands.sort(key=lambda c: (-c[0], c[1], c[2]))
+        return cands[:k]
+
+    # ------------------------------------------------------------------
+    # sort keys
+    # ------------------------------------------------------------------
+
+    def _normalize_sort(self, sort_spec) -> List[dict]:
+        if isinstance(sort_spec, (str, dict)):
+            sort_spec = [sort_spec]
+        out = []
+        for clause in sort_spec:
+            if isinstance(clause, str):
+                field, opts = clause, {}
+            elif isinstance(clause, dict) and len(clause) == 1:
+                (field, opts), = clause.items()
+                if isinstance(opts, str):
+                    opts = {"order": opts}
+            else:
+                raise ParsingError(f"invalid sort clause [{clause}]")
+            order = opts.get("order", "desc" if field == "_score" else "asc")
+            out.append({"field": field, "order": order,
+                        "missing": opts.get("missing", "_last")})
+        return out
+
+    def _sort_raw_for(self, clause: dict, seg_idx: int, seg: Segment,
+                      docs: np.ndarray, scores: Optional[np.ndarray]):
+        """Raw (un-normalized) sort values for matched docs of one segment:
+        float64 array for numeric/_score/_doc, object array (str | None)
+        for keyword fields."""
+        field = clause["field"]
+        if field == "_score":
+            sc = scores[docs] if scores is not None else np.zeros(len(docs))
+            return sc.astype(np.float64)
+        if field == "_doc":
+            return ((np.int64(seg_idx) << 32) +
+                    docs.astype(np.int64)).astype(np.float64)
+        ft = self.mapper.field_type(field)
+        nf = seg.numeric_fields.get(field)
+        if nf is not None or isinstance(ft, (NumberFieldType, DateFieldType)):
+            return seg.numeric_first_value_column(field)[docs]
+        kf = seg.keyword_fields.get(field)
+        vals = np.full(len(docs), None, dtype=object)
+        if kf is not None:
+            first_term: Dict[int, str] = {}
+            for d, o in zip(kf.dv_docs_host[::-1], kf.dv_ords_host[::-1]):
+                first_term[int(d)] = kf.ord_terms[int(o)]
+            for i, d in enumerate(docs):
+                vals[i] = first_term.get(int(d))
+        return vals
+
+    @staticmethod
+    def _normalize_keys(clause: dict, raw: np.ndarray) -> np.ndarray:
+        """Global ascending-normalized float64 key column. String values
+        factorize over the *whole* candidate set (even codes, so a
+        search_after cursor of an absent string can land between codes)."""
+        desc = clause["order"] == "desc"
+        missing_last = clause["missing"] != "_first"
+        fill = _MISSING_LAST if (missing_last != desc) else -_MISSING_LAST
+        if raw.dtype == object:
+            uniq = sorted({v for v in raw if v is not None})
+            code_of = {v: i * 2 for i, v in enumerate(uniq)}
+            keys = np.asarray([code_of[v] if v is not None else fill
+                               for v in raw], np.float64)
+        else:
+            keys = np.where(np.isnan(raw), fill, raw)
+        return -keys if desc else keys
+
+    # ------------------------------------------------------------------
+    # main entry
+    # ------------------------------------------------------------------
 
     def search(self, body: Optional[dict] = None, *, size: int = 10,
                from_: int = 0, min_score: Optional[float] = None,
@@ -74,18 +216,32 @@ class ShardSearcher:
         from_ = int(body.get("from", from_))
         min_score = body.get("min_score", min_score)
         track_total_hits = body.get("track_total_hits", track_total_hits)
-        query = (parse_query(body["query"]) if body.get("query")
-                 else MatchAllQuery())
+        query_spec = body.get("query")
+        knn_spec = body.get("knn")
+        query = parse_query(query_spec) if query_spec else MatchAllQuery()
         aggs_spec = body.get("aggs") or body.get("aggregations")
         aggs = parse_aggs(aggs_spec) if aggs_spec else None
+        sort_spec = body.get("sort")
+        search_after = body.get("search_after")
+        rank_spec = body.get("rank")
+
+        use_field_sort = bool(sort_spec) and self._normalize_sort(
+            sort_spec)[0]["field"] != "_score"
 
         k = size + from_
-        # Dispatch all per-segment device work first, pull results after —
-        # no host sync between segments, so XLA can overlap their kernels
-        # (the reference overlaps segments via per-leaf search threads,
-        # ContextIndexSearcher.java:177).
-        pending = []  # (seg_idx, count_dev, vals_dev|None, idx_dev|None)
-        agg_pending = []  # (seg, mask_dev, scores_dev)
+        # window widened for search_after-less deep pagination handled by
+        # caller; knn/rrf need their own candidate windows
+        window = k
+        if rank_spec and "rrf" in rank_spec:
+            window = max(window, int(rank_spec["rrf"].get(
+                "rank_window_size", max(k, 10))))
+
+        # --- query phase (device) -----------------------------------------
+        pending = []
+        agg_pending = []
+        host_masks: Dict[int, np.ndarray] = {}
+        host_scores: Dict[int, np.ndarray] = {}
+        need_host_mask = use_field_sort
         for seg_idx, seg in enumerate(self.segments):
             scores, mask = query.execute(self.ctx, seg)
             mask = mask & seg.live_dev
@@ -93,52 +249,132 @@ class ShardSearcher:
                 mask = mask & (scores >= np.float32(min_score))
             count_dev = jnp.sum(mask) if track_total_hits is not False else None
             vals_dev = idx_dev = None
-            if k > 0:
-                kk = min(max(k, 1), seg.n_pad)
+            # the sort path needs the query top-k only to combine with knn
+            if window > 0 and (not use_field_sort or knn_spec):
+                kk = min(max(window, 1), seg.n_pad)
                 topk = get_topk_kernel(seg.n_pad, kk)
                 vals_dev, idx_dev = topk(scores, mask)
             pending.append((seg_idx, count_dev, vals_dev, idx_dev))
             if aggs is not None:
                 agg_pending.append((seg, mask, scores))
+            if need_host_mask:
+                host_masks[seg_idx] = np.asarray(mask)
+                if not use_field_sort or _sort_includes_score(sort_spec):
+                    host_scores[seg_idx] = np.asarray(scores)
 
         total = 0
-        candidates: List[Tuple[float, int, int]] = []  # (score, seg_idx, doc)
-        max_score = None
+        candidates: List[Tuple[float, int, int]] = []
         for seg_idx, count_dev, vals_dev, idx_dev in pending:
             if count_dev is not None:
                 total += int(count_dev)
             if vals_dev is not None:
                 vals = np.asarray(vals_dev)
                 idx = np.asarray(idx_dev)
-                valid = vals > float("-inf")
-                for v, d in zip(vals[valid], idx[valid]):
+                ok = vals > float("-inf")
+                for v, d in zip(vals[ok], idx[ok]):
                     candidates.append((float(v), seg_idx, int(d)))
-
-        # merge: score desc, then (seg_idx, doc) asc — global doc-id order
         candidates.sort(key=lambda c: (-c[0], c[1], c[2]))
-        if candidates:
-            max_score = candidates[0][0]
-        page = candidates[from_: from_ + size]
+
+        # --- knn section ---------------------------------------------------
+        knn_rankings: List[List[Tuple[float, int, int]]] = []
+        if knn_spec:
+            specs = knn_spec if isinstance(knn_spec, list) else [knn_spec]
+            for spec in specs:
+                knn_rankings.append(self._knn_candidates(spec))
+
+        max_score: Optional[float] = None
+        if knn_rankings:
+            if rank_spec and "rrf" in rank_spec:
+                rc = int(rank_spec["rrf"].get("rank_constant", 60))
+                rankings = ([candidates[:window]] if query_spec else []) \
+                    + knn_rankings
+                rrf: Dict[Tuple[int, int], float] = {}
+                for ranking in rankings:
+                    for rank_i, (_, si, d) in enumerate(ranking):
+                        rrf[(si, d)] = rrf.get((si, d), 0.0) + \
+                            1.0 / (rc + rank_i + 1)
+                candidates = sorted(
+                    ((sc, si, d) for (si, d), sc in rrf.items()),
+                    key=lambda c: (-c[0], c[1], c[2]))
+            else:
+                # hybrid: sum scores for docs in both result sets
+                combined: Dict[Tuple[int, int], float] = {}
+                if query_spec:
+                    for sc, si, d in candidates:
+                        combined[(si, d)] = combined.get((si, d), 0.0) + sc
+                for ranking in knn_rankings:
+                    for sc, si, d in ranking:
+                        combined[(si, d)] = combined.get((si, d), 0.0) + sc
+                candidates = sorted(
+                    ((sc, si, d) for (si, d), sc in combined.items()),
+                    key=lambda c: (-c[0], c[1], c[2]))
+            if not query_spec:
+                total = len(candidates)
+            if use_field_sort:
+                # knn + sort: the knn/hybrid result set IS the doc set; the
+                # sort only orders it (reference: knn section + sort)
+                restricted: Dict[int, np.ndarray] = {}
+                for _, si, d in candidates:
+                    m = restricted.get(si)
+                    if m is None:
+                        m = restricted[si] = np.zeros(
+                            self.segments[si].n_pad, bool)
+                    m[d] = True
+                host_masks = {si: host_masks[si] & m if si in host_masks
+                              else m for si, m in restricted.items()}
+                total = len(candidates)
+
+        # --- ranking → page ------------------------------------------------
+        if use_field_sort:
+            page, sort_clauses = self._field_sorted_page(
+                sort_spec, search_after, host_masks, host_scores, k)
+            page = page[from_:]
+            if track_total_hits is not False and not knn_rankings:
+                total = sum(int(m[: self.segments[si].n_docs].sum())
+                            for si, m in host_masks.items())
+        else:
+            sort_clauses = None
+            if candidates:
+                max_score = candidates[0][0]
+            if search_after is not None:
+                # search_after on _score desc
+                after = float(search_after[0])
+                candidates = [c for c in candidates if c[0] < after]
+            page = [(float(sc), si, d, None) for sc, si, d in
+                    candidates[from_: from_ + size]]
         total_relation = "eq"
         if track_total_hits is False:
-            total = len(candidates)
+            total = len(page) if use_field_sort else len(candidates)
             total_relation = "gte" if total >= k else "eq"
         elif isinstance(track_total_hits, int) and not isinstance(
                 track_total_hits, bool) and total > track_total_hits:
             total = track_total_hits
             total_relation = "gte"
 
+        # --- fetch phase ---------------------------------------------------
+        source_spec = body.get("_source", True)
+        dv_specs = body.get("docvalue_fields") or []
+        hl_spec = body.get("highlight")
+        hl_terms: Dict[str, set] = {}
+        if hl_spec:
+            query.collect_highlight_terms(self.ctx, hl_terms)
+
         hits = []
-        for score, seg_idx, d in page:
+        for score, seg_idx, d, sort_values in page:
             seg = self.segments[seg_idx]
-            hits.append(ShardHit(
+            src = seg.sources[d]
+            hit = ShardHit(
                 doc_id=seg.doc_uids[d], score=score, seg_idx=seg_idx,
-                local_doc=d, source=seg.sources[d],
-                seq_no=int(seg.seq_nos[d])))
+                local_doc=d, source=filter_source(src, source_spec),
+                sort_values=sort_values, seq_no=int(seg.seq_nos[d]))
+            if dv_specs:
+                hit.fields = docvalue_fields(seg, self.mapper, d, dv_specs)
+            if hl_spec:
+                hit.highlight = highlight(self.mapper, src, hl_spec, hl_terms)
+            hits.append(hit)
 
         agg_results = None
         if aggs is not None:
-            # score arrays only leave the device when a top_hits agg needs them
             seg_scores = ({seg.seg_id: np.asarray(sc)
                            for seg, _, sc in agg_pending}
                           if _tree_needs_scores(aggs) else {})
@@ -151,6 +387,93 @@ class ShardSearcher:
                                  hits=hits, max_score=max_score,
                                  aggregations=agg_results)
 
+    def _field_sorted_page(self, sort_spec, search_after, host_masks,
+                           host_scores, k):
+        """Sorted query path: lexsort matched docs on normalized keys
+        (reference: ``search/sort/SortBuilder`` → Lucene ``SortField``)."""
+        clauses = self._normalize_sort(sort_spec)
+        all_rows = []       # (seg_idx, doc)
+        raw_cols = [[] for _ in clauses]
+        for seg_idx, seg in enumerate(self.segments):
+            m = host_masks.get(seg_idx)
+            if m is None:
+                continue
+            docs = np.flatnonzero(m[: seg.n_docs])
+            if docs.size == 0:
+                continue
+            scores = host_scores.get(seg_idx)
+            for ci, clause in enumerate(clauses):
+                raw_cols[ci].append(self._sort_raw_for(
+                    clause, seg_idx, seg, docs, scores))
+            all_rows.extend((seg_idx, int(d)) for d in docs)
+        if not all_rows:
+            return [], clauses
+        raws = [np.concatenate(c) for c in raw_cols]
+        keys = [self._normalize_keys(clause, raw)
+                for clause, raw in zip(clauses, raws)]
+        n = len(all_rows)
+        keep = np.ones(n, bool)
+        if search_after is not None:
+            if len(search_after) != len(clauses):
+                raise IllegalArgumentError(
+                    f"search_after must have {len(clauses)} values")
+            eq_prefix = np.ones(n, bool)
+            gt_any = np.zeros(n, bool)
+            for ci, clause in enumerate(clauses):
+                after_key = self._after_key(clause, search_after[ci],
+                                            raws[ci], keys[ci])
+                gt_any |= eq_prefix & (keys[ci] > after_key)
+                eq_prefix &= keys[ci] == after_key
+            keep = gt_any
+        idx = np.flatnonzero(keep)
+        order = np.lexsort(tuple(keys[ci][idx] for ci in
+                                 range(len(clauses) - 1, -1, -1)))
+        top = idx[order[:k]]
+        page = []
+        for i in top:
+            seg_idx, d = all_rows[i]
+            sort_values = []
+            for ci, clause in enumerate(clauses):
+                v = raws[ci][i]
+                if isinstance(v, float) and np.isnan(v):
+                    sort_values.append(None)
+                elif isinstance(v, (np.floating, np.integer)):
+                    fv = float(v)
+                    sort_values.append(int(fv) if fv.is_integer() else fv)
+                else:
+                    sort_values.append(v)
+            score = None
+            for ci, clause in enumerate(clauses):
+                if clause["field"] == "_score":
+                    score = float(raws[ci][i])
+            page.append((score, seg_idx, d, sort_values))
+        return page, clauses
+
+    def _after_key(self, clause, after_value, raw_col, key_col):
+        """Normalize a search_after cursor value into key space."""
+        field = clause["field"]
+        desc = clause["order"] == "desc"
+        if after_value is None:
+            # same fill + desc negation as _normalize_keys, so a null cursor
+            # lands exactly on the missing block's key
+            missing_last = clause["missing"] != "_first"
+            fill = _MISSING_LAST if (missing_last != desc) else -_MISSING_LAST
+            return -fill if desc else fill
+        if field == "_score" or field == "_doc" or isinstance(
+                after_value, (int, float)):
+            v = float(after_value)
+            return -v if desc else v
+        # string cursor: odd/even code trick — present values have even
+        # codes; an absent cursor value lands between codes
+        uniq = sorted({v for v in raw_col if isinstance(v, str)})
+        import bisect
+        i = bisect.bisect_left(uniq, after_value)
+        if i < len(uniq) and uniq[i] == after_value:
+            code = i * 2
+        else:
+            code = i * 2 - 1
+        return -code if desc else code
+
     def count(self, body: Optional[dict] = None) -> int:
         body = body or {}
         query = (parse_query(body["query"]) if body.get("query")
@@ -160,3 +483,12 @@ class ShardSearcher:
             _, mask = query.execute(self.ctx, seg)
             total += int(jnp.sum(mask & seg.live_dev))
         return total
+
+
+def _sort_includes_score(sort_spec) -> bool:
+    if isinstance(sort_spec, (str, dict)):
+        sort_spec = [sort_spec]
+    for c in sort_spec or []:
+        if c == "_score" or (isinstance(c, dict) and "_score" in c):
+            return True
+    return False

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import ast
 import math
-from typing import Dict
+from typing import Any, Dict
 
 from ..common.errors import ElasticsearchError
 
@@ -60,6 +60,88 @@ def compile_expression(source: str):
                 raise ScriptException(
                     f"disallowed function call in script [{source}]")
     return tree
+
+
+def evaluate_expression_vec(source: str, params: Dict[str, Any]):
+    """Evaluate the same restricted grammar over *arrays* (jnp or numpy):
+    operators broadcast elementwise, ``a if c else b`` lowers to ``where``,
+    comparisons return boolean arrays. This is how score scripts run on
+    device — the whole expression traces into one XLA program (the
+    reference compiles Painless to bytecode per doc; here one fused kernel
+    for the whole segment)."""
+    import jax.numpy as jnp
+    tree = compile_expression(source)
+
+    vec_funcs = {
+        "abs": jnp.abs, "min": jnp.minimum, "max": jnp.maximum,
+        "round": jnp.round, "floor": jnp.floor, "ceil": jnp.ceil,
+        "sqrt": jnp.sqrt, "log": jnp.log, "log10": jnp.log10,
+        "exp": jnp.exp, "pow": jnp.power, "sin": jnp.sin, "cos": jnp.cos,
+        "tan": jnp.tan,
+    }
+
+    def ev(node):
+        if isinstance(node, ast.Expression):
+            return ev(node.body)
+        if isinstance(node, ast.Constant):
+            if not isinstance(node.value, (int, float, bool)):
+                raise ScriptException(f"non-numeric constant [{node.value}]")
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id in params:
+                return params[node.id]
+            raise ScriptException(f"unknown variable [{node.id}]")
+        if isinstance(node, ast.BinOp):
+            a, b = ev(node.left), ev(node.right)
+            op = type(node.op)
+            if op is ast.Add:
+                return a + b
+            if op is ast.Sub:
+                return a - b
+            if op is ast.Mult:
+                return a * b
+            if op is ast.Div:
+                return a / b
+            if op is ast.Mod:
+                return a % b
+            if op is ast.Pow:
+                return a ** b
+            if op is ast.FloorDiv:
+                return a // b
+        if isinstance(node, ast.UnaryOp):
+            v = ev(node.operand)
+            if isinstance(node.op, ast.USub):
+                return -v
+            if isinstance(node.op, ast.UAdd):
+                return +v
+            if isinstance(node.op, ast.Not):
+                return jnp.logical_not(v)
+        if isinstance(node, ast.Compare):
+            if len(node.ops) != 1:
+                raise ScriptException("chained comparisons not supported "
+                                      "in vector scripts")
+            left, right = ev(node.left), ev(node.comparators[0])
+            op = type(node.ops[0])
+            return {ast.Lt: left < right, ast.LtE: left <= right,
+                    ast.Gt: left > right, ast.GtE: left >= right,
+                    ast.Eq: left == right, ast.NotEq: left != right}[op]
+        if isinstance(node, ast.BoolOp):
+            vals = [ev(v) for v in node.values]
+            out = vals[0]
+            for v in vals[1:]:
+                out = (jnp.logical_and(out, v)
+                       if isinstance(node.op, ast.And)
+                       else jnp.logical_or(out, v))
+            return out
+        if isinstance(node, ast.IfExp):
+            return jnp.where(ev(node.test), ev(node.body), ev(node.orelse))
+        if isinstance(node, ast.Call):
+            fn = vec_funcs[node.func.id]
+            return fn(*[ev(a) for a in node.args])
+        raise ScriptException(
+            f"unsupported node [{type(node).__name__}]")  # pragma: no cover
+
+    return ev(tree)
 
 
 def evaluate_expression(source: str, params: Dict[str, float]) -> float:

@@ -1,0 +1,268 @@
+"""Sort/search_after, knn + hybrid + RRF, script_score/function_score,
+and fetch-phase (source filtering, docvalue_fields, highlight) tests."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import (IllegalArgumentError,
+                                             ParsingError)
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+MAPPING = {"properties": {
+    "body": {"type": "text"},
+    "tag": {"type": "keyword"},
+    "price": {"type": "double"},
+    "day": {"type": "date"},
+    "vec": {"type": "dense_vector", "dims": 4, "similarity": "cosine"},
+}}
+
+ROWS = [
+    ("1", "red apple pie", "fruit", 3.0, "2024-01-01", [1, 0, 0, 0]),
+    ("2", "green apple", "fruit", 1.5, "2024-01-05", [0.9, 0.1, 0, 0]),
+    ("3", "red fire truck", "toy", 20.0, "2024-02-01", [0, 1, 0, 0]),
+    ("4", "blue sky", None, 7.0, "2024-02-10", [0, 0, 1, 0]),
+    ("5", "red wine", "drink", 12.0, "2024-03-01", [0.5, 0.5, 0, 0]),
+]
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    mapper = MapperService(MAPPING)
+    segs = []
+    for half in (ROWS[:3], ROWS[3:]):
+        b = SegmentBuilder(f"_s{len(segs)}")
+        for (id_, body, tag, price, day, vec) in half:
+            doc = {"body": body, "price": price, "day": day, "vec": vec}
+            if tag is not None:
+                doc["tag"] = tag
+            b.add(mapper.parse_document(id_, doc), seq_no=int(id_))
+        segs.append(b.build())
+    return ShardSearcher(segs, mapper)
+
+
+# --- sort ------------------------------------------------------------------
+
+
+def test_sort_numeric_asc_desc(searcher):
+    r = searcher.search({"sort": [{"price": "asc"}], "size": 5})
+    assert [h.doc_id for h in r.hits] == ["2", "1", "4", "5", "3"]
+    assert r.hits[0].sort_values == [1.5]
+    r = searcher.search({"sort": [{"price": {"order": "desc"}}], "size": 2})
+    assert [h.doc_id for h in r.hits] == ["3", "5"]
+
+
+def test_sort_keyword_and_missing(searcher):
+    r = searcher.search({"sort": [{"tag": "asc"}, {"price": "asc"}],
+                         "size": 5})
+    # drink, fruit(1.5), fruit(3.0), toy, missing-last
+    assert [h.doc_id for h in r.hits] == ["5", "2", "1", "3", "4"]
+    assert r.hits[0].sort_values == ["drink", 12.0]
+    assert r.hits[-1].sort_values[0] is None
+    r = searcher.search({"sort": [{"tag": {"order": "asc",
+                                           "missing": "_first"}}],
+                         "size": 2})
+    assert r.hits[0].doc_id == "4"
+
+
+def test_sort_date(searcher):
+    r = searcher.search({"sort": [{"day": "desc"}], "size": 2})
+    assert [h.doc_id for h in r.hits] == ["5", "4"]
+
+
+def test_search_after(searcher):
+    r1 = searcher.search({"sort": [{"price": "asc"}], "size": 2})
+    assert [h.doc_id for h in r1.hits] == ["2", "1"]
+    r2 = searcher.search({"sort": [{"price": "asc"}], "size": 2,
+                          "search_after": r1.hits[-1].sort_values})
+    assert [h.doc_id for h in r2.hits] == ["4", "5"]
+    r3 = searcher.search({"sort": [{"price": "asc"}], "size": 2,
+                          "search_after": r2.hits[-1].sort_values})
+    assert [h.doc_id for h in r3.hits] == ["3"]
+
+
+def test_search_after_keyword_cursor(searcher):
+    r = searcher.search({"sort": [{"tag": "asc"}, {"price": "asc"}],
+                         "size": 5,
+                         "search_after": ["eggs", 0.0]})  # absent value
+    # "eggs" sorts between drink and fruit
+    assert [h.doc_id for h in r.hits] == ["2", "1", "3", "4"]
+
+
+def test_sort_with_query(searcher):
+    r = searcher.search({"query": {"match": {"body": "red"}},
+                         "sort": [{"price": "desc"}]})
+    assert [h.doc_id for h in r.hits] == ["3", "5", "1"]
+    assert r.total == 3
+
+
+# --- knn -------------------------------------------------------------------
+
+
+def test_knn_basic(searcher):
+    r = searcher.search({"knn": {"field": "vec", "query_vector": [1, 0, 0, 0],
+                                 "k": 3, "num_candidates": 5}, "size": 3})
+    assert [h.doc_id for h in r.hits][:2] == ["1", "2"]
+    assert r.hits[0].score == pytest.approx(1.0)  # (1+cos)/2, cos=1
+
+
+def test_knn_with_filter(searcher):
+    r = searcher.search({"knn": {"field": "vec", "query_vector": [1, 0, 0, 0],
+                                 "k": 3, "num_candidates": 5,
+                                 "filter": {"term": {"tag": "toy"}}},
+                         "size": 3})
+    assert [h.doc_id for h in r.hits] == ["3"]
+
+
+def test_knn_hybrid_sum(searcher):
+    # doc1 matches both 'red' and is closest to the vector: hybrid sum wins
+    r = searcher.search({"query": {"match": {"body": "red"}},
+                         "knn": {"field": "vec", "query_vector": [1, 0, 0, 0],
+                                 "k": 2, "num_candidates": 5},
+                         "size": 3})
+    assert r.hits[0].doc_id == "1"
+    bm25_only = searcher.search({"query": {"match": {"body": "red"}}})
+    bm25_score = {h.doc_id: h.score for h in bm25_only.hits}["1"]
+    assert r.hits[0].score > bm25_score
+
+
+def test_knn_rrf(searcher):
+    r = searcher.search({"query": {"match": {"body": "red"}},
+                         "knn": {"field": "vec", "query_vector": [1, 0, 0, 0],
+                                 "k": 3, "num_candidates": 5},
+                         "rank": {"rrf": {"rank_constant": 60,
+                                          "rank_window_size": 5}},
+                         "size": 3})
+    # doc1 = knn rank 1 + bm25 rank 2 ("red wine" is shorter, wins bm25)
+    assert r.hits[0].doc_id == "1"
+    assert r.hits[0].score == pytest.approx(1 / 61 + 1 / 62, rel=1e-3)
+
+
+def test_knn_requires_vector_field(searcher):
+    with pytest.raises(IllegalArgumentError):
+        searcher.search({"knn": {"field": "price",
+                                 "query_vector": [1, 0, 0, 0], "k": 2}})
+
+
+# --- script_score / function_score ----------------------------------------
+
+
+def test_script_score_cosine(searcher):
+    r = searcher.search({"query": {"script_score": {
+        "query": {"match_all": {}},
+        "script": {"source": "cosineSimilarity(params.qv, 'vec') + 1.0",
+                   "params": {"qv": [1, 0, 0, 0]}}}}, "size": 5})
+    assert r.hits[0].doc_id == "1"
+    assert r.hits[0].score == pytest.approx(2.0)
+
+
+def test_script_score_doc_values(searcher):
+    r = searcher.search({"query": {"script_score": {
+        "query": {"match_all": {}},
+        "script": {"source": "doc['price'].value * 2"}}}, "size": 5})
+    assert r.hits[0].doc_id == "3"
+    assert r.hits[0].score == pytest.approx(40.0)
+
+
+def test_function_score_field_value_factor(searcher):
+    r = searcher.search({"query": {"function_score": {
+        "query": {"match": {"body": "red"}},
+        "field_value_factor": {"field": "price", "factor": 1.0},
+        "boost_mode": "replace"}}, "size": 5})
+    assert [h.doc_id for h in r.hits] == ["3", "5", "1"]
+    assert r.hits[0].score == pytest.approx(20.0)
+
+
+# --- fetch phase -----------------------------------------------------------
+
+
+def test_source_filtering(searcher):
+    r = searcher.search({"query": {"ids": {"values": ["1"]}},
+                         "_source": ["body"]})
+    assert r.hits[0].source == {"body": "red apple pie"}
+    r = searcher.search({"query": {"ids": {"values": ["1"]}},
+                         "_source": False})
+    assert r.hits[0].source is None
+    r = searcher.search({"query": {"ids": {"values": ["1"]}},
+                         "_source": {"excludes": ["vec", "day"]}})
+    assert set(r.hits[0].source) == {"body", "tag", "price"}
+
+
+def test_docvalue_fields(searcher):
+    r = searcher.search({"query": {"ids": {"values": ["1"]}},
+                         "docvalue_fields": ["tag", "price",
+                                             {"field": "day"}]})
+    f = r.hits[0].fields
+    assert f["tag"] == ["fruit"]
+    assert f["price"] == [3.0]
+    assert f["day"][0].startswith("2024-01-01T")
+
+
+def test_highlight(searcher):
+    r = searcher.search({"query": {"match": {"body": "red"}},
+                         "highlight": {"fields": {"body": {}}}})
+    for h in r.hits:
+        assert any("<em>red</em>" in frag for frag in h.highlight["body"])
+    r = searcher.search({"query": {"match": {"body": "apple pie"}},
+                         "highlight": {"fields": {"body": {}},
+                                       "pre_tags": ["<b>"],
+                                       "post_tags": ["</b>"]}})
+    h1 = [h for h in r.hits if h.doc_id == "1"][0]
+    assert "<b>apple</b> <b>pie</b>" in h1.highlight["body"][0]
+
+
+# --- review regressions ----------------------------------------------------
+
+
+def test_sort_with_from_offset(searcher):
+    r = searcher.search({"sort": [{"price": "asc"}], "size": 2, "from": 2})
+    assert [h.doc_id for h in r.hits] == ["4", "5"]
+
+
+def test_search_after_null_cursor_desc(searcher):
+    # page past the missing block on a desc sort: nothing left
+    r1 = searcher.search({"sort": [{"tag": "desc"}], "size": 10})
+    last = r1.hits[-1]
+    assert last.sort_values == [None]
+    r2 = searcher.search({"sort": [{"tag": "desc"}], "size": 10,
+                          "search_after": last.sort_values})
+    assert r2.hits == []
+
+
+def test_knn_with_field_sort(searcher):
+    # knn selects the 2 nearest docs; sort orders THEM by price
+    r = searcher.search({"knn": {"field": "vec", "query_vector": [1, 0, 0, 0],
+                                 "k": 2, "num_candidates": 5},
+                         "sort": [{"price": "asc"}], "size": 5})
+    assert [h.doc_id for h in r.hits] == ["2", "1"]
+    assert r.total == 2
+
+
+def test_sort_track_total_hits_variants(searcher):
+    r = searcher.search({"sort": [{"price": "asc"}], "size": 1,
+                         "track_total_hits": 2})
+    assert r.total == 2 and r.total_relation == "gte"
+    r = searcher.search({"sort": [{"price": "asc"}], "size": 3,
+                         "track_total_hits": False})
+    assert r.total == 3
+
+
+def test_function_score_min_mode_excludes_nonmatching():
+    mapper = MapperService(MAPPING)
+    b = SegmentBuilder("_0")
+    for (id_, body, tag, price, day, vec) in ROWS:
+        doc = {"body": body, "price": price, "day": day, "vec": vec}
+        if tag is not None:
+            doc["tag"] = tag
+        b.add(mapper.parse_document(id_, doc), seq_no=int(id_))
+    s = ShardSearcher([b.build()], mapper)
+    r = s.search({"query": {"function_score": {
+        "query": {"ids": {"values": ["4"]}},   # tag missing on doc 4
+        "functions": [
+            {"filter": {"term": {"tag": "fruit"}}, "weight": 5},
+            {"weight": 3},
+        ],
+        "score_mode": "min", "boost_mode": "replace"}}})
+    # doc4 doesn't match the filtered function: min over {3} = 3, not 0
+    assert r.hits[0].score == pytest.approx(3.0)
