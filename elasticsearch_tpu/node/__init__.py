@@ -1,0 +1,13 @@
+"""Node layer: index lifecycle, routing, and request execution on one node.
+
+Re-design of the reference's node-level services
+(``indices/IndicesService.java:176`` owning per-index ``IndexService`` →
+``IndexShard`` instances; ``node/Node.java`` wiring). One process owns a
+set of indices; each index has N shards (each an ``index.engine.Engine``),
+docs route to shards by Murmur3, and searches fan out over every shard's
+segments with global (DFS-quality) term statistics.
+"""
+
+from .indices_service import IndexService, IndicesService
+
+__all__ = ["IndexService", "IndicesService"]

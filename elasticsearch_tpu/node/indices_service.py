@@ -1,0 +1,291 @@
+"""Index lifecycle + per-index shard management on one node.
+
+Reference parity targets: ``indices/IndicesService.java:176`` (create/
+remove index services), ``index/IndexService.java`` (shard ownership),
+``cluster/metadata/MetadataCreateIndexService.java`` (validation,
+settings), ``action/bulk/TransportBulkAction.java:99`` (routing + per-shard
+grouping). Single-node scope here; the distributed data plane in
+``parallel/`` takes over shard placement across a device mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common.errors import (ElasticsearchError, IllegalArgumentError,
+                             ResourceAlreadyExistsError, IndexNotFoundError)
+from ..index.engine import Engine
+from ..index.mapping import MapperService
+from ..search.shard_search import ShardSearcher, ShardSearchResult
+from ..utils.murmur3 import shard_for
+
+_VALID_INDEX_RE = re.compile(r"^[^A-Z _\-+][^A-Z\\/*?\"<>| ,#]*$")
+
+
+def validate_index_name(name: str) -> None:
+    if not name or name in (".", ".."):
+        raise IllegalArgumentError(f"invalid index name [{name}]")
+    if name.startswith(("-", "_", "+")) or name != name.lower() or \
+            any(c in name for c in '\\/*?"<>| ,#'):
+        raise IllegalArgumentError(
+            f"invalid index name [{name}], must be lowercase and may not "
+            f"contain spaces or the characters \\/*?\"<>|,#")
+
+
+class IndexService:
+    """One index: settings, mapper, and its primary shards."""
+
+    def __init__(self, name: str, path: str, settings: Optional[dict] = None,
+                 mappings: Optional[dict] = None):
+        self.name = name
+        self.path = path
+        settings = dict(settings or {})
+        flat = _flatten_settings(settings)
+        self.num_shards = int(flat.get("index.number_of_shards",
+                                       flat.get("number_of_shards", 1)))
+        self.num_replicas = int(flat.get("index.number_of_replicas",
+                                         flat.get("number_of_replicas", 1)))
+        if self.num_shards < 1 or self.num_shards > 1024:
+            raise IllegalArgumentError(
+                f"invalid number_of_shards [{self.num_shards}]")
+        self.settings = flat
+        self.creation_date = int(time.time() * 1000)
+        self.uuid = f"{abs(hash((name, self.creation_date))):022x}"[:22]
+        self.mapper = MapperService(mappings or {})
+        self.shards: List[Engine] = []
+        for i in range(self.num_shards):
+            shard_path = os.path.join(path, str(i))
+            os.makedirs(shard_path, exist_ok=True)
+            self.shards.append(Engine(
+                shard_path, self.mapper,
+                translog_durability=flat.get("index.translog.durability",
+                                             "request"),
+                gc_deletes_seconds=_parse_time_seconds(
+                    flat.get("index.gc_deletes", "60s"))))
+        self.aliases: Dict[str, dict] = {}
+
+    # -- routing ------------------------------------------------------------
+
+    def shard_id_for(self, doc_id: str, routing: Optional[str] = None) -> int:
+        return shard_for(routing if routing is not None else doc_id,
+                         self.num_shards)
+
+    def shard_for_doc(self, doc_id: str, routing: Optional[str] = None) -> Engine:
+        return self.shards[self.shard_id_for(doc_id, routing)]
+
+    # -- document ops -------------------------------------------------------
+
+    def index_doc(self, doc_id: str, source: dict, *,
+                  routing: Optional[str] = None, op_type: str = "index",
+                  if_seq_no=None, if_primary_term=None):
+        return self.shard_for_doc(doc_id, routing).index(
+            doc_id, source, routing=routing, op_type=op_type,
+            if_seq_no=if_seq_no, if_primary_term=if_primary_term)
+
+    def get_doc(self, doc_id: str, routing: Optional[str] = None):
+        return self.shard_for_doc(doc_id, routing).get(doc_id)
+
+    def delete_doc(self, doc_id: str, *, routing: Optional[str] = None,
+                   if_seq_no=None, if_primary_term=None):
+        return self.shard_for_doc(doc_id, routing).delete(
+            doc_id, if_seq_no=if_seq_no, if_primary_term=if_primary_term)
+
+    # -- search -------------------------------------------------------------
+
+    def searcher(self) -> ShardSearcher:
+        """Searcher over every shard's searchable segments. Term statistics
+        are computed over the union — equivalent to the reference's DFS
+        phase being always-on (``search/dfs/DfsPhase.java``), which is
+        strictly more consistent than its per-shard default."""
+        segments = []
+        for shard in self.shards:
+            segments.extend(shard.searchable_segments())
+        return ShardSearcher(segments, self.mapper)
+
+    def search(self, body: Optional[dict] = None) -> ShardSearchResult:
+        return self.searcher().search(body or {})
+
+    def count(self, body: Optional[dict] = None) -> int:
+        return self.searcher().count(body or {})
+
+    # -- admin --------------------------------------------------------------
+
+    def refresh(self) -> None:
+        for s in self.shards:
+            s.refresh()
+
+    def flush(self) -> None:
+        for s in self.shards:
+            s.flush()
+
+    def force_merge(self) -> None:
+        for s in self.shards:
+            s.force_merge()
+
+    def put_mapping(self, mappings: dict) -> None:
+        self.mapper.merge(mappings)
+
+    def update_settings(self, settings: dict) -> None:
+        flat = _flatten_settings(settings)
+        static = {"index.number_of_shards", "number_of_shards"}
+        for k in flat:
+            if k in static:
+                raise IllegalArgumentError(
+                    f"final {self.name} setting [{k}], not updateable")
+        self.settings.update(flat)
+        if "index.number_of_replicas" in flat:
+            self.num_replicas = int(flat["index.number_of_replicas"])
+
+    def stats(self) -> dict:
+        docs = sum(s.doc_count for s in self.shards)
+        deleted = sum(s.deleted_count for s in self.shards)
+        seg_count = sum(len(s.searchable_segments()) for s in self.shards)
+        store = 0
+        for s in self.shards:
+            for root, _, files in os.walk(s.path):
+                for f in files:
+                    try:
+                        store += os.path.getsize(os.path.join(root, f))
+                    except OSError:
+                        pass
+        ops = {}
+        for key in ("index_total", "delete_total", "refresh_total",
+                    "flush_total", "merge_total", "get_total"):
+            ops[key] = sum(s.stats.get(key, 0) for s in self.shards)
+        return {"docs": {"count": docs, "deleted": deleted},
+                "store": {"size_in_bytes": store},
+                "segments": {"count": seg_count},
+                "indexing": {"index_total": ops["index_total"],
+                             "delete_total": ops["delete_total"]},
+                "get": {"total": ops["get_total"]},
+                "refresh": {"total": ops["refresh_total"]},
+                "flush": {"total": ops["flush_total"]},
+                "merges": {"total": ops["merge_total"]}}
+
+    def close(self) -> None:
+        for s in self.shards:
+            s.close()
+
+
+class IndicesService:
+    """All indices on this node (reference: ``IndicesService.java:176``).
+    Resolves index expressions (names, aliases, wildcards, _all)."""
+
+    def __init__(self, data_path: str):
+        self.data_path = data_path
+        os.makedirs(data_path, exist_ok=True)
+        self.indices: Dict[str, IndexService] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def create_index(self, name: str, settings: Optional[dict] = None,
+                     mappings: Optional[dict] = None,
+                     aliases: Optional[dict] = None) -> IndexService:
+        validate_index_name(name)
+        if name in self.indices or name in self.all_aliases():
+            raise ResourceAlreadyExistsError(f"index [{name}] already exists")
+        svc = IndexService(name, os.path.join(self.data_path, name),
+                           settings, mappings)
+        for alias, spec in (aliases or {}).items():
+            svc.aliases[alias] = spec or {}
+        self.indices[name] = svc
+        return svc
+
+    def delete_index(self, expression: str) -> List[str]:
+        names = self.resolve(expression, allow_aliases=False)
+        for n in names:
+            svc = self.indices.pop(n)
+            svc.close()
+            shutil.rmtree(svc.path, ignore_errors=True)
+        return names
+
+    def get(self, name: str) -> IndexService:
+        svc = self.indices.get(name)
+        if svc is None:
+            resolved = self.resolve(name)
+            if len(resolved) != 1:
+                raise IllegalArgumentError(
+                    f"alias [{name}] has more than one index associated")
+            return self.indices[resolved[0]]
+        return svc
+
+    def exists(self, expression: str) -> bool:
+        try:
+            return bool(self.resolve(expression))
+        except IndexNotFoundError:
+            return False
+
+    def all_aliases(self) -> Dict[str, List[str]]:
+        out: Dict[str, List[str]] = {}
+        for name, svc in self.indices.items():
+            for a in svc.aliases:
+                out.setdefault(a, []).append(name)
+        return out
+
+    def resolve(self, expression: Optional[str],
+                allow_aliases: bool = True) -> List[str]:
+        """Index expression → concrete index names (reference:
+        ``IndexNameExpressionResolver``): comma lists, wildcards, _all,
+        aliases."""
+        if expression in (None, "", "_all", "*"):
+            return sorted(self.indices)
+        aliases = self.all_aliases() if allow_aliases else {}
+        out: List[str] = []
+        for part in str(expression).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if part in self.indices:
+                out.append(part)
+            elif part in aliases:
+                out.extend(aliases[part])
+            elif "*" in part or "?" in part:
+                import fnmatch
+                matched = [n for n in self.indices
+                           if fnmatch.fnmatchcase(n, part)]
+                if allow_aliases:
+                    for a, names in aliases.items():
+                        if fnmatch.fnmatchcase(a, part):
+                            matched.extend(names)
+                out.extend(sorted(set(matched)))
+            else:
+                raise IndexNotFoundError(f"no such index [{part}]")
+        seen = set()
+        uniq = []
+        for n in out:
+            if n not in seen:
+                seen.add(n)
+                uniq.append(n)
+        return uniq
+
+    def close(self) -> None:
+        for svc in self.indices.values():
+            svc.close()
+
+
+def _flatten_settings(settings: dict, prefix: str = "") -> Dict[str, Any]:
+    """{"index": {"number_of_shards": 2}} → {"index.number_of_shards": 2}."""
+    out: Dict[str, Any] = {}
+    for k, v in settings.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten_settings(v, key + "."))
+        else:
+            out[key] = v
+    return out
+
+
+def _parse_time_seconds(v) -> float:
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v)
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h|d)?", s)
+    if not m:
+        raise IllegalArgumentError(f"failed to parse time value [{v}]")
+    mult = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0,
+            "d": 86400.0}.get(m.group(2) or "s", 1.0)
+    return float(m.group(1)) * mult

@@ -1,0 +1,7 @@
+"""REST API layer (reference: ``rest/RestController.java:196`` dispatching
+119 ``Rest*Action`` handlers; response shapes per ``rest-api-spec``)."""
+
+from .api import RestAPI
+from .http_server import HttpServer
+
+__all__ = ["RestAPI", "HttpServer"]

@@ -1,0 +1,1184 @@
+"""REST API: routes + handlers with ES-shaped JSON in and out.
+
+Re-design of the reference's REST layer: ``rest/RestController.java:196``
+(dispatch), handlers under ``rest/action/`` (119 classes), response wire
+shapes per ``rest-api-spec`` (144 JSON specs). One class holds the route
+table; handlers are sync functions (the engine is single-writer per shard)
+invoked from the asyncio HTTP server.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, unquote
+
+from ..common.errors import (DocumentMissingError, ElasticsearchError,
+                             IllegalArgumentError, IndexNotFoundError,
+                             ParsingError, ResourceAlreadyExistsError,
+                             VersionConflictError)
+from ..index.mapping import MapperService
+from ..node.indices_service import IndexService, IndicesService
+from ..search.shard_search import ShardHit, ShardSearcher
+
+JSON_CT = "application/json"
+
+
+def _json_body(body: bytes) -> dict:
+    if not body:
+        return {}
+    try:
+        return json.loads(body)
+    except json.JSONDecodeError as e:
+        raise ParsingError(f"request body is not valid JSON: {e}")
+
+
+def _error_payload(e: Exception) -> Tuple[int, dict]:
+    if isinstance(e, ElasticsearchError):
+        status = getattr(e, "status", 500)
+        etype = getattr(e, "error_type", type(e).__name__)
+        reason = str(e)
+    else:
+        status, etype, reason = 500, "exception", str(e)
+    return status, {
+        "error": {"root_cause": [{"type": etype, "reason": reason}],
+                  "type": etype, "reason": reason},
+        "status": status}
+
+
+def _flag(params: dict, name: str, default: bool = False) -> bool:
+    v = params.get(name)
+    if v is None:
+        return default
+    return str(v).lower() not in ("false", "0", "no")
+
+
+class RestAPI:
+    """Route table + handlers over one node's IndicesService."""
+
+    def __init__(self, indices: IndicesService, cluster_name: str = "es-tpu",
+                 node_name: str = "node-0"):
+        self.indices = indices
+        self.cluster_name = cluster_name
+        self.node_name = node_name
+        self.node_id = uuid.uuid4().hex[:20]
+        self.start_time = time.time()
+        self.cluster_settings: Dict[str, dict] = {"persistent": {},
+                                                  "transient": {}}
+        self.templates: Dict[str, dict] = {}
+        self.scrolls: Dict[str, dict] = {}
+        self.pits: Dict[str, dict] = {}
+        self._routes: List[Tuple[str, re.Pattern, List[str], Callable]] = []
+        self._build_routes()
+
+    # ------------------------------------------------------------------
+    # routing
+    # ------------------------------------------------------------------
+
+    def _add(self, methods: str, pattern: str, fn: Callable) -> None:
+        names = re.findall(r"\{(\w+)\}", pattern)
+        rx = re.compile("^" + re.sub(
+            r"\{\w+\}", r"([^/]+)", pattern) + "$")
+        for m in methods.split(","):
+            self._routes.append((m, rx, names, fn))
+
+    def _build_routes(self) -> None:
+        add = self._add
+        add("GET,HEAD", "/", self.h_root)
+        # cluster
+        add("GET", "/_cluster/health", self.h_cluster_health)
+        add("GET", "/_cluster/health/{index}", self.h_cluster_health)
+        add("GET", "/_cluster/stats", self.h_cluster_stats)
+        add("GET", "/_cluster/settings", self.h_cluster_get_settings)
+        add("PUT", "/_cluster/settings", self.h_cluster_put_settings)
+        add("GET", "/_nodes", self.h_nodes)
+        add("GET", "/_nodes/stats", self.h_nodes_stats)
+        # cat
+        add("GET", "/_cat/indices", self.h_cat_indices)
+        add("GET", "/_cat/indices/{index}", self.h_cat_indices)
+        add("GET", "/_cat/health", self.h_cat_health)
+        add("GET", "/_cat/count", self.h_cat_count)
+        add("GET", "/_cat/count/{index}", self.h_cat_count)
+        add("GET", "/_cat/shards", self.h_cat_shards)
+        add("GET", "/_cat/nodes", self.h_cat_nodes)
+        add("GET", "/_cat/aliases", self.h_cat_aliases)
+        # search / count / mget / analyze / field caps
+        add("GET,POST", "/_search", self.h_search)
+        add("GET,POST", "/{index}/_search", self.h_search)
+        add("GET,POST", "/_search/scroll", self.h_scroll)
+        add("DELETE", "/_search/scroll", self.h_clear_scroll)
+        add("GET,POST", "/_count", self.h_count)
+        add("GET,POST", "/{index}/_count", self.h_count)
+        add("GET,POST", "/_mget", self.h_mget)
+        add("GET,POST", "/{index}/_mget", self.h_mget)
+        add("GET,POST", "/_analyze", self.h_analyze)
+        add("GET,POST", "/{index}/_analyze", self.h_analyze)
+        add("GET,POST", "/_field_caps", self.h_field_caps)
+        add("GET,POST", "/{index}/_field_caps", self.h_field_caps)
+        add("POST", "/{index}/_pit", self.h_open_pit)
+        add("DELETE", "/_pit", self.h_close_pit)
+        # bulk + by-query
+        add("POST,PUT", "/_bulk", self.h_bulk)
+        add("POST,PUT", "/{index}/_bulk", self.h_bulk)
+        add("POST", "/{index}/_delete_by_query", self.h_delete_by_query)
+        add("POST", "/{index}/_update_by_query", self.h_update_by_query)
+        # templates
+        add("PUT,POST", "/_index_template/{name}", self.h_put_template)
+        add("GET", "/_index_template/{name}", self.h_get_template)
+        add("GET", "/_index_template", self.h_get_template)
+        add("DELETE", "/_index_template/{name}", self.h_delete_template)
+        add("PUT,POST", "/_template/{name}", self.h_put_template)
+        add("GET", "/_template/{name}", self.h_get_template)
+        add("DELETE", "/_template/{name}", self.h_delete_template)
+        # aliases
+        add("POST", "/_aliases", self.h_update_aliases)
+        add("GET", "/_alias", self.h_get_alias)
+        add("GET", "/_alias/{name}", self.h_get_alias)
+        add("GET", "/{index}/_alias", self.h_get_alias)
+        add("GET", "/{index}/_alias/{name}", self.h_get_alias)
+        add("PUT", "/{index}/_alias/{name}", self.h_put_alias)
+        add("DELETE", "/{index}/_alias/{name}", self.h_delete_alias)
+        # index admin
+        add("GET", "/_stats", self.h_stats)
+        add("GET", "/{index}/_stats", self.h_stats)
+        add("GET,PUT", "/{index}/_mapping", self.h_mapping)
+        add("GET,PUT", "/{index}/_settings", self.h_settings)
+        add("GET,PUT", "/_settings", self.h_settings)
+        add("POST", "/{index}/_refresh", self.h_refresh)
+        add("POST", "/_refresh", self.h_refresh)
+        add("POST", "/{index}/_flush", self.h_flush)
+        add("POST", "/_flush", self.h_flush)
+        add("POST", "/{index}/_forcemerge", self.h_forcemerge)
+        # documents
+        add("PUT,POST", "/{index}/_doc/{id}", self.h_index_doc)
+        add("POST", "/{index}/_doc", self.h_index_doc_auto)
+        add("GET,HEAD", "/{index}/_doc/{id}", self.h_get_doc)
+        add("DELETE", "/{index}/_doc/{id}", self.h_delete_doc)
+        add("PUT,POST", "/{index}/_create/{id}", self.h_create_doc)
+        add("GET,HEAD", "/{index}/_source/{id}", self.h_get_source)
+        add("POST", "/{index}/_update/{id}", self.h_update_doc)
+        # index CRUD last ({index} captures anything)
+        add("PUT", "/{index}", self.h_create_index)
+        add("DELETE", "/{index}", self.h_delete_index)
+        add("GET,HEAD", "/{index}", self.h_get_index)
+
+    def handle(self, method: str, path: str, query: str,
+               body: bytes) -> Tuple[int, str, bytes]:
+        params = {k: v[-1] for k, v in parse_qs(query).items()}
+        if query:
+            # bare flags like ?v
+            for part in query.split("&"):
+                if part and "=" not in part:
+                    params[part] = "true"
+        path = unquote(path.rstrip("/")) or "/"
+        matched_path = False
+        for m, rx, names, fn in self._routes:
+            match = rx.match(path)
+            if match is None:
+                continue
+            matched_path = True
+            if m != method and not (method == "HEAD" and m == "GET"):
+                continue
+            kwargs = dict(zip(names, match.groups()))
+            try:
+                result = fn(params, body, **kwargs)
+            except Exception as e:  # noqa: BLE001 — ES-shaped error replies
+                status, payload = _error_payload(e)
+                return status, JSON_CT, json.dumps(payload).encode()
+            if isinstance(result, tuple):
+                status, payload = result
+            else:
+                status, payload = 200, result
+            if isinstance(payload, (dict, list)):
+                return status, JSON_CT, json.dumps(payload).encode()
+            if isinstance(payload, str):
+                return status, "text/plain; charset=UTF-8", payload.encode()
+            return status, JSON_CT, payload
+        if matched_path:
+            status, payload = 405, {"error": f"Incorrect HTTP method for uri "
+                                             f"[{path}] and method [{method}]",
+                                    "status": 405}
+        else:
+            status, payload = 400, {
+                "error": f"no handler found for uri [{path}] and method "
+                         f"[{method}]", "status": 400}
+        return status, JSON_CT, json.dumps(payload).encode()
+
+    # ------------------------------------------------------------------
+    # root / cluster
+    # ------------------------------------------------------------------
+
+    def h_root(self, params, body):
+        return {
+            "name": self.node_name,
+            "cluster_name": self.cluster_name,
+            "cluster_uuid": self.node_id,
+            "version": {"number": "8.0.0-tpu",
+                        "build_flavor": "tpu-native",
+                        "lucene_version": "n/a"},
+            "tagline": "You Know, for Search",
+        }
+
+    def _health(self, index: Optional[str] = None) -> dict:
+        names = self.indices.resolve(index)
+        shards = sum(self.indices.indices[n].num_shards for n in names)
+        return {
+            "cluster_name": self.cluster_name,
+            "status": "green",
+            "timed_out": False,
+            "number_of_nodes": 1,
+            "number_of_data_nodes": 1,
+            "active_primary_shards": shards,
+            "active_shards": shards,
+            "relocating_shards": 0,
+            "initializing_shards": 0,
+            "unassigned_shards": 0,
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number": 100.0,
+        }
+
+    def h_cluster_health(self, params, body, index=None):
+        return self._health(index)
+
+    def h_cluster_stats(self, params, body):
+        docs = sum(sum(s.doc_count for s in svc.shards)
+                   for svc in self.indices.indices.values())
+        return {
+            "cluster_name": self.cluster_name,
+            "status": "green",
+            "indices": {"count": len(self.indices.indices),
+                        "docs": {"count": docs},
+                        "shards": {"total": sum(
+                            svc.num_shards
+                            for svc in self.indices.indices.values())}},
+            "nodes": {"count": {"total": 1, "data": 1, "master": 1}},
+        }
+
+    def h_cluster_get_settings(self, params, body):
+        return dict(self.cluster_settings, defaults={})
+
+    def h_cluster_put_settings(self, params, body):
+        b = _json_body(body)
+        for scope in ("persistent", "transient"):
+            self.cluster_settings[scope].update(b.get(scope) or {})
+        return {"acknowledged": True,
+                "persistent": self.cluster_settings["persistent"],
+                "transient": self.cluster_settings["transient"]}
+
+    def h_nodes(self, params, body):
+        return {"_nodes": {"total": 1, "successful": 1, "failed": 0},
+                "cluster_name": self.cluster_name,
+                "nodes": {self.node_id: {
+                    "name": self.node_name,
+                    "roles": ["master", "data", "ingest"],
+                    "version": "8.0.0-tpu"}}}
+
+    def h_nodes_stats(self, params, body):
+        total_docs = sum(sum(s.doc_count for s in svc.shards)
+                         for svc in self.indices.indices.values())
+        return {"_nodes": {"total": 1, "successful": 1, "failed": 0},
+                "cluster_name": self.cluster_name,
+                "nodes": {self.node_id: {
+                    "name": self.node_name,
+                    "indices": {"docs": {"count": total_docs}},
+                    "jvm": {"uptime_in_millis": int(
+                        (time.time() - self.start_time) * 1000)}}}}
+
+    # ------------------------------------------------------------------
+    # cat
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _cat_table(rows: List[List[str]], headers: List[str],
+                   verbose: bool) -> str:
+        if not rows and not verbose:
+            return ""
+        widths = [len(h) for h in headers]
+        for r in rows:
+            for i, c in enumerate(r):
+                widths[i] = max(widths[i], len(str(c)))
+        lines = []
+        if verbose:
+            lines.append(" ".join(h.ljust(widths[i])
+                                  for i, h in enumerate(headers)).rstrip())
+        for r in rows:
+            lines.append(" ".join(str(c).ljust(widths[i])
+                                  for i, c in enumerate(r)).rstrip())
+        return "\n".join(lines) + "\n"
+
+    def h_cat_indices(self, params, body, index=None):
+        rows = []
+        for name in self.indices.resolve(index):
+            svc = self.indices.indices[name]
+            st = svc.stats()
+            rows.append(["green", "open", name, svc.uuid,
+                         svc.num_shards, svc.num_replicas,
+                         st["docs"]["count"], st["docs"]["deleted"],
+                         st["store"]["size_in_bytes"],
+                         st["store"]["size_in_bytes"]])
+        return self._cat_table(rows, ["health", "status", "index", "uuid",
+                                      "pri", "rep", "docs.count",
+                                      "docs.deleted", "store.size",
+                                      "pri.store.size"],
+                               _flag(params, "v"))
+
+    def h_cat_health(self, params, body):
+        h = self._health()
+        rows = [[int(time.time()), time.strftime("%H:%M:%S"),
+                 h["cluster_name"], h["status"], 1, 1,
+                 h["active_shards"], h["active_primary_shards"], 0, 0, 0, 0,
+                 "-", "100.0%"]]
+        return self._cat_table(rows, ["epoch", "timestamp", "cluster",
+                                      "status", "node.total", "node.data",
+                                      "shards", "pri", "relo", "init",
+                                      "unassign", "pending_tasks",
+                                      "max_task_wait_time",
+                                      "active_shards_percent"],
+                               _flag(params, "v"))
+
+    def h_cat_count(self, params, body, index=None):
+        total = 0
+        for name in self.indices.resolve(index):
+            total += sum(s.doc_count
+                         for s in self.indices.indices[name].shards)
+        return self._cat_table(
+            [[int(time.time()), time.strftime("%H:%M:%S"), total]],
+            ["epoch", "timestamp", "count"], _flag(params, "v"))
+
+    def h_cat_shards(self, params, body):
+        rows = []
+        for name, svc in sorted(self.indices.indices.items()):
+            for i, shard in enumerate(svc.shards):
+                rows.append([name, i, "p", "STARTED", shard.doc_count,
+                             self.node_name])
+        return self._cat_table(rows, ["index", "shard", "prirep", "state",
+                                      "docs", "node"], _flag(params, "v"))
+
+    def h_cat_nodes(self, params, body):
+        return self._cat_table(
+            [["127.0.0.1", "mdi", "*", self.node_name]],
+            ["ip", "node.role", "master", "name"], _flag(params, "v"))
+
+    def h_cat_aliases(self, params, body):
+        rows = []
+        for alias, names in sorted(self.indices.all_aliases().items()):
+            for n in names:
+                rows.append([alias, n, "-", "-", "-", "-"])
+        return self._cat_table(rows, ["alias", "index", "filter",
+                                      "routing.index", "routing.search",
+                                      "is_write_index"], _flag(params, "v"))
+
+    # ------------------------------------------------------------------
+    # index CRUD / admin
+    # ------------------------------------------------------------------
+
+    def _apply_templates(self, name: str, settings: dict,
+                         mappings: dict) -> Tuple[dict, dict]:
+        import fnmatch
+        matching = []
+        for tname, t in self.templates.items():
+            for pat in t.get("index_patterns", []):
+                if fnmatch.fnmatchcase(name, pat):
+                    matching.append((t.get("priority", 0), tname, t))
+                    break
+        merged_settings: dict = {}
+        merged_mappings: dict = {}
+        for _, _, t in sorted(matching, key=lambda x: x[0]):
+            tpl = t.get("template", t)
+            merged_settings.update(tpl.get("settings") or {})
+            props = (tpl.get("mappings") or {}).get("properties") or {}
+            merged_mappings.setdefault("properties", {}).update(props)
+        merged_settings.update(settings or {})
+        if mappings:
+            merged_mappings.setdefault("properties", {}).update(
+                mappings.get("properties") or {})
+            for k, v in mappings.items():
+                if k != "properties":
+                    merged_mappings[k] = v
+        return merged_settings, merged_mappings
+
+    def h_create_index(self, params, body, index):
+        b = _json_body(body)
+        settings, mappings = self._apply_templates(
+            index, b.get("settings") or {}, b.get("mappings") or {})
+        self.indices.create_index(index, settings, mappings,
+                                  b.get("aliases"))
+        return {"acknowledged": True, "shards_acknowledged": True,
+                "index": index}
+
+    def h_delete_index(self, params, body, index):
+        self.indices.delete_index(index)
+        return {"acknowledged": True}
+
+    def h_get_index(self, params, body, index):
+        out = {}
+        for name in self.indices.resolve(index):
+            svc = self.indices.indices[name]
+            out[name] = {
+                "aliases": svc.aliases,
+                "mappings": svc.mapper.mapping_dict(),
+                "settings": {"index": {
+                    "number_of_shards": str(svc.num_shards),
+                    "number_of_replicas": str(svc.num_replicas),
+                    "uuid": svc.uuid,
+                    "creation_date": str(svc.creation_date),
+                    "provided_name": name}},
+            }
+        if not out:
+            raise IndexNotFoundError(f"no such index [{index}]")
+        return out
+
+    def h_mapping(self, params, body, index):
+        names = self.indices.resolve(index)
+        if params.get("__method") == "PUT" or body:
+            b = _json_body(body)
+            for n in names:
+                self.indices.indices[n].put_mapping(b)
+            return {"acknowledged": True}
+        return {n: {"mappings": self.indices.indices[n].mapper.mapping_dict()}
+                for n in names}
+
+    def h_settings(self, params, body, index=None):
+        names = self.indices.resolve(index)
+        if body:
+            b = _json_body(body)
+            for n in names:
+                self.indices.indices[n].update_settings(
+                    b.get("settings", b))
+            return {"acknowledged": True}
+        out = {}
+        for n in names:
+            svc = self.indices.indices[n]
+            out[n] = {"settings": {"index": {
+                "number_of_shards": str(svc.num_shards),
+                "number_of_replicas": str(svc.num_replicas),
+                "uuid": svc.uuid}}}
+        return out
+
+    def h_refresh(self, params, body, index=None):
+        names = self.indices.resolve(index)
+        for n in names:
+            self.indices.indices[n].refresh()
+        return {"_shards": {"total": len(names), "successful": len(names),
+                            "failed": 0}}
+
+    def h_flush(self, params, body, index=None):
+        names = self.indices.resolve(index)
+        for n in names:
+            self.indices.indices[n].flush()
+        return {"_shards": {"total": len(names), "successful": len(names),
+                            "failed": 0}}
+
+    def h_forcemerge(self, params, body, index):
+        for n in self.indices.resolve(index):
+            self.indices.indices[n].force_merge()
+        return {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def h_stats(self, params, body, index=None):
+        names = self.indices.resolve(index)
+        per_index = {n: {"primaries": self.indices.indices[n].stats(),
+                         "total": self.indices.indices[n].stats()}
+                     for n in names}
+        agg: Dict[str, Any] = {"docs": {"count": 0, "deleted": 0},
+                               "store": {"size_in_bytes": 0}}
+        for n in names:
+            st = per_index[n]["primaries"]
+            agg["docs"]["count"] += st["docs"]["count"]
+            agg["docs"]["deleted"] += st["docs"]["deleted"]
+            agg["store"]["size_in_bytes"] += st["store"]["size_in_bytes"]
+        return {"_shards": {"total": sum(
+            self.indices.indices[n].num_shards for n in names),
+            "successful": sum(self.indices.indices[n].num_shards
+                              for n in names), "failed": 0},
+            "_all": {"primaries": agg, "total": agg},
+            "indices": per_index}
+
+    # ------------------------------------------------------------------
+    # aliases / templates
+    # ------------------------------------------------------------------
+
+    def h_update_aliases(self, params, body):
+        b = _json_body(body)
+        for action in b.get("actions", []):
+            (verb, spec), = action.items()
+            idx_names = self.indices.resolve(
+                spec.get("index") or ",".join(spec.get("indices", [])),
+                allow_aliases=False)
+            aliases = spec.get("aliases") or [spec.get("alias")]
+            for n in idx_names:
+                svc = self.indices.indices[n]
+                for a in aliases:
+                    if verb == "add":
+                        svc.aliases[a] = {k: v for k, v in spec.items()
+                                          if k in ("filter", "routing")}
+                    elif verb == "remove":
+                        svc.aliases.pop(a, None)
+                    elif verb == "remove_index":
+                        pass
+                    else:
+                        raise IllegalArgumentError(
+                            f"unknown alias action [{verb}]")
+        return {"acknowledged": True}
+
+    def h_get_alias(self, params, body, index=None, name=None):
+        out: Dict[str, dict] = {}
+        for n in self.indices.resolve(index):
+            svc = self.indices.indices[n]
+            aliases = svc.aliases
+            if name is not None:
+                import fnmatch
+                aliases = {a: s for a, s in aliases.items()
+                           if fnmatch.fnmatchcase(a, name)}
+                if not aliases:
+                    continue
+            out[n] = {"aliases": aliases}
+        if name is not None and not out:
+            return 404, {"error": f"alias [{name}] missing", "status": 404}
+        return out
+
+    def h_put_alias(self, params, body, index, name):
+        for n in self.indices.resolve(index, allow_aliases=False):
+            self.indices.indices[n].aliases[name] = _json_body(body)
+        return {"acknowledged": True}
+
+    def h_delete_alias(self, params, body, index, name):
+        for n in self.indices.resolve(index, allow_aliases=False):
+            self.indices.indices[n].aliases.pop(name, None)
+        return {"acknowledged": True}
+
+    def h_put_template(self, params, body, name):
+        b = _json_body(body)
+        if "index_patterns" not in b:
+            raise IllegalArgumentError(
+                "index template requires [index_patterns]")
+        if isinstance(b["index_patterns"], str):
+            b["index_patterns"] = [b["index_patterns"]]
+        self.templates[name] = b
+        return {"acknowledged": True}
+
+    def h_get_template(self, params, body, name=None):
+        if name is None:
+            return {"index_templates": [
+                {"name": n, "index_template": t}
+                for n, t in self.templates.items()]}
+        import fnmatch
+        matched = {n: t for n, t in self.templates.items()
+                   if fnmatch.fnmatchcase(n, name)}
+        if not matched:
+            return 404, {"error": f"index template matching [{name}] not "
+                                  f"found", "status": 404}
+        return {"index_templates": [{"name": n, "index_template": t}
+                                    for n, t in matched.items()]}
+
+    def h_delete_template(self, params, body, name):
+        if name not in self.templates:
+            return 404, {"error": f"index template [{name}] missing",
+                         "status": 404}
+        del self.templates[name]
+        return {"acknowledged": True}
+
+    # ------------------------------------------------------------------
+    # documents
+    # ------------------------------------------------------------------
+
+    def _doc_response(self, index: str, result, op: str) -> dict:
+        return {"_index": index, "_id": result.doc_id,
+                "_version": result.version,
+                "result": op,
+                "_shards": {"total": 1, "successful": 1, "failed": 0},
+                "_seq_no": result.seq_no, "_primary_term": 1}
+
+    def h_index_doc(self, params, body, index, id):
+        svc = self._get_or_autocreate(index)
+        op_type = params.get("op_type", "index")
+        r = svc.index_doc(id, _json_body(body),
+                          routing=params.get("routing"), op_type=op_type,
+                          if_seq_no=_int_or_none(params.get("if_seq_no")),
+                          if_primary_term=_int_or_none(
+                              params.get("if_primary_term")))
+        if params.get("refresh") in ("true", "wait_for", ""):
+            svc.refresh()
+        return (201 if r.created else 200), self._doc_response(
+            index, r, "created" if r.created else "updated")
+
+    def h_index_doc_auto(self, params, body, index):
+        return self.h_index_doc(params, body, index, uuid.uuid4().hex[:20])
+
+    def h_create_doc(self, params, body, index, id):
+        params = dict(params, op_type="create")
+        return self.h_index_doc(params, body, index, id)
+
+    def h_get_doc(self, params, body, index, id):
+        svc = self.indices.get(index)
+        r = svc.get_doc(id, routing=params.get("routing"))
+        if not r.found:
+            return 404, {"_index": index, "_id": id, "found": False}
+        return {"_index": index, "_id": id, "_version": r.version,
+                "_seq_no": r.seq_no, "_primary_term": 1, "found": True,
+                "_source": r.source}
+
+    def h_get_source(self, params, body, index, id):
+        svc = self.indices.get(index)
+        r = svc.get_doc(id, routing=params.get("routing"))
+        if not r.found:
+            return 404, {"error": f"document [{id}] missing", "status": 404}
+        return r.source
+
+    def h_delete_doc(self, params, body, index, id):
+        svc = self.indices.get(index)
+        r = svc.delete_doc(id, routing=params.get("routing"),
+                           if_seq_no=_int_or_none(params.get("if_seq_no")),
+                           if_primary_term=_int_or_none(
+                               params.get("if_primary_term")))
+        if params.get("refresh") in ("true", "wait_for", ""):
+            svc.refresh()
+        if not r.found:
+            return 404, self._doc_response(index, r, "not_found")
+        return self._doc_response(index, r, "deleted")
+
+    def h_update_doc(self, params, body, index, id):
+        svc = self.indices.get(index)
+        b = _json_body(body)
+        existing = svc.get_doc(id, routing=params.get("routing"))
+        if not existing.found:
+            if "upsert" in b:
+                r = svc.index_doc(id, b["upsert"],
+                                  routing=params.get("routing"))
+                return 201, self._doc_response(index, r, "created")
+            if b.get("doc_as_upsert") and "doc" in b:
+                r = svc.index_doc(id, b["doc"],
+                                  routing=params.get("routing"))
+                return 201, self._doc_response(index, r, "created")
+            raise DocumentMissingError(f"[{id}]: document missing")
+        if "doc" in b:
+            merged = _deep_merge(dict(existing.source or {}), b["doc"])
+            if b.get("detect_noop", True) and merged == existing.source:
+                return {"_index": index, "_id": id,
+                        "_version": existing.version, "result": "noop",
+                        "_shards": {"total": 0, "successful": 0,
+                                    "failed": 0}}
+            r = svc.index_doc(id, merged, routing=params.get("routing"))
+            if params.get("refresh") in ("true", "wait_for", ""):
+                svc.refresh()
+            return self._doc_response(index, r, "updated")
+        if "script" in b:
+            src = dict(existing.source or {})
+            script = b["script"]
+            source = script.get("source") if isinstance(script, dict) \
+                else script
+            ctx_params = (script.get("params", {})
+                          if isinstance(script, dict) else {})
+            new_src = _apply_update_script(src, source, ctx_params)
+            r = svc.index_doc(id, new_src, routing=params.get("routing"))
+            return self._doc_response(index, r, "updated")
+        raise IllegalArgumentError(
+            "update requires [doc], [script], or [upsert]")
+
+    def h_mget(self, params, body, index=None):
+        b = _json_body(body)
+        out = []
+        if "docs" in b:
+            entries = b["docs"]
+        else:
+            entries = [{"_id": i} for i in b.get("ids", [])]
+        for e in entries:
+            idx = e.get("_index", index)
+            if idx is None:
+                raise IllegalArgumentError("mget requires an index per doc")
+            try:
+                svc = self.indices.get(idx)
+                r = svc.get_doc(e["_id"], routing=e.get("routing"))
+            except IndexNotFoundError:
+                out.append({"_index": idx, "_id": e["_id"], "found": False})
+                continue
+            if r.found:
+                out.append({"_index": idx, "_id": e["_id"],
+                            "_version": r.version, "found": True,
+                            "_source": r.source})
+            else:
+                out.append({"_index": idx, "_id": e["_id"], "found": False})
+        return {"docs": out}
+
+    def _get_or_autocreate(self, index: str) -> IndexService:
+        try:
+            return self.indices.get(index)
+        except IndexNotFoundError:
+            settings, mappings = self._apply_templates(index, {}, {})
+            return self.indices.create_index(index, settings, mappings)
+
+    # ------------------------------------------------------------------
+    # bulk
+    # ------------------------------------------------------------------
+
+    def h_bulk(self, params, body, index=None):
+        t0 = time.time()
+        lines = body.split(b"\n")
+        items = []
+        errors = False
+        i = 0
+        touched: set = set()
+        while i < len(lines):
+            line = lines[i].strip()
+            i += 1
+            if not line:
+                continue
+            try:
+                action = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ParsingError(f"Malformed action/metadata line: {e}")
+            (verb, meta), = action.items()
+            if verb not in ("index", "create", "delete", "update"):
+                raise IllegalArgumentError(
+                    f"Malformed action/metadata line, expected one of "
+                    f"[create, delete, index, update] but found [{verb}]")
+            idx = meta.get("_index", index)
+            if idx is None:
+                raise IllegalArgumentError("bulk item requires _index")
+            doc_id = meta.get("_id") or uuid.uuid4().hex[:20]
+            source = None
+            if verb != "delete":
+                if i >= len(lines):
+                    raise ParsingError("bulk body truncated")
+                source = json.loads(lines[i])
+                i += 1
+            try:
+                svc = self._get_or_autocreate(idx)
+                touched.add(idx)
+                if verb == "delete":
+                    r = svc.delete_doc(doc_id, routing=meta.get("routing"))
+                    items.append({"delete": dict(
+                        self._doc_response(idx, r, "deleted" if r.found
+                                           else "not_found"),
+                        status=200 if r.found else 404)})
+                elif verb == "update":
+                    status, resp = self.h_update_doc(
+                        {"routing": meta.get("routing")} if
+                        meta.get("routing") else {},
+                        json.dumps(source).encode(), idx, doc_id) \
+                        if isinstance(self.h_update_doc(
+                            {}, json.dumps(source).encode(), idx, doc_id),
+                            tuple) else (200, None)
+                    items.append({"update": dict(resp or {}, status=status)})
+                else:
+                    r = svc.index_doc(doc_id, source,
+                                      routing=meta.get("routing"),
+                                      op_type=("create" if verb == "create"
+                                               else "index"))
+                    items.append({verb: dict(
+                        self._doc_response(idx, r, "created" if r.created
+                                           else "updated"),
+                        status=201 if r.created else 200)})
+            except ElasticsearchError as e:
+                errors = True
+                status, payload = _error_payload(e)
+                items.append({verb: {"_index": idx, "_id": doc_id,
+                                     "status": status,
+                                     "error": payload["error"]}})
+        if params.get("refresh") in ("true", "wait_for", ""):
+            for idx in touched:
+                self.indices.get(idx).refresh()
+        return {"took": int((time.time() - t0) * 1000), "errors": errors,
+                "items": items}
+
+    # ------------------------------------------------------------------
+    # search
+    # ------------------------------------------------------------------
+
+    def _hit_json(self, index_name: str, h: ShardHit) -> dict:
+        out = {"_index": index_name, "_id": h.doc_id,
+               "_score": h.score, "_source": h.source}
+        if h.sort_values is not None:
+            out["sort"] = h.sort_values
+        if h.fields:
+            out["fields"] = h.fields
+        if h.highlight:
+            out["highlight"] = h.highlight
+        return out
+
+    def _search_indices(self, names: List[str], search_body: dict) -> dict:
+        t0 = time.time()
+        size = int(search_body.get("size", 10))
+        from_ = int(search_body.get("from", 0))
+        results = []
+        window_body = dict(search_body)
+        window_body["size"] = size + from_
+        window_body["from"] = 0
+        for n in names:
+            svc = self.indices.indices[n]
+            results.append((n, svc.search(window_body)))
+        total = sum(r.total for _, r in results)
+        relation = "eq"
+        if any(r.total_relation == "gte" for _, r in results):
+            relation = "gte"
+        max_scores = [r.max_score for _, r in results
+                      if r.max_score is not None]
+        all_hits = [(n, h) for n, r in results for h in r.hits]
+        if search_body.get("sort") and not _sort_is_score(
+                search_body.get("sort")):
+            all_hits.sort(key=lambda nh: _sort_key_tuple(nh[1]))
+        else:
+            all_hits.sort(key=lambda nh: (
+                -(nh[1].score if nh[1].score is not None else float("-inf")),
+                nh[0], nh[1].doc_id))
+        page = all_hits[from_: from_ + size]
+        aggregations = None
+        if len(names) == 1:
+            aggregations = results[0][1].aggregations
+        elif any(r.aggregations for _, r in results):
+            # cross-index agg reduce: re-run with partial collection
+            aggregations = self._reduce_cross_index_aggs(
+                names, search_body)
+        shards_total = sum(self.indices.indices[n].num_shards for n in names)
+        out = {
+            "took": int((time.time() - t0) * 1000),
+            "timed_out": False,
+            "_shards": {"total": shards_total, "successful": shards_total,
+                        "skipped": 0, "failed": 0},
+            "hits": {
+                "total": {"value": total, "relation": relation},
+                "max_score": max(max_scores) if max_scores else None,
+                "hits": [self._hit_json(n, h) for n, h in page],
+            },
+        }
+        if aggregations is not None:
+            out["aggregations"] = aggregations
+        return out
+
+    def _reduce_cross_index_aggs(self, names: List[str],
+                                 search_body: dict) -> dict:
+        from ..search.aggregations import (AggregationContext, parse_aggs,
+                                           run_aggregations)
+        from ..search.query_dsl import MatchAllQuery, parse_query
+        import numpy as np
+        spec = search_body.get("aggs") or search_body.get("aggregations")
+        aggs = parse_aggs(spec)
+        seg_masks = []
+        ctx0 = None
+        for n in names:
+            svc = self.indices.indices[n]
+            searcher = svc.searcher()
+            if ctx0 is None:
+                ctx0 = AggregationContext(svc.mapper,
+                                          shard_ctx=searcher.ctx)
+            q = (parse_query(search_body["query"])
+                 if search_body.get("query") else MatchAllQuery())
+            for seg in searcher.segments:
+                _, mask = q.execute(searcher.ctx, seg)
+                mask = mask & seg.live_dev
+                seg_masks.append((seg, np.asarray(mask)))
+        return run_aggregations(aggs, ctx0, seg_masks)
+
+    def h_search(self, params, body, index=None):
+        names = self.indices.resolve(index)
+        search_body = _json_body(body)
+        if "q" in params:
+            search_body["query"] = {"query_string": {
+                "query": params["q"]}} if False else _lucene_qs_to_dsl(
+                params["q"])
+        for p in ("size", "from"):
+            if p in params:
+                search_body[p] = int(params[p])
+        if not names:
+            return {"took": 0, "timed_out": False,
+                    "_shards": {"total": 0, "successful": 0, "skipped": 0,
+                                "failed": 0},
+                    "hits": {"total": {"value": 0, "relation": "eq"},
+                             "max_score": None, "hits": []}}
+        scroll = params.get("scroll")
+        if scroll:
+            return self._start_scroll(names, search_body, scroll)
+        return self._search_indices(names, search_body)
+
+    def h_count(self, params, body, index=None):
+        names = self.indices.resolve(index)
+        b = _json_body(body)
+        total = 0
+        for n in names:
+            total += self.indices.indices[n].count(b)
+        return {"count": total,
+                "_shards": {"total": len(names), "successful": len(names),
+                            "skipped": 0, "failed": 0}}
+
+    # -- scroll ---------------------------------------------------------
+
+    SCROLL_MAX_DOCS = 500_000
+
+    def _start_scroll(self, names, search_body, keep_alive) -> dict:
+        size = int(search_body.get("size", 10))
+        big = dict(search_body)
+        big["size"] = self.SCROLL_MAX_DOCS
+        big["from"] = 0
+        all_hits = []
+        for n in names:
+            r = self.indices.indices[n].search(big)
+            all_hits.extend((n, h) for h in r.hits)
+        if search_body.get("sort") and not _sort_is_score(
+                search_body.get("sort")):
+            all_hits.sort(key=lambda nh: _sort_key_tuple(nh[1]))
+        else:
+            all_hits.sort(key=lambda nh: (
+                -(nh[1].score if nh[1].score is not None else float("-inf")),
+                nh[0], nh[1].doc_id))
+        sid = uuid.uuid4().hex
+        self.scrolls[sid] = {"hits": all_hits, "pos": size,
+                             "total": len(all_hits),
+                             "expiry": time.time() + 300}
+        page = all_hits[:size]
+        return {
+            "_scroll_id": sid, "took": 0, "timed_out": False,
+            "_shards": {"total": len(names), "successful": len(names),
+                        "skipped": 0, "failed": 0},
+            "hits": {"total": {"value": len(all_hits), "relation": "eq"},
+                     "max_score": None,
+                     "hits": [self._hit_json(n, h) for n, h in page]}}
+
+    def h_scroll(self, params, body):
+        b = _json_body(body)
+        sid = b.get("scroll_id") or params.get("scroll_id")
+        ctx = self.scrolls.get(sid)
+        if ctx is None:
+            return 404, {"error": {"type": "search_context_missing_exception",
+                                   "reason": f"No search context found for "
+                                             f"id [{sid}]"}, "status": 404}
+        size = 10
+        page = ctx["hits"][ctx["pos"]: ctx["pos"] + size]
+        ctx["pos"] += size
+        return {
+            "_scroll_id": sid, "took": 0, "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "skipped": 0,
+                        "failed": 0},
+            "hits": {"total": {"value": ctx["total"], "relation": "eq"},
+                     "max_score": None,
+                     "hits": [self._hit_json(n, h) for n, h in page]}}
+
+    def h_clear_scroll(self, params, body):
+        b = _json_body(body)
+        ids = b.get("scroll_id", [])
+        if isinstance(ids, str):
+            ids = [ids]
+        n = 0
+        for sid in ids:
+            if self.scrolls.pop(sid, None) is not None:
+                n += 1
+        return {"succeeded": True, "num_freed": n}
+
+    def h_open_pit(self, params, body, index):
+        names = self.indices.resolve(index)
+        pid = uuid.uuid4().hex
+        self.pits[pid] = {"indices": names,
+                          "expiry": time.time() + 300}
+        return {"id": pid}
+
+    def h_close_pit(self, params, body):
+        b = _json_body(body)
+        ok = self.pits.pop(b.get("id"), None) is not None
+        return {"succeeded": ok, "num_freed": 1 if ok else 0}
+
+    # -- by query --------------------------------------------------------
+
+    def _matched_ids(self, svc: IndexService, query: dict) -> List[str]:
+        searcher = svc.searcher()
+        r = searcher.search({"query": query, "size": self.SCROLL_MAX_DOCS,
+                             "_source": False})
+        return [h.doc_id for h in r.hits]
+
+    def h_delete_by_query(self, params, body, index):
+        t0 = time.time()
+        b = _json_body(body)
+        query = b.get("query") or {"match_all": {}}
+        deleted = 0
+        for n in self.indices.resolve(index):
+            svc = self.indices.indices[n]
+            for doc_id in self._matched_ids(svc, query):
+                r = svc.delete_doc(doc_id)
+                if r.found:
+                    deleted += 1
+            svc.refresh()
+        return {"took": int((time.time() - t0) * 1000), "timed_out": False,
+                "deleted": deleted, "total": deleted, "failures": [],
+                "batches": 1, "version_conflicts": 0, "noops": 0,
+                "retries": {"bulk": 0, "search": 0}}
+
+    def h_update_by_query(self, params, body, index):
+        t0 = time.time()
+        b = _json_body(body)
+        query = b.get("query") or {"match_all": {}}
+        script = b.get("script")
+        updated = 0
+        for n in self.indices.resolve(index):
+            svc = self.indices.indices[n]
+            for doc_id in self._matched_ids(svc, query):
+                g = svc.get_doc(doc_id)
+                if not g.found:
+                    continue
+                src = dict(g.source or {})
+                if script:
+                    source = script.get("script") if False else (
+                        script.get("source") if isinstance(script, dict)
+                        else script)
+                    src = _apply_update_script(
+                        src, source, script.get("params", {})
+                        if isinstance(script, dict) else {})
+                svc.index_doc(doc_id, src)
+                updated += 1
+            svc.refresh()
+        return {"took": int((time.time() - t0) * 1000), "timed_out": False,
+                "updated": updated, "total": updated, "failures": [],
+                "batches": 1, "version_conflicts": 0, "noops": 0,
+                "retries": {"bulk": 0, "search": 0}}
+
+    # ------------------------------------------------------------------
+    # analyze / field caps
+    # ------------------------------------------------------------------
+
+    def h_analyze(self, params, body, index=None):
+        b = _json_body(body)
+        text = b.get("text")
+        if text is None:
+            raise IllegalArgumentError("[_analyze] requires [text]")
+        texts = text if isinstance(text, list) else [text]
+        if index is not None and b.get("field"):
+            svc = self.indices.get(index)
+            ft = svc.mapper.field_type(b["field"])
+            analyzer = getattr(ft, "analyzer", None)
+            if analyzer is None:
+                from ..index.analysis import BUILTIN_ANALYZERS
+                analyzer = BUILTIN_ANALYZERS["standard"]
+        else:
+            from ..index.analysis import BUILTIN_ANALYZERS
+            name = b.get("analyzer", "standard")
+            analyzer = BUILTIN_ANALYZERS.get(name)
+            if analyzer is None and index is not None:
+                svc = self.indices.get(index)
+                analyzer = svc.mapper.analysis.get(name)
+            if analyzer is None:
+                raise IllegalArgumentError(
+                    f"failed to find global analyzer [{name}]")
+        tokens = []
+        for ti, t in enumerate(texts):
+            for tok in analyzer.analyze(str(t)):
+                tokens.append({"token": tok.term,
+                               "start_offset": tok.start_offset,
+                               "end_offset": tok.end_offset,
+                               "type": "<ALPHANUM>",
+                               "position": tok.position})
+        return {"tokens": tokens}
+
+    def h_field_caps(self, params, body, index=None):
+        names = self.indices.resolve(index)
+        patterns = (params.get("fields") or
+                    _json_body(body).get("fields") or "*")
+        if isinstance(patterns, str):
+            patterns = patterns.split(",")
+        import fnmatch
+        fields: Dict[str, Dict[str, dict]] = {}
+        for n in names:
+            svc = self.indices.indices[n]
+            for fname in svc.mapper.field_names():
+                if not any(fnmatch.fnmatchcase(fname, p) for p in patterns):
+                    continue
+                ft = svc.mapper.field_type(fname)
+                tname = getattr(ft, "type_name", "object")
+                caps = fields.setdefault(fname, {}).setdefault(tname, {
+                    "type": tname, "metadata_field": False,
+                    "searchable": True, "aggregatable":
+                        getattr(ft, "has_doc_values", False)})
+        return {"indices": names, "fields": fields}
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _int_or_none(v):
+    return int(v) if v is not None else None
+
+
+def _deep_merge(base: dict, patch: dict) -> dict:
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            base[k] = _deep_merge(dict(base[k]), v)
+        else:
+            base[k] = v
+    return base
+
+
+_CTX_ASSIGN_RE = re.compile(
+    r"^\s*ctx\._source\.(\w+)\s*(\+?=)\s*(.+?)\s*;?\s*$")
+
+
+def _apply_update_script(src: dict, source: str, params: dict) -> dict:
+    """Painless-lite update scripts: statements of the form
+    ``ctx._source.field = <expr>`` / ``+=`` with expressions over
+    ``ctx._source.*`` and ``params.*`` (the full Painless engine is the
+    reference's ``modules/lang-painless``; this covers the common
+    counter/set idioms)."""
+    from ..utils.expressions import evaluate_expression
+
+    for stmt in source.split(";"):
+        stmt = stmt.strip()
+        if not stmt:
+            continue
+        m = _CTX_ASSIGN_RE.match(stmt + ("=" if "=" not in stmt else ""))
+        m = _CTX_ASSIGN_RE.match(stmt if stmt.endswith(";") else stmt + ";") \
+            or _CTX_ASSIGN_RE.match(stmt)
+        if m is None:
+            raise IllegalArgumentError(
+                f"unsupported update script statement [{stmt}]")
+        field, op, expr = m.group(1), m.group(2), m.group(3)
+        expr = re.sub(r"ctx\._source\.(\w+)", r"\1", expr)
+        env = {k: v for k, v in src.items()
+               if isinstance(v, (int, float))}
+        env.update({k: v for k, v in params.items()
+                    if isinstance(v, (int, float))})
+        if re.fullmatch(r"'[^']*'|\"[^\"]*\"", expr):
+            val: Any = expr[1:-1]
+        else:
+            val = evaluate_expression(expr, env)
+        if op == "+=":
+            val = src.get(field, 0) + val
+        src[field] = val
+    return src
+
+
+def _lucene_qs_to_dsl(q: str) -> dict:
+    """Tiny subset of the Lucene query-string syntax for ``?q=``:
+    ``field:value`` pairs and bare terms (reference: full parser in
+    ``index/query/QueryStringQueryBuilder``)."""
+    clauses = []
+    for part in q.split():
+        if ":" in part:
+            f, _, v = part.partition(":")
+            clauses.append({"match": {f: v}})
+        else:
+            clauses.append({"multi_match": {"query": part, "fields": ["*"]}})
+    if len(clauses) == 1:
+        return clauses[0]
+    return {"bool": {"must": clauses}}
+
+
+def _sort_is_score(sort_spec) -> bool:
+    if isinstance(sort_spec, (str, dict)):
+        sort_spec = [sort_spec]
+    first = sort_spec[0] if sort_spec else "_score"
+    return first == "_score" or (isinstance(first, dict) and
+                                 "_score" in first)
+
+
+def _sort_key_tuple(h: ShardHit):
+    out = []
+    for v in h.sort_values or []:
+        if v is None:
+            out.append((1, 0))
+        elif isinstance(v, str):
+            out.append((0, v))
+        else:
+            out.append((0, v))
+    return tuple(out)

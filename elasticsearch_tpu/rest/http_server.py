@@ -1,0 +1,141 @@
+"""Minimal asyncio HTTP/1.1 server for the REST layer.
+
+The reference serves HTTP via Netty (``modules/transport-netty4/.../
+Netty4HttpServerTransport.java``) with an in-repo pure-Java NIO alternative
+(``libs/nio``). Here: asyncio streams — an event loop per process, no
+threads in the request path, which matches the single-writer asyncio design
+of the node. Supports keep-alive, Content-Length bodies, and chunked
+transfer decoding (curl/clients use both).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Callable, Optional, Tuple
+
+MAX_BODY = 100 * 1024 * 1024  # reference default http.max_content_length
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, reason: str):
+        self.status = status
+        self.reason = reason
+
+
+_STATUS_TEXT = {200: "OK", 201: "Created", 400: "Bad Request",
+                404: "Not Found", 405: "Method Not Allowed",
+                409: "Conflict", 413: "Payload Too Large",
+                429: "Too Many Requests", 500: "Internal Server Error"}
+
+
+class HttpServer:
+    """handler(method, path, query_string, body_bytes) →
+    (status, content_type, payload_bytes)."""
+
+    def __init__(self, handler: Callable, host: str = "127.0.0.1",
+                 port: int = 9200):
+        self.handler = handler
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve_connection, self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _serve_connection(self, reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    request = await self._read_request(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                if request is None:
+                    break
+                method, target, headers, body = request
+                path, _, query = target.partition("?")
+                try:
+                    status, ctype, payload = await self._dispatch(
+                        method, path, query, body)
+                except HttpError as e:
+                    status, ctype, payload = e.status, "application/json", \
+                        json.dumps({"error": e.reason,
+                                    "status": e.status}).encode()
+                except Exception as e:  # handler bug → 500, keep serving
+                    status, ctype, payload = 500, "application/json", \
+                        json.dumps({"error": {
+                            "type": "exception",
+                            "reason": str(e)}, "status": 500}).encode()
+                keep_alive = headers.get("connection", "").lower() != "close"
+                head = (f"HTTP/1.1 {status} "
+                        f"{_STATUS_TEXT.get(status, 'Unknown')}\r\n"
+                        f"content-type: {ctype}\r\n"
+                        f"content-length: {len(payload)}\r\n"
+                        f"X-elastic-product: Elasticsearch\r\n"
+                        f"connection: "
+                        f"{'keep-alive' if keep_alive else 'close'}\r\n\r\n")
+                writer.write(head.encode() + (b"" if method == "HEAD"
+                                              else payload))
+                await writer.drain()
+                if not keep_alive:
+                    break
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _dispatch(self, method, path, query, body):
+        result = self.handler(method, path, query, body)
+        if asyncio.iscoroutine(result):
+            result = await result
+        return result
+
+    async def _read_request(self, reader: asyncio.StreamReader):
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except asyncio.IncompleteReadError as e:
+            if not e.partial:
+                return None
+            raise
+        lines = head.decode("latin-1").split("\r\n")
+        try:
+            method, target, _version = lines[0].split(" ", 2)
+        except ValueError:
+            raise HttpError(400, "malformed request line")
+        headers = {}
+        for line in lines[1:]:
+            if not line:
+                continue
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        body = b""
+        if headers.get("transfer-encoding", "").lower() == "chunked":
+            chunks = []
+            total = 0
+            while True:
+                size_line = await reader.readuntil(b"\r\n")
+                size = int(size_line.strip() or b"0", 16)
+                if size == 0:
+                    await reader.readuntil(b"\r\n")
+                    break
+                total += size
+                if total > MAX_BODY:
+                    raise HttpError(413, "content length exceeded")
+                chunks.append(await reader.readexactly(size))
+                await reader.readexactly(2)
+            body = b"".join(chunks)
+        elif "content-length" in headers:
+            n = int(headers["content-length"])
+            if n > MAX_BODY:
+                raise HttpError(413, "content length exceeded")
+            body = await reader.readexactly(n)
+        return method.upper(), target, headers, body
