@@ -13,6 +13,8 @@ for Lucene's BulkScorer loop which is not available in this image).
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
@@ -69,6 +71,42 @@ def cpu_bm25_search(corpus, batches, k):
     return time.perf_counter() - t0, out
 
 
+def _init_jax_backend(retries: int = 3, backoff_s: float = 10.0):
+    """Initialize the accelerator backend, retrying transient failures.
+
+    Round-1 bench died inside ``jax.devices()`` with a transient "TPU backend
+    setup/compile error" and produced no number at all. Retry with backoff;
+    if the accelerator never comes up, fall back to CPU so the bench still
+    emits a (clearly labeled) measurement instead of exiting nonzero.
+    """
+    import jax
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # local/dev runs: the ambient sitecustomize registers the accelerator
+        # backend and env vars alone can't override it — go through jax.config
+        jax.config.update("jax_platforms", "cpu")
+    last = None
+    for attempt in range(retries):
+        try:
+            devs = jax.devices()
+            print(f"# jax backend: {devs[0].platform} x{len(devs)}",
+                  file=sys.stderr)
+            return jax
+        except Exception as e:  # backend init is the only thing that throws
+            last = e
+            print(f"# backend init attempt {attempt + 1}/{retries} failed: "
+                  f"{e}", file=sys.stderr)
+            if attempt + 1 < retries:
+                time.sleep(backoff_s)
+    print(f"# falling back to CPU after {retries} failures: {last}",
+          file=sys.stderr)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+        return jax
+    except Exception as e:
+        raise SystemExit(f"no usable jax backend: {e}") from e
+
+
 def main():
     rng = np.random.RandomState(1234)
     corpus = build_corpus(rng)
@@ -80,7 +118,7 @@ def main():
     cpu_qps = (2 * BATCH) / cpu_s
 
     # ---- TPU --------------------------------------------------------------
-    import jax
+    jax = _init_jax_backend()
     from elasticsearch_tpu.parallel import DistributedSearchPlane, make_search_mesh
 
     n_dev = len(jax.devices())
@@ -104,6 +142,8 @@ def main():
         "value": round(tpu_qps, 1),
         "unit": "queries/s",
         "vs_baseline": round(tpu_qps / cpu_qps, 2),
+        # a CPU-fallback run must be distinguishable from a real TPU result
+        "backend": jax.devices()[0].platform,
     }))
 
 
