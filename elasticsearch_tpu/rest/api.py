@@ -80,8 +80,13 @@ class RestAPI:
 
     def _add(self, methods: str, pattern: str, fn: Callable) -> None:
         names = re.findall(r"\{(\w+)\}", pattern)
-        rx = re.compile("^" + re.sub(
-            r"\{\w+\}", r"([^/]+)", pattern) + "$")
+        body = re.sub(r"\{\w+\}", r"([^/]+)", pattern)
+        if pattern.startswith("/{"):
+            # a leading {index} placeholder must not swallow unknown _api
+            # paths (ES: "no handler found", 400 — RestController.java:196);
+            # _-prefixed names are reserved — except the _all expression
+            body = body.replace("([^/]+)", "((?:_all|(?!_)[^/]+))", 1)
+        rx = re.compile("^" + body + "$")
         for m in methods.split(","):
             self._routes.append((m, rx, names, fn))
 
@@ -757,13 +762,12 @@ class RestAPI:
                                            else "not_found"),
                         status=200 if r.found else 404)})
                 elif verb == "update":
-                    status, resp = self.h_update_doc(
-                        {"routing": meta.get("routing")} if
-                        meta.get("routing") else {},
-                        json.dumps(source).encode(), idx, doc_id) \
-                        if isinstance(self.h_update_doc(
-                            {}, json.dumps(source).encode(), idx, doc_id),
-                            tuple) else (200, None)
+                    up_params = ({"routing": meta.get("routing")}
+                                 if meta.get("routing") else {})
+                    r = self.h_update_doc(up_params,
+                                          json.dumps(source).encode(),
+                                          idx, doc_id)
+                    status, resp = r if isinstance(r, tuple) else (200, r)
                     items.append({"update": dict(resp or {}, status=status)})
                 else:
                     r = svc.index_doc(doc_id, source,
@@ -801,6 +805,12 @@ class RestAPI:
             out["highlight"] = h.highlight
         return out
 
+    # score-path search_after cursors are [score, shard_doc]; across indices
+    # the shard_doc is made globally unique by folding the index ordinal into
+    # the high bits (ES: PIT's implicit _shard_doc is likewise a global
+    # shard-ordinal << 32 | doc)
+    _GSD_ORD_SHIFT = 52
+
     def _search_indices(self, names: List[str], search_body: dict) -> dict:
         t0 = time.time()
         size = int(search_body.get("size", 10))
@@ -809,9 +819,29 @@ class RestAPI:
         window_body = dict(search_body)
         window_body["size"] = size + from_
         window_body["from"] = 0
+        score_sorted = not (search_body.get("sort") and not _sort_is_score(
+            search_body.get("sort")))
+        sa = search_body.get("search_after")
+        ord_of = {n: i for i, n in enumerate(names)}
         for n in names:
+            body_n = window_body
+            if score_sorted and sa is not None and len(sa) > 1 \
+                    and len(names) > 1:
+                # translate the global cursor into this index's local one:
+                # ties in earlier indices sort before the cursor, later
+                # indices after it
+                gsd = int(sa[1])
+                a_ord = gsd >> self._GSD_ORD_SHIFT
+                local = gsd & ((1 << self._GSD_ORD_SHIFT) - 1)
+                body_n = dict(window_body)
+                if a_ord == ord_of[n]:
+                    body_n["search_after"] = [sa[0], local]
+                elif a_ord < ord_of[n]:
+                    body_n["search_after"] = [sa[0], -1]  # include all ties
+                else:
+                    body_n["search_after"] = [sa[0]]      # exclude all ties
             svc = self.indices.indices[n]
-            results.append((n, svc.search(window_body)))
+            results.append((n, svc.search(body_n)))
         total = sum(r.total for _, r in results)
         relation = "eq"
         if any(r.total_relation == "gte" for _, r in results):
@@ -819,13 +849,24 @@ class RestAPI:
         max_scores = [r.max_score for _, r in results
                       if r.max_score is not None]
         all_hits = [(n, h) for n, r in results for h in r.hits]
-        if search_body.get("sort") and not _sort_is_score(
-                search_body.get("sort")):
+        if not score_sorted:
             all_hits.sort(key=lambda nh: _sort_key_tuple(nh[1]))
         else:
-            all_hits.sort(key=lambda nh: (
-                -(nh[1].score if nh[1].score is not None else float("-inf")),
-                nh[0], nh[1].doc_id))
+            # tie order MUST match the shards' (score desc, shard_doc asc)
+            # cursor order or pagination duplicates/skips tied docs
+            def _skey(nh):
+                n, h = nh
+                sd = (h.sort_values[1]
+                      if h.sort_values and len(h.sort_values) > 1 else 0)
+                return (-(h.score if h.score is not None else float("-inf")),
+                        ord_of[n], sd)
+            all_hits.sort(key=_skey)
+            for n, h in all_hits:
+                if h.sort_values is not None and len(h.sort_values) > 1:
+                    h.sort_values = [
+                        h.sort_values[0],
+                        (ord_of[n] << self._GSD_ORD_SHIFT)
+                        | int(h.sort_values[1])]
         page = all_hits[from_: from_ + size]
         aggregations = None
         if len(names) == 1:
@@ -853,26 +894,25 @@ class RestAPI:
     def _reduce_cross_index_aggs(self, names: List[str],
                                  search_body: dict) -> dict:
         from ..search.aggregations import (AggregationContext, parse_aggs,
-                                           run_aggregations)
+                                           run_aggregations_multi)
         from ..search.query_dsl import MatchAllQuery, parse_query
         import numpy as np
         spec = search_body.get("aggs") or search_body.get("aggregations")
         aggs = parse_aggs(spec)
-        seg_masks = []
-        ctx0 = None
+        ctx_seg_masks = []
         for n in names:
             svc = self.indices.indices[n]
             searcher = svc.searcher()
-            if ctx0 is None:
-                ctx0 = AggregationContext(svc.mapper,
-                                          shard_ctx=searcher.ctx)
+            # per-index context: sub-queries and field-type decisions must
+            # see THIS index's mapping and term statistics
+            ctx = AggregationContext(svc.mapper, shard_ctx=searcher.ctx)
             q = (parse_query(search_body["query"])
                  if search_body.get("query") else MatchAllQuery())
             for seg in searcher.segments:
                 _, mask = q.execute(searcher.ctx, seg)
                 mask = mask & seg.live_dev
-                seg_masks.append((seg, np.asarray(mask)))
-        return run_aggregations(aggs, ctx0, seg_masks)
+                ctx_seg_masks.append((ctx, seg, np.asarray(mask)))
+        return run_aggregations_multi(aggs, ctx_seg_masks)
 
     def h_search(self, params, body, index=None):
         names = self.indices.resolve(index)
@@ -926,7 +966,7 @@ class RestAPI:
                 -(nh[1].score if nh[1].score is not None else float("-inf")),
                 nh[0], nh[1].doc_id))
         sid = uuid.uuid4().hex
-        self.scrolls[sid] = {"hits": all_hits, "pos": size,
+        self.scrolls[sid] = {"hits": all_hits, "pos": size, "size": size,
                              "total": len(all_hits),
                              "expiry": time.time() + 300}
         page = all_hits[:size]
@@ -946,7 +986,7 @@ class RestAPI:
             return 404, {"error": {"type": "search_context_missing_exception",
                                    "reason": f"No search context found for "
                                              f"id [{sid}]"}, "status": 404}
-        size = 10
+        size = ctx.get("size", 10)
         page = ctx["hits"][ctx["pos"]: ctx["pos"] + size]
         ctx["pos"] += size
         return {

@@ -149,13 +149,26 @@ def run_aggregations(aggs: Dict[str, Aggregator], ctx: AggregationContext,
                      seg_masks: List[Tuple[Segment, np.ndarray]]) -> dict:
     """Collect every segment then reduce — shard-level entry point.
     Pipeline aggs run last, over their sibling's reduced output."""
+    return run_aggregations_multi(
+        aggs, [(ctx, seg, mask) for seg, mask in seg_masks])
+
+
+def run_aggregations_multi(
+        aggs: Dict[str, Aggregator],
+        ctx_seg_masks: List[Tuple[AggregationContext, Segment, np.ndarray]],
+) -> dict:
+    """Cross-index entry point: each segment collects under its *own*
+    index's context (mapper + term stats), then one shared reduce — the
+    reference reduces per-shard trees the same way
+    (``SearchPhaseController.java:211-219``)."""
     result: Dict[str, dict] = {}
     pipelines: Dict[str, PipelineAggregator] = {}
     for name, agg in aggs.items():
         if isinstance(agg, PipelineAggregator):
             pipelines[name] = agg
             continue
-        partials = [agg.collect(ctx, seg, mask) for seg, mask in seg_masks]
+        partials = [agg.collect(ctx, seg, mask)
+                    for ctx, seg, mask in ctx_seg_masks]
         result[name] = agg.reduce(partials)
     for name, p in pipelines.items():
         result[name] = p.apply(result)
@@ -546,39 +559,54 @@ class TermsAgg(BucketAggregator):
         self.missing = body.get("missing")
 
     def collect(self, ctx, seg, mask):
+        """Per-segment partial: ``(buckets, trunc_err)``. Without sub-aggs,
+        counts are exact for every distinct term (vectorized unique/counts —
+        no cap needed). With sub-aggs, each term costs a full bucket mask, so
+        collection is capped at shard_size ranked by segment-local count and
+        ``trunc_err`` carries the last kept count — the upper bound on what a
+        dropped term could have had (reference:
+        ``InternalTerms.java`` docCountError accounting)."""
         buckets: Dict[Any, Tuple[int, dict]] = {}
+        trunc_err = 0
         kw = _keyword_pairs(seg, self.field)
         if kw is not None:
             docs, ords, terms = kw
             pm = mask[docs]
             sel_ords, counts = np.unique(ords[pm], return_counts=True)
-            # rank by count on this segment; keep generous shard_size
-            top = np.argsort(-counts, kind="stable")[: self.shard_size * 2]
-            for i in top:
-                o = int(sel_ords[i])
-                key = terms[o]
-                if self.subs:
+            if self.subs:
+                order = np.argsort(-counts, kind="stable")
+                if order.size > self.shard_size:
+                    trunc_err = int(counts[order[self.shard_size - 1]])
+                    order = order[: self.shard_size]
+                for i in order:
+                    o = int(sel_ords[i])
                     bucket_docs = np.zeros(mask.shape[0], bool)
                     bucket_docs[docs[pm & (ords == o)]] = True
-                    buckets[key] = _bucket_payload(self, ctx, seg,
-                                                  mask & bucket_docs)
-                else:
-                    buckets[key] = (int(counts[i]), {})
+                    buckets[terms[o]] = _bucket_payload(self, ctx, seg,
+                                                        mask & bucket_docs)
+            else:
+                for i, c in zip(sel_ords.tolist(), counts.tolist()):
+                    buckets[terms[i]] = (c, {})
         else:
             num = _numeric_pairs(seg, self.field)
             if num is not None:
                 docs, vals = num
                 pm = mask[docs]
                 sel_vals, counts = np.unique(vals[pm], return_counts=True)
-                for v, c in zip(sel_vals, counts):
-                    key = v
-                    if self.subs:
+                if self.subs:
+                    order = np.argsort(-counts, kind="stable")
+                    if order.size > self.shard_size:
+                        trunc_err = int(counts[order[self.shard_size - 1]])
+                        order = order[: self.shard_size]
+                    for i in order:
+                        v = sel_vals[i]
                         bucket_docs = np.zeros(mask.shape[0], bool)
                         bucket_docs[docs[pm & (vals == v)]] = True
-                        buckets[key] = _bucket_payload(self, ctx, seg,
-                                                      mask & bucket_docs)
-                    else:
-                        buckets[key] = (int(c), {})
+                        buckets[v] = _bucket_payload(self, ctx, seg,
+                                                     mask & bucket_docs)
+                else:
+                    for v, c in zip(sel_vals.tolist(), counts.tolist()):
+                        buckets[v] = (c, {})
         if self.missing is not None:
             has = np.zeros(mask.shape[0], bool)
             if kw is not None:
@@ -590,7 +618,7 @@ class TermsAgg(BucketAggregator):
                 buckets[self.missing] = _bucket_payload(
                     self, ctx, seg, miss_mask) if self.subs else \
                     (int(miss_mask.sum()), {})
-        return buckets
+        return buckets, trunc_err
 
     def _sort_key(self, ctx=None):
         ((field, direction),) = list(self.order.items())[:1] or \
@@ -600,8 +628,11 @@ class TermsAgg(BucketAggregator):
 
     def reduce(self, partials):
         merged: Dict[Any, List] = {}
+        err_bound = 0
         for p in partials:
-            for key, (count, subs) in p.items():
+            bkts, trunc_err = p
+            err_bound += trunc_err
+            for key, (count, subs) in bkts.items():
                 merged.setdefault(key, []).append((count, subs))
         rows = []
         for key, items in merged.items():
@@ -637,7 +668,7 @@ class TermsAgg(BucketAggregator):
                 b["key"] = int(key)
             b.update(subs)
             out_buckets.append(b)
-        return {"doc_count_error_upper_bound": 0,
+        return {"doc_count_error_upper_bound": err_bound,
                 "sum_other_doc_count": total_other,
                 "buckets": out_buckets}
 

@@ -251,9 +251,31 @@ class ShardSearcher:
             vals_dev = idx_dev = None
             # the sort path needs the query top-k only to combine with knn
             if window > 0 and (not use_field_sort or knn_spec):
+                # push the search_after cursor into the selection mask so
+                # the per-segment top-k window starts AFTER the cursor —
+                # otherwise docs tied on score beyond the global top-k are
+                # unreachable on later pages (totals/aggs keep the full mask)
+                sel_mask = mask
+                if search_after is not None and not use_field_sort \
+                        and not knn_spec:
+                    a_sc = jnp.float32(float(search_after[0]))
+                    if len(search_after) > 1:
+                        asd = int(search_after[1])
+                        a_si, a_d = asd >> 32, asd & 0xFFFFFFFF
+                        if seg_idx < a_si:
+                            cond = scores < a_sc
+                        elif seg_idx == a_si:
+                            cond = (scores < a_sc) | (
+                                (scores == a_sc) &
+                                (jnp.arange(seg.n_pad) > a_d))
+                        else:
+                            cond = scores <= a_sc
+                    else:
+                        cond = scores < a_sc
+                    sel_mask = mask & cond
                 kk = min(max(window, 1), seg.n_pad)
                 topk = get_topk_kernel(seg.n_pad, kk)
-                vals_dev, idx_dev = topk(scores, mask)
+                vals_dev, idx_dev = topk(scores, sel_mask)
             pending.append((seg_idx, count_dev, vals_dev, idx_dev))
             if aggs is not None:
                 agg_pending.append((seg, mask, scores))
@@ -337,11 +359,24 @@ class ShardSearcher:
             if candidates:
                 max_score = candidates[0][0]
             if search_after is not None:
-                # search_after on _score desc
+                # search_after on _score desc. Hits carry a [score,
+                # shard_doc] composite cursor (mirroring ES's implicit
+                # _shard_doc tiebreak under PIT); when the client passes it
+                # back, docs tied on score paginate correctly instead of
+                # being skipped by a bare strict-< filter.
                 after = float(search_after[0])
-                candidates = [c for c in candidates if c[0] < after]
-            page = [(float(sc), si, d, None) for sc, si, d in
-                    candidates[from_: from_ + size]]
+                if len(search_after) > 1:
+                    after_sd = int(search_after[1])
+                    candidates = [
+                        c for c in candidates
+                        if c[0] < after or
+                        (c[0] == after and self._shard_doc(c[1], c[2])
+                         > after_sd)]
+                else:
+                    candidates = [c for c in candidates if c[0] < after]
+            page = [(float(sc), si, d,
+                     [float(sc), self._shard_doc(si, d)])
+                    for sc, si, d in candidates[from_: from_ + size]]
         total_relation = "eq"
         if track_total_hits is False:
             total = len(page) if use_field_sort else len(candidates)
@@ -386,6 +421,11 @@ class ShardSearcher:
         return ShardSearchResult(total=total, total_relation=total_relation,
                                  hits=hits, max_score=max_score,
                                  aggregations=agg_results)
+
+    @staticmethod
+    def _shard_doc(seg_idx: int, doc: int) -> int:
+        """Stable tiebreak key over (segment, doc) — ES's ``_shard_doc``."""
+        return (seg_idx << 32) | doc
 
     def _field_sorted_page(self, sort_spec, search_after, host_masks,
                            host_scores, k):
