@@ -36,10 +36,12 @@ from ..common.errors import DocumentMissingError, VersionConflictError
 from .mapping import MapperService
 from .segment import Segment, SegmentBuilder
 from .seqno import LocalCheckpointTracker, NO_OPS_PERFORMED
+from .store import (apply_liveness_sidecar, load_segment, merge_segments,
+                    save_liveness, save_segment, segment_file_names)
 from .translog import (OP_DELETE, OP_INDEX, OP_NOOP, Translog, TranslogOp)
 
 
-@dataclass
+@dataclass(slots=True)
 class VersionValue:
     version: int
     seq_no: int
@@ -143,33 +145,44 @@ class Engine:
         mapping = commit.get("mapping")
         if mapping:
             self.mapper.merge(mapping)
+        # fast-forward to the committed checkpoint up front so the per-doc
+        # seq-no accounting below is vectorized (only seq-nos ABOVE the
+        # checkpoint need individual marking — persisted ops at or below it
+        # are contiguous by definition of the safe commit)
+        committed_ckpt = commit.get("local_checkpoint", NO_OPS_PERFORMED)
+        self.tracker.fast_forward(committed_ckpt)
         for seg_file in commit["segments"]:
-            with gzip.open(os.path.join(self.store_dir, seg_file), "rt") as f:
-                data = json.load(f)
-            builder = SegmentBuilder(data["seg_id"])
-            for uid, source, seq_no, live, routing in zip(
-                    data["doc_uids"], data["sources"], data["seq_nos"],
-                    data["live"], data["routing"]):
-                parsed = self.mapper.parse_document(uid, source, routing)
-                local = builder.add(parsed, seq_no)
-                if not live:
-                    builder.deleted.add(local)
-            seg = builder.build()
+            if seg_file.endswith(".npz"):
+                # binary columnar format: postings/doc-values load directly,
+                # no re-analysis through the mapper (store.py)
+                seg, versions, routing = load_segment(self.store_dir,
+                                                      seg_file)
+                primary_term = commit.get("primary_term", 1)
+            else:
+                seg, versions, routing, primary_term = \
+                    self._load_legacy_segment(seg_file, commit)
             self.segments.append(seg)
             self._persisted_segments[seg.seg_id] = seg_file
-            seg_no = int(data["seg_id"].lstrip("_")) if \
-                data["seg_id"].lstrip("_").isdigit() else 0
+            seg_no = int(seg.seg_id.lstrip("_")) if \
+                seg.seg_id.lstrip("_").isdigit() else 0
             self._next_seg_no = max(self._next_seg_no, seg_no + 1)
-            for local, (uid, live, routing) in enumerate(zip(
-                    data["doc_uids"], data["live"], data["routing"])):
-                if live:
-                    self.version_map[uid] = VersionValue(
-                        version=data["versions"][local],
-                        seq_no=data["seq_nos"][local],
-                        primary_term=data.get("primary_term", 1),
-                        location=("segment", seg, local), routing=routing)
-                self.tracker.advance_max_seq_no(data["seq_nos"][local])
-                self.tracker.mark_processed(data["seq_nos"][local])
+            seq_nos = np.asarray(seg.seq_nos)
+            versions_l = np.asarray(versions).tolist()
+            seq_nos_l = seq_nos.tolist()
+            live_l = seg.live.tolist()
+            vm = self.version_map
+            for local, uid in enumerate(seg.doc_uids):
+                if live_l[local]:
+                    vm[uid] = VersionValue(
+                        version=versions_l[local],
+                        seq_no=seq_nos_l[local],
+                        primary_term=primary_term,
+                        location=("segment", seg, local),
+                        routing=routing[local])
+            if seq_nos.size:
+                self.tracker.advance_max_seq_no(int(seq_nos.max()))
+                for s in seq_nos[seq_nos > committed_ckpt].tolist():
+                    self.tracker.mark_processed(s)
         for uid, ts in commit.get("tombstones", {}).items():
             cur = self.version_map.get(uid)
             if cur is None or cur.seq_no < ts["seq_no"]:
@@ -177,12 +190,28 @@ class Engine:
                     version=ts["version"], seq_no=ts["seq_no"],
                     primary_term=ts.get("primary_term", 1), deleted=True,
                     ts=ts.get("ts", 0.0))
-        # segments only carry index-op seq-nos; deletes/no-ops below the
-        # committed local checkpoint would otherwise stay pending forever,
-        # pinning the checkpoint (and translog trimming) at a stale value
-        committed_ckpt = commit.get("local_checkpoint", NO_OPS_PERFORMED)
-        self.tracker.fast_forward(committed_ckpt)
         self._committed_seq_no = committed_ckpt
+
+    def _load_legacy_segment(self, seg_file: str, commit: dict):
+        """Round-1 gzip-JSON segments (sources only): rebuild through the
+        mapper. Kept for forward-compat of old stores; new flushes always
+        write the binary format."""
+        with gzip.open(os.path.join(self.store_dir, seg_file), "rt") as f:
+            data = json.load(f)
+        builder = SegmentBuilder(data["seg_id"])
+        for uid, source, seq_no, live, routing in zip(
+                data["doc_uids"], data["sources"], data["seq_nos"],
+                data["live"], data["routing"]):
+            parsed = self.mapper.parse_document(uid, source, routing)
+            local = builder.add(parsed, seq_no)
+            if not live:
+                builder.deleted.add(local)
+        seg = builder.build()
+        # deletes flushed after the legacy file was written live only in the
+        # .live.npy sidecar — without this overlay they'd resurrect here
+        apply_liveness_sidecar(seg, self.store_dir)
+        return (seg, data["versions"], data["routing"],
+                data.get("primary_term", 1))
 
     def _replay_translog(self) -> None:
         """Replay ops above the commit point (reference:
@@ -407,9 +436,12 @@ class Engine:
         Lucene commit + translog trim)."""
         self.refresh()
         for seg in self.segments:
-            if (seg.seg_id not in self._persisted_segments
-                    or seg.seg_id in self._dirty_segments):
+            if seg.seg_id not in self._persisted_segments:
                 self._persist_segment(seg)
+            elif seg.seg_id in self._dirty_segments:
+                # only the liveness bitmap changed: rewrite the sidecar
+                # .live.npy, never the immutable segment data
+                save_liveness(seg, self.store_dir)
         self._dirty_segments.clear()
         self._prune_tombstones()
         commit = {
@@ -438,8 +470,11 @@ class Engine:
         self.translog.mark_committed(self.tracker.checkpoint)
         self.translog.rollover()
         self.translog.trim_unneeded_generations()
-        # drop orphaned segment files from before merges
+        # drop orphaned segment files from before merges (the .live.npy
+        # sidecar of every referenced segment must survive too)
         referenced = set(commit["segments"]) | {"commit_point.json"}
+        for s in self.segments:
+            referenced.update(segment_file_names(s.seg_id))
         for fname in os.listdir(self.store_dir):
             if fname.startswith("seg_") and fname not in referenced:
                 try:
@@ -449,7 +484,6 @@ class Engine:
         self.stats["flush_total"] += 1
 
     def _persist_segment(self, seg: Segment) -> None:
-        fname = f"seg_{seg.seg_id}.json.gz"
         versions = []
         for local, uid in enumerate(seg.doc_uids):
             vv = self.version_map.get(uid)
@@ -458,24 +492,13 @@ class Engine:
                 versions.append(vv.version)
             else:
                 versions.append(1)
-        data = {"seg_id": seg.seg_id, "doc_uids": seg.doc_uids,
-                "sources": seg.sources, "seq_nos": seg.seq_nos.tolist(),
-                "live": seg.live.tolist(), "versions": versions,
-                "routing": [self.version_map[u].routing
-                            if u in self.version_map else None
-                            for u in seg.doc_uids],
-                "primary_term": self.primary_term}
-        tmp_path = os.path.join(self.store_dir, fname + ".tmp")
-        # fsync (after the gzip trailer is written) before the commit point
-        # references this file: a crash after the commit-point fsync must
-        # never find a truncated segment with its ops already trimmed from
-        # the translog
-        with open(tmp_path, "wb") as raw:
-            with gzip.GzipFile(fileobj=raw, mode="wb") as gz:
-                gz.write(json.dumps(data).encode())
-            raw.flush()
-            os.fsync(raw.fileno())
-        os.replace(tmp_path, os.path.join(self.store_dir, fname))
+        routing = [self.version_map[u].routing
+                   if u in self.version_map else None
+                   for u in seg.doc_uids]
+        # save_segment fsyncs data before the commit point references it: a
+        # crash after the commit-point fsync must never find a truncated
+        # segment with its ops already trimmed from the translog
+        fname = save_segment(seg, self.store_dir, versions, routing)
         self._persisted_segments[seg.seg_id] = fname
 
     def maybe_merge(self) -> bool:
@@ -505,28 +528,19 @@ class Engine:
         return self._merge(list(self.segments))
 
     def _merge(self, to_merge: List[Segment]) -> bool:
+        """Columnar merge (``store.merge_segments``): postings and doc
+        values concatenate vectorized under a union vocab — documents are
+        NOT re-analyzed through the mapper."""
         if not to_merge:
             return False
         merged_ids = {id(s) for s in to_merge}
-        builder = SegmentBuilder(f"_{self._next_seg_no}")
+        ordered = [s for s in self.segments if id(s) in merged_ids]
+        new_seg = merge_segments(f"_{self._next_seg_no}", ordered)
         self._next_seg_no += 1
-        new_locations: Dict[str, int] = {}
-        for seg in self.segments:
-            if id(seg) not in merged_ids:
-                continue
-            for local in np.nonzero(seg.live)[0]:
-                uid = seg.doc_uids[local]
-                vv = self.version_map.get(uid)
-                routing = vv.routing if vv else None
-                parsed = self.mapper.parse_document(uid, seg.sources[local],
-                                                    routing)
-                new_local = builder.add(parsed, int(seg.seq_nos[local]))
-                new_locations[uid] = new_local
-        new_seg = builder.build() if len(builder) else None
         rest = [s for s in self.segments if id(s) not in merged_ids]
         if new_seg is not None:
             rest.append(new_seg)
-            for uid, new_local in new_locations.items():
+            for new_local, uid in enumerate(new_seg.doc_uids):
                 vv = self.version_map.get(uid)
                 if vv and not vv.deleted:
                     vv.location = ("segment", new_seg, new_local)

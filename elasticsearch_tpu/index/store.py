@@ -1,0 +1,531 @@
+"""Binary columnar segment store + columnar merge.
+
+Replaces the round-1 gzip-JSON-of-sources format (which re-analyzed every
+document through the mapper on restart and merge — O(corpus) re-analysis)
+with persisted *index structures*:
+
+- ``seg_<id>.npz``          — all postings/doc-values/vector arrays plus the
+  packed source bytes and per-doc metadata, written once, immutable.
+- ``seg_<id>.live.npy``     — the liveness bitmap alone, rewritten when
+  deletes dirty an already-persisted segment (Lucene's ``.liv`` files next
+  to immutable segment files — reference: ``index/store/Store.java:130``,
+  ``SoftDeletesDirectoryReaderWrapper``).
+
+Merge is a vectorized columnar concatenation (union vocab → stable sort of
+posting runs by union term id → run-gather of positions); no document is
+re-tokenized. Reference behavior: Lucene segment merging driven by
+``EsTieredMergePolicy.java:35``.
+
+String dictionaries are packed as (uint8 concat, int64 offsets) pairs so the
+whole segment round-trips through ``np.savez``/``np.load`` without pickle.
+Sources decode lazily (:class:`PackedSources`) so restart cost is zip-read,
+not JSON-parse.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .segment import (KeywordFieldData, NumericFieldData, Segment,
+                      TextFieldData, VectorFieldData)
+
+FORMAT_VERSION = 2
+
+
+# ---------------------------------------------------------------------------
+# packed string lists
+# ---------------------------------------------------------------------------
+
+
+def pack_strs(strs: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+    """list[str] → (uint8 data, int64 offsets[len+1])."""
+    encoded = [s.encode("utf-8") for s in strs]
+    offsets = np.zeros(len(encoded) + 1, np.int64)
+    if encoded:
+        np.cumsum([len(b) for b in encoded], out=offsets[1:])
+    data = np.frombuffer(b"".join(encoded), dtype=np.uint8).copy() \
+        if encoded else np.empty(0, np.uint8)
+    return data, offsets
+
+
+def unpack_strs(data: np.ndarray, offsets: np.ndarray) -> List[str]:
+    buf = data.tobytes()
+    return [buf[offsets[i]: offsets[i + 1]].decode("utf-8")
+            for i in range(len(offsets) - 1)]
+
+
+class PackedSources:
+    """Lazily-decoded packed ``_source`` column: JSON bytes + offsets.
+
+    Quacks like the ``List[Optional[dict]]`` the rest of the engine indexes
+    into, but restart pays zero JSON parsing until a doc is actually
+    fetched."""
+
+    __slots__ = ("data", "offsets")
+
+    def __init__(self, data: np.ndarray, offsets: np.ndarray):
+        self.data = data
+        self.offsets = offsets
+
+    @classmethod
+    def from_list(cls, sources: Sequence[Optional[dict]]) -> "PackedSources":
+        data, offsets = pack_strs(
+            [json.dumps(s, separators=(",", ":")) if s is not None
+             else "null" for s in sources])
+        return cls(data, offsets)
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        raw = self.data[self.offsets[i]: self.offsets[i + 1]].tobytes()
+        return json.loads(raw) if raw != b"null" else None
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def gather(self, keep: np.ndarray) -> "PackedSources":
+        """Select rows by boolean mask — byte-level, no decode."""
+        idx = np.nonzero(keep)[0]
+        lengths = (self.offsets[1:] - self.offsets[:-1])[idx]
+        data = _gather_runs(self.data, self.offsets[:-1][idx], lengths)
+        offsets = np.zeros(idx.size + 1, np.int64)
+        np.cumsum(lengths, out=offsets[1:])
+        return PackedSources(data, offsets)
+
+
+def _as_packed_sources(sources) -> PackedSources:
+    if isinstance(sources, PackedSources):
+        return sources
+    return PackedSources.from_list(sources)
+
+
+# ---------------------------------------------------------------------------
+# vectorized run gather
+# ---------------------------------------------------------------------------
+
+
+def _gather_runs(flat: np.ndarray, starts: np.ndarray,
+                 lengths: np.ndarray) -> np.ndarray:
+    """Concatenate ``flat[starts[i] : starts[i]+lengths[i]]`` for all i,
+    fully vectorized (the repeat-arange trick)."""
+    lengths = np.asarray(lengths, np.int64)
+    total = int(lengths.sum())
+    if total == 0:
+        return np.empty(0, flat.dtype)
+    out_starts = np.zeros(lengths.shape[0], np.int64)
+    np.cumsum(lengths[:-1], out=out_starts[1:])
+    idx = np.repeat(np.asarray(starts, np.int64) - out_starts, lengths) \
+        + np.arange(total, dtype=np.int64)
+    return flat[idx]
+
+
+# ---------------------------------------------------------------------------
+# save / load
+# ---------------------------------------------------------------------------
+
+
+def _seg_npz_name(seg_id: str) -> str:
+    return f"seg_{seg_id}.npz"
+
+
+def _seg_live_name(seg_id: str) -> str:
+    return f"seg_{seg_id}.live.npy"
+
+
+def save_segment(seg: Segment, store_dir: str, versions: Sequence[int],
+                 routing: Sequence[Optional[str]]) -> str:
+    """Persist one immutable segment; returns the npz file name. The
+    liveness bitmap goes to its own file via :func:`save_liveness` so later
+    deletes never rewrite this file."""
+    arrays: Dict[str, np.ndarray] = {}
+    manifest: dict = {"format": FORMAT_VERSION, "seg_id": seg.seg_id,
+                      "n_docs": seg.n_docs,
+                      "text_fields": [], "keyword_fields": [],
+                      "numeric_fields": [], "vector_fields": []}
+
+    uid_data, uid_off = pack_strs(seg.doc_uids)
+    arrays["uids_data"], arrays["uids_off"] = uid_data, uid_off
+    src = _as_packed_sources(seg.sources)
+    arrays["src_data"], arrays["src_off"] = src.data, src.offsets
+    arrays["seq_nos"] = np.asarray(seg.seq_nos, np.int64)
+    arrays["versions"] = np.asarray(list(versions), np.int64)
+    arrays["routing_isnull"] = np.asarray(
+        [r is None for r in routing], bool)
+    r_data, r_off = pack_strs([r or "" for r in routing])
+    arrays["routing_data"], arrays["routing_off"] = r_data, r_off
+
+    for i, (name, f) in enumerate(sorted(seg.text_fields.items())):
+        manifest["text_fields"].append(
+            {"name": name, "sum_dl": f.sum_dl,
+             "field_doc_count": f.field_doc_count})
+        terms = sorted(f.term_ids, key=f.term_ids.get)
+        td, to = pack_strs(terms)
+        p = f"t{i}_"
+        arrays[p + "terms_data"], arrays[p + "terms_off"] = td, to
+        arrays[p + "df"] = f.df
+        arrays[p + "offsets"] = f.offsets
+        arrays[p + "docs"] = f.docs_host
+        arrays[p + "tf"] = f.tf_host
+        arrays[p + "doc_len"] = f.doc_len_host
+        arrays[p + "ttf"] = f.total_term_freq
+        arrays[p + "pos_off"] = f.pos_offsets
+        arrays[p + "pos_flat"] = f.pos_flat
+
+    for i, (name, f) in enumerate(sorted(seg.keyword_fields.items())):
+        manifest["keyword_fields"].append({"name": name})
+        td, to = pack_strs(f.ord_terms)
+        p = f"k{i}_"
+        arrays[p + "terms_data"], arrays[p + "terms_off"] = td, to
+        arrays[p + "df"] = f.df
+        arrays[p + "offsets"] = f.offsets
+        arrays[p + "docs"] = f.docs_host
+        arrays[p + "dv_ords"] = f.dv_ords_host
+        arrays[p + "dv_docs"] = f.dv_docs_host
+
+    for i, (name, f) in enumerate(sorted(seg.numeric_fields.items())):
+        manifest["numeric_fields"].append({"name": name, "base": f.base})
+        p = f"n{i}_"
+        arrays[p + "vals"] = f.vals_host
+        arrays[p + "docs"] = f.docs_host
+
+    for i, (name, f) in enumerate(sorted(seg.vector_fields.items())):
+        manifest["vector_fields"].append({"name": name})
+        p = f"v{i}_"
+        arrays[p + "mat"] = f.matrix_host
+        arrays[p + "exists"] = f.exists
+
+    arrays["manifest"] = np.frombuffer(
+        json.dumps(manifest).encode("utf-8"), dtype=np.uint8).copy()
+
+    fname = _seg_npz_name(seg.seg_id)
+    tmp = os.path.join(store_dir, fname + ".tmp")
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **arrays)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, os.path.join(store_dir, fname))
+    save_liveness(seg, store_dir)
+    return fname
+
+
+def save_liveness(seg: Segment, store_dir: str) -> None:
+    """Rewrite only the liveness bitmap (deletes don't touch segment data)."""
+    tmp = os.path.join(store_dir, _seg_live_name(seg.seg_id) + ".tmp")
+    with open(tmp, "wb") as fh:
+        np.save(fh, seg.live)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, os.path.join(store_dir, _seg_live_name(seg.seg_id)))
+
+
+def load_segment(store_dir: str, fname: str):
+    """Load one persisted segment without touching the mapper.
+
+    Returns ``(segment, versions int64[N], routing list[Optional[str]])``.
+    """
+    with np.load(os.path.join(store_dir, fname)) as z:
+        arrays = {k: z[k] for k in z.files}
+    manifest = json.loads(arrays["manifest"].tobytes().decode("utf-8"))
+
+    doc_uids = unpack_strs(arrays["uids_data"], arrays["uids_off"])
+    sources = PackedSources(arrays["src_data"], arrays["src_off"])
+    seq_nos = arrays["seq_nos"]
+    versions = arrays["versions"]
+    isnull = arrays["routing_isnull"]
+    r_strs = unpack_strs(arrays["routing_data"], arrays["routing_off"])
+    routing = [None if isnull[i] else r_strs[i] for i in range(len(r_strs))]
+
+    text_fields: Dict[str, TextFieldData] = {}
+    for i, m in enumerate(manifest["text_fields"]):
+        p = f"t{i}_"
+        terms = unpack_strs(arrays[p + "terms_data"], arrays[p + "terms_off"])
+        text_fields[m["name"]] = TextFieldData(
+            term_ids={t: j for j, t in enumerate(terms)},
+            df=arrays[p + "df"], offsets=arrays[p + "offsets"],
+            docs_host=arrays[p + "docs"], tf_host=arrays[p + "tf"],
+            doc_len_host=arrays[p + "doc_len"], sum_dl=m["sum_dl"],
+            field_doc_count=m["field_doc_count"],
+            total_term_freq=arrays[p + "ttf"],
+            pos_offsets=arrays[p + "pos_off"],
+            pos_flat=arrays[p + "pos_flat"])
+
+    keyword_fields: Dict[str, KeywordFieldData] = {}
+    for i, m in enumerate(manifest["keyword_fields"]):
+        p = f"k{i}_"
+        terms = unpack_strs(arrays[p + "terms_data"], arrays[p + "terms_off"])
+        keyword_fields[m["name"]] = KeywordFieldData(
+            ord_terms=terms, term_ords={t: j for j, t in enumerate(terms)},
+            df=arrays[p + "df"], offsets=arrays[p + "offsets"],
+            docs_host=arrays[p + "docs"],
+            dv_ords_host=arrays[p + "dv_ords"],
+            dv_docs_host=arrays[p + "dv_docs"])
+
+    numeric_fields: Dict[str, NumericFieldData] = {}
+    for i, m in enumerate(manifest["numeric_fields"]):
+        p = f"n{i}_"
+        numeric_fields[m["name"]] = NumericFieldData(
+            base=m["base"], vals_host=arrays[p + "vals"],
+            docs_host=arrays[p + "docs"])
+
+    vector_fields: Dict[str, VectorFieldData] = {}
+    for i, m in enumerate(manifest["vector_fields"]):
+        p = f"v{i}_"
+        vector_fields[m["name"]] = VectorFieldData(
+            matrix_host=arrays[p + "mat"], exists=arrays[p + "exists"])
+
+    seg = Segment(manifest["seg_id"], manifest["n_docs"], doc_uids, sources,
+                  seq_nos, text_fields, keyword_fields, numeric_fields,
+                  vector_fields)
+    apply_liveness_sidecar(seg, store_dir)
+    return seg, versions, routing
+
+
+def apply_liveness_sidecar(seg: Segment, store_dir: str) -> None:
+    """Overlay the ``.live.npy`` sidecar (if present) onto a freshly loaded
+    segment — deletes after the segment file was written live only here."""
+    live_path = os.path.join(store_dir, _seg_live_name(seg.seg_id))
+    if os.path.exists(live_path):
+        live = np.load(live_path)
+        if live.shape[0] == seg.n_docs:
+            seg.live = live.astype(bool)
+            seg._live_dev = None
+
+
+def segment_file_names(seg_id: str) -> List[str]:
+    return [_seg_npz_name(seg_id), _seg_live_name(seg_id)]
+
+
+# ---------------------------------------------------------------------------
+# columnar merge
+# ---------------------------------------------------------------------------
+
+
+def merge_segments(seg_id: str,
+                   segments: List[Segment]) -> Optional[Segment]:
+    """Merge live docs of ``segments`` into one new segment **columnar-ly**:
+    no re-tokenization, no mapper. Returns None when nothing is live.
+    (Routing stays in the engine's version map — the source of truth at
+    persist time.)"""
+    lives = [s.live.copy() for s in segments]
+    n_live = [int(m.sum()) for m in lives]
+    n_new = sum(n_live)
+    if n_new == 0:
+        return None
+    # new doc id for each old local doc (valid where live)
+    remaps: List[np.ndarray] = []
+    base = 0
+    for s, m in zip(segments, lives):
+        r = np.cumsum(m, dtype=np.int64) - 1 + base
+        remaps.append(r.astype(np.int32))
+        base += int(m.sum())
+
+    doc_uids: List[str] = []
+    for s, m in zip(segments, lives):
+        idx = np.nonzero(m)[0]
+        doc_uids.extend(s.doc_uids[i] for i in idx)
+    seq_nos = np.concatenate(
+        [np.asarray(s.seq_nos)[m] for s, m in zip(segments, lives)]) \
+        if segments else np.empty(0, np.int64)
+    sources = _concat_sources(segments, lives)
+
+    text_fields = _merge_text(segments, lives, remaps, n_new)
+    keyword_fields = _merge_keyword(segments, lives, remaps)
+    numeric_fields = _merge_numeric(segments, lives, remaps)
+    vector_fields = _merge_vector(segments, lives, remaps, n_new)
+
+    return Segment(seg_id, n_new, doc_uids, sources,
+                   seq_nos.astype(np.int64), text_fields, keyword_fields,
+                   numeric_fields, vector_fields)
+
+
+def _concat_sources(segments, lives):
+    packed = [_as_packed_sources(s.sources).gather(m)
+              for s, m in zip(segments, lives)]
+    data = np.concatenate([p.data for p in packed]) if packed \
+        else np.empty(0, np.uint8)
+    sizes = [p.offsets[1:] - p.offsets[:-1] for p in packed]
+    lengths = np.concatenate(sizes) if sizes else np.empty(0, np.int64)
+    offsets = np.zeros(lengths.size + 1, np.int64)
+    np.cumsum(lengths, out=offsets[1:])
+    return PackedSources(data, offsets)
+
+
+def _union_vocab(term_lists: List[List[str]]):
+    union = sorted(set().union(*map(set, term_lists))) if term_lists else []
+    index = {t: i for i, t in enumerate(union)}
+    maps = [np.asarray([index[t] for t in terms], np.int64)
+            if terms else np.empty(0, np.int64) for terms in term_lists]
+    return union, maps
+
+
+def _merge_text(segments, lives, remaps, n_new) -> Dict[str, TextFieldData]:
+    names = sorted({n for s in segments for n in s.text_fields})
+    out: Dict[str, TextFieldData] = {}
+    for name in names:
+        parts = []          # (utid, docs, tf, pos_lengths, pos_starts, flat)
+        doc_len_new = np.zeros(n_new, np.float32)
+        term_lists = []
+        active = []
+        for s, m, r in zip(segments, lives, remaps):
+            f = s.text_fields.get(name)
+            if f is None:
+                continue
+            active.append((f, m, r))
+            term_lists.append(sorted(f.term_ids, key=f.term_ids.get))
+        union_terms, term_maps = _union_vocab(term_lists)
+        for (f, m, r), tmap in zip(active, term_maps):
+            df_pre = (f.offsets[1:] - f.offsets[:-1]).astype(np.int64)
+            pair_term = np.repeat(np.arange(df_pre.size), df_pre)
+            keep = m[f.docs_host]
+            docs_k = r[f.docs_host[keep]]
+            tf_k = f.tf_host[keep]
+            utid_k = tmap[pair_term[keep]]
+            pos_lengths = (f.pos_offsets[1:] - f.pos_offsets[:-1])[keep]
+            pos_starts = f.pos_offsets[:-1][keep]
+            flat_k = _gather_runs(f.pos_flat, pos_starts, pos_lengths)
+            parts.append((utid_k, docs_k, tf_k, pos_lengths, flat_k))
+            live_idx = np.nonzero(m)[0]
+            doc_len_new[r[live_idx]] = f.doc_len_host[live_idx]
+        if not parts:
+            continue
+        utid = np.concatenate([p[0] for p in parts])
+        docs = np.concatenate([p[1] for p in parts])
+        tf = np.concatenate([p[2] for p in parts])
+        pos_lengths = np.concatenate([p[3] for p in parts])
+        pos_flat = np.concatenate([p[4] for p in parts])
+        pair_starts = np.zeros(pos_lengths.size, np.int64)
+        np.cumsum(pos_lengths[:-1], out=pair_starts[1:])
+
+        order = np.argsort(utid, kind="stable")
+        utid_o = utid[order]
+        docs_o = docs[order].astype(np.int32)
+        tf_o = tf[order].astype(np.float32)
+        lengths_o = pos_lengths[order]
+        pos_flat_o = _gather_runs(pos_flat, pair_starts[order], lengths_o)
+        pos_off_o = np.zeros(lengths_o.size + 1, np.int64)
+        np.cumsum(lengths_o, out=pos_off_o[1:])
+
+        v_u = len(union_terms)
+        df_new = np.bincount(utid_o, minlength=v_u).astype(np.int32)
+        ttf_new = np.bincount(utid_o, weights=tf_o,
+                              minlength=v_u).astype(np.int64)
+        keep_terms = df_new > 0
+        terms_c = [t for t, k in zip(union_terms, keep_terms) if k]
+        df_c = df_new[keep_terms]
+        ttf_c = ttf_new[keep_terms]
+        offsets_c = np.zeros(df_c.size + 1, np.int64)
+        np.cumsum(df_c, out=offsets_c[1:])
+        out[name] = TextFieldData(
+            term_ids={t: j for j, t in enumerate(terms_c)},
+            df=df_c, offsets=offsets_c, docs_host=docs_o, tf_host=tf_o,
+            doc_len_host=doc_len_new, sum_dl=float(doc_len_new.sum()),
+            field_doc_count=int((doc_len_new > 0).sum()),
+            total_term_freq=ttf_c, pos_offsets=pos_off_o,
+            pos_flat=pos_flat_o)
+    return out
+
+
+def _merge_keyword(segments, lives, remaps) -> Dict[str, KeywordFieldData]:
+    names = sorted({n for s in segments for n in s.keyword_fields})
+    out: Dict[str, KeywordFieldData] = {}
+    for name in names:
+        active = []
+        term_lists = []
+        for s, m, r in zip(segments, lives, remaps):
+            f = s.keyword_fields.get(name)
+            if f is None:
+                continue
+            active.append((f, m, r))
+            term_lists.append(f.ord_terms)
+        union_terms_all, term_maps = _union_vocab(term_lists)
+        p_utid, p_docs, dv_ords_parts, dv_docs_parts = [], [], [], []
+        for (f, m, r), tmap in zip(active, term_maps):
+            df_pre = (f.offsets[1:] - f.offsets[:-1]).astype(np.int64)
+            pair_term = np.repeat(np.arange(df_pre.size), df_pre)
+            keep = m[f.docs_host]
+            p_docs.append(r[f.docs_host[keep]])
+            p_utid.append(tmap[pair_term[keep]])
+            dv_keep = m[f.dv_docs_host]
+            dv_docs_parts.append(r[f.dv_docs_host[dv_keep]])
+            dv_ords_parts.append(tmap[f.dv_ords_host[dv_keep]])
+        if not active:
+            continue
+        utid = np.concatenate(p_utid) if p_utid else np.empty(0, np.int64)
+        docs = np.concatenate(p_docs) if p_docs else np.empty(0, np.int64)
+        order = np.argsort(utid, kind="stable")
+        utid_o = utid[order]
+        docs_o = docs[order].astype(np.int32)
+        v_u = len(union_terms_all)
+        df_new = np.bincount(utid_o, minlength=v_u).astype(np.int32)
+        keep_terms = df_new > 0
+        comp = np.cumsum(keep_terms, dtype=np.int64) - 1
+        terms_c = [t for t, k in zip(union_terms_all, keep_terms) if k]
+        df_c = df_new[keep_terms]
+        offsets_c = np.zeros(df_c.size + 1, np.int64)
+        np.cumsum(df_c, out=offsets_c[1:])
+        dv_docs = np.concatenate(dv_docs_parts).astype(np.int32) \
+            if dv_docs_parts else np.empty(0, np.int32)
+        dv_ords_u = np.concatenate(dv_ords_parts) if dv_ords_parts \
+            else np.empty(0, np.int64)
+        dv_ords = comp[dv_ords_u].astype(np.int32) if dv_ords_u.size \
+            else np.empty(0, np.int32)
+        out[name] = KeywordFieldData(
+            ord_terms=terms_c,
+            term_ords={t: j for j, t in enumerate(terms_c)},
+            df=df_c, offsets=offsets_c, docs_host=docs_o,
+            dv_ords_host=dv_ords, dv_docs_host=dv_docs)
+    return out
+
+
+def _merge_numeric(segments, lives, remaps) -> Dict[str, NumericFieldData]:
+    names = sorted({n for s in segments for n in s.numeric_fields})
+    out: Dict[str, NumericFieldData] = {}
+    for name in names:
+        docs_parts, vals_parts = [], []
+        for s, m, r in zip(segments, lives, remaps):
+            f = s.numeric_fields.get(name)
+            if f is None:
+                continue
+            keep = m[f.docs_host]
+            docs_parts.append(r[f.docs_host[keep]])
+            vals_parts.append(f.vals_host[keep])
+        if not docs_parts:
+            continue
+        docs = np.concatenate(docs_parts).astype(np.int32)
+        vals = np.concatenate(vals_parts)
+        base = float(vals.min()) if vals.size else 0.0
+        out[name] = NumericFieldData(base=base, vals_host=vals,
+                                     docs_host=docs)
+    return out
+
+
+def _merge_vector(segments, lives, remaps, n_new) -> Dict[str,
+                                                          VectorFieldData]:
+    names = sorted({n for s in segments for n in s.vector_fields})
+    out: Dict[str, VectorFieldData] = {}
+    for name in names:
+        dim = 0
+        for s in segments:
+            f = s.vector_fields.get(name)
+            if f is not None and f.matrix_host.size:
+                dim = f.matrix_host.shape[1]
+                break
+        mat = np.zeros((n_new, dim), np.float32)
+        exists = np.zeros(n_new, bool)
+        for s, m, r in zip(segments, lives, remaps):
+            f = s.vector_fields.get(name)
+            if f is None:
+                continue
+            live_idx = np.nonzero(m)[0]
+            mat[r[live_idx]] = f.matrix_host[live_idx]
+            exists[r[live_idx]] = f.exists[live_idx]
+        out[name] = VectorFieldData(matrix_host=mat, exists=exists)
+    return out
