@@ -50,19 +50,15 @@ def make_impacts(tf: np.ndarray, docs: np.ndarray, doc_len: np.ndarray,
             ).astype(np.float32)
 
 
-def bm25_topk_merge_body(postings_docs, postings_impact, starts, lengths,
-                         idfw, *, n_pad: int, L: int, k: int,
-                         min_should_match: int = 1):
-    """Score one query against one shard partition, returning (values f32[k],
-    local_doc i32[k]); empty slots carry -inf / n_pad.
+def bm25_merge_candidates(postings_docs, postings_impact, starts, lengths,
+                          idfw, *, n_pad: int, L: int):
+    """Sorted-merge candidate stage shared by the plain top-k kernel and the
+    tiered kernel (``ops/tiered_bm25.py``).
 
-    postings_docs:   int32[P'] flat CSR doc ids (padding: n_pad sentinel).
-    postings_impact: float32[P'] precomputed impacts (see make_impacts).
-    starts:          int32[Q] run start offsets (absent terms: any valid
-                     offset with length 0).
-    lengths:         int32[Q] run lengths, clamped to L by the caller.
-    idfw:            float32[Q] idf × boost × duplicate-count per term.
-    min_should_match: minimum distinct matching term slots per doc.
+    Returns ``(sdocs i32[Q*L], gscore f32[Q*L], gcount f32[Q*L],
+    is_last bool[Q*L])``: candidates sorted by doc id with each doc group's
+    summed score/match-count materialized at its *last* slot (other slots
+    hold partial prefixes — mask with ``is_last``).
     """
     Q = starts.shape[0]
 
@@ -90,7 +86,6 @@ def bm25_topk_merge_body(postings_docs, postings_impact, starts, lengths,
     # cumsum trick reconstructs each group's sum with prefix-dependent
     # rounding, which breaks exact score ties (Lucene tie-break parity
     # needs identical docs to score bitwise-identically).
-    n = sdocs.shape[0]
     nxt = jnp.concatenate([sdocs[1:], jnp.full((1,), -2, sdocs.dtype)])
     is_last = sdocs != nxt
     gscore = scontrib
@@ -105,7 +100,27 @@ def bm25_topk_merge_body(postings_docs, postings_impact, starts, lengths,
         gcount = gcount + jnp.where(
             same, jnp.concatenate([jnp.zeros((j,), svalid.dtype),
                                    svalid[:-j]]), 0.0)
+    return sdocs, gscore, gcount, is_last
 
+
+def bm25_topk_merge_body(postings_docs, postings_impact, starts, lengths,
+                         idfw, *, n_pad: int, L: int, k: int,
+                         min_should_match: int = 1):
+    """Score one query against one shard partition, returning (values f32[k],
+    local_doc i32[k]); empty slots carry -inf / n_pad.
+
+    postings_docs:   int32[P'] flat CSR doc ids (padding: n_pad sentinel).
+    postings_impact: float32[P'] precomputed impacts (see make_impacts).
+    starts:          int32[Q] run start offsets (absent terms: any valid
+                     offset with length 0).
+    lengths:         int32[Q] run lengths, clamped to L by the caller.
+    idfw:            float32[Q] idf × boost × duplicate-count per term.
+    min_should_match: minimum distinct matching term slots per doc.
+    """
+    sdocs, gscore, gcount, is_last = bm25_merge_candidates(
+        postings_docs, postings_impact, starts, lengths, idfw,
+        n_pad=n_pad, L=L)
+    n = sdocs.shape[0]
     score = jnp.where(
         is_last & (sdocs < n_pad) & (gcount >= min_should_match),
         gscore, NEG_INF)

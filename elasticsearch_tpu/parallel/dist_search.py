@@ -39,6 +39,8 @@ except ImportError:  # pragma: no cover
 
 from ..ops.bm25 import DEFAULT_B, DEFAULT_K1, idf_weight
 from ..ops.sorted_merge import bm25_topk_merge_body, make_impacts
+from ..ops.tiered_bm25 import (build_dense_rows, split_tiers,
+                               tiered_bm25_topk)
 from ..utils.shapes import round_up_pow2
 from .mesh import AXIS_REPLICA, AXIS_SHARD
 
@@ -124,6 +126,53 @@ def build_bm25_topk_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
     return jax.jit(step)
 
 
+def build_tiered_bm25_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
+                           T_pad: int, C: int, n_shards: int,
+                           min_should_match: int = 1):
+    """Jitted distributed tiered step (``ops/tiered_bm25.py``): sparse
+    sorted-merge + dense Zipf-head streaming matmul per shard, then the ICI
+    all_gather/top_k reduce.
+
+    Additional global shapes vs :func:`build_bm25_topk_step`:
+      dense_blocks bf16[S, n_blk, T_pad, C]  sharded over ``shard``
+      dense_rid    i32[B, S, Q]              (row ids into the shard's dense
+                                              tier; weight-0 slots inert)
+      dense_w      f32[B, S, Q]
+      W            f32[B, S, T_pad]          (per-query dense row weights)
+    """
+    s_dev = mesh.shape[AXIS_SHARD]
+    if n_shards % s_dev:
+        raise ValueError(f"{n_shards} shards not divisible over {s_dev} devices")
+    s_loc = n_shards // s_dev
+    kk = min(k, n_pad)
+
+    def body(pd, pi, dense, st, ln, idfw, rid, dw, W):
+        def per_shard(pd_s, pi_s, dense_s, st_s, ln_s, rid_s, dw_s, W_s):
+            return tiered_bm25_topk(
+                pd_s, pi_s, dense_s, st_s, ln_s, idfw, rid_s, dw_s, W_s,
+                n_pad=n_pad, L=L, k=kk, min_should_match=min_should_match)
+
+        vals, idx = jax.vmap(per_shard,
+                             in_axes=(0, 0, 0, 1, 1, 1, 1, 1),
+                             out_axes=1)(pd, pi, dense, st, ln, rid, dw, W)
+        return _global_topk_reduce(vals, idx, s_loc=s_loc, kk=kk, n_pad=n_pad)
+
+    shard_corpus = P(AXIS_SHARD, None)
+    step = shard_map(
+        body, mesh=mesh,
+        in_specs=(shard_corpus, shard_corpus,
+                  P(AXIS_SHARD, None, None, None),
+                  P(AXIS_REPLICA, AXIS_SHARD, None),
+                  P(AXIS_REPLICA, AXIS_SHARD, None),
+                  P(AXIS_REPLICA, None),
+                  P(AXIS_REPLICA, AXIS_SHARD, None),
+                  P(AXIS_REPLICA, AXIS_SHARD, None),
+                  P(AXIS_REPLICA, AXIS_SHARD, None)),
+        out_specs=(P(AXIS_REPLICA, None), P(AXIS_REPLICA, None)),
+        check_vma=False)
+    return jax.jit(step)
+
+
 def build_knn_step(mesh: Mesh, *, n_pad: int, dim: int, k: int,
                    n_shards: int, similarity: str = "dot_product"):
     """Jitted distributed brute-force kNN: einsum on the MXU per shard
@@ -196,54 +245,102 @@ class DistributedSearchPlane:
     batch assembly; everything per-document runs on device.
     """
 
+    #: dense-tier block width (docs per streamed matmul block)
+    DENSE_BLOCK = 1 << 19
+    #: dense-tier row budget per shard (memory cap: T × n_pad × 2B each)
+    MAX_DENSE_TERMS = 256
+
     def __init__(self, mesh: Mesh, shards: Sequence[dict], field: str,
-                 *, k1: float = DEFAULT_K1, b: float = DEFAULT_B):
+                 *, k1: float = DEFAULT_K1, b: float = DEFAULT_B,
+                 dense_threshold: Optional[int] = None):
         """``shards``: one dict per shard with keys
         ``term_ids`` (term→tid), ``df`` i32[V], ``offsets`` i64[V+1],
         ``docs`` i32[P], ``tf`` f32[P], ``doc_len`` f32[N], ``doc_uids``
         (optional list), as produced by
         :meth:`from_segments` / index builders.
+
+        ``dense_threshold``: terms with per-shard df above this go to the
+        dense tier (default ``max(n_pad // 64, 4096)``) — see
+        ``ops/tiered_bm25.py``. The sorted-merge L is then bounded by the
+        largest *sparse* df instead of the corpus-wide max df.
         """
         self.mesh = mesh
         self.field = field
         self.k1, self.b = k1, b
         self.n_shards = len(shards)
-        # retain only what query assembly needs (term dicts + CSR offsets);
-        # the postings/doc_len arrays live on device after init
-        self.shards = [dict(term_ids=s["term_ids"], offsets=s["offsets"],
-                            df=s["df"], doc_uids=s.get("doc_uids"))
-                       for s in shards]
         if self.n_shards % mesh.shape[AXIS_SHARD]:
             raise ValueError("shard count must divide mesh shard axis")
 
         self.n_pad = round_up_pow2(max(max(s["doc_len"].shape[0] for s in shards), 1))
-        # slack after the last run so dynamic_slice(start, L) never clamps
-        # into foreign data: search() caps L at L_cap and the tables carry
-        # L_cap sentinel entries past the last run
-        self.max_df = max(max((int(s["df"].max()) if s["df"].size else 0)
-                              for s in shards), 1)
-        self.L_cap = round_up_pow2(self.max_df)
-        p_pad = round_up_pow2(
-            max(s["docs"].shape[0] for s in shards) + self.L_cap)
-        self.p_pad = p_pad
+        if dense_threshold is None:
+            dense_threshold = max(self.n_pad // 64, 4096)
+        self.dense_threshold = dense_threshold
 
+        # full-table impacts first (dense rows reference original postings),
+        # then split each shard's vocab into tiers
         S = self.n_shards
-        docs = np.full((S, p_pad), self.n_pad, np.int32)
-        impacts = np.zeros((S, p_pad), np.float32)
         self.n_docs_total = 0
-        for i, s in enumerate(shards):
-            pn = s["docs"].shape[0]
-            docs[i, :pn] = s["docs"]
+        impacts_full: List[np.ndarray] = []
+        tiers: List[dict] = []
+        for s in shards:
             fdc = max(int((s["doc_len"] > 0).sum()), 1)
             avgdl = max(float(s["doc_len"].sum()) / fdc, 1e-9)
-            impacts[i, :pn] = make_impacts(
-                s["tf"], s["docs"], s["doc_len"], avgdl, k1, b)
+            impacts_full.append(make_impacts(
+                s["tf"], s["docs"], s["doc_len"], avgdl, k1, b))
+            tiers.append(split_tiers(
+                s, dense_threshold=dense_threshold,
+                max_dense_terms=self.MAX_DENSE_TERMS))
             self.n_docs_total += int(s["doc_len"].shape[0])
+
+        # retain what query assembly needs: term dicts, ORIGINAL df (global
+        # idf stats), sparse-tier offsets/df, dense row maps
+        self.shards = []
+        for s, t in zip(shards, tiers):
+            dense_row_of = {int(tid): r
+                            for r, tid in enumerate(t["dense_tids"])}
+            self.shards.append(dict(
+                term_ids=s["term_ids"], df=s["df"],
+                sparse_offsets=t["offsets"], sparse_df=t["df"],
+                dense_row_of=dense_row_of, doc_uids=s.get("doc_uids")))
+
+        self.max_sparse_df = max(
+            max((t["sparse_max_df"] for t in tiers), default=1), 1)
+        self.L_cap = round_up_pow2(self.max_sparse_df)
+        self.n_dense = max(t["dense_tids"].size for t in tiers)
+        self.T_pad = round_up_pow2(max(self.n_dense, 1)) if self.n_dense \
+            else 0
+
+        # sparse postings table with L_cap sentinel slack after the last run
+        # so dynamic_slice(start, L) never clamps into foreign data
+        p_need = max(t["docs"].shape[0] for t in tiers) + self.L_cap
+        p_pad = -(-p_need // 1024) * 1024
+        self.p_pad = p_pad
+        docs = np.full((S, p_pad), self.n_pad, np.int32)
+        impacts = np.zeros((S, p_pad), np.float32)
+        for i, (s, t, imp) in enumerate(zip(shards, tiers, impacts_full)):
+            pn = t["docs"].shape[0]
+            docs[i, :pn] = t["docs"]
+            keep = np.ones(s["docs"].shape[0], bool)
+            for tid in t["dense_tids"]:
+                keep[s["offsets"][tid]: s["offsets"][tid + 1]] = False
+            impacts[i, :pn] = imp[keep]
 
         corpus_spec = NamedSharding(mesh, P(AXIS_SHARD, None))
         self.docs_dev = jax.device_put(docs, corpus_spec)
         self.impacts_dev = jax.device_put(impacts, corpus_spec)
-        self._steps: Dict[Tuple[int, int, int], callable] = {}
+
+        self.dense_dev = None
+        if self.T_pad:
+            C = min(self.DENSE_BLOCK, self.n_pad)
+            self.dense_block = C
+            dense = np.stack([
+                build_dense_rows(s, t["dense_tids"], imp,
+                                 n_pad=self.n_pad, block=C,
+                                 t_pad=self.T_pad)
+                for s, t, imp in zip(shards, tiers, impacts_full)])
+            self.dense_dev = jax.device_put(
+                dense, NamedSharding(mesh, P(AXIS_SHARD, None, None, None)))
+        self._steps: Dict[Tuple, callable] = {}
 
     @classmethod
     def from_segments(cls, mesh: Mesh, segments: Sequence, field: str, **kw):
@@ -261,12 +358,19 @@ class DistributedSearchPlane:
     # -- query assembly ------------------------------------------------------
 
     def _lookup(self, queries: Sequence[Sequence[str]], Q: int):
+        """Per-shard run/row lookup for a query batch. A term is scored by
+        the sparse tier or the dense tier *per shard* (membership can differ
+        across shards); global idf always uses the original df stats."""
         B, S = len(queries), self.n_shards
+        T = self.T_pad
         starts = np.zeros((B, S, Q), np.int32)
         lengths = np.zeros((B, S, Q), np.int32)
+        dense_rid = np.zeros((B, S, Q), np.int32)
+        dense_hit = np.zeros((B, S, Q), bool)
         weights = np.zeros((B, Q), np.float32)
         gdf = np.zeros((B, Q), np.int64)
         max_len = 1
+        any_dense = False
         for bi, terms in enumerate(queries):
             uniq: Dict[str, int] = {}
             for t in terms:
@@ -282,15 +386,31 @@ class DistributedSearchPlane:
                     tid = sh["term_ids"].get(t)
                     if tid is None:
                         continue
-                    st = int(sh["offsets"][tid])
-                    ln = int(sh["offsets"][tid + 1]) - st
+                    gdf[bi, qi] += int(sh["df"][tid])
+                    row = sh["dense_row_of"].get(int(tid)) \
+                        if sh["dense_row_of"] else None
+                    if row is not None:
+                        dense_rid[bi, si, qi] = row
+                        dense_hit[bi, si, qi] = True
+                        any_dense = True
+                        continue
+                    st = int(sh["sparse_offsets"][tid])
+                    ln = int(sh["sparse_offsets"][tid + 1]) - st
                     starts[bi, si, qi] = st
                     lengths[bi, si, qi] = ln
-                    gdf[bi, qi] += int(sh["df"][tid])
                     max_len = max(max_len, ln)
         idf = idf_weight(self.n_docs_total, gdf).astype(np.float32)
         idf[gdf == 0] = 0.0
-        return starts, lengths, idf * weights, max_len
+        idfw = idf * weights
+        dense_w = np.where(dense_hit, idfw[:, None, :], 0.0) \
+            .astype(np.float32)
+        W = np.zeros((B, S, max(T, 1)), np.float32)
+        if any_dense:
+            bi_ix, si_ix, qi_ix = np.nonzero(dense_hit)
+            np.add.at(W, (bi_ix, si_ix, dense_rid[bi_ix, si_ix, qi_ix]),
+                      idfw[bi_ix, qi_ix])
+        return (starts, lengths, idfw, dense_rid, dense_w, W, max_len,
+                any_dense)
 
     def search(self, queries: Sequence[Sequence[str]], k: int = 10,
                *, Q: Optional[int] = None, L: Optional[int] = None):
