@@ -430,7 +430,8 @@ class DistributedSearchPlane:
             raise ValueError(
                 f"Q={Q} would drop terms from a {needed_q}-term query; "
                 f"pass Q=None to size automatically")
-        starts, lengths, idfw, max_len = self._lookup(queries, Q)
+        (starts, lengths, idfw, dense_rid, dense_w, W, max_len,
+         any_dense) = self._lookup(queries, Q)
         if L is None:
             L = round_up_pow2(max_len)
         elif L < max_len:
@@ -438,16 +439,28 @@ class DistributedSearchPlane:
                 f"L={L} would truncate a postings run of length {max_len}; "
                 f"pass L=None to size automatically")
         # L may never exceed the table's sentinel slack (slices would clamp
-        # into foreign runs); L_cap >= max_df, so no real run is truncated
+        # into foreign runs); L_cap >= max_sparse_df, so no real sparse run
+        # is truncated
         L = min(L, self.L_cap)
         np.minimum(lengths, L, out=lengths)
-        step = self._get_step(Q, L, k)
         repl = NamedSharding(self.mesh, P(AXIS_REPLICA, None))
         repl3 = NamedSharding(self.mesh, P(AXIS_REPLICA, AXIS_SHARD, None))
-        vals, gdocs = step(
-            self.docs_dev, self.impacts_dev,
-            jax.device_put(starts, repl3), jax.device_put(lengths, repl3),
-            jax.device_put(idfw, repl))
+        if any_dense:
+            step = self._get_step(Q, L, k, tiered=True)
+            vals, gdocs = step(
+                self.docs_dev, self.impacts_dev, self.dense_dev,
+                jax.device_put(starts, repl3),
+                jax.device_put(lengths, repl3),
+                jax.device_put(idfw, repl),
+                jax.device_put(dense_rid, repl3),
+                jax.device_put(dense_w, repl3),
+                jax.device_put(W, repl3))
+        else:
+            step = self._get_step(Q, L, k)
+            vals, gdocs = step(
+                self.docs_dev, self.impacts_dev,
+                jax.device_put(starts, repl3), jax.device_put(lengths, repl3),
+                jax.device_put(idfw, repl))
         vals = np.asarray(vals)[:B]          # drop replica-padding slots
         gdocs = np.asarray(gdocs)[:B]
         hits = []
@@ -460,11 +473,18 @@ class DistributedSearchPlane:
             hits.append(row)
         return vals, hits
 
-    def _get_step(self, Q: int, L: int, k: int):
-        key = (Q, L, k)
+    def _get_step(self, Q: int, L: int, k: int, *, tiered: bool = False):
+        key = (Q, L, k, tiered)
         fn = self._steps.get(key)
         if fn is None:
-            fn = self._steps[key] = build_bm25_topk_step(
-                self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
-                n_shards=self.n_shards)
+            if tiered:
+                fn = build_tiered_bm25_step(
+                    self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
+                    T_pad=self.T_pad, C=self.dense_block,
+                    n_shards=self.n_shards)
+            else:
+                fn = build_bm25_topk_step(
+                    self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
+                    n_shards=self.n_shards)
+            self._steps[key] = fn
         return fn

@@ -256,3 +256,55 @@ def test_plane_odd_batch_with_replicas():
     assert len(hits) == 1 and len(hits[0]) == 3
     vals3, hits3 = plane.search([["quick", "fox"]] * 3, k=3)
     np.testing.assert_allclose(vals3[0], vals[0])
+
+
+@pytest.mark.parametrize("n_replicas", [1, 2])
+def test_tiered_plane_matches_bruteforce(n_replicas):
+    """Force Zipf-head terms into the dense tier (dense_threshold=1) and
+    check mixed dense/sparse, dense-only, and absent-term queries all match
+    the host brute force exactly."""
+    n_shards = 4
+    mesh = make_search_mesh(n_shards=4, n_replicas=n_replicas)
+    mapper, segs = _build_shards(n_shards)
+    plane = DistributedSearchPlane.from_segments(
+        mesh, segs, "body", dense_threshold=1)
+    assert plane.T_pad > 0, "dense tier must actually engage"
+    queries = [["the", "fox"],          # dense + sparse
+               ["the"],                 # dense-only
+               ["quick", "the", "river"],
+               ["dog", "dog", "the", "park"],   # dup weights across tiers
+               ["zzz_absent"]]
+    vals, hits = plane.search(queries, k=6)
+    for bi, q in enumerate(queries):
+        ref = _ref_bm25(q, n_shards)
+        expect = sorted(ref.items(), key=lambda kv: -kv[1])[:6]
+        got = []
+        for (shard, local), v in zip(hits[bi], vals[bi]):
+            doc_global = int(segs[shard].doc_uids[local])
+            got.append((doc_global, float(v)))
+        assert len(got) == len(expect), (q, got, expect)
+        for (gd, gv), (ed, ev) in zip(got, expect):
+            # bf16 dense impacts: ~3 decimal digits
+            assert abs(gv - ev) <= 0.01 * max(1.0, abs(ev)), (q, got, expect)
+
+
+def test_tiered_sparse_bound_decoupled_from_head_df():
+    """The sorted-merge L must be bounded by the sparse tier's max df, not
+    the corpus-wide max df (the round-1 L_cap blowup)."""
+    n_shards = 2
+    mesh = make_search_mesh(n_shards=2, n_replicas=1)
+    mapper, segs = _build_shards(n_shards)
+    plane = DistributedSearchPlane.from_segments(
+        mesh, segs, "body", dense_threshold=2)
+    # 'the' has per-shard df > 2 on this corpus → dense tier
+    all_dense_df = []
+    for sh in plane.shards:
+        tid = sh["term_ids"].get("the")
+        assert tid is not None and tid in sh["dense_row_of"]
+        all_dense_df.append(int(sh["df"][tid]))
+    assert max(all_dense_df) > plane.max_sparse_df >= 1
+    # L_cap derives from the SPARSE max df (pow2 with a tile-min floor of
+    # 8), never the head term's df, and the sparse df obeys the threshold
+    from elasticsearch_tpu.utils.shapes import round_up_pow2
+    assert plane.max_sparse_df <= 2
+    assert plane.L_cap == round_up_pow2(plane.max_sparse_df)
