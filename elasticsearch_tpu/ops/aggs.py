@@ -1,0 +1,198 @@
+"""Device kernels for aggregations: scatter-free masked ordinal reductions.
+
+The reference collects aggregations doc-at-a-time into BigArrays buckets
+(``search/aggregations/AggregatorBase.java``; the hot loop is
+``LeafBucketCollector.collect(doc, bucket)`` — SURVEY §3.2 hot loop 2).
+A TPU scatter-add over bucket ords would serialize, so these kernels use two
+scatter-free shapes instead:
+
+- **ordinal-CSR cumsum-diff** for high-cardinality keyword ordinals: with
+  doc-values pairs sorted by (ordinal, doc) and a CSR ``offsets[V+1]``, the
+  per-ordinal masked count is ``cumsum(mask_pairs)`` gathered at run
+  boundaries — one gather + one cumsum + one small gather, all vectorized.
+  Counts accumulate in int32, so they are **exact** (no float summation
+  order issues) and bitwise-match the host numpy path.
+- **one-hot matmul** for low-cardinality buckets (histograms): a
+  ``[M, nb]`` equality one-hot reduced over pairs — XLA fuses the compare +
+  sum; for f32 sums this rides the MXU.
+
+Masks arrive as the query's dense ``bool[n_pad]`` doc mask (the query tree
+output); pair docs are padded with the ``n_pad`` sentinel which gathers a
+``False``/0 via OOB-fill, so padding is inert.
+
+Precision contract: counts are int32-exact; value sums use f32 cumsum and
+are only used on the device path when the caller accepts f32 (the exact
+float64 reduction stays host-side, see ``search/aggregations.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: below this many doc-values pairs the host numpy path wins (dispatch
+#: overhead dominates); aggregations consult this before shipping to device
+DEVICE_MIN_PAIRS = 1 << 16
+
+#: one-hot histogram kernel cap: above this bucket count the [M, nb]
+#: one-hot is wasteful and the host path wins
+MAX_DEVICE_BUCKETS = 4096
+
+
+@jax.jit
+def masked_ordinal_counts(offsets, pair_docs, mask):
+    """Exact per-ordinal masked pair counts.
+
+    offsets:   int32[Vp+1] ordinal-CSR run boundaries (padded ordinals are
+               zero-length runs — ``offsets`` repeats its last value).
+    pair_docs: int32[Mp] owning doc per pair, sorted by (ordinal, doc),
+               padded with an out-of-range sentinel.
+    mask:      bool[n_pad] dense query doc mask.
+    Returns int32[Vp] counts.
+    """
+    m = jnp.take(mask, pair_docs, mode="fill", fill_value=False)
+    c = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                         jnp.cumsum(m.astype(jnp.int32))])
+    return jnp.take(c, offsets[1:]) - jnp.take(c, offsets[:-1])
+
+
+@jax.jit
+def masked_ordinal_sums(offsets, pair_docs, pair_vals, mask):
+    """Per-ordinal masked f32 value sums (same layout as
+    :func:`masked_ordinal_counts`; f32 cumsum — see precision contract)."""
+    m = jnp.take(mask, pair_docs, mode="fill", fill_value=False)
+    mv = jnp.where(m, pair_vals, 0.0)
+    s = jnp.concatenate([jnp.zeros(1, jnp.float32), jnp.cumsum(mv)])
+    return jnp.take(s, offsets[1:]) - jnp.take(s, offsets[:-1])
+
+
+@functools.partial(jax.jit, static_argnames=("n_buckets",))
+def masked_bucket_counts(bucket_ids, pair_docs, mask, *, n_buckets: int):
+    """Low-cardinality masked bucket counts via one-hot reduction.
+
+    bucket_ids: int32[Mp] precomputed bucket per pair (host computes these
+                exactly in f64 once per (field, interval) and caches the
+                device array); out-of-range ids fall outside [0, n_buckets).
+    Returns int32[n_buckets].
+    """
+    m = jnp.take(mask, pair_docs, mode="fill", fill_value=False)
+    onehot = (bucket_ids[:, None] == jnp.arange(n_buckets, dtype=jnp.int32)
+              [None, :]) & m[:, None]
+    return jnp.sum(onehot.astype(jnp.int32), axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("n_buckets",))
+def masked_bucket_sums(bucket_ids, pair_docs, pair_vals, mask,
+                       *, n_buckets: int):
+    """One-hot masked f32 value sums per bucket (MXU-friendly matmul)."""
+    m = jnp.take(mask, pair_docs, mode="fill", fill_value=False)
+    onehot = ((bucket_ids[:, None] ==
+               jnp.arange(n_buckets, dtype=jnp.int32)[None, :]) &
+              m[:, None]).astype(jnp.float32)
+    mv = jnp.where(m, pair_vals, 0.0)
+    return mv @ onehot
+
+
+@jax.jit
+def masked_metrics(pair_docs, pair_vals, mask):
+    """One-pass masked (count, sum, min, max) over a pair column.
+    Returns (f32 count, f32 sum, f32 min, f32 max) — min/max are +inf/-inf
+    when nothing matches."""
+    m = jnp.take(mask, pair_docs, mode="fill", fill_value=False)
+    cnt = jnp.sum(m.astype(jnp.float32))
+    s = jnp.sum(jnp.where(m, pair_vals, 0.0))
+    mn = jnp.min(jnp.where(m, pair_vals, jnp.inf))
+    mx = jnp.max(jnp.where(m, pair_vals, -jnp.inf))
+    return cnt, s, mn, mx
+
+
+def top_ordinals(counts, k: int):
+    """(counts desc, ordinal asc) top-k over a device counts vector.
+    Ties resolve to the lower ordinal (term-dictionary order — the
+    reference's ``BytesRef`` compare)."""
+    kk = min(k, counts.shape[0])
+    vals, ords = jax.lax.top_k(counts, kk)
+    return np.asarray(vals), np.asarray(ords)
+
+
+# ---------------------------------------------------------------------------
+# per-segment device caches (ordinal CSR, histogram bucket ids)
+# ---------------------------------------------------------------------------
+
+
+def _pad_pow2(arr: np.ndarray, fill) -> np.ndarray:
+    from ..utils.shapes import round_up_pow2
+    size = round_up_pow2(max(arr.shape[0], 1))
+    if arr.shape[0] == size:
+        return arr
+    out = np.full(size, fill, dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+def _seg_cache(seg) -> dict:
+    # lives on the segment so it dies with it (no id()-keyed global map
+    # that could collide after GC)
+    c = getattr(seg, "_agg_dev_cache", None)
+    if c is None:
+        c = seg._agg_dev_cache = {}
+    return c
+
+
+def ordinal_csr(seg, field: str):
+    """Lazy per-(segment, field) ordinal-CSR device arrays for keyword
+    doc-values: pairs re-sorted by (ordinal, doc) + padded offsets.
+    Returns (offsets_dev i32[Vp+1], pair_docs_dev i32[Mp], V)."""
+    cache = _seg_cache(seg)
+    key = ("ord_csr", field)
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    f = seg.keyword_fields[field]
+    order = np.lexsort((f.dv_docs_host, f.dv_ords_host))
+    sdocs = f.dv_docs_host[order]
+    sords = f.dv_ords_host[order]
+    v = len(f.ord_terms)
+    offsets = np.zeros(v + 1, np.int32)
+    np.cumsum(np.bincount(sords, minlength=v).astype(np.int32),
+              out=offsets[1:])
+    off_pad = _pad_pow2(offsets, offsets[-1])
+    docs_pad = _pad_pow2(sdocs, seg.n_pad)
+    hit = (jnp.asarray(off_pad), jnp.asarray(docs_pad), v)
+    cache[key] = hit
+    return hit
+
+
+def histogram_bucket_ids(seg, field: str, interval: float, offset: float):
+    """Lazy per-(segment, field, interval, offset) device bucket-id arrays
+    for numeric histograms. Bucket ids are computed host-side in exact f64
+    once, then reused across queries with different masks.
+    Returns (ids_dev i32[Mp], pair_docs_dev i32[Mp], n_buckets, base)."""
+    cache = _seg_cache(seg)
+    key = ("hist", field, interval, offset)
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    f = seg.numeric_fields[field]
+    keys = np.floor((f.vals_host - offset) / interval)
+    base = float(keys.min()) if keys.size else 0.0
+    # bucket span in exact f64 BEFORE any int32 cast: a wide value range
+    # must report its true n_buckets so the caller's cardinality guard
+    # falls back to the host path instead of silently wrapping
+    span = float(keys.max() - base) if keys.size else -1.0
+    n_buckets = int(span) + 1 if keys.size else 0
+    if n_buckets > MAX_DEVICE_BUCKETS:
+        # too many buckets for the one-hot kernel (and beyond 2^31 the
+        # int32 cast would wrap) — callers take the host path
+        hit = (None, None, n_buckets, base)
+        cache[key] = hit
+        return hit
+    ids = (keys - base).astype(np.int32)
+    ids_pad = _pad_pow2(ids, np.int32(-1))
+    docs_pad = _pad_pow2(f.docs_host, seg.n_pad)
+    hit = (jnp.asarray(ids_pad), jnp.asarray(docs_pad), n_buckets, base)
+    cache[key] = hit
+    return hit

@@ -36,8 +36,20 @@ from ..index.mapping import (
     BooleanFieldType, DateFieldType, KeywordFieldType, MapperService,
     NumberFieldType, format_date_millis, parse_date_millis)
 from ..index.segment import Segment
+from ..ops import aggs as ops_aggs
 
 INT_TYPES = {"long", "integer", "short", "byte"}
+
+
+def _device_mask(seg, mask: np.ndarray):
+    """Upload a host doc mask padded to the segment's n_pad (pair-doc
+    sentinels gather False via OOB-fill)."""
+    import jax.numpy as jnp
+    if mask.shape[0] == seg.n_pad:
+        return jnp.asarray(mask)
+    padded = np.zeros(seg.n_pad, bool)
+    padded[: mask.shape[0]] = mask
+    return jnp.asarray(padded)
 
 
 # ---------------------------------------------------------------------------
@@ -571,13 +583,25 @@ class TermsAgg(BucketAggregator):
         kw = _keyword_pairs(seg, self.field)
         if kw is not None:
             docs, ords, terms = kw
-            pm = mask[docs]
-            sel_ords, counts = np.unique(ords[pm], return_counts=True)
+            if docs.shape[0] >= ops_aggs.DEVICE_MIN_PAIRS:
+                # device hot path: ordinal-CSR cumsum-diff counts (exact
+                # int32 — bitwise-identical to the numpy unique path)
+                off_dev, pdocs_dev, V = ops_aggs.ordinal_csr(seg, self.field)
+                counts_all = np.asarray(ops_aggs.masked_ordinal_counts(
+                    off_dev, pdocs_dev, _device_mask(seg, mask)))[:V]
+                sel_ords = np.flatnonzero(counts_all)
+                counts = counts_all[sel_ords]
+                pm = None
+            else:
+                pm = mask[docs]
+                sel_ords, counts = np.unique(ords[pm], return_counts=True)
             if self.subs:
                 order = np.argsort(-counts, kind="stable")
                 if order.size > self.shard_size:
                     trunc_err = int(counts[order[self.shard_size - 1]])
                     order = order[: self.shard_size]
+                if pm is None and order.size:
+                    pm = mask[docs]
                 for i in order:
                     o = int(sel_ords[i])
                     bucket_docs = np.zeros(mask.shape[0], bool)
@@ -586,7 +610,7 @@ class TermsAgg(BucketAggregator):
                                                         mask & bucket_docs)
             else:
                 for i, c in zip(sel_ords.tolist(), counts.tolist()):
-                    buckets[terms[i]] = (c, {})
+                    buckets[terms[i]] = (int(c), {})
         else:
             num = _numeric_pairs(seg, self.field)
             if num is not None:
@@ -708,6 +732,20 @@ class HistogramAgg(BucketAggregator):
         if num is None:
             return {}
         docs, vals = num
+        if (docs.shape[0] >= ops_aggs.DEVICE_MIN_PAIRS and not self.subs):
+            # device hot path: cached exact bucket ids + one-hot counts
+            ids_dev, pdocs_dev, n_buckets, base = \
+                ops_aggs.histogram_bucket_ids(seg, self.field, self.interval,
+                                              self.offset)
+            if ids_dev is not None and n_buckets:
+                counts = np.asarray(ops_aggs.masked_bucket_counts(
+                    ids_dev, pdocs_dev, _device_mask(seg, mask),
+                    n_buckets=n_buckets))
+                out = {}
+                for bid in np.flatnonzero(counts):
+                    key = (base + bid) * self.interval + self.offset
+                    out[float(key)] = (int(counts[bid]), {})
+                return out
         pm = mask[docs]
         ids = self._bucket_ids(vals[pm])
         out = {}

@@ -1,0 +1,111 @@
+"""Device aggregation kernels (ops/aggs.py): parity with the host numpy
+path, forced on by shrinking DEVICE_MIN_PAIRS so the small fixtures take
+the device route."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.ops import aggs as ops_aggs
+from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+MAPPING = {"properties": {
+    "tag": {"type": "keyword"},
+    "price": {"type": "double"},
+    "body": {"type": "text"},
+}}
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    rng = np.random.RandomState(3)
+    mapper = MapperService(MAPPING)
+    segs = []
+    for si in range(2):
+        b = SegmentBuilder(f"_d{si}")
+        for i in range(150):
+            did = si * 1000 + i
+            b.add(mapper.parse_document(str(did), {
+                "tag": f"k{rng.randint(12)}",
+                "price": float(rng.randint(100)),
+                "body": "common" if i % 3 else "rare",
+            }), seq_no=did)
+        segs.append(b.build())
+    return ShardSearcher(segs, mapper)
+
+
+def _run(searcher, aggs, query=None):
+    body = {"aggs": aggs, "size": 0}
+    if query:
+        body["query"] = query
+    return searcher.search(body).aggregations
+
+
+@pytest.mark.parametrize("query", [
+    None, {"match": {"body": "common"}}, {"match": {"body": "rare"}}])
+def test_terms_device_matches_host(searcher, query, monkeypatch):
+    host = _run(searcher, {"t": {"terms": {"field": "tag", "size": 20}}},
+                query)
+    monkeypatch.setattr(ops_aggs, "DEVICE_MIN_PAIRS", 1)
+    dev = _run(searcher, {"t": {"terms": {"field": "tag", "size": 20}}},
+               query)
+    assert dev == host   # int32-exact kernel: bitwise-identical buckets
+
+
+@pytest.mark.parametrize("query", [None, {"match": {"body": "common"}}])
+def test_histogram_device_matches_host(searcher, query, monkeypatch):
+    spec = {"h": {"histogram": {"field": "price", "interval": 10}}}
+    host = _run(searcher, spec, query)
+    monkeypatch.setattr(ops_aggs, "DEVICE_MIN_PAIRS", 1)
+    dev = _run(searcher, spec, query)
+    assert dev == host
+
+
+def test_terms_device_with_subagg_matches_host(searcher, monkeypatch):
+    spec = {"t": {"terms": {"field": "tag", "size": 5},
+                  "aggs": {"p": {"avg": {"field": "price"}}}}}
+    host = _run(searcher, spec)
+    monkeypatch.setattr(ops_aggs, "DEVICE_MIN_PAIRS", 1)
+    dev = _run(searcher, spec)
+    assert dev == host
+
+
+def test_ordinal_kernel_against_numpy():
+    rng = np.random.RandomState(0)
+    import jax.numpy as jnp
+    n_pad, V, M = 1 << 10, 37, 5000
+    docs = rng.randint(0, 700, M).astype(np.int32)
+    ords = rng.randint(0, V, M).astype(np.int32)
+    order = np.lexsort((docs, ords))
+    docs, ords = docs[order], ords[order]
+    offsets = np.zeros(V + 1, np.int32)
+    np.cumsum(np.bincount(ords, minlength=V).astype(np.int32),
+              out=offsets[1:])
+    mask = rng.rand(n_pad) < 0.4
+    got = np.asarray(ops_aggs.masked_ordinal_counts(
+        jnp.asarray(offsets), jnp.asarray(docs), jnp.asarray(mask)))
+    want = np.bincount(ords[mask[docs]], minlength=V)
+    np.testing.assert_array_equal(got, want)
+    vals = rng.rand(M).astype(np.float32)
+    got_s = np.asarray(ops_aggs.masked_ordinal_sums(
+        jnp.asarray(offsets), jnp.asarray(docs), jnp.asarray(vals),
+        jnp.asarray(mask)))
+    want_s = np.zeros(V, np.float64)
+    np.add.at(want_s, ords[mask[docs]], vals[mask[docs]])
+    np.testing.assert_allclose(got_s, want_s, rtol=1e-4)
+
+
+def test_masked_metrics_kernel():
+    rng = np.random.RandomState(1)
+    import jax.numpy as jnp
+    n_pad, M = 256, 1000
+    docs = rng.randint(0, 200, M).astype(np.int32)
+    vals = rng.randn(M).astype(np.float32)
+    mask = rng.rand(n_pad) < 0.5
+    cnt, s, mn, mx = [np.asarray(x) for x in ops_aggs.masked_metrics(
+        jnp.asarray(docs), jnp.asarray(vals), jnp.asarray(mask))]
+    pm = mask[docs]
+    assert cnt == pm.sum()
+    np.testing.assert_allclose(s, vals[pm].sum(), rtol=1e-5)
+    assert mn == vals[pm].min() and mx == vals[pm].max()
