@@ -114,3 +114,8 @@ class SnapshotMissingError(ElasticsearchError):
 class PipelineError(ElasticsearchError):
     status = 400
     error_type = "pipeline_processing_exception"
+
+
+class ResourceNotFoundError(ElasticsearchError):
+    status = 404
+    error_type = "resource_not_found_exception"

@@ -1,0 +1,388 @@
+"""Ingest pipelines: processor semantics, failure chains, bulk integration,
+simulate API. Reference behaviors: ``ingest/IngestService.java:437``,
+``ingest/CompoundProcessor.java``, ``modules/ingest-common`` processor
+semantics, ``RestSimulatePipelineAction``."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.errors import ElasticsearchError
+from elasticsearch_tpu.ingest import IngestDocument, IngestService, Pipeline
+from elasticsearch_tpu.ingest.pipeline import eval_ingest_expr
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+@pytest.fixture()
+def api(tmp_path):
+    return RestAPI(IndicesService(str(tmp_path)))
+
+
+def req(api, method, path, body=None, query=""):
+    raw = b""
+    if body is not None:
+        raw = (json.dumps(body) if isinstance(body, (dict, list))
+               else body).encode() if not isinstance(body, bytes) else body
+    status, _ct, payload = api.handle(method, path, query, raw)
+    try:
+        return status, json.loads(payload)
+    except (ValueError, UnicodeDecodeError):
+        return status, payload
+
+
+def bulk_lines(*ops):
+    return "\n".join(json.dumps(o) for o in ops) + "\n"
+
+
+def run_pipeline(config, source, index="i", doc_id="1"):
+    svc = IngestService()
+    svc.put_pipeline("p", config)
+    out = svc.run("p", index, doc_id, source)
+    return None if out is None else out.source
+
+
+# ---------------------------------------------------------------------------
+# processors
+# ---------------------------------------------------------------------------
+
+
+def test_set_remove_rename_append():
+    out = run_pipeline({"processors": [
+        {"set": {"field": "a.b", "value": 5}},
+        {"set": {"field": "copied", "copy_from": "a"}},
+        {"rename": {"field": "old", "target_field": "new"}},
+        {"remove": {"field": "gone"}},
+        {"append": {"field": "tags", "value": ["x", "y"]}},
+        {"append": {"field": "tags", "value": "x"}},
+    ]}, {"old": 1, "gone": 2, "tags": "t0"})
+    assert out == {"a": {"b": 5}, "copied": {"b": 5}, "new": 1,
+                   "tags": ["t0", "x", "y", "x"]}
+
+
+def test_set_templating_and_override():
+    out = run_pipeline({"processors": [
+        {"set": {"field": "greeting", "value": "hi {{user.name}}"}},
+        {"set": {"field": "user.name", "value": "nope",
+                 "override": False}},
+    ]}, {"user": {"name": "kim"}})
+    assert out["greeting"] == "hi kim"
+    assert out["user"]["name"] == "kim"
+
+
+def test_convert_and_bytes_and_case():
+    out = run_pipeline({"processors": [
+        {"convert": {"field": "n", "type": "integer"}},
+        {"convert": {"field": "f", "type": "float"}},
+        {"convert": {"field": "b", "type": "boolean"}},
+        {"convert": {"field": "auto", "type": "auto"}},
+        {"bytes": {"field": "size"}},
+        {"lowercase": {"field": "shout"}},
+        {"uppercase": {"field": "whisper"}},
+        {"trim": {"field": "pad"}},
+    ]}, {"n": "42", "f": "2.5", "b": "TRUE", "auto": "7",
+         "size": "2kb", "shout": "LOUD", "whisper": "soft",
+         "pad": "  x  "})
+    assert out == {"n": 42, "f": 2.5, "b": True, "auto": 7, "size": 2048,
+                   "shout": "loud", "whisper": "SOFT", "pad": "x"}
+
+
+def test_split_join_gsub_urldecode_htmlstrip():
+    out = run_pipeline({"processors": [
+        {"split": {"field": "csv", "separator": ","}},
+        {"join": {"field": "csv", "separator": "|",
+                  "target_field": "joined"}},
+        {"gsub": {"field": "s", "pattern": r"\d+", "replacement": "#"}},
+        {"urldecode": {"field": "url"}},
+        {"html_strip": {"field": "html"}},
+    ]}, {"csv": "a,b,c", "s": "x1y22", "url": "a%20b",
+         "html": "<b>bold</b>"})
+    assert out["csv"] == ["a", "b", "c"]
+    assert out["joined"] == "a|b|c"
+    assert out["s"] == "x#y#"
+    assert out["url"] == "a b"
+    assert out["html"] == "bold"
+
+
+def test_date_processor_formats():
+    out = run_pipeline({"processors": [
+        {"date": {"field": "t1", "formats": ["ISO8601"],
+                  "target_field": "iso"}},
+        {"date": {"field": "t2", "formats": ["UNIX"],
+                  "target_field": "unix"}},
+        {"date": {"field": "t3", "formats": ["yyyy-MM-dd"],
+                  "target_field": "ymd"}},
+    ]}, {"t1": "2024-03-01T10:00:00Z", "t2": 1709287200,
+         "t3": "2024-03-01"})
+    assert out["iso"].startswith("2024-03-01T10:00:00")
+    assert out["unix"].startswith("2024-03-01T")
+    assert out["ymd"].startswith("2024-03-01")
+
+
+def test_grok_and_dissect():
+    out = run_pipeline({"processors": [{"grok": {
+        "field": "msg",
+        "patterns": ["%{IP:client.ip} %{WORD:method} %{NUMBER:bytes:int}"],
+    }}]}, {"msg": "10.1.2.3 GET 1234"})
+    assert out["client"]["ip"] == "10.1.2.3"
+    assert out["method"] == "GET"
+    assert out["bytes"] == 1234
+
+    out = run_pipeline({"processors": [{"dissect": {
+        "field": "line", "pattern": "%{ts} [%{level}] %{msg}"}}]},
+        {"line": "t0 [WARN] disk full"})
+    assert out == {"line": "t0 [WARN] disk full", "ts": "t0",
+                   "level": "WARN", "msg": "disk full"}
+
+
+def test_json_and_kv():
+    out = run_pipeline({"processors": [
+        {"json": {"field": "payload"}},
+        {"kv": {"field": "q", "field_split": "&", "value_split": "=",
+                "target_field": "params"}},
+    ]}, {"payload": "{\"a\": 1}", "q": "x=1&y=2"})
+    assert out["payload"] == {"a": 1}
+    assert out["params"] == {"x": "1", "y": "2"}
+
+
+def test_script_processor_and_conditions():
+    out = run_pipeline({"processors": [
+        {"script": {"source": "ctx.total = ctx.price * ctx.qty"}},
+        {"set": {"field": "big", "value": True,
+                 "if": "ctx.total > 100"}},
+        {"set": {"field": "small", "value": True,
+                 "if": "ctx.total < 100"}},
+        {"set": {"field": "tagged", "value": True,
+                 "if": "ctx.kind == 'sale'"}},
+    ]}, {"price": 30, "qty": 5, "kind": "sale"})
+    assert out["total"] == 150
+    assert out["big"] is True
+    assert "small" not in out
+    assert out["tagged"] is True
+
+
+def test_eval_expr_string_safety():
+    assert eval_ingest_expr("ctx.a == 'x'", {"a": "x"}) is True
+    assert eval_ingest_expr("ctx.a.b + 1", {"a_b": 2}) == 3
+    # mixed-type comparisons are false, not errors (painless-ish leniency)
+    assert eval_ingest_expr("ctx.a > 3", {"a": "zzz"}) is False
+
+
+def test_drop_and_fail():
+    assert run_pipeline({"processors": [
+        {"drop": {"if": "ctx.skip == 1"}},
+        {"set": {"field": "kept", "value": True}},
+    ]}, {"skip": 1}) is None
+    out = run_pipeline({"processors": [
+        {"drop": {"if": "ctx.skip == 1"}},
+        {"set": {"field": "kept", "value": True}},
+    ]}, {"skip": 0})
+    assert out["kept"] is True
+    with pytest.raises(ElasticsearchError) as ei:
+        run_pipeline({"processors": [
+            {"fail": {"message": "bad doc {{id}}"}}]}, {"id": 7})
+    assert "bad doc 7" in str(ei.value)
+
+
+def test_on_failure_chain_and_ignore_failure():
+    out = run_pipeline({"processors": [
+        {"rename": {"field": "absent", "target_field": "x",
+                    "on_failure": [{"set": {
+                        "field": "err",
+                        "value": "{{_ingest.on_failure_message}}"}}]}},
+    ]}, {})
+    assert "absent" in out["err"]
+    out = run_pipeline({"processors": [
+        {"rename": {"field": "absent", "target_field": "x",
+                    "ignore_failure": True}},
+        {"set": {"field": "after", "value": 1}},
+    ]}, {})
+    assert out == {"after": 1}
+    # pipeline-level on_failure
+    out = run_pipeline({
+        "processors": [{"rename": {"field": "absent",
+                                   "target_field": "x"}}],
+        "on_failure": [{"set": {"field": "fallback", "value": True}}],
+    }, {})
+    assert out == {"fallback": True}
+
+
+def test_pipeline_processor_and_cycle_detection():
+    svc = IngestService()
+    svc.put_pipeline("inner", {"processors": [
+        {"set": {"field": "inner_ran", "value": True}}]})
+    svc.put_pipeline("outer", {"processors": [
+        {"pipeline": {"name": "inner"}},
+        {"set": {"field": "outer_ran", "value": True}}]})
+    out = svc.run("outer", "i", "1", {})
+    assert out.source == {"inner_ran": True, "outer_ran": True}
+
+    svc.put_pipeline("a", {"processors": [{"pipeline": {"name": "b"}}]})
+    svc.put_pipeline("b", {"processors": [{"pipeline": {"name": "a"}}]})
+    with pytest.raises(ElasticsearchError) as ei:
+        svc.run("a", "i", "1", {})
+    assert "Cycle" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# REST integration
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_crud_rest(api):
+    st, _ = req(api, "PUT", "/_ingest/pipeline/p1", {"processors": [
+        {"set": {"field": "v", "value": 1}}]})
+    assert st == 200
+    st, out = req(api, "GET", "/_ingest/pipeline/p1")
+    assert st == 200 and "p1" in out
+    st, out = req(api, "GET", "/_ingest/pipeline")
+    assert "p1" in out
+    st, _ = req(api, "DELETE", "/_ingest/pipeline/p1")
+    assert st == 200
+    st, _ = req(api, "GET", "/_ingest/pipeline/p1")
+    assert st == 404
+    st, _ = req(api, "DELETE", "/_ingest/pipeline/p1")
+    assert st == 404
+
+
+def test_bulk_with_pipeline_param(api):
+    req(api, "PUT", "/_ingest/pipeline/tagger", {"processors": [
+        {"set": {"field": "tagged", "value": True}},
+        {"drop": {"if": "ctx.secret == 1"}},
+    ]})
+    st, out = req(api, "POST", "/_bulk", bulk_lines(
+        {"index": {"_index": "i", "_id": "1"}}, {"n": 1},
+        {"index": {"_index": "i", "_id": "2"}}, {"n": 2, "secret": 1},
+    ), query="pipeline=tagger&refresh=true")
+    assert st == 200 and not out["errors"]
+    assert out["items"][1]["index"]["result"] == "noop"
+    st, d1 = req(api, "GET", "/i/_doc/1")
+    assert d1["_source"] == {"n": 1, "tagged": True}
+    st, _ = req(api, "GET", "/i/_doc/2")
+    assert st == 404
+
+
+def test_default_and_final_pipeline_settings(api):
+    req(api, "PUT", "/_ingest/pipeline/dflt", {"processors": [
+        {"set": {"field": "from_default", "value": True}}]})
+    req(api, "PUT", "/_ingest/pipeline/fin", {"processors": [
+        {"set": {"field": "from_final", "value": True}}]})
+    req(api, "PUT", "/idx", {"settings": {
+        "index": {"default_pipeline": "dflt", "final_pipeline": "fin"}}})
+    req(api, "PUT", "/idx/_doc/1", {"n": 1}, query="refresh=true")
+    _, doc = req(api, "GET", "/idx/_doc/1")
+    assert doc["_source"] == {"n": 1, "from_default": True,
+                              "from_final": True}
+    # explicit pipeline param overrides default, final still runs
+    req(api, "PUT", "/_ingest/pipeline/other", {"processors": [
+        {"set": {"field": "from_other", "value": True}}]})
+    req(api, "PUT", "/idx/_doc/2", {"n": 2},
+        query="pipeline=other&refresh=true")
+    _, doc = req(api, "GET", "/idx/_doc/2")
+    assert doc["_source"] == {"n": 2, "from_other": True,
+                              "from_final": True}
+    # pipeline=_none skips the default
+    req(api, "PUT", "/idx/_doc/3", {"n": 3},
+        query="pipeline=_none&refresh=true")
+    _, doc = req(api, "GET", "/idx/_doc/3")
+    assert doc["_source"] == {"n": 3, "from_final": True}
+
+
+def test_bulk_item_error_on_pipeline_failure(api):
+    req(api, "PUT", "/_ingest/pipeline/strict", {"processors": [
+        {"fail": {"message": "rejected", "if": "ctx.bad == 1"}}]})
+    st, out = req(api, "POST", "/_bulk", bulk_lines(
+        {"index": {"_index": "i", "_id": "a"}}, {"bad": 1},
+        {"index": {"_index": "i", "_id": "b"}}, {"bad": 0},
+    ), query="pipeline=strict&refresh=true")
+    assert out["errors"] is True
+    assert "error" in out["items"][0]["index"]
+    assert out["items"][1]["index"]["status"] == 201
+    st, _ = req(api, "GET", "/i/_doc/b")
+    assert st == 200
+
+
+def test_simulate_api(api):
+    body = {"pipeline": {"processors": [
+        {"set": {"field": "x", "value": 1}},
+        {"uppercase": {"field": "name"}}]},
+        "docs": [{"_source": {"name": "ada"}},
+                 {"_source": {"name": 7}}]}
+    st, out = req(api, "POST", "/_ingest/pipeline/_simulate", body)
+    assert st == 200
+    assert out["docs"][0]["doc"]["_source"] == {"name": "ADA", "x": 1}
+    assert "error" in out["docs"][1]
+    # simulate an existing pipeline by id, verbose
+    req(api, "PUT", "/_ingest/pipeline/pv", {"processors": [
+        {"set": {"field": "a", "value": 1}},
+        {"set": {"field": "b", "value": 2}}]})
+    st, out = req(api, "POST", "/_ingest/pipeline/pv/_simulate",
+                  {"docs": [{"_source": {}}]}, query="verbose=true")
+    steps = out["docs"][0]["processor_results"]
+    assert [s["status"] for s in steps] == ["success", "success"]
+    assert steps[1]["doc"]["_source"] == {"a": 1, "b": 2}
+
+
+def test_pipeline_level_on_failure_halts_remaining(api):
+    req(api, "PUT", "/_ingest/pipeline/halt", {
+        "processors": [
+            {"fail": {"message": "boom"}},
+            {"set": {"field": "should_not_run", "value": True}}],
+        "on_failure": [{"set": {"field": "handled", "value": True}}]})
+    req(api, "PUT", "/h/_doc/1", {"v": 1},
+        query="pipeline=halt&refresh=true")
+    _, doc = req(api, "GET", "/h/_doc/1")
+    assert doc["_source"] == {"v": 1, "handled": True}
+    # processor-level on_failure continues with the rest of the pipeline
+    req(api, "PUT", "/_ingest/pipeline/cont", {"processors": [
+        {"fail": {"message": "boom",
+                  "on_failure": [{"set": {"field": "handled",
+                                          "value": True}}]}},
+        {"set": {"field": "did_run", "value": True}}]})
+    req(api, "PUT", "/h/_doc/2", {"v": 2},
+        query="pipeline=cont&refresh=true")
+    _, doc = req(api, "GET", "/h/_doc/2")
+    assert doc["_source"] == {"v": 2, "handled": True, "did_run": True}
+
+
+def test_pipeline_reroute_index_and_id(api):
+    req(api, "PUT", "/_ingest/pipeline/route", {"processors": [
+        {"set": {"field": "_index", "value": "rerouted"}},
+        {"set": {"field": "_id", "value": "new-id"}}]})
+    st, out = req(api, "PUT", "/orig/_doc/1", {"v": 1},
+                  query="pipeline=route&refresh=true")
+    assert out["_index"] == "rerouted" and out["_id"] == "new-id"
+    st, _ = req(api, "GET", "/rerouted/_doc/new-id")
+    assert st == 200
+    st, _ = req(api, "GET", "/orig/_doc/1")
+    assert st == 404
+    # same through bulk
+    st, out = req(api, "POST", "/_bulk", bulk_lines(
+        {"index": {"_index": "orig", "_id": "2"}}, {"v": 2},
+    ), query="pipeline=route&refresh=true")
+    assert out["items"][0]["index"]["_index"] == "rerouted"
+    st, _ = req(api, "GET", "/rerouted/_doc/new-id")
+    assert st == 200
+
+
+def test_inner_pipeline_drop_propagates():
+    svc = IngestService()
+    svc.put_pipeline("inner", {"processors": [{"drop": {}}]})
+    svc.put_pipeline("outer", {"processors": [
+        {"pipeline": {"name": "inner"}},
+        {"set": {"field": "after", "value": 1}}]})
+    assert svc.run("outer", "i", "1", {"a": 1}) is None
+
+
+def test_get_simulate_and_wildcard_pipeline_ids(api):
+    # GET inline simulate must not be shadowed by the {id} route
+    st, out = req(api, "GET", "/_ingest/pipeline/_simulate",
+                  {"pipeline": {"processors": [
+                      {"set": {"field": "x", "value": 1}}]},
+                   "docs": [{"_source": {}}]})
+    assert st == 200 and out["docs"][0]["doc"]["_source"] == {"x": 1}
+    # wildcard ids are glob, not regex: '.' is literal
+    req(api, "PUT", "/_ingest/pipeline/my.pipe", {"processors": []})
+    req(api, "PUT", "/_ingest/pipeline/myxpipe", {"processors": []})
+    st, out = req(api, "GET", "/_ingest/pipeline/my.pipe*")
+    assert list(out) == ["my.pipe"]
