@@ -21,7 +21,9 @@ from ..common.errors import (DocumentMissingError, ElasticsearchError,
                              ParsingError, ResourceAlreadyExistsError,
                              VersionConflictError)
 from ..index.mapping import MapperService
+from ..ingest import IngestService
 from ..node.indices_service import IndexService, IndicesService
+from ..snapshots import SnapshotsService
 from ..search.shard_search import ShardHit, ShardSearcher
 
 JSON_CT = "application/json"
@@ -71,6 +73,8 @@ class RestAPI:
         self.templates: Dict[str, dict] = {}
         self.scrolls: Dict[str, dict] = {}
         self.pits: Dict[str, dict] = {}
+        self.ingest = IngestService()
+        self.snapshots = SnapshotsService(indices)
         self._routes: List[Tuple[str, re.Pattern, List[str], Callable]] = []
         self._build_routes()
 
@@ -125,6 +129,28 @@ class RestAPI:
         add("GET,POST", "/{index}/_field_caps", self.h_field_caps)
         add("POST", "/{index}/_pit", self.h_open_pit)
         add("DELETE", "/_pit", self.h_close_pit)
+        # snapshots / repositories
+        add("PUT,POST", "/_snapshot/{repo}", self.h_put_repo)
+        add("GET", "/_snapshot", self.h_get_repo)
+        add("GET", "/_snapshot/{repo}", self.h_get_repo)
+        add("DELETE", "/_snapshot/{repo}", self.h_delete_repo)
+        add("PUT,POST", "/_snapshot/{repo}/{snap}", self.h_create_snapshot)
+        add("GET", "/_snapshot/{repo}/{snap}", self.h_get_snapshot)
+        add("GET", "/_snapshot/{repo}/{snap}/_status",
+            self.h_snapshot_status)
+        add("DELETE", "/_snapshot/{repo}/{snap}", self.h_delete_snapshot)
+        add("POST", "/_snapshot/{repo}/{snap}/_restore",
+            self.h_restore_snapshot)
+        # ingest pipelines (_simulate before {id}: routes match in
+        # registration order and {id} would swallow the literal _simulate)
+        add("POST,GET", "/_ingest/pipeline/_simulate",
+            self.h_simulate_pipeline)
+        add("POST,GET", "/_ingest/pipeline/{id}/_simulate",
+            self.h_simulate_pipeline)
+        add("PUT", "/_ingest/pipeline/{id}", self.h_put_pipeline)
+        add("GET", "/_ingest/pipeline/{id}", self.h_get_pipeline)
+        add("GET", "/_ingest/pipeline", self.h_get_pipeline)
+        add("DELETE", "/_ingest/pipeline/{id}", self.h_delete_pipeline)
         # bulk + by-query
         add("POST,PUT", "/_bulk", self.h_bulk)
         add("POST,PUT", "/{index}/_bulk", self.h_bulk)
@@ -602,8 +628,21 @@ class RestAPI:
     def h_index_doc(self, params, body, index, id):
         svc = self._get_or_autocreate(index)
         op_type = params.get("op_type", "index")
-        r = svc.index_doc(id, _json_body(body),
-                          routing=params.get("routing"), op_type=op_type,
+        ingested = self._run_ingest(svc, index, id, _json_body(body),
+                                    params.get("routing"),
+                                    params.get("pipeline"))
+        if ingested is None:                 # dropped by a drop processor
+            return {"_index": index, "_id": id, "_version": -3,
+                    "result": "noop", "_shards": {"total": 0,
+                                                  "successful": 0,
+                                                  "failed": 0}}
+        source, new_index, new_id, routing = ingested
+        if new_index != index:               # pipeline rerouted the doc
+            svc = self._get_or_autocreate(new_index)
+            index = new_index
+        id = new_id or id
+        r = svc.index_doc(id, source,
+                          routing=routing, op_type=op_type,
                           if_seq_no=_int_or_none(params.get("if_seq_no")),
                           if_primary_term=_int_or_none(
                               params.get("if_primary_term")))
@@ -721,6 +760,139 @@ class RestAPI:
     # bulk
     # ------------------------------------------------------------------
 
+    # ------------------------------------------------------------------
+    # snapshots (reference: snapshots/SnapshotsService.java,
+    # repositories/blobstore/BlobStoreRepository.java)
+    # ------------------------------------------------------------------
+
+    def h_put_repo(self, params, body, repo):
+        self.snapshots.put_repository(repo, _json_body(body))
+        return {"acknowledged": True}
+
+    def h_get_repo(self, params, body, repo=None):
+        repos = self.snapshots.repositories
+        if repo is None or repo in ("_all", "*"):
+            names = sorted(repos)
+        else:
+            names = [r for r in repo.split(",") if r in repos]
+            if not names:
+                self.snapshots.get_repository(repo)   # raises 404
+        return {n: {"type": "fs",
+                    "settings": {"location": repos[n].location}}
+                for n in names}
+
+    def h_delete_repo(self, params, body, repo):
+        self.snapshots.delete_repository(repo)
+        return {"acknowledged": True}
+
+    def h_create_snapshot(self, params, body, repo, snap):
+        payload = _json_body(body) if body else {}
+        meta = self.snapshots.create(
+            repo, snap, payload.get("indices"),
+            include_global_state=payload.get("include_global_state", True))
+        if params.get("wait_for_completion") in ("true", ""):
+            return {"snapshot": meta}
+        return {"accepted": True}
+
+    def h_get_snapshot(self, params, body, repo, snap):
+        snaps = self.snapshots.get(repo, snap)
+        return {"snapshots": snaps}
+
+    def h_snapshot_status(self, params, body, repo, snap):
+        return self.snapshots.status(repo, snap)
+
+    def h_delete_snapshot(self, params, body, repo, snap):
+        self.snapshots.delete(repo, snap)
+        return {"acknowledged": True}
+
+    def h_restore_snapshot(self, params, body, repo, snap):
+        payload = _json_body(body) if body else {}
+        return self.snapshots.restore(
+            repo, snap, payload.get("indices"),
+            rename_pattern=payload.get("rename_pattern"),
+            rename_replacement=payload.get("rename_replacement"))
+
+    # ------------------------------------------------------------------
+    # ingest pipelines (reference: ingest/IngestService.java:437,
+    # RestPutPipelineAction / RestSimulatePipelineAction)
+    # ------------------------------------------------------------------
+
+    def h_put_pipeline(self, params, body, id):
+        self.ingest.put_pipeline(id, _json_body(body))
+        return {"acknowledged": True}
+
+    def h_get_pipeline(self, params, body, id=None):
+        if id is None:
+            return {pid: p.config for pid, p in
+                    self.ingest.pipelines.items()}
+        import fnmatch
+        out = {}
+        for pid in id.split(","):
+            if "*" in pid:
+                for k, p in self.ingest.pipelines.items():
+                    if fnmatch.fnmatchcase(k, pid):
+                        out[k] = p.config
+            elif pid in self.ingest.pipelines:
+                out[pid] = self.ingest.pipelines[pid].config
+        if not out and "*" not in (id or ""):
+            return 404, {}
+        return out
+
+    def h_delete_pipeline(self, params, body, id):
+        self.ingest.delete_pipeline(id)
+        return {"acknowledged": True}
+
+    def h_simulate_pipeline(self, params, body, id=None):
+        from ..ingest.pipeline import Pipeline
+        payload = _json_body(body)
+        if id is not None:
+            pipeline = self.ingest.get_pipeline(id)
+        else:
+            if "pipeline" not in payload:
+                raise ParsingError("required property is missing: "
+                                   "[pipeline]")
+            pipeline = Pipeline("_simulate_pipeline", payload["pipeline"])
+            self.ingest._inject(pipeline)
+        docs = payload.get("docs")
+        if not isinstance(docs, list) or not docs:
+            raise ParsingError("must specify at least one document in "
+                               "[docs]")
+        verbose = params.get("verbose") in ("true", "")
+        return self.ingest.simulate(pipeline, docs, verbose=verbose)
+
+    def _run_ingest(self, svc: IndexService, index: str,
+                    doc_id: Optional[str], source: dict,
+                    routing: Optional[str],
+                    pipeline_param: Optional[str]):
+        """Apply request/default pipeline then final_pipeline. Returns
+        (source, index, doc_id, routing) honoring pipeline mutations of
+        ``_index``/``_id``/``_routing`` (the reference's reroute-on-ingest
+        in ``TransportBulkAction``), or None when the doc was dropped."""
+        pid = pipeline_param or svc.settings.get("index.default_pipeline")
+        if pid and pid != "_none":
+            doc = self.ingest.run(pid, index, doc_id, source, routing)
+            if doc is None:
+                return None
+            source = doc.source
+            new_index = doc.meta.get("_index") or index
+            if new_index != index:
+                # the TARGET index's final_pipeline applies after a
+                # reroute (TransportBulkAction re-resolves the pipeline)
+                index = new_index
+                svc = self._get_or_autocreate(index)
+            doc_id = doc.meta.get("_id") or doc_id
+            routing = doc.meta.get("_routing")
+        final = svc.settings.get("index.final_pipeline")
+        if final and final != "_none":
+            doc = self.ingest.run(final, index, doc_id, source, routing)
+            if doc is None:
+                return None
+            source = doc.source
+            index = doc.meta.get("_index") or index
+            doc_id = doc.meta.get("_id") or doc_id
+            routing = doc.meta.get("_routing")
+        return source, index, doc_id, routing
+
     def h_bulk(self, params, body, index=None):
         t0 = time.time()
         lines = body.split(b"\n")
@@ -770,8 +942,22 @@ class RestAPI:
                     status, resp = r if isinstance(r, tuple) else (200, r)
                     items.append({"update": dict(resp or {}, status=status)})
                 else:
+                    ingested = self._run_ingest(
+                        svc, idx, doc_id, source, meta.get("routing"),
+                        meta.get("pipeline") or params.get("pipeline"))
+                    if ingested is None:     # dropped by a drop processor
+                        items.append({verb: {
+                            "_index": idx, "_id": doc_id, "_version": -3,
+                            "result": "noop", "status": 200}})
+                        continue
+                    source, idx2, doc_id2, routing = ingested
+                    if idx2 != idx:          # pipeline rerouted the doc
+                        svc = self._get_or_autocreate(idx2)
+                        idx = idx2
+                        touched.add(idx)
+                    doc_id = doc_id2 or doc_id
                     r = svc.index_doc(doc_id, source,
-                                      routing=meta.get("routing"),
+                                      routing=routing,
                                       op_type=("create" if verb == "create"
                                                else "index"))
                     items.append({verb: dict(

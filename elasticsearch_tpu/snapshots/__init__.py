@@ -1,0 +1,3 @@
+from .repository import FsRepository, SnapshotsService
+
+__all__ = ["FsRepository", "SnapshotsService"]

@@ -1,0 +1,380 @@
+"""Snapshot/restore: content-addressed incremental backups to a blob store.
+
+Re-design of the reference's snapshot stack
+(``snapshots/SnapshotsService.java`` orchestrates, ``repositories/blobstore/
+BlobStoreRepository.java`` owns the blob layout, ``IndexShardSnapshot*``
+describe per-shard file manifests). The reference's layout is
+``indices/<uuid>/<shard>/__<blob>`` with per-shard generation files; here
+the same incrementality comes from **content addressing**: every shard file
+is stored once under its sha256, and a snapshot is metadata (shard file
+manifests + index settings/mappings) pointing at hashes. Unchanged segments
+between snapshots — the common case, segments are immutable — cost zero new
+bytes.
+
+Layout under the repository root::
+
+    blobs/<hh>/<sha256>          # deduplicated file contents
+    snap-<name>.json             # snapshot metadata + shard manifests
+    index.json                   # repository index: snapshot list
+
+Restore writes a shard's files back into a fresh store directory and lets
+the engine's normal recovery path open the commit point — restore *is*
+recovery, the same way the reference's restore is a recovery source
+(``RecoverySource.SnapshotRecoverySource``).
+
+Concurrency model: one snapshot/restore at a time per repository,
+synchronous (the reference queues these through the cluster state; the
+single-node control plane here runs them inline — the multi-node path goes
+through the coordinator once Phase-3 lands).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+import uuid as uuid_mod
+from typing import Dict, List, Optional
+
+from ..common.errors import (IllegalArgumentError, ResourceAlreadyExistsError,
+                             SnapshotError, SnapshotMissingError)
+
+
+class FsRepository:
+    """Filesystem blob store with content-addressed deduplication."""
+
+    def __init__(self, name: str, location: str, compress: bool = False):
+        self.name = name
+        self.location = location
+        self.compress = compress
+        os.makedirs(os.path.join(location, "blobs"), exist_ok=True)
+
+    # -- blob primitives ----------------------------------------------------
+
+    def _blob_path(self, digest: str) -> str:
+        return os.path.join(self.location, "blobs", digest[:2], digest)
+
+    def put_file(self, path: str) -> Dict[str, object]:
+        """Store one file; returns its manifest entry. Dedup by sha256 —
+        an existing blob is never rewritten (segments are immutable)."""
+        h = hashlib.sha256()
+        size = 0
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                h.update(chunk)
+                size += len(chunk)
+        digest = h.hexdigest()
+        blob = self._blob_path(digest)
+        if not os.path.exists(blob):
+            os.makedirs(os.path.dirname(blob), exist_ok=True)
+            tmp = blob + f".tmp.{os.getpid()}"
+            shutil.copyfile(path, tmp)
+            with open(tmp, "rb") as f:
+                os.fsync(f.fileno())
+            os.replace(tmp, blob)
+        return {"name": os.path.basename(path), "hash": digest,
+                "size": size}
+
+    def get_file(self, entry: dict, dest_dir: str) -> None:
+        blob = self._blob_path(entry["hash"])
+        if not os.path.exists(blob):
+            raise SnapshotError(
+                f"repository [{self.name}] is missing blob "
+                f"[{entry['hash']}] for file [{entry['name']}]")
+        shutil.copyfile(blob, os.path.join(dest_dir, entry["name"]))
+
+    # -- snapshot metadata --------------------------------------------------
+
+    def _index_path(self) -> str:
+        return os.path.join(self.location, "index.json")
+
+    def _snap_path(self, snapshot: str) -> str:
+        return os.path.join(self.location, f"snap-{snapshot}.json")
+
+    def read_index(self) -> dict:
+        try:
+            with open(self._index_path()) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {"snapshots": []}
+
+    def write_index(self, idx: dict) -> None:
+        tmp = self._index_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(idx, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._index_path())
+
+    def read_snapshot(self, snapshot: str) -> dict:
+        try:
+            with open(self._snap_path(snapshot)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            raise SnapshotMissingError(
+                f"[{self.name}:{snapshot}] is missing")
+
+    def write_snapshot(self, snapshot: str, meta: dict) -> None:
+        tmp = self._snap_path(snapshot) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path(snapshot))
+
+    def delete_snapshot_meta(self, snapshot: str) -> None:
+        try:
+            os.remove(self._snap_path(snapshot))
+        except FileNotFoundError:
+            pass
+
+    def gc_blobs(self) -> int:
+        """Drop blobs referenced by no snapshot (the reference's
+        cleanup-after-delete in ``BlobStoreRepository.deleteSnapshots``)."""
+        referenced = set()
+        for s in self.read_index()["snapshots"]:
+            meta = self.read_snapshot(s["snapshot"])
+            for idx_meta in meta["indices"].values():
+                for manifest in idx_meta["shards"].values():
+                    for entry in manifest:
+                        referenced.add(entry["hash"])
+        removed = 0
+        blob_root = os.path.join(self.location, "blobs")
+        for sub in os.listdir(blob_root):
+            subdir = os.path.join(blob_root, sub)
+            for fname in os.listdir(subdir):
+                if fname not in referenced:
+                    os.remove(os.path.join(subdir, fname))
+                    removed += 1
+        return removed
+
+
+class SnapshotsService:
+    """Repository registry + snapshot/restore orchestration."""
+
+    def __init__(self, indices_service):
+        self.indices = indices_service
+        self.repositories: Dict[str, FsRepository] = {}
+
+    # -- repositories -------------------------------------------------------
+
+    def put_repository(self, name: str, body: dict) -> None:
+        if body.get("type") != "fs":
+            raise IllegalArgumentError(
+                f"repository type [{body.get('type')}] unknown — only [fs] "
+                f"is supported")
+        settings = body.get("settings") or {}
+        location = settings.get("location")
+        if not location:
+            raise IllegalArgumentError(
+                "missing location setting for fs repository")
+        if not os.path.isabs(location):
+            raise IllegalArgumentError(
+                f"location [{location}] must be an absolute path")
+        self.repositories[name] = FsRepository(
+            name, location, compress=bool(settings.get("compress", False)))
+
+    def get_repository(self, name: str) -> FsRepository:
+        repo = self.repositories.get(name)
+        if repo is None:
+            raise SnapshotMissingError(f"[{name}] missing repository")
+        return repo
+
+    def delete_repository(self, name: str) -> None:
+        if name not in self.repositories:
+            raise SnapshotMissingError(f"[{name}] missing repository")
+        del self.repositories[name]
+
+    # -- snapshot -----------------------------------------------------------
+
+    def create(self, repo_name: str, snapshot: str,
+               indices_expr: Optional[str] = None,
+               include_global_state: bool = True) -> dict:
+        repo = self.get_repository(repo_name)
+        idx = repo.read_index()
+        if any(s["snapshot"] == snapshot for s in idx["snapshots"]):
+            raise ResourceAlreadyExistsError(
+                f"[{repo_name}:{snapshot}] snapshot with the same name "
+                f"already exists")
+        if isinstance(indices_expr, list):   # ES accepts array or CSV string
+            indices_expr = ",".join(indices_expr)
+        names = self.indices.resolve(indices_expr)
+        start = time.time()
+        indices_meta: Dict[str, dict] = {}
+        total_files = 0
+        for name in names:
+            svc = self.indices.get(name)
+            shards: Dict[str, List[dict]] = {}
+            for shard_id, engine in enumerate(svc.shards):
+                engine.flush()          # durable commit point to copy
+                manifest = []
+                store = engine.store_dir
+                commit = json.load(open(
+                    os.path.join(store, "commit_point.json")))
+                files = ["commit_point.json"]
+                for fname in commit["segments"]:
+                    # the commit entry itself (npz, or a legacy round-1
+                    # .json.gz) plus its liveness sidecar if present
+                    files.append(fname)
+                    seg_base = fname
+                    for suffix in (".npz", ".json.gz"):
+                        if seg_base.endswith(suffix):
+                            seg_base = seg_base[: -len(suffix)]
+                            break
+                    sidecar = seg_base + ".live.npy"
+                    if os.path.exists(os.path.join(store, sidecar)):
+                        files.append(sidecar)
+                missing = [f for f in files
+                           if not os.path.exists(os.path.join(store, f))]
+                if missing:
+                    raise SnapshotError(
+                        f"shard [{name}][{shard_id}] store is missing "
+                        f"committed files {missing}")
+                for fname in files:
+                    manifest.append(repo.put_file(
+                        os.path.join(store, fname)))
+                    total_files += 1
+                shards[str(shard_id)] = manifest
+            indices_meta[name] = {
+                "settings": dict(svc.settings),
+                "mappings": svc.mapper.mapping_dict(),
+                "aliases": dict(svc.aliases),
+                "num_shards": svc.num_shards,
+                "shards": shards,
+            }
+        meta = {
+            "snapshot": snapshot,
+            "uuid": uuid_mod.uuid4().hex[:20],
+            "repository": repo_name,
+            "state": "SUCCESS",
+            "indices": indices_meta,
+            "include_global_state": include_global_state,
+            "start_time_in_millis": int(start * 1000),
+            "end_time_in_millis": int(time.time() * 1000),
+            "total_files": total_files,
+            "version": "8.0.0-tpu",
+        }
+        repo.write_snapshot(snapshot, meta)
+        idx["snapshots"].append({"snapshot": snapshot,
+                                 "uuid": meta["uuid"],
+                                 "state": "SUCCESS",
+                                 "indices": sorted(indices_meta)})
+        repo.write_index(idx)
+        return meta
+
+    def get(self, repo_name: str, expr: str) -> List[dict]:
+        repo = self.get_repository(repo_name)
+        listed = repo.read_index()["snapshots"]
+        if expr in ("_all", "*", None, ""):
+            names = [s["snapshot"] for s in listed]
+        else:
+            import fnmatch
+            names = []
+            for part in expr.split(","):
+                if "*" in part:
+                    names.extend(s["snapshot"] for s in listed
+                                 if fnmatch.fnmatchcase(s["snapshot"], part))
+                else:
+                    if not any(s["snapshot"] == part for s in listed):
+                        raise SnapshotMissingError(
+                            f"[{repo_name}:{part}] is missing")
+                    names.append(part)
+        return [repo.read_snapshot(n) for n in names]
+
+    def delete(self, repo_name: str, snapshot: str) -> None:
+        repo = self.get_repository(repo_name)
+        idx = repo.read_index()
+        if not any(s["snapshot"] == snapshot for s in idx["snapshots"]):
+            raise SnapshotMissingError(f"[{repo_name}:{snapshot}] is missing")
+        idx["snapshots"] = [s for s in idx["snapshots"]
+                            if s["snapshot"] != snapshot]
+        repo.write_index(idx)
+        repo.delete_snapshot_meta(snapshot)
+        repo.gc_blobs()
+
+    # -- restore ------------------------------------------------------------
+
+    def restore(self, repo_name: str, snapshot: str,
+                indices_expr: Optional[str] = None,
+                rename_pattern: Optional[str] = None,
+                rename_replacement: Optional[str] = None) -> dict:
+        import re as re_mod
+        repo = self.get_repository(repo_name)
+        meta = repo.read_snapshot(snapshot)
+        if isinstance(indices_expr, list):   # ES accepts array or CSV string
+            indices_expr = ",".join(indices_expr)
+        wanted = list(meta["indices"])
+        if indices_expr and indices_expr not in ("_all", "*"):
+            import fnmatch
+            sel = []
+            for part in indices_expr.split(","):
+                hits = [n for n in meta["indices"]
+                        if fnmatch.fnmatchcase(n, part)]
+                if not hits:
+                    raise SnapshotError(
+                        f"[{repo_name}:{snapshot}] no index matches "
+                        f"[{part}] in snapshot")
+                sel.extend(h for h in hits if h not in sel)
+            wanted = sel
+        restored = []
+        for name in wanted:
+            target = name
+            if rename_pattern and rename_replacement is not None:
+                target = re_mod.sub(rename_pattern, rename_replacement, name)
+            if self.indices.exists(target):
+                raise ResourceAlreadyExistsError(
+                    f"cannot restore index [{target}] because an open index "
+                    f"with same name already exists in the cluster")
+            imeta = meta["indices"][name]
+            path = os.path.join(self.indices.data_path, target)
+            try:
+                for shard_id_s, manifest in imeta["shards"].items():
+                    store = os.path.join(path, shard_id_s, "store")
+                    os.makedirs(store, exist_ok=True)
+                    for entry in manifest:
+                        repo.get_file(entry, store)
+                # IndexService construction opens every shard engine, whose
+                # recovery path reads the restored commit point — restore
+                # IS recovery (RecoverySource.SnapshotRecoverySource)
+                from ..node.indices_service import IndexService
+                settings = {k: v for k, v in imeta["settings"].items()
+                            if k != "index.uuid"}
+                svc = IndexService(target, path, settings,
+                                   imeta["mappings"])
+                for alias, spec in imeta.get("aliases", {}).items():
+                    svc.aliases[alias] = spec or {}
+                self.indices.indices[target] = svc
+                restored.append(target)
+            except Exception:
+                shutil.rmtree(path, ignore_errors=True)
+                raise
+        return {"snapshot": {"snapshot": snapshot,
+                             "indices": restored,
+                             "shards": {"total": sum(
+                                 meta["indices"][n]["num_shards"]
+                                 for n in wanted), "failed": 0,
+                                 "successful": sum(
+                                     meta["indices"][n]["num_shards"]
+                                     for n in wanted)}}}
+
+    def status(self, repo_name: str, snapshot: str) -> dict:
+        snaps = self.get(repo_name, snapshot)
+        if not snaps:                        # wildcard matched nothing
+            raise SnapshotMissingError(
+                f"[{repo_name}:{snapshot}] is missing")
+        meta = snaps[0]
+        shards_total = sum(i["num_shards"] for i in meta["indices"].values())
+        return {"snapshots": [{
+            "snapshot": meta["snapshot"],
+            "repository": repo_name,
+            "uuid": meta["uuid"],
+            "state": meta["state"],
+            "shards_stats": {"done": shards_total, "failed": 0,
+                             "total": shards_total},
+            "stats": {"total": {"file_count": meta.get("total_files", 0)}},
+        }]}
