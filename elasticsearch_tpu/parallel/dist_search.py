@@ -41,7 +41,7 @@ from ..ops.bm25 import DEFAULT_B, DEFAULT_K1, idf_weight
 from ..ops.sorted_merge import bm25_topk_merge_body, make_impacts
 from ..ops.tiered_bm25 import (build_dense_rows, split_tiers,
                                tiered_bm25_topk)
-from ..utils.shapes import round_up_pow2
+from ..utils.shapes import round_up_multiple, round_up_pow2
 from .mesh import AXIS_REPLICA, AXIS_SHARD
 
 NEG_INF = float("-inf")
@@ -307,8 +307,10 @@ class DistributedSearchPlane:
             max((t["sparse_max_df"] for t in tiers), default=1), 1)
         self.L_cap = round_up_pow2(self.max_sparse_df)
         self.n_dense = max(t["dense_tids"].size for t in tiers)
-        self.T_pad = round_up_pow2(max(self.n_dense, 1)) if self.n_dense \
-            else 0
+        # multiple-of-16 (not pow2): the dense tier is T_pad × n_pad bf16 of
+        # HBM, and the MXU only needs lane alignment, not a power of two
+        self.T_pad = round_up_multiple(max(self.n_dense, 1), 16) \
+            if self.n_dense else 0
 
         # sparse postings table with L_cap sentinel slack after the last run
         # so dynamic_slice(start, L) never clamps into foreign data
@@ -413,9 +415,15 @@ class DistributedSearchPlane:
                 any_dense)
 
     def search(self, queries: Sequence[Sequence[str]], k: int = 10,
-               *, Q: Optional[int] = None, L: Optional[int] = None):
+               *, Q: Optional[int] = None, L: Optional[int] = None,
+               tiered: Optional[bool] = None):
         """Run a batch of bag-of-terms queries. Returns
         (scores f32[B, k], hits list[list[(shard, local_doc)]]).
+
+        ``tiered``: None (default) picks the tiered kernel iff the batch
+        touches a dense-tier term; True forces the tiered kernel whenever a
+        dense tier exists (stable compile shapes for latency benchmarking —
+        an all-sparse batch then just scores an empty dense weight matrix).
         """
         B = len(queries)
         # pad the batch to a replica-axis multiple (the mesh partitions the
@@ -445,7 +453,10 @@ class DistributedSearchPlane:
         np.minimum(lengths, L, out=lengths)
         repl = NamedSharding(self.mesh, P(AXIS_REPLICA, None))
         repl3 = NamedSharding(self.mesh, P(AXIS_REPLICA, AXIS_SHARD, None))
-        if any_dense:
+        use_tiered = any_dense if tiered is None else (tiered and self.T_pad > 0)
+        if tiered is False and any_dense:
+            raise ValueError("tiered=False but the batch hits dense-tier terms")
+        if use_tiered:
             step = self._get_step(Q, L, k, tiered=True)
             vals, gdocs = step(
                 self.docs_dev, self.impacts_dev, self.dense_dev,

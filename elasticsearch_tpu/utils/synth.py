@@ -29,3 +29,83 @@ def synthetic_csr_corpus(rng: np.random.RandomState, n_docs: int, vocab: int,
     df = (offsets[1:] - offsets[:-1]).astype(np.int32)
     return dict(docs=p_docs, tf=p_tf, offsets=offsets, df=df,
                 doc_len=lens.astype(np.float32))
+
+
+def split_csr_shards(corpus: dict, n_shards: int) -> list:
+    """Split one CSR corpus into ``n_shards`` contiguous doc-range shards
+    (vectorized — no per-term Python loop; the bench's stand-in for the
+    doc→shard routing an indexing pipeline would do with murmur3)."""
+    n_docs = corpus["doc_len"].shape[0]
+    vocab = corpus["df"].shape[0]
+    per = -(-n_docs // n_shards)
+    docs, tf, offsets = corpus["docs"], corpus["tf"], corpus["offsets"]
+    term_of = np.repeat(np.arange(vocab, dtype=np.int32),
+                        np.diff(offsets).astype(np.int64))
+    shard_of = docs // per
+    out = []
+    for si in range(n_shards):
+        keep = shard_of == si
+        sterm = term_of[keep]
+        ndf = np.bincount(sterm, minlength=vocab).astype(np.int32)
+        noff = np.zeros(vocab + 1, np.int64)
+        np.cumsum(ndf, out=noff[1:])
+        out.append(dict(
+            docs=(docs[keep] - si * per).astype(np.int32),
+            tf=tf[keep], offsets=noff, df=ndf,
+            doc_len=corpus["doc_len"][si * per: (si + 1) * per]))
+    return out
+
+
+def synthetic_csr_corpus_fast(rng: np.random.RandomState, n_docs: int,
+                              vocab: int, avg_dl: int,
+                              zipf_s: float = 1.2) -> dict:
+    """O(P) sort-free Zipf CSR corpus for large benchmarks.
+
+    ``synthetic_csr_corpus`` materializes every token and lexsorts (term,
+    doc) — O(P log P) single-threaded, minutes at 2^23 docs. Here the CSR is
+    constructed directly in term-major order: per-term document frequencies
+    follow the Zipf pmf analytically, and each term's doc-ascending run is a
+    sorted uniform sample drawn with the exponential-gap trick (normalized
+    per-run cumulative sums of exponentials are order statistics of
+    uniforms). Adjacent duplicate docs within a run are dropped and ``df``
+    recomputed, so runs stay strictly doc-ascending like SegmentBuilder's.
+    """
+    ranks = np.arange(1, vocab + 1, dtype=np.float64)
+    pmf = ranks ** (-zipf_s)
+    pmf /= pmf.sum()
+    df = np.minimum(n_docs, np.maximum(
+        1, np.round(pmf * n_docs * avg_dl))).astype(np.int64)
+    p_total = int(df.sum())
+
+    # sorted uniform doc ids per run via normalized exponential-gap cumsums
+    gaps = rng.exponential(1.0, p_total + vocab)
+    run_ends = np.cumsum(df + 1)
+    run_starts = run_ends - (df + 1)
+    g = np.cumsum(gaps)
+    seg_base = np.repeat(g[run_starts] - gaps[run_starts], df + 1)
+    seg_cum = g - seg_base                       # per-run cumulative sums
+    seg_total = np.repeat(seg_cum[run_ends - 1], df + 1)
+    u = seg_cum / seg_total                      # sorted uniforms per run
+    # drop each run's last slot (u == 1, the normalizer)
+    keep = np.ones(p_total + vocab, bool)
+    keep[run_ends - 1] = False
+    docs = np.minimum((u[keep] * n_docs).astype(np.int64), n_docs - 1)
+
+    # dedup *within runs*: doc-ascending, so dup iff same as predecessor
+    # and not at a run start
+    starts0 = np.cumsum(df) - df
+    is_start = np.zeros(p_total, bool)
+    is_start[starts0] = True
+    dup = np.zeros(p_total, bool)
+    dup[1:] = docs[1:] == docs[:-1]
+    dup &= ~is_start
+    docs = docs[~dup]
+    term_of = np.repeat(np.arange(vocab, dtype=np.int32), df)[~dup]
+    new_df = np.bincount(term_of, minlength=vocab).astype(np.int32)
+    offsets = np.zeros(vocab + 1, np.int64)
+    np.cumsum(new_df, out=offsets[1:])
+
+    tf = (1.0 + rng.poisson(0.35, docs.shape[0])).astype(np.float32)
+    doc_len = np.maximum(1, rng.poisson(avg_dl, n_docs)).astype(np.float32)
+    return dict(docs=docs.astype(np.int32), tf=tf, offsets=offsets,
+                df=new_df, doc_len=doc_len)
