@@ -1,0 +1,6 @@
+from .coordination import Coordinator, NotLeaderError
+from .sim import DeterministicTaskQueue, MockTransport
+from .state import ClusterState
+
+__all__ = ["ClusterState", "Coordinator", "DeterministicTaskQueue",
+           "MockTransport", "NotLeaderError"]

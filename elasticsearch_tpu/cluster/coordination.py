@@ -1,0 +1,498 @@
+"""Cluster coordination: term-based elections + two-phase publication.
+
+Re-design of the reference's consensus layer
+(``cluster/coordination/Coordinator.java:98``, ``CoordinationState.java``,
+``Publication.java``/``PublicationTransportHandler.java``, heartbeats in
+``LeaderChecker.java:66``/``FollowersChecker.java:68``). Same protocol
+skeleton, built against the deterministic sim transport (``sim.py``):
+
+- **Terms + joins.** A candidate bumps its term and solicits joins
+  (start_join → join). A node grants at most one join per term (its vote),
+  and a candidate only accepts a join if its own accepted state is at
+  least as fresh as the joiner's — with quorum intersection this
+  guarantees the elected leader holds every possibly-committed state
+  (``CoordinationState.handleJoin``'s term/version check).
+- **Two-phase publication.** publish_request (accept quorum) →
+  apply_commit. A node accepts a publication only for its current term
+  and a version newer than what it already accepted in that term; commits
+  apply exactly the accepted (term, version). Publication failure steps
+  the leader down.
+- **Failure detection.** The leader heartbeats followers
+  (FollowersChecker direction) and steps down when it cannot reach a
+  voting quorum; followers start elections when the leader goes quiet
+  (LeaderChecker direction) with seeded random jitter breaking ties.
+
+Omitted vs the reference (documented, not silently): pre-vote
+(``PreVoteCollector.java`` — a rejoining node may force one spurious
+re-election on heal), voting-config reconfiguration
+(``Reconfigurator.java`` — the voting config is the initial node set), and
+diff-based state transfer.
+
+Safety invariants are asserted in the sim tests
+(``tests/test_coordination.py``): unique leader per term, committed
+versions form one monotonic chain, no committed update is ever lost by a
+later leader.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from .sim import DeterministicTaskQueue, MockTransport
+from .state import ClusterState
+
+CANDIDATE, LEADER, FOLLOWER = "CANDIDATE", "LEADER", "FOLLOWER"
+
+
+class PersistedState:
+    """What survives a node restart (the reference's
+    ``gateway/PersistedClusterStateService.java``): current term + last
+    accepted state. In-memory here; the disk-backed variant serializes
+    this dict."""
+
+    def __init__(self, initial: ClusterState):
+        self.current_term = 0
+        self.accepted_term = 0          # term in which accepted was written
+        self.accepted = initial         # last accepted (maybe uncommitted)
+        self.committed_version = 0
+
+
+class Coordinator:
+    """One node's coordination module."""
+
+    HEARTBEAT_INTERVAL = 0.1
+    LEADER_TIMEOUT = 0.45
+    ELECTION_MIN, ELECTION_MAX = 0.05, 0.3
+    PUBLISH_TIMEOUT = 0.6
+    RPC_TIMEOUT = 0.2
+
+    def __init__(self, node_id: str, queue: DeterministicTaskQueue,
+                 transport: MockTransport, initial: ClusterState,
+                 on_commit: Optional[Callable[[ClusterState], None]] = None):
+        self.node_id = node_id
+        self.queue = queue
+        self.transport = transport
+        self.persisted = PersistedState(initial)
+        self.mode = CANDIDATE
+        self.known_leader: Optional[str] = None
+        self.applied: ClusterState = initial
+        self.on_commit_cb = on_commit
+        self.join_votes: Set[str] = set()
+        self._joined_term = 0          # highest term this node voted in
+        self._last_leader_msg = queue.now
+        self._election_task = None
+        self._heartbeat_task = None
+        self._active_publication: Optional[dict] = None
+        self._pending_tasks: List[Callable[[ClusterState], ClusterState]] = []
+        self._task_listeners: List[Callable] = []
+        self.stopped = False
+
+        t = transport
+        t.register(node_id, "start_join", self._handle_start_join)
+        t.register(node_id, "join", self._handle_join)
+        t.register(node_id, "publish", self._handle_publish)
+        t.register(node_id, "commit", self._handle_commit)
+        t.register(node_id, "heartbeat", self._handle_heartbeat)
+
+        self._schedule_election(initial_delay=True)
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    @property
+    def term(self) -> int:
+        return self.persisted.current_term
+
+    def _peers(self) -> List[str]:
+        return [n for n in self.persisted.accepted.nodes
+                if n != self.node_id]
+
+    def _quorum(self, votes: Set[str]) -> bool:
+        return self.persisted.accepted.quorum(votes)
+
+    def stop(self) -> None:
+        """Simulated crash: stop timers and drop all volatile state."""
+        self.stopped = True
+        if self._election_task:
+            self._election_task.cancel()
+        if self._heartbeat_task:
+            self._heartbeat_task.cancel()
+
+    def restart(self) -> None:
+        """Recover from persisted state (terms + accepted survive; mode,
+        votes, leader knowledge, and queued state-update closures do not —
+        a real restart cannot replay in-memory tasks)."""
+        self.stopped = False
+        self.mode = CANDIDATE
+        self.known_leader = None
+        self.join_votes = set()
+        self._active_publication = None
+        self._fail_listeners(self._task_listeners)
+        self._pending_tasks = []
+        self._task_listeners = []
+        self.applied = self.persisted.accepted if \
+            self.persisted.accepted.version <= \
+            self.persisted.committed_version else self.applied
+        self._last_leader_msg = self.queue.now
+        self._schedule_election()
+
+    # ------------------------------------------------------------------
+    # elections
+    # ------------------------------------------------------------------
+
+    def _schedule_election(self, initial_delay: bool = False) -> None:
+        if self._election_task:
+            self._election_task.cancel()
+        lo, hi = self.ELECTION_MIN, self.ELECTION_MAX
+        delay = self.queue.rng.uniform(lo, hi) + \
+            (self.LEADER_TIMEOUT if not initial_delay else 0.0)
+        self._election_task = self.queue.schedule(delay, self._election_tick)
+
+    def _election_tick(self) -> None:
+        if self.stopped:
+            return
+        if self.mode == LEADER:
+            return
+        quiet = self.queue.now - self._last_leader_msg
+        if self.mode == FOLLOWER and quiet < self.LEADER_TIMEOUT:
+            self._schedule_election()
+            return
+        self._start_election()
+        self._schedule_election()
+
+    def _start_election(self) -> None:
+        self.mode = CANDIDATE
+        self.known_leader = None
+        new_term = self.term + 1
+        self._set_term(new_term)
+        self.join_votes = set()
+        # vote for self (start_join to self, handled inline)
+        self._grant_join_to_self(new_term)
+        for peer in self._peers():
+            self.transport.send(
+                self.node_id, peer, "start_join",
+                {"term": new_term, "source": self.node_id},
+                timeout=self.RPC_TIMEOUT)
+
+    def _set_term(self, term: int) -> None:
+        if term > self.persisted.current_term:
+            self.persisted.current_term = term
+            if self.mode == LEADER:
+                self._become_candidate()
+
+    def _grant_join_to_self(self, term: int) -> None:
+        if term > self._joined_term:
+            self._joined_term = term
+            self._on_join_granted(self.node_id, term,
+                                  self.persisted.accepted_term,
+                                  self.persisted.accepted.version)
+
+    # remote: someone asks us to join their election
+    def _handle_start_join(self, src: str, payload: dict) -> dict:
+        if self.stopped:
+            raise ConnectionError("node stopped")
+        term = payload["term"]
+        if term <= self._joined_term or term < self.term:
+            return {"granted": False}
+        self._set_term(term)
+        if self.mode == LEADER:
+            self._become_candidate()
+        self._joined_term = term
+        # send our vote with our accepted-state freshness
+        self.transport.send(
+            self.node_id, payload["source"], "join",
+            {"term": term, "source": self.node_id,
+             "accepted_term": self.persisted.accepted_term,
+             "accepted_version": self.persisted.accepted.version},
+            timeout=self.RPC_TIMEOUT)
+        return {"granted": True}
+
+    def _handle_join(self, src: str, payload: dict) -> dict:
+        if self.stopped:
+            raise ConnectionError("node stopped")
+        if payload["term"] != self.term or self.mode == LEADER:
+            return {"ok": False}
+        self._on_join_granted(payload["source"], payload["term"],
+                              payload["accepted_term"],
+                              payload["accepted_version"])
+        return {"ok": True}
+
+    def _on_join_granted(self, voter: str, term: int, j_accept_term: int,
+                         j_accept_version: int) -> None:
+        # safety: refuse votes from nodes with FRESHER accepted state than
+        # ours — we could otherwise win and publish over committed data
+        # (CoordinationState.handleJoin's check, inverted to drop the vote)
+        ours = (self.persisted.accepted_term,
+                self.persisted.accepted.version)
+        theirs = (j_accept_term, j_accept_version)
+        if theirs > ours:
+            return
+        if self.mode != CANDIDATE or term != self.term:
+            return
+        self.join_votes.add(voter)
+        if self._quorum(self.join_votes):
+            self._become_leader()
+
+    def _become_leader(self) -> None:
+        self.mode = LEADER
+        self.known_leader = self.node_id
+        self._reachable_rounds_without_quorum = 0
+        self._schedule_heartbeat()
+        # republish the freshest accepted state under the new term: commits
+        # any in-flight publication from the fallen leader (the node-join
+        # cluster-state update in the reference)
+        base = self.persisted.accepted
+        self._publish(base.updated(
+            term=self.term, version=base.version + 1,
+            master_node=self.node_id))
+
+    def _become_candidate(self) -> None:
+        if self._heartbeat_task:
+            self._heartbeat_task.cancel()
+        self.mode = CANDIDATE
+        self.known_leader = None
+        pub, self._active_publication = self._active_publication, None
+        if pub is not None and not pub["done"]:
+            pub["done"] = True
+            self._fail_listeners(pub["listeners"])
+        self._fail_listeners(self._task_listeners)
+        self._pending_tasks = []
+        self._task_listeners = []
+        self._schedule_election()
+
+    @staticmethod
+    def _fail_listeners(listeners: List[Callable]) -> None:
+        """Notify waiting submitters that their update failed to commit
+        (the reference's ``onFailure`` on FailedToCommitClusterStateException
+        — here: the listener fires with ``None``)."""
+        for ln in listeners:
+            ln(None)
+
+    # ------------------------------------------------------------------
+    # publication (two-phase)
+    # ------------------------------------------------------------------
+
+    def submit_state_update(self, fn: Callable[[ClusterState], ClusterState],
+                            listener: Optional[Callable] = None) -> None:
+        """MasterService.submitStateUpdateTask: only meaningful on the
+        leader; tasks batch into the next publication."""
+        if self.mode != LEADER:
+            raise NotLeaderError(self.known_leader)
+        self._pending_tasks.append(fn)
+        if listener:
+            self._task_listeners.append(listener)
+        if self._active_publication is None:
+            self._publish_pending()
+
+    def _publish_pending(self) -> None:
+        if self.mode != LEADER or not self._pending_tasks:
+            return
+        state = self.persisted.accepted
+        for fn in self._pending_tasks:
+            state = fn(state)
+        self._pending_tasks = []
+        listeners, self._task_listeners = self._task_listeners, []
+        self._publish(state.updated(
+            term=self.term,
+            version=self.persisted.accepted.version + 1,
+            master_node=self.node_id), listeners)
+
+    def _publish(self, state: ClusterState,
+                 listeners: Optional[List[Callable]] = None) -> None:
+        pub = {"term": state.term, "version": state.version,
+               "state": state, "acks": set(), "commits": set(),
+               "committed": False, "done": False,
+               "listeners": listeners or []}
+        self._active_publication = pub
+
+        # accept locally first (the leader is a voter)
+        self._accept_publication(state)
+        self._on_publish_ack(pub, self.node_id)
+        for peer in self._peers():
+            self.transport.send(
+                self.node_id, peer, "publish",
+                {"term": state.term, "version": state.version,
+                 "state": state.copy_data(), "source": self.node_id},
+                on_response=lambda r, p=pub, n=peer: (
+                    self._on_publish_ack(p, n) if r.get("accepted") else
+                    None),
+                on_failure=lambda e: None,
+                timeout=self.RPC_TIMEOUT)
+        self.queue.schedule(self.PUBLISH_TIMEOUT,
+                            lambda: self._publication_timeout(pub))
+
+    def _publication_timeout(self, pub: dict) -> None:
+        if pub is self._active_publication and not pub["committed"]:
+            # could not reach an accept quorum: fail the waiters, step down
+            self._active_publication = None
+            pub["done"] = True
+            self._fail_listeners(pub["listeners"])
+            if self.mode == LEADER:
+                self._become_candidate()
+
+    def _on_publish_ack(self, pub: dict, node: str) -> None:
+        if pub["done"] or pub is not self._active_publication:
+            return
+        pub["acks"].add(node)
+        if not pub["committed"] and \
+                self.persisted.accepted.quorum(pub["acks"]):
+            pub["committed"] = True
+            self._commit_locally(pub["term"], pub["version"])
+            for peer in self._peers():
+                self.transport.send(
+                    self.node_id, peer, "commit",
+                    {"term": pub["term"], "version": pub["version"],
+                     "source": self.node_id},
+                    timeout=self.RPC_TIMEOUT)
+            pub["done"] = True
+            self._active_publication = None
+            for ln in pub["listeners"]:
+                ln(self.applied)
+            if self._pending_tasks:
+                self._publish_pending()
+
+    # remote handlers --------------------------------------------------------
+
+    def _handle_publish(self, src: str, payload: dict) -> dict:
+        if self.stopped:
+            raise ConnectionError("node stopped")
+        term, version = payload["term"], payload["version"]
+        if term < self.term:
+            return {"accepted": False, "reason": "stale term"}
+        if term > self.term:
+            self._set_term(term)
+        # a publish from a live leader for our term: follow it
+        self._last_leader_msg = self.queue.now
+        if self.mode != FOLLOWER or self.known_leader != payload["source"]:
+            if self.mode == LEADER and payload["source"] != self.node_id:
+                self._become_candidate()
+            self.mode = FOLLOWER
+            self.known_leader = payload["source"]
+        if (term, version) <= (self.persisted.accepted_term,
+                               self.persisted.accepted.version) and not (
+                term == self.persisted.accepted_term and
+                version == self.persisted.accepted.version):
+            return {"accepted": False, "reason": "stale version"}
+        self._accept_publication(ClusterState(payload["state"]))
+        return {"accepted": True}
+
+    def _accept_publication(self, state: ClusterState) -> None:
+        self.persisted.accepted = state
+        self.persisted.accepted_term = state.term
+
+    def _handle_commit(self, src: str, payload: dict) -> dict:
+        if self.stopped:
+            raise ConnectionError("node stopped")
+        term, version = payload["term"], payload["version"]
+        if (term, version) != (self.persisted.accepted_term,
+                               self.persisted.accepted.version):
+            return {"applied": False}
+        self._last_leader_msg = self.queue.now
+        self._commit_locally(term, version)
+        return {"applied": True}
+
+    def _commit_locally(self, term: int, version: int) -> None:
+        if version <= self.persisted.committed_version:
+            return
+        self.persisted.committed_version = version
+        self.applied = self.persisted.accepted
+        if self.on_commit_cb:
+            self.on_commit_cb(self.applied)
+
+    # ------------------------------------------------------------------
+    # failure detection
+    # ------------------------------------------------------------------
+
+    def _schedule_heartbeat(self) -> None:
+        if self._heartbeat_task:
+            self._heartbeat_task.cancel()
+        self._heartbeat_task = self.queue.schedule(
+            self.HEARTBEAT_INTERVAL, self._heartbeat_tick)
+
+    def _heartbeat_tick(self) -> None:
+        if self.stopped or self.mode != LEADER:
+            return
+        reachable = {self.node_id}
+        pending = {"count": len(self._peers())}
+
+        def mark(node, resp):
+            reachable.add(node)
+            # lag repair (the reference's LagDetector + full-state resend):
+            # a healed follower reports a stale committed version in its
+            # heartbeat ack; re-send the committed state directly to it
+            if (self.mode == LEADER and
+                    resp.get("committed", 0) <
+                    self.persisted.committed_version and
+                    self.persisted.accepted.version ==
+                    self.persisted.committed_version):
+                self._send_catchup(node)
+
+        round_term = self.term
+
+        def done(_=None):
+            pending["count"] -= 1
+            if pending["count"] == 0:
+                # a stale round must not depose a node that already moved
+                # on (stepped down / new term) while RPCs were in flight
+                if (self.stopped or self.mode != LEADER or
+                        self.term != round_term):
+                    return
+                if not self._quorum(reachable):
+                    self._reachable_rounds_without_quorum += 1
+                    # two strikes: transient losses don't depose a leader
+                    if self._reachable_rounds_without_quorum >= 2:
+                        self._become_candidate()
+                        return
+                else:
+                    self._reachable_rounds_without_quorum = 0
+                self._schedule_heartbeat()
+
+        if pending["count"] == 0:
+            self._schedule_heartbeat()
+            return
+        for peer in self._peers():
+            self.transport.send(
+                self.node_id, peer, "heartbeat",
+                {"term": self.term, "source": self.node_id},
+                on_response=lambda r, n=peer: (mark(n, r), done()),
+                on_failure=lambda e: done(),
+                timeout=self.RPC_TIMEOUT)
+
+    def _send_catchup(self, peer: str) -> None:
+        state = self.persisted.accepted
+        term, version = state.term, state.version
+
+        def committed_ack(r):
+            if r.get("accepted"):
+                self.transport.send(
+                    self.node_id, peer, "commit",
+                    {"term": term, "version": version,
+                     "source": self.node_id},
+                    timeout=self.RPC_TIMEOUT)
+
+        self.transport.send(
+            self.node_id, peer, "publish",
+            {"term": term, "version": version,
+             "state": state.copy_data(), "source": self.node_id},
+            on_response=committed_ack, timeout=self.RPC_TIMEOUT)
+
+    def _handle_heartbeat(self, src: str, payload: dict) -> dict:
+        if self.stopped:
+            raise ConnectionError("node stopped")
+        if payload["term"] < self.term:
+            return {"term": self.term, "ok": False}
+        if payload["term"] > self.term:
+            self._set_term(payload["term"])
+        self._last_leader_msg = self.queue.now
+        if self.mode != LEADER:
+            self.mode = FOLLOWER
+            self.known_leader = payload["source"]
+        return {"term": self.term, "ok": True,
+                "committed": self.persisted.committed_version}
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader: Optional[str]):
+        super().__init__(f"not the elected master (known leader: {leader})")
+        self.leader = leader

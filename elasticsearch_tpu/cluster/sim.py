@@ -1,0 +1,187 @@
+"""Deterministic simulation kit: virtual-time task queue + disruptable
+in-memory transport.
+
+Re-design of the reference's coordination test harness
+(``test/framework/.../cluster/coordination/DeterministicTaskQueue.java:48``
+runs every threadpool task on one thread under a virtual clock;
+``DisruptableMockTransport.java`` injects partitions) as the *first-class*
+substrate the control plane is developed against (SURVEY §4.3/§7 Phase 3:
+simulator-first). Nodes never see real time or sockets — everything
+schedules through :class:`DeterministicTaskQueue`, so a partition/heal/
+leader-kill schedule replays bit-identically from a seed.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+
+class DeterministicTaskQueue:
+    """Single-threaded virtual-time scheduler. Tasks run in (time, seq)
+    order; equal deadlines keep submission order, and the seeded RNG is the
+    only source of nondeterminism (election jitter), so a run is a pure
+    function of (seed, schedule)."""
+
+    def __init__(self, seed: int = 0):
+        self.now: float = 0.0
+        self.rng = random.Random(seed)
+        self._heap: List[Tuple[float, int, Callable[[], None]]] = []
+        self._seq = 0
+
+    def schedule(self, delay: float, fn: Callable[[], None]) -> "Cancellable":
+        task = Cancellable(fn)
+        heapq.heappush(self._heap, (self.now + max(delay, 0.0),
+                                    self._seq, task))
+        self._seq += 1
+        return task
+
+    def run_until(self, deadline: float) -> None:
+        """Advance virtual time, running every task due before ``deadline``."""
+        while self._heap and self._heap[0][0] <= deadline:
+            t, _, task = heapq.heappop(self._heap)
+            self.now = max(self.now, t)
+            if not task.cancelled:
+                task.fn()
+        self.now = deadline
+
+    def run_for(self, duration: float) -> None:
+        self.run_until(self.now + duration)
+
+    def run_until_idle(self, max_time: float = 1e9) -> None:
+        while self._heap and self._heap[0][0] <= max_time:
+            self.run_until(self._heap[0][0])
+
+    @property
+    def pending(self) -> int:
+        return sum(1 for _, _, t in self._heap if not t.cancelled)
+
+
+class Cancellable:
+    __slots__ = ("fn", "cancelled")
+
+    def __init__(self, fn: Callable[[], None]):
+        self.fn = fn
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+    def __lt__(self, other):        # heap tiebreak never reaches tasks,
+        return False                # but keep heapq happy under ties
+
+
+class MockTransport:
+    """In-memory request/response bus with fault injection.
+
+    Supports: symmetric partitions (node-set isolation), per-link
+    blackholes (drop silently — the nastier failure mode), node crashes
+    (drop + no response forever), and uniform random delivery delay.
+    Responses traverse the same disruption checks as requests, so a
+    partition formed mid-RPC loses the response — the case that breaks
+    naive two-phase protocols.
+    """
+
+    def __init__(self, queue: DeterministicTaskQueue,
+                 min_delay: float = 0.001, max_delay: float = 0.01):
+        self.queue = queue
+        self.min_delay = min_delay
+        self.max_delay = max_delay
+        self._handlers: Dict[str, Dict[str, Callable]] = {}
+        self._partitions: List[Set[str]] = []
+        self._blackholes: Set[Tuple[str, str]] = set()
+        self._crashed: Set[str] = set()
+        self.delivered = 0
+        self.dropped = 0
+
+    # -- wiring --------------------------------------------------------------
+
+    def register(self, node_id: str, action: str, handler: Callable) -> None:
+        """handler(from_node, payload) -> response payload (or raises)."""
+        self._handlers.setdefault(node_id, {})[action] = handler
+
+    # -- disruption ----------------------------------------------------------
+
+    def partition(self, *groups: Set[str]) -> None:
+        """Install a partition: messages cross group boundaries never."""
+        self._partitions = [set(g) for g in groups]
+
+    def heal(self) -> None:
+        self._partitions = []
+        self._blackholes.clear()
+
+    def blackhole(self, src: str, dst: str) -> None:
+        self._blackholes.add((src, dst))
+
+    def crash(self, node_id: str) -> None:
+        self._crashed.add(node_id)
+
+    def restart(self, node_id: str) -> None:
+        self._crashed.discard(node_id)
+
+    def _connected(self, src: str, dst: str) -> bool:
+        if src in self._crashed or dst in self._crashed:
+            return False
+        if (src, dst) in self._blackholes:
+            return False
+        for group in self._partitions:
+            if (src in group) != (dst in group):
+                return False
+        return True
+
+    # -- messaging -----------------------------------------------------------
+
+    def send(self, src: str, dst: str, action: str, payload: Any,
+             on_response: Optional[Callable[[Any], None]] = None,
+             on_failure: Optional[Callable[[Exception], None]] = None,
+             timeout: float = 1.0) -> None:
+        """Asynchronous RPC. Exactly one of on_response/on_failure fires,
+        unless the link drops BOTH directions silently — then on_failure
+        fires at ``timeout`` (the reference's transport timeouts)."""
+        state = {"done": False}
+
+        def finish_ok(resp):
+            if not state["done"]:
+                state["done"] = True
+                if on_response:
+                    on_response(resp)
+
+        def finish_err(e):
+            if not state["done"]:
+                state["done"] = True
+                if on_failure:
+                    on_failure(e)
+
+        if timeout is not None:
+            self.queue.schedule(timeout, lambda: finish_err(
+                TimeoutError(f"[{action}] {src}->{dst} timed out")))
+
+        def deliver():
+            if not self._connected(src, dst):
+                self.dropped += 1        # silent: timeout handles it
+                return
+            handler = self._handlers.get(dst, {}).get(action)
+            if handler is None:
+                self.dropped += 1
+                return
+            self.delivered += 1
+            try:
+                resp = handler(src, payload)
+            except Exception as e:       # noqa: BLE001 — remote exception
+                self._schedule_back(dst, src, lambda: finish_err(e))
+                return
+            self._schedule_back(dst, src, lambda: finish_ok(resp))
+
+        self.queue.schedule(self._delay(), deliver)
+
+    def _schedule_back(self, src: str, dst: str, fn: Callable) -> None:
+        def back():
+            if self._connected(src, dst):
+                fn()
+            else:
+                self.dropped += 1
+        self.queue.schedule(self._delay(), back)
+
+    def _delay(self) -> float:
+        return self.queue.rng.uniform(self.min_delay, self.max_delay)

@@ -1,0 +1,92 @@
+"""Cluster state: the single replicated source of truth.
+
+Re-design of the reference's ``cluster/ClusterState.java`` (immutable value
+with term/version, discovery nodes, metadata, routing table) as a plain
+JSON-serializable dict wrapper — publication ships the full state (the
+reference's diff-based publication, ``cluster/Diff.java``, is an
+optimization layered on the same protocol; full-state keeps the simulator
+checkable and is what the reference falls back to on any diff miss).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Set
+
+
+class ClusterState:
+    """Immutable-by-convention snapshot. ``data`` layout::
+
+        term            int   — master term that published this state
+        version         int   — monotonically increasing per commit
+        master_node     str | None
+        nodes           {node_id: {"name": ...}}
+        voting_config   [node_id]   — quorum basis (static in round 2;
+                        reconfiguration is the reference's
+                        Reconfigurator.java, not yet implemented)
+        metadata        {"indices": {name: {settings, mappings, aliases,
+                        num_shards}}}
+        routing         {index: {shard_id: {"primary": node_id,
+                        "replicas": [node_id]}}}
+    """
+
+    def __init__(self, data: Dict[str, Any]):
+        self.data = data
+
+    # -- accessors -----------------------------------------------------------
+
+    @property
+    def term(self) -> int:
+        return self.data["term"]
+
+    @property
+    def version(self) -> int:
+        return self.data["version"]
+
+    @property
+    def master_node(self) -> Optional[str]:
+        return self.data.get("master_node")
+
+    @property
+    def nodes(self) -> Dict[str, dict]:
+        return self.data["nodes"]
+
+    @property
+    def voting_config(self) -> List[str]:
+        return self.data["voting_config"]
+
+    @property
+    def metadata(self) -> dict:
+        return self.data["metadata"]
+
+    @property
+    def routing(self) -> dict:
+        # read-only view: a getter must never mutate the snapshot (the
+        # commit-divergence oracle compares byte-identical JSON)
+        return self.data.get("routing", {})
+
+    def quorum(self, votes: Set[str]) -> bool:
+        config = self.voting_config
+        return len(set(config) & votes) * 2 > len(config)
+
+    # -- evolution -----------------------------------------------------------
+
+    def updated(self, **changes) -> "ClusterState":
+        d = copy.deepcopy(self.data)
+        d.update(changes)
+        return ClusterState(d)
+
+    def copy_data(self) -> Dict[str, Any]:
+        return copy.deepcopy(self.data)
+
+    @classmethod
+    def initial(cls, node_ids: List[str]) -> "ClusterState":
+        return cls({
+            "term": 0,
+            "version": 0,
+            "master_node": None,
+            "nodes": {n: {"name": n} for n in node_ids},
+            "voting_config": list(node_ids),
+            "metadata": {"indices": {}},
+            "routing": {},
+        })

@@ -77,19 +77,25 @@ def synthetic_csr_corpus_fast(rng: np.random.RandomState, n_docs: int,
         1, np.round(pmf * n_docs * avg_dl))).astype(np.int64)
     p_total = int(df.sum())
 
-    # sorted uniform doc ids per run via normalized exponential-gap cumsums
+    # sorted uniform doc ids per run via normalized exponential-gap cumsums.
+    # Memory discipline: everything length-(P+V) is computed IN PLACE on one
+    # float64 buffer (peak ≈ 2 such arrays + the int64 docs, not 6 — at the
+    # 268M-posting bench config that is the difference between ~7 GB and an
+    # OOM-killed bench host)
     gaps = rng.exponential(1.0, p_total + vocab)
     run_ends = np.cumsum(df + 1)
     run_starts = run_ends - (df + 1)
-    g = np.cumsum(gaps)
-    seg_base = np.repeat(g[run_starts] - gaps[run_starts], df + 1)
-    seg_cum = g - seg_base                       # per-run cumulative sums
-    seg_total = np.repeat(seg_cum[run_ends - 1], df + 1)
-    u = seg_cum / seg_total                      # sorted uniforms per run
+    first_gap = gaps[run_starts].copy()          # small: [V]
+    g = np.cumsum(gaps, out=gaps)                # g aliases gaps
+    seg_base = g[run_starts] - first_gap         # small: [V]
+    g -= np.repeat(seg_base, df + 1)             # per-run cumulative sums
+    seg_total = g[run_ends - 1].copy()           # small: [V]
+    g /= np.repeat(seg_total, df + 1)            # sorted uniforms per run
     # drop each run's last slot (u == 1, the normalizer)
     keep = np.ones(p_total + vocab, bool)
     keep[run_ends - 1] = False
-    docs = np.minimum((u[keep] * n_docs).astype(np.int64), n_docs - 1)
+    docs = np.minimum((g[keep] * n_docs).astype(np.int64), n_docs - 1)
+    del gaps, g, keep
 
     # dedup *within runs*: doc-ascending, so dup iff same as predecessor
     # and not at a run start

@@ -1,0 +1,334 @@
+"""Deterministic coordination sim: elections, two-phase publication, and
+failure schedules with safety invariants (the reference's
+``AbstractCoordinatorTestCase.java:148`` + ``LinearizabilityChecker.java``
+pattern — run schedules under a virtual clock, assert safety on every
+commit, then liveness at quiescence)."""
+
+import pytest
+
+from elasticsearch_tpu.cluster import (ClusterState, Coordinator,
+                                       DeterministicTaskQueue, MockTransport,
+                                       NotLeaderError)
+
+
+class SimCluster:
+    """N coordinators on one virtual clock with invariant recording."""
+
+    def __init__(self, n: int, seed: int = 0):
+        self.queue = DeterministicTaskQueue(seed)
+        self.transport = MockTransport(self.queue)
+        self.node_ids = [f"n{i}" for i in range(n)]
+        initial = ClusterState.initial(self.node_ids)
+        self.commits = {}            # version -> (term, data_json, first_node)
+        self.nodes = {}
+        for nid in self.node_ids:
+            self.nodes[nid] = Coordinator(
+                nid, self.queue, self.transport,
+                ClusterState.initial(self.node_ids),
+                on_commit=lambda st, nid=nid: self._record(nid, st))
+
+    def _record(self, nid, state):
+        import json
+        key = state.version
+        blob = json.dumps(state.data, sort_keys=True)
+        prev = self.commits.get(key)
+        if prev is not None:
+            # SAFETY: all nodes committing a version commit the SAME state
+            assert prev[1] == blob, (
+                f"divergent commit at version {key}: {nid} vs {prev[2]}")
+        else:
+            self.commits[key] = (state.term, blob, nid)
+
+    # -- queries -------------------------------------------------------------
+
+    def leaders(self):
+        return [c for c in self.nodes.values()
+                if c.mode == "LEADER" and not c.stopped]
+
+    def the_leader(self):
+        ls = self.leaders()
+        assert len(ls) == 1, f"expected one leader, got {[l.node_id for l in ls]}"
+        return ls[0]
+
+    def run(self, seconds):
+        self.queue.run_for(seconds)
+
+    def assert_unique_leader_per_term(self):
+        by_term = {}
+        for c in self.nodes.values():
+            if c.mode == "LEADER" and not c.stopped:
+                assert by_term.setdefault(c.term, c.node_id) == c.node_id, \
+                    f"two live leaders in term {c.term}"
+
+    def stable_leader(self, timeout=10.0):
+        """Run until exactly one live leader exists and a quorum follows it."""
+        step = 0.25
+        waited = 0.0
+        while waited < timeout:
+            self.run(step)
+            waited += step
+            self.assert_unique_leader_per_term()
+            ls = self.leaders()
+            if len(ls) != 1:
+                continue
+            leader = ls[0]
+            followers = [c for c in self.nodes.values()
+                         if not c.stopped and c.known_leader ==
+                         leader.node_id]
+            if len(followers) * 2 > len(self.node_ids):
+                return leader
+        raise AssertionError("no stable leader emerged")
+
+
+def put_index(cluster, leader, name):
+    """Submit a create-index metadata update and wait for its commit."""
+    done = {}
+
+    def update(state):
+        new = state.updated()
+        new.metadata["indices"][name] = {"num_shards": 1}
+        return new
+
+    leader.submit_state_update(update, listener=lambda st: done.update(ok=st))
+    cluster.queue.run_until_idle(cluster.queue.now + 5.0)
+    assert done, f"update [{name}] never resolved"
+    assert done["ok"] is not None, f"update [{name}] failed to commit"
+    return done["ok"]
+
+
+def test_bootstrap_elects_single_leader():
+    cluster = SimCluster(5, seed=42)
+    leader = cluster.stable_leader()
+    assert leader.applied.master_node == leader.node_id
+    # every live node converges to the same applied state
+    cluster.run(2.0)
+    versions = {c.applied.version for c in cluster.nodes.values()}
+    assert len(versions) == 1
+
+
+def test_state_update_reaches_all_nodes():
+    cluster = SimCluster(3, seed=7)
+    leader = cluster.stable_leader()
+    st = put_index(cluster, leader, "idx1")
+    assert "idx1" in st.metadata["indices"]
+    cluster.run(1.0)
+    for c in cluster.nodes.values():
+        assert "idx1" in c.applied.metadata["indices"]
+    # non-leaders refuse updates and name the leader
+    follower = next(c for c in cluster.nodes.values()
+                    if c.mode != "LEADER")
+    with pytest.raises(NotLeaderError) as ei:
+        follower.submit_state_update(lambda s: s)
+    assert ei.value.leader == leader.node_id
+
+
+def test_leader_kill_promotes_without_losing_commits():
+    cluster = SimCluster(5, seed=3)
+    leader = cluster.stable_leader()
+    put_index(cluster, leader, "before-kill")
+    leader.stop()
+    cluster.transport.crash(leader.node_id)
+    new_leader = cluster.stable_leader()
+    assert new_leader.node_id != leader.node_id
+    # SAFETY: committed metadata survives the failover
+    assert "before-kill" in new_leader.applied.metadata["indices"]
+    put_index(cluster, new_leader, "after-kill")
+    cluster.run(1.0)
+    for c in cluster.nodes.values():
+        if c.stopped:
+            continue
+        assert "before-kill" in c.applied.metadata["indices"]
+        assert "after-kill" in c.applied.metadata["indices"]
+
+
+def test_partition_minority_cannot_commit():
+    cluster = SimCluster(5, seed=11)
+    leader = cluster.stable_leader()
+    put_index(cluster, leader, "pre")
+    # isolate the leader with one follower (minority side)
+    minority = {leader.node_id,
+                next(n for n in cluster.node_ids
+                     if n != leader.node_id)}
+    majority = set(cluster.node_ids) - minority
+    cluster.transport.partition(minority, majority)
+    new_leader = None
+    for _ in range(40):
+        cluster.run(0.5)
+        cluster.assert_unique_leader_per_term()
+        ls = [c for c in cluster.leaders()
+              if c.node_id in majority]
+        if ls:
+            new_leader = ls[0]
+            break
+    assert new_leader is not None, "majority side failed to elect"
+    # old leader must have stepped down (cannot heartbeat a quorum)
+    assert cluster.nodes[leader.node_id].mode != "LEADER"
+    put_index(cluster, new_leader, "during-partition")
+    # heal: everyone converges on the majority's history
+    cluster.transport.heal()
+    final = cluster.stable_leader()
+    cluster.run(3.0)
+    for c in cluster.nodes.values():
+        assert "pre" in c.applied.metadata["indices"]
+        assert "during-partition" in c.applied.metadata["indices"]
+
+
+def test_partitioned_publication_cannot_diverge():
+    """An in-flight publication cut by a partition either commits on the
+    majority or nowhere — the commits record asserts no divergence."""
+    cluster = SimCluster(5, seed=19)
+    leader = cluster.stable_leader()
+    submitted = []
+
+    def update(state):
+        new = state.updated()
+        new.metadata["indices"]["racy"] = {"num_shards": 1}
+        return new
+
+    leader.submit_state_update(update,
+                               listener=lambda st: submitted.append(st))
+    # cut the cluster immediately, mid-publication
+    half_a = set(cluster.node_ids[:2]) | {leader.node_id}
+    half_b = set(cluster.node_ids) - half_a
+    cluster.transport.partition(half_a, half_b)
+    cluster.run(5.0)
+    cluster.transport.heal()
+    cluster.stable_leader()
+    cluster.run(3.0)
+    # the _record hook asserted per-version consistency throughout; now
+    # check convergence: all nodes agree whether 'racy' exists
+    presence = {("racy" in c.applied.metadata["indices"])
+                for c in cluster.nodes.values()}
+    assert len(presence) == 1
+
+
+def test_restart_recovers_from_persisted_state():
+    cluster = SimCluster(3, seed=5)
+    leader = cluster.stable_leader()
+    put_index(cluster, leader, "durable")
+    victim = next(c for c in cluster.nodes.values() if c.mode != "LEADER")
+    victim.stop()
+    cluster.transport.crash(victim.node_id)
+    cluster.run(2.0)
+    put_index(cluster, cluster.the_leader(), "while-down")
+    victim.restart()
+    cluster.transport.restart(victim.node_id)
+    cluster.run(3.0)
+    assert "durable" in victim.applied.metadata["indices"]
+    # lag repair: the restarted node catches up on the missed commit
+    assert "while-down" in victim.applied.metadata["indices"]
+
+
+def test_determinism_same_seed_same_history():
+    def history(seed):
+        cluster = SimCluster(5, seed=seed)
+        leader = cluster.stable_leader()
+        put_index(cluster, leader, "x")
+        leader.stop()
+        cluster.transport.crash(leader.node_id)
+        cluster.stable_leader()
+        cluster.run(2.0)
+        return sorted(cluster.commits.items())
+
+    h1 = history(123)
+    h2 = history(123)
+    assert h1 == h2
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_disruption_schedule_safety(seed):
+    """Randomized kill/partition/heal schedule: safety must hold for every
+    seed (the reference runs randomized AbstractCoordinatorTestCase
+    schedules the same way)."""
+    cluster = SimCluster(5, seed=seed)
+    rng = cluster.queue.rng
+    leader = cluster.stable_leader()
+    counter = [0]
+
+    def maybe_update():
+        ls = cluster.leaders()
+        if len(ls) == 1:
+            name = f"i{counter[0]}"
+            counter[0] += 1
+            try:
+                ls[0].submit_state_update(
+                    lambda s, n=name: _with_index(s, n))
+            except NotLeaderError:
+                pass
+
+    def _with_index(state, name):
+        new = state.updated()
+        new.metadata["indices"][name] = {"num_shards": 1}
+        return new
+
+    crashed = []
+    for step in range(12):
+        action = rng.random()
+        if action < 0.3 and not crashed:
+            ls = cluster.leaders()
+            if ls:
+                victim = ls[0]
+                victim.stop()
+                cluster.transport.crash(victim.node_id)
+                crashed.append(victim)
+        elif action < 0.5:
+            ids = [n for n in cluster.node_ids
+                   if not cluster.nodes[n].stopped]
+            if len(ids) >= 3:
+                cut = set(ids[: len(ids) // 2])
+                cluster.transport.partition(
+                    cut, set(cluster.node_ids) - cut)
+        elif action < 0.7:
+            cluster.transport.heal()
+            for v in crashed:
+                v.restart()
+                cluster.transport.restart(v.node_id)
+            crashed.clear()
+        else:
+            maybe_update()
+        cluster.run(rng.uniform(0.3, 1.5))
+        cluster.assert_unique_leader_per_term()
+    # final heal: the cluster must converge (liveness) with safety intact
+    cluster.transport.heal()
+    for v in crashed:
+        v.restart()
+        cluster.transport.restart(v.node_id)
+    cluster.stable_leader(timeout=20.0)
+    cluster.run(3.0)
+    versions = {c.applied.version for c in cluster.nodes.values()
+                if not c.stopped}
+    assert len(versions) == 1, f"cluster failed to converge: {versions}"
+
+
+def test_crash_drops_queued_tasks_and_fails_listeners():
+    """In-memory update closures must die with the node; waiting listeners
+    get a failure callback (None), never silence."""
+    cluster = SimCluster(3, seed=9)
+    leader = cluster.stable_leader()
+    results = []
+    leader.submit_state_update(
+        lambda s: _add_idx(s, "committed-first"),
+        listener=lambda st: results.append(("a", st)))
+    # queue a second task behind the in-flight publication, then crash
+    leader.submit_state_update(
+        lambda s: _add_idx(s, "queued-at-crash"),
+        listener=lambda st: results.append(("b", st)))
+    leader.stop()
+    cluster.transport.crash(leader.node_id)
+    cluster.stable_leader()
+    cluster.run(3.0)
+    leader.restart()
+    cluster.transport.restart(leader.node_id)
+    cluster.stable_leader()
+    cluster.run(3.0)
+    for c in cluster.nodes.values():
+        assert "queued-at-crash" not in c.applied.metadata["indices"], \
+            "a crashed node's in-memory task closure was resurrected"
+    # the queued task's listener must have been failure-notified by now
+    assert ("b", None) in results
+
+
+def _add_idx(state, name):
+    new = state.updated()
+    new.metadata["indices"][name] = {"num_shards": 1}
+    return new
