@@ -369,10 +369,10 @@ class Coordinator:
                 self._become_candidate()
             self.mode = FOLLOWER
             self.known_leader = payload["source"]
-        if (term, version) <= (self.persisted.accepted_term,
-                               self.persisted.accepted.version) and not (
-                term == self.persisted.accepted_term and
-                version == self.persisted.accepted.version):
+        # strictly-older publications are stale; re-accepting the identical
+        # (term, version) is allowed — the catch-up path resends it
+        if (term, version) < (self.persisted.accepted_term,
+                              self.persisted.accepted.version):
             return {"accepted": False, "reason": "stale version"}
         self._accept_publication(ClusterState(payload["state"]))
         return {"accepted": True}
@@ -417,6 +417,16 @@ class Coordinator:
         pending = {"count": len(self._peers())}
 
         def mark(node, resp):
+            # a follower that REJECTED the heartbeat (it moved to a newer
+            # term) is not reachability — counting it would let a deposed
+            # leader keep quorum forever under asymmetric partitions
+            if resp.get("term", 0) > self.term:
+                self._set_term(resp["term"])
+                if self.mode == LEADER:
+                    self._become_candidate()
+                return
+            if not resp.get("ok"):
+                return
             reachable.add(node)
             # lag repair (the reference's LagDetector + full-state resend):
             # a healed follower reports a stale committed version in its

@@ -108,6 +108,9 @@ class Engine:
         self._refresh_listeners: List = []
         self.stats = {"index_total": 0, "delete_total": 0, "refresh_total": 0,
                       "flush_total": 0, "merge_total": 0, "get_total": 0}
+        #: optional () -> int returning the lowest seq-no that must stay in
+        #: translog history (set by the replication layer's lease tracker)
+        self.history_retention_provider = None
 
         self._recover_from_store()
         # allocate the buffer only after recovery has claimed the persisted
@@ -467,7 +470,14 @@ class Engine:
             os.fsync(f.fileno())
         os.replace(tmp, self._commit_point_path())
         self._committed_seq_no = self.tracker.checkpoint
-        self.translog.mark_committed(self.tracker.checkpoint)
+        committed = self.tracker.checkpoint
+        if self.history_retention_provider is not None:
+            # retention leases (ReplicationTracker.min_retained_seq_no) pin
+            # translog history for recovering copies: never trim at/above
+            # the lease floor, even though the ops are committed
+            committed = min(committed,
+                            self.history_retention_provider() - 1)
+        self.translog.mark_committed(committed)
         self.translog.rollover()
         self.translog.trim_unneeded_generations()
         # drop orphaned segment files from before merges (the .live.npy

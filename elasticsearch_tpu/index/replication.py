@@ -1,0 +1,302 @@
+"""Shard replication: primary fan-out, global checkpoints, peer recovery.
+
+Re-design of the reference's replication write path and recovery stack:
+
+- ``action/support/replication/TransportReplicationAction.java:94`` /
+  ``ReplicationOperation.java:57,181`` — the primary executes an op,
+  assigns its seq-no, then fans it out to every in-sync copy and only
+  acks once the group has it; a failed copy is demoted out of the in-sync
+  set rather than blocking the write.
+- ``index/seqno/ReplicationTracker.java`` — primary-side checkpoint
+  bookkeeping (already implemented in ``seqno.py``; this module is its
+  first production consumer).
+- ``indices/recovery/RecoverySourceHandler.java:149`` — peer recovery:
+  ops-based replay from the primary's translog when history retention
+  covers the copy's checkpoint (phase2 :667), file-based store copy +
+  replay otherwise (phase1 :463).
+- Primary-term fencing (``IndexShard.java`` operation primary terms): a
+  replica rejects ops from a deposed primary's term, so a network-zombie
+  old primary cannot diverge a copy after promotion.
+
+Replica copies are reached through a :class:`ReplicaChannel` so the same
+group logic runs over direct in-process calls (here, and in the
+deterministic sim) or a node-to-node transport (the multi-node path).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from ..common.errors import ElasticsearchError, IllegalArgumentError
+from .engine import DeleteResult, Engine, IndexResult
+from .seqno import ReplicationTracker, UNASSIGNED_SEQ_NO
+from .translog import OP_DELETE, OP_INDEX, OP_NOOP, TranslogOp
+
+
+class ReplicaFencedError(ElasticsearchError):
+    status = 409
+    error_type = "illegal_index_shard_state_exception"
+
+
+class ReplicaShard:
+    """One replica copy: an engine plus the fencing/checkpoint surface the
+    primary talks to. In-process stand-in for the replica-side transport
+    handlers (``TransportReplicationAction.ReplicaOperationTransportHandler``)."""
+
+    def __init__(self, allocation_id: str, engine: Engine):
+        self.allocation_id = allocation_id
+        self.engine = engine
+        self.known_global_checkpoint = UNASSIGNED_SEQ_NO
+
+    def _fence(self, primary_term: int) -> None:
+        # the engine's primary term is the single fencing authority — a
+        # promotion bumps it there, immediately fencing the old primary
+        if primary_term < self.engine.primary_term:
+            raise ReplicaFencedError(
+                f"operation primary term [{primary_term}] is too old "
+                f"(current [{self.engine.primary_term}])")
+        if primary_term > self.engine.primary_term:
+            self.engine.primary_term = primary_term
+
+    def apply_index(self, primary_term: int, seq_no: int, version: int,
+                    doc_id: str, source: dict,
+                    routing: Optional[str], global_checkpoint: int) -> int:
+        self._fence(primary_term)
+        self.engine.index(doc_id, source, routing=routing, seq_no=seq_no,
+                          version=version)
+        self._update_gcp(global_checkpoint)
+        return self.engine.tracker.checkpoint
+
+    def apply_delete(self, primary_term: int, seq_no: int, version: int,
+                     doc_id: str, global_checkpoint: int) -> int:
+        self._fence(primary_term)
+        self.engine.delete(doc_id, seq_no=seq_no, version=version)
+        self._update_gcp(global_checkpoint)
+        return self.engine.tracker.checkpoint
+
+    def apply_translog_op(self, primary_term: int, op: TranslogOp) -> int:
+        self._fence(primary_term)
+        if op.op_type == OP_INDEX:
+            self.engine.index(op.doc_id, op.source, routing=op.routing,
+                              seq_no=op.seq_no, version=op.version)
+        elif op.op_type == OP_DELETE:
+            self.engine.delete(op.doc_id, seq_no=op.seq_no,
+                               version=op.version)
+        else:
+            self.engine.noop(op.seq_no, op.reason or "recovery")
+        return self.engine.tracker.checkpoint
+
+    def _update_gcp(self, global_checkpoint: int) -> None:
+        # replicas learn the global checkpoint piggybacked on writes
+        # (ReplicationTracker.updateGlobalCheckpointOnReplica); it is the
+        # copy's safe resume point when it later peer-recovers or promotes
+        self.known_global_checkpoint = max(
+            self.known_global_checkpoint, global_checkpoint)
+
+    @property
+    def local_checkpoint(self) -> int:
+        return self.engine.tracker.checkpoint
+
+
+class ReplicaChannel:
+    """Transport seam: the in-process default calls the replica directly;
+    the multi-node build substitutes an RPC-backed channel with identical
+    semantics (exceptions propagate as failures)."""
+
+    def __init__(self, replica: ReplicaShard):
+        self.replica = replica
+
+    def index(self, *a, **kw) -> int:
+        return self.replica.apply_index(*a, **kw)
+
+    def delete(self, *a, **kw) -> int:
+        return self.replica.apply_delete(*a, **kw)
+
+    def translog_op(self, *a, **kw) -> int:
+        return self.replica.apply_translog_op(*a, **kw)
+
+    def sync_gcp(self, global_checkpoint: int) -> None:
+        self.replica._update_gcp(global_checkpoint)
+
+    @property
+    def allocation_id(self) -> str:
+        return self.replica.allocation_id
+
+
+@dataclass
+class ReplicationResponse:
+    result: object                       # IndexResult | DeleteResult
+    total: int
+    successful: int
+    failed: List[str]
+
+
+class PrimaryShardGroup:
+    """The primary's replication group: local engine + replica channels +
+    the (previously dead, now load-bearing) ReplicationTracker."""
+
+    def __init__(self, allocation_id: str, engine: Engine,
+                 on_replica_failure: Optional[Callable[[str, Exception],
+                                                       None]] = None):
+        self.allocation_id = allocation_id
+        self.engine = engine
+        self.tracker = ReplicationTracker(allocation_id, engine.tracker)
+        self.tracker.activate_primary_mode(engine.tracker.checkpoint)
+        self.replicas: Dict[str, ReplicaChannel] = {}
+        self.on_replica_failure = on_replica_failure
+        # retention leases actually pin translog history: flushes on this
+        # engine will not trim ops at/above the lease floor
+        engine.history_retention_provider = self.tracker.min_retained_seq_no
+
+    # -- write path ----------------------------------------------------------
+
+    def index(self, doc_id: str, source: dict, *,
+              routing: Optional[str] = None,
+              if_seq_no: Optional[int] = None,
+              if_primary_term: Optional[int] = None,
+              op_type: str = "index") -> ReplicationResponse:
+        r: IndexResult = self.engine.index(
+            doc_id, source, routing=routing, if_seq_no=if_seq_no,
+            if_primary_term=if_primary_term, op_type=op_type)
+        return self._replicate(
+            r, lambda ch: ch.index(
+                self.engine.primary_term, r.seq_no, r.version, doc_id,
+                source, routing, self.tracker.global_checkpoint))
+
+    def delete(self, doc_id: str, *,
+               if_seq_no: Optional[int] = None,
+               if_primary_term: Optional[int] = None) -> ReplicationResponse:
+        r: DeleteResult = self.engine.delete(
+            doc_id, if_seq_no=if_seq_no, if_primary_term=if_primary_term)
+        return self._replicate(
+            r, lambda ch: ch.delete(
+                self.engine.primary_term, r.seq_no, r.version, doc_id,
+                self.tracker.global_checkpoint))
+
+    def _replicate(self, result,
+                   send: Callable[[ReplicaChannel], int]
+                   ) -> ReplicationResponse:
+        failed: List[str] = []
+        for aid, ch in list(self.replicas.items()):
+            try:
+                replica_ckpt = send(ch)
+                self.tracker.update_local_checkpoint(aid, replica_ckpt)
+            except Exception as e:   # noqa: BLE001 — a copy failed, not us
+                failed.append(aid)
+                self._fail_replica(aid, e)
+        self.tracker.update_local_checkpoint(
+            self.allocation_id, self.engine.tracker.checkpoint)
+        return ReplicationResponse(
+            result=result, total=1 + len(self.replicas) + len(failed),
+            successful=1 + len(self.replicas), failed=failed)
+
+    def _fail_replica(self, allocation_id: str, error: Exception) -> None:
+        """Demote a failed copy (ReplicationOperation.java:181 →
+        shard-failed to the master; here: drop from the group)."""
+        self.replicas.pop(allocation_id, None)
+        self.tracker.remove_allocation(allocation_id)
+        if self.on_replica_failure:
+            self.on_replica_failure(allocation_id, error)
+
+    # -- group management / recovery ----------------------------------------
+
+    def add_replica(self, replica: ReplicaShard) -> None:
+        """Peer-recover a new/stale copy into the in-sync set
+        (RecoverySourceHandler.recoverToTarget :149)."""
+        aid = replica.allocation_id
+        self.tracker.init_tracking(aid)
+        lease_floor = replica.local_checkpoint + 1
+        self.tracker.add_lease(f"peer_recovery/{aid}", max(lease_floor, 0),
+                               source="peer recovery")
+        channel = ReplicaChannel(replica)
+
+        ops = self.engine.translog.read_ops(
+            from_seq_no=replica.local_checkpoint + 1)
+        covered = self._history_covers(replica.local_checkpoint + 1, ops)
+        if not covered:
+            # phase1: file-based — ship the primary's store wholesale,
+            # then replay what the new commit point doesn't contain.
+            # Re-opens the engine IN PLACE: the caller's ReplicaShard
+            # stays the live object (it may later be promoted)
+            self._file_based_restart(replica)
+            ops = self.engine.translog.read_ops(
+                from_seq_no=replica.local_checkpoint + 1)
+
+        # phase2: ops-based replay from the translog
+        for op in ops:
+            channel.translog_op(self.engine.primary_term, op)
+
+        # the copy is caught up to everything the primary had when we
+        # snapshotted; ops indexed meanwhile arrive via the live fan-out
+        # (which starts now) — matching the reference's "finalize" step
+        self.replicas[aid] = channel
+        self.tracker.mark_in_sync(aid, replica.local_checkpoint)
+        self.tracker.remove_lease(f"peer_recovery/{aid}")
+
+    def _history_covers(self, from_seq_no: int,
+                        ops: List[TranslogOp]) -> bool:
+        """True if retained translog history contains every op in
+        [from_seq_no, max_seq_no] (no gaps below what we must replay)."""
+        need_from = from_seq_no
+        have = {op.seq_no for op in ops}
+        for s in range(need_from, self.engine.tracker.max_seq_no + 1):
+            if s not in have:
+                return False
+        return True
+
+    def _file_based_restart(self, replica: ReplicaShard) -> None:
+        """Replace the replica's store with a copy of the primary's and
+        re-open its engine in place (phase1 file sync)."""
+        self.engine.flush()
+        replica_path = replica.engine.path
+        mapper = replica.engine.mapper
+        replica.engine.close()
+        store_src = self.engine.store_dir
+        store_dst = os.path.join(replica_path, "store")
+        translog_dst = os.path.join(replica_path, "translog")
+        shutil.rmtree(store_dst, ignore_errors=True)
+        shutil.rmtree(translog_dst, ignore_errors=True)
+        shutil.copytree(store_src, store_dst)
+        replica.engine = Engine(replica_path, mapper,
+                                primary_term=self.engine.primary_term)
+
+    # -- checkpoints ---------------------------------------------------------
+
+    @property
+    def global_checkpoint(self) -> int:
+        return self.tracker.global_checkpoint
+
+    def sync_global_checkpoint(self) -> None:
+        """Background GCP sync (the reference's
+        ``GlobalCheckpointSyncAction``) — piggybacking covers the common
+        case; this pushes after quiet periods. Goes through the channel
+        seam so an RPC-backed channel works identically."""
+        for aid, ch in list(self.replicas.items()):
+            try:
+                ch.sync_gcp(self.tracker.global_checkpoint)
+            except Exception as e:   # noqa: BLE001
+                self._fail_replica(aid, e)
+
+
+def promote_to_primary(replica: ReplicaShard,
+                       new_primary_term: int) -> PrimaryShardGroup:
+    """Replica → primary promotion (the reference's
+    ``IndexShard.updateShardState`` on a promotion cluster-state delta):
+    bump the primary term, fill checkpoint gaps with no-ops so the local
+    checkpoint catches up to max_seq_no, and activate primary mode."""
+    engine = replica.engine
+    if new_primary_term <= engine.primary_term:
+        raise IllegalArgumentError(
+            f"promotion term [{new_primary_term}] must exceed "
+            f"[{engine.primary_term}]")
+    engine.primary_term = new_primary_term
+    # fill gaps: ops the old primary acked to us may skip seq-nos it
+    # assigned to writes that never reached this copy
+    # (IndexShard.fillSeqNoGaps)
+    for s in range(engine.tracker.checkpoint + 1,
+                   engine.tracker.max_seq_no + 1):
+        engine.noop(s, reason="primary promotion gap fill")
+    return PrimaryShardGroup(replica.allocation_id, engine)
