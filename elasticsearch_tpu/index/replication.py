@@ -150,6 +150,9 @@ class PrimaryShardGroup:
         # retention leases actually pin translog history: flushes on this
         # engine will not trim ops at/above the lease floor
         engine.history_retention_provider = self.tracker.min_retained_seq_no
+        #: set when a replica on a newer primary term fences us — this
+        #: group must stop acking writes (it has been deposed)
+        self.deposed = False
 
     # -- write path ----------------------------------------------------------
 
@@ -179,11 +182,22 @@ class PrimaryShardGroup:
     def _replicate(self, result,
                    send: Callable[[ReplicaChannel], int]
                    ) -> ReplicationResponse:
+        if self.deposed:
+            raise ReplicaFencedError(
+                "shard group was deposed by a newer primary term")
         failed: List[str] = []
         for aid, ch in list(self.replicas.items()):
             try:
                 replica_ckpt = send(ch)
                 self.tracker.update_local_checkpoint(aid, replica_ckpt)
+            except ReplicaFencedError:
+                # a copy on a NEWER primary term rejected us: WE are the
+                # deposed primary. Fail the operation (never ack) and stop
+                # accepting writes — the reference fails the primary shard
+                # on this (ReplicationOperation's primary-term check), it
+                # does not demote the promoted copy.
+                self.deposed = True
+                raise
             except Exception as e:   # noqa: BLE001 — a copy failed, not us
                 failed.append(aid)
                 self._fail_replica(aid, e)

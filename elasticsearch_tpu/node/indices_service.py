@@ -97,19 +97,32 @@ class IndexService:
     # -- search -------------------------------------------------------------
 
     def searcher(self) -> ShardSearcher:
-        """Searcher over every shard's searchable segments. Term statistics
-        are computed over the union — equivalent to the reference's DFS
-        phase being always-on (``search/dfs/DfsPhase.java``), which is
-        strictly more consistent than its per-shard default."""
+        """Pooled searcher over every shard's searchable segments (used by
+        single-shard paths and features that need one flat segment list,
+        e.g. scroll snapshots). Term statistics are computed over the
+        union — equivalent to the reference's DFS phase being always-on
+        (``search/dfs/DfsPhase.java``)."""
         segments = []
         for shard in self.shards:
             segments.extend(shard.searchable_segments())
         return ShardSearcher(segments, self.mapper)
 
+    def dist_searcher(self) -> "DistributedSearcher":
+        """Scatter-gather searcher: one query phase per shard, one global
+        reduce (``search/dist_query.py`` — the coordinating-node role)."""
+        from ..search.dist_query import DistributedSearcher
+        return DistributedSearcher(
+            [shard.searchable_segments() for shard in self.shards],
+            self.mapper)
+
     def search(self, body: Optional[dict] = None) -> ShardSearchResult:
+        if self.num_shards > 1:
+            return self.dist_searcher().search(body or {})
         return self.searcher().search(body or {})
 
     def count(self, body: Optional[dict] = None) -> int:
+        if self.num_shards > 1:
+            return self.dist_searcher().count(body or {})
         return self.searcher().count(body or {})
 
     # -- admin --------------------------------------------------------------

@@ -991,13 +991,50 @@ class RestAPI:
             out["highlight"] = h.highlight
         return out
 
-    # score-path search_after cursors are [score, shard_doc]; across indices
-    # the shard_doc is made globally unique by folding the index ordinal into
-    # the high bits (ES: PIT's implicit _shard_doc is likewise a global
-    # shard-ordinal << 32 | doc)
-    _GSD_ORD_SHIFT = 52
+    # search_after tiebreak cursors fold the index ordinal into the high
+    # bits of the shard-doc component (ES: PIT's implicit _shard_doc is
+    # likewise a global composite). 64 clears the DistributedSearcher's
+    # shard<<48 | seg<<32 | doc encoding for any shard count.
+    _GSD_ORD_SHIFT = 64
+
+    def _index_local_cursor(self, sa, idx_ord: int, score_sorted: bool,
+                            n_user: int):
+        """Translate a cross-index search_after cursor into one index's
+        local cursor: the cursor index gets the local composite, earlier
+        indices exclude equal-tiebreak rows, later ones include them.
+        Returns None to drop the cursor for this index."""
+        shift = self._GSD_ORD_SHIFT
+        if score_sorted:
+            if len(sa) < 2:
+                return list(sa)
+            gsd = int(sa[1])
+            a_ord = gsd >> shift
+            local = gsd & ((1 << shift) - 1)
+            if a_ord == idx_ord:
+                return [sa[0], local]
+            if a_ord < idx_ord:
+                return [sa[0], -1]           # include all ties
+            return [sa[0]]                   # exclude all ties
+        if len(sa) != n_user + 1:
+            return list(sa)                  # legacy strict tuple cursor
+        try:
+            gsd = int(sa[-1])
+        except (OverflowError, ValueError):  # e.g. inf sentinel
+            return list(sa)
+        if gsd < 0:
+            return list(sa)
+        a_ord = gsd >> shift
+        local = gsd & ((1 << shift) - 1)
+        prefix = list(sa[:-1])
+        if a_ord == idx_ord:
+            return prefix + [local]
+        if a_ord < idx_ord:
+            return prefix + [-1.0]           # equal-prefix rows all pass
+        return prefix + [float("inf")]       # equal-prefix rows excluded
 
     def _search_indices(self, names: List[str], search_body: dict) -> dict:
+        from ..search.dist_query import merge_sort_key
+        from ..search.shard_search import normalize_sort
         t0 = time.time()
         size = int(search_body.get("size", 10))
         from_ = int(search_body.get("from", 0))
@@ -1005,27 +1042,23 @@ class RestAPI:
         window_body = dict(search_body)
         window_body["size"] = size + from_
         window_body["from"] = 0
-        score_sorted = not (search_body.get("sort") and not _sort_is_score(
-            search_body.get("sort")))
+        sort_spec = search_body.get("sort")
+        score_sorted = not (sort_spec and not _sort_is_score(sort_spec))
+        user_clauses = normalize_sort(sort_spec) if sort_spec and \
+            not score_sorted else []
+        n_user = len(user_clauses)
         sa = search_body.get("search_after")
         ord_of = {n: i for i, n in enumerate(names)}
+        shift = self._GSD_ORD_SHIFT
+        local_mask = (1 << shift) - 1
         for n in names:
             body_n = window_body
-            if score_sorted and sa is not None and len(sa) > 1 \
-                    and len(names) > 1:
-                # translate the global cursor into this index's local one:
-                # ties in earlier indices sort before the cursor, later
-                # indices after it
-                gsd = int(sa[1])
-                a_ord = gsd >> self._GSD_ORD_SHIFT
-                local = gsd & ((1 << self._GSD_ORD_SHIFT) - 1)
+            if sa is not None and len(names) > 1:
                 body_n = dict(window_body)
-                if a_ord == ord_of[n]:
-                    body_n["search_after"] = [sa[0], local]
-                elif a_ord < ord_of[n]:
-                    body_n["search_after"] = [sa[0], -1]  # include all ties
-                else:
-                    body_n["search_after"] = [sa[0]]      # exclude all ties
+                cursor = self._index_local_cursor(
+                    sa, ord_of[n], score_sorted, n_user)
+                if cursor is not None:
+                    body_n["search_after"] = cursor
             svc = self.indices.indices[n]
             results.append((n, svc.search(body_n)))
         total = sum(r.total for _, r in results)
@@ -1036,7 +1069,21 @@ class RestAPI:
                       if r.max_score is not None]
         all_hits = [(n, h) for n, r in results for h in r.hits]
         if not score_sorted:
-            all_hits.sort(key=lambda nh: _sort_key_tuple(nh[1]))
+            # clause-aware merge (direction + missing placement), then the
+            # global (index ordinal, shard-doc) tiebreak — matching the
+            # cursor translation order
+            def _fkey(nh):
+                n, h = nh
+                vals = h.sort_values or []
+                sd = vals[n_user] if len(vals) > n_user else 0
+                return (merge_sort_key(user_clauses, vals[:n_user]),
+                        ord_of[n], sd)
+            all_hits.sort(key=_fkey)
+            for n, h in all_hits:
+                if h.sort_values is not None and \
+                        len(h.sort_values) == n_user + 1:
+                    h.sort_values = h.sort_values[:n_user] + [
+                        (ord_of[n] << shift) | int(h.sort_values[n_user])]
         else:
             # tie order MUST match the shards' (score desc, shard_doc asc)
             # cursor order or pagination duplicates/skips tied docs
@@ -1051,8 +1098,7 @@ class RestAPI:
                 if h.sort_values is not None and len(h.sort_values) > 1:
                     h.sort_values = [
                         h.sort_values[0],
-                        (ord_of[n] << self._GSD_ORD_SHIFT)
-                        | int(h.sort_values[1])]
+                        (ord_of[n] << shift) | int(h.sort_values[1])]
         page = all_hits[from_: from_ + size]
         aggregations = None
         if len(names) == 1:

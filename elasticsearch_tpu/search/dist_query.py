@@ -1,0 +1,306 @@
+"""Distributed query execution: scatter per shard, one global reduce.
+
+Re-design of the reference's search coordination
+(``action/search/AbstractSearchAsyncAction.java:70`` fans the query to one
+copy of every shard; ``SearchPhaseController.java:155-219`` merges the
+per-shard ``TopDocs``/aggregation trees on the coordinating node). The
+full query DSL — bool trees, filters, sort, knn, highlights — executes
+*per shard* through :class:`ShardSearcher` (each shard's segments live on
+its device; the bag-of-words/kNN hot paths additionally have the fully
+on-mesh SPMD plane in ``parallel/dist_search.py``), and this module is
+the coordinating side:
+
+- **DFS phase always-on**: term statistics (df, avgdl, doc counts) are
+  computed over ALL shards and injected into every shard's context, so
+  scores are identical to a single pooled searcher
+  (``search/dfs/DfsPhase.java`` — the reference makes this opt-in; global
+  stats are cheap host-side sums here).
+- **Query phase**: every shard returns its top ``from+size`` window
+  (sorted by the request's sort), its total, and its per-segment
+  aggregation inputs.
+- **Reduce**: hits merge by the sort key with the global
+  ``(shard, segment, doc)`` tie-break (ES's ``_shard_doc``); aggregation
+  partials from every shard's segments reduce ONCE globally — per-shard
+  pre-reduce would break exactness for terms/cardinality.
+- **search_after**: the composite score cursor carries a global shard-doc
+  component; the coordinator rewrites it into the correct per-shard local
+  cursor (strict-below for shards ordered before the cursor shard, local
+  composite on it, ties-allowed after it).
+
+``rank.rrf`` requests fall back to the pooled single-searcher path:
+reciprocal-rank fusion needs *global* per-ranking positions, which a
+per-shard scatter cannot provide without shipping full rankings — the
+reference centralizes RRF on the coordinator the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..index.mapping import MapperService
+from .aggregations import (AggregationContext, parse_aggs,
+                           run_aggregations_multi)
+from .query_dsl import ShardContext
+from .shard_search import (ShardHit, ShardSearcher, ShardSearchResult,
+                           _tree_needs_scores)
+
+#: bits reserved for the (segment, doc) part of the global shard-doc key
+_LOCAL_BITS = 48
+
+
+class DfsShardContext(ShardContext):
+    """Per-shard context whose statistics delegate to the cross-shard
+    union — the always-on DFS phase."""
+
+    def __init__(self, segments, mapper, global_ctx: ShardContext):
+        super().__init__(segments, mapper)
+        self._global = global_ctx
+        self.total_docs = global_ctx.total_docs
+
+    def term_df(self, field: str, term: str) -> int:
+        return self._global.term_df(field, term)
+
+    def field_avgdl(self, field: str) -> float:
+        return self._global.field_avgdl(field)
+
+
+class _Desc:
+    """Inverts comparisons for descending non-numeric sort keys."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+
+def merge_sort_key(clauses: List[dict], sort_values: List[Any]) -> tuple:
+    """Clause-aware coordinator merge key over a hit's raw sort values
+    (``SearchPhaseController``'s cross-shard comparator): numbers negate
+    for desc, strings wrap in a comparison-inverting proxy, None obeys the
+    clause's missing-first/last placement."""
+    parts = []
+    for clause, v in zip(clauses, sort_values):
+        desc = clause["order"] == "desc"
+        missing_first = clause["missing"] == "_first"
+        if v is None:
+            parts.append((-1 if missing_first else 1, 0))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            parts.append((0, -float(v) if desc else float(v)))
+        else:
+            parts.append((0, _Desc(v) if desc else v))
+    return tuple(parts)
+
+
+class DistributedSearcher:
+    """Coordinating-node search over one searcher per shard."""
+
+    def __init__(self, shard_segment_lists: List[list],
+                 mapper: MapperService):
+        all_segments = [s for segs in shard_segment_lists for s in segs]
+        self._global_ctx = ShardContext(all_segments, mapper)
+        self.mapper = mapper
+        self.shards: List[ShardSearcher] = []
+        for segs in shard_segment_lists:
+            searcher = ShardSearcher(segs, mapper)
+            searcher.ctx = DfsShardContext(searcher.segments, mapper,
+                                           self._global_ctx)
+            self.shards.append(searcher)
+
+    # ------------------------------------------------------------------
+
+    def search(self, body: Optional[dict] = None) -> ShardSearchResult:
+        body = body or {}
+        if body.get("rank") and "rrf" in body["rank"]:
+            # global-rank fusion: run pooled (see module docstring)
+            pooled = ShardSearcher(self._global_ctx.segments, self.mapper)
+            pooled.ctx = self._global_ctx
+            return pooled.search(body)
+
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        track_total_hits = body.get("track_total_hits", True)
+        aggs_spec = body.get("aggs") or body.get("aggregations")
+        sort_spec = body.get("sort")
+        search_after = body.get("search_after")
+        use_field_sort = False
+        clauses = None
+        if sort_spec:
+            clauses = self.shards[0]._normalize_sort(sort_spec) \
+                if self.shards else []
+            use_field_sort = bool(clauses) and \
+                clauses[0]["field"] != "_score"
+
+        shard_body = dict(body)
+        shard_body["size"] = size + from_
+        shard_body["from"] = 0
+        shard_body.pop("aggs", None)
+        shard_body.pop("aggregations", None)
+        if aggs_spec:
+            shard_body["aggs"] = aggs_spec          # parsed, inputs only
+        if isinstance(track_total_hits, int) and not isinstance(
+                track_total_hits, bool):
+            shard_body["track_total_hits"] = True   # cap at the coordinator
+        # shards append the implicit trailing _doc tiebreak themselves
+        # (ShardSearcher._field_sorted_page) and return n_user+1 values
+        n_user_sort = len(clauses) if clauses else 0
+
+        # -- knn DFS phase: per-shard candidates → global top-k -------------
+        knn_overrides = self._global_knn(body.get("knn"))
+
+        per_shard: List[ShardSearchResult] = []
+        for shard_idx, shard in enumerate(self.shards):
+            sb = shard_body
+            if search_after is not None:
+                local_after = self._local_cursor_any(
+                    search_after, shard_idx, use_field_sort, n_user_sort)
+                sb = dict(shard_body)
+                if local_after is not None:
+                    sb["search_after"] = local_after
+                else:
+                    sb.pop("search_after", None)
+            per_shard.append(shard.search(
+                sb, collect_agg_inputs=True,
+                knn_override=(knn_overrides[shard_idx]
+                              if knn_overrides is not None else None)))
+
+        # -- totals ---------------------------------------------------------
+        total = sum(r.total for r in per_shard)
+        total_relation = "gte" if any(r.total_relation == "gte"
+                                      for r in per_shard) else "eq"
+        if isinstance(track_total_hits, int) and not isinstance(
+                track_total_hits, bool) and total > track_total_hits:
+            total = track_total_hits
+            total_relation = "gte"
+
+        # -- merge hits (SearchPhaseController.sortDocs) --------------------
+        merged: List[Tuple[tuple, int, ShardHit]] = []
+        for shard_idx, r in enumerate(per_shard):
+            for h in r.hits:
+                merged.append((self._merge_key(clauses, use_field_sort,
+                                               shard_idx, h),
+                               shard_idx, h))
+        merged.sort(key=lambda t: t[0])
+        page = merged[from_: from_ + size]
+        hits: List[ShardHit] = []
+        max_score = None
+        for key, shard_idx, h in page:
+            # rewrite the tiebreak into the GLOBAL shard-doc space so the
+            # cursor round-trips across shards
+            if not use_field_sort and h.score is not None:
+                h.sort_values = [h.score, self._global_shard_doc(
+                    shard_idx, h.seg_idx, h.local_doc)]
+            elif use_field_sort and h.sort_values is not None and \
+                    len(h.sort_values) == n_user_sort + 1:
+                local_sd = int(h.sort_values[-1])
+                h.sort_values = h.sort_values[:-1] + [
+                    (shard_idx << _LOCAL_BITS) | local_sd]
+            hits.append(h)
+        scores = [r.max_score for r in per_shard if r.max_score is not None]
+        if scores:
+            max_score = max(scores)
+
+        # -- one global aggregation reduce ----------------------------------
+        agg_results = None
+        if aggs_spec:
+            aggs = parse_aggs(aggs_spec)
+            triples = []
+            for shard, r in zip(self.shards, per_shard):
+                seg_scores = {}
+                if _tree_needs_scores(aggs):
+                    seg_scores = {seg.seg_id: sc
+                                  for seg, _, sc in (r.agg_inputs or [])
+                                  if sc is not None}
+                ctx = AggregationContext(self.mapper, shard_ctx=shard.ctx,
+                                         seg_scores=seg_scores)
+                for seg, mask, _ in (r.agg_inputs or []):
+                    triples.append((ctx, seg, mask))
+            agg_results = run_aggregations_multi(aggs, triples)
+
+        return ShardSearchResult(total=total, total_relation=total_relation,
+                                 hits=hits, max_score=max_score,
+                                 aggregations=agg_results)
+
+    def count(self, body: Optional[dict] = None) -> int:
+        return sum(s.count(body) for s in self.shards)
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _global_shard_doc(shard_idx: int, seg_idx: int, doc: int) -> int:
+        return (shard_idx << _LOCAL_BITS) | (seg_idx << 32) | doc
+
+    def _global_knn(self, knn_spec):
+        """knn DFS phase: each shard surfaces its local top-k per ranking,
+        the coordinator keeps the GLOBAL top-k and hands each shard its
+        slice (the reference's ``KnnSearchBuilder`` DFS round-trip —
+        per-shard-k hybrid scoring would otherwise boost docs that are not
+        global knn winners)."""
+        if not knn_spec:
+            return None
+        specs = knn_spec if isinstance(knn_spec, list) else [knn_spec]
+        overrides = [[[] for _ in specs] for _ in self.shards]
+        for ri, spec in enumerate(specs):
+            k = int(spec.get("k", 10))
+            cands = []
+            for si, shard in enumerate(self.shards):
+                for sc, seg_idx, d in shard._knn_candidates(spec):
+                    cands.append((sc, si, seg_idx, d))
+            cands.sort(key=lambda c: (-c[0], c[1], c[2], c[3]))
+            for sc, si, seg_idx, d in cands[:k]:
+                overrides[si][ri].append((sc, seg_idx, d))
+        return overrides
+
+    @staticmethod
+    def _local_cursor_any(search_after, shard_idx: int,
+                          use_field_sort: bool, n_user_sort: int):
+        """Rewrite a global cursor into the shard's local cursor (see
+        module docstring). Returns None for 'no cursor on this shard'."""
+        if not use_field_sort:
+            if len(search_after) < 2:
+                return list(search_after)
+            a_score = search_after[0]
+            gsd = int(search_after[1])
+            cursor_shard = gsd >> _LOCAL_BITS
+            local_sd = gsd & ((1 << _LOCAL_BITS) - 1)
+            if shard_idx < cursor_shard:
+                return [a_score]             # strictly below the score
+            if shard_idx == cursor_shard:
+                return [a_score, local_sd]   # local composite
+            return [a_score, -1]             # ties allowed (after cursor)
+        if len(search_after) == n_user_sort:
+            # caller-built cursor without the implicit _shard_doc: the
+            # shard applies legacy strict-tuple semantics itself
+            return list(search_after)
+        prefix = list(search_after[:-1])
+        try:
+            gsd = int(search_after[-1])
+        except (OverflowError, ValueError):
+            # inf sentinel from an upstream coordinator: strict everywhere
+            return prefix + [float("inf")]
+        if gsd < 0:
+            return prefix + [-1.0]           # ties allowed everywhere
+        cursor_shard = gsd >> _LOCAL_BITS
+        local_sd = gsd & ((1 << _LOCAL_BITS) - 1)
+        if shard_idx < cursor_shard:
+            # equal-prefix rows must NOT pass: max _doc key
+            return prefix + [float((1 << _LOCAL_BITS) - 1)]
+        if shard_idx == cursor_shard:
+            return prefix + [float(local_sd)]
+        # equal-prefix rows all pass
+        return prefix + [-1.0]
+
+    def _merge_key(self, clauses, use_field_sort: bool, shard_idx: int,
+                   h: ShardHit) -> tuple:
+        tie = (shard_idx, h.seg_idx, h.local_doc)
+        if not use_field_sort:
+            score = h.score if h.score is not None else float("-inf")
+            return (-score,) + tie
+        return (merge_sort_key(clauses, h.sort_values or []),) + tie

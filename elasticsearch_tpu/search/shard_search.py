@@ -65,6 +65,11 @@ class ShardSearchResult:
     max_score: Optional[float]
     aggregations: Optional[Dict[str, Any]] = None
     profile: Optional[dict] = None
+    #: (segment, host mask, host scores | None) per segment — returned
+    #: instead of reduced aggregations when the caller (the distributed
+    #: coordinator) wants ONE global reduce across shards
+    agg_inputs: Optional[List[Tuple[Segment, np.ndarray,
+                                    Optional[np.ndarray]]]] = None
 
 
 def _knn_score_transform(similarity: str, sim):
@@ -144,22 +149,7 @@ class ShardSearcher:
     # ------------------------------------------------------------------
 
     def _normalize_sort(self, sort_spec) -> List[dict]:
-        if isinstance(sort_spec, (str, dict)):
-            sort_spec = [sort_spec]
-        out = []
-        for clause in sort_spec:
-            if isinstance(clause, str):
-                field, opts = clause, {}
-            elif isinstance(clause, dict) and len(clause) == 1:
-                (field, opts), = clause.items()
-                if isinstance(opts, str):
-                    opts = {"order": opts}
-            else:
-                raise ParsingError(f"invalid sort clause [{clause}]")
-            order = opts.get("order", "desc" if field == "_score" else "asc")
-            out.append({"field": field, "order": order,
-                        "missing": opts.get("missing", "_last")})
-        return out
+        return normalize_sort(sort_spec)
 
     def _sort_raw_for(self, clause: dict, seg_idx: int, seg: Segment,
                       docs: np.ndarray, scores: Optional[np.ndarray]):
@@ -210,7 +200,10 @@ class ShardSearcher:
 
     def search(self, body: Optional[dict] = None, *, size: int = 10,
                from_: int = 0, min_score: Optional[float] = None,
-               track_total_hits=True) -> ShardSearchResult:
+               track_total_hits=True,
+               collect_agg_inputs: bool = False,
+               knn_override: Optional[List[List[Tuple[float, int, int]]]]
+               = None) -> ShardSearchResult:
         body = body or {}
         size = int(body.get("size", size))
         from_ = int(body.get("from", from_))
@@ -299,7 +292,11 @@ class ShardSearcher:
 
         # --- knn section ---------------------------------------------------
         knn_rankings: List[List[Tuple[float, int, int]]] = []
-        if knn_spec:
+        if knn_override is not None:
+            # the coordinator already reduced per-shard knn candidates to
+            # the GLOBAL top-k and handed us this shard's slice
+            knn_rankings = knn_override
+        elif knn_spec:
             specs = knn_spec if isinstance(knn_spec, list) else [knn_spec]
             for spec in specs:
                 knn_rankings.append(self._knn_candidates(spec))
@@ -409,7 +406,13 @@ class ShardSearcher:
             hits.append(hit)
 
         agg_results = None
-        if aggs is not None:
+        agg_inputs = None
+        if aggs is not None and collect_agg_inputs:
+            need_scores = _tree_needs_scores(aggs)
+            agg_inputs = [(seg, np.asarray(m),
+                           np.asarray(sc) if need_scores else None)
+                          for seg, m, sc in agg_pending]
+        elif aggs is not None:
             seg_scores = ({seg.seg_id: np.asarray(sc)
                            for seg, _, sc in agg_pending}
                           if _tree_needs_scores(aggs) else {})
@@ -420,7 +423,8 @@ class ShardSearcher:
 
         return ShardSearchResult(total=total, total_relation=total_relation,
                                  hits=hits, max_score=max_score,
-                                 aggregations=agg_results)
+                                 aggregations=agg_results,
+                                 agg_inputs=agg_inputs)
 
     @staticmethod
     def _shard_doc(seg_idx: int, doc: int) -> int:
@@ -430,8 +434,22 @@ class ShardSearcher:
     def _field_sorted_page(self, sort_spec, search_after, host_masks,
                            host_scores, k):
         """Sorted query path: lexsort matched docs on normalized keys
-        (reference: ``search/sort/SortBuilder`` → Lucene ``SortField``)."""
+        (reference: ``search/sort/SortBuilder`` → Lucene ``SortField``).
+
+        An implicit trailing ``_doc`` tiebreak is always appended (the
+        reference's PIT ``_shard_doc``): without it, docs exactly tied on
+        every user sort key at a page boundary are skipped by the strict
+        search_after tuple filter. Cursors may carry the tiebreak value or
+        omit it (legacy strict-tuple semantics)."""
         clauses = self._normalize_sort(sort_spec)
+        n_user = len(clauses)
+        if clauses[-1]["field"] != "_doc":
+            clauses.append({"field": "_doc", "order": "asc",
+                            "missing": "_last"})
+        if search_after is not None and len(search_after) == n_user \
+                and len(clauses) == n_user + 1:
+            # no tiebreak in the cursor: exclude all equal-prefix rows
+            search_after = list(search_after) + [float("inf")]
         all_rows = []       # (seg_idx, doc)
         raw_cols = [[] for _ in clauses]
         for seg_idx, seg in enumerate(self.segments):
@@ -523,6 +541,27 @@ class ShardSearcher:
             _, mask = query.execute(self.ctx, seg)
             total += int(jnp.sum(mask & seg.live_dev))
         return total
+
+
+def normalize_sort(sort_spec) -> List[dict]:
+    """Sort spec → [{field, order, missing}] (shared by the shard searcher
+    and the coordinating merges in ``dist_query.py`` / the REST layer)."""
+    if isinstance(sort_spec, (str, dict)):
+        sort_spec = [sort_spec]
+    out = []
+    for clause in sort_spec:
+        if isinstance(clause, str):
+            field, opts = clause, {}
+        elif isinstance(clause, dict) and len(clause) == 1:
+            (field, opts), = clause.items()
+            if isinstance(opts, str):
+                opts = {"order": opts}
+        else:
+            raise ParsingError(f"invalid sort clause [{clause}]")
+        order = opts.get("order", "desc" if field == "_score" else "asc")
+        out.append({"field": field, "order": order,
+                    "missing": opts.get("missing", "_last")})
+    return out
 
 
 def _sort_includes_score(sort_spec) -> bool:

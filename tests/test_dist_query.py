@@ -1,0 +1,222 @@
+"""Distributed scatter-gather search vs the pooled single-searcher path:
+full DSL + aggs + sort + pagination must match exactly (the always-on DFS
+phase makes scores identical). Reference: AbstractSearchAsyncAction /
+SearchPhaseController merge semantics."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.dist_query import DistributedSearcher
+from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+MAPPING = {"properties": {
+    "body": {"type": "text"},
+    "tag": {"type": "keyword"},
+    "price": {"type": "double"},
+    "vec": {"type": "dense_vector", "dims": 4, "similarity": "cosine"},
+}}
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta",
+         "theta", "common"]
+
+
+def build(n_shards, n_docs=120, segs_per_shard=2, seed=0):
+    rng = np.random.RandomState(seed)
+    mapper = MapperService(MAPPING)
+    shard_segs = [[] for _ in range(n_shards)]
+    builders = {}
+    for d in range(n_docs):
+        shard = d % n_shards
+        seg = (d // n_shards) % segs_per_shard
+        b = builders.setdefault((shard, seg), SegmentBuilder(f"s{shard}_{seg}"))
+        nw = rng.randint(2, 6)
+        text = " ".join(rng.choice(WORDS, nw)) + (" common" if d % 3 else "")
+        b.add(mapper.parse_document(str(d), {
+            "body": text,
+            "tag": f"t{rng.randint(5)}",
+            "price": float(rng.randint(100)),
+            "vec": rng.randn(4).astype(float).tolist(),
+        }), seq_no=d)
+    for (shard, seg), b in sorted(builders.items()):
+        shard_segs[shard].append(b.build())
+    pooled = ShardSearcher([s for segs in shard_segs for s in segs], mapper)
+    dist = DistributedSearcher(shard_segs, mapper)
+    return pooled, dist
+
+
+@pytest.fixture(scope="module")
+def searchers():
+    return build(n_shards=3)
+
+
+def norm_hits(res):
+    return [(h.doc_id, None if h.score is None else round(h.score, 5),
+             h.sort_values if h.sort_values and h.score is None else None)
+            for h in res.hits]
+
+
+BODIES = [
+    {"query": {"match": {"body": "alpha beta"}}, "size": 15},
+    {"query": {"bool": {
+        "must": [{"match": {"body": "common"}}],
+        "should": [{"term": {"tag": "t1"}}],
+        "filter": [{"range": {"price": {"gte": 20}}}],
+        "must_not": [{"term": {"tag": "t4"}}]}}, "size": 20},
+    {"query": {"match_all": {}}, "size": 7, "from": 5},
+    {"query": {"match": {"body": "gamma"}}, "size": 10,
+     "min_score": 0.2},
+    {"query": {"constant_score": {"filter": {"terms": {
+        "tag": ["t0", "t2"]}}}}, "size": 10},
+]
+
+
+@pytest.mark.parametrize("body", BODIES)
+def test_hits_match_pooled(searchers, body):
+    pooled, dist = searchers
+    rp = pooled.search(dict(body))
+    rd = dist.search(dict(body))
+    assert rd.total == rp.total
+    assert len(rd.hits) == len(rp.hits)
+    # scores identical (global DFS stats); doc order may differ only on
+    # exact ties, where both orders are valid — compare (score → id-set)
+    ps = [round(h.score, 5) for h in rp.hits]
+    ds = [round(h.score, 5) for h in rd.hits]
+    assert ds == ps
+    from collections import defaultdict
+    by_score_p, by_score_d = defaultdict(set), defaultdict(set)
+    for h in rp.hits:
+        by_score_p[round(h.score, 5)].add(h.doc_id)
+    for h in rd.hits:
+        by_score_d[round(h.score, 5)].add(h.doc_id)
+    for sc in by_score_p:
+        # every fully-included score group matches exactly; the boundary
+        # group may be split differently between equally-valid tie orders
+        if len(by_score_p[sc]) == len(by_score_d[sc]):
+            assert by_score_p[sc] == by_score_d[sc]
+
+
+def test_terms_agg_matches_pooled(searchers):
+    pooled, dist = searchers
+    body = {"size": 0, "query": {"match": {"body": "common"}},
+            "aggs": {
+                "tags": {"terms": {"field": "tag", "size": 10}},
+                "price_stats": {"stats": {"field": "price"}},
+                "per_tag_price": {"terms": {"field": "tag", "size": 3},
+                                  "aggs": {"avg_p": {"avg": {
+                                      "field": "price"}}}},
+                "hist": {"histogram": {"field": "price", "interval": 25}},
+            }}
+    rp = pooled.search(dict(body))
+    rd = dist.search(dict(body))
+    assert rd.aggregations == rp.aggregations
+    assert rd.total == rp.total
+
+
+def test_field_sort_and_pagination_match(searchers):
+    pooled, dist = searchers
+    body = {"query": {"match_all": {}},
+            "sort": [{"price": "desc"}, {"tag": "asc"}], "size": 10}
+    rp = pooled.search(dict(body))
+    rd = dist.search(dict(body))
+    assert [h.sort_values[:2] for h in rd.hits] == \
+        [h.sort_values[:2] for h in rp.hits]
+    # paginate the distributed path with search_after through every page
+    # and check the union equals the pooled full ordering's values
+    seen = []
+    after = None
+    while True:
+        b = dict(body, size=9)
+        if after is not None:
+            b["search_after"] = after
+        r = dist.search(b)
+        if not r.hits:
+            break
+        seen.extend(h.sort_values[:2] for h in r.hits)
+        after = r.hits[-1].sort_values
+    full = pooled.search(dict(body, size=1000))
+    assert seen == [h.sort_values[:2] for h in full.hits]
+
+
+def test_score_search_after_globally_consistent(searchers):
+    """The global shard-doc cursor paginates every match exactly once."""
+    pooled, dist = searchers
+    body = {"query": {"match": {"body": "common"}}, "size": 6}
+    collected = []
+    after = None
+    while True:
+        b = dict(body)
+        if after is not None:
+            b["search_after"] = after
+        r = dist.search(b)
+        if not r.hits:
+            break
+        collected.extend(h.doc_id for h in r.hits)
+        after = r.hits[-1].sort_values
+    assert len(collected) == len(set(collected)), "duplicate during paging"
+    full = pooled.search(dict(body, size=1000))
+    assert set(collected) == {h.doc_id for h in full.hits}
+    assert len(collected) == full.total
+
+
+def test_knn_hybrid_matches_pooled(searchers):
+    pooled, dist = searchers
+    body = {"query": {"match": {"body": "common"}},
+            "knn": {"field": "vec", "query_vector": [0.5, -0.2, 0.8, 0.1],
+                    "k": 12, "num_candidates": 40},
+            "size": 12}
+    rp = pooled.search(dict(body))
+    rd = dist.search(dict(body))
+    assert [round(h.score, 5) for h in rd.hits] == \
+        [round(h.score, 5) for h in rp.hits]
+
+
+def test_rrf_falls_back_to_pooled(searchers):
+    pooled, dist = searchers
+    body = {"query": {"match": {"body": "common"}},
+            "knn": {"field": "vec", "query_vector": [0.5, -0.2, 0.8, 0.1],
+                    "k": 10, "num_candidates": 30},
+            "rank": {"rrf": {"rank_constant": 20, "rank_window_size": 30}},
+            "size": 10}
+    rp = pooled.search(dict(body))
+    rd = dist.search(dict(body))
+    assert [h.doc_id for h in rd.hits] == [h.doc_id for h in rp.hits]
+
+
+def test_through_index_service(tmp_path):
+    """REST-level: a 3-shard index routes through the distributed path and
+    matches a 1-shard index with identical docs."""
+    import json
+    from elasticsearch_tpu.node.indices_service import IndicesService
+    from elasticsearch_tpu.rest.api import RestAPI
+    api = RestAPI(IndicesService(str(tmp_path)))
+
+    def req(method, path, body=None, query=""):
+        raw = json.dumps(body).encode() if body is not None else b""
+        st, _ct, payload = api.handle(method, path, query, raw)
+        return st, json.loads(payload)
+
+    req("PUT", "/multi", {"settings": {"index": {"number_of_shards": 3}},
+                          "mappings": MAPPING})
+    req("PUT", "/single", {"settings": {"index": {"number_of_shards": 1}},
+                           "mappings": MAPPING})
+    rng = np.random.RandomState(1)
+    for d in range(60):
+        doc = {"body": " ".join(rng.choice(WORDS, 4)),
+               "tag": f"t{rng.randint(4)}", "price": float(rng.randint(50))}
+        req("PUT", f"/multi/_doc/{d}", doc)
+        req("PUT", f"/single/_doc/{d}", doc)
+    req("POST", "/multi/_refresh")
+    req("POST", "/single/_refresh")
+    body = {"query": {"bool": {"must": [{"match": {"body": "alpha"}}],
+                               "filter": [{"range": {"price": {"lt": 40}}}]}},
+            "aggs": {"tags": {"terms": {"field": "tag"}}}, "size": 30}
+    st, rm = req("POST", "/multi/_search", body)
+    st, rs = req("POST", "/single/_search", body)
+    assert rm["hits"]["total"] == rs["hits"]["total"]
+    assert rm["aggregations"] == rs["aggregations"]
+    assert sorted((h["_id"], round(h["_score"], 5))
+                  for h in rm["hits"]["hits"]) == \
+        sorted((h["_id"], round(h["_score"], 5))
+               for h in rs["hits"]["hits"])

@@ -215,3 +215,20 @@ def test_gcp_sync_through_channel(group):
     primary.sync_global_checkpoint()
     assert r1.known_global_checkpoint == primary.global_checkpoint
     assert r2.known_global_checkpoint == primary.global_checkpoint
+
+
+def test_deposed_primary_cannot_ack_writes(group):
+    """A zombie primary whose replica was promoted must FAIL writes (never
+    ack), not demote the promoted copy (ReplicationOperation's
+    primary-term check fails the primary, not the replica)."""
+    primary, r1, r2, _ = group
+    primary.index("d0", {"body": "x", "n": 0})
+    promote_to_primary(r1, primary.engine.primary_term + 1)
+    with pytest.raises(ReplicaFencedError):
+        primary.index("zombie-write", {"body": "stale", "n": -1})
+    assert primary.deposed
+    # permanently read-only: subsequent writes fail fast
+    with pytest.raises(ReplicaFencedError):
+        primary.index("zombie-2", {"body": "stale", "n": -2})
+    # the promoted copy never saw the zombie writes
+    assert "zombie-write" not in search_ids(r1.engine)
