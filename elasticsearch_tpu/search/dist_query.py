@@ -66,6 +66,33 @@ class DfsShardContext(ShardContext):
         return self._global.field_avgdl(field)
 
 
+class FixedStatsContext(ShardContext):
+    """Shard context with externally-supplied term statistics (the
+    cluster-level DFS phase: node-local stats are NOT comparable across
+    nodes, so the search coordinator collects cluster-wide df/avgdl/doc
+    counts first and pins them here). Terms absent from the table fall
+    back to local stats — best effort for expansions (wildcards etc.) the
+    stats round could not anticipate."""
+
+    def __init__(self, segments, mapper, stats: dict):
+        super().__init__(segments, mapper)
+        self._stats = stats
+        self.total_docs = int(stats.get("total_docs", self.total_docs))
+
+    def term_df(self, field: str, term: str) -> int:
+        df = self._stats.get("terms", {}).get(field, {}).get(term)
+        if df is not None:
+            return int(df)
+        return super().term_df(field, term)
+
+    def field_avgdl(self, field: str) -> float:
+        fs = self._stats.get("fields", {}).get(field)
+        if fs:
+            sum_dl, doc_count = fs
+            return sum_dl / doc_count if doc_count else 1.0
+        return super().field_avgdl(field)
+
+
 class _Desc:
     """Inverts comparisons for descending non-numeric sort keys."""
 
@@ -116,7 +143,12 @@ class DistributedSearcher:
 
     # ------------------------------------------------------------------
 
-    def search(self, body: Optional[dict] = None) -> ShardSearchResult:
+    def search(self, body: Optional[dict] = None, *,
+               collect_agg_inputs: bool = False) -> ShardSearchResult:
+        """``collect_agg_inputs``: skip the global agg reduce and attach
+        ``result.agg_inputs_by_shard`` — [(shard_searcher, agg_inputs)] —
+        so an outer coordinator (the cluster tier) can reduce ONCE across
+        nodes without re-executing the query phase."""
         body = body or {}
         if body.get("rank") and "rrf" in body["rank"]:
             # global-rank fusion: run pooled (see module docstring)
@@ -209,7 +241,12 @@ class DistributedSearcher:
 
         # -- one global aggregation reduce ----------------------------------
         agg_results = None
-        if aggs_spec:
+        agg_inputs_by_shard = None
+        if aggs_spec and collect_agg_inputs:
+            agg_inputs_by_shard = [(shard, r.agg_inputs or [])
+                                   for shard, r in zip(self.shards,
+                                                       per_shard)]
+        elif aggs_spec:
             aggs = parse_aggs(aggs_spec)
             triples = []
             for shard, r in zip(self.shards, per_shard):
@@ -224,9 +261,12 @@ class DistributedSearcher:
                     triples.append((ctx, seg, mask))
             agg_results = run_aggregations_multi(aggs, triples)
 
-        return ShardSearchResult(total=total, total_relation=total_relation,
-                                 hits=hits, max_score=max_score,
-                                 aggregations=agg_results)
+        result = ShardSearchResult(total=total,
+                                   total_relation=total_relation,
+                                   hits=hits, max_score=max_score,
+                                   aggregations=agg_results)
+        result.agg_inputs_by_shard = agg_inputs_by_shard
+        return result
 
     def count(self, body: Optional[dict] = None) -> int:
         return sum(s.count(body) for s in self.shards)

@@ -1,0 +1,4 @@
+from .tcp import AsyncTaskQueue, NodeLoop, RemoteTransportError, TcpTransport
+
+__all__ = ["AsyncTaskQueue", "NodeLoop", "RemoteTransportError",
+           "TcpTransport"]

@@ -1,0 +1,340 @@
+"""Node-to-node TCP transport: length-prefixed frames, request/response
+correlation, timeouts.
+
+Re-design of the reference's transport layer
+(``transport/TransportService.java:71`` action registry + response
+handlers; ``transport/TcpTransport.java:97`` length-prefixed binary frames
+over pooled connections). Differences by design:
+
+- frames are ``4-byte big-endian length + JSON`` (the wire format is an
+  implementation detail behind the same send/register interface the
+  deterministic sim exposes — ``cluster/sim.py`` — so the Coordinator and
+  replication channels run unchanged over either);
+- one connection per peer direction, dialed lazily and redialed on
+  failure (the reference pools several per profile);
+- the event loop doubles as the task scheduler (:class:`AsyncTaskQueue`
+  mirrors the sim's virtual-clock queue API against real time).
+
+Thread model: everything runs on one asyncio loop thread per node —
+handlers execute on it, like the reference's transport worker pool but
+single-threaded (the GIL-friendly choice; heavy work belongs on the
+engine/search layers, not the transport thread).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 1 << 26          # 64 MiB: a full cluster state / recovery chunk
+
+
+class AsyncTaskQueue:
+    """The sim's DeterministicTaskQueue API over a real asyncio loop."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, seed: int = 0):
+        self.loop = loop
+        self.rng = random.Random(seed)
+
+    @property
+    def now(self) -> float:
+        return self.loop.time()
+
+    def schedule(self, delay: float, fn: Callable[[], None]):
+        handle = self.loop.call_later(max(delay, 0.0), fn)
+
+        class _Cancellable:
+            cancelled = False
+
+            def cancel(self_inner):
+                self_inner.cancelled = True
+                handle.cancel()
+
+        return _Cancellable()
+
+
+class TcpTransport:
+    """One node's transport endpoint. ``send`` and handlers run on the
+    node's loop thread; public ``send`` may be called from any thread."""
+
+    def __init__(self, node_id: str, host: str, port: int,
+                 peers: Dict[str, Tuple[str, int]],
+                 loop: asyncio.AbstractEventLoop):
+        self.node_id = node_id
+        self.host = host
+        self.port = port
+        self.peers = dict(peers)              # node_id -> (host, port)
+        self.loop = loop
+        self._handlers: Dict[str, Callable] = {}
+        self._conns: Dict[str, Tuple[asyncio.StreamReader,
+                                     asyncio.StreamWriter]] = {}
+        self._dialing: Dict[str, asyncio.Lock] = {}
+        self._pending: Dict[int, Tuple[Callable, Callable]] = {}
+        self._req_id = 0
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.closed = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve, self.host, self.port)
+
+    async def stop(self) -> None:
+        self.closed = True
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for _, w in self._conns.values():
+            w.close()
+        self._conns.clear()
+
+    # -- registry (TransportService.registerRequestHandler) ------------------
+
+    def register(self, node_id: str, action: str, handler: Callable) -> None:
+        # node_id accepted for sim-interface parity; always the local node
+        self._handlers[action] = handler
+
+    # -- client side ---------------------------------------------------------
+
+    def send(self, src: str, dst: str, action: str, payload: Any,
+             on_response: Optional[Callable[[Any], None]] = None,
+             on_failure: Optional[Callable[[Exception], None]] = None,
+             timeout: float = 1.0) -> None:
+        self.loop.call_soon_threadsafe(
+            lambda: self.loop.create_task(self._send(
+                dst, action, payload, on_response, on_failure, timeout)))
+
+    async def _send(self, dst: str, action: str, payload, on_response,
+                    on_failure, timeout: float) -> None:
+        state = {"done": False}
+
+        def finish_ok(resp):
+            if not state["done"]:
+                state["done"] = True
+                if on_response:
+                    on_response(resp)
+
+        def finish_err(e):
+            if not state["done"]:
+                state["done"] = True
+                if on_failure:
+                    on_failure(e)
+
+        if dst == self.node_id:
+            # loopback: dispatch directly (the reference's local optimization)
+            try:
+                resp = self._handlers[action](self.node_id, payload)
+                if hasattr(resp, "result") and hasattr(resp, "add_done_callback"):
+                    resp = await asyncio.wrap_future(resp)
+                finish_ok(resp)
+            except Exception as e:      # noqa: BLE001
+                finish_err(e)
+            return
+
+        self._req_id += 1
+        req_id = self._req_id
+        self._pending[req_id] = (finish_ok, finish_err, dst)
+
+        def on_timeout():
+            self._pending.pop(req_id, None)
+            finish_err(TimeoutError(
+                f"[{action}] {self.node_id}->{dst} timed out"))
+
+        timer = self.loop.call_later(timeout, on_timeout)
+        try:
+            writer = await self._connect(dst)
+            frame = json.dumps({
+                "t": "req", "id": req_id, "action": action,
+                "src": self.node_id, "payload": payload,
+            }).encode()
+            writer.write(_LEN.pack(len(frame)) + frame)
+            await writer.drain()
+        except Exception as e:          # noqa: BLE001 — dial/write failure
+            timer.cancel()
+            self._pending.pop(req_id, None)
+            self._conns.pop(dst, None)
+            finish_err(e)
+
+    async def _connect(self, dst: str) -> asyncio.StreamWriter:
+        conn = self._conns.get(dst)
+        if conn is not None and not conn[1].is_closing():
+            return conn[1]
+        lock = self._dialing.setdefault(dst, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(dst)
+            if conn is not None and not conn[1].is_closing():
+                return conn[1]
+            host, port = self.peers[dst]
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port), timeout=1.0)
+            self._conns[dst] = (reader, writer)
+            self.loop.create_task(self._read_responses(dst, reader))
+            return writer
+
+    async def _read_responses(self, dst: str, reader: asyncio.StreamReader):
+        try:
+            while True:
+                msg = await self._read_frame(reader)
+                if msg is None:
+                    break
+                if msg.get("t") != "resp":
+                    continue
+                handlers = self._pending.pop(msg["id"], None)
+                if handlers is None:
+                    continue                   # response after timeout
+                ok, err, _dst = handlers
+                if "error" in msg:
+                    e = msg["error"]
+                    if isinstance(e, dict):
+                        err(RemoteTransportError(e.get("reason", ""),
+                                                 e.get("type")))
+                    else:                      # legacy string form
+                        err(RemoteTransportError(str(e)))
+                else:
+                    ok(msg.get("payload"))
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            self._conns.pop(dst, None)
+            # fail in-flight requests to the dropped peer NOW instead of
+            # stalling their callers until the RPC timeout fires
+            stale = [rid for rid, (_, _, d) in self._pending.items()
+                     if d == dst]
+            for rid in stale:
+                _, err, _ = self._pending.pop(rid)
+                err(ConnectionError(f"connection to [{dst}] closed"))
+
+    # -- server side ---------------------------------------------------------
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        # one task per request: a slow data handler (offloaded to the
+        # node's worker thread) must not head-of-line-block heartbeats
+        # and publications sharing the connection
+        write_lock = asyncio.Lock()
+        try:
+            while True:
+                msg = await self._read_frame(reader)
+                if msg is None:
+                    break
+                if msg.get("t") != "req":
+                    continue
+                self.loop.create_task(
+                    self._handle_request(msg, writer, write_lock))
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except RuntimeError:        # loop already stopped at teardown
+                pass
+
+    async def _handle_request(self, msg: dict, writer: asyncio.StreamWriter,
+                              write_lock: asyncio.Lock) -> None:
+        handler = self._handlers.get(msg["action"])
+        out: Dict[str, Any] = {"t": "resp", "id": msg["id"]}
+        if handler is None:
+            out["error"] = f"no handler for [{msg['action']}]"
+        else:
+            try:
+                resp = handler(msg.get("src"), msg.get("payload"))
+                if hasattr(resp, "result") and \
+                        hasattr(resp, "add_done_callback"):
+                    # a handler offloaded to a worker thread returned a
+                    # concurrent Future — await without blocking the loop
+                    resp = await asyncio.wrap_future(resp)
+                out["payload"] = resp
+            except Exception as e:      # noqa: BLE001
+                # ship the exception TYPE so callers can re-raise
+                # semantically (a fencing rejection must not look like a
+                # generic replica failure)
+                out["error"] = {"type": type(e).__name__, "reason": str(e)}
+        frame = json.dumps(out).encode()
+        try:
+            async with write_lock:
+                writer.write(_LEN.pack(len(frame)) + frame)
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+
+    @staticmethod
+    async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+        try:
+            head = await reader.readexactly(_LEN.size)
+        except asyncio.IncompleteReadError:
+            return None
+        (length,) = _LEN.unpack(head)
+        if length > MAX_FRAME:
+            raise ConnectionError(f"frame of {length} bytes exceeds limit")
+        body = await reader.readexactly(length)
+        return json.loads(body)
+
+
+class RemoteTransportError(Exception):
+    """The remote handler raised; ``remote_type`` carries the remote
+    exception class name so callers can map it back to semantics (the
+    reference wraps remote exceptions the same way)."""
+
+    def __init__(self, reason: str, remote_type: Optional[str] = None):
+        super().__init__(f"[{remote_type}] {reason}" if remote_type
+                         else reason)
+        self.remote_type = remote_type
+
+
+class NodeLoop:
+    """Owns one node's asyncio loop on a daemon thread (the reference's
+    transport worker + generic threadpool, collapsed to one)."""
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._started = threading.Event()
+        self._thread.start()
+        self._started.wait()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self._started.set()
+        self.loop.run_forever()
+
+    def call(self, coro, timeout: float = 5.0):
+        """Run a coroutine on the loop from the outside, synchronously."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def sync(self, fn, timeout: float = 5.0):
+        """Run a plain callable on the loop thread, synchronously."""
+        done = threading.Event()
+        box = {}
+
+        def run():
+            try:
+                box["v"] = fn()
+            except Exception as e:      # noqa: BLE001
+                box["e"] = e
+            finally:
+                done.set()
+
+        self.loop.call_soon_threadsafe(run)
+        if not done.wait(timeout):
+            raise TimeoutError("loop call timed out")
+        if "e" in box:
+            raise box["e"]
+        return box.get("v")
+
+    def stop(self):
+        def drain():
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+            self.loop.stop()
+        try:
+            self.loop.call_soon_threadsafe(drain)
+        except RuntimeError:
+            pass
+        self._thread.join(timeout=2)

@@ -1,0 +1,206 @@
+"""Multi-node cluster over real TCP: election, routed CRUD, replicated
+writes, scatter-gather search, node-death failover. Each node runs its own
+event loop + data worker thread and talks over localhost sockets — the
+process-level integration the sim tier (test_coordination.py) abstracts."""
+
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node.cluster_node import ClusterNode
+
+BASE_PORT = 29310
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    peers = {f"n{i}": ("127.0.0.1", BASE_PORT + i) for i in range(3)}
+    nodes = [ClusterNode(f"n{i}", "127.0.0.1", BASE_PORT + i, peers,
+                         str(tmp_path / f"n{i}"), seed=i)
+             for i in range(3)]
+    try:
+        yield nodes
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+
+def wait_leader(nodes, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [n for n in nodes
+                   if not n.stopped and n.coordinator.mode == "LEADER"]
+        if len(leaders) == 1:
+            followers = [n for n in nodes if not n.stopped and
+                         n.coordinator.known_leader ==
+                         leaders[0].node_id]
+            if len(followers) * 2 > len(nodes):
+                return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError("no stable leader over TCP")
+
+
+def wait_for(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def test_cluster_lifecycle_and_replicated_crud(cluster):
+    nodes = cluster
+    leader = wait_leader(nodes)
+    any_node = nodes[(nodes.index(leader) + 1) % 3]   # a non-master client
+
+    any_node.create_index("events", num_shards=2, num_replicas=1,
+                          mappings={"properties": {
+                              "msg": {"type": "text"},
+                              "kind": {"type": "keyword"},
+                              "n": {"type": "integer"}}})
+    # the routing covers both shards with distinct primaries + replicas
+    st = any_node.applied_state
+    table = st.data["routing"]["events"]
+    assert set(table) == {"0", "1"}
+    for entry in table.values():
+        assert entry["replicas"] and \
+            entry["replicas"][0] != entry["primary"]
+
+    # wait for replica recovery channels to attach
+    def replicas_in_sync():
+        for n in nodes:
+            for key, g in n.primaries.items():
+                if key[0] == "events" and not g.replicas:
+                    return False
+        return any(key[0] == "events"
+                   for n in nodes for key in n.primaries)
+    wait_for(replicas_in_sync, msg="replica channels")
+
+    rng = np.random.RandomState(0)
+    docs = {}
+    for i in range(40):
+        src = {
+            "msg": f"event number {i} " + ("alpha" if i % 2 else "beta"),
+            "kind": f"k{i % 4}", "n": i}
+        docs[f"d{i}"] = src
+        r = any_node.index_doc("events", f"d{i}", src)
+        assert r["result"] == "created" and r["failed_copies"] == [], r
+    # read-your-writes through any node
+    g = nodes[0].get_doc("events", "d7")
+    assert g["found"] and g["_source"]["n"] == 7
+    d = nodes[2].delete_doc("events", "d7")
+    assert d["found"]
+    assert not nodes[1].get_doc("events", "d7")["found"]
+
+    any_node.refresh("events")
+    res = nodes[0].search("events", {
+        "query": {"match": {"msg": "alpha"}},
+        "aggs": {"kinds": {"terms": {"field": "kind"}}},
+        "size": 30})
+    assert res["total"] == 19                      # d7 deleted
+    kinds = {b["key"]: b["doc_count"]
+             for b in res["aggregations"]["kinds"]["buckets"]}
+    assert sum(kinds.values()) == 19
+    # every node coordinates identically
+    res2 = nodes[1].search("events", {
+        "query": {"match": {"msg": "alpha"}},
+        "aggs": {"kinds": {"terms": {"field": "kind"}}}, "size": 30})
+    assert res2["total"] == res["total"]
+    assert {b["key"]: b["doc_count"]
+            for b in res2["aggregations"]["kinds"]["buckets"]} == kinds
+
+    # cross-node score comparability: the cluster-wide DFS stats must make
+    # scores identical to a pooled single-searcher over the same docs
+    from elasticsearch_tpu.index.mapping import MapperService
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.search.shard_search import ShardSearcher
+    mapper = MapperService({"properties": {
+        "msg": {"type": "text"}, "kind": {"type": "keyword"},
+        "n": {"type": "integer"}}})
+    b = SegmentBuilder("_ref")
+    for i, (did, src) in enumerate(sorted(docs.items())):
+        local = b.add(mapper.parse_document(did, src), seq_no=i)
+        if did == "d7":
+            # delete via liveness, as the engine does — deleted docs still
+            # count in idf stats (Lucene docCount semantics)
+            b.deleted.add(local)
+    ref = ShardSearcher([b.build()], mapper)
+    rr = ref.search({"query": {"match": {"msg": "alpha"}}, "size": 30})
+    ref_scores = {h.doc_id: round(h.score, 4) for h in rr.hits}
+    got_scores = {h["id"]: round(h["score"], 4) for h in res["hits"]}
+    assert got_scores == ref_scores
+
+    # cross-node search_after pagination: no dup/loss across 2 shards on
+    # different nodes (node-ordinal cursor space)
+    seen = []
+    after = None
+    while True:
+        body = {"query": {"match": {"msg": "event"}}, "size": 7}
+        if after is not None:
+            body["search_after"] = after
+        r = nodes[2].search("events", body)
+        if not r["hits"]:
+            break
+        seen.extend(h["id"] for h in r["hits"])
+        after = r["hits"][-1]["sort"]
+    assert len(seen) == len(set(seen)) == 39, \
+        (len(seen), len(set(seen)))
+
+
+def test_node_death_promotes_replicas_no_acked_loss(cluster):
+    nodes = cluster
+    leader = wait_leader(nodes)
+    client = next(n for n in nodes if n is not leader)
+    client.create_index("ledger", num_shards=2, num_replicas=1,
+                        mappings={"properties": {
+                            "v": {"type": "integer"}}})
+
+    def replicas_attached():
+        return all(g.replicas for n in nodes
+                   for key, g in n.primaries.items() if key[0] == "ledger")
+    wait_for(replicas_attached, msg="replica channels")
+
+    acked = []
+    for i in range(30):
+        r = client.index_doc("ledger", f"a{i}", {"v": i})
+        if not r["failed_copies"]:
+            acked.append(f"a{i}")
+    assert len(acked) == 30
+
+    # kill a DATA node that primaries at least one shard (never the
+    # client; the master may die too — both paths must work)
+    table = client.applied_state.data["routing"]["ledger"]
+    primary_nodes = {e["primary"] for e in table.values()}
+    victim_id = sorted(primary_nodes - {client.node_id})[0] \
+        if primary_nodes - {client.node_id} else None
+    if victim_id is None:
+        pytest.skip("routing placed every primary on the client node")
+    victim = next(n for n in nodes if n.node_id == victim_id)
+    victim.stop()
+
+    # the (possibly re-elected) master promotes in-sync replicas
+    def failed_over():
+        st = client.applied_state
+        t = st.data["routing"]["ledger"]
+        return all(e["primary"] != victim_id for e in t.values())
+    wait_for(failed_over, timeout=15.0, msg="failover routing update")
+
+    live = [n for n in nodes if not n.stopped]
+    wait_leader(live)
+    # ZERO acknowledged-op loss: every acked doc is readable post-failover
+    time.sleep(0.5)      # let promotions apply
+    for doc in acked:
+        g = client.get_doc("ledger", doc)
+        assert g["found"], f"acked doc {doc} lost in failover"
+    # and the cluster still accepts writes on every shard
+    for i in range(30, 40):
+        r = client.index_doc("ledger", f"a{i}", {"v": i})
+        assert r["result"] == "created"
+    client.refresh("ledger")
+    res = client.search("ledger", {"query": {"match_all": {}}, "size": 100})
+    assert res["total"] == 40
