@@ -240,6 +240,33 @@ class GeoPointFieldType(MappedFieldType):
         return (lat, lon)
 
 
+class CompletionFieldType(MappedFieldType):
+    """Auto-complete inputs (reference:
+    ``search/suggest/completion/CompletionFieldMapper.java``). Inputs are
+    stored as keyword terms on the field itself and the per-doc suggestion
+    weight as a hidden ``<field>._weight`` numeric column — the FST the
+    reference builds is replaced by prefix scans of the keyword ordinal
+    table (``search/suggest.py``). Weight is per document (the reference
+    allows per-input weights; documented simplification)."""
+
+    type_name = "completion"
+
+    def parse_value(self, value):
+        # "text" | ["a", "b"] | {"input": [...], "weight": n}
+        if isinstance(value, str):
+            return [value.lower()], 1
+        if isinstance(value, list):
+            return [str(v).lower() for v in value], 1
+        if isinstance(value, dict):
+            inputs = value.get("input", [])
+            if isinstance(inputs, str):
+                inputs = [inputs]
+            return ([str(v).lower() for v in inputs],
+                    int(value.get("weight", 1)))
+        raise MapperParsingError(
+            f"failed to parse completion input [{value}]")
+
+
 class ObjectFieldType(MappedFieldType):
     type_name = "object"
     is_searchable = False
@@ -371,6 +398,8 @@ class MapperService:
                                         spec.get("similarity", "cosine"), params)
         if ftype == "geo_point":
             return GeoPointFieldType(name, params)
+        if ftype == "completion":
+            return CompletionFieldType(name, params)
         raise MapperParsingError(f"No handler for type [{ftype}] declared on field [{name}]")
 
     def _rebuild_mapping_def(self) -> None:
@@ -519,6 +548,11 @@ class MapperService:
             v = ft.parse_value(value)
             if v is not None:
                 parsed.keyword_terms.setdefault(full, []).append(v)
+        elif isinstance(ft, CompletionFieldType):
+            inputs, weight = ft.parse_value(value)
+            parsed.keyword_terms.setdefault(full, []).extend(inputs)
+            parsed.numeric_values.setdefault(f"{full}._weight",
+                                             []).append(float(weight))
         elif isinstance(ft, DenseVectorFieldType):
             parsed.vectors[full] = ft.parse_value(value)
         elif isinstance(ft, GeoPointFieldType):

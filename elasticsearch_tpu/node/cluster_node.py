@@ -617,6 +617,12 @@ class ClusterNode:
                     key = (-sc, ni, sd)
                 merged.append((key, ni, h))
         merged.sort(key=lambda t: t[0])
+        collapse_field = (body.get("collapse") or {}).get("field")
+        if collapse_field:
+            from ..search.dist_query import collapse_first_by_key
+            merged = collapse_first_by_key(
+                merged, lambda t: (t[2].get("fields") or {}).get(
+                    collapse_field, [None])[0])
         hits = []
         for _, ni, h in merged[from_: from_ + size]:
             if h.get("sort"):
@@ -648,6 +654,17 @@ class ClusterNode:
         out = {"total": total, "hits": hits}
         if aggs_out is not None:
             out["aggregations"] = aggs_out
+        # suggest merges across nodes (options dedupe/re-rank; per-node
+        # freq/df are node-local — documented approximation); profile
+        # concatenates shard entries
+        suggests = [r["suggest"] for r in results if r.get("suggest")]
+        if suggests:
+            from ..rest.api import _merge_suggest
+            out["suggest"] = _merge_suggest(suggests)
+        profiles = [r["profile"] for r in results if r.get("profile")]
+        if profiles:
+            out["profile"] = {"shards": [sh for p in profiles
+                                         for sh in p["shards"]]}
         return out
 
     def _node_local_cursor(self, sa, node_ord: int, use_field_sort: bool,
@@ -826,8 +843,12 @@ class ClusterNode:
         want_partials = payload.get("want_agg_partials")
         r = dist.search(dict(body), collect_agg_inputs=want_partials)
         hits = [{"id": h.doc_id, "score": h.score, "sort": h.sort_values,
-                 "source": h.source} for h in r.hits]
+                 "source": h.source, "fields": h.fields} for h in r.hits]
         out = {"total": r.total, "hits": hits}
+        if r.suggest is not None:
+            out["suggest"] = r.suggest
+        if r.profile is not None:
+            out["profile"] = r.profile
         aggs_spec = body.get("aggs") or body.get("aggregations")
         if want_partials and aggs_spec:
             from ..search.aggregations import (AggregationContext,

@@ -1099,6 +1099,12 @@ class RestAPI:
                     h.sort_values = [
                         h.sort_values[0],
                         (ord_of[n] << shift) | int(h.sort_values[1])]
+        collapse_field = (search_body.get("collapse") or {}).get("field")
+        if collapse_field:
+            from ..search.dist_query import collapse_first_by_key
+            all_hits = collapse_first_by_key(
+                all_hits, lambda nh: (nh[1].fields or {}).get(
+                    collapse_field, [None])[0])
         page = all_hits[from_: from_ + size]
         aggregations = None
         if len(names) == 1:
@@ -1121,6 +1127,15 @@ class RestAPI:
         }
         if aggregations is not None:
             out["aggregations"] = aggregations
+        # cross-index suggest: merge options per (suggester, token entry) —
+        # dedupe by text keeping the best score, re-rank score-descending
+        suggests = [r.suggest for _, r in results if r.suggest]
+        if suggests:
+            out["suggest"] = _merge_suggest(suggests)
+        profiles = [r.profile for _, r in results if r.profile]
+        if profiles:
+            out["profile"] = {"shards": [sh for p in profiles
+                                         for sh in p["shards"]]}
         return out
 
     def _reduce_cross_index_aggs(self, names: List[str],
@@ -1442,6 +1457,41 @@ def _sort_is_score(sort_spec) -> bool:
     first = sort_spec[0] if sort_spec else "_score"
     return first == "_score" or (isinstance(first, dict) and
                                  "_score" in first)
+
+
+def _merge_suggest(suggests: List[Dict[str, list]]) -> Dict[str, list]:
+    """Merge suggest sections from several shards/indices/nodes: per
+    suggester, per token entry (matched by offset), options dedupe by text
+    keeping the best score and re-rank (score desc, freq desc)."""
+    merged: Dict[str, list] = {}
+    for s in suggests:
+        for sname, entries in s.items():
+            if sname not in merged:
+                merged[sname] = [dict(e, options=list(e["options"]))
+                                 for e in entries]
+                continue
+            by_offset = {e["offset"]: e for e in merged[sname]}
+            for e in entries:
+                tgt = by_offset.get(e["offset"])
+                if tgt is None:
+                    merged[sname].append(dict(e,
+                                              options=list(e["options"])))
+                else:
+                    tgt["options"] = tgt["options"] + list(e["options"])
+    for entries in merged.values():
+        for e in entries:
+            best: Dict[str, dict] = {}
+            for o in e["options"]:
+                cur = best.get(o["text"])
+                score = o.get("score", o.get("_score", 0.0))
+                if cur is None or score > cur.get("score",
+                                                  cur.get("_score", 0.0)):
+                    best[o["text"]] = o
+            e["options"] = sorted(
+                best.values(),
+                key=lambda o: (-o.get("score", o.get("_score", 0.0)),
+                               -o.get("freq", 0), o["text"]))
+    return merged
 
 
 def _sort_key_tuple(h: ShardHit):

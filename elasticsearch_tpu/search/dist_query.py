@@ -44,10 +44,66 @@ from .aggregations import (AggregationContext, parse_aggs,
                            run_aggregations_multi)
 from .query_dsl import ShardContext
 from .shard_search import (ShardHit, ShardSearcher, ShardSearchResult,
-                           _tree_needs_scores)
+                           _tree_needs_scores, collapse_first_by_key)
 
 #: bits reserved for the (segment, doc) part of the global shard-doc key
 _LOCAL_BITS = 48
+
+
+def _required_ranges(query_spec) -> List[tuple]:
+    """Extract (field, lo, hi) bounds every match MUST satisfy: top-level
+    ``range`` clauses plus those inside ``bool.must``/``bool.filter``
+    (recursively). should/must_not never make a clause required."""
+    out: List[tuple] = []
+    if not isinstance(query_spec, dict):
+        return out
+    if "range" in query_spec:
+        for field, cond in query_spec["range"].items():
+            if not isinstance(cond, dict):
+                continue
+            lo = cond.get("gte", cond.get("gt"))
+            hi = cond.get("lte", cond.get("lt"))
+            if isinstance(lo, str) or isinstance(hi, str):
+                continue                      # dates/strings: not analyzed
+            out.append((field,
+                        float(lo) if lo is not None else float("-inf"),
+                        float(hi) if hi is not None else float("inf")))
+    b = query_spec.get("bool")
+    if isinstance(b, dict):
+        for section in ("must", "filter"):
+            clauses = b.get(section) or []
+            if isinstance(clauses, dict):
+                clauses = [clauses]
+            for c in clauses:
+                out.extend(_required_ranges(c))
+    return out
+
+
+def _shard_can_match(shard: "ShardSearcher", bounds: List[tuple]) -> bool:
+    """False iff some required range is disjoint from the shard's
+    [min, max] for that field across every segment."""
+    for field, lo, hi in bounds:
+        fmin, fmax = float("inf"), float("-inf")
+        present = False
+        for seg in shard.segments:
+            nf = seg.numeric_fields.get(field)
+            if nf is None or nf.vals_host.size == 0:
+                continue
+            cache = getattr(seg, "_minmax_cache", None)
+            if cache is None:
+                cache = seg._minmax_cache = {}
+            mm = cache.get(field)
+            if mm is None:
+                mm = cache[field] = (float(nf.vals_host.min()),
+                                     float(nf.vals_host.max()))
+            present = True
+            fmin = min(fmin, mm[0])
+            fmax = max(fmax, mm[1])
+        if not present:
+            return False                      # no values: cannot match
+        if fmax < lo or fmin > hi:
+            return False
+    return True
 
 
 class DfsShardContext(ShardContext):
@@ -175,6 +231,9 @@ class DistributedSearcher:
         shard_body["from"] = 0
         shard_body.pop("aggs", None)
         shard_body.pop("aggregations", None)
+        # suggesters run ONCE against the cross-shard term dictionaries
+        # (per-shard suggestion option sets would diverge and not merge)
+        suggest_spec = shard_body.pop("suggest", None)
         if aggs_spec:
             shard_body["aggs"] = aggs_spec          # parsed, inputs only
         if isinstance(track_total_hits, int) and not isinstance(
@@ -187,8 +246,23 @@ class DistributedSearcher:
         # -- knn DFS phase: per-shard candidates → global top-k -------------
         knn_overrides = self._global_knn(body.get("knn"))
 
+        # can_match pre-filter (CanMatchPreFilterSearchPhase.java:58): skip
+        # shards whose numeric ranges cannot satisfy a required range
+        # clause. Suppressed when aggregations are present (a global agg
+        # must still see every shard) or knn runs (vector hits ignore the
+        # query ranges).
+        can_skip = not aggs_spec and not knn_overrides
+        bounds = _required_ranges(body.get("query")) if can_skip else []
+        self.last_skipped = 0
+
         per_shard: List[ShardSearchResult] = []
+        empty = ShardSearchResult(total=0, total_relation="eq", hits=[],
+                                  max_score=None)
         for shard_idx, shard in enumerate(self.shards):
+            if bounds and not _shard_can_match(shard, bounds):
+                self.last_skipped += 1
+                per_shard.append(empty)
+                continue
             sb = shard_body
             if search_after is not None:
                 local_after = self._local_cursor_any(
@@ -220,6 +294,12 @@ class DistributedSearcher:
                                                shard_idx, h),
                                shard_idx, h))
         merged.sort(key=lambda t: t[0])
+        collapse_field = (body.get("collapse") or {}).get("field")
+        if collapse_field:
+            # shards collapsed locally; dedupe groups ACROSS shards too
+            merged = collapse_first_by_key(
+                merged, lambda t: (t[2].fields or {}).get(
+                    collapse_field, [None])[0])
         page = merged[from_: from_ + size]
         hits: List[ShardHit] = []
         max_score = None
@@ -261,10 +341,23 @@ class DistributedSearcher:
                     triples.append((ctx, seg, mask))
             agg_results = run_aggregations_multi(aggs, triples)
 
+        suggest_out = None
+        if suggest_spec:
+            from .suggest import run_suggest
+            suggest_out = run_suggest(self._global_ctx, suggest_spec)
+        profile_out = None
+        if body.get("profile"):
+            shards_prof = [sh for r in per_shard if r.profile
+                           for sh in r.profile["shards"]]
+            if shards_prof:
+                profile_out = {"shards": shards_prof}
+
         result = ShardSearchResult(total=total,
                                    total_relation=total_relation,
                                    hits=hits, max_score=max_score,
-                                   aggregations=agg_results)
+                                   aggregations=agg_results,
+                                   profile=profile_out,
+                                   suggest=suggest_out)
         result.agg_inputs_by_shard = agg_inputs_by_shard
         return result
 

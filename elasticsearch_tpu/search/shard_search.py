@@ -14,6 +14,8 @@ rank fusion under ``rank.rrf``)."""
 
 from __future__ import annotations
 
+import json
+import time as _time
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -65,6 +67,7 @@ class ShardSearchResult:
     max_score: Optional[float]
     aggregations: Optional[Dict[str, Any]] = None
     profile: Optional[dict] = None
+    suggest: Optional[Dict[str, list]] = None
     #: (segment, host mask, host scores | None) per segment — returned
     #: instead of reduced aggregations when the caller (the distributed
     #: coordinator) wants ONE global reduce across shards
@@ -217,6 +220,11 @@ class ShardSearcher:
         sort_spec = body.get("sort")
         search_after = body.get("search_after")
         rank_spec = body.get("rank")
+        rescore_spec = body.get("rescore")
+        collapse_spec = body.get("collapse")
+        profile_on = bool(body.get("profile"))
+        suggest_spec = body.get("suggest")
+        t_query0 = _time.perf_counter() if profile_on else 0.0
 
         use_field_sort = bool(sort_spec) and self._normalize_sort(
             sort_spec)[0]["field"] != "_score"
@@ -228,6 +236,24 @@ class ShardSearcher:
         if rank_spec and "rrf" in rank_spec:
             window = max(window, int(rank_spec["rrf"].get(
                 "rank_window_size", max(k, 10))))
+        if rescore_spec:
+            if use_field_sort:
+                raise IllegalArgumentError(
+                    "Cannot use [sort] option in conjunction with "
+                    "[rescore].")
+            for rs in (rescore_spec if isinstance(rescore_spec, list)
+                       else [rescore_spec]):
+                mode = (rs.get("query") or {}).get("score_mode", "total")
+                if mode not in ("total", "multiply", "avg", "max", "min"):
+                    # parse-time validation, not data-dependent
+                    raise IllegalArgumentError(
+                        f"[rescore] illegal score_mode [{mode}]")
+                window = max(window, int(rs.get("window_size", 10)))
+        if collapse_spec:
+            # exact collapse needs the full ranking: every group's best hit
+            # must be visible (the reference's grouping collector sees all
+            # matches; here the per-segment top-k window opens fully)
+            window = 1 << 30
 
         # --- query phase (device) -----------------------------------------
         pending = []
@@ -343,10 +369,15 @@ class ShardSearcher:
                               else m for si, m in restricted.items()}
                 total = len(candidates)
 
+        # --- rescore (QueryRescorer.java: reorder the top window only) -----
+        if rescore_spec and candidates:
+            candidates = self._apply_rescore(rescore_spec, candidates)
+
         # --- ranking → page ------------------------------------------------
         if use_field_sort:
             page, sort_clauses = self._field_sorted_page(
-                sort_spec, search_after, host_masks, host_scores, k)
+                sort_spec, search_after, host_masks, host_scores, k,
+                collapse_field=(collapse_spec or {}).get("field"))
             page = page[from_:]
             if track_total_hits is not False and not knn_rankings:
                 total = sum(int(m[: self.segments[si].n_docs].sum())
@@ -371,6 +402,9 @@ class ShardSearcher:
                          > after_sd)]
                 else:
                     candidates = [c for c in candidates if c[0] < after]
+            if collapse_spec:
+                candidates = self._collapse_candidates(
+                    collapse_spec["field"], candidates)
             page = [(float(sc), si, d,
                      [float(sc), self._shard_doc(si, d)])
                     for sc, si, d in candidates[from_: from_ + size]]
@@ -391,6 +425,8 @@ class ShardSearcher:
         if hl_spec:
             query.collect_highlight_terms(self.ctx, hl_terms)
 
+        collapse_keyf = (self._collapse_key_fn(collapse_spec["field"])
+                         if collapse_spec else None)
         hits = []
         for score, seg_idx, d, sort_values in page:
             seg = self.segments[seg_idx]
@@ -401,6 +437,10 @@ class ShardSearcher:
                 sort_values=sort_values, seq_no=int(seg.seq_nos[d]))
             if dv_specs:
                 hit.fields = docvalue_fields(seg, self.mapper, d, dv_specs)
+            if collapse_keyf is not None:
+                kv = collapse_keyf(seg_idx, d)
+                hit.fields = dict(hit.fields or {},
+                                  **{collapse_spec["field"]: [kv]})
             if hl_spec:
                 hit.highlight = highlight(self.mapper, src, hl_spec, hl_terms)
             hits.append(hit)
@@ -421,18 +461,131 @@ class ShardSearcher:
             seg_masks = [(seg, np.asarray(m)) for seg, m, _ in agg_pending]
             agg_results = run_aggregations(aggs, agg_ctx, seg_masks)
 
+        suggest_out = None
+        if suggest_spec:
+            from .suggest import run_suggest
+            suggest_out = run_suggest(self.ctx, suggest_spec)
+
+        profile_out = None
+        if profile_on:
+            # per-request query-phase timing (search/profile/Profilers.java
+            # — segment-level collectors folded into one query node)
+            total_nanos = int((_time.perf_counter() - t_query0) * 1e9)
+            profile_out = {"shards": [{
+                "id": "[tpu][0]",
+                "searches": [{
+                    "query": [{
+                        "type": type(query).__name__,
+                        "description": json.dumps(query_spec or
+                                                  {"match_all": {}}),
+                        "time_in_nanos": total_nanos,
+                        "breakdown": {
+                            "segments": len(self.segments),
+                            "score_mode": ("field_sort" if use_field_sort
+                                           else "score"),
+                        },
+                    }],
+                    "rewrite_time": 0,
+                    "collector": [{
+                        "name": "EagerDenseCollector",
+                        "reason": "search_top_hits",
+                        "time_in_nanos": total_nanos,
+                    }],
+                }],
+                "aggregations": [],
+            }]}
+
         return ShardSearchResult(total=total, total_relation=total_relation,
                                  hits=hits, max_score=max_score,
                                  aggregations=agg_results,
-                                 agg_inputs=agg_inputs)
+                                 agg_inputs=agg_inputs,
+                                 profile=profile_out, suggest=suggest_out)
 
     @staticmethod
     def _shard_doc(seg_idx: int, doc: int) -> int:
         """Stable tiebreak key over (segment, doc) — ES's ``_shard_doc``."""
         return (seg_idx << 32) | doc
 
+    # ------------------------------------------------------------------
+    # rescore + collapse
+    # ------------------------------------------------------------------
+
+    def _apply_rescore(self, rescore_spec, candidates):
+        """Second-pass scoring of the top window
+        (``search/rescore/QueryRescorer.java``): the window reorders by
+        ``query_weight·orig + rescore_query_weight·secondary``; ranks
+        below the window keep their original order."""
+        specs = rescore_spec if isinstance(rescore_spec, list) \
+            else [rescore_spec]
+        for spec in specs:
+            body = spec.get("query") or {}
+            rq_spec = body.get("rescore_query")
+            if rq_spec is None:
+                raise ParsingError("rescore requires [query.rescore_query]")
+            qw = float(body.get("query_weight", 1.0))
+            rw = float(body.get("rescore_query_weight", 1.0))
+            mode = body.get("score_mode", "total")
+            window = min(int(spec.get("window_size", 10)), len(candidates))
+            rq = parse_query(rq_spec)
+            seg_scores: Dict[int, np.ndarray] = {}
+            seg_masks: Dict[int, np.ndarray] = {}
+            needed = {si for _, si, _ in candidates[:window]}
+            for si in needed:
+                sc, m = rq.execute(self.ctx, self.segments[si])
+                seg_scores[si] = np.asarray(sc)
+                seg_masks[si] = np.asarray(m)
+            rescored = []
+            for sc, si, d in candidates[:window]:
+                if seg_masks[si][d]:
+                    rs = float(seg_scores[si][d])
+                    if mode == "total":
+                        ns = qw * sc + rw * rs
+                    elif mode == "multiply":
+                        ns = (qw * sc) * (rw * rs)
+                    elif mode == "avg":
+                        ns = (qw * sc + rw * rs) / 2.0
+                    elif mode == "max":
+                        ns = max(qw * sc, rw * rs)
+                    else:                    # "min" (validated at parse)
+                        ns = min(qw * sc, rw * rs)
+                else:
+                    ns = qw * sc
+                rescored.append((ns, si, d))
+            rescored.sort(key=lambda c: (-c[0], c[1], c[2]))
+            candidates = rescored + candidates[window:]
+        return candidates
+
+    def _collapse_key_fn(self, field: str):
+        """(seg_idx, doc) → group key for the collapse field (first value;
+        None groups together, like the reference's null group)."""
+        ft = self.mapper.field_type(field)
+        if isinstance(ft, KeywordFieldType):
+            tables: Dict[int, Dict[int, str]] = {}
+
+            def key(si, d):
+                t = tables.get(si)
+                if t is None:
+                    t = tables[si] = {}
+                    kf = self.segments[si].keyword_fields.get(field)
+                    if kf is not None:
+                        for doc, o in zip(kf.dv_docs_host[::-1],
+                                          kf.dv_ords_host[::-1]):
+                            t[int(doc)] = kf.ord_terms[int(o)]
+                return t.get(d)
+            return key
+
+        def nkey(si, d):
+            v = self.segments[si].numeric_first_value_column(field)[d]
+            return None if np.isnan(v) else float(v)
+        return nkey
+
+    def _collapse_candidates(self, field: str, candidates):
+        keyf = self._collapse_key_fn(field)
+        return collapse_first_by_key(candidates,
+                                     lambda c: keyf(c[1], c[2]))
+
     def _field_sorted_page(self, sort_spec, search_after, host_masks,
-                           host_scores, k):
+                           host_scores, k, collapse_field=None):
         """Sorted query path: lexsort matched docs on normalized keys
         (reference: ``search/sort/SortBuilder`` → Lucene ``SortField``).
 
@@ -486,7 +639,22 @@ class ShardSearcher:
         idx = np.flatnonzero(keep)
         order = np.lexsort(tuple(keys[ci][idx] for ci in
                                  range(len(clauses) - 1, -1, -1)))
-        top = idx[order[:k]]
+        if collapse_field is not None:
+            keyf = self._collapse_key_fn(collapse_field)
+            seen = set()
+            kept = []
+            for i in idx[order]:
+                si, d = all_rows[i]
+                kv = keyf(si, d)
+                if kv in seen:
+                    continue
+                seen.add(kv)
+                kept.append(i)
+                if len(kept) >= k:
+                    break
+            top = np.asarray(kept, dtype=np.int64)
+        else:
+            top = idx[order[:k]]
         page = []
         for i in top:
             seg_idx, d = all_rows[i]
@@ -541,6 +709,20 @@ class ShardSearcher:
             _, mask = query.execute(self.ctx, seg)
             total += int(jnp.sum(mask & seg.live_dev))
         return total
+
+
+def collapse_first_by_key(items, key_fn):
+    """First-wins group dedupe over an already-ranked list — THE collapse
+    semantics, shared by every merge tier (shard, index, cluster, REST)."""
+    seen = set()
+    out = []
+    for it in items:
+        kv = key_fn(it)
+        if kv in seen:
+            continue
+        seen.add(kv)
+        out.append(it)
+    return out
 
 
 def normalize_sort(sort_spec) -> List[dict]:

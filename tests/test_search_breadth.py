@@ -1,0 +1,216 @@
+"""Suggesters, rescore, collapse, profile, can_match. Reference behaviors:
+``search/suggest/``, ``search/rescore/QueryRescorer.java``,
+``search/collapse/``, ``search/profile/Profilers.java``,
+``action/search/CanMatchPreFilterSearchPhase.java``."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.dist_query import DistributedSearcher
+from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+MAPPING = {"properties": {
+    "body": {"type": "text"},
+    "brand": {"type": "keyword"},
+    "price": {"type": "double"},
+    "sugg": {"type": "completion"},
+}}
+
+DOCS = [
+    ("1", "the quick brown fox jumps", "acme", 10.0, {"input": ["quick fox", "quiet fox"], "weight": 5}),
+    ("2", "a lazy dog sleeps deeply", "acme", 20.0, {"input": "lazy dog", "weight": 9}),
+    ("3", "quick silver surfing fox", "bolt", 30.0, "quick silver"),
+    ("4", "brown bears fish rivers", "bolt", 40.0, "brown bear"),
+    ("5", "the quick brown rabbit", "core", 50.0, {"input": "quick rabbit", "weight": 2}),
+    ("6", "foxes and rabbits run quick", "core", 60.0, "running fast"),
+]
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    mapper = MapperService(MAPPING)
+    b = SegmentBuilder("_0")
+    for i, (did, body, brand, price, sugg) in enumerate(DOCS):
+        b.add(mapper.parse_document(did, {
+            "body": body, "brand": brand, "price": price, "sugg": sugg}),
+            seq_no=i)
+    return ShardSearcher([b.build()], mapper)
+
+
+# -- suggesters --------------------------------------------------------------
+
+
+def test_term_suggester(searcher):
+    r = searcher.search({"size": 0, "suggest": {
+        "fix": {"text": "quik",
+                "term": {"field": "body", "suggest_mode": "missing",
+                         "min_word_length": 3}}}})
+    opts = r.suggest["fix"][0]["options"]
+    assert opts and opts[0]["text"] == "quick"
+    assert opts[0]["freq"] == 4
+    # existing word with suggest_mode=missing → no options
+    r = searcher.search({"size": 0, "suggest": {
+        "fix": {"text": "quick", "term": {"field": "body",
+                                          "min_word_length": 3}}}})
+    assert r.suggest["fix"][0]["options"] == []
+
+
+def test_phrase_suggester(searcher):
+    r = searcher.search({"size": 0, "suggest": {
+        "p": {"text": "quik brown fix",
+              "phrase": {"field": "body", "max_errors": 2,
+                         "direct_generator": [{"min_word_length": 3}],
+                         "highlight": {"pre_tag": "<em>",
+                                       "post_tag": "</em>"}}}}})
+    options = r.suggest["p"][0]["options"]
+    assert options
+    assert options[0]["text"] == "quick brown fox"
+    hl = next((o.get("highlighted") for o in options
+               if o["text"] == "quick brown fox"), None)
+    assert hl and "<em>quick</em>" in hl
+
+
+def test_completion_suggester(searcher):
+    r = searcher.search({"size": 0, "suggest": {
+        "c": {"prefix": "qui", "completion": {"field": "sugg"}}}})
+    opts = r.suggest["c"][0]["options"]
+    texts = [o["text"] for o in opts]
+    assert texts[0] == "quick fox"        # weight 5 beats weight 2 & 1
+    assert "quick rabbit" in texts and "quick silver" in texts
+    assert "quiet fox" in texts
+    # weight ordering holds
+    scores = [o["_score"] for o in opts]
+    assert scores == sorted(scores, reverse=True)
+
+
+# -- rescore -----------------------------------------------------------------
+
+
+def test_rescore_reorders_window(searcher):
+    base = {"query": {"match": {"body": "quick"}}, "size": 4}
+    r0 = searcher.search(dict(base))
+    assert r0.total == 4
+    r = searcher.search(dict(base, rescore={
+        "window_size": 4,
+        "query": {"rescore_query": {"term": {"brand": "core"}},
+                  "query_weight": 0.0, "rescore_query_weight": 10.0}}))
+    # with query_weight 0, 'core' docs outrank everything in the window
+    top_brands = {h.doc_id for h in r.hits[:2]}
+    assert top_brands == {"5", "6"}
+    # rescore + sort is rejected like the reference
+    with pytest.raises(IllegalArgumentError):
+        searcher.search(dict(base, sort=[{"price": "asc"}],
+                             rescore={"query": {"rescore_query":
+                                                {"match_all": {}}}}))
+
+
+def test_rescore_score_modes(searcher):
+    base = {"query": {"match": {"body": "quick"}}, "size": 4}
+    for mode in ("total", "multiply", "avg", "max", "min"):
+        r = searcher.search(dict(base, rescore={
+            "window_size": 4,
+            "query": {"rescore_query": {"term": {"brand": "acme"}},
+                      "score_mode": mode}}))
+        assert len(r.hits) == 4
+
+
+# -- collapse ----------------------------------------------------------------
+
+
+def test_collapse_keyword(searcher):
+    r = searcher.search({"query": {"match_all": {}}, "size": 10,
+                         "sort": [{"price": "desc"}],
+                         "collapse": {"field": "brand"}})
+    assert [h.doc_id for h in r.hits] == ["6", "4", "2"]
+    assert [h.fields["brand"][0] for h in r.hits] == \
+        ["core", "bolt", "acme"]
+    # total counts matches, not groups (reference behavior)
+    assert r.total == 6
+
+
+def test_collapse_score_path(searcher):
+    r = searcher.search({"query": {"match": {"body": "quick"}},
+                         "size": 10, "collapse": {"field": "brand"}})
+    brands = [h.fields["brand"][0] for h in r.hits]
+    assert len(brands) == len(set(brands)) == 3
+
+
+# -- profile -----------------------------------------------------------------
+
+
+def test_profile_shape(searcher):
+    r = searcher.search({"query": {"match": {"body": "quick"}},
+                         "profile": True, "size": 1})
+    prof = r.profile["shards"][0]["searches"][0]
+    assert prof["query"][0]["type"]
+    assert prof["query"][0]["time_in_nanos"] > 0
+    assert prof["collector"][0]["name"]
+
+
+# -- can_match ---------------------------------------------------------------
+
+
+def test_can_match_skips_disjoint_shards():
+    mapper = MapperService(MAPPING)
+    shard_lists = []
+    for lo in (0, 100, 200):
+        b = SegmentBuilder(f"_{lo}")
+        for i in range(5):
+            b.add(mapper.parse_document(f"{lo}-{i}", {
+                "body": "doc", "brand": "x", "price": float(lo + i)}),
+                seq_no=i)
+        shard_lists.append([b.build()])
+    dist = DistributedSearcher(shard_lists, mapper)
+    r = dist.search({"query": {"bool": {"filter": [
+        {"range": {"price": {"gte": 100, "lt": 105}}}]}}, "size": 20})
+    assert r.total == 5
+    assert dist.last_skipped == 2            # shards [0..4] and [200..204]
+    # no skip when the range spans shards
+    r = dist.search({"query": {"range": {"price": {"gte": 50}}},
+                     "size": 20})
+    assert dist.last_skipped == 1            # only the 0..4 shard skips
+    assert r.total == 10
+    # aggs suppress the pre-filter (global agg must see every shard)
+    r = dist.search({"query": {"range": {"price": {"gte": 1000}}},
+                     "size": 0, "aggs": {"g": {"global": {}, "aggs": {
+                         "c": {"value_count": {"field": "price"}}}}}})
+    assert dist.last_skipped == 0
+    assert r.aggregations["g"]["c"]["value"] == 15
+
+
+# -- REST surface ------------------------------------------------------------
+
+
+def test_suggest_and_profile_over_rest(tmp_path):
+    import json
+    from elasticsearch_tpu.node.indices_service import IndicesService
+    from elasticsearch_tpu.rest.api import RestAPI
+    api = RestAPI(IndicesService(str(tmp_path)))
+
+    def req(method, path, body=None, query=""):
+        raw = json.dumps(body).encode() if body is not None else b""
+        st, _ct, payload = api.handle(method, path, query, raw)
+        return st, json.loads(payload)
+
+    req("PUT", "/idx", {"mappings": MAPPING,
+                        "settings": {"index": {"number_of_shards": 2}}})
+    for i, (did, body, brand, price, sugg) in enumerate(DOCS):
+        req("PUT", f"/idx/_doc/{did}", {"body": body, "brand": brand,
+                                        "price": price, "sugg": sugg})
+    req("POST", "/idx/_refresh")
+    st, out = req("POST", "/idx/_search", {
+        "size": 0, "suggest": {"s": {"text": "quik", "term": {
+            "field": "body", "min_word_length": 3}}}})
+    assert st == 200
+    assert out["suggest"]["s"][0]["options"][0]["text"] == "quick"
+    st, out = req("POST", "/idx/_search", {
+        "query": {"match": {"body": "quick"}}, "profile": True})
+    assert "profile" in out and out["profile"]["shards"]
+    st, out = req("POST", "/idx/_search", {
+        "query": {"match_all": {}}, "collapse": {"field": "brand"},
+        "sort": [{"price": "desc"}], "size": 10})
+    ids = [h["_id"] for h in out["hits"]["hits"]]
+    assert ids == ["6", "4", "2"]
