@@ -259,7 +259,12 @@ class Engine:
             return
         kind = current.location[0]
         if kind == "buffer":
-            self._buffer.deleted.add(current.location[1])
+            parent = current.location[1]
+            self._buffer.deleted.add(parent)
+            # nested children die with their buffered parent
+            for c, p in self._buffer.parent_of.items():
+                if p == parent:
+                    self._buffer.deleted.add(c)
         else:
             _, seg, local = current.location
             seg.delete_doc(local)
@@ -569,8 +574,10 @@ class Engine:
 
     @property
     def doc_count(self) -> int:
-        return sum(s.live_count for s in self.segments) + \
-            len(self._buffer) - len(self._buffer.deleted)
+        return sum(s.live_parent_count for s in self.segments) + \
+            sum(1 for i in range(self._buffer.n_docs)
+                if i not in self._buffer.deleted
+                and i not in self._buffer.parent_of)
 
     @property
     def deleted_count(self) -> int:

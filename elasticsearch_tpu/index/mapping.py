@@ -240,6 +240,160 @@ class GeoPointFieldType(MappedFieldType):
         return (lat, lon)
 
 
+class IpFieldType(MappedFieldType):
+    """IP addresses (reference: ``index/mapper/IpFieldMapper.java``).
+    Stored dual: the numeric value (for range/CIDR masks on device) and
+    the normalized string as a keyword term (exact term matches). IPv4 is
+    exact; IPv6 numeric comparisons carry f64 (2^53) precision — range
+    endpoints beyond that resolve to the nearest representable value
+    (documented deviation; the reference compares 128-bit points)."""
+
+    type_name = "ip"
+    has_doc_values = True
+
+    def parse_value(self, value):
+        import ipaddress
+        try:
+            ip = ipaddress.ip_address(str(value))
+        except ValueError as e:
+            raise MapperParsingError(f"'{value}' is not an IP string "
+                                     f"literal.") from e
+        return str(ip), float(int(ip))
+
+    @staticmethod
+    def cidr_bounds(value: str):
+        """'a.b.c.d/n' → (lo_int, hi_int) or None when not a CIDR."""
+        import ipaddress
+        if "/" not in str(value):
+            return None
+        net = ipaddress.ip_network(str(value), strict=False)
+        return float(int(net.network_address)), \
+            float(int(net.broadcast_address))
+
+
+RANGE_TYPES = {"integer_range", "long_range", "float_range",
+               "double_range", "date_range", "ip_range"}
+
+
+class RangeFieldType(MappedFieldType):
+    """Range fields (reference: ``index/mapper/RangeFieldMapper.java``):
+    each value is an interval stored as two numeric columns
+    ``<field>._gte`` / ``<field>._lte`` (bounds normalized to closed);
+    queries compare interval endpoints under a relation
+    (intersects/contains/within)."""
+
+    type_name = "range"
+
+    def __init__(self, name: str, range_kind: str, params: dict):
+        super().__init__(name, params)
+        self.range_kind = range_kind
+        self.type_name = range_kind
+
+    def _point(self, v):
+        try:
+            if self.range_kind == "date_range":
+                return float(parse_date_millis(v))
+            if self.range_kind == "ip_range":
+                import ipaddress
+                return float(int(ipaddress.ip_address(str(v))))
+            return float(v)
+        except (ValueError, TypeError) as e:
+            raise MapperParsingError(
+                f"failed to parse [{self.range_kind}] bound [{v}] for "
+                f"field [{self.name}]") from e
+
+    def parse_value(self, value):
+        if not isinstance(value, dict):
+            raise MapperParsingError(
+                f"range field [{self.name}] expects an object with "
+                f"gte/gt/lte/lt bounds")
+        integral = self.range_kind in ("integer_range", "long_range",
+                                       "date_range", "ip_range")
+        lo = value.get("gte")
+        if lo is None and value.get("gt") is not None:
+            p = self._point(value["gt"])
+            lo = p + 1 if integral else float(np.nextafter(p, np.inf))
+        elif lo is not None:
+            lo = self._point(lo)
+        hi = value.get("lte")
+        if hi is None and value.get("lt") is not None:
+            p = self._point(value["lt"])
+            hi = p - 1 if integral else float(np.nextafter(p, -np.inf))
+        elif hi is not None:
+            hi = self._point(hi)
+        if lo is None:
+            lo = -1.7e308
+        if hi is None:
+            hi = 1.7e308
+        return float(lo), float(hi)
+
+
+class SearchAsYouTypeFieldType(TextFieldType):
+    """search_as_you_type: the base text field plus an ``._index_prefix``
+    sibling holding edge n-grams (2..max_prefix_chars) of every analyzed
+    term, so as-you-type prefixes match postings without wildcard scans
+    (the reference adds shingle subfields too; prefix covers the hot
+    match_bool_prefix path)."""
+
+    type_name = "search_as_you_type"
+    MAX_PREFIX = 10
+
+    def __init__(self, name, analyzer, params):
+        super().__init__(name, analyzer, None, params)
+
+
+class PrefixSubFieldType(TextFieldType):
+    """The ``._index_prefix`` sibling of a search_as_you_type field —
+    queryable like text, but its postings are written by the parent's
+    prefix-gram branch, never by the generic multi-field loop."""
+
+    type_name = "text"
+
+
+class RuntimeFieldType(MappedFieldType):
+    """Runtime fields (reference: ``index/mapper/RuntimeField.java`` —
+    script-computed at query time, no index structures). The script is a
+    restricted expression (``utils/expressions.py``) over the document's
+    numeric doc-value columns; the column materializes lazily per segment
+    as one vectorized evaluation and caches — usable in sort, range
+    queries, and numeric aggregations."""
+
+    type_name = "runtime"
+    has_doc_values = True
+
+    def __init__(self, name: str, runtime_kind: str, script_source: str,
+                 params: dict):
+        super().__init__(name, params)
+        self.runtime_kind = runtime_kind
+        self.script_source = script_source
+
+    def column(self, seg) -> np.ndarray:
+        """float64[n_pad] computed column (NaN where any input is
+        missing), cached on the segment."""
+        key = f"__rt__{self.name}"
+        col = seg._fv_columns.get(key)
+        if col is None:
+            import ast as _ast
+            from ..utils.expressions import (compile_expression,
+                                             evaluate_expression_vec)
+            tree = compile_expression(self.script_source)
+            names = {n.id for n in _ast.walk(tree)
+                     if isinstance(n, _ast.Name)}
+            env = {}
+            for nm in names:
+                try:
+                    env[nm] = seg.numeric_first_value_column(nm)
+                except Exception:       # noqa: BLE001 — math fn names etc.
+                    continue
+            col = np.asarray(
+                evaluate_expression_vec(self.script_source, env),
+                dtype=np.float64)
+            if col.shape == ():          # constant expression
+                col = np.full(seg.n_pad, float(col))
+            seg._fv_columns[key] = col
+        return col
+
+
 class CompletionFieldType(MappedFieldType):
     """Auto-complete inputs (reference:
     ``search/suggest/completion/CompletionFieldMapper.java``). Inputs are
@@ -272,6 +426,19 @@ class ObjectFieldType(MappedFieldType):
     is_searchable = False
 
 
+class NestedFieldType(ObjectFieldType):
+    """Nested objects as block-joined hidden child documents (reference:
+    ``index/mapper/NestedObjectMapper.java`` + Lucene block join): each
+    nested value becomes its own document indexed immediately BEFORE its
+    parent, carrying the ``path.field`` leaf values; the segment stores a
+    parent bitmask and child→parent pointers, and ``nested`` queries join
+    child matches back to parents (``search/query_dsl.py NestedQuery``).
+    Cross-object match leakage — the flattened v1 gap — is gone: each
+    child matches independently."""
+
+    type_name = "nested"
+
+
 # ---------------------------------------------------------------------------
 # Parsed document
 # ---------------------------------------------------------------------------
@@ -297,6 +464,10 @@ class ParsedDocument:
     geo_points: Dict[str, List[Tuple[float, float]]] = dc_field(default_factory=dict)
     # dynamic mapping updates discovered while parsing (to merge into mapping)
     dynamic_updates: Dict[str, dict] = dc_field(default_factory=dict)
+    # (nested path, child ParsedDocument) — block-joined hidden children,
+    # indexed immediately before this parent (NestedFieldType)
+    nested_docs: List[Tuple[str, "ParsedDocument"]] = \
+        dc_field(default_factory=list)
 
     def field_names(self) -> List[str]:
         names = set()
@@ -327,6 +498,7 @@ class MapperService:
         self._mapping_def: dict = {"properties": {}}
         self.dynamic: Any = True
         self.source_enabled = True
+        self.runtime_defs: Dict[str, dict] = {}
         if mappings:
             self.merge(mappings)
 
@@ -339,6 +511,16 @@ class MapperService:
             self.dynamic = mappings["dynamic"]
         if "_source" in mappings:
             self.source_enabled = bool(mappings["_source"].get("enabled", True))
+        for name, spec in (mappings.get("runtime") or {}).items():
+            script = (spec.get("script") or {})
+            src = script.get("source") if isinstance(script, dict) \
+                else str(script)
+            if not src:
+                raise MapperParsingError(
+                    f"runtime field [{name}] requires a script")
+            self._fields[name] = RuntimeFieldType(
+                name, spec.get("type", "double"), src, {})
+            self.runtime_defs[name] = spec
         props = mappings.get("properties", {})
         self._merge_properties("", props)
         self._rebuild_mapping_def()
@@ -360,7 +542,10 @@ class MapperService:
                     f"mapper [{full}] cannot be changed from type "
                     f"[{existing.type_name}] to [{ftype}]")
             if ftype == "object" or ftype == "nested":
-                self._fields[full] = ObjectFieldType(full, {"type": ftype})
+                self._fields[full] = (
+                    NestedFieldType(full, {"type": "nested"})
+                    if ftype == "nested"
+                    else ObjectFieldType(full, {"type": ftype}))
                 self._merge_properties(f"{full}.", spec.get("properties", {}))
                 continue
             self._fields[full] = self._build_field(full, ftype, spec)
@@ -400,12 +585,27 @@ class MapperService:
             return GeoPointFieldType(name, params)
         if ftype == "completion":
             return CompletionFieldType(name, params)
+        if ftype == "ip":
+            return IpFieldType(name, params)
+        if ftype in RANGE_TYPES:
+            return RangeFieldType(name, ftype, params)
+        if ftype == "search_as_you_type":
+            # reference: SearchAsYouTypeFieldMapper — a text field plus
+            # prefix-acceleration subfields; here the main field is text
+            # and ._index_prefix stores edge n-grams of every term so
+            # prefix/bool-prefix matches hit the postings directly
+            analyzer = self.analysis.get(spec.get("analyzer", "standard"))
+            self._fields[f"{name}._index_prefix"] = PrefixSubFieldType(
+                f"{name}._index_prefix", analyzer, None, {})
+            return SearchAsYouTypeFieldType(name, analyzer, params)
         raise MapperParsingError(f"No handler for type [{ftype}] declared on field [{name}]")
 
     def _rebuild_mapping_def(self) -> None:
         root: dict = {}
         for name in sorted(self._fields):
             ft = self._fields[name]
+            if isinstance(ft, RuntimeFieldType):
+                continue                 # rendered under "runtime"
             parts = name.split(".")
             # Place under parent's "fields" if parent exists and is a leaf
             # (multi-field), else nest via "properties".
@@ -422,11 +622,17 @@ class MapperService:
                 n.split(".")[-1]: self._fields[n].to_mapping()
                 for n in self._fields
                 if n.startswith(name + ".") and "." not in n[len(name) + 1:]
-                and not isinstance(ft, ObjectFieldType)}
+                and not isinstance(ft, ObjectFieldType)
+                # synthetic siblings re-register from the parent's type on
+                # merge; rendering them as multi-fields would round-trip
+                # them into plain text fields (double indexing)
+                and not isinstance(self._fields[n], PrefixSubFieldType)}
             if subfields:
                 entry["fields"] = subfields
             node[parts[-1]] = entry
         mapping_def: dict = {"properties": root}
+        if self.runtime_defs:
+            mapping_def["runtime"] = dict(self.runtime_defs)
         if self.dynamic is not True:
             mapping_def["dynamic"] = self.dynamic
         if not self.source_enabled:
@@ -463,6 +669,19 @@ class MapperService:
             if value is None:
                 continue
             ft = self._fields.get(full)
+            if isinstance(ft, NestedFieldType):
+                children = value if isinstance(value, list) else [value]
+                for ci, child in enumerate(children):
+                    if not isinstance(child, dict):
+                        raise MapperParsingError(
+                            f"object mapping for [{full}] tried to parse "
+                            f"field as object, but got a non-object value")
+                    child_parsed = ParsedDocument(
+                        doc_id=f"{parsed.doc_id}#{full}#{ci}", source=child)
+                    child_parsed.dynamic_updates = parsed.dynamic_updates
+                    self._parse_object(f"{full}.", child, child_parsed)
+                    parsed.nested_docs.append((full, child_parsed))
+                continue
             if isinstance(value, dict) and (ft is None or isinstance(ft, ObjectFieldType)):
                 if ft is None:
                     if self._check_dynamic(full):
@@ -544,6 +763,23 @@ class MapperService:
             for t in new:
                 toks.append(Token(t.term, t.position + base_pos,
                                   t.start_offset, t.end_offset))
+            if isinstance(ft, SearchAsYouTypeFieldType):
+                pref = parsed.text_tokens.setdefault(
+                    f"{full}._index_prefix", [])
+                for t in new:
+                    for n in range(2, min(len(t.term),
+                                          ft.MAX_PREFIX) + 1):
+                        pref.append(Token(t.term[:n],
+                                          t.position + base_pos,
+                                          t.start_offset, t.end_offset))
+        elif isinstance(ft, IpFieldType):
+            s, num = ft.parse_value(value)
+            parsed.keyword_terms.setdefault(full, []).append(s)
+            parsed.numeric_values.setdefault(full, []).append(num)
+        elif isinstance(ft, RangeFieldType):
+            lo, hi = ft.parse_value(value)
+            parsed.numeric_values.setdefault(f"{full}._gte", []).append(lo)
+            parsed.numeric_values.setdefault(f"{full}._lte", []).append(hi)
         elif isinstance(ft, KeywordFieldType):
             v = ft.parse_value(value)
             if v is not None:
@@ -563,7 +799,8 @@ class MapperService:
         for sub_name in list(self._fields):
             if sub_name.startswith(full + ".") and "." not in sub_name[len(full) + 1:]:
                 sub = self._fields[sub_name]
-                if isinstance(sub, ObjectFieldType) or sub_name == full:
+                if isinstance(sub, (ObjectFieldType, PrefixSubFieldType)) \
+                        or sub_name == full:
                     continue
                 if not isinstance(ft, ObjectFieldType) and not isinstance(
                         sub, (ObjectFieldType,)):

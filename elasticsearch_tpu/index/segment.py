@@ -139,7 +139,9 @@ class Segment:
                  text_fields: Dict[str, TextFieldData],
                  keyword_fields: Dict[str, KeywordFieldData],
                  numeric_fields: Dict[str, NumericFieldData],
-                 vector_fields: Dict[str, VectorFieldData]):
+                 vector_fields: Dict[str, VectorFieldData],
+                 parent_of: Optional[np.ndarray] = None,
+                 nested_paths: Optional[Dict[str, np.ndarray]] = None):
         self.seg_id = seg_id
         self.n_docs = n_docs
         self.n_pad = round_up_pow2(max(n_docs, 1))
@@ -150,10 +152,23 @@ class Segment:
         self.keyword_fields = keyword_fields
         self.numeric_fields = numeric_fields
         self.vector_fields = vector_fields
+        # block join: child -> parent pointers (self for top-level docs)
+        # and per-nested-path child marks; parent_mask excludes hidden
+        # children from every top-level query/agg/fetch
+        self.parent_of = (parent_of if parent_of is not None
+                          else np.arange(n_docs, dtype=np.int32))
+        self.nested_paths = nested_paths or {}
+        self.parent_mask = self.parent_of == np.arange(n_docs,
+                                                       dtype=np.int32)
+        self._parent_mask_dev: Optional[jnp.ndarray] = None
+        self._children_of: Optional[Dict[int, List[int]]] = None
         self.live = np.ones(n_docs, dtype=bool)     # host liveness (deletes)
         self._live_dev: Optional[jnp.ndarray] = None
         self._fv_columns: Dict[str, np.ndarray] = {}
-        self._uid_to_doc: Dict[str, int] = {u: i for i, u in enumerate(doc_uids)}
+        # hidden nested children never resolve by uid: a user doc whose id
+        # happens to collide with a synthetic child uid must win
+        self._uid_to_doc: Dict[str, int] = {
+            u: i for i, u in enumerate(doc_uids) if self.parent_mask[i]}
         self._upload()
 
     # -- device upload -------------------------------------------------------
@@ -188,6 +203,19 @@ class Segment:
 
     def delete_doc(self, local_doc: int) -> None:
         self.live[local_doc] = False
+        # cascade: a doc's hidden nested descendants die with it
+        # (recursive — multi-level nesting chains parent pointers)
+        if len(self.nested_paths):
+            if self._children_of is None:
+                cmap: Dict[int, List[int]] = {}
+                for c in np.flatnonzero(~self.parent_mask):
+                    cmap.setdefault(int(self.parent_of[c]), []).append(int(c))
+                self._children_of = cmap
+            stack = list(self._children_of.get(local_doc, ()))
+            while stack:
+                c = stack.pop()
+                self.live[c] = False
+                stack.extend(self._children_of.get(c, ()))
         self._live_dev = None
 
     @property
@@ -199,8 +227,28 @@ class Segment:
         return self._live_dev
 
     @property
+    def parent_mask_dev(self) -> jnp.ndarray:
+        if self._parent_mask_dev is None:
+            padded = np.zeros(self.n_pad, dtype=bool)
+            padded[: self.n_docs] = self.parent_mask
+            self._parent_mask_dev = jnp.asarray(padded)
+        return self._parent_mask_dev
+
+    @property
+    def has_nested(self) -> bool:
+        return bool(self.nested_paths)
+
+    @property
     def live_count(self) -> int:
         return int(self.live.sum())
+
+    @property
+    def live_parent_count(self) -> int:
+        """User-visible doc count: hidden nested children excluded (the
+        reference's _count likewise only sees top-level docs)."""
+        if not self.nested_paths:
+            return int(self.live.sum())
+        return int((self.live & self.parent_mask).sum())
 
     def find_doc(self, uid: str) -> Optional[int]:
         d = self._uid_to_doc.get(uid)
@@ -270,6 +318,9 @@ class SegmentBuilder:
         # local ids deleted before the segment is frozen (doc updated or
         # removed while still in the buffer); applied to `live` at build()
         self.deleted: set = set()
+        # block-join bookkeeping: child local id -> parent local id / path
+        self.parent_of: Dict[int, int] = {}
+        self.nested_path_of: Dict[int, str] = {}
         # field -> term -> list[(doc, tf)] built doc-ascending
         self._text_postings: Dict[str, Dict[str, List[Tuple[int, int]]]] = {}
         # field -> term -> doc -> positions
@@ -289,7 +340,27 @@ class SegmentBuilder:
 
     def add(self, parsed: ParsedDocument, seq_no: int,
             store_source: bool = True) -> int:
-        """Index one parsed document; returns its local doc id."""
+        """Index one parsed document (plus its block-joined nested
+        children, Lucene block order: children first, RECURSIVELY — a
+        grandchild's parent pointer targets its immediate nested parent,
+        so multi-level paths join level by level like the reference's
+        stacked ToParentBlockJoin); returns the top local doc id."""
+        return self._add_block(parsed, seq_no, store_source)
+
+    def _add_block(self, parsed: ParsedDocument, seq_no: int,
+                   store_source: bool) -> int:
+        child_ids = []
+        for path, child in parsed.nested_docs:
+            cid = self._add_block(child, seq_no, store_source=False)
+            self.nested_path_of[cid] = path
+            child_ids.append(cid)
+        doc = self._add_single(parsed, seq_no, store_source)
+        for cid in child_ids:
+            self.parent_of[cid] = doc
+        return doc
+
+    def _add_single(self, parsed: ParsedDocument, seq_no: int,
+                    store_source: bool = True) -> int:
         doc = len(self.doc_uids)
         self.doc_uids.append(parsed.doc_id)
         self.sources.append(parsed.source if store_source else None)
@@ -410,9 +481,19 @@ class SegmentBuilder:
                 exists[d] = True
             vector_fields[field] = VectorFieldData(matrix_host=mat, exists=exists)
 
+        parent_of = np.arange(n, dtype=np.int32)
+        for c, p in self.parent_of.items():
+            parent_of[c] = p
+        nested_paths: Dict[str, np.ndarray] = {}
+        for c, path in self.nested_path_of.items():
+            m = nested_paths.get(path)
+            if m is None:
+                m = nested_paths[path] = np.zeros(n, bool)
+            m[c] = True
         seg = Segment(self.seg_id, n, list(self.doc_uids), list(self.sources),
                       np.asarray(self.seq_nos, np.int64), text_fields,
-                      keyword_fields, numeric_fields, vector_fields)
+                      keyword_fields, numeric_fields, vector_fields,
+                      parent_of=parent_of, nested_paths=nested_paths)
         for local in self.deleted:
             seg.delete_doc(local)
         return seg

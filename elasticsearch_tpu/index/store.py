@@ -202,6 +202,12 @@ def save_segment(seg: Segment, store_dir: str, versions: Sequence[int],
         arrays[p + "mat"] = f.matrix_host
         arrays[p + "exists"] = f.exists
 
+    if seg.nested_paths:
+        manifest["nested_paths"] = sorted(seg.nested_paths)
+        arrays["parent_of"] = seg.parent_of
+        for i, path in enumerate(sorted(seg.nested_paths)):
+            arrays[f"np{i}_mask"] = seg.nested_paths[path]
+
     arrays["manifest"] = np.frombuffer(
         json.dumps(manifest).encode("utf-8"), dtype=np.uint8).copy()
 
@@ -281,9 +287,16 @@ def load_segment(store_dir: str, fname: str):
         vector_fields[m["name"]] = VectorFieldData(
             matrix_host=arrays[p + "mat"], exists=arrays[p + "exists"])
 
+    parent_of = arrays.get("parent_of")
+    nested_paths = None
+    if "nested_paths" in manifest:
+        nested_paths = {path: arrays[f"np{i}_mask"]
+                        for i, path in enumerate(manifest["nested_paths"])}
+
     seg = Segment(manifest["seg_id"], manifest["n_docs"], doc_uids, sources,
                   seq_nos, text_fields, keyword_fields, numeric_fields,
-                  vector_fields)
+                  vector_fields, parent_of=parent_of,
+                  nested_paths=nested_paths)
     apply_liveness_sidecar(seg, store_dir)
     return seg, versions, routing
 
@@ -341,9 +354,25 @@ def merge_segments(seg_id: str,
     numeric_fields = _merge_numeric(segments, lives, remaps)
     vector_fields = _merge_vector(segments, lives, remaps, n_new)
 
+    # block-join arrays: remap child→parent pointers and per-path marks
+    # (delete cascade guarantees a live child's parent is live too)
+    parent_of = None
+    nested_paths: Dict[str, np.ndarray] = {}
+    if any(s.nested_paths for s in segments):
+        parent_of = np.concatenate(
+            [r[s.parent_of[m]] for s, m, r in zip(segments, lives, remaps)]
+        ).astype(np.int32) if n_new else np.empty(0, np.int32)
+        all_paths = sorted({p for s in segments for p in s.nested_paths})
+        for path in all_paths:
+            nested_paths[path] = np.concatenate([
+                (s.nested_paths[path][m] if path in s.nested_paths
+                 else np.zeros(int(m.sum()), bool))
+                for s, m in zip(segments, lives)])
+
     return Segment(seg_id, n_new, doc_uids, sources,
                    seq_nos.astype(np.int64), text_fields, keyword_fields,
-                   numeric_fields, vector_fields)
+                   numeric_fields, vector_fields,
+                   parent_of=parent_of, nested_paths=nested_paths or None)
 
 
 def _concat_sources(segments, lives):

@@ -34,7 +34,8 @@ import numpy as np
 from ..common.errors import IllegalArgumentError, ParsingError
 from ..index.mapping import (
     BooleanFieldType, DateFieldType, KeywordFieldType, MapperService,
-    NumberFieldType, format_date_millis, parse_date_millis)
+    NumberFieldType, RuntimeFieldType, format_date_millis,
+    parse_date_millis)
 from ..index.segment import Segment
 from ..ops import aggs as ops_aggs
 
@@ -57,12 +58,20 @@ def _device_mask(seg, mask: np.ndarray):
 # ---------------------------------------------------------------------------
 
 
-def _numeric_pairs(seg: Segment, field: str):
-    """(docs int32[M], vals float64[M]) host-side exact values, or None."""
+def _numeric_pairs(seg: Segment, field: str, mapper=None):
+    """(docs int32[M], vals float64[M]) host-side exact values, or None.
+    Runtime fields materialize their computed column as pairs."""
     f = seg.numeric_fields.get(field)
-    if f is None or f.docs_host.shape[0] == 0:
-        return None
-    return f.docs_host, f.vals_host
+    if f is not None and f.docs_host.shape[0] > 0:
+        return f.docs_host, f.vals_host
+    if mapper is not None:
+        ft = mapper.field_type(field)
+        if isinstance(ft, RuntimeFieldType):
+            col = ft.column(seg)[: seg.n_docs]
+            docs = np.flatnonzero(~np.isnan(col)).astype(np.int32)
+            if docs.size:
+                return docs, col[docs]
+    return None
 
 
 def _keyword_pairs(seg: Segment, field: str):
@@ -236,7 +245,7 @@ class _NumericMetricAgg(Aggregator):
             raise ParsingError("metric aggregation requires [field]")
 
     def _matched_values(self, ctx, seg, mask: np.ndarray) -> np.ndarray:
-        pairs = _numeric_pairs(seg, self.field)
+        pairs = _numeric_pairs(seg, self.field, ctx.mapper)
         vals_list = []
         if pairs is not None:
             docs, vals = pairs
@@ -392,7 +401,7 @@ class CardinalityAgg(Aggregator):
             docs, ords, terms = kw
             sel = np.unique(ords[mask[docs]])
             return {"values": {terms[o] for o in sel}}
-        num = _numeric_pairs(seg, self.field)
+        num = _numeric_pairs(seg, self.field, ctx.mapper)
         if num is not None:
             docs, vals = num
             return {"values": set(np.unique(vals[mask[docs]]).tolist())}
@@ -612,7 +621,7 @@ class TermsAgg(BucketAggregator):
                 for i, c in zip(sel_ords.tolist(), counts.tolist()):
                     buckets[terms[i]] = (int(c), {})
         else:
-            num = _numeric_pairs(seg, self.field)
+            num = _numeric_pairs(seg, self.field, ctx.mapper)
             if num is not None:
                 docs, vals = num
                 pm = mask[docs]
@@ -728,7 +737,7 @@ class HistogramAgg(BucketAggregator):
         return np.floor((vals - self.offset) / self.interval)
 
     def collect(self, ctx, seg, mask):
-        num = _numeric_pairs(seg, self.field)
+        num = _numeric_pairs(seg, self.field, ctx.mapper)
         if num is None:
             return {}
         docs, vals = num
@@ -870,7 +879,7 @@ class DateHistogramAgg(BucketAggregator):
         return np.floor(vals / self.fixed_ms) * self.fixed_ms
 
     def collect(self, ctx, seg, mask):
-        num = _numeric_pairs(seg, self.field)
+        num = _numeric_pairs(seg, self.field, ctx.mapper)
         if num is None:
             return {}
         docs, vals = num
@@ -927,7 +936,7 @@ class RangeAgg(BucketAggregator):
         return f"{f}-{t}"
 
     def collect(self, ctx, seg, mask):
-        num = _numeric_pairs(seg, self.field)
+        num = _numeric_pairs(seg, self.field, ctx.mapper)
         out = {}
         for r in self.ranges:
             key = self._range_key(r)
@@ -1044,7 +1053,7 @@ class MissingAgg(BucketAggregator):
         kw = _keyword_pairs(seg, self.field)
         if kw is not None:
             has[kw[0]] = True
-        num = _numeric_pairs(seg, self.field)
+        num = _numeric_pairs(seg, self.field, ctx.mapper)
         if num is not None:
             has[num[0]] = True
         tf = seg.text_fields.get(self.field)
@@ -1073,6 +1082,8 @@ class GlobalAgg(BucketAggregator):
     def collect(self, ctx, seg, mask):
         gm = np.zeros(mask.shape[0], bool)
         gm[: seg.n_docs] = seg.live
+        if seg.has_nested:
+            gm[: seg.n_docs] &= seg.parent_mask    # children stay hidden
         if self.subs:
             return _bucket_payload(self, ctx, seg, gm)
         return (int(gm.sum()), {})

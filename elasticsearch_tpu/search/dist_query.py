@@ -100,7 +100,9 @@ def _shard_can_match(shard: "ShardSearcher", bounds: List[tuple]) -> bool:
             fmin = min(fmin, mm[0])
             fmax = max(fmax, mm[1])
         if not present:
-            return False                      # no values: cannot match
+            # not a plain numeric column (range/runtime/unmapped field):
+            # the heuristic cannot reason about it — never skip on it
+            continue
         if fmax < lo or fmin > hi:
             return False
     return True

@@ -29,8 +29,9 @@ import numpy as np
 
 from ..common.errors import IllegalArgumentError, ParsingError
 from ..index.mapping import (
-    BooleanFieldType, DateFieldType, DenseVectorFieldType, KeywordFieldType,
-    MapperService, NumberFieldType, TextFieldType, parse_date_millis)
+    BooleanFieldType, DateFieldType, DenseVectorFieldType, IpFieldType,
+    KeywordFieldType, MapperService, NumberFieldType, RangeFieldType,
+    RuntimeFieldType, TextFieldType, parse_date_millis)
 from ..index.segment import Segment
 from ..ops.bm25 import DEFAULT_B, DEFAULT_K1, get_bm25_kernel, idf_weight
 from ..ops.masks import get_postings_match_kernel, get_range_mask_kernel
@@ -393,6 +394,22 @@ class TermQuery(Query):
             scores, matched, _ = _keyword_terms_result(
                 ctx, seg, self.field, {v: 1.0}, scored=True)
             return scores * np.float32(self.boost), matched > 0
+        if isinstance(ft, IpFieldType):
+            cidr = IpFieldType.cidr_bounds(self.value)
+            if cidr is not None:
+                return _exact_numeric_mask(seg, self.field, cidr[0],
+                                           cidr[1], self.boost)
+            _, num = ft.parse_value(self.value)
+            return _exact_numeric_mask(seg, self.field, num, num,
+                                       self.boost)
+        if isinstance(ft, RangeFieldType):
+            if ft.range_kind == "ip_range" and "/" in str(self.value):
+                lo, hi = IpFieldType.cidr_bounds(self.value)
+                return _range_field_result(seg, self.field, lo, hi,
+                                           "intersects", self.boost)
+            p = ft._point(self.value)      # point containment
+            return _range_field_result(seg, self.field, p, p,
+                                       "intersects", self.boost)
         if isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType)):
             val = ft.parse_value(self.value)
             return _numeric_range_result(seg, self.field, val, val, self.boost)
@@ -462,6 +479,48 @@ def _f32_upper_bound(bound: float, inclusive: bool) -> np.float32:
     return b32
 
 
+def _exact_numeric_mask(seg: Segment, field: str, lo, hi, boost):
+    """Host-side EXACT f64 range mask over a numeric field's pairs — for
+    types whose magnitudes exceed f32-offset precision on device (ip:
+    CIDR boundaries are exact integers near 2^32)."""
+    nf = seg.numeric_fields.get(field)
+    if nf is None:
+        return _const_result(seg, 0.0, False)
+    lo_v = -1.8e308 if lo is None else float(lo)
+    hi_v = 1.8e308 if hi is None else float(hi)
+    sel = (nf.vals_host >= lo_v) & (nf.vals_host <= hi_v)
+    m = np.zeros(seg.n_pad, bool)
+    m[nf.docs_host[sel]] = True
+    mask = jnp.asarray(m)
+    return jnp.where(mask, np.float32(boost), 0.0), mask
+
+
+def _range_field_result(seg: Segment, field: str, lo, hi, relation: str,
+                        boost: float):
+    """Relation mask for a RANGE field's stored intervals
+    (``RangeFieldMapper`` queries): the query interval [lo, hi] vs EVERY
+    stored [gte, lte] pair of a doc — a doc matches if ANY of its
+    intervals satisfies the relation (the pairs append in lockstep at
+    parse time, so the two columns align positionally)."""
+    g = seg.numeric_fields.get(f"{field}._gte")
+    l = seg.numeric_fields.get(f"{field}._lte")
+    if g is None or l is None or g.vals_host.size == 0:
+        return _const_result(seg, 0.0, False)
+    glo, ghi = g.vals_host, l.vals_host
+    lo_v = -1.8e308 if lo is None else float(lo)
+    hi_v = 1.8e308 if hi is None else float(hi)
+    if relation == "within":            # doc interval inside the query's
+        sel = (glo >= lo_v) & (ghi <= hi_v)
+    elif relation == "contains":        # doc interval covers the query's
+        sel = (glo <= lo_v) & (ghi >= hi_v)
+    else:                               # intersects
+        sel = (glo <= hi_v) & (ghi >= lo_v)
+    m = np.zeros(seg.n_pad, bool)
+    m[g.docs_host[sel]] = True
+    mask = jnp.asarray(m)
+    return jnp.where(mask, np.float32(boost), 0.0), mask
+
+
 def _numeric_range_result(seg: Segment, field: str, lo, hi, boost,
                           include_lo=True, include_hi=True):
     """Range mask over a numeric field's (value, doc) pairs. Bounds arrive in
@@ -485,16 +544,72 @@ class RangeQuery(Query):
     """Range (reference: ``RangeQueryBuilder.java``). Constant-score."""
 
     def __init__(self, field: str, gte=None, gt=None, lte=None, lt=None,
-                 boost: float = 1.0, date_format: Optional[str] = None):
+                 boost: float = 1.0, date_format: Optional[str] = None,
+                 relation: str = "intersects"):
         self.field = field
         self.gte, self.gt, self.lte, self.lt = gte, gt, lte, lt
         self.boost = boost
         self.date_format = date_format
+        self.relation = relation
+        if relation not in ("intersects", "contains", "within"):
+            raise ParsingError(
+                f"[range] unknown relation [{relation}]")
 
     def execute(self, ctx, seg):
         ft = ctx.field_type(self.field)
         if ft is None:
             return _const_result(seg, 0.0, False)
+        if isinstance(ft, RuntimeFieldType):
+            col = ft.column(seg)
+            lo = float(self.gte if self.gte is not None else self.gt) \
+                if (self.gte is not None or self.gt is not None) \
+                else float("-inf")
+            hi = float(self.lte if self.lte is not None else self.lt) \
+                if (self.lte is not None or self.lt is not None) \
+                else float("inf")
+            with np.errstate(invalid="ignore"):
+                m = ~np.isnan(col)
+                m &= (col > lo) if self.gt is not None else (col >= lo)
+                m &= (col < hi) if self.lt is not None else (col <= hi)
+            mask = jnp.asarray(m)
+            return jnp.where(mask, np.float32(self.boost), 0.0), mask
+        if isinstance(ft, IpFieldType):
+            lo = hi = None
+            for v, inclusive in ((self.gte, True), (self.gt, False)):
+                if v is not None:
+                    cidr = IpFieldType.cidr_bounds(v)
+                    if cidr is not None:
+                        # gte block → from its start; gt block → past its
+                        # END (the whole block is excluded)
+                        lo = cidr[0] if inclusive else cidr[1] + 1
+                    else:
+                        lo = ft.parse_value(v)[1]
+                        if not inclusive:
+                            lo += 1
+            for v, inclusive in ((self.lte, True), (self.lt, False)):
+                if v is not None:
+                    cidr = IpFieldType.cidr_bounds(v)
+                    if cidr is not None:
+                        # lte block → to its end; lt block → below its START
+                        hi = cidr[1] if inclusive else cidr[0] - 1
+                    else:
+                        hi = ft.parse_value(v)[1]
+                        if not inclusive:
+                            hi -= 1
+            return _exact_numeric_mask(seg, self.field, lo, hi, self.boost)
+        if isinstance(ft, RangeFieldType):
+            lo = ft._point(self.gte if self.gte is not None else self.gt) \
+                if (self.gte is not None or self.gt is not None) else None
+            hi = ft._point(self.lte if self.lte is not None else self.lt) \
+                if (self.lte is not None or self.lt is not None) else None
+            integral = ft.range_kind in ("integer_range", "long_range",
+                                         "date_range", "ip_range")
+            if self.gt is not None and lo is not None:
+                lo = lo + 1 if integral else float(np.nextafter(lo, np.inf))
+            if self.lt is not None and hi is not None:
+                hi = hi - 1 if integral else float(np.nextafter(hi, -np.inf))
+            return _range_field_result(seg, self.field, lo, hi,
+                                       self.relation, self.boost)
         if isinstance(ft, (NumberFieldType, BooleanFieldType)):
             lo = self.gte if self.gte is not None else self.gt
             hi = self.lte if self.lte is not None else self.lt
@@ -837,19 +952,58 @@ class BoostingQuery(Query):
 
 
 class NestedQuery(Query):
-    """v1: nested docs are flattened at index time, so `nested` delegates to
-    its inner query (correct for single-valued nesting; multi-valued cross-
-    object matching semantics are a known gap vs the reference's
-    ``modules/parent-join`` + nested docs)."""
+    """Block-join nested query (reference: ``NestedQueryBuilder.java`` →
+    Lucene ``ToParentBlockJoinQuery``): the inner query executes against
+    the hidden child documents of ``path`` (see
+    ``index/mapping.py NestedFieldType``) and matches join back to their
+    parents with ``score_mode`` (avg default | sum | max | min | none)
+    aggregating child scores per parent."""
 
-    def __init__(self, path: str, inner: Query, boost: float = 1.0):
+    def __init__(self, path: str, inner: Query, boost: float = 1.0,
+                 score_mode: str = "avg"):
         self.path = path
         self.inner = inner
         self.boost = boost
+        if score_mode not in ("avg", "sum", "max", "min", "none"):
+            raise ParsingError(
+                f"[nested] illegal score_mode [{score_mode}]")
+        self.score_mode = score_mode
 
     def execute(self, ctx, seg):
+        path_mask = seg.nested_paths.get(self.path)
+        if path_mask is None:
+            # no children for this path in the segment (or legacy
+            # flattened data): no parent can match
+            return _const_result(seg, 0.0, False)
         s, m = self.inner.execute(ctx, seg)
-        return s * np.float32(self.boost), m
+        child_m = np.zeros(seg.n_pad, bool)
+        child_m[: seg.n_docs] = path_mask & seg.live[: seg.n_docs]
+        child_m &= np.asarray(m)
+        child_docs = np.flatnonzero(child_m)
+        n = seg.n_pad
+        pscore = np.zeros(n, np.float32)
+        pmask = np.zeros(n, bool)
+        if child_docs.size:
+            parents = seg.parent_of[child_docs]
+            pmask[parents] = True
+            cs = np.asarray(s)[child_docs].astype(np.float32)
+            if self.score_mode == "sum":
+                np.add.at(pscore, parents, cs)
+            elif self.score_mode == "max":
+                np.maximum.at(pscore, parents, cs)
+            elif self.score_mode == "min":
+                tmp = np.full(n, np.inf, np.float32)
+                np.minimum.at(tmp, parents, cs)
+                pscore = np.where(pmask, tmp, 0.0).astype(np.float32)
+            elif self.score_mode == "none":
+                pscore = pmask.astype(np.float32)
+            else:                       # avg
+                cnt = np.zeros(n, np.float32)
+                np.add.at(pscore, parents, cs)
+                np.add.at(cnt, parents, 1.0)
+                pscore = np.where(cnt > 0, pscore / np.maximum(cnt, 1), 0.0)
+        return (jnp.asarray(pscore * np.float32(self.boost)),
+                jnp.asarray(pmask))
 
     def collect_highlight_terms(self, ctx, out):
         self.inner.collect_highlight_terms(ctx, out)
@@ -1137,7 +1291,8 @@ def _parse_range(body):
                         opts.pop("to"))
     return RangeQuery(field, opts.get("gte"), opts.get("gt"), opts.get("lte"),
                       opts.get("lt"), float(opts.get("boost", 1.0)),
-                      opts.get("format"))
+                      opts.get("format"),
+                      relation=opts.get("relation", "intersects"))
 
 
 def _parse_bool(body):
@@ -1207,7 +1362,8 @@ def _parse_boosting(body):
 
 def _parse_nested(body):
     return NestedQuery(body.get("path", ""), parse_query(body["query"]),
-                       float(body.get("boost", 1.0)))
+                       float(body.get("boost", 1.0)),
+                       score_mode=body.get("score_mode", "avg"))
 
 
 def _parse_multi_match(body):

@@ -24,7 +24,8 @@ import numpy as np
 
 from ..common.errors import IllegalArgumentError, ParsingError
 from ..index.mapping import (DateFieldType, DenseVectorFieldType,
-                             KeywordFieldType, MapperService, NumberFieldType)
+                             KeywordFieldType, MapperService, NumberFieldType,
+                             RuntimeFieldType)
 from ..index.segment import Segment
 from ..ops.topk import get_topk_kernel
 from ..utils.shapes import round_up_pow2
@@ -130,6 +131,8 @@ class ShardSearcher:
             sim, exists = _vector_similarity(sim_kind, qv, seg, field)
             scores = _knn_score_transform(ft.similarity, sim)
             mask = exists & seg.live_dev
+            if seg.has_nested:
+                mask = mask & seg.parent_mask_dev
             if filter_q is not None:
                 _, fm = filter_q.execute(self.ctx, seg)
                 mask = mask & fm
@@ -167,6 +170,8 @@ class ShardSearcher:
             return ((np.int64(seg_idx) << 32) +
                     docs.astype(np.int64)).astype(np.float64)
         ft = self.mapper.field_type(field)
+        if isinstance(ft, RuntimeFieldType):
+            return ft.column(seg)[docs]
         nf = seg.numeric_fields.get(field)
         if nf is not None or isinstance(ft, (NumberFieldType, DateFieldType)):
             return seg.numeric_first_value_column(field)[docs]
@@ -264,6 +269,9 @@ class ShardSearcher:
         for seg_idx, seg in enumerate(self.segments):
             scores, mask = query.execute(self.ctx, seg)
             mask = mask & seg.live_dev
+            if seg.has_nested:
+                # hidden block-join children never surface at top level
+                mask = mask & seg.parent_mask_dev
             if min_score is not None:
                 mask = mask & (scores >= np.float32(min_score))
             count_dev = jnp.sum(mask) if track_total_hits is not False else None
@@ -707,7 +715,10 @@ class ShardSearcher:
         total = 0
         for seg in self.segments:
             _, mask = query.execute(self.ctx, seg)
-            total += int(jnp.sum(mask & seg.live_dev))
+            mask = mask & seg.live_dev
+            if seg.has_nested:
+                mask = mask & seg.parent_mask_dev
+            total += int(jnp.sum(mask))
         return total
 
 

