@@ -14,6 +14,8 @@ import re
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
 from urllib.parse import parse_qs, unquote
 
 from ..common.errors import (DocumentMissingError, ElasticsearchError,
@@ -73,6 +75,7 @@ class RestAPI:
         self.templates: Dict[str, dict] = {}
         self.scrolls: Dict[str, dict] = {}
         self.pits: Dict[str, dict] = {}
+        self._tasks: Dict[str, dict] = {}
         self.ingest = IngestService()
         self.snapshots = SnapshotsService(indices)
         self._routes: List[Tuple[str, re.Pattern, List[str], Callable]] = []
@@ -156,6 +159,10 @@ class RestAPI:
         add("POST,PUT", "/{index}/_bulk", self.h_bulk)
         add("POST", "/{index}/_delete_by_query", self.h_delete_by_query)
         add("POST", "/{index}/_update_by_query", self.h_update_by_query)
+        add("POST", "/_reindex", self.h_reindex)
+        add("GET,POST", "/{index}/_explain/{id}", self.h_explain)
+        add("GET,POST", "/{index}/_termvectors/{id}", self.h_termvectors)
+        add("GET", "/_tasks", self.h_tasks)
         # templates
         add("PUT,POST", "/_index_template/{name}", self.h_put_template)
         add("GET", "/_index_template/{name}", self.h_get_template)
@@ -1291,6 +1298,167 @@ class RestAPI:
                 "deleted": deleted, "total": deleted, "failures": [],
                 "batches": 1, "version_conflicts": 0, "noops": 0,
                 "retries": {"bulk": 0, "search": 0}}
+
+    def h_explain(self, params, body, index, id):
+        """Score explanation for one document (reference:
+        ``RestExplainAction`` → ``TransportExplainAction``): the query
+        executes against the owning segment and the per-top-level-clause
+        contributions are reported (the dense execution model scores whole
+        segments; the per-doc breakdown gathers each clause's score at the
+        doc)."""
+        from ..search.query_dsl import parse_query
+        svc = self.indices.get(index)
+        payload = _json_body(body)
+        query_spec = payload.get("query") or {"match_all": {}}
+        searcher = svc.searcher()
+        target = None
+        for seg_idx, seg in enumerate(searcher.segments):
+            d = seg.find_doc(id)
+            if d is not None:
+                target = (seg_idx, seg, d)
+                break
+        if target is None:
+            return 404, {"_index": index, "_id": id, "matched": False,
+                         "error": f"document [{id}] does not exist"}
+        seg_idx, seg, d = target
+        query = parse_query(query_spec)
+        scores, mask = query.execute(searcher.ctx, seg)
+        matched = bool(np.asarray(mask)[d]) and bool(seg.live[d])
+        value = float(np.asarray(scores)[d]) if matched else 0.0
+        details = []
+        if isinstance(query_spec, dict) and "bool" in query_spec:
+            for section in ("must", "should", "filter"):
+                clauses = query_spec["bool"].get(section) or []
+                if isinstance(clauses, dict):
+                    clauses = [clauses]
+                for c in clauses:
+                    cs, cm = parse_query(c).execute(searcher.ctx, seg)
+                    if bool(np.asarray(cm)[d]):
+                        details.append({
+                            "value": float(np.asarray(cs)[d]),
+                            "description": f"{section} clause: "
+                                           f"{json.dumps(c)}",
+                            "details": []})
+        return {"_index": index, "_id": id, "matched": matched,
+                "explanation": {
+                    "value": value,
+                    "description": ("sum of:" if details else
+                                    f"query: {json.dumps(query_spec)}"),
+                    "details": details}}
+
+    def h_termvectors(self, params, body, index, id):
+        """Term vectors of one doc's text fields (reference:
+        ``RestTermVectorsAction``): term freq, positions, and (with
+        ``term_statistics=true``) df/ttf from the shard stats."""
+        svc = self.indices.get(index)
+        searcher = svc.searcher()
+        want_stats = params.get("term_statistics") in ("true", "")
+        fields_filter = params.get("fields")
+        wanted = set(fields_filter.split(",")) if fields_filter else None
+        for seg in searcher.segments:
+            d = seg.find_doc(id)
+            if d is None:
+                continue
+            tv = {}
+            for fname, f in seg.text_fields.items():
+                if wanted is not None and fname not in wanted:
+                    continue
+                terms_out = {}
+                for term, tid in f.term_ids.items():
+                    st, ln, df = f.term_run(term)
+                    run = f.docs_host[st: st + ln]
+                    i = int(np.searchsorted(run, d))
+                    if i >= ln or run[i] != d:
+                        continue
+                    p = st + i
+                    positions = f.pos_flat[
+                        f.pos_offsets[p]: f.pos_offsets[p + 1]]
+                    entry = {"term_freq": int(f.tf_host[p]),
+                             "tokens": [{"position": int(pos)}
+                                        for pos in positions]}
+                    if want_stats:
+                        entry["doc_freq"] = int(df)
+                        entry["ttf"] = int(f.total_term_freq[tid])
+                    terms_out[term] = entry
+                if terms_out:
+                    tv[fname] = {
+                        "field_statistics": {
+                            "sum_doc_freq": int(f.df.sum()),
+                            "doc_count": f.field_doc_count,
+                            "sum_ttf": int(f.total_term_freq.sum())},
+                        "terms": terms_out}
+            return {"_index": index, "_id": id, "found": True,
+                    "took": 0, "term_vectors": tv}
+        return 404, {"_index": index, "_id": id, "found": False}
+
+    def h_reindex(self, params, body):
+        """Copy documents between indices (reference: ``modules/reindex``
+        ``TransportReindexAction`` — scroll source + bulk dest; here one
+        snapshot scan + sequential writes)."""
+        t0 = time.time()
+        payload = _json_body(body)
+        src_spec = payload.get("source") or {}
+        dst_spec = payload.get("dest") or {}
+        if not src_spec.get("index"):
+            raise IllegalArgumentError("[source.index] is required")
+        dst_name = dst_spec.get("index")
+        if not dst_name:
+            raise IllegalArgumentError("[dest.index] is required")
+        src_names = self.indices.resolve(src_spec.get("index"))
+        query = src_spec.get("query")
+        created = updated = total = 0
+        dst = self._get_or_autocreate(dst_name)
+        task_id = self._register_task("indices:data/write/reindex")
+        for sname in src_names:
+            svc = self.indices.get(sname)
+            svc.refresh()
+            searcher = svc.searcher()
+            res = searcher.search({
+                "query": query or {"match_all": {}},
+                "size": self.SCROLL_MAX_DOCS})
+            for h in res.hits:
+                total += 1
+                r = dst.index_doc(h.doc_id, h.source)
+                if r.created:
+                    created += 1
+                else:
+                    updated += 1
+        if params.get("refresh") in ("true", ""):
+            dst.refresh()
+        self._complete_task(task_id)
+        return {"took": int((time.time() - t0) * 1000), "timed_out": False,
+                "total": total, "created": created, "updated": updated,
+                "deleted": 0, "batches": 1, "noops": 0,
+                "version_conflicts": 0, "failures": []}
+
+    def _register_task(self, action: str) -> str:
+        tid = f"{self.node_id}:{len(self._tasks) + 1}"
+        self._tasks[tid] = {"node": self.node_id, "id": len(self._tasks) + 1,
+                            "action": action, "start_time_in_millis":
+                                int(time.time() * 1000),
+                            "running": True, "cancellable": False}
+        return tid
+
+    def _complete_task(self, tid: str) -> None:
+        t = self._tasks.get(tid)
+        if t:
+            t["running"] = False
+            t["running_time_in_nanos"] = (
+                int(time.time() * 1000) - t["start_time_in_millis"]) * 10**6
+
+    def h_tasks(self, params, body):
+        """Task management API (reference: ``RestListTasksAction`` — the
+        synchronous execution model means tasks complete within their
+        request; the registry records recent long-running actions)."""
+        import fnmatch
+        actions = params.get("actions")
+        tasks = {tid: t for tid, t in self._tasks.items()
+                 if actions is None or any(
+                     fnmatch.fnmatchcase(t["action"], pat)
+                     for pat in actions.split(","))}
+        return {"nodes": {self.node_id: {
+            "name": self.node_name,
+            "tasks": tasks}}}
 
     def h_update_by_query(self, params, body, index):
         t0 = time.time()

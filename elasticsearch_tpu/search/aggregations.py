@@ -1263,3 +1263,9 @@ _AGG_PARSERS = {
     "derivative": DerivativeAgg,
     "bucket_script": BucketScriptAgg,
 }
+
+# composite / significant_terms / rare_terms / sampler / nested /
+# reverse_nested live in aggs_extra.py; it registers itself into
+# _AGG_PARSERS at its own module bottom, which keeps BOTH import orders
+# safe (importing aggs_extra first re-enters here only to bind names)
+from . import aggs_extra as _aggs_extra      # noqa: E402, F401

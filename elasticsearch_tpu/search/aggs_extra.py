@@ -1,0 +1,467 @@
+"""Additional bucket aggregations: composite, significant/rare terms,
+sampler, nested/reverse_nested.
+
+Reference counterparts:
+
+- ``bucket/composite/CompositeAggregator.java`` — paginable multi-source
+  buckets ordered by the natural source tuple order with ``after`` keys;
+  here each source materializes a per-doc key column, the tuple key set
+  builds vectorized per segment, and the reduce slices the globally-sorted
+  tuple space (exact pagination, no coordinator approximation needed
+  because partials carry every tuple past the cursor up to ``size`` per
+  segment... sized by the same bound the reference uses).
+- ``bucket/terms/SignificantTermsAggregator`` — foreground vs background
+  counts scored by JLH (default) / chi-square / GND-style mutual
+  information. Background = the whole shard (or a ``background_filter``).
+- ``bucket/terms/RareTermsAggregator`` — long-tail terms with doc count
+  at/below ``max_doc_count`` (exact per shard, merged exactly because
+  partials keep full counts).
+- ``bucket/sampler/SamplerAggregator`` — restrict sub-aggregations to the
+  top ``shard_size`` scoring docs per shard.
+- ``bucket/nested/NestedAggregator`` + ``ReverseNestedAggregator`` — hop
+  the mask between the parent doc space and a nested path's hidden child
+  docs (block-join arrays from ``index/segment.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.errors import IllegalArgumentError, ParsingError
+from .aggregations import (Aggregator, BucketAggregator, _bucket_payload,
+                           _keyword_pairs, _numeric_pairs, _reduce_subs,
+                           _sub_results)
+
+
+# ---------------------------------------------------------------------------
+# composite
+# ---------------------------------------------------------------------------
+
+
+def _composite_interval(kind: str, cfg: dict) -> float:
+    """Resolve a histogram/date_histogram source's bucket width in value
+    space (millis for dates), accepting the ES interval spellings."""
+    from .aggregations import _CALENDAR_INTERVALS, _parse_fixed_interval
+    try:
+        if kind == "histogram":
+            return float(cfg["interval"])
+        for key in ("fixed_interval", "interval"):
+            v = cfg.get(key)
+            if v is None:
+                continue
+            if isinstance(v, (int, float)):
+                return float(v)
+            return _parse_fixed_interval(str(v))
+        cal = cfg.get("calendar_interval")
+        if cal is not None:
+            # calendar units approximate to fixed widths in the composite
+            # key space (the reference's composite rounds the same way for
+            # fixed units; month/year calendar rounding is approximated)
+            unit = _CALENDAR_INTERVALS.get(cal, cal)
+            return {"s": 1e3, "m": 6e4, "h": 3.6e6, "d": 8.64e7,
+                    "w": 6.048e8, "M": 2.592e9, "q": 7.776e9,
+                    "y": 3.1536e10}[unit]
+        raise KeyError("interval")
+    except (KeyError, TypeError, ValueError) as e:
+        raise ParsingError(
+            f"[composite] invalid interval for a [{kind}] source: "
+            f"{cfg}") from e
+
+
+class CompositeAgg(BucketAggregator):
+    """Paginable multi-source buckets."""
+
+    def __init__(self, body: dict):
+        sources = body.get("sources")
+        if not isinstance(sources, list) or not sources:
+            raise ParsingError("[composite] requires a non-empty [sources]")
+        self.sources = []
+        for s in sources:
+            if not isinstance(s, dict) or len(s) != 1:
+                raise ParsingError(
+                    "[composite] each source must be {name: {type: ...}}")
+            (name, spec), = s.items()
+            kinds = [k for k in ("terms", "histogram", "date_histogram")
+                     if k in spec]
+            if len(kinds) != 1:
+                raise ParsingError(
+                    f"[composite] source [{name}] must define exactly one "
+                    f"of terms/histogram/date_histogram")
+            kind = kinds[0]
+            cfg = spec[kind]
+            self.sources.append({
+                "name": name, "kind": kind,
+                "field": cfg.get("field"),
+                "interval": (_composite_interval(kind, cfg)
+                             if kind != "terms" else None),
+                "order": cfg.get("order", "asc"),
+            })
+        self.size = int(body.get("size", 10))
+        self.after = body.get("after")
+
+    # -- per-source key columns ---------------------------------------------
+
+    def _key_column(self, seg, src) -> np.ndarray:
+        """object[n_docs] per-doc key (first value; None = missing,
+        excluded like the reference default)."""
+        n = seg.n_docs
+        col = np.full(n, None, dtype=object)
+        if src["kind"] == "terms":
+            kw = _keyword_pairs(seg, src["field"])
+            if kw is not None:
+                docs, ords, terms = kw
+                for d, o in zip(docs[::-1], ords[::-1]):
+                    col[int(d)] = terms[int(o)]
+                return col
+        num = _numeric_pairs(seg, src["field"])
+        if num is not None:
+            docs, vals = num
+            if src["kind"] == "terms":
+                for d, v in zip(docs[::-1], vals[::-1]):
+                    col[int(d)] = float(v)
+            else:
+                iv = src["interval"]
+                for d, v in zip(docs[::-1], vals[::-1]):
+                    col[int(d)] = float(np.floor(v / iv) * iv)
+        return col
+
+    def collect(self, ctx, seg, mask):
+        docs_mask = mask[: seg.n_docs].copy()
+        cols = [self._key_column(seg, s) for s in self.sources]
+        for c in cols:
+            docs_mask &= np.asarray([v is not None for v in c])
+        idx = np.flatnonzero(docs_mask)
+        buckets: Dict[tuple, Tuple[int, dict]] = {}
+        by_key_docs: Dict[tuple, List[int]] = {}
+        for d in idx:
+            key = tuple(c[d] for c in cols)
+            by_key_docs.setdefault(key, []).append(int(d))
+        for key, ds in by_key_docs.items():
+            if self.subs:
+                bm = np.zeros(mask.shape[0], bool)
+                bm[ds] = True
+                buckets[key] = _bucket_payload(self, ctx, seg, bm)
+            else:
+                buckets[key] = (len(ds), {})
+        return buckets
+
+    def _tuple_sort_key(self, key: tuple):
+        parts = []
+        for v, src in zip(key, self.sources):
+            desc = src["order"] == "desc"
+            if isinstance(v, str):
+                parts.append((1, _RevStr(v) if desc else v))
+            else:
+                parts.append((0, -float(v) if desc else float(v)))
+        return tuple(parts)
+
+    def reduce(self, partials):
+        merged: Dict[tuple, List] = {}
+        for p in partials:
+            for key, item in p.items():
+                merged.setdefault(key, []).append(item)
+        keys = sorted(merged, key=self._tuple_sort_key)
+        if self.after is not None:
+            missing = [s["name"] for s in self.sources
+                       if s["name"] not in self.after]
+            if missing:
+                raise ParsingError(
+                    f"[composite] after key is missing sources {missing}")
+            after_key = tuple(self.after[s["name"]] for s in self.sources)
+            ak = self._tuple_sort_key(after_key)
+            keys = [k for k in keys if self._tuple_sort_key(k) > ak]
+        page = keys[: self.size]
+        buckets = []
+        for key in page:
+            items = merged[key]
+            count = sum(c for c, _ in items)
+            b = {"key": {s["name"]: v
+                         for s, v in zip(self.sources, key)},
+                 "doc_count": count}
+            if self.subs:
+                b.update(_reduce_subs(self, [s for _, s in items]))
+            buckets.append(b)
+        out = {"buckets": buckets}
+        if page:
+            out["after_key"] = {s["name"]: v
+                                for s, v in zip(self.sources, page[-1])}
+        return out
+
+
+class _RevStr:
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+    def __gt__(self, other):
+        return other.v > self.v
+
+
+# ---------------------------------------------------------------------------
+# significant_terms / rare_terms
+# ---------------------------------------------------------------------------
+
+
+def _jlh(fg, fg_total, bg, bg_total) -> float:
+    if fg == 0 or fg_total == 0 or bg_total == 0:
+        return 0.0
+    fg_pct = fg / fg_total
+    bg_pct = bg / bg_total if bg_total else 0.0
+    if fg_pct <= bg_pct or bg_pct == 0:
+        return 0.0
+    return (fg_pct - bg_pct) * (fg_pct / bg_pct)
+
+
+def _chi_square(fg, fg_total, bg, bg_total) -> float:
+    # 2x2 contingency chi-square with the reference's
+    # include_negatives=false default
+    a, b = fg, bg - fg if bg >= fg else 0
+    c, d = fg_total - fg, max(bg_total - bg - (fg_total - fg), 0)
+    n = a + b + c + d
+    if n == 0 or (a + b) == 0 or (c + d) == 0 or (a + c) == 0 or \
+            (b + d) == 0:
+        return 0.0
+    num = n * (a * d - b * c) ** 2
+    den = (a + b) * (c + d) * (a + c) * (b + d)
+    score = num / den
+    if (a / (a + c) if a + c else 0) < (b / (b + d) if b + d else 0):
+        return 0.0
+    return score
+
+
+class SignificantTermsAgg(BucketAggregator):
+    def __init__(self, body: dict):
+        self.field = body.get("field")
+        if self.field is None:
+            raise ParsingError("significant_terms requires [field]")
+        self.size = int(body.get("size", 10))
+        self.min_doc_count = int(body.get("min_doc_count", 3))
+        self.heuristic = "chi_square" if "chi_square" in body else "jlh"
+        self.background_filter = body.get("background_filter")
+
+    def collect(self, ctx, seg, mask):
+        kw = _keyword_pairs(seg, self.field)
+        if kw is None:
+            # field-less segment: its docs still belong to both the
+            # foreground and the background populations
+            return {"fg_total": int(mask[: seg.n_docs].sum()),
+                    "bg_total": int(_live_parents(
+                        seg, mask.shape[0])[: seg.n_docs].sum()),
+                    "terms": {}}
+        docs, ords, terms = kw
+        fg_mask = mask
+        if self.background_filter is not None:
+            from .query_dsl import parse_query
+            _, bgm = parse_query(self.background_filter).execute(
+                ctx.shard_ctx, seg)
+            bg_mask = np.asarray(bgm)[: mask.shape[0]] & \
+                _live_parents(seg, mask.shape[0])
+        else:
+            bg_mask = _live_parents(seg, mask.shape[0])
+        pm_fg = fg_mask[docs]
+        pm_bg = bg_mask[docs]
+        fg_ords, fg_counts = np.unique(ords[pm_fg], return_counts=True)
+        bg_ords, bg_counts = np.unique(ords[pm_bg], return_counts=True)
+        bg_of = dict(zip(bg_ords.tolist(), bg_counts.tolist()))
+        t = {}
+        for o, c in zip(fg_ords.tolist(), fg_counts.tolist()):
+            t[terms[o]] = (c, bg_of.get(o, 0))
+        return {"fg_total": int(fg_mask[: seg.n_docs].sum()),
+                "bg_total": int(bg_mask[: seg.n_docs].sum()),
+                "terms": t}
+
+    def reduce(self, partials):
+        fg_total = sum(p["fg_total"] for p in partials)
+        bg_total = sum(p["bg_total"] for p in partials)
+        merged: Dict[str, List[int]] = {}
+        for p in partials:
+            for term, (fg, bg) in p["terms"].items():
+                cur = merged.setdefault(term, [0, 0])
+                cur[0] += fg
+                cur[1] += bg
+        score_fn = _chi_square if self.heuristic == "chi_square" else _jlh
+        rows = []
+        for term, (fg, bg) in merged.items():
+            if fg < self.min_doc_count:
+                continue
+            score = score_fn(fg, fg_total, bg, bg_total)
+            if score > 0:
+                rows.append((score, term, fg, bg))
+        rows.sort(key=lambda r: (-r[0], r[1]))
+        return {"doc_count": fg_total,
+                "bg_count": bg_total,
+                "buckets": [{"key": t, "doc_count": fg, "score": s,
+                             "bg_count": bg}
+                            for s, t, fg, bg in rows[: self.size]]}
+
+
+class RareTermsAgg(BucketAggregator):
+    def __init__(self, body: dict):
+        self.field = body.get("field")
+        if self.field is None:
+            raise ParsingError("rare_terms requires [field]")
+        self.max_doc_count = int(body.get("max_doc_count", 1))
+        if not 1 <= self.max_doc_count <= 100:
+            raise IllegalArgumentError(
+                "[max_doc_count] must be in [1, 100]")
+
+    def collect(self, ctx, seg, mask):
+        kw = _keyword_pairs(seg, self.field)
+        buckets: Dict[Any, int] = {}
+        if kw is not None:
+            docs, ords, terms = kw
+            pm = mask[docs]
+            sel, counts = np.unique(ords[pm], return_counts=True)
+            for o, c in zip(sel.tolist(), counts.tolist()):
+                buckets[terms[o]] = c
+        else:
+            num = _numeric_pairs(seg, self.field, ctx.mapper)
+            if num is not None:
+                docs, vals = num
+                pm = mask[docs]
+                sel, counts = np.unique(vals[pm], return_counts=True)
+                for v, c in zip(sel.tolist(), counts.tolist()):
+                    buckets[v] = c
+        return buckets
+
+    def reduce(self, partials):
+        merged: Dict[Any, int] = {}
+        for p in partials:
+            for term, c in p.items():
+                merged[term] = merged.get(term, 0) + c
+        rows = [(t, c) for t, c in merged.items()
+                if c <= self.max_doc_count]
+        rows.sort(key=lambda r: (r[1], str(r[0])))
+        return {"buckets": [{"key": t, "doc_count": c} for t, c in rows]}
+
+
+# ---------------------------------------------------------------------------
+# sampler
+# ---------------------------------------------------------------------------
+
+
+class SamplerAgg(BucketAggregator):
+    """Sub-aggregations over only the top ``shard_size`` scoring docs per
+    shard (needs per-segment scores from the query phase)."""
+
+    def __init__(self, body: dict):
+        self.shard_size = int(body.get("shard_size", 100))
+
+    def collect(self, ctx, seg, mask):
+        scores = ctx.seg_scores.get(seg.seg_id)
+        docs_mask = mask[: seg.n_docs]
+        idx = np.flatnonzero(docs_mask)
+        if scores is not None and idx.size > self.shard_size:
+            sc = scores[: seg.n_docs][idx]
+            keep = idx[np.argsort(-sc, kind="stable")[: self.shard_size]]
+        else:
+            keep = idx[: self.shard_size]
+        sm = np.zeros(mask.shape[0], bool)
+        sm[keep] = True
+        return (int(sm.sum()), _sub_results(self, ctx, seg, sm))
+
+    def reduce(self, partials):
+        count = sum(c for c, _ in partials)
+        out = {"doc_count": count}
+        out.update(_reduce_subs(self, [s for _, s in partials]))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# nested / reverse_nested
+# ---------------------------------------------------------------------------
+
+
+def _live_parents(seg, n) -> np.ndarray:
+    m = np.zeros(n, bool)
+    m[: seg.n_docs] = seg.live
+    if seg.has_nested:
+        m[: seg.n_docs] &= seg.parent_mask
+    return m
+
+
+class NestedAgg(BucketAggregator):
+    """Hop the mask from parent docs DOWN to their ``path`` children:
+    sub-aggregations then run in the child doc space, where the
+    ``path.field`` doc values live."""
+
+    def __init__(self, body: dict):
+        self.path = body.get("path")
+        if self.path is None:
+            raise ParsingError("nested aggregation requires [path]")
+
+    def collect(self, ctx, seg, mask):
+        n = mask.shape[0]
+        child_mask = np.zeros(n, bool)
+        pm = seg.nested_paths.get(self.path)
+        if pm is not None:
+            child_idx = np.flatnonzero(pm & seg.live[: seg.n_docs])
+            parents = seg.parent_of[child_idx]
+            keep = mask[parents]
+            child_mask[child_idx[keep]] = True
+        return (int(child_mask.sum()),
+                _sub_results(self, ctx, seg, child_mask))
+
+    def reduce(self, partials):
+        count = sum(c for c, _ in partials)
+        out = {"doc_count": count}
+        out.update(_reduce_subs(self, [s for _, s in partials]))
+        return out
+
+
+class ReverseNestedAgg(BucketAggregator):
+    """Inside a ``nested`` agg: hop the (child-space) mask back UP to the
+    parent documents."""
+
+    def __init__(self, body: dict):
+        self.path = body.get("path")     # None → all the way to the root
+
+    def collect(self, ctx, seg, mask):
+        n = mask.shape[0]
+        up = np.zeros(n, bool)
+        idx = np.flatnonzero(mask[: seg.n_docs])
+        if idx.size:
+            parents = idx.copy()
+            # climb until the target level: root (parent_mask) or the
+            # docs belonging to self.path
+            target = (seg.nested_paths.get(self.path)
+                      if self.path is not None else None)
+            for _ in range(8):           # nesting depth bound
+                at_target = seg.parent_mask[parents] if target is None \
+                    else target[parents]
+                done = parents[at_target]
+                up[done] = True
+                rest = parents[~at_target]
+                if rest.size == 0:
+                    break
+                parents = seg.parent_of[rest]
+        return (int(up.sum()), _sub_results(self, ctx, seg, up))
+
+    def reduce(self, partials):
+        count = sum(c for c, _ in partials)
+        out = {"doc_count": count}
+        out.update(_reduce_subs(self, [s for _, s in partials]))
+        return out
+
+
+# self-registration: runs after this module's classes exist, against the
+# fully-initialized (or at least _AGG_PARSERS-bearing) aggregations module
+from .aggregations import _AGG_PARSERS      # noqa: E402
+
+_AGG_PARSERS.update({
+    "composite": CompositeAgg,
+    "significant_terms": SignificantTermsAgg,
+    "rare_terms": RareTermsAgg,
+    "sampler": SamplerAgg,
+    "nested": NestedAgg,
+    "reverse_nested": ReverseNestedAgg,
+})

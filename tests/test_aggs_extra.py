@@ -1,0 +1,182 @@
+"""composite, significant_terms, rare_terms, sampler, nested/reverse_nested
+aggregations. Reference behaviors: ``bucket/composite/``,
+``SignificantTermsAggregator`` (JLH/chi-square), ``RareTermsAggregator``,
+``SamplerAggregator``, ``NestedAggregator``/``ReverseNestedAggregator``."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+MAPPING = {"properties": {
+    "body": {"type": "text"},
+    "cat": {"type": "keyword"},
+    "store": {"type": "keyword"},
+    "price": {"type": "double"},
+    "comments": {"type": "nested", "properties": {
+        "author": {"type": "keyword"},
+        "stars": {"type": "integer"}}},
+}}
+
+ROWS = [
+    ("1", "error disk full crash", "sys", "north", 10,
+     [{"author": "kim", "stars": 5}]),
+    ("2", "error net down crash", "sys", "south", 20,
+     [{"author": "kim", "stars": 2}, {"author": "lee", "stars": 4}]),
+    ("3", "all good ok fine", "app", "north", 30, []),
+    ("4", "all quiet ok", "app", "south", 10, [{"author": "zoe",
+                                                "stars": 1}]),
+    ("5", "error crash boom", "sys", "north", 20, []),
+    ("6", "routine ok normal", "app", "north", 40, []),
+    ("7", "one rare gem here", "gem", "south", 10, []),
+]
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    mapper = MapperService(MAPPING)
+    segs = []
+    for half in (ROWS[:4], ROWS[4:]):
+        b = SegmentBuilder(f"_x{len(segs)}")
+        for i, (did, body, cat, store, price, comments) in enumerate(half):
+            b.add(mapper.parse_document(did, {
+                "body": body, "cat": cat, "store": store, "price": price,
+                "comments": comments}), seq_no=i)
+        segs.append(b.build())
+    return ShardSearcher(segs, mapper)
+
+
+def agg(searcher, aggs, query=None):
+    body = {"aggs": aggs, "size": 0}
+    if query:
+        body["query"] = query
+    return searcher.search(body).aggregations
+
+
+def test_composite_pagination(searcher):
+    spec = {"c": {"composite": {"size": 3, "sources": [
+        {"st": {"terms": {"field": "store"}}},
+        {"pr": {"histogram": {"field": "price", "interval": 20}}}]}}}
+    r1 = agg(searcher, spec)["c"]
+    assert len(r1["buckets"]) == 3
+    keys = [(b["key"]["st"], b["key"]["pr"]) for b in r1["buckets"]]
+    assert keys == sorted(keys)          # natural tuple order
+    # page 2 via after_key; union covers every (store, bucket) pair
+    spec2 = {"c": {"composite": {"size": 10, "after": r1["after_key"],
+                                 "sources": [
+        {"st": {"terms": {"field": "store"}}},
+        {"pr": {"histogram": {"field": "price", "interval": 20}}}]}}}
+    r2 = agg(searcher, spec2)["c"]
+    keys2 = [(b["key"]["st"], b["key"]["pr"]) for b in r2["buckets"]]
+    assert not (set(keys) & set(keys2))
+    total_docs = sum(b["doc_count"]
+                     for b in r1["buckets"] + r2["buckets"])
+    assert total_docs == len(ROWS)
+    # sub-agg on composite buckets
+    spec3 = {"c": {"composite": {"size": 10, "sources": [
+        {"st": {"terms": {"field": "store"}}}]},
+        "aggs": {"p": {"avg": {"field": "price"}}}}}
+    r3 = agg(searcher, spec3)["c"]
+    north = next(b for b in r3["buckets"] if b["key"]["st"] == "north")
+    assert north["doc_count"] == 4 and north["p"]["value"] == 25.0
+
+
+def test_significant_terms(searcher):
+    r = agg(searcher, {"sig": {"significant_terms": {
+        "field": "cat", "min_doc_count": 1}}},
+        query={"match": {"body": "error"}})["sig"]
+    assert r["doc_count"] == 3
+    assert r["buckets"], "no significant terms surfaced"
+    top = r["buckets"][0]
+    assert top["key"] == "sys"           # 'sys' is 3/3 fg vs 3/7 bg
+    assert top["doc_count"] == 3 and top["score"] > 0
+    # 'app' never co-occurs with error → absent
+    assert all(b["key"] != "app" for b in r["buckets"])
+    # chi_square heuristic also ranks sys first
+    r = agg(searcher, {"sig": {"significant_terms": {
+        "field": "cat", "min_doc_count": 1, "chi_square": {}}}},
+        query={"match": {"body": "error"}})["sig"]
+    assert r["buckets"][0]["key"] == "sys"
+
+
+def test_rare_terms(searcher):
+    r = agg(searcher, {"rare": {"rare_terms": {"field": "cat"}}})["rare"]
+    assert [b["key"] for b in r["buckets"]] == ["gem"]
+    r = agg(searcher, {"rare": {"rare_terms": {
+        "field": "cat", "max_doc_count": 3}}})["rare"]
+    assert sorted(b["key"] for b in r["buckets"]) == ["app", "gem", "sys"]
+    # a term split 2+1 across segments must NOT look rare at max=1
+    # ('sys' is 3 total: 2 in seg0 + 1 in seg1)
+    r = agg(searcher, {"rare": {"rare_terms": {
+        "field": "cat", "max_doc_count": 2}}})["rare"]
+    assert all(b["key"] != "sys" for b in r["buckets"])
+
+
+def test_sampler(searcher):
+    r = searcher.search({
+        "query": {"match": {"body": "error crash"}},
+        "size": 0,
+        "aggs": {"s": {"sampler": {"shard_size": 1}, "aggs": {
+            "cats": {"terms": {"field": "cat"}}}}}})
+    s = r.aggregations["s"]
+    # one doc sampled per segment (2 segments with matches)
+    assert s["doc_count"] == 2
+    assert sum(b["doc_count"] for b in s["cats"]["buckets"]) == 2
+
+
+def test_nested_and_reverse_nested_aggs(searcher):
+    r = agg(searcher, {"cm": {"nested": {"path": "comments"}, "aggs": {
+        "authors": {"terms": {"field": "comments.author"}},
+        "avg_stars": {"avg": {"field": "comments.stars"}}}}})["cm"]
+    assert r["doc_count"] == 4           # 4 comment docs in total
+    authors = {b["key"]: b["doc_count"] for b in r["authors"]["buckets"]}
+    assert authors == {"kim": 2, "lee": 1, "zoe": 1}
+    assert r["avg_stars"]["value"] == 3.0
+    # reverse_nested: back to parents per author
+    r = agg(searcher, {"cm": {"nested": {"path": "comments"}, "aggs": {
+        "authors": {"terms": {"field": "comments.author"}, "aggs": {
+            "back": {"reverse_nested": {}, "aggs": {
+                "stores": {"terms": {"field": "store"}}}}}}}}})["cm"]
+    kim = next(b for b in r["authors"]["buckets"] if b["key"] == "kim")
+    assert kim["back"]["doc_count"] == 2
+    stores = {b["key"]: b["doc_count"]
+              for b in kim["back"]["stores"]["buckets"]}
+    assert stores == {"north": 1, "south": 1}
+    # nested agg under a query: only matching parents' comments count
+    r = agg(searcher, {"cm": {"nested": {"path": "comments"}, "aggs": {
+        "n": {"value_count": {"field": "comments.stars"}}}}},
+        query={"term": {"store": "south"}})["cm"]
+    assert r["doc_count"] == 3           # doc2's two + doc4's one
+
+
+def test_composite_date_histogram_source(searcher):
+    # docs have no date field in this fixture — use a fresh one
+    mapper = MapperService({"properties": {"ts": {"type": "date"},
+                                           "k": {"type": "keyword"}}})
+    b = SegmentBuilder("_d0")
+    for i, day in enumerate(["2024-01-01", "2024-01-01", "2024-01-02",
+                             "2024-01-05"]):
+        b.add(mapper.parse_document(str(i), {"ts": day, "k": "x"}),
+              seq_no=i)
+    s = ShardSearcher([b.build()], mapper)
+    r = s.search({"size": 0, "aggs": {"c": {"composite": {
+        "size": 10, "sources": [{"d": {"date_histogram": {
+            "field": "ts", "fixed_interval": "1d"}}}]}}}})
+    buckets = r.aggregations["c"]["buckets"]
+    assert [b_["doc_count"] for b_ in buckets] == [2, 1, 1]
+    assert buckets[0]["key"]["d"] == 1704067200000.0   # 2024-01-01 UTC
+    # bad interval is a 400-class parse error, not a raw crash
+    import pytest as _pytest
+    from elasticsearch_tpu.common.errors import ParsingError
+    with _pytest.raises(ParsingError):
+        s.search({"size": 0, "aggs": {"c": {"composite": {
+            "sources": [{"h": {"histogram": {"field": "ts",
+                                             "interval": "abc"}}}]}}}})
+    # stale after key missing a source name → parse error, not KeyError
+    with _pytest.raises(ParsingError):
+        s.search({"size": 0, "aggs": {"c": {"composite": {
+            "size": 2, "after": {"nope": 1}, "sources": [{"d": {
+                "date_histogram": {"field": "ts",
+                                   "fixed_interval": "1d"}}}]}}}})

@@ -267,3 +267,63 @@ def test_malformed_json_body_is_es_shaped_error(api):
     status, resp = req(api, "POST", "/i/_search", "{not json")
     assert status == 400
     assert "error" in resp
+
+
+# ---------------------------------------------------------------------------
+# explain / termvectors / reindex / tasks
+# ---------------------------------------------------------------------------
+
+
+def test_explain(api):
+    req(api, "PUT", "/e/_doc/1", {"t": "alpha beta", "n": 5})
+    req(api, "PUT", "/e/_doc/2", {"t": "gamma", "n": 1})
+    req(api, "POST", "/e/_refresh")
+    st, out = req(api, "POST", "/e/_explain/1", {"query": {"bool": {
+        "must": [{"match": {"t": "alpha"}}],
+        "filter": [{"range": {"n": {"gte": 2}}}]}}})
+    assert st == 200 and out["matched"] is True
+    assert out["explanation"]["value"] > 0
+    assert len(out["explanation"]["details"]) == 2
+    st, out = req(api, "POST", "/e/_explain/2", {"query": {
+        "match": {"t": "alpha"}}})
+    assert out["matched"] is False
+    st, out = req(api, "POST", "/e/_explain/ghost", {"query": {
+        "match_all": {}}})
+    assert st == 404
+
+
+def test_termvectors(api):
+    req(api, "PUT", "/tv/_doc/1", {"t": "hello world hello"})
+    req(api, "POST", "/tv/_refresh")
+    st, out = req(api, "GET", "/tv/_termvectors/1",
+                  query="term_statistics=true")
+    assert st == 200 and out["found"]
+    terms = out["term_vectors"]["t"]["terms"]
+    assert terms["hello"]["term_freq"] == 2
+    assert [tok["position"] for tok in terms["hello"]["tokens"]] == [0, 2]
+    assert terms["world"]["doc_freq"] == 1
+    st, out = req(api, "GET", "/tv/_termvectors/nope")
+    assert st == 404
+
+
+def test_reindex_and_tasks(api):
+    for i in range(6):
+        req(api, "PUT", f"/src_ix/_doc/{i}",
+            {"v": i, "tag": "keep" if i % 2 else "drop"})
+    req(api, "POST", "/src_ix/_refresh")
+    st, out = req(api, "POST", "/_reindex", {
+        "source": {"index": "src_ix", "query": {"term": {"tag": "keep"}}},
+        "dest": {"index": "dst_ix"}}, query="refresh=true")
+    assert st == 200 and out["created"] == 3 and out["total"] == 3
+    st, out = req(api, "POST", "/dst_ix/_search",
+                  {"query": {"match_all": {}}})
+    assert out["hits"]["total"]["value"] == 3
+    # re-run: same docs update instead of create
+    st, out = req(api, "POST", "/_reindex", {
+        "source": {"index": "src_ix", "query": {"term": {"tag": "keep"}}},
+        "dest": {"index": "dst_ix"}})
+    assert out["updated"] == 3 and out["created"] == 0
+    st, out = req(api, "GET", "/_tasks")
+    node_tasks = list(out["nodes"].values())[0]["tasks"]
+    assert any(t["action"] == "indices:data/write/reindex"
+               for t in node_tasks.values())
