@@ -119,3 +119,8 @@ class PipelineError(ElasticsearchError):
 class ResourceNotFoundError(ElasticsearchError):
     status = 404
     error_type = "resource_not_found_exception"
+
+
+class IndexClosedError(ElasticsearchError):
+    status = 400
+    error_type = "index_closed_exception"

@@ -166,6 +166,27 @@ def parse_date_millis(value: Any, fmt: str = "strict_date_optional_time||epoch_m
         raise MapperParsingError(f"failed to parse date field [{value}]") from e
 
 
+def _looks_date(s: str) -> bool:
+    if not (_DATE_YMD_RE.match(s) or
+            re.match(r"^\d{4}-\d{2}-\d{2}[T ]\d{2}:", s)):
+        return False
+    try:
+        parse_date_millis(s)            # detection VALIDATES by parsing
+        return True
+    except MapperParsingError:
+        return False
+
+
+def _looks_iso_datetime(s: str) -> bool:
+    if not re.match(r"^\d{4}-\d{2}-\d{2}[T ]\d{2}:", s):
+        return False
+    try:
+        parse_date_millis(s)
+        return True
+    except MapperParsingError:
+        return False
+
+
 def format_date_millis(millis: float) -> str:
     d = _EPOCH + _dt.timedelta(milliseconds=millis)
     return d.strftime("%Y-%m-%dT%H:%M:%S.") + f"{d.microsecond // 1000:03d}Z"
@@ -537,15 +558,19 @@ class MapperService:
                 raise MapperParsingError(f"no type specified for field [{full}]")
             existing = self._fields.get(full)
             if existing is not None and existing.type_name != ftype and not (
-                    ftype == "object" and existing.type_name == "object"):
+                    ftype == "object" and
+                    existing.type_name in ("object", "nested")):
                 raise IllegalArgumentError(
                     f"mapper [{full}] cannot be changed from type "
                     f"[{existing.type_name}] to [{ftype}]")
             if ftype == "object" or ftype == "nested":
-                self._fields[full] = (
-                    NestedFieldType(full, {"type": "nested"})
-                    if ftype == "nested"
-                    else ObjectFieldType(full, {"type": ftype}))
+                if ftype == "nested" or not isinstance(
+                        existing, NestedFieldType):
+                    # dynamic "object" updates never demote a nested field
+                    self._fields[full] = (
+                        NestedFieldType(full, {"type": "nested"})
+                        if ftype == "nested"
+                        else ObjectFieldType(full, {"type": ftype}))
                 self._merge_properties(f"{full}.", spec.get("properties", {}))
                 continue
             self._fields[full] = self._build_field(full, ftype, spec)
@@ -569,7 +594,9 @@ class MapperService:
                 spec.get("normalizer") == "lowercase", params)
         if ftype in NUMERIC_TYPES:
             return NumberFieldType(name, ftype, params)
-        if ftype == "date":
+        if ftype in ("date", "date_nanos"):
+            # date_nanos maps onto the millisecond date column (documented
+            # precision reduction; the reference stores nanos in a long)
             return DateFieldType(
                 name, spec.get("format", "strict_date_optional_time||epoch_millis"),
                 params)
@@ -728,8 +755,13 @@ class MapperService:
         elif isinstance(sample, float):
             spec = {"type": "double"}
         elif isinstance(sample, str):
-            spec = {"type": "text", "fields": {"keyword": {
-                "type": "keyword", "ignore_above": 256}}}
+            # date detection (DynamicFieldsBuilder: date_detection default
+            # true for strict_date_optional_time-shaped strings)
+            if _looks_date(sample.strip()):
+                spec = {"type": "date"}
+            else:
+                spec = {"type": "text", "fields": {"keyword": {
+                    "type": "keyword", "ignore_above": 256}}}
         elif isinstance(sample, list):
             return None  # empty/odd nested list
         else:

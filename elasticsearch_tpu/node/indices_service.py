@@ -17,7 +17,8 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..common.errors import (ElasticsearchError, IllegalArgumentError,
-                             ResourceAlreadyExistsError, IndexNotFoundError)
+                             IndexClosedError, IndexNotFoundError,
+                             ResourceAlreadyExistsError)
 from ..index.engine import Engine
 from ..index.mapping import MapperService
 from ..search.shard_search import ShardSearcher, ShardSearchResult
@@ -67,6 +68,11 @@ class IndexService:
                 gc_deletes_seconds=_parse_time_seconds(
                     flat.get("index.gc_deletes", "60s"))))
         self.aliases: Dict[str, dict] = {}
+        self.closed = False
+
+    def _check_open(self) -> None:
+        if self.closed:
+            raise IndexClosedError(f"closed index [{self.name}]")
 
     # -- routing ------------------------------------------------------------
 
@@ -82,15 +88,18 @@ class IndexService:
     def index_doc(self, doc_id: str, source: dict, *,
                   routing: Optional[str] = None, op_type: str = "index",
                   if_seq_no=None, if_primary_term=None):
+        self._check_open()
         return self.shard_for_doc(doc_id, routing).index(
             doc_id, source, routing=routing, op_type=op_type,
             if_seq_no=if_seq_no, if_primary_term=if_primary_term)
 
     def get_doc(self, doc_id: str, routing: Optional[str] = None):
+        self._check_open()
         return self.shard_for_doc(doc_id, routing).get(doc_id)
 
     def delete_doc(self, doc_id: str, *, routing: Optional[str] = None,
                    if_seq_no=None, if_primary_term=None):
+        self._check_open()
         return self.shard_for_doc(doc_id, routing).delete(
             doc_id, if_seq_no=if_seq_no, if_primary_term=if_primary_term)
 
@@ -116,11 +125,13 @@ class IndexService:
             self.mapper)
 
     def search(self, body: Optional[dict] = None) -> ShardSearchResult:
+        self._check_open()
         if self.num_shards > 1:
             return self.dist_searcher().search(body or {})
         return self.searcher().search(body or {})
 
     def count(self, body: Optional[dict] = None) -> int:
+        self._check_open()
         if self.num_shards > 1:
             return self.dist_searcher().count(body or {})
         return self.searcher().count(body or {})
@@ -169,8 +180,15 @@ class IndexService:
         for key in ("index_total", "delete_total", "refresh_total",
                     "flush_total", "merge_total", "get_total"):
             ops[key] = sum(s.stats.get(key, 0) for s in self.shards)
+        tl_ops = sum(s.translog.total_operations() for s in self.shards)
+        tl_size = sum(s.translog.size_in_bytes() for s in self.shards)
         return {"docs": {"count": docs, "deleted": deleted},
                 "store": {"size_in_bytes": store},
+                "translog": {"operations": tl_ops,
+                             "size_in_bytes": tl_size,
+                             "uncommitted_operations": tl_ops,
+                             "uncommitted_size_in_bytes": tl_size,
+                             "earliest_last_modified_age": 0},
                 "segments": {"count": seg_count},
                 "indexing": {"index_total": ops["index_total"],
                              "delete_total": ops["delete_total"]},

@@ -104,6 +104,11 @@ class RestAPI:
         add("GET", "/_cluster/health", self.h_cluster_health)
         add("GET", "/_cluster/health/{index}", self.h_cluster_health)
         add("GET", "/_cluster/stats", self.h_cluster_stats)
+        add("GET", "/_cluster/state", self.h_cluster_state)
+        add("GET", "/_cluster/state/{metric}", self.h_cluster_state)
+        add("GET", "/_cluster/state/{metric}/{index}",
+            self.h_cluster_state)
+        add("GET", "/_cluster/pending_tasks", self.h_pending_tasks)
         add("GET", "/_cluster/settings", self.h_cluster_get_settings)
         add("PUT", "/_cluster/settings", self.h_cluster_put_settings)
         add("GET", "/_nodes", self.h_nodes)
@@ -122,6 +127,8 @@ class RestAPI:
         add("GET,POST", "/{index}/_search", self.h_search)
         add("GET,POST", "/_search/scroll", self.h_scroll)
         add("DELETE", "/_search/scroll", self.h_clear_scroll)
+        add("GET,POST", "/{index}/_validate/query", self.h_validate_query)
+        add("GET,POST", "/_validate/query", self.h_validate_query)
         add("GET,POST", "/_count", self.h_count)
         add("GET,POST", "/{index}/_count", self.h_count)
         add("GET,POST", "/_mget", self.h_mget)
@@ -181,8 +188,15 @@ class RestAPI:
         add("DELETE", "/{index}/_alias/{name}", self.h_delete_alias)
         # index admin
         add("GET", "/_stats", self.h_stats)
+        add("GET", "/_stats/{metric}", self.h_stats)
         add("GET", "/{index}/_stats", self.h_stats)
+        add("GET", "/{index}/_stats/{metric}", self.h_stats)
+        add("POST", "/{index}/_close", self.h_close_index)
+        add("POST", "/{index}/_open", self.h_open_index)
         add("GET,PUT", "/{index}/_mapping", self.h_mapping)
+        add("GET", "/{index}/_mapping/field/{fields}",
+            self.h_field_mapping)
+        add("GET", "/_mapping/field/{fields}", self.h_field_mapping)
         add("GET,PUT", "/{index}/_settings", self.h_settings)
         add("GET,PUT", "/_settings", self.h_settings)
         add("POST", "/{index}/_refresh", self.h_refresh)
@@ -283,6 +297,77 @@ class RestAPI:
 
     def h_cluster_health(self, params, body, index=None):
         return self._health(index)
+
+    def h_cluster_state(self, params, body, metric=None, index=None):
+        """Cluster state (reference: ``RestClusterStateAction``): the
+        single-node composition of the same sections the coordinator
+        publishes in the multi-node tier."""
+        names = self.indices.resolve(index)
+        meta_indices = {}
+        routing_table = {}
+        for n in names:
+            svc = self.indices.indices[n]
+            meta_indices[n] = {
+                "state": "close" if getattr(svc, "closed", False)
+                else "open",
+                "settings": {"index": dict(svc.settings)},
+                "mappings": svc.mapper.mapping_dict(),
+                "aliases": sorted(svc.aliases),
+            }
+            routing_table[n] = {"shards": {
+                str(s): [{"state": "STARTED", "primary": True,
+                          "node": self.node_id, "shard": s, "index": n}]
+                for s in range(svc.num_shards)}}
+        return {
+            "cluster_name": self.cluster_name,
+            "cluster_uuid": self.node_id,
+            "version": 1,
+            "state_uuid": self.node_id,
+            "master_node": self.node_id,
+            "blocks": {},
+            "nodes": {self.node_id: {"name": self.node_name,
+                                     "transport_address": "127.0.0.1:9300",
+                                     "attributes": {}}},
+            "metadata": {"cluster_uuid": self.node_id,
+                         "templates": self.templates,
+                         "indices": meta_indices},
+            "routing_table": {"indices": routing_table},
+        }
+
+    def h_pending_tasks(self, params, body):
+        return {"tasks": []}
+
+    def h_close_index(self, params, body, index):
+        names = self.indices.resolve(index)
+        for n in names:
+            self.indices.indices[n].closed = True
+        return {"acknowledged": True, "shards_acknowledged": True,
+                "indices": {n: {"closed": True} for n in names}}
+
+    def h_open_index(self, params, body, index):
+        names = self.indices.resolve(index)
+        for n in names:
+            self.indices.indices[n].closed = False
+        return {"acknowledged": True, "shards_acknowledged": True}
+
+    def h_field_mapping(self, params, body, fields, index=None):
+        """GET field mappings (reference: ``RestGetFieldMappingAction``)."""
+        names = self.indices.resolve(index)
+        want = fields.split(",")
+        out = {}
+        for n in names:
+            svc = self.indices.indices[n]
+            fmap = {}
+            for f in want:
+                import fnmatch
+                for fname, ft in svc.mapper._fields.items():
+                    if not fnmatch.fnmatchcase(fname, f):
+                        continue
+                    leaf = fname.split(".")[-1]
+                    fmap[fname] = {"full_name": fname,
+                                   "mapping": {leaf: ft.to_mapping()}}
+            out[n] = {"mappings": fmap}
+        return out
 
     def h_cluster_stats(self, params, body):
         docs = sum(sum(s.doc_count for s in svc.shards)
@@ -518,15 +603,23 @@ class RestAPI:
             self.indices.indices[n].force_merge()
         return {"_shards": {"total": 1, "successful": 1, "failed": 0}}
 
-    def h_stats(self, params, body, index=None):
+    def h_stats(self, params, body, index=None, metric=None):
         names = self.indices.resolve(index)
-        per_index = {n: {"primaries": self.indices.indices[n].stats(),
-                         "total": self.indices.indices[n].stats()}
-                     for n in names}
+        metrics = set(metric.split(",")) if metric and metric != "_all" \
+            else None
+
+        def trim(st: dict) -> dict:
+            if metrics is None:
+                return st
+            return {k: v for k, v in st.items() if k in metrics}
+
+        stats_of = {n: self.indices.indices[n].stats() for n in names}
+        per_index = {n: {"primaries": trim(stats_of[n]),
+                         "total": trim(stats_of[n])} for n in names}
         agg: Dict[str, Any] = {"docs": {"count": 0, "deleted": 0},
                                "store": {"size_in_bytes": 0}}
         for n in names:
-            st = per_index[n]["primaries"]
+            st = stats_of[n]
             agg["docs"]["count"] += st["docs"]["count"]
             agg["docs"]["deleted"] += st["docs"]["deleted"]
             agg["store"]["size_in_bytes"] += st["store"]["size_in_bytes"]
@@ -534,7 +627,7 @@ class RestAPI:
             self.indices.indices[n].num_shards for n in names),
             "successful": sum(self.indices.indices[n].num_shards
                               for n in names), "failed": 0},
-            "_all": {"primaries": agg, "total": agg},
+            "_all": {"primaries": trim(agg), "total": trim(agg)},
             "indices": per_index}
 
     # ------------------------------------------------------------------
@@ -655,6 +748,13 @@ class RestAPI:
                               params.get("if_primary_term")))
         if params.get("refresh") in ("true", "wait_for", ""):
             svc.refresh()
+            resp = self._doc_response(index, r,
+                                      "created" if r.created else "updated")
+            # wait_for waits for a scheduled refresh rather than forcing
+            # one (synchronous here, but the reported flag keeps the
+            # reference's contract)
+            resp["forced_refresh"] = params["refresh"] != "wait_for"
+            return (201 if r.created else 200), resp
         return (201 if r.created else 200), self._doc_response(
             index, r, "created" if r.created else "updated")
 
@@ -670,9 +770,12 @@ class RestAPI:
         r = svc.get_doc(id, routing=params.get("routing"))
         if not r.found:
             return 404, {"_index": index, "_id": id, "found": False}
-        return {"_index": index, "_id": id, "_version": r.version,
-                "_seq_no": r.seq_no, "_primary_term": 1, "found": True,
-                "_source": r.source}
+        out = {"_index": index, "_id": id, "_version": r.version,
+               "_seq_no": r.seq_no, "_primary_term": 1, "found": True,
+               "_source": r.source}
+        if getattr(r, "routing", None) is not None:
+            out["_routing"] = r.routing
+        return out
 
     def h_get_source(self, params, body, index, id):
         svc = self.indices.get(index)
@@ -694,7 +797,7 @@ class RestAPI:
         return self._doc_response(index, r, "deleted")
 
     def h_update_doc(self, params, body, index, id):
-        svc = self.indices.get(index)
+        svc = self._get_or_autocreate(index)
         b = _json_body(body)
         existing = svc.get_doc(id, routing=params.get("routing"))
         if not existing.found:
@@ -738,22 +841,37 @@ class RestAPI:
             entries = b["docs"]
         else:
             entries = [{"_id": i} for i in b.get("ids", [])]
+        from ..search.fetch import filter_source
+        req_src = params.get("_source")
+        if req_src in ("true", "false"):
+            req_src = req_src == "true"
+        elif req_src is not None:
+            req_src = req_src.split(",")
         for e in entries:
             idx = e.get("_index", index)
             if idx is None:
                 raise IllegalArgumentError("mget requires an index per doc")
+            doc_id = str(e["_id"])
+            routing = e.get("routing")
+            routing = str(routing) if routing is not None else None
             try:
                 svc = self.indices.get(idx)
-                r = svc.get_doc(e["_id"], routing=e.get("routing"))
+                r = svc.get_doc(doc_id, routing=routing)
             except IndexNotFoundError:
-                out.append({"_index": idx, "_id": e["_id"], "found": False})
+                out.append({"_index": idx, "_id": doc_id, "found": False})
                 continue
             if r.found:
-                out.append({"_index": idx, "_id": e["_id"],
-                            "_version": r.version, "found": True,
-                            "_source": r.source})
+                src_spec = e.get("_source", req_src)
+                if src_spec is None:
+                    src_spec = True
+                entry = {"_index": idx, "_id": doc_id,
+                         "_version": r.version, "found": True}
+                filtered = filter_source(r.source, src_spec)
+                if src_spec is not False:
+                    entry["_source"] = filtered
+                out.append(entry)
             else:
-                out.append({"_index": idx, "_id": e["_id"], "found": False})
+                out.append({"_index": idx, "_id": doc_id, "found": False})
         return {"docs": out}
 
     def _get_or_autocreate(self, index: str) -> IndexService:
@@ -924,7 +1042,9 @@ class RestAPI:
             idx = meta.get("_index", index)
             if idx is None:
                 raise IllegalArgumentError("bulk item requires _index")
-            doc_id = meta.get("_id") or uuid.uuid4().hex[:20]
+            doc_id = meta.get("_id")
+            doc_id = str(doc_id) if doc_id is not None \
+                else uuid.uuid4().hex[:20]
             source = None
             if verb != "delete":
                 if i >= len(lines):
@@ -980,6 +1100,11 @@ class RestAPI:
         if params.get("refresh") in ("true", "wait_for", ""):
             for idx in touched:
                 self.indices.get(idx).refresh()
+            forced = params["refresh"] != "wait_for"
+            for item in items:
+                for verb_resp in item.values():
+                    if "error" not in verb_resp:
+                        verb_resp["forced_refresh"] = forced
         return {"took": int((time.time() - t0) * 1000), "errors": errors,
                 "items": items}
 
@@ -987,9 +1112,20 @@ class RestAPI:
     # search
     # ------------------------------------------------------------------
 
-    def _hit_json(self, index_name: str, h: ShardHit) -> dict:
+    def _hit_json(self, index_name: str, h: ShardHit,
+                  flags: Optional[dict] = None) -> dict:
         out = {"_index": index_name, "_id": h.doc_id,
                "_score": h.score, "_source": h.source}
+        flags = flags or {}
+        if flags.get("seq_no_primary_term") and h.seq_no is not None:
+            out["_seq_no"] = h.seq_no
+            out["_primary_term"] = 1
+        if flags.get("version"):
+            try:
+                g = self.indices.get(index_name).get_doc(h.doc_id)
+                out["_version"] = g.version if g.found else None
+            except Exception:   # noqa: BLE001 — alias/closed edge cases
+                out["_version"] = None
         if h.sort_values is not None:
             out["sort"] = h.sort_values
         if h.fields:
@@ -1129,7 +1265,8 @@ class RestAPI:
             "hits": {
                 "total": {"value": total, "relation": relation},
                 "max_score": max(max_scores) if max_scores else None,
-                "hits": [self._hit_json(n, h) for n, h in page],
+                "hits": [self._hit_json(n, h, search_body)
+                         for n, h in page],
             },
         }
         if aggregations is not None:
@@ -1186,8 +1323,42 @@ class RestAPI:
                              "max_score": None, "hits": []}}
         scroll = params.get("scroll")
         if scroll:
-            return self._start_scroll(names, search_body, scroll)
-        return self._search_indices(names, search_body)
+            out = self._start_scroll(names, search_body, scroll)
+        else:
+            out = self._search_indices(names, search_body)
+        if params.get("rest_total_hits_as_int") in ("true", ""):
+            total = out.get("hits", {}).get("total")
+            if isinstance(total, dict):
+                out["hits"]["total"] = total["value"]
+        return out
+
+    def h_validate_query(self, params, body, index=None):
+        """Query validation (reference: ``RestValidateQueryAction``):
+        parse the query; explain=true adds the parsed description."""
+        from ..search.query_dsl import parse_query
+        payload = _json_body(body) if body else {}
+        spec = payload.get("query")
+        if spec is None and params.get("q"):
+            spec = _lucene_qs_to_dsl(params["q"])
+        valid = True
+        error = None
+        if spec is not None:
+            try:
+                parse_query(spec)
+            except Exception as e:      # noqa: BLE001 — any parse failure
+                valid = False
+                error = f"{type(e).__name__}: {e}"
+        out = {"valid": valid,
+               "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if params.get("explain") in ("true", "") or error:
+            expl = {"index": (self.indices.resolve(index) or [index])[0]
+                    if index else "_all", "valid": valid}
+            if error:
+                expl["error"] = error
+            else:
+                expl["explanation"] = json.dumps(spec or {"match_all": {}})
+            out["explanations"] = [expl]
+        return out
 
     def h_count(self, params, body, index=None):
         names = self.indices.resolve(index)

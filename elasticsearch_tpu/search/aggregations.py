@@ -152,6 +152,7 @@ def parse_aggs(spec: dict) -> Dict[str, Aggregator]:
             raise ParsingError(f"unknown aggregation type [{kind}]")
         agg = factory(body[kind])
         agg.name = name
+        agg.meta = body.get("meta")
         subs = parse_aggs(sub_spec) if sub_spec else {}
         if subs and not isinstance(agg, BucketAggregator):
             raise ParsingError(
@@ -191,8 +192,12 @@ def run_aggregations_multi(
         partials = [agg.collect(ctx, seg, mask)
                     for ctx, seg, mask in ctx_seg_masks]
         result[name] = agg.reduce(partials)
+        if getattr(agg, "meta", None) is not None:
+            result[name]["meta"] = agg.meta
     for name, p in pipelines.items():
         result[name] = p.apply(result)
+        if getattr(p, "meta", None) is not None:
+            result[name]["meta"] = p.meta
     return result
 
 
@@ -425,6 +430,12 @@ class PercentilesAgg(_NumericMetricAgg):
         super().__init__(body)
         self.percents = tuple(body.get("percents", self.DEFAULT_PERCENTS))
         self.keyed = bool(body.get("keyed", True))
+        td = body.get("tdigest") or {}
+        compression = td.get("compression")
+        if compression is not None and float(compression) < 0:
+            raise IllegalArgumentError(
+                f"[compression] must be greater than or equal to 0. "
+                f"Found [{float(compression)}]")
 
     def collect(self, ctx, seg, mask):
         return {"values": self._matched_values(ctx, seg, mask)}
@@ -435,7 +446,11 @@ class PercentilesAgg(_NumericMetricAgg):
         if allv.size == 0:
             vals = {f"{p}": None for p in self.percents}
         else:
-            qs = np.percentile(allv, self.percents)
+            # Hazen interpolation (q·n − ½): what the reference's TDigest
+            # converges to on exactly-held data — its tiny-shard unit
+            # expectations (values.1\.0 == min, midpoints between points)
+            # only hold under this rule, not numpy's default linear one
+            qs = np.percentile(allv, self.percents, method="hazen")
             vals = {f"{p}": float(q) for p, q in zip(self.percents, qs)}
         if self.keyed:
             return {"values": vals}
@@ -589,6 +604,7 @@ class TermsAgg(BucketAggregator):
         ``InternalTerms.java`` docCountError accounting)."""
         buckets: Dict[Any, Tuple[int, dict]] = {}
         trunc_err = 0
+        self._mapper = ctx.mapper        # for key_as_string at reduce
         kw = _keyword_pairs(seg, self.field)
         if kw is not None:
             docs, ords, terms = kw
@@ -653,6 +669,14 @@ class TermsAgg(BucketAggregator):
                     (int(miss_mask.sum()), {})
         return buckets, trunc_err
 
+    def _bucket_key_as_string(self, mapper, key):
+        ft = _field_type(mapper, self.field) if mapper else None
+        if isinstance(ft, BooleanFieldType):
+            return "true" if key else "false"
+        if isinstance(ft, DateFieldType):
+            return format_date_millis(float(key))
+        return None
+
     def _sort_key(self, ctx=None):
         ((field, direction),) = list(self.order.items())[:1] or \
             [("_count", "desc")]
@@ -695,10 +719,14 @@ class TermsAgg(BucketAggregator):
         rows = rows[: self.size]
         total_other -= sum(c for _, c, _ in rows)
         out_buckets = []
+        mapper = getattr(self, "_mapper", None)
         for key, count, subs in rows:
             b = {"key": key, "doc_count": count}
             if isinstance(key, float) and key.is_integer():
                 b["key"] = int(key)
+            kas = self._bucket_key_as_string(mapper, b["key"])
+            if kas is not None:
+                b["key_as_string"] = kas
             b.update(subs)
             out_buckets.append(b)
         return {"doc_count_error_upper_bound": err_bound,

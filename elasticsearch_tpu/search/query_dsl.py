@@ -1360,6 +1360,171 @@ def _parse_boosting(body):
                          float(body.get("boost", 1.0)))
 
 
+class _AllTextFieldsQuery(Query):
+    """Match against every text field (the ``*`` / default-field case of
+    query_string): dis_max over the segment's text fields, resolved at
+    execute time."""
+
+    def __init__(self, text: str, phrase: bool, boost: float = 1.0):
+        self.text = text
+        self.phrase = phrase
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        fields = sorted(seg.text_fields)
+        subs = [(MatchPhraseQuery(f, self.text) if self.phrase
+                 else MatchQuery(f, self.text)) for f in fields]
+        if not subs:
+            return _const_result(seg, 0.0, False)
+        return DisMaxQuery(subs, 0.0, self.boost).execute(ctx, seg)
+
+    def collect_highlight_terms(self, ctx, out):
+        for seg in ctx.segments:
+            for f in seg.text_fields:
+                MatchQuery(f, self.text).collect_highlight_terms(ctx, out)
+
+
+class QueryStringQuery(Query):
+    """Lucene query-string syntax, the commonly-used subset (reference:
+    ``QueryStringQueryBuilder`` wrapping the full Lucene parser):
+    ``field:term``, quoted phrases, AND/OR/NOT + default_operator, +/-
+    prefixes, trailing-* wildcards, field boosts (``title^2``).
+    ``simple_query_string`` shares the parser with lenient semantics
+    (reference: ``SimpleQueryStringBuilder`` — its +|- operator spellings
+    map onto the same tree)."""
+
+    def __init__(self, query: str, fields: Optional[List[str]] = None,
+                 default_operator: str = "or", boost: float = 1.0,
+                 lenient: bool = False):
+        self.boost = boost
+        self.lenient = lenient
+        self.inner = self._compile(str(query), fields or ["*"],
+                                   default_operator.lower())
+
+    @staticmethod
+    def _tokenize(q: str) -> List[str]:
+        out, cur, in_q = [], "", False
+        for ch in q:
+            if ch == '"':
+                cur += ch
+                if in_q:
+                    out.append(cur)
+                    cur = ""
+                in_q = not in_q
+            elif ch.isspace() and not in_q:
+                if cur:
+                    out.append(cur)
+                    cur = ""
+            else:
+                cur += ch
+        if cur:
+            out.append(cur)
+        return out
+
+    def _leaf(self, fields: List[str], text: str) -> "Query":
+        field = None
+        if ":" in text and not text.startswith('"'):
+            field, _, text = text.partition(":")
+        phrase = text.startswith('"') and text.endswith('"') and \
+            len(text) >= 2
+        if phrase:
+            text = text[1:-1]
+        targets = [field] if field else fields
+        subs: List[Query] = []
+        for f in targets:
+            boost = 1.0
+            if "^" in f:
+                f, _, b = f.partition("^")
+                boost = float(b)
+            if f in ("*", ""):
+                sub = _AllTextFieldsQuery(text, phrase, boost)
+            elif phrase:
+                sub = MatchPhraseQuery(f, text, 0, boost)
+            elif text.endswith("*") and len(text) > 1:
+                sub = WildcardQuery(f, text.lower(), boost)
+            else:
+                sub = MatchQuery(f, text, boost=boost)
+            subs.append(sub)
+        return subs[0] if len(subs) == 1 else DisMaxQuery(subs, 0.0)
+
+    def _compile(self, q: str, fields: List[str], default_op: str) -> Query:
+        tokens = self._tokenize(q)
+        must, should, must_not = [], [], []
+        pending_op = None
+        last_bucket = None                    # where the previous leaf went
+        for tok in tokens:
+            up = tok.upper()
+            if up in ("AND", "OR"):
+                pending_op = up
+                continue
+            if up == "NOT":
+                pending_op = "NOT"
+                continue
+            neg = tok.startswith("-") or pending_op == "NOT"
+            req = tok.startswith("+")
+            tok = tok.lstrip("+-") if not tok.startswith('"') else tok
+            if not tok:
+                pending_op = None
+                continue
+            try:
+                leaf = self._leaf(fields, tok)
+            except Exception:   # noqa: BLE001
+                if self.lenient:
+                    pending_op = None
+                    continue            # simple_query_string never throws
+                raise
+            if neg:
+                must_not.append(leaf)
+                last_bucket = must_not
+            elif pending_op == "OR":
+                # an explicit OR joins the PREVIOUS leaf too, even under
+                # default_operator=and ("a OR b" matches either)
+                if last_bucket is must and must:
+                    should.append(must.pop())
+                should.append(leaf)
+                last_bucket = should
+            elif req or pending_op == "AND" or (
+                    pending_op is None and default_op == "and"):
+                must.append(leaf)
+                last_bucket = must
+            else:
+                should.append(leaf)
+                last_bucket = should
+            pending_op = None
+        if default_op == "and" and should and not must and \
+                len(should) == 1:
+            must, should = should, []
+        return BoolQuery(must=must, should=should, must_not=must_not,
+                         filter=[],
+                         minimum_should_match=(1 if should and not must
+                                               else 0))
+
+    def execute(self, ctx, seg):
+        s, m = self.inner.execute(ctx, seg)
+        return s * np.float32(self.boost), m
+
+    def collect_highlight_terms(self, ctx, out):
+        self.inner.collect_highlight_terms(ctx, out)
+
+
+def _parse_query_string(body):
+    if "query" not in body:
+        raise ParsingError("[query_string] requires [query]")
+    fields = body.get("fields") or (
+        [body["default_field"]] if body.get("default_field") else None)
+    return QueryStringQuery(body["query"], fields,
+                            body.get("default_operator", "or"),
+                            float(body.get("boost", 1.0)))
+
+
+def _parse_simple_query_string(body):
+    if "query" not in body:
+        raise ParsingError("[simple_query_string] requires [query]")
+    return QueryStringQuery(body["query"], body.get("fields"),
+                            body.get("default_operator", "or"),
+                            float(body.get("boost", 1.0)), lenient=True)
+
+
 def _parse_nested(body):
     return NestedQuery(body.get("path", ""), parse_query(body["query"]),
                        float(body.get("boost", 1.0)),
@@ -1442,6 +1607,8 @@ _PARSERS = {
     "fuzzy": _parse_fuzzy,
     "boosting": _parse_boosting,
     "nested": _parse_nested,
+    "query_string": _parse_query_string,
+    "simple_query_string": _parse_simple_query_string,
 }
 
 

@@ -1,0 +1,379 @@
+"""YAML REST conformance runner.
+
+Executes the reference's REST API test suites
+(``rest-api-spec/src/main/resources/rest-api-spec/test/`` — the
+declarative, implementation-agnostic conformance corpus every official
+client and the reference itself run; SURVEY §4 calls it out as directly
+reusable) against :class:`~elasticsearch_tpu.rest.api.RestAPI`.
+
+The suites are DATA, loaded in place from the read-only reference checkout
+at run time — nothing is copied into this repo. When the reference tree is
+absent the runner reports zero suites and callers skip.
+
+Supported step grammar (the subset the corpus overwhelmingly uses):
+
+- ``do``: one API call — the action name resolves to (method, path) via
+  the machine-readable api specs (``rest-api-spec/api/*.json``), path
+  parts substitute from params, the rest become the query string;
+  ``catch:`` asserts an error class/regex instead of success.
+- assertions: ``match`` (with ``/regex/`` support), ``length``,
+  ``is_true``, ``is_false``, ``gt/gte/lt/lte``, ``set`` (capture into
+  ``$vars``), ``transform_and_set`` (ignored-unsupported).
+- ``skip``: version ranges are ignored (we implement the 8.x surface);
+  ``features`` gates honored against the runner's feature set.
+
+The runner returns structured results so tests can (a) hard-assert a
+curated allowlist and (b) sweep the whole corpus for a conformance score.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import quote
+
+REFERENCE_SPEC_ROOT = "/root/reference/rest-api-spec/src/main/resources/rest-api-spec"
+
+#: yaml test features this runner understands
+SUPPORTED_FEATURES = {"headers", "allowed_warnings", "warnings"}
+
+
+class ApiRegistry:
+    """action name → url template resolution from the api spec JSONs."""
+
+    def __init__(self, spec_root: str = REFERENCE_SPEC_ROOT):
+        self.specs: Dict[str, dict] = {}
+        api_dir = os.path.join(spec_root, "api")
+        if not os.path.isdir(api_dir):
+            return
+        for fname in os.listdir(api_dir):
+            if not fname.endswith(".json") or fname == "_common.json":
+                continue
+            with open(os.path.join(api_dir, fname)) as f:
+                doc = json.load(f)
+            for name, spec in doc.items():
+                self.specs[name] = spec
+
+    def resolve(self, action: str, params: Dict[str, Any]
+                ) -> Tuple[str, str, Dict[str, Any]]:
+        """(method, path, leftover_query_params). Picks the most specific
+        path whose parts are all present."""
+        spec = self.specs.get(action)
+        if spec is None:
+            raise KeyError(f"unknown api action [{action}]")
+        paths = spec.get("url", {}).get("paths", [])
+        best = None
+        for p in paths:
+            parts = set(p.get("parts") or {})
+            if parts <= set(params):
+                if best is None or len(parts) > len(best[1]):
+                    best = (p, parts)
+        if best is None:
+            raise KeyError(f"[{action}] no path matches params "
+                           f"{sorted(params)}")
+        p, parts = best
+        path = p["path"]
+        for part in parts:
+            v = params[part]
+            if isinstance(v, list):
+                v = ",".join(str(x) for x in v)
+            path = path.replace("{" + part + "}", quote(str(v), safe=","))
+        methods = p["methods"]
+        # prefer a body-accepting method when both GET and POST exist
+        method = "POST" if "POST" in methods else methods[0]
+        query = {k: v for k, v in params.items() if k not in parts}
+        return method, path, query
+
+
+def _json_default(o):
+    """YAML eagerly parses date-shaped scalars into datetime objects; the
+    wire wants them back as ISO strings."""
+    import datetime
+    if isinstance(o, (datetime.date, datetime.datetime)):
+        return o.isoformat()
+    raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+@dataclass
+class StepFailure(Exception):
+    reason: str
+
+    def __str__(self):
+        return self.reason
+
+
+@dataclass
+class TestResult:
+    suite: str
+    name: str
+    ok: bool
+    reason: str = ""
+
+
+class YamlTestRunner:
+    """Runs suites against a fresh RestAPI per suite file."""
+
+    def __init__(self, api_factory, spec_root: str = REFERENCE_SPEC_ROOT):
+        self.api_factory = api_factory
+        self.spec_root = spec_root
+        self.registry = ApiRegistry(spec_root)
+
+    # -- discovery -----------------------------------------------------------
+
+    def discover(self) -> List[str]:
+        root = os.path.join(self.spec_root, "test")
+        if not os.path.isdir(root):
+            return []
+        out = []
+        for dirpath, _dirs, files in os.walk(root):
+            for f in sorted(files):
+                if f.endswith(".yml"):
+                    out.append(os.path.join(dirpath, f))
+        return sorted(out)
+
+    # -- execution -----------------------------------------------------------
+
+    def run_file(self, path: str) -> List[TestResult]:
+        import yaml
+        rel = os.path.relpath(path, os.path.join(self.spec_root, "test"))
+        with open(path) as f:
+            docs = list(yaml.safe_load_all(f))
+        setup_steps: List[dict] = []
+        teardown_steps: List[dict] = []
+        tests: List[Tuple[str, List[dict]]] = []
+        for doc in docs:
+            if not isinstance(doc, dict):
+                continue
+            for name, steps in doc.items():
+                if name == "setup":
+                    setup_steps = steps or []
+                elif name == "teardown":
+                    teardown_steps = steps or []
+                else:
+                    tests.append((name, steps or []))
+        results = []
+        for name, steps in tests:
+            api = self.api_factory()
+            state = {"vars": {}, "last": None, "api": api}
+            try:
+                self._run_steps(setup_steps, state)
+                self._run_steps(steps, state)
+                results.append(TestResult(rel, name, True))
+            except StepFailure as e:
+                results.append(TestResult(rel, name, False, str(e)))
+            except Exception as e:   # noqa: BLE001 — runner bug or crash
+                results.append(TestResult(
+                    rel, name, False, f"{type(e).__name__}: {e}"))
+            finally:
+                try:
+                    self._run_steps(teardown_steps, state)
+                except Exception:   # noqa: BLE001
+                    pass
+        return results
+
+    def _run_steps(self, steps: List[dict], state: dict) -> None:
+        for step in steps:
+            if not isinstance(step, dict) or len(step) != 1:
+                raise StepFailure(f"malformed step {step!r}")
+            (kind, body), = step.items()
+            if kind == "do":
+                self._do(body, state)
+            elif kind == "skip":
+                self._skip(body)
+            elif kind == "set":
+                ((path, var),) = body.items()
+                state["vars"][var] = self._lookup(state["last"], path,
+                                                  state)
+            elif kind == "match":
+                ((path, expected),) = body.items()
+                self._assert_match(path, expected, state)
+            elif kind == "length":
+                ((path, expected),) = body.items()
+                got = self._lookup(state["last"], path, state)
+                if got is None or len(got) != int(expected):
+                    raise StepFailure(
+                        f"length {path}: got "
+                        f"{None if got is None else len(got)} "
+                        f"!= {expected}")
+            elif kind in ("is_true", "is_false"):
+                got = self._lookup(state["last"], body, state,
+                                   missing_ok=True)
+                truthy = got not in (None, False, "", 0, {}, [])
+                if truthy != (kind == "is_true"):
+                    raise StepFailure(f"{kind} {body}: value {got!r}")
+            elif kind in ("gt", "gte", "lt", "lte"):
+                ((path, expected),) = body.items()
+                got = self._lookup(state["last"], path, state)
+                expected = self._subst(expected, state)
+                ops = {"gt": lambda a, b: a > b,
+                       "gte": lambda a, b: a >= b,
+                       "lt": lambda a, b: a < b,
+                       "lte": lambda a, b: a <= b}
+                try:
+                    ok = ops[kind](float(got), float(expected))
+                except (TypeError, ValueError):
+                    raise StepFailure(f"{kind} {path}: non-numeric "
+                                      f"{got!r}")
+                if not ok:
+                    raise StepFailure(
+                        f"{kind} {path}: {got!r} vs {expected!r}")
+            elif kind in ("transform_and_set", "contains",
+                          "close_to"):
+                # rare step kinds: treat as unsupported → skip the test
+                raise StepFailure(f"unsupported step kind [{kind}]")
+            else:
+                raise StepFailure(f"unknown step kind [{kind}]")
+
+    def _skip(self, body: dict) -> None:
+        feats = body.get("features") or []
+        if isinstance(feats, str):
+            feats = [feats]
+        unsupported = [f for f in feats if f not in SUPPORTED_FEATURES]
+        if unsupported:
+            raise StepFailure(f"requires features {unsupported}")
+        # version-range skips are ignored: we target the 8.x surface
+
+    def _do(self, body: dict, state: dict) -> None:
+        body = dict(body)
+        catch = body.pop("catch", None)
+        body.pop("headers", None)
+        body.pop("allowed_warnings", None)
+        body.pop("warnings", None)
+        if len(body) != 1:
+            raise StepFailure(f"do step with {len(body)} actions")
+        (action, raw_params), = body.items()
+        params = self._subst(raw_params or {}, state)
+        req_body = params.pop("body", None)
+        method, path, query = self.registry.resolve(action, params)
+        if req_body is not None and method == "GET":
+            method = "POST"
+        qs = "&".join(
+            f"{k}={quote(str(v).lower() if isinstance(v, bool) else str(v), safe=',*')}"
+            for k, v in query.items())
+        if isinstance(req_body, list):        # bulk NDJSON form
+            payload = "\n".join(
+                x if isinstance(x, str)
+                else json.dumps(x, default=_json_default)
+                for x in req_body) + "\n"
+            raw = payload.encode()
+        elif isinstance(req_body, str):
+            raw = req_body.encode()
+        elif req_body is not None:
+            raw = json.dumps(req_body, default=_json_default).encode()
+        else:
+            raw = b""
+        status, _ct, out = state["api"].handle(method, path, qs, raw)
+        try:
+            resp = json.loads(out)
+        except Exception:   # noqa: BLE001 — _cat text responses
+            resp = out.decode() if isinstance(out, bytes) else out
+        state["last"] = resp
+        if catch is not None:
+            if status < 400:
+                raise StepFailure(
+                    f"[{action}] expected error [{catch}], got {status}")
+            expected_status = {"missing": 404, "conflict": 409,
+                              "forbidden": 403,
+                              "request_timeout": 408,
+                              "unauthorized": 401}.get(catch)
+            if expected_status and status != expected_status:
+                raise StepFailure(
+                    f"[{action}] expected {expected_status} for "
+                    f"[{catch}], got {status}")
+            if catch.startswith("/") and catch.endswith("/"):
+                blob = json.dumps(resp)
+                if re.search(catch[1:-1], blob) is None:
+                    raise StepFailure(
+                        f"[{action}] error body does not match {catch}")
+            return
+        if status >= 400:
+            raise StepFailure(
+                f"[{action}] HTTP {status}: {json.dumps(resp)[:300]}")
+
+    # -- value plumbing ------------------------------------------------------
+
+    def _subst(self, value, state):
+        if isinstance(value, dict):
+            return {k: self._subst(v, state) for k, v in value.items()}
+        if isinstance(value, list):
+            return [self._subst(v, state) for v in value]
+        if isinstance(value, str):
+            if value.startswith("$"):
+                name = value[1:]
+                if name in state["vars"]:
+                    return state["vars"][name]
+            m = re.fullmatch(r"\$\{(\w+)\}", value)
+            if m and m.group(1) in state["vars"]:
+                return state["vars"][m.group(1)]
+        return value
+
+    def _lookup(self, obj, path: str, state: dict, missing_ok=False):
+        if path == "$body":
+            return obj
+        path = self._subst(path, state)
+        if isinstance(path, str) and path.startswith("$"):
+            return path
+        cur = obj
+        parts = re.split(r"(?<!\\)\.", str(path))
+        for raw in parts:
+            key = raw.replace("\\.", ".")
+            key = self._subst(key, state)
+            if isinstance(cur, list):
+                try:
+                    cur = cur[int(key)]
+                except (ValueError, IndexError):
+                    if missing_ok:
+                        return None
+                    raise StepFailure(f"path [{path}]: bad index [{key}]")
+            elif isinstance(cur, dict):
+                if key not in cur:
+                    if missing_ok:
+                        return None
+                    raise StepFailure(f"path [{path}]: missing [{key}]")
+                cur = cur[key]
+            else:
+                if missing_ok:
+                    return None
+                raise StepFailure(f"path [{path}]: hit leaf at [{key}]")
+        return cur
+
+    def _assert_match(self, path: str, expected, state: dict) -> None:
+        got = self._lookup(state["last"], path, state,
+                           missing_ok=expected is None)
+        expected = self._subst(expected, state)
+        if isinstance(expected, str) and len(expected) > 1 and \
+                expected.startswith("/") and expected.rstrip().endswith("/"):
+            pat = expected.strip().strip("/")
+            # multi-line corpus regexes use verbose mode (comments +
+            # insignificant whitespace); single-line ones are literal
+            flags = re.VERBOSE if "\n" in pat else 0
+            if re.search(pat, str(got), flags) is None:
+                raise StepFailure(
+                    f"match {path}: {got!r} !~ /{pat[:80]}/")
+            return
+        if isinstance(expected, float) and isinstance(got, (int, float)):
+            if abs(float(got) - expected) < 1e-6:
+                return
+        if got != expected:
+            raise StepFailure(f"match {path}: {got!r} != {expected!r}")
+
+
+def run_conformance(api_factory, suites: Optional[List[str]] = None,
+                    spec_root: str = REFERENCE_SPEC_ROOT
+                    ) -> List[TestResult]:
+    """Run the given suite files (relative to the corpus test root), or
+    everything discoverable."""
+    runner = YamlTestRunner(api_factory, spec_root)
+    files = runner.discover()
+    if suites is not None:
+        wanted = set(suites)
+        files = [f for f in files
+                 if os.path.relpath(
+                     f, os.path.join(spec_root, "test")) in wanted]
+    out: List[TestResult] = []
+    for f in files:
+        out.extend(runner.run_file(f))
+    return out

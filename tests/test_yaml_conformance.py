@@ -1,0 +1,100 @@
+"""YAML REST conformance: the reference's own rest-api-spec test corpus
+executed in place against our REST layer (``testkit/yaml_runner.py``).
+
+Two tiers: a hard allowlist of suites that must pass completely, and a
+corpus-wide sweep that must stay above a floor (ratcheted up as coverage
+grows). Skips when the reference checkout is absent."""
+
+import os
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+from elasticsearch_tpu.testkit.yaml_runner import (REFERENCE_SPEC_ROOT,
+                                                   YamlTestRunner,
+                                                   run_conformance)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(os.path.join(REFERENCE_SPEC_ROOT, "test")),
+    reason="reference rest-api-spec corpus not available")
+
+
+def factory():
+    return RestAPI(IndicesService(tempfile.mkdtemp()))
+
+
+#: suites that pass COMPLETELY — regressions here are hard failures
+ALLOWLIST = [
+    "bulk/20_list_of_strings.yml",
+    "bulk/30_big_string.yml",
+    "cluster.state/10_basic.yml",
+    "create/10_with_id.yml",
+    "create/40_routing.yml",
+    "delete/10_basic.yml",
+    "delete/11_shard_header.yml",
+    "delete/12_result.yml",
+    "delete/20_cas.yml",
+    "delete/30_routing.yml",
+    "get/10_basic.yml",
+    "get/15_default_values.yml",
+    "get/40_routing.yml",
+    "index/12_result.yml",
+    "index/15_without_id.yml",
+    "index/20_optype.yml",
+    "index/30_cas.yml",
+    "index/40_routing.yml",
+    "indices.get_alias/20_empty.yml",
+    "indices.get_field_mapping/20_missing_field.yml",
+    "indices.get_field_mapping/40_missing_index.yml",
+    "indices.get_field_mapping/50_field_wildcards.yml",
+    "indices.open/10_basic.yml",
+    "indices.open/20_multiple_indices.yml",
+    "indices.validate_query/20_query_string.yml",
+    "info/10_info.yml",
+    "info/20_lucene_version.yml",
+    "mget/10_basic.yml",
+    "mget/12_non_existent_index.yml",
+    "mget/17_default_index.yml",
+    "mtermvectors/20_deprecated.yml",
+    "search.aggregation/140_value_count_metric.yml",
+    "search.aggregation/150_stats_metric.yml",
+    "search.aggregation/260_weighted_avg.yml",
+    "search/issue4895.yml",
+    "suggest/10_basic.yml",
+    "update/10_doc.yml",
+    "update/11_shard_header.yml",
+    "update/12_result.yml",
+    "update/13_legacy_doc.yml",
+    "update/20_doc_upsert.yml",
+    "update/22_doc_as_upsert.yml",
+]
+
+#: corpus-wide pass floor (ratchet: raise when conformance climbs)
+SWEEP_FLOOR = 270
+
+
+def test_allowlisted_suites_pass_completely():
+    results = run_conformance(factory, suites=ALLOWLIST)
+    assert results, "allowlist resolved to zero tests"
+    failures = [f"{r.suite} :: {r.name}: {r.reason}"
+                for r in results if not r.ok]
+    assert not failures, "\n".join(failures)
+
+
+def test_corpus_sweep_above_floor():
+    runner = YamlTestRunner(factory)
+    ok = total = 0
+    for f in runner.discover():
+        try:
+            rs = runner.run_file(f)
+        except Exception:   # noqa: BLE001 — a crashing suite counts failed
+            continue
+        for r in rs:
+            total += 1
+            ok += bool(r.ok)
+    assert total > 1000, f"corpus looks truncated: {total} tests"
+    assert ok >= SWEEP_FLOOR, (
+        f"conformance regressed: {ok}/{total} passing "
+        f"(floor {SWEEP_FLOOR})")
