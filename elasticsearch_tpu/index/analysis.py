@@ -23,7 +23,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence
 from ..common.errors import IllegalArgumentError
 
 
-@dataclass
+@dataclass(slots=True)
 class Token:
     """A single analyzed token (term text, position, char offsets)."""
 
@@ -322,9 +322,22 @@ class Analyzer:
         self.token_filters = list(token_filters)
         self.char_filters = list(char_filters)
 
+    #: set on analyzers whose (tokenizer, first filter) pair is exactly
+    #: (standard word segmentation, lowercase) — eligible for the native
+    #: ASCII fast path, which fuses both steps in C++
+    _native_fast = False
+
     def analyze(self, text: str) -> List[Token]:
         for cf in self.char_filters:
             text = cf(text)
+        if self._native_fast:
+            fast = _native_tokenize(text)
+            if fast is not None:
+                tokens = [Token(term, pos, s, e)
+                          for pos, (term, s, e) in enumerate(fast)]
+                for tf in self.token_filters[1:]:   # lowercase fused in
+                    tokens = tf(tokens)
+                return tokens
         tokens = self.tokenizer(text)
         for tf in self.token_filters:
             tokens = tf(tokens)
@@ -334,15 +347,34 @@ class Analyzer:
         return [t.term for t in self.analyze(text)]
 
 
+def _native_tokenize(text: str):
+    """ASCII fast path via the C++ library; None → use the Python path."""
+    try:
+        from ..native import tokenize_ascii
+    except Exception:   # noqa: BLE001 — no native package
+        return None
+    return tokenize_ascii(text)
+
+
+def _mark_native(an: Analyzer) -> Analyzer:
+    if an.tokenizer is standard_tokenizer and an.token_filters and \
+            an.token_filters[0] is lowercase_filter:
+        an._native_fast = True
+    return an
+
+
 BUILTIN_ANALYZERS: Dict[str, Analyzer] = {
-    "standard": Analyzer("standard", standard_tokenizer, [lowercase_filter]),
+    "standard": _mark_native(
+        Analyzer("standard", standard_tokenizer, [lowercase_filter])),
     "simple": Analyzer("simple", letter_tokenizer, [lowercase_filter]),
     "whitespace": Analyzer("whitespace", whitespace_tokenizer),
     "keyword": Analyzer("keyword", keyword_tokenizer),
     "stop": Analyzer("stop", letter_tokenizer,
                      [lowercase_filter, make_stop_filter()]),
-    "english": Analyzer("english", standard_tokenizer,
-                        [lowercase_filter, make_stop_filter(), porter_stem_filter]),
+    "english": _mark_native(
+        Analyzer("english", standard_tokenizer,
+                 [lowercase_filter, make_stop_filter(),
+                  porter_stem_filter])),
 }
 
 
@@ -398,8 +430,9 @@ class AnalysisRegistry:
                     raise IllegalArgumentError(
                         f"failed to find char_filter [{cfname}] for analyzer [{name}]")
                 char_filters.append(custom_char_filters[cfname])
-            self._analyzers[name] = Analyzer(name, custom_tokenizers[tok_name],
-                                             filters, char_filters)
+            self._analyzers[name] = _mark_native(
+                Analyzer(name, custom_tokenizers[tok_name],
+                         filters, char_filters))
 
     @staticmethod
     def _build_tokenizer(name: str, spec: dict):

@@ -1,0 +1,97 @@
+"""Native host-runtime fast paths (C++ via ctypes).
+
+The TPU owns the compute path; this package owns the hottest host loops
+around it in native code, the way the reference keeps its runtime native
+(Lucene's ``StandardTokenizer``, the translog's checksummed framing,
+``OperationRouting``'s murmur3):
+
+- :func:`tokenize_ascii` — word segmentation + lowercasing for ASCII
+  text (the overwhelmingly common case; non-ASCII transparently falls
+  back to the Unicode-aware Python tokenizer),
+- :func:`murmur3_32` — doc→shard routing hash, dispatched from
+  ``utils/murmur3.py`` (bit-exact parity with the Python reference is
+  test-enforced: routing must never move when the library appears).
+
+The shared library compiles on first import when the checked-in ``.so``
+is missing or stale (``g++`` is in the image); every entry point has a
+pure-Python fallback so the package degrades gracefully without a
+toolchain. Callers check :data:`AVAILABLE`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "fastpath.cpp")
+_LIB = os.path.join(_HERE, "libfastpath.so")
+
+_lib = None
+
+
+def _ensure_built() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    try:
+        if (not os.path.exists(_LIB) or
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            # build to a temp name and rename into place: concurrent
+            # importers (test workers, cluster nodes) must never dlopen a
+            # half-written library or truncate a mapped one
+            tmp = f"{_LIB}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                 "-o", tmp, _SRC],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _LIB)
+        lib = ctypes.CDLL(_LIB)
+    except Exception:   # noqa: BLE001 — no toolchain / load failure
+        return None
+    lib.murmur3_32.restype = ctypes.c_uint32
+    lib.murmur3_32.argtypes = [ctypes.c_char_p, ctypes.c_int32,
+                               ctypes.c_uint32]
+    lib.tokenize_ascii.restype = ctypes.c_int32
+    lib.tokenize_ascii.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32]
+    _lib = lib
+    return lib
+
+
+_LIB_HANDLE = _ensure_built()
+AVAILABLE = _LIB_HANDLE is not None
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    if _LIB_HANDLE is not None:
+        return int(_LIB_HANDLE.murmur3_32(data, len(data),
+                                          seed & 0xFFFFFFFF))
+    from ..utils import murmur3 as py
+    return py.murmur3_32(data, seed)
+
+
+def tokenize_ascii(text: str) -> Optional[List[Tuple[str, int, int]]]:
+    """[(lowered_term, start, end)] for pure-ASCII text, None when the
+    text needs the Unicode fallback (non-ASCII byte, or no native lib)."""
+    if _LIB_HANDLE is None:
+        return None
+    raw = text.encode("utf-8", errors="surrogatepass")
+    if len(raw) != len(text):            # multi-byte chars present
+        return None
+    n = len(raw)
+    max_tokens = n // 2 + 2
+    lowered = ctypes.create_string_buffer(n or 1)
+    starts = (ctypes.c_int32 * max_tokens)()
+    ends = (ctypes.c_int32 * max_tokens)()
+    count = _LIB_HANDLE.tokenize_ascii(raw, n, lowered, starts, ends,
+                                       max_tokens)
+    if count < 0:
+        return None
+    low = lowered.raw[:n].decode("ascii")
+    return [(low[starts[i]:ends[i]], starts[i], ends[i])
+            for i in range(count)]

@@ -1,0 +1,102 @@
+// Native host-runtime fast paths for elasticsearch_tpu.
+//
+// The TPU owns the compute path (jax/XLA); this shared library owns the
+// hottest HOST loops around it, mirroring how the reference keeps its
+// runtime in native code (Lucene's StandardTokenizer / the translog's
+// checksummed framing in BufferedChecksumStreamOutput):
+//
+//  - tokenize_ascii: UAX#29-approximating word segmentation + lowercase
+//    for ASCII buffers (the overwhelmingly common case; non-ASCII falls
+//    back to the Python tokenizer which handles full Unicode),
+//  - murmur3_32: the doc-routing hash (OperationRouting.generateShardId),
+//    dispatched from utils/murmur3.py when the library is present.
+//
+// Exposed with plain C symbols for ctypes — no pybind11 dependency.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// murmur3 x86 32-bit (little-endian), matching utils/murmur3.py
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+uint32_t murmur3_32(const uint8_t* data, int32_t len, uint32_t seed) {
+    const int nblocks = len / 4;
+    uint32_t h1 = seed;
+    const uint32_t c1 = 0xcc9e2d51u;
+    const uint32_t c2 = 0x1b873593u;
+
+    for (int i = 0; i < nblocks; i++) {
+        uint32_t k1;
+        std::memcpy(&k1, data + i * 4, 4);
+        k1 *= c1; k1 = rotl32(k1, 15); k1 *= c2;
+        h1 ^= k1; h1 = rotl32(h1, 13); h1 = h1 * 5 + 0xe6546b64u;
+    }
+
+    const uint8_t* tail = data + nblocks * 4;
+    uint32_t k1 = 0;
+    switch (len & 3) {
+        case 3: k1 ^= (uint32_t)tail[2] << 16; [[fallthrough]];
+        case 2: k1 ^= (uint32_t)tail[1] << 8;  [[fallthrough]];
+        case 1: k1 ^= (uint32_t)tail[0];
+                k1 *= c1; k1 = rotl32(k1, 15); k1 *= c2; h1 ^= k1;
+    }
+
+    h1 ^= (uint32_t)len;
+    h1 ^= h1 >> 16; h1 *= 0x85ebca6bu;
+    h1 ^= h1 >> 13; h1 *= 0xc2b2ae35u;
+    h1 ^= h1 >> 16;
+    return h1;
+}
+
+// ---------------------------------------------------------------------------
+// ASCII word tokenizer + lowercase
+//
+// Writes three parallel int32 arrays (start, end, position) and lowercases
+// the input IN a caller-provided copy buffer. Returns the token count, or
+// -1 if a non-ASCII byte was seen (caller falls back to Python/Unicode).
+// Word chars: [A-Za-z0-9_] — the same class the Python _WORD_RE uses for
+// ASCII input, so parity is exact on the fast path's domain.
+// ---------------------------------------------------------------------------
+
+int32_t tokenize_ascii(const uint8_t* text, int32_t len,
+                       uint8_t* lowered,            // out: len bytes
+                       int32_t* starts, int32_t* ends,
+                       int32_t max_tokens) {
+    int32_t count = 0;
+    int32_t i = 0;
+    while (i < len) {
+        uint8_t c = text[i];
+        if (c >= 0x80) return -1;                    // non-ASCII: fall back
+        bool word = (c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
+                    (c >= 'a' && c <= 'z') || c == '_';
+        lowered[i] = (c >= 'A' && c <= 'Z') ? (uint8_t)(c + 32) : c;
+        if (word) {
+            if (count >= max_tokens) return count;
+            int32_t start = i;
+            while (i < len) {
+                uint8_t d = text[i];
+                if (d >= 0x80) return -1;
+                bool w = (d >= '0' && d <= '9') || (d >= 'A' && d <= 'Z') ||
+                         (d >= 'a' && d <= 'z') || d == '_';
+                if (!w) break;
+                lowered[i] = (d >= 'A' && d <= 'Z') ? (uint8_t)(d + 32) : d;
+                i++;
+            }
+            starts[count] = start;
+            ends[count] = i;
+            count++;
+        } else {
+            i++;
+        }
+    }
+    return count;
+}
+
+}  // extern "C"

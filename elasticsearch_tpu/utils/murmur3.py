@@ -19,7 +19,30 @@ def _rotl32(x: int, r: int) -> int:
     return ((x << r) | (x >> (32 - r))) & _MASK
 
 
+_NATIVE = None
+
+
+def _native_handle():
+    """The C++ implementation when built (bit-exact, parity-tested in
+    tests/test_native.py — routing must never move when it appears)."""
+    global _NATIVE
+    if _NATIVE is None:
+        try:
+            from ..native import _LIB_HANDLE
+            _NATIVE = _LIB_HANDLE if _LIB_HANDLE is not None else False
+        except Exception:   # noqa: BLE001
+            _NATIVE = False
+    return _NATIVE
+
+
 def murmur3_32(data: bytes, seed: int = 0) -> int:
+    lib = _native_handle()
+    if lib:
+        return int(lib.murmur3_32(data, len(data), seed & 0xFFFFFFFF))
+    return _murmur3_32_py(data, seed)
+
+
+def _murmur3_32_py(data: bytes, seed: int = 0) -> int:
     h = seed & _MASK
     n = len(data)
     rounded = n - (n % 4)

@@ -1,0 +1,105 @@
+"""Native fast paths (C++ via ctypes): bit-exact parity with the Python
+implementations, transparent Unicode fallback, end-to-end analyzer
+equivalence. Skips gracefully when no toolchain built the library."""
+
+import random
+import string
+import pytest
+
+from elasticsearch_tpu import native
+from elasticsearch_tpu.index.analysis import (BUILTIN_ANALYZERS, Token,
+                                              lowercase_filter,
+                                              standard_tokenizer)
+from elasticsearch_tpu.utils import murmur3 as py_murmur3
+
+pytestmark = pytest.mark.skipif(not native.AVAILABLE,
+                                reason="native library unavailable")
+
+
+def test_murmur3_parity():
+    rng = random.Random(0)
+    cases = [b"", b"a", b"abc", b"hello world", b"\x00\x01\x02\x03",
+             "ünïcodé".encode("utf-8")]
+    cases += [bytes(rng.randrange(256) for _ in range(rng.randrange(64)))
+              for _ in range(500)]
+    for data in cases:
+        for seed in (0, 1, 0xDEADBEEF):
+            assert native.murmur3_32(data, seed) == \
+                py_murmur3._murmur3_32_py(data, seed), (data, seed)
+
+
+def test_routing_stability_native_vs_python():
+    """Doc→shard routing must be IDENTICAL whichever implementation runs
+    (a mismatch would re-route existing docs after an upgrade)."""
+    for i in range(2000):
+        key = f"doc-{i}".encode()
+        assert native.murmur3_32(key) == py_murmur3._murmur3_32_py(key)
+
+
+def test_tokenizer_parity_ascii():
+    rng = random.Random(2)
+    corpus = [
+        "The Quick Brown Fox... jumps! over_the lazy-dog 42 times",
+        "", "    ", "a", "A", "___", "x" * 500,
+        "comma,separated,values;and:more", "tabs\tand\nnewlines  here",
+    ]
+    alphabet = string.ascii_letters + string.digits + " _.,;:!?-()[]"
+    corpus += ["".join(rng.choice(alphabet)
+                       for _ in range(rng.randrange(120)))
+               for _ in range(300)]
+    for text in corpus:
+        want = lowercase_filter(standard_tokenizer(text))
+        got_raw = native.tokenize_ascii(text)
+        assert got_raw is not None, f"fast path refused ASCII: {text!r}"
+        got = [Token(t, p, s, e)
+               for p, (t, s, e) in enumerate(got_raw)]
+        assert [(t.term, t.position, t.start_offset, t.end_offset)
+                for t in got] == \
+            [(t.term, t.position, t.start_offset, t.end_offset)
+             for t in want], text
+
+
+def test_tokenizer_unicode_falls_back():
+    assert native.tokenize_ascii("héllo wörld") is None
+    # and the analyzer still handles it via the Python path
+    toks = BUILTIN_ANALYZERS["standard"].analyze("héllo wörld")
+    assert [t.term for t in toks] == ["héllo", "wörld"]
+
+
+def test_analyzer_end_to_end_uses_fast_path():
+    an = BUILTIN_ANALYZERS["standard"]
+    assert an._native_fast
+    toks = an.analyze("Fast Path TOKENS_42 here")
+    assert [t.term for t in toks] == ["fast", "path", "tokens_42", "here"]
+    assert [t.start_offset for t in toks] == [0, 5, 10, 20]
+    # english analyzer: stop+stem filters still run after the fused stage
+    en = BUILTIN_ANALYZERS["english"]
+    assert en._native_fast
+    assert [t.term for t in en.analyze("The running foxes")] == \
+        ["run", "fox"]
+
+
+def test_indexing_parity_native_vs_python(monkeypatch, tmp_path):
+    """Whole segments built with and without the native path are
+    term-for-term identical."""
+    from elasticsearch_tpu.index import analysis as an_mod
+    from elasticsearch_tpu.index.mapping import MapperService
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+
+    docs = ["The quick brown fox", "Lazy dogs sleep ALL day",
+            "running RUNS ran 42 times"]
+
+    def build():
+        mapper = MapperService({"properties": {"t": {"type": "text"}}})
+        b = SegmentBuilder("_p")
+        for i, d in enumerate(docs):
+            b.add(mapper.parse_document(str(i), {"t": d}), seq_no=i)
+        seg = b.build()
+        f = seg.text_fields["t"]
+        return (sorted(f.term_ids), f.df.tolist(), f.docs_host.tolist(),
+                f.tf_host.tolist(), f.pos_flat.tolist())
+
+    fast = build()
+    monkeypatch.setattr(an_mod, "_native_tokenize", lambda text: None)
+    slow = build()
+    assert fast == slow
