@@ -442,6 +442,35 @@ class CompletionFieldType(MappedFieldType):
             f"failed to parse completion input [{value}]")
 
 
+class BinaryFieldType(MappedFieldType):
+    """Base64 blobs (reference: ``BinaryFieldMapper``): stored in _source,
+    neither indexed nor doc-valued — exists queries consult the source."""
+
+    type_name = "binary"
+    is_searchable = False
+
+    def parse_value(self, value):
+        import base64
+        try:
+            base64.b64decode(str(value), validate=True)
+        except Exception as e:
+            raise MapperParsingError(
+                f"failed to parse field [{self.name}] of type [binary]"
+            ) from e
+        return str(value)
+
+
+class AliasFieldType(MappedFieldType):
+    """Field alias (reference: ``FieldAliasMapper``): queries and aggs on
+    the alias resolve to the target path; documents never write to it."""
+
+    type_name = "alias"
+
+    def __init__(self, name: str, path: str, params: dict):
+        super().__init__(name, params)
+        self.path = path
+
+
 class ObjectFieldType(MappedFieldType):
     type_name = "object"
     is_searchable = False
@@ -614,6 +643,13 @@ class MapperService:
             return CompletionFieldType(name, params)
         if ftype == "ip":
             return IpFieldType(name, params)
+        if ftype == "binary":
+            return BinaryFieldType(name, params)
+        if ftype == "alias":
+            if "path" not in spec:
+                raise MapperParsingError(
+                    f"Field [{name}] of type [alias] must have a [path]")
+            return AliasFieldType(name, spec["path"], params)
         if ftype in RANGE_TYPES:
             return RangeFieldType(name, ftype, params)
         if ftype == "search_as_you_type":
@@ -670,6 +706,12 @@ class MapperService:
         return self._mapping_def
 
     def field_type(self, name: str) -> Optional[MappedFieldType]:
+        ft = self._field_type_raw(name)
+        if isinstance(ft, AliasFieldType):
+            return self._field_type_raw(ft.path)
+        return ft
+
+    def _field_type_raw(self, name: str) -> Optional[MappedFieldType]:
         return self._fields.get(name)
 
     def field_names(self) -> List[str]:
@@ -729,7 +771,16 @@ class MapperService:
             for v in values:
                 if v is None:
                     continue
-                self._index_leaf(ft, full, v, parsed)
+                if isinstance(ft, AliasFieldType):
+                    raise MapperParsingError(
+                        f"Cannot write to a field alias [{full}].")
+                try:
+                    self._index_leaf(ft, full, v, parsed)
+                except MapperParsingError:
+                    # ignore_malformed drops the bad VALUE, keeps the doc
+                    # (the reference also records it in _ignored)
+                    if not ft.params.get("ignore_malformed"):
+                        raise
 
     def _maybe_geo(self, full: str, value: dict, parsed: ParsedDocument) -> bool:
         return False  # dynamic geo detection is off, like the reference default
@@ -812,6 +863,12 @@ class MapperService:
             lo, hi = ft.parse_value(value)
             parsed.numeric_values.setdefault(f"{full}._gte", []).append(lo)
             parsed.numeric_values.setdefault(f"{full}._lte", []).append(hi)
+        elif isinstance(ft, BinaryFieldType):
+            ft.parse_value(value)            # validate; stored in _source
+            # presence for exists queries via the _field_names meta field
+            # (the reference's FieldNamesFieldMapper)
+            parsed.keyword_terms.setdefault("_field_names",
+                                            []).append(full)
         elif isinstance(ft, KeywordFieldType):
             v = ft.parse_value(value)
             if v is not None:

@@ -19,6 +19,7 @@ import numpy as np
 from urllib.parse import parse_qs, unquote
 
 from ..common.errors import (DocumentMissingError, ElasticsearchError,
+                             ResourceNotFoundError,
                              IllegalArgumentError, IndexNotFoundError,
                              ParsingError, ResourceAlreadyExistsError,
                              VersionConflictError)
@@ -113,6 +114,12 @@ class RestAPI:
         add("PUT", "/_cluster/settings", self.h_cluster_put_settings)
         add("GET", "/_nodes", self.h_nodes)
         add("GET", "/_nodes/stats", self.h_nodes_stats)
+        add("GET", "/_nodes/stats/{metric}", self.h_nodes_stats)
+        add("GET", "/_nodes/stats/{metric}/{index_metric}",
+            self.h_nodes_stats)
+        add("GET", "/_nodes/{node_id}/stats", self.h_nodes_stats)
+        add("GET", "/_nodes/{node_id}/stats/{metric}",
+            self.h_nodes_stats)
         # cat
         add("GET", "/_cat/indices", self.h_cat_indices)
         add("GET", "/_cat/indices/{index}", self.h_cat_indices)
@@ -126,7 +133,9 @@ class RestAPI:
         add("GET,POST", "/_search", self.h_search)
         add("GET,POST", "/{index}/_search", self.h_search)
         add("GET,POST", "/_search/scroll", self.h_scroll)
+        add("GET,POST", "/_search/scroll/{scroll_id}", self.h_scroll)
         add("DELETE", "/_search/scroll", self.h_clear_scroll)
+        add("DELETE", "/_search/scroll/{scroll_id}", self.h_clear_scroll)
         add("GET,POST", "/{index}/_validate/query", self.h_validate_query)
         add("GET,POST", "/_validate/query", self.h_validate_query)
         add("GET,POST", "/_count", self.h_count)
@@ -184,7 +193,8 @@ class RestAPI:
         add("GET", "/_alias/{name}", self.h_get_alias)
         add("GET", "/{index}/_alias", self.h_get_alias)
         add("GET", "/{index}/_alias/{name}", self.h_get_alias)
-        add("PUT", "/{index}/_alias/{name}", self.h_put_alias)
+        add("PUT,POST", "/{index}/_alias/{name}", self.h_put_alias)
+        add("PUT,POST", "/{index}/_aliases/{name}", self.h_put_alias)
         add("DELETE", "/{index}/_alias/{name}", self.h_delete_alias)
         # index admin
         add("GET", "/_stats", self.h_stats)
@@ -193,7 +203,8 @@ class RestAPI:
         add("GET", "/{index}/_stats/{metric}", self.h_stats)
         add("POST", "/{index}/_close", self.h_close_index)
         add("POST", "/{index}/_open", self.h_open_index)
-        add("GET,PUT", "/{index}/_mapping", self.h_mapping)
+        add("GET,PUT,POST", "/{index}/_mapping", self.h_mapping)
+        add("GET", "/_mapping", self.h_mapping)
         add("GET", "/{index}/_mapping/field/{fields}",
             self.h_field_mapping)
         add("GET", "/_mapping/field/{fields}", self.h_field_mapping)
@@ -402,7 +413,8 @@ class RestAPI:
                     "roles": ["master", "data", "ingest"],
                     "version": "8.0.0-tpu"}}}
 
-    def h_nodes_stats(self, params, body):
+    def h_nodes_stats(self, params, body, metric=None,
+                      index_metric=None, node_id=None):
         total_docs = sum(sum(s.doc_count for s in svc.shards)
                          for svc in self.indices.indices.values())
         return {"_nodes": {"total": 1, "successful": 1, "failed": 0},
@@ -557,7 +569,7 @@ class RestAPI:
             raise IndexNotFoundError(f"no such index [{index}]")
         return out
 
-    def h_mapping(self, params, body, index):
+    def h_mapping(self, params, body, index=None):
         names = self.indices.resolve(index)
         if params.get("__method") == "PUT" or body:
             b = _json_body(body)
@@ -634,27 +646,65 @@ class RestAPI:
     # aliases / templates
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _alias_spec(spec: dict) -> dict:
+        """Normalize an alias definition: plain ``routing`` expands to
+        index_routing + search_routing (AliasAction semantics)."""
+        out = {}
+        if "filter" in spec:
+            out["filter"] = spec["filter"]
+        routing = spec.get("routing")
+        if routing is not None:
+            out["index_routing"] = str(routing)
+            out["search_routing"] = str(routing)
+        if spec.get("index_routing") is not None:
+            out["index_routing"] = str(spec["index_routing"])
+        if spec.get("search_routing") is not None:
+            out["search_routing"] = str(spec["search_routing"])
+        if "is_write_index" in spec:
+            out["is_write_index"] = bool(spec["is_write_index"])
+        return out
+
     def h_update_aliases(self, params, body):
         b = _json_body(body)
         for action in b.get("actions", []):
             (verb, spec), = action.items()
+            if verb == "remove_index":
+                target = spec.get("index") or ",".join(
+                    spec.get("indices", []))
+                if not target:
+                    raise IllegalArgumentError(
+                        "[remove_index] requires an index")
+                self.indices.delete_index(target)
+                continue
             idx_names = self.indices.resolve(
                 spec.get("index") or ",".join(spec.get("indices", [])),
                 allow_aliases=False)
             aliases = spec.get("aliases") or [spec.get("alias")]
+            if isinstance(aliases, str):
+                aliases = [aliases]
             for n in idx_names:
                 svc = self.indices.indices[n]
                 for a in aliases:
                     if verb == "add":
-                        svc.aliases[a] = {k: v for k, v in spec.items()
-                                          if k in ("filter", "routing")}
+                        svc.aliases[a] = self._alias_spec(spec)
                     elif verb == "remove":
-                        svc.aliases.pop(a, None)
-                    elif verb == "remove_index":
-                        pass
+                        pass             # applied after validation below
                     else:
                         raise IllegalArgumentError(
                             f"unknown alias action [{verb}]")
+            if verb == "remove":
+                # must_exist validates across ALL targets BEFORE mutating
+                # (atomic; the reference rejects when the alias exists on
+                # none of the indices)
+                if spec.get("must_exist", False) and not any(
+                        a in self.indices.indices[n].aliases
+                        for n in idx_names for a in aliases):
+                    raise ResourceNotFoundError(
+                        f"aliases [{','.join(aliases)}] missing")
+                for n in idx_names:
+                    for a in aliases:
+                        self.indices.indices[n].aliases.pop(a, None)
         return {"acknowledged": True}
 
     def h_get_alias(self, params, body, index=None, name=None):
@@ -674,8 +724,9 @@ class RestAPI:
         return out
 
     def h_put_alias(self, params, body, index, name):
+        spec = self._alias_spec(_json_body(body)) if body else {}
         for n in self.indices.resolve(index, allow_aliases=False):
-            self.indices.indices[n].aliases[name] = _json_body(body)
+            self.indices.indices[n].aliases[name] = spec
         return {"acknowledged": True}
 
     def h_delete_alias(self, params, body, index, name):
@@ -1323,6 +1374,9 @@ class RestAPI:
                              "max_score": None, "hits": []}}
         scroll = params.get("scroll")
         if scroll:
+            if int(search_body.get("size", 10)) == 0:
+                raise IllegalArgumentError(
+                    "[size] cannot be [0] in a scroll context")
             out = self._start_scroll(names, search_body, scroll)
         else:
             out = self._search_indices(names, search_body)
@@ -1403,9 +1457,9 @@ class RestAPI:
                      "max_score": None,
                      "hits": [self._hit_json(n, h) for n, h in page]}}
 
-    def h_scroll(self, params, body):
-        b = _json_body(body)
-        sid = b.get("scroll_id") or params.get("scroll_id")
+    def h_scroll(self, params, body, scroll_id=None):
+        b = _json_body(body) if body else {}
+        sid = scroll_id or b.get("scroll_id") or params.get("scroll_id")
         ctx = self.scrolls.get(sid)
         if ctx is None:
             return 404, {"error": {"type": "search_context_missing_exception",
@@ -1422,11 +1476,18 @@ class RestAPI:
                      "max_score": None,
                      "hits": [self._hit_json(n, h) for n, h in page]}}
 
-    def h_clear_scroll(self, params, body):
-        b = _json_body(body)
+    def h_clear_scroll(self, params, body, scroll_id=None):
+        b = _json_body(body) if body else {}
         ids = b.get("scroll_id", [])
         if isinstance(ids, str):
             ids = [ids]
+        if scroll_id:
+            ids = list(ids) + (["_all"] if scroll_id == "_all"
+                               else scroll_id.split(","))
+        if "_all" in ids:
+            n = len(self.scrolls)
+            self.scrolls.clear()
+            return {"succeeded": True, "num_freed": n}
         n = 0
         for sid in ids:
             if self.scrolls.pop(sid, None) is not None:

@@ -58,9 +58,18 @@ def _device_mask(seg, mask: np.ndarray):
 # ---------------------------------------------------------------------------
 
 
+def _concrete(mapper, field: str) -> str:
+    """Field alias → target path (FieldAliasMapper)."""
+    if mapper is None:
+        return field
+    ft = mapper.field_type(field)
+    return ft.name if ft is not None and ft.name != field else field
+
+
 def _numeric_pairs(seg: Segment, field: str, mapper=None):
     """(docs int32[M], vals float64[M]) host-side exact values, or None.
     Runtime fields materialize their computed column as pairs."""
+    field = _concrete(mapper, field)
     f = seg.numeric_fields.get(field)
     if f is not None and f.docs_host.shape[0] > 0:
         return f.docs_host, f.vals_host
@@ -74,8 +83,9 @@ def _numeric_pairs(seg: Segment, field: str, mapper=None):
     return None
 
 
-def _keyword_pairs(seg: Segment, field: str):
+def _keyword_pairs(seg: Segment, field: str, mapper=None):
     """(docs int32[M], ords int32[M], ord_terms list) or None."""
+    field = _concrete(mapper, field)
     f = seg.keyword_fields.get(field)
     if f is None or f.dv_docs_host.shape[0] == 0:
         return None
@@ -318,7 +328,7 @@ class ValueCountAgg(_NumericMetricAgg):
 
     def collect(self, ctx, seg, mask):
         # counts values of any doc-values type
-        kw = _keyword_pairs(seg, self.field)
+        kw = _keyword_pairs(seg, self.field, ctx.mapper)
         if kw is not None:
             docs, _, _ = kw[0], kw[1], kw[2]
             return {"count": int(mask[kw[0]].sum())}
@@ -401,7 +411,7 @@ class CardinalityAgg(Aggregator):
             body.get("precision_threshold", self.PRECISION_DEFAULT))
 
     def collect(self, ctx, seg, mask):
-        kw = _keyword_pairs(seg, self.field)
+        kw = _keyword_pairs(seg, self.field, ctx.mapper)
         if kw is not None:
             docs, ords, terms = kw
             sel = np.unique(ords[mask[docs]])
@@ -605,7 +615,7 @@ class TermsAgg(BucketAggregator):
         buckets: Dict[Any, Tuple[int, dict]] = {}
         trunc_err = 0
         self._mapper = ctx.mapper        # for key_as_string at reduce
-        kw = _keyword_pairs(seg, self.field)
+        kw = _keyword_pairs(seg, self.field, ctx.mapper)
         if kw is not None:
             docs, ords, terms = kw
             if docs.shape[0] >= ops_aggs.DEVICE_MIN_PAIRS:
@@ -954,13 +964,26 @@ class RangeAgg(BucketAggregator):
             raise ParsingError("range requires [field] and [ranges]")
         self.keyed = bool(body.get("keyed", False))
 
+    # bound parsing/formatting hooks: date_range/ip_range override these
+    # (aggs_extra.py)
+    def _parse_bound(self, v, which: str) -> float:
+        return float(v)
+
+    def _format_bound(self, v: float):
+        return float(v)
+
+    def _bounds(self, r):
+        frm = r.get("from")
+        to = r.get("to")
+        return (self._parse_bound(frm, "from") if frm is not None else None,
+                self._parse_bound(to, "to") if to is not None else None)
+
     def _range_key(self, r) -> str:
         if "key" in r:
             return r["key"]
-        frm = r.get("from")
-        to = r.get("to")
-        f = "*" if frm is None else f"{float(frm)}"
-        t = "*" if to is None else f"{float(to)}"
+        lo, hi = self._bounds(r)
+        f = "*" if lo is None else f"{self._format_bound(lo)}"
+        t = "*" if hi is None else f"{self._format_bound(hi)}"
         return f"{f}-{t}"
 
     def collect(self, ctx, seg, mask):
@@ -976,10 +999,11 @@ class RangeAgg(BucketAggregator):
                 continue
             docs, vals = num
             sel = np.ones(vals.shape[0], bool)
-            if r.get("from") is not None:
-                sel &= vals >= float(r["from"])
-            if r.get("to") is not None:
-                sel &= vals < float(r["to"])
+            lo, hi = self._bounds(r)
+            if lo is not None:
+                sel &= vals >= lo
+            if hi is not None:
+                sel &= vals < hi
             pm = mask[docs] & sel
             bucket_docs = np.zeros(mask.shape[0], bool)
             bucket_docs[docs[pm]] = True
@@ -999,10 +1023,11 @@ class RangeAgg(BucketAggregator):
             subs = _reduce_subs(self, [s for _, s in items]) \
                 if self.subs else {}
             b = {"key": key, "doc_count": count}
-            if r.get("from") is not None:
-                b["from"] = float(r["from"])
-            if r.get("to") is not None:
-                b["to"] = float(r["to"])
+            lo, hi = self._bounds(r)
+            if lo is not None:
+                b["from"] = self._format_bound(lo)
+            if hi is not None:
+                b["to"] = self._format_bound(hi)
             b.update(subs)
             buckets.append(b)
         if self.keyed:
@@ -1078,7 +1103,7 @@ class MissingAgg(BucketAggregator):
 
     def collect(self, ctx, seg, mask):
         has = np.zeros(mask.shape[0], bool)
-        kw = _keyword_pairs(seg, self.field)
+        kw = _keyword_pairs(seg, self.field, ctx.mapper)
         if kw is not None:
             has[kw[0]] = True
         num = _numeric_pairs(seg, self.field, ctx.mapper)

@@ -30,9 +30,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common.errors import IllegalArgumentError, ParsingError
-from .aggregations import (Aggregator, BucketAggregator, _bucket_payload,
-                           _keyword_pairs, _numeric_pairs, _reduce_subs,
-                           _sub_results)
+from .aggregations import (Aggregator, BucketAggregator, RangeAgg,
+                           _bucket_payload, _keyword_pairs, _numeric_pairs,
+                           _reduce_subs, _sub_results)
 
 
 # ---------------------------------------------------------------------------
@@ -453,11 +453,71 @@ class ReverseNestedAgg(BucketAggregator):
         return out
 
 
+class DateRangeAgg(RangeAgg):
+    """date_range (reference: ``bucket/range/DateRangeAggregationBuilder``):
+    bounds parse as dates (math expressions not yet), keys format as
+    ISO strings."""
+
+    def _parse_bound(self, v, which: str) -> float:
+        from ..index.mapping import parse_date_millis
+        return float(parse_date_millis(v))
+
+    def _format_bound(self, v: float):
+        return v
+
+    def _range_key(self, r) -> str:
+        if "key" in r:
+            return r["key"]
+        from ..index.mapping import format_date_millis
+        lo, hi = self._bounds(r)
+        f = "*" if lo is None else format_date_millis(lo)
+        t = "*" if hi is None else format_date_millis(hi)
+        return f"{f}-{t}"
+
+
+class IpRangeAgg(RangeAgg):
+    """ip_range (reference: ``bucket/range/IpRangeAggregationBuilder``):
+    bounds are addresses or CIDR masks over the ip field's numeric
+    column."""
+
+    def __init__(self, body):
+        ranges = []
+        for r in body.get("ranges") or []:
+            if "mask" in r:
+                from ..index.mapping import IpFieldType
+                bounds = IpFieldType.cidr_bounds(r["mask"])
+                if bounds is None:
+                    raise ParsingError(
+                        f"[ip_range] invalid mask [{r['mask']}]")
+                lo, hi = bounds
+                r = dict(r, **{"from": lo, "to": hi + 1,
+                               "key": r.get("key", r["mask"])})
+                r.pop("mask")
+            ranges.append(r)
+        super().__init__(dict(body, ranges=ranges))
+
+    def _parse_bound(self, v, which: str) -> float:
+        if isinstance(v, (int, float)):
+            return float(v)
+        import ipaddress
+        return float(int(ipaddress.ip_address(str(v))))
+
+    def _format_bound(self, v: float):
+        import ipaddress
+        if 0 <= v < 2 ** 32:
+            return str(ipaddress.IPv4Address(int(v)))
+        if v < 2 ** 128:
+            return str(ipaddress.IPv6Address(int(v)))
+        return float(v)                  # past the address space (mask /0)
+
+
 # self-registration: runs after this module's classes exist, against the
 # fully-initialized (or at least _AGG_PARSERS-bearing) aggregations module
 from .aggregations import _AGG_PARSERS      # noqa: E402
 
 _AGG_PARSERS.update({
+    "date_range": DateRangeAgg,
+    "ip_range": IpRangeAgg,
     "composite": CompositeAgg,
     "significant_terms": SignificantTermsAgg,
     "rare_terms": RareTermsAgg,

@@ -81,6 +81,12 @@ class ShardContext:
     def field_type(self, name: str):
         return self.mapper.field_type(name)
 
+    def concrete_field(self, name: str) -> str:
+        """Resolve a field ALIAS to its target path (segment tables key by
+        concrete names; FieldAliasMapper semantics)."""
+        ft = self.mapper.field_type(name)
+        return ft.name if ft is not None and ft.name != name else name
+
 
 def _const_result(seg: Segment, score: float, value: bool):
     n = seg.n_pad
@@ -266,6 +272,7 @@ class MatchQuery(Query):
         return [str(self.text)]
 
     def execute(self, ctx, seg):
+        self.field = ctx.concrete_field(self.field)
         ft = ctx.field_type(self.field)
         if ft is None:
             return _const_result(seg, 0.0, False)
@@ -304,6 +311,7 @@ class MatchPhraseQuery(Query):
         self.boost = boost
 
     def execute(self, ctx, seg):
+        self.field = ctx.concrete_field(self.field)
         ft = ctx.field_type(self.field)
         if ft is None:
             return _const_result(seg, 0.0, False)
@@ -382,6 +390,9 @@ class TermQuery(Query):
         self.boost = boost
 
     def execute(self, ctx, seg):
+        if self.field == "_id":
+            return IdsQuery([self.value], self.boost).execute(ctx, seg)
+        self.field = ctx.concrete_field(self.field)
         ft = ctx.field_type(self.field)
         if ft is None:
             return _const_result(seg, 0.0, False)
@@ -429,6 +440,9 @@ class TermsQuery(Query):
         self.boost = boost
 
     def execute(self, ctx, seg):
+        if self.field == "_id":
+            return IdsQuery(list(self.values), self.boost).execute(ctx, seg)
+        self.field = ctx.concrete_field(self.field)
         ft = ctx.field_type(self.field)
         if ft is None or not self.values:
             return _const_result(seg, 0.0, False)
@@ -556,6 +570,7 @@ class RangeQuery(Query):
                 f"[range] unknown relation [{relation}]")
 
     def execute(self, ctx, seg):
+        self.field = ctx.concrete_field(self.field)
         ft = ctx.field_type(self.field)
         if ft is None:
             return _const_result(seg, 0.0, False)
@@ -663,21 +678,24 @@ class ExistsQuery(Query):
         self.boost = boost
 
     def execute(self, ctx, seg):
+        field = ctx.concrete_field(self.field)
         exists = np.zeros(seg.n_pad, bool)
-        tf_ = seg.text_fields.get(self.field)
+        tf_ = seg.text_fields.get(field)
         if tf_ is not None:
             exists[: seg.n_docs] |= tf_.doc_len_host > 0
-        kf = seg.keyword_fields.get(self.field)
+        kf = seg.keyword_fields.get(field)
         if kf is not None:
             exists[kf.dv_docs_host] = True
-        nf = seg.numeric_fields.get(self.field)
+        nf = seg.numeric_fields.get(field)
         if nf is not None:
             exists[nf.docs_host] = True
-        vf = seg.vector_fields.get(self.field)
+        vf = seg.vector_fields.get(field)
         if vf is not None:
             exists[: seg.n_docs] |= vf.exists
-        # also any subfield counts? reference: exists matches docs with any
-        # indexed value for the exact field name only.
+        fn = seg.keyword_fields.get("_field_names")
+        if fn is not None:               # source-only types (binary)
+            st, ln, _ = fn.term_run(field)
+            exists[fn.docs_host[st: st + ln]] = True
         mask = jnp.asarray(exists)
         return jnp.where(mask, np.float32(self.boost), 0.0), mask
 
@@ -708,6 +726,7 @@ class PrefixQuery(Query):
         self.boost = boost
 
     def execute(self, ctx, seg):
+        self.field = ctx.concrete_field(self.field)
         import bisect
         ft = ctx.field_type(self.field)
         value = self.value
@@ -759,6 +778,7 @@ class WildcardQuery(Query):
             self._re = re.compile(f"{esc}\\Z")
 
     def execute(self, ctx, seg):
+        self.field = ctx.concrete_field(self.field)
         mask = np.zeros(seg.n_pad, bool)
         f = seg.text_fields.get(self.field)
         if f is not None:
@@ -800,6 +820,7 @@ class FuzzyQuery(Query):
         return _edit_distance_le(term, self.value, self.max_edits)
 
     def execute(self, ctx, seg):
+        self.field = ctx.concrete_field(self.field)
         mask = np.zeros(seg.n_pad, bool)
         f = seg.text_fields.get(self.field)
         if f is not None:
@@ -1507,6 +1528,29 @@ class QueryStringQuery(Query):
         self.inner.collect_highlight_terms(ctx, out)
 
 
+def _parse_match_bool_prefix(body):
+    """match_bool_prefix (reference: ``MatchBoolPrefixQueryBuilder``):
+    every analyzed term as a term clause, the LAST as a prefix."""
+    if not isinstance(body, dict) or len(body) != 1:
+        raise ParsingError("[match_bool_prefix] requires exactly one field")
+    (field, spec), = body.items()
+    if isinstance(spec, str):
+        spec = {"query": spec}
+    text = str(spec.get("query", ""))
+    operator = str(spec.get("operator", "or")).lower()
+    terms = text.split()
+    clauses: List[Query] = []
+    for t in terms[:-1]:
+        clauses.append(MatchQuery(field, t))
+    if terms:
+        clauses.append(PrefixQuery(field, terms[-1].lower()))
+    if not clauses:
+        return MatchNoneQuery()
+    if operator == "and":
+        return BoolQuery(must=clauses)
+    return BoolQuery(should=clauses, minimum_should_match=1)
+
+
 def _parse_query_string(body):
     if "query" not in body:
         raise ParsingError("[query_string] requires [query]")
@@ -1607,6 +1651,7 @@ _PARSERS = {
     "fuzzy": _parse_fuzzy,
     "boosting": _parse_boosting,
     "nested": _parse_nested,
+    "match_bool_prefix": _parse_match_bool_prefix,
     "query_string": _parse_query_string,
     "simple_query_string": _parse_simple_query_string,
 }

@@ -38,7 +38,8 @@ from urllib.parse import quote
 REFERENCE_SPEC_ROOT = "/root/reference/rest-api-spec/src/main/resources/rest-api-spec"
 
 #: yaml test features this runner understands
-SUPPORTED_FEATURES = {"headers", "allowed_warnings", "warnings"}
+SUPPORTED_FEATURES = {"headers", "allowed_warnings", "warnings",
+                      "arbitrary_key"}
 
 
 class ApiRegistry:
@@ -321,6 +322,12 @@ class YamlTestRunner:
         for raw in parts:
             key = raw.replace("\\.", ".")
             key = self._subst(key, state)
+            if key == "_arbitrary_key_" and isinstance(cur, dict):
+                if not cur:
+                    raise StepFailure(f"path [{path}]: empty for "
+                                      f"arbitrary key")
+                cur = next(iter(cur))        # the KEY itself (feature)
+                continue
             if isinstance(cur, list):
                 try:
                     cur = cur[int(key)]

@@ -294,3 +294,45 @@ def test_child_uid_cannot_shadow_real_doc():
     seg = s.segments[0]
     d = seg.find_doc("1#comments#0")
     assert d is not None and seg.parent_mask[d]
+
+
+def test_field_alias_resolves_in_queries_and_aggs():
+    s = build_searcher(
+        {"properties": {"k": {"type": "keyword"},
+                        "n": {"type": "integer"},
+                        "ka": {"type": "alias", "path": "k"},
+                        "na": {"type": "alias", "path": "n"}}},
+        [("1", {"k": "x", "n": 5}), ("2", {"k": "y", "n": 9})])
+    r = s.search({"query": {"term": {"ka": "x"}}})
+    assert [h.doc_id for h in r.hits] == ["1"]
+    r = s.search({"query": {"range": {"na": {"gte": 7}}}})
+    assert [h.doc_id for h in r.hits] == ["2"]
+    r = s.search({"size": 0, "aggs": {
+        "t": {"terms": {"field": "ka"}},
+        "m": {"max": {"field": "na"}}}})
+    assert {b["key"] for b in r.aggregations["t"]["buckets"]} == {"x", "y"}
+    assert r.aggregations["m"]["value"] == 9
+    r = s.search({"query": {"exists": {"field": "ka"}}})
+    assert r.total == 2
+    # writing to an alias is rejected
+    with pytest.raises(MapperParsingError):
+        build_searcher(
+            {"properties": {"k": {"type": "keyword"},
+                            "ka": {"type": "alias", "path": "k"}}},
+            [("1", {"ka": "nope"})])
+
+
+def test_binary_field_and_ignore_malformed():
+    s = build_searcher(
+        {"properties": {"blob": {"type": "binary"},
+                        "n": {"type": "integer",
+                              "ignore_malformed": True}}},
+        [("1", {"blob": "aGVsbG8=", "n": 5}),
+         ("2", {"n": "not-a-number"}),      # dropped value, doc kept
+         ("3", {})])
+    r = s.search({"query": {"exists": {"field": "blob"}}})
+    assert [h.doc_id for h in r.hits] == ["1"]
+    r = s.search({"query": {"match_all": {}}})
+    assert r.total == 3
+    r = s.search({"query": {"range": {"n": {"gte": 0}}}})
+    assert [h.doc_id for h in r.hits] == ["1"]

@@ -45,12 +45,15 @@ ALLOWLIST = [
     "index/20_optype.yml",
     "index/30_cas.yml",
     "index/40_routing.yml",
+    "indices.delete_alias/10_basic.yml",
     "indices.get_alias/20_empty.yml",
     "indices.get_field_mapping/20_missing_field.yml",
     "indices.get_field_mapping/40_missing_index.yml",
     "indices.get_field_mapping/50_field_wildcards.yml",
+    "indices.get_mapping/40_aliases.yml",
     "indices.open/10_basic.yml",
     "indices.open/20_multiple_indices.yml",
+    "indices.update_aliases/20_routing.yml",
     "indices.validate_query/20_query_string.yml",
     "info/10_info.yml",
     "info/20_lucene_version.yml",
@@ -72,7 +75,7 @@ ALLOWLIST = [
 ]
 
 #: corpus-wide pass floor (ratchet: raise when conformance climbs)
-SWEEP_FLOOR = 270
+SWEEP_FLOOR = 360
 
 
 def test_allowlisted_suites_pass_completely():
