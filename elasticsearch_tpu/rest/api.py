@@ -201,6 +201,11 @@ class RestAPI:
         add("GET", "/_stats/{metric}", self.h_stats)
         add("GET", "/{index}/_stats", self.h_stats)
         add("GET", "/{index}/_stats/{metric}", self.h_stats)
+        add("POST", "/{index}/_rollover", self.h_rollover)
+        add("POST", "/{index}/_rollover/{new_index}", self.h_rollover)
+        add("PUT,POST", "/{index}/_shrink/{target}", self.h_shrink)
+        add("PUT,POST", "/{index}/_split/{target}", self.h_split)
+        add("PUT,POST", "/{index}/_clone/{target}", self.h_clone)
         add("POST", "/{index}/_close", self.h_close_index)
         add("POST", "/{index}/_open", self.h_open_index)
         add("GET,PUT,POST", "/{index}/_mapping", self.h_mapping)
@@ -347,6 +352,121 @@ class RestAPI:
 
     def h_pending_tasks(self, params, body):
         return {"tasks": []}
+
+    _ROLLOVER_RE = re.compile(r"^(.*?)-(\d+)$")
+
+    def h_rollover(self, params, body, index, new_index=None):
+        """Rollover (reference: ``MetadataRolloverService`` /
+        ``TransportRolloverAction``): the alias moves to a freshly created
+        index when any condition matches (or unconditionally)."""
+        alias = index
+        targets = [n for n, svc in self.indices.indices.items()
+                   if alias in svc.aliases]
+        if len(targets) != 1:
+            raise IllegalArgumentError(
+                f"rollover target [{alias}] must point to exactly one "
+                f"index, found {len(targets)}")
+        old = targets[0]
+        svc = self.indices.get(old)
+        payload = _json_body(body) if body else {}
+        conditions = payload.get("conditions") or {}
+        st = svc.stats()
+        age_s = max(0.0, time.time() - svc.creation_date / 1000.0)
+        results = {}
+        for cond, want in conditions.items():
+            if cond == "max_docs":
+                results[cond] = st["docs"]["count"] >= int(want)
+            elif cond == "max_age":
+                from ..common.settings import parse_time_millis
+                results[cond] = age_s * 1000 >= parse_time_millis(want)
+            elif cond in ("max_size", "max_primary_shard_size"):
+                from ..common.settings import parse_bytes
+                results[cond] = st["store"]["size_in_bytes"] >= \
+                    parse_bytes(want)
+            else:
+                raise IllegalArgumentError(
+                    f"unknown rollover condition [{cond}]")
+        do_roll = (not conditions) or any(results.values())
+        if new_index is None:
+            m = self._ROLLOVER_RE.match(old)
+            if m is None:
+                raise IllegalArgumentError(
+                    f"index name [{old}] does not match pattern '^.*-\\d+$'"
+                )
+            new_index = f"{m.group(1)}-{int(m.group(2)) + 1:06d}"
+        dry = _flag(params, "dry_run")
+        if do_roll and not dry:
+            self.indices.create_index(
+                new_index, payload.get("settings"),
+                payload.get("mappings") or
+                svc.mapper.mapping_dict())
+            self.indices.indices[new_index].aliases[alias] =                 dict(svc.aliases.get(alias) or {})
+            del svc.aliases[alias]
+        return {"acknowledged": do_roll and not dry,
+                "shards_acknowledged": do_roll and not dry,
+                "old_index": old, "new_index": new_index,
+                "rolled_over": do_roll and not dry, "dry_run": dry,
+                "conditions": {f"[{k}: {conditions[k]}]": v
+                               for k, v in results.items()}}
+
+    def _resize(self, index, target, num_shards, body, kind):
+        svc = self.indices.get(index)
+        payload = _json_body(body) if body else {}
+        settings = payload.get("settings") or {}
+        flat_requested = dict(settings.get("index", settings))
+        n = int(flat_requested.get("number_of_shards", num_shards))
+        if kind == "shrink" and svc.num_shards % n:
+            raise IllegalArgumentError(
+                f"the number of source shards [{svc.num_shards}] must be "
+                f"a multiple of [{n}]")
+        if kind == "split" and (n % svc.num_shards or n <= svc.num_shards):
+            raise IllegalArgumentError(
+                f"the number of target shards [{n}] must be a larger "
+                f"multiple of the source shards [{svc.num_shards}]")
+        if kind == "clone" and n != svc.num_shards:
+            raise IllegalArgumentError(
+                f"cannot clone to a different shard count [{n}] than the "
+                f"source [{svc.num_shards}]")
+        # target settings: the source's (minus shard count — analysis etc.
+        # must survive or copied mappings dangle), overlaid with requested
+        base = {k: v for k, v in svc.settings.items()
+                if k not in ("index.number_of_shards", "number_of_shards")}
+        base.update({f"index.{k}" if not k.startswith("index.") else k: v
+                     for k, v in flat_requested.items()})
+        base["index.number_of_shards"] = n
+        dst = self.indices.create_index(target, base,
+                                        svc.mapper.mapping_dict())
+        for alias, spec in (payload.get("aliases") or {}).items():
+            dst.aliases[alias] = self._alias_spec(spec or {})
+        # the reference hard-links segment files and rewrites routing;
+        # shard counts change here so documents re-route through the data
+        # path (same semantics, different mechanics)
+        svc.refresh()
+        total = svc.count({"query": {"match_all": {}}})
+        if total > self.SCROLL_MAX_DOCS:
+            self.indices.delete_index(target)
+            raise IllegalArgumentError(
+                f"[{kind}] source has {total} docs, beyond the "
+                f"{self.SCROLL_MAX_DOCS}-doc single-pass copy limit")
+        res = svc.search({"query": {"match_all": {}},
+                          "size": self.SCROLL_MAX_DOCS})
+        for h in res.hits:
+            dst.index_doc(h.doc_id, h.source)
+        dst.refresh()
+        return {"acknowledged": True, "shards_acknowledged": True,
+                "index": target}
+
+    def h_shrink(self, params, body, index, target):
+        return self._resize(index, target, 1, body, "shrink")
+
+    def h_split(self, params, body, index, target):
+        svc = self.indices.get(index)
+        return self._resize(index, target, svc.num_shards * 2, body,
+                            "split")
+
+    def h_clone(self, params, body, index, target):
+        svc = self.indices.get(index)
+        return self._resize(index, target, svc.num_shards, body, "clone")
 
     def h_close_index(self, params, body, index):
         names = self.indices.resolve(index)

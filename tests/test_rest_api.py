@@ -327,3 +327,86 @@ def test_reindex_and_tasks(api):
     node_tasks = list(out["nodes"].values())[0]["tasks"]
     assert any(t["action"] == "indices:data/write/reindex"
                for t in node_tasks.values())
+
+
+def test_rollover(api):
+    req(api, "PUT", "/logs-000001", {"aliases": {"logs": {}}})
+    for i in range(5):
+        req(api, "PUT", f"/logs-000001/_doc/{i}", {"n": i})
+    # conditions unmet → no rollover
+    st, out = req(api, "POST", "/logs/_rollover",
+                  {"conditions": {"max_docs": 100}})
+    assert out["rolled_over"] is False and out["old_index"] == "logs-000001"
+    # condition met → rollover to logs-000002, alias moves
+    st, out = req(api, "POST", "/logs/_rollover",
+                  {"conditions": {"max_docs": 3}})
+    assert out["rolled_over"] is True
+    assert out["new_index"] == "logs-000002"
+    st, _ = req(api, "PUT", "/logs/_doc/x", {"n": 99},
+                query="refresh=true")
+    st, d = req(api, "GET", "/logs-000002/_doc/x")
+    assert d["found"]
+    # dry_run evaluates without acting
+    st, out = req(api, "POST", "/logs/_rollover", {},
+                  query="dry_run=true")
+    assert out["dry_run"] is True and out["rolled_over"] is False
+    assert "logs-000003" not in api.indices.indices
+
+
+def test_shrink_split_clone(api):
+    req(api, "PUT", "/big", {"settings": {"index": {"number_of_shards": 4}}})
+    for i in range(20):
+        req(api, "PUT", f"/big/_doc/{i}", {"n": i})
+    req(api, "POST", "/big/_refresh")
+    st, out = req(api, "PUT", "/big/_shrink/small", {"settings": {
+        "index": {"number_of_shards": 2}}})
+    assert st == 200
+    assert api.indices.indices["small"].num_shards == 2
+    st, out = req(api, "POST", "/small/_search",
+                  {"query": {"match_all": {}}})
+    assert out["hits"]["total"]["value"] == 20
+    st, out = req(api, "PUT", "/big/_split/bigger", {"settings": {
+        "index": {"number_of_shards": 8}}})
+    assert api.indices.indices["bigger"].num_shards == 8
+    st, out = req(api, "POST", "/bigger/_search",
+                  {"query": {"match_all": {}}})
+    assert out["hits"]["total"]["value"] == 20
+    st, _ = req(api, "PUT", "/big/_clone/copy", None)
+    st, out = req(api, "POST", "/copy/_search",
+                  {"query": {"match_all": {}}})
+    assert out["hits"]["total"]["value"] == 20
+    # invalid factors rejected
+    st, _ = req(api, "PUT", "/big/_shrink/bad", {"settings": {
+        "index": {"number_of_shards": 3}}})
+    assert st == 400
+
+
+def test_rollover_dry_run_spellings_and_resize_validation(api):
+    req(api, "PUT", "/r-000001", {"aliases": {"r": {}}})
+    req(api, "PUT", "/r-000001/_doc/1", {"n": 1})
+    # any truthy dry_run spelling must NOT roll over
+    st, out = req(api, "POST", "/r/_rollover", {}, query="dry_run=1")
+    assert out["dry_run"] is True and out["rolled_over"] is False
+    assert "r-000002" not in api.indices.indices
+    # malformed byte size is a 400, not a 500
+    st, out = req(api, "POST", "/r/_rollover",
+                  {"conditions": {"max_size": "1.2.3gb"}})
+    assert st == 400
+    # clone must keep the shard count; split must strictly grow
+    req(api, "PUT", "/rz", {"settings": {"index": {"number_of_shards": 4}}})
+    st, _ = req(api, "PUT", "/rz/_clone/rz2", {"settings": {
+        "index": {"number_of_shards": 3}}})
+    assert st == 400
+    st, _ = req(api, "PUT", "/rz/_split/rz3", {"settings": {
+        "index": {"number_of_shards": 4}}})
+    assert st == 400
+    # resize carries requested aliases
+    req(api, "PUT", "/rz/_doc/1", {"n": 1})
+    st, _ = req(api, "PUT", "/rz/_shrink/rzs", {
+        "settings": {"index": {"number_of_shards": 2}},
+        "aliases": {"rz-alias": {}}})
+    assert st == 200
+    req(api, "POST", "/rzs/_refresh")
+    st, out = req(api, "POST", "/rz-alias/_search",
+                  {"query": {"match_all": {}}})
+    assert st == 200 and out["hits"]["total"]["value"] == 1
