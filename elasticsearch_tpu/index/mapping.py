@@ -241,20 +241,55 @@ class DenseVectorFieldType(MappedFieldType):
         return arr
 
 
+_GEOHASH_B32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+_GEOHASH_ORD = {c: i for i, c in enumerate(_GEOHASH_B32)}
+
+
+def geohash_decode(h: str):
+    """Geohash → (lat, lon) cell center (``Geohash.java`` semantics)."""
+    lat_lo, lat_hi, lon_lo, lon_hi = -90.0, 90.0, -180.0, 180.0
+    even = True
+    for c in h:
+        bits = _GEOHASH_ORD[c]
+        for shift in range(4, -1, -1):
+            bit = (bits >> shift) & 1
+            if even:
+                mid = (lon_lo + lon_hi) / 2
+                lon_lo, lon_hi = (mid, lon_hi) if bit else (lon_lo, mid)
+            else:
+                mid = (lat_lo + lat_hi) / 2
+                lat_lo, lat_hi = (mid, lat_hi) if bit else (lat_lo, mid)
+            even = not even
+    return ((lat_lo + lat_hi) / 2, (lon_lo + lon_hi) / 2)
+
+
 class GeoPointFieldType(MappedFieldType):
     type_name = "geo_point"
     has_doc_values = True
 
     def parse_value(self, value):
-        # Accept {"lat":..,"lon":..}, [lon, lat], "lat,lon", geohash not yet.
-        if isinstance(value, dict):
-            lat, lon = float(value["lat"]), float(value["lon"])
-        elif isinstance(value, (list, tuple)):
-            lon, lat = float(value[0]), float(value[1])
-        elif isinstance(value, str):
-            parts = value.split(",")
-            lat, lon = float(parts[0]), float(parts[1])
-        else:
+        # Accept {"lat":..,"lon":..}, [lon, lat], "lat,lon", and geohash.
+        try:
+            if isinstance(value, dict):
+                if "geohash" in value:
+                    lat, lon = geohash_decode(str(value["geohash"]))
+                else:
+                    lat, lon = float(value["lat"]), float(value["lon"])
+            elif isinstance(value, (list, tuple)):
+                lon, lat = float(value[0]), float(value[1])
+            elif isinstance(value, str):
+                if "," in value:
+                    parts = value.split(",")
+                    lat, lon = float(parts[0]), float(parts[1])
+                elif all(c in _GEOHASH_ORD for c in value) and value:
+                    lat, lon = geohash_decode(value)
+                else:
+                    raise MapperParsingError(
+                        f"failed to parse geo_point [{value}]")
+            else:
+                raise MapperParsingError(
+                    f"failed to parse geo_point [{value}]")
+        except (ValueError, TypeError, KeyError, IndexError):
             raise MapperParsingError(f"failed to parse geo_point [{value}]")
         if not (-90 <= lat <= 90) or not (-180 <= lon <= 180):
             raise MapperParsingError(f"geo_point out of bounds [{value}]")
@@ -881,7 +916,12 @@ class MapperService:
         elif isinstance(ft, DenseVectorFieldType):
             parsed.vectors[full] = ft.parse_value(value)
         elif isinstance(ft, GeoPointFieldType):
-            parsed.geo_points.setdefault(full, []).append(ft.parse_value(value))
+            lat, lon = ft.parse_value(value)
+            parsed.geo_points.setdefault(full, []).append((lat, lon))
+            # paired positional columns (lockstep append, like range fields'
+            # _gte/_lte) so distance/grid queries and aggs read doc values
+            parsed.numeric_values.setdefault(f"{full}._lat", []).append(lat)
+            parsed.numeric_values.setdefault(f"{full}._lon", []).append(lon)
         elif isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType)):
             parsed.numeric_values.setdefault(full, []).append(ft.parse_value(value))
         # index multi-fields too

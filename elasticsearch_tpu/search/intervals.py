@@ -1,0 +1,327 @@
+"""Minimal-interval algebra over segment position lists.
+
+The engine behind the ``intervals`` query (reference:
+``index/query/IntervalQueryBuilder.java`` + Lucene's
+``queries/intervals/``) and the span family (reference:
+``index/query/SpanNearQueryBuilder.java`` etc.). The reference delegates
+to Lucene's lazy minimal-interval iterators; here candidate docs are
+found with device postings masks first, then per-candidate interval sets
+are computed host-side from the segment's position CSR — the same
+device-filter → host-verify split the phrase query uses
+(``query_dsl.MatchPhraseQuery``).
+
+An interval is an inclusive ``(start, end)`` position pair. Sources
+produce the MINIMAL intervals for a doc (no produced interval properly
+contains another), matching Lucene's minimal interval semantics; filters
+prune them against a second source's intervals.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Interval = Tuple[int, int]
+
+#: cap on chains enumerated per doc per combiner — positions within one
+#: document are sentence-scale; this guards pathological repetition.
+MAX_CHAINS = 65536
+
+#: cap on terms a multi-term source expands to (Lucene:
+#: ``IntervalQueryBuilder`` expands through the same 128-term limit).
+MAX_EXPANSIONS = 128
+
+
+def _minimal(intervals: List[Interval]) -> List[Interval]:
+    """Drop every interval that properly contains another one."""
+    if len(intervals) <= 1:
+        return intervals
+    uniq = sorted(set(intervals))
+    out = []
+    for i, (s, e) in enumerate(uniq):
+        contains_other = any(
+            (s2, e2) != (s, e) and s2 >= s and e2 <= e
+            for (s2, e2) in uniq)
+        if not contains_other:
+            out.append((s, e))
+    return out
+
+
+class IntervalSource:
+    """One node of the interval expression tree, bound to a field."""
+
+    field: str = ""
+
+    def doc_candidates(self, seg) -> np.ndarray:
+        """Local doc ids that MAY produce intervals (superset)."""
+        raise NotImplementedError
+
+    def intervals(self, seg, doc: int) -> List[Interval]:
+        raise NotImplementedError
+
+    def leaf_weights(self, seg) -> List[Tuple[str, str]]:
+        """(field, term) pairs for scoring/idf purposes."""
+        raise NotImplementedError
+
+
+def _term_docs(seg, field: str, term: str) -> np.ndarray:
+    f = seg.text_fields.get(field)
+    if f is None:
+        return np.empty(0, np.int32)
+    start, length, _ = f.term_run(term)
+    return f.docs_host[start:start + length]
+
+
+def _term_positions(seg, field: str, term: str, doc: int) -> np.ndarray:
+    f = seg.text_fields.get(field)
+    if f is None:
+        return np.empty(0, np.int32)
+    return f.positions_for(term, doc)
+
+
+class TermSource(IntervalSource):
+    def __init__(self, field: str, term: str):
+        self.field = field
+        self.term = term
+
+    def doc_candidates(self, seg):
+        return _term_docs(seg, self.field, self.term)
+
+    def intervals(self, seg, doc):
+        return [(int(p), int(p))
+                for p in _term_positions(seg, self.field, self.term, doc)]
+
+    def leaf_weights(self, seg):
+        return [(self.field, self.term)]
+
+
+class ExpansionSource(IntervalSource):
+    """Multi-term source: prefix / wildcard / fuzzy / regexp, expanded
+    against each segment's term dictionary (capped at MAX_EXPANSIONS)."""
+
+    def __init__(self, field: str, predicate, descr: str):
+        self.field = field
+        self.predicate = predicate      # term -> bool
+        self.descr = descr
+        self._cache = {}                # id(seg) -> expanded terms
+
+    def _terms(self, seg) -> List[str]:
+        key = id(seg)
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        f = seg.text_fields.get(self.field)
+        out: List[str] = []
+        if f is not None:
+            for t in f.term_ids:
+                if self.predicate(t):
+                    out.append(t)
+                    if len(out) >= MAX_EXPANSIONS:
+                        break
+        self._cache[key] = out
+        return out
+
+    def doc_candidates(self, seg):
+        runs = [_term_docs(seg, self.field, t) for t in self._terms(seg)]
+        if not runs:
+            return np.empty(0, np.int32)
+        return np.unique(np.concatenate(runs))
+
+    def intervals(self, seg, doc):
+        out: List[Interval] = []
+        for t in self._terms(seg):
+            out.extend((int(p), int(p))
+                       for p in _term_positions(seg, self.field, t, doc))
+        return sorted(set(out))
+
+    def leaf_weights(self, seg):
+        return [(self.field, t) for t in self._terms(seg)]
+
+
+class CombineSource(IntervalSource):
+    """all_of (ordered/unordered + max_gaps) over sub-sources."""
+
+    def __init__(self, subs: Sequence[IntervalSource], ordered: bool,
+                 max_gaps: int = -1):
+        self.subs = list(subs)
+        self.ordered = ordered
+        self.max_gaps = max_gaps
+        self.field = subs[0].field if subs else ""
+
+    def doc_candidates(self, seg):
+        runs = [s.doc_candidates(seg) for s in self.subs]
+        if not runs or any(r.size == 0 for r in runs):
+            return np.empty(0, np.int32)
+        out = runs[0]
+        for r in runs[1:]:
+            out = np.intersect1d(out, r, assume_unique=False)
+        return out
+
+    def intervals(self, seg, doc):
+        sub_ints = [s.intervals(seg, doc) for s in self.subs]
+        if any(not si for si in sub_ints):
+            return []
+        total = 1
+        for si in sub_ints:
+            total *= len(si)
+            if total > MAX_CHAINS:
+                sub_ints = [si[:8] for si in sub_ints]   # bounded fallback
+                break
+        out: List[Interval] = []
+        for chain in itertools.product(*sub_ints):
+            if self.ordered:
+                ok = all(chain[i + 1][0] > chain[i][1]
+                         for i in range(len(chain) - 1))
+                if not ok:
+                    continue
+            s = min(c[0] for c in chain)
+            e = max(c[1] for c in chain)
+            if not self.ordered:
+                # unordered requires genuinely distinct sub-interval slots:
+                # two subs may not collapse onto the identical interval
+                if len({c for c in chain}) < len(chain):
+                    continue
+            if self.max_gaps >= 0:
+                width = e - s + 1
+                inner = sum(c[1] - c[0] + 1 for c in chain)
+                if width - inner > self.max_gaps:
+                    continue
+            out.append((s, e))
+        return _minimal(out)
+
+    def leaf_weights(self, seg):
+        out = []
+        for s in self.subs:
+            out.extend(s.leaf_weights(seg))
+        return out
+
+
+class AnyOfSource(IntervalSource):
+    def __init__(self, subs: Sequence[IntervalSource]):
+        self.subs = list(subs)
+        self.field = subs[0].field if subs else ""
+
+    def doc_candidates(self, seg):
+        runs = [s.doc_candidates(seg) for s in self.subs]
+        runs = [r for r in runs if r.size]
+        if not runs:
+            return np.empty(0, np.int32)
+        return np.unique(np.concatenate(runs))
+
+    def intervals(self, seg, doc):
+        out: List[Interval] = []
+        for s in self.subs:
+            out.extend(s.intervals(seg, doc))
+        return _minimal(out)
+
+    def leaf_weights(self, seg):
+        out = []
+        for s in self.subs:
+            out.extend(s.leaf_weights(seg))
+        return out
+
+
+class FilteredSource(IntervalSource):
+    """Applies an interval filter (containing / overlapping / before / …)
+    from the reference's ``IntervalFilterBuilder``."""
+
+    KINDS = ("containing", "not_containing", "contained_by",
+             "not_contained_by", "overlapping", "not_overlapping",
+             "before", "after")
+
+    def __init__(self, source: IntervalSource, kind: str,
+                 reference: IntervalSource):
+        self.source = source
+        self.kind = kind
+        self.reference = reference
+        self.field = source.field
+
+    def doc_candidates(self, seg):
+        return self.source.doc_candidates(seg)
+
+    def intervals(self, seg, doc):
+        ints = self.source.intervals(seg, doc)
+        if not ints:
+            return []
+        refs = self.reference.intervals(seg, doc)
+        kind = self.kind
+        out = []
+        for (s, e) in ints:
+            if kind == "containing":
+                keep = any(fs >= s and fe <= e for fs, fe in refs)
+            elif kind == "not_containing":
+                keep = not any(fs >= s and fe <= e for fs, fe in refs)
+            elif kind == "contained_by":
+                keep = any(s >= fs and e <= fe for fs, fe in refs)
+            elif kind == "not_contained_by":
+                keep = not any(s >= fs and e <= fe for fs, fe in refs)
+            elif kind == "overlapping":
+                keep = any(fs <= e and fe >= s for fs, fe in refs)
+            elif kind == "not_overlapping":
+                keep = not any(fs <= e and fe >= s for fs, fe in refs)
+            elif kind == "before":
+                keep = any(e < fs for fs, fe in refs)
+            elif kind == "after":
+                keep = any(s > fe for fs, fe in refs)
+            else:
+                keep = True
+            if keep:
+                out.append((s, e))
+        return out
+
+    def leaf_weights(self, seg):
+        return self.source.leaf_weights(seg)
+
+
+class FirstSource(IntervalSource):
+    """span_first: intervals ending within the first ``end`` positions."""
+
+    def __init__(self, source: IntervalSource, end: int):
+        self.source = source
+        self.end = end
+        self.field = source.field
+
+    def doc_candidates(self, seg):
+        return self.source.doc_candidates(seg)
+
+    def intervals(self, seg, doc):
+        return [(s, e) for s, e in self.source.intervals(seg, doc)
+                if e < self.end]
+
+    def leaf_weights(self, seg):
+        return self.source.leaf_weights(seg)
+
+
+class NotNearSource(IntervalSource):
+    """span_not: include intervals with no exclude interval within
+    ``pre`` positions before or ``post`` positions after."""
+
+    def __init__(self, include: IntervalSource, exclude: IntervalSource,
+                 pre: int = 0, post: int = 0):
+        self.include = include
+        self.exclude = exclude
+        self.pre = pre
+        self.post = post
+        self.field = include.field
+
+    def doc_candidates(self, seg):
+        return self.include.doc_candidates(seg)
+
+    def intervals(self, seg, doc):
+        ints = self.include.intervals(seg, doc)
+        if not ints:
+            return []
+        excl = self.exclude.intervals(seg, doc)
+        if not excl:
+            return ints
+        out = []
+        for (s, e) in ints:
+            lo, hi = s - self.pre, e + self.post
+            if not any(fs <= hi and fe >= lo for fs, fe in excl):
+                out.append((s, e))
+        return out
+
+    def leaf_weights(self, seg):
+        return self.include.leaf_weights(seg)

@@ -1,0 +1,656 @@
+"""Positional + relevance-expansion queries: ``intervals``, the span
+family, ``more_like_this`` and ``distance_feature``.
+
+References: ``index/query/IntervalQueryBuilder.java``,
+``SpanNearQueryBuilder.java`` / ``SpanTermQueryBuilder.java`` (+ siblings),
+``MoreLikeThisQueryBuilder.java``, ``DistanceFeatureQueryBuilder.java``.
+
+Execution model: candidate docs come from device postings masks, the
+positional algebra itself runs host-side over the segment position CSR
+(see ``search/intervals.py``); ``more_like_this`` rewrites into the
+bool/term machinery which is fully device-side.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.errors import IllegalArgumentError, ParsingError
+from ..common.settings import parse_time_millis
+from ..index.mapping import (DateFieldType, GeoPointFieldType, TextFieldType,
+                             parse_date_millis)
+from ..ops.bm25 import DEFAULT_B, DEFAULT_K1, idf_weight
+from . import intervals as iv
+from .query_dsl import (BoolQuery, FuzzyQuery, Query, TermQuery,
+                        _const_result, _edit_distance_le,
+                        register_query_parser, wildcard_regex)
+
+# ---------------------------------------------------------------------------
+# shared: interval-source scoring as a Query
+# ---------------------------------------------------------------------------
+
+
+class _IntervalScoredQuery(Query):
+    """Scores any IntervalSource tree: freq = Σ 1/(1+width-1) over minimal
+    intervals (Lucene ``IntervalScorer`` sloppy weight), idf = Σ leaf idfs."""
+
+    def __init__(self, field: str, boost: float = 1.0):
+        self.field = field
+        self.boost = boost
+
+    def build_source(self, ctx, seg) -> Optional[iv.IntervalSource]:
+        raise NotImplementedError
+
+    def execute(self, ctx, seg):
+        field = ctx.concrete_field(self.field)
+        ft = ctx.field_type(field)
+        if not isinstance(ft, TextFieldType):
+            return _const_result(seg, 0.0, False)
+        src = self.build_source(ctx, seg)
+        if src is None:
+            return _const_result(seg, 0.0, False)
+        cands = src.doc_candidates(seg)
+        scores_host = np.zeros(seg.n_pad, np.float32)
+        mask_host = np.zeros(seg.n_pad, bool)
+        if cands.size:
+            leaves = src.leaf_weights(seg)
+            by_field: Dict[str, set] = {}
+            for lf, lt in leaves:
+                by_field.setdefault(lf, set()).add(lt)
+            idf = 0.0
+            for lf, terms in by_field.items():
+                dfs = [ctx.term_df(lf, t) for t in terms]
+                idf += float(idf_weight(ctx.total_docs, dfs).sum())
+            avgdl = max(ctx.field_avgdl(field), 1e-9)
+            f = seg.text_fields.get(field)
+            k1, b = DEFAULT_K1, DEFAULT_B
+            for d in np.unique(cands):
+                ints = src.intervals(seg, int(d))
+                if not ints:
+                    continue
+                freq = sum(1.0 / (1 + (e - s)) for s, e in ints)
+                dl = float(f.doc_len_host[d]) if f is not None else 1.0
+                norm = freq + k1 * (1 - b + b * dl / avgdl)
+                scores_host[d] = idf * (k1 + 1) * freq / norm
+                mask_host[d] = True
+        return (jnp.asarray(scores_host * np.float32(self.boost)),
+                jnp.asarray(mask_host))
+
+    def collect_highlight_terms(self, ctx, out):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# intervals query
+# ---------------------------------------------------------------------------
+
+_RULE_KEYS = ("match", "all_of", "any_of", "prefix", "wildcard", "fuzzy")
+
+
+class IntervalsQuery(_IntervalScoredQuery):
+    def __init__(self, field: str, rule: dict, boost: float = 1.0):
+        super().__init__(field, boost)
+        self.rule = rule
+
+    def build_source(self, ctx, seg):
+        return _build_interval_source(ctx, self.field, self.rule)
+
+
+def _analyzer_for(ctx, field: str):
+    ft = ctx.field_type(ctx.concrete_field(field))
+    if isinstance(ft, TextFieldType):
+        return ft.search_analyzer
+    return None
+
+
+def _build_interval_source(ctx, field: str, rule: dict):
+    if not isinstance(rule, dict):
+        raise ParsingError("Expected an object for interval source")
+    keys = [k for k in rule if k in _RULE_KEYS]
+    if len(keys) != 1:
+        raise ParsingError(
+            f"expected one interval source, found {sorted(rule)}")
+    kind = keys[0]
+    body = rule[kind]
+    if kind == "match":
+        use_field = body.get("use_field", field)
+        an = _analyzer_for(ctx, use_field)
+        if an is None:
+            return None
+        cfield = ctx.concrete_field(use_field)
+        terms = an.terms(str(body.get("query", "")))
+        if not terms:
+            return None
+        if len(terms) == 1:
+            src = iv.TermSource(cfield, terms[0])
+        else:
+            src = iv.CombineSource(
+                [iv.TermSource(cfield, t) for t in terms],
+                ordered=bool(body.get("ordered", False)),
+                max_gaps=int(body.get("max_gaps", -1)))
+        return _apply_interval_filter(ctx, field, src, body.get("filter"))
+    if kind == "all_of":
+        subs = [_build_interval_source(ctx, field, r)
+                for r in body.get("intervals", [])]
+        if not subs or any(s is None for s in subs):
+            return None
+        src = iv.CombineSource(subs,
+                               ordered=bool(body.get("ordered", False)),
+                               max_gaps=int(body.get("max_gaps", -1)))
+        return _apply_interval_filter(ctx, field, src, body.get("filter"))
+    if kind == "any_of":
+        subs = [_build_interval_source(ctx, field, r)
+                for r in body.get("intervals", [])]
+        subs = [s for s in subs if s is not None]
+        if not subs:
+            return None
+        src = iv.AnyOfSource(subs)
+        return _apply_interval_filter(ctx, field, src, body.get("filter"))
+    if kind == "prefix":
+        use_field = body.get("use_field", field)
+        cfield = ctx.concrete_field(use_field)
+        pfx = str(body.get("prefix", ""))
+        return iv.ExpansionSource(cfield, lambda t: t.startswith(pfx),
+                                  f"prefix:{pfx}")
+    if kind == "wildcard":
+        use_field = body.get("use_field", field)
+        cfield = ctx.concrete_field(use_field)
+        pat = str(body.get("pattern", ""))
+        rx = wildcard_regex(pat)
+        return iv.ExpansionSource(cfield, lambda t: bool(rx.match(t)),
+                                  f"wildcard:{pat}")
+    if kind == "fuzzy":
+        use_field = body.get("use_field", field)
+        cfield = ctx.concrete_field(use_field)
+        term = str(body.get("term", ""))
+        fz = body.get("fuzziness", "AUTO")
+        if fz in ("AUTO", "auto", None):
+            n = len(term)
+            max_edits = 0 if n <= 2 else (1 if n <= 5 else 2)
+        else:
+            max_edits = int(fz)
+        plen = int(body.get("prefix_length", 0))
+
+        def pred(t, term=term, k=max_edits, plen=plen):
+            if plen and t[:plen] != term[:plen]:
+                return False
+            return _edit_distance_le(t, term, k)
+
+        return iv.ExpansionSource(cfield, pred, f"fuzzy:{term}")
+    raise ParsingError(f"unknown interval source [{kind}]")
+
+
+def _apply_interval_filter(ctx, field: str, src, flt: Optional[dict]):
+    if not flt:
+        return src
+    if not isinstance(flt, dict) or len(flt) != 1:
+        raise ParsingError("interval filter must define exactly one relation")
+    (kind, inner), = flt.items()
+    if kind == "script":
+        raise ParsingError("interval script filters are not supported")
+    if kind not in iv.FilteredSource.KINDS:
+        raise ParsingError(f"unknown interval filter [{kind}]")
+    ref = _build_interval_source(ctx, field, inner)
+    if ref is None:
+        # an unbuildable reference filters nothing for not_* kinds and
+        # everything for positive kinds
+        if kind.startswith("not_"):
+            return src
+        return None
+    return iv.FilteredSource(src, kind, ref)
+
+
+def _parse_intervals(body):
+    if not isinstance(body, dict):
+        raise ParsingError("[intervals] query malformed")
+    opts = dict(body)
+    boost = float(opts.pop("boost", 1.0))
+    if len(opts) != 1:
+        raise ParsingError("[intervals] expects exactly one field")
+    (field, rule), = opts.items()
+    if isinstance(rule, dict) and "boost" in rule:
+        # boost nests inside the field object (IntervalQueryBuilder)
+        rule = dict(rule)
+        boost *= float(rule.pop("boost"))
+    return IntervalsQuery(field, rule, boost)
+
+
+# ---------------------------------------------------------------------------
+# span queries — thin adapters over the same interval algebra
+# ---------------------------------------------------------------------------
+
+
+class SpanQuery(_IntervalScoredQuery):
+    """A span query node: carries a builder fn (ctx, seg) -> source and the
+    field it reports (field_masking_span may mask the true one)."""
+
+    def __init__(self, field: str, builder, boost: float = 1.0):
+        super().__init__(field, boost)
+        self._builder = builder
+
+    def build_source(self, ctx, seg):
+        return self._builder(ctx, seg)
+
+
+def _span_field_and_builder(spec: dict) -> Tuple[str, "callable"]:
+    """Parse one span clause to (reported_field, builder)."""
+    if not isinstance(spec, dict) or len(spec) != 1:
+        raise ParsingError("span clause malformed")
+    (kind, body), = spec.items()
+
+    if kind == "span_term":
+        if len(body) != 1:
+            raise ParsingError("[span_term] expects one field")
+        (field, v), = body.items()
+        value = v.get("value") if isinstance(v, dict) else v
+
+        def b(ctx, seg, field=field, value=value):
+            return iv.TermSource(ctx.concrete_field(field), str(value))
+        return field, b
+
+    if kind == "span_near":
+        clauses = [(_span_field_and_builder(c)) for c in body.get("clauses", [])]
+        if not clauses:
+            raise ParsingError("[span_near] requires clauses")
+        slop = int(body.get("slop", 0))
+        in_order = bool(body.get("in_order", True))
+        field = clauses[0][0]
+
+        def b(ctx, seg, clauses=clauses, slop=slop, in_order=in_order):
+            subs = [cb(ctx, seg) for _, cb in clauses]
+            if any(s is None for s in subs):
+                return None
+            return iv.CombineSource(subs, ordered=in_order, max_gaps=slop)
+        return field, b
+
+    if kind == "span_or":
+        clauses = [(_span_field_and_builder(c)) for c in body.get("clauses", [])]
+        if not clauses:
+            raise ParsingError("[span_or] requires clauses")
+        field = clauses[0][0]
+
+        def b(ctx, seg, clauses=clauses):
+            subs = [cb(ctx, seg) for _, cb in clauses]
+            subs = [s for s in subs if s is not None]
+            return iv.AnyOfSource(subs) if subs else None
+        return field, b
+
+    if kind == "span_not":
+        fi, bi = _span_field_and_builder(body["include"])
+        _, be = _span_field_and_builder(body["exclude"])
+        dist = body.get("dist")
+        pre = int(dist if dist is not None else body.get("pre", 0))
+        post = int(dist if dist is not None else body.get("post", 0))
+
+        def b(ctx, seg, bi=bi, be=be, pre=pre, post=post):
+            inc, exc = bi(ctx, seg), be(ctx, seg)
+            if inc is None:
+                return None
+            if exc is None:
+                return inc
+            return iv.NotNearSource(inc, exc, pre, post)
+        return fi, b
+
+    if kind == "span_first":
+        if "match" not in body or "end" not in body:
+            raise ParsingError("[span_first] requires [match] and [end]")
+        fi, bi = _span_field_and_builder(body["match"])
+        end = int(body["end"])
+
+        def b(ctx, seg, bi=bi, end=end):
+            src = bi(ctx, seg)
+            return iv.FirstSource(src, end) if src is not None else None
+        return fi, b
+
+    if kind == "span_multi":
+        inner = body.get("match")
+        if not isinstance(inner, dict) or len(inner) != 1:
+            raise ParsingError("[span_multi] requires a [match] clause")
+        (mt_kind, mt_body), = inner.items()
+        if len(mt_body) != 1:
+            raise ParsingError("[span_multi] match expects one field")
+        (field, v), = mt_body.items()
+        opts = dict(v) if isinstance(v, dict) else {"value": v}
+        value = str(opts.get("value", opts.get("query", "")))
+
+        def b(ctx, seg, mt_kind=mt_kind, field=field, value=value, opts=opts):
+            cfield = ctx.concrete_field(field)
+            if mt_kind == "prefix":
+                return iv.ExpansionSource(
+                    cfield, lambda t: t.startswith(value), f"prefix:{value}")
+            if mt_kind == "wildcard":
+                rx = wildcard_regex(value)
+                return iv.ExpansionSource(
+                    cfield, lambda t: bool(rx.match(t)), f"wildcard:{value}")
+            if mt_kind == "regexp":
+                rx = re.compile(f"(?:{value})\\Z")
+                return iv.ExpansionSource(
+                    cfield, lambda t: bool(rx.match(t)), f"regexp:{value}")
+            if mt_kind == "fuzzy":
+                fq = FuzzyQuery(field, value,
+                                opts.get("fuzziness", "AUTO"),
+                                int(opts.get("prefix_length", 0)))
+                return iv.ExpansionSource(
+                    cfield, fq._matches, f"fuzzy:{value}")
+            if mt_kind == "range":
+                lo = opts.get("gte", opts.get("gt"))
+                hi = opts.get("lte", opts.get("lt"))
+
+                def pred(t, lo=lo, hi=hi):
+                    return ((lo is None or t >= str(lo)) and
+                            (hi is None or t <= str(hi)))
+                return iv.ExpansionSource(cfield, pred, "range")
+            raise ParsingError(
+                f"[span_multi] cannot wrap query type [{mt_kind}]")
+        return field, b
+
+    if kind in ("span_containing", "span_within"):
+        fl, bl = _span_field_and_builder(body["little"])
+        fb, bb = _span_field_and_builder(body["big"])
+        containing = kind == "span_containing"
+
+        def b(ctx, seg, bl=bl, bb=bb, containing=containing):
+            little, big = bl(ctx, seg), bb(ctx, seg)
+            if little is None or big is None:
+                return None
+            if containing:
+                return iv.FilteredSource(big, "containing", little)
+            return iv.FilteredSource(little, "contained_by", big)
+        return (fb if containing else fl), b
+
+    if kind == "field_masking_span":
+        _, bi = _span_field_and_builder(body["query"])
+        return body.get("field", ""), bi
+
+    raise ParsingError(f"unknown span query [{kind}]")
+
+
+def _make_span_parser(kind: str):
+    def parse(body):
+        opts = dict(body) if isinstance(body, dict) else body
+        boost = 1.0
+        if isinstance(opts, dict):
+            boost = float(opts.pop("boost", 1.0))
+            if kind == "span_term" and len(opts) == 1:
+                # boost nests inside the per-field value object
+                (fld, v), = opts.items()
+                if isinstance(v, dict) and "boost" in v:
+                    v = dict(v)
+                    boost *= float(v.pop("boost"))
+                    opts = {fld: v}
+        field, builder = _span_field_and_builder({kind: opts})
+        return SpanQuery(field, builder, boost)
+    return parse
+
+
+# ---------------------------------------------------------------------------
+# more_like_this
+# ---------------------------------------------------------------------------
+
+
+class MoreLikeThisQuery(Query):
+    """Term-vector similarity (reference: ``MoreLikeThisQueryBuilder.java``,
+    Lucene ``MoreLikeThis``): select the highest tf·idf terms from the
+    *like* texts/docs, drop *unlike* terms, rewrite to a should-of-terms
+    bool. The rewrite happens once per shard context and then scores fully
+    device-side."""
+
+    def __init__(self, like, unlike=None, fields=None, *,
+                 max_query_terms: int = 25, min_term_freq: int = 2,
+                 min_doc_freq: int = 5, max_doc_freq: int = 1 << 62,
+                 minimum_should_match="30%", include: bool = False,
+                 boost: float = 1.0):
+        self.like = like if isinstance(like, list) else [like]
+        self.unlike = (unlike if isinstance(unlike, list)
+                       else [unlike]) if unlike else []
+        self.fields = fields
+        self.max_query_terms = max_query_terms
+        self.min_term_freq = min_term_freq
+        self.min_doc_freq = min_doc_freq
+        self.max_doc_freq = max_doc_freq
+        self.minimum_should_match = minimum_should_match
+        self.include = include
+        self.boost = boost
+        self._ctx_cache: Dict[int, Query] = {}
+
+    # -- helpers ----------------------------------------------------------
+
+    def _doc_source(self, ctx, item: dict) -> Optional[dict]:
+        if "doc" in item:
+            return item["doc"]
+        doc_id = item.get("_id")
+        if doc_id is None:
+            return None
+        for seg in ctx.segments:
+            d = seg.find_doc(str(doc_id))
+            if d is not None:
+                return seg.sources[d]
+        return None
+
+    def _field_texts(self, ctx, items) -> Tuple[Dict[str, List[str]], List[str]]:
+        """Per selected field, the texts contributed by like/unlike items;
+        plus the _ids of items that referenced live docs."""
+        if self.fields:
+            fields = list(self.fields)
+        else:
+            fields = [name for name, ft in ctx.mapper._fields.items()
+                      if isinstance(ft, TextFieldType)]
+        texts: Dict[str, List[str]] = {f: [] for f in fields}
+        seen_ids: List[str] = []
+        for item in items:
+            if isinstance(item, str):
+                for f in fields:
+                    texts[f].append(item)
+                continue
+            if isinstance(item, dict):
+                if "_id" in item and "doc" not in item:
+                    seen_ids.append(str(item["_id"]))
+                src = self._doc_source(ctx, item)
+                if src is None:
+                    continue
+                for f in fields:
+                    v = _dig(src, f)
+                    if v is not None:
+                        texts[f].append(str(v))
+        return texts, seen_ids
+
+    def _rewrite(self, ctx) -> Query:
+        like_texts, like_ids = self._field_texts(ctx, self.like)
+        unlike_texts, _ = self._field_texts(ctx, self.unlike)
+
+        stop: Dict[str, set] = {}
+        for f, txts in unlike_texts.items():
+            an = _analyzer_for(ctx, f)
+            if an is None:
+                continue
+            s = stop.setdefault(f, set())
+            for t in txts:
+                s.update(an.terms(t))
+
+        scored: List[Tuple[float, str, str]] = []      # (tfidf, field, term)
+        for f, txts in like_texts.items():
+            an = _analyzer_for(ctx, f)
+            if an is None or not txts:
+                continue
+            tf: Dict[str, int] = {}
+            for t in txts:
+                for term in an.terms(t):
+                    tf[term] = tf.get(term, 0) + 1
+            for term, freq in tf.items():
+                if freq < self.min_term_freq:
+                    continue
+                if term in stop.get(f, ()):
+                    continue
+                df = ctx.term_df(f, term)
+                if df < self.min_doc_freq or df > self.max_doc_freq:
+                    continue
+                idf = math.log(1 + (ctx.total_docs - df + 0.5) / (df + 0.5))
+                scored.append((freq * idf, f, term))
+        scored.sort(reverse=True)
+        scored = scored[: self.max_query_terms]
+        if not scored:
+            from .query_dsl import MatchNoneQuery
+            return MatchNoneQuery()
+        should = [TermQuery(f, term) for _, f, term in scored]
+        must_not: List[Query] = []
+        if not self.include and like_ids:
+            from .query_dsl import IdsQuery
+            must_not.append(IdsQuery(like_ids))
+        return BoolQuery(should=should, must_not=must_not,
+                         minimum_should_match=self.minimum_should_match,
+                         boost=self.boost)
+
+    def execute(self, ctx, seg):
+        q = self._ctx_cache.get(id(ctx))
+        if q is None:
+            q = self._ctx_cache[id(ctx)] = self._rewrite(ctx)
+        return q.execute(ctx, seg)
+
+    def collect_highlight_terms(self, ctx, out):
+        q = self._ctx_cache.get(id(ctx))
+        if q is not None:
+            q.collect_highlight_terms(ctx, out)
+
+
+def _dig(src: dict, path: str):
+    cur = src
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _parse_more_like_this(body):
+    if not isinstance(body, dict):
+        raise ParsingError("[more_like_this] malformed")
+    like = body.get("like")
+    if like is None:
+        raise ParsingError("more_like_this requires 'like' to be specified")
+    kwargs = {}
+    for src_key, dst_key, conv in (
+            ("max_query_terms", "max_query_terms", int),
+            ("min_term_freq", "min_term_freq", int),
+            ("min_doc_freq", "min_doc_freq", int),
+            ("max_doc_freq", "max_doc_freq", int),
+            ("minimum_should_match", "minimum_should_match", lambda v: v),
+            ("include", "include", bool),
+            ("boost", "boost", float)):
+        if src_key in body:
+            kwargs[dst_key] = conv(body[src_key])
+    return MoreLikeThisQuery(like, body.get("unlike"),
+                             body.get("fields"), **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# distance_feature
+# ---------------------------------------------------------------------------
+
+_DIST_METERS = {"mm": 1e-3, "millimeters": 1e-3, "cm": 1e-2,
+                "centimeters": 1e-2, "m": 1.0, "meters": 1.0,
+                "km": 1000.0, "kilometers": 1000.0,
+                "mi": 1609.344, "miles": 1609.344, "yd": 0.9144,
+                "yards": 0.9144, "ft": 0.3048, "feet": 0.3048,
+                "in": 0.0254, "inch": 0.0254, "nmi": 1852.0, "NM": 1852.0,
+                "nauticalmiles": 1852.0, None: 1.0}
+_DIST_RE = re.compile(
+    r"^\s*(-?\d+(?:\.\d+)?)\s*(" +
+    "|".join(sorted((u for u in _DIST_METERS if u), key=len, reverse=True)) +
+    r")?\s*$")
+
+EARTH_MEAN_RADIUS_M = 6371008.7714      # Lucene GeoUtils.EARTH_MEAN_RADIUS
+
+
+def parse_distance_meters(value) -> float:
+    if isinstance(value, (int, float)):
+        return float(value)
+    m = _DIST_RE.match(str(value))
+    if not m:
+        raise IllegalArgumentError(f"failed to parse distance [{value}]")
+    return float(m.group(1)) * _DIST_METERS[m.group(2)]
+
+
+def haversine_meters(lat1, lon1, lat2, lon2):
+    """Vectorized great-circle distance (numpy) in meters."""
+    p1, p2 = np.radians(lat1), np.radians(lat2)
+    dp = p2 - p1
+    dl = np.radians(lon2) - np.radians(lon1)
+    a = (np.sin(dp / 2.0) ** 2 +
+         np.cos(p1) * np.cos(p2) * np.sin(dl / 2.0) ** 2)
+    return 2.0 * EARTH_MEAN_RADIUS_M * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+
+
+class DistanceFeatureQuery(Query):
+    """score = boost · pivot / (pivot + distance(value, origin)); matches
+    every doc that has the field (``DistanceFeatureQueryBuilder.java``)."""
+
+    def __init__(self, field: str, origin, pivot, boost: float = 1.0):
+        self.field = field
+        self.origin = origin
+        self.pivot = pivot
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        field = ctx.concrete_field(self.field)
+        ft = ctx.field_type(field)
+        scores_host = np.zeros(seg.n_pad, np.float32)
+        mask_host = np.zeros(seg.n_pad, bool)
+        if isinstance(ft, GeoPointFieldType):
+            lat = seg.numeric_fields.get(f"{field}._lat")
+            lon = seg.numeric_fields.get(f"{field}._lon")
+            if lat is None or lon is None or lat.vals_host.size == 0:
+                return _const_result(seg, 0.0, False)
+            olat, olon = GeoPointFieldType.parse_value(ft, self.origin)
+            pivot_m = parse_distance_meters(self.pivot)
+            if pivot_m <= 0:
+                raise IllegalArgumentError(
+                    f"[pivot] must be positive, got [{self.pivot}]")
+            dist = haversine_meters(lat.vals_host, lon.vals_host, olat, olon)
+            sc = self.boost * pivot_m / (pivot_m + dist)
+            np.maximum.at(scores_host, lat.docs_host, sc.astype(np.float32))
+            mask_host[lat.docs_host] = True
+        elif isinstance(ft, DateFieldType):
+            nf = seg.numeric_fields.get(field)
+            if nf is None or nf.vals_host.size == 0:
+                return _const_result(seg, 0.0, False)
+            origin_ms = parse_date_millis(self.origin)
+            pivot_ms = parse_time_millis(self.pivot)
+            if pivot_ms <= 0:
+                raise IllegalArgumentError(
+                    f"[pivot] must be positive, got [{self.pivot}]")
+            dist = np.abs(nf.vals_host - origin_ms)
+            sc = self.boost * pivot_ms / (pivot_ms + dist)
+            np.maximum.at(scores_host, nf.docs_host, sc.astype(np.float32))
+            mask_host[nf.docs_host] = True
+        else:
+            raise IllegalArgumentError(
+                f"field [{self.field}] is not a date or geo_point field")
+        return jnp.asarray(scores_host), jnp.asarray(mask_host)
+
+
+def _parse_distance_feature(body):
+    if not isinstance(body, dict):
+        raise ParsingError("[distance_feature] malformed")
+    for req in ("field", "origin", "pivot"):
+        if req not in body:
+            raise ParsingError(f"[distance_feature] requires [{req}]")
+    return DistanceFeatureQuery(body["field"], body["origin"], body["pivot"],
+                                float(body.get("boost", 1.0)))
+
+
+# ---------------------------------------------------------------------------
+# registration (imported from query_dsl at module bottom — SPI hooks)
+# ---------------------------------------------------------------------------
+
+register_query_parser("intervals", _parse_intervals)
+register_query_parser("more_like_this", _parse_more_like_this)
+register_query_parser("distance_feature", _parse_distance_feature)
+for _kind in ("span_term", "span_near", "span_or", "span_not", "span_first",
+              "span_multi", "span_containing", "span_within",
+              "field_masking_span"):
+    register_query_parser(_kind, _make_span_parser(_kind))

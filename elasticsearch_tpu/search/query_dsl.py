@@ -761,6 +761,13 @@ class PrefixQuery(Query):
         return jnp.where(mask, np.float32(self.boost), 0.0), mask
 
 
+def wildcard_regex(pattern: str) -> "re.Pattern":
+    """``*``/``?`` wildcard → anchored regex (shared by wildcard query,
+    interval wildcard source and span_multi)."""
+    esc = re.escape(pattern).replace(r"\*", ".*").replace(r"\?", ".")
+    return re.compile(f"{esc}\\Z")
+
+
 class WildcardQuery(Query):
     """Wildcard/regexp: host-side term-dictionary scan → postings union mask
     (uploads a host-computed doc mask; term dictionaries are host-resident)."""
@@ -774,8 +781,7 @@ class WildcardQuery(Query):
             # Lucene regexp is anchored at both ends
             self._re = re.compile(f"(?:{pattern})\\Z")
         else:
-            esc = re.escape(pattern).replace(r"\*", ".*").replace(r"\?", ".")
-            self._re = re.compile(f"{esc}\\Z")
+            self._re = wildcard_regex(pattern)
 
     def execute(self, ctx, seg):
         self.field = ctx.concrete_field(self.field)
@@ -1660,3 +1666,9 @@ _PARSERS = {
 def register_query_parser(name: str, parser) -> None:
     """SPI hook mirroring ``SearchPlugin#getQueries``."""
     _PARSERS[name] = parser
+
+
+# positional/expansion queries (intervals, spans, more_like_this,
+# distance_feature) register themselves through the SPI hook above; the
+# import must come after the registry exists (same pattern as aggs_extra)
+from . import positional as _positional          # noqa: E402, F401

@@ -1,0 +1,187 @@
+"""Positional query family: intervals, spans, more_like_this,
+distance_feature (search/positional.py + search/intervals.py)."""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import (IllegalArgumentError,
+                                             ParsingError)
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.positional import (haversine_meters,
+                                                 parse_distance_meters)
+from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+MAPPING = {
+    "properties": {
+        "text": {"type": "text"},
+        "ts": {"type": "date"},
+        "loc": {"type": "geo_point"},
+    }
+}
+
+CORPUS = [
+    {"text": "some like it hot some like it cold",
+     "ts": "2024-01-01T10:00:00Z", "loc": [-71.34, 41.13]},
+    {"text": "its cold outside theres no kind of atmosphere",
+     "ts": "2024-01-01T11:00:00Z", "loc": [-71.34, 41.14]},
+    {"text": "baby its cold there outside",
+     "ts": "2024-01-01T09:00:00Z", "loc": [-71.34, 41.12]},
+    {"text": "outside it is cold and wet",
+     "ts": "2024-01-02T00:00:00Z", "loc": [0.0, 0.0]},
+]
+
+
+def build(split=None):
+    svc = MapperService(MAPPING)
+    bounds = split or [len(CORPUS)]
+    segs, start = [], 0
+    for seg_no, end in enumerate(bounds):
+        b = SegmentBuilder(f"_{seg_no}")
+        for i in range(start, end):
+            b.add(svc.parse_document(str(i), CORPUS[i]), seq_no=i)
+        segs.append(b.build())
+        start = end
+    return ShardSearcher(segs, svc)
+
+
+def ids(res):
+    return sorted(h.doc_id for h in res.hits)
+
+
+def run(q, split=None):
+    return build(split).search({"query": q, "size": 10})
+
+
+# -- intervals ---------------------------------------------------------------
+
+def test_intervals_ordered_vs_unordered():
+    q_ord = {"intervals": {"text": {"match":
+             {"query": "cold outside", "ordered": True}}}}
+    q_unord = {"intervals": {"text": {"match": {"query": "cold outside"}}}}
+    assert ids(run(q_ord)) == ["1", "2"]
+    assert ids(run(q_unord)) == ["1", "2", "3"]
+
+
+def test_intervals_max_gaps_and_multisegment():
+    q = {"intervals": {"text": {"match":
+         {"query": "cold outside", "max_gaps": 1}}}}
+    assert ids(run(q)) == ["1", "2"]
+    assert ids(run(q, split=[2, 4])) == ["1", "2"]
+
+
+def test_intervals_filter_before_after():
+    before = {"intervals": {"text": {"match":
+              {"query": "cold", "filter":
+               {"before": {"match": {"query": "outside"}}}}}}}
+    after = {"intervals": {"text": {"match":
+             {"query": "cold", "filter":
+              {"after": {"match": {"query": "outside"}}}}}}}
+    assert ids(run(before)) == ["1", "2"]
+    assert ids(run(after)) == ["3"]
+
+
+def test_intervals_unknown_filter_rejected():
+    q = {"intervals": {"text": {"match":
+         {"query": "cold", "filter": {"nope": {"match": {"query": "x"}}}}}}}
+    with pytest.raises(ParsingError):
+        run(q)
+
+
+# -- spans -------------------------------------------------------------------
+
+def test_span_near_in_order():
+    q = {"span_near": {"clauses": [
+        {"span_term": {"text": "cold"}},
+        {"span_term": {"text": "outside"}}],
+        "slop": 0, "in_order": True}}
+    assert ids(run(q)) == ["1"]
+    q["span_near"]["slop"] = 2
+    assert ids(run(q)) == ["1", "2"]
+
+
+def test_span_or_and_not():
+    q_or = {"span_or": {"clauses": [
+        {"span_term": {"text": "atmosphere"}},
+        {"span_term": {"text": "wet"}}]}}
+    assert ids(run(q_or)) == ["1", "3"]
+    q_not = {"span_not": {
+        "include": {"span_term": {"text": "cold"}},
+        "exclude": {"span_term": {"text": "its"}}, "pre": 1, "post": 0}}
+    # docs 1,2 have "its" directly before "cold" → excluded
+    assert ids(run(q_not)) == ["0", "3"]
+
+
+def test_span_first():
+    q = {"span_first": {"match": {"span_term": {"text": "cold"}}, "end": 2}}
+    # only doc 1 ("its cold ...") has cold within the first 2 positions
+    assert ids(run(q)) == ["1"]
+
+
+def test_span_multi_prefix():
+    q = {"span_near": {"clauses": [
+        {"span_term": {"text": "cold"}},
+        {"span_multi": {"match": {"prefix": {"text": {"value": "out"}}}}}],
+        "slop": 3, "in_order": True}}
+    assert ids(run(q)) == ["1", "2"]
+
+
+# -- more_like_this ----------------------------------------------------------
+
+def test_mlt_like_text():
+    q = {"more_like_this": {"like": "cold outside", "fields": ["text"],
+                            "min_term_freq": 1, "min_doc_freq": 1}}
+    # all docs share at least one term; msm 30% of 2 terms → 0 → ≥1
+    assert ids(run(q)) == ["0", "1", "2", "3"]
+
+
+def test_mlt_like_doc_excludes_self_by_default():
+    q = {"more_like_this": {"like": [{"_id": "1"}], "fields": ["text"],
+                            "min_term_freq": 1, "min_doc_freq": 1}}
+    res = run(q)
+    assert "1" not in ids(res)
+    assert len(res.hits) > 0
+
+
+def test_mlt_unlike_removes_terms():
+    q = {"more_like_this": {
+        "like": [{"_id": "1"}], "unlike": [{"_id": "2"}],
+        "fields": ["text"], "include": True,
+        "min_term_freq": 1, "min_doc_freq": 1}}
+    got = ids(run(q))
+    # doc2's terms (baby its cold there outside) are all struck; doc1
+    # keeps {theres, no, kind, of, atmosphere} → only doc1 matches
+    assert got == ["1"]
+
+
+# -- distance_feature --------------------------------------------------------
+
+def test_distance_feature_date_ranks_by_proximity():
+    q = {"distance_feature": {"field": "ts", "pivot": "1h",
+                              "origin": "2024-01-01T09:20:00Z"}}
+    res = build().search({"query": q, "size": 10})
+    assert [h.doc_id for h in res.hits] == ["2", "0", "1", "3"]
+
+
+def test_distance_feature_geo_ranks_by_proximity():
+    q = {"distance_feature": {"field": "loc", "pivot": "1km",
+                              "origin": [-71.34, 41.12]}}
+    res = build().search({"query": q, "size": 10})
+    assert [h.doc_id for h in res.hits] == ["2", "0", "1", "3"]
+
+
+def test_distance_feature_rejects_bad_field():
+    q = {"distance_feature": {"field": "text", "pivot": "1h",
+                              "origin": "2024-01-01"}}
+    with pytest.raises(IllegalArgumentError):
+        run(q)
+
+
+def test_distance_units_and_haversine():
+    assert parse_distance_meters("1km") == 1000.0
+    assert parse_distance_meters("1mi") == pytest.approx(1609.344)
+    assert parse_distance_meters(5) == 5.0
+    with pytest.raises(IllegalArgumentError):
+        parse_distance_meters("1parsec")
+    # London → Paris ≈ 344 km
+    d = haversine_meters(51.5074, -0.1278, 48.8566, 2.3522)
+    assert 330_000 < d < 350_000
