@@ -1476,9 +1476,47 @@ class RestAPI:
                 ctx_seg_masks.append((ctx, seg, np.asarray(mask)))
         return run_aggregations_multi(aggs, ctx_seg_masks)
 
+    def _rewrite_terms_lookup(self, node):
+        """Coordinator-side rewrite of terms-lookup clauses
+        ({"terms": {f: {"index","id","path"}}}) into literal value lists —
+        the reference resolves these with an async GET during query rewrite
+        (``TermsQueryBuilder.doRewrite``)."""
+        if isinstance(node, list):
+            for item in node:
+                self._rewrite_terms_lookup(item)
+            return
+        if not isinstance(node, dict):
+            return
+        t = node.get("terms")
+        if isinstance(t, dict):
+            for field, spec in list(t.items()):
+                if isinstance(spec, dict) and "index" in spec \
+                        and "id" in spec:
+                    try:
+                        svc = self.indices.get(spec["index"])
+                        r = svc.get_doc(str(spec["id"]),
+                                        routing=spec.get("routing"))
+                        src = r.source if r.found else {}
+                    except Exception:   # noqa: BLE001 — missing index → []
+                        src = {}
+                    vals = [src]
+                    for part in str(spec.get("path", "")).split("."):
+                        nxt = []
+                        for v in vals:
+                            if isinstance(v, dict) and part in v:
+                                hit = v[part]
+                                nxt.extend(hit if isinstance(hit, list)
+                                           else [hit])
+                        vals = nxt
+                    t[field] = [v for v in vals
+                                if not isinstance(v, (dict, list))]
+        for v in node.values():
+            self._rewrite_terms_lookup(v)
+
     def h_search(self, params, body, index=None):
         names = self.indices.resolve(index)
         search_body = _json_body(body)
+        self._rewrite_terms_lookup(search_body)
         if "q" in params:
             search_body["query"] = {"query_string": {
                 "query": params["q"]}} if False else _lucene_qs_to_dsl(
@@ -1537,6 +1575,7 @@ class RestAPI:
     def h_count(self, params, body, index=None):
         names = self.indices.resolve(index)
         b = _json_body(body)
+        self._rewrite_terms_lookup(b)
         total = 0
         for n in names:
             total += self.indices.indices[n].count(b)
@@ -1637,6 +1676,7 @@ class RestAPI:
     def h_delete_by_query(self, params, body, index):
         t0 = time.time()
         b = _json_body(body)
+        self._rewrite_terms_lookup(b)
         query = b.get("query") or {"match_all": {}}
         deleted = 0
         for n in self.indices.resolve(index):
@@ -1661,6 +1701,7 @@ class RestAPI:
         from ..search.query_dsl import parse_query
         svc = self.indices.get(index)
         payload = _json_body(body)
+        self._rewrite_terms_lookup(payload)
         query_spec = payload.get("query") or {"match_all": {}}
         searcher = svc.searcher()
         target = None
@@ -1815,6 +1856,7 @@ class RestAPI:
     def h_update_by_query(self, params, body, index):
         t0 = time.time()
         b = _json_body(body)
+        self._rewrite_terms_lookup(b)
         query = b.get("query") or {"match_all": {}}
         script = b.get("script")
         updated = 0

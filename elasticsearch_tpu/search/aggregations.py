@@ -1164,11 +1164,17 @@ def _resolve_buckets_path(sibling_results: dict, path: str):
         raise IllegalArgumentError(
             f"buckets_path [{path}] must reference a multi-bucket sibling")
     buckets = sib["buckets"]
+    if isinstance(buckets, dict):       # keyed response form
+        buckets = list(buckets.values())
     series = []
     for b in buckets:
         v: Any = b
         if len(parts) == 1 or parts[1] == "_count":
             v = b["doc_count"]
+        elif b.get("doc_count") == 0:
+            # GapPolicy.SKIP: an empty bucket's metric is treated as
+            # missing, not 0 (``BucketHelpers.resolveBucketValue``)
+            v = None
         else:
             for p in parts[1:]:
                 if isinstance(v, dict):
@@ -1322,3 +1328,4 @@ _AGG_PARSERS = {
 # _AGG_PARSERS at its own module bottom, which keeps BOTH import orders
 # safe (importing aggs_extra first re-enters here only to bind names)
 from . import aggs_extra as _aggs_extra      # noqa: E402, F401
+from . import aggs_geo as _aggs_geo          # noqa: E402, F401

@@ -1,0 +1,145 @@
+"""Geo aggs (grid/distance/bounds/centroid), auto_date_histogram,
+variable_width_histogram, adjacency_matrix, significant_text
+(search/aggs_geo.py)."""
+
+import pytest
+
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.search.aggs_geo import geohash_encode, geotile_key
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+MAPPING = {"properties": {
+    "loc": {"type": "geo_point"},
+    "city": {"type": "keyword"},
+    "pop": {"type": "long"},
+    "date": {"type": "date"},
+    "num": {"type": "integer"},
+    "text": {"type": "text"},
+}}
+
+ROWS = [
+    ("1", {"loc": {"lat": 40.7128, "lon": -74.0060}, "city": "nyc",
+           "pop": 8623000, "date": "2020-03-01", "num": [-3],
+           "text": "good stuff"}),
+    ("2", {"loc": {"lat": 34.0522, "lon": -118.2437}, "city": "la",
+           "pop": 4000000, "date": "2020-03-02", "num": [-2],
+           "text": "good things"}),
+    ("3", {"loc": {"lat": 41.8781, "lon": -87.6298}, "city": "chi",
+           "pop": 2716000, "date": "2020-03-08", "num": [1],
+           "text": "bad stuff"}),
+    ("4", {"loc": {"lat": 52.3740, "lon": 4.9123}, "city": "ams",
+           "pop": 872000, "date": "2020-03-09", "num": [4, 5],
+           "text": "bad things"}),
+]
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    mapper = MapperService(MAPPING)
+    segs = []
+    for half in (ROWS[:2], ROWS[2:]):
+        b = SegmentBuilder(f"_g{len(segs)}", )
+        for i, (did, doc) in enumerate(half):
+            b.add(mapper.parse_document(did, doc), seq_no=i)
+        segs.append(b.build())
+    return ShardSearcher(segs, mapper)
+
+
+def aggs(searcher, spec, query=None):
+    body = {"size": 0, "aggs": spec}
+    if query:
+        body["query"] = query
+    return searcher.search(body).aggregations
+
+
+def test_geohash_geotile_encode():
+    assert geohash_encode(52.374081, 4.912350, 3) == "u17"
+    assert geotile_key(52.374081, 4.912350, 8) == "8/131/84"
+
+
+def test_geohash_grid(searcher):
+    r = aggs(searcher, {"grid": {"geohash_grid": {"field": "loc",
+                                                  "precision": 1}}})
+    keys = {b["key"]: b["doc_count"] for b in r["grid"]["buckets"]}
+    assert keys == {"d": 2, "9": 1, "u": 1}   # nyc+chi=d, la=9, ams=u
+
+def test_geotile_grid_sorted_by_count(searcher):
+    r = aggs(searcher, {"grid": {"geotile_grid": {"field": "loc",
+                                                  "precision": 0}}})
+    assert r["grid"]["buckets"][0]["key"] == "0/0/0"
+    assert r["grid"]["buckets"][0]["doc_count"] == 4
+
+
+def test_geo_distance_ranges_and_subs(searcher):
+    r = aggs(searcher, {"d": {
+        "geo_distance": {"field": "loc", "origin": "35.7796, -78.6382",
+                         "ranges": [{"to": 1000000},
+                                    {"from": 1000000, "to": 5000000},
+                                    {"from": 5000000}]},
+        "aggs": {"p": {"sum": {"field": "pop"}}}}})
+    b = r["d"]["buckets"]
+    assert [x["key"] for x in b] == ["*-1000000.0", "1000000.0-5000000.0",
+                                    "5000000.0-*"]
+    assert [x["doc_count"] for x in b] == [1, 2, 1]
+    assert b[0]["p"]["value"] == 8623000.0
+
+
+def test_geo_bounds_and_centroid(searcher):
+    r = aggs(searcher, {"b": {"geo_bounds": {"field": "loc"}},
+                        "c": {"geo_centroid": {"field": "loc"}}})
+    bounds = r["b"]["bounds"]
+    assert bounds["top_left"]["lat"] == pytest.approx(52.3740)
+    assert bounds["top_left"]["lon"] == pytest.approx(-118.2437)
+    assert bounds["bottom_right"]["lat"] == pytest.approx(34.0522)
+    assert bounds["bottom_right"]["lon"] == pytest.approx(4.9123)
+    assert r["c"]["count"] == 4
+    assert r["c"]["location"]["lat"] == pytest.approx(
+        (40.7128 + 34.0522 + 41.8781 + 52.3740) / 4)
+
+
+def test_auto_date_histogram_picks_7d(searcher):
+    r = aggs(searcher, {"h": {"auto_date_histogram":
+                              {"field": "date", "buckets": 2}}})
+    assert r["h"]["interval"] == "7d"
+    assert len(r["h"]["buckets"]) == 2
+    assert r["h"]["buckets"][0]["key_as_string"].startswith("2020-03-01")
+    assert [b["doc_count"] for b in r["h"]["buckets"]] == [2, 2]
+
+
+def test_auto_date_histogram_subs(searcher):
+    r = aggs(searcher, {"h": {"auto_date_histogram":
+                              {"field": "date", "buckets": 2},
+                              "aggs": {"p": {"sum": {"field": "num"}}}}})
+    assert r["h"]["buckets"][0]["p"]["value"] == -5.0
+    assert r["h"]["buckets"][1]["p"]["value"] == 10.0
+
+
+def test_variable_width_histogram(searcher):
+    r = aggs(searcher, {"h": {"variable_width_histogram":
+                              {"field": "num", "buckets": 3}}})
+    b = r["h"]["buckets"]
+    assert [x["key"] for x in b] == [-2.5, 1.0, 4.5]
+    assert [x["doc_count"] for x in b] == [2, 1, 1]   # 4,5 same doc
+
+
+def test_adjacency_matrix(searcher):
+    r = aggs(searcher, {"m": {"adjacency_matrix": {"filters": {
+        "good": {"match": {"text": "good"}},
+        "stuff": {"match": {"text": "stuff"}}}}}})
+    got = {b["key"]: b["doc_count"] for b in r["m"]["buckets"]}
+    assert got == {"good": 2, "stuff": 2, "good&stuff": 1}
+
+
+def test_significant_text(searcher):
+    r = aggs(searcher,
+             {"s": {"significant_text": {"field": "text",
+                                         "min_doc_count": 2}}},
+             query={"term": {"city": "nyc"}})
+    # fg: doc1 only; min_doc_count 2 filters everything
+    assert r["s"]["buckets"] == []
+    r = aggs(searcher,
+             {"s": {"significant_text": {"field": "text",
+                                         "min_doc_count": 1}}},
+             query={"match": {"text": "good"}})
+    assert r["s"]["buckets"][0]["key"] == "good"
