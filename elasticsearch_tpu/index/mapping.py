@@ -934,7 +934,13 @@ class MapperService:
                 if not isinstance(ft, ObjectFieldType) and not isinstance(
                         sub, (ObjectFieldType,)):
                     # only leaf multi-fields of leaf parents
-                    if isinstance(sub, KeywordFieldType):
+                    if isinstance(sub, CompletionFieldType):
+                        inputs, weight = sub.parse_value(value)
+                        parsed.keyword_terms.setdefault(
+                            sub_name, []).extend(inputs)
+                        parsed.numeric_values.setdefault(
+                            f"{sub_name}._weight", []).append(float(weight))
+                    elif isinstance(sub, KeywordFieldType):
                         v = sub.parse_value(value)
                         if v is not None:
                             parsed.keyword_terms.setdefault(sub_name, []).append(v)

@@ -53,7 +53,12 @@ class IndexService:
         if self.num_shards < 1 or self.num_shards > 1024:
             raise IllegalArgumentError(
                 f"invalid number_of_shards [{self.num_shards}]")
-        self.settings = flat
+        _reject_retired_settings(flat)
+        # settings store under their canonical "index."-prefixed keys so
+        # later lookups (preserve_existing, GET _settings) are uniform
+        self.settings = {
+            (k if k.startswith("index.") else f"index.{k}"): v
+            for k, v in flat.items()}
         self.creation_date = int(time.time() * 1000)
         self.uuid = f"{abs(hash((name, self.creation_date))):022x}"[:22]
         self.mapper = MapperService(mappings or {})
@@ -69,6 +74,20 @@ class IndexService:
                     flat.get("index.gc_deletes", "60s"))))
         self.aliases: Dict[str, dict] = {}
         self.closed = False
+        # search-phase counters (+ per-group when a search carries a
+        # ``stats`` group list; reference: SearchStats.groupStats)
+        self.search_stats: Dict[str, object] = {
+            "query_total": 0, "fetch_total": 0, "scroll_total": 0,
+            "suggest_total": 0, "groups": {}}
+
+    def record_search(self, groups: Optional[List[str]] = None) -> None:
+        self.search_stats["query_total"] += 1
+        self.search_stats["fetch_total"] += 1
+        for g in groups or []:
+            gs = self.search_stats["groups"].setdefault(
+                str(g), {"query_total": 0, "fetch_total": 0})
+            gs["query_total"] += 1
+            gs["fetch_total"] += 1
 
     def _check_open(self) -> None:
         if self.closed:
@@ -154,17 +173,49 @@ class IndexService:
         self.mapper.merge(mappings)
 
     def update_settings(self, settings: dict) -> None:
-        flat = _flatten_settings(settings)
-        static = {"index.number_of_shards", "number_of_shards"}
+        flat = {(k if k.startswith("index.") else f"index.{k}"): v
+                for k, v in _flatten_settings(settings).items()}
+        _reject_retired_settings(flat)
         for k in flat:
-            if k in static:
+            if k == "index.number_of_shards":
                 raise IllegalArgumentError(
                     f"final {self.name} setting [{k}], not updateable")
         self.settings.update(flat)
         if "index.number_of_replicas" in flat:
             self.num_replicas = int(flat["index.number_of_replicas"])
 
-    def stats(self) -> dict:
+    def field_bytes(self):
+        """(fielddata_bytes_by_field, completion_bytes_by_field) — host
+        array footprints of each field's loaded columns, the analog of
+        Lucene fielddata / completion FST memory accounting."""
+        from ..index.mapping import CompletionFieldType
+        completion_fields = {n for n, ft in self.mapper._fields.items()
+                             if isinstance(ft, CompletionFieldType)}
+        fd: Dict[str, int] = {}
+        comp: Dict[str, int] = {}
+        for s in self.shards:
+            for seg in s.searchable_segments():
+                for fname, f in seg.text_fields.items():
+                    fd[fname] = fd.get(fname, 0) + int(
+                        f.docs_host.nbytes + f.tf_host.nbytes +
+                        f.pos_flat.nbytes + f.doc_len_host.nbytes)
+                for fname, f in seg.keyword_fields.items():
+                    n = int(f.docs_host.nbytes + f.dv_ords_host.nbytes +
+                            f.dv_docs_host.nbytes +
+                            sum(len(t) for t in f.ord_terms))
+                    if fname in completion_fields:
+                        comp[fname] = comp.get(fname, 0) + n
+                    else:
+                        fd[fname] = fd.get(fname, 0) + n
+                for fname, f in seg.numeric_fields.items():
+                    fd[fname] = fd.get(fname, 0) + int(
+                        f.vals_host.nbytes + f.docs_host.nbytes)
+        return fd, comp
+
+    def stats(self, with_field_bytes: bool = True) -> dict:
+        """``with_field_bytes=False`` skips the per-field column-footprint
+        walk (O(vocabulary)) for callers that only need counts (cat,
+        rollover conditions)."""
         docs = sum(s.doc_count for s in self.shards)
         deleted = sum(s.deleted_count for s in self.shards)
         seg_count = sum(len(s.searchable_segments()) for s in self.shards)
@@ -182,20 +233,53 @@ class IndexService:
             ops[key] = sum(s.stats.get(key, 0) for s in self.shards)
         tl_ops = sum(s.translog.total_operations() for s in self.shards)
         tl_size = sum(s.translog.size_in_bytes() for s in self.shards)
-        return {"docs": {"count": docs, "deleted": deleted},
-                "store": {"size_in_bytes": store},
-                "translog": {"operations": tl_ops,
-                             "size_in_bytes": tl_size,
-                             "uncommitted_operations": tl_ops,
-                             "uncommitted_size_in_bytes": tl_size,
-                             "earliest_last_modified_age": 0},
-                "segments": {"count": seg_count},
-                "indexing": {"index_total": ops["index_total"],
-                             "delete_total": ops["delete_total"]},
-                "get": {"total": ops["get_total"]},
-                "refresh": {"total": ops["refresh_total"]},
-                "flush": {"total": ops["flush_total"]},
-                "merges": {"total": ops["merge_total"]}}
+        fd, comp = self.field_bytes() if with_field_bytes else ({}, {})
+        ss = self.search_stats
+        out = empty_index_stats()
+        out["docs"].update(count=docs, deleted=deleted)
+        out["store"].update(size_in_bytes=store,
+                            total_data_set_size_in_bytes=store)
+        out["translog"].update(operations=tl_ops, size_in_bytes=tl_size,
+                               uncommitted_operations=tl_ops,
+                               uncommitted_size_in_bytes=tl_size)
+        out["segments"].update(count=seg_count,
+                               memory_in_bytes=sum(fd.values()))
+        out["indexing"].update(index_total=ops["index_total"],
+                               delete_total=ops["delete_total"])
+        out["get"].update(total=ops["get_total"])
+        out["search"].update(query_total=ss["query_total"],
+                             fetch_total=ss["fetch_total"],
+                             scroll_total=ss["scroll_total"],
+                             suggest_total=ss["suggest_total"])
+        out["refresh"].update(total=ops["refresh_total"],
+                              external_total=ops["refresh_total"])
+        out["flush"].update(total=ops["flush_total"])
+        out["merges"].update(total=ops["merge_total"])
+        out["fielddata"].update(memory_size_in_bytes=sum(fd.values()))
+        out["completion"].update(size_in_bytes=sum(comp.values()))
+        return out
+
+    def shard_stats(self, node_id: str = "node") -> Dict[str, list]:
+        """level=shards payload: shard number → list of copies."""
+        out: Dict[str, list] = {}
+        for i, s in enumerate(self.shards):
+            segs = s.searchable_segments()
+            commit_id = f"{abs(hash(tuple(sorted(g.seg_id for g in segs)))):016x}"
+            out[str(i)] = [{
+                "routing": {"state": "STARTED", "primary": True,
+                            "node": node_id, "relocating_node": None},
+                "docs": {"count": s.doc_count, "deleted": s.deleted_count},
+                "store": {"size_in_bytes": 0},
+                "commit": {"id": commit_id,
+                           "generation": s.stats.get("flush_total", 0) + 1,
+                           "user_data": {}, "num_docs": s.doc_count},
+                "seq_no": {"max_seq_no": s.tracker.max_seq_no,
+                           "local_checkpoint": s.tracker.checkpoint,
+                           "global_checkpoint": s.tracker.checkpoint},
+                "shard_path": {"data_path": s.path,
+                               "is_custom_data_path": False},
+            }]
+        return out
 
     def close(self) -> None:
         for s in self.shards:
@@ -320,3 +404,78 @@ def _parse_time_seconds(v) -> float:
     mult = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0,
             "d": 86400.0}.get(m.group(2) or "s", 1.0)
     return float(m.group(1)) * mult
+
+
+#: settings removed in 8.0 — using them is an error, not a no-op
+#: (reference: IndexSettings deprecation/removal of translog retention)
+_RETIRED_SETTING_PREFIXES = ("index.translog.retention.",
+                             "translog.retention.")
+
+
+def _reject_retired_settings(flat: Dict[str, Any]) -> None:
+    for k in flat:
+        if any(k.startswith(p) for p in _RETIRED_SETTING_PREFIXES):
+            raise IllegalArgumentError(
+                f"unknown setting [{k}] please check that any required "
+                f"plugins are installed, or check the breaking changes "
+                f"documentation for removed settings")
+
+
+def empty_index_stats() -> Dict[str, Any]:
+    """Zero-valued index stats tree — the full section/field shape of the
+    reference's CommonStats serialization; IndexService.stats() fills in
+    the live numbers and nodes-level rollups start from this so every
+    section exists even with zero indices."""
+    zero_cache = {"memory_size_in_bytes": 0, "evictions": 0,
+                  "hit_count": 0, "miss_count": 0}
+    return {
+        "docs": {"count": 0, "deleted": 0},
+        "store": {"size_in_bytes": 0, "total_data_set_size_in_bytes": 0,
+                  "reserved_in_bytes": 0},
+        "indexing": {"index_total": 0, "index_time_in_millis": 0,
+                     "index_current": 0, "index_failed": 0,
+                     "delete_total": 0, "delete_time_in_millis": 0,
+                     "delete_current": 0, "noop_update_total": 0,
+                     "is_throttled": False, "throttle_time_in_millis": 0},
+        "get": {"total": 0, "time_in_millis": 0, "exists_total": 0,
+                "exists_time_in_millis": 0, "missing_total": 0,
+                "missing_time_in_millis": 0, "current": 0},
+        "search": {"open_contexts": 0, "query_total": 0,
+                   "query_time_in_millis": 0, "query_current": 0,
+                   "fetch_total": 0, "fetch_time_in_millis": 0,
+                   "fetch_current": 0, "scroll_total": 0,
+                   "scroll_time_in_millis": 0, "scroll_current": 0,
+                   "suggest_total": 0, "suggest_time_in_millis": 0,
+                   "suggest_current": 0},
+        "merges": {"current": 0, "current_docs": 0,
+                   "current_size_in_bytes": 0, "total": 0,
+                   "total_time_in_millis": 0, "total_docs": 0,
+                   "total_size_in_bytes": 0},
+        "refresh": {"total": 0, "total_time_in_millis": 0,
+                    "external_total": 0,
+                    "external_total_time_in_millis": 0, "listeners": 0},
+        "flush": {"total": 0, "periodic": 0, "total_time_in_millis": 0},
+        "warmer": {"current": 0, "total": 0, "total_time_in_millis": 0},
+        "query_cache": dict(zero_cache, total_count=0, cache_size=0,
+                            cache_count=0),
+        "fielddata": {"memory_size_in_bytes": 0, "evictions": 0},
+        "completion": {"size_in_bytes": 0},
+        "segments": {"count": 0, "memory_in_bytes": 0,
+                     "terms_memory_in_bytes": 0,
+                     "stored_fields_memory_in_bytes": 0,
+                     "doc_values_memory_in_bytes": 0,
+                     "index_writer_memory_in_bytes": 0,
+                     "version_map_memory_in_bytes": 0,
+                     "fixed_bit_set_memory_in_bytes": 0,
+                     "max_unsafe_auto_id_timestamp": -1, "file_sizes": {}},
+        "translog": {"operations": 0, "size_in_bytes": 0,
+                     "uncommitted_operations": 0,
+                     "uncommitted_size_in_bytes": 0,
+                     "earliest_last_modified_age": 0},
+        "request_cache": dict(zero_cache),
+        "recovery": {"current_as_source": 0, "current_as_target": 0,
+                     "throttle_time_in_millis": 0},
+        "bulk": {"total_operations": 0, "total_time_in_millis": 0,
+                 "total_size_in_bytes": 0, "avg_time_in_millis": 0,
+                 "avg_size_in_bytes": 0},
+    }

@@ -10,6 +10,7 @@ invoked from the asyncio HTTP server.
 from __future__ import annotations
 
 import json
+import os
 import re
 import time
 import uuid
@@ -120,6 +121,8 @@ class RestAPI:
         add("GET", "/_nodes/{node_id}/stats", self.h_nodes_stats)
         add("GET", "/_nodes/{node_id}/stats/{metric}",
             self.h_nodes_stats)
+        add("GET", "/_nodes/{node_id}", self.h_nodes)
+        add("GET", "/_nodes/{node_id}/{metric}", self.h_nodes)
         # cat
         add("GET", "/_cat/indices", self.h_cat_indices)
         add("GET", "/_cat/indices/{index}", self.h_cat_indices)
@@ -129,6 +132,7 @@ class RestAPI:
         add("GET", "/_cat/shards", self.h_cat_shards)
         add("GET", "/_cat/nodes", self.h_cat_nodes)
         add("GET", "/_cat/aliases", self.h_cat_aliases)
+        add("GET", "/_cat/aliases/{name}", self.h_cat_aliases)
         # search / count / mget / analyze / field caps
         add("GET,POST", "/_search", self.h_search)
         add("GET,POST", "/{index}/_search", self.h_search)
@@ -215,6 +219,8 @@ class RestAPI:
         add("GET", "/_mapping/field/{fields}", self.h_field_mapping)
         add("GET,PUT", "/{index}/_settings", self.h_settings)
         add("GET,PUT", "/_settings", self.h_settings)
+        add("GET", "/{index}/_settings/{name}", self.h_settings)
+        add("GET", "/_settings/{name}", self.h_settings)
         add("POST", "/{index}/_refresh", self.h_refresh)
         add("POST", "/_refresh", self.h_refresh)
         add("POST", "/{index}/_flush", self.h_flush)
@@ -261,6 +267,9 @@ class RestAPI:
             else:
                 status, payload = 200, result
             if isinstance(payload, (dict, list)):
+                fp = params.get("filter_path")
+                if fp and isinstance(payload, dict):
+                    payload = _apply_filter_path(payload, fp)
                 return status, JSON_CT, json.dumps(payload).encode()
             if isinstance(payload, str):
                 return status, "text/plain; charset=UTF-8", payload.encode()
@@ -370,7 +379,7 @@ class RestAPI:
         svc = self.indices.get(old)
         payload = _json_body(body) if body else {}
         conditions = payload.get("conditions") or {}
-        st = svc.stats()
+        st = svc.stats(with_field_bytes=False)
         age_s = max(0.0, time.time() - svc.creation_date / 1000.0)
         results = {}
         for cond, want in conditions.items():
@@ -525,45 +534,236 @@ class RestAPI:
                 "persistent": self.cluster_settings["persistent"],
                 "transient": self.cluster_settings["transient"]}
 
-    def h_nodes(self, params, body):
+    def h_nodes(self, params, body, node_id=None, metric=None):
+        info = {
+            "name": self.node_name,
+            "transport_address": "127.0.0.1:9300",
+            "host": "127.0.0.1", "ip": "127.0.0.1",
+            "version": "8.0.0-tpu",
+            "build_flavor": "tpu-native", "build_type": "source",
+            "build_hash": "unknown",
+            "roles": ["master", "data", "ingest"],
+            "attributes": {},
+            "settings": {"cluster": {"name": self.cluster_name},
+                         "node": {"name": self.node_name}},
+            "os": {"refresh_interval_in_millis": 1000},
+            "process": {"id": os.getpid(), "mlockall": False},
+            "jvm": {"pid": os.getpid(), "version": "n/a",
+                    "using_compressed_ordinary_object_pointers": "true"},
+            "thread_pool": {"search": {"type": "fixed"},
+                            "write": {"type": "fixed"}},
+            "transport": {"bound_address": ["127.0.0.1:9300"],
+                          "publish_address": "127.0.0.1:9300",
+                          "profiles": {}},
+            "http": {"bound_address": ["127.0.0.1:9200"],
+                     "publish_address": "127.0.0.1:9200",
+                     "max_content_length_in_bytes": 104857600},
+            "plugins": [], "modules": [],
+            "ingest": {"processors": [
+                {"type": t} for t in sorted(
+                    __import__("elasticsearch_tpu.ingest.pipeline",
+                               fromlist=["_PROCESSOR_TYPES"]
+                               )._PROCESSOR_TYPES)]},
+            "aggregations": {},
+        }
         return {"_nodes": {"total": 1, "successful": 1, "failed": 0},
                 "cluster_name": self.cluster_name,
-                "nodes": {self.node_id: {
-                    "name": self.node_name,
-                    "roles": ["master", "data", "ingest"],
-                    "version": "8.0.0-tpu"}}}
+                "nodes": {self.node_id: info}}
+
+    #: nodes.stats sections (reference: NodesStatsRequest.Metric)
+    NODES_STATS_METRICS = ("indices", "os", "process", "jvm", "thread_pool",
+                           "fs", "transport", "http", "breaker", "script",
+                           "discovery", "ingest", "adaptive_selection",
+                           "script_cache", "indexing_pressure")
 
     def h_nodes_stats(self, params, body, metric=None,
                       index_metric=None, node_id=None):
-        total_docs = sum(sum(s.doc_count for s in svc.shards)
-                         for svc in self.indices.indices.values())
+        uri = "/_nodes/stats" + (f"/{metric}" if metric else "")
+        self._check_params(params, {"level", "types", "fields", "groups",
+                                    "completion_fields", "fielddata_fields",
+                                    "include_segment_file_sizes",
+                                    "include_unloaded_segments"}, uri)
+        wanted = set(self.NODES_STATS_METRICS)
+        if metric and metric != "_all":
+            wanted = self._check_metrics(metric, wanted, uri)
+        from ..node.indices_service import empty_index_stats
+        indices_stats: Dict[str, Any] = empty_index_stats()
+        per_index: Dict[str, Any] = {}
+        for n, svc in self.indices.indices.items():
+            st = svc.stats()
+            _merge_numeric_tree(indices_stats, st)
+            per_index[n] = st
+        if index_metric and index_metric != "_all":
+            im = self._check_metrics(
+                index_metric, set(self.STATS_METRICS),
+                f"{uri}/{index_metric}")
+            keep = {self._METRIC_SECTION.get(m, m) for m in im}
+            indices_stats = {k: v for k, v in indices_stats.items()
+                             if k in keep}
+        if params.get("include_segment_file_sizes") in ("true", "") and \
+                "segments" in indices_stats:
+            indices_stats["segments"]["file_sizes"] = _segment_file_sizes(
+                [sh for svc in self.indices.indices.values()
+                 for sh in svc.shards])
+        if params.get("level") == "indices":
+            indices_stats["indices"] = per_index
+        zero_pressure = {"combined_coordinating_and_primary_in_bytes": 0,
+                         "coordinating_in_bytes": 0, "primary_in_bytes": 0,
+                         "replica_in_bytes": 0, "all_in_bytes": 0}
+        sections = {
+            "indices": indices_stats,
+            "os": {"timestamp": int(time.time() * 1000),
+                   "cpu": {"percent": 0},
+                   "mem": {"total_in_bytes": 0, "free_in_bytes": 0,
+                           "used_in_bytes": 0, "free_percent": 0,
+                           "used_percent": 0}},
+            "process": {"timestamp": int(time.time() * 1000),
+                        "open_file_descriptors": 0,
+                        "max_file_descriptors": 0,
+                        "cpu": {"percent": 0, "total_in_millis": 0},
+                        "mem": {"total_virtual_in_bytes": 0}},
+            "jvm": {"timestamp": int(time.time() * 1000),
+                    "uptime_in_millis": int(
+                        (time.time() - self.start_time) * 1000),
+                    "mem": {"heap_used_in_bytes": 0, "heap_used_percent": 0,
+                            "heap_committed_in_bytes": 0,
+                            "heap_max_in_bytes": 0,
+                            "non_heap_used_in_bytes": 0,
+                            "non_heap_committed_in_bytes": 0,
+                            "pools": {}},
+                    "threads": {"count": 1, "peak_count": 1},
+                    "gc": {"collectors": {}},
+                    "buffer_pools": {
+                        "direct": {"count": 0, "used_in_bytes": 0,
+                                   "total_capacity_in_bytes": 0},
+                        "mapped": {"count": 0, "used_in_bytes": 0,
+                                   "total_capacity_in_bytes": 0}},
+                    "classes": {"current_loaded_count": 0,
+                                "total_loaded_count": 0,
+                                "total_unloaded_count": 0}},
+            "thread_pool": {"search": {"threads": 1, "queue": 0,
+                                       "active": 0, "rejected": 0,
+                                       "largest": 1, "completed": 0},
+                            "write": {"threads": 1, "queue": 0,
+                                      "active": 0, "rejected": 0,
+                                      "largest": 1, "completed": 0}},
+            "fs": (lambda du: {
+                "timestamp": int(time.time() * 1000),
+                "total": {"total_in_bytes": du.total,
+                          "free_in_bytes": du.free,
+                          "available_in_bytes": du.free},
+                "data": [{"path": self.indices.data_path,
+                          "mount": "/", "type": "fs",
+                          "total_in_bytes": du.total,
+                          "free_in_bytes": du.free,
+                          "available_in_bytes": du.free}]})(
+                __import__("shutil").disk_usage(self.indices.data_path)),
+            "transport": {"server_open": 0,
+                          "total_outbound_connections": 0,
+                          "rx_count": 0, "rx_size_in_bytes": 0,
+                          "tx_count": 0, "tx_size_in_bytes": 0},
+            "http": {"current_open": 0, "total_opened": 0,
+                     "clients": []},
+            "breaker": {"parent": {"limit_size_in_bytes": 0,
+                                   "estimated_size_in_bytes": 0,
+                                   "overhead": 1.0, "tripped": 0}},
+            "script": {"compilations": 0, "cache_evictions": 0,
+                       "compilation_limit_triggered": 0},
+            "discovery": {
+                "cluster_state_queue": {"total": 0, "pending": 0,
+                                        "committed": 0},
+                "published_cluster_states": {"full_states": 0,
+                                             "incompatible_diffs": 0,
+                                             "compatible_diffs": 0},
+                "cluster_state_update": {"unchanged": {"count": 0}},
+                "serialized_cluster_states": {
+                    "full_states": {"count": 0},
+                    "diffs": {"count": 0}}},
+            "ingest": {"total": {"count": 0, "time_in_millis": 0,
+                                 "current": 0, "failed": 0},
+                       "pipelines": {}},
+            "adaptive_selection": {},
+            "script_cache": {"sum": {"compilations": 0,
+                                     "cache_evictions": 0,
+                                     "compilation_limit_triggered": 0}},
+            "indexing_pressure": {"memory": {
+                "current": dict(zero_pressure),
+                "total": dict(zero_pressure, coordinating_rejections=0,
+                              primary_rejections=0, replica_rejections=0),
+                "limit_in_bytes": 53687091}},
+        }
+        node = {"timestamp": int(time.time() * 1000),
+                "name": self.node_name,
+                "transport_address": "127.0.0.1:9300",
+                "host": "127.0.0.1", "ip": "127.0.0.1:9300",
+                "roles": ["master", "data", "ingest"],
+                "attributes": {}}
+        for k in self.NODES_STATS_METRICS:
+            if k in wanted and k in sections:
+                # the "breaker" metric serializes under "breakers"
+                node["breakers" if k == "breaker" else k] = sections[k]
         return {"_nodes": {"total": 1, "successful": 1, "failed": 0},
                 "cluster_name": self.cluster_name,
-                "nodes": {self.node_id: {
-                    "name": self.node_name,
-                    "indices": {"docs": {"count": total_docs}},
-                    "jvm": {"uptime_in_millis": int(
-                        (time.time() - self.start_time) * 1000)}}}}
+                "nodes": {self.node_id: node}}
 
     # ------------------------------------------------------------------
     # cat
     # ------------------------------------------------------------------
 
     @staticmethod
-    def _cat_table(rows: List[List[str]], headers: List[str],
-                   verbose: bool) -> str:
+    def _cat_cell(c) -> str:
+        if isinstance(c, bool):
+            return "true" if c else "false"
+        return str(c)
+
+    @staticmethod
+    def _cat_sort_key(cell):
+        """Numeric-aware sort key: numbers order numerically, before
+        strings (mirrors the reference cat table comparator)."""
+        try:
+            return (0, float(cell), "")
+        except (TypeError, ValueError):
+            return (1, 0.0, str(cell))
+
+    def _cat_table(self, rows: List[List[str]], headers: List[str],
+                   verbose: bool, params: Optional[dict] = None):
+        params = params or {}
+        if _flag(params, "help"):
+            w = max((len(h) for h in headers), default=0)
+            return "".join(f"{h.ljust(w)} | {h} | {h}\n" for h in headers)
+        col_of = {h: i for i, h in enumerate(headers)}
+        if params.get("s"):
+            # stable multi-key sort with per-key :asc/:desc suffixes:
+            # apply keys right-to-left
+            specs = []
+            for k in str(params["s"]).split(","):
+                k = k.strip()
+                name, _, order = k.partition(":")
+                if name in col_of:
+                    specs.append((name, order == "desc"))
+            for name, desc in reversed(specs):
+                rows = sorted(rows, key=lambda r, c=col_of[name]:
+                              self._cat_sort_key(r[c]), reverse=desc)
+        if params.get("h"):
+            sel = [c.strip() for c in str(params["h"]).split(",")
+                   if c.strip() in col_of]
+            rows = [[r[col_of[c]] for c in sel] for r in rows]
+            headers = sel
+        if params.get("format") == "json":
+            return [dict(zip(headers, (self._cat_cell(c) for c in r)))
+                    for r in rows]
         if not rows and not verbose:
             return ""
         widths = [len(h) for h in headers]
         for r in rows:
             for i, c in enumerate(r):
-                widths[i] = max(widths[i], len(str(c)))
+                widths[i] = max(widths[i], len(self._cat_cell(c)))
         lines = []
         if verbose:
             lines.append(" ".join(h.ljust(widths[i])
                                   for i, h in enumerate(headers)).rstrip())
         for r in rows:
-            lines.append(" ".join(str(c).ljust(widths[i])
+            lines.append(" ".join(self._cat_cell(c).ljust(widths[i])
                                   for i, c in enumerate(r)).rstrip())
         return "\n".join(lines) + "\n"
 
@@ -571,7 +771,7 @@ class RestAPI:
         rows = []
         for name in self.indices.resolve(index):
             svc = self.indices.indices[name]
-            st = svc.stats()
+            st = svc.stats(with_field_bytes=False)
             rows.append(["green", "open", name, svc.uuid,
                          svc.num_shards, svc.num_replicas,
                          st["docs"]["count"], st["docs"]["deleted"],
@@ -581,7 +781,7 @@ class RestAPI:
                                       "pri", "rep", "docs.count",
                                       "docs.deleted", "store.size",
                                       "pri.store.size"],
-                               _flag(params, "v"))
+                               _flag(params, "v"), params)
 
     def h_cat_health(self, params, body):
         h = self._health()
@@ -595,7 +795,7 @@ class RestAPI:
                                       "unassign", "pending_tasks",
                                       "max_task_wait_time",
                                       "active_shards_percent"],
-                               _flag(params, "v"))
+                               _flag(params, "v"), params)
 
     def h_cat_count(self, params, body, index=None):
         total = 0
@@ -604,7 +804,7 @@ class RestAPI:
                          for s in self.indices.indices[name].shards)
         return self._cat_table(
             [[int(time.time()), time.strftime("%H:%M:%S"), total]],
-            ["epoch", "timestamp", "count"], _flag(params, "v"))
+            ["epoch", "timestamp", "count"], _flag(params, "v"), params)
 
     def h_cat_shards(self, params, body):
         rows = []
@@ -613,21 +813,32 @@ class RestAPI:
                 rows.append([name, i, "p", "STARTED", shard.doc_count,
                              self.node_name])
         return self._cat_table(rows, ["index", "shard", "prirep", "state",
-                                      "docs", "node"], _flag(params, "v"))
+                                      "docs", "node"], _flag(params, "v"), params)
 
     def h_cat_nodes(self, params, body):
         return self._cat_table(
             [["127.0.0.1", "mdi", "*", self.node_name]],
-            ["ip", "node.role", "master", "name"], _flag(params, "v"))
+            ["ip", "node.role", "master", "name"], _flag(params, "v"), params)
 
-    def h_cat_aliases(self, params, body):
+    def h_cat_aliases(self, params, body, name=None):
+        import fnmatch
         rows = []
+        pats = [p.strip() for p in name.split(",")] if name else None
         for alias, names in sorted(self.indices.all_aliases().items()):
+            if pats and not any(fnmatch.fnmatchcase(alias, p)
+                                for p in pats):
+                continue
             for n in names:
-                rows.append([alias, n, "-", "-", "-", "-"])
+                spec = self.indices.indices[n].aliases.get(alias, {})
+                rows.append([
+                    alias, n,
+                    "*" if spec.get("filter") else "-",
+                    spec.get("index_routing") or "-",
+                    spec.get("search_routing") or "-",
+                    spec.get("is_write_index", "-")])
         return self._cat_table(rows, ["alias", "index", "filter",
                                       "routing.index", "routing.search",
-                                      "is_write_index"], _flag(params, "v"))
+                                      "is_write_index"], _flag(params, "v"), params)
 
     # ------------------------------------------------------------------
     # index CRUD / admin
@@ -699,21 +910,110 @@ class RestAPI:
         return {n: {"mappings": self.indices.indices[n].mapper.mapping_dict()}
                 for n in names}
 
-    def h_settings(self, params, body, index=None):
-        names = self.indices.resolve(index)
+    #: defaults surfaced by include_defaults=true (scoped subset of
+    #: IndexSettings' registered defaults)
+    SETTINGS_DEFAULTS = {
+        "index.refresh_interval": "1s",
+        "index.max_result_window": "10000",
+        "index.max_inner_result_window": "100",
+        "index.max_rescore_window": "10000",
+        "index.max_ngram_diff": "1",
+        "index.max_shingle_diff": "3",
+        "index.blocks.read_only": "false",
+        "index.gc_deletes": "60s",
+        "index.flush_after_merge": "512mb",
+        "index.translog.durability": "REQUEST",
+        "index.translog.flush_threshold_size": "512mb",
+        "index.soft_deletes.enabled": "true",
+    }
+
+    def _index_flat_settings(self, n: str) -> Dict[str, str]:
+        svc = self.indices.indices[n]
+
+        def s(v):
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            return str(v)
+        flat = {}
+        for k, v in svc.settings.items():
+            k2 = k if k.startswith("index.") else f"index.{k}"
+            flat[k2] = s(v)
+        flat["index.number_of_shards"] = str(svc.num_shards)
+        flat["index.number_of_replicas"] = str(svc.num_replicas)
+        flat["index.uuid"] = svc.uuid
+        flat["index.creation_date"] = str(svc.creation_date)
+        flat["index.version.created"] = "8000099"
+        flat["index.provided_name"] = n
+        return flat
+
+    @staticmethod
+    def _nest_flat(flat: Dict[str, str]) -> dict:
+        out: dict = {}
+        for k, v in flat.items():
+            cur = out
+            parts = k.split(".")
+            ok = True
+            for p in parts[:-1]:
+                nxt = cur.setdefault(p, {})
+                if not isinstance(nxt, dict):
+                    ok = False
+                    break
+                cur = nxt
+            if ok:
+                cur[parts[-1]] = v
+        return out
+
+    def h_settings(self, params, body, index=None, name=None):
         if body:
             b = _json_body(body)
+            if params.get("ignore_unavailable") in ("true", "") and index:
+                names = []
+                for part in index.split(","):
+                    try:
+                        names.extend(self.indices.resolve(part))
+                    except IndexNotFoundError:
+                        pass
+            else:
+                names = self.indices.resolve(index)
+            preserve = params.get("preserve_existing") in ("true", "")
             for n in names:
-                self.indices.indices[n].update_settings(
-                    b.get("settings", b))
+                svc = self.indices.indices[n]
+                spec = b.get("settings", b)
+                if preserve:
+                    from ..node.indices_service import _flatten_settings
+                    flat = _flatten_settings(dict(spec))
+                    spec = {k: v for k, v in flat.items()
+                            if (k if k.startswith("index.")
+                                else f"index.{k}") not in svc.settings
+                            and k.split(".")[-1] not in
+                            ("number_of_replicas", "number_of_shards")}
+                svc.update_settings(spec)
             return {"acknowledged": True}
+        names = self.indices.resolve(index)
+        if index is not None and not names and \
+                not any(c in index for c in "*,"):
+            raise IndexNotFoundError(f"no such index [{index}]")
+        import fnmatch
+        pats = None
+        if name is not None and name not in ("_all", "*"):
+            pats = [p.strip() for p in name.split(",") if p.strip()]
+        flat_form = params.get("flat_settings") in ("true", "")
         out = {}
         for n in names:
-            svc = self.indices.indices[n]
-            out[n] = {"settings": {"index": {
-                "number_of_shards": str(svc.num_shards),
-                "number_of_replicas": str(svc.num_replicas),
-                "uuid": svc.uuid}}}
+            flat = self._index_flat_settings(n)
+            if pats is not None:
+                flat = {k: v for k, v in flat.items()
+                        if any(fnmatch.fnmatchcase(k, p) for p in pats)}
+            entry: dict = {
+                "settings": (flat if flat_form else self._nest_flat(flat))}
+            if params.get("include_defaults") in ("true", ""):
+                d = {k: v for k, v in self.SETTINGS_DEFAULTS.items()
+                     if k not in self._index_flat_settings(n)}
+                if pats is not None:
+                    d = {k: v for k, v in d.items()
+                         if any(fnmatch.fnmatchcase(k, p) for p in pats)}
+                entry["defaults"] = d if flat_form else self._nest_flat(d)
+            out[n] = entry
         return out
 
     def h_refresh(self, params, body, index=None):
@@ -735,32 +1035,141 @@ class RestAPI:
             self.indices.indices[n].force_merge()
         return {"_shards": {"total": 1, "successful": 1, "failed": 0}}
 
+    #: valid stats metric names (reference: CommonStatsFlags.Flag); note
+    #: the API metric "merge" serializes as section "merges"
+    STATS_METRICS = ("docs", "store", "indexing", "get", "search", "merge",
+                     "refresh", "flush", "warmer", "query_cache",
+                     "fielddata", "completion", "segments", "translog",
+                     "suggest", "request_cache", "recovery", "bulk")
+    _METRIC_SECTION = {"merge": "merges", "suggest": "search"}
+    STATS_PARAMS = {"level", "types", "completion_fields",
+                    "fielddata_fields", "fields", "groups",
+                    "include_segment_file_sizes",
+                    "include_unloaded_segments", "expand_wildcards",
+                    "forbid_closed_indices", "ignore_unavailable",
+                    "allow_no_indices"}
+
+    @staticmethod
+    def _check_params(params: dict, allowed: set, uri: str) -> None:
+        common = {"pretty", "human", "error_trace", "filter_path", "format",
+                  "master_timeout", "timeout", "rest_total_hits_as_int"}
+        for p in params:
+            if p not in allowed and p not in common:
+                raise IllegalArgumentError(
+                    f"request [{uri}] contains unrecognized parameter: "
+                    f"[{p}]")
+
+    @staticmethod
+    def _check_metrics(metric: str, valid, uri: str) -> set:
+        import difflib
+        wanted = set()
+        for m in metric.split(","):
+            m = m.strip()
+            if m in ("_all", ""):
+                return set(valid)
+            if m not in valid:
+                hint = difflib.get_close_matches(m, list(valid), n=3)
+                suffix = f" -> did you mean [{hint[0]}]?" if len(hint) == 1 \
+                    else (f" -> did you mean any of {sorted(hint)}?"
+                          if hint else "")
+                raise IllegalArgumentError(
+                    f"request [{uri}] contains unrecognized metric: "
+                    f"[{m}]{suffix}")
+            wanted.add(m)
+        return wanted
+
+    @staticmethod
+    def _match_fields(patterns: str, candidates) -> List[str]:
+        import fnmatch
+        pats = [p.strip() for p in str(patterns).split(",") if p.strip()]
+        out = []
+        for c in candidates:
+            if any(fnmatch.fnmatchcase(c, p) for p in pats):
+                out.append(c)
+        return out
+
     def h_stats(self, params, body, index=None, metric=None):
+        self._check_params(params, self.STATS_PARAMS,
+                           "/_stats" if index is None else f"/{index}/_stats")
         names = self.indices.resolve(index)
-        metrics = set(metric.split(",")) if metric and metric != "_all" \
-            else None
+        metrics = None
+        if metric and metric != "_all":
+            metrics = self._check_metrics(
+                metric, set(self.STATS_METRICS) | {"_all"},
+                f"/_stats/{metric}")
+
+        fields = params.get("fields")
+        fd_fields = params.get("fielddata_fields") or fields
+        comp_fields = params.get("completion_fields") or fields
+        groups = params.get("groups")
+
+        def decorate(svc, st: dict) -> dict:
+            st = {k: (dict(v) if isinstance(v, dict) else v)
+                  for k, v in st.items()}
+            if svc.closed:
+                # a closed index has no open engine: translog is drained
+                # and segments are unloaded unless explicitly included
+                st["translog"] = {k: 0 for k in st["translog"]}
+                if params.get("include_unloaded_segments") not in \
+                        ("true", ""):
+                    st["segments"] = dict(st["segments"], count=0,
+                                          memory_in_bytes=0)
+            if params.get("include_segment_file_sizes") in ("true", ""):
+                st["segments"] = dict(
+                    st["segments"],
+                    file_sizes=_segment_file_sizes(svc.shards))
+            if fd_fields or comp_fields:
+                fd, comp = svc.field_bytes()
+                if fd_fields:
+                    matched = self._match_fields(fd_fields, sorted(fd))
+                    st["fielddata"]["fields"] = {
+                        f: {"memory_size_in_bytes": fd[f]} for f in matched}
+                if comp_fields:
+                    matched = self._match_fields(comp_fields, sorted(comp))
+                    st["completion"]["fields"] = {
+                        f: {"size_in_bytes": comp[f]} for f in matched}
+            if groups:
+                gstats = svc.search_stats.get("groups", {})
+                matched = self._match_fields(groups, sorted(gstats))
+                st["search"] = dict(st["search"])
+                st["search"]["groups"] = {
+                    g: dict(gstats[g], query_time_in_millis=0,
+                            query_current=0, fetch_time_in_millis=0,
+                            fetch_current=0)
+                    for g in matched}
+            return st
 
         def trim(st: dict) -> dict:
             if metrics is None:
                 return st
-            return {k: v for k, v in st.items() if k in metrics}
+            keep = {self._METRIC_SECTION.get(m, m) for m in metrics}
+            return {k: v for k, v in st.items() if k in keep}
 
-        stats_of = {n: self.indices.indices[n].stats() for n in names}
-        per_index = {n: {"primaries": trim(stats_of[n]),
-                         "total": trim(stats_of[n])} for n in names}
-        agg: Dict[str, Any] = {"docs": {"count": 0, "deleted": 0},
-                               "store": {"size_in_bytes": 0}}
+        stats_of = {}
         for n in names:
-            st = stats_of[n]
-            agg["docs"]["count"] += st["docs"]["count"]
-            agg["docs"]["deleted"] += st["docs"]["deleted"]
-            agg["store"]["size_in_bytes"] += st["store"]["size_in_bytes"]
-        return {"_shards": {"total": sum(
-            self.indices.indices[n].num_shards for n in names),
+            svc = self.indices.indices[n]
+            stats_of[n] = trim(decorate(svc, svc.stats()))
+        level = params.get("level", "indices")
+        per_index = {}
+        for n in names:
+            entry = {"uuid": self.indices.indices[n].uuid,
+                     "primaries": stats_of[n], "total": stats_of[n]}
+            if level == "shards":
+                entry["shards"] = self.indices.indices[n].shard_stats(
+                    self.node_id)
+            per_index[n] = entry
+        agg: Dict[str, Any] = {}
+        for n in names:
+            _merge_numeric_tree(agg, stats_of[n])
+        out = {"_shards": {"total": sum(
+            self.indices.indices[n].num_shards *
+            (1 + self.indices.indices[n].num_replicas) for n in names),
             "successful": sum(self.indices.indices[n].num_shards
                               for n in names), "failed": 0},
-            "_all": {"primaries": trim(agg), "total": trim(agg)},
-            "indices": per_index}
+            "_all": {"primaries": agg, "total": agg}}
+        if level != "cluster":
+            out["indices"] = per_index
+        return out
 
     # ------------------------------------------------------------------
     # aliases / templates
@@ -828,19 +1237,56 @@ class RestAPI:
         return {"acknowledged": True}
 
     def h_get_alias(self, params, body, index=None, name=None):
+        """Alias name expressions support comma lists, wildcards and
+        ``-`` exclusions; only CONCRETE names that match nothing 404
+        (reference: ``TransportGetAliasesAction.java`` postProcess)."""
+        import fnmatch
+        all_alias_names = set(self.indices.all_aliases())
+        concrete_missing: List[str] = []
+        if name is None or name in ("_all", "*"):
+            selected = set(all_alias_names)
+        else:
+            parts = [p.strip() for p in name.split(",") if p.strip()]
+            selected = set()
+            # a dash expression is an EXCLUSION only once a wildcard
+            # expression has been seen; before that it is a literal
+            # (missing) alias name — RestGetAliasesAction semantics
+            seen_wildcard = False
+            for p in parts:
+                is_pat = "*" in p or "?" in p
+                if p.startswith("-") and (seen_wildcard or is_pat):
+                    pat = p[1:]
+                    selected -= {a for a in selected
+                                 if fnmatch.fnmatchcase(a, pat)}
+                    seen_wildcard = seen_wildcard or is_pat
+                elif p in ("_all", "*"):
+                    selected |= all_alias_names
+                    seen_wildcard = True
+                elif is_pat:
+                    selected |= {a for a in all_alias_names
+                                 if fnmatch.fnmatchcase(a, p)}
+                    seen_wildcard = True
+                elif p in all_alias_names:
+                    selected.add(p)
+                else:
+                    concrete_missing.append(p)
+        ew = params.get("expand_wildcards", "all")
         out: Dict[str, dict] = {}
         for n in self.indices.resolve(index):
             svc = self.indices.indices[n]
-            aliases = svc.aliases
-            if name is not None:
-                import fnmatch
-                aliases = {a: s for a, s in aliases.items()
-                           if fnmatch.fnmatchcase(a, name)}
-                if not aliases:
-                    continue
-            out[n] = {"aliases": aliases}
-        if name is not None and not out:
-            return 404, {"error": f"alias [{name}] missing", "status": 404}
+            if svc.closed and "closed" not in ew and "all" not in ew:
+                continue
+            aliases = {a: s for a, s in svc.aliases.items()
+                       if a in selected}
+            if aliases or name is None:
+                out[n] = {"aliases": aliases}
+        if concrete_missing:
+            noun = "aliases" if len(concrete_missing) > 1 else "alias"
+            payload = {"error": f"{noun} "
+                       f"[{','.join(sorted(concrete_missing))}] missing",
+                       "status": 404}
+            payload.update(out)
+            return 404, payload
         return out
 
     def h_put_alias(self, params, body, index, name):
@@ -1350,6 +1796,11 @@ class RestAPI:
         from ..search.dist_query import merge_sort_key
         from ..search.shard_search import normalize_sort
         t0 = time.time()
+        groups = search_body.get("stats")
+        for _n in names:
+            svc = self.indices.indices.get(_n)
+            if svc is not None:
+                svc.record_search(groups)
         size = int(search_body.get("size", 10))
         from_ = int(search_body.get("from", 0))
         results = []
@@ -2066,3 +2517,156 @@ def _sort_key_tuple(h: ShardHit):
         else:
             out.append((0, v))
     return tuple(out)
+
+
+#: stats leaves that combine by MAX, not sum (sentinel/high-watermark)
+_MERGE_MAX_KEYS = {"max_unsafe_auto_id_timestamp", "max_seq_no"}
+
+
+def _merge_numeric_tree(dst: dict, src: dict) -> None:
+    """Recursively sum numeric leaves of ``src`` into ``dst`` (stats
+    aggregation across indices/shards); non-numeric leaves copy through."""
+    for k, v in src.items():
+        if isinstance(v, dict):
+            _merge_numeric_tree(dst.setdefault(k, {}), v)
+        elif isinstance(v, bool):
+            dst[k] = dst.get(k, False) or v
+        elif isinstance(v, (int, float)):
+            if k in _MERGE_MAX_KEYS:
+                dst[k] = max(dst.get(k, v), v)
+            else:
+                dst[k] = dst.get(k, 0) + v
+        else:
+            dst.setdefault(k, v)
+
+
+# ---------------------------------------------------------------------------
+# filter_path response filtering (reference: XContentMapValues.filter /
+# rest FilterPath) — dot paths with * and ** wildcards, "-" for excludes
+# ---------------------------------------------------------------------------
+
+def _fp_match(key: str, pat: str) -> bool:
+    import fnmatch
+    return fnmatch.fnmatchcase(str(key), pat)
+
+
+def _fp_include(obj, patterns):
+    if not isinstance(obj, dict):
+        return obj
+    out = {}
+    for k, v in obj.items():
+        keep_all = False
+        sub = []
+        for p in patterns:
+            if not p:
+                continue
+            seg = p[0]
+            if seg == "**":
+                if len(p) == 1:             # trailing ** keeps the subtree
+                    keep_all = True
+                    continue
+                sub.append(p)               # ** can keep matching deeper
+                rest = p[1:]
+                if rest and _fp_match(k, rest[0]):
+                    if len(rest) == 1:
+                        keep_all = True
+                    else:
+                        sub.append(rest[1:])
+            elif _fp_match(k, seg):
+                if len(p) == 1:
+                    keep_all = True
+                else:
+                    sub.append(p[1:])
+        if keep_all:
+            out[k] = v
+        elif sub:
+            if isinstance(v, dict):
+                f = _fp_include(v, sub)
+                if f:
+                    out[k] = f
+            elif isinstance(v, list):
+                fl = []
+                for item in v:
+                    if isinstance(item, dict):
+                        fi = _fp_include(item, sub)
+                        if fi:
+                            fl.append(fi)
+                if fl:
+                    out[k] = fl
+    return out
+
+
+def _fp_exclude(obj, patterns):
+    if not isinstance(obj, dict):
+        return obj
+    out = {}
+    for k, v in obj.items():
+        drop = False
+        sub = []
+        for p in patterns:
+            if not p:
+                continue
+            seg = p[0]
+            if seg == "**":
+                if len(p) == 1:             # trailing ** drops the subtree
+                    drop = True
+                    continue
+                sub.append(p)
+                rest = p[1:]
+                if rest and _fp_match(k, rest[0]):
+                    if len(rest) == 1:
+                        drop = True
+                    else:
+                        sub.append(rest[1:])
+            elif _fp_match(k, seg):
+                if len(p) == 1:
+                    drop = True
+                else:
+                    sub.append(p[1:])
+        if drop:
+            continue
+        if sub and isinstance(v, dict):
+            out[k] = _fp_exclude(v, sub)
+        elif sub and isinstance(v, list):
+            out[k] = [_fp_exclude(i, sub) if isinstance(i, dict) else i
+                      for i in v]
+        else:
+            out[k] = v
+    return out
+
+
+def _apply_filter_path(payload: dict, filter_path: str) -> dict:
+    includes, excludes = [], []
+    for raw in str(filter_path).split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        if raw.startswith("-"):
+            excludes.append(raw[1:].split("."))
+        else:
+            includes.append(raw.split("."))
+    out = payload
+    if includes:
+        out = _fp_include(out, includes)
+    if excludes:
+        out = _fp_exclude(out, excludes)
+    return out
+
+
+def _segment_file_sizes(shards) -> Dict[str, dict]:
+    """Per-extension on-disk footprint across shard directories
+    (include_segment_file_sizes=true serialization)."""
+    sizes: Dict[str, dict] = {}
+    for sh in shards:
+        for root, _, files in os.walk(sh.path):
+            for fname in files:
+                ext = fname.rsplit(".", 1)[-1]
+                try:
+                    sz = os.path.getsize(os.path.join(root, fname))
+                except OSError:
+                    continue
+                e = sizes.setdefault(ext, {"size_in_bytes": 0, "count": 0,
+                                           "description": ext})
+                e["size_in_bytes"] += sz
+                e["count"] += 1
+    return sizes

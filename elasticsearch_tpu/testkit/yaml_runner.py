@@ -38,8 +38,9 @@ from urllib.parse import quote
 REFERENCE_SPEC_ROOT = "/root/reference/rest-api-spec/src/main/resources/rest-api-spec"
 
 #: yaml test features this runner understands
+#: node_selector is trivially satisfied on a single-node target
 SUPPORTED_FEATURES = {"headers", "allowed_warnings", "warnings",
-                      "arbitrary_key"}
+                      "arbitrary_key", "node_selector"}
 
 
 class ApiRegistry:
@@ -163,6 +164,10 @@ class YamlTestRunner:
                 self._run_steps(setup_steps, state)
                 self._run_steps(steps, state)
                 results.append(TestResult(rel, name, True))
+            except TestSkipped as e:
+                # version-gated tests the reference runner would skip
+                # count as not-applicable (ok), mirroring its CI
+                results.append(TestResult(rel, name, True, f"SKIP: {e}"))
             except StepFailure as e:
                 results.append(TestResult(rel, name, False, str(e)))
             except Exception as e:   # noqa: BLE001 — runner bug or crash
@@ -202,7 +207,9 @@ class YamlTestRunner:
             elif kind in ("is_true", "is_false"):
                 got = self._lookup(state["last"], body, state,
                                    missing_ok=True)
-                truthy = got not in (None, False, "", 0, {}, [])
+                # the reference runner's falsiness: null, "", false,
+                # "false", 0, "0" — an empty map/list IS truthy
+                truthy = got not in (None, False, "", 0, "false", "0")
                 if truthy != (kind == "is_true"):
                     raise StepFailure(f"{kind} {body}: value {got!r}")
             elif kind in ("gt", "gte", "lt", "lte"):
@@ -235,9 +242,20 @@ class YamlTestRunner:
         unsupported = [f for f in feats if f not in SUPPORTED_FEATURES]
         if unsupported:
             raise StepFailure(f"requires features {unsupported}")
-        # version-range skips are ignored: we target the 8.x surface
+        ver = body.get("version")
+        if ver is not None and _version_in_ranges(OUR_VERSION, str(ver)):
+            raise TestSkipped(f"version skip [{ver}]")
 
     def _do(self, body: dict, state: dict) -> None:
+        if isinstance(body, dict) and "node_selector" in body:
+            sel = body.get("node_selector") or {}
+            ver = sel.get("version")
+            if ver is not None and \
+                    not _version_in_ranges(OUR_VERSION, str(ver)):
+                # no node of this single-node target matches → the
+                # reference runner skips such tests
+                raise TestSkipped(f"node_selector version [{ver}]")
+            body = {k: v for k, v in body.items() if k != "node_selector"}
         body = dict(body)
         catch = body.pop("catch", None)
         body.pop("headers", None)
@@ -251,9 +269,14 @@ class YamlTestRunner:
         method, path, query = self.registry.resolve(action, params)
         if req_body is not None and method == "GET":
             method = "POST"
-        qs = "&".join(
-            f"{k}={quote(str(v).lower() if isinstance(v, bool) else str(v), safe=',*')}"
-            for k, v in query.items())
+        def _qv(v):
+            if isinstance(v, bool):
+                return str(v).lower()
+            if isinstance(v, list):
+                return ",".join(str(x) for x in v)
+            return str(v)
+        qs = "&".join(f"{k}={quote(_qv(v), safe=',*')}"
+                      for k, v in query.items())
         if isinstance(req_body, list):        # bulk NDJSON form
             payload = "\n".join(
                 x if isinstance(x, str)
@@ -312,7 +335,7 @@ class YamlTestRunner:
         return value
 
     def _lookup(self, obj, path: str, state: dict, missing_ok=False):
-        if path == "$body":
+        if path in ("$body", ""):
             return obj
         path = self._subst(path, state)
         if isinstance(path, str) and path.startswith("$"):
@@ -354,10 +377,9 @@ class YamlTestRunner:
         if isinstance(expected, str) and len(expected) > 1 and \
                 expected.startswith("/") and expected.rstrip().endswith("/"):
             pat = expected.strip().strip("/")
-            # multi-line corpus regexes use verbose mode (comments +
-            # insignificant whitespace); single-line ones are literal
-            flags = re.VERBOSE if "\n" in pat else 0
-            if re.search(pat, str(got), flags) is None:
+            # the reference runner compiles every /regex/ with COMMENTS
+            # (whitespace-insignificant) — match that
+            if re.search(pat, str(got), re.VERBOSE) is None:
                 raise StepFailure(
                     f"match {path}: {got!r} !~ /{pat[:80]}/")
             return
@@ -384,3 +406,41 @@ def run_conformance(api_factory, suites: Optional[List[str]] = None,
     for f in files:
         out.extend(runner.run_file(f))
     return out
+
+
+#: the surface we implement (version-gated skips compare against this)
+OUR_VERSION = (8, 0, 0)
+
+
+class TestSkipped(Exception):
+    """Raised when a version gate makes a test not-applicable."""
+
+
+def _parse_version(s: str):
+    parts = []
+    for piece in s.strip().split("."):
+        num = "".join(ch for ch in piece if ch.isdigit())
+        parts.append(int(num) if num else 0)
+    while len(parts) < 3:
+        parts.append(0)
+    return tuple(parts[:3])
+
+
+def _version_in_ranges(ver, ranges: str) -> bool:
+    """True if ``ver`` falls inside any of the comma-separated
+    ``"lo - hi"`` ranges (either bound may be empty; "all" matches)."""
+    for rng in ranges.split(","):
+        rng = rng.strip()
+        if not rng:
+            continue
+        if rng.lower() == "all":
+            return True
+        if "-" in rng:
+            lo_s, _, hi_s = rng.partition("-")
+            lo = _parse_version(lo_s) if lo_s.strip() else (0, 0, 0)
+            hi = _parse_version(hi_s) if hi_s.strip() else (99, 99, 99)
+            if lo <= ver <= hi:
+                return True
+        elif _parse_version(rng) == ver:
+            return True
+    return False
