@@ -197,12 +197,31 @@ class DateFieldType(MappedFieldType):
     has_doc_values = True
 
     def __init__(self, name: str, date_format: str = "strict_date_optional_time||epoch_millis",
-                 params: Optional[dict] = None):
+                 params: Optional[dict] = None, nanos: bool = False):
         super().__init__(name, params)
         self.format = date_format
+        self.nanos = nanos          # date_nanos resolution (sort values
+                                    # serialize as epoch nanos)
 
     def parse_value(self, value):
         return parse_date_millis(value, self.format)
+
+
+class TokenCountFieldType(MappedFieldType):
+    """token_count (reference: TokenCountFieldMapper): stores the analyzed
+    token count of its input as an integer doc value."""
+
+    type_name = "token_count"
+    has_doc_values = True
+
+    def __init__(self, name: str, analyzer: Analyzer,
+                 params: Optional[dict] = None):
+        super().__init__(name, params)
+        self.analyzer = analyzer
+        self.doc_values = (params or {}).get("doc_values", True)
+
+    def parse_value(self, value):
+        return float(len(self.analyzer.terms(str(value))))
 
 
 class BooleanFieldType(MappedFieldType):
@@ -659,11 +678,15 @@ class MapperService:
         if ftype in NUMERIC_TYPES:
             return NumberFieldType(name, ftype, params)
         if ftype in ("date", "date_nanos"):
-            # date_nanos maps onto the millisecond date column (documented
-            # precision reduction; the reference stores nanos in a long)
+            # date_nanos maps onto the millisecond date column with the
+            # sub-ms remainder kept in the float fraction (the reference
+            # stores nanos in a long)
             return DateFieldType(
                 name, spec.get("format", "strict_date_optional_time||epoch_millis"),
-                params)
+                params, nanos=(ftype == "date_nanos"))
+        if ftype == "token_count":
+            an = self.analysis.get(spec.get("analyzer", "standard"))
+            return TokenCountFieldType(name, an, params)
         if ftype == "boolean":
             return BooleanFieldType(name, params)
         if ftype == "dense_vector":
@@ -762,6 +785,9 @@ class MapperService:
         if not isinstance(source, dict):
             raise MapperParsingError("document source must be a JSON object")
         parsed = ParsedDocument(doc_id=doc_id, source=source, routing=routing)
+        if routing is not None:
+            # _routing indexes as a metadata keyword (RoutingFieldMapper)
+            parsed.keyword_terms.setdefault("_routing", []).append(routing)
         self._parse_object("", source, parsed)
         if parsed.dynamic_updates:
             self.merge({"properties": parsed.dynamic_updates})
@@ -922,7 +948,8 @@ class MapperService:
             # _gte/_lte) so distance/grid queries and aggs read doc values
             parsed.numeric_values.setdefault(f"{full}._lat", []).append(lat)
             parsed.numeric_values.setdefault(f"{full}._lon", []).append(lon)
-        elif isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType)):
+        elif isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType,
+                             TokenCountFieldType)):
             parsed.numeric_values.setdefault(full, []).append(ft.parse_value(value))
         # index multi-fields too
         for sub_name in list(self._fields):

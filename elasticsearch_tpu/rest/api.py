@@ -21,7 +21,8 @@ from urllib.parse import parse_qs, unquote
 
 from ..common.errors import (DocumentMissingError, ElasticsearchError,
                              ResourceNotFoundError,
-                             IllegalArgumentError, IndexNotFoundError,
+                             IllegalArgumentError, IndexClosedError,
+                             IndexNotFoundError,
                              ParsingError, ResourceAlreadyExistsError,
                              VersionConflictError)
 from ..index.mapping import MapperService
@@ -247,7 +248,10 @@ class RestAPI:
             for part in query.split("&"):
                 if part and "=" not in part:
                     params[part] = "true"
-        path = unquote(path.rstrip("/")) or "/"
+        # match routes on the ENCODED path, decode per captured segment
+        # (RestUtils.decodeComponent: %2F inside one segment — date-math
+        # index names, slashed ids — must not split routing)
+        path = path.rstrip("/") or "/"
         matched_path = False
         for m, rx, names, fn in self._routes:
             match = rx.match(path)
@@ -256,7 +260,8 @@ class RestAPI:
             matched_path = True
             if m != method and not (method == "HEAD" and m == "GET"):
                 continue
-            kwargs = dict(zip(names, match.groups()))
+            kwargs = {k: (unquote(v) if v is not None else v)
+                      for k, v in zip(names, match.groups())}
             try:
                 result = fn(params, body, **kwargs)
             except Exception as e:  # noqa: BLE001 — ES-shaped error replies
@@ -1730,10 +1735,19 @@ class RestAPI:
     # ------------------------------------------------------------------
 
     def _hit_json(self, index_name: str, h: ShardHit,
-                  flags: Optional[dict] = None) -> dict:
-        out = {"_index": index_name, "_id": h.doc_id,
-               "_score": h.score, "_source": h.source}
+                  flags: Optional[dict] = None,
+                  n_sort: Optional[int] = None) -> dict:
+        """``n_sort``: how many leading sort values are user-visible
+        (the internal shard-doc tiebreak is NOT serialized — the
+        reference only emits it under a PIT's implicit _shard_doc);
+        None = legacy passthrough, -1 = suppress the sort array."""
+        out = {"_index": index_name, "_id": h.doc_id, "_score": h.score}
+        if h.source is not None:
+            out["_source"] = h.source
         flags = flags or {}
+        stored = flags.get("stored_fields")
+        if stored == "_none_" or stored == ["_none_"]:
+            out.pop("_id", None)
         if flags.get("seq_no_primary_term") and h.seq_no is not None:
             out["_seq_no"] = h.seq_no
             out["_primary_term"] = 1
@@ -1743,8 +1757,9 @@ class RestAPI:
                 out["_version"] = g.version if g.found else None
             except Exception:   # noqa: BLE001 — alias/closed edge cases
                 out["_version"] = None
-        if h.sort_values is not None:
-            out["sort"] = h.sort_values
+        if h.sort_values is not None and n_sort != -1:
+            out["sort"] = (h.sort_values if n_sort is None
+                           else h.sort_values[:n_sort])
         if h.fields:
             out["fields"] = h.fields
         if h.highlight:
@@ -1804,6 +1819,21 @@ class RestAPI:
         size = int(search_body.get("size", 10))
         from_ = int(search_body.get("from", 0))
         results = []
+        # explicit trailing _shard_doc (the reference's PIT tiebreak):
+        # strip it before the shards (they always compute the composite)
+        # and serialize the tiebreak component in hit.sort
+        raw_sort = search_body.get("sort")
+        include_tiebreak = False
+        if isinstance(raw_sort, list) and raw_sort and (
+                raw_sort[-1] == "_shard_doc" or
+                (isinstance(raw_sort[-1], dict)
+                 and "_shard_doc" in raw_sort[-1])):
+            include_tiebreak = True
+            search_body = dict(search_body)
+            if len(raw_sort) > 1:
+                search_body["sort"] = raw_sort[:-1]
+            else:
+                search_body.pop("sort", None)
         window_body = dict(search_body)
         window_body["size"] = size + from_
         window_body["from"] = 0
@@ -1813,6 +1843,22 @@ class RestAPI:
             not score_sorted else []
         n_user = len(user_clauses)
         sa = search_body.get("search_after")
+        if sa and user_clauses and names:
+            # cursor values arrive in field format space (e.g. formatted
+            # dates) — coerce through the field type like SortField.parse
+            from ..index.mapping import DateFieldType
+            mapper = self.indices.indices[names[0]].mapper
+            sa = list(sa)
+            for i, cl in enumerate(user_clauses[: len(sa)]):
+                ft = mapper.field_type(cl["field"])
+                if isinstance(ft, DateFieldType):
+                    if isinstance(sa[i], str):
+                        try:
+                            sa[i] = ft.parse_value(sa[i])
+                        except Exception:  # noqa: BLE001 — keep raw cursor
+                            pass
+                    elif ft.nanos and isinstance(sa[i], (int, float)):
+                        sa[i] = float(sa[i]) / 1e6   # nanos → internal ms
         ord_of = {n: i for i, n in enumerate(names)}
         shift = self._GSD_ORD_SHIFT
         local_mask = (1 << shift) - 1
@@ -1824,15 +1870,42 @@ class RestAPI:
                     sa, ord_of[n], score_sorted, n_user)
                 if cursor is not None:
                     body_n["search_after"] = cursor
+            elif sa is not None:
+                body_n = dict(window_body, search_after=sa)
             svc = self.indices.indices[n]
             results.append((n, svc.search(body_n)))
         total = sum(r.total for _, r in results)
         relation = "eq"
         if any(r.total_relation == "gte" for _, r in results):
             relation = "gte"
+        tth = search_body.get("track_total_hits")
+        if isinstance(tth, int) and not isinstance(tth, bool) \
+                and tth != -1 and total > tth:
+            # -1 means fully-accurate tracking, not a cap
+            total, relation = tth, "gte"
         max_scores = [r.max_score for _, r in results
                       if r.max_score is not None]
         all_hits = [(n, h) for n, r in results for h in r.hits]
+        ib = search_body.get("indices_boost")
+        if ib:
+            import fnmatch
+            entries = list(ib.items()) if isinstance(ib, dict) else \
+                [e for d in ib for e in d.items()]
+            boost_of: Dict[str, float] = {}
+            for pat, b in entries:
+                resolved = [n for n in names
+                            if fnmatch.fnmatchcase(n, pat)
+                            or pat in self.indices.indices[n].aliases]
+                if not resolved and not search_body.get(
+                        "_lenient_indices_boost"):
+                    raise IndexNotFoundError(f"no such index [{pat}]")
+                for n in resolved:         # first matching entry wins
+                    boost_of.setdefault(n, float(b))
+            for n, h in all_hits:
+                if h.score is not None:
+                    h.score *= boost_of.get(n, 1.0)
+            max_scores = [h.score for _, h in all_hits
+                          if h.score is not None]
         if not score_sorted:
             # clause-aware merge (direction + missing placement), then the
             # global (index ordinal, shard-doc) tiebreak — matching the
@@ -1871,6 +1944,22 @@ class RestAPI:
                 all_hits, lambda nh: (nh[1].fields or {}).get(
                     collapse_field, [None])[0])
         page = all_hits[from_: from_ + size]
+        if user_clauses and names:
+            # date_nanos sort values serialize as epoch NANOS longs
+            mapper0 = self.indices.indices[names[0]].mapper
+            from ..index.mapping import DateFieldType as _DFT
+            nano_idx = [i for i, cl in enumerate(user_clauses)
+                        if isinstance(mapper0.field_type(cl["field"]), _DFT)
+                        and mapper0.field_type(cl["field"]).nanos]
+            if nano_idx:
+                for _, h in page:
+                    if h.sort_values:
+                        sv = list(h.sort_values)
+                        for i in nano_idx:
+                            if i < len(sv) and isinstance(
+                                    sv[i], (int, float)):
+                                sv[i] = int(round(float(sv[i]) * 1e6))
+                        h.sort_values = sv
         aggregations = None
         if len(names) == 1:
             aggregations = results[0][1].aggregations
@@ -1887,10 +1976,16 @@ class RestAPI:
             "hits": {
                 "total": {"value": total, "relation": relation},
                 "max_score": max(max_scores) if max_scores else None,
-                "hits": [self._hit_json(n, h, search_body)
-                         for n, h in page],
+                "hits": [self._hit_json(
+                    n, h, search_body,
+                    n_sort=(None if include_tiebreak
+                            else -1 if sort_spec is None
+                            else (n_user if not score_sorted else 1)))
+                    for n, h in page],
             },
         }
+        if search_body.get("track_total_hits") is False:
+            out["hits"].pop("total", None)
         if aggregations is not None:
             out["aggregations"] = aggregations
         # cross-index suggest: merge options per (suggester, token entry) —
@@ -1943,12 +2038,15 @@ class RestAPI:
             for field, spec in list(t.items()):
                 if isinstance(spec, dict) and "index" in spec \
                         and "id" in spec:
+                    # a missing lookup INDEX is an error (the reference's
+                    # coordinator rewrite GET fails the request); a
+                    # missing DOC resolves to no terms
+                    svc = self.indices.get(spec["index"])
                     try:
-                        svc = self.indices.get(spec["index"])
                         r = svc.get_doc(str(spec["id"]),
                                         routing=spec.get("routing"))
                         src = r.source if r.found else {}
-                    except Exception:   # noqa: BLE001 — missing index → []
+                    except Exception:   # noqa: BLE001 — doc-level miss
                         src = {}
                     vals = [src]
                     for part in str(spec.get("path", "")).split("."):
@@ -1964,10 +2062,266 @@ class RestAPI:
         for v in node.values():
             self._rewrite_terms_lookup(v)
 
-    def h_search(self, params, body, index=None):
+    #: accepted top-level search body keys (SearchSourceBuilder fields)
+    SEARCH_BODY_KEYS = {
+        "query", "from", "size", "sort", "_source", "fields",
+        "docvalue_fields", "stored_fields", "script_fields", "aggs",
+        "aggregations", "highlight", "suggest", "search_after", "collapse",
+        "rescore", "explain", "version", "seq_no_primary_term",
+        "track_total_hits", "track_scores", "min_score", "post_filter",
+        "knn", "pit", "profile", "indices_boost", "stats", "timeout",
+        "terminate_after", "runtime_mappings", "slice", "rank", "ext",
+        "indices_options"}
+
+    def _validate_search(self, search_body: dict, params: dict,
+                         names: List[str], scroll: bool = False) -> None:
+        """Request validations the reference performs up front
+        (SearchSourceBuilder parse + SearchService.validate)."""
+        for key in search_body:
+            if key not in self.SEARCH_BODY_KEYS:
+                raise ParsingError(f"unknown key [{key}] in the search "
+                                   f"request")
+        tth = search_body.get("track_total_hits")
+        if isinstance(tth, int) and not isinstance(tth, bool) and \
+                tth < 0 and tth != -1:
+            raise IllegalArgumentError(
+                f"[track_total_hits] parameter must be positive or equals "
+                f"to -1, got {tth}")
+        frm = search_body.get("from", params.get("from"))
+        if frm is not None and int(frm) < 0:
+            raise IllegalArgumentError(
+                f"[from] parameter cannot be negative but was [{frm}]")
+        size = search_body.get("size", params.get("size"))
+        if size is not None and int(size) < 0:
+            raise IllegalArgumentError(
+                f"[size] parameter cannot be negative, found [{size}]")
+        max_window = 10000
+        for n in names:
+            try:
+                max_window = int(self.indices.indices[n].settings.get(
+                    "index.max_result_window", max_window))
+            except (KeyError, ValueError):
+                pass
+        f, s = int(frm or 0), int(size if size is not None else 10)
+        if scroll:
+            if s > max_window:
+                raise IllegalArgumentError(
+                    f"Batch size is too large, size must be less than or "
+                    f"equal to: [{max_window}] but was [{s}]. Scroll batch "
+                    f"sizes cost as much memory as result windows so they "
+                    f"are controlled by the [index.max_result_window] "
+                    f"index level setting.")
+        elif f + s > max_window:
+            raise IllegalArgumentError(
+                f"Result window is too large, from + size must be less "
+                f"than or equal to: [{max_window}] but was [{f + s}]. See "
+                f"the scroll api for a more efficient way to request "
+                f"large data sets. This limit can be set by changing the "
+                f"[index.max_result_window] index level setting.")
+        for resc in _as_list(search_body.get("rescore")):
+            w = int((resc or {}).get("window_size", 10))
+            if w > 10000:
+                raise IllegalArgumentError(
+                    f"Rescore window [{w}] is too large. It must be less "
+                    f"than [10000]. This prevents allocating massive "
+                    f"heaps for storing the results to be rescored. This "
+                    f"limit can be set by changing the "
+                    f"[index.max_rescore_window] index level setting.")
+        def idx_setting(key: str, default: int) -> int:
+            v = default
+            for n in names:
+                raw = self.indices.indices[n].settings.get(key)
+                if raw is not None:
+                    try:
+                        v = int(raw)
+                    except (TypeError, ValueError):
+                        pass
+            return v
+
+        dvf = search_body.get("docvalue_fields")
+        max_dvf = idx_setting("index.max_docvalue_fields_search", 100)
+        if isinstance(dvf, list) and len(dvf) > max_dvf:
+            raise IllegalArgumentError(
+                f"Trying to retrieve too many docvalue_fields. Must be "
+                f"less than or equal to: [{max_dvf}] but was [{len(dvf)}]. "
+                f"This limit can be set by changing the "
+                f"[index.max_docvalue_fields_search] index level setting.")
+        sf = search_body.get("script_fields")
+        max_sf = idx_setting("index.max_script_fields", 32)
+        if isinstance(sf, dict) and len(sf) > max_sf:
+            raise IllegalArgumentError(
+                f"Trying to retrieve too many script_fields. Must be less "
+                f"than or equal to: [{max_sf}] but was [{len(sf)}]. This "
+                f"limit can be set by changing the [index.max_script_fields]"
+                f" index level setting.")
+        max_regex = idx_setting("index.max_regex_length", 1000)
+        max_terms = idx_setting("index.max_terms_count", 65536)
+
+        def walk_query(q):
+            if isinstance(q, list):
+                for item in q:
+                    walk_query(item)
+                return
+            if not isinstance(q, dict):
+                return
+            for k, v in q.items():
+                if k == "regexp" and isinstance(v, dict):
+                    for spec in v.values():
+                        val = spec.get("value") if isinstance(spec, dict) \
+                            else spec
+                        if val is not None and len(str(val)) > max_regex:
+                            raise IllegalArgumentError(
+                                f"The length of regex [{len(str(val))}] "
+                                f"used in the Regexp Query request has "
+                                f"exceeded the allowed maximum of "
+                                f"[{max_regex}]. This maximum can be set "
+                                f"by changing the [index.max_regex_length] "
+                                f"index level setting.")
+                if k == "terms" and isinstance(v, dict):
+                    for vals in v.values():
+                        if isinstance(vals, list) and len(vals) > max_terms:
+                            raise IllegalArgumentError(
+                                f"The number of terms [{len(vals)}] used "
+                                f"in the Terms Query request has exceeded "
+                                f"the allowed maximum of [{max_terms}]. "
+                                f"This maximum can be set by changing the "
+                                f"[index.max_terms_count] index level "
+                                f"setting.")
+                walk_query(v)
+
+        walk_query(search_body.get("query"))
+        collapse = search_body.get("collapse")
+        if collapse:
+            if scroll:
+                raise IllegalArgumentError(
+                    "cannot use `collapse` in a scroll context")
+            if search_body.get("search_after") is not None:
+                raise IllegalArgumentError(
+                    "cannot use `collapse` in conjunction with "
+                    "`search_after`")
+            if search_body.get("rescore"):
+                raise IllegalArgumentError(
+                    "cannot use `collapse` in conjunction with `rescore`")
+        st = params.get("search_type")
+        if st and st not in ("query_then_fetch", "dfs_query_then_fetch"):
+            raise IllegalArgumentError(
+                f"No search type for [{st}]")
+        brs = params.get("batched_reduce_size")
+        if brs is not None and int(brs) < 2:
+            raise IllegalArgumentError("batchedReduceSize must be >= 2")
+        pfss = params.get("pre_filter_shard_size")
+        if pfss is not None and int(pfss) < 1:
+            raise IllegalArgumentError("preFilterShardSize must be >= 1")
+
+    @staticmethod
+    def _resolve_date_math(expr: Optional[str]) -> Optional[str]:
+        """``<logstash-{now/d}>`` style date-math index names
+        (IndexNameExpressionResolver.DateMathExpressionResolver)."""
+        if not expr or "<" not in expr:
+            return expr
+        import datetime
+
+        def one(name: str) -> str:
+            if not (name.startswith("<") and name.endswith(">")):
+                return name
+            inner = name[1:-1]
+            m = re.match(r"^(.*)\{now(?:/([dMyHhms]))?"
+                         r"(?:\{([^}|]+)(?:\|[^}]*)?\})?\}$", inner)
+            if not m:
+                return name
+            static, unit, fmt = m.group(1), m.group(2), m.group(3)
+            now = datetime.datetime.now(datetime.timezone.utc)
+            if unit in ("d",):
+                now = now.replace(hour=0, minute=0, second=0, microsecond=0)
+            elif unit == "M":
+                now = now.replace(day=1, hour=0, minute=0, second=0,
+                                  microsecond=0)
+            elif unit == "y":
+                now = now.replace(month=1, day=1, hour=0, minute=0,
+                                  second=0, microsecond=0)
+            pattern = fmt or "yyyy.MM.dd"
+            out = pattern
+            for java, strf in (("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"),
+                               ("HH", "%H"), ("mm", "%M"), ("ss", "%S")):
+                out = out.replace(java, now.strftime(strf))
+            return static + out
+        return ",".join(one(p) for p in expr.split(","))
+
+    def _resolve_search_indices(self, index: Optional[str],
+                                params: dict) -> List[str]:
+        """Index resolution with indices-options semantics."""
+        index = self._resolve_date_math(index)
+        ignore_unavail = params.get("ignore_unavailable") in ("true", "")
+        if ignore_unavail and index:
+            names = []
+            for part in index.split(","):
+                try:
+                    names.extend(self.indices.resolve(part))
+                except IndexNotFoundError:
+                    pass
+            return [n for n in names
+                    if not self.indices.indices[n].closed]
         names = self.indices.resolve(index)
+        ew = params.get("expand_wildcards", "open")
+        for n in names:
+            if self.indices.indices[n].closed and index and (
+                    (not any(c in index for c in "*,")
+                     and index != "_all")
+                    or "closed" in ew or ew == "all"):
+                raise IndexClosedError(f"closed index [{n}]")
+        names = [n for n in names if not self.indices.indices[n].closed]
+        if not names and index and \
+                params.get("allow_no_indices") == "false":
+            raise IndexNotFoundError(f"no such index [{index}]")
+        return names
+
+    def h_search(self, params, body, index=None):
+        names = self._resolve_search_indices(index, params)
         search_body = _json_body(body)
+        # URL-param forms of fetch options (they OVERRIDE body _source
+        # filtering, RestSearchAction.parseSearchSource)
+        if "_source_includes" in params or "_source_excludes" in params:
+            search_body["_source"] = {
+                k: params[p].split(",")
+                for k, p in (("includes", "_source_includes"),
+                             ("excludes", "_source_excludes")) if p in params}
+        elif "_source" in params:
+            v = params["_source"]
+            search_body["_source"] = (v.lower() == "true") \
+                if v.lower() in ("true", "false") else v.split(",")
+        if "docvalue_fields" in params:
+            search_body["docvalue_fields"] = \
+                params["docvalue_fields"].split(",")
+        if "stored_fields" in params:
+            search_body["stored_fields"] = params["stored_fields"].split(",")
+        if "track_total_hits" in params:
+            v = params["track_total_hits"].lower()
+            search_body["track_total_hits"] = (
+                v == "true" if v in ("true", "false") else int(v))
+        for bflag in ("seq_no_primary_term", "version", "explain"):
+            if bflag in params:
+                search_body[bflag] = _flag(params, bflag)
+        if search_body.get("fields"):
+            for n in names:
+                if not self.indices.indices[n].mapper.source_enabled:
+                    raise IllegalArgumentError(
+                        f"Unable to retrieve the requested [fields] since "
+                        f"_source is disabled in the mappings for index "
+                        f"[{n}]")
         self._rewrite_terms_lookup(search_body)
+        self._validate_search(search_body, params, names,
+                              scroll=bool(params.get("scroll")))
+        if params.get("rest_total_hits_as_int") in ("true", "") and \
+                isinstance(search_body.get("track_total_hits"), int) and \
+                not isinstance(search_body.get("track_total_hits"), bool) \
+                and search_body.get("track_total_hits") != -1:
+            raise IllegalArgumentError(
+                "[rest_total_hits_as_int] cannot be used if the tracking "
+                "of total hits is not accurate, got "
+                f"{search_body['track_total_hits']}")
+        if params.get("ignore_unavailable") in ("true", "") and \
+                search_body.get("indices_boost"):
+            search_body = dict(search_body, _lenient_indices_boost=True)
         if "q" in params:
             search_body["query"] = {"query_string": {
                 "query": params["q"]}} if False else _lucene_qs_to_dsl(
@@ -1976,11 +2330,14 @@ class RestAPI:
             if p in params:
                 search_body[p] = int(params[p])
         if not names:
-            return {"took": 0, "timed_out": False,
-                    "_shards": {"total": 0, "successful": 0, "skipped": 0,
-                                "failed": 0},
-                    "hits": {"total": {"value": 0, "relation": "eq"},
-                             "max_score": None, "hits": []}}
+            empty = {"took": 0, "timed_out": False,
+                     "_shards": {"total": 0, "successful": 0, "skipped": 0,
+                                 "failed": 0},
+                     "hits": {"total": {"value": 0, "relation": "eq"},
+                              "max_score": None, "hits": []}}
+            if params.get("rest_total_hits_as_int") in ("true", ""):
+                empty["hits"]["total"] = 0
+            return empty
         scroll = params.get("scroll")
         if scroll:
             if int(search_body.get("size", 10)) == 0:
@@ -1993,6 +2350,8 @@ class RestAPI:
             total = out.get("hits", {}).get("total")
             if isinstance(total, dict):
                 out["hits"]["total"] = total["value"]
+            elif total is None and "hits" in out:
+                out["hits"]["total"] = -1    # track_total_hits=false
         return out
 
     def h_validate_query(self, params, body, index=None):
@@ -2651,6 +3010,12 @@ def _apply_filter_path(payload: dict, filter_path: str) -> dict:
     if excludes:
         out = _fp_exclude(out, excludes)
     return out
+
+
+def _as_list(v) -> list:
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
 
 
 def _segment_file_sizes(shards) -> Dict[str, dict]:

@@ -100,7 +100,12 @@ def docvalue_fields(seg: Segment, mapper: MapperService, local_doc: int,
         if nf is not None:
             sel = nf.docs_host == local_doc
             for v in nf.vals_host[sel]:
-                if isinstance(ft, DateFieldType) or fmt in (
+                if fmt is not None and "#" in fmt:
+                    vals.append(decimal_format(float(v), fmt))
+                elif isinstance(ft, DateFieldType) and fmt not in (
+                        None, "strict_date_optional_time", "date"):
+                    vals.append(java_date_format(float(v), fmt))
+                elif isinstance(ft, DateFieldType) or fmt in (
                         "date", "strict_date_optional_time"):
                     vals.append(format_date_millis(float(v)))
                 elif float(v).is_integer() and ft is not None and \
@@ -215,4 +220,132 @@ def highlight(mapper: MapperService, source: Optional[dict],
                                          pre, post))
         if frags:
             out[field] = frags[: n_frags if n_frags > 0 else None]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fields retrieval (reference: subphase/FetchFieldsPhase.java +
+# fetch/subphase/FieldFetcher.java — source-driven, formatted values)
+# ---------------------------------------------------------------------------
+
+_JAVA_STRFTIME = [("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
+                  ("mm", "%M"), ("ss", "%S")]
+
+
+def java_date_format(millis: float, pattern: str) -> str:
+    """Subset of Joda/Java date patterns → formatted UTC string."""
+    import datetime
+    if pattern in ("epoch_millis",):
+        return str(int(millis))
+    dt = datetime.datetime.fromtimestamp(millis / 1000.0,
+                                         tz=datetime.timezone.utc)
+    out = pattern
+    if "SSS" in out:
+        out = out.replace("SSS", f"{dt.microsecond // 1000:03d}")
+    for java, strf in _JAVA_STRFTIME:
+        out = out.replace(java, dt.strftime(strf))
+    return out
+
+
+def decimal_format(value: float, pattern: str) -> str:
+    """Minimal java DecimalFormat: '#.0' style patterns → fixed decimals."""
+    if "." in pattern:
+        decimals = len(pattern.split(".", 1)[1])
+        return f"{value:.{decimals}f}"
+    return str(int(round(value)))
+
+
+def _source_path_values(src, path: str) -> List[Any]:
+    """All values at a dotted path, traversing dicts and flattening lists."""
+    nodes = [src]
+    for part in path.split("."):
+        nxt: List[Any] = []
+        for n in nodes:
+            if isinstance(n, list):
+                n_items = n
+            else:
+                n_items = [n]
+            for item in n_items:
+                if isinstance(item, dict) and part in item:
+                    v = item[part]
+                    nxt.extend(v if isinstance(v, list) else [v])
+        nodes = nxt
+    return [n for n in nodes if n is not None]
+
+
+def fetch_fields(mapper: MapperService, src: Optional[dict],
+                 specs: Sequence) -> Dict[str, List[Any]]:
+    """The ``fields`` request option: formatted values extracted from
+    _source for every mapped field matching each pattern."""
+    import fnmatch
+    from ..index.mapping import (AliasFieldType, NumberFieldType,
+                                 ObjectFieldType, RangeFieldType,
+                                 BooleanFieldType, TokenCountFieldType)
+    from ..common.errors import IllegalArgumentError
+    out: Dict[str, List[Any]] = {}
+    if not isinstance(src, dict):
+        return out
+    mapped = mapper._fields
+    for spec in specs:
+        if isinstance(spec, dict):
+            pattern = spec.get("field")
+            fmt = spec.get("format")
+        else:
+            pattern, fmt = spec, None
+        if pattern is None:
+            raise ParsingError("[fields] entries require [field]")
+        matches = [pattern] if pattern in mapped else [
+            f for f in mapped
+            if fnmatch.fnmatchcase(f, pattern)]
+        for f in matches:
+            ft = mapped.get(f)
+            if isinstance(ft, ObjectFieldType):
+                continue
+            path = f
+            if isinstance(ft, AliasFieldType):
+                path = ft.path
+                ft = mapper.field_type(f)
+            if fmt is not None and not isinstance(
+                    ft, (DateFieldType, RangeFieldType)):
+                raise IllegalArgumentError(
+                    f"Field [{f}] of type [{getattr(ft, 'type_name', '?')}]"
+                    f" doesn't support formats.")
+            raw = _source_path_values(src, path)
+            if not raw and "." in path:
+                # multi-field subfield: values live at the PARENT's path
+                parent = path.rsplit(".", 1)[0]
+                pft = mapped.get(parent)
+                if pft is not None and not isinstance(pft, ObjectFieldType):
+                    raw = _source_path_values(src, parent)
+            vals: List[Any] = []
+            for v in raw:
+                try:
+                    if isinstance(ft, DateFieldType):
+                        ms = ft.parse_value(v)
+                        vals.append(java_date_format(ms, fmt)
+                                    if fmt else
+                                    (v if isinstance(v, str) else ms))
+                    elif isinstance(ft, TokenCountFieldType):
+                        if not ft.doc_values:
+                            continue     # no doc values → not retrievable
+                        vals.append(int(ft.parse_value(v)))
+                    elif isinstance(ft, RangeFieldType):
+                        vals.append(v)
+                    elif isinstance(ft, NumberFieldType):
+                        n = float(ft.parse_value(v))
+                        vals.append(int(n) if ft.type_name in (
+                            "long", "integer", "short", "byte")
+                            else n)
+                    elif isinstance(ft, BooleanFieldType):
+                        vals.append(v if isinstance(v, bool)
+                                    else str(v).lower() == "true")
+                    else:
+                        vals.append(v if isinstance(v, (dict, bool))
+                                    else str(v))
+                except IllegalArgumentError:
+                    raise
+                except Exception:   # noqa: BLE001 — malformed value skip
+                    continue
+            if vals:
+                out[f] = vals
     return out

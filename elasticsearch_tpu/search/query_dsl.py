@@ -677,8 +677,37 @@ class ExistsQuery(Query):
         self.field = field
         self.boost = boost
 
+    #: metadata fields every live doc carries (FieldNamesFieldMapper
+    #: exempts them from _field_names; exists matches all docs)
+    ALWAYS_PRESENT = {"_id", "_index", "_type", "_seq_no", "_version",
+                      "_primary_term", "_doc_count"}
+
     def execute(self, ctx, seg):
+        if self.field == "_source":
+            from ..common.errors import ElasticsearchError
+
+            class QueryShardError(ElasticsearchError):
+                status = 400
+                error_type = "query_shard_exception"
+            raise QueryShardError(
+                "the [_source] field may not be queried directly")
+        if self.field in self.ALWAYS_PRESENT:
+            return _const_result(seg, self.boost, True)
         field = ctx.concrete_field(self.field)
+        # object field: exists iff any mapped subfield exists
+        sub_fields = [n for n in getattr(ctx.mapper, "_fields", {})
+                      if n.startswith(field + ".")]
+        from ..index.mapping import ObjectFieldType as _Obj
+        ft_self = ctx.field_type(field)
+        if isinstance(ft_self, _Obj) and sub_fields:
+            sub = [ExistsQuery(sf) for sf in sub_fields]
+            return BoolQuery(should=sub, boost=self.boost).execute(ctx, seg)
+        # geo_point: presence via the paired coordinate columns
+        if seg.numeric_fields.get(f"{field}._lat") is not None:
+            exists = np.zeros(seg.n_pad, bool)
+            exists[seg.numeric_fields[f"{field}._lat"].docs_host] = True
+            mask = jnp.asarray(exists)
+            return jnp.where(mask, np.float32(self.boost), 0.0), mask
         exists = np.zeros(seg.n_pad, bool)
         tf_ = seg.text_fields.get(field)
         if tf_ is not None:
@@ -1301,6 +1330,7 @@ def _parse_terms(body):
     (field, values), = opts.items()
     if not isinstance(values, list):
         raise ParsingError("[terms] query requires an array of values")
+    # count limits are enforced settings-aware at the request layer
     return TermsQuery(field, values, boost)
 
 
@@ -1368,6 +1398,8 @@ def _parse_wildcard(body):
 
 
 def _parse_regexp(body):
+    # length limits are enforced settings-aware at the request layer
+    # (RestAPI._validate_search walk), not here
     field, value, opts = _field_body(body, "value")
     return WildcardQuery(field, value, float(opts.get("boost", 1.0)),
                          is_regexp=True)
@@ -1411,6 +1443,22 @@ class _AllTextFieldsQuery(Query):
                 MatchQuery(f, self.text).collect_highlight_terms(ctx, out)
 
 
+class _AllFieldsRegexpQuery(Query):
+    """Regex literal with no explicit field: dis_max of regexp over every
+    text field, resolved per segment (the default-field case)."""
+
+    def __init__(self, pattern: str, boost: float = 1.0):
+        self.pattern = pattern
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        subs = [WildcardQuery(f, self.pattern, is_regexp=True)
+                for f in sorted(seg.text_fields)]
+        if not subs:
+            return _const_result(seg, 0.0, False)
+        return DisMaxQuery(subs, 0.0, self.boost).execute(ctx, seg)
+
+
 class QueryStringQuery(Query):
     """Lucene query-string syntax, the commonly-used subset (reference:
     ``QueryStringQueryBuilder`` wrapping the full Lucene parser):
@@ -1450,20 +1498,39 @@ class QueryStringQuery(Query):
 
     def _leaf(self, fields: List[str], text: str) -> "Query":
         field = None
-        if ":" in text and not text.startswith('"'):
+        if ":" in text and not text.startswith('"') \
+                and not text.startswith("/"):
             field, _, text = text.partition(":")
         phrase = text.startswith('"') and text.endswith('"') and \
             len(text) >= 2
         if phrase:
             text = text[1:-1]
+        regex = None
+        if text.startswith("/") and text.endswith("/") and len(text) >= 2:
+            regex = text[1:-1]
+            if len(regex) > 1000:
+                raise IllegalArgumentError(
+                    f"The length of regex [{len(regex)}] used in the "
+                    f"Regexp Query request has exceeded the allowed "
+                    f"maximum of [1000]. This maximum can be set by "
+                    f"changing the [index.max_regex_length] index level "
+                    f"setting.")
         targets = [field] if field else fields
         subs: List[Query] = []
         for f in targets:
             boost = 1.0
             if "^" in f:
-                f, _, b = f.partition("^")
-                boost = float(b)
-            if f in ("*", ""):
+                head, _, b = f.partition("^")
+                try:
+                    boost = float(b)
+                    f = head
+                except ValueError:
+                    pass             # a literal ^ in the term, not a boost
+            if regex is not None:
+                sub = (_AllFieldsRegexpQuery(regex, boost)
+                       if f in ("*", "")
+                       else WildcardQuery(f, regex, boost, is_regexp=True))
+            elif f in ("*", ""):
                 sub = _AllTextFieldsQuery(text, phrase, boost)
             elif phrase:
                 sub = MatchPhraseQuery(f, text, 0, boost)
@@ -1475,6 +1542,10 @@ class QueryStringQuery(Query):
         return subs[0] if len(subs) == 1 else DisMaxQuery(subs, 0.0)
 
     def _compile(self, q: str, fields: List[str], default_op: str) -> Query:
+        qs = q.strip()
+        if qs.startswith("/") and qs.endswith("/") and len(qs) >= 2:
+            # a whole-query regex literal (spaces inside stay part of it)
+            return self._leaf(fields, qs)
         tokens = self._tokenize(q)
         must, should, must_not = [], [], []
         pending_op = None
@@ -1585,6 +1656,24 @@ def _parse_multi_match(body):
     fields = body.get("fields") or []
     text = body.get("query")
     mtype = body.get("type", "best_fields")
+    if mtype == "bool_prefix" and "slop" in body:
+        raise IllegalArgumentError(
+            "[slop] not allowed for type [bool_prefix]")
+    if mtype == "bool_prefix":
+        from .query_dsl import _parse_match_bool_prefix   # self module
+        queries = []
+        for f in body.get("fields") or []:
+            if "^" in f:
+                f = f.partition("^")[0]
+            queries.append(_parse_match_bool_prefix(
+                {f: {"query": body.get("query"),
+                     "minimum_should_match":
+                         body.get("minimum_should_match"),
+                     "fuzziness": body.get("fuzziness")}}))
+        if not queries:
+            return MatchNoneQuery()
+        return DisMaxQuery(queries, float(body.get("tie_breaker", 0.0)),
+                           float(body.get("boost", 1.0)))
     tie = float(body.get("tie_breaker", 0.0))
     queries: List[Query] = []
     for f in fields:

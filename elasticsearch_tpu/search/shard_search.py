@@ -427,7 +427,15 @@ class ShardSearcher:
 
         # --- fetch phase ---------------------------------------------------
         source_spec = body.get("_source", True)
+        stored = body.get("stored_fields")
+        if stored is not None and "_source" not in body and \
+                "_source" not in _as_list_(stored):
+            # stored_fields [] / "_none_" / list without _source → no source
+            source_spec = False
+        if not self.mapper.source_enabled:
+            source_spec = False
         dv_specs = body.get("docvalue_fields") or []
+        field_specs = body.get("fields") or []
         hl_spec = body.get("highlight")
         hl_terms: Dict[str, set] = {}
         if hl_spec:
@@ -445,6 +453,18 @@ class ShardSearcher:
                 sort_values=sort_values, seq_no=int(seg.seq_nos[d]))
             if dv_specs:
                 hit.fields = docvalue_fields(seg, self.mapper, d, dv_specs)
+            if field_specs:
+                from .fetch import fetch_fields
+                hit.fields = dict(fetch_fields(self.mapper, src,
+                                               field_specs),
+                                  **(hit.fields or {}))
+            stored_list = [f for f in _as_list_(stored or [])
+                           if f not in ("_none_", "_source")]
+            if stored_list:
+                from .fetch import fetch_fields
+                hit.fields = dict(fetch_fields(self.mapper, src,
+                                               stored_list),
+                                  **(hit.fields or {}))
             if collapse_keyf is not None:
                 kv = collapse_keyf(seg_idx, d)
                 hit.fields = dict(hit.fields or {},
@@ -764,3 +784,7 @@ def _sort_includes_score(sort_spec) -> bool:
         if c == "_score" or (isinstance(c, dict) and "_score" in c):
             return True
     return False
+
+
+def _as_list_(v) -> list:
+    return v if isinstance(v, list) else [v]

@@ -186,7 +186,8 @@ def test_search_after_score_ties_paginate_completely(api):
     seen = []
     after = None
     while True:
-        body = {"query": {"match": {"body": "same"}}, "size": 5}
+        body = {"query": {"match": {"body": "same"}}, "size": 5,
+                "sort": [{"_score": "desc"}, "_shard_doc"]}
         if after is not None:
             body["search_after"] = after
         _, resp = req(api, "POST", "/p/_search", body)
@@ -215,7 +216,8 @@ def test_search_after_score_ties_across_indices(api):
     seen = []
     after = None
     while True:
-        body = {"query": {"match": {"body": "same"}}, "size": 3}
+        body = {"query": {"match": {"body": "same"}}, "size": 3,
+                "sort": [{"_score": "desc"}, "_shard_doc"]}
         if after is not None:
             body["search_after"] = after
         _, resp = req(api, "POST", "/m1,m2/_search", body)
