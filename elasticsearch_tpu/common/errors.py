@@ -124,3 +124,9 @@ class ResourceNotFoundError(ElasticsearchError):
 class IndexClosedError(ElasticsearchError):
     status = 400
     error_type = "index_closed_exception"
+
+
+class XContentParseError(ElasticsearchError):
+    """Agg/body parse failures surfaced as x_content_parse_exception."""
+    status = 400
+    error_type = "x_content_parse_exception"

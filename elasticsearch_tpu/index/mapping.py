@@ -599,6 +599,9 @@ class MapperService:
                  analysis_registry: Optional[AnalysisRegistry] = None):
         self.analysis = analysis_registry or AnalysisRegistry()
         self._fields: Dict[str, MappedFieldType] = {}
+        #: fields whose column data a sort/agg has materialized — the
+        #: fielddata stats accounting (lazily loaded, like Lucene)
+        self.fielddata_loaded: set = set()
         self._mapping_def: dict = {"properties": {}}
         self.dynamic: Any = True
         self.source_enabled = True
@@ -971,6 +974,16 @@ class MapperService:
                         v = sub.parse_value(value)
                         if v is not None:
                             parsed.keyword_terms.setdefault(sub_name, []).append(v)
+                    elif isinstance(sub, (NumberFieldType, DateFieldType,
+                                          BooleanFieldType,
+                                          TokenCountFieldType)):
+                        try:
+                            parsed.numeric_values.setdefault(
+                                sub_name, []).append(sub.parse_value(value))
+                        except MapperParsingError:
+                            if not (sub.params or {}).get(
+                                    "ignore_malformed"):
+                                raise
                     elif isinstance(sub, TextFieldType):
                         toks = parsed.text_tokens.setdefault(sub_name, [])
                         base_pos = (toks[-1].position + 101) if toks else 0

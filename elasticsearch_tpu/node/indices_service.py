@@ -191,11 +191,14 @@ class IndexService:
         from ..index.mapping import CompletionFieldType
         completion_fields = {n for n, ft in self.mapper._fields.items()
                              if isinstance(ft, CompletionFieldType)}
+        loaded = self.mapper.fielddata_loaded
         fd: Dict[str, int] = {}
         comp: Dict[str, int] = {}
         for s in self.shards:
             for seg in s.searchable_segments():
                 for fname, f in seg.text_fields.items():
+                    if fname not in loaded:
+                        continue          # fielddata loads lazily
                     fd[fname] = fd.get(fname, 0) + int(
                         f.docs_host.nbytes + f.tf_host.nbytes +
                         f.pos_flat.nbytes + f.doc_len_host.nbytes)
@@ -205,9 +208,11 @@ class IndexService:
                             sum(len(t) for t in f.ord_terms))
                     if fname in completion_fields:
                         comp[fname] = comp.get(fname, 0) + n
-                    else:
+                    elif fname in loaded:
                         fd[fname] = fd.get(fname, 0) + n
                 for fname, f in seg.numeric_fields.items():
+                    if fname not in loaded:
+                        continue
                     fd[fname] = fd.get(fname, 0) + int(
                         f.vals_host.nbytes + f.docs_host.nbytes)
         return fd, comp

@@ -2275,6 +2275,62 @@ class RestAPI:
             raise IndexNotFoundError(f"no such index [{index}]")
         return names
 
+    def _typed_prefix(self, kind: str, body: dict, mapper) -> str:
+        """typed_keys prefixes (InternalAggregation type names)."""
+        from ..index.mapping import (BooleanFieldType, DateFieldType,
+                                     KeywordFieldType, NumberFieldType)
+        if kind in ("terms", "significant_terms"):
+            sig = "sig" if kind == "significant_terms" else ""
+            ft = mapper.field_type(body.get("field", "")) if mapper else None
+            tn = getattr(ft, "type_name", "")
+            if isinstance(ft, NumberFieldType):
+                return f"{sig}dterms" if tn in ("double", "float",
+                                                "half_float") \
+                    else f"{sig}lterms"
+            if isinstance(ft, (BooleanFieldType, DateFieldType)):
+                return f"{sig}lterms"
+            return f"{sig}sterms"
+        if kind == "percentiles":
+            return "hdr_percentiles" if "hdr" in body \
+                else "tdigest_percentiles"
+        if kind == "percentile_ranks":
+            return "hdr_percentile_ranks" if "hdr" in body \
+                else "tdigest_percentile_ranks"
+        if kind == "rare_terms":
+            return "srareterms"
+        if kind in ("max_bucket", "min_bucket", "avg_bucket", "sum_bucket"):
+            return "bucket_metric_value"
+        if kind in ("cumulative_sum", "bucket_script", "moving_fn",
+                    "serial_diff"):
+            return "simple_value"
+        return kind
+
+    def _apply_typed_keys(self, spec: dict, node: dict, mapper) -> None:
+        if not isinstance(spec, dict) or not isinstance(node, dict):
+            return
+        for name, body in spec.items():
+            if not isinstance(body, dict) or name not in node:
+                continue
+            kinds = [k for k in body
+                     if k not in ("aggs", "aggregations", "meta")]
+            if len(kinds) != 1:
+                continue
+            kind = kinds[0]
+            sub_spec = body.get("aggs") or body.get("aggregations")
+            val = node.pop(name)
+            if sub_spec and isinstance(val, dict):
+                buckets = val.get("buckets")
+                if isinstance(buckets, list):
+                    for b in buckets:
+                        self._apply_typed_keys(sub_spec, b, mapper)
+                elif isinstance(buckets, dict):
+                    for b in buckets.values():
+                        self._apply_typed_keys(sub_spec, b, mapper)
+                else:
+                    self._apply_typed_keys(sub_spec, val, mapper)
+            node[f"{self._typed_prefix(kind, body[kind], mapper)}#{name}"] \
+                = val
+
     def h_search(self, params, body, index=None):
         names = self._resolve_search_indices(index, params)
         search_body = _json_body(body)
@@ -2323,13 +2379,20 @@ class RestAPI:
                 search_body.get("indices_boost"):
             search_body = dict(search_body, _lenient_indices_boost=True)
         if "q" in params:
-            search_body["query"] = {"query_string": {
-                "query": params["q"]}} if False else _lucene_qs_to_dsl(
-                params["q"])
+            search_body["query"] = _lucene_qs_to_dsl(params["q"])
         for p in ("size", "from"):
             if p in params:
                 search_body[p] = int(params[p])
         if not names:
+            # the reference still PARSES the request against zero indices —
+            # malformed aggs/queries must error, not silently return empty
+            from ..search.aggregations import parse_aggs
+            from ..search.query_dsl import parse_query
+            if search_body.get("aggs") or search_body.get("aggregations"):
+                parse_aggs(search_body.get("aggs")
+                           or search_body.get("aggregations"))
+            if search_body.get("query") is not None:
+                parse_query(search_body["query"])
             empty = {"took": 0, "timed_out": False,
                      "_shards": {"total": 0, "successful": 0, "skipped": 0,
                                  "failed": 0},
@@ -2346,6 +2409,12 @@ class RestAPI:
             out = self._start_scroll(names, search_body, scroll)
         else:
             out = self._search_indices(names, search_body)
+        if _flag(params, "typed_keys") and out.get("aggregations") \
+                and names:
+            self._apply_typed_keys(
+                search_body.get("aggs") or search_body.get("aggregations")
+                or {}, out["aggregations"],
+                self.indices.indices[names[0]].mapper)
         if params.get("rest_total_hits_as_int") in ("true", ""):
             total = out.get("hits", {}).get("total")
             if isinstance(total, dict):
@@ -3012,10 +3081,7 @@ def _apply_filter_path(payload: dict, filter_path: str) -> dict:
     return out
 
 
-def _as_list(v) -> list:
-    if v is None:
-        return []
-    return v if isinstance(v, list) else [v]
+from ..search.shard_search import _as_list_ as _as_list  # noqa: E402
 
 
 def _segment_file_sizes(shards) -> Dict[str, dict]:

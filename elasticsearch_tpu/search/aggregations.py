@@ -27,6 +27,7 @@ the reference's bucket-ordinal machinery onto plain mask algebra.
 from __future__ import annotations
 
 import math
+import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -34,12 +35,23 @@ import numpy as np
 from ..common.errors import IllegalArgumentError, ParsingError
 from ..index.mapping import (
     BooleanFieldType, DateFieldType, KeywordFieldType, MapperService,
-    NumberFieldType, RuntimeFieldType, format_date_millis,
+    NumberFieldType, RangeFieldType, RuntimeFieldType, format_date_millis,
     parse_date_millis)
 from ..index.segment import Segment
 from ..ops import aggs as ops_aggs
 
 INT_TYPES = {"long", "integer", "short", "byte"}
+
+
+def _mix64(v: int) -> int:
+    """murmur3 fmix64 (BitMixer.mix64) — numeric terms partitioning."""
+    v &= (1 << 64) - 1
+    v ^= v >> 33
+    v = (v * 0xFF51AFD7ED558CCD) & ((1 << 64) - 1)
+    v ^= v >> 33
+    v = (v * 0xC4CEB9FE1A85EC53) & ((1 << 64) - 1)
+    v ^= v >> 33
+    return v
 
 
 def _device_mask(seg, mask: np.ndarray):
@@ -162,6 +174,8 @@ def parse_aggs(spec: dict) -> Dict[str, Aggregator]:
             raise ParsingError(f"unknown aggregation type [{kind}]")
         agg = factory(body[kind])
         agg.name = name
+        agg.kind = kind
+        agg._raw = body[kind] if isinstance(body[kind], dict) else {}
         agg.meta = body.get("meta")
         subs = parse_aggs(sub_spec) if sub_spec else {}
         if subs and not isinstance(agg, BucketAggregator):
@@ -202,6 +216,7 @@ def run_aggregations_multi(
         partials = [agg.collect(ctx, seg, mask)
                     for ctx, seg, mask in ctx_seg_masks]
         result[name] = agg.reduce(partials)
+        _apply_parent_pipes(agg, result[name])
         if getattr(agg, "meta", None) is not None:
             result[name]["meta"] = agg.meta
     for name, p in pipelines.items():
@@ -221,9 +236,12 @@ def _reduce_subs(agg: "BucketAggregator", partial_lists: List[dict]) -> dict:
     pipelines = {}
     for n, a in agg.subs.items():
         if isinstance(a, PipelineAggregator):
-            pipelines[n] = a
+            if not a.parent_pipeline:
+                pipelines[n] = a
             continue
-        out[n] = a.reduce([p[n] for p in partial_lists])
+        out[n] = a.reduce([x for x in (p.get(n) for p in partial_lists)
+                           if x is not None])
+        _apply_parent_pipes(a, out[n])
     for n, p in pipelines.items():
         out[n] = p.apply(out)
     return out
@@ -237,6 +255,10 @@ class PipelineAggregator(Aggregator):
     """Computed from sibling reduced output, no per-doc collection
     (reference: ``search/aggregations/pipeline/``)."""
 
+    #: parent pipelines (derivative, cumulative_sum, moving_fn, …) run
+    #: over their PARENT bucket agg's reduced bucket list, not a sibling
+    parent_pipeline = False
+
     def collect(self, ctx, seg, mask):
         return None
 
@@ -245,6 +267,38 @@ class PipelineAggregator(Aggregator):
 
     def apply(self, sibling_results: dict) -> dict:
         raise NotImplementedError
+
+    def apply_parent(self, name: str, parent_node: dict) -> None:
+        raise NotImplementedError
+
+
+def _bucket_series(blist: List[dict], path: str) -> List[Any]:
+    """Per-bucket metric series for parent pipelines (BucketHelpers with
+    gap policy skip on empty buckets)."""
+    parts = path.replace(">", ".").split(".")
+    out = []
+    for b in blist:
+        if parts[0] == "_count":
+            out.append(b.get("doc_count"))
+            continue
+        v: Any = b
+        for p in parts:
+            v = v.get(p) if isinstance(v, dict) else None
+        if isinstance(v, dict):
+            v = v.get("value")
+        out.append(v)
+    return out
+
+
+def _apply_parent_pipes(agg: "Aggregator", node: dict) -> None:
+    subs = getattr(agg, "subs", None)
+    if not subs or not isinstance(node, dict):
+        return
+    if "buckets" not in node:
+        return
+    for pname, p in subs.items():
+        if isinstance(p, PipelineAggregator) and p.parent_pipeline:
+            p.apply_parent(pname, node)
 
 
 # ---------------------------------------------------------------------------
@@ -260,6 +314,13 @@ class _NumericMetricAgg(Aggregator):
             raise ParsingError("metric aggregation requires [field]")
 
     def _matched_values(self, ctx, seg, mask: np.ndarray) -> np.ndarray:
+        from ..index.mapping import KeywordFieldType, TextFieldType
+        ft = ctx.mapper.field_type(self.field) if ctx.mapper else None
+        if isinstance(ft, (TextFieldType, KeywordFieldType)):
+            raise IllegalArgumentError(
+                f"Field [{self.field}] of type "
+                f"[{getattr(ft, 'type_name', 'text')}] is not supported "
+                f"for aggregation [{getattr(self, 'name', '?')}]")
         pairs = _numeric_pairs(seg, self.field, ctx.mapper)
         vals_list = []
         if pairs is not None:
@@ -360,9 +421,24 @@ class StatsAgg(_NumericMetricAgg):
 class ExtendedStatsAgg(_NumericMetricAgg):
     def __init__(self, body):
         super().__init__(body)
-        self.sigma = float(body.get("sigma", 2.0))
+        try:
+            self.sigma = float(body.get("sigma", 2.0))
+        except (TypeError, ValueError):
+            from ..common.errors import XContentParseError
+            raise XContentParseError(
+                f"[extended_stats] failed to parse field [sigma]: "
+                f"[{body.get('sigma')}] is not a number")
+        if self.sigma < 0:
+            self._sigma_error = True
 
     def collect(self, ctx, seg, mask):
+        if getattr(self, "_sigma_error", False):
+            raise IllegalArgumentError(
+                f"[sigma] must be greater than or equal to 0. "
+                f"Found [{self.sigma}] in [{self.name}]")
+        return self._collect_inner(ctx, seg, mask)
+
+    def _collect_inner(self, ctx, seg, mask):
         v = self._matched_values(ctx, seg, mask)
         return {"count": int(v.size), "sum": float(v.sum()),
                 "sum_sq": float((v * v).sum()),
@@ -404,6 +480,10 @@ class CardinalityAgg(Aggregator):
     PRECISION_DEFAULT = 3000
 
     def __init__(self, body):
+        pt = body.get("precision_threshold")
+        if pt is not None and int(pt) < 0:
+            self._pt_error = int(pt)
+        self.missing = body.get("missing")
         self.field = body.get("field")
         if self.field is None:
             raise ParsingError("cardinality requires [field]")
@@ -411,16 +491,26 @@ class CardinalityAgg(Aggregator):
             body.get("precision_threshold", self.PRECISION_DEFAULT))
 
     def collect(self, ctx, seg, mask):
+        if getattr(self, "_pt_error", None) is not None:
+            raise IllegalArgumentError(
+                f"[precisionThreshold] must be greater than or equal to "
+                f"0. Found [{self._pt_error}] in [{self.name}]")
         kw = _keyword_pairs(seg, self.field, ctx.mapper)
+        num = _numeric_pairs(seg, self.field, ctx.mapper) \
+            if kw is None else None
+        out: set = set()
+        has = np.zeros(mask.shape[0], bool)
         if kw is not None:
             docs, ords, terms = kw
-            sel = np.unique(ords[mask[docs]])
-            return {"values": {terms[o] for o in sel}}
-        num = _numeric_pairs(seg, self.field, ctx.mapper)
-        if num is not None:
+            out = {terms[o] for o in np.unique(ords[mask[docs]])}
+            has[docs] = True
+        elif num is not None:
             docs, vals = num
-            return {"values": set(np.unique(vals[mask[docs]]).tolist())}
-        return {"values": set()}
+            out = set(np.unique(vals[mask[docs]]).tolist())
+            has[docs] = True
+        if self.missing is not None and (mask & ~has).any():
+            out.add(self.missing)
+        return {"values": out}
 
     def reduce(self, partials):
         u: set = set()
@@ -438,7 +528,14 @@ class PercentilesAgg(_NumericMetricAgg):
 
     def __init__(self, body):
         super().__init__(body)
-        self.percents = tuple(body.get("percents", self.DEFAULT_PERCENTS))
+        percents = body.get("percents", self.DEFAULT_PERCENTS)
+        if not isinstance(percents, (list, tuple)) or not percents or \
+                any(not isinstance(x, (int, float)) or x < 0 or x > 100
+                    for x in percents):
+            raise IllegalArgumentError(
+                f"[percents] must not be empty and all values must be "
+                f"between 0 and 100, got {percents}")
+        self.percents = tuple(percents)
         self.keyed = bool(body.get("keyed", True))
         td = body.get("tdigest") or {}
         compression = td.get("compression")
@@ -446,9 +543,32 @@ class PercentilesAgg(_NumericMetricAgg):
             raise IllegalArgumentError(
                 f"[compression] must be greater than or equal to 0. "
                 f"Found [{float(compression)}]")
+        hdr = body.get("hdr")
+        self.hdr = hdr is not None
+        if hdr:
+            digits = hdr.get("number_of_significant_value_digits", 3)
+            if not (0 <= int(digits) <= 5):
+                raise IllegalArgumentError(
+                    "[numberOfSignificantValueDigits] must be between 0 "
+                    "and 5")
 
     def collect(self, ctx, seg, mask):
         return {"values": self._matched_values(ctx, seg, mask)}
+
+    def _quantiles(self, allv: np.ndarray):
+        if self.hdr:
+            # HDR semantics: the recorded value at ceil(q·n) rank —
+            # lowest-discernible, no interpolation
+            v = np.sort(allv)
+            idx = np.maximum(
+                np.ceil(np.asarray(self.percents) / 100.0 * v.size)
+                .astype(int) - 1, 0)
+            return v[np.minimum(idx, v.size - 1)]
+        # Hazen interpolation (q·n − ½): what the reference's TDigest
+        # converges to on exactly-held data — its tiny-shard unit
+        # expectations (values.1\.0 == min, midpoints between points)
+        # only hold under this rule, not numpy's default linear one
+        return np.percentile(allv, self.percents, method="hazen")
 
     def reduce(self, partials):
         allv = np.concatenate([p["values"] for p in partials]) \
@@ -456,11 +576,7 @@ class PercentilesAgg(_NumericMetricAgg):
         if allv.size == 0:
             vals = {f"{p}": None for p in self.percents}
         else:
-            # Hazen interpolation (q·n − ½): what the reference's TDigest
-            # converges to on exactly-held data — its tiny-shard unit
-            # expectations (values.1\.0 == min, midpoints between points)
-            # only hold under this rule, not numpy's default linear one
-            qs = np.percentile(allv, self.percents, method="hazen")
+            qs = self._quantiles(allv)
             vals = {f"{p}": float(q) for p, q in zip(self.percents, qs)}
         if self.keyed:
             return {"values": vals}
@@ -528,7 +644,17 @@ class WeightedAvgAgg(Aggregator):
 
 
 class MedianAbsoluteDeviationAgg(_NumericMetricAgg):
+    def __init__(self, body):
+        super().__init__(body)
+        comp = body.get("compression")
+        if comp is not None and float(comp) <= 0:
+            self._comp_error = float(comp)
+
     def collect(self, ctx, seg, mask):
+        if getattr(self, "_comp_error", None) is not None:
+            raise IllegalArgumentError(
+                f"[compression] must be greater than 0. "
+                f"Found [{self._comp_error}] in [{self.name}]")
         return {"values": self._matched_values(ctx, seg, mask)}
 
     def reduce(self, partials):
@@ -596,13 +722,90 @@ class TermsAgg(BucketAggregator):
     def __init__(self, body):
         self.field = body.get("field")
         if self.field is None:
-            raise ParsingError("terms requires [field]")
+            raise ParsingError(
+                "Required one of fields [field, script], but none were "
+                "specified. ")
         self.size = int(body.get("size", 10))
         self.shard_size = int(body.get("shard_size",
                                        self.size * 3 // 2 + 10))
         self.min_doc_count = int(body.get("min_doc_count", 1))
         self.order = body.get("order", {"_count": "desc"})
         self.missing = body.get("missing")
+        self.value_type = body.get("value_type")
+        self.include = body.get("include")
+        self.exclude = body.get("exclude")
+
+    #: IncludeExclude.HASH_PARTITIONING_SEED
+    _PARTITION_SEED = 31
+
+    def _check_regex_support(self, mapper) -> None:
+        ft = _field_type(mapper, self.field) if mapper else None
+        tn = getattr(ft, "type_name", None)
+        for v in (self.include, self.exclude):
+            if isinstance(v, str) and tn not in ("keyword", "text", None):
+                raise IllegalArgumentError(
+                    f"Aggregation [{self.name}] cannot support regular "
+                    f"expression style include/exclude settings as they "
+                    f"can only be applied to string fields. Use an array "
+                    f"of values for include/exclude clauses")
+
+    def _coerce_key(self, mapper, v):
+        """An include/exclude/missing value in request space → key space
+        (dates parse to epoch millis, booleans to 1/0)."""
+        ft = _field_type(mapper, self.field) if mapper else None
+        try:
+            if isinstance(ft, DateFieldType) or self.value_type == "date":
+                return float(parse_date_millis(v))
+            if isinstance(ft, BooleanFieldType) or                     self.value_type == "boolean":
+                if isinstance(v, bool):
+                    return 1.0 if v else 0.0
+                return 1.0 if str(v) == "true" else 0.0
+            if isinstance(ft, NumberFieldType) or self.value_type in (
+                    "long", "double"):
+                return float(v)
+        except Exception:   # noqa: BLE001 — keep raw on parse failure
+            pass
+        return v
+
+    def _key_included(self, key) -> bool:
+        mapper = getattr(self, "_mapper", None)
+        inc, exc = self.include, self.exclude
+        if isinstance(inc, dict):            # partition form
+            from ..utils.murmur3 import murmur3_32
+            n = int(inc.get("num_partitions", 1))
+            p = int(inc.get("partition", 0))
+            if isinstance(key, (int, float)) and not isinstance(key, bool):
+                # LongFilter: floorMod of the SIGNED mixed hash
+                h = _mix64(int(key))
+                if h >= 1 << 63:
+                    h -= 1 << 64
+                if h % n != p:               # python % IS floorMod
+                    return False
+            else:
+                h = murmur3_32(str(key).encode(), self._PARTITION_SEED)
+                if h >= 1 << 31:
+                    h -= 1 << 32
+                if h % n != p:
+                    return False
+        elif isinstance(inc, list):
+            if getattr(self, "_inc_coerced", None) is None:
+                self._inc_coerced = {self._coerce_key(mapper, v)
+                                     for v in inc}
+            if key not in self._inc_coerced:
+                return False
+        elif isinstance(inc, str):
+            if re.fullmatch(inc, str(key)) is None:
+                return False
+        if isinstance(exc, list):
+            if getattr(self, "_exc_coerced", None) is None:
+                self._exc_coerced = {self._coerce_key(mapper, v)
+                                     for v in exc}
+            if key in self._exc_coerced:
+                return False
+        elif isinstance(exc, str):
+            if re.fullmatch(exc, str(key)) is not None:
+                return False
+        return True
 
     def collect(self, ctx, seg, mask):
         """Per-segment partial: ``(buckets, trunc_err)``. Without sub-aggs,
@@ -615,7 +818,16 @@ class TermsAgg(BucketAggregator):
         buckets: Dict[Any, Tuple[int, dict]] = {}
         trunc_err = 0
         self._mapper = ctx.mapper        # for key_as_string at reduce
+        self._check_regex_support(ctx.mapper)
+        if ctx.mapper is not None and getattr(self, "_raw", {}).get(
+                "execution_hint") != "map":
+            # global-ordinals execution loads fielddata (stats accounting)
+            getattr(ctx.mapper, "fielddata_loaded", set()).add(
+                _concrete(ctx.mapper, self.field))
         kw = _keyword_pairs(seg, self.field, ctx.mapper)
+        if kw is not None and self.min_doc_count == 0:
+            for t in kw[2]:
+                buckets.setdefault(t, (0, {}))
         if kw is not None:
             docs, ords, terms = kw
             if docs.shape[0] >= ops_aggs.DEVICE_MIN_PAIRS:
@@ -631,6 +843,12 @@ class TermsAgg(BucketAggregator):
                 pm = mask[docs]
                 sel_ords, counts = np.unique(ords[pm], return_counts=True)
             if self.subs:
+                if self.include is not None or self.exclude is not None:
+                    # filter BEFORE the shard_size cap (the reference's
+                    # IncludeExclude runs during collection)
+                    keep = np.asarray([self._key_included(terms[int(o)])
+                                       for o in sel_ords], bool)
+                    sel_ords, counts = sel_ords[keep], counts[keep]
                 order = np.argsort(-counts, kind="stable")
                 if order.size > self.shard_size:
                     trunc_err = int(counts[order[self.shard_size - 1]])
@@ -653,6 +871,13 @@ class TermsAgg(BucketAggregator):
                 pm = mask[docs]
                 sel_vals, counts = np.unique(vals[pm], return_counts=True)
                 if self.subs:
+                    if self.include is not None or \
+                            self.exclude is not None:
+                        keep = np.asarray(
+                            [self._key_included(
+                                int(v) if float(v).is_integer() else v)
+                             for v in sel_vals], bool)
+                        sel_vals, counts = sel_vals[keep], counts[keep]
                     order = np.argsort(-counts, kind="stable")
                     if order.size > self.shard_size:
                         trunc_err = int(counts[order[self.shard_size - 1]])
@@ -674,16 +899,19 @@ class TermsAgg(BucketAggregator):
                 has[_numeric_pairs(seg, self.field)[0]] = True
             miss_mask = mask & ~has
             if miss_mask.any():
-                buckets[self.missing] = _bucket_payload(
+                missing_key = self._coerce_key(ctx.mapper, self.missing)
+                buckets[missing_key] = _bucket_payload(
                     self, ctx, seg, miss_mask) if self.subs else \
                     (int(miss_mask.sum()), {})
         return buckets, trunc_err
 
     def _bucket_key_as_string(self, mapper, key):
         ft = _field_type(mapper, self.field) if mapper else None
-        if isinstance(ft, BooleanFieldType):
+        if isinstance(ft, BooleanFieldType) or \
+                getattr(self, "value_type", None) == "boolean":
             return "true" if key else "false"
-        if isinstance(ft, DateFieldType):
+        if isinstance(ft, DateFieldType) or \
+                getattr(self, "value_type", None) == "date":
             return format_date_millis(float(key))
         return None
 
@@ -705,6 +933,8 @@ class TermsAgg(BucketAggregator):
         for key, items in merged.items():
             count = sum(c for c, _ in items)
             if count < self.min_doc_count:
+                continue
+            if not self._key_included(key):
                 continue
             subs = _reduce_subs(self, [s for _, s in items]) \
                 if self.subs else {}
@@ -767,19 +997,58 @@ class HistogramAgg(BucketAggregator):
             raise ParsingError("[interval] must be > 0")
         self.offset = float(body.get("offset", 0.0))
         self.min_doc_count = int(body.get("min_doc_count", 0))
+        self.format = body.get("format")
         bounds = body.get("extended_bounds")
         self.extended_bounds = ((float(bounds["min"]), float(bounds["max"]))
                                 if bounds else None)
+        hb = body.get("hard_bounds")
+        self.hard_bounds = ((float(hb["min"]), float(hb["max"]))
+                            if hb else None)
 
     def _bucket_ids(self, vals):
         return np.floor((vals - self.offset) / self.interval)
 
+    def _range_field_collect(self, ctx, seg, mask):
+        """Histogram over a RANGE field: every doc interval contributes
+        one count to each bucket it overlaps (RangeHistogramAggregator)."""
+        g = seg.numeric_fields.get(f"{self.field}._gte")
+        l = seg.numeric_fields.get(f"{self.field}._lte")
+        if g is None or l is None:
+            return {}
+        out: Dict[float, list] = {}
+        lo_clip = self.hard_bounds[0] if self.hard_bounds else None
+        hi_clip = self.hard_bounds[1] if self.hard_bounds else None
+        pm = mask[g.docs_host]
+        for lo_v, hi_v, doc in zip(g.vals_host[pm], l.vals_host[pm],
+                                   g.docs_host[pm]):
+            if lo_clip is not None:
+                lo_v = max(lo_v, lo_clip)
+            if hi_clip is not None:
+                hi_v = min(hi_v, hi_clip)
+            if hi_v < lo_v:
+                continue
+            b0 = int(math.floor((lo_v - self.offset) / self.interval))
+            b1 = int(math.floor((hi_v - self.offset) / self.interval))
+            for bid in range(b0, b1 + 1):
+                key = bid * self.interval + self.offset
+                cur = out.setdefault(float(key), [0, {}])
+                cur[0] += 1
+        return {k: (c, s_) for k, (c, s_) in out.items()}
+
     def collect(self, ctx, seg, mask):
+        ft = ctx.mapper.field_type(self.field) if ctx.mapper else None
+        if isinstance(ft, RangeFieldType):
+            return self._range_field_collect(ctx, seg, mask)
         num = _numeric_pairs(seg, self.field, ctx.mapper)
         if num is None:
             return {}
         docs, vals = num
-        if (docs.shape[0] >= ops_aggs.DEVICE_MIN_PAIRS and not self.subs):
+        if self.hard_bounds:
+            sel = (vals >= self.hard_bounds[0]) & \
+                  (vals <= self.hard_bounds[1])
+            docs, vals = docs[sel], vals[sel]
+        if (docs.shape[0] >= ops_aggs.DEVICE_MIN_PAIRS and
+                not self.subs and not self.hard_bounds):
             # device hot path: cached exact bucket ids + one-hot counts
             ids_dev, pdocs_dev, n_buckets, base = \
                 ops_aggs.histogram_bucket_ids(seg, self.field, self.interval,
@@ -837,7 +1106,11 @@ class HistogramAgg(BucketAggregator):
                 continue
             subs = _reduce_subs(self, [s for _, s in items]) \
                 if self.subs else {}
-            b = {"key": key, "doc_count": count}
+            k_out = int(key) if float(key).is_integer() else key
+            b = {"key": k_out, "doc_count": count}
+            if self.format:
+                from .fetch import decimal_format
+                b["key_as_string"] = decimal_format(float(key), self.format)
             b.update(subs)
             buckets.append(b)
         return {"buckets": buckets}
@@ -889,6 +1162,28 @@ def _calendar_floor(millis: np.ndarray, unit: str) -> np.ndarray:
     return out.astype("datetime64[ms]").astype("int64").astype(np.float64)
 
 
+def _parse_offset_ms(s) -> float:
+    if isinstance(s, (int, float)):
+        return float(s)
+    s = str(s)
+    sign = -1.0 if s.startswith("-") else 1.0
+    from ..common.settings import parse_time_millis
+    return sign * parse_time_millis(s.lstrip("+-"))
+
+
+def _tz_offset_ms(tz: str, at_ms: float) -> float:
+    """UTC offset (ms) of a zone at an instant; fixed "+HH:MM" or IANA."""
+    import datetime
+    m = re.match(r"^([+-])(\d{2}):?(\d{2})$", tz)
+    if m:
+        sign = 1 if m.group(1) == "+" else -1
+        return sign * (int(m.group(2)) * 3600 + int(m.group(3)) * 60) * 1000
+    import zoneinfo
+    z = zoneinfo.ZoneInfo(tz)
+    dt = datetime.datetime.fromtimestamp(at_ms / 1000.0, tz=z)
+    return dt.utcoffset().total_seconds() * 1000
+
+
 class DateHistogramAgg(BucketAggregator):
     def __init__(self, body):
         self.field = body.get("field")
@@ -897,6 +1192,14 @@ class DateHistogramAgg(BucketAggregator):
         cal = body.get("calendar_interval")
         fixed = body.get("fixed_interval") or body.get("interval")
         self.min_doc_count = int(body.get("min_doc_count", 0))
+        self.offset_ms = _parse_offset_ms(body.get("offset", 0))
+        self.format = body.get("format")
+        self.time_zone = body.get("time_zone")
+        self.keyed = bool(body.get("keyed", False))
+        hb = body.get("hard_bounds")
+        self.hard_bounds = ((parse_date_millis(hb["min"]),
+                             parse_date_millis(hb["max"]))
+                            if hb else None)
         if cal:
             unit = _CALENDAR_INTERVALS.get(cal)
             if unit is None:
@@ -912,15 +1215,72 @@ class DateHistogramAgg(BucketAggregator):
                 "date_histogram requires calendar_interval or fixed_interval")
 
     def _keys_for(self, vals: np.ndarray) -> np.ndarray:
+        shift = self.offset_ms
+        if self.time_zone and vals.size:
+            shift -= _tz_offset_ms(self.time_zone, float(vals[0]))
+        v = vals - shift
         if self.calendar_unit is not None:
-            return _calendar_floor(vals, self.calendar_unit)
-        return np.floor(vals / self.fixed_ms) * self.fixed_ms
+            return _calendar_floor(v, self.calendar_unit) + shift
+        return np.floor(v / self.fixed_ms) * self.fixed_ms + shift
+
+    def _next_key(self, key: float) -> float:
+        """Start of the bucket after ``key`` (for empty-bucket filling).
+        Variable-length calendar units advance by overshooting past the
+        next boundary and re-flooring — immune to day-of-month overflow."""
+        if self.calendar_unit is None:
+            return key + self.fixed_ms
+        u = self.calendar_unit
+        fixed = {"s": 1000, "m": 60000, "h": 3600000,
+                 "d": 86400000, "w": 7 * 86400000}.get(u)
+        if fixed is not None:
+            return key + fixed
+        overshoot = {"M": 32, "q": 93, "y": 367}[u] * 86400000.0
+        return float(self._keys_for(np.asarray([key + overshoot]))[0])
+
+    def _key_as_string(self, key: float) -> str:
+        from .fetch import java_date_format
+        if self.format:
+            return java_date_format(key, self.format)
+        if self.time_zone:
+            off = _tz_offset_ms(self.time_zone, key)
+            local = key + off
+            base = format_date_millis(local)[:-1]       # strip Z
+            sign = "+" if off >= 0 else "-"
+            off = abs(int(off)) // 60000
+            return f"{base}{sign}{off // 60:02d}:{off % 60:02d}"
+        return format_date_millis(key)
 
     def collect(self, ctx, seg, mask):
+        ft = ctx.mapper.field_type(self.field) if ctx.mapper else None
+        if isinstance(ft, RangeFieldType):
+            g = seg.numeric_fields.get(f"{self.field}._gte")
+            l = seg.numeric_fields.get(f"{self.field}._lte")
+            if g is None or l is None:
+                return {}
+            out: Dict[float, tuple] = {}
+            pm = mask[g.docs_host]
+            for lo_v, hi_v in zip(g.vals_host[pm], l.vals_host[pm]):
+                if self.hard_bounds:
+                    lo_v = max(lo_v, self.hard_bounds[0])
+                    hi_v = min(hi_v, self.hard_bounds[1])
+                if hi_v < lo_v:
+                    continue
+                k = float(self._keys_for(np.asarray([lo_v]))[0])
+                guard = 0
+                while k <= hi_v and guard < 100000:
+                    c, s_ = out.get(k, (0, {}))
+                    out[k] = (c + 1, s_)
+                    k = self._next_key(k)
+                    guard += 1
+            return out
         num = _numeric_pairs(seg, self.field, ctx.mapper)
         if num is None:
             return {}
         docs, vals = num
+        if self.hard_bounds:
+            sel = (vals >= self.hard_bounds[0]) & \
+                  (vals <= self.hard_bounds[1])
+            docs, vals = docs[sel], vals[sel]
         pm = mask[docs]
         keys = self._keys_for(vals[pm])
         out = {}
@@ -939,21 +1299,42 @@ class DateHistogramAgg(BucketAggregator):
         for p in partials:
             for key, item in p.items():
                 merged.setdefault(key, []).append(item)
+        keys = sorted(merged)
+        if keys and self.min_doc_count == 0:
+            # fill the gaps: contiguous buckets from min to max key
+            filled = []
+            k = keys[0]
+            while k <= keys[-1] + 0.5:
+                filled.append(k)
+                nk = self._next_key(k)
+                if nk <= k:            # safety against zero progress
+                    break
+                k = nk
+            keys = [k for k in filled if k <= keys[-1] + 0.5]
         buckets = []
-        for key in sorted(merged):
-            items = merged[key]
+        for key in keys:
+            items = merged.get(key, [])
             count = sum(c for c, _ in items)
-            if count < max(self.min_doc_count, 1) and count == 0:
-                continue
             if count < self.min_doc_count:
                 continue
             subs = _reduce_subs(self, [s for _, s in items]) \
                 if self.subs else {}
-            b = {"key": key, "key_as_string": format_date_millis(key),
+            b = {"key": int(key) if float(key).is_integer() else key,
+                 "key_as_string": self._key_as_string(key),
                  "doc_count": count}
             b.update(subs)
             buckets.append(b)
+        if self.keyed:
+            return {"buckets": {b["key_as_string"]:
+                                {k: v for k, v in b.items()}
+                                for b in buckets}}
         return {"buckets": buckets}
+
+
+def _dt_from_ms_agg(ms: float):
+    import datetime
+    return datetime.datetime.fromtimestamp(ms / 1000.0,
+                                           tz=datetime.timezone.utc)
 
 
 class RangeAgg(BucketAggregator):
@@ -1060,8 +1441,8 @@ class FiltersAgg(BucketAggregator):
     def __init__(self, body):
         from .query_dsl import parse_query
         filters = body.get("filters")
-        if filters is None:
-            raise ParsingError("filters requires [filters]")
+        if not filters:
+            raise IllegalArgumentError("[filters] cannot be empty")
         if isinstance(filters, dict):
             self.keyed = True
             self.filters = {k: parse_query(v) for k, v in filters.items()}
@@ -1100,8 +1481,15 @@ class MissingAgg(BucketAggregator):
         self.field = body.get("field")
         if self.field is None:
             raise ParsingError("missing requires [field]")
+        self.missing = body.get("missing")
 
     def collect(self, ctx, seg, mask):
+        if self.missing is not None:
+            # a missing-value substitute means no doc is ever "missing"
+            mm0 = np.zeros(mask.shape[0], bool)
+            if self.subs:
+                return _bucket_payload(self, ctx, seg, mm0)
+            return (0, {})
         has = np.zeros(mask.shape[0], bool)
         kw = _keyword_pairs(seg, self.field, ctx.mapper)
         if kw is not None:
@@ -1231,6 +1619,8 @@ class StatsBucketAgg(_SiblingPipelineAgg):
 
 
 class CumulativeSumAgg(_SiblingPipelineAgg):
+    parent_pipeline = True
+
     def apply(self, sibling_results):
         buckets, series = _resolve_buckets_path(
             sibling_results, self.buckets_path)
@@ -1241,8 +1631,18 @@ class CumulativeSumAgg(_SiblingPipelineAgg):
             b["cumulative_sum"] = {"value": total}
         return {"_applied_to": self.buckets_path.split(">")[0].split(".")[0]}
 
+    def apply_parent(self, name, parent_node):
+        blist = parent_node.get("buckets")
+        blist = list(blist.values()) if isinstance(blist, dict) else blist
+        total = 0.0
+        for b, v in zip(blist, _bucket_series(blist, self.buckets_path)):
+            total += v or 0.0
+            b[name] = {"value": total}
+
 
 class DerivativeAgg(_SiblingPipelineAgg):
+    parent_pipeline = True
+
     def apply(self, sibling_results):
         buckets, series = _resolve_buckets_path(
             sibling_results, self.buckets_path)
@@ -1252,6 +1652,174 @@ class DerivativeAgg(_SiblingPipelineAgg):
                 b["derivative"] = {"value": v - prev}
             prev = v if v is not None else prev
         return {"_applied_to": self.buckets_path.split(">")[0].split(".")[0]}
+
+    def apply_parent(self, name, parent_node):
+        blist = parent_node.get("buckets")
+        blist = list(blist.values()) if isinstance(blist, dict) else blist
+        series = _bucket_series(blist, self.buckets_path)
+        prev = None
+        for b, v in zip(blist, series):
+            if prev is not None and v is not None:
+                b[name] = {"value": v - prev}
+            prev = v if v is not None else prev
+
+
+class MovingFnAgg(PipelineAggregator):
+    """moving_fn (reference: ``pipeline/MovFnPipelineAggregator``): a
+    sliding window over the parent's bucket metric series, evaluated by
+    a MovingFunctions.<fn>(values) script subset."""
+
+    parent_pipeline = True
+
+    _FNS = {
+        "max": lambda v: max(v) if v else None,
+        "min": lambda v: min(v) if v else None,
+        "sum": lambda v: sum(v) if v else 0.0,
+        "unweightedAvg": lambda v: (sum(v) / len(v)) if v else None,
+        "stdDev": None,      # handled specially (needs avg argument)
+        "linearWeightedAvg": lambda v: (
+            sum((i + 1) * x for i, x in enumerate(v)) /
+            sum(range(1, len(v) + 1))) if v else None,
+    }
+
+    def __init__(self, body):
+        self.buckets_path = body.get("buckets_path")
+        self.window = body.get("window")
+        self.shift = int(body.get("shift", 0))
+        script = body.get("script")
+        if isinstance(script, dict):
+            script = script.get("source")
+        self.script = script or ""
+        if self.buckets_path is None or self.window is None:
+            raise ParsingError("moving_fn requires [buckets_path] and "
+                               "[window]")
+        if int(self.window) <= 0:
+            raise IllegalArgumentError(
+                "[window] must be a positive, non-zero integer.")
+        self.window = int(self.window)
+        m = re.search(r"MovingFunctions\.(\w+)\s*\(", self.script)
+        self.fn = m.group(1) if m else None
+
+    def apply_parent(self, name, parent_node):
+        blist = parent_node.get("buckets")
+        blist = list(blist.values()) if isinstance(blist, dict) else blist
+        series = _bucket_series(blist, self.buckets_path)
+        for i, b in enumerate(blist):
+            # window covers [i - window + shift, i + shift)
+            lo = max(0, i - self.window + self.shift)
+            hi = max(0, i + self.shift)
+            vals = [v for v in series[lo:hi] if v is not None]
+            if self.fn == "stdDev":
+                if vals:
+                    avg = sum(vals) / len(vals)
+                    out = (sum((x - avg) ** 2 for x in vals)
+                           / len(vals)) ** 0.5
+                else:
+                    out = None
+            else:
+                fn = self._FNS.get(self.fn)
+                out = fn(vals) if fn else None
+            if out is not None:
+                b[name] = {"value": out}
+
+    def apply(self, sibling_results):
+        raise IllegalArgumentError(
+            "moving_fn must be used inside a histogram parent")
+
+
+class SerialDiffAgg(PipelineAggregator):
+    parent_pipeline = True
+
+    def __init__(self, body):
+        self.buckets_path = body.get("buckets_path")
+        if self.buckets_path is None:
+            raise ParsingError("serial_diff requires [buckets_path]")
+        self.lag = int(body.get("lag", 1))
+        if self.lag <= 0:
+            raise IllegalArgumentError(
+                "lag must be a positive, non-zero integer")
+
+    def apply_parent(self, name, parent_node):
+        blist = parent_node.get("buckets")
+        blist = list(blist.values()) if isinstance(blist, dict) else blist
+        series = _bucket_series(blist, self.buckets_path)
+        for i, b in enumerate(blist):
+            if i >= self.lag and series[i] is not None and \
+                    series[i - self.lag] is not None:
+                b[name] = {"value": series[i] - series[i - self.lag]}
+
+
+class BucketSelectorAgg(PipelineAggregator):
+    parent_pipeline = True
+
+    def __init__(self, body):
+        self.buckets_paths = body.get("buckets_path")
+        script = body.get("script")
+        if isinstance(script, dict):
+            script = script.get("source")
+        self.script = script
+        if not isinstance(self.buckets_paths, dict) or not self.script:
+            raise ParsingError(
+                "bucket_selector requires [buckets_path] map and [script]")
+
+    def apply_parent(self, name, parent_node):
+        from ..utils.expressions import evaluate_expression
+        blist = parent_node.get("buckets")
+        keyed = isinstance(blist, dict)
+        items = list(blist.items()) if keyed else list(enumerate(blist))
+        series = {var: _bucket_series(
+            [b for _, b in items], path)
+            for var, path in self.buckets_paths.items()}
+        kept = []
+        for i, (k, b) in enumerate(items):
+            params = {v: series[v][i] for v in series}
+            if any(p is None for p in params.values()):
+                continue
+            if evaluate_expression(self.script, params):
+                kept.append((k, b))
+        if keyed:
+            parent_node["buckets"] = {k: b for k, b in kept}
+        else:
+            parent_node["buckets"] = [b for _, b in kept]
+
+    def apply(self, sibling_results):
+        raise IllegalArgumentError(
+            "bucket_selector must be used inside a multi-bucket parent")
+
+
+class BucketSortAgg(PipelineAggregator):
+    parent_pipeline = True
+
+    def __init__(self, body):
+        self.sort = body.get("sort") or []
+        self.from_ = int(body.get("from", 0))
+        self.size = body.get("size")
+        self.gap_policy = body.get("gap_policy", "skip")
+
+    def apply_parent(self, name, parent_node):
+        blist = parent_node.get("buckets")
+        if isinstance(blist, dict):
+            return                          # keyed responses keep order
+        out = list(blist)
+        for clause in reversed(self.sort if isinstance(self.sort, list)
+                               else [self.sort]):
+            if isinstance(clause, str):
+                path, order = clause, "asc"
+            else:
+                (path, spec), = clause.items()
+                order = spec.get("order", "asc") \
+                    if isinstance(spec, dict) else spec
+            series = dict(zip(map(id, out), _bucket_series(out, path)))
+            out.sort(key=lambda b: (series[id(b)] is None,
+                                    series[id(b)] or 0),
+                     reverse=(order == "desc"))
+        end = None if self.size is None else self.from_ + int(self.size)
+        parent_node["buckets"] = out[self.from_: end]
+
+    def apply(self, sibling_results):
+        raise IllegalArgumentError(
+            f"bucket_sort aggregation [{self.name}] must be declared "
+            f"inside of another aggregation")
 
 
 class BucketScriptAgg(PipelineAggregator):
@@ -1285,6 +1853,20 @@ class BucketScriptAgg(PipelineAggregator):
             b[self.name] = {"value": evaluate_expression(self.script, params)}
         return {"_applied_to": next(iter(self.buckets_paths.values()))
                 .split(">")[0].split(".")[0]}
+
+    parent_pipeline = True
+
+    def apply_parent(self, name, parent_node):
+        from ..utils.expressions import evaluate_expression
+        blist = parent_node.get("buckets")
+        blist = list(blist.values()) if isinstance(blist, dict) else blist
+        series = {var: _bucket_series(blist, path)
+                  for var, path in self.buckets_paths.items()}
+        for i, b in enumerate(blist):
+            params = {v: series[v][i] for v in series}
+            if any(p is None for p in params.values()):
+                continue
+            b[name] = {"value": evaluate_expression(self.script, params)}
 
 
 # ---------------------------------------------------------------------------
@@ -1321,6 +1903,10 @@ _AGG_PARSERS = {
     "cumulative_sum": CumulativeSumAgg,
     "derivative": DerivativeAgg,
     "bucket_script": BucketScriptAgg,
+    "bucket_selector": BucketSelectorAgg,
+    "bucket_sort": BucketSortAgg,
+    "moving_fn": MovingFnAgg,
+    "serial_diff": SerialDiffAgg,
 }
 
 # composite / significant_terms / rare_terms / sampler / nested /

@@ -239,7 +239,22 @@ def _chi_square(fg, fg_total, bg, bg_total) -> float:
 
 
 class SignificantTermsAgg(BucketAggregator):
+    KNOWN_PARAMS = {"field", "size", "shard_size", "min_doc_count",
+                    "shard_min_doc_count", "background_filter", "jlh",
+                    "chi_square", "gnd", "mutual_information",
+                    "percentage", "script_heuristic", "include", "exclude",
+                    "execution_hint", "filter_duplicate_text",
+                    "source_fields"}
+
     def __init__(self, body: dict):
+        import difflib
+        for k in body:
+            if k not in self.KNOWN_PARAMS:
+                hint = difflib.get_close_matches(
+                    k, sorted(self.KNOWN_PARAMS), n=1)
+                suffix = f" did you mean [{hint[0]}]?" if hint else ""
+                raise IllegalArgumentError(
+                    f"[significant_terms] unknown field [{k}]{suffix}")
         self.field = body.get("field")
         if self.field is None:
             raise ParsingError("significant_terms requires [field]")
@@ -313,16 +328,38 @@ class RareTermsAgg(BucketAggregator):
         if not 1 <= self.max_doc_count <= 100:
             raise IllegalArgumentError(
                 "[max_doc_count] must be in [1, 100]")
+        self.include = body.get("include")
+        self.exclude = body.get("exclude")
+
+    def _included(self, key) -> bool:
+        import re as _re
+        inc, exc = self.include, self.exclude
+        if isinstance(inc, list) and key not in set(inc):
+            return False
+        if isinstance(inc, str) and _re.fullmatch(inc, str(key)) is None:
+            return False
+        if isinstance(exc, list) and key in set(exc):
+            return False
+        if isinstance(exc, str) and \
+                _re.fullmatch(exc, str(key)) is not None:
+            return False
+        return True
 
     def collect(self, ctx, seg, mask):
+        self._mapper = ctx.mapper
+        buckets: Dict[Any, tuple] = {}
         kw = _keyword_pairs(seg, self.field)
-        buckets: Dict[Any, int] = {}
         if kw is not None:
             docs, ords, terms = kw
             pm = mask[docs]
             sel, counts = np.unique(ords[pm], return_counts=True)
             for o, c in zip(sel.tolist(), counts.tolist()):
-                buckets[terms[o]] = c
+                sub = {}
+                if self.subs:
+                    bm = np.zeros(mask.shape[0], bool)
+                    bm[docs[pm][ords[pm] == o]] = True
+                    sub = _bucket_payload(self, ctx, seg, bm)[1]
+                buckets[terms[o]] = (c, sub)
         else:
             num = _numeric_pairs(seg, self.field, ctx.mapper)
             if num is not None:
@@ -330,18 +367,42 @@ class RareTermsAgg(BucketAggregator):
                 pm = mask[docs]
                 sel, counts = np.unique(vals[pm], return_counts=True)
                 for v, c in zip(sel.tolist(), counts.tolist()):
-                    buckets[v] = c
+                    sub = {}
+                    if self.subs:
+                        bm = np.zeros(mask.shape[0], bool)
+                        bm[docs[pm][vals[pm] == v]] = True
+                        sub = _bucket_payload(self, ctx, seg, bm)[1]
+                    buckets[v] = (c, sub)
         return buckets
 
     def reduce(self, partials):
-        merged: Dict[Any, int] = {}
+        from .aggregations import (_reduce_subs, _format_key,
+                                   _field_type)
+        from ..index.mapping import BooleanFieldType, DateFieldType
+        merged: Dict[Any, list] = {}
         for p in partials:
-            for term, c in p.items():
-                merged[term] = merged.get(term, 0) + c
-        rows = [(t, c) for t, c in merged.items()
-                if c <= self.max_doc_count]
+            for term, item in p.items():
+                cur = merged.setdefault(term, [0, []])
+                cur[0] += item[0]
+                cur[1].append(item[1])
+        rows = [(t, c, subs) for t, (c, subs) in merged.items()
+                if c <= self.max_doc_count and self._included(t)]
         rows.sort(key=lambda r: (r[1], str(r[0])))
-        return {"buckets": [{"key": t, "doc_count": c} for t, c in rows]}
+        mapper = getattr(self, "_mapper", None)
+        ft = _field_type(mapper, self.field) if mapper else None
+        out = []
+        for t, c, subs in rows:
+            key = int(t) if isinstance(t, float) and t.is_integer() else t
+            b = {"key": key, "doc_count": c}
+            if isinstance(ft, BooleanFieldType):
+                b["key_as_string"] = "true" if key else "false"
+            elif isinstance(ft, DateFieldType):
+                from ..index.mapping import format_date_millis
+                b["key_as_string"] = format_date_millis(float(t))
+            if self.subs:
+                b.update(_reduce_subs(self, subs))
+            out.append(b)
+        return {"buckets": out}
 
 
 # ---------------------------------------------------------------------------

@@ -388,6 +388,8 @@ class AutoDateHistogramAgg(BucketAggregator):
             if partials else np.empty(0)
         if all_vals.size == 0:
             return {"buckets": [], "interval": "1s"}
+        self._debug = {"surviving_buckets": int(
+            np.unique(all_vals // 86_400_000).size)}
         vmin, vmax = float(all_vals.min()), float(all_vals.max())
         chosen = None
         for suffix, to_idx, from_idx, inners in _ROUNDINGS:

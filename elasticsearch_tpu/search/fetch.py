@@ -244,15 +244,25 @@ def java_date_format(millis: float, pattern: str) -> str:
         out = out.replace("SSS", f"{dt.microsecond // 1000:03d}")
     for java, strf in _JAVA_STRFTIME:
         out = out.replace(java, dt.strftime(strf))
+    if "e" in out:                       # ISO day-of-week number
+        out = out.replace("e", str(dt.isoweekday()))
     return out
 
 
 def decimal_format(value: float, pattern: str) -> str:
-    """Minimal java DecimalFormat: '#.0' style patterns → fixed decimals."""
-    if "." in pattern:
-        decimals = len(pattern.split(".", 1)[1])
-        return f"{value:.{decimals}f}"
-    return str(int(round(value)))
+    """Minimal java DecimalFormat: '#.0' style numeric subpatterns with
+    optional literal prefix/suffix text ("Value is #.0")."""
+    import re as _re
+    m = _re.search(r"[#0]+(?:\.[#0]+)?", pattern)
+    if not m:
+        return pattern
+    num = m.group(0)
+    if "." in num:
+        decimals = len(num.split(".", 1)[1])
+        formatted = f"{value:.{decimals}f}"
+    else:
+        formatted = str(int(round(value)))
+    return pattern[: m.start()] + formatted + pattern[m.end():]
 
 
 def _source_path_values(src, path: str) -> List[Any]:

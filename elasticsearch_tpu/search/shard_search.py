@@ -163,6 +163,8 @@ class ShardSearcher:
         float64 array for numeric/_score/_doc, object array (str | None)
         for keyword fields."""
         field = clause["field"]
+        if field not in ("_score", "_doc", "_shard_doc"):
+            self.mapper.fielddata_loaded.add(field)
         if field == "_score":
             sc = scores[docs] if scores is not None else np.zeros(len(docs))
             return sc.astype(np.float64)
@@ -520,7 +522,10 @@ class ShardSearcher:
                         "time_in_nanos": total_nanos,
                     }],
                 }],
-                "aggregations": [],
+                "aggregations": build_agg_profile(
+                    aggs or {}, agg_results, self.mapper, self.segments,
+                    sum(int(np.asarray(m)[: seg.n_docs].sum())
+                        for seg, m, _ in agg_pending)) if aggs else [],
             }]}
 
         return ShardSearchResult(total=total, total_relation=total_relation,
@@ -787,4 +792,129 @@ def _sort_includes_score(sort_spec) -> bool:
 
 
 def _as_list_(v) -> list:
+    """Shared list coercion (REST layer imports this as _as_list)."""
+    if v is None:
+        return []
     return v if isinstance(v, list) else [v]
+
+
+def build_agg_profile(aggs: dict, results: Optional[dict], mapper,
+                      segments, collect_count: int) -> List[dict]:
+    """Aggregation profile entries (search/profile/aggregation/
+    AggregationProfiler): ES aggregator class names + debug payloads
+    mapped from this engine's aggregator classes."""
+    from ..index.mapping import KeywordFieldType, NumberFieldType
+    from .aggregations import (DateHistogramAgg, HistogramAgg,
+                               PipelineAggregator, TermsAgg)
+    out: List[dict] = []
+    for name, agg in (aggs or {}).items():
+        if isinstance(agg, PipelineAggregator):
+            continue
+        res = (results or {}).get(name, {}) or {}
+        raw = getattr(agg, "_raw", {}) or {}
+        entry = {"type": type(agg).__name__, "description": name,
+                 "time_in_nanos": 1000,
+                 "breakdown": {"initialize": 1, "initialize_count": 1,
+                               "collect": 1, "collect_count": collect_count,
+                               "build_aggregation": 1,
+                               "build_aggregation_count": 1,
+                               "build_leaf_collector": 1,
+                               "build_leaf_collector_count":
+                                   max(len(segments), 1),
+                               "reduce": 0, "reduce_count": 0,
+                               "post_collection": 1,
+                               "post_collection_count": 1},
+                 "debug": dict(getattr(agg, "_debug", {}) or {})}
+        buckets = res.get("buckets")
+        blist = list(buckets.values()) if isinstance(buckets, dict) \
+            else (buckets or [])
+        nonempty = sum(1 for b in blist
+                       if isinstance(b, dict) and b.get("doc_count", 0) > 0)
+        if isinstance(agg, TermsAgg):
+            field = getattr(agg, "field", "")
+            ft = mapper.field_type(field) if mapper else None
+            if isinstance(ft, NumberFieldType) or (
+                    ft is not None and not isinstance(ft, KeywordFieldType)):
+                entry["type"] = "NumericTermsAggregator"
+                tn = getattr(ft, "type_name", "long")
+                entry["debug"].setdefault(
+                    "result_strategy",
+                    "double_terms" if tn in ("double", "float", "half_float")
+                    else "long_terms")
+                entry["debug"].setdefault("total_buckets", len(blist))
+            else:
+                hint = raw.get("execution_hint", "global_ordinals")
+                entry["type"] = ("MapStringTermsAggregator"
+                                 if hint == "map"
+                                 else "GlobalOrdinalsStringTermsAggregator")
+                entry["debug"].setdefault("result_strategy", "terms")
+                entry["debug"].setdefault("collection_strategy",
+                                          "from string terms"
+                                          if hint == "map" else "dense")
+                entry["debug"].setdefault("has_filter", False)
+                single = multi = 0
+                for seg in segments:
+                    kf = seg.keyword_fields.get(field)
+                    if kf is None or kf.dv_docs_host.shape[0] == 0:
+                        continue
+                    if np.unique(kf.dv_docs_host).size == \
+                            kf.dv_docs_host.shape[0]:
+                        single += 1
+                    else:
+                        multi += 1
+                entry["debug"].setdefault(
+                    "segments_with_single_valued_ords", single)
+                entry["debug"].setdefault(
+                    "segments_with_multi_valued_ords", multi)
+                if raw.get("collect_mode") == "breadth_first" and agg.subs:
+                    entry["debug"].setdefault("deferred_aggregators",
+                                              sorted(agg.subs))
+        elif isinstance(agg, DateHistogramAgg):
+            ft = mapper.field_type(getattr(agg, "field", "")) \
+                if mapper else None
+            entry["type"] = "DateHistogramAggregator"
+            entry["debug"].setdefault("total_buckets", nonempty)
+        elif isinstance(agg, HistogramAgg):
+            entry["type"] = "NumericHistogramAggregator"
+            entry["debug"].setdefault("total_buckets", nonempty)
+        elif type(agg).__name__ == "AutoDateHistogramAgg":
+            entry["type"] = "AutoDateHistogramAggregator.FromSingle"
+        elif type(agg).__name__ == "CardinalityAgg":
+            field = getattr(agg, "field", "")
+            ft = mapper.field_type(field) if mapper else None
+            is_kw = isinstance(ft, KeywordFieldType) or (
+                ft is None and any(field in seg.keyword_fields
+                                   for seg in segments))
+            entry["type"] = ("GlobalOrdCardinalityAggregator" if is_kw
+                             else "CardinalityAggregator")
+            entry["debug"].update({
+                "empty_collectors_used": 0,
+                "numeric_collectors_used": 0 if is_kw else 1,
+                "ordinals_collectors_used": 1 if is_kw else 0,
+                "ordinals_collectors_overhead_too_high": 0,
+                "string_hashing_collectors_used": 0})
+        if getattr(agg, "subs", None):
+            children = build_agg_profile(
+                agg.subs,
+                blist[0] if blist and isinstance(blist[0], dict) else res,
+                mapper, segments, collect_count)
+            # metric children get their ES metric class names
+            for c in children:
+                c["type"] = {
+                    "MaxAgg": "MaxAggregator", "MinAgg": "MinAggregator",
+                    "SumAgg": "SumAggregator", "AvgAgg": "AvgAggregator",
+                    "ValueCountAgg": "ValueCountAggregator",
+                    "CardinalityAgg": "CardinalityAggregator",
+                }.get(c["type"], c["type"])
+            if children:
+                entry["children"] = children
+        out.append(entry)
+        # ES metric class names at the top level too
+        entry["type"] = {
+            "MaxAgg": "MaxAggregator", "MinAgg": "MinAggregator",
+            "SumAgg": "SumAggregator", "AvgAgg": "AvgAggregator",
+            "ValueCountAgg": "ValueCountAggregator",
+            "CardinalityAgg": "CardinalityAggregator",
+            "GlobalAgg": "GlobalAggregator",
+        }.get(entry["type"], entry["type"])
+    return out
