@@ -791,6 +791,14 @@ class MapperService:
         if routing is not None:
             # _routing indexes as a metadata keyword (RoutingFieldMapper)
             parsed.keyword_terms.setdefault("_routing", []).append(routing)
+        dc = source.get("_doc_count")
+        if dc is not None:
+            if not isinstance(dc, int) or isinstance(dc, bool) or dc <= 0:
+                raise MapperParsingError(
+                    f"[_doc_count] field value must be a positive integer,"
+                    f" got [{dc}]")
+            parsed.numeric_values.setdefault("_doc_count",
+                                             []).append(float(dc))
         self._parse_object("", source, parsed)
         if parsed.dynamic_updates:
             self.merge({"properties": parsed.dynamic_updates})
@@ -801,6 +809,8 @@ class MapperService:
             full = f"{prefix}{key}"
             if value is None:
                 continue
+            if full == "_doc_count":
+                continue          # meta field, handled in parse_document
             ft = self._fields.get(full)
             if isinstance(ft, NestedFieldType):
                 children = value if isinstance(value, list) else [value]

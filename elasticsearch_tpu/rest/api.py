@@ -532,6 +532,15 @@ class RestAPI:
         return dict(self.cluster_settings, defaults={})
 
     def h_cluster_put_settings(self, params, body):
+        from ..search import aggregations as _aggs_mod
+        b0 = _json_body(body)
+        for scope in ("persistent", "transient"):
+            sc = b0.get(scope) or {}
+            mb = sc.get("search.max_buckets",
+                        (sc.get("search") or {}).get("max_buckets", ...))
+            if mb is not ...:
+                _aggs_mod.MAX_BUCKETS[0] = (65536 if mb is None
+                                            else int(mb))
         b = _json_body(body)
         for scope in ("persistent", "transient"):
             self.cluster_settings[scope].update(b.get(scope) or {})

@@ -95,6 +95,17 @@ def _numeric_pairs(seg: Segment, field: str, mapper=None):
     return None
 
 
+def _doc_weights(seg: Segment):
+    """float64[n_docs] per-doc count weights from the _doc_count meta
+    field (DocCountFieldMapper), or None when absent."""
+    dc = seg.numeric_fields.get("_doc_count")
+    if dc is None or dc.docs_host.size == 0:
+        return None
+    w = np.ones(seg.n_docs, np.float64)
+    w[dc.docs_host] = dc.vals_host
+    return w
+
+
 def _keyword_pairs(seg: Segment, field: str, mapper=None):
     """(docs int32[M], ords int32[M], ord_terms list) or None."""
     field = _concrete(mapper, field)
@@ -183,6 +194,16 @@ def parse_aggs(spec: dict) -> Dict[str, Aggregator]:
                 f"aggregation [{name}] of type [{kind}] cannot have "
                 f"sub-aggregations")
         if isinstance(agg, BucketAggregator):
+            # composite may only nest under SINGLE-bucket parents
+            single_bucket = {"FilterAgg", "NestedAgg", "ReverseNestedAgg",
+                             "GlobalAgg", "MissingAgg", "SamplerAgg"}
+            for sn, sa in subs.items():
+                if type(sa).__name__ == "CompositeAgg" and \
+                        type(agg).__name__ not in single_bucket:
+                    raise IllegalArgumentError(
+                        f"[composite] aggregation cannot be used with a "
+                        f"parent aggregation of type: "
+                        f"[{type(agg).__name__}]")
             agg.subs = subs
         if isinstance(agg, PipelineAggregator) and subs:
             raise ParsingError(
@@ -197,6 +218,41 @@ def run_aggregations(aggs: Dict[str, Aggregator], ctx: AggregationContext,
     Pipeline aggs run last, over their sibling's reduced output."""
     return run_aggregations_multi(
         aggs, [(ctx, seg, mask) for seg, mask in seg_masks])
+
+
+#: search.max_buckets cluster setting (mutable; REST layer updates it)
+MAX_BUCKETS = [65536]
+
+
+def _count_buckets(node) -> int:
+    total = 0
+    if isinstance(node, dict):
+        b = node.get("buckets")
+        if isinstance(b, list):
+            total += len(b)
+            for item in b:
+                total += _count_buckets(item)
+        elif isinstance(b, dict):
+            total += len(b)
+            for item in b.values():
+                total += _count_buckets(item)
+        else:
+            for v in node.values():
+                if isinstance(v, dict):
+                    total += _count_buckets(v)
+    return total
+
+
+def _check_max_buckets(result: dict) -> None:
+    limit = MAX_BUCKETS[0]
+    n = sum(_count_buckets(v) for v in result.values()
+            if isinstance(v, dict))
+    if n > limit:
+        raise IllegalArgumentError(
+            f"Trying to create too many buckets. Must be less than or "
+            f"equal to: [{limit}] but was [{n}]. This limit can be set "
+            f"by changing the [search.max_buckets] cluster level "
+            f"setting.")
 
 
 def run_aggregations_multi(
@@ -223,6 +279,7 @@ def run_aggregations_multi(
         result[name] = p.apply(result)
         if getattr(p, "meta", None) is not None:
             result[name]["meta"] = p.meta
+    _check_max_buckets(result)
     return result
 
 
@@ -313,7 +370,17 @@ class _NumericMetricAgg(Aggregator):
         if self.field is None:
             raise ParsingError("metric aggregation requires [field]")
 
+    def _with_value_string(self, out: dict) -> dict:
+        """Metric values over date fields also serialize formatted
+        (value_as_string, like the reference's DocValueFormat)."""
+        mapper = getattr(self, "_mapper", None)
+        ft = _field_type(mapper, self.field) if mapper else None
+        if isinstance(ft, DateFieldType) and out.get("value") is not None:
+            out["value_as_string"] = format_date_millis(out["value"])
+        return out
+
     def _matched_values(self, ctx, seg, mask: np.ndarray) -> np.ndarray:
+        self._mapper = ctx.mapper
         from ..index.mapping import KeywordFieldType, TextFieldType
         ft = ctx.mapper.field_type(self.field) if ctx.mapper else None
         if isinstance(ft, (TextFieldType, KeywordFieldType)):
@@ -348,7 +415,7 @@ class AvgAgg(_NumericMetricAgg):
     def reduce(self, partials):
         s = sum(p["sum"] for p in partials)
         c = sum(p["count"] for p in partials)
-        return {"value": (s / c) if c else None}
+        return self._with_value_string({"value": (s / c) if c else None})
 
 
 class SumAgg(_NumericMetricAgg):
@@ -367,7 +434,8 @@ class MinAgg(_NumericMetricAgg):
 
     def reduce(self, partials):
         vals = [p["min"] for p in partials if p["min"] is not None]
-        return {"value": min(vals) if vals else None}
+        return self._with_value_string(
+            {"value": min(vals) if vals else None})
 
 
 class MaxAgg(_NumericMetricAgg):
@@ -377,7 +445,8 @@ class MaxAgg(_NumericMetricAgg):
 
     def reduce(self, partials):
         vals = [p["max"] for p in partials if p["max"] is not None]
-        return {"value": max(vals) if vals else None}
+        return self._with_value_string(
+            {"value": max(vals) if vals else None})
 
 
 class ValueCountAgg(_NumericMetricAgg):
@@ -708,9 +777,17 @@ class TopHitsAgg(Aggregator):
 # ---------------------------------------------------------------------------
 
 
+def _mask_count(seg, bucket_docs_mask) -> int:
+    """Doc count of a bucket mask, honoring _doc_count weights."""
+    w = _doc_weights(seg)
+    if w is None:
+        return int(bucket_docs_mask.sum())
+    return int(w[bucket_docs_mask[: seg.n_docs]].sum())
+
+
 def _bucket_payload(agg: BucketAggregator, ctx, seg, bucket_docs_mask):
     """(count, sub_partials) for one bucket in one segment."""
-    return (int(bucket_docs_mask.sum()),
+    return (_mask_count(seg, bucket_docs_mask),
             _sub_results(agg, ctx, seg, bucket_docs_mask))
 
 
@@ -830,7 +907,8 @@ class TermsAgg(BucketAggregator):
                 buckets.setdefault(t, (0, {}))
         if kw is not None:
             docs, ords, terms = kw
-            if docs.shape[0] >= ops_aggs.DEVICE_MIN_PAIRS:
+            if docs.shape[0] >= ops_aggs.DEVICE_MIN_PAIRS and \
+                    _doc_weights(seg) is None:
                 # device hot path: ordinal-CSR cumsum-diff counts (exact
                 # int32 — bitwise-identical to the numpy unique path)
                 off_dev, pdocs_dev, V = ops_aggs.ordinal_csr(seg, self.field)
@@ -841,7 +919,15 @@ class TermsAgg(BucketAggregator):
                 pm = None
             else:
                 pm = mask[docs]
-                sel_ords, counts = np.unique(ords[pm], return_counts=True)
+                w = _doc_weights(seg)
+                if w is None:
+                    sel_ords, counts = np.unique(ords[pm],
+                                                 return_counts=True)
+                else:
+                    sel_ords, inv = np.unique(ords[pm],
+                                              return_inverse=True)
+                    counts = np.bincount(
+                        inv, weights=w[docs[pm]]).astype(np.int64)
             if self.subs:
                 if self.include is not None or self.exclude is not None:
                     # filter BEFORE the shard_size cap (the reference's
@@ -869,7 +955,15 @@ class TermsAgg(BucketAggregator):
             if num is not None:
                 docs, vals = num
                 pm = mask[docs]
-                sel_vals, counts = np.unique(vals[pm], return_counts=True)
+                w = _doc_weights(seg)
+                if w is None:
+                    sel_vals, counts = np.unique(vals[pm],
+                                                 return_counts=True)
+                else:
+                    sel_vals, inv = np.unique(vals[pm],
+                                              return_inverse=True)
+                    counts = np.bincount(
+                        inv, weights=w[docs[pm]]).astype(np.int64)
                 if self.subs:
                     if self.include is not None or \
                             self.exclude is not None:
@@ -1029,6 +1123,12 @@ class HistogramAgg(BucketAggregator):
                 continue
             b0 = int(math.floor((lo_v - self.offset) / self.interval))
             b1 = int(math.floor((hi_v - self.offset) / self.interval))
+            if b1 - b0 > 100000:
+                raise IllegalArgumentError(
+                    f"Trying to create too many buckets. Must be less "
+                    f"than or equal to: [{MAX_BUCKETS[0]}]. This limit "
+                    f"can be set by changing the [search.max_buckets] "
+                    f"cluster level setting.")
             for bid in range(b0, b1 + 1):
                 key = bid * self.interval + self.offset
                 cur = out.setdefault(float(key), [0, {}])
@@ -1215,9 +1315,26 @@ class DateHistogramAgg(BucketAggregator):
                 "date_histogram requires calendar_interval or fixed_interval")
 
     def _keys_for(self, vals: np.ndarray) -> np.ndarray:
-        shift = self.offset_ms
-        if self.time_zone and vals.size:
-            shift -= _tz_offset_ms(self.time_zone, float(vals[0]))
+        if not self.time_zone:
+            shift = self.offset_ms
+            v = vals - shift
+            if self.calendar_unit is not None:
+                return _calendar_floor(v, self.calendar_unit) + shift
+            return np.floor(v / self.fixed_ms) * self.fixed_ms + shift
+        # per-value utc offsets (hour-cached — DST transitions move the
+        # offset mid-stream); falls back to one offset on huge spans
+        hours = vals // 3_600_000.0
+        uniq = np.unique(hours)
+        if uniq.size > 10000:
+            off = np.full(vals.shape,
+                          _tz_offset_ms(self.time_zone,
+                                        float(vals[0]) if vals.size
+                                        else 0.0))
+        else:
+            of_hour = {h: _tz_offset_ms(self.time_zone, h * 3_600_000.0)
+                       for h in uniq.tolist()}
+            off = np.asarray([of_hour[h] for h in hours.tolist()])
+        shift = self.offset_ms - off
         v = vals - shift
         if self.calendar_unit is not None:
             return _calendar_floor(v, self.calendar_unit) + shift
@@ -1283,6 +1400,7 @@ class DateHistogramAgg(BucketAggregator):
             docs, vals = docs[sel], vals[sel]
         pm = mask[docs]
         keys = self._keys_for(vals[pm])
+        w = _doc_weights(seg)
         out = {}
         for key in np.unique(keys):
             if self.subs:
@@ -1290,8 +1408,11 @@ class DateHistogramAgg(BucketAggregator):
                 bucket_docs[docs[pm][keys == key]] = True
                 out[float(key)] = _bucket_payload(self, ctx, seg,
                                                   mask & bucket_docs)
-            else:
+            elif w is None:
                 out[float(key)] = (int((keys == key).sum()), {})
+            else:
+                out[float(key)] = (
+                    int(w[docs[pm][keys == key]].sum()), {})
         return out
 
     def reduce(self, partials):
@@ -1810,9 +1931,11 @@ class BucketSortAgg(PipelineAggregator):
                 order = spec.get("order", "asc") \
                     if isinstance(spec, dict) else spec
             series = dict(zip(map(id, out), _bucket_series(out, path)))
-            out.sort(key=lambda b: (series[id(b)] is None,
-                                    series[id(b)] or 0),
-                     reverse=(order == "desc"))
+            present = [b for b in out if series[id(b)] is not None]
+            absent = [b for b in out if series[id(b)] is None]
+            present.sort(key=lambda b: series[id(b)],
+                         reverse=(order == "desc"))
+            out = present + absent         # gap buckets always last
         end = None if self.size is None else self.from_ + int(self.size)
         parent_node["buckets"] = out[self.from_: end]
 

@@ -73,85 +73,188 @@ def _composite_interval(kind: str, cfg: dict) -> float:
 class CompositeAgg(BucketAggregator):
     """Paginable multi-source buckets."""
 
+    MAX_BUCKETS_CEILING = 65536
+
     def __init__(self, body: dict):
+        if "sources" not in body:
+            raise ParsingError("Required [sources]")
         sources = body.get("sources")
-        if not isinstance(sources, list) or not sources:
-            raise ParsingError("[composite] requires a non-empty [sources]")
+        if not sources or not isinstance(sources, list):
+            raise ParsingError(
+                "Composite [sources] cannot be null or empty")
         self.sources = []
+        seen_names = set()
+        dups = []
         for s in sources:
             if not isinstance(s, dict) or len(s) != 1:
                 raise ParsingError(
                     "[composite] each source must be {name: {type: ...}}")
             (name, spec), = s.items()
-            kinds = [k for k in ("terms", "histogram", "date_histogram")
+            if name in seen_names:
+                dups.append(name)
+            seen_names.add(name)
+            kinds = [k for k in ("terms", "histogram", "date_histogram",
+                                 "geotile_grid")
                      if k in spec]
             if len(kinds) != 1:
                 raise ParsingError(
                     f"[composite] source [{name}] must define exactly one "
-                    f"of terms/histogram/date_histogram")
+                    f"of terms/histogram/date_histogram/geotile_grid")
             kind = kinds[0]
             cfg = spec[kind]
             self.sources.append({
                 "name": name, "kind": kind,
                 "field": cfg.get("field"),
                 "interval": (_composite_interval(kind, cfg)
-                             if kind != "terms" else None),
+                             if kind in ("histogram", "date_histogram")
+                             else None),
                 "order": cfg.get("order", "asc"),
+                "format": cfg.get("format"),
+                "time_zone": cfg.get("time_zone"),
+                "offset": cfg.get("offset"),
+                "precision": int(cfg.get("precision", 7)),
+                "calendar": cfg.get("calendar_interval"),
             })
+        if dups:
+            raise IllegalArgumentError(
+                f"Composite source names must be unique, found "
+                f"duplicates: [{','.join(sorted(set(dups)))}]")
+        from .aggregations import MAX_BUCKETS
         self.size = int(body.get("size", 10))
+        if self.size > MAX_BUCKETS[0]:
+            raise IllegalArgumentError(
+                f"Trying to create too many buckets. Must be less than or "
+                f"equal to: [{MAX_BUCKETS[0]}] but was [{self.size}]. "
+                f"This limit can be set by changing the "
+                f"[search.max_buckets] cluster level setting.")
         self.after = body.get("after")
 
-    # -- per-source key columns ---------------------------------------------
+    def _render_key_value(self, src, v):
+        from ..index.mapping import format_date_millis
+        if src["kind"] == "date_histogram" and isinstance(v, (int, float)):
+            if src["format"] == "iso8601" or (
+                    src["format"] is None and src.get("time_zone")):
+                tz = src.get("time_zone")
+                if tz:
+                    from .aggregations import _tz_offset_ms
+                    off = _tz_offset_ms(tz, float(v))
+                    base = format_date_millis(float(v) + off)[:-1]
+                    sign = "+" if off >= 0 else "-"
+                    o = abs(int(off)) // 60000
+                    return f"{base}{sign}{o // 60:02d}:{o % 60:02d}"
+                return format_date_millis(float(v))
+            if src["format"]:
+                from .fetch import java_date_format
+                return java_date_format(float(v), src["format"])
+            mapper = getattr(self, "_mapper", None)
+            ft = mapper.field_type(src["field"]) if mapper else None
+            from ..index.mapping import DateFieldType
+            if isinstance(ft, DateFieldType) and ft.nanos:
+                return format_date_millis(float(v))
+            return int(v)
+        if isinstance(v, float) and v.is_integer():
+            return int(v)
+        return v
 
-    def _key_column(self, seg, src) -> np.ndarray:
-        """object[n_docs] per-doc key (first value; None = missing,
-        excluded like the reference default)."""
+    def _parse_after_value(self, src, v):
+        if src["kind"] == "date_histogram" and isinstance(v, str):
+            import re as _re
+            from ..index.mapping import parse_date_millis
+            try:
+                ms = float(parse_date_millis(v))
+            except Exception:   # noqa: BLE001
+                return v
+            # a cursor without an explicit zone reads in the SOURCE's tz
+            if src.get("time_zone") and not _re.search(
+                    r"(Z|[+-]\d{2}:?\d{2})$", v):
+                from .aggregations import _tz_offset_ms
+                ms -= _tz_offset_ms(src["time_zone"], ms)
+            return ms
+        return v
+
+    # -- per-source key values ----------------------------------------------
+
+    def _key_values(self, seg, src) -> list:
+        """per-doc LIST of keys (every value of a multi-valued field forms
+        its own combination — CompositeValuesSourceBuilder semantics);
+        empty list = missing, excluded like the reference default."""
         n = seg.n_docs
-        col = np.full(n, None, dtype=object)
+        col = [[] for _ in range(n)]
+        if src["kind"] == "geotile_grid":
+            la = seg.numeric_fields.get(f"{src['field']}._lat")
+            lo = seg.numeric_fields.get(f"{src['field']}._lon")
+            if la is not None and lo is not None:
+                from .aggs_geo import geotile_key
+                for d, lat, lon in zip(la.docs_host, la.vals_host,
+                                       lo.vals_host):
+                    col[int(d)].append(
+                        geotile_key(lat, lon, src["precision"]))
+            return col
         if src["kind"] == "terms":
             kw = _keyword_pairs(seg, src["field"])
             if kw is not None:
                 docs, ords, terms = kw
-                for d, o in zip(docs[::-1], ords[::-1]):
-                    col[int(d)] = terms[int(o)]
+                for d, o in zip(docs, ords):
+                    col[int(d)].append(terms[int(o)])
                 return col
         num = _numeric_pairs(seg, src["field"])
         if num is not None:
             docs, vals = num
             if src["kind"] == "terms":
-                for d, v in zip(docs[::-1], vals[::-1]):
-                    col[int(d)] = float(v)
+                for d, v in zip(docs, vals):
+                    col[int(d)].append(float(v))
             else:
                 iv = src["interval"]
-                for d, v in zip(docs[::-1], vals[::-1]):
-                    col[int(d)] = float(np.floor(v / iv) * iv)
-        return col
+                shift = 0.0
+                if src.get("offset"):
+                    from .aggregations import _parse_offset_ms
+                    shift += _parse_offset_ms(src["offset"])
+                if src.get("time_zone") and vals.size:
+                    from .aggregations import _tz_offset_ms
+                    shift -= _tz_offset_ms(src["time_zone"],
+                                           float(vals[0]))
+                for d, v in zip(docs, vals):
+                    col[int(d)].append(
+                        float(np.floor((v - shift) / iv) * iv + shift))
+        # dedupe per doc, preserving order
+        return [list(dict.fromkeys(c)) for c in col]
 
     def collect(self, ctx, seg, mask):
-        docs_mask = mask[: seg.n_docs].copy()
-        cols = [self._key_column(seg, s) for s in self.sources]
-        for c in cols:
-            docs_mask &= np.asarray([v is not None for v in c])
+        import itertools as _it
+        self._mapper = ctx.mapper
+        docs_mask = mask[: seg.n_docs]
+        cols = [self._key_values(seg, s) for s in self.sources]
         idx = np.flatnonzero(docs_mask)
         buckets: Dict[tuple, Tuple[int, dict]] = {}
         by_key_docs: Dict[tuple, List[int]] = {}
         for d in idx:
-            key = tuple(c[d] for c in cols)
-            by_key_docs.setdefault(key, []).append(int(d))
+            per_source = [c[d] for c in cols]
+            if any(not vs for vs in per_source):
+                continue
+            for key in _it.product(*per_source):
+                by_key_docs.setdefault(key, []).append(int(d))
+        from .aggregations import _doc_weights
+        w = _doc_weights(seg)
         for key, ds in by_key_docs.items():
+            n = len(ds) if w is None else int(w[ds].sum())
             if self.subs:
                 bm = np.zeros(mask.shape[0], bool)
                 bm[ds] = True
-                buckets[key] = _bucket_payload(self, ctx, seg, bm)
+                sub = _bucket_payload(self, ctx, seg, bm)[1]
+                buckets[key] = (n, sub)
             else:
-                buckets[key] = (len(ds), {})
+                buckets[key] = (n, {})
         return buckets
 
     def _tuple_sort_key(self, key: tuple):
         parts = []
         for v, src in zip(key, self.sources):
             desc = src["order"] == "desc"
-            if isinstance(v, str):
+            if src["kind"] == "geotile_grid" and isinstance(v, str):
+                z, x, y = (int(t) for t in v.split("/"))
+                t3 = (z, x, y)
+                parts.append((0, tuple(-c for c in t3) if desc else t3))
+            elif isinstance(v, str):
                 parts.append((1, _RevStr(v) if desc else v))
             else:
                 parts.append((0, -float(v) if desc else float(v)))
@@ -169,7 +272,9 @@ class CompositeAgg(BucketAggregator):
             if missing:
                 raise ParsingError(
                     f"[composite] after key is missing sources {missing}")
-            after_key = tuple(self.after[s["name"]] for s in self.sources)
+            after_key = tuple(
+                self._parse_after_value(s, self.after[s["name"]])
+                for s in self.sources)
             ak = self._tuple_sort_key(after_key)
             keys = [k for k in keys if self._tuple_sort_key(k) > ak]
         page = keys[: self.size]
@@ -177,7 +282,7 @@ class CompositeAgg(BucketAggregator):
         for key in page:
             items = merged[key]
             count = sum(c for c, _ in items)
-            b = {"key": {s["name"]: v
+            b = {"key": {s["name"]: self._render_key_value(s, v)
                          for s, v in zip(self.sources, key)},
                  "doc_count": count}
             if self.subs:
@@ -185,8 +290,9 @@ class CompositeAgg(BucketAggregator):
             buckets.append(b)
         out = {"buckets": buckets}
         if page:
-            out["after_key"] = {s["name"]: v
-                                for s, v in zip(self.sources, page[-1])}
+            out["after_key"] = {
+                s["name"]: self._render_key_value(s, v)
+                for s, v in zip(self.sources, page[-1])}
         return out
 
 
@@ -376,8 +482,7 @@ class RareTermsAgg(BucketAggregator):
         return buckets
 
     def reduce(self, partials):
-        from .aggregations import (_reduce_subs, _format_key,
-                                   _field_type)
+        from .aggregations import _reduce_subs, _field_type
         from ..index.mapping import BooleanFieldType, DateFieldType
         merged: Dict[Any, list] = {}
         for p in partials:

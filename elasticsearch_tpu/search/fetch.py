@@ -239,14 +239,21 @@ def java_date_format(millis: float, pattern: str) -> str:
         return str(int(millis))
     dt = datetime.datetime.fromtimestamp(millis / 1000.0,
                                          tz=datetime.timezone.utc)
-    out = pattern
-    if "SSS" in out:
-        out = out.replace("SSS", f"{dt.microsecond // 1000:03d}")
-    for java, strf in _JAVA_STRFTIME:
-        out = out.replace(java, dt.strftime(strf))
-    if "e" in out:                       # ISO day-of-week number
-        out = out.replace("e", str(dt.isoweekday()))
-    return out
+    # tokenize runs of pattern letters so literal text survives intact
+    reps = {"yyyy": "%Y", "MM": "%m", "dd": "%d", "HH": "%H",
+            "mm": "%M", "ss": "%S"}
+
+    def _render(m):
+        run = m.group(0)
+        if run == "SSS":
+            return f"{dt.microsecond // 1000:03d}"
+        if set(run) == {"e"}:            # ISO day-of-week number
+            return str(dt.isoweekday()).rjust(len(run), "0")
+        if run in reps:
+            return dt.strftime(reps[run])
+        return run
+    import re as _re
+    return _re.sub(r"([a-zA-Z])\1*", _render, pattern)
 
 
 def decimal_format(value: float, pattern: str) -> str:
