@@ -130,3 +130,9 @@ class XContentParseError(ElasticsearchError):
     """Agg/body parse failures surfaced as x_content_parse_exception."""
     status = 400
     error_type = "x_content_parse_exception"
+
+
+class ActionRequestValidationError(ElasticsearchError):
+    """Request validation failures (action_request_validation_exception)."""
+    status = 400
+    error_type = "action_request_validation_exception"

@@ -203,8 +203,33 @@ class DateFieldType(MappedFieldType):
         self.nanos = nanos          # date_nanos resolution (sort values
                                     # serialize as epoch nanos)
 
+    #: max epoch-millis storable in a signed-64 nanosecond long
+    NANOS_MAX_MS = (1 << 63) / 1e6
+
     def parse_value(self, value):
-        return parse_date_millis(value, self.format)
+        ms = parse_date_millis(value, self.format)
+        if self.nanos:
+            if ms < 0:
+                e = MapperParsingError(
+                    f"failed to parse field [{self.name}] of type "
+                    f"[date_nanos]")
+                e.caused_by = {
+                    "type": "illegal_argument_exception",
+                    "reason": f"date[{value}] is before the epoch in 1970 "
+                              f"and cannot be stored in nanosecond "
+                              f"resolution"}
+                raise e
+            if ms > self.NANOS_MAX_MS:
+                e = MapperParsingError(
+                    f"failed to parse field [{self.name}] of type "
+                    f"[date_nanos]")
+                e.caused_by = {
+                    "type": "illegal_argument_exception",
+                    "reason": f"date[{value}] is after 2262-04-11T23:47:"
+                              f"16.854775807 and cannot be stored in "
+                              f"nanosecond resolution"}
+                raise e
+        return ms
 
 
 class TokenCountFieldType(MappedFieldType):
@@ -602,6 +627,8 @@ class MapperService:
         #: fields whose column data a sort/agg has materialized — the
         #: fielddata stats accounting (lazily loaded, like Lucene)
         self.fielddata_loaded: set = set()
+        #: index.mapping.nested_objects.limit (set by the index service)
+        self.nested_limit = 10000
         self._mapping_def: dict = {"properties": {}}
         self.dynamic: Any = True
         self.source_enabled = True
@@ -800,6 +827,12 @@ class MapperService:
             parsed.numeric_values.setdefault("_doc_count",
                                              []).append(float(dc))
         self._parse_object("", source, parsed)
+        if len(parsed.nested_docs) > self.nested_limit:
+            raise IllegalArgumentError(
+                f"The number of nested documents has exceeded the allowed "
+                f"limit of [{self.nested_limit}]. This limit can be set "
+                f"by changing the [index.mapping.nested_objects.limit] "
+                f"index level setting.")
         if parsed.dynamic_updates:
             self.merge({"properties": parsed.dynamic_updates})
         return parsed

@@ -1085,7 +1085,13 @@ class IngestService:
         :class:`IngestDocument` (callers must honor ``doc.meta`` —
         pipelines may rewrite ``_index``/``_id``/``_routing``, the
         reference's reroute-on-ingest), or None when dropped."""
-        pipeline = self.get_pipeline(pipeline_id)
+        p0 = self.pipelines.get(pipeline_id)
+        if p0 is None:
+            # a missing pipeline on a WRITE is a request error, not a 404
+            # (TransportBulkAction validates before indexing)
+            raise IllegalArgumentError(
+                f"pipeline with id [{pipeline_id}] does not exist")
+        pipeline = p0
         doc = IngestDocument(index, doc_id, source, routing)
         self.stats["count"] += 1
         try:

@@ -62,6 +62,11 @@ class IndexService:
         self.creation_date = int(time.time() * 1000)
         self.uuid = f"{abs(hash((name, self.creation_date))):022x}"[:22]
         self.mapper = MapperService(mappings or {})
+        try:
+            self.mapper.nested_limit = int(self.settings.get(
+                "index.mapping.nested_objects.limit", 10000))
+        except (TypeError, ValueError):
+            pass
         self.shards: List[Engine] = []
         for i in range(self.num_shards):
             shard_path = os.path.join(path, str(i))
@@ -183,6 +188,12 @@ class IndexService:
         self.settings.update(flat)
         if "index.number_of_replicas" in flat:
             self.num_replicas = int(flat["index.number_of_replicas"])
+        if "index.mapping.nested_objects.limit" in flat:
+            try:
+                self.mapper.nested_limit = int(
+                    flat["index.mapping.nested_objects.limit"])
+            except (TypeError, ValueError):
+                pass
 
     def field_bytes(self):
         """(fielddata_bytes_by_field, completion_bytes_by_field) — host

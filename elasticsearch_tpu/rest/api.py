@@ -50,10 +50,25 @@ def _error_payload(e: Exception) -> Tuple[int, dict]:
         reason = str(e)
     else:
         status, etype, reason = 500, "exception", str(e)
-    return status, {
-        "error": {"root_cause": [{"type": etype, "reason": reason}],
-                  "type": etype, "reason": reason},
-        "status": status}
+    err = {"root_cause": [{"type": etype, "reason": reason}],
+           "type": etype, "reason": reason}
+    caused_by = getattr(e, "caused_by", None)
+    if caused_by:
+        err["caused_by"] = caused_by
+    return status, {"error": err, "status": status}
+
+
+
+
+class _RequireAliasError(ElasticsearchError):
+    status = 404
+    error_type = "index_not_found_exception"
+
+
+def _require_alias_error(index: str) -> "_RequireAliasError":
+    return _RequireAliasError(
+        f"no such index [{index}] and [require_alias] request flag is "
+        f"[true] and [{index}] is not an alias")
 
 
 def _flag(params: dict, name: str, default: bool = False) -> bool:
@@ -242,7 +257,8 @@ class RestAPI:
 
     def handle(self, method: str, path: str, query: str,
                body: bytes) -> Tuple[int, str, bytes]:
-        params = {k: v[-1] for k, v in parse_qs(query).items()}
+        params = {k: v[-1] for k, v in
+                  parse_qs(query, keep_blank_values=True).items()}
         if query:
             # bare flags like ?v
             for part in query.split("&"):
@@ -1357,8 +1373,26 @@ class RestAPI:
                 "_seq_no": result.seq_no, "_primary_term": 1}
 
     def h_index_doc(self, params, body, index, id):
+        if id == "":
+            raise IllegalArgumentError("if _id is specified it must not "
+                                       "be empty")
+        if len(str(id).encode()) > 512:
+            raise IllegalArgumentError(
+                f"id [{id}] is too long, must be no longer than 512 bytes "
+                f"but was: {len(str(id).encode())}")
+        if params.get("require_alias") in ("true", "") and \
+                index not in self.indices.all_aliases():
+            raise _require_alias_error(index)
         svc = self._get_or_autocreate(index)
         op_type = params.get("op_type", "index")
+        ext_version = None
+        if params.get("version_type") in ("external", "external_gte"):
+            ext_version = int(params.get("version", 0))
+            if op_type == "create":
+                from ..common.errors import ActionRequestValidationError
+                raise ActionRequestValidationError(
+                    "Validation Failed: 1: create operations only "
+                    "support internal versioning. use index instead;")
         ingested = self._run_ingest(svc, index, id, _json_body(body),
                                     params.get("routing"),
                                     params.get("pipeline"))
@@ -1372,11 +1406,29 @@ class RestAPI:
             svc = self._get_or_autocreate(new_index)
             index = new_index
         id = new_id or id
+        if ext_version is not None:
+            # external versioning: validate BEFORE applying the write
+            gte = params.get("version_type") == "external_gte"
+            shard = svc.shard_for_doc(id, routing)
+            if not hasattr(shard, "external_versions"):
+                shard.external_versions = {}
+            cur = shard.external_versions.get(id)
+            if cur is not None and (
+                    ext_version < cur or
+                    (not gte and ext_version == cur)):
+                raise VersionConflictError(
+                    f"[{id}]: version conflict, current version [{cur}] "
+                    f"is higher or equal to the one provided "
+                    f"[{ext_version}]")
         r = svc.index_doc(id, source,
                           routing=routing, op_type=op_type,
                           if_seq_no=_int_or_none(params.get("if_seq_no")),
                           if_primary_term=_int_or_none(
                               params.get("if_primary_term")))
+        if ext_version is not None:
+            shard.external_versions[id] = ext_version
+            r = type(r)(**{**r.__dict__, "version": ext_version}) \
+                if hasattr(r, "__dict__") else r
         if params.get("refresh") in ("true", "wait_for", ""):
             svc.refresh()
             resp = self._doc_response(index, r,
@@ -1396,27 +1448,102 @@ class RestAPI:
         params = dict(params, op_type="create")
         return self.h_index_doc(params, body, index, id)
 
+    def _get_source_spec(self, params):
+        spec = params.get("_source")
+        if spec in ("true", "false", ""):
+            spec = spec != "false"
+        elif spec is not None:
+            spec = spec.split(",")
+        if "_source_includes" in params or "_source_excludes" in params:
+            spec = {k: params[p].split(",")
+                    for k, p in (("includes", "_source_includes"),
+                                 ("excludes", "_source_excludes"))
+                    if p in params}
+        return spec
+
+    def _doc_visible(self, svc, doc_id, realtime: bool) -> bool:
+        if realtime:
+            return True
+        return any(seg.find_doc(doc_id) is not None
+                   for sh in svc.shards
+                   for seg in sh.searchable_segments())
+
     def h_get_doc(self, params, body, index, id):
         svc = self.indices.get(index)
+        if params.get("refresh") in ("true", ""):
+            svc.refresh()
         r = svc.get_doc(id, routing=params.get("routing"))
-        if not r.found:
+        realtime = params.get("realtime") not in ("false",)
+        if not r.found or not self._doc_visible(svc, id, realtime):
             return 404, {"_index": index, "_id": id, "found": False}
+        if params.get("version"):
+            want = int(params["version"])
+            if want != r.version:
+                raise VersionConflictError(
+                    f"[{id}]: version conflict, current version "
+                    f"[{r.version}] is different than the one provided "
+                    f"[{want}]")
         out = {"_index": index, "_id": id, "_version": r.version,
-               "_seq_no": r.seq_no, "_primary_term": 1, "found": True,
-               "_source": r.source}
+               "_seq_no": r.seq_no, "_primary_term": 1, "found": True}
+        src_spec = self._get_source_spec(params)
+        stored = params.get("stored_fields")
+        if stored:
+            from ..search.fetch import fetch_fields
+            names = [f for f in stored.split(",") if f != "_source"]
+            flds = fetch_fields(svc.mapper, r.source, names)
+            if flds:
+                out["fields"] = flds
+            if src_spec is None:
+                src_spec = "_source" in stored.split(",")
+        if src_spec is not False:
+            from ..search.fetch import filter_source
+            out["_source"] = filter_source(
+                r.source, True if src_spec is None else src_spec)
         if getattr(r, "routing", None) is not None:
             out["_routing"] = r.routing
         return out
 
     def h_get_source(self, params, body, index, id):
         svc = self.indices.get(index)
+        if not svc.mapper.source_enabled:
+            return 404, {"error": f"document [{id}] missing: _source is "
+                                  f"disabled", "status": 404}
+        if params.get("refresh") in ("true", ""):
+            svc.refresh()
         r = svc.get_doc(id, routing=params.get("routing"))
-        if not r.found:
+        realtime = params.get("realtime") not in ("false",)
+        if not r.found or not self._doc_visible(svc, id, realtime):
             return 404, {"error": f"document [{id}] missing", "status": 404}
-        return r.source
+        src_spec = self._get_source_spec(params)
+        from ..search.fetch import filter_source
+        return filter_source(r.source,
+                             True if src_spec is None else src_spec)
 
     def h_delete_doc(self, params, body, index, id):
         svc = self.indices.get(index)
+        if params.get("version_type") in ("external", "external_gte"):
+            want = int(params.get("version", 0))
+            gte = params.get("version_type") == "external_gte"
+            shard = svc.shard_for_doc(id, params.get("routing"))
+            cur = getattr(shard, "external_versions", {}).get(id)
+            if cur is not None and (want < cur or
+                                    (not gte and want == cur)):
+                raise VersionConflictError(
+                    f"[{id}]: version conflict, current version [{cur}] "
+                    f"is higher or equal to the one provided [{want}]")
+            if not hasattr(shard, "external_versions"):
+                shard.external_versions = {}
+            shard.external_versions[id] = want
+            r = svc.delete_doc(id, routing=params.get("routing"))
+            if params.get("refresh") in ("true", "wait_for", ""):
+                svc.refresh()
+            resp = self._doc_response(index, r,
+                                      "deleted" if r.found
+                                      else "not_found")
+            resp["_version"] = want
+            if not r.found:
+                return 404, resp
+            return resp
         r = svc.delete_doc(id, routing=params.get("routing"),
                            if_seq_no=_int_or_none(params.get("if_seq_no")),
                            if_primary_term=_int_or_none(
@@ -1427,31 +1554,101 @@ class RestAPI:
             return 404, self._doc_response(index, r, "not_found")
         return self._doc_response(index, r, "deleted")
 
+    #: UpdateRequest body fields (unknown keys get did-you-mean 400s)
+    UPDATE_BODY_KEYS = {"doc", "script", "upsert", "doc_as_upsert",
+                        "detect_noop", "scripted_upsert", "_source",
+                        "if_seq_no", "if_primary_term"}
+
     def h_update_doc(self, params, body, index, id):
-        svc = self._get_or_autocreate(index)
+        import difflib
         b = _json_body(body)
+        for k in b:
+            if k not in self.UPDATE_BODY_KEYS:
+                hint = difflib.get_close_matches(
+                    k, sorted(self.UPDATE_BODY_KEYS), n=1)
+                suffix = f" did you mean [{hint[0]}]?" if hint else ""
+                raise IllegalArgumentError(
+                    f"[UpdateRequest] unknown field [{k}]{suffix}")
+        if params.get("require_alias") in ("true", "") and \
+                index not in self.indices.all_aliases():
+            raise _require_alias_error(index)
+        svc = self._get_or_autocreate(index)
+        if_seq_no = _int_or_none(params.get("if_seq_no",
+                                            b.get("if_seq_no")))
+        if_primary_term = _int_or_none(params.get("if_primary_term",
+                                                  b.get("if_primary_term")))
+        refresh = params.get("refresh") in ("true", "wait_for", "")
+
+        def finish(status, resp, src_after=None):
+            if refresh:
+                svc.refresh()
+                resp["forced_refresh"] = \
+                    params.get("refresh") != "wait_for"
+            src_spec = params.get("_source", b.get("_source"))
+            if "_source_includes" in params or \
+                    "_source_excludes" in params:
+                src_spec = {k: params[p].split(",")
+                            for k, p in (("includes", "_source_includes"),
+                                         ("excludes", "_source_excludes"))
+                            if p in params}
+            if src_spec is not None and src_spec not in ("false", False):
+                from ..search.fetch import filter_source
+                if isinstance(src_spec, str) and src_spec not in (
+                        "true", ""):
+                    src_spec = src_spec.split(",")
+                elif src_spec in ("true", "", True):
+                    src_spec = True
+                resp["get"] = {"found": True,
+                               "_source": filter_source(src_after or {},
+                                                        src_spec)}
+            return (status, resp) if status != 200 else resp
+
         existing = svc.get_doc(id, routing=params.get("routing"))
+        if not existing.found and (if_seq_no is not None or
+                                   if_primary_term is not None):
+            raise VersionConflictError(
+                f"[{id}]: version conflict, document does not exist "
+                f"(expected seqNo [{if_seq_no}])")
         if not existing.found:
             if "upsert" in b:
-                r = svc.index_doc(id, b["upsert"],
-                                  routing=params.get("routing"))
-                return 201, self._doc_response(index, r, "created")
+                src = b["upsert"]
+                if b.get("scripted_upsert") and "script" in b:
+                    script = b["script"]
+                    source = script.get("source") if isinstance(
+                        script, dict) else script
+                    src = _apply_update_script(
+                        dict(src), source,
+                        script.get("params", {}) if isinstance(
+                            script, dict) else {})
+                r = svc.index_doc(id, src, routing=params.get("routing"))
+                return finish(201, self._doc_response(index, r, "created"),
+                              src)
             if b.get("doc_as_upsert") and "doc" in b:
                 r = svc.index_doc(id, b["doc"],
                                   routing=params.get("routing"))
-                return 201, self._doc_response(index, r, "created")
+                return finish(201, self._doc_response(index, r, "created"),
+                              b["doc"])
             raise DocumentMissingError(f"[{id}]: document missing")
+        if if_seq_no is not None and existing.seq_no != if_seq_no:
+            raise VersionConflictError(
+                f"[{id}]: version conflict, required seqNo [{if_seq_no}], "
+                f"current [{existing.seq_no}]")
+        if if_primary_term is not None and if_primary_term != 1:
+            raise VersionConflictError(
+                f"[{id}]: version conflict, required primary term "
+                f"[{if_primary_term}]")
         if "doc" in b:
             merged = _deep_merge(dict(existing.source or {}), b["doc"])
             if b.get("detect_noop", True) and merged == existing.source:
-                return {"_index": index, "_id": id,
+                resp = {"_index": index, "_id": id,
                         "_version": existing.version, "result": "noop",
+                        "_seq_no": existing.seq_no, "_primary_term": 1,
                         "_shards": {"total": 0, "successful": 0,
                                     "failed": 0}}
+                return finish(200, resp, existing.source)
             r = svc.index_doc(id, merged, routing=params.get("routing"))
-            if params.get("refresh") in ("true", "wait_for", ""):
-                svc.refresh()
-            return self._doc_response(index, r, "updated")
+            return finish(200, self._doc_response(index, r, "updated"),
+                          merged)
         if "script" in b:
             src = dict(existing.source or {})
             script = b["script"]
@@ -1461,23 +1658,52 @@ class RestAPI:
                           if isinstance(script, dict) else {})
             new_src = _apply_update_script(src, source, ctx_params)
             r = svc.index_doc(id, new_src, routing=params.get("routing"))
-            return self._doc_response(index, r, "updated")
+            return finish(200, self._doc_response(index, r, "updated"),
+                          new_src)
         raise IllegalArgumentError(
             "update requires [doc], [script], or [upsert]")
 
     def h_mget(self, params, body, index=None):
         b = _json_body(body)
-        out = []
         if "docs" in b:
             entries = b["docs"]
-        else:
+        elif "ids" in b:
             entries = [{"_id": i} for i in b.get("ids", [])]
-        from ..search.fetch import filter_source
-        req_src = params.get("_source")
-        if req_src in ("true", "false"):
-            req_src = req_src == "true"
-        elif req_src is not None:
-            req_src = req_src.split(",")
+        else:
+            entries = None
+        errors = []
+        if not entries:
+            errors.append("no documents to get")
+        for i, e in enumerate(entries or []):
+            if not isinstance(e, dict) or "_id" not in e:
+                errors.append(f"id is missing for doc {i}")
+            else:
+                bad = [k for k in ("_type", "_routing", "_version",
+                                   "_version_type", "_parent")
+                       if k in e]
+                if bad:
+                    errors.append(
+                        f"Action/metadata line [{i}] contains an unknown "
+                        f"parameter [{bad[0]}]")
+                if e.get("_index", index) is None:
+                    errors.append(f"index is missing for doc {i}")
+        if errors:
+            from ..common.errors import ActionRequestValidationError
+            raise ActionRequestValidationError(
+                "Validation Failed: " + "; ".join(
+                    f"{i + 1}: {m}" for i, m in enumerate(errors)) + ";")
+        out = []
+        from ..search.fetch import fetch_fields, filter_source
+        req_src = self._get_source_spec(params)
+        realtime = params.get("realtime") not in ("false",)
+        if params.get("refresh") in ("true", ""):
+            seen_idx = {e.get("_index", index) for e in entries
+                        if isinstance(e, dict)}
+            for ix in seen_idx:
+                try:
+                    self.indices.get(ix).refresh()
+                except Exception:   # noqa: BLE001 — missing index
+                    pass
         for e in entries:
             idx = e.get("_index", index)
             if idx is None:
@@ -1486,17 +1712,50 @@ class RestAPI:
             routing = e.get("routing")
             routing = str(routing) if routing is not None else None
             try:
+                resolved = self.indices.resolve(idx)
+                if len(resolved) > 1:
+                    out.append({"_index": idx, "_id": doc_id, "error": {
+                        "root_cause": [{
+                            "type": "illegal_argument_exception",
+                            "reason": f"alias [{idx}] has more than one "
+                                      f"index associated with it "
+                                      f"[{', '.join(sorted(resolved))}], "
+                                      f"can't execute a single index "
+                                      f"op"}],
+                        "type": "illegal_argument_exception",
+                        "reason": f"alias [{idx}] has more than one index "
+                                  f"associated with it "
+                                  f"[{', '.join(sorted(resolved))}], "
+                                  f"can't execute a single index op"}})
+                    continue
                 svc = self.indices.get(idx)
                 r = svc.get_doc(doc_id, routing=routing)
             except IndexNotFoundError:
                 out.append({"_index": idx, "_id": doc_id, "found": False})
                 continue
+            if r.found and not self._doc_visible(svc, doc_id, realtime):
+                out.append({"_index": idx, "_id": doc_id, "found": False})
+                continue
             if r.found:
                 src_spec = e.get("_source", req_src)
-                if src_spec is None:
-                    src_spec = True
                 entry = {"_index": idx, "_id": doc_id,
                          "_version": r.version, "found": True}
+                if routing is not None:
+                    entry["_routing"] = routing
+                stored = e.get("stored_fields",
+                               params.get("stored_fields"))
+                if stored:
+                    if isinstance(stored, str):
+                        stored = stored.split(",")
+                    flds = fetch_fields(svc.mapper, r.source,
+                                        [f for f in stored
+                                         if f != "_source"])
+                    if flds:
+                        entry["fields"] = flds
+                    if src_spec is None:
+                        src_spec = "_source" in stored
+                if src_spec is None:
+                    src_spec = True
                 filtered = filter_source(r.source, src_spec)
                 if src_spec is not False:
                     entry["_source"] = filtered
@@ -1665,15 +1924,26 @@ class RestAPI:
                 action = json.loads(line)
             except json.JSONDecodeError as e:
                 raise ParsingError(f"Malformed action/metadata line: {e}")
+            if not action:
+                raise IllegalArgumentError(
+                    f"Malformed action/metadata line [{i}], expected "
+                    f"FIELD_NAME but found [END_OBJECT]")
             (verb, meta), = action.items()
+            if verb == "index" and meta.get("op_type") == "create":
+                verb = "create"
             if verb not in ("index", "create", "delete", "update"):
                 raise IllegalArgumentError(
                     f"Malformed action/metadata line, expected one of "
                     f"[create, delete, index, update] but found [{verb}]")
+            if "_type" in meta:
+                raise IllegalArgumentError(
+                    f"Action/metadata line [{i}] contains an unknown "
+                    f"parameter [_type]")
             idx = meta.get("_index", index)
             if idx is None:
                 raise IllegalArgumentError("bulk item requires _index")
             doc_id = meta.get("_id")
+            has_explicit_id = doc_id is not None
             doc_id = str(doc_id) if doc_id is not None \
                 else uuid.uuid4().hex[:20]
             source = None
@@ -1683,6 +1953,33 @@ class RestAPI:
                 source = json.loads(lines[i])
                 i += 1
             try:
+                if has_explicit_id and doc_id == "":
+                    if verb == "create":
+                        doc_id = uuid.uuid4().hex[:20]
+                    else:
+                        raise IllegalArgumentError(
+                            "if _id is specified it must not be empty")
+                require_alias = meta.get(
+                    "require_alias",
+                    params.get("require_alias") in ("true", ""))
+                if require_alias and idx not in self.indices.all_aliases():
+                    raise _require_alias_error(idx)
+                resolved = self.indices.resolve(idx) \
+                    if idx in self.indices.all_aliases() else [idx]
+                if len(resolved) > 1:
+                    writers = [n for n in resolved
+                               if self.indices.indices[n].aliases.get(
+                                   idx, {}).get("is_write_index")]
+                    if len(writers) == 1:
+                        resolved = writers
+                        idx = writers[0]
+                    else:
+                        raise IllegalArgumentError(
+                            f"no write index is defined for alias "
+                            f"[{idx}]. The write index may be explicitly "
+                            f"disabled using is_write_index=false or the "
+                            f"alias points to multiple indices without "
+                            f"one being designated as a write index")
                 svc = self._get_or_autocreate(idx)
                 touched.add(idx)
                 if verb == "delete":
@@ -1692,8 +1989,20 @@ class RestAPI:
                                            else "not_found"),
                         status=200 if r.found else 404)})
                 elif verb == "update":
-                    up_params = ({"routing": meta.get("routing")}
-                                 if meta.get("routing") else {})
+                    up_params = {}
+                    if meta.get("routing"):
+                        up_params["routing"] = meta["routing"]
+                    for cas in ("if_seq_no", "if_primary_term"):
+                        if meta.get(cas) is not None:
+                            up_params[cas] = meta[cas]
+                    msrc = meta.get("_source", params.get("_source"))
+                    if msrc is not None:
+                        up_params["_source"] = msrc if isinstance(
+                            msrc, (str, dict)) \
+                            else ("true" if msrc else "false")
+                    for p_ in ("_source_includes", "_source_excludes"):
+                        if params.get(p_) is not None:
+                            up_params[p_] = params[p_]
                     r = self.h_update_doc(up_params,
                                           json.dumps(source).encode(),
                                           idx, doc_id)
@@ -1717,7 +2026,11 @@ class RestAPI:
                     r = svc.index_doc(doc_id, source,
                                       routing=routing,
                                       op_type=("create" if verb == "create"
-                                               else "index"))
+                                               else "index"),
+                                      if_seq_no=_int_or_none(
+                                          meta.get("if_seq_no")),
+                                      if_primary_term=_int_or_none(
+                                          meta.get("if_primary_term")))
                     items.append({verb: dict(
                         self._doc_response(idx, r, "created" if r.created
                                            else "updated"),
@@ -2835,6 +3148,8 @@ class RestAPI:
 
 
 def _int_or_none(v):
+    if v == "":
+        return None
     return int(v) if v is not None else None
 
 

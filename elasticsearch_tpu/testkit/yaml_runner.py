@@ -266,7 +266,20 @@ class YamlTestRunner:
         (action, raw_params), = body.items()
         params = self._subst(raw_params or {}, state)
         req_body = params.pop("body", None)
-        method, path, query = self.registry.resolve(action, params)
+        ignore = params.pop("ignore", None)
+        ignore_statuses = {int(x) for x in (
+            ignore if isinstance(ignore, list) else [ignore])} \
+            if ignore is not None else set()
+        try:
+            method, path, query = self.registry.resolve(action, params)
+        except KeyError as e:
+            if catch == "param":
+                return                     # expected unbuildable request
+            raise StepFailure(str(e))
+        if catch == "param":
+            raise StepFailure(
+                f"[{action}] expected a parameter error, but the url "
+                f"resolved")
         if req_body is not None and method == "GET":
             method = "POST"
         def _qv(v):
@@ -294,7 +307,15 @@ class YamlTestRunner:
             resp = json.loads(out)
         except Exception:   # noqa: BLE001 — _cat text responses
             resp = out.decode() if isinstance(out, bytes) else out
-        state["last"] = resp
+        if method == "HEAD":
+            # HEAD responses surface as a boolean body (exists semantics)
+            state["last"] = status < 300
+            if catch is None:
+                return
+        else:
+            state["last"] = resp
+        if status in ignore_statuses:
+            return
         if catch is not None:
             if status < 400:
                 raise StepFailure(
