@@ -153,6 +153,12 @@ def parse_date_millis(value: Any, fmt: str = "strict_date_optional_time||epoch_m
     if re.fullmatch(r"-?\d+", s):
         if "epoch_second" in fmt and "epoch_millis" not in fmt:
             return float(s) * 1000.0
+        if len(s) == 4 and "strict_date_optional_time" in fmt and \
+                1000 <= int(s) <= 9999:
+            # strict_date_optional_time accepts a bare year and comes
+            # before epoch_millis in the default format list
+            d = _dt.datetime(int(s), 1, 1, tzinfo=_dt.timezone.utc)
+            return (d - _EPOCH).total_seconds() * 1000.0
         return float(s)
     try:
         if _DATE_YMD_RE.match(s):
@@ -505,20 +511,104 @@ class CompletionFieldType(MappedFieldType):
 
     type_name = "completion"
 
+    def __init__(self, name: str, params: Optional[dict] = None):
+        super().__init__(name, params)
+        ctxs = (params or {}).get("contexts") or []
+        if isinstance(ctxs, dict):
+            ctxs = [ctxs]
+        self.contexts = ctxs        # [{name, type, path?, precision?}]
+
     def parse_value(self, value):
-        # "text" | ["a", "b"] | {"input": [...], "weight": n}
+        """→ (inputs, weight, contexts_dict)."""
         if isinstance(value, str):
-            return [value.lower()], 1
-        if isinstance(value, list):
-            return [str(v).lower() for v in value], 1
-        if isinstance(value, dict):
+            inputs, weight, ctxs = [value], 1, {}
+        elif isinstance(value, list) and any(
+                isinstance(v, dict) for v in value):
+            # array of {input, weight} entries — inputs merge; the
+            # per-doc weight column keeps the FIRST entry's weight
+            # (per-input weights are a documented simplification)
+            inputs, weight, ctxs = [], None, {}
+            for v in value:
+                i2, w2, c2 = self.parse_value(v)
+                inputs.extend(i2)
+                if weight is None:
+                    weight = w2
+                for ck, cv in c2.items():
+                    ctxs.setdefault(ck, cv)
+            weight = 1 if weight is None else weight
+        elif isinstance(value, list):
+            inputs, weight, ctxs = [str(v) for v in value], 1, {}
+        elif isinstance(value, dict):
             inputs = value.get("input", [])
             if isinstance(inputs, str):
                 inputs = [inputs]
-            return ([str(v).lower() for v in inputs],
-                    int(value.get("weight", 1)))
-        raise MapperParsingError(
-            f"failed to parse completion input [{value}]")
+            inputs = [str(v) for v in inputs]
+            weight = int(value.get("weight", 1))
+            ctxs = value.get("contexts") or {}
+        else:
+            raise MapperParsingError(
+                f"failed to parse completion input [{value}]")
+        if self.contexts and not ctxs and not any(
+                c.get("path") for c in self.contexts):
+            raise MapperParsingError(
+                f"Contexts are mandatory in context enabled "
+                f"completion field [{self.name}]")
+        return inputs, weight, ctxs
+
+    def context_tokens(self, ctxs: dict, source: dict) -> dict:
+        """context name → list of stored tokens (geo → geohash12)."""
+        out = {}
+        for cdef in self.contexts:
+            cname = cdef.get("name")
+            ctype = cdef.get("type", "category")
+            vals = ctxs.get(cname)
+            if vals is None and cdef.get("path"):
+                cur = source
+                for part in str(cdef["path"]).split("."):
+                    cur = cur.get(part) if isinstance(cur, dict) else None
+                vals = cur
+            if vals is None:
+                continue
+            if not isinstance(vals, list):
+                vals = [vals]
+            toks = []
+            for v in vals:
+                if ctype == "geo":
+                    lat, lon = GeoPointFieldType(cname).parse_value(v)
+                    toks.append(geohash_encode_12(lat, lon))
+                else:
+                    toks.append(str(v))
+            out[cname] = toks
+        return out
+
+
+def geohash_encode_12(lat: float, lon: float) -> str:
+    """12-char geohash (max context precision; queries prefix-match)."""
+    lat_lo, lat_hi, lon_lo, lon_hi = -90.0, 90.0, -180.0, 180.0
+    out, bits, n, even = [], 0, 0, True
+    while len(out) < 12:
+        if even:
+            mid = (lon_lo + lon_hi) / 2
+            if lon >= mid:
+                bits = (bits << 1) | 1
+                lon_lo = mid
+            else:
+                bits <<= 1
+                lon_hi = mid
+        else:
+            mid = (lat_lo + lat_hi) / 2
+            if lat >= mid:
+                bits = (bits << 1) | 1
+                lat_lo = mid
+            else:
+                bits <<= 1
+                lat_hi = mid
+        even = not even
+        n += 1
+        if n == 5:
+            out.append(_GEOHASH_B32[bits])
+            bits = n = 0
+    return "".join(out)
 
 
 class BinaryFieldType(MappedFieldType):
@@ -981,10 +1071,14 @@ class MapperService:
             if v is not None:
                 parsed.keyword_terms.setdefault(full, []).append(v)
         elif isinstance(ft, CompletionFieldType):
-            inputs, weight = ft.parse_value(value)
+            inputs, weight, cvals = ft.parse_value(value)
             parsed.keyword_terms.setdefault(full, []).extend(inputs)
             parsed.numeric_values.setdefault(f"{full}._weight",
                                              []).append(float(weight))
+            for cname, toks in ft.context_tokens(cvals,
+                                                 parsed.source).items():
+                parsed.keyword_terms.setdefault(
+                    f"{full}._ctx_{cname}", []).extend(toks)
         elif isinstance(ft, DenseVectorFieldType):
             parsed.vectors[full] = ft.parse_value(value)
         elif isinstance(ft, GeoPointFieldType):
@@ -1008,11 +1102,16 @@ class MapperService:
                         sub, (ObjectFieldType,)):
                     # only leaf multi-fields of leaf parents
                     if isinstance(sub, CompletionFieldType):
-                        inputs, weight = sub.parse_value(value)
+                        inputs, weight, cvals = sub.parse_value(value)
                         parsed.keyword_terms.setdefault(
                             sub_name, []).extend(inputs)
                         parsed.numeric_values.setdefault(
                             f"{sub_name}._weight", []).append(float(weight))
+                        for cname, toks in sub.context_tokens(
+                                cvals, parsed.source).items():
+                            parsed.keyword_terms.setdefault(
+                                f"{sub_name}._ctx_{cname}",
+                                []).extend(toks)
                     elif isinstance(sub, KeywordFieldType):
                         v = sub.parse_value(value)
                         if v is not None:

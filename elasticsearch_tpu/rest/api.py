@@ -344,11 +344,56 @@ class RestAPI:
     def h_cluster_health(self, params, body, index=None):
         return self._health(index)
 
+    #: cluster-state response sections selectable by the metric path
+    CLUSTER_STATE_METRICS = ("version", "master_node", "nodes",
+                             "routing_table", "routing_nodes", "metadata",
+                             "blocks", "customs")
+
+    def _index_blocks(self) -> Dict[str, dict]:
+        """Per-index block entries: an index may carry several blocks
+        (closed AND read-only) at once."""
+        out: Dict[str, dict] = {}
+        for n, sv in self.indices.indices.items():
+            entry = {}
+            if sv.closed:
+                entry["4"] = {"description": "index closed",
+                              "retryable": False,
+                              "levels": ["read", "write"]}
+            if str(sv.settings.get("index.blocks.read_only",
+                                   "")).lower() == "true":
+                entry["5"] = {"description": "index read-only (api)",
+                              "retryable": False,
+                              "levels": ["write", "metadata_write"]}
+            if entry:
+                out[n] = entry
+        return out
+
     def h_cluster_state(self, params, body, metric=None, index=None):
         """Cluster state (reference: ``RestClusterStateAction``): the
         single-node composition of the same sections the coordinator
-        publishes in the multi-node tier."""
-        names = self.indices.resolve(index)
+        publishes in the multi-node tier; the metric path filters the
+        emitted sections."""
+        if index is not None and params.get(
+                "ignore_unavailable") in ("true", ""):
+            names = []
+            for part in index.split(","):
+                try:
+                    names.extend(self.indices.resolve(part))
+                except IndexNotFoundError:
+                    pass
+        else:
+            names = self.indices.resolve(index)
+        if not names and index and \
+                params.get("allow_no_indices") == "false":
+            raise IndexNotFoundError(f"no such index [{index}]")
+        ew = params.get("expand_wildcards", "open")
+        if index and any(c in index for c in "*,") or index == "_all":
+            if "closed" not in ew and "all" not in ew:
+                names = [n for n in names
+                         if not self.indices.indices[n].closed]
+            elif ew == "closed":
+                names = [n for n in names
+                         if self.indices.indices[n].closed]
         meta_indices = {}
         routing_table = {}
         for n in names:
@@ -364,21 +409,38 @@ class RestAPI:
                 str(s): [{"state": "STARTED", "primary": True,
                           "node": self.node_id, "shard": s, "index": n}]
                 for s in range(svc.num_shards)}}
-        return {
-            "cluster_name": self.cluster_name,
-            "cluster_uuid": self.node_id,
+        sections = {
             "version": 1,
-            "state_uuid": self.node_id,
             "master_node": self.node_id,
-            "blocks": {},
+            "blocks": {"indices": self._index_blocks()},
             "nodes": {self.node_id: {"name": self.node_name,
                                      "transport_address": "127.0.0.1:9300",
                                      "attributes": {}}},
+            "routing_nodes": {"unassigned": [],
+                              "nodes": {self.node_id: []}},
             "metadata": {"cluster_uuid": self.node_id,
                          "templates": self.templates,
                          "indices": meta_indices},
             "routing_table": {"indices": routing_table},
         }
+        out = {"cluster_name": self.cluster_name,
+               "cluster_uuid": self.node_id}
+        wanted = set(self.CLUSTER_STATE_METRICS)
+        if metric and metric != "_all":
+            wanted = {m.strip() for m in metric.split(",")}
+            bad = wanted - set(self.CLUSTER_STATE_METRICS)
+            if bad:
+                raise IllegalArgumentError(
+                    f"request [/_cluster/state/{metric}] contains "
+                    f"unrecognized metric: [{sorted(bad)[0]}]")
+        out["state_uuid"] = self.node_id
+        for k in self.CLUSTER_STATE_METRICS:
+            if k in wanted and k in sections:
+                v = sections[k]
+                if k == "blocks" and not v.get("indices"):
+                    v = {}
+                out[k] = v
+        return out
 
     def h_pending_tasks(self, params, body):
         return {"tasks": []}
@@ -2312,7 +2374,15 @@ class RestAPI:
             out["aggregations"] = aggregations
         # cross-index suggest: merge options per (suggester, token entry) —
         # dedupe by text keeping the best score, re-rank score-descending
-        suggests = [r.suggest for _, r in results if r.suggest]
+        suggests = []
+        for n, r in results:
+            if not r.suggest:
+                continue
+            for entries in r.suggest.values():
+                for entry in entries:
+                    for opt in entry.get("options", []):
+                        opt.setdefault("_index", n)
+            suggests.append(r.suggest)
         if suggests:
             out["suggest"] = _merge_suggest(suggests)
         profiles = [r.profile for _, r in results if r.profile]
@@ -2512,6 +2582,12 @@ class RestAPI:
                 walk_query(v)
 
         walk_query(search_body.get("query"))
+        if scroll and size is not None and int(size) == 0:
+            raise IllegalArgumentError(
+                "[size] cannot be [0] in a scroll context")
+        if scroll and params.get("request_cache") is not None:
+            raise IllegalArgumentError(
+                "[request_cache] cannot be used in a scroll context")
         collapse = search_body.get("collapse")
         if collapse:
             if scroll:
@@ -2737,6 +2813,15 @@ class RestAPI:
                 search_body.get("aggs") or search_body.get("aggregations")
                 or {}, out["aggregations"],
                 self.indices.indices[names[0]].mapper)
+        if _flag(params, "typed_keys") and out.get("suggest"):
+            sspec = search_body.get("suggest") or {}
+            renamed = {}
+            for sname, entries in out["suggest"].items():
+                body_s = sspec.get(sname) or {}
+                kind = next((k for k in ("term", "phrase", "completion")
+                             if k in body_s), None)
+                renamed[f"{kind}#{sname}" if kind else sname] = entries
+            out["suggest"] = renamed
         if params.get("rest_total_hits_as_int") in ("true", ""):
             total = out.get("hits", {}).get("total")
             if isinstance(total, dict):
@@ -2789,6 +2874,20 @@ class RestAPI:
     SCROLL_MAX_DOCS = 500_000
 
     def _start_scroll(self, names, search_body, keep_alive) -> dict:
+        from ..common.settings import parse_time_millis
+        if keep_alive and keep_alive != "_none":
+            ka_ms = parse_time_millis(keep_alive)
+            max_ka = parse_time_millis(
+                (self.cluster_settings.get("persistent") or {}).get(
+                    "search.max_keep_alive",
+                    (self.cluster_settings.get("transient") or {}).get(
+                        "search.max_keep_alive", "24h")))
+            if ka_ms > max_ka:
+                raise IllegalArgumentError(
+                    f"Keep alive for request ({keep_alive}) is too large. "
+                    f"It must be less than ({int(max_ka // 60000)}m). This "
+                    f"limit can be set by changing the "
+                    f"[search.max_keep_alive] cluster level setting.")
         size = int(search_body.get("size", 10))
         big = dict(search_body)
         big["size"] = self.SCROLL_MAX_DOCS
@@ -2804,6 +2903,41 @@ class RestAPI:
             all_hits.sort(key=lambda nh: (
                 -(nh[1].score if nh[1].score is not None else float("-inf")),
                 nh[0], nh[1].doc_id))
+        slc = search_body.get("slice")
+        if slc:
+            sid_, smax = int(slc.get("id", 0)), int(slc.get("max", 1))
+            if smax <= 1:
+                raise IllegalArgumentError(
+                    f"max must be greater than 1, got [{smax}]")
+            if not (0 <= sid_ < smax):
+                raise IllegalArgumentError(
+                    f"id must be less than max, got id [{sid_}] and "
+                    f"max [{smax}]")
+            explicit = []
+            for n in names:
+                raw = self.indices.indices[n].settings.get(
+                    "index.max_slices_per_scroll")
+                if raw is not None:
+                    try:
+                        explicit.append(int(raw))
+                    except (TypeError, ValueError):
+                        pass
+            max_slices = min(explicit) if explicit else 1024
+            if smax > max_slices:
+                raise IllegalArgumentError(
+                    f"The number of slices [{smax}] is too large. It must "
+                    f"be less than [{max_slices}]. This limit can be set "
+                    f"by changing the [index.max_slices_per_scroll] index "
+                    f"level setting.")
+            from ..utils.murmur3 import murmur3_32, shard_for
+            def _slice_of(n, h):
+                shards = self.indices.indices[n].num_shards
+                if smax <= shards:
+                    # slice by shard id (SliceBuilder shard partitioning)
+                    return shard_for(h.doc_id, shards) % smax
+                return murmur3_32(h.doc_id.encode()) % smax
+            all_hits = [nh for nh in all_hits
+                        if _slice_of(*nh) == sid_]
         sid = uuid.uuid4().hex
         self.scrolls[sid] = {"hits": all_hits, "pos": size, "size": size,
                              "total": len(all_hits),
@@ -2819,7 +2953,22 @@ class RestAPI:
 
     def h_scroll(self, params, body, scroll_id=None):
         b = _json_body(body) if body else {}
-        sid = scroll_id or b.get("scroll_id") or params.get("scroll_id")
+        # body params OVERRIDE query-string/path ones (RestSearchScroll)
+        sid = b.get("scroll_id") or scroll_id or params.get("scroll_id")
+        ka = b.get("scroll") or params.get("scroll")
+        if ka:
+            from ..common.settings import parse_time_millis
+            max_ka = parse_time_millis(
+                (self.cluster_settings.get("persistent") or {}).get(
+                    "search.max_keep_alive",
+                    (self.cluster_settings.get("transient") or {}).get(
+                        "search.max_keep_alive", "24h")))
+            if parse_time_millis(ka) > max_ka:
+                raise IllegalArgumentError(
+                    f"Keep alive for request ({ka}) is too large. It must "
+                    f"be less than ({int(max_ka // 60000)}m). This limit "
+                    f"can be set by changing the [search.max_keep_alive] "
+                    f"cluster level setting.")
         ctx = self.scrolls.get(sid)
         if ctx is None:
             return 404, {"error": {"type": "search_context_missing_exception",
@@ -2828,13 +2977,16 @@ class RestAPI:
         size = ctx.get("size", 10)
         page = ctx["hits"][ctx["pos"]: ctx["pos"] + size]
         ctx["pos"] += size
-        return {
+        out = {
             "_scroll_id": sid, "took": 0, "timed_out": False,
             "_shards": {"total": 1, "successful": 1, "skipped": 0,
                         "failed": 0},
             "hits": {"total": {"value": ctx["total"], "relation": "eq"},
                      "max_score": None,
                      "hits": [self._hit_json(n, h) for n, h in page]}}
+        if params.get("rest_total_hits_as_int") in ("true", ""):
+            out["hits"]["total"] = ctx["total"]
+        return out
 
     def h_clear_scroll(self, params, body, scroll_id=None):
         b = _json_body(body) if body else {}
@@ -2852,6 +3004,8 @@ class RestAPI:
         for sid in ids:
             if self.scrolls.pop(sid, None) is not None:
                 n += 1
+        if n == 0:
+            return 404, {"succeeded": True, "num_freed": 0}
         return {"succeeded": True, "num_freed": n}
 
     def h_open_pit(self, params, body, index):
@@ -3122,24 +3276,108 @@ class RestAPI:
 
     def h_field_caps(self, params, body, index=None):
         names = self.indices.resolve(index)
-        patterns = (params.get("fields") or
-                    _json_body(body).get("fields") or "*")
+        b = _json_body(body)
+        patterns = (params.get("fields") or b.get("fields") or "*")
         if isinstance(patterns, str):
             patterns = patterns.split(",")
+        index_filter = b.get("index_filter")
+        if index_filter is not None:
+            from ..search.query_dsl import parse_query
+            kept = []
+            for n in names:
+                svc = self.indices.indices[n]
+                try:
+                    svc.refresh()        # filter evaluates live contents
+                    docs = sum(sh.doc_count for sh in svc.shards)
+                    if docs == 0 or svc.count(
+                            {"query": index_filter}) > 0:
+                        kept.append(n)   # empty shard → can_match true
+                except Exception:   # noqa: BLE001 — unmapped fields
+                    pass
+            names = kept
         import fnmatch
+        from ..index.mapping import (DateFieldType, NestedFieldType,
+                                     ObjectFieldType)
+        # (field, type) → caps + the indices carrying that type
+        per_type_idx: Dict[str, Dict[str, list]] = {}
         fields: Dict[str, Dict[str, dict]] = {}
+        mapped_in: Dict[str, set] = {}
         for n in names:
             svc = self.indices.indices[n]
             for fname in svc.mapper.field_names():
-                if not any(fnmatch.fnmatchcase(fname, p) for p in patterns):
+                if not any(fnmatch.fnmatchcase(fname, p)
+                           for p in patterns):
                     continue
+                mapped_in.setdefault(fname, set()).add(n)
                 ft = svc.mapper.field_type(fname)
                 tname = getattr(ft, "type_name", "object")
+                if isinstance(ft, DateFieldType) and ft.nanos:
+                    tname = "date_nanos"
+                is_obj = isinstance(ft, (ObjectFieldType, NestedFieldType))
+                unsearchable = is_obj or (
+                    (getattr(ft, "params", None) or {}).get("index")
+                    is False)
+                no_dv = is_obj or (
+                    (getattr(ft, "params", None) or {}).get("doc_values")
+                    is False) or not getattr(ft, "has_doc_values", False)
                 caps = fields.setdefault(fname, {}).setdefault(tname, {
                     "type": tname, "metadata_field": False,
-                    "searchable": True, "aggregatable":
-                        getattr(ft, "has_doc_values", False)})
-        return {"indices": names, "fields": fields}
+                    "searchable": True, "aggregatable": True,
+                    "_search_in": [], "_nosearch_in": [],
+                    "_agg_in": [], "_noagg_in": []})
+                (caps["_nosearch_in"] if unsearchable
+                 else caps["_search_in"]).append(n)
+                (caps["_noagg_in"] if no_dv
+                 else caps["_agg_in"]).append(n)
+                meta = (ft.params or {}).get("meta") \
+                    if hasattr(ft, "params") else None
+                if meta:
+                    m = caps.setdefault("meta", {})
+                    for mk, mv in meta.items():
+                        m.setdefault(mk, set()).add(str(mv))
+                per_type_idx.setdefault(fname, {}).setdefault(
+                    tname, []).append(n)
+
+        # finalize searchability: true iff searchable in EVERY index
+        # carrying the type; mixed → non_searchable_indices
+        for fname, types in fields.items():
+            for tname, caps in types.items():
+                nosearch = caps.pop("_nosearch_in", [])
+                search = caps.pop("_search_in", [])
+                caps["searchable"] = not nosearch
+                if nosearch and search:
+                    caps["non_searchable_indices"] = sorted(nosearch)
+                noagg = caps.pop("_noagg_in", [])
+                agg = caps.pop("_agg_in", [])
+                caps["aggregatable"] = not noagg
+                if noagg and agg:
+                    caps["non_aggregatable_indices"] = sorted(noagg)
+                if "meta" in caps:
+                    caps["meta"] = {k: sorted(v)
+                                    for k, v in caps["meta"].items()}
+        # a type entry lists its indices when the field maps to MULTIPLE
+        # types across the queried indices (FieldCapabilities.indices)
+        for fname, types in fields.items():
+            for tname, caps in types.items():
+                idxs = per_type_idx.get(fname, {}).get(tname, [])
+                if len(types) > 1:
+                    caps["indices"] = sorted(idxs)
+            unmapped = [n for n in names
+                        if n not in mapped_in.get(fname, set())]
+            if _flag(params, "include_unmapped") and unmapped and types:
+                missing = sorted(unmapped)
+                if missing:
+                    types["unmapped"] = {
+                        "type": "unmapped", "metadata_field": False,
+                        "searchable": False, "aggregatable": False,
+                        "indices": missing}
+                    for tname2, caps2 in list(types.items()):
+                        if tname2 != "unmapped":
+                            caps2.setdefault(
+                                "indices",
+                                sorted(per_type_idx.get(fname, {}).get(
+                                    tname2, [])))
+        return {"indices": sorted(names), "fields": fields}
 
 
 # ---------------------------------------------------------------------------

@@ -637,8 +637,17 @@ class RangeQuery(Query):
             fmt = self.date_format or ft.format
             lo = self.gte if self.gte is not None else self.gt
             hi = self.lte if self.lte is not None else self.lt
-            lo_v = parse_date_millis(lo, fmt) if lo is not None else None
-            hi_v = parse_date_millis(hi, fmt) if hi is not None else None
+
+            def _bound(v):
+                # numeric bounds coerce through the format list (a bare
+                # 4-digit number reads as a year, DateMathParser-style)
+                if isinstance(v, (int, float)) and not isinstance(
+                        v, bool) and 1000 <= v <= 9999 and \
+                        float(v).is_integer():
+                    v = str(int(v))
+                return parse_date_millis(v, fmt)
+            lo_v = _bound(lo) if lo is not None else None
+            hi_v = _bound(hi) if hi is not None else None
             return _numeric_range_result(
                 seg, self.field, lo_v, hi_v, self.boost,
                 include_lo=self.gt is None, include_hi=self.lt is None)

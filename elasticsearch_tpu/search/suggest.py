@@ -211,40 +211,107 @@ class CompletionSuggester:
             raise ParsingError("the required field option is missing")
         self.size = int(body.get("size", 5))
         self.skip_duplicates = bool(body.get("skip_duplicates", False))
+        self.contexts = body.get("contexts") or {}
+
+    def _context_filter(self, ctx, seg):
+        """bool[n_docs] of docs matching every requested context, or None
+        when the query has no context clauses."""
+        if not self.contexts:
+            return None
+        from ..index.mapping import (CompletionFieldType,
+                                     GeoPointFieldType, geohash_encode_12)
+        ft = ctx.mapper.field_type(self.field) if ctx.mapper else None
+        cdefs = {c.get("name"): c for c in
+                 getattr(ft, "contexts", [])} if ft is not None else {}
+        keep = np.ones(seg.n_docs, bool)
+        for cname, clauses in self.contexts.items():
+            kf = seg.keyword_fields.get(f"{self.field}._ctx_{cname}")
+            any_match = np.zeros(seg.n_docs, bool)
+            if not isinstance(clauses, list):
+                clauses = [clauses]
+            ctype = (cdefs.get(cname) or {}).get("type", "category")
+            for cl in clauses:
+                if ctype == "geo":
+                    spec = cl if isinstance(cl, dict) else {"context": cl}
+                    point = spec["context"] if "context" in spec else spec
+                    precision = _geohash_level(
+                        spec.get("precision",
+                                 (cdefs.get(cname) or {}).get(
+                                     "precision", 6)))
+                    lat, lon = GeoPointFieldType(cname).parse_value(point)
+                    # the reference matches the query cell AND its 8
+                    # neighbors (GeoContextMapping.toInternalQueryContexts)
+                    bits = 5 * precision
+                    dlon = 360.0 / (1 << ((bits + 1) // 2))
+                    dlat = 180.0 / (1 << (bits // 2))
+                    prefixes = set()
+                    for di in (-1, 0, 1):
+                        for dj in (-1, 0, 1):
+                            la = min(max(lat + di * dlat, -90.0), 90.0)
+                            lo_ = ((lon + dj * dlon + 180.0) % 360.0) - 180.0
+                            prefixes.add(
+                                geohash_encode_12(la, lo_)[:precision])
+                    if kf is not None:
+                        for term, o in kf.term_ords.items():
+                            if any(term.startswith(p_) for p_ in prefixes):
+                                st, ln, _ = kf.term_run(term)
+                                any_match[kf.docs_host[st: st + ln]] = True
+                else:
+                    val = cl.get("context") if isinstance(cl, dict) else cl
+                    if kf is not None:
+                        st, ln, _ = kf.term_run(str(val))
+                        any_match[kf.docs_host[st: st + ln]] = True
+            keep &= any_match
+        return keep
 
     def run(self, ctx, prefix: str) -> List[dict]:
-        import bisect
+        ft = ctx.mapper.field_type(self.field) if ctx.mapper else None
+        defined = {c.get("name") for c in getattr(ft, "contexts", [])}
+        if defined and (not self.contexts or
+                        all(not v for v in self.contexts.values())):
+            raise IllegalArgumentError(
+                "Missing mandatory contexts in context query")
         prefix = prefix.lower()
-        options: List[Tuple[float, str, str]] = []
+        options: List[Tuple[float, str, str, dict]] = []
         for seg in ctx.segments:
             kf = seg.keyword_fields.get(self.field)
             if kf is None:
                 continue
+            ctx_keep = self._context_filter(ctx, seg)
             weights = seg.numeric_first_value_column(
                 f"{self.field}._weight")
-            # ord_terms is sorted: binary-search the range start, then walk
-            # while the prefix holds (an upper-bound sentinel like
-            # prefix+U+FFFF would miss supplementary-plane continuations)
-            lo = bisect.bisect_left(kf.ord_terms, prefix)
-            for o in range(lo, len(kf.ord_terms)):
-                inp = kf.ord_terms[o]
-                if not inp.startswith(prefix):
+            # inputs keep their original case; matching is lowercase
+            # (the completion "simple" analyzer) over a cached
+            # case-folded sorted table (segments are immutable)
+            import bisect
+            lowered = getattr(kf, "_lowered_sorted", None)
+            if lowered is None:
+                lowered = sorted((t.lower(), t) for t in kf.ord_terms)
+                kf._lowered_sorted = lowered
+            lo_i = bisect.bisect_left(lowered, (prefix,))
+            for li in range(lo_i, len(lowered)):
+                low, inp = lowered[li]
+                if not low.startswith(prefix):
                     break
                 st, ln, _ = kf.term_run(inp)
                 for doc in kf.docs_host[st: st + ln]:
                     if not seg.live[doc]:
                         continue
+                    if ctx_keep is not None and not ctx_keep[int(doc)]:
+                        continue
                     w = weights[doc]
                     w = 1.0 if np.isnan(w) else float(w)
-                    options.append((w, inp, seg.doc_uids[int(doc)]))
+                    options.append((w, inp, seg.doc_uids[int(doc)],
+                                    seg.sources[int(doc)]))
         options.sort(key=lambda o: (-o[0], o[1]))
         out = []
         seen = set()
-        for weight, inp, doc_id in options:
+        for weight, inp, doc_id, src in options:
             if self.skip_duplicates and inp in seen:
                 continue
             seen.add(inp)
-            out.append({"text": inp, "_id": doc_id, "_score": float(weight)})
+            out.append({"text": inp, "_id": doc_id,
+                        "_score": float(weight), "_source": src})
             if len(out) >= self.size:
                 break
         return [{"text": prefix, "offset": 0, "length": len(prefix),
@@ -277,3 +344,22 @@ def run_suggest(ctx, spec: dict) -> Dict[str, list]:
                 f"suggestion [{name}] requires one of [term, phrase, "
                 f"completion]")
     return out
+
+
+#: geohash cell heights per precision level (meters) — the mapping from
+#: a distance precision ("5km") to the coarsest level at least that fine
+_GEOHASH_LEVEL_M = [5009400.0, 1252300.0, 156500.0, 39100.0, 4900.0,
+                    1200.0, 152.9, 38.2, 4.78, 1.19, 0.149, 0.037]
+
+
+def _geohash_level(precision) -> int:
+    if isinstance(precision, int):
+        return max(1, min(precision, 12))
+    if isinstance(precision, str) and precision.isdigit():
+        return max(1, min(int(precision), 12))
+    from .positional import parse_distance_meters
+    meters = parse_distance_meters(precision)
+    for level, size in enumerate(_GEOHASH_LEVEL_M, start=1):
+        if size <= meters:
+            return level
+    return 12

@@ -3,9 +3,11 @@
 The reference routes ``doc_id → shard`` with Murmur3 over the routing key
 (``cluster/routing/OperationRouting.java:242-256``, backed by
 ``Murmur3HashFunction``). Implemented from the public MurmurHash3 spec
-(Austin Appleby, public domain); we hash the routing key's UTF-8 bytes with
-seed 0. Routing only needs to be self-consistent within this system, so
-byte-for-byte parity with the reference's UTF-16 hashing is not required.
+(Austin Appleby, public domain). ``shard_for`` hashes the routing key's
+UTF-16LE code units with seed 0 and takes the signed floorMod — BIT-EXACT
+with the reference, because shard-coupled features (scroll slicing,
+shard-partition terms) assert specific doc→shard placements. Changing the
+hash invalidates on-disk shard assignments of previously written indexes.
 """
 
 from __future__ import annotations
@@ -77,8 +79,14 @@ def _murmur3_32_py(data: bytes, seed: int = 0) -> int:
 
 def shard_for(routing: str, num_shards: int, routing_partition_size: int = 1,
               partition_offset: int = 0) -> int:
-    """doc → shard (reference: ``OperationRouting.generateShardId``)."""
-    h = murmur3_32(routing.encode("utf-8"))
+    """doc → shard, BIT-EXACT with the reference
+    (``OperationRouting.generateShardId`` + ``Murmur3HashFunction``: the
+    hash runs over the routing key's UTF-16LE code units and the shard is
+    the signed floorMod — shard-coupled behaviors like scroll slicing
+    depend on landing on the same shards)."""
+    h = murmur3_32(routing.encode("utf-16-le"))
     if routing_partition_size > 1:
         h = (h + partition_offset) % (1 << 32)
+    if h >= 1 << 31:
+        h -= 1 << 32            # java int; python % IS floorMod
     return h % num_shards

@@ -55,7 +55,10 @@ def test_date_parsing_variants():
     assert parse_date_millis("1970-01-01T00:00:00Z") == 0.0
     assert parse_date_millis("1970-01-01") == 0.0
     assert parse_date_millis(1000) == 1000.0
-    assert parse_date_millis("1000") == 1000.0
+    # a bare 4-digit value reads as a YEAR (strict_date_optional_time
+    # precedes epoch_millis in the default format list)
+    assert parse_date_millis("1000") == parse_date_millis("1000-01-01")
+    assert parse_date_millis("10000") == 10000.0
     assert parse_date_millis("1970-01-01T00:00:01+00:00") == 1000.0
     assert format_date_millis(0.0) == "1970-01-01T00:00:00.000Z"
     with pytest.raises(MapperParsingError):
