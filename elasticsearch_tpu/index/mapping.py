@@ -582,11 +582,11 @@ class CompletionFieldType(MappedFieldType):
         return out
 
 
-def geohash_encode_12(lat: float, lon: float) -> str:
-    """12-char geohash (max context precision; queries prefix-match)."""
+def geohash_encode(lat: float, lon: float, precision: int) -> str:
+    """Geohash encoding (Geohash.java bit interleaving)."""
     lat_lo, lat_hi, lon_lo, lon_hi = -90.0, 90.0, -180.0, 180.0
     out, bits, n, even = [], 0, 0, True
-    while len(out) < 12:
+    while len(out) < precision:
         if even:
             mid = (lon_lo + lon_hi) / 2
             if lon >= mid:
@@ -609,6 +609,11 @@ def geohash_encode_12(lat: float, lon: float) -> str:
             out.append(_GEOHASH_B32[bits])
             bits = n = 0
     return "".join(out)
+
+
+def geohash_encode_12(lat: float, lon: float) -> str:
+    """12-char geohash (max context precision; queries prefix-match)."""
+    return geohash_encode(lat, lon, 12)
 
 
 class BinaryFieldType(MappedFieldType):

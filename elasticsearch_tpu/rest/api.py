@@ -88,6 +88,8 @@ class RestAPI:
         self.node_name = node_name
         self.node_id = uuid.uuid4().hex[:20]
         self.start_time = time.time()
+        self.voting_exclusions: List[dict] = []
+        self.component_templates: Dict[str, dict] = {}
         self.cluster_settings: Dict[str, dict] = {"persistent": {},
                                                   "transient": {}}
         self.templates: Dict[str, dict] = {}
@@ -140,6 +142,7 @@ class RestAPI:
         add("GET", "/_nodes/{node_id}", self.h_nodes)
         add("GET", "/_nodes/{node_id}/{metric}", self.h_nodes)
         # cat
+        add("GET", "/_cat/shards/{index}", self.h_cat_shards)
         add("GET", "/_cat/indices", self.h_cat_indices)
         add("GET", "/_cat/indices/{index}", self.h_cat_indices)
         add("GET", "/_cat/health", self.h_cat_health)
@@ -148,6 +151,21 @@ class RestAPI:
         add("GET", "/_cat/shards", self.h_cat_shards)
         add("GET", "/_cat/nodes", self.h_cat_nodes)
         add("GET", "/_cat/aliases", self.h_cat_aliases)
+        add("GET", "/_cat/templates", self.h_cat_templates)
+        add("GET", "/_cat/templates/{name}", self.h_cat_templates)
+        add("GET", "/_cat/allocation", self.h_cat_allocation)
+        add("GET", "/_cat/allocation/{node_id}", self.h_cat_allocation)
+        add("POST", "/_cluster/voting_config_exclusions",
+            self.h_post_voting_exclusions)
+        add("DELETE", "/_cluster/voting_config_exclusions",
+            self.h_delete_voting_exclusions)
+        add("PUT,POST", "/_component_template/{name}",
+            self.h_put_component_template)
+        add("GET", "/_component_template/{name}",
+            self.h_get_component_template)
+        add("GET", "/_component_template", self.h_get_component_template)
+        add("DELETE", "/_component_template/{name}",
+            self.h_delete_component_template)
         add("GET", "/_cat/aliases/{name}", self.h_cat_aliases)
         # search / count / mget / analyze / field caps
         add("GET,POST", "/_search", self.h_search)
@@ -420,6 +438,9 @@ class RestAPI:
                               "nodes": {self.node_id: []}},
             "metadata": {"cluster_uuid": self.node_id,
                          "templates": self.templates,
+                         "cluster_coordination": {
+                             "voting_config_exclusions":
+                                 list(self.voting_exclusions)},
                          "indices": meta_indices},
             "routing_table": {"indices": routing_table},
         }
@@ -818,8 +839,11 @@ class RestAPI:
             return (1, 0.0, str(cell))
 
     def _cat_table(self, rows: List[List[str]], headers: List[str],
-                   verbose: bool, params: Optional[dict] = None):
+                   verbose: bool, params: Optional[dict] = None,
+                   default_columns: Optional[List[str]] = None,
+                   aliases: Optional[Dict[str, str]] = None):
         params = params or {}
+        aliases = aliases or {}
         if _flag(params, "help"):
             w = max((len(h) for h in headers), default=0)
             return "".join(f"{h.ljust(w)} | {h} | {h}\n" for h in headers)
@@ -831,14 +855,24 @@ class RestAPI:
             for k in str(params["s"]).split(","):
                 k = k.strip()
                 name, _, order = k.partition(":")
+                name = aliases.get(name, name)
                 if name in col_of:
                     specs.append((name, order == "desc"))
             for name, desc in reversed(specs):
-                rows = sorted(rows, key=lambda r, c=col_of[name]:
-                              self._cat_sort_key(r[c]), reverse=desc)
+                c = col_of[name]
+                present = [r for r in rows if self._cat_cell(r[c]) != ""]
+                absent = [r for r in rows if self._cat_cell(r[c]) == ""]
+                present.sort(key=lambda r: self._cat_sort_key(r[c]),
+                             reverse=desc)
+                rows = present + absent      # empty cells always last
         if params.get("h"):
-            sel = [c.strip() for c in str(params["h"]).split(",")
-                   if c.strip() in col_of]
+            sel = [aliases.get(c.strip(), c.strip())
+                   for c in str(params["h"]).split(",")]
+            sel = [c for c in sel if c in col_of]
+            rows = [[r[col_of[c]] for c in sel] for r in rows]
+            headers = sel
+        elif default_columns:
+            sel = [c for c in default_columns if c in col_of]
             rows = [[r[col_of[c]] for c in sel] for r in rows]
             headers = sel
         if params.get("format") == "json":
@@ -846,34 +880,88 @@ class RestAPI:
                     for r in rows]
         if not rows and not verbose:
             return ""
-        widths = [len(h) for h in headers]
+        # without the header row, column widths come from the data alone
+        widths = [len(h) if verbose else 0 for h in headers]
         for r in rows:
             for i, c in enumerate(r):
                 widths[i] = max(widths[i], len(self._cat_cell(c)))
+        # numeric columns right-align (the reference's Table renderer)
+        def _is_num(c):
+            return isinstance(c, (int, float)) and not isinstance(c, bool)
+        numeric_col = [bool(rows) and all(_is_num(r[i]) or r[i] in ("",)
+                                          for r in rows)
+                       for i in range(len(headers))]
         lines = []
         if verbose:
             lines.append(" ".join(h.ljust(widths[i])
                                   for i, h in enumerate(headers)).rstrip())
         for r in rows:
-            lines.append(" ".join(self._cat_cell(c).ljust(widths[i])
-                                  for i, c in enumerate(r)).rstrip())
+            cells = []
+            for i, c in enumerate(r):
+                txt = self._cat_cell(c)
+                cells.append(txt.rjust(widths[i]) if numeric_col[i]
+                             else txt.ljust(widths[i]))
+            line = " ".join(cells)
+            # trailing pads stay only when the LAST cell is an empty
+            # placeholder (the reference width-pads empty cells)
+            if r and self._cat_cell(r[-1]) != "":
+                line = line.rstrip()
+            lines.append(line)
         return "\n".join(lines) + "\n"
 
+    #: cat indices column aliases (Table cell aliases in the reference)
+    _CAT_IDX_ALIASES = {"i": "index", "idx": "index", "h": "health",
+                        "s": "status", "dc": "docs.count",
+                        "docsCount": "docs.count", "dd": "docs.deleted",
+                        "cd": "creation.date",
+                        "cds": "creation.date.string",
+                        "ss": "store.size", "p": "pri", "r": "rep",
+                        "id": "uuid"}
+
     def h_cat_indices(self, params, body, index=None):
+        health_filter = params.get("health")
+        if health_filter is not None and health_filter not in (
+                "green", "yellow", "red"):
+            raise IllegalArgumentError(
+                f"unknown health value [{health_filter}]")
         rows = []
+        ew = params.get("expand_wildcards", "open,closed")
+        wildcarded = index is None or any(c in index for c in "*")
         for name in self.indices.resolve(index):
             svc = self.indices.indices[name]
+            hidden = str(svc.settings.get("index.hidden",
+                                          "")).lower() == "true" or                 name.startswith(".")
+            if hidden and wildcarded and "all" not in ew and                     "hidden" not in ew and                     not (index or "").startswith("."):
+                continue
+            closed = svc.closed
             st = svc.stats(with_field_bytes=False)
-            rows.append(["green", "open", name, svc.uuid,
+            size = _human_bytes(st["store"]["size_in_bytes"])
+            health = "green" if svc.num_replicas == 0 or closed \
+                else "yellow"       # unassigned replicas on one node
+            rows.append([health, "close" if closed else "open",
+                         name, svc.uuid,
                          svc.num_shards, svc.num_replicas,
-                         st["docs"]["count"], st["docs"]["deleted"],
-                         st["store"]["size_in_bytes"],
-                         st["store"]["size_in_bytes"]])
+                         "" if closed else st["docs"]["count"],
+                         "" if closed else st["docs"]["deleted"],
+                         "" if closed else size,
+                         "" if closed else size,
+                         str(svc.creation_date),
+                         format_date_millis_cat(svc.creation_date)])
+        if health_filter is not None:
+            rows = [r for r in rows if r[0] == health_filter]
         return self._cat_table(rows, ["health", "status", "index", "uuid",
                                       "pri", "rep", "docs.count",
                                       "docs.deleted", "store.size",
-                                      "pri.store.size"],
-                               _flag(params, "v"), params)
+                                      "pri.store.size", "creation.date",
+                                      "creation.date.string"],
+                               _flag(params, "v"), params,
+                               aliases=self._CAT_IDX_ALIASES,
+                               default_columns=["health", "status",
+                                                "index", "uuid", "pri",
+                                                "rep", "docs.count",
+                                                "docs.deleted",
+                                                "store.size",
+                                                "pri.store.size"])
 
     def h_cat_health(self, params, body):
         h = self._health()
@@ -898,19 +986,133 @@ class RestAPI:
             [[int(time.time()), time.strftime("%H:%M:%S"), total]],
             ["epoch", "timestamp", "count"], _flag(params, "v"), params)
 
-    def h_cat_shards(self, params, body):
+    def h_cat_shards(self, params, body, index=None):
         rows = []
-        for name, svc in sorted(self.indices.indices.items()):
+        for name in sorted(self.indices.resolve(index)):
+            svc = self.indices.indices[name]
             for i, shard in enumerate(svc.shards):
                 rows.append([name, i, "p", "STARTED", shard.doc_count,
+                             "0b", "127.0.0.1", self.node_id,
                              self.node_name])
         return self._cat_table(rows, ["index", "shard", "prirep", "state",
-                                      "docs", "node"], _flag(params, "v"), params)
+                                      "docs", "store", "ip", "id", "node"],
+                               _flag(params, "v"), params)
 
     def h_cat_nodes(self, params, body):
+        import shutil as _sh
+        du = _sh.disk_usage(self.indices.data_path)
+        full_id = _flag(params, "full_id")
+        rows = [["127.0.0.1", 42, 42, 1, "0.00", "0.00", "0.00",
+                 "dim", "*", self.node_name,
+                 self.node_id if full_id else self.node_id[:4],
+                 _human_bytes(du.free), _human_bytes(du.total),
+                 _human_bytes(du.used), f"{du.used / du.total * 100:.2f}"
+                 if du.total else "0.00"]]
         return self._cat_table(
-            [["127.0.0.1", "mdi", "*", self.node_name]],
-            ["ip", "node.role", "master", "name"], _flag(params, "v"), params)
+            rows,
+            ["ip", "heap.percent", "ram.percent", "cpu", "load_1m",
+             "load_5m", "load_15m", "node.role", "master", "name", "id",
+             "diskAvail", "diskTotal", "diskUsed", "diskUsedPercent"],
+            _flag(params, "v"), params,
+            default_columns=["ip", "heap.percent", "ram.percent", "cpu",
+                             "load_1m", "load_5m", "load_15m",
+                             "node.role", "master", "name"])
+
+    def h_cat_templates(self, params, body, name=None):
+        import fnmatch
+        rows = []
+        pats = [p.strip() for p in name.split(",")] if name else None
+        for tname, t in sorted(self.templates.items()):
+            if pats and not any(fnmatch.fnmatchcase(tname, p)
+                                for p in pats):
+                continue
+            rows.append([tname,
+                         "[" + ", ".join(t.get("index_patterns", []))
+                         + "]",
+                         t.get("order", t.get("priority", "")),
+                         t.get("version", ""),
+                         ("[" + ", ".join(t["composed_of"]) + "]")
+                         if "composed_of" in t else ""])
+        return self._cat_table(rows, ["name", "index_patterns", "order",
+                                      "version", "composed_of"],
+                               _flag(params, "v"), params,
+                               aliases={"n": "name", "t": "index_patterns",
+                                        "o": "order", "p": "order",
+                                        "v": "version",
+                                        "c": "composed_of"})
+
+    def h_cat_allocation(self, params, body, node_id=None):
+        import shutil as _sh
+        if node_id is not None and node_id not in (
+                "_master", "_local", "*", "_all", self.node_id,
+                self.node_name):
+            rows = []
+        else:
+            du = _sh.disk_usage(self.indices.data_path)
+            shards = sum(svc.num_shards
+                         for svc in self.indices.indices.values())
+            used = sum(svc.stats(with_field_bytes=False)
+                       ["store"]["size_in_bytes"]
+                       for svc in self.indices.indices.values())
+            pct = round(du.used / du.total * 100) if du.total else 0
+            unit = params.get("bytes")
+            if unit:
+                div = {"b": 1, "kb": 1 << 10, "mb": 1 << 20,
+                       "gb": 1 << 30, "tb": 1 << 40}.get(unit, 1)
+                fmt = lambda v: int(v // div)     # noqa: E731
+            else:
+                fmt = _human_bytes
+            rows = [[shards, fmt(used), fmt(du.used), fmt(du.free),
+                     fmt(du.total), pct, "127.0.0.1",
+                     "127.0.0.1", self.node_name]]
+        return self._cat_table(rows, ["shards", "disk.indices",
+                                      "disk.used", "disk.avail",
+                                      "disk.total", "disk.percent",
+                                      "host", "ip", "node"],
+                               _flag(params, "v"), params)
+
+    def h_post_voting_exclusions(self, params, body):
+        names = params.get("node_names")
+        ids = params.get("node_ids")
+        if (names is None) == (ids is None):
+            raise IllegalArgumentError(
+                "You must set [node_names] or [node_ids] but not both")
+        for w in (names or ids).split(","):
+            if ids is not None:
+                entry = {"node_id": w,
+                         "node_name": (self.node_name
+                                       if w == self.node_id
+                                       else "_absent_")}
+            else:
+                entry = {"node_id": (self.node_id
+                                     if w == self.node_name
+                                     else "_absent_"),
+                         "node_name": w}
+            self.voting_exclusions.append(entry)
+        return 200, {}
+
+    def h_delete_voting_exclusions(self, params, body):
+        self.voting_exclusions = []
+        return 200, {}
+
+    def h_put_component_template(self, params, body, name):
+        self.component_templates[name] = _json_body(body)
+        return {"acknowledged": True}
+
+    def h_get_component_template(self, params, body, name=None):
+        items = [{"name": n, "component_template": t}
+                 for n, t in self.component_templates.items()
+                 if name is None or n == name]
+        if name is not None and not items:
+            raise ResourceNotFoundError(
+                f"component template matching [{name}] not found")
+        return {"component_templates": items}
+
+    def h_delete_component_template(self, params, body, name):
+        if self.component_templates.pop(name, None) is None:
+            raise ResourceNotFoundError(
+                f"component template [{name}] missing")
+        return {"acknowledged": True}
 
     def h_cat_aliases(self, params, body, name=None):
         import fnmatch
@@ -930,7 +1132,10 @@ class RestAPI:
                     spec.get("is_write_index", "-")])
         return self._cat_table(rows, ["alias", "index", "filter",
                                       "routing.index", "routing.search",
-                                      "is_write_index"], _flag(params, "v"), params)
+                                      "is_write_index"],
+                               _flag(params, "v"), params,
+                               aliases={"a": "alias", "i": "index",
+                                        "idx": "index"})
 
     # ------------------------------------------------------------------
     # index CRUD / admin
@@ -2873,21 +3078,32 @@ class RestAPI:
 
     SCROLL_MAX_DOCS = 500_000
 
-    def _start_scroll(self, names, search_body, keep_alive) -> dict:
+
+    def _max_keep_alive_ms(self) -> float:
         from ..common.settings import parse_time_millis
-        if keep_alive and keep_alive != "_none":
-            ka_ms = parse_time_millis(keep_alive)
-            max_ka = parse_time_millis(
-                (self.cluster_settings.get("persistent") or {}).get(
-                    "search.max_keep_alive",
-                    (self.cluster_settings.get("transient") or {}).get(
-                        "search.max_keep_alive", "24h")))
-            if ka_ms > max_ka:
-                raise IllegalArgumentError(
-                    f"Keep alive for request ({keep_alive}) is too large. "
-                    f"It must be less than ({int(max_ka // 60000)}m). This "
-                    f"limit can be set by changing the "
-                    f"[search.max_keep_alive] cluster level setting.")
+        raw = (self.cluster_settings.get("transient") or {}).get(
+            "search.max_keep_alive")
+        if raw is None:
+            raw = (self.cluster_settings.get("persistent") or {}).get(
+                "search.max_keep_alive")
+        if raw is None:
+            raw = "24h"
+        return parse_time_millis(raw)
+
+    def _check_keep_alive(self, keep_alive) -> None:
+        if not keep_alive or keep_alive == "_none":
+            return
+        from ..common.settings import parse_time_millis
+        max_ka = self._max_keep_alive_ms()
+        if parse_time_millis(keep_alive) > max_ka:
+            raise IllegalArgumentError(
+                f"Keep alive for request ({keep_alive}) is too large. It "
+                f"must be less than ({int(max_ka // 60000)}m). This limit "
+                f"can be set by changing the [search.max_keep_alive] "
+                f"cluster level setting.")
+
+    def _start_scroll(self, names, search_body, keep_alive) -> dict:
+        self._check_keep_alive(keep_alive)
         size = int(search_body.get("size", 10))
         big = dict(search_body)
         big["size"] = self.SCROLL_MAX_DOCS
@@ -2956,19 +3172,7 @@ class RestAPI:
         # body params OVERRIDE query-string/path ones (RestSearchScroll)
         sid = b.get("scroll_id") or scroll_id or params.get("scroll_id")
         ka = b.get("scroll") or params.get("scroll")
-        if ka:
-            from ..common.settings import parse_time_millis
-            max_ka = parse_time_millis(
-                (self.cluster_settings.get("persistent") or {}).get(
-                    "search.max_keep_alive",
-                    (self.cluster_settings.get("transient") or {}).get(
-                        "search.max_keep_alive", "24h")))
-            if parse_time_millis(ka) > max_ka:
-                raise IllegalArgumentError(
-                    f"Keep alive for request ({ka}) is too large. It must "
-                    f"be less than ({int(max_ka // 60000)}m). This limit "
-                    f"can be set by changing the [search.max_keep_alive] "
-                    f"cluster level setting.")
+        self._check_keep_alive(ka)
         ctx = self.scrolls.get(sid)
         if ctx is None:
             return 404, {"error": {"type": "search_context_missing_exception",
@@ -3644,6 +3848,22 @@ def _apply_filter_path(payload: dict, filter_path: str) -> dict:
 
 
 from ..search.shard_search import _as_list_ as _as_list  # noqa: E402
+
+
+def _human_bytes(n) -> str:
+    """cat-style byte sizes (ByteSizeValue): 88 → '88b', 4608 → '4.5kb'."""
+    n = float(n)
+    for unit, div in (("tb", 1 << 40), ("gb", 1 << 30), ("mb", 1 << 20),
+                      ("kb", 1 << 10)):
+        if n >= div:
+            v = n / div
+            return f"{v:.1f}{unit}".replace(".0" + unit, unit)
+    return f"{int(n)}b"
+
+
+def format_date_millis_cat(ms) -> str:
+    from ..index.mapping import format_date_millis
+    return format_date_millis(float(ms))
 
 
 def _segment_file_sizes(shards) -> Dict[str, dict]:

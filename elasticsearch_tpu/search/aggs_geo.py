@@ -35,38 +35,7 @@ from .positional import haversine_meters, parse_distance_meters
 # geo keys
 # ---------------------------------------------------------------------------
 
-_B32 = "0123456789bcdefghjkmnpqrstuvwxyz"
-
-
-def geohash_encode(lat: float, lon: float, precision: int) -> str:
-    lat_lo, lat_hi, lon_lo, lon_hi = -90.0, 90.0, -180.0, 180.0
-    out = []
-    bits = 0
-    n = 0
-    even = True
-    while len(out) < precision:
-        if even:
-            mid = (lon_lo + lon_hi) / 2
-            if lon >= mid:
-                bits = (bits << 1) | 1
-                lon_lo = mid
-            else:
-                bits <<= 1
-                lon_hi = mid
-        else:
-            mid = (lat_lo + lat_hi) / 2
-            if lat >= mid:
-                bits = (bits << 1) | 1
-                lat_lo = mid
-            else:
-                bits <<= 1
-                lat_hi = mid
-        even = not even
-        n += 1
-        if n == 5:
-            out.append(_B32[bits])
-            bits = n = 0
-    return "".join(out)
+from ..index.mapping import geohash_encode  # noqa: F401 (re-export)
 
 
 #: web-mercator latitude bound (GeoTileUtils.LATITUDE_MASK)

@@ -40,7 +40,9 @@ REFERENCE_SPEC_ROOT = "/root/reference/rest-api-spec/src/main/resources/rest-api
 #: yaml test features this runner understands
 #: node_selector is trivially satisfied on a single-node target
 SUPPORTED_FEATURES = {"headers", "allowed_warnings", "warnings",
-                      "arbitrary_key", "node_selector"}
+                      "arbitrary_key", "node_selector", "contains",
+                      "default_shards", "no_xpack",
+                      "default_shards, no_xpack"}
 
 
 class ApiRegistry:
@@ -228,8 +230,23 @@ class YamlTestRunner:
                 if not ok:
                     raise StepFailure(
                         f"{kind} {path}: {got!r} vs {expected!r}")
-            elif kind in ("transform_and_set", "contains",
-                          "close_to"):
+            elif kind == "contains":
+                ((path, expected),) = body.items()
+                got = self._lookup(state["last"], path, state)
+                expected = self._subst(expected, state)
+                hit = False
+                for item in (got if isinstance(got, list) else [got]):
+                    if item == expected or (
+                            isinstance(item, dict) and
+                            isinstance(expected, dict) and
+                            all(item.get(k) == v
+                                for k, v in expected.items())):
+                        hit = True
+                        break
+                if not hit:
+                    raise StepFailure(
+                        f"contains {path}: {expected!r} not in {got!r}")
+            elif kind in ("transform_and_set", "close_to"):
                 # rare step kinds: treat as unsupported → skip the test
                 raise StepFailure(f"unsupported step kind [{kind}]")
             else:
@@ -303,9 +320,12 @@ class YamlTestRunner:
         else:
             raw = b""
         status, _ct, out = state["api"].handle(method, path, qs, raw)
-        try:
-            resp = json.loads(out)
-        except Exception:   # noqa: BLE001 — _cat text responses
+        if isinstance(_ct, str) and "json" in _ct:
+            try:
+                resp = json.loads(out)
+            except Exception:   # noqa: BLE001
+                resp = out.decode() if isinstance(out, bytes) else out
+        else:
             resp = out.decode() if isinstance(out, bytes) else out
         if method == "HEAD":
             # HEAD responses surface as a boolean body (exists semantics)
