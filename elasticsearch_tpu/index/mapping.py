@@ -140,9 +140,12 @@ _EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
 _DATE_YMD_RE = re.compile(r"^\d{4}-\d{2}-\d{2}$")
 
 
-def parse_date_millis(value: Any, fmt: str = "strict_date_optional_time||epoch_millis") -> float:
+def parse_date_millis(value: Any, fmt: str = "strict_date_optional_time||epoch_millis",
+                      round_up: bool = False) -> float:
     """Parse a date into epoch milliseconds (UTC). Supports the reference's
-    default ``strict_date_optional_time||epoch_millis`` plus ``epoch_second``."""
+    default ``strict_date_optional_time||epoch_millis`` plus
+    ``epoch_second``. ``round_up`` resolves /unit date-math rounding to
+    the END of the unit (gt/lte range-bound semantics)."""
     if isinstance(value, bool):
         raise MapperParsingError(f"failed to parse date [{value}]")
     if isinstance(value, numbers.Number):
@@ -150,6 +153,8 @@ def parse_date_millis(value: Any, fmt: str = "strict_date_optional_time||epoch_m
             return float(value) * 1000.0
         return float(value)
     s = str(value).strip()
+    if "||" in s or s.startswith("now"):
+        return _parse_date_math(s, fmt, round_up)
     if re.fullmatch(r"-?\d+", s):
         if "epoch_second" in fmt and "epoch_millis" not in fmt:
             return float(s) * 1000.0
@@ -170,6 +175,89 @@ def parse_date_millis(value: Any, fmt: str = "strict_date_optional_time||epoch_m
         return (d - _EPOCH).total_seconds() * 1000.0
     except ValueError as e:
         raise MapperParsingError(f"failed to parse date field [{value}]") from e
+
+
+_DM_OP_RE = re.compile(r"([+\-]\d+[yMwdhHms])|(/[yMwdhHms])")
+
+
+def _parse_date_math(s: str, fmt: str, round_up: bool = False) -> float:
+    """Date-math expressions: ``<base>||<ops>`` or ``now<ops>`` where ops
+    are ±N<unit> adjustments and /<unit> floor rounding
+    (``common/time/DateMathParser`` semantics)."""
+    if s.startswith("now"):
+        base = _dt.datetime.now(_dt.timezone.utc)
+        ops = s[3:]
+    else:
+        base_s, _, ops = s.partition("||")
+        ms = parse_date_millis(base_s, fmt)
+        base = _EPOCH + _dt.timedelta(milliseconds=ms)
+    pos = 0
+    for m in _DM_OP_RE.finditer(ops):
+        if m.start() != pos:
+            raise MapperParsingError(
+                f"failed to parse date field [{s}]")
+        pos = m.end()
+        tok = m.group(0)
+        if tok.startswith("/"):
+            u = tok[1]
+            if u == "y":
+                base = base.replace(month=1, day=1, hour=0, minute=0,
+                                    second=0, microsecond=0)
+            elif u == "M":
+                base = base.replace(day=1, hour=0, minute=0, second=0,
+                                    microsecond=0)
+            elif u == "w":
+                base = (base - _dt.timedelta(days=base.weekday())).replace(
+                    hour=0, minute=0, second=0, microsecond=0)
+            elif u == "d":
+                base = base.replace(hour=0, minute=0, second=0,
+                                    microsecond=0)
+            elif u in ("h", "H"):
+                base = base.replace(minute=0, second=0, microsecond=0)
+            elif u == "m":
+                base = base.replace(second=0, microsecond=0)
+            elif u == "s":
+                base = base.replace(microsecond=0)
+        else:
+            n = int(tok[:-1])
+            u = tok[-1]
+            if u == "y":
+                base = base.replace(year=base.year + n)
+            elif u == "M":
+                total = base.year * 12 + (base.month - 1) + n
+                day = min(base.day, [31, 29 if (total // 12) % 4 == 0
+                                     else 28, 31, 30, 31, 30, 31, 31, 30,
+                                     31, 30, 31][total % 12])
+                base = base.replace(year=total // 12,
+                                    month=total % 12 + 1, day=day)
+            else:
+                delta = {"w": _dt.timedelta(weeks=n),
+                         "d": _dt.timedelta(days=n),
+                         "h": _dt.timedelta(hours=n),
+                         "H": _dt.timedelta(hours=n),
+                         "m": _dt.timedelta(minutes=n),
+                         "s": _dt.timedelta(seconds=n)}[u]
+                base = base + delta
+    if pos != len(ops):
+        raise MapperParsingError(f"failed to parse date field [{s}]")
+    ms = (base - _EPOCH).total_seconds() * 1000.0
+    if round_up and "/" in ops:
+        # end of the floored unit minus 1ms (RoundUp parsing)
+        u = ops[ops.rindex("/") + 1]
+        spans = {"s": 1000.0, "m": 60000.0, "h": 3600000.0,
+                 "H": 3600000.0, "d": 86400000.0, "w": 7 * 86400000.0}
+        if u in spans:
+            ms += spans[u] - 1
+        elif u == "M":
+            nxt = (base.year * 12 + base.month)  # base is month start
+            ms = (_dt.datetime(nxt // 12, nxt % 12 + 1, 1,
+                               tzinfo=_dt.timezone.utc)
+                  - _EPOCH).total_seconds() * 1000.0 - 1
+        elif u == "y":
+            ms = (_dt.datetime(base.year + 1, 1, 1,
+                               tzinfo=_dt.timezone.utc)
+                  - _EPOCH).total_seconds() * 1000.0 - 1
+    return ms
 
 
 def _looks_date(s: str) -> bool:
@@ -395,10 +483,10 @@ class RangeFieldType(MappedFieldType):
         self.range_kind = range_kind
         self.type_name = range_kind
 
-    def _point(self, v):
+    def _point(self, v, round_up: bool = False):
         try:
             if self.range_kind == "date_range":
-                return float(parse_date_millis(v))
+                return float(parse_date_millis(v, round_up=round_up))
             if self.range_kind == "ip_range":
                 import ipaddress
                 return float(int(ipaddress.ip_address(str(v))))

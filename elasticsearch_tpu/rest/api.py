@@ -222,8 +222,9 @@ class RestAPI:
         add("GET", "/_index_template/{name}", self.h_get_template)
         add("GET", "/_index_template", self.h_get_template)
         add("DELETE", "/_index_template/{name}", self.h_delete_template)
-        add("PUT,POST", "/_template/{name}", self.h_put_template)
-        add("GET", "/_template/{name}", self.h_get_template)
+        add("PUT,POST", "/_template/{name}", self.h_put_template_legacy)
+        add("GET", "/_template/{name}", self.h_get_template_legacy)
+        add("GET", "/_template", self.h_get_template_legacy)
         add("DELETE", "/_template/{name}", self.h_delete_template)
         # aliases
         add("POST", "/_aliases", self.h_update_aliases)
@@ -1596,6 +1597,45 @@ class RestAPI:
         for n in self.indices.resolve(index, allow_aliases=False):
             self.indices.indices[n].aliases.pop(name, None)
         return {"acknowledged": True}
+
+    def h_put_template_legacy(self, params, body, name):
+        b = _json_body(body)
+        if "index_patterns" not in b:
+            raise IllegalArgumentError("index patterns are missing")
+        if params.get("create") in ("true", "") and name in self.templates:
+            raise IllegalArgumentError(
+                f"index_template [{name}] already exists")
+        return self.h_put_template(params, body, name)
+
+    def h_get_template_legacy(self, params, body, name=None):
+        import fnmatch
+        flat = params.get("flat_settings") in ("true", "")
+        if name is None:
+            return {n: self._legacy_template_view(t, flat)
+                    for n, t in self.templates.items()}
+        matched = {n: self._legacy_template_view(t, flat)
+                   for n, t in self.templates.items()
+                   if fnmatch.fnmatchcase(n, name) or n == name}
+        if not matched and not any(c in name for c in "*,"):
+            return 404, {"error": f"index template matching [{name}] not "
+                                  f"found", "status": 404}
+        return matched
+
+    def _legacy_template_view(self, t: dict, flat_form: bool = False
+                              ) -> dict:
+        from ..node.indices_service import _flatten_settings
+        raw = _flatten_settings(dict(t.get("settings") or {}))
+        flat = {(k if k.startswith("index.") else f"index.{k}"): str(v)
+                for k, v in raw.items()}
+        out = {"order": t.get("order", 0),
+               "index_patterns": t.get("index_patterns", []),
+               "settings": flat if flat_form else self._nest_flat(flat),
+               "mappings": t.get("mappings", {}),
+               "aliases": {a: self._alias_spec(spec or {})
+                           for a, spec in (t.get("aliases") or {}).items()}}
+        if "version" in t:
+            out["version"] = t["version"]
+        return out
 
     def h_put_template(self, params, body, name):
         b = _json_body(body)

@@ -613,9 +613,12 @@ class RangeQuery(Query):
                             hi -= 1
             return _exact_numeric_mask(seg, self.field, lo, hi, self.boost)
         if isinstance(ft, RangeFieldType):
-            lo = ft._point(self.gte if self.gte is not None else self.gt) \
+            # gt/lte date bounds round UP through /unit date math
+            lo = ft._point(self.gte if self.gte is not None else self.gt,
+                           round_up=self.gte is None) \
                 if (self.gte is not None or self.gt is not None) else None
-            hi = ft._point(self.lte if self.lte is not None else self.lt) \
+            hi = ft._point(self.lte if self.lte is not None else self.lt,
+                           round_up=self.lte is not None) \
                 if (self.lte is not None or self.lt is not None) else None
             integral = ft.range_kind in ("integer_range", "long_range",
                                          "date_range", "ip_range")
@@ -638,16 +641,18 @@ class RangeQuery(Query):
             lo = self.gte if self.gte is not None else self.gt
             hi = self.lte if self.lte is not None else self.lt
 
-            def _bound(v):
+            def _bound(v, round_up=False):
                 # numeric bounds coerce through the format list (a bare
                 # 4-digit number reads as a year, DateMathParser-style)
                 if isinstance(v, (int, float)) and not isinstance(
                         v, bool) and 1000 <= v <= 9999 and \
                         float(v).is_integer():
                     v = str(int(v))
-                return parse_date_millis(v, fmt)
-            lo_v = _bound(lo) if lo is not None else None
-            hi_v = _bound(hi) if hi is not None else None
+                return parse_date_millis(v, fmt, round_up=round_up)
+            lo_v = _bound(lo, round_up=self.gte is None) \
+                if lo is not None else None
+            hi_v = _bound(hi, round_up=self.lte is not None) \
+                if hi is not None else None
             return _numeric_range_result(
                 seg, self.field, lo_v, hi_v, self.boost,
                 include_lo=self.gt is None, include_hi=self.lt is None)
@@ -1487,7 +1492,7 @@ class QueryStringQuery(Query):
 
     @staticmethod
     def _tokenize(q: str) -> List[str]:
-        out, cur, in_q = [], "", False
+        out, cur, in_q, in_rng = [], "", False, False
         for ch in q:
             if ch == '"':
                 cur += ch
@@ -1495,7 +1500,15 @@ class QueryStringQuery(Query):
                     out.append(cur)
                     cur = ""
                 in_q = not in_q
-            elif ch.isspace() and not in_q:
+            elif ch in "[{" and not in_q:
+                in_rng = True
+                cur += ch
+            elif ch in "]}" and in_rng:
+                in_rng = False
+                cur += ch
+                out.append(cur)
+                cur = ""
+            elif ch.isspace() and not in_q and not in_rng:
                 if cur:
                     out.append(cur)
                     cur = ""
@@ -1514,6 +1527,17 @@ class QueryStringQuery(Query):
             len(text) >= 2
         if phrase:
             text = text[1:-1]
+        m_range = re.match(r"^([\[{])\s*(\S+)\s+TO\s+(\S+)\s*([\]}])$",
+                           text)
+        if m_range and field:
+            open_b, lo, hi, close_b = m_range.groups()
+            kw = {}
+            if lo != "*":
+                kw["gte" if open_b == "[" else "gt"] = lo
+            if hi != "*":
+                kw["lte" if close_b == "]" else "lt"] = hi
+            return RangeQuery(field, kw.get("gte"), kw.get("gt"),
+                              kw.get("lte"), kw.get("lt"))
         regex = None
         if text.startswith("/") and text.endswith("/") and len(text) >= 2:
             regex = text[1:-1]
