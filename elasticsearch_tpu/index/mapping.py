@@ -141,7 +141,8 @@ _DATE_YMD_RE = re.compile(r"^\d{4}-\d{2}-\d{2}$")
 
 
 def parse_date_millis(value: Any, fmt: str = "strict_date_optional_time||epoch_millis",
-                      round_up: bool = False) -> float:
+                      round_up: bool = False,
+                      date_math: bool = True) -> float:
     """Parse a date into epoch milliseconds (UTC). Supports the reference's
     default ``strict_date_optional_time||epoch_millis`` plus
     ``epoch_second``. ``round_up`` resolves /unit date-math rounding to
@@ -154,6 +155,10 @@ def parse_date_millis(value: Any, fmt: str = "strict_date_optional_time||epoch_m
         return float(value)
     s = str(value).strip()
     if "||" in s or s.startswith("now"):
+        if not date_math:
+            # date math is a QUERY-side construct; document values must
+            # be concrete (nondeterministic now() would poison reindex)
+            raise MapperParsingError(f"failed to parse date field [{s}]")
         return _parse_date_math(s, fmt, round_up)
     if re.fullmatch(r"-?\d+", s):
         if "epoch_second" in fmt and "epoch_millis" not in fmt:
@@ -178,6 +183,16 @@ def parse_date_millis(value: Any, fmt: str = "strict_date_optional_time||epoch_m
 
 
 _DM_OP_RE = re.compile(r"([+\-]\d+[yMwdhHms])|(/[yMwdhHms])")
+
+
+def _add_months(base: "_dt.datetime", n: int) -> "_dt.datetime":
+    """Calendar month addition with day-of-month clamping (the
+    reference's DateMathParser clamps to the target month's last day)."""
+    import calendar
+    total = base.year * 12 + (base.month - 1) + n
+    year, month = total // 12, total % 12 + 1
+    day = min(base.day, calendar.monthrange(year, month)[1])
+    return base.replace(year=year, month=month, day=day)
 
 
 def _parse_date_math(s: str, fmt: str, round_up: bool = False) -> float:
@@ -218,18 +233,28 @@ def _parse_date_math(s: str, fmt: str, round_up: bool = False) -> float:
                 base = base.replace(second=0, microsecond=0)
             elif u == "s":
                 base = base.replace(microsecond=0)
+            if round_up:
+                # RoundUp semantics apply AT the rounding step, so later
+                # ± offsets compose on top of the end-of-unit instant
+                if u == "y":
+                    base = base.replace(year=base.year + 1)
+                elif u == "M":
+                    base = _add_months(base, 1)
+                else:
+                    base = base + {"w": _dt.timedelta(weeks=1),
+                                   "d": _dt.timedelta(days=1),
+                                   "h": _dt.timedelta(hours=1),
+                                   "H": _dt.timedelta(hours=1),
+                                   "m": _dt.timedelta(minutes=1),
+                                   "s": _dt.timedelta(seconds=1)}[u]
+                base = base - _dt.timedelta(milliseconds=1)
         else:
             n = int(tok[:-1])
             u = tok[-1]
             if u == "y":
-                base = base.replace(year=base.year + n)
+                base = _add_months(base, 12 * n)
             elif u == "M":
-                total = base.year * 12 + (base.month - 1) + n
-                day = min(base.day, [31, 29 if (total // 12) % 4 == 0
-                                     else 28, 31, 30, 31, 30, 31, 31, 30,
-                                     31, 30, 31][total % 12])
-                base = base.replace(year=total // 12,
-                                    month=total % 12 + 1, day=day)
+                base = _add_months(base, n)
             else:
                 delta = {"w": _dt.timedelta(weeks=n),
                          "d": _dt.timedelta(days=n),
@@ -240,24 +265,7 @@ def _parse_date_math(s: str, fmt: str, round_up: bool = False) -> float:
                 base = base + delta
     if pos != len(ops):
         raise MapperParsingError(f"failed to parse date field [{s}]")
-    ms = (base - _EPOCH).total_seconds() * 1000.0
-    if round_up and "/" in ops:
-        # end of the floored unit minus 1ms (RoundUp parsing)
-        u = ops[ops.rindex("/") + 1]
-        spans = {"s": 1000.0, "m": 60000.0, "h": 3600000.0,
-                 "H": 3600000.0, "d": 86400000.0, "w": 7 * 86400000.0}
-        if u in spans:
-            ms += spans[u] - 1
-        elif u == "M":
-            nxt = (base.year * 12 + base.month)  # base is month start
-            ms = (_dt.datetime(nxt // 12, nxt % 12 + 1, 1,
-                               tzinfo=_dt.timezone.utc)
-                  - _EPOCH).total_seconds() * 1000.0 - 1
-        elif u == "y":
-            ms = (_dt.datetime(base.year + 1, 1, 1,
-                               tzinfo=_dt.timezone.utc)
-                  - _EPOCH).total_seconds() * 1000.0 - 1
-    return ms
+    return (base - _EPOCH).total_seconds() * 1000.0
 
 
 def _looks_date(s: str) -> bool:
@@ -301,7 +309,7 @@ class DateFieldType(MappedFieldType):
     NANOS_MAX_MS = (1 << 63) / 1e6
 
     def parse_value(self, value):
-        ms = parse_date_millis(value, self.format)
+        ms = parse_date_millis(value, self.format, date_math=False)
         if self.nanos:
             if ms < 0:
                 e = MapperParsingError(
@@ -1068,9 +1076,11 @@ class MapperService:
                     self._index_leaf(ft, full, v, parsed)
                 except MapperParsingError:
                     # ignore_malformed drops the bad VALUE, keeps the doc
-                    # (the reference also records it in _ignored)
+                    # and records the field in the _ignored meta field
                     if not ft.params.get("ignore_malformed"):
                         raise
+                    parsed.keyword_terms.setdefault("_ignored",
+                                                    []).append(full)
 
     def _maybe_geo(self, full: str, value: dict, parsed: ParsedDocument) -> bool:
         return False  # dynamic geo detection is off, like the reference default

@@ -641,9 +641,13 @@ class RestAPI:
             if mb is not ...:
                 _aggs_mod.MAX_BUCKETS[0] = (65536 if mb is None
                                             else int(mb))
-        b = _json_body(body)
         for scope in ("persistent", "transient"):
-            self.cluster_settings[scope].update(b.get(scope) or {})
+            for k, v in (b0.get(scope) or {}).items():
+                if v is None:
+                    # null resets a setting to its default
+                    self.cluster_settings[scope].pop(k, None)
+                else:
+                    self.cluster_settings[scope][k] = v
         return {"acknowledged": True,
                 "persistent": self.cluster_settings["persistent"],
                 "transient": self.cluster_settings["transient"]}
@@ -1613,9 +1617,11 @@ class RestAPI:
         if name is None:
             return {n: self._legacy_template_view(t, flat)
                     for n, t in self.templates.items()}
+        pats = [p_.strip() for p_ in name.split(",") if p_.strip()]
         matched = {n: self._legacy_template_view(t, flat)
                    for n, t in self.templates.items()
-                   if fnmatch.fnmatchcase(n, name) or n == name}
+                   if any(fnmatch.fnmatchcase(n, p_) or n == p_
+                          for p_ in pats)}
         if not matched and not any(c in name for c in "*,"):
             return 404, {"error": f"index template matching [{name}] not "
                                   f"found", "status": 404}
@@ -2386,6 +2392,8 @@ class RestAPI:
                 out["_version"] = g.version if g.found else None
             except Exception:   # noqa: BLE001 — alias/closed edge cases
                 out["_version"] = None
+        if h.ignored:
+            out["_ignored"] = sorted(set(h.ignored))
         if h.sort_values is not None and n_sort != -1:
             out["sort"] = (h.sort_values if n_sort is None
                            else h.sort_values[:n_sort])
@@ -2793,15 +2801,62 @@ class RestAPI:
                 f" index level setting.")
         max_regex = idx_setting("index.max_regex_length", 1000)
         max_terms = idx_setting("index.max_terms_count", 65536)
+        allow_expensive = str(
+            (self.cluster_settings.get("transient") or {}).get(
+                "search.allow_expensive_queries",
+                (self.cluster_settings.get("persistent") or {}).get(
+                    "search.allow_expensive_queries",
+                    "true"))).lower() != "false"
+        expensive_kinds = {"prefix", "wildcard", "regexp", "fuzzy",
+                           "intervals", "script_score", "percolate",
+                           "distance_feature", "nested", "has_child",
+                           "has_parent"}
+        expensive_label = {"nested": "joining", "has_child": "joining",
+                           "has_parent": "joining"}
 
-        def walk_query(q):
+        #: clause kind → positions holding SUB-CLAUSES (clause-position
+        #: recursion only; field names never read as clause kinds)
+        _SUBCLAUSE_POS = {
+            "bool": ("must", "should", "must_not", "filter"),
+            "dis_max": ("queries",),
+            "constant_score": ("filter", "query"),
+            "nested": ("query",),
+            "boosting": ("positive", "negative"),
+            "function_score": ("query",),
+            "has_child": ("query",), "has_parent": ("query",),
+            "span_multi": (), "script_score": ("query",),
+        }
+
+        def walk_clause(q):
             if isinstance(q, list):
                 for item in q:
-                    walk_query(item)
+                    walk_clause(item)
                 return
             if not isinstance(q, dict):
                 return
             for k, v in q.items():
+                if not allow_expensive and k == "range" and \
+                        isinstance(v, dict) and names:
+                    from ..index.mapping import (KeywordFieldType,
+                                                 TextFieldType)
+                    mp = self.indices.indices[names[0]].mapper
+                    for fld in v:
+                        if isinstance(mp.field_type(fld),
+                                      (TextFieldType, KeywordFieldType)):
+                            raise IllegalArgumentError(
+                                f"[range] queries on [text] or [keyword] "
+                                f"fields cannot be executed when "
+                                f"'search.allow_expensive_queries' is "
+                                f"set to false.")
+                if not allow_expensive and k in expensive_kinds:
+                    extra = (" For optimised prefix queries on text "
+                             "fields please enable [index_prefixes]."
+                             if k == "prefix" else "")
+                    label = expensive_label.get(k, k)
+                    raise IllegalArgumentError(
+                        f"[{label}] queries cannot be executed when "
+                        f"'search.allow_expensive_queries' is set to "
+                        f"false.{extra}")
                 if k == "regexp" and isinstance(v, dict):
                     for spec in v.values():
                         val = spec.get("value") if isinstance(spec, dict) \
@@ -2824,9 +2879,11 @@ class RestAPI:
                                 f"This maximum can be set by changing the "
                                 f"[index.max_terms_count] index level "
                                 f"setting.")
-                walk_query(v)
+                for pos in _SUBCLAUSE_POS.get(k, ()):
+                    if isinstance(v, dict) and pos in v:
+                        walk_clause(v[pos])
 
-        walk_query(search_body.get("query"))
+        walk_clause(search_body.get("query"))
         if scroll and size is not None and int(size) == 0:
             raise IllegalArgumentError(
                 "[size] cannot be [0] in a scroll context")
@@ -3022,7 +3079,17 @@ class RestAPI:
                 search_body.get("indices_boost"):
             search_body = dict(search_body, _lenient_indices_boost=True)
         if "q" in params:
-            search_body["query"] = _lucene_qs_to_dsl(params["q"])
+            search_body["query"] = {"query_string": {
+                "query": params["q"],
+                **({"default_field": params["df"]} if "df" in params
+                   else {}),
+                **({"default_operator": params["default_operator"]}
+                   if "default_operator" in params else {}),
+                **({"analyzer": params["analyzer"]}
+                   if "analyzer" in params else {}),
+                **({"lenient": params["lenient"] == "true"}
+                   if "lenient" in params else {}),
+            }}
         for p in ("size", "from"):
             if p in params:
                 search_body[p] = int(params[p])
@@ -3082,7 +3149,9 @@ class RestAPI:
         payload = _json_body(body) if body else {}
         spec = payload.get("query")
         if spec is None and params.get("q"):
-            spec = _lucene_qs_to_dsl(params["q"])
+            spec = {"query_string": {"query": params["q"], **(
+                {"default_field": params["df"]} if "df" in params
+                else {})}}
         valid = True
         error = None
         if spec is not None:

@@ -395,6 +395,13 @@ class TermQuery(Query):
         self.field = ctx.concrete_field(self.field)
         ft = ctx.field_type(self.field)
         if ft is None:
+            # unmapped META keyword columns (_ignored, _routing) are
+            # still term-addressable
+            if self.field in seg.keyword_fields:
+                scores, matched, _ = _keyword_terms_result(
+                    ctx, seg, self.field, {str(self.value): 1.0},
+                    scored=False)
+                return scores * np.float32(self.boost), matched > 0
             return _const_result(seg, 0.0, False)
         if isinstance(ft, TextFieldType):
             scores, matched, _ = _score_text_terms(
@@ -444,6 +451,11 @@ class TermsQuery(Query):
             return IdsQuery(list(self.values), self.boost).execute(ctx, seg)
         self.field = ctx.concrete_field(self.field)
         ft = ctx.field_type(self.field)
+        if ft is None and self.field in seg.keyword_fields and self.values:
+            scores, matched, _ = _keyword_terms_result(
+                ctx, seg, self.field,
+                {str(v): 1.0 for v in self.values}, scored=False)
+            return scores * np.float32(self.boost), matched > 0
         if ft is None or not self.values:
             return _const_result(seg, 0.0, False)
         if isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType)):
@@ -637,6 +649,11 @@ class RangeQuery(Query):
                 seg, self.field, lo_v, hi_v, self.boost,
                 include_lo=self.gt is None, include_hi=self.lt is None)
         if isinstance(ft, DateFieldType):
+            cached = getattr(self, "_date_bounds", None)
+            if cached is not None:
+                return _numeric_range_result(
+                    seg, self.field, cached[0], cached[1], self.boost,
+                    include_lo=self.gt is None, include_hi=self.lt is None)
             fmt = self.date_format or ft.format
             lo = self.gte if self.gte is not None else self.gt
             hi = self.lte if self.lte is not None else self.lt
@@ -653,6 +670,8 @@ class RangeQuery(Query):
                 if lo is not None else None
             hi_v = _bound(hi, round_up=self.lte is not None) \
                 if hi is not None else None
+            # snapshot so 'now' resolves ONCE per request, not per segment
+            self._date_bounds = (lo_v, hi_v)
             return _numeric_range_result(
                 seg, self.field, lo_v, hi_v, self.boost,
                 include_lo=self.gt is None, include_hi=self.lt is None)
@@ -1473,6 +1492,24 @@ class _AllFieldsRegexpQuery(Query):
         return DisMaxQuery(subs, 0.0, self.boost).execute(ctx, seg)
 
 
+class _LenientQuery(Query):
+    """Wraps a clause so data-conversion failures mean "no match" —
+    query_string/simple_query_string lenient semantics."""
+
+    def __init__(self, inner: Query):
+        self.inner = inner
+
+    def execute(self, ctx, seg):
+        from ..common.errors import ElasticsearchError
+        try:
+            return self.inner.execute(ctx, seg)
+        except ElasticsearchError:
+            return _const_result(seg, 0.0, False)
+
+    def collect_highlight_terms(self, ctx, out):
+        self.inner.collect_highlight_terms(ctx, out)
+
+
 class QueryStringQuery(Query):
     """Lucene query-string syntax, the commonly-used subset (reference:
     ``QueryStringQueryBuilder`` wrapping the full Lucene parser):
@@ -1484,9 +1521,14 @@ class QueryStringQuery(Query):
 
     def __init__(self, query: str, fields: Optional[List[str]] = None,
                  default_operator: str = "or", boost: float = 1.0,
-                 lenient: bool = False):
+                 lenient: bool = False, data_lenient: Optional[bool] = None):
         self.boost = boost
-        self.lenient = lenient
+        self.lenient = lenient          # syntax tolerance
+        # data tolerance (conversion errors → no match); defaults to the
+        # syntax flag for plain query_string, but simple_query_string is
+        # always syntax-lenient WITHOUT being data-lenient
+        self.data_lenient = lenient if data_lenient is None \
+            else data_lenient
         self.inner = self._compile(str(query), fields or ["*"],
                                    default_operator.lower())
 
@@ -1500,8 +1542,8 @@ class QueryStringQuery(Query):
                     out.append(cur)
                     cur = ""
                 in_q = not in_q
-            elif ch in "[{" and not in_q:
-                in_rng = True
+            elif ch in "[{" and not in_q and cur.endswith(":"):
+                in_rng = True               # field:[a TO b] range syntax
                 cur += ch
             elif ch in "]}" and in_rng:
                 in_rng = False
@@ -1529,7 +1571,7 @@ class QueryStringQuery(Query):
             text = text[1:-1]
         m_range = re.match(r"^([\[{])\s*(\S+)\s+TO\s+(\S+)\s*([\]}])$",
                            text)
-        if m_range and field:
+        if m_range and field and not phrase:
             open_b, lo, hi, close_b = m_range.groups()
             kw = {}
             if lo != "*":
@@ -1604,6 +1646,8 @@ class QueryStringQuery(Query):
                     pending_op = None
                     continue            # simple_query_string never throws
                 raise
+            if self.data_lenient:
+                leaf = _LenientQuery(leaf)
             if neg:
                 must_not.append(leaf)
                 last_bucket = must_not
@@ -1638,27 +1682,64 @@ class QueryStringQuery(Query):
         self.inner.collect_highlight_terms(ctx, out)
 
 
-def _parse_match_bool_prefix(body):
+class MatchBoolPrefixQuery(Query):
     """match_bool_prefix (reference: ``MatchBoolPrefixQueryBuilder``):
-    every analyzed term as a term clause, the LAST as a prefix."""
+    every analyzed term as a term clause, the LAST as a prefix. Analysis
+    (and the optional custom analyzer / fuzziness) resolves at execute
+    time against the target field."""
+
+    def __init__(self, field: str, spec: dict, boost: float = 1.0):
+        self.field = field
+        self.spec = spec
+        self.boost = boost
+
+    def _build(self, ctx):
+        spec = self.spec
+        text = str(spec.get("query", ""))
+        operator = str(spec.get("operator", "or")).lower()
+        field = ctx.concrete_field(self.field)
+        ft = ctx.field_type(field)
+        an_name = spec.get("analyzer")
+        if an_name and ctx.mapper is not None:
+            analyzer = ctx.mapper.analysis.get(an_name)
+            terms = analyzer.terms(text)
+        elif isinstance(ft, TextFieldType):
+            terms = ft.search_analyzer.terms(text)
+        else:
+            terms = text.split()
+        fuzziness = spec.get("fuzziness")
+        clauses: List[Query] = []
+        for t in terms[:-1]:
+            if fuzziness is not None:
+                clauses.append(FuzzyQuery(field, t, fuzziness))
+            else:
+                clauses.append(TermQuery(field, t))
+        if terms:
+            clauses.append(PrefixQuery(field, terms[-1]))
+        if not clauses:
+            return MatchNoneQuery()
+        msm = spec.get("minimum_should_match")
+        if operator == "and":
+            return BoolQuery(must=clauses, boost=self.boost)
+        return BoolQuery(should=clauses,
+                         minimum_should_match=msm if msm is not None
+                         else 1, boost=self.boost)
+
+    def execute(self, ctx, seg):
+        return self._build(ctx).execute(ctx, seg)
+
+    def collect_highlight_terms(self, ctx, out):
+        self._build(ctx).collect_highlight_terms(ctx, out)
+
+
+def _parse_match_bool_prefix(body):
     if not isinstance(body, dict) or len(body) != 1:
         raise ParsingError("[match_bool_prefix] requires exactly one field")
     (field, spec), = body.items()
     if isinstance(spec, str):
         spec = {"query": spec}
-    text = str(spec.get("query", ""))
-    operator = str(spec.get("operator", "or")).lower()
-    terms = text.split()
-    clauses: List[Query] = []
-    for t in terms[:-1]:
-        clauses.append(MatchQuery(field, t))
-    if terms:
-        clauses.append(PrefixQuery(field, terms[-1].lower()))
-    if not clauses:
-        return MatchNoneQuery()
-    if operator == "and":
-        return BoolQuery(must=clauses)
-    return BoolQuery(should=clauses, minimum_should_match=1)
+    return MatchBoolPrefixQuery(field, spec,
+                                float(spec.get("boost", 1.0)))
 
 
 def _parse_query_string(body):
@@ -1668,7 +1749,8 @@ def _parse_query_string(body):
         [body["default_field"]] if body.get("default_field") else None)
     return QueryStringQuery(body["query"], fields,
                             body.get("default_operator", "or"),
-                            float(body.get("boost", 1.0)))
+                            float(body.get("boost", 1.0)),
+                            lenient=bool(body.get("lenient", False)))
 
 
 def _parse_simple_query_string(body):
@@ -1676,7 +1758,8 @@ def _parse_simple_query_string(body):
         raise ParsingError("[simple_query_string] requires [query]")
     return QueryStringQuery(body["query"], body.get("fields"),
                             body.get("default_operator", "or"),
-                            float(body.get("boost", 1.0)), lenient=True)
+                            float(body.get("boost", 1.0)), lenient=True,
+                            data_lenient=bool(body.get("lenient", False)))
 
 
 def _parse_nested(body):
@@ -1693,16 +1776,18 @@ def _parse_multi_match(body):
         raise IllegalArgumentError(
             "[slop] not allowed for type [bool_prefix]")
     if mtype == "bool_prefix":
-        from .query_dsl import _parse_match_bool_prefix   # self module
         queries = []
         for f in body.get("fields") or []:
+            fboost = 1.0
             if "^" in f:
-                f = f.partition("^")[0]
-            queries.append(_parse_match_bool_prefix(
-                {f: {"query": body.get("query"),
-                     "minimum_should_match":
-                         body.get("minimum_should_match"),
-                     "fuzziness": body.get("fuzziness")}}))
+                f, _, b_ = f.partition("^")
+                fboost = float(b_)
+            spec = {"query": body.get("query"), "boost": fboost}
+            for opt in ("minimum_should_match", "fuzziness", "analyzer",
+                        "operator"):
+                if body.get(opt) is not None:
+                    spec[opt] = body[opt]
+            queries.append(MatchBoolPrefixQuery(f, spec, fboost))
         if not queries:
             return MatchNoneQuery()
         return DisMaxQuery(queries, float(body.get("tie_breaker", 0.0)),

@@ -58,6 +58,7 @@ class ShardHit:
     seq_no: Optional[int] = None
     fields: Optional[Dict[str, List[Any]]] = None
     highlight: Optional[Dict[str, List[str]]] = None
+    ignored: Optional[List[str]] = None
 
 
 @dataclass
@@ -453,6 +454,14 @@ class ShardSearcher:
                 doc_id=seg.doc_uids[d], score=score, seg_idx=seg_idx,
                 local_doc=d, source=filter_source(src, source_spec),
                 sort_values=sort_values, seq_no=int(seg.seq_nos[d]))
+            ign = seg.keyword_fields.get("_ignored")
+            if ign is not None and ign.dv_docs_host.size:
+                # dv pairs are doc-sorted: O(log M) slice per hit
+                lo_i = int(np.searchsorted(ign.dv_docs_host, d, "left"))
+                hi_i = int(np.searchsorted(ign.dv_docs_host, d, "right"))
+                if hi_i > lo_i:
+                    hit.ignored = [ign.ord_terms[o] for o in
+                                   ign.dv_ords_host[lo_i:hi_i]]
             if dv_specs:
                 hit.fields = docvalue_fields(seg, self.mapper, d, dv_specs)
             if field_specs:
