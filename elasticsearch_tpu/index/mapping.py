@@ -832,6 +832,9 @@ class MapperService:
     def merge(self, mappings: dict) -> None:
         if not isinstance(mappings, dict):
             raise MapperParsingError("mapping must be an object")
+        if "_doc" in mappings:
+            raise IllegalArgumentError(
+                "Types cannot be provided in put mapping requests")
         if "dynamic" in mappings:
             self.dynamic = mappings["dynamic"]
         if "_source" in mappings:
@@ -982,6 +985,9 @@ class MapperService:
         self._mapping_def = mapping_def
 
     def mapping_dict(self) -> dict:
+        if not self._mapping_def.get("properties") and \
+                len(self._mapping_def) == 1:
+            return {}               # a bare empty mapping serializes as {}
         return self._mapping_def
 
     def field_type(self, name: str) -> Optional[MappedFieldType]:

@@ -275,7 +275,7 @@ class ClusterNode:
 
         def update(state: ClusterState) -> ClusterState:
             if name not in state.metadata["indices"]:
-                raise IndexNotFoundError(f"no such index [{name}]")
+                raise IndexNotFoundError(name)
             new = state.updated()
             del new.metadata["indices"][name]
             new.data["routing"].pop(name, None)
@@ -506,7 +506,7 @@ class ClusterNode:
     def _index_meta(self, index: str) -> Tuple[dict, dict]:
         st = self.applied_state
         if st is None or index not in st.metadata["indices"]:
-            raise IndexNotFoundError(f"no such index [{index}]")
+            raise IndexNotFoundError(index)
         return (st.metadata["indices"][index],
                 st.data.get("routing", {}).get(index, {}))
 

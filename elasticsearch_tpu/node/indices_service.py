@@ -384,7 +384,7 @@ class IndicesService:
                             matched.extend(names)
                 out.extend(sorted(set(matched)))
             else:
-                raise IndexNotFoundError(f"no such index [{part}]")
+                raise IndexNotFoundError(part)
         seen = set()
         uniq = []
         for n in out:

@@ -50,8 +50,15 @@ def _error_payload(e: Exception) -> Tuple[int, dict]:
         reason = str(e)
     else:
         status, etype, reason = 500, "exception", str(e)
-    err = {"root_cause": [{"type": etype, "reason": reason}],
-           "type": etype, "reason": reason}
+    rc = {"type": etype, "reason": reason}
+    idx = getattr(e, "index", None)
+    if idx is not None:
+        rc["index"] = idx
+        rc["resource.type"] = "index_or_alias"
+        rc["resource.id"] = idx
+    err = {"root_cause": [rc], "type": etype, "reason": reason}
+    if idx is not None:
+        err["index"] = idx
     caused_by = getattr(e, "caused_by", None)
     if caused_by:
         err["caused_by"] = caused_by
@@ -142,6 +149,8 @@ class RestAPI:
         add("GET", "/_nodes/{node_id}", self.h_nodes)
         add("GET", "/_nodes/{node_id}/{metric}", self.h_nodes)
         # cat
+        add("GET,POST", "/_msearch", self.h_msearch)
+        add("GET,POST", "/{index}/_msearch", self.h_msearch)
         add("GET", "/_cat/shards/{index}", self.h_cat_shards)
         add("GET", "/_cat/indices", self.h_cat_indices)
         add("GET", "/_cat/indices/{index}", self.h_cat_indices)
@@ -404,7 +413,7 @@ class RestAPI:
             names = self.indices.resolve(index)
         if not names and index and \
                 params.get("allow_no_indices") == "false":
-            raise IndexNotFoundError(f"no such index [{index}]")
+            raise IndexNotFoundError(index)
         ew = params.get("expand_wildcards", "open")
         if index and any(c in index for c in "*,") or index == "_all":
             if "closed" not in ew and "all" not in ew:
@@ -1157,11 +1166,28 @@ class RestAPI:
                     break
         merged_settings: dict = {}
         merged_mappings: dict = {}
+
+        def _deep_props(dst: dict, src: dict) -> None:
+            for k, v in (src or {}).items():
+                if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                    _deep_props(dst[k], v)
+                else:
+                    dst[k] = v
+
+        merged_aliases: dict = {}
         for _, _, t in sorted(matching, key=lambda x: x[0]):
-            tpl = t.get("template", t)
-            merged_settings.update(tpl.get("settings") or {})
-            props = (tpl.get("mappings") or {}).get("properties") or {}
-            merged_mappings.setdefault("properties", {}).update(props)
+            layers = []
+            for comp in t.get("composed_of", []):
+                ct = (self.component_templates.get(comp) or {})
+                layers.append(ct.get("template") or {})
+            layers.append(t.get("template", t))
+            for tpl in layers:
+                merged_settings.update(tpl.get("settings") or {})
+                props = (tpl.get("mappings") or {}).get("properties") or {}
+                _deep_props(merged_mappings.setdefault("properties", {}),
+                            props)
+                merged_aliases.update(tpl.get("aliases") or {})
+        self._template_aliases_out = merged_aliases
         merged_settings.update(settings or {})
         if mappings:
             merged_mappings.setdefault("properties", {}).update(
@@ -1175,8 +1201,10 @@ class RestAPI:
         b = _json_body(body)
         settings, mappings = self._apply_templates(
             index, b.get("settings") or {}, b.get("mappings") or {})
+        aliases = dict(getattr(self, "_template_aliases_out", {}) or {})
+        aliases.update(b.get("aliases") or {})
         self.indices.create_index(index, settings, mappings,
-                                  b.get("aliases"))
+                                  aliases or None)
         return {"acknowledged": True, "shards_acknowledged": True,
                 "index": index}
 
@@ -1199,11 +1227,26 @@ class RestAPI:
                     "provided_name": name}},
             }
         if not out:
-            raise IndexNotFoundError(f"no such index [{index}]")
+            raise IndexNotFoundError(index)
         return out
 
     def h_mapping(self, params, body, index=None):
-        names = self.indices.resolve(index)
+        ew = params.get("expand_wildcards")
+        if index is not None and \
+                params.get("ignore_unavailable") in ("true", ""):
+            names = []
+            for part in index.split(","):
+                try:
+                    names.extend(self.indices.resolve(part))
+                except IndexNotFoundError:
+                    pass
+        else:
+            names = self.indices.resolve(index)
+        if ew == "none" and index and any(c in index for c in "*"):
+            names = []
+        if not names and index and \
+                params.get("allow_no_indices") == "false":
+            raise IndexNotFoundError(index)
         if params.get("__method") == "PUT" or body:
             b = _json_body(body)
             for n in names:
@@ -1294,7 +1337,7 @@ class RestAPI:
         names = self.indices.resolve(index)
         if index is not None and not names and \
                 not any(c in index for c in "*,"):
-            raise IndexNotFoundError(f"no such index [{index}]")
+            raise IndexNotFoundError(index)
         import fnmatch
         pats = None
         if name is not None and name not in ("_all", "*"):
@@ -1645,6 +1688,9 @@ class RestAPI:
 
     def h_put_template(self, params, body, name):
         b = _json_body(body)
+        if params.get("create") in ("true", "") and name in self.templates:
+            raise IllegalArgumentError(
+                f"index template [{name}] already exists")
         if "index_patterns" not in b:
             raise IllegalArgumentError(
                 "index template requires [index_patterns]")
@@ -1653,10 +1699,30 @@ class RestAPI:
         self.templates[name] = b
         return {"acknowledged": True}
 
+    def _composable_template_view(self, t: dict) -> dict:
+        out = dict(t)
+        tpl = t.get("template")
+        if isinstance(tpl, dict):
+            new_tpl = dict(tpl)
+            if tpl.get("settings"):
+                from ..node.indices_service import _flatten_settings
+                flat = {(k if k.startswith("index.")
+                         else f"index.{k}"): str(v)
+                        for k, v in _flatten_settings(
+                            dict(tpl["settings"])).items()}
+                new_tpl["settings"] = self._nest_flat(flat)
+            if tpl.get("aliases"):
+                new_tpl["aliases"] = {
+                    a: self._alias_spec(spec or {})
+                    for a, spec in tpl["aliases"].items()}
+            out = dict(t, template=new_tpl)
+        return out
+
     def h_get_template(self, params, body, name=None):
         if name is None:
             return {"index_templates": [
-                {"name": n, "index_template": t}
+                {"name": n,
+                 "index_template": self._composable_template_view(t)}
                 for n, t in self.templates.items()]}
         import fnmatch
         matched = {n: t for n, t in self.templates.items()
@@ -1664,8 +1730,9 @@ class RestAPI:
         if not matched:
             return 404, {"error": f"index template matching [{name}] not "
                                   f"found", "status": 404}
-        return {"index_templates": [{"name": n, "index_template": t}
-                                    for n, t in matched.items()]}
+        return {"index_templates": [
+            {"name": n, "index_template": self._composable_template_view(t)}
+            for n, t in matched.items()]}
 
     def h_delete_template(self, params, body, name):
         if name not in self.templates:
@@ -2082,7 +2149,10 @@ class RestAPI:
             return self.indices.get(index)
         except IndexNotFoundError:
             settings, mappings = self._apply_templates(index, {}, {})
-            return self.indices.create_index(index, settings, mappings)
+            aliases = dict(getattr(self, "_template_aliases_out", {})
+                           or {})
+            return self.indices.create_index(index, settings, mappings,
+                                             aliases or None)
 
     # ------------------------------------------------------------------
     # bulk
@@ -2535,7 +2605,7 @@ class RestAPI:
                             or pat in self.indices.indices[n].aliases]
                 if not resolved and not search_body.get(
                         "_lenient_indices_boost"):
-                    raise IndexNotFoundError(f"no such index [{pat}]")
+                    raise IndexNotFoundError(pat)
                 for n in resolved:         # first matching entry wins
                     boost_of.setdefault(n, float(b))
             for n, h in all_hits:
@@ -2857,6 +2927,20 @@ class RestAPI:
                         f"[{label}] queries cannot be executed when "
                         f"'search.allow_expensive_queries' is set to "
                         f"false.{extra}")
+                for pos in _SUBCLAUSE_POS.get(k, ()):
+                    if isinstance(v, dict) and pos in v:
+                        walk_clause(v[pos])
+
+        def walk_limits(q):
+            # regex/terms size limits recurse EVERYWHERE (field names
+            # can't collide with these checks — they inspect values)
+            if isinstance(q, list):
+                for item in q:
+                    walk_limits(item)
+                return
+            if not isinstance(q, dict):
+                return
+            for k, v in q.items():
                 if k == "regexp" and isinstance(v, dict):
                     for spec in v.values():
                         val = spec.get("value") if isinstance(spec, dict) \
@@ -2867,11 +2951,12 @@ class RestAPI:
                                 f"used in the Regexp Query request has "
                                 f"exceeded the allowed maximum of "
                                 f"[{max_regex}]. This maximum can be set "
-                                f"by changing the [index.max_regex_length] "
-                                f"index level setting.")
+                                f"by changing the [index.max_regex_length]"
+                                f" index level setting.")
                 if k == "terms" and isinstance(v, dict):
                     for vals in v.values():
-                        if isinstance(vals, list) and len(vals) > max_terms:
+                        if isinstance(vals, list) and \
+                                len(vals) > max_terms:
                             raise IllegalArgumentError(
                                 f"The number of terms [{len(vals)}] used "
                                 f"in the Terms Query request has exceeded "
@@ -2879,11 +2964,10 @@ class RestAPI:
                                 f"This maximum can be set by changing the "
                                 f"[index.max_terms_count] index level "
                                 f"setting.")
-                for pos in _SUBCLAUSE_POS.get(k, ()):
-                    if isinstance(v, dict) and pos in v:
-                        walk_clause(v[pos])
+                walk_limits(v)
 
         walk_clause(search_body.get("query"))
+        walk_limits(search_body.get("query"))
         if scroll and size is not None and int(size) == 0:
             raise IllegalArgumentError(
                 "[size] cannot be [0] in a scroll context")
@@ -2972,7 +3056,7 @@ class RestAPI:
         names = [n for n in names if not self.indices.indices[n].closed]
         if not names and index and \
                 params.get("allow_no_indices") == "false":
-            raise IndexNotFoundError(f"no such index [{index}]")
+            raise IndexNotFoundError(index)
         return names
 
     def _typed_prefix(self, kind: str, body: dict, mapper) -> str:
@@ -3030,6 +3114,52 @@ class RestAPI:
                     self._apply_typed_keys(sub_spec, val, mapper)
             node[f"{self._typed_prefix(kind, body[kind], mapper)}#{name}"] \
                 = val
+
+    def h_msearch(self, params, body, index=None):
+        """Multi-search (reference: ``TransportMultiSearchAction``):
+        NDJSON header/body pairs, each executed like an independent
+        search; failures surface per-response with their status."""
+        lines = [ln for ln in body.split(b"\n")]
+        responses = []
+        i = 0
+        t0 = time.time()
+        while i < len(lines):
+            raw = lines[i].strip()
+            i += 1
+            if not raw:
+                continue
+            try:
+                header = json.loads(raw)
+            except json.JSONDecodeError as e:
+                raise ParsingError(
+                    f"Malformed msearch header line: {e}")
+            if i >= len(lines):
+                raise IllegalArgumentError("msearch body truncated")
+            search_body_raw = lines[i]
+            i += 1
+            idx = header.get("index", index)
+            if isinstance(idx, list):
+                idx = ",".join(idx)
+            sub_params = dict(params)
+            for hk in ("preference", "routing", "search_type",
+                       "ignore_unavailable", "expand_wildcards",
+                       "allow_no_indices"):
+                if hk in header:
+                    v = header[hk]
+                    sub_params[hk] = (str(v).lower()
+                                      if isinstance(v, bool) else str(v))
+            try:
+                r = self.h_search(sub_params, search_body_raw, idx)
+                status, payload = r if isinstance(r, tuple) else (200, r)
+                payload = dict(payload, status=status)
+            except Exception as e:   # noqa: BLE001 — per-item failure
+                if "rest_total_hits_as_int" in str(e):
+                    raise            # request-level validation, not item
+                status, err = _error_payload(e)
+                payload = dict(err, status=status)
+            responses.append(payload)
+        return {"took": int((time.time() - t0) * 1000),
+                "responses": responses}
 
     def h_search(self, params, body, index=None):
         names = self._resolve_search_indices(index, params)
@@ -3749,22 +3879,6 @@ def _apply_update_script(src: dict, source: str, params: dict) -> dict:
             val = src.get(field, 0) + val
         src[field] = val
     return src
-
-
-def _lucene_qs_to_dsl(q: str) -> dict:
-    """Tiny subset of the Lucene query-string syntax for ``?q=``:
-    ``field:value`` pairs and bare terms (reference: full parser in
-    ``index/query/QueryStringQueryBuilder``)."""
-    clauses = []
-    for part in q.split():
-        if ":" in part:
-            f, _, v = part.partition(":")
-            clauses.append({"match": {f: v}})
-        else:
-            clauses.append({"multi_match": {"query": part, "fields": ["*"]}})
-    if len(clauses) == 1:
-        return clauses[0]
-    return {"bool": {"must": clauses}}
 
 
 def _sort_is_score(sort_spec) -> bool:

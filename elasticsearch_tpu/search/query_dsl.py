@@ -428,7 +428,12 @@ class TermQuery(Query):
             p = ft._point(self.value)      # point containment
             return _range_field_result(seg, self.field, p, p,
                                        "intersects", self.boost)
-        if isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType)):
+        if isinstance(ft, DateFieldType):
+            # query-side values may use date math (now/d etc.)
+            val = parse_date_millis(self.value, ft.format)
+            return _numeric_range_result(seg, self.field, val, val,
+                                         self.boost)
+        if isinstance(ft, (NumberFieldType, BooleanFieldType)):
             val = ft.parse_value(self.value)
             return _numeric_range_result(seg, self.field, val, val, self.boost)
         return _const_result(seg, 0.0, False)
@@ -461,7 +466,8 @@ class TermsQuery(Query):
         if isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType)):
             mask = jnp.zeros(seg.n_pad, jnp.bool_)
             for v in self.values:
-                val = ft.parse_value(v)
+                val = parse_date_millis(v, ft.format) \
+                    if isinstance(ft, DateFieldType) else ft.parse_value(v)
                 _, m = _numeric_range_result(seg, self.field, val, val, 1.0)
                 mask = mask | m
             return jnp.where(mask, np.float32(self.boost), 0.0), mask
@@ -649,12 +655,13 @@ class RangeQuery(Query):
                 seg, self.field, lo_v, hi_v, self.boost,
                 include_lo=self.gt is None, include_hi=self.lt is None)
         if isinstance(ft, DateFieldType):
-            cached = getattr(self, "_date_bounds", None)
+            fmt = self.date_format or ft.format
+            cached = getattr(self, "_date_bounds", {}).get(fmt) \
+                if hasattr(self, "_date_bounds") else None
             if cached is not None:
                 return _numeric_range_result(
                     seg, self.field, cached[0], cached[1], self.boost,
                     include_lo=self.gt is None, include_hi=self.lt is None)
-            fmt = self.date_format or ft.format
             lo = self.gte if self.gte is not None else self.gt
             hi = self.lte if self.lte is not None else self.lt
 
@@ -670,8 +677,11 @@ class RangeQuery(Query):
                 if lo is not None else None
             hi_v = _bound(hi, round_up=self.lte is not None) \
                 if hi is not None else None
-            # snapshot so 'now' resolves ONCE per request, not per segment
-            self._date_bounds = (lo_v, hi_v)
+            # snapshot so 'now' resolves ONCE per request, not per
+            # segment (keyed by format — indexes may map it differently)
+            if not hasattr(self, "_date_bounds"):
+                self._date_bounds = {}
+            self._date_bounds[fmt] = (lo_v, hi_v)
             return _numeric_range_result(
                 seg, self.field, lo_v, hi_v, self.boost,
                 include_lo=self.gt is None, include_hi=self.lt is None)
@@ -1780,8 +1790,12 @@ def _parse_multi_match(body):
         for f in body.get("fields") or []:
             fboost = 1.0
             if "^" in f:
-                f, _, b_ = f.partition("^")
-                fboost = float(b_)
+                head, _, b_ = f.partition("^")
+                try:
+                    fboost = float(b_)
+                    f = head
+                except ValueError:
+                    pass            # literal ^ in the field name
             spec = {"query": body.get("query"), "boost": fboost}
             for opt in ("minimum_should_match", "fuzziness", "analyzer",
                         "operator"):
