@@ -62,6 +62,7 @@ class IndexService:
         self.creation_date = int(time.time() * 1000)
         self.uuid = f"{abs(hash((name, self.creation_date))):022x}"[:22]
         self.mapper = MapperService(mappings or {})
+        self.mapper.index_name = name       # hit rendering (_index)
         try:
             self.mapper.nested_limit = int(self.settings.get(
                 "index.mapping.nested_objects.limit", 10000))
@@ -84,6 +85,9 @@ class IndexService:
         self.search_stats: Dict[str, object] = {
             "query_total": 0, "fetch_total": 0, "scroll_total": 0,
             "suggest_total": 0, "groups": {}}
+        # shard request cache counters (no actual cache behind them yet:
+        # every cacheable request counts as a miss, like a cold cache)
+        self.request_cache_stats = {"hit_count": 0, "miss_count": 0}
 
     def record_search(self, groups: Optional[List[str]] = None) -> None:
         self.search_stats["query_total"] += 1
@@ -252,6 +256,7 @@ class IndexService:
         fd, comp = self.field_bytes() if with_field_bytes else ({}, {})
         ss = self.search_stats
         out = empty_index_stats()
+        out["request_cache"].update(self.request_cache_stats)
         out["docs"].update(count=docs, deleted=deleted)
         out["store"].update(size_in_bytes=store,
                             total_data_set_size_in_bytes=store)

@@ -1156,7 +1156,7 @@ class RestAPI:
     # ------------------------------------------------------------------
 
     def _apply_templates(self, name: str, settings: dict,
-                         mappings: dict) -> Tuple[dict, dict]:
+                         mappings: dict) -> Tuple[dict, dict, dict]:
         import fnmatch
         matching = []
         for tname, t in self.templates.items():
@@ -1187,7 +1187,6 @@ class RestAPI:
                 _deep_props(merged_mappings.setdefault("properties", {}),
                             props)
                 merged_aliases.update(tpl.get("aliases") or {})
-        self._template_aliases_out = merged_aliases
         merged_settings.update(settings or {})
         if mappings:
             merged_mappings.setdefault("properties", {}).update(
@@ -1195,13 +1194,13 @@ class RestAPI:
             for k, v in mappings.items():
                 if k != "properties":
                     merged_mappings[k] = v
-        return merged_settings, merged_mappings
+        return merged_settings, merged_mappings, merged_aliases
 
     def h_create_index(self, params, body, index):
         b = _json_body(body)
-        settings, mappings = self._apply_templates(
+        settings, mappings, aliases = self._apply_templates(
             index, b.get("settings") or {}, b.get("mappings") or {})
-        aliases = dict(getattr(self, "_template_aliases_out", {}) or {})
+        aliases = dict(aliases)
         aliases.update(b.get("aliases") or {})
         self.indices.create_index(index, settings, mappings,
                                   aliases or None)
@@ -2148,9 +2147,8 @@ class RestAPI:
         try:
             return self.indices.get(index)
         except IndexNotFoundError:
-            settings, mappings = self._apply_templates(index, {}, {})
-            aliases = dict(getattr(self, "_template_aliases_out", {})
-                           or {})
+            settings, mappings, aliases = self._apply_templates(
+                index, {}, {})
             return self.indices.create_index(index, settings, mappings,
                                              aliases or None)
 
@@ -3153,7 +3151,7 @@ class RestAPI:
                 status, payload = r if isinstance(r, tuple) else (200, r)
                 payload = dict(payload, status=status)
             except Exception as e:   # noqa: BLE001 — per-item failure
-                if "rest_total_hits_as_int" in str(e):
+                if getattr(e, "request_level", False):
                     raise            # request-level validation, not item
                 status, err = _error_payload(e)
                 payload = dict(err, status=status)
@@ -3197,14 +3195,24 @@ class RestAPI:
         self._rewrite_terms_lookup(search_body)
         self._validate_search(search_body, params, names,
                               scroll=bool(params.get("scroll")))
+        if params.get("request_cache") in ("true", ""):
+            # no cache yet — every cacheable request is a cold miss
+            # (counted pre-execution, so a request that later fails at
+            # execute time still registers; acceptable approximation)
+            for n in names:
+                svc = self.indices.indices.get(n)
+                if svc is not None:
+                    svc.request_cache_stats["miss_count"] += 1
         if params.get("rest_total_hits_as_int") in ("true", "") and \
                 isinstance(search_body.get("track_total_hits"), int) and \
                 not isinstance(search_body.get("track_total_hits"), bool) \
                 and search_body.get("track_total_hits") != -1:
-            raise IllegalArgumentError(
+            e = IllegalArgumentError(
                 "[rest_total_hits_as_int] cannot be used if the tracking "
                 "of total hits is not accurate, got "
                 f"{search_body['track_total_hits']}")
+            e.request_level = True      # msearch fails the whole request
+            raise e
         if params.get("ignore_unavailable") in ("true", "") and \
                 search_body.get("indices_boost"):
             search_body = dict(search_body, _lenient_indices_boost=True)

@@ -44,14 +44,14 @@ INT_TYPES = {"long", "integer", "short", "byte"}
 
 
 def _mix64(v: int) -> int:
-    """murmur3 fmix64 (BitMixer.mix64) — numeric terms partitioning."""
-    v &= (1 << 64) - 1
-    v ^= v >> 33
-    v = (v * 0xFF51AFD7ED558CCD) & ((1 << 64) - 1)
-    v ^= v >> 33
-    v = (v * 0xC4CEB9FE1A85EC53) & ((1 << 64) - 1)
-    v ^= v >> 33
-    return v
+    """hppc ``BitMixer.mix64`` (Stafford mix13 variant, NOT murmur
+    fmix64) — the hash behind numeric terms partitioning
+    (``IncludeExclude.PartitionedLongFilter``)."""
+    m = (1 << 64) - 1
+    v &= m
+    v = ((v ^ (v >> 32)) * 0x4CD6944C5CC20B6D) & m
+    v = ((v ^ (v >> 29)) * 0xFC12C5B19D3259E9) & m
+    return v ^ (v >> 32)
 
 
 def _device_mask(seg, mask: np.ndarray):
@@ -588,6 +588,33 @@ class CardinalityAgg(Aggregator):
         return {"value": len(u)}
 
 
+def _hdr_quantize(chosen: np.ndarray, allv: np.ndarray,
+                  digits: int) -> np.ndarray:
+    """HdrHistogram DoubleHistogram value quantization. The double→long
+    conversion ratio auto-ranges so the smallest nonzero magnitude lands
+    in [subBucketHalfCount, subBucketCount); a stored long's reported
+    value is the highest long mapping to the same bucket slot
+    (``highestEquivalentValue``), scaled back to double space."""
+    import math
+    sub_bucket_count = 1 << math.ceil(math.log2(2 * 10 ** digits))
+    half_bl = (sub_bucket_count // 2).bit_length()
+    pos = allv[allv > 0]
+    if pos.size == 0:
+        return chosen
+    vmin = float(pos.min())
+    k = (half_bl - 1) - math.floor(math.log2(vmin))
+    ratio = 2.0 ** k
+    out = []
+    for x in chosen.tolist():
+        if x <= 0:
+            out.append(x)
+            continue
+        sv = int(x * ratio)
+        unit = 1 << max(0, sv.bit_length() - half_bl)
+        out.append(((sv // unit) * unit + unit - 1) / ratio)
+    return np.asarray(out)
+
+
 class PercentilesAgg(_NumericMetricAgg):
     """Exact percentiles via full value collection (the reference
     approximates with TDigest — ``metrics/TDigestState``; exact is
@@ -614,25 +641,33 @@ class PercentilesAgg(_NumericMetricAgg):
                 f"Found [{float(compression)}]")
         hdr = body.get("hdr")
         self.hdr = hdr is not None
+        self.hdr_digits = 3
         if hdr:
             digits = hdr.get("number_of_significant_value_digits", 3)
-            if not (0 <= int(digits) <= 5):
+            if digits is None or not (0 <= int(digits) <= 5):
                 raise IllegalArgumentError(
                     "[numberOfSignificantValueDigits] must be between 0 "
                     "and 5")
+            self.hdr_digits = int(digits)
 
     def collect(self, ctx, seg, mask):
         return {"values": self._matched_values(ctx, seg, mask)}
 
     def _quantiles(self, allv: np.ndarray):
         if self.hdr:
-            # HDR semantics: the recorded value at ceil(q·n) rank —
-            # lowest-discernible, no interpolation
+            # HDR semantics: the recorded value at ceil(q·n) rank, then
+            # quantized to the top of its histogram bucket
+            # (``DoubleHistogram.getValueAtPercentile`` returns
+            # highestEquivalentValue — conformance asserts the exact
+            # quantized doubles, e.g. 51 → 51.0302734375)
             v = np.sort(allv)
+            # countAtPercentile = max(round(p/100·n), 1) — the +0.5
+            # floor rounding in Histogram.getValueAtPercentile
             idx = np.maximum(
-                np.ceil(np.asarray(self.percents) / 100.0 * v.size)
-                .astype(int) - 1, 0)
-            return v[np.minimum(idx, v.size - 1)]
+                (np.asarray(self.percents) / 100.0 * v.size + 0.5)
+                .astype(int), 1) - 1
+            chosen = v[np.minimum(idx, v.size - 1)]
+            return _hdr_quantize(chosen, allv, self.hdr_digits)
         # Hazen interpolation (q·n − ½): what the reference's TDigest
         # converges to on exactly-held data — its tiny-shard unit
         # expectations (values.1\.0 == min, midpoints between points)
@@ -736,13 +771,76 @@ class MedianAbsoluteDeviationAgg(_NumericMetricAgg):
 
 
 class TopHitsAgg(Aggregator):
-    """Per-bucket top hits by query score (reference:
-    ``metrics/TopHitsAggregator.java``). Needs the per-segment scores, which
-    travel in the context."""
+    """Per-bucket top hits (reference: ``metrics/TopHitsAggregator.java``).
+    Scores travel in the context; ``sort`` overrides them. Inside a
+    ``nested`` agg the mask selects CHILD rows, which render as root hits
+    with ``_nested`` coordinates; a sort on a nested field from root
+    space rolls child doc values up to the parent (mode min)."""
 
     def __init__(self, body):
         self.size = int(body.get("size", 3))
+        self.from_ = int(body.get("from", 0))
         self.source = body.get("_source", True)
+        self.seq_no_primary_term = bool(body.get("seq_no_primary_term",
+                                                 False))
+        self._sorts = []                 # (field, desc?, nested_path)
+        sort = body.get("sort")
+        if isinstance(sort, (str, dict)):
+            sort = [sort]
+        for item in sort or []:
+            if isinstance(item, str):
+                self._sorts.append((item, item == "_score", None))
+            elif isinstance(item, dict):
+                for f, spec in item.items():
+                    if isinstance(spec, str):
+                        self._sorts.append((f, spec == "desc", None))
+                    else:
+                        spec = spec or {}
+                        self._sorts.append(
+                            (f, spec.get("order") == "desc",
+                             (spec.get("nested") or {}).get("path")))
+
+    def _sort_vals(self, ctx, seg, field, desc):
+        """row → value for one sort field, using the ES default sort
+        mode (min for asc, max for desc); values on child rows also
+        roll up to their parent for root-space sorting."""
+
+        def better(a, b):
+            return a > b if desc else a < b
+
+        kw = _keyword_pairs(seg, field)
+        direct: Dict[int, Any] = {}
+        if kw is not None:
+            pdocs, ords, terms = kw
+            for d, o in zip(pdocs.tolist(), ords.tolist()):
+                v = terms[o]
+                if d not in direct or better(v, direct[d]):
+                    direct[d] = v
+        else:
+            num = _numeric_pairs(seg, field, ctx.mapper)
+            if num is not None:
+                pdocs, nvals = num
+                for d, v in zip(pdocs.tolist(), nvals.tolist()):
+                    if d not in direct or better(v, direct[d]):
+                        direct[d] = v
+        rolled: Dict[int, Any] = {}
+        for d, v in direct.items():
+            r = int(seg.parent_of[d])
+            if r != d and (r not in rolled or better(v, rolled[r])):
+                rolled[r] = v
+        return direct, rolled
+
+    def _nested_coords(self, seg, d):
+        """(path, offset, root) for a child row, or None for a root."""
+        root = int(seg.parent_of[d])
+        if root == d:
+            return None
+        for path, pm in seg.nested_paths.items():
+            if pm[d]:
+                siblings = np.flatnonzero(
+                    pm & (seg.parent_of[: seg.n_docs] == root))
+                return path, int(np.searchsorted(siblings, d)), root
+        return None
 
     def collect(self, ctx, seg, mask):
         scores = getattr(ctx, "seg_scores", {}).get(seg.seg_id)
@@ -753,23 +851,84 @@ class TopHitsAgg(Aggregator):
             sc = scores[docs]
         else:
             sc = np.ones(docs.size, np.float32)
-        order = np.lexsort((docs, -sc))[: self.size]
+        rows = list(range(docs.size))
+        sort_keys: Dict[Tuple[int, int], Any] = {}
+        if self._sorts:
+            for li, (field, desc, _np_) in enumerate(self._sorts):
+                if field == "_score":
+                    for i in rows:
+                        sort_keys[(li, i)] = float(sc[i])
+                    continue
+                direct, rolled = self._sort_vals(ctx, seg, field, desc)
+                for i in rows:
+                    d = int(docs[i])
+                    sort_keys[(li, i)] = direct.get(d, rolled.get(d))
+            # stable multi-key: sort by each level from last to first,
+            # missing values always last regardless of direction
+            for li in range(len(self._sorts) - 1, -1, -1):
+                field, desc, _np_ = self._sorts[li]
+                present = [i for i in rows
+                           if sort_keys[(li, i)] is not None]
+                absent = [i for i in rows if sort_keys[(li, i)] is None]
+                present.sort(key=lambda i: sort_keys[(li, i)],
+                             reverse=bool(desc))
+                rows = present + absent
+        else:
+            rows = np.lexsort((docs, -sc)).tolist()
+        keep = rows[: self.from_ + self.size]
         hits = []
-        for i in order:
+        index_name = getattr(ctx.mapper, "index_name", None)
+        for i in keep:
             d = int(docs[i])
-            hits.append({"_id": seg.doc_uids[d],
-                         "_score": float(sc[i]),
-                         "_source": seg.sources[d] if self.source else None})
+            nc = self._nested_coords(seg, d)
+            root = nc[2] if nc else d
+            src = seg.sources[root]
+            if nc and isinstance(src, dict):
+                try:
+                    obj = src
+                    for part in nc[0].split("."):
+                        obj = obj[part]
+                    src = obj[nc[1]] if isinstance(obj, list) else obj
+                except (KeyError, IndexError, TypeError):
+                    src = None
+            score_sorted = not self._sorts or \
+                any(f == "_score" for f, _, _ in self._sorts)
+            h = {"_index": index_name, "_id": seg.doc_uids[root],
+                 "_score": float(sc[i]) if score_sorted else None,
+                 "_source": src if self.source else None}
+            if nc:
+                h["_nested"] = {"field": nc[0], "offset": nc[1]}
+            if self.seq_no_primary_term:
+                h["_seq_no"] = int(seg.seq_nos[root])
+                h["_primary_term"] = 1
+            if self._sorts:
+                h["sort"] = [sort_keys[(li, i)]
+                             for li in range(len(self._sorts))]
+            hits.append(h)
         return {"hits": hits, "total": int(docs.size)}
 
     def reduce(self, partials):
         total = sum(p["total"] for p in partials)
         allh = [h for p in partials for h in p["hits"]]
-        allh.sort(key=lambda h: (-h["_score"], h["_id"]))
+        if self._sorts:
+            # cross-segment merge with per-level direction: flip the
+            # comparison per level via the stable multi-pass again
+            for li in range(len(self._sorts) - 1, -1, -1):
+                desc = bool(self._sorts[li][1])
+                present = [h for h in allh if h["sort"][li] is not None]
+                absent = [h for h in allh if h["sort"][li] is None]
+                present.sort(key=lambda h: h["sort"][li],
+                             reverse=desc)
+                allh = present + absent
+            max_score = None
+        else:
+            allh.sort(key=lambda h: (-h["_score"], h["_id"]))
+            max_score = allh[0]["_score"] if allh else None
+        window = allh[self.from_: self.from_ + self.size]
         return {"hits": {
             "total": {"value": total, "relation": "eq"},
-            "max_score": allh[0]["_score"] if allh else None,
-            "hits": allh[: self.size]}}
+            "max_score": max_score,
+            "hits": window}}
 
 
 # ---------------------------------------------------------------------------
@@ -1465,6 +1624,11 @@ class RangeAgg(BucketAggregator):
         if self.field is None or not self.ranges:
             raise ParsingError("range requires [field] and [ranges]")
         self.keyed = bool(body.get("keyed", False))
+        self.missing = body.get("missing")
+
+    def _resolve(self, ctx):
+        """collect-time hook: date_range snapshots the field's format
+        here (bound parsing and key rendering are format-dependent)."""
 
     # bound parsing/formatting hooks: date_range/ip_range override these
     # (aggs_extra.py)
@@ -1474,11 +1638,25 @@ class RangeAgg(BucketAggregator):
     def _format_bound(self, v: float):
         return float(v)
 
+    def _bounds_salt(self):
+        """Memoization salt: date_range parses bounds with the field's
+        format, which differs per index in a cross-index search."""
+        return None
+
     def _bounds(self, r):
-        frm = r.get("from")
-        to = r.get("to")
-        return (self._parse_bound(frm, "from") if frm is not None else None,
+        # bounds resolve ONCE per (request, format) and memoize:
+        # date-math 'now' must not re-resolve between collect and reduce
+        cache = getattr(self, "_bounds_cache", None)
+        if cache is None:
+            cache = self._bounds_cache = {}
+        k = (id(r), self._bounds_salt())
+        if k not in cache:
+            frm = r.get("from")
+            to = r.get("to")
+            cache[k] = (
+                self._parse_bound(frm, "from") if frm is not None else None,
                 self._parse_bound(to, "to") if to is not None else None)
+        return cache[k]
 
     def _range_key(self, r) -> str:
         if "key" in r:
@@ -1489,26 +1667,39 @@ class RangeAgg(BucketAggregator):
         return f"{f}-{t}"
 
     def collect(self, ctx, seg, mask):
+        self._resolve(ctx)
         num = _numeric_pairs(seg, self.field, ctx.mapper)
+        miss_val = miss_docs = None
+        if self.missing is not None:
+            miss_val = self._parse_bound(self.missing, "from")
+            has = np.zeros(mask.shape[0], bool)
+            if num is not None:
+                has[num[0]] = True
+            miss_docs = mask & ~has
         out = {}
-        for r in self.ranges:
-            key = self._range_key(r)
-            if num is None:
+        for ri, r in enumerate(self.ranges):
+            key = ri          # ordinal: display keys may be per-format
+            lo, hi = self._bounds(r)
+            if num is None and miss_docs is None:
                 out[key] = (0, {n: a.collect(ctx, seg,
                                              np.zeros_like(mask))
                                 for n, a in self.subs.items()} if self.subs
                             else {})
                 continue
-            docs, vals = num
-            sel = np.ones(vals.shape[0], bool)
-            lo, hi = self._bounds(r)
-            if lo is not None:
-                sel &= vals >= lo
-            if hi is not None:
-                sel &= vals < hi
-            pm = mask[docs] & sel
             bucket_docs = np.zeros(mask.shape[0], bool)
-            bucket_docs[docs[pm]] = True
+            if num is not None:
+                docs, vals = num
+                sel = np.ones(vals.shape[0], bool)
+                if lo is not None:
+                    sel &= vals >= lo
+                if hi is not None:
+                    sel &= vals < hi
+                pm = mask[docs] & sel
+                bucket_docs[docs[pm]] = True
+            if miss_docs is not None and \
+                    (lo is None or miss_val >= lo) and \
+                    (hi is None or miss_val < hi):
+                bucket_docs |= miss_docs
             bm = mask & bucket_docs
             if self.subs:
                 out[key] = _bucket_payload(self, ctx, seg, bm)
@@ -1517,10 +1708,21 @@ class RangeAgg(BucketAggregator):
         return out
 
     def reduce(self, partials):
+        # the reference sorts ranges by (from, to) before bucketing
+        # (AbstractRangeBuilder.processRanges → sortRanges)
+        inf = float("inf")
+
+        def _order(r):
+            lo, hi = self._bounds(r)
+            return (-inf if lo is None else lo, inf if hi is None else hi)
+
         buckets = []
-        for r in self.ranges:
+        order = sorted(range(len(self.ranges)),
+                       key=lambda i: _order(self.ranges[i]))
+        for ri in order:
+            r = self.ranges[ri]
             key = self._range_key(r)
-            items = [p[key] for p in partials if key in p]
+            items = [p[ri] for p in partials if ri in p]
             count = sum(c for c, _ in items)
             subs = _reduce_subs(self, [s for _, s in items]) \
                 if self.subs else {}
@@ -1685,13 +1887,51 @@ def _resolve_buckets_path(sibling_results: dict, path: str):
             # missing, not 0 (``BucketHelpers.resolveBucketValue``)
             v = None
         else:
-            for p in parts[1:]:
+            sp = parts[1:]
+            for i, p in enumerate(sp):
+                if isinstance(v, dict) and isinstance(v.get(p), dict) \
+                        and "buckets" in v[p] and i + 1 < len(sp):
+                    # traversing INTO a multi-bucket agg yields one value
+                    # per inner bucket — an array, never a number
+                    raise IllegalArgumentError(
+                        "buckets_path must reference either a number "
+                        "value or a single value numeric metric "
+                        "aggregation, got: [Object[]] at aggregation "
+                        f"[{p}]")
                 if isinstance(v, dict):
                     v = v.get(p)
+            if isinstance(v, dict) and "buckets" in v:
+                raise IllegalArgumentError(
+                    "buckets_path must reference either a number value "
+                    "or a single value numeric metric aggregation, got: "
+                    f"[{_internal_agg_class(v)}] at aggregation "
+                    f"[{sp[-1]}]")
+            if isinstance(v, dict) and "value" not in v and \
+                    any(k in v for k in ("values", "min", "std_deviation")):
+                raise IllegalArgumentError(
+                    "buckets_path must reference either a number value "
+                    "or a single value numeric metric aggregation, but "
+                    f"[{sp[-1]}] contains multiple values. Please "
+                    "specify which to use.")
             if isinstance(v, dict):
                 v = v.get("value")
         series.append(v)
     return buckets, series
+
+
+def _internal_agg_class(node: dict) -> str:
+    """Best-effort reference class name for a multi-bucket result node,
+    keyed off the bucket key type (LongTerms/DoubleTerms/StringTerms —
+    ``BucketHelpers.formatResolutionError`` surfaces the class)."""
+    blist = node.get("buckets")
+    blist = list(blist.values()) if isinstance(blist, dict) else blist
+    keys = [b.get("key") for b in (blist or []) if isinstance(b, dict)]
+    if any(isinstance(k, str) for k in keys):
+        return "StringTerms"
+    if any(isinstance(k, float) and not float(k).is_integer()
+           for k in keys):
+        return "DoubleTerms"
+    return "LongTerms"
 
 
 class _SiblingPipelineAgg(PipelineAggregator):

@@ -344,6 +344,41 @@ def _chi_square(fg, fg_total, bg, bg_total) -> float:
     return score
 
 
+def _check_regex_include_exclude(agg, mapper) -> None:
+    """Regex-form include/exclude is string-fields-only
+    (``IncludeExclude`` builds a LongFilter for numerics and rejects
+    regex): shared by rare_terms and significant_terms."""
+    if isinstance(agg.include, str) or isinstance(agg.exclude, str):
+        from .aggregations import _field_type
+        from ..index.mapping import KeywordFieldType, TextFieldType
+        ft = _field_type(mapper, agg.field)
+        if ft is not None and not isinstance(
+                ft, (KeywordFieldType, TextFieldType)):
+            raise IllegalArgumentError(
+                f"Aggregation [{getattr(agg, 'name', agg.field)}] "
+                f"cannot support regular expression style "
+                f"include/exclude settings as they can only be "
+                f"applied to string fields. Use an array of values "
+                f"for include/exclude clauses")
+
+
+def _include_exclude_passes(agg, key, inc_set, exc_set) -> bool:
+    """One term against the agg's include/exclude (list sets are
+    pre-coerced by the caller; strings are anchored regexes)."""
+    import re as _re
+    inc, exc = agg.include, agg.exclude
+    if inc_set is not None and key not in inc_set:
+        return False
+    if isinstance(inc, str) and _re.fullmatch(inc, str(key)) is None:
+        return False
+    if exc_set is not None and key in exc_set:
+        return False
+    if isinstance(exc, str) and \
+            _re.fullmatch(exc, str(key)) is not None:
+        return False
+    return True
+
+
 class SignificantTermsAgg(BucketAggregator):
     KNOWN_PARAMS = {"field", "size", "shard_size", "min_doc_count",
                     "shard_min_doc_count", "background_filter", "jlh",
@@ -368,51 +403,128 @@ class SignificantTermsAgg(BucketAggregator):
         self.min_doc_count = int(body.get("min_doc_count", 3))
         self.heuristic = "chi_square" if "chi_square" in body else "jlh"
         self.background_filter = body.get("background_filter")
+        self.include = body.get("include")
+        self.exclude = body.get("exclude")
+        self._inc_set = self._exc_set = None    # built lazily, once
+        #: per-segment background stats, accumulated OUTSIDE the bucket
+        #: partials: under a bucketing parent, collect only runs for
+        #: (segment, bucket) pairs where the bucket exists, but the
+        #: background population must span every segment seen. Every
+        #: partial carries a reference to this dict so the stats survive
+        #: pickling to a coordinating node (the reducing instance over
+        #: there is a FRESH parse with an empty dict of its own).
+        self._seg_bg: Dict[str, tuple] = {}
 
-    def collect(self, ctx, seg, mask):
-        kw = _keyword_pairs(seg, self.field)
-        if kw is None:
-            # field-less segment: its docs still belong to both the
-            # foreground and the background populations
-            return {"fg_total": int(mask[: seg.n_docs].sum()),
-                    "bg_total": int(_live_parents(
-                        seg, mask.shape[0])[: seg.n_docs].sum()),
-                    "terms": {}}
-        docs, ords, terms = kw
-        fg_mask = mask
+    def _bg_token(self, seg) -> str:
+        """Segment identity for background dedup. seg_id ('_0', '_1')
+        recurs across shards and indices, so segments get stamped with
+        a process-unique token that also disambiguates across nodes."""
+        tok = getattr(seg, "_sig_bg_token", None)
+        if tok is None:
+            import uuid
+            tok = uuid.uuid4().hex
+            seg._sig_bg_token = tok
+        return tok
+
+    def _bg_mask(self, ctx, seg, mask):
         if self.background_filter is not None:
             from .query_dsl import parse_query
             _, bgm = parse_query(self.background_filter).execute(
                 ctx.shard_ctx, seg)
-            bg_mask = np.asarray(bgm)[: mask.shape[0]] & \
+            return np.asarray(bgm)[: mask.shape[0]] & \
                 _live_parents(seg, mask.shape[0])
-        else:
-            bg_mask = _live_parents(seg, mask.shape[0])
-        pm_fg = fg_mask[docs]
-        pm_bg = bg_mask[docs]
+        return _live_parents(seg, mask.shape[0])
+
+    def _collect_text(self, ctx, seg, mask, f):
+        """Postings-CSR path: per-term fg doc counts by bincount over
+        posting term-ids (text fields have no doc-values column)."""
+        v = len(f.term_ids)
+        tid = np.repeat(np.arange(v, dtype=np.int64),
+                        np.diff(f.offsets).astype(np.int64))
+        terms_sorted = list(f.term_ids)
+        tok = self._bg_token(seg)
+        if tok not in self._seg_bg:
+            bg_mask = self._bg_mask(ctx, seg, mask)
+            bg = np.bincount(tid[bg_mask[f.docs_host]], minlength=v)
+            self._seg_bg[tok] = (
+                int(bg_mask[: seg.n_docs].sum()),
+                {terms_sorted[i]: int(bg[i]) for i in np.flatnonzero(bg)})
+        fg = np.bincount(tid[mask[f.docs_host]], minlength=v)
+        t = {}
+        for i in np.flatnonzero(fg):
+            t[terms_sorted[i]] = int(fg[i])
+        return {"fg_total": int(mask[: seg.n_docs].sum()), "terms": t,
+                "seg_bg": self._seg_bg}
+
+    def _key_passes(self, key) -> bool:
+        # sig-terms keys are always strings (keyword/text sources),
+        # so list include/exclude needs no field-type coercion
+        if self._inc_set is None and isinstance(self.include, list):
+            self._inc_set = set(self.include)
+        if self._exc_set is None and isinstance(self.exclude, list):
+            self._exc_set = set(self.exclude)
+        return _include_exclude_passes(self, key, self._inc_set,
+                                       self._exc_set)
+
+    def collect(self, ctx, seg, mask):
+        _check_regex_include_exclude(self, ctx.mapper)
+        kw = _keyword_pairs(seg, self.field)
+        if kw is None:
+            field = self.field
+            ft = ctx.mapper.field_type(field) if ctx.mapper else None
+            if ft is not None and ft.name != field:
+                field = ft.name
+            f = seg.text_fields.get(field)
+            if f is not None:
+                return self._collect_text(ctx, seg, mask, f)
+            # field-less segment: its docs still belong to both the
+            # foreground and the background populations
+            tok = self._bg_token(seg)
+            if tok not in self._seg_bg:
+                self._seg_bg[tok] = (
+                    int(_live_parents(
+                        seg, mask.shape[0])[: seg.n_docs].sum()), {})
+            return {"fg_total": int(mask[: seg.n_docs].sum()),
+                    "terms": {}, "seg_bg": self._seg_bg}
+        docs, ords, terms = kw
+        tok = self._bg_token(seg)
+        if tok not in self._seg_bg:
+            bg_mask = self._bg_mask(ctx, seg, mask)
+            bg_ords, bg_counts = np.unique(ords[bg_mask[docs]],
+                                           return_counts=True)
+            self._seg_bg[tok] = (
+                int(bg_mask[: seg.n_docs].sum()),
+                {terms[o]: int(c) for o, c in
+                 zip(bg_ords.tolist(), bg_counts.tolist())})
+        pm_fg = mask[docs]
         fg_ords, fg_counts = np.unique(ords[pm_fg], return_counts=True)
-        bg_ords, bg_counts = np.unique(ords[pm_bg], return_counts=True)
-        bg_of = dict(zip(bg_ords.tolist(), bg_counts.tolist()))
         t = {}
         for o, c in zip(fg_ords.tolist(), fg_counts.tolist()):
-            t[terms[o]] = (c, bg_of.get(o, 0))
-        return {"fg_total": int(fg_mask[: seg.n_docs].sum()),
-                "bg_total": int(bg_mask[: seg.n_docs].sum()),
-                "terms": t}
+            t[terms[o]] = c
+        return {"fg_total": int(mask[: seg.n_docs].sum()), "terms": t,
+                "seg_bg": self._seg_bg}
 
     def reduce(self, partials):
         fg_total = sum(p["fg_total"] for p in partials)
-        bg_total = sum(p["bg_total"] for p in partials)
-        merged: Dict[str, List[int]] = {}
+        # union background stats: the local instance dict plus whatever
+        # rode in on (possibly remote) partials, deduped by seg token
+        seen = dict(self._seg_bg)
         for p in partials:
-            for term, (fg, bg) in p["terms"].items():
-                cur = merged.setdefault(term, [0, 0])
-                cur[0] += fg
-                cur[1] += bg
+            seen.update(p.get("seg_bg") or {})
+        bg_total = sum(t for t, _ in seen.values())
+        bg_of: Dict[str, int] = {}
+        for _, bmap in seen.values():
+            for term, c in bmap.items():
+                bg_of[term] = bg_of.get(term, 0) + c
+        merged: Dict[str, int] = {}
+        for p in partials:
+            for term, fg in p["terms"].items():
+                merged[term] = merged.get(term, 0) + fg
         score_fn = _chi_square if self.heuristic == "chi_square" else _jlh
         rows = []
-        for term, (fg, bg) in merged.items():
-            if fg < self.min_doc_count:
+        for term, fg in merged.items():
+            bg = bg_of.get(term, 0)
+            if fg < self.min_doc_count or not self._key_passes(term):
                 continue
             score = score_fn(fg, fg_total, bg, bg_total)
             if score > 0:
@@ -436,23 +548,41 @@ class RareTermsAgg(BucketAggregator):
                 "[max_doc_count] must be in [1, 100]")
         self.include = body.get("include")
         self.exclude = body.get("exclude")
+        self._inc_set = self._exc_set = None    # coerced lazily, once
+
+    def _coerce(self, vals):
+        """include/exclude values → key space via the field type (dates
+        parse to epoch millis, ips canonicalize, numerics to float)."""
+        from .aggregations import _field_type
+        from ..index.mapping import (BooleanFieldType, DateFieldType,
+                                     IpFieldType, NumberFieldType,
+                                     parse_date_millis)
+        ft = _field_type(getattr(self, "_mapper", None), self.field)
+        out = set()
+        for v in vals:
+            try:
+                if isinstance(ft, DateFieldType):
+                    v = float(parse_date_millis(v, ft.format))
+                elif isinstance(ft, BooleanFieldType):
+                    v = 1.0 if v in (True, "true") else 0.0
+                elif isinstance(ft, NumberFieldType):
+                    v = float(v)
+            except Exception:   # noqa: BLE001 — keep raw on failure
+                pass
+            out.add(v)
+        return out
 
     def _included(self, key) -> bool:
-        import re as _re
-        inc, exc = self.include, self.exclude
-        if isinstance(inc, list) and key not in set(inc):
-            return False
-        if isinstance(inc, str) and _re.fullmatch(inc, str(key)) is None:
-            return False
-        if isinstance(exc, list) and key in set(exc):
-            return False
-        if isinstance(exc, str) and \
-                _re.fullmatch(exc, str(key)) is not None:
-            return False
-        return True
+        if self._inc_set is None and isinstance(self.include, list):
+            self._inc_set = self._coerce(self.include)
+        if self._exc_set is None and isinstance(self.exclude, list):
+            self._exc_set = self._coerce(self.exclude)
+        return _include_exclude_passes(self, key, self._inc_set,
+                                       self._exc_set)
 
     def collect(self, ctx, seg, mask):
         self._mapper = ctx.mapper
+        _check_regex_include_exclude(self, ctx.mapper)
         buckets: Dict[Any, tuple] = {}
         kw = _keyword_pairs(seg, self.field)
         if kw is not None:
@@ -621,23 +751,51 @@ class ReverseNestedAgg(BucketAggregator):
 
 class DateRangeAgg(RangeAgg):
     """date_range (reference: ``bucket/range/DateRangeAggregationBuilder``):
-    bounds parse as dates (math expressions not yet), keys format as
-    ISO strings."""
+    bounds parse through the FIELD's date format (epoch_second bounds are
+    seconds, not millis) and keys render with it; date-math bounds
+    supported via parse_date_millis."""
+
+    def __init__(self, body):
+        super().__init__(body)
+        self.format = body.get("format")
+        self._ffmt = None               # field format, stashed at collect
+
+    def _resolve(self, ctx):
+        from ..index.mapping import DateFieldType
+        ft = ctx.mapper.field_type(self.field)
+        if isinstance(ft, DateFieldType):
+            self._ffmt = ft.format
+
+    def _bounds_salt(self):
+        return self.format or self._ffmt
 
     def _parse_bound(self, v, which: str) -> float:
         from ..index.mapping import parse_date_millis
-        return float(parse_date_millis(v))
+        fmt = self.format or self._ffmt or \
+            "strict_date_optional_time||epoch_millis"
+        return float(parse_date_millis(v, fmt))
 
     def _format_bound(self, v: float):
         return v
 
+    def _fmt_ms(self, ms: float) -> str:
+        from ..index.mapping import format_date_millis
+        fmt = (self.format or self._ffmt or "").split("||")[0]
+        if fmt == "epoch_second":
+            return str(int(ms // 1000))
+        if fmt == "epoch_millis":
+            return str(int(ms))
+        if fmt and not fmt.startswith("strict_date_optional_time"):
+            from .fetch import java_date_format
+            return java_date_format(ms, fmt)
+        return format_date_millis(ms)
+
     def _range_key(self, r) -> str:
         if "key" in r:
             return r["key"]
-        from ..index.mapping import format_date_millis
         lo, hi = self._bounds(r)
-        f = "*" if lo is None else format_date_millis(lo)
-        t = "*" if hi is None else format_date_millis(hi)
+        f = "*" if lo is None else self._fmt_ms(lo)
+        t = "*" if hi is None else self._fmt_ms(hi)
         return f"{f}-{t}"
 
 

@@ -594,39 +594,31 @@ class SignificantTextAgg(SignificantTermsAgg):
             field = ft.name
         f = seg.text_fields.get(field)
         if f is None:
+            tok = self._bg_token(seg)
+            if tok not in self._seg_bg:
+                self._seg_bg[tok] = (
+                    int(_live_parents(
+                        seg, mask.shape[0])[: seg.n_docs].sum()), {})
             return {"fg_total": int(mask[: seg.n_docs].sum()),
-                    "bg_total": int(_live_parents(
-                        seg, mask.shape[0])[: seg.n_docs].sum()),
-                    "terms": {}}
-        if self.background_filter is not None:
-            from .query_dsl import parse_query
-            _, bgm = parse_query(self.background_filter).execute(
-                ctx.shard_ctx, seg)
-            bg_mask = np.asarray(bgm)[: mask.shape[0]] & \
-                _live_parents(seg, mask.shape[0])
-        else:
-            bg_mask = _live_parents(seg, mask.shape[0])
+                    "terms": {}, "seg_bg": self._seg_bg}
+        if not self.filter_duplicate_text:
+            return self._collect_text(ctx, seg, mask, f)
         v = len(f.term_ids)
         tid = np.repeat(np.arange(v, dtype=np.int64),
                         np.diff(f.offsets).astype(np.int64))
-        pm_fg = mask[f.docs_host]
-        pm_bg = bg_mask[f.docs_host]
-        if self.filter_duplicate_text:
-            fg_docs = np.unique(f.docs_host[pm_fg])
-            fg_of = self._dedup_fg_counts(f, fg_docs)
-            fg = np.zeros(v, np.int64)
-            for t_id, c in fg_of.items():
-                fg[t_id] = c
-        else:
-            fg = np.bincount(tid[pm_fg], minlength=v)
-        bg = np.bincount(tid[pm_bg], minlength=v)
         terms_sorted = list(f.term_ids)
-        t = {}
-        for i in np.flatnonzero(fg):
-            t[terms_sorted[i]] = (int(fg[i]), int(bg[i]))
-        return {"fg_total": int(mask[: seg.n_docs].sum()),
-                "bg_total": int(bg_mask[: seg.n_docs].sum()),
-                "terms": t}
+        tok = self._bg_token(seg)
+        if tok not in self._seg_bg:
+            bg_mask = self._bg_mask(ctx, seg, mask)
+            bg = np.bincount(tid[bg_mask[f.docs_host]], minlength=v)
+            self._seg_bg[tok] = (
+                int(bg_mask[: seg.n_docs].sum()),
+                {terms_sorted[i]: int(bg[i]) for i in np.flatnonzero(bg)})
+        fg_docs = np.unique(f.docs_host[mask[f.docs_host]])
+        fg_of = self._dedup_fg_counts(f, fg_docs)
+        t = {terms_sorted[t_id]: c for t_id, c in fg_of.items() if c}
+        return {"fg_total": int(mask[: seg.n_docs].sum()), "terms": t,
+                "seg_bg": self._seg_bg}
 
 
 # ---------------------------------------------------------------------------
