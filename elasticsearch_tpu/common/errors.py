@@ -62,6 +62,14 @@ class IllegalArgumentError(ElasticsearchError):
     error_type = "illegal_argument_exception"
 
 
+class ElasticsearchParseError(ElasticsearchError):
+    """``ElasticsearchParseException`` — type "parse_exception", distinct
+    from ParsingError's "parsing_exception"."""
+
+    status = 400
+    error_type = "parse_exception"
+
+
 class ParsingError(ElasticsearchError):
     """Query DSL / body parse failure (``common/ParsingException.java``)."""
 

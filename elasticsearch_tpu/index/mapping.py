@@ -140,9 +140,155 @@ _EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
 _DATE_YMD_RE = re.compile(r"^\d{4}-\d{2}-\d{2}$")
 
 
+#: month-abbreviation tables for locale-dependent java patterns (MMM);
+#: keys are the first three letters, lowercased, dots stripped
+_MONTHS_BY_LOCALE = {
+    "en": {"jan": 1, "feb": 2, "mar": 3, "apr": 4, "may": 5, "jun": 6,
+           "jul": 7, "aug": 8, "sep": 9, "oct": 10, "nov": 11, "dec": 12},
+    "de": {"jan": 1, "feb": 2, "mär": 3, "apr": 4, "mai": 5, "jun": 6,
+           "jul": 7, "aug": 8, "sep": 9, "okt": 10, "nov": 11, "dez": 12},
+}
+
+
+def _parse_java_pattern(s: str, pattern: str, locale: str) -> Optional[float]:
+    """Parse against ONE java date pattern ("E, d MMM yyyy HH:mm:ss Z")
+    with locale-dependent month names (reference: DateFormatters with a
+    Locale). Returns epoch ms or None when the text doesn't fit."""
+    ns = _parse_java_pattern_ns(s, pattern, locale)
+    return None if ns is None else ns / 1e6
+
+
+def _parse_java_pattern_ns(s: str, pattern: str,
+                           locale: str) -> Optional[int]:
+    """Same as :func:`_parse_java_pattern` at exact NANOS resolution
+    (sub-second digits beyond 3 survive — date_nanos formats)."""
+    months = _MONTHS_BY_LOCALE.get(
+        (locale or "en").split("-")[0].split("_")[0],
+        _MONTHS_BY_LOCALE["en"])
+    groups = []         # extractor names, one per capture group
+
+    def _tok(m):
+        run = m.group(0)
+        c = run[0]
+        if c == "E":
+            return r"[^\W\d]+\.?"
+        if c == "y":
+            groups.append("y" if len(run) >= 4 else "yy")
+            return r"(\d{4})" if len(run) >= 4 else r"(\d{2})"
+        if run == "MMM" or run == "MMMM":
+            groups.append("Mname")
+            return r"([^\W\d]+\.?)"
+        if c == "M":
+            groups.append("M")
+            return r"(\d{2})" if len(run) == 2 else r"(\d{1,2})"
+        if c == "d":
+            groups.append("d")
+            return r"(\d{2})" if len(run) == 2 else r"(\d{1,2})"
+        if c in "Hh":
+            groups.append("H")
+            return r"(\d{2})" if len(run) == 2 else r"(\d{1,2})"
+        if c == "m":
+            groups.append("mi")
+            return r"(\d{2})"
+        if c == "s":
+            groups.append("se")
+            return r"(\d{2})"
+        if c == "S":
+            groups.append("S")
+            return r"(\d{1,%d})" % len(run)
+        if c == "Z" or c == "X":
+            groups.append("tz")
+            return r"([+-]\d{2}:?\d{2}|Z)"
+        return re.escape(run)
+
+    pat = re.sub(r"([a-zA-Z])\1*|[^a-zA-Z]+",
+                 lambda m: _tok(m) if m.group(0)[0].isalpha()
+                 else re.escape(m.group(0)), pattern)
+    m = re.fullmatch(pat, s.strip())
+    if m is None:
+        return None
+    vals = {"y": 1970, "M": 1, "d": 1, "H": 0, "mi": 0, "se": 0,
+            "S_ns": 0, "tz_s": 0}
+    for name, g in zip(groups, m.groups()):
+        if name == "Mname":
+            key = g.rstrip(".").lower()[:3]
+            mo = months.get(key) or _MONTHS_BY_LOCALE["en"].get(key)
+            if mo is None:
+                return None
+            vals["M"] = mo
+        elif name == "tz":
+            if g != "Z":
+                sign = 1 if g[0] == "+" else -1
+                digits = g[1:].replace(":", "")
+                vals["tz_s"] = sign * (int(digits[:2]) * 3600 +
+                                       int(digits[2:4]) * 60)
+        elif name == "S":
+            vals["S_ns"] = int(g.ljust(9, "0")[:9])
+        elif name == "yy":
+            # java reduced year: two digits pivot on 2000 (00-99 →
+            # 2000-2099, DateTimeFormatterBuilder.appendValueReduced)
+            vals["y"] = 2000 + int(g)
+        else:
+            vals[name] = int(g)
+    try:
+        d = _dt.datetime(vals["y"], vals["M"], vals["d"], vals["H"],
+                         vals["mi"], vals["se"],
+                         tzinfo=_dt.timezone.utc)
+    except ValueError:
+        return None
+    delta = d - _EPOCH
+    return ((delta.days * 86400 + delta.seconds - vals["tz_s"]) * 10 ** 9
+            + vals["S_ns"])
+
+
+_ISO_NS_RE = re.compile(
+    r"(\d{4})-(\d{2})-(\d{2})[T ](\d{2}):(\d{2}):(\d{2})"
+    r"(?:\.(\d{1,9}))?(Z|[+-]\d{2}:?\d{2})?")
+
+
+def parse_date_nanos(value: Any, fmt: str, locale: str = "en") -> int:
+    """Exact epoch-NANOS parse for date_nanos fields. float64 millis tops
+    out around 200ns granularity at 2018-era epochs, so ns-resolution
+    values must never round-trip through the float path (reference:
+    ``DateFieldMapper.Resolution.NANOSECONDS``)."""
+    if isinstance(value, numbers.Number) and not isinstance(value, bool):
+        if "epoch_second" in fmt and "epoch_millis" not in fmt:
+            return int(value) * 10 ** 9
+        return int(value) * 10 ** 6
+    s = str(value).strip()
+    m = _ISO_NS_RE.fullmatch(s)
+    if m:
+        y, mo, d, H, Mi, S, frac, tz = m.groups()
+        base = _dt.datetime(int(y), int(mo), int(d), int(H), int(Mi),
+                            int(S), tzinfo=_dt.timezone.utc)
+        delta = base - _EPOCH
+        ns = (delta.days * 86400 + delta.seconds) * 10 ** 9
+        ns += int((frac or "").ljust(9, "0") or 0)
+        if tz and tz != "Z":
+            sign = 1 if tz[0] == "+" else -1
+            digits = tz[1:].replace(":", "")
+            ns -= sign * (int(digits[:2]) * 3600 +
+                          int(digits[2:4] or 0) * 60) * 10 ** 9
+        return ns
+    if re.fullmatch(r"-?\d+", s):
+        if "epoch_second" in fmt and "epoch_millis" not in fmt:
+            return int(s) * 10 ** 9
+        return int(s) * 10 ** 6
+    for alt in fmt.split("||"):
+        if alt in ("strict_date_optional_time", "epoch_millis",
+                   "epoch_second"):
+            continue
+        ns = _parse_java_pattern_ns(s, alt, locale)
+        if ns is not None:
+            return ns
+    # date-math and anything else: ms-resolution fallback
+    return int(round(parse_date_millis(s, fmt, locale=locale) * 1e6))
+
+
 def parse_date_millis(value: Any, fmt: str = "strict_date_optional_time||epoch_millis",
                       round_up: bool = False,
-                      date_math: bool = True) -> float:
+                      date_math: bool = True,
+                      locale: str = "en") -> float:
     """Parse a date into epoch milliseconds (UTC). Supports the reference's
     default ``strict_date_optional_time||epoch_millis`` plus
     ``epoch_second``. ``round_up`` resolves /unit date-math rounding to
@@ -179,6 +325,14 @@ def parse_date_millis(value: Any, fmt: str = "strict_date_optional_time||epoch_m
                 d = d.replace(tzinfo=_dt.timezone.utc)
         return (d - _EPOCH).total_seconds() * 1000.0
     except ValueError as e:
+        # custom java patterns (letter runs + literals), locale-aware
+        for alt in fmt.split("||"):
+            if alt in ("strict_date_optional_time", "epoch_millis",
+                       "epoch_second"):
+                continue
+            ms = _parse_java_pattern(s, alt, locale)
+            if ms is not None:
+                return ms
         raise MapperParsingError(f"failed to parse date field [{value}]") from e
 
 
@@ -302,6 +456,7 @@ class DateFieldType(MappedFieldType):
                  params: Optional[dict] = None, nanos: bool = False):
         super().__init__(name, params)
         self.format = date_format
+        self.locale = (params or {}).get("locale") or "en"
         self.nanos = nanos          # date_nanos resolution (sort values
                                     # serialize as epoch nanos)
 
@@ -309,7 +464,8 @@ class DateFieldType(MappedFieldType):
     NANOS_MAX_MS = (1 << 63) / 1e6
 
     def parse_value(self, value):
-        ms = parse_date_millis(value, self.format, date_math=False)
+        ms = parse_date_millis(value, self.format, date_math=False,
+                               locale=self.locale)
         if self.nanos:
             if ms < 0:
                 e = MapperParsingError(
@@ -778,6 +934,9 @@ class ParsedDocument:
     keyword_terms: Dict[str, List[str]] = dc_field(default_factory=dict)
     # field name -> list of float64 values (numeric/date/boolean)
     numeric_values: Dict[str, List[float]] = dc_field(default_factory=dict)
+    # field name -> exact epoch-nanos longs (date_nanos only: float64
+    # cannot hold ns-resolution epochs)
+    int64_values: Dict[str, List[int]] = dc_field(default_factory=dict)
     # field name -> float32 vector
     vectors: Dict[str, np.ndarray] = dc_field(default_factory=dict)
     # field name -> list of (lat, lon)
@@ -1200,6 +1359,9 @@ class MapperService:
         elif isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType,
                              TokenCountFieldType)):
             parsed.numeric_values.setdefault(full, []).append(ft.parse_value(value))
+            if isinstance(ft, DateFieldType) and ft.nanos:
+                parsed.int64_values.setdefault(full, []).append(
+                    parse_date_nanos(value, ft.format, ft.locale))
         # index multi-fields too
         for sub_name in list(self._fields):
             if sub_name.startswith(full + ".") and "." not in sub_name[len(full) + 1:]:

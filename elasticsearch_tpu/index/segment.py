@@ -329,6 +329,8 @@ class SegmentBuilder:
         self._keyword_postings: Dict[str, Dict[str, List[int]]] = {}
         self._keyword_values: Dict[str, List[Tuple[int, str]]] = {}  # (doc, term)
         self._numeric_values: Dict[str, List[Tuple[int, float]]] = {}
+        # exact int64 doc values (date_nanos): host-side, never floats
+        self._int64_values: Dict[str, List[Tuple[int, int]]] = {}
         self._vectors: Dict[str, Dict[int, np.ndarray]] = {}
 
     def __len__(self) -> int:
@@ -390,6 +392,11 @@ class SegmentBuilder:
             lst = self._numeric_values.setdefault(field, [])
             for v in vals:
                 lst.append((doc, float(v)))
+
+        for field, ivals in parsed.int64_values.items():
+            ilst = self._int64_values.setdefault(field, [])
+            for v in ivals:
+                ilst.append((doc, int(v)))
 
         for field, vec in parsed.vectors.items():
             self._vectors.setdefault(field, {})[doc] = vec
@@ -494,6 +501,12 @@ class SegmentBuilder:
                       np.asarray(self.seq_nos, np.int64), text_fields,
                       keyword_fields, numeric_fields, vector_fields,
                       parent_of=parent_of, nested_paths=nested_paths)
+        # exact int64 doc values (date_nanos) ride as a host-side extra:
+        # {field: (docs int32[], vals int64[])}
+        seg.int64_fields = {
+            f: (np.asarray([d for d, _ in pairs], np.int32),
+                np.asarray([v for _, v in pairs], np.int64))
+            for f, pairs in self._int64_values.items()}
         for local in self.deleted:
             seg.delete_doc(local)
         return seg

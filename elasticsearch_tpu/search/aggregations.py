@@ -1055,6 +1055,15 @@ class TermsAgg(BucketAggregator):
         trunc_err = 0
         self._mapper = ctx.mapper        # for key_as_string at reduce
         self._check_regex_support(ctx.mapper)
+        if self.field == "_index":
+            # metadata field: every doc of the segment carries the
+            # owning index's name as its single value
+            name = getattr(ctx.mapper, "index_name", "") or ""
+            cnt = _mask_count(seg, mask)
+            if cnt or self.min_doc_count == 0:
+                buckets[name] = (_bucket_payload(self, ctx, seg, mask)
+                                 if self.subs else (cnt, {}))
+            return buckets, 0
         if ctx.mapper is not None and getattr(self, "_raw", {}).get(
                 "execution_hint") != "map":
             # global-ordinals execution loads fielddata (stats accounting)

@@ -64,7 +64,10 @@ def _required_ranges(query_spec) -> List[tuple]:
             lo = cond.get("gte", cond.get("gt"))
             hi = cond.get("lte", cond.get("lt"))
             if isinstance(lo, str) or isinstance(hi, str):
-                continue                      # dates/strings: not analyzed
+                # date strings resolve against each shard's own field
+                # format inside _shard_can_match
+                out.append((field, lo, hi))
+                continue
             out.append((field,
                         float(lo) if lo is not None else float("-inf"),
                         float(hi) if hi is not None else float("inf")))
@@ -83,6 +86,28 @@ def _shard_can_match(shard: "ShardSearcher", bounds: List[tuple]) -> bool:
     """False iff some required range is disjoint from the shard's
     [min, max] for that field across every segment."""
     for field, lo, hi in bounds:
+        if isinstance(lo, str) or isinstance(hi, str):
+            # resolve date-format bounds with this shard's mapping
+            from ..index.mapping import DateFieldType, parse_date_millis
+            ft = shard.mapper.field_type(field)
+            if not isinstance(ft, DateFieldType):
+                continue              # non-date string bounds: no skip
+            try:
+                # hi rounds UP (a bare day means end-of-day for lte) so
+                # the skip test stays conservative — can-match must
+                # never drop a shard that could hold matches
+                lo = parse_date_millis(lo, ft.format) \
+                    if isinstance(lo, str) else (
+                        float(lo) if lo is not None else float("-inf"))
+                hi = parse_date_millis(hi, ft.format, round_up=True) \
+                    if isinstance(hi, str) else (
+                        float(hi) if hi is not None else float("inf"))
+            except Exception:   # noqa: BLE001 — unparseable: no skip
+                continue
+            if lo is None:
+                lo = float("-inf")
+            if hi is None:
+                hi = float("inf")
         fmin, fmax = float("inf"), float("-inf")
         present = False
         for seg in shard.segments:

@@ -83,6 +83,36 @@ def filter_source(source: Optional[dict], spec) -> Optional[dict]:
 # ---------------------------------------------------------------------------
 
 
+def format_date_ns(ns: int, pattern: str) -> str:
+    """Java-pattern render at NANOS resolution, with quoted literals
+    ('T'), u-years, long S runs and X zone (date_nanos docvalue
+    formats)."""
+    import datetime
+    dt = datetime.datetime.fromtimestamp(
+        (ns // 10 ** 9), tz=datetime.timezone.utc)
+    frac9 = f"{ns % 10 ** 9:09d}"
+    reps = {"y": "%Y", "u": "%Y", "M": "%m", "d": "%d", "H": "%H",
+            "m": "%M", "s": "%S"}
+
+    def _render(m):
+        if m.group(1) is not None:          # 'quoted literal'
+            return m.group(1)[1:-1] or "'"
+        run = m.group(0)
+        c = run[0]
+        if c == "S":
+            return frac9[: len(run)]
+        if c in ("X", "Z"):
+            return "Z" if c == "X" else "+0000"
+        if set(run) == {"e"}:
+            return str(dt.isoweekday()).rjust(len(run), "0")
+        if c in reps:
+            return dt.strftime(reps[c])
+        return run
+    import re as _re
+    return _re.sub(r"('(?:[^']|'')*')|([a-zA-Z])\2*",
+                   lambda m: _render(m), pattern)
+
+
 def docvalue_fields(seg: Segment, mapper: MapperService, local_doc: int,
                     specs: Sequence) -> Dict[str, List[Any]]:
     out: Dict[str, List[Any]] = {}
@@ -96,18 +126,46 @@ def docvalue_fields(seg: Segment, mapper: MapperService, local_doc: int,
             raise ParsingError("docvalue_fields entries require [field]")
         ft = mapper.field_type(field)
         vals: List[Any] = []
+        is_ns = isinstance(ft, DateFieldType) and ft.nanos
+        if is_ns:
+            i64 = getattr(seg, "int64_fields", {}).get(ft.name or field)
+            if i64 is not None:
+                idocs, ivals = i64
+                sel64 = idocs == local_doc
+                ns_list = ivals[sel64].tolist()
+            else:
+                ns_list = []
         nf = seg.numeric_fields.get(field)
         if nf is not None:
             sel = nf.docs_host == local_doc
-            for v in nf.vals_host[sel]:
+            is_date = isinstance(ft, DateFieldType)
+            for vi, v in enumerate(nf.vals_host[sel]):
+                ns = 0
+                if is_ns and vi < len(ns_list):
+                    ns = ns_list[vi]
+                elif is_date:
+                    # integral ms → exact int arithmetic (float64*1e6
+                    # rounds off the low digits at epoch scale)
+                    ns = int(v) * 10 ** 6 if float(v).is_integer() \
+                        else int(round(float(v) * 1e6))
                 if fmt is not None and "#" in fmt:
                     vals.append(decimal_format(float(v), fmt))
+                elif isinstance(ft, DateFieldType) and fmt == \
+                        "epoch_millis":
+                    rem = ns % 10 ** 6
+                    vals.append(f"{ns // 10 ** 6}.{rem:06d}" if rem
+                                else str(ns // 10 ** 6))
                 elif isinstance(ft, DateFieldType) and fmt not in (
                         None, "strict_date_optional_time", "date"):
-                    vals.append(java_date_format(float(v), fmt))
+                    vals.append(format_date_ns(ns, fmt)
+                                if ("'" in fmt or "S" * 4 in fmt
+                                    or "X" in fmt or "u" in fmt or is_ns)
+                                else java_date_format(float(v), fmt))
                 elif isinstance(ft, DateFieldType) or fmt in (
                         "date", "strict_date_optional_time"):
-                    vals.append(format_date_millis(float(v)))
+                    vals.append(format_date_millis(ns // 10 ** 6
+                                                   if is_ns
+                                                   else float(v)))
                 elif float(v).is_integer() and ft is not None and \
                         getattr(ft, "type_name", "") in (
                             "long", "integer", "short", "byte"):
@@ -119,7 +177,9 @@ def docvalue_fields(seg: Segment, mapper: MapperService, local_doc: int,
             sel = kf.dv_docs_host == local_doc
             vals.extend(kf.ord_terms[o] for o in kf.dv_ords_host[sel])
         if vals:
-            out[field] = vals
+            # repeated specs for one field (different formats) append in
+            # spec order, like FetchDocValuesPhase
+            out.setdefault(field, []).extend(vals)
     return out
 
 
@@ -180,8 +240,21 @@ def highlight(mapper: MapperService, source: Optional[dict],
         fields_spec = merged
     pre = (highlight_spec.get("pre_tags") or ["<em>"])[0]
     post = (highlight_spec.get("post_tags") or ["</em>"])[0]
-    out: Dict[str, List[str]] = {}
+    field_terms = highlight_spec.get("_field_terms") or {}
+    max_ao = highlight_spec.get("_max_analyzed_offset")
+    # wildcard field patterns expand over the mapping (ES matches every
+    # mapped field; only those with terms produce output)
+    expanded: Dict[str, dict] = {}
     for field, fspec in fields_spec.items():
+        if "*" in field:
+            import fnmatch
+            for name in list(getattr(mapper, "_fields", {})):
+                if fnmatch.fnmatchcase(name, field):
+                    expanded.setdefault(name, fspec)
+        else:
+            expanded[field] = fspec
+    out: Dict[str, List[str]] = {}
+    for field, fspec in expanded.items():
         fspec = fspec or {}
         frag_size = int(fspec.get("fragment_size",
                                   highlight_spec.get("fragment_size", 100)))
@@ -190,24 +263,61 @@ def highlight(mapper: MapperService, source: Optional[dict],
         ft = mapper.field_type(field)
         if ft is None:
             continue
-        terms = query_terms.get(field, set())
+        rfm = fspec.get("require_field_match",
+                        highlight_spec.get("require_field_match", True))
+        if field in field_terms:            # highlight_query override
+            terms = field_terms[field]
+        elif rfm in (False, "false"):
+            # any query term from any field may highlight this one
+            terms = set().union(*query_terms.values()) \
+                if query_terms else set()
+        else:
+            terms = query_terms.get(field, set())
+            if not terms and "." in field:
+                # multi-field subfield: fall back to the parent's terms
+                terms = query_terms.get(field.rsplit(".", 1)[0], set())
         if not terms:
             continue
-        # walk the source path
-        value = source
-        for part in field.split("."):
-            if not isinstance(value, dict) or part not in value:
-                value = None
-                break
-            value = value[part]
+        # walk the source path (multi-field subfields read the parent's
+        # source value, like the reference's SourceFieldMapper lookup)
+        def _walk(path):
+            v = source
+            for part in path.split("."):
+                if not isinstance(v, dict) or part not in v:
+                    return None
+                v = v[part]
+            return v
+        value = _walk(field)
+        if value is None and "." in field:
+            value = _walk(field.rsplit(".", 1)[0])
         if value is None:
             continue
         values = value if isinstance(value, list) else [value]
         analyzer = getattr(ft, "search_analyzer", None) or \
             getattr(ft, "analyzer", None)
         frags: List[str] = []
+        ign = getattr(ft, "ignore_above", None)
+        if max_ao is not None:
+            # re-analysis beyond the cap is rejected; offsets stored at
+            # index time (index_options offsets / term vectors) let the
+            # unified and fvh highlighters skip re-analysis
+            has_offsets = ft.params.get("index_options") == "offsets" or \
+                ft.params.get("term_vector") == "with_positions_offsets"
+            hl_type = fspec.get("type", highlight_spec.get("type"))
+            needs_analysis = hl_type == "plain" or not has_offsets
+            if needs_analysis and any(len(str(v)) > max_ao
+                                      for v in values):
+                raise IllegalArgumentError(
+                    f"The length of [{field}] field of a doc exceeds "
+                    f"the [index.highlight.max_analyzed_offset] limit "
+                    f"of [{max_ao}]. To avoid this error, set the query "
+                    f"parameter [max_analyzed_offset] to a value less "
+                    f"than index setting value and this will tolerate "
+                    f"long field values by truncating them.")
         for v in values:
             text = str(v)
+            if ign is not None and len(text) > ign:
+                continue    # value was ignored at index time: no marks
             spans = []
             if analyzer is not None:
                 for tok in analyzer.analyze(text):

@@ -672,7 +672,9 @@ class RangeQuery(Query):
                         v, bool) and 1000 <= v <= 9999 and \
                         float(v).is_integer():
                     v = str(int(v))
-                return parse_date_millis(v, fmt, round_up=round_up)
+                return parse_date_millis(
+                    v, fmt, round_up=round_up,
+                    locale=getattr(ft, "locale", "en"))
             lo_v = _bound(lo, round_up=self.gte is None) \
                 if lo is not None else None
             hi_v = _bound(hi, round_up=self.lte is not None) \
@@ -831,6 +833,20 @@ class PrefixQuery(Query):
                          np.asarray([length], np.int32))
         mask = matched > 0
         return jnp.where(mask, np.float32(self.boost), 0.0), mask
+
+    def collect_highlight_terms(self, ctx, out):
+        # expand the prefix over the shard's term dictionaries so the
+        # highlighter can mark the concrete matching terms
+        dest = out.setdefault(self.field, set())
+        for seg in ctx.segments:
+            f = seg.text_fields.get(self.field)
+            terms = list(f.term_ids) if f is not None else None
+            if terms is None:
+                kf = seg.keyword_fields.get(self.field)
+                terms = kf.ord_terms if kf is not None else []
+            for t in terms:
+                if t.startswith(self.value):
+                    dest.add(t)
 
 
 def wildcard_regex(pattern: str) -> "re.Pattern":
@@ -1778,7 +1794,45 @@ def _parse_nested(body):
                        score_mode=body.get("score_mode", "avg"))
 
 
+class _LazyMultiMatch(Query):
+    """multi_match with wildcard field patterns: expansion needs the
+    mapping, which only exists at execute time (reference:
+    ``QueryParserHelper.resolveMappingFields``)."""
+
+    def __init__(self, body):
+        self.body = body
+        self._built = None
+
+    def _build(self, ctx):
+        if self._built is None:
+            import fnmatch
+            fields = []
+            for f in self.body.get("fields") or []:
+                pat, caret, boost = f.partition("^")
+                if "*" in pat:
+                    from ..index.mapping import (KeywordFieldType,
+                                                 TextFieldType)
+                    for n, ft in getattr(ctx.mapper, "_fields",
+                                         {}).items():
+                        if fnmatch.fnmatchcase(n, pat) and isinstance(
+                                ft, (TextFieldType, KeywordFieldType)):
+                            fields.append(n + caret + boost)
+                else:
+                    fields.append(f)
+            self._built = _parse_multi_match(
+                dict(self.body, fields=fields))
+        return self._built
+
+    def execute(self, ctx, seg):
+        return self._build(ctx).execute(ctx, seg)
+
+    def collect_highlight_terms(self, ctx, out):
+        self._build(ctx).collect_highlight_terms(ctx, out)
+
+
 def _parse_multi_match(body):
+    if any("*" in (f or "") for f in body.get("fields") or []):
+        return _LazyMultiMatch(body)
     fields = body.get("fields") or []
     text = body.get("query")
     mtype = body.get("type", "best_fields")

@@ -175,6 +175,24 @@ class ShardSearcher:
         ft = self.mapper.field_type(field)
         if isinstance(ft, RuntimeFieldType):
             return ft.column(seg)[docs]
+        if isinstance(ft, DateFieldType) and ft.nanos:
+            if clause.get("numeric_type") == "date":
+                # unified ms domain requested: the float column suffices
+                return seg.numeric_first_value_column(field)[docs]
+            i64 = getattr(seg, "int64_fields", {}).get(
+                ft.name if ft.name else field)
+            vals = np.full(len(docs), None, dtype=object)
+            if i64 is not None:
+                idocs, ivals = i64
+                first: Dict[int, int] = {}
+                for d_, v_ in zip(idocs.tolist()[::-1],
+                                  ivals.tolist()[::-1]):
+                    first[d_] = v_
+                for i, d_ in enumerate(docs):
+                    vals[i] = first.get(int(d_))
+            # exact ns longs as an object column: float64 loses the
+            # bottom bits of ns-resolution epochs
+            return vals
         nf = seg.numeric_fields.get(field)
         if nf is not None or isinstance(ft, (NumberFieldType, DateFieldType)):
             return seg.numeric_first_value_column(field)[docs]
@@ -441,8 +459,27 @@ class ShardSearcher:
         field_specs = body.get("fields") or []
         hl_spec = body.get("highlight")
         hl_terms: Dict[str, set] = {}
+        hl_field_terms: Dict[str, set] = {}
         if hl_spec:
             query.collect_highlight_terms(self.ctx, hl_terms)
+            fs = hl_spec.get("fields", {})
+            if isinstance(fs, list):
+                merged_fs = {}
+                for f_ in fs:
+                    merged_fs.update(f_)
+                fs = merged_fs
+            for hf, hf_spec in fs.items():
+                hq = (hf_spec or {}).get("highlight_query")
+                if hq:
+                    # per-field override query supplies THE terms
+                    # (HighlightBuilder#highlightQuery)
+                    ov: Dict[str, set] = {}
+                    parse_query(hq).collect_highlight_terms(self.ctx, ov)
+                    hl_field_terms[hf] = set().union(*ov.values()) \
+                        if ov else set()
+            hl_spec = dict(hl_spec, _field_terms=hl_field_terms,
+                           _max_analyzed_offset=getattr(
+                               self, "max_analyzed_offset", None))
 
         collapse_keyf = (self._collapse_key_fn(collapse_spec["field"])
                          if collapse_spec else None)
@@ -594,13 +631,19 @@ class ShardSearcher:
                     ns = qw * sc
                 rescored.append((ns, si, d))
             rescored.sort(key=lambda c: (-c[0], c[1], c[2]))
-            candidates = rescored + candidates[window:]
+            # below the window, ranks hold but the primary weight still
+            # applies (QueryRescorer keeps score*queryWeight there)
+            tail = [(qw * sc, si, d) for sc, si, d in candidates[window:]]
+            candidates = rescored + tail
         return candidates
 
     def _collapse_key_fn(self, field: str):
         """(seg_idx, doc) → group key for the collapse field (first value;
         None groups together, like the reference's null group)."""
         ft = self.mapper.field_type(field)
+        if ft is not None and ft.name != field:
+            field = ft.name             # alias → concrete column
+
         if isinstance(ft, KeywordFieldType):
             tables: Dict[int, Dict[int, str]] = {}
 
@@ -727,13 +770,15 @@ class ShardSearcher:
             missing_last = clause["missing"] != "_first"
             fill = _MISSING_LAST if (missing_last != desc) else -_MISSING_LAST
             return -fill if desc else fill
-        if field == "_score" or field == "_doc" or isinstance(
-                after_value, (int, float)):
+        if raw_col.dtype != object and (
+                field == "_score" or field == "_doc" or isinstance(
+                    after_value, (int, float))):
             v = float(after_value)
             return -v if desc else v
-        # string cursor: odd/even code trick — present values have even
-        # codes; an absent cursor value lands between codes
-        uniq = sorted({v for v in raw_col if isinstance(v, str)})
+        # object-column cursor (strings, exact ns longs): odd/even code
+        # trick — present values have even codes; an absent cursor value
+        # lands between codes
+        uniq = sorted({v for v in raw_col if v is not None})
         import bisect
         i = bisect.bisect_left(uniq, after_value)
         if i < len(uniq) and uniq[i] == after_value:
@@ -787,7 +832,8 @@ def normalize_sort(sort_spec) -> List[dict]:
             raise ParsingError(f"invalid sort clause [{clause}]")
         order = opts.get("order", "desc" if field == "_score" else "asc")
         out.append({"field": field, "order": order,
-                    "missing": opts.get("missing", "_last")})
+                    "missing": opts.get("missing", "_last"),
+                    "numeric_type": opts.get("numeric_type")})
     return out
 
 
