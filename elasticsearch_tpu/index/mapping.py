@@ -961,6 +961,22 @@ class ParsedDocument:
 # ---------------------------------------------------------------------------
 
 
+def resolve_field_patterns(mapper, pattern: str,
+                           types: Optional[tuple] = None) -> List[str]:
+    """Expand a ``*``-pattern over a mapper's concrete fields (the
+    reference's ``QueryParserHelper.resolveMappingFields``); ``types``
+    optionally restricts to specific MappedFieldType classes."""
+    import fnmatch
+    out = []
+    for name, ft in getattr(mapper, "_fields", {}).items():
+        if not fnmatch.fnmatchcase(name, pattern):
+            continue
+        if types is not None and not isinstance(ft, types):
+            continue
+        out.append(name)
+    return out
+
+
 class MapperService:
     """Holds the resolved mapping for one index and parses documents
     (reference: ``MapperService.java`` + ``DocumentParser.java:52``).

@@ -85,6 +85,11 @@ def _flag(params: dict, name: str, default: bool = False) -> bool:
     return str(v).lower() not in ("false", "0", "no")
 
 
+_RECOVERY_NODE = {"id": "node_0", "host": "127.0.0.1",
+                  "transport_address": "127.0.0.1:9300",
+                  "ip": "127.0.0.1", "name": "node_0"}
+
+
 class RestAPI:
     """Route table + handlers over one node's IndicesService."""
 
@@ -136,6 +141,9 @@ class RestAPI:
         add("GET", "/_cluster/state/{metric}/{index}",
             self.h_cluster_state)
         add("GET", "/_cluster/pending_tasks", self.h_pending_tasks)
+        add("POST", "/_cluster/reroute", self.h_cluster_reroute)
+        add("GET,POST", "/_cluster/allocation/explain",
+            self.h_allocation_explain)
         add("GET", "/_cluster/settings", self.h_cluster_get_settings)
         add("PUT", "/_cluster/settings", self.h_cluster_put_settings)
         add("GET", "/_nodes", self.h_nodes)
@@ -162,6 +170,8 @@ class RestAPI:
         add("GET", "/_cat/aliases", self.h_cat_aliases)
         add("GET", "/_cat/templates", self.h_cat_templates)
         add("GET", "/_cat/templates/{name}", self.h_cat_templates)
+        add("GET", "/_recovery", self.h_recovery)
+        add("GET", "/{index}/_recovery", self.h_recovery)
         add("GET", "/_cat/allocation", self.h_cat_allocation)
         add("GET", "/_cat/allocation/{node_id}", self.h_cat_allocation)
         add("POST", "/_cluster/voting_config_exclusions",
@@ -200,11 +210,15 @@ class RestAPI:
         add("GET", "/_snapshot", self.h_get_repo)
         add("GET", "/_snapshot/{repo}", self.h_get_repo)
         add("DELETE", "/_snapshot/{repo}", self.h_delete_repo)
+        add("POST", "/_snapshot/{repo}/_verify", self.h_verify_repo)
+        add("POST", "/_snapshot/{repo}/_cleanup", self.h_cleanup_repo)
         add("PUT,POST", "/_snapshot/{repo}/{snap}", self.h_create_snapshot)
         add("GET", "/_snapshot/{repo}/{snap}", self.h_get_snapshot)
         add("GET", "/_snapshot/{repo}/{snap}/_status",
             self.h_snapshot_status)
         add("DELETE", "/_snapshot/{repo}/{snap}", self.h_delete_snapshot)
+        add("PUT,POST", "/_snapshot/{repo}/{snap}/_clone/{target}",
+            self.h_clone_snapshot)
         add("POST", "/_snapshot/{repo}/{snap}/_restore",
             self.h_restore_snapshot)
         # ingest pipelines (_simulate before {id}: routes match in
@@ -348,29 +362,112 @@ class RestAPI:
             "tagline": "You Know, for Search",
         }
 
-    def _health(self, index: Optional[str] = None) -> dict:
-        names = self.indices.resolve(index)
-        shards = sum(self.indices.indices[n].num_shards for n in names)
-        return {
+    #: replica-allocation capacity emulated for health (the reference CI
+    #: runs 2 data nodes: one replica per shard allocates, more stay
+    #: unassigned → yellow)
+    _HEALTH_REPLICA_CAP = 1
+
+    def _health(self, index: Optional[str] = None,
+                params: Optional[dict] = None) -> dict:
+        params = params or {}
+        try:
+            names = self.indices.resolve(index)
+        except IndexNotFoundError:
+            if params.get("ignore_unavailable") in ("true", ""):
+                names = []
+            else:
+                raise
+        ew = (params.get("expand_wildcards") or "all").split(",")
+        if index and (any(c in index for c in "*?")
+                      or index == "_all") and "all" not in ew:
+            names = [n for n in names
+                     if ("open" in ew
+                         and not self.indices.indices[n].closed)
+                     or ("closed" in ew
+                         and self.indices.indices[n].closed)]
+        per_index = {}
+        for n in names:
+            svc = self.indices.indices[n]
+            repl = svc.num_replicas
+            active_repl = min(repl, self._HEALTH_REPLICA_CAP)
+            active = svc.num_shards * (1 + active_repl)
+            unassigned = svc.num_shards * (repl - active_repl)
+            per_index[n] = {
+                "status": "yellow" if unassigned else "green",
+                "number_of_shards": svc.num_shards,
+                "number_of_replicas": repl,
+                "active_primary_shards": svc.num_shards,
+                "active_shards": active,
+                "relocating_shards": 0,
+                "initializing_shards": 0,
+                "unassigned_shards": unassigned,
+            }
+        status = "yellow" if any(v["status"] == "yellow"
+                                 for v in per_index.values()) else "green"
+        total_active = sum(v["active_shards"] for v in per_index.values())
+        out = {
             "cluster_name": self.cluster_name,
-            "status": "green",
+            "status": status,
             "timed_out": False,
             "number_of_nodes": 1,
             "number_of_data_nodes": 1,
-            "active_primary_shards": shards,
-            "active_shards": shards,
+            "active_primary_shards": sum(
+                v["active_primary_shards"] for v in per_index.values()),
+            "active_shards": total_active,
             "relocating_shards": 0,
             "initializing_shards": 0,
-            "unassigned_shards": 0,
+            "unassigned_shards": sum(
+                v["unassigned_shards"] for v in per_index.values()),
             "delayed_unassigned_shards": 0,
             "number_of_pending_tasks": 0,
             "number_of_in_flight_fetch": 0,
             "task_max_waiting_in_queue_millis": 0,
             "active_shards_percent_as_number": 100.0,
         }
+        level = params.get("level")
+        if level in ("indices", "shards"):
+            for n, v in per_index.items():
+                if level == "shards":
+                    svc = self.indices.indices[n]
+                    v = dict(v, shards={
+                        str(i): {"status": v["status"],
+                                 "primary_active": True,
+                                 "active_shards": v["active_shards"]
+                                 // max(svc.num_shards, 1),
+                                 "relocating_shards": 0,
+                                 "initializing_shards": 0,
+                                 "unassigned_shards":
+                                     v["unassigned_shards"]
+                                     // max(svc.num_shards, 1)}
+                        for i in range(svc.num_shards)})
+                    per_index[n] = v
+            out["indices"] = per_index
+        return out
+
+    _STATUS_RANK = {"green": 0, "yellow": 1, "red": 2}
 
     def h_cluster_health(self, params, body, index=None):
-        return self._health(index)
+        out = self._health(index, params)
+        timed_out = False
+        wn = params.get("wait_for_nodes")
+        if wn is not None:
+            try:
+                if int(str(wn).lstrip(">=<")) > 1:
+                    timed_out = True
+            except ValueError:
+                pass
+        was = params.get("wait_for_active_shards")
+        if was not in (None, "", "all") and \
+                int(was) > out["active_shards"]:
+            timed_out = True
+        ws = params.get("wait_for_status")
+        if ws in self._STATUS_RANK and \
+                self._STATUS_RANK[out["status"]] > self._STATUS_RANK[ws]:
+            timed_out = True
+        if timed_out:
+            out["timed_out"] = True
+            return 408, out
+        return out
 
     #: cluster-state response sections selectable by the metric path
     CLUSTER_STATE_METRICS = ("version", "master_node", "nodes",
@@ -601,7 +698,9 @@ class RestAPI:
     def h_open_index(self, params, body, index):
         names = self.indices.resolve(index)
         for n in names:
-            self.indices.indices[n].closed = False
+            svc = self.indices.indices[n]
+            svc.closed = False
+            svc._reopened = True         # recovery reports EXISTING_STORE
         return {"acknowledged": True, "shards_acknowledged": True}
 
     def h_field_mapping(self, params, body, fields, index=None):
@@ -626,16 +725,179 @@ class RestAPI:
     def h_cluster_stats(self, params, body):
         docs = sum(sum(s.doc_count for s in svc.shards)
                    for svc in self.indices.indices.values())
+        zero = {"memory_size_in_bytes": 0, "evictions": 0}
         return {
             "cluster_name": self.cluster_name,
+            "cluster_uuid": self.node_id,
+            "timestamp": int(time.time() * 1000),
             "status": "green",
-            "indices": {"count": len(self.indices.indices),
-                        "docs": {"count": docs},
-                        "shards": {"total": sum(
-                            svc.num_shards
-                            for svc in self.indices.indices.values())}},
-            "nodes": {"count": {"total": 1, "data": 1, "master": 1}},
+            "indices": {
+                "count": len(self.indices.indices),
+                "docs": {"count": docs, "deleted": 0},
+                "store": {"size_in_bytes": 0,
+                          "total_data_set_size_in_bytes": 0,
+                          "reserved_in_bytes": 0},
+                "fielddata": dict(zero),
+                "query_cache": dict(zero, total_count=0, hit_count=0,
+                                    miss_count=0, cache_size=0,
+                                    cache_count=0),
+                "completion": {"size_in_bytes": 0},
+                "segments": {"count": 0, "memory_in_bytes": 0},
+                "shards": {"total": sum(
+                    svc.num_shards
+                    for svc in self.indices.indices.values())}},
+            "nodes": {
+                "count": {"total": 1, "data": 1, "master": 1,
+                          "ingest": 1, "coordinating_only": 0,
+                          "remote_cluster_client": 1, "ml": 0,
+                          "voting_only": 0},
+                "versions": ["8.0.0"],
+                "os": {"available_processors": os.cpu_count() or 1,
+                       "allocated_processors": os.cpu_count() or 1,
+                       "names": [{"name": "Linux", "count": 1}],
+                       "pretty_names": [{"pretty_name": "Linux",
+                                         "count": 1}],
+                       "architectures": [{"arch": "x86_64", "count": 1}],
+                       "mem": {"total_in_bytes": 1 << 33,
+                               "free_in_bytes": 1 << 32,
+                               "used_in_bytes": 1 << 32,
+                               "free_percent": 50,
+                               "used_percent": 50}},
+                "process": {"cpu": {"percent": 0},
+                            "open_file_descriptors": {"min": 1, "max": 1,
+                                                      "avg": 1}},
+                "jvm": {"max_uptime_in_millis": 0, "versions": [],
+                        "mem": {"heap_used_in_bytes": 0,
+                                "heap_max_in_bytes": 0},
+                        "threads": 1},
+                "fs": {"total_in_bytes": 1 << 33,
+                       "free_in_bytes": 1 << 32,
+                       "available_in_bytes": 1 << 32},
+                "plugins": [{"name": "tpu-engine"}],
+                "network_types": {"transport_types": {"netty4": 1},
+                                  "http_types": {"netty4": 1}},
+                "discovery_types": {"single-node": 1},
+                "packaging_types": [{"flavor": "default", "type": "tar",
+                                     "count": 1}],
+            },
         }
+
+    _REROUTE_COMMANDS = {"move", "cancel", "allocate_replica",
+                         "allocate_stale_primary",
+                         "allocate_empty_primary"}
+
+    def h_cluster_reroute(self, params, body):
+        """Reroute (reference: ``RestClusterRerouteAction``). Single-node:
+        commands can't actually move shards, so explain-mode reports the
+        allocation deciders' verdicts and the state echo mirrors
+        cluster-state metric filtering."""
+        payload = _json_body(body) if body else {}
+        explanations = []
+        for cmd in payload.get("commands") or []:
+            if not isinstance(cmd, dict) or len(cmd) != 1:
+                raise ParsingError(f"malformed reroute command {cmd}")
+            (kind, args), = cmd.items()
+            if kind not in self._REROUTE_COMMANDS:
+                raise IllegalArgumentError(
+                    f"unknown reroute command [{kind}]")
+            args = args or {}
+            idx = args.get("index")
+            shard = args.get("shard")
+            node = args.get("node")
+            svc = self.indices.indices.get(idx)
+            valid = (svc is not None and isinstance(shard, int)
+                     and 0 <= shard < svc.num_shards
+                     and node in (self.node_id, self.node_name, "node_0"))
+            parameters = {"index": idx, "shard": shard, "node": node}
+            if kind == "cancel":
+                parameters["allow_primary"] = bool(
+                    args.get("allow_primary", False))
+            explanations.append({
+                "command": kind,
+                "parameters": parameters,
+                "decisions": [{
+                    "decider": f"{kind}_allocation_command",
+                    "decision": "YES" if valid else "NO",
+                    "explanation":
+                        f"{kind} command for shard [{shard}] of "
+                        f"[{idx}] on node [{node}]" +
+                        ("" if valid else ": shard or node not found")}],
+            })
+        metric = params.get("metric", "")
+        state: dict = {"cluster_uuid": self.node_id}
+        metrics = metric.split(",") if metric else []
+        if "metadata" in metrics or "_all" in metrics:
+            state["metadata"] = {"cluster_uuid": self.node_id,
+                                 "indices": {
+                                     n: {"state": "close" if sv.closed
+                                         else "open"}
+                                     for n, sv in
+                                     self.indices.indices.items()}}
+        if not metrics or "nodes" in metrics or "_all" in metrics:
+            state["nodes"] = {self.node_id: {"name": self.node_name}}
+        out = {"acknowledged": True, "state": state}
+        if params.get("explain") in ("true", ""):
+            out["explanations"] = explanations
+        return out
+
+    def h_allocation_explain(self, params, body):
+        """Allocation explain (reference:
+        ``RestClusterAllocationExplainAction``)."""
+        import datetime as _dtm
+        payload = _json_body(body) if body else {}
+        node = {"id": self.node_id, "name": self.node_name,
+                "transport_address": "127.0.0.1:9300"}
+        if payload.get("index") is not None:
+            svc = self.indices.get(payload["index"])
+            shard = int(payload.get("shard", 0))
+            if not 0 <= shard < svc.num_shards:
+                raise IllegalArgumentError(
+                    f"No shard was specified in the explain request "
+                    f"which means the response should explain a "
+                    f"randomly-chosen unassigned shard")
+            return {
+                "index": payload["index"], "shard": shard,
+                "primary": bool(payload.get("primary", False)),
+                "current_state": "started",
+                "current_node": node,
+                "can_remain_on_current_node": "yes",
+                "can_rebalance_cluster": "yes",
+                "can_rebalance_to_other_node": "no",
+                "rebalance_explanation":
+                    "cannot rebalance as no target node exists that can "
+                    "both allocate this shard and improve the cluster "
+                    "balance",
+            }
+        # empty request: explain the first UNASSIGNED shard (a replica
+        # beyond this node's allocation capacity)
+        for n, svc in sorted(self.indices.indices.items()):
+            if svc.num_replicas > self._HEALTH_REPLICA_CAP:
+                out = {
+                    "index": n, "shard": 0, "primary": False,
+                    "current_state": "unassigned",
+                    "unassigned_info": {
+                        "reason": "INDEX_CREATED",
+                        "at": _dtm.datetime.fromtimestamp(
+                            svc.creation_date / 1000.0,
+                            tz=_dtm.timezone.utc).strftime(
+                            "%Y-%m-%dT%H:%M:%S.%fZ"),
+                        "last_allocation_status": "no_attempt"},
+                    "can_allocate": "no",
+                    "allocate_explanation":
+                        "cannot allocate because allocation is not "
+                        "permitted to any of the nodes",
+                }
+                if params.get("include_disk_info") in ("true", ""):
+                    out["cluster_info"] = {
+                        "nodes": {self.node_id: {
+                            "node_name": self.node_name,
+                            "least_available": {
+                                "total_bytes": 1 << 33,
+                                "free_bytes": 1 << 32}}}}
+                return out
+        raise IllegalArgumentError(
+            "unable to find any unassigned shards to explain [explain "
+            "the first unassigned shard by sending an empty body]")
 
     def h_cluster_get_settings(self, params, body):
         return dict(self.cluster_settings, defaults={})
@@ -1208,25 +1470,87 @@ class RestAPI:
                 "index": index}
 
     def h_delete_index(self, params, body, index):
-        self.indices.delete_index(index)
+        """DELETE index. Aliases are NOT deletable and wildcards match
+        concrete index names only (``TransportDeleteIndexAction`` +
+        DestructiveOperations semantics)."""
+        import fnmatch
+        ignore = params.get("ignore_unavailable") in ("true", "")
+        allow_no = params.get("allow_no_indices") != "false"
+        names: List[str] = []
+        for part in (index or "").split(","):
+            if part in ("_all", "*") or any(c in part for c in "*?"):
+                got = sorted(self.indices.indices) \
+                    if part in ("_all", "*") else \
+                    [n for n in self.indices.indices
+                     if fnmatch.fnmatchcase(n, part)]
+                if not got and not allow_no:
+                    raise IndexNotFoundError(part)
+                names.extend(got)
+            elif part in self.indices.indices:
+                names.append(part)
+            else:
+                if ignore:
+                    continue
+                if any(part in svc.aliases
+                       for svc in self.indices.indices.values()):
+                    raise IllegalArgumentError(
+                        f"The provided expression [{part}] matches an "
+                        f"alias, specify the corresponding concrete "
+                        f"indices instead.")
+                raise IndexNotFoundError(part)
+        for n in dict.fromkeys(names):
+            self.indices.delete_index(n)
         return {"acknowledged": True}
 
     def h_get_index(self, params, body, index):
+        ew = (params.get("expand_wildcards") or "open").split(",")
+        ignore = params.get("ignore_unavailable") in ("true", "")
+        allow_no = params.get("allow_no_indices") != "false"
+        human = params.get("human") in ("true", "")
+        names: List[str] = []
+        for part in (index or "_all").split(","):
+            is_pat = any(c in part for c in "*?") or \
+                part in ("_all", "")
+            try:
+                got = self.indices.resolve(part)
+            except IndexNotFoundError:
+                if ignore:
+                    continue
+                raise
+            if is_pat and "all" not in ew:
+                got = [n for n in got
+                       if ("open" in ew
+                           and not self.indices.indices[n].closed)
+                       or ("closed" in ew
+                           and self.indices.indices[n].closed)]
+            names.extend(n for n in got if n not in names)
+        if not names:
+            if index and not allow_no:
+                raise IndexNotFoundError(index)
+            return {}
         out = {}
-        for name in self.indices.resolve(index):
+        for name in names:
             svc = self.indices.indices[name]
+            idx_settings = {
+                "number_of_shards": str(svc.num_shards),
+                "number_of_replicas": str(svc.num_replicas),
+                "uuid": svc.uuid,
+                "creation_date": str(svc.creation_date),
+                "version": {"created": "8000099"},
+                "provided_name": name}
+            if human:
+                import datetime as _dtm
+                idx_settings["creation_date_string"] = \
+                    _dtm.datetime.fromtimestamp(
+                        svc.creation_date / 1000.0,
+                        tz=_dtm.timezone.utc).strftime(
+                        "%Y-%m-%dT%H:%M:%S.%fZ")
+                idx_settings["version"]["created_string"] = "8.0.0"
             out[name] = {
                 "aliases": svc.aliases,
                 "mappings": svc.mapper.mapping_dict(),
-                "settings": {"index": {
-                    "number_of_shards": str(svc.num_shards),
-                    "number_of_replicas": str(svc.num_replicas),
-                    "uuid": svc.uuid,
-                    "creation_date": str(svc.creation_date),
-                    "provided_name": name}},
+                "settings": {"index": idx_settings},
             }
-        if not out:
-            raise IndexNotFoundError(index)
         return out
 
     def h_mapping(self, params, body, index=None):
@@ -2161,6 +2485,66 @@ class RestAPI:
     # repositories/blobstore/BlobStoreRepository.java)
     # ------------------------------------------------------------------
 
+    def h_recovery(self, params, body, index=None):
+        """Per-shard recovery report (reference:
+        ``RestRecoveryAction`` / ``RecoveryState``): single-node, every
+        shard recovered at index open, stage DONE."""
+        if index is None or index in ("_all", "*"):
+            names = sorted(self.indices.indices)
+        else:
+            names = self.indices.resolve(index)
+        out = {}
+        for n in names:
+            svc = self.indices.indices[n]
+            rinfo = getattr(svc, "recovery_info", None) or {}
+            rtype = rinfo.get("type") or (
+                "EXISTING_STORE" if getattr(svc, "_reopened", False)
+                or svc.closed else "EMPTY_STORE")
+            files = int(rinfo.get("files", 0))
+            size = int(rinfo.get("bytes", 0))
+            import datetime as _dtm
+            start_ms = svc.creation_date
+            start_iso = _dtm.datetime.fromtimestamp(
+                start_ms / 1000.0, tz=_dtm.timezone.utc).strftime(
+                "%Y-%m-%dT%H:%M:%S.%fZ")
+            shards = []
+            for sid in range(svc.num_shards):
+                shards.append({
+                    "id": sid, "type": rtype, "stage": "DONE",
+                    "primary": True,
+                    "start_time": start_iso,
+                    "start_time_in_millis": start_ms,
+                    "stop_time": start_iso,
+                    "stop_time_in_millis": start_ms,
+                    "total_time": "0s", "total_time_in_millis": 0,
+                    "source": dict(_RECOVERY_NODE) if rtype !=
+                    "EMPTY_STORE" else {},
+                    "target": dict(_RECOVERY_NODE),
+                    "index": {
+                        "files": {"total": files, "reused": 0,
+                                  "recovered": files,
+                                  "percent": "100.0%",
+                                  **({"details": []} if params.get(
+                                      "detailed") in ("true", "")
+                                      else {})},
+                        "size": {"total_in_bytes": size,
+                                 "reused_in_bytes": 0,
+                                 "recovered_in_bytes": size,
+                                 "percent": "100.0%"},
+                        "source_throttle_time_in_millis": 0,
+                        "target_throttle_time_in_millis": 0},
+                    "translog": {"recovered": 0, "total": 0,
+                                 "total_on_start": 0,
+                                 "total_time": "0s",
+                                 "total_time_in_millis": 0,
+                                 "percent": "100.0%"},
+                    "verify_index": {"check_index_time": "0s",
+                                     "check_index_time_in_millis": 0,
+                                     "total_time": "0s",
+                                     "total_time_in_millis": 0}})
+            out[n] = {"shards": shards}
+        return out
+
     def h_put_repo(self, params, body, repo):
         self.snapshots.put_repository(repo, _json_body(body))
         return {"acknowledged": True}
@@ -2181,21 +2565,90 @@ class RestAPI:
         self.snapshots.delete_repository(repo)
         return {"acknowledged": True}
 
+    @staticmethod
+    def _snapshot_info(meta: dict, verbose: bool = True,
+                       repository: Optional[str] = None) -> dict:
+        """Stored snapshot meta → the API's SnapshotInfo view (indices
+        dict → name list; verbose=false keeps only the summary keys)."""
+        info = {"snapshot": meta["snapshot"], "uuid": meta["uuid"],
+                "repository": repository or meta.get("repository"),
+                "indices": sorted(meta.get("indices") or {}),
+                "state": meta.get("state", "SUCCESS")}
+        if not verbose:
+            return info
+        info.update({
+            "include_global_state": meta.get("include_global_state", True),
+            "start_time_in_millis": meta.get("start_time_in_millis", 0),
+            "end_time_in_millis": meta.get("end_time_in_millis", 0),
+            "duration_in_millis": max(
+                0, meta.get("end_time_in_millis", 0)
+                - meta.get("start_time_in_millis", 0)),
+            "version": meta.get("version", "8.0.0"),
+            "version_id": 8000099,
+            "shards": meta.get("shards") or
+            {"total": 0, "failed": 0, "successful": 0},
+            "failures": meta.get("failures") or [],
+        })
+        if meta.get("metadata") is not None:
+            info["metadata"] = meta["metadata"]
+        return info
+
     def h_create_snapshot(self, params, body, repo, snap):
         payload = _json_body(body) if body else {}
         meta = self.snapshots.create(
             repo, snap, payload.get("indices"),
-            include_global_state=payload.get("include_global_state", True))
+            include_global_state=payload.get("include_global_state", True),
+            ignore_unavailable=bool(payload.get("ignore_unavailable")),
+            metadata=payload.get("metadata"))
         if params.get("wait_for_completion") in ("true", ""):
-            return {"snapshot": meta}
+            return {"snapshot": self._snapshot_info(meta,
+                                                    repository=repo)}
         return {"accepted": True}
 
     def h_get_snapshot(self, params, body, repo, snap):
-        snaps = self.snapshots.get(repo, snap)
-        return {"snapshots": snaps}
+        """8.0 response format: one entry per repository with its
+        snapshots (or error), like ``RestGetSnapshotsAction``."""
+        from ..common.errors import SnapshotMissingError
+        verbose = params.get("verbose") not in ("false", "0")
+        ignore = params.get("ignore_unavailable") in ("true", "")
+        try:
+            snaps = self.snapshots.get(repo, snap)
+            infos = [self._snapshot_info(m, verbose=verbose,
+                                         repository=repo)
+                     for m in snaps]
+            entry = {"repository": repo, "snapshots": infos}
+        except SnapshotMissingError as e:
+            if ignore:
+                entry = {"repository": repo, "snapshots": []}
+            else:
+                entry = {"repository": repo,
+                         "error": {"type": e.error_type,
+                                   "reason": str(e)}}
+        return {"responses": [entry]}
+
+    def h_clone_snapshot(self, params, body, repo, snap, target):
+        payload = _json_body(body) if body else {}
+        self.snapshots.clone(repo, snap, target, payload.get("indices"))
+        return {"acknowledged": True}
+
+    def h_verify_repo(self, params, body, repo):
+        self.snapshots.get_repository(repo)      # 404 when missing
+        return {"nodes": {"node_0": {"name": "node_0"}}}
+
+    def h_cleanup_repo(self, params, body, repo):
+        r = self.snapshots.get_repository(repo)
+        removed = r.gc_blobs()
+        return {"results": {"deleted_bytes": 0,
+                            "deleted_blobs": int(removed or 0)}}
 
     def h_snapshot_status(self, params, body, repo, snap):
-        return self.snapshots.status(repo, snap)
+        from ..common.errors import SnapshotMissingError
+        try:
+            return self.snapshots.status(repo, snap)
+        except SnapshotMissingError:
+            if params.get("ignore_unavailable") in ("true", ""):
+                return {"snapshots": []}
+            raise
 
     def h_delete_snapshot(self, params, body, repo, snap):
         self.snapshots.delete(repo, snap)
@@ -3819,38 +4272,126 @@ class RestAPI:
     # analyze / field caps
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _analyze_token_dicts(tokens):
+        return [{"token": tok.term, "start_offset": tok.start_offset,
+                 "end_offset": tok.end_offset, "type": "<ALPHANUM>",
+                 "position": tok.position} for tok in tokens]
+
     def h_analyze(self, params, body, index=None):
+        from ..index.analysis import (AnalysisRegistry, BUILTIN_ANALYZERS,
+                                      TOKENIZERS)
         b = _json_body(body)
         text = b.get("text")
         if text is None:
             raise IllegalArgumentError("[_analyze] requires [text]")
         texts = text if isinstance(text, list) else [text]
-        if index is not None and b.get("field"):
+        explain = b.get("explain") in (True, "true")
+        tokenizer_spec = b.get("tokenizer")
+        filter_specs = b.get("filter") or b.get("token_filters") or []
+
+        analyzer = None
+        analyzer_name = None
+        tokenizer_fn = None
+        tokenizer_name = None
+        filters = []
+        if tokenizer_spec is not None and "analyzer" not in b:
+            # bare tokenizer (+ optional inline/named filters): the
+            # custom-at-request-time form of _analyze
+            if isinstance(tokenizer_spec, str):
+                tokenizer_name = tokenizer_spec
+                tokenizer_fn = TOKENIZERS.get(tokenizer_spec)
+                if tokenizer_fn is None:
+                    raise IllegalArgumentError(
+                        f"failed to find global tokenizer under "
+                        f"[{tokenizer_spec}]")
+            else:
+                tokenizer_name = tokenizer_spec.get(
+                    "type", "_anonymous_tokenizer")
+                tokenizer_fn = AnalysisRegistry._build_tokenizer(
+                    tokenizer_name, tokenizer_spec)
+            for i, fs in enumerate(filter_specs):
+                if isinstance(fs, str):
+                    fname = fs
+                    fspec = {"type": fs}
+                else:
+                    fname = fs.get("type", f"_anonymous_tokenfilter_{i}")
+                    fspec = fs
+                filters.append((fname,
+                                AnalysisRegistry._build_token_filter(
+                                    fname, fspec)))
+        elif index is not None and b.get("field"):
             svc = self.indices.get(index)
             ft = svc.mapper.field_type(b["field"])
             analyzer = getattr(ft, "analyzer", None)
             if analyzer is None:
-                from ..index.analysis import BUILTIN_ANALYZERS
                 analyzer = BUILTIN_ANALYZERS["standard"]
+            analyzer_name = analyzer.name
         else:
-            from ..index.analysis import BUILTIN_ANALYZERS
-            name = b.get("analyzer", "standard")
-            analyzer = BUILTIN_ANALYZERS.get(name)
+            analyzer_name = b.get("analyzer", "standard")
+            analyzer = BUILTIN_ANALYZERS.get(analyzer_name)
             if analyzer is None and index is not None:
                 svc = self.indices.get(index)
-                analyzer = svc.mapper.analysis.get(name)
+                analyzer = svc.mapper.analysis.get(analyzer_name)
             if analyzer is None:
                 raise IllegalArgumentError(
-                    f"failed to find global analyzer [{name}]")
+                    f"failed to find global analyzer [{analyzer_name}]")
+
+        max_tokens = None
+        if index is not None:
+            svc = self.indices.indices.get(index)
+            if svc is not None:
+                try:
+                    max_tokens = int(svc.settings.get(
+                        "index.analyze.max_token_count", 10000))
+                except (TypeError, ValueError):
+                    max_tokens = 10000
+
+        def _check_limit(n):
+            if max_tokens is not None and n > max_tokens:
+                raise IllegalArgumentError(
+                    f"The number of tokens produced by calling _analyze "
+                    f"has exceeded the allowed maximum of [{max_tokens}]."
+                    f" This limit can be set by changing the "
+                    f"[index.analyze.max_token_count] index level "
+                    f"setting.")
+
+        if tokenizer_fn is not None:
+            tokenized = []
+            for t in texts:
+                tokenized.extend(tokenizer_fn(str(t)))
+            _check_limit(len(tokenized))
+            stages = []             # (filter name, tokens after it)
+            cur = tokenized
+            for fname, fn in filters:
+                cur = fn(cur)
+                _check_limit(len(cur))
+                stages.append((fname, list(cur)))
+            if explain:
+                detail = {"custom_analyzer": True,
+                          "tokenizer": {
+                              "name": tokenizer_name,
+                              "tokens": self._analyze_token_dicts(
+                                  tokenized)}}
+                if stages:
+                    detail["tokenfilters"] = [
+                        {"name": fname,
+                         "tokens": self._analyze_token_dicts(toks)}
+                        for fname, toks in stages]
+                return {"detail": detail}
+            return {"tokens": self._analyze_token_dicts(cur)}
+
         tokens = []
-        for ti, t in enumerate(texts):
-            for tok in analyzer.analyze(str(t)):
-                tokens.append({"token": tok.term,
-                               "start_offset": tok.start_offset,
-                               "end_offset": tok.end_offset,
-                               "type": "<ALPHANUM>",
-                               "position": tok.position})
-        return {"tokens": tokens}
+        for t in texts:
+            tokens.extend(analyzer.analyze(str(t)))
+        _check_limit(len(tokens))
+        if explain:
+            return {"detail": {
+                "custom_analyzer": False,
+                "analyzer": {"name": analyzer_name,
+                             "tokens": self._analyze_token_dicts(
+                                 tokens)}}}
+        return {"tokens": self._analyze_token_dicts(tokens)}
 
     def h_field_caps(self, params, body, index=None):
         names = self.indices.resolve(index)

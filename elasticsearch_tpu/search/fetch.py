@@ -247,10 +247,9 @@ def highlight(mapper: MapperService, source: Optional[dict],
     expanded: Dict[str, dict] = {}
     for field, fspec in fields_spec.items():
         if "*" in field:
-            import fnmatch
-            for name in list(getattr(mapper, "_fields", {})):
-                if fnmatch.fnmatchcase(name, field):
-                    expanded.setdefault(name, fspec)
+            from ..index.mapping import resolve_field_patterns
+            for name in resolve_field_patterns(mapper, field):
+                expanded.setdefault(name, fspec)
         else:
             expanded[field] = fspec
     out: Dict[str, List[str]] = {}

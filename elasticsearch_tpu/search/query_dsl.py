@@ -1805,18 +1805,17 @@ class _LazyMultiMatch(Query):
 
     def _build(self, ctx):
         if self._built is None:
-            import fnmatch
             fields = []
             for f in self.body.get("fields") or []:
                 pat, caret, boost = f.partition("^")
                 if "*" in pat:
                     from ..index.mapping import (KeywordFieldType,
-                                                 TextFieldType)
-                    for n, ft in getattr(ctx.mapper, "_fields",
-                                         {}).items():
-                        if fnmatch.fnmatchcase(n, pat) and isinstance(
-                                ft, (TextFieldType, KeywordFieldType)):
-                            fields.append(n + caret + boost)
+                                                 TextFieldType,
+                                                 resolve_field_patterns)
+                    fields.extend(
+                        n + caret + boost for n in resolve_field_patterns(
+                            ctx.mapper, pat,
+                            (TextFieldType, KeywordFieldType)))
                 else:
                     fields.append(f)
             self._built = _parse_multi_match(

@@ -174,8 +174,10 @@ class SnapshotsService:
             raise IllegalArgumentError(
                 "missing location setting for fs repository")
         if not os.path.isabs(location):
-            raise IllegalArgumentError(
-                f"location [{location}] must be an absolute path")
+            # relative locations resolve under the node's repo root
+            # (the reference resolves them against path.repo)
+            location = os.path.join(self.indices.data_path, "repos",
+                                    location)
         self.repositories[name] = FsRepository(
             name, location, compress=bool(settings.get("compress", False)))
 
@@ -194,7 +196,9 @@ class SnapshotsService:
 
     def create(self, repo_name: str, snapshot: str,
                indices_expr: Optional[str] = None,
-               include_global_state: bool = True) -> dict:
+               include_global_state: bool = True,
+               ignore_unavailable: bool = False,
+               metadata: Optional[dict] = None) -> dict:
         repo = self.get_repository(repo_name)
         idx = repo.read_index()
         if any(s["snapshot"] == snapshot for s in idx["snapshots"]):
@@ -203,10 +207,16 @@ class SnapshotsService:
                 f"already exists")
         if isinstance(indices_expr, list):   # ES accepts array or CSV string
             indices_expr = ",".join(indices_expr)
-        names = self.indices.resolve(indices_expr)
+        try:
+            names = self.indices.resolve(indices_expr)
+        except Exception:   # noqa: BLE001 — missing named index
+            if not ignore_unavailable:
+                raise
+            names = []
         start = time.time()
         indices_meta: Dict[str, dict] = {}
         total_files = 0
+        total_bytes = 0
         for name in names:
             svc = self.indices.get(name)
             shards: Dict[str, List[dict]] = {}
@@ -236,9 +246,10 @@ class SnapshotsService:
                         f"shard [{name}][{shard_id}] store is missing "
                         f"committed files {missing}")
                 for fname in files:
-                    manifest.append(repo.put_file(
-                        os.path.join(store, fname)))
+                    entry = repo.put_file(os.path.join(store, fname))
+                    manifest.append(entry)
                     total_files += 1
+                    total_bytes += int(entry.get("size", 0))
                 shards[str(shard_id)] = manifest
             indices_meta[name] = {
                 "settings": dict(svc.settings),
@@ -247,6 +258,7 @@ class SnapshotsService:
                 "num_shards": svc.num_shards,
                 "shards": shards,
             }
+        shards_total = sum(m["num_shards"] for m in indices_meta.values())
         meta = {
             "snapshot": snapshot,
             "uuid": uuid_mod.uuid4().hex[:20],
@@ -254,9 +266,14 @@ class SnapshotsService:
             "state": "SUCCESS",
             "indices": indices_meta,
             "include_global_state": include_global_state,
+            "metadata": metadata,
             "start_time_in_millis": int(start * 1000),
             "end_time_in_millis": int(time.time() * 1000),
             "total_files": total_files,
+            "total_size_in_bytes": total_bytes,
+            "shards": {"total": shards_total, "failed": 0,
+                       "successful": shards_total},
+            "failures": [],
             "version": "8.0.0-tpu",
         }
         repo.write_snapshot(snapshot, meta)
@@ -285,6 +302,45 @@ class SnapshotsService:
                             f"[{repo_name}:{part}] is missing")
                     names.append(part)
         return [repo.read_snapshot(n) for n in names]
+
+    def clone(self, repo_name: str, snapshot: str, target: str,
+              indices_expr: Optional[str] = None) -> None:
+        """Snapshot clone (``TransportCloneSnapshotAction``): the target
+        references the SAME blobs (dedup by content hash), restricted to
+        the requested indices."""
+        repo = self.get_repository(repo_name)
+        idx = repo.read_index()
+        if not any(s["snapshot"] == snapshot for s in idx["snapshots"]):
+            raise SnapshotMissingError(f"[{repo_name}:{snapshot}] is missing")
+        if any(s["snapshot"] == target for s in idx["snapshots"]):
+            raise ResourceAlreadyExistsError(
+                f"[{repo_name}:{target}] snapshot with the same name "
+                f"already exists")
+        meta = dict(repo.read_snapshot(snapshot))
+        if indices_expr:
+            import fnmatch
+            pats = indices_expr.split(",") \
+                if isinstance(indices_expr, str) else list(indices_expr)
+            meta["indices"] = {
+                n: m for n, m in meta["indices"].items()
+                if any(fnmatch.fnmatchcase(n, p) for p in pats)}
+        meta["snapshot"] = target
+        meta["uuid"] = uuid_mod.uuid4().hex[:20]
+        shards_total = sum(m.get("num_shards", 0)
+                           for m in meta["indices"].values())
+        meta["shards"] = {"total": shards_total, "failed": 0,
+                          "successful": shards_total}
+        meta["total_files"] = sum(
+            len(man) for m in meta["indices"].values()
+            for man in m.get("shards", {}).values())
+        meta["total_size_in_bytes"] = sum(
+            int(e.get("size", 0)) for m in meta["indices"].values()
+            for man in m.get("shards", {}).values() for e in man)
+        repo.write_snapshot(target, meta)
+        idx["snapshots"].append({"snapshot": target, "uuid": meta["uuid"],
+                                 "state": meta.get("state", "SUCCESS"),
+                                 "indices": sorted(meta["indices"])})
+        repo.write_index(idx)
 
     def delete(self, repo_name: str, snapshot: str) -> None:
         repo = self.get_repository(repo_name)
@@ -327,17 +383,34 @@ class SnapshotsService:
             if rename_pattern and rename_replacement is not None:
                 target = re_mod.sub(rename_pattern, rename_replacement, name)
             if self.indices.exists(target):
-                raise ResourceAlreadyExistsError(
-                    f"cannot restore index [{target}] because an open index "
-                    f"with same name already exists in the cluster")
+                existing = self.indices.indices.get(target)
+                if existing is not None and existing.closed:
+                    # restoring over a CLOSED index replaces it
+                    # (RestoreService: only open indices conflict) —
+                    # including its on-disk stores/translogs, which
+                    # would otherwise replay the OLD index's ops over
+                    # the restored commit
+                    del self.indices.indices[target]
+                    shutil.rmtree(os.path.join(
+                        self.indices.data_path, target),
+                        ignore_errors=True)
+                else:
+                    raise ResourceAlreadyExistsError(
+                        f"cannot restore index [{target}] because an "
+                        f"open index with same name already exists in "
+                        f"the cluster")
             imeta = meta["indices"][name]
             path = os.path.join(self.indices.data_path, target)
+            files_n = 0
+            bytes_n = 0
             try:
                 for shard_id_s, manifest in imeta["shards"].items():
                     store = os.path.join(path, shard_id_s, "store")
                     os.makedirs(store, exist_ok=True)
                     for entry in manifest:
                         repo.get_file(entry, store)
+                        files_n += 1
+                        bytes_n += int(entry.get("size", 0))
                 # IndexService construction opens every shard engine, whose
                 # recovery path reads the restored commit point — restore
                 # IS recovery (RecoverySource.SnapshotRecoverySource)
@@ -348,6 +421,9 @@ class SnapshotsService:
                                    imeta["mappings"])
                 for alias, spec in imeta.get("aliases", {}).items():
                     svc.aliases[alias] = spec or {}
+                svc.recovery_info = {"type": "SNAPSHOT",
+                                     "files": files_n,
+                                     "bytes": bytes_n}
                 self.indices.indices[target] = svc
                 restored.append(target)
             except Exception:
@@ -369,6 +445,9 @@ class SnapshotsService:
                 f"[{repo_name}:{snapshot}] is missing")
         meta = snaps[0]
         shards_total = sum(i["num_shards"] for i in meta["indices"].values())
+        files = meta.get("total_files", 0)
+        file_stats = {"file_count": files,
+                      "size_in_bytes": meta.get("total_size_in_bytes", 0)}
         return {"snapshots": [{
             "snapshot": meta["snapshot"],
             "repository": repo_name,
@@ -376,5 +455,9 @@ class SnapshotsService:
             "state": meta["state"],
             "shards_stats": {"done": shards_total, "failed": 0,
                              "total": shards_total},
-            "stats": {"total": {"file_count": meta.get("total_files", 0)}},
+            "stats": {"incremental": dict(file_stats),
+                      "total": dict(file_stats),
+                      "start_time_in_millis":
+                          meta.get("start_time_in_millis", 0),
+                      "time_in_millis": 0},
         }]}

@@ -105,16 +105,18 @@ def test_snapshot_delete_and_gc(api, tmp_path):
     req(api, "PUT", "/_snapshot/r", _repo_body(tmp_path))
     req(api, "PUT", "/_snapshot/r/s1", {}, query="wait_for_completion=true")
     st, out = req(api, "GET", "/_snapshot/r/_all")
-    assert len(out["snapshots"]) == 1
+    assert len(out["responses"][0]["snapshots"]) == 1
     st, _ = req(api, "DELETE", "/_snapshot/r/s1")
     assert st == 200
     st, out = req(api, "GET", "/_snapshot/r/_all")
-    assert out["snapshots"] == []
+    assert out["responses"][0]["snapshots"] == []
     blobs = sum(len(files) for _, _, files in
                 os.walk(tmp_path / "repo_r" / "blobs"))
     assert blobs == 0
-    st, _ = req(api, "GET", "/_snapshot/r/s1")
-    assert st == 404
+    st, out = req(api, "GET", "/_snapshot/r/s1")
+    # 8.0 multi-repo format: per-repository error entry, HTTP 200
+    assert out["responses"][0]["error"]["type"] == \
+        "snapshot_missing_exception"
     st, _ = req(api, "DELETE", "/_snapshot/r/s1")
     assert st == 404
 
@@ -143,20 +145,21 @@ def test_snapshot_selects_indices_and_status(api, tmp_path):
     req(api, "PUT", "/_snapshot/r/part", {"indices": "i1"},
         query="wait_for_completion=true")
     st, out = req(api, "GET", "/_snapshot/r/part")
-    assert list(out["snapshots"][0]["indices"]) == ["i1"]
+    assert list(out["responses"][0]["snapshots"][0]["indices"]) == ["i1"]
     st, out = req(api, "GET", "/_snapshot/r/part/_status")
     assert out["snapshots"][0]["shards_stats"]["failed"] == 0
     # wildcard get
     st, out = req(api, "GET", "/_snapshot/r/pa*")
-    assert len(out["snapshots"]) == 1
+    assert len(out["responses"][0]["snapshots"]) == 1
 
 
 def test_repo_validation(api, tmp_path):
     st, _ = req(api, "PUT", "/_snapshot/bad", {"type": "s3", "settings": {}})
     assert st == 400
-    st, _ = req(api, "PUT", "/_snapshot/bad",
+    # relative locations resolve under the node repo root (path.repo)
+    st, _ = req(api, "PUT", "/_snapshot/rel",
                 {"type": "fs", "settings": {"location": "relative/path"}})
-    assert st == 400
+    assert st == 200
     st, _ = req(api, "PUT", "/_snapshot/r", _repo_body(tmp_path))
     st, out = req(api, "GET", "/_snapshot/r")
     assert "r" in out
