@@ -104,6 +104,11 @@ class ClusterBlockError(ElasticsearchError):
     error_type = "cluster_block_exception"
 
 
+class InvalidIndexNameError(ElasticsearchError):
+    status = 400
+    error_type = "invalid_index_name_exception"
+
+
 class InvalidAliasNameError(ElasticsearchError):
     status = 400
     error_type = "invalid_alias_name_exception"

@@ -28,12 +28,13 @@ _VALID_INDEX_RE = re.compile(r"^[^A-Z _\-+][^A-Z\\/*?\"<>| ,#]*$")
 
 
 def validate_index_name(name: str) -> None:
+    from ..common.errors import InvalidIndexNameError
     if not name or name in (".", ".."):
-        raise IllegalArgumentError(f"invalid index name [{name}]")
+        raise InvalidIndexNameError(f"Invalid index name [{name}]")
     if name.startswith(("-", "_", "+")) or name != name.lower() or \
             any(c in name for c in '\\/*?"<>| ,#'):
-        raise IllegalArgumentError(
-            f"invalid index name [{name}], must be lowercase and may not "
+        raise InvalidIndexNameError(
+            f"Invalid index name [{name}], must be lowercase and may not "
             f"contain spaces or the characters \\/*?\"<>|,#")
 
 

@@ -170,6 +170,15 @@ class RestAPI:
         add("GET", "/_cat/aliases", self.h_cat_aliases)
         add("GET", "/_cat/templates", self.h_cat_templates)
         add("GET", "/_cat/templates/{name}", self.h_cat_templates)
+        add("GET", "/_segments", self.h_segments)
+        add("GET", "/{index}/_segments", self.h_segments)
+        add("GET", "/_shard_stores", self.h_shard_stores)
+        add("GET", "/{index}/_shard_stores", self.h_shard_stores)
+        add("POST", "/_cache/clear", self.h_clear_cache)
+        add("POST", "/{index}/_cache/clear", self.h_clear_cache)
+        add("GET,POST", "/{index}/_termvectors", self.h_termvectors)
+        add("GET,POST", "/_mtermvectors", self.h_mtermvectors)
+        add("GET,POST", "/{index}/_mtermvectors", self.h_mtermvectors)
         add("GET", "/_recovery", self.h_recovery)
         add("GET", "/{index}/_recovery", self.h_recovery)
         add("GET", "/_cat/allocation", self.h_cat_allocation)
@@ -241,6 +250,12 @@ class RestAPI:
         add("GET,POST", "/{index}/_termvectors/{id}", self.h_termvectors)
         add("GET", "/_tasks", self.h_tasks)
         # templates
+        add("POST", "/_index_template/_simulate_index/{name}",
+            self.h_simulate_index_template)
+        add("POST", "/_index_template/_simulate/{name}",
+            self.h_simulate_template)
+        add("POST", "/_index_template/_simulate",
+            self.h_simulate_template)
         add("PUT,POST", "/_index_template/{name}", self.h_put_template)
         add("GET", "/_index_template/{name}", self.h_get_template)
         add("GET", "/_index_template", self.h_get_template)
@@ -336,6 +351,8 @@ class RestAPI:
                 return status, JSON_CT, json.dumps(payload).encode()
             if isinstance(payload, str):
                 return status, "text/plain; charset=UTF-8", payload.encode()
+            if payload is None:
+                return status, JSON_CT, b"null"
             return status, JSON_CT, payload
         if matched_path:
             status, payload = 405, {"error": f"Incorrect HTTP method for uri "
@@ -601,8 +618,12 @@ class RestAPI:
                 results[cond] = age_s * 1000 >= parse_time_millis(want)
             elif cond in ("max_size", "max_primary_shard_size"):
                 from ..common.settings import parse_bytes
-                results[cond] = st["store"]["size_in_bytes"] >= \
-                    parse_bytes(want)
+                # a doc-less index counts as size 0: its on-disk commit
+                # scaffolding isn't doc data (the reference reads docs
+                # store stats, 0 before anything is indexed)
+                size = st["store"]["size_in_bytes"] \
+                    if st["docs"]["count"] else 0
+                results[cond] = size >= parse_bytes(want)
             else:
                 raise IllegalArgumentError(
                     f"unknown rollover condition [{cond}]")
@@ -614,7 +635,14 @@ class RestAPI:
                     f"index name [{old}] does not match pattern '^.*-\\d+$'"
                 )
             new_index = f"{m.group(1)}-{int(m.group(2)) + 1:06d}"
+        from ..node.indices_service import validate_index_name
+        validate_index_name(new_index)
         dry = _flag(params, "dry_run")
+        if new_index in self.indices.indices:
+            # the rollover target must be free — validated up front,
+            # even for a dry run or unmatched conditions
+            raise ResourceAlreadyExistsError(
+                f"index [{new_index}] already exists")
         if do_roll and not dry:
             self.indices.create_index(
                 new_index, payload.get("settings"),
@@ -923,7 +951,17 @@ class RestAPI:
                 "persistent": self.cluster_settings["persistent"],
                 "transient": self.cluster_settings["transient"]}
 
+    #: nodes.info sections selectable via the metric path
+    NODES_INFO_METRICS = ("settings", "os", "process", "jvm",
+                          "thread_pool", "transport", "http", "plugins",
+                          "modules", "ingest", "aggregations", "indices")
+
     def h_nodes(self, params, body, node_id=None, metric=None):
+        if metric is None and node_id is not None and all(
+                m.strip() in self.NODES_INFO_METRICS
+                for m in node_id.split(",")):
+            # GET /_nodes/{metric}: a metric list in the node_id slot
+            node_id, metric = None, node_id
         info = {
             "name": self.node_name,
             "transport_address": "127.0.0.1:9300",
@@ -931,9 +969,11 @@ class RestAPI:
             "version": "8.0.0-tpu",
             "build_flavor": "tpu-native", "build_type": "source",
             "build_hash": "unknown",
-            "roles": ["master", "data", "ingest"],
+            "roles": ["data", "ingest", "master",
+                      "remote_cluster_client"],    # sorted (7.8+)
             "attributes": {},
-            "settings": {"cluster": {"name": self.cluster_name},
+            "settings": {"client": {"type": "node"},
+                         "cluster": {"name": self.cluster_name},
                          "node": {"name": self.node_name}},
             "os": {"refresh_interval_in_millis": 1000},
             "process": {"id": os.getpid(), "mlockall": False},
@@ -953,8 +993,24 @@ class RestAPI:
                     __import__("elasticsearch_tpu.ingest.pipeline",
                                fromlist=["_PROCESSOR_TYPES"]
                                )._PROCESSOR_TYPES)]},
-            "aggregations": {},
+            "aggregations": {
+                kind: {"types": ["other"]}
+                for kind in sorted(__import__(
+                    "elasticsearch_tpu.search.aggregations",
+                    fromlist=["_AGG_PARSERS"])._AGG_PARSERS)},
         }
+        if params.get("flat_settings") in ("true", ""):
+            from ..node.indices_service import _flatten_settings
+            info["settings"] = {k: str(v) for k, v in
+                                _flatten_settings(
+                                    info["settings"]).items()}
+        if metric:
+            wanted = {m.strip() for m in metric.split(",")}
+            keep = {"name", "transport_address", "host", "ip", "version",
+                    "build_flavor", "build_type", "build_hash", "roles",
+                    "attributes"}
+            info = {k: v for k, v in info.items()
+                    if k in keep or k in wanted}
         return {"_nodes": {"total": 1, "successful": 1, "failed": 0},
                 "cluster_name": self.cluster_name,
                 "nodes": {self.node_id: info}}
@@ -1462,8 +1518,40 @@ class RestAPI:
         b = _json_body(body)
         settings, mappings, aliases = self._apply_templates(
             index, b.get("settings") or {}, b.get("mappings") or {})
+        flat_settings = {k: v for grp in (settings.get("index", {})
+                                          if isinstance(settings.get(
+                                              "index"), dict) else {},
+                                          settings)
+                         for k, v in (grp or {}).items()}
+        sd_vals = [flat_settings.get("soft_deletes.enabled"),
+                   flat_settings.get("index.soft_deletes.enabled")]
+        for container in (flat_settings.get("soft_deletes"),
+                          (flat_settings.get("index") or {})
+                          if isinstance(flat_settings.get("index"), dict)
+                          else {}):
+            if isinstance(container, dict):
+                sd_vals.append(container.get("enabled"))
+                inner = container.get("soft_deletes")
+                if isinstance(inner, dict):
+                    sd_vals.append(inner.get("enabled"))
+        if any(str(v).lower() == "false" for v in sd_vals
+               if v is not None):
+            raise IllegalArgumentError(
+                "Creating indices with soft-deletes disabled is no "
+                "longer supported")
+
+        def _check_empty_names(props):
+            for fname, spec in (props or {}).items():
+                if fname == "":
+                    raise IllegalArgumentError(
+                        "field name cannot be an empty string")
+                if isinstance(spec, dict):
+                    _check_empty_names(spec.get("properties"))
+        _check_empty_names((mappings or {}).get("properties"))
         aliases = dict(aliases)
         aliases.update(b.get("aliases") or {})
+        aliases = {a: self._alias_spec(sp or {})
+                   for a, sp in aliases.items()}
         self.indices.create_index(index, settings, mappings,
                                   aliases or None)
         return {"acknowledged": True, "shards_acknowledged": True,
@@ -1964,8 +2052,32 @@ class RestAPI:
         return {"acknowledged": True}
 
     def h_delete_alias(self, params, body, index, name):
-        for n in self.indices.resolve(index, allow_aliases=False):
-            self.indices.indices[n].aliases.pop(name, None)
+        """DELETE /{index}/_alias/{name}: name may be a CSV of alias
+        names/wildcards (* and _all remove every alias); 404 when
+        nothing matched (``TransportIndicesAliasesAction``)."""
+        import fnmatch
+        names = self.indices.resolve(index, allow_aliases=False)
+        removed_any = False
+        for n in names:
+            svc = self.indices.indices[n]
+            for pat in name.split(","):
+                if pat in ("_all", "*"):
+                    removed_any = removed_any or bool(svc.aliases)
+                    svc.aliases.clear()
+                elif any(c in pat for c in "*?"):
+                    hit = [a for a in svc.aliases
+                           if fnmatch.fnmatchcase(a, pat)]
+                    for a in hit:
+                        del svc.aliases[a]
+                    removed_any = removed_any or bool(hit)
+                elif pat in svc.aliases:
+                    del svc.aliases[pat]
+                    removed_any = True
+        if not removed_any:
+            e = ElasticsearchError(f"aliases [{name}] missing")
+            e.status = 404
+            e.error_type = "aliases_not_found_exception"
+            raise e
         return {"acknowledged": True}
 
     def h_put_template_legacy(self, params, body, name):
@@ -2008,6 +2120,112 @@ class RestAPI:
         if "version" in t:
             out["version"] = t["version"]
         return out
+
+    @staticmethod
+    def _patterns_of(tpl) -> List[str]:
+        pats = tpl.get("index_patterns") or []
+        return [pats] if isinstance(pats, str) else list(pats)
+
+    def _compose_template_view(self, tpl: dict) -> dict:
+        """Composable template (+ composed_of component layers) →
+        resolved {settings, mappings, aliases} view (reference:
+        ``TransportSimulateIndexTemplateAction.resolveTemplate``)."""
+        def _deep_props(dst, src):
+            for k, v in (src or {}).items():
+                if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                    _deep_props(dst[k], v)
+                else:
+                    dst[k] = v
+
+        settings: dict = {}
+        mappings: dict = {}
+        aliases: dict = {}
+        layers = [(self.component_templates.get(c) or {}).get(
+            "template") or {} for c in tpl.get("composed_of", [])]
+        layers.append(tpl.get("template") or {})
+        for layer in layers:
+            raw = layer.get("settings") or {}
+            flat = dict(raw.get("index", raw)) \
+                if "index" in raw and isinstance(
+                    raw.get("index"), dict) else dict(raw)
+            for k, v in flat.items():
+                k = k[6:] if k.startswith("index.") else k
+                sval = ("true" if v is True else
+                        "false" if v is False else str(v))
+                # dotted keys nest (the response renders the settings
+                # tree, not flat keys)
+                node = settings
+                parts = k.split(".")
+                for part in parts[:-1]:
+                    node = node.setdefault(part, {})
+                node[parts[-1]] = sval
+            props = (layer.get("mappings") or {}).get("properties") or {}
+            if props:
+                _deep_props(mappings.setdefault("properties", {}), props)
+            for k, v in (layer.get("mappings") or {}).items():
+                if k != "properties":
+                    mappings[k] = v
+            aliases.update(layer.get("aliases") or {})
+        return {"settings": {"index": settings},
+                "mappings": mappings, "aliases": aliases}
+
+    @staticmethod
+    def _is_composable(tpl: dict) -> bool:
+        return any(k in tpl for k in ("template", "composed_of",
+                                      "priority"))
+
+    def h_simulate_index_template(self, params, body, name):
+        """POST /_index_template/_simulate_index/{index}: resolve the
+        template that WOULD apply to a new index of that name."""
+        import fnmatch
+        body_tpl = _json_body(body) if body else None
+        candidates = []                # (priority, tname, tpl)
+        for tname, t in self.templates.items():
+            if self._is_composable(t) and any(
+                    fnmatch.fnmatchcase(name, p)
+                    for p in self._patterns_of(t)):
+                candidates.append((int(t.get("priority", 0)), tname, t))
+        if body_tpl:
+            candidates.append((int(body_tpl.get("priority", 0)),
+                               None, body_tpl))
+        if not candidates:
+            return None                # serialized as a JSON null body
+        _, win_name, winner = max(candidates, key=lambda c: c[0])
+        overlapping = sorted(
+            ({"name": tname, "index_patterns": self._patterns_of(t)}
+             for tname, t in self.templates.items()
+             if tname != win_name and any(
+                 fnmatch.fnmatchcase(name, p)
+                 for p in self._patterns_of(t))),
+            key=lambda e: e["name"])
+        return {"template": self._compose_template_view(winner),
+                "overlapping": overlapping}
+
+    def h_simulate_template(self, params, body, name=None):
+        """POST /_index_template/_simulate[/{name}]: resolve a stored or
+        request-provided template and report pattern overlaps."""
+        import fnmatch
+        tpl = _json_body(body) if body else None
+        if tpl is None:
+            if name is None or name not in self.templates:
+                raise IllegalArgumentError(
+                    f"unable to simulate template [{name}] that does "
+                    f"not exist")
+            tpl = self.templates[name]
+        pats = self._patterns_of(tpl)
+
+        def _overlaps(other) -> bool:
+            return any(fnmatch.fnmatchcase(p2, p1)
+                       or fnmatch.fnmatchcase(p1, p2)
+                       for p1 in pats for p2 in self._patterns_of(other))
+
+        overlapping = sorted(
+            ({"name": tname, "index_patterns": self._patterns_of(t)}
+             for tname, t in self.templates.items()
+             if tname != name and _overlaps(t)),
+            key=lambda e: e["name"])
+        return {"template": self._compose_template_view(tpl),
+                "overlapping": overlapping}
 
     def h_put_template(self, params, body, name):
         b = _json_body(body)
@@ -2173,6 +2391,7 @@ class RestAPI:
 
     def h_get_doc(self, params, body, index, id):
         svc = self.indices.get(index)
+        index = svc.name            # alias → concrete name in responses
         if params.get("refresh") in ("true", ""):
             svc.refresh()
         r = svc.get_doc(id, routing=params.get("routing"))
@@ -2484,6 +2703,90 @@ class RestAPI:
     # snapshots (reference: snapshots/SnapshotsService.java,
     # repositories/blobstore/BlobStoreRepository.java)
     # ------------------------------------------------------------------
+
+    def _stores_index_selection(self, params, index):
+        """Shared indices-options resolution for segments/shard_stores:
+        closed indices 400 unless ignore_unavailable, missing wildcard
+        matches honor allow_no_indices."""
+        ignore = params.get("ignore_unavailable") in ("true", "")
+        allow_no = params.get("allow_no_indices") != "false"
+        try:
+            names = self.indices.resolve(index)
+        except IndexNotFoundError:
+            if ignore:
+                names = []
+            else:
+                raise
+        kept = []
+        for n in names:
+            svc = self.indices.indices[n]
+            if svc.closed:
+                if ignore:
+                    continue
+                from ..common.errors import IndexClosedError
+                raise IndexClosedError(f"closed index [{n}]")
+            kept.append(n)
+        if not kept and not allow_no:
+            raise IndexNotFoundError(index or "_all")
+        return kept
+
+    def h_segments(self, params, body, index=None):
+        """GET /_segments (reference: ``RestIndicesSegmentsAction``)."""
+        names = self._stores_index_selection(params, index)
+        indices_out = {}
+        shards_total = 0
+        for n in names:
+            svc = self.indices.indices[n]
+            shards_out = {}
+            for sid, engine in enumerate(svc.shards):
+                shards_total += 1
+                segs = {}
+                for gi, seg in enumerate(engine.searchable_segments()):
+                    segs[seg.seg_id] = {
+                        "generation": gi,
+                        "num_docs": int(seg.live.sum()),
+                        "deleted_docs": int((~seg.live).sum()),
+                        "size_in_bytes": 0,
+                        "memory_in_bytes": 0,
+                        "committed": True, "search": True,
+                        "version": "9.0.0",
+                        "compound": False}
+                shards_out[str(sid)] = [{
+                    "routing": {"state": "STARTED", "primary": True,
+                                "node": self.node_id},
+                    "num_committed_segments": len(segs),
+                    "num_search_segments": len(segs),
+                    "segments": segs}]
+            indices_out[n] = {"shards": shards_out}
+        return {"_shards": {"total": shards_total,
+                            "successful": shards_total, "failed": 0},
+                "indices": indices_out}
+
+    def h_shard_stores(self, params, body, index=None):
+        """GET /_shard_stores (reference: ``RestIndicesShardStoresAction``)
+        — single node: every primary store lives here."""
+        names = self._stores_index_selection(params, index)
+        indices_out = {}
+        for n in names:
+            svc = self.indices.indices[n]
+            shards_out = {}
+            for sid in range(svc.num_shards):
+                shards_out[str(sid)] = {"stores": [{
+                    self.node_id: {
+                        "name": self.node_name,
+                        "transport_address": "127.0.0.1:9300"},
+                    "allocation_id": uuid.uuid4().hex[:20],
+                    "allocation": "primary"}]}
+            indices_out[n] = {"shards": shards_out}
+        return {"indices": indices_out}
+
+    def h_clear_cache(self, params, body, index=None):
+        """POST /_cache/clear (reference: ``RestClearIndicesCacheAction``)
+        — caches are per-request here, so clearing is a counted no-op."""
+        names = self._stores_index_selection(params, index)
+        shards = sum(self.indices.indices[n].num_shards for n in names)
+        return {"_shards": {"total": shards, "successful": shards,
+                            "failed": 0}}
 
     def h_recovery(self, params, body, index=None):
         """Per-shard recovery report (reference:
@@ -4086,9 +4389,23 @@ class RestAPI:
         doc)."""
         from ..search.query_dsl import parse_query
         svc = self.indices.get(index)
+        index = svc.name             # alias → concrete in responses
         payload = _json_body(body)
         self._rewrite_terms_lookup(payload)
-        query_spec = payload.get("query") or {"match_all": {}}
+        if payload and "query" not in payload:
+            raise ParsingError(
+                "Expected [query] element, but found none")
+        query_spec = payload.get("query")
+        if "q" in params:
+            qs = {"query": params["q"]}
+            if "df" in params:
+                qs["default_field"] = params["df"]
+            if "default_operator" in params:
+                qs["default_operator"] = params["default_operator"]
+            if params.get("lenient") in ("true", ""):
+                qs["lenient"] = True
+            query_spec = {"query_string": qs}
+        query_spec = query_spec or {"match_all": {}}
         searcher = svc.searcher()
         target = None
         for seg_idx, seg in enumerate(searcher.segments):
@@ -4118,30 +4435,82 @@ class RestAPI:
                             "description": f"{section} clause: "
                                            f"{json.dumps(c)}",
                             "details": []})
-        return {"_index": index, "_id": id, "matched": matched,
-                "explanation": {
-                    "value": value,
-                    "description": ("sum of:" if details else
-                                    f"query: {json.dumps(query_spec)}"),
-                    "details": details}}
+        out = {"_index": index, "_id": id, "matched": matched,
+               "explanation": {
+                   "value": value,
+                   "description": ("sum of:" if details else
+                                   f"query: {json.dumps(query_spec)}"),
+                   "details": details}}
+        src_spec = self._get_source_spec(params)
+        if src_spec is not None and src_spec is not False:
+            from ..search.fetch import filter_source
+            out["get"] = {"found": True,
+                          "_source": filter_source(seg.sources[d],
+                                                   src_spec)}
+        return out
 
-    def h_termvectors(self, params, body, index, id):
-        """Term vectors of one doc's text fields (reference:
-        ``RestTermVectorsAction``): term freq, positions, and (with
-        ``term_statistics=true``) df/ttf from the shard stats."""
-        svc = self.indices.get(index)
+    def _termvectors_one(self, params, body_spec, index, id):
+        """Term vectors for ONE doc. Multi-index aliases reject like the
+        reference's single-shard routing check."""
+        names = self.indices.resolve(index)
+        if len(names) > 1:
+            listed = "[" + ", ".join(sorted(names)) + "]"
+            raise IllegalArgumentError(
+                f"Alias [{index}] has more than one index associated "
+                f"with it [{listed}], can't execute a single index op")
+        concrete = names[0]
+        svc = self.indices.indices[concrete]
+        if params.get("realtime") != "false":
+            # realtime reads see the doc even before an explicit refresh
+            svc.refresh()
+        want_stats = params.get("term_statistics") in ("true", "") or \
+            (body_spec or {}).get("term_statistics") is True
+        fields_filter = params.get("fields") or \
+            (body_spec or {}).get("fields")
+        if isinstance(fields_filter, str):
+            fields_filter = fields_filter.split(",")
+        wanted = set(fields_filter) if fields_filter else None
         searcher = svc.searcher()
-        want_stats = params.get("term_statistics") in ("true", "")
-        fields_filter = params.get("fields")
-        wanted = set(fields_filter.split(",")) if fields_filter else None
         for seg in searcher.segments:
             d = seg.find_doc(id)
-            if d is None:
+            if d is None or not seg.live[d]:
                 continue
+            src = seg.sources[d] or {}
             tv = {}
             for fname, f in seg.text_fields.items():
                 if wanted is not None and fname not in wanted:
                     continue
+                ft = svc.mapper.field_type(fname)
+                analyzer = getattr(ft, "analyzer", None)
+                value = src
+                for part in fname.split("."):
+                    value = value.get(part) if isinstance(value, dict) \
+                        else None
+                    if value is None:
+                        break
+                # offsets come from re-analysis of the stored source
+                # (positions ride the postings CSR, offsets don't)
+                tok_of: Dict[str, list] = {}
+                if analyzer is not None and value is not None:
+                    vals = value if isinstance(value, list) else [value]
+                    base_pos = 0
+                    base_off = 0
+                    for v in vals:
+                        text = str(v)
+                        last = -1
+                        for tok in analyzer.analyze(text):
+                            last = max(last, tok.position)
+                            tok_of.setdefault(tok.term, []).append(
+                                {"position": base_pos + tok.position,
+                                 "start_offset":
+                                     base_off + tok.start_offset,
+                                 "end_offset":
+                                     base_off + tok.end_offset})
+                        # multi-valued gap matches index-time postings
+                        # (position_increment_gap 100 + 1, offsets run
+                        # on as if values were space-joined)
+                        base_pos += last + 101
+                        base_off += len(text) + 1
                 terms_out = {}
                 for term, tid in f.term_ids.items():
                     st, ln, df = f.term_run(term)
@@ -4150,11 +4519,13 @@ class RestAPI:
                     if i >= ln or run[i] != d:
                         continue
                     p = st + i
-                    positions = f.pos_flat[
-                        f.pos_offsets[p]: f.pos_offsets[p + 1]]
+                    toks = tok_of.get(term)
+                    if not toks:
+                        toks = [{"position": int(pos)} for pos in
+                                f.pos_flat[f.pos_offsets[p]:
+                                           f.pos_offsets[p + 1]]]
                     entry = {"term_freq": int(f.tf_host[p]),
-                             "tokens": [{"position": int(pos)}
-                                        for pos in positions]}
+                             "tokens": toks}
                     if want_stats:
                         entry["doc_freq"] = int(df)
                         entry["ttf"] = int(f.total_term_freq[tid])
@@ -4166,9 +4537,58 @@ class RestAPI:
                             "doc_count": f.field_doc_count,
                             "sum_ttf": int(f.total_term_freq.sum())},
                         "terms": terms_out}
-            return {"_index": index, "_id": id, "found": True,
-                    "took": 0, "term_vectors": tv}
-        return 404, {"_index": index, "_id": id, "found": False}
+            return {"_index": concrete, "_id": id, "_version": 1,
+                    "found": True, "took": 0, "term_vectors": tv}
+        return {"_index": concrete, "_id": id, "found": False}
+
+    def h_termvectors(self, params, body, index, id=None):
+        """Term vectors of one doc's text fields (reference:
+        ``RestTermVectorsAction``): term freq, positions + re-analyzed
+        offsets, and (with ``term_statistics=true``) df/ttf."""
+        spec = _json_body(body) if body else {}
+        if id is None:
+            id = spec.get("_id") or spec.get("id")
+        return self._termvectors_one(params, spec, index, id)
+
+    def h_mtermvectors(self, params, body, index=None):
+        """Multi term-vectors (reference: ``RestMultiTermVectorsAction``):
+        per-item payloads with per-item error entries."""
+        spec = _json_body(body) if body else {}
+        items = spec.get("docs")
+        if items is None and spec.get("ids"):
+            items = [{"_id": i} for i in spec["ids"]]
+        if items is None and params.get("ids"):
+            items = [{"_id": i} for i in params["ids"].split(",")]
+        if not items:
+            from ..common.errors import ActionRequestValidationError
+            raise ActionRequestValidationError(
+                "multi term vectors: no documents requested")
+        out = []
+        for item in items or []:
+            bad = [k for k in item
+                   if k not in ("_index", "_id", "id", "_routing",
+                                "routing", "fields", "term_statistics",
+                                "field_statistics", "offsets",
+                                "positions", "payloads", "doc",
+                                "version", "version_type", "filter")]
+            if bad:
+                raise ParsingError(
+                    f"unknown parameter [{bad[0]}] in request body")
+            idx = item.get("_index") or index
+            did = item.get("_id") or item.get("id")
+            try:
+                if idx is None:
+                    from ..common.errors import \
+                        ActionRequestValidationError
+                    raise ActionRequestValidationError(
+                        "index is missing")
+                r = self._termvectors_one(params, item, idx, did)
+                out.append(r)
+            except ElasticsearchError as e:
+                status, payload = _error_payload(e)
+                out.append({"_index": idx, "_id": did,
+                            "error": payload["error"]})
+        return {"docs": out}
 
     def h_reindex(self, params, body):
         """Copy documents between indices (reference: ``modules/reindex``

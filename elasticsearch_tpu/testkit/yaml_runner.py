@@ -41,7 +41,7 @@ REFERENCE_SPEC_ROOT = "/root/reference/rest-api-spec/src/main/resources/rest-api
 #: node_selector is trivially satisfied on a single-node target
 SUPPORTED_FEATURES = {"headers", "allowed_warnings", "warnings",
                       "arbitrary_key", "node_selector", "contains",
-                      "default_shards", "no_xpack",
+                      "default_shards", "no_xpack", "stash_in_path",
                       "default_shards, no_xpack"}
 
 
