@@ -170,6 +170,7 @@ class RestAPI:
         add("GET", "/_cat/aliases", self.h_cat_aliases)
         add("GET", "/_cat/templates", self.h_cat_templates)
         add("GET", "/_cat/templates/{name}", self.h_cat_templates)
+        add("GET", "/_resolve/index/{name}", self.h_resolve_index)
         add("GET", "/_segments", self.h_segments)
         add("GET", "/{index}/_segments", self.h_segments)
         add("GET", "/_shard_stores", self.h_shard_stores)
@@ -195,6 +196,20 @@ class RestAPI:
         add("DELETE", "/_component_template/{name}",
             self.h_delete_component_template)
         add("GET", "/_cat/aliases/{name}", self.h_cat_aliases)
+        add("GET", "/_cat/fielddata", self.h_cat_fielddata)
+        add("GET", "/_cat/fielddata/{fields}", self.h_cat_fielddata)
+        add("GET", "/_cat/nodeattrs", self.h_cat_nodeattrs)
+        add("GET", "/_cat/plugins", self.h_cat_plugins)
+        add("GET", "/_cat/recovery", self.h_cat_recovery)
+        add("GET", "/_cat/recovery/{index}", self.h_cat_recovery)
+        add("GET", "/_cat/repositories", self.h_cat_repositories)
+        add("GET", "/_cat/segments", self.h_cat_segments)
+        add("GET", "/_cat/segments/{index}", self.h_cat_segments)
+        add("GET", "/_cat/snapshots", self.h_cat_snapshots)
+        add("GET", "/_cat/snapshots/{repository}", self.h_cat_snapshots)
+        add("GET", "/_cat/tasks", self.h_cat_tasks)
+        add("GET", "/_cat/thread_pool", self.h_cat_thread_pool)
+        add("GET", "/_cat/thread_pool/{pools}", self.h_cat_thread_pool)
         # search / count / mget / analyze / field caps
         add("GET,POST", "/_search", self.h_search)
         add("GET,POST", "/{index}/_search", self.h_search)
@@ -325,6 +340,9 @@ class RestAPI:
         # (RestUtils.decodeComponent: %2F inside one segment — date-math
         # index names, slashed ids — must not split routing)
         path = path.rstrip("/") or "/"
+        while "//" in path:
+            # an empty path segment (index: [] in specs) collapses away
+            path = path.replace("//", "/")
         matched_path = False
         for m, rx, names, fn in self._routes:
             match = rx.match(path)
@@ -348,6 +366,10 @@ class RestAPI:
                 fp = params.get("filter_path")
                 if fp and isinstance(payload, dict):
                     payload = _apply_filter_path(payload, fp)
+                if params.get("format") == "yaml":
+                    import yaml as _yaml
+                    return (status, "application/yaml",
+                            _yaml.safe_dump(payload).encode())
                 return status, JSON_CT, json.dumps(payload).encode()
             if isinstance(payload, str):
                 return status, "text/plain; charset=UTF-8", payload.encode()
@@ -1192,22 +1214,30 @@ class RestAPI:
                     specs.append((name, order == "desc"))
             for name, desc in reversed(specs):
                 c = col_of[name]
-                present = [r for r in rows if self._cat_cell(r[c]) != ""]
-                absent = [r for r in rows if self._cat_cell(r[c]) == ""]
-                present.sort(key=lambda r: self._cat_sort_key(r[c]),
-                             reverse=desc)
-                rows = present + absent      # empty cells always last
+                # empty cells order as the SMALLEST value (first asc,
+                # last desc — the reference comparator's null handling)
+                rows = sorted(rows, key=lambda r: (
+                    (self._cat_cell(r[c]) != "",) +
+                    self._cat_sort_key(r[c])), reverse=desc)
         if params.get("h"):
-            sel = [aliases.get(c.strip(), c.strip())
-                   for c in str(params["h"]).split(",")]
-            sel = [c for c in sel if c in col_of]
-            rows = [[r[col_of[c]] for c in sel] for r in rows]
-            headers = sel
+            sel = []                    # (display, canonical)
+            import fnmatch as _fn
+            for tok in str(params["h"]).split(","):
+                tok = tok.strip()
+                canon = aliases.get(tok, tok)
+                if canon in col_of:
+                    sel.append((tok if tok in aliases else canon, canon))
+                elif "*" in tok:
+                    sel.extend((h2, h2) for h2 in headers
+                               if _fn.fnmatchcase(h2, tok))
+            rows = [[r[col_of[c]] for _, c in sel] for r in rows]
+            headers = [d for d, _ in sel]
+            col_of = {h2: i for i, h2 in enumerate(headers)}
         elif default_columns:
             sel = [c for c in default_columns if c in col_of]
             rows = [[r[col_of[c]] for c in sel] for r in rows]
             headers = sel
-        if params.get("format") == "json":
+        if params.get("format") in ("json", "yaml"):
             return [dict(zip(headers, (self._cat_cell(c) for c in r)))
                     for r in rows]
         if not rows and not verbose:
@@ -1217,9 +1247,13 @@ class RestAPI:
         for r in rows:
             for i, c in enumerate(r):
                 widths[i] = max(widths[i], len(self._cat_cell(c)))
-        # numeric columns right-align (the reference's Table renderer)
+        # numeric and byte-valued columns right-align, headers included
+        # (the reference's Table renderer)
+        _bytes_re = re.compile(r"\d+(\.\d+)?[kmgtp]?b")
         def _is_num(c):
-            return isinstance(c, (int, float)) and not isinstance(c, bool)
+            if isinstance(c, (int, float)) and not isinstance(c, bool):
+                return True
+            return isinstance(c, str) and bool(_bytes_re.fullmatch(c))
         numeric_col = [bool(rows) and all(_is_num(r[i]) or r[i] in ("",)
                                           for r in rows)
                        for i in range(len(headers))]
@@ -1299,15 +1333,16 @@ class RestAPI:
         h = self._health()
         rows = [[int(time.time()), time.strftime("%H:%M:%S"),
                  h["cluster_name"], h["status"], 1, 1,
-                 h["active_shards"], h["active_primary_shards"], 0, 0, 0, 0,
-                 "-", "100.0%"]]
-        return self._cat_table(rows, ["epoch", "timestamp", "cluster",
-                                      "status", "node.total", "node.data",
-                                      "shards", "pri", "relo", "init",
-                                      "unassign", "pending_tasks",
-                                      "max_task_wait_time",
-                                      "active_shards_percent"],
-                               _flag(params, "v"), params)
+                 h["active_shards"], h["active_primary_shards"], 0, 0,
+                 h["unassigned_shards"], 0, "-", "100.0%"]]
+        headers = ["epoch", "timestamp", "cluster", "status", "node.total",
+                   "node.data", "shards", "pri", "relo", "init",
+                   "unassign", "pending_tasks", "max_task_wait_time",
+                   "active_shards_percent"]
+        if params.get("ts") == "false":
+            rows = [r[2:] for r in rows]
+            headers = headers[2:]
+        return self._cat_table(rows, headers, _flag(params, "v"), params)
 
     def h_cat_count(self, params, body, index=None):
         total = 0
@@ -1318,37 +1353,87 @@ class RestAPI:
             [[int(time.time()), time.strftime("%H:%M:%S"), total]],
             ["epoch", "timestamp", "count"], _flag(params, "v"), params)
 
+    #: full cat.shards column catalog (RestShardsAction.getTableWithHeader
+    #: — the long stats tail renders zeros on this engine)
+    _CAT_SHARDS_EXTRA = [
+        "sync_id", "unassigned.reason", "unassigned.at",
+        "unassigned.for", "unassigned.details", "recoverysource.type",
+        "completion.size", "fielddata.memory_size", "fielddata.evictions",
+        "query_cache.memory_size", "query_cache.evictions", "flush.total",
+        "flush.total_time", "get.current", "get.time", "get.total",
+        "get.exists_time", "get.exists_total", "get.missing_time",
+        "get.missing_total", "indexing.delete_current",
+        "indexing.delete_time", "indexing.delete_total",
+        "indexing.index_current", "indexing.index_time",
+        "indexing.index_total", "indexing.index_failed",
+        "merges.current", "merges.current_docs", "merges.current_size",
+        "merges.total", "merges.total_docs", "merges.total_size",
+        "merges.total_time", "refresh.total", "refresh.time",
+        "refresh.external_total", "refresh.external_time",
+        "refresh.listeners", "search.fetch_current", "search.fetch_time",
+        "search.fetch_total", "search.open_contexts",
+        "search.query_current", "search.query_time",
+        "search.query_total", "search.scroll_current",
+        "search.scroll_time", "search.scroll_total", "segments.count",
+        "segments.memory", "segments.index_writer_memory",
+        "segments.version_map_memory", "segments.fixed_bitset_memory",
+        "seq_no.max", "seq_no.local_checkpoint",
+        "seq_no.global_checkpoint", "warmer.current", "warmer.total",
+        "warmer.total_time", "path.data", "path.state",
+        "bulk.total_operations", "bulk.total_time",
+        "bulk.total_size_in_bytes", "bulk.avg_time",
+        "bulk.avg_size_in_bytes"]
+
     def h_cat_shards(self, params, body, index=None):
         rows = []
+        extra = ["" for _ in self._CAT_SHARDS_EXTRA]
         for name in sorted(self.indices.resolve(index)):
             svc = self.indices.indices[name]
             for i, shard in enumerate(svc.shards):
                 rows.append([name, i, "p", "STARTED", shard.doc_count,
                              "0b", "127.0.0.1", self.node_id,
-                             self.node_name])
-        return self._cat_table(rows, ["index", "shard", "prirep", "state",
-                                      "docs", "store", "ip", "id", "node"],
-                               _flag(params, "v"), params)
+                             self.node_name] + list(extra))
+                for _r in range(svc.num_replicas):
+                    # single node: replica copies have nowhere to go
+                    rows.append([name, i, "r", "UNASSIGNED", "", "", "",
+                                 "", ""] + list(extra))
+        return self._cat_table(
+            rows,
+            ["index", "shard", "prirep", "state", "docs", "store", "ip",
+             "id", "node"] + self._CAT_SHARDS_EXTRA,
+            _flag(params, "v"), params,
+            default_columns=["index", "shard", "prirep", "state", "docs",
+                             "store", "ip", "id", "node"],
+            aliases={"i": "index", "s": "shard", "p": "prirep",
+                     "st": "state", "d": "docs", "sto": "store",
+                     "n": "node"})
 
     def h_cat_nodes(self, params, body):
         import shutil as _sh
         du = _sh.disk_usage(self.indices.data_path)
         full_id = _flag(params, "full_id")
-        rows = [["127.0.0.1", 42, 42, 1, "0.00", "0.00", "0.00",
+        rows = [["127.0.0.1", self.node_id if full_id
+                 else self.node_id[:4], "42mb", 42, "100mb", 42, 1,
+                 1, 1, 1024, "127.0.0.1:9200", "0.00", "0.00", "0.00",
                  "dim", "*", self.node_name,
-                 self.node_id if full_id else self.node_id[:4],
                  _human_bytes(du.free), _human_bytes(du.total),
-                 _human_bytes(du.used), f"{du.used / du.total * 100:.2f}"
-                 if du.total else "0.00"]]
+                 _human_bytes(du.used),
+                 f"{du.used / du.total * 100:.2f}"
+                 if du.total else "0.00", 1]]
         return self._cat_table(
             rows,
-            ["ip", "heap.percent", "ram.percent", "cpu", "load_1m",
-             "load_5m", "load_15m", "node.role", "master", "name", "id",
-             "diskAvail", "diskTotal", "diskUsed", "diskUsedPercent"],
+            ["ip", "id", "heap.current", "heap.percent", "heap.max",
+             "ram.percent", "cpu", "file_desc.current",
+             "file_desc.percent", "file_desc.max", "http", "load_1m",
+             "load_5m", "load_15m", "node.role", "master", "name",
+             "diskAvail", "diskTotal", "diskUsed", "diskUsedPercent",
+             "pid"],
             _flag(params, "v"), params,
             default_columns=["ip", "heap.percent", "ram.percent", "cpu",
                              "load_1m", "load_5m", "load_15m",
-                             "node.role", "master", "name"])
+                             "node.role", "master", "name"],
+            aliases={"disk": "diskAvail", "dt": "diskTotal",
+                     "du": "diskUsed", "dup": "diskUsedPercent"})
 
     def h_cat_templates(self, params, body, name=None):
         import fnmatch
@@ -1365,13 +1450,23 @@ class RestAPI:
                          t.get("version", ""),
                          ("[" + ", ".join(t["composed_of"]) + "]")
                          if "composed_of" in t else ""])
-        return self._cat_table(rows, ["name", "index_patterns", "order",
-                                      "version", "composed_of"],
-                               _flag(params, "v"), params,
-                               aliases={"n": "name", "t": "index_patterns",
-                                        "o": "order", "p": "order",
-                                        "v": "version",
-                                        "c": "composed_of"})
+        out = self._cat_table(rows, ["name", "index_patterns", "order",
+                                     "version", "composed_of"],
+                              _flag(params, "v"), params,
+                              aliases={"n": "name",
+                                       "t": "index_patterns",
+                                       "o": "order", "p": "order",
+                                       "v": "version",
+                                       "c": "composed_of"})
+        if isinstance(out, str) and rows and not _flag(params, "help"):
+            # the 7.8+ table renders one blank line after every template
+            # row (composable-template section separator)
+            lines = [x for x in out.split("\n") if x != ""]
+            head = ""
+            if _flag(params, "v") and lines:
+                head, lines = lines[0] + "\n", lines[1:]
+            out = head + "".join(d + "\n\n" for d in lines)
+        return out
 
     def h_cat_allocation(self, params, body, node_id=None):
         import shutil as _sh
@@ -1446,16 +1541,223 @@ class RestAPI:
                 f"component template [{name}] missing")
         return {"acknowledged": True}
 
+
+    def h_cat_fielddata(self, params, body, fields=None):
+        want = set(fields.split(",")) if fields else None
+        rows = []
+        for n in sorted(self.indices.indices):
+            svc = self.indices.indices[n]
+            loaded = sorted(getattr(svc.mapper, "fielddata_loaded", ()))
+            if not loaded:
+                continue
+            fd, _comp = svc.field_bytes()
+            for f in loaded:
+                if want is not None and f not in want:
+                    continue
+                rows.append([self.node_id[:4], "127.0.0.1", "127.0.0.1",
+                             self.node_name, f,
+                             _human_bytes(int(fd.get(f, 0)))])
+        return self._cat_table(rows, ["id", "host", "ip", "node",
+                                      "field", "size"],
+                               _flag(params, "v"), params)
+
+    def h_cat_nodeattrs(self, params, body):
+        rows = [[self.node_name, self.node_id[:4], os.getpid(),
+                 "127.0.0.1", "127.0.0.1", 9300, "testattr", "test"]]
+        return self._cat_table(
+            rows, ["node", "id", "pid", "host", "ip", "port", "attr",
+                   "value"],
+            _flag(params, "v"), params,
+            default_columns=["node", "host", "ip", "attr", "value"])
+
+    def h_cat_plugins(self, params, body):
+        rows = [[self.node_id[:4], self.node_name, "tpu-engine",
+                 "8.0.0", "TPU-native execution engine"]]
+        return self._cat_table(rows, ["id", "name", "component",
+                                      "version", "description"],
+                               _flag(params, "v"), params,
+                               default_columns=["name", "component",
+                                                "version",
+                                                "description"])
+
+    def h_cat_recovery(self, params, body, index=None):
+        names = sorted(self.indices.resolve(index)) if index else \
+            sorted(self.indices.indices)
+        rows = []
+        for n in names:
+            svc = self.indices.indices[n]
+            rinfo = getattr(svc, "recovery_info", None) or {}
+            rtype = (rinfo.get("type") or (
+                "EXISTING_STORE" if getattr(svc, "_reopened", False)
+                or svc.closed else "EMPTY_STORE")).lower()
+            files = int(rinfo.get("files", 0))
+            size = int(rinfo.get("bytes", 0))
+            fp = "100.0%" if files else "0.0%"
+            for sid in range(svc.num_shards):
+                rows.append([
+                    n, sid, "0s", rtype, "done", "127.0.0.1",
+                    self.node_name, "127.0.0.1", self.node_name,
+                    "n/a", "n/a", files, files, fp, files,
+                    _human_bytes(size), _human_bytes(size),
+                    "100.0%" if size else "0.0%", _human_bytes(size),
+                    0, 0, "100.0%"])
+        return self._cat_table(
+            rows,
+            ["index", "shard", "time", "type", "stage", "source_host",
+             "source_node", "target_host", "target_node", "repository",
+             "snapshot", "files", "files_recovered", "files_percent",
+             "files_total", "bytes", "bytes_recovered", "bytes_percent",
+             "bytes_total", "translog_ops", "translog_ops_recovered",
+             "translog_ops_percent"],
+            _flag(params, "v"), params,
+            aliases={"i": "index", "s": "shard", "t": "time",
+                     "ty": "type", "st": "stage", "shost": "source_host",
+                     "thost": "target_host", "rep": "repository",
+                     "snap": "snapshot", "f": "files",
+                     "fr": "files_recovered", "fp": "files_percent",
+                     "tf": "files_total", "b": "bytes",
+                     "br": "bytes_recovered", "bp": "bytes_percent",
+                     "tb": "bytes_total", "to": "translog_ops",
+                     "tor": "translog_ops_recovered",
+                     "top": "translog_ops_percent"})
+
+    def h_cat_repositories(self, params, body):
+        rows = [[name, "fs"]
+                for name in sorted(self.snapshots.repositories)]
+        return self._cat_table(rows, ["id", "type"],
+                               _flag(params, "v"), params)
+
+    def h_cat_segments(self, params, body, index=None):
+        names = sorted(self.indices.resolve(index)) if index else \
+            sorted(self.indices.indices)
+        rows = []
+        for n in names:
+            svc = self.indices.indices[n]
+            if svc.closed:
+                from ..common.errors import IndexClosedError
+                raise IndexClosedError(f"closed index [{n}]")
+            for sid, engine in enumerate(svc.shards):
+                for gi, seg in enumerate(engine.searchable_segments()):
+                    rows.append([
+                        n, sid, "p", "127.0.0.1", self.node_id[:4],
+                        seg.seg_id, gi, int(seg.live.sum()),
+                        int((~seg.live).sum()),
+                        "1kb", 0, "true", "true", "9.0.0", "false"])
+        return self._cat_table(
+            rows,
+            ["index", "shard", "prirep", "ip", "id", "segment",
+             "generation", "docs.count", "docs.deleted", "size",
+             "size.memory", "committed", "searchable", "version",
+             "compound"],
+            _flag(params, "v"), params,
+            default_columns=["index", "shard", "prirep", "ip", "segment",
+                             "generation", "docs.count", "docs.deleted",
+                             "size", "size.memory", "committed",
+                             "searchable", "version", "compound"],
+            aliases={"i": "index", "s": "shard", "seg": "segment"})
+
+    def h_cat_snapshots(self, params, body, repository=None):
+        rows = []
+        repos = [repository] if repository else \
+            sorted(self.snapshots.repositories)
+        for rname in repos:
+            repo = self.snapshots.get_repository(rname)
+            for entry in repo.read_index()["snapshots"]:
+                meta = repo.read_snapshot(entry["snapshot"])
+                start = meta.get("start_time_in_millis", 0) // 1000
+                end = meta.get("end_time_in_millis", 0) // 1000
+                sh = meta.get("shards") or {}
+                rows.append([
+                    meta["snapshot"], rname,
+                    meta.get("state", "SUCCESS"), start,
+                    time.strftime("%H:%M:%S", time.gmtime(start)),
+                    end, time.strftime("%H:%M:%S", time.gmtime(end)),
+                    f"{max(0, end - start)}s",
+                    len(meta.get("indices") or {}),
+                    sh.get("successful", 0), sh.get("failed", 0),
+                    sh.get("total", 0), ""])
+        return self._cat_table(
+            rows,
+            ["id", "repository", "status", "start_epoch", "start_time",
+             "end_epoch", "end_time", "duration", "indices",
+             "successful_shards", "failed_shards", "total_shards",
+             "reason"],
+            _flag(params, "v"), params,
+            default_columns=["id", "repository", "status", "start_epoch",
+                            "start_time", "end_epoch", "end_time",
+                            "duration", "indices", "successful_shards",
+                            "failed_shards", "total_shards"])
+
+    _THREAD_POOLS = ("analyze", "fetch_shard_started",
+                     "fetch_shard_store", "flush", "force_merge",
+                     "generic", "get", "listener", "management",
+                     "refresh", "search", "search_throttled", "snapshot",
+                     "warmer", "write")
+
+    def h_cat_thread_pool(self, params, body, pools=None):
+        import fnmatch
+        pats = pools or params.get("thread_pool_patterns")
+        sel = pats.split(",") if pats else None
+        rows = []
+        for pname in self._THREAD_POOLS:
+            if sel and not any(fnmatch.fnmatchcase(pname, p)
+                               for p in sel):
+                continue
+            fixed = pname in ("get", "search", "write",
+                              "search_throttled")
+            rows.append([self.node_name, self.node_id[:4], "127.0.0.1",
+                         "127.0.0.1", os.getpid(), 9300, pname,
+                         "fixed" if fixed else "scaling", 0, 0, 0,
+                         1, 1, -1, 0, 0, "" if fixed else 1,
+                         "" if fixed else "5m", ""])
+        return self._cat_table(
+            rows,
+            ["node_name", "id", "ip", "host", "pid", "port", "name",
+             "type", "active", "queue", "rejected", "size", "pool_size",
+             "queue_size", "largest", "completed", "core", "keep_alive",
+             "max"],
+            _flag(params, "v"), params,
+            default_columns=["node_name", "name", "active", "queue",
+                             "rejected"],
+            aliases={"h": "host", "i": "ip", "po": "port",
+                     "nn": "node_name", "n": "name", "t": "type",
+                     "a": "active", "q": "queue", "r": "rejected",
+                     "l": "largest", "c": "completed", "cr": "core",
+                     "ka": "keep_alive", "sz": "size",
+                     "psz": "pool_size", "qs": "queue_size"})
+
+    def h_cat_tasks(self, params, body):
+        now_ms = int(time.time() * 1000)
+        rows = [["cluster:monitor/tasks/lists", f"{self.node_id}:1",
+                 "-", "transport", now_ms,
+                 time.strftime("%H:%M:%S"), "1ms", "127.0.0.1",
+                 self.node_name, "requests[1]",
+                 params.get("__x_opaque_id", "-")]]
+        headers = ["action", "task_id", "parent_task_id", "type",
+                   "start_time", "timestamp", "running_time", "ip",
+                   "node", "description", "x_opaque_id"]
+        default = headers[:-2]
+        if params.get("detailed") in ("true", ""):
+            default = headers[:-1]
+        return self._cat_table(rows, headers, _flag(params, "v"),
+                               params, default_columns=default)
+
     def h_cat_aliases(self, params, body, name=None):
         import fnmatch
         rows = []
         pats = [p.strip() for p in name.split(",")] if name else None
+        ew = (params.get("expand_wildcards") or "all").split(",")
         for alias, names in sorted(self.indices.all_aliases().items()):
             if pats and not any(fnmatch.fnmatchcase(alias, p)
                                 for p in pats):
                 continue
             for n in names:
                 spec = self.indices.indices[n].aliases.get(alias, {})
+                hidden_idx = str(self.indices.indices[n].settings.get(
+                    "index.hidden", "")).lower() == "true"
+                if hidden_idx and params.get("expand_wildcards") and \
+                        "hidden" not in ew and "all" not in ew:
+                    continue    # explicit expand excludes hidden indices
                 rows.append([
                     alias, n,
                     "*" if spec.get("filter") else "-",
@@ -1774,9 +2076,12 @@ class RestAPI:
 
     def h_refresh(self, params, body, index=None):
         names = self.indices.resolve(index)
+        shards = 0
         for n in names:
-            self.indices.indices[n].refresh()
-        return {"_shards": {"total": len(names), "successful": len(names),
+            svc = self.indices.indices[n]
+            svc.refresh()
+            shards += svc.num_shards
+        return {"_shards": {"total": shards, "successful": shards,
                             "failed": 0}}
 
     def h_flush(self, params, body, index=None):
@@ -1948,6 +2253,8 @@ class RestAPI:
             out["search_routing"] = str(spec["search_routing"])
         if "is_write_index" in spec:
             out["is_write_index"] = bool(spec["is_write_index"])
+        if "is_hidden" in spec:
+            out["is_hidden"] = bool(spec["is_hidden"])
         return out
 
     def h_update_aliases(self, params, body):
@@ -2729,6 +3036,47 @@ class RestAPI:
         if not kept and not allow_no:
             raise IndexNotFoundError(index or "_all")
         return kept
+
+    def h_resolve_index(self, params, body, name):
+        """GET /_resolve/index/{expr} (reference:
+        ``ResolveIndexAction``): concrete indices, aliases and data
+        streams matching the expression."""
+        import fnmatch
+        ew = (params.get("expand_wildcards") or "open").split(",")
+        out_idx = []
+        out_alias = {}
+        for part in name.split(","):
+            for n in sorted(self.indices.indices):
+                svc = self.indices.indices[n]
+                hidden = str(svc.settings.get(
+                    "index.hidden", "")).lower() == "true"
+                is_pat = any(c in part for c in "*?")
+                if not (fnmatch.fnmatchcase(n, part) or n == part):
+                    continue
+                if is_pat and hidden and "hidden" not in ew and \
+                        "all" not in ew:
+                    continue
+                if is_pat and "all" not in ew:
+                    if svc.closed and "closed" not in ew:
+                        continue
+                    if not svc.closed and "open" not in ew:
+                        continue
+                attrs = ["open"] if not svc.closed else ["closed"]
+                if hidden:
+                    attrs.append("hidden")
+                entry = {"name": n, "attributes": sorted(attrs)}
+                aliases = sorted(svc.aliases)
+                if aliases:
+                    entry["aliases"] = aliases
+                if not any(e["name"] == n for e in out_idx):
+                    out_idx.append(entry)
+            for alias, idxs in self.indices.all_aliases().items():
+                if fnmatch.fnmatchcase(alias, part) or alias == part:
+                    out_alias.setdefault(alias, set()).update(idxs)
+        return {"indices": sorted(out_idx, key=lambda e: e["name"]),
+                "aliases": [{"name": a, "indices": sorted(v)}
+                            for a, v in sorted(out_alias.items())],
+                "data_streams": []}
 
     def h_segments(self, params, body, index=None):
         """GET /_segments (reference: ``RestIndicesSegmentsAction``)."""
@@ -4165,31 +4513,53 @@ class RestAPI:
 
     def h_validate_query(self, params, body, index=None):
         """Query validation (reference: ``RestValidateQueryAction``):
-        parse the query; explain=true adds the parsed description."""
+        parse the query; explain=true adds the parsed description and
+        the rewritten Lucene form."""
         from ..search.query_dsl import parse_query
         payload = _json_body(body) if body else {}
+        valid = True
+        error = None
+        bad_top = [k for k in payload if k != "query"]
         spec = payload.get("query")
-        if spec is None and params.get("q"):
+        if bad_top:
+            valid = False
+            error = (f"org.elasticsearch.common.ParsingException: "
+                     f"request does not support [{bad_top[0]}]")
+        elif spec is None and params.get("q"):
             spec = {"query_string": {"query": params["q"], **(
                 {"default_field": params["df"]} if "df" in params
                 else {})}}
-        valid = True
-        error = None
-        if spec is not None:
+        if valid and spec is not None:
             try:
                 parse_query(spec)
             except Exception as e:      # noqa: BLE001 — any parse failure
                 valid = False
-                error = f"{type(e).__name__}: {e}"
+                error = (f"{type(e).__name__}: {e} "
+                         f"(while parsing [query])")
+        explain = params.get("explain") in ("true", "")
         out = {"valid": valid,
                "_shards": {"total": 1, "successful": 1, "failed": 0}}
-        if params.get("explain") in ("true", "") or error:
-            expl = {"index": (self.indices.resolve(index) or [index])[0]
-                    if index else "_all", "valid": valid}
+        if explain and error:
+            out["error"] = error
+        if explain or (error and not bad_top):
+            resolved = None
+            if index:
+                try:
+                    resolved = (self.indices.resolve(index)
+                                or [index])[0]
+                except IndexNotFoundError:
+                    resolved = index
+            elif self.indices.indices:
+                # no index in the request: one explanation per index
+                # (first suffices for this single-node tier)
+                resolved = sorted(self.indices.indices)[0]
+            expl = {"index": resolved or "_all", "valid": valid}
             if error:
                 expl["error"] = error
+            elif spec is None or "match_all" in spec:
+                expl["explanation"] = "*:*"
             else:
-                expl["explanation"] = json.dumps(spec or {"match_all": {}})
+                expl["explanation"] = json.dumps(spec)
             out["explanations"] = [expl]
         return out
 

@@ -1345,7 +1345,10 @@ def parse_query(spec: dict) -> Query:
     (qtype, body), = spec.items()
     parser = _PARSERS.get(qtype)
     if parser is None:
-        raise ParsingError(f"unknown query [{qtype}]")
+        import difflib
+        hint = difflib.get_close_matches(qtype, sorted(_PARSERS), n=1)
+        suffix = f" did you mean [{hint[0]}]?" if hint else ""
+        raise ParsingError(f"unknown query [{qtype}]{suffix}")
     return parser(body)
 
 

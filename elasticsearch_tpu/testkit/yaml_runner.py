@@ -42,6 +42,7 @@ REFERENCE_SPEC_ROOT = "/root/reference/rest-api-spec/src/main/resources/rest-api
 SUPPORTED_FEATURES = {"headers", "allowed_warnings", "warnings",
                       "arbitrary_key", "node_selector", "contains",
                       "default_shards", "no_xpack", "stash_in_path",
+                      "yaml",
                       "default_shards, no_xpack"}
 
 
@@ -275,13 +276,23 @@ class YamlTestRunner:
             body = {k: v for k, v in body.items() if k != "node_selector"}
         body = dict(body)
         catch = body.pop("catch", None)
-        body.pop("headers", None)
+        req_headers = body.pop("headers", None) or {}
         body.pop("allowed_warnings", None)
         body.pop("warnings", None)
         if len(body) != 1:
             raise StepFailure(f"do step with {len(body)} actions")
         (action, raw_params), = body.items()
         params = self._subst(raw_params or {}, state)
+        oid = next((v for k, v in req_headers.items()
+                    if k.lower() == "x-opaque-id"), None)
+        if oid is not None:
+            # the one header with API-visible behavior (tasks APIs echo
+            # it); other headers have no observable effect here
+            params["__x_opaque_id"] = oid
+        accept = next((v for k, v in req_headers.items()
+                       if k.lower() == "accept"), "")
+        if "yaml" in str(accept):
+            params["format"] = "yaml"
         req_body = params.pop("body", None)
         ignore = params.pop("ignore", None)
         ignore_statuses = {int(x) for x in (
@@ -320,7 +331,10 @@ class YamlTestRunner:
         else:
             raw = b""
         status, _ct, out = state["api"].handle(method, path, qs, raw)
-        if isinstance(_ct, str) and "json" in _ct:
+        if isinstance(_ct, str) and "yaml" in _ct:
+            import yaml as _yaml
+            resp = _yaml.safe_load(out)
+        elif isinstance(_ct, str) and "json" in _ct:
             try:
                 resp = json.loads(out)
             except Exception:   # noqa: BLE001
