@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -45,40 +46,114 @@ CPU_REF_QUERIES = 32       # CPU reference is ~0.2 s/query at 8.4M docs
 K1, B = 1.2, 0.75
 
 
-def _init_jax_backend(retries: int = 3, backoff_s: float = 10.0):
-    """Initialize the accelerator backend, retrying transient failures.
+# ---------------------------------------------------------------------------
+# Backend orchestration (parent process — NEVER touches a jax backend itself)
+#
+# Rounds 1 and 2 produced no perf number because jax backend init against the
+# tunneled accelerator sometimes HANGS instead of throwing: an in-process
+# retry loop around jax.devices() (the round-2 fix) blocks forever on attempt
+# 2 and the driver's outer timeout kills the whole script (rc=124, no JSON).
+# The only robust shape is process isolation: probe the backend in a
+# subprocess with a hard wall-clock timeout, run the bench itself in a
+# timeboxed subprocess, and fall back to forced-CPU (proven to work — the
+# test suite runs on it) or, last resort, a pure-numpy measurement.
+# A final JSON line is emitted UNCONDITIONALLY.
+# ---------------------------------------------------------------------------
 
-    Round-1 bench died inside ``jax.devices()`` with a transient "TPU backend
-    setup/compile error" and produced no number at all. Retry with backoff;
-    if the accelerator never comes up, fall back to CPU so the bench still
-    emits a (clearly labeled) measurement instead of exiting nonzero.
-    """
-    import jax
-    if os.environ.get("BENCH_FORCE_CPU"):
-        # local/dev runs: the ambient sitecustomize registers the accelerator
-        # backend and env vars alone can't override it — go through jax.config
-        jax.config.update("jax_platforms", "cpu")
-    last = None
-    for attempt in range(retries):
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+ACCEL_BENCH_TIMEOUT_S = int(os.environ.get("BENCH_ACCEL_TIMEOUT", 700))
+CPU_BENCH_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", 500))
+
+_PROBE_SRC = (
+    "import jax; d = jax.devices(); print(d[0].platform, len(d), flush=True)"
+)
+
+
+def _probe_backend(attempts: int = 2) -> str | None:
+    """Ask a throwaway subprocess what jax backend comes up, with a hard
+    timeout per attempt. Returns the platform string or None if the backend
+    hangs/fails every attempt."""
+    for i in range(attempts):
         try:
-            devs = jax.devices()
-            print(f"# jax backend: {devs[0].platform} x{len(devs)}",
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                plat, ndev = r.stdout.split()[:2]
+                print(f"# backend probe: {plat} x{ndev}", file=sys.stderr)
+                return plat
+            print(f"# backend probe attempt {i + 1}/{attempts} rc="
+                  f"{r.returncode}: {r.stderr.strip()[-300:]}",
                   file=sys.stderr)
-            return jax
-        except Exception as e:  # backend init is the only thing that throws
-            last = e
-            print(f"# backend init attempt {attempt + 1}/{retries} failed: "
-                  f"{e}", file=sys.stderr)
-            if attempt + 1 < retries:
-                time.sleep(backoff_s)
-    print(f"# falling back to CPU after {retries} failures: {last}",
+        except subprocess.TimeoutExpired:
+            print(f"# backend probe attempt {i + 1}/{attempts} timed out "
+                  f"after {PROBE_TIMEOUT_S}s (hung init)", file=sys.stderr)
+    return None
+
+
+def _run_child(mode: str, timeout_s: int) -> str | None:
+    """Run `bench.py --child <mode>` under a hard timeout; return its final
+    JSON stdout line, or None on timeout/failure."""
+    print(f"# launching bench child mode={mode} timeout={timeout_s}s",
           file=sys.stderr)
     try:
-        jax.config.update("jax_platforms", "cpu")
-        jax.devices()
-        return jax
-    except Exception as e:
-        raise SystemExit(f"no usable jax backend: {e}") from e
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", mode],
+            stdout=subprocess.PIPE, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"# bench child ({mode}) timed out after {timeout_s}s",
+              file=sys.stderr)
+        return None
+    line = None
+    for ln in (r.stdout or "").splitlines():
+        ln = ln.strip()
+        if ln.startswith("{") and ln.endswith("}"):
+            line = ln
+    if r.returncode != 0:
+        print(f"# bench child ({mode}) rc={r.returncode}", file=sys.stderr)
+        return None
+    if line is None:
+        print(f"# bench child ({mode}) emitted no JSON line", file=sys.stderr)
+    return line
+
+
+def _numpy_last_resort() -> None:
+    """No usable jax backend at all: measure the numpy CSR reference alone so
+    the driver still records a real (clearly labeled) number."""
+    rng = np.random.RandomState(1234)
+    from elasticsearch_tpu.utils.synth import synthetic_csr_corpus_fast
+    n_docs = 1 << 16
+    corpus = synthetic_csr_corpus_fast(rng, n_docs, VOCAB, AVG_DL, zipf_s=1.2)
+    queries = sample_queries(rng, corpus, 1, batch=CPU_REF_QUERIES)[0]
+    times, _ = cpu_bm25_search(corpus, queries, K)
+    qps = len(times) / sum(times)
+    print(json.dumps({
+        "metric": f"bm25_topk_qps_{n_docs}_docs_uncapped_df",
+        "value": round(qps, 1),
+        "unit": "queries/s",
+        "vs_baseline": 1.0,
+        "p99_ms": round(float(np.percentile(times, 99) * 1e3), 2),
+        "cpu_ref_qps": round(qps, 1),
+        "n_devices": 0,
+        "backend": "numpy-fallback-no-jax",
+    }))
+
+
+def orchestrate() -> None:
+    plan: list[tuple[str, int]] = []
+    if not os.environ.get("BENCH_FORCE_CPU"):
+        plat = _probe_backend()
+        if plat is not None and plat != "cpu":
+            plan.append(("accel", ACCEL_BENCH_TIMEOUT_S))
+    plan.append(("cpu", CPU_BENCH_TIMEOUT_S))
+    for mode, tmo in plan:
+        line = _run_child(mode, tmo)
+        if line is not None:
+            print(line, flush=True)
+            return
+    _numpy_last_resort()
 
 
 def sample_queries(rng, corpus, n_batches, batch=BATCH):
@@ -146,14 +221,20 @@ def _score_one(corpus, terms, doc: int) -> float:
     return s
 
 
-def main():
-    jax = _init_jax_backend()
+def main(mode: str = "accel"):
+    import jax
+    if mode == "cpu" or os.environ.get("BENCH_FORCE_CPU"):
+        # the ambient sitecustomize registers the accelerator backend and env
+        # vars alone can't override it — go through jax.config
+        jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    print(f"# jax backend: {devs[0].platform} x{len(devs)}", file=sys.stderr)
     from elasticsearch_tpu.parallel import (DistributedSearchPlane,
                                             make_search_mesh)
     from elasticsearch_tpu.utils.synth import (split_csr_shards,
                                                synthetic_csr_corpus_fast)
 
-    on_cpu = jax.devices()[0].platform == "cpu"
+    on_cpu = devs[0].platform == "cpu"
     n_docs = int(os.environ.get("BENCH_N_DOCS", 0)) or \
         ((1 << 18) if on_cpu else (1 << 23))
 
@@ -240,4 +321,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 2 and sys.argv[1] == "--child":
+        main(sys.argv[2] if len(sys.argv) > 2 else "accel")
+    else:
+        orchestrate()

@@ -110,12 +110,20 @@ class KeywordFieldData:
 
 @dataclass
 class NumericFieldData:
-    """(value, doc) pair column. Device floats are offsets from ``base``."""
+    """(value, doc) pair column.
 
-    base: float                              # float64 min value
+    The device column stores each pair's int32 RANK among the segment's
+    sorted distinct values, not the value itself: range bounds are
+    binary-searched into rank space on the host (exact f64 compares) and
+    the device compares integers — exact at ANY value span, where a
+    float32 value/offset column would overflow or collapse neighboring
+    values (the round-2 ±inf corruption on wide-span longs/doubles)."""
+
+    base: float                              # float64 min value (store manifest)
     vals_host: np.ndarray                    # float64[M] exact values
     docs_host: np.ndarray                    # int32[M]
-    vals_off_dev: jnp.ndarray = None         # float32[M_pad] (value - base)
+    uniq_vals: np.ndarray = None             # float64[U] sorted distinct values
+    ranks_dev: jnp.ndarray = None            # int32[M_pad] rank per pair
     docs_dev: jnp.ndarray = None             # int32[M_pad]
 
 
@@ -190,8 +198,9 @@ class Segment:
                                         jnp.int32)
         for f in self.numeric_fields.values():
             m_pad = round_up_pow2(max(f.docs_host.shape[0], 1))
-            off = (f.vals_host - f.base).astype(np.float32)
-            f.vals_off_dev = jnp.asarray(_pad_to(off, m_pad, 0.0), jnp.float32)
+            f.uniq_vals, inv = np.unique(f.vals_host, return_inverse=True)
+            f.ranks_dev = jnp.asarray(_pad_to(inv.astype(np.int32), m_pad, 0),
+                                      jnp.int32)
             f.docs_dev = jnp.asarray(_pad_to(f.docs_host, m_pad, n_pad), jnp.int32)
         for f in self.vector_fields.values():
             d = f.matrix_host.shape[1] if f.matrix_host.size else 0

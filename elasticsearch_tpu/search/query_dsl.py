@@ -486,35 +486,11 @@ class TermsQuery(Query):
         return jnp.where(mask, np.float32(self.boost), 0.0), mask
 
 
-def _f32_lower_bound(bound: float, inclusive: bool) -> np.float32:
-    """Largest-correct f32 lower bound: inclusive keeps values == bound,
-    exclusive admits only values > bound (bounds are in f32 offset space but
-    computed from exact f64; casts must round conservatively)."""
-    b32 = np.float32(bound)
-    if inclusive:
-        if np.float64(b32) > bound:
-            b32 = np.nextafter(b32, np.float32(-np.inf))
-    else:
-        if np.float64(b32) <= bound:
-            b32 = np.nextafter(b32, np.float32(np.inf))
-    return b32
-
-
-def _f32_upper_bound(bound: float, inclusive: bool) -> np.float32:
-    b32 = np.float32(bound)
-    if inclusive:
-        if np.float64(b32) < bound:
-            b32 = np.nextafter(b32, np.float32(np.inf))
-    else:
-        if np.float64(b32) >= bound:
-            b32 = np.nextafter(b32, np.float32(-np.inf))
-    return b32
-
-
 def _exact_numeric_mask(seg: Segment, field: str, lo, hi, boost):
-    """Host-side EXACT f64 range mask over a numeric field's pairs — for
-    types whose magnitudes exceed f32-offset precision on device (ip:
-    CIDR boundaries are exact integers near 2^32)."""
+    """Host-side EXACT f64 inclusive range mask over a numeric field's
+    pairs — for ip fields, whose query bounds are pre-adjusted to inclusive
+    exact integers (CIDR boundaries near 2^32); general numeric ranges run
+    in device rank space (``_numeric_range_result``)."""
     nf = seg.numeric_fields.get(field)
     if nf is None:
         return _const_result(seg, 0.0, False)
@@ -555,19 +531,35 @@ def _range_field_result(seg: Segment, field: str, lo, hi, relation: str,
 
 def _numeric_range_result(seg: Segment, field: str, lo, hi, boost,
                           include_lo=True, include_hi=True):
-    """Range mask over a numeric field's (value, doc) pairs. Bounds arrive in
-    value space (float64) and are shifted to the segment's f32 offset space
-    with conservative rounding so gt/gte/lt/lte stay exact for values that
-    are exactly representable after the base-offset shift."""
+    """Range mask over a numeric field's (value, doc) pairs. Bounds arrive
+    in value space (float64) and are binary-searched into the segment's
+    sorted-distinct-value RANK space on the host; the device compares int32
+    ranks — exact for gt/gte/lt/lte at any magnitude/span (no f32
+    offset rounding; see ``NumericFieldData``)."""
     nf = seg.numeric_fields.get(field)
-    if nf is None:
+    if nf is None or nf.uniq_vals is None or nf.uniq_vals.size == 0:
         return _const_result(seg, 0.0, False)
-    lo_off = (np.float32(-3.0e38) if lo is None
-              else _f32_lower_bound(float(lo) - nf.base, include_lo))
-    hi_off = (np.float32(3.0e38) if hi is None
-              else _f32_upper_bound(float(hi) - nf.base, include_hi))
+    uniq = nf.uniq_vals
+    # NaN values sort to the tail of uniq and must never match a range
+    n_comparable = int(uniq.shape[0] - np.isnan(uniq).sum())
+    if n_comparable == 0:
+        return _const_result(seg, 0.0, False)
+    if lo is None:
+        lo_rank = 0
+    else:
+        lo_rank = int(np.searchsorted(uniq, float(lo),
+                                      "left" if include_lo else "right"))
+    if hi is None:
+        hi_rank = n_comparable - 1
+    else:
+        hi_rank = min(int(np.searchsorted(uniq, float(hi),
+                                          "right" if include_hi else "left"))
+                      - 1, n_comparable - 1)
+    if lo_rank > hi_rank:
+        return _const_result(seg, 0.0, False)
     kernel = get_range_mask_kernel(seg.n_pad)
-    mask = kernel(nf.vals_off_dev, nf.docs_dev, lo_off, hi_off)
+    mask = kernel(nf.ranks_dev, nf.docs_dev,
+                  np.int32(lo_rank), np.int32(hi_rank))
     scores = jnp.where(mask, np.float32(boost), 0.0)
     return scores, mask
 

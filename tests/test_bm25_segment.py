@@ -164,19 +164,19 @@ def test_keyword_postings_and_ordinals():
     assert pairs == [(0, 0), (0, 1), (1, 1), (2, 0), (2, 2), (3, 1), (3, 1)]
 
 
-def test_numeric_docvalues_base_offset():
+def test_numeric_docvalues_rank_column():
     svc = MapperService({"properties": {"ts": {"type": "long"}}})
     builder = SegmentBuilder("_0")
-    vals = [1700000000123, 1700000000456, 1700000001000]
+    vals = [1700000000456, 1700000000123, 1700000001000]
     for i, v in enumerate(vals):
         builder.add(svc.parse_document(str(i), {"ts": v}), seq_no=i)
     seg = builder.build()
     nf = seg.numeric_fields["ts"]
     assert nf.base == 1700000000123.0
     np.testing.assert_array_equal(nf.vals_host, np.asarray(vals, np.float64))
-    # device offsets are exact because they are small
-    off = np.asarray(nf.vals_off_dev)[:3]
-    np.testing.assert_array_equal(off, [0.0, 333.0, 877.0])
+    # device column is the rank of each pair's value among sorted distincts
+    np.testing.assert_array_equal(np.asarray(nf.ranks_dev)[:3], [1, 0, 2])
+    np.testing.assert_array_equal(nf.uniq_vals, sorted(vals))
 
 
 def test_segment_deletes_and_find_doc():
