@@ -89,6 +89,10 @@ class IndexService:
         # shard request cache counters (no actual cache behind them yet:
         # every cacheable request counts as a miss, like a cold cache)
         self.request_cache_stats = {"hit_count": 0, "miss_count": 0}
+        # serving planes for the tiered TPU kernel (search/plane_route.py);
+        # lazily built per text field, invalidated by segment-list changes
+        from ..search.plane_route import ServingPlaneCache
+        self.plane_cache = ServingPlaneCache()
 
     def record_search(self, groups: Optional[List[str]] = None) -> None:
         self.search_stats["query_total"] += 1
@@ -143,7 +147,10 @@ class IndexService:
         segments = []
         for shard in self.shards:
             segments.extend(shard.searchable_segments())
-        sr = ShardSearcher(segments, self.mapper)
+        sr = ShardSearcher(
+            segments, self.mapper,
+            plane_provider=lambda segs, field:
+                self.plane_cache.plane_for(segs, self.mapper, field))
         mao = self.settings.get("index.highlight.max_analyzed_offset")
         if mao is not None:
             sr.max_analyzed_offset = int(mao)
@@ -155,7 +162,9 @@ class IndexService:
         from ..search.dist_query import DistributedSearcher
         return DistributedSearcher(
             [shard.searchable_segments() for shard in self.shards],
-            self.mapper)
+            self.mapper,
+            plane_provider=lambda segs, field:
+                self.plane_cache.plane_for(segs, self.mapper, field))
 
     def search(self, body: Optional[dict] = None) -> ShardSearchResult:
         self._check_open()

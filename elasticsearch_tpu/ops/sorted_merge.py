@@ -105,9 +105,12 @@ def bm25_merge_candidates(postings_docs, postings_impact, starts, lengths,
 
 def bm25_topk_merge_body(postings_docs, postings_impact, starts, lengths,
                          idfw, *, n_pad: int, L: int, k: int,
-                         min_should_match: int = 1):
+                         min_should_match: int = 1, with_count: bool = False):
     """Score one query against one shard partition, returning (values f32[k],
-    local_doc i32[k]); empty slots carry -inf / n_pad.
+    local_doc i32[k]); empty slots carry -inf / n_pad. With ``with_count``
+    also returns the scalar i32 number of matching docs (every match is a
+    candidate since runs are complete) — the device-side equivalent of
+    Lucene's ``TotalHitCountCollector`` without a second pass.
 
     postings_docs:   int32[P'] flat CSR doc ids (padding: n_pad sentinel).
     postings_impact: float32[P'] precomputed impacts (see make_impacts).
@@ -121,13 +124,15 @@ def bm25_topk_merge_body(postings_docs, postings_impact, starts, lengths,
         postings_docs, postings_impact, starts, lengths, idfw,
         n_pad=n_pad, L=L)
     n = sdocs.shape[0]
-    score = jnp.where(
-        is_last & (sdocs < n_pad) & (gcount >= min_should_match),
-        gscore, NEG_INF)
+    matched = is_last & (sdocs < n_pad) & (gcount >= min_should_match)
+    score = jnp.where(matched, gscore, NEG_INF)
     vals, sel = lax.top_k(score, min(k, n))
     out_docs = jnp.take(sdocs, sel, mode="clip")
     out_docs = jnp.where(vals > NEG_INF, out_docs, n_pad)
     if n < k:                       # fewer candidates than requested hits
         vals = jnp.pad(vals, (0, k - n), constant_values=NEG_INF)
         out_docs = jnp.pad(out_docs, (0, k - n), constant_values=n_pad)
+    if with_count:
+        return vals, out_docs.astype(jnp.int32), \
+            jnp.sum(matched.astype(jnp.int32))
     return vals, out_docs.astype(jnp.int32)

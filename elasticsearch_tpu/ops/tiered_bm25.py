@@ -125,8 +125,9 @@ def dense_stream_topk(W, dense_blocks, *, k: int,
 
     W:            f32[B, T] per-query idf·boost weights over dense rows.
     dense_blocks: bf16[n_blk, T, C] block-major impact rows.
-    Returns (vals f32[B, k], docs i32[B, k]) of docs scored by dense terms
-    alone (unmatched docs masked to -inf).
+    Returns (vals f32[B, k], docs i32[B, k], n_matched i32[B]) of docs
+    scored by dense terms alone (unmatched docs masked to -inf);
+    ``n_matched`` counts ALL dense-tier-matched docs, not just the top-k.
     """
     B = W.shape[0]
     C = dense_blocks.shape[2]
@@ -134,7 +135,7 @@ def dense_stream_topk(W, dense_blocks, *, k: int,
     Wpos = (W > 0).astype(jnp.float32)
 
     def step(carry, xs):
-        best_v, best_i = carry
+        best_v, best_i, n_matched = carry
         blk_idx, blk = xs
         s = lax.dot_general(W, blk.astype(jnp.float32),
                             (((1,), (0,)), ((), ())),
@@ -146,6 +147,8 @@ def dense_stream_topk(W, dense_blocks, *, k: int,
             s = jnp.where(cnt >= min_should_match, s, NEG_INF)
         # a matched doc always scores > 0 (impacts > 0, idf > 0)
         s = jnp.where(s > 0, s, NEG_INF)
+        n_matched = n_matched + jnp.sum((s > NEG_INF).astype(jnp.int32),
+                                        axis=1)
         v, i = lax.top_k(s, min(k, C))
         gi = (i + blk_idx * C).astype(jnp.int32)
         if v.shape[1] < k:
@@ -158,14 +161,15 @@ def dense_stream_topk(W, dense_blocks, *, k: int,
         # keeps doc-ascending tie order
         nv, sel = lax.top_k(cat_v, k)
         ni = jnp.take_along_axis(cat_i, sel, axis=1)
-        return (nv, ni), None
+        return (nv, ni, n_matched), None
 
     n_blk = dense_blocks.shape[0]
     init = (jnp.full((B, k), NEG_INF, jnp.float32),
-            jnp.zeros((B, k), jnp.int32))
-    (vals, docs), _ = lax.scan(
+            jnp.zeros((B, k), jnp.int32),
+            jnp.zeros((B,), jnp.int32))
+    (vals, docs, n_matched), _ = lax.scan(
         step, init, (jnp.arange(n_blk, dtype=jnp.int32), dense_blocks))
-    return vals, docs
+    return vals, docs, n_matched
 
 
 def gather_dense_for_candidates(dense_blocks, cand_docs, dense_rid, dense_w,
@@ -215,13 +219,17 @@ def merge_topk_lists(vals_a, docs_a, vals_b, docs_b, *, k: int,
 def tiered_bm25_topk(postings_docs, postings_impact, dense_blocks,
                      starts, lengths, idfw, dense_rid, dense_w, W,
                      *, n_pad: int, L: int, k: int,
-                     min_should_match: int = 1):
+                     min_should_match: int = 1, with_count: bool = False):
     """Full tiered scoring of a query batch against ONE shard partition.
 
     Shapes: starts/lengths i32[B, Q], idfw f32[B, Q], dense_rid i32[B, Qd],
     dense_w f32[B, Qd], W f32[B, T]. Returns (vals f32[B, k],
-    docs i32[B, k]).
-    """
+    docs i32[B, k]) — plus i32[B] exact match counts when ``with_count``
+    (total = sparse candidates + dense-matched − overlap, each tier counted
+    in its own full pass; requires min_should_match == 1, where a doc's
+    tier membership alone decides matching)."""
+    if with_count and min_should_match != 1:
+        raise ValueError("with_count requires min_should_match == 1")
 
     def per_query(st_q, ln_q, iw_q, rid_q, dw_q):
         sdocs, gscore, gcount, is_last = bm25_merge_candidates(
@@ -231,9 +239,8 @@ def tiered_bm25_topk(postings_docs, postings_impact, dense_blocks,
             dense_blocks, sdocs, rid_q, dw_q, n_pad=n_pad)
         gscore = gscore + add
         gcount = gcount + cnt
-        score = jnp.where(
-            is_last & (sdocs < n_pad) & (gcount >= min_should_match),
-            gscore, NEG_INF)
+        matched = is_last & (sdocs < n_pad) & (gcount >= min_should_match)
+        score = jnp.where(matched, gscore, NEG_INF)
         n = sdocs.shape[0]
         vals, sel = lax.top_k(score, min(k, n))
         out_docs = jnp.take(sdocs, sel, mode="clip")
@@ -241,11 +248,17 @@ def tiered_bm25_topk(postings_docs, postings_impact, dense_blocks,
         if n < k:
             vals = jnp.pad(vals, (0, k - n), constant_values=NEG_INF)
             out_docs = jnp.pad(out_docs, (0, k - n), constant_values=n_pad)
-        return vals, out_docs.astype(jnp.int32)
+        # candidates double-counted by the dense tier's own pass
+        overlap = jnp.sum((matched & (cnt > 0)).astype(jnp.int32))
+        return vals, out_docs.astype(jnp.int32), \
+            jnp.sum(matched.astype(jnp.int32)) - overlap
 
-    cand_vals, cand_docs = jax.vmap(per_query)(
+    cand_vals, cand_docs, cand_net = jax.vmap(per_query)(
         starts, lengths, idfw, dense_rid, dense_w)
-    dense_vals, dense_docs = dense_stream_topk(
+    dense_vals, dense_docs, dense_n = dense_stream_topk(
         W, dense_blocks, k=k, min_should_match=min_should_match)
-    return merge_topk_lists(cand_vals, cand_docs, dense_vals, dense_docs,
-                            k=k, n_pad=n_pad)
+    vals, docs = merge_topk_lists(cand_vals, cand_docs, dense_vals,
+                                  dense_docs, k=k, n_pad=n_pad)
+    if with_count:
+        return vals, docs, cand_net + dense_n
+    return vals, docs

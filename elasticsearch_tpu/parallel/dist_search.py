@@ -52,28 +52,36 @@ NEG_INF = float("-inf")
 # ---------------------------------------------------------------------------
 
 
-def _global_topk_reduce(vals, idx, *, s_loc: int, kk: int, n_pad: int):
+def _global_topk_reduce(vals, idx, *, s_loc: int, kk: int, n_pad: int,
+                        out_k: Optional[int] = None):
     """Shared ICI reduce: globalize local doc ids, merge the device's own
     shards, then all_gather + top_k over the shard axis. vals/idx are
-    [B_loc, S_loc, kk]; returns ([B_loc, kk], [B_loc, kk])."""
+    [B_loc, S_loc, kk]; returns ([B_loc, out_k], [B_loc, out_k]).
+
+    ``out_k`` (default ``kk``) is the GLOBAL result width: per-shard lists
+    cap at that shard's pad (kk ≤ n_pad) but the union across shards can
+    satisfy a larger k, so intermediate merges keep min(out_k, available)
+    candidates instead of collapsing to the per-shard cap."""
+    out_k = kk if out_k is None else out_k
     b_loc = vals.shape[0]
     shard0 = lax.axis_index(AXIS_SHARD) * s_loc
     sid = shard0 + jnp.arange(s_loc, dtype=jnp.int32)
     gidx = idx + sid[None, :, None] * n_pad
     vals = vals.reshape(b_loc, s_loc * kk)
     gidx = gidx.reshape(b_loc, s_loc * kk)
-    if s_loc > 1:
-        vals, sel = lax.top_k(vals, kk)
+    if s_loc > 1 and s_loc * kk > out_k:
+        vals, sel = lax.top_k(vals, out_k)
         gidx = jnp.take_along_axis(gidx, sel, axis=1)
     av_all = lax.all_gather(vals, AXIS_SHARD, axis=1, tiled=True)
     ai_all = lax.all_gather(gidx, AXIS_SHARD, axis=1, tiled=True)
-    gvals, gsel = lax.top_k(av_all, kk)
+    gvals, gsel = lax.top_k(av_all, min(out_k, av_all.shape[1]))
     gdocs = jnp.take_along_axis(ai_all, gsel, axis=1)
     return gvals, gdocs
 
 
 def build_bm25_topk_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
-                         n_shards: int, min_should_match: int = 1):
+                         n_shards: int, min_should_match: int = 1,
+                         with_count: bool = False):
     """Jitted distributed step: batched BM25 scoring + global top-k.
 
     Global input shapes (S = n_shards, B = query batch):
@@ -94,6 +102,7 @@ def build_bm25_topk_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
         raise ValueError(f"{n_shards} shards not divisible over {s_dev} devices")
     s_loc = n_shards // s_dev
     kk = min(k, n_pad)
+    out_k = min(k, n_shards * n_pad)
 
     def body(pd, pi, st, ln, idfw):
         assert st.shape[-1] == Q, (
@@ -105,30 +114,39 @@ def build_bm25_topk_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
                 # candidate postings, not the whole shard partition
                 return bm25_topk_merge_body(
                     pd_s, pi_s, st_q, ln_q, iw_q, n_pad=n_pad, L=L, k=kk,
-                    min_should_match=min_should_match)
+                    min_should_match=min_should_match,
+                    with_count=with_count)
 
             return jax.vmap(per_query)(st_s, ln_s, idfw)     # [B_loc, kk]
 
-        vals, idx = jax.vmap(per_shard, in_axes=(0, 0, 1, 1),
-                             out_axes=1)(pd, pi, st, ln)
+        out = jax.vmap(per_shard, in_axes=(0, 0, 1, 1),
+                       out_axes=1)(pd, pi, st, ln)
         # vals/idx: [B_loc, S_loc, kk]
-        return _global_topk_reduce(vals, idx, s_loc=s_loc, kk=kk, n_pad=n_pad)
+        gvals, gdocs = _global_topk_reduce(out[0], out[1], s_loc=s_loc,
+                                           kk=kk, n_pad=n_pad, out_k=out_k)
+        if with_count:
+            counts = lax.psum(jnp.sum(out[2], axis=1), AXIS_SHARD)
+            return gvals, gdocs, counts
+        return gvals, gdocs
 
     shard_corpus = P(AXIS_SHARD, None)
+    out_specs = (P(AXIS_REPLICA, None), P(AXIS_REPLICA, None)) \
+        + ((P(AXIS_REPLICA),) if with_count else ())
     step = shard_map(
         body, mesh=mesh,
         in_specs=(shard_corpus, shard_corpus,
                   P(AXIS_REPLICA, AXIS_SHARD, None),
                   P(AXIS_REPLICA, AXIS_SHARD, None),
                   P(AXIS_REPLICA, None)),
-        out_specs=(P(AXIS_REPLICA, None), P(AXIS_REPLICA, None)),
+        out_specs=out_specs,
         check_vma=False)
     return jax.jit(step)
 
 
 def build_tiered_bm25_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
                            T_pad: int, C: int, n_shards: int,
-                           min_should_match: int = 1):
+                           min_should_match: int = 1,
+                           with_count: bool = False):
     """Jitted distributed tiered step (``ops/tiered_bm25.py``): sparse
     sorted-merge + dense Zipf-head streaming matmul per shard, then the ICI
     all_gather/top_k reduce.
@@ -145,19 +163,28 @@ def build_tiered_bm25_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
         raise ValueError(f"{n_shards} shards not divisible over {s_dev} devices")
     s_loc = n_shards // s_dev
     kk = min(k, n_pad)
+    out_k = min(k, n_shards * n_pad)
 
     def body(pd, pi, dense, st, ln, idfw, rid, dw, W):
         def per_shard(pd_s, pi_s, dense_s, st_s, ln_s, rid_s, dw_s, W_s):
             return tiered_bm25_topk(
                 pd_s, pi_s, dense_s, st_s, ln_s, idfw, rid_s, dw_s, W_s,
-                n_pad=n_pad, L=L, k=kk, min_should_match=min_should_match)
+                n_pad=n_pad, L=L, k=kk, min_should_match=min_should_match,
+                with_count=with_count)
 
-        vals, idx = jax.vmap(per_shard,
-                             in_axes=(0, 0, 0, 1, 1, 1, 1, 1),
-                             out_axes=1)(pd, pi, dense, st, ln, rid, dw, W)
-        return _global_topk_reduce(vals, idx, s_loc=s_loc, kk=kk, n_pad=n_pad)
+        out = jax.vmap(per_shard,
+                       in_axes=(0, 0, 0, 1, 1, 1, 1, 1),
+                       out_axes=1)(pd, pi, dense, st, ln, rid, dw, W)
+        gvals, gdocs = _global_topk_reduce(out[0], out[1], s_loc=s_loc,
+                                           kk=kk, n_pad=n_pad, out_k=out_k)
+        if with_count:
+            counts = lax.psum(jnp.sum(out[2], axis=1), AXIS_SHARD)
+            return gvals, gdocs, counts
+        return gvals, gdocs
 
     shard_corpus = P(AXIS_SHARD, None)
+    out_specs = (P(AXIS_REPLICA, None), P(AXIS_REPLICA, None)) \
+        + ((P(AXIS_REPLICA),) if with_count else ())
     step = shard_map(
         body, mesh=mesh,
         in_specs=(shard_corpus, shard_corpus,
@@ -168,7 +195,7 @@ def build_tiered_bm25_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
                   P(AXIS_REPLICA, AXIS_SHARD, None),
                   P(AXIS_REPLICA, AXIS_SHARD, None),
                   P(AXIS_REPLICA, AXIS_SHARD, None)),
-        out_specs=(P(AXIS_REPLICA, None), P(AXIS_REPLICA, None)),
+        out_specs=out_specs,
         check_vma=False)
     return jax.jit(step)
 
@@ -190,6 +217,7 @@ def build_knn_step(mesh: Mesh, *, n_pad: int, dim: int, k: int,
         raise ValueError(f"{n_shards} shards not divisible over {s_dev} devices")
     s_loc = n_shards // s_dev
     kk = min(k, n_pad)
+    out_k = min(k, n_shards * n_pad)
     if similarity not in ("dot_product", "cosine", "l2_norm"):
         raise ValueError(f"unknown similarity [{similarity}]")
 
@@ -218,7 +246,8 @@ def build_knn_step(mesh: Mesh, *, n_pad: int, dim: int, k: int,
             return vals, idx.astype(jnp.int32)
 
         vals, idx = jax.vmap(per_shard, out_axes=1)(vecs, exists)
-        return _global_topk_reduce(vals, idx, s_loc=s_loc, kk=kk, n_pad=n_pad)
+        return _global_topk_reduce(vals, idx, s_loc=s_loc, kk=kk, n_pad=n_pad,
+                                   out_k=out_k)
 
     step = shard_map(
         body, mesh=mesh,
@@ -263,11 +292,19 @@ class DistributedSearchPlane:
         dense tier (default ``max(n_pad // 64, 4096)``) — see
         ``ops/tiered_bm25.py``. The sorted-merge L is then bounded by the
         largest *sparse* df instead of the corpus-wide max df.
+
+        A shard dict may carry an ``avgdl`` override: the serving path
+        (``search/plane_route.py``) feeds one SEGMENT per plane shard but
+        needs impacts normalized by the cross-segment shard-level avgdl
+        (Lucene computes avgdl at the IndexSearcher level) so plane scores
+        equal the per-segment path's bit-for-tie.
         """
         self.mesh = mesh
         self.field = field
         self.k1, self.b = k1, b
         self.n_shards = len(shards)
+        #: dispatches through a compiled step (tests assert the plane ran)
+        self.n_dispatches = 0
         if self.n_shards % mesh.shape[AXIS_SHARD]:
             raise ValueError("shard count must divide mesh shard axis")
 
@@ -283,8 +320,11 @@ class DistributedSearchPlane:
         impacts_full: List[np.ndarray] = []
         tiers: List[dict] = []
         for s in shards:
-            fdc = max(int((s["doc_len"] > 0).sum()), 1)
-            avgdl = max(float(s["doc_len"].sum()) / fdc, 1e-9)
+            if s.get("avgdl") is not None:
+                avgdl = max(float(s["avgdl"]), 1e-9)
+            else:
+                fdc = max(int((s["doc_len"] > 0).sum()), 1)
+                avgdl = max(float(s["doc_len"].sum()) / fdc, 1e-9)
             impacts_full.append(make_impacts(
                 s["tf"], s["docs"], s["doc_len"], avgdl, k1, b))
             tiers.append(split_tiers(
@@ -416,9 +456,11 @@ class DistributedSearchPlane:
 
     def search(self, queries: Sequence[Sequence[str]], k: int = 10,
                *, Q: Optional[int] = None, L: Optional[int] = None,
-               tiered: Optional[bool] = None):
+               tiered: Optional[bool] = None, with_totals: bool = False):
         """Run a batch of bag-of-terms queries. Returns
-        (scores f32[B, k], hits list[list[(shard, local_doc)]]).
+        (scores f32[B, k], hits list[list[(shard, local_doc)]]) — plus
+        exact per-query match counts (list[int], the device-side
+        TotalHitCountCollector) when ``with_totals``.
 
         ``tiered``: None (default) picks the tiered kernel iff the batch
         touches a dense-tier term; True forces the tiered kernel whenever a
@@ -457,8 +499,9 @@ class DistributedSearchPlane:
         if tiered is False and any_dense:
             raise ValueError("tiered=False but the batch hits dense-tier terms")
         if use_tiered:
-            step = self._get_step(Q, L, k, tiered=True)
-            vals, gdocs = step(
+            step = self._get_step(Q, L, k, tiered=True,
+                                  with_count=with_totals)
+            out = step(
                 self.docs_dev, self.impacts_dev, self.dense_dev,
                 jax.device_put(starts, repl3),
                 jax.device_put(lengths, repl3),
@@ -467,11 +510,13 @@ class DistributedSearchPlane:
                 jax.device_put(dense_w, repl3),
                 jax.device_put(W, repl3))
         else:
-            step = self._get_step(Q, L, k)
-            vals, gdocs = step(
+            step = self._get_step(Q, L, k, with_count=with_totals)
+            out = step(
                 self.docs_dev, self.impacts_dev,
                 jax.device_put(starts, repl3), jax.device_put(lengths, repl3),
                 jax.device_put(idfw, repl))
+        self.n_dispatches += 1
+        vals, gdocs = out[0], out[1]
         vals = np.asarray(vals)[:B]          # drop replica-padding slots
         gdocs = np.asarray(gdocs)[:B]
         hits = []
@@ -482,20 +527,24 @@ class DistributedSearchPlane:
                     break
                 row.append((int(g) // self.n_pad, int(g) % self.n_pad))
             hits.append(row)
+        if with_totals:
+            totals = [int(c) for c in np.asarray(out[2])[:B]]
+            return vals, hits, totals
         return vals, hits
 
-    def _get_step(self, Q: int, L: int, k: int, *, tiered: bool = False):
-        key = (Q, L, k, tiered)
+    def _get_step(self, Q: int, L: int, k: int, *, tiered: bool = False,
+                  with_count: bool = False):
+        key = (Q, L, k, tiered, with_count)
         fn = self._steps.get(key)
         if fn is None:
             if tiered:
                 fn = build_tiered_bm25_step(
                     self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
                     T_pad=self.T_pad, C=self.dense_block,
-                    n_shards=self.n_shards)
+                    n_shards=self.n_shards, with_count=with_count)
             else:
                 fn = build_bm25_topk_step(
                     self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
-                    n_shards=self.n_shards)
+                    n_shards=self.n_shards, with_count=with_count)
             self._steps[key] = fn
         return fn

@@ -213,16 +213,24 @@ class DistributedSearcher:
     """Coordinating-node search over one searcher per shard."""
 
     def __init__(self, shard_segment_lists: List[list],
-                 mapper: MapperService):
+                 mapper: MapperService, plane_provider=None):
         all_segments = [s for segs in shard_segment_lists for s in segs]
         self._global_ctx = ShardContext(all_segments, mapper)
         self.mapper = mapper
+        self.plane_provider = plane_provider
         self.shards: List[ShardSearcher] = []
-        for segs in shard_segment_lists:
+        # flattened-filtered segment index -> (shard, shard-local filtered
+        # segment): the pooled plane route returns hits in global-segment
+        # space and must rewrite cursors into the coordinator's
+        # (shard << _LOCAL_BITS | seg << 32 | doc) encoding
+        self._seg_owner: List[Tuple[int, int]] = []
+        for shard_idx, segs in enumerate(shard_segment_lists):
             searcher = ShardSearcher(segs, mapper)
             searcher.ctx = DfsShardContext(searcher.segments, mapper,
                                            self._global_ctx)
             self.shards.append(searcher)
+            for li in range(len(searcher.segments)):
+                self._seg_owner.append((shard_idx, li))
 
     # ------------------------------------------------------------------
 
@@ -238,6 +246,28 @@ class DistributedSearcher:
             pooled = ShardSearcher(self._global_ctx.segments, self.mapper)
             pooled.ctx = self._global_ctx
             return pooled.search(body)
+
+        # plane route: when the tiered TPU plane can serve this body, run
+        # POOLED over the flattened segment list — the plane is itself the
+        # multi-shard scatter-gather (shard-ascending tie order == the
+        # coordinator's merge order), so fanning out per index shard first
+        # would only re-partition work the device mesh already partitions
+        if self.plane_provider is not None and not collect_agg_inputs:
+            from .plane_route import body_eligible, extract_bag_of_terms
+            if body_eligible(body):
+                ext = extract_bag_of_terms(body.get("query"), self.mapper)
+                if ext is not None and self.plane_provider(
+                        self._global_ctx.segments, ext[0]) is not None:
+                    pooled = ShardSearcher(self._global_ctx.segments,
+                                           self.mapper,
+                                           plane_provider=self.plane_provider)
+                    pooled.ctx = self._global_ctx
+                    res = pooled.search(body)
+                    for h in res.hits:
+                        sh, li = self._seg_owner[h.seg_idx]
+                        h.sort_values = [h.score, self._global_shard_doc(
+                            sh, li, h.local_doc)]
+                    return res
 
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))

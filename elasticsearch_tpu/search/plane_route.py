@@ -1,0 +1,229 @@
+"""Serving route onto the tiered TPU search plane.
+
+The flagship distributed kernel (``parallel/dist_search.py``: tiered BM25 —
+dense Zipf-head streaming matmuls + sparse sorted-merge — with the ICI
+all_gather/top_k reduce) must serve PRODUCT traffic, not just the bench:
+the reference executes every eligible query through its one production
+scorer (``action/search/AbstractSearchAsyncAction.java:70`` →
+``search/internal/ContextIndexSearcher.java:210-224``). This module is the
+bridge from the REST/cluster search path into the plane:
+
+- :func:`extract_bag_of_terms` recognizes request bodies whose query
+  reduces to a weighted bag of terms over ONE text field — ``match``
+  (OR operator), ``term`` on a text field, and ``bool``/``dis_max``-free
+  pure-``should`` disjunctions of those — exactly the shapes whose scoring
+  model (sum of per-term BM25 over shard-level stats) the plane computes.
+- :class:`ServingPlaneCache` owns one :class:`DistributedSearchPlane` per
+  (shard, field), built lazily from the live segment list (one SEGMENT per
+  plane shard, so the plane's shard-ascending tie order equals the
+  per-segment path's (segment, doc) order) and invalidated on refresh /
+  merge / delete. Segments with deletes or nested docs disable the route
+  (plane postings would score hidden/dead docs).
+
+Score parity with ``query_dsl._score_text_terms``: idf uses the identical
+``idf_weight`` over summed dfs and total docs; impacts are normalized by
+the cross-segment shard avgdl (``avgdl`` override); the exact per-query
+match counts come back from the same dispatch (``with_totals``), so
+``track_total_hits`` needs no second pass.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..index.mapping import MapperService, TextFieldType
+from ..index.segment import Segment
+
+#: plane construction is O(postings); don't bother below this many docs
+#: unless a test forces it (ENV knob in ServingPlaneCache)
+_MIN_DOCS_DEFAULT = 0
+
+
+def _match_terms(field: str, spec, mapper: MapperService) \
+        -> Optional[Tuple[str, List[str]]]:
+    """One match clause → (concrete text field, analyzed terms)."""
+    if isinstance(spec, dict):
+        if set(spec) - {"query", "operator", "boost",
+                        "minimum_should_match"}:
+            return None
+        if str(spec.get("operator", "or")).lower() != "or":
+            return None
+        if spec.get("boost", 1.0) != 1.0:
+            return None
+        msm = spec.get("minimum_should_match")
+        if msm is not None and msm != 1:
+            return None
+        text = spec.get("query")
+    else:
+        text = spec
+    if text is None or isinstance(text, (dict, list)):
+        return None
+    ft = mapper.field_type(field)
+    if not isinstance(ft, TextFieldType):
+        return None
+    terms = ft.search_analyzer.terms(str(text))
+    return (ft.name, terms) if terms else None
+
+
+def _term_terms(field: str, spec, mapper: MapperService) \
+        -> Optional[Tuple[str, List[str]]]:
+    """One term clause on a TEXT field → single unanalyzed term."""
+    if isinstance(spec, dict):
+        if set(spec) - {"value", "boost"}:
+            return None
+        if spec.get("boost", 1.0) != 1.0:
+            return None
+        value = spec.get("value")
+    else:
+        value = spec
+    if value is None or isinstance(value, (dict, list)):
+        return None
+    ft = mapper.field_type(field)
+    if not isinstance(ft, TextFieldType):
+        return None
+    return ft.name, [str(value)]
+
+
+def extract_bag_of_terms(query_spec, mapper: MapperService) \
+        -> Optional[Tuple[str, List[str]]]:
+    """Request query → (field, bag of terms with duplicates) when the query
+    is plane-eligible, else None. Duplicate terms encode weight (the plane
+    counts repeats into idfw, matching the per-segment path's weights)."""
+    if not isinstance(query_spec, dict) or len(query_spec) != 1:
+        return None
+    (kind, body), = query_spec.items()
+    if kind == "match":
+        if not isinstance(body, dict) or len(body) != 1:
+            return None
+        (field, spec), = body.items()
+        return _match_terms(field, spec, mapper)
+    if kind == "term":
+        if not isinstance(body, dict) or len(body) != 1:
+            return None
+        (field, spec), = body.items()
+        return _term_terms(field, spec, mapper)
+    if kind == "bool":
+        if not isinstance(body, dict):
+            return None
+        if set(body) - {"should", "minimum_should_match", "boost"}:
+            return None           # must/filter/must_not change semantics
+        if body.get("boost", 1.0) != 1.0:
+            return None
+        msm = body.get("minimum_should_match")
+        if msm is not None and msm != 1:
+            return None
+        should = body.get("should")
+        if isinstance(should, dict):
+            should = [should]
+        if not should:
+            return None
+        field = None
+        terms: List[str] = []
+        for clause in should:
+            sub = extract_bag_of_terms(clause, mapper)
+            if sub is None:
+                return None
+            f, ts = sub
+            if field is None:
+                field = f
+            elif field != f:
+                return None       # cross-field disjunction: scores differ
+            terms.extend(ts)
+        return (field, terms) if field is not None and terms else None
+    return None
+
+
+#: request-body features the plane cannot serve (need per-doc masks or
+#: post-hoc reordering); shared by the single-shard and pooled dist routes
+_PLANE_INCOMPATIBLE = ("aggs", "aggregations", "sort", "knn", "rescore",
+                       "collapse", "suggest", "search_after", "min_score",
+                       "profile", "rank")
+
+
+def body_eligible(body: dict) -> bool:
+    """True when the request body's FEATURE set allows the plane route
+    (the query shape itself is judged by :func:`extract_bag_of_terms`)."""
+    if any(body.get(k) for k in _PLANE_INCOMPATIBLE):
+        return False
+    return int(body.get("size", 10)) + int(body.get("from", 0)) > 0
+
+
+class ServingPlaneCache:
+    """Per-(shard, field) plane registry for the product search path."""
+
+    def __init__(self, mesh_factory=None, min_docs: int = _MIN_DOCS_DEFAULT):
+        self._mesh_factory = mesh_factory
+        self._mesh = None
+        self._planes: Dict[str, Tuple[tuple, object]] = {}
+        self.min_docs = min_docs
+
+    def _get_mesh(self):
+        if self._mesh is None:
+            if self._mesh_factory is not None:
+                self._mesh = self._mesh_factory()
+            else:
+                # serving default: the local device. Multi-chip serving uses
+                # a factory wired by the node (mesh over its chips).
+                import jax
+                from .. import parallel as par
+                self._mesh = par.make_search_mesh(
+                    n_shards=1, n_replicas=1, devices=jax.devices()[:1])
+        return self._mesh
+
+    @staticmethod
+    def _signature(segments: Sequence[Segment], field: str) -> Optional[tuple]:
+        """Cache key over the segment list; None → route ineligible."""
+        sig = []
+        any_field = False
+        for s in segments:
+            if s.has_nested or not bool(s.live.all()):
+                return None
+            if field in s.text_fields:
+                any_field = True
+            sig.append((s.seg_id, s.n_docs))
+        return tuple(sig) if any_field else None
+
+    def plane_for(self, segments: Sequence[Segment], mapper: MapperService,
+                  field: str):
+        """The serving plane for this segment list, or None when the route
+        is ineligible (deletes, nested docs, absent field)."""
+        segments = [s for s in segments if s.n_docs > 0]
+        if not segments:
+            return None
+        if sum(s.n_docs for s in segments) < self.min_docs:
+            return None
+        sig = self._signature(segments, field)
+        if sig is None:
+            return None
+        cached = self._planes.get(field)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        from ..parallel.dist_search import DistributedSearchPlane
+        # shard-level (cross-segment) avgdl, same as ShardContext.field_avgdl
+        sum_dl = 0.0
+        doc_count = 0
+        for s in segments:
+            sdl, dc = s.field_stats(field)
+            sum_dl += sdl
+            doc_count += dc
+        avgdl = sum_dl / doc_count if doc_count else 1.0
+        shards = []
+        for seg in segments:
+            f = seg.text_fields.get(field)
+            if f is None:
+                n = seg.n_docs
+                shards.append(dict(
+                    term_ids={}, df=np.zeros(0, np.int32),
+                    offsets=np.zeros(1, np.int64),
+                    docs=np.zeros(0, np.int32), tf=np.zeros(0, np.float32),
+                    doc_len=np.zeros(n, np.float32), avgdl=avgdl))
+            else:
+                shards.append(dict(
+                    term_ids=f.term_ids, df=f.df, offsets=f.offsets,
+                    docs=f.docs_host, tf=f.tf_host,
+                    doc_len=f.doc_len_host, avgdl=avgdl))
+        plane = DistributedSearchPlane(self._get_mesh(), shards, field)
+        self._planes[field] = (sig, plane)
+        return plane

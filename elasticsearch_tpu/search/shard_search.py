@@ -91,12 +91,20 @@ def _knn_score_transform(similarity: str, sim):
 
 
 class ShardSearcher:
-    """Executes one search request against one shard's segment list."""
+    """Executes one search request against one shard's segment list.
 
-    def __init__(self, segments: List[Segment], mapper: MapperService):
+    ``plane_provider``: optional ``field -> DistributedSearchPlane | None``
+    hook (``plane_route.ServingPlaneCache``). When set, eligible bag-of-
+    terms queries execute through the tiered TPU plane — the production
+    scorer — instead of the per-segment eager path; everything else
+    (fetch, error shapes, pagination) is shared."""
+
+    def __init__(self, segments: List[Segment], mapper: MapperService,
+                 plane_provider=None):
         self.segments = [s for s in segments if s.n_docs > 0]
         self.mapper = mapper
         self.ctx = ShardContext(self.segments, mapper)
+        self.plane_provider = plane_provider
 
     # ------------------------------------------------------------------
     # knn
@@ -281,69 +289,96 @@ class ShardSearcher:
             # matches; here the per-segment top-k window opens fully)
             window = 1 << 30
 
+        # --- plane route (the production TPU kernel) ----------------------
+        # Eligible bag-of-terms queries run through the tiered distributed
+        # plane: one dispatch returns top-k AND exact totals. Features that
+        # need per-doc masks (aggs, field sort) or reordering (rescore,
+        # collapse, search_after cursors) stay on the per-segment path.
+        plane_route = None
+        if (self.plane_provider is not None and query_spec
+                and knn_override is None and window > 0
+                and min_score is None and search_after is None):
+            from .plane_route import body_eligible, extract_bag_of_terms
+            # body_eligible re-checks body-carried features; the kwargs
+            # variants (min_score/search_after above) are checked directly
+            if body_eligible(body):
+                ext = extract_bag_of_terms(query_spec, self.mapper)
+                if ext is not None:
+                    plane = self.plane_provider(self.segments, ext[0])
+                    if plane is not None:
+                        plane_route = (plane, ext[1])
+
         # --- query phase (device) -----------------------------------------
         pending = []
         agg_pending = []
         host_masks: Dict[int, np.ndarray] = {}
         host_scores: Dict[int, np.ndarray] = {}
         need_host_mask = use_field_sort
-        for seg_idx, seg in enumerate(self.segments):
-            scores, mask = query.execute(self.ctx, seg)
-            mask = mask & seg.live_dev
-            if seg.has_nested:
-                # hidden block-join children never surface at top level
-                mask = mask & seg.parent_mask_dev
-            if min_score is not None:
-                mask = mask & (scores >= np.float32(min_score))
-            count_dev = jnp.sum(mask) if track_total_hits is not False else None
-            vals_dev = idx_dev = None
-            # the sort path needs the query top-k only to combine with knn
-            if window > 0 and (not use_field_sort or knn_spec):
-                # push the search_after cursor into the selection mask so
-                # the per-segment top-k window starts AFTER the cursor —
-                # otherwise docs tied on score beyond the global top-k are
-                # unreachable on later pages (totals/aggs keep the full mask)
-                sel_mask = mask
-                if search_after is not None and not use_field_sort \
-                        and not knn_spec:
-                    a_sc = jnp.float32(float(search_after[0]))
-                    if len(search_after) > 1:
-                        asd = int(search_after[1])
-                        a_si, a_d = asd >> 32, asd & 0xFFFFFFFF
-                        if seg_idx < a_si:
-                            cond = scores < a_sc
-                        elif seg_idx == a_si:
-                            cond = (scores < a_sc) | (
-                                (scores == a_sc) &
-                                (jnp.arange(seg.n_pad) > a_d))
+        if plane_route is not None:
+            plane, bag_terms = plane_route
+            pvals, phits, ptotals = plane.search(
+                [bag_terms], k=max(window, 1), with_totals=True)
+            total = int(ptotals[0])
+            candidates = [(float(v), si, d)
+                          for v, (si, d) in zip(pvals[0], phits[0])]
+        else:
+            for seg_idx, seg in enumerate(self.segments):
+                scores, mask = query.execute(self.ctx, seg)
+                mask = mask & seg.live_dev
+                if seg.has_nested:
+                    # hidden block-join children never surface at top level
+                    mask = mask & seg.parent_mask_dev
+                if min_score is not None:
+                    mask = mask & (scores >= np.float32(min_score))
+                count_dev = jnp.sum(mask) if track_total_hits is not False else None
+                vals_dev = idx_dev = None
+                # the sort path needs the query top-k only to combine with knn
+                if window > 0 and (not use_field_sort or knn_spec):
+                    # push the search_after cursor into the selection mask so
+                    # the per-segment top-k window starts AFTER the cursor —
+                    # otherwise docs tied on score beyond the global top-k are
+                    # unreachable on later pages (totals/aggs keep the full mask)
+                    sel_mask = mask
+                    if search_after is not None and not use_field_sort \
+                            and not knn_spec:
+                        a_sc = jnp.float32(float(search_after[0]))
+                        if len(search_after) > 1:
+                            asd = int(search_after[1])
+                            a_si, a_d = asd >> 32, asd & 0xFFFFFFFF
+                            if seg_idx < a_si:
+                                cond = scores < a_sc
+                            elif seg_idx == a_si:
+                                cond = (scores < a_sc) | (
+                                    (scores == a_sc) &
+                                    (jnp.arange(seg.n_pad) > a_d))
+                            else:
+                                cond = scores <= a_sc
                         else:
-                            cond = scores <= a_sc
-                    else:
-                        cond = scores < a_sc
-                    sel_mask = mask & cond
-                kk = min(max(window, 1), seg.n_pad)
-                topk = get_topk_kernel(seg.n_pad, kk)
-                vals_dev, idx_dev = topk(scores, sel_mask)
-            pending.append((seg_idx, count_dev, vals_dev, idx_dev))
-            if aggs is not None:
-                agg_pending.append((seg, mask, scores))
-            if need_host_mask:
-                host_masks[seg_idx] = np.asarray(mask)
-                if not use_field_sort or _sort_includes_score(sort_spec):
-                    host_scores[seg_idx] = np.asarray(scores)
+                            cond = scores < a_sc
+                        sel_mask = mask & cond
+                    kk = min(max(window, 1), seg.n_pad)
+                    topk = get_topk_kernel(seg.n_pad, kk)
+                    vals_dev, idx_dev = topk(scores, sel_mask)
+                pending.append((seg_idx, count_dev, vals_dev, idx_dev))
+                if aggs is not None:
+                    agg_pending.append((seg, mask, scores))
+                if need_host_mask:
+                    host_masks[seg_idx] = np.asarray(mask)
+                    if not use_field_sort or _sort_includes_score(sort_spec):
+                        host_scores[seg_idx] = np.asarray(scores)
 
-        total = 0
-        candidates: List[Tuple[float, int, int]] = []
-        for seg_idx, count_dev, vals_dev, idx_dev in pending:
-            if count_dev is not None:
-                total += int(count_dev)
-            if vals_dev is not None:
-                vals = np.asarray(vals_dev)
-                idx = np.asarray(idx_dev)
-                ok = vals > float("-inf")
-                for v, d in zip(vals[ok], idx[ok]):
-                    candidates.append((float(v), seg_idx, int(d)))
-        candidates.sort(key=lambda c: (-c[0], c[1], c[2]))
+            total = 0
+            candidates: List[Tuple[float, int, int]] = []
+            for seg_idx, count_dev, vals_dev, idx_dev in pending:
+                if count_dev is not None:
+                    total += int(count_dev)
+                if vals_dev is not None:
+                    vals = np.asarray(vals_dev)
+                    idx = np.asarray(idx_dev)
+                    ok = vals > float("-inf")
+                    for v, d in zip(vals[ok], idx[ok]):
+                        candidates.append((float(v), seg_idx, int(d)))
+            candidates.sort(key=lambda c: (-c[0], c[1], c[2]))
 
         # --- knn section ---------------------------------------------------
         knn_rankings: List[List[Tuple[float, int, int]]] = []

@@ -308,3 +308,26 @@ def test_tiered_sparse_bound_decoupled_from_head_df():
     from elasticsearch_tpu.utils.shapes import round_up_pow2
     assert plane.max_sparse_df <= 2
     assert plane.L_cap == round_up_pow2(plane.max_sparse_df)
+
+
+@pytest.mark.parametrize("dense_threshold", [None, 2])
+def test_plane_with_totals_exact(dense_threshold):
+    """Exact per-query match counts from the same dispatch, on both the
+    sparse-only and (dense_threshold=2 forces head terms dense) tiered
+    kernels — the device-side TotalHitCountCollector."""
+    mapper, segs = _build_shards(4)
+    mesh = make_search_mesh(n_shards=4, n_replicas=1,
+                            devices=jax.devices()[:4])
+    kw = {} if dense_threshold is None else {
+        "dense_threshold": dense_threshold}
+    plane = DistributedSearchPlane.from_segments(mesh, segs, "body", **kw)
+    queries = [["quick", "dog"], ["the"], ["fox", "fox", "river"],
+               ["absent"], ["the", "quick", "brown", "fox"]]
+    vals, hits, totals = plane.search(queries, k=5, with_totals=True)
+    tokens = [d.split() for d in DOCS]
+    for q, t in zip(queries, totals):
+        expect = sum(1 for toks in tokens if any(term in toks
+                                                 for term in set(q)))
+        assert t == expect, (q, t, expect)
+    if dense_threshold is not None:
+        assert plane.T_pad > 0          # the dense tier actually engaged
