@@ -141,6 +141,14 @@ class ClusterNode:
         # synchronous RPCs — the loop stays free to deliver the responses
         self._data_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"{node_id}-data")
+        # full REST stack (node/cluster_rest.py): local IndicesService +
+        # RestAPI + cluster dispatch; metadata replicates via the op log
+        from .cluster_rest import ClusterHooks, ClusterRestService
+        self.rest = ClusterRestService(self,
+                                       os.path.join(data_path, "local"))
+        self._hooks = ClusterHooks(self.rest)
+        self.http = None
+        self._http_pool: Optional[ThreadPoolExecutor] = None
         self._register_handlers()
         self.node_loop.call(self.transport.start())
         self.coordinator = self.node_loop.sync(lambda: Coordinator(
@@ -159,6 +167,11 @@ class ClusterNode:
         self.stopped = True
         self.node_loop.sync(self.coordinator.stop)
         try:
+            if self.http is not None:
+                self.node_loop.call(self.http.stop())
+        except Exception:   # noqa: BLE001
+            pass
+        try:
             self.node_loop.call(self.transport.stop())
         except Exception:   # noqa: BLE001
             pass
@@ -166,11 +179,43 @@ class ClusterNode:
         # _apply_state/_recover_replica must not touch a closed engine or
         # mutate the shard maps mid-iteration
         self._data_pool.shutdown(wait=True, cancel_futures=True)
+        if self._http_pool is not None:
+            self._http_pool.shutdown(wait=False, cancel_futures=True)
+        closed = set()
         for g in self.primaries.values():
             g.engine.close()
+            closed.add(id(g.engine))
         for r in self.replicas.values():
             r.engine.close()
+            closed.add(id(r.engine))
+        # local-service engines not wrapped by any group (unassigned copies)
+        for svc in self.rest.indices.indices.values():
+            for e in svc.shards:
+                if id(e) not in closed:
+                    try:
+                        e.close()
+                    except Exception:   # noqa: BLE001
+                        pass
         self.node_loop.stop()
+
+    def start_http(self, port: int, host: str = "127.0.0.1") -> None:
+        """Serve the full REST API over HTTP from this node (reference:
+        every node binds 9200 — ``http/AbstractHttpServerTransport.java``).
+        Requests execute on a small pool so blocking RPC fan-outs never
+        stall the transport loop."""
+        import asyncio
+        from ..rest.http_server import HttpServer
+        self._http_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix=f"{self.node_id}-http")
+
+        async def handler(method, path, query, body):
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                self._http_pool, self.rest.handle, method, path, query,
+                body)
+
+        self.http = HttpServer(handler, host=host, port=port)
+        self.node_loop.call(self.http.start())
 
     def rpc(self, dst: str, action: str, payload, timeout: float = 2.0):
         """Synchronous RPC from any thread (test/client surface)."""
@@ -201,16 +246,23 @@ class ClusterNode:
     def create_index(self, name: str, *, num_shards: int = 1,
                      num_replicas: int = 0, mappings: Optional[dict] = None,
                      timeout: float = 5.0) -> None:
-        self._master_call("admin:create_index", {
-            "name": name, "num_shards": num_shards,
-            "num_replicas": num_replicas, "mappings": mappings or {}},
-            timeout=timeout)
+        import json as _json
+        body = _json.dumps({
+            "settings": {"number_of_shards": num_shards,
+                         "number_of_replicas": num_replicas},
+            "mappings": mappings or {}}).encode()
+        status, _ct, out = self.rest._meta_op("PUT", f"/{name}", "", body)
+        if status >= 400:
+            raise ElasticsearchError(
+                f"create index [{name}] failed: {out[:200]!r}")
         self._await_applied(lambda st: name in st.metadata["indices"],
                             timeout)
 
     def delete_index(self, name: str, timeout: float = 5.0) -> None:
-        self._master_call("admin:delete_index", {"name": name},
-                          timeout=timeout)
+        status, _ct, out = self.rest._meta_op("DELETE", f"/{name}", "", b"")
+        if status >= 400:
+            raise ElasticsearchError(
+                f"delete index [{name}] failed: {out[:200]!r}")
         self._await_applied(lambda st: name not in st.metadata["indices"],
                             timeout)
 
@@ -242,48 +294,6 @@ class ClusterNode:
             time.sleep(0.02)
         raise TimeoutError("cluster state change was not applied in time")
 
-    # master-side handlers ---------------------------------------------------
-
-    def _h_create_index(self, src, payload):
-        name = payload["name"]
-        num_shards = int(payload["num_shards"])
-        num_replicas = int(payload["num_replicas"])
-        mappings = payload.get("mappings") or {}
-
-        def update(state: ClusterState) -> ClusterState:
-            if name in state.metadata["indices"]:
-                raise ElasticsearchError(f"index [{name}] already exists")
-            new = state.updated()
-            live = sorted(new.nodes)
-            new.metadata["indices"][name] = {
-                "num_shards": num_shards, "num_replicas": num_replicas,
-                "mappings": mappings, "primary_term": 1}
-            routing = {}
-            for s in range(num_shards):
-                owner = live[s % len(live)]
-                reps = [live[(s + 1 + r) % len(live)]
-                        for r in range(min(num_replicas, len(live) - 1))]
-                routing[str(s)] = {"primary": owner, "replicas": reps}
-            new.data["routing"][name] = routing
-            return new
-
-        self._submit_and_wait(update)
-        return {"acknowledged": True}
-
-    def _h_delete_index(self, src, payload):
-        name = payload["name"]
-
-        def update(state: ClusterState) -> ClusterState:
-            if name not in state.metadata["indices"]:
-                raise IndexNotFoundError(name)
-            new = state.updated()
-            del new.metadata["indices"][name]
-            new.data["routing"].pop(name, None)
-            return new
-
-        self._submit_and_wait(update)
-        return {"acknowledged": True}
-
     def _submit_and_wait(self, update, timeout: float = 5.0):
         done = threading.Event()
         box: Dict[str, Any] = {}
@@ -313,25 +323,35 @@ class ClusterNode:
         self._data_pool.submit(self._apply_state, state)
 
     def _apply_state(self, state: ClusterState) -> None:
+        # 1. replay metadata ops into the local service (creates/deletes
+        #    local IndexServices, mappings, aliases, templates, ...)
+        self.rest.apply_ops(state)
+        for svc in self.rest.indices.indices.values():
+            if svc.cluster_hooks is None:
+                svc.cluster_hooks = self._hooks
         indices = state.metadata["indices"]
         routing = state.data.get("routing", {})
-        # close shards for deleted indices
+        # 2. drop groups for deleted indices (engines are owned and closed
+        #    by the local service's delete path)
         for (name, sid) in list(self.primaries):
             if name not in indices:
-                self.primaries.pop((name, sid)).engine.close()
+                self.primaries.pop((name, sid))
         for (name, sid) in list(self.replicas):
             if name not in indices:
-                self.replicas.pop((name, sid)).engine.close()
-        # open/adjust shards per routing
+                self.replicas.pop((name, sid))
+        # 3. wire replication groups around the local service's engines
         for name, meta in indices.items():
-            mapper = self.mappers.get(name)
-            if mapper is None:
-                mapper = self.mappers[name] = MapperService(
-                    meta.get("mappings") or {})
+            svc = self.rest.indices.indices.get(name)
+            if svc is None:
+                continue                 # op replay failed/lagging
+            self.mappers[name] = svc.mapper
             table = routing.get(name, {})
             for sid_s, entry in table.items():
                 sid = int(sid_s)
+                if sid >= len(svc.shards):
+                    continue
                 key = (name, sid)
+                engine = svc.shards[sid]
                 term = int(meta.get("primary_term", 1))
                 if entry["primary"] == self.node_id:
                     if key in self.primaries:
@@ -344,9 +364,9 @@ class ClusterNode:
                         self.primaries[key] = group
                         self._sync_replica_channels(key, entry, term)
                     else:
+                        engine.primary_term = max(engine.primary_term, term)
                         group = PrimaryShardGroup(
-                            f"{self.node_id}/{name}/{sid}",
-                            self._new_engine(name, sid, mapper, term))
+                            f"{self.node_id}/{name}/{sid}", engine)
                         self.primaries[key] = group
                         self._sync_replica_channels(key, entry, term)
                 elif self.node_id in entry["replicas"]:
@@ -356,21 +376,15 @@ class ClusterNode:
                         self.replicas[key] = ReplicaShard(
                             f"{self.node_id}/{name}/{sid}", g.engine)
                     elif key not in self.replicas:
+                        engine.primary_term = max(engine.primary_term, term)
                         self.replicas[key] = ReplicaShard(
-                            f"{self.node_id}/{name}/{sid}",
-                            self._new_engine(name, sid, mapper, term))
+                            f"{self.node_id}/{name}/{sid}", engine)
                 else:
-                    # copy moved away from this node
-                    if key in self.primaries:
-                        self.primaries.pop(key).engine.close()
-                    if key in self.replicas:
-                        self.replicas.pop(key).engine.close()
-
-    def _new_engine(self, name: str, sid: int, mapper: MapperService,
-                    term: int) -> Engine:
-        path = os.path.join(self.data_path, name, str(sid))
-        os.makedirs(path, exist_ok=True)
-        return Engine(path, mapper, primary_term=term)
+                    # copy moved away from this node: drop the wrappers
+                    # (the local service keeps its engine; reads route
+                    # through the cluster hooks, so stale data is inert)
+                    self.primaries.pop(key, None)
+                    self.replicas.pop(key, None)
 
     def _sync_replica_channels(self, key, entry, term) -> None:
         """Attach RPC channels for this primary's replica set and trigger
@@ -714,10 +728,15 @@ class ClusterNode:
                 handler, src, payload)
 
         t.register(nid, "ping", lambda s, p: {"ok": True})
-        t.register(nid, "admin:create_index",
-                   on_worker(self._h_create_index))
-        t.register(nid, "admin:delete_index",
-                   on_worker(self._h_delete_index))
+        t.register(nid, "meta:op", on_worker(self.rest.h_meta_op))
+        t.register(nid, "meta:history",
+                   on_worker(self.rest.h_meta_history))
+        t.register(nid, "rest:exec", on_worker(self.rest.h_rest_exec))
+        t.register(nid, "doc2:index", on_worker(self.rest.h_doc2_index))
+        t.register(nid, "doc2:delete", on_worker(self.rest.h_doc2_delete))
+        t.register(nid, "doc2:get", on_worker(self.rest.h_doc2_get))
+        t.register(nid, "doc2:visible",
+                   on_worker(self._hooks.h_doc2_visible))
         t.register(nid, "doc:index", on_worker(self._h_doc_index))
         t.register(nid, "doc:get", on_worker(self._h_doc_get))
         t.register(nid, "doc:delete", on_worker(self._h_doc_delete))
@@ -777,6 +796,12 @@ class ClusterNode:
 
     def _h_refresh(self, src, payload):
         name = payload["index"]
+        svc = self.rest.indices.indices.get(name)
+        if svc is not None:
+            # group wiring is async: refresh the local service's engines
+            # directly so just-written not-yet-wrapped copies are covered
+            for e in svc.shards:
+                e.refresh()
         for (iname, sid), g in self.primaries.items():
             if iname == name:
                 g.engine.refresh()
@@ -843,7 +868,9 @@ class ClusterNode:
         want_partials = payload.get("want_agg_partials")
         r = dist.search(dict(body), collect_agg_inputs=want_partials)
         hits = [{"id": h.doc_id, "score": h.score, "sort": h.sort_values,
-                 "source": h.source, "fields": h.fields} for h in r.hits]
+                 "source": h.source, "fields": h.fields,
+                 "highlight": h.highlight, "seq_no": h.seq_no,
+                 "ignored": h.ignored} for h in r.hits]
         out = {"total": r.total, "hits": hits}
         if r.suggest is not None:
             out["suggest"] = r.suggest

@@ -1,0 +1,848 @@
+"""REST front for the multi-node cluster: every node serves the full API.
+
+Reference parity target: every node hosts HTTP
+(``http/AbstractHttpServerTransport.java:68``) and dispatches into the
+distributed action layer (``rest/RestController.java:196``); metadata
+mutations are master actions whose results replicate in cluster state,
+document ops route to the owning shard, searches scatter-gather.
+
+TPU-era re-design (NOT a port of the action-per-API class hierarchy):
+
+- **Metadata surface = replicated state machine.** Each node hosts a full
+  local :class:`IndicesService`/:class:`RestAPI`. A metadata mutation
+  (index create/delete, mappings, settings, aliases, templates, ingest
+  pipelines, stored scripts…) forwards the RAW REST request to the elected
+  master, which executes it against ITS local service (full validation of
+  the whole existing surface, for free) and, on success, appends the
+  request to an op log in cluster state. Every node applies the log in
+  order to its own local service — deterministic replay ≙ the reference's
+  ``MasterService.submitStateUpdateTask`` + state publication, but generic
+  over the entire metadata API instead of one action class per op.
+- **Document ops** never special-case the REST layer: the local service's
+  ``cluster_hooks`` seam routes each (index, shard) write/read through the
+  node's replication group when locally primaried, or over the transport
+  to the owner. Bulk/mget/update all inherit this by construction.
+- **Search** routes through the same seam: an index whose shards are all
+  locally primaried searches local engines (and the tiered TPU plane);
+  anything else scatter-gathers over the cluster with cluster-wide DFS
+  stats (``ClusterNode.search``).
+- **Whole-request forwarding** covers stateful/segment-bound reads
+  (scroll, explain, termvectors, validate, field_caps…): when one node
+  primaries every shard of the referenced indices, the raw request
+  executes there with full single-node fidelity.
+
+Known gaps (documented, not hidden): segment-bound reads on indices spread
+across nodes fall back to local best-effort; snapshots are node-local; the
+op log keeps a bounded tail in state (nodes that fall further behind fetch
+history from the master over RPC).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common import errors as _errors
+from ..index.engine import DeleteResult, GetResult, IndexResult
+from ..search.shard_search import ShardHit, ShardSearchResult
+from ..transport.tcp import RemoteTransportError
+from .indices_service import IndicesService
+
+#: op-log tail length carried in cluster state; older history is fetched
+#: from the master over RPC (meta:history)
+OP_TAIL = 128
+
+_META_SUFFIXES = {
+    "_mapping", "_mappings", "_settings", "_alias", "_aliases",
+    "_open", "_close", "_rollover", "_shrink", "_split", "_clone",
+    "_block", "_freeze", "_unfreeze",
+}
+_META_ROOTS = ("/_aliases", "/_template", "/_index_template",
+               "/_component_template", "/_ingest/pipeline", "/_scripts")
+#: segment-bound reads that forward wholesale to a single-owner node
+_FORWARD_SUFFIXES = {"_explain", "_termvectors", "_mtermvectors",
+                     "_validate", "_field_caps", "_delete_by_query",
+                     "_update_by_query"}
+#: _refresh is NOT here: IndexService.refresh's cluster hook already
+#: reaches every copy; broadcasting it too would fan out O(N^2)
+_BROADCAST_SUFFIXES = {"_flush", "_forcemerge"}
+#: doc-write routes that may auto-create their target index via master
+_DOC_WRITE_SUFFIXES = {"_doc", "_create", "_update", "_bulk"}
+
+
+def _b64(raw: bytes) -> str:
+    return base64.b64encode(raw or b"").decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s or "")
+
+
+def _remote_error(e: RemoteTransportError) -> Exception:
+    """Map a remote exception back to its ES error class by name so the
+    REST layer renders the same status/type it would for a local failure."""
+    cls = getattr(_errors, e.remote_type or "", None)
+    if isinstance(cls, type) and issubclass(cls, Exception):
+        try:
+            return cls(str(e))
+        except Exception:   # noqa: BLE001 — ctor signature mismatch
+            pass
+    return _errors.ElasticsearchError(str(e))
+
+
+class LocalGroupWriter:
+    """Doc ops for a locally-primaried shard: through the replication
+    group (seq-no fan-out, fencing) — the same engine the local service
+    owns."""
+
+    def __init__(self, group):
+        self.group = group
+
+    def index(self, doc_id, source, *, routing=None, op_type="index",
+              if_seq_no=None, if_primary_term=None):
+        return self.group.index(
+            doc_id, source, routing=routing, op_type=op_type,
+            if_seq_no=if_seq_no, if_primary_term=if_primary_term).result
+
+    def delete(self, doc_id, *, if_seq_no=None, if_primary_term=None):
+        return self.group.delete(
+            doc_id, if_seq_no=if_seq_no,
+            if_primary_term=if_primary_term).result
+
+    def get(self, doc_id):
+        return self.group.engine.get(doc_id)
+
+
+class RemoteShardProxy:
+    """Doc ops for a shard primaried on another node (the routing phase of
+    ``TransportReplicationAction``): RPC to the owner, rebuild the engine
+    result dataclass from the wire dict."""
+
+    def __init__(self, node, owner: str, index: str, shard: int):
+        self.node = node
+        self.owner = owner
+        self.index_name = index
+        self.shard = shard
+
+    def _call(self, action: str, payload: dict) -> dict:
+        payload = dict(payload, index=self.index_name, shard=self.shard)
+        try:
+            return self.node.rpc(self.owner, action, payload, timeout=5.0)
+        except RemoteTransportError as e:
+            raise _remote_error(e) from e
+
+    def index(self, doc_id, source, *, routing=None, op_type="index",
+              if_seq_no=None, if_primary_term=None):
+        r = self._call("doc2:index", {
+            "id": doc_id, "source": source, "routing": routing,
+            "op_type": op_type, "if_seq_no": if_seq_no,
+            "if_primary_term": if_primary_term})
+        return IndexResult(**r)
+
+    def delete(self, doc_id, *, if_seq_no=None, if_primary_term=None):
+        r = self._call("doc2:delete", {
+            "id": doc_id, "if_seq_no": if_seq_no,
+            "if_primary_term": if_primary_term})
+        return DeleteResult(**r)
+
+    def get(self, doc_id):
+        r = self._call("doc2:get", {"id": doc_id})
+        return GetResult(**r)
+
+
+class ClusterHooks:
+    """The seam installed on every local IndexService (see
+    ``IndicesService.cluster_hooks``)."""
+
+    def __init__(self, rest: "ClusterRestService"):
+        self.rest = rest
+
+    def writer(self, index: str, shard: int):
+        node = self.rest.node
+        st = node.applied_state
+        if st is None:
+            return None
+        table = st.data.get("routing", {}).get(index)
+        if table is None or str(shard) not in table:
+            return None
+        owner = table[str(shard)]["primary"]
+        if owner == node.node_id:
+            group = node.primaries.get((index, shard))
+            return LocalGroupWriter(group) if group is not None else None
+        return RemoteShardProxy(node, owner, index, shard)
+
+    def search(self, index: str, body: dict):
+        """None → the caller's local engines are authoritative."""
+        node = self.rest.node
+        st = node.applied_state
+        table = (st.data.get("routing", {}) if st else {}).get(index)
+        if not table:
+            return None
+        owners = {e["primary"] for e in table.values()}
+        if owners == {node.node_id}:
+            return None
+        out = node.search(index, dict(body))
+        hits = []
+        for h in out["hits"]:
+            hits.append(ShardHit(
+                doc_id=h["id"], score=h.get("score"), seg_idx=0,
+                local_doc=0, source=h.get("source"),
+                sort_values=h.get("sort"), seq_no=h.get("seq_no"),
+                fields=h.get("fields"), highlight=h.get("highlight"),
+                ignored=h.get("ignored")))
+        max_score = None
+        sort_spec = body.get("sort")
+        if not sort_spec or sort_spec in ("_score", ["_score"]):
+            scores = [h.score for h in hits if h.score is not None]
+            max_score = max(scores) if scores else None
+        total = out["total"]
+        relation = "eq"
+        tth = body.get("track_total_hits", True)
+        k = int(body.get("size", 10)) + int(body.get("from", 0))
+        if tth is False:
+            total = len(hits)
+            relation = "gte" if total >= k else "eq"
+        elif isinstance(tth, int) and not isinstance(tth, bool) \
+                and total > tth:
+            total = tth
+            relation = "gte"
+        return ShardSearchResult(
+            total=total, total_relation=relation, hits=hits,
+            max_score=max_score, aggregations=out.get("aggregations"),
+            suggest=out.get("suggest"), profile=out.get("profile"))
+
+    def count(self, index: str, body: dict):
+        node = self.rest.node
+        st = node.applied_state
+        table = (st.data.get("routing", {}) if st else {}).get(index)
+        if not table:
+            return None
+        owners = {e["primary"] for e in table.values()}
+        if owners == {node.node_id}:
+            return None
+        q = {"size": 0}
+        if body.get("query"):
+            q["query"] = body["query"]
+        return node.search(index, q)["total"]
+
+    def doc_visible(self, index: str, shard: int, doc_id: str):
+        """Non-realtime GET visibility against the OWNING copy's searchable
+        segments (None → not cluster-routed, caller scans locally)."""
+        node = self.rest.node
+        st = node.applied_state
+        table = (st.data.get("routing", {}) if st else {}).get(index)
+        if table is None or str(shard) not in table:
+            return None
+        owner = table[str(shard)]["primary"]
+        if owner == node.node_id:
+            g = node.primaries.get((index, shard))
+            if g is None:
+                return None
+            return any(seg.find_doc(doc_id) is not None
+                       for seg in g.engine.searchable_segments())
+        try:
+            r = node.rpc(owner, "doc2:visible",
+                         {"index": index, "shard": shard, "id": doc_id},
+                         timeout=5.0)
+            return bool(r["visible"])
+        except RemoteTransportError as e:
+            raise _remote_error(e) from e
+
+    def h_doc2_visible(self, src, payload) -> dict:
+        g = self.rest.node.primaries.get(
+            (payload["index"], int(payload["shard"])))
+        if g is None:
+            return {"visible": False}
+        return {"visible": any(
+            seg.find_doc(payload["id"]) is not None
+            for seg in g.engine.searchable_segments())}
+
+    def refresh(self, index: str) -> bool:
+        """Cluster-wide refresh of every copy of ``index``. True when the
+        index is cluster-routed (the caller's local loop is skipped)."""
+        node = self.rest.node
+        st = node.applied_state
+        if st is None or index not in st.data.get("routing", {}):
+            return False
+        # the local service's own engines first: group wiring is async, so
+        # right after index creation a locally-primaried engine may not be
+        # wrapped yet — it still holds any direct writes
+        svc = self.rest.indices.indices.get(index)
+        if svc is not None:
+            for e in svc.shards:
+                e.refresh()
+        for (iname, _sid), g in list(node.primaries.items()):
+            if iname == index:
+                g.engine.refresh()
+        for (iname, _sid), r in list(node.replicas.items()):
+            if iname == index:
+                r.engine.refresh()
+        for n in node.node_ids:
+            if n == node.node_id:
+                continue
+            try:
+                node.rpc(n, "shard:refresh", {"index": index}, timeout=2.0)
+            except Exception:   # noqa: BLE001 — dead nodes skip
+                pass
+        return True
+
+
+class ClusterRestService:
+    """Per-node REST stack: local IndicesService + RestAPI + the cluster
+    dispatch described in the module docstring."""
+
+    def __init__(self, node, data_path: str):
+        from ..rest.api import RestAPI
+        self.node = node
+        self.indices = IndicesService(data_path)
+        self.api = RestAPI(self.indices)
+        self.lock = threading.RLock()
+        self.applied_seq = 0
+        #: master-side full op history (for nodes behind the state tail)
+        self.full_log: List[dict] = []
+        #: scroll/pit id -> owning node (forwarded stateful reads)
+        self._sticky: Dict[str, str] = {}
+        #: per-index last-propagated mapping fingerprint
+        self._propagated: Dict[str, str] = {}
+        #: seqs this node executed as master before publication (replay
+        #: must not re-execute them when they arrive out of order)
+        self._self_executed: set = set()
+
+    # ------------------------------------------------------------------
+    # op-log application (every node, on the data worker)
+    # ------------------------------------------------------------------
+
+    def apply_ops(self, state) -> None:
+        log = state.data.get("meta_ops")
+        if not log:
+            return
+        seq = log["seq"]
+        tail = log["tail"]
+        with self.lock:
+            if self.applied_seq >= seq:
+                return
+            have = {op["seq"]: op for op in tail}
+            missing = [s for s in range(self.applied_seq + 1, seq + 1)
+                       if s not in have]
+            if missing:
+                ops = self._fetch_history(missing[0], missing[-1])
+                have.update({op["seq"]: op for op in ops})
+            for s in range(self.applied_seq + 1, seq + 1):
+                op = have.get(s)
+                if op is None:
+                    continue                    # unrecoverable gap: skip
+                if op["src"] != self.node.node_id and \
+                        s not in self._self_executed:
+                    try:
+                        self.api.handle(op["m"], op["p"], op["q"],
+                                        _unb64(op["b"]))
+                    except Exception:   # noqa: BLE001 — replay best-effort
+                        pass
+                self._self_executed.discard(s)
+                self.applied_seq = s
+
+    def _fetch_history(self, lo: int, hi: int) -> List[dict]:
+        master = self.node.applied_state.master_node \
+            if self.node.applied_state else None
+        if master is None or master == self.node.node_id:
+            return []
+        try:
+            r = self.node.rpc(master, "meta:history",
+                              {"from": lo, "to": hi}, timeout=5.0)
+            return r.get("ops", [])
+        except Exception:   # noqa: BLE001
+            return []
+
+    # ------------------------------------------------------------------
+    # request entry
+    # ------------------------------------------------------------------
+
+    def handle(self, method: str, path: str, query: str, body: bytes
+               ) -> Tuple[int, str, bytes]:
+        from ..rest.api import JSON_CT, _error_payload
+        try:
+            return self._dispatch(method, path, query or "", body or b"")
+        except RemoteTransportError as e:
+            status, payload = _error_payload(_remote_error(e))
+            return status, JSON_CT, json.dumps(payload).encode()
+        except Exception as e:   # noqa: BLE001 — ES-shaped error replies
+            status, payload = _error_payload(e)
+            return status, JSON_CT, json.dumps(payload).encode()
+
+    def _dispatch(self, method, path, query, body):
+        segs = [s for s in path.split("/") if s]
+        # cluster-aware admin views
+        if path.startswith("/_cluster/health"):
+            return self._health(query)
+        if path == "/_cluster/state" or path.startswith("/_cluster/state"):
+            return self._cluster_state()
+        if self._is_meta_mutation(method, path, segs):
+            return self._meta_op(method, path, query, body)
+        if segs and segs[-1].split("?")[0] in _BROADCAST_SUFFIXES \
+                and method in ("POST", "GET"):
+            return self._broadcast(method, path, query, body)
+        if path.startswith("/_search/scroll"):
+            return self._sticky_route(method, path, query, body)
+        fwd = self._forward_target(method, path, query, segs)
+        if fwd is not None:
+            return self._exec_on(fwd, method, path, query, body)
+        self._ensure_doc_indices(method, path, segs, body)
+        return self._local(method, path, query, body)
+
+    def _local(self, method, path, query, body):
+        with self.lock:
+            out = self.api.handle(method, path, query, body)
+        self._after_local(method, path, body)
+        return out
+
+    # ------------------------------------------------------------------
+    # classification
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _is_meta_mutation(method, path, segs) -> bool:
+        if method not in ("PUT", "POST", "DELETE"):
+            return False
+        if any(path.startswith(r) for r in _META_ROOTS):
+            return True
+        if len(segs) == 1 and not segs[0].startswith("_") \
+                and method in ("PUT", "DELETE"):
+            return True                      # index create/delete
+        if len(segs) >= 2 and not segs[0].startswith("_") and \
+                any(s in _META_SUFFIXES for s in segs[1:]):
+            return True
+        return False
+
+    def _forward_target(self, method, path, query, segs) -> Optional[str]:
+        """Single-owner whole-request forwarding for segment-bound reads."""
+        if not segs or segs[0].startswith("_"):
+            return None
+        is_scroll_search = (len(segs) >= 2 and segs[-1] == "_search"
+                            and "scroll=" in query)
+        tail = next((s for s in segs[1:] if s.startswith("_")), None)
+        if not is_scroll_search and tail not in _FORWARD_SUFFIXES:
+            return None
+        owners = self._owners_of(segs[0])
+        if owners is None or owners == {self.node.node_id}:
+            return None
+        if len(owners) == 1:
+            return next(iter(owners))
+        return None                          # spread: local best-effort
+
+    def _owners_of(self, expression: str) -> Optional[set]:
+        st = self.node.applied_state
+        if st is None:
+            return None
+        routing = st.data.get("routing", {})
+        try:
+            with self.lock:
+                names = self.indices.resolve(expression)
+        except _errors.ElasticsearchError:
+            return None
+        owners = set()
+        for n in names:
+            table = routing.get(n)
+            if table is None:
+                return None                  # locally-known only
+            owners.update(e["primary"] for e in table.values())
+        return owners or None
+
+    # ------------------------------------------------------------------
+    # metadata ops through the master
+    # ------------------------------------------------------------------
+
+    def _meta_op(self, method, path, query, body):
+        node = self.node
+        payload = {"m": method, "p": path, "q": query, "b": _b64(body)}
+        deadline = time.monotonic() + 10.0
+        resp = None
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline and resp is None:
+            leader = node.node_loop.sync(
+                lambda: node.coordinator.known_leader)
+            if leader is None:
+                time.sleep(0.05)
+                continue
+            if leader == node.node_id:
+                # direct call — an RPC loopback from the data worker would
+                # deadlock behind itself (single-threaded pool)
+                resp = self.h_meta_op(node.node_id, payload)
+                break
+            try:
+                resp = node.rpc(leader, "meta:op", payload, timeout=10.0)
+            except Exception as e:   # noqa: BLE001 — retry via new leader
+                last = e
+                time.sleep(0.05)
+        if resp is None:
+            raise _errors.ElasticsearchError(
+                f"no master acked [{method} {path}]: {last}")
+        seq = resp.get("seq")
+        on_data_worker = threading.current_thread().name.startswith(
+            f"{node.node_id}-data")
+        if seq and not on_data_worker:
+            # wait until locally applied so follow-up reads observe the op
+            # (skip on the data worker: application is queued behind us)
+            wait_deadline = time.monotonic() + 10.0
+            while self.applied_seq < seq and \
+                    time.monotonic() < wait_deadline:
+                time.sleep(0.01)
+        return (resp["status"], resp.get("ct", "application/json"),
+                _unb64(resp["out"]))
+
+    # master side (registered as "meta:op" on every node; only the master
+    # receives it in practice)
+    def h_meta_op(self, src, payload) -> dict:
+        # a freshly-elected master may hold unapplied ops from the previous
+        # term: catch its local service up BEFORE executing the new op, or
+        # its replay would be permanently cancelled by the seq bump below
+        st = self.node.applied_state
+        if st is not None:
+            self.apply_ops(st)
+        method, path = payload["m"], payload["p"]
+        query, body = payload["q"], _unb64(payload["b"])
+        with self.lock:
+            status, ct, out = self.api.handle(method, path, query, body)
+        seq = None
+        if status < 400:
+            entry = {"src": self.node.node_id, "m": method, "p": path,
+                     "q": query, "b": payload["b"]}
+            seq = self._publish_op(entry)
+            with self.lock:
+                if self.applied_seq == seq - 1:
+                    self.applied_seq = seq
+                else:
+                    # non-contiguous (ops raced in): mark this seq as
+                    # already executed so replay skips it
+                    self._self_executed.add(seq)
+        return {"status": status, "ct": ct, "out": _b64(out), "seq": seq}
+
+    def h_meta_history(self, src, payload) -> dict:
+        lo, hi = int(payload["from"]), int(payload["to"])
+        return {"ops": [op for op in self.full_log
+                        if lo <= op["seq"] <= hi]}
+
+    def _publish_op(self, entry: dict) -> int:
+        box: Dict[str, int] = {}
+
+        def update(st):
+            new = st.updated()
+            log = dict(new.data.get("meta_ops")
+                       or {"seq": 0, "tail": []})
+            log["seq"] = int(log["seq"]) + 1
+            op = dict(entry, seq=log["seq"])
+            log["tail"] = (list(log["tail"]) + [op])[-OP_TAIL:]
+            new.data["meta_ops"] = log
+            box["seq"] = log["seq"]
+            box["op"] = op
+            self._sync_index_metadata(new)
+            return new
+
+        self.node._submit_and_wait(update)
+        self.full_log.append(box["op"])
+        return box["seq"]
+
+    def _sync_index_metadata(self, new_state) -> None:
+        """Reconcile cluster metadata/routing with the master's local
+        service after an op: allocate routing for new indices (round-robin
+        primaries + replica fan-out, the round-2 allocator), drop removed
+        ones. Generic over every index-creating op (create, rollover,
+        shrink/split/clone...)."""
+        with self.lock:
+            local = {
+                n: (svc.num_shards, svc.num_replicas)
+                for n, svc in self.indices.indices.items()}
+        meta = new_state.metadata["indices"]
+        routing = new_state.data.setdefault("routing", {})
+        live = sorted(new_state.nodes)
+        for n, (shards, replicas) in local.items():
+            if n in meta:
+                continue
+            meta[n] = {"num_shards": shards, "num_replicas": replicas,
+                       "mappings": {}, "primary_term": 1}
+            table = {}
+            for s in range(shards):
+                owner = live[(hash(n) + s) % len(live)]
+                reps = [live[(hash(n) + s + 1 + r) % len(live)]
+                        for r in range(min(replicas, len(live) - 1))]
+                table[str(s)] = {"primary": owner, "replicas": reps}
+            routing[n] = table
+        for n in list(meta):
+            if n not in local:
+                del meta[n]
+                routing.pop(n, None)
+
+    # ------------------------------------------------------------------
+    # auto-create + dynamic-mapping propagation for doc writes
+    # ------------------------------------------------------------------
+
+    def _ensure_doc_indices(self, method, path, segs, body) -> None:
+        if method not in ("PUT", "POST", "DELETE"):
+            return
+        tail = next((s for s in segs if s.startswith("_")), None)
+        if tail not in _DOC_WRITE_SUFFIXES:
+            return
+        targets = set()
+        if segs and not segs[0].startswith("_"):
+            targets.add(segs[0])
+        if tail == "_bulk":
+            default = segs[0] if segs and not segs[0].startswith("_") \
+                else None
+            for line in (body or b"").split(b"\n"):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    op = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(op, dict) and len(op) == 1 and \
+                        next(iter(op)) in ("index", "create", "update",
+                                           "delete"):
+                    idx = next(iter(op.values())).get("_index", default)
+                    if idx:
+                        targets.add(idx)
+        st = self.node.applied_state
+        known = (st.metadata["indices"] if st else {})
+        with self.lock:
+            aliases = self.indices.all_aliases()
+        for idx in targets:
+            if idx in known or idx in aliases:
+                continue
+            try:
+                self._meta_op("PUT", f"/{idx}", "", b"{}")
+            except _errors.ElasticsearchError:
+                pass                          # exists / races are fine
+
+    def _after_local(self, method, path, body) -> None:
+        """Propagate dynamic-mapping growth to the cluster (the
+        reference's mapping-update master round-trip inside the bulk
+        path, ``TransportShardBulkAction.java:233``). Only the indices the
+        request targeted are fingerprinted — re-serializing every mapping
+        per doc write would scale with total cluster mapping size."""
+        if method not in ("PUT", "POST", "DELETE"):
+            return
+        segs = [s for s in path.split("/") if s]
+        tail = next((s for s in segs if s.startswith("_")), None)
+        if tail not in _DOC_WRITE_SUFFIXES:
+            return
+        targets = set()
+        if segs and not segs[0].startswith("_"):
+            targets.add(segs[0])
+        if tail == "_bulk":
+            default = segs[0] if segs and not segs[0].startswith("_") \
+                else None
+            for line in (body or b"").split(b"\n"):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    op = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(op, dict) and len(op) == 1 and \
+                        next(iter(op)) in ("index", "create", "update",
+                                           "delete"):
+                    idx = next(iter(op.values())).get("_index", default)
+                    if idx:
+                        targets.add(idx)
+        st = self.node.applied_state
+        known = st.metadata["indices"] if st else {}
+        with self.lock:
+            concrete = set()
+            for t in targets:
+                try:
+                    concrete.update(self.indices.resolve(t))
+                except _errors.ElasticsearchError:
+                    pass
+            items = [(n, svc) for n, svc in self.indices.indices.items()
+                     if n in concrete]
+        for name, svc in items:
+            if name not in known:
+                continue
+            try:
+                m = svc.mapper.mapping_dict()
+            except Exception:   # noqa: BLE001
+                continue
+            fp = json.dumps(m, sort_keys=True, default=str)
+            if self._propagated.get(name) == fp:
+                continue
+            if not m.get("properties") and not m.get("runtime"):
+                self._propagated[name] = fp
+                continue
+            try:
+                self._meta_op("PUT", f"/{name}/_mapping", "",
+                              json.dumps(m, default=str).encode())
+                self._propagated[name] = fp
+            except _errors.ElasticsearchError:
+                pass
+
+    # ------------------------------------------------------------------
+    # forwarding / broadcast
+    # ------------------------------------------------------------------
+
+    def _exec_on(self, target: str, method, path, query, body):
+        if target == self.node.node_id:
+            return self._local(method, path, query, body)
+        try:
+            r = self.node.rpc(target, "rest:exec", {
+                "m": method, "p": path, "q": query, "b": _b64(body)},
+                timeout=30.0)
+        except RemoteTransportError as e:
+            raise _remote_error(e) from e
+        out = _unb64(r["out"])
+        self._remember_sticky(out, target)
+        return r["status"], r.get("ct", "application/json"), out
+
+    def h_rest_exec(self, src, payload) -> dict:
+        status, ct, out = self._local(
+            payload["m"], payload["p"], payload["q"],
+            _unb64(payload["b"]))
+        return {"status": status, "ct": ct, "out": _b64(out)}
+
+    def _remember_sticky(self, out: bytes, target: str) -> None:
+        try:
+            doc = json.loads(out)
+        except ValueError:
+            return
+        if isinstance(doc, dict):
+            for k in ("_scroll_id", "id", "pit_id"):
+                v = doc.get(k)
+                if isinstance(v, str) and len(v) > 16:
+                    self._sticky[v] = target
+
+    def _sticky_route(self, method, path, query, body):
+        sid = None
+        try:
+            doc = json.loads(body or b"{}")
+            sid = doc.get("scroll_id") or doc.get("id")
+            if isinstance(sid, list):
+                sid = sid[0] if sid else None
+        except ValueError:
+            pass
+        if sid is None and path.count("/") >= 3:
+            sid = path.rsplit("/", 1)[-1]
+        target = self._sticky.get(sid or "")
+        if target and target != self.node.node_id:
+            return self._exec_on(target, method, path, query, body)
+        return self._local(method, path, query, body)
+
+    def _broadcast(self, method, path, query, body):
+        for n in self.node.node_ids:
+            if n == self.node.node_id:
+                continue
+            try:
+                self.node.rpc(n, "rest:exec", {
+                    "m": method, "p": path, "q": query, "b": _b64(body)},
+                    timeout=10.0)
+            except Exception:   # noqa: BLE001 — dead nodes skip
+                pass
+        return self._local(method, path, query, body)
+
+    # ------------------------------------------------------------------
+    # cluster-aware admin views
+    # ------------------------------------------------------------------
+
+    def _health(self, query: str):
+        params = dict(p.split("=", 1) for p in query.split("&")
+                      if "=" in p)
+        want = params.get("wait_for_status")
+        timeout = 5.0
+        deadline = time.monotonic() + timeout
+        order = {"red": 0, "yellow": 1, "green": 2}
+        while True:
+            doc = self._health_doc()
+            if want is None or order[doc["status"]] >= order.get(want, 0):
+                break
+            if time.monotonic() > deadline:
+                doc["timed_out"] = True
+                break
+            time.sleep(0.05)
+        return 200, "application/json", json.dumps(doc).encode()
+
+    def _health_doc(self) -> dict:
+        st = self.node.applied_state
+        nodes = sorted(st.nodes) if st else []
+        routing = st.data.get("routing", {}) if st else {}
+        n_primary = n_unassigned_replicas = 0
+        status = "green"
+        for table in routing.values():
+            for entry in table.values():
+                n_primary += 1
+                if entry["primary"] not in nodes:
+                    status = "red"
+        if status != "red":
+            for name, table in routing.items():
+                meta = st.metadata["indices"].get(name, {})
+                want = int(meta.get("num_replicas", 0))
+                for entry in table.values():
+                    missing = want - len(entry["replicas"])
+                    if missing > 0:
+                        n_unassigned_replicas += missing
+                        status = "yellow"
+        return {
+            "cluster_name": "elasticsearch_tpu",
+            "status": status,
+            "timed_out": False,
+            "number_of_nodes": len(nodes),
+            "number_of_data_nodes": len(nodes),
+            "active_primary_shards": n_primary,
+            "active_shards": n_primary,
+            "relocating_shards": 0,
+            "initializing_shards": 0,
+            "unassigned_shards": n_unassigned_replicas,
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number": 100.0,
+        }
+
+    def _cluster_state(self):
+        st = self.node.applied_state
+        doc = {
+            "cluster_name": "elasticsearch_tpu",
+            "master_node": st.master_node if st else None,
+            "version": st.version if st else 0,
+            "nodes": {n: {"name": n} for n in (st.nodes if st else {})},
+            "metadata": {"indices": dict(
+                st.metadata["indices"] if st else {})},
+            "routing_table": dict(st.data.get("routing", {}) if st else {}),
+        }
+        return 200, "application/json", json.dumps(doc).encode()
+
+    # ------------------------------------------------------------------
+    # doc2 handlers (owner side) — registered by ClusterNode
+    # ------------------------------------------------------------------
+
+    def h_doc2_index(self, src, payload) -> dict:
+        w = self._local_writer(payload)
+        r = w.index(payload["id"], payload["source"],
+                    routing=payload.get("routing"),
+                    op_type=payload.get("op_type", "index"),
+                    if_seq_no=payload.get("if_seq_no"),
+                    if_primary_term=payload.get("if_primary_term"))
+        self._after_local("POST", f"/{payload['index']}/_doc/x", b"")
+        return dict(r.__dict__)
+
+    def h_doc2_delete(self, src, payload) -> dict:
+        w = self._local_writer(payload)
+        r = w.delete(payload["id"],
+                     if_seq_no=payload.get("if_seq_no"),
+                     if_primary_term=payload.get("if_primary_term"))
+        return dict(r.__dict__)
+
+    def h_doc2_get(self, src, payload) -> dict:
+        w = self._local_writer(payload)
+        return dict(w.get(payload["id"]).__dict__)
+
+    def _local_writer(self, payload) -> LocalGroupWriter:
+        key = (payload["index"], int(payload["shard"]))
+        group = self.node.primaries.get(key)
+        if group is None:
+            raise _errors.ElasticsearchError(
+                f"shard [{key}] is not primaried on [{self.node.node_id}]")
+        return LocalGroupWriter(group)

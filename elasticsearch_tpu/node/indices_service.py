@@ -93,6 +93,11 @@ class IndexService:
         # lazily built per text field, invalidated by segment-list changes
         from ..search.plane_route import ServingPlaneCache
         self.plane_cache = ServingPlaneCache()
+        # cluster seam (node/cluster_rest.py): when set, per-shard doc ops
+        # and whole-index search route through the cluster instead of the
+        # local engines (which hold data only for locally-assigned shards).
+        # None on the single-node path — zero behavior change.
+        self.cluster_hooks = None
 
     def record_search(self, groups: Optional[List[str]] = None) -> None:
         self.search_stats["query_total"] += 1
@@ -122,17 +127,35 @@ class IndexService:
                   routing: Optional[str] = None, op_type: str = "index",
                   if_seq_no=None, if_primary_term=None):
         self._check_open()
+        if self.cluster_hooks is not None:
+            w = self.cluster_hooks.writer(self.name, self.shard_id_for(
+                doc_id, routing))
+            if w is not None:
+                return w.index(doc_id, source, routing=routing,
+                               op_type=op_type, if_seq_no=if_seq_no,
+                               if_primary_term=if_primary_term)
         return self.shard_for_doc(doc_id, routing).index(
             doc_id, source, routing=routing, op_type=op_type,
             if_seq_no=if_seq_no, if_primary_term=if_primary_term)
 
     def get_doc(self, doc_id: str, routing: Optional[str] = None):
         self._check_open()
+        if self.cluster_hooks is not None:
+            w = self.cluster_hooks.writer(self.name, self.shard_id_for(
+                doc_id, routing))
+            if w is not None:
+                return w.get(doc_id)
         return self.shard_for_doc(doc_id, routing).get(doc_id)
 
     def delete_doc(self, doc_id: str, *, routing: Optional[str] = None,
                    if_seq_no=None, if_primary_term=None):
         self._check_open()
+        if self.cluster_hooks is not None:
+            w = self.cluster_hooks.writer(self.name, self.shard_id_for(
+                doc_id, routing))
+            if w is not None:
+                return w.delete(doc_id, if_seq_no=if_seq_no,
+                                if_primary_term=if_primary_term)
         return self.shard_for_doc(doc_id, routing).delete(
             doc_id, if_seq_no=if_seq_no, if_primary_term=if_primary_term)
 
@@ -168,12 +191,20 @@ class IndexService:
 
     def search(self, body: Optional[dict] = None) -> ShardSearchResult:
         self._check_open()
+        if self.cluster_hooks is not None:
+            r = self.cluster_hooks.search(self.name, body or {})
+            if r is not None:
+                return r
         if self.num_shards > 1:
             return self.dist_searcher().search(body or {})
         return self.searcher().search(body or {})
 
     def count(self, body: Optional[dict] = None) -> int:
         self._check_open()
+        if self.cluster_hooks is not None:
+            c = self.cluster_hooks.count(self.name, body or {})
+            if c is not None:
+                return c
         if self.num_shards > 1:
             return self.dist_searcher().count(body or {})
         return self.searcher().count(body or {})
@@ -181,6 +212,9 @@ class IndexService:
     # -- admin --------------------------------------------------------------
 
     def refresh(self) -> None:
+        if self.cluster_hooks is not None and \
+                self.cluster_hooks.refresh(self.name):
+            return
         for s in self.shards:
             s.refresh()
 

@@ -2689,9 +2689,15 @@ class RestAPI:
                     if p in params}
         return spec
 
-    def _doc_visible(self, svc, doc_id, realtime: bool) -> bool:
+    def _doc_visible(self, svc, doc_id, realtime: bool,
+                     routing=None) -> bool:
         if realtime:
             return True
+        if svc.cluster_hooks is not None:
+            vis = svc.cluster_hooks.doc_visible(
+                svc.name, svc.shard_id_for(doc_id, routing), doc_id)
+            if vis is not None:
+                return vis
         return any(seg.find_doc(doc_id) is not None
                    for sh in svc.shards
                    for seg in sh.searchable_segments())
@@ -2703,7 +2709,8 @@ class RestAPI:
             svc.refresh()
         r = svc.get_doc(id, routing=params.get("routing"))
         realtime = params.get("realtime") not in ("false",)
-        if not r.found or not self._doc_visible(svc, id, realtime):
+        if not r.found or not self._doc_visible(svc, id, realtime,
+                                                params.get("routing")):
             return 404, {"_index": index, "_id": id, "found": False}
         if params.get("version"):
             want = int(params["version"])
@@ -2741,7 +2748,8 @@ class RestAPI:
             svc.refresh()
         r = svc.get_doc(id, routing=params.get("routing"))
         realtime = params.get("realtime") not in ("false",)
-        if not r.found or not self._doc_visible(svc, id, realtime):
+        if not r.found or not self._doc_visible(svc, id, realtime,
+                                                params.get("routing")):
             return 404, {"error": f"document [{id}] missing", "status": 404}
         src_spec = self._get_source_spec(params)
         from ..search.fetch import filter_source
@@ -2962,7 +2970,8 @@ class RestAPI:
             except IndexNotFoundError:
                 out.append({"_index": idx, "_id": doc_id, "found": False})
                 continue
-            if r.found and not self._doc_visible(svc, doc_id, realtime):
+            if r.found and not self._doc_visible(svc, doc_id, realtime,
+                                                 routing):
                 out.append({"_index": idx, "_id": doc_id, "found": False})
                 continue
             if r.found:

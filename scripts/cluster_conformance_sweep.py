@@ -1,0 +1,113 @@
+"""Full-corpus YAML conformance sweep against a 3-node TCP cluster.
+
+Runs every rest-api-spec suite through a non-master node's cluster REST
+front and prints a per-directory score plus the corpus total, for
+comparison with the single-node sweep (tests/test_yaml_conformance.py).
+
+Usage:  python scripts/cluster_conformance_sweep.py [suite-prefix ...]
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from elasticsearch_tpu.node.cluster_node import ClusterNode  # noqa: E402
+from elasticsearch_tpu.testkit.yaml_runner import (  # noqa: E402
+    REFERENCE_SPEC_ROOT, YamlTestRunner)
+
+BASE_PORT = 29700
+
+
+def main():
+    prefixes = sys.argv[1:]
+    d = tempfile.mkdtemp(prefix="cluster_sweep_")
+    peers = {f"n{i}": ("127.0.0.1", BASE_PORT + i) for i in range(3)}
+    nodes = [ClusterNode(f"n{i}", "127.0.0.1", BASE_PORT + i, peers,
+                         os.path.join(d, f"n{i}"), seed=i)
+             for i in range(3)]
+    leader = None
+    deadline = time.monotonic() + 20.0
+    while leader is None and time.monotonic() < deadline:
+        ls = [n for n in nodes if n.coordinator.mode == "LEADER"]
+        if len(ls) == 1:
+            leader = ls[0]
+        time.sleep(0.05)
+    assert leader is not None
+    client = nodes[(nodes.index(leader) + 1) % 3]
+    print(f"# 3-node cluster up; REST front: {client.node_id} "
+          f"(master: {leader.node_id})", file=sys.stderr)
+
+    class Target:
+        def handle(self, m, p, q, b):
+            return client.rest.handle(m, p, q or "", b or b"")
+
+    def factory():
+        rest = client.rest
+        rest.handle("DELETE", "/*", "expand_wildcards=all", b"")
+        with rest.lock:
+            templates = list(rest.api.templates)
+            comps = list(rest.api.component_templates)
+            idx_t = list(getattr(rest.api, "index_templates", {}) or {})
+        for t in templates:
+            rest.handle("DELETE", f"/_template/{t}", "", b"")
+        for t in idx_t:
+            rest.handle("DELETE", f"/_index_template/{t}", "", b"")
+        for t in comps:
+            rest.handle("DELETE", f"/_component_template/{t}", "", b"")
+        return Target()
+
+    runner = YamlTestRunner(factory)
+    files = runner.discover()
+    if prefixes:
+        root = os.path.join(REFERENCE_SPEC_ROOT, "test")
+        files = [f for f in files
+                 if any(os.path.relpath(f, root).startswith(p)
+                        for p in prefixes)]
+    by_dir = {}
+    total = passed = 0
+    t0 = time.time()
+    try:
+        for i, f in enumerate(files):
+            try:
+                results = runner.run_file(f)
+            except Exception as e:   # noqa: BLE001 — suite-level crash
+                results = []
+                print(f"# suite crash {f}: {e}", file=sys.stderr)
+            for r in results:
+                total += 1
+                top = r.suite.split("/")[0]
+                cur = by_dir.setdefault(top, [0, 0])
+                cur[1] += 1
+                if r.ok:
+                    passed += 1
+                    cur[0] += 1
+            if (i + 1) % 25 == 0:
+                print(f"# {i + 1}/{len(files)} files, {passed}/{total} "
+                      f"({time.time() - t0:.0f}s)", file=sys.stderr)
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:   # noqa: BLE001
+                pass
+    for name in sorted(by_dir):
+        p, t = by_dir[name]
+        flag = "" if p == t else f"   <-- {t - p} failing"
+        print(f"{name:45s} {p:4d}/{t:<4d}{flag}")
+    print(json.dumps({"cluster_conformance_pass": passed,
+                      "total": total,
+                      "pct": round(100.0 * passed / max(total, 1), 1)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""YAML conformance against a REAL 3-node TCP cluster (VERDICT r2 next
+#3): the same reference rest-api-spec scenarios that drive the single-node
+RestAPI run through a non-master node's cluster REST front — metadata via
+the replicated op log, doc ops routed to shard owners, searches
+scatter-gathered.
+
+A representative suite list runs in CI; the full-corpus sweep lives in
+``scripts/cluster_conformance_sweep.py`` (slow) and its score is recorded
+in STATUS.md next to the single-node number."""
+
+import json
+import os
+import tempfile
+import time
+
+import pytest
+
+from elasticsearch_tpu.node.cluster_node import ClusterNode
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+from elasticsearch_tpu.testkit.yaml_runner import (REFERENCE_SPEC_ROOT,
+                                                   run_conformance)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(os.path.join(REFERENCE_SPEC_ROOT, "test")),
+    reason="reference rest-api-spec corpus not available")
+
+BASE_PORT = 29480
+
+#: representative spread: doc CRUD, bulk, search, aggs, mapping, aliases
+SUITES = [
+    "index/10_with_id.yml",
+    "index/12_result.yml",
+    "index/20_optype.yml",
+    "create/10_with_id.yml",
+    "get/10_basic.yml",
+    "get/15_default_values.yml",
+    "delete/10_basic.yml",
+    "delete/12_result.yml",
+    "update/10_doc.yml",
+    "update/20_doc_upsert.yml",
+    "bulk/20_list_of_strings.yml",
+    "mget/10_basic.yml",
+    "count/10_basic.yml",
+    "search/10_source_filtering.yml",
+    "search.aggregation/150_stats_metric.yml",
+    "indices.create/10_basic.yml",
+    "indices.put_mapping/10_basic.yml",
+    "indices.get_mapping/10_basic.yml",
+    "indices.exists/10_basic.yml",
+    "indices.delete_alias/10_basic.yml",
+]
+
+
+@pytest.fixture(scope="module")
+def cluster_client(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cluster_conf")
+    peers = {f"n{i}": ("127.0.0.1", BASE_PORT + i) for i in range(3)}
+    nodes = [ClusterNode(f"n{i}", "127.0.0.1", BASE_PORT + i, peers,
+                         str(d / f"n{i}"), seed=i) for i in range(3)]
+    deadline = time.monotonic() + 15.0
+    leader = None
+    while time.monotonic() < deadline and leader is None:
+        ls = [n for n in nodes if n.coordinator.mode == "LEADER"]
+        if len(ls) == 1:
+            leader = ls[0]
+        time.sleep(0.05)
+    assert leader is not None, "no leader"
+    client = nodes[(nodes.index(leader) + 1) % 3]   # non-master front
+    try:
+        yield client
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+
+class _ClusterTarget:
+    def __init__(self, node):
+        self.node = node
+
+    def handle(self, method, path, query, body):
+        return self.node.rest.handle(method, path, query or "",
+                                     body or b"")
+
+
+def _wipe(node):
+    rest = node.rest
+    rest.handle("DELETE", "/*", "expand_wildcards=all", b"")
+    with rest.lock:
+        templates = list(rest.api.templates)
+        comps = list(rest.api.component_templates)
+        idx_templates = list(getattr(rest.api, "index_templates", {}) or {})
+    for t in templates:
+        rest.handle("DELETE", f"/_template/{t}", "", b"")
+    for t in idx_templates:
+        rest.handle("DELETE", f"/_index_template/{t}", "", b"")
+    for t in comps:
+        rest.handle("DELETE", f"/_component_template/{t}", "", b"")
+
+
+def test_cluster_conformance_vs_single_node(cluster_client):
+    # single-node score over the same suites
+    def single_factory():
+        return RestAPI(IndicesService(tempfile.mkdtemp()))
+    single = run_conformance(single_factory, suites=SUITES)
+    single_pass = sum(1 for r in single if r.ok)
+    assert single_pass > 0
+
+    def cluster_factory():
+        _wipe(cluster_client)
+        return _ClusterTarget(cluster_client)
+    multi = run_conformance(cluster_factory, suites=SUITES)
+    multi_pass = sum(1 for r in multi if r.ok)
+    failures = [f"{r.suite} :: {r.name}: {r.reason[:120]}"
+                for r in multi if not r.ok]
+    # the multi-node front must keep >= 90% of the single-node score on
+    # this representative set (VERDICT target is 95% corpus-wide; the
+    # sweep script measures that)
+    assert multi_pass >= 0.9 * single_pass, (
+        f"multi-node {multi_pass}/{len(multi)} vs single-node "
+        f"{single_pass}/{len(single)}:\n" + "\n".join(failures[:15]))
