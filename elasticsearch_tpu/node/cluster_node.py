@@ -79,11 +79,11 @@ class RpcReplicaChannel:
         self.shard_id = shard_id
         self.allocation_id = allocation_id
 
-    def _call(self, action: str, payload: dict):
+    def _call(self, action: str, payload: dict, timeout: float = 3.0):
         payload = dict(payload, index=self.index_name, shard=self.shard_id)
         try:
             return self.node.rpc(self.target_node, action, payload,
-                                 timeout=3.0)
+                                 timeout=timeout)
         except RemoteTransportError as e:
             if e.remote_type == "ReplicaFencedError":
                 # semantic round-trip: the remote copy is on a newer
@@ -119,9 +119,15 @@ class ClusterNode:
 
     def __init__(self, node_id: str, host: str, port: int,
                  peers: Dict[str, Tuple[str, int]], data_path: str,
-                 seed: int = 0):
+                 seed: int = 0,
+                 node_attrs: Optional[Dict[str, dict]] = None):
         self.node_id = node_id
         self.data_path = data_path
+        #: awareness/filter attributes for EVERY node (static membership)
+        self.node_attrs = node_attrs or {}
+        #: master-side liveness + disk usage learned from watch pings
+        self._live_nodes: Optional[set] = None
+        self._disk_used: Dict[str, float] = {}
         os.makedirs(data_path, exist_ok=True)
         self.node_loop = NodeLoop()
         all_peers = dict(peers)
@@ -141,6 +147,15 @@ class ClusterNode:
         # synchronous RPCs — the loop stays free to deliver the responses
         self._data_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"{node_id}-data")
+        # separate single-thread lanes so one class of work never queues
+        # behind another class blocked on a cross-node RPC (the reference
+        # runs 17 purpose-specific pools — threadpool/ThreadPool.java):
+        # replica-apply ops never wait behind a doc op fanning out to THIS
+        # node's peer, and metadata ops never wait behind either.
+        self._replica_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"{node_id}-replica")
+        self._meta_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"{node_id}-meta")
         # full REST stack (node/cluster_rest.py): local IndicesService +
         # RestAPI + cluster dispatch; metadata replicates via the op log
         from .cluster_rest import ClusterHooks, ClusterRestService
@@ -179,6 +194,8 @@ class ClusterNode:
         # _apply_state/_recover_replica must not touch a closed engine or
         # mutate the shard maps mid-iteration
         self._data_pool.shutdown(wait=True, cancel_futures=True)
+        self._replica_pool.shutdown(wait=True, cancel_futures=True)
+        self._meta_pool.shutdown(wait=True, cancel_futures=True)
         if self._http_pool is not None:
             self._http_pool.shutdown(wait=False, cancel_futures=True)
         closed = set()
@@ -320,7 +337,16 @@ class ClusterNode:
         # commits arrive on the transport loop; shard lifecycle (engine
         # creation, promotion, recovery kickoff) belongs on the data worker
         self.applied_state = state
-        self._data_pool.submit(self._apply_state, state)
+        self._data_pool.submit(self._apply_state_safe, state)
+
+    def _apply_state_safe(self, state: ClusterState) -> None:
+        """State application must never silently die half-way: a later
+        commit retries, and the failure is visible for debugging."""
+        try:
+            self._apply_state(state)
+        except Exception as e:   # noqa: BLE001
+            import traceback
+            self.last_apply_error = (e, traceback.format_exc())
 
     def _apply_state(self, state: ClusterState) -> None:
         # 1. replay metadata ops into the local service (creates/deletes
@@ -357,10 +383,14 @@ class ClusterNode:
                     if key in self.primaries:
                         self._sync_replica_channels(key, entry, term)
                     elif key in self.replicas:
-                        # promotion: replica -> primary
+                        # promotion: replica -> primary. Refresh so docs
+                        # the copy received through recovery/replication
+                        # stay SEARCHABLE across the ownership change (the
+                        # reference refreshes before marking started)
                         rep = self.replicas.pop(key)
                         group = promote_to_primary(
                             rep, max(term, rep.engine.primary_term + 1))
+                        group.engine.refresh()
                         self.primaries[key] = group
                         self._sync_replica_channels(key, entry, term)
                     else:
@@ -413,7 +443,8 @@ class ClusterNode:
                          ch: RpcReplicaChannel, aid: str,
                          attempts: int = 20) -> None:
         try:
-            remote_ckpt = ch._call("replica:checkpoint", {})["checkpoint"]
+            remote_ckpt = ch._call("replica:checkpoint", {},
+                                   timeout=1.0)["checkpoint"]
             group.tracker.init_tracking(aid)
             group.tracker.add_lease(f"peer_recovery/{aid}",
                                     max(remote_ckpt + 1, 0),
@@ -425,6 +456,13 @@ class ClusterNode:
             group.replicas[aid] = ch
             group.tracker.mark_in_sync(aid, ckpt)
             group.tracker.remove_lease(f"peer_recovery/{aid}")
+            # recovered docs must be searchable on the target immediately
+            # (finalize-refresh, like the reference's recovery finalize)
+            try:
+                self.rpc(ch.target_node, "shard:refresh",
+                         {"index": ch.index_name}, timeout=2.0)
+            except Exception:   # noqa: BLE001
+                pass
         except Exception:   # noqa: BLE001 — replica node not ready: retry
             group.tracker.remove_lease(f"peer_recovery/{aid}")
             if attempts > 0 and not self.stopped:
@@ -441,12 +479,17 @@ class ClusterNode:
         self._watch_task = self.queue.schedule(0.5, self._node_watch_tick)
 
     def _node_watch_tick(self):
-        """Master-side shard failover watch. Runs ON the transport loop —
+        """Master-side node watch: liveness + disk usage for EVERY peer
+        (allocation needs both), shard failover for the dead, and a
+        periodic allocation round. Runs ON the transport loop —
         everything here is callback-based (a blocking RPC would starve the
         loop that delivers its own response)."""
         if self.stopped:
             return
         if self.coordinator.mode != "LEADER":
+            # a later re-election must not allocate from a stale snapshot:
+            # liveness is only maintained while leading
+            self._live_nodes = None
             self._schedule_node_watch()
             return
         state = self.coordinator.applied
@@ -457,25 +500,187 @@ class ClusterNode:
                 referenced.add(entry["primary"])
                 referenced.update(entry["replicas"])
         referenced.discard(self.node_id)
-        if not referenced:
+        targets = {n for n in self.node_ids if n != self.node_id}
+        if not targets:
             self._schedule_node_watch()
             return
         alive = {self.node_id}
-        pending = {"n": len(referenced)}
+        self._disk_used[self.node_id] = _disk_used_frac(self.data_path)
+        pending = {"n": len(targets)}
 
         def done():
             pending["n"] -= 1
             if pending["n"] == 0:
-                dead = referenced - alive
+                self._live_nodes = set(alive)
+                # flap guard: a node must miss TWO consecutive rounds
+                # before failover strips its shards — one lost ping during
+                # election churn must not promote empty copies
+                missed = targets - alive
+                streaks = getattr(self, "_dead_streaks", {})
+                self._dead_streaks = {
+                    n: streaks.get(n, 0) + 1 for n in missed}
+                dead = referenced & {n for n, c in
+                                     self._dead_streaks.items() if c >= 2}
                 if dead:
                     self._fail_over_dead_nodes(dead)
+                # allocation runs on the data worker (it issues blocking
+                # in-sync RPCs for staged relocations); at most ONE round
+                # queued — ticks fire every 0.5s but a round with probes
+                # can take seconds, and backlog would starve doc ops
+                if not getattr(self, "_alloc_pending", False):
+                    self._alloc_pending = True
+                    self._data_pool.submit(self._allocation_round)
                 self._schedule_node_watch()
 
-        for n in sorted(referenced):
+        def on_pong(r, n):
+            alive.add(n)
+            if isinstance(r, dict) and "disk_used_frac" in r:
+                self._disk_used[n] = float(r["disk_used_frac"])
+            done()
+
+        for n in sorted(targets):
+            self.transport.send(
+                self.node_id, n, "ping", {},
+                on_response=lambda r, n=n: on_pong(r, n),
+                on_failure=lambda e: done(), timeout=0.5)
+
+    # ------------------------------------------------------------------
+    # allocation round (master, data worker) — BalancedShardsAllocator +
+    # deciders + staged relocations (cluster/allocation.py)
+    # ------------------------------------------------------------------
+
+    def live_nodes(self) -> set:
+        """Nodes believed alive. Before the first watch round completes
+        (fresh election) this PINGS every peer synchronously — allocating
+        shards to a down node points writes at nothing and silently drops
+        data, so liveness must never be assumed."""
+        if self._live_nodes is not None:
+            return set(self._live_nodes) | {self.node_id}
+        alive = {self.node_id}
+        pending = threading.Event()
+        left = {"n": 0}
+        targets = [n for n in self.node_ids if n != self.node_id]
+        if not targets:
+            return alive
+        left["n"] = len(targets)
+
+        def done():
+            left["n"] -= 1
+            if left["n"] == 0:
+                pending.set()
+
+        for n in targets:
             self.transport.send(
                 self.node_id, n, "ping", {},
                 on_response=lambda r, n=n: (alive.add(n), done()),
                 on_failure=lambda e: done(), timeout=0.5)
+        pending.wait(1.5)
+        self._live_nodes = set(alive)
+        return alive
+
+    def _allocation_round(self) -> None:
+        self._alloc_pending = False
+        if self.stopped or self.coordinator.mode != "LEADER":
+            return
+        st = self.applied_state
+        if st is None:
+            return
+        from ..cluster.allocation import (AllocationContext,
+                                          BalancedAllocator)
+        live = sorted(self.live_nodes())
+        routing = st.data.get("routing", {})
+        # completion probes for staged relocations (blocking RPC is fine
+        # here — we are on the data worker)
+        completed: set = set()
+        in_flight = 0
+        for index, table in routing.items():
+            for sid_s, entry in table.items():
+                tgt = entry.get("relocating_to")
+                if not tgt:
+                    continue
+                in_flight += 1
+                owner = entry.get("primary")
+                aid = f"{tgt}/{index}/{sid_s}"
+                ok = False
+                try:
+                    if owner == self.node_id:
+                        g = self.primaries.get((index, int(sid_s)))
+                        ok = g is not None and \
+                            aid in g.tracker.in_sync_allocation_ids()
+                    elif owner is not None:
+                        r = self.rpc(owner, "shard:insync",
+                                     {"index": index, "shard": int(sid_s),
+                                      "aid": aid}, timeout=2.0)
+                        ok = bool(r.get("in_sync"))
+                except Exception:   # noqa: BLE001 — probe later
+                    ok = False
+                if ok:
+                    completed.add((index, sid_s))
+        from ..cluster.allocation import MAX_RETRIES
+        ctx = AllocationContext(
+            live, routing, st.metadata["indices"],
+            node_attrs=self.node_attrs, disk_used=dict(self._disk_used),
+            moves_in_flight=in_flight - len(completed))
+        allocator = BalancedAllocator()
+        plan = [] if completed else allocator.plan_rebalance(ctx)
+        # replica deficits only: red shards (no primary) wait for a copy
+        # to return; retry-exhausted shards wait for a manual reroute
+        needs_fill = any(
+            ((e.get("primary") and
+              len(e.get("replicas", ())) < min(
+                  int((st.metadata["indices"].get(i) or {})
+                      .get("num_replicas", 0)), len(live) - 1)) or
+             (not e.get("primary") and e.get("fresh"))) and
+            int(e.get("failed_attempts", 0)) < MAX_RETRIES
+            for i, t in routing.items() for e in t.values())
+        if not completed and not plan and not needs_fill:
+            return
+
+        def update(state: ClusterState) -> ClusterState:
+            new = state.updated()
+            r = new.data.setdefault("routing", {})
+            meta = new.metadata["indices"]
+            for index, sid_s in completed:
+                entry = r.get(index, {}).get(sid_s)
+                if entry is None or not entry.get("relocating_to"):
+                    continue
+                tgt = entry.pop("relocating_to")
+                kind = entry.pop("relocating_kind", "replica")
+                src = entry.pop("relocating_from", None)
+                if kind == "primary":
+                    if tgt in entry.get("replicas", ()):
+                        entry["replicas"].remove(tgt)
+                    entry["primary"] = tgt
+                    m = meta.get(index)
+                    if m is not None:
+                        m["primary_term"] = \
+                            int(m.get("primary_term", 1)) + 1
+                else:
+                    if src in entry.get("replicas", ()):
+                        entry["replicas"].remove(src)
+            actx = AllocationContext(
+                live, r, meta, node_attrs=self.node_attrs,
+                disk_used=dict(self._disk_used))
+            allocator.allocate_unassigned(actx)
+            for mv in plan:
+                entry = r.get(mv["index"], {}).get(str(mv["sid"]))
+                if entry is None or entry.get("relocating_to"):
+                    continue
+                if mv["to"] in entry.get("replicas", ()) or \
+                        entry.get("primary") == mv["to"]:
+                    continue
+                entry.setdefault("replicas", []).append(mv["to"])
+                entry["relocating_to"] = mv["to"]
+                entry["relocating_kind"] = mv["kind"]
+                entry["relocating_from"] = mv["from"]
+            return new
+
+        try:
+            self._submit_and_wait(update, timeout=5.0)
+        except (NotLeaderError, TimeoutError):
+            pass
+        except Exception:   # noqa: BLE001 — next tick retries
+            pass
 
     def _fail_over_dead_nodes(self, dead: set) -> None:
         """Promote in-sync replicas of every shard primaried on a dead
@@ -722,15 +927,24 @@ class ClusterNode:
         t = self.transport
         nid = self.node_id
 
-        def on_worker(handler):
+        def on_worker(handler, pool=None):
             # transport awaits the returned Future without blocking
-            return lambda src, payload: self._data_pool.submit(
-                handler, src, payload)
+            pool = pool or self._data_pool
+            return lambda src, payload: pool.submit(handler, src, payload)
 
-        t.register(nid, "ping", lambda s, p: {"ok": True})
-        t.register(nid, "meta:op", on_worker(self.rest.h_meta_op))
+        def on_replica(handler):
+            return on_worker(handler, self._replica_pool)
+
+        def on_meta(handler):
+            return on_worker(handler, self._meta_pool)
+
+        t.register(nid, "ping", lambda s, p: {
+            "ok": True, "disk_used_frac": _disk_used_frac(self.data_path)})
+        t.register(nid, "shard:insync", on_worker(self._h_shard_insync))
+        t.register(nid, "alloc:reroute", on_worker(self._h_alloc_reroute))
+        t.register(nid, "meta:op", on_meta(self.rest.h_meta_op))
         t.register(nid, "meta:history",
-                   on_worker(self.rest.h_meta_history))
+                   on_meta(self.rest.h_meta_history))
         t.register(nid, "rest:exec", on_worker(self.rest.h_rest_exec))
         t.register(nid, "doc2:index", on_worker(self.rest.h_doc2_index))
         t.register(nid, "doc2:delete", on_worker(self.rest.h_doc2_delete))
@@ -743,14 +957,15 @@ class ClusterNode:
         t.register(nid, "shard:refresh", on_worker(self._h_refresh))
         t.register(nid, "search:shards", on_worker(self._h_search_shards))
         t.register(nid, "search:stats", on_worker(self._h_search_stats))
-        t.register(nid, "replica:index", on_worker(self._h_replica_index))
-        t.register(nid, "replica:delete", on_worker(self._h_replica_delete))
+        t.register(nid, "replica:index", on_replica(self._h_replica_index))
+        t.register(nid, "replica:delete",
+                   on_replica(self._h_replica_delete))
         t.register(nid, "replica:translog_op",
-                   on_worker(self._h_replica_translog))
+                   on_replica(self._h_replica_translog))
         t.register(nid, "replica:checkpoint",
-                   on_worker(self._h_replica_checkpoint))
+                   on_replica(self._h_replica_checkpoint))
         t.register(nid, "replica:sync_gcp",
-                   on_worker(self._h_replica_sync_gcp))
+                   on_replica(self._h_replica_sync_gcp))
 
     def _primary(self, payload) -> PrimaryShardGroup:
         key = (payload["index"], int(payload["shard"]))
@@ -927,3 +1142,32 @@ class ClusterNode:
         r = self._replica(payload)
         r._update_gcp(payload["gcp"])
         return {"ok": True}
+
+    def _h_alloc_reroute(self, src, payload):
+        if payload.get("retry_failed"):
+            def update(st):
+                new = st.updated()
+                for table in new.data.get("routing", {}).values():
+                    for entry in table.values():
+                        entry.pop("failed_attempts", None)
+                return new
+            self._submit_and_wait(update)
+        self._allocation_round()
+        return {"acknowledged": True}
+
+    def _h_shard_insync(self, src, payload):
+        g = self.primaries.get((payload["index"], int(payload["shard"])))
+        return {"in_sync": g is not None and
+                payload["aid"] in g.tracker.in_sync_allocation_ids()}
+
+
+def _disk_used_frac(path: str) -> float:
+    """Used fraction of the filesystem holding ``path`` (the reference's
+    FsInfo probe feeding DiskThresholdDecider)."""
+    try:
+        sv = os.statvfs(path)
+        total = sv.f_blocks * sv.f_frsize
+        free = sv.f_bavail * sv.f_frsize
+        return 1.0 - (free / total) if total else 0.0
+    except OSError:
+        return 0.0

@@ -229,6 +229,38 @@ class ClusterHooks:
             q["query"] = body["query"]
         return node.search(index, q)["total"]
 
+    def agg_partials(self, index: str, body: dict):
+        """Aggregation partials for one cluster-routed index, collected on
+        the owning nodes and shipped for ONE shared reduce (the cross-
+        index agg path). None → index is locally complete, collect here."""
+        node = self.rest.node
+        st = node.applied_state
+        table = (st.data.get("routing", {}) if st else {}).get(index)
+        if not table:
+            return None
+        owners = {e["primary"] for e in table.values()}
+        if owners == {node.node_id}:
+            return None
+        import base64
+        import pickle
+        by_node: Dict[str, List[int]] = {}
+        for sid_s, entry in table.items():
+            by_node.setdefault(entry["primary"], []).append(int(sid_s))
+        shard_body = {"size": 0,
+                      "aggs": body.get("aggs") or body.get("aggregations")}
+        if body.get("query"):
+            shard_body["query"] = body["query"]
+        partials: Dict[str, list] = {}
+        for owner in sorted(by_node):
+            r = node.rpc(owner, "search:shards", {
+                "index": index, "shards": by_node[owner],
+                "body": shard_body, "want_agg_partials": True},
+                timeout=10.0)
+            got = pickle.loads(base64.b64decode(r.get("agg_partials", "")))
+            for name_, parts in got.items():
+                partials.setdefault(name_, []).extend(parts)
+        return partials
+
     def doc_visible(self, index: str, shard: int, doc_id: str):
         """Non-realtime GET visibility against the OWNING copy's searchable
         segments (None → not cluster-routed, caller scans locally)."""
@@ -311,6 +343,10 @@ class ClusterRestService:
         #: seqs this node executed as master before publication (replay
         #: must not re-execute them when they arrive out of order)
         self._self_executed: set = set()
+        #: master-side idempotency cache: a client that timed out and
+        #: retried a non-idempotent op (index create...) must get the
+        #: FIRST execution's response, not a duplicate execution
+        self._op_cache: Dict[str, dict] = {}
 
     # ------------------------------------------------------------------
     # op-log application (every node, on the data worker)
@@ -380,6 +416,10 @@ class ClusterRestService:
             return self._health(query)
         if path == "/_cluster/state" or path.startswith("/_cluster/state"):
             return self._cluster_state()
+        if path.startswith("/_cluster/allocation/explain"):
+            return self._alloc_explain(body)
+        if path.startswith("/_cluster/reroute") and method == "POST":
+            return self._reroute(query)
         if self._is_meta_mutation(method, path, segs):
             return self._meta_op(method, path, query, body)
         if segs and segs[-1].split("?")[0] in _BROADCAST_SUFFIXES \
@@ -456,8 +496,10 @@ class ClusterRestService:
     # ------------------------------------------------------------------
 
     def _meta_op(self, method, path, query, body):
+        import uuid
         node = self.node
-        payload = {"m": method, "p": path, "q": query, "b": _b64(body)}
+        payload = {"m": method, "p": path, "q": query, "b": _b64(body),
+                   "op_id": uuid.uuid4().hex}
         deadline = time.monotonic() + 10.0
         resp = None
         last: Optional[Exception] = None
@@ -496,6 +538,9 @@ class ClusterRestService:
     # master side (registered as "meta:op" on every node; only the master
     # receives it in practice)
     def h_meta_op(self, src, payload) -> dict:
+        op_id = payload.get("op_id")
+        if op_id and op_id in self._op_cache:
+            return self._op_cache[op_id]
         # a freshly-elected master may hold unapplied ops from the previous
         # term: catch its local service up BEFORE executing the new op, or
         # its replay would be permanently cancelled by the seq bump below
@@ -518,7 +563,14 @@ class ClusterRestService:
                     # non-contiguous (ops raced in): mark this seq as
                     # already executed so replay skips it
                     self._self_executed.add(seq)
-        return {"status": status, "ct": ct, "out": _b64(out), "seq": seq}
+        resp = {"status": status, "ct": ct, "out": _b64(out), "seq": seq}
+        if op_id:
+            while len(self._op_cache) > 512:
+                # evict oldest only (insertion order): a full clear would
+                # drop entries an in-flight client retry still needs
+                self._op_cache.pop(next(iter(self._op_cache)))
+            self._op_cache[op_id] = resp
+        return resp
 
     def h_meta_history(self, src, payload) -> dict:
         lo, hi = int(payload["from"]), int(payload["to"])
@@ -527,6 +579,10 @@ class ClusterRestService:
 
     def _publish_op(self, entry: dict) -> int:
         box: Dict[str, int] = {}
+        # liveness resolves HERE (worker thread) — the update function
+        # below runs on the transport loop, which must never block on its
+        # own ping responses
+        live = sorted(self.node.live_nodes())
 
         def update(st):
             new = st.updated()
@@ -538,14 +594,14 @@ class ClusterRestService:
             new.data["meta_ops"] = log
             box["seq"] = log["seq"]
             box["op"] = op
-            self._sync_index_metadata(new)
+            self._sync_index_metadata(new, live)
             return new
 
         self.node._submit_and_wait(update)
         self.full_log.append(box["op"])
         return box["seq"]
 
-    def _sync_index_metadata(self, new_state) -> None:
+    def _sync_index_metadata(self, new_state, live: List[str]) -> None:
         """Reconcile cluster metadata/routing with the master's local
         service after an op: allocate routing for new indices (round-robin
         primaries + replica fan-out, the round-2 allocator), drop removed
@@ -555,21 +611,25 @@ class ClusterRestService:
             local = {
                 n: (svc.num_shards, svc.num_replicas)
                 for n, svc in self.indices.indices.items()}
+        from ..cluster.allocation import (AllocationContext,
+                                          BalancedAllocator)
         meta = new_state.metadata["indices"]
         routing = new_state.data.setdefault("routing", {})
-        live = sorted(new_state.nodes)
+        node = self.node
+        allocator = BalancedAllocator()
         for n, (shards, replicas) in local.items():
             if n in meta:
                 continue
+            with self.lock:
+                svc = self.indices.indices.get(n)
+                settings = dict(svc.settings) if svc is not None else {}
             meta[n] = {"num_shards": shards, "num_replicas": replicas,
-                       "mappings": {}, "primary_term": 1}
-            table = {}
-            for s in range(shards):
-                owner = live[(hash(n) + s) % len(live)]
-                reps = [live[(hash(n) + s + 1 + r) % len(live)]
-                        for r in range(min(replicas, len(live) - 1))]
-                table[str(s)] = {"primary": owner, "replicas": reps}
-            routing[n] = table
+                       "mappings": {}, "primary_term": 1,
+                       "settings": settings}
+            ctx = AllocationContext(
+                live, routing, meta, node_attrs=node.node_attrs,
+                disk_used=dict(getattr(node, "_disk_used", {})))
+            allocator.allocate_index(n, shards, replicas, ctx)
         for n in list(meta):
             if n not in local:
                 del meta[n]
@@ -800,6 +860,61 @@ class ClusterRestService:
             "task_max_waiting_in_queue_millis": 0,
             "active_shards_percent_as_number": 100.0,
         }
+
+    def _alloc_explain(self, body: bytes):
+        """GET /_cluster/allocation/explain — per-node decider verdicts
+        (``ClusterAllocationExplainAction``)."""
+        from ..cluster.allocation import AllocationContext, explain
+        node = self.node
+        st = node.applied_state
+        if st is None:
+            raise _errors.ElasticsearchError("no cluster state")
+        routing = st.data.get("routing", {})
+        spec = {}
+        try:
+            spec = json.loads(body or b"{}") or {}
+        except ValueError:
+            pass
+        index, sid = spec.get("index"), spec.get("shard")
+        if index is None:
+            # default: the first unassigned shard, like the reference
+            for iname, table in sorted(routing.items()):
+                for sid_s, entry in sorted(table.items()):
+                    if not entry.get("primary"):
+                        index, sid = iname, int(sid_s)
+                        break
+                if index is not None:
+                    break
+        if index is None:
+            raise _errors.IllegalArgumentError(
+                "unable to find any unassigned shards to explain "
+                "(pass index and shard)")
+        live = sorted(node.live_nodes())
+        ctx = AllocationContext(
+            live, routing, st.metadata["indices"],
+            node_attrs=node.node_attrs,
+            disk_used=dict(getattr(node, "_disk_used", {})))
+        doc = explain(index, int(sid or 0), ctx)
+        return 200, "application/json", json.dumps(doc).encode()
+
+    def _reroute(self, query: str):
+        """POST /_cluster/reroute[?retry_failed=true] — clears max-retry
+        counters and triggers an allocation round on the master."""
+        retry = "retry_failed=true" in (query or "")
+        node = self.node
+
+        leader = node.node_loop.sync(lambda: node.coordinator.known_leader)
+        if leader == node.node_id:
+            out = node._h_alloc_reroute(None, {"retry_failed": retry})
+        elif leader is not None:
+            # single long-timeout RPC, no retry: a reroute is not
+            # idempotent-cheap (each execution re-clears counters and
+            # queues an allocation round)
+            out = node.rpc(leader, "alloc:reroute",
+                           {"retry_failed": retry}, timeout=20.0)
+        else:
+            raise _errors.ElasticsearchError("no known master")
+        return 200, "application/json", json.dumps(out).encode()
 
     def _cluster_state(self):
         st = self.node.applied_state

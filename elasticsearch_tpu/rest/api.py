@@ -3932,8 +3932,22 @@ class RestAPI:
         spec = search_body.get("aggs") or search_body.get("aggregations")
         aggs = parse_aggs(spec)
         ctx_seg_masks = []
+        extra_partials: dict = {}
         for n in names:
             svc = self.indices.indices[n]
+            if svc.cluster_hooks is not None:
+                # cluster-routed index: the owning nodes collect partials
+                # and ship them into this one shared reduce
+                remote = svc.cluster_hooks.agg_partials(n, search_body)
+                if remote is not None:
+                    for name_, parts in remote.items():
+                        extra_partials.setdefault(name_, []).extend(parts)
+                    # reduce-side rendering (key_as_string...) reads the
+                    # mapper captured at collect time; remote partials
+                    # never collected here, so prime from the replicated
+                    # local mapping
+                    _prime_agg_mappers(aggs, svc.mapper)
+                    continue
             searcher = svc.searcher()
             # per-index context: sub-queries and field-type decisions must
             # see THIS index's mapping and term statistics
@@ -3944,7 +3958,8 @@ class RestAPI:
                 _, mask = q.execute(searcher.ctx, seg)
                 mask = mask & seg.live_dev
                 ctx_seg_masks.append((ctx, seg, np.asarray(mask)))
-        return run_aggregations_multi(aggs, ctx_seg_masks)
+        return run_aggregations_multi(aggs, ctx_seg_masks,
+                                      extra_partials=extra_partials)
 
     def _rewrite_terms_lookup(self, node):
         """Coordinator-side rewrite of terms-lookup clauses
@@ -5581,3 +5596,14 @@ def _segment_file_sizes(shards) -> Dict[str, dict]:
                 e["size_in_bytes"] += sz
                 e["count"] += 1
     return sizes
+
+
+def _prime_agg_mappers(aggs: dict, mapper) -> None:
+    """Recursively hand agg instances a mapper for reduce-side rendering
+    when their collect phase ran on a REMOTE node (cluster agg partials)."""
+    for a in aggs.values():
+        if getattr(a, "_mapper", None) is None:
+            a._mapper = mapper
+        subs = getattr(a, "subs", None)
+        if subs:
+            _prime_agg_mappers(subs, mapper)

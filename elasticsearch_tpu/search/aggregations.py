@@ -258,11 +258,14 @@ def _check_max_buckets(result: dict) -> None:
 def run_aggregations_multi(
         aggs: Dict[str, Aggregator],
         ctx_seg_masks: List[Tuple[AggregationContext, Segment, np.ndarray]],
+        extra_partials: Optional[Dict[str, list]] = None,
 ) -> dict:
     """Cross-index entry point: each segment collects under its *own*
     index's context (mapper + term stats), then one shared reduce — the
     reference reduces per-shard trees the same way
-    (``SearchPhaseController.java:211-219``)."""
+    (``SearchPhaseController.java:211-219``). ``extra_partials`` carries
+    already-collected partials from REMOTE shards (the cluster tier) into
+    the same reduce."""
     result: Dict[str, dict] = {}
     pipelines: Dict[str, PipelineAggregator] = {}
     for name, agg in aggs.items():
@@ -271,6 +274,7 @@ def run_aggregations_multi(
             continue
         partials = [agg.collect(ctx, seg, mask)
                     for ctx, seg, mask in ctx_seg_masks]
+        partials.extend((extra_partials or {}).get(name, ()))
         result[name] = agg.reduce(partials)
         _apply_parent_pipes(agg, result[name])
         if getattr(agg, "meta", None) is not None:

@@ -32,3 +32,8 @@ import pytest
 def _seed_numpy():
     np.random.seed(42)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running multi-node integration tests")

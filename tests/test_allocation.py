@@ -1,0 +1,221 @@
+"""Balanced shard allocation + deciders + rebalance (VERDICT r2 next #4).
+
+Unit tier: deciders and the weight-driven allocator over synthetic routing
+tables. Integration tier: a late-started 4th node receives shards via
+staged relocation; awareness keeps copies across zones."""
+
+import json
+import os
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.allocation import (
+    AllocationContext, AwarenessDecider, BalancedAllocator, DiskThresholdDecider,
+    FilterDecider, MaxRetryDecider, SameShardDecider, decide, explain)
+
+NODES = ["n0", "n1", "n2"]
+
+
+def ctx_with(routing=None, meta=None, **kw):
+    return AllocationContext(kw.pop("nodes", NODES), routing or {},
+                             meta or {}, **kw)
+
+
+# ---------------------------------------------------------------------------
+# deciders
+# ---------------------------------------------------------------------------
+
+
+def test_same_shard_decider():
+    ctx = ctx_with({"i": {"0": {"primary": "n0", "replicas": ["n1"]}}})
+    d = SameShardDecider()
+    assert d.can_allocate("i", 0, "n0", ctx).verdict == "NO"
+    assert d.can_allocate("i", 0, "n1", ctx).verdict == "NO"
+    assert d.can_allocate("i", 0, "n2", ctx).verdict == "YES"
+
+
+def test_filter_decider_require_exclude():
+    meta = {"i": {"settings": {
+        "index.routing.allocation.require._name": "n1"}}}
+    d = FilterDecider()
+    ctx = ctx_with({}, meta)
+    assert d.can_allocate("i", 0, "n0", ctx).verdict == "NO"
+    assert d.can_allocate("i", 0, "n1", ctx).verdict == "YES"
+    meta2 = {"i": {"settings": {
+        "index.routing.allocation.exclude.zone": "z1"}}}
+    ctx2 = ctx_with({}, meta2,
+                    node_attrs={"n0": {"zone": "z1"}, "n1": {"zone": "z2"}})
+    assert d.can_allocate("i", 0, "n0", ctx2).verdict == "NO"
+    assert d.can_allocate("i", 0, "n1", ctx2).verdict == "YES"
+
+
+def test_awareness_decider_spreads_zones():
+    attrs = {"n0": {"zone": "a"}, "n1": {"zone": "a"}, "n2": {"zone": "b"}}
+    d = AwarenessDecider()
+    # primary already in zone a -> the replica must go to zone b
+    ctx = ctx_with({"i": {"0": {"primary": "n0", "replicas": []}}},
+                   node_attrs=attrs)
+    assert d.can_allocate("i", 0, "n1", ctx).verdict == "NO"
+    assert d.can_allocate("i", 0, "n2", ctx).verdict == "YES"
+
+
+def test_disk_threshold_decider():
+    d = DiskThresholdDecider()
+    ctx = ctx_with({}, disk_used={"n0": 0.95, "n1": 0.30})
+    assert d.can_allocate("i", 0, "n0", ctx).verdict == "NO"
+    assert d.can_allocate("i", 0, "n1", ctx).verdict == "YES"
+    assert d.can_allocate("i", 0, "n2", ctx).verdict == "YES"  # unknown
+
+
+def test_max_retry_decider_and_explain():
+    routing = {"i": {"0": {"primary": None, "replicas": [],
+                           "failed_attempts": 5}}}
+    ctx = ctx_with(routing)
+    assert MaxRetryDecider().can_allocate("i", 0, "n0", ctx).verdict == "NO"
+    doc = explain("i", 0, ctx)
+    assert doc["can_allocate"] == "no"
+    assert all(n["node_decision"] == "no"
+               for n in doc["node_allocation_decisions"])
+    reasons = [d["decider"] for n in doc["node_allocation_decisions"]
+               for d in n["deciders"]]
+    assert "max_retry" in reasons
+
+
+# ---------------------------------------------------------------------------
+# balanced allocator
+# ---------------------------------------------------------------------------
+
+
+def test_allocate_index_balances_across_nodes():
+    ctx = ctx_with({})
+    alloc = BalancedAllocator()
+    alloc.allocate_index("a", 3, 0, ctx)
+    alloc.allocate_index("b", 3, 0, ctx)
+    per_node = {}
+    for table in ctx.routing.values():
+        for e in table.values():
+            per_node[e["primary"]] = per_node.get(e["primary"], 0) + 1
+    assert sorted(per_node.values()) == [2, 2, 2], per_node
+
+
+def test_allocate_unassigned_fills_missing_replicas():
+    routing = {"i": {"0": {"primary": "n0", "replicas": []}}}
+    meta = {"i": {"num_replicas": 1}}
+    ctx = ctx_with(routing, meta)
+    placed = BalancedAllocator().allocate_unassigned(ctx)
+    assert placed == 1
+    assert routing["i"]["0"]["replicas"], routing
+
+
+def test_plan_rebalance_moves_to_empty_node():
+    # everything piled on n0 -> moves toward n1/n2 proposed
+    routing = {"i": {str(s): {"primary": "n0", "replicas": []}
+                     for s in range(4)}}
+    ctx = ctx_with(routing, {"i": {"num_replicas": 0}})
+    moves = BalancedAllocator().plan_rebalance(ctx)
+    assert moves, "expected rebalance moves"
+    assert all(m["from"] == "n0" and m["to"] in ("n1", "n2")
+               for m in moves)
+
+
+# ---------------------------------------------------------------------------
+# integration: late-joining node receives shards; awareness spreads zones
+# ---------------------------------------------------------------------------
+
+BASE_PORT = 29940
+
+
+@pytest.mark.slow
+def test_late_node_join_triggers_rebalance(tmp_path):
+    from elasticsearch_tpu.node.cluster_node import ClusterNode
+    peers = {f"n{i}": ("127.0.0.1", BASE_PORT + i) for i in range(4)}
+    nodes = [ClusterNode(f"n{i}", "127.0.0.1", BASE_PORT + i, peers,
+                         str(tmp_path / f"n{i}"), seed=i)
+             for i in range(3)]                    # n3 NOT started yet
+    late = None
+    try:
+        deadline = time.monotonic() + 15
+        leader = None
+        while leader is None and time.monotonic() < deadline:
+            ls = [n for n in nodes if n.coordinator.mode == "LEADER"]
+            if len(ls) == 1:
+                leader = ls[0]
+            time.sleep(0.05)
+        assert leader is not None
+        client = nodes[0]
+        st, _, out = client.rest.handle("PUT", "/r", "", json.dumps({
+            "settings": {"number_of_shards": 6, "number_of_replicas": 0},
+            "mappings": {"properties": {"v": {"type": "long"}}}}).encode())
+        assert st == 200, out
+        for i in range(30):
+            client.rest.handle("PUT", f"/r/_doc/{i}", "",
+                               json.dumps({"v": i}).encode())
+        client.rest.handle("POST", "/r/_refresh", "", b"")
+
+        # join the 4th node: the allocator must MOVE shards onto it and
+        # the data must survive the relocation
+        late = ClusterNode("n3", "127.0.0.1", BASE_PORT + 3, peers,
+                           str(tmp_path / "n3"), seed=3)
+        deadline = time.monotonic() + 40
+        moved = False
+        while time.monotonic() < deadline:
+            stt = client.node_loop.sync(
+                lambda: client.coordinator.applied)
+            table = stt.data.get("routing", {}).get("r", {})
+            owners = {e["primary"] for e in table.values()}
+            if "n3" in owners and not any(
+                    e.get("relocating_to") for e in table.values()):
+                moved = True
+                break
+            time.sleep(0.3)
+        assert moved, f"no shard moved to n3: {table}"
+        st, _, out = client.rest.handle(
+            "POST", "/r/_search", "",
+            json.dumps({"size": 0, "track_total_hits": True}).encode())
+        assert json.loads(out)["hits"]["total"]["value"] == 30
+    finally:
+        for n in nodes + ([late] if late else []):
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+
+@pytest.mark.slow
+def test_awareness_keeps_copies_in_distinct_zones(tmp_path):
+    from elasticsearch_tpu.node.cluster_node import ClusterNode
+    base = BASE_PORT + 10
+    attrs = {"n0": {"zone": "a"}, "n1": {"zone": "a"},
+             "n2": {"zone": "b"}, "n3": {"zone": "b"}}
+    peers = {f"n{i}": ("127.0.0.1", base + i) for i in range(4)}
+    nodes = [ClusterNode(f"n{i}", "127.0.0.1", base + i, peers,
+                         str(tmp_path / f"n{i}"), seed=i, node_attrs=attrs)
+             for i in range(4)]
+    try:
+        deadline = time.monotonic() + 15
+        leader = None
+        while leader is None and time.monotonic() < deadline:
+            ls = [n for n in nodes if n.coordinator.mode == "LEADER"]
+            if len(ls) == 1:
+                leader = ls[0]
+            time.sleep(0.05)
+        assert leader is not None
+        client = nodes[0]
+        st, _, out = client.rest.handle("PUT", "/az", "", json.dumps({
+            "settings": {"number_of_shards": 4,
+                         "number_of_replicas": 1}}).encode())
+        assert st == 200, out
+        stt = client.node_loop.sync(lambda: client.coordinator.applied)
+        table = stt.data.get("routing", {}).get("az", {})
+        zone = lambda n: attrs[n]["zone"]   # noqa: E731
+        for sid, entry in table.items():
+            copies = [entry["primary"]] + entry["replicas"]
+            assert len(copies) == 2, (sid, entry)
+            assert zone(copies[0]) != zone(copies[1]), (sid, entry)
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
