@@ -144,14 +144,17 @@ def evaluate_expression_vec(source: str, params: Dict[str, Any]):
     return ev(tree)
 
 
-def evaluate_expression(source: str, params: Dict[str, float]) -> float:
+def evaluate_expression(source: str, params: Dict[str, float],
+                        allow_strings: bool = False) -> float:
     tree = compile_expression(source)
 
     def ev(node):
         if isinstance(node, ast.Expression):
             return ev(node.body)
         if isinstance(node, ast.Constant):
-            if not isinstance(node.value, (int, float, bool)):
+            ok_types = (int, float, bool, str) if allow_strings \
+                else (int, float, bool)
+            if not isinstance(node.value, ok_types):
                 raise ScriptException(f"non-numeric constant [{node.value}]")
             return node.value
         if isinstance(node, ast.Name):
@@ -209,3 +212,49 @@ def evaluate_expression(source: str, params: Dict[str, float]) -> float:
             f"unsupported node [{type(node).__name__}]")  # pragma: no cover
 
     return ev(tree)
+
+
+# ---------------------------------------------------------------------------
+# per-doc scripts: doc['field'].value access (script_fields, script sort,
+# scripted_metric map scripts — reference: Painless doc-values API)
+# ---------------------------------------------------------------------------
+
+import re as _re
+
+_DOC_RE = _re.compile(r"doc\[['\"]([^'\"]+)['\"]\]\.(value|size\(\))")
+
+
+def compile_doc_expression(source: str):
+    """Rewrite ``doc['f'].value`` / ``doc['f'].size()`` into synthetic
+    variables; returns (cleaned_source, ordered field list). The cleaned
+    source must pass :func:`compile_expression`."""
+    fields: list = []
+
+    def sub(m):
+        f, attr = m.group(1), m.group(2)
+        if f not in fields:
+            fields.append(f)
+        i = fields.index(f)
+        return f"__doc{i}" if attr == "value" else f"__size{i}"
+
+    cleaned = _DOC_RE.sub(sub, source)
+    compile_expression(cleaned)
+    return cleaned, fields
+
+
+def evaluate_doc_expression(cleaned: str, fields, params: Dict[str, Any],
+                            field_values: Dict[str, Any]):
+    """Evaluate a compiled doc expression for ONE document.
+
+    ``field_values``: field -> first value (None when absent; strings
+    allowed — equality/comparison work, arithmetic on strings raises a
+    ScriptException like Painless's class-cast errors)."""
+    env = dict(params)
+    for i, f in enumerate(fields):
+        v = field_values.get(f)
+        env[f"__doc{i}"] = 0 if v is None else v
+        env[f"__size{i}"] = 0 if v is None else 1
+    try:
+        return evaluate_expression(cleaned, env, allow_strings=True)
+    except TypeError as e:
+        raise ScriptException(f"runtime error in script: {e}")

@@ -1,0 +1,126 @@
+"""Mustache-lite renderer for search templates.
+
+The reference embeds full Mustache via ``modules/lang-mustache``
+(``MustacheScriptEngine.java:53``) for ``_search/template`` /
+``_render/template``. Search templates overwhelmingly use a small core,
+implemented here without a dependency:
+
+- ``{{var}}`` / ``{{a.b}}`` — variable substitution (dotted paths);
+  strings insert raw (the template supplies its own quotes), other JSON
+  values insert as JSON.
+- ``{{#toJson}}var{{/toJson}}`` — JSON-encode a parameter.
+- ``{{#join}}var{{/join}}`` — comma-join a list parameter.
+- ``{{#section}}...{{/section}}`` — truthy gate; lists iterate with
+  ``{{.}}`` bound to the item and dotted lookups falling through to the
+  item when it is an object.
+- ``{{^section}}...{{/section}}`` — inverted (renders when falsy/absent).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any
+
+_TAG = re.compile(r"\{\{\s*([#^/]?)\s*([^}]*?)\s*\}\}")
+
+
+def _lookup(params, path: str):
+    if path == ".":
+        return params
+    cur = params
+    for part in path.split("."):
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        else:
+            return None
+    return cur
+
+
+def _stringify(v: Any) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, str):
+        return v
+    if isinstance(v, (int, float)):
+        return json.dumps(v)
+    return json.dumps(v)
+
+
+def render_mustache(template: str, params: dict) -> str:
+    out, _ = _render(template, 0, params, None)
+    return out
+
+
+def _render(src: str, pos: int, params, stop_tag):
+    out = []
+    while pos < len(src):
+        m = _TAG.search(src, pos)
+        if m is None:
+            out.append(src[pos:])
+            return "".join(out), len(src)
+        out.append(src[pos:m.start()])
+        sigil, name = m.group(1), m.group(2)
+        pos = m.end()
+        if sigil == "/":
+            if stop_tag is not None and name == stop_tag:
+                return "".join(out), pos
+            continue                      # stray close: drop
+        if sigil in ("#", "^"):
+            body_start = pos
+            # find the matching close (nesting-aware)
+            depth = 1
+            scan = pos
+            close_at = len(src)
+            pos = len(src)
+            while True:
+                m2 = _TAG.search(src, scan)
+                if m2 is None:
+                    break
+                if m2.group(1) in ("#", "^"):
+                    depth += 1
+                elif m2.group(1) == "/":
+                    depth -= 1
+                    if depth == 0:
+                        close_at = m2.start()
+                        pos = m2.end()
+                        break
+                scan = m2.end()
+            body = src[body_start:close_at]
+            if sigil == "#" and name == "toJson":
+                v = _lookup(params, body.strip())
+                out.append(json.dumps(v))
+                continue
+            if sigil == "#" and name == "join":
+                v = _lookup(params, body.strip())
+                out.append(",".join(_stringify(x)
+                                    for x in (v or [])))
+                continue
+            v = _lookup(params, name)
+            truthy = bool(v) and v != []
+            if sigil == "^":
+                if not truthy:
+                    rendered, _ = _render(body, 0, params, None)
+                    out.append(rendered)
+                continue
+            if not truthy:
+                continue
+            if isinstance(v, list):
+                for item in v:
+                    scope = dict(params, **item) \
+                        if isinstance(item, dict) else dict(params)
+                    if not isinstance(item, dict):
+                        scope = {**params, ".": item}
+                    rendered, _ = _render(body, 0, scope, None)
+                    out.append(rendered)
+            else:
+                scope = dict(params, **v) if isinstance(v, dict) \
+                    else params
+                rendered, _ = _render(body, 0, scope, None)
+                out.append(rendered)
+            continue
+        # plain variable
+        out.append(_stringify(_lookup(params, name)))
+    return "".join(out), pos
