@@ -1,0 +1,89 @@
+"""Data-only wire codec for structured intra-cluster payloads.
+
+The reference never ships native object serialization between nodes: every
+message is a versioned, hand-rolled structured format
+(``common/io/stream/StreamInput.java`` — data in, data out, no code).
+Aggregation partials here are arbitrary nested Python data (dicts with
+non-string keys, tuples, numpy arrays); ``pickle`` would round-trip them
+but gives any peer that can reach the transport port arbitrary code
+execution. This codec covers exactly the closed set of data shapes the
+aggregators produce and nothing else — decoding cannot instantiate
+arbitrary classes.
+
+Encoding: every container is a tagged JSON array ``[tag, payload...]``;
+plain scalars (None/bool/int/float/str) encode as themselves. Since no
+aggregator partial contains a *bare* JSON array or object (they all pass
+through :func:`encode`), decoding is unambiguous.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any
+
+import numpy as np
+
+__all__ = ["dumps_b64", "loads_b64", "encode", "decode"]
+
+
+def encode(o: Any):
+    if o is None or isinstance(o, (bool, int, str)):
+        return o
+    if isinstance(o, float):
+        return o                           # Python json handles nan/inf
+    if isinstance(o, np.generic):
+        return encode(o.item())
+    if isinstance(o, dict):
+        return ["D", [[encode(k), encode(v)] for k, v in o.items()]]
+    if isinstance(o, list):
+        return ["L", [encode(x) for x in o]]
+    if isinstance(o, tuple):
+        return ["T", [encode(x) for x in o]]
+    if isinstance(o, (set, frozenset)):
+        return ["S", [encode(x) for x in sorted(o, key=repr)]]
+    if isinstance(o, (bytes, bytearray)):
+        return ["B", base64.b64encode(bytes(o)).decode()]
+    if isinstance(o, np.ndarray):
+        c = np.ascontiguousarray(o)
+        return ["A", str(c.dtype), list(c.shape),
+                base64.b64encode(c.tobytes()).decode()]
+    raise TypeError(
+        f"not wire-encodable (data-only codec): {type(o).__name__}")
+
+
+def decode(o: Any):
+    if o is None or isinstance(o, (bool, int, float, str)):
+        return o
+    if isinstance(o, list) and o and isinstance(o[0], str):
+        tag = o[0]
+        if tag == "D":
+            out = {}
+            for k, v in o[1]:
+                key = decode(k)
+                if isinstance(key, list):
+                    key = tuple(key)       # dict keys must be hashable
+                out[key] = decode(v)
+            return out
+        if tag == "L":
+            return [decode(x) for x in o[1]]
+        if tag == "T":
+            return tuple(decode(x) for x in o[1])
+        if tag == "S":
+            return {decode(x) for x in o[1]}
+        if tag == "B":
+            return base64.b64decode(o[1])
+        if tag == "A":
+            _, dtype, shape, b = o
+            return np.frombuffer(
+                base64.b64decode(b), dtype=np.dtype(dtype)).reshape(shape)
+    raise ValueError("malformed data-codec payload")
+
+
+def dumps_b64(o: Any) -> str:
+    return base64.b64encode(
+        json.dumps(encode(o), allow_nan=True).encode()).decode()
+
+
+def loads_b64(s: str):
+    return decode(json.loads(base64.b64decode(s or "") or b"null"))

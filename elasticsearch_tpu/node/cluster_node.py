@@ -17,10 +17,10 @@ metadata + routing). The data plane on top:
   RPC-backed replica channels (phase 2) with primary-term fencing intact.
 - **Search** scatters to one node per shard copy and merges exactly: hits
   through the coordinator comparator, aggregation PARTIALS (not reduced
-  per node) shipped pickled and reduced once — the same exactness
-  contract as ``search/dist_query.py``. Pickle is a trusted-cluster wire
-  format (the reference uses its own binary StreamOutput; swapping the
-  codec is a transport-layer concern).
+  per node) shipped over the data-only wire codec
+  (``common/datacodec.py`` — the reference's ``StreamOutput`` analog:
+  structured data, never native object serialization) and reduced once —
+  the same exactness contract as ``search/dist_query.py``.
 - **Failure handling**: the elected master watches data nodes through its
   coordinator heartbeats; when a node leaves, it submits a routing update
   promoting in-sync replicas of every shard the dead node primaried
@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import base64
 import os
-import pickle
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -42,6 +41,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..cluster.coordination import Coordinator, NotLeaderError
 from ..cluster.state import ClusterState
+from ..common.datacodec import dumps_b64 as _data64
+from ..common.datacodec import loads_b64 as _undata64
 from ..common.errors import ElasticsearchError, IndexNotFoundError
 from ..index.engine import Engine
 from ..index.mapping import MapperService
@@ -52,14 +53,6 @@ from ..search.shard_search import ShardSearcher, normalize_sort
 from ..transport.tcp import (AsyncTaskQueue, NodeLoop, RemoteTransportError,
                              TcpTransport)
 from ..utils.murmur3 import shard_for as _murmur_shard
-
-
-def _pickle64(obj) -> str:
-    return base64.b64encode(pickle.dumps(obj)).decode()
-
-
-def _unpickle64(s: str):
-    return pickle.loads(base64.b64decode(s))
 
 
 def shard_for(doc_id: str, routing: Optional[str], num_shards: int) -> int:
@@ -855,7 +848,7 @@ class ClusterNode:
         if body.get("aggs"):
             from ..search.aggregations import parse_aggs
             aggs = parse_aggs(body["aggs"])
-            partial_lists = [_unpickle64(r["agg_partials"])
+            partial_lists = [_undata64(r["agg_partials"])
                              for r in results]
             aggs_out = {}
             from ..search.aggregations import PipelineAggregator
@@ -1112,7 +1105,7 @@ class ClusterNode:
                     partials.setdefault(name_, []).extend(
                         agg.collect(ctx, seg, mask)
                         for seg, mask, _ in agg_inputs)
-            out["agg_partials"] = _pickle64(partials)
+            out["agg_partials"] = _data64(partials)
         return out
 
     def _h_replica_index(self, src, payload):

@@ -172,6 +172,14 @@ class ClusterHooks:
         owner = table[str(shard)]["primary"]
         if owner == node.node_id:
             group = node.primaries.get((index, shard))
+            # None (group not wired yet): the caller falls back to the
+            # bare local engine — safe, because the group, when wired,
+            # wraps the SAME engine object (cluster_node._apply_state
+            # step 3), and replica channels are wired in that same pass
+            # with ops-based recovery replaying the translog, so a write
+            # landing before wiring still reaches every copy. Waiting
+            # here would deadlock: this runs under rest.lock, which the
+            # data worker needs (apply_ops) to do the wiring.
             return LocalGroupWriter(group) if group is not None else None
         return RemoteShardProxy(node, owner, index, shard)
 
@@ -241,8 +249,7 @@ class ClusterHooks:
         owners = {e["primary"] for e in table.values()}
         if owners == {node.node_id}:
             return None
-        import base64
-        import pickle
+        from ..common.datacodec import loads_b64
         by_node: Dict[str, List[int]] = {}
         for sid_s, entry in table.items():
             by_node.setdefault(entry["primary"], []).append(int(sid_s))
@@ -256,7 +263,7 @@ class ClusterHooks:
                 "index": index, "shards": by_node[owner],
                 "body": shard_body, "want_agg_partials": True},
                 timeout=10.0)
-            got = pickle.loads(base64.b64decode(r.get("agg_partials", "")))
+            got = loads_b64(r.get("agg_partials", ""))
             for name_, parts in got.items():
                 partials.setdefault(name_, []).extend(parts)
         return partials
@@ -334,8 +341,24 @@ class ClusterRestService:
         self.api = RestAPI(self.indices)
         self.lock = threading.RLock()
         self.applied_seq = 0
-        #: master-side full op history (for nodes behind the state tail)
-        self.full_log: List[dict] = []
+        #: op history by seq, maintained on EVERY node as ops apply (not
+        #: just the executing master) so history survives master changes;
+        #: nodes behind the state tail fetch missing ranges from peers.
+        #: Bounded: a node further behind than HISTORY_CAP meta ops is
+        #: declared divergent rather than growing memory without limit.
+        self.full_log: Dict[int, dict] = {}
+        #: first-seen time per missing seq — a gap is only declared
+        #: unrecoverable after GAP_GRACE seconds of failed fetches, so a
+        #: healing partition never causes permanent divergence
+        self._gap_since: Dict[int, float] = {}
+        #: serializes execute→snapshot→publish across the direct-call and
+        #: RPC entry points of h_meta_op (NOT self.lock: this one is never
+        #: needed by the transport loop, so holding it across the blocking
+        #: publish is safe)
+        self._meta_mutex = threading.Lock()
+        #: set when this node skipped an unrecoverable op-log gap — its
+        #: metadata surface may have diverged; surfaced in _cluster_state
+        self.meta_divergent = False
         #: scroll/pit id -> owning node (forwarded stateful reads)
         self._sticky: Dict[str, str] = {}
         #: per-index last-propagated mapping fingerprint
@@ -352,25 +375,51 @@ class ClusterRestService:
     # op-log application (every node, on the data worker)
     # ------------------------------------------------------------------
 
+    #: in-memory op history bound per node (≈ a few MB of meta ops)
+    HISTORY_CAP = 4096
+    #: seconds of failed history fetches before a gap is unrecoverable
+    GAP_GRACE = 20.0
+
     def apply_ops(self, state) -> None:
         log = state.data.get("meta_ops")
         if not log:
             return
         seq = log["seq"]
         tail = log["tail"]
+        if self.applied_seq >= seq:     # racy fast-path; re-checked below
+            return
+        have = {op["seq"]: op for op in tail}
+        missing = [s for s in range(self.applied_seq + 1, seq + 1)
+                   if s not in have]
+        if missing:
+            # network fetch OUTSIDE self.lock: the REST plane (_local)
+            # and op application contend on it, and peers may be slow
+            ops = self._fetch_history(missing[0], missing[-1])
+            have.update({op["seq"]: op for op in ops})
         with self.lock:
-            if self.applied_seq >= seq:
-                return
-            have = {op["seq"]: op for op in tail}
-            missing = [s for s in range(self.applied_seq + 1, seq + 1)
-                       if s not in have]
-            if missing:
-                ops = self._fetch_history(missing[0], missing[-1])
-                have.update({op["seq"]: op for op in ops})
             for s in range(self.applied_seq + 1, seq + 1):
                 op = have.get(s)
                 if op is None:
-                    continue                    # unrecoverable gap: skip
+                    # gap beyond the state tail that no peer served. A
+                    # transient fetch failure (partition healing) must NOT
+                    # advance past the op — stop and retry on the next
+                    # commit; only after GAP_GRACE seconds of failures is
+                    # the gap declared unrecoverable and flagged loudly.
+                    now = time.monotonic()
+                    first = self._gap_since.setdefault(s, now)
+                    if now - first < self.GAP_GRACE:
+                        return
+                    self._gap_since.pop(s, None)
+                    if not self.meta_divergent:
+                        self.meta_divergent = True
+                        import sys
+                        print(f"[{self.node.node_id}] metadata op-log gap "
+                              f"at seq {s} (applied {self.applied_seq}, "
+                              f"target {seq}): local metadata may have "
+                              f"diverged", file=sys.stderr)
+                    self.applied_seq = s
+                    continue
+                self._gap_since.pop(s, None)
                 if op["src"] != self.node.node_id and \
                         s not in self._self_executed:
                     try:
@@ -379,19 +428,43 @@ class ClusterRestService:
                     except Exception:   # noqa: BLE001 — replay best-effort
                         pass
                 self._self_executed.discard(s)
+                self._log_append(op)
                 self.applied_seq = s
 
+    def _log_append(self, op: dict) -> None:
+        self.full_log[op["seq"]] = op
+        while len(self.full_log) > self.HISTORY_CAP:
+            self.full_log.pop(min(self.full_log))
+
     def _fetch_history(self, lo: int, hi: int) -> List[dict]:
-        master = self.node.applied_state.master_node \
-            if self.node.applied_state else None
-        if master is None or master == self.node.node_id:
-            return []
-        try:
-            r = self.node.rpc(master, "meta:history",
-                              {"from": lo, "to": hi}, timeout=5.0)
-            return r.get("ops", [])
-        except Exception:   # noqa: BLE001
-            return []
+        """Fetch an op range beyond the state tail: the master first,
+        then other peers — every node keeps the full log as it applies,
+        so any node that was up for the range can serve it. Bounded by a
+        shared deadline: this runs with rest.lock held on the data
+        worker, so it must not stall the node for O(cluster) × timeout."""
+        st = self.node.applied_state
+        master = st.master_node if st else None
+        candidates = [master] if master else []
+        candidates += [n for n in self.node.node_ids if n != master]
+        got: Dict[int, dict] = {}
+        deadline = time.monotonic() + 6.0
+        for target in candidates:
+            if target == self.node.node_id or target is None:
+                continue
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                break
+            try:
+                r = self.node.rpc(target, "meta:history",
+                                  {"from": lo, "to": hi},
+                                  timeout=min(2.0, budget))
+                for op in r.get("ops", []):
+                    got.setdefault(op["seq"], op)
+            except Exception:   # noqa: BLE001 — try the next peer
+                continue
+            if all(s in got for s in range(lo, hi + 1)):
+                break
+        return list(got.values())
 
     # ------------------------------------------------------------------
     # request entry
@@ -509,15 +582,16 @@ class ClusterRestService:
             if leader is None:
                 time.sleep(0.05)
                 continue
-            if leader == node.node_id:
-                # direct call — an RPC loopback from the data worker would
-                # deadlock behind itself (single-threaded pool)
-                resp = self.h_meta_op(node.node_id, payload)
-                break
             try:
-                resp = node.rpc(leader, "meta:op", payload, timeout=10.0)
-            except Exception as e:   # noqa: BLE001 — retry via new leader
-                last = e
+                if leader == node.node_id:
+                    # direct call — an RPC loopback from the data worker
+                    # would deadlock behind itself (single-threaded pool)
+                    resp = self.h_meta_op(node.node_id, payload)
+                else:
+                    resp = node.rpc(leader, "meta:op", payload,
+                                    timeout=10.0)
+            except Exception as e:   # noqa: BLE001 — catching-up master /
+                last = e              # leader change: retry until deadline
                 time.sleep(0.05)
         if resp is None:
             raise _errors.ElasticsearchError(
@@ -538,6 +612,14 @@ class ClusterRestService:
     # master side (registered as "meta:op" on every node; only the master
     # receives it in practice)
     def h_meta_op(self, src, payload) -> dict:
+        # serialize with the direct-call path (leader == self skips the
+        # RPC and its single-threaded meta pool): without this, op A's
+        # local-service snapshot could interleave with op B's publish and
+        # resurrect a just-deleted index in cluster metadata
+        with self._meta_mutex:
+            return self._h_meta_op_locked(payload)
+
+    def _h_meta_op_locked(self, payload) -> dict:
         op_id = payload.get("op_id")
         if op_id and op_id in self._op_cache:
             return self._op_cache[op_id]
@@ -547,6 +629,16 @@ class ClusterRestService:
         st = self.node.applied_state
         if st is not None:
             self.apply_ops(st)
+            log = st.data.get("meta_ops") or {}
+            if self.applied_seq < int(log.get("seq", 0)):
+                # still behind (op-log gap pending retry): executing now
+                # would publish with a stale local-service snapshot and
+                # _sync_index_metadata would drop every index this node
+                # hasn't caught up to — refuse retryably instead
+                raise _errors.ElasticsearchError(
+                    f"master [{self.node.node_id}] is catching up on "
+                    f"metadata ops ({self.applied_seq}/"
+                    f"{log.get('seq')}); retry")
         method, path = payload["m"], payload["p"]
         query, body = payload["q"], _unb64(payload["b"])
         with self.lock:
@@ -574,15 +666,22 @@ class ClusterRestService:
 
     def h_meta_history(self, src, payload) -> dict:
         lo, hi = int(payload["from"]), int(payload["to"])
-        return {"ops": [op for op in self.full_log
-                        if lo <= op["seq"] <= hi]}
+        return {"ops": [self.full_log[s] for s in range(lo, hi + 1)
+                        if s in self.full_log]}
 
     def _publish_op(self, entry: dict) -> int:
         box: Dict[str, int] = {}
-        # liveness resolves HERE (worker thread) — the update function
-        # below runs on the transport loop, which must never block on its
-        # own ping responses
+        # liveness AND the local-service index snapshot resolve HERE
+        # (worker thread) — the update function below runs on the
+        # transport loop, which must never block on its own ping
+        # responses NOR contend on self.lock (held across cross-node
+        # RPCs inside api.handle): either would stall RPC delivery for
+        # a full timeout and can churn the leader
         live = sorted(self.node.live_nodes())
+        with self.lock:
+            local = {
+                n: (svc.num_shards, svc.num_replicas, dict(svc.settings))
+                for n, svc in self.indices.indices.items()}
 
         def update(st):
             new = st.updated()
@@ -594,35 +693,30 @@ class ClusterRestService:
             new.data["meta_ops"] = log
             box["seq"] = log["seq"]
             box["op"] = op
-            self._sync_index_metadata(new, live)
+            self._sync_index_metadata(new, live, local)
             return new
 
         self.node._submit_and_wait(update)
-        self.full_log.append(box["op"])
+        self._log_append(box["op"])
         return box["seq"]
 
-    def _sync_index_metadata(self, new_state, live: List[str]) -> None:
+    def _sync_index_metadata(self, new_state, live: List[str],
+                             local: Dict[str, tuple]) -> None:
         """Reconcile cluster metadata/routing with the master's local
-        service after an op: allocate routing for new indices (round-robin
-        primaries + replica fan-out, the round-2 allocator), drop removed
-        ones. Generic over every index-creating op (create, rollover,
-        shrink/split/clone...)."""
-        with self.lock:
-            local = {
-                n: (svc.num_shards, svc.num_replicas)
-                for n, svc in self.indices.indices.items()}
+        service after an op: allocate routing for new indices (the
+        balanced allocator), drop removed ones. Generic over every
+        index-creating op (create, rollover, shrink/split/clone...).
+        ``local`` is a lock-free snapshot taken on the worker thread —
+        this runs on the transport loop and must not touch self.lock."""
         from ..cluster.allocation import (AllocationContext,
                                           BalancedAllocator)
         meta = new_state.metadata["indices"]
         routing = new_state.data.setdefault("routing", {})
         node = self.node
         allocator = BalancedAllocator()
-        for n, (shards, replicas) in local.items():
+        for n, (shards, replicas, settings) in local.items():
             if n in meta:
                 continue
-            with self.lock:
-                svc = self.indices.indices.get(n)
-                settings = dict(svc.settings) if svc is not None else {}
             meta[n] = {"num_shards": shards, "num_replicas": replicas,
                        "mappings": {}, "primary_term": 1,
                        "settings": settings}
@@ -927,6 +1021,8 @@ class ClusterRestService:
                 st.metadata["indices"] if st else {})},
             "routing_table": dict(st.data.get("routing", {}) if st else {}),
         }
+        if self.meta_divergent:
+            doc["meta_divergent"] = True
         return 200, "application/json", json.dumps(doc).encode()
 
     # ------------------------------------------------------------------

@@ -27,6 +27,10 @@ _TAG = re.compile(r"\{\{\s*([#^/]?)\s*([^}]*?)\s*\}\}")
 
 def _lookup(params, path: str):
     if path == ".":
+        # inside a list section the current item travels under the "."
+        # key of the iteration scope; at top level "." is the whole map
+        if isinstance(params, dict) and "." in params:
+            return params["."]
         return params
     cur = params
     for part in path.split("."):
@@ -109,14 +113,17 @@ def _render(src: str, pos: int, params, stop_tag):
                 continue
             if isinstance(v, list):
                 for item in v:
-                    scope = dict(params, **item) \
-                        if isinstance(item, dict) else dict(params)
-                    if not isinstance(item, dict):
+                    # "." always rebinds to the CURRENT item — without
+                    # this, a nested section's items would see a stale
+                    # "." inherited from an outer iteration scope
+                    if isinstance(item, dict):
+                        scope = {**params, **item, ".": item}
+                    else:
                         scope = {**params, ".": item}
                     rendered, _ = _render(body, 0, scope, None)
                     out.append(rendered)
             else:
-                scope = dict(params, **v) if isinstance(v, dict) \
+                scope = {**params, **v, ".": v} if isinstance(v, dict) \
                     else params
                 rendered, _ = _render(body, 0, scope, None)
                 out.append(rendered)

@@ -1,0 +1,65 @@
+"""Wire codec for structured intra-cluster payloads (agg partials).
+
+Reference bar: ``common/io/stream/StreamInput.java`` — node↔node payloads
+are data-only structured formats, never native object serialization (a
+pickle here would be remote code execution for anything that can reach
+the transport port).
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.datacodec import (decode, dumps_b64, encode,
+                                                loads_b64)
+
+
+def roundtrip(o):
+    return loads_b64(dumps_b64(o))
+
+
+def test_scalars_and_containers():
+    o = {"a": 1, "b": [1.5, None, True, "x"], 3.25: ("t", 2),
+         ("k", 1): {"nested": [set([1, 2])]}}
+    r = roundtrip(o)
+    assert r["a"] == 1 and r["b"] == [1.5, None, True, "x"]
+    assert r[3.25] == ("t", 2)
+    assert r[("k", 1)] == {"nested": [{1, 2}]}
+
+
+def test_non_finite_floats():
+    r = roundtrip([float("nan"), float("inf"), float("-inf")])
+    assert np.isnan(r[0]) and r[1] == float("inf")
+
+
+def test_numpy_arrays_and_scalars():
+    a = np.arange(12, dtype=np.int64).reshape(3, 4)
+    b = np.array([1.5, np.nan], dtype=np.float32)
+    r = roundtrip({"a": a, "b": b, "s": np.float64(2.5)})
+    np.testing.assert_array_equal(r["a"], a)
+    np.testing.assert_array_equal(r["b"], b)
+    assert r["s"] == 2.5 and isinstance(r["s"], float)
+
+
+def test_bytes():
+    assert roundtrip(b"\x00\xffpayload") == b"\x00\xffpayload"
+
+
+def test_agg_partial_shape():
+    # the (count, sub_partials) histogram/terms partial shape
+    p = {"h": [{2.0: (3, {"m": [(1.0, 2)]}), 4.0: (1, {})}],
+         "tops": [{"hits": [{"_id": "a", "_score": 1.5, "sort": [None]}],
+                   "total": 7}]}
+    assert roundtrip(p) == p
+
+
+def test_decode_cannot_execute_code():
+    # no tag dispatches to anything but the closed container set
+    with pytest.raises((ValueError, TypeError, IndexError, KeyError)):
+        decode(["X", "os.system"])
+
+
+def test_unencodable_rejected():
+    class Thing:
+        pass
+    with pytest.raises(TypeError):
+        encode({"x": Thing()})

@@ -1,0 +1,39 @@
+"""Mustache-lite search-template renderer (``utils/mustache.py``).
+
+Reference bar: ``modules/lang-mustache/.../MustacheScriptEngine.java:53``.
+"""
+
+import json
+
+from elasticsearch_tpu.utils.mustache import render_mustache
+
+
+def test_variable_and_dotted_path():
+    assert render_mustache('{"q": "{{query}}"}',
+                           {"query": "hello"}) == '{"q": "hello"}'
+    assert render_mustache("{{a.b}}", {"a": {"b": 3}}) == "3"
+
+
+def test_list_section_dot_binds_item():
+    out = render_mustache("{{#items}}[{{.}}]{{/items}}",
+                          {"items": [1, 2, 3]})
+    assert out == "[1][2][3]"
+
+
+def test_list_section_object_items():
+    out = render_mustache("{{#users}}{{name}},{{/users}}",
+                          {"users": [{"name": "a"}, {"name": "b"}]})
+    assert out == "a,b,"
+
+
+def test_inverted_and_truthy_sections():
+    assert render_mustache("{{^x}}none{{/x}}", {}) == "none"
+    assert render_mustache("{{#x}}y{{/x}}", {"x": False}) == ""
+    assert render_mustache("{{#x}}y{{/x}}", {"x": 1}) == "y"
+
+
+def test_to_json_and_join():
+    assert render_mustache("{{#toJson}}v{{/toJson}}",
+                           {"v": [1, "a"]}) == json.dumps([1, "a"])
+    assert render_mustache("{{#join}}v{{/join}}",
+                           {"v": [1, 2]}) == "1,2"
