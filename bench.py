@@ -69,11 +69,18 @@ _PROBE_SRC = (
 )
 
 
-def _probe_backend(attempts: int = 2) -> str | None:
+PROBE_LOG: list = []          # every attempt's outcome, emitted in the JSON
+
+
+def _probe_backend(attempts: int = 3, stagger_s: int = 15) -> str | None:
     """Ask a throwaway subprocess what jax backend comes up, with a hard
-    timeout per attempt. Returns the platform string or None if the backend
-    hangs/fails every attempt."""
+    timeout per attempt and a stagger between attempts (the tunnel hang is
+    intermittent across rounds: r01 threw, r02/r03 hung — an init that
+    fails now may succeed seconds later). Returns the platform string or
+    None; every attempt's outcome lands in PROBE_LOG for the final JSON."""
     for i in range(attempts):
+        if i:
+            time.sleep(stagger_s)
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC],
@@ -82,11 +89,14 @@ def _probe_backend(attempts: int = 2) -> str | None:
             if r.returncode == 0 and r.stdout.strip():
                 plat, ndev = r.stdout.split()[:2]
                 print(f"# backend probe: {plat} x{ndev}", file=sys.stderr)
+                PROBE_LOG.append(f"ok:{plat}x{ndev}")
                 return plat
+            PROBE_LOG.append(f"rc={r.returncode}")
             print(f"# backend probe attempt {i + 1}/{attempts} rc="
                   f"{r.returncode}: {r.stderr.strip()[-300:]}",
                   file=sys.stderr)
         except subprocess.TimeoutExpired:
+            PROBE_LOG.append(f"timeout{PROBE_TIMEOUT_S}s")
             print(f"# backend probe attempt {i + 1}/{attempts} timed out "
                   f"after {PROBE_TIMEOUT_S}s (hung init)", file=sys.stderr)
     return None
@@ -138,6 +148,7 @@ def _numpy_last_resort() -> None:
         "cpu_ref_qps": round(qps, 1),
         "n_devices": 0,
         "backend": "numpy-fallback-no-jax",
+        "probe_attempts": PROBE_LOG,
     }))
 
 
@@ -151,6 +162,12 @@ def orchestrate() -> None:
     for mode, tmo in plan:
         line = _run_child(mode, tmo)
         if line is not None:
+            try:
+                doc = json.loads(line)
+                doc["probe_attempts"] = PROBE_LOG
+                line = json.dumps(doc)
+            except ValueError:
+                pass
             print(line, flush=True)
             return
     _numpy_last_resort()
@@ -266,20 +283,39 @@ def main(mode: str = "accel"):
           f"sparse L_cap {plane.L_cap} "
           f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
 
-    # fixed compile shapes: Q=N_TERMS, L=L_cap, tiered kernel throughout
+    # fixed compile shapes: Q=N_TERMS, L=L_cap, tiered kernel throughout.
+    # On a CPU backend the serving path is the plane's term-at-a-time eager
+    # scorer (search_eager — the matmul dense tier exists to ride the MXU
+    # and does ~25x the arithmetic a CPU should do); the tiered kernel is
+    # still timed and reported as kernel_cpu_qps for transparency.
+    on_cpu_serving = on_cpu
     tiered = plane.T_pad > 0
     warm = sample_queries(rng, corpus, 1)[0]
     t0 = time.perf_counter()
     plane.search(warm, k=K, Q=N_TERMS, L=plane.L_cap, tiered=tiered)
     print(f"# compile+warm: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
+    kernel_cpu_qps = None
+    if on_cpu_serving:
+        kb = sample_queries(rng, corpus, 8)
+        t0 = time.perf_counter()
+        for qs in kb:
+            plane.search(qs, k=K, Q=N_TERMS, L=plane.L_cap, tiered=tiered)
+        kernel_cpu_qps = (8 * BATCH) / (time.perf_counter() - t0)
+        print(f"# tiered kernel on cpu: {kernel_cpu_qps:.1f} qps "
+              f"(reported as kernel_cpu_qps)", file=sys.stderr)
+        plane.search_eager(warm, k=K)       # warm the eager path
+
     timed_batches = sample_queries(rng, corpus, TIMED_ITERS)
     lat = []
     first_result = None
     for qs in timed_batches:
         t0 = time.perf_counter()
-        vals, hits = plane.search(qs, k=K, Q=N_TERMS, L=plane.L_cap,
-                                  tiered=tiered)
+        if on_cpu_serving:
+            vals, hits = plane.search_eager(qs, k=K)
+        else:
+            vals, hits = plane.search(qs, k=K, Q=N_TERMS, L=plane.L_cap,
+                                      tiered=tiered)
         lat.append(time.perf_counter() - t0)
         if first_result is None:
             first_result = (qs, vals)
@@ -304,7 +340,7 @@ def main(mode: str = "accel"):
     print("# correctness cross-check vs CPU reference: OK",
           file=sys.stderr)
 
-    print(json.dumps({
+    doc = {
         "metric": f"bm25_topk_qps_{n_docs}_docs_uncapped_df",
         "value": round(tpu_qps, 1),
         "unit": "queries/s",
@@ -317,7 +353,11 @@ def main(mode: str = "accel"):
         "n_devices": n_dev,
         # a CPU-fallback run must be distinguishable from a real TPU result
         "backend": jax.devices()[0].platform,
-    }))
+    }
+    if kernel_cpu_qps is not None:
+        doc["serving_path"] = "eager-cpu"
+        doc["kernel_cpu_qps"] = round(kernel_cpu_qps, 1)
+    print(json.dumps(doc))
 
 
 if __name__ == "__main__":

@@ -396,6 +396,14 @@ class ClusterRestService:
             # and op application contend on it, and peers may be slow
             ops = self._fetch_history(missing[0], missing[-1])
             have.update({op["seq"]: op for op in ops})
+            # seed the gap clock for EVERY still-missing seq in one pass:
+            # each would otherwise start its 20s grace only after the
+            # previous one expired, stalling a far-behind node for
+            # GAP_GRACE x gap-width instead of one grace window total
+            now0 = time.monotonic()
+            for s in missing:
+                if s not in have:
+                    self._gap_since.setdefault(s, now0)
         with self.lock:
             for s in range(self.applied_seq + 1, seq + 1):
                 op = have.get(s)
@@ -432,9 +440,13 @@ class ClusterRestService:
                 self.applied_seq = s
 
     def _log_append(self, op: dict) -> None:
-        self.full_log[op["seq"]] = op
-        while len(self.full_log) > self.HISTORY_CAP:
-            self.full_log.pop(min(self.full_log))
+        # self.lock serializes the two writers (apply_ops on the data
+        # worker already holds it; _publish_op on a request thread does
+        # not) — an unguarded min()-while-insert would race
+        with self.lock:
+            self.full_log[op["seq"]] = op
+            while len(self.full_log) > self.HISTORY_CAP:
+                self.full_log.pop(min(self.full_log))
 
     def _fetch_history(self, lo: int, hi: int) -> List[dict]:
         """Fetch an op range beyond the state tail: the master first,
@@ -666,8 +678,12 @@ class ClusterRestService:
 
     def h_meta_history(self, src, payload) -> dict:
         lo, hi = int(payload["from"]), int(payload["to"])
-        return {"ops": [self.full_log[s] for s in range(lo, hi + 1)
-                        if s in self.full_log]}
+        # iterate the bounded log, never the peer-supplied range (a
+        # hostile {"from": 0, "to": 2**62} must not pin the meta pool)
+        with self.lock:
+            return {"ops": [self.full_log[s]
+                            for s in sorted(self.full_log)
+                            if lo <= s <= hi]}
 
     def _publish_op(self, entry: dict) -> int:
         box: Dict[str, int] = {}

@@ -146,7 +146,8 @@ def build_bm25_topk_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
 def build_tiered_bm25_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
                            T_pad: int, C: int, n_shards: int,
                            min_should_match: int = 1,
-                           with_count: bool = False):
+                           with_count: bool = False,
+                           U: Optional[int] = None):
     """Jitted distributed tiered step (``ops/tiered_bm25.py``): sparse
     sorted-merge + dense Zipf-head streaming matmul per shard, then the ICI
     all_gather/top_k reduce.
@@ -157,6 +158,15 @@ def build_tiered_bm25_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
                                               tier; weight-0 slots inert)
       dense_w      f32[B, S, Q]
       W            f32[B, S, T_pad]          (per-query dense row weights)
+
+    ``U``: used-row gather width. A query batch touches only the dense
+    rows its terms map to — usually a small subset of T_pad — so when
+    ``U < T_pad`` the step first gathers the batch's used rows
+    (``u_ids i32[S, U]``) into a [n_blk, U, C] working set and streams
+    THAT through the matmul: HBM traffic and MXU work drop from
+    T_pad·n_pad to U·n_pad per dispatch. ``W`` / ``dense_rid`` are then
+    slot-indexed ([B, S, U] / slot ids). Exact: unused rows have zero
+    weight everywhere.
     """
     s_dev = mesh.shape[AXIS_SHARD]
     if n_shards % s_dev:
@@ -164,17 +174,21 @@ def build_tiered_bm25_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
     s_loc = n_shards // s_dev
     kk = min(k, n_pad)
     out_k = min(k, n_shards * n_pad)
+    gathered = U is not None and U < T_pad
 
-    def body(pd, pi, dense, st, ln, idfw, rid, dw, W):
-        def per_shard(pd_s, pi_s, dense_s, st_s, ln_s, rid_s, dw_s, W_s):
+    def body(pd, pi, dense, st, ln, idfw, rid, dw, W, u_ids):
+        def per_shard(pd_s, pi_s, dense_s, st_s, ln_s, rid_s, dw_s, W_s,
+                      u_s):
+            if gathered:
+                dense_s = jnp.take(dense_s, u_s, axis=1)
             return tiered_bm25_topk(
                 pd_s, pi_s, dense_s, st_s, ln_s, idfw, rid_s, dw_s, W_s,
                 n_pad=n_pad, L=L, k=kk, min_should_match=min_should_match,
                 with_count=with_count)
 
         out = jax.vmap(per_shard,
-                       in_axes=(0, 0, 0, 1, 1, 1, 1, 1),
-                       out_axes=1)(pd, pi, dense, st, ln, rid, dw, W)
+                       in_axes=(0, 0, 0, 1, 1, 1, 1, 1, 0),
+                       out_axes=1)(pd, pi, dense, st, ln, rid, dw, W, u_ids)
         gvals, gdocs = _global_topk_reduce(out[0], out[1], s_loc=s_loc,
                                            kk=kk, n_pad=n_pad, out_k=out_k)
         if with_count:
@@ -194,7 +208,8 @@ def build_tiered_bm25_step(mesh: Mesh, *, n_pad: int, Q: int, L: int, k: int,
                   P(AXIS_REPLICA, None),
                   P(AXIS_REPLICA, AXIS_SHARD, None),
                   P(AXIS_REPLICA, AXIS_SHARD, None),
-                  P(AXIS_REPLICA, AXIS_SHARD, None)),
+                  P(AXIS_REPLICA, AXIS_SHARD, None),
+                  P(AXIS_SHARD, None)),
         out_specs=out_specs,
         check_vma=False)
     return jax.jit(step)
@@ -310,7 +325,12 @@ class DistributedSearchPlane:
 
         self.n_pad = round_up_pow2(max(max(s["doc_len"].shape[0] for s in shards), 1))
         if dense_threshold is None:
-            dense_threshold = max(self.n_pad // 64, 4096)
+            # ROOFLINE.md: the sparse tier's bitonic sort (VPU) is the
+            # dominant per-dispatch cost at n_pad/64, while the dense
+            # tier's streaming matmul (MXU + HBM) is far under its
+            # ceiling — so push the boundary down: more head terms dense
+            # (bounded by MAX_DENSE_TERMS), 4x smaller sort tiles
+            dense_threshold = max(self.n_pad // 256, 4096)
         self.dense_threshold = dense_threshold
 
         # full-table impacts first (dense rows reference original postings),
@@ -371,6 +391,19 @@ class DistributedSearchPlane:
         self.docs_dev = jax.device_put(docs, corpus_spec)
         self.impacts_dev = jax.device_put(impacts, corpus_spec)
 
+        # CPU fallback: the streaming-matmul dense tier exists to ride the
+        # MXU; on a CPU backend it does ~25x the arithmetic of term-at-a-
+        # time scoring, so the plane keeps the ORIGINAL per-shard CSR (with
+        # precomputed impacts) host-side and serves via
+        # :meth:`search_eager` instead. Only retained on CPU — on TPU this
+        # would duplicate the corpus in host RAM for nothing.
+        self._host_csr = None
+        if jax.devices()[0].platform == "cpu":
+            self._host_csr = [
+                dict(offsets=s["offsets"], docs=s["docs"], impacts=imp,
+                     n_docs=int(s["doc_len"].shape[0]))
+                for s, imp in zip(shards, impacts_full)]
+
         self.dense_dev = None
         if self.T_pad:
             C = min(self.DENSE_BLOCK, self.n_pad)
@@ -404,7 +437,6 @@ class DistributedSearchPlane:
         the sparse tier or the dense tier *per shard* (membership can differ
         across shards); global idf always uses the original df stats."""
         B, S = len(queries), self.n_shards
-        T = self.T_pad
         starts = np.zeros((B, S, Q), np.int32)
         lengths = np.zeros((B, S, Q), np.int32)
         dense_rid = np.zeros((B, S, Q), np.int32)
@@ -444,15 +476,49 @@ class DistributedSearchPlane:
         idf = idf_weight(self.n_docs_total, gdf).astype(np.float32)
         idf[gdf == 0] = 0.0
         idfw = idf * weights
+        return (starts, lengths, idfw, dense_rid, dense_hit, max_len,
+                any_dense)
+
+    def _dense_inputs(self, idfw, dense_rid, dense_hit):
+        """Slot-space dense-tier inputs for one batch: pick the used-row
+        gather width U (pow2-bucketed for compile-cache stability), build
+        ``u_ids`` i32[S, U] (the batch's used rows per shard), the
+        slot-indexed per-candidate (rid, w) pairs, and the slot-space
+        weight matrix W f32[B, S, U]. When the batch uses most of the
+        dense tier, U collapses to T_pad and u_ids is a dummy (the step
+        streams the full block array, no gather)."""
+        B, S = dense_hit.shape[0], self.n_shards
+        T = self.T_pad
+        u_lists = [np.unique(dense_rid[:, si, :][dense_hit[:, si, :]])
+                   for si in range(S)]
+        max_used = max((r.size for r in u_lists), default=0)
+        U = min(T, max(16, round_up_pow2(max(max_used, 1))))
+        # the gather moves ~3x the U rows through HBM (read + write the
+        # working set, then the matmul re-reads it), so it only pays when
+        # the batch touches well under a third of the dense tier
+        if 3 * U > T:
+            U = T
+        if U < T:
+            u_ids = np.zeros((S, U), np.int32)
+            rid_out = np.zeros_like(dense_rid)
+            for si, rows in enumerate(u_lists):
+                u_ids[si, :rows.size] = rows
+                bi_ix, qi_ix = np.nonzero(dense_hit[:, si, :])
+                if bi_ix.size:
+                    rid_out[bi_ix, si, qi_ix] = np.searchsorted(
+                        rows, dense_rid[bi_ix, si, qi_ix]).astype(np.int32)
+        else:
+            U = T
+            u_ids = np.zeros((S, 1), np.int32)
+            rid_out = dense_rid
         dense_w = np.where(dense_hit, idfw[:, None, :], 0.0) \
             .astype(np.float32)
-        W = np.zeros((B, S, max(T, 1)), np.float32)
-        if any_dense:
-            bi_ix, si_ix, qi_ix = np.nonzero(dense_hit)
-            np.add.at(W, (bi_ix, si_ix, dense_rid[bi_ix, si_ix, qi_ix]),
+        W = np.zeros((B, S, max(U, 1)), np.float32)
+        bi_ix, si_ix, qi_ix = np.nonzero(dense_hit)
+        if bi_ix.size:
+            np.add.at(W, (bi_ix, si_ix, rid_out[bi_ix, si_ix, qi_ix]),
                       idfw[bi_ix, qi_ix])
-        return (starts, lengths, idfw, dense_rid, dense_w, W, max_len,
-                any_dense)
+        return U, u_ids, rid_out, dense_w, W
 
     def search(self, queries: Sequence[Sequence[str]], k: int = 10,
                *, Q: Optional[int] = None, L: Optional[int] = None,
@@ -480,7 +546,7 @@ class DistributedSearchPlane:
             raise ValueError(
                 f"Q={Q} would drop terms from a {needed_q}-term query; "
                 f"pass Q=None to size automatically")
-        (starts, lengths, idfw, dense_rid, dense_w, W, max_len,
+        (starts, lengths, idfw, dense_rid, dense_hit, max_len,
          any_dense) = self._lookup(queries, Q)
         if L is None:
             L = round_up_pow2(max_len)
@@ -499,16 +565,20 @@ class DistributedSearchPlane:
         if tiered is False and any_dense:
             raise ValueError("tiered=False but the batch hits dense-tier terms")
         if use_tiered:
+            U, u_ids, rid_slots, dense_w, W = self._dense_inputs(
+                idfw, dense_rid, dense_hit)
             step = self._get_step(Q, L, k, tiered=True,
-                                  with_count=with_totals)
+                                  with_count=with_totals, U=U)
+            shard2 = NamedSharding(self.mesh, P(AXIS_SHARD, None))
             out = step(
                 self.docs_dev, self.impacts_dev, self.dense_dev,
                 jax.device_put(starts, repl3),
                 jax.device_put(lengths, repl3),
                 jax.device_put(idfw, repl),
-                jax.device_put(dense_rid, repl3),
+                jax.device_put(rid_slots, repl3),
                 jax.device_put(dense_w, repl3),
-                jax.device_put(W, repl3))
+                jax.device_put(W, repl3),
+                jax.device_put(u_ids, shard2))
         else:
             step = self._get_step(Q, L, k, with_count=with_totals)
             out = step(
@@ -532,16 +602,82 @@ class DistributedSearchPlane:
             return vals, hits, totals
         return vals, hits
 
+    def search_eager(self, queries: Sequence[Sequence[str]], k: int = 10):
+        """CPU-native serving path: term-at-a-time scatter-add over the
+        original CSR with precomputed impacts, per shard, exact top-k with
+        the kernel path's tie order (score desc, (shard, doc) asc).
+
+        This is the same eager-scoring algorithm as Lucene's ``BulkScorer``
+        loop (``search/internal/ContextIndexSearcher.java:210-224``) but
+        each posting costs one multiply-add instead of the full BM25 norm
+        (impacts are precomputed at build time — the plane's representation
+        pays off on every backend). Only available when the plane was built
+        on a CPU backend (``_host_csr`` retained)."""
+        if self._host_csr is None:
+            raise RuntimeError("search_eager requires a CPU-backend plane")
+        vals_out = np.full((len(queries), k), NEG_INF, np.float32)
+        hits_out: List[List[Tuple[int, int]]] = []
+        for bi, terms in enumerate(queries):
+            weights: Dict[str, float] = {}
+            for t in terms:
+                weights[t] = weights.get(t, 0.0) + 1.0
+            # global idf over the original df stats (same as _lookup)
+            idfw_of: Dict[str, float] = {}
+            for t, w in weights.items():
+                gdf = sum(int(s2["df"][s2["term_ids"][t]])
+                          for s2 in self.shards if t in s2["term_ids"])
+                if gdf:
+                    idfw_of[t] = float(
+                        idf_weight(self.n_docs_total, np.int64(gdf))) * w
+            cand_v: List[np.ndarray] = []
+            cand_g: List[np.ndarray] = []
+            for si, (sh, csr) in enumerate(zip(self.shards,
+                                               self._host_csr)):
+                scores = np.zeros(csr["n_docs"], np.float32)
+                matched = False
+                for t, idfw in idfw_of.items():
+                    tid = sh["term_ids"].get(t)
+                    if tid is None:
+                        continue
+                    st = int(csr["offsets"][tid])
+                    en = int(csr["offsets"][tid + 1])
+                    if en > st:
+                        # docs within one postings run are unique, so the
+                        # fancy-index += is a safe (buffered) scatter-add
+                        scores[csr["docs"][st:en]] += \
+                            idfw * csr["impacts"][st:en]
+                        matched = True
+                if not matched:
+                    continue
+                kk = min(k, csr["n_docs"])
+                top = np.argpartition(-scores, kk - 1)[:kk]
+                sel = top[scores[top] > 0]
+                order = np.lexsort((sel, -scores[sel]))
+                sel = sel[order]
+                cand_v.append(scores[sel])
+                cand_g.append(sel.astype(np.int64) + si * self.n_pad)
+            row: List[Tuple[int, int]] = []
+            if cand_v:
+                v = np.concatenate(cand_v)
+                g = np.concatenate(cand_g)
+                order = np.lexsort((g, -v))[:k]
+                vals_out[bi, :order.size] = v[order]
+                row = [(int(g[j]) // self.n_pad, int(g[j]) % self.n_pad)
+                       for j in order]
+            hits_out.append(row)
+        self.n_dispatches += 1
+        return vals_out, hits_out
+
     def _get_step(self, Q: int, L: int, k: int, *, tiered: bool = False,
-                  with_count: bool = False):
-        key = (Q, L, k, tiered, with_count)
+                  with_count: bool = False, U: Optional[int] = None):
+        key = (Q, L, k, tiered, with_count, U)
         fn = self._steps.get(key)
         if fn is None:
             if tiered:
                 fn = build_tiered_bm25_step(
                     self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
                     T_pad=self.T_pad, C=self.dense_block,
-                    n_shards=self.n_shards, with_count=with_count)
+                    n_shards=self.n_shards, with_count=with_count, U=U)
             else:
                 fn = build_bm25_topk_step(
                     self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,

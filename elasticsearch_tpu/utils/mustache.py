@@ -123,8 +123,10 @@ def _render(src: str, pos: int, params, stop_tag):
                     rendered, _ = _render(body, 0, scope, None)
                     out.append(rendered)
             else:
+                # a truthy section value becomes the current context:
+                # dicts merge their keys in, scalars bind only "."
                 scope = {**params, **v, ".": v} if isinstance(v, dict) \
-                    else params
+                    else {**params, ".": v}
                 rendered, _ = _render(body, 0, scope, None)
                 out.append(rendered)
             continue

@@ -331,3 +331,52 @@ def test_plane_with_totals_exact(dense_threshold):
         assert t == expect, (q, t, expect)
     if dense_threshold is not None:
         assert plane.T_pad > 0          # the dense tier actually engaged
+
+
+def test_tiered_used_row_gather_matches_full_stream():
+    """When a batch touches well under a third of the dense tier, the step
+    gathers only the used rows (U < T_pad) before the streaming matmul —
+    results must be identical to the CPU eager reference."""
+    from elasticsearch_tpu.utils.synth import synthetic_csr_corpus_fast
+    rng = np.random.RandomState(7)
+    corpus = synthetic_csr_corpus_fast(rng, 512, 256, 16, zipf_s=1.1)
+    corpus["term_ids"] = {f"t{t}": t for t in range(256)}
+    mesh = make_search_mesh(n_shards=1, n_replicas=1)
+    plane = DistributedSearchPlane(mesh, [corpus], "body",
+                                   dense_threshold=0)   # every term dense
+    assert plane.T_pad >= 48, "need a wide dense tier for the gather gate"
+    queries = [["t3", "t7"], ["t0"], ["t12", "t3", "t90"], ["t200"]]
+    vals, hits = plane.search(queries, k=8)
+    # the batch used few rows → a gathered step must have been compiled
+    assert any(key[5] is not None and key[5] < plane.T_pad
+               for key in plane._steps), plane._steps.keys()
+    ev, eh = plane.search_eager(queries, k=8)
+    for bi in range(len(queries)):
+        # bf16 dense impacts can reorder near-ties vs the f32 eager path:
+        # require per-rank score agreement and near-total doc overlap
+        for a, b in zip(vals[bi], ev[bi]):
+            if a == float("-inf") and b == float("-inf"):
+                continue
+            assert abs(a - b) <= 0.01 * max(1.0, abs(b))
+        assert len(set(hits[bi]) & set(eh[bi])) >= len(eh[bi]) - 1, \
+            (queries[bi], hits[bi], eh[bi])
+
+
+def test_search_eager_matches_kernel_path():
+    """The CPU-fallback eager scorer (term-at-a-time over precomputed
+    impacts) must produce the kernel path's exact results and tie order."""
+    n_shards = 4
+    mesh = make_search_mesh(n_shards=4, n_replicas=1)
+    mapper, segs = _build_shards(n_shards)
+    plane = DistributedSearchPlane.from_segments(mesh, segs, "body")
+    assert plane._host_csr is not None   # tests run on the CPU backend
+    queries = [["the", "fox"], ["quick", "the", "river"], ["zzz_absent"],
+               ["dog", "dog", "park"]]
+    kv, kh = plane.search(queries, k=6)
+    ev, eh = plane.search_eager(queries, k=6)
+    for bi in range(len(queries)):
+        assert kh[bi] == eh[bi], (queries[bi], kh[bi], eh[bi])
+        for a, b in zip(kv[bi], ev[bi]):
+            if a == float("-inf") and b == float("-inf"):
+                continue
+            assert abs(a - b) <= 0.01 * max(1.0, abs(b))

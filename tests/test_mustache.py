@@ -37,3 +37,9 @@ def test_to_json_and_join():
                            {"v": [1, "a"]}) == json.dumps([1, "a"])
     assert render_mustache("{{#join}}v{{/join}}",
                            {"v": [1, 2]}) == "1,2"
+
+
+def test_scalar_section_binds_dot():
+    assert render_mustache("{{#x}}{{.}}{{/x}}", {"x": "hi"}) == "hi"
+    assert render_mustache("{{#o}}{{a}}:{{/o}}{{#n}}[{{.}}]{{/n}}",
+                           {"o": {"a": 1}, "n": 5}) == "1:[5]"
