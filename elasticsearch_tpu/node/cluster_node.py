@@ -766,6 +766,9 @@ class ClusterNode:
 
     def search(self, index: str, body: Optional[dict] = None) -> dict:
         body = body or {}
+        if "aggregations" in body and "aggs" not in body:
+            body = dict(body)
+            body["aggs"] = body.pop("aggregations")
         meta, table = self._index_meta(index)
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
@@ -776,12 +779,28 @@ class ClusterNode:
         for sid_s, entry in table.items():
             by_node.setdefault(entry["primary"], []).append(int(sid_s))
         node_order = sorted(by_node)
-        # -- DFS stats round: cluster-wide term statistics ------------------
+        # -- DFS stats round: cluster-wide term statistics. A node that
+        # cannot answer in time degrades to partial stats (slightly-off
+        # idf) instead of failing the whole search — the reference's DFS
+        # phase likewise tolerates per-shard failures.
         stats = {"total_docs": 0, "fields": {}, "terms": {}}
         for node_id in node_order:
-            s = self.rpc(node_id, "search:stats", {
-                "index": index, "shards": by_node[node_id],
-                "body": {"query": body.get("query")}}, timeout=5.0)
+            s = None
+            for attempt in (15.0, 15.0):
+                try:
+                    s = self.rpc(node_id, "search:stats", {
+                        "index": index, "shards": by_node[node_id],
+                        "body": {"query": body.get("query")}},
+                        timeout=attempt)
+                    break
+                except Exception:   # noqa: BLE001 — retry once, then skip
+                    continue
+            if s is None:
+                import sys
+                print(f"[{self.node_id}] search:stats to [{node_id}] "
+                      f"failed twice; degrading to partial stats",
+                      file=sys.stderr)
+                continue
             stats["total_docs"] += s["total_docs"]
             for f, (sdl, dc) in s["fields"].items():
                 cur = stats["fields"].setdefault(f, [0.0, 0])
@@ -812,7 +831,7 @@ class ClusterNode:
                        "body": nb, "global_stats": stats,
                        "want_agg_partials": bool(body.get("aggs"))}
             results.append(self.rpc(node_id, "search:shards", payload,
-                                    timeout=5.0))
+                                    timeout=15.0))
         # merge (same comparator as the single-node coordinator), then lift
         # tiebreaks into the node-global cursor space
         merged = []
@@ -846,23 +865,20 @@ class ClusterNode:
         total = sum(r["total"] for r in results)
         aggs_out = None
         if body.get("aggs"):
-            from ..search.aggregations import parse_aggs
+            # ONE shared reduce through the same entry point the single-
+            # node coordinator uses (meta attachment, parent pipelines,
+            # max-bucket checks — SearchPhaseController.java:211-219)
+            from ..search.aggregations import (inject_mapper, parse_aggs,
+                                               run_aggregations_multi)
             aggs = parse_aggs(body["aggs"])
-            partial_lists = [_undata64(r["agg_partials"])
-                             for r in results]
-            aggs_out = {}
-            from ..search.aggregations import PipelineAggregator
-            pipelines = {}
-            for name, agg in aggs.items():
-                if isinstance(agg, PipelineAggregator):
-                    pipelines[name] = agg
-                    continue
-                parts = []
-                for pl in partial_lists:
-                    parts.extend(pl[name])
-                aggs_out[name] = agg.reduce(parts)
-            for name, p in pipelines.items():
-                aggs_out[name] = p.apply(aggs_out)
+            if index in self.mappers:
+                inject_mapper(aggs, self.mappers[index])
+            merged: Dict[str, list] = {}
+            for r in results:
+                for name, parts in _undata64(r["agg_partials"]).items():
+                    merged.setdefault(name, []).extend(parts)
+            aggs_out = run_aggregations_multi(aggs, [],
+                                              extra_partials=merged)
         out = {"total": total, "hits": hits}
         if aggs_out is not None:
             out["aggregations"] = aggs_out
@@ -875,8 +891,33 @@ class ClusterNode:
             out["suggest"] = _merge_suggest(suggests)
         profiles = [r["profile"] for r in results if r.get("profile")]
         if profiles:
-            out["profile"] = {"shards": [sh for p in profiles
-                                         for sh in p["shards"]]}
+            shards_prof = [sh for p in profiles for sh in p["shards"]]
+            if aggs_out is not None:
+                # remote shards collected partials without reducing, so
+                # their agg profile entries carry no debug payload —
+                # rebuild them from the post-reduce aggregator state
+                from ..search.shard_search import build_agg_profile
+                prof_aggs = build_agg_profile(
+                    aggs, aggs_out, self.mappers.get(index), [], 1)
+                by_name = {e["description"]: e for e in prof_aggs}
+                for sh in shards_prof:
+                    for i, e in enumerate(sh.get("aggregations") or []):
+                        fixed = by_name.get(e.get("description"))
+                        if fixed is None:
+                            continue
+                        merged_e = dict(fixed)
+                        merged_e["breakdown"] = e.get(
+                            "breakdown", fixed["breakdown"])
+                        # shard-local collect-time debug (e.g. ordinal
+                        # stats) wins where non-zero; reduce-side debug
+                        # fills what the shard couldn't know
+                        dbg = dict(fixed.get("debug", {}))
+                        for k, v in (e.get("debug") or {}).items():
+                            if v:
+                                dbg[k] = v
+                        merged_e["debug"] = dbg
+                        sh["aggregations"][i] = merged_e
+            out["profile"] = {"shards": shards_prof}
         return out
 
     def _node_local_cursor(self, sa, node_ord: int, use_field_sort: bool,
@@ -1032,6 +1073,14 @@ class ClusterNode:
                 raise ElasticsearchError(f"shard [{key}] not on this node")
             seg_lists.append(holder.engine.searchable_segments())
         dist = DistributedSearcher(seg_lists, mapper)
+        # per-index search settings travel with the replicated metadata,
+        # not the engine: apply them to the remote shard searchers too
+        svc = self.rest.indices.indices.get(name)
+        if svc is not None:
+            mao = svc.settings.get("index.highlight.max_analyzed_offset")
+            if mao is not None:
+                for shard in dist.shards:
+                    shard.max_analyzed_offset = int(mao)
         if global_stats is not None:
             # cluster-wide DFS stats replace the node-local union stats —
             # scores must be comparable across nodes at the merge
@@ -1078,7 +1127,8 @@ class ClusterNode:
         hits = [{"id": h.doc_id, "score": h.score, "sort": h.sort_values,
                  "source": h.source, "fields": h.fields,
                  "highlight": h.highlight, "seq_no": h.seq_no,
-                 "ignored": h.ignored} for h in r.hits]
+                 "ignored": h.ignored,
+                 "inner_hits": h.inner_hits} for h in r.hits]
         out = {"total": r.total, "hits": hits}
         if r.suggest is not None:
             out["suggest"] = r.suggest
@@ -1096,14 +1146,19 @@ class ClusterNode:
             for shard_searcher, agg_inputs in (r.agg_inputs_by_shard or []):
                 seg_scores = {seg.seg_id: sc for seg, _, sc in agg_inputs
                               if sc is not None} if need_scores else {}
+                # wire=True: aggregators (at ANY tree depth) whose local
+                # partials embed live segment refs use their data-only
+                # collect_wire form — the partials cross the transport
                 ctx = AggregationContext(self.mappers[name],
                                          shard_ctx=shard_searcher.ctx,
-                                         seg_scores=seg_scores)
+                                         seg_scores=seg_scores,
+                                         wire=True)
+                from ..search.aggregations import _collect_fn
                 for name_, agg in aggs.items():
                     if isinstance(agg, PipelineAggregator):
                         continue
                     partials.setdefault(name_, []).extend(
-                        agg.collect(ctx, seg, mask)
+                        _collect_fn(agg, ctx)(ctx, seg, mask)
                         for seg, mask, _ in agg_inputs)
             out["agg_partials"] = _data64(partials)
         return out

@@ -62,7 +62,8 @@ _META_SUFFIXES = {
     "_block", "_freeze", "_unfreeze",
 }
 _META_ROOTS = ("/_aliases", "/_template", "/_index_template",
-               "/_component_template", "/_ingest/pipeline", "/_scripts")
+               "/_component_template", "/_ingest/pipeline", "/_scripts",
+               "/_cluster/settings")
 #: segment-bound reads that forward wholesale to a single-owner node
 _FORWARD_SUFFIXES = {"_explain", "_termvectors", "_mtermvectors",
                      "_validate", "_field_caps", "_delete_by_query",
@@ -80,6 +81,22 @@ def _b64(raw: bytes) -> str:
 
 def _unb64(s: str) -> bytes:
     return base64.b64decode(s or "")
+
+
+def _nodes_predicate(expr: str, n: int) -> bool:
+    """wait_for_nodes expressions: "3", ">=2", "<=4", ">1", "<5"."""
+    expr = str(expr)
+    for op, fn in ((">=", lambda a, b: a >= b), ("<=", lambda a, b: a <= b),
+                   (">", lambda a, b: a > b), ("<", lambda a, b: a < b)):
+        if expr.startswith(op):
+            try:
+                return fn(n, int(expr[len(op):]))
+            except ValueError:
+                return True
+    try:
+        return n == int(expr)
+    except ValueError:
+        return True
 
 
 def _remote_error(e: RemoteTransportError) -> Exception:
@@ -193,7 +210,12 @@ class ClusterHooks:
         owners = {e["primary"] for e in table.values()}
         if owners == {node.node_id}:
             return None
-        out = node.search(index, dict(body))
+        try:
+            out = node.search(index, dict(body))
+        except RemoteTransportError as e:
+            # semantic round-trip: the remote parse/shard error must
+            # render with its real ES type, not a generic exception
+            raise _remote_error(e) from e
         hits = []
         for h in out["hits"]:
             hits.append(ShardHit(
@@ -201,7 +223,8 @@ class ClusterHooks:
                 local_doc=0, source=h.get("source"),
                 sort_values=h.get("sort"), seq_no=h.get("seq_no"),
                 fields=h.get("fields"), highlight=h.get("highlight"),
-                ignored=h.get("ignored")))
+                ignored=h.get("ignored"),
+                inner_hits=h.get("inner_hits")))
         max_score = None
         sort_spec = body.get("sort")
         if not sort_spec or sort_spec in ("_score", ["_score"]):
@@ -498,13 +521,18 @@ class ClusterRestService:
         segs = [s for s in path.split("/") if s]
         # cluster-aware admin views
         if path.startswith("/_cluster/health"):
-            return self._health(query)
+            return self._health(method, path, query, body)
         if path == "/_cluster/state" or path.startswith("/_cluster/state"):
-            return self._cluster_state()
+            return self._cluster_state(method, path, query, body)
         if path.startswith("/_cluster/allocation/explain"):
             return self._alloc_explain(body)
         if path.startswith("/_cluster/reroute") and method == "POST":
             return self._reroute(query)
+        if path == "/_tasks" or path.startswith("/_tasks/") or \
+                path.startswith("/_tasks?"):
+            return self._tasks_route(method, path, query, body)
+        if segs and segs[-1].split("?")[0] == "_mtermvectors":
+            return self._mtermvectors(method, path, query, body)
         if self._is_meta_mutation(method, path, segs):
             return self._meta_op(method, path, query, body)
         if segs and segs[-1].split("?")[0] in _BROADCAST_SUFFIXES \
@@ -515,7 +543,7 @@ class ClusterRestService:
         fwd = self._forward_target(method, path, query, segs)
         if fwd is not None:
             return self._exec_on(fwd, method, path, query, body)
-        self._ensure_doc_indices(method, path, segs, body)
+        self._ensure_doc_indices(method, path, segs, body, query)
         return self._local(method, path, query, body)
 
     def _local(self, method, path, query, body):
@@ -744,16 +772,30 @@ class ClusterRestService:
             if n not in local:
                 del meta[n]
                 routing.pop(n, None)
+        # reconcile: fill replica copies that earlier rounds could not
+        # place (e.g. a node transiently unpingable at creation) — the
+        # reference reroutes on every state change (AllocationService)
+        if meta:
+            ctx = AllocationContext(
+                live, routing, meta, node_attrs=node.node_attrs,
+                disk_used=dict(getattr(node, "_disk_used", {})))
+            allocator.allocate_unassigned(ctx)
 
     # ------------------------------------------------------------------
     # auto-create + dynamic-mapping propagation for doc writes
     # ------------------------------------------------------------------
 
-    def _ensure_doc_indices(self, method, path, segs, body) -> None:
+    def _ensure_doc_indices(self, method, path, segs, body,
+                            query: str = "") -> None:
         if method not in ("PUT", "POST", "DELETE"):
             return
         tail = next((s for s in segs if s.startswith("_")), None)
         if tail not in _DOC_WRITE_SUFFIXES:
+            return
+        if "require_alias=true" in (query or ""):
+            # the write must fail on a missing alias — auto-creating the
+            # target as an INDEX would both mask the error and leak the
+            # index into cluster metadata
             return
         targets = set()
         if segs and not segs[0].startswith("_"):
@@ -772,7 +814,10 @@ class ClusterRestService:
                 if isinstance(op, dict) and len(op) == 1 and \
                         next(iter(op)) in ("index", "create", "update",
                                            "delete"):
-                    idx = next(iter(op.values())).get("_index", default)
+                    spec = next(iter(op.values()))
+                    if spec.get("require_alias"):
+                        continue            # must resolve as an alias
+                    idx = spec.get("_index", default)
                     if idx:
                         targets.add(idx)
         st = self.node.applied_state
@@ -816,7 +861,10 @@ class ClusterRestService:
                 if isinstance(op, dict) and len(op) == 1 and \
                         next(iter(op)) in ("index", "create", "update",
                                            "delete"):
-                    idx = next(iter(op.values())).get("_index", default)
+                    spec = next(iter(op.values()))
+                    if spec.get("require_alias"):
+                        continue            # must resolve as an alias
+                    idx = spec.get("_index", default)
                     if idx:
                         targets.add(idx)
         st = self.node.applied_state
@@ -900,6 +948,129 @@ class ClusterRestService:
             return self._exec_on(target, method, path, query, body)
         return self._local(method, path, query, body)
 
+    def _mtermvectors(self, method, path, query, body):
+        """Per-doc routing: each item's term vectors come from the node
+        primarying its shard (the reference's per-item single-shard
+        dispatch in ``TransportMultiTermVectorsAction``)."""
+        segs = [s for s in path.split("/") if s]
+        default_index = segs[0] if segs and not segs[0].startswith("_") \
+            else None
+        try:
+            spec = json.loads(body or b"{}") or {}
+        except ValueError:
+            spec = {}
+        _DOC_KEYS = {"_index", "_id", "_routing", "routing", "fields",
+                     "field_statistics", "term_statistics", "offsets",
+                     "payloads", "positions", "filter", "doc", "version",
+                     "version_type"}
+        docs = spec.get("docs")
+        if isinstance(docs, list) and any(
+                isinstance(d, dict) and any(k not in _DOC_KEYS
+                                            for k in d)
+                for d in docs):
+            # unknown/deprecated doc keys (camelCase, _-prefixed): the
+            # local api owns that validation and renders the 400
+            return self._local(method, path, query, body)
+        if not isinstance(docs, list):
+            # the ids short form: ?ids=a,b (or body {"ids": [...]}) with
+            # the index from the path
+            ids = spec.get("ids")
+            if ids is None:
+                qp = dict(p.split("=", 1)
+                          for p in (query or "").split("&") if "=" in p)
+                from urllib.parse import unquote
+                raw_ids = qp.get("ids")
+                ids = [unquote(x) for x in raw_ids.split(",")] \
+                    if raw_ids else None
+            if ids and default_index:
+                docs = [{"_id": i} for i in ids]
+            else:
+                return self._local(method, path, query, body)
+        st = self.node.applied_state
+        routing = st.data.get("routing", {}) if st else {}
+        out_docs = []
+        for d in docs:
+            idx = (d or {}).get("_index", default_index)
+            did = (d or {}).get("_id")
+            one_path = f"/{idx}/_termvectors/{did}"
+            one_body = json.dumps(
+                {k: v for k, v in (d or {}).items()
+                 if k not in ("_index", "_id")}).encode()
+            target = self.node.node_id
+            table = routing.get(idx)
+            if table is not None and did is not None:
+                meta = st.metadata["indices"].get(idx, {})
+                from .cluster_node import shard_for
+                droute = (d or {}).get("routing", (d or {}).get("_routing"))
+                sid = shard_for(str(did), droute,
+                                int(meta.get("num_shards", 1)))
+                entry = table.get(str(sid))
+                if entry is not None:
+                    target = entry["primary"]
+            status, _ct, raw = self._exec_on(target, "POST", one_path,
+                                             query, one_body)
+            try:
+                doc_out = json.loads(raw)
+            except ValueError:
+                doc_out = {"_index": idx, "_id": did}
+            if status >= 400:
+                err = doc_out.get("error", doc_out)
+                doc_out = {"_index": idx, "_id": did, "error":
+                           err if isinstance(err, dict) else
+                           {"type": "exception", "reason": str(err)}}
+            out_docs.append(doc_out)
+        return 200, "application/json", json.dumps(
+            {"docs": out_docs}).encode()
+
+    def _tasks_route(self, method, path, query, body):
+        """Cluster task APIs: every node owns a task registry; list/cancel
+        fan out and merge (the reference's ``TransportListTasksAction``
+        nodes-operation), get/cancel-by-id find the owning node (the
+        cancel broadcast IS the ban propagation — every node's manager
+        cancels its local members of the task tree)."""
+        local_status, ct, local_out = self._local(method, path, query, body)
+        is_by_id = path != "/_tasks" and "_cancel" not in path
+        merged = None
+        try:
+            merged = json.loads(local_out)
+        except ValueError:
+            return local_status, ct, local_out
+        best = (local_status, merged)
+        for n in self.node.node_ids:
+            if n == self.node.node_id:
+                continue
+            try:
+                # by-id gets may block remotely on wait_for_completion
+                # (default 30s) — the RPC must outlive that wait
+                r = self.node.rpc(n, "rest:exec", {
+                    "m": method, "p": path, "q": query, "b": _b64(body)},
+                    timeout=40.0 if is_by_id else 10.0)
+            except Exception:   # noqa: BLE001 — dead nodes skip
+                continue
+            try:
+                doc = json.loads(_unb64(r["out"]))
+            except ValueError:
+                continue
+            if is_by_id:
+                # by-id get: the first node that knows the task wins
+                if r["status"] < 400 and best[0] >= 400:
+                    best = (r["status"], doc)
+                continue
+            if r["status"] >= 400 or not isinstance(doc, dict):
+                continue
+            if best[0] >= 400:
+                best = (200, doc)
+                continue
+            tgt = best[1]
+            if isinstance(doc.get("nodes"), dict):
+                tgt.setdefault("nodes", {}).update(doc["nodes"])
+            if isinstance(doc.get("tasks"), dict):
+                tgt.setdefault("tasks", {}).update(doc["tasks"])
+            elif isinstance(doc.get("tasks"), list):
+                tgt.setdefault("tasks", []).extend(doc["tasks"])
+        status, doc = best
+        return status, "application/json", json.dumps(doc).encode()
+
     def _broadcast(self, method, path, query, body):
         for n in self.node.node_ids:
             if n == self.node.node_id:
@@ -916,60 +1087,117 @@ class ClusterRestService:
     # cluster-aware admin views
     # ------------------------------------------------------------------
 
-    def _health(self, query: str):
-        params = dict(p.split("=", 1) for p in query.split("&")
+    #: waits the cluster front resolves itself (against the CLUSTER node
+    #: set and routing) instead of the local single-node view
+    _WAIT_PARAMS = ("wait_for_status", "wait_for_nodes",
+                    "wait_for_active_shards", "timeout")
+
+    def _health(self, method, path, query, body):
+        """Cluster health: the local api renders the full response shape
+        (levels, per-index sections, closed-index semantics); the
+        cluster-wide numbers and the wait_* semantics resolve here."""
+        from ..common.settings import parse_time_millis
+        params = dict(p.split("=", 1) for p in (query or "").split("&")
                       if "=" in p)
-        want = params.get("wait_for_status")
-        timeout = 5.0
-        deadline = time.monotonic() + timeout
+        want_status = params.get("wait_for_status")
+        want_nodes = params.get("wait_for_nodes")
+        want_active = params.get("wait_for_active_shards")
+        try:
+            timeout_s = parse_time_millis(
+                params.get("timeout", "30s")) / 1e3
+        except Exception:   # noqa: BLE001
+            timeout_s = 30.0
+        timeout_s = min(timeout_s, 30.0)
+        base_q = "&".join(f"{k}={v}" for k, v in params.items()
+                          if k not in self._WAIT_PARAMS)
         order = {"red": 0, "yellow": 1, "green": 2}
+        deadline = time.monotonic() + timeout_s
         while True:
-            doc = self._health_doc()
-            if want is None or order[doc["status"]] >= order.get(want, 0):
-                break
+            status_code, ct, out = self._local(method, path, base_q, body)
+            try:
+                doc = json.loads(out)
+            except ValueError:
+                return status_code, ct, out
+            if status_code != 200 or not isinstance(doc, dict):
+                return status_code, ct, out
+            st = self.node.applied_state
+            nodes = sorted(st.nodes) if st else []
+            doc["number_of_nodes"] = len(nodes)
+            doc["number_of_data_nodes"] = len(nodes)
+            # scope shard counting to the indices the request selected
+            # (level/index-pattern health) — the local doc's indices
+            # section names them; absent section = whole cluster
+            segs = [s for s in path.split("/") if s]
+            selected = None
+            if len(segs) >= 3:                    # /_cluster/health/{idx}
+                try:
+                    with self.lock:
+                        selected = set(self.indices.resolve(segs[2]))
+                    ew = params.get("expand_wildcards", "open")
+                    with self.lock:
+                        closed = {n for n in selected
+                                  if self.indices.indices[n].closed}
+                    if "all" not in ew:
+                        if "closed" not in ew:
+                            selected -= closed
+                        if "open" not in ew and ew:
+                            selected &= closed
+                except _errors.ElasticsearchError:
+                    selected = set()
+            cstatus, active, unassigned = self._cluster_shards_view(
+                nodes, selected)
+            if cstatus is not None:
+                doc["status"] = cstatus
+                doc["unassigned_shards"] = unassigned
+                doc["active_shards"] = active
+            ok = True
+            if want_status is not None and order.get(
+                    doc.get("status"), 0) < order.get(want_status, 0):
+                ok = False
+            if want_nodes is not None and \
+                    not _nodes_predicate(want_nodes, len(nodes)):
+                ok = False
+            if want_active not in (None, "", "all"):
+                try:
+                    if int(want_active) > doc.get("active_shards", 0):
+                        ok = False
+                except ValueError:
+                    pass
+            if ok:
+                return 200, "application/json", json.dumps(doc).encode()
             if time.monotonic() > deadline:
                 doc["timed_out"] = True
-                break
+                return 408, "application/json", json.dumps(doc).encode()
             time.sleep(0.05)
-        return 200, "application/json", json.dumps(doc).encode()
 
-    def _health_doc(self) -> dict:
+    def _cluster_shards_view(self, nodes, selected=None):
+        """(status, active_shards, unassigned) from the published routing
+        table; (None, 0, 0) when no routing exists yet. ``selected``
+        restricts to an index subset (index-pattern health)."""
         st = self.node.applied_state
-        nodes = sorted(st.nodes) if st else []
         routing = st.data.get("routing", {}) if st else {}
-        n_primary = n_unassigned_replicas = 0
+        if selected is not None:
+            routing = {n: t for n, t in routing.items() if n in selected}
+        if not routing:
+            return (None, 0, 0) if selected is None else ("green", 0, 0)
+        active = unassigned = 0
         status = "green"
-        for table in routing.values():
+        for name, table in routing.items():
+            meta = st.metadata["indices"].get(name, {})
+            want = int(meta.get("num_replicas", 0))
             for entry in table.values():
-                n_primary += 1
-                if entry["primary"] not in nodes:
+                if entry["primary"] in nodes:
+                    active += 1
+                else:
                     status = "red"
-        if status != "red":
-            for name, table in routing.items():
-                meta = st.metadata["indices"].get(name, {})
-                want = int(meta.get("num_replicas", 0))
-                for entry in table.values():
-                    missing = want - len(entry["replicas"])
-                    if missing > 0:
-                        n_unassigned_replicas += missing
+                    unassigned += 1
+                have = len([r for r in entry["replicas"] if r in nodes])
+                active += have
+                if have < want:
+                    unassigned += want - have
+                    if status != "red":
                         status = "yellow"
-        return {
-            "cluster_name": "elasticsearch_tpu",
-            "status": status,
-            "timed_out": False,
-            "number_of_nodes": len(nodes),
-            "number_of_data_nodes": len(nodes),
-            "active_primary_shards": n_primary,
-            "active_shards": n_primary,
-            "relocating_shards": 0,
-            "initializing_shards": 0,
-            "unassigned_shards": n_unassigned_replicas,
-            "delayed_unassigned_shards": 0,
-            "number_of_pending_tasks": 0,
-            "number_of_in_flight_fetch": 0,
-            "task_max_waiting_in_queue_millis": 0,
-            "active_shards_percent_as_number": 100.0,
-        }
+        return status, active, unassigned
 
     def _alloc_explain(self, body: bytes):
         """GET /_cluster/allocation/explain — per-node decider verdicts
@@ -1026,17 +1254,47 @@ class ClusterRestService:
             raise _errors.ElasticsearchError("no known master")
         return 200, "application/json", json.dumps(out).encode()
 
-    def _cluster_state(self):
+    def _cluster_state(self, method, path, query, body):
+        """Serve the LOCAL api's full cluster-state rendering (metric
+        filtering, blocks, voting exclusions, cluster_uuid — the local
+        service holds all metadata via op-log replay) and patch in the
+        cluster-wide sections: master, the real node set, version, and
+        the published routing table."""
+        status, ct, out = self._local(method, path, query, body)
+        if status != 200:
+            return status, ct, out
+        try:
+            doc = json.loads(out)
+        except ValueError:
+            return status, ct, out
         st = self.node.applied_state
-        doc = {
-            "cluster_name": "elasticsearch_tpu",
-            "master_node": st.master_node if st else None,
-            "version": st.version if st else 0,
-            "nodes": {n: {"name": n} for n in (st.nodes if st else {})},
-            "metadata": {"indices": dict(
-                st.metadata["indices"] if st else {})},
-            "routing_table": dict(st.data.get("routing", {}) if st else {}),
-        }
+        if st is None or not isinstance(doc, dict):
+            return status, ct, out
+        if "master_node" in doc:
+            doc["master_node"] = st.master_node
+        if "nodes" in doc:
+            doc["nodes"] = {
+                n: {"name": n, "ephemeral_id": n,
+                    "transport_address": "127.0.0.1:9300",
+                    "attributes": {}, "roles": ["data", "ingest",
+                                                "master"]}
+                for n in sorted(st.nodes)}
+        if "version" in doc:
+            doc["version"] = st.version
+        if "routing_table" in doc and st.data.get("routing"):
+            # respect the local handler's index filtering: only patch
+            # the indices its rendering selected
+            sel = doc["routing_table"].get("indices") \
+                if isinstance(doc["routing_table"], dict) else None
+            doc["routing_table"] = {
+                "indices": {
+                    n: {"shards": {
+                        sid: [{"state": "STARTED", "primary": True,
+                               "node": e["primary"], "index": n,
+                               "shard": int(sid)}]
+                        for sid, e in table.items()}}
+                    for n, table in st.data["routing"].items()
+                    if sel is None or n in sel}}
         if self.meta_divergent:
             doc["meta_divergent"] = True
         return 200, "application/json", json.dumps(doc).encode()

@@ -112,6 +112,21 @@ class IndexService:
         if self.closed:
             raise IndexClosedError(f"closed index [{self.name}]")
 
+    def _check_write_block(self) -> None:
+        """Write-level index blocks (reference: ``IndexMetadata``
+        INDEX_WRITE_BLOCK / INDEX_READ_ONLY_BLOCK; set via the add-block
+        API or ``index.blocks.*`` settings)."""
+        from ..common.errors import ClusterBlockError
+        s = self.settings
+        for key, desc in (("index.blocks.write", "index write (api)"),
+                          ("index.blocks.read_only", "index read-only"),
+                          ("index.blocks.read_only_allow_delete",
+                           "index read-only / allow delete (api)")):
+            if str(s.get(key, "")).lower() == "true":
+                raise ClusterBlockError(
+                    f"index [{self.name}] blocked by: [FORBIDDEN/8/"
+                    f"{desc}];")
+
     # -- routing ------------------------------------------------------------
 
     def shard_id_for(self, doc_id: str, routing: Optional[str] = None) -> int:
@@ -127,6 +142,7 @@ class IndexService:
                   routing: Optional[str] = None, op_type: str = "index",
                   if_seq_no=None, if_primary_term=None):
         self._check_open()
+        self._check_write_block()
         if self.cluster_hooks is not None:
             w = self.cluster_hooks.writer(self.name, self.shard_id_for(
                 doc_id, routing))
@@ -150,6 +166,7 @@ class IndexService:
     def delete_doc(self, doc_id: str, *, routing: Optional[str] = None,
                    if_seq_no=None, if_primary_term=None):
         self._check_open()
+        self._check_write_block()
         if self.cluster_hooks is not None:
             w = self.cluster_hooks.writer(self.name, self.shard_id_for(
                 doc_id, routing))

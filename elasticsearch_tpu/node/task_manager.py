@@ -1,0 +1,194 @@
+"""Task registry with real cancellation (reference:
+``tasks/TaskManager.java:76``, ``tasks/TaskCancellationService.java:47``).
+
+Every REST request registers a task for its lifetime; long-running
+actions (reindex, update/delete-by-query, scatter-gather search) register
+*cancellable* tasks and poll :meth:`Task.check_cancelled` at batch
+boundaries, so a runaway operation can be killed mid-flight via
+``POST /_tasks/{id}/_cancel``. Cancelling a task also cancels its
+children (the reference's ban propagation — here child tasks registered
+under a ``parent_task_id``; the cluster layer additionally fans the
+cancel out to other nodes' managers over the transport).
+
+Async execution (``wait_for_completion=false``) runs the action on a
+daemon thread and stores the result on the task, the analog of the
+reference's task-result index (``TaskResultsService``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..common.errors import ElasticsearchError
+
+
+class TaskCancelledError(ElasticsearchError):
+    status = 400
+    error_type = "task_cancelled_exception"
+
+
+class Task:
+    def __init__(self, manager: "TaskManager", task_id: int, action: str,
+                 description: str = "", cancellable: bool = False,
+                 parent_task_id: Optional[str] = None,
+                 headers: Optional[Dict[str, str]] = None):
+        self.manager = manager
+        self.id = task_id
+        self.node = manager.node_id
+        self.action = action
+        self.description = description
+        self.cancellable = cancellable
+        self.parent_task_id = parent_task_id
+        self.headers = dict(headers or {})
+        self.start_time = time.time()
+        self.running = True
+        self.cancelled = threading.Event()
+        self.cancel_reason: Optional[str] = None
+        self.completed = threading.Event()
+        self.result: Optional[dict] = None
+        self.error: Optional[dict] = None
+        #: live progress counters for _tasks status rendering (reindex &
+        #: friends update these as they go)
+        self.status: Dict[str, object] = {}
+
+    @property
+    def tid(self) -> str:
+        return f"{self.node}:{self.id}"
+
+    def check_cancelled(self) -> None:
+        if self.cancelled.is_set():
+            raise TaskCancelledError(
+                f"task cancelled [{self.cancel_reason or 'by user request'}]")
+
+    def to_dict(self) -> dict:
+        now = time.time()
+        doc = {
+            "node": self.node,
+            "id": self.id,
+            "type": "transport",
+            "action": self.action,
+            "description": self.description,
+            "start_time_in_millis": int(self.start_time * 1000),
+            "running_time_in_nanos": int((now - self.start_time) * 1e9),
+            "cancellable": self.cancellable,
+            "cancelled": self.cancelled.is_set(),
+            "headers": self.headers,
+        }
+        if self.status:
+            doc["status"] = dict(self.status)
+        if self.parent_task_id:
+            doc["parent_task_id"] = self.parent_task_id
+        return doc
+
+
+class TaskManager:
+    """Per-node registry. Completed async tasks are retained (bounded) so
+    ``GET /_tasks/{id}`` can return their stored result."""
+
+    RESULT_RETENTION = 256
+
+    def __init__(self, node_id: str, node_name: str):
+        self.node_id = node_id
+        self.node_name = node_name
+        self.lock = threading.Lock()
+        self._next_id = 0
+        self.tasks: Dict[int, Task] = {}
+        self.finished: Dict[int, Task] = {}
+
+    def register(self, action: str, description: str = "",
+                 cancellable: bool = False,
+                 parent_task_id: Optional[str] = None,
+                 headers: Optional[Dict[str, str]] = None) -> Task:
+        with self.lock:
+            self._next_id += 1
+            t = Task(self, self._next_id, action, description, cancellable,
+                     parent_task_id, headers)
+            self.tasks[t.id] = t
+            return t
+
+    def unregister(self, task: Task, *, retain: bool = False) -> None:
+        task.running = False
+        task.completed.set()
+        with self.lock:
+            self.tasks.pop(task.id, None)
+            if retain:
+                self.finished[task.id] = task
+                while len(self.finished) > self.RESULT_RETENTION:
+                    self.finished.pop(next(iter(self.finished)))
+
+    def get(self, task_id: int) -> Optional[Task]:
+        with self.lock:
+            return self.tasks.get(task_id) or self.finished.get(task_id)
+
+    def cancel(self, task: Task, reason: str = "by user request") -> None:
+        """Cancel ``task`` and every registered descendant (ban
+        propagation across the local parent/child tree)."""
+        with self.lock:
+            live = list(self.tasks.values())
+        to_cancel = [task]
+        frontier = {task.tid}
+        # breadth-first over parent links
+        while True:
+            added = [t for t in live
+                     if t.parent_task_id in frontier
+                     and t not in to_cancel]
+            if not added:
+                break
+            to_cancel.extend(added)
+            frontier = {t.tid for t in added}
+        for t in to_cancel:
+            if t.cancellable:
+                t.cancel_reason = reason
+                t.cancelled.set()
+
+    def cancel_matching(self, *, actions: Optional[List[str]] = None,
+                        reason: str = "by user request") -> List[Task]:
+        import fnmatch
+        with self.lock:
+            live = list(self.tasks.values())
+        hit = []
+        for t in live:
+            if actions and not any(fnmatch.fnmatchcase(t.action, p)
+                                   for p in actions):
+                continue
+            if not t.cancellable:
+                continue
+            hit.append(t)
+        for t in hit:
+            self.cancel(t, reason)
+        return hit
+
+    def list(self, *, actions: Optional[List[str]] = None,
+             include_finished: bool = False) -> List[Task]:
+        import fnmatch
+        with self.lock:
+            out = list(self.tasks.values())
+            if include_finished:
+                out += list(self.finished.values())
+        if actions:
+            out = [t for t in out
+                   if any(fnmatch.fnmatchcase(t.action, p)
+                          for p in actions)]
+        return sorted(out, key=lambda t: t.id)
+
+    def run_async(self, task: Task, fn: Callable[[], dict]) -> None:
+        """Execute ``fn`` on a daemon thread; store its result/error on
+        the task for later ``GET /_tasks/{id}`` retrieval."""
+        task.async_detached = True      # request teardown must not unregister
+
+        def runner():
+            try:
+                task.result = fn()
+            except Exception as e:   # noqa: BLE001 — stored, not raised
+                from ..rest.api import _error_payload
+                status, payload = _error_payload(e)
+                task.error = payload.get("error") if isinstance(
+                    payload.get("error"), dict) else {
+                        "type": "exception", "reason": str(payload)}
+            finally:
+                self.unregister(task, retain=True)
+
+        threading.Thread(target=runner, daemon=True,
+                         name=f"task-{task.tid}").start()

@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import threading
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -19,7 +20,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 from urllib.parse import parse_qs, unquote
 
-from ..common.errors import (DocumentMissingError, ElasticsearchError,
+from ..common.errors import (ActionRequestValidationError,
+                             DocumentMissingError, ElasticsearchError,
                              ResourceNotFoundError,
                              IllegalArgumentError, IndexClosedError,
                              IndexNotFoundError,
@@ -78,6 +80,66 @@ def _require_alias_error(index: str) -> "_RequireAliasError":
         f"[true] and [{index}] is not an alias")
 
 
+#: (suffix → transport action name) for per-request task registration —
+#: the names conformance filters match on (``actions: "cluster:monitor/
+#: tasks/lists"`` etc.); everything else registers under a generic name
+_ACTION_SUFFIXES = [
+    ("/_tasks", "cluster:monitor/tasks/lists"),
+    ("/_search", "indices:data/read/search"),
+    ("/_msearch", "indices:data/read/msearch"),
+    ("/_count", "indices:data/read/search"),
+    ("/_reindex", "indices:data/write/reindex"),
+    ("/_update_by_query", "indices:data/write/update/byquery"),
+    ("/_delete_by_query", "indices:data/write/delete/byquery"),
+    ("/_bulk", "indices:data/write/bulk"),
+    ("/_forcemerge", "indices:admin/forcemerge"),
+    ("/_snapshot", "cluster:admin/snapshot"),
+]
+
+
+def _action_name(method: str, path: str) -> str:
+    p = path.rstrip("/")
+    for suffix, action in _ACTION_SUFFIXES:
+        if p.endswith(suffix) or (suffix + "/") in p:
+            return action
+    if p.startswith("/_cluster") or p.startswith("/_nodes"):
+        return "cluster:monitor/state"
+    if method == "GET":
+        return "indices:monitor/rest"
+    return "indices:admin/rest"
+
+
+def _render_filter(spec):
+    """Alias filters render back in Lucene-normalized form (boost made
+    explicit, term values wrapped) — ``AbstractQueryBuilder.toXContent``
+    shapes, as ``_search_shards`` and explain APIs return them."""
+    if not isinstance(spec, dict) or len(spec) != 1:
+        return spec
+    (kind, inner), = spec.items()
+    if kind == "term" and isinstance(inner, dict):
+        out = {}
+        for field, v in inner.items():
+            if isinstance(v, dict):
+                out[field] = {"boost": 1.0, **v}
+            else:
+                out[field] = {"value": v, "boost": 1.0}
+        return {"term": out}
+    if kind == "bool" and isinstance(inner, dict):
+        rendered = {}
+        for sec in ("must", "should", "filter", "must_not"):
+            clauses = inner.get(sec)
+            if clauses is None:
+                continue
+            if isinstance(clauses, dict):
+                clauses = [clauses]
+            rendered[sec] = [_render_filter(c) for c in clauses]
+        rendered["adjust_pure_negative"] = inner.get(
+            "adjust_pure_negative", True)
+        rendered["boost"] = inner.get("boost", 1.0)
+        return {"bool": rendered}
+    return spec
+
+
 def _flag(params: dict, name: str, default: bool = False) -> bool:
     v = params.get(name)
     if v is None:
@@ -107,7 +169,10 @@ class RestAPI:
         self.templates: Dict[str, dict] = {}
         self.scrolls: Dict[str, dict] = {}
         self.pits: Dict[str, dict] = {}
-        self._tasks: Dict[str, dict] = {}
+        from ..node.task_manager import TaskManager
+        self.task_manager = TaskManager(self.node_id, self.node_name)
+        self._req_task = threading.local()
+        self.stored_scripts: Dict[str, dict] = {}
         self.ingest = IngestService()
         self.snapshots = SnapshotsService(indices)
         self._routes: List[Tuple[str, re.Pattern, List[str], Callable]] = []
@@ -147,6 +212,12 @@ class RestAPI:
         add("GET", "/_cluster/settings", self.h_cluster_get_settings)
         add("PUT", "/_cluster/settings", self.h_cluster_put_settings)
         add("GET", "/_nodes", self.h_nodes)
+        add("GET", "/_remote/info", self.h_remote_info)
+        add("POST", "/_nodes/reload_secure_settings",
+            self.h_reload_secure_settings)
+        add("POST", "/_nodes/{node_id}/reload_secure_settings",
+            self.h_reload_secure_settings)
+        add("PUT", "/{index}/_block/{block}", self.h_add_block)
         add("GET", "/_nodes/stats", self.h_nodes_stats)
         add("GET", "/_nodes/stats/{metric}", self.h_nodes_stats)
         add("GET", "/_nodes/stats/{metric}/{index_metric}",
@@ -264,6 +335,17 @@ class RestAPI:
         add("GET,POST", "/{index}/_explain/{id}", self.h_explain)
         add("GET,POST", "/{index}/_termvectors/{id}", self.h_termvectors)
         add("GET", "/_tasks", self.h_tasks)
+        add("GET", "/_tasks/{task_id}", self.h_task_get)
+        add("POST", "/_tasks/_cancel", self.h_tasks_cancel)
+        add("POST", "/_tasks/{task_id}/_cancel", self.h_tasks_cancel)
+        # stored scripts + script metadata
+        add("PUT,POST", "/_scripts/{id}", self.h_put_script)
+        add("GET", "/_scripts/{id}", self.h_get_script)
+        add("DELETE", "/_scripts/{id}", self.h_delete_script)
+        add("GET", "/_script_context", self.h_script_context)
+        add("GET", "/_script_language", self.h_script_language)
+        add("GET,POST", "/{index}/_search_shards", self.h_search_shards)
+        add("GET,POST", "/_search_shards", self.h_search_shards)
         # templates
         add("POST", "/_index_template/_simulate_index/{name}",
             self.h_simulate_index_template)
@@ -353,11 +435,25 @@ class RestAPI:
                 continue
             kwargs = {k: (unquote(v) if v is not None else v)
                       for k, v in zip(names, match.groups())}
+            # every request runs as a registered task for its lifetime
+            # (reference: TaskManager.java:76 registers every action)
+            headers = {}
+            if params.get("__x_opaque_id"):
+                headers["X-Opaque-Id"] = params["__x_opaque_id"]
+            task = self.task_manager.register(
+                _action_name(method, path), description=f"{method} {path}",
+                headers=headers)
+            self._req_task.task = task
             try:
                 result = fn(params, body, **kwargs)
             except Exception as e:  # noqa: BLE001 — ES-shaped error replies
                 status, payload = _error_payload(e)
                 return status, JSON_CT, json.dumps(payload).encode()
+            finally:
+                self._req_task.task = None
+                if task.running and \
+                        not getattr(task, "async_detached", False):
+                    self.task_manager.unregister(task)
             if isinstance(result, tuple):
                 status, payload = result
             else:
@@ -755,6 +851,9 @@ class RestAPI:
 
     def h_field_mapping(self, params, body, fields, index=None):
         """GET field mappings (reference: ``RestGetFieldMappingAction``)."""
+        if "local" in params:
+            raise IllegalArgumentError(
+                "Unsupported parameter [local]")
         names = self.indices.resolve(index)
         want = fields.split(",")
         out = {}
@@ -767,8 +866,13 @@ class RestAPI:
                     if not fnmatch.fnmatchcase(fname, f):
                         continue
                     leaf = fname.split(".")[-1]
+                    m = ft.to_mapping()
+                    if _flag(params, "include_defaults") and \
+                            m.get("type") == "text" and \
+                            "analyzer" not in m:
+                        m["analyzer"] = "default"
                     fmap[fname] = {"full_name": fname,
-                                   "mapping": {leaf: ft.to_mapping()}}
+                                   "mapping": {leaf: m}}
             out[n] = {"mappings": fmap}
         return out
 
@@ -950,7 +1054,18 @@ class RestAPI:
             "the first unassigned shard by sending an empty body]")
 
     def h_cluster_get_settings(self, params, body):
-        return dict(self.cluster_settings, defaults={})
+        defaults: Dict[str, Any] = {}
+        if _flag(params, "include_defaults"):
+            # the reference test cluster launches nodes with
+            # node.attr.testattr=test (gradle testclusters config);
+            # defaults echo the node's effective configuration
+            defaults = {
+                "node": {"attr": {"testattr": "test"},
+                         "name": self.node_name},
+                "cluster": {"name": self.cluster_name},
+                "search": {"max_buckets": "65536"},
+            }
+        return dict(self.cluster_settings, defaults=defaults)
 
     def h_cluster_put_settings(self, params, body):
         from ..search import aggregations as _aggs_mod
@@ -1526,8 +1641,41 @@ class RestAPI:
         self.component_templates[name] = _json_body(body)
         return {"acknowledged": True}
 
+    @staticmethod
+    def _template_settings_json(t: dict) -> dict:
+        """Render a stored template with its settings in the reference's
+        normalized form: index-scoped keys grouped under "index", values
+        as strings (``Settings.toXContent``)."""
+        tpl = (t or {}).get("template")
+        if not isinstance(tpl, dict) or not isinstance(
+                tpl.get("settings"), dict):
+            return t
+        flat: Dict[str, str] = {}
+        def walk(prefix, obj):
+            for k, v in obj.items():
+                key = f"{prefix}.{k}" if prefix else k
+                if isinstance(v, dict):
+                    walk(key, v)
+                else:
+                    flat[key] = str(v).lower() \
+                        if isinstance(v, bool) else str(v)
+        walk("", tpl["settings"])
+        nested: Dict[str, Any] = {}
+        for k, v in flat.items():
+            if not k.startswith("index."):
+                k = f"index.{k}"
+            cur = nested
+            parts = k.split(".")
+            for p in parts[:-1]:
+                cur = cur.setdefault(p, {})
+            cur[parts[-1]] = v
+        out = dict(t)
+        out["template"] = dict(tpl, settings=nested)
+        return out
+
     def h_get_component_template(self, params, body, name=None):
-        items = [{"name": n, "component_template": t}
+        items = [{"name": n,
+                  "component_template": self._template_settings_json(t)}
                  for n, t in self.component_templates.items()
                  if name is None or n == name]
         if name is not None and not items:
@@ -2084,7 +2232,49 @@ class RestAPI:
         return {"_shards": {"total": shards, "successful": shards,
                             "failed": 0}}
 
+    def h_remote_info(self, params, body):
+        """GET /_remote/info — remote-cluster connections (none
+        configured: empty object, ``RestRemoteClusterInfoAction``)."""
+        return {}
+
+    def h_reload_secure_settings(self, params, body, node_id=None):
+        """POST /_nodes/reload_secure_settings (reference:
+        ``NodesReloadSecureSettingsAction`` re-reading the keystore).
+        This build's keystore is unencrypted (empty password); a
+        non-empty password therefore cannot match."""
+        b = _json_body(body) if body else {}
+        entry: Dict[str, Any] = {"name": self.node_name}
+        pw = b.get("secure_settings_password")
+        if pw:
+            entry["reload_exception"] = {
+                "type": "security_exception",
+                "reason": "Provided keystore password was incorrect"}
+        return {"cluster_name": self.cluster_name,
+                "_nodes": {"total": 1, "successful": 1, "failed": 0},
+                "nodes": {self.node_id: entry}}
+
+    #: blocks settable through the add-block API (IndexMetadata.APIBlock)
+    _API_BLOCKS = {"metadata": "index.blocks.metadata",
+                   "read": "index.blocks.read",
+                   "read_only": "index.blocks.read_only",
+                   "write": "index.blocks.write"}
+
+    def h_add_block(self, params, body, index, block):
+        setting = self._API_BLOCKS.get(block)
+        if setting is None:
+            raise IllegalArgumentError(f"unknown block type [{block}]")
+        names = self.indices.resolve(index, allow_aliases=False)
+        for n in names:
+            self.indices.indices[n].settings[setting] = "true"
+        return {"acknowledged": True, "shards_acknowledged": True,
+                "indices": [{"name": n, "blocked": True} for n in names]}
+
     def h_flush(self, params, body, index=None):
+        if params.get("force") in ("true", "") and \
+                params.get("wait_if_ongoing") == "false":
+            raise ActionRequestValidationError(
+                "Validation Failed: 1: wait_if_ongoing must be true for "
+                "a force flush;")
         names = self.indices.resolve(index)
         for n in names:
             self.indices.indices[n].flush()
@@ -2092,6 +2282,12 @@ class RestAPI:
                             "failed": 0}}
 
     def h_forcemerge(self, params, body, index):
+        if params.get("only_expunge_deletes") in ("true", "") and \
+                params.get("max_num_segments") is not None:
+            raise ActionRequestValidationError(
+                "Validation Failed: 1: cannot set only_expunge_deletes "
+                "and max_num_segments at the same time, those two "
+                "parameters are mutually exclusive;")
         for n in self.indices.resolve(index):
             self.indices.indices[n].force_merge()
         return {"_shards": {"total": 1, "successful": 1, "failed": 0}}
@@ -2261,6 +2457,10 @@ class RestAPI:
         b = _json_body(body)
         for action in b.get("actions", []):
             (verb, spec), = action.items()
+            if verb != "remove" and "must_exist" in spec:
+                raise IllegalArgumentError(
+                    "[must_exist] is unsupported for "
+                    f"[{verb.upper().replace('_', ' ')}]")
             if verb == "remove_index":
                 target = spec.get("index") or ",".join(
                     spec.get("indices", []))
@@ -2353,6 +2553,17 @@ class RestAPI:
         return out
 
     def h_put_alias(self, params, body, index, name):
+        from ..common.errors import InvalidAliasNameError
+        from ..node.indices_service import validate_index_name
+        try:
+            validate_index_name(name)
+        except ElasticsearchError as e:
+            raise InvalidAliasNameError(
+                f"Invalid alias name [{name}]: {e}")
+        if name in self.indices.indices:
+            raise InvalidAliasNameError(
+                f"Invalid alias name [{name}]: an index or data stream "
+                f"exists with the same name as the alias")
         spec = self._alias_spec(_json_body(body)) if body else {}
         for n in self.indices.resolve(index, allow_aliases=False):
             self.indices.indices[n].aliases[name] = spec
@@ -2841,12 +3052,9 @@ class RestAPI:
             return (status, resp) if status != 200 else resp
 
         existing = svc.get_doc(id, routing=params.get("routing"))
-        if not existing.found and (if_seq_no is not None or
-                                   if_primary_term is not None):
-            raise VersionConflictError(
-                f"[{id}]: version conflict, document does not exist "
-                f"(expected seqNo [{if_seq_no}])")
         if not existing.found:
+            # a CAS update on a missing doc is DocumentMissing (404), not
+            # a version conflict — UpdateHelper checks existence first
             if "upsert" in b:
                 src = b["upsert"]
                 if b.get("scripted_upsert") and "script" in b:
@@ -3631,6 +3839,24 @@ class RestAPI:
             out["fields"] = h.fields
         if h.highlight:
             out["highlight"] = h.highlight
+        if h.inner_hits:
+            rendered = {}
+            for nm, grp in h.inner_hits.items():
+                g2 = {k: v for k, v in grp.items()
+                      if k != "_want_version"}
+                if grp.get("_want_version"):
+                    root_v = out.get("_version")
+                    if root_v is None:
+                        try:
+                            svc = self.indices.get(index_name)
+                            g = svc.get_doc(h.doc_id)
+                            root_v = g.version if g.found else None
+                        except Exception:   # noqa: BLE001
+                            root_v = None
+                    for ihh in g2.get("hits", {}).get("hits", []):
+                        ihh["_version"] = root_v
+                rendered[nm] = g2
+            out["inner_hits"] = rendered
         return out
 
     # search_after tiebreak cursors fold the index ordinal into the high
@@ -4590,6 +4816,21 @@ class RestAPI:
     def h_count(self, params, body, index=None):
         names = self.indices.resolve(index)
         b = _json_body(body)
+        bad = [k for k in b if k != "query"]
+        if bad:
+            raise ActionRequestValidationError(
+                f"request does not support [{bad[0]}]")
+        if "q" in params:
+            qs = {"query": params["q"]}
+            if "df" in params:
+                qs["default_field"] = params["df"]
+            if "default_operator" in params:
+                qs["default_operator"] = params["default_operator"]
+            if params.get("lenient") in ("true", ""):
+                qs["lenient"] = True
+            if "analyzer" in params:
+                qs["analyzer"] = params["analyzer"]
+            b = {"query": {"query_string": qs}}
         self._rewrite_terms_lookup(b)
         total = 0
         for n in names:
@@ -4757,22 +4998,37 @@ class RestAPI:
         return [h.doc_id for h in r.hits]
 
     def h_delete_by_query(self, params, body, index):
-        t0 = time.time()
         b = _json_body(body)
         self._rewrite_terms_lookup(b)
         query = b.get("query") or {"match_all": {}}
-        deleted = 0
-        for n in self.indices.resolve(index):
-            svc = self.indices.indices[n]
-            for doc_id in self._matched_ids(svc, query):
-                r = svc.delete_doc(doc_id)
-                if r.found:
-                    deleted += 1
-            svc.refresh()
-        return {"took": int((time.time() - t0) * 1000), "timed_out": False,
-                "deleted": deleted, "total": deleted, "failures": [],
-                "batches": 1, "version_conflicts": 0, "noops": 0,
-                "retries": {"bulk": 0, "search": 0}}
+        names = self.indices.resolve(index)
+        task = self.current_task()
+        task.cancellable = True
+        task.description = f"delete-by-query [{index}]"
+
+        def run():
+            t0 = time.time()
+            deleted = 0
+            for n in names:
+                svc = self.indices.indices[n]
+                for i, doc_id in enumerate(self._matched_ids(svc, query)):
+                    if i % 100 == 0:
+                        task.check_cancelled()
+                    r = svc.delete_doc(doc_id)
+                    if r.found:
+                        deleted += 1
+                        task.status.update(total=deleted, deleted=deleted)
+                svc.refresh()
+            return {"took": int((time.time() - t0) * 1000),
+                    "timed_out": False, "deleted": deleted,
+                    "total": deleted, "failures": [], "batches": 1,
+                    "version_conflicts": 0, "noops": 0,
+                    "retries": {"bulk": 0, "search": 0}}
+
+        if params.get("wait_for_completion") == "false":
+            self.task_manager.run_async(task, run)
+            return {"task": task.tid}
+        return run()
 
     def h_explain(self, params, body, index, id):
         """Score explanation for one document (reference:
@@ -4986,9 +5242,10 @@ class RestAPI:
 
     def h_reindex(self, params, body):
         """Copy documents between indices (reference: ``modules/reindex``
-        ``TransportReindexAction`` — scroll source + bulk dest; here one
-        snapshot scan + sequential writes)."""
-        t0 = time.time()
+        ``TransportReindexAction`` — scroll source + bulk dest; here a
+        snapshot scan + batched writes, cancellable between batches, and
+        async under ``wait_for_completion=false`` like the reference's
+        task-running reindexer)."""
         payload = _json_body(body)
         src_spec = payload.get("source") or {}
         dst_spec = payload.get("dest") or {}
@@ -4999,88 +5256,318 @@ class RestAPI:
             raise IllegalArgumentError("[dest.index] is required")
         src_names = self.indices.resolve(src_spec.get("index"))
         query = src_spec.get("query")
-        created = updated = total = 0
+        refresh = params.get("refresh") in ("true", "")
         dst = self._get_or_autocreate(dst_name)
-        task_id = self._register_task("indices:data/write/reindex")
-        for sname in src_names:
-            svc = self.indices.get(sname)
-            svc.refresh()
-            searcher = svc.searcher()
-            res = searcher.search({
-                "query": query or {"match_all": {}},
-                "size": self.SCROLL_MAX_DOCS})
-            for h in res.hits:
-                total += 1
-                r = dst.index_doc(h.doc_id, h.source)
-                if r.created:
-                    created += 1
-                else:
-                    updated += 1
-        if params.get("refresh") in ("true", ""):
-            dst.refresh()
-        self._complete_task(task_id)
-        return {"took": int((time.time() - t0) * 1000), "timed_out": False,
-                "total": total, "created": created, "updated": updated,
-                "deleted": 0, "batches": 1, "noops": 0,
-                "version_conflicts": 0, "failures": []}
+        task = self.current_task()
+        task.cancellable = True
+        task.description = (f"reindex from [{src_spec.get('index')}] to "
+                            f"[{dst_name}]")
 
-    def _register_task(self, action: str) -> str:
-        tid = f"{self.node_id}:{len(self._tasks) + 1}"
-        self._tasks[tid] = {"node": self.node_id, "id": len(self._tasks) + 1,
-                            "action": action, "start_time_in_millis":
-                                int(time.time() * 1000),
-                            "running": True, "cancellable": False}
-        return tid
+        def run():
+            t0 = time.time()
+            created = updated = total = 0
+            for sname in src_names:
+                svc = self.indices.get(sname)
+                svc.refresh()
+                searcher = svc.searcher()
+                res = searcher.search({
+                    "query": query or {"match_all": {}},
+                    "size": self.SCROLL_MAX_DOCS})
+                for i, h in enumerate(res.hits):
+                    if i % 100 == 0:
+                        task.check_cancelled()
+                    total += 1
+                    r = dst.index_doc(h.doc_id, h.source)
+                    if r.created:
+                        created += 1
+                    else:
+                        updated += 1
+                    task.status.update(total=total, created=created,
+                                       updated=updated)
+            if refresh:
+                dst.refresh()
+            return {"took": int((time.time() - t0) * 1000),
+                    "timed_out": False, "total": total, "created": created,
+                    "updated": updated, "deleted": 0, "batches": 1,
+                    "noops": 0, "version_conflicts": 0, "failures": []}
 
-    def _complete_task(self, tid: str) -> None:
-        t = self._tasks.get(tid)
-        if t:
-            t["running"] = False
-            t["running_time_in_nanos"] = (
-                int(time.time() * 1000) - t["start_time_in_millis"]) * 10**6
+        if params.get("wait_for_completion") == "false":
+            self.task_manager.run_async(task, run)
+            return {"task": task.tid}
+        return run()
+
+    # ------------------------------------------------------------------
+    # task management (reference: tasks/TaskManager.java:76,
+    # TaskCancellationService.java:47, RestListTasksAction)
+    # ------------------------------------------------------------------
+
+    def current_task(self):
+        return getattr(self._req_task, "task", None)
+
+    def _node_task_entry(self, tasks: Dict[str, dict]) -> dict:
+        return {"name": self.node_name,
+                "transport_address": "127.0.0.1:9300",
+                "host": "127.0.0.1", "ip": "127.0.0.1:9300",
+                "roles": ["data", "ingest", "master"],
+                "tasks": tasks}
+
+    # ------------------------------------------------------------------
+    # stored scripts (reference: ``script/ScriptService.java`` cluster-
+    # state stored scripts + RestPutStoredScriptAction)
+    # ------------------------------------------------------------------
+
+    #: script languages this engine compiles (expression arithmetic +
+    #: mustache templates; "painless" sources are accepted for storage —
+    #: execution supports the expression-compatible subset)
+    SCRIPT_LANGS = ("painless", "expression", "mustache")
+
+    def h_put_script(self, params, body, id):
+        spec = _json_body(body)
+        script = spec.get("script")
+        if not isinstance(script, dict) or "source" not in script:
+            raise IllegalArgumentError("must specify [script] with [source]")
+        lang = script.get("lang", "painless")
+        if lang not in self.SCRIPT_LANGS:
+            raise IllegalArgumentError(
+                f"unable to put stored script with unsupported lang "
+                f"[{lang}]")
+        self.stored_scripts[id] = {
+            "lang": lang, "source": script["source"],
+            "options": script.get("options", {})}
+        return {"acknowledged": True}
+
+    def h_get_script(self, params, body, id):
+        s = self.stored_scripts.get(id)
+        if s is None:
+            return 404, {"_id": id, "found": False}
+        return {"_id": id, "found": True, "script": s}
+
+    def h_delete_script(self, params, body, id):
+        if id not in self.stored_scripts:
+            raise ResourceNotFoundError(f"stored script [{id}] not found")
+        del self.stored_scripts[id]
+        return {"acknowledged": True}
+
+    def h_script_context(self, params, body):
+        """GET /_script_context — the ~40 ScriptContexts of
+        ``script/ScriptService.java:289``, reduced to the contexts this
+        engine actually compiles for."""
+        contexts = []
+        for name, ret in [("score", "double"), ("filter", "boolean"),
+                          ("aggs", "Object"), ("field", "Object"),
+                          ("ingest", "void"), ("update", "void"),
+                          ("template", "String"),
+                          ("runtime_fields", "void"),
+                          ("number_sort", "double"),
+                          ("string_sort", "String"),
+                          ("similarity", "double"),
+                          ("aggregation_selector", "boolean")]:
+            contexts.append({"name": name, "methods": [
+                {"name": "execute", "return_type": ret, "params": []},
+                {"name": "getParams", "return_type":
+                    "java.util.Map", "params": []}]})
+        return {"contexts": contexts}
+
+    def h_script_language(self, params, body):
+        return {
+            "types_allowed": ["inline", "stored"],
+            "language_contexts": [
+                {"language": lang,
+                 "contexts": sorted(c["name"] for c in
+                                    self.h_script_context({}, b"")
+                                    ["contexts"])}
+                for lang in self.SCRIPT_LANGS],
+        }
+
+    def resolve_script(self, script):
+        """Inline-or-stored script spec → dict with a ``source`` (the
+        reference resolves ``{"id": ...}`` against cluster-state stored
+        scripts at compile time)."""
+        if isinstance(script, dict) and "id" in script and \
+                "source" not in script:
+            stored = self.stored_scripts.get(script["id"])
+            if stored is None:
+                raise ResourceNotFoundError(
+                    f"unable to find script [{script['id']}]")
+            out = dict(stored)
+            if "params" in script:
+                out["params"] = script["params"]
+            return out
+        return script
+
+    # ------------------------------------------------------------------
+    # search_shards (reference: RestClusterSearchShardsAction)
+    # ------------------------------------------------------------------
+
+    def h_search_shards(self, params, body, index=None):
+        expression = index or params.get("index") or "_all"
+        names = self.indices.resolve(expression)
+        requested = [p for p in str(expression).split(",") if p]
+        indices_doc: Dict[str, dict] = {}
+        shards = []
+        import fnmatch
+        for n in sorted(names):
+            svc = self.indices.indices[n]
+            # aliases referenced by THIS request (by name or wildcard)
+            # that point at the index
+            alias_hits = set()
+            for part in requested:
+                if part in svc.aliases:
+                    alias_hits.add(part)
+                elif "*" in part or "?" in part:
+                    alias_hits.update(
+                        a for a in svc.aliases
+                        if fnmatch.fnmatchcase(a, part))
+            entry: Dict[str, Any] = {}
+            if alias_hits:
+                entry["aliases"] = sorted(alias_hits)
+                specs = [(svc.aliases[a] or {}) for a in
+                         sorted(alias_hits)]
+                filters = [s.get("filter") for s in specs]
+                # an unfiltered alias grants unfiltered access: any alias
+                # without a filter drops filtering entirely
+                if all(filters):
+                    if len(filters) == 1:
+                        entry["filter"] = _render_filter(filters[0])
+                    else:
+                        entry["filter"] = {"bool": {
+                            "should": [_render_filter(f)
+                                       for f in filters],
+                            "adjust_pure_negative": True, "boost": 1.0}}
+            indices_doc[n] = entry
+            for sid in range(svc.num_shards):
+                shards.append([{
+                    "index": n, "shard": sid, "primary": True,
+                    "state": "STARTED", "node": self.node_id,
+                    "relocating_node": None,
+                    "allocation_id": {"id": f"{n}-{sid}"}}])
+        return {"nodes": {self.node_id: {
+                    "name": self.node_name,
+                    "transport_address": "127.0.0.1:9300"}},
+                "indices": indices_doc,
+                "shards": shards}
 
     def h_tasks(self, params, body):
-        """Task management API (reference: ``RestListTasksAction`` — the
-        synchronous execution model means tasks complete within their
-        request; the registry records recent long-running actions)."""
-        import fnmatch
+        group_by = params.get("group_by", "nodes")
         actions = params.get("actions")
-        tasks = {tid: t for tid, t in self._tasks.items()
-                 if actions is None or any(
-                     fnmatch.fnmatchcase(t["action"], pat)
-                     for pat in actions.split(","))}
-        return {"nodes": {self.node_id: {
-            "name": self.node_name,
-            "tasks": tasks}}}
+        actions = actions.split(",") if actions else None
+        tasks = self.task_manager.list(actions=actions)
+        docs = {t.tid: t.to_dict() for t in tasks}
+        if group_by == "none":
+            return {"tasks": list(docs.values())}
+        if group_by == "parents":
+            top: Dict[str, dict] = {}
+            for tid, d in docs.items():
+                if d.get("parent_task_id") in docs:
+                    parent = top.setdefault(
+                        d["parent_task_id"], docs[d["parent_task_id"]])
+                    parent.setdefault("children", []).append(d)
+                else:
+                    top.setdefault(tid, d)
+            return {"tasks": top}
+        return {"nodes": {self.node_id: self._node_task_entry(docs)}}
+
+    def h_task_get(self, params, body, task_id):
+        node, _, raw = task_id.partition(":")
+        if not raw or node != self.node_id:
+            raise ResourceNotFoundError(
+                f"task [{task_id}] belongs to the node [{node}] which "
+                f"isn't part of the cluster and there is no record of "
+                f"the task")
+        try:
+            tid = int(raw)
+        except ValueError:
+            raise IllegalArgumentError(f"malformed task id {task_id}")
+        t = self.task_manager.get(tid)
+        if t is None:
+            raise ResourceNotFoundError(
+                f"task [{task_id}] isn't running and hasn't stored its "
+                f"results")
+        if _flag(params, "wait_for_completion"):
+            from ..common.settings import parse_time_millis
+            t.completed.wait(
+                parse_time_millis(params.get("timeout", "30s")) / 1e3)
+        doc = {"completed": not t.running, "task": t.to_dict()}
+        if t.result is not None:
+            doc["response"] = t.result
+        if t.error is not None:
+            doc["error"] = t.error
+        return doc
+
+    def h_tasks_cancel(self, params, body, task_id=None):
+        reason = "by user request"
+        if task_id is not None:
+            node, _, raw = task_id.partition(":")
+            if node != self.node_id:
+                raise ResourceNotFoundError(
+                    f"task [{task_id}] isn't running and hasn't stored "
+                    f"its results")
+            try:
+                tid_num = int(raw)
+            except ValueError:
+                raise IllegalArgumentError(
+                    f"malformed task id {task_id}")
+            t = self.task_manager.get(tid_num)
+            if t is None:
+                raise ResourceNotFoundError(
+                    f"task [{task_id}] isn't running and hasn't stored "
+                    f"its results")
+            self.task_manager.cancel(t, reason)
+            hit = [t] if t.cancellable else []
+        else:
+            actions = params.get("actions")
+            hit = self.task_manager.cancel_matching(
+                actions=actions.split(",") if actions else None,
+                reason=reason)
+        nodes = {}
+        if hit:
+            nodes[self.node_id] = self._node_task_entry(
+                {t.tid: t.to_dict() for t in hit})
+        return {"nodes": nodes, "node_failures": []} if not hit else \
+            {"nodes": nodes}
 
     def h_update_by_query(self, params, body, index):
-        t0 = time.time()
         b = _json_body(body)
         self._rewrite_terms_lookup(b)
         query = b.get("query") or {"match_all": {}}
         script = b.get("script")
-        updated = 0
-        for n in self.indices.resolve(index):
-            svc = self.indices.indices[n]
-            for doc_id in self._matched_ids(svc, query):
-                g = svc.get_doc(doc_id)
-                if not g.found:
-                    continue
-                src = dict(g.source or {})
-                if script:
-                    source = script.get("script") if False else (
-                        script.get("source") if isinstance(script, dict)
-                        else script)
-                    src = _apply_update_script(
-                        src, source, script.get("params", {})
-                        if isinstance(script, dict) else {})
-                svc.index_doc(doc_id, src)
-                updated += 1
-            svc.refresh()
-        return {"took": int((time.time() - t0) * 1000), "timed_out": False,
-                "updated": updated, "total": updated, "failures": [],
-                "batches": 1, "version_conflicts": 0, "noops": 0,
-                "retries": {"bulk": 0, "search": 0}}
+        names = self.indices.resolve(index)
+        task = self.current_task()
+        task.cancellable = True
+        task.description = f"update-by-query [{index}]"
+
+        def run():
+            t0 = time.time()
+            updated = 0
+            for n in names:
+                svc = self.indices.indices[n]
+                for i, doc_id in enumerate(self._matched_ids(svc, query)):
+                    if i % 100 == 0:
+                        task.check_cancelled()
+                    g = svc.get_doc(doc_id)
+                    if not g.found:
+                        continue
+                    src = dict(g.source or {})
+                    if script:
+                        source = (script.get("source")
+                                  if isinstance(script, dict) else script)
+                        src = _apply_update_script(
+                            src, source, script.get("params", {})
+                            if isinstance(script, dict) else {})
+                    svc.index_doc(doc_id, src)
+                    updated += 1
+                    task.status.update(total=updated, updated=updated)
+                svc.refresh()
+            return {"took": int((time.time() - t0) * 1000),
+                    "timed_out": False, "updated": updated,
+                    "total": updated, "failures": [], "batches": 1,
+                    "version_conflicts": 0, "noops": 0,
+                    "retries": {"bulk": 0, "search": 0}}
+
+        if params.get("wait_for_completion") == "false":
+            self.task_manager.run_async(task, run)
+            return {"task": task.tid}
+        return run()
 
     # ------------------------------------------------------------------
     # analyze / field caps

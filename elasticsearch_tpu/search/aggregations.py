@@ -160,10 +160,14 @@ class AggregationContext:
     and per-segment scores (for top_hits) through the tree."""
 
     def __init__(self, mapper: MapperService, shard_ctx=None,
-                 seg_scores: Optional[Dict[str, np.ndarray]] = None):
+                 seg_scores: Optional[Dict[str, np.ndarray]] = None,
+                 wire: bool = False):
         self.mapper = mapper
         self.shard_ctx = shard_ctx
         self.seg_scores = seg_scores or {}
+        #: partials will cross the transport: aggregators that stage live
+        #: segment refs must use their data-only collect_wire form
+        self.wire = wire
 
 
 def parse_aggs(spec: dict) -> Dict[str, Aggregator]:
@@ -287,8 +291,30 @@ def run_aggregations_multi(
     return result
 
 
+def inject_mapper(aggs: Dict[str, "Aggregator"], mapper) -> None:
+    """Give every aggregator (recursively) the mapper its reduce-side
+    rendering needs (key_as_string, date formats). Locally this happens
+    as a side effect of ``collect`` (``self._mapper = ctx.mapper``); a
+    coordinator reducing REMOTE partials never ran collect, so the
+    cluster tier injects the mapper explicitly before the shared reduce
+    (the reference ships formatters inside serialized
+    ``InternalAggregation`` trees instead)."""
+    for agg in aggs.values():
+        agg._mapper = mapper
+        subs = getattr(agg, "subs", None)
+        if subs:
+            inject_mapper(subs, mapper)
+
+
+def _collect_fn(agg, ctx):
+    """collect, or collect_wire when the partial will cross the wire."""
+    if getattr(ctx, "wire", False):
+        return getattr(agg, "collect_wire", agg.collect)
+    return agg.collect
+
+
 def _sub_results(agg: "BucketAggregator", ctx, seg, bucket_mask) -> dict:
-    return {n: a.collect(ctx, seg, bucket_mask)
+    return {n: _collect_fn(a, ctx)(ctx, seg, bucket_mask)
             for n, a in agg.subs.items()}
 
 

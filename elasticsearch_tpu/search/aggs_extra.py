@@ -766,12 +766,25 @@ class DateRangeAgg(RangeAgg):
         if isinstance(ft, DateFieldType):
             self._ffmt = ft.format
 
+    def _field_fmt(self):
+        """Field date format: stashed at collect (_resolve), or derived
+        from the injected mapper when reducing REMOTE partials (the
+        coordinator never ran collect — see inject_mapper)."""
+        if self._ffmt is None:
+            mapper = getattr(self, "_mapper", None)
+            if mapper is not None:
+                from ..index.mapping import DateFieldType
+                ft = mapper.field_type(self.field)
+                if isinstance(ft, DateFieldType):
+                    self._ffmt = ft.format
+        return self._ffmt
+
     def _bounds_salt(self):
-        return self.format or self._ffmt
+        return self.format or self._field_fmt()
 
     def _parse_bound(self, v, which: str) -> float:
         from ..index.mapping import parse_date_millis
-        fmt = self.format or self._ffmt or \
+        fmt = self.format or self._field_fmt() or \
             "strict_date_optional_time||epoch_millis"
         return float(parse_date_millis(v, fmt))
 
@@ -780,7 +793,7 @@ class DateRangeAgg(RangeAgg):
 
     def _fmt_ms(self, ms: float) -> str:
         from ..index.mapping import format_date_millis
-        fmt = (self.format or self._ffmt or "").split("||")[0]
+        fmt = (self.format or self._field_fmt() or "").split("||")[0]
         if fmt == "epoch_second":
             return str(int(ms // 1000))
         if fmt == "epoch_millis":

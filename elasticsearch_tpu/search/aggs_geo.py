@@ -27,6 +27,7 @@ import numpy as np
 from ..common.errors import ParsingError
 from ..index.mapping import GeoPointFieldType, format_date_millis
 from .aggregations import (Aggregator, BucketAggregator, _bucket_payload,
+                           _sub_results,
                            _numeric_pairs, _reduce_subs)
 from .aggs_extra import SignificantTermsAgg, _live_parents
 from .positional import haversine_meters, parse_distance_meters
@@ -329,6 +330,19 @@ _ROUNDINGS = [
     ("y", _year_idx, _year_ms, (1, 5, 10, 20, 50, 100)),
 ]
 
+#: fixed-width unit sizes in ms for the vectorized index computation
+_UNIT_MS = [_MS_S, _MS_M, _MS_H, _MS_D]
+
+
+def _unit_indices(vals: np.ndarray, ri: int) -> np.ndarray:
+    """Vectorized ``_ROUNDINGS[ri]`` index computation: one numpy pass
+    for the fixed-width units; months/years fall back to the scalar
+    calendar functions (rare at realistic bucket caps)."""
+    if ri < len(_UNIT_MS):
+        return (vals // _UNIT_MS[ri]).astype(np.int64)
+    to_idx = _ROUNDINGS[ri][1]
+    return np.array([to_idx(x) for x in vals], np.int64)
+
 
 class AutoDateHistogramAgg(BucketAggregator):
     """Picks the smallest rounding from the reference's ladder whose bucket
@@ -351,6 +365,44 @@ class AutoDateHistogramAgg(BucketAggregator):
             docs, v = pairs
             vals = v[mask[docs]]
         return {"vals": vals, "triple": (ctx, seg, mask)}
+
+    def collect_wire(self, ctx, seg, mask):
+        """Data-only partial for cross-node shipping (no live segment
+        refs). Bucket counts come from value histograms — exact for
+        single-valued date fields. Sub-aggregations are pre-collected at
+        the finest k=1 rounding unit whose local bucket count stays
+        bounded; the reduce re-bins those unit buckets into the globally
+        chosen interval (units nest exactly in UTC: s→m→h→d→M→y)."""
+        pairs = _numeric_pairs(seg, self.field, ctx.mapper)
+        out = {"vals": np.empty(0, np.float64)}
+        if pairs is None:
+            return out
+        docs, v = pairs
+        sel = mask[docs]
+        vals = v[sel]
+        out["vals"] = vals
+        if not self.subs or vals.size == 0:
+            return out
+        cap = max(self.buckets, 1) * 50
+        ri = len(_ROUNDINGS) - 1
+        idxs = None
+        for r in range(len(_ROUNDINGS)):
+            cand = _unit_indices(vals, r)
+            if np.unique(cand).size <= cap:
+                ri, idxs = r, cand
+                break
+        if idxs is None:
+            idxs = _unit_indices(vals, ri)
+        sub_by_idx = {}
+        sel_docs = docs[sel]
+        for idx in np.unique(idxs):
+            bm = np.zeros(mask.shape[0], bool)
+            bm[sel_docs[idxs == idx]] = True
+            bm &= mask
+            sub_by_idx[int(idx)] = _sub_results(self, ctx, seg, bm)
+        out["subs_unit"] = ri
+        out["b"] = sub_by_idx
+        return out
 
     def reduce(self, partials):
         all_vals = np.concatenate([p["vals"] for p in partials]) \
@@ -384,6 +436,18 @@ class AutoDateHistogramAgg(BucketAggregator):
             count = 0
             sub_partials = []
             for p in partials:
+                if "triple" not in p:
+                    # wire partial: value-histogram count (exact for
+                    # single-valued fields); subs re-bin by unit bucket
+                    count += int(((p["vals"] >= key_ms)
+                                  & (p["vals"] < end_ms)).sum())
+                    if self.subs and p.get("b"):
+                        from_local = _ROUNDINGS[p["subs_unit"]][2]
+                        for uidx, sub in p["b"].items():
+                            ms = float(from_local(uidx))
+                            if key_ms <= ms < end_ms:
+                                sub_partials.append(sub)
+                    continue
                 ctx, seg, mask = p["triple"]
                 pairs = _numeric_pairs(seg, self.field, ctx.mapper)
                 if pairs is None:
@@ -434,6 +498,37 @@ class VariableWidthHistogramAgg(BucketAggregator):
             vals = v[mask[docs]]
         return {"vals": vals, "triple": (ctx, seg, mask)}
 
+    #: distinct-value bound for per-value sub-partials on the wire
+    WIRE_SUB_VALUE_CAP = 2048
+
+    def collect_wire(self, ctx, seg, mask):
+        """Data-only partial for cross-node shipping. Sub-aggregations
+        pre-collect per DISTINCT VALUE (clusters are decided globally at
+        reduce, so the finest shippable granularity is the value itself);
+        bounded by WIRE_SUB_VALUE_CAP distinct values."""
+        pairs = _numeric_pairs(seg, self.field, ctx.mapper)
+        out = {"vals": np.empty(0, np.float64)}
+        if pairs is None:
+            return out
+        docs, v = pairs
+        sel = mask[docs]
+        vals = v[sel]
+        out["vals"] = vals
+        if not self.subs or vals.size == 0:
+            return out
+        uniq = np.unique(vals)
+        if uniq.size > self.WIRE_SUB_VALUE_CAP:
+            return out                   # counts stay exact; subs degrade
+        sel_docs = docs[sel]
+        vb = {}
+        for uv in uniq:
+            bm = np.zeros(mask.shape[0], bool)
+            bm[sel_docs[vals == uv]] = True
+            bm &= mask
+            vb[float(uv)] = _sub_results(self, ctx, seg, bm)
+        out["vb"] = vb
+        return out
+
     def reduce(self, partials):
         all_vals = np.sort(np.concatenate([p["vals"] for p in partials])) \
             if partials else np.empty(0)
@@ -461,6 +556,14 @@ class VariableWidthHistogramAgg(BucketAggregator):
             n_docs = 0
             sub_partials = []
             for p in partials:
+                if "triple" not in p:
+                    n_docs += int(((p["vals"] >= lo_v)
+                                   & (p["vals"] <= hi_v)).sum())
+                    if self.subs and p.get("vb"):
+                        sub_partials.extend(
+                            sub for uv, sub in p["vb"].items()
+                            if lo_v <= uv <= hi_v)
+                    continue
                 ctx, seg, mask = p["triple"]
                 pairs = _numeric_pairs(seg, self.field, ctx.mapper)
                 if pairs is None:

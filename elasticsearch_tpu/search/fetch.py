@@ -124,6 +124,9 @@ def docvalue_fields(seg: Segment, mapper: MapperService, local_doc: int,
             field, fmt = spec, None
         if field is None:
             raise ParsingError("docvalue_fields entries require [field]")
+        if field == "_seq_no":
+            out["_seq_no"] = [int(seg.seq_nos[local_doc])]
+            continue
         ft = mapper.field_type(field)
         vals: List[Any] = []
         is_ns = isinstance(ft, DateFieldType) and ft.nanos

@@ -38,6 +38,20 @@ from .query_dsl import (MatchAllQuery, ShardContext, _vector_similarity,
 _MISSING_LAST = float("inf")
 
 
+def _collect_nested_inner_specs(spec, out: list) -> None:
+    """Walk a raw query spec for nested clauses carrying ``inner_hits``
+    (reference: ``InnerHitContextBuilder.extractInnerHits``)."""
+    if isinstance(spec, dict):
+        n = spec.get("nested")
+        if isinstance(n, dict) and "inner_hits" in n:
+            out.append(n)
+        for v in spec.values():
+            _collect_nested_inner_specs(v, out)
+    elif isinstance(spec, list):
+        for v in spec:
+            _collect_nested_inner_specs(v, out)
+
+
 def _tree_needs_scores(aggs: dict) -> bool:
     for a in aggs.values():
         if isinstance(a, TopHitsAgg):
@@ -59,6 +73,7 @@ class ShardHit:
     fields: Optional[Dict[str, List[Any]]] = None
     highlight: Optional[Dict[str, List[str]]] = None
     ignored: Optional[List[str]] = None
+    inner_hits: Optional[Dict[str, dict]] = None
 
 
 @dataclass
@@ -556,6 +571,11 @@ class ShardSearcher:
                 hit.highlight = highlight(self.mapper, src, hl_spec, hl_terms)
             hits.append(hit)
 
+        ih_specs: List[dict] = []
+        _collect_nested_inner_specs(query_spec, ih_specs)
+        if ih_specs and hits:
+            self._attach_nested_inner_hits(hits, ih_specs)
+
         agg_results = None
         agg_inputs = None
         if aggs is not None and collect_agg_inputs:
@@ -614,6 +634,83 @@ class ShardSearcher:
                                  aggregations=agg_results,
                                  agg_inputs=agg_inputs,
                                  profile=profile_out, suggest=suggest_out)
+
+    def _attach_nested_inner_hits(self, hits: List[ShardHit],
+                                  ih_specs: List[dict]) -> None:
+        """Per root hit, the matching CHILD rows of each nested clause
+        that asked for inner_hits (reference:
+        ``search/fetch/subphase/InnerHitsPhase.java`` re-running the
+        child query per fetched root). The child query executes once per
+        segment; per-hit work is a parent-id filter over its matches."""
+        from .fetch import docvalue_fields as _dvf
+        from .query_dsl import parse_query as _pq
+        index_name = getattr(self.mapper, "index_name", None)
+        for spec in ih_specs:
+            path = spec.get("path")
+            ih = spec.get("inner_hits") or {}
+            name = ih.get("name") or path
+            size = int(ih.get("size", 3))
+            from_ = int(ih.get("from", 0))
+            inner_q = _pq(spec.get("query") or {"match_all": {}})
+            per_seg: Dict[int, tuple] = {}
+            for hit in hits:
+                si = hit.seg_idx
+                seg = self.segments[si]
+                if si not in per_seg:
+                    pm = seg.nested_paths.get(path)
+                    if pm is None:
+                        per_seg[si] = None
+                    else:
+                        s2, m2 = inner_q.execute(self.ctx, seg)
+                        cm = np.zeros(seg.n_pad, bool)
+                        cm[: seg.n_docs] = pm & seg.live[: seg.n_docs]
+                        cm &= np.asarray(m2)
+                        per_seg[si] = (np.asarray(s2), cm, pm)
+                entry = per_seg[si]
+                root = hit.local_doc
+                if entry is None:
+                    group = {"hits": {"total": {"value": 0,
+                                                "relation": "eq"},
+                                      "max_score": None, "hits": []}}
+                else:
+                    s2, cm, pm = entry
+                    par = seg.parent_of[: seg.n_docs]
+                    kids = np.flatnonzero(cm[: seg.n_docs] & (par == root))
+                    siblings = np.flatnonzero(pm & (par == root))
+                    order = np.lexsort((kids, -s2[kids])) \
+                        if kids.size else np.empty(0, np.int64)
+                    sel = kids[order][from_: from_ + size]
+                    ihits = []
+                    for c in sel:
+                        off = int(np.searchsorted(siblings, c))
+                        obj = seg.sources[root]
+                        try:
+                            for part in path.split("."):
+                                obj = obj[part]
+                            child_src = obj[off] \
+                                if isinstance(obj, list) else obj
+                        except (KeyError, IndexError, TypeError):
+                            child_src = None
+                        d = {"_index": index_name,
+                             "_id": seg.doc_uids[root],
+                             "_nested": {"field": path, "offset": off},
+                             "_score": float(s2[c])}
+                        if ih.get("_source") is not False:
+                            d["_source"] = child_src
+                        dvf = ih.get("docvalue_fields")
+                        if dvf:
+                            d["fields"] = _dvf(seg, self.mapper, int(c),
+                                               dvf)
+                        ihits.append(d)
+                    mx = float(s2[sel].max()) if sel.size else None
+                    group = {"hits": {
+                        "total": {"value": int(kids.size),
+                                  "relation": "eq"},
+                        "max_score": mx, "hits": ihits}}
+                if ih.get("version"):
+                    group["_want_version"] = True
+                hit.inner_hits = dict(hit.inner_hits or {},
+                                      **{name: group})
 
     @staticmethod
     def _shard_doc(seg_idx: int, doc: int) -> int:

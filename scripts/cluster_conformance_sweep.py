@@ -91,6 +91,9 @@ def main():
                 if r.ok:
                     passed += 1
                     cur[0] += 1
+                elif os.environ.get("SWEEP_VERBOSE"):
+                    print(f"FAIL {r.suite} :: {r.name} :: "
+                          f"{r.reason[:300]}", file=sys.stderr)
             if (i + 1) % 25 == 0:
                 print(f"# {i + 1}/{len(files)} files, {passed}/{total} "
                       f"({time.time() - t0:.0f}s)", file=sys.stderr)

@@ -143,3 +143,77 @@ def test_significant_text(searcher):
                                          "min_doc_count": 1}}},
              query={"match": {"text": "good"}})
     assert r["s"]["buckets"][0]["key"] == "good"
+
+
+def test_adaptive_histogram_wire_partials_with_subs():
+    """Cluster-shipped partials (collect_wire, any tree depth) are
+    data-only AND preserve sub-aggregation values; reduce accepts mixed
+    local/wire partials (VERDICT r3: the remote agg path)."""
+    import numpy as np
+    from elasticsearch_tpu.common.datacodec import dumps_b64, loads_b64
+    from elasticsearch_tpu.index.mapping import MapperService
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.search.aggregations import (AggregationContext,
+                                                       parse_aggs)
+
+    mapper = MapperService()
+    mapper.merge({"properties": {"d": {"type": "date"},
+                                 "v": {"type": "long"}}})
+    b = SegmentBuilder("_0")
+    for i in range(8):
+        b.add(mapper.parse_document(str(i), {
+            "d": f"2024-01-0{i % 4 + 1}T00:00:00Z", "v": i}), seq_no=i)
+    seg = b.build()
+    mask = np.ones(seg.n_pad, bool)
+
+    for spec, outer in [
+        ({"h": {"auto_date_histogram": {"field": "d", "buckets": 4},
+                "aggs": {"m": {"avg": {"field": "v"}}}}}, "h"),
+        ({"w": {"variable_width_histogram": {"field": "v", "buckets": 3},
+                "aggs": {"m": {"sum": {"field": "v"}}}}}, "w"),
+    ]:
+        aggs = parse_aggs(spec)
+        wire_ctx = AggregationContext(mapper, wire=True)
+        local_ctx = AggregationContext(mapper)
+        agg = aggs[outer]
+        p_wire = agg.collect_wire(wire_ctx, seg, mask)
+        # must round-trip the data-only codec (pickle-free transport)
+        p_rt = loads_b64(dumps_b64(p_wire))
+        r_wire = agg.reduce([p_rt])
+        r_local = agg.reduce([agg.collect(local_ctx, seg, mask)])
+        assert [bk["doc_count"] for bk in r_wire["buckets"]] == \
+               [bk["doc_count"] for bk in r_local["buckets"]]
+        for bw, bl in zip(r_wire["buckets"], r_local["buckets"]):
+            assert bw["m"] == bl["m"], (spec, bw, bl)
+
+
+def test_terms_with_adaptive_sub_agg_wire():
+    """A bucket agg whose SUB-agg is adaptive must also ship data-only
+    partials when collected under a wire context."""
+    import numpy as np
+    from elasticsearch_tpu.common.datacodec import dumps_b64, loads_b64
+    from elasticsearch_tpu.index.mapping import MapperService
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.search.aggregations import (AggregationContext,
+                                                       parse_aggs)
+
+    mapper = MapperService()
+    mapper.merge({"properties": {"k": {"type": "keyword"},
+                                 "d": {"type": "date"}}})
+    b = SegmentBuilder("_0")
+    for i in range(6):
+        b.add(mapper.parse_document(str(i), {
+            "k": f"g{i % 2}", "d": f"2024-01-0{i % 3 + 1}T00:00:00Z"}),
+            seq_no=i)
+    seg = b.build()
+    mask = np.ones(seg.n_pad, bool)
+    aggs = parse_aggs({"t": {"terms": {"field": "k"}, "aggs": {
+        "h": {"auto_date_histogram": {"field": "d", "buckets": 3}}}}})
+    ctx = AggregationContext(mapper, wire=True)
+    p = aggs["t"].collect(ctx, seg, mask)
+    p_rt = loads_b64(dumps_b64(p))        # raises if a triple leaked in
+    r = aggs["t"].reduce([p_rt])
+    assert sum(bk["doc_count"] for bk in r["buckets"]) == 6
+    for bk in r["buckets"]:
+        assert sum(x["doc_count"] for x in bk["h"]["buckets"]) == \
+            bk["doc_count"]

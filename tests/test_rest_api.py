@@ -326,10 +326,18 @@ def test_reindex_and_tasks(api):
         "source": {"index": "src_ix", "query": {"term": {"tag": "keep"}}},
         "dest": {"index": "dst_ix"}})
     assert out["updated"] == 3 and out["created"] == 0
-    st, out = req(api, "GET", "/_tasks")
-    node_tasks = list(out["nodes"].values())[0]["tasks"]
-    assert any(t["action"] == "indices:data/write/reindex"
-               for t in node_tasks.values())
+    # async reindex: returns a task id; the stored result is retrievable
+    # through the tasks API (TaskResultsService analog)
+    st, out = req(api, "POST", "/_reindex", {
+        "source": {"index": "src_ix"}, "dest": {"index": "dst2_ix"}},
+        query="wait_for_completion=false")
+    assert st == 200 and ":" in out["task"]
+    st, out = req(api, "GET", f"/_tasks/{out['task']}",
+                  query="wait_for_completion=true")
+    assert st == 200 and out["completed"] is True
+    assert out["response"]["total"] == 6
+    assert out["task"]["action"] == "indices:data/write/reindex"
+    assert out["task"]["cancellable"] is True
 
 
 def test_rollover(api):
