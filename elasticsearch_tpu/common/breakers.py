@@ -1,0 +1,215 @@
+"""Hierarchical circuit breakers: real memory accounting with trips.
+
+Reference: ``common/breaker/CircuitBreaker.java`` +
+``indices/breaker/HierarchyCircuitBreakerService.java:62`` — every
+allocation-heavy operation (agg bucket growth, fielddata loads, serving
+plane construction) estimates its bytes against a child breaker; the
+parent breaker bounds the sum. A trip raises
+``CircuitBreakingError`` (429) instead of letting the node OOM.
+
+The byte budget is a configured ceiling, not a JVM heap: the TPU build's
+host memory pressure comes from numpy columns and reduce-time bucket
+trees. Default budget 1 GiB, overridable via the
+``indices.breaker.total.limit`` dynamic cluster setting (as in the
+reference); child limits accept the same ``indices.breaker.<name>.limit``
+settings with percentage or byte values.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from .errors import CircuitBreakingError
+
+#: synthetic "heap" the percentage limits resolve against
+DEFAULT_BUDGET = 1 << 30
+
+
+def parse_bytes_or_pct(value, budget: int) -> int:
+    s = str(value).strip()
+    if s.endswith("%"):
+        return int(budget * float(s[:-1]) / 100.0)
+    mult = 1
+    sl = s.lower()
+    for suffix, m in (("kb", 1 << 10), ("mb", 1 << 20), ("gb", 1 << 30),
+                      ("b", 1)):
+        if sl.endswith(suffix):
+            sl = sl[: -len(suffix)]
+            mult = m
+            break
+    return int(float(sl) * mult)
+
+
+class CircuitBreaker:
+    def __init__(self, name: str, limit: int, overhead: float = 1.0,
+                 parent: Optional["ParentBreaker"] = None):
+        self.name = name
+        self.limit = limit
+        self.overhead = overhead
+        self.parent = parent
+        self.used = 0
+        self.trip_count = 0
+        self.lock = threading.Lock()
+
+    def add_estimate(self, nbytes: int, label: str = "<op>") -> None:
+        add = int(nbytes * self.overhead)
+        with self.lock:
+            new = self.used + add
+            if new > self.limit:
+                self.trip_count += 1
+                raise CircuitBreakingError(
+                    f"[{self.name}] Data too large, data for [{label}] "
+                    f"would be [{new}/{_h(new)}], which is larger than "
+                    f"the limit of [{self.limit}/{_h(self.limit)}]")
+            self.used = new
+        if self.parent is not None:
+            try:
+                self.parent.check(label)
+            except CircuitBreakingError:
+                with self.lock:
+                    self.used -= add
+                raise
+
+    def release(self, nbytes: int) -> None:
+        with self.lock:
+            self.used = max(0, self.used - int(nbytes * self.overhead))
+
+    def reserve(self, nbytes: int, label: str = "<op>"):
+        """Context manager: estimate on enter, release on exit."""
+        breaker = self
+
+        class _R:
+            def __enter__(self):
+                breaker.add_estimate(nbytes, label)
+                return breaker
+
+            def __exit__(self, *exc):
+                breaker.release(nbytes)
+                return False
+
+        return _R()
+
+    def stats(self) -> dict:
+        return {"limit_size_in_bytes": self.limit,
+                "limit_size": _h(self.limit),
+                "estimated_size_in_bytes": self.used,
+                "estimated_size": _h(self.used),
+                "overhead": self.overhead,
+                "tripped": self.trip_count}
+
+
+class ParentBreaker:
+    """Bounds the SUM of the child breakers (the hierarchy part)."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+        self.trip_count = 0
+        self.children: Dict[str, CircuitBreaker] = {}
+
+    def total_used(self) -> int:
+        return sum(c.used for c in self.children.values())
+
+    def check(self, label: str) -> None:
+        total = self.total_used()
+        if total > self.limit:
+            self.trip_count += 1
+            raise CircuitBreakingError(
+                f"[parent] Data too large, data for [{label}] would be "
+                f"[{total}/{_h(total)}], which is larger than the limit "
+                f"of [{self.limit}/{_h(self.limit)}], real usage: "
+                f"[{total}], new bytes reserved: [0]")
+
+    def stats(self) -> dict:
+        return {"limit_size_in_bytes": self.limit,
+                "limit_size": _h(self.limit),
+                "estimated_size_in_bytes": self.total_used(),
+                "estimated_size": _h(self.total_used()),
+                "overhead": 1.0,
+                "tripped": self.trip_count}
+
+
+class BreakerService:
+    """The node's breaker hierarchy (request / fielddata / in-flight /
+    accounting under one parent), with dynamic limit updates."""
+
+    #: (name, default limit fraction of budget, overhead)
+    CHILDREN = (("request", 0.6, 1.0), ("fielddata", 0.4, 1.03),
+                ("in_flight_requests", 1.0, 2.0), ("accounting", 1.0, 1.0))
+
+    def __init__(self, budget: int = DEFAULT_BUDGET):
+        self.budget = budget
+        self.parent = ParentBreaker(int(budget * 0.95))
+        for name, frac, overhead in self.CHILDREN:
+            b = CircuitBreaker(name, int(budget * frac), overhead,
+                               parent=self.parent)
+            self.parent.children[name] = b
+
+    def breaker(self, name: str) -> CircuitBreaker:
+        return self.parent.children[name]
+
+    def apply_setting(self, key: str, value) -> bool:
+        """``indices.breaker.total.limit`` / ``indices.breaker.<child>.
+        limit`` (% of budget or absolute bytes). Returns handled?"""
+        parts = key.split(".")
+        if len(parts) != 4 or parts[:2] != ["indices", "breaker"] or \
+                parts[3] != "limit":
+            return False
+        target = parts[2]
+        if value is None:
+            if target == "total":
+                self.parent.limit = int(self.budget * 0.95)
+            elif target in self.parent.children:
+                for name, frac, _ov in self.CHILDREN:
+                    if name == target:
+                        self.parent.children[name].limit = \
+                            int(self.budget * frac)
+            return True
+        nbytes = parse_bytes_or_pct(value, self.budget)
+        if target == "total":
+            self.parent.limit = nbytes
+        elif target in self.parent.children:
+            self.parent.children[target].limit = nbytes
+        else:
+            return False
+        return True
+
+    def stats(self) -> dict:
+        out = {name: b.stats()
+               for name, b in self.parent.children.items()}
+        out["parent"] = self.parent.stats()
+        return out
+
+
+def _h(n: int) -> str:
+    for unit, div in (("gb", 1 << 30), ("mb", 1 << 20), ("kb", 1 << 10)):
+        if n >= div:
+            return f"{n / div:.1f}{unit}"
+    return f"{n}b"
+
+
+def estimate_partial_bytes(obj, _depth: int = 0) -> int:
+    """Rough recursive footprint of an aggregation partial tree — the
+    request breaker's unit of account for reduce-time bucket growth
+    (the reference accounts per-bucket via BigArrays)."""
+    import numpy as np
+    if _depth > 12:
+        return 64
+    if isinstance(obj, np.ndarray):
+        return int(obj.nbytes)
+    if isinstance(obj, dict):
+        return 64 + sum(64 + estimate_partial_bytes(v, _depth + 1)
+                        for v in obj.values())
+    if isinstance(obj, (list, tuple, set)):
+        return 64 + sum(estimate_partial_bytes(v, _depth + 1)
+                        for v in obj)
+    if isinstance(obj, str):
+        return 48 + len(obj)
+    return 32
+
+
+#: PROCESS-scoped service: in-process multi-node test clusters share it,
+#: which is the honest model — they share the host's actual memory, so
+#: the budget bounds their combined footprint. Per-node *surfaces*
+#: (stats rendering) compute node-local estimates without writing here.
+DEFAULT = BreakerService()

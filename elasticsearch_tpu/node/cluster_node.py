@@ -1000,6 +1000,34 @@ class ClusterNode:
                    on_replica(self._h_replica_checkpoint))
         t.register(nid, "replica:sync_gcp",
                    on_replica(self._h_replica_sync_gcp))
+        t.register(nid, "snap:shard", on_worker(self._h_snap_shard))
+
+    def _h_snap_shard(self, src, payload):
+        """Upload this node's primary copy of one shard into the shared
+        repo (the data-node half of master-coordinated snapshots —
+        ``SnapshotShardsService``)."""
+        name, sid = payload["index"], int(payload["shard"])
+        holder = self.primaries.get((name, sid))
+        if holder is not None:
+            engine = holder.engine
+        else:
+            # fall back to the bare local engine ONLY when routing names
+            # this node as the primary (group wiring can lag the routing
+            # publish) — anything else would upload an empty copy
+            st = self.applied_state
+            entry = ((st.data.get("routing", {}) if st else {})
+                     .get(name, {})).get(str(sid))
+            svc = self.rest.indices.indices.get(name)
+            if svc is None or sid >= len(svc.shards) or entry is None \
+                    or entry.get("primary") != self.node_id:
+                raise ElasticsearchError(
+                    f"shard [{name}][{sid}] is not primaried on "
+                    f"[{self.node_id}]")
+            engine = svc.shards[sid]
+        with self.rest.lock:
+            manifest, nf, nb = self.rest.api.snapshots.upload_shard(
+                payload["repo"], name, sid, engine)
+        return {"manifest": manifest, "files": nf, "bytes": nb}
 
     def _primary(self, payload) -> PrimaryShardGroup:
         key = (payload["index"], int(payload["shard"]))

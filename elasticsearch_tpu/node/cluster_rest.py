@@ -358,10 +358,18 @@ class ClusterRestService:
     dispatch described in the module docstring."""
 
     def __init__(self, node, data_path: str):
+        import os
         from ..rest.api import RestAPI
         self.node = node
         self.indices = IndicesService(data_path)
         self.api = RestAPI(self.indices)
+        # relative repo locations resolve to ONE shared directory across
+        # the cluster (the reference's path.repo): owners upload shard
+        # blobs where the master writes metadata. data_path is
+        # <cluster-root>/<node>/local — path.repo sits at <cluster-root>.
+        self.api.snapshots.path_repo = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(data_path))),
+            "shared_repos")
         self.lock = threading.RLock()
         self.applied_seq = 0
         #: op history by seq, maintained on EVERY node as ops apply (not
@@ -379,6 +387,9 @@ class ClusterRestService:
         #: needed by the transport loop, so holding it across the blocking
         #: publish is safe)
         self._meta_mutex = threading.Lock()
+        #: serializes master-side snapshot create vs delete (a delete's
+        #: blob GC must not reap an in-flight create's uploads)
+        self._snapshot_mutex = threading.Lock()
         #: set when this node skipped an unrecoverable op-log gap — its
         #: metadata surface may have diverged; surfaced in _cluster_state
         self.meta_divergent = False
@@ -533,6 +544,10 @@ class ClusterRestService:
             return self._tasks_route(method, path, query, body)
         if segs and segs[-1].split("?")[0] == "_mtermvectors":
             return self._mtermvectors(method, path, query, body)
+        if segs and segs[0] == "_snapshot":
+            routed = self._snapshot_route(method, path, query, segs, body)
+            if routed is not None:
+                return routed
         if self._is_meta_mutation(method, path, segs):
             return self._meta_op(method, path, query, body)
         if segs and segs[-1].split("?")[0] in _BROADCAST_SUFFIXES \
@@ -947,6 +962,124 @@ class ClusterRestService:
         if target and target != self.node.node_id:
             return self._exec_on(target, method, path, query, body)
         return self._local(method, path, query, body)
+
+    def _snapshot_route(self, method, path, query, segs, body):
+        """Master-coordinated snapshots (reference:
+        ``snapshots/SnapshotsService.java:126``): repository CRUD
+        replicates via the op log (every node can then read the SHARED
+        fs repo); snapshot CREATE runs on the master, which asks each
+        shard's owning node to upload that shard's files
+        (``snap:shard`` — the reference's ``SnapshotShardsService``)
+        and writes the snapshot metadata once; snapshot DELETE runs on
+        the master (single metadata writer). Reads and restore stay
+        local. Returns None for routes the normal dispatch should keep
+        handling."""
+        if len(segs) == 2 and method in ("PUT", "POST", "DELETE"):
+            return self._meta_op(method, path, query, body)   # repo CRUD
+        if len(segs) == 4 and segs[3] == "_restore" and \
+                method in ("POST", "PUT"):
+            # restore replicates like any metadata op: every node replays
+            # it from the SHARED repo into its local service, so the
+            # restored index exists cluster-wide (deterministic replay —
+            # same blobs everywhere)
+            return self._meta_op(method, path, query, body)
+        is_data_op = len(segs) == 3 and not segs[2].startswith("_")
+        if not is_data_op:
+            return None
+        node = self.node
+        leader = node.node_loop.sync(lambda: node.coordinator.known_leader)
+        if method == "DELETE":
+            if leader == node.node_id:
+                with self._snapshot_mutex:     # vs in-flight create's gc
+                    return self._local(method, path, query, body)
+            if leader is None:
+                raise _errors.ElasticsearchError("no known master")
+            return self._exec_on(leader, method, path, query, body)
+        if method not in ("PUT", "POST"):
+            return None                           # GET snapshot: local
+        if leader != node.node_id:
+            if leader is None:
+                raise _errors.ElasticsearchError("no known master")
+            return self._exec_on(leader, method, path, query, body)
+        with self._snapshot_mutex:
+            return self._snapshot_create_master(segs[1], segs[2], query,
+                                                body)
+
+    def _snapshot_create_master(self, repo, snap, query, body):
+        from urllib.parse import unquote
+        repo, snap = unquote(repo), unquote(snap)
+        spec = {}
+        try:
+            spec = json.loads(body or b"{}") or {}
+        except ValueError:
+            pass
+        node = self.node
+        st = node.applied_state
+        routing = st.data.get("routing", {}) if st else {}
+        with self.lock:
+            snaps = self.api.snapshots
+            expr = spec.get("indices")
+            if isinstance(expr, list):
+                expr = ",".join(expr)
+            try:
+                names = self.indices.resolve(expr)
+            except _errors.ElasticsearchError:
+                if not spec.get("ignore_unavailable"):
+                    raise
+                names = []
+            # fail fast on duplicates BEFORE any shard uploads
+            ridx = snaps.get_repository(repo).read_index()
+            if any(s["snapshot"] == snap for s in ridx["snapshots"]):
+                raise _errors.ResourceAlreadyExistsError(
+                    f"[{repo}:{snap}] snapshot with the same name "
+                    f"already exists")
+        import time as _time
+        start = _time.time()
+        indices_meta = {}
+        total_files = total_bytes = 0
+        for name in sorted(names):
+            table = routing.get(name, {})
+            with self.lock:
+                svc = self.indices.indices[name]
+                base = snaps.index_snapshot_meta(name)
+            shards = {}
+            for sid in range(svc.num_shards):
+                entry = table.get(str(sid))
+                if entry is None and table:
+                    # an unassigned shard must FAIL the snapshot, not
+                    # silently upload the master's empty local copy
+                    raise _errors.SnapshotError(
+                        f"shard [{name}][{sid}] has no assigned "
+                        f"primary; cannot snapshot")
+                owner = entry["primary"] if entry else node.node_id
+                if owner == node.node_id:
+                    holder = node.primaries.get((name, sid))
+                    engine = holder.engine if holder is not None \
+                        else svc.shards[sid]
+                    with self.lock:
+                        manifest, nf, nb = snaps.upload_shard(
+                            repo, name, sid, engine)
+                else:
+                    r = node.rpc(owner, "snap:shard", {
+                        "repo": repo, "index": name, "shard": sid},
+                        timeout=30.0)
+                    manifest, nf, nb = r["manifest"], r["files"], r["bytes"]
+                shards[str(sid)] = manifest
+                total_files += nf
+                total_bytes += nb
+            indices_meta[name] = dict(base, shards=shards)
+        with self.lock:
+            meta = snaps.create_from_manifests(
+                repo, snap, indices_meta, total_files, total_bytes,
+                include_global_state=spec.get("include_global_state",
+                                              True),
+                metadata=spec.get("metadata"), start=start)
+            if "wait_for_completion=true" in (query or ""):
+                doc = {"snapshot": self.api._snapshot_info(
+                    meta, repository=repo)}
+            else:
+                doc = {"accepted": True}
+        return 200, "application/json", json.dumps(doc).encode()
 
     def _mtermvectors(self, method, path, query, body):
         """Per-doc routing: each item's term vectors come from the node

@@ -370,6 +370,12 @@ class IndexService:
     def close(self) -> None:
         for s in self.shards:
             s.close()
+        # release the serving planes' breaker reservations (their dense
+        # tiers die with the index)
+        try:
+            self.plane_cache.release()
+        except Exception:   # noqa: BLE001 — close must not throw
+            pass
 
 
 class IndicesService:

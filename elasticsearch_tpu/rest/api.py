@@ -1053,6 +1053,26 @@ class RestAPI:
             "unable to find any unassigned shards to explain [explain "
             "the first unassigned shard by sending an empty body]")
 
+    def _breaker_stats(self) -> dict:
+        """Live breaker hierarchy stats. The breaker service is
+        process-scoped (nodes in one process share real host memory);
+        the fielddata estimate for THIS node's surface is computed from
+        its own loaded column footprints at render time — never written
+        back into the shared service, so one node's stats cannot clobber
+        another's."""
+        from ..common.breakers import DEFAULT as _breakers
+        fd_total = 0
+        for svc in self.indices.indices.values():
+            try:
+                fd, _comp = svc.field_bytes()
+                fd_total += sum(fd.values())
+            except Exception:   # noqa: BLE001 — closed index edge
+                pass
+        out = _breakers.stats()
+        out["fielddata"] = dict(out["fielddata"],
+                                estimated_size_in_bytes=fd_total)
+        return out
+
     def h_cluster_get_settings(self, params, body):
         defaults: Dict[str, Any] = {}
         if _flag(params, "include_defaults"):
@@ -1077,8 +1097,11 @@ class RestAPI:
             if mb is not ...:
                 _aggs_mod.MAX_BUCKETS[0] = (65536 if mb is None
                                             else int(mb))
+        from ..common.breakers import DEFAULT as _breakers
         for scope in ("persistent", "transient"):
             for k, v in (b0.get(scope) or {}).items():
+                if k.startswith("indices.breaker."):
+                    _breakers.apply_setting(k, v)
                 if v is None:
                     # null resets a setting to its default
                     self.cluster_settings[scope].pop(k, None)
@@ -1246,9 +1269,7 @@ class RestAPI:
                           "tx_count": 0, "tx_size_in_bytes": 0},
             "http": {"current_open": 0, "total_opened": 0,
                      "clients": []},
-            "breaker": {"parent": {"limit_size_in_bytes": 0,
-                                   "estimated_size_in_bytes": 0,
-                                   "overhead": 1.0, "tripped": 0}},
+            "breaker": self._breaker_stats(),
             "script": {"compilations": 0, "cache_evictions": 0,
                        "compilation_limit_triggered": 0},
             "discovery": {

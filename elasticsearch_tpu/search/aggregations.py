@@ -270,6 +270,9 @@ def run_aggregations_multi(
     (``SearchPhaseController.java:211-219``). ``extra_partials`` carries
     already-collected partials from REMOTE shards (the cluster tier) into
     the same reduce."""
+    from ..common.breakers import DEFAULT as _breakers
+    from ..common.breakers import estimate_partial_bytes
+    request_breaker = _breakers.breaker("request")
     result: Dict[str, dict] = {}
     pipelines: Dict[str, PipelineAggregator] = {}
     for name, agg in aggs.items():
@@ -279,7 +282,12 @@ def run_aggregations_multi(
         partials = [agg.collect(ctx, seg, mask)
                     for ctx, seg, mask in ctx_seg_masks]
         partials.extend((extra_partials or {}).get(name, ()))
-        result[name] = agg.reduce(partials)
+        # reduce-time bucket-tree accounting (the reference's BigArrays
+        # byte accounting per bucket): a too-large agg trips the request
+        # breaker with a 429 instead of exhausting host memory
+        est = sum(estimate_partial_bytes(p) for p in partials)
+        with request_breaker.reserve(est, f"<agg [{name}]>"):
+            result[name] = agg.reduce(partials)
         _apply_parent_pipes(agg, result[name])
         if getattr(agg, "meta", None) is not None:
             result[name]["meta"] = agg.meta

@@ -224,6 +224,41 @@ class ServingPlaneCache:
                     term_ids=f.term_ids, df=f.df, offsets=f.offsets,
                     docs=f.docs_host, tf=f.tf_host,
                     doc_len=f.doc_len_host, avgdl=avgdl))
-        plane = DistributedSearchPlane(self._get_mesh(), shards, field)
+        # the dense tier is the big persistent allocation (T_pad × n_pad
+        # bf16 per shard): reserve its estimate against the accounting
+        # breaker BEFORE building, so an overfull node 429s instead of
+        # OOMing inside the constructor
+        from ..common.breakers import DEFAULT as _breakers
+        from ..parallel.dist_search import DistributedSearchPlane as _P
+        from ..utils.shapes import round_up_multiple, round_up_pow2
+        acct = _breakers.breaker("accounting")
+        n_pad = round_up_pow2(max(
+            max(s["doc_len"].shape[0] for s in shards), 1))
+        threshold = max(n_pad // 256, 4096)
+        t_est = max((min(int((np.asarray(s["df"]) > threshold).sum()),
+                         _P.MAX_DENSE_TERMS) for s in shards),
+                    default=0)
+        nbytes = round_up_multiple(max(t_est, 1), 16) * n_pad * 2 * \
+            len(shards) if t_est else 0
+        acct.add_estimate(nbytes, f"<serving plane [{field}]>")
+        try:
+            plane = DistributedSearchPlane(self._get_mesh(), shards,
+                                           field)
+        except Exception:
+            acct.release(nbytes)
+            raise
+        old = self._planes.get(field)
+        if old is not None:
+            acct.release(getattr(old[1], "_acct_bytes", 0))
+        plane._acct_bytes = nbytes
         self._planes[field] = (sig, plane)
         return plane
+
+    def release(self) -> None:
+        """Release every plane's breaker reservation (the owning index is
+        closing or being deleted)."""
+        from ..common.breakers import DEFAULT as _breakers
+        acct = _breakers.breaker("accounting")
+        for _sig, plane in self._planes.values():
+            acct.release(getattr(plane, "_acct_bytes", 0))
+        self._planes.clear()

@@ -36,7 +36,7 @@ import os
 import shutil
 import time
 import uuid as uuid_mod
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..common.errors import (IllegalArgumentError, ResourceAlreadyExistsError,
                              SnapshotError, SnapshotMissingError)
@@ -160,6 +160,10 @@ class SnapshotsService:
     def __init__(self, indices_service):
         self.indices = indices_service
         self.repositories: Dict[str, FsRepository] = {}
+        #: base for RELATIVE repo locations (the reference's path.repo).
+        #: The cluster tier points every node's service at one shared
+        #: directory so owners upload shards into the same blob store.
+        self.path_repo: Optional[str] = None
 
     # -- repositories -------------------------------------------------------
 
@@ -174,10 +178,11 @@ class SnapshotsService:
             raise IllegalArgumentError(
                 "missing location setting for fs repository")
         if not os.path.isabs(location):
-            # relative locations resolve under the node's repo root
-            # (the reference resolves them against path.repo)
-            location = os.path.join(self.indices.data_path, "repos",
-                                    location)
+            # relative locations resolve under path.repo (shared across
+            # the cluster) or the node's own repo root on a single node
+            base = self.path_repo or os.path.join(
+                self.indices.data_path, "repos")
+            location = os.path.join(base, location)
         self.repositories[name] = FsRepository(
             name, location, compress=bool(settings.get("compress", False)))
 
@@ -221,43 +226,75 @@ class SnapshotsService:
             svc = self.indices.get(name)
             shards: Dict[str, List[dict]] = {}
             for shard_id, engine in enumerate(svc.shards):
-                engine.flush()          # durable commit point to copy
-                manifest = []
-                store = engine.store_dir
-                commit = json.load(open(
-                    os.path.join(store, "commit_point.json")))
-                files = ["commit_point.json"]
-                for fname in commit["segments"]:
-                    # the commit entry itself (npz, or a legacy round-1
-                    # .json.gz) plus its liveness sidecar if present
-                    files.append(fname)
-                    seg_base = fname
-                    for suffix in (".npz", ".json.gz"):
-                        if seg_base.endswith(suffix):
-                            seg_base = seg_base[: -len(suffix)]
-                            break
-                    sidecar = seg_base + ".live.npy"
-                    if os.path.exists(os.path.join(store, sidecar)):
-                        files.append(sidecar)
-                missing = [f for f in files
-                           if not os.path.exists(os.path.join(store, f))]
-                if missing:
-                    raise SnapshotError(
-                        f"shard [{name}][{shard_id}] store is missing "
-                        f"committed files {missing}")
-                for fname in files:
-                    entry = repo.put_file(os.path.join(store, fname))
-                    manifest.append(entry)
-                    total_files += 1
-                    total_bytes += int(entry.get("size", 0))
+                manifest, nfiles, nbytes = self.upload_shard(
+                    repo_name, name, shard_id, engine)
+                total_files += nfiles
+                total_bytes += nbytes
                 shards[str(shard_id)] = manifest
-            indices_meta[name] = {
-                "settings": dict(svc.settings),
+            indices_meta[name] = dict(self.index_snapshot_meta(name),
+                                      shards=shards)
+        return self.create_from_manifests(
+            repo_name, snapshot, indices_meta, total_files, total_bytes,
+            include_global_state=include_global_state, metadata=metadata,
+            start=start)
+
+    def index_snapshot_meta(self, name: str) -> dict:
+        svc = self.indices.get(name)
+        return {"settings": dict(svc.settings),
                 "mappings": svc.mapper.mapping_dict(),
                 "aliases": dict(svc.aliases),
-                "num_shards": svc.num_shards,
-                "shards": shards,
-            }
+                "num_shards": svc.num_shards}
+
+    def upload_shard(self, repo_name: str, index_name: str, shard_id: int,
+                     engine) -> Tuple[List[dict], int, int]:
+        """Upload ONE shard's committed files into the repo (the data-
+        node side of the reference's ``SnapshotShardsService``): in the
+        cluster tier each shard's owner runs this against the SHARED fs
+        repo, and only the coordinating master writes metadata."""
+        repo = self.get_repository(repo_name)
+        engine.flush()                  # durable commit point to copy
+        manifest: List[dict] = []
+        store = engine.store_dir
+        commit = json.load(open(os.path.join(store, "commit_point.json")))
+        files = ["commit_point.json"]
+        for fname in commit["segments"]:
+            # the commit entry itself (npz, or a legacy round-1
+            # .json.gz) plus its liveness sidecar if present
+            files.append(fname)
+            seg_base = fname
+            for suffix in (".npz", ".json.gz"):
+                if seg_base.endswith(suffix):
+                    seg_base = seg_base[: -len(suffix)]
+                    break
+            sidecar = seg_base + ".live.npy"
+            if os.path.exists(os.path.join(store, sidecar)):
+                files.append(sidecar)
+        missing = [f for f in files
+                   if not os.path.exists(os.path.join(store, f))]
+        if missing:
+            raise SnapshotError(
+                f"shard [{index_name}][{shard_id}] store is missing "
+                f"committed files {missing}")
+        nbytes = 0
+        for fname in files:
+            entry = repo.put_file(os.path.join(store, fname))
+            manifest.append(entry)
+            nbytes += int(entry.get("size", 0))
+        return manifest, len(files), nbytes
+
+    def create_from_manifests(self, repo_name: str, snapshot: str,
+                              indices_meta: Dict[str, dict],
+                              total_files: int, total_bytes: int, *,
+                              include_global_state: bool = True,
+                              metadata: Optional[dict] = None,
+                              start: Optional[float] = None) -> dict:
+        """Finalize a snapshot from per-shard manifests (master side)."""
+        repo = self.get_repository(repo_name)
+        idx = repo.read_index()
+        if any(s["snapshot"] == snapshot for s in idx["snapshots"]):
+            raise ResourceAlreadyExistsError(
+                f"[{repo_name}:{snapshot}] snapshot with the same name "
+                f"already exists")
         shards_total = sum(m["num_shards"] for m in indices_meta.values())
         meta = {
             "snapshot": snapshot,
@@ -267,7 +304,7 @@ class SnapshotsService:
             "indices": indices_meta,
             "include_global_state": include_global_state,
             "metadata": metadata,
-            "start_time_in_millis": int(start * 1000),
+            "start_time_in_millis": int((start or time.time()) * 1000),
             "end_time_in_millis": int(time.time() * 1000),
             "total_files": total_files,
             "total_size_in_bytes": total_bytes,

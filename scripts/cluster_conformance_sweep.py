@@ -52,8 +52,17 @@ def main():
             return client.rest.handle(m, p, q or "", b or b"")
 
     def factory():
+        import shutil
         rest = client.rest
         rest.handle("DELETE", "/*", "expand_wildcards=all", b"")
+        # wipe snapshot repositories (the reference test framework's
+        # wipeRepositories between suites): registration is replicated,
+        # the blob dirs are shared — clear both on every node
+        for n in nodes:
+            snaps = n.rest.api.snapshots
+            for name, repo in list(snaps.repositories.items()):
+                shutil.rmtree(repo.location, ignore_errors=True)
+                snaps.repositories.pop(name, None)
         with rest.lock:
             templates = list(rest.api.templates)
             comps = list(rest.api.component_templates)

@@ -1,0 +1,90 @@
+"""Circuit breakers: real memory accounting with 429 trips (reference:
+``indices/breaker/HierarchyCircuitBreakerService.java:62``)."""
+
+import json
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.common.breakers import (DEFAULT, BreakerService,
+                                               CircuitBreakingError,
+                                               parse_bytes_or_pct)
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+def req(api, method, path, body=None, query=""):
+    raw = json.dumps(body).encode() if isinstance(body, (dict, list)) \
+        else (body or b"")
+    st, _ct, out = api.handle(method, path, query, raw)
+    return st, json.loads(out or b"{}")
+
+
+def test_child_breaker_trips_and_releases():
+    svc = BreakerService(budget=1000)
+    b = svc.breaker("request")
+    b.limit = 100
+    b.add_estimate(60, "a")
+    with pytest.raises(CircuitBreakingError):
+        b.add_estimate(50, "b")
+    assert b.trip_count == 1
+    b.release(60)
+    b.add_estimate(90, "c")        # fits again after release
+    b.release(90)
+
+
+def test_parent_bounds_sum_of_children():
+    svc = BreakerService(budget=1000)
+    svc.parent.limit = 100
+    svc.breaker("request").limit = 80
+    svc.breaker("fielddata").limit = 80
+    svc.breaker("request").add_estimate(70, "r")
+    with pytest.raises(CircuitBreakingError):
+        svc.breaker("fielddata").add_estimate(60, "f")
+    # the failed child reservation must be rolled back
+    assert svc.breaker("fielddata").used == 0
+    svc.breaker("request").release(70)
+
+
+def test_parse_limits():
+    assert parse_bytes_or_pct("50%", 1000) == 500
+    assert parse_bytes_or_pct("2kb", 0) == 2048
+    assert parse_bytes_or_pct("100b", 0) == 100
+    assert parse_bytes_or_pct(123, 0) == 123
+
+
+def test_too_large_agg_returns_429_not_oom():
+    api = RestAPI(IndicesService(tempfile.mkdtemp()))
+    lines = []
+    for i in range(400):
+        lines.append(json.dumps({"index": {"_index": "t",
+                                           "_id": str(i)}}))
+        lines.append(json.dumps({"k": f"term-{i}", "v": i}))
+    api.handle("POST", "/_bulk", "", ("\n".join(lines) + "\n").encode())
+    req(api, "POST", "/t/_refresh")
+    st, out = req(api, "PUT", "/_cluster/settings", {
+        "transient": {"indices.breaker.request.limit": "1kb"}})
+    assert st == 200
+    try:
+        st, out = req(api, "POST", "/t/_search", {
+            "size": 0,
+            "aggs": {"all_terms": {"terms": {"field": "k.keyword",
+                                             "size": 400}}}})
+        assert st == 429, out
+        assert out["error"]["type"] == "circuit_breaking_exception"
+        # the failed reservation must not leak into the breaker
+        assert DEFAULT.breaker("request").used == 0
+        # stats report real limits, not stubs
+        st, out = req(api, "GET", "/_nodes/stats/breaker")
+        brk = list(out["nodes"].values())[0]["breakers"]
+        assert brk["request"]["limit_size_in_bytes"] == 1024
+        assert brk["request"]["tripped"] >= 1
+        assert brk["parent"]["limit_size_in_bytes"] > 0
+    finally:
+        req(api, "PUT", "/_cluster/settings", {
+            "transient": {"indices.breaker.request.limit": None}})
+    st, out = req(api, "POST", "/t/_search", {
+        "size": 0, "aggs": {"all_terms": {"terms": {
+            "field": "k.keyword", "size": 400}}}})
+    assert st == 200
+    assert len(out["aggregations"]["all_terms"]["buckets"]) == 400
