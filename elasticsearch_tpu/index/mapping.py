@@ -868,6 +868,63 @@ def geohash_encode_12(lat: float, lon: float) -> str:
     return geohash_encode(lat, lon, 12)
 
 
+class JoinFieldType(MappedFieldType):
+    """Parent/child relations inside one index (reference:
+    ``modules/parent-join/.../ParentJoinFieldMapper.java``). A doc's
+    value is ``"parent"`` or ``{"name": "child", "parent": "<id>"}``;
+    storage is the reference's own trick: the relation NAME is a keyword
+    at the field, and the parent id a keyword at ``<field>#<parent>`` —
+    parents store their OWN id there, so has_parent/has_child/children
+    all work off one column."""
+
+    type_name = "join"
+
+    def __init__(self, name: str, relations: dict, params: dict):
+        super().__init__(name, params)
+        self.relations_raw = dict(relations or {})
+        self.relations: Dict[str, List[str]] = {}
+        for parent, kids in self.relations_raw.items():
+            self.relations[parent] = [kids] if isinstance(kids, str) \
+                else list(kids)
+
+    def parent_rel_of(self, name: str) -> Optional[str]:
+        """The parent relation a NAME belongs under (None for roots)."""
+        for parent, kids in self.relations.items():
+            if name in kids:
+                return parent
+        return None
+
+    def all_names(self) -> List[str]:
+        out = list(self.relations)
+        for kids in self.relations.values():
+            out.extend(kids)
+        return out
+
+    def id_field_for(self, rel_name: str) -> str:
+        """Column carrying the family id for docs of ``rel_name``."""
+        parent = self.parent_rel_of(rel_name) or rel_name
+        return f"{self.name}#{parent}"
+
+    def to_mapping(self) -> dict:
+        return {"type": "join", "eager_global_ordinals": True,
+                "relations": self.relations_raw}
+
+
+class PercolatorFieldType(MappedFieldType):
+    """Stored-query field (reference:
+    ``modules/percolator/PercolatorFieldMapper.java:93``). The query
+    spec lives in _source; match-time the percolate query runs each
+    stored query against an in-memory segment built from the candidate
+    document. (The reference extracts candidate terms to prune which
+    stored queries run; this build evaluates all of them — exact, and
+    the per-query cost is one tiny-segment execution.)"""
+
+    type_name = "percolator"
+
+    def to_mapping(self) -> dict:
+        return {"type": "percolator"}
+
+
 class BinaryFieldType(MappedFieldType):
     """Base64 blobs (reference: ``BinaryFieldMapper``): stored in _source,
     neither indexed nor doc-valued — exists queries consult the source."""
@@ -1107,6 +1164,15 @@ class MapperService:
                 raise MapperParsingError(
                     f"Field [{name}] of type [alias] must have a [path]")
             return AliasFieldType(name, spec["path"], params)
+        if ftype == "join":
+            jf = JoinFieldType(name, spec.get("relations") or {}, params)
+            # the family-id columns exist per parent relation
+            for parent in jf.relations:
+                self._fields[f"{name}#{parent}"] = KeywordFieldType(
+                    f"{name}#{parent}", 2 ** 31 - 1, False, {})
+            return jf
+        if ftype == "percolator":
+            return PercolatorFieldType(name, params)
         if ftype in RANGE_TYPES:
             return RangeFieldType(name, ftype, params)
         if ftype == "search_as_you_type":
@@ -1126,6 +1192,8 @@ class MapperService:
             ft = self._fields[name]
             if isinstance(ft, RuntimeFieldType):
                 continue                 # rendered under "runtime"
+            if "#" in name:
+                continue                 # join-family id columns: internal
             parts = name.split(".")
             # Place under parent's "fields" if parent exists and is a leaf
             # (multi-field), else nest via "properties".
@@ -1348,6 +1416,40 @@ class MapperService:
             ft.parse_value(value)            # validate; stored in _source
             # presence for exists queries via the _field_names meta field
             # (the reference's FieldNamesFieldMapper)
+            parsed.keyword_terms.setdefault("_field_names",
+                                            []).append(full)
+        elif isinstance(ft, JoinFieldType):
+            if isinstance(value, str):
+                rel, parent_id = value, None
+            elif isinstance(value, dict):
+                rel = value.get("name")
+                parent_id = value.get("parent")
+            else:
+                raise MapperParsingError(
+                    f"failed to parse join field [{full}]")
+            if rel not in ft.all_names():
+                raise MapperParsingError(
+                    f"unknown join name [{rel}] for field [{full}]")
+            parsed.keyword_terms.setdefault(full, []).append(rel)
+            if ft.parent_rel_of(rel) is not None:
+                if parent_id is None:
+                    raise MapperParsingError(
+                        f"[parent] is missing for join field [{full}]")
+                parsed.keyword_terms.setdefault(
+                    ft.id_field_for(rel), []).append(str(parent_id))
+            if rel in ft.relations:
+                # a doc whose relation has children of its own stores
+                # its OWN id in that relation's family column (multi-
+                # level joins: parent -> child -> grand_child)
+                parsed.keyword_terms.setdefault(
+                    f"{full}#{rel}", []).append(parsed.doc_id)
+        elif isinstance(ft, PercolatorFieldType):
+            from ..search.query_dsl import parse_query
+            try:
+                parse_query(value)       # the stored query must parse
+            except Exception as e:
+                raise MapperParsingError(
+                    f"failed to parse query for field [{full}]: {e}")
             parsed.keyword_terms.setdefault("_field_names",
                                             []).append(full)
         elif isinstance(ft, KeywordFieldType):

@@ -346,6 +346,8 @@ class RestAPI:
         add("GET", "/_script_language", self.h_script_language)
         add("GET,POST", "/{index}/_search_shards", self.h_search_shards)
         add("GET,POST", "/_search_shards", self.h_search_shards)
+        add("GET,POST", "/_rank_eval", self.h_rank_eval)
+        add("GET,POST", "/{index}/_rank_eval", self.h_rank_eval)
         # templates
         add("POST", "/_index_template/_simulate_index/{name}",
             self.h_simulate_index_template)
@@ -4245,6 +4247,18 @@ class RestAPI:
                         vals = nxt
                     t[field] = [v for v in vals
                                 if not isinstance(v, (dict, list))]
+        p = node.get("percolate")
+        if isinstance(p, dict) and "document" not in p and \
+                "documents" not in p and "index" in p and "id" in p:
+            # fetch-form percolate: resolve the candidate doc here (the
+            # reference's coordinator GET during query rewrite)
+            svc = self.indices.get(p["index"])
+            r = svc.get_doc(str(p["id"]), routing=p.get("routing"))
+            if not r.found:
+                raise ResourceNotFoundError(
+                    f"indexed document [{p['index']}/{p['id']}] couldn't "
+                    f"be found")
+            p["document"] = r.source or {}
         for v in node.values():
             self._rewrite_terms_lookup(v)
 
@@ -4351,9 +4365,10 @@ class RestAPI:
         expensive_kinds = {"prefix", "wildcard", "regexp", "fuzzy",
                            "intervals", "script_score", "percolate",
                            "distance_feature", "nested", "has_child",
-                           "has_parent"}
+                           "has_parent", "parent_id"}
         expensive_label = {"nested": "joining", "has_child": "joining",
-                           "has_parent": "joining"}
+                           "has_parent": "joining",
+                           "parent_id": "joining"}
 
         #: clause kind → positions holding SUB-CLAUSES (clause-position
         #: recursion only; field names never read as clause kinds)
@@ -5468,6 +5483,101 @@ class RestAPI:
                 "indices": indices_doc,
                 "shards": shards}
 
+    # ------------------------------------------------------------------
+    # rank evaluation (reference: ``modules/rank-eval/RankEvalSpec.java``)
+    # ------------------------------------------------------------------
+
+    def h_rank_eval(self, params, body, index=None):
+        import math
+        spec = _json_body(body)
+        expression = index or params.get("index")
+        templates = {t["id"]: (t.get("template") or {}).get("source")
+                     for t in spec.get("templates") or []}
+        (metric_name, metric_opts), = (spec.get("metric")
+                                       or {"precision": {}}).items()
+        t0 = time.time()
+        details: Dict[str, dict] = {}
+        failures: Dict[str, dict] = {}
+        scores: List[float] = []
+        for req_spec in spec.get("requests") or []:
+            qid = req_spec.get("id")
+            try:
+                request = req_spec.get("request")
+                if request is None and req_spec.get("template_id"):
+                    from ..utils.mustache import render_mustache
+                    tpl = templates.get(req_spec["template_id"])
+                    if isinstance(tpl, dict):
+                        tpl = json.dumps(tpl)
+                    request = json.loads(render_mustache(
+                        tpl or "{}", req_spec.get("params") or {}))
+                request = dict(request or {})
+                if "aggs" in request or "aggregations" in request:
+                    raise IllegalArgumentError(
+                        "Query in rated requests should not contain "
+                        "aggregations.")
+                if "suggest" in request:
+                    raise IllegalArgumentError(
+                        "Query in rated requests should not contain a "
+                        "suggest section.")
+                if "highlight" in request:
+                    raise IllegalArgumentError(
+                        "Query in rated requests should not contain a "
+                        "highlighter section.")
+                if "explain" in request:
+                    raise IllegalArgumentError(
+                        "Query in rated requests should not use "
+                        "explain.")
+                if "profile" in request:
+                    raise IllegalArgumentError(
+                        "Query in rated requests should not use "
+                        "profile.")
+                k = int(metric_opts.get("k", 10))
+                request.setdefault("size", k)
+                out = self._search_indices(
+                    self.indices.resolve(expression), request,
+                    record_stats=False)
+                hits = out["hits"]["hits"]
+                ratings = {(r["_index"], str(r["_id"])): int(r["rating"])
+                           for r in req_spec.get("ratings") or []}
+                rated_hits = []
+                unrated = []
+                ranks: List[Optional[int]] = []
+                for h in hits:
+                    key = (h["_index"], str(h["_id"]))
+                    entry = {"hit": {"_index": h["_index"],
+                                     "_id": h["_id"],
+                                     "_score": h.get("_score")}}
+                    if key in ratings:
+                        entry["rating"] = ratings[key]
+                        ranks.append(ratings[key])
+                    else:
+                        unrated.append({"_index": h["_index"],
+                                        "_id": h["_id"]})
+                        ranks.append(None)
+                    rated_hits.append(entry)
+                score, mdetails = _rank_metric(
+                    metric_name, metric_opts, ranks, ratings)
+                scores.append(score)
+                details[qid] = {
+                    "metric_score": score,
+                    "unrated_docs": unrated,
+                    "hits": rated_hits,
+                    "metric_details": {metric_name: mdetails},
+                }
+            except IllegalArgumentError:
+                raise
+            except Exception as e:   # noqa: BLE001 — per-request failure
+                _status, payload = _error_payload(e)
+                failures[qid] = payload.get("error", {
+                    "type": "exception", "reason": str(e)})
+        doc = {
+            "took": int((time.time() - t0) * 1000),
+            "metric_score": (sum(scores) / len(scores)) if scores else 0.0,
+            "details": details,
+            "failures": failures,
+        }
+        return doc
+
     def h_tasks(self, params, body):
         group_by = params.get("group_by", "nodes")
         actions = params.get("actions")
@@ -5824,6 +5934,71 @@ class RestAPI:
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
+
+
+def _rank_metric(name: str, opts: dict, ranks, ratings) -> Tuple[float,
+                                                                 dict]:
+    """IR metric over one ranked result list (reference:
+    ``modules/rank-eval``: PrecisionAtK, RecallAtK, MeanReciprocalRank,
+    DiscountedCumulativeGain, ExpectedReciprocalRank). ``ranks`` is the
+    per-position rating (None = unlabeled); ``ratings`` the full rated
+    set for recall denominators."""
+    import math
+    threshold = int(opts.get("relevant_rating_threshold", 1))
+    if name == "precision":
+        ignore_unlabeled = bool(opts.get("ignore_unlabeled"))
+        retrieved = relevant = 0
+        for r in ranks:
+            if r is None and ignore_unlabeled:
+                continue
+            retrieved += 1
+            if r is not None and r >= threshold:
+                relevant += 1
+        score = relevant / retrieved if retrieved else 0.0
+        return score, {"relevant_docs_retrieved": relevant,
+                       "docs_retrieved": retrieved}
+    if name == "recall":
+        relevant_retrieved = sum(1 for r in ranks
+                                 if r is not None and r >= threshold)
+        total_relevant = sum(1 for r in ratings.values()
+                             if r >= threshold)
+        score = relevant_retrieved / total_relevant \
+            if total_relevant else 0.0
+        return score, {"relevant_docs_retrieved": relevant_retrieved,
+                       "relevant_docs": total_relevant}
+    if name == "mean_reciprocal_rank":
+        first = -1
+        for i, r in enumerate(ranks):
+            if r is not None and r >= threshold:
+                first = i + 1
+                break
+        score = 1.0 / first if first > 0 else 0.0
+        return score, {"first_relevant": first}
+    if name == "dcg":
+        def dcg_of(gains):
+            return sum((2 ** g - 1) / math.log2(i + 2)
+                       for i, g in enumerate(gains))
+        gains = [r or 0 for r in ranks]
+        score = dcg_of(gains)
+        details = {"dcg": score}
+        if opts.get("normalize"):
+            ideal = dcg_of(sorted((r for r in ratings.values()),
+                                  reverse=True)[: len(ranks)])
+            details["ideal_dcg"] = ideal
+            score = score / ideal if ideal else 0.0
+            details["normalized_dcg"] = score
+        return score, details
+    if name == "expected_reciprocal_rank":
+        max_rel = int(opts.get("maximum_relevance", 4))
+        denom = 2 ** max_rel
+        p_look = 1.0
+        err = 0.0
+        for i, r in enumerate(ranks):
+            rel = (2 ** (r or 0) - 1) / denom
+            err += p_look * rel / (i + 1)
+            p_look *= (1 - rel)
+        return err, {"unrated_docs": sum(1 for r in ranks if r is None)}
+    raise IllegalArgumentError(f"unknown rank-eval metric [{name}]")
 
 
 def _int_or_none(v):

@@ -848,6 +848,69 @@ class IpRangeAgg(RangeAgg):
         return float(v)                  # past the address space (mask /0)
 
 
+class _JoinBucketAgg(BucketAggregator):
+    """Shared machinery of the parent-join ``children`` / ``parent``
+    single-bucket aggregations (reference: ``modules/parent-join/...
+    aggregations/ChildrenAggregator.java`` / ``ParentAggregator``)."""
+
+    def __init__(self, body: dict):
+        self.rel_type = body.get("type")
+        if self.rel_type is None:
+            raise ParsingError(
+                f"Missing [type] for [{self.kind}] aggregation")
+
+    def _transform(self, ctx, seg, mask) -> np.ndarray:
+        from .query_dsl import _join_field, _kw_values_by_doc
+        out = np.zeros(seg.n_pad, bool)
+        jf = _join_field(ctx)
+        if jf is None or jf.parent_rel_of(self.rel_type) is None:
+            return out
+        parent_rel = jf.parent_rel_of(self.rel_type)
+        rels = _kw_values_by_doc(seg, jf.name)
+        fam = _kw_values_by_doc(seg, jf.id_field_for(self.rel_type))
+        if self.kind == "children":
+            # parents in the bucket -> their child docs of rel_type
+            bucket_ids = {seg.doc_uids[d]
+                          for d in np.flatnonzero(mask[: seg.n_docs])
+                          if rels.get(d) == parent_rel}
+            for d, pid in fam.items():
+                if rels.get(d) == self.rel_type and pid in bucket_ids \
+                        and seg.live[d]:
+                    out[d] = True
+        else:
+            # child docs in the bucket -> their parent docs
+            pids = {pid for d, pid in fam.items()
+                    if mask[d] and rels.get(d) == self.rel_type}
+            for pid in pids:
+                d = seg.find_doc(pid)
+                if d is not None and rels.get(d) == parent_rel and \
+                        seg.live[d]:
+                    out[d] = True
+        return out
+
+    def collect(self, ctx, seg, mask):
+        bm = self._transform(ctx, seg, mask)
+        if self.subs:
+            return _bucket_payload(self, ctx, seg, bm)
+        return (int(bm.sum()), {})
+
+    def reduce(self, partials):
+        count = sum(c for c, _ in partials)
+        out = {"doc_count": count}
+        if self.subs:
+            out.update(_reduce_subs(self, [s for _, s in partials]))
+        return out
+
+
+class ChildrenAgg(_JoinBucketAgg):
+    kind = "children"
+
+
+class ParentAgg(_JoinBucketAgg):
+    #: "type" names the CHILD relation whose parents we bucket
+    kind = "parent"
+
+
 # self-registration: runs after this module's classes exist, against the
 # fully-initialized (or at least _AGG_PARSERS-bearing) aggregations module
 from .aggregations import _AGG_PARSERS      # noqa: E402
@@ -861,4 +924,6 @@ _AGG_PARSERS.update({
     "sampler": SamplerAgg,
     "nested": NestedAgg,
     "reverse_nested": ReverseNestedAgg,
+    "children": ChildrenAgg,
+    "parent": ParentAgg,
 })

@@ -1904,8 +1904,270 @@ def _parse_function_score(body):
                               float(body.get("boost", 1.0)))
 
 
+def _kw_values_by_doc(seg, field: str) -> Dict[int, str]:
+    """doc → first keyword value of ``field`` (join columns are
+    single-valued)."""
+    kf = seg.keyword_fields.get(field)
+    if kf is None:
+        return {}
+    out: Dict[int, str] = {}
+    for d, o in zip(kf.dv_docs_host.tolist(), kf.dv_ords_host.tolist()):
+        out.setdefault(int(d), kf.ord_terms[o])
+    return out
+
+
+def _join_field(ctx):
+    from ..index.mapping import JoinFieldType
+    mapper = getattr(ctx, "mapper", None)
+    for ft in (getattr(mapper, "_fields", {}) or {}).values():
+        if isinstance(ft, JoinFieldType):
+            return ft
+    return None
+
+
+def _rel_mask(ctx, seg, field: str, names) -> np.ndarray:
+    """bool[n_pad]: docs whose join relation name is in ``names``."""
+    m = np.zeros(seg.n_pad, bool)
+    for d, rel in _kw_values_by_doc(seg, field).items():
+        if rel in names:
+            m[d] = True
+    return m
+
+
+class HasChildQuery(Query):
+    """Parents with a matching child (reference:
+    ``modules/parent-join/.../HasChildQueryBuilder.java``). Children and
+    parents share a shard (routing to the parent id), so the join is a
+    per-segment group-by over the family-id column."""
+
+    def __init__(self, child_type: str, inner: Query,
+                 score_mode: str = "none", boost: float = 1.0,
+                 min_children: int = 1,
+                 max_children: Optional[int] = None):
+        self.child_type = child_type
+        self.inner = inner
+        self.score_mode = score_mode
+        self.boost = boost
+        self.min_children = min_children
+        self.max_children = max_children
+
+    def execute(self, ctx, seg):
+        jf = _join_field(ctx)
+        if jf is None or jf.parent_rel_of(self.child_type) is None:
+            return _const_result(seg, 0.0, False)
+        id_field = jf.id_field_for(self.child_type)
+        s, m = self.inner.execute(ctx, seg)
+        child_m = _rel_mask(ctx, seg, jf.name, {self.child_type})
+        child_m &= np.asarray(m)
+        child_m[: seg.n_docs] &= seg.live[: seg.n_docs]
+        fam = _kw_values_by_doc(seg, id_field)
+        sn = np.asarray(s)
+        agg: Dict[str, List[float]] = {}
+        for d in np.flatnonzero(child_m).tolist():
+            pid = fam.get(d)
+            if pid is not None:
+                agg.setdefault(pid, []).append(float(sn[d]))
+        parent_rel = jf.parent_rel_of(self.child_type)
+        scores = np.zeros(seg.n_pad, np.float32)
+        mask = np.zeros(seg.n_pad, bool)
+        rels = _kw_values_by_doc(seg, jf.name)
+        for pid, child_scores in agg.items():
+            n = len(child_scores)
+            if n < self.min_children or \
+                    (self.max_children is not None
+                     and n > self.max_children):
+                continue
+            d = seg.find_doc(pid)
+            if d is None or rels.get(d) != parent_rel or \
+                    not seg.live[d]:
+                continue
+            if self.score_mode == "sum":
+                v = sum(child_scores)
+            elif self.score_mode == "max":
+                v = max(child_scores)
+            elif self.score_mode == "min":
+                v = min(child_scores)
+            elif self.score_mode == "avg":
+                v = sum(child_scores) / n
+            else:                        # none
+                v = 1.0
+            mask[d] = True
+            scores[d] = v
+        return (jnp.asarray(scores * np.float32(self.boost)),
+                jnp.asarray(mask))
+
+    def collect_highlight_terms(self, ctx, out):
+        pass                             # parent hits carry no child terms
+
+
+class HasParentQuery(Query):
+    """Children of a matching parent (``HasParentQueryBuilder.java``)."""
+
+    def __init__(self, parent_type: str, inner: Query,
+                 score: bool = False, boost: float = 1.0):
+        self.parent_type = parent_type
+        self.inner = inner
+        self.score = score
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        jf = _join_field(ctx)
+        if jf is None or self.parent_type not in jf.relations:
+            return _const_result(seg, 0.0, False)
+        s, m = self.inner.execute(ctx, seg)
+        parent_m = _rel_mask(ctx, seg, jf.name, {self.parent_type})
+        parent_m &= np.asarray(m)
+        parent_m[: seg.n_docs] &= seg.live[: seg.n_docs]
+        sn = np.asarray(s)
+        matched: Dict[str, float] = {}
+        for d in np.flatnonzero(parent_m).tolist():
+            matched[seg.doc_uids[d]] = float(sn[d])
+        kids = set(jf.relations[self.parent_type])
+        id_field = f"{jf.name}#{self.parent_type}"
+        fam = _kw_values_by_doc(seg, id_field)
+        rels = _kw_values_by_doc(seg, jf.name)
+        scores = np.zeros(seg.n_pad, np.float32)
+        mask = np.zeros(seg.n_pad, bool)
+        for d, pid in fam.items():
+            if rels.get(d) in kids and pid in matched and seg.live[d]:
+                mask[d] = True
+                scores[d] = matched[pid] if self.score else 1.0
+        return (jnp.asarray(scores * np.float32(self.boost)),
+                jnp.asarray(mask))
+
+    def collect_highlight_terms(self, ctx, out):
+        pass
+
+
+class ParentIdQuery(Query):
+    """Children of one specific parent id (``ParentIdQueryBuilder``)."""
+
+    def __init__(self, child_type: str, parent_id: str,
+                 boost: float = 1.0):
+        self.child_type = child_type
+        self.parent_id = str(parent_id)
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        jf = _join_field(ctx)
+        if jf is None or jf.parent_rel_of(self.child_type) is None:
+            return _const_result(seg, 0.0, False)
+        id_field = jf.id_field_for(self.child_type)
+        fam = _kw_values_by_doc(seg, id_field)
+        rels = _kw_values_by_doc(seg, jf.name)
+        mask = np.zeros(seg.n_pad, bool)
+        for d, pid in fam.items():
+            if pid == self.parent_id and \
+                    rels.get(d) == self.child_type and seg.live[d]:
+                mask[d] = True
+        return (jnp.asarray(mask.astype(np.float32)
+                            * np.float32(self.boost)),
+                jnp.asarray(mask))
+
+
+class PercolateQuery(Query):
+    """Reverse search (reference: ``modules/percolator/PercolateQuery
+    .java``): each doc carrying a stored query at ``field`` matches when
+    that query matches the candidate document(s). The candidates index
+    into a throwaway in-memory segment under this index's mapper; every
+    stored query executes against it (see PercolatorFieldType on the
+    skipped candidate-extraction optimization)."""
+
+    def __init__(self, field: str, documents: List[dict],
+                 boost: float = 1.0):
+        self.field = field
+        self.documents = documents
+        self.boost = boost
+        self._tmp = None                 # (searcher, segment) lazy
+
+    def _temp_segment(self, ctx):
+        if self._tmp is None:
+            from ..index.segment import SegmentBuilder
+            from .shard_search import ShardSearcher
+            b = SegmentBuilder("_percolate_tmp")
+            for i, doc in enumerate(self.documents):
+                b.add(ctx.mapper.parse_document(f"_tmp_{i}", dict(doc)),
+                      seq_no=i)
+            seg = b.build()
+            self._tmp = (ShardSearcher([seg], ctx.mapper), seg)
+        return self._tmp
+
+    def execute(self, ctx, seg):
+        from ..index.mapping import PercolatorFieldType
+        ft = ctx.mapper.field_type(self.field) if ctx.mapper else None
+        if not isinstance(ft, PercolatorFieldType):
+            return _const_result(seg, 0.0, False)
+        searcher, tmp_seg = self._temp_segment(ctx)
+        mask = np.zeros(seg.n_pad, bool)
+        for d in range(seg.n_docs):
+            if not seg.live[d]:
+                continue
+            src = seg.sources[d]
+            spec = (src or {}).get(self.field)
+            if not isinstance(spec, dict):
+                continue
+            try:
+                q = parse_query(spec)
+                _s, m2 = q.execute(searcher.ctx, tmp_seg)
+                if bool(np.asarray(m2)[: tmp_seg.n_docs].any()):
+                    mask[d] = True
+            except Exception:   # noqa: BLE001 — unparsable stored query
+                continue
+        return (jnp.asarray(mask.astype(np.float32)
+                            * np.float32(self.boost)),
+                jnp.asarray(mask))
+
+
+def _parse_has_child(body):
+    if "type" not in body or "query" not in body:
+        raise ParsingError("[has_child] requires [type] and [query]")
+    return HasChildQuery(
+        body["type"], parse_query(body["query"]),
+        score_mode=body.get("score_mode", "none"),
+        boost=float(body.get("boost", 1.0)),
+        min_children=int(body.get("min_children", 1)),
+        max_children=(int(body["max_children"])
+                      if "max_children" in body else None))
+
+
+def _parse_has_parent(body):
+    if "parent_type" not in body or "query" not in body:
+        raise ParsingError(
+            "[has_parent] requires [parent_type] and [query]")
+    return HasParentQuery(
+        body["parent_type"], parse_query(body["query"]),
+        score=bool(body.get("score", False)),
+        boost=float(body.get("boost", 1.0)))
+
+
+def _parse_parent_id(body):
+    if "type" not in body or "id" not in body:
+        raise ParsingError("[parent_id] requires [type] and [id]")
+    return ParentIdQuery(body["type"], body["id"],
+                         float(body.get("boost", 1.0)))
+
+
+def _parse_percolate(body):
+    if "field" not in body:
+        raise ParsingError("[percolate] requires [field]")
+    docs = body.get("documents")
+    if docs is None:
+        doc = body.get("document")
+        docs = [doc] if doc is not None else None
+    if docs is None:
+        raise ParsingError(
+            "[percolate] requires [document], [documents], or a "
+            "[index]/[id] pair (resolved by the REST layer)")
+    return PercolateQuery(body["field"], list(docs),
+                          float(body.get("boost", 1.0)))
+
+
 _PARSERS = {
     "match_all": _parse_match_all,
+    "has_child": _parse_has_child,
+    "has_parent": _parse_has_parent,
+    "parent_id": _parse_parent_id,
+    "percolate": _parse_percolate,
     "script_score": _parse_script_score,
     "function_score": _parse_function_score,
     "match_none": _parse_match_none,

@@ -38,18 +38,25 @@ from .query_dsl import (MatchAllQuery, ShardContext, _vector_similarity,
 _MISSING_LAST = float("inf")
 
 
-def _collect_nested_inner_specs(spec, out: list) -> None:
-    """Walk a raw query spec for nested clauses carrying ``inner_hits``
-    (reference: ``InnerHitContextBuilder.extractInnerHits``)."""
+def _collect_nested_inner_specs(spec, out: list,
+                                join_out: Optional[list] = None) -> None:
+    """Walk a raw query spec for nested / has_child / has_parent clauses
+    carrying ``inner_hits`` (reference:
+    ``InnerHitContextBuilder.extractInnerHits``)."""
     if isinstance(spec, dict):
         n = spec.get("nested")
         if isinstance(n, dict) and "inner_hits" in n:
             out.append(n)
+        if join_out is not None:
+            for kind in ("has_child", "has_parent"):
+                j = spec.get(kind)
+                if isinstance(j, dict) and "inner_hits" in j:
+                    join_out.append((kind, j))
         for v in spec.values():
-            _collect_nested_inner_specs(v, out)
+            _collect_nested_inner_specs(v, out, join_out)
     elif isinstance(spec, list):
         for v in spec:
-            _collect_nested_inner_specs(v, out)
+            _collect_nested_inner_specs(v, out, join_out)
 
 
 def _tree_needs_scores(aggs: dict) -> bool:
@@ -572,9 +579,12 @@ class ShardSearcher:
             hits.append(hit)
 
         ih_specs: List[dict] = []
-        _collect_nested_inner_specs(query_spec, ih_specs)
+        join_specs: List[tuple] = []
+        _collect_nested_inner_specs(query_spec, ih_specs, join_specs)
         if ih_specs and hits:
             self._attach_nested_inner_hits(hits, ih_specs)
+        if join_specs and hits:
+            self._attach_join_inner_hits(hits, join_specs)
 
         agg_results = None
         agg_inputs = None
@@ -709,6 +719,78 @@ class ShardSearcher:
                         "max_score": mx, "hits": ihits}}
                 if ih.get("version"):
                     group["_want_version"] = True
+                hit.inner_hits = dict(hit.inner_hits or {},
+                                      **{name: group})
+
+    def _attach_join_inner_hits(self, hits: List[ShardHit],
+                                join_specs: List[tuple]) -> None:
+        """Per root hit, the matching related REAL docs of each
+        has_child / has_parent clause that asked for inner_hits
+        (reference: parent-join's ``ParentChildInnerHitContextBuilder``).
+        Related docs share the root's shard (routing contract)."""
+        from .query_dsl import (_join_field, _kw_values_by_doc,
+                                parse_query)
+        index_name = getattr(self.mapper, "index_name", None)
+        jf = _join_field(self.ctx)
+        if jf is None:
+            return
+        for kind, spec in join_specs:
+            ih = spec.get("inner_hits") or {}
+            rel = spec.get("type") if kind == "has_child" \
+                else spec.get("parent_type")
+            name = ih.get("name") or rel
+            size = int(ih.get("size", 3))
+            from_ = int(ih.get("from", 0))
+            inner_q = parse_query(spec.get("query") or {"match_all": {}})
+            per_seg: Dict[int, tuple] = {}
+            for hit in hits:
+                si = hit.seg_idx
+                seg = self.segments[si]
+                if si not in per_seg:
+                    s2, m2 = inner_q.execute(self.ctx, seg)
+                    rels = _kw_values_by_doc(seg, jf.name)
+                    if kind == "has_child":
+                        fam = _kw_values_by_doc(
+                            seg, jf.id_field_for(rel))
+                    else:
+                        fam = _kw_values_by_doc(seg, f"{jf.name}#{rel}")
+                    per_seg[si] = (np.asarray(s2), np.asarray(m2),
+                                   rels, fam)
+                s2, m2, rels, fam = per_seg[si]
+                seg = self.segments[hit.seg_idx]
+                sel: List[int] = []
+                if kind == "has_child":
+                    # inner hits = matching CHILD docs of this parent
+                    for d, pid in fam.items():
+                        if pid == hit.doc_id and rels.get(d) == rel \
+                                and m2[d] and seg.live[d]:
+                            sel.append(d)
+                else:
+                    # inner hits = this child's matching PARENT doc
+                    my_pid = _kw_values_by_doc(
+                        seg, f"{jf.name}#{rel}").get(hit.local_doc)
+                    pd = seg.find_doc(my_pid) if my_pid else None
+                    if pd is not None and rels.get(pd) == rel and \
+                            m2[pd] and seg.live[pd]:
+                        sel.append(pd)
+                sel.sort(key=lambda d: (-float(s2[d]), d))
+                window = sel[from_: from_ + size]
+                ihits = []
+                for d in window:
+                    doc_out = {"_index": index_name,
+                               "_id": seg.doc_uids[d],
+                               "_score": float(s2[d])}
+                    if ih.get("_source") is not False:
+                        doc_out["_source"] = seg.sources[d]
+                    if ih.get("seq_no_primary_term"):
+                        doc_out["_seq_no"] = int(seg.seq_nos[d])
+                        doc_out["_primary_term"] = 1
+                    ihits.append(doc_out)
+                group = {"hits": {
+                    "total": {"value": len(sel), "relation": "eq"},
+                    "max_score": (float(s2[window[0]]) if window
+                                  else None),
+                    "hits": ihits}}
                 hit.inner_hits = dict(hit.inner_hits or {},
                                       **{name: group})
 

@@ -1,0 +1,46 @@
+"""Module conformance: the reference's OWN YAML suites for the
+parent-join, percolator, and rank-eval modules, run in place (same
+pattern as the main rest-api-spec corpus — SURVEY §4.5).
+
+Reference: ``modules/{parent-join,percolator,rank-eval}/src/yamlRestTest``.
+"""
+
+import glob
+import os
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+from elasticsearch_tpu.testkit.yaml_runner import YamlTestRunner
+
+MODULES_ROOT = "/root/reference/modules"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(MODULES_ROOT),
+    reason="reference modules not available")
+
+
+def factory():
+    return RestAPI(IndicesService(tempfile.mkdtemp()))
+
+
+def _module_files(mod: str):
+    return sorted(glob.glob(
+        f"{MODULES_ROOT}/{mod}/src/yamlRestTest/resources/rest-api-spec/"
+        f"test/**/*.yml", recursive=True))
+
+
+@pytest.mark.parametrize("mod", ["parent-join", "percolator", "rank-eval"])
+def test_module_suites_pass_completely(mod):
+    runner = YamlTestRunner(factory)
+    files = _module_files(mod)
+    assert files, f"no YAML suites found for {mod}"
+    failures = []
+    for f in files:
+        for r in runner.run_file(f):
+            if not r.ok:
+                failures.append(f"{os.path.basename(f)} :: {r.name}: "
+                                f"{r.reason[:200]}")
+    assert not failures, "\n".join(failures)
