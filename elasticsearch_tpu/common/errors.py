@@ -62,6 +62,15 @@ class IllegalArgumentError(ElasticsearchError):
     error_type = "illegal_argument_exception"
 
 
+class IllegalStateError(ElasticsearchError):
+    """Reference: ``java.lang.IllegalStateException`` surfaced through
+    ``ElasticsearchException`` (e.g. resize validation in
+    ``cluster/metadata/MetadataCreateIndexService.java:1068``)."""
+
+    status = 500
+    error_type = "illegal_state_exception"
+
+
 class ElasticsearchParseError(ElasticsearchError):
     """``ElasticsearchParseException`` — type "parse_exception", distinct
     from ParsingError's "parsing_exception"."""

@@ -10,9 +10,11 @@ grouping). Single-node scope here; the distributed data plane in
 
 from __future__ import annotations
 
+import contextlib
 import os
 import re
 import shutil
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -25,6 +27,22 @@ from ..search.shard_search import ShardSearcher, ShardSearchResult
 from ..utils.murmur3 import shard_for
 
 _VALID_INDEX_RE = re.compile(r"^[^A-Z _\-+][^A-Z\\/*?\"<>| ,#]*$")
+
+#: thread-local marker: the current thread is performing an internal
+#: resize/recovery copy and may write through application write blocks
+_INTERNAL_COPY = threading.local()
+
+
+@contextlib.contextmanager
+def internal_copy_writes():
+    """Scope an internal (resize/recovery) copy on the current thread so
+    ``IndexService._check_write_block`` lets its writes through."""
+    prev = getattr(_INTERNAL_COPY, "active", False)
+    _INTERNAL_COPY.active = True
+    try:
+        yield
+    finally:
+        _INTERNAL_COPY.active = prev
 
 
 def validate_index_name(name: str) -> None:
@@ -117,6 +135,13 @@ class IndexService:
         INDEX_WRITE_BLOCK / INDEX_READ_ONLY_BLOCK; set via the add-block
         API or ``index.blocks.*`` settings)."""
         from ..common.errors import ClusterBlockError
+        if getattr(_INTERNAL_COPY, "active", False):
+            # internal resize/recovery copy on THIS thread — the reference
+            # moves segment files below the write API
+            # (TransportResizeAction.java), so application write blocks
+            # must not stop it; concurrent client writes on other threads
+            # still hit the block
+            return
         s = self.settings
         for key, desc in (("index.blocks.write", "index write (api)"),
                           ("index.blocks.read_only", "index read-only"),

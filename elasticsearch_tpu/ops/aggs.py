@@ -109,6 +109,83 @@ def masked_metrics(pair_docs, pair_vals, mask):
     return cnt, s, mn, mx
 
 
+@jax.jit
+def masked_rank_prefix(offsets, pair_docs, mask):
+    """Masked-count prefix over a **(ordinal, value)**-sorted pair layout —
+    the exact-percentile primitive.
+
+    With pairs sorted by (ordinal, value) so each ordinal's run holds its
+    values ascending, the masked prefix ``C = cumsum(mask[pair_docs])`` is
+    monotone; the r-th smallest *masked* value of ordinal ``o`` (run
+    ``[st, en)``) sits at the first index ``i`` with
+    ``C[i+1] - C[st] == r + 1`` — found by ``searchsorted`` on ``C``
+    (:func:`_rank_pick`). One bandwidth pass + O(log M) per
+    (bucket, rank): exact percentiles where the reference approximates
+    with TDigest (``search/aggregations/metrics/TDigestState.java``) and
+    collects doc-at-a-time.
+
+    Returns (counts int32[V], prefix int32[M+1]) — the prefix stays a
+    device array for :func:`_rank_pick`.
+    """
+    m = jnp.take(mask, pair_docs, mode="fill", fill_value=False)
+    c = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                         jnp.cumsum(m.astype(jnp.int32))])
+    counts = jnp.take(c, offsets[1:]) - jnp.take(c, offsets[:-1])
+    return counts, c
+
+
+@jax.jit
+def _rank_pick(c, offsets, pair_vals_sorted, ordinals, lo, hi, frac):
+    """Device rank→value gather: searchsorted on the monotone masked-count
+    prefix ``c`` finds the pair index of each wanted masked rank; linear
+    interpolation between the lo/hi ranks happens in the same kernel.
+    ordinals int32[B]; lo/hi int32[B, R]; frac f32[B, R]."""
+    st = jnp.take(offsets, ordinals)                        # [B]
+    base = jnp.take(c, st)                                  # [B]
+
+    def pick(rank):                                         # [B, R]
+        tgt = base[:, None] + rank + 1
+        idx = jnp.searchsorted(c, tgt, side="left") - 1
+        idx = jnp.clip(idx, 0, pair_vals_sorted.shape[0] - 1)
+        return jnp.take(pair_vals_sorted, idx)
+
+    return (1.0 - frac) * pick(lo) + frac * pick(hi)
+
+
+def masked_ordinal_percentiles(offsets, pair_docs, pair_vals_sorted, mask,
+                               ordinals, qs):
+    """Exact masked percentiles per ordinal (Hazen interpolation, matching
+    ``search/aggregations.py``'s host path). ``ordinals`` int32[B] selects
+    which buckets; ``qs`` float[R] in [0, 100]. Returns f64[B, R] (NaN for
+    empty buckets). Only the V-sized counts and the [B, R] result cross
+    the host boundary; the M-sized prefix stays on device.
+
+    Callers: the terms+percentiles benchmark (``bench.py`` config #3,
+    BASELINE.md). Product integration is staged: the REST percentiles agg
+    (``search/aggregations.py`` PercentilesAgg) reduces exactly across
+    multiple segments, which needs a cross-segment rank merge on top of
+    this single-run kernel."""
+    counts, c = masked_rank_prefix(offsets, pair_docs, mask)
+    counts_h = np.asarray(counts)
+    ordinals = np.asarray(ordinals, np.int64)
+    qs = np.asarray(qs, np.float64)
+    n = counts_h[ordinals].astype(np.float64)              # [B]
+    # Hazen position q·n − ½ clamped to [0, n−1]; lo/hi adjacent ranks
+    pos = np.clip(qs[None, :] / 100.0 * n[:, None] - 0.5, 0.0,
+                  np.maximum(n[:, None] - 1.0, 0.0))
+    lo = np.floor(pos).astype(np.int32)
+    hi = np.minimum(lo + 1,
+                    np.maximum(n[:, None].astype(np.int32) - 1, 0))
+    frac = (pos - lo).astype(np.float32)
+    picked = _rank_pick(c, jnp.asarray(offsets),
+                        pair_vals_sorted, jnp.asarray(ordinals, jnp.int32),
+                        jnp.asarray(lo), jnp.asarray(hi),
+                        jnp.asarray(frac))
+    out = np.asarray(picked, np.float64)
+    out[n == 0] = np.nan
+    return out
+
+
 def top_ordinals(counts, k: int):
     """(counts desc, ordinal asc) top-k over a device counts vector.
     Ties resolve to the lower ordinal (term-dictionary order — the

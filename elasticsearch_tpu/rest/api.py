@@ -777,12 +777,34 @@ class RestAPI:
                 "conditions": {f"[{k}: {conditions[k]}]": v
                                for k, v in results.items()}}
 
+    @staticmethod
+    def _default_routing_shards(num_shards: int) -> int:
+        """Default routing-shard count for indices created without
+        ``index.number_of_routing_shards`` — largest power-of-two multiple
+        of ``num_shards`` within 1024, so any power-of-two split works
+        (reference: ``MetadataCreateIndexService.calculateNumRoutingShards``).
+        """
+        log2_num = max(0, (num_shards - 1).bit_length())
+        return num_shards << max(1, 10 - log2_num)
+
     def _resize(self, index, target, num_shards, body, kind):
+        from ..common.errors import IllegalStateError
+        from ..node.indices_service import _flatten_settings
         svc = self.indices.get(index)
         payload = _json_body(body) if body else {}
-        settings = payload.get("settings") or {}
-        flat_requested = dict(settings.get("index", settings))
-        n = int(flat_requested.get("number_of_shards", num_shards))
+        flat_requested = _flatten_settings(payload.get("settings") or {})
+
+        def req(key, default=None):
+            return flat_requested.get(
+                f"index.{key}", flat_requested.get(key, default))
+
+        # validation order mirrors the reference: shard-count factor checks
+        # first (TransportResizeAction.java:134-155 via selectShrink/Split/
+        # CloneShard), then the routing-shards-on-resize rejection
+        # (TransportResizeAction.java:160-166, legal only when splitting
+        # from one shard), then the source read-only requirement
+        # (MetadataCreateIndexService.java:1068).
+        n = int(req("number_of_shards", num_shards))
         if kind == "shrink" and svc.num_shards % n:
             raise IllegalArgumentError(
                 f"the number of source shards [{svc.num_shards}] must be "
@@ -791,10 +813,35 @@ class RestAPI:
             raise IllegalArgumentError(
                 f"the number of target shards [{n}] must be a larger "
                 f"multiple of the source shards [{svc.num_shards}]")
+        if kind == "split":
+            # from one shard any split is legal (unless the request pins
+            # routing shards explicitly); otherwise the target count must
+            # divide the source's routing-shard count
+            # (IndexMetadata.java:1648-1652)
+            requested_rn = req("number_of_routing_shards")
+            explicit = svc.settings.get("index.number_of_routing_shards")
+            if svc.num_shards == 1:
+                rn = int(requested_rn) if requested_rn is not None else n
+            elif explicit:
+                rn = int(explicit)
+            else:
+                rn = self._default_routing_shards(svc.num_shards)
+            if rn % n:
+                raise IllegalStateError(
+                    f"the number of routing shards [{rn}] must be a "
+                    f"multiple of the target shards [{n}]")
         if kind == "clone" and n != svc.num_shards:
             raise IllegalArgumentError(
                 f"cannot clone to a different shard count [{n}] than the "
                 f"source [{svc.num_shards}]")
+        if req("number_of_routing_shards") is not None and not (
+                kind == "split" and svc.num_shards == 1):
+            raise IllegalArgumentError(
+                "cannot provide index.number_of_routing_shards on resize")
+        if str(svc.settings.get("index.blocks.write", "")).lower() != "true":
+            raise IllegalStateError(
+                f"index {index} must be read-only to resize index. "
+                f'use "index.blocks.write=true"')
         # target settings: the source's (minus shard count — analysis etc.
         # must survive or copied mappings dangle), overlaid with requested
         base = {k: v for k, v in svc.settings.items()
@@ -818,9 +865,17 @@ class RestAPI:
                 f"{self.SCROLL_MAX_DOCS}-doc single-pass copy limit")
         res = svc.search({"query": {"match_all": {}},
                           "size": self.SCROLL_MAX_DOCS})
-        for h in res.hits:
-            dst.index_doc(h.doc_id, h.source)
-        dst.refresh()
+        # the internal copy bypasses application-level write blocks: the
+        # target inherits index.blocks.write from the source, but the
+        # reference copies segments below the write API
+        # (TransportResizeAction.java — Lucene-level recovery), so the
+        # block must not stop the resize itself (thread-local scope:
+        # concurrent client writes still hit the block)
+        from ..node.indices_service import internal_copy_writes
+        with internal_copy_writes():
+            for h in res.hits:
+                dst.index_doc(h.doc_id, h.source)
+            dst.refresh()
         return {"acknowledged": True, "shards_acknowledged": True,
                 "index": target}
 

@@ -1,0 +1,158 @@
+"""Micro-batching queue for the serving plane: concurrent plane-eligible
+queries coalesce into ONE device dispatch.
+
+The reference amortizes per-query overhead through its search thread pool
+(``threadpool/ThreadPool.java`` SEARCH lane) and batched partial reduction
+(``action/search/QueryPhaseResultConsumer.java``); on a TPU the analogous
+lever is the batch dimension of the dispatch itself — one ``plane.search``
+over B queries costs barely more than B=1 (the kernel is bandwidth-bound
+over the postings table, which every query in the batch shares).
+
+Design ("batch whatever queued during the previous dispatch"): the first
+arrival becomes the *leader* and dispatches immediately — zero added
+latency at low load. Requests that arrive while the device is busy queue
+up; when the leader finishes it promotes one waiter to leader for the
+accumulated batch. Under load the batch size converges to
+arrival-rate × dispatch-time with no tuning knob and no timed wait.
+
+One batcher per plane (planes are per-(shard, field) and rebuilt on
+refresh); dispatches on one plane are serialized by construction, distinct
+planes dispatch concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+#: upper bound on queries per dispatch — past this the dispatch itself is
+#: long enough that splitting reduces tail latency
+MAX_BATCH = 64
+
+
+class _Slot:
+    __slots__ = ("terms", "k", "done", "is_leader", "vals", "hits",
+                 "total", "error")
+
+    def __init__(self, terms: Sequence[str], k: int):
+        self.terms = terms
+        self.k = k
+        self.done = False
+        self.is_leader = False
+        self.vals = None
+        self.hits: Optional[List[Tuple[int, int]]] = None
+        self.total: Optional[int] = None
+        self.error: Optional[BaseException] = None
+
+
+class PlaneMicroBatcher:
+    """Serializes and batches ``plane.search`` dispatches for one plane."""
+
+    def __init__(self, plane, max_batch: int = MAX_BATCH):
+        self.plane = plane
+        self.max_batch = max_batch
+        self._cond = threading.Condition()
+        self._queue: List[_Slot] = []
+        self._leader_active = False
+        # observability (nodes stats / ROOFLINE measurements)
+        self.n_dispatches = 0
+        self.n_queries = 0
+        self.max_seen_batch = 0
+
+    def search(self, terms: Sequence[str], k: int):
+        """One query through the batched dispatch. Returns
+        (scores[k], hits[(shard, doc)...], exact total). Blocks until the
+        dispatch that carries this query completes."""
+        slot = _Slot(terms, k)
+        with self._cond:
+            self._queue.append(slot)
+            if self._leader_active:
+                while not (slot.done or slot.is_leader):
+                    self._cond.wait()
+                if slot.done:
+                    return self._result(slot)
+                # promoted: fall through to lead the accumulated batch
+            else:
+                self._leader_active = True
+        self._lead()
+        return self._result(slot)
+
+    @staticmethod
+    def _result(slot: _Slot):
+        if slot.error is not None:
+            raise slot.error
+        return slot.vals, slot.hits, slot.total
+
+    @staticmethod
+    def _k_bucket(k: int) -> int:
+        """Dispatch k rounded up to a power of two: co-batched queries only
+        share a dispatch within the same bucket, so one size=10000 request
+        neither inflates every size=10 neighbor's kernel nor churns the
+        per-k compile cache (``dist_search._get_step`` caches per k)."""
+        return 1 << max(0, (k - 1).bit_length())
+
+    def _lead(self) -> None:
+        """Dispatch the queued batch (which includes the caller's slot),
+        then hand leadership to a waiter if more queued meanwhile. Only
+        slots in the head slot's k-bucket join; others stay queued for the
+        next leader."""
+        with self._cond:
+            kb = self._k_bucket(self._queue[0].k)
+            batch = [s for s in self._queue[:self.max_batch]
+                     if self._k_bucket(s.k) == kb]
+            taken = set(map(id, batch))
+            self._queue = [s for s in self._queue
+                           if id(s) not in taken]
+        # dispatch at the bucket's rounded-up k so the compile shape is
+        # stable within a bucket (slots trim to their own k on fan-out)
+        k = self._k_bucket(max(s.k for s in batch))
+        # pad the batch to a power of two: every distinct traced B shape is
+        # a fresh XLA compile — ragged arrival sizes would otherwise
+        # compile dozens of programs (empty bags score as no-op queries,
+        # same as the plane's own replica padding)
+        b_pad = 1 << max(0, (len(batch) - 1).bit_length())
+        queries = [s.terms for s in batch] + \
+            [[] for _ in range(b_pad - len(batch))]
+        # pin L (postings-run cap) and the tiered flag so the compile shape
+        # depends only on (B_pow2, Q_pow2, k-bucket), not on which terms a
+        # batch happens to touch
+        L = getattr(self.plane, "L_cap", None)
+        tiered = getattr(self.plane, "T_pad", 0) > 0 or None
+        try:
+            vals, hits, totals = self.plane.search(
+                queries, k=k, L=L, tiered=tiered, with_totals=True)
+        except BaseException as e:          # noqa: BLE001 — fan the error
+            for s in batch:                 # out to every query in the batch
+                s.error = e
+        else:
+            for i, s in enumerate(batch):
+                s.vals = vals[i][:s.k]
+                s.hits = hits[i][:s.k]
+                s.total = totals[i]
+        self.n_dispatches += 1
+        self.n_queries += len(batch)
+        self.max_seen_batch = max(self.max_seen_batch, len(batch))
+        with self._cond:
+            for s in batch:
+                s.done = True
+            if self._queue:
+                self._queue[0].is_leader = True
+            else:
+                self._leader_active = False
+            self._cond.notify_all()
+
+
+def batched_search(plane, terms: Sequence[str], k: int):
+    """Module entry: route one query through the plane's micro-batcher
+    (created lazily on first use; plane rebuilds get a fresh one)."""
+    batcher = getattr(plane, "_microbatcher", None)
+    if batcher is None:
+        with _CREATE_LOCK:
+            batcher = getattr(plane, "_microbatcher", None)
+            if batcher is None:
+                batcher = PlaneMicroBatcher(plane)
+                plane._microbatcher = batcher
+    return batcher.search(terms, k)
+
+
+_CREATE_LOCK = threading.Lock()

@@ -338,11 +338,14 @@ class ShardSearcher:
         need_host_mask = use_field_sort
         if plane_route is not None:
             plane, bag_terms = plane_route
-            pvals, phits, ptotals = plane.search(
-                [bag_terms], k=max(window, 1), with_totals=True)
-            total = int(ptotals[0])
+            # concurrent eligible queries coalesce into one device dispatch
+            # (search/microbatch.py — the search-thread-pool analog)
+            from .microbatch import batched_search
+            pvals0, phits0, ptotal0 = batched_search(
+                plane, bag_terms, k=max(window, 1))
+            total = int(ptotal0)
             candidates = [(float(v), si, d)
-                          for v, (si, d) in zip(pvals[0], phits[0])]
+                          for v, (si, d) in zip(pvals0, phits0)]
         else:
             for seg_idx, seg in enumerate(self.segments):
                 scores, mask = query.execute(self.ctx, seg)

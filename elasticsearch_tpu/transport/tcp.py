@@ -329,12 +329,27 @@ class NodeLoop:
         return box.get("v")
 
     def stop(self):
-        def drain():
-            for task in asyncio.all_tasks(self.loop):
+        """Drain the loop cleanly: cancel every task, give the
+        cancellations a cycle to unwind (so no 'Task was destroyed but it
+        is pending!' storm at interpreter exit), then stop the loop."""
+        done = threading.Event()
+
+        async def drain():
+            me = asyncio.current_task(self.loop)
+            tasks = [t for t in asyncio.all_tasks(self.loop) if t is not me]
+            for task in tasks:
                 task.cancel()
-            self.loop.stop()
+            # await the cancellations so each coroutine actually exits;
+            # return_exceptions swallows the CancelledErrors
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        def kick():
+            t = self.loop.create_task(drain())
+            t.add_done_callback(lambda _t: (done.set(), self.loop.stop()))
+
         try:
-            self.loop.call_soon_threadsafe(drain)
-        except RuntimeError:
-            pass
+            self.loop.call_soon_threadsafe(kick)
+        except RuntimeError:             # loop already closed
+            return
+        done.wait(timeout=2)
         self._thread.join(timeout=2)

@@ -109,3 +109,37 @@ def test_masked_metrics_kernel():
     assert cnt == pm.sum()
     np.testing.assert_allclose(s, vals[pm].sum(), rtol=1e-5)
     assert mn == vals[pm].min() and mx == vals[pm].max()
+
+
+def test_masked_ordinal_percentiles_exact_vs_numpy():
+    """The cumsum+searchsorted percentile kernel is EXACT (Hazen), unlike
+    the reference's TDigest (metrics/TDigestState.java)."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(7)
+    N, V, M = 3000, 12, 15000
+    ords = rng.randint(0, V, M).astype(np.int32)
+    docs = rng.randint(0, N, M).astype(np.int32)
+    vals = (rng.randn(M) * 50).astype(np.float32)
+    order = np.lexsort((vals, ords))
+    ords_s, docs_s, vals_s = ords[order], docs[order], vals[order]
+    offsets = np.cumsum(
+        np.concatenate([[0], np.bincount(ords_s, minlength=V)])
+    ).astype(np.int32)
+    mask = rng.rand(N) < 0.3
+    qs = [5.0, 50.0, 95.0]
+    out = ops_aggs.masked_ordinal_percentiles(
+        jnp.asarray(offsets), jnp.asarray(docs_s), jnp.asarray(vals_s),
+        jnp.asarray(mask), np.arange(V, dtype=np.int32), qs)
+    for o in range(V):
+        mv = np.sort(vals[(ords == o) & mask[docs]])
+        n = len(mv)
+        for qi, q in enumerate(qs):
+            if n == 0:
+                assert np.isnan(out[o, qi])
+                continue
+            pos = min(max(q / 100 * n - 0.5, 0.0), n - 1.0)
+            lo = int(np.floor(pos))
+            hi = min(lo + 1, n - 1)
+            frac = pos - lo
+            ref = (1 - frac) * mv[lo] + frac * mv[hi]
+            assert abs(out[o, qi] - ref) < 1e-3

@@ -1,0 +1,136 @@
+"""Micro-batching serving queue (search/microbatch.py): concurrent queries
+coalesce into shared dispatches with per-query results intact."""
+
+import threading
+import time
+
+from elasticsearch_tpu.search.microbatch import (PlaneMicroBatcher,
+                                                 batched_search)
+
+
+class FakePlane:
+    """Records dispatch batch sizes; scores query i as float(i)."""
+
+    def __init__(self, dispatch_s=0.0):
+        self.batches = []
+        self.dispatch_s = dispatch_s
+        self.lock = threading.Lock()
+
+    def search(self, queries, k=10, L=None, tiered=None, with_totals=False):
+        real = [q for q in queries if q]          # drop pow2 padding slots
+        with self.lock:
+            self.batches.append(len(real))
+        if self.dispatch_s:
+            time.sleep(self.dispatch_s)
+        vals = [[float(q[0])] * k if q else [] for q in queries]
+        hits = [[(0, int(q[0]))] * k if q else [] for q in queries]
+        totals = [int(q[0]) + 1000 if q else 0 for q in queries]
+        return vals, hits, totals
+
+
+def test_single_query_zero_added_latency_path():
+    plane = FakePlane()
+    b = PlaneMicroBatcher(plane)
+    vals, hits, total = b.search([7], k=3)
+    assert vals == [7.0] * 3 and hits == [(0, 7)] * 3 and total == 1007
+    assert plane.batches == [1]
+
+
+def test_concurrent_queries_coalesce_and_results_stay_per_query():
+    plane = FakePlane(dispatch_s=0.05)
+    b = PlaneMicroBatcher(plane)
+    results = {}
+    errs = []
+
+    def go(i):
+        try:
+            vals, hits, total = b.search([i], k=2)
+            results[i] = (vals, hits, total)
+        except Exception as e:          # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    for i in range(24):
+        vals, hits, total = results[i]
+        assert vals == [float(i)] * 2
+        assert hits == [(0, i)] * 2
+        assert total == i + 1000
+    # 24 queries with a 50 ms dispatch must coalesce well below 24
+    # dispatches (first leader may go alone; the rest pile up behind it)
+    assert len(plane.batches) < 24
+    assert sum(plane.batches) == 24
+    assert max(plane.batches) >= 2
+
+
+def test_mixed_k_trims_per_slot():
+    plane = FakePlane(dispatch_s=0.02)
+    b = PlaneMicroBatcher(plane)
+    out = {}
+
+    def go(i, k):
+        out[i] = b.search([i], k=k)
+
+    threads = [threading.Thread(target=go, args=(i, 2 + (i % 3)))
+               for i in range(9)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(9):
+        k = 2 + (i % 3)
+        vals, hits, total = out[i]
+        assert len(vals) == k and len(hits) == k
+
+
+def test_error_fans_out_and_batcher_recovers():
+    class Boom(FakePlane):
+        def __init__(self):
+            super().__init__(dispatch_s=0.02)
+            self.fail_first = True
+
+        def search(self, queries, k=10, L=None, tiered=None,
+                   with_totals=False):
+            with self.lock:
+                first = self.fail_first
+                self.fail_first = False
+            if first:
+                time.sleep(0.02)
+                raise RuntimeError("kernel exploded")
+            return super().search(queries, k, L, tiered, with_totals)
+
+    plane = Boom()
+    b = PlaneMicroBatcher(plane)
+    errs, oks = [], []
+
+    def go(i):
+        try:
+            oks.append(b.search([i], k=1))
+        except RuntimeError:
+            errs.append(i)
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # the first dispatch's queries error; later ones succeed
+    assert errs, "first dispatch should have failed"
+    assert len(errs) + len(oks) == 8
+    # batcher still serves after the failure
+    vals, hits, total = b.search([3], k=1)
+    assert vals == [3.0]
+
+
+def test_batched_search_entry_creates_one_batcher_per_plane():
+    plane = FakePlane()
+    vals, hits, total = batched_search(plane, [5], k=1)
+    assert vals == [5.0] and total == 1005
+    assert getattr(plane, "_microbatcher") is not None
+    b1 = plane._microbatcher
+    batched_search(plane, [6], k=1)
+    assert plane._microbatcher is b1

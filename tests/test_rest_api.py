@@ -369,6 +369,12 @@ def test_shrink_split_clone(api):
     for i in range(20):
         req(api, "PUT", f"/big/_doc/{i}", {"n": i})
     req(api, "POST", "/big/_refresh")
+    # resize requires the source to be write-blocked
+    # (MetadataCreateIndexService.java:1068)
+    st, _ = req(api, "PUT", "/big/_shrink/early", {"settings": {
+        "index": {"number_of_shards": 2}}})
+    assert st == 500        # illegal_state: not read-only yet
+    req(api, "PUT", "/big/_settings", {"index.blocks.write": True})
     st, out = req(api, "PUT", "/big/_shrink/small", {"settings": {
         "index": {"number_of_shards": 2}}})
     assert st == 200
@@ -413,6 +419,7 @@ def test_rollover_dry_run_spellings_and_resize_validation(api):
     assert st == 400
     # resize carries requested aliases
     req(api, "PUT", "/rz/_doc/1", {"n": 1})
+    req(api, "PUT", "/rz/_settings", {"index.blocks.write": True})
     st, _ = req(api, "PUT", "/rz/_shrink/rzs", {
         "settings": {"index": {"number_of_shards": 2}},
         "aliases": {"rz-alias": {}}})
@@ -421,3 +428,29 @@ def test_rollover_dry_run_spellings_and_resize_validation(api):
     st, out = req(api, "POST", "/rz-alias/_search",
                   {"query": {"match_all": {}}})
     assert st == 200 and out["hits"]["total"]["value"] == 1
+
+
+def test_internal_copy_write_block_bypass_is_thread_local(api):
+    """The resize-copy bypass must not leak to concurrent client writes
+    (reference copies below the write API; clients still hit the block)."""
+    import threading
+    from elasticsearch_tpu.common.errors import ClusterBlockError
+    from elasticsearch_tpu.node.indices_service import internal_copy_writes
+    req(api, "PUT", "/blk", None)
+    req(api, "PUT", "/blk/_settings", {"index.blocks.write": True})
+    svc = api.indices.get("blk")
+    other_thread_result = {}
+
+    def try_write():
+        try:
+            svc.index_doc("x", {"n": 1})
+            other_thread_result["ok"] = True
+        except ClusterBlockError:
+            other_thread_result["blocked"] = True
+
+    with internal_copy_writes():
+        svc.index_doc("internal", {"n": 0})      # this thread: bypassed
+        t = threading.Thread(target=try_write)
+        t.start()
+        t.join()
+    assert other_thread_result == {"blocked": True}
