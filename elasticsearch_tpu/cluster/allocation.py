@@ -110,7 +110,10 @@ class FilterDecider:
 
     def can_allocate(self, index, sid, node, ctx) -> Decision:
         settings = ctx.index_settings(index)
-        attrs = dict(ctx.node_attrs.get(node) or {}, _name=node)
+        # pseudo-attributes (reference: DiscoveryNodeFilters) — node id
+        # and name coincide in this model; _ip/_host are loopback
+        attrs = dict(ctx.node_attrs.get(node) or {}, _name=node, _id=node,
+                     _ip="127.0.0.1", _host="127.0.0.1")
         for key, value in settings.items():
             if not key.startswith("index.routing.allocation."):
                 continue
@@ -382,15 +385,20 @@ class BalancedAllocator:
         return moves
 
 
-def explain(index: str, sid: int, ctx,
-            deciders=ALL_DECIDERS) -> dict:
-    """Allocation explain (``ClusterAllocationExplainAction``): per-node
-    decider verdicts for one shard copy."""
+def explain(index: str, sid: int, ctx, deciders=ALL_DECIDERS,
+            primary: bool = True, force_unassigned: bool = False,
+            unassigned_reason: str = "INDEX_CREATED") -> dict:
+    """Allocation explain (``ClusterAllocationExplainAction`` /
+    ``allocation/ClusterAllocationExplanation.java``): per-node decider
+    verdicts for one shard copy, plus the assigned-shard rebalance
+    sections or the unassigned-shard allocate sections."""
+    import time as _time
     out = []
     for node in sorted(ctx.nodes):
         verdict, decisions = decide(index, sid, node, ctx, deciders)
         out.append({
             "node_id": node,
+            "node_name": node,
             "node_decision": "yes" if verdict == YES else
                              ("throttled" if verdict == THROTTLE else "no"),
             "deciders": [{"decider": d.decider,
@@ -401,15 +409,45 @@ def explain(index: str, sid: int, ctx,
                           "explanation": "all deciders allow allocation"}],
         })
     entry = ctx.routing.get(index, {}).get(str(sid)) or {}
-    return {
+    owner = None if force_unassigned else (
+        entry.get("primary") if primary
+        else (entry.get("replicas") or [None])[0])
+    doc = {
         "index": index,
         "shard": sid,
-        "primary": True,
-        "current_state": "started" if entry.get("primary")
-                         else "unassigned",
-        "current_node": {"id": entry.get("primary")}
-                        if entry.get("primary") else None,
-        "can_allocate": "yes" if any(
-            n["node_decision"] == "yes" for n in out) else "no",
-        "node_allocation_decisions": out,
+        "primary": primary,
+        "current_state": "started" if owner else "unassigned",
     }
+    others_yes = any(n["node_decision"] == "yes" for n in out
+                     if n["node_id"] != owner)
+    if owner:
+        doc["current_node"] = {"id": owner, "name": owner,
+                               "transport_address": "127.0.0.1:9300"}
+        # the copy is started and healthy; the deciders that could force
+        # it off (filters, disk watermarks) are the same ones consulted
+        # for allocation — none veto staying put in this model
+        doc["can_remain_on_current_node"] = "yes"
+        doc["can_rebalance_cluster"] = "yes"
+        doc["can_rebalance_to_other_node"] = \
+            "yes" if others_yes else "no"
+        doc["rebalance_explanation"] = (
+            "rebalancing is allowed on this cluster; the balancer moves "
+            "this shard only when it improves the weight function"
+            if others_yes else
+            "cannot rebalance as no target node exists that can both "
+            "allocate this shard and improve the cluster balance")
+    else:
+        doc["unassigned_info"] = {
+            "reason": unassigned_reason,
+            "at": _time.strftime("%Y-%m-%dT%H:%M:%S.000Z", _time.gmtime()),
+            "last_allocation_status": "no_attempt",
+        }
+        doc["can_allocate"] = "yes" if any(
+            n["node_decision"] == "yes" for n in out) else "no"
+        doc["allocate_explanation"] = (
+            "Elasticsearch can allocate the shard."
+            if doc["can_allocate"] == "yes" else
+            "Elasticsearch isn't allowed to allocate this shard to any of "
+            "the nodes in the cluster.")
+    doc["node_allocation_decisions"] = out
+    return doc

@@ -86,6 +86,14 @@ class ParsingError(ElasticsearchError):
     error_type = "parsing_exception"
 
 
+class QueryShardError(ElasticsearchError):
+    """Reference: ``index/query/QueryShardException.java`` — a query that
+    cannot execute against this shard's mapping."""
+
+    status = 400
+    error_type = "query_shard_exception"
+
+
 class SearchPhaseExecutionError(ElasticsearchError):
     status = 500
     error_type = "search_phase_execution_exception"

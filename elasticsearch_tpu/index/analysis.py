@@ -518,6 +518,11 @@ class AnalysisRegistry:
 
     def get(self, name: str) -> Analyzer:
         a = self._analyzers.get(name)
+        if a is None and name == "default":
+            # "analyzer": "default" aliases the index default analyzer
+            # (settings `index.analysis.analyzer.default`), falling back
+            # to standard (reference: AnalysisRegistry.getAnalyzer)
+            a = self._analyzers.get("standard")
         if a is None:
             raise IllegalArgumentError(f"failed to find analyzer [{name}]")
         return a

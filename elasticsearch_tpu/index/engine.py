@@ -88,9 +88,14 @@ class Engine:
                  primary_term: int = 1,
                  translog_durability: str = Translog.DURABILITY_REQUEST,
                  max_segments: int = 12,
-                 gc_deletes_seconds: float = 60.0):
+                 gc_deletes_seconds: float = 60.0,
+                 index_sort: Optional[List[Tuple[str, str]]] = None):
         self.path = path
         self.mapper = mapper
+        #: [(field, "asc"|"desc")] — segments hold docs in this order
+        #: (reference: IndexSortConfig); applied at refresh/merge via a
+        #: sorted rebuild
+        self.index_sort = index_sort
         self.primary_term = primary_term
         self.max_segments = max_segments
         # tombstone retention window (reference: `index.gc_deletes`)
@@ -101,6 +106,12 @@ class Engine:
         self.segments: List[Segment] = []
         self._persisted_segments: Dict[str, str] = {}  # seg_id -> file name
         self._dirty_segments: set = set()  # persisted segs with changed liveness
+        #: segment-located deletes awaiting the next refresh — NRT delete
+        #: isolation: a delete is realtime-GET-visible immediately (version
+        #: map tombstone) but search-visible only after refresh, like the
+        #: reference's reader-reopen semantics (InternalEngine.delete +
+        #: ReaderManager swap)
+        self._pending_seg_deletes: List[Tuple[object, int]] = []
         self._next_seg_no = 0
         self.version_map: Dict[str, VersionValue] = {}
         self.tracker = LocalCheckpointTracker()
@@ -267,12 +278,10 @@ class Engine:
                     self._buffer.deleted.add(c)
         else:
             _, seg, local = current.location
-            seg.delete_doc(local)
-            # an already-persisted segment's liveness bitmap changed: it must
-            # be re-persisted at the next flush or the delete is lost on
-            # restart (the persisted file still says live=True)
-            if seg.seg_id in self._persisted_segments:
-                self._dirty_segments.add(seg.seg_id)
+            # NRT isolation: queue for the next refresh instead of marking
+            # the shared liveness bitmap now — the open "reader" (current
+            # segment views) must not see the delete until refresh
+            self._pending_seg_deletes.append((seg, local))
 
     # ------------------------------------------------------------------
     # index / delete / get
@@ -419,20 +428,86 @@ class Engine:
     # refresh / flush / merge
     # ------------------------------------------------------------------
 
+    def _apply_pending_deletes(self) -> bool:
+        """Publish queued segment-level deletes to the liveness bitmaps —
+        the refresh-time half of NRT delete isolation."""
+        if not self._pending_seg_deletes:
+            return False
+        pending, self._pending_seg_deletes = self._pending_seg_deletes, []
+        for seg, local in pending:
+            seg.delete_doc(local)
+            # an already-persisted segment's liveness bitmap changed: it
+            # must be re-persisted at the next flush or the delete is lost
+            # on restart (the persisted file still says live=True)
+            if seg.seg_id in self._persisted_segments:
+                self._dirty_segments.add(seg.seg_id)
+        return True
+
+    def _sorted_rebuild(self, seg: Segment) -> Segment:
+        """Reorder a fully-live segment by ``index_sort`` (re-parse of the
+        stored sources — index sorting is opt-in and write-time-paid, like
+        the reference's sorted flush; nested docs forbid index sorting in
+        the reference, so segments with nested paths pass through)."""
+        if not self.index_sort or seg.n_docs <= 1 or seg.nested_paths:
+            return seg
+        n = seg.n_docs
+        cols = []
+        for field, order in self.index_sort:
+            nf = seg.numeric_fields.get(field)
+            col = np.full(n, np.inf)
+            if nf is not None:
+                # first value per doc (pairs sorted by doc)
+                docs = np.asarray(nf.docs_host)
+                vals = np.asarray(nf.vals_host, np.float64)
+                first = np.full(n, np.inf)
+                # reversed assignment keeps the FIRST pair per doc
+                first[docs[::-1]] = vals[::-1]
+                col = first
+            if str(order) == "desc":
+                col = np.where(np.isinf(col), col, -col)
+            cols.append(col)
+        # np.lexsort sorts by the LAST key first: insertion-order tiebreak
+        # least significant, index_sort[0] most significant (last)
+        order_idx = np.lexsort([np.arange(n)] + cols[::-1])
+        builder = SegmentBuilder(seg.seg_id)
+        for local in order_idx:
+            local = int(local)
+            if not seg.live[local]:
+                continue                    # dead rows drop, like a merge
+            uid = seg.doc_uids[local]
+            vv = self.version_map.get(uid)
+            parsed = self.mapper.parse_document(
+                uid, seg.sources[local],
+                vv.routing if vv is not None else None)
+            builder.add(parsed, int(seg.seq_nos[local]))
+        # both callers repoint the version map themselves (refresh by the
+        # builder's buffer locals, merge by enumerating the result)
+        return builder.build()
+
     def refresh(self) -> bool:
         """Freeze the buffer into a searchable device segment (NRT refresh;
         reference: ``InternalEngine.refresh`` dual ReaderManager swap)."""
+        applied_deletes = self._apply_pending_deletes()
         if len(self._buffer) == 0:
-            return False
+            if applied_deletes:
+                self.stats["refresh_total"] += 1
+                self.maybe_merge()
+            return applied_deletes
         builder = self._buffer
         self._new_buffer()
         seg = builder.build()
+        seg = self._sorted_rebuild(seg)
         self.segments.append(seg)
-        # repoint version map entries from buffer to the new segment
-        for local, uid in enumerate(seg.doc_uids):
+        # repoint version map entries from buffer to the new segment (by
+        # the BUILDER's local ids — index sorting may have permuted the
+        # segment's doc order)
+        for old_local, uid in enumerate(builder.doc_uids):
             vv = self.version_map.get(uid)
-            if vv and vv.location == ("buffer", local):
-                vv.location = ("segment", seg, local)
+            if vv and vv.location == ("buffer", old_local):
+                new_local = seg.find_doc(uid)
+                if new_local is None:       # deleted while buffered
+                    continue
+                vv.location = ("segment", seg, new_local)
                 vv.source = None  # now served from segment store
         self.stats["refresh_total"] += 1
         self.maybe_merge()
@@ -521,6 +596,7 @@ class Engine:
         count exceeds the budget, and prune tombstone-heavy segments
         (reference: ``EsTieredMergePolicy.java:35``). Merging re-parses live
         sources into a fresh segment; device postings are rebuilt."""
+        self._apply_pending_deletes()       # merges rewrite liveness
         candidates = [s for s in self.segments
                       if s.n_docs and s.live_count < s.n_docs // 2]
         if len(self.segments) > self.max_segments:
@@ -536,6 +612,9 @@ class Engine:
 
     def force_merge(self) -> bool:
         """Merge everything into one segment (``_forcemerge`` API)."""
+        # a merge rewrites liveness into the new segment: publish queued
+        # NRT deletes first or they'd dangle on dropped segment objects
+        self._apply_pending_deletes()
         live_segments = [s for s in self.segments if s.n_docs > 0]
         if len(live_segments) <= 1 and all(
                 s.live_count == s.n_docs for s in live_segments):
@@ -554,6 +633,7 @@ class Engine:
         self._next_seg_no += 1
         rest = [s for s in self.segments if id(s) not in merged_ids]
         if new_seg is not None:
+            new_seg = self._sorted_rebuild(new_seg)
             rest.append(new_seg)
             for new_local, uid in enumerate(new_seg.doc_uids):
                 vv = self.version_map.get(uid)
@@ -574,14 +654,23 @@ class Engine:
 
     @property
     def doc_count(self) -> int:
+        # queued NRT deletes are already logically dead (their version-map
+        # entry is a tombstone or points at a newer copy): subtract them so
+        # an updated-but-unrefreshed doc never counts twice
+        pending = sum(1 for seg, local in self._pending_seg_deletes
+                      if seg.live[local] and
+                      (len(seg.nested_paths) == 0 or
+                       seg.parent_mask[local]))
         return sum(s.live_parent_count for s in self.segments) + \
             sum(1 for i in range(self._buffer.n_docs)
                 if i not in self._buffer.deleted
-                and i not in self._buffer.parent_of)
+                and i not in self._buffer.parent_of) - pending
 
     @property
     def deleted_count(self) -> int:
-        return sum(s.n_docs - s.live_count for s in self.segments)
+        return sum(s.n_docs - s.live_count for s in self.segments) + \
+            sum(1 for seg, local in self._pending_seg_deletes
+                if seg.live[local])
 
     def close(self) -> None:
         self.translog.close()

@@ -459,6 +459,12 @@ class DateFieldType(MappedFieldType):
         self.locale = (params or {}).get("locale") or "en"
         self.nanos = nanos          # date_nanos resolution (sort values
                                     # serialize as epoch nanos)
+        if nanos:
+            # instance override: rendered mappings must say date_nanos or
+            # a replicated put_mapping round-trip silently demotes the
+            # field to ms resolution (cluster tier replays the RENDERED
+            # mapping on every node)
+            self.type_name = "date_nanos"
 
     #: max epoch-millis storable in a signed-64 nanosecond long
     NANOS_MAX_MS = (1 << 63) / 1e6
@@ -1087,6 +1093,11 @@ class MapperService:
 
     def _merge_properties(self, prefix: str, props: dict) -> None:
         for name, spec in props.items():
+            if name == "":
+                # reference: ObjectMapper.TypeParser rejects empty names
+                # with an IllegalArgumentException
+                raise IllegalArgumentError(
+                    "field name cannot be an empty string")
             if not isinstance(spec, dict):
                 raise MapperParsingError(f"invalid mapping for field [{name}]")
             full = f"{prefix}{name}"
@@ -1105,9 +1116,13 @@ class MapperService:
             if ftype == "object" or ftype == "nested":
                 if ftype == "nested" or not isinstance(
                         existing, NestedFieldType):
-                    # dynamic "object" updates never demote a nested field
+                    # dynamic "object" updates never demote a nested
+                    # field; nested params (include_in_parent/root)
+                    # survive into the rendered mapping
+                    extra = {k: v for k, v in spec.items()
+                             if k not in ("type", "properties")}
                     self._fields[full] = (
-                        NestedFieldType(full, {"type": "nested"})
+                        NestedFieldType(full, extra)
                         if ftype == "nested"
                         else ObjectFieldType(full, {"type": ftype}))
                 self._merge_properties(f"{full}.", spec.get("properties", {}))

@@ -543,9 +543,11 @@ class DateProcessor(Processor):
 
 
 class ScriptProcessor(Processor):
-    """Assignment scripts: ``ctx.target = <expr>`` statements separated by
-    ``;`` (the reference embeds Painless; the restricted grammar keeps the
-    expressions compilable — see ``utils/expressions.py``)."""
+    """Ingest scripts through the sandboxed Painless-lite engine
+    (``script/painless_lite.py``): ``ctx`` is the document source itself
+    (plus ``_index``/``_id`` metadata), mutated in place — statements,
+    conditionals, loops and method calls all work (reference: the ingest
+    ScriptProcessor embeds full Painless)."""
 
     type_name = "script"
 
@@ -556,24 +558,23 @@ class ScriptProcessor(Processor):
             raise ParsingError("[source] required property is missing "
                                "(processor [script])")
         self.params = body.get("params", {})
-        self.statements = []
-        for stmt in src.split(";"):
-            stmt = stmt.strip()
-            if not stmt:
-                continue
-            m = re.match(r"ctx\.([A-Za-z_][A-Za-z0-9_.]*)\s*=(?!=)\s*(.+)$",
-                         stmt)
-            if m is None:
-                raise ScriptException(
-                    f"ingest scripts must be `ctx.field = expression` "
-                    f"statements, got [{stmt}]")
-            self.statements.append((m.group(1), m.group(2)))
+        from ..script.service import DEFAULT as _scripts
+        self.compiled = _scripts.compile(src)   # compile-time validation
 
     def run(self, doc):
-        for target, expr in self.statements:
-            env = dict(doc.flat_env())
-            env.update(self.params)
-            doc.set(target, eval_ingest_expr(expr, env))
+        ctx = doc.source
+        # metadata reads/writes go through the same ctx (the reference
+        # exposes _index/_id on the ingest ctx map); pop back out even
+        # when the script throws, or a handled failure would index the
+        # metadata keys into _source
+        for k, v in doc.meta.items():
+            ctx.setdefault(k, v)
+        try:
+            self.compiled.run({"ctx": ctx, "params": dict(self.params)})
+        finally:
+            for k in list(doc.meta):
+                if k in ctx:
+                    doc.meta[k] = ctx.pop(k)
 
 
 class LowercaseProcessor(Processor):
@@ -993,6 +994,16 @@ class Pipeline:
         self.meta = config.get("_meta")
         if "processors" not in config:
             raise ParsingError("[processors] required property is missing")
+        unknown = set(config) - {"description", "version", "_meta",
+                                 "processors", "on_failure"}
+        if unknown:
+            # reference: Pipeline.create rejects leftover top-level keys
+            # with an ElasticsearchParseException
+            from ..common.errors import ElasticsearchParseError
+            raise ElasticsearchParseError(
+                f"pipeline [{pipeline_id}] doesn't support one or more "
+                f"provided configuration parameters "
+                f"{sorted(unknown)}")
         self.processors = [build_processor(p) for p in config["processors"]]
         self.on_failure = [build_processor(p) for p in
                            config.get("on_failure", [])]

@@ -113,7 +113,10 @@ class ClusterNode:
     def __init__(self, node_id: str, host: str, port: int,
                  peers: Dict[str, Tuple[str, int]], data_path: str,
                  seed: int = 0,
-                 node_attrs: Optional[Dict[str, dict]] = None):
+                 node_attrs: Optional[Dict[str, dict]] = None,
+                 shared_secret: Optional[str] = None,
+                 transport_ssl: Optional[tuple] = None,
+                 security=None):
         self.node_id = node_id
         self.data_path = data_path
         #: awareness/filter attributes for EVERY node (static membership)
@@ -125,8 +128,12 @@ class ClusterNode:
         self.node_loop = NodeLoop()
         all_peers = dict(peers)
         all_peers.pop(node_id, None)
+        ssl_srv, ssl_cli = transport_ssl or (None, None)
         self.transport = TcpTransport(node_id, host, port, all_peers,
-                                      self.node_loop.loop)
+                                      self.node_loop.loop,
+                                      shared_secret=shared_secret,
+                                      ssl_server_ctx=ssl_srv,
+                                      ssl_client_ctx=ssl_cli)
         self.queue = AsyncTaskQueue(self.node_loop.loop, seed=seed)
         self.node_ids = sorted(list(peers) + [node_id]) \
             if node_id not in peers else sorted(peers)
@@ -154,6 +161,9 @@ class ClusterNode:
         from .cluster_rest import ClusterHooks, ClusterRestService
         self.rest = ClusterRestService(self,
                                        os.path.join(data_path, "local"))
+        if security is not None:
+            # shared API-key store + REST enforcement at the front door
+            self.rest.api.security = security
         self._hooks = ClusterHooks(self.rest)
         self.http = None
         self._http_pool: Optional[ThreadPoolExecutor] = None
@@ -218,14 +228,37 @@ class ClusterNode:
         self._http_pool = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix=f"{self.node_id}-http")
 
-        async def handler(method, path, query, body):
+        async def handler(method, path, query, body, headers=None):
             loop = asyncio.get_running_loop()
             return await loop.run_in_executor(
-                self._http_pool, self.rest.handle, method, path, query,
-                body)
+                self._http_pool, lambda: self.rest.handle(
+                    method, path, query, body, headers=headers))
 
-        self.http = HttpServer(handler, host=host, port=port)
+        self.http = HttpServer(handler, host=host, port=port,
+                               pass_headers=True)
         self.node_loop.call(self.http.start())
+
+    def rpc_or_direct(self, dst: str, action: str, raw_fn, payload,
+                      timeout: float = 2.0, readonly: bool = False):
+        """RPC — except self-calls that must not queue behind the data
+        worker:
+
+        - FROM the data worker, a loopback would deadlock behind itself
+          (the handler queues on the same single-threaded pool) — invoke
+          directly, we ARE the serialization point (same special case as
+          ``ClusterRestService._meta_op``'s master loopback);
+        - ``readonly`` self-calls (search/stats reads) go direct from ANY
+          thread: the caller typically holds ``rest.lock`` while the data
+          worker may be waiting for that same lock in ``_apply_state`` —
+          queueing the read behind it deadlocks until the RPC timeout.
+          Direct reads race engine refresh the same way the front's own
+          ``_local`` searches of its primaried shards already do
+          (segment lists swap atomically; segments are immutable)."""
+        if dst == self.node_id and (
+                readonly or threading.current_thread().name
+                .startswith(f"{self.node_id}-data")):
+            return raw_fn(self.node_id, payload)
+        return self.rpc(dst, action, payload, timeout=timeout)
 
     def rpc(self, dst: str, action: str, payload, timeout: float = 2.0):
         """Synchronous RPC from any thread (test/client surface)."""
@@ -764,6 +797,38 @@ class ClusterNode:
     #: DistributedSearcher's shard<<48 | seg<<32 | doc encoding
     _NODE_ORD_SHIFT = 64
 
+    #: adaptive-replica-selection EWMA smoothing (the reference's
+    #: ResponseCollectorService uses alpha=0.3)
+    _ARS_ALPHA = 0.3
+
+    def _ars_rank(self, node_id: str) -> float:
+        """Observed EWMA response seconds for ``node_id`` (0.0 when never
+        measured — new nodes get tried)."""
+        stats = getattr(self, "_ars_stats", None)
+        if stats is None:
+            return 0.0
+        rec = stats.get(node_id)
+        return rec["ewma_s"] if rec else 0.0
+
+    def _ars_observe(self, node_id: str, seconds: float) -> None:
+        stats = getattr(self, "_ars_stats", None)
+        if stats is None:
+            stats = self._ars_stats = {}
+        rec = stats.setdefault(node_id,
+                               {"ewma_s": 0.0, "searches": 0})
+        rec["searches"] += 1
+        rec["ewma_s"] = seconds if rec["searches"] == 1 else (
+            self._ARS_ALPHA * seconds +
+            (1 - self._ARS_ALPHA) * rec["ewma_s"])
+
+    def adaptive_selection_stats(self) -> dict:
+        """nodes-stats ``adaptive_selection`` section (reference:
+        ``ResponseCollectorService.ComputedNodeStats``)."""
+        return {n: {"outgoing_searches": rec["searches"],
+                    "avg_response_time_ns": int(rec["ewma_s"] * 1e9),
+                    "rank": f"{rec['ewma_s'] * 1e3:.1f}"}
+                for n, rec in getattr(self, "_ars_stats", {}).items()}
+
     def search(self, index: str, body: Optional[dict] = None) -> dict:
         body = body or {}
         if "aggregations" in body and "aggs" not in body:
@@ -774,10 +839,22 @@ class ClusterNode:
         from_ = int(body.get("from", 0))
         shard_body = dict(body, size=size + from_)
         shard_body["from"] = 0
-        # group shards by the node serving them (primary preferred)
+        # group shards by the node serving them — adaptive replica
+        # selection: each shard's copy set (primary + in-sync replicas)
+        # ranks by the EWMA response time this coordinator has observed
+        # per node (reference: ``cluster/routing/OperationRouting.java:42``
+        # + ``node/ResponseCollectorService.java``); ties prefer the
+        # node with the fewest shards already assigned in this request
+        # (spreads load), then the primary
         by_node: Dict[str, List[int]] = {}
+        live = self.live_nodes()
         for sid_s, entry in table.items():
-            by_node.setdefault(entry["primary"], []).append(int(sid_s))
+            copies = [entry["primary"]] + [
+                r for r in entry.get("replicas", ()) if r in live]
+            best = min(copies, key=lambda n: (
+                self._ars_rank(n), len(by_node.get(n, ())),
+                0 if n == entry["primary"] else 1))
+            by_node.setdefault(best, []).append(int(sid_s))
         node_order = sorted(by_node)
         # -- DFS stats round: cluster-wide term statistics. A node that
         # cannot answer in time degrades to partial stats (slightly-off
@@ -788,10 +865,11 @@ class ClusterNode:
             s = None
             for attempt in (15.0, 15.0):
                 try:
-                    s = self.rpc(node_id, "search:stats", {
-                        "index": index, "shards": by_node[node_id],
-                        "body": {"query": body.get("query")}},
-                        timeout=attempt)
+                    s = self.rpc_or_direct(
+                        node_id, "search:stats", self._h_search_stats, {
+                            "index": index, "shards": by_node[node_id],
+                            "body": {"query": body.get("query")}},
+                        timeout=attempt, readonly=True)
                     break
                 except Exception:   # noqa: BLE001 — retry once, then skip
                     continue
@@ -830,8 +908,11 @@ class ClusterNode:
             payload = {"index": index, "shards": by_node[node_id],
                        "body": nb, "global_stats": stats,
                        "want_agg_partials": bool(body.get("aggs"))}
-            results.append(self.rpc(node_id, "search:shards", payload,
-                                    timeout=15.0))
+            t_rpc = time.monotonic()
+            results.append(self.rpc_or_direct(
+                node_id, "search:shards", self._h_search_shards, payload,
+                timeout=15.0, readonly=True))
+            self._ars_observe(node_id, time.monotonic() - t_rpc)
         # merge (same comparator as the single-node coordinator), then lift
         # tiebreaks into the node-global cursor space
         merged = []
@@ -1001,6 +1082,8 @@ class ClusterNode:
         t.register(nid, "replica:sync_gcp",
                    on_replica(self._h_replica_sync_gcp))
         t.register(nid, "snap:shard", on_worker(self._h_snap_shard))
+        t.register(nid, "stats:shards", on_worker(self.rest.h_stats_shards))
+        t.register(nid, "search:canmatch", on_worker(self._h_can_match))
 
     def _h_snap_shard(self, src, payload):
         """Upload this node's primary copy of one shard into the shared
@@ -1144,6 +1227,17 @@ class ClusterNode:
                     tgt[t] = tgt.get(t, 0) + sum(
                         seg.term_df(f, t) for seg in shard.segments)
         return {"total_docs": total_docs, "fields": fields, "terms": terms}
+
+    def _h_can_match(self, src, payload):
+        """can_match verdict over THIS node's segments of the index: its
+        local service engines hold data only for locally-primaried
+        shards; empty engines contribute nothing (conservative)."""
+        from ..search.dist_query import _shard_can_match
+        svc = self.rest.indices.indices.get(payload["index"])
+        if svc is None:
+            return {"can_match": True}
+        bounds = [tuple(b) for b in payload.get("bounds") or []]
+        return {"can_match": _shard_can_match(svc.searcher(), bounds)}
 
     def _h_search_shards(self, src, payload):
         name = payload["index"]

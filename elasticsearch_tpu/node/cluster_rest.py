@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import re
 import threading
 import time
@@ -97,6 +98,19 @@ def _nodes_predicate(expr: str, n: int) -> bool:
         return n == int(expr)
     except ValueError:
         return True
+
+
+def _parse_query(query: Optional[str]) -> Dict[str, str]:
+    """Decoded query params; bare flags (?v) become "" like parse_qs
+    with keep_blank_values can't express — shared by every cluster-front
+    handler (rest/api.py has the same shape inline)."""
+    from urllib.parse import parse_qs
+    out = {k: v[-1] for k, v in parse_qs(
+        query or "", keep_blank_values=True).items()}
+    for part in (query or "").split("&"):
+        if part and "=" not in part:
+            out[part] = ""
+    return out
 
 
 def _remote_error(e: RemoteTransportError) -> Exception:
@@ -282,14 +296,40 @@ class ClusterHooks:
             shard_body["query"] = body["query"]
         partials: Dict[str, list] = {}
         for owner in sorted(by_node):
-            r = node.rpc(owner, "search:shards", {
-                "index": index, "shards": by_node[owner],
-                "body": shard_body, "want_agg_partials": True},
-                timeout=10.0)
+            r = node.rpc_or_direct(owner, "search:shards",
+                                   node._h_search_shards, {
+                                       "index": index,
+                                       "shards": by_node[owner],
+                                       "body": shard_body,
+                                       "want_agg_partials": True},
+                                   timeout=10.0, readonly=True)
             got = loads_b64(r.get("agg_partials", ""))
             for name_, parts in got.items():
                 partials.setdefault(name_, []).extend(parts)
         return partials
+
+    def can_match(self, index: str, bounds) -> Optional[bool]:
+        """Cluster-wide can_match: OR of each owner node's verdict over
+        its primaried segments (reference: ``TransportSearchAction``'s
+        can-match phase fans out ``ShardSearchRequest``s). None → index
+        not cluster-routed, caller evaluates locally."""
+        node = self.rest.node
+        st = node.applied_state
+        table = (st.data.get("routing", {}) if st else {}).get(index)
+        if table is None:
+            return None
+        owners = {e["primary"] for e in table.values() if e.get("primary")}
+        for owner in sorted(owners):
+            try:
+                r = node.rpc_or_direct(
+                    owner, "search:canmatch", node._h_can_match,
+                    {"index": index, "bounds": bounds}, timeout=5.0,
+                    readonly=True)
+                if r.get("can_match", True):
+                    return True
+            except Exception:   # noqa: BLE001 — unreachable owner: the
+                return True     # skip heuristic must stay conservative
+        return False
 
     def doc_visible(self, index: str, shard: int, doc_id: str):
         """Non-realtime GET visibility against the OWNING copy's searchable
@@ -362,7 +402,16 @@ class ClusterRestService:
         from ..rest.api import RestAPI
         self.node = node
         self.indices = IndicesService(data_path)
-        self.api = RestAPI(self.indices)
+        self.api = RestAPI(self.indices, node_name=node.node_id)
+        # the front door (handle()) authenticates; internal dispatches
+        # into the local api are then trusted
+        self.api.enforce_security = False
+        self.api.adaptive_selection_provider = \
+            node.adaptive_selection_stats
+        # the local api's fabricated node id must BE this cluster node's
+        # id: /_nodes responses feed allocation filters (include._id) and
+        # test-captured $node_id round-trips into routing
+        self.api.node_id = node.node_id
         # relative repo locations resolve to ONE shared directory across
         # the cluster (the reference's path.repo): owners upload shard
         # blobs where the master writes metadata. data_path is
@@ -516,10 +565,15 @@ class ClusterRestService:
     # request entry
     # ------------------------------------------------------------------
 
-    def handle(self, method: str, path: str, query: str, body: bytes
-               ) -> Tuple[int, str, bytes]:
+    def handle(self, method: str, path: str, query: str, body: bytes,
+               headers: Optional[dict] = None) -> Tuple[int, str, bytes]:
         from ..rest.api import JSON_CT, _error_payload
         try:
+            if self.api.security.enabled:
+                # authenticate at the front door; forwarded/replicated
+                # internal hops stay inside the trusted transport
+                self.api._principal_tls.value = \
+                    self.api.security.authenticate(headers)
             return self._dispatch(method, path, query or "", body or b"")
         except RemoteTransportError as e:
             status, payload = _error_payload(_remote_error(e))
@@ -536,12 +590,20 @@ class ClusterRestService:
         if path == "/_cluster/state" or path.startswith("/_cluster/state"):
             return self._cluster_state(method, path, query, body)
         if path.startswith("/_cluster/allocation/explain"):
-            return self._alloc_explain(body)
+            return self._alloc_explain(query, body)
         if path.startswith("/_cluster/reroute") and method == "POST":
-            return self._reroute(query)
+            return self._reroute(query, body)
         if path == "/_tasks" or path.startswith("/_tasks/") or \
                 path.startswith("/_tasks?"):
             return self._tasks_route(method, path, query, body)
+        if method == "GET" and segs and (
+                segs[-1] == "_stats" or
+                (len(segs) >= 2 and segs[-2] == "_stats") or
+                (segs[0] == "_stats")):
+            return self._indices_stats(method, path, query, body)
+        if method == "GET" and len(segs) >= 2 and segs[0] == "_cat" \
+                and segs[1] == "segments":
+            return self._cat_segments(method, path, query, body)
         if segs and segs[-1].split("?")[0] == "_mtermvectors":
             return self._mtermvectors(method, path, query, body)
         if segs and segs[0] == "_snapshot":
@@ -914,6 +976,226 @@ class ClusterRestService:
                 pass
 
     # ------------------------------------------------------------------
+    # cluster-wide shard stats (owner side + front merge)
+    # ------------------------------------------------------------------
+
+    def h_stats_shards(self, src, payload) -> dict:
+        """Owner side: engine-level stats of THIS node's primary copies of
+        the asked shards (reference: the per-shard halves of
+        ``TransportIndicesStatsAction`` / ``IndicesService.stats``)."""
+        index = payload["index"]
+        out = {}
+        svc = self.indices.indices.get(index)
+        for sid in payload.get("shards", []):
+            sid = int(sid)
+            g = self.node.primaries.get((index, sid))
+            engine = g.engine if g is not None else (
+                svc.shards[sid] if svc is not None
+                and sid < len(svc.shards) else None)
+            if engine is None:
+                continue
+            store = 0
+            for root, _dirs, files in os.walk(engine.path):
+                for f in files:
+                    try:
+                        store += os.path.getsize(os.path.join(root, f))
+                    except OSError:
+                        pass
+            segs = engine.searchable_segments()
+            est = getattr(engine, "stats", {}) or {}
+            # fielddata bytes of THIS engine's segments for fields the
+            # owner's query path marked loaded (global-ordinals terms,
+            # field sorts — mapper.fielddata_loaded)
+            fd_bytes = 0
+            loaded = getattr(svc.mapper, "fielddata_loaded", set()) \
+                if svc is not None else set()
+            for seg in segs:
+                for fname, f in seg.keyword_fields.items():
+                    if fname in loaded:
+                        fd_bytes += int(
+                            f.docs_host.nbytes + f.dv_ords_host.nbytes +
+                            f.dv_docs_host.nbytes)
+                for fname, f in seg.numeric_fields.items():
+                    if fname in loaded:
+                        fd_bytes += int(f.vals_host.nbytes +
+                                        f.docs_host.nbytes)
+            out[str(sid)] = {
+                "fielddata": fd_bytes,
+                "docs": engine.doc_count,
+                "deleted": engine.deleted_count,
+                "store": store,
+                "tl_ops": engine.translog.total_operations(),
+                "tl_size": engine.translog.size_in_bytes(),
+                "get_total": int(est.get("get_total", 0)),
+                "index_total": int(est.get("index_total", 0)),
+                "delete_total": int(est.get("delete_total", 0)),
+                "segments": [
+                    {"seg_id": s.seg_id,
+                     "live": int(s.live.sum()),
+                     "deleted": int((~s.live).sum())}
+                    for s in segs],
+            }
+        return out
+
+    def _remote_shard_stats(self, names) -> Dict[str, Dict[str, dict]]:
+        """index → shard-id → owner stats for every shard primaried on
+        ANOTHER node (front-local shards are already in the local stats)."""
+        st = self.node.applied_state
+        routing = (st.data.get("routing", {}) if st else {})
+        out: Dict[str, Dict[str, dict]] = {}
+        for n in names:
+            table = routing.get(n)
+            if not table:
+                continue
+            by_owner: Dict[str, list] = {}
+            for sid, e in table.items():
+                if e["primary"] != self.node.node_id and \
+                        self.node.node_id not in e.get("replicas", ()):
+                    # front holds NO copy: fetch from the primary owner
+                    # (a local replica engine already carries the docs —
+                    # fetching again would double-count)
+                    by_owner.setdefault(e["primary"], []).append(sid)
+            got: Dict[str, dict] = {}
+            for owner, sids in sorted(by_owner.items()):
+                try:
+                    r = self.node.rpc(owner, "stats:shards",
+                                      {"index": n, "shards": sids},
+                                      timeout=10.0)
+                except Exception:   # noqa: BLE001 — a dead owner's shard
+                    continue        # stats degrade to the local zeros
+                got.update(r or {})
+            if got:
+                out[n] = got
+        return out
+
+    def _indices_stats(self, method, path, query, body):
+        """Serve the local stats rendering, then add the engine-resident
+        sections (docs/store/translog/segments) of remote-owned primary
+        shards — the front's local engines for those shards are empty."""
+        status, ct, out = self._local(method, path, query, body)
+        if status != 200:
+            return status, ct, out
+        try:
+            doc = json.loads(out)
+        except ValueError:
+            return status, ct, out
+        indices = doc.get("indices")
+        if not isinstance(indices, dict):
+            return status, ct, out
+        remote = self._remote_shard_stats(list(indices))
+        if not remote:
+            return status, ct, out
+
+        def bump(section: dict, key: str, delta: int) -> None:
+            if isinstance(section, dict) and key in section:
+                section[key] = section[key] + delta
+
+        params = _parse_query(query)
+        include_unloaded = params.get("include_unloaded_segments") \
+            in ("true", "")
+        for n, shards in remote.items():
+            entry = indices.get(n, {})
+            svc = self.indices.indices.get(n)
+            closed = svc is not None and svc.closed
+            if closed and not include_unloaded:
+                continue             # closed: local zeros are correct
+            adds = {"docs": 0, "deleted": 0, "store": 0, "tl_ops": 0,
+                    "tl_size": 0, "seg_count": 0, "get_total": 0,
+                    "index_total": 0, "delete_total": 0, "fielddata": 0}
+            for _sid, s in shards.items():
+                adds["docs"] += s["docs"]
+                adds["deleted"] += s["deleted"]
+                adds["store"] += s["store"]
+                adds["tl_ops"] += s["tl_ops"]
+                adds["tl_size"] += s["tl_size"]
+                adds["seg_count"] += len(s["segments"])
+                adds["get_total"] += s.get("get_total", 0)
+                adds["index_total"] += s.get("index_total", 0)
+                adds["delete_total"] += s.get("delete_total", 0)
+                adds["fielddata"] += s.get("fielddata", 0)
+            targets = [entry.get("primaries"), entry.get("total"),
+                       (doc.get("_all") or {}).get("primaries"),
+                       (doc.get("_all") or {}).get("total")]
+            for t in targets:
+                if not isinstance(t, dict):
+                    continue
+                # a closed index reports only unloaded segments (the local
+                # decorate zeroed translog and the engines are closed)
+                bump(t.get("segments", {}), "count", adds["seg_count"])
+                if closed:
+                    continue
+                bump(t.get("docs", {}), "count", adds["docs"])
+                bump(t.get("docs", {}), "deleted", adds["deleted"])
+                bump(t.get("store", {}), "size_in_bytes", adds["store"])
+                bump(t.get("store", {}), "total_data_set_size_in_bytes",
+                     adds["store"])
+                tl = t.get("translog", {})
+                bump(tl, "operations", adds["tl_ops"])
+                bump(tl, "size_in_bytes", adds["tl_size"])
+                bump(tl, "uncommitted_operations", adds["tl_ops"])
+                bump(tl, "uncommitted_size_in_bytes", adds["tl_size"])
+                bump(t.get("get", {}), "total", adds["get_total"])
+                bump(t.get("fielddata", {}), "memory_size_in_bytes",
+                     adds["fielddata"])
+                ix = t.get("indexing", {})
+                bump(ix, "index_total", adds["index_total"])
+                bump(ix, "delete_total", adds["delete_total"])
+        from ..rest.api import JSON_CT
+        return 200, JSON_CT, json.dumps(doc).encode()
+
+    def _cat_segments(self, method, path, query, body):
+        """Cluster cat segments: the local rows cover front-primaried
+        shards; remote-owned shards' segment lists come over
+        ``stats:shards`` and render in the same table."""
+        from urllib.parse import unquote
+        segs = [s for s in path.split("/") if s]
+        index_expr = unquote(segs[2]) if len(segs) >= 3 else None
+        st = self.node.applied_state
+        routing = (st.data.get("routing", {}) if st else {})
+        with self.lock:
+            try:
+                names = sorted(self.api.indices.resolve(index_expr)) \
+                    if index_expr else sorted(self.api.indices.indices)
+            except _errors.ElasticsearchError:
+                return self._local(method, path, query, body)
+        if not any(n in routing for n in names):
+            return self._local(method, path, query, body)
+        params = _parse_query(query)
+        rows = []
+        remote = self._remote_shard_stats(names)
+        for n in names:
+            svc = self.indices.indices.get(n)
+            if svc is None:
+                continue
+            if svc.closed:
+                raise _errors.IndexClosedError(f"closed index [{n}]")
+            table = routing.get(n) or {}
+            for sid in range(svc.num_shards):
+                owner = (table.get(str(sid)) or {}).get(
+                    "primary", self.node.node_id)
+                if owner == self.node.node_id:
+                    engine = svc.shards[sid]
+                    seg_list = [
+                        {"seg_id": s.seg_id, "live": int(s.live.sum()),
+                         "deleted": int((~s.live).sum())}
+                        for s in engine.searchable_segments()]
+                else:
+                    seg_list = (remote.get(n, {}).get(str(sid), {})
+                                .get("segments", []))
+                for gi, s in enumerate(seg_list):
+                    rows.append(self.api.cat_segment_row(
+                        n, sid, owner[:4], s["seg_id"], gi, s["live"],
+                        s["deleted"]))
+        with self.lock:
+            text = self.api.cat_segments_table(rows, params)
+        # mirror RestAPI.handle's payload rendering (str → text/plain,
+        # list → JSON for format=json)
+        if isinstance(text, (dict, list)):
+            from ..rest.api import JSON_CT
+            return 200, JSON_CT, json.dumps(text).encode()
+        return 200, "text/plain; charset=UTF-8", str(text).encode()
+
+    # ------------------------------------------------------------------
     # forwarding / broadcast
     # ------------------------------------------------------------------
 
@@ -1109,8 +1391,7 @@ class ClusterRestService:
             # the index from the path
             ids = spec.get("ids")
             if ids is None:
-                qp = dict(p.split("=", 1)
-                          for p in (query or "").split("&") if "=" in p)
+                qp = _parse_query(query)
                 from urllib.parse import unquote
                 raw_ids = qp.get("ids")
                 ids = [unquote(x) for x in raw_ids.split(",")] \
@@ -1230,8 +1511,7 @@ class ClusterRestService:
         (levels, per-index sections, closed-index semantics); the
         cluster-wide numbers and the wait_* semantics resolve here."""
         from ..common.settings import parse_time_millis
-        params = dict(p.split("=", 1) for p in (query or "").split("&")
-                      if "=" in p)
+        params = _parse_query(query)
         want_status = params.get("wait_for_status")
         want_nodes = params.get("wait_for_nodes")
         want_active = params.get("wait_for_active_shards")
@@ -1260,17 +1540,26 @@ class ClusterRestService:
             # scope shard counting to the indices the request selected
             # (level/index-pattern health) — the local doc's indices
             # section names them; absent section = whole cluster
-            segs = [s for s in path.split("/") if s]
+            from urllib.parse import unquote
+            segs = [unquote(s) for s in path.split("/") if s]
             selected = None
             if len(segs) >= 3:                    # /_cluster/health/{idx}
                 try:
                     with self.lock:
                         selected = set(self.indices.resolve(segs[2]))
-                    ew = params.get("expand_wildcards", "open")
+                    # health defaults to lenient open+closed expansion
+                    # (RestClusterHealthAction: lenientExpandHidden) —
+                    # 7.2+ closed indices are replicated and count
+                    ew = params.get("expand_wildcards", "open,closed")
                     with self.lock:
                         closed = {n for n in selected
                                   if self.indices.indices[n].closed}
-                    if "all" not in ew:
+                    # expand_wildcards filters WILDCARD expansions only;
+                    # a concrete closed index name is always selected
+                    # (the reference's IndicesOptions semantics)
+                    is_pattern = any(c in segs[2] for c in "*?") or \
+                        segs[2] in ("_all", "")
+                    if is_pattern and "all" not in ew:
                         if "closed" not in ew:
                             selected -= closed
                         if "open" not in ew and ew:
@@ -1332,7 +1621,7 @@ class ClusterRestService:
                         status = "yellow"
         return status, active, unassigned
 
-    def _alloc_explain(self, body: bytes):
+    def _alloc_explain(self, query: str, body: bytes):
         """GET /_cluster/allocation/explain — per-node decider verdicts
         (``ClusterAllocationExplainAction``)."""
         from ..cluster.allocation import AllocationContext, explain
@@ -1347,12 +1636,25 @@ class ClusterRestService:
         except ValueError:
             pass
         index, sid = spec.get("index"), spec.get("shard")
+        primary = bool(spec.get("primary", True))
+        force_unassigned = False
+        live = sorted(node.live_nodes())
         if index is None:
-            # default: the first unassigned shard, like the reference
+            # default: the first unassigned copy — a primary-less shard,
+            # or a shard whose replica count is below the configured want
+            # (the reference explains a random unassigned shard)
             for iname, table in sorted(routing.items()):
-                for sid_s, entry in sorted(table.items()):
+                want = int((st.metadata["indices"].get(iname) or {})
+                           .get("num_replicas", 0))
+                for sid_s, entry in sorted(
+                        table.items(), key=lambda kv: int(kv[0])):
                     if not entry.get("primary"):
-                        index, sid = iname, int(sid_s)
+                        index, sid, primary = iname, int(sid_s), True
+                        force_unassigned = True
+                        break
+                    if len(entry.get("replicas", ())) < want:
+                        index, sid, primary = iname, int(sid_s), False
+                        force_unassigned = True
                         break
                 if index is not None:
                     break
@@ -1360,32 +1662,144 @@ class ClusterRestService:
             raise _errors.IllegalArgumentError(
                 "unable to find any unassigned shards to explain "
                 "(pass index and shard)")
-        live = sorted(node.live_nodes())
         ctx = AllocationContext(
             live, routing, st.metadata["indices"],
             node_attrs=node.node_attrs,
             disk_used=dict(getattr(node, "_disk_used", {})))
-        doc = explain(index, int(sid or 0), ctx)
+        doc = explain(index, int(sid or 0), ctx, primary=primary,
+                      force_unassigned=force_unassigned)
+        if "include_disk_info=true" in (query or ""):
+            doc["cluster_info"] = {
+                "nodes": {n: {
+                    "node_name": n,
+                    "least_available": {"path": "/", "total_bytes": 0,
+                                        "used_bytes": 0,
+                                        "free_bytes": 0},
+                    "most_available": {"path": "/", "total_bytes": 0,
+                                       "used_bytes": 0, "free_bytes": 0},
+                } for n in live},
+            }
         return 200, "application/json", json.dumps(doc).encode()
 
-    def _reroute(self, query: str):
-        """POST /_cluster/reroute[?retry_failed=true] — clears max-retry
-        counters and triggers an allocation round on the master."""
-        retry = "retry_failed=true" in (query or "")
+    def _reroute(self, query: str, body: bytes = b""):
+        """POST /_cluster/reroute — explicit commands (explained under
+        ``explain``/``dry_run``), retry counter clearing, and a triggered
+        allocation round on the master
+        (``TransportClusterRerouteAction`` + ``AllocationCommands``)."""
+        params = _parse_query(query)
+        retry = params.get("retry_failed") in ("true", "")
+        explain = params.get("explain") in ("true", "")
+        dry_run = params.get("dry_run") in ("true", "")
         node = self.node
+        spec = {}
+        try:
+            spec = json.loads(body or b"{}") or {}
+        except ValueError:
+            pass
+        explanations = self._reroute_commands(
+            spec.get("commands") or [], explain, dry_run)
 
-        leader = node.node_loop.sync(lambda: node.coordinator.known_leader)
-        if leader == node.node_id:
-            out = node._h_alloc_reroute(None, {"retry_failed": retry})
-        elif leader is not None:
-            # single long-timeout RPC, no retry: a reroute is not
-            # idempotent-cheap (each execution re-clears counters and
-            # queues an allocation round)
-            out = node.rpc(leader, "alloc:reroute",
-                           {"retry_failed": retry}, timeout=20.0)
-        else:
-            raise _errors.ElasticsearchError("no known master")
+        if not dry_run:
+            leader = node.node_loop.sync(
+                lambda: node.coordinator.known_leader)
+            if leader == node.node_id:
+                node._h_alloc_reroute(None, {"retry_failed": retry})
+            elif leader is not None:
+                # single long-timeout RPC, no retry: a reroute is not
+                # idempotent-cheap (each execution re-clears counters
+                # and queues an allocation round)
+                node.rpc(leader, "alloc:reroute",
+                         {"retry_failed": retry}, timeout=20.0)
+            else:
+                raise _errors.ElasticsearchError("no known master")
+        out: Dict[str, Any] = {"acknowledged": True}
+        # state sections by metric (the reference returns the resulting
+        # cluster state filtered by ?metric=, default excludes metadata)
+        metric = params.get("metric")
+        st = node.applied_state
+        state: Dict[str, Any] = {
+            "cluster_uuid": "_na_", "version": st.version if st else 0}
+        wanted = {m.strip() for m in metric.split(",")} if metric else set()
+        if "metadata" in wanted or "_all" in wanted:
+            with self.lock:
+                state["metadata"] = {"indices": {
+                    n: {"state": "close" if svc.closed else "open"}
+                    for n, svc in self.indices.indices.items()}}
+        if "nodes" in wanted or "_all" in wanted:
+            state["nodes"] = {
+                n: {"name": n} for n in sorted(st.nodes)} if st else {}
+        out["state"] = state
+        if explain:
+            out["explanations"] = explanations
         return 200, "application/json", json.dumps(out).encode()
+
+    def _reroute_commands(self, commands, explain: bool,
+                          dry_run: bool) -> list:
+        """Validate explicit allocation commands; an explanation entry per
+        command mirrors ``AllocationCommand`` naming. Non-dry-run illegal
+        commands raise (the reference 400s)."""
+        node = self.node
+        st = node.applied_state
+        routing = (st.data.get("routing", {}) if st else {})
+        out = []
+        for cmd in commands:
+            if not isinstance(cmd, dict) or len(cmd) != 1:
+                raise _errors.IllegalArgumentError(
+                    f"malformed reroute command {cmd!r}")
+            (kind, args), = cmd.items()
+            args = args or {}
+            index = args.get("index")
+            sid = str(args.get("shard", 0))
+            target = args.get("from_node") if kind == "move" \
+                else args.get("node")
+            entry = (routing.get(index) or {}).get(sid)
+            decider = f"{kind}_allocation_command"
+            decisions = []
+            if kind in ("cancel", "move"):
+                on_node = entry is not None and (
+                    entry.get("primary") == target or
+                    target in entry.get("replicas", ()))
+                if entry is None or not on_node:
+                    decisions.append({
+                        "decider": decider, "decision": "NO",
+                        "explanation": (
+                            f"can't {kind} {index} [{sid}]: failed to "
+                            f"find shard copy on node [{target}]")})
+                else:
+                    decisions.append({
+                        "decider": decider, "decision": "YES",
+                        "explanation": f"shard copy found on [{target}]"})
+            elif kind in ("allocate_replica", "allocate_stale_primary",
+                          "allocate_empty_primary"):
+                if entry is None:
+                    decisions.append({
+                        "decider": decider, "decision": "NO",
+                        "explanation": f"no such shard [{index}][{sid}]"})
+                else:
+                    decisions.append({
+                        "decider": decider, "decision": "YES",
+                        "explanation": "allocation is permitted"})
+            else:
+                raise _errors.IllegalArgumentError(
+                    f"unknown reroute command [{kind}]")
+            params_out = {"index": index, "shard": int(args.get("shard", 0)),
+                          "node": target}
+            if kind in ("cancel", "allocate_stale_primary",
+                        "allocate_empty_primary"):
+                params_out["allow_primary"] = bool(
+                    args.get("allow_primary", False))
+            if kind == "move":
+                params_out = {"index": index,
+                              "shard": int(args.get("shard", 0)),
+                              "from_node": args.get("from_node"),
+                              "to_node": args.get("to_node")}
+            bad = any(d["decision"] == "NO" for d in decisions)
+            if bad and not dry_run:
+                raise _errors.IllegalArgumentError(
+                    decisions[0]["explanation"])
+            out.append({"command": kind, "parameters": params_out,
+                        "decisions": decisions})
+        return out
 
     def _cluster_state(self, method, path, query, body):
         """Serve the LOCAL api's full cluster-state rendering (metric

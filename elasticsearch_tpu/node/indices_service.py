@@ -11,6 +11,7 @@ grouping). Single-node scope here; the distributed data plane in
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import re
 import shutil
@@ -87,6 +88,19 @@ class IndexService:
                 "index.mapping.nested_objects.limit", 10000))
         except (TypeError, ValueError):
             pass
+        # index sorting (reference: IndexSortConfig — segments hold docs
+        # ordered by these fields; forbidden with nested docs)
+        sort_fields = flat.get("index.sort.field")
+        index_sort = None
+        if sort_fields:
+            if not isinstance(sort_fields, list):
+                sort_fields = [sort_fields]
+            sort_orders = flat.get("index.sort.order") or []
+            if not isinstance(sort_orders, list):
+                sort_orders = [sort_orders]
+            index_sort = [
+                (f, (sort_orders[i] if i < len(sort_orders) else "asc"))
+                for i, f in enumerate(sort_fields)]
         self.shards: List[Engine] = []
         for i in range(self.num_shards):
             shard_path = os.path.join(path, str(i))
@@ -96,7 +110,8 @@ class IndexService:
                 translog_durability=flat.get("index.translog.durability",
                                              "request"),
                 gc_deletes_seconds=_parse_time_seconds(
-                    flat.get("index.gc_deletes", "60s"))))
+                    flat.get("index.gc_deletes", "60s")),
+                index_sort=index_sort))
         self.aliases: Dict[str, dict] = {}
         self.closed = False
         # search-phase counters (+ per-group when a search carries a
@@ -104,8 +119,11 @@ class IndexService:
         self.search_stats: Dict[str, object] = {
             "query_total": 0, "fetch_total": 0, "scroll_total": 0,
             "suggest_total": 0, "groups": {}}
-        # shard request cache counters (no actual cache behind them yet:
-        # every cacheable request counts as a miss, like a cold cache)
+        # shard request cache (reference: IndicesRequestCache.java):
+        # size==0 results keyed on (segment signature, body); the
+        # signature bakes in liveness so refresh/merge/delete invalidate
+        from collections import OrderedDict
+        self.request_cache: "OrderedDict" = OrderedDict()
         self.request_cache_stats = {"hit_count": 0, "miss_count": 0}
         # serving planes for the tiered TPU kernel (search/plane_route.py);
         # lazily built per text field, invalidated by segment-list changes
@@ -231,15 +249,65 @@ class IndexService:
             plane_provider=lambda segs, field:
                 self.plane_cache.plane_for(segs, self.mapper, field))
 
-    def search(self, body: Optional[dict] = None) -> ShardSearchResult:
+    #: request-cache entry cap per index (reference sizes by bytes —
+    #: indices.requests.cache.size 1%; entries are simpler and safe here)
+    REQUEST_CACHE_MAX = 256
+
+    def _request_cache_key(self, body: dict,
+                           explicit: Optional[bool]) -> Optional[tuple]:
+        """Cache key when this request is cacheable, else None
+        (reference: ``IndicesRequestCache.java`` — size==0 requests by
+        default, opt-in/out via ?request_cache, never non-deterministic
+        bodies; the segment-list+liveness signature IS the invalidation,
+        like the cache's reader-key)."""
+        if explicit is False:
+            return None
+        if str(self.settings.get("index.requests.cache.enable", "true")
+               ).lower() == "false":
+            return None
+        if int(body.get("size", 10)) != 0:
+            # only size==0 shapes are safe to cache: the coordinator
+            # mutates hit objects in place (sort-cursor lifting, boosts),
+            # so a cached hit would be re-mutated on every cache hit —
+            # the reference likewise only caches size==0 even under
+            # ?request_cache=true
+            return None
+        try:
+            blob = json.dumps(body, sort_keys=True)
+        except (TypeError, ValueError):
+            return None
+        if "now" in blob or "random_score" in blob or \
+                body.get("profile"):
+            return None
+        sig = tuple((seg.seg_id, seg.n_docs, int(seg.live.sum()))
+                    for sh in self.shards
+                    for seg in sh.searchable_segments())
+        return (sig, blob)
+
+    def search(self, body: Optional[dict] = None,
+               request_cache: Optional[bool] = None) -> ShardSearchResult:
         self._check_open()
         if self.cluster_hooks is not None:
             r = self.cluster_hooks.search(self.name, body or {})
             if r is not None:
                 return r
+        key = self._request_cache_key(body or {}, request_cache)
+        if key is not None:
+            hit = self.request_cache.get(key)
+            if hit is not None:
+                self.request_cache.move_to_end(key)
+                self.request_cache_stats["hit_count"] += 1
+                return hit
         if self.num_shards > 1:
-            return self.dist_searcher().search(body or {})
-        return self.searcher().search(body or {})
+            r = self.dist_searcher().search(body or {})
+        else:
+            r = self.searcher().search(body or {})
+        if key is not None:
+            self.request_cache_stats["miss_count"] += 1
+            self.request_cache[key] = r
+            while len(self.request_cache) > self.REQUEST_CACHE_MAX:
+                self.request_cache.popitem(last=False)
+        return r
 
     def count(self, body: Optional[dict] = None) -> int:
         self._check_open()
@@ -259,6 +327,17 @@ class IndexService:
             return
         for s in self.shards:
             s.refresh()
+
+    def refresh_shard(self, doc_id: str,
+                      routing: Optional[str] = None) -> None:
+        """Refresh only the shard owning ``doc_id`` — the scope of a doc
+        op's ``?refresh=true`` (reference: ``TransportShardBulkAction``
+        refreshes the affected shard, never the whole index; other
+        shards' pending NRT deletes must stay invisible)."""
+        if self.cluster_hooks is not None and \
+                self.cluster_hooks.refresh(self.name):
+            return
+        self.shard_for_doc(doc_id, routing).refresh()
 
     def flush(self) -> None:
         for s in self.shards:

@@ -45,6 +45,18 @@ def _json_body(body: bytes) -> dict:
         raise ParsingError(f"request body is not valid JSON: {e}")
 
 
+def _script_service():
+    """The process-wide ScriptService (live stats for nodes stats)."""
+    from ..script.service import DEFAULT
+    return DEFAULT
+
+
+def _indexing_pressure():
+    """The process-wide IndexingPressure (live stats + bulk gate)."""
+    from ..common.indexing_pressure import DEFAULT
+    return DEFAULT
+
+
 def _error_payload(e: Exception) -> Tuple[int, dict]:
     if isinstance(e, ElasticsearchError):
         status = getattr(e, "status", 500)
@@ -64,6 +76,10 @@ def _error_payload(e: Exception) -> Tuple[int, dict]:
     caused_by = getattr(e, "caused_by", None)
     if caused_by:
         err["caused_by"] = caused_by
+    extra_header = (e.to_dict().get("error", {}).get("header")
+                    if isinstance(e, ElasticsearchError) else None)
+    if extra_header:
+        err["header"] = extra_header      # 401 WWW-Authenticate etc.
     return status, {"error": err, "status": status}
 
 
@@ -161,6 +177,17 @@ class RestAPI:
         self.cluster_name = cluster_name
         self.node_name = node_name
         self.node_id = uuid.uuid4().hex[:20]
+        # security (x-pack analog): off by default — conformance runs
+        # unauthenticated; the node binary enables it via settings
+        from ..security import SecurityService
+        #: cluster seam: () -> adaptive_selection stats (ARS EWMAs live
+        #: on the ClusterNode; single-node has no peers to rank)
+        self.adaptive_selection_provider = None
+        self.security = SecurityService(enabled=False)
+        self.enforce_security = True
+        # per-REQUEST principal: requests run on a worker pool, so the
+        # authenticated identity must be thread-local
+        self._principal_tls = threading.local()
         self.start_time = time.time()
         self.voting_exclusions: List[dict] = []
         self.component_templates: Dict[str, dict] = {}
@@ -213,6 +240,10 @@ class RestAPI:
         add("PUT", "/_cluster/settings", self.h_cluster_put_settings)
         add("GET", "/_nodes", self.h_nodes)
         add("GET", "/_remote/info", self.h_remote_info)
+        add("PUT,POST", "/_security/api_key", self.h_create_api_key)
+        add("DELETE", "/_security/api_key", self.h_invalidate_api_key)
+        add("GET", "/_security/api_key", self.h_get_api_keys)
+        add("GET", "/_security/_authenticate", self.h_authenticate)
         add("POST", "/_nodes/reload_secure_settings",
             self.h_reload_secure_settings)
         add("POST", "/_nodes/{node_id}/reload_secure_settings",
@@ -412,7 +443,19 @@ class RestAPI:
         add("GET,HEAD", "/{index}", self.h_get_index)
 
     def handle(self, method: str, path: str, query: str,
-               body: bytes) -> Tuple[int, str, bytes]:
+               body: bytes,
+               headers: Optional[dict] = None) -> Tuple[int, str, bytes]:
+        if self.security.enabled and self.enforce_security:
+            # every route requires credentials when security is on
+            # (reference: SecurityRestFilter wraps the whole dispatcher);
+            # the cluster front enforces at ITS door and disables this
+            # inner check for trusted internal dispatches
+            try:
+                self._principal_tls.value = \
+                    self.security.authenticate(headers)
+            except Exception as e:   # noqa: BLE001 — 401 as ES error body
+                status, payload = _error_payload(e)
+                return status, JSON_CT, json.dumps(payload).encode()
         params = {k: v[-1] for k, v in
                   parse_qs(query, keep_blank_values=True).items()}
         if query:
@@ -1269,9 +1312,6 @@ class RestAPI:
                  for sh in svc.shards])
         if params.get("level") == "indices":
             indices_stats["indices"] = per_index
-        zero_pressure = {"combined_coordinating_and_primary_in_bytes": 0,
-                         "coordinating_in_bytes": 0, "primary_in_bytes": 0,
-                         "replica_in_bytes": 0, "all_in_bytes": 0}
         sections = {
             "indices": indices_stats,
             "os": {"timestamp": int(time.time() * 1000),
@@ -1327,8 +1367,7 @@ class RestAPI:
             "http": {"current_open": 0, "total_opened": 0,
                      "clients": []},
             "breaker": self._breaker_stats(),
-            "script": {"compilations": 0, "cache_evictions": 0,
-                       "compilation_limit_triggered": 0},
+            "script": _script_service().stats_doc(),
             "discovery": {
                 "cluster_state_queue": {"total": 0, "pending": 0,
                                         "committed": 0},
@@ -1342,15 +1381,13 @@ class RestAPI:
             "ingest": {"total": {"count": 0, "time_in_millis": 0,
                                  "current": 0, "failed": 0},
                        "pipelines": {}},
-            "adaptive_selection": {},
+            "adaptive_selection": (self.adaptive_selection_provider()
+                                   if self.adaptive_selection_provider
+                                   else {}),
             "script_cache": {"sum": {"compilations": 0,
                                      "cache_evictions": 0,
                                      "compilation_limit_triggered": 0}},
-            "indexing_pressure": {"memory": {
-                "current": dict(zero_pressure),
-                "total": dict(zero_pressure, coordinating_rejections=0,
-                              primary_rejections=0, replica_rejections=0),
-                "limit_in_bytes": 53687091}},
+            "indexing_pressure": _indexing_pressure().stats_doc(),
         }
         node = {"timestamp": int(time.time() * 1000),
                 "name": self.node_name,
@@ -1853,22 +1890,18 @@ class RestAPI:
         return self._cat_table(rows, ["id", "type"],
                                _flag(params, "v"), params)
 
-    def h_cat_segments(self, params, body, index=None):
-        names = sorted(self.indices.resolve(index)) if index else \
-            sorted(self.indices.indices)
-        rows = []
-        for n in names:
-            svc = self.indices.indices[n]
-            if svc.closed:
-                from ..common.errors import IndexClosedError
-                raise IndexClosedError(f"closed index [{n}]")
-            for sid, engine in enumerate(svc.shards):
-                for gi, seg in enumerate(engine.searchable_segments()):
-                    rows.append([
-                        n, sid, "p", "127.0.0.1", self.node_id[:4],
-                        seg.seg_id, gi, int(seg.live.sum()),
-                        int((~seg.live).sum()),
-                        "1kb", 0, "true", "true", "9.0.0", "false"])
+    @staticmethod
+    def cat_segment_row(index: str, sid: int, owner_short: str,
+                        seg_id: str, generation: int, live: int,
+                        deleted: int) -> list:
+        """One cat-segments row (shared by the single-node handler and
+        the cluster front's owner-gathered rendering)."""
+        return [index, sid, "p", "127.0.0.1", owner_short, seg_id,
+                generation, live, deleted,
+                "1kb", 0, "true", "true", "9.0.0", "false"]
+
+    def cat_segments_table(self, rows, params):
+        """Render cat-segments rows with the canonical column spec."""
         return self._cat_table(
             rows,
             ["index", "shard", "prirep", "ip", "id", "segment",
@@ -1881,6 +1914,22 @@ class RestAPI:
                              "size", "size.memory", "committed",
                              "searchable", "version", "compound"],
             aliases={"i": "index", "s": "shard", "seg": "segment"})
+
+    def h_cat_segments(self, params, body, index=None):
+        names = sorted(self.indices.resolve(index)) if index else \
+            sorted(self.indices.indices)
+        rows = []
+        for n in names:
+            svc = self.indices.indices[n]
+            if svc.closed:
+                from ..common.errors import IndexClosedError
+                raise IndexClosedError(f"closed index [{n}]")
+            for sid, engine in enumerate(svc.shards):
+                for gi, seg in enumerate(engine.searchable_segments()):
+                    rows.append(self.cat_segment_row(
+                        n, sid, self.node_id[:4], seg.seg_id, gi,
+                        int(seg.live.sum()), int((~seg.live).sum())))
+        return self.cat_segments_table(rows, params)
 
     def h_cat_snapshots(self, params, body, repository=None):
         rows = []
@@ -2147,13 +2196,10 @@ class RestAPI:
         out = {}
         for name in names:
             svc = self.indices.indices[name]
-            idx_settings = {
-                "number_of_shards": str(svc.num_shards),
-                "number_of_replicas": str(svc.num_replicas),
-                "uuid": svc.uuid,
-                "creation_date": str(svc.creation_date),
-                "version": {"created": "8000099"},
-                "provided_name": name}
+            # full settings render (custom keys like index.priority
+            # included), same source as GET /{index}/_settings
+            idx_settings = self._nest_flat(
+                self._index_flat_settings(name)).get("index", {})
             if human:
                 import datetime as _dtm
                 idx_settings["creation_date_string"] = \
@@ -2309,6 +2355,43 @@ class RestAPI:
             shards += svc.num_shards
         return {"_shards": {"total": shards, "successful": shards,
                             "failed": 0}}
+
+    # -- security (x-pack ApiKeyService analog) -------------------------
+
+    def h_create_api_key(self, params, body):
+        b = _json_body(body)
+        name = b.get("name")
+        if not name:
+            raise IllegalArgumentError("api key name is required")
+        exp = b.get("expiration")
+        exp_ms = None
+        if exp:
+            from ..common.settings import parse_time_millis
+            exp_ms = int(parse_time_millis(exp))
+        out = self.security.create_key(name, expiration_ms=exp_ms)
+        return {"id": out["id"], "name": out["name"],
+                "api_key": out["api_key"], "encoded": out["encoded"]}
+
+    def h_invalidate_api_key(self, params, body):
+        b = _json_body(body)
+        ids = b.get("ids") or ([b["id"]] if b.get("id") else None)
+        name = b.get("name")
+        if not ids and not name:
+            raise IllegalArgumentError(
+                "One of [ids, name] must be specified")
+        return self.security.invalidate(ids=ids, name=name)
+
+    def h_get_api_keys(self, params, body):
+        return self.security.list_keys()
+
+    def h_authenticate(self, params, body):
+        if not self.security.enabled:
+            return {"username": "_anonymous", "roles": ["superuser"],
+                    "authentication_type": "anonymous"}
+        p = getattr(self._principal_tls, "value", None) or {}
+        return {"username": p.get("username"), "roles": ["superuser"],
+                "authentication_type": p.get("authentication_type"),
+                "api_key": p.get("api_key")}
 
     def h_remote_info(self, params, body):
         """GET /_remote/info — remote-cluster connections (none
@@ -2947,7 +3030,7 @@ class RestAPI:
             r = type(r)(**{**r.__dict__, "version": ext_version}) \
                 if hasattr(r, "__dict__") else r
         if params.get("refresh") in ("true", "wait_for", ""):
-            svc.refresh()
+            svc.refresh_shard(id, routing)
             resp = self._doc_response(index, r,
                                       "created" if r.created else "updated")
             # wait_for waits for a scheduled refresh rather than forcing
@@ -3062,7 +3145,7 @@ class RestAPI:
             shard.external_versions[id] = want
             r = svc.delete_doc(id, routing=params.get("routing"))
             if params.get("refresh") in ("true", "wait_for", ""):
-                svc.refresh()
+                svc.refresh_shard(id, params.get("routing"))
             resp = self._doc_response(index, r,
                                       "deleted" if r.found
                                       else "not_found")
@@ -3075,7 +3158,7 @@ class RestAPI:
                            if_primary_term=_int_or_none(
                                params.get("if_primary_term")))
         if params.get("refresh") in ("true", "wait_for", ""):
-            svc.refresh()
+            svc.refresh_shard(id, params.get("routing"))
         if not r.found:
             return 404, self._doc_response(index, r, "not_found")
         return self._doc_response(index, r, "deleted")
@@ -3175,11 +3258,26 @@ class RestAPI:
         if "script" in b:
             src = dict(existing.source or {})
             script = b["script"]
-            source = script.get("source") if isinstance(script, dict) \
-                else script
-            ctx_params = (script.get("params", {})
-                          if isinstance(script, dict) else {})
-            new_src = _apply_update_script(src, source, ctx_params)
+            if isinstance(script, dict):
+                source = self._resolve_script_source(script)
+                ctx_params = script.get("params", {})
+            else:
+                source, ctx_params = script, {}
+            ctx_extra = {"op": "index", "_id": id, "_index": index}
+            new_src = _apply_update_script(src, source, ctx_params,
+                                           ctx_extra=ctx_extra)
+            if ctx_extra.get("op") == "none":
+                noop = {"_index": index, "_id": id,
+                        "_version": existing.version, "result": "noop",
+                        "_shards": {"total": 0, "successful": 0,
+                                    "failed": 0},
+                        "_seq_no": existing.seq_no, "_primary_term": 1}
+                return finish(200, noop, src)
+            if ctx_extra.get("op") == "delete":
+                r = svc.delete_doc(id, routing=params.get("routing"))
+                return finish(200,
+                              self._doc_response(index, r, "deleted"),
+                              None)
             r = svc.index_doc(id, new_src, routing=params.get("routing"))
             return finish(200, self._doc_response(index, r, "updated"),
                           new_src)
@@ -3689,6 +3787,11 @@ class RestAPI:
         return source, index, doc_id, routing
 
     def h_bulk(self, params, body, index=None):
+        from ..common.indexing_pressure import DEFAULT as _pressure
+        with _pressure.coordinating(len(body), "bulk request"):
+            return self._bulk_inner(params, body, index)
+
+    def _bulk_inner(self, params, body, index=None):
         t0 = time.time()
         lines = body.split(b"\n")
         items = []
@@ -3871,6 +3974,47 @@ class RestAPI:
                 ih_out[name] = {"hits": r["hits"]}
             hit_out["inner_hits"] = ih_out
 
+    def _script_fields_for(self, sf: dict, h: ShardHit) -> dict:
+        """script_fields through the Painless-lite engine: per hit, each
+        script sees ``doc`` (source-backed doc values), ``params``, and
+        ``_source`` (reference: ``fetch/subphase/ScriptFieldsPhase``)."""
+        from ..script.painless_lite import DocAccessor
+        from ..script.service import DEFAULT as _scripts
+        source = h.source or {}
+
+        def lookup(field):
+            node: Any = source
+            for part in field.split("."):
+                node = node.get(part) if isinstance(node, dict) else None
+                if node is None:
+                    break
+            return node if isinstance(node, list) else (
+                [] if node is None else [node])
+        out = {}
+        for name, spec in sf.items():
+            script = (spec or {}).get("script") or {}
+            if isinstance(script, str):
+                script = {"source": script}
+            src_code = self._resolve_script_source(script)
+            env = {"doc": DocAccessor(lookup),
+                   "params": dict(script.get("params") or {},
+                                  _source=source),
+                   "_source": source}
+            v = _scripts.run(src_code, env)
+            out[name] = v if isinstance(v, list) else [v]
+        return out
+
+    def _resolve_script_source(self, script: dict) -> str:
+        """Inline ``source`` or stored-script ``id`` lookup (reference:
+        ``script/StoredScriptSource``)."""
+        if script.get("id"):
+            stored = self.stored_scripts.get(script["id"])
+            if stored is None:
+                raise ResourceNotFoundError(
+                    f"unable to find script [{script['id']}]")
+            return stored.get("source", "")
+        return script.get("source", "")
+
     def _hit_json(self, index_name: str, h: ShardHit,
                   flags: Optional[dict] = None,
                   n_sort: Optional[int] = None) -> dict:
@@ -3915,6 +4059,10 @@ class RestAPI:
                            else h.sort_values[:n_sort])
         if h.fields:
             out["fields"] = h.fields
+        sf = flags.get("script_fields")
+        if isinstance(sf, dict) and sf:
+            out.setdefault("fields", {})
+            out["fields"].update(self._script_fields_for(sf, h))
         if h.highlight:
             out["highlight"] = h.highlight
         if h.inner_hits:
@@ -3983,6 +4131,9 @@ class RestAPI:
         from ..search.dist_query import merge_sort_key
         from ..search.shard_search import normalize_sort
         t0 = time.time()
+        # ?request_cache= rides in on a private body key (params don't
+        # reach this layer), same pattern as _pre_filter_shard_size
+        request_cache_flag = search_body.pop("_request_cache", None)
         groups = search_body.get("stats")
         if record_stats:
             for _n in names:
@@ -4027,7 +4178,16 @@ class RestAPI:
                     nonmatch = []
                     for n in names:
                         svc = self.indices.indices[n]
-                        if not _shard_can_match(svc.searcher(), bounds):
+                        verdict = None
+                        if svc.cluster_hooks is not None:
+                            # remote-owned shards: each owner evaluates
+                            # over its own segments
+                            verdict = svc.cluster_hooks.can_match(
+                                n, [list(b) for b in bounds])
+                        if verdict is None:
+                            verdict = _shard_can_match(svc.searcher(),
+                                                       bounds)
+                        if not verdict:
                             nonmatch.append(n)
                     if len(nonmatch) == len(names):
                         nonmatch = nonmatch[1:]   # one shard must report
@@ -4102,7 +4262,8 @@ class RestAPI:
             elif sa is not None:
                 body_n = dict(window_body, search_after=sa)
             svc = self.indices.indices[n]
-            results.append((n, svc.search(body_n)))
+            results.append((n, svc.search(
+                body_n, request_cache=request_cache_flag)))
         total = sum(r.total for _, r in results)
         relation = "eq"
         if any(r.total_relation == "gte" for _, r in results):
@@ -4515,6 +4676,10 @@ class RestAPI:
         if scroll and params.get("request_cache") is not None:
             raise IllegalArgumentError(
                 "[request_cache] cannot be used in a scroll context")
+        if scroll and search_body.get("track_total_hits") is False:
+            raise IllegalArgumentError(
+                "disabling [track_total_hits] is not allowed in a "
+                "scroll context")
         collapse = search_body.get("collapse")
         if collapse:
             if scroll:
@@ -4753,14 +4918,9 @@ class RestAPI:
         self._rewrite_terms_lookup(search_body)
         self._validate_search(search_body, params, names,
                               scroll=bool(params.get("scroll")))
-        if params.get("request_cache") in ("true", ""):
-            # no cache yet — every cacheable request is a cold miss
-            # (counted pre-execution, so a request that later fails at
-            # execute time still registers; acceptable approximation)
-            for n in names:
-                svc = self.indices.indices.get(n)
-                if svc is not None:
-                    svc.request_cache_stats["miss_count"] += 1
+        if params.get("request_cache") is not None:
+            search_body["_request_cache"] = \
+                params["request_cache"] in ("true", "")
         if params.get("rest_total_hits_as_int") in ("true", "") and \
                 isinstance(search_body.get("track_total_hits"), int) and \
                 not isinstance(search_body.get("track_total_hits"), bool) \
@@ -5011,8 +5171,11 @@ class RestAPI:
             all_hits = [nh for nh in all_hits
                         if _slice_of(*nh) == sid_]
         sid = uuid.uuid4().hex
+        hit_flags = {k: search_body[k] for k in ("script_fields",)
+                     if k in search_body}
         self.scrolls[sid] = {"hits": all_hits, "pos": size, "size": size,
                              "total": len(all_hits),
+                             "flags": hit_flags,
                              "expiry": time.time() + 300}
         page = all_hits[:size]
         return {
@@ -5021,7 +5184,8 @@ class RestAPI:
                         "skipped": 0, "failed": 0},
             "hits": {"total": {"value": len(all_hits), "relation": "eq"},
                      "max_score": None,
-                     "hits": [self._hit_json(n, h) for n, h in page]}}
+                     "hits": [self._hit_json(n, h, hit_flags)
+                              for n, h in page]}}
 
     def h_scroll(self, params, body, scroll_id=None):
         b = _json_body(body) if body else {}
@@ -5043,7 +5207,8 @@ class RestAPI:
                         "failed": 0},
             "hits": {"total": {"value": ctx["total"], "relation": "eq"},
                      "max_score": None,
-                     "hits": [self._hit_json(n, h) for n, h in page]}}
+                     "hits": [self._hit_json(n, h, ctx.get("flags"))
+                              for n, h in page]}}
         if params.get("rest_total_hits_as_int") in ("true", ""):
             out["hits"]["total"] = ctx["total"]
         return out
@@ -6071,41 +6236,21 @@ def _deep_merge(base: dict, patch: dict) -> dict:
     return base
 
 
-_CTX_ASSIGN_RE = re.compile(
-    r"^\s*ctx\._source\.(\w+)\s*(\+?=)\s*(.+?)\s*;?\s*$")
-
-
-def _apply_update_script(src: dict, source: str, params: dict) -> dict:
-    """Painless-lite update scripts: statements of the form
-    ``ctx._source.field = <expr>`` / ``+=`` with expressions over
-    ``ctx._source.*`` and ``params.*`` (the full Painless engine is the
-    reference's ``modules/lang-painless``; this covers the common
-    counter/set idioms)."""
-    from ..utils.expressions import evaluate_expression
-
-    for stmt in source.split(";"):
-        stmt = stmt.strip()
-        if not stmt:
-            continue
-        m = _CTX_ASSIGN_RE.match(stmt + ("=" if "=" not in stmt else ""))
-        m = _CTX_ASSIGN_RE.match(stmt if stmt.endswith(";") else stmt + ";") \
-            or _CTX_ASSIGN_RE.match(stmt)
-        if m is None:
-            raise IllegalArgumentError(
-                f"unsupported update script statement [{stmt}]")
-        field, op, expr = m.group(1), m.group(2), m.group(3)
-        expr = re.sub(r"ctx\._source\.(\w+)", r"\1", expr)
-        env = {k: v for k, v in src.items()
-               if isinstance(v, (int, float))}
-        env.update({k: v for k, v in params.items()
-                    if isinstance(v, (int, float))})
-        if re.fullmatch(r"'[^']*'|\"[^\"]*\"", expr):
-            val: Any = expr[1:-1]
-        else:
-            val = evaluate_expression(expr, env)
-        if op == "+=":
-            val = src.get(field, 0) + val
-        src[field] = val
+def _apply_update_script(src: dict, source: str, params: dict,
+                         ctx_extra: Optional[dict] = None) -> dict:
+    """Update-context scripts through the sandboxed Painless-lite engine
+    (``script/painless_lite.py`` — statements, loops, method calls on
+    ``ctx._source`` values; the reference's ``modules/lang-painless``).
+    ``ctx_extra`` carries extra ctx fields (e.g. ``op``) whose mutations
+    the caller reads back."""
+    from ..script.service import DEFAULT as _scripts
+    ctx = {"_source": src}
+    if ctx_extra is not None:
+        ctx.update(ctx_extra)
+    _scripts.run_update(source, ctx, params)
+    if ctx_extra is not None:
+        for k in list(ctx_extra):
+            ctx_extra[k] = ctx.get(k)
     return src
 
 

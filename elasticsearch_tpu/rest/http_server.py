@@ -34,15 +34,21 @@ class HttpServer:
     (status, content_type, payload_bytes)."""
 
     def __init__(self, handler: Callable, host: str = "127.0.0.1",
-                 port: int = 9200):
+                 port: int = 9200, ssl_ctx=None,
+                 pass_headers: bool = False):
         self.handler = handler
         self.host = host
         self.port = port
+        self.ssl_ctx = ssl_ctx
+        #: hand parsed request headers to the handler as a 5th argument
+        #: (the security layer authenticates from Authorization)
+        self.pass_headers = pass_headers
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._serve_connection, self.host, self.port)
+            self._serve_connection, self.host, self.port,
+            ssl=self.ssl_ctx)
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -63,7 +69,7 @@ class HttpServer:
                 path, _, query = target.partition("?")
                 try:
                     status, ctype, payload = await self._dispatch(
-                        method, path, query, body)
+                        method, path, query, body, headers)
                 except HttpError as e:
                     status, ctype, payload = e.status, "application/json", \
                         json.dumps({"error": e.reason,
@@ -93,8 +99,11 @@ class HttpServer:
             except Exception:
                 pass
 
-    async def _dispatch(self, method, path, query, body):
-        result = self.handler(method, path, query, body)
+    async def _dispatch(self, method, path, query, body, headers=None):
+        if self.pass_headers:
+            result = self.handler(method, path, query, body, headers)
+        else:
+            result = self.handler(method, path, query, body)
         if asyncio.iscoroutine(result):
             result = await result
         return result

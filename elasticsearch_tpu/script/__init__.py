@@ -1,0 +1,17 @@
+"""Scripting: a sandboxed Painless-subset engine + the script service
+(compilation cache, rate limit, stats) behind every script context —
+script_score, script fields, update/ingest scripts, scripted_metric.
+
+Reference: ``modules/lang-painless/`` (Compiler.java — full Java-like
+language to JVM bytecode) and ``server/.../script/ScriptService.java``
+(contexts, caches, compilation rate limits). This engine interprets a
+C-style subset (statements, loops, method calls on values, doc-values and
+ctx/params/state access) — sandboxed by construction: the interpreter
+only ever touches plain Python values through an allowlisted method
+table, with an execution step budget."""
+
+from .painless_lite import (CompiledScript, PainlessError, compile_painless)
+from .service import ScriptService
+
+__all__ = ["CompiledScript", "PainlessError", "compile_painless",
+           "ScriptService"]

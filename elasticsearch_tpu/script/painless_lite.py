@@ -1,0 +1,1016 @@
+"""Painless-lite: tokenizer + recursive-descent parser + interpreter for
+the Java-like scripting subset the reference's common script idioms use.
+
+Reference: ``modules/lang-painless/src/main/java/org/elasticsearch/
+painless/Compiler.java`` (ANTLR grammar → JVM bytecode, 41k LoC). This is
+a re-design, not a port: an interpreter over immutable parse trees whose
+only effects are on plain Python values (lists/dicts/numbers/strings)
+reached through an allowlisted method table — no reflection surface, no
+attribute walks into Python internals, and a hard execution step budget
+(the reference sandboxes via its own classloader + API allowlist;
+``PainlessLookup`` is the analog of ``_METHODS`` below).
+
+Supported grammar (the idioms the reference's docs + test corpus lean on):
+
+  statements   if/else · for(;;) · for (x in expr) · while · break ·
+               continue · return · declarations (``def``/typed) ·
+               assignment (=, +=, -=, *=, /=, ++, --) · expression stmts
+  expressions  ternary ``c ? a : b`` · && || ! · comparisons ·
+               + - * / % · method calls ``x.add(1)`` · field access
+               ``ctx._source.f`` · subscripts ``doc['f']`` · list ``[]``
+               and map ``[:]``/``['k': v]`` literals · ``new ArrayList()``
+               / ``new HashMap()`` · Math.* · String concatenation
+
+Script contexts bind the usual roots: ``params``, ``doc``, ``ctx``,
+``state``, ``states``, ``_score``, ``_value``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common.errors import ElasticsearchError
+
+MAX_STEPS = 1_000_000      # interpreter step budget per run
+MAX_DEPTH = 64             # expression/call nesting
+
+
+class PainlessError(ElasticsearchError):
+    status = 400
+    error_type = "script_exception"
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+_PUNCT2 = {"==", "!=", "<=", ">=", "&&", "||", "+=", "-=", "*=", "/=",
+           "%=", "++", "--", "=~", "==~"}
+_PUNCT1 = set("+-*/%<>=!?:;,.(){}[]")
+_KEYWORDS = {"if", "else", "for", "while", "return", "break", "continue",
+             "in", "new", "true", "false", "null", "def", "instanceof"}
+_TYPE_WORDS = {"def", "int", "long", "double", "float", "boolean",
+               "String", "List", "Map", "Object", "var", "ArrayList",
+               "HashMap"}
+
+
+class _Tok:
+    __slots__ = ("kind", "value", "pos")
+
+    def __init__(self, kind: str, value, pos: int):
+        self.kind = kind          # num str ident punct kw eof
+        self.value = value
+        self.pos = pos
+
+    def __repr__(self):          # pragma: no cover — debug aid
+        return f"{self.kind}:{self.value!r}"
+
+
+def _tokenize(src: str) -> List[_Tok]:
+    toks: List[_Tok] = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c in " \t\r\n":
+            i += 1
+            continue
+        if src.startswith("//", i):
+            j = src.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if src.startswith("/*", i):
+            j = src.find("*/", i + 2)
+            if j < 0:
+                raise PainlessError("unterminated comment")
+            i = j + 2
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and src[i + 1].isdigit()):
+            j = i
+            seen_dot = False
+            while j < n and (src[j].isdigit() or
+                             (src[j] == "." and not seen_dot and
+                              j + 1 < n and src[j + 1].isdigit())):
+                if src[j] == ".":
+                    seen_dot = True
+                j += 1
+            if j < n and src[j] in "eE":
+                k = j + 1
+                if k < n and src[k] in "+-":
+                    k += 1
+                if k < n and src[k].isdigit():
+                    seen_dot = True
+                    j = k
+                    while j < n and src[j].isdigit():
+                        j += 1
+            text = src[i:j]
+            if j < n and src[j] in "lLfFdD":    # java numeric suffixes
+                if src[j] in "fFdD":
+                    seen_dot = True
+                j += 1
+            toks.append(_Tok("num", float(text) if seen_dot
+                             else int(text), i))
+            i = j
+            continue
+        if c in "'\"":
+            j = i + 1
+            buf = []
+            while j < n and src[j] != c:
+                if src[j] == "\\" and j + 1 < n:
+                    esc = src[j + 1]
+                    buf.append({"n": "\n", "t": "\t", "\\": "\\",
+                                "'": "'", '"': '"'}.get(esc, esc))
+                    j += 2
+                else:
+                    buf.append(src[j])
+                    j += 1
+            if j >= n:
+                raise PainlessError("unterminated string literal")
+            toks.append(_Tok("str", "".join(buf), i))
+            i = j + 1
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (src[j].isalnum() or src[j] == "_"):
+                j += 1
+            word = src[i:j]
+            toks.append(_Tok("kw" if word in _KEYWORDS else "ident",
+                             word, i))
+            i = j
+            continue
+        two = src[i:i + 2]
+        if two in _PUNCT2:
+            toks.append(_Tok("punct", two, i))
+            i += 2
+            continue
+        if c in _PUNCT1:
+            toks.append(_Tok("punct", c, i))
+            i += 1
+            continue
+        raise PainlessError(f"unexpected character [{c}] in script")
+    toks.append(_Tok("eof", None, n))
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# parse trees (tiny tuples: (kind, ...))
+# ---------------------------------------------------------------------------
+
+
+class _Parser:
+    def __init__(self, toks: List[_Tok]):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self, k=0) -> _Tok:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> _Tok:
+        t = self.toks[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def expect(self, value: str) -> None:
+        t = self.next()
+        if t.value != value:
+            raise PainlessError(
+                f"expected [{value}] but found [{t.value}]")
+
+    def at(self, value: str) -> bool:
+        return self.peek().value == value
+
+    # -- statements -----------------------------------------------------
+
+    def parse_program(self):
+        stmts = []
+        while self.peek().kind != "eof":
+            stmts.append(self.statement())
+        return ("block", stmts)
+
+    def block(self):
+        if self.at("{"):
+            self.next()
+            stmts = []
+            while not self.at("}"):
+                if self.peek().kind == "eof":
+                    raise PainlessError("unterminated block")
+                stmts.append(self.statement())
+            self.next()
+            return ("block", stmts)
+        return self.statement()
+
+    def _semi(self) -> None:
+        if self.at(";"):
+            self.next()
+
+    def statement(self):
+        t = self.peek()
+        if t.value == ";":
+            self.next()
+            return ("block", [])
+        if t.value == "if":
+            self.next()
+            self.expect("(")
+            cond = self.expr()
+            self.expect(")")
+            then = self.block()
+            other = None
+            if self.at("else"):
+                self.next()
+                other = self.block()
+            return ("if", cond, then, other)
+        if t.value == "while":
+            self.next()
+            self.expect("(")
+            cond = self.expr()
+            self.expect(")")
+            return ("while", cond, self.block())
+        if t.value == "for":
+            return self._for()
+        if t.value == "return":
+            self.next()
+            if self.at(";") or self.peek().value == "}":
+                self._semi()
+                return ("return", None)
+            e = self.expr()
+            self._semi()
+            return ("return", e)
+        if t.value == "break":
+            self.next()
+            self._semi()
+            return ("break",)
+        if t.value == "continue":
+            self.next()
+            self._semi()
+            return ("continue",)
+        # declaration: `def x = ...` / `double x = ...` / `List x = ...`
+        if (t.value in _TYPE_WORDS and self.peek(1).kind == "ident") or \
+                (t.kind == "ident" and t.value in _TYPE_WORDS and
+                 self.peek(1).kind == "ident"):
+            self.next()                      # drop the type word
+            name = self.next().value
+            init = None
+            if self.at("="):
+                self.next()
+                init = self.expr()
+            self._semi()
+            return ("decl", name, init)
+        # assignment or expression statement
+        e = self.expr()
+        t2 = self.peek()
+        if t2.value in ("=", "+=", "-=", "*=", "/=", "%="):
+            self.next()
+            rhs = self.expr()
+            self._semi()
+            return ("assign", t2.value, e, rhs)
+        if t2.value in ("++", "--"):
+            self.next()
+            self._semi()
+            return ("assign", "+=" if t2.value == "++" else "-=",
+                    e, ("num", 1))
+        self._semi()
+        return ("expr", e)
+
+    def _for(self):
+        self.next()
+        self.expect("(")
+        # for (x in expr) — Painless's foreach
+        if (self.peek().kind in ("ident", "kw") and
+                self.peek(1).value == "in"):
+            var = self.next().value
+            self.next()                      # in
+            it = self.expr()
+            self.expect(")")
+            return ("foreach", var, it, self.block())
+        if self.peek().value in _TYPE_WORDS and \
+                self.peek(1).kind == "ident" and \
+                self.peek(2).value in ("in", ":"):
+            self.next()
+            var = self.next().value
+            self.next()
+            it = self.expr()
+            self.expect(")")
+            return ("foreach", var, it, self.block())
+        # classic for(init; cond; post)
+        init = None
+        if not self.at(";"):
+            init = self.statement()          # consumes its own ';'
+        else:
+            self.next()
+        cond = None
+        if not self.at(";"):
+            cond = self.expr()
+        self.expect(";")
+        post = None
+        if not self.at(")"):
+            post = self.statement()          # no trailing ';' inside ()
+        self.expect(")")
+        return ("for", init, cond, post, self.block())
+
+    # -- expressions ----------------------------------------------------
+
+    def expr(self):
+        return self.ternary()
+
+    def ternary(self):
+        c = self.or_()
+        if self.at("?"):
+            self.next()
+            a = self.expr()
+            self.expect(":")
+            b = self.expr()
+            return ("ternary", c, a, b)
+        return c
+
+    def or_(self):
+        e = self.and_()
+        while self.at("||"):
+            self.next()
+            e = ("or", e, self.and_())
+        return e
+
+    def and_(self):
+        e = self.equality()
+        while self.at("&&"):
+            self.next()
+            e = ("and", e, self.equality())
+        return e
+
+    def equality(self):
+        e = self.relational()
+        while self.peek().value in ("==", "!="):
+            op = self.next().value
+            e = ("cmp", op, e, self.relational())
+        return e
+
+    def relational(self):
+        e = self.additive()
+        while self.peek().value in ("<", "<=", ">", ">="):
+            op = self.next().value
+            e = ("cmp", op, e, self.additive())
+        if self.at("instanceof"):
+            self.next()
+            self.next()                      # type name — always true-ish
+            return ("bool", True)
+        return e
+
+    def additive(self):
+        e = self.multiplicative()
+        while self.peek().value in ("+", "-"):
+            op = self.next().value
+            e = ("bin", op, e, self.multiplicative())
+        return e
+
+    def multiplicative(self):
+        e = self.unary()
+        while self.peek().value in ("*", "/", "%"):
+            op = self.next().value
+            e = ("bin", op, e, self.unary())
+        return e
+
+    def unary(self):
+        t = self.peek()
+        if t.value == "!":
+            self.next()
+            return ("not", self.unary())
+        if t.value == "-":
+            self.next()
+            return ("neg", self.unary())
+        if t.value == "+":
+            self.next()
+            return self.unary()
+        if t.value == "(":
+            # cast like (int) x — a type name alone inside parens
+            if self.peek(1).value in _TYPE_WORDS and \
+                    self.peek(2).value == ")":
+                self.next()
+                ty = self.next().value
+                self.next()
+                return ("cast", ty, self.unary())
+        return self.postfix()
+
+    def postfix(self):
+        e = self.primary()
+        while True:
+            t = self.peek()
+            if t.value == ".":
+                self.next()
+                name = self.next()
+                if name.kind not in ("ident", "kw"):
+                    raise PainlessError(
+                        f"expected member name after '.' "
+                        f"[{name.value}]")
+                if self.at("("):
+                    args = self._args()
+                    e = ("call", e, name.value, args)
+                else:
+                    e = ("attr", e, name.value)
+            elif t.value == "[":
+                self.next()
+                idx = self.expr()
+                self.expect("]")
+                e = ("index", e, idx)
+            else:
+                return e
+
+    def _args(self):
+        self.expect("(")
+        args = []
+        while not self.at(")"):
+            args.append(self.expr())
+            if self.at(","):
+                self.next()
+        self.next()
+        return args
+
+    def primary(self):
+        t = self.next()
+        if t.kind == "num":
+            return ("num", t.value)
+        if t.kind == "str":
+            return ("str", t.value)
+        if t.value == "true":
+            return ("bool", True)
+        if t.value == "false":
+            return ("bool", False)
+        if t.value == "null":
+            return ("null",)
+        if t.value == "new":
+            ty = self.next().value
+            self._args()                     # constructor args ignored
+            if ty in ("ArrayList", "List"):
+                return ("list", [])
+            if ty in ("HashMap", "Map"):
+                return ("map", [])
+            raise PainlessError(f"cannot construct [{ty}]")
+        if t.value == "(":
+            e = self.expr()
+            self.expect(")")
+            return e
+        if t.value == "[":
+            # list literal [a, b] · empty map [:] · map ['k': v]
+            if self.at(":"):
+                self.next()
+                self.expect("]")
+                return ("map", [])
+            items = []
+            is_map = None
+            while not self.at("]"):
+                k = self.expr()
+                if is_map is None:
+                    is_map = self.at(":")
+                if is_map:
+                    self.expect(":")
+                    v = self.expr()
+                    items.append((k, v))
+                else:
+                    items.append(k)
+                if self.at(","):
+                    self.next()
+            self.next()
+            return ("map", items) if is_map else ("list_lit", items)
+        if t.kind in ("ident", "kw"):
+            return ("name", t.value)
+        raise PainlessError(f"unexpected token [{t.value}]")
+
+
+# ---------------------------------------------------------------------------
+# interpreter
+# ---------------------------------------------------------------------------
+
+_MATH = {
+    "abs": abs, "max": max, "min": min, "floor": math.floor,
+    "ceil": math.ceil, "sqrt": math.sqrt, "log": math.log,
+    "log10": math.log10, "exp": math.exp, "pow": math.pow,
+    "round": round, "sin": math.sin, "cos": math.cos, "tan": math.tan,
+}
+
+
+def _meth_list(obj: list, name: str, args: list):
+    if name == "add":
+        if len(args) == 2:
+            obj.insert(int(args[0]), args[1])
+        else:
+            obj.append(args[0])
+        return None
+    if name == "addAll":
+        obj.extend(args[0])
+        return None
+    if name in ("size", "length"):
+        return len(obj)
+    if name == "get":
+        return obj[int(args[0])]
+    if name == "set":
+        obj[int(args[0])] = args[1]
+        return None
+    if name == "contains":
+        return args[0] in obj
+    if name == "indexOf":
+        try:
+            return obj.index(args[0])
+        except ValueError:
+            return -1
+    if name == "remove":
+        del obj[int(args[0])]
+        return None
+    if name == "isEmpty":
+        return len(obj) == 0
+    if name == "clear":
+        obj.clear()
+        return None
+    if name == "sort":
+        obj.sort()
+        return None
+    raise PainlessError(f"unknown List method [{name}]")
+
+
+def _meth_map(obj: dict, name: str, args: list):
+    if name == "put":
+        obj[args[0]] = args[1]
+        return None
+    if name == "get":
+        return obj.get(args[0])
+    if name == "getOrDefault":
+        return obj.get(args[0], args[1])
+    if name == "containsKey":
+        return args[0] in obj
+    if name == "containsValue":
+        return args[0] in obj.values()
+    if name == "remove":
+        return obj.pop(args[0], None)
+    if name == "size":
+        return len(obj)
+    if name == "isEmpty":
+        return len(obj) == 0
+    if name == "keySet":
+        return list(obj.keys())
+    if name == "values":
+        return list(obj.values())
+    if name == "putAll":
+        obj.update(args[0])
+        return None
+    if name == "entrySet":
+        return [{"key": k, "value": v} for k, v in obj.items()]
+    raise PainlessError(f"unknown Map method [{name}]")
+
+
+def _meth_str(obj: str, name: str, args: list):
+    if name == "length":
+        return len(obj)
+    if name == "substring":
+        return obj[int(args[0]):] if len(args) == 1 else \
+            obj[int(args[0]):int(args[1])]
+    if name == "contains":
+        return args[0] in obj
+    if name == "startsWith":
+        return obj.startswith(args[0])
+    if name == "endsWith":
+        return obj.endswith(args[0])
+    if name == "toUpperCase":
+        return obj.upper()
+    if name == "toLowerCase":
+        return obj.lower()
+    if name == "trim":
+        return obj.strip()
+    if name == "indexOf":
+        return obj.find(args[0])
+    if name == "replace":
+        return obj.replace(args[0], args[1])
+    if name == "split":
+        import re as _re
+        return _re.split(args[0], obj)
+    if name == "charAt":
+        return obj[int(args[0])]
+    if name == "equals":
+        return obj == args[0]
+    if name == "equalsIgnoreCase":
+        return isinstance(args[0], str) and obj.lower() == args[0].lower()
+    if name == "isEmpty":
+        return len(obj) == 0
+    if name == "toString":
+        return obj
+    if name == "compareTo":
+        return (obj > args[0]) - (obj < args[0])
+    if name == "hashCode":
+        # deterministic (NOT Python's salted hash): Java's String.hashCode
+        h = 0
+        for ch in obj:
+            h = (31 * h + ord(ch)) & 0xFFFFFFFF
+        return h - (1 << 32) if h >= (1 << 31) else h
+    raise PainlessError(f"unknown String method [{name}]")
+
+
+def _meth_num(obj, name: str, args: list):
+    if name == "intValue":
+        return int(obj)
+    if name == "longValue":
+        return int(obj)
+    if name in ("doubleValue", "floatValue"):
+        return float(obj)
+    if name == "toString":
+        return str(obj)
+    if name == "compareTo":
+        return (obj > args[0]) - (obj < args[0])
+    raise PainlessError(f"unknown numeric method [{name}]")
+
+
+class DocValues:
+    """``doc['field']`` accessor: .value / .values / .size() / .empty
+    (reference: the Painless doc-values API, ``ScriptDocValues.java``)."""
+
+    __slots__ = ("values",)
+
+    def __init__(self, values: list):
+        self.values = values
+
+    @property
+    def value(self):
+        if not self.values:
+            raise PainlessError(
+                "A document doesn't have a value for a field! Use "
+                "doc[<field>].size()==0 to check if a document is "
+                "missing a field!")
+        return self.values[0]
+
+    @property
+    def empty(self):
+        return not self.values
+
+    def method(self, name, args):
+        if name == "size":
+            return len(self.values)
+        if name == "isEmpty":
+            return not self.values
+        if name == "get":
+            return self.values[int(args[0])]
+        if name == "contains":
+            return args[0] in self.values
+        raise PainlessError(f"unknown doc-values method [{name}]")
+
+
+class DocAccessor:
+    """``doc`` root: subscript (and attribute) → :class:`DocValues`.
+    ``lookup`` is a callable field → list-of-values for the CURRENT doc."""
+
+    __slots__ = ("lookup",)
+
+    def __init__(self, lookup):
+        self.lookup = lookup
+
+    def get(self, field: str) -> DocValues:
+        vals = self.lookup(field)
+        return DocValues(vals if isinstance(vals, list)
+                         else [] if vals is None else [vals])
+
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+class CompiledScript:
+    """A parsed program; ``run(env)`` interprets it and returns the
+    script's value (explicit ``return`` or the last expression
+    statement's value, like Painless)."""
+
+    def __init__(self, source: str, tree):
+        self.source = source
+        self.tree = tree
+
+    def run(self, env: Dict[str, Any]) -> Any:
+        interp = _Interp(dict(env))
+        try:
+            interp.exec_block(self.tree)
+        except _Return as r:
+            return r.value
+        return interp.last_value
+
+
+class _Interp:
+    def __init__(self, env: Dict[str, Any]):
+        self.env = env
+        self.steps = 0
+        self.last_value = None
+
+    def _tick(self):
+        self.steps += 1
+        if self.steps > MAX_STEPS:
+            raise PainlessError(
+                "script exceeded the execution step budget "
+                f"[{MAX_STEPS}] (infinite loop?)")
+
+    # -- statements -----------------------------------------------------
+
+    def exec_block(self, node):
+        for stmt in node[1]:
+            self.exec_stmt(stmt)
+
+    def exec_stmt(self, node):
+        self._tick()
+        kind = node[0]
+        if kind == "block":
+            self.exec_block(node)
+        elif kind == "if":
+            if _truthy(self.eval(node[1])):
+                self.exec_stmt(node[2])
+            elif node[3] is not None:
+                self.exec_stmt(node[3])
+        elif kind == "while":
+            while _truthy(self.eval(node[1])):
+                self._tick()
+                try:
+                    self.exec_stmt(node[2])
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+        elif kind == "for":
+            _init, cond, post, body = node[1], node[2], node[3], node[4]
+            if _init is not None:
+                self.exec_stmt(_init)
+            while cond is None or _truthy(self.eval(cond)):
+                self._tick()
+                try:
+                    self.exec_stmt(body)
+                except _Break:
+                    break
+                except _Continue:
+                    pass
+                if post is not None:
+                    self.exec_stmt(post)
+        elif kind == "foreach":
+            var, it, body = node[1], node[2], node[3]
+            seq = self.eval(it)
+            if isinstance(seq, DocValues):
+                seq = seq.values
+            if isinstance(seq, dict):
+                seq = list(seq.keys())
+            if not isinstance(seq, (list, tuple, str)):
+                raise PainlessError(
+                    f"cannot iterate over [{type(seq).__name__}]")
+            for v in list(seq):
+                self._tick()
+                self.env[var] = v
+                try:
+                    self.exec_stmt(body)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+        elif kind == "decl":
+            self.env[node[1]] = (None if node[2] is None
+                                 else self.eval(node[2]))
+        elif kind == "assign":
+            self._assign(node[1], node[2], node[3])
+        elif kind == "return":
+            raise _Return(None if node[1] is None else self.eval(node[1]))
+        elif kind == "break":
+            raise _Break()
+        elif kind == "continue":
+            raise _Continue()
+        elif kind == "expr":
+            self.last_value = self.eval(node[1])
+        else:                                # pragma: no cover
+            raise PainlessError(f"unknown statement [{kind}]")
+
+    def _assign(self, op: str, target, rhs_node):
+        rhs = self.eval(rhs_node)
+        if op != "=":
+            cur = self.eval(target)
+            rhs = _binop(op[0], cur, rhs)
+        kind = target[0]
+        if kind == "name":
+            self.env[target[1]] = rhs
+        elif kind == "attr":
+            obj = self.eval(target[1])
+            if isinstance(obj, dict):
+                obj[target[2]] = rhs
+            else:
+                raise PainlessError(
+                    f"cannot write field [{target[2]}] of "
+                    f"[{type(obj).__name__}]")
+        elif kind == "index":
+            obj = self.eval(target[1])
+            idx = self.eval(target[2])
+            if isinstance(obj, list):
+                obj[int(idx)] = rhs
+            elif isinstance(obj, dict):
+                obj[idx] = rhs
+            else:
+                raise PainlessError(
+                    f"cannot index-assign [{type(obj).__name__}]")
+        else:
+            raise PainlessError("invalid assignment target")
+
+    # -- expressions ----------------------------------------------------
+
+    def eval(self, node, depth: int = 0):
+        self._tick()
+        if depth > MAX_DEPTH:
+            raise PainlessError("expression nesting too deep")
+        kind = node[0]
+        if kind == "num" or kind == "str" or kind == "bool":
+            return node[1]
+        if kind == "null":
+            return None
+        if kind == "name":
+            name = node[1]
+            if name in self.env:
+                return self.env[name]
+            if name == "Math":
+                return _MATH_ROOT
+            raise PainlessError(f"unknown variable [{name}]")
+        if kind == "list" or kind == "list_lit":
+            return [self.eval(e, depth + 1) for e in node[1]]
+        if kind == "map":
+            return {self.eval(k, depth + 1): self.eval(v, depth + 1)
+                    for k, v in node[1]}
+        if kind == "ternary":
+            return (self.eval(node[2], depth + 1)
+                    if _truthy(self.eval(node[1], depth + 1))
+                    else self.eval(node[3], depth + 1))
+        if kind == "or":
+            left = self.eval(node[1], depth + 1)
+            return left if _truthy(left) else self.eval(node[2], depth + 1)
+        if kind == "and":
+            left = self.eval(node[1], depth + 1)
+            return self.eval(node[2], depth + 1) if _truthy(left) else left
+        if kind == "not":
+            return not _truthy(self.eval(node[1], depth + 1))
+        if kind == "neg":
+            return -self.eval(node[1], depth + 1)
+        if kind == "cmp":
+            return _compare(node[1], self.eval(node[2], depth + 1),
+                            self.eval(node[3], depth + 1))
+        if kind == "bin":
+            return _binop(node[1], self.eval(node[2], depth + 1),
+                          self.eval(node[3], depth + 1))
+        if kind == "cast":
+            v = self.eval(node[2], depth + 1)
+            if node[1] in ("int", "long"):
+                return int(v)
+            if node[1] in ("double", "float"):
+                return float(v)
+            if node[1] == "String":
+                return _to_str(v)
+            return v
+        if kind == "attr":
+            return self._attr(self.eval(node[1], depth + 1), node[2])
+        if kind == "index":
+            obj = self.eval(node[1], depth + 1)
+            idx = self.eval(node[2], depth + 1)
+            if isinstance(obj, DocAccessor):
+                return obj.get(str(idx))
+            if isinstance(obj, list):
+                return obj[int(idx)]
+            if isinstance(obj, dict):
+                return obj.get(idx)
+            if isinstance(obj, str):
+                return obj[int(idx)]
+            raise PainlessError(
+                f"cannot subscript [{type(obj).__name__}]")
+        if kind == "call":
+            obj = self.eval(node[1], depth + 1)
+            args = [self.eval(a, depth + 1) for a in node[3]]
+            return self._call(obj, node[2], args)
+        raise PainlessError(f"unknown expression [{kind}]")
+
+    def _attr(self, obj, name: str):
+        if isinstance(obj, DocAccessor):
+            return obj.get(name)
+        if isinstance(obj, DocValues):
+            if name == "value":
+                return obj.value
+            if name == "values":
+                return obj.values
+            if name == "empty":
+                return obj.empty
+            if name == "length":
+                return len(obj.values)
+            raise PainlessError(f"unknown doc-values field [{name}]")
+        if obj is _MATH_ROOT:
+            if name == "PI":
+                return math.pi
+            if name == "E":
+                return math.e
+            raise PainlessError(f"unknown Math field [{name}]")
+        if isinstance(obj, dict):
+            # maps read like objects: ctx._source.f
+            return obj.get(name)
+        if isinstance(obj, list) and name == "length":
+            return len(obj)
+        if obj is None:
+            raise PainlessError(
+                f"cannot access field [{name}] of a null value")
+        raise PainlessError(
+            f"cannot access field [{name}] of "
+            f"[{type(obj).__name__}]")
+
+    def _call(self, obj, name: str, args: list):
+        if obj is _MATH_ROOT:
+            fn = _MATH.get(name)
+            if fn is None:
+                raise PainlessError(f"unknown Math method [{name}]")
+            return fn(*args)
+        if isinstance(obj, DocValues):
+            return obj.method(name, args)
+        if isinstance(obj, DocAccessor):
+            if name == "containsKey":
+                return True            # mapping presence is not tracked
+            raise PainlessError(f"unknown doc method [{name}]")
+        if isinstance(obj, list):
+            return _meth_list(obj, name, args)
+        if isinstance(obj, dict):
+            return _meth_map(obj, name, args)
+        if isinstance(obj, str):
+            return _meth_str(obj, name, args)
+        if isinstance(obj, bool):
+            if name == "toString":
+                return "true" if obj else "false"
+            raise PainlessError(f"unknown boolean method [{name}]")
+        if isinstance(obj, (int, float)):
+            return _meth_num(obj, name, args)
+        if obj is None:
+            raise PainlessError(
+                f"cannot invoke [{name}] on a null value")
+        raise PainlessError(
+            f"cannot invoke [{name}] on [{type(obj).__name__}]")
+
+
+_MATH_ROOT = object()
+
+
+def _truthy(v) -> bool:
+    if v is None:
+        raise PainlessError("cannot use a null value as a condition")
+    return bool(v)
+
+
+def _to_str(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, float) and v.is_integer():
+        return f"{v:.1f}"                    # Java Double.toString(2.0)
+    return str(v)
+
+
+def _binop(op: str, a, b):
+    try:
+        if op == "+":
+            if isinstance(a, str) or isinstance(b, str):
+                return _to_str(a) + _to_str(b)   # Java string concat
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            if isinstance(a, int) and isinstance(b, int):
+                q = a / b                    # Java int division truncates
+                return int(q) if q >= 0 else -int(-q)
+            return a / b
+        if op == "%":
+            return a % b
+    except TypeError as e:
+        raise PainlessError(f"type error in script arithmetic: {e}")
+    except ZeroDivisionError:
+        raise PainlessError("/ by zero")
+    raise PainlessError(f"unknown operator [{op}]")
+
+
+def _compare(op: str, a, b):
+    if op == "==":
+        return a == b
+    if op == "!=":
+        return a != b
+    try:
+        if op == "<":
+            return a < b
+        if op == "<=":
+            return a <= b
+        if op == ">":
+            return a > b
+        if op == ">=":
+            return a >= b
+    except TypeError as e:
+        raise PainlessError(f"type error in script comparison: {e}")
+    raise PainlessError(f"unknown comparison [{op}]")
+
+
+def compile_painless(source: str) -> CompiledScript:
+    """Tokenize + parse; raises :class:`PainlessError` on any syntax the
+    subset doesn't carry."""
+    toks = _tokenize(source)
+    tree = _Parser(toks).parse_program()
+    return CompiledScript(source, tree)

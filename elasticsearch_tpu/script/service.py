@@ -1,0 +1,112 @@
+"""ScriptService: compilation cache + rate limit + live stats behind
+every script context.
+
+Reference: ``server/src/main/java/org/elasticsearch/script/
+ScriptService.java:289`` — contexts resolve (lang, source) through an
+LRU cache (default 3000 entries, ``script.cache.max_size``) guarded by a
+compilation rate limit (default ``150/5m``,
+``script.max_compilations_rate``); stats surface through nodes stats
+(compilations, cache_evictions, compilation_limit_triggered).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+from ..common.errors import ElasticsearchError
+from .painless_lite import CompiledScript, PainlessError, compile_painless
+
+
+class CircuitBreakingScriptError(ElasticsearchError):
+    status = 429
+    error_type = "circuit_breaking_exception"
+
+
+class ScriptService:
+    CACHE_MAX = 3000
+    RATE_MAX, RATE_WINDOW_S = 150, 300.0     # 150 compilations / 5m
+
+    def __init__(self, cache_max: int = CACHE_MAX,
+                 rate_max: int = RATE_MAX,
+                 rate_window_s: float = RATE_WINDOW_S,
+                 clock=time.monotonic):
+        self.cache_max = cache_max
+        self.rate_max = rate_max
+        self.rate_window_s = rate_window_s
+        self.clock = clock
+        self._cache: "OrderedDict[Tuple[str, str], CompiledScript]" = \
+            OrderedDict()
+        # the DEFAULT instance is shared across in-process cluster nodes'
+        # worker threads: LRU mutation + token bucket need the lock
+        self._lock = threading.RLock()
+        # token bucket (the reference uses the same shape)
+        self._tokens = float(rate_max)
+        self._last_refill = clock()
+        self.stats = {"compilations": 0, "cache_evictions": 0,
+                      "compilation_limit_triggered": 0}
+
+    def compile(self, source: str, lang: str = "painless"
+                ) -> CompiledScript:
+        key = (lang, source)
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit is not None:
+                self._cache.move_to_end(key)
+                return hit
+            self._take_token(source)
+        compiled = compile_painless(source)
+        with self._lock:
+            self.stats["compilations"] += 1
+            self._cache[key] = compiled
+            if len(self._cache) > self.cache_max:
+                self._cache.popitem(last=False)
+                self.stats["cache_evictions"] += 1
+        return compiled
+
+    def _take_token(self, source: str) -> None:
+        now = self.clock()
+        self._tokens = min(
+            float(self.rate_max),
+            self._tokens + (now - self._last_refill) *
+            (self.rate_max / self.rate_window_s))
+        self._last_refill = now
+        if self._tokens < 1.0:
+            self.stats["compilation_limit_triggered"] += 1
+            raise CircuitBreakingScriptError(
+                "[script] Too many dynamic script compilations within, "
+                f"max: [{self.rate_max}/{int(self.rate_window_s)}s]; "
+                "please use indexed, or scripts with parameters "
+                "instead; this limit can be changed by the "
+                "[script.max_compilations_rate] setting")
+        self._tokens -= 1.0
+
+    # -- contexts --------------------------------------------------------
+
+    def run(self, source: str, env: Dict[str, Any],
+            lang: str = "painless") -> Any:
+        return self.compile(source, lang).run(env)
+
+    def run_update(self, source: str, ctx: Dict[str, Any],
+                   params: Optional[dict] = None) -> Dict[str, Any]:
+        """Update context: the script mutates ``ctx`` in place
+        (``ctx._source``, ``ctx.op``)."""
+        self.run(source, {"ctx": ctx, "params": params or {}})
+        return ctx
+
+    def stats_doc(self) -> dict:
+        return {"compilations": self.stats["compilations"],
+                "cache_evictions": self.stats["cache_evictions"],
+                "compilation_limit_triggered":
+                    self.stats["compilation_limit_triggered"]}
+
+
+#: process-wide default service (same pattern as ``common/breakers.py``
+#: DEFAULT — documented singleton; per-node isolation is the cluster
+#: test harness's known limitation)
+DEFAULT = ScriptService()
+
+__all__ = ["DEFAULT", "ScriptService", "CircuitBreakingScriptError",
+           "PainlessError"]

@@ -279,15 +279,29 @@ def run_aggregations_multi(
         if isinstance(agg, PipelineAggregator):
             pipelines[name] = agg
             continue
-        partials = [agg.collect(ctx, seg, mask)
-                    for ctx, seg, mask in ctx_seg_masks]
-        partials.extend((extra_partials or {}).get(name, ()))
-        # reduce-time bucket-tree accounting (the reference's BigArrays
-        # byte accounting per bucket): a too-large agg trips the request
-        # breaker with a 429 instead of exhausting host memory
-        est = sum(estimate_partial_bytes(p) for p in partials)
-        with request_breaker.reserve(est, f"<agg [{name}]>"):
+        # collection-time accounting (the reference's BigArrays accounts
+        # DURING bucket growth, ``AggregatorBase.addRequestCircuitBreaker-
+        # Bytes``): reserve each segment's partial AS it is produced, so
+        # a pathological high-cardinality agg trips BEFORE the next
+        # segment's partial is even materialized — not after everything
+        # is already resident
+        partials = []
+        reserved = 0
+        try:
+            for ctx, seg, mask in ctx_seg_masks:
+                p = agg.collect(ctx, seg, mask)
+                step = estimate_partial_bytes(p)
+                request_breaker.add_estimate(step, f"<agg [{name}]>")
+                reserved += step
+                partials.append(p)
+            for p in (extra_partials or {}).get(name, ()):
+                step = estimate_partial_bytes(p)
+                request_breaker.add_estimate(step, f"<agg [{name}]>")
+                reserved += step
+                partials.append(p)
             result[name] = agg.reduce(partials)
+        finally:
+            request_breaker.release(reserved)
         _apply_parent_pipes(agg, result[name])
         if getattr(agg, "meta", None) is not None:
             result[name]["meta"] = agg.meta
@@ -2283,7 +2297,82 @@ class BucketScriptAgg(PipelineAggregator):
 # registry
 # ---------------------------------------------------------------------------
 
+class ScriptedMetricAgg(Aggregator):
+    """scripted_metric: init/map per segment, combine per partial, reduce
+    once across every shard's partials (reference:
+    ``metrics/ScriptedMetricAggregator.java``; scripts run through the
+    sandboxed Painless-lite engine, ``script/painless_lite.py``).
+
+    Divergence (documented): map/combine run per SEGMENT rather than per
+    shard — combine must stay associative, which every reference example
+    (and the reference's own reduce contract) already requires. ``doc``
+    reads field values out of the stored ``_source`` (the engine's
+    doc-values view for scripts)."""
+
+    def __init__(self, body):
+        def src(key):
+            v = body.get(key)
+            if isinstance(v, dict):
+                v = v.get("source")
+            return v
+        self.init_script = src("init_script")
+        self.map_script = src("map_script")
+        if not self.map_script:
+            raise IllegalArgumentError(
+                "[map_script] must be provided for metric aggregations.")
+        self.combine_script = src("combine_script")
+        self.reduce_script = src("reduce_script")
+        self.params = body.get("params") or {}
+
+    def collect(self, ctx, seg, mask):
+        import copy
+
+        from ..script.painless_lite import DocAccessor
+        from ..script.service import DEFAULT as _scripts
+        state: dict = {}
+        params = copy.deepcopy(self.params)
+        if self.init_script:
+            _scripts.run(self.init_script,
+                         {"state": state, "params": params})
+        mask_h = np.asarray(mask)
+        compiled = _scripts.compile(self.map_script)
+        for local in np.flatnonzero(mask_h[: seg.n_docs]):
+            source = seg.sources[int(local)] or {}
+
+            def lookup(field, _s=source):
+                v = _s.get(field)
+                if v is None and "." in field:
+                    node = _s
+                    for part in field.split("."):
+                        node = node.get(part) if isinstance(node, dict) \
+                            else None
+                        if node is None:
+                            break
+                    v = node
+                return v if isinstance(v, list) else (
+                    [] if v is None else [v])
+            compiled.run({"state": state, "params": params,
+                          "doc": DocAccessor(lookup)})
+        if self.combine_script:
+            return _scripts.run(self.combine_script,
+                                {"state": state, "params": params})
+        return state
+
+    def reduce(self, partials):
+        import copy
+        from ..script.service import DEFAULT as _scripts
+        states = list(partials)
+        if self.reduce_script:
+            value = _scripts.run(self.reduce_script, {
+                "states": states,
+                "params": copy.deepcopy(self.params)})
+        else:
+            value = states
+        return {"value": value}
+
+
 _AGG_PARSERS = {
+    "scripted_metric": ScriptedMetricAgg,
     "avg": AvgAgg,
     "sum": SumAgg,
     "min": MinAgg,

@@ -721,11 +721,7 @@ class ExistsQuery(Query):
 
     def execute(self, ctx, seg):
         if self.field == "_source":
-            from ..common.errors import ElasticsearchError
-
-            class QueryShardError(ElasticsearchError):
-                status = 400
-                error_type = "query_shard_exception"
+            from ..common.errors import QueryShardError
             raise QueryShardError(
                 "the [_source] field may not be queried directly")
         if self.field in self.ALWAYS_PRESENT:

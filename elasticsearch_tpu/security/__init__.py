@@ -1,0 +1,13 @@
+"""Security layer: API-key authentication on REST, shared-secret
+challenge-response on the node-to-node transport, optional TLS on both
+planes (reference: ``x-pack/plugin/security/`` — ``ApiKeyService.java``,
+``authc/``, transport interceptors). Off by default so the open
+conformance corpus runs unchanged; enabling flips every REST request to
+require credentials and every transport connection to complete the
+handshake."""
+
+from .apikeys import (AuthenticationError, SecurityService,
+                      make_self_signed_tls)
+
+__all__ = ["AuthenticationError", "SecurityService",
+           "make_self_signed_tls"]

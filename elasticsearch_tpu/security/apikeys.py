@@ -1,0 +1,209 @@
+"""API keys with hashed storage + TLS material helper.
+
+Reference: ``x-pack/plugin/security/.../authc/ApiKeyService.java`` — keys
+are (id, secret) pairs; the secret is stored only as a salted PBKDF2 hash
+(the reference default hasher is PBKDF2 as well); clients authenticate
+with ``Authorization: ApiKey base64(id:secret)``. Invalidation is a
+tombstone, not a delete, so audit surfaces can still list the key.
+
+Design notes (TPU-era simplifications, documented not hidden):
+- principals are key names; there is no realm chain or RBAC — any valid
+  key is a full-access user (the reference's role resolution,
+  ``authz/RBACEngine.java``, is out of scope this round);
+- the key store is in-memory with an optional JSON file behind it
+  (hashes only — never secrets);
+- PBKDF2 iteration count is 10_000 (reference default for api keys).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import secrets
+import time
+from typing import Dict, Optional
+
+from ..common.errors import ElasticsearchError
+
+_PBKDF2_ITERS = 10_000
+
+
+class AuthenticationError(ElasticsearchError):
+    """401 security_exception (reference:
+    ``ElasticsearchSecurityException`` with RestStatus.UNAUTHORIZED)."""
+
+    status = 401
+    error_type = "security_exception"
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        d["error"]["header"] = {
+            "WWW-Authenticate": ['Basic realm="security" charset="UTF-8"',
+                                 "ApiKey"]}
+        return d
+
+
+def _hash_secret(secret: str, salt: bytes) -> str:
+    dk = hashlib.pbkdf2_hmac("sha256", secret.encode(), salt,
+                             _PBKDF2_ITERS)
+    return dk.hex()
+
+
+class SecurityService:
+    """API-key issue/verify/invalidate + request authentication."""
+
+    def __init__(self, enabled: bool = False,
+                 persist_path: Optional[str] = None):
+        self.enabled = enabled
+        self.persist_path = persist_path
+        #: key id -> record (secret_hash/salt, name, creation, invalidated)
+        self._keys: Dict[str, dict] = {}
+        if persist_path and os.path.exists(persist_path):
+            try:
+                with open(persist_path) as f:
+                    self._keys = json.load(f)
+            except (OSError, ValueError):
+                self._keys = {}
+
+    # -- key lifecycle ---------------------------------------------------
+
+    def create_key(self, name: str,
+                   expiration_ms: Optional[int] = None) -> dict:
+        """Returns {id, name, api_key, encoded} — the cleartext secret
+        appears ONLY in this response (the store keeps the hash)."""
+        key_id = secrets.token_urlsafe(15)
+        secret = secrets.token_urlsafe(24)
+        salt = secrets.token_bytes(16)
+        self._keys[key_id] = {
+            "name": name,
+            "salt": salt.hex(),
+            "hash": _hash_secret(secret, salt),
+            "creation": int(time.time() * 1000),
+            "expiration": (int(time.time() * 1000) + expiration_ms)
+            if expiration_ms else None,
+            "invalidated": False,
+        }
+        self._persist()
+        return {"id": key_id, "name": name, "api_key": secret,
+                "encoded": base64.b64encode(
+                    f"{key_id}:{secret}".encode()).decode()}
+
+    def invalidate(self, ids=None, name: Optional[str] = None) -> dict:
+        hit = []
+        for kid, rec in self._keys.items():
+            if rec["invalidated"]:
+                continue
+            if (ids and kid in ids) or (name and rec["name"] == name):
+                rec["invalidated"] = True
+                rec["invalidation"] = int(time.time() * 1000)
+                hit.append(kid)
+        self._persist()
+        return {"invalidated_api_keys": hit,
+                "previously_invalidated_api_keys": [],
+                "error_count": 0}
+
+    def list_keys(self) -> dict:
+        return {"api_keys": [
+            {"id": kid, "name": rec["name"], "creation": rec["creation"],
+             "invalidated": rec["invalidated"],
+             "expiration": rec.get("expiration")}
+            for kid, rec in sorted(self._keys.items())]}
+
+    def _persist(self) -> None:
+        if not self.persist_path:
+            return
+        tmp = self.persist_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._keys, f)
+        os.replace(tmp, self.persist_path)
+
+    # -- authentication --------------------------------------------------
+
+    def verify(self, key_id: str, secret: str) -> Optional[str]:
+        """Key name when (id, secret) is valid and live, else None.
+        Constant-time hash compare."""
+        rec = self._keys.get(key_id)
+        if rec is None or rec["invalidated"]:
+            return None
+        exp = rec.get("expiration")
+        if exp is not None and exp < time.time() * 1000:
+            return None
+        want = rec["hash"]
+        got = _hash_secret(secret, bytes.fromhex(rec["salt"]))
+        return rec["name"] if hmac.compare_digest(want, got) else None
+
+    def authenticate(self, headers: Optional[dict]) -> dict:
+        """Authenticate one REST request from its headers. Returns the
+        principal doc; raises :class:`AuthenticationError` (401) when
+        credentials are missing or invalid."""
+        auth = (headers or {}).get("authorization") or \
+            (headers or {}).get("Authorization")
+        if not auth:
+            raise AuthenticationError(
+                "missing authentication credentials for REST request")
+        scheme, _, value = auth.partition(" ")
+        if scheme.lower() == "apikey":
+            try:
+                decoded = base64.b64decode(value.strip()).decode()
+                key_id, _, secret = decoded.partition(":")
+            except Exception:   # noqa: BLE001 — malformed header
+                raise AuthenticationError(
+                    "unable to authenticate with provided credentials")
+            name = self.verify(key_id, secret)
+            if name is None:
+                raise AuthenticationError(
+                    "unable to authenticate api key "
+                    f"[{key_id}]")
+            return {"username": name, "authentication_type": "api_key",
+                    "api_key": {"id": key_id, "name": name}}
+        raise AuthenticationError(
+            f"unsupported authentication scheme [{scheme}]")
+
+
+def make_self_signed_tls(cert_dir: str, common_name: str = "localhost"):
+    """Generate a self-signed cert/key pair and return
+    (server_ssl_context, client_ssl_context) — the client context trusts
+    exactly this cert (the reference ships ``elasticsearch-certutil``;
+    this is its minimum in-process equivalent for tests and dev)."""
+    import ssl
+    from datetime import datetime, timedelta, timezone
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(cert_dir, exist_ok=True)
+    cert_path = os.path.join(cert_dir, "node.crt")
+    key_path = os.path.join(cert_dir, "node.key")
+    if not (os.path.exists(cert_path) and os.path.exists(key_path)):
+        key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        name = x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+        now = datetime.now(timezone.utc)
+        cert = (x509.CertificateBuilder()
+                .subject_name(name).issuer_name(name)
+                .public_key(key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - timedelta(minutes=5))
+                .not_valid_after(now + timedelta(days=365))
+                .add_extension(x509.SubjectAlternativeName(
+                    [x509.DNSName(common_name),
+                     x509.DNSName("127.0.0.1")]), critical=False)
+                .sign(key, hashes.SHA256()))
+        with open(key_path, "wb") as f:
+            f.write(key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption()))
+        with open(cert_path, "wb") as f:
+            f.write(cert.public_bytes(serialization.Encoding.PEM))
+    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server_ctx.load_cert_chain(cert_path, key_path)
+    client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    client_ctx.load_verify_locations(cert_path)
+    client_ctx.check_hostname = False
+    return server_ctx, client_ctx

@@ -34,6 +34,18 @@ _LEN = struct.Struct(">I")
 MAX_FRAME = 1 << 26          # 64 MiB: a full cluster state / recovery chunk
 
 
+def _hmac_hex(secret: str, nonce: str) -> str:
+    import hashlib
+    import hmac as _hmac
+    return _hmac.new(secret.encode(), nonce.encode(),
+                     hashlib.sha256).hexdigest()
+
+
+def _const_eq(a: str, b: str) -> bool:
+    import hmac as _hmac
+    return _hmac.compare_digest(str(a), str(b))
+
+
 class AsyncTaskQueue:
     """The sim's DeterministicTaskQueue API over a real asyncio loop."""
 
@@ -64,12 +76,21 @@ class TcpTransport:
 
     def __init__(self, node_id: str, host: str, port: int,
                  peers: Dict[str, Tuple[str, int]],
-                 loop: asyncio.AbstractEventLoop):
+                 loop: asyncio.AbstractEventLoop,
+                 shared_secret: Optional[str] = None,
+                 ssl_server_ctx=None, ssl_client_ctx=None):
         self.node_id = node_id
         self.host = host
         self.port = port
         self.peers = dict(peers)              # node_id -> (host, port)
         self.loop = loop
+        #: cluster shared secret: inbound connections must answer an
+        #: HMAC challenge before any frame is accepted (reference: the
+        #: security plugin's transport interceptor / keystore secret —
+        #: `xpack.security.transport.*`). None → open transport.
+        self.shared_secret = shared_secret
+        self.ssl_server_ctx = ssl_server_ctx
+        self.ssl_client_ctx = ssl_client_ctx
         self._handlers: Dict[str, Callable] = {}
         self._conns: Dict[str, Tuple[asyncio.StreamReader,
                                      asyncio.StreamWriter]] = {}
@@ -83,7 +104,7 @@ class TcpTransport:
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._serve, self.host, self.port)
+            self._serve, self.host, self.port, ssl=self.ssl_server_ctx)
 
     async def stop(self) -> None:
         self.closed = True
@@ -172,7 +193,22 @@ class TcpTransport:
                 return conn[1]
             host, port = self.peers[dst]
             reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(host, port), timeout=1.0)
+                asyncio.open_connection(host, port,
+                                        ssl=self.ssl_client_ctx),
+                timeout=2.0 if self.ssl_client_ctx else 1.0)
+            if self.shared_secret is not None:
+                # challenge-response before any frames flow
+                ch = await asyncio.wait_for(self._read_frame(reader),
+                                            timeout=2.0)
+                if not ch or ch.get("t") != "challenge":
+                    writer.close()
+                    raise ConnectionError(
+                        f"no auth challenge from [{dst}]")
+                mac = _hmac_hex(self.shared_secret, ch.get("nonce", ""))
+                frame = json.dumps({"t": "hello", "src": self.node_id,
+                                    "mac": mac}).encode()
+                writer.write(_LEN.pack(len(frame)) + frame)
+                await writer.drain()
             self._conns[dst] = (reader, writer)
             self.loop.create_task(self._read_responses(dst, reader))
             return writer
@@ -219,6 +255,21 @@ class TcpTransport:
         # and publications sharing the connection
         write_lock = asyncio.Lock()
         try:
+            if self.shared_secret is not None:
+                import secrets as _secrets
+                nonce = _secrets.token_hex(16)
+                frame = json.dumps({"t": "challenge",
+                                    "nonce": nonce}).encode()
+                writer.write(_LEN.pack(len(frame)) + frame)
+                await writer.drain()
+                hello = await asyncio.wait_for(self._read_frame(reader),
+                                               timeout=5.0)
+                want = _hmac_hex(self.shared_secret, nonce)
+                if not hello or hello.get("t") != "hello" or \
+                        not _const_eq(hello.get("mac", ""), want):
+                    # un-keyed peer: drop before any frame is processed
+                    writer.close()
+                    return
             while True:
                 msg = await self._read_frame(reader)
                 if msg is None:

@@ -204,3 +204,73 @@ def test_node_death_promotes_replicas_no_acked_loss(cluster):
     client.refresh("ledger")
     res = client.search("ledger", {"query": {"match_all": {}}, "size": 100})
     assert res["total"] == 40
+
+
+def test_adaptive_replica_selection_spreads_reads(tmp_path):
+    """With replicas, search routing ranks copies by observed EWMA
+    response time: a slow primary's shard moves to a replica copy
+    (OperationRouting.java:42 + ResponseCollectorService)."""
+    import time as _t
+
+    base = 29740
+    peers = {f"n{i}": ("127.0.0.1", base + i) for i in range(3)}
+    nodes = [ClusterNode(f"n{i}", "127.0.0.1", base + i, peers,
+                         str(tmp_path / f"n{i}"), seed=i)
+             for i in range(3)]
+    try:
+        deadline = _t.monotonic() + 20.0
+        leader = None
+        while leader is None and _t.monotonic() < deadline:
+            ls = [n for n in nodes if n.coordinator.mode == "LEADER"]
+            if len(ls) == 1:
+                leader = ls[0]
+            _t.sleep(0.05)
+        assert leader is not None
+        front = nodes[(nodes.index(leader) + 1) % 3]
+        front.create_index("r", num_shards=1, num_replicas=2)
+        import json as _json
+        st, _ct, out = front.rest.handle(
+            "PUT", "/r/_doc/1", "refresh=true",
+            _json.dumps({"v": 1}).encode())
+        assert st in (200, 201), out
+        # wait until the replicas are placed and in sync
+        deadline = _t.monotonic() + 10.0
+        table = None
+        while _t.monotonic() < deadline:
+            st_ = front.applied_state
+            table = (st_.data.get("routing", {}) or {}).get("r")
+            if table and len(table["0"].get("replicas", [])) == 2:
+                break
+            _t.sleep(0.05)
+        assert table and len(table["0"]["replicas"]) == 2, table
+        primary = table["0"]["primary"]
+        # poison the primary's EWMA: the coordinator should now rank a
+        # replica copy first
+        front._ars_observe(primary, 5.0)
+        for other in peers:
+            if other != primary:
+                front._ars_observe(other, 0.001)
+        chosen = []
+        body = {"query": {"match_all": {}}}
+        # run a few searches; record which node got the shard
+        for _ in range(4):
+            by = {}
+            live = front.live_nodes()
+            entry = table["0"]
+            copies = [entry["primary"]] + [r for r in entry["replicas"]
+                                           if r in live]
+            best = min(copies, key=lambda n: (front._ars_rank(n), 0))
+            chosen.append(best)
+            r = front.search("r", body)
+            assert r["total"] == 1
+        assert all(c != primary for c in chosen), (chosen, primary)
+        # stats section populated
+        stats = front.adaptive_selection_stats()
+        assert stats[primary]["outgoing_searches"] >= 1
+        assert stats[primary]["avg_response_time_ns"] > 0
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass

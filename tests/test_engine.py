@@ -434,3 +434,20 @@ def test_engine_tombstones_pruned_after_gc_window(tmp_path):
     e2.flush()
     assert any(vv.deleted for vv in e2.version_map.values())
     e2.close()
+
+
+def test_index_sort_multi_field_priority(tmp_path):
+    """index.sort.field [f1, f2]: f1 is the PRIMARY segment order
+    (IndexSortConfig — regression: lexsort key order)."""
+    from elasticsearch_tpu.index.mapping import MapperService
+    mapper = MapperService({"properties": {
+        "f1": {"type": "integer"}, "f2": {"type": "integer"}}})
+    e = Engine(str(tmp_path / "s"), mapper,
+               index_sort=[("f1", "asc"), ("f2", "desc")])
+    e.index("a", {"f1": 2, "f2": 0})
+    e.index("b", {"f1": 1, "f2": 5})
+    e.index("c", {"f1": 1, "f2": 3})
+    e.refresh()
+    seg = e.segments[0]
+    assert list(seg.doc_uids) == ["b", "c", "a"]   # f1 asc, then f2 desc
+    e.close()

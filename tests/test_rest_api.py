@@ -454,3 +454,28 @@ def test_internal_copy_write_block_bypass_is_thread_local(api):
         t.start()
         t.join()
     assert other_thread_result == {"blocked": True}
+
+
+def test_shard_request_cache_hits_and_invalidation(api):
+    """Repeated identical size=0 searches hit the cache; a refresh with
+    new docs invalidates (IndicesRequestCache.java semantics)."""
+    req(api, "PUT", "/rc", None)
+    req(api, "PUT", "/rc/_doc/1", {"tag": "a"})
+    req(api, "POST", "/rc/_refresh")
+    body = {"size": 0, "query": {"match_all": {}},
+            "aggs": {"t": {"terms": {"field": "tag.keyword"}}}}
+    st, out1 = req(api, "POST", "/rc/_search", body)
+    svc = api.indices.get("rc")
+    assert svc.request_cache_stats["miss_count"] == 1
+    st, out2 = req(api, "POST", "/rc/_search", body)
+    assert svc.request_cache_stats["hit_count"] == 1
+    assert out2["aggregations"] == out1["aggregations"]
+    # new data → new segment signature → recompute, counts stay honest
+    req(api, "PUT", "/rc/_doc/2", {"tag": "b"})
+    req(api, "POST", "/rc/_refresh")
+    st, out3 = req(api, "POST", "/rc/_search", body)
+    assert svc.request_cache_stats["miss_count"] == 2
+    assert len(out3["aggregations"]["t"]["buckets"]) == 2
+    # size>0 requests are not cached unless ?request_cache=true
+    st, _ = req(api, "POST", "/rc/_search", {"query": {"match_all": {}}})
+    assert svc.request_cache_stats["miss_count"] == 2

@@ -1,0 +1,227 @@
+"""Painless-lite engine + ScriptService (cache/rate-limit/stats) +
+script contexts: scripted_metric agg, script_fields, update scripts,
+ingest scripts. Reference: modules/lang-painless + script/ScriptService."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+from elasticsearch_tpu.script import PainlessError, compile_painless
+from elasticsearch_tpu.script.painless_lite import DocAccessor
+from elasticsearch_tpu.script.service import ScriptService
+
+
+def run(src, env=None):
+    return compile_painless(src).run(env or {})
+
+
+# -- language ----------------------------------------------------------------
+
+def test_statements_loops_and_values():
+    assert run("int x = 2; x += 3; return x * 2") == 10
+    assert run("def l = []; for (int i = 0; i < 4; i++) { l.add(i) } "
+               "return l") == [0, 1, 2, 3]
+    assert run("def m = ['a': 1, 'b': 2]; def s = 0; "
+               "for (k in m.keySet()) { s += m.get(k) } return s") == 3
+    assert run("def x = 5; if (x > 3) { return 'big' } else "
+               "{ return 'small' }") == "big"
+    assert run("return 1 < 2 && 'a' != 'b' ? [1, 2].size() : -1") == 2
+    assert run("return Math.floor(Math.sqrt(50))") == 7
+    assert run("String s = 'Hello World'; "
+               "return s.toLowerCase().split(' ')[1]") == "world"
+    assert run("return 7 / 2") == 3              # java int division
+    assert run("return 7.0 / 2") == 3.5
+    assert run("return 'n=' + 3") == "n=3"       # string concat
+
+
+def test_sandbox_rejects_and_budgets():
+    with pytest.raises(PainlessError):
+        run("import os")          # no import machinery: unknown variable
+    with pytest.raises(PainlessError):
+        run("x.__class__")
+    with pytest.raises(PainlessError):
+        run("while (true) { }")
+    with pytest.raises(PainlessError):
+        run("unknownVar + 1")
+    with pytest.raises(PainlessError):
+        run("new File('x')")
+
+
+def test_doc_values_accessor():
+    doc = DocAccessor(lambda f: {"price": [10.5], "tags": ["a", "b"],
+                                 "missing": []}.get(f, []))
+    assert run("return doc['price'].value * 2", {"doc": doc}) == 21.0
+    assert run("return doc['tags'].size()", {"doc": doc}) == 2
+    assert run("return doc['missing'].size() == 0 ? -1 : "
+               "doc['missing'].value", {"doc": doc}) == -1
+    with pytest.raises(PainlessError):
+        run("return doc['missing'].value", {"doc": doc})
+
+
+def test_service_cache_and_rate_limit():
+    clock = [0.0]
+    svc = ScriptService(rate_max=3, rate_window_s=60.0,
+                        clock=lambda: clock[0])
+    for i in range(3):
+        svc.run(f"return {i}", {})
+    with pytest.raises(Exception) as ei:
+        svc.run("return 99", {})
+    assert "compilations" in str(ei.value) or "max" in str(ei.value)
+    assert svc.stats_doc()["compilation_limit_triggered"] == 1
+    # cached scripts keep running under the limit
+    assert svc.run("return 2", {}) == 2
+    # time refills the bucket
+    clock[0] += 60.0
+    assert svc.run("return 99", {}) == 99
+    assert svc.stats_doc()["compilations"] == 4
+
+
+# -- REST contexts -----------------------------------------------------------
+
+@pytest.fixture()
+def api(tmp_path):
+    return RestAPI(IndicesService(str(tmp_path)))
+
+
+def req(api, method, path, body=None, query=""):
+    raw = json.dumps(body).encode() if body is not None else b""
+    st, _ct, payload = api.handle(method, path, query, raw)
+    return st, json.loads(payload)
+
+
+def test_scripted_metric_profit(api):
+    """The canonical reference example: summed profit across shards
+    (metrics/ScriptedMetricAggregator.java docs)."""
+    req(api, "PUT", "/sales", {"settings": {"index":
+                                            {"number_of_shards": 2}}})
+    docs = [("sale", 80), ("cost", 10), ("sale", 130), ("cost", 30)]
+    for i, (t, a) in enumerate(docs):
+        req(api, "PUT", f"/sales/_doc/{i}", {"type": t, "amount": a})
+    req(api, "POST", "/sales/_refresh")
+    st, out = req(api, "POST", "/sales/_search", {
+        "size": 0,
+        "aggs": {"profit": {"scripted_metric": {
+            "init_script": "state.transactions = []",
+            "map_script": "state.transactions.add("
+                          "doc['type'].value == 'sale' ? "
+                          "doc['amount'].value : -1 * doc['amount'].value)",
+            "combine_script": "double p = 0; "
+                              "for (t in state.transactions) { p += t } "
+                              "return p",
+            "reduce_script": "double p = 0; for (a in states) { p += a } "
+                             "return p",
+        }}}})
+    assert st == 200, out
+    assert out["aggregations"]["profit"]["value"] == 170.0
+
+
+def test_scripted_metric_under_terms(api):
+    req(api, "PUT", "/t2", None)
+    for i, (g, v) in enumerate([("a", 1), ("a", 2), ("b", 10)]):
+        req(api, "PUT", f"/t2/_doc/{i}",
+            {"g": g, "v": v})
+    req(api, "POST", "/t2/_refresh")
+    st, out = req(api, "POST", "/t2/_search", {
+        "size": 0,
+        "aggs": {"groups": {
+            "terms": {"field": "g.keyword"},
+            "aggs": {"total": {"scripted_metric": {
+                "init_script": "state.s = 0",
+                "map_script": "state.s += doc['v'].value",
+                "combine_script": "return state.s",
+                "reduce_script":
+                    "double t = 0; for (s in states) { t += s } return t",
+            }}}}}})
+    assert st == 200, out
+    buckets = {b["key"]: b["total"]["value"]
+               for b in out["aggregations"]["groups"]["buckets"]}
+    assert buckets == {"a": 3.0, "b": 10.0}
+
+
+def test_script_fields(api):
+    req(api, "PUT", "/sf", None)
+    req(api, "PUT", "/sf/_doc/1", {"price": 10, "qty": 3})
+    req(api, "POST", "/sf/_refresh")
+    st, out = req(api, "POST", "/sf/_search", {
+        "query": {"match_all": {}},
+        "script_fields": {
+            "total": {"script": {
+                "source": "doc['price'].value * doc['qty'].value"}},
+            "labeled": {"script": {
+                "source": "params.prefix + doc['qty'].value",
+                "params": {"prefix": "qty-"}}},
+        }})
+    assert st == 200, out
+    f = out["hits"]["hits"][0]["fields"]
+    assert f["total"] == [30]
+    assert f["labeled"] == ["qty-3"]
+
+
+def test_update_script_rich_statements(api):
+    req(api, "PUT", "/u", None)
+    req(api, "PUT", "/u/_doc/1", {"counter": 1, "tags": ["x"]})
+    st, out = req(api, "POST", "/u/_update/1", {"script": {
+        "source": "ctx._source.counter += params.n; "
+                  "if (ctx._source.counter > 2) "
+                  "{ ctx._source.tags.add('big') }",
+        "params": {"n": 5}}})
+    assert st == 200, out
+    _, doc = req(api, "GET", "/u/_doc/1")
+    assert doc["_source"]["counter"] == 6
+    assert doc["_source"]["tags"] == ["x", "big"]
+
+
+def test_ingest_script_processor_statements(api):
+    req(api, "PUT", "/_ingest/pipeline/calc", {
+        "processors": [{"script": {"source":
+                                   "ctx.total = 0; "
+                                   "for (v in ctx.values) "
+                                   "{ ctx.total += v } "
+                                   "ctx.grade = ctx.total > 10 ? "
+                                   "'high' : 'low'"}}]})
+    st, out = req(api, "PUT", "/p1/_doc/1", {"values": [3, 4, 5]},
+                  query="pipeline=calc")
+    assert st in (200, 201), out
+    _, doc = req(api, "GET", "/p1/_doc/1")
+    assert doc["_source"]["total"] == 12
+    assert doc["_source"]["grade"] == "high"
+
+
+def test_nodes_stats_reports_live_script_counts(api):
+    from elasticsearch_tpu.script.service import DEFAULT
+    before = DEFAULT.stats_doc()["compilations"]
+    req(api, "PUT", "/s1", None)
+    req(api, "PUT", "/s1/_doc/1", {"v": 1})
+    req(api, "POST", "/s1/_refresh")
+    req(api, "POST", "/s1/_search", {
+        "script_fields": {"x": {"script": {
+            "source": "doc['v'].value + 41.5"}}}})
+    st, out = req(api, "GET", "/_nodes/stats")
+    node = next(iter(out["nodes"].values()))
+    assert node["script"]["compilations"] >= before + 1
+
+
+def test_update_script_ctx_op_none_and_delete(api):
+    req(api, "PUT", "/ops", None)
+    req(api, "PUT", "/ops/_doc/1", {"n": 1})
+    st, out = req(api, "POST", "/ops/_update/1", {"script": {
+        "source": "if (ctx._source.n < 5) { ctx.op = 'none' }"}})
+    assert st == 200 and out["result"] == "noop", out
+    st, out = req(api, "POST", "/ops/_update/1", {"script": {
+        "source": "ctx.op = 'delete'"}})
+    assert st == 200 and out["result"] == "deleted", out
+    st, _ = req(api, "GET", "/ops/_doc/1")
+    assert st == 404
+
+
+def test_update_with_stored_script(api):
+    req(api, "PUT", "/_scripts/bump", {"script": {
+        "lang": "painless", "source": "ctx._source.n += params.by"}})
+    req(api, "PUT", "/st/_doc/1", {"n": 10})
+    st, out = req(api, "POST", "/st/_update/1", {
+        "script": {"id": "bump", "params": {"by": 7}}})
+    assert st == 200, out
+    _, doc = req(api, "GET", "/st/_doc/1")
+    assert doc["_source"]["n"] == 17

@@ -1,0 +1,210 @@
+"""Security layer: API keys on REST (401 anonymous when enabled), the
+transport shared-secret handshake (un-keyed peers rejected), TLS material.
+Reference: ``x-pack/plugin/security/`` — ApiKeyService, transport
+interceptors. Security is OFF by default (conformance corpus runs open)."""
+
+import base64
+import json
+import os
+import tempfile
+import time
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+from elasticsearch_tpu.security import SecurityService, make_self_signed_tls
+
+
+def req(api, method, path, body=None, query="", headers=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    st, _ct, payload = api.handle(method, path, query, raw, headers=headers)
+    try:
+        return st, json.loads(payload)
+    except ValueError:
+        return st, payload
+
+
+@pytest.fixture()
+def open_api(tmp_path):
+    return RestAPI(IndicesService(str(tmp_path)))
+
+
+def test_security_disabled_by_default_everything_open(open_api):
+    st, _ = req(open_api, "PUT", "/idx", None)
+    assert st == 200
+    st, out = req(open_api, "GET", "/_security/_authenticate")
+    assert st == 200 and out["username"] == "_anonymous"
+
+
+def test_api_key_lifecycle_and_auth(tmp_path):
+    api = RestAPI(IndicesService(str(tmp_path)))
+    # create a key while still open (bootstrap), then enable security
+    st, key = req(api, "POST", "/_security/api_key", {"name": "ops"})
+    assert st == 200 and key["api_key"] and key["encoded"]
+    api.security.enabled = True
+
+    # anonymous → 401 security_exception with WWW-Authenticate header
+    st, out = req(api, "GET", "/idx2/_search")
+    assert st == 401
+    assert out["error"]["type"] == "security_exception"
+    assert "WWW-Authenticate" in out["error"]["header"]
+
+    # bad credentials → 401
+    bogus = base64.b64encode(b"nope:nope").decode()
+    st, out = req(api, "PUT", "/idx2", None,
+                  headers={"authorization": f"ApiKey {bogus}"})
+    assert st == 401
+
+    # valid key → through
+    h = {"authorization": f"ApiKey {key['encoded']}"}
+    st, _ = req(api, "PUT", "/idx2", None, headers=h)
+    assert st == 200
+    st, out = req(api, "GET", "/_security/_authenticate", headers=h)
+    assert out["username"] == "ops"
+    assert out["api_key"]["id"] == key["id"]
+
+    # invalidate → the same key stops working
+    st, out = req(api, "DELETE", "/_security/api_key",
+                  {"ids": [key["id"]]}, headers=h)
+    assert out["invalidated_api_keys"] == [key["id"]]
+    st, _ = req(api, "GET", "/idx2", headers=h)
+    assert st == 401
+
+
+def test_api_key_storage_holds_hashes_not_secrets(tmp_path):
+    path = os.path.join(str(tmp_path), "keys.json")
+    svc = SecurityService(enabled=True, persist_path=path)
+    out = svc.create_key("deploy")
+    on_disk = open(path).read()
+    assert out["api_key"] not in on_disk          # never the cleartext
+    assert svc.verify(out["id"], out["api_key"]) == "deploy"
+    assert svc.verify(out["id"], "wrong") is None
+    # a fresh service over the same file still verifies
+    svc2 = SecurityService(enabled=True, persist_path=path)
+    assert svc2.verify(out["id"], out["api_key"]) == "deploy"
+
+
+def test_api_key_expiration(tmp_path):
+    svc = SecurityService(enabled=True)
+    out = svc.create_key("short", expiration_ms=1)
+    time.sleep(0.01)
+    assert svc.verify(out["id"], out["api_key"]) is None
+
+
+def test_transport_shared_secret_rejects_unkeyed_peer():
+    """A peer without the secret cannot execute RPCs; keyed peers can."""
+    from elasticsearch_tpu.transport.tcp import NodeLoop, TcpTransport
+
+    port_a, port_b, port_c = 29660, 29661, 29662
+    peers = {"a": ("127.0.0.1", port_a), "b": ("127.0.0.1", port_b),
+             "c": ("127.0.0.1", port_c)}
+    loops = [NodeLoop() for _ in range(3)]
+    a = TcpTransport("a", "127.0.0.1", port_a, peers, loops[0].loop,
+                     shared_secret="s3cret")
+    b = TcpTransport("b", "127.0.0.1", port_b, peers, loops[1].loop,
+                     shared_secret="s3cret")
+    c = TcpTransport("c", "127.0.0.1", port_c, peers, loops[2].loop,
+                     shared_secret="WRONG")
+    for t, nl in zip((a, b, c), loops):
+        nl.call(t.start())
+    a.register("a", "ping", lambda src, payload: {"pong": True})
+
+    import threading
+    got: dict = {}
+
+    def call(transport, tag):
+        done = threading.Event()
+
+        def ok(resp):
+            got[tag] = resp
+            done.set()
+
+        def err(e):
+            got[tag] = e
+            done.set()
+        transport.send(transport.node_id, "a", "ping", {},
+                       on_response=ok, on_failure=err, timeout=3.0)
+        done.wait(5.0)
+
+    call(b, "keyed")        # correct secret → served
+    call(c, "unkeyed")      # wrong secret → rejected/timeout
+    assert got["keyed"] == {"pong": True}
+    assert isinstance(got["unkeyed"], Exception)
+    for t, nl in zip((a, b, c), loops):
+        try:
+            nl.call(t.stop())
+        except Exception:
+            pass
+        nl.stop()
+
+
+def test_tls_material_and_handshake(tmp_path):
+    """Self-signed TLS contexts wire through the transport: a TLS server
+    + trusting client complete an RPC."""
+    from elasticsearch_tpu.transport.tcp import NodeLoop, TcpTransport
+    srv_ctx, cli_ctx = make_self_signed_tls(str(tmp_path))
+    port_a, port_b = 29670, 29671
+    peers = {"a": ("127.0.0.1", port_a), "b": ("127.0.0.1", port_b)}
+    loops = [NodeLoop(), NodeLoop()]
+    a = TcpTransport("a", "127.0.0.1", port_a, peers, loops[0].loop,
+                     ssl_server_ctx=srv_ctx, ssl_client_ctx=cli_ctx)
+    b = TcpTransport("b", "127.0.0.1", port_b, peers, loops[1].loop,
+                     ssl_server_ctx=srv_ctx, ssl_client_ctx=cli_ctx)
+    for t, nl in zip((a, b), loops):
+        nl.call(t.start())
+    a.register("a", "echo", lambda src, payload: {"echo": payload})
+
+    import threading
+    done = threading.Event()
+    box: dict = {}
+    b.send("b", "a", "echo", {"x": 1},
+           on_response=lambda r: (box.update(r=r), done.set()),
+           on_failure=lambda e: (box.update(e=e), done.set()),
+           timeout=5.0)
+    assert done.wait(8.0)
+    assert box.get("r") == {"echo": {"x": 1}}, box
+    for t, nl in zip((a, b), loops):
+        try:
+            nl.call(t.stop())
+        except Exception:
+            pass
+        nl.stop()
+
+
+def test_cluster_node_with_security_enabled(tmp_path):
+    """3-node cluster with security: anonymous REST 401s at the front,
+    a valid API key passes; nodes share the transport secret."""
+    from elasticsearch_tpu.node.cluster_node import ClusterNode
+    base = 29680
+    peers = {f"n{i}": ("127.0.0.1", base + i) for i in range(3)}
+    sec = SecurityService(enabled=True)
+    key = sec.create_key("admin")
+    nodes = [ClusterNode(f"n{i}", "127.0.0.1", base + i, peers,
+                         os.path.join(str(tmp_path), f"n{i}"), seed=i,
+                         shared_secret="cluster-secret", security=sec)
+             for i in range(3)]
+    deadline = time.monotonic() + 20.0
+    leader = None
+    while leader is None and time.monotonic() < deadline:
+        ls = [n for n in nodes if n.coordinator.mode == "LEADER"]
+        if len(ls) == 1:
+            leader = ls[0]
+        time.sleep(0.05)
+    assert leader is not None
+    front = nodes[(nodes.index(leader) + 1) % 3].rest
+    st, _ct, out = front.handle("PUT", "/secured", "", b"")
+    assert st == 401, out
+    h = {"authorization": f"ApiKey {key['encoded']}"}
+    st, _ct, out = front.handle("PUT", "/secured", "", b"", headers=h)
+    assert st == 200, out
+    st, _ct, out = front.handle(
+        "PUT", "/secured/_doc/1", "refresh=true",
+        json.dumps({"x": 1}).encode(), headers=h)
+    assert st in (200, 201), out
+    st, _ct, out = front.handle(
+        "POST", "/secured/_search", "",
+        json.dumps({"query": {"match_all": {}}}).encode(), headers=h)
+    assert json.loads(out)["hits"]["total"]["value"] == 1
+    for n in nodes:
+        n.stop()

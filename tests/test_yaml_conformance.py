@@ -74,8 +74,9 @@ ALLOWLIST = [
     "update/22_doc_as_upsert.yml",
 ]
 
-#: corpus-wide pass floor (ratchet: raise when conformance climbs)
-SWEEP_FLOOR = 1040
+#: corpus-wide pass floor (ratchet: raise when conformance climbs;
+#: round 5 measured 1121/1127 before the final fixes)
+SWEEP_FLOOR = 1115
 
 
 def test_allowlisted_suites_pass_completely():
