@@ -56,16 +56,15 @@ class IndexingPressure:
                 self.current_bytes -= bytes_
 
     def stats_doc(self) -> dict:
+        def shape(n: int) -> dict:
+            return {"combined_coordinating_and_primary_in_bytes": n,
+                    "coordinating_in_bytes": n, "primary_in_bytes": 0,
+                    "replica_in_bytes": 0, "all_in_bytes": n}
         return {"memory": {
-            "current": {"combined_coordinating_and_primary_in_bytes":
-                        self.current_bytes,
-                        "all_in_bytes": self.current_bytes},
-            "total": {"combined_coordinating_and_primary_in_bytes":
-                      self.total_bytes,
-                      "all_in_bytes": self.total_bytes,
-                      "coordinating_rejections": self.rejections,
-                      "primary_rejections": 0,
-                      "replica_rejections": 0},
+            "current": shape(self.current_bytes),
+            "total": dict(shape(self.total_bytes),
+                          coordinating_rejections=self.rejections,
+                          primary_rejections=0, replica_rejections=0),
             "limit_in_bytes": self.limit_bytes,
         }}
 

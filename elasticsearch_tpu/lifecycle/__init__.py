@@ -1,0 +1,21 @@
+"""Data streams + index lifecycle management (ILM-lite).
+
+Reference: ``cluster/metadata/MetadataCreateDataStreamService.java:54``
+(streams over generational backing indices with an @timestamp contract)
+and ``x-pack/plugin/ilm/.../IndexLifecycleService.java:52`` (policy state
+machine driving rollover/delete). Re-design notes:
+
+- a data stream is registry state on the IndicesService: name →
+  {generation, indices:[backing names], template}; backing indices are
+  ordinary indices named ``.ds-<stream>-<NNNNNN>`` whose resolution rides
+  the existing expression resolver (stream name → its backing list, like
+  an alias with a write index = the latest generation);
+- ILM policies evaluate on an injectable clock (``tick(now)``), so tests
+  drive phase transitions deterministically instead of sleeping — the
+  reference runs the same logic off a scheduler thread.
+"""
+
+from .datastreams import DataStreamService
+from .ilm import IlmService
+
+__all__ = ["DataStreamService", "IlmService"]

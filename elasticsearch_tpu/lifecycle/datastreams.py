@@ -1,0 +1,170 @@
+"""Data streams: generational backing indices behind one write surface.
+
+Reference: ``cluster/metadata/MetadataCreateDataStreamService.java:54``,
+``cluster/metadata/DataStream.java`` — a stream requires a matching
+composable template carrying ``data_stream: {}``; documents land in the
+current write index (the highest generation); rollover mints
+``.ds-<name>-<generation+1>``; reads resolve to every backing index.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..common.errors import (IllegalArgumentError, IndexNotFoundError,
+                             ResourceAlreadyExistsError,
+                             ResourceNotFoundError)
+
+
+def backing_name(stream: str, generation: int) -> str:
+    return f".ds-{stream}-{generation:06d}"
+
+
+class DataStreamService:
+    """Stream registry + lifecycle, operating through the owning
+    RestAPI's indices service (creation runs the full index machinery:
+    templates, mappings, allocation on the cluster tier)."""
+
+    def __init__(self, api):
+        self.api = api
+        #: name -> {"generation": int, "indices": [names], "template": str}
+        self.streams: Dict[str, dict] = {}
+
+    # -- template matching ----------------------------------------------
+
+    def matching_template(self, name: str) -> Optional[str]:
+        """Highest-priority composable template with ``data_stream`` whose
+        patterns match ``name`` (reference: the stream's defining
+        template)."""
+        import fnmatch
+        best = None
+        for tname, t in self.api.templates.items():
+            if "data_stream" not in t:
+                continue
+            pats = t.get("index_patterns") or []
+            if any(fnmatch.fnmatchcase(name, p) for p in pats):
+                pr = int(t.get("priority", 0))
+                if best is None or pr > best[0]:
+                    best = (pr, tname)
+        return best[1] if best else None
+
+    # -- CRUD ------------------------------------------------------------
+
+    def create(self, name: str) -> dict:
+        if name in self.streams:
+            raise ResourceAlreadyExistsError(
+                f"data_stream [{name}] already exists")
+        tpl = self.matching_template(name)
+        if tpl is None:
+            raise IllegalArgumentError(
+                f"no matching index template found for data stream "
+                f"[{name}]")
+        self.streams[name] = {"generation": 0, "indices": [],
+                              "template": tpl}
+        self._roll(name)
+        return {"acknowledged": True}
+
+    def _roll(self, name: str) -> str:
+        """Mint the next backing index and make it the write index."""
+        st = self.streams[name]
+        st["generation"] += 1
+        backing = backing_name(name, st["generation"])
+        # the template's mappings/settings apply through the normal
+        # create path (templates match .ds-* only via the stream's own
+        # patterns, so merge the defining template explicitly)
+        t = self.api.templates.get(st["template"]) or {}
+        body_tpl = t.get("template") or {}
+        mappings = dict(body_tpl.get("mappings") or {})
+        props = dict((mappings.get("properties") or {}))
+        props.setdefault("@timestamp", {"type": "date"})
+        mappings["properties"] = props
+        self.api.indices.create_index(
+            backing, body_tpl.get("settings") or {}, mappings)
+        st["indices"].append(backing)
+        self._after_meta_change()
+        return backing
+
+    def delete(self, pattern: str) -> dict:
+        import fnmatch
+        hit = [n for n in self.streams
+               if fnmatch.fnmatchcase(n, pattern)] if any(
+                   c in pattern for c in "*?") else (
+                       [pattern] if pattern in self.streams else [])
+        if not hit and not any(c in pattern for c in "*?"):
+            raise ResourceNotFoundError(
+                f"data_stream matching [{pattern}] not found")
+        for n in hit:
+            st = self.streams.pop(n)
+            for idx in st["indices"]:
+                try:
+                    self.api.indices.delete_index(idx)
+                except IndexNotFoundError:
+                    pass
+        self._after_meta_change()
+        return {"acknowledged": True}
+
+    def get(self, pattern: Optional[str]) -> dict:
+        import fnmatch
+        names = sorted(self.streams) if not pattern or pattern in (
+            "*", "_all") else [
+            n for n in sorted(self.streams)
+            if fnmatch.fnmatchcase(n, pattern)] if any(
+                c in pattern for c in "*?") else (
+                    [pattern] if pattern in self.streams else None)
+        if names is None:
+            raise ResourceNotFoundError(
+                f"data_stream matching [{pattern}] not found")
+        out = []
+        for n in names:
+            st = self.streams[n]
+            out.append({
+                "name": n,
+                "timestamp_field": {"name": "@timestamp"},
+                "indices": [
+                    {"index_name": idx,
+                     "index_uuid": getattr(
+                         self.api.indices.indices.get(idx), "uuid", "")}
+                    for idx in st["indices"]],
+                "generation": st["generation"],
+                "status": "GREEN",
+                "template": st["template"],
+            })
+        return {"data_streams": out}
+
+    # -- write/read routing ---------------------------------------------
+
+    def write_index(self, name: str) -> Optional[str]:
+        st = self.streams.get(name)
+        return st["indices"][-1] if st and st["indices"] else None
+
+    def backing_indices(self, name: str) -> Optional[List[str]]:
+        st = self.streams.get(name)
+        return list(st["indices"]) if st else None
+
+    def rollover(self, name: str) -> dict:
+        if name not in self.streams:
+            raise ResourceNotFoundError(
+                f"data_stream [{name}] not found")
+        old = self.write_index(name)
+        new = self._roll(name)
+        return {"acknowledged": True, "rolled_over": True,
+                "old_index": old, "new_index": new,
+                "dry_run": False, "shards_acknowledged": True,
+                "conditions": {}}
+
+    def auto_create(self, name: str) -> Optional[str]:
+        """First write to an unknown name whose matching template is a
+        data-stream template: create the stream, return its write index
+        (reference: auto-create flows through the same metadata
+        service)."""
+        if name in self.streams:
+            return self.write_index(name)
+        if self.matching_template(name) is None:
+            return None
+        self.create(name)
+        return self.write_index(name)
+
+    def _after_meta_change(self) -> None:
+        """Expression resolution consults the registry through the
+        indices service (streams resolve like aliases)."""
+        self.api.indices.data_streams_provider = self.backing_indices

@@ -1,0 +1,115 @@
+"""ILM-lite: a policy state machine over hot-rollover and delete phases.
+
+Reference: ``x-pack/plugin/ilm/.../IndexLifecycleService.java:52`` +
+``TimeseriesLifecycleType`` (phase ordering). The two load-bearing phases
+are implemented — hot (rollover on max_age/max_docs) and delete
+(min_age) — driven by an injectable clock through ``tick(now_ms)`` so
+tests step time instead of sleeping; the reference runs the identical
+evaluation from a scheduler every ``indices.lifecycle.poll_interval``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..common.errors import ResourceNotFoundError
+from ..common.settings import parse_time_millis
+
+
+class IlmService:
+    def __init__(self, api):
+        self.api = api
+        self.policies: Dict[str, dict] = {}
+
+    # -- policy CRUD -----------------------------------------------------
+
+    def put_policy(self, name: str, policy: dict) -> dict:
+        self.policies[name] = policy or {}
+        return {"acknowledged": True}
+
+    def get_policy(self, name: Optional[str]) -> dict:
+        if name and name not in self.policies:
+            raise ResourceNotFoundError(
+                f"Lifecycle policy not found: [{name}]")
+        names = [name] if name else sorted(self.policies)
+        return {n: {"policy": self.policies[n], "version": 1}
+                for n in names}
+
+    def delete_policy(self, name: str) -> dict:
+        if name not in self.policies:
+            raise ResourceNotFoundError(
+                f"Lifecycle policy not found: [{name}]")
+        del self.policies[name]
+        return {"acknowledged": True}
+
+    # -- evaluation ------------------------------------------------------
+
+    def _policy_of(self, svc) -> Optional[dict]:
+        pname = svc.settings.get("index.lifecycle.name")
+        return self.policies.get(pname) if pname else None
+
+    def tick(self, now_ms: Optional[int] = None) -> dict:
+        """One evaluation round: apply hot-phase rollover conditions and
+        delete-phase expiry to every policy-managed index. Returns what
+        happened (for observability and tests)."""
+        now_ms = int(time.time() * 1000) if now_ms is None else int(now_ms)
+        rolled, deleted = [], []
+        for name, svc in list(self.api.indices.indices.items()):
+            policy = self._policy_of(svc)
+            if policy is None:
+                continue
+            phases = (policy.get("policy") or policy).get("phases") or {}
+            age_ms = now_ms - svc.creation_date
+            dl = phases.get("delete") or {}
+            if "delete" in (dl.get("actions") or {}):
+                min_age = parse_time_millis(dl.get("min_age", "0ms"))
+                if age_ms >= min_age:
+                    # a data stream's non-write backing index deletes;
+                    # its write index waits for the next rollover first
+                    ds = self._owning_stream(name)
+                    if ds is None or \
+                            self.api.datastreams.write_index(ds) != name:
+                        self.api.indices.delete_index(name)
+                        if ds is not None:
+                            st = self.api.datastreams.streams[ds]
+                            st["indices"].remove(name)
+                        deleted.append(name)
+                        continue
+            hot = phases.get("hot") or {}
+            ro = (hot.get("actions") or {}).get("rollover")
+            if ro:
+                ds = self._owning_stream(name)
+                if ds is not None and \
+                        self.api.datastreams.write_index(ds) == name and \
+                        self._rollover_due(svc, ro, age_ms):
+                    self.api.datastreams.rollover(ds)
+                    rolled.append(ds)
+        return {"rolled_over": rolled, "deleted": deleted}
+
+    def _owning_stream(self, index: str) -> Optional[str]:
+        for ds, st in self.api.datastreams.streams.items():
+            if index in st["indices"]:
+                return ds
+        return None
+
+    @staticmethod
+    def _rollover_due(svc, conditions: dict, age_ms: int) -> bool:
+        if "max_age" in conditions and age_ms >= parse_time_millis(
+                conditions["max_age"]):
+            return True
+        if "max_docs" in conditions:
+            docs = sum(s.doc_count for s in svc.shards)
+            if docs >= int(conditions["max_docs"]):
+                return True
+        return False
+
+    def explain(self, index: str) -> dict:
+        svc = self.api.indices.get(index)
+        pname = svc.settings.get("index.lifecycle.name")
+        out = {"index": index, "managed": pname is not None}
+        if pname:
+            out.update({"policy": pname,
+                        "age": f"{max(0, int(time.time() * 1000) - svc.creation_date) // 1000}s",
+                        "phase": "hot"})
+        return out

@@ -1156,17 +1156,19 @@ class ClusterNode:
 
     def _h_refresh(self, src, payload):
         name = payload["index"]
+        shard = payload.get("shard")         # None → every shard
         svc = self.rest.indices.indices.get(name)
         if svc is not None:
             # group wiring is async: refresh the local service's engines
             # directly so just-written not-yet-wrapped copies are covered
-            for e in svc.shards:
-                e.refresh()
+            for sid, e in enumerate(svc.shards):
+                if shard is None or sid == shard:
+                    e.refresh()
         for (iname, sid), g in self.primaries.items():
-            if iname == name:
+            if iname == name and (shard is None or sid == shard):
                 g.engine.refresh()
         for (iname, sid), r in self.replicas.items():
-            if iname == name:
+            if iname == name and (shard is None or sid == shard):
                 r.engine.refresh()
         return {"ok": True}
 

@@ -363,9 +363,12 @@ class ClusterHooks:
             seg.find_doc(payload["id"]) is not None
             for seg in g.engine.searchable_segments())}
 
-    def refresh(self, index: str) -> bool:
-        """Cluster-wide refresh of every copy of ``index``. True when the
-        index is cluster-routed (the caller's local loop is skipped)."""
+    def refresh(self, index: str, shard: Optional[int] = None) -> bool:
+        """Cluster-wide refresh of every copy of ``index`` — or of ONE
+        shard when ``shard`` is given (the scope of a doc op's
+        ``?refresh=true``: other shards' pending NRT deletes must stay
+        invisible). True when the index is cluster-routed (the caller's
+        local loop is skipped)."""
         node = self.rest.node
         st = node.applied_state
         if st is None or index not in st.data.get("routing", {}):
@@ -375,19 +378,21 @@ class ClusterHooks:
         # wrapped yet — it still holds any direct writes
         svc = self.rest.indices.indices.get(index)
         if svc is not None:
-            for e in svc.shards:
-                e.refresh()
-        for (iname, _sid), g in list(node.primaries.items()):
-            if iname == index:
+            for sid, e in enumerate(svc.shards):
+                if shard is None or sid == shard:
+                    e.refresh()
+        for (iname, sid), g in list(node.primaries.items()):
+            if iname == index and (shard is None or sid == shard):
                 g.engine.refresh()
-        for (iname, _sid), r in list(node.replicas.items()):
-            if iname == index:
+        for (iname, sid), r in list(node.replicas.items()):
+            if iname == index and (shard is None or sid == shard):
                 r.engine.refresh()
         for n in node.node_ids:
             if n == node.node_id:
                 continue
             try:
-                node.rpc(n, "shard:refresh", {"index": index}, timeout=2.0)
+                node.rpc(n, "shard:refresh",
+                         {"index": index, "shard": shard}, timeout=2.0)
             except Exception:   # noqa: BLE001 — dead nodes skip
                 pass
         return True
@@ -604,6 +609,14 @@ class ClusterRestService:
         if method == "GET" and len(segs) >= 2 and segs[0] == "_cat" \
                 and segs[1] == "segments":
             return self._cat_segments(method, path, query, body)
+        if method == "GET" and len(segs) >= 2 and segs[0] == "_cat" \
+                and segs[1] == "shards":
+            return self._cat_shards(method, path, query, body)
+        if method == "GET" and len(segs) >= 2 and segs[0] == "_cat" \
+                and segs[1] == "fielddata":
+            return self._cat_fielddata(method, path, query, body, segs)
+        if method == "GET" and segs and segs[-1] == "_segments":
+            return self._segments(method, path, query, body)
         if segs and segs[-1].split("?")[0] == "_mtermvectors":
             return self._mtermvectors(method, path, query, body)
         if segs and segs[0] == "_snapshot":
@@ -722,6 +735,24 @@ class ClusterRestService:
             wait_deadline = time.monotonic() + 10.0
             while self.applied_seq < seq and \
                     time.monotonic() < wait_deadline:
+                time.sleep(0.01)
+        segs_ = [s for s in path.split("/") if s]
+        if method == "PUT" and len(segs_) == 1 and \
+                not segs_[0].startswith("_") and \
+                resp.get("status", 500) < 300 and seq:
+            # index create: ack only once THIS node's applied routing
+            # covers the new index — otherwise an immediate write races
+            # the routing publication, falls back to the bare local
+            # engine, and orphans the doc on a shard that routes
+            # elsewhere once the table lands
+            from urllib.parse import unquote as _unq
+            iname = _unq(segs_[0])
+            wait_deadline = time.monotonic() + 10.0
+            while time.monotonic() < wait_deadline:
+                st_now = self.node.applied_state
+                if st_now is not None and iname in \
+                        st_now.data.get("routing", {}):
+                    break
                 time.sleep(0.01)
         return (resp["status"], resp.get("ct", "application/json"),
                 _unb64(resp["out"]))
@@ -984,6 +1015,9 @@ class ClusterRestService:
         the asked shards (reference: the per-shard halves of
         ``TransportIndicesStatsAction`` / ``IndicesService.stats``)."""
         index = payload["index"]
+        sections = set(payload.get("sections") or ())   # empty → all
+        def want(sec):
+            return not sections or sec in sections
         out = {}
         svc = self.indices.indices.get(index)
         for sid in payload.get("shards", []):
@@ -995,32 +1029,39 @@ class ClusterRestService:
             if engine is None:
                 continue
             store = 0
-            for root, _dirs, files in os.walk(engine.path):
-                for f in files:
-                    try:
-                        store += os.path.getsize(os.path.join(root, f))
-                    except OSError:
-                        pass
+            if want("store"):
+                for root, _dirs, files in os.walk(engine.path):
+                    for f in files:
+                        try:
+                            store += os.path.getsize(
+                                os.path.join(root, f))
+                        except OSError:
+                            pass
             segs = engine.searchable_segments()
             est = getattr(engine, "stats", {}) or {}
             # fielddata bytes of THIS engine's segments for fields the
             # owner's query path marked loaded (global-ordinals terms,
             # field sorts — mapper.fielddata_loaded)
-            fd_bytes = 0
-            loaded = getattr(svc.mapper, "fielddata_loaded", set()) \
-                if svc is not None else set()
+            fd_fields: Dict[str, int] = {}
+            loaded = (getattr(svc.mapper, "fielddata_loaded", set())
+                      if svc is not None and want("fielddata") else set())
             for seg in segs:
                 for fname, f in seg.keyword_fields.items():
                     if fname in loaded:
-                        fd_bytes += int(
+                        fd_fields[fname] = fd_fields.get(fname, 0) + int(
                             f.docs_host.nbytes + f.dv_ords_host.nbytes +
                             f.dv_docs_host.nbytes)
                 for fname, f in seg.numeric_fields.items():
                     if fname in loaded:
-                        fd_bytes += int(f.vals_host.nbytes +
-                                        f.docs_host.nbytes)
+                        fd_fields[fname] = fd_fields.get(fname, 0) + int(
+                            f.vals_host.nbytes + f.docs_host.nbytes)
+                for fname, f in seg.text_fields.items():
+                    if fname in loaded:
+                        fd_fields[fname] = fd_fields.get(fname, 0) + int(
+                            f.docs_host.nbytes + f.tf_host.nbytes)
             out[str(sid)] = {
-                "fielddata": fd_bytes,
+                "fielddata": sum(fd_fields.values()),
+                "fielddata_fields": fd_fields,
                 "docs": engine.doc_count,
                 "deleted": engine.deleted_count,
                 "store": store,
@@ -1037,7 +1078,8 @@ class ClusterRestService:
             }
         return out
 
-    def _remote_shard_stats(self, names) -> Dict[str, Dict[str, dict]]:
+    def _remote_shard_stats(self, names, sections=None
+                            ) -> Dict[str, Dict[str, dict]]:
         """index → shard-id → owner stats for every shard primaried on
         ANOTHER node (front-local shards are already in the local stats)."""
         st = self.node.applied_state
@@ -1059,7 +1101,8 @@ class ClusterRestService:
             for owner, sids in sorted(by_owner.items()):
                 try:
                     r = self.node.rpc(owner, "stats:shards",
-                                      {"index": n, "shards": sids},
+                                      {"index": n, "shards": sids,
+                                       "sections": sorted(sections or ())},
                                       timeout=10.0)
                 except Exception:   # noqa: BLE001 — a dead owner's shard
                     continue        # stats degrade to the local zeros
@@ -1143,6 +1186,143 @@ class ClusterRestService:
         from ..rest.api import JSON_CT
         return 200, JSON_CT, json.dumps(doc).encode()
 
+    def _segments(self, method, path, query, body):
+        """GET /_segments on the cluster: remote-owned shards' segment
+        lists come over ``stats:shards`` and patch into the local
+        rendering (which covers front-held copies)."""
+        status, ct, out = self._local(method, path, query, body)
+        if status != 200:
+            return status, ct, out
+        try:
+            doc = json.loads(out)
+        except ValueError:
+            return status, ct, out
+        indices = doc.get("indices")
+        st = self.node.applied_state
+        routing = (st.data.get("routing", {}) if st else {})
+        if not isinstance(indices, dict) or not routing:
+            return status, ct, out
+        remote = self._remote_shard_stats(
+            [n for n in indices if n in routing], sections={"segments"})
+        for n, shards in remote.items():
+            shards_out = (indices.get(n) or {}).get("shards")
+            if not isinstance(shards_out, dict):
+                continue
+            for sid, s in shards.items():
+                seg_map = {
+                    seg["seg_id"]: {
+                        "generation": gi, "num_docs": seg["live"],
+                        "deleted_docs": seg["deleted"],
+                        "size_in_bytes": 0, "memory_in_bytes": 0,
+                        "committed": True, "search": True,
+                        "version": "9.0.0", "compound": False}
+                    for gi, seg in enumerate(s.get("segments", []))}
+                copies = shards_out.get(sid)
+                if copies:
+                    copies[0]["segments"] = seg_map
+                    copies[0]["num_committed_segments"] = len(seg_map)
+                    copies[0]["num_search_segments"] = len(seg_map)
+        from ..rest.api import JSON_CT
+        return 200, JSON_CT, json.dumps(doc).encode()
+
+    def _cat_fielddata(self, method, path, query, body, segs):
+        """Cluster cat fielddata: the owners hold the loaded columns —
+        merge their per-field byte maps with the local rendering."""
+        from urllib.parse import unquote
+        from ..rest.api import _flag, _human_bytes
+        want = None
+        if len(segs) >= 3:
+            want = set(unquote(segs[2]).split(","))
+        with self.lock:
+            names = sorted(self.api.indices.indices)
+        remote = self._remote_shard_stats(names,
+                                          sections={"fielddata"})
+        fields: Dict[str, int] = {}
+        with self.lock:
+            for n in names:
+                svc = self.indices.indices[n]
+                loaded = sorted(getattr(svc.mapper, "fielddata_loaded",
+                                        ()))
+                if loaded:
+                    fd, _comp = svc.field_bytes()
+                    for f in loaded:
+                        fields[f] = fields.get(f, 0) + int(fd.get(f, 0))
+        for n, shards in remote.items():
+            for _sid, s in shards.items():
+                for f, b in (s.get("fielddata_fields") or {}).items():
+                    fields[f] = fields.get(f, 0) + int(b)
+        params = _parse_query(query)
+        rows = [[self.node.node_id[:4], "127.0.0.1", "127.0.0.1",
+                 self.node.node_id, f, _human_bytes(b)]
+                for f, b in sorted(fields.items())
+                if want is None or f in want]
+        with self.lock:
+            text = self.api._cat_table(
+                rows, ["id", "host", "ip", "node", "field", "size"],
+                _flag(params, "v"), params,
+                aliases={"f": "field", "s": "size"})
+        if isinstance(text, (dict, list)):
+            from ..rest.api import JSON_CT
+            return 200, JSON_CT, json.dumps(text).encode()
+        return 200, "text/plain; charset=UTF-8", str(text).encode()
+
+    def _cat_shards(self, method, path, query, body):
+        """Cluster cat shards: per-shard docs/owner from the routing
+        table + owner engine stats (``stats:shards``); falls back to the
+        local rendering for unrouted indices."""
+        from urllib.parse import unquote
+        from ..rest.api import _flag
+        segs = [s for s in path.split("/") if s]
+        index_expr = unquote(segs[2]) if len(segs) >= 3 else None
+        st = self.node.applied_state
+        routing = (st.data.get("routing", {}) if st else {})
+        with self.lock:
+            try:
+                names = sorted(self.api.indices.resolve(index_expr)) \
+                    if index_expr else sorted(self.api.indices.indices)
+            except _errors.ElasticsearchError:
+                return self._local(method, path, query, body)
+        if not any(n in routing for n in names):
+            return self._local(method, path, query, body)
+        params = _parse_query(query)
+        remote = self._remote_shard_stats(names, sections={"docs"})
+        extra = ["" for _ in self.api._CAT_SHARDS_EXTRA]
+        rows = []
+        for n in names:
+            svc = self.indices.indices.get(n)
+            if svc is None:
+                continue
+            table = routing.get(n) or {}
+            for sid in range(svc.num_shards):
+                entry = table.get(str(sid)) or {}
+                owner = entry.get("primary", self.node.node_id)
+                if owner == self.node.node_id or \
+                        self.node.node_id in entry.get("replicas", ()):
+                    docs = svc.shards[sid].doc_count
+                else:
+                    docs = (remote.get(n, {}).get(str(sid), {})
+                            .get("docs", 0))
+                rows.append([n, sid, "p", "STARTED", docs, "0b",
+                             "127.0.0.1", owner, owner] + list(extra))
+                for rnode in entry.get("replicas", ()):
+                    rows.append([n, sid, "r", "STARTED", docs, "0b",
+                                 "127.0.0.1", rnode, rnode] + list(extra))
+        with self.lock:
+            text = self.api._cat_table(
+                rows,
+                ["index", "shard", "prirep", "state", "docs", "store",
+                 "ip", "id", "node"] + self.api._CAT_SHARDS_EXTRA,
+                _flag(params, "v"), params,
+                default_columns=["index", "shard", "prirep", "state",
+                                 "docs", "store", "ip", "id", "node"],
+                aliases={"i": "index", "s": "shard", "p": "prirep",
+                         "st": "state", "d": "docs", "sto": "store",
+                         "n": "node"})
+        if isinstance(text, (dict, list)):
+            from ..rest.api import JSON_CT
+            return 200, JSON_CT, json.dumps(text).encode()
+        return 200, "text/plain; charset=UTF-8", str(text).encode()
+
     def _cat_segments(self, method, path, query, body):
         """Cluster cat segments: the local rows cover front-primaried
         shards; remote-owned shards' segment lists come over
@@ -1162,7 +1342,7 @@ class ClusterRestService:
             return self._local(method, path, query, body)
         params = _parse_query(query)
         rows = []
-        remote = self._remote_shard_stats(names)
+        remote = self._remote_shard_stats(names, sections={"segments"})
         for n in names:
             svc = self.indices.indices.get(n)
             if svc is None:

@@ -334,10 +334,11 @@ class IndexService:
         op's ``?refresh=true`` (reference: ``TransportShardBulkAction``
         refreshes the affected shard, never the whole index; other
         shards' pending NRT deletes must stay invisible)."""
+        sid = self.shard_id_for(doc_id, routing)
         if self.cluster_hooks is not None and \
-                self.cluster_hooks.refresh(self.name):
+                self.cluster_hooks.refresh(self.name, shard=sid):
             return
-        self.shard_for_doc(doc_id, routing).refresh()
+        self.shards[sid].refresh()
 
     def flush(self) -> None:
         for s in self.shards:
@@ -490,6 +491,10 @@ class IndicesService:
         self.data_path = data_path
         os.makedirs(data_path, exist_ok=True)
         self.indices: Dict[str, IndexService] = {}
+        #: data-stream seam: name -> backing index list (or None) —
+        #: set by the owning RestAPI's DataStreamService so stream names
+        #: resolve like aliases over their generations
+        self.data_streams_provider = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -554,6 +559,9 @@ class IndicesService:
                 out.append(part)
             elif part in aliases:
                 out.extend(aliases[part])
+            elif self.data_streams_provider is not None and \
+                    self.data_streams_provider(part) is not None:
+                out.extend(self.data_streams_provider(part))
             elif "*" in part or "?" in part:
                 import fnmatch
                 matched = [n for n in self.indices

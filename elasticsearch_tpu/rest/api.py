@@ -57,6 +57,69 @@ def _indexing_pressure():
     return DEFAULT
 
 
+def _os_stats() -> dict:
+    """Real host memory/load figures (reference: ``monitor/os/OsProbe``;
+    /proc is authoritative on this platform — no psutil dependency)."""
+    total = free = avail = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, rest = line.partition(":")
+                kb = int(rest.strip().split()[0])
+                if k == "MemTotal":
+                    total = kb * 1024
+                elif k == "MemFree":
+                    free = kb * 1024
+                elif k == "MemAvailable":
+                    avail = kb * 1024
+    except OSError:
+        pass
+    used = max(total - (avail or free), 0)
+    pct = int(round(used * 100 / total)) if total else 0
+    try:
+        load1, load5, load15 = os.getloadavg()
+    except OSError:
+        load1 = load5 = load15 = 0.0
+    return {"timestamp": int(time.time() * 1000),
+            "cpu": {"percent": min(99, int(load1 * 100 /
+                                           (os.cpu_count() or 1))),
+                    "load_average": {"1m": round(load1, 2),
+                                     "5m": round(load5, 2),
+                                     "15m": round(load15, 2)}},
+            "mem": {"total_in_bytes": total,
+                    "free_in_bytes": avail or free,
+                    "used_in_bytes": used,
+                    "free_percent": 100 - pct, "used_percent": pct}}
+
+
+def _process_stats() -> dict:
+    """Real process figures (reference: ``monitor/process/ProcessProbe``)."""
+    import resource
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    cpu_ms = int((ru.ru_utime + ru.ru_stime) * 1000)
+    try:
+        n_fds = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        n_fds = 0
+    try:
+        max_fds = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    except (ValueError, OSError):
+        max_fds = 0
+    vsize = 0
+    try:
+        with open("/proc/self/statm") as f:
+            vsize = int(f.read().split()[0]) * (os.sysconf("SC_PAGE_SIZE")
+                                                if hasattr(os, "sysconf")
+                                                else 4096)
+    except (OSError, ValueError):
+        pass
+    return {"timestamp": int(time.time() * 1000),
+            "open_file_descriptors": n_fds,
+            "max_file_descriptors": max_fds,
+            "cpu": {"percent": 0, "total_in_millis": cpu_ms},
+            "mem": {"total_virtual_in_bytes": vsize}}
+
+
 def _error_payload(e: Exception) -> Tuple[int, dict]:
     if isinstance(e, ElasticsearchError):
         status = getattr(e, "status", 500)
@@ -179,7 +242,16 @@ class RestAPI:
         self.node_id = uuid.uuid4().hex[:20]
         # security (x-pack analog): off by default — conformance runs
         # unauthenticated; the node binary enables it via settings
+        from ..lifecycle import DataStreamService, IlmService
         from ..security import SecurityService
+        self.datastreams = DataStreamService(self)
+        self.ilm = IlmService(self)
+        self._async_searches: Dict[str, Any] = {}
+        self.indices.data_streams_provider = \
+            self.datastreams.backing_indices
+        #: internal re-entrant dispatches (async search task threads)
+        #: ride on the SUBMITTING request's authentication
+        self._internal_tls = threading.local()
         #: cluster seam: () -> adaptive_selection stats (ARS EWMAs live
         #: on the ClusterNode; single-node has no peers to rank)
         self.adaptive_selection_provider = None
@@ -240,6 +312,19 @@ class RestAPI:
         add("PUT", "/_cluster/settings", self.h_cluster_put_settings)
         add("GET", "/_nodes", self.h_nodes)
         add("GET", "/_remote/info", self.h_remote_info)
+        add("POST", "/{index}/_async_search", self.h_submit_async_search)
+        add("GET", "/_async_search/{id}", self.h_get_async_search)
+        add("DELETE", "/_async_search/{id}", self.h_delete_async_search)
+        add("PUT", "/_data_stream/{name}", self.h_create_data_stream)
+        add("GET", "/_data_stream", self.h_get_data_streams)
+        add("GET", "/_data_stream/{name}", self.h_get_data_streams)
+        add("DELETE", "/_data_stream/{name}", self.h_delete_data_stream)
+        add("PUT", "/_ilm/policy/{name}", self.h_put_ilm_policy)
+        add("GET", "/_ilm/policy", self.h_get_ilm_policy)
+        add("GET", "/_ilm/policy/{name}", self.h_get_ilm_policy)
+        add("DELETE", "/_ilm/policy/{name}", self.h_delete_ilm_policy)
+        add("GET", "/{index}/_ilm/explain", self.h_ilm_explain)
+        add("POST", "/_ilm/_tick", self.h_ilm_tick)
         add("PUT,POST", "/_security/api_key", self.h_create_api_key)
         add("DELETE", "/_security/api_key", self.h_invalidate_api_key)
         add("GET", "/_security/api_key", self.h_get_api_keys)
@@ -445,7 +530,8 @@ class RestAPI:
     def handle(self, method: str, path: str, query: str,
                body: bytes,
                headers: Optional[dict] = None) -> Tuple[int, str, bytes]:
-        if self.security.enabled and self.enforce_security:
+        if self.security.enabled and self.enforce_security and \
+                not getattr(self._internal_tls, "active", False):
             # every route requires credentials when security is on
             # (reference: SecurityRestFilter wraps the whole dispatcher);
             # the cluster front enforces at ITS door and disables this
@@ -756,6 +842,24 @@ class RestAPI:
     _ROLLOVER_RE = re.compile(r"^(.*?)-(\d+)$")
 
     def h_rollover(self, params, body, index, new_index=None):
+        if index in self.datastreams.streams:
+            payload = _json_body(body) if body else {}
+            conds = payload.get("conditions") or {}
+            if conds:
+                # condition-gated stream rollover: reuse the ILM checks
+                svc = self.indices.get(
+                    self.datastreams.write_index(index))
+                import time as _t
+                age_ms = int(_t.time() * 1000) - svc.creation_date
+                from ..lifecycle.ilm import IlmService as _Ilm
+                if not _Ilm._rollover_due(svc, conds, age_ms):
+                    return {"acknowledged": False, "rolled_over": False,
+                            "dry_run": False, "conditions": {
+                                c: False for c in conds}}
+            return self.datastreams.rollover(index)
+        return self._rollover_impl(params, body, index, new_index)
+
+    def _rollover_impl(self, params, body, index, new_index=None):
         """Rollover (reference: ``MetadataRolloverService`` /
         ``TransportRolloverAction``): the alias moves to a freshly created
         index when any condition matches (or unconditionally)."""
@@ -771,11 +875,19 @@ class RestAPI:
         payload = _json_body(body) if body else {}
         conditions = payload.get("conditions") or {}
         st = svc.stats(with_field_bytes=False)
+        doc_count = st["docs"]["count"]
+        if svc.cluster_hooks is not None and "max_docs" in conditions:
+            # routed index: the doc condition needs the CLUSTER count
+            # (front engines hold only locally-primaried shards)
+            try:
+                doc_count = int(svc.count({"query": {"match_all": {}}}))
+            except Exception:   # noqa: BLE001 — fall back to local
+                pass
         age_s = max(0.0, time.time() - svc.creation_date / 1000.0)
         results = {}
         for cond, want in conditions.items():
             if cond == "max_docs":
-                results[cond] = st["docs"]["count"] >= int(want)
+                results[cond] = doc_count >= int(want)
             elif cond == "max_age":
                 from ..common.settings import parse_time_millis
                 results[cond] = age_s * 1000 >= parse_time_millis(want)
@@ -1314,16 +1426,8 @@ class RestAPI:
             indices_stats["indices"] = per_index
         sections = {
             "indices": indices_stats,
-            "os": {"timestamp": int(time.time() * 1000),
-                   "cpu": {"percent": 0},
-                   "mem": {"total_in_bytes": 0, "free_in_bytes": 0,
-                           "used_in_bytes": 0, "free_percent": 0,
-                           "used_percent": 0}},
-            "process": {"timestamp": int(time.time() * 1000),
-                        "open_file_descriptors": 0,
-                        "max_file_descriptors": 0,
-                        "cpu": {"percent": 0, "total_in_millis": 0},
-                        "mem": {"total_virtual_in_bytes": 0}},
+            "os": _os_stats(),
+            "process": _process_stats(),
             "jvm": {"timestamp": int(time.time() * 1000),
                     "uptime_in_millis": int(
                         (time.time() - self.start_time) * 1000),
@@ -1577,8 +1681,14 @@ class RestAPI:
     def h_cat_count(self, params, body, index=None):
         total = 0
         for name in self.indices.resolve(index):
-            total += sum(s.doc_count
-                         for s in self.indices.indices[name].shards)
+            svc = self.indices.indices[name]
+            if svc.cluster_hooks is not None:
+                # routed index: count cluster-wide (front engines hold
+                # only locally-primaried shards)
+                c = svc.count({"query": {"match_all": {}}})
+                total += int(c)
+                continue
+            total += sum(s.doc_count for s in svc.shards)
         return self._cat_table(
             [[int(time.time()), time.strftime("%H:%M:%S"), total]],
             ["epoch", "timestamp", "count"], _flag(params, "v"), params)
@@ -2393,6 +2503,104 @@ class RestAPI:
                 "authentication_type": p.get("authentication_type"),
                 "api_key": p.get("api_key")}
 
+    # -- async search (x-pack async-search analog:
+    # TransportSubmitAsyncSearchAction.java:48) ------------------------
+
+    def h_submit_async_search(self, params, body, index):
+        """Submit: run the search on a detached task; block up to
+        ``wait_for_completion_timeout`` (default 1s) and return inline
+        when it finishes in time, else the async envelope with the id."""
+        import uuid as _uuid
+        from ..common.settings import parse_time_millis
+        wait_ms = parse_time_millis(
+            params.get("wait_for_completion_timeout", "1s"))
+        body_bytes = body
+        q = "&".join(f"{k}={v}" for k, v in params.items()
+                     if k not in ("wait_for_completion_timeout",
+                                  "keep_on_completion", "keep_alive"))
+        task = self.task_manager.register(
+            "indices:data/read/async_search",
+            description=f"async_search [{index}]")
+        sid = _uuid.uuid4().hex
+        self._async_searches[sid] = task
+
+        def run():
+            # the submitter already authenticated: this internal hop
+            # must not re-challenge (it runs with no client headers)
+            self._internal_tls.active = True
+            try:
+                st, _ct, out = self.handle("POST", f"/{index}/_search",
+                                           q, body_bytes)
+            finally:
+                self._internal_tls.active = False
+            doc = json.loads(out)
+            if st >= 400:
+                raise ElasticsearchError(
+                    (doc.get("error") or {}).get("reason", "failed"))
+            return doc
+
+        self.task_manager.run_async(task, run)
+        deadline = time.time() + wait_ms / 1e3
+        while task.running and time.time() < deadline:
+            time.sleep(0.005)
+        return self._async_envelope(sid, task)
+
+    def _async_envelope(self, sid: str, task) -> dict:
+        out = {"id": sid, "is_partial": bool(task.running),
+               "is_running": bool(task.running),
+               "start_time_in_millis": int(task.start_time * 1000),
+               "expiration_time_in_millis":
+                   int(task.start_time * 1000) + 432_000_000}
+        if not task.running:
+            if getattr(task, "error", None):
+                return (400, {"error": task.error,
+                              "id": sid, "is_running": False,
+                              "is_partial": True})
+            out["response"] = task.result
+        return out
+
+    def h_get_async_search(self, params, body, id):
+        task = self._async_searches.get(id)
+        if task is None:
+            raise ResourceNotFoundError(id)
+        return self._async_envelope(id, task)
+
+    def h_delete_async_search(self, params, body, id):
+        task = self._async_searches.pop(id, None)
+        if task is None:
+            raise ResourceNotFoundError(id)
+        if task.running:
+            self.task_manager.cancel(task, "deleted")
+        return {"acknowledged": True}
+
+    def h_create_data_stream(self, params, body, name):
+        return self.datastreams.create(name)
+
+    def h_get_data_streams(self, params, body, name=None):
+        return self.datastreams.get(name)
+
+    def h_delete_data_stream(self, params, body, name):
+        return self.datastreams.delete(name)
+
+    def h_put_ilm_policy(self, params, body, name):
+        return self.ilm.put_policy(name, _json_body(body))
+
+    def h_get_ilm_policy(self, params, body, name=None):
+        return self.ilm.get_policy(name)
+
+    def h_delete_ilm_policy(self, params, body, name):
+        return self.ilm.delete_policy(name)
+
+    def h_ilm_explain(self, params, body, index):
+        return {"indices": {index: self.ilm.explain(index)}}
+
+    def h_ilm_tick(self, params, body):
+        """Test/ops hook: one ILM evaluation round, optionally at a
+        caller-provided clock (?now_ms=) — the reference schedules the
+        same evaluation off indices.lifecycle.poll_interval."""
+        now = params.get("now_ms")
+        return self.ilm.tick(int(now) if now else None)
+
     def h_remote_info(self, params, body):
         """GET /_remote/info — remote-cluster connections (none
         configured: empty object, ``RestRemoteClusterInfoAction``)."""
@@ -2984,7 +3192,8 @@ class RestAPI:
                 index not in self.indices.all_aliases():
             raise _require_alias_error(index)
         svc = self._get_or_autocreate(index)
-        op_type = params.get("op_type", "index")
+        index = svc.name        # data stream/alias writes report the
+        op_type = params.get("op_type", "index")    # concrete index
         ext_version = None
         if params.get("version_type") in ("external", "external_gte"):
             ext_version = int(params.get("version", 0))
@@ -3387,9 +3596,18 @@ class RestAPI:
         return {"docs": out}
 
     def _get_or_autocreate(self, index: str) -> IndexService:
+        wi = self.datastreams.write_index(index)
+        if wi is not None:
+            return self.indices.get(wi)
         try:
             return self.indices.get(index)
         except IndexNotFoundError:
+            # a matching data-stream template auto-creates the STREAM
+            # (reference: auto-create routes through the data-stream
+            # metadata service when the template carries data_stream)
+            wi = self.datastreams.auto_create(index)
+            if wi is not None:
+                return self.indices.get(wi)
             settings, mappings, aliases = self._apply_templates(
                 index, {}, {})
             return self.indices.create_index(index, settings, mappings,
@@ -3469,7 +3687,13 @@ class RestAPI:
         return {"indices": sorted(out_idx, key=lambda e: e["name"]),
                 "aliases": [{"name": a, "indices": sorted(v)}
                             for a, v in sorted(out_alias.items())],
-                "data_streams": []}
+                "data_streams": [
+                    {"name": n,
+                     "backing_indices": list(st["indices"]),
+                     "timestamp_field": "@timestamp"}
+                    for n, st in sorted(self.datastreams.streams.items())
+                    if any(fnmatch.fnmatchcase(n, p) or n == p
+                           for p in name.split(","))]}
 
     def h_segments(self, params, body, index=None):
         """GET /_segments (reference: ``RestIndicesSegmentsAction``)."""
@@ -6059,7 +6283,13 @@ class RestAPI:
                 svc = self.indices.indices[n]
                 try:
                     svc.refresh()        # filter evaluates live contents
-                    docs = sum(sh.doc_count for sh in svc.shards)
+                    if svc.cluster_hooks is not None:
+                        # routed: count cluster-wide (front engines hold
+                        # only locally-primaried shards)
+                        docs = int(svc.count(
+                            {"query": {"match_all": {}}}))
+                    else:
+                        docs = sum(sh.doc_count for sh in svc.shards)
                     if docs == 0 or svc.count(
                             {"query": index_filter}) > 0:
                         kept.append(n)   # empty shard → can_match true

@@ -86,21 +86,27 @@ def _shard_can_match(shard: "ShardSearcher", bounds: List[tuple]) -> bool:
     """False iff some required range is disjoint from the shard's
     [min, max] for that field across every segment."""
     for field, lo, hi in bounds:
-        if isinstance(lo, str) or isinstance(hi, str):
-            # resolve date-format bounds with this shard's mapping
-            from ..index.mapping import DateFieldType, parse_date_millis
-            ft = shard.mapper.field_type(field)
-            if not isinstance(ft, DateFieldType):
-                continue              # non-date string bounds: no skip
+        from ..index.mapping import DateFieldType, parse_date_millis
+        ft = shard.mapper.field_type(field)
+        if isinstance(ft, DateFieldType):
+            # resolve bounds with this shard's date mapping, using the
+            # QUERY layer's coercion (a bare 4-digit number reads as a
+            # year, not epoch millis — RangeQuery._bound); hi rounds UP
+            # so the skip test stays conservative — can-match must
+            # never drop a shard that could hold matches
+            def _co(v):
+                if isinstance(v, (int, float)) and not isinstance(
+                        v, bool) and 1000 <= v <= 9999 and \
+                        float(v).is_integer():
+                    return str(int(v))
+                return v
             try:
-                # hi rounds UP (a bare day means end-of-day for lte) so
-                # the skip test stays conservative — can-match must
-                # never drop a shard that could hold matches
-                lo = parse_date_millis(lo, ft.format) \
-                    if isinstance(lo, str) else (
+                lo = parse_date_millis(_co(lo), ft.format) \
+                    if isinstance(_co(lo), str) else (
                         float(lo) if lo is not None else float("-inf"))
-                hi = parse_date_millis(hi, ft.format, round_up=True) \
-                    if isinstance(hi, str) else (
+                hi = parse_date_millis(_co(hi), ft.format,
+                                       round_up=True) \
+                    if isinstance(_co(hi), str) else (
                         float(hi) if hi is not None else float("inf"))
             except Exception:   # noqa: BLE001 — unparseable: no skip
                 continue
@@ -108,6 +114,8 @@ def _shard_can_match(shard: "ShardSearcher", bounds: List[tuple]) -> bool:
                 lo = float("-inf")
             if hi is None:
                 hi = float("inf")
+        elif isinstance(lo, str) or isinstance(hi, str):
+            continue                  # non-date string bounds: no skip
         fmin, fmax = float("inf"), float("-inf")
         present = False
         for seg in shard.segments:

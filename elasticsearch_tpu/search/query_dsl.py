@@ -27,7 +27,8 @@ from typing import Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..common.errors import IllegalArgumentError, ParsingError
+from ..common.errors import (ElasticsearchError,
+                             IllegalArgumentError, ParsingError)
 from ..index.mapping import (
     BooleanFieldType, DateFieldType, DenseVectorFieldType, IpFieldType,
     KeywordFieldType, MapperService, NumberFieldType, RangeFieldType,
@@ -2061,13 +2062,69 @@ class ParentIdQuery(Query):
                 jnp.asarray(mask))
 
 
+def _extract_required_terms(spec) -> "Optional[set]":
+    """Candidate-extraction (reference: ``modules/percolator/
+    QueryAnalyzer.java``): a set of (field, token) pairs such that the
+    stored query can only match documents containing AT LEAST ONE of
+    them; None → unanalyzable (ranges, match_all, negations…) — the
+    stored query must always execute. Conservative by construction:
+    over-approximating the set only costs an execution, never a miss."""
+    if not isinstance(spec, dict) or len(spec) != 1:
+        return None
+    (kind, body), = spec.items()
+    if kind in ("term", "match", "match_phrase"):
+        if not isinstance(body, dict) or len(body) != 1:
+            return None
+        (field, v), = body.items()
+        if isinstance(v, dict):
+            v = v.get("value", v.get("query"))
+        if v is None or isinstance(v, (dict, list, bool)):
+            return None
+        # tokens by the standard lowercase/word split — matching the
+        # default analyzer's output is enough for an over-approximation
+        import re as _re
+        whole = str(v).lower()
+        toks = [t for t in _re.split(r"\W+", whole) if t]
+        if not toks:
+            return None
+        # a match/phrase needs every term for AND/phrase, any term for
+        # OR — requiring presence of AT LEAST ONE is safe for all three;
+        # the whole value joins the set so exact keyword terms
+        # ("foo-bar") intersect the candidate's untokenized ord_terms
+        return {(field, t) for t in toks} | {(field, whole)}
+    if kind == "bool":
+        if not isinstance(body, dict):
+            return None
+        musts = body.get("must") or body.get("filter") or []
+        if isinstance(musts, dict):
+            musts = [musts]
+        for clause in musts:
+            got = _extract_required_terms(clause)
+            if got is not None:
+                return got          # one analyzable must-clause suffices
+        shoulds = body.get("should") or []
+        if isinstance(shoulds, dict):
+            shoulds = [shoulds]
+        if shoulds and not musts:
+            union: set = set()
+            for clause in shoulds:
+                got = _extract_required_terms(clause)
+                if got is None:
+                    return None     # one opaque branch could match alone
+                union |= got
+            return union
+        return None
+    return None
+
+
 class PercolateQuery(Query):
     """Reverse search (reference: ``modules/percolator/PercolateQuery
     .java``): each doc carrying a stored query at ``field`` matches when
     that query matches the candidate document(s). The candidates index
-    into a throwaway in-memory segment under this index's mapper; every
-    stored query executes against it (see PercolatorFieldType on the
-    skipped candidate-extraction optimization)."""
+    into a throwaway in-memory segment under this index's mapper; stored
+    queries whose extracted required terms (``_extract_required_terms``,
+    the QueryAnalyzer analog) are absent from the candidate are pruned
+    without executing — O(matching-ish queries), not O(stored)."""
 
     def __init__(self, field: str, documents: List[dict],
                  boost: float = 1.0):
@@ -2094,6 +2151,38 @@ class PercolateQuery(Query):
         if not isinstance(ft, PercolatorFieldType):
             return _const_result(seg, 0.0, False)
         searcher, tmp_seg = self._temp_segment(ctx)
+        # candidate term set: every (field, token) present in the tmp
+        # segment (text tokens + keyword values, lowercased to meet the
+        # extractor's normalization)
+        cand: set = set()
+        for fname, f in tmp_seg.text_fields.items():
+            base = fname.split(".")[0]
+            for t in f.term_ids:
+                cand.add((fname, str(t).lower()))
+                cand.add((base, str(t).lower()))
+        import re as _re
+        for fname, f in tmp_seg.keyword_fields.items():
+            base = fname.split(".")[0]
+            for t in f.ord_terms:
+                whole = str(t).lower()
+                for tok in [whole] + [x for x in _re.split(r"\W+", whole)
+                                      if x]:
+                    cand.add((fname, tok))
+                    cand.add((base, tok))
+        for fname, f in tmp_seg.numeric_fields.items():
+            base = fname.split(".")[0]
+            for v in np.asarray(f.vals_host).tolist():
+                for rep in (str(v), str(int(v)) if float(v).is_integer()
+                            else str(v)):
+                    cand.add((fname, rep))
+                    cand.add((base, rep))
+        # per-segment extraction cache: stored queries are immutable for
+        # a segment's lifetime
+        cache = getattr(seg, "_percolate_extractions", None)
+        if cache is None or cache[0] != self.field:
+            cache = (self.field, {})
+            seg._percolate_extractions = cache
+        extractions = cache[1]
         mask = np.zeros(seg.n_pad, bool)
         for d in range(seg.n_docs):
             if not seg.live[d]:
@@ -2103,11 +2192,18 @@ class PercolateQuery(Query):
             if not isinstance(spec, dict):
                 continue
             try:
+                if d not in extractions:
+                    extractions[d] = _extract_required_terms(spec)
+                req = extractions[d]
+                if req is not None and not (req & cand):
+                    continue        # no required term present: pruned
                 q = parse_query(spec)
                 _s, m2 = q.execute(searcher.ctx, tmp_seg)
                 if bool(np.asarray(m2)[: tmp_seg.n_docs].any()):
                     mask[d] = True
-            except Exception:   # noqa: BLE001 — unparsable stored query
+            except Exception:   # noqa: BLE001 — a malformed stored query
+                # cannot match; the reference rejects these at index
+                # time, here percolate-time failures stay non-fatal
                 continue
         return (jnp.asarray(mask.astype(np.float32)
                             * np.float32(self.boost)),

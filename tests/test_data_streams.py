@@ -1,0 +1,136 @@
+"""Data streams + ILM-lite (reference:
+MetadataCreateDataStreamService.java, IndexLifecycleService.java).
+Phase transitions run on a test clock via /_ilm/_tick?now_ms=."""
+
+import json
+import time
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+@pytest.fixture()
+def api(tmp_path):
+    api = RestAPI(IndicesService(str(tmp_path)))
+    req(api, "PUT", "/_index_template/logs-template", {
+        "index_patterns": ["logs-*"],
+        "data_stream": {},
+        "priority": 200,
+        "template": {"mappings": {"properties": {
+            "message": {"type": "text"}}}}})
+    return api
+
+
+def req(api, method, path, body=None, query=""):
+    raw = json.dumps(body).encode() if body is not None else b""
+    st, _ct, payload = api.handle(method, path, query, raw)
+    try:
+        return st, json.loads(payload)
+    except ValueError:
+        return st, payload
+
+
+def test_create_get_delete_data_stream(api):
+    st, out = req(api, "PUT", "/_data_stream/logs-app")
+    assert st == 200 and out["acknowledged"]
+    st, out = req(api, "GET", "/_data_stream/logs-app")
+    ds = out["data_streams"][0]
+    assert ds["name"] == "logs-app"
+    assert ds["generation"] == 1
+    assert ds["timestamp_field"] == {"name": "@timestamp"}
+    assert ds["indices"][0]["index_name"] == ".ds-logs-app-000001"
+    # stream without a matching data_stream template → 400
+    st, out = req(api, "PUT", "/_data_stream/metrics-x")
+    assert st == 400
+    # delete removes backing indices too
+    st, _ = req(api, "DELETE", "/_data_stream/logs-app")
+    assert st == 200
+    assert ".ds-logs-app-000001" not in api.indices.indices
+
+
+def test_writes_route_to_write_index_and_reads_span_generations(api):
+    req(api, "PUT", "/_data_stream/logs-web")
+    st, out = req(api, "POST", "/logs-web/_doc", {
+        "@timestamp": "2026-01-01T00:00:00Z", "message": "one"},
+        query="refresh=true")
+    assert st in (200, 201), out
+    assert out["_index"] == ".ds-logs-web-000001"
+    st, out = req(api, "POST", "/logs-web/_rollover")
+    assert out["rolled_over"] and out["new_index"] == ".ds-logs-web-000002"
+    st, out = req(api, "POST", "/logs-web/_doc", {
+        "@timestamp": "2026-01-01T00:01:00Z", "message": "two"},
+        query="refresh=true")
+    assert out["_index"] == ".ds-logs-web-000002"
+    # search on the stream name spans every generation
+    st, out = req(api, "POST", "/logs-web/_search",
+                  {"query": {"match": {"message": "one two"}}})
+    assert out["hits"]["total"]["value"] == 2
+    got = {h["_index"] for h in out["hits"]["hits"]}
+    assert got == {".ds-logs-web-000001", ".ds-logs-web-000002"}
+
+
+def test_auto_create_on_first_write(api):
+    st, out = req(api, "POST", "/logs-auto/_doc", {
+        "@timestamp": "2026-01-01T00:00:00Z", "message": "hi"})
+    assert st in (200, 201), out
+    assert out["_index"] == ".ds-logs-auto-000001"
+    st, out = req(api, "GET", "/_data_stream/logs-auto")
+    assert out["data_streams"][0]["generation"] == 1
+
+
+def test_resolve_index_lists_streams(api):
+    req(api, "PUT", "/_data_stream/logs-r")
+    st, out = req(api, "GET", "/_resolve/index/logs-*")
+    assert out["data_streams"][0]["name"] == "logs-r"
+    assert out["data_streams"][0]["backing_indices"] == \
+        [".ds-logs-r-000001"]
+
+
+def test_ilm_policy_rollover_and_delete_on_test_clock(api):
+    req(api, "PUT", "/_ilm/policy/logs-policy", {"policy": {"phases": {
+        "hot": {"actions": {"rollover": {"max_age": "1h"}}},
+        "delete": {"min_age": "3h", "actions": {"delete": {}}},
+    }}})
+    st, out = req(api, "GET", "/_ilm/policy/logs-policy")
+    assert "logs-policy" in out
+    # stream whose template binds the policy
+    req(api, "PUT", "/_index_template/ilm-template", {
+        "index_patterns": ["ilmlogs-*"], "data_stream": {},
+        "priority": 300,
+        "template": {"settings": {
+            "index.lifecycle.name": "logs-policy"}}})
+    req(api, "PUT", "/_data_stream/ilmlogs-a")
+    t0 = api.indices.get(".ds-ilmlogs-a-000001").creation_date
+    # +30m: nothing due
+    st, out = req(api, "POST", "/_ilm/_tick",
+                  query=f"now_ms={t0 + 30 * 60 * 1000}")
+    assert out == {"rolled_over": [], "deleted": []}
+    # +2h: hot rollover fires on the write index
+    st, out = req(api, "POST", "/_ilm/_tick",
+                  query=f"now_ms={t0 + 2 * 3600 * 1000}")
+    assert out["rolled_over"] == ["ilmlogs-a"]
+    assert ".ds-ilmlogs-a-000002" in api.indices.indices
+    # +4h: generation 1 (no longer the write index) deletes by age;
+    # generation 2 is past max_age too, so it rolls to generation 3 —
+    # the current write index always survives deletion
+    st, out = req(api, "POST", "/_ilm/_tick",
+                  query=f"now_ms={t0 + 4 * 3600 * 1000}")
+    assert ".ds-ilmlogs-a-000001" in out["deleted"]
+    assert ".ds-ilmlogs-a-000001" not in api.indices.indices
+    st, out = req(api, "GET", "/_data_stream/ilmlogs-a")
+    live = [i["index_name"] for i in out["data_streams"][0]["indices"]]
+    assert ".ds-ilmlogs-a-000001" not in live
+    assert live[-1] == ".ds-ilmlogs-a-000003"   # fresh write index
+    # explain surface
+    st, out = req(api, "GET", "/.ds-ilmlogs-a-000003/_ilm/explain")
+    exp = out["indices"][".ds-ilmlogs-a-000003"]
+    assert exp["managed"] and exp["policy"] == "logs-policy"
+
+
+def test_ilm_policy_crud_errors(api):
+    st, _ = req(api, "GET", "/_ilm/policy/nope")
+    assert st == 404
+    st, _ = req(api, "DELETE", "/_ilm/policy/nope")
+    assert st == 404

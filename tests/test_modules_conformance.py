@@ -44,3 +44,53 @@ def test_module_suites_pass_completely(mod):
                 failures.append(f"{os.path.basename(f)} :: {r.name}: "
                                 f"{r.reason[:200]}")
     assert not failures, "\n".join(failures)
+
+
+def test_percolator_candidate_extraction_prunes_executions():
+    """Stored queries whose required terms are absent from the candidate
+    never execute (QueryAnalyzer.java analog); results stay exact."""
+    import json
+    import tempfile
+
+    from elasticsearch_tpu.node.indices_service import IndicesService
+    from elasticsearch_tpu.rest.api import RestAPI
+    from elasticsearch_tpu.search import query_dsl as qd
+
+    api = RestAPI(IndicesService(tempfile.mkdtemp()))
+
+    def req(method, path, body=None, query=""):
+        raw = json.dumps(body).encode() if body is not None else b""
+        st, _ct, payload = api.handle(method, path, query, raw)
+        return st, json.loads(payload)
+
+    req("PUT", "/queries", {"mappings": {"properties": {
+        "q": {"type": "percolator"}, "msg": {"type": "text"}}}})
+    for i in range(20):
+        req("PUT", f"/queries/_doc/{i}",
+            {"q": {"match": {"msg": f"topic{i}"}}})
+    req("PUT", "/queries/_doc/range",
+        {"q": {"range": {"n": {"gte": 5}}}})      # unanalyzable: always runs
+    req("POST", "/queries/_refresh")
+
+    executed = []
+    orig = qd.parse_query
+
+    def spy(spec, *a, **k):
+        executed.append(json.dumps(spec, sort_keys=True))
+        return orig(spec, *a, **k)
+
+    qd.parse_query, parse_was = spy, orig
+    try:
+        st, out = req("POST", "/queries/_search", {"query": {
+            "percolate": {"field": "q",
+                          "document": {"msg": "about topic7 only"}}}})
+    finally:
+        qd.parse_query = parse_was
+    assert st == 200, out
+    hits = {h["_id"] for h in out["hits"]["hits"]}
+    assert hits == {"7"}, hits
+    # only the matching stored query + the unanalyzable range executed —
+    # the other 19 match queries were pruned without parsing
+    stored_executions = [e for e in executed if "topic" in e or
+                         "range" in e]
+    assert len(stored_executions) <= 3, stored_executions

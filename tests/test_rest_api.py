@@ -479,3 +479,23 @@ def test_shard_request_cache_hits_and_invalidation(api):
     # size>0 requests are not cached unless ?request_cache=true
     st, _ = req(api, "POST", "/rc/_search", {"query": {"match_all": {}}})
     assert svc.request_cache_stats["miss_count"] == 2
+
+
+def test_async_search_surface(api):
+    """_async_search: inline completion within the wait window, GET
+    polling, DELETE (x-pack async-search analog)."""
+    for i in range(5):
+        req(api, "PUT", f"/as/_doc/{i}", {"n": i})
+    req(api, "POST", "/as/_refresh")
+    st, out = req(api, "POST", "/as/_async_search",
+                  {"query": {"match_all": {}}})
+    assert st == 200, out
+    assert out["is_running"] is False
+    assert out["response"]["hits"]["total"]["value"] == 5
+    sid = out["id"]
+    st, again = req(api, "GET", f"/_async_search/{sid}")
+    assert again["response"]["hits"]["total"]["value"] == 5
+    st, _ = req(api, "DELETE", f"/_async_search/{sid}")
+    assert st == 200
+    st, _ = req(api, "GET", f"/_async_search/{sid}")
+    assert st == 404
