@@ -216,6 +216,10 @@ class ClusterNode:
                         e.close()
                     except Exception:   # noqa: BLE001
                         pass
+        try:
+            self.rest.api.close()
+        except Exception:   # noqa: BLE001
+            pass
         self.node_loop.stop()
 
     def start_http(self, port: int, host: str = "127.0.0.1") -> None:

@@ -125,6 +125,10 @@ class IndexService:
         from collections import OrderedDict
         self.request_cache: "OrderedDict" = OrderedDict()
         self.request_cache_stats = {"hit_count": 0, "miss_count": 0}
+        #: search/indexing slow-log ring (reference: SearchSlowLog.java /
+        #: IndexingSlowLog.java write per-index log files; entries also
+        #: persist to <index>/_index_*_slowlog.log)
+        self.slow_log: List[dict] = []
         # serving planes for the tiered TPU kernel (search/plane_route.py);
         # lazily built per text field, invalidated by segment-list changes
         from ..search.plane_route import ServingPlaneCache
@@ -186,6 +190,18 @@ class IndexService:
                   if_seq_no=None, if_primary_term=None):
         self._check_open()
         self._check_write_block()
+        t0 = time.perf_counter()
+        try:
+            return self._index_doc_inner(
+                doc_id, source, routing=routing, op_type=op_type,
+                if_seq_no=if_seq_no, if_primary_term=if_primary_term)
+        finally:
+            self._slowlog_record("index", time.perf_counter() - t0,
+                                 f"[{doc_id}] " + str(source)[:500])
+
+    def _index_doc_inner(self, doc_id, source, *, routing=None,
+                         op_type="index", if_seq_no=None,
+                         if_primary_term=None):
         if self.cluster_hooks is not None:
             w = self.cluster_hooks.writer(self.name, self.shard_id_for(
                 doc_id, routing))
@@ -284,12 +300,58 @@ class IndexService:
                     for seg in sh.searchable_segments())
         return (sig, blob)
 
+    #: slow-log ring size per index (entries also append to the on-disk
+    #: log file, the reference's actual surface)
+    SLOWLOG_MAX = 512
+
+    def _slowlog_threshold(self, kind: str, level: str) -> Optional[float]:
+        """Threshold seconds for ``index.(search|indexing).slowlog.
+        threshold...`` settings, None = disabled (reference:
+        ``index/SearchSlowLog.java:43`` / ``IndexingSlowLog.java:46``)."""
+        key = (f"index.search.slowlog.threshold.query.{level}"
+               if kind == "query" else
+               f"index.indexing.slowlog.threshold.index.{level}")
+        raw = self.settings.get(key)
+        if raw in (None, "", "-1", -1):
+            return None
+        try:
+            return _parse_time_seconds(raw)
+        except Exception:   # noqa: BLE001 — malformed threshold: off
+            return None
+
+    def _slowlog_record(self, kind: str, took_s: float,
+                        detail: str) -> None:
+        worst = None
+        for level in ("warn", "info", "debug", "trace"):
+            thr = self._slowlog_threshold(kind, level)
+            if thr is not None and took_s >= thr:
+                worst = level
+                break
+        if worst is None:
+            return
+        entry = {"level": worst, "took_ms": round(took_s * 1e3, 3),
+                 "index": self.name, "kind": kind, "source": detail,
+                 "timestamp": time.time()}
+        self.slow_log.append(entry)
+        del self.slow_log[: -self.SLOWLOG_MAX]
+        try:
+            import json as _json
+            fname = ("_index_search_slowlog.log" if kind == "query"
+                     else "_index_indexing_slowlog.log")
+            with open(os.path.join(self.path, fname), "a") as f:
+                f.write(_json.dumps(entry) + "\n")
+        except OSError:
+            pass
+
     def search(self, body: Optional[dict] = None,
                request_cache: Optional[bool] = None) -> ShardSearchResult:
         self._check_open()
+        t0 = time.perf_counter()
         if self.cluster_hooks is not None:
             r = self.cluster_hooks.search(self.name, body or {})
             if r is not None:
+                self._slowlog_record("query", time.perf_counter() - t0,
+                                     str(body or {})[:1000])
                 return r
         key = self._request_cache_key(body or {}, request_cache)
         if key is not None:
@@ -307,6 +369,8 @@ class IndexService:
             self.request_cache[key] = r
             while len(self.request_cache) > self.REQUEST_CACHE_MAX:
                 self.request_cache.popitem(last=False)
+        self._slowlog_record("query", time.perf_counter() - t0,
+                             str(body or {})[:1000])
         return r
 
     def count(self, body: Optional[dict] = None) -> int:

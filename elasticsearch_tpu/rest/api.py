@@ -244,6 +244,9 @@ class RestAPI:
         # unauthenticated; the node binary enables it via settings
         from ..lifecycle import DataStreamService, IlmService
         from ..security import SecurityService
+        from ..transport.remote import RemoteClusterRegistry
+        self.remotes = RemoteClusterRegistry(
+            lambda: self.cluster_settings)
         self.datastreams = DataStreamService(self)
         self.ilm = IlmService(self)
         self._async_searches: Dict[str, Any] = {}
@@ -2601,10 +2604,139 @@ class RestAPI:
         now = params.get("now_ms")
         return self.ilm.tick(int(now) if now else None)
 
+    def close(self) -> None:
+        """Release external resources (remote-cluster connections)."""
+        self.remotes.close()
+
     def h_remote_info(self, params, body):
-        """GET /_remote/info — remote-cluster connections (none
-        configured: empty object, ``RestRemoteClusterInfoAction``)."""
-        return {}
+        """GET /_remote/info — configured remote-cluster connections
+        (``RestRemoteClusterInfoAction``; connections dial lazily, so
+        ``connected`` reflects configuration here)."""
+        return {alias: {
+            "connected": True, "mode": "proxy",
+            "proxy_address": f"{host}:{port}",
+            "seeds": [f"{host}:{port}"],
+            "num_proxy_sockets_connected": 1,
+            "max_proxy_socket_connections": 1,
+            "initial_connect_timeout": "30s",
+            "skip_unavailable": False,
+        } for alias, (host, port) in sorted(
+            self.remotes.aliases().items())}
+
+    def _ccs_search(self, params, body, local_parts, remote_parts):
+        """Cross-cluster search (``TransportSearchAction`` +
+        ``SearchResponseMerger``): each remote executes the FULL
+        sub-search on its own cluster over ``rest:exec``; hits merge by
+        score/sort here. Aggregations, scroll and PIT require
+        single-cluster scope (documented divergence: the reference
+        merges final agg trees; this engine's exact reduce runs on
+        partials that don't cross the REST boundary)."""
+        search_body = _json_body(body)
+        if search_body.get("aggs") or search_body.get("aggregations") \
+                or params.get("scroll") or search_body.get("pit"):
+            raise IllegalArgumentError(
+                "aggregations/scroll/pit are not supported on "
+                "cross-cluster expressions by this engine")
+        # URL size/from would re-page each sub-search (h_search applies
+        # them over the body): page ONCE at this coordinator
+        size = int(params.get("size", search_body.get("size", 10)))
+        from_ = int(params.get("from", search_body.get("from", 0)))
+        sub_params = {k: v for k, v in params.items()
+                      if k not in ("size", "from")}
+        sub_body = dict(search_body, size=size + from_)
+        sub_body["from"] = 0
+        raw = json.dumps(sub_body).encode()
+        from urllib.parse import urlencode
+        q = urlencode(sub_params)      # re-encode: values were decoded
+        results: Dict[object, dict] = {}
+
+        def run_local():
+            out = self.h_search(dict(sub_params), raw,
+                                ",".join(local_parts))
+            if isinstance(out, tuple):
+                out = out[1]
+            results[None] = out if isinstance(out, dict) \
+                else json.loads(out)
+
+        def run_remote(alias, patterns):
+            st, _ct, payload = self.remotes.client(alias).exec(
+                "POST", f"/{','.join(patterns)}/_search", q, raw)
+            doc = json.loads(payload)
+            if st >= 400:
+                raise ElasticsearchError(
+                    f"remote cluster [{alias}] search failed: "
+                    f"{(doc.get('error') or {}).get('reason')}")
+            results[alias] = doc
+
+        # the reference fans out per cluster concurrently — a slow remote
+        # must cost max(latency), not sum
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=1 + len(remote_parts)) as ex:
+            futs = []
+            if local_parts:
+                futs.append(ex.submit(run_local))
+            for alias, patterns in sorted(remote_parts.items()):
+                futs.append(ex.submit(run_remote, alias, patterns))
+            for f in futs:
+                f.result()
+        responses = [(a, results[a]) for a in
+                     ([None] if local_parts else []) +
+                     sorted(remote_parts)]
+        merged_hits = []
+        total = 0
+        relation = "eq"
+        max_score = None
+        shards = {"total": 0, "successful": 0, "skipped": 0, "failed": 0}
+        took = 0
+        for ci, (alias, doc) in enumerate(responses):
+            h = doc.get("hits") or {}
+            t = h.get("total") or {}
+            total += int(t.get("value", 0))
+            if t.get("relation") == "gte":
+                relation = "gte"
+            ms = h.get("max_score")
+            if ms is not None:
+                max_score = ms if max_score is None else max(max_score,
+                                                             ms)
+            sh = doc.get("_shards") or {}
+            for k in shards:
+                shards[k] += int(sh.get(k, 0))
+            took = max(took, int(doc.get("took", 0)))
+            for hit in h.get("hits", []):
+                if alias is not None:
+                    hit = dict(hit, _index=f"{alias}:{hit['_index']}")
+                merged_hits.append((ci, hit))
+
+        clauses = None
+        if search_body.get("sort"):
+            from ..search.shard_search import normalize_sort
+            clauses = normalize_sort(search_body["sort"])
+
+        def sort_key(entry):
+            ci, hit = entry
+            sv = hit.get("sort")
+            if clauses and sv:
+                # the same direction-aware comparator every merge tier
+                # uses (dist_query.merge_sort_key)
+                from ..search.dist_query import merge_sort_key
+                return (0, merge_sort_key(clauses, sv), ci)
+            sc = hit.get("_score")
+            return (1, -(sc if sc is not None else float("-inf")), ci)
+
+        try:
+            merged_hits = sorted(merged_hits, key=sort_key)
+        except TypeError:
+            pass    # cross-cluster sort-type mismatch: keep the per-
+            #         cluster order intact (sorted() left it untouched)
+        page = [h for _ci, h in merged_hits[from_: from_ + size]]
+        return {
+            "took": took, "timed_out": False, "num_reduce_phases": 1,
+            "_shards": shards,
+            "_clusters": {"total": len(responses),
+                          "successful": len(responses), "skipped": 0},
+            "hits": {"total": {"value": total, "relation": relation},
+                     "max_score": max_score, "hits": page},
+        }
 
     def h_reload_secure_settings(self, params, body, node_id=None):
         """POST /_nodes/reload_secure_settings (reference:
@@ -5107,6 +5239,10 @@ class RestAPI:
         pfss_p = params.get("pre_filter_shard_size")
         if pfss_p is not None and int(pfss_p) < 1:
             raise IllegalArgumentError("preFilterShardSize must be >= 1")
+        local_parts, remote_parts = self.remotes.split_expression(index)
+        if remote_parts:
+            return self._ccs_search(params, body, local_parts,
+                                    remote_parts)
         names = self._resolve_search_indices(index, params)
         search_body = _json_body(body)
         # URL-param forms of fetch options (they OVERRIDE body _source

@@ -1,0 +1,119 @@
+"""Cross-cluster search (reference: transport/RemoteClusterService.java:64
++ SearchResponseMerger): alias:index expressions execute on the remote
+cluster over the transport and merge with local hits."""
+
+import json
+import os
+import time
+
+import pytest
+
+from elasticsearch_tpu.node.cluster_node import ClusterNode
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+BASE_PORT = 29770
+
+
+@pytest.fixture(scope="module")
+def remote_cluster(tmp_path_factory):
+    d = tmp_path_factory.mktemp("remote_ccs")
+    peers = {f"r{i}": ("127.0.0.1", BASE_PORT + i) for i in range(3)}
+    nodes = [ClusterNode(f"r{i}", "127.0.0.1", BASE_PORT + i, peers,
+                         str(d / f"r{i}"), seed=i) for i in range(3)]
+    deadline = time.monotonic() + 20.0
+    leader = None
+    while leader is None and time.monotonic() < deadline:
+        ls = [n for n in nodes if n.coordinator.mode == "LEADER"]
+        if len(ls) == 1:
+            leader = ls[0]
+        time.sleep(0.05)
+    assert leader is not None
+    try:
+        yield nodes
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+
+def req(api, method, path, body=None, query=""):
+    raw = json.dumps(body).encode() if body is not None else b""
+    st, _ct, payload = api.handle(method, path, query, raw)
+    try:
+        return st, json.loads(payload)
+    except ValueError:
+        return st, payload
+
+
+def test_cross_cluster_search_merges_hits(remote_cluster, tmp_path):
+    remote = remote_cluster[0].rest
+    st, _ct, _out = remote.handle("PUT", "/shared-logs", "", json.dumps(
+        {"settings": {"number_of_shards": 2,
+                      "number_of_replicas": 0}}).encode())
+    assert st == 200
+    for i in range(3):
+        st, _ct, _out = remote.handle(
+            "PUT", f"/shared-logs/_doc/r{i}", "refresh=true",
+            json.dumps({"msg": "remote event", "rank": 10 + i}).encode())
+        assert st in (200, 201)
+
+    api = RestAPI(IndicesService(str(tmp_path)))
+    req(api, "PUT", "/shared-logs", None)
+    for i in range(2):
+        req(api, "PUT", f"/shared-logs/_doc/l{i}",
+            {"msg": "local event", "rank": i}, query="refresh=true")
+
+    # register the remote under alias c2 via cluster settings
+    st, _ = req(api, "PUT", "/_cluster/settings", {"persistent": {
+        "cluster.remote.c2.seeds": [f"127.0.0.1:{BASE_PORT}"]}})
+    assert st == 200
+    st, info = req(api, "GET", "/_remote/info")
+    assert info["c2"]["connected"] and \
+        info["c2"]["seeds"] == [f"127.0.0.1:{BASE_PORT}"]
+
+    # CCS: local + remote merge, remote hits carry the alias prefix
+    st, out = req(api, "POST", "/shared-logs,c2:shared-logs/_search",
+                  {"query": {"match": {"msg": "event"}},
+                   "sort": [{"rank": "desc"}], "size": 10})
+    assert st == 200, out
+    hits = out["hits"]["hits"]
+    assert out["hits"]["total"]["value"] == 5
+    assert out["_clusters"]["successful"] == 2
+    assert [h["_id"] for h in hits] == ["r2", "r1", "r0", "l1", "l0"]
+    assert hits[0]["_index"] == "c2:shared-logs"
+    assert hits[-1]["_index"] == "shared-logs"
+
+    # remote-only expression
+    st, out = req(api, "POST", "/c2:shared-*/_search",
+                  {"query": {"match_all": {}}})
+    assert out["hits"]["total"]["value"] == 3
+
+    # aggs over remotes: clear divergence error, not silent wrong data
+    st, out = req(api, "POST", "/c2:shared-logs/_search",
+                  {"size": 0, "aggs": {"m": {"max": {"field": "rank"}}}})
+    assert st == 400
+
+
+def test_ccs_respects_url_paging_once(remote_cluster, tmp_path):
+    """URL ?from/&size page once at the CCS coordinator, not per
+    cluster (SearchResponseMerger re-pages the merged set)."""
+    remote = remote_cluster[0].rest
+    for i in range(4):
+        remote.handle("PUT", f"/pg/_doc/r{i}", "refresh=true",
+                      json.dumps({"rank": 10 + i}).encode())
+    api = RestAPI(IndicesService(str(tmp_path)))
+    for i in range(4):
+        req(api, "PUT", f"/pg/_doc/l{i}", {"rank": i},
+            query="refresh=true")
+    req(api, "PUT", "/_cluster/settings", {"persistent": {
+        "cluster.remote.c2.seeds": [f"127.0.0.1:{BASE_PORT}"]}})
+    st, out = req(api, "POST", "/pg,c2:pg/_search",
+                  {"sort": [{"rank": "desc"}]}, query="from=2&size=3")
+    assert st == 200, out
+    ids = [h["_id"] for h in out["hits"]["hits"]]
+    # global desc order: r3 r2 r1 r0 l3 l2 l1 l0 → from=2 size=3
+    assert ids == ["r1", "r0", "l3"], ids
+    assert out["hits"]["total"]["value"] == 8

@@ -499,3 +499,27 @@ def test_async_search_surface(api):
     assert st == 200
     st, _ = req(api, "GET", f"/_async_search/{sid}")
     assert st == 404
+
+
+def test_slow_logs_record_over_threshold(api, tmp_path):
+    """index.search.slowlog / indexing.slowlog thresholds: entries land
+    in the in-memory ring and the per-index log file
+    (SearchSlowLog.java:43 / IndexingSlowLog.java:46)."""
+    import os
+    req(api, "PUT", "/sl", {"settings": {
+        "index.search.slowlog.threshold.query.warn": "0ms",
+        "index.indexing.slowlog.threshold.index.warn": "0ms"}})
+    req(api, "PUT", "/sl/_doc/1", {"v": 1})
+    req(api, "POST", "/sl/_refresh")
+    req(api, "POST", "/sl/_search", {"query": {"match_all": {}}})
+    svc = api.indices.get("sl")
+    kinds = {e["kind"] for e in svc.slow_log}
+    assert kinds == {"index", "query"}, svc.slow_log
+    assert all(e["level"] == "warn" for e in svc.slow_log)
+    assert os.path.exists(os.path.join(svc.path,
+                                       "_index_search_slowlog.log"))
+    # thresholds off -> nothing records
+    req(api, "PUT", "/quiet", None)
+    req(api, "PUT", "/quiet/_doc/1", {"v": 1})
+    req(api, "POST", "/quiet/_search", {"query": {"match_all": {}}})
+    assert api.indices.get("quiet").slow_log == []
