@@ -4698,11 +4698,20 @@ class RestAPI:
             aggregations = self._reduce_cross_index_aggs(
                 names, search_body)
         shards_total = sum(self.indices.indices[n].num_shards for n in names)
+        failures = []
+        for n, r in results:
+            for f in (r.shard_failures or []):
+                failures.append(dict(f, index=n))
+        shards_out = {"total": shards_total,
+                      "successful": shards_total - len(failures),
+                      "skipped": skipped_shards,
+                      "failed": len(failures)}
+        if failures:
+            shards_out["failures"] = failures
         out = {
             "took": int((time.time() - t0) * 1000),
             "timed_out": False,
-            "_shards": {"total": shards_total, "successful": shards_total,
-                        "skipped": skipped_shards, "failed": 0},
+            "_shards": shards_out,
             "hits": {
                 "total": {"value": total, "relation": relation},
                 "max_score": max(max_scores) if max_scores else None,

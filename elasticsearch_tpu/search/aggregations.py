@@ -32,7 +32,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..common.errors import IllegalArgumentError, ParsingError
+from ..common.errors import (ElasticsearchError,
+                             IllegalArgumentError, ParsingError)
 from ..index.mapping import (
     BooleanFieldType, DateFieldType, KeywordFieldType, MapperService,
     NumberFieldType, RangeFieldType, RuntimeFieldType, format_date_millis,
@@ -667,6 +668,15 @@ def _hdr_quantize(chosen: np.ndarray, allv: np.ndarray,
     return np.asarray(out)
 
 
+class HdrNegativeValueError(ElasticsearchError):
+    """HDR histograms cannot record negatives — the reference throws
+    ArrayIndexOutOfBoundsException from DoubleHistogram, failing THAT
+    SHARD (its conformance suite asserts exactly this failure type)."""
+
+    status = 500
+    error_type = "array_index_out_of_bounds_exception"
+
+
 class PercentilesAgg(_NumericMetricAgg):
     """Exact percentiles via full value collection (the reference
     approximates with TDigest — ``metrics/TDigestState``; exact is
@@ -703,7 +713,11 @@ class PercentilesAgg(_NumericMetricAgg):
             self.hdr_digits = int(digits)
 
     def collect(self, ctx, seg, mask):
-        return {"values": self._matched_values(ctx, seg, mask)}
+        vals = self._matched_values(ctx, seg, mask)
+        if self.hdr and vals.size and float(np.min(vals)) < 0:
+            raise HdrNegativeValueError(
+                "Histogram recorded value cannot be negative.")
+        return {"values": vals}
 
     def _quantiles(self, allv: np.ndarray):
         if self.hdr:

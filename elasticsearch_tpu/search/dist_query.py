@@ -342,8 +342,62 @@ class DistributedSearcher:
                 knn_override=(knn_overrides[shard_idx]
                               if knn_overrides is not None else None)))
 
+        # -- per-shard aggregation pre-collect (partial-failure scope) ------
+        # an agg that errors on ONE shard (e.g. HDR percentiles meeting a
+        # negative value) fails THAT shard — its hits drop, the request
+        # survives with _shards.failures (the reference's
+        # ShardSearchFailure semantics)
+        shard_failures: List[dict] = []
+        failed_shards: set = set()
+        precollected = None
+        aggs = None
+        if aggs_spec and not collect_agg_inputs:
+            from ..common.errors import ElasticsearchError
+            aggs = parse_aggs(aggs_spec)
+            need_scores = _tree_needs_scores(aggs)
+            precollected = {}
+            from .aggregations import PipelineAggregator, _collect_fn
+            for shard_idx, (shard, r) in enumerate(zip(self.shards,
+                                                       per_shard)):
+                seg_scores = {seg.seg_id: sc
+                              for seg, _, sc in (r.agg_inputs or [])
+                              if sc is not None} if need_scores else {}
+                ctx = AggregationContext(self.mapper,
+                                         shard_ctx=shard.ctx,
+                                         seg_scores=seg_scores)
+                got: Dict[str, list] = {}
+                try:
+                    for name, agg in aggs.items():
+                        if isinstance(agg, PipelineAggregator):
+                            continue
+                        fn = _collect_fn(agg, ctx)
+                        got[name] = [fn(ctx, seg, mask)
+                                     for seg, mask, _ in
+                                     (r.agg_inputs or [])]
+                except ElasticsearchError as e:
+                    failed_shards.add(shard_idx)
+                    shard_failures.append({
+                        "shard": shard_idx, "node": None,
+                        "reason": {"type": e.error_type,
+                                   "reason": str(e)},
+                        "status": e.status,
+                        "_exc": e})
+                    continue
+                for name, parts in got.items():
+                    precollected.setdefault(name, []).extend(parts)
+            if failed_shards and not any(precollected.values()):
+                # every data-bearing shard failed (empty shards succeed
+                # vacuously): the request fails with the underlying
+                # cause (the reference's SearchPhaseExecutionException
+                # carries the cause's status — a 400 validation error
+                # stays a 400)
+                raise shard_failures[0]["_exc"]
+            for f in shard_failures:
+                f.pop("_exc", None)
+
         # -- totals ---------------------------------------------------------
-        total = sum(r.total for r in per_shard)
+        total = sum(r.total for i, r in enumerate(per_shard)
+                    if i not in failed_shards)
         total_relation = "gte" if any(r.total_relation == "gte"
                                       for r in per_shard) else "eq"
         if isinstance(track_total_hits, int) and not isinstance(
@@ -354,6 +408,8 @@ class DistributedSearcher:
         # -- merge hits (SearchPhaseController.sortDocs) --------------------
         merged: List[Tuple[tuple, int, ShardHit]] = []
         for shard_idx, r in enumerate(per_shard):
+            if shard_idx in failed_shards:
+                continue
             for h in r.hits:
                 merged.append((self._merge_key(clauses, use_field_sort,
                                                shard_idx, h),
@@ -392,19 +448,10 @@ class DistributedSearcher:
                                    for shard, r in zip(self.shards,
                                                        per_shard)]
         elif aggs_spec:
-            aggs = parse_aggs(aggs_spec)
-            triples = []
-            for shard, r in zip(self.shards, per_shard):
-                seg_scores = {}
-                if _tree_needs_scores(aggs):
-                    seg_scores = {seg.seg_id: sc
-                                  for seg, _, sc in (r.agg_inputs or [])
-                                  if sc is not None}
-                ctx = AggregationContext(self.mapper, shard_ctx=shard.ctx,
-                                         seg_scores=seg_scores)
-                for seg, mask, _ in (r.agg_inputs or []):
-                    triples.append((ctx, seg, mask))
-            agg_results = run_aggregations_multi(aggs, triples)
+            # partials were pre-collected per shard above (with failure
+            # scoping); one shared reduce over the survivors
+            agg_results = run_aggregations_multi(
+                aggs, [], extra_partials=precollected or {})
 
         suggest_out = None
         if suggest_spec:
@@ -422,7 +469,8 @@ class DistributedSearcher:
                                    hits=hits, max_score=max_score,
                                    aggregations=agg_results,
                                    profile=profile_out,
-                                   suggest=suggest_out)
+                                   suggest=suggest_out,
+                                   shard_failures=shard_failures or None)
         result.agg_inputs_by_shard = agg_inputs_by_shard
         return result
 

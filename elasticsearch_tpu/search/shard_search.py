@@ -97,6 +97,10 @@ class ShardSearchResult:
     #: coordinator) wants ONE global reduce across shards
     agg_inputs: Optional[List[Tuple[Segment, np.ndarray,
                                     Optional[np.ndarray]]]] = None
+    #: per-shard partial failures (aggs that errored on one shard — the
+    #: reference's ShardSearchFailure list; hits of failed shards are
+    #: excluded, the rest of the response stands)
+    shard_failures: Optional[List[dict]] = None
 
 
 def _knn_score_transform(similarity: str, sim):
