@@ -965,6 +965,24 @@ class ClusterNode:
             aggs_out = run_aggregations_multi(aggs, [],
                                               extra_partials=merged)
         out = {"total": total, "hits": hits}
+        all_failures = [f for r in results
+                        for f in (r.get("failures") or [])]
+        if all_failures:
+            def _has_partials(r):
+                try:
+                    return any(_undata64(r.get("agg_partials", ""))
+                               .values())
+                except Exception:   # noqa: BLE001
+                    return False
+            if all(not r.get("hits") for r in results) and \
+                    not any(_has_partials(r) for r in results):
+                # every data shard cluster-wide failed: raise the cause
+                f0 = all_failures[0]["reason"]
+                err = ElasticsearchError(f0.get("reason", "shard failure"))
+                err.error_type = f0.get("type", "exception")
+                err.status = int(all_failures[0].get("status", 500))
+                raise err
+            out["failures"] = all_failures
         if aggs_out is not None:
             out["aggregations"] = aggs_out
         # suggest merges across nodes (options dedupe/re-rank; per-node
@@ -1266,12 +1284,15 @@ class ClusterNode:
         if want_partials and aggs_spec:
             from ..search.aggregations import (AggregationContext,
                                                PipelineAggregator,
-                                               parse_aggs)
+                                               _collect_fn, parse_aggs)
             from ..search.shard_search import _tree_needs_scores
             aggs = parse_aggs(aggs_spec)
             need_scores = _tree_needs_scores(aggs)
             partials: Dict[str, list] = {}
-            for shard_searcher, agg_inputs in (r.agg_inputs_by_shard or []):
+            failures: List[dict] = []
+            failed_pos: List[int] = []
+            for pos, (shard_searcher, agg_inputs) in enumerate(
+                    r.agg_inputs_by_shard or []):
                 seg_scores = {seg.seg_id: sc for seg, _, sc in agg_inputs
                               if sc is not None} if need_scores else {}
                 # wire=True: aggregators (at ANY tree depth) whose local
@@ -1281,13 +1302,55 @@ class ClusterNode:
                                          shard_ctx=shard_searcher.ctx,
                                          seg_scores=seg_scores,
                                          wire=True)
-                from ..search.aggregations import _collect_fn
-                for name_, agg in aggs.items():
-                    if isinstance(agg, PipelineAggregator):
-                        continue
-                    partials.setdefault(name_, []).extend(
-                        _collect_fn(agg, ctx)(ctx, seg, mask)
-                        for seg, mask, _ in agg_inputs)
+                got: Dict[str, list] = {}
+                try:
+                    for name_, agg in aggs.items():
+                        if isinstance(agg, PipelineAggregator):
+                            continue
+                        got[name_] = [
+                            _collect_fn(agg, ctx)(ctx, seg, mask)
+                            for seg, mask, _ in agg_inputs]
+                except ElasticsearchError as e:
+                    # per-shard failure scope (ShardSearchFailure): this
+                    # shard's hits drop below; the request survives
+                    failed_pos.append(pos)
+                    failures.append({
+                        "shard": int(payload["shards"][pos]),
+                        "node": self.node_id,
+                        "reason": {"type": e.error_type,
+                                   "reason": str(e)},
+                        "status": e.status})
+                    continue
+                for name_, parts in got.items():
+                    partials.setdefault(name_, []).extend(parts)
+            if failed_pos:
+                if not any(partials.values()):
+                    # every data-bearing shard here failed (empty shards
+                    # are vacuous): surface the cause — the coordinator
+                    # decides whether OTHER nodes survived
+                    out["all_failed"] = True
+                surviving = [sid for i, sid in
+                             enumerate(payload["shards"])
+                             if i not in failed_pos]
+                if surviving:
+                    # recompute hits over the surviving shard subset
+                    # (failure path only — correctness over cost)
+                    body2 = {k: v for k, v in body.items()
+                             if k not in ("aggs", "aggregations")}
+                    r2 = self._local_dist_searcher(
+                        name, surviving,
+                        payload.get("global_stats")).search(body2)
+                    out["total"] = r2.total
+                    out["hits"] = [
+                        {"id": h.doc_id, "score": h.score,
+                         "sort": h.sort_values, "source": h.source,
+                         "fields": h.fields, "highlight": h.highlight,
+                         "seq_no": h.seq_no, "ignored": h.ignored,
+                         "inner_hits": h.inner_hits} for h in r2.hits]
+                else:
+                    out["total"] = 0
+                    out["hits"] = []
+                out["failures"] = failures
             out["agg_partials"] = _data64(partials)
         return out
 

@@ -258,7 +258,8 @@ class ClusterHooks:
         return ShardSearchResult(
             total=total, total_relation=relation, hits=hits,
             max_score=max_score, aggregations=out.get("aggregations"),
-            suggest=out.get("suggest"), profile=out.get("profile"))
+            suggest=out.get("suggest"), profile=out.get("profile"),
+            shard_failures=out.get("failures"))
 
     def count(self, index: str, body: dict):
         node = self.rest.node
