@@ -328,6 +328,9 @@ class RestAPI:
         add("DELETE", "/_ilm/policy/{name}", self.h_delete_ilm_policy)
         add("GET", "/{index}/_ilm/explain", self.h_ilm_explain)
         add("POST", "/_ilm/_tick", self.h_ilm_tick)
+        add("GET,POST", "/_sql", self.h_sql)
+        add("POST", "/_sql/translate", self.h_sql_translate)
+        add("POST", "/_sql/close", self.h_sql_close)
         add("PUT,POST", "/_security/api_key", self.h_create_api_key)
         add("DELETE", "/_security/api_key", self.h_invalidate_api_key)
         add("GET", "/_security/api_key", self.h_get_api_keys)
@@ -588,6 +591,13 @@ class RestAPI:
                 if task.running and \
                         not getattr(task, "async_detached", False):
                     self.task_manager.unregister(task)
+            if isinstance(result, tuple) and len(result) == 3:
+                # (status, content_type, str|bytes) — non-JSON bodies
+                # (SQL txt/csv/tsv, hot_threads text) pick their own type
+                st3, ct3, body3 = result
+                if isinstance(body3, str):
+                    body3 = body3.encode()
+                return st3, ct3, body3
             if isinstance(result, tuple):
                 status, payload = result
             else:
@@ -2575,6 +2585,90 @@ class RestAPI:
         if task.running:
             self.task_manager.cancel(task, "deleted")
         return {"acknowledged": True}
+
+    # ------------------------------------------------------------------
+    # internal re-dispatch seam (SQL/EQL/graph/transform ride the full
+    # cluster-aware search path by calling back through handle())
+    # ------------------------------------------------------------------
+
+    def internal_search(self, index: str, body: dict,
+                        params: str = "") -> dict:
+        """Run a search as an already-authenticated internal dispatch and
+        return the parsed response; ES-shaped errors re-raise."""
+        prev = getattr(self._internal_tls, "active", False)
+        self._internal_tls.active = True
+        try:
+            st, _ct, out = self.handle(
+                "POST", f"/{index}/_search", params,
+                json.dumps(body).encode())
+        finally:
+            self._internal_tls.active = prev
+        doc = json.loads(out)
+        if st >= 400:
+            err = (doc.get("error") or {})
+            if isinstance(err, str):
+                err = {"reason": err}
+            e = ElasticsearchError(err.get("reason", "search failed"))
+            e.error_type = err.get("type", "exception")
+            e.status = st
+            raise e
+        return doc
+
+    def internal_bulk(self, index: str, lines: List[dict],
+                      refresh: bool = False) -> dict:
+        """Internal bulk write (transform/rollup/watcher destinations)."""
+        prev = getattr(self._internal_tls, "active", False)
+        self._internal_tls.active = True
+        try:
+            payload = "".join(json.dumps(ln) + "\n" for ln in lines)
+            st, _ct, out = self.handle(
+                "POST", f"/{index}/_bulk",
+                "refresh=true" if refresh else "",
+                payload.encode())
+        finally:
+            self._internal_tls.active = prev
+        doc = json.loads(out)
+        if st >= 400:
+            raise ElasticsearchError(str(doc.get("error")))
+        return doc
+
+    # ------------------------------------------------------------------
+    # SQL (x-pack/plugin/sql analog — xpack/sql.py)
+    # ------------------------------------------------------------------
+
+    @property
+    def sql(self):
+        if getattr(self, "_sql_svc", None) is None:
+            from ..xpack.sql import SqlService
+
+            def mapper_of(table):
+                names = self.indices.resolve(table)
+                return self.indices.indices[names[0]].mapper \
+                    if names else None
+            self._sql_svc = SqlService(
+                lambda index, b: self.internal_search(index, b),
+                mapper_of)
+        return self._sql_svc
+
+    def h_sql(self, params, body):
+        payload = _json_body(body)
+        fmt = params.get("format", "json")
+        out = self.sql.execute(payload, fmt)
+        if isinstance(out, str):
+            ct = {"csv": "text/csv; charset=UTF-8",
+                  "tsv": "text/tab-separated-values; charset=UTF-8",
+                  "txt": "text/plain; charset=UTF-8"}.get(
+                      fmt, "text/plain; charset=UTF-8")
+            return 200, ct, out
+        return out
+
+    def h_sql_translate(self, params, body):
+        return self.sql.translate(_json_body(body))
+
+    def h_sql_close(self, params, body):
+        payload = _json_body(body)
+        found = self.sql.close_cursor(payload.get("cursor", ""))
+        return {"succeeded": found}
 
     def h_create_data_stream(self, params, body, name):
         return self.datastreams.create(name)

@@ -213,9 +213,22 @@ class CompositeAgg(BucketAggregator):
                     from .aggregations import _tz_offset_ms
                     shift -= _tz_offset_ms(src["time_zone"],
                                            float(vals[0]))
-                for d, v in zip(docs, vals):
-                    col[int(d)].append(
-                        float(np.floor((v - shift) / iv) * iv + shift))
+                cal = src.get("calendar")
+                if cal is not None and src["kind"] == "date_histogram":
+                    # true calendar rounding (weeks start Monday, months/
+                    # quarters/years at their calendar boundary) — same
+                    # rule as the standalone date_histogram
+                    from .aggregations import (_CALENDAR_INTERVALS,
+                                               _calendar_floor)
+                    unit = _CALENDAR_INTERVALS.get(cal, cal)
+                    keys = _calendar_floor(
+                        np.asarray(vals, np.float64) - shift, unit) + shift
+                    for d, k in zip(docs, keys):
+                        col[int(d)].append(float(k))
+                else:
+                    for d, v in zip(docs, vals):
+                        col[int(d)].append(
+                            float(np.floor((v - shift) / iv) * iv + shift))
         # dedupe per doc, preserving order
         return [list(dict.fromkeys(c)) for c in col]
 

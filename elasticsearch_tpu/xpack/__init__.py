@@ -1,0 +1,9 @@
+"""X-Pack analog features (SQL, EQL, transform, rollup, watcher, enrich,
+graph, CCR) re-designed for the TPU-native stack.
+
+Each feature translates its surface language down to the same query-DSL /
+aggregation machinery the `_search` path runs (and therefore inherits the
+cluster scatter-gather and the TPU scoring plane for free), instead of
+maintaining a parallel execution engine the way the reference's separate
+x-pack plugins do (reference: ``x-pack/plugin/*``).
+"""

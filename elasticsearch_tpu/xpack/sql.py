@@ -1,0 +1,1086 @@
+"""SQL front-end: parse a SQL subset, fold it into query DSL + aggs, and
+serve ES-SQL-shaped responses (columns/rows, cursors, txt/csv/tsv formats).
+
+Reference: ``x-pack/plugin/sql`` — parser → analyzer → optimizer → physical
+plan "folding" into a search request (``sql/{parser,analysis,planner}/``).
+This implementation keeps the same *observable* pipeline (SELECT folds to a
+search body; GROUP BY folds to a composite aggregation with metric
+sub-aggs; cursors page through composite ``after_key``s) but is a compact
+recursive-descent parser + direct folder rather than a multi-stage rule
+optimizer: the heavy lifting (scoring, agg collection) already lives in the
+TPU search path the folded request executes on.
+
+Supported surface (documented subset):
+  SELECT */cols/aggregate-functions [AS alias]
+  FROM index [WHERE cond] [GROUP BY cols] [HAVING cond]
+  [ORDER BY col [ASC|DESC], ...] [LIMIT n]
+Predicates: =, !=/<>, <, <=, >, >=, [NOT] LIKE, [NOT] IN (...),
+BETWEEN..AND, IS [NOT] NULL, AND/OR/NOT, MATCH(field, 'text'),
+QUERY('query string'), SCORE().
+Aggregates: COUNT(*|col|DISTINCT col), SUM, AVG, MIN, MAX.
+Scalar date parts: YEAR/MONTH/DAY (host-evaluated over group keys).
+"""
+from __future__ import annotations
+
+import json
+import re
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common.errors import ElasticsearchError, IllegalArgumentError
+
+
+class SqlParsingError(ElasticsearchError):
+    status = 400
+    error_type = "parsing_exception"
+
+
+class SqlVerificationError(ElasticsearchError):
+    """Unknown column / invalid combination (``VerificationException``)."""
+    status = 400
+    error_type = "verification_exception"
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RX = re.compile(r"""
+    \s*(?:
+      (?P<num>-?\d+\.\d+|-?\d+)
+    | '(?P<str>(?:[^']|'')*)'
+    | "(?P<qid>(?:[^"]|"")*)"
+    | (?P<op><=|>=|!=|<>|=|<|>|\(|\)|,|\*|\.)
+    | (?P<id>[A-Za-z_][A-Za-z0-9_.*-]*)
+    )""", re.VERBOSE)
+
+_KEYWORDS = {
+    "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
+    "AND", "OR", "NOT", "LIKE", "IN", "BETWEEN", "IS", "NULL", "AS",
+    "ASC", "DESC", "DISTINCT", "TRUE", "FALSE",
+}
+
+
+def _tokenize(text: str) -> List[Tuple[str, Any]]:
+    out: List[Tuple[str, Any]] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RX.match(text, pos)
+        if m is None or m.end() == pos:
+            rest = text[pos:].strip()
+            if not rest:
+                break
+            raise SqlParsingError(f"line 1:{pos + 1}: token recognition "
+                                  f"error at: '{rest[0]}'")
+        pos = m.end()
+        if m.group("num") is not None:
+            n = m.group("num")
+            out.append(("num", float(n) if "." in n else int(n)))
+        elif m.group("str") is not None:
+            out.append(("str", m.group("str").replace("''", "'")))
+        elif m.group("qid") is not None:
+            out.append(("id", m.group("qid").replace('""', '"')))
+        elif m.group("op") is not None:
+            out.append(("op", m.group("op")))
+        else:
+            word = m.group("id")
+            if word.upper() in _KEYWORDS:
+                out.append(("kw", word.upper()))
+            else:
+                out.append(("id", word))
+    out.append(("eof", None))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+class Expr:
+    pass
+
+
+class Col(Expr):
+    def __init__(self, name: str):
+        self.name = name
+
+
+class Lit(Expr):
+    def __init__(self, value: Any):
+        self.value = value
+
+
+class Func(Expr):
+    def __init__(self, name: str, args: List[Expr], distinct: bool = False):
+        self.name = name.upper()
+        self.args = args
+        self.distinct = distinct
+
+
+class Cmp(Expr):
+    def __init__(self, op: str, left: Expr, right: Expr):
+        self.op, self.left, self.right = op, left, right
+
+
+class Like(Expr):
+    def __init__(self, col: Expr, pattern: str, negate: bool):
+        self.col, self.pattern, self.negate = col, pattern, negate
+
+
+class InList(Expr):
+    def __init__(self, col: Expr, values: List[Any], negate: bool):
+        self.col, self.values, self.negate = col, values, negate
+
+
+class Between(Expr):
+    def __init__(self, col: Expr, low: Any, high: Any):
+        self.col, self.low, self.high = col, low, high
+
+
+class IsNull(Expr):
+    def __init__(self, col: Expr, negate: bool):
+        self.col, self.negate = col, negate
+
+
+class Bool(Expr):
+    def __init__(self, op: str, parts: List[Expr]):
+        self.op, self.parts = op, parts      # "and" | "or"
+
+
+class Not(Expr):
+    def __init__(self, part: Expr):
+        self.part = part
+
+
+class SelectItem:
+    def __init__(self, expr: Expr, alias: Optional[str]):
+        self.expr, self.alias = expr, alias
+
+
+class Query:
+    def __init__(self):
+        self.items: List[SelectItem] = []
+        self.star = False
+        self.table: str = ""
+        self.where: Optional[Expr] = None
+        self.group_by: List[Expr] = []
+        self.having: Optional[Expr] = None
+        self.order_by: List[Tuple[Expr, bool]] = []   # (expr, asc)
+        self.limit: Optional[int] = None
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, Any]]):
+        self.toks = tokens
+        self.i = 0
+
+    # -- token helpers --------------------------------------------------
+    def peek(self) -> Tuple[str, Any]:
+        return self.toks[self.i]
+
+    def next(self) -> Tuple[str, Any]:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept_kw(self, *words: str) -> Optional[str]:
+        k, v = self.peek()
+        if k == "kw" and v in words:
+            self.i += 1
+            return v
+        return None
+
+    def expect_kw(self, word: str) -> None:
+        if not self.accept_kw(word):
+            k, v = self.peek()
+            raise SqlParsingError(f"expected {word} but found [{v}]")
+
+    def accept_op(self, op: str) -> bool:
+        k, v = self.peek()
+        if k == "op" and v == op:
+            self.i += 1
+            return True
+        return False
+
+    # -- grammar --------------------------------------------------------
+    def parse(self) -> Query:
+        q = Query()
+        self.expect_kw("SELECT")
+        if self.accept_op("*"):
+            q.star = True
+        else:
+            q.items.append(self.select_item())
+            while self.accept_op(","):
+                q.items.append(self.select_item())
+        self.expect_kw("FROM")
+        q.table = self.table_name()
+        if self.accept_kw("WHERE"):
+            q.where = self.expr()
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            q.group_by.append(self.primary())
+            while self.accept_op(","):
+                q.group_by.append(self.primary())
+        if self.accept_kw("HAVING"):
+            q.having = self.expr()
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                e = self.primary()
+                asc = True
+                if self.accept_kw("DESC"):
+                    asc = False
+                else:
+                    self.accept_kw("ASC")
+                q.order_by.append((e, asc))
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("LIMIT"):
+            k, v = self.next()
+            if k != "num" or not isinstance(v, int):
+                raise SqlParsingError("LIMIT expects an integer")
+            q.limit = v
+        k, v = self.peek()
+        if k != "eof":
+            raise SqlParsingError(f"unexpected trailing input [{v}]")
+        return q
+
+    def table_name(self) -> str:
+        k, v = self.next()
+        if k not in ("id", "str"):
+            raise SqlParsingError(f"expected index name but found [{v}]")
+        name = str(v)
+        # frozen-index syntax and catalog-qualified names are not needed;
+        # allow  alias:index  (CCS) and patterns straight through
+        return name
+
+    def select_item(self) -> SelectItem:
+        e = self.primary()
+        alias = None
+        if self.accept_kw("AS"):
+            k, v = self.next()
+            if k != "id":
+                raise SqlParsingError("expected alias name")
+            alias = v
+        else:
+            k, v = self.peek()
+            if k == "id":
+                self.i += 1
+                alias = v
+        return SelectItem(e, alias)
+
+    def expr(self) -> Expr:
+        parts = [self.and_expr()]
+        while self.accept_kw("OR"):
+            parts.append(self.and_expr())
+        return parts[0] if len(parts) == 1 else Bool("or", parts)
+
+    def and_expr(self) -> Expr:
+        parts = [self.not_expr()]
+        while self.accept_kw("AND"):
+            parts.append(self.not_expr())
+        return parts[0] if len(parts) == 1 else Bool("and", parts)
+
+    def not_expr(self) -> Expr:
+        if self.accept_kw("NOT"):
+            return Not(self.not_expr())
+        return self.predicate()
+
+    def predicate(self) -> Expr:
+        left = self.primary()
+        k, v = self.peek()
+        if k == "op" and v in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            self.i += 1
+            right = self.primary()
+            return Cmp("!=" if v == "<>" else v, left, right)
+        negate = bool(self.accept_kw("NOT"))
+        if self.accept_kw("LIKE"):
+            kk, vv = self.next()
+            if kk != "str":
+                raise SqlParsingError("LIKE expects a string pattern")
+            return Like(left, vv, negate)
+        if self.accept_kw("IN"):
+            if not self.accept_op("("):
+                raise SqlParsingError("IN expects a value list")
+            vals = []
+            while True:
+                kk, vv = self.next()
+                if kk not in ("num", "str", "kw"):
+                    raise SqlParsingError("IN expects literal values")
+                vals.append(self._kw_literal(kk, vv))
+                if self.accept_op(")"):
+                    break
+                if not self.accept_op(","):
+                    raise SqlParsingError("expected , or ) in IN list")
+            return InList(left, vals, negate)
+        if self.accept_kw("BETWEEN"):
+            lo = self.literal_value()
+            self.expect_kw("AND")
+            hi = self.literal_value()
+            e: Expr = Between(left, lo, hi)
+            return Not(e) if negate else e
+        if negate:
+            raise SqlParsingError("NOT must precede LIKE/IN/BETWEEN here")
+        if self.accept_kw("IS"):
+            neg = bool(self.accept_kw("NOT"))
+            self.expect_kw("NULL")
+            return IsNull(left, neg)
+        return left
+
+    @staticmethod
+    def _kw_literal(kind: str, val: Any) -> Any:
+        if kind == "kw":
+            if val == "TRUE":
+                return True
+            if val == "FALSE":
+                return False
+            if val == "NULL":
+                return None
+            raise SqlParsingError(f"unexpected keyword [{val}] as value")
+        return val
+
+    def literal_value(self) -> Any:
+        k, v = self.next()
+        if k not in ("num", "str", "kw"):
+            raise SqlParsingError(f"expected a literal but found [{v}]")
+        return self._kw_literal(k, v)
+
+    def primary(self) -> Expr:
+        if self.accept_op("("):
+            e = self.expr()
+            if not self.accept_op(")"):
+                raise SqlParsingError("expected )")
+            return e
+        k, v = self.next()
+        if k == "num" or k == "str":
+            return Lit(v)
+        if k == "kw" and v in ("TRUE", "FALSE", "NULL"):
+            return Lit({"TRUE": True, "FALSE": False, "NULL": None}[v])
+        if k == "id":
+            if self.accept_op("("):
+                return self.func_call(v)
+            return Col(v)
+        raise SqlParsingError(f"unexpected token [{v}]")
+
+    def func_call(self, name: str) -> Func:
+        distinct = bool(self.accept_kw("DISTINCT"))
+        args: List[Expr] = []
+        if self.accept_op(")"):
+            return Func(name, args, distinct)
+        while True:
+            if self.accept_op("*"):
+                args.append(Lit("*"))
+            else:
+                args.append(self.primary())
+            if self.accept_op(")"):
+                break
+            if not self.accept_op(","):
+                raise SqlParsingError("expected , or ) in argument list")
+        return Func(name, args, distinct)
+
+
+def parse_sql(text: str) -> Query:
+    return _Parser(_tokenize(text)).parse()
+
+
+# ---------------------------------------------------------------------------
+# folding: WHERE → query DSL
+# ---------------------------------------------------------------------------
+
+_CMP_RANGE = {"<": "lt", "<=": "lte", ">": "gt", ">=": "gte"}
+_AGG_FUNCS = {"COUNT", "SUM", "AVG", "MIN", "MAX"}
+_DATE_PARTS = {"YEAR", "MONTH", "DAY", "HOUR", "MINUTE"}
+
+
+def _col_name(e: Expr) -> str:
+    if not isinstance(e, Col):
+        raise SqlVerificationError("expected a column reference")
+    return e.name
+
+
+def _like_to_wildcard(pattern: str) -> str:
+    # SQL % / _ → wildcard * / ?, literal escapes kept simple
+    return pattern.replace("%", "*").replace("_", "?")
+
+
+def fold_condition(e: Expr, resolve=None) -> dict:
+    """Fold a WHERE/HAVING-free condition into query DSL.
+
+    ``resolve`` maps a column name to the field exact operations should
+    target — ES SQL silently uses a text field's ``.keyword`` sub-field
+    for exact semantics (``sql/analysis/analyzer/Analyzer.java`` exact
+    -field resolution); full-text operators (MATCH/QUERY/LIKE-as-match)
+    keep the raw field.
+    """
+    rf = resolve or (lambda n: n)
+    if isinstance(e, Bool):
+        key = "must" if e.op == "and" else "should"
+        out: dict = {"bool": {key: [fold_condition(p, resolve)
+                                    for p in e.parts]}}
+        if e.op == "or":
+            out["bool"]["minimum_should_match"] = 1
+        return out
+    if isinstance(e, Not):
+        return {"bool": {"must_not": [fold_condition(e.part, resolve)]}}
+    if isinstance(e, Cmp):
+        if isinstance(e.left, Func):
+            fn = e.left
+            if fn.name == "SCORE":
+                raise SqlVerificationError(
+                    "SCORE() cannot be used in WHERE; use ORDER BY SCORE()")
+            raise SqlVerificationError(
+                f"scalar function [{fn.name}] not supported in WHERE")
+        col, lit = e.left, e.right
+        flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+        op = e.op
+        if isinstance(col, Lit) and isinstance(lit, Col):
+            col, lit = lit, col
+            op = flip.get(op, op)
+        if not isinstance(col, Col) or not isinstance(lit, Lit):
+            raise SqlVerificationError(
+                "comparison must be between a column and a literal")
+        if op == "=":
+            return {"term": {rf(col.name): {"value": lit.value}}}
+        if op == "!=":
+            return {"bool": {"must_not": [
+                {"term": {rf(col.name): {"value": lit.value}}}]}}
+        return {"range": {col.name: {_CMP_RANGE[op]: lit.value}}}
+    if isinstance(e, Like):
+        q = {"wildcard": {rf(_col_name(e.col)): {
+            "value": _like_to_wildcard(e.pattern)}}}
+        return {"bool": {"must_not": [q]}} if e.negate else q
+    if isinstance(e, InList):
+        q = {"terms": {rf(_col_name(e.col)): list(e.values)}}
+        return {"bool": {"must_not": [q]}} if e.negate else q
+    if isinstance(e, Between):
+        return {"range": {_col_name(e.col): {"gte": e.low, "lte": e.high}}}
+    if isinstance(e, IsNull):
+        q = {"exists": {"field": _col_name(e.col)}}
+        return q if e.negate else {"bool": {"must_not": [q]}}
+    if isinstance(e, Func):
+        if e.name == "MATCH":
+            if len(e.args) < 2:
+                raise SqlVerificationError("MATCH needs (field, text)")
+            field = e.args[0].name if isinstance(e.args[0], Col) \
+                else str(_lit(e.args[0]))
+            return {"match": {field: {"query": _lit(e.args[1])}}}
+        if e.name == "QUERY":
+            return {"query_string": {"query": str(_lit(e.args[0]))}}
+        raise SqlVerificationError(
+            f"function [{e.name}] not valid as a condition")
+    raise SqlVerificationError("condition not translatable")
+
+
+def _lit(e: Expr) -> Any:
+    if not isinstance(e, Lit):
+        raise SqlVerificationError("expected a literal")
+    return e.value
+
+
+# ---------------------------------------------------------------------------
+# type mapping
+# ---------------------------------------------------------------------------
+
+_SQL_TYPES = {
+    "text": "text", "keyword": "keyword", "long": "long",
+    "integer": "integer", "short": "short", "byte": "byte",
+    "double": "double", "float": "float", "half_float": "half_float",
+    "scaled_float": "scaled_float", "boolean": "boolean",
+    "date": "datetime", "date_nanos": "datetime", "ip": "ip",
+    "unsigned_long": "unsigned_long", "version": "version",
+}
+
+
+def _sql_type(type_name: Optional[str]) -> str:
+    if type_name is None:
+        return "keyword"
+    return _SQL_TYPES.get(type_name, type_name)
+
+
+# ---------------------------------------------------------------------------
+# the service
+# ---------------------------------------------------------------------------
+
+class SqlService:
+    """Holds cursors and executes folded SQL through the REST search seam.
+
+    ``search_fn(index, body) -> response-dict`` is supplied by the REST
+    layer so the folded request rides the full (cluster-aware, TPU-planed)
+    search path.
+    """
+
+    MAX_PAGE = 1000
+    #: bound on live cursors (abandoned pagers evict oldest-first; the
+    #: reference expires cursors server-side the same way)
+    MAX_CURSORS = 500
+
+    def __init__(self, search_fn, mapper_fn):
+        self.search_fn = search_fn
+        self.mapper_fn = mapper_fn       # index -> MapperService or None
+        self.cursors: Dict[str, dict] = {}
+
+    def _new_cursor(self, state: dict) -> str:
+        cur = uuid.uuid4().hex
+        while len(self.cursors) >= self.MAX_CURSORS:
+            self.cursors.pop(next(iter(self.cursors)))
+        self.cursors[cur] = state
+        return cur
+
+    def _exact_resolver(self, mapper):
+        """ES SQL targets a text field's ``.keyword`` sub-field for exact
+        operations (sort, group, term equality); a text field with no
+        keyword sub-field is not exact-capable."""
+        def rf(name: str) -> str:
+            if mapper is None:
+                return name
+            ft = mapper.field_type(name)
+            if ft is not None and ft.type_name == "text":
+                sub = mapper.field_type(name + ".keyword")
+                if sub is not None and sub.type_name == "keyword":
+                    return name + ".keyword"
+            return name
+        return rf
+
+    # -- public entry ---------------------------------------------------
+    def execute(self, payload: dict, fmt: str = "json") -> Any:
+        if payload.get("cursor"):
+            return self._continue_cursor(payload["cursor"], fmt)
+        sql = payload.get("query")
+        if not sql or not isinstance(sql, str):
+            raise SqlParsingError("[query] is required")
+        q = parse_sql(sql)
+        fetch_size = int(payload.get("fetch_size", 1000))
+        if q.group_by or any(self._is_agg_item(it) for it in q.items):
+            return self._run_grouped(q, fetch_size, fmt, payload)
+        return self._run_select(q, fetch_size, fmt, payload)
+
+    def translate(self, payload: dict) -> dict:
+        sql = payload.get("query")
+        if not sql:
+            raise SqlParsingError("[query] is required")
+        q = parse_sql(sql)
+        if q.group_by or any(self._is_agg_item(it) for it in q.items):
+            body, _cols = self._fold_grouped(q, int(
+                payload.get("fetch_size", 1000)))
+        else:
+            body, _cols = self._fold_select(q)
+        return body
+
+    def close_cursor(self, cursor: str) -> bool:
+        return self.cursors.pop(cursor, None) is not None
+
+    # -- plain SELECT ---------------------------------------------------
+    @staticmethod
+    def _is_agg_item(it: SelectItem) -> bool:
+        return isinstance(it.expr, Func) and it.expr.name in _AGG_FUNCS
+
+    def _columns_for(self, q: Query, mapper) -> List[dict]:
+        cols = []
+        if q.star:
+            names = mapper.field_names() if mapper is not None else []
+            for n in names:
+                ft = mapper.field_type(n)
+                tn = getattr(ft, "type_name", None)
+                if tn in (None, "object", "nested", "alias", "completion"):
+                    continue
+                if n.startswith("_"):
+                    continue
+                cols.append({"name": n, "type": _sql_type(tn)})
+            return cols
+        for it in q.items:
+            e = it.expr
+            if isinstance(e, Col):
+                tn = None
+                if mapper is not None:
+                    tn = getattr(mapper.field_type(e.name), "type_name",
+                                 None)
+                    if tn is None:
+                        raise SqlVerificationError(
+                            f"Unknown column [{e.name}]")
+                cols.append({"name": it.alias or e.name,
+                             "type": _sql_type(tn)})
+            elif isinstance(e, Func) and e.name == "SCORE":
+                cols.append({"name": it.alias or "SCORE()",
+                             "type": "float"})
+            elif isinstance(e, Lit):
+                t = ("long" if isinstance(e.value, int)
+                     else "double" if isinstance(e.value, float)
+                     else "keyword")
+                cols.append({"name": it.alias or str(e.value), "type": t})
+            else:
+                raise SqlVerificationError(
+                    "only columns, literals and SCORE() are selectable "
+                    "without GROUP BY")
+        return cols
+
+    def _fold_select(self, q: Query) -> Tuple[dict, List[dict]]:
+        mapper = self.mapper_fn(q.table)
+        rf = self._exact_resolver(mapper)
+        cols = self._columns_for(q, mapper)
+        body: dict = {"size": q.limit if q.limit is not None else 1000}
+        if q.where is not None:
+            body["query"] = fold_condition(q.where, rf)
+        if q.order_by:
+            sort = []
+            for e, asc in q.order_by:
+                order = "asc" if asc else "desc"
+                if isinstance(e, Func) and e.name == "SCORE":
+                    sort.append({"_score": {"order": order}})
+                else:
+                    sort.append({rf(_col_name(e)): {"order": order}})
+            body["sort"] = sort
+        else:
+            # implicit sort so fetch_size paging always has a cursor key
+            # (ES SQL pages unsorted selects the same way); relevance
+            # order when SCORE() is projected, index order otherwise
+            want_score = any(isinstance(it.expr, Func)
+                             and it.expr.name == "SCORE" for it in q.items)
+            body["sort"] = [{"_score": {"order": "desc"}}] if want_score \
+                else [{"_doc": {"order": "asc"}}]
+        fields = [it.expr.name for it in q.items
+                  if isinstance(it.expr, Col)] if not q.star else True
+        body["_source"] = fields if fields else True
+        return body, cols
+
+    def _run_select(self, q: Query, fetch_size: int, fmt: str,
+                    payload: dict) -> Any:
+        body, cols = self._fold_select(q)
+        limit = body["size"]
+        page = min(limit, fetch_size, self.MAX_PAGE)
+        body["size"] = page
+        want_score = any(isinstance(it.expr, Func)
+                         and it.expr.name == "SCORE" for it in q.items)
+        if want_score:
+            body["track_scores"] = True
+        resp = self.search_fn(q.table, body)
+        rows = self._rows_from_hits(q, cols, resp["hits"]["hits"])
+        out = {"columns": cols, "rows": rows}
+        # deep SELECT pagination beyond one page is cursor-driven
+        remaining = (limit - len(rows)) if q.limit is not None else None
+        if len(rows) == page and (remaining is None or remaining > 0) and \
+                resp["hits"]["hits"]:
+            last = resp["hits"]["hits"][-1]
+            if body.get("sort") and last.get("sort") is not None:
+                cur = self._new_cursor({
+                    "kind": "select", "q": q, "body": body, "cols": cols,
+                    "after": last["sort"], "remaining": remaining,
+                    "fetch": page})
+                out["cursor"] = cur
+        return self._format(out, fmt)
+
+    def _rows_from_hits(self, q: Query, cols: List[dict],
+                        hits: List[dict]) -> List[list]:
+        rows = []
+        for h in hits:
+            src = h.get("_source") or {}
+            row = []
+            if q.star:
+                for c in cols:
+                    v = _path_get(src, c["name"])
+                    if v is None and "." in c["name"]:
+                        # multi-field sub-column (name.keyword) reads the
+                        # parent's source value, like ES SQL
+                        v = _path_get(src, c["name"].rsplit(".", 1)[0])
+                    row.append(v)
+            else:
+                for it in q.items:
+                    e = it.expr
+                    if isinstance(e, Col):
+                        row.append(_path_get(src, e.name))
+                    elif isinstance(e, Func) and e.name == "SCORE":
+                        row.append(h.get("_score"))
+                    else:
+                        row.append(_lit(e))
+            rows.append(row)
+        return rows
+
+    # -- GROUP BY / aggregates -----------------------------------------
+    def _fold_grouped(self, q: Query,
+                      fetch_size: int) -> Tuple[dict, List[dict]]:
+        mapper = self.mapper_fn(q.table)
+        group_cols: List[Tuple[str, Optional[str], str]] = []
+        # (composite source name, date_part, column name)
+        for e in q.group_by:
+            if isinstance(e, Func) and e.name in _DATE_PARTS:
+                col = _col_name(e.args[0])
+                group_cols.append((col, e.name, f"{e.name}({col})"))
+            else:
+                group_cols.append((_col_name(e), None, _col_name(e)))
+        cols: List[dict] = []
+        metrics: Dict[str, dict] = {}
+        row_plan: List[Tuple[str, Any]] = []   # ("group", idx)|("metric", key)|("lit", v)
+        items = q.items if q.items else [
+            SelectItem(Col(c[2]), None) for c in group_cols]
+        midx = 0
+        for it in items:
+            e = it.expr
+            if isinstance(e, Func) and e.name in _AGG_FUNCS:
+                arg = e.args[0] if e.args else Lit("*")
+                label = it.alias or self._fn_label(e)
+                if e.name == "COUNT" and isinstance(arg, Lit) \
+                        and arg.value == "*":
+                    row_plan.append(("count", None))
+                    cols.append({"name": label, "type": "long"})
+                    continue
+                field = _col_name(arg)
+                if mapper is not None and \
+                        mapper.field_type(field) is None:
+                    raise SqlVerificationError(f"Unknown column [{field}]")
+                key = f"m{midx}"
+                midx += 1
+                exact = self._exact_resolver(mapper)(field)
+                if e.name == "COUNT" and e.distinct:
+                    metrics[key] = {"cardinality": {"field": exact}}
+                    cols.append({"name": label, "type": "long"})
+                elif e.name == "COUNT":
+                    metrics[key] = {"value_count": {"field": exact}}
+                    cols.append({"name": label, "type": "long"})
+                else:
+                    metrics[key] = {e.name.lower(): {"field": field}}
+                    cols.append({"name": label, "type": "double"})
+                row_plan.append(("metric", key))
+            else:
+                # must be one of the group-by expressions
+                name = (f"{e.name}({_col_name(e.args[0])})"
+                        if isinstance(e, Func) else _col_name(e))
+                for gi, (_c, _p, cname) in enumerate(group_cols):
+                    if cname == name:
+                        row_plan.append(("group", gi))
+                        tn = None
+                        if _p is not None:
+                            tn = "integer"
+                        elif mapper is not None:
+                            ft = mapper.field_type(_c)
+                            if ft is None:
+                                raise SqlVerificationError(
+                                    f"Unknown column [{_c}]")
+                            tn = _sql_type(ft.type_name)
+                        cols.append({"name": it.alias or name,
+                                     "type": tn or "keyword"})
+                        break
+                else:
+                    raise SqlVerificationError(
+                        f"Cannot use non-grouped column [{name}], "
+                        f"expected one of {[c[2] for c in group_cols]}")
+        if not q.group_by:
+            # global aggregates: single row of top-level aggs
+            body: dict = {"size": 0, "aggs": {
+                k: v for k, v in metrics.items()}}
+            if q.where is not None:
+                body["query"] = fold_condition(
+                    q.where, self._exact_resolver(mapper))
+            body["track_total_hits"] = True
+            return body, cols
+        sources = []
+        for (c, part, cname) in group_cols:
+            if part is not None:
+                cal = {"YEAR": "year", "MONTH": "month", "DAY": "day",
+                       "HOUR": "hour", "MINUTE": "minute"}[part]
+                sources.append({cname: {"date_histogram": {
+                    "field": c, "calendar_interval": cal,
+                    "missing_bucket": True}}})
+            else:
+                sources.append({cname: {"terms": {
+                    "field": self._exact_resolver(mapper)(c),
+                    "missing_bucket": True}}})
+        comp: dict = {"size": min(fetch_size, self.MAX_PAGE),
+                      "sources": sources}
+        aggs: dict = {"groupby": {"composite": comp}}
+        if metrics:
+            aggs["groupby"]["aggs"] = dict(metrics)
+        body = {"size": 0, "aggs": aggs}
+        if q.where is not None:
+            body["query"] = fold_condition(
+                q.where, self._exact_resolver(mapper))
+        return body, cols
+
+    @staticmethod
+    def _fn_label(e: Func) -> str:
+        if e.name == "COUNT" and e.args and isinstance(e.args[0], Lit):
+            return "COUNT(*)"
+        inner = e.args[0].name if e.args and isinstance(e.args[0], Col) \
+            else "*"
+        d = "DISTINCT " if e.distinct else ""
+        return f"{e.name}({d}{inner})"
+
+    def _run_grouped(self, q: Query, fetch_size: int, fmt: str,
+                     payload: dict) -> Any:
+        body, cols = self._fold_grouped(q, fetch_size)
+        if not q.group_by:
+            resp = self.search_fn(q.table, body)
+            aggs = resp.get("aggregations") or {}
+            row = []
+            items = q.items
+            mi = 0
+            for it in items:
+                e = it.expr
+                if isinstance(e, Func) and e.name == "COUNT" and e.args \
+                        and isinstance(e.args[0], Lit) \
+                        and e.args[0].value == "*":
+                    row.append(resp["hits"]["total"]["value"])
+                else:
+                    row.append(aggs.get(f"m{mi}", {}).get("value"))
+                    mi += 1
+            rows = [row]
+            if q.having is not None:
+                n2i = {c["name"]: i for i, c in enumerate(cols)}
+                rows = [r for r in rows
+                        if _eval_having(q.having, n2i, r, q)]
+            return self._format({"columns": cols, "rows": rows}, fmt)
+        rows, after = self._grouped_page(q, body, cols)
+        rows = self._post_group(q, cols, rows)
+        out = {"columns": cols, "rows": rows}
+        if after is not None and not q.having and not q.order_by \
+                and q.limit is None:
+            cur = self._new_cursor({"kind": "grouped", "q": q,
+                                    "body": body, "cols": cols,
+                                    "after": after})
+            out["cursor"] = cur
+        return self._format(out, fmt)
+
+    def _grouped_page(self, q: Query, body: dict,
+                      cols: List[dict]) -> Tuple[List[list], Optional[dict]]:
+        """One composite page → rows (+ after_key). HAVING/ORDER BY/LIMIT
+        queries drain ALL pages here so host-side filtering is exact."""
+        drain = bool(q.having or q.order_by or q.limit is not None)
+        rows: List[list] = []
+        sources_def = body["aggs"]["groupby"]["composite"]["sources"]
+        group_names = [list(s.keys())[0] for s in sources_def]
+        date_parts = {}
+        for s in sources_def:
+            (gname, gdef), = s.items()
+            m = re.match(r"(YEAR|MONTH|DAY|HOUR|MINUTE)\(", gname)
+            if m and "date_histogram" in gdef:
+                date_parts[gname] = m.group(1)
+        after = None
+        while True:
+            resp = self.search_fn(q.table, body)
+            comp = (resp.get("aggregations") or {}).get("groupby") or {}
+            for b in comp.get("buckets", []):
+                row = []
+                items = q.items if q.items else [
+                    SelectItem(Col(n), None) for n in group_names]
+                for plan, it in zip(self._plan_of(q, group_names), items):
+                    kind, ref = plan
+                    if kind == "group":
+                        v = b["key"].get(group_names[ref])
+                        part = date_parts.get(group_names[ref])
+                        if part is not None and v is not None:
+                            v = _date_part(part, v)
+                        row.append(v)
+                    elif kind == "count":
+                        row.append(b["doc_count"])
+                    else:
+                        row.append((b.get(ref) or {}).get("value"))
+                rows.append(row)
+            after = comp.get("after_key")
+            if after is None or not comp.get("buckets"):
+                return rows, None
+            if not drain:
+                return rows, after
+            body = dict(body)
+            newaggs = json.loads(json.dumps(body["aggs"]))
+            newaggs["groupby"]["composite"]["after"] = after
+            body["aggs"] = newaggs
+
+    def _plan_of(self, q: Query,
+                 group_names: List[str]) -> List[Tuple[str, Any]]:
+        plan: List[Tuple[str, Any]] = []
+        items = q.items if q.items else [SelectItem(Col(n), None)
+                                         for n in group_names]
+        mi = 0
+        for it in items:
+            e = it.expr
+            if isinstance(e, Func) and e.name in _AGG_FUNCS:
+                if e.name == "COUNT" and e.args and \
+                        isinstance(e.args[0], Lit) and e.args[0].value == "*":
+                    plan.append(("count", None))
+                else:
+                    plan.append(("metric", f"m{mi}"))
+                    mi += 1
+            else:
+                name = (f"{e.name}({_col_name(e.args[0])})"
+                        if isinstance(e, Func) else e.name)
+                plan.append(("group", group_names.index(name)))
+        return plan
+
+    def _post_group(self, q: Query, cols: List[dict],
+                    rows: List[list]) -> List[list]:
+        name_to_idx = {c["name"]: i for i, c in enumerate(cols)}
+        if q.having is not None:
+            rows = [r for r in rows
+                    if _eval_having(q.having, name_to_idx, r, q)]
+        if q.order_by:
+            for e, asc in reversed(q.order_by):
+                if isinstance(e, Func) and e.name in _AGG_FUNCS:
+                    key_name = self._fn_label(e)
+                else:
+                    key_name = _col_name(e) if isinstance(e, Col) else None
+                idx = name_to_idx.get(key_name)
+                if idx is None:
+                    # maybe aliased: match by position in select items
+                    for i, it in enumerate(q.items):
+                        if _expr_eq(it.expr, e):
+                            idx = i
+                            break
+                if idx is None:
+                    raise SqlVerificationError(
+                        f"ORDER BY refers to unknown output [{key_name}]")
+                rows.sort(key=lambda r, j=idx: (r[j] is None,
+                                                r[j] if r[j] is not None
+                                                else 0),
+                          reverse=not asc)
+        if q.limit is not None:
+            rows = rows[:q.limit]
+        return rows
+
+    # -- cursors --------------------------------------------------------
+    def _continue_cursor(self, cursor: str, fmt: str) -> Any:
+        st = self.cursors.get(cursor)
+        if st is None:
+            raise SqlParsingError("invalid or expired cursor")
+        q, cols = st["q"], st["cols"]
+        if st["kind"] == "select":
+            body = dict(st["body"])
+            body["search_after"] = st["after"]
+            page = st["fetch"]
+            if st["remaining"] is not None:
+                page = min(page, st["remaining"])
+            body["size"] = page
+            resp = self.search_fn(q.table, body)
+            rows = self._rows_from_hits(q, cols, resp["hits"]["hits"])
+            out = {"columns": cols, "rows": rows}
+            done = len(rows) < page or (
+                st["remaining"] is not None
+                and st["remaining"] - len(rows) <= 0)
+            if not done and resp["hits"]["hits"]:
+                st["after"] = resp["hits"]["hits"][-1]["sort"]
+                if st["remaining"] is not None:
+                    st["remaining"] -= len(rows)
+                out["cursor"] = cursor
+            else:
+                self.cursors.pop(cursor, None)
+            return self._format(out, fmt)
+        body = json.loads(json.dumps(st["body"]))
+        body["aggs"]["groupby"]["composite"]["after"] = st["after"]
+        rows, after = self._grouped_page(q, body, cols)
+        out = {"columns": cols, "rows": rows}
+        if after is not None:
+            st["after"] = after
+            out["cursor"] = cursor
+        else:
+            self.cursors.pop(cursor, None)
+        return self._format(out, fmt)
+
+    # -- output formats -------------------------------------------------
+    @staticmethod
+    def _format(out: dict, fmt: str) -> Any:
+        if fmt in ("json", None):
+            return out
+        cols = out["columns"]
+        rows = out["rows"]
+
+        def cell(v: Any) -> str:
+            if v is None:
+                return "null"
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            if isinstance(v, float):
+                return repr(v)
+            return str(v)
+
+        if fmt in ("csv", "tsv"):
+            sep = "," if fmt == "csv" else "\t"
+
+            def esc(s: str) -> str:
+                if fmt == "csv" and (sep in s or '"' in s or "\n" in s):
+                    return '"' + s.replace('"', '""') + '"'
+                return s
+            lines = [sep.join(esc(c["name"]) for c in cols)]
+            lines += [sep.join(esc(cell(v)) for v in r) for r in rows]
+            return "\n".join(lines) + "\n"
+        if fmt == "txt":
+            headers = [c["name"] for c in cols]
+            table = [[cell(v) for v in r] for r in rows]
+            widths = [max([len(h)] + [len(r[i]) for r in table])
+                      for i, h in enumerate(headers)]
+            head = "|".join(h.ljust(w) for h, w in zip(headers, widths))
+            rule = "+".join("-" * w for w in widths)
+            body_lines = ["|".join(v.ljust(w) for v, w in zip(r, widths))
+                          for r in table]
+            return "\n".join([head, rule] + body_lines) + "\n"
+        raise IllegalArgumentError(f"Invalid format [{fmt}]")
+
+
+def _expr_eq(a: Expr, b: Expr) -> bool:
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, Col):
+        return a.name == b.name
+    if isinstance(a, Func):
+        return a.name == b.name and len(a.args) == len(b.args) and \
+            all(_expr_eq(x, y) for x, y in zip(a.args, b.args))
+    if isinstance(a, Lit):
+        return a.value == b.value
+    return False
+
+
+def _eval_having(e: Expr, name_to_idx: Dict[str, int], row: list,
+                 q: Query) -> bool:
+    if isinstance(e, Bool):
+        vals = [_eval_having(p, name_to_idx, row, q) for p in e.parts]
+        return all(vals) if e.op == "and" else any(vals)
+    if isinstance(e, Not):
+        return not _eval_having(e.part, name_to_idx, row, q)
+    if isinstance(e, Cmp):
+        left = _having_value(e.left, name_to_idx, row, q)
+        right = _having_value(e.right, name_to_idx, row, q)
+        if left is None or right is None:
+            return False
+        ops = {"=": lambda a, b: a == b, "!=": lambda a, b: a != b,
+               "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+               ">": lambda a, b: a > b, ">=": lambda a, b: a >= b}
+        return ops[e.op](left, right)
+    raise SqlVerificationError("HAVING supports comparisons of aggregates")
+
+
+def _having_value(e: Expr, name_to_idx: Dict[str, int], row: list,
+                  q: Query) -> Any:
+    if isinstance(e, Lit):
+        return e.value
+    label = None
+    if isinstance(e, Func):
+        label = SqlService._fn_label(e)
+    elif isinstance(e, Col):
+        label = e.name
+    idx = name_to_idx.get(label)
+    if idx is None:
+        for i, it in enumerate(q.items):
+            if it.alias == label or _expr_eq(it.expr, e):
+                idx = i
+                break
+    if idx is None:
+        raise SqlVerificationError(
+            f"HAVING refers to [{label}] which is not in the SELECT list")
+    return row[idx]
+
+
+def _path_get(src: dict, path: str) -> Any:
+    cur: Any = src
+    for part in path.split("."):
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            return None
+    if isinstance(cur, (dict,)):
+        return None
+    return cur
+
+
+def _date_part(part: str, epoch_millis: Any) -> int:
+    """Host-side calendar-part extraction over date_histogram keys."""
+    import datetime as _dt
+    dt = _dt.datetime.fromtimestamp(float(epoch_millis) / 1e3,
+                                    _dt.timezone.utc)
+    return {"YEAR": dt.year, "MONTH": dt.month, "DAY": dt.day,
+            "HOUR": dt.hour, "MINUTE": dt.minute}[part]
