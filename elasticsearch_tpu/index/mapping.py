@@ -100,6 +100,127 @@ class KeywordFieldType(MappedFieldType):
         return s.lower() if self.normalize_lowercase else s
 
 
+class ConstantKeywordFieldType(KeywordFieldType):
+    """A single value shared by every document of the index (reference:
+    ``x-pack/plugin/mapper-constant-keyword/.../ConstantKeywordFieldMapper
+    .java``). The value pins on the mapping or on the first document that
+    supplies one; later documents must agree. Each document indexes the
+    constant term (including documents that omit the field — stamped in
+    ``parse_document``) so term/terms/exists/aggs ride the normal keyword
+    column."""
+
+    type_name = "constant_keyword"
+
+    def __init__(self, name: str, params: Optional[dict] = None):
+        super().__init__(name, 2 ** 31 - 1, False, params)
+        self.value: Optional[str] = (None if params is None
+                                     else params.get("value"))
+
+    def parse_value(self, value):
+        s = super().parse_value(value)
+        if self.value is None:
+            self.value = s
+            self.params["value"] = s      # round-trips in the mapping
+            self._pinned_dirty = True     # owning mapper re-renders
+        elif s != self.value:
+            raise MapperParsingError(
+                f"[constant_keyword] field [{self.name}] only accepts "
+                f"values that are equal to the value defined in the "
+                f"mappings [{self.value}], but got [{s}]")
+        return self.value
+
+
+class WildcardFieldType(KeywordFieldType):
+    """Wildcard-optimized keyword (reference: ``x-pack/plugin/wildcard/``
+    — n-gram-accelerated there; here wildcard/regexp queries scan the
+    keyword ordinal table directly, which the TPU build's term
+    dictionaries keep host-side anyway, so no acceleration structure is
+    needed for correctness)."""
+
+    type_name = "wildcard"
+
+    def __init__(self, name: str, params: Optional[dict] = None):
+        super().__init__(name, int((params or {}).get(
+            "ignore_above", 2 ** 31 - 1)), False, params)
+
+
+_VERSION_RX = re.compile(r"^(\d+)\.(\d+)\.(\d+)(?:[-+].*)?$")
+
+
+class VersionFieldType(KeywordFieldType):
+    """Semver-ordered keyword (reference: ``x-pack/plugin/mapper-version/
+    .../VersionStringFieldMapper.java`` encodes versions into
+    order-preserving sortable bytes). Here each value indexes its keyword
+    term plus a numeric order key into the paired numeric column — the
+    same dual-column trick the ip type uses — so sorting is semver-
+    correct while term queries and aggs stay string-shaped. Non-semver
+    strings carry no order key and sort as missing (documented
+    approximation of the reference's 'sorts after valid versions')."""
+
+    type_name = "version"
+
+    def __init__(self, name: str, params: Optional[dict] = None):
+        super().__init__(name, 2 ** 31 - 1, False, params)
+
+    #: parts cap: each of major/minor/patch packs into a 100k radix
+    _RADIX = 100_000
+
+    def sort_key(self, s: str) -> Optional[float]:
+        m = _VERSION_RX.match(s)
+        if m is None:
+            return None
+        major, minor, patch = (min(int(g), self._RADIX - 1)
+                               for g in m.groups())
+        pre = 0 if "-" in s else 1        # prereleases order before GA
+        return float(((major * self._RADIX + minor) * self._RADIX
+                      + patch) * 2 + pre)
+
+
+class FlattenedFieldType(KeywordFieldType):
+    """Whole-object field (reference: ``x-pack/plugin/mapper-flattened/
+    .../FlattenedFieldMapper.java``): one mapped field indexes every leaf
+    of a JSON object. The root field column carries every leaf value (a
+    query on ``field`` matches any leaf); each dotted key path gets its
+    own keyword column (``field.key``), resolved to a synthetic keyword
+    type by ``MapperService.field_type`` without appearing in the
+    mapping. Subclassing the keyword type lets every keyword-capable
+    query/agg work on the root column unchanged (the reference's root
+    type is likewise a keyword-family type)."""
+
+    type_name = "flattened"
+
+    def __init__(self, name: str, params: Optional[dict] = None):
+        super().__init__(name, 2 ** 31 - 1, False, params)
+        self.depth_limit = int((self.params or {}).get("depth_limit", 20))
+
+    def leaves(self, value: Any):
+        """Yield (dotted_path, leaf_string) pairs; '' path for the root."""
+        out: List[Tuple[str, str]] = []
+
+        def walk(prefix: str, v: Any, depth: int) -> None:
+            if depth > self.depth_limit:
+                raise MapperParsingError(
+                    f"The provided [flattened] field [{self.name}] "
+                    f"exceeds the maximum depth limit of "
+                    f"[{self.depth_limit}].")
+            if isinstance(v, dict):
+                for k, sub in v.items():
+                    walk(f"{prefix}.{k}" if prefix else str(k), sub,
+                         depth + 1)
+            elif isinstance(v, list):
+                for sub in v:
+                    walk(prefix, sub, depth)
+            elif v is not None:
+                if isinstance(v, bool):
+                    s = "true" if v else "false"
+                else:
+                    s = str(v)
+                out.append((prefix, s))
+
+        walk("", value, 0)
+        return out
+
+
 class NumberFieldType(MappedFieldType):
     has_doc_values = True
 
@@ -1146,6 +1267,14 @@ class MapperService:
             return KeywordFieldType(
                 name, int(spec.get("ignore_above", 2 ** 31 - 1)),
                 spec.get("normalizer") == "lowercase", params)
+        if ftype == "constant_keyword":
+            return ConstantKeywordFieldType(name, params)
+        if ftype == "wildcard":
+            return WildcardFieldType(name, params)
+        if ftype == "version":
+            return VersionFieldType(name, params)
+        if ftype == "flattened":
+            return FlattenedFieldType(name, params)
         if ftype in NUMERIC_TYPES:
             return NumberFieldType(name, ftype, params)
         if ftype in ("date", "date_nanos"):
@@ -1252,6 +1381,16 @@ class MapperService:
         ft = self._field_type_raw(name)
         if isinstance(ft, AliasFieldType):
             return self._field_type_raw(ft.path)
+        if ft is None and "." in name:
+            # flattened sub-paths resolve to synthetic keyword types
+            # (FlattenedFieldMapper.KeyedFlattenedFieldType)
+            parts = name.split(".")
+            for i in range(len(parts) - 1, 0, -1):
+                anc = self._field_type_raw(".".join(parts[:i]))
+                if isinstance(anc, FlattenedFieldType):
+                    return KeywordFieldType(name, 2 ** 31 - 1, False, {})
+                if anc is not None:
+                    break
         return ft
 
     def _field_type_raw(self, name: str) -> Optional[MappedFieldType]:
@@ -1282,6 +1421,17 @@ class MapperService:
             parsed.numeric_values.setdefault("_doc_count",
                                              []).append(float(dc))
         self._parse_object("", source, parsed)
+        # constant_keyword: every doc of the index carries the constant
+        # (term queries must match docs that omitted the field)
+        for fname, ft0 in self._fields.items():
+            if isinstance(ft0, ConstantKeywordFieldType) and \
+                    ft0.value is not None:
+                if getattr(ft0, "_pinned_dirty", False):
+                    # a first-doc pin changes the rendered mapping
+                    ft0._pinned_dirty = False
+                    self._rebuild_mapping_def()
+                if fname not in parsed.keyword_terms:
+                    parsed.keyword_terms[fname] = [ft0.value]
         if len(parsed.nested_docs) > self.nested_limit:
             raise IllegalArgumentError(
                 f"The number of nested documents has exceeded the allowed "
@@ -1467,6 +1617,24 @@ class MapperService:
                     f"failed to parse query for field [{full}]: {e}")
             parsed.keyword_terms.setdefault("_field_names",
                                             []).append(full)
+        elif isinstance(ft, VersionFieldType):
+            v = ft.parse_value(value)
+            if v is not None:
+                parsed.keyword_terms.setdefault(full, []).append(v)
+                k = ft.sort_key(v)
+                if k is not None:
+                    # paired numeric order key → semver-correct sorting
+                    parsed.numeric_values.setdefault(full, []).append(k)
+        elif isinstance(ft, FlattenedFieldType):
+            if not isinstance(value, (dict, list)):
+                raise MapperParsingError(
+                    f"Failed to parse object: expecting an object but "
+                    f"got [{type(value).__name__}] for field [{full}]")
+            for path, leaf in ft.leaves(value):
+                parsed.keyword_terms.setdefault(full, []).append(leaf)
+                if path:
+                    parsed.keyword_terms.setdefault(
+                        f"{full}.{path}", []).append(leaf)
         elif isinstance(ft, KeywordFieldType):
             v = ft.parse_value(value)
             if v is not None:

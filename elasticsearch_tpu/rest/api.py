@@ -328,6 +328,59 @@ class RestAPI:
         add("DELETE", "/_ilm/policy/{name}", self.h_delete_ilm_policy)
         add("GET", "/{index}/_ilm/explain", self.h_ilm_explain)
         add("POST", "/_ilm/_tick", self.h_ilm_tick)
+        add("GET,POST", "/{index}/_eql/search", self.h_eql_search)
+        add("GET,POST", "/{index}/_graph/explore", self.h_graph_explore)
+        # transform (x-pack/plugin/transform)
+        add("PUT", "/_transform/{id}", self.h_put_transform)
+        add("GET", "/_transform", self.h_get_transform)
+        add("GET", "/_transform/_stats", self.h_transform_stats)
+        add("GET", "/_transform/{id}", self.h_get_transform)
+        add("GET", "/_transform/{id}/_stats", self.h_transform_stats)
+        add("POST", "/_transform/_preview", self.h_preview_transform)
+        add("POST", "/_transform/{id}/_start", self.h_start_transform)
+        add("POST", "/_transform/{id}/_stop", self.h_stop_transform)
+        add("DELETE", "/_transform/{id}", self.h_delete_transform)
+        # rollup (x-pack/plugin/rollup)
+        add("PUT", "/_rollup/job/{id}", self.h_put_rollup_job)
+        add("GET", "/_rollup/job", self.h_get_rollup_jobs)
+        add("GET", "/_rollup/job/{id}", self.h_get_rollup_jobs)
+        add("DELETE", "/_rollup/job/{id}", self.h_delete_rollup_job)
+        add("POST", "/_rollup/job/{id}/_start", self.h_start_rollup_job)
+        add("POST", "/_rollup/job/{id}/_stop", self.h_stop_rollup_job)
+        add("GET", "/_rollup/data/{pattern}", self.h_rollup_caps)
+        add("GET,POST", "/{index}/_rollup_search", self.h_rollup_search)
+        # watcher (x-pack/plugin/watcher)
+        add("PUT,POST", "/_watcher/watch/{id}", self.h_put_watch)
+        add("GET", "/_watcher/watch/{id}", self.h_get_watch)
+        add("DELETE", "/_watcher/watch/{id}", self.h_delete_watch)
+        add("PUT,POST", "/_watcher/watch/{id}/_execute",
+            self.h_execute_watch)
+        add("PUT,POST", "/_watcher/watch/{id}/_activate",
+            self.h_activate_watch)
+        add("PUT,POST", "/_watcher/watch/{id}/_deactivate",
+            self.h_deactivate_watch)
+        add("GET", "/_watcher/stats", self.h_watcher_stats)
+        add("POST", "/_watcher/_tick", self.h_watcher_tick)
+        # ccr (x-pack/plugin/ccr)
+        add("GET", "/{index}/_ccr/shard_changes", self.h_ccr_changes)
+        add("PUT,POST", "/{index}/_ccr/follow", self.h_ccr_follow)
+        add("POST", "/{index}/_ccr/pause_follow", self.h_ccr_pause)
+        add("POST", "/{index}/_ccr/resume_follow", self.h_ccr_resume)
+        add("POST", "/{index}/_ccr/unfollow", self.h_ccr_unfollow)
+        add("GET", "/_ccr/stats", self.h_ccr_stats)
+        add("POST", "/_ccr/_tick", self.h_ccr_tick)
+        add("PUT", "/_ccr/auto_follow/{name}", self.h_ccr_put_auto)
+        add("GET", "/_ccr/auto_follow", self.h_ccr_get_auto)
+        add("GET", "/_ccr/auto_follow/{name}", self.h_ccr_get_auto)
+        add("DELETE", "/_ccr/auto_follow/{name}", self.h_ccr_del_auto)
+        # enrich (x-pack/plugin/enrich)
+        add("PUT", "/_enrich/policy/{name}", self.h_put_enrich_policy)
+        add("GET", "/_enrich/policy", self.h_get_enrich_policy)
+        add("GET", "/_enrich/policy/{name}", self.h_get_enrich_policy)
+        add("DELETE", "/_enrich/policy/{name}",
+            self.h_delete_enrich_policy)
+        add("PUT,POST", "/_enrich/policy/{name}/_execute",
+            self.h_execute_enrich_policy)
         add("GET,POST", "/_sql", self.h_sql)
         add("POST", "/_sql/translate", self.h_sql_translate)
         add("POST", "/_sql/close", self.h_sql_close)
@@ -2661,6 +2714,211 @@ class RestAPI:
                       fmt, "text/plain; charset=UTF-8")
             return 200, ct, out
         return out
+
+    # ------------------------------------------------------------------
+    # EQL (x-pack/plugin/eql analog — xpack/eql.py)
+    # ------------------------------------------------------------------
+
+    @property
+    def eql(self):
+        if getattr(self, "_eql_svc", None) is None:
+            from ..xpack.eql import EqlService
+
+            def mapper_of(table):
+                names = self.indices.resolve(table)
+                return self.indices.indices[names[0]].mapper \
+                    if names else None
+            self._eql_svc = EqlService(
+                lambda index, b: self.internal_search(index, b),
+                mapper_of)
+        return self._eql_svc
+
+    def h_eql_search(self, params, body, index):
+        self.indices.resolve(index)      # 404 before parsing, like ES
+        return self.eql.search(index, _json_body(body))
+
+    def h_graph_explore(self, params, body, index):
+        """POST /{index}/_graph/explore (x-pack graph analog)."""
+        self.indices.resolve(index)
+        from ..xpack.graph import GraphService
+        if getattr(self, "_graph_svc", None) is None:
+            self._graph_svc = GraphService(
+                lambda i, b: self.internal_search(i, b))
+        return self._graph_svc.explore(index, _json_body(body))
+
+    # ------------------------------------------------------------------
+    # transform / rollup / watcher / enrich (x-pack analogs)
+    # ------------------------------------------------------------------
+
+    @property
+    def transform(self):
+        if getattr(self, "_transform_svc", None) is None:
+            from ..xpack.transform import TransformService
+            self._transform_svc = TransformService(
+                lambda i, b: self.internal_search(i, b),
+                lambda i, lines: self.internal_bulk(i, lines,
+                                                    refresh=True))
+        return self._transform_svc
+
+    def h_put_transform(self, params, body, id):
+        return self.transform.put(id, _json_body(body))
+
+    def h_get_transform(self, params, body, id=None):
+        return self.transform.get(id)
+
+    def h_transform_stats(self, params, body, id=None):
+        return self.transform.stats(id)
+
+    def h_preview_transform(self, params, body):
+        return self.transform.preview(_json_body(body))
+
+    def h_start_transform(self, params, body, id):
+        return self.transform.start(id)
+
+    def h_stop_transform(self, params, body, id):
+        return self.transform.stop(id)
+
+    def h_delete_transform(self, params, body, id):
+        return self.transform.delete(id,
+                                     force=params.get("force") == "true")
+
+    @property
+    def rollup(self):
+        if getattr(self, "_rollup_svc", None) is None:
+            from ..xpack.rollup import RollupService
+            def create_index(i, mappings):
+                prev = getattr(self._internal_tls, "active", False)
+                self._internal_tls.active = True
+                try:
+                    self.handle("PUT", f"/{i}", "", json.dumps(
+                        {"mappings": mappings}).encode())
+                finally:
+                    self._internal_tls.active = prev
+            self._rollup_svc = RollupService(
+                lambda i, b: self.internal_search(i, b),
+                lambda i, lines: self.internal_bulk(i, lines,
+                                                    refresh=True),
+                create_index)
+        return self._rollup_svc
+
+    def h_put_rollup_job(self, params, body, id):
+        return self.rollup.put_job(id, _json_body(body))
+
+    def h_get_rollup_jobs(self, params, body, id=None):
+        return self.rollup.get_jobs(id)
+
+    def h_delete_rollup_job(self, params, body, id):
+        return self.rollup.delete_job(id)
+
+    def h_start_rollup_job(self, params, body, id):
+        return self.rollup.start_job(id)
+
+    def h_stop_rollup_job(self, params, body, id):
+        return self.rollup.stop_job(id)
+
+    def h_rollup_caps(self, params, body, pattern=None):
+        return self.rollup.caps(pattern)
+
+    def h_rollup_search(self, params, body, index):
+        self.indices.resolve(index)
+        return self.rollup.rollup_search(index, _json_body(body))
+
+    @property
+    def watcher(self):
+        if getattr(self, "_watcher_svc", None) is None:
+            from ..xpack.watcher import WatcherService
+            self._watcher_svc = WatcherService(
+                lambda i, b: self.internal_search(i, b),
+                lambda i, lines: self.internal_bulk(i, lines,
+                                                    refresh=True))
+        return self._watcher_svc
+
+    def h_put_watch(self, params, body, id):
+        return self.watcher.put(id, _json_body(body),
+                                active=params.get("active", "true")
+                                != "false")
+
+    def h_get_watch(self, params, body, id):
+        return self.watcher.get(id)
+
+    def h_delete_watch(self, params, body, id):
+        return self.watcher.delete(id)
+
+    def h_execute_watch(self, params, body, id):
+        return self.watcher.execute(id, _json_body(body))
+
+    def h_activate_watch(self, params, body, id):
+        return self.watcher.activate(id, True)
+
+    def h_deactivate_watch(self, params, body, id):
+        return self.watcher.activate(id, False)
+
+    def h_watcher_stats(self, params, body):
+        return self.watcher.stats()
+
+    def h_watcher_tick(self, params, body):
+        now = params.get("now_ms")
+        return self.watcher.tick(int(now) if now else None)
+
+    @property
+    def ccr(self):
+        if getattr(self, "_ccr_svc", None) is None:
+            from ..xpack.ccr import CcrService
+            self._ccr_svc = CcrService(self)
+        return self._ccr_svc
+
+    def h_ccr_changes(self, params, body, index):
+        return self.ccr.shard_changes(
+            index, int(params.get("shard", 0)),
+            int(params.get("from_seq_no", 0)),
+            int(params.get("max_ops", 5120)))
+
+    def h_ccr_follow(self, params, body, index):
+        return self.ccr.follow(index, _json_body(body))
+
+    def h_ccr_pause(self, params, body, index):
+        return self.ccr.pause(index)
+
+    def h_ccr_resume(self, params, body, index):
+        return self.ccr.resume(index)
+
+    def h_ccr_unfollow(self, params, body, index):
+        return self.ccr.unfollow(index)
+
+    def h_ccr_stats(self, params, body):
+        return self.ccr.stats()
+
+    def h_ccr_tick(self, params, body):
+        return self.ccr.tick()
+
+    def h_ccr_put_auto(self, params, body, name):
+        return self.ccr.put_auto_follow(name, _json_body(body))
+
+    def h_ccr_get_auto(self, params, body, name=None):
+        return self.ccr.get_auto_follow(name)
+
+    def h_ccr_del_auto(self, params, body, name):
+        return self.ccr.delete_auto_follow(name)
+
+    @property
+    def enrich(self):
+        if getattr(self, "_enrich_svc", None) is None:
+            from ..xpack.enrich import EnrichService
+            self._enrich_svc = EnrichService(
+                lambda i, b: self.internal_search(i, b))
+        return self._enrich_svc
+
+    def h_put_enrich_policy(self, params, body, name):
+        return self.enrich.put_policy(name, _json_body(body))
+
+    def h_get_enrich_policy(self, params, body, name=None):
+        return self.enrich.get_policy(name)
+
+    def h_delete_enrich_policy(self, params, body, name):
+        return self.enrich.delete_policy(name)
+
+    def h_execute_enrich_policy(self, params, body, name):
+        return self.enrich.execute_policy(name)
 
     def h_sql_translate(self, params, body):
         return self.sql.translate(_json_body(body))

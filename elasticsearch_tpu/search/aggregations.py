@@ -199,6 +199,29 @@ def parse_aggs(spec: dict) -> Dict[str, Aggregator]:
                 f"aggregation [{name}] of type [{kind}] cannot have "
                 f"sub-aggregations")
         if isinstance(agg, BucketAggregator):
+            # rate descendants resolve their per-unit factor from the
+            # CLOSEST enclosing date_histogram (RateAggregator's parent
+            # Rounding); subtrees build before parents, so walking all
+            # descendants here stamps any not yet claimed by a nearer one
+            if isinstance(agg, DateHistogramAgg):
+                if agg.fixed_ms is not None:
+                    interval_ms = agg.fixed_ms
+                else:
+                    from .aggs_analytics import _UNIT_MS
+                    unit_names = {
+                        "s": "second", "m": "minute", "h": "hour",
+                        "d": "day", "w": "week", "M": "month",
+                        "q": "quarter", "y": "year"}
+                    interval_ms = _UNIT_MS[unit_names[agg.calendar_unit]]
+
+                def _stamp(tree):
+                    for sa in tree.values():
+                        if getattr(sa, "_needs_parent_interval", False) \
+                                and sa._parent_interval_ms is None:
+                            sa._parent_interval_ms = interval_ms
+                        if getattr(sa, "subs", None):
+                            _stamp(sa.subs)
+                _stamp(subs)
             # composite may only nest under SINGLE-bucket parents
             single_bucket = {"FilterAgg", "NestedAgg", "ReverseNestedAgg",
                              "GlobalAgg", "MissingAgg", "SamplerAgg"}
@@ -2428,3 +2451,4 @@ _AGG_PARSERS = {
 # safe (importing aggs_extra first re-enters here only to bind names)
 from . import aggs_extra as _aggs_extra      # noqa: E402, F401
 from . import aggs_geo as _aggs_geo          # noqa: E402, F401
+from . import aggs_analytics as _aggs_analytics   # noqa: E402, F401

@@ -30,9 +30,10 @@ import numpy as np
 from ..common.errors import (ElasticsearchError,
                              IllegalArgumentError, ParsingError)
 from ..index.mapping import (
-    BooleanFieldType, DateFieldType, DenseVectorFieldType, IpFieldType,
-    KeywordFieldType, MapperService, NumberFieldType, RangeFieldType,
-    RuntimeFieldType, TextFieldType, parse_date_millis)
+    BooleanFieldType, ConstantKeywordFieldType, DateFieldType,
+    DenseVectorFieldType, IpFieldType, KeywordFieldType, MapperService,
+    NumberFieldType, RangeFieldType, RuntimeFieldType, TextFieldType,
+    parse_date_millis)
 from ..index.segment import Segment
 from ..ops.bm25 import DEFAULT_B, DEFAULT_K1, get_bm25_kernel, idf_weight
 from ..ops.masks import get_postings_match_kernel, get_range_mask_kernel
@@ -385,14 +386,23 @@ class TermQuery(Query):
     BM25 on the unanalyzed term; keyword fields score idf; numeric/date/bool
     behave as an equality filter with constant score."""
 
-    def __init__(self, field: str, value, boost: float = 1.0):
+    def __init__(self, field: str, value, boost: float = 1.0,
+                 case_insensitive: bool = False):
         self.field = field
         self.value = value
         self.boost = boost
+        self.case_insensitive = case_insensitive
 
     def execute(self, ctx, seg):
         if self.field == "_id":
             return IdsQuery([self.value], self.boost).execute(ctx, seg)
+        if self.case_insensitive:
+            # case-insensitive exact term = ci literal scan of the term
+            # dictionary (TermQueryBuilder's caseInsensitive flag)
+            import re as _re
+            return WildcardQuery(
+                self.field, _re.escape(str(self.value)), self.boost,
+                is_regexp=True, case_insensitive=True).execute(ctx, seg)
         self.field = ctx.concrete_field(self.field)
         ft = ctx.field_type(self.field)
         if ft is None:
@@ -408,6 +418,12 @@ class TermQuery(Query):
             scores, matched, _ = _score_text_terms(
                 ctx, seg, self.field, {str(self.value): 1.0})
             return scores * np.float32(self.boost), matched > 0
+        if isinstance(ft, ConstantKeywordFieldType):
+            # query-time rewrite against the mapped constant: matches all
+            # docs (including ones indexed before the value pinned) or
+            # none (ConstantKeywordFieldMapper.termQuery)
+            hit = ft.value is not None and str(self.value) == ft.value
+            return _const_result(seg, self.boost if hit else 0.0, hit)
         if isinstance(ft, KeywordFieldType):
             v = ft.parse_value(self.value)
             scores, matched, _ = _keyword_terms_result(
@@ -472,6 +488,10 @@ class TermsQuery(Query):
                 _, m = _numeric_range_result(seg, self.field, val, val, 1.0)
                 mask = mask | m
             return jnp.where(mask, np.float32(self.boost), 0.0), mask
+        if isinstance(ft, ConstantKeywordFieldType):
+            hit = ft.value is not None and \
+                any(str(v) == ft.value for v in self.values)
+            return _const_result(seg, self.boost if hit else 0.0, hit)
         if isinstance(ft, KeywordFieldType):
             weights = {}
             for v in self.values:
@@ -728,6 +748,9 @@ class ExistsQuery(Query):
         if self.field in self.ALWAYS_PRESENT:
             return _const_result(seg, self.boost, True)
         field = ctx.concrete_field(self.field)
+        if isinstance(ctx.field_type(field), ConstantKeywordFieldType):
+            ck = ctx.field_type(field)
+            return _const_result(seg, self.boost, ck.value is not None)
         # object field: exists iff any mapped subfield exists
         sub_fields = [n for n in getattr(ctx.mapper, "_fields", {})
                       if n.startswith(field + ".")]
@@ -838,11 +861,11 @@ class PrefixQuery(Query):
                     dest.add(t)
 
 
-def wildcard_regex(pattern: str) -> "re.Pattern":
+def wildcard_regex(pattern: str, flags: int = 0) -> "re.Pattern":
     """``*``/``?`` wildcard → anchored regex (shared by wildcard query,
     interval wildcard source and span_multi)."""
     esc = re.escape(pattern).replace(r"\*", ".*").replace(r"\?", ".")
-    return re.compile(f"{esc}\\Z")
+    return re.compile(f"{esc}\\Z", flags)
 
 
 class WildcardQuery(Query):
@@ -850,15 +873,16 @@ class WildcardQuery(Query):
     (uploads a host-computed doc mask; term dictionaries are host-resident)."""
 
     def __init__(self, field: str, pattern: str, boost: float = 1.0,
-                 is_regexp: bool = False):
+                 is_regexp: bool = False, case_insensitive: bool = False):
         self.field = field
         self.pattern = pattern
         self.boost = boost
+        flags = re.IGNORECASE if case_insensitive else 0
         if is_regexp:
             # Lucene regexp is anchored at both ends
-            self._re = re.compile(f"(?:{pattern})\\Z")
+            self._re = re.compile(f"(?:{pattern})\\Z", flags)
         else:
-            self._re = wildcard_regex(pattern)
+            self._re = wildcard_regex(pattern, flags)
 
     def execute(self, ctx, seg):
         self.field = ctx.concrete_field(self.field)
@@ -1370,7 +1394,9 @@ def _parse_match_phrase(body):
 
 def _parse_term(body):
     field, value, opts = _field_body(body, "value")
-    return TermQuery(field, value, float(opts.get("boost", 1.0)))
+    return TermQuery(field, value, float(opts.get("boost", 1.0)),
+                     case_insensitive=bool(opts.get("case_insensitive",
+                                                    False)))
 
 
 def _parse_terms(body):
@@ -1445,7 +1471,9 @@ def _parse_wildcard(body):
     field, value, opts = _field_body(body, "value")
     if value is None:
         value = opts.pop("wildcard", None)
-    return WildcardQuery(field, value, float(opts.get("boost", 1.0)))
+    return WildcardQuery(field, value, float(opts.get("boost", 1.0)),
+                         case_insensitive=bool(
+                             opts.get("case_insensitive", False)))
 
 
 def _parse_regexp(body):
@@ -1453,7 +1481,9 @@ def _parse_regexp(body):
     # (RestAPI._validate_search walk), not here
     field, value, opts = _field_body(body, "value")
     return WildcardQuery(field, value, float(opts.get("boost", 1.0)),
-                         is_regexp=True)
+                         is_regexp=True,
+                         case_insensitive=bool(
+                             opts.get("case_insensitive", False)))
 
 
 def _parse_fuzzy(body):

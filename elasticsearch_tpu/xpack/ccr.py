@@ -1,0 +1,288 @@
+"""CCR: cross-cluster replication — followers replay the leader's
+sequence-numbered operation history.
+
+Reference: ``x-pack/plugin/ccr/.../ShardFollowNodeTask.java:64`` — the
+follower task polls the leader's ``shard_changes`` action (ops from a
+seq-no, served from translog/Lucene history) and replays batches on the
+follower shard, tracking per-shard checkpoints; ``AutoFollowCoordinator``
+watches remote cluster state for new leader indices matching patterns.
+
+Here the leader surface is ``GET /{index}/_ccr/shard_changes`` (REST,
+because remote clusters speak ``rest:exec`` — same wire the reference's
+dedicated transport action rides), reading each shard's retained translog
+ops. The follower replays ops through its local write path per poll
+round; polling is driven by ``POST /_ccr/_tick`` (injectable clock, the
+same explicit-trigger stance as the ILM/watcher ticks) and is drained
+once inline when a follow starts. Checkpoints are per leader shard.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..common.errors import (ElasticsearchError, IllegalArgumentError,
+                             ResourceAlreadyExistsError,
+                             ResourceNotFoundError)
+
+
+class CcrService:
+    #: ops fetched per shard per poll round (the reference's
+    #: max_read_request_operation_count default is 5120)
+    BATCH = 5120
+
+    def __init__(self, api):
+        self.api = api                   # RestAPI (for local writes)
+        self.followers: Dict[str, dict] = {}
+        self.auto_patterns: Dict[str, dict] = {}
+
+    # -- leader side ----------------------------------------------------
+    def shard_changes(self, index: str, shard: int, from_seq_no: int,
+                      max_ops: int) -> dict:
+        names = self.api.indices.resolve(index)
+        svc = self.api.indices.indices[names[0]]
+        if shard >= len(svc.shards):
+            raise IllegalArgumentError(
+                f"no such shard [{shard}] in [{index}]")
+        eng = svc.shards[shard]
+        ops = eng.translog.read_ops(from_seq_no=from_seq_no)[: max_ops]
+        return {
+            "index": names[0], "shard": shard,
+            "max_seq_no": int(eng.tracker.max_seq_no),
+            "operations": [op.to_dict() for op in ops],
+        }
+
+    # -- follower side --------------------------------------------------
+    def follow(self, follower_index: str, body: dict) -> dict:
+        remote = body.get("remote_cluster")
+        leader = body.get("leader_index")
+        if not remote or not leader:
+            raise IllegalArgumentError(
+                "[remote_cluster] and [leader_index] are required")
+        if follower_index in self.followers:
+            raise ResourceAlreadyExistsError(
+                f"follower [{follower_index}] already exists")
+        client = self.api.remotes.client(remote)
+        # bootstrap: create the follower with the leader's mappings
+        st, _ct, out = client.exec("GET", f"/{leader}/_mapping", "", b"")
+        import json as _json
+        if st >= 400:
+            raise ElasticsearchError(
+                f"cannot read leader index [{leader}] on [{remote}]")
+        mappings = next(iter(_json.loads(out).values()))["mappings"]
+        st2, _ct2, out2 = client.exec("GET", f"/{leader}/_settings", "",
+                                      b"")
+        shards = 1
+        if st2 < 400:
+            st_doc = next(iter(_json.loads(out2).values()))
+            shards = int(((st_doc.get("settings") or {}).get("index")
+                          or {}).get("number_of_shards", 1))
+        self._internal(
+            "PUT", f"/{follower_index}",
+            {"mappings": mappings,
+             "settings": {"index": {"number_of_shards": shards}}})
+        self.followers[follower_index] = {
+            "remote_cluster": remote, "leader_index": leader,
+            "status": "active",
+            "checkpoints": {},           # leader shard -> next seq_no
+            "stats": {"operations_read": 0, "operations_written": 0,
+                      "failed_read_requests": 0, "poll_count": 0},
+        }
+        self.poll_one(follower_index)    # inline first drain
+        return {"follow_index_created": True,
+                "follow_index_shards_acked": True,
+                "index_following_started": True}
+
+    def pause(self, follower_index: str) -> dict:
+        f = self._follower(follower_index)
+        f["status"] = "paused"
+        return {"acknowledged": True}
+
+    def resume(self, follower_index: str) -> dict:
+        f = self._follower(follower_index)
+        f["status"] = "active"
+        self.poll_one(follower_index)
+        return {"acknowledged": True}
+
+    def unfollow(self, follower_index: str) -> dict:
+        f = self._follower(follower_index)
+        if f["status"] != "paused":
+            raise ElasticsearchError(
+                f"cannot convert the follower index [{follower_index}] "
+                f"to a non-follower, because it has not been paused")
+        del self.followers[follower_index]
+        return {"acknowledged": True}
+
+    def stats(self) -> dict:
+        return {"follow_stats": {"indices": [
+            {"index": name,
+             "shards": [{"shard_id": int(s),
+                         "leader_index": f["leader_index"],
+                         "remote_cluster": f["remote_cluster"],
+                         "follower_global_checkpoint": cp - 1,
+                         "operations_read":
+                             f["stats"]["operations_read"]}
+                        for s, cp in sorted(
+                            f["checkpoints"].items())] or
+             [{"shard_id": 0, "leader_index": f["leader_index"],
+               "remote_cluster": f["remote_cluster"],
+               "follower_global_checkpoint": -1,
+               "operations_read": 0}]}
+            for name, f in sorted(self.followers.items())]},
+            "auto_follow_stats": {
+                "number_of_successful_follow_indices":
+                    len(self.followers)}}
+
+    def _follower(self, name: str) -> dict:
+        f = self.followers.get(name)
+        if f is None:
+            raise ResourceNotFoundError(
+                f"follower index [{name}] does not exist")
+        return f
+
+    # -- polling --------------------------------------------------------
+    def poll_one(self, follower_index: str) -> int:
+        """One poll round: fetch + replay new leader ops; returns the
+        number of ops applied."""
+        import json as _json
+        f = self._follower(follower_index)
+        if f["status"] != "active":
+            return 0
+        client = self.api.remotes.client(f["remote_cluster"])
+        f["stats"]["poll_count"] += 1
+        applied = 0
+        shard = 0
+        while True:
+            cp = f["checkpoints"].get(str(shard), 0)
+            st, _ct, out = client.exec(
+                "GET",
+                f"/{f['leader_index']}/_ccr/shard_changes",
+                f"shard={shard}&from_seq_no={cp}&max_ops={self.BATCH}",
+                b"")
+            if st == 400 and shard > 0:
+                break                    # past the last leader shard
+            if st >= 400:
+                f["stats"]["failed_read_requests"] += 1
+                break
+            doc = _json.loads(out)
+            ops = doc.get("operations", [])
+            f["stats"]["operations_read"] += len(ops)
+            next_cp = cp
+            for op in ops:
+                self._apply(follower_index, op)
+                applied += 1
+                f["stats"]["operations_written"] += 1
+                next_cp = max(next_cp, int(op["seq_no"]) + 1)
+            f["checkpoints"][str(shard)] = next_cp
+            shard += 1
+            # probe the next shard; shard_changes 400s past the end
+            if shard > 64:
+                break
+        if applied:
+            self._internal("POST", f"/{follower_index}/_refresh", None)
+        return applied
+
+    def tick(self) -> dict:
+        polled = {}
+        for name in list(self.followers):
+            try:
+                polled[name] = self.poll_one(name)
+            except ElasticsearchError as e:
+                polled[name] = f"error: {e}"
+        created = self._auto_follow()
+        return {"polled": polled, "auto_followed": created}
+
+    def _apply(self, follower_index: str, op: dict) -> None:
+        kind = op.get("op")
+        if kind == "index":
+            q = f"routing={op['routing']}" if op.get("routing") else ""
+            self._internal("PUT",
+                           f"/{follower_index}/_doc/{op['id']}",
+                           op.get("source") or {}, query=q)
+        elif kind == "delete":
+            try:
+                self._internal("DELETE",
+                               f"/{follower_index}/_doc/{op['id']}", None)
+            except ElasticsearchError:
+                pass                     # already absent on the follower
+        # no_op: checkpoint advances only
+
+    def _internal(self, method: str, path: str, body, query: str = ""):
+        import json as _json
+        payload = b"" if body is None else _json.dumps(body).encode()
+        prev = getattr(self.api._internal_tls, "active", False)
+        self.api._internal_tls.active = True
+        try:
+            st, _ct, out = self.api.handle(method, path, query, payload)
+        finally:
+            self.api._internal_tls.active = prev
+        if st >= 400:
+            doc = _json.loads(out)
+            err = (doc.get("error") or {})
+            reason = err.get("reason") if isinstance(err, dict) else err
+            e = ElasticsearchError(str(reason))
+            e.status = st
+            raise e
+        return out
+
+    # -- auto-follow ----------------------------------------------------
+    def put_auto_follow(self, name: str, body: dict) -> dict:
+        if not body.get("remote_cluster") or \
+                not body.get("leader_index_patterns"):
+            raise IllegalArgumentError(
+                "[remote_cluster] and [leader_index_patterns] are "
+                "required")
+        self.auto_patterns[name] = {
+            "remote_cluster": body["remote_cluster"],
+            "leader_index_patterns": body["leader_index_patterns"],
+            "follow_index_pattern": body.get("follow_index_pattern",
+                                             "{{leader_index}}"),
+        }
+        return {"acknowledged": True}
+
+    def get_auto_follow(self, name: Optional[str]) -> dict:
+        if name is None:
+            items = sorted(self.auto_patterns.items())
+        else:
+            if name not in self.auto_patterns:
+                raise ResourceNotFoundError(
+                    f"auto-follow pattern [{name}] is missing")
+            items = [(name, self.auto_patterns[name])]
+        return {"patterns": [{"name": n, "pattern": p}
+                             for n, p in items]}
+
+    def delete_auto_follow(self, name: str) -> dict:
+        if self.auto_patterns.pop(name, None) is None:
+            raise ResourceNotFoundError(
+                f"auto-follow pattern [{name}] is missing")
+        return {"acknowledged": True}
+
+    def _auto_follow(self) -> List[str]:
+        import fnmatch
+        import json as _json
+        created = []
+        for pname, p in self.auto_patterns.items():
+            try:
+                client = self.api.remotes.client(p["remote_cluster"])
+                st, _ct, out = client.exec("GET", "/_cat/indices",
+                                           "format=json", b"")
+                if st >= 400:
+                    continue
+                remote_indices = [row["index"]
+                                  for row in _json.loads(out)]
+            except ElasticsearchError:
+                continue
+            for li in remote_indices:
+                if not any(fnmatch.fnmatch(li, pat)
+                           for pat in p["leader_index_patterns"]):
+                    continue
+                follow_name = p["follow_index_pattern"].replace(
+                    "{{leader_index}}", li)
+                if follow_name in self.followers:
+                    continue
+                try:
+                    self.follow(follow_name, {
+                        "remote_cluster": p["remote_cluster"],
+                        "leader_index": li})
+                    created.append(follow_name)
+                except ElasticsearchError:
+                    continue
+        return created

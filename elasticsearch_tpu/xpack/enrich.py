@@ -1,0 +1,143 @@
+"""Enrich: lookup-join policies + the ``enrich`` ingest processor.
+
+Reference: ``x-pack/plugin/enrich/`` — ``EnrichPolicyRunner.java`` builds
+a hidden ``.enrich-*`` lookup index on ``_execute``; the
+``MatchProcessor`` then term-joins incoming docs against it inside ingest
+pipelines. Here ``_execute`` drains the source through the search seam
+into an in-process hash table keyed on the match field (the observable
+core of the hidden index: exact-match lookup with ``max_matches``), and
+the processor registers through the same ingest SPI hook every other
+processor uses. The table registry is process-global, mirroring the
+ingest registry itself (policies are cluster state in the reference;
+the cluster tier re-executes policies per node the same way pipelines
+replicate)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..common.errors import (IllegalArgumentError,
+                             ResourceAlreadyExistsError,
+                             ResourceNotFoundError)
+from ..ingest.pipeline import Processor, ProcessorException, _req, \
+    register_processor
+
+#: policy name → {"match_field", "lookup": {value: [enrich-doc, ...]}}
+_ENRICH_LOOKUPS: Dict[str, dict] = {}
+
+
+class EnrichService:
+    MAX_DOCS = 100_000
+
+    def __init__(self, search_fn):
+        self.search_fn = search_fn
+        self.policies: Dict[str, dict] = {}
+
+    def put_policy(self, name: str, body: dict) -> dict:
+        if name in self.policies:
+            raise ResourceAlreadyExistsError(
+                f"policy [{name}] already exists")
+        ptype = next(iter(body), None)
+        if ptype not in ("match", "geo_match", "range"):
+            raise IllegalArgumentError(
+                f"unsupported policy type "
+                f"[{ptype}], supported types are [match, geo_match, "
+                f"range]")
+        spec = body[ptype]
+        for req_key in ("indices", "match_field", "enrich_fields"):
+            if req_key not in spec:
+                raise IllegalArgumentError(f"[{req_key}] is required")
+        self.policies[name] = {"type": ptype, "spec": spec}
+        return {"acknowledged": True}
+
+    def get_policy(self, name: Optional[str]) -> dict:
+        if name in (None, "_all", "*"):
+            items = sorted(self.policies.items())
+        else:
+            if name not in self.policies:
+                raise ResourceNotFoundError(
+                    f"policy [{name}] not found")
+            items = [(name, self.policies[name])]
+        return {"policies": [
+            {"config": {p["type"]: dict(p["spec"], name=n)}}
+            for n, p in items]}
+
+    def delete_policy(self, name: str) -> dict:
+        if self.policies.pop(name, None) is None:
+            raise ResourceNotFoundError(f"policy [{name}] not found")
+        _ENRICH_LOOKUPS.pop(name, None)
+        return {"acknowledged": True}
+
+    def execute_policy(self, name: str) -> dict:
+        p = self.policies.get(name)
+        if p is None:
+            raise ResourceNotFoundError(f"policy [{name}] not found")
+        spec = p["spec"]
+        indices = spec["indices"]
+        if isinstance(indices, list):
+            indices = ",".join(indices)
+        match_field = spec["match_field"]
+        enrich_fields = spec["enrich_fields"]
+        lookup: Dict[Any, List[dict]] = {}
+        search_after = None
+        while True:
+            body: dict = {"size": 1000,
+                          "sort": [{"_doc": {"order": "asc"}}],
+                          "query": spec.get("query") or {"match_all": {}}}
+            if search_after is not None:
+                body["search_after"] = search_after
+            resp = self.search_fn(indices, body)
+            hits = resp["hits"]["hits"]
+            for h in hits:
+                src = h.get("_source") or {}
+                key = src.get(match_field)
+                if key is None:
+                    continue
+                doc = {f: src[f] for f in enrich_fields if f in src}
+                doc[match_field] = key
+                keys = key if isinstance(key, list) else [key]
+                for k in keys:
+                    lookup.setdefault(k, []).append(doc)
+            if len(hits) < 1000 or sum(
+                    len(v) for v in lookup.values()) >= self.MAX_DOCS:
+                break
+            search_after = hits[-1]["sort"]
+        _ENRICH_LOOKUPS[name] = {"match_field": match_field,
+                                 "lookup": lookup}
+        return {"status": {"phase": "COMPLETE"}}
+
+
+class EnrichProcessor(Processor):
+    """``enrich`` ingest processor (``MatchProcessor.java``)."""
+
+    type_name = "enrich"
+
+    def __init__(self, body):
+        super().__init__(body)
+        self.policy_name = _req(body, "policy_name", "enrich")
+        self.field = _req(body, "field", "enrich")
+        self.target_field = _req(body, "target_field", "enrich")
+        self.max_matches = int(body.get("max_matches", 1))
+        self.override = body.get("override", True)
+        if not (1 <= self.max_matches <= 128):
+            raise ProcessorException(
+                "[max_matches] should be between 1 and 128")
+
+    def run(self, doc):
+        table = _ENRICH_LOOKUPS.get(self.policy_name)
+        if table is None:
+            raise ProcessorException(
+                f"no enrich index exists for policy with name "
+                f"[{self.policy_name}]")
+        key = doc.get(self.field)
+        if key is None:
+            return
+        if not self.override and doc.get(self.target_field) is not None:
+            return
+        matches = table["lookup"].get(key, [])[: self.max_matches]
+        if not matches:
+            return
+        doc.set(self.target_field,
+                matches[0] if self.max_matches == 1 else matches)
+
+
+register_processor(EnrichProcessor)

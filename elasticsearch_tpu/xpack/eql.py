@@ -1,0 +1,639 @@
+"""EQL front-end: event queries, sequences (by / with maxspan / until),
+and head/tail pipes over the standard search path.
+
+Reference: ``x-pack/plugin/eql`` — EQL parses to the shared ``ql`` tree and
+compiles event filters down to query DSL; sequences run as an iterative
+state machine over time-ordered event batches
+(``eql/execution/sequence/TumblingWindow.java``, ``SequenceMatcher``).
+Here each step's filter folds to DSL and executes through the (cluster-
+aware, TPU-planed) search seam; the sequence automaton then runs host-side
+over the time-merged event stream — same observable semantics (partial
+sequences keyed by join keys, maxspan windows, ``until`` clearing), sized
+for the response's ``size`` cap.
+
+Surface (documented subset):
+  <category> where <cond>           event query
+  sequence [by f1[,f2]] [with maxspan=Nu]
+    [cat1 where c1] [by g1] ... [until [cat where c]]
+  pipes: | head N   | tail N
+Conditions: ==, !=, <, <=, >, >=, :/like (wildcard match), in, in~,
+and/or/not, parentheses, wildcard(field, "p1", ...), true/false/null
+literals, double-quoted strings.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common.errors import ElasticsearchError
+
+
+class EqlParsingError(ElasticsearchError):
+    status = 400
+    error_type = "parsing_exception"
+
+
+class EqlVerificationError(ElasticsearchError):
+    status = 400
+    error_type = "verification_exception"
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+_TOK_RX = re.compile(r"""
+    \s*(?:
+      (?P<num>-?\d+\.\d+|-?\d+)
+    | "(?P<str>(?:[^"\\]|\\.)*)"
+    | (?P<op>==|!=|<=|>=|<|>|\(|\)|\[|\]|,|\||=|:)
+    | (?P<id>[A-Za-z_@][A-Za-z0-9_.@-]*~?)
+    )""", re.VERBOSE)
+
+_KEYWORDS = {"where", "and", "or", "not", "in", "like", "sequence", "by",
+             "with", "maxspan", "until", "head", "tail", "true", "false",
+             "null", "any"}
+
+
+def _untokenize_str(s: str) -> str:
+    return re.sub(r"\\(.)", r"\1", s)
+
+
+def _tokenize(text: str) -> List[Tuple[str, Any]]:
+    out: List[Tuple[str, Any]] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOK_RX.match(text, pos)
+        if m is None or m.end() == pos:
+            rest = text[pos:].strip()
+            if not rest:
+                break
+            raise EqlParsingError(
+                f"line 1:{pos + 1}: token recognition error at: "
+                f"'{rest[0]}'")
+        pos = m.end()
+        if m.group("num") is not None:
+            n = m.group("num")
+            out.append(("num", float(n) if "." in n else int(n)))
+        elif m.group("str") is not None:
+            out.append(("str", _untokenize_str(m.group("str"))))
+        elif m.group("op") is not None:
+            out.append(("op", m.group("op")))
+        else:
+            word = m.group("id")
+            low = word.lower().rstrip("~")
+            if low in _KEYWORDS and word.rstrip("~").islower():
+                out.append(("kw", low + ("~" if word.endswith("~")
+                                         else "")))
+            else:
+                out.append(("id", word))
+    out.append(("eof", None))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# condition AST → DSL folding (shares design with xpack/sql.fold_condition)
+# ---------------------------------------------------------------------------
+
+class _P:
+    def __init__(self, toks):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, kind, val=None):
+        k, v = self.peek()
+        if k == kind and (val is None or v == val):
+            self.i += 1
+            return v if val is None else True
+        return None if val is None else False
+
+    def expect_op(self, op):
+        if not self.accept("op", op):
+            raise EqlParsingError(f"expected '{op}' at [{self.peek()[1]}]")
+
+
+def _fold_cond(p: _P, resolve) -> dict:
+    return _or(p, resolve)
+
+
+def _or(p: _P, rf) -> dict:
+    parts = [_and(p, rf)]
+    while p.accept("kw", "or"):
+        parts.append(_and(p, rf))
+    if len(parts) == 1:
+        return parts[0]
+    return {"bool": {"should": parts, "minimum_should_match": 1}}
+
+
+def _and(p: _P, rf) -> dict:
+    parts = [_not(p, rf)]
+    while p.accept("kw", "and"):
+        parts.append(_not(p, rf))
+    if len(parts) == 1:
+        return parts[0]
+    return {"bool": {"must": parts}}
+
+
+def _not(p: _P, rf) -> dict:
+    if p.accept("kw", "not"):
+        return {"bool": {"must_not": [_not(p, rf)]}}
+    return _pred(p, rf)
+
+
+_RANGE_OP = {"<": "lt", "<=": "lte", ">": "gt", ">=": "gte"}
+
+
+def _pred(p: _P, rf) -> dict:
+    if p.accept("op", "("):
+        inner = _fold_cond(p, rf)
+        p.expect_op(")")
+        return inner
+    k, v = p.next()
+    if k == "kw" and v == "true":
+        return {"match_all": {}}
+    if k == "kw" and v == "false":
+        return {"bool": {"must_not": [{"match_all": {}}]}}
+    if k == "id" and p.accept("op", "("):
+        return _func(p, v, rf)
+    if k != "id":
+        raise EqlParsingError(f"unexpected token [{v}] in condition")
+    field = v
+    kk, vv = p.peek()
+    if kk == "op" and vv in ("==", "!="):
+        p.next()
+        val = _value(p)
+        if val is None:
+            q: dict = {"exists": {"field": field}}
+            return q if vv == "!=" else {"bool": {"must_not": [q]}}
+        q = {"term": {rf(field): {"value": val}}}
+        return q if vv == "==" else {"bool": {"must_not": [q]}}
+    if kk == "op" and vv in _RANGE_OP:
+        p.next()
+        val = _value(p)
+        return {"range": {field: {_RANGE_OP[vv]: val}}}
+    if kk == "op" and vv == ":":
+        p.next()
+        return _like(p, field, rf)
+    if (kk == "kw" and vv in ("like", "like~")) or \
+            (kk == "id" and vv in ("like", "like~")):
+        p.next()
+        return _like(p, field, rf, ci=str(vv).endswith("~"))
+    if kk == "kw" and vv in ("in", "in~"):
+        ci = vv.endswith("~")
+        p.next()
+        p.expect_op("(")
+        vals = []
+        while True:
+            vals.append(_value(p))
+            if p.accept("op", ")"):
+                break
+            if not p.accept("op", ","):
+                raise EqlParsingError("expected , or ) in value list")
+        if ci:
+            # in~ is case-insensitive membership: disjunction of ci terms
+            return {"bool": {"should": [
+                {"term": {rf(field): {"value": v,
+                                      "case_insensitive": True}}}
+                for v in vals], "minimum_should_match": 1}}
+        return {"terms": {rf(field): vals}}
+    if kk == "kw" and vv == "not":
+        p.next()
+        ci = bool(p.accept("kw", "in~"))
+        if not ci and not p.accept("kw", "in"):
+            raise EqlParsingError("expected 'in' after 'not'")
+        p.expect_op("(")
+        vals = []
+        while True:
+            vals.append(_value(p))
+            if p.accept("op", ")"):
+                break
+            if not p.accept("op", ","):
+                raise EqlParsingError("expected , or ) in value list")
+        if ci:
+            return {"bool": {"must_not": [
+                {"term": {rf(field): {"value": v,
+                                      "case_insensitive": True}}}
+                for v in vals]}}
+        return {"bool": {"must_not": [{"terms": {rf(field): vals}}]}}
+    raise EqlParsingError(f"expected an operator after [{field}]")
+
+
+def _like(p: _P, field: str, rf, ci: bool = False) -> dict:
+    k, v = p.next()
+    single = None
+    if k == "str":
+        single = v
+    elif k == "op" and v == "(":
+        pats = []
+        while True:
+            kk, vv = p.next()
+            if kk != "str":
+                raise EqlParsingError("like expects string patterns")
+            pats.append(vv)
+            if p.accept("op", ")"):
+                break
+            if not p.accept("op", ","):
+                raise EqlParsingError("expected , or ) in pattern list")
+        shoulds = [_one_like(field, pt, rf, ci) for pt in pats]
+        return {"bool": {"should": shoulds, "minimum_should_match": 1}}
+    else:
+        raise EqlParsingError("like expects a string pattern")
+    return _one_like(field, single, rf, ci)
+
+
+def _one_like(field: str, pattern: str, rf, ci: bool) -> dict:
+    if "*" in pattern or "?" in pattern:
+        q: dict = {"value": pattern}
+        if ci:
+            q["case_insensitive"] = True
+        return {"wildcard": {rf(field): q}}
+    tq: dict = {"value": pattern}
+    if ci:
+        tq["case_insensitive"] = True
+    return {"term": {rf(field): tq}}
+
+
+def _func(p: _P, name: str, rf) -> dict:
+    """wildcard(field, "p1", ...) / cidrMatch(field, "cidr", ...) analogs."""
+    args: List[Any] = []
+    while True:
+        k, v = p.next()
+        if k == "id":
+            args.append(("field", v))
+        elif k in ("str", "num"):
+            args.append(("lit", v))
+        else:
+            raise EqlParsingError(f"unexpected token in {name}()")
+        if p.accept("op", ")"):
+            break
+        if not p.accept("op", ","):
+            raise EqlParsingError(f"expected , or ) in {name}()")
+    lname = name.lower()
+    if lname == "wildcard":
+        if not args or args[0][0] != "field":
+            raise EqlVerificationError("wildcard() needs a field first")
+        field = args[0][1]
+        pats = [a[1] for a in args[1:] if a[0] == "lit"]
+        shoulds = [_one_like(field, str(pt), rf, False) for pt in pats]
+        return {"bool": {"should": shoulds, "minimum_should_match": 1}}
+    if lname == "cidrmatch":
+        if not args or args[0][0] != "field":
+            raise EqlVerificationError("cidrMatch() needs a field first")
+        field = args[0][1]
+        nets = [str(a[1]) for a in args[1:] if a[0] == "lit"]
+        return {"terms": {field: nets}}
+    raise EqlVerificationError(f"unknown function [{name}]")
+
+
+def _value(p: _P) -> Any:
+    k, v = p.next()
+    if k == "num" or k == "str":
+        return v
+    if k == "kw" and v in ("true", "false", "null"):
+        return {"true": True, "false": False, "null": None}[v]
+    raise EqlParsingError(f"expected a value but found [{v}]")
+
+
+# ---------------------------------------------------------------------------
+# top-level query parsing
+# ---------------------------------------------------------------------------
+
+class EventQuery:
+    def __init__(self, category: Optional[str], cond_dsl: dict,
+                 join_fields: Optional[List[str]] = None):
+        self.category = category
+        self.cond_dsl = cond_dsl
+        self.join_fields = join_fields or []
+
+
+class ParsedEql:
+    def __init__(self):
+        self.kind = "event"              # event | sequence
+        self.event: Optional[EventQuery] = None
+        self.steps: List[EventQuery] = []
+        self.until: Optional[EventQuery] = None
+        self.by: List[str] = []
+        self.maxspan_ms: Optional[float] = None
+        self.pipes: List[Tuple[str, int]] = []
+
+
+_SPAN_UNITS = {"ms": 1.0, "s": 1e3, "m": 6e4, "h": 3.6e6, "d": 8.64e7}
+
+
+def parse_eql(text: str, resolve) -> ParsedEql:
+    p = _P(_tokenize(text))
+    out = ParsedEql()
+    k, v = p.peek()
+    if k == "kw" and v == "sequence":
+        p.next()
+        out.kind = "sequence"
+        if p.accept("kw", "by"):
+            out.by.append(_field_name(p))
+            while p.accept("op", ","):
+                out.by.append(_field_name(p))
+        if p.accept("kw", "with"):
+            if not p.accept("kw", "maxspan"):
+                raise EqlParsingError("expected maxspan after 'with'")
+            if not p.accept("op", "="):
+                raise EqlParsingError("expected = after maxspan")
+            kk, vv = p.next()
+            if kk != "num":
+                raise EqlParsingError("maxspan expects a number+unit")
+            ku, vu = p.peek()
+            unit = "s"
+            if ku == "id" and vu in _SPAN_UNITS:
+                p.next()
+                unit = vu
+            elif ku == "kw" and vu == "maxspan":   # pragma: no cover
+                pass
+            out.maxspan_ms = float(vv) * _SPAN_UNITS[unit]
+        while True:
+            kk, vv = p.peek()
+            if kk == "op" and vv == "[":
+                p.next()
+                out.steps.append(_bracketed_event(p, resolve))
+                if p.accept("kw", "by"):
+                    out.steps[-1].join_fields.append(_field_name(p))
+                    while p.accept("op", ","):
+                        out.steps[-1].join_fields.append(_field_name(p))
+            elif kk == "kw" and vv == "until":
+                p.next()
+                if not p.accept("op", "["):
+                    raise EqlParsingError("until expects [event where ...]")
+                out.until = _bracketed_event(p, resolve)
+            else:
+                break
+        if len(out.steps) < 2:
+            raise EqlParsingError(
+                "a sequence requires a minimum of 2 queries")
+        for s in out.steps:
+            if len(s.join_fields) != len(out.steps[0].join_fields):
+                raise EqlParsingError(
+                    "per-step 'by' arity must match across the sequence")
+    else:
+        out.event = _event_query(p, resolve)
+    # pipes
+    while p.accept("op", "|"):
+        kk, vv = p.next()
+        if kk not in ("kw", "id") or vv not in ("head", "tail"):
+            raise EqlParsingError(f"unknown pipe [{vv}]")
+        kn, vn = p.next()
+        if kn != "num" or not isinstance(vn, int):
+            raise EqlParsingError(f"pipe {vv} expects an integer")
+        out.pipes.append((vv, vn))
+    k, v = p.peek()
+    if k != "eof":
+        raise EqlParsingError(f"unexpected trailing input [{v}]")
+    return out
+
+
+def _field_name(p: _P) -> str:
+    k, v = p.next()
+    if k != "id":
+        raise EqlParsingError(f"expected a field name but found [{v}]")
+    return v
+
+
+def _event_query(p: _P, resolve) -> EventQuery:
+    k, v = p.next()
+    if k == "kw" and v == "any":
+        category = None
+    elif k in ("id", "str"):
+        category = str(v)
+    else:
+        raise EqlParsingError(f"expected an event category, found [{v}]")
+    if not p.accept("kw", "where"):
+        raise EqlParsingError("expected 'where'")
+    cond = _fold_cond(p, resolve)
+    return EventQuery(category, cond)
+
+
+def _bracketed_event(p: _P, resolve) -> EventQuery:
+    ev = _event_query(p, resolve)
+    if not p.accept("op", "]"):
+        raise EqlParsingError("expected ]")
+    return ev
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+class EqlService:
+    """Executes parsed EQL through the search seam.
+
+    ``search_fn(index, body) -> response`` and ``mapper_fn(index)`` come
+    from the REST layer (same seam as ``SqlService``).
+    """
+
+    #: per-step event fetch bound for the host-side sequence automaton
+    #: (the reference windows in batches of ``eql.fetch_size``; one large
+    #: time-ordered page keeps the automaton exact at conformance scale
+    #: and is documented as the scale limit)
+    SEQUENCE_FETCH = 10_000
+
+    def __init__(self, search_fn, mapper_fn):
+        self.search_fn = search_fn
+        self.mapper_fn = mapper_fn
+
+    def _resolver(self, index: str):
+        mapper = self.mapper_fn(index)
+
+        def rf(name: str) -> str:
+            if mapper is None:
+                return name
+            ft = mapper.field_type(name)
+            if ft is not None and ft.type_name == "text":
+                sub = mapper.field_type(name + ".keyword")
+                if sub is not None and sub.type_name == "keyword":
+                    return name + ".keyword"
+            return name
+        return rf
+
+    def search(self, index: str, payload: dict) -> dict:
+        import time as _time
+        t0 = _time.time()
+        query = payload.get("query")
+        if not query or not isinstance(query, str):
+            raise EqlParsingError("[query] is required")
+        ts_field = payload.get("timestamp_field", "@timestamp")
+        cat_field = payload.get("event_category_field", "event.category")
+        tiebreak = payload.get("tiebreaker_field")
+        size = int(payload.get("size", 10))
+        rf = self._resolver(index)
+        parsed = parse_eql(query, rf)
+        if parsed.kind == "event":
+            hits, total = self._run_event(
+                index, parsed, payload, ts_field, cat_field, tiebreak,
+                size, rf)
+            body: dict = {"events": hits,
+                          "total": {"value": total, "relation": "eq"}}
+        else:
+            seqs = self._run_sequence(
+                index, parsed, payload, ts_field, cat_field, tiebreak,
+                size, rf)
+            body = {"sequences": seqs,
+                    "total": {"value": len(seqs), "relation": "eq"}}
+        return {
+            "is_partial": False, "is_running": False,
+            "took": int((_time.time() - t0) * 1000), "timed_out": False,
+            "hits": body,
+        }
+
+    # -- event queries --------------------------------------------------
+    def _event_filter(self, ev: EventQuery, payload: dict,
+                      cat_field: str, rf) -> dict:
+        must: List[dict] = [ev.cond_dsl]
+        if ev.category is not None:
+            must.append({"term": {rf(cat_field): {"value": ev.category}}})
+        if payload.get("filter"):
+            must.append(payload["filter"])
+        return {"bool": {"must": must}} if len(must) > 1 else must[0]
+
+    @staticmethod
+    def _event_hit(h: dict) -> dict:
+        return {"_index": h["_index"], "_id": h["_id"],
+                "_source": h.get("_source")}
+
+    def _run_event(self, index, parsed, payload, ts_field, cat_field,
+                   tiebreak, size, rf):
+        head_n, tail = size, False
+        for pipe, n in parsed.pipes:
+            head_n = min(head_n, n) if pipe == "head" else head_n
+            if pipe == "tail":
+                head_n, tail = min(size, n), True
+        sort: List[dict] = [{ts_field: {
+            "order": "desc" if tail else "asc"}}]
+        if tiebreak:
+            sort.append({rf(tiebreak): {
+                "order": "desc" if tail else "asc"}})
+        body = {"size": head_n, "sort": sort, "track_total_hits": True,
+                "query": self._event_filter(parsed.event, payload,
+                                            cat_field, rf)}
+        resp = self.search_fn(index, body)
+        hits = [self._event_hit(h) for h in resp["hits"]["hits"]]
+        if tail:
+            hits.reverse()
+        return hits, resp["hits"]["total"]["value"]
+
+    # -- sequences ------------------------------------------------------
+    def _fetch_step(self, index, ev, payload, ts_field, cat_field,
+                    tiebreak, rf) -> List[dict]:
+        sort: List[dict] = [{ts_field: {"order": "asc"}}]
+        if tiebreak:
+            sort.append({rf(tiebreak): {"order": "asc"}})
+        body = {"size": self.SEQUENCE_FETCH, "sort": sort,
+                "query": self._event_filter(ev, payload, cat_field, rf)}
+        return self.search_fn(index, body)["hits"]["hits"]
+
+    def _run_sequence(self, index, parsed, payload, ts_field, cat_field,
+                      tiebreak, size, rf) -> List[dict]:
+        steps = parsed.steps
+        n = len(steps)
+        streams = [self._fetch_step(index, ev, payload, ts_field,
+                                    cat_field, tiebreak, rf)
+                   for ev in steps]
+        until_stream = (self._fetch_step(index, parsed.until, payload,
+                                         ts_field, cat_field, tiebreak,
+                                         rf)
+                        if parsed.until is not None else [])
+        # merge into one time-ordered stream tagged by step index
+        # (reference: TumblingWindow advances all stages in one ordered
+        # pass); -1 tags until-events
+        merged: List[Tuple[Any, int, int, dict]] = []
+        for si, hs in enumerate(streams):
+            for hi, h in enumerate(hs):
+                merged.append((self._sort_key(h), si, hi, h))
+        for hi, h in enumerate(until_stream):
+            merged.append((self._sort_key(h), -1, hi, h))
+        merged.sort(key=lambda t: (t[0], t[1]))
+
+        def join_key(h: dict, si: int) -> Optional[tuple]:
+            fields = list(parsed.by)
+            if si >= 0 and steps[si].join_fields:
+                fields = fields + steps[si].join_fields
+            elif si < 0 and parsed.until is not None \
+                    and parsed.until.join_fields:
+                fields = fields + parsed.until.join_fields
+            if not fields:
+                return ()
+            src = h.get("_source") or {}
+            vals = []
+            for f in fields:
+                v = _dot_get(src, f)
+                if v is None:
+                    return None           # missing join key: not joinable
+                vals.append(v)
+            return tuple(vals)
+
+        # partial sequences: key → list of event-lists awaiting stage len()
+        partials: Dict[tuple, List[List[dict]]] = {}
+        completed: List[dict] = []
+        for sk, si, _hi, h in merged:
+            if si == -1:
+                k = join_key(h, -1)
+                if k is not None and k in partials:
+                    # until clears in-flight sequences for that key
+                    partials.pop(k, None)
+                continue
+            k = join_key(h, si)
+            if k is None:
+                continue
+            ts = sk[0]
+            if si == 0:
+                partials.setdefault(k, []).append([h])
+                continue
+            plist = partials.get(k)
+            if not plist:
+                continue
+            # the automaton extends the MOST RECENT partial at stage si
+            # (ES keeps one in-flight sequence per key per stage, last
+            # writer wins — SequenceMatcher's stage replacement)
+            for p in reversed(plist):
+                if len(p) != si:
+                    continue
+                if parsed.maxspan_ms is not None:
+                    t0 = self._ts_value(p[0])
+                    if ts - t0 > parsed.maxspan_ms:
+                        continue
+                p.append(h)
+                if len(p) == n:
+                    plist.remove(p)
+                    completed.append({
+                        "join_keys": list(k),
+                        "events": [self._event_hit(e) for e in p]})
+                break
+            if not plist:
+                partials.pop(k, None)
+        for pipe, pn in parsed.pipes:
+            completed = completed[:pn] if pipe == "head" \
+                else completed[-pn:]
+        return completed[:size]
+
+    def _sort_key(self, h: dict) -> tuple:
+        s = h.get("sort")
+        if s:
+            return tuple(s)
+        return (0,)
+
+    def _ts_value(self, h: dict) -> float:
+        s = h.get("sort")
+        return float(s[0]) if s else 0.0
+
+
+def _dot_get(src: dict, path: str) -> Any:
+    cur: Any = src
+    for part in path.split("."):
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            return None
+    return cur

@@ -1,0 +1,110 @@
+"""Graph explore: entity co-occurrence expansion over terms aggregations.
+
+Reference: ``x-pack/plugin/graph/.../TransportGraphExploreAction.java`` —
+each hop runs a (sampled) significant/plain terms aggregation under the
+seed query to pick vertices, then expands connections by co-occurrence
+counting between the frontier's terms and the next hop's fields. Here each
+hop folds into plain searches through the shared search seam: one terms
+agg picks the hop's vertices, then one filtered terms agg per frontier
+vertex counts co-occurrence (exact doc counts, not the reference's
+sampler approximation — documented divergence that only strengthens
+weights).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common.errors import IllegalArgumentError
+
+
+class GraphService:
+    MAX_HOPS = 5
+
+    def __init__(self, search_fn):
+        self.search_fn = search_fn
+
+    def explore(self, index: str, payload: dict) -> dict:
+        import time as _time
+        t0 = _time.time()
+        hop = payload
+        if "vertices" not in hop:
+            raise IllegalArgumentError(
+                "Graph explore request requires [vertices]")
+        vertices: List[dict] = []     # {field, term, weight, depth}
+        connections: List[dict] = []  # {source, target, weight, doc_count}
+        vkey: Dict[Tuple[str, str], int] = {}
+
+        def add_vertex(field: str, term: str, weight: float,
+                       depth: int) -> int:
+            k = (field, term)
+            if k in vkey:
+                return vkey[k]
+            vkey[k] = len(vertices)
+            vertices.append({"field": field, "term": term,
+                             "weight": weight, "depth": depth})
+            return vkey[k]
+
+        # hop 0: seed vertices under the seed query
+        seed_query = hop.get("query") or {"match_all": {}}
+        frontier: List[int] = []
+        for vspec in hop["vertices"]:
+            field = vspec["field"]
+            size = int(vspec.get("size", 5))
+            min_dc = int(vspec.get("min_doc_count", 3))
+            body = {"size": 0, "query": seed_query, "aggs": {
+                "v": {"terms": {"field": field, "size": size,
+                                "min_doc_count": min_dc}}}}
+            resp = self.search_fn(index, body)
+            total = max(resp["hits"]["total"]["value"], 1)
+            for b in resp["aggregations"]["v"]["buckets"]:
+                vi = add_vertex(field, str(b["key"]),
+                                b["doc_count"] / total, 0)
+                frontier.append(vi)
+
+        # connection hops expand from the current frontier
+        depth = 1
+        conn = hop.get("connections")
+        while conn is not None and depth <= self.MAX_HOPS:
+            if "vertices" not in conn:
+                raise IllegalArgumentError(
+                    "[connections] requires [vertices]")
+            next_frontier: List[int] = []
+            frontier_seen: set = set()
+            for src_i in frontier:
+                src = vertices[src_i]
+                for vspec in conn["vertices"]:
+                    field = vspec["field"]
+                    size = int(vspec.get("size", 5))
+                    min_dc = int(vspec.get("min_doc_count", 3))
+                    must: List[dict] = [
+                        {"term": {src["field"]: src["term"]}}]
+                    if conn.get("query"):
+                        must.append(conn["query"])
+                    body = {"size": 0,
+                            "query": {"bool": {"must": must}},
+                            "aggs": {"v": {"terms": {
+                                "field": field, "size": size,
+                                "min_doc_count": min_dc}}}}
+                    resp = self.search_fn(index, body)
+                    total = max(resp["hits"]["total"]["value"], 1)
+                    for b in resp["aggregations"]["v"]["buckets"]:
+                        term = str(b["key"])
+                        if field == src["field"] and term == src["term"]:
+                            continue       # self-loop
+                        tgt_i = add_vertex(field, term,
+                                           b["doc_count"] / total, depth)
+                        connections.append({
+                            "source": src_i, "target": tgt_i,
+                            "weight": b["doc_count"] / total,
+                            "doc_count": b["doc_count"]})
+                        if vertices[tgt_i]["depth"] == depth and \
+                                tgt_i not in frontier_seen:
+                            frontier_seen.add(tgt_i)
+                            next_frontier.append(tgt_i)
+            frontier = next_frontier
+            conn = conn.get("connections")
+            depth += 1
+
+        return {"took": int((_time.time() - t0) * 1000),
+                "timed_out": False,
+                "vertices": vertices, "connections": connections}

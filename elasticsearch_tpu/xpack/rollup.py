@@ -1,0 +1,348 @@
+"""Rollup: scheduled downsampling jobs + rollup-aware search.
+
+Reference: ``x-pack/plugin/rollup/`` — ``RollupIndexer.java`` pages a
+composite aggregation over the job's groups and writes one summary doc
+per bucket into the rollup index using the flattened column naming
+(``<field>.date_histogram.timestamp``, ``<field>.terms.value``,
+``<metric>.avg.value`` + ``.avg._count`` …); ``TransportRollupSearch
+Action.java`` rewrites live aggregations onto those columns and repairs
+averages from sum/count pairs. Both halves are reproduced here over the
+shared search/bulk seams; jobs execute their full batch on ``_start``
+(the indexer loop collapses, same stance as transforms)."""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional
+
+from ..common.errors import (ElasticsearchError, IllegalArgumentError,
+                             ResourceAlreadyExistsError,
+                             ResourceNotFoundError)
+
+
+class RollupService:
+    PAGE = 500
+
+    def __init__(self, search_fn, bulk_fn, create_index_fn=None):
+        self.search_fn = search_fn
+        self.bulk_fn = bulk_fn
+        #: (index, mappings) -> None; pre-creates the rollup index with
+        #: typed columns (keyword terms values, date timestamps) the way
+        #: RollupIndexer does — dynamic mapping would text-ify them
+        self.create_index_fn = create_index_fn
+        self.jobs: Dict[str, dict] = {}
+
+    # -- job CRUD -------------------------------------------------------
+    def put_job(self, jid: str, body: dict) -> dict:
+        if jid in self.jobs:
+            raise ResourceAlreadyExistsError(
+                f"Cannot create rollup job [{jid}] because job was "
+                f"previously created (existing metadata)")
+        for req_key in ("index_pattern", "rollup_index", "cron",
+                        "page_size", "groups"):
+            if req_key not in body:
+                raise IllegalArgumentError(f"[{req_key}] is required")
+        if "date_histogram" not in body["groups"]:
+            raise IllegalArgumentError(
+                "rollup requires a [groups.date_histogram]")
+        self.jobs[jid] = {"config": dict(body, id=jid),
+                          "status": {"job_state": "stopped"},
+                          "stats": {"pages_processed": 0,
+                                    "documents_processed": 0,
+                                    "rollups_indexed": 0,
+                                    "trigger_count": 0}}
+        return {"acknowledged": True}
+
+    def get_jobs(self, jid: Optional[str]) -> dict:
+        if jid in (None, "_all"):
+            items = sorted(self.jobs.items())
+        else:
+            items = [(jid, self.jobs[jid])] if jid in self.jobs else []
+        return {"jobs": [{"config": j["config"], "status": j["status"],
+                          "stats": j["stats"]} for _, j in items]}
+
+    def delete_job(self, jid: str) -> dict:
+        j = self.jobs.get(jid)
+        if j is None:
+            raise ResourceNotFoundError(f"the task with id [{jid}] "
+                                        f"doesn't exist")
+        if j["status"]["job_state"] == "started":
+            raise ElasticsearchError(
+                f"Could not delete job [{jid}] because indexer state is "
+                f"[STARTED]. Job must be [STOPPED] before deletion.")
+        del self.jobs[jid]
+        return {"acknowledged": True}
+
+    def start_job(self, jid: str) -> dict:
+        j = self.jobs.get(jid)
+        if j is None:
+            raise ResourceNotFoundError(f"Task for Rollup Job [{jid}] "
+                                        f"not found")
+        j["status"]["job_state"] = "started"
+        j["stats"]["trigger_count"] += 1
+        try:
+            self._run(j)
+        finally:
+            j["status"]["job_state"] = "stopped"
+        return {"started": True}
+
+    def stop_job(self, jid: str) -> dict:
+        j = self.jobs.get(jid)
+        if j is None:
+            raise ResourceNotFoundError(f"Task for Rollup Job [{jid}] "
+                                        f"not found")
+        j["status"]["job_state"] = "stopped"
+        return {"stopped": True}
+
+    def caps(self, pattern: Optional[str]) -> dict:
+        out: Dict[str, dict] = {}
+        for jid, j in self.jobs.items():
+            cfg = j["config"]
+            if pattern not in (None, "_all") and \
+                    cfg["index_pattern"] != pattern:
+                continue
+            fields: Dict[str, list] = {}
+            groups = cfg["groups"]
+            dh = groups["date_histogram"]
+            fields.setdefault(dh["field"], []).append(
+                {"agg": "date_histogram",
+                 **{k: v for k, v in dh.items() if k != "field"}})
+            for tf in (groups.get("terms") or {}).get("fields", []):
+                fields.setdefault(tf, []).append({"agg": "terms"})
+            for m in cfg.get("metrics", []):
+                for op in m.get("metrics", []):
+                    fields.setdefault(m["field"], []).append({"agg": op})
+            out.setdefault(cfg["index_pattern"], {"rollup_jobs": []})[
+                "rollup_jobs"].append({
+                    "job_id": jid, "rollup_index": cfg["rollup_index"],
+                    "index_pattern": cfg["index_pattern"],
+                    "fields": fields})
+        return out
+
+    # -- the indexer ----------------------------------------------------
+    def _run(self, j: dict) -> None:
+        cfg = j["config"]
+        groups = cfg["groups"]
+        dh = groups["date_histogram"]
+        date_field = dh["field"]
+        sources: List[dict] = [{"_ts": {"date_histogram": {
+            "field": date_field,
+            **{k: v for k, v in dh.items()
+               if k in ("fixed_interval", "calendar_interval",
+                        "interval", "time_zone")}}}}]
+        term_fields = (groups.get("terms") or {}).get("fields", [])
+        for tf in term_fields:
+            sources.append({f"_t_{tf}": {"terms": {"field": tf}}})
+        hist = groups.get("histogram")
+        hist_fields = (hist or {}).get("fields", [])
+        for hf in hist_fields:
+            sources.append({f"_h_{hf}": {"histogram": {
+                "field": hf, "interval": hist["interval"]}}})
+        aggs: Dict[str, dict] = {}
+        for m in cfg.get("metrics", []):
+            f = m["field"]
+            for op in m.get("metrics", []):
+                if op == "avg":
+                    aggs[f"{f}_sum"] = {"sum": {"field": f}}
+                    aggs[f"{f}_vc"] = {"value_count": {"field": f}}
+                elif op in ("sum", "min", "max"):
+                    aggs[f"{f}_{op}"] = {op: {"field": f}}
+                elif op == "value_count":
+                    aggs[f"{f}_vc"] = {"value_count": {"field": f}}
+        if self.create_index_fn is not None:
+            props: Dict[str, dict] = {
+                f"{date_field}.date_histogram.timestamp":
+                    {"type": "date"},
+                f"{date_field}.date_histogram._count": {"type": "long"},
+            }
+            for tf in term_fields:
+                props[f"{tf}.terms.value"] = {"type": "keyword"}
+                props[f"{tf}.terms._count"] = {"type": "long"}
+            for hf in hist_fields:
+                props[f"{hf}.histogram.value"] = {"type": "double"}
+            for m in cfg.get("metrics", []):
+                for op in m.get("metrics", []):
+                    if op == "avg":
+                        props[f"{m['field']}.avg.value"] = \
+                            {"type": "double"}
+                        props[f"{m['field']}.avg._count"] = \
+                            {"type": "long"}
+                    else:
+                        key = "vc" if op == "value_count" else op
+                        props[f"{m['field']}.{op}.value"] = \
+                            {"type": "double"}
+            self.create_index_fn(cfg["rollup_index"],
+                                 {"properties": props})
+        after = None
+        page_size = min(int(cfg.get("page_size", self.PAGE)), 10_000)
+        interval = (dh.get("fixed_interval") or dh.get("interval")
+                    or dh.get("calendar_interval"))
+        while True:
+            comp: dict = {"size": page_size, "sources": sources}
+            if after is not None:
+                comp["after"] = after
+            body: dict = {"size": 0, "aggs": {"_r": {
+                "composite": comp, **({"aggs": aggs} if aggs else {})}}}
+            resp = self.search_fn(cfg["index_pattern"], body)
+            node = (resp.get("aggregations") or {}).get("_r") or {}
+            buckets = node.get("buckets", [])
+            j["stats"]["pages_processed"] += 1
+            lines: List[dict] = []
+            for b in buckets:
+                doc: Dict[str, Any] = {
+                    "_rollup.id": cfg["id"], "_rollup.version": 2,
+                    f"{date_field}.date_histogram.timestamp":
+                        b["key"]["_ts"],
+                    f"{date_field}.date_histogram.interval": interval,
+                    f"{date_field}.date_histogram._count":
+                        b["doc_count"],
+                }
+                for tf in term_fields:
+                    doc[f"{tf}.terms.value"] = b["key"].get(f"_t_{tf}")
+                    doc[f"{tf}.terms._count"] = b["doc_count"]
+                for hf in hist_fields:
+                    doc[f"{hf}.histogram.value"] = b["key"].get(
+                        f"_h_{hf}")
+                    doc[f"{hf}.histogram.interval"] = hist["interval"]
+                    doc[f"{hf}.histogram._count"] = b["doc_count"]
+                for m in cfg.get("metrics", []):
+                    f = m["field"]
+                    for op in m.get("metrics", []):
+                        if op == "avg":
+                            doc[f"{f}.avg.value"] = \
+                                (b.get(f"{f}_sum") or {}).get("value")
+                            doc[f"{f}.avg._count"] = \
+                                (b.get(f"{f}_vc") or {}).get("value")
+                        elif op in ("sum", "min", "max"):
+                            doc[f"{f}.{op}.value"] = \
+                                (b.get(f"{f}_{op}") or {}).get("value")
+                        elif op == "value_count":
+                            doc[f"{f}.value_count.value"] = \
+                                (b.get(f"{f}_vc") or {}).get("value")
+                rid = hashlib.sha1(json.dumps(
+                    b["key"], sort_keys=True).encode()).hexdigest()[:20]
+                lines.append({"index": {"_index": cfg["rollup_index"],
+                                        "_id": f"{cfg['id']}${rid}"}})
+                lines.append(doc)
+                j["stats"]["documents_processed"] += b["doc_count"]
+                j["stats"]["rollups_indexed"] += 1
+            if lines:
+                self.bulk_fn(cfg["rollup_index"], lines)
+            after = node.get("after_key")
+            if after is None or not buckets:
+                return
+
+    # -- rollup search --------------------------------------------------
+    def rollup_search(self, index: str, body: dict) -> dict:
+        """Rewrite a live-shaped search onto rollup columns
+        (``TransportRollupSearchAction`` RollupResponseTranslator)."""
+        aggs_in = body.get("aggs") or body.get("aggregations") or {}
+        if body.get("size", 0) != 0:
+            raise IllegalArgumentError(
+                "Rollup does not support returning search hits, please "
+                "try again with [size: 0]")
+        new_body: dict = {"size": 0}
+        if body.get("query") is not None:
+            new_body["query"] = self._rewrite_query(body["query"])
+        if aggs_in:
+            new_body["aggs"] = self._rewrite_aggs(aggs_in)
+        resp = self.search_fn(index, new_body)
+        aggs_out = resp.get("aggregations") or {}
+        self._repair_avgs(aggs_out)
+        out = {"took": resp.get("took", 0), "timed_out": False,
+               "_shards": resp.get("_shards", {}),
+               "hits": {"total": {"value": 0, "relation": "eq"},
+                        "max_score": 0.0, "hits": []}}
+        if aggs_out:
+            out["aggregations"] = aggs_out
+        return out
+
+    #: marker suffix for staged avg-count siblings (stripped on repair)
+    _AVG_COUNT = "__rollup_avg_count"
+
+    def _rewrite_aggs(self, aggs_in: dict) -> dict:
+        out: Dict[str, dict] = {}
+        for name, spec in aggs_in.items():
+            new_spec: Dict[str, Any] = {}
+            for k, v in spec.items():
+                if k in ("aggs", "aggregations"):
+                    new_spec["aggs"] = self._rewrite_aggs(v)
+                elif k == "date_histogram":
+                    new_spec[k] = dict(
+                        v, field=f"{v['field']}.date_histogram.timestamp")
+                elif k == "terms":
+                    new_spec[k] = dict(v,
+                                       field=f"{v['field']}.terms.value")
+                elif k == "histogram":
+                    new_spec[k] = dict(
+                        v, field=f"{v['field']}.histogram.value")
+                elif k in ("sum", "min", "max"):
+                    new_spec[k] = dict(v,
+                                       field=f"{v['field']}.{k}.value")
+                elif k == "value_count":
+                    new_spec["sum"] = {
+                        "field": f"{v['field']}.value_count.value"}
+                elif k == "avg":
+                    # stage sum(value) here + a sum(_count) sibling;
+                    # _repair_avgs divides and strips the sibling
+                    new_spec["sum"] = {"field": f"{v['field']}.avg.value"}
+                    out[name + self._AVG_COUNT] = {"sum": {
+                        "field": f"{v['field']}.avg._count"}}
+                else:
+                    new_spec[k] = v
+            out[name] = new_spec
+        return out
+
+    def _repair_avgs(self, node: Any) -> None:
+        if isinstance(node, list):
+            for item in node:
+                self._repair_avgs(item)
+            return
+        if not isinstance(node, dict):
+            return
+        for cname in [c for c in list(node)
+                      if c.endswith(self._AVG_COUNT)]:
+            base = cname[: -len(self._AVG_COUNT)]
+            cnt = (node.pop(cname) or {}).get("value")
+            tgt = node.get(base)
+            if isinstance(tgt, dict):
+                total = tgt.get("value")
+                tgt["value"] = ((total / cnt)
+                                if total is not None and cnt else None)
+        for v in node.values():
+            self._repair_avgs(v)
+
+    def _group_fields(self):
+        """(date_histogram fields, terms fields) across configured jobs —
+        the caps the reference validates queried fields against."""
+        date_fields, term_fields = set(), set()
+        for j in self.jobs.values():
+            groups = j["config"]["groups"]
+            date_fields.add(groups["date_histogram"]["field"])
+            term_fields.update(
+                (groups.get("terms") or {}).get("fields", []))
+        return date_fields, term_fields
+
+    def _rewrite_query(self, q: dict) -> dict:
+        date_fields, term_fields = self._group_fields()
+        if "match_all" in q:
+            return q
+        if "range" in q:
+            (f, spec), = q["range"].items()
+            if f not in date_fields:
+                raise IllegalArgumentError(
+                    f"Field [{f}] in [range] query is not available in "
+                    f"selected rollup indices, cannot query.")
+            return {"range": {f"{f}.date_histogram.timestamp": spec}}
+        if "term" in q:
+            (f, spec), = q["term"].items()
+            base = f[:-len(".keyword")] if f.endswith(".keyword") else f
+            if base not in term_fields and f not in term_fields:
+                raise IllegalArgumentError(
+                    f"Field [{f}] in [term] query is not available in "
+                    f"selected rollup indices, cannot query.")
+            return {"term": {f"{base}.terms.value": spec}}
+        raise IllegalArgumentError(
+            f"Unsupported Query in rollup search: "
+            f"[{next(iter(q), '?')}]")
+

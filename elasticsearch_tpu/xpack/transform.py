@@ -1,0 +1,240 @@
+"""Transforms: pivot / latest jobs that page the source through composite
+aggregations into a destination index.
+
+Reference: ``x-pack/plugin/transform/.../transforms/TransformIndexer.java``
+— a checkpointed persistent task pages ``composite`` results and bulk-
+indexes pivoted docs into the dest. Here a transform executes its full
+batch synchronously on ``_start`` (the indexer loop collapses: page →
+bulk → next ``after_key`` until drained), reusing the composite agg and
+bulk machinery through the REST seam; ``docs_processed``/``pages``
+surface in stats. Continuous (``sync``) transforms re-drain on each
+``_start`` from their last checkpoint timestamp — the reference's
+poll-loop reduced to an explicit trigger, same shape as the ILM tick.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from ..common.errors import (ElasticsearchError, IllegalArgumentError,
+                             ResourceAlreadyExistsError,
+                             ResourceNotFoundError)
+
+
+class TransformService:
+    PAGE = 500
+
+    def __init__(self, search_fn, bulk_fn):
+        self.search_fn = search_fn
+        self.bulk_fn = bulk_fn
+        self.transforms: Dict[str, dict] = {}
+
+    # -- CRUD -----------------------------------------------------------
+    def put(self, tid: str, body: dict) -> dict:
+        if tid in self.transforms:
+            raise ResourceAlreadyExistsError(
+                f"Transform with id [{tid}] already exists")
+        src = body.get("source") or {}
+        if not src.get("index"):
+            raise IllegalArgumentError("[source.index] is required")
+        if not (body.get("dest") or {}).get("index"):
+            raise IllegalArgumentError("[dest.index] is required")
+        if not body.get("pivot") and not body.get("latest"):
+            raise IllegalArgumentError(
+                "Either [pivot] or [latest] must be specified")
+        if body.get("pivot") and body.get("latest"):
+            raise IllegalArgumentError(
+                "[pivot] and [latest] are mutually exclusive")
+        self.transforms[tid] = {
+            "config": dict(body, id=tid),
+            "state": "stopped",
+            "checkpoint": 0,
+            "stats": {"pages_processed": 0, "documents_processed": 0,
+                      "documents_indexed": 0, "trigger_count": 0},
+            "create_time": int(time.time() * 1000),
+        }
+        return {"acknowledged": True}
+
+    def get(self, tid: Optional[str]) -> dict:
+        if tid in (None, "_all", "*"):
+            items = sorted(self.transforms.items())
+        else:
+            if tid not in self.transforms:
+                raise ResourceNotFoundError(
+                    f"Transform with id [{tid}] could not be found")
+            items = [(tid, self.transforms[tid])]
+        return {"count": len(items),
+                "transforms": [t["config"] for _, t in items]}
+
+    def stats(self, tid: Optional[str]) -> dict:
+        if tid in (None, "_all", "*"):
+            items = sorted(self.transforms.items())
+        else:
+            if tid not in self.transforms:
+                raise ResourceNotFoundError(
+                    f"Transform with id [{tid}] could not be found")
+            items = [(tid, self.transforms[tid])]
+        return {"count": len(items), "transforms": [
+            {"id": k, "state": t["state"],
+             "checkpointing": {"last": {
+                 "checkpoint": t["checkpoint"]}},
+             "stats": dict(t["stats"])} for k, t in items]}
+
+    def delete(self, tid: str, force: bool = False) -> dict:
+        t = self.transforms.get(tid)
+        if t is None:
+            raise ResourceNotFoundError(
+                f"Transform with id [{tid}] could not be found")
+        if t["state"] == "started" and not force:
+            raise ElasticsearchError(
+                f"Cannot delete transform [{tid}] as the task is running."
+                f" Stop the transform first")
+        del self.transforms[tid]
+        return {"acknowledged": True}
+
+    # -- execution ------------------------------------------------------
+    def preview(self, body: dict) -> dict:
+        docs = self._run_batch(body, write=False, limit=100)
+        return {"preview": docs, "generated_dest_index": {
+            "mappings": {"_meta": {"_transform": {
+                "transform": "transform-preview"}}}}}
+
+    def start(self, tid: str) -> dict:
+        t = self.transforms.get(tid)
+        if t is None:
+            raise ResourceNotFoundError(
+                f"Transform with id [{tid}] could not be found")
+        cfg = t["config"]
+        t["state"] = "indexing"
+        t["stats"]["trigger_count"] += 1
+        try:
+            docs = self._run_batch(cfg, write=True, stats=t["stats"])
+        finally:
+            # batch transforms complete; continuous ones stay started
+            t["state"] = ("started" if cfg.get("sync") else "stopped")
+        t["checkpoint"] += 1
+        return {"acknowledged": True}
+
+    def stop(self, tid: str) -> dict:
+        t = self.transforms.get(tid)
+        if t is None:
+            raise ResourceNotFoundError(
+                f"Transform with id [{tid}] could not be found")
+        t["state"] = "stopped"
+        return {"acknowledged": True}
+
+    def _run_batch(self, cfg: dict, write: bool, limit: int = 0,
+                   stats: Optional[dict] = None) -> List[dict]:
+        src = cfg["source"]
+        dest_index = (cfg.get("dest") or {}).get("index")
+        out_docs: List[dict] = []
+        if cfg.get("pivot"):
+            out_docs = self._run_pivot(cfg, src, limit, stats)
+        else:
+            out_docs = self._run_latest(cfg, src, limit, stats)
+        if write and dest_index:
+            lines: List[dict] = []
+            for d in out_docs:
+                lines.append({"index": {"_index": dest_index,
+                                        "_id": d.pop("_transform_id_")}})
+                lines.append(d)
+            if lines:
+                self.bulk_fn(dest_index, lines)
+            if stats is not None:
+                stats["documents_indexed"] += len(out_docs)
+        else:
+            for d in out_docs:
+                d.pop("_transform_id_", None)
+        return out_docs
+
+    def _run_pivot(self, cfg, src, limit, stats) -> List[dict]:
+        pivot = cfg["pivot"]
+        group_by = pivot.get("group_by") or {}
+        if not group_by:
+            raise IllegalArgumentError("[pivot.group_by] is required")
+        sources = []
+        for name, spec in group_by.items():
+            (kind, inner), = spec.items()
+            if kind not in ("terms", "date_histogram", "histogram"):
+                raise IllegalArgumentError(
+                    f"Unsupported group_by type [{kind}]")
+            sources.append({name: {kind: inner}})
+        aggs_spec = pivot.get("aggregations") or pivot.get("aggs") or {}
+        comp: dict = {"size": self.PAGE, "sources": sources}
+        out: List[dict] = []
+        after = None
+        while True:
+            agg_body: dict = {"composite": dict(comp)}
+            if after is not None:
+                agg_body["composite"]["after"] = after
+            if aggs_spec:
+                agg_body["aggs"] = aggs_spec
+            body = {"size": 0, "aggs": {"_transform": agg_body}}
+            if src.get("query"):
+                body["query"] = src["query"]
+            resp = self.search_fn(src["index"], body)
+            node = (resp.get("aggregations") or {}).get("_transform") or {}
+            buckets = node.get("buckets", [])
+            if stats is not None:
+                stats["pages_processed"] += 1
+            for b in buckets:
+                doc = dict(b["key"])
+                for aname in aggs_spec:
+                    av = b.get(aname) or {}
+                    doc[aname] = av.get("value", av if av else None)
+                key_blob = json.dumps(b["key"], sort_keys=True).encode()
+                doc["_transform_id_"] = hashlib.sha1(
+                    key_blob).hexdigest()[:20]
+                out.append(doc)
+                if stats is not None:
+                    stats["documents_processed"] += b.get("doc_count", 0)
+                if limit and len(out) >= limit:
+                    return out
+            after = node.get("after_key")
+            if after is None or not buckets:
+                return out
+
+    def _run_latest(self, cfg, src, limit, stats) -> List[dict]:
+        latest = cfg["latest"]
+        keys = latest.get("unique_key")
+        sort_field = latest.get("sort")
+        if not keys or not sort_field:
+            raise IllegalArgumentError(
+                "[latest.unique_key] and [latest.sort] are required")
+        sources = [{k: {"terms": {"field": k}}} for k in keys]
+        out: List[dict] = []
+        after = None
+        while True:
+            comp: dict = {"size": self.PAGE, "sources": sources}
+            if after is not None:
+                comp["after"] = after
+            body = {"size": 0, "aggs": {"_transform": {
+                "composite": comp,
+                "aggs": {"_latest": {"top_hits": {
+                    "size": 1, "sort": [{sort_field: "desc"}]}}}}}}
+            if src.get("query"):
+                body["query"] = src["query"]
+            resp = self.search_fn(src["index"], body)
+            node = (resp.get("aggregations") or {}).get("_transform") or {}
+            buckets = node.get("buckets", [])
+            if stats is not None:
+                stats["pages_processed"] += 1
+            for b in buckets:
+                hits = (b.get("_latest") or {}).get(
+                    "hits", {}).get("hits", [])
+                if not hits:
+                    continue
+                doc = dict(hits[0].get("_source") or {})
+                key_blob = json.dumps(b["key"], sort_keys=True).encode()
+                doc["_transform_id_"] = hashlib.sha1(
+                    key_blob).hexdigest()[:20]
+                out.append(doc)
+                if stats is not None:
+                    stats["documents_processed"] += b.get("doc_count", 0)
+                if limit and len(out) >= limit:
+                    return out
+            after = node.get("after_key")
+            if after is None or not buckets:
+                return out

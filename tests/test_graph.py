@@ -1,0 +1,65 @@
+"""Graph explore tests (x-pack/plugin/graph analog — xpack/graph.py)."""
+
+import json
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+@pytest.fixture()
+def api():
+    api = RestAPI(IndicesService(tempfile.mkdtemp()))
+    orders = [("alice", "laptop"), ("alice", "mouse"), ("bob", "laptop"),
+              ("bob", "keyboard"), ("carol", "mouse"), ("carol", "laptop"),
+              ("dan", "phone")]
+    for i, (u, p) in enumerate(orders):
+        api.handle("PUT", f"/orders/_doc/{i}", "",
+                   json.dumps({"user": u, "product": p}).encode())
+    api.handle("POST", "/orders/_refresh", "", b"")
+    return api
+
+
+def req(api, method, path, body=None):
+    b = json.dumps(body).encode() if isinstance(body, (dict, list)) \
+        else (body or b"")
+    st, _ct, out = api.handle(method, path, "", b)
+    return st, json.loads(out)
+
+
+def test_explore_one_hop(api):
+    st, r = req(api, "POST", "/orders/_graph/explore", {
+        "query": {"term": {"product.keyword": "laptop"}},
+        "vertices": [{"field": "user.keyword", "size": 5,
+                      "min_doc_count": 1}],
+        "connections": {"vertices": [{"field": "product.keyword",
+                                      "size": 5, "min_doc_count": 1}]}})
+    assert st == 200
+    seeds = {v["term"] for v in r["vertices"] if v["depth"] == 0}
+    assert seeds == {"alice", "bob", "carol"}       # laptop buyers
+    expanded = {v["term"] for v in r["vertices"] if v["depth"] == 1}
+    assert expanded == {"laptop", "mouse", "keyboard"}   # their products
+    assert "phone" not in {v["term"] for v in r["vertices"]}
+    # every connection links a depth-0 user to a depth-1 product
+    for c in r["connections"]:
+        assert r["vertices"][c["source"]]["depth"] == 0
+        assert r["vertices"][c["target"]]["depth"] == 1
+        assert c["doc_count"] >= 1
+
+
+def test_explore_requires_vertices(api):
+    st, r = req(api, "POST", "/orders/_graph/explore",
+                {"query": {"match_all": {}}})
+    assert st == 400
+
+
+def test_explore_seed_only(api):
+    st, r = req(api, "POST", "/orders/_graph/explore", {
+        "vertices": [{"field": "product.keyword", "size": 10,
+                      "min_doc_count": 2}]})
+    assert st == 200
+    assert r["connections"] == []
+    terms = {v["term"] for v in r["vertices"]}
+    assert terms == {"laptop", "mouse"}    # only products with >=2 docs

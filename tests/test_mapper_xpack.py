@@ -1,0 +1,148 @@
+"""Specialized x-pack field type tests: constant_keyword, wildcard,
+version, flattened (reference: ``x-pack/plugin/mapper-constant-keyword``,
+``wildcard``, ``mapper-version``, ``mapper-flattened``).
+"""
+
+import json
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+@pytest.fixture()
+def api():
+    return RestAPI(IndicesService(tempfile.mkdtemp()))
+
+
+def req(api, method, path, body=None, query=""):
+    b = json.dumps(body).encode() if isinstance(body, (dict, list)) \
+        else (body or b"")
+    st, _ct, out = api.handle(method, path, query, b)
+    return st, json.loads(out)
+
+
+def search(api, index, body):
+    st, r = req(api, "POST", f"/{index}/_search", body)
+    assert st == 200, r
+    return r
+
+
+def test_constant_keyword(api):
+    st, _ = req(api, "PUT", "/ck", {"mappings": {"properties": {
+        "env": {"type": "constant_keyword", "value": "prod"},
+        "v": {"type": "long"}}}})
+    assert st == 200
+    # docs with and without the field both carry the constant
+    req(api, "PUT", "/ck/_doc/1", {"env": "prod", "v": 1})
+    req(api, "PUT", "/ck/_doc/2", {"v": 2})
+    req(api, "POST", "/ck/_refresh")
+    r = search(api, "ck", {"query": {"term": {"env": "prod"}}})
+    assert r["hits"]["total"]["value"] == 2
+    # a conflicting value is rejected
+    st, r = req(api, "PUT", "/ck/_doc/3", {"env": "staging"})
+    assert st == 400
+    # terms agg sees the constant for every doc
+    r = search(api, "ck", {"size": 0, "aggs": {
+        "e": {"terms": {"field": "env"}}}})
+    assert r["aggregations"]["e"]["buckets"] == [
+        {"key": "prod", "doc_count": 2}]
+
+
+def test_constant_keyword_value_pins_on_first_doc(api):
+    req(api, "PUT", "/ck2", {"mappings": {"properties": {
+        "env": {"type": "constant_keyword"}}}})
+    req(api, "PUT", "/ck2/_doc/1", {"env": "dev"})
+    st, _ = req(api, "PUT", "/ck2/_doc/2", {"env": "other"})
+    assert st == 400
+    st, r = req(api, "GET", "/ck2/_mapping")
+    assert r["ck2"]["mappings"]["properties"]["env"]["value"] == "dev"
+
+
+def test_wildcard_field(api):
+    req(api, "PUT", "/wc", {"mappings": {"properties": {
+        "path": {"type": "wildcard"}}}})
+    for i, p in enumerate(["/var/log/syslog", "/var/log/auth.log",
+                           "/home/u/notes.txt"]):
+        req(api, "PUT", f"/wc/_doc/{i}", {"path": p})
+    req(api, "POST", "/wc/_refresh")
+    r = search(api, "wc", {"query": {"wildcard": {
+        "path": {"value": "*log*"}}}})
+    assert r["hits"]["total"]["value"] == 2
+    r = search(api, "wc", {"query": {"term": {
+        "path": "/home/u/notes.txt"}}})
+    assert r["hits"]["total"]["value"] == 1
+
+
+def test_version_field_ordering(api):
+    req(api, "PUT", "/vv", {"mappings": {"properties": {
+        "ver": {"type": "version"}}}})
+    vers = ["1.10.0", "1.2.0", "2.0.0-alpha", "2.0.0", "1.2.10"]
+    for i, v in enumerate(vers):
+        req(api, "PUT", f"/vv/_doc/{i}", {"ver": v})
+    req(api, "POST", "/vv/_refresh")
+    r = search(api, "vv", {"sort": [{"ver": "asc"}], "size": 10})
+    got = [h["_source"]["ver"] for h in r["hits"]["hits"]]
+    # semver order, NOT lexicographic (1.2.0 < 1.2.10 < 1.10.0;
+    # 2.0.0-alpha before 2.0.0)
+    assert got == ["1.2.0", "1.2.10", "1.10.0", "2.0.0-alpha", "2.0.0"]
+    r = search(api, "vv", {"query": {"term": {"ver": "1.10.0"}}})
+    assert r["hits"]["total"]["value"] == 1
+
+
+def test_flattened_field(api):
+    req(api, "PUT", "/fl", {"mappings": {"properties": {
+        "labels": {"type": "flattened"}}}})
+    req(api, "PUT", "/fl/_doc/1", {"labels": {
+        "priority": "urgent", "release": ["v1.2", "v1.3"],
+        "nested": {"team": "infra"}}})
+    req(api, "PUT", "/fl/_doc/2", {"labels": {"priority": "low"}})
+    req(api, "POST", "/fl/_refresh")
+    # root query matches any leaf value
+    r = search(api, "fl", {"query": {"term": {"labels": "urgent"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+    # keyed path query
+    r = search(api, "fl", {"query": {"term": {
+        "labels.priority": "urgent"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+    # deep path
+    r = search(api, "fl", {"query": {"term": {
+        "labels.nested.team": "infra"}}})
+    assert r["hits"]["total"]["value"] == 1
+    # arrays index every element
+    r = search(api, "fl", {"query": {"term": {"labels.release": "v1.3"}}})
+    assert r["hits"]["total"]["value"] == 1
+    # terms agg over a keyed path
+    r = search(api, "fl", {"size": 0, "aggs": {
+        "p": {"terms": {"field": "labels.priority"}}}})
+    got = {b["key"]: b["doc_count"]
+           for b in r["aggregations"]["p"]["buckets"]}
+    assert got == {"low": 1, "urgent": 1}
+
+
+def test_flattened_depth_limit(api):
+    req(api, "PUT", "/fd", {"mappings": {"properties": {
+        "f": {"type": "flattened", "depth_limit": 2}}}})
+    st, _ = req(api, "PUT", "/fd/_doc/1", {"f": {"a": {"b": "ok"}}})
+    assert st in (200, 201)
+    st, r = req(api, "PUT", "/fd/_doc/2",
+                {"f": {"a": {"b": {"c": "deep"}}}})
+    assert st == 400
+
+
+def test_flattened_rejects_scalars(api):
+    req(api, "PUT", "/fs", {"mappings": {"properties": {
+        "f": {"type": "flattened"}}}})
+    st, _ = req(api, "PUT", "/fs/_doc/1", {"f": "scalar"})
+    assert st == 400
+
+
+def test_unsigned_long_range(api):
+    req(api, "PUT", "/ul", {"mappings": {"properties": {
+        "n": {"type": "unsigned_long"}}}})
+    st, _ = req(api, "PUT", "/ul/_doc/1", {"n": 18446744073709551615})
+    assert st in (200, 201)
+    st, _ = req(api, "PUT", "/ul/_doc/2", {"n": -1})
+    assert st == 400

@@ -1,0 +1,274 @@
+"""Transform / rollup / watcher / enrich tests (x-pack analogs —
+xpack/{transform,rollup,watcher,enrich}.py).
+"""
+
+import json
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+@pytest.fixture()
+def api():
+    return RestAPI(IndicesService(tempfile.mkdtemp()))
+
+
+def req(api, method, path, body=None, query=""):
+    b = json.dumps(body).encode() if isinstance(body, (dict, list)) \
+        else (body or b"")
+    st, _ct, out = api.handle(method, path, query, b)
+    return st, json.loads(out)
+
+
+@pytest.fixture()
+def sales(api):
+    rows = [("2023-01-01T01:00:00Z", "a", 10.0),
+            ("2023-01-01T02:00:00Z", "a", 20.0),
+            ("2023-01-01T03:00:00Z", "b", 30.0),
+            ("2023-01-02T01:00:00Z", "a", 40.0),
+            ("2023-01-02T02:00:00Z", "b", 50.0)]
+    for i, (ts, cat, price) in enumerate(rows):
+        req(api, "PUT", f"/sales/_doc/{i}",
+            {"@timestamp": ts, "category": cat, "price": price})
+    req(api, "POST", "/sales/_refresh")
+    return api
+
+
+# -- transform -------------------------------------------------------------
+
+def test_transform_pivot_end_to_end(sales):
+    st, r = req(sales, "PUT", "/_transform/t1", {
+        "source": {"index": "sales"},
+        "dest": {"index": "sales_by_cat"},
+        "pivot": {
+            "group_by": {"cat": {"terms": {"field": "category.keyword"}}},
+            "aggregations": {
+                "total": {"sum": {"field": "price"}},
+                "avg_price": {"avg": {"field": "price"}}}}})
+    assert st == 200 and r == {"acknowledged": True}
+    st, r = req(sales, "POST", "/_transform/t1/_start")
+    assert st == 200
+    st, r = req(sales, "POST", "/sales_by_cat/_search",
+                {"sort": [{"cat.keyword": "asc"}]})
+    docs = [h["_source"] for h in r["hits"]["hits"]]
+    assert docs == [
+        {"cat": "a", "total": 70.0, "avg_price": 70.0 / 3},
+        {"cat": "b", "total": 80.0, "avg_price": 40.0}]
+    st, r = req(sales, "GET", "/_transform/t1/_stats")
+    assert r["transforms"][0]["stats"]["documents_indexed"] == 2
+    assert r["transforms"][0]["stats"]["documents_processed"] == 5
+
+
+def test_transform_rerun_upserts_not_duplicates(sales):
+    req(sales, "PUT", "/_transform/t2", {
+        "source": {"index": "sales"}, "dest": {"index": "dest2"},
+        "pivot": {"group_by": {"cat": {"terms": {
+            "field": "category.keyword"}}},
+            "aggregations": {"n": {"value_count": {"field": "price"}}}}})
+    req(sales, "POST", "/_transform/t2/_start")
+    req(sales, "POST", "/_transform/t2/_start")
+    st, r = req(sales, "POST", "/dest2/_search", {})
+    assert r["hits"]["total"]["value"] == 2    # stable ids → upserts
+
+
+def test_transform_preview_and_validation(sales):
+    st, r = req(sales, "POST", "/_transform/_preview", {
+        "source": {"index": "sales"},
+        "dest": {"index": "unused"},
+        "pivot": {"group_by": {"cat": {"terms": {
+            "field": "category.keyword"}}},
+            "aggregations": {"m": {"max": {"field": "price"}}}}})
+    assert st == 200
+    assert {d["cat"]: d["m"] for d in r["preview"]} == \
+        {"a": 40.0, "b": 50.0}
+    st, r = req(sales, "PUT", "/_transform/bad", {
+        "source": {"index": "sales"}, "dest": {"index": "x"}})
+    assert st == 400
+    st, r = req(sales, "GET", "/_transform/nope")
+    assert st == 404
+
+
+def test_transform_latest(sales):
+    req(sales, "PUT", "/_transform/t3", {
+        "source": {"index": "sales"}, "dest": {"index": "latest_dest"},
+        "latest": {"unique_key": ["category.keyword"],
+                   "sort": "@timestamp"}})
+    req(sales, "POST", "/_transform/t3/_start")
+    st, r = req(sales, "POST", "/latest_dest/_search",
+                {"sort": [{"category.keyword": "asc"}]})
+    docs = [h["_source"] for h in r["hits"]["hits"]]
+    assert [d["price"] for d in docs] == [40.0, 50.0]   # latest per cat
+
+
+# -- rollup ----------------------------------------------------------------
+
+def test_rollup_job_and_search(sales):
+    st, r = req(sales, "PUT", "/_rollup/job/r1", {
+        "index_pattern": "sales", "rollup_index": "sales_rollup",
+        "cron": "*/30 * * * * ?", "page_size": 100,
+        "groups": {
+            "date_histogram": {"field": "@timestamp",
+                               "calendar_interval": "1d"},
+            "terms": {"fields": ["category.keyword"]}},
+        "metrics": [{"field": "price",
+                     "metrics": ["sum", "avg", "max"]}]})
+    assert st == 200
+    st, r = req(sales, "POST", "/_rollup/job/r1/_start")
+    assert st == 200
+    st, r = req(sales, "POST", "/sales_rollup/_search",
+                {"size": 20})
+    assert r["hits"]["total"]["value"] == 4   # 2 days × 2 categories
+    src = r["hits"]["hits"][0]["_source"]
+    assert "@timestamp.date_histogram.timestamp" in src
+    assert "price.sum.value" in src
+    # rollup-aware search rebuilds live-shaped aggregations
+    st, r = req(sales, "POST", "/sales_rollup/_rollup_search", {
+        "size": 0, "aggs": {"cats": {
+            "terms": {"field": "category.keyword"},
+            "aggs": {"total": {"sum": {"field": "price"}},
+                     "avg_p": {"avg": {"field": "price"}}}}}})
+    assert st == 200, r
+    got = {b["key"]: (b["total"]["value"], b["avg_p"]["value"])
+           for b in r["aggregations"]["cats"]["buckets"]}
+    assert got["a"] == (70.0, 70.0 / 3)
+    assert got["b"] == (80.0, 40.0)
+    # caps
+    st, r = req(sales, "GET", "/_rollup/data/sales")
+    caps = r["sales"]["rollup_jobs"][0]
+    assert caps["rollup_index"] == "sales_rollup"
+    st, r = req(sales, "GET", "/{i}/_rollup_search".format(
+        i="sales_rollup"), {"size": 5})
+    assert st == 400       # hits not supported
+
+
+def test_rollup_job_lifecycle_errors(api):
+    st, r = req(api, "PUT", "/_rollup/job/bad", {"index_pattern": "x"})
+    assert st == 400
+    st, r = req(api, "POST", "/_rollup/job/nope/_start")
+    assert st == 404
+
+
+# -- watcher ---------------------------------------------------------------
+
+def test_watcher_execute_with_search_input(sales):
+    st, r = req(sales, "PUT", "/_watcher/watch/w1", {
+        "trigger": {"schedule": {"interval": "10s"}},
+        "input": {"search": {"request": {
+            "indices": ["sales"],
+            "body": {"query": {"range": {"price": {"gte": 45}}}}}}},
+        "condition": {"compare": {
+            "ctx.payload.hits.total.value": {"gt": 0}}},
+        "actions": {
+            "log_it": {"logging": {
+                "text": "found {{ctx.payload.hits.total.value}} hits"}},
+            "index_it": {"index": {"index": "alerts"}}}})
+    assert st == 200 and r["created"] is True
+    st, r = req(sales, "POST", "/_watcher/watch/w1/_execute")
+    assert st == 200
+    rec = r["watch_record"]
+    assert rec["state"] == "executed"
+    assert rec["result"]["condition"]["met"] is True
+    acts = {a["id"]: a for a in rec["result"]["actions"]}
+    assert acts["log_it"]["logging"]["logged_text"] == "found 1 hits"
+    assert acts["index_it"]["status"] == "success"
+    st, r = req(sales, "POST", "/alerts/_search", {})
+    assert r["hits"]["total"]["value"] == 1
+
+
+def test_watcher_condition_not_met(sales):
+    req(sales, "PUT", "/_watcher/watch/w2", {
+        "trigger": {"schedule": {"interval": "10s"}},
+        "input": {"simple": {"n": 0}},
+        "condition": {"compare": {"ctx.payload.n": {"gt": 5}}},
+        "actions": {"a": {"logging": {"text": "x"}}}})
+    st, r = req(sales, "POST", "/_watcher/watch/w2/_execute")
+    assert r["watch_record"]["state"] == "execution_not_needed"
+
+
+def test_watcher_tick_runs_due_watches(sales):
+    req(sales, "PUT", "/_watcher/watch/w3", {
+        "trigger": {"schedule": {"interval": "10s"}},
+        "input": {"simple": {"ok": 1}},
+        "condition": {"always": {}},
+        "actions": {"a": {"logging": {"text": "ping"}}}})
+    st, r = req(sales, "POST", "/_watcher/_tick", query="now_ms=1000000")
+    assert r["ran"] == ["w3"]
+    # not due again 5s later
+    st, r = req(sales, "POST", "/_watcher/_tick", query="now_ms=1005000")
+    assert r["ran"] == []
+    # due after the interval
+    st, r = req(sales, "POST", "/_watcher/_tick", query="now_ms=1011000")
+    assert r["ran"] == ["w3"]
+
+
+def test_watcher_crud_and_activation(api):
+    req(api, "PUT", "/_watcher/watch/w4", {
+        "trigger": {"schedule": {"interval": "1m"}},
+        "input": {"simple": {}}, "condition": {"always": {}},
+        "actions": {}})
+    st, r = req(api, "GET", "/_watcher/watch/w4")
+    assert r["found"] is True
+    st, r = req(api, "POST", "/_watcher/watch/w4/_deactivate")
+    assert r["status"]["state"]["active"] is False
+    st, r = req(api, "POST", "/_watcher/_tick", query="now_ms=99999999")
+    assert r["ran"] == []            # inactive watches don't run
+    st, r = req(api, "DELETE", "/_watcher/watch/w4")
+    assert r["found"] is True
+    st, r = req(api, "GET", "/_watcher/watch/w4")
+    assert st == 404
+    st, r = req(api, "GET", "/_watcher/stats")
+    assert r["watch_count"] == 0
+
+
+# -- enrich ----------------------------------------------------------------
+
+def test_enrich_policy_and_processor(api):
+    for i, (u, city, tier) in enumerate([
+            ("alice", "berlin", "gold"), ("bob", "paris", "silver")]):
+        req(api, "PUT", f"/users/_doc/{i}",
+            {"email": u, "city": city, "tier": tier})
+    req(api, "POST", "/users/_refresh")
+    st, r = req(api, "PUT", "/_enrich/policy/users-policy", {
+        "match": {"indices": "users", "match_field": "email",
+                  "enrich_fields": ["city", "tier"]}})
+    assert st == 200
+    st, r = req(api, "PUT", "/_enrich/policy/users-policy/_execute")
+    assert st == 200 and r["status"]["phase"] == "COMPLETE"
+    # pipeline with the enrich processor joins incoming docs
+    st, r = req(api, "PUT", "/_ingest/pipeline/join-users", {
+        "processors": [{"enrich": {
+            "policy_name": "users-policy", "field": "user",
+            "target_field": "user_info"}}]})
+    assert st == 200
+    st, r = req(api, "PUT", "/orders2/_doc/1",
+                {"user": "alice", "amount": 5},
+                query="pipeline=join-users")
+    assert st in (200, 201)
+    req(api, "POST", "/orders2/_refresh")
+    st, r = req(api, "GET", "/orders2/_doc/1")
+    assert r["_source"]["user_info"]["city"] == "berlin"
+    assert r["_source"]["user_info"]["tier"] == "gold"
+    # no match → no target field
+    req(api, "PUT", "/orders2/_doc/2", {"user": "nobody"},
+        query="pipeline=join-users")
+    st, r = req(api, "GET", "/orders2/_doc/2")
+    assert "user_info" not in r["_source"]
+    # CRUD
+    st, r = req(api, "GET", "/_enrich/policy/users-policy")
+    assert r["policies"][0]["config"]["match"]["match_field"] == "email"
+    st, r = req(api, "DELETE", "/_enrich/policy/users-policy")
+    assert st == 200
+    st, r = req(api, "GET", "/_enrich/policy/users-policy")
+    assert st == 404
+
+
+def test_enrich_policy_validation(api):
+    st, r = req(api, "PUT", "/_enrich/policy/bad", {"match": {}})
+    assert st == 400
+    st, r = req(api, "PUT", "/_enrich/policy/bad2", {"weird": {}})
+    assert st == 400
+    st, r = req(api, "PUT", "/_enrich/policy/nope/_execute")
+    assert st == 404
