@@ -1,0 +1,9 @@
+"""CLI tools (L15): keystore management, node launcher, SQL shell.
+
+Reference: ``distribution/tools/{keystore-cli,launchers}`` and the
+x-pack SQL CLI. Run as modules:
+
+    python -m elasticsearch_tpu.cli.keystore  <create|list|add|remove>
+    python -m elasticsearch_tpu.cli.node      [--port 9200] [--data DIR]
+    python -m elasticsearch_tpu.cli.sql       [--server host:port]
+"""

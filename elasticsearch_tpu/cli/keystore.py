@@ -1,0 +1,100 @@
+"""estpu-keystore: manage the secure-settings keystore.
+
+Reference: ``distribution/tools/keystore-cli/`` (CreateKeyStoreCommand,
+AddStringKeyStoreCommand, ListKeyStoreCommand, RemoveSettingKeyStore
+Command, ChangeKeyStorePasswordCommand).
+
+    python -m elasticsearch_tpu.cli.keystore create [--path FILE]
+    python -m elasticsearch_tpu.cli.keystore list
+    python -m elasticsearch_tpu.cli.keystore add <setting> [--stdin]
+    python -m elasticsearch_tpu.cli.keystore remove <setting>
+    python -m elasticsearch_tpu.cli.keystore passwd
+"""
+from __future__ import annotations
+
+import argparse
+import getpass
+import os
+import sys
+
+from ..common.keystore import Keystore, KeystoreError
+
+
+def _default_path() -> str:
+    return os.environ.get("ESTPU_KEYSTORE",
+                          os.path.join(os.getcwd(), Keystore.FILENAME))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="estpu-keystore")
+    ap.add_argument("--path", default=None,
+                    help="keystore file (default: $ESTPU_KEYSTORE or "
+                         "./estpu.keystore)")
+    ap.add_argument("--password", default=None,
+                    help="keystore password (prompted when protected)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("create")
+    sub.add_parser("list")
+    p_add = sub.add_parser("add")
+    p_add.add_argument("setting")
+    p_add.add_argument("--stdin", action="store_true",
+                       help="read the value from stdin")
+    p_rm = sub.add_parser("remove")
+    p_rm.add_argument("setting")
+    sub.add_parser("passwd")
+    args = ap.parse_args(argv)
+    path = args.path or _default_path()
+
+    def load() -> Keystore:
+        pw = args.password if args.password is not None else ""
+        try:
+            return Keystore.load(path, pw)
+        except KeystoreError:
+            if args.password is None and sys.stdin.isatty():
+                pw = getpass.getpass("Keystore password: ")
+                return Keystore.load(path, pw)
+            raise
+
+    try:
+        if args.cmd == "create":
+            if os.path.exists(path):
+                print(f"keystore already exists at [{path}]",
+                      file=sys.stderr)
+                return 1
+            Keystore(path, args.password or "").save()
+            print(f"Created keystore [{path}]")
+            return 0
+        if not os.path.exists(path):
+            print(f"ERROR: keystore not found at [{path}]; run 'create'",
+                  file=sys.stderr)
+            return 1
+        ks = load()
+        if args.cmd == "list":
+            for k in ks.list_keys():
+                print(k)
+        elif args.cmd == "add":
+            if args.stdin or not sys.stdin.isatty():
+                value = sys.stdin.readline().rstrip("\n")
+            else:
+                value = getpass.getpass(
+                    f"Enter value for {args.setting}: ")
+            ks.set(args.setting, value)
+            ks.save()
+        elif args.cmd == "remove":
+            ks.remove(args.setting)
+            ks.save()
+        elif args.cmd == "passwd":
+            new = args.password
+            if new is None:
+                new = getpass.getpass("New password: ")
+            ks.password = new
+            ks.save()
+            print("Password updated")
+        return 0
+    except KeystoreError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,91 @@
+"""estpu-node: launch a single node serving HTTP.
+
+Reference: the ``elasticsearch`` launcher scripts
+(``distribution/tools/launchers/``) + ``bootstrap/Elasticsearch.java:75``
+reduced to the single-process case: build the node stack, bind the HTTP
+port, serve until SIGINT. Cluster formation (multi-node) is configured
+through ``--seed`` peers, in which case the full coordination stack runs.
+
+    python -m elasticsearch_tpu.cli.node --port 9200 --data ./data
+    python -m elasticsearch_tpu.cli.node --name n1 --transport-port 9300 \\
+        --seed n1=127.0.0.1:9300 --seed n2=127.0.0.1:9301
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="estpu-node")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9200)
+    ap.add_argument("--data", default="./data")
+    ap.add_argument("--name", default="estpu-node-0")
+    ap.add_argument("--cluster-name", default="es-tpu")
+    ap.add_argument("--transport-port", type=int, default=None,
+                    help="enable the cluster transport on this port")
+    ap.add_argument("--seed", action="append", default=[],
+                    metavar="NAME=HOST:PORT",
+                    help="cluster peer (repeatable; includes self)")
+    ap.add_argument("--jax-platform", default=None,
+                    help="force the jax backend (tpu/cpu); default: "
+                         "ambient")
+    args = ap.parse_args(argv)
+    if args.jax_platform:
+        import jax
+        jax.config.update("jax_platforms", args.jax_platform)
+    os.makedirs(args.data, exist_ok=True)
+
+    if args.transport_port is not None and args.seed:
+        peers = {}
+        for s in args.seed:
+            name, _, addr = s.partition("=")
+            host, _, port = addr.partition(":")
+            peers[name] = (host, int(port))
+        from ..node.cluster_node import ClusterNode
+        node = ClusterNode(args.name, args.host, args.transport_port,
+                           peers, args.data)
+        handler = node.rest.handle
+        print(f"[{args.name}] cluster node up: transport "
+              f"{args.host}:{args.transport_port}, peers "
+              f"{sorted(peers)}")
+    else:
+        from ..node.indices_service import IndicesService
+        from ..rest.api import RestAPI
+        api = RestAPI(IndicesService(args.data),
+                      cluster_name=args.cluster_name,
+                      node_name=args.name)
+        handler = api.handle
+        node = None
+
+    from ..rest.http_server import HttpServer
+
+    async def serve():
+        srv = HttpServer(handler, host=args.host, port=args.port,
+                         pass_headers=True)
+        await srv.start()
+        print(f"[{args.name}] HTTP listening on "
+              f"http://{args.host}:{args.port}")
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:   # pragma: no cover (windows)
+                pass
+        await stop.wait()
+        await srv.stop()
+
+    try:
+        asyncio.run(serve())
+    finally:
+        if node is not None:
+            node.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

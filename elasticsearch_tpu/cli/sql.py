@@ -1,0 +1,60 @@
+"""estpu-sql: interactive SQL shell against a running node.
+
+Reference: the x-pack SQL CLI (``x-pack/plugin/sql/sql-cli``) — reads
+statements, POSTs to ``/_sql?format=txt``, prints the table.
+
+    python -m elasticsearch_tpu.cli.sql --server 127.0.0.1:9200
+    echo "SELECT * FROM idx" | python -m elasticsearch_tpu.cli.sql
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="estpu-sql")
+    ap.add_argument("--server", default="127.0.0.1:9200")
+    ap.add_argument("-e", "--execute", default=None,
+                    help="run one statement and exit")
+    args = ap.parse_args(argv)
+    from ..client.transport import ClientTransport, TransportError
+    t = ClientTransport([args.server])
+
+    def run(stmt: str) -> int:
+        stmt = stmt.strip().rstrip(";")
+        if not stmt:
+            return 0
+        try:
+            _st, out = t.perform_request(
+                "POST", "/_sql", {"format": "txt"}, {"query": stmt})
+            print(out, end="" if str(out).endswith("\n") else "\n")
+            return 0
+        except TransportError as e:
+            info = e.info
+            reason = info
+            if isinstance(info, dict):
+                reason = (info.get("error") or {}).get("reason", info)
+            print(f"ERROR: {reason}", file=sys.stderr)
+            return 1
+
+    if args.execute is not None:
+        return run(args.execute)
+    if not sys.stdin.isatty():
+        rc = 0
+        for line in sys.stdin:
+            rc |= run(line)
+        return rc
+    print(f"estpu-sql connected to {args.server} "
+          f"(terminate statements with Enter; Ctrl-D to exit)")
+    while True:
+        try:
+            line = input("sql> ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        run(line)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,14 @@
+"""Client library (L15): low-level HTTP transport + typed API surface +
+bulk/scan helpers.
+
+Reference: ``client/rest`` (``RestClient.java`` — load balancing, dead-
+node marking, retries, sniffing hook), ``client/rest-high-level``
+(``RestHighLevelClient.java`` — typed request/response mirror), and
+``client/sniffer``. The typed surface here is namespace objects over one
+``perform_request`` seam rather than 93k LoC of request classes — the
+dict-in/dict-out style is the Pythonic shape of the same API.
+"""
+
+from .transport import ClientTransport, TransportError, ConnectionError  # noqa: F401
+from .api import EsTpuClient  # noqa: F401
+from .helpers import bulk, scan  # noqa: F401

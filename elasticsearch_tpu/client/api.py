@@ -1,0 +1,389 @@
+"""Typed client surface: namespaced methods over the transport.
+
+Reference: ``client/rest-high-level/.../RestHighLevelClient.java`` and
+its per-feature sub-clients (IndicesClient, ClusterClient, …). Methods
+take/return plain dicts — the request classes of the reference collapse
+into keyword arguments, the response classes into the parsed JSON.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .transport import ClientTransport
+
+
+def _esc(name: str) -> str:
+    from urllib.parse import quote
+    return quote(str(name), safe="*,")
+
+
+class _Namespace:
+    def __init__(self, client: "EsTpuClient"):
+        self._c = client
+
+
+class IndicesClient(_Namespace):
+    def create(self, index: str, body: Optional[dict] = None, **params):
+        return self._c._req("PUT", f"/{_esc(index)}", params, body)
+
+    def delete(self, index: str, **params):
+        return self._c._req("DELETE", f"/{_esc(index)}", params)
+
+    def get(self, index: str, **params):
+        return self._c._req("GET", f"/{_esc(index)}", params)
+
+    def exists(self, index: str, **params) -> bool:
+        from .transport import TransportError
+        try:
+            self._c._req("HEAD", f"/{_esc(index)}", params)
+            return True
+        except TransportError as e:
+            if e.status_code == 404:
+                return False
+            raise
+
+    def refresh(self, index: Optional[str] = None, **params):
+        path = f"/{_esc(index)}/_refresh" if index else "/_refresh"
+        return self._c._req("POST", path, params)
+
+    def flush(self, index: Optional[str] = None, **params):
+        path = f"/{_esc(index)}/_flush" if index else "/_flush"
+        return self._c._req("POST", path, params)
+
+    def forcemerge(self, index: str, **params):
+        return self._c._req("POST", f"/{_esc(index)}/_forcemerge",
+                            params)
+
+    def get_mapping(self, index: str, **params):
+        return self._c._req("GET", f"/{_esc(index)}/_mapping", params)
+
+    def put_mapping(self, index: str, body: dict, **params):
+        return self._c._req("PUT", f"/{_esc(index)}/_mapping", params,
+                            body)
+
+    def get_settings(self, index: str, **params):
+        return self._c._req("GET", f"/{_esc(index)}/_settings", params)
+
+    def put_settings(self, index: str, body: dict, **params):
+        return self._c._req("PUT", f"/{_esc(index)}/_settings", params,
+                            body)
+
+    def put_alias(self, index: str, name: str, **params):
+        return self._c._req(
+            "PUT", f"/{_esc(index)}/_alias/{_esc(name)}", params)
+
+    def get_alias(self, index: Optional[str] = None, **params):
+        path = f"/{_esc(index)}/_alias" if index else "/_alias"
+        return self._c._req("GET", path, params)
+
+    def update_aliases(self, body: dict, **params):
+        return self._c._req("POST", "/_aliases", params, body)
+
+    def put_index_template(self, name: str, body: dict, **params):
+        return self._c._req("PUT", f"/_index_template/{_esc(name)}",
+                            params, body)
+
+    def rollover(self, alias: str, body: Optional[dict] = None,
+                 **params):
+        return self._c._req("POST", f"/{_esc(alias)}/_rollover", params,
+                            body)
+
+    def shrink(self, index: str, target: str,
+               body: Optional[dict] = None, **params):
+        return self._c._req(
+            "PUT", f"/{_esc(index)}/_shrink/{_esc(target)}", params,
+            body)
+
+    def split(self, index: str, target: str,
+              body: Optional[dict] = None, **params):
+        return self._c._req(
+            "PUT", f"/{_esc(index)}/_split/{_esc(target)}", params, body)
+
+    def stats(self, index: Optional[str] = None, **params):
+        path = f"/{_esc(index)}/_stats" if index else "/_stats"
+        return self._c._req("GET", path, params)
+
+    def analyze(self, body: dict, index: Optional[str] = None, **params):
+        path = f"/{_esc(index)}/_analyze" if index else "/_analyze"
+        return self._c._req("GET", path, params, body)
+
+    def open(self, index: str, **params):
+        return self._c._req("POST", f"/{_esc(index)}/_open", params)
+
+    def close(self, index: str, **params):
+        return self._c._req("POST", f"/{_esc(index)}/_close", params)
+
+
+class ClusterClient(_Namespace):
+    def health(self, index: Optional[str] = None, **params):
+        path = f"/_cluster/health/{_esc(index)}" if index \
+            else "/_cluster/health"
+        return self._c._req("GET", path, params)
+
+    def state(self, metric: Optional[str] = None, **params):
+        path = f"/_cluster/state/{metric}" if metric \
+            else "/_cluster/state"
+        return self._c._req("GET", path, params)
+
+    def stats(self, **params):
+        return self._c._req("GET", "/_cluster/stats", params)
+
+    def get_settings(self, **params):
+        return self._c._req("GET", "/_cluster/settings", params)
+
+    def put_settings(self, body: dict, **params):
+        return self._c._req("PUT", "/_cluster/settings", params, body)
+
+    def reroute(self, body: Optional[dict] = None, **params):
+        return self._c._req("POST", "/_cluster/reroute", params, body)
+
+    def allocation_explain(self, body: Optional[dict] = None, **params):
+        return self._c._req("GET", "/_cluster/allocation/explain",
+                            params, body)
+
+
+class CatClient(_Namespace):
+    def _cat(self, path: str, **params):
+        params.setdefault("format", "json")
+        return self._c._req("GET", path, params)
+
+    def indices(self, **params):
+        return self._cat("/_cat/indices", **params)
+
+    def shards(self, **params):
+        return self._cat("/_cat/shards", **params)
+
+    def nodes(self, **params):
+        return self._cat("/_cat/nodes", **params)
+
+    def health(self, **params):
+        return self._cat("/_cat/health", **params)
+
+    def count(self, **params):
+        return self._cat("/_cat/count", **params)
+
+    def aliases(self, **params):
+        return self._cat("/_cat/aliases", **params)
+
+    def segments(self, **params):
+        return self._cat("/_cat/segments", **params)
+
+
+class NodesClient(_Namespace):
+    def info(self, **params):
+        return self._c._req("GET", "/_nodes", params)
+
+    def stats(self, **params):
+        return self._c._req("GET", "/_nodes/stats", params)
+
+    def hot_threads(self, **params):
+        return self._c._req("GET", "/_nodes/hot_threads", params)
+
+
+class SnapshotClient(_Namespace):
+    def create_repository(self, repository: str, body: dict, **params):
+        return self._c._req("PUT", f"/_snapshot/{_esc(repository)}",
+                            params, body)
+
+    def create(self, repository: str, snapshot: str,
+               body: Optional[dict] = None, **params):
+        return self._c._req(
+            "PUT", f"/_snapshot/{_esc(repository)}/{_esc(snapshot)}",
+            params, body)
+
+    def get(self, repository: str, snapshot: str, **params):
+        return self._c._req(
+            "GET", f"/_snapshot/{_esc(repository)}/{_esc(snapshot)}",
+            params)
+
+    def restore(self, repository: str, snapshot: str,
+                body: Optional[dict] = None, **params):
+        return self._c._req(
+            "POST",
+            f"/_snapshot/{_esc(repository)}/{_esc(snapshot)}/_restore",
+            params, body)
+
+    def delete(self, repository: str, snapshot: str, **params):
+        return self._c._req(
+            "DELETE", f"/_snapshot/{_esc(repository)}/{_esc(snapshot)}",
+            params)
+
+
+class SqlClient(_Namespace):
+    def query(self, body: dict, **params):
+        return self._c._req("POST", "/_sql", params, body)
+
+    def translate(self, body: dict, **params):
+        return self._c._req("POST", "/_sql/translate", params, body)
+
+    def clear_cursor(self, body: dict, **params):
+        return self._c._req("POST", "/_sql/close", params, body)
+
+
+class EqlClient(_Namespace):
+    def search(self, index: str, body: dict, **params):
+        return self._c._req("POST", f"/{_esc(index)}/_eql/search",
+                            params, body)
+
+
+class TasksClient(_Namespace):
+    def list(self, **params):
+        return self._c._req("GET", "/_tasks", params)
+
+    def get(self, task_id: str, **params):
+        return self._c._req("GET", f"/_tasks/{_esc(task_id)}", params)
+
+    def cancel(self, task_id: str, **params):
+        return self._c._req("POST", f"/_tasks/{_esc(task_id)}/_cancel",
+                            params)
+
+
+class SecurityClient(_Namespace):
+    def create_api_key(self, body: dict, **params):
+        return self._c._req("PUT", "/_security/api_key", params, body)
+
+    def invalidate_api_key(self, body: dict, **params):
+        return self._c._req("DELETE", "/_security/api_key", params, body)
+
+    def authenticate(self, **params):
+        return self._c._req("GET", "/_security/_authenticate", params)
+
+
+class EsTpuClient:
+    """The entry point: ``EsTpuClient(["localhost:9200"])``."""
+
+    def __init__(self, hosts: List[str], timeout: float = 30.0,
+                 max_retries: int = 3, api_key: Optional[str] = None,
+                 sniff_on_start: bool = False):
+        headers = {}
+        if api_key:
+            headers["Authorization"] = f"ApiKey {api_key}"
+        self.transport = ClientTransport(hosts, timeout=timeout,
+                                         max_retries=max_retries,
+                                         headers=headers)
+        if sniff_on_start:
+            self.transport.sniff()
+        self.indices = IndicesClient(self)
+        self.cluster = ClusterClient(self)
+        self.cat = CatClient(self)
+        self.nodes = NodesClient(self)
+        self.snapshot = SnapshotClient(self)
+        self.sql = SqlClient(self)
+        self.eql = EqlClient(self)
+        self.tasks = TasksClient(self)
+        self.security = SecurityClient(self)
+
+    def _req(self, method: str, path: str,
+             params: Optional[dict] = None, body: Any = None) -> Any:
+        _status, parsed = self.transport.perform_request(
+            method, path, params, body)
+        return parsed
+
+    # -- document + search core ----------------------------------------
+    def info(self, **params):
+        return self._req("GET", "/", params)
+
+    def ping(self) -> bool:
+        from .transport import TransportError
+        try:
+            self._req("GET", "/")
+            return True
+        except TransportError:
+            return False
+
+    def index(self, index: str, body: dict, id: Optional[str] = None,
+              **params):
+        if id is None:
+            return self._req("POST", f"/{_esc(index)}/_doc", params,
+                             body)
+        return self._req("PUT", f"/{_esc(index)}/_doc/{_esc(id)}",
+                         params, body)
+
+    def create(self, index: str, id: str, body: dict, **params):
+        return self._req("PUT", f"/{_esc(index)}/_create/{_esc(id)}",
+                         params, body)
+
+    def get(self, index: str, id: str, **params):
+        return self._req("GET", f"/{_esc(index)}/_doc/{_esc(id)}",
+                         params)
+
+    def get_source(self, index: str, id: str, **params):
+        return self._req("GET", f"/{_esc(index)}/_source/{_esc(id)}",
+                         params)
+
+    def exists(self, index: str, id: str, **params) -> bool:
+        from .transport import TransportError
+        try:
+            self._req("HEAD", f"/{_esc(index)}/_doc/{_esc(id)}", params)
+            return True
+        except TransportError as e:
+            if e.status_code == 404:
+                return False
+            raise
+
+    def delete(self, index: str, id: str, **params):
+        return self._req("DELETE", f"/{_esc(index)}/_doc/{_esc(id)}",
+                         params)
+
+    def update(self, index: str, id: str, body: dict, **params):
+        return self._req("POST", f"/{_esc(index)}/_update/{_esc(id)}",
+                         params, body)
+
+    def mget(self, body: dict, index: Optional[str] = None, **params):
+        path = f"/{_esc(index)}/_mget" if index else "/_mget"
+        return self._req("POST", path, params, body)
+
+    def bulk(self, body, index: Optional[str] = None, **params):
+        """``body`` is NDJSON text or a list of action/source dicts."""
+        if isinstance(body, list):
+            import json as _json
+            body = "".join(_json.dumps(x) + "\n" for x in body)
+        path = f"/{_esc(index)}/_bulk" if index else "/_bulk"
+        return self._req("POST", path, params, body)
+
+    def search(self, index: Optional[str] = None,
+               body: Optional[dict] = None, **params):
+        path = f"/{_esc(index)}/_search" if index else "/_search"
+        return self._req("POST", path, params, body or {})
+
+    def msearch(self, body, index: Optional[str] = None, **params):
+        if isinstance(body, list):
+            import json as _json
+            body = "".join(_json.dumps(x) + "\n" for x in body)
+        path = f"/{_esc(index)}/_msearch" if index else "/_msearch"
+        return self._req("POST", path, params, body)
+
+    def count(self, index: Optional[str] = None,
+              body: Optional[dict] = None, **params):
+        path = f"/{_esc(index)}/_count" if index else "/_count"
+        return self._req("POST", path, params, body)
+
+    def scroll(self, scroll_id: str, scroll: str = "1m", **params):
+        return self._req("POST", "/_search/scroll", params,
+                         {"scroll_id": scroll_id, "scroll": scroll})
+
+    def clear_scroll(self, scroll_id: str, **params):
+        return self._req("DELETE", "/_search/scroll", params,
+                         {"scroll_id": [scroll_id]})
+
+    def delete_by_query(self, index: str, body: dict, **params):
+        return self._req("POST", f"/{_esc(index)}/_delete_by_query",
+                         params, body)
+
+    def update_by_query(self, index: str,
+                        body: Optional[dict] = None, **params):
+        return self._req("POST", f"/{_esc(index)}/_update_by_query",
+                         params, body)
+
+    def reindex(self, body: dict, **params):
+        return self._req("POST", "/_reindex", params, body)
+
+    def explain(self, index: str, id: str, body: dict, **params):
+        return self._req("POST", f"/{_esc(index)}/_explain/{_esc(id)}",
+                         params, body)
+
+    def field_caps(self, index: Optional[str] = None,
+                   fields: str = "*", **params):
+        params = dict(params, fields=fields)
+        path = f"/{_esc(index)}/_field_caps" if index else "/_field_caps"
+        return self._req("GET", path, params)

@@ -1,0 +1,91 @@
+"""Client helpers: chunked bulk + scroll-driven scan.
+
+Reference: ``client/rest-high-level`` ``BulkProcessor`` (chunking/flush)
+and the high-level client's scroll helper idiom.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, Optional, Tuple
+
+
+def bulk(client, actions: Iterable[dict], index: Optional[str] = None,
+         chunk_size: int = 500, refresh: bool = False,
+         raise_on_error: bool = True) -> Tuple[int, list]:
+    """Index an iterable of actions in chunks.
+
+    An action is either a full ``{"_op_type", "_index", "_id", ...doc}``
+    dict (op type defaults to ``index``) or a bare source dict when
+    ``index`` is given. Returns ``(successes, errors)``.
+    """
+    import json as _json
+    ok = 0
+    errors: list = []
+    buf: list = []
+
+    def flush():
+        nonlocal ok
+        if not buf:
+            return
+        payload = "".join(_json.dumps(x) + "\n" for x in buf)
+        params = {"refresh": "true"} if refresh else {}
+        resp = client._req("POST",
+                           f"/{index}/_bulk" if index else "/_bulk",
+                           params, payload)
+        for item in resp.get("items", []):
+            (_op, detail), = item.items()
+            if detail.get("error"):
+                errors.append(item)
+            else:
+                ok += 1
+        buf.clear()
+
+    pending_items = 0
+    for action in actions:
+        a = dict(action)
+        op = a.pop("_op_type", "index")
+        meta: Dict[str, Any] = {}
+        for k in ("_index", "_id", "_routing", "routing"):
+            if k in a:
+                meta[k if k.startswith("_") else "_" + k] = a.pop(k)
+        if index and "_index" not in meta:
+            meta["_index"] = index
+        buf.append({op: meta})
+        if op != "delete":
+            buf.append(a.get("_source", a))
+        pending_items += 1
+        if pending_items >= chunk_size:
+            flush()
+            pending_items = 0
+    flush()
+    if errors and raise_on_error:
+        raise RuntimeError(f"{len(errors)} document(s) failed to index: "
+                           f"{errors[:3]}")
+    return ok, errors
+
+
+def scan(client, index: Optional[str] = None,
+         query: Optional[dict] = None, scroll: str = "5m",
+         size: int = 1000, clear_scroll: bool = True) -> Iterator[dict]:
+    """Iterate every hit of a query via scroll."""
+    body = dict(query or {"query": {"match_all": {}}})
+    body["size"] = size
+    resp = client.search(index=index, body=body,
+                         scroll=scroll)
+    sid = resp.get("_scroll_id")
+    try:
+        while True:
+            hits = resp["hits"]["hits"]
+            if not hits:
+                return
+            for h in hits:
+                yield h
+            if sid is None:
+                return
+            resp = client.scroll(sid, scroll=scroll)
+            sid = resp.get("_scroll_id", sid)
+    finally:
+        if sid and clear_scroll:
+            try:
+                client.clear_scroll(sid)
+            except Exception:   # noqa: BLE001 — best-effort cleanup
+                pass

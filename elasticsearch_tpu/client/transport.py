@@ -1,0 +1,170 @@
+"""Low-level client transport: load balancing, dead-node marking,
+retries, sniffing.
+
+Reference: ``client/rest/.../RestClient.java`` — round-robin over
+configured hosts, failed hosts quarantined with exponentially growing
+dead-times and revived after timeout (or when all are dead), retries on
+connection errors against the next host; ``client/sniffer/
+ElasticsearchNodesSniffer.java`` refreshes the host list from
+``GET /_nodes``.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class TransportError(Exception):
+    """Non-2xx response with the parsed error body attached."""
+
+    def __init__(self, status: int, info: Any):
+        self.status_code = status
+        self.info = info
+        reason = info
+        if isinstance(info, dict):
+            err = info.get("error")
+            if isinstance(err, dict):
+                reason = err.get("reason", err.get("type"))
+            elif err is not None:
+                reason = err
+        super().__init__(f"TransportError({status}, {reason!r})")
+
+
+class ConnectionError(TransportError):           # noqa: A001
+    def __init__(self, info: Any):
+        Exception.__init__(self, f"ConnectionError: {info}")
+        self.status_code = None
+        self.info = info
+
+
+class _Host:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self.failed_attempts = 0
+        self.dead_until = 0.0
+
+    def mark_dead(self) -> None:
+        self.failed_attempts += 1
+        # 1min base doubling per failure, capped at 30min (RestClient's
+        # DEFAULT_DEAD_TIMEOUT schedule)
+        timeout = min(60.0 * (2 ** (self.failed_attempts - 1)), 1800.0)
+        self.dead_until = time.monotonic() + timeout
+
+    def mark_alive(self) -> None:
+        self.failed_attempts = 0
+        self.dead_until = 0.0
+
+    @property
+    def alive(self) -> bool:
+        return time.monotonic() >= self.dead_until
+
+    def __repr__(self):
+        return f"{self.host}:{self.port}"
+
+
+class ClientTransport:
+    def __init__(self, hosts: List[str], timeout: float = 30.0,
+                 max_retries: int = 3,
+                 headers: Optional[Dict[str, str]] = None):
+        self._hosts: List[_Host] = []
+        for h in hosts:
+            if "://" in h:
+                h = h.split("://", 1)[1]
+            name, _, port = h.partition(":")
+            self._hosts.append(_Host(name, int(port or 9200)))
+        if not self._hosts:
+            raise ValueError("at least one host is required")
+        self.timeout = timeout
+        self.max_retries = max_retries
+        self.headers = dict(headers or {})
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    # -- host selection -------------------------------------------------
+    def _next_host(self) -> _Host:
+        with self._lock:
+            n = len(self._hosts)
+            for _ in range(n):
+                h = self._hosts[self._rr % n]
+                self._rr += 1
+                if h.alive:
+                    return h
+            # all dead: revive the least-recently-failed (RestClient
+            # retries the host that has been dead the longest)
+            return min(self._hosts, key=lambda x: x.dead_until)
+
+    def sniff(self) -> None:
+        """Refresh hosts from GET /_nodes (ElasticsearchNodesSniffer)."""
+        status, body = self.perform_request("GET", "/_nodes")
+        found: List[_Host] = []
+        for node in (body.get("nodes") or {}).values():
+            addr = (node.get("http") or {}).get("publish_address") \
+                or node.get("transport_address")
+            if not addr:
+                continue
+            host, _, port = str(addr).rpartition(":")
+            try:
+                found.append(_Host(host or "127.0.0.1", int(port)))
+            except ValueError:
+                continue
+        if found:
+            with self._lock:
+                self._hosts = found
+                self._rr = 0
+
+    # -- request path ---------------------------------------------------
+    def perform_request(self, method: str, path: str,
+                        params: Optional[dict] = None,
+                        body: Any = None,
+                        headers: Optional[dict] = None
+                        ) -> Tuple[int, Any]:
+        query = ""
+        if params:
+            from urllib.parse import urlencode
+            query = "?" + urlencode(
+                {k: (str(v).lower() if isinstance(v, bool) else v)
+                 for k, v in params.items() if v is not None})
+        if isinstance(body, (dict, list)):
+            payload: Optional[bytes] = json.dumps(body).encode()
+            ctype = "application/json"
+        elif isinstance(body, str):
+            payload = body.encode()
+            ctype = "application/x-ndjson"
+        else:
+            payload = body
+            ctype = "application/json"
+        last_err: Optional[Exception] = None
+        for _ in range(self.max_retries + 1):
+            host = self._next_host()
+            try:
+                conn = http.client.HTTPConnection(
+                    host.host, host.port, timeout=self.timeout)
+                try:
+                    send_headers = {"Content-Type": ctype,
+                                    **self.headers, **(headers or {})}
+                    conn.request(method, path + query, payload,
+                                 send_headers)
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                finally:
+                    conn.close()
+            except (OSError, socket.timeout,
+                    http.client.HTTPException) as e:
+                host.mark_dead()
+                last_err = e
+                continue
+            host.mark_alive()
+            ct = resp.getheader("content-type", "")
+            if ct.startswith("application/json"):
+                parsed: Any = json.loads(raw) if raw else None
+            else:
+                parsed = raw.decode(errors="replace")
+            if resp.status >= 400:
+                raise TransportError(resp.status, parsed)
+            return resp.status, parsed
+        raise ConnectionError(last_err)

@@ -22,11 +22,21 @@ skeleton, built against the deterministic sim transport (``sim.py``):
   voting quorum; followers start elections when the leader goes quiet
   (LeaderChecker direction) with seeded random jitter breaking ties.
 
-Omitted vs the reference (documented, not silently): pre-vote
-(``PreVoteCollector.java`` — a rejoining node may force one spurious
-re-election on heal), voting-config reconfiguration
-(``Reconfigurator.java`` — the voting config is the initial node set), and
-diff-based state transfer.
+- **Pre-vote.** Before bumping its term a would-be candidate polls peers
+  (``PreVoteCollector.java``): a peer grants only when it has not heard
+  from a live leader recently and the requester's accepted state is at
+  least as fresh as its own. A rejoining node therefore cannot force a
+  spurious re-election on heal.
+- **Voting-config reconfiguration.** The voting configuration travels in
+  cluster state; ``set_voting_config`` publishes a new one, and commits
+  require an accept quorum in BOTH the last-committed and the newly-
+  accepted configuration (``CoordinationState``'s joint check backing
+  ``Reconfigurator.java``), so no two configs can commit disjoint chains.
+- **Diff publication.** The leader tracks each peer's acked version and
+  ships a two-level state delta (``statediff.py``) when the peer is
+  exactly one version behind; any mismatch answers ``need_full`` and the
+  leader resends the full state (``PublicationTransportHandler``'s
+  fallback).
 
 Safety invariants are asserted in the sim tests
 (``tests/test_coordination.py``): unique leader per term, committed
@@ -55,6 +65,9 @@ class PersistedState:
         self.accepted_term = 0          # term in which accepted was written
         self.accepted = initial         # last accepted (maybe uncommitted)
         self.committed_version = 0
+        #: last COMMITTED voting config — reconfigurations must reach a
+        #: quorum here too before the new config takes over
+        self.committed_config = list(initial.voting_config)
 
 
 class Coordinator:
@@ -83,11 +96,18 @@ class Coordinator:
         self._election_task = None
         self._heartbeat_task = None
         self._active_publication: Optional[dict] = None
+        #: leader-side: peer -> (accepted_term, accepted_version) last
+        #: acked, the basis for diff publication
+        self._peer_accepted: Dict[str, tuple] = {}
+        #: telemetry: how publications went out (sim tests assert diffs
+        #: actually ride the wire)
+        self.pub_stats = {"full": 0, "diff": 0, "diff_refused": 0}
         self._pending_tasks: List[Callable[[ClusterState], ClusterState]] = []
         self._task_listeners: List[Callable] = []
         self.stopped = False
 
         t = transport
+        t.register(node_id, "pre_vote", self._handle_pre_vote)
         t.register(node_id, "start_join", self._handle_start_join)
         t.register(node_id, "join", self._handle_join)
         t.register(node_id, "publish", self._handle_publish)
@@ -110,6 +130,15 @@ class Coordinator:
 
     def _quorum(self, votes: Set[str]) -> bool:
         return self.persisted.accepted.quorum(votes)
+
+    def _commit_quorum(self, votes: Set[str]) -> bool:
+        """Accept quorum in BOTH the newly-accepted config and the last
+        committed one — the joint condition that makes reconfiguration
+        safe (CoordinationState.isPublishQuorum)."""
+        if not self.persisted.accepted.quorum(votes):
+            return False
+        cc = self.persisted.committed_config
+        return len(set(cc) & votes) * 2 > len(cc)
 
     def stop(self) -> None:
         """Simulated crash: stop timers and drop all volatile state."""
@@ -158,8 +187,63 @@ class Coordinator:
         if self.mode == FOLLOWER and quiet < self.LEADER_TIMEOUT:
             self._schedule_election()
             return
-        self._start_election()
+        self._run_pre_vote()
         self._schedule_election()
+
+    def _run_pre_vote(self) -> None:
+        """PreVoteCollector: poll peers without touching any term state;
+        proceed to a real election only on a quorum of grants."""
+        round_ = {"grants": {self.node_id}, "done": False}
+        ours = (self.persisted.accepted_term,
+                self.persisted.accepted.version)
+
+        def on_grant(peer, resp):
+            if round_["done"] or self.stopped or self.mode == LEADER:
+                return
+            # a leader emerged while grants were in flight: stand down
+            if self.mode == FOLLOWER and \
+                    self.queue.now - self._last_leader_msg < \
+                    self.LEADER_TIMEOUT:
+                round_["done"] = True
+                return
+            if resp.get("term", 0) > self.term:
+                self._set_term(resp["term"])
+            if not resp.get("granted"):
+                return
+            round_["grants"].add(peer)
+            if self._quorum(round_["grants"]):
+                round_["done"] = True
+                self._start_election()
+
+        for peer in self._peers():
+            self.transport.send(
+                self.node_id, peer, "pre_vote",
+                {"source": self.node_id, "term": self.term,
+                 "accepted_term": ours[0], "accepted_version": ours[1]},
+                on_response=lambda r, n=peer: on_grant(n, r),
+                on_failure=lambda e: None,
+                timeout=self.RPC_TIMEOUT)
+        if self._quorum(round_["grants"]):
+            round_["done"] = True
+            self._start_election()
+
+    def _handle_pre_vote(self, src: str, payload: dict) -> dict:
+        if self.stopped:
+            raise ConnectionError("node stopped")
+        # state-free: granting a pre-vote changes nothing locally. A
+        # LEADER refuses; a FOLLOWER with a recently-live leader refuses;
+        # a CANDIDATE (no leader at all — bootstrap, post-partition)
+        # always grants, else bootstrap would deadlock on quiet-periods
+        quiet = self.queue.now - self._last_leader_msg
+        if self.mode == LEADER or (self.mode == FOLLOWER
+                                   and quiet < self.LEADER_TIMEOUT):
+            return {"granted": False, "term": self.term}
+        theirs = (payload["accepted_term"], payload["accepted_version"])
+        ours = (self.persisted.accepted_term,
+                self.persisted.accepted.version)
+        if theirs < ours:
+            return {"granted": False, "term": self.term}
+        return {"granted": True, "term": self.term}
 
     def _start_election(self) -> None:
         self.mode = CANDIDATE
@@ -285,6 +369,21 @@ class Coordinator:
         if self._active_publication is None:
             self._publish_pending()
 
+    def set_voting_config(self, voting_nodes: List[str],
+                          listener: Optional[Callable] = None) -> None:
+        """Reconfiguration (Reconfigurator.java): publish a state whose
+        voting_config is the given master-eligible set. Safe because the
+        commit needs a quorum in both old and new configs."""
+        nodes = self.persisted.accepted.nodes
+        unknown = [n for n in voting_nodes if n not in nodes]
+        if unknown:
+            raise ValueError(f"unknown voting nodes {unknown}")
+        if not voting_nodes:
+            raise ValueError("voting config cannot be empty")
+        self.submit_state_update(
+            lambda s: s.updated(voting_config=list(voting_nodes)),
+            listener)
+
     def _publish_pending(self) -> None:
         if self.mode != LEADER or not self._pending_tasks:
             return
@@ -307,16 +406,30 @@ class Coordinator:
         self._active_publication = pub
 
         # accept locally first (the leader is a voter)
+        prev_data = self.persisted.accepted.copy_data()
+        prev_key = (self.persisted.accepted_term,
+                    self.persisted.accepted.version)
         self._accept_publication(state)
         self._on_publish_ack(pub, self.node_id)
+        from .statediff import compute_diff
+        diff = compute_diff(prev_data, state.data)
         for peer in self._peers():
+            if self._peer_accepted.get(peer) == prev_key:
+                # the peer acked exactly the base state: ship the delta
+                self.pub_stats["diff"] += 1
+                msg = {"term": state.term, "version": state.version,
+                       "diff": diff, "base_term": prev_key[0],
+                       "base_version": prev_key[1],
+                       "source": self.node_id}
+            else:
+                self.pub_stats["full"] += 1
+                msg = {"term": state.term, "version": state.version,
+                       "state": state.copy_data(),
+                       "source": self.node_id}
             self.transport.send(
-                self.node_id, peer, "publish",
-                {"term": state.term, "version": state.version,
-                 "state": state.copy_data(), "source": self.node_id},
+                self.node_id, peer, "publish", msg,
                 on_response=lambda r, p=pub, n=peer: (
-                    self._on_publish_ack(p, n) if r.get("accepted") else
-                    None),
+                    self._on_publish_response(p, n, r)),
                 on_failure=lambda e: None,
                 timeout=self.RPC_TIMEOUT)
         self.queue.schedule(self.PUBLISH_TIMEOUT,
@@ -331,12 +444,33 @@ class Coordinator:
             if self.mode == LEADER:
                 self._become_candidate()
 
+    def _on_publish_response(self, pub: dict, node: str,
+                             resp: dict) -> None:
+        if resp.get("accepted"):
+            self._peer_accepted[node] = (pub["term"], pub["version"])
+            self._on_publish_ack(pub, node)
+        elif resp.get("need_full") and pub is self._active_publication \
+                and not pub["done"]:
+            # diff base mismatch: fall back to the full state
+            # (PublicationTransportHandler's incompatible-version path)
+            self.pub_stats["diff_refused"] += 1
+            self.pub_stats["full"] += 1
+            self.transport.send(
+                self.node_id, node, "publish",
+                {"term": pub["term"], "version": pub["version"],
+                 "state": pub["state"].copy_data(),
+                 "source": self.node_id},
+                on_response=lambda r, p=pub, n=node: (
+                    self._on_publish_response(p, n, r)),
+                on_failure=lambda e: None,
+                timeout=self.RPC_TIMEOUT)
+
     def _on_publish_ack(self, pub: dict, node: str) -> None:
         if pub["done"] or pub is not self._active_publication:
             return
         pub["acks"].add(node)
         if not pub["committed"] and \
-                self.persisted.accepted.quorum(pub["acks"]):
+                self._commit_quorum(pub["acks"]):
             pub["committed"] = True
             self._commit_locally(pub["term"], pub["version"])
             for peer in self._peers():
@@ -374,7 +508,17 @@ class Coordinator:
         if (term, version) < (self.persisted.accepted_term,
                               self.persisted.accepted.version):
             return {"accepted": False, "reason": "stale version"}
-        self._accept_publication(ClusterState(payload["state"]))
+        if "diff" in payload:
+            base = (payload["base_term"], payload["base_version"])
+            if base != (self.persisted.accepted_term,
+                        self.persisted.accepted.version):
+                return {"accepted": False, "need_full": True}
+            from .statediff import apply_diff
+            new_data = apply_diff(self.persisted.accepted.data,
+                                  payload["diff"])
+            self._accept_publication(ClusterState(new_data))
+        else:
+            self._accept_publication(ClusterState(payload["state"]))
         return {"accepted": True}
 
     def _accept_publication(self, state: ClusterState) -> None:
@@ -396,6 +540,8 @@ class Coordinator:
         if version <= self.persisted.committed_version:
             return
         self.persisted.committed_version = version
+        self.persisted.committed_config = list(
+            self.persisted.accepted.voting_config)
         self.applied = self.persisted.accepted
         if self.on_commit_cb:
             self.on_commit_cb(self.applied)

@@ -1,0 +1,55 @@
+"""Cluster-state diffs for incremental publication.
+
+Reference: ``cluster/Diff.java`` + ``cluster/DiffableUtils.java`` — the
+leader serializes per-component diffs keyed on the receiver's last-known
+version; any mismatch falls back to a full-state send
+(``PublicationTransportHandler``'s IncompatibleClusterStateVersionException
+path). Here the diff is a two-level dict delta over the JSON state: top-
+level scalar keys replace wholesale, top-level dict keys (nodes, metadata,
+routing) patch per sub-key with explicit removals — the same shape
+DiffableUtils produces for its keyed maps.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+
+def compute_diff(old: Dict[str, Any], new: Dict[str, Any]) -> dict:
+    """Delta such that ``apply_diff(old, d) == new``."""
+    out: dict = {"set": {}, "patch": {}, "del": []}
+    for k, nv in new.items():
+        ov = old.get(k, _MISSING)
+        if isinstance(nv, dict) and isinstance(ov, dict):
+            sets = {sk: sv for sk, sv in nv.items()
+                    if sk not in ov or ov[sk] != sv}
+            dels = [sk for sk in ov if sk not in nv]
+            if sets or dels:
+                out["patch"][k] = {"set": sets, "del": dels}
+        elif ov is _MISSING or ov != nv:
+            out["set"][k] = nv
+    out["del"] = [k for k in old if k not in new]
+    return out
+
+
+def apply_diff(old: Dict[str, Any], diff: dict) -> Dict[str, Any]:
+    new = copy.deepcopy(old)
+    for k in diff.get("del", []):
+        new.pop(k, None)
+    for k, v in diff.get("set", {}).items():
+        new[k] = copy.deepcopy(v)
+    for k, patch in diff.get("patch", {}).items():
+        tgt = dict(new.get(k) or {})
+        for sk in patch.get("del", []):
+            tgt.pop(sk, None)
+        for sk, sv in patch.get("set", {}).items():
+            tgt[sk] = copy.deepcopy(sv)
+        new[k] = tgt
+    return new
+
+
+class _Missing:
+    pass
+
+
+_MISSING = _Missing()

@@ -1,0 +1,135 @@
+"""Secure-settings keystore: encrypted at rest, loaded into node settings.
+
+Reference: ``server/.../common/settings/KeyStoreWrapper.java:83`` — an
+optionally password-protected container for secure settings (repository
+credentials, TLS passphrases, remote-cluster secrets) stored beside the
+config, plus the ``elasticsearch-keystore`` CLI
+(``distribution/tools/keystore-cli/``).
+
+Format (versioned, all big-endian):
+  magic ``ESTPUKS1`` | salt(16) | nonce(16) | ciphertext | hmac-tag(32)
+
+Crypto is stdlib-only by necessity (no ``cryptography`` wheel in the
+image): PBKDF2-HMAC-SHA256 key derivation, then an HMAC-SHA256 counter
+keystream (CTR construction over a PRF) for confidentiality and an
+encrypt-then-MAC tag over header+ciphertext for integrity. An empty
+password (the reference's default since 7.x) still encrypts — obfuscation
+at rest, real protection when a password is set.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import struct
+from typing import Dict, Optional
+
+from .errors import ElasticsearchError, IllegalArgumentError
+
+MAGIC = b"ESTPUKS1"
+PBKDF2_ITERS = 120_000
+
+
+class KeystoreError(ElasticsearchError):
+    status = 500
+    error_type = "security_exception"
+
+
+def _derive(password: str, salt: bytes) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", password.encode(), salt,
+                               PBKDF2_ITERS, dklen=64)
+
+
+def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
+    out = bytearray()
+    counter = 0
+    while len(out) < n:
+        block = hmac.new(key, nonce + struct.pack(">Q", counter),
+                         hashlib.sha256).digest()
+        out.extend(block)
+        counter += 1
+    return bytes(out[:n])
+
+
+class Keystore:
+    """In-memory view of the secure settings + load/save."""
+
+    FILENAME = "estpu.keystore"
+
+    def __init__(self, path: str, password: str = ""):
+        self.path = path
+        self.password = password
+        self.entries: Dict[str, str] = {}
+
+    # -- persistence ----------------------------------------------------
+    def save(self) -> None:
+        salt = os.urandom(16)
+        nonce = os.urandom(16)
+        keys = _derive(self.password, salt)
+        enc_key, mac_key = keys[:32], keys[32:]
+        plain = json.dumps(self.entries, sort_keys=True).encode()
+        cipher = bytes(a ^ b for a, b in
+                       zip(plain, _keystream(enc_key, nonce, len(plain))))
+        header = MAGIC + salt + nonce
+        tag = hmac.new(mac_key, header + cipher, hashlib.sha256).digest()
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "wb") as fh:
+            fh.write(header + cipher + tag)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+
+    @classmethod
+    def load(cls, path: str, password: str = "") -> "Keystore":
+        ks = cls(path, password)
+        with open(path, "rb") as fh:
+            blob = fh.read()
+        if len(blob) < len(MAGIC) + 16 + 16 + 32 or \
+                not blob.startswith(MAGIC):
+            raise KeystoreError(f"[{path}] is not a keystore file")
+        salt = blob[len(MAGIC): len(MAGIC) + 16]
+        nonce = blob[len(MAGIC) + 16: len(MAGIC) + 32]
+        cipher = blob[len(MAGIC) + 32: -32]
+        tag = blob[-32:]
+        keys = _derive(password, salt)
+        enc_key, mac_key = keys[:32], keys[32:]
+        header = MAGIC + salt + nonce
+        expect = hmac.new(mac_key, header + cipher,
+                          hashlib.sha256).digest()
+        if not hmac.compare_digest(tag, expect):
+            raise KeystoreError(
+                "Provided keystore password was incorrect")
+        plain = bytes(a ^ b for a, b in
+                      zip(cipher, _keystream(enc_key, nonce,
+                                             len(cipher))))
+        ks.entries = json.loads(plain.decode())
+        return ks
+
+    @classmethod
+    def load_or_create(cls, path: str,
+                       password: str = "") -> "Keystore":
+        if os.path.exists(path):
+            return cls.load(path, password)
+        return cls(path, password)
+
+    # -- entry API ------------------------------------------------------
+    def set(self, key: str, value: str) -> None:
+        if not key or key != key.lower():
+            raise IllegalArgumentError(
+                f"Setting name [{key}] does not match the allowed "
+                f"setting name pattern [[a-z0-9_\\-.]+]")
+        self.entries[key] = value
+
+    def get(self, key: str) -> Optional[str]:
+        return self.entries.get(key)
+
+    def remove(self, key: str) -> None:
+        if key not in self.entries:
+            raise IllegalArgumentError(
+                f"Setting [{key}] does not exist in the keystore.")
+        del self.entries[key]
+
+    def list_keys(self):
+        return sorted(self.entries)

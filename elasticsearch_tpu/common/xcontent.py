@@ -1,0 +1,245 @@
+"""x-content: pluggable content formats on the REST boundary.
+
+Reference: ``libs/x-content`` (``XContentType.java``: JSON, SMILE, YAML,
+CBOR — negotiated from Content-Type/Accept). Here JSON is the native
+in-process form; YAML rides the bundled pyyaml and CBOR is a self-
+contained RFC 8949 codec below (no cbor wheel in the image). SMILE has no
+stdlib-feasible codec and is rejected with the same error shape an
+unknown content type gets from the reference's ``RestController``.
+
+The REST layer calls :func:`decode_request` to normalize an incoming body
+to the parsed-JSON-equivalent bytes and :func:`encode_response` to render
+the response in the Accept'ed format.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Optional, Tuple
+
+from .errors import ElasticsearchError
+
+
+class UnsupportedContentType(ElasticsearchError):
+    status = 406
+    error_type = "status_exception"
+
+
+# ---------------------------------------------------------------------------
+# CBOR (RFC 8949 subset: the JSON-representable data model)
+# ---------------------------------------------------------------------------
+
+def cbor_encode(obj: Any) -> bytes:
+    out = bytearray()
+    _cb_enc(obj, out)
+    return bytes(out)
+
+
+def _cb_head(major: int, n: int, out: bytearray) -> None:
+    if n < 24:
+        out.append((major << 5) | n)
+    elif n < 0x100:
+        out.append((major << 5) | 24)
+        out.append(n)
+    elif n < 0x10000:
+        out.append((major << 5) | 25)
+        out.extend(struct.pack(">H", n))
+    elif n < 0x100000000:
+        out.append((major << 5) | 26)
+        out.extend(struct.pack(">I", n))
+    else:
+        out.append((major << 5) | 27)
+        out.extend(struct.pack(">Q", n))
+
+
+def _cb_enc(obj: Any, out: bytearray) -> None:
+    if obj is None:
+        out.append(0xF6)
+    elif obj is True:
+        out.append(0xF5)
+    elif obj is False:
+        out.append(0xF4)
+    elif isinstance(obj, int):
+        if obj >= 0:
+            _cb_head(0, obj, out)
+        else:
+            _cb_head(1, -1 - obj, out)
+    elif isinstance(obj, float):
+        out.append(0xFB)
+        out.extend(struct.pack(">d", obj))
+    elif isinstance(obj, bytes):
+        _cb_head(2, len(obj), out)
+        out.extend(obj)
+    elif isinstance(obj, str):
+        bs = obj.encode("utf-8")
+        _cb_head(3, len(bs), out)
+        out.extend(bs)
+    elif isinstance(obj, (list, tuple)):
+        _cb_head(4, len(obj), out)
+        for item in obj:
+            _cb_enc(item, out)
+    elif isinstance(obj, dict):
+        _cb_head(5, len(obj), out)
+        for k, v in obj.items():
+            _cb_enc(str(k), out)
+            _cb_enc(v, out)
+    else:
+        raise ElasticsearchError(
+            f"cannot CBOR-encode type [{type(obj).__name__}]")
+
+
+class _CborReader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.i = 0
+
+    def byte(self) -> int:
+        b = self.data[self.i]
+        self.i += 1
+        return b
+
+    def take(self, n: int) -> bytes:
+        chunk = self.data[self.i: self.i + n]
+        if len(chunk) != n:
+            raise ElasticsearchError("truncated CBOR input")
+        self.i += n
+        return chunk
+
+    def length(self, info: int) -> Optional[int]:
+        if info < 24:
+            return info
+        if info == 24:
+            return self.byte()
+        if info == 25:
+            return struct.unpack(">H", self.take(2))[0]
+        if info == 26:
+            return struct.unpack(">I", self.take(4))[0]
+        if info == 27:
+            return struct.unpack(">Q", self.take(8))[0]
+        if info == 31:
+            return None                  # indefinite
+        raise ElasticsearchError("malformed CBOR length")
+
+    def decode(self) -> Any:
+        ib = self.byte()
+        major, info = ib >> 5, ib & 0x1F
+        if major == 0:
+            return self.length(info)
+        if major == 1:
+            return -1 - self.length(info)
+        if major == 2 or major == 3:
+            n = self.length(info)
+            if n is None:                # indefinite string: concat chunks
+                parts = []
+                while self.data[self.i] != 0xFF:
+                    parts.append(self.decode())
+                self.i += 1
+                if major == 3:
+                    return "".join(parts)
+                return b"".join(parts)
+            raw = self.take(n)
+            return raw.decode("utf-8") if major == 3 else raw
+        if major == 4:
+            n = self.length(info)
+            items = []
+            if n is None:
+                while self.data[self.i] != 0xFF:
+                    items.append(self.decode())
+                self.i += 1
+            else:
+                for _ in range(n):
+                    items.append(self.decode())
+            return items
+        if major == 5:
+            n = self.length(info)
+            obj = {}
+            if n is None:
+                while self.data[self.i] != 0xFF:
+                    k = self.decode()
+                    obj[k] = self.decode()
+                self.i += 1
+            else:
+                for _ in range(n):
+                    k = self.decode()
+                    obj[k] = self.decode()
+            return obj
+        if major == 7:
+            if info == 20:
+                return False
+            if info == 21:
+                return True
+            if info == 22 or info == 23:
+                return None
+            if info == 25:               # half float
+                h = struct.unpack(">H", self.take(2))[0]
+                return _half_to_float(h)
+            if info == 26:
+                return struct.unpack(">f", self.take(4))[0]
+            if info == 27:
+                return struct.unpack(">d", self.take(8))[0]
+        raise ElasticsearchError(f"unsupported CBOR item [{ib:#x}]")
+
+
+def _half_to_float(h: int) -> float:
+    sign = -1.0 if h & 0x8000 else 1.0
+    exp = (h >> 10) & 0x1F
+    frac = h & 0x3FF
+    if exp == 0:
+        return sign * frac * 2.0 ** -24
+    if exp == 31:
+        return sign * (float("inf") if frac == 0 else float("nan"))
+    return sign * (1 + frac / 1024.0) * 2.0 ** (exp - 15)
+
+
+def cbor_decode(data: bytes) -> Any:
+    return _CborReader(data).decode()
+
+
+# ---------------------------------------------------------------------------
+# negotiation
+# ---------------------------------------------------------------------------
+
+def _base_type(ct: Optional[str]) -> str:
+    if not ct:
+        return "application/json"
+    return ct.split(";")[0].strip().lower()
+
+
+def decode_request(body: bytes, content_type: Optional[str]) -> bytes:
+    """Incoming body → JSON bytes the handlers natively parse."""
+    base = _base_type(content_type)
+    if base in ("application/json", "application/x-ndjson", "text/plain",
+                ""):
+        return body
+    if base == "application/cbor":
+        return json.dumps(cbor_decode(body)).encode()
+    if base in ("application/yaml", "text/yaml"):
+        import yaml
+        return json.dumps(yaml.safe_load(body)).encode()
+    if base == "application/smile":
+        raise UnsupportedContentType(
+            "Content-Type header [application/smile] is not supported")
+    raise UnsupportedContentType(
+        f"Content-Type header [{content_type}] is not supported")
+
+
+def encode_response(payload: bytes, json_ct: str,
+                    accept: Optional[str]) -> Tuple[bytes, str]:
+    """JSON response bytes → the Accept'ed wire format."""
+    base = _base_type(accept)
+    if base in ("application/json", "", "*/*") or \
+            not json_ct.startswith("application/json"):
+        return payload, json_ct
+    if base == "application/cbor":
+        return cbor_encode(json.loads(payload)), "application/cbor"
+    if base in ("application/yaml", "text/yaml"):
+        import yaml
+        return (yaml.safe_dump(json.loads(payload)).encode(),
+                "application/yaml")
+    if base == "application/smile":
+        raise UnsupportedContentType(
+            "Accept header [application/smile] is not supported")
+    # vnd.elasticsearch+json compat media types serve plain JSON;
+    # any other unknown Accept falls back to JSON (permissive, like
+    # text/* agents) rather than failing a readable response
+    return payload, json_ct

@@ -117,6 +117,11 @@ class ConstantKeywordFieldType(KeywordFieldType):
                                      else params.get("value"))
 
     def parse_value(self, value):
+        # query-side parsing must NOT pin: only documents set the value
+        # (ConstantKeywordFieldMapper pins on parse of an indexed doc)
+        return super().parse_value(value)
+
+    def index_value(self, value):
         s = super().parse_value(value)
         if self.value is None:
             self.value = s
@@ -1617,6 +1622,10 @@ class MapperService:
                     f"failed to parse query for field [{full}]: {e}")
             parsed.keyword_terms.setdefault("_field_names",
                                             []).append(full)
+        elif isinstance(ft, ConstantKeywordFieldType):
+            v = ft.index_value(value)
+            if v is not None:
+                parsed.keyword_terms.setdefault(full, []).append(v)
         elif isinstance(ft, VersionFieldType):
             v = ft.parse_value(value)
             if v is not None:

@@ -264,6 +264,9 @@ class RestAPI:
         # authenticated identity must be thread-local
         self._principal_tls = threading.local()
         self.start_time = time.time()
+        #: the HTTP server stamps its real bind address here on start
+        #: (client sniffing reads nodes.*.http.publish_address)
+        self.http_publish_address = "127.0.0.1:9200"
         self.voting_exclusions: List[dict] = []
         self.component_templates: Dict[str, dict] = {}
         self.cluster_settings: Dict[str, dict] = {"persistent": {},
@@ -388,6 +391,8 @@ class RestAPI:
         add("DELETE", "/_security/api_key", self.h_invalidate_api_key)
         add("GET", "/_security/api_key", self.h_get_api_keys)
         add("GET", "/_security/_authenticate", self.h_authenticate)
+        add("GET", "/_nodes/hot_threads", self.h_hot_threads)
+        add("GET", "/_nodes/{node_id}/hot_threads", self.h_hot_threads)
         add("POST", "/_nodes/reload_secure_settings",
             self.h_reload_secure_settings)
         add("POST", "/_nodes/{node_id}/reload_secure_settings",
@@ -589,6 +594,43 @@ class RestAPI:
     def handle(self, method: str, path: str, query: str,
                body: bytes,
                headers: Optional[dict] = None) -> Tuple[int, str, bytes]:
+        """Entry: x-content negotiation around the JSON-native core
+        (reference: ``RestController.dispatchRequest`` resolving
+        ``XContentType`` from Content-Type/Accept — libs/x-content)."""
+        accept = None
+        if headers:
+            hmap = {k.lower(): v for k, v in headers.items()}
+            ct = hmap.get("content-type")
+            accept = hmap.get("accept")
+            if body and ct:
+                from ..common.xcontent import (UnsupportedContentType,
+                                               decode_request)
+                try:
+                    body = decode_request(body, ct)
+                except UnsupportedContentType as e:
+                    payload = {"error": {"type": e.error_type,
+                                         "reason": str(e)},
+                               "status": e.status}
+                    return (e.status, JSON_CT,
+                            json.dumps(payload).encode())
+        status, out_ct, payload = self._handle_json(
+            method, path, query, body, headers)
+        if accept and payload:
+            from ..common.xcontent import (UnsupportedContentType,
+                                           encode_response)
+            try:
+                payload, out_ct = encode_response(payload, out_ct,
+                                                  accept)
+            except UnsupportedContentType as e:
+                err = {"error": {"type": e.error_type,
+                                 "reason": str(e)}, "status": e.status}
+                return e.status, JSON_CT, json.dumps(err).encode()
+        return status, out_ct, payload
+
+    def _handle_json(self, method: str, path: str, query: str,
+                     body: bytes,
+                     headers: Optional[dict] = None) \
+            -> Tuple[int, str, bytes]:
         if self.security.enabled and self.enforce_security and \
                 not getattr(self._internal_tls, "active", False):
             # every route requires credentials when security is on
@@ -1422,8 +1464,8 @@ class RestAPI:
             "transport": {"bound_address": ["127.0.0.1:9300"],
                           "publish_address": "127.0.0.1:9300",
                           "profiles": {}},
-            "http": {"bound_address": ["127.0.0.1:9200"],
-                     "publish_address": "127.0.0.1:9200",
+            "http": {"bound_address": [self.http_publish_address],
+                     "publish_address": self.http_publish_address,
                      "max_content_length_in_bytes": 104857600},
             "plugins": [], "modules": [],
             "ingest": {"processors": [
@@ -3090,18 +3132,47 @@ class RestAPI:
                      "max_score": max_score, "hits": page},
         }
 
+    def h_hot_threads(self, params, body, node_id=None):
+        """GET /_nodes/hot_threads (monitor/jvm/HotThreads.java:41) —
+        thread stack sampling, text response."""
+        from ..utils.hot_threads import hot_threads
+        from ..common.settings import parse_time_millis
+        text = hot_threads(
+            threads=int(params.get("threads", 3)),
+            interval_ms=parse_time_millis(
+                params.get("interval", "500ms")),
+            snapshots=int(params.get("snapshots", 10)),
+            ignore_idle=params.get("ignore_idle_threads", "true")
+            != "false",
+            node_name=self.node_name, node_id=self.node_id)
+        return 200, "text/plain; charset=UTF-8", text
+
+    @property
+    def keystore_path(self) -> str:
+        from ..common.keystore import Keystore
+        return os.path.join(self.indices.data_path, Keystore.FILENAME)
+
     def h_reload_secure_settings(self, params, body, node_id=None):
         """POST /_nodes/reload_secure_settings (reference:
-        ``NodesReloadSecureSettingsAction`` re-reading the keystore).
-        This build's keystore is unencrypted (empty password); a
-        non-empty password therefore cannot match."""
+        ``NodesReloadSecureSettingsAction`` re-reading the keystore with
+        the client-supplied password — KeyStoreWrapper.java:83)."""
+        from ..common.keystore import Keystore, KeystoreError
         b = _json_body(body) if body else {}
         entry: Dict[str, Any] = {"name": self.node_name}
-        pw = b.get("secure_settings_password")
-        if pw:
+        pw = b.get("secure_settings_password") or ""
+        if not os.path.exists(self.keystore_path):
+            # nodes auto-create an empty-password keystore (the 7.x
+            # default) — a non-empty supplied password then mismatches
+            Keystore(self.keystore_path, "").save()
+        try:
+            ks = Keystore.load(self.keystore_path, pw)
+            #: secure settings live beside (not inside) normal settings;
+            #: consumers read them via this map (repo credentials,
+            #: remote-cluster secrets)
+            self.secure_settings = dict(ks.entries)
+        except KeystoreError as e:
             entry["reload_exception"] = {
-                "type": "security_exception",
-                "reason": "Provided keystore password was incorrect"}
+                "type": "security_exception", "reason": str(e)}
         return {"cluster_name": self.cluster_name,
                 "_nodes": {"total": 1, "successful": 1, "failed": 0},
                 "nodes": {self.node_id: entry}}

@@ -49,6 +49,14 @@ class HttpServer:
         self._server = await asyncio.start_server(
             self._serve_connection, self.host, self.port,
             ssl=self.ssl_ctx)
+        owner = getattr(self.handler, "__self__", None)
+        if owner is not None and hasattr(owner, "http_publish_address"):
+            # advertise the REAL bound socket (host may be 0.0.0.0 and
+            # port 0 means ephemeral) for client sniffing
+            host, port = self._server.sockets[0].getsockname()[:2]
+            if host in ("0.0.0.0", "::"):
+                host = "127.0.0.1"
+            owner.http_publish_address = f"{host}:{port}"
 
     async def stop(self) -> None:
         if self._server is not None:

@@ -37,11 +37,13 @@ class EnrichService:
             raise ResourceAlreadyExistsError(
                 f"policy [{name}] already exists")
         ptype = next(iter(body), None)
-        if ptype not in ("match", "geo_match", "range"):
+        if ptype not in ("match", "range"):
+            # geo_match needs shape containment, which the lookup table
+            # design doesn't carry — reject at put rather than silently
+            # degrade to exact matching
             raise IllegalArgumentError(
-                f"unsupported policy type "
-                f"[{ptype}], supported types are [match, geo_match, "
-                f"range]")
+                f"unsupported policy type [{ptype}], supported types "
+                f"are [match, range]")
         spec = body[ptype]
         for req_key in ("indices", "match_field", "enrich_fields"):
             if req_key not in spec:
@@ -78,6 +80,8 @@ class EnrichService:
         match_field = spec["match_field"]
         enrich_fields = spec["enrich_fields"]
         lookup: Dict[Any, List[dict]] = {}
+        intervals: List[tuple] = []      # (lo, hi, doc) for range policies
+        is_range = p["type"] == "range"
         search_after = None
         while True:
             body: dict = {"size": 1000,
@@ -94,15 +98,23 @@ class EnrichService:
                     continue
                 doc = {f: src[f] for f in enrich_fields if f in src}
                 doc[match_field] = key
+                if is_range:
+                    iv = _as_interval(key)
+                    if iv is not None:
+                        intervals.append((iv[0], iv[1], doc))
+                    continue
                 keys = key if isinstance(key, list) else [key]
                 for k in keys:
                     lookup.setdefault(k, []).append(doc)
             if len(hits) < 1000 or sum(
-                    len(v) for v in lookup.values()) >= self.MAX_DOCS:
+                    len(v) for v in lookup.values()) + \
+                    len(intervals) >= self.MAX_DOCS:
                 break
             search_after = hits[-1]["sort"]
         _ENRICH_LOOKUPS[name] = {"match_field": match_field,
-                                 "lookup": lookup}
+                                 "lookup": lookup,
+                                 "intervals": intervals if is_range
+                                 else None}
         return {"status": {"phase": "COMPLETE"}}
 
 
@@ -133,11 +145,60 @@ class EnrichProcessor(Processor):
             return
         if not self.override and doc.get(self.target_field) is not None:
             return
-        matches = table["lookup"].get(key, [])[: self.max_matches]
+        if table.get("intervals") is not None:
+            # range policy: containment scan over stored intervals
+            probe = _as_point(key)
+            matches = [d for lo, hi, d in table["intervals"]
+                       if probe is not None and lo <= probe <= hi][
+                           : self.max_matches]
+        else:
+            matches = table["lookup"].get(key, [])[: self.max_matches]
         if not matches:
             return
         doc.set(self.target_field,
                 matches[0] if self.max_matches == 1 else matches)
+
+
+def _as_interval(value):
+    """A range-policy match value → (lo, hi): {gte,lte} dicts, CIDR
+    strings, or [lo, hi] pairs (EnrichPolicyRunner's range field
+    semantics reduced to closed numeric/IP intervals)."""
+    if isinstance(value, dict):
+        lo = value.get("gte", value.get("gt"))
+        hi = value.get("lte", value.get("lt"))
+        lo_p, hi_p = _as_point(lo), _as_point(hi)
+        if lo_p is None or hi_p is None:
+            return None
+        return lo_p, hi_p
+    if isinstance(value, str) and "/" in value:
+        import ipaddress
+        try:
+            net = ipaddress.ip_network(value, strict=False)
+        except ValueError:
+            return None
+        return float(int(net.network_address)), \
+            float(int(net.broadcast_address))
+    if isinstance(value, (list, tuple)) and len(value) == 2:
+        lo_p, hi_p = _as_point(value[0]), _as_point(value[1])
+        if lo_p is None or hi_p is None:
+            return None
+        return lo_p, hi_p
+    return None
+
+
+def _as_point(value):
+    if isinstance(value, bool) or value is None:
+        return None
+    if isinstance(value, (int, float)):
+        return float(value)
+    try:
+        import ipaddress
+        return float(int(ipaddress.ip_address(str(value))))
+    except ValueError:
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            return None
 
 
 register_processor(EnrichProcessor)

@@ -325,7 +325,12 @@ class ParsedEql:
         self.pipes: List[Tuple[str, int]] = []
 
 
-_SPAN_UNITS = {"ms": 1.0, "s": 1e3, "m": 6e4, "h": 3.6e6, "d": 8.64e7}
+def _span_ms(num: float, unit: str) -> float:
+    from ..common.settings import parse_time_millis
+    return parse_time_millis(f"{num}{unit}")
+
+
+_SPAN_UNITS = ("ms", "s", "m", "h", "d")
 
 
 def parse_eql(text: str, resolve) -> ParsedEql:
@@ -352,9 +357,7 @@ def parse_eql(text: str, resolve) -> ParsedEql:
             if ku == "id" and vu in _SPAN_UNITS:
                 p.next()
                 unit = vu
-            elif ku == "kw" and vu == "maxspan":   # pragma: no cover
-                pass
-            out.maxspan_ms = float(vv) * _SPAN_UNITS[unit]
+            out.maxspan_ms = _span_ms(float(vv), unit)
         while True:
             kk, vv = p.peek()
             if kk == "op" and vv == "[":
@@ -604,6 +607,11 @@ class EqlService:
                     t0 = self._ts_value(p[0])
                     if ts - t0 > parsed.maxspan_ms:
                         continue
+                # a sequence needs DISTINCT events: the same doc matching
+                # two step filters must not complete a stage with itself
+                if any(e.get("_index") == h.get("_index")
+                       and e.get("_id") == h.get("_id") for e in p):
+                    continue
                 p.append(h)
                 if len(p) == n:
                     plist.remove(p)

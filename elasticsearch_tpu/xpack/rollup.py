@@ -168,7 +168,6 @@ class RollupService:
                         props[f"{m['field']}.avg._count"] = \
                             {"type": "long"}
                     else:
-                        key = "vc" if op == "value_count" else op
                         props[f"{m['field']}.{op}.value"] = \
                             {"type": "double"}
             self.create_index_fn(cfg["rollup_index"],

@@ -18,19 +18,16 @@ from typing import Any, Dict, List, Optional
 from ..common.errors import (IllegalArgumentError, ResourceNotFoundError)
 
 
-_INTERVAL_UNITS = {"ms": 1.0, "s": 1e3, "m": 6e4, "h": 3.6e6,
-                   "d": 8.64e7, "w": 6.048e8}
-
-
 def _parse_interval_ms(s: Any) -> float:
+    """Schedule intervals: bare numbers mean SECONDS (the reference's
+    IntervalSchedule default unit); unit strings ride the shared parser."""
     if isinstance(s, (int, float)) and not isinstance(s, bool):
-        return float(s) * 1e3      # bare numbers are seconds
-    import re as _re
-    m = _re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h|d|w)?", str(s).strip())
-    if m is None:
-        raise IllegalArgumentError(
-            f"unable to parse interval [{s}]")
-    return float(m.group(1)) * _INTERVAL_UNITS[m.group(2) or "s"]
+        return float(s) * 1e3
+    from ..common.settings import parse_time_millis
+    txt = str(s).strip()
+    if txt.isdigit():
+        return float(txt) * 1e3
+    return parse_time_millis(txt)
 
 
 def _path_get(obj: Any, path: str) -> Any:

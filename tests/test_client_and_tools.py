@@ -1,0 +1,234 @@
+"""L15 tests: client library (transport, typed API, helpers), CLI tools,
+keystore, hot_threads, x-content negotiation.
+
+Reference: ``client/rest`` RestClient behaviors (round-robin, dead-node
+retries), ``client/rest-high-level`` surface, ``distribution/tools/
+keystore-cli``, ``monitor/jvm/HotThreads.java``, ``libs/x-content``.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+from elasticsearch_tpu.rest.http_server import HttpServer
+
+PORT = 29860
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    d = tmp_path_factory.mktemp("client_srv")
+    api = RestAPI(IndicesService(str(d)))
+    loop = asyncio.new_event_loop()
+    srv = HttpServer(api.handle, host="127.0.0.1", port=PORT,
+                     pass_headers=True)
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            await srv.start()
+            started.set()
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    yield api
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    from elasticsearch_tpu.client import EsTpuClient
+    return EsTpuClient([f"127.0.0.1:{PORT}"])
+
+
+def test_client_core_roundtrip(client):
+    assert client.ping() is True
+    info = client.info()
+    assert info["tagline"] == "You Know, for Search"
+    client.indices.create("books", {"mappings": {"properties": {
+        "title": {"type": "text"}, "year": {"type": "integer"}}}})
+    assert client.indices.exists("books") is True
+    client.index("books", {"title": "Dune", "year": 1965}, id="1")
+    client.index("books", {"title": "Dune Messiah", "year": 1969},
+                 id="2", refresh="true")
+    doc = client.get("books", "1")
+    assert doc["_source"]["title"] == "Dune"
+    r = client.search("books", {"query": {"match": {"title": "dune"}},
+                                "sort": [{"year": "asc"}]})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1", "2"]
+    assert client.count("books")["count"] == 2
+    client.delete("books", "2", refresh="true")
+    assert client.exists("books", "2") is False
+
+
+def test_client_error_surfaces(client):
+    from elasticsearch_tpu.client import TransportError
+    with pytest.raises(TransportError) as ei:
+        client.get("books", "missing-doc")
+    assert ei.value.status_code == 404
+    with pytest.raises(TransportError) as ei:
+        client.search("books", {"query": {"bad_query_kind": {}}})
+    assert ei.value.status_code == 400
+
+
+def test_client_namespaces(client):
+    h = client.cluster.health()
+    assert h["status"] in ("green", "yellow")
+    rows = client.cat.indices()
+    assert any(r["index"] == "books" for r in rows)
+    stats = client.nodes.stats()
+    assert "nodes" in stats
+    out = client.sql.query({"query": "SELECT title FROM books"})
+    assert out["rows"] == [["Dune"]]
+
+
+def test_client_dead_node_failover():
+    from elasticsearch_tpu.client import EsTpuClient
+    # first host unreachable → transport retries onto the live one
+    c = EsTpuClient([f"127.0.0.1:1", f"127.0.0.1:{PORT}"],
+                    timeout=2.0)
+    assert c.ping() is True
+    dead = c.transport._hosts[0]
+    assert dead.failed_attempts >= 1 and not dead.alive
+
+
+def test_bulk_and_scan_helpers(client):
+    from elasticsearch_tpu.client import bulk, scan
+    ok, errors = bulk(client,
+                      ({"_id": str(i), "n": i} for i in range(25)),
+                      index="bulked", chunk_size=10, refresh=True)
+    assert ok == 25 and errors == []
+    hits = list(scan(client, index="bulked",
+                     query={"query": {"range": {"n": {"gte": 5}}}},
+                     size=7))
+    assert len(hits) == 20
+    assert {h["_source"]["n"] for h in hits} == set(range(5, 25))
+
+
+def test_sniff(client):
+    client.transport.sniff()
+    assert client.ping() is True
+
+
+# -- CLI tools -------------------------------------------------------------
+
+def test_keystore_cli_and_crypto(tmp_path):
+    from elasticsearch_tpu.cli.keystore import main
+    from elasticsearch_tpu.common.keystore import Keystore, KeystoreError
+    path = str(tmp_path / "estpu.keystore")
+    assert main(["--path", path, "--password", "s3cret",
+                 "create"]) == 0
+    assert main(["--path", path, "--password", "x", "create"]) == 1
+    ks = Keystore.load(path, "s3cret")
+    ks.set("cluster.remote.leader.credentials", "hunter2")
+    ks.save()
+    # wrong password rejected via HMAC, not a parse error
+    with pytest.raises(KeystoreError):
+        Keystore.load(path, "wrong")
+    ks2 = Keystore.load(path, "s3cret")
+    assert ks2.get("cluster.remote.leader.credentials") == "hunter2"
+    assert ks2.list_keys() == ["cluster.remote.leader.credentials"]
+    # on-disk bytes don't leak the secret
+    blob = open(path, "rb").read()
+    assert b"hunter2" not in blob
+    # invalid setting names rejected
+    with pytest.raises(Exception):
+        ks2.set("BadName", "x")
+
+
+def test_sql_cli_execute(server, capsys):
+    from elasticsearch_tpu.cli.sql import main
+    rc = main(["--server", f"127.0.0.1:{PORT}",
+               "-e", "SELECT title FROM books ORDER BY title"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "title" in out and "Dune" in out
+    rc = main(["--server", f"127.0.0.1:{PORT}", "-e", "SELEC nope"])
+    assert rc == 1
+
+
+# -- hot_threads + x-content ----------------------------------------------
+
+def test_hot_threads_endpoint(server):
+    spin = {"on": True}
+
+    def burner():
+        while spin["on"]:
+            sum(i * i for i in range(2000))
+
+    t = threading.Thread(target=burner, name="burner-thread",
+                         daemon=True)
+    t.start()
+    try:
+        st, ct, out = server.handle(
+            "GET", "/_nodes/hot_threads", "interval=200ms&snapshots=5",
+            b"")
+    finally:
+        spin["on"] = False
+    assert st == 200 and ct.startswith("text/plain")
+    text = out.decode()
+    assert "Hot threads at" in text
+    assert "cpu usage by thread" in text
+    assert "burner-thread" in text
+
+
+def test_cbor_roundtrip():
+    from elasticsearch_tpu.common.xcontent import (cbor_decode,
+                                                   cbor_encode)
+    doc = {"a": 1, "b": -42, "c": [1.5, "x", True, None],
+           "nested": {"k": "v" * 100}, "big": 2 ** 40}
+    assert cbor_decode(cbor_encode(doc)) == doc
+
+
+def test_content_negotiation(server):
+    from elasticsearch_tpu.common.xcontent import (cbor_decode,
+                                                   cbor_encode)
+    # CBOR request body
+    body = cbor_encode({"query": {"match_all": {}}})
+    st, ct, out = server.handle(
+        "POST", "/books/_search", "", body,
+        headers={"Content-Type": "application/cbor"})
+    assert st == 200 and ct.startswith("application/json")
+    # CBOR response via Accept
+    st, ct, out = server.handle(
+        "POST", "/books/_search", "",
+        json.dumps({"size": 0}).encode(),
+        headers={"Content-Type": "application/json",
+                 "Accept": "application/cbor"})
+    assert st == 200 and ct == "application/cbor"
+    decoded = cbor_decode(out)
+    assert decoded["hits"]["total"]["value"] >= 1
+    # YAML response
+    st, ct, out = server.handle(
+        "GET", "/", "", b"", headers={"Accept": "application/yaml"})
+    assert ct == "application/yaml"
+    assert b"tagline:" in out
+    # SMILE rejected with the reference's error shape
+    st, ct, out = server.handle(
+        "POST", "/books/_search", "", b"xx",
+        headers={"Content-Type": "application/smile"})
+    assert st == 406
+
+
+def test_reload_secure_settings_with_keystore(server):
+    # wrong password on the (auto-created empty) keystore errors
+    st, _ct, out = server.handle(
+        "POST", "/_nodes/reload_secure_settings", "",
+        json.dumps({"secure_settings_password": "nope"}).encode())
+    node = next(iter(json.loads(out)["nodes"].values()))
+    assert node["reload_exception"]["type"] == "security_exception"
+    # correct (empty) password loads
+    st, _ct, out = server.handle(
+        "POST", "/_nodes/reload_secure_settings", "", b"")
+    node = next(iter(json.loads(out)["nodes"].values()))
+    assert "reload_exception" not in node

@@ -272,3 +272,34 @@ def test_enrich_policy_validation(api):
     assert st == 400
     st, r = req(api, "PUT", "/_enrich/policy/nope/_execute")
     assert st == 404
+
+
+def test_enrich_range_policy(api):
+    for i, (cidr, zone) in enumerate([("10.0.0.0/8", "internal"),
+                                      ("192.168.0.0/16", "lan")]):
+        req(api, "PUT", f"/nets/_doc/{i}",
+            {"net": cidr, "zone": zone}, query="refresh=true")
+    st, r = req(api, "PUT", "/_enrich/policy/net-zones", {
+        "range": {"indices": "nets", "match_field": "net",
+                  "enrich_fields": ["zone"]}})
+    assert st == 200
+    req(api, "PUT", "/_enrich/policy/net-zones/_execute")
+    req(api, "PUT", "/_ingest/pipeline/zone-join", {
+        "processors": [{"enrich": {"policy_name": "net-zones",
+                                   "field": "ip",
+                                   "target_field": "net_info"}}]})
+    req(api, "PUT", "/traffic/_doc/1", {"ip": "10.1.2.3"},
+        query="pipeline=zone-join&refresh=true")
+    st, r = req(api, "GET", "/traffic/_doc/1")
+    assert r["_source"]["net_info"]["zone"] == "internal"
+    req(api, "PUT", "/traffic/_doc/2", {"ip": "8.8.8.8"},
+        query="pipeline=zone-join&refresh=true")
+    st, r = req(api, "GET", "/traffic/_doc/2")
+    assert "net_info" not in r["_source"]
+
+
+def test_enrich_geo_match_rejected(api):
+    st, r = req(api, "PUT", "/_enrich/policy/geo", {
+        "geo_match": {"indices": "x", "match_field": "loc",
+                      "enrich_fields": ["f"]}})
+    assert st == 400
