@@ -376,6 +376,91 @@ class RestAPI:
         add("GET", "/_ccr/auto_follow", self.h_ccr_get_auto)
         add("GET", "/_ccr/auto_follow/{name}", self.h_ccr_get_auto)
         add("DELETE", "/_ccr/auto_follow/{name}", self.h_ccr_del_auto)
+        # ml (x-pack/plugin/ml)
+        add("PUT", "/_ml/anomaly_detectors/{job_id}", self.h_ml_put_job)
+        add("GET", "/_ml/anomaly_detectors", self.h_ml_get_jobs)
+        add("GET", "/_ml/anomaly_detectors/_stats", self.h_ml_job_stats)
+        add("GET", "/_ml/anomaly_detectors/{job_id}", self.h_ml_get_jobs)
+        add("GET", "/_ml/anomaly_detectors/{job_id}/_stats",
+            self.h_ml_job_stats)
+        add("DELETE", "/_ml/anomaly_detectors/{job_id}",
+            self.h_ml_delete_job)
+        add("POST", "/_ml/anomaly_detectors/{job_id}/_open",
+            self.h_ml_open_job)
+        add("POST", "/_ml/anomaly_detectors/{job_id}/_close",
+            self.h_ml_close_job)
+        add("POST", "/_ml/anomaly_detectors/{job_id}/_data",
+            self.h_ml_post_data)
+        add("POST", "/_ml/anomaly_detectors/{job_id}/_flush",
+            self.h_ml_flush_job)
+        add("GET,POST", "/_ml/anomaly_detectors/{job_id}/results/buckets",
+            self.h_ml_get_buckets)
+        add("GET,POST", "/_ml/anomaly_detectors/{job_id}/results/records",
+            self.h_ml_get_records)
+        add("GET,POST",
+            "/_ml/anomaly_detectors/{job_id}/results/overall_buckets",
+            self.h_ml_overall_buckets)
+        add("GET", "/_ml/anomaly_detectors/{job_id}/model_snapshots",
+            self.h_ml_get_snapshots)
+        add("POST", "/_ml/anomaly_detectors/{job_id}/model_snapshots"
+            "/{snapshot_id}/_revert", self.h_ml_revert_snapshot)
+        add("PUT", "/_ml/datafeeds/{feed_id}", self.h_ml_put_datafeed)
+        add("GET", "/_ml/datafeeds", self.h_ml_get_datafeeds)
+        add("GET", "/_ml/datafeeds/_stats", self.h_ml_datafeed_stats)
+        add("GET", "/_ml/datafeeds/{feed_id}", self.h_ml_get_datafeeds)
+        add("GET", "/_ml/datafeeds/{feed_id}/_stats",
+            self.h_ml_datafeed_stats)
+        add("DELETE", "/_ml/datafeeds/{feed_id}", self.h_ml_del_datafeed)
+        add("POST", "/_ml/datafeeds/{feed_id}/_start",
+            self.h_ml_start_datafeed)
+        add("POST", "/_ml/datafeeds/{feed_id}/_stop",
+            self.h_ml_stop_datafeed)
+        add("GET,POST", "/_ml/datafeeds/{feed_id}/_preview",
+            self.h_ml_preview_datafeed)
+        add("PUT", "/_ml/trained_models/{model_id}", self.h_ml_put_model)
+        add("GET", "/_ml/trained_models", self.h_ml_get_models)
+        add("GET", "/_ml/trained_models/_stats", self.h_ml_model_stats)
+        add("GET", "/_ml/trained_models/{model_id}", self.h_ml_get_models)
+        add("GET", "/_ml/trained_models/{model_id}/_stats",
+            self.h_ml_model_stats)
+        add("DELETE", "/_ml/trained_models/{model_id}",
+            self.h_ml_del_model)
+        add("POST", "/_ml/trained_models/{model_id}/_infer",
+            self.h_ml_infer)
+        add("POST", "/_ml/trained_models/{model_id}/deployment/_infer",
+            self.h_ml_infer)
+        add("GET,POST", "/_ml/data_frame/analytics/_explain",
+            self.h_ml_explain_analytics)
+        add("PUT", "/_ml/data_frame/analytics/{id}",
+            self.h_ml_put_analytics)
+        add("GET", "/_ml/data_frame/analytics", self.h_ml_get_analytics)
+        add("GET", "/_ml/data_frame/analytics/_stats",
+            self.h_ml_analytics_stats)
+        add("GET", "/_ml/data_frame/analytics/{id}",
+            self.h_ml_get_analytics)
+        add("GET", "/_ml/data_frame/analytics/{id}/_stats",
+            self.h_ml_analytics_stats)
+        add("DELETE", "/_ml/data_frame/analytics/{id}",
+            self.h_ml_del_analytics)
+        add("POST", "/_ml/data_frame/analytics/{id}/_start",
+            self.h_ml_start_analytics)
+        add("POST", "/_ml/data_frame/analytics/{id}/_stop",
+            self.h_ml_stop_analytics)
+        add("PUT", "/_ml/calendars/{calendar_id}", self.h_ml_put_calendar)
+        add("GET", "/_ml/calendars", self.h_ml_get_calendars)
+        add("GET", "/_ml/calendars/{calendar_id}", self.h_ml_get_calendars)
+        add("DELETE", "/_ml/calendars/{calendar_id}",
+            self.h_ml_del_calendar)
+        add("POST", "/_ml/calendars/{calendar_id}/events",
+            self.h_ml_post_cal_events)
+        add("GET", "/_ml/calendars/{calendar_id}/events",
+            self.h_ml_get_cal_events)
+        add("PUT", "/_ml/filters/{filter_id}", self.h_ml_put_filter)
+        add("GET", "/_ml/filters", self.h_ml_get_filters)
+        add("GET", "/_ml/filters/{filter_id}", self.h_ml_get_filters)
+        add("DELETE", "/_ml/filters/{filter_id}", self.h_ml_del_filter)
+        add("GET", "/_ml/info", self.h_ml_info)
+        add("POST", "/_ml/set_upgrade_mode", self.h_ml_upgrade_mode)
         # enrich (x-pack/plugin/enrich)
         add("PUT", "/_enrich/policy/{name}", self.h_put_enrich_policy)
         add("GET", "/_enrich/policy", self.h_get_enrich_policy)
@@ -2941,6 +3026,149 @@ class RestAPI:
 
     def h_ccr_del_auto(self, params, body, name):
         return self.ccr.delete_auto_follow(name)
+
+    @property
+    def ml(self):
+        if getattr(self, "_ml_svc", None) is None:
+            from ..xpack.ml import MlService, registry_bind
+            self._ml_svc = MlService(
+                lambda i, b: self.internal_search(i, b),
+                lambda i, lines: self.internal_bulk(i, lines,
+                                                    refresh=True))
+            registry_bind(self._ml_svc)
+        return self._ml_svc
+
+    def h_ml_put_job(self, params, body, job_id):
+        return self.ml.put_job(job_id, _json_body(body))
+
+    def h_ml_get_jobs(self, params, body, job_id=None):
+        return self.ml.get_jobs(job_id)
+
+    def h_ml_job_stats(self, params, body, job_id=None):
+        return self.ml.job_stats(job_id)
+
+    def h_ml_delete_job(self, params, body, job_id):
+        return self.ml.delete_job(job_id,
+                                  force=params.get("force") == "true")
+
+    def h_ml_open_job(self, params, body, job_id):
+        return self.ml.open_job(job_id)
+
+    def h_ml_close_job(self, params, body, job_id):
+        return self.ml.close_job(job_id,
+                                 force=params.get("force") == "true")
+
+    def h_ml_post_data(self, params, body, job_id):
+        return self.ml.post_data(job_id, body)
+
+    def h_ml_flush_job(self, params, body, job_id):
+        return self.ml.flush_job(job_id)
+
+    def h_ml_get_buckets(self, params, body, job_id):
+        return self.ml.get_buckets(job_id, _json_body(body), params)
+
+    def h_ml_get_records(self, params, body, job_id):
+        return self.ml.get_records(job_id, _json_body(body), params)
+
+    def h_ml_overall_buckets(self, params, body, job_id):
+        return self.ml.get_overall_buckets(job_id, _json_body(body))
+
+    def h_ml_get_snapshots(self, params, body, job_id):
+        return self.ml.get_model_snapshots(job_id)
+
+    def h_ml_revert_snapshot(self, params, body, job_id, snapshot_id):
+        return self.ml.revert_model_snapshot(job_id, snapshot_id)
+
+    def h_ml_put_datafeed(self, params, body, feed_id):
+        return self.ml.put_datafeed(feed_id, _json_body(body))
+
+    def h_ml_get_datafeeds(self, params, body, feed_id=None):
+        return self.ml.get_datafeeds(feed_id)
+
+    def h_ml_datafeed_stats(self, params, body, feed_id=None):
+        return self.ml.datafeed_stats(feed_id)
+
+    def h_ml_del_datafeed(self, params, body, feed_id):
+        return self.ml.delete_datafeed(feed_id)
+
+    def h_ml_start_datafeed(self, params, body, feed_id):
+        payload = _json_body(body)
+        return self.ml.start_datafeed(
+            feed_id, payload.get("start") or params.get("start"),
+            payload.get("end") or params.get("end"))
+
+    def h_ml_stop_datafeed(self, params, body, feed_id):
+        return self.ml.stop_datafeed(feed_id)
+
+    def h_ml_preview_datafeed(self, params, body, feed_id):
+        return self.ml.preview_datafeed(feed_id)
+
+    def h_ml_put_model(self, params, body, model_id):
+        return self.ml.put_trained_model(model_id, _json_body(body))
+
+    def h_ml_get_models(self, params, body, model_id=None):
+        return self.ml.get_trained_models(model_id)
+
+    def h_ml_model_stats(self, params, body, model_id=None):
+        return self.ml.trained_model_stats(model_id)
+
+    def h_ml_del_model(self, params, body, model_id):
+        return self.ml.delete_trained_model(model_id)
+
+    def h_ml_infer(self, params, body, model_id):
+        return self.ml.infer(model_id, _json_body(body))
+
+    def h_ml_put_analytics(self, params, body, id):
+        return self.ml.put_analytics(id, _json_body(body))
+
+    def h_ml_get_analytics(self, params, body, id=None):
+        return self.ml.get_analytics(id)
+
+    def h_ml_analytics_stats(self, params, body, id=None):
+        return self.ml.analytics_stats(id)
+
+    def h_ml_del_analytics(self, params, body, id):
+        return self.ml.delete_analytics(id)
+
+    def h_ml_start_analytics(self, params, body, id):
+        return self.ml.start_analytics(id)
+
+    def h_ml_stop_analytics(self, params, body, id):
+        return self.ml.stop_analytics(id)
+
+    def h_ml_explain_analytics(self, params, body):
+        return self.ml.explain_analytics(_json_body(body))
+
+    def h_ml_put_calendar(self, params, body, calendar_id):
+        return self.ml.put_calendar(calendar_id, _json_body(body))
+
+    def h_ml_get_calendars(self, params, body, calendar_id=None):
+        return self.ml.get_calendars(calendar_id)
+
+    def h_ml_del_calendar(self, params, body, calendar_id):
+        return self.ml.delete_calendar(calendar_id)
+
+    def h_ml_post_cal_events(self, params, body, calendar_id):
+        return self.ml.post_calendar_events(calendar_id, _json_body(body))
+
+    def h_ml_get_cal_events(self, params, body, calendar_id):
+        return self.ml.get_calendar_events(calendar_id)
+
+    def h_ml_put_filter(self, params, body, filter_id):
+        return self.ml.put_filter(filter_id, _json_body(body))
+
+    def h_ml_get_filters(self, params, body, filter_id=None):
+        return self.ml.get_filters(filter_id)
+
+    def h_ml_del_filter(self, params, body, filter_id):
+        return self.ml.delete_filter(filter_id)
+
+    def h_ml_info(self, params, body):
+        return self.ml.info()
+
+    def h_ml_upgrade_mode(self, params, body):
+        return self.ml.set_upgrade_mode(
+            params.get("enabled", "false") == "true")
 
     @property
     def enrich(self):

@@ -1,0 +1,417 @@
+"""ML tests: anomaly detection jobs/datafeeds, trained-model inference,
+dataframe analytics (x-pack/plugin/ml analog — xpack/ml.py)."""
+
+import json
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+@pytest.fixture()
+def api():
+    return RestAPI(IndicesService(tempfile.mkdtemp()))
+
+
+def req(api, method, path, body=None, query=""):
+    if isinstance(body, (dict, list)):
+        b = json.dumps(body).encode()
+    elif isinstance(body, str):
+        b = body.encode()
+    else:
+        b = body or b""
+    st, _ct, out = api.handle(method, path, query, b)
+    return st, json.loads(out)
+
+
+JOB = {"analysis_config": {
+           "bucket_span": "1h",
+           "detectors": [{"function": "mean", "field_name": "value"}]},
+       "data_description": {"time_field": "time"}}
+
+
+def _series(n_normal=48, spike=None):
+    """Hourly records at value≈10, optional (hour, value) spike."""
+    recs = []
+    for h in range(n_normal):
+        v = 10.0 + (h % 3) * 0.1
+        if spike and h == spike[0]:
+            v = spike[1]
+        recs.append({"time": h * 3_600_000, "value": v})
+    return "\n".join(json.dumps(r) for r in recs)
+
+
+# -- anomaly detection jobs ------------------------------------------------
+
+def test_job_crud_and_validation(api):
+    st, r = req(api, "PUT", "/_ml/anomaly_detectors/j1", JOB)
+    assert st == 200 and r["job_id"] == "j1"
+    st, r = req(api, "PUT", "/_ml/anomaly_detectors/j1", JOB)
+    assert st == 400  # already exists
+    st, r = req(api, "PUT", "/_ml/anomaly_detectors/bad",
+                {"analysis_config": {"detectors": [
+                    {"function": "mean"}]}})  # mean needs field_name
+    assert st == 400
+    st, r = req(api, "GET", "/_ml/anomaly_detectors")
+    assert r["count"] == 1
+    st, r = req(api, "GET", "/_ml/anomaly_detectors/j1/_stats")
+    assert r["jobs"][0]["state"] == "closed"
+    st, r = req(api, "DELETE", "/_ml/anomaly_detectors/j1")
+    assert r == {"acknowledged": True}
+    st, r = req(api, "GET", "/_ml/anomaly_detectors/j1")
+    assert st == 404
+
+
+def test_anomaly_detection_flags_spike(api):
+    req(api, "PUT", "/_ml/anomaly_detectors/j1", JOB)
+    st, r = req(api, "POST", "/_ml/anomaly_detectors/j1/_open")
+    assert r["opened"] is True
+    st, r = req(api, "POST", "/_ml/anomaly_detectors/j1/_data",
+                _series(spike=(40, 500.0)))
+    assert st == 200 and r["processed_record_count"] == 48
+    st, r = req(api, "POST", "/_ml/anomaly_detectors/j1/_flush")
+    assert r["flushed"] is True
+    st, r = req(api, "GET",
+                "/_ml/anomaly_detectors/j1/results/buckets")
+    assert r["count"] == 48
+    spiked = [b for b in r["buckets"] if b["anomaly_score"] > 50]
+    assert [b["timestamp"] for b in spiked] == [40 * 3_600_000]
+    st, r = req(api, "GET",
+                "/_ml/anomaly_detectors/j1/results/records")
+    assert r["count"] >= 1
+    top = r["records"][0]
+    assert top["timestamp"] == 40 * 3_600_000
+    assert top["actual"] == [500.0]
+    assert abs(top["typical"][0] - 10.0) < 1.0
+    assert top["probability"] < 1e-6
+    # steady series produces no high-score buckets elsewhere
+    others = [b for b in req(api, "GET",
+              "/_ml/anomaly_detectors/j1/results/buckets")[1]["buckets"]
+              if b["timestamp"] != 40 * 3_600_000]
+    assert all(b["anomaly_score"] < 20 for b in others)
+
+
+def test_results_are_indexed_searchable(api):
+    req(api, "PUT", "/_ml/anomaly_detectors/j1", JOB)
+    req(api, "POST", "/_ml/anomaly_detectors/j1/_open")
+    req(api, "POST", "/_ml/anomaly_detectors/j1/_data",
+        _series(spike=(30, 900.0)))
+    req(api, "POST", "/_ml/anomaly_detectors/j1/_flush")
+    st, r = req(api, "POST", "/.ml-anomalies-shared/_search",
+                {"query": {"bool": {"filter": [
+                    {"term": {"result_type": "record"}},
+                    {"range": {"record_score": {"gt": 50}}}]}}})
+    assert st == 200
+    assert r["hits"]["total"]["value"] >= 1
+    src = r["hits"]["hits"][0]["_source"]
+    assert src["job_id"] == "j1" and src["actual"] == [900.0]
+
+
+def test_partition_field_isolates_series(api):
+    body = {"analysis_config": {
+                "bucket_span": "1h",
+                "detectors": [{"function": "mean", "field_name": "v",
+                               "partition_field_name": "host"}]},
+            "data_description": {"time_field": "time"}}
+    req(api, "PUT", "/_ml/anomaly_detectors/jp", body)
+    req(api, "POST", "/_ml/anomaly_detectors/jp/_open")
+    recs = []
+    for h in range(40):
+        recs.append({"time": h * 3_600_000, "host": "a", "v": 5.0})
+        # host b runs hot at 1000 ALWAYS — normal for b, so no anomaly
+        recs.append({"time": h * 3_600_000, "host": "b", "v": 1000.0})
+    recs.append({"time": 40 * 3_600_000, "host": "a", "v": 1000.0})
+    recs.append({"time": 40 * 3_600_000, "host": "b", "v": 1000.0})
+    recs.append({"time": 41 * 3_600_000, "host": "a", "v": 5.0})
+    req(api, "POST", "/_ml/anomaly_detectors/jp/_data",
+        "\n".join(json.dumps(r) for r in recs))
+    req(api, "POST", "/_ml/anomaly_detectors/jp/_flush")
+    st, r = req(api, "GET",
+                "/_ml/anomaly_detectors/jp/results/records",
+                {"record_score": 50})
+    assert r["count"] == 1
+    assert r["records"][0]["partition_field_value"] == "a"
+
+
+def test_model_snapshot_revert(api):
+    req(api, "PUT", "/_ml/anomaly_detectors/j1", JOB)
+    req(api, "POST", "/_ml/anomaly_detectors/j1/_open")
+    req(api, "POST", "/_ml/anomaly_detectors/j1/_data", _series())
+    st, r = req(api, "POST", "/_ml/anomaly_detectors/j1/_close")
+    assert r["closed"] is True
+    st, r = req(api, "GET",
+                "/_ml/anomaly_detectors/j1/model_snapshots")
+    assert r["count"] == 1
+    snap_id = r["model_snapshots"][0]["snapshot_id"]
+    st, r = req(api, "POST",
+                f"/_ml/anomaly_detectors/j1/model_snapshots/"
+                f"{snap_id}/_revert")
+    assert r["model"]["snapshot_id"] == snap_id
+
+
+# -- datafeeds -------------------------------------------------------------
+
+def test_datafeed_end_to_end(api):
+    for h in range(50):
+        v = 700.0 if h == 45 else 20.0
+        req(api, "PUT", f"/metrics/_doc/{h}",
+            {"time": h * 3_600_000, "value": v})
+    req(api, "POST", "/metrics/_refresh")
+    req(api, "PUT", "/_ml/anomaly_detectors/jd", JOB)
+    st, r = req(api, "PUT", "/_ml/datafeeds/fd",
+                {"job_id": "jd", "indices": ["metrics"]})
+    assert st == 200 and r["datafeed_id"] == "fd"
+    # job must be open to start the feed
+    st, r = req(api, "POST", "/_ml/datafeeds/fd/_start")
+    assert st >= 400
+    req(api, "POST", "/_ml/anomaly_detectors/jd/_open")
+    st, r = req(api, "POST", "/_ml/datafeeds/fd/_start")
+    assert st == 200 and r["started"] is True
+    st, r = req(api, "GET",
+                "/_ml/anomaly_detectors/jd/results/records",
+                {"record_score": 50})
+    assert r["count"] == 1
+    assert r["records"][0]["timestamp"] == 45 * 3_600_000
+    st, r = req(api, "GET", "/_ml/datafeeds/fd/_stats")
+    assert r["datafeeds"][0]["timing_stats"]["search_count"] >= 1
+
+
+def test_datafeed_preview_and_validation(api):
+    req(api, "PUT", "/idx/_doc/1", {"time": 0, "value": 1.0})
+    req(api, "POST", "/idx/_refresh")
+    st, r = req(api, "PUT", "/_ml/datafeeds/f1",
+                {"job_id": "nope", "indices": ["idx"]})
+    assert st == 404
+    req(api, "PUT", "/_ml/anomaly_detectors/j1", JOB)
+    req(api, "PUT", "/_ml/datafeeds/f1",
+        {"job_id": "j1", "indices": ["idx"]})
+    st, r = req(api, "GET", "/_ml/datafeeds/f1/_preview")
+    assert st == 200 and r == [{"time": 0, "value": 1.0}]
+
+
+# -- trained models + inference -------------------------------------------
+
+TREE_MODEL = {
+    "inference_config": {"regression": {}},
+    "input": {"field_names": ["x", "y"]},
+    "definition": {"trained_model": {"tree": {
+        "feature_names": ["x", "y"],
+        "tree_structure": [
+            {"node_index": 0, "split_feature": 0, "threshold": 5.0,
+             "left_child": 1, "right_child": 2},
+            {"node_index": 1, "leaf_value": 10.0},
+            {"node_index": 2, "split_feature": 1, "threshold": 3.0,
+             "left_child": 3, "right_child": 4},
+            {"node_index": 3, "leaf_value": 20.0},
+            {"node_index": 4, "leaf_value": 30.0}]}}}}
+
+
+def test_tree_inference(api):
+    st, r = req(api, "PUT", "/_ml/trained_models/m1", TREE_MODEL)
+    assert st == 200 and "definition" not in r
+    st, r = req(api, "POST", "/_ml/trained_models/m1/_infer",
+                {"docs": [{"x": 1.0, "y": 0.0},
+                          {"x": 9.0, "y": 1.0},
+                          {"x": 9.0, "y": 9.0}]})
+    assert st == 200
+    vals = [d["predicted_value"] for d in r["inference_results"]]
+    assert vals == [10.0, 20.0, 30.0]
+    st, r = req(api, "GET", "/_ml/trained_models/m1/_stats")
+    assert r["trained_model_stats"][0]["inference_stats"][
+        "inference_count"] == 3
+
+
+def test_ensemble_weighted_sum_and_classification(api):
+    ens = {
+        "inference_config": {"regression": {}},
+        "definition": {"trained_model": {"ensemble": {
+            "feature_names": ["x"],
+            "aggregate_output": {"weighted_sum": {"weights": [0.5, 2.0]}},
+            "trained_models": [
+                {"tree": {"feature_names": ["x"], "tree_structure": [
+                    {"node_index": 0, "split_feature": 0,
+                     "threshold": 1.0, "left_child": 1,
+                     "right_child": 2},
+                    {"node_index": 1, "leaf_value": 2.0},
+                    {"node_index": 2, "leaf_value": 4.0}]}},
+                {"tree": {"feature_names": ["x"], "tree_structure": [
+                    {"node_index": 0, "leaf_value": 3.0}]}}]}}}}
+    req(api, "PUT", "/_ml/trained_models/ens", ens)
+    st, r = req(api, "POST", "/_ml/trained_models/ens/_infer",
+                {"docs": [{"x": 0.0}, {"x": 5.0}]})
+    vals = [d["predicted_value"] for d in r["inference_results"]]
+    assert vals == [0.5 * 2.0 + 2.0 * 3.0, 0.5 * 4.0 + 2.0 * 3.0]
+
+    clf = {
+        "inference_config": {"classification": {"num_top_classes": 2}},
+        "definition": {"trained_model": {"tree": {
+            "feature_names": ["x"],
+            "classification_labels": ["no", "yes"],
+            "tree_structure": [
+                {"node_index": 0, "split_feature": 0, "threshold": 0.5,
+                 "left_child": 1, "right_child": 2},
+                {"node_index": 1, "leaf_value": [4.0, 0.0]},
+                {"node_index": 2, "leaf_value": [0.0, 4.0]}]}}}}
+    req(api, "PUT", "/_ml/trained_models/clf", clf)
+    st, r = req(api, "POST", "/_ml/trained_models/clf/_infer",
+                {"docs": [{"x": 0.0}, {"x": 1.0}]})
+    out = r["inference_results"]
+    assert out[0]["predicted_value"] == "no"
+    assert out[1]["predicted_value"] == "yes"
+    assert out[1]["top_classes"][0]["class_probability"] > 0.9
+
+
+def test_one_hot_preprocessor(api):
+    model = {
+        "inference_config": {"regression": {}},
+        "definition": {
+            "preprocessors": [{"one_hot_encoding": {
+                "field": "color",
+                "hot_map": {"red": "color_red"}}}],
+            "trained_model": {"tree": {
+                "feature_names": ["color_red"],
+                "tree_structure": [
+                    {"node_index": 0, "split_feature": 0,
+                     "threshold": 0.5, "left_child": 1,
+                     "right_child": 2},
+                    {"node_index": 1, "leaf_value": 1.0},
+                    {"node_index": 2, "leaf_value": 2.0}]}}}}
+    req(api, "PUT", "/_ml/trained_models/pp", model)
+    st, r = req(api, "POST", "/_ml/trained_models/pp/_infer",
+                {"docs": [{"color": "red"}, {"color": "blue"}]})
+    vals = [d["predicted_value"] for d in r["inference_results"]]
+    assert vals == [2.0, 1.0]
+
+
+def test_inference_ingest_processor(api):
+    req(api, "PUT", "/_ml/trained_models/m1", TREE_MODEL)
+    st, r = req(api, "PUT", "/_ingest/pipeline/scorer",
+                {"processors": [{"inference": {
+                    "model_id": "m1",
+                    "target_field": "ml"}}]})
+    assert st == 200
+    st, r = req(api, "PUT", "/docs/_doc/1",
+                {"x": 9.0, "y": 9.0}, query="pipeline=scorer")
+    assert st == 201
+    st, r = req(api, "GET", "/docs/_doc/1")
+    assert r["_source"]["ml"]["predicted_value"] == 30.0
+    assert r["_source"]["ml"]["model_id"] == "m1"
+
+
+# -- dataframe analytics ---------------------------------------------------
+
+def _index_cluster(api, index):
+    """Two tight clusters + one far outlier."""
+    docs = []
+    for i in range(20):
+        docs.append({"a": 1.0 + (i % 5) * 0.01, "b": 2.0})
+    for i in range(20):
+        docs.append({"a": 8.0 + (i % 5) * 0.01, "b": 9.0})
+    docs.append({"a": 100.0, "b": -50.0})
+    for i, d in enumerate(docs):
+        req(api, "PUT", f"/{index}/_doc/{i}", d)
+    req(api, "POST", f"/{index}/_refresh")
+    return len(docs) - 1  # outlier id
+
+
+def test_outlier_detection(api):
+    outlier_id = _index_cluster(api, "points")
+    st, r = req(api, "PUT", "/_ml/data_frame/analytics/od",
+                {"source": {"index": "points"},
+                 "dest": {"index": "points_out"},
+                 "analysis": {"outlier_detection": {}}})
+    assert st == 200
+    st, r = req(api, "POST", "/_ml/data_frame/analytics/od/_start")
+    assert st == 200
+    st, r = req(api, "POST", "/points_out/_search",
+                {"size": 50, "sort": [
+                    {"ml.outlier_score": "desc"}]})
+    hits = r["hits"]["hits"]
+    assert len(hits) == 41
+    assert hits[0]["_id"] == str(outlier_id)
+    assert hits[0]["_source"]["ml"]["outlier_score"] > 0.9
+    assert hits[-1]["_source"]["ml"]["outlier_score"] < 0.5
+    st, r = req(api, "GET", "/_ml/data_frame/analytics/od/_stats")
+    assert r["data_frame_analytics"][0]["progress"][-1][
+        "progress_percent"] == 100
+
+
+def test_regression_analytics(api):
+    for i in range(40):
+        x = float(i)
+        req(api, "PUT", f"/reg/_doc/{i}",
+            {"x": x, "noise": (i % 7) * 0.01, "target": 3.0 * x + 7.0})
+    # unlabeled row gets a prediction but is_training false
+    req(api, "PUT", "/reg/_doc/100", {"x": 50.0, "noise": 0.0})
+    req(api, "POST", "/reg/_refresh")
+    req(api, "PUT", "/_ml/data_frame/analytics/rg",
+        {"source": {"index": "reg"}, "dest": {"index": "reg_out"},
+         "analysis": {"regression": {"dependent_variable": "target"}}})
+    st, r = req(api, "POST", "/_ml/data_frame/analytics/rg/_start")
+    assert st == 200
+    st, r = req(api, "GET", "/reg_out/_doc/100")
+    ml = r["_source"]["ml"]
+    assert ml["is_training"] is False
+    assert abs(ml["target_prediction"] - 157.0) < 1.0
+    st, r = req(api, "GET", "/reg_out/_doc/10")
+    assert abs(r["_source"]["ml"]["target_prediction"] - 37.0) < 0.5
+
+
+def test_classification_analytics(api):
+    for i in range(30):
+        req(api, "PUT", f"/clf/_doc/a{i}",
+            {"f": -2.0 - (i % 5) * 0.1, "label": "neg"})
+        req(api, "PUT", f"/clf/_doc/b{i}",
+            {"f": 2.0 + (i % 5) * 0.1, "label": "pos"})
+    req(api, "PUT", "/clf/_doc/q", {"f": 3.0})
+    req(api, "POST", "/clf/_refresh")
+    req(api, "PUT", "/_ml/data_frame/analytics/cl",
+        {"source": {"index": "clf"}, "dest": {"index": "clf_out"},
+         "analysis": {"classification": {"dependent_variable": "label"}}})
+    st, r = req(api, "POST", "/_ml/data_frame/analytics/cl/_start")
+    assert st == 200
+    st, r = req(api, "GET", "/clf_out/_doc/q")
+    ml = r["_source"]["ml"]
+    assert ml["label_prediction"] == "pos"
+    assert ml["prediction_probability"] > 0.8
+    assert ml["is_training"] is False
+
+
+def test_analytics_explain_and_validation(api):
+    st, r = req(api, "PUT", "/_ml/data_frame/analytics/bad",
+                {"source": {"index": "x"}, "dest": {"index": "y"},
+                 "analysis": {"nope": {}}})
+    assert st == 400
+    _index_cluster(api, "pts2")
+    st, r = req(api, "POST", "/_ml/data_frame/analytics/_explain",
+                {"source": {"index": "pts2"},
+                 "analysis": {"outlier_detection": {}}})
+    assert st == 200
+    names = {f["name"] for f in r["field_selection"]}
+    assert names == {"a", "b"}
+
+
+# -- calendars / filters / info -------------------------------------------
+
+def test_calendars_filters_info(api):
+    st, r = req(api, "PUT", "/_ml/calendars/hols", {"job_ids": ["j1"]})
+    assert r["calendar_id"] == "hols"
+    st, r = req(api, "POST", "/_ml/calendars/hols/events",
+                {"events": [{"description": "xmas",
+                             "start_time": 0, "end_time": 1}]})
+    assert len(r["events"]) == 1
+    st, r = req(api, "GET", "/_ml/calendars/hols/events")
+    assert r["count"] == 1
+    st, r = req(api, "PUT", "/_ml/filters/safe",
+                {"items": ["b.com", "a.com"]})
+    assert r["items"] == ["a.com", "b.com"]
+    st, r = req(api, "GET", "/_ml/filters")
+    assert r["count"] == 1
+    st, r = req(api, "GET", "/_ml/info")
+    assert "defaults" in r and r["upgrade_mode"] is False
+    st, r = req(api, "POST", "/_ml/set_upgrade_mode",
+                query="enabled=true")
+    assert req(api, "GET", "/_ml/info")[1]["upgrade_mode"] is True
+    req(api, "POST", "/_ml/set_upgrade_mode", query="enabled=false")
