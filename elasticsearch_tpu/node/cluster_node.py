@@ -234,8 +234,14 @@ class ClusterNode:
 
         async def handler(method, path, query, body, headers=None):
             loop = asyncio.get_running_loop()
+            # copy_context so context-bound request state (the
+            # deprecation-warning accumulator) follows the request onto
+            # the worker thread
+            import contextvars
+            ctx = contextvars.copy_context()
             return await loop.run_in_executor(
-                self._http_pool, lambda: self.rest.handle(
+                self._http_pool, lambda: ctx.run(
+                    self.rest.handle,
                     method, path, query, body, headers=headers))
 
         self.http = HttpServer(handler, host=host, port=port,

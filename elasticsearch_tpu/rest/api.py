@@ -469,6 +469,37 @@ class RestAPI:
             self.h_delete_enrich_policy)
         add("PUT,POST", "/_enrich/policy/{name}/_execute",
             self.h_execute_enrich_policy)
+        # slm (x-pack snapshot lifecycle management)
+        add("GET", "/_slm/policy", self.h_slm_get_policy)
+        add("GET", "/_slm/stats", self.h_slm_stats)
+        add("GET", "/_slm/status", self.h_slm_status)
+        add("POST", "/_slm/start", self.h_slm_start)
+        add("POST", "/_slm/stop", self.h_slm_stop)
+        add("POST", "/_slm/_execute_retention", self.h_slm_retention)
+        add("POST", "/_slm/_tick", self.h_slm_tick)
+        add("PUT", "/_slm/policy/{policy_id}", self.h_slm_put_policy)
+        add("GET", "/_slm/policy/{policy_id}", self.h_slm_get_policy)
+        add("DELETE", "/_slm/policy/{policy_id}", self.h_slm_del_policy)
+        add("PUT,POST", "/_slm/policy/{policy_id}/_execute",
+            self.h_slm_execute)
+        # license + /_xpack (x-pack/plugin/core license/)
+        add("GET", "/_license", self.h_get_license)
+        add("PUT,POST", "/_license", self.h_put_license)
+        add("DELETE", "/_license", self.h_delete_license)
+        add("POST", "/_license/start_trial", self.h_start_trial)
+        add("POST", "/_license/start_basic", self.h_start_basic)
+        add("GET", "/_license/trial_status", self.h_trial_status)
+        add("GET", "/_license/basic_status", self.h_basic_status)
+        add("GET", "/_xpack", self.h_xpack_info)
+        add("GET", "/_xpack/usage", self.h_xpack_usage)
+        # deprecation checkup (x-pack/plugin/deprecation)
+        add("GET", "/_migration/deprecations", self.h_deprecations)
+        add("GET", "/{index}/_migration/deprecations",
+            self.h_deprecations)
+        # monitoring (x-pack/plugin/monitoring)
+        add("POST,PUT", "/_monitoring/bulk", self.h_monitoring_bulk)
+        add("POST", "/_monitoring/_collect", self.h_monitoring_collect)
+        add("POST", "/_monitoring/_tick", self.h_monitoring_tick)
         add("GET,POST", "/_sql", self.h_sql)
         add("POST", "/_sql/translate", self.h_sql_translate)
         add("POST", "/_sql/close", self.h_sql_close)
@@ -728,6 +759,12 @@ class RestAPI:
             except Exception as e:   # noqa: BLE001 — 401 as ES error body
                 status, payload = _error_payload(e)
                 return status, JSON_CT, json.dumps(payload).encode()
+        if not getattr(self._internal_tls, "active", False):
+            # fresh warning scope per EXTERNAL request only — internal
+            # re-dispatches (SQL/transform/ML seams) keep accumulating
+            # into the outer request's scope
+            from ..xpack.deprecation import begin_request
+            begin_request()
         params = {k: v[-1] for k, v in
                   parse_qs(query, keep_blank_values=True).items()}
         if query:
@@ -3170,6 +3207,198 @@ class RestAPI:
         return self.ml.set_upgrade_mode(
             params.get("enabled", "false") == "true")
 
+    # ------------------------------------------------------------------
+    # SLM (x-pack snapshot lifecycle — xpack/slm.py)
+    # ------------------------------------------------------------------
+
+    @property
+    def slm(self):
+        if getattr(self, "_slm_svc", None) is None:
+            from ..xpack.slm import SlmService
+
+            def create(repo, name, config):
+                return self._create_snapshot_from_config(
+                    repo, name, config)
+
+            def list_snaps(repo):
+                return [self._snapshot_info(m, repository=repo)
+                        for m in self.snapshots.get(repo, "_all")]
+
+            self._slm_svc = SlmService(
+                create,
+                lambda repo, name: self.snapshots.delete(repo, name),
+                list_snaps)
+        return self._slm_svc
+
+    def h_slm_put_policy(self, params, body, policy_id):
+        return self.slm.put_policy(policy_id, _json_body(body))
+
+    def h_slm_get_policy(self, params, body, policy_id=None):
+        return self.slm.get_policies(policy_id)
+
+    def h_slm_del_policy(self, params, body, policy_id):
+        return self.slm.delete_policy(policy_id)
+
+    def h_slm_execute(self, params, body, policy_id):
+        return self.slm.execute_policy(policy_id)
+
+    def h_slm_retention(self, params, body):
+        self.slm.execute_retention()
+        return {"acknowledged": True}
+
+    def h_slm_tick(self, params, body):
+        """Injectable-clock scheduler seam, like ``/_ilm/_tick`` and
+        ``/_watcher/_tick`` — the cluster tier (or an operator cron)
+        drives scheduled policies through here."""
+        now = int(params["now"]) if params.get("now") else None
+        return {"executed": self.slm.tick(now)}
+
+    def h_slm_stats(self, params, body):
+        return self.slm.get_stats()
+
+    def h_slm_status(self, params, body):
+        return self.slm.status()
+
+    def h_slm_start(self, params, body):
+        return self.slm.start()
+
+    def h_slm_stop(self, params, body):
+        return self.slm.stop()
+
+    # ------------------------------------------------------------------
+    # license + /_xpack (xpack/license.py)
+    # ------------------------------------------------------------------
+
+    @property
+    def license(self):
+        if getattr(self, "_license_svc", None) is None:
+            from ..xpack.license import LicenseService
+            self._license_svc = LicenseService(self.node_id)
+        return self._license_svc
+
+    def h_get_license(self, params, body):
+        return self.license.get_license()
+
+    def h_put_license(self, params, body):
+        return self.license.put_license(
+            _json_body(body), params.get("acknowledge") == "true")
+
+    def h_delete_license(self, params, body):
+        return self.license.delete_license()
+
+    def h_start_trial(self, params, body):
+        return self.license.start_trial(
+            params.get("acknowledge") == "true")
+
+    def h_start_basic(self, params, body):
+        return self.license.start_basic(
+            params.get("acknowledge") == "true")
+
+    def h_trial_status(self, params, body):
+        return self.license.trial_status()
+
+    def h_basic_status(self, params, body):
+        return self.license.basic_status()
+
+    def h_xpack_info(self, params, body):
+        return self.license.xpack_info()
+
+    def h_xpack_usage(self, params, body):
+        """Per-feature usage counts (``XPackUsageAction``) — live
+        numbers from each lazily-built service (zeroes before use)."""
+        ml = getattr(self, "_ml_svc", None)
+        transform = getattr(self, "_transform_svc", None)
+        watcher = getattr(self, "_watcher_svc", None)
+        slm = getattr(self, "_slm_svc", None)
+        return {
+            "security": {"available": True,
+                         "enabled": self.security.enabled},
+            "ml": {"available": True, "enabled": True,
+                   "jobs": {"_all": {"count":
+                            len(ml.jobs) if ml else 0}},
+                   "data_frame_analytics_jobs": {
+                       "_all": {"count":
+                                len(ml.analytics) if ml else 0}},
+                   "inference": {"trained_models": {
+                       "_all": {"count": len(ml.models) if ml else 0}}}},
+            "transform": {"available": True, "enabled": True},
+            "watcher": {"available": True, "enabled": True,
+                        "count": {"total":
+                                  len(watcher.watches)
+                                  if watcher else 0}},
+            "slm": {"available": True, "enabled": True,
+                    "policy_count": len(slm.policies) if slm else 0},
+            "ilm": {"policy_count": len(self.ilm.policies)},
+            "sql": {"available": True, "enabled": True},
+            "eql": {"available": True, "enabled": True},
+            "rollup": {"available": True, "enabled": True},
+            "ccr": {"available": True, "enabled": True},
+            "graph": {"available": True, "enabled": True},
+            "enrich": {"available": True, "enabled": True},
+            "monitoring": {"available": True, "enabled": True},
+            "data_streams": {"available": True, "enabled": True},
+            "voting_only": {"available": True, "enabled": True},
+        }
+
+    # ------------------------------------------------------------------
+    # deprecation + monitoring (xpack/{deprecation,monitoring}.py)
+    # ------------------------------------------------------------------
+
+    def h_deprecations(self, params, body, index=None):
+        from ..node.indices_service import _flatten_settings
+        from ..xpack.deprecation import deprecation_info
+
+        def indices_settings():
+            names = self.indices.resolve(index or "_all")
+            out = {}
+            for n in names:
+                try:
+                    out[n] = _flatten_settings(
+                        dict(self.indices.get(n).settings or {}))
+                except Exception:   # noqa: BLE001 — index vanished
+                    continue
+            return out
+
+        return deprecation_info(
+            indices_settings,
+            lambda: {},
+            lambda: sorted(getattr(self, "_legacy_template_names",
+                                   set())))
+
+    @property
+    def monitoring(self):
+        if getattr(self, "_monitoring_svc", None) is None:
+            from ..xpack.monitoring import MonitoringService
+
+            def fetch(method, path):
+                prev = getattr(self._internal_tls, "active", False)
+                self._internal_tls.active = True
+                try:
+                    st, _ct, out = self.handle(method, path, "", b"")
+                finally:
+                    self._internal_tls.active = prev
+                return json.loads(out)
+
+            self._monitoring_svc = MonitoringService(
+                fetch,
+                lambda i, lines: self.internal_bulk(i, lines,
+                                                    refresh=True),
+                cluster_uuid=self.node_id)
+        return self._monitoring_svc
+
+    def h_monitoring_bulk(self, params, body):
+        return self.monitoring.bulk(
+            params.get("system_id", ""),
+            params.get("interval", ""), body)
+
+    def h_monitoring_collect(self, params, body):
+        n = self.monitoring.collect()
+        return {"collected": n}
+
+    def h_monitoring_tick(self, params, body):
+        now = int(params["now"]) if params.get("now") else None
+        return {"collected": bool(self.monitoring.tick(now))}
+
     @property
     def enrich(self):
         if getattr(self, "_enrich_svc", None) is None:
@@ -3757,7 +3986,15 @@ class RestAPI:
         if params.get("create") in ("true", "") and name in self.templates:
             raise IllegalArgumentError(
                 f"index_template [{name}] already exists")
-        return self.h_put_template(params, body, name)
+        from ..xpack.deprecation import warn
+        warn("legacy_template",
+             "Legacy index templates are deprecated in favor of "
+             "composable templates.")
+        result = self.h_put_template(params, body, name)
+        if not hasattr(self, "_legacy_template_names"):
+            self._legacy_template_names = set()
+        self._legacy_template_names.add(name)
+        return result
 
     def h_get_template_legacy(self, params, body, name=None):
         import fnmatch
@@ -3950,6 +4187,7 @@ class RestAPI:
             return 404, {"error": f"index template [{name}] missing",
                          "status": 404}
         del self.templates[name]
+        getattr(self, "_legacy_template_names", set()).discard(name)
         return {"acknowledged": True}
 
     # ------------------------------------------------------------------
@@ -4644,13 +4882,19 @@ class RestAPI:
             info["metadata"] = meta["metadata"]
         return info
 
+    def _create_snapshot_from_config(self, repo: str, snap: str,
+                                     config: dict) -> dict:
+        """Single marshalling point for snapshot-create config (used by
+        the REST handler AND the SLM executor, so they can't diverge)."""
+        return self.snapshots.create(
+            repo, snap, config.get("indices"),
+            include_global_state=config.get("include_global_state", True),
+            ignore_unavailable=bool(config.get("ignore_unavailable")),
+            metadata=config.get("metadata"))
+
     def h_create_snapshot(self, params, body, repo, snap):
         payload = _json_body(body) if body else {}
-        meta = self.snapshots.create(
-            repo, snap, payload.get("indices"),
-            include_global_state=payload.get("include_global_state", True),
-            ignore_unavailable=bool(payload.get("ignore_unavailable")),
-            metadata=payload.get("metadata"))
+        meta = self._create_snapshot_from_config(repo, snap, payload)
         if params.get("wait_for_completion") in ("true", ""):
             return {"snapshot": self._snapshot_info(meta,
                                                     repository=repo)}

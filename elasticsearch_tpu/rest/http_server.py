@@ -75,6 +75,11 @@ class HttpServer:
                     break
                 method, target, headers, body = request
                 path, _, query = target.partition("?")
+                # bind the deprecation-warning container in THIS task's
+                # context before dispatch so a handler running on an
+                # executor thread (cluster mode) shares it
+                from ..xpack.deprecation import begin_request
+                begin_request()
                 try:
                     status, ctype, payload = await self._dispatch(
                         method, path, query, body, headers)
@@ -88,11 +93,17 @@ class HttpServer:
                             "type": "exception",
                             "reason": str(e)}, "status": 500}).encode()
                 keep_alive = headers.get("connection", "").lower() != "close"
+                # RFC-7234 299 deprecation warnings accumulated by the
+                # handler (HeaderWarning analog — xpack/deprecation.py)
+                from ..xpack.deprecation import drain_warnings
+                warn_lines = "".join(f"Warning: {w}\r\n"
+                                     for w in drain_warnings())
                 head = (f"HTTP/1.1 {status} "
                         f"{_STATUS_TEXT.get(status, 'Unknown')}\r\n"
                         f"content-type: {ctype}\r\n"
                         f"content-length: {len(payload)}\r\n"
                         f"X-elastic-product: Elasticsearch\r\n"
+                        + warn_lines +
                         f"connection: "
                         f"{'keep-alive' if keep_alive else 'close'}\r\n\r\n")
                 writer.write(head.encode() + (b"" if method == "HEAD"

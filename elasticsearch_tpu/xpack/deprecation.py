@@ -1,0 +1,133 @@
+"""Deprecation warnings: per-response ``Warning`` headers, a deprecation
+log, and the ``/_migration/deprecations`` checkup API.
+
+Reference: ``server/.../common/logging/DeprecationLogger.java`` (emits
+RFC-7234 ``299`` warn-code response headers through the thread-local
+``HeaderWarning`` and writes rate-limited deprecation log entries) +
+``x-pack/plugin/deprecation/.../DeprecationInfoAction.java`` (runs a
+checklist of cluster/node/index checks and buckets the findings).
+
+The thread-local response-header channel is the same design: handlers
+call ``warn()`` anywhere below the dispatcher; the HTTP layer drains the
+accumulated warnings into ``Warning:`` headers after the handler
+returns.  Each (key) is emitted once per request and once per process
+into the in-memory log ring, mirroring the reference's deduplication.
+"""
+from __future__ import annotations
+
+import contextvars
+import time
+from typing import Callable, Dict, List, Optional
+
+_WARN_PREFIX = '299 Elasticsearch-8.0.0-tpu "'
+
+#: Per-request accumulator.  A ContextVar holding a MUTABLE container:
+#: the HTTP layer binds a fresh container before dispatch, and because
+#: handlers may run on a worker thread (cluster mode dispatches through
+#: an executor with ``contextvars.copy_context()``), warn() mutates the
+#: shared container instead of rebinding the var — mutations are visible
+#: to the draining side regardless of which thread the handler ran on.
+_accum: contextvars.ContextVar = contextvars.ContextVar(
+    "deprecation_accum")
+
+#: process-wide deprecation log ring (the reference writes to the
+#: ``_deprecation.json`` log file; bounded so it can't grow unbounded)
+_LOG: List[dict] = []
+_LOG_KEYS: set = set()
+_LOG_MAX = 1000
+
+
+def _container() -> dict:
+    try:
+        return _accum.get()
+    except LookupError:
+        c = {"msgs": [], "keys": set()}
+        _accum.set(c)
+        return c
+
+
+def begin_request() -> None:
+    """Reset the per-request warning accumulator (dispatcher calls this
+    at entry; ``HeaderWarning.setThreadContext`` analog).  Clears the
+    bound container IN PLACE so a container bound by an outer layer
+    (the HTTP connection task) stays shared with it."""
+    c = _container()
+    c["msgs"].clear()
+    c["keys"].clear()
+
+
+def warn(key: str, message: str) -> None:
+    """Record a deprecation: once per request in the response headers,
+    once per process in the log."""
+    c = _container()
+    if key not in c["keys"]:
+        c["keys"].add(key)
+        c["msgs"].append(message)
+    if key not in _LOG_KEYS and len(_LOG) < _LOG_MAX:
+        _LOG_KEYS.add(key)
+        _LOG.append({"key": key, "message": message,
+                     "@timestamp": int(time.time() * 1000)})
+
+
+def drain_warnings() -> List[str]:
+    """Formatted ``Warning`` header values accumulated this request."""
+    c = _container()
+    out = [f'{_WARN_PREFIX}{m}"' for m in c["msgs"]]
+    c["msgs"].clear()
+    c["keys"].clear()
+    return out
+
+
+def deprecation_log() -> List[dict]:
+    return list(_LOG)
+
+
+# ---------------------------------------------------------------------------
+# /_migration/deprecations checks
+# ---------------------------------------------------------------------------
+
+def deprecation_info(get_indices: Callable[[], Dict[str, dict]],
+                     get_cluster_settings: Callable[[], dict],
+                     legacy_templates: Callable[[], List[str]]) -> dict:
+    """Run the checkup list (``DeprecationChecks.java``): each check
+    returns issues shaped ``{level, message, url, details}``."""
+    cluster_issues: List[dict] = []
+    index_issues: Dict[str, List[dict]] = {}
+
+    tmpl = legacy_templates()
+    if tmpl:
+        cluster_issues.append({
+            "level": "warning",
+            "message": "Legacy index templates are deprecated in favor "
+                       "of composable templates.",
+            "url": "https://ela.st/es-deprecation-7-legacy-index-"
+                   "templates",
+            "details": f"Legacy index templates {sorted(tmpl)} are in "
+                       f"use."})
+
+    for name, settings in get_indices().items():
+        issues = []
+        if str(settings.get("index.soft_deletes.enabled")) == "false":
+            issues.append({
+                "level": "warning",
+                "message": "Setting [index.soft_deletes.enabled] to "
+                           "[false] is deprecated.",
+                "url": "https://ela.st/es-deprecation-7-soft-deletes",
+                "details": "soft deletes cannot be disabled in 8.0"})
+        shards = settings.get("index.number_of_shards")
+        try:
+            if shards is not None and int(shards) > 1024:
+                issues.append({
+                    "level": "critical",
+                    "message": "Number of shards is too large.",
+                    "url": "https://ela.st/es-max-shards",
+                    "details": f"index has {shards} shards"})
+        except (TypeError, ValueError):
+            pass
+        if issues:
+            index_issues[name] = issues
+
+    return {"cluster_settings": cluster_issues,
+            "node_settings": [],
+            "index_settings": index_issues,
+            "ml_settings": []}

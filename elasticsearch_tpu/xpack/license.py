@@ -1,0 +1,178 @@
+"""Licensing + the ``/_xpack`` info/usage surface.
+
+Reference: ``x-pack/plugin/core/.../license/LicenseService.java`` (state
+machine over basic/trial/gold/platinum licenses, trial-once semantics),
+``rest/action/XPackInfoAction`` and ``XPackUsageAction``.  The licensing
+model here is the observable subset: a self-generated basic license by
+default, one 30-day trial upgrade, explicit license PUT, and the feature
+availability matrix the ``/_xpack`` endpoints render — actual feature
+gating stays off (everything is enabled) exactly like the reference's
+default basic-with-everything-OSS posture in tests.
+"""
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Dict, Optional
+
+from ..common.errors import IllegalArgumentError
+
+_TRIAL_DAYS = 30
+
+#: feature → minimum license level that enables it (reference:
+#: ``XPackLicenseState.java`` feature checks)
+FEATURES = {
+    "security": "basic", "monitoring": "basic", "rollup": "basic",
+    "ilm": "basic", "slm": "basic", "transform": "basic",
+    "data_streams": "basic", "eql": "basic", "sql": "basic",
+    "frozen_indices": "basic", "vectors": "basic",
+    "analytics": "basic", "searchable_snapshots": "enterprise",
+    "ml": "platinum", "graph": "platinum", "watcher": "gold",
+    "ccr": "platinum", "enrich": "basic", "spatial": "basic",
+    "logstash": "gold", "voting_only": "basic", "aggregate_metric":
+    "basic", "autoscaling": "enterprise", "data_tiers": "basic",
+}
+
+_LEVELS = ["basic", "standard", "gold", "platinum", "enterprise",
+           "trial"]
+
+
+def _now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+class LicenseService:
+    def __init__(self, cluster_uuid: str = "cluster"):
+        self.cluster_uuid = cluster_uuid
+        self.trial_used = False
+        self.license = self._self_generated("basic")
+
+    def _self_generated(self, ltype: str) -> dict:
+        now = _now_ms()
+        uid = hashlib.sha1(
+            f"{self.cluster_uuid}:{ltype}:{now}".encode()).hexdigest()
+        lic = {"status": "active", "uid": uid, "type": ltype,
+               "issue_date_in_millis": now,
+               "issued_to": self.cluster_uuid,
+               "issuer": "elasticsearch",
+               "start_date_in_millis": now,
+               "max_nodes": 1000}
+        if ltype == "trial":
+            lic["expiry_date_in_millis"] = \
+                now + _TRIAL_DAYS * 86_400_000
+        return lic
+
+    def _level(self) -> str:
+        lic = self.license
+        if lic is None or lic["status"] != "active":
+            return "none"
+        t = lic["type"]
+        # an active trial unlocks everything, like the reference
+        return "enterprise" if t == "trial" else t
+
+    def feature_active(self, feature: str) -> bool:
+        need = FEATURES.get(feature, "basic")
+        level = self._level()
+        if level == "none":
+            return False
+        return _LEVELS.index(level if level in _LEVELS else "basic") >= \
+            _LEVELS.index(need if need in _LEVELS else "basic")
+
+    # -- REST ------------------------------------------------------------
+    def get_license(self) -> dict:
+        if self.license is None:
+            from ..common.errors import ResourceNotFoundError
+            raise ResourceNotFoundError("no license is installed")
+        out = dict(self.license)
+        out["issue_date"] = _iso(out["issue_date_in_millis"])
+        if "expiry_date_in_millis" in out:
+            out["expiry_date"] = _iso(out["expiry_date_in_millis"])
+        return {"license": out}
+
+    def put_license(self, body: dict, acknowledge: bool) -> dict:
+        licenses = body.get("licenses") or \
+            ([body["license"]] if body.get("license") else [])
+        if not licenses:
+            raise IllegalArgumentError(
+                "The license must be provided in the request body")
+        lic = licenses[0]
+        ltype = lic.get("type", "basic")
+        if ltype not in _LEVELS:
+            raise IllegalArgumentError(
+                f"unknown license type [{ltype}]")
+        if not acknowledge and ltype != (self.license or {}).get("type"):
+            return {"acknowledged": False,
+                    "license_status": "valid",
+                    "acknowledge": {
+                        "message": "This license update requires "
+                                   "acknowledgement. To acknowledge the "
+                                   "license, please read the following "
+                                   "messages and update the license "
+                                   "again, this time with the "
+                                   "\"acknowledge=true\" parameter:"}}
+        self.license = dict(self._self_generated(ltype), **{
+            k: v for k, v in lic.items() if k in
+            ("uid", "issued_to", "issuer", "expiry_date_in_millis",
+             "max_nodes", "type")})
+        return {"acknowledged": True, "license_status": "valid"}
+
+    def delete_license(self) -> dict:
+        self.license = None
+        return {"acknowledged": True}
+
+    def start_trial(self, acknowledge: bool) -> dict:
+        if self.trial_used:
+            return {"acknowledged": True, "trial_was_started": False,
+                    "error_message": "Operation failed: Trial was "
+                                     "already activated."}
+        if not acknowledge:
+            return {"acknowledged": False, "trial_was_started": False,
+                    "error_message": "Operation failed: Needs "
+                                     "acknowledgement."}
+        self.trial_used = True
+        self.license = self._self_generated("trial")
+        return {"acknowledged": True, "trial_was_started": True,
+                "type": "trial"}
+
+    def start_basic(self, acknowledge: bool) -> dict:
+        if self.license is not None and \
+                self.license.get("type") == "basic":
+            return {"acknowledged": True, "basic_was_started": False,
+                    "error_message": "Operation failed: Current license "
+                                     "is basic."}
+        if not acknowledge and self.license is not None:
+            return {"acknowledged": False, "basic_was_started": False,
+                    "error_message": "Operation failed: Needs "
+                                     "acknowledgement."}
+        self.license = self._self_generated("basic")
+        return {"acknowledged": True, "basic_was_started": True}
+
+    def trial_status(self) -> dict:
+        return {"eligible_to_start_trial": not self.trial_used}
+
+    def basic_status(self) -> dict:
+        eligible = self.license is None or \
+            self.license.get("type") != "basic"
+        return {"eligible_to_start_basic": eligible}
+
+    # -- /_xpack ---------------------------------------------------------
+    def xpack_info(self, build_hash: str = "tpu-native") -> dict:
+        lic = self.license or {}
+        features: Dict[str, dict] = {}
+        for feat in sorted(FEATURES):
+            features[feat] = {"available": self.feature_active(feat),
+                              "enabled": True}
+        return {
+            "build": {"hash": build_hash, "date": _iso(_now_ms())},
+            "license": {"uid": lic.get("uid"),
+                        "type": lic.get("type"),
+                        "mode": lic.get("type"),
+                        "status": lic.get("status", "invalid")},
+            "features": features,
+            "tagline": "You know, for X"}
+
+
+def _iso(ms: int) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S",
+                         time.gmtime(ms / 1000)) + \
+        f".{ms % 1000:03d}Z"

@@ -1,0 +1,140 @@
+"""Monitoring: self-metrics collectors exporting into local
+``.monitoring-es-*`` indices + the ``/_monitoring/bulk`` intake.
+
+Reference: ``x-pack/plugin/monitoring/`` — ``Collector`` subclasses
+(cluster stats, node stats, index stats, shards) sample the running
+node on an interval and the ``LocalExporter`` bulk-indexes the sampled
+documents into ``.monitoring-es-7-<date>``; external agents (beats,
+kibana) push through ``/_monitoring/bulk``.
+
+Collection here rides the same internal REST seam as transform/rollup:
+each collector issues the ordinary stats API call and wraps the response
+in the reference's document envelope (``cluster_uuid``, ``timestamp``,
+``type``), so the monitoring index is queryable with the standard DSL
+the way Kibana's monitoring app expects.  The interval runs on the
+injectable ``tick(now_ms)`` shared by ILM/SLM/watcher.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List, Optional
+
+
+def _now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+def _index_for(ms: int) -> str:
+    return ".monitoring-es-8-" + time.strftime("%Y.%m.%d",
+                                               time.gmtime(ms / 1000))
+
+
+class MonitoringService:
+    """``fetch(method, path) -> dict`` runs an internal REST call;
+    ``bulk_fn(index, lines)`` writes export batches."""
+
+    DEFAULT_INTERVAL_MS = 10_000
+
+    def __init__(self, fetch: Callable[[str, str], dict],
+                 bulk_fn: Callable[[str, List[dict]], dict],
+                 cluster_uuid: str = "cluster"):
+        self.fetch = fetch
+        self.bulk_fn = bulk_fn
+        self.cluster_uuid = cluster_uuid
+        self.enabled = True
+        self.interval_ms = self.DEFAULT_INTERVAL_MS
+        self._next_due: Optional[int] = None
+        self.collected_count = 0
+
+    # -- collectors ------------------------------------------------------
+    def collect(self, now_ms: Optional[int] = None) -> int:
+        """One collection round: cluster stats, node stats, index stats
+        → one bulk into today's monitoring index.  Returns doc count."""
+        now = now_ms if now_ms is not None else _now_ms()
+        ts = now
+        docs: List[dict] = []
+
+        cluster = self.fetch("GET", "/_cluster/stats")
+        docs.append({"type": "cluster_stats",
+                     "cluster_stats": {
+                         "indices": cluster.get("indices"),
+                         "nodes": cluster.get("nodes")},
+                     "cluster_state": {
+                         "status": cluster.get("status"),
+                         "cluster_uuid": self.cluster_uuid}})
+
+        nodes = self.fetch("GET", "/_nodes/stats")
+        for node_id, nstats in (nodes.get("nodes") or {}).items():
+            docs.append({"type": "node_stats",
+                         "node_stats": {
+                             "node_id": node_id,
+                             "indices": nstats.get("indices"),
+                             "jvm": nstats.get("jvm"),
+                             "process": nstats.get("process"),
+                             "thread_pool": nstats.get("thread_pool")}})
+
+        stats = self.fetch("GET", "/_stats")
+        for index, istats in (stats.get("indices") or {}).items():
+            if index.startswith(".monitoring-"):
+                continue
+            docs.append({"type": "index_stats",
+                         "index_stats": {
+                             "index": index,
+                             "primaries": istats.get("primaries"),
+                             "total": istats.get("total")}})
+
+        lines: List[dict] = []
+        for d in docs:
+            d["cluster_uuid"] = self.cluster_uuid
+            d["timestamp"] = ts
+            lines.append({"index": {}})
+            lines.append(d)
+        if lines:
+            self.bulk_fn(_index_for(now), lines)
+        self.collected_count += len(docs)
+        return len(docs)
+
+    def tick(self, now_ms: Optional[int] = None) -> bool:
+        if not self.enabled:
+            return False
+        now = now_ms if now_ms is not None else _now_ms()
+        if self._next_due is None:
+            self._next_due = now + self.interval_ms
+            return False
+        if now < self._next_due:
+            return False
+        self._next_due = now + self.interval_ms
+        self.collect(now)
+        return True
+
+    # -- /_monitoring/bulk ----------------------------------------------
+    def bulk(self, system_id: str, interval: str,
+             payload: bytes) -> dict:
+        """External intake: NDJSON of {index meta}\\n{doc} pairs, each
+        doc wrapped in the envelope and routed to the monitoring index
+        (``RestMonitoringBulkAction.java``)."""
+        now = _now_ms()
+        lines: List[dict] = []
+        meta_type = "doc"
+        text = payload.decode() if isinstance(payload,
+                                              (bytes, bytearray)) \
+            else str(payload)
+        for raw in text.splitlines():
+            raw = raw.strip()
+            if not raw:
+                continue
+            doc = json.loads(raw)
+            if "index" in doc and set(doc) == {"index"}:
+                meta_type = (doc["index"] or {}).get("_type", "doc")
+                continue
+            doc = {"type": meta_type, meta_type: doc,
+                   "cluster_uuid": self.cluster_uuid,
+                   "timestamp": now,
+                   "source_node": {"system_id": system_id,
+                                   "interval": interval}}
+            lines.append({"index": {}})
+            lines.append(doc)
+        if lines:
+            self.bulk_fn(_index_for(now), lines)
+        return {"took": 0, "ignored": False, "errors": False}

@@ -1,0 +1,295 @@
+"""Snapshot lifecycle management: scheduled snapshots + retention.
+
+Reference: ``x-pack/plugin/core/src/main/java/org/elasticsearch/xpack/
+core/slm/`` + ``x-pack/plugin/ilm/.../slm/SnapshotLifecycleService.java``
+— policies carry a cron schedule, a name pattern, a repository, snapshot
+config, and a retention block; a scheduler triggers snapshot creation
+and a periodic retention task deletes expired snapshots.
+
+Same collapse as ILM/watcher here: scheduling rides an injectable
+``tick(now_ms)`` instead of a background thread, so tests (and the
+cluster tier, which ticks all services together) drive time explicitly.
+Snapshot naming resolves ``<date-math>`` headers the way
+``IndexNameExpressionResolver`` does for date-math index names.
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common.errors import (IllegalArgumentError,
+                             ResourceNotFoundError)
+
+
+def _now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+def _duration_ms(v: Any) -> int:
+    s = str(v).strip().lower()
+    units = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+             "d": 86_400_000}
+    for suffix in ("ms", "s", "m", "h", "d"):
+        if s.endswith(suffix):
+            num = s[: -len(suffix)]
+            try:
+                return int(float(num) * units[suffix])
+            except ValueError:
+                break
+    raise IllegalArgumentError(
+        f"failed to parse [{v}] as a time value")
+
+
+def _interval_of_schedule(schedule: str) -> int:
+    """Interval in ms from a cron-ish schedule.
+
+    The reference uses full Quartz cron (``slm/SnapshotLifecyclePolicy``);
+    here the supported shapes are the common periodic ones: a plain
+    interval (``30m``/``1d``) or the daily/hourly cron forms
+    (``0 30 1 * * ?`` → daily, ``0 */N * * * ?`` → every N minutes).
+    """
+    schedule = schedule.strip()
+    try:
+        return _duration_ms(schedule)
+    except IllegalArgumentError:
+        pass
+    parts = schedule.split()
+    if len(parts) in (6, 7):
+        m = re.fullmatch(r"\*/(\d+)", parts[1])
+        if m:
+            return int(m.group(1)) * 60_000
+        m = re.fullmatch(r"\*/(\d+)", parts[2])
+        if m:
+            return int(m.group(1)) * 3_600_000
+        if parts[3] in ("*", "?") and parts[1].isdigit():
+            return 86_400_000 if parts[2].isdigit() else 3_600_000
+        return 86_400_000
+    raise IllegalArgumentError(
+        f"invalid schedule [{schedule}]: must be a time value or cron "
+        f"expression")
+
+
+class SlmService:
+    """``create_snapshot(repo, name, config) -> info``,
+    ``delete_snapshot(repo, name)``, ``list_snapshots(repo) -> [info]``
+    are bound to the snapshot layer through the REST seam."""
+
+    def __init__(self,
+                 create_snapshot: Callable[[str, str, dict], dict],
+                 delete_snapshot: Callable[[str, str], None],
+                 list_snapshots: Callable[[str], List[dict]]):
+        self.create_snapshot = create_snapshot
+        self.delete_snapshot = delete_snapshot
+        self.list_snapshots = list_snapshots
+        self.policies: Dict[str, dict] = {}
+        self.running = True
+        self.stats = {"retention_runs": 0, "retention_deleted": 0,
+                      "retention_failed": 0,
+                      "total_snapshots_taken": 0,
+                      "total_snapshots_failed": 0,
+                      "total_snapshots_deleted": 0}
+
+    # -- policy CRUD -----------------------------------------------------
+    def put_policy(self, pid: str, body: dict) -> dict:
+        for req in ("schedule", "name", "repository"):
+            if not body.get(req):
+                raise IllegalArgumentError(f"[{req}] is required")
+        _interval_of_schedule(body["schedule"])  # validate
+        if not str(body["name"]).startswith("<") and \
+                not re.fullmatch(r"[a-z0-9._-]+", str(body["name"])):
+            raise IllegalArgumentError(
+                f"invalid snapshot name [{body['name']}]")
+        existing = self.policies.get(pid)
+        self.policies[pid] = {
+            "policy": dict(body),
+            "version": (existing["version"] + 1) if existing else 1,
+            "modified_date_millis": _now_ms(),
+            "last_success": existing.get("last_success")
+            if existing else None,
+            "last_failure": existing.get("last_failure")
+            if existing else None,
+            "next_due": None,        # resolved lazily on first tick
+        }
+        return {"acknowledged": True}
+
+    def get_policies(self, pid: Optional[str]) -> dict:
+        if pid in (None, "", "*", "_all"):
+            ids = sorted(self.policies)
+        else:
+            missing = [p for p in pid.split(",")
+                       if p not in self.policies]
+            if missing:
+                raise ResourceNotFoundError(
+                    f"snapshot lifecycle policy or policies "
+                    f"{missing} not found")
+            ids = pid.split(",")
+        out = {}
+        for i in ids:
+            p = self.policies[i]
+            entry = {"version": p["version"],
+                     "modified_date_millis": p["modified_date_millis"],
+                     "policy": p["policy"],
+                     "stats": {"policy": i,
+                               "snapshots_taken":
+                                   p.get("snapshots_taken", 0),
+                               "snapshots_failed":
+                                   p.get("snapshots_failed", 0),
+                               "snapshots_deleted":
+                                   p.get("snapshots_deleted", 0)}}
+            if p["last_success"]:
+                entry["last_success"] = p["last_success"]
+            if p["last_failure"]:
+                entry["last_failure"] = p["last_failure"]
+            out[i] = entry
+        return out
+
+    def delete_policy(self, pid: str) -> dict:
+        if pid not in self.policies:
+            raise ResourceNotFoundError(
+                f"snapshot lifecycle policy or policies [{pid}] not "
+                f"found")
+        del self.policies[pid]
+        return {"acknowledged": True}
+
+    # -- execution -------------------------------------------------------
+    def _resolve_name(self, pattern: str, now_ms: int) -> str:
+        """``<name-{date}>`` date-math headers → concrete names, plus a
+        uniquifying suffix like ``SnapshotLifecycleTask`` appends."""
+        name = pattern
+        if name.startswith("<") and name.endswith(">"):
+            name = name[1:-1]
+            tm = time.gmtime(now_ms / 1000)
+
+            def sub(m):
+                fmt = m.group(1)
+                fmt = (fmt.replace("yyyy", "%Y").replace("MM", "%m")
+                       .replace("dd", "%d").replace("HH", "%H"))
+                return time.strftime(fmt, tm)
+            name = re.sub(r"\{([^}]+)\}", sub, name)
+        return f"{name}-{now_ms % 1_000_000:06d}"
+
+    def execute_policy(self, pid: str,
+                       now_ms: Optional[int] = None) -> dict:
+        p = self.policies.get(pid)
+        if p is None:
+            raise ResourceNotFoundError(
+                f"snapshot lifecycle policy or policies [{pid}] not "
+                f"found")
+        now = now_ms if now_ms is not None else _now_ms()
+        cfg = p["policy"]
+        snap_name = self._resolve_name(cfg["name"], now)
+        import copy
+        config = copy.deepcopy(cfg.get("config") or {})
+        config.setdefault("metadata", {})["policy"] = pid
+        try:
+            self.create_snapshot(cfg["repository"], snap_name, config)
+        except Exception as e:   # noqa: BLE001 — recorded, not raised
+            p["last_failure"] = {"snapshot_name": snap_name, "time": now,
+                                 "details": str(e)}
+            p["snapshots_failed"] = p.get("snapshots_failed", 0) + 1
+            self.stats["total_snapshots_failed"] += 1
+            raise
+        p["last_success"] = {"snapshot_name": snap_name, "time": now}
+        p["snapshots_taken"] = p.get("snapshots_taken", 0) + 1
+        self.stats["total_snapshots_taken"] += 1
+        return {"snapshot_name": snap_name}
+
+    def execute_retention(self, now_ms: Optional[int] = None) -> dict:
+        """Delete snapshots whose policy retention has expired
+        (``SnapshotRetentionTask.java``): expire_after by age,
+        min_count floor, max_count ceiling."""
+        now = now_ms if now_ms is not None else _now_ms()
+        self.stats["retention_runs"] += 1
+        deleted = 0
+        for pid, p in self.policies.items():
+            ret = (p["policy"].get("retention") or {})
+            if not ret:
+                continue
+            repo = p["policy"]["repository"]
+            try:
+                snaps = [s for s in self.list_snapshots(repo)
+                         if (s.get("metadata") or {}).get(
+                             "policy") == pid]
+            except Exception:    # noqa: BLE001 — repo gone: skip policy
+                continue
+            snaps.sort(key=lambda s: s.get("start_time_in_millis", 0))
+            expire_after = ret.get("expire_after")
+            min_count = int(ret.get("min_count", 0) or 0)
+            max_count = ret.get("max_count")
+            to_delete: List[dict] = []
+            if expire_after:
+                ttl = _duration_ms(expire_after)
+                expired = [s for s in snaps
+                           if now - s.get("start_time_in_millis",
+                                          now) > ttl]
+                keep_floor = max(min_count, 0)
+                # never delete below min_count, oldest expire first
+                n_deletable = max(0, len(snaps) - keep_floor)
+                to_delete.extend(expired[:n_deletable])
+            if max_count is not None:
+                overflow = len(snaps) - len(to_delete) - int(max_count)
+                if overflow > 0:
+                    remaining = [s for s in snaps if s not in to_delete]
+                    to_delete.extend(remaining[:overflow])
+            for s in to_delete:
+                try:
+                    self.delete_snapshot(repo, s["snapshot"])
+                    deleted += 1
+                    p["snapshots_deleted"] = \
+                        p.get("snapshots_deleted", 0) + 1
+                except Exception:  # noqa: BLE001
+                    self.stats["retention_failed"] += 1
+        self.stats["retention_deleted"] += deleted
+        self.stats["total_snapshots_deleted"] += deleted
+        return {"deleted": deleted}
+
+    def tick(self, now_ms: Optional[int] = None) -> List[str]:
+        """Run every policy whose schedule interval has elapsed."""
+        if not self.running:
+            return []
+        now = now_ms if now_ms is not None else _now_ms()
+        fired = []
+        for pid, p in self.policies.items():
+            interval = _interval_of_schedule(p["policy"]["schedule"])
+            if p["next_due"] is None:
+                p["next_due"] = now + interval
+                continue
+            if now >= p["next_due"]:
+                p["next_due"] = now + interval
+                try:
+                    self.execute_policy(pid, now)
+                    fired.append(pid)
+                except Exception:   # noqa: BLE001 — recorded on policy
+                    pass
+        return fired
+
+    # -- status ----------------------------------------------------------
+    def status(self) -> dict:
+        return {"operation_mode": "RUNNING" if self.running
+                else "STOPPED"}
+
+    def start(self) -> dict:
+        self.running = True
+        return {"acknowledged": True}
+
+    def stop(self) -> dict:
+        self.running = False
+        return {"acknowledged": True}
+
+    def get_stats(self) -> dict:
+        per_policy = [{"policy": pid,
+                       "snapshots_taken": p.get("snapshots_taken", 0),
+                       "snapshots_failed": p.get("snapshots_failed", 0),
+                       "snapshots_deleted": p.get("snapshots_deleted", 0)}
+                      for pid, p in sorted(self.policies.items())]
+        return {"retention_runs": self.stats["retention_runs"],
+                "retention_deleted": self.stats["retention_deleted"],
+                "retention_failed": self.stats["retention_failed"],
+                "total_snapshots_taken":
+                    self.stats["total_snapshots_taken"],
+                "total_snapshots_failed":
+                    self.stats["total_snapshots_failed"],
+                "total_snapshots_deleted":
+                    self.stats["total_snapshots_deleted"],
+                "policy_stats": per_policy}

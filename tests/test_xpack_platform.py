@@ -1,0 +1,251 @@
+"""SLM / license / deprecation / monitoring tests
+(xpack/{slm,license,deprecation,monitoring}.py)."""
+
+import json
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+@pytest.fixture()
+def api():
+    return RestAPI(IndicesService(tempfile.mkdtemp()))
+
+
+def req(api, method, path, body=None, query=""):
+    b = json.dumps(body).encode() if isinstance(body, (dict, list)) \
+        else (body.encode() if isinstance(body, str) else (body or b""))
+    st, _ct, out = api.handle(method, path, query, b)
+    return st, json.loads(out)
+
+
+@pytest.fixture()
+def repo(api, tmp_path):
+    req(api, "PUT", "/_snapshot/backups",
+        {"type": "fs", "settings": {"location": str(tmp_path / "r")}})
+    req(api, "PUT", "/logs/_doc/1", {"msg": "hello"})
+    req(api, "POST", "/logs/_refresh")
+    return api
+
+
+# -- SLM -------------------------------------------------------------------
+
+def test_slm_policy_crud_and_execute(repo):
+    api = repo
+    st, r = req(api, "PUT", "/_slm/policy/nightly",
+                {"schedule": "0 30 1 * * ?",
+                 "name": "<nightly-snap-{yyyy.MM.dd}>",
+                 "repository": "backups",
+                 "config": {"indices": ["logs"]},
+                 "retention": {"expire_after": "30d", "min_count": 1,
+                               "max_count": 5}})
+    assert st == 200 and r == {"acknowledged": True}
+    st, r = req(api, "GET", "/_slm/policy/nightly")
+    assert r["nightly"]["version"] == 1
+    assert r["nightly"]["policy"]["repository"] == "backups"
+    st, r = req(api, "POST", "/_slm/policy/nightly/_execute")
+    assert st == 200 and r["snapshot_name"].startswith("nightly-snap-")
+    # snapshot actually exists, carries the slm policy metadata
+    st, r = req(api, "GET", "/_snapshot/backups/_all")
+    snaps = r["responses"][0]["snapshots"]
+    assert len(snaps) == 1
+    assert snaps[0]["metadata"]["policy"] == "nightly"
+    assert snaps[0]["indices"] == ["logs"]
+    st, r = req(api, "GET", "/_slm/policy/nightly")
+    assert r["nightly"]["last_success"]["snapshot_name"] == \
+        snaps[0]["snapshot"]
+    st, r = req(api, "GET", "/_slm/stats")
+    assert r["total_snapshots_taken"] == 1
+    st, r = req(api, "DELETE", "/_slm/policy/nightly")
+    assert r == {"acknowledged": True}
+    st, r = req(api, "GET", "/_slm/policy/nightly")
+    assert st == 404
+
+
+def test_slm_retention_max_count(repo):
+    api = repo
+    req(api, "PUT", "/_slm/policy/p1",
+        {"schedule": "1h", "name": "snap", "repository": "backups",
+         "config": {"indices": ["logs"]},
+         "retention": {"max_count": 2}})
+    for _ in range(4):
+        st, r = req(api, "POST", "/_slm/policy/p1/_execute")
+        assert st == 200
+    st, r = req(api, "GET", "/_snapshot/backups/_all")
+    assert len(r["responses"][0]["snapshots"]) == 4
+    st, r = req(api, "POST", "/_slm/_execute_retention")
+    assert st == 200
+    st, r = req(api, "GET", "/_snapshot/backups/_all")
+    snaps = r["responses"][0]["snapshots"]
+    assert len(snaps) == 2
+    st, r = req(api, "GET", "/_slm/stats")
+    assert r["total_snapshots_deleted"] == 2
+    assert r["policy_stats"][0]["snapshots_deleted"] == 2
+
+
+def test_slm_tick_schedule(repo):
+    api = repo
+    req(api, "PUT", "/_slm/policy/tick",
+        {"schedule": "30m", "name": "auto", "repository": "backups"})
+    svc = api.slm
+    t0 = 1_700_000_000_000
+    assert svc.tick(t0) == []          # first tick only arms the timer
+    assert svc.tick(t0 + 60_000) == []  # not due yet
+    assert svc.tick(t0 + 31 * 60_000) == ["tick"]
+    st, r = req(api, "GET", "/_snapshot/backups/_all")
+    assert len(r["responses"][0]["snapshots"]) == 1
+    # stopped SLM does not fire
+    req(api, "POST", "/_slm/stop")
+    assert svc.tick(t0 + 120 * 60_000) == []
+    st, r = req(api, "GET", "/_slm/status")
+    assert r == {"operation_mode": "STOPPED"}
+    req(api, "POST", "/_slm/start")
+    assert req(api, "GET", "/_slm/status")[1] == \
+        {"operation_mode": "RUNNING"}
+
+
+def test_slm_validation(api):
+    st, r = req(api, "PUT", "/_slm/policy/bad",
+                {"name": "x", "repository": "r"})
+    assert st == 400  # schedule required
+    st, r = req(api, "PUT", "/_slm/policy/bad",
+                {"schedule": "not-a-schedule", "name": "x",
+                 "repository": "r"})
+    assert st == 400
+
+
+# -- license / _xpack ------------------------------------------------------
+
+def test_license_lifecycle(api):
+    st, r = req(api, "GET", "/_license")
+    assert st == 200 and r["license"]["type"] == "basic"
+    assert r["license"]["status"] == "active"
+    # trial needs acknowledge
+    st, r = req(api, "POST", "/_license/start_trial")
+    assert r["trial_was_started"] is False
+    st, r = req(api, "GET", "/_license/trial_status")
+    assert r["eligible_to_start_trial"] is True
+    st, r = req(api, "POST", "/_license/start_trial",
+                query="acknowledge=true")
+    assert r["trial_was_started"] is True and r["type"] == "trial"
+    assert req(api, "GET", "/_license")[1]["license"]["type"] == "trial"
+    # trial only once
+    st, r = req(api, "POST", "/_license/start_trial",
+                query="acknowledge=true")
+    assert r["trial_was_started"] is False
+    # back to basic
+    st, r = req(api, "POST", "/_license/start_basic",
+                query="acknowledge=true")
+    assert r["basic_was_started"] is True
+    st, r = req(api, "GET", "/_license/basic_status")
+    assert r["eligible_to_start_basic"] is False
+
+
+def test_xpack_info_and_usage(api):
+    st, r = req(api, "GET", "/_xpack")
+    assert st == 200
+    assert r["license"]["type"] == "basic"
+    assert r["features"]["sql"]["available"] is True
+    # platinum features unavailable on basic, available on trial
+    assert r["features"]["ml"]["available"] is False
+    req(api, "POST", "/_license/start_trial", query="acknowledge=true")
+    st, r = req(api, "GET", "/_xpack")
+    assert r["features"]["ml"]["available"] is True
+    # usage reflects live service state
+    req(api, "PUT", "/_ml/anomaly_detectors/j1",
+        {"analysis_config": {"bucket_span": "1h", "detectors": [
+            {"function": "count"}]},
+         "data_description": {"time_field": "t"}})
+    st, r = req(api, "GET", "/_xpack/usage")
+    assert r["ml"]["jobs"]["_all"]["count"] == 1
+    assert r["slm"]["policy_count"] == 0
+
+
+# -- deprecation -----------------------------------------------------------
+
+def test_deprecation_info_flags_legacy_templates(api):
+    st, r = req(api, "GET", "/_migration/deprecations")
+    assert r["cluster_settings"] == []
+    req(api, "PUT", "/_template/old",
+        {"index_patterns": ["old-*"], "settings": {}})
+    st, r = req(api, "GET", "/_migration/deprecations")
+    assert len(r["cluster_settings"]) == 1
+    assert "Legacy index templates" in r["cluster_settings"][0]["message"]
+    assert r["cluster_settings"][0]["level"] == "warning"
+
+
+def test_deprecation_warning_header_on_http(api):
+    """The HTTP layer emits RFC-7234 299 Warning headers for
+    deprecated usage within that request."""
+    import asyncio
+
+    from elasticsearch_tpu.rest.http_server import HttpServer
+
+    async def run():
+        server = HttpServer(api.handle, port=0, pass_headers=True)
+        await server.start()
+        port = server._server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        body = json.dumps({"index_patterns": ["x-*"]}).encode()
+        writer.write(
+            b"PUT /_template/t1 HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() +
+            b"\r\nConnection: close\r\n\r\n" + body)
+        await writer.drain()
+        raw = await reader.read(-1)
+        writer.close()
+        await server.stop()
+        return raw.decode()
+
+    raw = asyncio.run(run())
+    head = raw.split("\r\n\r\n")[0]
+    assert "Warning: 299 Elasticsearch-8.0.0-tpu" in head
+    assert "Legacy index templates" in head
+
+
+# -- monitoring ------------------------------------------------------------
+
+def test_monitoring_collect_indexes_docs(api):
+    req(api, "PUT", "/metrics/_doc/1", {"v": 1})
+    req(api, "POST", "/metrics/_refresh")
+    st, r = req(api, "POST", "/_monitoring/_collect")
+    assert st == 200 and r["collected"] >= 3  # cluster + node + index
+    st, r = req(api, "POST", "/.monitoring-es-8-*/_search",
+                {"query": {"term": {"type": "index_stats"}}, "size": 10})
+    assert st == 200
+    hits = r["hits"]["hits"]
+    assert any(h["_source"]["index_stats"]["index"] == "metrics"
+               for h in hits)
+    src = hits[0]["_source"]
+    assert "cluster_uuid" in src and "timestamp" in src
+    st, r = req(api, "POST", "/.monitoring-es-8-*/_search",
+                {"query": {"term": {"type": "cluster_stats"}}})
+    assert r["hits"]["total"]["value"] == 1
+
+
+def test_monitoring_bulk_intake(api):
+    payload = (json.dumps({"index": {"_type": "kibana_stats"}}) + "\n" +
+               json.dumps({"kibana": {"uuid": "k1"},
+                           "requests": {"total": 5}}) + "\n")
+    st, r = req(api, "POST", "/_monitoring/bulk", payload,
+                query="system_id=kibana&interval=10s")
+    assert st == 200 and r["errors"] is False
+    st, r = req(api, "POST", "/.monitoring-es-8-*/_search",
+                {"query": {"term": {"type": "kibana_stats"}}})
+    assert r["hits"]["total"]["value"] == 1
+    src = r["hits"]["hits"][0]["_source"]
+    assert src["kibana_stats"]["requests"]["total"] == 5
+    assert src["source_node"]["system_id"] == "kibana"
+
+
+def test_monitoring_tick_interval(api):
+    svc = api.monitoring
+    t0 = 1_700_000_000_000
+    assert svc.tick(t0) is False         # arms
+    assert svc.tick(t0 + 5_000) is False
+    assert svc.tick(t0 + 11_000) is True
+    assert svc.collected_count >= 2
