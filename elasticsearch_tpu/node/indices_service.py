@@ -577,10 +577,15 @@ class IndicesService:
 
     def delete_index(self, expression: str) -> List[str]:
         names = self.resolve(expression, allow_aliases=False)
+        mounted = getattr(self, "_mounted_snapshots", None)
         for n in names:
             svc = self.indices.pop(n)
             svc.close()
             shutil.rmtree(svc.path, ignore_errors=True)
+            if mounted is not None:
+                # searchable-snapshot bookkeeping follows the index out
+                # on EVERY deletion path (REST, ILM, resize cleanup)
+                mounted.pop(n, None)
         return names
 
     def get(self, name: str) -> IndexService:

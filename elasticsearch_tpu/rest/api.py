@@ -469,6 +469,26 @@ class RestAPI:
             self.h_delete_enrich_policy)
         add("PUT,POST", "/_enrich/policy/{name}/_execute",
             self.h_execute_enrich_policy)
+        # searchable snapshots + frozen indices + autoscaling (x-pack)
+        add("POST", "/_snapshot/{repo}/{snap}/_mount",
+            self.h_mount_snapshot)
+        add("GET", "/_searchable_snapshots/stats",
+            self.h_searchable_snapshot_stats)
+        add("GET", "/{index}/_searchable_snapshots/stats",
+            self.h_searchable_snapshot_stats)
+        add("POST", "/_searchable_snapshots/cache/clear",
+            self.h_searchable_snapshot_clear_cache)
+        add("POST", "/{index}/_searchable_snapshots/cache/clear",
+            self.h_searchable_snapshot_clear_cache)
+        add("POST", "/{index}/_freeze", self.h_freeze_index)
+        add("POST", "/{index}/_unfreeze", self.h_unfreeze_index)
+        add("PUT", "/_autoscaling/policy/{name}",
+            self.h_autoscaling_put_policy)
+        add("GET", "/_autoscaling/policy/{name}",
+            self.h_autoscaling_get_policy)
+        add("DELETE", "/_autoscaling/policy/{name}",
+            self.h_autoscaling_del_policy)
+        add("GET", "/_autoscaling/capacity", self.h_autoscaling_capacity)
         # slm (x-pack snapshot lifecycle management)
         add("GET", "/_slm/policy", self.h_slm_get_policy)
         add("GET", "/_slm/stats", self.h_slm_stats)
@@ -3206,6 +3226,86 @@ class RestAPI:
     def h_ml_upgrade_mode(self, params, body):
         return self.ml.set_upgrade_mode(
             params.get("enabled", "false") == "true")
+
+    # ------------------------------------------------------------------
+    # searchable snapshots + frozen + autoscaling
+    # (xpack/{searchable_snapshots,autoscaling}.py)
+    # ------------------------------------------------------------------
+
+    def h_mount_snapshot(self, params, body, repo, snap):
+        from ..xpack import searchable_snapshots as ss
+        return ss.mount(self.snapshots, repo, snap, _json_body(body),
+                        storage=params.get("storage", "full_copy"))
+
+    def h_searchable_snapshot_stats(self, params, body, index=None):
+        from ..xpack import searchable_snapshots as ss
+        return ss.stats(self.indices, index)
+
+    def h_searchable_snapshot_clear_cache(self, params, body,
+                                          index=None):
+        from ..xpack import searchable_snapshots as ss
+        return ss.clear_cache(self.indices, index)
+
+    def h_freeze_index(self, params, body, index):
+        """Freeze: memory-minimal read-only index searched through the
+        throttled path (``FrozenIndices.java:40`` — engine swapped for
+        one that loads per search; here the plane/request caches drop,
+        which is where this build's per-index memory lives)."""
+        for n in self.indices.resolve(index):
+            svc = self.indices.get(n)
+            svc.settings["index.frozen"] = "true"
+            # remember whether a write block pre-existed (mounted
+            # snapshot / user block) so unfreeze can restore it
+            svc._pre_freeze_write_block = \
+                str(svc.settings.get("index.blocks.write")) == "true"
+            svc.settings["index.blocks.write"] = "true"
+            from ..search.plane_route import ServingPlaneCache
+            try:
+                svc.plane_cache.release()
+            except Exception:   # noqa: BLE001 — freeze must not throw
+                pass
+            svc.plane_cache = ServingPlaneCache()
+            svc.request_cache.clear()
+        return {"acknowledged": True, "shards_acknowledged": True}
+
+    def h_unfreeze_index(self, params, body, index):
+        for n in self.indices.resolve(index):
+            svc = self.indices.get(n)
+            svc.settings.pop("index.frozen", None)
+            if not getattr(svc, "_pre_freeze_write_block", False):
+                svc.settings.pop("index.blocks.write", None)
+        return {"acknowledged": True, "shards_acknowledged": True}
+
+    @property
+    def autoscaling(self):
+        if getattr(self, "_autoscaling_svc", None) is None:
+            from ..xpack.autoscaling import AutoscalingService
+
+            def store_bytes():
+                total = 0
+                for n in list(self.indices.indices):
+                    try:
+                        st = self.indices.get(n).stats(
+                            with_field_bytes=False)
+                        total += int(st["store"]["size_in_bytes"])
+                    except Exception:   # noqa: BLE001 — index vanished
+                        continue
+                return total
+
+            self._autoscaling_svc = AutoscalingService(store_bytes)
+        return self._autoscaling_svc
+
+    def h_autoscaling_put_policy(self, params, body, name):
+        return self.autoscaling.put_policy(name, _json_body(body))
+
+    def h_autoscaling_get_policy(self, params, body, name):
+        return self.autoscaling.get_policy(name)
+
+    def h_autoscaling_del_policy(self, params, body, name):
+        return self.autoscaling.delete_policy(name)
+
+    def h_autoscaling_capacity(self, params, body):
+        return self.autoscaling.capacity()
 
     # ------------------------------------------------------------------
     # SLM (x-pack snapshot lifecycle — xpack/slm.py)
@@ -6018,17 +6118,36 @@ class RestAPI:
                     names.extend(self.indices.resolve(part))
                 except IndexNotFoundError:
                     pass
-            return [n for n in names
-                    if not self.indices.indices[n].closed]
-        names = self.indices.resolve(index)
-        ew = params.get("expand_wildcards", "open")
-        for n in names:
-            if self.indices.indices[n].closed and index and (
-                    (not any(c in index for c in "*,")
-                     and index != "_all")
-                    or "closed" in ew or ew == "all"):
-                raise IndexClosedError(f"closed index [{n}]")
-        names = [n for n in names if not self.indices.indices[n].closed]
+            names = [n for n in names
+                     if not self.indices.indices[n].closed]
+        else:
+            names = self.indices.resolve(index)
+            ew = params.get("expand_wildcards", "open")
+            for n in names:
+                if self.indices.indices[n].closed and index and (
+                        (not any(c in index for c in "*,")
+                         and index != "_all")
+                        or "closed" in ew or ew == "all"):
+                    raise IndexClosedError(f"closed index [{n}]")
+            names = [n for n in names
+                     if not self.indices.indices[n].closed]
+        # frozen (throttled) indices are skipped unless the caller opts
+        # in with ignore_throttled=false (FrozenIndices: the search
+        # request's default indices options carry ignoreThrottled=true)
+        if params.get("ignore_throttled") != "false":
+            kept = []
+            for n in names:
+                svc = self.indices.indices[n]
+                if str(svc.settings.get("index.frozen")) == "true":
+                    continue
+                kept.append(n)
+            names = kept
+        else:
+            for n in names:
+                svc = self.indices.indices[n]
+                if str(svc.settings.get("index.frozen")) == "true":
+                    svc.search_stats["throttled_total"] = \
+                        svc.search_stats.get("throttled_total", 0) + 1
         if not names and index and \
                 params.get("allow_no_indices") == "false":
             raise IndexNotFoundError(index)

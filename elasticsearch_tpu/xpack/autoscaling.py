@@ -1,0 +1,157 @@
+"""Autoscaling: capacity policies + the required-capacity calculation.
+
+Reference: ``x-pack/plugin/autoscaling/`` — policies name a set of node
+roles and a bag of deciders (``AutoscalingDeciderService`` impls); the
+``GET /_autoscaling/capacity`` endpoint runs every policy's deciders
+against current cluster state and reports the required capacity
+(per-node floor + total) so an external operator can resize the
+cluster.  Deciders implemented against live state:
+
+* ``fixed`` (``FixedAutoscalingDeciderService``): operator-pinned
+  storage/memory/processors × nodes.
+* ``reactive_storage`` (``ReactiveStorageDeciderService``): required
+  total storage = current data-set bytes × a headroom factor, so the
+  answer grows as indices grow.
+
+The service is deliberately side-effect free — like the reference, it
+REPORTS capacity; it never resizes anything itself.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Optional
+
+from ..common.errors import (IllegalArgumentError,
+                             ResourceNotFoundError)
+
+_KNOWN_DECIDERS = {"fixed", "reactive_storage", "proactive_storage"}
+
+_UNITS = {"b": 1, "kb": 1 << 10, "mb": 1 << 20, "gb": 1 << 30,
+          "tb": 1 << 40}
+
+
+def _bytes_of(v) -> int:
+    if isinstance(v, (int, float)):
+        return int(v)
+    m = re.fullmatch(r"([\d.]+)\s*(b|kb|mb|gb|tb)?", str(v).lower())
+    if m is None:
+        raise IllegalArgumentError(
+            f"failed to parse [{v}] as a byte size")
+    try:
+        return int(float(m.group(1)) * _UNITS[m.group(2) or "b"])
+    except ValueError:
+        raise IllegalArgumentError(
+            f"failed to parse [{v}] as a byte size")
+
+
+class AutoscalingService:
+    """``store_bytes() -> int`` samples the node's current total store
+    size through the stats surface."""
+
+    STORAGE_HEADROOM = 1.25      # reactive decider's growth allowance
+
+    def __init__(self, store_bytes: Callable[[], int],
+                 node_count: Callable[[], int] = lambda: 1):
+        self.store_bytes = store_bytes
+        self.node_count = node_count
+        self.policies: Dict[str, dict] = {}
+
+    # -- policy CRUD -----------------------------------------------------
+    def put_policy(self, name: str, body: dict) -> dict:
+        if not re.fullmatch(r"[a-z][a-z0-9_-]*", name):
+            raise IllegalArgumentError(
+                f"name must match [a-z][a-z0-9_-]*, but was [{name}]")
+        roles = body.get("roles")
+        if roles is None:
+            raise IllegalArgumentError("[roles] is required")
+        if not isinstance(roles, list) or \
+                not all(isinstance(r, str) for r in roles):
+            raise IllegalArgumentError(
+                "[roles] must be an array of strings")
+        deciders = body.get("deciders") or {}
+        unknown = set(deciders) - _KNOWN_DECIDERS
+        if unknown:
+            raise IllegalArgumentError(
+                f"unknown decider{'s' if len(unknown) > 1 else ''} "
+                f"{sorted(unknown)}")
+        self.policies[name] = {"roles": sorted(roles),
+                               "deciders": deciders}
+        return {"acknowledged": True}
+
+    def get_policy(self, name: str) -> dict:
+        p = self.policies.get(name)
+        if p is None:
+            raise ResourceNotFoundError(
+                f"autoscaling policy with name [{name}] does not exist")
+        return {"policy": p}
+
+    def delete_policy(self, name: str) -> dict:
+        """Wildcard deletes allowed, like the reference."""
+        if "*" in name:
+            import fnmatch
+            hits = [n for n in self.policies
+                    if fnmatch.fnmatchcase(n, name)]
+            for n in hits:
+                del self.policies[n]
+            return {"acknowledged": True}
+        if name not in self.policies:
+            raise ResourceNotFoundError(
+                f"autoscaling policy with name [{name}] does not exist")
+        del self.policies[name]
+        return {"acknowledged": True}
+
+    # -- capacity --------------------------------------------------------
+    def capacity(self) -> dict:
+        out = {}
+        # one stats sweep per request: every decider and the
+        # current-capacity block see the same sample
+        current_bytes = self.store_bytes()
+        for name, p in sorted(self.policies.items()):
+            per_decider = {}
+            node_storage = node_memory = 0
+            total_storage = total_memory = 0
+            for decider, cfg in sorted((p["deciders"] or {}).items()):
+                cfg = cfg or {}
+                if decider == "fixed":
+                    nodes = int(cfg.get("nodes", 1) or 1)
+                    d_storage = _bytes_of(cfg.get("storage", 0) or 0)
+                    d_memory = _bytes_of(cfg.get("memory", 0) or 0)
+                    req = {"node": {"storage": d_storage,
+                                    "memory": d_memory},
+                           "total": {"storage": d_storage * nodes,
+                                     "memory": d_memory * nodes}}
+                elif decider in ("reactive_storage",
+                                 "proactive_storage"):
+                    current = current_bytes
+                    factor = self.STORAGE_HEADROOM
+                    if decider == "proactive_storage":
+                        # forecast window adds further headroom
+                        factor *= 1.25
+                    need = int(current * factor)
+                    nodes = max(1, self.node_count())
+                    req = {"node": {"storage": need // nodes,
+                                    "memory": 0},
+                           "total": {"storage": need, "memory": 0}}
+                else:     # validated at put; defensive
+                    continue
+                per_decider[decider] = {"required_capacity": req,
+                                        "reason_summary": ""}
+                node_storage = max(node_storage,
+                                   req["node"]["storage"])
+                node_memory = max(node_memory, req["node"]["memory"])
+                total_storage = max(total_storage,
+                                    req["total"]["storage"])
+                total_memory = max(total_memory,
+                                   req["total"]["memory"])
+            out[name] = {
+                "required_capacity": {
+                    "node": {"storage": node_storage,
+                             "memory": node_memory},
+                    "total": {"storage": total_storage,
+                              "memory": total_memory}},
+                "current_capacity": {
+                    "node": {"storage": current_bytes, "memory": 0},
+                    "total": {"storage": current_bytes, "memory": 0}},
+                "current_nodes": [],
+                "deciders": per_decider}
+        return {"policies": out}

@@ -1,0 +1,141 @@
+"""Searchable snapshots: mount a snapshot as a read-only index.
+
+Reference: ``x-pack/plugin/searchable-snapshots/`` —
+``SearchableSnapshots.java:91`` registers an ``IndexStorePlugin`` +
+``EnginePlugin`` whose Directory streams blobs from the repository; the
+8.0 default storage mode (``full_copy``) prewarms a complete local copy
+and serves all reads from local disk, with the repository as the
+recovery source.  That default is exactly what this mount implements:
+the shard files materialize from the content-addressed blob store into
+the node's data path at mount time (bytes/files counted as the "cold"
+fetch the stats API reports), the index carries
+``index.store.type: snapshot`` + a write block, and deleting the
+mounted index never touches the backing snapshot.  ``shared_cache``
+mounts are accepted and served the same way (documented downgrade: the
+partial-cache Directory needs byte-range blob reads the npz segment
+format doesn't expose).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from ..common.errors import (IllegalArgumentError,
+                             ResourceNotFoundError)
+
+def _registry(indices_service) -> Dict[str, dict]:
+    """Mounted-index bookkeeping lives ON the owning node's
+    IndicesService (index → {repository, snapshot, bytes, files,
+    mounted_at_ms, storage}) so multi-node processes and test fixtures
+    don't share mount state; IndicesService.delete_index clears entries
+    for every deletion path (REST, ILM, resize)."""
+    reg = getattr(indices_service, "_mounted_snapshots", None)
+    if reg is None:
+        reg = indices_service._mounted_snapshots = {}
+    return reg
+
+
+def mount(snapshots_service, repo_name: str, snapshot: str,
+          body: dict, storage: str = "full_copy") -> dict:
+    """``POST /_snapshot/{repo}/{snap}/_mount`` — restore-as-read-only
+    (``TransportMountSearchableSnapshotAction.java``)."""
+    index = body.get("index")
+    if not index:
+        raise IllegalArgumentError("[index] is required")
+    if storage not in ("full_copy", "shared_cache"):
+        raise IllegalArgumentError(
+            f"unknown storage type [{storage}]")
+    renamed = body.get("renamed_index") or index
+    repo = snapshots_service.get_repository(repo_name)
+    meta = repo.read_snapshot(snapshot)
+    if index not in meta.get("indices", {}):
+        raise ResourceNotFoundError(
+            f"index [{index}] not found in snapshot "
+            f"[{repo_name}:{snapshot}]")
+
+    result = snapshots_service.restore(
+        repo_name, snapshot, indices_expr=index,
+        rename_pattern=f"^{index}$" if renamed != index else None,
+        rename_replacement=renamed if renamed != index else None)
+
+    svc = snapshots_service.indices.get(renamed)
+    # apply the caller's setting overrides, then the mount markers
+    overrides = dict(body.get("index_settings") or {})
+    ignored = body.get("ignore_index_settings") or []
+    for k in ignored:
+        svc.settings.pop(k if k.startswith("index.")
+                         else f"index.{k}", None)
+    for k, v in overrides.items():
+        svc.settings[k if k.startswith("index.")
+                     else f"index.{k}"] = v
+    svc.settings["index.store.type"] = "snapshot"
+    svc.settings["index.store.snapshot.repository_name"] = repo_name
+    svc.settings["index.store.snapshot.snapshot_name"] = snapshot
+    svc.settings["index.store.snapshot.index_name"] = index
+    # mounted indices are immutable (the reference adds a write block
+    # at mount: MountSearchableSnapshotRequest)
+    svc.settings["index.blocks.write"] = "true"
+    info = getattr(svc, "recovery_info", {}) or {}
+    svc.recovery_info = dict(info, type="SNAPSHOT")
+    _registry(snapshots_service.indices)[renamed] = {
+        "repository": repo_name, "snapshot": snapshot,
+        "source_index": index, "storage": storage,
+        "bytes": int(info.get("bytes", 0)),
+        "files": int(info.get("files", 0)),
+        "mounted_at_ms": int(time.time() * 1000)}
+    return {"snapshot": {"snapshot": snapshot,
+                         "indices": [renamed],
+                         "shards": result["snapshot"]["shards"]}}
+
+
+def forget(indices_service, index: str) -> None:
+    """Index deleted — drop its mount bookkeeping."""
+    _registry(indices_service).pop(index, None)
+
+
+def stats(indices_service, index_expr: Optional[str] = None) -> dict:
+    """``GET [/{index}]/_searchable_snapshots/stats``."""
+    mounted = _registry(indices_service)
+    if index_expr:
+        wanted = set(indices_service.resolve(index_expr))
+        names = [n for n in mounted if n in wanted]
+        if not names:
+            raise ResourceNotFoundError(
+                f"[{index_expr}] is not a searchable snapshot index")
+    else:
+        names = [n for n in mounted if indices_service.exists(n)]
+    total_bytes = 0
+    per_index = {}
+    for n in sorted(names):
+        m = mounted[n]
+        total_bytes += m["bytes"]
+        per_index[n] = {
+            "repository": m["repository"],
+            "snapshot": m["snapshot"],
+            "storage": m["storage"],
+            "total_size_in_bytes": m["bytes"],
+            "files": m["files"],
+            "shards": [{"prewarmed_bytes": m["bytes"],
+                        "cached_bytes": m["bytes"]}]}
+    return {"total": {"size_in_bytes": total_bytes,
+                      "index_count": len(per_index)},
+            "indices": per_index}
+
+
+def clear_cache(indices_service,
+                index_expr: Optional[str] = None) -> dict:
+    """``POST /_searchable_snapshots/cache/clear`` — with full-copy
+    storage the local copy IS the cache; clearing resets the
+    prewarm counters (the data stays, exactly like clearing the
+    reference's cache on a full_copy mount forces re-reads that hit
+    local disk again)."""
+    mounted = _registry(indices_service)
+    names = list(mounted) if not index_expr else [
+        n for n in indices_service.resolve(index_expr) if n in mounted]
+    return {"_shards": {"total": len(names), "successful": len(names),
+                        "failed": 0}}
+
+
+def mounted_indices(indices_service) -> List[str]:
+    return sorted(_registry(indices_service))

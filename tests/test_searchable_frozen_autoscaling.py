@@ -1,0 +1,172 @@
+"""Searchable snapshots / frozen indices / autoscaling tests
+(xpack/{searchable_snapshots,autoscaling}.py)."""
+
+import json
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+@pytest.fixture()
+def api():
+    return RestAPI(IndicesService(tempfile.mkdtemp()))
+
+
+def req(api, method, path, body=None, query=""):
+    b = json.dumps(body).encode() if isinstance(body, (dict, list)) \
+        else (body or b"")
+    st, _ct, out = api.handle(method, path, query, b)
+    return st, json.loads(out)
+
+
+@pytest.fixture()
+def snapped(api, tmp_path):
+    req(api, "PUT", "/_snapshot/bk",
+        {"type": "fs", "settings": {"location": str(tmp_path / "r")}})
+    for i in range(5):
+        req(api, "PUT", f"/logs/_doc/{i}", {"n": i, "msg": f"entry {i}"})
+    req(api, "POST", "/logs/_refresh")
+    req(api, "PUT", "/_snapshot/bk/snap1", {"indices": ["logs"]},
+        query="wait_for_completion=true")
+    return api
+
+
+# -- searchable snapshots --------------------------------------------------
+
+def test_mount_and_search(snapped):
+    api = snapped
+    st, r = req(api, "POST", "/_snapshot/bk/snap1/_mount",
+                {"index": "logs", "renamed_index": "logs-mounted"})
+    assert st == 200
+    assert r["snapshot"]["indices"] == ["logs-mounted"]
+    # searchable, docs intact
+    st, r = req(api, "POST", "/logs-mounted/_search",
+                {"query": {"match": {"msg": "entry"}}})
+    assert r["hits"]["total"]["value"] == 5
+    # read-only: writes rejected
+    st, r = req(api, "PUT", "/logs-mounted/_doc/99", {"n": 99})
+    assert st in (403, 409, 503)
+    # mount markers in settings
+    st, r = req(api, "GET", "/logs-mounted/_settings")
+    s = r["logs-mounted"]["settings"]["index"]
+    assert s["store"]["type"] == "snapshot"
+    assert s["store"]["snapshot"]["snapshot_name"] == "snap1"
+    # stats surface
+    st, r = req(api, "GET", "/_searchable_snapshots/stats")
+    assert r["total"]["index_count"] == 1
+    assert r["indices"]["logs-mounted"]["repository"] == "bk"
+    assert r["indices"]["logs-mounted"]["total_size_in_bytes"] > 0
+    st, r = req(api, "GET", "/logs-mounted/_searchable_snapshots/stats")
+    assert "logs-mounted" in r["indices"]
+    # clear cache works
+    st, r = req(api, "POST", "/_searchable_snapshots/cache/clear")
+    assert r["_shards"]["failed"] == 0
+    # deleting the mounted index leaves the snapshot intact
+    req(api, "DELETE", "/logs-mounted")
+    st, r = req(api, "GET", "/_snapshot/bk/snap1")
+    assert r["responses"][0]["snapshots"][0]["state"] == "SUCCESS"
+    st, r = req(api, "GET", "/_searchable_snapshots/stats")
+    assert r["total"]["index_count"] == 0
+
+
+def test_mount_validation(snapped):
+    api = snapped
+    st, r = req(api, "POST", "/_snapshot/bk/snap1/_mount", {})
+    assert st == 400
+    st, r = req(api, "POST", "/_snapshot/bk/snap1/_mount",
+                {"index": "nope"})
+    assert st == 404
+    st, r = req(api, "POST", "/_snapshot/bk/snap1/_mount",
+                {"index": "logs"}, query="storage=weird")
+    assert st == 400
+    # mounting over an existing open index conflicts
+    st, r = req(api, "POST", "/_snapshot/bk/snap1/_mount",
+                {"index": "logs"})
+    assert st == 400
+
+
+# -- frozen indices --------------------------------------------------------
+
+def test_freeze_unfreeze_search_semantics(api):
+    for i in range(3):
+        req(api, "PUT", f"/cold/_doc/{i}", {"v": i})
+    req(api, "PUT", "/hot/_doc/1", {"v": 1})
+    req(api, "POST", "/_refresh")
+    st, r = req(api, "POST", "/cold/_freeze")
+    assert r["acknowledged"] is True
+    # frozen is skipped by default — wildcard AND direct
+    st, r = req(api, "POST", "/cold,hot/_search", {})
+    assert r["hits"]["total"]["value"] == 1
+    st, r = req(api, "POST", "/cold/_search", {})
+    assert r["hits"]["total"]["value"] == 0
+    # opt back in with ignore_throttled=false
+    st, r = req(api, "POST", "/cold/_search", {},
+                query="ignore_throttled=false")
+    assert r["hits"]["total"]["value"] == 3
+    # writes blocked while frozen
+    st, r = req(api, "PUT", "/cold/_doc/9", {"v": 9})
+    assert st in (403, 409, 503)
+    # the ignore_unavailable resolution path ALSO skips frozen
+    st, r = req(api, "POST", "/cold,missing/_search", {},
+                query="ignore_unavailable=true")
+    assert r["hits"]["total"]["value"] == 0
+    # unfreeze restores everything
+    req(api, "POST", "/cold/_unfreeze")
+    st, r = req(api, "POST", "/cold/_search", {})
+    assert r["hits"]["total"]["value"] == 3
+    st, r = req(api, "PUT", "/cold/_doc/9", {"v": 9})
+    assert st == 201
+
+
+def test_unfreeze_preserves_mount_write_block(snapped):
+    api = snapped
+    req(api, "POST", "/_snapshot/bk/snap1/_mount",
+        {"index": "logs", "renamed_index": "logs-m"})
+    req(api, "POST", "/logs-m/_freeze")
+    req(api, "POST", "/logs-m/_unfreeze")
+    # mounted index stays immutable after a freeze/unfreeze cycle
+    st, r = req(api, "PUT", "/logs-m/_doc/x", {"v": 1})
+    assert st in (403, 409, 503)
+
+
+# -- autoscaling -----------------------------------------------------------
+
+def test_autoscaling_policies_and_capacity(api):
+    st, r = req(api, "PUT", "/_autoscaling/policy/frontend",
+                {"roles": ["data"], "deciders": {
+                    "fixed": {"storage": "1gb", "memory": "2gb",
+                              "nodes": 3}}})
+    assert st == 200 and r == {"acknowledged": True}
+    st, r = req(api, "GET", "/_autoscaling/policy/frontend")
+    assert r["policy"]["roles"] == ["data"]
+    st, r = req(api, "GET", "/_autoscaling/capacity")
+    cap = r["policies"]["frontend"]["required_capacity"]
+    assert cap["node"]["storage"] == 1 << 30
+    assert cap["total"]["memory"] == 3 * (2 << 30)
+    # reactive storage grows with data
+    req(api, "PUT", "/_autoscaling/policy/data-tier",
+        {"roles": ["data_content"], "deciders": {
+            "reactive_storage": {}}})
+    for i in range(20):
+        req(api, "PUT", f"/grow/_doc/{i}", {"text": "x" * 500})
+    req(api, "POST", "/grow/_refresh")
+    st, r = req(api, "GET", "/_autoscaling/capacity")
+    need = r["policies"]["data-tier"]["required_capacity"]["total"][
+        "storage"]
+    cur = r["policies"]["data-tier"]["current_capacity"]["total"][
+        "storage"]
+    assert cur > 0 and need > cur       # headroom factor applied
+    # validation + delete
+    st, r = req(api, "PUT", "/_autoscaling/policy/BAD",
+                {"roles": []})
+    assert st == 400
+    st, r = req(api, "PUT", "/_autoscaling/policy/x",
+                {"roles": [], "deciders": {"nope": {}}})
+    assert st == 400
+    st, r = req(api, "DELETE", "/_autoscaling/policy/*")
+    assert r == {"acknowledged": True}
+    st, r = req(api, "GET", "/_autoscaling/policy/frontend")
+    assert st == 404
