@@ -730,6 +730,154 @@ class GeoPointFieldType(MappedFieldType):
         return (lat, lon)
 
 
+class RankFeatureFieldType(MappedFieldType):
+    """Single positive feature value for ``rank_feature`` queries
+    (reference: ``mapper-extras/.../RankFeatureFieldMapper.java``).
+    Stored as an ordinary numeric doc-values column — the rank_feature
+    query reads it straight off the device-resident column instead of
+    the reference's frequency-encoded term."""
+
+    type_name = "rank_feature"
+    has_doc_values = True
+
+    def __init__(self, name, params=None,
+                 positive_score_impact: bool = True):
+        super().__init__(name, params)
+        self.positive_score_impact = positive_score_impact
+
+    def parse_value(self, value):
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            raise MapperParsingError(
+                f"failed to parse field [{self.name}] of type "
+                f"[rank_feature]")
+        if v <= 0:
+            raise MapperParsingError(
+                f"[rank_feature] fields must have a positive value, "
+                f"got [{v}] for field [{self.name}]")
+        return v
+
+
+class RankFeaturesFieldType(MappedFieldType):
+    """Sparse feature map {name: positive value}
+    (``RankFeaturesFieldMapper.java``); each feature lands in its own
+    ``field.feature`` numeric column."""
+
+    type_name = "rank_features"
+    has_doc_values = True
+
+    def __init__(self, name, params=None,
+                 positive_score_impact: bool = True):
+        super().__init__(name, params)
+        self.positive_score_impact = positive_score_impact
+
+    def parse_value(self, value):
+        if not isinstance(value, dict):
+            raise MapperParsingError(
+                f"[rank_features] fields must be json objects, "
+                f"expected a START_OBJECT for field [{self.name}]")
+        out = {}
+        for feat, v in value.items():
+            try:
+                fv = float(v)
+            except (TypeError, ValueError):
+                raise MapperParsingError(
+                    f"failed to parse feature [{feat}] of field "
+                    f"[{self.name}]")
+            if fv <= 0:
+                raise MapperParsingError(
+                    f"[rank_features] fields must have positive "
+                    f"values, got [{fv}] for feature [{feat}]")
+            out[feat] = fv
+        return out
+
+
+class AggregateMetricDoubleFieldType(MappedFieldType):
+    """Pre-aggregated metric document (``aggregate_metric_double``,
+    ``x-pack mapper: AggregateDoubleMetricFieldMapper.java``): each doc
+    carries min/max/sum/value_count sub-metrics, one numeric column per
+    metric; queries and sorts on the bare name resolve to
+    ``default_metric``'s column."""
+
+    type_name = "aggregate_metric_double"
+    has_doc_values = True
+
+    VALID_METRICS = ("min", "max", "sum", "value_count")
+
+    def __init__(self, name, metrics, default_metric, params=None):
+        super().__init__(name, params)
+        if not metrics:
+            raise MapperParsingError(
+                f"Property [metrics] is required for field [{name}]")
+        for m in metrics:
+            if m not in self.VALID_METRICS:
+                raise MapperParsingError(
+                    f"Metric [{m}] is not supported for field [{name}]; "
+                    f"supported metrics are "
+                    f"{list(self.VALID_METRICS)}")
+        if default_metric is None:
+            raise MapperParsingError(
+                f"Property [default_metric] is required for field "
+                f"[{name}]")
+        if default_metric not in metrics:
+            raise MapperParsingError(
+                f"Default metric [{default_metric}] is not defined in "
+                f"the metrics of field [{name}]")
+        self.metrics = list(metrics)
+        self.default_metric = default_metric
+
+    def parse_value(self, value):
+        if not isinstance(value, dict):
+            raise MapperParsingError(
+                f"Failed to parse object: expecting an object for "
+                f"field [{self.name}]")
+        out = {}
+        for m in self.metrics:
+            if m not in value:
+                raise MapperParsingError(
+                    f"Aggregate metric field [{self.name}] must "
+                    f"contain all metrics {self.metrics}")
+            try:
+                out[m] = float(value[m])
+            except (TypeError, ValueError):
+                raise MapperParsingError(
+                    f"failed to parse metric [{m}] of field "
+                    f"[{self.name}]")
+        if "value_count" in out and out["value_count"] < 0:
+            raise MapperParsingError(
+                f"Aggregate metric [value_count] of field "
+                f"[{self.name}] cannot be a negative number")
+        return out
+
+
+class GeoShapeFieldType(MappedFieldType):
+    """Arbitrary geometries (``geo_shape``; reference:
+    ``x-pack/plugin/spatial/`` + ``GeoShapeFieldMapper.java``).
+    The geometry is validated at parse time and kept in _source; the
+    geo_shape query evaluates relations against parsed geometries with
+    a per-segment cache (search/geometry.py), and the indexed bbox
+    columns (``._minx`` …) give exists/pre-filter columns — vs the
+    reference's triangulated BKD encoding."""
+
+    type_name = "geo_shape"
+    has_doc_values = True
+
+    def parse_value(self, value):
+        from ..search.geometry import parse_geometry
+        try:
+            geom = parse_geometry(value)
+        except Exception as e:
+            raise MapperParsingError(
+                f"failed to parse field [{self.name}] of type "
+                f"[geo_shape]: {e}")
+        if geom.empty:
+            raise MapperParsingError(
+                f"failed to parse field [{self.name}] of type "
+                f"[geo_shape]: empty geometry")
+        return geom
+
+
 class IpFieldType(MappedFieldType):
     """IP addresses (reference: ``index/mapper/IpFieldMapper.java``).
     Stored dual: the numeric value (for range/CIDR masks on device) and
@@ -1302,6 +1450,22 @@ class MapperService:
                                         spec.get("similarity", "cosine"), params)
         if ftype == "geo_point":
             return GeoPointFieldType(name, params)
+        if ftype == "geo_shape":
+            return GeoShapeFieldType(name, params)
+        if ftype == "rank_feature":
+            return RankFeatureFieldType(
+                name, params,
+                positive_score_impact=spec.get(
+                    "positive_score_impact", True))
+        if ftype == "rank_features":
+            return RankFeaturesFieldType(
+                name, params,
+                positive_score_impact=spec.get(
+                    "positive_score_impact", True))
+        if ftype == "aggregate_metric_double":
+            return AggregateMetricDoubleFieldType(
+                name, spec.get("metrics"), spec.get("default_metric"),
+                params)
         if ftype == "completion":
             return CompletionFieldType(name, params)
         if ftype == "ip":
@@ -1666,6 +1830,33 @@ class MapperService:
             # _gte/_lte) so distance/grid queries and aggs read doc values
             parsed.numeric_values.setdefault(f"{full}._lat", []).append(lat)
             parsed.numeric_values.setdefault(f"{full}._lon", []).append(lon)
+        elif isinstance(ft, GeoShapeFieldType):
+            geom = ft.parse_value(value)
+            x1, y1, x2, y2 = geom.bbox()
+            # bbox columns: presence (exists) + coarse pre-filter
+            parsed.numeric_values.setdefault(full, []).append(0.0)
+            for key, v in (("_minx", x1), ("_miny", y1),
+                           ("_maxx", x2), ("_maxy", y2)):
+                parsed.numeric_values.setdefault(
+                    f"{full}.{key}", []).append(v)
+        elif isinstance(ft, RankFeatureFieldType):
+            parsed.numeric_values.setdefault(full, []).append(
+                ft.parse_value(value))
+        elif isinstance(ft, RankFeaturesFieldType):
+            feats = ft.parse_value(value)
+            parsed.numeric_values.setdefault(full, []).append(0.0)
+            for feat, fv in feats.items():
+                parsed.numeric_values.setdefault(
+                    f"{full}.{feat}", []).append(fv)
+        elif isinstance(ft, AggregateMetricDoubleFieldType):
+            metrics = ft.parse_value(value)
+            # the bare name carries default_metric so term/range/sort
+            # resolve like the reference's default_metric delegation
+            parsed.numeric_values.setdefault(full, []).append(
+                metrics[ft.default_metric])
+            for m, v in metrics.items():
+                parsed.numeric_values.setdefault(
+                    f"{full}.{m}", []).append(v)
         elif isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType,
                              TokenCountFieldType)):
             parsed.numeric_values.setdefault(full, []).append(ft.parse_value(value))

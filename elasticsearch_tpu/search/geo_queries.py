@@ -1,0 +1,455 @@
+"""Geo queries (geo_shape / geo_bounding_box / geo_distance), the
+rank_feature query, and the pinned query.
+
+Reference: ``index/query/{GeoShapeQueryBuilder,GeoBoundingBoxQueryBuilder,
+GeoDistanceQueryBuilder}.java``, ``mapper-extras/.../
+RankFeatureQueryBuilder.java``, and ``x-pack/plugin/
+search-business-rules/.../PinnedQueryBuilder.java``.
+
+Design split: the point-based filters (bounding box, distance) are
+vectorized numpy over the geo_point ``._lat``/``._lon`` doc-value
+columns — a single fused comparison over the whole segment, the same
+columns the device aggs read.  geo_shape relations run per matching doc
+against geometries parsed out of _source with a per-segment cache
+(search/geometry.py documents the trade vs the reference's BKD
+triangles); a bbox pre-filter on the indexed ``._minx``… columns skips
+the exact predicate for segments/docs that cannot match.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..common.errors import IllegalArgumentError
+from ..index.mapping import (GeoPointFieldType, GeoShapeFieldType,
+                             RankFeatureFieldType, RankFeaturesFieldType)
+from .geometry import Geometry, parse_geometry, relate
+from .query_dsl import (ParsingError, Query, _const_result, jnp,
+                        parse_query, register_query_parser)
+
+# .positional helpers (haversine_meters, parse_distance_meters) import
+# lazily inside execute() — positional itself imports query_dsl, whose
+# module-bottom SPI imports land here before positional finishes
+
+
+def _geo_helpers():
+    from .positional import haversine_meters, parse_distance_meters
+    return haversine_meters, parse_distance_meters
+
+
+def _latlon(seg, field):
+    lat = seg.numeric_fields.get(f"{field}._lat")
+    lon = seg.numeric_fields.get(f"{field}._lon")
+    if lat is None or lon is None or lat.vals_host.size == 0:
+        return None
+    return lat, lon
+
+
+def _mask_result(seg, mask_host, boost):
+    mask = jnp.asarray(mask_host)
+    return jnp.where(mask, np.float32(boost), 0.0), mask
+
+
+class GeoBoundingBoxQuery(Query):
+    def __init__(self, field: str, top: float, left: float,
+                 bottom: float, right: float, boost: float = 1.0):
+        self.field = field
+        self.top, self.left = top, left
+        self.bottom, self.right = bottom, right
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        field = ctx.concrete_field(self.field)
+        cols = _latlon(seg, field)
+        if cols is None:
+            return _const_result(seg, 0.0, False)
+        lat, lon = cols
+        ok_lat = (lat.vals_host >= self.bottom) & \
+            (lat.vals_host <= self.top)
+        if self.left <= self.right:
+            ok_lon = (lon.vals_host >= self.left) & \
+                (lon.vals_host <= self.right)
+        else:                               # box crossing the dateline
+            ok_lon = (lon.vals_host >= self.left) | \
+                (lon.vals_host <= self.right)
+        mask_host = np.zeros(seg.n_pad, bool)
+        mask_host[lat.docs_host[ok_lat & ok_lon]] = True
+        return _mask_result(seg, mask_host, self.boost)
+
+
+class GeoDistanceQuery(Query):
+    def __init__(self, field: str, origin, distance, boost: float = 1.0):
+        self.field = field
+        self.origin = origin
+        self.distance = distance
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        field = ctx.concrete_field(self.field)
+        cols = _latlon(seg, field)
+        if cols is None:
+            return _const_result(seg, 0.0, False)
+        lat, lon = cols
+        haversine_meters, parse_distance_meters = _geo_helpers()
+        olat, olon = GeoPointFieldType.parse_value(
+            ctx.field_type(field) or GeoPointFieldType(field),
+            self.origin)
+        dist_m = parse_distance_meters(self.distance)
+        d = haversine_meters(lat.vals_host, lon.vals_host, olat, olon)
+        mask_host = np.zeros(seg.n_pad, bool)
+        mask_host[lat.docs_host[d <= dist_m]] = True
+        return _mask_result(seg, mask_host, self.boost)
+
+
+class GeoShapeQuery(Query):
+    def __init__(self, field: str, shape: Geometry, relation: str,
+                 boost: float = 1.0, ignore_unmapped: bool = False):
+        self.field = field
+        self.shape = shape
+        self.relation = relation
+        self.boost = boost
+        self.ignore_unmapped = ignore_unmapped
+
+    def _doc_geometries(self, seg, field):
+        """Per-doc parsed geometries, cached on the segment (segments
+        are immutable, so the cache lives as long as the geometry
+        columns do)."""
+        cache = getattr(seg, "_geo_shape_cache", None)
+        if cache is None:
+            cache = seg._geo_shape_cache = {}
+        if field in cache:
+            return cache[field]
+        per_doc: List[Optional[Geometry]] = [None] * seg.n_docs
+        for i, src in enumerate(seg.sources):
+            if not src or not seg.parent_mask[i]:
+                continue
+            # dotted traversal flattening object arrays, like the
+            # reference's source lookup
+            nodes = [src]
+            for part in field.split("."):
+                nxt = []
+                for node in nodes:
+                    if isinstance(node, list):
+                        node = [n for n in node if isinstance(n, dict)]
+                        nxt.extend(n[part] for n in node if part in n)
+                    elif isinstance(node, dict) and part in node:
+                        nxt.append(node[part])
+                nodes = nxt
+            if not nodes:
+                continue
+            values = []
+            for node in nodes:
+                if isinstance(node, list) and not (
+                        node and isinstance(node[0], (int, float))):
+                    values.extend(node)
+                else:
+                    values.append(node)
+            g = Geometry()
+            for v in values:
+                try:
+                    sub = parse_geometry(v)
+                except Exception:   # noqa: BLE001 — tolerate odd source
+                    continue
+                g.points.extend(sub.points)
+                g.lines.extend(sub.lines)
+                g.polygons.extend(sub.polygons)
+            if not g.empty:
+                per_doc[i] = g
+        cache[field] = per_doc
+        return per_doc
+
+    def execute(self, ctx, seg):
+        field = ctx.concrete_field(self.field)
+        ft = ctx.field_type(field)
+        if ft is None:
+            if self.ignore_unmapped:
+                return _const_result(seg, 0.0, False)
+            from ..common.errors import QueryShardError
+            raise QueryShardError(
+                f"failed to find type for field [{self.field}]")
+        mask_host = np.zeros(seg.n_pad, bool)
+        if isinstance(ft, GeoPointFieldType):
+            cols = _latlon(seg, field)
+            if cols is None:
+                return _const_result(seg, 0.0, False)
+            lat, lon = cols
+            # group multi-valued points per doc: within/disjoint are
+            # ALL-points relations, not any-point
+            by_doc = {}
+            for doc, la, lo in zip(lat.docs_host, lat.vals_host,
+                                   lon.vals_host):
+                by_doc.setdefault(int(doc), Geometry()).add_point(
+                    float(lo), float(la))
+            for doc, g in by_doc.items():
+                if relate(g, self.shape, self.relation):
+                    mask_host[doc] = True
+            return _mask_result(seg, mask_host, self.boost)
+        if not isinstance(ft, GeoShapeFieldType):
+            from ..common.errors import QueryShardError
+            raise QueryShardError(
+                f"Field [{self.field}] is of unsupported type "
+                f"[{ft.type_name}] for [geo_shape] query")
+        # coarse reject on the indexed bbox columns: only docs whose
+        # bbox interacts with the query bbox run the exact predicate
+        # (disjoint/contains must still check every doc)
+        candidates = None
+        minx = seg.numeric_fields.get(f"{field}._minx")
+        if minx is not None and self.relation in ("intersects", "within") \
+                and not self.shape.empty:
+            qx1, qy1, qx2, qy2 = self.shape.bbox()
+            maxx = seg.numeric_fields[f"{field}._maxx"]
+            miny = seg.numeric_fields[f"{field}._miny"]
+            maxy = seg.numeric_fields[f"{field}._maxy"]
+            ok = ~((maxx.vals_host < qx1) | (minx.vals_host > qx2)
+                   | (maxy.vals_host < qy1) | (miny.vals_host > qy2))
+            candidates = set(int(d) for d in minx.docs_host[ok])
+        per_doc = self._doc_geometries(seg, field)
+        for i, g in enumerate(per_doc):
+            if g is None:
+                # docs without the field never match intersects/within/
+                # contains, and DO match disjoint only when they have
+                # the field in ES — no field, no match, all relations
+                continue
+            if candidates is not None and i not in candidates:
+                continue
+            if relate(g, self.shape, self.relation):
+                mask_host[i] = True
+        return _mask_result(seg, mask_host, self.boost)
+
+
+class RankFeatureQuery(Query):
+    """score = boost · f(value); matches docs that have the feature
+    (``RankFeatureQueryBuilder.java``: saturation / log / sigmoid /
+    linear)."""
+
+    def __init__(self, field: str, function: str, opts: dict,
+                 boost: float = 1.0):
+        self.field = field
+        self.function = function
+        self.opts = opts
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        field = ctx.concrete_field(self.field)
+        ft = ctx.field_type(field)
+        root = field.split(".", 1)[0]
+        root_ft = ctx.field_type(root)
+        if not isinstance(ft, (RankFeatureFieldType,
+                               RankFeaturesFieldType)) and \
+                not isinstance(root_ft, RankFeaturesFieldType):
+            from ..common.errors import QueryShardError
+            raise QueryShardError(
+                f"[rank_feature] query only works on [rank_feature] "
+                f"fields, not [{ft.type_name if ft else None}]")
+        positive = True
+        for t in (ft, root_ft):
+            if isinstance(t, (RankFeatureFieldType,
+                              RankFeaturesFieldType)):
+                positive = t.positive_score_impact
+                break
+        nf = seg.numeric_fields.get(field)
+        if nf is None or nf.vals_host.size == 0:
+            return _const_result(seg, 0.0, False)
+        v = nf.vals_host.astype(np.float64)
+        fn = self.function
+        if fn == "saturation":
+            pivot = self.opts.get("pivot")
+            if pivot is None:
+                # the reference computes an approximate geometric mean
+                # when pivot is omitted
+                pivot = float(np.exp(np.mean(np.log(np.maximum(
+                    v, 1e-9)))))
+            pivot = float(pivot)
+            sc = v / (v + pivot) if positive else pivot / (v + pivot)
+        else:
+            # negative-impact fields store the reciprocal in the
+            # reference, making EVERY function decrease with the value
+            fv = v if positive else 1.0 / np.maximum(v, 1e-9)
+            if fn == "log":
+                scaling = float(self.opts.get("scaling_factor", 1.0))
+                sc = np.log(scaling + fv)
+            elif fn == "sigmoid":
+                pivot = float(self.opts["pivot"])
+                exponent = float(self.opts["exponent"])
+                vp = np.power(fv, exponent)
+                sc = vp / (vp + pivot ** exponent)
+            elif fn == "linear":
+                sc = fv
+            else:
+                sc = None
+        if sc is None:
+            raise ParsingError(
+                f"unknown function [{fn}] for [rank_feature] query")
+        scores_host = np.zeros(seg.n_pad, np.float32)
+        mask_host = np.zeros(seg.n_pad, bool)
+        np.maximum.at(scores_host, nf.docs_host,
+                      (self.boost * sc).astype(np.float32))
+        mask_host[nf.docs_host] = True
+        return jnp.asarray(scores_host), jnp.asarray(mask_host)
+
+
+class PinnedQuery(Query):
+    """Promote the given ids above every organic hit, in the listed
+    order (``PinnedQueryBuilder.java`` — implemented there with giant
+    per-id boosts above the organic score range; same trick here)."""
+
+    # within float32 integer-exact range (eps(1e7)=1) so BASE - rank
+    # stays strictly decreasing; organic scores never approach 1e7
+    _PIN_BASE = np.float32(1e7)
+
+    def __init__(self, ids: List[str], organic: Query,
+                 boost: float = 1.0):
+        self.ids = ids
+        self.organic = organic
+        self.boost = boost
+
+    def execute(self, ctx, seg):
+        scores, mask = self.organic.execute(ctx, seg)
+        scores_host = np.asarray(scores).copy()
+        mask_host = np.asarray(mask).copy()
+        for rank, doc_id in enumerate(self.ids):
+            doc = seg._uid_to_doc.get(str(doc_id))
+            if doc is None or not seg.live[doc]:
+                continue
+            scores_host[doc] = self._PIN_BASE - rank
+            mask_host[doc] = True
+        return jnp.asarray(scores_host), jnp.asarray(mask_host)
+
+
+# ---------------------------------------------------------------------------
+# parsers
+# ---------------------------------------------------------------------------
+
+def _parse_geo_bounding_box(body):
+    opts = dict(body or {})
+    boost = float(opts.pop("boost", 1.0))
+    opts.pop("validation_method", None)
+    opts.pop("type", None)
+    opts.pop("ignore_unmapped", None)
+    opts.pop("_name", None)
+    if len(opts) != 1:
+        raise ParsingError(
+            "[geo_bounding_box] query requires exactly one field")
+    (field, spec), = opts.items()
+    gp = GeoPointFieldType(field)
+    if "wkt" in spec:
+        g = parse_geometry(spec["wkt"])
+        left, bottom, right, top = g.bbox()
+    elif "top_left" in spec or "topLeft" in spec:
+        tl = GeoPointFieldType.parse_value(
+            gp, spec.get("top_left", spec.get("topLeft")))
+        br = GeoPointFieldType.parse_value(
+            gp, spec.get("bottom_right", spec.get("bottomRight")))
+        top, left = tl
+        bottom, right = br
+    elif "top_right" in spec:
+        tr = GeoPointFieldType.parse_value(gp, spec["top_right"])
+        bl = GeoPointFieldType.parse_value(gp, spec["bottom_left"])
+        top, right = tr
+        bottom, left = bl
+    else:
+        try:
+            top = float(spec["top"])
+            left = float(spec["left"])
+            bottom = float(spec["bottom"])
+            right = float(spec["right"])
+        except KeyError as e:
+            raise ParsingError(
+                f"failed to parse [geo_bounding_box] query: missing "
+                f"{e}")
+    if top < bottom:
+        raise ParsingError(
+            f"top is below bottom corner: {top} vs. {bottom}")
+    return GeoBoundingBoxQuery(field, top, left, bottom, right, boost)
+
+
+def _parse_geo_distance(body):
+    opts = dict(body or {})
+    boost = float(opts.pop("boost", 1.0))
+    distance = opts.pop("distance", None)
+    if distance is None:
+        raise ParsingError("geo_distance requires [distance]")
+    opts.pop("distance_type", None)
+    opts.pop("validation_method", None)
+    opts.pop("ignore_unmapped", None)
+    opts.pop("_name", None)
+    if len(opts) != 1:
+        raise ParsingError(
+            "[geo_distance] query requires exactly one field")
+    (field, origin), = opts.items()
+    return GeoDistanceQuery(field, origin, distance, boost)
+
+
+def _parse_geo_shape(body):
+    opts = dict(body or {})
+    boost = float(opts.pop("boost", 1.0))
+    ignore_unmapped = bool(opts.pop("ignore_unmapped", False))
+    opts.pop("_name", None)
+    if len(opts) != 1:
+        raise ParsingError(
+            "[geo_shape] query requires exactly one field")
+    (field, spec), = opts.items()
+    if not isinstance(spec, dict):
+        raise ParsingError("[geo_shape] malformed query")
+    if "indexed_shape" in spec:
+        raise ParsingError(
+            "[geo_shape] indexed_shape is not supported — inline the "
+            "[shape] definition")
+    shape = spec.get("shape")
+    if shape is None:
+        raise ParsingError("[geo_shape] requires a [shape]")
+    try:
+        geom = parse_geometry(shape)
+    except Exception as e:
+        raise ParsingError(f"[geo_shape] failed to parse shape: {e}")
+    return GeoShapeQuery(field, geom,
+                         spec.get("relation", "intersects"), boost,
+                         ignore_unmapped)
+
+
+def _parse_rank_feature(body):
+    if not isinstance(body, dict) or "field" not in body:
+        raise ParsingError("[rank_feature] query requires [field]")
+    opts = dict(body)
+    field = opts.pop("field")
+    boost = float(opts.pop("boost", 1.0))
+    opts.pop("_name", None)
+    functions = [k for k in ("saturation", "log", "sigmoid", "linear")
+                 if k in opts]
+    if len(functions) > 1:
+        raise ParsingError(
+            "[rank_feature] query can only have one of [saturation], "
+            "[log], [sigmoid], [linear]")
+    fn = functions[0] if functions else "saturation"
+    fn_opts = opts.get(fn) or {}
+    if fn == "log" and "scaling_factor" not in fn_opts:
+        raise ParsingError(
+            "[rank_feature] [log] function requires [scaling_factor]")
+    if fn == "sigmoid" and ("pivot" not in fn_opts
+                            or "exponent" not in fn_opts):
+        raise ParsingError(
+            "[rank_feature] [sigmoid] function requires [pivot] and "
+            "[exponent]")
+    return RankFeatureQuery(field, fn, fn_opts, boost)
+
+
+def _parse_pinned(body):
+    if not isinstance(body, dict):
+        raise ParsingError("[pinned] malformed query")
+    ids = body.get("ids")
+    if ids is None:
+        raise ParsingError("[pinned] query requires [ids]")
+    organic_spec = body.get("organic")
+    if organic_spec is None:
+        raise ParsingError("[pinned] query requires [organic]")
+    return PinnedQuery([str(i) for i in ids],
+                       parse_query(organic_spec),
+                       float(body.get("boost", 1.0)))
+
+
+register_query_parser("geo_bounding_box", _parse_geo_bounding_box)
+register_query_parser("geo_distance", _parse_geo_distance)
+register_query_parser("geo_shape", _parse_geo_shape)
+register_query_parser("rank_feature", _parse_rank_feature)
+register_query_parser("pinned", _parse_pinned)

@@ -453,6 +453,12 @@ class TermQuery(Query):
         if isinstance(ft, (NumberFieldType, BooleanFieldType)):
             val = ft.parse_value(self.value)
             return _numeric_range_result(seg, self.field, val, val, self.boost)
+        from ..index.mapping import AggregateMetricDoubleFieldType
+        if isinstance(ft, AggregateMetricDoubleFieldType):
+            # equality against the default_metric column
+            val = float(self.value)
+            return _numeric_range_result(seg, self.field, val, val,
+                                         self.boost)
         return _const_result(seg, 0.0, False)
 
     def collect_highlight_terms(self, ctx, out):
@@ -659,7 +665,13 @@ class RangeQuery(Query):
                 hi = hi - 1 if integral else float(np.nextafter(hi, -np.inf))
             return _range_field_result(seg, self.field, lo, hi,
                                        self.relation, self.boost)
-        if isinstance(ft, (NumberFieldType, BooleanFieldType)):
+        from ..index.mapping import AggregateMetricDoubleFieldType, \
+            RankFeatureFieldType
+        if isinstance(ft, (NumberFieldType, BooleanFieldType,
+                           AggregateMetricDoubleFieldType,
+                           RankFeatureFieldType)):
+            # aggregate_metric_double's bare column carries its
+            # default_metric; rank_feature is an ordinary positive float
             lo = self.gte if self.gte is not None else self.gt
             hi = self.lte if self.lte is not None else self.lt
             lo_v = float(lo) if lo is not None else None
@@ -2325,3 +2337,4 @@ def register_query_parser(name: str, parser) -> None:
 # distance_feature) register themselves through the SPI hook above; the
 # import must come after the registry exists (same pattern as aggs_extra)
 from . import positional as _positional          # noqa: E402, F401
+from . import geo_queries as _geo_queries        # noqa: E402, F401

