@@ -81,10 +81,15 @@ class Coordinator:
 
     def __init__(self, node_id: str, queue: DeterministicTaskQueue,
                  transport: MockTransport, initial: ClusterState,
-                 on_commit: Optional[Callable[[ClusterState], None]] = None):
+                 on_commit: Optional[Callable[[ClusterState], None]] = None,
+                 voting_only: bool = False):
         self.node_id = node_id
         self.queue = queue
         self.transport = transport
+        #: voting-only master-eligible node (x-pack voting-only-node
+        #: plugin, ``VotingOnlyNodePlugin.java``): counts toward voting
+        #: quorums and grants votes, but never runs for master itself
+        self.voting_only = voting_only
         self.persisted = PersistedState(initial)
         self.mode = CANDIDATE
         self.known_leader: Optional[str] = None
@@ -182,6 +187,11 @@ class Coordinator:
         if self.stopped:
             return
         if self.mode == LEADER:
+            return
+        if self.voting_only:
+            # never a candidate for the win; keep watching so vote
+            # handling stays live for other candidates
+            self._schedule_election()
             return
         quiet = self.queue.now - self._last_leader_msg
         if self.mode == FOLLOWER and quiet < self.LEADER_TIMEOUT:

@@ -169,7 +169,10 @@ class MockTransport:
             try:
                 resp = handler(src, payload)
             except Exception as e:       # noqa: BLE001 — remote exception
-                self._schedule_back(dst, src, lambda: finish_err(e))
+                # bind now: the except-name is unbound once the block
+                # exits, and the lambda runs later on the queue
+                self._schedule_back(dst, src,
+                                    lambda err=e: finish_err(err))
                 return
             self._schedule_back(dst, src, lambda: finish_ok(resp))
 

@@ -269,6 +269,8 @@ class RestAPI:
         self.http_publish_address = "127.0.0.1:9200"
         self.voting_exclusions: List[dict] = []
         self.component_templates: Dict[str, dict] = {}
+        #: x-pack logstash plugin pipeline configs (h_logstash_*)
+        self._logstash_pipelines: Dict[str, dict] = {}
         self.cluster_settings: Dict[str, dict] = {"persistent": {},
                                                   "transient": {}}
         self.templates: Dict[str, dict] = {}
@@ -469,6 +471,14 @@ class RestAPI:
             self.h_delete_enrich_policy)
         add("PUT,POST", "/_enrich/policy/{name}/_execute",
             self.h_execute_enrich_policy)
+        # logstash config management (x-pack logstash plugin)
+        add("PUT", "/_logstash/pipeline/{id}", self.h_logstash_put)
+        add("GET", "/_logstash/pipeline", self.h_logstash_get)
+        add("GET", "/_logstash/pipeline/{id}", self.h_logstash_get)
+        add("DELETE", "/_logstash/pipeline/{id}", self.h_logstash_delete)
+        # repositories metering (x-pack repositories-metering-api)
+        add("GET", "/_nodes/{node_id}/_repositories_metering",
+            self.h_repositories_metering)
         # searchable snapshots + frozen indices + autoscaling (x-pack)
         add("POST", "/_snapshot/{repo}/{snap}/_mount",
             self.h_mount_snapshot)
@@ -1564,6 +1574,9 @@ class RestAPI:
             for k, v in (b0.get(scope) or {}).items():
                 if k.startswith("indices.breaker."):
                     _breakers.apply_setting(k, v)
+                if k == "stack.templates.enabled" and \
+                        str(v).lower() == "true":
+                    self.register_stack_templates()
                 if v is None:
                     # null resets a setting to its default
                     self.cluster_settings[scope].pop(k, None)
@@ -3226,6 +3239,115 @@ class RestAPI:
     def h_ml_upgrade_mode(self, params, body):
         return self.ml.set_upgrade_mode(
             params.get("enabled", "false") == "true")
+
+    # ------------------------------------------------------------------
+    # logstash config management + repositories metering (x-pack)
+    # ------------------------------------------------------------------
+
+    def register_stack_templates(self) -> int:
+        """Built-in logs/metrics/synthetics data-stream templates
+        (x-pack ``stack`` plugin — ``StackTemplateRegistry.java``).
+        Off by default so conformance suites see a clean template
+        registry; flipped on via the ``stack.templates.enabled``
+        cluster setting or an explicit call."""
+        components = {
+            "data-streams-mappings": {"template": {"mappings": {
+                "properties": {
+                    "@timestamp": {"type": "date"},
+                    "data_stream": {"properties": {
+                        "dataset": {"type": "constant_keyword"},
+                        "namespace": {"type": "constant_keyword"},
+                        "type": {"type": "constant_keyword"}}}}}}},
+            "logs-mappings": {"template": {"mappings": {"properties": {
+                "message": {"type": "text"},
+                "log": {"properties": {
+                    "level": {"type": "keyword"}}}}}}},
+            "logs-settings": {"template": {"settings": {
+                "index": {"number_of_replicas": 1}}}},
+            "metrics-mappings": {"template": {"mappings": {
+                "properties": {"host": {"properties": {
+                    "name": {"type": "keyword"}}}}}}},
+            "metrics-settings": {"template": {"settings": {
+                "index": {"number_of_replicas": 1}}}},
+            "synthetics-mappings": {"template": {"mappings": {
+                "properties": {"monitor": {"properties": {
+                    "id": {"type": "keyword"}}}}}}},
+            "synthetics-settings": {"template": {"settings": {
+                "index": {"number_of_replicas": 1}}}},
+        }
+        n = 0
+        for name, body in components.items():
+            if name not in self.component_templates:
+                self.component_templates[name] = dict(
+                    body, _meta={"managed": True})
+                n += 1
+        for name, pattern, comps in (
+                ("logs", "logs-*-*",
+                 ["data-streams-mappings", "logs-mappings",
+                  "logs-settings"]),
+                ("metrics", "metrics-*-*",
+                 ["data-streams-mappings", "metrics-mappings",
+                  "metrics-settings"]),
+                ("synthetics", "synthetics-*-*",
+                 ["data-streams-mappings", "synthetics-mappings",
+                  "synthetics-settings"])):
+            if name not in self.templates:
+                self.templates[name] = {
+                    "index_patterns": [pattern],
+                    "composed_of": comps,
+                    "data_stream": {},
+                    "priority": 100,
+                    "_meta": {"managed": True,
+                              "description": f"default {name} template "
+                              f"installed by x-pack"},
+                    "version": 1}
+                n += 1
+        return n
+
+    def h_logstash_put(self, params, body, id):
+        """Centralized logstash pipeline configs (x-pack ``logstash``
+        plugin — CRUD over the ``.logstash`` system index; an in-memory
+        registry carries the same surface)."""
+        doc = _json_body(body)
+        if not doc.get("pipeline"):
+            raise IllegalArgumentError("[pipeline] is required")
+        created = id not in self._logstash_pipelines
+        self._logstash_pipelines[id] = dict(doc, pipeline_id=id)
+        return (201 if created else 200), {}
+
+    def h_logstash_get(self, params, body, id=None):
+        store = self._logstash_pipelines
+        if id is None:
+            return {k: v for k, v in sorted(store.items())}
+        if id not in store:
+            raise ResourceNotFoundError(
+                f"logstash pipeline [{id}] not found")
+        return {id: store[id]}
+
+    def h_logstash_delete(self, params, body, id):
+        store = self._logstash_pipelines
+        if id not in store:
+            raise ResourceNotFoundError(
+                f"logstash pipeline [{id}] not found")
+        del store[id]
+        return {}
+
+    def h_repositories_metering(self, params, body, node_id):
+        """Per-repository blob operation counters
+        (``RepositoriesMeteringAction``)."""
+        repos = []
+        for name, repo in sorted(self.snapshots.repositories.items()):
+            m = getattr(repo, "metering", {})
+            repos.append({
+                "repository_name": name,
+                "repository_type": "fs",
+                "repository_location": {"location": repo.location},
+                "request_counts": {
+                    "PutObject": m.get("PutObject", 0),
+                    "GetObject": m.get("GetObject", 0)}})
+        return {"_nodes": {"total": 1, "successful": 1, "failed": 0},
+                "cluster_name": self.cluster_name,
+                "nodes": {self.node_id: repos}}
 
     # ------------------------------------------------------------------
     # searchable snapshots + frozen + autoscaling

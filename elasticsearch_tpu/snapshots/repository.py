@@ -49,6 +49,11 @@ class FsRepository:
         self.name = name
         self.location = location
         self.compress = compress
+        #: repositories-metering-api counters (x-pack
+        #: repositories-metering: RepositoryStatsSnapshot) — blob-level
+        #: operation + byte counts per repository instance
+        self.metering = {"PutObject": 0, "GetObject": 0,
+                         "bytes_written": 0, "bytes_read": 0}
         os.makedirs(os.path.join(location, "blobs"), exist_ok=True)
 
     # -- blob primitives ----------------------------------------------------
@@ -77,6 +82,9 @@ class FsRepository:
             with open(tmp, "rb") as f:
                 os.fsync(f.fileno())
             os.replace(tmp, blob)
+            # deduped blobs issue no write: count only real uploads
+            self.metering["PutObject"] += 1
+            self.metering["bytes_written"] += size
         return {"name": os.path.basename(path), "hash": digest,
                 "size": size}
 
@@ -87,6 +95,8 @@ class FsRepository:
                 f"repository [{self.name}] is missing blob "
                 f"[{entry['hash']}] for file [{entry['name']}]")
         shutil.copyfile(blob, os.path.join(dest_dir, entry["name"]))
+        self.metering["GetObject"] += 1
+        self.metering["bytes_read"] += int(entry.get("size", 0))
 
     # -- snapshot metadata --------------------------------------------------
 
@@ -183,8 +193,13 @@ class SnapshotsService:
             base = self.path_repo or os.path.join(
                 self.indices.data_path, "repos")
             location = os.path.join(base, location)
+        prev = self.repositories.get(name)
         self.repositories[name] = FsRepository(
             name, location, compress=bool(settings.get("compress", False)))
+        if prev is not None:
+            # metering survives repository setting updates (the
+            # reference archives RepositoryStatsSnapshot across them)
+            self.repositories[name].metering = prev.metering
 
     def get_repository(self, name: str) -> FsRepository:
         repo = self.repositories.get(name)

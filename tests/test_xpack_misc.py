@@ -1,0 +1,100 @@
+"""Logstash pipelines / stack templates / repositories metering /
+voting-only node tests."""
+
+import json
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+@pytest.fixture()
+def api():
+    return RestAPI(IndicesService(tempfile.mkdtemp()))
+
+
+def req(api, method, path, body=None, query=""):
+    b = json.dumps(body).encode() if isinstance(body, (dict, list)) \
+        else (body or b"")
+    st, _ct, out = api.handle(method, path, query, b)
+    return st, (json.loads(out) if out else None)
+
+
+def test_logstash_pipeline_crud(api):
+    doc = {"description": "sample", "pipeline": "input {} output {}",
+           "pipeline_metadata": {"version": 1},
+           "username": "elastic"}
+    st, _ = req(api, "PUT", "/_logstash/pipeline/ingest1", doc)
+    assert st == 201
+    st, _ = req(api, "PUT", "/_logstash/pipeline/ingest1", doc)
+    assert st == 200          # update
+    st, r = req(api, "GET", "/_logstash/pipeline/ingest1")
+    assert r["ingest1"]["pipeline"] == "input {} output {}"
+    st, r = req(api, "GET", "/_logstash/pipeline")
+    assert list(r) == ["ingest1"]
+    st, _ = req(api, "DELETE", "/_logstash/pipeline/ingest1")
+    assert st == 200
+    st, _ = req(api, "GET", "/_logstash/pipeline/ingest1")
+    assert st == 404
+    st, _ = req(api, "PUT", "/_logstash/pipeline/bad", {})
+    assert st == 400
+
+
+def test_stack_templates_via_setting(api):
+    st, r = req(api, "GET", "/_index_template")
+    baseline = len(r.get("index_templates", []))
+    req(api, "PUT", "/_cluster/settings",
+        {"persistent": {"stack.templates.enabled": True}})
+    st, r = req(api, "GET", "/_index_template")
+    names = {t["name"] for t in r["index_templates"]}
+    assert {"logs", "metrics", "synthetics"} <= names
+    assert len(r["index_templates"]) == baseline + 3
+    st, r = req(api, "GET", "/_component_template/logs-mappings")
+    assert st == 200
+    # a logs-*-* data stream now auto-creates through the template
+    st, r = req(api, "PUT", "/_data_stream/logs-app-default")
+    assert st == 200
+
+
+def test_repositories_metering(api, tmp_path):
+    req(api, "PUT", "/_snapshot/bk",
+        {"type": "fs", "settings": {"location": str(tmp_path / "r")}})
+    req(api, "PUT", "/logs/_doc/1", {"m": "x"})
+    req(api, "POST", "/logs/_refresh")
+    req(api, "PUT", "/_snapshot/bk/s1", {"indices": ["logs"]},
+        query="wait_for_completion=true")
+    st, r = req(api, "GET", "/_nodes/_all/_repositories_metering")
+    repos = next(iter(r["nodes"].values()))
+    assert repos[0]["repository_name"] == "bk"
+    assert repos[0]["request_counts"]["PutObject"] > 0
+
+
+def test_voting_only_node_never_becomes_master():
+    from elasticsearch_tpu.cluster.coordination import Coordinator
+    from elasticsearch_tpu.cluster.sim import (DeterministicTaskQueue,
+                                               MockTransport)
+    from elasticsearch_tpu.cluster.state import ClusterState
+
+    queue = DeterministicTaskQueue(7)
+    transport = MockTransport(queue)
+    ids = ["n1", "n2", "nv"]
+    nodes = {
+        nid: Coordinator(nid, queue, transport,
+                         ClusterState.initial(ids),
+                         voting_only=(nid == "nv"))
+        for nid in ids}
+    queue.run_for(10.0)
+    leaders = [n for n, c in nodes.items() if c.mode == "LEADER"]
+    assert len(leaders) == 1 and leaders[0] != "nv"
+    # kill the leader; the OTHER full node must win (quorum needs the
+    # voting-only node's vote), and nv still never becomes master
+    dead = leaders[0]
+    nodes[dead].stop()
+    queue.run_for(30.0)
+    alive_leader = [n for n, c in nodes.items()
+                    if c.mode == "LEADER" and n != dead]
+    expected = [n for n in ids if n not in (dead, "nv")]
+    assert alive_leader == expected
+    assert nodes["nv"].mode != "LEADER"
