@@ -57,9 +57,11 @@ AVG_DL = 32
 BATCH = 64                 # queries per dispatch
 N_TERMS = 4                # terms per query
 K = 10
-TIMED_ITERS = 128          # percentile sample size: p99 interpolates near
-                           # the top sample, so keep the pool deep enough
-CPU_REF_QUERIES = 32       # CPU reference is ~0.2 s/query at 8.4M docs
+TIMED_ITERS = 64           # percentile sample size: p99 interpolates near
+                           # the top sample; 64 keeps the accel pass
+                           # inside the driver's wall-clock budget over
+                           # the tunneled chip
+CPU_REF_QUERIES = 12       # CPU reference is ~4-8 s/query at 8.4M docs
 K1, B = 1.2, 0.75
 
 
@@ -275,7 +277,7 @@ def bench_bool_disjunction(rng, corpus, plane, on_cpu):
     """Config #2: bool should-disjunction = 8-term bag-of-terms queries
     through the same tiered kernel (weights via duplicate terms)."""
     n_terms = 8
-    iters = 16 if on_cpu else 64
+    iters = 16 if on_cpu else 24
     df = corpus["df"].astype(np.float64)
     eligible = np.flatnonzero(df >= 2)
     p = df[eligible] / df[eligible].sum()
