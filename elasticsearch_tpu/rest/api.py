@@ -537,6 +537,23 @@ class RestAPI:
         add("DELETE", "/_security/api_key", self.h_invalidate_api_key)
         add("GET", "/_security/api_key", self.h_get_api_keys)
         add("GET", "/_security/_authenticate", self.h_authenticate)
+        # native users + roles (x-pack security RBAC — security/rbac.py)
+        add("GET,POST", "/_security/user/_has_privileges",
+            self.h_has_privileges)
+        add("PUT,POST", "/_security/user/{username}", self.h_put_user)
+        add("GET", "/_security/user", self.h_get_users)
+        add("GET", "/_security/user/{username}", self.h_get_users)
+        add("DELETE", "/_security/user/{username}", self.h_delete_user)
+        add("PUT,POST", "/_security/user/{username}/_password",
+            self.h_change_password)
+        add("PUT,POST", "/_security/user/{username}/_enable",
+            self.h_enable_user)
+        add("PUT,POST", "/_security/user/{username}/_disable",
+            self.h_disable_user)
+        add("PUT,POST", "/_security/role/{name}", self.h_put_role)
+        add("GET", "/_security/role", self.h_get_roles)
+        add("GET", "/_security/role/{name}", self.h_get_roles)
+        add("DELETE", "/_security/role/{name}", self.h_delete_role)
         add("GET", "/_nodes/hot_threads", self.h_hot_threads)
         add("GET", "/_nodes/{node_id}/hot_threads", self.h_hot_threads)
         add("POST", "/_nodes/reload_secure_settings",
@@ -786,7 +803,16 @@ class RestAPI:
             try:
                 self._principal_tls.value = \
                     self.security.authenticate(headers)
-            except Exception as e:   # noqa: BLE001 — 401 as ES error body
+                # role-based authorization on every route except the
+                # self-service endpoints any authenticated user may
+                # call (AuthorizationService.authorize +
+                # RestAuthenticateAction / HasPrivileges)
+                if path.rstrip("/") not in (
+                        "/_security/_authenticate",
+                        "/_security/user/_has_privileges"):
+                    self.security.rbac.authorize(
+                        self._principal_tls.value, method, path)
+            except Exception as e:   # noqa: BLE001 — 401/403 ES body
                 status, payload = _error_payload(e)
                 return status, JSON_CT, json.dumps(payload).encode()
         if not getattr(self._internal_tls, "active", False):
@@ -2741,7 +2767,9 @@ class RestAPI:
         if exp:
             from ..common.settings import parse_time_millis
             exp_ms = int(parse_time_millis(exp))
-        out = self.security.create_key(name, expiration_ms=exp_ms)
+        out = self.security.create_key(
+            name, expiration_ms=exp_ms,
+            role_descriptors=b.get("role_descriptors"))
         return {"id": out["id"], "name": out["name"],
                 "api_key": out["api_key"], "encoded": out["encoded"]}
 
@@ -2762,9 +2790,50 @@ class RestAPI:
             return {"username": "_anonymous", "roles": ["superuser"],
                     "authentication_type": "anonymous"}
         p = getattr(self._principal_tls, "value", None) or {}
-        return {"username": p.get("username"), "roles": ["superuser"],
+        # API keys report no role names (their effective privileges are
+        # the key's role_descriptors); realm users report their roles
+        return {"username": p.get("username"),
+                "roles": p.get("roles", []),
                 "authentication_type": p.get("authentication_type"),
                 "api_key": p.get("api_key")}
+
+    def _principal(self) -> dict:
+        return getattr(self._principal_tls, "value", None) or \
+            {"username": "_anonymous", "roles": ["superuser"]}
+
+    def h_put_user(self, params, body, username):
+        return self.security.rbac.put_user(username, _json_body(body))
+
+    def h_get_users(self, params, body, username=None):
+        return self.security.rbac.get_users(username)
+
+    def h_delete_user(self, params, body, username):
+        out = self.security.rbac.delete_user(username)
+        return (200 if out["found"] else 404), out
+
+    def h_change_password(self, params, body, username):
+        return self.security.rbac.change_password(username,
+                                                  _json_body(body))
+
+    def h_enable_user(self, params, body, username):
+        return self.security.rbac.set_enabled(username, True)
+
+    def h_disable_user(self, params, body, username):
+        return self.security.rbac.set_enabled(username, False)
+
+    def h_put_role(self, params, body, name):
+        return self.security.rbac.put_role(name, _json_body(body))
+
+    def h_get_roles(self, params, body, name=None):
+        return self.security.rbac.get_roles(name)
+
+    def h_delete_role(self, params, body, name):
+        out = self.security.rbac.delete_role(name)
+        return (200 if out["found"] else 404), out
+
+    def h_has_privileges(self, params, body):
+        return self.security.rbac.has_privileges(self._principal(),
+                                                 _json_body(body))
 
     # -- async search (x-pack async-search analog:
     # TransportSubmitAsyncSearchAction.java:48) ------------------------
@@ -2931,10 +3000,12 @@ class RestAPI:
         return self._eql_svc
 
     def h_eql_search(self, params, body, index):
+        self._deny_if_restricted(index)
         self.indices.resolve(index)      # 404 before parsing, like ES
         return self.eql.search(index, _json_body(body))
 
     def h_graph_explore(self, params, body, index):
+        self._deny_if_restricted(index)
         """POST /{index}/_graph/explore (x-pack graph analog)."""
         self.indices.resolve(index)
         from ..xpack.graph import GraphService
@@ -4533,8 +4604,9 @@ class RestAPI:
             svc.refresh()
         r = svc.get_doc(id, routing=params.get("routing"))
         realtime = params.get("realtime") not in ("false",)
-        if not r.found or not self._doc_visible(svc, id, realtime,
-                                                params.get("routing")):
+        visible, fls = self._doc_read_guard(index, id)
+        if not r.found or not visible or not self._doc_visible(
+                svc, id, realtime, params.get("routing")):
             return 404, {"_index": index, "_id": id, "found": False}
         if params.get("version"):
             want = int(params["version"])
@@ -4561,7 +4633,7 @@ class RestAPI:
                 r.source, True if src_spec is None else src_spec)
         if getattr(r, "routing", None) is not None:
             out["_routing"] = r.routing
-        return out
+        return self._fls_trim_doc(out, fls)
 
     def h_get_source(self, params, body, index, id):
         svc = self.indices.get(index)
@@ -4572,13 +4644,19 @@ class RestAPI:
             svc.refresh()
         r = svc.get_doc(id, routing=params.get("routing"))
         realtime = params.get("realtime") not in ("false",)
-        if not r.found or not self._doc_visible(svc, id, realtime,
-                                                params.get("routing")):
+        visible, fls = self._doc_read_guard(index, id)
+        if not r.found or not visible or not self._doc_visible(
+                svc, id, realtime, params.get("routing")):
             return 404, {"error": f"document [{id}] missing", "status": 404}
         src_spec = self._get_source_spec(params)
         from ..search.fetch import filter_source
-        return filter_source(r.source,
-                             True if src_spec is None else src_spec)
+        out_src = filter_source(r.source,
+                                True if src_spec is None else src_spec)
+        if fls is not None and isinstance(out_src, dict):
+            import fnmatch
+            out_src = {k: v for k, v in out_src.items()
+                       if any(fnmatch.fnmatchcase(k, g) for g in fls)}
+        return out_src
 
     def h_delete_doc(self, params, body, index, id):
         svc = self.indices.get(index)
@@ -4836,6 +4914,21 @@ class RestAPI:
                 out.append(entry)
             else:
                 out.append({"_index": idx, "_id": doc_id, "found": False})
+        if self.security.enabled and self.enforce_security and \
+                not getattr(self._internal_tls, "active", False):
+            # per-doc DLS visibility + FLS trim, like the single get
+            for d in out:
+                if not d.get("found"):
+                    continue
+                visible, fls = self._doc_read_guard(d["_index"],
+                                                    d["_id"])
+                if not visible:
+                    idx_, id_ = d["_index"], d["_id"]
+                    d.clear()
+                    d.update({"_index": idx_, "_id": id_,
+                              "found": False})
+                else:
+                    self._fls_trim_doc(d, fls)
         return {"docs": out}
 
     def _get_or_autocreate(self, index: str) -> IndexService:
@@ -6390,6 +6483,11 @@ class RestAPI:
                                     remote_parts)
         names = self._resolve_search_indices(index, params)
         search_body = _json_body(body)
+        fls_grant = None
+        if self.security.enabled and self.enforce_security and \
+                not getattr(self._internal_tls, "active", False):
+            search_body, fls_grant = self._apply_dls_fls(
+                names, search_body)
         # URL-param forms of fetch options (they OVERRIDE body _source
         # filtering, RestSearchAction.parseSearchSource)
         if "_source_includes" in params or "_source_excludes" in params:
@@ -6515,7 +6613,184 @@ class RestAPI:
                     t = ih.get("hits", {}).get("total")
                     if isinstance(t, dict):
                         ih["hits"]["total"] = t["value"]
+        if fls_grant is not None:
+            self._apply_fls(out, fls_grant)
         return out
+
+    def _restrictions_for(self, names):
+        """(dls_queries, fls_grant) for a set of target indices, or
+        (None, None) when the principal is unrestricted.  Mixed
+        restrictions across indices in ONE request are rejected rather
+        than risk cross-index leakage through a shared filter."""
+        principal = self._principal()
+        if "superuser" in (principal.get("roles") or []):
+            return None, None
+        per_index = [self.security.rbac.dls_fls(principal, n)
+                     for n in names]
+        if not per_index:
+            return None, None
+        first = per_index[0]
+        if any(p != first for p in per_index[1:]):
+            from ..security.rbac import AuthorizationError
+            raise AuthorizationError(
+                "searching across indices with differing document- or "
+                "field-level security is not supported in one request")
+        queries, fls = first
+        return (queries or None), fls
+
+    #: body sections whose field references would leak restricted
+    #: values past an _source-level trim
+    _FLS_SENSITIVE = ("aggs", "aggregations", "sort", "docvalue_fields",
+                      "script_fields", "highlight", "suggest",
+                      "collapse", "runtime_mappings")
+
+    def _apply_dls_fls(self, names, search_body):
+        """Document- and field-level security for one search request
+        (``authz/accesscontrol/SecurityIndexSearcherWrapper`` analog:
+        DLS role queries filter the query; FLS grants trim _source)."""
+        queries, fls = self._restrictions_for(names)
+        if queries:
+            dls = {"bool": {"should": queries,
+                            "minimum_should_match": 1}} \
+                if len(queries) > 1 else queries[0]
+            orig = search_body.get("query") or {"match_all": {}}
+            search_body = dict(search_body,
+                               query={"bool": {"must": [orig],
+                                               "filter": [dls]}})
+        if fls is not None:
+            # sections that surface raw field VALUES outside _source
+            # (agg buckets, sort keys, highlights …) cannot be trimmed
+            # after the fact — reject unless every referenced field is
+            # granted
+            import fnmatch
+
+            def granted(f):
+                return any(fnmatch.fnmatchcase(str(f), g) for g in fls)
+
+            def scan(node):
+                if isinstance(node, dict):
+                    for k, v in node.items():
+                        if k == "field" and isinstance(v, str) and \
+                                not granted(v):
+                            return v
+                        if k == "fields" and isinstance(v, list):
+                            for f in v:
+                                fv = f.get("field") if \
+                                    isinstance(f, dict) else f
+                                if isinstance(fv, str) and \
+                                        not granted(fv):
+                                    return fv
+                        bad = scan(v)
+                        if bad:
+                            return bad
+                elif isinstance(node, list):
+                    for v in node:
+                        bad = scan(v)
+                        if bad:
+                            return bad
+                return None
+
+            for section in self._FLS_SENSITIVE:
+                spec = search_body.get(section)
+                if spec is None:
+                    continue
+                if section == "sort":
+                    items = spec if isinstance(spec, list) else [spec]
+                    for s in items:
+                        fields = [s] if isinstance(s, str) else \
+                            list(s) if isinstance(s, dict) else []
+                        for f in fields:
+                            if f not in ("_score", "_doc",
+                                         "_shard_doc") and \
+                                    not granted(f):
+                                self._fls_reject(f)
+                    continue
+                bad = scan(spec)
+                if bad:
+                    self._fls_reject(bad)
+        return search_body, fls
+
+    @staticmethod
+    def _fls_reject(field):
+        from ..security.rbac import AuthorizationError
+        raise AuthorizationError(
+            f"field [{field}] is not granted by this role's field "
+            f"level security")
+
+    def _doc_read_guard(self, index: str, doc_id: str):
+        """DLS/FLS for single-document reads.  Returns the FLS grant
+        (or None); raises not-visible as a KeyError-style miss by
+        returning False when the DLS query excludes the doc.  The DLS
+        check runs as an internal ids+filter search — the reference
+        likewise rewrites realtime gets to a filtered search when DLS
+        applies (``SecuritySearchOperationListener``)."""
+        if not (self.security.enabled and self.enforce_security) or \
+                getattr(self._internal_tls, "active", False):
+            return True, None
+        queries, fls = self._restrictions_for([index])
+        if queries:
+            dls = {"bool": {"should": queries,
+                            "minimum_should_match": 1}} \
+                if len(queries) > 1 else queries[0]
+            resp = self.internal_search(index, {
+                "size": 0, "track_total_hits": True,
+                "query": {"bool": {
+                    "filter": [{"ids": {"values": [doc_id]}}, dls]}}})
+            if resp["hits"]["total"]["value"] == 0:
+                return False, fls
+        return True, fls
+
+    def _fls_trim_doc(self, out: dict, fls) -> dict:
+        if fls is None:
+            return out
+        import fnmatch
+
+        def allowed(f):
+            return any(fnmatch.fnmatchcase(f, g) for g in fls)
+
+        if isinstance(out.get("_source"), dict):
+            out["_source"] = {k: v for k, v in out["_source"].items()
+                              if allowed(k)}
+        if isinstance(out.get("fields"), dict):
+            out["fields"] = {k: v for k, v in out["fields"].items()
+                             if allowed(k)}
+        return out
+
+    def _deny_if_restricted(self, index_expr):
+        """Endpoints whose responses can't be post-filtered (explain,
+        termvectors, EQL, graph) refuse under DLS/FLS rather than
+        leak."""
+        if not (self.security.enabled and self.enforce_security) or \
+                getattr(self._internal_tls, "active", False):
+            return
+        try:
+            names = self.indices.resolve(index_expr)
+        except Exception:   # noqa: BLE001 — missing index: 404 later
+            return
+        queries, fls = self._restrictions_for(names)
+        if queries or fls is not None:
+            from ..security.rbac import AuthorizationError
+            raise AuthorizationError(
+                "this endpoint is not available for roles with "
+                "document- or field-level security")
+
+    @staticmethod
+    def _apply_fls(out, grant):
+        """Trim every hit's _source to the granted field patterns."""
+        import fnmatch
+
+        def allowed(field):
+            return any(fnmatch.fnmatchcase(field, g) for g in grant)
+
+        for hit in out.get("hits", {}).get("hits", []):
+            src = hit.get("_source")
+            if isinstance(src, dict):
+                hit["_source"] = {k: v for k, v in src.items()
+                                  if allowed(k)}
+            flds = hit.get("fields")
+            if isinstance(flds, dict):
+                hit["fields"] = {k: v for k, v in flds.items()
+                                 if allowed(k)}
 
     def h_validate_query(self, params, body, index=None):
         """Query validation (reference: ``RestValidateQueryAction``):
@@ -6588,6 +6863,16 @@ class RestAPI:
                 qs["analyzer"] = params["analyzer"]
             b = {"query": {"query_string": qs}}
         self._rewrite_terms_lookup(b)
+        if self.security.enabled and self.enforce_security and \
+                not getattr(self._internal_tls, "active", False):
+            queries, _fls = self._restrictions_for(names)
+            if queries:
+                dls = {"bool": {"should": queries,
+                                "minimum_should_match": 1}} \
+                    if len(queries) > 1 else queries[0]
+                orig = b.get("query") or {"match_all": {}}
+                b = dict(b, query={"bool": {"must": [orig],
+                                            "filter": [dls]}})
         total = 0
         for n in names:
             total += self.indices.indices[n].count(b)
@@ -6792,6 +7077,7 @@ class RestAPI:
         return run()
 
     def h_explain(self, params, body, index, id):
+        self._deny_if_restricted(index)
         """Score explanation for one document (reference:
         ``RestExplainAction`` → ``TransportExplainAction``): the query
         executes against the owning segment and the per-top-level-clause
@@ -6953,6 +7239,7 @@ class RestAPI:
         return {"_index": concrete, "_id": id, "found": False}
 
     def h_termvectors(self, params, body, index, id=None):
+        self._deny_if_restricted(index)
         """Term vectors of one doc's text fields (reference:
         ``RestTermVectorsAction``): term freq, positions + re-analyzed
         offsets, and (with ``term_statistics=true``) df/ttf."""

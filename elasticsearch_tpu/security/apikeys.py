@@ -59,19 +59,30 @@ class SecurityService:
                  persist_path: Optional[str] = None):
         self.enabled = enabled
         self.persist_path = persist_path
+        # native users + roles + the authorize() checkpoint (rbac.py)
+        from .rbac import RbacService
+        self.rbac = RbacService()
+        self.rbac._on_change = self._persist
         #: key id -> record (secret_hash/salt, name, creation, invalidated)
         self._keys: Dict[str, dict] = {}
         if persist_path and os.path.exists(persist_path):
             try:
                 with open(persist_path) as f:
-                    self._keys = json.load(f)
+                    blob = json.load(f)
+                if "keys" in blob or "users" in blob:
+                    self._keys = blob.get("keys") or {}
+                    self.rbac.users = blob.get("users") or {}
+                    self.rbac.roles = blob.get("roles") or {}
+                else:           # pre-RBAC file layout: keys only
+                    self._keys = blob
             except (OSError, ValueError):
                 self._keys = {}
 
     # -- key lifecycle ---------------------------------------------------
 
     def create_key(self, name: str,
-                   expiration_ms: Optional[int] = None) -> dict:
+                   expiration_ms: Optional[int] = None,
+                   role_descriptors: Optional[dict] = None) -> dict:
         """Returns {id, name, api_key, encoded} — the cleartext secret
         appears ONLY in this response (the store keeps the hash)."""
         key_id = secrets.token_urlsafe(15)
@@ -85,6 +96,7 @@ class SecurityService:
             "expiration": (int(time.time() * 1000) + expiration_ms)
             if expiration_ms else None,
             "invalidated": False,
+            "role_descriptors": role_descriptors or None,
         }
         self._persist()
         return {"id": key_id, "name": name, "api_key": secret,
@@ -117,7 +129,8 @@ class SecurityService:
             return
         tmp = self.persist_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(self._keys, f)
+            json.dump({"keys": self._keys, "users": self.rbac.users,
+                       "roles": self.rbac.roles}, f)
         os.replace(tmp, self.persist_path)
 
     # -- authentication --------------------------------------------------
@@ -157,8 +170,34 @@ class SecurityService:
                 raise AuthenticationError(
                     "unable to authenticate api key "
                     f"[{key_id}]")
-            return {"username": name, "authentication_type": "api_key",
-                    "api_key": {"id": key_id, "name": name}}
+            rec = self._keys.get(key_id) or {}
+            principal = {"username": name,
+                         "authentication_type": "api_key",
+                         "api_key": {"id": key_id, "name": name}}
+            rds = rec.get("role_descriptors")
+            if rds:
+                # API keys with role_descriptors are LIMITED to them;
+                # without, they act as the superuser-equivalent owner
+                # (the observable shape of the reference's owner-scoped
+                # keys under the default operator setup)
+                principal["_inline_roles"] = list(rds.values()) \
+                    if isinstance(rds, dict) else list(rds)
+            else:
+                principal["roles"] = ["superuser"]
+            return principal
+        if scheme.lower() == "basic":
+            try:
+                decoded = base64.b64decode(value.strip()).decode()
+                username, _, password = decoded.partition(":")
+            except Exception:   # noqa: BLE001 — malformed header
+                raise AuthenticationError(
+                    "unable to authenticate with provided credentials")
+            view = self.rbac.verify_password(username, password)
+            if view is None:
+                raise AuthenticationError(
+                    f"unable to authenticate user [{username}] for "
+                    f"REST request")
+            return dict(view, authentication_type="realm")
         raise AuthenticationError(
             f"unsupported authentication scheme [{scheme}]")
 

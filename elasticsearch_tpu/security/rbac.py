@@ -1,0 +1,437 @@
+"""Native users, roles, and role-based authorization.
+
+Reference: ``x-pack/plugin/security/`` — the native realm
+(``authc/esnative/NativeUsersStore.java``) stores PBKDF2-hashed users in
+a system index; the role store (``authz/store/NativeRolesStore.java``)
+holds role descriptors with cluster privileges, index privileges,
+document-level security queries, and field-level security grants; the
+authorization service (``authz/AuthorizationService.java``) resolves the
+union of a user's roles and checks every transport action against them.
+
+Same model here, sized to this build: users/roles live in the service
+(persisted beside the API keys when a path is configured), Basic auth
+rides the same ``authenticate`` entry the API keys use, and every REST
+dispatch classifies into (scope, privilege-kind) — the observable
+granularity of the reference's action matrix: index read / write /
+admin / monitor, cluster monitor / admin — plus DLS/FLS effects that
+the search path applies.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common.errors import (ElasticsearchError, IllegalArgumentError,
+                             ResourceNotFoundError)
+
+
+class AuthorizationError(ElasticsearchError):
+    error_type = "security_exception"
+    status = 403
+
+
+def _hash_pw(password: str, salt: bytes) -> str:
+    return hashlib.pbkdf2_hmac("sha256", password.encode(), salt,
+                               10_000).hex()
+
+
+#: index privilege → granted kinds (IndexPrivilege.java's named sets)
+_INDEX_PRIVS: Dict[str, frozenset] = {
+    "all": frozenset({"read", "write", "admin", "monitor"}),
+    "read": frozenset({"read"}),
+    "write": frozenset({"write"}),
+    "index": frozenset({"write"}),
+    "create": frozenset({"write"}),
+    "create_doc": frozenset({"write"}),
+    "delete": frozenset({"write"}),
+    "create_index": frozenset({"admin"}),
+    "delete_index": frozenset({"admin"}),
+    "manage": frozenset({"admin", "monitor"}),
+    "monitor": frozenset({"monitor"}),
+    "view_index_metadata": frozenset({"monitor"}),
+}
+
+#: cluster privilege → granted kinds (ClusterPrivilegeResolver.java)
+_CLUSTER_PRIVS: Dict[str, frozenset] = {
+    "all": frozenset({"monitor", "admin"}),
+    "monitor": frozenset({"monitor"}),
+    "manage": frozenset({"monitor", "admin"}),
+    "manage_security": frozenset({"monitor", "admin"}),
+    "manage_index_templates": frozenset({"monitor", "admin"}),
+    "manage_ml": frozenset({"monitor", "admin"}),
+    "manage_ilm": frozenset({"monitor", "admin"}),
+    "manage_slm": frozenset({"monitor", "admin"}),
+}
+
+#: built-in reserved roles (subset of ReservedRolesStore.java)
+BUILTIN_ROLES: Dict[str, dict] = {
+    "superuser": {
+        "cluster": ["all"],
+        "indices": [{"names": ["*"], "privileges": ["all"],
+                     "allow_restricted_indices": True}],
+        "metadata": {"_reserved": True}},
+    "monitoring_user": {
+        "cluster": ["monitor"],
+        "indices": [{"names": [".monitoring-*"],
+                     "privileges": ["read"]}],
+        "metadata": {"_reserved": True}},
+    "viewer": {
+        "cluster": [],
+        "indices": [{"names": ["*"], "privileges": ["read",
+                                                    "view_index_metadata"]}],
+        "metadata": {"_reserved": True}},
+    "editor": {
+        "cluster": [],
+        "indices": [{"names": ["*"],
+                     "privileges": ["read", "write", "create_index",
+                                    "view_index_metadata"]}],
+        "metadata": {"_reserved": True}},
+}
+
+
+#: top-level (indexless) endpoints that are DATA operations over all
+#: indices, not cluster admin — they authorize as index ops on "*"
+_TOP_LEVEL_READ = {"_search", "_msearch", "_count", "_mget",
+                   "_field_caps", "_rank_eval", "_async_search",
+                   "_knn_search", "_sql", "_render", "_search_shards",
+                   "_mtermvectors", "_pit"}
+_TOP_LEVEL_WRITE = {"_bulk", "_reindex"}
+
+
+def classify_request(method: str, path: str) -> Tuple[str, str, str]:
+    """(scope, kind, index_expr) for one REST request — the authz
+    checkpoint granularity.  scope: "index"|"cluster".  kind for index:
+    read|write|admin|monitor; for cluster: monitor|admin."""
+    p = path.rstrip("/") or "/"
+    if p == "/" or p in ("/_xpack", "/_license"):
+        return "cluster", "monitor", ""
+    seg = p.split("/")[1]
+    if seg.startswith("_"):
+        base = seg.split("?")[0]
+        if base in _TOP_LEVEL_READ:
+            return "index", "read", "*"
+        if base in _TOP_LEVEL_WRITE:
+            return "index", "write", "*"
+        if base == "_security":
+            # user/role/key management is privileged regardless of verb
+            # (manage_security); self-service paths are exempted at the
+            # dispatch layer before this runs
+            return "cluster", "admin", ""
+        if method == "GET":
+            return "cluster", "monitor", ""
+        return "cluster", "admin", ""
+    index = seg
+    rest = "/" + "/".join(p.split("/")[2:]) if "/" in p[1:] else ""
+    read_eps = ("_search", "_msearch", "_count", "_doc", "_source",
+                "_mget", "_explain", "_termvectors", "_mtermvectors",
+                "_field_caps", "_rank_eval", "_validate", "_graph",
+                "_knn_search", "_eql", "_async_search", "_pit",
+                "_searchable_snapshots")
+    write_eps = ("_bulk", "_create", "_update", "_delete_by_query",
+                 "_update_by_query", "_rollover")
+    monitor_eps = ("_stats", "_segments", "_recovery", "_shard_stores",
+                   "_settings", "_mapping", "_alias", "_ilm")
+    first = rest.split("/")[1] if len(rest) > 1 else ""
+    if first in read_eps:
+        if first == "_doc" and method in ("PUT", "POST", "DELETE"):
+            return "index", "write", index
+        return "index", "read", index
+    if first in write_eps:
+        return "index", "write", index
+    if first in monitor_eps and method in ("GET", "HEAD"):
+        return "index", "monitor", index
+    if not first and method in ("GET", "HEAD"):
+        return "index", "monitor", index
+    return "index", "admin", index
+
+
+class RbacService:
+    """Users + roles + the authorize() checkpoint."""
+
+    def __init__(self):
+        self.users: Dict[str, dict] = {}
+        self.roles: Dict[str, dict] = {}
+        #: owner's persistence hook (SecurityService wires its own)
+        self._on_change = lambda: None
+
+    # -- users -----------------------------------------------------------
+    def put_user(self, username: str, body: dict) -> dict:
+        if not re.fullmatch(r"[a-zA-Z0-9_@.+-]+", username or ""):
+            raise IllegalArgumentError(
+                f"invalid user name [{username}]")
+        existing = self.users.get(username)
+        password = body.get("password")
+        if password is None and existing is None:
+            raise IllegalArgumentError(
+                "password must be specified unless you are updating an "
+                "existing user")
+        if password is not None and len(str(password)) < 6:
+            raise IllegalArgumentError(
+                "passwords must be at least [6] characters long")
+        rec = dict(existing or {})
+        if password is not None:
+            salt = os.urandom(16)
+            rec["salt"] = salt.hex()
+            rec["hash"] = _hash_pw(str(password), salt)
+        rec["roles"] = list(body.get("roles",
+                                     rec.get("roles") or []))
+        for k in ("full_name", "email", "metadata"):
+            if k in body:
+                rec[k] = body[k]
+        rec.setdefault("enabled", True)
+        created = existing is None
+        self.users[username] = rec
+        self._on_change()
+        return {"created": created}
+
+    def get_users(self, username: Optional[str]) -> dict:
+        if username:
+            missing = [u for u in username.split(",")
+                       if u not in self.users]
+            if missing:
+                raise ResourceNotFoundError(
+                    f"user [{missing[0]}] not found")
+            names = username.split(",")
+        else:
+            names = sorted(self.users)
+        return {u: self._user_view(u) for u in names}
+
+    def _user_view(self, username: str) -> dict:
+        r = self.users[username]
+        return {"username": username, "roles": r.get("roles") or [],
+                "full_name": r.get("full_name"),
+                "email": r.get("email"),
+                "metadata": r.get("metadata") or {},
+                "enabled": r.get("enabled", True)}
+
+    def delete_user(self, username: str) -> dict:
+        if username not in self.users:
+            return {"found": False}
+        del self.users[username]
+        self._on_change()
+        return {"found": True}
+
+    def change_password(self, username: str, body: dict) -> dict:
+        if username not in self.users:
+            raise ResourceNotFoundError(f"user [{username}] not found")
+        password = body.get("password")
+        if not password or len(str(password)) < 6:
+            raise IllegalArgumentError(
+                "passwords must be at least [6] characters long")
+        salt = os.urandom(16)
+        self.users[username]["salt"] = salt.hex()
+        self.users[username]["hash"] = _hash_pw(str(password), salt)
+        self._on_change()
+        return {}
+
+    def set_enabled(self, username: str, enabled: bool) -> dict:
+        if username not in self.users:
+            raise ResourceNotFoundError(f"user [{username}] not found")
+        self.users[username]["enabled"] = enabled
+        self._on_change()
+        return {}
+
+    def verify_password(self, username: str,
+                        password: str) -> Optional[dict]:
+        rec = self.users.get(username)
+        if rec is None or not rec.get("enabled", True):
+            return None
+        if _hash_pw(password, bytes.fromhex(rec["salt"])) != rec["hash"]:
+            return None
+        return self._user_view(username)
+
+    # -- roles -----------------------------------------------------------
+    def put_role(self, name: str, body: dict) -> dict:
+        if name in BUILTIN_ROLES:
+            raise IllegalArgumentError(
+                f"role [{name}] is reserved and cannot be modified")
+        for priv in body.get("cluster") or []:
+            if priv not in _CLUSTER_PRIVS:
+                raise IllegalArgumentError(
+                    f"unknown cluster privilege [{priv}]")
+        for entry in body.get("indices") or []:
+            if not entry.get("names"):
+                raise IllegalArgumentError(
+                    "indices privileges must refer to at least one "
+                    "index name")
+            for priv in entry.get("privileges") or []:
+                if priv not in _INDEX_PRIVS:
+                    raise IllegalArgumentError(
+                        f"unknown index privilege [{priv}]")
+            if not entry.get("privileges"):
+                raise IllegalArgumentError(
+                    "indices privileges must define at least one "
+                    "privilege")
+        created = name not in self.roles
+        self.roles[name] = {
+            "cluster": list(body.get("cluster") or []),
+            "indices": [dict(e) for e in body.get("indices") or []],
+            "run_as": list(body.get("run_as") or []),
+            "metadata": body.get("metadata") or {},
+            "transient_metadata": {"enabled": True}}
+        self._on_change()
+        return {"role": {"created": created}}
+
+    def get_roles(self, name: Optional[str]) -> dict:
+        all_roles = {**BUILTIN_ROLES, **self.roles}
+        if name:
+            missing = [n for n in name.split(",")
+                       if n not in all_roles]
+            if missing:
+                raise ResourceNotFoundError(
+                    f"role [{missing[0]}] not found")
+            names = name.split(",")
+        else:
+            names = sorted(self.roles)     # GET all lists custom only
+        return {n: self._role_view(all_roles[n]) for n in names}
+
+    @staticmethod
+    def _role_view(r: dict) -> dict:
+        return {"cluster": r.get("cluster") or [],
+                "indices": r.get("indices") or [],
+                "run_as": r.get("run_as") or [],
+                "metadata": {k: v for k, v in
+                             (r.get("metadata") or {}).items()
+                             if not k.startswith("_")},
+                "transient_metadata": {"enabled": True}}
+
+    def delete_role(self, name: str) -> dict:
+        if name in BUILTIN_ROLES:
+            raise IllegalArgumentError(
+                f"role [{name}] is reserved and cannot be deleted")
+        if name not in self.roles:
+            return {"found": False}
+        del self.roles[name]
+        self._on_change()
+        return {"found": True}
+
+    # -- authorization ---------------------------------------------------
+    def _resolve(self, role_names: List[str],
+                 inline: Optional[List[dict]] = None) -> List[dict]:
+        out = []
+        for n in role_names or []:
+            r = self.roles.get(n) or BUILTIN_ROLES.get(n)
+            if r is not None:
+                out.append(r)
+        out.extend(inline or [])
+        return out
+
+    @staticmethod
+    def _index_matches(patterns: List[str], index: str) -> bool:
+        import fnmatch
+        return any(fnmatch.fnmatchcase(index, p) for p in patterns)
+
+    def authorize(self, principal: dict, method: str,
+                  path: str) -> None:
+        """403 unless some resolved role grants the classified
+        (scope, kind) on the target (AuthorizationService.authorize)."""
+        roles = self._resolve(principal.get("roles") or [],
+                              principal.get("_inline_roles"))
+        scope, kind, index_expr = classify_request(method, path)
+        username = principal.get("username", "_unknown")
+        if scope == "cluster":
+            # the root ping needs authentication only, like the
+            # reference's main action
+            if path.rstrip("/") in ("", "/"):
+                return
+            for r in roles:
+                for priv in r.get("cluster") or []:
+                    if kind in _CLUSTER_PRIVS.get(priv, ()):
+                        return
+            raise AuthorizationError(
+                f"action [cluster:{kind}] is unauthorized for user "
+                f"[{username}]")
+        # index scope: EVERY named index must be granted
+        targets = [i for i in (index_expr or "").split(",") if i] \
+            or ["*"]
+        for target in targets:
+            ok = False
+            for r in roles:
+                for e in r.get("indices") or []:
+                    if not self._index_matches(e.get("names") or [],
+                                               target):
+                        continue
+                    granted = set()
+                    for priv in e.get("privileges") or []:
+                        granted |= _INDEX_PRIVS.get(priv, frozenset())
+                    if kind in granted:
+                        ok = True
+                        break
+                if ok:
+                    break
+            if not ok:
+                raise AuthorizationError(
+                    f"action [indices:{kind}] is unauthorized for "
+                    f"user [{username}] on indices [{target}]")
+
+    def dls_fls(self, principal: dict,
+                index: str) -> Tuple[List[Any], Optional[List[str]]]:
+        """(dls_queries, fls_grant) effective for one index read.
+
+        Reference semantics (``authz/accesscontrol/``): DLS queries
+        from multiple roles OR together; FLS grants union.  A role
+        entry granting read WITHOUT restrictions lifts both."""
+        roles = self._resolve(principal.get("roles") or [],
+                              principal.get("_inline_roles"))
+        queries: List[Any] = []
+        fields: List[str] = []
+        unrestricted = False
+        for r in roles:
+            for e in r.get("indices") or []:
+                if not self._index_matches(e.get("names") or [], index):
+                    continue
+                granted = set()
+                for priv in e.get("privileges") or []:
+                    granted |= _INDEX_PRIVS.get(priv, frozenset())
+                if "read" not in granted:
+                    continue
+                q = e.get("query")
+                fs = (e.get("field_security") or {}).get("grant")
+                if q is None and fs is None:
+                    unrestricted = True
+                if q is not None:
+                    import json as _json
+                    queries.append(_json.loads(q)
+                                   if isinstance(q, str) else q)
+                if fs is not None:
+                    fields.extend(fs)
+        if unrestricted:
+            return [], None
+        return queries, (fields if fields else None)
+
+    def has_privileges(self, principal: dict, body: dict) -> dict:
+        roles = self._resolve(principal.get("roles") or [],
+                              principal.get("_inline_roles"))
+        cluster_have = set()
+        for r in roles:
+            for p in r.get("cluster") or []:
+                cluster_have |= _CLUSTER_PRIVS.get(p, frozenset())
+        cluster_res = {}
+        for priv in body.get("cluster") or []:
+            want = _CLUSTER_PRIVS.get(priv, frozenset({priv}))
+            cluster_res[priv] = bool(want) and want <= cluster_have
+        index_res: Dict[str, dict] = {}
+        for entry in body.get("index") or []:
+            for name in entry.get("names") or []:
+                per = index_res.setdefault(name, {})
+                have = set()
+                for r in roles:
+                    for e in r.get("indices") or []:
+                        if self._index_matches(
+                                e.get("names") or [], name):
+                            for p in e.get("privileges") or []:
+                                have |= _INDEX_PRIVS.get(
+                                    p, frozenset())
+                for priv in entry.get("privileges") or []:
+                    want = _INDEX_PRIVS.get(priv, frozenset())
+                    per[priv] = bool(want) and want <= have
+        all_ok = all(cluster_res.values()) and all(
+            v for per in index_res.values() for v in per.values())
+        return {"username": principal.get("username"),
+                "has_all_requested": all_ok,
+                "cluster": cluster_res,
+                "index": index_res,
+                "application": {}}

@@ -1,0 +1,295 @@
+"""RBAC tests: native users, roles, authorization, DLS/FLS
+(security/rbac.py)."""
+
+import base64
+import json
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+def req(api, method, path, body=None, query="", user=None):
+    b = json.dumps(body).encode() if isinstance(body, (dict, list)) \
+        else (body or b"")
+    headers = None
+    if user is not None:
+        token = base64.b64encode(
+            f"{user[0]}:{user[1]}".encode()).decode()
+        headers = {"Authorization": f"Basic {token}"}
+    st, _ct, out = api.handle(method, path, query, b, headers=headers)
+    return st, json.loads(out)
+
+
+@pytest.fixture()
+def api():
+    """Security-enabled API with an admin + limited users set up
+    through an internal (pre-security) bootstrap."""
+    a = RestAPI(IndicesService(tempfile.mkdtemp()))
+    rbac = a.security.rbac
+    rbac.put_user("admin", {"password": "admin-pass",
+                            "roles": ["superuser"]})
+    a.security.enabled = True
+    return a
+
+
+ADMIN = ("admin", "admin-pass")
+
+
+def test_user_role_crud_and_authn(api):
+    st, r = req(api, "PUT", "/_security/user/alice",
+                {"password": "alice-pw", "roles": ["viewer"],
+                 "full_name": "Alice"}, user=ADMIN)
+    assert st == 200 and r == {"created": True}
+    # wrong password → 401
+    st, r = req(api, "GET", "/_security/_authenticate",
+                user=("alice", "wrong"))
+    assert st == 401
+    st, r = req(api, "GET", "/_security/_authenticate",
+                user=("alice", "alice-pw"))
+    assert st == 200 and r["username"] == "alice"
+    assert r["roles"] == ["viewer"]
+    # short password rejected
+    st, r = req(api, "PUT", "/_security/user/bob",
+                {"password": "abc"}, user=ADMIN)
+    assert st == 400
+    # change password invalidates the old one
+    req(api, "PUT", "/_security/user/alice/_password",
+        {"password": "new-pass-1"}, user=ADMIN)
+    assert req(api, "GET", "/_security/_authenticate",
+               user=("alice", "alice-pw"))[0] == 401
+    assert req(api, "GET", "/_security/_authenticate",
+               user=("alice", "new-pass-1"))[0] == 200
+    # disable turns authentication off
+    req(api, "PUT", "/_security/user/alice/_disable", user=ADMIN)
+    assert req(api, "GET", "/_security/_authenticate",
+               user=("alice", "new-pass-1"))[0] == 401
+    req(api, "PUT", "/_security/user/alice/_enable", user=ADMIN)
+    st, r = req(api, "GET", "/_security/user/alice", user=ADMIN)
+    assert r["alice"]["full_name"] == "Alice"
+    st, r = req(api, "DELETE", "/_security/user/alice", user=ADMIN)
+    assert r == {"found": True}
+
+
+def test_role_validation_and_builtin_protection(api):
+    st, r = req(api, "PUT", "/_security/role/app",
+                {"cluster": ["monitor"],
+                 "indices": [{"names": ["app-*"],
+                              "privileges": ["read", "write"]}]},
+                user=ADMIN)
+    assert st == 200 and r["role"]["created"] is True
+    st, r = req(api, "PUT", "/_security/role/bad",
+                {"indices": [{"names": ["x"],
+                              "privileges": ["fly"]}]}, user=ADMIN)
+    assert st == 400
+    st, r = req(api, "PUT", "/_security/role/superuser",
+                {"cluster": ["all"]}, user=ADMIN)
+    assert st == 400          # reserved
+    st, r = req(api, "GET", "/_security/role/app", user=ADMIN)
+    assert r["app"]["indices"][0]["names"] == ["app-*"]
+    st, r = req(api, "DELETE", "/_security/role/app", user=ADMIN)
+    assert r == {"found": True}
+
+
+def test_authorization_enforced(api):
+    req(api, "PUT", "/_security/role/logreader",
+        {"indices": [{"names": ["logs-*"], "privileges": ["read"]}]},
+        user=ADMIN)
+    req(api, "PUT", "/_security/user/reader",
+        {"password": "reader-pw", "roles": ["logreader"]}, user=ADMIN)
+    req(api, "PUT", "/logs-app/_doc/1", {"msg": "hi"}, user=ADMIN)
+    req(api, "PUT", "/secrets/_doc/1", {"key": "x"}, user=ADMIN)
+    req(api, "POST", "/_refresh", user=ADMIN)
+    # granted: search on logs-*
+    st, r = req(api, "POST", "/logs-app/_search", {}, user=("reader",
+                                                            "reader-pw"))
+    assert st == 200 and r["hits"]["total"]["value"] == 1
+    # denied: search on another index
+    st, r = req(api, "POST", "/secrets/_search", {},
+                user=("reader", "reader-pw"))
+    assert st == 403
+    assert r["error"]["type"] == "security_exception"
+    # denied: writes anywhere
+    st, r = req(api, "PUT", "/logs-app/_doc/2", {"msg": "no"},
+                user=("reader", "reader-pw"))
+    assert st == 403
+    # denied: cluster admin
+    st, r = req(api, "PUT", "/_cluster/settings",
+                {"persistent": {"search.max_buckets": 100}},
+                user=("reader", "reader-pw"))
+    assert st == 403
+    # admin can do all of it
+    st, r = req(api, "PUT", "/logs-app/_doc/2", {"msg": "ok"},
+                user=ADMIN)
+    assert st == 201
+
+
+def test_has_privileges(api):
+    req(api, "PUT", "/_security/role/mixed",
+        {"cluster": ["monitor"],
+         "indices": [{"names": ["a-*"], "privileges": ["read"]}]},
+        user=ADMIN)
+    req(api, "PUT", "/_security/user/mix",
+        {"password": "mix-pass", "roles": ["mixed"]}, user=ADMIN)
+    st, r = req(api, "POST", "/_security/user/_has_privileges",
+                {"cluster": ["monitor", "manage"],
+                 "index": [{"names": ["a-1", "b-1"],
+                            "privileges": ["read"]}]},
+                user=("mix", "mix-pass"))
+    assert st == 200
+    assert r["has_all_requested"] is False
+    assert r["cluster"] == {"monitor": True, "manage": False}
+    assert r["index"]["a-1"]["read"] is True
+    assert r["index"]["b-1"]["read"] is False
+
+
+def test_dls_and_fls(api):
+    req(api, "PUT", "/_security/role/team-a",
+        {"indices": [{"names": ["docs"], "privileges": ["read"],
+                      "query": {"term": {"team": "a"}},
+                      "field_security": {"grant": ["team", "title"]}}]},
+        user=ADMIN)
+    req(api, "PUT", "/_security/user/ana",
+        {"password": "ana-pass", "roles": ["team-a"]}, user=ADMIN)
+    req(api, "PUT", "/docs/_doc/1",
+        {"team": "a", "title": "t1", "secret": "s1"}, user=ADMIN)
+    req(api, "PUT", "/docs/_doc/2",
+        {"team": "b", "title": "t2", "secret": "s2"}, user=ADMIN)
+    req(api, "POST", "/docs/_refresh", user=ADMIN)
+    # admin sees both docs, full source
+    st, r = req(api, "POST", "/docs/_search", {}, user=ADMIN)
+    assert r["hits"]["total"]["value"] == 2
+    # ana sees only team a docs, with secret stripped
+    st, r = req(api, "POST", "/docs/_search", {},
+                user=("ana", "ana-pass"))
+    assert st == 200 and r["hits"]["total"]["value"] == 1
+    src = r["hits"]["hits"][0]["_source"]
+    assert src == {"team": "a", "title": "t1"}
+    # DLS composes with the user's own query
+    st, r = req(api, "POST", "/docs/_search",
+                {"query": {"match_all": {}}}, user=("ana", "ana-pass"))
+    assert r["hits"]["total"]["value"] == 1
+
+
+def test_dls_fls_cover_get_mget_count_and_deny_rest(api):
+    """The review-identified bypass paths: get/_source/mget/count honor
+    DLS+FLS; explain/termvectors refuse under restrictions."""
+    req(api, "PUT", "/_security/role/team-a",
+        {"indices": [{"names": ["docs"], "privileges": ["read"],
+                      "query": {"term": {"team": "a"}},
+                      "field_security": {"grant": ["team*",
+                                                   "title*"]}}]},
+        user=ADMIN)
+    req(api, "PUT", "/_security/user/ana",
+        {"password": "ana-pass", "roles": ["team-a"]}, user=ADMIN)
+    req(api, "PUT", "/docs/_doc/1",
+        {"team": "a", "title": "t1", "secret": "s1"}, user=ADMIN)
+    req(api, "PUT", "/docs/_doc/2",
+        {"team": "b", "title": "t2", "secret": "s2"}, user=ADMIN)
+    req(api, "POST", "/docs/_refresh", user=ADMIN)
+    ANA = ("ana", "ana-pass")
+    # get: excluded doc 404s; included doc loses restricted fields
+    st, r = req(api, "GET", "/docs/_doc/2", user=ANA)
+    assert st == 404
+    st, r = req(api, "GET", "/docs/_doc/1", user=ANA)
+    assert st == 200 and r["_source"] == {"team": "a", "title": "t1"}
+    st, r = req(api, "GET", "/docs/_source/2", user=ANA)
+    assert st == 404
+    st, r = req(api, "GET", "/docs/_source/1", user=ANA)
+    assert r == {"team": "a", "title": "t1"}
+    # mget follows the same rules
+    st, r = req(api, "POST", "/docs/_mget",
+                {"ids": ["1", "2"]}, user=ANA)
+    d1, d2 = r["docs"]
+    assert d1["found"] is True and "secret" not in d1["_source"]
+    assert d2["found"] is False
+    # count applies DLS
+    st, r = req(api, "POST", "/docs/_count", {}, user=ANA)
+    assert r["count"] == 1
+    # FLS blocks aggs/sort on restricted fields
+    st, r = req(api, "POST", "/docs/_search",
+                {"aggs": {"s": {"terms": {"field": "secret"}}}},
+                user=ANA)
+    assert st == 403
+    st, r = req(api, "POST", "/docs/_search",
+                {"sort": ["secret"]}, user=ANA)
+    assert st == 403
+    st, r = req(api, "POST", "/docs/_search",
+                {"sort": ["title.keyword"],
+                 "aggs": {"t": {"terms": {"field": "team.keyword"}}}},
+                user=ANA)
+    assert st == 200
+    # un-post-filterable endpoints refuse
+    st, r = req(api, "GET", "/docs/_explain/1",
+                {"query": {"match_all": {}}}, user=ANA)
+    assert st == 403
+    st, r = req(api, "GET", "/docs/_termvectors/1", None, user=ANA)
+    assert st == 403
+
+
+def test_classification_of_top_level_endpoints(api):
+    """viewer can POST /_search; monitoring_user cannot read all
+    indices through GET /_search (review finding)."""
+    req(api, "PUT", "/_security/user/vw",
+        {"password": "view-pass", "roles": ["viewer"]}, user=ADMIN)
+    req(api, "PUT", "/_security/user/mon",
+        {"password": "mon-pass", "roles": ["monitoring_user"]},
+        user=ADMIN)
+    req(api, "PUT", "/data/_doc/1", {"x": 1}, user=ADMIN)
+    req(api, "POST", "/_refresh", user=ADMIN)
+    st, r = req(api, "POST", "/_search", {}, user=("vw", "view-pass"))
+    assert st == 200
+    st, r = req(api, "GET", "/_search", None, user=("mon", "mon-pass"))
+    assert st == 403          # no read grant on *
+    # viewer holds no cluster privileges → cluster APIs refused,
+    # but the root ping works for any authenticated user
+    st, r = req(api, "GET", "/_cluster/settings", None,
+                user=("vw", "view-pass"))
+    assert st == 403
+    st, r = req(api, "GET", "/", None, user=("vw", "view-pass"))
+    assert st == 200
+    # security APIs need admin, not just monitor
+    st, r = req(api, "GET", "/_security/user", None,
+                user=("mon", "mon-pass"))
+    assert st == 403
+
+
+def test_users_roles_persist_across_restart(tmp_path):
+    from elasticsearch_tpu.security.apikeys import SecurityService
+    p = str(tmp_path / "sec.json")
+    s1 = SecurityService(enabled=True, persist_path=p)
+    s1.rbac.put_user("u", {"password": "pass-123", "roles": ["viewer"]})
+    s1.rbac.put_role("r", {"indices": [{"names": ["x"],
+                                        "privileges": ["read"]}]})
+    s2 = SecurityService(enabled=True, persist_path=p)
+    assert s2.rbac.verify_password("u", "pass-123") is not None
+    assert "r" in s2.rbac.roles
+
+
+def test_api_key_role_descriptors_limit_access(api):
+    st, r = req(api, "POST", "/_security/api_key",
+                {"name": "limited", "role_descriptors": {
+                    "ro": {"indices": [{"names": ["pub-*"],
+                                        "privileges": ["read"]}]}}},
+                user=ADMIN)
+    assert st == 200
+    encoded = r["encoded"]
+    req(api, "PUT", "/pub-1/_doc/1", {"x": 1}, user=ADMIN)
+    req(api, "PUT", "/priv/_doc/1", {"x": 1}, user=ADMIN)
+    req(api, "POST", "/_refresh", user=ADMIN)
+
+    def key_req(method, path, body=None):
+        b = json.dumps(body).encode() if isinstance(body, dict) else b""
+        st, _ct, out = api.handle(
+            method, path, "", b,
+            headers={"Authorization": f"ApiKey {encoded}"})
+        return st, json.loads(out)
+
+    st, r = key_req("POST", "/pub-1/_search", {})
+    assert st == 200 and r["hits"]["total"]["value"] == 1
+    st, r = key_req("POST", "/priv/_search", {})
+    assert st == 403
+    st, r = key_req("PUT", "/pub-1/_doc/2", {"x": 2})
+    assert st == 403
