@@ -154,6 +154,14 @@ class ClusterNode:
         # node's peer, and metadata ops never wait behind either.
         self._replica_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"{node_id}-replica")
+        # read-only metadata lane (search:stats / search:shards /
+        # can_match / stats:shards): reads over immutable searcher
+        # snapshots, safe off the single writer
+        self._read_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix=f"{node_id}-read")
+        #: allocation ids with a recovery task (incl. retry chain) in
+        #: flight — state applications must not resubmit them
+        self._recovering: set = set()
         self._meta_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"{node_id}-meta")
         # full REST stack (node/cluster_rest.py): local IndicesService +
@@ -199,6 +207,7 @@ class ClusterNode:
         self._data_pool.shutdown(wait=True, cancel_futures=True)
         self._replica_pool.shutdown(wait=True, cancel_futures=True)
         self._meta_pool.shutdown(wait=True, cancel_futures=True)
+        self._read_pool.shutdown(wait=True, cancel_futures=True)
         if self._http_pool is not None:
             self._http_pool.shutdown(wait=False, cancel_futures=True)
         closed = set()
@@ -468,8 +477,25 @@ class ClusterNode:
                 group.tracker.remove_allocation(aid)
         have = {ch.target_node for ch in group.replicas.values()
                 if isinstance(ch, RpcReplicaChannel)}
+        # self-healing re-notify: a wired in-sync copy missing from the
+        # published in_sync list (lost shard:started — master blip)
+        # re-sends on the next state application
+        published = set(entry.get("in_sync") or ())
+        for ch in group.replicas.values():
+            if isinstance(ch, RpcReplicaChannel) and \
+                    ch.allocation_id in \
+                    group.tracker.in_sync_allocation_ids() and \
+                    ch.target_node not in published:
+                self._notify_shard_started(name, sid, ch.target_node)
         for target in wanted - have:
             aid = f"{target}/{name}/{sid}"
+            # every state application re-walks the wanted set; a
+            # recovery already in flight (incl. its retry chain) must
+            # not be resubmitted — duplicate tasks stack up on the data
+            # worker and starve doc ops
+            if aid in self._recovering:
+                continue
+            self._recovering.add(aid)
             ch = RpcReplicaChannel(self, target, name, sid, aid)
             # ops-based recovery runs on the data worker (it issues
             # synchronous RPCs; engine access stays serialized there)
@@ -499,6 +525,13 @@ class ClusterNode:
                          {"index": ch.index_name}, timeout=2.0)
             except Exception:   # noqa: BLE001
                 pass
+            # publish "shard started": until the master records the
+            # copy in the routing entry's in_sync list, searches must
+            # not read it (ShardRouting INITIALIZING→STARTED — a
+            # recovering replica is invisible to ARS)
+            self._notify_shard_started(ch.index_name, ch.shard_id,
+                                       ch.target_node)
+            self._recovering.discard(aid)
         except Exception:   # noqa: BLE001 — replica node not ready: retry
             group.tracker.remove_lease(f"peer_recovery/{aid}")
             if attempts > 0 and not self.stopped:
@@ -506,6 +539,8 @@ class ClusterNode:
                     0.25, lambda: self._data_pool.submit(
                         self._recover_replica, group, ch, aid,
                         attempts - 1))
+            else:
+                self._recovering.discard(aid)
 
     # ------------------------------------------------------------------
     # node failure watch (master only) — FollowersChecker consequence
@@ -694,6 +729,13 @@ class ClusterNode:
                 else:
                     if src in entry.get("replicas", ()):
                         entry["replicas"].remove(src)
+                # in_sync never outlives replica membership: a stale
+                # entry would let a re-assigned, still-recovering copy
+                # serve searches again
+                if entry.get("in_sync"):
+                    entry["in_sync"] = [
+                        x for x in entry["in_sync"]
+                        if x in entry.get("replicas", ())]
             actx = AllocationContext(
                 live, r, meta, node_attrs=self.node_attrs,
                 disk_used=dict(self._disk_used))
@@ -747,6 +789,11 @@ class ClusterNode:
                     else:
                         entry["replicas"] = [r for r in entry["replicas"]
                                              if r not in dead]
+                    if entry.get("in_sync"):
+                        entry["in_sync"] = [
+                            r for r in entry["in_sync"]
+                            if r not in dead
+                            and r in entry.get("replicas", ())]
             return new
 
         try:
@@ -859,8 +906,14 @@ class ClusterNode:
         by_node: Dict[str, List[int]] = {}
         live = self.live_nodes()
         for sid_s, entry in table.items():
+            # only STARTED (recovery-complete) replicas serve reads: a
+            # copy still replaying the translog would return stale or
+            # empty results (the 230_composite index-sorted visibility
+            # failure was exactly this)
+            in_sync = set(entry.get("in_sync") or ())
             copies = [entry["primary"]] + [
-                r for r in entry.get("replicas", ()) if r in live]
+                r for r in entry.get("replicas", ())
+                if r in live and r in in_sync]
             best = min(copies, key=lambda n: (
                 self._ars_rank(n), len(by_node.get(n, ())),
                 0 if n == entry["primary"] else 1))
@@ -1081,9 +1134,13 @@ class ClusterNode:
         def on_meta(handler):
             return on_worker(handler, self._meta_pool)
 
+        def on_read(handler):
+            return on_worker(handler, self._read_pool)
+
         t.register(nid, "ping", lambda s, p: {
             "ok": True, "disk_used_frac": _disk_used_frac(self.data_path)})
         t.register(nid, "shard:insync", on_worker(self._h_shard_insync))
+        t.register(nid, "shard:started", on_meta(self._h_shard_started))
         t.register(nid, "alloc:reroute", on_worker(self._h_alloc_reroute))
         t.register(nid, "meta:op", on_meta(self.rest.h_meta_op))
         t.register(nid, "meta:history",
@@ -1098,8 +1155,14 @@ class ClusterNode:
         t.register(nid, "doc:get", on_worker(self._h_doc_get))
         t.register(nid, "doc:delete", on_worker(self._h_doc_delete))
         t.register(nid, "shard:refresh", on_worker(self._h_refresh))
-        t.register(nid, "search:shards", on_worker(self._h_search_shards))
-        t.register(nid, "search:stats", on_worker(self._h_search_stats))
+        # cheap read-only metadata RPCs get their own lane: a long
+        # search/aggregation grinding on the data worker (left behind by
+        # a client that already timed out) must not starve the term-
+        # statistics round of the NEXT search into its 2x15s degrade
+        # path — the same isolation the readonly self-RPC direct path
+        # grants self-calls
+        t.register(nid, "search:shards", on_read(self._h_search_shards))
+        t.register(nid, "search:stats", on_read(self._h_search_stats))
         t.register(nid, "replica:index", on_replica(self._h_replica_index))
         t.register(nid, "replica:delete",
                    on_replica(self._h_replica_delete))
@@ -1110,8 +1173,8 @@ class ClusterNode:
         t.register(nid, "replica:sync_gcp",
                    on_replica(self._h_replica_sync_gcp))
         t.register(nid, "snap:shard", on_worker(self._h_snap_shard))
-        t.register(nid, "stats:shards", on_worker(self.rest.h_stats_shards))
-        t.register(nid, "search:canmatch", on_worker(self._h_can_match))
+        t.register(nid, "stats:shards", on_read(self.rest.h_stats_shards))
+        t.register(nid, "search:canmatch", on_read(self._h_can_match))
 
     def _h_snap_shard(self, src, payload):
         """Upload this node's primary copy of one shard into the shared
@@ -1404,6 +1467,51 @@ class ClusterNode:
         g = self.primaries.get((payload["index"], int(payload["shard"])))
         return {"in_sync": g is not None and
                 payload["aid"] in g.tracker.in_sync_allocation_ids()}
+
+    def _notify_shard_started(self, index: str, shard: int,
+                              node: str) -> None:
+        """Primary-side: tell the master a replica copy finished
+        recovery (``ShardStateAction.shardStarted``)."""
+        st = self.applied_state
+        master = st.master_node if st else None
+        payload = {"index": index, "shard": int(shard), "node": node}
+
+        def notify():
+            try:
+                if master == self.node_id:
+                    self._h_shard_started(self.node_id, payload)
+                elif master is not None:
+                    self.rpc(master, "shard:started", payload,
+                             timeout=5.0)
+            except Exception:   # noqa: BLE001 — reads stay on the
+                pass            # primary until a retry re-notifies
+
+        # off the data worker: the notify RPC must never delay doc ops
+        self._read_pool.submit(notify)
+
+    def _h_shard_started(self, src, payload):
+        """Master-side: record the copy in the routing entry's in_sync
+        list; searches route to in_sync replicas only."""
+        index, sid = payload["index"], str(payload["shard"])
+        node = payload["node"]
+
+        def update(st):
+            new = st.updated()
+            entry = (new.data.get("routing", {}).get(index) or {}).get(
+                sid)
+            if entry is not None and node in entry.get("replicas", ()) \
+                    and node not in (entry.get("in_sync") or ()):
+                entry.setdefault("in_sync", []).append(node)
+            return new
+
+        # fire-and-forget: waiting for publication here would block the
+        # calling lane (the data worker when primary == master) on a
+        # publish that itself needs that lane to apply state
+        try:
+            self.coordinator.submit_state_update(update)
+        except Exception:   # noqa: BLE001 — not leader anymore: the
+            pass            # new master re-learns from re-notification
+        return {"acknowledged": True}
 
 
 def _disk_used_frac(path: str) -> float:

@@ -117,12 +117,18 @@ def _remote_error(e: RemoteTransportError) -> Exception:
     """Map a remote exception back to its ES error class by name so the
     REST layer renders the same status/type it would for a local failure."""
     cls = getattr(_errors, e.remote_type or "", None)
+    reason = getattr(e, "remote_reason", None) or str(e)
+    mapped = None
     if isinstance(cls, type) and issubclass(cls, Exception):
         try:
-            return cls(str(e))
+            mapped = cls(reason)
         except Exception:   # noqa: BLE001 — ctor signature mismatch
-            pass
-    return _errors.ElasticsearchError(str(e))
+            mapped = None
+    if mapped is None:
+        mapped = _errors.ElasticsearchError(str(e))
+    if getattr(e, "caused_by", None):
+        mapped.caused_by = e.caused_by
+    return mapped
 
 
 class LocalGroupWriter:
@@ -192,7 +198,7 @@ class ClusterHooks:
     def __init__(self, rest: "ClusterRestService"):
         self.rest = rest
 
-    def writer(self, index: str, shard: int):
+    def writer(self, index: str, shard: int, for_read: bool = False):
         node = self.rest.node
         st = node.applied_state
         if st is None:
@@ -200,6 +206,14 @@ class ClusterHooks:
         table = st.data.get("routing", {}).get(index)
         if table is None or str(shard) not in table:
             return None
+        if not for_read:
+            # a MUTATION fetched through this front invalidates its
+            # cluster request-cache entries for the index (writes
+            # through OTHER fronts are invisible here — front-scoped
+            # cache, see search()); doc GETs share this handle and must
+            # not invalidate
+            gens = self.rest._front_write_gen
+            gens[index] = gens.get(index, 0) + 1
         owner = table[str(shard)]["primary"]
         if owner == node.node_id:
             group = node.primaries.get((index, shard))
@@ -214,7 +228,7 @@ class ClusterHooks:
             return LocalGroupWriter(group) if group is not None else None
         return RemoteShardProxy(node, owner, index, shard)
 
-    def search(self, index: str, body: dict):
+    def search(self, index: str, body: dict, request_cache=None):
         """None → the caller's local engines are authoritative."""
         node = self.rest.node
         st = node.applied_state
@@ -224,6 +238,25 @@ class ClusterHooks:
         owners = {e["primary"] for e in table.values()}
         if owners == {node.node_id}:
             return None
+        # FRONT-scoped cluster request cache: the per-shard cache the
+        # reference keeps on data nodes (IndicesRequestCache) maps here
+        # to the coordinating node caching the merged size==0 result,
+        # keyed on (cluster-state version, this front's write
+        # generation for the index, body). Writes routed through OTHER
+        # coordinating nodes do not bump this front's generation — a
+        # disclosed narrowing; state-version changes (mappings, routing,
+        # index recreation) invalidate everything.
+        cache_key = None
+        svc = self.rest.indices.indices.get(index)
+        if svc is not None:
+            blob = svc._request_cache_blob(dict(body), request_cache)
+            if blob is not None:
+                cache_key = (st.version,
+                             self.rest._front_write_gen.get(index, 0),
+                             blob)
+                hit = svc.cache_get(cache_key)
+                if hit is not None:
+                    return hit
         try:
             out = node.search(index, dict(body))
         except RemoteTransportError as e:
@@ -255,11 +288,18 @@ class ClusterHooks:
                 and total > tth:
             total = tth
             relation = "gte"
-        return ShardSearchResult(
+        result = ShardSearchResult(
             total=total, total_relation=relation, hits=hits,
             max_score=max_score, aggregations=out.get("aggregations"),
             suggest=out.get("suggest"), profile=out.get("profile"),
             shard_failures=out.get("failures"))
+        if cache_key is not None and svc is not None \
+                and not out.get("failures"):
+            # responses carrying shard failures never enter the cache —
+            # a transient degradation must not replay until the next
+            # invalidation (the reference cache has the same rule)
+            svc.cache_put(cache_key, result)
+        return result
 
     def count(self, index: str, body: dict):
         node = self.rest.node
@@ -374,6 +414,8 @@ class ClusterHooks:
         st = node.applied_state
         if st is None or index not in st.data.get("routing", {}):
             return False
+        gens = self.rest._front_write_gen
+        gens[index] = gens.get(index, 0) + 1
         # the local service's own engines first: group wiring is async, so
         # right after index creation a locally-primaried engine may not be
         # wrapped yet — it still holds any direct writes
@@ -412,6 +454,9 @@ class ClusterRestService:
         # the front door (handle()) authenticates; internal dispatches
         # into the local api are then trusted
         self.api.enforce_security = False
+        #: per-index generation of writes/refreshes routed through THIS
+        #: front — the cluster request cache's invalidation signal
+        self._front_write_gen: Dict[str, int] = {}
         self.api.adaptive_selection_provider = \
             node.adaptive_selection_stats
         # the local api's fabricated node id must BE this cluster node's
@@ -1091,13 +1136,17 @@ class ClusterRestService:
             if not table:
                 continue
             by_owner: Dict[str, list] = {}
+            ops_only: set = set()
             for sid, e in table.items():
-                if e["primary"] != self.node.node_id and \
-                        self.node.node_id not in e.get("replicas", ()):
-                    # front holds NO copy: fetch from the primary owner
-                    # (a local replica engine already carries the docs —
-                    # fetching again would double-count)
-                    by_owner.setdefault(e["primary"], []).append(sid)
+                if e["primary"] == self.node.node_id:
+                    continue             # local engine already counted
+                if self.node.node_id in e.get("replicas", ()):
+                    # the local replica carries the DATA (docs/store —
+                    # fetching again would double-count), but ACTIVITY
+                    # counters (get/index/delete totals) record where
+                    # the ops EXECUTED: the primary. Fetch those alone.
+                    ops_only.add(str(sid))
+                by_owner.setdefault(e["primary"], []).append(sid)
             got: Dict[str, dict] = {}
             for owner, sids in sorted(by_owner.items()):
                 try:
@@ -1107,7 +1156,14 @@ class ClusterRestService:
                                       timeout=10.0)
                 except Exception:   # noqa: BLE001 — a dead owner's shard
                     continue        # stats degrade to the local zeros
-                got.update(r or {})
+                for sid_s, s in (r or {}).items():
+                    if sid_s in ops_only:
+                        s = {k: s.get(k, 0) for k in
+                             ("get_total", "index_total",
+                              "delete_total")}
+                        s.update(docs=0, deleted=0, store=0, tl_ops=0,
+                                 tl_size=0, segments=[], fielddata=0)
+                    got[sid_s] = s
             if got:
                 out[n] = got
         return out
@@ -1236,8 +1292,6 @@ class ClusterRestService:
             want = set(unquote(segs[2]).split(","))
         with self.lock:
             names = sorted(self.api.indices.indices)
-        remote = self._remote_shard_stats(names,
-                                          sections={"fielddata"})
         fields: Dict[str, int] = {}
         with self.lock:
             for n in names:
@@ -1248,10 +1302,34 @@ class ClusterRestService:
                     fd, _comp = svc.field_bytes()
                     for f in loaded:
                         fields[f] = fields.get(f, 0) + int(fd.get(f, 0))
-        for n, shards in remote.items():
-            for _sid, s in shards.items():
-                for f, b in (s.get("fielddata_fields") or {}).items():
-                    fields[f] = fields.get(f, 0) + int(b)
+        # fielddata is NODE-LOCAL state: the loaded columns live on
+        # whichever copy executed the sort/global-ordinals (primary OR
+        # replica under adaptive replica selection), so ask every peer
+        # for the shards IT holds — not just primary owners
+        st = self.node.applied_state
+        routing = (st.data.get("routing", {}) if st else {})
+        live = self.node.live_nodes()
+        by_node: Dict[str, Dict[str, list]] = {}
+        for n in names:
+            for sid, e in (routing.get(n) or {}).items():
+                holders = [e["primary"]] + list(e.get("replicas", ()))
+                for h in holders:
+                    if h != self.node.node_id and h in live:
+                        by_node.setdefault(h, {}).setdefault(
+                            n, []).append(sid)
+        for peer, per_index in sorted(by_node.items()):
+            for n, sids in per_index.items():
+                try:
+                    r = self.node.rpc(peer, "stats:shards",
+                                      {"index": n, "shards": sids,
+                                       "sections": ["fielddata"]},
+                                      timeout=10.0)
+                except Exception:   # noqa: BLE001 — dead peer: skip
+                    continue
+                for _sid, s in (r or {}).items():
+                    for f, b in (s.get("fielddata_fields")
+                                 or {}).items():
+                        fields[f] = fields.get(f, 0) + int(b)
         params = _parse_query(query)
         rows = [[self.node.node_id[:4], "127.0.0.1", "127.0.0.1",
                  self.node.node_id, f, _human_bytes(b)]

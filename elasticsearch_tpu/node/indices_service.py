@@ -217,7 +217,7 @@ class IndexService:
         self._check_open()
         if self.cluster_hooks is not None:
             w = self.cluster_hooks.writer(self.name, self.shard_id_for(
-                doc_id, routing))
+                doc_id, routing), for_read=True)
             if w is not None:
                 return w.get(doc_id)
         return self.shard_for_doc(doc_id, routing).get(doc_id)
@@ -269,13 +269,14 @@ class IndexService:
     #: indices.requests.cache.size 1%; entries are simpler and safe here)
     REQUEST_CACHE_MAX = 256
 
-    def _request_cache_key(self, body: dict,
-                           explicit: Optional[bool]) -> Optional[tuple]:
-        """Cache key when this request is cacheable, else None
-        (reference: ``IndicesRequestCache.java`` — size==0 requests by
-        default, opt-in/out via ?request_cache, never non-deterministic
-        bodies; the segment-list+liveness signature IS the invalidation,
-        like the cache's reader-key)."""
+    def _request_cache_blob(self, body: dict,
+                            explicit: Optional[bool]) -> Optional[str]:
+        """The canonical body blob when this request is cacheable, else
+        None (reference: ``IndicesRequestCache.java`` — size==0 requests
+        by default, opt-in/out via ?request_cache, never
+        non-deterministic bodies). No invalidation component here —
+        callers add their own (segment signature locally, write
+        generation on the cluster front)."""
         if explicit is False:
             return None
         if str(self.settings.get("index.requests.cache.enable", "true")
@@ -295,10 +296,32 @@ class IndexService:
         if "now" in blob or "random_score" in blob or \
                 body.get("profile"):
             return None
+        return blob
+
+    def _request_cache_key(self, body: dict,
+                           explicit: Optional[bool]) -> Optional[tuple]:
+        """Local cache key: the segment-list+liveness signature IS the
+        invalidation, like the reference cache's reader-key."""
+        blob = self._request_cache_blob(body, explicit)
+        if blob is None:
+            return None
         sig = tuple((seg.seg_id, seg.n_docs, int(seg.live.sum()))
                     for sh in self.shards
                     for seg in sh.searchable_segments())
         return (sig, blob)
+
+    def cache_get(self, key):
+        hit = self.request_cache.get(key)
+        if hit is not None:
+            self.request_cache.move_to_end(key)
+            self.request_cache_stats["hit_count"] += 1
+        return hit
+
+    def cache_put(self, key, result) -> None:
+        self.request_cache_stats["miss_count"] += 1
+        self.request_cache[key] = result
+        while len(self.request_cache) > self.REQUEST_CACHE_MAX:
+            self.request_cache.popitem(last=False)
 
     #: slow-log ring size per index (entries also append to the on-disk
     #: log file, the reference's actual surface)
@@ -348,27 +371,23 @@ class IndexService:
         self._check_open()
         t0 = time.perf_counter()
         if self.cluster_hooks is not None:
-            r = self.cluster_hooks.search(self.name, body or {})
+            r = self.cluster_hooks.search(self.name, body or {},
+                                          request_cache=request_cache)
             if r is not None:
                 self._slowlog_record("query", time.perf_counter() - t0,
                                      str(body or {})[:1000])
                 return r
         key = self._request_cache_key(body or {}, request_cache)
         if key is not None:
-            hit = self.request_cache.get(key)
+            hit = self.cache_get(key)
             if hit is not None:
-                self.request_cache.move_to_end(key)
-                self.request_cache_stats["hit_count"] += 1
                 return hit
         if self.num_shards > 1:
             r = self.dist_searcher().search(body or {})
         else:
             r = self.searcher().search(body or {})
         if key is not None:
-            self.request_cache_stats["miss_count"] += 1
-            self.request_cache[key] = r
-            while len(self.request_cache) > self.REQUEST_CACHE_MAX:
-                self.request_cache.popitem(last=False)
+            self.cache_put(key, r)
         self._slowlog_record("query", time.perf_counter() - t0,
                              str(body or {})[:1000])
         return r

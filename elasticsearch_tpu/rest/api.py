@@ -2041,8 +2041,22 @@ class RestAPI:
         import shutil as _sh
         du = _sh.disk_usage(self.indices.data_path)
         full_id = _flag(params, "full_id")
-        rows = [["127.0.0.1", self.node_id if full_id
-                 else self.node_id[:4], "42mb", 42, "100mb", 42, 1,
+        # the short id is ALWAYS 4 chars (cat/RestNodesAction renders
+        # the uuid prefix) — cluster node names like "n2" are shorter,
+        # so derive a stable 4-char form from a hash
+        # reference ids are 20+ char uuids: short form is its 4-char
+        # prefix, full form the whole id — cluster node names like "n2"
+        # get a stable derived suffix to keep both shapes
+        if len(self.node_id) >= 5:
+            short_id, long_id = self.node_id[:4], self.node_id
+        else:
+            import hashlib as _hl
+            digest = _hl.sha1(self.node_id.encode()).hexdigest()
+            short_id = self.node_id[:4] if len(self.node_id) >= 4 \
+                else digest[:4]
+            long_id = f"{self.node_id}-{digest[:8]}"
+        rows = [["127.0.0.1", long_id if full_id
+                 else short_id, "42mb", 42, "100mb", 42, 1,
                  1, 1, 1024, "127.0.0.1:9200", "0.00", "0.00", "0.00",
                  "dim", "*", self.node_name,
                  _human_bytes(du.free), _human_bytes(du.total),

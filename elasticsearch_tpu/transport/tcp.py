@@ -228,8 +228,11 @@ class TcpTransport:
                 if "error" in msg:
                     e = msg["error"]
                     if isinstance(e, dict):
-                        err(RemoteTransportError(e.get("reason", ""),
-                                                 e.get("type")))
+                        rte = RemoteTransportError(e.get("reason", ""),
+                                                   e.get("type"))
+                        if e.get("caused_by"):
+                            rte.caused_by = e["caused_by"]
+                        err(rte)
                     else:                      # legacy string form
                         err(RemoteTransportError(str(e)))
                 else:
@@ -305,7 +308,13 @@ class TcpTransport:
                 # ship the exception TYPE so callers can re-raise
                 # semantically (a fencing rejection must not look like a
                 # generic replica failure)
-                out["error"] = {"type": type(e).__name__, "reason": str(e)}
+                out["error"] = {"type": type(e).__name__,
+                                "reason": str(e)}
+                # nested causes survive the wire (BulkItemResponse
+                # renders error.caused_by — date_nanos range errors etc.)
+                cb = getattr(e, "caused_by", None)
+                if cb:
+                    out["error"]["caused_by"] = cb
         frame = json.dumps(out).encode()
         try:
             async with write_lock:
@@ -336,6 +345,8 @@ class RemoteTransportError(Exception):
         super().__init__(f"[{remote_type}] {reason}" if remote_type
                          else reason)
         self.remote_type = remote_type
+        self.remote_reason = reason
+        self.caused_by: Optional[dict] = None
 
 
 class NodeLoop:

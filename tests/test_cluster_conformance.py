@@ -62,6 +62,17 @@ SUITES = [
     "cluster.allocation_explain/10_basic.yml",
     "search/140_pre_filter_search_shards.yml",
     "search/90_search_after.yml",
+    # the final five to reach 1127/1127 (session-3 fixes: per-node
+    # fielddata fan-out, 4-char cat ids, caused_by over the wire,
+    # replica in_sync read gating, front-side request cache, primary
+    # activity counters)
+    "cat.fielddata/10_basic.yml",
+    "cat.nodes/10_basic.yml",
+    "index/80_date_nanos.yml",
+    "search.aggregation/230_composite.yml",
+    "search.aggregation/50_filter.yml",
+    "search/150_rewrite_on_coordinator.yml",
+    "indices.stats/10_index.yml",
 ]
 
 
