@@ -681,6 +681,19 @@ class RestAPI:
         add("GET", "/_tasks/{task_id}", self.h_task_get)
         add("POST", "/_tasks/_cancel", self.h_tasks_cancel)
         add("POST", "/_tasks/{task_id}/_cancel", self.h_tasks_cancel)
+        # search templates (modules/lang-mustache:
+        # RestSearchTemplateAction / RestRenderSearchTemplateAction /
+        # RestMultiSearchTemplateAction)
+        add("GET,POST", "/_search/template", self.h_search_template)
+        add("GET,POST", "/{index}/_search/template",
+            self.h_search_template)
+        add("GET,POST", "/_render/template", self.h_render_template)
+        add("GET,POST", "/_render/template/{id}",
+            self.h_render_template)
+        add("GET,POST", "/_msearch/template",
+            self.h_msearch_template)
+        add("GET,POST", "/{index}/_msearch/template",
+            self.h_msearch_template)
         # stored scripts + script metadata
         add("PUT,POST", "/_scripts/{id}", self.h_put_script)
         add("GET", "/_scripts/{id}", self.h_get_script)
@@ -7382,6 +7395,92 @@ class RestAPI:
     #: mustache templates; "painless" sources are accepted for storage —
     #: execution supports the expression-compatible subset)
     SCRIPT_LANGS = ("painless", "expression", "mustache")
+
+    def _render_search_template(self, spec: dict) -> dict:
+        """Mustache template + params → a concrete search body
+        (``MustacheScriptEngine`` — utils/mustache.py is the engine)."""
+        from ..utils.mustache import render_mustache
+        source = spec.get("source")
+        if source is None and spec.get("id"):
+            stored = self.stored_scripts.get(spec["id"])
+            if stored is None:
+                raise ResourceNotFoundError(
+                    f"unable to find script [{spec['id']}]")
+            if stored.get("lang") not in (None, "mustache"):
+                raise IllegalArgumentError(
+                    f"search template expects lang [mustache], but "
+                    f"stored script [{spec['id']}] is "
+                    f"[{stored.get('lang')}]")
+            source = stored["source"]
+        if source is None:
+            raise IllegalArgumentError(
+                "template is missing: specify [source] or [id]")
+        if isinstance(source, dict):
+            # object-form templates render through their JSON text
+            source = json.dumps(source)
+        rendered = render_mustache(str(source), spec.get("params") or {})
+        try:
+            return json.loads(rendered)
+        except ValueError as e:
+            raise IllegalArgumentError(
+                f"Failed to parse rendered search template: {e}")
+
+    def h_search_template(self, params, body, index=None):
+        spec = _json_body(body)
+        search_body = self._render_search_template(spec)
+        if params.get("explain") in ("true", ""):
+            search_body["explain"] = True
+        if params.get("profile") in ("true", ""):
+            search_body["profile"] = True
+        return self.h_search(params, json.dumps(search_body).encode(),
+                             index)
+
+    def h_render_template(self, params, body, id=None):
+        spec = _json_body(body)
+        if id is not None and not spec.get("id"):
+            spec = dict(spec, id=id)
+        return {"template_output": self._render_search_template(spec)}
+
+    def h_msearch_template(self, params, body, index=None):
+        """NDJSON header/template pairs: render each template line to a
+        concrete search body, then delegate the whole batch to
+        h_msearch so header-param forwarding, request-level error
+        semantics, and per-item failure shaping stay in ONE place
+        (``RestMultiSearchTemplateAction`` likewise converts to a
+        multi-search request)."""
+        lines = [ln for ln in (body or b"").split(b"\n") if ln.strip()]
+        if len(lines) % 2:
+            raise IllegalArgumentError(
+                "msearch template must have an even number of lines")
+        out_lines: List[bytes] = []
+        render_errors: Dict[int, dict] = {}
+        n_items = 0
+        for i in range(0, len(lines), 2):
+            slot = n_items
+            n_items += 1
+            try:
+                spec = json.loads(lines[i + 1])
+                rendered = self._render_search_template(spec)
+            except Exception as e:   # noqa: BLE001 — render fails the
+                status, payload = _error_payload(e)   # ITEM, not request
+                render_errors[slot] = dict(payload, status=status)
+                continue
+            out_lines.append(lines[i])
+            out_lines.append(json.dumps(rendered).encode())
+        if out_lines:
+            result = self.h_msearch(params,
+                                    b"\n".join(out_lines) + b"\n", index)
+        else:
+            result = {"took": 0, "responses": []}
+        # splice render failures back into their original positions
+        if render_errors:
+            merged: List[dict] = []
+            executed = iter(result["responses"])
+            for slot in range(n_items):
+                merged.append(render_errors.get(slot)
+                              or next(executed))
+            result = dict(result, responses=merged)
+        return result
 
     def h_put_script(self, params, body, id):
         spec = _json_body(body)

@@ -1,0 +1,81 @@
+"""Search template tests (lang-mustache module analog —
+h_search_template / h_render_template / h_msearch_template)."""
+
+import json
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.node.indices_service import IndicesService
+from elasticsearch_tpu.rest.api import RestAPI
+
+
+@pytest.fixture()
+def api():
+    a = RestAPI(IndicesService(tempfile.mkdtemp()))
+    for i, title in ((1, "red shoe"), (2, "blue shoe"), (3, "red hat")):
+        a.handle("PUT", f"/prods/_doc/{i}", "",
+                 json.dumps({"title": title}).encode())
+    a.handle("POST", "/prods/_refresh", "", b"")
+    return a
+
+
+def req(api, method, path, body=None, query=""):
+    if isinstance(body, (dict, list)):
+        b = json.dumps(body).encode()
+    elif isinstance(body, str):
+        b = body.encode()
+    else:
+        b = body or b""
+    st, _ct, out = api.handle(method, path, query, b)
+    return st, json.loads(out)
+
+
+def test_inline_template(api):
+    st, r = req(api, "POST", "/prods/_search/template",
+                {"source": '{"query":{"match":{"title":{"query":'
+                           '"{{color}} shoe","operator":"and"}}}}',
+                 "params": {"color": "red"}})
+    assert st == 200 and r["hits"]["total"]["value"] == 1
+    assert r["hits"]["hits"][0]["_id"] == "1"
+
+
+def test_stored_template_and_missing(api):
+    req(api, "PUT", "/_scripts/by-color",
+        {"script": {"lang": "mustache",
+                    "source": '{"query":{"match":{"title":'
+                              '"{{color}}"}},"size":10}'}})
+    st, r = req(api, "POST", "/prods/_search/template",
+                {"id": "by-color", "params": {"color": "blue"}})
+    assert st == 200 and r["hits"]["total"]["value"] == 1
+    st, r = req(api, "POST", "/prods/_search/template", {"id": "nope"})
+    assert st == 404
+    st, r = req(api, "POST", "/prods/_search/template", {"params": {}})
+    assert st == 400
+
+
+def test_render_template(api):
+    st, r = req(api, "POST", "/_render/template",
+                {"source": '{"query":{"term":{"c":"{{v}}"}}}',
+                 "params": {"v": "x"}})
+    assert r == {"template_output": {"query": {"term": {"c": "x"}}}}
+    # sections render arrays (mustache loops)
+    st, r = req(api, "POST", "/_render/template",
+                {"source": '{"query":{"terms":{"f":['
+                           '{{#vals}}"{{.}}",{{/vals}}"_pad"]}}}',
+                 "params": {"vals": ["a", "b"]}})
+    assert r["template_output"]["query"]["terms"]["f"] == \
+        ["a", "b", "_pad"]
+
+
+def test_msearch_template(api):
+    nd = (json.dumps({"index": "prods"}) + "\n" +
+          json.dumps({"source": '{"query":{"match":{"title":'
+                                '"{{w}}"}}}',
+                      "params": {"w": "shoe"}}) + "\n" +
+          json.dumps({"index": "prods"}) + "\n" +
+          json.dumps({"id": "missing-template"}) + "\n")
+    st, r = req(api, "POST", "/_msearch/template", nd)
+    assert st == 200
+    assert r["responses"][0]["hits"]["total"]["value"] == 2
+    assert r["responses"][1]["status"] == 404
