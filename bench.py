@@ -621,6 +621,18 @@ def main(mode: str = "accel"):
         # the ambient sitecustomize registers the accelerator backend and env
         # vars alone can't override it — go through jax.config
         jax.config.update("jax_platforms", "cpu")
+    # persistent compilation cache: recompiles over the tunnel cost
+    # minutes per run; cached executables survive into the driver's
+    # end-of-round invocation
+    try:
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(
+            __file__)), ".jax_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    except Exception as e:   # noqa: BLE001 — cache is best-effort
+        print(f"# compilation cache unavailable: {e}", file=sys.stderr)
     devs = jax.devices()
     print(f"# jax backend: {devs[0].platform} x{len(devs)}", file=sys.stderr)
     from elasticsearch_tpu.parallel import (DistributedSearchPlane,
