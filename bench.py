@@ -289,14 +289,15 @@ def bench_bool_disjunction(rng, corpus, plane, on_cpu):
     cpu_times, _ = cpu_bm25_search(corpus, cpu_qs, K)
     cpu_qps = len(cpu_times) / sum(cpu_times)
     Q = 8
-    plane.search(batches[0], k=K, Q=Q, L=plane.L_cap, tiered=plane.T_pad > 0)
+    Lb = workload_L(plane, batches, Q)
+    plane.search(batches[0], k=K, Q=Q, L=Lb, tiered=plane.T_pad > 0)
     lat = []
     for qs in batches[1:]:
         t0 = time.perf_counter()
         if on_cpu:
             plane.search_eager(qs, k=K)
         else:
-            plane.search(qs, k=K, Q=Q, L=plane.L_cap,
+            plane.search(qs, k=K, Q=Q, L=Lb,
                          tiered=plane.T_pad > 0)
         lat.append(time.perf_counter() - t0)
     lat = np.asarray(lat)
@@ -314,12 +315,13 @@ def bench_batch_curve(rng, corpus, plane, on_cpu):
     curve = {}
     for b in (1, 4, 16, 64):
         qs = sample_queries(rng, corpus, 1, batch=b)[0]
-        plane.search(qs, k=K, Q=N_TERMS, L=plane.L_cap,
+        Lc = workload_L(plane, [qs], N_TERMS)
+        plane.search(qs, k=K, Q=N_TERMS, L=Lc,
                      tiered=plane.T_pad > 0)        # compile this B
         ts = []
         for _ in range(5):
             t0 = time.perf_counter()
-            plane.search(qs, k=K, Q=N_TERMS, L=plane.L_cap,
+            plane.search(qs, k=K, Q=N_TERMS, L=Lc,
                          tiered=plane.T_pad > 0)
             ts.append(time.perf_counter() - t0)
         curve[str(b)] = round(float(np.median(ts)) * 1e3, 2)
@@ -487,7 +489,7 @@ def bench_hybrid_rrf(rng, mesh, on_cpu):
     def one_batch(qbags, qvecs, timed=True):
         t0 = time.perf_counter()
         _vals, hits = plane.search(qbags, k=window, Q=N_TERMS,
-                                   L=plane.L_cap, tiered=plane.T_pad > 0)
+                                   L=L_hy, tiered=plane.T_pad > 0)
         _kvals, kidx = kstep(d_vecs, d_exists,
                              jax.device_put(qvecs, q_shard))
         kidx = np.asarray(kidx)
@@ -500,6 +502,12 @@ def bench_hybrid_rrf(rng, mesh, on_cpu):
 
     warm_b = sample_queries(rng, corpus, 1, batch=B)[0]
     warm_v = rng.randn(B, dim).astype(np.float32)
+    iters = 8 if on_cpu else 24
+    timed_b = [sample_queries(rng, corpus, 1, batch=B)[0]
+               for _ in range(iters)]
+    timed_v = [rng.randn(B, dim).astype(np.float32)
+               for _ in range(iters)]
+    L_hy = workload_L(plane, [warm_b] + timed_b)
     one_batch(warm_b, warm_v)
     # numpy reference on 4 queries: same retrievers, same fusion
     t0 = time.perf_counter()
@@ -513,11 +521,8 @@ def bench_hybrid_rrf(rng, mesh, on_cpu):
         cpu_fused.append(_rrf([list(map(int, cpu_hits[bi])),
                                list(map(int, vr))], k_out))
     cpu_qps = 4 / (time.perf_counter() - t0)
-    iters = 8 if on_cpu else 24
     ts = []
-    for _ in range(iters):
-        qb = sample_queries(rng, corpus, 1, batch=B)[0]
-        qv = rng.randn(B, dim).astype(np.float32)
+    for qb, qv in zip(timed_b, timed_v):
         _f, dt = one_batch(qb, qv)
         ts.append(dt)
     ts = np.asarray(ts)
@@ -615,6 +620,18 @@ def bench_serving(rng):
         "microbatch": batch_stats})
 
 
+
+def workload_L(plane, batches, Q=None):
+    """One compile shape per config, sized to the WORKLOAD's largest
+    sparse posting run instead of the table-wide L_cap — the merge cost
+    scales with L, and frequency-weighted queries mostly hit dense-tier
+    terms whose sparse runs are empty."""
+    from elasticsearch_tpu.utils.shapes import round_up_pow2
+    max_len = 1
+    for qs in batches:
+        max_len = max(max_len, plane.max_run_len(qs))
+    return min(round_up_pow2(max_len), plane.L_cap)
+
 def main(mode: str = "accel"):
     import jax
     if mode == "cpu" or os.environ.get("BENCH_FORCE_CPU"):
@@ -672,7 +689,7 @@ def main(mode: str = "accel"):
           f"sparse L_cap {plane.L_cap} "
           f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
 
-    # fixed compile shapes: Q=N_TERMS, L=L_cap, tiered kernel throughout.
+    # fixed compile shapes: Q=N_TERMS, workload-sized L, tiered kernel.
     # On a CPU backend the serving path is the plane's term-at-a-time eager
     # scorer (search_eager — the matmul dense tier exists to ride the MXU
     # and does ~25x the arithmetic a CPU should do); the tiered kernel is
@@ -680,22 +697,25 @@ def main(mode: str = "accel"):
     on_cpu_serving = on_cpu
     tiered = plane.T_pad > 0
     warm = sample_queries(rng, corpus, 1)[0]
+    timed_batches = sample_queries(rng, corpus, TIMED_ITERS)
+    kb = sample_queries(rng, corpus, 8) if on_cpu else []
     t0 = time.perf_counter()
-    plane.search(warm, k=K, Q=N_TERMS, L=plane.L_cap, tiered=tiered)
+    L1 = workload_L(plane, [warm] + timed_batches + kb, N_TERMS)
+    print(f"# headline L (workload-sized): {L1} (cap {plane.L_cap})",
+          file=sys.stderr)
+    plane.search(warm, k=K, Q=N_TERMS, L=L1, tiered=tiered)
     print(f"# compile+warm: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     kernel_cpu_qps = None
     if on_cpu_serving:
-        kb = sample_queries(rng, corpus, 8)
         t0 = time.perf_counter()
         for qs in kb:
-            plane.search(qs, k=K, Q=N_TERMS, L=plane.L_cap, tiered=tiered)
+            plane.search(qs, k=K, Q=N_TERMS, L=L1, tiered=tiered)
         kernel_cpu_qps = (8 * BATCH) / (time.perf_counter() - t0)
         print(f"# tiered kernel on cpu: {kernel_cpu_qps:.1f} qps "
               f"(reported as kernel_cpu_qps)", file=sys.stderr)
         plane.search_eager(warm, k=K)       # warm the eager path
 
-    timed_batches = sample_queries(rng, corpus, TIMED_ITERS)
     lat = []
     first_result = None
     for qs in timed_batches:
@@ -703,7 +723,7 @@ def main(mode: str = "accel"):
         if on_cpu_serving:
             vals, hits = plane.search_eager(qs, k=K)
         else:
-            vals, hits = plane.search(qs, k=K, Q=N_TERMS, L=plane.L_cap,
+            vals, hits = plane.search(qs, k=K, Q=N_TERMS, L=L1,
                                       tiered=tiered)
         lat.append(time.perf_counter() - t0)
         if first_result is None:

@@ -479,6 +479,40 @@ class DistributedSearchPlane:
         return (starts, lengths, idfw, dense_rid, dense_hit, max_len,
                 any_dense)
 
+    def max_run_len(self, queries: Sequence[Sequence[str]]) -> int:
+        """Longest sparse-tier posting run any of these queries touches
+        — the minimal safe L.  Cheap (dict probes + offset diffs only;
+        none of _lookup's array assembly), for callers sizing a shared
+        compile shape across a workload."""
+        out = 1
+        for terms in queries:
+            for t in set(terms):
+                for sh in self.shards:
+                    tid = sh["term_ids"].get(t)
+                    if tid is None:
+                        continue
+                    if sh["dense_row_of"] and \
+                            int(tid) in sh["dense_row_of"]:
+                        continue
+                    ln = int(sh["sparse_offsets"][tid + 1]) - \
+                        int(sh["sparse_offsets"][tid])
+                    out = max(out, ln)
+        return out
+
+    def ladder_L(self, needed: int) -> int:
+        """Smallest rung of a fixed 4-step geometric ladder ≥ needed
+        (L_cap, L_cap/8, L_cap/64, L_cap/512 floored at 1024).  Serving
+        uses this instead of raw pow2 buckets: at most 4 sparse-merge
+        compile shapes per (B, Q, k) family instead of ~log2(L_cap),
+        while ordinary short-run batches still skip the worst-case
+        merge cost."""
+        rungs = sorted({max(1024, self.L_cap >> s)
+                        for s in (9, 6, 3, 0)})
+        for r in rungs:
+            if needed <= r:
+                return r
+        return self.L_cap
+
     def _dense_inputs(self, idfw, dense_rid, dense_hit):
         """Slot-space dense-tier inputs for one batch: pick the used-row
         gather width U (pow2-bucketed for compile-cache stability), build

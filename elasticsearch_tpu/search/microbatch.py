@@ -113,10 +113,15 @@ class PlaneMicroBatcher:
         b_pad = 1 << max(0, (len(batch) - 1).bit_length())
         queries = [s.terms for s in batch] + \
             [[] for _ in range(b_pad - len(batch))]
-        # pin L (postings-run cap) and the tiered flag so the compile shape
-        # depends only on (B_pow2, Q_pow2, k-bucket), not on which terms a
-        # batch happens to touch
-        L = getattr(self.plane, "L_cap", None)
+        # size L to the batch through the plane's 4-rung ladder: ordinary
+        # short-run batches skip the worst-case sparse-merge cost
+        # (pinning L_cap made every dispatch pay it — the difference
+        # between ~10ms and multi-second dispatches on the full corpus),
+        # while the rung count bounds serving-time compiles to at most 4
+        # shapes per (B, Q, k) family
+        L = None
+        if hasattr(self.plane, "max_run_len"):
+            L = self.plane.ladder_L(self.plane.max_run_len(queries))
         tiered = getattr(self.plane, "T_pad", 0) > 0 or None
         try:
             vals, hits, totals = self.plane.search(
