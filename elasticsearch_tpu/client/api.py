@@ -248,6 +248,147 @@ class SecurityClient(_Namespace):
     def authenticate(self, **params):
         return self._c._req("GET", "/_security/_authenticate", params)
 
+    def put_user(self, username: str, body: dict, **params):
+        return self._c._req("PUT", f"/_security/user/{_esc(username)}",
+                            params, body)
+
+    def get_user(self, username: Optional[str] = None, **params):
+        path = f"/_security/user/{_esc(username)}" if username \
+            else "/_security/user"
+        return self._c._req("GET", path, params)
+
+    def delete_user(self, username: str, **params):
+        return self._c._req("DELETE",
+                            f"/_security/user/{_esc(username)}", params)
+
+    def put_role(self, name: str, body: dict, **params):
+        return self._c._req("PUT", f"/_security/role/{_esc(name)}",
+                            params, body)
+
+    def get_role(self, name: Optional[str] = None, **params):
+        path = f"/_security/role/{_esc(name)}" if name \
+            else "/_security/role"
+        return self._c._req("GET", path, params)
+
+    def delete_role(self, name: str, **params):
+        return self._c._req("DELETE", f"/_security/role/{_esc(name)}",
+                            params)
+
+    def has_privileges(self, body: dict, **params):
+        return self._c._req("POST", "/_security/user/_has_privileges",
+                            params, body)
+
+
+class MlClient(_Namespace):
+    def put_job(self, job_id: str, body: dict, **params):
+        return self._c._req(
+            "PUT", f"/_ml/anomaly_detectors/{_esc(job_id)}", params,
+            body)
+
+    def open_job(self, job_id: str, **params):
+        return self._c._req(
+            "POST", f"/_ml/anomaly_detectors/{_esc(job_id)}/_open",
+            params)
+
+    def close_job(self, job_id: str, **params):
+        return self._c._req(
+            "POST", f"/_ml/anomaly_detectors/{_esc(job_id)}/_close",
+            params)
+
+    def get_jobs(self, job_id: Optional[str] = None, **params):
+        path = f"/_ml/anomaly_detectors/{_esc(job_id)}" if job_id \
+            else "/_ml/anomaly_detectors"
+        return self._c._req("GET", path, params)
+
+    def get_buckets(self, job_id: str, body: Optional[dict] = None,
+                    **params):
+        return self._c._req(
+            "POST",
+            f"/_ml/anomaly_detectors/{_esc(job_id)}/results/buckets",
+            params, body or {})
+
+    def get_records(self, job_id: str, body: Optional[dict] = None,
+                    **params):
+        return self._c._req(
+            "POST",
+            f"/_ml/anomaly_detectors/{_esc(job_id)}/results/records",
+            params, body or {})
+
+    def put_datafeed(self, feed_id: str, body: dict, **params):
+        return self._c._req("PUT", f"/_ml/datafeeds/{_esc(feed_id)}",
+                            params, body)
+
+    def start_datafeed(self, feed_id: str, **params):
+        return self._c._req(
+            "POST", f"/_ml/datafeeds/{_esc(feed_id)}/_start", params)
+
+    def put_trained_model(self, model_id: str, body: dict, **params):
+        return self._c._req(
+            "PUT", f"/_ml/trained_models/{_esc(model_id)}", params,
+            body)
+
+    def infer_trained_model(self, model_id: str, body: dict, **params):
+        return self._c._req(
+            "POST", f"/_ml/trained_models/{_esc(model_id)}/_infer",
+            params, body)
+
+    def put_data_frame_analytics(self, aid: str, body: dict, **params):
+        return self._c._req(
+            "PUT", f"/_ml/data_frame/analytics/{_esc(aid)}", params,
+            body)
+
+    def start_data_frame_analytics(self, aid: str, **params):
+        return self._c._req(
+            "POST", f"/_ml/data_frame/analytics/{_esc(aid)}/_start",
+            params)
+
+
+class SlmClient(_Namespace):
+    def put_lifecycle(self, policy_id: str, body: dict, **params):
+        return self._c._req("PUT", f"/_slm/policy/{_esc(policy_id)}",
+                            params, body)
+
+    def get_lifecycle(self, policy_id: Optional[str] = None, **params):
+        path = f"/_slm/policy/{_esc(policy_id)}" if policy_id \
+            else "/_slm/policy"
+        return self._c._req("GET", path, params)
+
+    def execute_lifecycle(self, policy_id: str, **params):
+        return self._c._req(
+            "POST", f"/_slm/policy/{_esc(policy_id)}/_execute", params)
+
+    def execute_retention(self, **params):
+        return self._c._req("POST", "/_slm/_execute_retention", params)
+
+    def get_stats(self, **params):
+        return self._c._req("GET", "/_slm/stats", params)
+
+
+class LicenseClient(_Namespace):
+    def get(self, **params):
+        return self._c._req("GET", "/_license", params)
+
+    def post_start_trial(self, **params):
+        return self._c._req("POST", "/_license/start_trial", params)
+
+    def post_start_basic(self, **params):
+        return self._c._req("POST", "/_license/start_basic", params)
+
+
+class AutoscalingClient(_Namespace):
+    def put_autoscaling_policy(self, name: str, body: dict, **params):
+        return self._c._req("PUT",
+                            f"/_autoscaling/policy/{_esc(name)}",
+                            params, body)
+
+    def get_autoscaling_capacity(self, **params):
+        return self._c._req("GET", "/_autoscaling/capacity", params)
+
+    def delete_autoscaling_policy(self, name: str, **params):
+        return self._c._req("DELETE",
+                            f"/_autoscaling/policy/{_esc(name)}",
+                            params)
+
 
 class EsTpuClient:
     """The entry point: ``EsTpuClient(["localhost:9200"])``."""
@@ -272,6 +413,10 @@ class EsTpuClient:
         self.eql = EqlClient(self)
         self.tasks = TasksClient(self)
         self.security = SecurityClient(self)
+        self.ml = MlClient(self)
+        self.slm = SlmClient(self)
+        self.license = LicenseClient(self)
+        self.autoscaling = AutoscalingClient(self)
 
     def _req(self, method: str, path: str,
              params: Optional[dict] = None, body: Any = None) -> Any:

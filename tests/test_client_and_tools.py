@@ -90,6 +90,22 @@ def test_client_namespaces(client):
     assert "nodes" in stats
     out = client.sql.query({"query": "SELECT title FROM books"})
     assert out["rows"] == [["Dune"]]
+    # session-3 namespaces: ml / slm / license / autoscaling
+    lic = client.license.get()
+    assert lic["license"]["type"] == "basic"
+    client.ml.put_job("cjob", {
+        "analysis_config": {"bucket_span": "1h", "detectors": [
+            {"function": "count"}]},
+        "data_description": {"time_field": "t"}})
+    jobs = client.ml.get_jobs("cjob")
+    assert jobs["count"] == 1
+    client.autoscaling.put_autoscaling_policy(
+        "p1", {"roles": ["data"],
+               "deciders": {"fixed": {"storage": "1gb"}}})
+    cap = client.autoscaling.get_autoscaling_capacity()
+    assert "p1" in cap["policies"]
+    stats = client.slm.get_stats()
+    assert "total_snapshots_taken" in stats
 
 
 def test_client_dead_node_failover():
