@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .topk import batched_blockwise_topk
 from .sorted_merge import bm25_merge_candidates
 
 NEG_INF = float("-inf")
@@ -149,7 +150,7 @@ def dense_stream_topk(W, dense_blocks, *, k: int,
         s = jnp.where(s > 0, s, NEG_INF)
         n_matched = n_matched + jnp.sum((s > NEG_INF).astype(jnp.int32),
                                         axis=1)
-        v, i = lax.top_k(s, min(k, C))
+        v, i = batched_blockwise_topk(s, min(k, C))
         gi = (i + blk_idx * C).astype(jnp.int32)
         if v.shape[1] < k:
             v = jnp.pad(v, ((0, 0), (0, k - v.shape[1])),

@@ -28,25 +28,47 @@ def _topk_kernel(n: int, k: int):
         (values float32[k], indices int32[k]); excluded slots carry -inf."""
         masked = jnp.where(mask, scores, NEG_INF)
         if use_blocks:
-            blocks = masked.reshape(n // _BLOCK, _BLOCK)
-            bvals, bidx = jax.lax.top_k(blocks, k)          # [B, k] each
-            base = (jnp.arange(n // _BLOCK, dtype=jnp.int32) * _BLOCK)[:, None]
-            cand_idx = (bidx.astype(jnp.int32) + base).reshape(-1)
-            cand_vals = bvals.reshape(-1)
-            # Stable global tie-break: candidates are ordered by block, and
-            # within a block top_k returns lowest-index-first for ties, but
-            # across the flattened candidate list equal values from a later
-            # block could sit earlier than a same-valued candidate from an
-            # earlier block only if top_k reordered them — it does not: we
-            # re-sort by (value desc, index asc) explicitly to be safe.
-            order = jnp.lexsort((cand_idx, -cand_vals))
-            cand_vals = cand_vals[order][:k]
-            cand_idx = cand_idx[order][:k]
-            return cand_vals, cand_idx
+            # one algorithm, one implementation: the batched helper's
+            # tie-break argument (block-major candidates + top_k's
+            # lowest-index preference) covers the 1-D case as its B=1
+            # slice
+            vals, idx = batched_blockwise_topk(masked[None], k,
+                                               block=_BLOCK)
+            return vals[0], idx[0]
         vals, idx = jax.lax.top_k(masked, k)
         return vals, idx.astype(jnp.int32)
 
     return jax.jit(kernel)
+
+
+def batched_blockwise_topk(scores, k: int, block: int = _BLOCK):
+    """Exact top-k over the last axis of ``scores`` [B, n] via the
+    two-stage blockwise path: per-block ``top_k`` then a final ``top_k``
+    over the B × (n/block)·k candidate set.  ``lax.top_k`` cost grows
+    with the sorted width, so two narrow selections beat one over n
+    (the same trade ops/topk.py's 1-D kernel makes; this is the batched
+    form the kNN einsum and the dense-tier scan need).
+
+    Exact: any global top-k element is inside its own block's top-k
+    (k ≤ block).  Tie-break stays ascending-index: candidates are laid
+    out block-major, within a block ``top_k`` puts the lowest index
+    first among equals, and the final ``top_k`` picks the lowest
+    candidate position among equals — which is the earlier block.
+    Falls back to plain ``top_k`` when the shape doesn't block."""
+    n = scores.shape[-1]
+    if n % block or n < 2 * block or k > block:
+        vals, idx = jax.lax.top_k(scores, min(k, n))
+        return vals, idx.astype(jnp.int32)
+    nb = n // block
+    blocks = scores.reshape(scores.shape[0], nb, block)
+    bv, bi = jax.lax.top_k(blocks, k)                # [B, nb, k]
+    base = (jnp.arange(nb, dtype=jnp.int32) * block)[None, :, None]
+    cand_idx = (bi.astype(jnp.int32) + base).reshape(
+        scores.shape[0], nb * k)
+    cand_vals = bv.reshape(scores.shape[0], nb * k)
+    vals, sel = jax.lax.top_k(cand_vals, k)
+    idx = jnp.take_along_axis(cand_idx, sel, axis=1)
+    return vals, idx
 
 
 _CACHE: dict = {}

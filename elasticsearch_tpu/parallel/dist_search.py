@@ -41,6 +41,7 @@ from ..ops.bm25 import DEFAULT_B, DEFAULT_K1, idf_weight
 from ..ops.sorted_merge import bm25_topk_merge_body, make_impacts
 from ..ops.tiered_bm25 import (build_dense_rows, split_tiers,
                                tiered_bm25_topk)
+from ..ops.topk import batched_blockwise_topk
 from ..utils.shapes import round_up_multiple, round_up_pow2
 from .mesh import AXIS_REPLICA, AXIS_SHARD
 
@@ -257,7 +258,7 @@ def build_knn_step(mesh: Mesh, *, n_pad: int, dim: int, k: int,
                 scores = jnp.einsum("bd,nd->bn", qq, vv,
                                     preferred_element_type=jnp.float32)
             scores = jnp.where(exists_s[None, :], scores, NEG_INF)
-            vals, idx = lax.top_k(scores, kk)
+            vals, idx = batched_blockwise_topk(scores, kk)
             return vals, idx.astype(jnp.int32)
 
         vals, idx = jax.vmap(per_shard, out_axes=1)(vecs, exists)
