@@ -92,6 +92,25 @@ def _os_stats() -> dict:
                     "free_percent": 100 - pct, "used_percent": pct}}
 
 
+def _os_mem_stats() -> dict:
+    """Memory slice of the shared /proc/meminfo probe — cluster-stats
+    and node-stats must report from identical parsing."""
+    return {"mem": _os_stats()["mem"]}
+
+
+def _fs_stats(path: str) -> dict:
+    """Real filesystem figures for the data path
+    (``monitor/fs/FsProbe.java``)."""
+    try:
+        import shutil as _sh
+        du = _sh.disk_usage(path)
+        return {"total_in_bytes": du.total, "free_in_bytes": du.free,
+                "available_in_bytes": du.free}
+    except OSError:
+        return {"total_in_bytes": 0, "free_in_bytes": 0,
+                "available_in_bytes": 0}
+
+
 def _process_stats() -> dict:
     """Real process figures (reference: ``monitor/process/ProcessProbe``)."""
     import resource
@@ -1417,27 +1436,26 @@ class RestAPI:
                           "remote_cluster_client": 1, "ml": 0,
                           "voting_only": 0},
                 "versions": ["8.0.0"],
-                "os": {"available_processors": os.cpu_count() or 1,
-                       "allocated_processors": os.cpu_count() or 1,
-                       "names": [{"name": "Linux", "count": 1}],
-                       "pretty_names": [{"pretty_name": "Linux",
-                                         "count": 1}],
-                       "architectures": [{"arch": "x86_64", "count": 1}],
-                       "mem": {"total_in_bytes": 1 << 33,
-                               "free_in_bytes": 1 << 32,
-                               "used_in_bytes": 1 << 32,
-                               "free_percent": 50,
-                               "used_percent": 50}},
-                "process": {"cpu": {"percent": 0},
-                            "open_file_descriptors": {"min": 1, "max": 1,
-                                                      "avg": 1}},
+                "os": dict(_os_mem_stats(),
+                           available_processors=os.cpu_count() or 1,
+                           allocated_processors=os.cpu_count() or 1,
+                           names=[{"name": "Linux", "count": 1}],
+                           pretty_names=[{"pretty_name": "Linux",
+                                          "count": 1}],
+                           architectures=[{"arch": "x86_64",
+                                           "count": 1}]),
+                "process": (lambda p: {
+                    "cpu": p["cpu"],
+                    "open_file_descriptors": {
+                        "min": p["open_file_descriptors"],
+                        "max": p["open_file_descriptors"],
+                        "avg": p["open_file_descriptors"]}})(
+                    _process_stats()),
                 "jvm": {"max_uptime_in_millis": 0, "versions": [],
                         "mem": {"heap_used_in_bytes": 0,
                                 "heap_max_in_bytes": 0},
                         "threads": 1},
-                "fs": {"total_in_bytes": 1 << 33,
-                       "free_in_bytes": 1 << 32,
-                       "available_in_bytes": 1 << 32},
+                "fs": _fs_stats(self.indices.data_path),
                 "plugins": [{"name": "tpu-engine"}],
                 "network_types": {"transport_types": {"netty4": 1},
                                   "http_types": {"netty4": 1}},
@@ -1755,17 +1773,12 @@ class RestAPI:
                             "write": {"threads": 1, "queue": 0,
                                       "active": 0, "rejected": 0,
                                       "largest": 1, "completed": 0}},
-            "fs": (lambda du: {
+            "fs": (lambda t: {
                 "timestamp": int(time.time() * 1000),
-                "total": {"total_in_bytes": du.total,
-                          "free_in_bytes": du.free,
-                          "available_in_bytes": du.free},
-                "data": [{"path": self.indices.data_path,
-                          "mount": "/", "type": "fs",
-                          "total_in_bytes": du.total,
-                          "free_in_bytes": du.free,
-                          "available_in_bytes": du.free}]})(
-                __import__("shutil").disk_usage(self.indices.data_path)),
+                "total": t,
+                "data": [dict(t, path=self.indices.data_path,
+                              mount="/", type="fs")]})(
+                _fs_stats(self.indices.data_path)),
             "transport": {"server_open": 0,
                           "total_outbound_connections": 0,
                           "rx_count": 0, "rx_size_in_bytes": 0,
