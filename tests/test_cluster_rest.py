@@ -206,3 +206,42 @@ def test_rest_cluster_health_and_http(cluster):
             f"http://127.0.0.1:{http_port}/httpidx/_doc/1",
             timeout=10) as resp:
         assert json.loads(resp.read())["_source"] == {"a": 1}
+
+
+def test_deprecation_warning_header_in_cluster_mode(cluster, tmp_path):
+    """Cluster HTTP dispatches run on an executor thread; the
+    deprecation-warning accumulator must cross that boundary
+    (contextvars copy_context in start_http) so the RFC-7234 299
+    Warning header still renders."""
+    import http.client
+    nodes = cluster
+    wait_leader(nodes)
+    front = nodes[1]
+    http_port = BASE_PORT + 50
+    front.start_http(http_port)
+    deadline = time.monotonic() + 5.0
+    conn = None
+    while time.monotonic() < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", http_port,
+                                              timeout=5)
+            conn.request("GET", "/")
+            conn.getresponse().read()
+            break
+        except OSError:
+            time.sleep(0.1)
+    assert conn is not None
+    body = json.dumps({"index_patterns": ["w-*"]})
+    conn.request("PUT", "/_template/warn1", body,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    resp.read()
+    warns = resp.getheader("Warning")
+    assert resp.status == 200
+    assert warns is not None and "Legacy index templates" in warns
+    # a non-deprecated request on the same connection carries none
+    conn.request("GET", "/_cluster/health", None)
+    resp = conn.getresponse()
+    resp.read()
+    assert resp.getheader("Warning") is None
+    conn.close()
