@@ -642,8 +642,14 @@ def main(mode: str = "accel"):
     # minutes per run; cached executables survive into the driver's
     # end-of-round invocation
     try:
+        # per-backend cache dirs: a CPU-child loading artifacts the
+        # accel child compiled (or vice versa) triggers machine-feature
+        # mismatch warnings and risks SIGILL on a real mismatch
+        plat = "cpu" if (mode == "cpu"
+                         or os.environ.get("BENCH_FORCE_CPU")) \
+            else "accel"
         cache_dir = os.path.join(os.path.dirname(os.path.abspath(
-            __file__)), ".jax_cache")
+            __file__)), ".jax_cache", plat)
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
