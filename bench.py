@@ -641,21 +641,22 @@ def main(mode: str = "accel"):
     # persistent compilation cache: recompiles over the tunnel cost
     # minutes per run; cached executables survive into the driver's
     # end-of-round invocation
-    try:
-        # per-backend cache dirs: a CPU-child loading artifacts the
-        # accel child compiled (or vice versa) triggers machine-feature
-        # mismatch warnings and risks SIGILL on a real mismatch
-        plat = "cpu" if (mode == "cpu"
-                         or os.environ.get("BENCH_FORCE_CPU")) \
-            else "accel"
-        cache_dir = os.path.join(os.path.dirname(os.path.abspath(
-            __file__)), ".jax_cache", plat)
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          1.0)
-    except Exception as e:   # noqa: BLE001 — cache is best-effort
-        print(f"# compilation cache unavailable: {e}", file=sys.stderr)
+    if mode != "cpu" and not os.environ.get("BENCH_FORCE_CPU"):
+        # accel only: recompiles over the tunnel cost minutes per run
+        # and the cache halves the next run's setup. CPU children skip
+        # it — their compiles are seconds, and this XLA version's CPU
+        # AOT loader logs feature-mismatch warnings on every cache load
+        # (virtual +prefer-no-* features baked at compile time).
+        try:
+            cache_dir = os.path.join(os.path.dirname(os.path.abspath(
+                __file__)), ".jax_cache", "accel")
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception as e:   # noqa: BLE001 — cache is best-effort
+            print(f"# compilation cache unavailable: {e}",
+                  file=sys.stderr)
     devs = jax.devices()
     print(f"# jax backend: {devs[0].platform} x{len(devs)}", file=sys.stderr)
     from elasticsearch_tpu.parallel import (DistributedSearchPlane,
