@@ -7972,6 +7972,22 @@ class RestAPI:
         index_filter = b.get("index_filter")
         if index_filter is not None:
             from ..search.query_dsl import parse_query
+            # an unparseable filter fails the whole REQUEST (400), like
+            # the reference — only per-index evaluation verdicts drop
+            # individual indices below
+            parse_query(index_filter)
+
+            def _err_status(e) -> int:
+                st = getattr(e, "status", None)
+                if st is None and hasattr(e, "remote_type"):
+                    # remote shard errors cross the wire by class NAME;
+                    # recover the status from the error registry so a
+                    # remote 4xx drops the index exactly like a local one
+                    from ..common import errors as _errs
+                    cls = getattr(_errs, e.remote_type or "", None)
+                    st = getattr(cls, "status", None)
+                return st or 0
+
             kept = []
             for n in names:
                 svc = self.indices.indices[n]
@@ -7987,8 +8003,13 @@ class RestAPI:
                     if docs == 0 or svc.count(
                             {"query": index_filter}) > 0:
                         kept.append(n)   # empty shard → can_match true
-                except Exception:   # noqa: BLE001 — unmapped fields
-                    pass
+                except Exception as e:   # noqa: BLE001
+                    # a 4xx (unmapped field) is a real no-match verdict;
+                    # anything else (transient RPC under cluster load)
+                    # must KEEP the index — silently dropping caps is
+                    # worse than an extra entry
+                    if not (400 <= _err_status(e) < 500):
+                        kept.append(n)
             names = kept
         import fnmatch
         from ..index.mapping import (DateFieldType, NestedFieldType,
