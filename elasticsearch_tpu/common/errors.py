@@ -166,3 +166,14 @@ class ActionRequestValidationError(ElasticsearchError):
     """Request validation failures (action_request_validation_exception)."""
     status = 400
     error_type = "action_request_validation_exception"
+
+
+def remote_status(e) -> int:
+    """HTTP status of any exception, including remote-wrapped ones whose
+    class crossed the transport by NAME (RemoteTransportError carries
+    ``remote_type``); 0 when unknown."""
+    st = getattr(e, "status", None)
+    if st is None and hasattr(e, "remote_type"):
+        cls = globals().get(getattr(e, "remote_type", "") or "")
+        st = getattr(cls, "status", None)
+    return int(st or 0)

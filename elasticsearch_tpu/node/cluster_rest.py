@@ -178,6 +178,19 @@ class RemoteShardProxy:
             "id": doc_id, "source": source, "routing": routing,
             "op_type": op_type, "if_seq_no": if_seq_no,
             "if_primary_term": if_primary_term})
+        meta_seq = r.pop("_meta_seq", None)
+        if meta_seq:
+            # a dynamic-mapping update rode this write: the front must
+            # hold the REST ack until that metadata op is locally
+            # applied, so the client's next request (field_caps, GET
+            # _mapping) sees the new fields — the reference acks only
+            # after the master publishes the mapping change. We run
+            # UNDER the front's self.lock here, so only STASH the seq;
+            # _local waits after releasing the lock (waiting here would
+            # stall state application against the lock).
+            tls = self.node.rest._pending_ack_seq_tls
+            tls.value = max(getattr(tls, "value", None) or 0,
+                            int(meta_seq))
         return IndexResult(**r)
 
     def delete(self, doc_id, *, if_seq_no=None, if_primary_term=None):
@@ -472,6 +485,18 @@ class ClusterRestService:
             "shared_repos")
         self.lock = threading.RLock()
         self.applied_seq = 0
+        #: serializes op application: the data worker (state apply), the
+        #: meta pool (h_meta_op catch-up), and write-ack waiters
+        #: (wait_applied_seq) may all call apply_ops concurrently — an
+        #: unguarded pair could double-execute the same op
+        self._apply_ops_mutex = threading.RLock()
+        #: last metadata-op seq this thread published (_meta_op writes,
+        #: _after_local consumes)
+        self._last_meta_seq_tls = threading.local()
+        #: meta seq a routed write on this thread must see applied
+        #: before its REST response leaves (_local drains it OUTSIDE
+        #: self.lock — waiting inside would stall state application)
+        self._pending_ack_seq_tls = threading.local()
         #: op history by seq, maintained on EVERY node as ops apply (not
         #: just the executing master) so history survives master changes;
         #: nodes behind the state tail fetch missing ranges from peers.
@@ -514,6 +539,19 @@ class ClusterRestService:
     #: seconds of failed history fetches before a gap is unrecoverable
     GAP_GRACE = 20.0
 
+    def wait_applied_seq(self, seq: int, timeout: float = 3.0) -> bool:
+        """Spin until this node has applied metadata op ``seq`` (or the
+        timeout passes). Used to hold write acks that carried a dynamic
+        mapping update until the change is locally visible — usually
+        near-instant, as the op rode the publication already in flight.
+        A pure spin ON PURPOSE: application belongs to the data worker
+        (whose apply path holds self.lock before taking the apply
+        mutex); applying from here would invert that lock order."""
+        deadline = time.monotonic() + timeout
+        while self.applied_seq < seq and time.monotonic() < deadline:
+            time.sleep(0.01)
+        return self.applied_seq >= seq
+
     def apply_ops(self, state) -> None:
         log = state.data.get("meta_ops")
         if not log:
@@ -521,6 +559,12 @@ class ClusterRestService:
         seq = log["seq"]
         tail = log["tail"]
         if self.applied_seq >= seq:     # racy fast-path; re-checked below
+            return
+        with self._apply_ops_mutex:
+            self._apply_ops_locked(seq, tail)
+
+    def _apply_ops_locked(self, seq: int, tail) -> None:
+        if self.applied_seq >= seq:
             return
         have = {op["seq"]: op for op in tail}
         missing = [s for s in range(self.applied_seq + 1, seq + 1)
@@ -683,8 +727,13 @@ class ClusterRestService:
         return self._local(method, path, query, body)
 
     def _local(self, method, path, query, body):
+        self._pending_ack_seq_tls.value = None
         with self.lock:
             out = self.api.handle(method, path, query, body)
+        pending = getattr(self._pending_ack_seq_tls, "value", None)
+        if pending:
+            self._pending_ack_seq_tls.value = None
+            self.wait_applied_seq(int(pending))
         self._after_local(method, path, body)
         return out
 
@@ -773,6 +822,10 @@ class ClusterRestService:
             raise _errors.ElasticsearchError(
                 f"no master acked [{method} {path}]: {last}")
         seq = resp.get("seq")
+        # expose the op seq to the caller (thread-local: _meta_op's
+        # return is the REST 3-tuple) — _after_local reads it to thread
+        # mapping-update visibility through write acks
+        self._last_meta_seq_tls.value = seq
         on_data_worker = threading.current_thread().name.startswith(
             f"{node.node_id}-data")
         if seq and not on_data_worker:
@@ -986,18 +1039,22 @@ class ClusterRestService:
             except _errors.ElasticsearchError:
                 pass                          # exists / races are fine
 
-    def _after_local(self, method, path, body) -> None:
+    def _after_local(self, method, path, body):
         """Propagate dynamic-mapping growth to the cluster (the
         reference's mapping-update master round-trip inside the bulk
         path, ``TransportShardBulkAction.java:233``). Only the indices the
         request targeted are fingerprinted — re-serializing every mapping
-        per doc write would scale with total cluster mapping size."""
+        per doc write would scale with total cluster mapping size.
+        Returns the newest metadata-op seq this call published (None if
+        nothing changed) so write acks can wait for cluster visibility —
+        the reference acks a write only after the mapping update is
+        published."""
         if method not in ("PUT", "POST", "DELETE"):
-            return
+            return None
         segs = [s for s in path.split("/") if s]
         tail = next((s for s in segs if s.startswith("_")), None)
         if tail not in _DOC_WRITE_SUFFIXES:
-            return
+            return None
         targets = set()
         if segs and not segs[0].startswith("_"):
             targets.add(segs[0])
@@ -1032,6 +1089,7 @@ class ClusterRestService:
                     pass
             items = [(n, svc) for n, svc in self.indices.indices.items()
                      if n in concrete]
+        newest_seq = None
         for name, svc in items:
             if name not in known:
                 continue
@@ -1046,11 +1104,16 @@ class ClusterRestService:
                 self._propagated[name] = fp
                 continue
             try:
+                self._last_meta_seq_tls.value = None
                 self._meta_op("PUT", f"/{name}/_mapping", "",
                               json.dumps(m, default=str).encode())
                 self._propagated[name] = fp
+                seq = self._last_meta_seq_tls.value
+                if seq:
+                    newest_seq = max(newest_seq or 0, int(seq))
             except _errors.ElasticsearchError:
                 pass
+        return newest_seq
 
     # ------------------------------------------------------------------
     # cluster-wide shard stats (owner side + front merge)
@@ -2116,8 +2179,12 @@ class ClusterRestService:
                     op_type=payload.get("op_type", "index"),
                     if_seq_no=payload.get("if_seq_no"),
                     if_primary_term=payload.get("if_primary_term"))
-        self._after_local("POST", f"/{payload['index']}/_doc/x", b"")
-        return dict(r.__dict__)
+        seq = self._after_local("POST", f"/{payload['index']}/_doc/x",
+                                b"")
+        out = dict(r.__dict__)
+        if seq:
+            out["_meta_seq"] = seq
+        return out
 
     def h_doc2_delete(self, src, payload) -> dict:
         w = self._local_writer(payload)

@@ -7977,16 +7977,7 @@ class RestAPI:
             # individual indices below
             parse_query(index_filter)
 
-            def _err_status(e) -> int:
-                st = getattr(e, "status", None)
-                if st is None and hasattr(e, "remote_type"):
-                    # remote shard errors cross the wire by class NAME;
-                    # recover the status from the error registry so a
-                    # remote 4xx drops the index exactly like a local one
-                    from ..common import errors as _errs
-                    cls = getattr(_errs, e.remote_type or "", None)
-                    st = getattr(cls, "status", None)
-                return st or 0
+            from ..common.errors import remote_status as _err_status
 
             kept = []
             for n in names:
