@@ -16,8 +16,10 @@ datasets):
    percentile kernel (``ops/aggs.py:masked_ordinal_percentiles``) vs a
    numpy groupby (NYC-taxi shape: Zipf keyword + value column, filtered
    mask).
-4. brute-force kNN — ``dist_search.build_knn_step`` einsum at the
-   GloVe-1.2M/d=100/k=100 shape vs numpy matmul+argpartition.
+4. brute-force kNN — ``dist_search.build_knn_step`` blocked streaming
+   einsum (pack-time corpus invariants + running top-k) at the
+   GloVe-1.2M/d=100/k=100 shape vs numpy matmul+argpartition at the SAME
+   batch size; both sides report achieved corpus GB/s.
 5. hybrid BM25 + kNN RRF — plane top-100 + kNN top-100 + reciprocal-rank
    fusion, vs the same pipeline in numpy.
 Plus: the REST **serving** path under 32 concurrent clients through
@@ -391,77 +393,98 @@ def bench_terms_percentiles(rng, on_cpu):
 
 def bench_knn(rng, mesh, on_cpu):
     """Config #4: brute-force kNN at the GloVe shape (1.2M × d=100,
-    k=100) — one einsum on the MXU vs numpy matmul+argpartition."""
-    import jax
-    import jax.numpy as jnp
-    from elasticsearch_tpu.parallel.dist_search import build_knn_step
-    from elasticsearch_tpu.utils.shapes import round_up_pow2
+    k=100) — the ``DistributedKnnPlane`` (pack-time corpus invariants +
+    blocked streaming running-top-k) vs numpy matmul+argpartition. The
+    CPU reference scores the SAME B=16 batches the plane scores (the old
+    4-query slice made vs_baseline
+    apples-to-oranges), and both sides report achieved corpus GB/s
+    (vectors read once per batch)."""
+    from elasticsearch_tpu.parallel.dist_search import DistributedKnnPlane
     n_vec = (1 << 17) if on_cpu else 1_200_000
     dim, k, B = 100, 100, 16
     n_dev = mesh.devices.size
-    n_pad = round_up_pow2(-(-n_vec // n_dev))
-    vecs = rng.randn(n_dev, n_pad, dim).astype(np.float32)
-    exists = np.zeros((n_dev, n_pad), bool)
-    flat_count = 0
+    per = -(-n_vec // n_dev)
+    shard_vecs = []
     for s in range(n_dev):
-        take = min(n_pad, max(0, n_vec - s * n_pad))
-        exists[s, :take] = True
-        flat_count += take
-    step = build_knn_step(mesh, n_pad=n_pad, dim=dim, k=k,
-                          n_shards=n_dev, similarity="cosine")
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from elasticsearch_tpu.parallel.mesh import AXIS_REPLICA, AXIS_SHARD
-    d_vecs = jax.device_put(vecs, NamedSharding(mesh, P(AXIS_SHARD)))
-    d_exists = jax.device_put(exists, NamedSharding(mesh, P(AXIS_SHARD)))
-    q_shard = NamedSharding(mesh, P(AXIS_REPLICA, None))
+        take = min(per, max(0, n_vec - s * per))
+        shard_vecs.append(rng.randn(take, dim).astype(np.float32))
+    # the plane packs vectors WITH their corpus invariants once (cosine
+    # rows unit-normalized at pack time — the old step re-normalized the
+    # corpus on every dispatch) and serves the blocked running-top-k step;
+    # on a CPU backend it serves search_host (the search_eager analogue:
+    # same blocked streaming design, BLAS matmul + threshold-pruned block
+    # selection) while the jitted kernel is timed separately
+    plane = DistributedKnnPlane(mesh, [dict(vectors=v) for v in shard_vecs],
+                                similarity="cosine")
+    host_serving = plane._host_pack is not None
     qs = rng.randn(B, dim).astype(np.float32)
-    vals, idx = step(d_vecs, d_exists, jax.device_put(qs, q_shard))
-    np.asarray(vals)                              # compile + sync
-    # numpy reference (same cosine + top-k) on a 4-query slice
-    flat = vecs.reshape(-1, dim)[
-        exists.reshape(-1)][:n_vec]
+    vals, _hits = plane.serve(qs, k=k)           # compile/warm
+    # numpy reference: same cosine + top-k, same B=16 batch size, corpus
+    # normalized once outside the timed loop (its own pack-time invariant)
+    flat = np.concatenate(shard_vecs)
     fn = flat / np.maximum(
         np.linalg.norm(flat, axis=1, keepdims=True), 1e-12)
+    cpu_iters = 6 if on_cpu else 1
+    cpu_batches = [rng.randn(B, dim).astype(np.float32)
+                   for _ in range(cpu_iters)]
     t0 = time.perf_counter()
-    qn = qs[:4] / np.maximum(
-        np.linalg.norm(qs[:4], axis=1, keepdims=True), 1e-12)
-    sc = qn @ fn.T
-    part = np.argpartition(-sc, k, axis=1)[:, :k]
-    for row, p_row in zip(sc, part):
-        p_row[np.argsort(-row[p_row], kind="stable")]
-    cpu_qps = 4 / (time.perf_counter() - t0)
-    # device cross-check: top-1 score of query 0 matches numpy
-    ref_top = float(np.max(sc[0]))
+    for qb in [qs] + cpu_batches:
+        qn = qb / np.maximum(
+            np.linalg.norm(qb, axis=1, keepdims=True), 1e-12)
+        sc = qn @ fn.T
+        part = np.argpartition(-sc, k, axis=1)[:, :k]
+        for row, p_row in zip(sc, part):
+            p_row[np.argsort(-row[p_row], kind="stable")]
+        if qb is qs:
+            sc_first = sc
+            t0 = time.perf_counter()      # cross-check batch not timed
+    cpu_s = time.perf_counter() - t0
+    cpu_qps = (cpu_iters * B) / cpu_s
+    # cross-check: top-1 score of query 0 matches numpy
+    ref_top = float(np.max(sc_first[0]))
     got_top = float(np.asarray(vals)[0][0])
     if abs(got_top - ref_top) > 0.01 * max(1.0, abs(ref_top)):
         raise SystemExit(f"knn mismatch: {got_top} vs {ref_top}")
-    iters = 8 if on_cpu else 32
+    iters = 16 if on_cpu else 32
     ts = []
     for _ in range(iters):
         qb = rng.randn(B, dim).astype(np.float32)
         t0 = time.perf_counter()
-        vals, idx = step(d_vecs, d_exists, jax.device_put(qb, q_shard))
-        np.asarray(vals)
+        vals, _hits = plane.serve(qb, k=k)
         ts.append(time.perf_counter() - t0)
     ts = np.asarray(ts)
     qps = (iters * B) / ts.sum()
-    return _emit("knn_bruteforce_glove_shape", {
+    kernel_cpu_qps = None
+    if host_serving:
+        plane.search(qs, k=k)                    # compile the jitted step
+        t0 = time.perf_counter()
+        for qb in cpu_batches:
+            plane.search(qb, k=k)
+        kernel_cpu_qps = (cpu_iters * B) / (time.perf_counter() - t0)
+    # achieved bandwidth: the blocked path reads the corpus once per
+    # batch (ROOFLINE.md kNN section) — n_vec·dim·4 bytes per dispatch
+    batch_bytes = n_vec * dim * 4
+    doc = {
         "value": round(qps, 1), "unit": "queries/s",
         "vs_baseline": round(qps / cpu_qps, 2),
         "p99_ms": round(float(np.percentile(ts, 99) * 1e3), 2),
-        "n_vectors": int(flat_count), "dim": dim, "k": k,
-        "cpu_ref_qps": round(cpu_qps, 1)})
+        "n_vectors": int(n_vec), "dim": dim, "k": k,
+        "gb_per_s": round(batch_bytes * iters / ts.sum() / 1e9, 2),
+        "cpu_ref_qps": round(cpu_qps, 1),
+        "cpu_ref_gb_per_s": round(batch_bytes * cpu_iters / cpu_s / 1e9,
+                                  2)}
+    if kernel_cpu_qps is not None:
+        doc["serving_path"] = "host-blocked-topk"
+        doc["kernel_cpu_qps"] = round(kernel_cpu_qps, 1)
+    return _emit("knn_bruteforce_glove_shape", doc)
 
 
 def bench_hybrid_rrf(rng, mesh, on_cpu):
     """Config #5: hybrid BM25 + kNN with reciprocal-rank fusion (window
     100, k=10) — both retrievers on device, fusion on host; vs the same
     two retrievers in numpy."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
     from elasticsearch_tpu.parallel import DistributedSearchPlane
-    from elasticsearch_tpu.parallel.dist_search import build_knn_step
-    from elasticsearch_tpu.parallel.mesh import AXIS_REPLICA, AXIS_SHARD
+    from elasticsearch_tpu.parallel.dist_search import DistributedKnnPlane
     from elasticsearch_tpu.utils.shapes import round_up_pow2
     from elasticsearch_tpu.utils.synth import (split_csr_shards,
                                                synthetic_csr_corpus_fast)
@@ -475,28 +498,35 @@ def bench_hybrid_rrf(rng, mesh, on_cpu):
         s["term_ids"] = corpus["term_ids"]
     plane = DistributedSearchPlane(mesh, shards, field="body")
     n_pad = round_up_pow2(-(-n_hy // n_dev))
-    vecs = rng.randn(n_dev, n_pad, dim).astype(np.float32)
-    exists = np.zeros((n_dev, n_pad), bool)
+    shard_vecs = []
     for s in range(n_dev):
-        exists[s, :min(n_pad, max(0, n_hy - s * n_pad))] = True
-    kstep = build_knn_step(mesh, n_pad=n_pad, dim=dim, k=window,
-                           n_shards=n_dev, similarity="dot_product")
-    d_vecs = jax.device_put(vecs, NamedSharding(mesh, P(AXIS_SHARD)))
-    d_exists = jax.device_put(exists, NamedSharding(mesh, P(AXIS_SHARD)))
-    q_shard = NamedSharding(mesh, P(AXIS_REPLICA, None))
+        take = min(n_pad, max(0, n_hy - s * n_pad))
+        shard_vecs.append(rng.randn(take, dim).astype(np.float32))
+    # vector retriever = the kNN plane (blocked step on device, host
+    # blocked scorer on the CPU fallback — same split as the text plane)
+    kplane = DistributedKnnPlane(
+        mesh, [dict(vectors=v) for v in shard_vecs],
+        similarity="dot_product")
+    vecs_flat = np.concatenate(shard_vecs)
     B = 16
+
+    # CPU serving parity with config #1: the text retriever serves eager
+    # (term-at-a-time over precomputed impacts), the vector retriever the
+    # host blocked scorer; on an accelerator both ride their kernels
+    text_eager = on_cpu and plane._host_csr is not None
 
     def one_batch(qbags, qvecs, timed=True):
         t0 = time.perf_counter()
-        _vals, hits = plane.search(qbags, k=window, Q=N_TERMS,
-                                   L=L_hy, tiered=plane.T_pad > 0)
-        _kvals, kidx = kstep(d_vecs, d_exists,
-                             jax.device_put(qvecs, q_shard))
-        kidx = np.asarray(kidx)
+        if text_eager:
+            _vals, hits = plane.search_eager(qbags, k=window)
+        else:
+            _vals, hits = plane.search(qbags, k=window, Q=N_TERMS,
+                                       L=L_hy, tiered=plane.T_pad > 0)
+        _kvals, khits = kplane.serve(qvecs, k=window)
         fused = []
         for bi in range(len(qbags)):
             text_ranks = [si * n_pad + d for (si, d) in hits[bi]]
-            vec_ranks = [int(g) for g in kidx[bi] if g >= 0]
+            vec_ranks = [si * kplane.n_pad + d for (si, d) in khits[bi]]
             fused.append(_rrf([text_ranks, vec_ranks], k_out))
         return fused, time.perf_counter() - t0
 
@@ -512,7 +542,7 @@ def bench_hybrid_rrf(rng, mesh, on_cpu):
     # numpy reference on 4 queries: same retrievers, same fusion
     t0 = time.perf_counter()
     _times, cpu_hits = cpu_bm25_search(corpus, warm_b[:4], window)
-    flat = vecs.reshape(-1, dim)[exists.reshape(-1)][:n_hy]
+    flat = vecs_flat
     sc = warm_v[:4] @ flat.T
     part = np.argpartition(-sc, window, axis=1)[:, :window]
     cpu_fused = []

@@ -132,6 +132,20 @@ class VectorFieldData:
     matrix_host: np.ndarray                  # float32[N, D]
     exists: np.ndarray                       # bool[N]
     matrix_dev: jnp.ndarray = None           # float32[N_pad, D]
+    # segment-lifetime corpus invariant, built once on first use and
+    # reused by every cosine query against this column (segments are
+    # immutable, so it can never go stale)
+    unit_dev: jnp.ndarray = None             # row-normalized matrix_dev
+
+    def unit_matrix_dev(self) -> jnp.ndarray:
+        """Unit-normalized rows — computed ONCE per segment column, not
+        per query (the old cosine path re-normalized the whole segment on
+        every knn clause / script_score call)."""
+        if self.unit_dev is None:
+            m = self.matrix_dev
+            self.unit_dev = m / jnp.maximum(
+                jnp.linalg.norm(m, axis=-1, keepdims=True), 1e-12)
+        return self.unit_dev
 
 
 # ---------------------------------------------------------------------------

@@ -249,7 +249,9 @@ class IndexService:
         sr = ShardSearcher(
             segments, self.mapper,
             plane_provider=lambda segs, field:
-                self.plane_cache.plane_for(segs, self.mapper, field))
+                self.plane_cache.plane_for(segs, self.mapper, field),
+            knn_plane_provider=lambda segs, field:
+                self.plane_cache.knn_plane_for(segs, self.mapper, field))
         mao = self.settings.get("index.highlight.max_analyzed_offset")
         if mao is not None:
             sr.max_analyzed_offset = int(mao)
@@ -263,7 +265,9 @@ class IndexService:
             [shard.searchable_segments() for shard in self.shards],
             self.mapper,
             plane_provider=lambda segs, field:
-                self.plane_cache.plane_for(segs, self.mapper, field))
+                self.plane_cache.plane_for(segs, self.mapper, field),
+            knn_plane_provider=lambda segs, field:
+                self.plane_cache.knn_plane_for(segs, self.mapper, field))
 
     #: request-cache entry cap per index (reference sizes by bytes —
     #: indices.requests.cache.size 1%; entries are simpler and safe here)

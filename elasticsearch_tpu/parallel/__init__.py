@@ -16,9 +16,12 @@ parallelism axes map onto a ``jax.sharding.Mesh``:
 """
 
 from .mesh import make_search_mesh, search_mesh_axes
-from .dist_search import DistributedSearchPlane, build_bm25_topk_step, build_knn_step
+from .dist_search import (DistributedKnnPlane, DistributedSearchPlane,
+                          build_bm25_topk_step, build_knn_step,
+                          prepare_knn_corpus)
 
 __all__ = [
     "make_search_mesh", "search_mesh_axes",
     "DistributedSearchPlane", "build_bm25_topk_step", "build_knn_step",
+    "DistributedKnnPlane", "prepare_knn_corpus",
 ]

@@ -221,11 +221,13 @@ class DistributedSearcher:
     """Coordinating-node search over one searcher per shard."""
 
     def __init__(self, shard_segment_lists: List[list],
-                 mapper: MapperService, plane_provider=None):
+                 mapper: MapperService, plane_provider=None,
+                 knn_plane_provider=None):
         all_segments = [s for segs in shard_segment_lists for s in segs]
         self._global_ctx = ShardContext(all_segments, mapper)
         self.mapper = mapper
         self.plane_provider = plane_provider
+        self.knn_plane_provider = knn_plane_provider
         self.shards: List[ShardSearcher] = []
         # flattened-filtered segment index -> (shard, shard-local filtered
         # segment): the pooled plane route returns hits in global-segment
@@ -233,7 +235,8 @@ class DistributedSearcher:
         # (shard << _LOCAL_BITS | seg << 32 | doc) encoding
         self._seg_owner: List[Tuple[int, int]] = []
         for shard_idx, segs in enumerate(shard_segment_lists):
-            searcher = ShardSearcher(segs, mapper)
+            searcher = ShardSearcher(segs, mapper,
+                                     knn_plane_provider=knn_plane_provider)
             searcher.ctx = DfsShardContext(searcher.segments, mapper,
                                            self._global_ctx)
             self.shards.append(searcher)
@@ -251,7 +254,9 @@ class DistributedSearcher:
         body = body or {}
         if body.get("rank") and "rrf" in body["rank"]:
             # global-rank fusion: run pooled (see module docstring)
-            pooled = ShardSearcher(self._global_ctx.segments, self.mapper)
+            pooled = ShardSearcher(
+                self._global_ctx.segments, self.mapper,
+                knn_plane_provider=self.knn_plane_provider)
             pooled.ctx = self._global_ctx
             return pooled.search(body)
 

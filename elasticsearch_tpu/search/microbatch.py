@@ -25,6 +25,8 @@ from __future__ import annotations
 import threading
 from typing import List, Optional, Sequence, Tuple
 
+import numpy as np
+
 #: upper bound on queries per dispatch — past this the dispatch itself is
 #: long enough that splitting reduces tail latency
 MAX_BATCH = 64
@@ -108,24 +110,13 @@ class PlaneMicroBatcher:
         k = self._k_bucket(max(s.k for s in batch))
         # pad the batch to a power of two: every distinct traced B shape is
         # a fresh XLA compile — ragged arrival sizes would otherwise
-        # compile dozens of programs (empty bags score as no-op queries,
-        # same as the plane's own replica padding)
+        # compile dozens of programs (padding slots score as no-op
+        # queries, same as the plane's own replica padding)
         b_pad = 1 << max(0, (len(batch) - 1).bit_length())
         queries = [s.terms for s in batch] + \
-            [[] for _ in range(b_pad - len(batch))]
-        # size L to the batch through the plane's 4-rung ladder: ordinary
-        # short-run batches skip the worst-case sparse-merge cost
-        # (pinning L_cap made every dispatch pay it — the difference
-        # between ~10ms and multi-second dispatches on the full corpus),
-        # while the rung count bounds serving-time compiles to at most 4
-        # shapes per (B, Q, k) family
-        L = None
-        if hasattr(self.plane, "max_run_len"):
-            L = self.plane.ladder_L(self.plane.max_run_len(queries))
-        tiered = getattr(self.plane, "T_pad", 0) > 0 or None
+            [self._pad_slot() for _ in range(b_pad - len(batch))]
         try:
-            vals, hits, totals = self.plane.search(
-                queries, k=k, L=L, tiered=tiered, with_totals=True)
+            vals, hits, totals = self._dispatch(queries, k)
         except BaseException as e:          # noqa: BLE001 — fan the error
             for s in batch:                 # out to every query in the batch
                 s.error = e
@@ -146,6 +137,48 @@ class PlaneMicroBatcher:
                 self._leader_active = False
             self._cond.notify_all()
 
+    # -- dispatch hooks (overridden by the kNN batcher) ---------------------
+
+    def _pad_slot(self):
+        """Inert query filling a pow2 padding slot."""
+        return []
+
+    def _dispatch(self, queries, k: int):
+        """One device dispatch over the coalesced batch → (vals, hits,
+        totals) aligned with ``queries``. Runs outside the queue lock."""
+        # size L to the batch through the plane's 4-rung ladder: ordinary
+        # short-run batches skip the worst-case sparse-merge cost
+        # (pinning L_cap made every dispatch pay it — the difference
+        # between ~10ms and multi-second dispatches on the full corpus),
+        # while the rung count bounds serving-time compiles to at most 4
+        # shapes per (B, Q, k) family
+        L = None
+        if hasattr(self.plane, "max_run_len"):
+            L = self.plane.ladder_L(self.plane.max_run_len(queries))
+        tiered = getattr(self.plane, "T_pad", 0) > 0 or None
+        return self.plane.search(queries, k=k, L=L, tiered=tiered,
+                                 with_totals=True)
+
+
+class KnnPlaneMicroBatcher(PlaneMicroBatcher):
+    """Micro-batcher over a ``DistributedKnnPlane``: concurrent REST kNN
+    requests coalesce their query_vector batches into ONE blocked einsum
+    dispatch, exactly like lexical queries coalesce through the text
+    plane — the corpus streams through the MXU once per batch regardless
+    of how many requests share it. Slots carry query vectors instead of
+    term bags; there is no totals concept (kNN always matches its k)."""
+
+    def _pad_slot(self):
+        # zero vector: scores 0.0 everywhere (or -‖v‖² under l2), results
+        # discarded with the slot
+        return np.zeros(max(self.plane.dim, 1), np.float32)
+
+    def _dispatch(self, queries, k: int):
+        # plane.serve picks the backend-appropriate path (numpy blocked
+        # scorer on CPU — the search_eager analogue — jitted step on TPU)
+        vals, hits = self.plane.serve(np.stack(queries), k=k)
+        return vals, hits, [None] * len(queries)
+
 
 def batched_search(plane, terms: Sequence[str], k: int):
     """Module entry: route one query through the plane's micro-batcher
@@ -158,6 +191,21 @@ def batched_search(plane, terms: Sequence[str], k: int):
                 batcher = PlaneMicroBatcher(plane)
                 plane._microbatcher = batcher
     return batcher.search(terms, k)
+
+
+def batched_knn_search(plane, query_vector, k: int):
+    """Route one kNN query through the knn plane's micro-batcher.
+    Returns (raw_scores[k'], hits [(shard, doc), ...])."""
+    batcher = getattr(plane, "_microbatcher", None)
+    if batcher is None:
+        with _CREATE_LOCK:
+            batcher = getattr(plane, "_microbatcher", None)
+            if batcher is None:
+                batcher = KnnPlaneMicroBatcher(plane)
+                plane._microbatcher = batcher
+    vals, hits, _total = batcher.search(
+        np.asarray(query_vector, np.float32), k)
+    return vals, hits
 
 
 _CREATE_LOCK = threading.Lock()

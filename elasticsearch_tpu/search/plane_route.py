@@ -157,7 +157,21 @@ class ServingPlaneCache:
         self._mesh_factory = mesh_factory
         self._mesh = None
         self._planes: Dict[str, Tuple[tuple, object]] = {}
+        # kNN planes key on (field, segment signature): the distributed
+        # searcher probes one plane per index shard (distinct segment
+        # lists), and field-only keying would rebuild on every alternating
+        # probe. LRU-capped; evicted planes release their breaker bytes.
+        from collections import OrderedDict
+        self._knn_planes: "OrderedDict[tuple, object]" = OrderedDict()
+        #: consecutive plane builds without a cache hit — when more
+        #: distinct (field, sig) combinations are in flight than the
+        #: cache holds, packing a corpus per probe would thrash; the
+        #: route bows out to the per-segment path instead
+        self._knn_build_streak = 0
         self.min_docs = min_docs
+
+    #: max cached kNN planes (each is one packed f32 corpus copy)
+    KNN_PLANE_CACHE_MAX = 32
 
     def _get_mesh(self):
         if self._mesh is None:
@@ -254,6 +268,122 @@ class ServingPlaneCache:
         self._planes[field] = (sig, plane)
         return plane
 
+    @staticmethod
+    def _knn_signature(segments: Sequence[Segment],
+                       field: str) -> Optional[tuple]:
+        """Cache key for the kNN plane; None → route ineligible (deletes,
+        nested docs, or the field has no vectors anywhere — the plane
+        packs exists-masked rows but per-doc liveness/parent masks stay on
+        the per-segment path)."""
+        sig = []
+        any_field = False
+        for s in segments:
+            if s.has_nested or not bool(s.live.all()):
+                return None
+            if field in s.vector_fields:
+                any_field = True
+            sig.append((s.seg_id, s.n_docs))
+        return tuple(sig) if any_field else None
+
+    def knn_plane_for(self, segments: Sequence[Segment],
+                      mapper: MapperService, field: str):
+        """The kNN serving plane (``DistributedKnnPlane`` — pack-time
+        corpus invariants + blocked running-top-k) for this segment list,
+        or None when the route is ineligible. One SEGMENT per plane shard,
+        same as the lexical plane, so tie order matches the per-segment
+        path."""
+        from ..index.mapping import DenseVectorFieldType
+        segments = [s for s in segments if s.n_docs > 0]
+        if not segments:
+            return None
+        ft = mapper.field_type(field)
+        if not isinstance(ft, DenseVectorFieldType):
+            return None
+        sig = self._knn_signature(segments, field)
+        if sig is None:
+            return None
+        key = (field, sig)
+        cached = self._knn_planes.get(key)
+        if cached is not None:
+            self._knn_planes.move_to_end(key)
+            self._knn_build_streak = 0
+            return cached
+        if self._knn_build_streak >= self.KNN_PLANE_CACHE_MAX:
+            # every recent probe missed: building would evict entries the
+            # same request needs again (O(corpus) repack per query) — the
+            # per-segment fallback is the cheaper correct path
+            return None
+        from ..parallel.dist_search import DistributedKnnPlane
+        # step similarity: ranking by raw dot is order-equivalent for
+        # max_inner_product (its _score transform is monotone); unknown
+        # similarity strings keep the per-segment path's quirks
+        similarity = {"cosine": "cosine", "dot_product": "dot_product",
+                      "l2_norm": "l2_norm",
+                      "max_inner_product": "dot_product"}.get(
+                          getattr(ft, "similarity", "cosine"))
+        if similarity is None:
+            return None
+        shards = []
+        for seg in segments:
+            f = seg.vector_fields.get(field)
+            if f is None:
+                shards.append(dict(
+                    vectors=np.zeros((seg.n_docs, 1), np.float32),
+                    exists=np.zeros(seg.n_docs, bool)))
+            else:
+                ex = np.zeros(seg.n_docs, bool)
+                ex[: f.exists.shape[0]] = f.exists
+                shards.append(dict(vectors=f.matrix_host, exists=ex))
+        dims = {s["vectors"].shape[1] for s in shards if s["exists"].any()}
+        if len(dims) > 1:
+            return None
+        dim = dims.pop() if dims else 1
+        for s in shards:
+            if not s["exists"].any():
+                s["vectors"] = np.zeros((s["exists"].shape[0], dim),
+                                        np.float32)
+        # the packed corpus (f32[S, n_pad, dim] + invariants) is the big
+        # persistent allocation: reserve it against the accounting breaker
+        # before building, like the lexical plane's dense tier
+        from ..common.breakers import DEFAULT as _breakers
+        from ..utils.shapes import round_up_pow2
+        acct = _breakers.breaker("accounting")
+        n_pad = round_up_pow2(max(max(s["exists"].shape[0]
+                                      for s in shards), 1))
+        nbytes = len(shards) * n_pad * (dim * 4 + 5)
+        # make room BEFORE reserving: drop superseded generations of this
+        # field (a refresh/merge kept part of the segment list, so the
+        # old signature shares seg_ids with the new one — planes for
+        # OTHER shards of the same field are disjoint and survive) and
+        # any LRU overflow
+        new_ids = {sid for sid, _ in sig}
+        for old_key in [ok for ok in self._knn_planes
+                        if ok[0] == field and ok[1] != sig
+                        and any(sid in new_ids for sid, _ in ok[1])]:
+            acct.release(getattr(self._knn_planes.pop(old_key),
+                                 "_acct_bytes", 0))
+        while len(self._knn_planes) >= self.KNN_PLANE_CACHE_MAX:
+            _, old = self._knn_planes.popitem(last=False)
+            acct.release(getattr(old, "_acct_bytes", 0))
+        acct.add_estimate(nbytes, f"<knn serving plane [{field}]>")
+        try:
+            plane = DistributedKnnPlane(self._get_mesh(), shards,
+                                        similarity=similarity)
+        except Exception:
+            acct.release(nbytes)
+            raise
+        plane._acct_bytes = nbytes
+        raced = self._knn_planes.get(key)
+        if raced is not None:
+            # another thread built the same plane meanwhile: keep the
+            # winner, release this copy's reservation
+            acct.release(nbytes)
+            self._knn_planes.move_to_end(key)
+            return raced
+        self._knn_planes[key] = plane
+        self._knn_build_streak += 1
+        return plane
+
     def release(self) -> None:
         """Release every plane's breaker reservation (the owning index is
         closing or being deleted)."""
@@ -261,4 +391,7 @@ class ServingPlaneCache:
         acct = _breakers.breaker("accounting")
         for _sig, plane in self._planes.values():
             acct.release(getattr(plane, "_acct_bytes", 0))
+        for plane in self._knn_planes.values():
+            acct.release(getattr(plane, "_acct_bytes", 0))
         self._planes.clear()
+        self._knn_planes.clear()

@@ -1168,15 +1168,19 @@ def _vector_similarity(kind: str, qv: np.ndarray, seg: Segment,
     exists[: f.exists.shape[0]] = f.exists
     exists_dev = jnp.asarray(exists)
     if kind == "cosineSimilarity":
+        # corpus rows are a segment invariant: normalized once when the
+        # column is first used (VectorFieldData.unit_matrix_dev), only the
+        # query side is normalized per call
         qn = q / jnp.maximum(jnp.linalg.norm(q), 1e-12)
-        mn = mat / jnp.maximum(
-            jnp.linalg.norm(mat, axis=-1, keepdims=True), 1e-12)
-        sim = mn @ qn
+        sim = f.unit_matrix_dev() @ qn
     elif kind == "dotProduct":
         sim = mat @ q
     elif kind == "l1norm":
         sim = jnp.sum(jnp.abs(mat - q[None, :]), axis=-1)
     else:  # l2norm
+        # direct subtraction, NOT the expanded ‖v‖²-2v·q+‖q‖² form: the
+        # expansion loses the distance to f32 cancellation for
+        # near-duplicate vectors, and script distances are user-facing
         sim = jnp.linalg.norm(mat - q[None, :], axis=-1)
     return jnp.where(exists_dev, sim, 0.0), exists_dev
 

@@ -126,15 +126,31 @@ class ShardSearcher:
     (fetch, error shapes, pagination) is shared."""
 
     def __init__(self, segments: List[Segment], mapper: MapperService,
-                 plane_provider=None):
+                 plane_provider=None, knn_plane_provider=None):
         self.segments = [s for s in segments if s.n_docs > 0]
         self.mapper = mapper
         self.ctx = ShardContext(self.segments, mapper)
         self.plane_provider = plane_provider
+        #: optional ``(segments, field) -> DistributedKnnPlane | None``
+        #: hook: eligible knn clauses run through the blocked device plane
+        #: (pack-time invariants + streaming top-k) with query_vector
+        #: micro-batching across concurrent requests
+        self.knn_plane_provider = knn_plane_provider
 
     # ------------------------------------------------------------------
     # knn
     # ------------------------------------------------------------------
+
+    @staticmethod
+    def _knn_score_from_raw(similarity: str, raw: float) -> float:
+        """Plane raw similarity → ES _score (host-side scalar form of
+        :func:`_knn_score_transform`; the plane's l2 raw is ``-‖q-v‖²``,
+        clamped at 0 for float cancellation)."""
+        if similarity in ("cosine", "cos", "dot_product"):
+            return (1.0 + raw) / 2.0
+        if similarity == "max_inner_product":
+            return 1.0 / (1.0 - raw) if raw < 0 else raw + 1.0
+        return 1.0 / (1.0 + max(0.0, -raw))        # l2_norm
 
     def _knn_candidates(self, spec: dict) -> List[Tuple[float, int, int]]:
         """Brute-force kNN for one knn clause: einsum per segment + top-k
@@ -160,6 +176,27 @@ class ShardSearcher:
         filt = spec.get("filter")
         filter_q = parse_query(filt) if filt else None
         qv = np.asarray(qv, np.float32)
+
+        # --- knn plane route (the production vector kernel) ---------------
+        # Filter-free clauses over clean segments (no deletes / nested)
+        # run through the DistributedKnnPlane: corpus invariants packed
+        # once, blocked streaming top-k, and concurrent requests coalesce
+        # their query_vector batches into one dispatch (microbatch.py).
+        if (self.knn_plane_provider is not None and filter_q is None
+                and num_candidates >= k):
+            plane = self.knn_plane_provider(self.segments, field)
+            if plane is not None:
+                from .microbatch import batched_knn_search
+                raw, phits = batched_knn_search(plane, qv,
+                                                k=num_candidates)
+                cands = [
+                    (self._knn_score_from_raw(ft.similarity, float(v))
+                     * boost, si, d)
+                    for v, (si, d) in zip(raw, phits)]
+                # monotone transforms preserve the plane's (score desc,
+                # shard asc, doc asc) order; re-sort for boost safety
+                cands.sort(key=lambda c: (-c[0], c[1], c[2]))
+                return cands[:k]
 
         pending = []
         for seg_idx, seg in enumerate(self.segments):

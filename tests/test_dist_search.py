@@ -11,7 +11,8 @@ import jax
 from elasticsearch_tpu.index.mapping import MapperService
 from elasticsearch_tpu.index.segment import SegmentBuilder
 from elasticsearch_tpu.parallel import (
-    DistributedSearchPlane, build_knn_step, make_search_mesh)
+    DistributedSearchPlane, build_knn_step, make_search_mesh,
+    prepare_knn_corpus)
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 K1, B = 1.2, 0.75
@@ -123,8 +124,10 @@ def test_dist_knn_matches_bruteforce():
     queries = rng.randn(4, dim).astype(np.float32)
 
     step = build_knn_step(mesh, n_pad=n_per, dim=dim, k=k, n_shards=n_shards)
+    _pv, vnorm2 = prepare_knn_corpus(vecs, "dot_product")
     vals, gdocs = step(
         jax.device_put(vecs, NamedSharding(mesh, P("shard", None, None))),
+        jax.device_put(vnorm2, NamedSharding(mesh, P("shard", None))),
         jax.device_put(exists, NamedSharding(mesh, P("shard", None))),
         jax.device_put(queries, NamedSharding(mesh, P("replica", None))))
     vals, gdocs = np.asarray(vals), np.asarray(gdocs)
