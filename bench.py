@@ -566,9 +566,17 @@ def bench_hybrid_rrf(rng, mesh, on_cpu):
 
 def bench_serving(rng):
     """REST serving under concurrency: 32 client threads through
-    ``RestAPI.handle`` → plane route → micro-batching queue. Serving p99
-    is a different quantity from kernel QPS (per-request wall time incl.
-    parse, routing, fetch) and is reported separately."""
+    ``RestAPI.handle`` → dispatcher-thread micro-batching queue. The
+    headline window bypasses the plane request cache
+    (``request_cache=false``) so it measures the DISPATCH pipeline —
+    apples-to-apples with r05, which had no plane cache — and a second
+    cache-enabled window reports the cached path (qps + hit/miss)
+    separately. Serving p99 is a different quantity from kernel QPS
+    (per-request wall time incl. parse, routing, fetch); per-stage
+    (queue/prep/dispatch/fetch) p50/p99 come from the batcher's
+    per-request samples, plus warm vs cold first-request latency and the
+    warmup shape-lattice cost, so future PRs ratchet on stage numbers
+    instead of one aggregate p99."""
     import tempfile
     import threading
     from elasticsearch_tpu.node.indices_service import IndicesService
@@ -582,8 +590,12 @@ def bench_serving(rng):
         lines.append(json.dumps({"body": body}))
     api.handle("POST", "/srv/_bulk", "refresh=true",
                ("\n".join(lines) + "\n").encode())
+    # cold first request: plane build + first dispatch land here (what a
+    # node's very first query pays)
+    t0 = time.perf_counter()
     api.handle("POST", "/srv/_search", "",
                json.dumps({"query": {"match": {"body": "w3"}}}).encode())
+    cold_first_ms = (time.perf_counter() - t0) * 1e3
     n_clients, per_client = 32, 8
 
     # warm the micro-batch compile shapes (pow2 B buckets) with one
@@ -600,53 +612,90 @@ def bench_serving(rng):
         t.start()
     for t in warmers:
         t.join()
-    lat, errs = [], []
+    # warm first request through the DISPATCH path (request_cache=false
+    # so the cache can't answer it): cold vs warm is the compile tax
+    t0 = time.perf_counter()
+    api.handle("POST", "/srv/_search", "request_cache=false",
+               json.dumps({"query": {"match": {"body": "w3"}}}).encode())
+    warm_first_ms = (time.perf_counter() - t0) * 1e3
+
+    svc = api.indices.get("srv")
+
+    def _batchers():
+        out = []
+        for _f, (_sig, plane) in getattr(svc.plane_cache, "_planes",
+                                         {}).items():
+            b = getattr(plane, "_microbatcher", None)
+            if b is not None:
+                out.append(b)
+        return out
+
+    # snapshot so stage percentiles cover the timed window only
+    # (warm-round compiles would pollute the p99)
+    skip_n = {id(b): len(b.stage_samples["queue"]) for b in _batchers()}
     lock = threading.Lock()
 
-    def client(tid):
-        try:
-            for j in range(per_client):
-                q = {"query": {"match": {
-                    "body": vocab[(tid * per_client + j) % 64]}}}
-                t0 = time.perf_counter()
-                st, _ct, payload = api.handle(
-                    "POST", "/srv/_search", "", json.dumps(q).encode())
-                dt = time.perf_counter() - t0
-                doc = json.loads(payload)
-                assert st == 200 and doc["hits"]["total"]["value"] > 0
-                with lock:
-                    lat.append(dt)
-        except Exception as e:                     # noqa: BLE001
-            with lock:
-                errs.append(repr(e))
+    def run_window(params: str, per: int):
+        lat, errs = [], []
 
-    t0 = time.perf_counter()
-    threads = [threading.Thread(target=client, args=(t,))
-               for t in range(n_clients)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
-    if errs:
-        raise SystemExit(f"serving bench errors: {errs[:3]}")
-    lat_a = np.asarray(lat)
-    svc = api.indices.get("srv")
-    planes = getattr(svc.plane_cache, "_planes", {})
-    batch_stats = {}
-    for _f, (_sig, plane) in planes.items():
-        b = getattr(plane, "_microbatcher", None)
-        if b is not None:
-            batch_stats = {
-                "dispatches": b.n_dispatches, "queries": b.n_queries,
-                "max_batch": b.max_seen_batch,
-                "mean_batch": round(b.n_queries / max(b.n_dispatches, 1),
-                                    2)}
+        def client(tid):
+            try:
+                for j in range(per):
+                    q = {"query": {"match": {
+                        "body": vocab[(tid * per + j) % 64]}}}
+                    t0 = time.perf_counter()
+                    st, _ct, payload = api.handle(
+                        "POST", "/srv/_search", params,
+                        json.dumps(q).encode())
+                    dt = time.perf_counter() - t0
+                    doc = json.loads(payload)
+                    assert st == 200 and doc["hits"]["total"]["value"] > 0
+                    with lock:
+                        lat.append(dt)
+            except Exception as e:                 # noqa: BLE001
+                with lock:
+                    errs.append(repr(e))
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errs:
+            raise SystemExit(f"serving bench errors: {errs[:3]}")
+        a = np.asarray(lat)
+        return {"value": round(len(a) / wall, 1), "unit": "requests/s",
+                "p50_ms": round(float(np.percentile(a, 50) * 1e3), 2),
+                "p99_ms": round(float(np.percentile(a, 99) * 1e3), 2),
+                "n_requests": int(len(a))}
+
+    # headline: every request rides the dispatch pipeline (cache
+    # bypassed — r05's number had no plane cache to compare against)
+    dispatch_win = run_window("request_cache=false", per_client)
+    batch_stats, stage_pcts = {}, {}
+    for b in _batchers():
+        doc = b.stats_doc()
+        doc["mean_batch"] = round(doc["queries"] / max(doc["dispatches"],
+                                                       1), 2)
+        batch_stats = doc
+        stage_pcts = b.stage_percentiles(skip=skip_n.get(id(b), 0))
+    # cached path: identical plane-eligible bodies served from the shard
+    # request cache before the batcher
+    cache0 = dict(svc.plane_cache_stats)
+    cached_win = run_window("", per_client)
+    cached_win["hit_count"] = \
+        svc.plane_cache_stats["hit_count"] - cache0["hit_count"]
+    cached_win["miss_count"] = \
+        svc.plane_cache_stats["miss_count"] - cache0["miss_count"]
     return _emit("rest_serving_32_clients", {
-        "value": round(len(lat_a) / wall, 1), "unit": "requests/s",
-        "p50_ms": round(float(np.percentile(lat_a, 50) * 1e3), 2),
-        "p99_ms": round(float(np.percentile(lat_a, 99) * 1e3), 2),
-        "n_requests": int(len(lat_a)), "n_clients": n_clients,
+        **dispatch_win, "n_clients": n_clients,
+        "cold_first_request_ms": round(cold_first_ms, 2),
+        "warm_first_request_ms": round(warm_first_ms, 2),
+        "stages": stage_pcts,
+        "cached": cached_win,
         "microbatch": batch_stats})
 
 

@@ -125,6 +125,13 @@ class IndexService:
         from collections import OrderedDict
         self.request_cache: "OrderedDict" = OrderedDict()
         self.request_cache_stats = {"hit_count": 0, "miss_count": 0}
+        # plane-served slice of the request cache (identical plane-eligible
+        # bodies served before the micro-batcher) — counted separately so
+        # the serving bench can attribute hits to this path
+        self.plane_cache_stats = {"hit_count": 0, "miss_count": 0}
+        # the plane path puts the concurrent serving hot path through this
+        # cache: get's move_to_end racing put's eviction would KeyError
+        self._cache_lock = threading.Lock()
         #: search/indexing slow-log ring (reference: SearchSlowLog.java /
         #: IndexingSlowLog.java write per-index log files; entries also
         #: persist to <index>/_index_*_slowlog.log)
@@ -314,18 +321,61 @@ class IndexService:
                     for seg in sh.searchable_segments())
         return (sig, blob)
 
+    def _plane_cache_key(self, body: dict,
+                         explicit: Optional[bool]) -> Optional[tuple]:
+        """Request-cache key for PLANE-ELIGIBLE bodies (size>0): a pure
+        bag-of-terms query with no feature sections is a deterministic
+        read of the segment state, so identical bodies can be served from
+        the cache before they ever reach the micro-batcher. The usual
+        size==0-only rule exists because the coordinator mutates hit
+        objects in place (sort-cursor lifting, boosts) — the plane path
+        instead caches a pristine copy and hands out per-hit copies
+        (:func:`_copy_shard_result`), keeping cached hits immutable."""
+        if explicit is False:
+            return None
+        if str(self.settings.get("index.requests.cache.enable", "true")
+               ).lower() == "false":
+            return None
+        if not isinstance(body, dict) or not body.get("query"):
+            return None
+        # cursor/threshold kwargs keep per-request semantics out of the
+        # cache (mirrors the plane route's own kwargs checks); scripted
+        # fetch sections may be nondeterministic. No "now"-substring
+        # guard like the size==0 cache: bag-of-terms queries cannot carry
+        # date math, and a substring check would silently disable caching
+        # for any body containing those letters ("snow", "know", ...).
+        if body.get("search_after") is not None or \
+                body.get("min_score") is not None or \
+                body.get("script_fields") or body.get("runtime_mappings"):
+            return None
+        from ..search.plane_route import body_eligible, extract_bag_of_terms
+        if not body_eligible(body):
+            return None
+        if extract_bag_of_terms(body["query"], self.mapper) is None:
+            return None
+        try:
+            blob = json.dumps(body, sort_keys=True)
+        except (TypeError, ValueError):
+            return None
+        sig = tuple((seg.seg_id, seg.n_docs, int(seg.live.sum()))
+                    for sh in self.shards
+                    for seg in sh.searchable_segments())
+        return (sig, "plane", blob)
+
     def cache_get(self, key):
-        hit = self.request_cache.get(key)
-        if hit is not None:
-            self.request_cache.move_to_end(key)
-            self.request_cache_stats["hit_count"] += 1
-        return hit
+        with self._cache_lock:
+            hit = self.request_cache.get(key)
+            if hit is not None:
+                self.request_cache.move_to_end(key)
+                self.request_cache_stats["hit_count"] += 1
+            return hit
 
     def cache_put(self, key, result) -> None:
-        self.request_cache_stats["miss_count"] += 1
-        self.request_cache[key] = result
-        while len(self.request_cache) > self.REQUEST_CACHE_MAX:
-            self.request_cache.popitem(last=False)
+        with self._cache_lock:
+            self.request_cache_stats["miss_count"] += 1
+            self.request_cache[key] = result
+            while len(self.request_cache) > self.REQUEST_CACHE_MAX:
+                self.request_cache.popitem(last=False)
 
     #: slow-log ring size per index (entries also append to the on-disk
     #: log file, the reference's actual surface)
@@ -347,7 +397,7 @@ class IndexService:
             return None
 
     def _slowlog_record(self, kind: str, took_s: float,
-                        detail: str) -> None:
+                        detail: str, stages: Optional[dict] = None) -> None:
         worst = None
         for level in ("warn", "info", "debug", "trace"):
             thr = self._slowlog_threshold(kind, level)
@@ -359,6 +409,11 @@ class IndexService:
         entry = {"level": worst, "took_ms": round(took_s * 1e3, 3),
                  "index": self.name, "kind": kind, "source": detail,
                  "timestamp": time.time()}
+        if stages:
+            # plane-served queries: which pipeline stage ate the time
+            # (queue wait / host prep / device dispatch / fetch)
+            entry["serving_stages"] = {
+                s: round(ms, 3) for s, ms in stages.items()}
         self.slow_log.append(entry)
         del self.slow_log[: -self.SLOWLOG_MAX]
         try:
@@ -382,18 +437,35 @@ class IndexService:
                                      str(body or {})[:1000])
                 return r
         key = self._request_cache_key(body or {}, request_cache)
+        plane_key = None
         if key is not None:
             hit = self.cache_get(key)
             if hit is not None:
                 return hit
+        else:
+            # plane-served path: identical plane-eligible bodies hit the
+            # shard request cache BEFORE the micro-batcher (cached hits
+            # stay pristine — copies in, copies out)
+            plane_key = self._plane_cache_key(body or {}, request_cache)
+            if plane_key is not None:
+                hit = self.cache_get(plane_key)
+                if hit is not None:
+                    with self._cache_lock:
+                        self.plane_cache_stats["hit_count"] += 1
+                    return _copy_shard_result(hit)
         if self.num_shards > 1:
             r = self.dist_searcher().search(body or {})
         else:
             r = self.searcher().search(body or {})
         if key is not None:
             self.cache_put(key, r)
+        elif plane_key is not None:
+            with self._cache_lock:
+                self.plane_cache_stats["miss_count"] += 1
+            self.cache_put(plane_key, _copy_shard_result(r))
         self._slowlog_record("query", time.perf_counter() - t0,
-                             str(body or {})[:1000])
+                             str(body or {})[:1000],
+                             stages=getattr(r, "serving_stages", None))
         return r
 
     def count(self, body: Optional[dict] = None) -> int:
@@ -489,6 +561,31 @@ class IndexService:
                         f.vals_host.nbytes + f.docs_host.nbytes)
         return fd, comp
 
+    def plane_serving_stats(self) -> dict:
+        """Micro-batcher serving stats aggregated over this index's
+        planes (lexical + kNN), plus the plane-path cache counters — the
+        ``plane_serving`` nodes-stats section."""
+        from ..search.microbatch import empty_serving_stats
+        out = empty_serving_stats()
+        batchers = []
+        for _sig, plane in list(getattr(self.plane_cache, "_planes",
+                                        {}).values()):
+            b = getattr(plane, "_microbatcher", None)
+            if b is not None:
+                batchers.append(b)
+        for plane in list(getattr(self.plane_cache, "_knn_planes",
+                                  {}).values()):
+            b = getattr(plane, "_microbatcher", None)
+            if b is not None:
+                batchers.append(b)
+        for b in batchers:
+            doc = b.stats_doc()
+            for k, v in doc.items():
+                out[k] = max(out[k], v) if k == "max_batch" else out[k] + v
+        out["cache_hit_count"] = self.plane_cache_stats["hit_count"]
+        out["cache_miss_count"] = self.plane_cache_stats["miss_count"]
+        return out
+
     def stats(self, with_field_bytes: bool = True) -> dict:
         """``with_field_bytes=False`` skips the per-field column-footprint
         walk (O(vocabulary)) for callers that only need counts (cat,
@@ -513,7 +610,10 @@ class IndexService:
         fd, comp = self.field_bytes() if with_field_bytes else ({}, {})
         ss = self.search_stats
         out = empty_index_stats()
+        # request_cache_stats already count the plane-path entries (they
+        # share cache_get/cache_put); plane_serving breaks them out
         out["request_cache"].update(self.request_cache_stats)
+        out["plane_serving"].update(self.plane_serving_stats())
         out["docs"].update(count=docs, deleted=deleted)
         out["store"].update(size_in_bytes=store,
                             total_data_set_size_in_bytes=store)
@@ -678,6 +778,25 @@ class IndicesService:
             svc.close()
 
 
+def _copy_shard_result(r: ShardSearchResult) -> ShardSearchResult:
+    """Defensive copy for plane-path cache entries: the coordinator
+    mutates hit objects in place (score boosts, sort-cursor lifting), so
+    both the stored entry and every served hit get fresh ShardHit shells
+    (sources/highlights are shared read-only payloads)."""
+    import copy
+    hits = []
+    for h in r.hits:
+        h2 = copy.copy(h)
+        if h2.sort_values is not None:
+            h2.sort_values = list(h2.sort_values)
+        if h2.fields is not None:
+            h2.fields = dict(h2.fields)
+        hits.append(h2)
+    r2 = copy.copy(r)
+    r2.hits = hits
+    return r2
+
+
 def _flatten_settings(settings: dict, prefix: str = "") -> Dict[str, Any]:
     """{"index": {"number_of_shards": 2}} → {"index.number_of_shards": 2}."""
     out: Dict[str, Any] = {}
@@ -722,6 +841,8 @@ def empty_index_stats() -> Dict[str, Any]:
     reference's CommonStats serialization; IndexService.stats() fills in
     the live numbers and nodes-level rollups start from this so every
     section exists even with zero indices."""
+    from ..search.microbatch import \
+        empty_serving_stats as _empty_serving_stats
     zero_cache = {"memory_size_in_bytes": 0, "evictions": 0,
                   "hit_count": 0, "miss_count": 0}
     return {
@@ -769,6 +890,10 @@ def empty_index_stats() -> Dict[str, Any]:
                      "uncommitted_size_in_bytes": 0,
                      "earliest_last_modified_age": 0},
         "request_cache": dict(zero_cache),
+        # serving-pipeline observability (search/microbatch.py): per-stage
+        # time totals + dispatch/coalescing counters + plane-path cache
+        "plane_serving": dict(_empty_serving_stats(),
+                              cache_hit_count=0, cache_miss_count=0),
         "recovery": {"current_as_source": 0, "current_as_target": 0,
                      "throttle_time_in_millis": 0},
         "bulk": {"total_operations": 0, "total_time_in_millis": 0,

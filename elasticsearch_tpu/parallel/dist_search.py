@@ -25,6 +25,8 @@ reference's ``ScoreDoc`` shard-index tie-break.
 from __future__ import annotations
 
 import functools
+import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -509,6 +511,8 @@ class DistributedSearchPlane:
             self.dense_dev = jax.device_put(
                 dense, NamedSharding(mesh, P(AXIS_SHARD, None, None, None)))
         self._steps: Dict[Tuple, callable] = {}
+        # dispatcher threads + the warmup thread build steps concurrently
+        self._steps_lock = threading.Lock()
 
     @classmethod
     def from_segments(cls, mesh: Mesh, segments: Sequence, field: str, **kw):
@@ -592,16 +596,19 @@ class DistributedSearchPlane:
                     out = max(out, ln)
         return out
 
+    def ladder_rungs(self) -> List[int]:
+        """The fixed 4-step geometric L ladder (L_cap, L_cap/8, L_cap/64,
+        L_cap/512 floored at 1024) — the serving compile-shape lattice's
+        L axis (:meth:`ladder_L` picks from these; warmup pre-compiles
+        them)."""
+        return sorted({max(1024, self.L_cap >> s) for s in (9, 6, 3, 0)})
+
     def ladder_L(self, needed: int) -> int:
-        """Smallest rung of a fixed 4-step geometric ladder ≥ needed
-        (L_cap, L_cap/8, L_cap/64, L_cap/512 floored at 1024).  Serving
-        uses this instead of raw pow2 buckets: at most 4 sparse-merge
-        compile shapes per (B, Q, k) family instead of ~log2(L_cap),
-        while ordinary short-run batches still skip the worst-case
-        merge cost."""
-        rungs = sorted({max(1024, self.L_cap >> s)
-                        for s in (9, 6, 3, 0)})
-        for r in rungs:
+        """Smallest ladder rung ≥ needed.  Serving uses this instead of
+        raw pow2 buckets: at most 4 sparse-merge compile shapes per
+        (B, Q, k) family instead of ~log2(L_cap), while ordinary
+        short-run batches still skip the worst-case merge cost."""
+        for r in self.ladder_rungs():
             if needed <= r:
                 return r
         return self.L_cap
@@ -647,9 +654,35 @@ class DistributedSearchPlane:
                       idfw[bi_ix, qi_ix])
         return U, u_ids, rid_out, dense_w, W
 
+    #: serving Q floor: dispatches through :meth:`serve` never trace a Q
+    #: below this, collapsing the Q shape axis (1..8-unique-term queries
+    #: all share one compile) at negligible host-assembly cost
+    SERVING_Q_MIN = 8
+
+    def serve(self, queries: Sequence[Sequence[str]], k: int = 10,
+              *, with_totals: bool = False,
+              stages: Optional[dict] = None):
+        """Serving entry (the micro-batcher's dispatch hook): the
+        CPU-native eager scorer when this plane was built on a CPU
+        backend — term-at-a-time over precomputed impacts compiles
+        nothing and beats XLA:CPU — else the jitted step at the stable
+        serving shapes: ladder-rung L, Q floored to SERVING_Q_MIN, so
+        live traffic only ever hits the pre-warmed (B, Q, L, k)
+        lattice."""
+        if self._host_csr is not None:
+            return self.search_eager(queries, k=k,
+                                     with_totals=with_totals, stages=stages)
+        L = self.ladder_L(self.max_run_len(queries))
+        needed_q = max(max((len(set(q)) for q in queries), default=1), 1)
+        Q = max(self.SERVING_Q_MIN, round_up_pow2(needed_q))
+        return self.search(queries, k=k, Q=Q, L=L,
+                           tiered=self.T_pad > 0 or None,
+                           with_totals=with_totals, stages=stages)
+
     def search(self, queries: Sequence[Sequence[str]], k: int = 10,
                *, Q: Optional[int] = None, L: Optional[int] = None,
-               tiered: Optional[bool] = None, with_totals: bool = False):
+               tiered: Optional[bool] = None, with_totals: bool = False,
+               stages: Optional[dict] = None):
         """Run a batch of bag-of-terms queries. Returns
         (scores f32[B, k], hits list[list[(shard, local_doc)]]) — plus
         exact per-query match counts (list[int], the device-side
@@ -659,7 +692,12 @@ class DistributedSearchPlane:
         touches a dense-tier term; True forces the tiered kernel whenever a
         dense tier exists (stable compile shapes for latency benchmarking —
         an all-sparse batch then just scores an empty dense weight matrix).
+
+        ``stages``: optional dict receiving per-stage ms timings
+        (``prep_ms`` host assembly + upload, ``dispatch_ms`` device step
+        incl. any compile, ``fetch_ms`` result sync + decode).
         """
+        t0 = time.perf_counter()
         B = len(queries)
         # pad the batch to a replica-axis multiple (the mesh partitions the
         # batch dim over replicas); padded slots run a no-op query
@@ -697,7 +735,7 @@ class DistributedSearchPlane:
             step = self._get_step(Q, L, k, tiered=True,
                                   with_count=with_totals, U=U)
             shard2 = NamedSharding(self.mesh, P(AXIS_SHARD, None))
-            out = step(
+            step_args = (
                 self.docs_dev, self.impacts_dev, self.dense_dev,
                 jax.device_put(starts, repl3),
                 jax.device_put(lengths, repl3),
@@ -708,10 +746,17 @@ class DistributedSearchPlane:
                 jax.device_put(u_ids, shard2))
         else:
             step = self._get_step(Q, L, k, with_count=with_totals)
-            out = step(
+            step_args = (
                 self.docs_dev, self.impacts_dev,
                 jax.device_put(starts, repl3), jax.device_put(lengths, repl3),
                 jax.device_put(idfw, repl))
+        t1 = time.perf_counter()
+        out = step(*step_args)
+        if stages is not None:
+            # sync here so device time lands in dispatch_ms, not in the
+            # first np.asarray of the fetch below
+            jax.block_until_ready(out)
+        t2 = time.perf_counter()
         self.n_dispatches += 1
         vals, gdocs = out[0], out[1]
         vals = np.asarray(vals)[:B]          # drop replica-padding slots
@@ -724,12 +769,18 @@ class DistributedSearchPlane:
                     break
                 row.append((int(g) // self.n_pad, int(g) % self.n_pad))
             hits.append(row)
+        if stages is not None:
+            stages["prep_ms"] = (t1 - t0) * 1e3
+            stages["dispatch_ms"] = (t2 - t1) * 1e3
+            stages["fetch_ms"] = (time.perf_counter() - t2) * 1e3
         if with_totals:
             totals = [int(c) for c in np.asarray(out[2])[:B]]
             return vals, hits, totals
         return vals, hits
 
-    def search_eager(self, queries: Sequence[Sequence[str]], k: int = 10):
+    def search_eager(self, queries: Sequence[Sequence[str]], k: int = 10,
+                     *, with_totals: bool = False,
+                     stages: Optional[dict] = None):
         """CPU-native serving path: term-at-a-time scatter-add over the
         original CSR with precomputed impacts, per shard, exact top-k with
         the kernel path's tie order (score desc, (shard, doc) asc).
@@ -739,11 +790,18 @@ class DistributedSearchPlane:
         each posting costs one multiply-add instead of the full BM25 norm
         (impacts are precomputed at build time — the plane's representation
         pays off on every backend). Only available when the plane was built
-        on a CPU backend (``_host_csr`` retained)."""
+        on a CPU backend (``_host_csr`` retained).
+
+        ``with_totals`` adds exact per-query match counts (docs with a
+        positive score — impacts and idf weights are strictly positive,
+        so a doc is matched iff some query term's posting touched it),
+        matching the kernel path's ``with_count`` semantics."""
         if self._host_csr is None:
             raise RuntimeError("search_eager requires a CPU-backend plane")
+        t0 = time.perf_counter()
         vals_out = np.full((len(queries), k), NEG_INF, np.float32)
         hits_out: List[List[Tuple[int, int]]] = []
+        totals: List[int] = []
         for bi, terms in enumerate(queries):
             weights: Dict[str, float] = {}
             for t in terms:
@@ -758,6 +816,7 @@ class DistributedSearchPlane:
                         idf_weight(self.n_docs_total, np.int64(gdf))) * w
             cand_v: List[np.ndarray] = []
             cand_g: List[np.ndarray] = []
+            total = 0
             for si, (sh, csr) in enumerate(zip(self.shards,
                                                self._host_csr)):
                 scores = np.zeros(csr["n_docs"], np.float32)
@@ -776,6 +835,8 @@ class DistributedSearchPlane:
                         matched = True
                 if not matched:
                     continue
+                if with_totals:
+                    total += int(np.count_nonzero(scores > 0))
                 kk = min(k, csr["n_docs"])
                 top = np.argpartition(-scores, kk - 1)[:kk]
                 sel = top[scores[top] > 0]
@@ -792,24 +853,34 @@ class DistributedSearchPlane:
                 row = [(int(g[j]) // self.n_pad, int(g[j]) % self.n_pad)
                        for j in order]
             hits_out.append(row)
+            totals.append(total)
         self.n_dispatches += 1
+        if stages is not None:
+            # host path: scoring IS the dispatch (no separate upload or
+            # device sync to attribute)
+            stages["prep_ms"] = 0.0
+            stages["dispatch_ms"] = (time.perf_counter() - t0) * 1e3
+            stages["fetch_ms"] = 0.0
+        if with_totals:
+            return vals_out, hits_out, totals
         return vals_out, hits_out
 
     def _get_step(self, Q: int, L: int, k: int, *, tiered: bool = False,
                   with_count: bool = False, U: Optional[int] = None):
         key = (Q, L, k, tiered, with_count, U)
-        fn = self._steps.get(key)
-        if fn is None:
-            if tiered:
-                fn = build_tiered_bm25_step(
-                    self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
-                    T_pad=self.T_pad, C=self.dense_block,
-                    n_shards=self.n_shards, with_count=with_count, U=U)
-            else:
-                fn = build_bm25_topk_step(
-                    self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
-                    n_shards=self.n_shards, with_count=with_count)
-            self._steps[key] = fn
+        with self._steps_lock:
+            fn = self._steps.get(key)
+            if fn is None:
+                if tiered:
+                    fn = build_tiered_bm25_step(
+                        self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
+                        T_pad=self.T_pad, C=self.dense_block,
+                        n_shards=self.n_shards, with_count=with_count, U=U)
+                else:
+                    fn = build_bm25_topk_step(
+                        self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
+                        n_shards=self.n_shards, with_count=with_count)
+                self._steps[key] = fn
         return fn
 
 
@@ -863,6 +934,11 @@ class DistributedKnnPlane:
         self._packed = (vecs, vnorm2, exists)
         self._dev = None          # device arrays, uploaded on first search()
         self._steps: Dict[int, callable] = {}
+        # dispatcher threads + the warmup thread hit the lazy upload and
+        # step cache concurrently — guard both (a double device_put would
+        # transiently hold 2x the corpus in HBM, and the _packed release
+        # below must not race a concurrent reader)
+        self._steps_lock = threading.Lock()
         # CPU fallback (same pattern as DistributedSearchPlane._host_csr):
         # XLA:CPU's dot/top_k run far below BLAS+introselect, so a CPU
         # backend serves through :meth:`search_host` — the same blocked
@@ -873,43 +949,48 @@ class DistributedKnnPlane:
             if jax.devices()[0].platform == "cpu" else None
 
     def _device_arrays(self):
-        if self._dev is None:
-            vecs, vnorm2, exists = self._packed
-            corpus3 = NamedSharding(self.mesh, P(AXIS_SHARD, None, None))
-            corpus2 = NamedSharding(self.mesh, P(AXIS_SHARD, None))
-            self._dev = (jax.device_put(vecs, corpus3),
-                         jax.device_put(vnorm2, corpus2),
-                         jax.device_put(exists, corpus2))
-            if self._host_pack is None:
-                # accelerator: the corpus now lives in HBM; don't hold a
-                # second copy in host RAM for the plane's lifetime
-                self._packed = None
-        return self._dev
+        with self._steps_lock:
+            if self._dev is None:
+                vecs, vnorm2, exists = self._packed
+                corpus3 = NamedSharding(self.mesh, P(AXIS_SHARD, None, None))
+                corpus2 = NamedSharding(self.mesh, P(AXIS_SHARD, None))
+                self._dev = (jax.device_put(vecs, corpus3),
+                             jax.device_put(vnorm2, corpus2),
+                             jax.device_put(exists, corpus2))
+                if self._host_pack is None:
+                    # accelerator: the corpus now lives in HBM; don't hold
+                    # a second copy in host RAM for the plane's lifetime
+                    self._packed = None
+            return self._dev
 
-    def serve(self, query_vectors, k: int = 10):
+    def serve(self, query_vectors, k: int = 10,
+              stages: Optional[dict] = None):
         """Serving entry: the CPU-native blocked scorer when this plane
         was built on a CPU backend, the jitted device step otherwise."""
         if self._host_pack is not None:
-            return self.search_host(query_vectors, k=k)
-        return self.search(query_vectors, k=k)
+            return self.search_host(query_vectors, k=k, stages=stages)
+        return self.search(query_vectors, k=k, stages=stages)
 
     def _get_step(self, k: int):
-        fn = self._steps.get(k)
-        if fn is None:
-            fn = build_knn_step(
-                self.mesh, n_pad=self.n_pad, dim=max(self.dim, 1), k=k,
-                n_shards=self.n_shards, similarity=self.similarity,
-                block=self.block)
-            self._steps[k] = fn
-        return fn
+        with self._steps_lock:
+            fn = self._steps.get(k)
+            if fn is None:
+                fn = build_knn_step(
+                    self.mesh, n_pad=self.n_pad, dim=max(self.dim, 1), k=k,
+                    n_shards=self.n_shards, similarity=self.similarity,
+                    block=self.block)
+                self._steps[k] = fn
+            return fn
 
-    def search(self, query_vectors, k: int = 10):
+    def search(self, query_vectors, k: int = 10,
+               stages: Optional[dict] = None):
         """Top-k over the packed corpus for a batch of query vectors.
 
         Returns (raw_scores f32[B, k'], hits list[list[(shard, local)]])
         where raw scores are the step's similarity values (cosine/dot: the
         dot product; l2_norm: ``-‖q-v‖²``) — callers apply their own
         monotone _score transform."""
+        t0 = time.perf_counter()
         q = np.asarray(query_vectors, np.float32)
         if q.ndim != 2 or (self.dim and q.shape[1] != self.dim):
             raise ValueError(
@@ -922,14 +1003,23 @@ class DistributedKnnPlane:
                 [q, np.zeros((B_pad - B, q.shape[1]), np.float32)])
         step = self._get_step(k)
         vecs_dev, vnorm2_dev, exists_dev = self._device_arrays()
-        vals, gdocs = step(
-            vecs_dev, vnorm2_dev, exists_dev,
-            jax.device_put(q, NamedSharding(self.mesh,
-                                            P(AXIS_REPLICA, None))))
+        q_dev = jax.device_put(q, NamedSharding(self.mesh,
+                                                P(AXIS_REPLICA, None)))
+        t1 = time.perf_counter()
+        out = step(vecs_dev, vnorm2_dev, exists_dev, q_dev)
+        if stages is not None:
+            jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        vals, gdocs = out
         self.n_dispatches += 1
         vals = np.asarray(vals)[:B]
         gdocs = np.asarray(gdocs)[:B]
-        return vals, self._decode_hits(vals, gdocs)
+        hits = self._decode_hits(vals, gdocs)
+        if stages is not None:
+            stages["prep_ms"] = (t1 - t0) * 1e3
+            stages["dispatch_ms"] = (t2 - t1) * 1e3
+            stages["fetch_ms"] = (time.perf_counter() - t2) * 1e3
+        return vals, hits
 
     def _decode_hits(self, vals, gdocs):
         hits = []
@@ -942,7 +1032,8 @@ class DistributedKnnPlane:
             hits.append(row)
         return hits
 
-    def search_host(self, query_vectors, k: int = 10):
+    def search_host(self, query_vectors, k: int = 10,
+                    stages: Optional[dict] = None):
         """CPU-native serving path: the SAME blocked streaming design as
         the device step — corpus read block by block, carried running
         top-k, O(B·block) transient memory — but in numpy, where the
@@ -953,6 +1044,7 @@ class DistributedKnnPlane:
         asc). Only available when the plane was built on a CPU backend."""
         if self._host_pack is None:
             raise RuntimeError("search_host requires a CPU-backend plane")
+        t0 = time.perf_counter()
         hvecs, hvn, hexists = self._host_pack
         q = np.asarray(query_vectors, np.float32)
         B = q.shape[0]
@@ -1027,4 +1119,8 @@ class DistributedKnnPlane:
                     theta[bi] = best_v[bi, -1]
                 b0 += step_b
         self.n_dispatches += 1
+        if stages is not None:
+            stages["prep_ms"] = 0.0
+            stages["dispatch_ms"] = (time.perf_counter() - t0) * 1e3
+            stages["fetch_ms"] = 0.0
         return best_v, self._decode_hits(best_v, best_g)

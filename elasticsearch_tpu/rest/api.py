@@ -4011,7 +4011,8 @@ class RestAPI:
     STATS_METRICS = ("docs", "store", "indexing", "get", "search", "merge",
                      "refresh", "flush", "warmer", "query_cache",
                      "fielddata", "completion", "segments", "translog",
-                     "suggest", "request_cache", "recovery", "bulk")
+                     "suggest", "request_cache", "recovery", "bulk",
+                     "plane_serving")
     _METRIC_SECTION = {"merge": "merges", "suggest": "search"}
     STATS_PARAMS = {"level", "types", "completion_fields",
                     "fielddata_fields", "fields", "groups",
@@ -8246,7 +8247,8 @@ def _sort_key_tuple(h: ShardHit):
 
 
 #: stats leaves that combine by MAX, not sum (sentinel/high-watermark)
-_MERGE_MAX_KEYS = {"max_unsafe_auto_id_timestamp", "max_seq_no"}
+_MERGE_MAX_KEYS = {"max_unsafe_auto_id_timestamp", "max_seq_no",
+                   "max_batch"}
 
 
 def _merge_numeric_tree(dst: dict, src: dict) -> None:

@@ -1,5 +1,6 @@
 """Micro-batching queue for the serving plane: concurrent plane-eligible
-queries coalesce into ONE device dispatch.
+queries coalesce into ONE device dispatch, driven by a dedicated
+dispatcher thread per plane.
 
 The reference amortizes per-query overhead through its search thread pool
 (``threadpool/ThreadPool.java`` SEARCH lane) and batched partial reduction
@@ -8,22 +9,42 @@ lever is the batch dimension of the dispatch itself — one ``plane.search``
 over B queries costs barely more than B=1 (the kernel is bandwidth-bound
 over the postings table, which every query in the batch shares).
 
-Design ("batch whatever queued during the previous dispatch"): the first
-arrival becomes the *leader* and dispatches immediately — zero added
-latency at low load. Requests that arrive while the device is busy queue
-up; when the leader finishes it promotes one waiter to leader for the
-accumulated batch. Under load the batch size converges to
+Design (dispatcher pipeline): client threads only enqueue a slot and
+block on its result; a small pool of dispatcher threads (PIPELINE_DEPTH,
+spawned on demand, exiting after IDLE_EXIT_S of quiet) drains the queue.
+While one dispatcher waits on a device result, the other accumulates the
+next batch and runs its host-side prep (term→id lookup, padding,
+``np.stack``), so host prep pipelines with device execution. No client
+thread ever "leads" a dispatch — the old leader-promotion scheme let a
+promoted leader's k-bucket filter starve waiters in other buckets (the
+convoy this rebuild kills). Under load the batch size converges to
 arrival-rate × dispatch-time with no tuning knob and no timed wait.
 
+Batch selection: the dispatcher picks the k-bucket with the most ready
+slots; when the queue runs deeper than one full batch it coalesces
+across buckets at the max-k shape instead (one bigger dispatch beats two
+half-empty ones); and any slot skipped STARVATION_ROUNDS times forces
+its own bucket next, so no bucket waits unboundedly behind a popular one.
+
+Observability: every request is stamped with per-stage timings — queue
+wait, host prep, device dispatch, result fetch — aggregated per batcher
+(totals for nodes stats, bounded sample rings for bench percentiles), so
+a serving regression is attributable to a stage instead of one opaque
+p99. :meth:`PlaneMicroBatcher.warmup` pre-compiles the serving shape
+lattice (B-pow2 × k-bucket × L-rung) off the serving path at plane-build
+time — a first-hit XLA compile landing mid-traffic is the classic
+multi-second p99 signature.
+
 One batcher per plane (planes are per-(shard, field) and rebuilt on
-refresh); dispatches on one plane are serialized by construction, distinct
-planes dispatch concurrently.
+refresh); distinct planes dispatch concurrently.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Sequence, Tuple
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -31,52 +52,102 @@ import numpy as np
 #: long enough that splitting reduces tail latency
 MAX_BATCH = 64
 
+#: per-request stage names, in pipeline order
+STAGES = ("queue", "prep", "dispatch", "fetch")
+
+#: per-stage sample ring size (bench percentiles read these)
+STAGE_SAMPLE_CAP = 4096
+
+
+def empty_serving_stats() -> Dict[str, int]:
+    """Zero-valued serving-stats doc — the shape :meth:`stats_doc`
+    returns and nodes stats aggregate (``plane_serving`` section)."""
+    return {
+        "dispatches": 0, "queries": 0, "max_batch": 0,
+        "starved_dispatches": 0, "coalesced_dispatches": 0,
+        "deduped_queries": 0,
+        "warmed_shapes": 0, "warmup_time_in_millis": 0,
+        "queue_time_in_millis": 0, "prep_time_in_millis": 0,
+        "dispatch_time_in_millis": 0, "fetch_time_in_millis": 0,
+    }
+
 
 class _Slot:
-    __slots__ = ("terms", "k", "done", "is_leader", "vals", "hits",
-                 "total", "error")
+    __slots__ = ("terms", "k", "done", "vals", "hits", "total", "error",
+                 "t_enq", "rounds_skipped", "stage_ms")
 
-    def __init__(self, terms: Sequence[str], k: int):
+    def __init__(self, terms, k: int):
         self.terms = terms
         self.k = k
         self.done = False
-        self.is_leader = False
         self.vals = None
         self.hits: Optional[List[Tuple[int, int]]] = None
         self.total: Optional[int] = None
         self.error: Optional[BaseException] = None
+        self.t_enq = time.perf_counter()
+        #: dispatch rounds that passed this slot over (starvation bound)
+        self.rounds_skipped = 0
+        #: per-stage ms for THIS request, filled at fan-out
+        self.stage_ms: Optional[Dict[str, float]] = None
 
 
 class PlaneMicroBatcher:
-    """Serializes and batches ``plane.search`` dispatches for one plane."""
+    """Batches ``plane.search`` dispatches for one plane behind a
+    dedicated dispatcher thread."""
+
+    #: concurrent dispatcher threads: 2 pipelines host prep of batch N+1
+    #: with the device execution / result sync of batch N
+    PIPELINE_DEPTH = 2
+    #: dispatcher threads exit after this long with an empty queue (a
+    #: rebuilt plane's orphaned batcher must not leak a thread forever)
+    IDLE_EXIT_S = 5.0
+    #: a queued slot skipped this many rounds forces its bucket next
+    STARVATION_ROUNDS = 4
 
     def __init__(self, plane, max_batch: int = MAX_BATCH):
         self.plane = plane
         self.max_batch = max_batch
-        self._cond = threading.Condition()
+        # one lock, two wait-sets: clients wait on _cond for their slot,
+        # dispatchers wait on _work for queue items — an enqueue then
+        # wakes ONE dispatcher instead of every blocked client
+        _lock = threading.Lock()
+        self._cond = threading.Condition(_lock)
+        self._work = threading.Condition(_lock)
         self._queue: List[_Slot] = []
-        self._leader_active = False
-        # observability (nodes stats / ROOFLINE measurements)
+        self._dispatchers: List[threading.Thread] = []
+        self._warmup_thread: Optional[threading.Thread] = None
+        # observability (nodes stats / serving bench) — mutated ONLY under
+        # self._cond
         self.n_dispatches = 0
         self.n_queries = 0
         self.max_seen_batch = 0
+        self.n_starved_dispatches = 0
+        self.n_coalesced_dispatches = 0
+        self.n_deduped = 0
+        self.warmed_shapes = 0
+        self.warmup_ms = 0.0
+        self._retired = False
+        self.stage_totals_ms: Dict[str, float] = {s: 0.0 for s in STAGES}
+        self.stage_samples: Dict[str, deque] = {
+            s: deque(maxlen=STAGE_SAMPLE_CAP) for s in STAGES}
 
-    def search(self, terms: Sequence[str], k: int):
+    # -- client entry -------------------------------------------------------
+
+    def search(self, terms: Sequence[str], k: int,
+               stages: Optional[dict] = None):
         """One query through the batched dispatch. Returns
         (scores[k], hits[(shard, doc)...], exact total). Blocks until the
-        dispatch that carries this query completes."""
+        dispatch that carries this query completes. ``stages``, when a
+        dict, receives this request's per-stage ms timings."""
         slot = _Slot(terms, k)
         with self._cond:
             self._queue.append(slot)
-            if self._leader_active:
-                while not (slot.done or slot.is_leader):
-                    self._cond.wait()
-                if slot.done:
-                    return self._result(slot)
-                # promoted: fall through to lead the accumulated batch
-            else:
-                self._leader_active = True
-        self._lead()
+            self._ensure_dispatcher_locked()
+            self._work.notify()
+            while not slot.done:
+                self._cond.wait()
+        if stages is not None and slot.stage_ms is not None:
+            stages.update(slot.stage_ms)
         return self._result(slot)
 
     @staticmethod
@@ -93,49 +164,252 @@ class PlaneMicroBatcher:
         per-k compile cache (``dist_search._get_step`` caches per k)."""
         return 1 << max(0, (k - 1).bit_length())
 
-    def _lead(self) -> None:
-        """Dispatch the queued batch (which includes the caller's slot),
-        then hand leadership to a waiter if more queued meanwhile. Only
-        slots in the head slot's k-bucket join; others stay queued for the
-        next leader."""
-        with self._cond:
-            kb = self._k_bucket(self._queue[0].k)
-            batch = [s for s in self._queue[:self.max_batch]
-                     if self._k_bucket(s.k) == kb]
-            taken = set(map(id, batch))
-            self._queue = [s for s in self._queue
-                           if id(s) not in taken]
+    # -- dispatcher ---------------------------------------------------------
+
+    def _ensure_dispatcher_locked(self) -> None:
+        self._dispatchers = [t for t in self._dispatchers if t.is_alive()]
+        if self._queue and len(self._dispatchers) < self.PIPELINE_DEPTH:
+            t = threading.Thread(
+                target=self._dispatch_loop,
+                name=f"plane-dispatch-{id(self):x}", daemon=True)
+            self._dispatchers.append(t)
+            t.start()
+
+    def _dispatch_loop(self) -> None:
+        me = threading.current_thread()
+        while True:
+            with self._cond:
+                deadline = time.monotonic() + self.IDLE_EXIT_S
+                while not self._queue:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0:
+                        if me in self._dispatchers:
+                            self._dispatchers.remove(me)
+                        return
+                    self._work.wait(rem)
+                batch = self._take_batch_locked()
+            try:
+                self._run_batch(batch)
+            except BaseException as e:   # noqa: BLE001 — the loop must
+                # survive anything so queued slots never hang a client
+                with self._cond:
+                    for s in batch:
+                        if not s.done:
+                            s.error = e
+                            s.done = True
+                    self._cond.notify_all()
+
+    def _take_batch_locked(self) -> List[_Slot]:
+        """Pick the next batch (caller holds the lock; queue non-empty).
+
+        Priority: (1) any slot skipped STARVATION_ROUNDS times gets its
+        bucket dispatched now — a queued slot whose bucket never matches
+        the popular one is still served within a bounded number of
+        rounds; (2) a queue deeper than one full batch coalesces across
+        buckets at the max-k shape; (3) otherwise the largest ready
+        bucket goes (ties resolve to the oldest slot's bucket)."""
+        q = self._queue
+        starved = next((s for s in q
+                        if s.rounds_skipped >= self.STARVATION_ROUNDS), None)
+        if starved is not None:
+            kb = self._k_bucket(starved.k)
+            batch = [s for s in q
+                     if self._k_bucket(s.k) == kb][: self.max_batch]
+            self.n_starved_dispatches += 1
+        elif len(q) > self.max_batch:
+            batch = q[: self.max_batch]
+            if len({self._k_bucket(s.k) for s in batch}) > 1:
+                self.n_coalesced_dispatches += 1
+        else:
+            counts: Dict[int, int] = {}
+            for s in q:
+                kb = self._k_bucket(s.k)
+                counts[kb] = counts.get(kb, 0) + 1
+            best = max(counts.values())
+            kb = next(self._k_bucket(s.k) for s in q
+                      if counts[self._k_bucket(s.k)] == best)
+            batch = [s for s in q
+                     if self._k_bucket(s.k) == kb][: self.max_batch]
+        taken = set(map(id, batch))
+        self._queue = [s for s in q if id(s) not in taken]
+        for s in self._queue:
+            s.rounds_skipped += 1
+        return batch
+
+    def _run_batch(self, batch: List[_Slot]) -> None:
+        t_pick = time.perf_counter()
         # dispatch at the bucket's rounded-up k so the compile shape is
-        # stable within a bucket (slots trim to their own k on fan-out)
+        # stable within a bucket (slots trim to their own k on fan-out);
+        # a coalesced cross-bucket batch runs at the max-k shape
         k = self._k_bucket(max(s.k for s in batch))
+        # in-flight dedup: identical queries that queued concurrently
+        # (the same hot body from many clients) share ONE dispatch slot —
+        # each client still gets its own result copy on fan-out
+        slot_of: Dict = {}
+        lane: List[int] = []
+        for s in batch:
+            qk = self._query_key(s.terms)
+            idx = slot_of.setdefault(qk, len(slot_of))
+            lane.append(idx)
+        n_deduped = len(batch) - len(slot_of)
+        uniq: List = [None] * len(slot_of)
+        for s, idx in zip(batch, lane):
+            if uniq[idx] is None:
+                uniq[idx] = s.terms
         # pad the batch to a power of two: every distinct traced B shape is
         # a fresh XLA compile — ragged arrival sizes would otherwise
         # compile dozens of programs (padding slots score as no-op
         # queries, same as the plane's own replica padding)
-        b_pad = 1 << max(0, (len(batch) - 1).bit_length())
-        queries = [s.terms for s in batch] + \
-            [self._pad_slot() for _ in range(b_pad - len(batch))]
+        b_pad = 1 << max(0, (len(uniq) - 1).bit_length())
+        queries = uniq + [self._pad_slot()
+                          for _ in range(b_pad - len(uniq))]
+        plane_stages: Dict[str, float] = {}
+        t_call = time.perf_counter()
+        err: Optional[BaseException] = None
         try:
-            vals, hits, totals = self._dispatch(queries, k)
+            vals, hits, totals = self._dispatch(queries, k, plane_stages)
         except BaseException as e:          # noqa: BLE001 — fan the error
-            for s in batch:                 # out to every query in the batch
-                s.error = e
-        else:
-            for i, s in enumerate(batch):
-                s.vals = vals[i][:s.k]
-                s.hits = hits[i][:s.k]
-                s.total = totals[i]
-        self.n_dispatches += 1
-        self.n_queries += len(batch)
-        self.max_seen_batch = max(self.max_seen_batch, len(batch))
-        with self._cond:
+            err = e                         # out to every query in the batch
+        t_done = time.perf_counter()
+        if err is not None:
             for s in batch:
+                s.error = err
+        else:
+            for s, idx in zip(batch, lane):
+                s.vals = vals[idx][:s.k]
+                s.hits = hits[idx][:s.k]
+                s.total = totals[idx]
+        # stage attribution: queue wait is per-slot; prep / dispatch /
+        # fetch are shared by the whole batch (one dispatch). The plane
+        # refines its own call into prep/dispatch/fetch when it can;
+        # otherwise the whole call counts as dispatch.
+        prep_ms = (t_call - t_pick) * 1e3 + plane_stages.get("prep_ms", 0.0)
+        dispatch_ms = plane_stages.get(
+            "dispatch_ms", (t_done - t_call) * 1e3)
+        fetch_base_ms = plane_stages.get("fetch_ms", 0.0)
+        with self._cond:
+            fetch_ms = fetch_base_ms + \
+                (time.perf_counter() - t_done) * 1e3
+            for s in batch:
+                s.stage_ms = {
+                    "queue": (t_pick - s.t_enq) * 1e3, "prep": prep_ms,
+                    "dispatch": dispatch_ms, "fetch": fetch_ms}
+                for name in STAGES:
+                    self.stage_totals_ms[name] += s.stage_ms[name]
+                    self.stage_samples[name].append(s.stage_ms[name])
                 s.done = True
-            if self._queue:
-                self._queue[0].is_leader = True
-            else:
-                self._leader_active = False
+            self.n_dispatches += 1
+            self.n_queries += len(batch)
+            self.n_deduped += n_deduped
+            self.max_seen_batch = max(self.max_seen_batch, len(batch))
             self._cond.notify_all()
+
+    # -- warmup (shape-lattice pre-compile) ---------------------------------
+
+    def warmup(self, ks: Sequence[int] = (10,),
+               max_b: Optional[int] = None, sync: bool = False):
+        """Pre-compile the serving shape lattice (B-pow2 × k-bucket ×
+        L-rung) so no first-hit XLA compile lands mid-traffic. Runs in a
+        background thread by default (plane build must not block on
+        minutes of compiles); ``sync=True`` blocks (tests). Host-serving
+        planes (CPU backend → eager/BLAS paths) compile nothing and
+        return immediately."""
+        if self._serves_host():
+            return None
+        shapes = list(self._warm_lattice(ks, max_b or self.max_batch))
+
+        def _run():
+            t0 = time.perf_counter()
+            n = 0
+            for fn in shapes:
+                if self._retired:
+                    # the plane was superseded (refresh rebuilt it):
+                    # stop compiling shapes nobody will ever serve and
+                    # release the thread's reference to the old corpus
+                    break
+                try:
+                    fn()
+                    n += 1
+                except Exception:   # noqa: BLE001 — warmup must never
+                    break           # take down serving
+            with self._cond:
+                self.warmed_shapes += n
+                self.warmup_ms += (time.perf_counter() - t0) * 1e3
+
+        if sync:
+            _run()
+            return None
+        t = threading.Thread(target=_run,
+                             name=f"plane-warmup-{id(self):x}", daemon=True)
+        self._warmup_thread = t
+        t.start()
+        return t
+
+    def retire(self) -> None:
+        """The owning plane was superseded or evicted: stop any in-flight
+        warmup at the next shape boundary (in-flight dispatches complete
+        normally; late arrivals through a stale reference still serve)."""
+        self._retired = True
+
+    def _serves_host(self) -> bool:
+        """True when the plane serves through a host-native path (CPU
+        backend) — nothing to pre-compile."""
+        return getattr(self.plane, "_host_csr", None) is not None
+
+    def _warm_lattice(self, ks, max_b):
+        """Thunks, one per (B, k-bucket, L-rung) serving shape."""
+        plane = self.plane
+        rungs = plane.ladder_rungs() if hasattr(plane, "ladder_rungs") \
+            else [None]
+        kbs = sorted({self._k_bucket(k) for k in ks})
+        # serving dispatches run at the plane's Q floor (serve() collapses
+        # the Q shape axis there) — warm that exact shape
+        qkw = {"Q": plane.SERVING_Q_MIN} \
+            if getattr(plane, "SERVING_Q_MIN", 0) else {}
+        b = 1
+        while b <= min(max_b, self.max_batch):
+            for kb in kbs:
+                for L in rungs:
+                    yield lambda B=b, kb=kb, L=L: plane.search(
+                        [self._pad_slot()] * B, k=kb, L=L,
+                        tiered=getattr(plane, "T_pad", 0) > 0 or None,
+                        with_totals=True, **qkw)
+            b <<= 1
+
+    # -- stats --------------------------------------------------------------
+
+    def stats_doc(self) -> Dict[str, int]:
+        """Aggregate serving stats (nodes stats ``plane_serving``)."""
+        with self._cond:
+            out = empty_serving_stats()
+            out.update(
+                dispatches=self.n_dispatches, queries=self.n_queries,
+                max_batch=self.max_seen_batch,
+                starved_dispatches=self.n_starved_dispatches,
+                coalesced_dispatches=self.n_coalesced_dispatches,
+                deduped_queries=self.n_deduped,
+                warmed_shapes=self.warmed_shapes,
+                warmup_time_in_millis=int(self.warmup_ms))
+            for name in STAGES:
+                out[f"{name}_time_in_millis"] = int(
+                    self.stage_totals_ms[name])
+            return out
+
+    def stage_percentiles(self, skip: int = 0) -> Dict[str, dict]:
+        """Per-stage p50/p99 over the retained per-request samples,
+        skipping the first ``skip`` samples of each ring (bench: exclude
+        a warmup window). Empty stages are omitted."""
+        with self._cond:
+            snap = {s: list(d)[skip:] for s, d in
+                    self.stage_samples.items()}
+        out = {}
+        for name, vals in snap.items():
+            if vals:
+                a = np.asarray(vals)
+                out[name] = {"p50_ms": round(float(np.percentile(a, 50)), 3),
+                             "p99_ms": round(float(np.percentile(a, 99)), 3),
+                             "n": len(vals)}
+        return out
 
     # -- dispatch hooks (overridden by the kNN batcher) ---------------------
 
@@ -143,15 +417,23 @@ class PlaneMicroBatcher:
         """Inert query filling a pow2 padding slot."""
         return []
 
-    def _dispatch(self, queries, k: int):
+    @staticmethod
+    def _query_key(terms):
+        """Hashable identity of one query (in-flight dedup)."""
+        return tuple(terms)
+
+    def _dispatch(self, queries, k: int,
+                  stages: Optional[dict] = None):
         """One device dispatch over the coalesced batch → (vals, hits,
-        totals) aligned with ``queries``. Runs outside the queue lock."""
-        # size L to the batch through the plane's 4-rung ladder: ordinary
-        # short-run batches skip the worst-case sparse-merge cost
-        # (pinning L_cap made every dispatch pay it — the difference
-        # between ~10ms and multi-second dispatches on the full corpus),
-        # while the rung count bounds serving-time compiles to at most 4
-        # shapes per (B, Q, k) family
+        totals) aligned with ``queries``. Runs on a dispatcher thread,
+        never under the queue lock."""
+        serve = getattr(self.plane, "serve", None)
+        if serve is not None:
+            # the plane's serving entry picks the backend path (eager
+            # CSR scorer on CPU, ladder-shaped jitted step on TPU) and
+            # refines the stage timings
+            return serve(queries, k=k, with_totals=True, stages=stages)
+        # legacy/raw planes: size L through the ladder here
         L = None
         if hasattr(self.plane, "max_run_len"):
             L = self.plane.ladder_L(self.plane.max_run_len(queries))
@@ -173,14 +455,35 @@ class KnnPlaneMicroBatcher(PlaneMicroBatcher):
         # discarded with the slot
         return np.zeros(max(self.plane.dim, 1), np.float32)
 
-    def _dispatch(self, queries, k: int):
+    @staticmethod
+    def _query_key(terms):
+        v = np.asarray(terms)
+        return (v.shape, v.tobytes())
+
+    def _serves_host(self) -> bool:
+        return getattr(self.plane, "_host_pack", None) is not None
+
+    def _warm_lattice(self, ks, max_b):
+        plane = self.plane
+        kbs = sorted({self._k_bucket(k) for k in ks})
+        b = 1
+        while b <= min(max_b, self.max_batch):
+            for kb in kbs:
+                yield lambda B=b, kb=kb: plane.search(
+                    np.zeros((B, max(plane.dim, 1)), np.float32), k=kb)
+            b <<= 1
+
+    def _dispatch(self, queries, k: int,
+                  stages: Optional[dict] = None):
         # plane.serve picks the backend-appropriate path (numpy blocked
         # scorer on CPU — the search_eager analogue — jitted step on TPU)
-        vals, hits = self.plane.serve(np.stack(queries), k=k)
+        vals, hits = self.plane.serve(np.stack(queries), k=k,
+                                      stages=stages)
         return vals, hits, [None] * len(queries)
 
 
-def batched_search(plane, terms: Sequence[str], k: int):
+def batched_search(plane, terms: Sequence[str], k: int,
+                   stages: Optional[dict] = None):
     """Module entry: route one query through the plane's micro-batcher
     (created lazily on first use; plane rebuilds get a fresh one)."""
     batcher = getattr(plane, "_microbatcher", None)
@@ -190,7 +493,7 @@ def batched_search(plane, terms: Sequence[str], k: int):
             if batcher is None:
                 batcher = PlaneMicroBatcher(plane)
                 plane._microbatcher = batcher
-    return batcher.search(terms, k)
+    return batcher.search(terms, k, stages=stages)
 
 
 def batched_knn_search(plane, query_vector, k: int):

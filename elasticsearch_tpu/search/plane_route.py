@@ -173,6 +173,33 @@ class ServingPlaneCache:
     #: max cached kNN planes (each is one packed f32 corpus copy)
     KNN_PLANE_CACHE_MAX = 32
 
+    @staticmethod
+    def _attach_batcher(plane, knn: bool = False):
+        """Pre-create the plane's micro-batcher at plane-build time and
+        kick off its serving-shape-lattice warmup (background thread; see
+        ``microbatch.PlaneMicroBatcher.warmup``) — a first-hit XLA
+        compile landing mid-traffic is the multi-second serving-p99
+        signature. Host-serving (CPU) planes compile nothing so warmup
+        returns immediately. ``ES_TPU_SERVING_WARMUP=0`` disables."""
+        import os
+        from .microbatch import KnnPlaneMicroBatcher, PlaneMicroBatcher
+        cls = KnnPlaneMicroBatcher if knn else PlaneMicroBatcher
+        batcher = cls(plane)
+        plane._microbatcher = batcher
+        if os.environ.get("ES_TPU_SERVING_WARMUP", "1").lower() \
+                not in ("0", "false"):
+            batcher.warmup()
+        return batcher
+
+    @staticmethod
+    def _retire(plane) -> None:
+        """Stop a superseded/evicted plane's in-flight warmup so rebuild
+        storms (refresh-heavy indices) don't stack background compile
+        threads each pinning an orphaned corpus copy."""
+        b = getattr(plane, "_microbatcher", None)
+        if b is not None:
+            b.retire()
+
     def _get_mesh(self):
         if self._mesh is None:
             if self._mesh_factory is not None:
@@ -264,7 +291,9 @@ class ServingPlaneCache:
         old = self._planes.get(field)
         if old is not None:
             acct.release(getattr(old[1], "_acct_bytes", 0))
+            self._retire(old[1])
         plane._acct_bytes = nbytes
+        self._attach_batcher(plane)
         self._planes[field] = (sig, plane)
         return plane
 
@@ -360,11 +389,13 @@ class ServingPlaneCache:
         for old_key in [ok for ok in self._knn_planes
                         if ok[0] == field and ok[1] != sig
                         and any(sid in new_ids for sid, _ in ok[1])]:
-            acct.release(getattr(self._knn_planes.pop(old_key),
-                                 "_acct_bytes", 0))
+            old = self._knn_planes.pop(old_key)
+            acct.release(getattr(old, "_acct_bytes", 0))
+            self._retire(old)
         while len(self._knn_planes) >= self.KNN_PLANE_CACHE_MAX:
             _, old = self._knn_planes.popitem(last=False)
             acct.release(getattr(old, "_acct_bytes", 0))
+            self._retire(old)
         acct.add_estimate(nbytes, f"<knn serving plane [{field}]>")
         try:
             plane = DistributedKnnPlane(self._get_mesh(), shards,
@@ -380,6 +411,7 @@ class ServingPlaneCache:
             acct.release(nbytes)
             self._knn_planes.move_to_end(key)
             return raced
+        self._attach_batcher(plane, knn=True)
         self._knn_planes[key] = plane
         self._knn_build_streak += 1
         return plane
@@ -391,7 +423,9 @@ class ServingPlaneCache:
         acct = _breakers.breaker("accounting")
         for _sig, plane in self._planes.values():
             acct.release(getattr(plane, "_acct_bytes", 0))
+            self._retire(plane)
         for plane in self._knn_planes.values():
             acct.release(getattr(plane, "_acct_bytes", 0))
+            self._retire(plane)
         self._planes.clear()
         self._knn_planes.clear()

@@ -101,6 +101,11 @@ class ShardSearchResult:
     #: reference's ShardSearchFailure list; hits of failed shards are
     #: excluded, the rest of the response stands)
     shard_failures: Optional[List[dict]] = None
+    #: per-stage serving-pipeline ms for plane-served queries (queue wait /
+    #: host prep / device dispatch / fetch — microbatch.STAGES); None when
+    #: the per-segment path served. Slow-log entries carry this so a slow
+    #: query is attributable to a stage.
+    serving_stages: Optional[Dict[str, float]] = None
 
 
 def _knn_score_transform(similarity: str, sim):
@@ -377,13 +382,16 @@ class ShardSearcher:
         host_masks: Dict[int, np.ndarray] = {}
         host_scores: Dict[int, np.ndarray] = {}
         need_host_mask = use_field_sort
+        serving_stages: Optional[Dict[str, float]] = None
         if plane_route is not None:
             plane, bag_terms = plane_route
             # concurrent eligible queries coalesce into one device dispatch
-            # (search/microbatch.py — the search-thread-pool analog)
+            # (search/microbatch.py — the search-thread-pool analog); the
+            # batcher stamps this request's per-stage pipeline timings
             from .microbatch import batched_search
+            serving_stages = {}
             pvals0, phits0, ptotal0 = batched_search(
-                plane, bag_terms, k=max(window, 1))
+                plane, bag_terms, k=max(window, 1), stages=serving_stages)
             total = int(ptotal0)
             candidates = [(float(v), si, d)
                           for v, (si, d) in zip(pvals0, phits0)]
@@ -687,7 +695,8 @@ class ShardSearcher:
                                  hits=hits, max_score=max_score,
                                  aggregations=agg_results,
                                  agg_inputs=agg_inputs,
-                                 profile=profile_out, suggest=suggest_out)
+                                 profile=profile_out, suggest=suggest_out,
+                                 serving_stages=serving_stages or None)
 
     def _attach_nested_inner_hits(self, hits: List[ShardHit],
                                   ih_specs: List[dict]) -> None:
