@@ -265,6 +265,25 @@ def _emit(name: str, doc: dict) -> dict:
     return doc
 
 
+def _telemetry_snapshot() -> dict:
+    """Final telemetry registry rollup for the bench JSON: compile
+    counts/ms per site, device bytes moved, live-memory watermark — a
+    compile-churn regression is then visible in the BENCH_r* trajectory,
+    not just as an unexplained p99."""
+    try:
+        from elasticsearch_tpu.common.telemetry import device_stats_doc
+        doc = device_stats_doc()
+        return {
+            "compiles": doc.get("compiles", {}),
+            "compile_millis": doc.get("compile_millis", {}),
+            "transfer_bytes": doc.get("transfer", {}),
+            "live_array_bytes_watermark":
+                doc.get("live_array_bytes_watermark", 0),
+        }
+    except Exception as e:   # noqa: BLE001 — telemetry must never cost
+        return {"error": repr(e)[:200]}    # the headline number
+
+
 def _rrf(rank_lists, k, rrf_k=60):
     """Reciprocal-rank fusion over per-retriever doc-id rank lists
     (reference: ``RRFRankDoc`` semantics — score Σ 1/(rrf_k + rank))."""
@@ -696,7 +715,8 @@ def bench_serving(rng):
         "warm_first_request_ms": round(warm_first_ms, 2),
         "stages": stage_pcts,
         "cached": cached_win,
-        "microbatch": batch_stats})
+        "microbatch": batch_stats,
+        "telemetry": _telemetry_snapshot()})
 
 
 
@@ -874,6 +894,8 @@ def main(mode: str = "accel"):
         # a CPU-fallback run must be distinguishable from a real TPU result
         "backend": jax.devices()[0].platform,
         "configs": configs,
+        # end-of-run registry rollup: compile counts + device bytes moved
+        "telemetry": _telemetry_snapshot(),
     }
     if kernel_cpu_qps is not None:
         doc["serving_path"] = "eager-cpu"

@@ -18,6 +18,21 @@ import os
 import signal
 
 
+def _wrap_handler(handle, owner=None):
+    """Adapt a REST ``handle`` to the HttpServer's 4-tuple form: collect
+    the echoed response headers (Trace-Id, X-Opaque-Id) per request.
+    ``owner`` keeps the ``__self__`` link HttpServer.start uses to
+    advertise the real bound address (http_publish_address)."""
+    def handler(method, path, query, body, headers=None):
+        rh = {}
+        status, ct, out = handle(method, path, query, body,
+                                 headers=headers, resp_headers=rh)
+        return status, ct, out, rh
+    if owner is not None:
+        handler.__self__ = owner
+    return handler
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="estpu-node")
     ap.add_argument("--host", default="127.0.0.1")
@@ -48,7 +63,7 @@ def main(argv=None) -> int:
         from ..node.cluster_node import ClusterNode
         node = ClusterNode(args.name, args.host, args.transport_port,
                            peers, args.data)
-        handler = node.rest.handle
+        handler = _wrap_handler(node.rest.handle)
         print(f"[{args.name}] cluster node up: transport "
               f"{args.host}:{args.transport_port}, peers "
               f"{sorted(peers)}")
@@ -58,7 +73,7 @@ def main(argv=None) -> int:
         api = RestAPI(IndicesService(args.data),
                       cluster_name=args.cluster_name,
                       node_name=args.name)
-        handler = api.handle
+        handler = _wrap_handler(api.handle, owner=api)
         node = None
 
     from ..rest.http_server import HttpServer

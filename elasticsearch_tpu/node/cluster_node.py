@@ -244,14 +244,19 @@ class ClusterNode:
         async def handler(method, path, query, body, headers=None):
             loop = asyncio.get_running_loop()
             # copy_context so context-bound request state (the
-            # deprecation-warning accumulator) follows the request onto
-            # the worker thread
+            # deprecation-warning accumulator, the trace context) follows
+            # the request onto the worker thread
             import contextvars
             ctx = contextvars.copy_context()
-            return await loop.run_in_executor(
-                self._http_pool, lambda: ctx.run(
-                    self.rest.handle,
-                    method, path, query, body, headers=headers))
+            rh: dict = {}
+
+            def run():
+                status, ct, out = ctx.run(
+                    self.rest.handle, method, path, query, body,
+                    headers=headers, resp_headers=rh)
+                return status, ct, out, rh
+
+            return await loop.run_in_executor(self._http_pool, run)
 
         self.http = HttpServer(handler, host=host, port=port,
                                pass_headers=True)
@@ -923,6 +928,11 @@ class ClusterNode:
         # cannot answer in time degrades to partial stats (slightly-off
         # idf) instead of failing the whole search — the reference's DFS
         # phase likewise tolerates per-shard failures.
+        # trace context crosses the wire in request payload headers: the
+        # data-node handlers re-bind it so their spans join THIS request's
+        # trace (coordinator → shard fan-out propagation)
+        from ..common.tracing import wire_headers
+        trace_hdrs = wire_headers()
         stats = {"total_docs": 0, "fields": {}, "terms": {}}
         for node_id in node_order:
             s = None
@@ -931,7 +941,8 @@ class ClusterNode:
                     s = self.rpc_or_direct(
                         node_id, "search:stats", self._h_search_stats, {
                             "index": index, "shards": by_node[node_id],
-                            "body": {"query": body.get("query")}},
+                            "body": {"query": body.get("query")},
+                            "_trace": trace_hdrs},
                         timeout=attempt, readonly=True)
                     break
                 except Exception:   # noqa: BLE001 — retry once, then skip
@@ -970,7 +981,8 @@ class ClusterNode:
                     nb.pop("search_after", None)
             payload = {"index": index, "shards": by_node[node_id],
                        "body": nb, "global_stats": stats,
-                       "want_agg_partials": bool(body.get("aggs"))}
+                       "want_agg_partials": bool(body.get("aggs")),
+                       "_trace": trace_hdrs}
             t_rpc = time.monotonic()
             results.append(self.rpc_or_direct(
                 node_id, "search:shards", self._h_search_shards, payload,
@@ -1295,7 +1307,16 @@ class ClusterNode:
 
     def _h_search_stats(self, src, payload):
         """DFS stats phase: this node's contribution to cluster-wide term
-        statistics for the query's terms (``search/dfs/DfsPhase.java``)."""
+        statistics for the query's terms (``search/dfs/DfsPhase.java``).
+        The span re-binds the coordinator's trace context from the
+        payload's wire headers — cross-node propagation."""
+        from ..common.tracing import span
+        with span(f"shard_stats[{payload['index']}]", node=self.node_id,
+                  headers=payload.get("_trace"),
+                  attrs={"shards": list(payload["shards"])}):
+            return self._h_search_stats_traced(src, payload)
+
+    def _h_search_stats_traced(self, src, payload):
         from ..search.query_dsl import MatchAllQuery, parse_query
         name = payload["index"]
         dist = self._local_dist_searcher(name, payload["shards"])
@@ -1333,6 +1354,17 @@ class ClusterNode:
         return {"can_match": _shard_can_match(svc.searcher(), bounds)}
 
     def _h_search_shards(self, src, payload):
+        """Query phase over this node's copies of the listed shards. The
+        span adopts the coordinator's trace (payload ``_trace`` wire
+        headers), so a front-node request's ``GET /_trace/{id}`` tree
+        spans the data nodes it fanned out to."""
+        from ..common.tracing import span
+        with span(f"shard_search[{payload['index']}]", node=self.node_id,
+                  headers=payload.get("_trace"),
+                  attrs={"shards": list(payload["shards"])}):
+            return self._h_search_shards_traced(src, payload)
+
+    def _h_search_shards_traced(self, src, payload):
         name = payload["index"]
         body = payload["body"]
         dist = self._local_dist_searcher(name, payload["shards"],

@@ -529,6 +529,10 @@ class ClusterRestService:
         #: retried a non-idempotent op (index create...) must get the
         #: FIRST execution's response, not a duplicate execution
         self._op_cache: Dict[str, dict] = {}
+        #: the front-door request's HTTP headers for the duration of its
+        #: dispatch — _local forwards them into api.handle so
+        #: X-Opaque-Id / traceparent reach the task + trace layer
+        self._incoming_headers_tls = threading.local()
 
     # ------------------------------------------------------------------
     # op-log application (every node, on the data worker)
@@ -661,15 +665,37 @@ class ClusterRestService:
     # ------------------------------------------------------------------
 
     def handle(self, method: str, path: str, query: str, body: bytes,
-               headers: Optional[dict] = None) -> Tuple[int, str, bytes]:
+               headers: Optional[dict] = None,
+               resp_headers: Optional[dict] = None) \
+            -> Tuple[int, str, bytes]:
         from ..rest.api import JSON_CT, _error_payload
+        self.api._trace_tls.value = None
         try:
             if self.api.security.enabled:
                 # authenticate at the front door; forwarded/replicated
                 # internal hops stay inside the trusted transport
                 self.api._principal_tls.value = \
                     self.api.security.authenticate(headers)
-            return self._dispatch(method, path, query or "", body or b"")
+            self._incoming_headers_tls.value = headers
+            try:
+                out = self._dispatch(method, path, query or "",
+                                     body or b"")
+            finally:
+                self._incoming_headers_tls.value = None
+            if resp_headers is not None:
+                # trace/opaque echo: _local dispatches run api.handle on
+                # THIS thread, which stamps the pair into _trace_tls.
+                # Disclosed narrowing: requests forwarded whole to
+                # another node (_exec_on) echo nothing — the remote's
+                # trace id stays queryable via the shared store only
+                info = getattr(self.api._trace_tls, "value", None)
+                if info:
+                    tid, opaque = info
+                    if tid:
+                        resp_headers["Trace-Id"] = tid
+                    if opaque:
+                        resp_headers["X-Opaque-Id"] = opaque
+            return out
         except RemoteTransportError as e:
             status, payload = _error_payload(_remote_error(e))
             return status, JSON_CT, json.dumps(payload).encode()
@@ -728,8 +754,10 @@ class ClusterRestService:
 
     def _local(self, method, path, query, body):
         self._pending_ack_seq_tls.value = None
+        hdrs = getattr(self._incoming_headers_tls, "value", None)
         with self.lock:
-            out = self.api.handle(method, path, query, body)
+            out = self.api.handle(method, path, query, body,
+                                  headers=hdrs)
         pending = getattr(self._pending_ack_seq_tls, "value", None)
         if pending:
             self._pending_ack_seq_tls.value = None

@@ -346,7 +346,10 @@ class IndexService:
         # for any body containing those letters ("snow", "know", ...).
         if body.get("search_after") is not None or \
                 body.get("min_score") is not None or \
-                body.get("script_fields") or body.get("runtime_mappings"):
+                body.get("script_fields") or body.get("runtime_mappings") \
+                or body.get("profile"):
+            # profiled bodies ride the plane but are never cached: a
+            # cached profile would replay stale stage timings
             return None
         from ..search.plane_route import body_eligible, extract_bag_of_terms
         if not body_eligible(body):
@@ -409,11 +412,21 @@ class IndexService:
         entry = {"level": worst, "took_ms": round(took_s * 1e3, 3),
                  "index": self.name, "kind": kind, "source": detail,
                  "timestamp": time.time()}
+        # request correlation (reference: SearchSlowLog stamps
+        # X-Opaque-Id and the APM trace.id into every slow-log line)
+        from ..common import tracing as _tracing
+        tid = _tracing.current_trace_id()
+        if tid:
+            entry["trace.id"] = tid
+        opaque = _tracing.current_opaque_id()
+        if opaque:
+            entry["x_opaque_id"] = opaque
         if stages:
             # plane-served queries: which pipeline stage ate the time
             # (queue wait / host prep / device dispatch / fetch)
             entry["serving_stages"] = {
-                s: round(ms, 3) for s, ms in stages.items()}
+                s: (round(ms, 3) if isinstance(ms, (int, float)) else ms)
+                for s, ms in stages.items()}
         self.slow_log.append(entry)
         del self.slow_log[: -self.SLOWLOG_MAX]
         try:
@@ -427,6 +440,17 @@ class IndexService:
 
     def search(self, body: Optional[dict] = None,
                request_cache: Optional[bool] = None) -> ShardSearchResult:
+        """One index's query execution. When a trace is active (REST
+        requests), the whole shard-level phase records as a span under
+        the coordinator's — the ``GET /_trace/{id}`` tree's shard tier."""
+        from ..common import tracing as _tracing
+        with _tracing.span(f"shards[{self.name}]",
+                           attrs={"index": self.name,
+                                  "shards": self.num_shards}):
+            return self._search_traced(body, request_cache)
+
+    def _search_traced(self, body: Optional[dict],
+                       request_cache: Optional[bool]) -> ShardSearchResult:
         self._check_open()
         t0 = time.perf_counter()
         if self.cluster_hooks is not None:

@@ -758,9 +758,22 @@ class DistributedSearchPlane:
             jax.block_until_ready(out)
         t2 = time.perf_counter()
         self.n_dispatches += 1
+        from ..common import telemetry as _tm
+        if stages is not None:
+            # per-dispatch compile-cache verdict: profile's serving
+            # section distinguishes a first-shape compile from steady state
+            stages["compile_cache"] = (
+                "miss" if _tm.last_call_compiled() else "hit")
         vals, gdocs = out[0], out[1]
         vals = np.asarray(vals)[:B]          # drop replica-padding slots
         gdocs = np.asarray(gdocs)[:B]
+        # device-transfer accounting: the per-dispatch uploads (corpus
+        # arrays are resident and excluded) + the fetched result rows
+        _tm.record_transfer(
+            h2d_bytes=starts.nbytes + lengths.nbytes + idfw.nbytes +
+            (rid_slots.nbytes + dense_w.nbytes + W.nbytes + u_ids.nbytes
+             if use_tiered else 0),
+            d2h_bytes=vals.nbytes + gdocs.nbytes)
         hits = []
         for bi in range(B):
             row = []
@@ -857,10 +870,11 @@ class DistributedSearchPlane:
         self.n_dispatches += 1
         if stages is not None:
             # host path: scoring IS the dispatch (no separate upload or
-            # device sync to attribute)
+            # device sync to attribute); nothing compiles here
             stages["prep_ms"] = 0.0
             stages["dispatch_ms"] = (time.perf_counter() - t0) * 1e3
             stages["fetch_ms"] = 0.0
+            stages["compile_cache"] = "host"
         if with_totals:
             return vals_out, hits_out, totals
         return vals_out, hits_out
@@ -880,6 +894,11 @@ class DistributedSearchPlane:
                     fn = build_bm25_topk_step(
                         self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
                         n_shards=self.n_shards, with_count=with_count)
+                # telemetry: each new input-shape signature through the
+                # jitted step is one XLA compile — counted per shape so
+                # compile churn is attributable (common/telemetry.py)
+                from ..common.telemetry import instrument_step
+                fn = instrument_step(fn, site="text_plane")
                 self._steps[key] = fn
         return fn
 
@@ -979,6 +998,8 @@ class DistributedKnnPlane:
                     self.mesh, n_pad=self.n_pad, dim=max(self.dim, 1), k=k,
                     n_shards=self.n_shards, similarity=self.similarity,
                     block=self.block)
+                from ..common.telemetry import instrument_step
+                fn = instrument_step(fn, site="knn_plane")
                 self._steps[k] = fn
             return fn
 
@@ -1012,13 +1033,18 @@ class DistributedKnnPlane:
         t2 = time.perf_counter()
         vals, gdocs = out
         self.n_dispatches += 1
+        from ..common import telemetry as _tm
+        compiled = _tm.last_call_compiled()
         vals = np.asarray(vals)[:B]
         gdocs = np.asarray(gdocs)[:B]
+        _tm.record_transfer(h2d_bytes=q.nbytes,
+                            d2h_bytes=vals.nbytes + gdocs.nbytes)
         hits = self._decode_hits(vals, gdocs)
         if stages is not None:
             stages["prep_ms"] = (t1 - t0) * 1e3
             stages["dispatch_ms"] = (t2 - t1) * 1e3
             stages["fetch_ms"] = (time.perf_counter() - t2) * 1e3
+            stages["compile_cache"] = "miss" if compiled else "hit"
         return vals, hits
 
     def _decode_hits(self, vals, gdocs):
@@ -1123,4 +1149,5 @@ class DistributedKnnPlane:
             stages["prep_ms"] = 0.0
             stages["dispatch_ms"] = (time.perf_counter() - t0) * 1e3
             stages["fetch_ms"] = 0.0
+            stages["compile_cache"] = "host"
         return best_v, self._decode_hits(best_v, best_g)

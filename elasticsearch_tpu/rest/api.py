@@ -57,6 +57,60 @@ def _indexing_pressure():
     return DEFAULT
 
 
+def _device_stats() -> dict:
+    """The nodes-stats ``device`` section (common/telemetry.py)."""
+    from ..common.telemetry import device_stats_doc
+    return device_stats_doc()
+
+
+def _node_telemetry_families(api) -> dict:
+    """This node's contribution to the process telemetry registry —
+    plane-serving counters, running tasks, adaptive selection — as
+    Prometheus-shaped families (registered weakly in RestAPI.__init__,
+    rendered by /_prometheus/metrics and /_nodes/telemetry)."""
+    lbl = {"node": api.node_name}
+    ps = api._plane_serving_rollup()
+    fams = {
+        "es_plane_serving_dispatches_total": {
+            "type": "counter", "help": "micro-batch device dispatches",
+            "samples": [(lbl, ps["dispatches"])]},
+        "es_plane_serving_queries_total": {
+            "type": "counter", "samples": [(lbl, ps["queries"])]},
+        "es_plane_serving_deduped_queries_total": {
+            "type": "counter", "samples": [(lbl, ps["deduped_queries"])]},
+        "es_plane_serving_max_batch": {
+            "type": "gauge", "samples": [(lbl, ps["max_batch"])]},
+        "es_plane_serving_cache_hits_total": {
+            "type": "counter", "samples": [(lbl, ps["cache_hit_count"])]},
+        "es_plane_serving_cache_misses_total": {
+            "type": "counter",
+            "samples": [(lbl, ps["cache_miss_count"])]},
+        "es_plane_serving_warmed_shapes_total": {
+            "type": "counter", "samples": [(lbl, ps["warmed_shapes"])]},
+        "es_plane_serving_stage_millis_total": {
+            "type": "counter",
+            "help": "per-stage serving-pipeline milliseconds",
+            "samples": [
+                (dict(lbl, stage=s), ps[f"{s}_time_in_millis"])
+                for s in ("queue", "prep", "dispatch", "fetch")]},
+        "es_tasks_running": {
+            "type": "gauge", "help": "registered live tasks",
+            "samples": [(lbl, len(api.task_manager.tasks))]},
+    }
+    if api.adaptive_selection_provider:
+        try:
+            ars = api.adaptive_selection_provider()
+        except Exception:   # noqa: BLE001 — cluster seam gone: skip
+            ars = {}
+        if ars:
+            fams["es_adaptive_selection_response_seconds"] = {
+                "type": "gauge",
+                "samples": [(dict(lbl, target=n),
+                             rec["avg_response_time_ns"] / 1e9)
+                            for n, rec in ars.items()]}
+    return fams
+
+
 def _os_stats() -> dict:
     """Real host memory/load figures (reference: ``monitor/os/OsProbe``;
     /proc is authoritative on this platform — no psutil dependency)."""
@@ -298,6 +352,16 @@ class RestAPI:
         from ..node.task_manager import TaskManager
         self.task_manager = TaskManager(self.node_id, self.node_name)
         self._req_task = threading.local()
+        #: (trace_id, x_opaque_id) of the last request on this thread —
+        #: handle() echoes them as response headers (reference:
+        #: X-Opaque-Id echo + APM trace.id)
+        self._trace_tls = threading.local()
+        # node-scoped telemetry producers register against the process
+        # registry via weakref (pruned when this API is collected):
+        # plane serving rollup, running tasks, adaptive selection
+        from ..common import telemetry as _telemetry
+        _telemetry.DEFAULT.register_object_collector(
+            f"node:{self.node_id}", self, _node_telemetry_families)
         self.stored_scripts: Dict[str, dict] = {}
         self.ingest = IngestService()
         self.snapshots = SnapshotsService(indices)
@@ -580,6 +644,9 @@ class RestAPI:
         add("POST", "/_nodes/{node_id}/reload_secure_settings",
             self.h_reload_secure_settings)
         add("PUT", "/{index}/_block/{block}", self.h_add_block)
+        add("GET", "/_nodes/telemetry", self.h_nodes_telemetry)
+        add("GET", "/_prometheus/metrics", self.h_prometheus)
+        add("GET", "/_trace/{trace_id}", self.h_trace_get)
         add("GET", "/_nodes/stats", self.h_nodes_stats)
         add("GET", "/_nodes/stats/{metric}", self.h_nodes_stats)
         add("GET", "/_nodes/stats/{metric}/{index_metric}",
@@ -788,10 +855,18 @@ class RestAPI:
 
     def handle(self, method: str, path: str, query: str,
                body: bytes,
-               headers: Optional[dict] = None) -> Tuple[int, str, bytes]:
+               headers: Optional[dict] = None,
+               resp_headers: Optional[dict] = None) \
+            -> Tuple[int, str, bytes]:
         """Entry: x-content negotiation around the JSON-native core
         (reference: ``RestController.dispatchRequest`` resolving
-        ``XContentType`` from Content-Type/Accept — libs/x-content)."""
+        ``XContentType`` from Content-Type/Accept — libs/x-content).
+
+        ``resp_headers``: optional out-param dict — receives the echoed
+        ``X-Opaque-Id`` and the request's ``Trace-Id`` (reference: the
+        opaque id is echoed on every response; the trace id is the
+        ``GET /_trace/{id}`` handle)."""
+        self._trace_tls.value = None
         accept = None
         if headers:
             hmap = {k.lower(): v for k, v in headers.items()}
@@ -810,6 +885,14 @@ class RestAPI:
                             json.dumps(payload).encode())
         status, out_ct, payload = self._handle_json(
             method, path, query, body, headers)
+        if resp_headers is not None:
+            info = getattr(self._trace_tls, "value", None)
+            if info:
+                tid, opaque = info
+                if tid:
+                    resp_headers["Trace-Id"] = tid
+                if opaque:
+                    resp_headers["X-Opaque-Id"] = opaque
         if accept and payload:
             from ..common.xcontent import (UnsupportedContentType,
                                            encode_response)
@@ -878,24 +961,51 @@ class RestAPI:
             kwargs = {k: (unquote(v) if v is not None else v)
                       for k, v in zip(names, match.groups())}
             # every request runs as a registered task for its lifetime
-            # (reference: TaskManager.java:76 registers every action)
-            headers = {}
-            if params.get("__x_opaque_id"):
-                headers["X-Opaque-Id"] = params["__x_opaque_id"]
-            task = self.task_manager.register(
-                _action_name(method, path), description=f"{method} {path}",
-                headers=headers)
-            self._req_task.task = task
+            # (reference: TaskManager.java:76 registers every action) and
+            # inside a traced root span: the trace id is minted here — or
+            # adopted from an incoming traceparent/trace.id header — and
+            # follows the request through coordinator → shard fan-out →
+            # microbatch dispatch (common/tracing.py)
+            from ..common import tracing as _tracing
+            hmap2 = {str(k).lower(): v for k, v in (headers or {}).items()}
+            opaque = params.get("__x_opaque_id") or \
+                hmap2.get("x-opaque-id")
+            action = _action_name(method, path)
+            desc = f"{method} {path}"
+            if opaque:
+                desc += f" [x-opaque-id={opaque}]"
+            _op_token = _tracing.set_opaque_id(opaque)
             try:
-                result = fn(params, body, **kwargs)
-            except Exception as e:  # noqa: BLE001 — ES-shaped error replies
-                status, payload = _error_payload(e)
-                return status, JSON_CT, json.dumps(payload).encode()
+                with _tracing.span(f"rest[{action}]", node=self.node_id,
+                                   headers=headers, root=True,
+                                   attrs={"action": action}) as sp:
+                    task_headers = {"trace.id": sp.trace_id}
+                    if opaque:
+                        task_headers["X-Opaque-Id"] = opaque
+                    self._trace_tls.value = (sp.trace_id, opaque)
+                    task = self.task_manager.register(
+                        action,
+                        description=desc + f" [trace.id={sp.trace_id}]",
+                        headers=task_headers)
+                    self._req_task.task = task
+                    try:
+                        result = fn(params, body, **kwargs)
+                    except Exception as e:  # noqa: BLE001 — ES-shaped
+                        sp.attrs["error"] = type(e).__name__
+                        status, payload = _error_payload(e)
+                        return status, JSON_CT, \
+                            json.dumps(payload).encode()
+                    finally:
+                        self._req_task.task = None
+                        if task.running and \
+                                not getattr(task, "async_detached", False):
+                            self.task_manager.unregister(task)
+                        # internal re-dispatches (monitoring fetch, SQL
+                        # seams) overwrite the echo stash — the OUTER
+                        # request's pair must win
+                        self._trace_tls.value = (sp.trace_id, opaque)
             finally:
-                self._req_task.task = None
-                if task.running and \
-                        not getattr(task, "async_detached", False):
-                    self.task_manager.unregister(task)
+                _tracing._OPAQUE.reset(_op_token)
             if isinstance(result, tuple) and len(result) == 3:
                 # (status, content_type, str|bytes) — non-JSON bodies
                 # (SQL txt/csv/tsv, hot_threads text) pick their own type
@@ -1707,11 +1817,13 @@ class RestAPI:
                 "cluster_name": self.cluster_name,
                 "nodes": {self.node_id: info}}
 
-    #: nodes.stats sections (reference: NodesStatsRequest.Metric)
+    #: nodes.stats sections (reference: NodesStatsRequest.Metric; "device"
+    #: is the TPU-native extension — XLA compiles, transfer bytes,
+    #: device-memory watermarks)
     NODES_STATS_METRICS = ("indices", "os", "process", "jvm", "thread_pool",
                            "fs", "transport", "http", "breaker", "script",
                            "discovery", "ingest", "adaptive_selection",
-                           "script_cache", "indexing_pressure")
+                           "script_cache", "indexing_pressure", "device")
 
     def h_nodes_stats(self, params, body, metric=None,
                       index_metric=None, node_id=None):
@@ -1807,6 +1919,7 @@ class RestAPI:
                                      "cache_evictions": 0,
                                      "compilation_limit_triggered": 0}},
             "indexing_pressure": _indexing_pressure().stats_doc(),
+            "device": _device_stats(),
         }
         node = {"timestamp": int(time.time() * 1000),
                 "name": self.node_name,
@@ -1821,6 +1934,62 @@ class RestAPI:
         return {"_nodes": {"total": 1, "successful": 1, "failed": 0},
                 "cluster_name": self.cluster_name,
                 "nodes": {self.node_id: node}}
+
+    # ------------------------------------------------------------------
+    # telemetry + tracing (common/telemetry.py, common/tracing.py)
+    # ------------------------------------------------------------------
+
+    def _plane_serving_rollup(self) -> dict:
+        """Node-level plane_serving rollup (cheap: batcher counters only,
+        no store walk)."""
+        from ..search.microbatch import empty_serving_stats
+        out = dict(empty_serving_stats(), cache_hit_count=0,
+                   cache_miss_count=0)
+        for svc in list(self.indices.indices.values()):
+            doc = svc.plane_serving_stats()
+            for k, v in doc.items():
+                out[k] = max(out.get(k, 0), v) if k == "max_batch" \
+                    else out.get(k, 0) + v
+        return out
+
+    def h_nodes_telemetry(self, params, body):
+        """GET /_nodes/telemetry: the full registry snapshot (counters /
+        gauges / histograms + collector families) plus node sections —
+        device/XLA instrumentation, plane serving, tasks, trace store."""
+        from ..common import telemetry, tracing
+        node = {
+            "name": self.node_name,
+            "timestamp": int(time.time() * 1000),
+            "registry": telemetry.DEFAULT.stats_doc(),
+            "device": telemetry.device_stats_doc(),
+            "plane_serving": self._plane_serving_rollup(),
+            "tasks": {"running": len(self.task_manager.tasks)},
+            "trace_store": tracing.DEFAULT_STORE.stats_doc(),
+        }
+        if self.adaptive_selection_provider:
+            node["adaptive_selection"] = self.adaptive_selection_provider()
+        return {"_nodes": {"total": 1, "successful": 1, "failed": 0},
+                "cluster_name": self.cluster_name,
+                "nodes": {self.node_id: node}}
+
+    def h_prometheus(self, params, body):
+        """GET /_prometheus/metrics: text exposition format 0.0.4 over
+        the same registry (node families contribute via collectors)."""
+        from ..common import telemetry
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                telemetry.DEFAULT.prometheus_text())
+
+    def h_trace_get(self, params, body, trace_id):
+        """GET /_trace/{trace_id}: the recorded span tree for one
+        request (REST edge → coordinator → shard fan-out → plane
+        dispatch)."""
+        from ..common.tracing import DEFAULT_STORE
+        doc = DEFAULT_STORE.get(trace_id)
+        if doc is None:
+            raise ResourceNotFoundError(
+                f"trace [{trace_id}] is not in the trace store (bounded "
+                f"ring of {DEFAULT_STORE.MAX_TRACES} traces)")
+        return doc
 
     # ------------------------------------------------------------------
     # cat
@@ -5735,6 +5904,17 @@ class RestAPI:
 
     def _search_indices(self, names: List[str], search_body: dict,
                         record_stats: bool = True) -> dict:
+        """Coordinator phase: fans the windowed body out per index and
+        merges — one traced span covering fan-out + reduce (the
+        coordinator tier of the ``GET /_trace/{id}`` span tree)."""
+        from ..common import tracing as _tracing
+        with _tracing.span("coordinator[search]", node=self.node_id,
+                           attrs={"indices": ",".join(names)}):
+            return self._search_indices_traced(names, search_body,
+                                               record_stats)
+
+    def _search_indices_traced(self, names: List[str], search_body: dict,
+                               record_stats: bool = True) -> dict:
         from ..search.dist_query import merge_sort_key
         from ..search.shard_search import normalize_sort
         t0 = time.time()

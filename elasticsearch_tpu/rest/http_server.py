@@ -31,7 +31,8 @@ _STATUS_TEXT = {200: "OK", 201: "Created", 400: "Bad Request",
 
 class HttpServer:
     """handler(method, path, query_string, body_bytes) →
-    (status, content_type, payload_bytes)."""
+    (status, content_type, payload_bytes) — or a 4-tuple with a trailing
+    extra-response-headers dict (X-Opaque-Id echo, Trace-Id)."""
 
     def __init__(self, handler: Callable, host: str = "127.0.0.1",
                  port: int = 9200, ssl_ctx=None,
@@ -80,9 +81,15 @@ class HttpServer:
                 # executor thread (cluster mode) shares it
                 from ..xpack.deprecation import begin_request
                 begin_request()
+                extra_headers = {}
                 try:
-                    status, ctype, payload = await self._dispatch(
+                    result = await self._dispatch(
                         method, path, query, body, headers)
+                    if len(result) == 4:
+                        status, ctype, payload, hx = result
+                        extra_headers.update(hx or {})
+                    else:
+                        status, ctype, payload = result
                 except HttpError as e:
                     status, ctype, payload = e.status, "application/json", \
                         json.dumps({"error": e.reason,
@@ -98,12 +105,21 @@ class HttpServer:
                 from ..xpack.deprecation import drain_warnings
                 warn_lines = "".join(f"Warning: {w}\r\n"
                                      for w in drain_warnings())
+                # CR/LF-sanitize before emission: X-Opaque-Id is
+                # client-controlled (and reaches here percent-decoded via
+                # the __x_opaque_id param), so raw reflection would allow
+                # response-header injection / response splitting
+                def _hsafe(s):
+                    return str(s).replace("\r", " ").replace("\n", " ")
+                extra_lines = "".join(
+                    f"{_hsafe(k)}: {_hsafe(v)}\r\n"
+                    for k, v in extra_headers.items())
                 head = (f"HTTP/1.1 {status} "
                         f"{_STATUS_TEXT.get(status, 'Unknown')}\r\n"
                         f"content-type: {ctype}\r\n"
                         f"content-length: {len(payload)}\r\n"
                         f"X-elastic-product: Elasticsearch\r\n"
-                        + warn_lines +
+                        + warn_lines + extra_lines +
                         f"connection: "
                         f"{'keep-alive' if keep_alive else 'close'}\r\n\r\n")
                 writer.write(head.encode() + (b"" if method == "HEAD"

@@ -74,7 +74,7 @@ def empty_serving_stats() -> Dict[str, int]:
 
 class _Slot:
     __slots__ = ("terms", "k", "done", "vals", "hits", "total", "error",
-                 "t_enq", "rounds_skipped", "stage_ms")
+                 "t_enq", "rounds_skipped", "stage_ms", "info")
 
     def __init__(self, terms, k: int):
         self.terms = terms
@@ -89,6 +89,9 @@ class _Slot:
         self.rounds_skipped = 0
         #: per-stage ms for THIS request, filled at fan-out
         self.stage_ms: Optional[Dict[str, float]] = None
+        #: dispatch metadata for THIS request (compile-cache hit/miss,
+        #: batch size) — the Profile API's ``serving`` section
+        self.info: Optional[Dict[str, object]] = None
 
 
 class PlaneMicroBatcher:
@@ -134,11 +137,14 @@ class PlaneMicroBatcher:
     # -- client entry -------------------------------------------------------
 
     def search(self, terms: Sequence[str], k: int,
-               stages: Optional[dict] = None):
+               stages: Optional[dict] = None,
+               info: Optional[dict] = None):
         """One query through the batched dispatch. Returns
         (scores[k], hits[(shard, doc)...], exact total). Blocks until the
         dispatch that carries this query completes. ``stages``, when a
-        dict, receives this request's per-stage ms timings."""
+        dict, receives this request's per-stage ms timings; ``info``
+        receives dispatch metadata (compile-cache hit/miss, batch size)
+        for the Profile API's serving section."""
         slot = _Slot(terms, k)
         with self._cond:
             self._queue.append(slot)
@@ -148,6 +154,8 @@ class PlaneMicroBatcher:
                 self._cond.wait()
         if stages is not None and slot.stage_ms is not None:
             stages.update(slot.stage_ms)
+        if info is not None and slot.info is not None:
+            info.update(slot.info)
         return self._result(slot)
 
     @staticmethod
@@ -287,10 +295,14 @@ class PlaneMicroBatcher:
         dispatch_ms = plane_stages.get(
             "dispatch_ms", (t_done - t_call) * 1e3)
         fetch_base_ms = plane_stages.get("fetch_ms", 0.0)
+        batch_info = {"batch_size": len(batch), "k_bucket": k,
+                      "compile_cache": plane_stages.get("compile_cache",
+                                                        "hit")}
         with self._cond:
             fetch_ms = fetch_base_ms + \
                 (time.perf_counter() - t_done) * 1e3
             for s in batch:
+                s.info = batch_info
                 s.stage_ms = {
                     "queue": (t_pick - s.t_enq) * 1e3, "prep": prep_ms,
                     "dispatch": dispatch_ms, "fetch": fetch_ms}
@@ -483,7 +495,8 @@ class KnnPlaneMicroBatcher(PlaneMicroBatcher):
 
 
 def batched_search(plane, terms: Sequence[str], k: int,
-                   stages: Optional[dict] = None):
+                   stages: Optional[dict] = None,
+                   info: Optional[dict] = None):
     """Module entry: route one query through the plane's micro-batcher
     (created lazily on first use; plane rebuilds get a fresh one)."""
     batcher = getattr(plane, "_microbatcher", None)
@@ -493,7 +506,7 @@ def batched_search(plane, terms: Sequence[str], k: int,
             if batcher is None:
                 batcher = PlaneMicroBatcher(plane)
                 plane._microbatcher = batcher
-    return batcher.search(terms, k, stages=stages)
+    return batcher.search(terms, k, stages=stages, info=info)
 
 
 def batched_knn_search(plane, query_vector, k: int):

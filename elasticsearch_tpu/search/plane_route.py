@@ -136,10 +136,15 @@ def extract_bag_of_terms(query_spec, mapper: MapperService) \
 
 
 #: request-body features the plane cannot serve (need per-doc masks or
-#: post-hoc reordering); shared by the single-shard and pooled dist routes
+#: post-hoc reordering); shared by the single-shard and pooled dist
+#: routes. ``profile`` is NOT here: profiled plane queries ride the real
+#: serving path and report a ``serving`` profile section (stage timings,
+#: compile-cache) — the Profile API must reflect production execution.
+#: (Profiled bodies still never enter the request cache:
+#: ``IndexService._plane_cache_key`` checks ``profile`` separately.)
 _PLANE_INCOMPATIBLE = ("aggs", "aggregations", "sort", "knn", "rescore",
                        "collapse", "suggest", "search_after", "min_score",
-                       "profile", "rank")
+                       "rank")
 
 
 def body_eligible(body: dict) -> bool:

@@ -383,18 +383,31 @@ class ShardSearcher:
         host_scores: Dict[int, np.ndarray] = {}
         need_host_mask = use_field_sort
         serving_stages: Optional[Dict[str, float]] = None
+        serving_info: Optional[Dict[str, object]] = None
         if plane_route is not None:
             plane, bag_terms = plane_route
             # concurrent eligible queries coalesce into one device dispatch
             # (search/microbatch.py — the search-thread-pool analog); the
-            # batcher stamps this request's per-stage pipeline timings
+            # batcher stamps this request's per-stage pipeline timings and
+            # dispatch metadata (compile-cache hit/miss, batch size)
             from .microbatch import batched_search
             serving_stages = {}
+            serving_info = {}
             pvals0, phits0, ptotal0 = batched_search(
-                plane, bag_terms, k=max(window, 1), stages=serving_stages)
+                plane, bag_terms, k=max(window, 1), stages=serving_stages,
+                info=serving_info)
             total = int(ptotal0)
             candidates = [(float(v), si, d)
                           for v, (si, d) in zip(pvals0, phits0)]
+            # trace: the micro-batch dispatch as one leaf span under the
+            # ambient shard span (stage timings arrive after the fact)
+            from ..common import tracing as _tracing
+            _tracing.record_point(
+                "plane_dispatch",
+                took_ms=sum(serving_stages.values()),
+                attrs={**{s: round(ms, 3)
+                          for s, ms in serving_stages.items()},
+                       **serving_info})
         else:
             for seg_idx, seg in enumerate(self.segments):
                 scores, mask = query.execute(self.ctx, seg)
@@ -664,7 +677,7 @@ class ShardSearcher:
             # per-request query-phase timing (search/profile/Profilers.java
             # — segment-level collectors folded into one query node)
             total_nanos = int((_time.perf_counter() - t_query0) * 1e9)
-            profile_out = {"shards": [{
+            shard_prof = {
                 "id": "[tpu][0]",
                 "searches": [{
                     "query": [{
@@ -680,7 +693,9 @@ class ShardSearcher:
                     }],
                     "rewrite_time": 0,
                     "collector": [{
-                        "name": "EagerDenseCollector",
+                        "name": ("PlaneMicroBatchCollector"
+                                 if serving_stages is not None
+                                 else "EagerDenseCollector"),
                         "reason": "search_top_hits",
                         "time_in_nanos": total_nanos,
                     }],
@@ -689,7 +704,16 @@ class ShardSearcher:
                     aggs or {}, agg_results, self.mapper, self.segments,
                     sum(int(np.asarray(m)[: seg.n_docs].sum())
                         for seg, m, _ in agg_pending)) if aggs else [],
-            }]}
+            }
+            if serving_stages is not None:
+                # the real plane path: per-stage pipeline timings + this
+                # dispatch's compile-cache verdict — the Profile API now
+                # reflects serving, not just host-side query rewriting
+                shard_prof["serving"] = {
+                    "stages_ms": {s: round(ms, 3)
+                                  for s, ms in serving_stages.items()},
+                    **(serving_info or {})}
+            profile_out = {"shards": [shard_prof]}
 
         return ShardSearchResult(total=total, total_relation=total_relation,
                                  hits=hits, max_score=max_score,

@@ -72,7 +72,25 @@ class MonitoringService:
                              "indices": nstats.get("indices"),
                              "jvm": nstats.get("jvm"),
                              "process": nstats.get("process"),
-                             "thread_pool": nstats.get("thread_pool")}})
+                             "thread_pool": nstats.get("thread_pool"),
+                             # TPU-native sections: serving pipeline +
+                             # device/XLA instrumentation must reach the
+                             # monitoring indices, not just live stats
+                             "plane_serving": (nstats.get("indices")
+                                               or {}).get("plane_serving"),
+                             "device": nstats.get("device")}})
+
+        # telemetry collector: the registry snapshot (compile counts,
+        # transfer bytes, breaker/pressure families) as its own doc type
+        telemetry = self.fetch("GET", "/_nodes/telemetry")
+        for node_id, tstats in (telemetry.get("nodes") or {}).items():
+            docs.append({"type": "node_telemetry",
+                         "node_telemetry": {
+                             "node_id": node_id,
+                             "device": tstats.get("device"),
+                             "plane_serving": tstats.get("plane_serving"),
+                             "registry": tstats.get("registry"),
+                             "tasks": tstats.get("tasks")}})
 
         stats = self.fetch("GET", "/_stats")
         for index, istats in (stats.get("indices") or {}).items():

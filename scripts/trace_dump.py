@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Pretty-print a span tree from a running node's trace store.
+
+Usage:
+    python scripts/trace_dump.py TRACE_ID [--host http://127.0.0.1:9200]
+    python scripts/trace_dump.py --last [--host ...]   # newest trace
+
+``--last`` issues a probe search first so there is always at least one
+trace, then dumps it (handy for eyeballing a node's span shape).
+
+Output, one line per span, indented by tree depth:
+
+    rest[indices:data/read/search]              12.41ms  node=n0
+      coordinator[search]                       11.80ms  indices=logs
+        shards[logs]                            11.02ms
+          plane_dispatch                         9.13ms  compile_cache=hit
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _get(host: str, path: str, headers=None):
+    req = urllib.request.Request(host.rstrip("/") + path,
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _fmt_attrs(span: dict) -> str:
+    parts = []
+    if span.get("node"):
+        parts.append(f"node={span['node']}")
+    for k, v in (span.get("attrs") or {}).items():
+        if isinstance(v, float):
+            v = round(v, 2)
+        parts.append(f"{k}={v}")
+    return "  ".join(parts)
+
+
+def print_tree(spans: list, depth: int = 0) -> None:
+    for span in spans:
+        name = "  " * depth + span.get("name", "?")
+        took = f"{span.get('took_ms', 0):9.2f}ms"
+        print(f"{name:<48}{took}  {_fmt_attrs(span)}".rstrip())
+        print_tree(span.get("children") or [], depth + 1)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace_id", nargs="?", help="trace id to dump")
+    ap.add_argument("--host", default="http://127.0.0.1:9200")
+    ap.add_argument("--last", action="store_true",
+                    help="probe-search the node and dump that trace")
+    ap.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the tree rendering")
+    args = ap.parse_args()
+    tid = args.trace_id
+    if args.last:
+        # any request mints a trace; its id comes back as a header
+        status, headers, _ = _get(args.host, "/")
+        tid = headers.get("Trace-Id")
+        if not tid:
+            print("node returned no Trace-Id header", file=sys.stderr)
+            return 2
+    if not tid:
+        ap.error("pass TRACE_ID or --last")
+    status, _headers, body = _get(args.host, f"/_trace/{tid}")
+    if status != 200:
+        print(f"GET /_trace/{tid} -> {status}: {body[:300]!r}",
+              file=sys.stderr)
+        return 1
+    doc = json.loads(body)
+    if args.json:
+        json.dump(doc, sys.stdout, indent=2)
+        print()
+        return 0
+    print(f"trace {doc['trace_id']} — {doc['span_count']} span(s)"
+          + (f", {doc['dropped_spans']} dropped"
+             if doc.get("dropped_spans") else ""))
+    print_tree(doc["tree"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
