@@ -27,7 +27,10 @@ Plus: the REST **serving** path under 32 concurrent clients through
 (``search/microbatch.py``), reporting serving p50/p99 + observed batch
 sizes — serving QPS and kernel QPS are different quantities and are
 reported separately. A B∈{1,4,16,64} dispatch-latency curve validates
-ROOFLINE.md's batching model.
+ROOFLINE.md's batching model. And **live_indexing_search**: search
+throughput under interleaved bulk-index + refresh traffic, delta-tier
+generations vs the legacy rebuild-every-refresh behavior (zero
+synchronous request-thread repacks is the acceptance invariant).
 
 ``vs_baseline`` is device QPS / CPU-reference QPS; every CPU reference is
 the same algorithm honestly tuned for numpy (standing in for Lucene's
@@ -89,6 +92,44 @@ _PROBE_SRC = (
     "import jax; d = jax.devices(); print(d[0].platform, len(d), flush=True)"
 )
 
+#: on-disk probe verdict (BENCH_r05 paid 3×120 s of timed-out probes
+#: EVERY run): the verdict is a per-machine fact, so it caches to a file
+#: next to the bench. A success verdict is trusted until the file is
+#: deleted; a failure verdict expires after BENCH_PROBE_CACHE_TTL
+#: seconds (default 24 h — tunnels come and go) and
+#: BENCH_PROBE_REFRESH=1 forces a fresh probe either way.
+PROBE_CACHE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".bench_probe_cache.json")
+PROBE_CACHE_FAIL_TTL_S = int(os.environ.get("BENCH_PROBE_CACHE_TTL",
+                                            24 * 3600))
+
+
+def _probe_cache_read() -> str | None:
+    """Cached platform string, "" for a cached (unexpired) failure, or
+    None when there is no usable cache entry."""
+    if os.environ.get("BENCH_PROBE_REFRESH"):
+        return None
+    try:
+        with open(PROBE_CACHE_PATH) as f:
+            doc = json.load(f)
+        plat = doc.get("platform", None)
+        if plat:
+            return str(plat)
+        if plat == "" and time.time() - float(doc.get("ts", 0)) \
+                < PROBE_CACHE_FAIL_TTL_S:
+            return ""
+    except (OSError, ValueError, TypeError):
+        pass
+    return None
+
+
+def _probe_cache_write(platform: str) -> None:
+    try:
+        with open(PROBE_CACHE_PATH, "w") as f:
+            json.dump({"platform": platform, "ts": time.time()}, f)
+    except OSError:
+        pass
+
 
 PROBE_LOG: list = []          # every attempt's outcome, emitted in the JSON
 
@@ -98,7 +139,16 @@ def _probe_backend(attempts: int = 3, stagger_s: int = 15) -> str | None:
     timeout per attempt and a stagger between attempts (the tunnel hang is
     intermittent across rounds: r01 threw, r02/r03 hung — an init that
     fails now may succeed seconds later). Returns the platform string or
-    None; every attempt's outcome lands in PROBE_LOG for the final JSON."""
+    None; every attempt's outcome lands in PROBE_LOG for the final JSON.
+    The verdict caches to PROBE_CACHE_PATH so the worst case (3 timed-out
+    probes = 6+ minutes) is paid once per machine, not once per run."""
+    cached = _probe_cache_read()
+    if cached is not None:
+        PROBE_LOG.append(f"cached:{cached or 'none'}")
+        print(f"# backend probe: cached verdict "
+              f"[{cached or 'no backend'}] from {PROBE_CACHE_PATH}",
+              file=sys.stderr)
+        return cached or None
     for i in range(attempts):
         if i:
             time.sleep(stagger_s)
@@ -111,6 +161,7 @@ def _probe_backend(attempts: int = 3, stagger_s: int = 15) -> str | None:
                 plat, ndev = r.stdout.split()[:2]
                 print(f"# backend probe: {plat} x{ndev}", file=sys.stderr)
                 PROBE_LOG.append(f"ok:{plat}x{ndev}")
+                _probe_cache_write(plat)
                 return plat
             PROBE_LOG.append(f"rc={r.returncode}")
             print(f"# backend probe attempt {i + 1}/{attempts} rc="
@@ -120,6 +171,7 @@ def _probe_backend(attempts: int = 3, stagger_s: int = 15) -> str | None:
             PROBE_LOG.append(f"timeout{PROBE_TIMEOUT_S}s")
             print(f"# backend probe attempt {i + 1}/{attempts} timed out "
                   f"after {PROBE_TIMEOUT_S}s (hung init)", file=sys.stderr)
+    _probe_cache_write("")          # failure verdict, TTL-bounded
     return None
 
 
@@ -642,9 +694,8 @@ def bench_serving(rng):
 
     def _batchers():
         out = []
-        for _f, (_sig, plane) in getattr(svc.plane_cache, "_planes",
-                                         {}).items():
-            b = getattr(plane, "_microbatcher", None)
+        for gen in getattr(svc.plane_cache, "_planes", {}).values():
+            b = getattr(gen, "_microbatcher", None)
             if b is not None:
                 out.append(b)
         return out
@@ -718,6 +769,133 @@ def bench_serving(rng):
         "microbatch": batch_stats,
         "telemetry": _telemetry_snapshot()})
 
+
+
+def bench_live_indexing(rng):
+    """Live-indexing serving (the ROADMAP's logs/metrics NRT scenario):
+    16 client threads search through ``RestAPI.handle`` while an indexer
+    thread continuously bulk-indexes + refreshes — every refresh changes
+    the segment list. Two windows, same harness style as
+    ``rest_serving_32_clients``:
+
+    - ``delta`` (default): incremental generations — appends ride the
+      delta tier, repacks happen in the background. The acceptance
+      invariant is ``request_thread_repacks == 0`` while the delta stays
+      under threshold (the cold build is excluded).
+    - ``rebuild_every_refresh``: the pre-generation behavior
+      (``delta_enabled=False``) — every refresh forces a synchronous
+      full repack on the next search's request thread.
+
+    ``vs_rebuild_every_refresh`` is the headline ratio."""
+    import tempfile
+    import threading
+    from elasticsearch_tpu.node.indices_service import IndicesService
+    from elasticsearch_tpu.rest.api import RestAPI
+    n_clients, per_client, n_seed = 16, 40, 16384
+    vocab = [f"w{i}" for i in range(64)]
+    out = {}
+    for mode in ("delta", "rebuild_every_refresh"):
+        api = RestAPI(IndicesService(
+            tempfile.mkdtemp(prefix=f"bench_live_{mode}_")))
+        lines = []
+        for i in range(n_seed):
+            body = " ".join(vocab[(i * 7 + j * 3) % 64] for j in range(8))
+            lines.append(json.dumps({"index": {"_id": str(i)}}))
+            lines.append(json.dumps({"body": body}))
+        api.handle("POST", "/live/_bulk", "refresh=true",
+                   ("\n".join(lines) + "\n").encode())
+        svc = api.indices.get("live")
+        cache = svc.plane_cache
+        cache.delta_enabled = (mode == "delta")
+        # cold build outside the window (both modes pay it once)
+        api.handle("POST", "/live/_search", "request_cache=false",
+                   json.dumps({"query": {"match": {"body": "w3"}}}
+                              ).encode())
+        rb0 = cache.rebuild_stats()
+        refreshes0 = sum(s.stats.get("refresh_total", 0)
+                         for s in svc.shards)
+        stop = threading.Event()
+        next_id = [n_seed]
+
+        id_lock = threading.Lock()
+
+        def indexer():
+            while not stop.is_set():
+                blines = []
+                with id_lock:
+                    lo = next_id[0]
+                    next_id[0] += 8
+                for i in range(lo, lo + 8):
+                    body = " ".join(vocab[(i * 5 + j) % 64]
+                                    for j in range(8))
+                    blines.append(json.dumps({"index": {"_id": str(i)}}))
+                    blines.append(json.dumps({"body": body}))
+                api.handle("POST", "/live/_bulk", "refresh=true",
+                           ("\n".join(blines) + "\n").encode())
+
+        indexers = [threading.Thread(target=indexer, daemon=True)
+                    for _ in range(2)]
+        for ix in indexers:
+            ix.start()
+        lat, errs = [], []
+        lock = threading.Lock()
+
+        def client(tid):
+            try:
+                for j in range(per_client):
+                    q = {"query": {"match": {
+                        "body": vocab[(tid * per_client + j) % 64]}}}
+                    t0 = time.perf_counter()
+                    st, _ct, payload = api.handle(
+                        "POST", "/live/_search", "request_cache=false",
+                        json.dumps(q).encode())
+                    dt = time.perf_counter() - t0
+                    doc = json.loads(payload)
+                    assert st == 200 and \
+                        doc["hits"]["total"]["value"] > 0
+                    with lock:
+                        lat.append(dt)
+            except Exception as e:                 # noqa: BLE001
+                with lock:
+                    errs.append(repr(e))
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        stop.set()
+        for ix in indexers:
+            ix.join(timeout=30)
+        cache.drain_repacks()
+        if errs:
+            raise SystemExit(f"live-indexing bench errors: {errs[:3]}")
+        rb = cache.rebuild_stats()
+        a = np.asarray(lat)
+        out[mode] = {
+            "qps": round(len(a) / wall, 1),
+            "p50_ms": round(float(np.percentile(a, 50) * 1e3), 2),
+            "p99_ms": round(float(np.percentile(a, 99) * 1e3), 2),
+            "n_requests": int(len(a)),
+            "refreshes_in_window": int(
+                sum(s.stats.get("refresh_total", 0)
+                    for s in svc.shards) - refreshes0),
+            # synchronous full repacks paid ON a request thread in the
+            # window (delta mode: must be 0 — cold build is excluded)
+            "request_thread_repacks": rb["sync"] - rb0["sync"],
+            "background_repacks": rb["background"] - rb0["background"],
+            "delta_served_queries": rb["delta_serves"]
+            - rb0["delta_serves"],
+        }
+    ratio = out["delta"]["qps"] / max(out["rebuild_every_refresh"]["qps"],
+                                      1e-9)
+    return _emit("live_indexing_search", {
+        "value": out["delta"]["qps"], "unit": "requests/s",
+        "vs_rebuild_every_refresh": round(ratio, 2),
+        "n_clients": n_clients, **out})
 
 
 def workload_L(plane, batches, Q=None):
@@ -879,6 +1057,7 @@ def main(mode: str = "accel"):
     run("knn", bench_knn, rng, mesh, on_cpu)
     run("hybrid_rrf", bench_hybrid_rrf, rng, mesh, on_cpu)
     run("serving", bench_serving, rng)
+    run("live_indexing", bench_live_indexing, rng)
 
     doc = {
         "metric": f"bm25_topk_qps_{n_docs}_docs_uncapped_df",

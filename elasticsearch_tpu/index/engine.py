@@ -116,7 +116,13 @@ class Engine:
         self.version_map: Dict[str, VersionValue] = {}
         self.tracker = LocalCheckpointTracker()
         self._buffer: SegmentBuilder = None  # type: ignore
-        self._refresh_listeners: List = []
+        #: callables invoked after every refresh/merge that changed the
+        #: searchable segment list (reference: ``ReferenceManager.
+        #: RefreshListener``). The serving layer uses this to reconcile
+        #: its plane generations — delta packs and background repacks
+        #: start at refresh time instead of on the first search to
+        #: notice a signature miss. Listeners must not throw.
+        self.refresh_listeners: List = []
         self.stats = {"index_total": 0, "delete_total": 0, "refresh_total": 0,
                       "flush_total": 0, "merge_total": 0, "get_total": 0}
         #: optional () -> int returning the lowest seq-no that must stay in
@@ -484,6 +490,13 @@ class Engine:
         # builder's buffer locals, merge by enumerating the result)
         return builder.build()
 
+    def _notify_refresh_listeners(self) -> None:
+        for fn in list(self.refresh_listeners):
+            try:
+                fn()
+            except Exception:   # noqa: BLE001 — a broken listener must
+                pass            # never fail the refresh itself
+
     def refresh(self) -> bool:
         """Freeze the buffer into a searchable device segment (NRT refresh;
         reference: ``InternalEngine.refresh`` dual ReaderManager swap)."""
@@ -492,6 +505,7 @@ class Engine:
             if applied_deletes:
                 self.stats["refresh_total"] += 1
                 self.maybe_merge()
+                self._notify_refresh_listeners()
             return applied_deletes
         builder = self._buffer
         self._new_buffer()
@@ -511,6 +525,7 @@ class Engine:
                 vv.source = None  # now served from segment store
         self.stats["refresh_total"] += 1
         self.maybe_merge()
+        self._notify_refresh_listeners()
         return True
 
     def flush(self) -> None:
@@ -619,7 +634,13 @@ class Engine:
         if len(live_segments) <= 1 and all(
                 s.live_count == s.n_docs for s in live_segments):
             return False
-        return self._merge(list(self.segments))
+        merged = self._merge(list(self.segments))
+        if merged:
+            # the segment list was restructured below any refresh: the
+            # serving layer must see it (its base planes decode hits
+            # against segments that no longer exist)
+            self._notify_refresh_listeners()
+        return merged
 
     def _merge(self, to_merge: List[Segment]) -> bool:
         """Columnar merge (``store.merge_segments``): postings and doc

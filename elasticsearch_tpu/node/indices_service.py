@@ -127,8 +127,18 @@ class IndexService:
         self.request_cache_stats = {"hit_count": 0, "miss_count": 0}
         # plane-served slice of the request cache (identical plane-eligible
         # bodies served before the micro-batcher) — counted separately so
-        # the serving bench can attribute hits to this path
-        self.plane_cache_stats = {"hit_count": 0, "miss_count": 0}
+        # the serving bench can attribute hits to this path. The counters
+        # are telemetry-registry citizens: instance-owned Counter objects
+        # (fresh per index — exact per-index counts) exposed through the
+        # process registry via a weakref collector, like every other
+        # node-scoped producer; :attr:`plane_cache_stats` is the
+        # dict-shaped read view the stats/bench surfaces keep using.
+        from ..common import telemetry as _tm
+        self._plane_cache_counters = {"hit": _tm.Counter(),
+                                      "miss": _tm.Counter()}
+        _tm.DEFAULT.register_object_collector(
+            f"plane_cache_requests_{self.uuid}", self,
+            IndexService._plane_cache_requests_doc)
         # the plane path puts the concurrent serving hot path through this
         # cache: get's move_to_end racing put's eviction would KeyError
         self._cache_lock = threading.Lock()
@@ -140,11 +150,49 @@ class IndexService:
         # lazily built per text field, invalidated by segment-list changes
         from ..search.plane_route import ServingPlaneCache
         self.plane_cache = ServingPlaneCache()
+        # serving-plane refresh hook: every engine refresh/merge that
+        # changed the searchable segment list reconciles the plane
+        # generations immediately (delta pack / background repack start
+        # on the indexing thread), instead of the first search paying a
+        # signature miss
+        for sh in self.shards:
+            sh.refresh_listeners.append(self._on_shard_refresh)
         # cluster seam (node/cluster_rest.py): when set, per-shard doc ops
         # and whole-index search route through the cluster instead of the
         # local engines (which hold data only for locally-assigned shards).
         # None on the single-node path — zero behavior change.
         self.cluster_hooks = None
+
+    def _on_shard_refresh(self) -> None:
+        """Engine refresh listener → plane-generation reconcile. Text
+        generations serve the POOLED list; kNN generations may be keyed
+        per index shard (the distributed searcher probes one per shard),
+        so every candidate view is offered and each generation
+        reconciles against its best match."""
+        try:
+            shard_lists = [sh.searchable_segments() for sh in self.shards]
+            segments = [seg for lst in shard_lists for seg in lst]
+            knn_lists = list(shard_lists)
+            if len(shard_lists) > 1:
+                knn_lists.append(segments)     # pooled RRF probes
+            self.plane_cache.notify_refresh(segments, self.mapper,
+                                            knn_lists=knn_lists)
+        except Exception:   # noqa: BLE001 — reconcile is best-effort;
+            pass            # the query path re-reconciles on its own
+
+    def _plane_cache_requests_doc(self) -> dict:
+        return {"es_plane_cache_requests_total": {
+            "type": "counter",
+            "help": "plane-path request cache lookups by result",
+            "samples": [({"index": self.name, "result": r}, c.value)
+                        for r, c in self._plane_cache_counters.items()]}}
+
+    @property
+    def plane_cache_stats(self) -> Dict[str, int]:
+        """Dict view over the plane-path cache counters (kept for the
+        stats document / bench surfaces that predate the registry)."""
+        return {"hit_count": int(self._plane_cache_counters["hit"].value),
+                "miss_count": int(self._plane_cache_counters["miss"].value)}
 
     def record_search(self, groups: Optional[List[str]] = None) -> None:
         self.search_stats["query_total"] += 1
@@ -474,8 +522,7 @@ class IndexService:
             if plane_key is not None:
                 hit = self.cache_get(plane_key)
                 if hit is not None:
-                    with self._cache_lock:
-                        self.plane_cache_stats["hit_count"] += 1
+                    self._plane_cache_counters["hit"].inc()
                     return _copy_shard_result(hit)
         if self.num_shards > 1:
             r = self.dist_searcher().search(body or {})
@@ -484,8 +531,7 @@ class IndexService:
         if key is not None:
             self.cache_put(key, r)
         elif plane_key is not None:
-            with self._cache_lock:
-                self.plane_cache_stats["miss_count"] += 1
+            self._plane_cache_counters["miss"].inc()
             self.cache_put(plane_key, _copy_shard_result(r))
         self._slowlog_record("query", time.perf_counter() - t0,
                              str(body or {})[:1000],
@@ -587,19 +633,21 @@ class IndexService:
 
     def plane_serving_stats(self) -> dict:
         """Micro-batcher serving stats aggregated over this index's
-        planes (lexical + kNN), plus the plane-path cache counters — the
-        ``plane_serving`` nodes-stats section."""
+        serving generations (lexical + kNN), plus the plane-path cache
+        counters and the generation-maintenance rollup (rebuilds by mode,
+        delta-served queries) — the ``plane_serving`` nodes-stats
+        section."""
         from ..search.microbatch import empty_serving_stats
         out = empty_serving_stats()
         batchers = []
-        for _sig, plane in list(getattr(self.plane_cache, "_planes",
-                                        {}).values()):
-            b = getattr(plane, "_microbatcher", None)
+        for gen in list(getattr(self.plane_cache, "_planes",
+                                {}).values()):
+            b = getattr(gen, "_microbatcher", None)
             if b is not None:
                 batchers.append(b)
-        for plane in list(getattr(self.plane_cache, "_knn_planes",
-                                  {}).values()):
-            b = getattr(plane, "_microbatcher", None)
+        for gen in list(getattr(self.plane_cache, "_knn_planes",
+                                {}).values()):
+            b = getattr(gen, "_microbatcher", None)
             if b is not None:
                 batchers.append(b)
         for b in batchers:
@@ -608,6 +656,13 @@ class IndexService:
                 out[k] = max(out[k], v) if k == "max_batch" else out[k] + v
         out["cache_hit_count"] = self.plane_cache_stats["hit_count"]
         out["cache_miss_count"] = self.plane_cache_stats["miss_count"]
+        try:
+            rb = self.plane_cache.rebuild_stats()
+        except Exception:   # noqa: BLE001 — stats must never fail a node
+            rb = {}
+        out["rebuilds_sync"] = rb.get("sync", 0)
+        out["rebuilds_background"] = rb.get("background", 0)
+        out["delta_served_queries"] = rb.get("delta_serves", 0)
         return out
 
     def stats(self, with_field_bytes: bool = True) -> dict:
@@ -915,9 +970,12 @@ def empty_index_stats() -> Dict[str, Any]:
                      "earliest_last_modified_age": 0},
         "request_cache": dict(zero_cache),
         # serving-pipeline observability (search/microbatch.py): per-stage
-        # time totals + dispatch/coalescing counters + plane-path cache
+        # time totals + dispatch/coalescing counters + plane-path cache +
+        # generation maintenance (rebuild storms must be visible)
         "plane_serving": dict(_empty_serving_stats(),
-                              cache_hit_count=0, cache_miss_count=0),
+                              cache_hit_count=0, cache_miss_count=0,
+                              rebuilds_sync=0, rebuilds_background=0,
+                              delta_served_queries=0),
         "recovery": {"current_as_source": 0, "current_as_target": 0,
                      "throttle_time_in_millis": 0},
         "bulk": {"total_operations": 0, "total_time_in_millis": 0,

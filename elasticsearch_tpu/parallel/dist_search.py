@@ -529,10 +529,29 @@ class DistributedSearchPlane:
 
     # -- query assembly ------------------------------------------------------
 
-    def _lookup(self, queries: Sequence[Sequence[str]], Q: int):
+    def global_df(self, term: str) -> int:
+        """Document frequency of ``term`` summed over every plane shard —
+        the plane's contribution to global idf stats (the delta tier adds
+        its own df on top via the ``extra_df`` dispatch kwarg)."""
+        out = 0
+        for sh in self.shards:
+            tid = sh["term_ids"].get(term)
+            if tid is not None:
+                out += int(sh["df"][tid])
+        return out
+
+    def _lookup(self, queries: Sequence[Sequence[str]], Q: int,
+                extra_docs: int = 0,
+                extra_df: Optional[Dict[str, int]] = None):
         """Per-shard run/row lookup for a query batch. A term is scored by
         the sparse tier or the dense tier *per shard* (membership can differ
-        across shards); global idf always uses the original df stats."""
+        across shards); global idf always uses the original df stats.
+
+        ``extra_docs``/``extra_df``: corpus mass living OUTSIDE this plane
+        (the serving delta tier — segments appended since the base pack).
+        They only shift the host-side idf weights, so base and delta docs
+        are scored under ONE shared set of global statistics; compile
+        shapes are untouched."""
         B, S = len(queries), self.n_shards
         starts = np.zeros((B, S, Q), np.int32)
         lengths = np.zeros((B, S, Q), np.int32)
@@ -553,6 +572,8 @@ class DistributedSearchPlane:
                     continue
                 uniq[t] = qi
                 weights[bi, qi] = 1.0
+                if extra_df:
+                    gdf[bi, qi] += int(extra_df.get(t, 0))
                 for si, sh in enumerate(self.shards):
                     tid = sh["term_ids"].get(t)
                     if tid is None:
@@ -570,7 +591,8 @@ class DistributedSearchPlane:
                     starts[bi, si, qi] = st
                     lengths[bi, si, qi] = ln
                     max_len = max(max_len, ln)
-        idf = idf_weight(self.n_docs_total, gdf).astype(np.float32)
+        idf = idf_weight(self.n_docs_total + extra_docs,
+                         gdf).astype(np.float32)
         idf[gdf == 0] = 0.0
         idfw = idf * weights
         return (starts, lengths, idfw, dense_rid, dense_hit, max_len,
@@ -661,28 +683,34 @@ class DistributedSearchPlane:
 
     def serve(self, queries: Sequence[Sequence[str]], k: int = 10,
               *, with_totals: bool = False,
-              stages: Optional[dict] = None):
+              stages: Optional[dict] = None, extra_docs: int = 0,
+              extra_df: Optional[Dict[str, int]] = None):
         """Serving entry (the micro-batcher's dispatch hook): the
         CPU-native eager scorer when this plane was built on a CPU
         backend — term-at-a-time over precomputed impacts compiles
         nothing and beats XLA:CPU — else the jitted step at the stable
         serving shapes: ladder-rung L, Q floored to SERVING_Q_MIN, so
         live traffic only ever hits the pre-warmed (B, Q, L, k)
-        lattice."""
+        lattice. ``extra_docs``/``extra_df`` fold a delta tier's corpus
+        mass into the idf weights (see :meth:`_lookup`)."""
         if self._host_csr is not None:
             return self.search_eager(queries, k=k,
-                                     with_totals=with_totals, stages=stages)
+                                     with_totals=with_totals, stages=stages,
+                                     extra_docs=extra_docs,
+                                     extra_df=extra_df)
         L = self.ladder_L(self.max_run_len(queries))
         needed_q = max(max((len(set(q)) for q in queries), default=1), 1)
         Q = max(self.SERVING_Q_MIN, round_up_pow2(needed_q))
         return self.search(queries, k=k, Q=Q, L=L,
                            tiered=self.T_pad > 0 or None,
-                           with_totals=with_totals, stages=stages)
+                           with_totals=with_totals, stages=stages,
+                           extra_docs=extra_docs, extra_df=extra_df)
 
     def search(self, queries: Sequence[Sequence[str]], k: int = 10,
                *, Q: Optional[int] = None, L: Optional[int] = None,
                tiered: Optional[bool] = None, with_totals: bool = False,
-               stages: Optional[dict] = None):
+               stages: Optional[dict] = None, extra_docs: int = 0,
+               extra_df: Optional[Dict[str, int]] = None):
         """Run a batch of bag-of-terms queries. Returns
         (scores f32[B, k], hits list[list[(shard, local_doc)]]) — plus
         exact per-query match counts (list[int], the device-side
@@ -712,7 +740,8 @@ class DistributedSearchPlane:
                 f"Q={Q} would drop terms from a {needed_q}-term query; "
                 f"pass Q=None to size automatically")
         (starts, lengths, idfw, dense_rid, dense_hit, max_len,
-         any_dense) = self._lookup(queries, Q)
+         any_dense) = self._lookup(queries, Q, extra_docs=extra_docs,
+                                   extra_df=extra_df)
         if L is None:
             L = round_up_pow2(max_len)
         elif L < max_len:
@@ -793,7 +822,8 @@ class DistributedSearchPlane:
 
     def search_eager(self, queries: Sequence[Sequence[str]], k: int = 10,
                      *, with_totals: bool = False,
-                     stages: Optional[dict] = None):
+                     stages: Optional[dict] = None, extra_docs: int = 0,
+                     extra_df: Optional[Dict[str, int]] = None):
         """CPU-native serving path: term-at-a-time scatter-add over the
         original CSR with precomputed impacts, per shard, exact top-k with
         the kernel path's tie order (score desc, (shard, doc) asc).
@@ -819,14 +849,17 @@ class DistributedSearchPlane:
             weights: Dict[str, float] = {}
             for t in terms:
                 weights[t] = weights.get(t, 0.0) + 1.0
-            # global idf over the original df stats (same as _lookup)
+            # global idf over the original df stats (same as _lookup),
+            # plus any delta-tier mass living outside this plane
             idfw_of: Dict[str, float] = {}
             for t, w in weights.items():
                 gdf = sum(int(s2["df"][s2["term_ids"][t]])
                           for s2 in self.shards if t in s2["term_ids"])
+                if extra_df:
+                    gdf += int(extra_df.get(t, 0))
                 if gdf:
-                    idfw_of[t] = float(
-                        idf_weight(self.n_docs_total, np.int64(gdf))) * w
+                    idfw_of[t] = float(idf_weight(
+                        self.n_docs_total + extra_docs, np.int64(gdf))) * w
             cand_v: List[np.ndarray] = []
             cand_g: List[np.ndarray] = []
             total = 0
@@ -1151,3 +1184,196 @@ class DistributedKnnPlane:
             stages["fetch_ms"] = 0.0
             stages["compile_cache"] = "host"
         return best_v, self._decode_hits(best_v, best_g)
+
+
+# ---------------------------------------------------------------------------
+# Delta tier: eager scoring of segments appended since the last base pack
+# ---------------------------------------------------------------------------
+#
+# A refresh under live indexing appends small segments far faster than a
+# full plane repack (CSR pack + dense tier + device upload + warmup
+# lattice) can absorb them. The serving layer therefore splits each plane
+# into the packed BASE generation plus an append-only DELTA tier: delta
+# segments are scored eagerly per query — CSR scatter-add for BM25 (the
+# BM25S observation: eager sparse scoring is cheap at small corpus
+# sizes), a BLAS matmul for kNN — and merged into the base dispatch's
+# top-k. Both scorers keep the kernel path's exact tie order
+# (score desc, global segment asc, doc asc), so the merged ranking equals
+# a full repack's.
+
+
+def merge_topk_rows(base_rows, delta_rows, k: int):
+    """Merge two per-query candidate lists of ``(value, seg, doc)`` rows
+    into the global top-k with the plane's tie order (value desc, seg
+    asc, doc asc). Each side covers its own partition's top-k, so the
+    union's top-k is the exact global top-k."""
+    if not delta_rows:
+        return base_rows[:k]
+    if not base_rows:
+        return delta_rows[:k]
+    cat = list(base_rows) + list(delta_rows)
+    cat.sort(key=lambda r: (-r[0], r[1], r[2]))
+    return cat[:k]
+
+
+class EagerDeltaScorer:
+    """Append-only lexical delta tier: term-at-a-time scatter-add over
+    each delta segment's CSR with impacts precomputed ONCE at
+    construction (the same eager algorithm as
+    :meth:`DistributedSearchPlane.search_eager`).
+
+    ``shards``: one dict per delta segment with ``term_ids``, ``df``,
+    ``offsets``, ``docs``, ``tf``, ``doc_len`` (a field-less segment
+    passes empty postings but still contributes its doc count).
+    ``seg_positions``: each delta segment's index in the CURRENT
+    serving segment list — hits are emitted in that global space so the
+    merge with base hits preserves (segment, doc) tie order.
+    ``avgdl``: the owning generation's FROZEN length norm — the base
+    plane's impacts baked it at pack time, so the delta must score under
+    the same value or base and delta scores would live on different
+    scales (it refreshes at the next repack).
+
+    No breaker reservation: the only allocation is the impacts column,
+    O(delta postings) — the arrays otherwise alias the segments' own
+    host columns."""
+
+    def __init__(self, shards: Sequence[dict], seg_positions: Sequence[int],
+                 *, avgdl: float, k1: float = DEFAULT_K1,
+                 b: float = DEFAULT_B):
+        self.seg_positions = list(seg_positions)
+        self.avgdl = max(float(avgdl), 1e-9)
+        self.n_docs = 0
+        self._csr: List[dict] = []
+        for s in shards:
+            n = int(s["doc_len"].shape[0])
+            self.n_docs += n
+            self._csr.append(dict(
+                term_ids=s["term_ids"], df=s["df"], offsets=s["offsets"],
+                docs=s["docs"],
+                impacts=make_impacts(s["tf"], s["docs"], s["doc_len"],
+                                     self.avgdl, k1, b),
+                n_docs=n))
+
+    def df(self, term: str) -> int:
+        """Delta-tier document frequency of ``term`` — fed back into the
+        base dispatch as ``extra_df`` so both tiers share one idf."""
+        out = 0
+        for csr in self._csr:
+            tid = csr["term_ids"].get(term)
+            if tid is not None:
+                out += int(csr["df"][tid])
+        return out
+
+    def score(self, queries: Sequence[Sequence[str]], k: int, idf_of,
+              with_totals: bool = False):
+        """Score a query batch against the delta tier. ``idf_of(term)``
+        returns the COMBINED-stats idf (base + delta df over base + delta
+        docs) — the same value the base dispatch uses via ``extra_df``.
+        Returns (rows per query [(val, global_seg, doc)] sorted by the
+        merge order, totals per query)."""
+        rows_out: List[List[Tuple[float, int, int]]] = []
+        totals: List[int] = []
+        for terms in queries:
+            weights: Dict[str, float] = {}
+            for t in terms:
+                weights[t] = weights.get(t, 0.0) + 1.0
+            idfw_of = {t: idf_of(t) * w for t, w in weights.items()
+                       if idf_of(t) > 0.0}
+            rows: List[Tuple[float, int, int]] = []
+            total = 0
+            for gseg, csr in zip(self.seg_positions, self._csr):
+                scores = np.zeros(csr["n_docs"], np.float32)
+                matched = False
+                for t, idfw in idfw_of.items():
+                    tid = csr["term_ids"].get(t)
+                    if tid is None:
+                        continue
+                    st = int(csr["offsets"][tid])
+                    en = int(csr["offsets"][tid + 1])
+                    if en > st:
+                        scores[csr["docs"][st:en]] += \
+                            idfw * csr["impacts"][st:en]
+                        matched = True
+                if not matched:
+                    continue
+                if with_totals:
+                    total += int(np.count_nonzero(scores > 0))
+                kk = min(k, csr["n_docs"])
+                top = np.argpartition(-scores, kk - 1)[:kk]
+                sel = top[scores[top] > 0]
+                order = np.lexsort((sel, -scores[sel]))
+                sel = sel[order]
+                rows.extend((float(scores[d]), gseg, int(d)) for d in sel)
+            rows.sort(key=lambda r: (-r[0], r[1], r[2]))
+            rows_out.append(rows[:k])
+            totals.append(total)
+        return rows_out, totals
+
+
+class KnnDeltaScorer:
+    """Append-only vector delta tier: one BLAS matmul per delta segment
+    with the SAME pack-time corpus invariants as the device plane
+    (:func:`prepare_knn_corpus` — unit rows for cosine, cached ``‖v‖²``
+    for l2), producing raw similarities in the plane's convention so
+    merged scores are directly comparable. kNN has no corpus-wide
+    statistics, so the delta tier is exactly exact — no frozen-stat
+    window.
+
+    ``shards``: dicts with ``vectors`` f32[N, dim] and ``exists``
+    bool[N], one per delta segment; ``seg_positions`` as in
+    :class:`EagerDeltaScorer`."""
+
+    def __init__(self, shards: Sequence[dict], seg_positions: Sequence[int],
+                 *, similarity: str):
+        if similarity not in KNN_SIMILARITIES:
+            raise ValueError(f"unknown similarity [{similarity}]")
+        self.similarity = similarity
+        self.seg_positions = list(seg_positions)
+        self.n_docs = 0
+        self._packed: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        for s in shards:
+            v = np.asarray(s["vectors"], np.float32)
+            n = v.shape[0]
+            self.n_docs += n
+            ex = np.asarray(s.get("exists")) if s.get("exists") is not None \
+                else np.ones(n, bool)
+            vecs, vnorm2 = prepare_knn_corpus(v, similarity)
+            vecs = vecs.copy()
+            vecs[~ex] = 0.0
+            vnorm2 = vnorm2.copy()
+            vnorm2[~ex] = 0.0
+            self._packed.append((vecs, vnorm2, ex))
+
+    def score(self, query_vectors, k: int):
+        """Raw-similarity top-k of the delta tier for a query batch —
+        rows per query [(raw, global_seg, doc)] in merge order."""
+        q = np.asarray(query_vectors, np.float32)
+        B = q.shape[0]
+        l2 = self.similarity == "l2_norm"
+        if self.similarity == "cosine":
+            qq = q / np.maximum(
+                np.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+        else:
+            qq = q
+        qn = np.sum(q * q, axis=1) if l2 else None
+        rows_out: List[List[Tuple[float, int, int]]] = [[]
+                                                        for _ in range(B)]
+        for gseg, (vecs, vnorm2, ex) in zip(self.seg_positions,
+                                            self._packed):
+            if not ex.any() or vecs.shape[1] != q.shape[1]:
+                continue
+            s = qq @ vecs.T                              # [B, N] BLAS
+            if l2:
+                s = 2.0 * s - vnorm2[None, :] - qn[:, None]
+            if not ex.all():
+                s[:, ~ex] = NEG_INF
+            kk = min(k, s.shape[1])
+            for bi in range(B):
+                top = np.argpartition(-s[bi], kk - 1)[:kk]
+                sel = top[s[bi][top] > NEG_INF]
+                rows_out[bi].extend(
+                    (float(s[bi][d]), gseg, int(d)) for d in sel)
+        for bi in range(B):
+            rows_out[bi].sort(key=lambda r: (-r[0], r[1], r[2]))
+            rows_out[bi] = rows_out[bi][:k]
+        return rows_out

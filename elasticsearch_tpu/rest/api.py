@@ -78,6 +78,10 @@ def _node_telemetry_families(api) -> dict:
             "type": "counter", "samples": [(lbl, ps["queries"])]},
         "es_plane_serving_deduped_queries_total": {
             "type": "counter", "samples": [(lbl, ps["deduped_queries"])]},
+        "es_plane_serving_delta_queries_total": {
+            "type": "counter",
+            "help": "queries whose dispatch merged a live delta tier",
+            "samples": [(lbl, ps["delta_queries"])]},
         "es_plane_serving_max_batch": {
             "type": "gauge", "samples": [(lbl, ps["max_batch"])]},
         "es_plane_serving_cache_hits_total": {

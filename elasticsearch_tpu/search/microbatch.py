@@ -35,8 +35,12 @@ lattice (B-pow2 × k-bucket × L-rung) off the serving path at plane-build
 time — a first-hit XLA compile landing mid-traffic is the classic
 multi-second p99 signature.
 
-One batcher per plane (planes are per-(shard, field) and rebuilt on
-refresh); distinct planes dispatch concurrently.
+One batcher per serving GENERATION (``plane_route`` hands the batcher a
+generation object — packed base plane + append-only delta tier — whose
+``serve`` merges delta hits into the base dispatch; an append-only
+refresh swaps the delta inside the same generation, so the batcher and
+its warmed shapes survive, and only a background repack retires it);
+distinct generations dispatch concurrently.
 """
 
 from __future__ import annotations
@@ -66,6 +70,7 @@ def empty_serving_stats() -> Dict[str, int]:
         "dispatches": 0, "queries": 0, "max_batch": 0,
         "starved_dispatches": 0, "coalesced_dispatches": 0,
         "deduped_queries": 0,
+        "delta_queries": 0, "delta_time_in_millis": 0,
         "warmed_shapes": 0, "warmup_time_in_millis": 0,
         "queue_time_in_millis": 0, "prep_time_in_millis": 0,
         "dispatch_time_in_millis": 0, "fetch_time_in_millis": 0,
@@ -74,11 +79,21 @@ def empty_serving_stats() -> Dict[str, int]:
 
 class _Slot:
     __slots__ = ("terms", "k", "done", "vals", "hits", "total", "error",
-                 "t_enq", "rounds_skipped", "stage_ms", "info")
+                 "t_enq", "rounds_skipped", "stage_ms", "info",
+                 "view_segments", "view_key")
 
-    def __init__(self, terms, k: int):
+    def __init__(self, terms, k: int, view=None):
         self.terms = terms
         self.k = k
+        #: the caller's segment-list snapshot (NRT view). Hit coordinates
+        #: must decode against THIS list, so slots only co-batch within
+        #: one view and the dispatch resolves the delta tier for exactly
+        #: this list (plane_route serve_view) — a refresh landing between
+        #: enqueue and dispatch must not shift coordinates under the
+        #: caller. None = viewless (legacy planes / tests).
+        self.view_segments = view
+        self.view_key = tuple(id(s) for s in view) \
+            if view is not None else None
         self.done = False
         self.vals = None
         self.hits: Optional[List[Tuple[int, int]]] = None
@@ -127,6 +142,11 @@ class PlaneMicroBatcher:
         self.n_starved_dispatches = 0
         self.n_coalesced_dispatches = 0
         self.n_deduped = 0
+        # delta-tier observability: queries whose dispatch merged a
+        # base+delta result (live indexing appended segments since the
+        # base pack) and the eager delta-scan time they paid
+        self.n_delta_queries = 0
+        self.delta_ms = 0.0
         self.warmed_shapes = 0
         self.warmup_ms = 0.0
         self._retired = False
@@ -138,14 +158,15 @@ class PlaneMicroBatcher:
 
     def search(self, terms: Sequence[str], k: int,
                stages: Optional[dict] = None,
-               info: Optional[dict] = None):
+               info: Optional[dict] = None, view=None):
         """One query through the batched dispatch. Returns
         (scores[k], hits[(shard, doc)...], exact total). Blocks until the
         dispatch that carries this query completes. ``stages``, when a
         dict, receives this request's per-stage ms timings; ``info``
         receives dispatch metadata (compile-cache hit/miss, batch size)
-        for the Profile API's serving section."""
-        slot = _Slot(terms, k)
+        for the Profile API's serving section. ``view`` is the caller's
+        segment-list snapshot (see ``_Slot.view_segments``)."""
+        slot = _Slot(terms, k, view=view)
         with self._cond:
             self._queue.append(slot)
             self._ensure_dispatcher_locked()
@@ -207,6 +228,11 @@ class PlaneMicroBatcher:
                             s.done = True
                     self._cond.notify_all()
 
+    def _bucket_key(self, s: _Slot):
+        """One dispatch = one (k shape, segment view): k decides the
+        compile shape, the view decides the hit coordinate space."""
+        return (self._k_bucket(s.k), s.view_key)
+
     def _take_batch_locked(self) -> List[_Slot]:
         """Pick the next batch (caller holds the lock; queue non-empty).
 
@@ -214,30 +240,39 @@ class PlaneMicroBatcher:
         bucket dispatched now — a queued slot whose bucket never matches
         the popular one is still served within a bounded number of
         rounds; (2) a queue deeper than one full batch coalesces across
-        buckets at the max-k shape; (3) otherwise the largest ready
-        bucket goes (ties resolve to the oldest slot's bucket)."""
+        k-buckets (within one view) at the max-k shape; (3) otherwise
+        the largest ready bucket goes (ties resolve to the oldest
+        slot's bucket)."""
         q = self._queue
         starved = next((s for s in q
                         if s.rounds_skipped >= self.STARVATION_ROUNDS), None)
         if starved is not None:
-            kb = self._k_bucket(starved.k)
+            bk = self._bucket_key(starved)
             batch = [s for s in q
-                     if self._k_bucket(s.k) == kb][: self.max_batch]
+                     if self._bucket_key(s) == bk][: self.max_batch]
             self.n_starved_dispatches += 1
         elif len(q) > self.max_batch:
-            batch = q[: self.max_batch]
+            # coalesce across k-buckets but never across views (a view
+            # boundary is a refresh boundary — coordinates differ)
+            vcounts: Dict = {}
+            for s in q:
+                vcounts[s.view_key] = vcounts.get(s.view_key, 0) + 1
+            vbest = max(vcounts.values())
+            vk = next(s.view_key for s in q
+                      if vcounts[s.view_key] == vbest)
+            batch = [s for s in q if s.view_key == vk][: self.max_batch]
             if len({self._k_bucket(s.k) for s in batch}) > 1:
                 self.n_coalesced_dispatches += 1
         else:
-            counts: Dict[int, int] = {}
+            counts: Dict = {}
             for s in q:
-                kb = self._k_bucket(s.k)
-                counts[kb] = counts.get(kb, 0) + 1
+                bk = self._bucket_key(s)
+                counts[bk] = counts.get(bk, 0) + 1
             best = max(counts.values())
-            kb = next(self._k_bucket(s.k) for s in q
-                      if counts[self._k_bucket(s.k)] == best)
+            bk = next(self._bucket_key(s) for s in q
+                      if counts[self._bucket_key(s)] == best)
             batch = [s for s in q
-                     if self._k_bucket(s.k) == kb][: self.max_batch]
+                     if self._bucket_key(s) == bk][: self.max_batch]
         taken = set(map(id, batch))
         self._queue = [s for s in q if id(s) not in taken]
         for s in self._queue:
@@ -275,7 +310,9 @@ class PlaneMicroBatcher:
         t_call = time.perf_counter()
         err: Optional[BaseException] = None
         try:
-            vals, hits, totals = self._dispatch(queries, k, plane_stages)
+            vals, hits, totals = self._dispatch(
+                queries, k, plane_stages,
+                view=batch[0].view_segments)
         except BaseException as e:          # noqa: BLE001 — fan the error
             err = e                         # out to every query in the batch
         t_done = time.perf_counter()
@@ -298,6 +335,14 @@ class PlaneMicroBatcher:
         batch_info = {"batch_size": len(batch), "k_bucket": k,
                       "compile_cache": plane_stages.get("compile_cache",
                                                         "hit")}
+        delta_ms = plane_stages.get("delta_ms")
+        if delta_ms is not None:
+            # this dispatch merged the base plane with a live delta tier:
+            # surface the scan cost + delta size in the Profile API's
+            # serving section and the batcher's stats rollup
+            batch_info["delta_ms"] = round(delta_ms, 3)
+            batch_info["delta_docs"] = int(
+                plane_stages.get("delta_docs", 0))
         with self._cond:
             fetch_ms = fetch_base_ms + \
                 (time.perf_counter() - t_done) * 1e3
@@ -313,6 +358,9 @@ class PlaneMicroBatcher:
             self.n_dispatches += 1
             self.n_queries += len(batch)
             self.n_deduped += n_deduped
+            if delta_ms is not None:
+                self.n_delta_queries += len(batch)
+                self.delta_ms += delta_ms
             self.max_seen_batch = max(self.max_seen_batch, len(batch))
             self._cond.notify_all()
 
@@ -400,6 +448,8 @@ class PlaneMicroBatcher:
                 starved_dispatches=self.n_starved_dispatches,
                 coalesced_dispatches=self.n_coalesced_dispatches,
                 deduped_queries=self.n_deduped,
+                delta_queries=self.n_delta_queries,
+                delta_time_in_millis=int(self.delta_ms),
                 warmed_shapes=self.warmed_shapes,
                 warmup_time_in_millis=int(self.warmup_ms))
             for name in STAGES:
@@ -435,10 +485,18 @@ class PlaneMicroBatcher:
         return tuple(terms)
 
     def _dispatch(self, queries, k: int,
-                  stages: Optional[dict] = None):
+                  stages: Optional[dict] = None, view=None):
         """One device dispatch over the coalesced batch → (vals, hits,
         totals) aligned with ``queries``. Runs on a dispatcher thread,
         never under the queue lock."""
+        if view is not None:
+            sv = getattr(self.plane, "serve_view", None)
+            if sv is not None:
+                # serving generation: resolve the delta tier for EXACTLY
+                # the batch's segment view, so hit coordinates match the
+                # callers' snapshot even if a refresh landed meanwhile
+                return sv(queries, k=k, view=view, with_totals=True,
+                          stages=stages)
         serve = getattr(self.plane, "serve", None)
         if serve is not None:
             # the plane's serving entry picks the backend path (eager
@@ -486,9 +544,15 @@ class KnnPlaneMicroBatcher(PlaneMicroBatcher):
             b <<= 1
 
     def _dispatch(self, queries, k: int,
-                  stages: Optional[dict] = None):
+                  stages: Optional[dict] = None, view=None):
         # plane.serve picks the backend-appropriate path (numpy blocked
         # scorer on CPU — the search_eager analogue — jitted step on TPU)
+        if view is not None:
+            sv = getattr(self.plane, "serve_view", None)
+            if sv is not None:
+                vals, hits = sv(np.stack(queries), k=k, view=view,
+                                stages=stages)
+                return vals, hits, [None] * len(queries)
         vals, hits = self.plane.serve(np.stack(queries), k=k,
                                       stages=stages)
         return vals, hits, [None] * len(queries)
@@ -496,9 +560,11 @@ class KnnPlaneMicroBatcher(PlaneMicroBatcher):
 
 def batched_search(plane, terms: Sequence[str], k: int,
                    stages: Optional[dict] = None,
-                   info: Optional[dict] = None):
+                   info: Optional[dict] = None, view=None):
     """Module entry: route one query through the plane's micro-batcher
-    (created lazily on first use; plane rebuilds get a fresh one)."""
+    (created lazily on first use; plane rebuilds get a fresh one).
+    ``view`` is the caller's segment-list snapshot — hit coordinates
+    come back in that list's space."""
     batcher = getattr(plane, "_microbatcher", None)
     if batcher is None:
         with _CREATE_LOCK:
@@ -506,10 +572,10 @@ def batched_search(plane, terms: Sequence[str], k: int,
             if batcher is None:
                 batcher = PlaneMicroBatcher(plane)
                 plane._microbatcher = batcher
-    return batcher.search(terms, k, stages=stages, info=info)
+    return batcher.search(terms, k, stages=stages, info=info, view=view)
 
 
-def batched_knn_search(plane, query_vector, k: int):
+def batched_knn_search(plane, query_vector, k: int, view=None):
     """Route one kNN query through the knn plane's micro-batcher.
     Returns (raw_scores[k'], hits [(shard, doc), ...])."""
     batcher = getattr(plane, "_microbatcher", None)
@@ -520,7 +586,7 @@ def batched_knn_search(plane, query_vector, k: int):
                 batcher = KnnPlaneMicroBatcher(plane)
                 plane._microbatcher = batcher
     vals, hits, _total = batcher.search(
-        np.asarray(query_vector, np.float32), k)
+        np.asarray(query_vector, np.float32), k, view=view)
     return vals, hits
 
 

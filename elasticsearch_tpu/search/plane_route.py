@@ -13,22 +13,54 @@ bridge from the REST/cluster search path into the plane:
   (OR operator), ``term`` on a text field, and ``bool``/``dis_max``-free
   pure-``should`` disjunctions of those — exactly the shapes whose scoring
   model (sum of per-term BM25 over shard-level stats) the plane computes.
-- :class:`ServingPlaneCache` owns one :class:`DistributedSearchPlane` per
-  (shard, field), built lazily from the live segment list (one SEGMENT per
-  plane shard, so the plane's shard-ascending tie order equals the
-  per-segment path's (segment, doc) order) and invalidated on refresh /
-  merge / delete. Segments with deletes or nested docs disable the route
-  (plane postings would score hidden/dead docs).
+- :class:`ServingPlaneCache` owns one serving GENERATION per (shard,
+  field): a packed base plane (:class:`DistributedSearchPlane` /
+  :class:`DistributedKnnPlane` over the segment list as of the last
+  repack) plus an append-only DELTA tier (segments created since),
+  scored eagerly per query and merged into the base dispatch's top-k.
+  Segments with deletes or nested docs disable the route (plane postings
+  would score hidden/dead docs).
+
+Incremental maintenance (the NRT-refresh problem): under live indexing a
+refresh appends a segment every second while a full plane repack — CSR
+pack, dense tier, device upload, warmup lattice — costs far more. The old
+design repacked EVERY segment synchronously on the first request to
+notice the signature change, collapsing search throughput into rebuild
+storms. Generations fix this the way Lucene-tier systems do (segment
+-tiered serving + background merges — the Anserini/HNSW line):
+
+- an append-only refresh never invalidates the base: the new segments
+  form the delta tier (``parallel/dist_search.EagerDeltaScorer`` /
+  ``KnnDeltaScorer``), and the request thread at most packs the delta's
+  CSR — O(delta), no device work;
+- a background repack thread folds the delta into a new base generation
+  once the delta exceeds :attr:`ServingPlaneCache.REPACK_DELTA_FRACTION`
+  of the base doc count, builds and warms the new plane OFF the request
+  thread, then atomically swaps generations (double-buffering: the old
+  generation serves until the new one is ready; its warmup is retired as
+  before);
+- a merge/delete restructures the base segment list, which the old base
+  cannot serve (its hit coordinates decode against segments that no
+  longer exist): the repack still happens in the background while the
+  per-segment path serves the gap.
 
 Score parity with ``query_dsl._score_text_terms``: idf uses the identical
-``idf_weight`` over summed dfs and total docs; impacts are normalized by
-the cross-segment shard avgdl (``avgdl`` override); the exact per-query
-match counts come back from the same dispatch (``with_totals``), so
-``track_total_hits`` needs no second pass.
+``idf_weight`` over summed dfs and total docs — the delta tier's df/doc
+mass is folded into every base dispatch (``extra_df``/``extra_docs``), so
+base and delta docs score under ONE stat set. The generation's length
+norm (avgdl) is FROZEN at base-pack time (base impacts bake it); the
+delta scores under the same frozen value, so base+delta serving is
+bit-equal to a full repack pinned to that avgdl, and drifts from the
+live per-segment path only by the delta window's avgdl movement — the
+repack threshold bounds the window, and the swap restores exactness.
 """
 
 from __future__ import annotations
 
+import os
+import threading
+import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -155,28 +187,458 @@ def body_eligible(body: dict) -> bool:
     return int(body.get("size", 10)) + int(body.get("from", 0)) > 0
 
 
+# ---------------------------------------------------------------------------
+# Serving generations: packed base plane + append-only delta tier
+# ---------------------------------------------------------------------------
+
+
+class _ServingGeneration:
+    """One serving generation: a packed base plane over an immutable
+    snapshot of the segment list, plus a swappable delta tier covering
+    segments appended since. Unknown attributes delegate to the base
+    plane (``n_dispatches``, ``_host_csr``/``_host_pack``, ladder/warmup
+    surface), so the micro-batcher and the stats layer treat a
+    generation exactly like a bare plane."""
+
+    kind = "plane"
+
+    #: per-view delta-scorer memo entries kept besides the live one
+    VIEW_MEMO_MAX = 4
+
+    def __init__(self, base, base_segments: Sequence[Segment], cache):
+        self.base = base
+        #: strong refs — identity (``is``) anchors for delta matching;
+        #: kept alive until the generation is released
+        self.base_segments = list(base_segments)
+        self.base_docs = sum(s.n_docs for s in base_segments)
+        self._cache = cache
+        self.delta = None
+        self._base_positions: List[int] = list(range(len(base_segments)))
+        self._delta_key: Optional[tuple] = None
+        self._delta_ver = -1
+        self._delta_lock = threading.Lock()
+        #: view key → (scorer, base_positions) for views that are not
+        #: the live delta (a dispatch racing a refresh serves its own
+        #: older view; see :meth:`_delta_for_view`)
+        self._view_memo: "OrderedDict[tuple, tuple]" = OrderedDict()
+
+    def __getattr__(self, name):
+        base = self.__dict__.get("base")
+        if base is None:
+            raise AttributeError(name)
+        return getattr(base, name)
+
+    # -- delta bookkeeping ---------------------------------------------------
+
+    def match(self, segments: Sequence[Segment]):
+        """Identity-subsequence match of this generation's base against
+        the CURRENT segment list. Returns (delta_segments,
+        delta_positions, base_positions) when every base segment appears
+        unchanged and in order (append-only refreshes, including
+        interleaved appends from other index shards), else None (a
+        merge/delete restructured the base — repack required)."""
+        base = self.base_segments
+        bi = 0
+        delta: List[Segment] = []
+        dpos: List[int] = []
+        bpos: List[int] = []
+        for pos, seg in enumerate(segments):
+            if bi < len(base) and seg is base[bi]:
+                bpos.append(pos)
+                bi += 1
+            else:
+                delta.append(seg)
+                dpos.append(pos)
+        if bi != len(base):
+            return None
+        return delta, dpos, bpos
+
+    def clear_delta(self, base_positions: Optional[List[int]] = None,
+                    ver: int = -1) -> None:
+        with self._delta_lock:
+            if ver >= 0 and ver < self._delta_ver:
+                return
+            self.delta = None
+            self._delta_key = None
+            self._delta_ver = max(self._delta_ver, ver)
+            if base_positions is not None:
+                self._base_positions = base_positions
+
+    def _swap_delta(self, scorer, key: tuple, base_positions: List[int],
+                    ver: int) -> None:
+        with self._delta_lock:
+            if ver < self._delta_ver:
+                return          # a newer segment list already swapped in
+            self.delta = scorer
+            self._delta_key = key
+            self._delta_ver = ver
+            self._base_positions = base_positions
+
+    def delta_docs(self) -> int:
+        d = self.delta
+        return d.n_docs if d is not None else 0
+
+    def _snapshot(self):
+        with self._delta_lock:
+            return self.delta, self._base_positions
+
+    def _build_delta(self, delta_segs: Sequence[Segment],
+                     delta_pos: List[int]):
+        raise NotImplementedError
+
+    def _delta_for_view(self, view: Sequence[Segment]):
+        """(delta scorer | None, base positions) for EXACTLY the given
+        segment list — the dispatch-time resolution that keeps hit
+        coordinates in the caller's NRT snapshot space. A refresh landing
+        between the caller's ``plane_for`` and the micro-batch dispatch
+        mutates the generation's live delta, so serving that newer delta
+        would emit coordinates past (or shifted within) the caller's
+        list; resolving per view instead makes the race harmless. The
+        live delta is the common-case hit; other views pay one O(delta)
+        pack memoized per view key."""
+        key = tuple(id(s) for s in view)
+        with self._delta_lock:
+            if self._delta_key == key:
+                return self.delta, self._base_positions
+            memo = self._view_memo.get(key)
+            if memo is not None:
+                self._view_memo.move_to_end(key)
+                return memo
+        m = self.match(view)
+        if m is None:
+            # unreachable for views that obtained this generation from
+            # plane_for (the base is immutable), but a stale caller must
+            # fail loudly rather than decode foreign coordinates
+            raise RuntimeError(
+                "serving view no longer contains this generation's base")
+        delta_segs, delta_pos, base_pos = m
+        scorer = self._build_delta(delta_segs, delta_pos) \
+            if delta_segs else None
+        with self._delta_lock:
+            self._view_memo[key] = (scorer, base_pos)
+            while len(self._view_memo) > self.VIEW_MEMO_MAX:
+                self._view_memo.popitem(last=False)
+        return scorer, base_pos
+
+
+class TextServingGeneration(_ServingGeneration):
+    """Lexical generation: ``DistributedSearchPlane`` base + eager CSR
+    delta (``parallel/dist_search.EagerDeltaScorer``)."""
+
+    kind = "text"
+
+    def __init__(self, base, base_segments, field: str, avgdl: float,
+                 cache):
+        super().__init__(base, base_segments, cache)
+        self.field = field
+        #: the generation's frozen length norm (baked into base impacts)
+        self.avgdl = avgdl
+
+    def _build_delta(self, delta_segs: Sequence[Segment],
+                     delta_pos: List[int]):
+        """Pack a delta scorer — O(delta postings), the only
+        serving-path cost a refresh adds."""
+        from ..parallel.dist_search import EagerDeltaScorer
+        shards = []
+        for seg in delta_segs:
+            f = seg.text_fields.get(self.field)
+            if f is None:
+                shards.append(dict(
+                    term_ids={}, df=np.zeros(0, np.int32),
+                    offsets=np.zeros(1, np.int64),
+                    docs=np.zeros(0, np.int32),
+                    tf=np.zeros(0, np.float32),
+                    doc_len=np.zeros(seg.n_docs, np.float32)))
+            else:
+                shards.append(dict(
+                    term_ids=f.term_ids, df=f.df, offsets=f.offsets,
+                    docs=f.docs_host, tf=f.tf_host,
+                    doc_len=f.doc_len_host))
+        return EagerDeltaScorer(shards, delta_pos, avgdl=self.avgdl)
+
+    def update_delta(self, segments: Sequence[Segment],
+                     delta_segs: Sequence[Segment], delta_pos: List[int],
+                     base_pos: List[int], ver: int) -> None:
+        """Pack (or reuse) the LIVE delta scorer for the current segment
+        list (the common serving view; dispatches for other views resolve
+        through :meth:`_delta_for_view`)."""
+        key = tuple(id(s) for s in segments)
+        with self._delta_lock:
+            if self._delta_key == key:
+                self._base_positions = base_pos
+                return
+        scorer = self._build_delta(delta_segs, delta_pos)
+        self._swap_delta(scorer, key, base_pos, ver)
+
+    def serve_view(self, queries, k: int = 10, *, view,
+                   with_totals: bool = False,
+                   stages: Optional[dict] = None):
+        """Micro-batcher dispatch hook: base dispatch (idf widened by the
+        delta's df/doc mass) + eager delta scan + host top-k merge, with
+        the delta resolved for the batch's exact segment view."""
+        delta, base_pos = self._delta_for_view(view)
+        return self._serve_merged(queries, k, delta, base_pos,
+                                  with_totals=with_totals, stages=stages)
+
+    def serve(self, queries, k: int = 10, *, with_totals: bool = False,
+              stages: Optional[dict] = None):
+        """Viewless entry (tests / direct callers): serve against the
+        generation's CURRENT delta snapshot."""
+        delta, base_pos = self._snapshot()
+        return self._serve_merged(queries, k, delta, base_pos,
+                                  with_totals=with_totals, stages=stages)
+
+    def _serve_merged(self, queries, k, delta, base_pos, *,
+                      with_totals: bool = False,
+                      stages: Optional[dict] = None):
+        if delta is None:
+            return self.base.serve(queries, k=k, with_totals=with_totals,
+                                   stages=stages)
+        # one shared stat set: the delta's term dfs fold into the base
+        # dispatch's idf weights, and the delta scores under the same
+        # combined idf — parity with a full repack at the frozen avgdl
+        extra_df: Dict[str, int] = {}
+        for q in queries:
+            for t in set(q):
+                if t not in extra_df:
+                    extra_df[t] = delta.df(t)
+        vals, hits, totals = self.base.serve(
+            queries, k=k, with_totals=True, stages=stages,
+            extra_docs=delta.n_docs, extra_df=extra_df)
+        t1 = time.perf_counter()
+        from ..ops.bm25 import idf_weight
+        n_total = self.base.n_docs_total + delta.n_docs
+        idf_cache: Dict[str, float] = {}
+
+        def idf_of(t: str) -> float:
+            v = idf_cache.get(t)
+            if v is None:
+                gdf = self.base.global_df(t) + extra_df.get(t, 0)
+                v = float(idf_weight(n_total, np.int64(gdf))) if gdf \
+                    else 0.0
+                idf_cache[t] = v
+            return v
+
+        from ..parallel.dist_search import merge_topk_rows
+        drows, dtotals = delta.score(queries, k, idf_of, with_totals=True)
+        vals_out, hits_out, totals_out = [], [], []
+        for bi in range(len(queries)):
+            base_rows = [(float(v), base_pos[si], int(d))
+                         for v, (si, d) in zip(vals[bi], hits[bi])]
+            merged = merge_topk_rows(base_rows, drows[bi], k)
+            vals_out.append(np.asarray([r[0] for r in merged], np.float32))
+            hits_out.append([(r[1], r[2]) for r in merged])
+            totals_out.append(int(totals[bi] or 0) + int(dtotals[bi]))
+        delta_ms = (time.perf_counter() - t1) * 1e3
+        if stages is not None:
+            stages["dispatch_ms"] = stages.get("dispatch_ms", 0.0) \
+                + delta_ms
+            stages["delta_ms"] = delta_ms
+            stages["delta_docs"] = delta.n_docs
+        self._cache._record_delta_serve("text", len(queries))
+        if with_totals:
+            return vals_out, hits_out, totals_out
+        return vals_out, hits_out
+
+
+class KnnServingGeneration(_ServingGeneration):
+    """Vector generation: ``DistributedKnnPlane`` base + BLAS delta
+    (``parallel/dist_search.KnnDeltaScorer``). No corpus-wide stats, so
+    delta serving is exactly exact."""
+
+    kind = "knn"
+
+    def __init__(self, base, base_segments, field: str, cache):
+        super().__init__(base, base_segments, cache)
+        self.field = field
+
+    def _build_delta(self, delta_segs: Sequence[Segment],
+                     delta_pos: List[int]):
+        from ..parallel.dist_search import KnnDeltaScorer
+        shards = []
+        for seg in delta_segs:
+            f = seg.vector_fields.get(self.field)
+            if f is None:
+                shards.append(dict(
+                    vectors=np.zeros((seg.n_docs, max(self.base.dim, 1)),
+                                     np.float32),
+                    exists=np.zeros(seg.n_docs, bool)))
+            else:
+                ex = np.zeros(seg.n_docs, bool)
+                ex[: f.exists.shape[0]] = f.exists
+                shards.append(dict(vectors=f.matrix_host, exists=ex))
+        return KnnDeltaScorer(shards, delta_pos,
+                              similarity=self.base.similarity)
+
+    def update_delta(self, segments: Sequence[Segment],
+                     delta_segs: Sequence[Segment], delta_pos: List[int],
+                     base_pos: List[int], ver: int) -> None:
+        key = tuple(id(s) for s in segments)
+        with self._delta_lock:
+            if self._delta_key == key:
+                self._base_positions = base_pos
+                return
+        scorer = self._build_delta(delta_segs, delta_pos)
+        self._swap_delta(scorer, key, base_pos, ver)
+
+    def serve_view(self, query_vectors, k: int = 10, *, view,
+                   stages: Optional[dict] = None):
+        delta, base_pos = self._delta_for_view(view)
+        return self._serve_merged(query_vectors, k, delta, base_pos,
+                                  stages=stages)
+
+    def serve(self, query_vectors, k: int = 10,
+              stages: Optional[dict] = None):
+        delta, base_pos = self._snapshot()
+        return self._serve_merged(query_vectors, k, delta, base_pos,
+                                  stages=stages)
+
+    def _serve_merged(self, query_vectors, k, delta, base_pos, *,
+                      stages: Optional[dict] = None):
+        vals, hits = self.base.serve(query_vectors, k=k, stages=stages)
+        if delta is None:
+            return vals, hits
+        t1 = time.perf_counter()
+        from ..parallel.dist_search import NEG_INF, merge_topk_rows
+        drows = delta.score(query_vectors, k)
+        B = len(hits)
+        vals_out = np.full((B, k), NEG_INF, np.float32)
+        hits_out = []
+        for bi in range(B):
+            base_rows = [(float(v), base_pos[si], int(d))
+                         for v, (si, d) in zip(vals[bi], hits[bi])]
+            merged = merge_topk_rows(base_rows, drows[bi], k)
+            for j, r in enumerate(merged):
+                vals_out[bi, j] = r[0]
+            hits_out.append([(r[1], r[2]) for r in merged])
+        delta_ms = (time.perf_counter() - t1) * 1e3
+        if stages is not None:
+            stages["dispatch_ms"] = stages.get("dispatch_ms", 0.0) \
+                + delta_ms
+            stages["delta_ms"] = delta_ms
+            stages["delta_docs"] = delta.n_docs
+        self._cache._record_delta_serve("knn", B)
+        return vals_out, hits_out
+
+
+# ---------------------------------------------------------------------------
+# ServingPlaneCache: generation registry + background repack
+# ---------------------------------------------------------------------------
+
+
 class ServingPlaneCache:
-    """Per-(shard, field) plane registry for the product search path."""
+    """Per-(shard, field) serving-generation registry for the product
+    search path. Request threads only ever (a) hit a generation, (b)
+    pack an O(delta) delta scorer, or (c) pay the one cold build per
+    field; full repacks run on a background thread and swap atomically
+    (see the module docstring)."""
+
+    #: max cached kNN generations (each base is one packed f32 corpus)
+    KNN_PLANE_CACHE_MAX = 32
+
+    #: delta-tier doc fraction (of the base generation's docs) above
+    #: which a background repack folds the delta into a new base
+    REPACK_DELTA_FRACTION = float(os.environ.get(
+        "ES_TPU_PLANE_DELTA_FRACTION", "0.125"))
 
     def __init__(self, mesh_factory=None, min_docs: int = _MIN_DOCS_DEFAULT):
         self._mesh_factory = mesh_factory
         self._mesh = None
-        self._planes: Dict[str, Tuple[tuple, object]] = {}
-        # kNN planes key on (field, segment signature): the distributed
-        # searcher probes one plane per index shard (distinct segment
-        # lists), and field-only keying would rebuild on every alternating
-        # probe. LRU-capped; evicted planes release their breaker bytes.
-        from collections import OrderedDict
-        self._knn_planes: "OrderedDict[tuple, object]" = OrderedDict()
+        self._planes: Dict[str, TextServingGeneration] = {}
+        # kNN generations key on (field, base segment identity): the
+        # distributed searcher probes one plane per index shard (distinct
+        # segment lists), and field-only keying would rebuild on every
+        # alternating probe. LRU-capped; evicted generations release
+        # their breaker bytes.
+        self._knn_planes: "OrderedDict[tuple, KnnServingGeneration]" = \
+            OrderedDict()
         #: consecutive plane builds without a cache hit — when more
-        #: distinct (field, sig) combinations are in flight than the
-        #: cache holds, packing a corpus per probe would thrash; the
+        #: distinct (field, segment-list) combinations are in flight than
+        #: the cache holds, packing a corpus per probe would thrash; the
         #: route bows out to the per-segment path instead
         self._knn_build_streak = 0
         self.min_docs = min_docs
+        #: delta-tier serving on/off (off = the old rebuild-every-refresh
+        #: behavior; the live-indexing bench uses this as its baseline)
+        self.delta_enabled = os.environ.get(
+            "ES_TPU_PLANE_DELTA", "1").lower() not in ("0", "false")
+        #: "background" (production) or "sync" (deterministic tests /
+        #: callers that need the swap visible before the call returns)
+        self.repack_mode = os.environ.get(
+            "ES_TPU_PLANE_REPACK_MODE", "background")
+        self._gen_lock = threading.RLock()
+        self._gen_ver = 0
+        self._repacking: set = set()
+        self._repack_threads: List[threading.Thread] = []
+        self._closed = False
+        # plane.rebuild / plane.delta_serve / plane.swap_ms metrics:
+        # instance-owned (fresh per cache — exact per-index counts) and
+        # exposed through the process telemetry registry via a weakref
+        # collector, like every other node-scoped producer
+        from ..common import telemetry as _tm
+        self._metric_lock = threading.Lock()
+        self._rebuild_counts: Dict[Tuple[str, str, str], _tm.Counter] = {}
+        self._delta_serve_counts: Dict[str, _tm.Counter] = {}
+        self._swap_ms = _tm.Histogram()
+        _tm.DEFAULT.register_object_collector(
+            f"plane_cache_{id(self):x}", self,
+            ServingPlaneCache._metrics_doc)
 
-    #: max cached kNN planes (each is one packed f32 corpus copy)
-    KNN_PLANE_CACHE_MAX = 32
+    # -- telemetry -----------------------------------------------------------
+
+    def _metrics_doc(self):
+        with self._metric_lock:
+            rb = [({"kind": k, "trigger": t, "mode": m}, c.value)
+                  for (k, t, m), c in self._rebuild_counts.items()]
+            ds = [({"kind": k}, c.value)
+                  for k, c in self._delta_serve_counts.items()]
+        return {
+            "es_plane_rebuild_total": {
+                "type": "counter",
+                "help": "serving plane (re)builds by kind/trigger/mode",
+                "samples": rb},
+            "es_plane_delta_serve_total": {
+                "type": "counter",
+                "help": "queries served through base+delta merge",
+                "samples": ds},
+            "es_plane_swap_ms": {
+                "type": "histogram",
+                "help": "background repack build+swap wall ms",
+                "samples": [({}, self._swap_ms.snapshot())]},
+        }
+
+    def _record_rebuild(self, kind: str, trigger: str, mode: str) -> None:
+        from ..common import telemetry as _tm
+        with self._metric_lock:
+            c = self._rebuild_counts.get((kind, trigger, mode))
+            if c is None:
+                c = self._rebuild_counts[(kind, trigger, mode)] = \
+                    _tm.Counter()
+        c.inc()
+
+    def _record_delta_serve(self, kind: str, n: int) -> None:
+        from ..common import telemetry as _tm
+        with self._metric_lock:
+            c = self._delta_serve_counts.get(kind)
+            if c is None:
+                c = self._delta_serve_counts[kind] = _tm.Counter()
+        c.inc(n)
+
+    def rebuild_stats(self) -> Dict[str, int]:
+        """Rollup for benches/tests: rebuild counts by mode and trigger,
+        plus delta-served query count."""
+        with self._metric_lock:
+            out: Dict[str, int] = {"sync": 0, "background": 0,
+                                   "cold": 0, "threshold": 0,
+                                   "structure": 0, "delta_serves": 0}
+            for (kind, trigger, mode), c in self._rebuild_counts.items():
+                out[mode] = out.get(mode, 0) + int(c.value)
+                out[trigger] = out.get(trigger, 0) + int(c.value)
+            for c in self._delta_serve_counts.values():
+                out["delta_serves"] += int(c.value)
+        return out
+
+    # -- shared plumbing -----------------------------------------------------
 
     @staticmethod
     def _attach_batcher(plane, knn: bool = False):
@@ -201,9 +663,19 @@ class ServingPlaneCache:
         """Stop a superseded/evicted plane's in-flight warmup so rebuild
         storms (refresh-heavy indices) don't stack background compile
         threads each pinning an orphaned corpus copy."""
-        b = getattr(plane, "_microbatcher", None)
+        b = plane.__dict__.get("_microbatcher") \
+            if isinstance(plane, _ServingGeneration) \
+            else getattr(plane, "_microbatcher", None)
         if b is not None:
             b.retire()
+
+    def _release_gen(self, gen) -> None:
+        """Release a generation's (or bare plane's) breaker reservation
+        and retire its batcher."""
+        from ..common.breakers import DEFAULT as _breakers
+        acct = _breakers.breaker("accounting")
+        acct.release(getattr(gen, "_acct_bytes", 0))
+        self._retire(gen)
 
     def _get_mesh(self):
         if self._mesh is None:
@@ -218,9 +690,140 @@ class ServingPlaneCache:
                     n_shards=1, n_replicas=1, devices=jax.devices()[:1])
         return self._mesh
 
+    def _next_ver(self) -> int:
+        with self._gen_lock:
+            self._gen_ver += 1
+            return self._gen_ver
+
+    # -- repack scheduling ---------------------------------------------------
+
+    def _delta_over_threshold(self, gen) -> bool:
+        d = gen.delta_docs()
+        return d > max(1, int(gen.base_docs * self.REPACK_DELTA_FRACTION))
+
+    def _schedule_repack(self, kind: str, field: str,
+                         segments: Sequence[Segment],
+                         mapper: MapperService, trigger: str) -> None:
+        """Fold the current segment list into a new base generation off
+        the request thread, then swap. One in-flight repack per (kind,
+        field); ``repack_mode == "sync"`` runs inline (tests)."""
+        with self._gen_lock:
+            if self._closed or (kind, field) in self._repacking:
+                return
+            self._repacking.add((kind, field))
+            self._repack_threads = [t for t in self._repack_threads
+                                    if t.is_alive()]
+        segments = list(segments)
+
+        def _run():
+            t0 = time.perf_counter()
+            try:
+                if kind == "text":
+                    self._build_text_generation(segments, mapper, field,
+                                                trigger=trigger,
+                                                mode="background")
+                else:
+                    self._build_knn_generation(segments, mapper, field,
+                                               trigger=trigger,
+                                               mode="background")
+                self._swap_ms.observe((time.perf_counter() - t0) * 1e3)
+            except Exception:   # noqa: BLE001 — a failed repack must
+                pass            # never take down serving; retried later
+            finally:
+                with self._gen_lock:
+                    self._repacking.discard((kind, field))
+
+        if self.repack_mode == "sync":
+            _run()
+            return
+        t = threading.Thread(target=_run, daemon=True,
+                             name=f"plane-repack-{kind}-{field}")
+        with self._gen_lock:
+            self._repack_threads.append(t)
+        t.start()
+
+    def drain_repacks(self, timeout: float = 30.0) -> None:
+        """Join in-flight background repacks (tests / orderly shutdown)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._gen_lock:
+                threads = [t for t in self._repack_threads if t.is_alive()]
+                busy = bool(self._repacking)
+            if not threads and not busy:
+                return
+            for t in threads:
+                t.join(max(0.01, deadline - time.monotonic()))
+
+    def notify_refresh(self, segments: Sequence[Segment],
+                       mapper: MapperService,
+                       knn_lists: Optional[Sequence[Sequence[Segment]]]
+                       = None) -> None:
+        """Engine refresh/merge hook (``index/engine.py`` →
+        ``IndexService``): reconcile every cached generation against the
+        new segment list NOW — delta packs and repack scheduling happen
+        at refresh time on the indexing thread, not on the first search
+        to notice the signature change. Never builds cold planes.
+
+        ``segments`` is the POOLED (cross-shard) list — the space text
+        generations serve in. ``knn_lists`` are the candidate views kNN
+        generations may be keyed by (per-index-shard lists from the
+        distributed searcher, plus the pooled list): each kNN generation
+        reconciles against the candidate matching it with the SMALLEST
+        delta, so another shard's corpus is never mistaken for this
+        generation's delta tier (which would schedule repacks onto a
+        pooled list no per-shard probe can ever match)."""
+        if self._closed:
+            return
+        segments = [s for s in segments if s.n_docs > 0]
+        if not segments:
+            return
+        with self._gen_lock:
+            text_fields = list(self._planes)
+        for field in text_fields:
+            sig = self._signature(segments, field)
+            if sig is None:
+                continue
+            self._text_generation(segments, mapper, field,
+                                  allow_sync_build=False)
+        self._knn_reconcile(knn_lists or [segments], mapper)
+
+    def _knn_reconcile(self, lists: Sequence[Sequence[Segment]],
+                       mapper: MapperService) -> None:
+        with self._gen_lock:
+            items = list(self._knn_planes.items())
+        for key, gen in items:
+            field = key[0]
+            best = None           # (delta_count, filtered_list, match)
+            for lst in lists:
+                lstf = [s for s in lst if s.n_docs > 0]
+                if not lstf or \
+                        self._knn_signature(lstf, field) is None:
+                    continue
+                m = gen.match(lstf)
+                if m is None:
+                    continue
+                if best is None or len(m[0]) < best[0]:
+                    best = (len(m[0]), lstf, m)
+            if best is None:
+                continue
+            _, lstf, (delta_segs, delta_pos, base_pos) = best
+            ver = self._next_ver()
+            if not delta_segs:
+                gen.clear_delta(base_pos, ver)
+                continue
+            if not self.delta_enabled:
+                continue
+            gen.update_delta(lstf, delta_segs, delta_pos, base_pos, ver)
+            if self._delta_over_threshold(gen):
+                self._schedule_repack("knn", field, lstf, mapper,
+                                      "threshold")
+
+    # -- lexical plane -------------------------------------------------------
+
     @staticmethod
     def _signature(segments: Sequence[Segment], field: str) -> Optional[tuple]:
-        """Cache key over the segment list; None → route ineligible."""
+        """Route-eligibility key over the segment list; None → route
+        ineligible (deletes, nested docs, absent field)."""
         sig = []
         any_field = False
         for s in segments:
@@ -231,23 +834,8 @@ class ServingPlaneCache:
             sig.append((s.seg_id, s.n_docs))
         return tuple(sig) if any_field else None
 
-    def plane_for(self, segments: Sequence[Segment], mapper: MapperService,
-                  field: str):
-        """The serving plane for this segment list, or None when the route
-        is ineligible (deletes, nested docs, absent field)."""
-        segments = [s for s in segments if s.n_docs > 0]
-        if not segments:
-            return None
-        if sum(s.n_docs for s in segments) < self.min_docs:
-            return None
-        sig = self._signature(segments, field)
-        if sig is None:
-            return None
-        cached = self._planes.get(field)
-        if cached is not None and cached[0] == sig:
-            return cached[1]
-        from ..parallel.dist_search import DistributedSearchPlane
-        # shard-level (cross-segment) avgdl, same as ShardContext.field_avgdl
+    def _pack_text_shards(self, segments: Sequence[Segment], field: str):
+        """(plane shard dicts, cross-segment avgdl) for a base pack."""
         sum_dl = 0.0
         doc_count = 0
         for s in segments:
@@ -270,12 +858,21 @@ class ServingPlaneCache:
                     term_ids=f.term_ids, df=f.df, offsets=f.offsets,
                     docs=f.docs_host, tf=f.tf_host,
                     doc_len=f.doc_len_host, avgdl=avgdl))
+        return shards, avgdl
+
+    def _build_text_generation(self, segments: Sequence[Segment],
+                               mapper: MapperService, field: str, *,
+                               trigger: str, mode: str
+                               ) -> TextServingGeneration:
+        """Full base pack: breaker reservation, plane construction,
+        batcher + warmup, atomic swap (releasing the old generation)."""
+        from ..parallel.dist_search import DistributedSearchPlane as _P
+        shards, avgdl = self._pack_text_shards(segments, field)
         # the dense tier is the big persistent allocation (T_pad × n_pad
         # bf16 per shard): reserve its estimate against the accounting
         # breaker BEFORE building, so an overfull node 429s instead of
         # OOMing inside the constructor
         from ..common.breakers import DEFAULT as _breakers
-        from ..parallel.dist_search import DistributedSearchPlane as _P
         from ..utils.shapes import round_up_multiple, round_up_pow2
         acct = _breakers.breaker("accounting")
         n_pad = round_up_pow2(max(
@@ -288,27 +885,92 @@ class ServingPlaneCache:
             len(shards) if t_est else 0
         acct.add_estimate(nbytes, f"<serving plane [{field}]>")
         try:
-            plane = DistributedSearchPlane(self._get_mesh(), shards,
-                                           field)
+            plane = _P(self._get_mesh(), shards, field)
         except Exception:
             acct.release(nbytes)
             raise
-        old = self._planes.get(field)
-        if old is not None:
-            acct.release(getattr(old[1], "_acct_bytes", 0))
-            self._retire(old[1])
         plane._acct_bytes = nbytes
-        self._attach_batcher(plane)
-        self._planes[field] = (sig, plane)
-        return plane
+        gen = TextServingGeneration(plane, segments, field, avgdl, self)
+        self._attach_batcher(gen)
+        with self._gen_lock:
+            if self._closed:
+                self._release_gen(gen)
+                return gen
+            old = self._planes.get(field)
+            self._planes[field] = gen
+        if old is not None:
+            # double-buffering: the old generation served until this
+            # swap; drop its reservation and stop its warmup now
+            self._release_gen(old)
+        self._record_rebuild("text", trigger, mode)
+        return gen
+
+    def plane_for(self, segments: Sequence[Segment], mapper: MapperService,
+                  field: str):
+        """The serving generation for this segment list, or None when the
+        route is ineligible (deletes, nested docs, absent field) or the
+        base is mid-repack after a structural change (the per-segment
+        path serves the gap)."""
+        segments = [s for s in segments if s.n_docs > 0]
+        if not segments:
+            return None
+        if sum(s.n_docs for s in segments) < self.min_docs:
+            return None
+        if self._signature(segments, field) is None:
+            return None
+        return self._text_generation(segments, mapper, field,
+                                     allow_sync_build=True)
+
+    def _text_generation(self, segments, mapper, field: str,
+                         allow_sync_build: bool):
+        with self._gen_lock:
+            gen = self._planes.get(field)
+        if gen is not None:
+            m = gen.match(segments)
+            if m is not None:
+                delta_segs, delta_pos, base_pos = m
+                ver = self._next_ver()
+                if not delta_segs:
+                    gen.clear_delta(base_pos, ver)
+                    return gen
+                if self.delta_enabled:
+                    gen.update_delta(segments, delta_segs, delta_pos,
+                                     base_pos, ver)
+                    if self._delta_over_threshold(gen):
+                        self._schedule_repack("text", field, segments,
+                                              mapper, "threshold")
+                        if self.repack_mode == "sync":
+                            with self._gen_lock:
+                                return self._planes.get(field)
+                    return gen
+            elif self.delta_enabled:
+                # merge/delete restructured the base: the old plane's hit
+                # coordinates no longer decode against this list — repack
+                # in the background, per-segment path serves meanwhile
+                self._schedule_repack("text", field, segments, mapper,
+                                      "structure")
+                if self.repack_mode == "sync":
+                    with self._gen_lock:
+                        return self._planes.get(field)
+                return None
+        if not allow_sync_build:
+            return None
+        # cold start (first build for this field) or legacy mode
+        # (delta_enabled=False: rebuild-every-refresh, the pre-generation
+        # behavior the live-indexing bench measures as its baseline)
+        return self._build_text_generation(
+            segments, mapper, field,
+            trigger="cold" if gen is None else "structure", mode="sync")
+
+    # -- kNN plane -----------------------------------------------------------
 
     @staticmethod
     def _knn_signature(segments: Sequence[Segment],
                        field: str) -> Optional[tuple]:
-        """Cache key for the kNN plane; None → route ineligible (deletes,
-        nested docs, or the field has no vectors anywhere — the plane
-        packs exists-masked rows but per-doc liveness/parent masks stay on
-        the per-segment path)."""
+        """Route-eligibility key for the kNN plane; None → ineligible
+        (deletes, nested docs, or the field has no vectors anywhere — the
+        plane packs exists-masked rows but per-doc liveness/parent masks
+        stay on the per-segment path)."""
         sig = []
         any_field = False
         for s in segments:
@@ -321,11 +983,11 @@ class ServingPlaneCache:
 
     def knn_plane_for(self, segments: Sequence[Segment],
                       mapper: MapperService, field: str):
-        """The kNN serving plane (``DistributedKnnPlane`` — pack-time
-        corpus invariants + blocked running-top-k) for this segment list,
-        or None when the route is ineligible. One SEGMENT per plane shard,
-        same as the lexical plane, so tie order matches the per-segment
-        path."""
+        """The kNN serving generation (``DistributedKnnPlane`` base —
+        pack-time corpus invariants + blocked running-top-k — plus a BLAS
+        delta tier) for this segment list, or None when the route is
+        ineligible. One SEGMENT per plane shard, same as the lexical
+        plane, so tie order matches the per-segment path."""
         from ..index.mapping import DenseVectorFieldType
         segments = [s for s in segments if s.n_docs > 0]
         if not segments:
@@ -333,19 +995,69 @@ class ServingPlaneCache:
         ft = mapper.field_type(field)
         if not isinstance(ft, DenseVectorFieldType):
             return None
-        sig = self._knn_signature(segments, field)
-        if sig is None:
+        if self._knn_signature(segments, field) is None:
             return None
-        key = (field, sig)
-        cached = self._knn_planes.get(key)
-        if cached is not None:
-            self._knn_planes.move_to_end(key)
-            self._knn_build_streak = 0
-            return cached
+        return self._knn_generation(segments, mapper, field,
+                                    allow_build=True)
+
+    def _knn_generation(self, segments, mapper, field: str,
+                        allow_build: bool):
+        with self._gen_lock:
+            items = list(self._knn_planes.items())
+        # pick the generation whose base covers this list with the
+        # SMALLEST delta (a pooled probe must prefer a pooled base over
+        # eagerly scanning every other shard's corpus as "delta")
+        best = None                   # (delta_count, key, gen, match)
+        for key, gen in items:
+            if key[0] != field:
+                continue
+            m = gen.match(segments)
+            if m is None:
+                continue
+            if best is None or len(m[0]) < best[0]:
+                best = (len(m[0]), key, gen, m)
+        if best is not None:
+            _, key, gen, (delta_segs, delta_pos, base_pos) = best
+            with self._gen_lock:
+                if key in self._knn_planes:
+                    self._knn_planes.move_to_end(key)
+                self._knn_build_streak = 0
+            ver = self._next_ver()
+            if not delta_segs:
+                gen.clear_delta(base_pos, ver)
+                return gen
+            if self.delta_enabled:
+                gen.update_delta(segments, delta_segs, delta_pos,
+                                 base_pos, ver)
+                if self._delta_over_threshold(gen):
+                    self._schedule_repack("knn", field, segments, mapper,
+                                          "threshold")
+                return gen
+            # legacy mode: fall through to a full rebuild
+        if not allow_build:
+            return None
         if self._knn_build_streak >= self.KNN_PLANE_CACHE_MAX:
             # every recent probe missed: building would evict entries the
             # same request needs again (O(corpus) repack per query) — the
             # per-segment fallback is the cheaper correct path
+            return None
+        gen = self._build_knn_generation(segments, mapper, field,
+                                         trigger="cold", mode="sync")
+        if gen is not None:
+            with self._gen_lock:
+                self._knn_build_streak += 1
+        return gen
+
+    def _build_knn_generation(self, segments, mapper, field: str, *,
+                              trigger: str, mode: str):
+        """Full kNN base pack + atomic swap into the LRU (superseded
+        generations of the same field sharing base segments are
+        released first — a repack kept part of the list, so identity
+        overlap marks the predecessors; generations for OTHER index
+        shards of the same field are disjoint and survive)."""
+        from ..index.mapping import DenseVectorFieldType
+        ft = mapper.field_type(field)
+        if not isinstance(ft, DenseVectorFieldType):
             return None
         from ..parallel.dist_search import DistributedKnnPlane
         # step similarity: ranking by raw dot is order-equivalent for
@@ -385,22 +1097,7 @@ class ServingPlaneCache:
         n_pad = round_up_pow2(max(max(s["exists"].shape[0]
                                       for s in shards), 1))
         nbytes = len(shards) * n_pad * (dim * 4 + 5)
-        # make room BEFORE reserving: drop superseded generations of this
-        # field (a refresh/merge kept part of the segment list, so the
-        # old signature shares seg_ids with the new one — planes for
-        # OTHER shards of the same field are disjoint and survive) and
-        # any LRU overflow
-        new_ids = {sid for sid, _ in sig}
-        for old_key in [ok for ok in self._knn_planes
-                        if ok[0] == field and ok[1] != sig
-                        and any(sid in new_ids for sid, _ in ok[1])]:
-            old = self._knn_planes.pop(old_key)
-            acct.release(getattr(old, "_acct_bytes", 0))
-            self._retire(old)
-        while len(self._knn_planes) >= self.KNN_PLANE_CACHE_MAX:
-            _, old = self._knn_planes.popitem(last=False)
-            acct.release(getattr(old, "_acct_bytes", 0))
-            self._retire(old)
+        key = (field, tuple(id(s) for s in segments))
         acct.add_estimate(nbytes, f"<knn serving plane [{field}]>")
         try:
             plane = DistributedKnnPlane(self._get_mesh(), shards,
@@ -409,28 +1106,53 @@ class ServingPlaneCache:
             acct.release(nbytes)
             raise
         plane._acct_bytes = nbytes
-        raced = self._knn_planes.get(key)
-        if raced is not None:
-            # another thread built the same plane meanwhile: keep the
-            # winner, release this copy's reservation
-            acct.release(nbytes)
-            self._knn_planes.move_to_end(key)
-            return raced
-        self._attach_batcher(plane, knn=True)
-        self._knn_planes[key] = plane
-        self._knn_build_streak += 1
-        return plane
+        gen = KnnServingGeneration(plane, segments, field, self)
+        # evict ONLY at swap time, never before the build: the
+        # predecessor generations keep serving for the whole pack window
+        # (double-buffering — a pre-build eviction would leave a gap that
+        # concurrent probes fill with synchronous request-thread cold
+        # builds, the exact storm this module eliminates). The breaker
+        # transiently holds old+new, same as the lexical path.
+        new_ids = set(key[1])
+        with self._gen_lock:
+            raced = self._knn_planes.get(key)
+            if raced is not None:
+                # another thread built the same base meanwhile: keep the
+                # winner, release this copy's reservation
+                acct.release(nbytes)
+                self._knn_planes.move_to_end(key)
+                return raced
+            if self._closed:
+                acct.release(nbytes)
+                return None
+            # superseded generations of this field (identity overlap
+            # with the new base — a repack kept part of their list) +
+            # any LRU overflow go out as the new generation goes in
+            doomed = [ok for ok in self._knn_planes
+                      if ok[0] == field and ok != key
+                      and any(sid in new_ids for sid in ok[1])]
+            old_gens = [self._knn_planes.pop(ok) for ok in doomed]
+            while len(self._knn_planes) >= self.KNN_PLANE_CACHE_MAX:
+                _, g = self._knn_planes.popitem(last=False)
+                old_gens.append(g)
+            self._knn_planes[key] = gen
+        for g in old_gens:
+            self._release_gen(g)
+        self._attach_batcher(gen, knn=True)
+        self._record_rebuild("knn", trigger, mode)
+        return gen
+
+    # -- lifecycle -----------------------------------------------------------
 
     def release(self) -> None:
-        """Release every plane's breaker reservation (the owning index is
-        closing or being deleted)."""
-        from ..common.breakers import DEFAULT as _breakers
-        acct = _breakers.breaker("accounting")
-        for _sig, plane in self._planes.values():
-            acct.release(getattr(plane, "_acct_bytes", 0))
-            self._retire(plane)
-        for plane in self._knn_planes.values():
-            acct.release(getattr(plane, "_acct_bytes", 0))
-            self._retire(plane)
-        self._planes.clear()
-        self._knn_planes.clear()
+        """Release every generation's breaker reservation (the owning
+        index is closing or being deleted); in-flight repacks see
+        ``_closed`` and drop their build instead of swapping it in."""
+        with self._gen_lock:
+            self._closed = True
+            gens = list(self._planes.values()) + \
+                list(self._knn_planes.values())
+            self._planes.clear()
+            self._knn_planes.clear()
+        for gen in gens:
+            self._release_gen(gen)

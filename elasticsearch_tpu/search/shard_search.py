@@ -193,7 +193,8 @@ class ShardSearcher:
             if plane is not None:
                 from .microbatch import batched_knn_search
                 raw, phits = batched_knn_search(plane, qv,
-                                                k=num_candidates)
+                                                k=num_candidates,
+                                                view=self.segments)
                 cands = [
                     (self._knn_score_from_raw(ft.similarity, float(v))
                      * boost, si, d)
@@ -359,9 +360,14 @@ class ShardSearcher:
 
         # --- plane route (the production TPU kernel) ----------------------
         # Eligible bag-of-terms queries run through the tiered distributed
-        # plane: one dispatch returns top-k AND exact totals. Features that
-        # need per-doc masks (aggs, field sort) or reordering (rescore,
-        # collapse, search_after cursors) stay on the per-segment path.
+        # plane: one dispatch returns top-k AND exact totals. The provider
+        # hands back a serving GENERATION (packed base + append-only delta
+        # tier merged per dispatch — plane_route.py), or None both when
+        # the route is ineligible and while a structural change (merge/
+        # delete) has the base mid-repack on the background thread — the
+        # per-segment path below serves the gap. Features that need per-doc
+        # masks (aggs, field sort) or reordering (rescore, collapse,
+        # search_after cursors) stay on the per-segment path.
         plane_route = None
         if (self.plane_provider is not None and query_spec
                 and knn_override is None and window > 0
@@ -393,9 +399,12 @@ class ShardSearcher:
             from .microbatch import batched_search
             serving_stages = {}
             serving_info = {}
+            # view=self.segments: hit coordinates must decode against
+            # THIS searcher's snapshot even if a refresh mutates the
+            # generation's delta while the request sits in the queue
             pvals0, phits0, ptotal0 = batched_search(
                 plane, bag_terms, k=max(window, 1), stages=serving_stages,
-                info=serving_info)
+                info=serving_info, view=self.segments)
             total = int(ptotal0)
             candidates = [(float(v), si, d)
                           for v, (si, d) in zip(pvals0, phits0)]

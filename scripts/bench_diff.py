@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Diff two ``BENCH_*.json`` result files per config and gate on
+throughput regressions.
+
+Usage::
+
+    python scripts/bench_diff.py BENCH_r05.json BENCH_r06.json
+    python scripts/bench_diff.py old.json new.json --threshold 0.10
+
+Prints one line per comparable metric — the headline plus every entry in
+``configs`` that carries a throughput ``value`` (unit ``*/s``) — with the
+old/new numbers, the relative delta, and ``p99_ms`` movement where both
+sides report it. Exits **1** when any throughput metric regressed by
+more than ``--threshold`` (default 10%), so CI can ratchet on bench
+trajectories instead of eyeballing the ``BENCH_r*`` files.
+
+Configs present on only one side are listed as added/removed but never
+gate (a new config is not a regression); error-shaped configs
+(``{"error": ...}``) gate only if the other side had a real number —
+a config that stopped producing results IS a regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _is_throughput(doc) -> bool:
+    return (isinstance(doc, dict)
+            and isinstance(doc.get("value"), (int, float))
+            and str(doc.get("unit", "")).endswith("/s"))
+
+
+def _unwrap(doc: dict) -> dict:
+    """Accept both the raw bench line and the driver's wrapper (the
+    ``BENCH_r*.json`` files nest the bench JSON under ``parsed``)."""
+    if isinstance(doc.get("parsed"), dict) and (
+            "value" in doc["parsed"] or "configs" in doc["parsed"]):
+        return doc["parsed"]
+    return doc
+
+
+def _metrics(doc: dict):
+    """Flatten one bench JSON into {name: config-doc} — the headline
+    (top-level value/unit) under ``<metric>``, then every config."""
+    out = {}
+    if _is_throughput(doc):
+        # stable key: the metric string embeds n_docs, which differs
+        # across backends/scales and would break the pairing
+        out["headline"] = doc
+    for name, cfg in (doc.get("configs") or {}).items():
+        out[f"configs.{name}"] = cfg if isinstance(cfg, dict) else {}
+    return out
+
+
+def diff(old: dict, new: dict, threshold: float):
+    """Returns (report lines, regression names)."""
+    lines = []
+    regressions = []
+    om, nm = _metrics(old), _metrics(new)
+    for name in sorted(set(om) | set(nm)):
+        o, n = om.get(name), nm.get(name)
+        if o is None:
+            lines.append(f"  {name:40s} ADDED"
+                         + (f"  {n['value']} {n.get('unit', '')}"
+                            if _is_throughput(n) else ""))
+            continue
+        if n is None:
+            lines.append(f"  {name:40s} REMOVED")
+            if _is_throughput(o):
+                regressions.append(f"{name} (removed)")
+            continue
+        if not _is_throughput(o):
+            continue                     # nothing numeric to compare
+        if not _is_throughput(n):
+            lines.append(f"  {name:40s} {o['value']:>10.1f} -> ERROR "
+                         f"({str(n.get('error', 'no value'))[:60]})")
+            regressions.append(f"{name} (errored)")
+            continue
+        ov, nv = float(o["value"]), float(n["value"])
+        delta = (nv - ov) / ov if ov else 0.0
+        flag = ""
+        if delta < -threshold:
+            flag = "  << REGRESSION"
+            regressions.append(f"{name} ({delta:+.1%})")
+        p99 = ""
+        if isinstance(o.get("p99_ms"), (int, float)) and \
+                isinstance(n.get("p99_ms"), (int, float)):
+            p99 = f"  p99 {o['p99_ms']:.1f} -> {n['p99_ms']:.1f} ms"
+        lines.append(f"  {name:40s} {ov:>10.1f} -> {nv:>10.1f} "
+                     f"{n.get('unit', ''):12s} {delta:+7.1%}{p99}{flag}")
+    return lines, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two BENCH_*.json files; exit 1 on a >threshold "
+                    "throughput regression.")
+    ap.add_argument("old", help="baseline BENCH json")
+    ap.add_argument("new", help="candidate BENCH json")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative throughput drop that fails the diff "
+                         "(default 0.10 = 10%%)")
+    args = ap.parse_args(argv)
+    with open(args.old) as f:
+        old = _unwrap(json.load(f))
+    with open(args.new) as f:
+        new = _unwrap(json.load(f))
+    print(f"bench diff: {args.old} -> {args.new} "
+          f"(threshold {args.threshold:.0%})")
+    if old.get("backend") != new.get("backend"):
+        print(f"  NOTE: backends differ ({old.get('backend')} -> "
+              f"{new.get('backend')}) — deltas are not apples-to-apples")
+    lines, regressions = diff(old, new, args.threshold)
+    for ln in lines:
+        print(ln)
+    if regressions:
+        print(f"FAIL: {len(regressions)} throughput regression(s) past "
+              f"{args.threshold:.0%}:")
+        for r in regressions:
+            print(f"  - {r}")
+        return 1
+    print("OK: no throughput regression past the threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,632 @@
+"""Incremental serving planes: base generation + append-only delta tier
+(search/plane_route.py generations, parallel/dist_search.py delta
+scorers, background repack + atomic swap).
+
+Invariants under test:
+- an append-only refresh NEVER rebuilds the base on the request thread
+  (counting-stub assertions on ``DistributedSearchPlane`` construction);
+- base+delta serving is top-k- AND totals-exact against the per-segment
+  path when avgdl is unchanged (uniform doc lengths), and bit-equal to a
+  full repack pinned to the generation's frozen avgdl in general;
+- crossing the delta doc-fraction threshold repacks in the background
+  and atomically swaps generations (old base serves until the swap);
+- a structural change (merge) falls back to the per-segment path while
+  the background repack runs;
+- kNN delta serving is exactly exact (no corpus-wide stats);
+- a zero-doc refresh stays a plane-cache hit (regression: no plane
+  construction, no request-cache invalidation).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import elasticsearch_tpu.parallel.dist_search as ds
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.plane_route import ServingPlaneCache
+from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+MAPPING = {"properties": {"body": {"type": "text"},
+                          "vec": {"type": "dense_vector", "dims": 8,
+                                  "similarity": "cosine"}}}
+
+WORDS = ["quick", "brown", "fox", "dog", "lazy", "jump", "search",
+         "engine", "rank", "doc", "the", "of"]
+
+
+def _mk_segments(svc, n_segs, per, seed=7, uniform_len=None, start=0,
+                 prefix="s"):
+    """``uniform_len``: every doc gets exactly that many tokens, so the
+    corpus avgdl is invariant under appends (delta-serving is then exact
+    end-to-end); None draws ragged lengths."""
+    rng = np.random.RandomState(seed)
+    segs = []
+    doc = start
+    for si in range(n_segs):
+        b = SegmentBuilder(f"{prefix}{si}")
+        for _ in range(per):
+            n_tok = uniform_len or rng.randint(3, 12)
+            toks = [WORDS[min(rng.zipf(1.5) - 1, len(WORDS) - 1)]
+                    for _ in range(n_tok)]
+            b.add(svc.parse_document(str(doc), {"body": " ".join(toks)}),
+                  seq_no=doc)
+            doc += 1
+        segs.append(b.build())
+    return segs
+
+
+class _CountingPlane:
+    """Counting stub factory: monkeypatches DistributedSearchPlane with a
+    subclass that counts constructions (the satellite regression's
+    'assert via a counting stub')."""
+
+    def __init__(self, monkeypatch):
+        self.builds = 0
+        self.build_threads = []
+        outer = self
+        real = ds.DistributedSearchPlane
+
+        class Counting(real):
+            def __init__(self, *a, **kw):
+                outer.builds += 1
+                outer.build_threads.append(threading.current_thread().name)
+                super().__init__(*a, **kw)
+
+        monkeypatch.setattr(ds, "DistributedSearchPlane", Counting)
+
+
+QUERIES = [
+    {"match": {"body": "quick dog"}},
+    {"match": {"body": "the search engine"}},
+    {"term": {"body": "fox"}},
+    {"match": {"body": "quick quick lazy"}},
+]
+
+
+# ---------------------------------------------------------------------------
+# append-only delta: no rebuild, exact results
+# ---------------------------------------------------------------------------
+
+
+def test_append_only_refresh_serves_delta_without_rebuild(monkeypatch):
+    svc = MapperService(MAPPING)
+    counter = _CountingPlane(monkeypatch)
+    base_segs = _mk_segments(svc, 2, 20, uniform_len=5)
+    cache = ServingPlaneCache()
+    cache.REPACK_DELTA_FRACTION = 10.0       # keep the delta under threshold
+    gen = cache.plane_for(base_segs, svc, "body")
+    assert gen is not None and counter.builds == 1
+    # three successive "refreshes" append segments: same generation, zero
+    # further base constructions, delta grows
+    segs = list(base_segs)
+    for i in range(3):
+        segs = segs + _mk_segments(svc, 1, 2, seed=100 + i, uniform_len=5,
+                                   start=1000 + 10 * i, prefix=f"d{i}_")
+        g = cache.plane_for(segs, svc, "body")
+        assert g is gen
+        assert g.delta is not None and g.delta.n_docs == 2 * (i + 1)
+    assert counter.builds == 1, "append-only refresh repacked the base"
+
+
+@pytest.mark.parametrize("n_delta", [1, 3])
+def test_delta_serving_matches_per_segment_path_exactly(n_delta):
+    """Uniform doc lengths → avgdl is append-invariant → base+delta must
+    equal the live per-segment path bit-for-tie (ids, order, scores,
+    totals)."""
+    svc = MapperService(MAPPING)
+    base_segs = _mk_segments(svc, 2, 20, uniform_len=5)
+    cache = ServingPlaneCache()
+    cache.REPACK_DELTA_FRACTION = 10.0       # keep the delta under threshold
+    cache.plane_for(base_segs, svc, "body")          # base generation
+    segs = base_segs + _mk_segments(svc, n_delta, 3, seed=42,
+                                    uniform_len=5, start=500, prefix="d")
+    plane_s = ShardSearcher(
+        segs, svc,
+        plane_provider=lambda s, f: cache.plane_for(s, svc, f))
+    ref_s = ShardSearcher(segs, svc)
+    for q in QUERIES:
+        rp = plane_s.search({"query": q, "size": 10})
+        rr = ref_s.search({"query": q, "size": 10})
+        assert [h.doc_id for h in rp.hits] == \
+            [h.doc_id for h in rr.hits], q
+        np.testing.assert_allclose([h.score for h in rp.hits],
+                                   [h.score for h in rr.hits],
+                                   rtol=2e-5, err_msg=str(q))
+        assert rp.total == rr.total, q
+    gen = cache.plane_for(segs, svc, "body")
+    assert gen.delta is not None            # results DID ride the delta
+    assert cache.rebuild_stats()["delta_serves"] >= len(QUERIES)
+
+
+def test_delta_parity_vs_full_repack_at_frozen_avgdl():
+    """Ragged doc lengths: base+delta equals a FULL plane over all
+    segments pinned to the generation's frozen avgdl — the delta tier's
+    exactness contract (idf/totals exact; the avgdl drift is exactly the
+    frozen-stat window, closed by the next repack)."""
+    svc = MapperService(MAPPING)
+    base_segs = _mk_segments(svc, 2, 25, seed=3)
+    delta_segs = _mk_segments(svc, 2, 4, seed=9, start=700, prefix="d")
+    cache = ServingPlaneCache()
+    cache.REPACK_DELTA_FRACTION = 10.0
+    gen = cache.plane_for(base_segs, svc, "body")
+    assert cache.plane_for(base_segs + delta_segs, svc, "body") is gen
+    shards, _ = cache._pack_text_shards(base_segs + delta_segs, "body")
+    for s in shards:
+        s["avgdl"] = gen.avgdl               # pin the reference plane
+    ref = ds.DistributedSearchPlane(cache._get_mesh(), shards, "body")
+    queries = [["quick", "dog"], ["the", "search", "engine"],
+               ["fox", "fox", "lazy"], ["absentterm", "quick"]]
+    vals, hits, totals = gen.serve(queries, k=10, with_totals=True)
+    rvals, rhits, rtotals = ref.serve(queries, k=10, with_totals=True)
+    for bi in range(len(queries)):
+        assert hits[bi] == rhits[bi], queries[bi]
+        np.testing.assert_allclose(
+            np.asarray(vals[bi]), np.asarray(rvals[bi])[: len(vals[bi])],
+            rtol=2e-5)
+        assert totals[bi] == int(rtotals[bi]), queries[bi]
+
+
+# ---------------------------------------------------------------------------
+# background repack: threshold + structural
+# ---------------------------------------------------------------------------
+
+
+def test_threshold_crossing_repacks_in_background_and_swaps(monkeypatch):
+    svc = MapperService(MAPPING)
+    counter = _CountingPlane(monkeypatch)
+    base_segs = _mk_segments(svc, 2, 20, seed=5)
+    cache = ServingPlaneCache()
+    cache.REPACK_DELTA_FRACTION = 0.05       # 20*2 docs → >2 docs trips
+    gen1 = cache.plane_for(base_segs, svc, "body")
+    assert counter.builds == 1
+    segs = base_segs + _mk_segments(svc, 1, 8, seed=11, start=800,
+                                    prefix="d")
+    g = cache.plane_for(segs, svc, "body")
+    assert g is gen1                         # old base serves the request
+    cache.drain_repacks()
+    assert counter.builds == 2
+    # the repack ran OFF the request thread
+    assert any(t.startswith("plane-repack") for t in counter.build_threads)
+    gen2 = cache.plane_for(segs, svc, "body")
+    assert gen2 is not gen1
+    assert gen2.delta is None                # delta folded into the base
+    assert len(gen2.base_segments) == len(segs)
+    st = cache.rebuild_stats()
+    assert st["background"] == 1 and st["threshold"] == 1
+    # post-swap, scores equal the live per-segment path exactly again
+    plane_s = ShardSearcher(
+        segs, svc, plane_provider=lambda s, f: cache.plane_for(s, svc, f))
+    ref_s = ShardSearcher(segs, svc)
+    rp = plane_s.search({"query": {"match": {"body": "quick dog"}}})
+    rr = ref_s.search({"query": {"match": {"body": "quick dog"}}})
+    assert [h.doc_id for h in rp.hits] == [h.doc_id for h in rr.hits]
+    np.testing.assert_allclose([h.score for h in rp.hits],
+                               [h.score for h in rr.hits], rtol=2e-5)
+    # the superseded generation's warmup was retired
+    assert gen1._microbatcher._retired is True
+
+
+def test_structural_change_serves_per_segment_until_background_swap():
+    """A merge rewrites the base segment list: the generation cannot
+    decode hits against it, so plane_for returns None (per-segment path
+    serves) while the background repack builds the new base."""
+    svc = MapperService(MAPPING)
+    base_segs = _mk_segments(svc, 3, 10, seed=6)
+    cache = ServingPlaneCache()
+    gen1 = cache.plane_for(base_segs, svc, "body")
+    assert gen1 is not None
+    # "merge": all docs re-packed into one fresh segment object
+    b = SegmentBuilder("merged")
+    doc = 0
+    for seg in base_segs:
+        for local in range(seg.n_docs):
+            b.add(svc.parse_document(seg.doc_uids[local],
+                                     seg.sources[local]),
+                  seq_no=int(seg.seq_nos[local]))
+            doc += 1
+    merged = [b.build()]
+    assert cache.plane_for(merged, svc, "body") is None   # fallback gap
+    cache.drain_repacks()
+    gen2 = cache.plane_for(merged, svc, "body")
+    assert gen2 is not None and gen2 is not gen1
+    st = cache.rebuild_stats()
+    assert st["structure"] >= 1 and st["background"] >= 1
+    # searches through the searcher still correct during AND after
+    plane_s = ShardSearcher(
+        merged, svc, plane_provider=lambda s, f: cache.plane_for(s, svc, f))
+    ref_s = ShardSearcher(merged, svc)
+    rp = plane_s.search({"query": {"match": {"body": "quick"}}})
+    rr = ref_s.search({"query": {"match": {"body": "quick"}}})
+    assert [h.doc_id for h in rp.hits] == [h.doc_id for h in rr.hits]
+
+
+def test_sync_repack_mode_for_deterministic_callers():
+    svc = MapperService(MAPPING)
+    base_segs = _mk_segments(svc, 2, 10, seed=8)
+    cache = ServingPlaneCache()
+    cache.repack_mode = "sync"
+    cache.REPACK_DELTA_FRACTION = 0.01
+    gen1 = cache.plane_for(base_segs, svc, "body")
+    segs = base_segs + _mk_segments(svc, 1, 5, seed=2, start=900,
+                                    prefix="d")
+    gen2 = cache.plane_for(segs, svc, "body")
+    assert gen2 is not gen1 and gen2.delta is None
+    assert cache.rebuild_stats()["threshold"] == 1
+
+
+# ---------------------------------------------------------------------------
+# kNN delta tier
+# ---------------------------------------------------------------------------
+
+
+def _mk_vector_segments(svc, rng, n_segs, per, start=0, prefix="v"):
+    segs = []
+    uid = start
+    for si in range(n_segs):
+        b = SegmentBuilder(f"{prefix}{si}")
+        for _ in range(per):
+            doc = {"body": f"doc {uid}"}
+            if uid % 5 != 3:                 # some docs lack the vector
+                doc["vec"] = [float(x) for x in rng.randn(8)]
+            b.add(svc.parse_document(str(uid), doc), seq_no=uid)
+            uid += 1
+        segs.append(b.build())
+    return segs
+
+
+@pytest.mark.parametrize("similarity", ("cosine", "l2_norm",
+                                        "dot_product"))
+def test_knn_delta_serving_matches_per_segment_exactly(similarity):
+    mapping = {"properties": {"body": {"type": "text"},
+                              "vec": {"type": "dense_vector", "dims": 8,
+                                      "similarity": similarity}}}
+    svc = MapperService(mapping)
+    rng = np.random.RandomState(17)
+    base_segs = _mk_vector_segments(svc, rng, 2, 8)
+    cache = ServingPlaneCache()
+    cache.REPACK_DELTA_FRACTION = 10.0
+    gen = cache.knn_plane_for(base_segs, svc, "vec")
+    assert gen is not None
+    segs = base_segs + _mk_vector_segments(svc, rng, 1, 5, start=400,
+                                           prefix="dv")
+    routed = ShardSearcher(
+        segs, svc,
+        knn_plane_provider=lambda s, f: cache.knn_plane_for(s, svc, f))
+    plain = ShardSearcher(segs, svc)
+    body = {"knn": {"field": "vec",
+                    "query_vector": [float(x) for x in rng.randn(8)],
+                    "k": 6, "num_candidates": 12}, "size": 6}
+    r1 = routed.search(dict(body))
+    r2 = plain.search(dict(body))
+    g2 = cache.knn_plane_for(segs, svc, "vec")
+    assert g2 is gen and g2.delta is not None     # delta engaged, no rebuild
+    assert [h.doc_id for h in r1.hits] == [h.doc_id for h in r2.hits]
+    for h1, h2 in zip(r1.hits, r2.hits):
+        assert h1.score == pytest.approx(h2.score, rel=1e-5, abs=1e-5)
+
+
+def test_knn_threshold_repack_swaps_generation():
+    svc = MapperService(MAPPING)
+    rng = np.random.RandomState(23)
+    base_segs = _mk_vector_segments(svc, rng, 2, 10)
+    cache = ServingPlaneCache()
+    cache.REPACK_DELTA_FRACTION = 0.05
+    gen1 = cache.knn_plane_for(base_segs, svc, "vec")
+    segs = base_segs + _mk_vector_segments(svc, rng, 1, 6, start=300,
+                                           prefix="dv")
+    g = cache.knn_plane_for(segs, svc, "vec")
+    assert g is gen1
+    cache.drain_repacks()
+    gen2 = cache.knn_plane_for(segs, svc, "vec")
+    assert gen2 is not gen1 and gen2.delta is None
+    # superseded generation evicted from the LRU (breaker released)
+    assert all(g is not gen1 for g in cache._knn_planes.values())
+    # post-swap parity
+    routed = ShardSearcher(
+        segs, svc,
+        knn_plane_provider=lambda s, f: cache.knn_plane_for(s, svc, f))
+    plain = ShardSearcher(segs, svc)
+    body = {"knn": {"field": "vec",
+                    "query_vector": [float(x) for x in rng.randn(8)],
+                    "k": 5, "num_candidates": 10}, "size": 5}
+    r1 = routed.search(dict(body))
+    r2 = plain.search(dict(body))
+    assert [h.doc_id for h in r1.hits] == [h.doc_id for h in r2.hits]
+
+
+# ---------------------------------------------------------------------------
+# engine/refresh integration + the zero-doc-refresh regression
+# ---------------------------------------------------------------------------
+
+
+def test_zero_doc_refresh_is_plane_cache_hit(monkeypatch, tmp_path):
+    """Satellite regression: a refresh that adds zero docs keeps the
+    segment signature, so identical bodies stay request-cache hits and
+    NO plane is constructed (counting stub)."""
+    from elasticsearch_tpu.node.indices_service import IndexService
+    svc = IndexService("zr", str(tmp_path), mappings={
+        "properties": {"body": {"type": "text"}}})
+    for i in range(8):
+        svc.index_doc(str(i), {"body": f"quick fox doc{i}"})
+    svc.refresh()
+    counter = _CountingPlane(monkeypatch)
+    body = {"query": {"match": {"body": "quick"}}}
+    r1 = svc.search(body)
+    assert counter.builds == 1 and \
+        svc.plane_cache_stats["miss_count"] == 1
+    svc.refresh()                            # zero docs: signature keeps
+    r2 = svc.search(body)
+    assert counter.builds == 1, "zero-doc refresh rebuilt the plane"
+    assert svc.plane_cache_stats["hit_count"] == 1
+    assert [h.doc_id for h in r2.hits] == [h.doc_id for h in r1.hits]
+    # a buffered (unrefreshed) doc is search-invisible: still a hit
+    svc.index_doc("buf", {"body": "quick buffered"})
+    r3 = svc.search(body)
+    assert counter.builds == 1
+    assert svc.plane_cache_stats["hit_count"] == 2
+    assert r3.total == r1.total
+    svc.close()
+
+
+def test_refresh_listener_prepacks_delta_before_first_search(monkeypatch,
+                                                             tmp_path):
+    """The engine refresh hook reconciles generations on the indexing
+    thread: after a refresh, the generation already carries the new
+    segment in its delta tier BEFORE any search arrives."""
+    from elasticsearch_tpu.node.indices_service import IndexService
+    svc = IndexService("nr", str(tmp_path), mappings={
+        "properties": {"body": {"type": "text"}}})
+    for i in range(8):
+        svc.index_doc(str(i), {"body": f"quick fox doc{i}"})
+    svc.refresh()
+    svc.search({"query": {"match": {"body": "quick"}}})   # cold build
+    gen = svc.plane_cache._planes["body"]
+    counter = _CountingPlane(monkeypatch)
+    svc.index_doc("new", {"body": "quick fresh"})
+    svc.refresh()                            # listener fires here
+    assert gen.delta is not None and gen.delta.n_docs == 1
+    assert counter.builds == 0
+    r = svc.search({"query": {"match": {"body": "quick"}}})
+    assert r.total == 9
+    svc.close()
+
+
+def test_live_indexing_request_thread_never_repacks(monkeypatch, tmp_path):
+    """The acceptance invariant end-to-end: interleaved index+refresh+
+    search under the delta threshold performs ZERO synchronous base
+    repacks after the cold build, and every response stays correct."""
+    from elasticsearch_tpu.node.indices_service import IndexService
+    svc = IndexService("li", str(tmp_path), mappings={
+        "properties": {"body": {"type": "text"}}})
+    for i in range(64):
+        svc.index_doc(str(i), {"body": f"quick fox doc{i} extra words"})
+    svc.refresh()
+    counter = _CountingPlane(monkeypatch)
+    svc.search({"query": {"match": {"body": "quick"}}},
+               request_cache=False)
+    assert counter.builds == 1               # cold build only
+    total = 64
+    for i in range(4):                       # 4 refreshes × 1 doc << 12.5%
+        svc.index_doc(f"n{i}", {"body": f"quick new{i}"})
+        svc.refresh()
+        total += 1
+        r = svc.search({"query": {"match": {"body": "quick"}}},
+                       request_cache=False)
+        assert r.total == total
+    assert counter.builds == 1, \
+        "live indexing under threshold forced a synchronous repack"
+    assert svc.plane_cache.rebuild_stats()["sync"] == 1   # the cold build
+    svc.close()
+
+
+def test_delta_stats_surface(tmp_path):
+    """plane_serving stats expose delta serving + rebuild counts."""
+    from elasticsearch_tpu.node.indices_service import IndexService
+    svc = IndexService("st", str(tmp_path), mappings={
+        "properties": {"body": {"type": "text"}}})
+    for i in range(8):
+        svc.index_doc(str(i), {"body": f"quick fox doc{i}"})
+    svc.refresh()
+    svc.search({"query": {"match": {"body": "quick"}}},
+               request_cache=False)
+    svc.index_doc("new", {"body": "quick fresh"})
+    svc.refresh()
+    svc.search({"query": {"match": {"body": "quick"}}},
+               request_cache=False)
+    ps = svc.plane_serving_stats()
+    assert ps["delta_queries"] >= 1
+    assert ps["delta_served_queries"] >= 1
+    assert ps["rebuilds_sync"] == 1 and ps["rebuilds_background"] == 0
+    # the registry carries the same families
+    from elasticsearch_tpu.common.telemetry import DEFAULT
+    doc = DEFAULT.stats_doc()
+    assert "es_plane_rebuild_total" in doc
+    assert "es_plane_delta_serve_total" in doc
+    assert "es_plane_cache_requests_total" in doc
+    svc.close()
+
+
+def test_multi_shard_interleaved_appends_remap_base_positions(monkeypatch,
+                                                              tmp_path):
+    """A multi-shard index flattens per-shard segment lists, so a refresh
+    on shard 0 INSERTS its new segment between shard 0's and shard 1's
+    existing segments — the identity-subsequence match must still find
+    the base (and remap its hit coordinates) instead of repacking."""
+    from elasticsearch_tpu.node.indices_service import IndexService
+    from elasticsearch_tpu.search.shard_search import ShardSearcher as SS
+    svc = IndexService("msd", str(tmp_path),
+                       settings={"number_of_shards": 3},
+                       mappings={"properties": {"body": {"type": "text"}}})
+    svc.plane_cache.REPACK_DELTA_FRACTION = 10.0
+    for i in range(30):
+        svc.index_doc(str(i), {"body": f"quick fox doc{i} pad pad"})
+    svc.refresh()
+    svc.search({"query": {"match": {"body": "quick"}}},
+               request_cache=False)                    # cold build
+    counter = _CountingPlane(monkeypatch)
+    for i in range(12):                 # docs hash across all 3 shards
+        svc.index_doc(f"x{i}", {"body": f"quick extra{i} pad pad pad"})
+    svc.refresh()
+    r = svc.search({"query": {"match": {"body": "quick"}}, "size": 42},
+                   request_cache=False)
+    assert counter.builds == 0, \
+        "interleaved multi-shard append was treated as structural"
+    segs = [seg for sh in svc.shards for seg in sh.searchable_segments()]
+    gen = svc.plane_cache._planes["body"]
+    assert gen.delta is not None and gen.delta.n_docs == 12
+    rr = SS(segs, svc.mapper).search(
+        {"query": {"match": {"body": "quick"}}, "size": 42})
+    assert [h.doc_id for h in r.hits] == [h.doc_id for h in rr.hits]
+    np.testing.assert_allclose([h.score for h in r.hits],
+                               [h.score for h in rr.hits], rtol=2e-5)
+    assert r.total == rr.total == 42
+    svc.close()
+
+
+def test_dispatch_view_pins_hit_space_across_refresh_race():
+    """A refresh landing between a caller's plane_for and its dispatch
+    mutates the generation's live delta — the dispatch must still serve
+    the CALLER's segment view (coordinates in its snapshot space), not
+    the newer delta's."""
+    svc = MapperService(MAPPING)
+    base_segs = _mk_segments(svc, 2, 15, uniform_len=5)
+    cache = ServingPlaneCache()
+    cache.REPACK_DELTA_FRACTION = 10.0
+    gen = cache.plane_for(base_segs, svc, "body")
+    ref_base = ShardSearcher(base_segs, svc).search(
+        {"query": {"match": {"body": "quick"}}})
+    # the "race": a newer list updates the generation's live delta
+    segs3 = base_segs + _mk_segments(svc, 1, 4, uniform_len=5, seed=77,
+                                     start=900, prefix="race")
+    assert cache.plane_for(segs3, svc, "body") is gen
+    assert gen.delta is not None and gen.delta.n_docs == 4
+    # dispatch pinned to the OLD view: results must equal the base-only
+    # reference, with every coordinate inside the 2-segment snapshot
+    vals, hits, totals = gen.serve_view(
+        [["quick"]], k=10, view=base_segs, with_totals=True)
+    assert all(si < len(base_segs) for si, _ in hits[0])
+    assert totals[0] == ref_base.total
+    ref_ids = [(h.seg_idx, h.local_doc) for h in ref_base.hits]
+    assert hits[0][: len(ref_ids)] == ref_ids
+    # the same dispatch for the NEW view sees the delta docs
+    _, _, totals3 = gen.serve_view([["quick"]], k=10, view=segs3,
+                                   with_totals=True)
+    ref3 = ShardSearcher(segs3, svc).search(
+        {"query": {"match": {"body": "quick"}}})
+    assert totals3[0] == ref3.total > ref_base.total
+
+
+def test_knn_repack_keeps_old_generation_serving_during_build(monkeypatch):
+    """Double-buffering: the background kNN repack must not evict the
+    serving generation before its replacement is built — probes during
+    the pack window must still find it (no request-thread cold build)."""
+    svc = MapperService(MAPPING)
+    rng = np.random.RandomState(5)
+    base_segs = _mk_vector_segments(svc, rng, 2, 10)
+    cache = ServingPlaneCache()
+    cache.REPACK_DELTA_FRACTION = 0.05
+    cache.repack_mode = "sync"
+    gen1 = cache.knn_plane_for(base_segs, svc, "vec")
+    assert gen1 is not None
+    seen_during_build = []
+    real = ds.DistributedKnnPlane
+
+    class Probing(real):
+        def __init__(self, *a, **kw):
+            # mid-build, the old generation must still be cached
+            seen_during_build.append(
+                any(g is gen1 for g in cache._knn_planes.values()))
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(ds, "DistributedKnnPlane", Probing)
+    segs = base_segs + _mk_vector_segments(svc, rng, 1, 6, start=300,
+                                           prefix="dv")
+    g = cache.knn_plane_for(segs, svc, "vec")   # sync: repack runs inline
+    assert seen_during_build == [True], \
+        "old kNN generation evicted before its replacement was built"
+    gen2 = cache.knn_plane_for(segs, svc, "vec")
+    assert gen2 is not gen1
+    assert all(g2 is not gen1 for g2 in cache._knn_planes.values())
+
+
+def test_multi_shard_knn_notify_does_not_cross_shard_deltas(tmp_path):
+    """Refresh reconcile must never treat ANOTHER index shard's corpus
+    as a per-shard kNN generation's delta tier (which would schedule
+    repacks onto pooled lists no per-shard probe can match)."""
+    from elasticsearch_tpu.node.indices_service import IndexService
+    svc = IndexService(
+        "mk", str(tmp_path), settings={"number_of_shards": 2},
+        mappings={"properties": {
+            "body": {"type": "text"},
+            "vec": {"type": "dense_vector", "dims": 8,
+                    "similarity": "cosine"}}})
+    rng = np.random.RandomState(9)
+    for i in range(24):
+        svc.index_doc(str(i), {"body": f"quick doc{i}",
+                               "vec": [float(x) for x in rng.randn(8)]})
+    svc.refresh()
+    qv = [float(x) for x in rng.randn(8)]
+    body = {"knn": {"field": "vec", "query_vector": qv, "k": 4,
+                    "num_candidates": 10}, "size": 4}
+    r1 = svc.search(dict(body))                 # builds per-shard gens
+    gens = list(svc.plane_cache._knn_planes.values())
+    assert gens
+    # one more doc + refresh: the reconcile fires with per-shard lists
+    svc.index_doc("extra", {"body": "quick extra",
+                            "vec": [float(x) for x in rng.randn(8)]})
+    svc.refresh()
+    st = svc.plane_cache.rebuild_stats()
+    assert st["background"] == 0, \
+        "cross-shard delta misattribution scheduled a repack"
+    for gen in svc.plane_cache._knn_planes.values():
+        # a generation's delta is at most the one appended doc, never
+        # the other shard's corpus
+        assert gen.delta_docs() <= 1
+    r2 = svc.search(dict(body))
+    from elasticsearch_tpu.search.dist_query import DistributedSearcher
+    ref = DistributedSearcher(
+        [sh.searchable_segments() for sh in svc.shards],
+        svc.mapper).search(dict(body))
+    assert [h.doc_id for h in r2.hits] == [h.doc_id for h in ref.hits]
+    svc.close()
+
+
+def test_concurrent_delta_search_and_repack_stay_consistent():
+    """Searches racing a background repack never error and always return
+    the full doc set (old generation serves until the swap)."""
+    svc = MapperService(MAPPING)
+    base_segs = _mk_segments(svc, 2, 30, uniform_len=5, seed=4)
+    cache = ServingPlaneCache()
+    cache.REPACK_DELTA_FRACTION = 0.01
+    cache.plane_for(base_segs, svc, "body")
+    segs = base_segs + _mk_segments(svc, 1, 10, uniform_len=5, seed=12,
+                                    start=600, prefix="d")
+    searcher = ShardSearcher(
+        segs, svc, plane_provider=lambda s, f: cache.plane_for(s, svc, f))
+    ref_total = ShardSearcher(segs, svc).search(
+        {"query": {"match": {"body": "quick"}}}).total
+    errs, totals = [], []
+    lock = threading.Lock()
+
+    def client():
+        try:
+            for _ in range(5):
+                r = searcher.search({"query": {"match": {"body": "quick"}}})
+                with lock:
+                    totals.append(r.total)
+                time.sleep(0.001)
+        except Exception as e:               # noqa: BLE001
+            with lock:
+                errs.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    cache.drain_repacks()
+    assert not errs
+    assert set(totals) == {ref_total}

@@ -158,15 +158,23 @@ def test_plane_bypassed_for_features_and_deletes():
     assert cache.plane_for(plane_s.segments, svc, "body") is None
 
 
-def test_plane_cache_invalidation_on_new_segment():
+def test_plane_cache_new_segment_joins_delta_tier_not_rebuild():
+    """An append-only refresh must NOT invalidate the base plane: the
+    SAME generation keeps serving, with the new segment riding its delta
+    tier — only a repack (threshold / structural change) swaps bases."""
     svc, segments = _mk_segments(n_segments=2)
     cache = ServingPlaneCache()
     p1 = cache.plane_for(segments, svc, "body")
     assert cache.plane_for(segments, svc, "body") is p1     # cached
+    base1 = p1.base
     b = SegmentBuilder("_x")
     b.add(svc.parse_document("new", {"body": "fresh quick doc"}), seq_no=99)
     p2 = cache.plane_for(segments + [b.build()], svc, "body")
-    assert p2 is not p1
+    assert p2 is p1 and p2.base is base1    # base survived the refresh
+    assert p2.delta is not None and p2.delta.n_docs == 1
+    # the base segment list alone maps back to a pure base hit
+    p3 = cache.plane_for(segments, svc, "body")
+    assert p3 is p1 and p3.delta is None
 
 
 def test_rest_bulk_then_search_runs_plane():
