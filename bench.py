@@ -325,13 +325,32 @@ def _telemetry_snapshot() -> dict:
     try:
         from elasticsearch_tpu.common.telemetry import device_stats_doc
         doc = device_stats_doc()
-        return {
+        out = {
             "compiles": doc.get("compiles", {}),
             "compile_millis": doc.get("compile_millis", {}),
             "transfer_bytes": doc.get("transfer", {}),
             "live_array_bytes_watermark":
                 doc.get("live_array_bytes_watermark", 0),
         }
+        # per-task resource attribution rollup (es_task_* families):
+        # the serving benches run through RestAPI.handle, so the
+        # attribution overhead and its outputs land in the trajectory
+        try:
+            from elasticsearch_tpu.common.telemetry import DEFAULT
+            snap = DEFAULT.stats_doc()
+            tasks = {}
+            for fam in ("es_task_cpu_millis_total",
+                        "es_task_device_millis_total",
+                        "es_task_docs_scanned_total"):
+                f = snap.get(fam)
+                if f:
+                    tasks[fam] = round(sum(
+                        s["value"] for s in f["series"]), 1)
+            if tasks:
+                out["task_attribution"] = tasks
+        except Exception:   # noqa: BLE001 — optional section
+            pass
+        return out
     except Exception as e:   # noqa: BLE001 — telemetry must never cost
         return {"error": repr(e)[:200]}    # the headline number
 

@@ -1,0 +1,391 @@
+"""Cluster health report: the 8.x indicator API over the telemetry layer.
+
+Reference: ``GET /_health_report`` (``health/HealthService.java`` +
+one ``HealthIndicatorService`` per concern) — each indicator evaluates
+live node state into ``green``/``yellow``/``red`` with a human
+``symptom``, machine ``details``, and, when degraded, reference-shaped
+``impacts`` (what stops working) and ``diagnosis`` (cause → action).
+The top-level ``status`` is the worst indicator.
+
+The TPU-native indicators are registry-driven — they read the SAME
+counters ``/_prometheus/metrics`` exposes, so an alert and the health
+report can never disagree:
+
+- ``shards_availability`` — unassigned/active shard counts (the cluster
+  front recomputes this from the published routing table, where ``red``
+  is reachable; the single-node view caps at ``yellow``).
+- ``plane_serving`` — synchronous request-thread plane rebuilds beyond
+  the cold builds. Per TELEMETRY.md, ``es_plane_rebuild_total{mode=
+  "sync"}`` rising past the cold count is the rebuild-storm signature
+  (every refresh repacking the serving plane on request threads).
+- ``compile_churn`` — steady-state XLA compiles: compiles recorded past
+  what the warmup lattice pre-compiled mean first-hit compiles are
+  landing mid-traffic (the multi-second p99 signature).
+- ``breakers`` — circuit-breaker trips (parent trip → red).
+- ``indexing_pressure`` — 429 rejections + current bytes vs the budget.
+- ``task_backlog`` — live registered tasks and the oldest task's age.
+
+Evaluation is snapshot-time only (never on a request path) and each
+indicator is fail-safe: an indicator that throws reports itself
+``unknown`` instead of failing the endpoint.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+GREEN, YELLOW, RED, UNKNOWN = "green", "yellow", "red", "unknown"
+
+_RANK = {GREEN: 0, UNKNOWN: 1, YELLOW: 2, RED: 3}
+
+
+def worst_status(statuses) -> str:
+    return max(statuses, key=lambda s: _RANK.get(s, 1), default=GREEN)
+
+
+def _impact(id_: str, severity: int, description: str,
+            areas: List[str]) -> dict:
+    return {"id": id_, "severity": severity, "description": description,
+            "impact_areas": areas}
+
+
+def _diagnosis(id_: str, cause: str, action: str,
+               affected: Optional[dict] = None) -> dict:
+    return {"id": id_, "cause": cause, "action": action,
+            "help_url": "TELEMETRY.md",
+            "affected_resources": affected or {}}
+
+
+class HealthService:
+    """Evaluates every indicator against one node's live surfaces.
+
+    ``api`` is the node's ``RestAPI`` (indices, task manager, plane
+    caches); the process telemetry registry and breaker/pressure
+    singletons are read directly."""
+
+    INDICATORS = ("shards_availability", "plane_serving", "compile_churn",
+                  "breakers", "indexing_pressure", "task_backlog")
+
+    #: sync non-cold rebuilds: first one turns yellow, a storm turns red
+    SYNC_REBUILD_YELLOW = 1
+    SYNC_REBUILD_RED = 8
+    #: steady-state compiles past the warmed lattice before degrading
+    COMPILE_SLACK = 4
+    COMPILE_RED = 64
+    #: live-task backlog thresholds
+    BACKLOG_YELLOW = 64
+    BACKLOG_RED = 512
+    OLDEST_TASK_YELLOW_S = 60.0
+    OLDEST_TASK_RED_S = 300.0
+    #: indexing-pressure utilization fraction that reads as saturation
+    PRESSURE_YELLOW_FRACTION = 0.8
+
+    def __init__(self, api):
+        self.api = api
+
+    # -- entry ---------------------------------------------------------------
+
+    def report(self, indicator: Optional[str] = None,
+               verbose: bool = True) -> dict:
+        from .errors import ResourceNotFoundError
+        names = self.INDICATORS
+        if indicator is not None:
+            if indicator not in self.INDICATORS:
+                raise ResourceNotFoundError(
+                    f"health indicator [{indicator}] does not exist; "
+                    f"known indicators are {sorted(self.INDICATORS)}")
+            names = (indicator,)
+        indicators: Dict[str, dict] = {}
+        for name in names:
+            try:
+                doc = getattr(self, f"_ind_{name}")()
+            except Exception as e:   # noqa: BLE001 — one broken indicator
+                doc = {"status": UNKNOWN,          # must not fail the API
+                       "symptom": f"indicator evaluation failed: {e}"}
+            if not verbose:
+                doc = {k: v for k, v in doc.items()
+                       if k in ("status", "symptom")}
+            indicators[name] = doc
+        return {
+            "status": worst_status(d["status"]
+                                   for d in indicators.values()),
+            "cluster_name": self.api.cluster_name,
+            "indicators": indicators,
+        }
+
+    # -- indicators ----------------------------------------------------------
+
+    def _ind_shards_availability(self) -> dict:
+        h = self.api._health()
+        unassigned = int(h.get("unassigned_shards", 0))
+        active = int(h.get("active_shards", 0))
+        status = {"green": GREEN, "yellow": YELLOW,
+                  "red": RED}.get(h.get("status"), UNKNOWN)
+        doc = {
+            "status": status,
+            "symptom": ("This cluster has all shards available."
+                        if status == GREEN else
+                        f"This cluster has {unassigned} unassigned "
+                        f"shard{'s' if unassigned != 1 else ''}."),
+            "details": {"active_shards": active,
+                        "unassigned_shards": unassigned,
+                        "active_primary_shards":
+                            int(h.get("active_primary_shards", 0))},
+        }
+        if status != GREEN:
+            doc["impacts"] = [_impact(
+                "shards_availability:degraded", 2,
+                "Searches may return partial results and writes may be "
+                "rejected for unassigned shards.", ["search", "ingest"])]
+            doc["diagnosis"] = [_diagnosis(
+                "shards_availability:unassigned",
+                f"{unassigned} shard copies are not assigned to any "
+                f"live node (replica count exceeds allocatable nodes, "
+                f"or owning nodes left the cluster).",
+                "Add data nodes, lower index.number_of_replicas, or "
+                "POST /_cluster/reroute?retry_failed=true.")]
+        return doc
+
+    def _ind_plane_serving(self) -> dict:
+        sync = cold = background = 0
+        delta_serves = 0
+        per_index: Dict[str, int] = {}
+        for name, svc in list(self.api.indices.indices.items()):
+            try:
+                rb = svc.plane_cache.rebuild_stats()
+            except Exception:   # noqa: BLE001 — no plane cache: skip
+                continue
+            sync += rb.get("sync", 0)
+            cold += rb.get("cold", 0)
+            background += rb.get("background", 0)
+            delta_serves += rb.get("delta_serves", 0)
+            storm_i = rb.get("sync", 0) - rb.get("cold", 0)
+            if storm_i > 0:
+                per_index[name] = storm_i
+        # every cold build is mode="sync"; a sync count past the cold
+        # count means NON-cold repacks ran on request threads — the
+        # rebuild-storm signature (TELEMETRY.md es_plane_rebuild_total)
+        storm = max(sync - cold, 0)
+        if storm >= self.SYNC_REBUILD_RED:
+            status = RED
+        elif storm >= self.SYNC_REBUILD_YELLOW:
+            status = YELLOW
+        else:
+            status = GREEN
+        doc = {
+            "status": status,
+            "symptom": ("Serving planes are maintained off the request "
+                        "path." if status == GREEN else
+                        f"{storm} synchronous serving-plane rebuilds ran "
+                        f"on request threads (rebuild storm)."),
+            "details": {"sync_rebuilds": sync, "cold_builds": cold,
+                        "background_repacks": background,
+                        "sync_noncold_rebuilds": storm,
+                        "delta_served_queries": delta_serves,
+                        "storming_indices": per_index},
+        }
+        if status != GREEN:
+            doc["impacts"] = [_impact(
+                "plane_serving:rebuild_storm", 1,
+                "Search requests stall behind full plane repacks "
+                "(O(postings) pack + device upload per refresh); p99 "
+                "collapses under live indexing.", ["search"])]
+            doc["diagnosis"] = [_diagnosis(
+                "plane_serving:sync_rebuilds",
+                "Refreshes are invalidating serving planes faster than "
+                "the background repack absorbs them, or delta-tier "
+                "serving is disabled (ES_TPU_PLANE_DELTA=0).",
+                "Re-enable delta serving, raise "
+                "ES_TPU_PLANE_DELTA_FRACTION, or lower the refresh "
+                "rate; watch es_plane_rebuild_total{mode=\"sync\"}.",
+                {"indices": sorted(per_index)})]
+        return doc
+
+    def _ind_compile_churn(self) -> dict:
+        from . import telemetry as _tm
+        compiles = _tm.compile_count()
+        warmed = 0
+        doc_reg = _tm.DEFAULT.stats_doc().get(
+            "es_plane_serving_warmed_shapes_total")
+        if doc_reg:
+            warmed = int(sum(s["value"] for s in doc_reg["series"]))
+        excess = max(compiles - warmed, 0)
+        if excess > self.COMPILE_RED:
+            status = RED
+        elif excess > self.COMPILE_SLACK:
+            status = YELLOW
+        else:
+            status = GREEN
+        doc = {
+            "status": status,
+            "symptom": ("XLA compiles are covered by the warmup "
+                        "lattice." if status == GREEN else
+                        f"{excess} XLA compiles landed outside the "
+                        f"warmup lattice (steady-state compile churn)."),
+            "details": {"compiles_total": compiles,
+                        "warmed_shapes_total": warmed,
+                        "excess_compiles": excess},
+        }
+        if status != GREEN:
+            doc["impacts"] = [_impact(
+                "compile_churn:first_hit_compiles", 2,
+                "First requests of an uncompiled shape pay multi-second "
+                "XLA compiles mid-traffic (serving p99 spikes).",
+                ["search"])]
+            doc["diagnosis"] = [_diagnosis(
+                "compile_churn:unwarmed_shapes",
+                "Serving dispatches hit input shapes the warmup lattice "
+                "never pre-compiled (new k buckets, ragged batch sizes, "
+                "or ES_TPU_SERVING_WARMUP=0).",
+                "Check es_xla_compiles_by_shape_total for the offending "
+                "shapes and widen the warmup ks / batch lattice.")]
+        return doc
+
+    def _ind_breakers(self) -> dict:
+        from .breakers import DEFAULT as svc
+        tripped = {}
+        details = {}
+        for name, st in svc.stats().items():
+            details[name] = {
+                "estimated_bytes": st["estimated_size_in_bytes"],
+                "limit_bytes": st["limit_size_in_bytes"],
+                "tripped": st["tripped"]}
+            if st["tripped"]:
+                tripped[name] = st["tripped"]
+        if tripped.get("parent"):
+            status = RED
+        elif tripped:
+            status = YELLOW
+        else:
+            status = GREEN
+        doc = {
+            "status": status,
+            "symptom": ("No circuit breakers have tripped."
+                        if status == GREEN else
+                        f"Circuit breakers tripped: "
+                        f"{', '.join(sorted(tripped))}."),
+            "details": details,
+        }
+        if status != GREEN:
+            doc["impacts"] = [_impact(
+                "breakers:rejections", 1 if status == RED else 2,
+                "Requests over the tripped budget are rejected with "
+                "429 circuit_breaking_exception.", ["search", "ingest"])]
+            doc["diagnosis"] = [_diagnosis(
+                "breakers:memory_pressure",
+                f"Memory budgets exhausted on "
+                f"{', '.join(sorted(tripped))}.",
+                "Reduce concurrent request size/fan-out, shrink "
+                "fielddata usage, or raise the breaker limits.")]
+        return doc
+
+    def _ind_indexing_pressure(self) -> dict:
+        from .indexing_pressure import DEFAULT as ip
+        frac = (ip.current_bytes / ip.limit_bytes) if ip.limit_bytes else 0
+        if ip.rejections and frac >= self.PRESSURE_YELLOW_FRACTION:
+            status = RED
+        elif ip.rejections or frac >= self.PRESSURE_YELLOW_FRACTION:
+            status = YELLOW
+        else:
+            status = GREEN
+        doc = {
+            "status": status,
+            "symptom": ("Indexing pressure is within budget."
+                        if status == GREEN else
+                        f"Indexing pressure degraded: {ip.rejections} "
+                        f"rejections, {int(frac * 100)}% of the byte "
+                        f"budget in flight."),
+            "details": {"current_bytes": ip.current_bytes,
+                        "limit_bytes": ip.limit_bytes,
+                        "total_bytes": ip.total_bytes,
+                        "rejections": ip.rejections},
+        }
+        if status != GREEN:
+            doc["impacts"] = [_impact(
+                "indexing_pressure:rejections", 2,
+                "Bulk/index requests beyond the byte budget are "
+                "rejected with 429.", ["ingest"])]
+            doc["diagnosis"] = [_diagnosis(
+                "indexing_pressure:saturation",
+                "Concurrent indexing payload bytes exceed the node's "
+                "indexing-pressure budget.",
+                "Reduce bulk concurrency/size or add indexing "
+                "capacity.")]
+        return doc
+
+    def _ind_task_backlog(self) -> dict:
+        tm = self.api.task_manager
+        with tm.lock:
+            live = list(tm.tasks.values())
+        now = time.time()
+        # monitor-lane tasks (including the health-report request
+        # itself) are not backlog
+        others = [t for t in live if ":monitor/" not in t.action]
+        count = len(others)
+        oldest_s = max((now - t.start_time for t in others), default=0.0)
+        if count > self.BACKLOG_RED or oldest_s > self.OLDEST_TASK_RED_S:
+            status = RED
+        elif count > self.BACKLOG_YELLOW or \
+                oldest_s > self.OLDEST_TASK_YELLOW_S:
+            status = YELLOW
+        else:
+            status = GREEN
+        doc = {
+            "status": status,
+            "symptom": ("The task backlog is nominal."
+                        if status == GREEN else
+                        f"{count} live tasks; oldest has run "
+                        f"{oldest_s:.0f}s."),
+            "details": {"running_tasks": len(live),
+                        "running_non_monitor_tasks": count,
+                        "oldest_task_age_seconds": round(oldest_s, 1)},
+        }
+        if status != GREEN:
+            doc["impacts"] = [_impact(
+                "task_backlog:queueing", 3,
+                "Requests queue behind a deep task backlog; latency "
+                "grows.", ["search", "ingest"])]
+            doc["diagnosis"] = [_diagnosis(
+                "task_backlog:long_running",
+                "Long-running or piling-up tasks (check "
+                "GET /_tasks?detailed for their resource_stats).",
+                "Cancel runaway tasks via POST /_tasks/{id}/_cancel or "
+                "add capacity.")]
+        return doc
+
+
+def merge_reports(local: dict, remote_docs: Dict[str, dict]) -> dict:
+    """Cluster fan-in: fold per-node reports into one (the reference
+    computes indicators on the coordinating node from cluster state;
+    here each node evaluates its registry-local view and the front takes
+    the worst per indicator, keeping a per-node status map in details).
+    ``remote_docs``: node_id -> that node's local report."""
+    merged = {"cluster_name": local.get("cluster_name"),
+              "indicators": {}}
+    all_docs = dict(remote_docs)
+    names = set(local.get("indicators", ()))
+    for doc in all_docs.values():
+        names.update(doc.get("indicators", ()))
+    for name in sorted(names):
+        per_node = {}
+        worst_doc = None
+        worst = GREEN
+        for node_id, rep in all_docs.items():
+            ind = (rep.get("indicators") or {}).get(name)
+            if not ind:
+                continue
+            per_node[node_id] = ind.get("status", UNKNOWN)
+            if worst_doc is None or \
+                    _RANK.get(ind.get("status"), 1) > _RANK.get(worst, 1):
+                worst_doc = ind
+                worst = ind.get("status", UNKNOWN)
+        out = dict(worst_doc or {"status": UNKNOWN,
+                                 "symptom": "no node reported"})
+        details = dict(out.get("details") or {})
+        details["nodes"] = per_node
+        out["details"] = details
+        merged["indicators"][name] = out
+    merged["status"] = worst_status(
+        d["status"] for d in merged["indicators"].values())
+    return merged

@@ -107,23 +107,52 @@ class Gauge:
 
 class Histogram:
     """Bounded-sample histogram: monotonic count/sum plus a ring of the
-    most recent ``cap`` observations for snapshot-time percentiles."""
+    most recent ``cap`` observations for snapshot-time percentiles.
 
-    __slots__ = ("count", "sum", "_ring", "_lock")
+    Observations may carry an *exemplar* — a trace id (or any short
+    correlation token) kept in its own bounded ring — so a latency
+    family's p99 breach links straight to one ``GET /_trace/{id}`` span
+    tree. Exemplars render in the exposition output as OpenMetrics
+    ``# {trace_id="..."} value`` suffixes (see :meth:`TelemetryRegistry.
+    prometheus_text`)."""
+
+    __slots__ = ("count", "sum", "_ring", "_exemplars", "_lock")
 
     CAP = 2048
+    #: retained (value, exemplar) pairs — small: only the worst recent
+    #: samples matter for the p99-breach → trace link
+    EXEMPLAR_CAP = 64
 
     def __init__(self, cap: int = CAP):
         self.count = 0
         self.sum = 0.0
         self._ring: deque = deque(maxlen=cap)
+        self._exemplars: deque = deque(maxlen=self.EXEMPLAR_CAP)
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         with self._lock:
             self.count += 1
             self.sum += v
             self._ring.append(v)
+            if exemplar:
+                self._exemplars.append((float(v), str(exemplar)))
+
+    def exemplar_at_least(self, threshold: Optional[float]) \
+            -> Optional[Tuple[float, str]]:
+        """The retained exemplar best illustrating values >= ``threshold``
+        (the smallest qualifying one, so a p99 exemplar is a p99-ish
+        sample, not always the single worst); falls back to the largest
+        retained exemplar when none qualifies."""
+        with self._lock:
+            pairs = list(self._exemplars)
+        if not pairs:
+            return None
+        if threshold is not None:
+            over = [p for p in pairs if p[0] >= threshold]
+            if over:
+                return min(over, key=lambda p: p[0])
+        return max(pairs, key=lambda p: p[0])
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -135,6 +164,9 @@ class Histogram:
                 return vals[min(len(vals) - 1, int(p * len(vals)))]
             doc.update(p50=round(q(0.50), 3), p99=round(q(0.99), 3),
                        min=round(vals[0], 3), max=round(vals[-1], 3))
+        ex = self.exemplar_at_least(doc.get("p99"))
+        if ex is not None:
+            doc["exemplar"] = {"value": round(ex[0], 3), "trace_id": ex[1]}
         return doc
 
 
@@ -311,9 +343,16 @@ class TelemetryRegistry:
                 out[name] = fam
         return out
 
-    def prometheus_text(self) -> str:
+    def prometheus_text(self, exemplars: bool = False) -> str:
         """Text exposition format 0.0.4. Histograms render as summaries
-        (quantile series + _count/_sum)."""
+        (quantile series + _count/_sum).
+
+        ``exemplars=True`` (``GET /_prometheus/metrics?exemplars=true``)
+        appends OpenMetrics ``# {trace_id="..."} value`` suffixes to p99
+        quantile lines that have one. OFF by default: a strict 0.0.4
+        parser rejects anything after the sample value, and a scrape
+        that errors drops EVERY metric — so exemplars are opt-in for
+        OpenMetrics-aware scrapers."""
         lines: List[str] = []
         with self._lock:
             fams = {name: (fam["type"], fam["help"],
@@ -348,9 +387,21 @@ class TelemetryRegistry:
                     snap = m.snapshot() if isinstance(m, Histogram) else m
                     for q, k in (("0.5", "p50"), ("0.99", "p99")):
                         if k in snap:
-                            lines.append(
-                                f"{name}{fmt_labels(labels, {'quantile': q})}"
-                                f" {snap[k]}")
+                            line = (f"{name}"
+                                    f"{fmt_labels(labels, {'quantile': q})}"
+                                    f" {snap[k]}")
+                            ex = snap.get("exemplar") \
+                                if exemplars and isinstance(snap, dict) \
+                                else None
+                            if q == "0.99" and ex:
+                                # OpenMetrics exemplar: the p99 sample
+                                # links to ONE trace id so a latency
+                                # breach resolves to GET /_trace/{id}
+                                line += (
+                                    ' # {trace_id="'
+                                    + _escape_label_value(ex["trace_id"])
+                                    + f'"}} {ex["value"]}')
+                            lines.append(line)
                     lines.append(
                         f"{name}_count{fmt_labels(labels)} {snap['count']}")
                     lines.append(

@@ -168,6 +168,35 @@ class TraceStore:
             doc["dropped_spans"] = dropped
         return doc
 
+    def recent(self, n: int = 50) -> List[dict]:
+        """The newest-first trace index: one row per retained trace with
+        its root span's name, start and duration (``GET /_trace`` — the
+        listing that makes an evicted id's 404 explainable and lets
+        ``trace_dump.py --last`` stop guessing)."""
+        n = int(n)
+        if n <= 0:
+            return []
+        with self._lock:
+            items = [(tid, list(ent["spans"]))
+                     for tid, ent in self._traces.items()]
+        out: List[dict] = []
+        for tid, spans in reversed(items[-n:]):
+            row = {"trace_id": tid, "span_count": len(spans)}
+            if spans:
+                ids = {s.get("span_id") for s in spans}
+                roots = [s for s in spans
+                         if s.get("parent_span_id") not in ids]
+                root = min(roots or spans,
+                           key=lambda s: s.get("start_ms", 0))
+                row.update(root=root.get("name"),
+                           start_ms=root.get("start_ms"),
+                           took_ms=root.get("took_ms"))
+                node = root.get("node")
+                if node:
+                    row["node"] = node
+            out.append(row)
+        return out
+
     def stats_doc(self) -> dict:
         with self._lock:
             return {"traces": len(self._traces),

@@ -988,6 +988,16 @@ class ClusterNode:
                 node_id, "search:shards", self._h_search_shards, payload,
                 timeout=15.0, readonly=True))
             self._ars_observe(node_id, time.monotonic() - t_rpc)
+        # coordinator-side resource roll-up: every data node's shard-
+        # phase ledger folds into THIS request's task, so a cluster
+        # search reports one cpu/device/docs total across the fan-out
+        from .task_manager import current_resources
+        task_res = current_resources()
+        if task_res is not None:
+            for r in results:
+                rd = r.get("_resources") if isinstance(r, dict) else None
+                if rd:
+                    task_res.merge_doc(rd)
         # merge (same comparator as the single-node coordinator), then lift
         # tiebreaks into the node-global cursor space
         merged = []
@@ -1357,12 +1367,41 @@ class ClusterNode:
         """Query phase over this node's copies of the listed shards. The
         span adopts the coordinator's trace (payload ``_trace`` wire
         headers), so a front-node request's ``GET /_trace/{id}`` tree
-        spans the data nodes it fanned out to."""
+        spans the data nodes it fanned out to.
+
+        Resource attribution: the shard phase runs under a FRESH ledger
+        (shadowing any task bound on this thread — on the coordinator's
+        own direct-call shard, the work must not double-charge its
+        task), and the ledger rides the response as ``_resources`` for
+        the coordinator's roll-up — a cluster search reports ONE total
+        across the fan-out."""
         from ..common.tracing import span
-        with span(f"shard_search[{payload['index']}]", node=self.node_id,
-                  headers=payload.get("_trace"),
-                  attrs={"shards": list(payload["shards"])}):
-            return self._h_search_shards_traced(src, payload)
+        from .task_manager import (TaskResources, bind_resources,
+                                   current_resources, unbind_resources)
+        outer = current_resources()
+        if outer is not None:
+            # direct-call shard on the coordinator's own request thread:
+            # fold the coordinator's CPU up to here, then skip the shard
+            # window on the outer ledger (it arrives via _resources — a
+            # stale outer mark would double-count it at cpu_release)
+            outer.cpu_checkpoint()
+        res = TaskResources()
+        token = bind_resources(res)
+        res.cpu_mark()
+        try:
+            with span(f"shard_search[{payload['index']}]",
+                      node=self.node_id,
+                      headers=payload.get("_trace"),
+                      attrs={"shards": list(payload["shards"])}):
+                out = self._h_search_shards_traced(src, payload)
+        finally:
+            res.cpu_release()
+            unbind_resources(token)
+            if outer is not None:
+                outer.cpu_mark()
+        if isinstance(out, dict):
+            out["_resources"] = res.to_dict()
+        return out
 
     def _h_search_shards_traced(self, src, payload):
         name = payload["index"]

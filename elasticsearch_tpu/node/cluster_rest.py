@@ -717,6 +717,10 @@ class ClusterRestService:
         if path == "/_tasks" or path.startswith("/_tasks/") or \
                 path.startswith("/_tasks?"):
             return self._tasks_route(method, path, query, body)
+        if path.startswith("/_health_report"):
+            return self._health_report(method, path, query, body)
+        if segs and segs[0] == "_nodes" and segs[-1] == "hot_threads":
+            return self._hot_threads(method, path, query, body, segs)
         if method == "GET" and segs and (
                 segs[-1] == "_stats" or
                 (len(segs) >= 2 and segs[-2] == "_stats") or
@@ -1941,6 +1945,123 @@ class ClusterRestService:
                 doc["timed_out"] = True
                 return 408, "application/json", json.dumps(doc).encode()
             time.sleep(0.05)
+
+    def _health_report(self, method, path, query, body):
+        """Cluster ``GET /_health_report``: every node evaluates its own
+        registry-local indicators (rest:exec runs the LOCAL handler — no
+        re-fan-out), the front folds them to the worst status per
+        indicator (per-node status map in details) and replaces
+        ``shards_availability`` with the authoritative routing-table
+        view, where red is reachable."""
+        status, ct, out = self._local(method, path, query, body)
+        st = self.node.applied_state
+        if status != 200 or st is None:
+            return status, ct, out
+        try:
+            local_doc = json.loads(out)
+        except ValueError:
+            return status, ct, out
+        if not isinstance(local_doc, dict) or \
+                "indicators" not in local_doc:
+            return status, ct, out
+        docs = {self.node.node_id: local_doc}
+
+        def fetch_one(n):
+            r = self.node.rpc(n, "rest:exec", {
+                "m": method, "p": path, "q": query, "b": _b64(body)},
+                timeout=5.0)
+            if r["status"] == 200:
+                return n, json.loads(_unb64(r["out"]))
+            return n, None
+
+        # concurrent fan-out: the "is this node healthy" endpoint must
+        # not serialize per-node timeouts — one dead peer costs one
+        # timeout window total, not one per peer
+        peers = [n for n in self.node.node_ids if n != self.node.node_id]
+        if peers:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=len(peers)) as pool:
+                for fut in [pool.submit(fetch_one, n) for n in peers]:
+                    try:
+                        n, doc_n = fut.result()
+                    except Exception:   # noqa: BLE001 — a dead node
+                        continue        # reports nothing; availability
+                    if doc_n:           # covers it below
+                        docs[n] = doc_n
+        from ..common.health import GREEN, merge_reports, worst_status
+        merged = merge_reports(local_doc, docs)
+        nodes = sorted(st.nodes)
+        cstatus, active, unassigned = self._cluster_shards_view(nodes)
+        ind = merged["indicators"].get("shards_availability")
+        if cstatus is not None and ind is not None:
+            ind["status"] = cstatus
+            ind.setdefault("details", {}).update(
+                active_shards=active, unassigned_shards=unassigned,
+                number_of_nodes=len(nodes))
+            if cstatus == GREEN:
+                ind["symptom"] = "This cluster has all shards available."
+                ind.pop("impacts", None)
+                ind.pop("diagnosis", None)
+            else:
+                ind["symptom"] = (
+                    f"This cluster has {unassigned} unassigned shard"
+                    f"{'s' if unassigned != 1 else ''}.")
+            merged["status"] = worst_status(
+                d["status"] for d in merged["indicators"].values())
+        return 200, "application/json", json.dumps(merged).encode()
+
+    def _hot_threads(self, method, path, query, body, segs):
+        """Cluster ``GET /_nodes[/{node_id}]/hot_threads``: fan the
+        sampler out to every selected node (each samples ITS process)
+        and concatenate the per-node text blocks — instead of the old
+        behavior of sampling only the front's process view."""
+        import fnmatch
+        node_filter = segs[1] if len(segs) == 3 else None
+
+        def selected(nid: str) -> bool:
+            # cluster node NAMES are their ids (ClusterRestService
+            # passes node_id as the api's node_name), so id matching
+            # covers the name form of RestAPI._node_id_matches too
+            if node_filter is None:
+                return True
+            for part in str(node_filter).split(","):
+                part = part.strip()
+                if part in ("", "_all") or \
+                        fnmatch.fnmatchcase(nid, part):
+                    return True
+                if part == "_local" and nid == self.node.node_id:
+                    return True
+            return False
+
+        bare = "/_nodes/hot_threads"      # target already selected
+
+        def sample_one(nid):
+            if nid == self.node.node_id:
+                return self._local(method, bare, query, body)
+            r = self.node.rpc(nid, "rest:exec", {
+                "m": method, "p": bare, "q": query,
+                "b": _b64(body)}, timeout=30.0)
+            return r["status"], None, _unb64(r["out"])
+
+        # concurrent sampling: each node's sampler runs a wall-clock
+        # snapshot window — serialized, a 3-node default request would
+        # take 3× the interval plus any dead-node timeout
+        targets = [nid for nid in sorted(self.node.node_ids)
+                   if selected(nid)]
+        blocks: List[str] = []
+        if targets:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=len(targets)) as pool:
+                for fut in [pool.submit(sample_one, n) for n in targets]:
+                    try:
+                        st, _ct, out = fut.result()
+                    except Exception:   # noqa: BLE001 — dead nodes
+                        continue        # sample nothing
+                    if st == 200 and out:
+                        blocks.append(
+                            out.decode(errors="replace").rstrip("\n"))
+        return (200, "text/plain; charset=UTF-8",
+                ("\n".join(blocks) + "\n").encode())
 
     def _cluster_shards_view(self, nodes, selected=None):
         """(status, active_shards, unassigned) from the published routing

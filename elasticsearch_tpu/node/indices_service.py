@@ -475,6 +475,13 @@ class IndexService:
             entry["serving_stages"] = {
                 s: (round(ms, 3) if isinstance(ms, (int, float)) else ms)
                 for s, ms in stages.items()}
+        from .task_manager import current_resources
+        res = current_resources()
+        if res is not None:
+            # the owning task's resource ledger AT THIS POINT: a slow
+            # entry names what the request had already burned (CPU,
+            # device-ms, docs scanned) when it crossed the threshold
+            entry["task_resources"] = res.to_dict()
         self.slow_log.append(entry)
         del self.slow_log[: -self.SLOWLOG_MAX]
         try:
@@ -491,11 +498,23 @@ class IndexService:
         """One index's query execution. When a trace is active (REST
         requests), the whole shard-level phase records as a span under
         the coordinator's — the ``GET /_trace/{id}`` tree's shard tier."""
+        from ..common import telemetry as _tm
         from ..common import tracing as _tracing
+        t0 = time.perf_counter()
         with _tracing.span(f"shards[{self.name}]",
                            attrs={"index": self.name,
                                   "shards": self.num_shards}):
-            return self._search_traced(body, request_cache)
+            r = self._search_traced(body, request_cache)
+            # SLO latency family: each sample may carry its trace id as
+            # an OpenMetrics exemplar, so a p99 breach on the scrape
+            # links straight to GET /_trace/{id} (O(1) on this path)
+            _tm.DEFAULT.histogram(
+                "es_query_latency_ms", {"index": self.name},
+                help="per-index shard-phase query latency ms "
+                     "(exemplars carry trace ids)").observe(
+                (time.perf_counter() - t0) * 1e3,
+                exemplar=_tracing.current_trace_id())
+            return r
 
     def _search_traced(self, body: Optional[dict],
                        request_cache: Optional[bool]) -> ShardSearchResult:

@@ -13,15 +13,145 @@ cancel out to other nodes' managers over the transport).
 Async execution (``wait_for_completion=false``) runs the action on a
 daemon thread and stores the result on the task, the analog of the
 reference's task-result index (``TaskResultsService``).
+
+Resource attribution (reference: ``tasks/TaskResourceTrackingService``
+behind ``_tasks?detailed`` CPU/memory): every task carries a
+:class:`TaskResources` ledger. The REST edge binds it into a
+``contextvars`` context (:func:`bind_resources`) so any layer on the
+request's call path — shard search, plane micro-batch fan-out, the
+cluster coordinator — can charge work to the owning task without
+argument plumbing:
+
+- host CPU-ms via ``time.thread_time`` deltas at stage boundaries
+  (:meth:`TaskResources.cpu_mark` / :meth:`cpu_checkpoint` — O(1) per
+  boundary, one dict probe under a lock);
+- device dispatch-ms, h2d/d2h transfer bytes and docs scanned (base
+  corpus + delta tier) stamped by the serving path after each dispatch;
+- cross-node roll-up: data nodes return their shard-phase ledger in the
+  ``search:shards`` RPC response and the coordinator merges it
+  (:meth:`TaskResources.merge_doc`), so a cluster search reports ONE
+  total.
+
+Completed tasks fold their ledger into per-action totals the manager
+exposes as ``es_task_*`` telemetry families (in-flight tasks contribute
+their live ledger at snapshot time, keeping the counters monotonic).
 """
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
 from ..common.errors import ElasticsearchError
+
+#: the resource ledger charged by work on this context, or None
+#: (maintenance paths stay free — mirrors tracing._CTX)
+_RES_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "es_task_resources", default=None)
+
+
+def bind_resources(res: "TaskResources"):
+    """Bind ``res`` as the context's charge target; returns the reset
+    token."""
+    return _RES_CTX.set(res)
+
+
+def unbind_resources(token) -> None:
+    _RES_CTX.reset(token)
+
+
+def current_resources() -> Optional["TaskResources"]:
+    return _RES_CTX.get()
+
+
+class TaskResources:
+    """Per-task resource ledger. All mutators are O(1) and lock-cheap —
+    they run at stage boundaries on the serving hot path."""
+
+    __slots__ = ("_lock", "cpu_ms", "device_ms", "h2d_bytes", "d2h_bytes",
+                 "docs_scanned", "delta_docs_scanned", "dispatches",
+                 "_cpu_marks")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cpu_ms = 0.0
+        self.device_ms = 0.0
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.docs_scanned = 0
+        self.delta_docs_scanned = 0
+        self.dispatches = 0
+        #: thread ident -> last ``time.thread_time()`` mark — per-thread
+        #: so an async task's worker and the request thread never mix
+        self._cpu_marks: Dict[int, float] = {}
+
+    # -- CPU boundaries ------------------------------------------------------
+
+    def cpu_mark(self) -> None:
+        """Start (or restart) this thread's CPU accounting window."""
+        with self._lock:
+            self._cpu_marks[threading.get_ident()] = time.thread_time()
+
+    def cpu_checkpoint(self) -> None:
+        """Fold this thread's CPU since its last mark into ``cpu_ms`` and
+        advance the mark — called at stage boundaries so an in-flight
+        task already shows the CPU its finished stages burned."""
+        now = time.thread_time()
+        tid = threading.get_ident()
+        with self._lock:
+            last = self._cpu_marks.get(tid)
+            if last is not None:
+                self.cpu_ms += (now - last) * 1e3
+            self._cpu_marks[tid] = now
+
+    def cpu_release(self) -> None:
+        """Final checkpoint + drop this thread's mark (request teardown)."""
+        self.cpu_checkpoint()
+        with self._lock:
+            self._cpu_marks.pop(threading.get_ident(), None)
+
+    # -- device / scan accounting -------------------------------------------
+
+    def add(self, *, device_ms: float = 0.0, h2d_bytes: int = 0,
+            d2h_bytes: int = 0, docs_scanned: int = 0,
+            delta_docs_scanned: int = 0, cpu_ms: float = 0.0,
+            dispatches: int = 0) -> None:
+        with self._lock:
+            self.cpu_ms += cpu_ms
+            self.device_ms += device_ms
+            self.h2d_bytes += int(h2d_bytes)
+            self.d2h_bytes += int(d2h_bytes)
+            self.docs_scanned += int(docs_scanned)
+            self.delta_docs_scanned += int(delta_docs_scanned)
+            self.dispatches += int(dispatches)
+
+    def merge_doc(self, doc: dict) -> None:
+        """Coordinator-side roll-up of a data node's wire ledger
+        (``search:shards`` response ``_resources``)."""
+        if not isinstance(doc, dict):
+            return
+        xfer = doc.get("transfer_bytes") or {}
+        self.add(cpu_ms=float(doc.get("cpu_time_ms", 0.0)),
+                 device_ms=float(doc.get("device_time_ms", 0.0)),
+                 h2d_bytes=int(xfer.get("h2d", 0)),
+                 d2h_bytes=int(xfer.get("d2h", 0)),
+                 docs_scanned=int(doc.get("docs_scanned", 0)),
+                 delta_docs_scanned=int(doc.get("delta_docs_scanned", 0)),
+                 dispatches=int(doc.get("dispatches", 0)))
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "cpu_time_ms": round(self.cpu_ms, 3),
+                "device_time_ms": round(self.device_ms, 3),
+                "transfer_bytes": {"h2d": self.h2d_bytes,
+                                   "d2h": self.d2h_bytes},
+                "docs_scanned": self.docs_scanned,
+                "delta_docs_scanned": self.delta_docs_scanned,
+                "dispatches": self.dispatches,
+            }
 
 
 class TaskCancelledError(ElasticsearchError):
@@ -52,6 +182,8 @@ class Task:
         #: live progress counters for _tasks status rendering (reindex &
         #: friends update these as they go)
         self.status: Dict[str, object] = {}
+        #: per-task resource ledger (``_tasks?detailed`` resource_stats)
+        self.resources = TaskResources()
 
     @property
     def tid(self) -> str:
@@ -62,7 +194,7 @@ class Task:
             raise TaskCancelledError(
                 f"task cancelled [{self.cancel_reason or 'by user request'}]")
 
-    def to_dict(self) -> dict:
+    def to_dict(self, detailed: bool = False) -> dict:
         now = time.time()
         doc = {
             "node": self.node,
@@ -76,6 +208,11 @@ class Task:
             "cancelled": self.cancelled.is_set(),
             "headers": self.headers,
         }
+        if detailed:
+            # an in-flight task's ledger is live: CPU folds in at each
+            # stage boundary, device/docs after each dispatch — so
+            # _tasks?detailed already attributes a running plane search
+            doc["resource_stats"] = self.resources.to_dict()
         if self.status:
             doc["status"] = dict(self.status)
         if self.parent_task_id:
@@ -96,6 +233,88 @@ class TaskManager:
         self._next_id = 0
         self.tasks: Dict[int, Task] = {}
         self.finished: Dict[int, Task] = {}
+        #: action -> folded resource totals of completed tasks (the
+        #: es_task_* registry families; live tasks add their in-flight
+        #: ledger at snapshot time, so the counters stay monotonic)
+        self._res_lock = threading.Lock()
+        self._action_totals: Dict[str, Dict[str, float]] = {}
+        from ..common import telemetry as _tm
+        _tm.DEFAULT.register_object_collector(
+            f"tasks:{node_id}", self, TaskManager._task_families)
+
+    _RES_KEYS = ("cpu_ms", "device_ms", "h2d_bytes", "d2h_bytes",
+                 "docs_scanned", "delta_docs_scanned", "dispatches")
+
+    def _fold_resources(self, task: Task) -> None:
+        r = task.resources
+        with r._lock:
+            vals = {k: getattr(r, k) for k in self._RES_KEYS}
+        if not any(vals.values()):
+            return
+        with self._res_lock:
+            tot = self._action_totals.setdefault(
+                task.action, {k: 0.0 for k in self._RES_KEYS})
+            tot["count"] = tot.get("count", 0) + 1
+            for k, v in vals.items():
+                tot[k] += v
+
+    def action_totals(self) -> Dict[str, Dict[str, float]]:
+        """Per-action resource totals: completed tasks' folded ledgers
+        plus every live task's current ledger (tests / bench rollups)."""
+        with self._res_lock:
+            out = {a: dict(t) for a, t in self._action_totals.items()}
+        with self.lock:
+            live = list(self.tasks.values())
+        for t in live:
+            r = t.resources
+            with r._lock:
+                vals = {k: getattr(r, k) for k in self._RES_KEYS}
+            if not any(vals.values()):
+                continue
+            tot = out.setdefault(t.action, {k: 0.0 for k in self._RES_KEYS})
+            for k, v in vals.items():
+                tot[k] = tot.get(k, 0) + v
+        return out
+
+    def _task_families(self) -> dict:
+        """Registry collector: per-task resource attribution rolled up by
+        action (``es_task_*`` — the per-request analog of the reference's
+        ``_tasks?detailed`` CPU tracking, exported for scrapes)."""
+        lbl = {"node": self.node_name}
+        totals = self.action_totals()
+        cpu, dev, xfer, docs, count = [], [], [], [], []
+        for action, tot in sorted(totals.items()):
+            alb = dict(lbl, action=action)
+            cpu.append((alb, round(tot.get("cpu_ms", 0.0), 3)))
+            dev.append((alb, round(tot.get("device_ms", 0.0), 3)))
+            xfer.append((dict(alb, direction="h2d"),
+                         int(tot.get("h2d_bytes", 0))))
+            xfer.append((dict(alb, direction="d2h"),
+                         int(tot.get("d2h_bytes", 0))))
+            docs.append((alb, int(tot.get("docs_scanned", 0))))
+            count.append((alb, int(tot.get("count", 0))))
+        return {
+            "es_task_cpu_millis_total": {
+                "type": "counter",
+                "help": "host CPU-ms attributed to tasks by action",
+                "samples": cpu},
+            "es_task_device_millis_total": {
+                "type": "counter",
+                "help": "device dispatch-ms attributed to tasks by action",
+                "samples": dev},
+            "es_task_transfer_bytes_total": {
+                "type": "counter",
+                "help": "h2d/d2h bytes attributed to tasks by action",
+                "samples": xfer},
+            "es_task_docs_scanned_total": {
+                "type": "counter",
+                "help": "docs scanned (base + delta tier) by action",
+                "samples": docs},
+            "es_tasks_completed_total": {
+                "type": "counter",
+                "help": "tasks completed with non-zero resource usage",
+                "samples": count},
+        }
 
     def register(self, action: str, description: str = "",
                  cancellable: bool = False,
@@ -111,6 +330,7 @@ class TaskManager:
     def unregister(self, task: Task, *, retain: bool = False) -> None:
         task.running = False
         task.completed.set()
+        self._fold_resources(task)
         with self.lock:
             self.tasks.pop(task.id, None)
             if retain:
@@ -179,6 +399,10 @@ class TaskManager:
         task.async_detached = True      # request teardown must not unregister
 
         def runner():
+            # the worker thread charges the SAME task ledger the request
+            # thread opened (per-thread CPU marks keep them separate)
+            token = bind_resources(task.resources)
+            task.resources.cpu_mark()
             try:
                 task.result = fn()
             except Exception as e:   # noqa: BLE001 — stored, not raised
@@ -188,6 +412,8 @@ class TaskManager:
                     payload.get("error"), dict) else {
                         "type": "exception", "reason": str(payload)}
             finally:
+                task.resources.cpu_release()
+                unbind_resources(token)
                 self.unregister(task, retain=True)
 
         threading.Thread(target=runner, daemon=True,

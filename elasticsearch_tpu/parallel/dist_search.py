@@ -798,11 +798,16 @@ class DistributedSearchPlane:
         gdocs = np.asarray(gdocs)[:B]
         # device-transfer accounting: the per-dispatch uploads (corpus
         # arrays are resident and excluded) + the fetched result rows
-        _tm.record_transfer(
-            h2d_bytes=starts.nbytes + lengths.nbytes + idfw.nbytes +
+        h2d = starts.nbytes + lengths.nbytes + idfw.nbytes + \
             (rid_slots.nbytes + dense_w.nbytes + W.nbytes + u_ids.nbytes
-             if use_tiered else 0),
-            d2h_bytes=vals.nbytes + gdocs.nbytes)
+             if use_tiered else 0)
+        d2h = vals.nbytes + gdocs.nbytes
+        _tm.record_transfer(h2d_bytes=h2d, d2h_bytes=d2h)
+        if stages is not None:
+            # per-dispatch bytes for task resource attribution (the
+            # micro-batcher shares them across the batch's slots)
+            stages["h2d_bytes"] = h2d
+            stages["d2h_bytes"] = d2h
         hits = []
         for bi in range(B):
             row = []
@@ -966,6 +971,9 @@ class DistributedKnnPlane:
         if len(dims) > 1:
             raise ValueError(f"mixed vector dims across shards: {dims}")
         self.dim = dims.pop() if dims else 0
+        #: real (unpadded) corpus rows — task docs-scanned attribution
+        self.n_docs_total = sum(int(s["vectors"].shape[0])
+                                for s in shards)
         self.n_pad = round_up_pow2(
             max(max(int(s["vectors"].shape[0]) for s in shards), 1))
         S = self.n_shards
@@ -1078,6 +1086,8 @@ class DistributedKnnPlane:
             stages["dispatch_ms"] = (t2 - t1) * 1e3
             stages["fetch_ms"] = (time.perf_counter() - t2) * 1e3
             stages["compile_cache"] = "miss" if compiled else "hit"
+            stages["h2d_bytes"] = q.nbytes
+            stages["d2h_bytes"] = vals.nbytes + gdocs.nbytes
         return vals, hits
 
     def _decode_hits(self, vals, gdocs):

@@ -650,7 +650,10 @@ class RestAPI:
         add("PUT", "/{index}/_block/{block}", self.h_add_block)
         add("GET", "/_nodes/telemetry", self.h_nodes_telemetry)
         add("GET", "/_prometheus/metrics", self.h_prometheus)
+        add("GET", "/_trace", self.h_trace_list)
         add("GET", "/_trace/{trace_id}", self.h_trace_get)
+        add("GET", "/_health_report", self.h_health_report)
+        add("GET", "/_health_report/{indicator}", self.h_health_report)
         add("GET", "/_nodes/stats", self.h_nodes_stats)
         add("GET", "/_nodes/stats/{metric}", self.h_nodes_stats)
         add("GET", "/_nodes/stats/{metric}/{index_metric}",
@@ -992,6 +995,14 @@ class RestAPI:
                         description=desc + f" [trace.id={sp.trace_id}]",
                         headers=task_headers)
                     self._req_task.task = task
+                    # resource attribution: the task's ledger rides the
+                    # request context (shard search / plane dispatch
+                    # charge it at stage boundaries), and the request
+                    # thread's CPU window opens here
+                    from ..node.task_manager import (bind_resources,
+                                                     unbind_resources)
+                    _res_token = bind_resources(task.resources)
+                    task.resources.cpu_mark()
                     try:
                         result = fn(params, body, **kwargs)
                     except Exception as e:  # noqa: BLE001 — ES-shaped
@@ -1000,6 +1011,8 @@ class RestAPI:
                         return status, JSON_CT, \
                             json.dumps(payload).encode()
                     finally:
+                        task.resources.cpu_release()
+                        unbind_resources(_res_token)
                         self._req_task.task = None
                         if task.running and \
                                 not getattr(task, "async_detached", False):
@@ -1978,10 +1991,28 @@ class RestAPI:
 
     def h_prometheus(self, params, body):
         """GET /_prometheus/metrics: text exposition format 0.0.4 over
-        the same registry (node families contribute via collectors)."""
+        the same registry (node families contribute via collectors).
+        ``?exemplars=true`` adds OpenMetrics trace-id exemplars to p99
+        quantile lines (opt-in: strict 0.0.4 parsers reject them)."""
         from ..common import telemetry
-        return (200, "text/plain; version=0.0.4; charset=utf-8",
-                telemetry.DEFAULT.prometheus_text())
+        exemplars = _flag(params, "exemplars")
+        ct = ("application/openmetrics-text; version=1.0.0; charset=utf-8"
+              if exemplars else "text/plain; version=0.0.4; charset=utf-8")
+        return (200, ct,
+                telemetry.DEFAULT.prometheus_text(exemplars=exemplars))
+
+    def h_trace_list(self, params, body):
+        """GET /_trace: newest-first index of retained trace ids with
+        each root span's action + duration — the listing that explains
+        an evicted id's 404 and feeds ``trace_dump.py --last``."""
+        from ..common.tracing import DEFAULT_STORE
+        try:
+            n = int(params.get("size", 50))
+        except ValueError:
+            raise IllegalArgumentError(
+                f"[size] must be an integer, got [{params.get('size')}]")
+        return {"traces": DEFAULT_STORE.recent(n),
+                "store": DEFAULT_STORE.stats_doc()}
 
     def h_trace_get(self, params, body, trace_id):
         """GET /_trace/{trace_id}: the recorded span tree for one
@@ -1992,8 +2023,20 @@ class RestAPI:
         if doc is None:
             raise ResourceNotFoundError(
                 f"trace [{trace_id}] is not in the trace store (bounded "
-                f"ring of {DEFAULT_STORE.MAX_TRACES} traces)")
+                f"ring of {DEFAULT_STORE.MAX_TRACES} traces; GET /_trace "
+                f"lists the ids still retained)")
         return doc
+
+    def h_health_report(self, params, body, indicator=None):
+        """GET /_health_report[/{indicator}] (reference: the 8.x health
+        indicator API — ``RestGetHealthAction``): every indicator
+        evaluated against this node's live registry/serving state."""
+        from ..common.health import HealthService
+        svc = getattr(self, "_health_svc", None)
+        if svc is None:
+            svc = self._health_svc = HealthService(self)
+        return svc.report(indicator=indicator,
+                          verbose=_flag(params, "verbose", True))
 
     # ------------------------------------------------------------------
     # cat
@@ -4095,11 +4138,30 @@ class RestAPI:
                      "max_score": max_score, "hits": page},
         }
 
+    def _node_id_matches(self, node_id: Optional[str]) -> bool:
+        """Does a ``/_nodes/{node_id}/...`` filter select THIS node?
+        Comma lists, ``_all``/``_local`` and id/name wildcards, per the
+        reference's node-id resolution."""
+        if node_id is None:
+            return True
+        import fnmatch
+        for part in str(node_id).split(","):
+            part = part.strip()
+            if part in ("", "_all", "_local") or \
+                    fnmatch.fnmatchcase(self.node_id, part) or \
+                    fnmatch.fnmatchcase(self.node_name, part):
+                return True
+        return False
+
     def h_hot_threads(self, params, body, node_id=None):
         """GET /_nodes/hot_threads (monitor/jvm/HotThreads.java:41) —
-        thread stack sampling, text response."""
+        thread stack sampling, text response. A ``{node_id}`` filter
+        that does not select this node samples nothing (the cluster
+        front fans the sampler out per selected node)."""
         from ..utils.hot_threads import hot_threads
         from ..common.settings import parse_time_millis
+        if not self._node_id_matches(node_id):
+            return 200, "text/plain; charset=UTF-8", ""
         text = hot_threads(
             threads=int(params.get("threads", 3)),
             interval_ms=parse_time_millis(
@@ -7905,8 +7967,12 @@ class RestAPI:
         group_by = params.get("group_by", "nodes")
         actions = params.get("actions")
         actions = actions.split(",") if actions else None
+        # ?detailed adds the per-task resource ledger (resource_stats:
+        # cpu/device ms, transfer bytes, docs scanned — the reference's
+        # task resource tracking surface)
+        detailed = _flag(params, "detailed")
         tasks = self.task_manager.list(actions=actions)
-        docs = {t.tid: t.to_dict() for t in tasks}
+        docs = {t.tid: t.to_dict(detailed=detailed) for t in tasks}
         if group_by == "none":
             return {"tasks": list(docs.values())}
         if group_by == "parents":
@@ -7941,7 +8007,7 @@ class RestAPI:
             from ..common.settings import parse_time_millis
             t.completed.wait(
                 parse_time_millis(params.get("timeout", "30s")) / 1e3)
-        doc = {"completed": not t.running, "task": t.to_dict()}
+        doc = {"completed": not t.running, "task": t.to_dict(detailed=True)}
         if t.result is not None:
             doc["response"] = t.result
         if t.error is not None:

@@ -335,6 +335,22 @@ class PlaneMicroBatcher:
         batch_info = {"batch_size": len(batch), "k_bucket": k,
                       "compile_cache": plane_stages.get("compile_cache",
                                                         "hit")}
+        # task resource attribution (node/task_manager.TaskResources):
+        # the dispatch's transfer bytes split across the batch's slots
+        # (so per-task sums reconcile with es_device_transfer_bytes_total)
+        # while docs scanned is per QUERY — every query's score covers
+        # the full base corpus plus the delta tier
+        share = 1.0 / max(len(batch), 1)
+        h2d = plane_stages.get("h2d_bytes")
+        d2h = plane_stages.get("d2h_bytes")
+        if h2d or d2h:
+            batch_info["h2d_bytes"] = int((h2d or 0) * share)
+            batch_info["d2h_bytes"] = int((d2h or 0) * share)
+        base_docs = getattr(self.plane, "base_docs", None)
+        if base_docs is None:
+            base_docs = getattr(self.plane, "n_docs_total", 0)
+        batch_info["docs_scanned"] = int(
+            base_docs + plane_stages.get("delta_docs", 0))
         delta_ms = plane_stages.get("delta_ms")
         if delta_ms is not None:
             # this dispatch merged the base plane with a live delta tier:
@@ -575,7 +591,9 @@ def batched_search(plane, terms: Sequence[str], k: int,
     return batcher.search(terms, k, stages=stages, info=info, view=view)
 
 
-def batched_knn_search(plane, query_vector, k: int, view=None):
+def batched_knn_search(plane, query_vector, k: int, view=None,
+                       stages: Optional[dict] = None,
+                       info: Optional[dict] = None):
     """Route one kNN query through the knn plane's micro-batcher.
     Returns (raw_scores[k'], hits [(shard, doc), ...])."""
     batcher = getattr(plane, "_microbatcher", None)
@@ -586,7 +604,8 @@ def batched_knn_search(plane, query_vector, k: int, view=None):
                 batcher = KnnPlaneMicroBatcher(plane)
                 plane._microbatcher = batcher
     vals, hits, _total = batcher.search(
-        np.asarray(query_vector, np.float32), k, view=view)
+        np.asarray(query_vector, np.float32), k, view=view,
+        stages=stages, info=info)
     return vals, hits
 
 

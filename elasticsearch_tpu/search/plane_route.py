@@ -579,7 +579,12 @@ class ServingPlaneCache:
         self._metric_lock = threading.Lock()
         self._rebuild_counts: Dict[Tuple[str, str, str], _tm.Counter] = {}
         self._delta_serve_counts: Dict[str, _tm.Counter] = {}
-        self._swap_ms = _tm.Histogram()
+        # per-kind swap histograms (pre-created so the family's label
+        # space is stable for the telemetry lint): a kNN repack packs a
+        # full f32 corpus while a text repack packs CSR+dense tiers —
+        # their swap costs must be distinguishable
+        self._swap_ms: Dict[str, _tm.Histogram] = {
+            "text": _tm.Histogram(), "knn": _tm.Histogram()}
         _tm.DEFAULT.register_object_collector(
             f"plane_cache_{id(self):x}", self,
             ServingPlaneCache._metrics_doc)
@@ -603,8 +608,9 @@ class ServingPlaneCache:
                 "samples": ds},
             "es_plane_swap_ms": {
                 "type": "histogram",
-                "help": "background repack build+swap wall ms",
-                "samples": [({}, self._swap_ms.snapshot())]},
+                "help": "background repack build+swap wall ms by kind",
+                "samples": [({"kind": k}, h.snapshot())
+                            for k, h in self._swap_ms.items()]},
         }
 
     def _record_rebuild(self, kind: str, trigger: str, mode: str) -> None:
@@ -726,7 +732,8 @@ class ServingPlaneCache:
                     self._build_knn_generation(segments, mapper, field,
                                                trigger=trigger,
                                                mode="background")
-                self._swap_ms.observe((time.perf_counter() - t0) * 1e3)
+                self._swap_ms[kind].observe(
+                    (time.perf_counter() - t0) * 1e3)
             except Exception:   # noqa: BLE001 — a failed repack must
                 pass            # never take down serving; retried later
             finally:

@@ -38,6 +38,39 @@ from .query_dsl import (MatchAllQuery, ShardContext, _vector_similarity,
 _MISSING_LAST = float("inf")
 
 
+def _attribute_dispatch(stages: Optional[dict],
+                        info: Optional[dict]) -> None:
+    """Charge one micro-batch dispatch to the request's task ledger
+    (``node/task_manager.TaskResources``, contextvars-bound at the REST
+    edge): host CPU since the last boundary, the dispatch's device
+    wall-ms, its transfer-byte share and the docs it scanned (base
+    corpus + delta tier). O(1) per dispatch; no-op outside any task."""
+    from ..node.task_manager import current_resources
+    res = current_resources()
+    if res is None:
+        return
+    res.cpu_checkpoint()
+    stages = stages or {}
+    info = info or {}
+    res.add(device_ms=float(stages.get("dispatch", 0.0)),
+            h2d_bytes=int(info.get("h2d_bytes", 0)),
+            d2h_bytes=int(info.get("d2h_bytes", 0)),
+            docs_scanned=int(info.get("docs_scanned", 0)),
+            delta_docs_scanned=int(info.get("delta_docs", 0)),
+            dispatches=1)
+
+
+def _attribute_segment_scan(segments) -> None:
+    """Per-segment (non-plane) query phase: the docs the eager scorers
+    covered, plus a CPU boundary checkpoint."""
+    from ..node.task_manager import current_resources
+    res = current_resources()
+    if res is None:
+        return
+    res.cpu_checkpoint()
+    res.add(docs_scanned=sum(s.n_docs for s in segments))
+
+
 def _collect_nested_inner_specs(spec, out: list,
                                 join_out: Optional[list] = None) -> None:
     """Walk a raw query spec for nested / has_child / has_parent clauses
@@ -192,9 +225,14 @@ class ShardSearcher:
             plane = self.knn_plane_provider(self.segments, field)
             if plane is not None:
                 from .microbatch import batched_knn_search
+                knn_stages: Dict[str, float] = {}
+                knn_info: Dict[str, object] = {}
                 raw, phits = batched_knn_search(plane, qv,
                                                 k=num_candidates,
-                                                view=self.segments)
+                                                view=self.segments,
+                                                stages=knn_stages,
+                                                info=knn_info)
+                _attribute_dispatch(knn_stages, knn_info)
                 cands = [
                     (self._knn_score_from_raw(ft.similarity, float(v))
                      * boost, si, d)
@@ -417,6 +455,7 @@ class ShardSearcher:
                 attrs={**{s: round(ms, 3)
                           for s, ms in serving_stages.items()},
                        **serving_info})
+            _attribute_dispatch(serving_stages, serving_info)
         else:
             for seg_idx, seg in enumerate(self.segments):
                 scores, mask = query.execute(self.ctx, seg)
@@ -475,6 +514,7 @@ class ShardSearcher:
                     for v, d in zip(vals[ok], idx[ok]):
                         candidates.append((float(v), seg_idx, int(d)))
             candidates.sort(key=lambda c: (-c[0], c[1], c[2]))
+            _attribute_segment_scan(self.segments)
 
         # --- knn section ---------------------------------------------------
         knn_rankings: List[List[Tuple[float, int, int]]] = []

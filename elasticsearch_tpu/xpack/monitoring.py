@@ -92,6 +92,23 @@ class MonitoringService:
                              "registry": tstats.get("registry"),
                              "tasks": tstats.get("tasks")}})
 
+        # health-report collector: indicator statuses land in the
+        # monitoring index so a dashboard can chart color transitions
+        # (rebuild storms, breaker trips) over time
+        try:
+            health = self.fetch("GET", "/_health_report")
+        except Exception:   # noqa: BLE001 — health must never fail collect
+            health = None
+        if isinstance(health, dict) and health.get("indicators"):
+            docs.append({"type": "health_report",
+                         "health_report": {
+                             "status": health.get("status"),
+                             "indicators": {
+                                 name: {"status": ind.get("status"),
+                                        "symptom": ind.get("symptom")}
+                                 for name, ind in
+                                 health["indicators"].items()}}})
+
         stats = self.fetch("GET", "/_stats")
         for index, istats in (stats.get("indices") or {}).items():
             if index.startswith(".monitoring-"):

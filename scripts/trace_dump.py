@@ -4,9 +4,12 @@
 Usage:
     python scripts/trace_dump.py TRACE_ID [--host http://127.0.0.1:9200]
     python scripts/trace_dump.py --last [--host ...]   # newest trace
+    python scripts/trace_dump.py --list [--host ...]   # recent trace ids
 
-``--last`` issues a probe search first so there is always at least one
-trace, then dumps it (handy for eyeballing a node's span shape).
+``--last`` reads the node's ``GET /_trace`` listing (newest-first trace
+index with root action + duration) and dumps the newest trace — no more
+probe-request guessing; if the store is empty it issues one probe
+request to mint a trace. ``--list`` prints the listing itself.
 
 Output, one line per span, indented by tree depth:
 
@@ -54,20 +57,47 @@ def main() -> int:
     ap.add_argument("trace_id", nargs="?", help="trace id to dump")
     ap.add_argument("--host", default="http://127.0.0.1:9200")
     ap.add_argument("--last", action="store_true",
-                    help="probe-search the node and dump that trace")
+                    help="dump the newest trace from the GET /_trace "
+                         "listing")
+    ap.add_argument("--list", action="store_true", dest="list_traces",
+                    help="print the recent-trace listing and exit")
     ap.add_argument("--json", action="store_true",
                     help="raw JSON instead of the tree rendering")
     args = ap.parse_args()
     tid = args.trace_id
+
+    def _listing():
+        status, _h, body = _get(args.host, "/_trace")
+        if status != 200:
+            print(f"GET /_trace -> {status}: {body[:300]!r}",
+                  file=sys.stderr)
+            return None
+        return json.loads(body).get("traces") or []
+
+    if args.list_traces:
+        rows = _listing()
+        if rows is None:
+            return 1
+        for row in rows:
+            print(f"{row['trace_id']}  "
+                  f"{row.get('took_ms', 0):9.2f}ms  "
+                  f"{row.get('root', '?')}  "
+                  f"spans={row.get('span_count', 0)}")
+        return 0
     if args.last:
-        # any request mints a trace; its id comes back as a header
-        status, headers, _ = _get(args.host, "/")
-        tid = headers.get("Trace-Id")
-        if not tid:
-            print("node returned no Trace-Id header", file=sys.stderr)
+        rows = _listing()
+        if rows is None:
+            return 1
+        if not rows:
+            # empty store: one probe request mints a trace
+            _get(args.host, "/")
+            rows = _listing() or []
+        if not rows:
+            print("trace store is empty", file=sys.stderr)
             return 2
+        tid = rows[0]["trace_id"]
     if not tid:
-        ap.error("pass TRACE_ID or --last")
+        ap.error("pass TRACE_ID, --last or --list")
     status, _headers, body = _get(args.host, f"/_trace/{tid}")
     if status != 200:
         print(f"GET /_trace/{tid} -> {status}: {body[:300]!r}",
