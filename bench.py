@@ -22,6 +22,10 @@ datasets):
    batch size; both sides report achieved corpus GB/s.
 5. hybrid BM25 + kNN RRF — plane top-100 + kNN top-100 + reciprocal-rank
    fusion, vs the same pipeline in numpy.
+6. ``knn_ivf_recall`` — IVF cluster-pruned ANN (k-means coarse quantizer
+   + int8 tier + exact re-rank) at 2^20 vectors: q/s AND recall@10 vs
+   the exact blocked scan on the same plane (recall is measured overlap,
+   never assumed).
 Plus: the REST **serving** path under 32 concurrent clients through
 ``RestAPI.handle`` → plane route → micro-batching queue
 (``search/microbatch.py``), reporting serving p50/p99 + observed batch
@@ -569,6 +573,107 @@ def bench_knn(rng, mesh, on_cpu):
     return _emit("knn_bruteforce_glove_shape", doc)
 
 
+def bench_knn_ivf(rng, mesh, on_cpu):
+    """Config: IVF cluster-pruned ANN at 2^20 (≥1M) vectors — q/s AND
+    recall@10 vs the exact blocked scan on the SAME plane, same queries.
+
+    The corpus is clustered synthetic embeddings (mixture of Gaussians;
+    iid-gaussian has no neighborhood structure for ANY index — real
+    embedding corpora are clustered) and queries are perturbed corpus
+    rows (the GloVe eval shape: queries live near the data manifold).
+    The exact window serves ``nprobe=0`` (the brute-force fallback
+    path); the IVF window serves the tier's benched defaults
+    (nprobe/rerank), which is exactly what production dispatches use —
+    the plane_serving health indicator flags anything below them.
+    Recall is measured, not assumed: overlap@10 of IVF hits vs exact
+    hits per query, averaged. Bytes-per-query for both tiers land in
+    the JSON so the ROOFLINE IVF model is checkable from the BENCH
+    trajectory."""
+    from elasticsearch_tpu.parallel.dist_search import (
+        IVF_DEFAULT_RERANK, DistributedKnnPlane)
+    n_vec = 1 << 20
+    dim, k, B = 64, 10, 16
+    nlist = 1024
+    n_centers = 2048
+    centers = rng.randn(n_centers, dim).astype(np.float32)
+    corpus = np.empty((n_vec, dim), np.float32)
+    chunk = 1 << 17
+    for lo in range(0, n_vec, chunk):
+        n = min(chunk, n_vec - lo)
+        cidx = rng.randint(0, n_centers, n)
+        corpus[lo: lo + n] = centers[cidx] \
+            + 0.35 * rng.randn(n, dim).astype(np.float32)
+    n_dev = mesh.devices.size
+    per = -(-n_vec // n_dev)
+    shards = [dict(vectors=corpus[s * per: (s + 1) * per])
+              for s in range(n_dev)]
+    # build timer starts HERE: index_build_s measures the pack (k-means
+    # + assignment + quantize + reorder), not the synthetic-data loop
+    t_build = time.perf_counter()
+    plane = DistributedKnnPlane(
+        mesh, shards, similarity="cosine",
+        ivf=dict(nlist=nlist, seed=7))
+    build_s = time.perf_counter() - t_build
+    nprobe = plane.ivf.default_nprobe
+
+    def q_batch(n):
+        qidx = rng.randint(0, n_vec, n)
+        return corpus[qidx] + 0.15 * rng.randn(n, dim).astype(np.float32)
+
+    # shared eval batches: exact ground truth AND the recall numerator
+    # come from the same queries
+    n_eval = 4
+    eval_b = [q_batch(B) for _ in range(n_eval)]
+    plane.serve(eval_b[0], k=k, nprobe=0)        # warm exact path
+    exact_hits, ts_exact = [], []
+    for qb in eval_b:
+        t0 = time.perf_counter()
+        _v, hits = plane.serve(qb, k=k, nprobe=0)
+        ts_exact.append(time.perf_counter() - t0)
+        exact_hits.append(hits)
+    exact_qps = (n_eval * B) / sum(ts_exact)
+    ivf_hits = []
+    iters = 12 if on_cpu else 24
+    extra_b = [q_batch(B) for _ in range(iters - n_eval)]
+    # warm pass over EVERY timed batch: the IVF step's compile shape
+    # includes the probed-union width bucket, which is data-dependent —
+    # serving each batch once caches every shape the window will hit,
+    # so no XLA compile can land inside the timed loop
+    for qb in eval_b + extra_b:
+        plane.serve(qb, k=k)
+    ts_ivf = []
+    for qb in eval_b + extra_b:
+        t0 = time.perf_counter()
+        _v, hits = plane.serve(qb, k=k)
+        ts_ivf.append(time.perf_counter() - t0)
+        if len(ivf_hits) < n_eval:
+            ivf_hits.append(hits)
+    ts_ivf = np.asarray(ts_ivf)
+    ivf_qps = (iters * B) / ts_ivf.sum()
+    overlaps = []
+    for eh, ih in zip(exact_hits, ivf_hits):
+        for erow, irow in zip(eh, ih):
+            overlaps.append(len(set(erow) & set(irow)) / max(len(erow), 1))
+    recall = float(np.mean(overlaps))
+    # bytes-per-query model terms (ROOFLINE IVF section): the pruned
+    # scan reads ~nprobe/nlist of the int8 tier + the exact re-rank
+    # gather; the exact scan streams the full f32 corpus
+    q_bytes = int(n_vec * nprobe / plane.ivf.nlist * (dim + 8)
+                  + IVF_DEFAULT_RERANK * k * dim * 4)
+    return _emit("knn_ivf_recall", {
+        "value": round(ivf_qps, 1), "unit": "queries/s",
+        "vs_exact_scan": round(ivf_qps / exact_qps, 2),
+        "recall_at_k": round(recall, 4), "k": k,
+        "p99_ms": round(float(np.percentile(ts_ivf, 99) * 1e3), 2),
+        "exact_qps": round(exact_qps, 1),
+        "n_vectors": n_vec, "dim": dim,
+        "nlist": plane.ivf.nlist, "nprobe": nprobe,
+        "rerank": IVF_DEFAULT_RERANK,
+        "quantized_bytes_per_query": q_bytes,
+        "exact_scan_bytes_per_query": n_vec * dim * 4,
+        "index_build_s": round(build_s, 1)})
+
+
 def bench_hybrid_rrf(rng, mesh, on_cpu):
     """Config #5: hybrid BM25 + kNN with reciprocal-rank fusion (window
     100, k=10) — both retrievers on device, fusion on host; vs the same
@@ -1074,6 +1179,7 @@ def main(mode: str = "accel"):
     del plane
     run("terms_percentiles", bench_terms_percentiles, rng, on_cpu)
     run("knn", bench_knn, rng, mesh, on_cpu)
+    run("knn_ivf_recall", bench_knn_ivf, rng, mesh, on_cpu)
     run("hybrid_rrf", bench_hybrid_rrf, rng, mesh, on_cpu)
     run("serving", bench_serving, rng)
     run("live_indexing", bench_live_indexing, rng)

@@ -32,10 +32,15 @@ indicator is fail-safe: an indicator that throws reports itself
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, List, Optional
 
 GREEN, YELLOW, RED, UNKNOWN = "green", "yellow", "red", "unknown"
+
+#: guards the ANN-drift watermark's read-modify-write (concurrent
+#: health pollers must not double-count or swallow a drift window)
+_ANN_DRIFT_LOCK = threading.Lock()
 
 _RANK = {GREEN: 0, UNKNOWN: 1, YELLOW: 2, RED: 3}
 
@@ -167,39 +172,82 @@ class HealthService:
         # count means NON-cold repacks ran on request threads — the
         # rebuild-storm signature (TELEMETRY.md es_plane_rebuild_total)
         storm = max(sync - cold, 0)
+        # ANN recall-config drift: dispatches served with nprobe BELOW
+        # the benched default (TELEMETRY.md
+        # es_ann_nprobe_below_default_total) — the knn_ivf_recall bench
+        # certifies recall@k at the default; lowering nprobe trades
+        # recall silently, which is a health concern, not an error.
+        # Windowed against the previous health evaluation (watermark on
+        # the api object): the counter is cumulative and would latch
+        # yellow forever, making its own remediation ("drop the
+        # override") unverifiable — yellow means drift SINCE last check.
+        # The evaluation CONSUMES the window (first poller wins);
+        # rate()-style monitors should read the cumulative
+        # ann_below_default_total in details instead.
+        from . import telemetry as _tm
+        with _ANN_DRIFT_LOCK:
+            ann_total = _tm.ann_drift_count()
+            seen = getattr(self.api, "_ann_drift_seen", 0)
+            ann_drift = max(ann_total - seen, 0)
+            self.api._ann_drift_seen = ann_total
         if storm >= self.SYNC_REBUILD_RED:
             status = RED
-        elif storm >= self.SYNC_REBUILD_YELLOW:
+        elif storm >= self.SYNC_REBUILD_YELLOW or ann_drift > 0:
             status = YELLOW
         else:
             status = GREEN
+        if storm > 0:
+            symptom = (f"{storm} synchronous serving-plane rebuilds ran "
+                       f"on request threads (rebuild storm).")
+        elif ann_drift > 0:
+            symptom = (f"{ann_drift} ANN dispatches served below the "
+                       f"benched nprobe (recall-config drift).")
+        else:
+            symptom = "Serving planes are maintained off the request path."
         doc = {
             "status": status,
-            "symptom": ("Serving planes are maintained off the request "
-                        "path." if status == GREEN else
-                        f"{storm} synchronous serving-plane rebuilds ran "
-                        f"on request threads (rebuild storm)."),
+            "symptom": symptom,
             "details": {"sync_rebuilds": sync, "cold_builds": cold,
                         "background_repacks": background,
                         "sync_noncold_rebuilds": storm,
                         "delta_served_queries": delta_serves,
+                        "ann_below_default_dispatches": ann_drift,
+                        "ann_below_default_total": ann_total,
                         "storming_indices": per_index},
         }
         if status != GREEN:
-            doc["impacts"] = [_impact(
-                "plane_serving:rebuild_storm", 1,
-                "Search requests stall behind full plane repacks "
-                "(O(postings) pack + device upload per refresh); p99 "
-                "collapses under live indexing.", ["search"])]
-            doc["diagnosis"] = [_diagnosis(
-                "plane_serving:sync_rebuilds",
-                "Refreshes are invalidating serving planes faster than "
-                "the background repack absorbs them, or delta-tier "
-                "serving is disabled (ES_TPU_PLANE_DELTA=0).",
-                "Re-enable delta serving, raise "
-                "ES_TPU_PLANE_DELTA_FRACTION, or lower the refresh "
-                "rate; watch es_plane_rebuild_total{mode=\"sync\"}.",
-                {"indices": sorted(per_index)})]
+            doc["impacts"] = []
+            doc["diagnosis"] = []
+            if storm > 0:
+                doc["impacts"].append(_impact(
+                    "plane_serving:rebuild_storm", 1,
+                    "Search requests stall behind full plane repacks "
+                    "(O(postings) pack + device upload per refresh); p99 "
+                    "collapses under live indexing.", ["search"]))
+                doc["diagnosis"].append(_diagnosis(
+                    "plane_serving:sync_rebuilds",
+                    "Refreshes are invalidating serving planes faster "
+                    "than the background repack absorbs them, or "
+                    "delta-tier serving is disabled (ES_TPU_PLANE_DELTA"
+                    "=0).",
+                    "Re-enable delta serving, raise "
+                    "ES_TPU_PLANE_DELTA_FRACTION, or lower the refresh "
+                    "rate; watch es_plane_rebuild_total{mode=\"sync\"}.",
+                    {"indices": sorted(per_index)}))
+            if ann_drift > 0:
+                doc["impacts"].append(_impact(
+                    "plane_serving:ann_recall_drift", 3,
+                    "kNN results may fall below the benched recall@k: "
+                    "queries are probing fewer IVF clusters than the "
+                    "knn_ivf_recall bench certified.", ["search"]))
+                doc["diagnosis"].append(_diagnosis(
+                    "plane_serving:ann_nprobe_below_default",
+                    "Requests set [knn.nprobe] below the serving "
+                    "default the recall bench measured.",
+                    "Drop the explicit nprobe override (or re-bench "
+                    "knn_ivf_recall at the lower nprobe and accept its "
+                    "recall@k); watch "
+                    "es_ann_nprobe_below_default_total."))
         return doc
 
     def _ind_compile_churn(self) -> dict:

@@ -48,8 +48,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "TelemetryRegistry", "DEFAULT",
-    "record_compile", "record_transfer", "instrument_step",
-    "device_stats_doc",
+    "record_compile", "record_transfer", "record_ann", "instrument_step",
+    "device_stats_doc", "ann_drift_count",
 ]
 
 
@@ -462,6 +462,49 @@ def record_transfer(h2d_bytes: int = 0, d2h_bytes: int = 0,
     if d2h_bytes:
         reg.counter("es_device_transfer_bytes_total",
                     {"direction": "d2h"}).inc(d2h_bytes)
+
+
+def record_ann(clusters_probed: int = 0, candidates_reranked: int = 0,
+               quantized_bytes: int = 0, exact_bytes: int = 0,
+               below_default: bool = False,
+               registry: Optional[TelemetryRegistry] = None) -> None:
+    """One IVF (cluster-pruned ANN) dispatch: how much of the corpus the
+    pruning actually visited. ``quantized_bytes`` is what the pruned
+    int8/bf16 scan read, ``exact_bytes`` what the f32 re-rank gather
+    read — their sum vs the full-corpus f32 bytes is the dispatch's
+    bandwidth win (ROOFLINE.md IVF model). ``below_default`` marks a
+    dispatch served under the benched nprobe — recall-config drift the
+    ``plane_serving`` health indicator surfaces as yellow."""
+    reg = registry or DEFAULT
+    if clusters_probed:
+        reg.counter("es_ann_clusters_probed_total",
+                    help="IVF clusters visited (queries × nprobe)").inc(
+                        clusters_probed)
+    if candidates_reranked:
+        reg.counter("es_ann_candidates_reranked_total",
+                    help="quantized-scan survivors re-scored exactly "
+                         "from the f32 tier").inc(candidates_reranked)
+    if quantized_bytes:
+        reg.counter("es_ann_bytes_read_total", {"tier": "quantized"},
+                    help="bytes the ANN dispatch read per tier").inc(
+                        quantized_bytes)
+    if exact_bytes:
+        reg.counter("es_ann_bytes_read_total", {"tier": "exact"}).inc(
+            exact_bytes)
+    if below_default:
+        reg.counter("es_ann_nprobe_below_default_total",
+                    help="ANN dispatches served with nprobe below the "
+                         "benched default (recall-config drift)").inc()
+
+
+def ann_drift_count(registry: Optional[TelemetryRegistry] = None) -> int:
+    """Dispatches served below the benched nprobe so far — the health
+    indicator's recall-drift signal."""
+    reg = registry or DEFAULT
+    doc = reg.metrics_doc().get("es_ann_nprobe_below_default_total")
+    if not doc:
+        return 0
+    return int(sum(s["value"] for s in doc["series"]))
 
 
 #: per-thread flag: did the LAST instrumented-step call on this thread

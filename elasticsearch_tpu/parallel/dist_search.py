@@ -369,6 +369,493 @@ def build_knn_step(mesh: Mesh, *, n_pad: int, dim: int, k: int,
 
 
 # ---------------------------------------------------------------------------
+# IVF tier: cluster-pruned ANN over an int8 quantized corpus
+# ---------------------------------------------------------------------------
+#
+# Brute-force kNN is exact O(N) and, per ROOFLINE.md, bandwidth-bound —
+# bytes moved per query is the lever. HNSW-style graphs (the Lucene/
+# Anserini answer) don't batch on device: pointer-chasing serializes on
+# the scalar unit. The TPU-shaped answer is cluster-pruned dense scans:
+#
+# - PACK time: k-means (batched-matmul Lloyd iterations; on an
+#   accelerator the assignment matmuls run through jnp, on the CPU
+#   backend through BLAS) assigns every corpus vector to one of nlist
+#   centroids; rows are REORDERED cluster-contiguous (stable within a
+#   cluster, so tie order inside a cluster stays doc-ascending) with a
+#   cluster-offset table, and each vector is scalar-quantized to int8
+#   with per-vector (scale, offset) rows: v ≈ scale·q + off, so
+#   dot(u, v) ≈ scale·dot(u, q) + off·Σu — one fused multiply-add per
+#   candidate after the int8 matmul. ``quant="bf16"`` keeps a bf16 tier
+#   instead (2 bytes/dim, no scale/off error).
+# - QUERY time: one [B, nlist] centroid matmul picks nprobe clusters per
+#   query; only those clusters' blocks stream through the running-top-k
+#   scan over the QUANTIZED tier (bytes moved drop by
+#   ~(nprobe/nlist)·(1/4) vs the exact f32 scan); the top
+#   ``rerank·k`` survivors are re-scored EXACTLY from the f32 tier and
+#   the final top-k keeps the plane's (score desc, global id asc) tie
+#   order.
+#
+# nprobe == nlist disables pruning: every row is scanned quantized, and
+# the exact re-rank restores f32 scores/order for everything that
+# reaches the rerank window (the property-test contract).
+
+#: rows per IVF device-scan block: the quantized tier is tiled into
+#: fixed blocks (block-major [NB, IVF_BLOCK, d]) so the probed-cluster
+#: union becomes a static-shape gather + lax.scan; boundary blocks are
+#: masked per row by cluster id, so blocks need no cluster alignment
+IVF_BLOCK = 256
+
+#: serving defaults (the knn_ivf_recall bench measures THESE — the
+#: plane_serving health indicator flags dispatches below the benched
+#: nprobe as recall-config drift)
+IVF_DEFAULT_NPROBE = 8
+IVF_DEFAULT_RERANK = 4
+
+#: k-means training defaults: Lloyd on a bounded sample (assignment of
+#: the FULL corpus happens once, chunked, after training)
+IVF_TRAIN_SAMPLE = 1 << 15
+IVF_KMEANS_ITERS = 6
+
+
+def _device_linalg() -> bool:
+    """True when the default jax backend is an accelerator — k-means
+    assignment matmuls then run through jnp (MXU); the CPU backend uses
+    BLAS directly (XLA:CPU runs well under numpy's sgemm here, same
+    verdict as search_host vs the jitted step)."""
+    import jax
+    try:
+        return jax.devices()[0].platform != "cpu"
+    except Exception:   # noqa: BLE001 — no backend: host math
+        return False
+
+
+def _assign_clusters(x: np.ndarray, centroids: np.ndarray, l2: bool,
+                     chunk: Optional[int] = None) -> np.ndarray:
+    """argmax_c metric(x, c) per row, chunked so the [chunk, nlist]
+    score matrix stays ≤ ~64 MB at ANY nlist (the chunk scales
+    inversely with the centroid count). Metric matches query-time probe
+    selection exactly: dot for cosine/dot_product (rows/centroids in
+    the plane's packed convention), ``2x·c - ‖c‖²`` for l2."""
+    if chunk is None:
+        chunk = max(1024, (64 << 20) // (4 * max(centroids.shape[0], 1)))
+    c2 = np.sum(centroids.astype(np.float64) ** 2,
+                axis=1).astype(np.float32)
+    on_dev = _device_linalg()
+    out = np.empty(x.shape[0], np.int32)
+    for lo in range(0, x.shape[0], chunk):
+        xb = x[lo: lo + chunk]
+        if on_dev:
+            s = jnp.einsum("nd,cd->nc", jnp.asarray(xb),
+                           jnp.asarray(centroids),
+                           preferred_element_type=jnp.float32)
+            if l2:
+                s = 2.0 * s - jnp.asarray(c2)[None, :]
+            out[lo: lo + chunk] = np.asarray(jnp.argmax(s, axis=1),
+                                             np.int32)
+        else:
+            s = xb @ centroids.T
+            if l2:
+                s = 2.0 * s - c2[None, :]
+            out[lo: lo + chunk] = np.argmax(s, axis=1).astype(np.int32)
+    return out
+
+
+def kmeans_fit(x: np.ndarray, nlist: int, *, l2: bool = False,
+               spherical: bool = False, iters: int = IVF_KMEANS_ITERS,
+               sample: int = IVF_TRAIN_SAMPLE, seed: int = 0) -> np.ndarray:
+    """Batched-matmul Lloyd: train nlist centroids on (a sample of) x.
+
+    Each iteration is one assignment matmul (device when an accelerator
+    backend is up) + one scatter-add update; empty clusters re-seed from
+    random rows so nlist stays fully used. ``spherical`` renormalizes
+    centroids each round (cosine corpora are packed unit — spherical
+    k-means keeps the probe metric consistent with row scoring)."""
+    rng = np.random.RandomState(seed)
+    n = x.shape[0]
+    if n == 0 or nlist <= 0:
+        raise ValueError("kmeans_fit needs rows and nlist >= 1")
+    train = x if n <= sample else x[rng.choice(n, sample, replace=False)]
+    # centroids are seeded (and re-seeded on empties) from the TRAIN
+    # sample, so nlist is capped by it, not by the full corpus
+    nlist = min(nlist, train.shape[0])
+    cent = train[rng.choice(train.shape[0], nlist, replace=False)].copy()
+    for _ in range(max(iters, 1)):
+        assign = _assign_clusters(train, cent, l2)
+        sums = np.zeros_like(cent, dtype=np.float64)
+        np.add.at(sums, assign, train.astype(np.float64))
+        counts = np.bincount(assign, minlength=nlist)
+        empty = counts == 0
+        nz = ~empty
+        cent[nz] = (sums[nz] / counts[nz, None]).astype(np.float32)
+        if empty.any():
+            cent[empty] = train[rng.choice(train.shape[0],
+                                           int(empty.sum()))]
+        if spherical:
+            cent /= np.maximum(
+                np.linalg.norm(cent, axis=1, keepdims=True), 1e-12)
+    return cent
+
+
+def quantize_int8_rows(vecs: np.ndarray):
+    """Per-vector asymmetric int8 scalar quantization.
+
+    Row i maps [min_i, max_i] onto [-127, 127]:
+    ``v ≈ scale·q + off`` with ``scale = (max-min)/254`` and
+    ``off = min + 127·scale`` — so a dequantized dot product is one
+    fused multiply-add on the int8 matmul result:
+    ``dot(u, v̂) = scale·dot(u, q) + off·Σu``. Returns
+    (codes int8[N, d], scale f32[N], off f32[N])."""
+    vecs = np.asarray(vecs, np.float32)
+    lo = vecs.min(axis=-1)
+    hi = vecs.max(axis=-1)
+    scale = np.maximum((hi - lo) / 254.0, 1e-12).astype(np.float32)
+    codes = np.clip(np.rint((vecs - lo[:, None]) / scale[:, None]) - 127.0,
+                    -127, 127).astype(np.int8)
+    off = (lo + 127.0 * scale).astype(np.float32)
+    return codes, scale, off
+
+
+class IvfKnnTier:
+    """Pack-time IVF index over one :class:`DistributedKnnPlane`'s packed
+    corpus: shared centroids + per-shard cluster-contiguous quantized
+    rows. The f32 tier (the plane's own packed vectors) stays in original
+    row order and serves the exact re-rank; only the QUANTIZED tier is
+    reordered."""
+
+    def __init__(self, similarity: str, quant: str = "int8",
+                 block: int = IVF_BLOCK):
+        if quant not in ("int8", "bf16"):
+            raise ValueError(f"unknown ivf quant [{quant}]")
+        self.similarity = similarity
+        self.quant = quant
+        self.block = block
+        self.nlist = 0
+        self.centroids: Optional[np.ndarray] = None
+        #: per shard: offsets i64[nlist+1] (cluster → row range in the
+        #: reordered space), rows i32[n_exist] (reordered → original
+        #: local row), codes, scale f32, off f32
+        self.shards: List[dict] = []
+        self.default_nprobe = IVF_DEFAULT_NPROBE
+        #: blocks per shard in the device tier (max over shards of
+        #: ceil(rows/block)) — the ONE source of the sentinel pad-block
+        #: index both device_arrays and union_blocks key off
+        self.n_blocks = 1
+        #: rows per cluster summed over shards (docs-scanned attribution
+        #: of a pruned dispatch reads this instead of re-diffing offsets)
+        self.cluster_sizes: Optional[np.ndarray] = None
+        self._dev = None
+        self._dev_lock = threading.Lock()
+
+    # -- pack ----------------------------------------------------------------
+
+    @classmethod
+    def build(cls, vecs: np.ndarray, exists: np.ndarray, similarity: str,
+              *, nlist: Optional[int] = None, quant: str = "int8",
+              iters: int = IVF_KMEANS_ITERS,
+              train_sample: int = IVF_TRAIN_SAMPLE, seed: int = 0,
+              block: int = IVF_BLOCK) -> "IvfKnnTier":
+        """``vecs`` f32[S, n_pad, d] / ``exists`` bool[S, n_pad]: the
+        plane's PACKED arrays (cosine rows already unit — centroid and
+        row scoring then share one metric). ``nlist`` defaults to
+        ~sqrt(N) rounded to a power of two (bounded so the average
+        cluster keeps ≥ 8 rows)."""
+        tier = cls(similarity, quant=quant, block=block)
+        S = vecs.shape[0]
+        d = vecs.shape[2]
+        flat = np.concatenate([vecs[s][exists[s]] for s in range(S)]) \
+            if S else np.zeros((0, d), np.float32)
+        n_exist = flat.shape[0]
+        if n_exist == 0:
+            raise ValueError("IVF tier needs at least one vector")
+        if nlist is None:
+            nlist = round_up_pow2(max(int(np.sqrt(n_exist)), 1))
+        nlist = max(1, min(int(nlist), max(n_exist // 8, 1)))
+        l2 = similarity == "l2_norm"
+        tier.centroids = kmeans_fit(
+            flat, nlist, l2=l2, spherical=(similarity == "cosine"),
+            iters=iters, sample=train_sample, seed=seed)
+        tier.nlist = tier.centroids.shape[0]
+        tier.default_nprobe = min(IVF_DEFAULT_NPROBE, tier.nlist)
+        for s in range(S):
+            rows0 = np.flatnonzero(exists[s]).astype(np.int32)
+            v = vecs[s][rows0]
+            assign = _assign_clusters(v, tier.centroids, l2) \
+                if rows0.size else np.zeros(0, np.int32)
+            # stable sort: rows within a cluster stay doc-ascending, so
+            # equal re-ranked scores tie-break exactly like the exact scan
+            order = np.argsort(assign, kind="stable")
+            rows = rows0[order]
+            offsets = np.zeros(tier.nlist + 1, np.int64)
+            np.cumsum(np.bincount(assign, minlength=tier.nlist),
+                      out=offsets[1:])
+            if quant == "int8":
+                codes, scale, off = quantize_int8_rows(v[order])
+            else:
+                # bf16 tier: 2 B/dim, no quantization error rows. Host
+                # math uses f16 (numpy has no bf16); the device tier is
+                # cast to bf16 at upload.
+                codes = v[order].astype(np.float16)
+                scale = np.ones(rows.size, np.float32)
+                off = np.zeros(rows.size, np.float32)
+            tier.shards.append(dict(offsets=offsets, rows=rows,
+                                    codes=codes, scale=scale, off=off))
+        tier.n_blocks = max(max((-(-sh["rows"].size // tier.block)
+                                 for sh in tier.shards), default=1), 1)
+        sizes = np.zeros(tier.nlist, np.int64)
+        for sh in tier.shards:
+            sizes += np.diff(sh["offsets"]).astype(np.int64)
+        tier.cluster_sizes = sizes
+        return tier
+
+    def quant_bytes_per_dim(self) -> int:
+        return 1 if self.quant == "int8" else 2
+
+    def nbytes(self) -> int:
+        return sum(sh["codes"].nbytes + sh["scale"].nbytes
+                   + sh["off"].nbytes + sh["rows"].nbytes
+                   for sh in self.shards) \
+            + (self.centroids.nbytes if self.centroids is not None else 0)
+
+    # -- query-time probe selection ------------------------------------------
+
+    def probe(self, qq: np.ndarray, nprobe: int) -> np.ndarray:
+        """Top-``nprobe`` cluster ids per query from ONE [B, nlist]
+        centroid matmul (host BLAS — the matrix is tiny and the probed
+        set must be host-visible anyway to size the static gather
+        shapes, the same reason the text plane's U-gather picks rows on
+        the host). ``qq``: queries in the plane's packed convention
+        (unit rows for cosine)."""
+        s = qq @ self.centroids.T
+        if self.similarity == "l2_norm":
+            c2 = np.sum(self.centroids.astype(np.float64) ** 2,
+                        axis=1).astype(np.float32)
+            s = 2.0 * s - c2[None, :]
+        nprobe = min(nprobe, self.nlist)
+        if nprobe >= self.nlist:
+            return np.broadcast_to(
+                np.arange(self.nlist, dtype=np.int32),
+                (qq.shape[0], self.nlist)).copy()
+        part = np.argpartition(-s, nprobe - 1, axis=1)[:, :nprobe]
+        return part.astype(np.int32)
+
+    # -- device tier ---------------------------------------------------------
+
+    def device_arrays(self, mesh: Mesh, n_pad: int):
+        """Block-major device tier (built lazily, once): codes
+        [S, NB+1, blk, d], scale/off/vn-row metadata [S, NB+1, blk],
+        rowid i32 (original local row; n_pad = sentinel), rcl i32
+        (cluster id per row; -1 = padding). Block NB is an all-sentinel
+        pad target for the probed-union gather."""
+        with self._dev_lock:
+            if self._dev is not None:
+                return self._dev
+            S = len(self.shards)
+            blk = self.block
+            d = self.shards[0]["codes"].shape[1] if S else 1
+            nb = self.n_blocks
+            cdt = np.int8 if self.quant == "int8" else np.float16
+            codes = np.zeros((S, nb + 1, blk, d), cdt)
+            scale = np.zeros((S, nb + 1, blk), np.float32)
+            off = np.zeros((S, nb + 1, blk), np.float32)
+            rowid = np.full((S, nb + 1, blk), n_pad, np.int32)
+            rcl = np.full((S, nb + 1, blk), -1, np.int32)
+            for s, sh in enumerate(self.shards):
+                n = sh["rows"].size
+                if not n:
+                    continue
+                flat_cl = np.repeat(
+                    np.arange(self.nlist, dtype=np.int32),
+                    np.diff(sh["offsets"]).astype(np.int64))
+                codes[s].reshape(-1, d)[:n] = sh["codes"]
+                scale[s].reshape(-1)[:n] = sh["scale"]
+                off[s].reshape(-1)[:n] = sh["off"]
+                rowid[s].reshape(-1)[:n] = sh["rows"]
+                rcl[s].reshape(-1)[:n] = flat_cl
+            spec4 = NamedSharding(mesh, P(AXIS_SHARD, None, None, None))
+            spec3 = NamedSharding(mesh, P(AXIS_SHARD, None, None))
+            dev_codes = jax.device_put(
+                codes if self.quant == "int8"
+                else codes.astype(jnp.bfloat16), spec4)
+            self._dev = dict(
+                nb=nb,
+                codes=dev_codes,
+                scale=jax.device_put(scale, spec3),
+                off=jax.device_put(off, spec3),
+                rowid=jax.device_put(rowid, spec3),
+                rcl=jax.device_put(rcl, spec3))
+            return self._dev
+
+    def union_blocks(self, probed: np.ndarray, n_shards: int):
+        """Per-shard union of the blocks the batch's probed clusters
+        touch, padded (with the sentinel block NB) to a shared pow2
+        width P — the static gather shape of the device step."""
+        blk = self.block
+        nb = self.n_blocks
+        uniq = np.unique(probed)
+        per_shard: List[np.ndarray] = []
+        for sh in self.shards[:n_shards]:
+            offs = sh["offsets"]
+            blocks: set = set()
+            for c in uniq:
+                lo, hi = int(offs[c]), int(offs[c + 1])
+                if hi > lo:
+                    blocks.update(range(lo // blk, (hi - 1) // blk + 1))
+            per_shard.append(np.fromiter(sorted(blocks), np.int32,
+                                         len(blocks)))
+        width = max(max((b.size for b in per_shard), default=1), 1)
+        Pw = min(round_up_pow2(width), nb)
+        Pw = max(Pw, 1)
+        out = np.full((n_shards, Pw), nb, np.int32)    # sentinel block
+        for s, b in enumerate(per_shard):
+            out[s, :min(b.size, Pw)] = b[:Pw]
+        return out, Pw
+
+
+def build_ivf_knn_step(mesh: Mesh, *, n_pad: int, dim: int, k: int,
+                       n_shards: int, similarity: str, nprobe: int,
+                       r_cand: int, p_blocks: int, blk: int,
+                       quant: str = "int8"):
+    """Jitted IVF dispatch: gather the probed-union blocks of the
+    quantized tier, stream them through a ``lax.scan`` running top-k of
+    width ``r_cand`` (the rerank window), re-score the survivors exactly
+    from the f32 tier, then the usual ICI all_gather/top_k reduce.
+
+    Global shapes: codes [S, NB+1, blk, dim] int8/bf16; scale/off/rowid/
+    rcl [S, NB+1, blk]; vecs f32[S, n_pad, dim] + vnorm2 f32[S, n_pad]
+    (the EXACT tier, original row order); queries f32[B, dim]; probed
+    i32[B, nprobe] (global cluster ids); u_blocks i32[S, p_blocks]
+    (per-shard union, sentinel NB padding). Bytes moved from HBM per
+    dispatch are ~p_blocks·blk·(dim·qbytes + 12) + r_cand·dim·4 per
+    shard — the pruning win the knn_ivf_recall bench measures."""
+    s_dev = mesh.shape[AXIS_SHARD]
+    if n_shards % s_dev:
+        raise ValueError(f"{n_shards} shards not divisible over {s_dev} devices")
+    s_loc = n_shards // s_dev
+    kk = min(k, n_pad)
+    out_k = min(k, n_shards * n_pad)
+    l2 = similarity == "l2_norm"
+
+    def body(codes, scale, off, rowid, rcl, vecs, vnorm2, q, probed,
+             u_blocks):
+        if similarity == "cosine":
+            qq = q / jnp.maximum(
+                jnp.linalg.norm(q, axis=-1, keepdims=True), 1e-12)
+        else:
+            qq = q
+        qsum = jnp.sum(qq, axis=-1)                       # [B]
+        qn = jnp.sum(q * q, axis=-1)                      # [B]
+
+        def per_shard(codes_s, scale_s, off_s, rowid_s, rcl_s, vecs_s,
+                      vn_s, u_s):
+            # gather ONLY the probed-union blocks: HBM reads scale with
+            # the union, not the corpus
+            g_codes = jnp.take(codes_s, u_s, axis=0)      # [P, blk, d]
+            g_scale = jnp.take(scale_s, u_s, axis=0)      # [P, blk]
+            g_off = jnp.take(off_s, u_s, axis=0)
+            g_rowid = jnp.take(rowid_s, u_s, axis=0)
+            g_rcl = jnp.take(rcl_s, u_s, axis=0)
+
+            def score_block(c_b, sc_b, of_b, rid_b, rc_b):
+                dots = jnp.einsum(
+                    "bd,nd->bn", qq, c_b.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+                s = sc_b[None, :] * dots \
+                    + of_b[None, :] * qsum[:, None]
+                if l2:
+                    vn_b = jnp.take(vn_s, jnp.clip(rid_b, 0, n_pad - 1))
+                    s = 2.0 * s - vn_b[None, :] - qn[:, None]
+                # per-query membership: the row's cluster must be in
+                # THIS query's probed set (co-batched queries share the
+                # gathered union but not the mask)
+                member = jnp.any(
+                    rc_b[None, :, None] == probed[:, None, :], axis=-1)
+                live = (rid_b < n_pad)[None, :]
+                return jnp.where(member & live, s, NEG_INF)
+
+            v0 = score_block(g_codes[0], g_scale[0], g_off[0],
+                             g_rowid[0], g_rcl[0])
+            rr = min(r_cand, blk)
+            v0, i0 = batched_blockwise_topk(v0, rr)
+            i0 = i0.astype(jnp.int32)
+            if rr < r_cand:
+                # the scan carry is the FIXED-width rerank window: pad
+                # the seed so every merge keeps exactly r_cand entries
+                padw = r_cand - rr
+                v0 = jnp.pad(v0, ((0, 0), (0, padw)),
+                             constant_values=NEG_INF)
+                i0 = jnp.pad(i0, ((0, 0), (0, padw)))
+
+            def step_blk(carry, xs):
+                acc_v, acc_i = carry
+                p_idx, c_b, sc_b, of_b, rid_b, rc_b = xs
+                bv, bi = batched_blockwise_topk(
+                    score_block(c_b, sc_b, of_b, rid_b, rc_b), rr)
+                gi = bi.astype(jnp.int32) + p_idx * blk
+                cat_v = jnp.concatenate([acc_v, bv], axis=1)
+                cat_i = jnp.concatenate([acc_i, gi], axis=1)
+                nv, sel = lax.top_k(cat_v, min(r_cand, cat_v.shape[1]))
+                ni = jnp.take_along_axis(cat_i, sel, axis=1)
+                return (nv, ni), None
+
+            if p_blocks > 1:
+                (vals_q, pos_q), _ = lax.scan(
+                    step_blk, (v0, i0),
+                    (jnp.arange(1, p_blocks, dtype=jnp.int32),
+                     g_codes[1:], g_scale[1:], g_off[1:], g_rowid[1:],
+                     g_rcl[1:]))
+            else:
+                vals_q, pos_q = v0, i0
+            # positions in the gathered space → original local rows
+            rid_flat = g_rowid.reshape(-1)
+            cand_rows = jnp.take(rid_flat, pos_q)          # [B, R]
+            # EXACT re-rank from the f32 tier: gather survivor rows,
+            # re-score, and sort candidates by row id FIRST so the final
+            # top_k's lowest-position tie preference restores the exact
+            # scan's (score desc, doc asc) order
+            order = jnp.argsort(cand_rows, axis=1)
+            cand_rows = jnp.take_along_axis(cand_rows, order, axis=1)
+            qvals = jnp.take_along_axis(vals_q, order, axis=1)
+            safe_rows = jnp.clip(cand_rows, 0, n_pad - 1)
+            cvecs = jnp.take(vecs_s, safe_rows, axis=0)    # [B, R, d]
+            ex = jnp.einsum("bd,brd->br", qq, cvecs,
+                            preferred_element_type=jnp.float32)
+            if l2:
+                cvn = jnp.take(vn_s, safe_rows)
+                ex = 2.0 * ex - cvn - qn[:, None]
+            ex = jnp.where(qvals == NEG_INF, NEG_INF, ex)
+            vals, sel = lax.top_k(ex, min(kk, ex.shape[1]))
+            idx = jnp.take_along_axis(cand_rows, sel, axis=1)
+            if vals.shape[1] < kk:
+                padw = kk - vals.shape[1]
+                vals = jnp.pad(vals, ((0, 0), (0, padw)),
+                               constant_values=NEG_INF)
+                idx = jnp.pad(idx, ((0, 0), (0, padw)))
+            return vals, idx
+
+        vals, idx = jax.vmap(per_shard, in_axes=(0, 0, 0, 0, 0, 0, 0, 0),
+                             out_axes=1)(codes, scale, off, rowid, rcl,
+                                         vecs, vnorm2, u_blocks)
+        return _global_topk_reduce(vals, idx, s_loc=s_loc, kk=kk,
+                                   n_pad=n_pad, out_k=out_k)
+
+    step = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(AXIS_SHARD, None, None, None),
+                  P(AXIS_SHARD, None, None),
+                  P(AXIS_SHARD, None, None),
+                  P(AXIS_SHARD, None, None),
+                  P(AXIS_SHARD, None, None),
+                  P(AXIS_SHARD, None, None),
+                  P(AXIS_SHARD, None),
+                  P(AXIS_REPLICA, None),
+                  P(AXIS_REPLICA, None),
+                  P(AXIS_SHARD, None)),
+        out_specs=(P(AXIS_REPLICA, None), P(AXIS_REPLICA, None)),
+        check_vma=False)
+    return jax.jit(step)
+
+
+# ---------------------------------------------------------------------------
 # Host-side plane: shard packing + query dispatch
 # ---------------------------------------------------------------------------
 
@@ -956,7 +1443,8 @@ class DistributedKnnPlane:
 
     def __init__(self, mesh: Mesh, shards: Sequence[dict], *,
                  similarity: str = "cosine",
-                 block: Optional[int] = KNN_BLOCK):
+                 block: Optional[int] = KNN_BLOCK,
+                 ivf: Optional[dict] = None):
         if similarity not in KNN_SIMILARITIES:
             raise ValueError(f"unknown similarity [{similarity}]")
         self.mesh = mesh
@@ -992,6 +1480,15 @@ class DistributedKnnPlane:
         vnorm2[~exists] = 0.0
         self.nbytes = vecs.nbytes + vnorm2.nbytes + exists.nbytes
         self._packed = (vecs, vnorm2, exists)
+        # IVF tier (cluster-pruned ANN): built at pack time from the
+        # packed rows, BEFORE the accelerator path releases the host
+        # copy. ``ivf`` is a kwargs dict for IvfKnnTier.build (nlist,
+        # quant, seed, iters, train_sample); None = exact-only plane
+        # (the brute-force fallback the existing bench config measures).
+        self.ivf: Optional[IvfKnnTier] = None
+        if ivf is not None and exists.any() and self.dim:
+            self.ivf = IvfKnnTier.build(vecs, exists, similarity, **ivf)
+            self.nbytes += self.ivf.nbytes()
         self._dev = None          # device arrays, uploaded on first search()
         self._steps: Dict[int, callable] = {}
         # dispatcher threads + the warmup thread hit the lazy upload and
@@ -1023,10 +1520,36 @@ class DistributedKnnPlane:
                     self._packed = None
             return self._dev
 
+    def resolve_ann(self, nprobe: Optional[int],
+                    rerank: Optional[int]):
+        """Effective (nprobe, rerank) for a dispatch, or None for the
+        exact path: nprobe=0 forces exact; None picks the tier's benched
+        default; values clip into [1, nlist] / [1, …]."""
+        if self.ivf is None or nprobe == 0:
+            return None
+        if nprobe is None:
+            nprobe = self.ivf.default_nprobe
+        nprobe = max(1, min(int(nprobe), self.ivf.nlist))
+        rerank = max(1, int(rerank)) if rerank else IVF_DEFAULT_RERANK
+        return nprobe, rerank
+
     def serve(self, query_vectors, k: int = 10,
-              stages: Optional[dict] = None):
-        """Serving entry: the CPU-native blocked scorer when this plane
-        was built on a CPU backend, the jitted device step otherwise."""
+              stages: Optional[dict] = None,
+              nprobe: Optional[int] = None,
+              rerank: Optional[int] = None):
+        """Serving entry: the CPU-native scorer when this plane was
+        built on a CPU backend, the jitted device step otherwise. When
+        an IVF tier exists the dispatch is cluster-pruned (quantized
+        scan + exact re-rank) at the resolved ``nprobe``/``rerank``;
+        ``nprobe=0`` forces the exact brute-force scan."""
+        ann = self.resolve_ann(nprobe, rerank)
+        if ann is not None:
+            if self._host_pack is not None:
+                return self.search_ivf_host(query_vectors, k=k,
+                                            nprobe=ann[0], rerank=ann[1],
+                                            stages=stages)
+            return self.search_ivf(query_vectors, k=k, nprobe=ann[0],
+                                   rerank=ann[1], stages=stages)
         if self._host_pack is not None:
             return self.search_host(query_vectors, k=k, stages=stages)
         return self.search(query_vectors, k=k, stages=stages)
@@ -1194,6 +1717,237 @@ class DistributedKnnPlane:
             stages["fetch_ms"] = 0.0
             stages["compile_cache"] = "host"
         return best_v, self._decode_hits(best_v, best_g)
+
+    # -- IVF: cluster-pruned quantized scan + exact re-rank ------------------
+
+    def _probe_queries(self, q: np.ndarray):
+        """Queries in the packed convention (unit rows for cosine) plus
+        the per-query Σq the dequantized dot needs."""
+        if self.similarity == "cosine":
+            qq = q / np.maximum(
+                np.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+        else:
+            qq = q
+        return qq, np.sum(qq, axis=1)
+
+    def _ivf_probed_docs(self, probed: np.ndarray) -> int:
+        """Mean rows per query the probed clusters cover (summed over
+        shards) — the docs-scanned attribution of a pruned dispatch."""
+        sizes = self.ivf.cluster_sizes
+        return int(sizes[probed].sum(axis=1).mean()) if probed.size else 0
+
+    def _record_ann(self, B: int, nprobe: int, cand: int,
+                    q_bytes: int, x_bytes: int,
+                    stages: Optional[dict]) -> None:
+        from ..common import telemetry as _tm
+        _tm.record_ann(
+            clusters_probed=B * nprobe, candidates_reranked=cand,
+            quantized_bytes=q_bytes, exact_bytes=x_bytes,
+            below_default=nprobe < self.ivf.default_nprobe)
+        if stages is not None:
+            stages["ann_quantized_bytes"] = q_bytes
+            stages["ann_exact_bytes"] = x_bytes
+
+    def search_ivf(self, query_vectors, k: int = 10, *, nprobe: int,
+                   rerank: int, stages: Optional[dict] = None):
+        """Device IVF dispatch: host centroid matmul picks the probed
+        clusters and sizes the static gather (pow2 union width), then
+        the jitted step streams ONLY those blocks of the quantized tier
+        through the running-top-k and re-ranks exactly from the f32
+        tier. Same return convention as :meth:`search`."""
+        if self.ivf is None:
+            raise RuntimeError("plane has no IVF tier")
+        t0 = time.perf_counter()
+        tier = self.ivf
+        q = np.asarray(query_vectors, np.float32)
+        if q.ndim != 2 or (self.dim and q.shape[1] != self.dim):
+            raise ValueError(
+                f"query_vectors must be [B, {self.dim}], got {q.shape}")
+        B = q.shape[0]
+        n_repl = self.mesh.shape[AXIS_REPLICA]
+        B_pad = -(-B // n_repl) * n_repl
+        if B_pad != B:
+            q = np.concatenate(
+                [q, np.zeros((B_pad - B, q.shape[1]), np.float32)])
+        qq, _ = self._probe_queries(q)
+        probed = tier.probe(qq, nprobe)
+        u_blocks, Pw = tier.union_blocks(probed, self.n_shards)
+        kk = min(k, self.n_pad)
+        r_cand = max(kk, min(rerank * kk, Pw * tier.block))
+        step = self._get_ivf_step(k, nprobe, r_cand, Pw)
+        dev = tier.device_arrays(self.mesh, self.n_pad)
+        vecs_dev, vnorm2_dev, _exists_dev = self._device_arrays()
+        repl = NamedSharding(self.mesh, P(AXIS_REPLICA, None))
+        shard2 = NamedSharding(self.mesh, P(AXIS_SHARD, None))
+        q_dev = jax.device_put(q, repl)
+        probed_dev = jax.device_put(probed, repl)
+        u_dev = jax.device_put(u_blocks, shard2)
+        t1 = time.perf_counter()
+        out = step(dev["codes"], dev["scale"], dev["off"], dev["rowid"],
+                   dev["rcl"], vecs_dev, vnorm2_dev, q_dev, probed_dev,
+                   u_dev)
+        if stages is not None:
+            jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        vals, gdocs = out
+        self.n_dispatches += 1
+        from ..common import telemetry as _tm
+        compiled = _tm.last_call_compiled()
+        vals = np.asarray(vals)[:B]
+        gdocs = np.asarray(gdocs)[:B]
+        h2d = q.nbytes + probed.nbytes + u_blocks.nbytes
+        d2h = vals.nbytes + gdocs.nbytes
+        _tm.record_transfer(h2d_bytes=h2d, d2h_bytes=d2h)
+        # bytes the pruned scan actually reads from HBM vs the exact
+        # re-rank gather (the ROOFLINE IVF model's two terms)
+        meta_b = 12 + (4 if self.similarity == "l2_norm" else 0)
+        q_bytes = self.n_shards * Pw * tier.block * \
+            (self.dim * tier.quant_bytes_per_dim() + meta_b)
+        x_bytes = self.n_shards * B_pad * r_cand * self.dim * 4
+        self._record_ann(B, nprobe, B_pad * r_cand * self.n_shards,
+                         q_bytes, x_bytes, stages)
+        hits = self._decode_hits(vals, gdocs)
+        if stages is not None:
+            stages["prep_ms"] = (t1 - t0) * 1e3
+            stages["dispatch_ms"] = (t2 - t1) * 1e3
+            stages["fetch_ms"] = (time.perf_counter() - t2) * 1e3
+            stages["compile_cache"] = "miss" if compiled else "hit"
+            stages["h2d_bytes"] = h2d
+            stages["d2h_bytes"] = d2h
+            stages["docs_scanned"] = self._ivf_probed_docs(probed[:B])
+        return vals, hits
+
+    def _get_ivf_step(self, k: int, nprobe: int, r_cand: int, Pw: int):
+        key = ("ivf", k, nprobe, r_cand, Pw)
+        with self._steps_lock:
+            fn = self._steps.get(key)
+            if fn is None:
+                fn = build_ivf_knn_step(
+                    self.mesh, n_pad=self.n_pad, dim=max(self.dim, 1),
+                    k=k, n_shards=self.n_shards,
+                    similarity=self.similarity, nprobe=nprobe,
+                    r_cand=r_cand, p_blocks=Pw, blk=self.ivf.block,
+                    quant=self.ivf.quant)
+                from ..common.telemetry import instrument_step
+                fn = instrument_step(fn, site="knn_ivf_plane")
+                self._steps[key] = fn
+            return fn
+
+    def search_ivf_host(self, query_vectors, k: int = 10, *, nprobe: int,
+                        rerank: int, stages: Optional[dict] = None):
+        """CPU-native IVF serving: centroid matmul picks each query's
+        clusters, every DISTINCT probed cluster is dequantized once per
+        batch and scored for its probing queries with one gemm over the
+        cluster's contiguous slice, the per-shard top-``rerank·k``
+        survivors re-rank exactly from the f32 tier, and the final
+        top-k keeps the kernel path's tie order (score desc,
+        (shard, doc) asc)."""
+        if self.ivf is None:
+            raise RuntimeError("plane has no IVF tier")
+        if self._host_pack is None:
+            raise RuntimeError("search_ivf_host requires a CPU-backend "
+                               "plane")
+        t0 = time.perf_counter()
+        tier = self.ivf
+        hvecs, hvn, _hex = self._host_pack
+        q = np.asarray(query_vectors, np.float32)
+        if q.ndim != 2 or (self.dim and q.shape[1] != self.dim):
+            raise ValueError(
+                f"query_vectors must be [B, {self.dim}], got {q.shape}")
+        B = q.shape[0]
+        qq, qsum = self._probe_queries(q)
+        l2 = self.similarity == "l2_norm"
+        qn = np.sum(q * q, axis=1) if l2 else None
+        probed = tier.probe(qq, nprobe)
+        kk = min(k, self.n_shards * self.n_pad)
+        R = max(kk, rerank * kk)
+        vals_out = np.full((B, kk), NEG_INF, np.float32)
+        hits_out: List[List[Tuple[int, int]]] = []
+        q_bytes = 0
+        qbpd = tier.quant_bytes_per_dim()
+        # batch × cluster inversion: each DISTINCT probed cluster is
+        # dequantized (astype) once per batch and scored for every query
+        # probing it with one [rows, d]×[d, nq] gemm over a CONTIGUOUS
+        # slice (the reorder made clusters contiguous — no gather) —
+        # co-batched queries sharing hot clusters share the decode
+        by_cluster: Dict[int, List[int]] = {}
+        for bi in range(B):
+            for c in probed[bi]:
+                by_cluster.setdefault(int(c), []).append(bi)
+        cand_v: List[List[np.ndarray]] = [[] for _ in range(B)]
+        cand_g: List[List[np.ndarray]] = [[] for _ in range(B)]
+        for si, sh in enumerate(tier.shards):
+            offs = sh["offsets"]
+            for c, bis in by_cluster.items():
+                lo, hi = int(offs[c]), int(offs[c + 1])
+                if hi <= lo:
+                    continue
+                sub = sh["codes"][lo:hi].astype(np.float32)
+                dots = sub @ qq[bis].T                 # [rows, nq]
+                s = sh["scale"][lo:hi, None] * dots \
+                    + sh["off"][lo:hi, None] * qsum[bis][None, :]
+                rows = sh["rows"][lo:hi]
+                if l2:
+                    s = 2.0 * s - hvn[si, rows][:, None] \
+                        - qn[bis][None, :]
+                q_bytes += (hi - lo) * (self.dim * qbpd + 8)
+                grows = rows.astype(np.int64) + si * self.n_pad
+                if s.shape[0] > R:
+                    # per-(query, cluster) pre-prune to R in ONE 2-D
+                    # introselect: the per-shard top-R of the union
+                    # equals the top-R over per-cluster top-Rs
+                    top = np.argpartition(-s, R - 1, axis=0)[:R]
+                    vs = s[top, np.arange(s.shape[1])[None, :]]
+                    for j, bi in enumerate(bis):
+                        cand_v[bi].append(vs[:, j])
+                        cand_g[bi].append(grows[top[:, j]])
+                else:
+                    for j, bi in enumerate(bis):
+                        cand_v[bi].append(s[:, j])
+                        cand_g[bi].append(grows)
+        for bi in range(B):
+            row: List[Tuple[int, int]] = []
+            if cand_v[bi]:
+                cv0 = np.concatenate(cand_v[bi])
+                cg = np.concatenate(cand_g[bi])
+                # per-shard window: keep R candidates per shard (the
+                # device step's semantics) before the exact re-rank
+                keep: List[np.ndarray] = []
+                sis_all = cg // self.n_pad
+                for si in np.unique(sis_all):
+                    m = np.flatnonzero(sis_all == si)
+                    if m.size > R:
+                        m = m[np.argpartition(-cv0[m], R - 1)[:R]]
+                    keep.append(m)
+                sel = np.concatenate(keep)
+                cg = cg[sel]
+                # exact re-rank: every surviving candidate re-scored
+                # from the f32 tier; quantized scores only chose the
+                # window, never the final order
+                sis = cg // self.n_pad
+                ds = cg % self.n_pad
+                cv = hvecs[sis, ds] @ qq[bi]
+                if l2:
+                    cv = 2.0 * cv - hvn[sis, ds] - qn[bi]
+                order = np.lexsort((cg, -cv))[:kk]
+                vals_out[bi, :order.size] = cv[order]
+                row = [(int(cg[j]) // self.n_pad,
+                        int(cg[j]) % self.n_pad) for j in order]
+            hits_out.append(row)
+        self.n_dispatches += 1
+        # nominal per-shard window accounting, matching the device
+        # path's convention (R candidates PER SHARD re-ranked) so
+        # es_ann_* totals agree across backends
+        x_bytes = B * R * self.n_shards * self.dim * 4
+        self._record_ann(B, nprobe, B * R * self.n_shards, q_bytes,
+                         x_bytes, stages)
+        if stages is not None:
+            stages["prep_ms"] = 0.0
+            stages["dispatch_ms"] = (time.perf_counter() - t0) * 1e3
+            stages["fetch_ms"] = 0.0
+            stages["compile_cache"] = "host"
+            stages["docs_scanned"] = self._ivf_probed_docs(probed)
+        return vals_out, hits_out
 
 
 # ---------------------------------------------------------------------------

@@ -6396,6 +6396,24 @@ class RestAPI:
                 f"the scroll api for a more efficient way to request "
                 f"large data sets. This limit can be set by changing the "
                 f"[index.max_result_window] index level setting.")
+        for kspec in _as_list(search_body.get("knn")):
+            if not isinstance(kspec, dict):
+                continue
+            # ANN accuracy knobs (see shard_search._knn_candidates):
+            # reject malformed values at the edge, like from/size above
+            np_ = kspec.get("nprobe")
+            if np_ is not None and (isinstance(np_, bool)
+                                    or not isinstance(np_, int)
+                                    or np_ < 0):
+                raise IllegalArgumentError(
+                    f"[knn] [nprobe] must be a non-negative integer, "
+                    f"got [{np_}]")
+            rr = kspec.get("rerank")
+            if rr is not None and (isinstance(rr, bool)
+                                   or not isinstance(rr, int) or rr < 1):
+                raise IllegalArgumentError(
+                    f"[knn] [rerank] must be a positive integer, "
+                    f"got [{rr}]")
         for resc in _as_list(search_body.get("rescore")):
             w = int((resc or {}).get("window_size", 10))
             if w > 10000:

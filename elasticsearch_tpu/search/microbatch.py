@@ -80,11 +80,15 @@ def empty_serving_stats() -> Dict[str, int]:
 class _Slot:
     __slots__ = ("terms", "k", "done", "vals", "hits", "total", "error",
                  "t_enq", "rounds_skipped", "stage_ms", "info",
-                 "view_segments", "view_key")
+                 "view_segments", "view_key", "params")
 
-    def __init__(self, terms, k: int, view=None):
+    def __init__(self, terms, k: int, view=None, params=None):
         self.terms = terms
         self.k = k
+        #: extra dispatch parameters that shape the kernel (kNN IVF:
+        #: bucketed (nprobe, rerank)) — co-batching only within one
+        #: params tuple, so the compile-shape lattice stays warm
+        self.params = params
         #: the caller's segment-list snapshot (NRT view). Hit coordinates
         #: must decode against THIS list, so slots only co-batch within
         #: one view and the dispatch resolves the delta tier for exactly
@@ -158,15 +162,16 @@ class PlaneMicroBatcher:
 
     def search(self, terms: Sequence[str], k: int,
                stages: Optional[dict] = None,
-               info: Optional[dict] = None, view=None):
+               info: Optional[dict] = None, view=None, params=None):
         """One query through the batched dispatch. Returns
         (scores[k], hits[(shard, doc)...], exact total). Blocks until the
         dispatch that carries this query completes. ``stages``, when a
         dict, receives this request's per-stage ms timings; ``info``
         receives dispatch metadata (compile-cache hit/miss, batch size)
         for the Profile API's serving section. ``view`` is the caller's
-        segment-list snapshot (see ``_Slot.view_segments``)."""
-        slot = _Slot(terms, k, view=view)
+        segment-list snapshot (see ``_Slot.view_segments``); ``params``
+        are kernel-shaping dispatch parameters (see ``_Slot.params``)."""
+        slot = _Slot(terms, k, view=view, params=params)
         with self._cond:
             self._queue.append(slot)
             self._ensure_dispatcher_locked()
@@ -229,9 +234,10 @@ class PlaneMicroBatcher:
                     self._cond.notify_all()
 
     def _bucket_key(self, s: _Slot):
-        """One dispatch = one (k shape, segment view): k decides the
-        compile shape, the view decides the hit coordinate space."""
-        return (self._k_bucket(s.k), s.view_key)
+        """One dispatch = one (k shape, segment view, params): k and
+        params decide the compile shape, the view decides the hit
+        coordinate space."""
+        return (self._k_bucket(s.k), s.view_key, s.params)
 
     def _take_batch_locked(self) -> List[_Slot]:
         """Pick the next batch (caller holds the lock; queue non-empty).
@@ -253,14 +259,17 @@ class PlaneMicroBatcher:
             self.n_starved_dispatches += 1
         elif len(q) > self.max_batch:
             # coalesce across k-buckets but never across views (a view
-            # boundary is a refresh boundary — coordinates differ)
+            # boundary is a refresh boundary — coordinates differ) or
+            # params (different kernel knobs = different compile shape)
             vcounts: Dict = {}
             for s in q:
-                vcounts[s.view_key] = vcounts.get(s.view_key, 0) + 1
+                vp = (s.view_key, s.params)
+                vcounts[vp] = vcounts.get(vp, 0) + 1
             vbest = max(vcounts.values())
-            vk = next(s.view_key for s in q
-                      if vcounts[s.view_key] == vbest)
-            batch = [s for s in q if s.view_key == vk][: self.max_batch]
+            vk = next((s.view_key, s.params) for s in q
+                      if vcounts[(s.view_key, s.params)] == vbest)
+            batch = [s for s in q
+                     if (s.view_key, s.params) == vk][: self.max_batch]
             if len({self._k_bucket(s.k) for s in batch}) > 1:
                 self.n_coalesced_dispatches += 1
         else:
@@ -312,7 +321,7 @@ class PlaneMicroBatcher:
         try:
             vals, hits, totals = self._dispatch(
                 queries, k, plane_stages,
-                view=batch[0].view_segments)
+                view=batch[0].view_segments, params=batch[0].params)
         except BaseException as e:          # noqa: BLE001 — fan the error
             err = e                         # out to every query in the batch
         t_done = time.perf_counter()
@@ -349,8 +358,12 @@ class PlaneMicroBatcher:
         base_docs = getattr(self.plane, "base_docs", None)
         if base_docs is None:
             base_docs = getattr(self.plane, "n_docs_total", 0)
+        # a cluster-pruned (IVF) dispatch scans only the probed rows —
+        # the plane reports them; full scans cover the whole base corpus
+        scanned = plane_stages.get("docs_scanned")
         batch_info["docs_scanned"] = int(
-            base_docs + plane_stages.get("delta_docs", 0))
+            (base_docs if scanned is None else scanned)
+            + plane_stages.get("delta_docs", 0))
         delta_ms = plane_stages.get("delta_ms")
         if delta_ms is not None:
             # this dispatch merged the base plane with a live delta tier:
@@ -501,10 +514,11 @@ class PlaneMicroBatcher:
         return tuple(terms)
 
     def _dispatch(self, queries, k: int,
-                  stages: Optional[dict] = None, view=None):
+                  stages: Optional[dict] = None, view=None, params=None):
         """One device dispatch over the coalesced batch → (vals, hits,
         totals) aligned with ``queries``. Runs on a dispatcher thread,
-        never under the queue lock."""
+        never under the queue lock. ``params`` is unused on the text
+        plane (lexical dispatches have no kernel knobs)."""
         if view is not None:
             sv = getattr(self.plane, "serve_view", None)
             if sv is not None:
@@ -552,25 +566,38 @@ class KnnPlaneMicroBatcher(PlaneMicroBatcher):
     def _warm_lattice(self, ks, max_b):
         plane = self.plane
         kbs = sorted({self._k_bucket(k) for k in ks})
+        has_ivf = getattr(plane, "ivf", None) is not None
         b = 1
         while b <= min(max_b, self.max_batch):
             for kb in kbs:
                 yield lambda B=b, kb=kb: plane.search(
                     np.zeros((B, max(plane.dim, 1)), np.float32), k=kb)
+                if has_ivf:
+                    # the IVF serving default is its own compile family
+                    # ((nprobe, rerank, union-width) shapes); warm the
+                    # default knobs so the first pruned dispatch of each
+                    # B×k shape doesn't compile mid-traffic
+                    yield lambda B=b, kb=kb: plane.serve(
+                        np.zeros((B, max(plane.dim, 1)), np.float32),
+                        k=kb)
             b <<= 1
 
     def _dispatch(self, queries, k: int,
-                  stages: Optional[dict] = None, view=None):
+                  stages: Optional[dict] = None, view=None, params=None):
         # plane.serve picks the backend-appropriate path (numpy blocked
-        # scorer on CPU — the search_eager analogue — jitted step on TPU)
+        # scorer on CPU — the search_eager analogue — jitted step on
+        # TPU); params carries the batch's bucketed IVF (nprobe, rerank)
+        kw = {}
+        if params is not None:
+            kw = {"nprobe": params[0], "rerank": params[1]}
         if view is not None:
             sv = getattr(self.plane, "serve_view", None)
             if sv is not None:
                 vals, hits = sv(np.stack(queries), k=k, view=view,
-                                stages=stages)
+                                stages=stages, **kw)
                 return vals, hits, [None] * len(queries)
         vals, hits = self.plane.serve(np.stack(queries), k=k,
-                                      stages=stages)
+                                      stages=stages, **kw)
         return vals, hits, [None] * len(queries)
 
 
@@ -593,9 +620,31 @@ def batched_search(plane, terms: Sequence[str], k: int,
 
 def batched_knn_search(plane, query_vector, k: int, view=None,
                        stages: Optional[dict] = None,
-                       info: Optional[dict] = None):
+                       info: Optional[dict] = None,
+                       nprobe: Optional[int] = None,
+                       rerank: Optional[int] = None):
     """Route one kNN query through the knn plane's micro-batcher.
-    Returns (raw_scores[k'], hits [(shard, doc), ...])."""
+    Returns (raw_scores[k'], hits [(shard, doc), ...]).
+
+    ``nprobe``/``rerank`` (the ANN accuracy knobs) ride the k-bucket
+    lattice: they are ROUNDED UP to a power of two here (never down —
+    extra probes only improve recall), so co-batched queries share one
+    compile shape and the warmup lattice covers live traffic. On a plane
+    without an IVF tier the knobs are inert (exact brute force) and
+    every request shares the knob-less dispatch."""
+    params = None
+    ivf = getattr(plane, "ivf", None)
+    if ivf is not None:
+        if nprobe == 0:
+            params = (0, 0)         # exact scan explicitly requested
+        else:
+            from ..utils.shapes import round_up_pow2
+            from ..parallel.dist_search import IVF_DEFAULT_RERANK
+            want = ivf.default_nprobe if nprobe is None \
+                else max(1, int(nprobe))
+            rr = IVF_DEFAULT_RERANK if not rerank else max(1, int(rerank))
+            params = (min(round_up_pow2(want, 1), ivf.nlist),
+                      round_up_pow2(rr, 1))
     batcher = getattr(plane, "_microbatcher", None)
     if batcher is None:
         with _CREATE_LOCK:
@@ -605,7 +654,7 @@ def batched_knn_search(plane, query_vector, k: int, view=None,
                 plane._microbatcher = batcher
     vals, hits, _total = batcher.search(
         np.asarray(query_vector, np.float32), k, view=view,
-        stages=stages, info=info)
+        stages=stages, info=info, params=params)
     return vals, hits
 
 

@@ -482,20 +482,33 @@ class KnnServingGeneration(_ServingGeneration):
         self._swap_delta(scorer, key, base_pos, ver)
 
     def serve_view(self, query_vectors, k: int = 10, *, view,
-                   stages: Optional[dict] = None):
+                   stages: Optional[dict] = None,
+                   nprobe: Optional[int] = None,
+                   rerank: Optional[int] = None):
         delta, base_pos = self._delta_for_view(view)
         return self._serve_merged(query_vectors, k, delta, base_pos,
-                                  stages=stages)
+                                  stages=stages, nprobe=nprobe,
+                                  rerank=rerank)
 
     def serve(self, query_vectors, k: int = 10,
-              stages: Optional[dict] = None):
+              stages: Optional[dict] = None,
+              nprobe: Optional[int] = None,
+              rerank: Optional[int] = None):
         delta, base_pos = self._snapshot()
         return self._serve_merged(query_vectors, k, delta, base_pos,
-                                  stages=stages)
+                                  stages=stages, nprobe=nprobe,
+                                  rerank=rerank)
 
     def _serve_merged(self, query_vectors, k, delta, base_pos, *,
-                      stages: Optional[dict] = None):
-        vals, hits = self.base.serve(query_vectors, k=k, stages=stages)
+                      stages: Optional[dict] = None,
+                      nprobe: Optional[int] = None,
+                      rerank: Optional[int] = None):
+        # the base dispatch may be cluster-pruned (IVF tier at the
+        # resolved nprobe/rerank); the DELTA tier always scores exact
+        # brute-force — appended segments are small, and exactness there
+        # keeps the merge's top-k honest for fresh docs
+        vals, hits = self.base.serve(query_vectors, k=k, stages=stages,
+                                     nprobe=nprobe, rerank=rerank)
         if delta is None:
             return vals, hits
         t1 = time.perf_counter()
@@ -541,6 +554,14 @@ class ServingPlaneCache:
     REPACK_DELTA_FRACTION = float(os.environ.get(
         "ES_TPU_PLANE_DELTA_FRACTION", "0.125"))
 
+    #: corpus size above which a kNN base pack also builds the IVF tier
+    #: (k-means + cluster-contiguous int8 quantized rows — cluster-pruned
+    #: approximate serving with exact re-rank). Below it the plane stays
+    #: exact brute force: the pruned scan only wins once the corpus
+    #: outgrows what one blocked f32 scan streams comfortably.
+    KNN_IVF_MIN_DOCS = int(os.environ.get(
+        "ES_TPU_KNN_IVF_MIN_DOCS", str(1 << 16)))
+
     def __init__(self, mesh_factory=None, min_docs: int = _MIN_DOCS_DEFAULT):
         self._mesh_factory = mesh_factory
         self._mesh = None
@@ -558,6 +579,9 @@ class ServingPlaneCache:
         #: route bows out to the per-segment path instead
         self._knn_build_streak = 0
         self.min_docs = min_docs
+        #: instance override of :attr:`KNN_IVF_MIN_DOCS` (tests force
+        #: IVF on tiny corpora by lowering it)
+        self.knn_ivf_min_docs = self.KNN_IVF_MIN_DOCS
         #: delta-tier serving on/off (off = the old rebuild-every-refresh
         #: behavior; the live-indexing bench uses this as its baseline)
         self.delta_enabled = os.environ.get(
@@ -1104,11 +1128,20 @@ class ServingPlaneCache:
         n_pad = round_up_pow2(max(max(s["exists"].shape[0]
                                       for s in shards), 1))
         nbytes = len(shards) * n_pad * (dim * 4 + 5)
+        # past the IVF threshold the pack also builds the quantized tier
+        # (int8 codes + scale/off/row maps ≈ dim+12 B/row) and serves
+        # cluster-pruned by default; the delta tier stays exact
+        total_docs = sum(int(s["exists"].shape[0]) for s in shards)
+        ivf_kw = None
+        if total_docs >= max(self.knn_ivf_min_docs, 1):
+            ivf_kw = {}
+            nbytes += len(shards) * n_pad * (dim + 12)
         key = (field, tuple(id(s) for s in segments))
         acct.add_estimate(nbytes, f"<knn serving plane [{field}]>")
         try:
             plane = DistributedKnnPlane(self._get_mesh(), shards,
-                                        similarity=similarity)
+                                        similarity=similarity,
+                                        ivf=ivf_kw)
         except Exception:
             acct.release(nbytes)
             raise

@@ -202,6 +202,22 @@ class ShardSearcher:
         k = int(spec.get("k", 10))
         num_candidates = int(spec.get("num_candidates", max(k, 10)))
         boost = float(spec.get("boost", 1.0))
+        # ANN accuracy knobs (num_candidates-style): nprobe = IVF
+        # clusters visited per query (0 forces the exact scan), rerank =
+        # exact-re-scoring window factor. Inert on the per-segment path
+        # and on planes below the IVF corpus threshold (brute force).
+        nprobe = spec.get("nprobe")
+        if nprobe is not None:
+            nprobe = int(nprobe)
+            if nprobe < 0:
+                raise IllegalArgumentError(
+                    f"[knn] [nprobe] must be non-negative, got [{nprobe}]")
+        rerank = spec.get("rerank")
+        if rerank is not None:
+            rerank = int(rerank)
+            if rerank < 1:
+                raise IllegalArgumentError(
+                    f"[knn] [rerank] must be positive, got [{rerank}]")
         ft = self.mapper.field_type(field)
         if not isinstance(ft, DenseVectorFieldType):
             raise IllegalArgumentError(
@@ -231,7 +247,9 @@ class ShardSearcher:
                                                 k=num_candidates,
                                                 view=self.segments,
                                                 stages=knn_stages,
-                                                info=knn_info)
+                                                info=knn_info,
+                                                nprobe=nprobe,
+                                                rerank=rerank)
                 _attribute_dispatch(knn_stages, knn_info)
                 cands = [
                     (self._knn_score_from_raw(ft.similarity, float(v))

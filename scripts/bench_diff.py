@@ -11,13 +11,18 @@ Prints one line per comparable metric — the headline plus every entry in
 ``configs`` that carries a throughput ``value`` (unit ``*/s``) — with the
 old/new numbers, the relative delta, and ``p99_ms`` movement where both
 sides report it. Exits **1** when any throughput metric regressed by
-more than ``--threshold`` (default 10%), so CI can ratchet on bench
-trajectories instead of eyeballing the ``BENCH_r*`` files.
+more than ``--threshold`` (default 10%), OR when a config's
+``recall_at_k`` dropped by more than 0.01 absolute (recall is a
+correctness budget, not a throughput — it gets its own, tighter gate),
+so CI can ratchet on bench trajectories instead of eyeballing the
+``BENCH_r*`` files.
 
-Configs present on only one side are listed as added/removed but never
-gate (a new config is not a regression); error-shaped configs
-(``{"error": ...}``) gate only if the other side had a real number —
-a config that stopped producing results IS a regression.
+Configs present in only one of the two files are SKIPPED with a note
+(added / removed), never gated — BENCH files span rounds where configs
+appear and (on backend fallbacks) drop out; a pairwise diff can only
+judge what both sides measured. Error-shaped configs (``{"error":
+...}``) still gate when the other side had a real number — a config
+that stopped producing results IS a regression.
 """
 
 from __future__ import annotations
@@ -55,6 +60,11 @@ def _metrics(doc: dict):
     return out
 
 
+#: absolute recall_at_k drop that fails the diff (recall is a
+#: correctness budget — 1% absolute is already a visible quality change)
+RECALL_DROP_MAX = 0.01
+
+
 def diff(old: dict, new: dict, threshold: float):
     """Returns (report lines, regression names)."""
     lines = []
@@ -63,14 +73,14 @@ def diff(old: dict, new: dict, threshold: float):
     for name in sorted(set(om) | set(nm)):
         o, n = om.get(name), nm.get(name)
         if o is None:
-            lines.append(f"  {name:40s} ADDED"
+            # one-sided config: note it, never gate (a new config is
+            # not a regression; the NEXT diff will pair it)
+            lines.append(f"  {name:40s} SKIPPED (only in new)"
                          + (f"  {n['value']} {n.get('unit', '')}"
                             if _is_throughput(n) else ""))
             continue
         if n is None:
-            lines.append(f"  {name:40s} REMOVED")
-            if _is_throughput(o):
-                regressions.append(f"{name} (removed)")
+            lines.append(f"  {name:40s} SKIPPED (only in old)")
             continue
         if not _is_throughput(o):
             continue                     # nothing numeric to compare
@@ -85,12 +95,22 @@ def diff(old: dict, new: dict, threshold: float):
         if delta < -threshold:
             flag = "  << REGRESSION"
             regressions.append(f"{name} ({delta:+.1%})")
+        rec = ""
+        orec, nrec = o.get("recall_at_k"), n.get("recall_at_k")
+        if isinstance(orec, (int, float)) and \
+                isinstance(nrec, (int, float)):
+            rec = f"  recall {orec:.4f} -> {nrec:.4f}"
+            if float(orec) - float(nrec) > RECALL_DROP_MAX:
+                flag = "  << RECALL REGRESSION"
+                regressions.append(
+                    f"{name} (recall_at_k {orec:.4f} -> {nrec:.4f})")
         p99 = ""
         if isinstance(o.get("p99_ms"), (int, float)) and \
                 isinstance(n.get("p99_ms"), (int, float)):
             p99 = f"  p99 {o['p99_ms']:.1f} -> {n['p99_ms']:.1f} ms"
         lines.append(f"  {name:40s} {ov:>10.1f} -> {nv:>10.1f} "
-                     f"{n.get('unit', ''):12s} {delta:+7.1%}{p99}{flag}")
+                     f"{n.get('unit', ''):12s} {delta:+7.1%}{rec}{p99}"
+                     f"{flag}")
     return lines, regressions
 
 
@@ -117,12 +137,13 @@ def main(argv=None) -> int:
     for ln in lines:
         print(ln)
     if regressions:
-        print(f"FAIL: {len(regressions)} throughput regression(s) past "
-              f"{args.threshold:.0%}:")
+        print(f"FAIL: {len(regressions)} regression(s) (throughput past "
+              f"{args.threshold:.0%} or recall_at_k past "
+              f"{RECALL_DROP_MAX}):")
         for r in regressions:
             print(f"  - {r}")
         return 1
-    print("OK: no throughput regression past the threshold")
+    print("OK: no throughput or recall regression past the thresholds")
     return 0
 
 

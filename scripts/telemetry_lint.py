@@ -95,6 +95,18 @@ def runtime_families() -> set:
         plane = DistributedSearchPlane(mesh, [corpus], field="body")
         plane._host_csr = None
         plane.serve([["t1"]], k=4, with_totals=True)
+        # IVF (cluster-pruned ANN) dispatch: registers the es_ann_*
+        # families (clusters probed / candidates re-ranked / bytes per
+        # tier), plus the nprobe-below-default drift counter the
+        # plane_serving health indicator reads
+        from elasticsearch_tpu.parallel.dist_search import \
+            DistributedKnnPlane
+        kvecs = rng.randn(256, 8).astype(np.float32)
+        kplane = DistributedKnnPlane(
+            mesh, [dict(vectors=kvecs)], similarity="cosine",
+            ivf=dict(nlist=8, seed=0))
+        kplane.serve(np.zeros((2, 8), np.float32), k=3)
+        kplane.serve(np.zeros((1, 8), np.float32), k=3, nprobe=1)
 
         snap = telemetry.DEFAULT.stats_doc()
         return {name for name in snap if name.startswith("es_")}

@@ -337,6 +337,94 @@ def test_knn_threshold_repack_swaps_generation():
     assert [h.doc_id for h in r1.hits] == [h.doc_id for h in r2.hits]
 
 
+def test_ivf_base_with_exact_delta_merge_and_tie_order():
+    """IVF + delta interaction: the base generation serves the
+    quantized cluster-pruned tier while APPENDED segments score exact
+    brute-force in the delta tier; the merged top-k keeps the plane's
+    (score desc, (segment, doc) asc) tie order. With pruning disabled
+    (huge nprobe + rerank) the merged result equals the per-segment
+    path exactly — quantized-base + exact-delta == exact."""
+    svc = MapperService(MAPPING)
+    rng = np.random.RandomState(31)
+    base_segs = _mk_vector_segments(svc, rng, 2, 40)
+    cache = ServingPlaneCache()
+    cache.REPACK_DELTA_FRACTION = 10.0      # keep the delta live
+    cache.knn_ivf_min_docs = 1              # force the IVF tier
+    gen = cache.knn_plane_for(base_segs, svc, "vec")
+    assert gen is not None and gen.base.ivf is not None
+    delta_segs = _mk_vector_segments(svc, rng, 1, 10, start=700,
+                                     prefix="dv")
+    segs = base_segs + delta_segs
+    routed = ShardSearcher(
+        segs, svc,
+        knn_plane_provider=lambda s, f: cache.knn_plane_for(s, svc, f))
+    plain = ShardSearcher(segs, svc)
+    # a query aimed at a DELTA doc: the exact delta tier must surface
+    # it first, at the per-segment path's exact score
+    dv = delta_segs[0].vector_fields["vec"].matrix_host[0]
+    for qv in (dv, rng.randn(8)):
+        body = {"knn": {"field": "vec",
+                        "query_vector": [float(x) for x in qv],
+                        "k": 8, "num_candidates": 16,
+                        "nprobe": 10 ** 6, "rerank": 64}, "size": 8}
+        r1 = routed.search(dict(body))
+        r2 = plain.search(dict(body))
+        g2 = cache.knn_plane_for(segs, svc, "vec")
+        assert g2 is gen and g2.delta is not None
+        assert [h.doc_id for h in r1.hits] == \
+            [h.doc_id for h in r2.hits]
+        for h1, h2 in zip(r1.hits, r2.hits):
+            assert h1.score == pytest.approx(h2.score, rel=1e-5,
+                                             abs=1e-5)
+
+
+def test_ivf_repack_folds_delta_with_recall_preserved():
+    """Crossing the repack threshold folds the delta docs into a NEW
+    base generation that again carries the IVF layout (the quantized
+    tier is rebuilt over base+delta); recall at the serving defaults is
+    preserved across the swap and the folded-in docs are findable."""
+    svc = MapperService(MAPPING)
+    rng = np.random.RandomState(37)
+    base_segs = _mk_vector_segments(svc, rng, 2, 40)
+    cache = ServingPlaneCache()
+    cache.REPACK_DELTA_FRACTION = 0.05
+    cache.knn_ivf_min_docs = 1
+    gen1 = cache.knn_plane_for(base_segs, svc, "vec")
+    assert gen1.base.ivf is not None
+    delta_segs = _mk_vector_segments(svc, rng, 1, 12, start=900,
+                                     prefix="dv")
+    segs = base_segs + delta_segs
+    g = cache.knn_plane_for(segs, svc, "vec")
+    assert g is gen1                          # delta serves pre-swap
+    cache.drain_repacks()
+    gen2 = cache.knn_plane_for(segs, svc, "vec")
+    assert gen2 is not gen1 and gen2.delta is None
+    # the repacked base carries the IVF layout over base+delta docs
+    assert gen2.base.ivf is not None
+    assert gen2.base_docs == sum(s.n_docs for s in segs)
+    # recall preserved: default-knob serving vs the exact scan on the
+    # SAME generation (delta docs included in both)
+    routed = ShardSearcher(
+        segs, svc,
+        knn_plane_provider=lambda s, f: cache.knn_plane_for(s, svc, f))
+    dv = delta_segs[0].vector_fields["vec"].matrix_host[1]
+    for qv in (dv, rng.randn(8)):
+        base_body = {"knn": {"field": "vec",
+                             "query_vector": [float(x) for x in qv],
+                             "k": 6, "num_candidates": 12}, "size": 6}
+        exact = routed.search(
+            {**base_body, "knn": {**base_body["knn"], "nprobe": 0}})
+        approx = routed.search(dict(base_body))
+        e_ids = [h.doc_id for h in exact.hits]
+        a_ids = [h.doc_id for h in approx.hits]
+        assert len(set(e_ids) & set(a_ids)) >= int(0.8 * len(e_ids))
+    # a folded-in delta doc is findable at rank 1 by its own vector
+    r = routed.search({"knn": {"field": "vec",
+                               "query_vector": [float(x) for x in dv],
+                               "k": 3, "num_candidates": 6}, "size": 3})
+    assert r.hits and r.hits[0].score == pytest.approx(1.0, abs=1e-5)
+
+
 # ---------------------------------------------------------------------------
 # engine/refresh integration + the zero-doc-refresh regression
 # ---------------------------------------------------------------------------
