@@ -674,6 +674,99 @@ def bench_knn_ivf(rng, mesh, on_cpu):
         "index_build_s": round(build_s, 1)})
 
 
+def bench_lexical_prune(rng, mesh, on_cpu):
+    """Config: block-max lexical pruning at 2-10M docs (default 2^22 =
+    4.2M synthetic Zipf docs; BENCH_LEX_N_DOCS overrides) — q/s and
+    blocks-skipped fraction for the rank-safe pruned scan vs the eager
+    scan on the SAME plane, same queries, top-10.
+
+    Rank-safety is ASSERTED in-bench: pruned results must be
+    bit-identical to eager (values, hits, tie order) on the shared eval
+    batches — a pruning bug fails the bench, it never reports a healthy
+    speedup. The plane is built WITHOUT the dense matmul tier
+    (``dense_threshold`` huge): this config measures the CPU host
+    serving split (``search_eager`` vs ``search_pruned_eager``), where
+    the dense tier is never read — at 4M docs it would be >2 GB of
+    dead weight. CSR impact bytes before/after int8 quantization land
+    in the JSON (the tier's resident-bytes win) and are asserted ≥2x.
+    ``p99_gate: true`` opts this config into scripts/bench_diff.py's
+    p99-latency gate."""
+    from elasticsearch_tpu.parallel import DistributedSearchPlane
+    from elasticsearch_tpu.utils.synth import synthetic_csr_corpus_fast
+    n_docs = int(os.environ.get("BENCH_LEX_N_DOCS", 0)) or (1 << 22)
+    vocab = 1 << 16
+    B = 16
+    corpus = synthetic_csr_corpus_fast(rng, n_docs, vocab, 16, zipf_s=1.2)
+    corpus["term_ids"] = {f"t{t}": t for t in range(vocab)}
+    t_build = time.perf_counter()
+    plane = DistributedSearchPlane(mesh, [corpus], field="body",
+                                   dense_threshold=1 << 30, blockmax={})
+    build_s = time.perf_counter() - t_build
+    tier = plane.blockmax
+    imp_f32 = tier.impact_bytes_f32()
+    imp_int8 = tier.impact_bytes_int8()
+    if imp_f32 < 2 * imp_int8:
+        raise SystemExit(
+            f"int8 impact quantization under 2x: {imp_f32} -> {imp_int8}")
+    df = corpus["df"].astype(np.float64)
+    eligible = np.flatnonzero(df >= 2)
+    p = df[eligible] / df[eligible].sum()
+
+    def q_batch():
+        draws = rng.choice(eligible, size=(B, N_TERMS), p=p)
+        return [[f"t{t}" for t in row] for row in draws]
+
+    # p99 over these dispatch samples feeds bench_diff's p99 gate —
+    # keep enough of them that one noisy batch doesn't swing it
+    n_eager = 3
+    n_pruned = 16
+    batches = [q_batch() for _ in range(n_pruned)]
+    plane.serve(batches[0], k=K, prune=False)       # warm both paths
+    plane.serve(batches[0], k=K, prune=True)
+    eager_res, ts_eager = [], []
+    for qb in batches[:n_eager]:
+        t0 = time.perf_counter()
+        res = plane.serve(qb, k=K, prune=False)
+        ts_eager.append(time.perf_counter() - t0)
+        eager_res.append(res)
+    eager_qps = (n_eager * B) / sum(ts_eager)
+    st: dict = {}
+    ts_pruned = []
+    pruned_res = []
+    for qb in batches:
+        stb: dict = {}
+        t0 = time.perf_counter()
+        res = plane.serve(qb, k=K, prune=True, stages=stb)
+        ts_pruned.append(time.perf_counter() - t0)
+        pruned_res.append(res)
+        for key in ("lex_blocks_scored", "lex_blocks_total"):
+            st[key] = st.get(key, 0) + stb.get(key, 0)
+    ts_pruned = np.asarray(ts_pruned)
+    pruned_qps = (n_pruned * B) / ts_pruned.sum()
+    # rank-safety: pruned == eager EXACTLY on the shared batches
+    for (ev, eh), (pv, ph) in zip(eager_res, pruned_res[:n_eager]):
+        if not (np.array_equal(ev, pv) and eh == ph):
+            raise SystemExit("lexical prune rank-safety violated: "
+                             "pruned != eager")
+    skipped = 1.0 - st["lex_blocks_scored"] / max(st["lex_blocks_total"],
+                                                  1)
+    return _emit("lexical_10m_prune", {
+        "value": round(pruned_qps, 1), "unit": "queries/s",
+        "vs_eager": round(pruned_qps / eager_qps, 2),
+        "eager_qps": round(eager_qps, 1),
+        "p99_ms": round(float(np.percentile(ts_pruned, 99) * 1e3), 2),
+        "eager_p99_ms": round(
+            float(np.percentile(ts_eager, 99) * 1e3), 2),
+        "p99_gate": True,
+        "blocks_skipped_frac": round(skipped, 4),
+        "rank_safety": "asserted-bit-identical",
+        "impact_bytes_f32": imp_f32,
+        "impact_bytes_int8": imp_int8,
+        "impact_bytes_ratio": round(imp_f32 / imp_int8, 2),
+        "n_docs": n_docs, "k": K, "n_terms": N_TERMS,
+        "index_build_s": round(build_s, 1)})
+
+
 def bench_hybrid_rrf(rng, mesh, on_cpu):
     """Config #5: hybrid BM25 + kNN with reciprocal-rank fusion (window
     100, k=10) — both retrievers on device, fusion on host; vs the same
@@ -1069,33 +1162,49 @@ def main(mode: str = "accel"):
     n_docs = int(os.environ.get("BENCH_N_DOCS", 0)) or \
         ((1 << 18) if on_cpu else (1 << 23))
 
+    # --configs substring filter (BENCH_CONFIGS env for child procs):
+    # run only matching configs — e.g. `--configs lexical_10m_prune`
+    # runs the 4M-doc pruning config alone without paying the full suite
+    filt = os.environ.get("BENCH_CONFIGS", "").strip()
+
+    def want(name: str) -> bool:
+        return not filt or filt in name
+
+    need_plane = any(want(n) for n in
+                     ("match_bm25_headline", "batch_curve",
+                      "bool_disjunction"))
     rng = np.random.RandomState(1234)
-    t0 = time.perf_counter()
-    corpus = synthetic_csr_corpus_fast(rng, n_docs, VOCAB, AVG_DL,
-                                       zipf_s=1.2)
-    corpus["term_ids"] = {f"t{t}": t for t in range(VOCAB)}
-    print(f"# corpus: {n_docs} docs, {corpus['docs'].shape[0]} postings "
-          f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
-
-    # ---- CPU reference ----------------------------------------------------
-    cpu_queries = sample_queries(rng, corpus, 1, batch=CPU_REF_QUERIES)[0]
-    cpu_times, _ = cpu_bm25_search(corpus, cpu_queries, K)
-    cpu_qps = len(cpu_times) / sum(cpu_times)
-    print(f"# cpu ref: {cpu_qps:.1f} qps, "
-          f"p99 {np.percentile(cpu_times, 99) * 1e3:.1f} ms", file=sys.stderr)
-
-    # ---- TPU --------------------------------------------------------------
     n_dev = len(jax.devices())
     mesh = make_search_mesh(n_shards=n_dev, n_replicas=1)
-    t0 = time.perf_counter()
-    shards = split_csr_shards(corpus, n_dev) if n_dev > 1 else [corpus]
-    for s in shards:
-        s["term_ids"] = corpus["term_ids"]
-    plane = DistributedSearchPlane(mesh, shards, field="body")
-    print(f"# plane: {plane.n_shards} shards, n_pad {plane.n_pad}, "
-          f"dense tier T={plane.n_dense} (pad {plane.T_pad}), "
-          f"sparse L_cap {plane.L_cap} "
-          f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
+    corpus = plane = None
+    cpu_qps = 0.0
+    if need_plane:
+        t0 = time.perf_counter()
+        corpus = synthetic_csr_corpus_fast(rng, n_docs, VOCAB, AVG_DL,
+                                           zipf_s=1.2)
+        corpus["term_ids"] = {f"t{t}": t for t in range(VOCAB)}
+        print(f"# corpus: {n_docs} docs, {corpus['docs'].shape[0]} postings "
+              f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
+
+        # ---- CPU reference ------------------------------------------------
+        cpu_queries = sample_queries(rng, corpus, 1,
+                                     batch=CPU_REF_QUERIES)[0]
+        cpu_times, _ = cpu_bm25_search(corpus, cpu_queries, K)
+        cpu_qps = len(cpu_times) / sum(cpu_times)
+        print(f"# cpu ref: {cpu_qps:.1f} qps, "
+              f"p99 {np.percentile(cpu_times, 99) * 1e3:.1f} ms",
+              file=sys.stderr)
+
+        # ---- TPU ----------------------------------------------------------
+        t0 = time.perf_counter()
+        shards = split_csr_shards(corpus, n_dev) if n_dev > 1 else [corpus]
+        for s in shards:
+            s["term_ids"] = corpus["term_ids"]
+        plane = DistributedSearchPlane(mesh, shards, field="body")
+        print(f"# plane: {plane.n_shards} shards, n_pad {plane.n_pad}, "
+              f"dense tier T={plane.n_dense} (pad {plane.T_pad}), "
+              f"sparse L_cap {plane.L_cap} "
+              f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
 
     # fixed compile shapes: Q=N_TERMS, workload-sized L, tiered kernel.
     # On a CPU backend the serving path is the plane's term-at-a-time eager
@@ -1103,67 +1212,74 @@ def main(mode: str = "accel"):
     # and does ~25x the arithmetic a CPU should do); the tiered kernel is
     # still timed and reported as kernel_cpu_qps for transparency.
     on_cpu_serving = on_cpu
-    tiered = plane.T_pad > 0
-    warm = sample_queries(rng, corpus, 1)[0]
-    timed_batches = sample_queries(rng, corpus, TIMED_ITERS)
-    kb = sample_queries(rng, corpus, 8) if on_cpu else []
-    t0 = time.perf_counter()
-    L1 = workload_L(plane, [warm] + timed_batches + kb, N_TERMS)
-    print(f"# headline L (workload-sized): {L1} (cap {plane.L_cap})",
-          file=sys.stderr)
-    plane.search(warm, k=K, Q=N_TERMS, L=L1, tiered=tiered)
-    print(f"# compile+warm: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
-
     kernel_cpu_qps = None
-    if on_cpu_serving:
+    tpu_qps = p99_ms = 0.0
+    lat = np.zeros(1)
+    if need_plane:
+        tiered = plane.T_pad > 0
+        warm = sample_queries(rng, corpus, 1)[0]
+        timed_batches = sample_queries(rng, corpus, TIMED_ITERS)
+        kb = sample_queries(rng, corpus, 8) if on_cpu else []
         t0 = time.perf_counter()
-        for qs in kb:
-            plane.search(qs, k=K, Q=N_TERMS, L=L1, tiered=tiered)
-        kernel_cpu_qps = (8 * BATCH) / (time.perf_counter() - t0)
-        print(f"# tiered kernel on cpu: {kernel_cpu_qps:.1f} qps "
-              f"(reported as kernel_cpu_qps)", file=sys.stderr)
-        plane.search_eager(warm, k=K)       # warm the eager path
+        L1 = workload_L(plane, [warm] + timed_batches + kb, N_TERMS)
+        print(f"# headline L (workload-sized): {L1} (cap {plane.L_cap})",
+              file=sys.stderr)
+        plane.search(warm, k=K, Q=N_TERMS, L=L1, tiered=tiered)
+        print(f"# compile+warm: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
 
-    lat = []
-    first_result = None
-    for qs in timed_batches:
-        t0 = time.perf_counter()
         if on_cpu_serving:
-            vals, hits = plane.search_eager(qs, k=K)
-        else:
-            vals, hits = plane.search(qs, k=K, Q=N_TERMS, L=L1,
-                                      tiered=tiered)
-        lat.append(time.perf_counter() - t0)
-        if first_result is None:
-            first_result = (qs, vals)
-    lat = np.asarray(lat)
-    tpu_qps = (TIMED_ITERS * BATCH) / lat.sum()
-    p99_ms = float(np.percentile(lat, 99) * 1e3)
+            t0 = time.perf_counter()
+            for qs in kb:
+                plane.search(qs, k=K, Q=N_TERMS, L=L1, tiered=tiered)
+            kernel_cpu_qps = (8 * BATCH) / (time.perf_counter() - t0)
+            print(f"# tiered kernel on cpu: {kernel_cpu_qps:.1f} qps "
+                  f"(reported as kernel_cpu_qps)", file=sys.stderr)
+            plane.search_eager(warm, k=K)       # warm the eager path
 
-    # correctness cross-check: the first dispatch's top-1 scores must match
-    # the CPU reference within f32/bf16 tolerance — a kernel regression
-    # must fail the bench, not report a healthy QPS (run on 4 queries; the
-    # CPU reference costs ~0.3 s/query at this corpus size)
-    qs, vals = first_result
-    _, cpu_hits = cpu_bm25_search(corpus, qs[:4], K)
-    for bi in range(4):
-        cpu_top = cpu_hits[bi][0]
-        cpu_score = _score_one(corpus, qs[bi], int(cpu_top))
-        tpu_score = float(vals[bi][0])
-        if abs(tpu_score - cpu_score) > 0.02 * max(1.0, abs(cpu_score)):
-            raise SystemExit(
-                f"correctness check failed: query {qs[bi]} TPU top score "
-                f"{tpu_score} vs CPU {cpu_score}")
-    print("# correctness cross-check vs CPU reference: OK",
-          file=sys.stderr)
+        lat = []
+        first_result = None
+        for qs in timed_batches:
+            t0 = time.perf_counter()
+            if on_cpu_serving:
+                vals, hits = plane.search_eager(qs, k=K)
+            else:
+                vals, hits = plane.search(qs, k=K, Q=N_TERMS, L=L1,
+                                          tiered=tiered)
+            lat.append(time.perf_counter() - t0)
+            if first_result is None:
+                first_result = (qs, vals)
+        lat = np.asarray(lat)
+        tpu_qps = (TIMED_ITERS * BATCH) / lat.sum()
+        p99_ms = float(np.percentile(lat, 99) * 1e3)
+
+        # correctness cross-check: the first dispatch's top-1 scores must
+        # match the CPU reference within f32/bf16 tolerance — a kernel
+        # regression must fail the bench, not report a healthy QPS (run on
+        # 4 queries; the CPU reference costs ~0.3 s/query at this size)
+        qs, vals = first_result
+        _, cpu_hits = cpu_bm25_search(corpus, qs[:4], K)
+        for bi in range(4):
+            cpu_top = cpu_hits[bi][0]
+            cpu_score = _score_one(corpus, qs[bi], int(cpu_top))
+            tpu_score = float(vals[bi][0])
+            if abs(tpu_score - cpu_score) > 0.02 * max(1.0, abs(cpu_score)):
+                raise SystemExit(
+                    f"correctness check failed: query {qs[bi]} TPU top "
+                    f"score {tpu_score} vs CPU {cpu_score}")
+        print("# correctness cross-check vs CPU reference: OK",
+              file=sys.stderr)
 
     configs = {}
-    _emit("match_bm25_headline", {
-        "value": round(tpu_qps, 1), "unit": "queries/s",
-        "vs_baseline": round(tpu_qps / cpu_qps, 2),
-        "p99_ms": round(p99_ms, 2)})
+    if need_plane:
+        _emit("match_bm25_headline", {
+            "value": round(tpu_qps, 1), "unit": "queries/s",
+            "vs_baseline": round(tpu_qps / cpu_qps, 2),
+            "p99_ms": round(p99_ms, 2)})
 
     def run(name, fn, *args):
+        if not want(name):
+            return
         try:
             configs[name] = fn(*args)
         except SystemExit:
@@ -1173,22 +1289,38 @@ def main(mode: str = "accel"):
             configs[name] = {"error": repr(e)[:300]}
             print(f"# config {name} FAILED: {e!r}", file=sys.stderr)
 
-    run("batch_curve", bench_batch_curve, rng, corpus, plane, on_cpu)
-    run("bool_disjunction", bench_bool_disjunction, rng, corpus, plane,
-        on_cpu)
-    del plane
+    if need_plane:
+        run("batch_curve", bench_batch_curve, rng, corpus, plane, on_cpu)
+        run("bool_disjunction", bench_bool_disjunction, rng, corpus,
+            plane, on_cpu)
+        del plane
     run("terms_percentiles", bench_terms_percentiles, rng, on_cpu)
     run("knn", bench_knn, rng, mesh, on_cpu)
     run("knn_ivf_recall", bench_knn_ivf, rng, mesh, on_cpu)
+    if on_cpu:
+        # host-serving config: the pruned/eager split it measures is the
+        # CPU path (search_pruned_eager vs search_eager); on an
+        # accelerator the dense matmul tier already owns the Zipf head
+        # and the fixed-trip masked scan would measure compile shape,
+        # not pruning
+        run("lexical_10m_prune", bench_lexical_prune, rng, mesh, on_cpu)
     run("hybrid_rrf", bench_hybrid_rrf, rng, mesh, on_cpu)
     run("serving", bench_serving, rng)
     run("live_indexing", bench_live_indexing, rng)
 
+    if not need_plane:
+        # filtered run without the headline: promote the first selected
+        # config's number so the final JSON line still carries a metric
+        first = next((c for c in configs.values()
+                      if isinstance(c, dict) and "value" in c), {})
+        tpu_qps = float(first.get("value", 0.0))
+        p99_ms = float(first.get("p99_ms", 0.0))
     doc = {
-        "metric": f"bm25_topk_qps_{n_docs}_docs_uncapped_df",
+        "metric": f"bm25_topk_qps_{n_docs}_docs_uncapped_df"
+        if need_plane else f"filtered[{filt}]",
         "value": round(tpu_qps, 1),
         "unit": "queries/s",
-        "vs_baseline": round(tpu_qps / cpu_qps, 2),
+        "vs_baseline": round(tpu_qps / cpu_qps, 2) if cpu_qps else None,
         "p99_ms": round(p99_ms, 2),
         "p50_ms": round(float(np.percentile(lat, 50) * 1e3), 2),
         "max_ms": round(float(lat.max() * 1e3), 2),
@@ -1208,7 +1340,17 @@ def main(mode: str = "accel"):
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 2 and sys.argv[1] == "--child":
-        main(sys.argv[2] if len(sys.argv) > 2 else "accel")
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", nargs="?", const="accel", default=None)
+    ap.add_argument("--configs", default=None,
+                    help="substring filter: run only configs whose name "
+                         "contains this (e.g. lexical_10m_prune)")
+    args, _unknown = ap.parse_known_args()
+    if args.configs:
+        # children inherit the filter through the environment
+        os.environ["BENCH_CONFIGS"] = args.configs
+    if args.child is not None:
+        main(args.child)
     else:
         orchestrate()

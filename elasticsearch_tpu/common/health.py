@@ -190,9 +190,18 @@ class HealthService:
             seen = getattr(self.api, "_ann_drift_seen", 0)
             ann_drift = max(ann_total - seen, 0)
             self.api._ann_drift_seen = ann_total
+            # lexical pruning drift, windowed the same way: requests
+            # explicitly forcing prune=off on a block-max plane fall
+            # off the benched WAND-as-a-scan serving path (TELEMETRY.md
+            # es_lex_prune_off_total) — a latency concern, not an error
+            lex_total = _tm.lex_prune_off_count()
+            lseen = getattr(self.api, "_lex_drift_seen", 0)
+            lex_drift = max(lex_total - lseen, 0)
+            self.api._lex_drift_seen = lex_total
         if storm >= self.SYNC_REBUILD_RED:
             status = RED
-        elif storm >= self.SYNC_REBUILD_YELLOW or ann_drift > 0:
+        elif storm >= self.SYNC_REBUILD_YELLOW or ann_drift > 0 \
+                or lex_drift > 0:
             status = YELLOW
         else:
             status = GREEN
@@ -202,6 +211,9 @@ class HealthService:
         elif ann_drift > 0:
             symptom = (f"{ann_drift} ANN dispatches served below the "
                        f"benched nprobe (recall-config drift).")
+        elif lex_drift > 0:
+            symptom = (f"{lex_drift} lexical dispatches forced prune=off "
+                       f"on a block-max plane (pruning drift).")
         else:
             symptom = "Serving planes are maintained off the request path."
         doc = {
@@ -213,6 +225,8 @@ class HealthService:
                         "delta_served_queries": delta_serves,
                         "ann_below_default_dispatches": ann_drift,
                         "ann_below_default_total": ann_total,
+                        "lex_prune_off_dispatches": lex_drift,
+                        "lex_prune_off_total": lex_total,
                         "storming_indices": per_index},
         }
         if status != GREEN:
@@ -248,6 +262,22 @@ class HealthService:
                     "knn_ivf_recall at the lower nprobe and accept its "
                     "recall@k); watch "
                     "es_ann_nprobe_below_default_total."))
+            if lex_drift > 0:
+                doc["impacts"].append(_impact(
+                    "plane_serving:lex_prune_drift", 3,
+                    "Lexical queries are eager-scoring every posting of "
+                    "a corpus the lexical_10m_prune bench serves "
+                    "block-max pruned — latency runs over the benched "
+                    "profile at large corpora.", ["search"]))
+                doc["diagnosis"].append(_diagnosis(
+                    "plane_serving:lex_prune_off",
+                    "Requests set [prune]=false on an index whose "
+                    "serving plane carries a block-max tier (results "
+                    "are identical either way — pruning is rank-safe).",
+                    "Drop the explicit prune override, or accept the "
+                    "eager latency profile; watch "
+                    "es_lex_blocks_skipped_total and "
+                    "es_lex_prune_off_total."))
         return doc
 
     def _ind_compile_churn(self) -> dict:

@@ -48,8 +48,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "TelemetryRegistry", "DEFAULT",
-    "record_compile", "record_transfer", "record_ann", "instrument_step",
-    "device_stats_doc", "ann_drift_count",
+    "record_compile", "record_transfer", "record_ann", "record_lex",
+    "instrument_step", "device_stats_doc", "ann_drift_count",
+    "lex_prune_off_count",
 ]
 
 
@@ -502,6 +503,50 @@ def ann_drift_count(registry: Optional[TelemetryRegistry] = None) -> int:
     indicator's recall-drift signal."""
     reg = registry or DEFAULT
     doc = reg.metrics_doc().get("es_ann_nprobe_below_default_total")
+    if not doc:
+        return 0
+    return int(sum(s["value"] for s in doc["series"]))
+
+
+def record_lex(blocks_scored: int = 0, blocks_skipped: int = 0,
+               quantized_bytes: int = 0, exact_bytes: int = 0,
+               prune_off: bool = False,
+               registry: Optional[TelemetryRegistry] = None) -> None:
+    """One block-max pruned lexical dispatch: how much of the impact-
+    ordered tier the rank-safe scan actually visited (the lexical mirror
+    of :func:`record_ann`). ``quantized_bytes`` is what the pruned int8
+    block scan read (surviving blocks + bound table), ``exact_bytes``
+    what the survivor re-score read from the f32 CSR. ``prune_off``
+    marks a request that explicitly forced ``prune=off`` on a
+    tier-bearing plane — benched-default drift the ``plane_serving``
+    health indicator surfaces as yellow."""
+    reg = registry or DEFAULT
+    # families are created unconditionally (zero increments included) so
+    # their presence is deterministic — the telemetry lint and health
+    # indicator read them on nodes whose corpora never early-exit
+    reg.counter("es_lex_blocks_scored_total",
+                help="block-max blocks the pruned lexical scan "
+                     "scored").inc(blocks_scored)
+    reg.counter("es_lex_blocks_skipped_total",
+                help="block-max blocks skipped by the rank-safe "
+                     "early exit").inc(blocks_skipped)
+    reg.counter("es_lex_bytes_read_total", {"tier": "quantized"},
+                help="bytes the lexical dispatch read per tier").inc(
+                    quantized_bytes)
+    reg.counter("es_lex_bytes_read_total", {"tier": "exact"}).inc(
+        exact_bytes)
+    reg.counter("es_lex_prune_off_total",
+                help="lexical dispatches that forced prune=off on a "
+                     "block-max plane (benched-default drift)").inc(
+                         1 if prune_off else 0)
+
+
+def lex_prune_off_count(registry: Optional[TelemetryRegistry]
+                        = None) -> int:
+    """Dispatches that forced prune=off on a tier-bearing plane so far —
+    the plane_serving health indicator's lexical-drift signal."""
+    reg = registry or DEFAULT
+    doc = reg.metrics_doc().get("es_lex_prune_off_total")
     if not doc:
         return 0
     return int(sum(s["value"] for s in doc["series"]))

@@ -856,6 +856,464 @@ def build_ivf_knn_step(mesh: Mesh, *, n_pad: int, dim: int, k: int,
 
 
 # ---------------------------------------------------------------------------
+# Block-max lexical pruning tier: rank-safe WAND-as-a-scan for BM25
+# ---------------------------------------------------------------------------
+#
+# The CSR planes eager-score every posting of every query term (the BM25S
+# bet) — unbeatable while corpora are small, but at 2-10M docs a Zipf
+# head term drags millions of postings through every dispatch while the
+# top-10 is decided by a few thousand. Lucene's answer is WAND/block-max
+# skipping (doc-at-a-time cursors + per-block score upper bounds); the
+# TPU-shaped recast is the same shape PR 6's IVF tier proved for kNN:
+#
+# - PACK time: each term's postings are reordered impact-descending and
+#   chunked into fixed LEX_BLOCK-wide blocks, so blocks are born sorted
+#   by descending per-block BM25 upper bound (the bound = the block's
+#   first impact, computed at the generation's FROZEN avgdl — the PR 4
+#   invariant that keeps bounds stable under delta serving). Impacts in
+#   the tier are int8-quantized per block (impact-ordered blocks are
+#   value-coherent, so the per-block scale is tight); the bound table,
+#   block offsets and per-term quantization error ride along as dense
+#   arrays.
+# - QUERY time: the blocks the query's terms own are merged into ONE
+#   descending-bound schedule; blocks stream through a scan that
+#   accumulates quantized partial scores and carries a running top-k
+#   window whose k·Q-th value lower-bounds the final k-th score (a doc
+#   holds at most one posting per term, so at least k DISTINCT docs sit
+#   above it). The scan early-exits once the remaining per-term bound
+#   mass ρ falls below that threshold θ: an unseen doc's whole score is
+#   ≤ ρ < θ ≤ the final k-th, so it can neither enter the top-k nor tie
+#   into it. Survivors (partial score + quantization slack + ρ still ≥
+#   θ) are re-scored EXACTLY from the f32 CSR in the eager path's
+#   arithmetic order — quantized scores only choose the window, never
+#   the final ranking — so results are bit-identical to the eager scan
+#   including the (score desc, doc asc) tie order.
+# - On the jitted device path the trip count is FIXED (the schedule
+#   length) and pruning is a per-query mask over scan steps, plus a
+#   per-query SAFETY verdict (window overflow / bound margin): an unsafe
+#   query re-dispatches through the eager kernel, so the pruned path is
+#   rank-safe by construction on every input. The CPU host path
+#   (``search_pruned_eager``) takes a true break and widens its survivor
+#   set dynamically, so it is always safe in one pass.
+
+#: postings per block-max block: small enough that per-block int8 scales
+#: stay tight on impact-ordered runs, large enough that the per-block
+#: bound/scale metadata (12 B) amortizes to <0.1 B/posting
+LEX_BLOCK = 128
+
+#: cap on the carried θ-window width; dispatches whose k·Q exceeds it
+#: serve with pruning inert (θ = -inf) and fall back to eager via the
+#: safety verdict — huge result windows shouldn't prune anyway
+LEX_THETA_WINDOW = 1024
+
+#: survivor (exact re-score) window factor: the device step keeps
+#: ``LEX_RERANK × k`` accumulator survivors for the exact re-score
+LEX_RERANK = 8
+
+
+class BlockMaxTier:
+    """Pack-time impact-ordered block-max tier over one
+    :class:`DistributedSearchPlane`'s full per-shard CSR (sparse AND
+    dense-tier terms — the host pruned path covers every query; the
+    device path prunes the sparse tier and leaves Zipf-head terms to the
+    streaming-matmul dense tier it already rides)."""
+
+    def __init__(self, block: int = LEX_BLOCK):
+        self.block = block
+        self.n_pad = 0
+        #: per shard: docs i32[NB, BS] (sentinel n_pad pad), codes
+        #: int8[NB, BS], scale/off/bound f32[NB], blk_offsets i64[V+1]
+        #: (term → block range), qerr f32[V] (max quantization half-step
+        #: over the term's blocks — the slack term of the rank-safety
+        #: margin), n_postings
+        self.shards: List[dict] = []
+        self.n_blocks = 1
+        self._dev = None
+        self._dev_lock = threading.Lock()
+
+    @classmethod
+    def build(cls, shards: Sequence[dict], impacts_full: Sequence[np.ndarray],
+              *, n_pad: int, block: int = LEX_BLOCK) -> "BlockMaxTier":
+        """``shards``: the plane constructor's shard dicts (original CSR
+        ``offsets``/``docs``); ``impacts_full``: per-shard f32 impacts at
+        the generation's frozen avgdl (``make_impacts`` output)."""
+        tier = cls(block=block)
+        tier.n_pad = n_pad
+        BS = block
+        for s, imp in zip(shards, impacts_full):
+            offsets = np.asarray(s["offsets"], np.int64)
+            docs = np.asarray(s["docs"], np.int32)
+            imp = np.asarray(imp, np.float32)
+            V = offsets.shape[0] - 1
+            Pn = docs.shape[0]
+            lens = np.diff(offsets)
+            # ONE stable global sort puts every term's postings
+            # impact-descending in place (stable: equal impacts keep the
+            # CSR's doc-ascending order, so block contents are
+            # deterministic)
+            tids = np.repeat(np.arange(V, dtype=np.int64), lens)
+            order = np.lexsort((-imp, tids))
+            nblk = -(-lens // BS)
+            blk_offsets = np.zeros(V + 1, np.int64)
+            np.cumsum(nblk, out=blk_offsets[1:])
+            NB = int(blk_offsets[-1])
+            bdocs = np.full((NB, BS), n_pad, np.int32)
+            bimp = np.zeros((NB, BS), np.float32)
+            if Pn:
+                rank = np.arange(Pn, dtype=np.int64) - \
+                    np.repeat(offsets[:-1], lens)
+                dst = np.repeat(blk_offsets[:-1], lens) * BS + rank
+                bdocs.reshape(-1)[dst] = docs[order]
+                bimp.reshape(-1)[dst] = imp[order]
+            real = bdocs < n_pad
+            # impact-descending within the term → slot 0 is the block max
+            # = the block's score upper bound (per unit idf weight)
+            bound = bimp[:, 0].copy()
+            lo_v = np.where(real, bimp, np.float32(np.inf)).min(axis=1) \
+                if NB else np.zeros(0, np.float32)
+            lo_v = np.minimum(lo_v, bound)
+            scale = np.maximum((bound - lo_v) / 254.0,
+                               1e-12).astype(np.float32)
+            codes = np.clip(
+                np.rint((bimp - lo_v[:, None]) / scale[:, None]) - 127.0,
+                -127, 127).astype(np.int8)
+            off = (lo_v + 127.0 * scale).astype(np.float32)
+            qerr = np.zeros(max(V, 1), np.float32)
+            if NB:
+                blk_tid = np.repeat(np.arange(V), nblk)
+                np.maximum.at(qerr, blk_tid,
+                              (scale * 0.5).astype(np.float32))
+            tier.shards.append(dict(
+                docs=bdocs, codes=codes, scale=scale, off=off,
+                bound=bound.astype(np.float32), blk_offsets=blk_offsets,
+                qerr=qerr, n_blocks=NB, n_postings=int(Pn)))
+        tier.n_blocks = max(max((sh["n_blocks"] for sh in tier.shards),
+                                default=1), 1)
+        return tier
+
+    # -- byte accounting (the bench's before/after quantization row) --------
+
+    def impact_bytes_f32(self) -> int:
+        """Bytes the eager plane holds per posting for impact values
+        (the f32 column quantization replaces in the scan tier)."""
+        return sum(sh["n_postings"] * 4 for sh in self.shards)
+
+    def impact_bytes_int8(self) -> int:
+        """Resident bytes of the quantized impact payload: int8 codes
+        (incl. block padding) + per-block scale/off/bound."""
+        return sum(sh["codes"].nbytes + sh["scale"].nbytes
+                   + sh["off"].nbytes + sh["bound"].nbytes
+                   for sh in self.shards)
+
+    def nbytes(self) -> int:
+        return sum(sh["docs"].nbytes + sh["codes"].nbytes
+                   + sh["scale"].nbytes + sh["off"].nbytes
+                   + sh["bound"].nbytes + sh["blk_offsets"].nbytes
+                   + sh["qerr"].nbytes for sh in self.shards)
+
+    # -- query-time schedule -------------------------------------------------
+
+    def schedule(self, si: int, term_rows: Sequence[Tuple[int, float]]):
+        """Descending-bound block schedule of one (query, shard):
+        ``term_rows`` = [(tid, idf·weight)]. Returns (blk i32[n],
+        w f32[n], rho f32[n], tpos i32[n], slack) where ``rho[i]`` is
+        the remaining per-term bound mass BEFORE scoring position i (the
+        WAND upper bound on any not-yet-seen doc's whole score),
+        ``tpos`` the owning term's index in ``term_rows`` (the host
+        chunk scatter groups by it — postings are unique only WITHIN a
+        term) and ``slack`` upper-bounds the accumulated quantization +
+        fp error of any doc's partial score."""
+        tsh = self.shards[si]
+        offs, bound, qerr = tsh["blk_offsets"], tsh["bound"], tsh["qerr"]
+        bl: List[np.ndarray] = []
+        sb: List[np.ndarray] = []
+        wl: List[np.ndarray] = []
+        nx: List[np.ndarray] = []
+        tp: List[np.ndarray] = []
+        slack = 0.0
+        rho0 = 0.0
+        for ti, (tid, w) in enumerate(term_rows):
+            b0, b1 = int(offs[tid]), int(offs[tid + 1])
+            if b1 <= b0:
+                continue
+            s = bound[b0:b1] * np.float32(w)
+            bl.append(np.arange(b0, b1, dtype=np.int32))
+            sb.append(s)
+            wl.append(np.full(b1 - b0, w, np.float32))
+            nx.append(np.concatenate([s[1:], np.zeros(1, np.float32)]))
+            tp.append(np.full(b1 - b0, ti, np.int32))
+            slack += float(qerr[tid]) * float(w)
+            rho0 += float(s[0])
+        if not bl:
+            return (np.zeros(0, np.int32), np.zeros(0, np.float32),
+                    np.zeros(0, np.float32), np.zeros(0, np.int32), 0.0)
+        blk = np.concatenate(bl)
+        sball = np.concatenate(sb)
+        wall = np.concatenate(wl)
+        nxall = np.concatenate(nx)
+        tpall = np.concatenate(tp)
+        order = np.argsort(-sball, kind="stable")
+        # consuming block j of term t shrinks t's remaining bound from
+        # bound[j] to bound[j+1] — rho is the exclusive cumsum of those
+        # drops off the total starting mass
+        delta = (sball - nxall)[order]
+        rho = np.float64(rho0) - (np.cumsum(delta, dtype=np.float64)
+                                  - delta)
+        # fp-margin: the partial accumulator runs in different precision/
+        # order than the eager scorer; a tiny relative pad keeps the
+        # rank-safety margin sound without costing measurable pruning
+        slack += 1e-5 * rho0
+        return (blk[order], wall[order], rho.astype(np.float32),
+                tpall[order], float(slack))
+
+    # -- device tier ---------------------------------------------------------
+
+    def device_arrays(self, mesh: Mesh):
+        """Block-major device tier (lazy, once): docs i32[S, NB+1, BS]
+        (row NB = all-sentinel pad block the masked scan steps read),
+        codes int8[S, NB+1, BS], scale/off f32[S, NB+1]."""
+        with self._dev_lock:
+            if self._dev is not None:
+                return self._dev
+            S = len(self.shards)
+            BS = self.block
+            nb = self.n_blocks
+            docs = np.full((S, nb + 1, BS), self.n_pad, np.int32)
+            codes = np.zeros((S, nb + 1, BS), np.int8)
+            scale = np.zeros((S, nb + 1), np.float32)
+            off = np.zeros((S, nb + 1), np.float32)
+            for s, sh in enumerate(self.shards):
+                n = sh["n_blocks"]
+                if not n:
+                    continue
+                docs[s, :n] = sh["docs"]
+                codes[s, :n] = sh["codes"]
+                scale[s, :n] = sh["scale"]
+                off[s, :n] = sh["off"]
+            spec3 = NamedSharding(mesh, P(AXIS_SHARD, None, None))
+            spec2 = NamedSharding(mesh, P(AXIS_SHARD, None))
+            self._dev = dict(
+                docs=jax.device_put(docs, spec3),
+                codes=jax.device_put(codes, spec3),
+                scale=jax.device_put(scale, spec2),
+                off=jax.device_put(off, spec2))
+            return self._dev
+
+
+def tie_stable_topk_docs(scores: np.ndarray, kk: int) -> np.ndarray:
+    """Doc ids of the top-``kk`` positive scores in (score desc, doc
+    asc) order, with the k-th-boundary TIE resolved doc-ascending —
+    introselect alone keeps an arbitrary tie member, which breaks the
+    kernel paths' tie contract. Bounded: the boundary tie set is
+    reduced with a linear partition before any sort, so a corpus where
+    millions of docs share the k-th score costs O(N), not
+    O(N log N)."""
+    n = scores.shape[0]
+    if n > kk:
+        kth = -np.partition(-scores, kk - 1)[kk - 1]
+        if kth <= 0:
+            sel = np.flatnonzero(scores > 0)
+        else:
+            sel = np.flatnonzero(scores > kth)
+            need = kk - sel.size
+            if need > 0:
+                ties = np.flatnonzero(scores == kth)
+                if ties.size > need:
+                    # smallest `need` doc ids among the boundary ties
+                    ties = np.partition(ties, need - 1)[:need]
+                sel = np.concatenate([sel, ties])
+    else:
+        sel = np.flatnonzero(scores > 0)
+    order = np.lexsort((sel, -scores[sel]))[:kk]
+    return sel[order]
+
+
+def total_value(t) -> int:
+    """Value of a per-query totals entry — plain int (exact count) or a
+    ``(value, "gte")`` tuple from a pruned dispatch (the count is a
+    lower bound: pruning skipped blocks whose docs were never seen,
+    Lucene's track_total_hits-under-WAND semantics)."""
+    return int(t[0]) if isinstance(t, tuple) else int(t or 0)
+
+
+def total_is_lower_bound(t) -> bool:
+    return isinstance(t, tuple)
+
+
+def build_pruned_bm25_step(mesh: Mesh, *, n_pad: int, Q: int, k: int,
+                           P_sched: int, W: int, R: int, BS: int,
+                           NB: int, n_shards: int):
+    """Jitted block-max pruned BM25 dispatch: stream the query batch's
+    descending-bound block schedule through a ``lax.scan`` that
+    scatter-adds dequantized impacts into a dense accumulator and
+    carries a running top-W window; steps whose remaining bound mass ρ
+    falls below the window's rank-safety threshold θ are MASKED OUT
+    (fixed trip count on device — the host path takes a true break).
+    The top-R accumulator survivors are re-scored EXACTLY from the f32
+    sparse postings table (binary search per (candidate, term), f32
+    summation in the sorted-merge kernel's order) and reduced over the
+    ICI like every other step.
+
+    Global shapes: postings_docs i32[S, P'] / postings_impact f32[S, P']
+    (the plane's sparse table, re-score tier); t_docs i32[S, NB+1, BS] /
+    t_codes i8[S, NB+1, BS] / t_scale, t_off f32[S, NB+1] (quantized
+    block tier; row NB = sentinel pad block); sched i32[B, S, P_sched]
+    (block ids, sentinel NB), w f32[B, S, P_sched] (idf·weight of the
+    block's term), rho f32[B, S, P_sched] (remaining bound mass before
+    each position), slack f32[B, S]; starts/lengths i32[B, S, Q] (FULL
+    sparse run lengths — never L-clamped; the re-score bisects whole
+    runs), idfw f32[B, Q].
+
+    Returns (vals f32[B, k], gdocs i32[B, k], matched i32[B],
+    unsafe i32[B], pruned i32[B], blocks_scored i32[B]): ``unsafe > 0``
+    means the survivor window could not certify rank-safety for that
+    query (caller re-dispatches it through the eager kernel);
+    ``matched`` is exact when ``pruned == 0``, else a lower bound."""
+    s_dev = mesh.shape[AXIS_SHARD]
+    if n_shards % s_dev:
+        raise ValueError(f"{n_shards} shards not divisible over {s_dev} devices")
+    s_loc = n_shards // s_dev
+    kk = min(k, n_pad)
+    out_k = min(k, n_shards * n_pad)
+    kq = k * Q
+    prune_active = kq <= W
+    kq_idx = min(kq, W) - 1
+
+    def body(pd, pi, td, tc, ts, to, sched, w, rho, slack, st, ln, idfw):
+        p_table = pd.shape[-1]
+        bisect_iters = max(int(np.ceil(np.log2(p_table + 1))) + 1, 1)
+
+        def per_shard(pd_s, pi_s, td_s, tc_s, ts_s, to_s, sched_s, w_s,
+                      rho_s, slack_s, st_s, ln_s):
+            def per_query(sched_q, w_q, rho_q, slack_q, st_q, ln_q, iw_q):
+                acc0 = jnp.zeros(n_pad, jnp.float32)
+                win0 = jnp.full(W, NEG_INF, jnp.float32)
+
+                def step(carry, xs):
+                    acc, win, pruned, rho_stop, n_sc = carry
+                    b_id, w_b, rho_b = xs
+                    theta = win[kq_idx] - slack_q if prune_active \
+                        else jnp.float32(NEG_INF)
+                    real = b_id != NB
+                    live = real & (rho_b >= theta)
+                    newly = real & ~live
+                    pruned = pruned | newly
+                    rho_stop = jnp.maximum(
+                        rho_stop, jnp.where(newly, rho_b, NEG_INF))
+                    safe_b = jnp.where(live, b_id, NB)
+                    d_b = jnp.take(td_s, safe_b, axis=0)      # [BS]
+                    q_b = jnp.take(tc_s, safe_b,
+                                   axis=0).astype(jnp.float32)
+                    # dequantized impact, clamped strictly positive so
+                    # acc > 0 is exactly "this doc was seen"
+                    vhat = jnp.maximum(
+                        ts_s[safe_b] * q_b + to_s[safe_b], 1e-9)
+                    contrib = jnp.where(live & (d_b < n_pad),
+                                        w_b * vhat, 0.0)
+                    acc = acc.at[d_b].add(contrib, mode="drop")
+                    av = jnp.take(acc, d_b, mode="fill",
+                                  fill_value=NEG_INF)
+                    av = jnp.where(live & (d_b < n_pad), av, NEG_INF)
+                    win, _ = lax.top_k(jnp.concatenate([win, av]), W)
+                    n_sc = n_sc + live.astype(jnp.int32)
+                    return (acc, win, pruned, rho_stop, n_sc), None
+
+                (acc, win, pruned, rho_stop, n_sc), _ = lax.scan(
+                    step,
+                    (acc0, win0, jnp.bool_(False),
+                     jnp.float32(NEG_INF), jnp.int32(0)),
+                    (sched_q, w_q, rho_q))
+                theta_end = win[kq_idx] - slack_q if prune_active \
+                    else jnp.float32(NEG_INF)
+                seen = acc > 0
+                matched = jnp.sum(seen.astype(jnp.int32))
+                rr = min(R, n_pad)
+                cv, ci = lax.top_k(jnp.where(seen, acc, NEG_INF), rr)
+                # safety verdict: docs outside the survivor window have
+                # partial ≤ cv[-1]; with the quantization slack and (if
+                # pruned) the remaining bound mass they must sit
+                # strictly below θ or the window may have cut a true
+                # top-k member — the caller then re-serves eagerly
+                rho_eff = jnp.maximum(rho_stop, 0.0)
+                overflow = matched > rr
+                unsafe = (overflow & (cv[-1] + slack_q >= theta_end)) \
+                    | (pruned & (cv[-1] + slack_q + rho_eff
+                                 >= theta_end))
+                # exact re-score: candidates sorted doc-ascending so the
+                # final top_k's lowest-position tie preference restores
+                # the eager kernel's (score desc, doc asc) order
+                ci = jnp.where(cv == NEG_INF, n_pad, ci)
+                order = jnp.argsort(ci)
+                ci = jnp.take(ci, order)
+                cvs = jnp.take(cv, order)
+                doc = ci[:, None]                           # [R, 1]
+                lo = jnp.broadcast_to(st_q[None, :], (rr, Q))
+                hi = lo + ln_q[None, :]
+                for _ in range(bisect_iters):
+                    cont = lo < hi
+                    mid = (lo + hi) // 2
+                    dv = jnp.take(pd_s, mid, mode="clip")
+                    go = dv < doc
+                    lo = jnp.where(cont & go, mid + 1, lo)
+                    hi = jnp.where(cont & ~go, mid, hi)
+                found = (lo < st_q[None, :] + ln_q[None, :]) & \
+                    (jnp.take(pd_s, lo, mode="clip") == doc)
+                c = jnp.where(
+                    found,
+                    iw_q[None, :] * jnp.take(pi_s, lo, mode="clip"),
+                    0.0)
+                # f32 summation in the sorted-merge kernel's order
+                # (highest term slot first — bit-parity with the eager
+                # step's shifted-add group reduction)
+                score = c[:, Q - 1]
+                for qslot in range(Q - 2, -1, -1):
+                    score = score + c[:, qslot]
+                score = jnp.where(cvs == NEG_INF, NEG_INF, score)
+                vals, sel = lax.top_k(score, kk)
+                docs = jnp.take(ci, sel)
+                docs = jnp.where(vals > NEG_INF, docs, n_pad)
+                return (vals, docs.astype(jnp.int32), matched,
+                        unsafe.astype(jnp.int32),
+                        pruned.astype(jnp.int32), n_sc)
+
+            return jax.vmap(per_query)(sched_s, w_s, rho_s, slack_s,
+                                       st_s, ln_s, idfw)
+
+        out = jax.vmap(per_shard,
+                       in_axes=(0, 0, 0, 0, 0, 0, 1, 1, 1, 1, 1, 1),
+                       out_axes=1)(pd, pi, td, tc, ts, to, sched, w,
+                                   rho, slack, st, ln)
+        vals, idx, matched, unsafe, pruned, n_sc = out
+        gvals, gdocs = _global_topk_reduce(vals, idx, s_loc=s_loc,
+                                           kk=kk, n_pad=n_pad,
+                                           out_k=out_k)
+        matched = lax.psum(jnp.sum(matched, axis=1), AXIS_SHARD)
+        unsafe = lax.psum(jnp.sum(unsafe, axis=1), AXIS_SHARD)
+        pruned = lax.psum(jnp.sum(pruned, axis=1), AXIS_SHARD)
+        n_sc = lax.psum(jnp.sum(n_sc, axis=1), AXIS_SHARD)
+        return gvals, gdocs, matched, unsafe, pruned, n_sc
+
+    shard_corpus = P(AXIS_SHARD, None)
+    step = shard_map(
+        body, mesh=mesh,
+        in_specs=(shard_corpus, shard_corpus,
+                  P(AXIS_SHARD, None, None), P(AXIS_SHARD, None, None),
+                  P(AXIS_SHARD, None), P(AXIS_SHARD, None),
+                  P(AXIS_REPLICA, AXIS_SHARD, None),
+                  P(AXIS_REPLICA, AXIS_SHARD, None),
+                  P(AXIS_REPLICA, AXIS_SHARD, None),
+                  P(AXIS_REPLICA, AXIS_SHARD),
+                  P(AXIS_REPLICA, AXIS_SHARD, None),
+                  P(AXIS_REPLICA, AXIS_SHARD, None),
+                  P(AXIS_REPLICA, None)),
+        out_specs=(P(AXIS_REPLICA, None), P(AXIS_REPLICA, None),
+                   P(AXIS_REPLICA), P(AXIS_REPLICA), P(AXIS_REPLICA),
+                   P(AXIS_REPLICA)),
+        check_vma=False)
+    return jax.jit(step)
+
+
+# ---------------------------------------------------------------------------
 # Host-side plane: shard packing + query dispatch
 # ---------------------------------------------------------------------------
 
@@ -878,7 +1336,8 @@ class DistributedSearchPlane:
 
     def __init__(self, mesh: Mesh, shards: Sequence[dict], field: str,
                  *, k1: float = DEFAULT_K1, b: float = DEFAULT_B,
-                 dense_threshold: Optional[int] = None):
+                 dense_threshold: Optional[int] = None,
+                 blockmax: Optional[dict] = None):
         """``shards``: one dict per shard with keys
         ``term_ids`` (term→tid), ``df`` i32[V], ``offsets`` i64[V+1],
         ``docs`` i32[P], ``tf`` f32[P], ``doc_len`` f32[N], ``doc_uids``
@@ -889,6 +1348,12 @@ class DistributedSearchPlane:
         dense tier (default ``max(n_pad // 64, 4096)``) — see
         ``ops/tiered_bm25.py``. The sorted-merge L is then bounded by the
         largest *sparse* df instead of the corpus-wide max df.
+
+        ``blockmax``: kwargs dict for :meth:`BlockMaxTier.build` (may be
+        empty) — builds the impact-ordered block-max pruning tier at
+        pack time so :meth:`serve` can run the rank-safe WAND-as-a-scan
+        path (``prune``); None = eager-only plane (the default — the
+        serving route enables the tier past its corpus threshold).
 
         A shard dict may carry an ``avgdl`` override: the serving path
         (``search/plane_route.py``) feeds one SEGMENT per plane shard but
@@ -933,6 +1398,14 @@ class DistributedSearchPlane:
                 s, dense_threshold=dense_threshold,
                 max_dense_terms=self.MAX_DENSE_TERMS))
             self.n_docs_total += int(s["doc_len"].shape[0])
+
+        # block-max pruning tier: impact-ordered int8 blocks + bound
+        # table over the FULL CSR, at the same frozen avgdl the impacts
+        # above baked — bounds stay valid for the generation's lifetime
+        self.blockmax: Optional[BlockMaxTier] = None
+        if blockmax is not None:
+            self.blockmax = BlockMaxTier.build(
+                shards, impacts_full, n_pad=self.n_pad, **blockmax)
 
         # retain what query assembly needs: term dicts, ORIGINAL df (global
         # idf stats), sparse-tier offsets/df, dense row maps
@@ -1171,7 +1644,8 @@ class DistributedSearchPlane:
     def serve(self, queries: Sequence[Sequence[str]], k: int = 10,
               *, with_totals: bool = False,
               stages: Optional[dict] = None, extra_docs: int = 0,
-              extra_df: Optional[Dict[str, int]] = None):
+              extra_df: Optional[Dict[str, int]] = None,
+              prune: Optional[bool] = None):
         """Serving entry (the micro-batcher's dispatch hook): the
         CPU-native eager scorer when this plane was built on a CPU
         backend — term-at-a-time over precomputed impacts compiles
@@ -1179,7 +1653,30 @@ class DistributedSearchPlane:
         serving shapes: ladder-rung L, Q floored to SERVING_Q_MIN, so
         live traffic only ever hits the pre-warmed (B, Q, L, k)
         lattice. ``extra_docs``/``extra_df`` fold a delta tier's corpus
-        mass into the idf weights (see :meth:`_lookup`)."""
+        mass into the idf weights (see :meth:`_lookup`).
+
+        ``prune``: block-max pruned scan (rank-safe — results are
+        bit-identical to the eager scan; under an early exit the totals
+        become ``(value, "gte")`` lower bounds, Lucene's WAND
+        track-total-hits semantics). None = tier default (on when the
+        plane packed a :class:`BlockMaxTier`); False forces eager.
+        Result windows past the θ-window cap (k·Q > LEX_THETA_WINDOW —
+        deep pagination / wide rescore windows) route straight to the
+        eager scan: pruning is provably inert there, and the pruned
+        machinery would only add candidate bookkeeping on top of a full
+        scan."""
+        if self.blockmax is not None and prune is not False:
+            needed_q = max(self.SERVING_Q_MIN, round_up_pow2(max(
+                max((len(set(q)) for q in queries), default=1), 1)))
+            if k * needed_q <= LEX_THETA_WINDOW:
+                if self._host_csr is not None:
+                    return self.search_pruned_eager(
+                        queries, k=k, with_totals=with_totals,
+                        stages=stages, extra_docs=extra_docs,
+                        extra_df=extra_df)
+                return self.search_pruned(
+                    queries, k=k, with_totals=with_totals, stages=stages,
+                    extra_docs=extra_docs, extra_df=extra_df)
         if self._host_csr is not None:
             return self.search_eager(queries, k=k,
                                      with_totals=with_totals, stages=stages,
@@ -1376,10 +1873,9 @@ class DistributedSearchPlane:
                 if with_totals:
                     total += int(np.count_nonzero(scores > 0))
                 kk = min(k, csr["n_docs"])
-                top = np.argpartition(-scores, kk - 1)[:kk]
-                sel = top[scores[top] > 0]
-                order = np.lexsort((sel, -scores[sel]))
-                sel = sel[order]
+                # tie-stable bounded cut: the k-th-boundary tie resolves
+                # doc-ascending (the kernel paths' tie contract)
+                sel = tie_stable_topk_docs(scores, kk)
                 cand_v.append(scores[sel])
                 cand_g.append(sel.astype(np.int64) + si * self.n_pad)
             row: List[Tuple[int, int]] = []
@@ -1403,6 +1899,556 @@ class DistributedSearchPlane:
         if with_totals:
             return vals_out, hits_out, totals
         return vals_out, hits_out
+
+    # -- block-max pruned serving -------------------------------------------
+
+    def _query_idfw(self, terms: Sequence[str], extra_docs: int,
+                    extra_df: Optional[Dict[str, int]]):
+        """(term → idf·weight) in first-appearance order — the SAME dict
+        :meth:`search_eager` iterates, so the pruned path's exact
+        re-score accumulates f32 contributions in the identical order
+        (bit-parity of every survivor's score)."""
+        weights: Dict[str, float] = {}
+        for t in terms:
+            weights[t] = weights.get(t, 0.0) + 1.0
+        idfw_of: Dict[str, float] = {}
+        for t, w in weights.items():
+            gdf = sum(int(s2["df"][s2["term_ids"][t]])
+                      for s2 in self.shards if t in s2["term_ids"])
+            if extra_df:
+                gdf += int(extra_df.get(t, 0))
+            if gdf:
+                idfw_of[t] = float(idf_weight(
+                    self.n_docs_total + extra_docs, np.int64(gdf))) * w
+        return idfw_of
+
+    def _prune_buffers(self, n_docs: int):
+        """Per-(thread, corpus-size) reusable accumulators for the host
+        pruned scan — callers reset the entries they touched (O(seen)),
+        never the whole buffer. Thread-local: the micro-batcher runs
+        PIPELINE_DEPTH dispatcher threads concurrently."""
+        tls = self.__dict__.get("_prune_tls")
+        if tls is None:
+            with self._steps_lock:
+                tls = self.__dict__.setdefault("_prune_tls",
+                                               threading.local())
+        bufs = getattr(tls, "bufs", None)
+        if bufs is None:
+            bufs = tls.bufs = {}
+        pair = bufs.get(n_docs)
+        if pair is None:
+            pair = bufs[n_docs] = (np.zeros(n_docs, np.float32),
+                                   np.zeros(n_docs, np.uint16))
+        return pair
+
+    def search_pruned_eager(self, queries: Sequence[Sequence[str]],
+                            k: int = 10, *, with_totals: bool = False,
+                            stages: Optional[dict] = None,
+                            extra_docs: int = 0,
+                            extra_df: Optional[Dict[str, int]] = None):
+        """CPU-native rank-safe pruned serving: blocks stream in
+        descending-bound order through a chunked scatter-add with a TRUE
+        break once the remaining bound mass ρ drops below the running
+        rank-safety threshold θ; survivors re-score exactly from the
+        original CSR. Results (values, hits, tie order) are
+        bit-identical to :meth:`search_eager`; totals become
+        ``(value, "gte")`` lower bounds for queries that early-exited
+        (the skipped blocks' docs were never counted)."""
+        if self._host_csr is None or self.blockmax is None:
+            raise RuntimeError("search_pruned_eager requires a CPU-backend "
+                               "plane with a block-max tier")
+        t0 = time.perf_counter()
+        tier = self.blockmax
+        BS = tier.block
+        B = len(queries)
+        vals_out = np.full((B, k), NEG_INF, np.float32)
+        hits_out: List[List[Tuple[int, int]]] = []
+        totals: List = []
+        blocks_scored = blocks_total = surv_total = 0
+        scanned_docs = 0
+        for bi, terms in enumerate(queries):
+            idfw_of = self._query_idfw(terms, extra_docs, extra_df)
+            cand_v: List[np.ndarray] = []
+            cand_g: List[np.ndarray] = []
+            theta_seed = NEG_INF       # exact k-th best across shards
+            pruned_any = False
+            seen_total = 0
+            for si, (sh, csr) in enumerate(zip(self.shards,
+                                               self._host_csr)):
+                term_rows = [(int(sh["term_ids"][t]), w)
+                             for t, w in idfw_of.items()
+                             if t in sh["term_ids"]]
+                if not term_rows:
+                    continue
+                blk, wblk, rho, tpos, slack = tier.schedule(si, term_rows)
+                n_sched = blk.shape[0]
+                blocks_total += n_sched
+                if not n_sched:
+                    continue
+                tsh = tier.shards[si]
+                n_docs = csr["n_docs"]
+                nterms = len(term_rows)
+                # reusable per-(thread, corpus-size) accumulators: acc
+                # holds quantized partials, tmask the per-doc seen-term
+                # bitmask (a doc seen in term t's scanned blocks holds
+                # its ONLY posting of t — postings are unique within a
+                # term — so the doc's remaining mass is the UNSEEN
+                # terms' remaining bounds, far tighter than the global
+                # ρ). Reset is O(seen), not O(corpus): fresh 2×O(N)
+                # allocations would cost more page faults per query
+                # than the whole scan
+                acc, tmask = self._prune_buffers(n_docs)
+                fine_mask = nterms <= 16
+                # θ candidates: DISTINCT doc ids whose live partial the
+                # dense acc serves — the true k-th distinct partial is a
+                # far tighter threshold than a value ring with up to Q
+                # duplicate entries per doc
+                wdocs = np.zeros(0, np.int64)
+                wcap = max(4 * k, 64)
+                theta = theta_seed
+                pos = 0
+                rho_end = 0.0
+                chunk = 128
+                # scan past the bare ρ < θ point by this factor: extra
+                # blocks are cheap (~128 postings each) while every unit
+                # of leftover per-term bound mass inflates the phase-2
+                # candidate set — stop only once ρ < θ·tighten
+                tighten = self.prune_tighten
+                uniq = None
+                seen_parts: List[np.ndarray] = []
+                try:
+                    while pos < n_sched:
+                        theta_stop = theta * tighten if theta > 0 \
+                            else theta
+                        if theta > NEG_INF and rho[pos] < theta_stop:
+                            rho_end = float(rho[pos])
+                            pruned_any = True
+                            break
+                        take = min(chunk, n_sched - pos)
+                        chunk = min(chunk * 4, 1024)
+                        if theta > NEG_INF:
+                            # ρ is nonincreasing: score only up to the
+                            # first position the current θ already prunes
+                            cut = int(np.searchsorted(
+                                -rho[pos: pos + take], -theta_stop,
+                                side="left"))
+                            if cut < take:
+                                take = cut
+                                if take == 0:
+                                    rho_end = float(rho[pos])
+                                    pruned_any = True
+                                    break
+                        cb = blk[pos: pos + take]
+                        cw = wblk[pos: pos + take]
+                        ct = tpos[pos: pos + take]
+                        d = tsh["docs"][cb]                  # [take, BS]
+                        vhat = np.maximum(
+                            tsh["scale"][cb][:, None]
+                            * tsh["codes"][cb].astype(np.float32)
+                            + tsh["off"][cb][:, None], 1e-9)
+                        contrib = cw[:, None] * vhat
+                        # duplicate docs inside one chunk only occur
+                        # ACROSS terms (postings are unique within a
+                        # term), so grouping the scatter by term keeps
+                        # the fast buffered fancy-index add safe
+                        for ti in np.unique(ct):
+                            rows = ct == ti
+                            dd = d[rows].ravel()
+                            cc = contrib[rows].ravel()
+                            m = dd < n_docs
+                            if not m.all():
+                                dd = dd[m]
+                                cc = cc[m]
+                            acc[dd] += cc
+                            tmask[dd] |= np.uint16(
+                                1 << int(ti)) if fine_mask \
+                                else np.uint16(1)
+                        # chunk's θ candidates by ACCUMULATED partial —
+                        # multi-term docs concentrate here, and θ from
+                        # true partials converges fastest
+                        dr = d.ravel()
+                        msk = dr < n_docs
+                        dr = dr[msk]
+                        seen_parts.append(dr)
+                        av = acc[dr]
+                        if av.size > wcap:
+                            top = np.argpartition(-av, wcap - 1)[:wcap]
+                            cdocs = dr[top]
+                        else:
+                            cdocs = dr
+                        wdocs = np.unique(
+                            np.concatenate([wdocs, cdocs]))
+                        wvals = acc[wdocs]
+                        if wdocs.size > wcap:
+                            keepw = np.argpartition(-wvals,
+                                                    wcap - 1)[:wcap]
+                            wdocs, wvals = wdocs[keepw], wvals[keepw]
+                        if wvals.size >= k:
+                            theta = max(theta, float(
+                                -np.partition(-wvals, k - 1)[k - 1])
+                                - slack)
+                        pos += take
+                    scored = min(pos, n_sched)
+                    blocks_scored += scored
+                    scanned_docs += scored * BS
+                    uniq = np.unique(np.concatenate(seen_parts)) \
+                        if seen_parts else np.zeros(0, np.int64)
+                    if with_totals:
+                        seen_total += int(uniq.size)
+                    if not uniq.size:
+                        continue
+                    sv = acc[uniq]
+                    if theta > NEG_INF:
+                        # per-term remaining bound at the stop point →
+                        # per-doc remaining mass via a bitmask LUT (a
+                        # completed schedule has no remaining mass and
+                        # skips the 2^nterms table outright)
+                        r_t = np.zeros(nterms, np.float64)
+                        if pruned_any and pos < n_sched:
+                            tail_t = tpos[pos:]
+                            tail_b = tsh["bound"][blk[pos:]] \
+                                * wblk[pos:]
+                            for ti in range(nterms):
+                                m = tail_t == ti
+                                if m.any():
+                                    r_t[ti] = float(tail_b[m].max())
+                        if fine_mask and r_t.any():
+                            lut = np.zeros(1 << nterms, np.float32)
+                            idx = np.arange(1 << nterms)
+                            for ti in range(nterms):
+                                lut += np.where(idx & (1 << ti) == 0,
+                                                np.float32(r_t[ti]), 0.0)
+                            ub = sv + (slack + lut[tmask[uniq]])
+                        elif r_t.any():
+                            ub = sv + np.float32(slack + rho_end)
+                        else:
+                            ub = sv + np.float32(slack)
+                        keep = ub >= theta
+                        cand = uniq[keep]
+                        cub = ub[keep]
+                    else:
+                        cand = uniq
+                        cub = np.full(uniq.size, np.float64(np.inf))
+                finally:
+                    # O(seen) buffer reset — the scanned doc lists mark
+                    # exactly the entries any scatter touched
+                    if uniq is not None:
+                        dirty = uniq
+                    elif seen_parts:
+                        dirty = np.unique(np.concatenate(seen_parts))
+                    else:
+                        dirty = np.zeros(0, np.int64)
+                    acc[dirty] = 0.0
+                    tmask[dirty] = 0
+                if not cand.size:
+                    continue
+                # phase 2 — WAND's own evaluation loop, vectorized:
+                # exact-score candidates in DESCENDING upper-bound order
+                # and stop once the next upper bound falls strictly
+                # below the running exact k-th (ties keep evaluating).
+                # True top docs carry the largest bounds, so this
+                # usually touches a few hundred docs, not the seen set.
+                kk = min(k, n_docs)
+                theta_x = theta_seed
+                ev_docs: List[np.ndarray] = []
+                ev_vals: List[np.ndarray] = []
+                n_ev = 0
+                i = 0
+                CH = max(4 * kk, 512)
+                # order only the head of the candidate list (argsort of
+                # the full set costs more than the evaluations it
+                # schedules); widen on the rare non-converged tail
+                M = min(max(8 * kk, 8 * CH), cand.size)
+                if cand.size > M:
+                    head = np.argpartition(-cub, M - 1)[:M]
+                    order = head[np.argsort(-cub[head], kind="stable")]
+                else:
+                    order = np.argsort(-cub, kind="stable")
+                cub_sorted = cub[order]
+                while i < cand.size:
+                    if i >= order.size:
+                        # the pre-sorted head ran out before θ_x closed
+                        # the loop: widen to the full candidate order
+                        rest = np.setdiff1d(np.arange(cand.size), order,
+                                            assume_unique=False)
+                        rest = rest[np.argsort(-cub[rest],
+                                               kind="stable")]
+                        order = np.concatenate([order, rest])
+                        cub_sorted = cub[order]
+                    if theta_x > NEG_INF:
+                        stop = int(np.searchsorted(-cub_sorted[i:],
+                                                   -theta_x,
+                                                   side="right"))
+                        if stop == 0:
+                            break
+                        take = min(CH, stop)
+                    else:
+                        take = CH
+                    sel_i = order[i: i + take]
+                    chunk_d = cand[sel_i]
+                    chunk_d.sort()
+                    # exact re-score from the f32 CSR, in the eager
+                    # path's term order and arithmetic (quantized
+                    # partials only chose the window, never the ranking)
+                    scores = np.zeros(chunk_d.size, np.float32)
+                    for t, idfw in idfw_of.items():
+                        tid = sh["term_ids"].get(t)
+                        if tid is None:
+                            continue
+                        st = int(csr["offsets"][tid])
+                        en = int(csr["offsets"][tid + 1])
+                        if en <= st:
+                            continue
+                        run = csr["docs"][st:en]
+                        p = np.searchsorted(run, chunk_d)
+                        hit = p < (en - st)
+                        hit[hit] = run[p[hit]] == chunk_d[hit]
+                        scores[hit] += idfw * csr["impacts"][st + p[hit]]
+                    ev_docs.append(chunk_d)
+                    ev_vals.append(scores)
+                    n_ev += chunk_d.size
+                    if n_ev >= kk:
+                        allv = np.concatenate(ev_vals) if len(ev_vals) > 1 \
+                            else ev_vals[0]
+                        theta_x = max(theta_x, float(
+                            -np.partition(-allv, kk - 1)[kk - 1]))
+                    i += take
+                surv_total += n_ev
+                if not ev_docs:
+                    continue
+                sel = np.concatenate(ev_docs)
+                svv = np.concatenate(ev_vals)
+                posv = svv > 0
+                sel, svv = sel[posv], svv[posv]
+                # tie-stable cut, matching search_eager's boundary order
+                if sel.size > kk:
+                    kth = -np.partition(-svv, kk - 1)[kk - 1]
+                    keepv = svv >= kth
+                    sel, svv = sel[keepv], svv[keepv]
+                order = np.lexsort((sel, -svv))[:kk]
+                sel, sv = sel[order], svv[order]
+                cand_v.append(sv)
+                cand_g.append(sel.astype(np.int64) + si * self.n_pad)
+                # exact k-th best so far floors the next shard's θ —
+                # a later shard prunes against the global threshold
+                allv = np.concatenate(cand_v)
+                if allv.size >= k:
+                    theta_seed = max(
+                        theta_seed,
+                        float(-np.partition(-allv, k - 1)[k - 1]))
+            row: List[Tuple[int, int]] = []
+            if cand_v:
+                v = np.concatenate(cand_v)
+                g = np.concatenate(cand_g)
+                order = np.lexsort((g, -v))[:k]
+                vals_out[bi, :order.size] = v[order]
+                row = [(int(g[j]) // self.n_pad, int(g[j]) % self.n_pad)
+                       for j in order]
+            hits_out.append(row)
+            totals.append((seen_total, "gte") if pruned_any
+                          else seen_total)
+        self.n_dispatches += 1
+        from ..common import telemetry as _tm
+        q_bytes = blocks_scored * BS * 5 + blocks_total * 4
+        x_bytes = surv_total * 8 * max(
+            max((len(set(q)) for q in queries), default=1), 1)
+        _tm.record_lex(blocks_scored=blocks_scored,
+                       blocks_skipped=blocks_total - blocks_scored,
+                       quantized_bytes=q_bytes, exact_bytes=x_bytes)
+        if stages is not None:
+            stages["prep_ms"] = 0.0
+            stages["dispatch_ms"] = (time.perf_counter() - t0) * 1e3
+            stages["fetch_ms"] = 0.0
+            stages["compile_cache"] = "host"
+            stages["docs_scanned"] = scanned_docs // max(B, 1)
+            stages["lex_blocks_scored"] = blocks_scored
+            stages["lex_blocks_total"] = blocks_total
+            stages["lex_survivors"] = surv_total
+        if with_totals:
+            return vals_out, hits_out, totals
+        return vals_out, hits_out
+
+    #: pruned-step compile knob: survivor window = LEX_RERANK × k
+    #: (pow2-rounded); tests shrink it to force the unsafe→eager
+    #: fallback
+    prune_rerank = LEX_RERANK
+
+    #: host-scan stop factor: keep scanning until ρ < θ·prune_tighten —
+    #: values < 1 trade a few extra (cheap) blocks for a much smaller
+    #: phase-2 candidate set (the per-term remaining bounds shrink).
+    #: 0.7 measured best on the lexical_10m_prune bench shape
+    prune_tighten = 0.7
+
+    def search_pruned(self, queries: Sequence[Sequence[str]],
+                      k: int = 10, *, with_totals: bool = False,
+                      stages: Optional[dict] = None, extra_docs: int = 0,
+                      extra_df: Optional[Dict[str, int]] = None):
+        """Jitted block-max pruned dispatch
+        (:func:`build_pruned_bm25_step`): host assembles the batch's
+        descending-bound block schedule (pow2-bucketed length — the
+        compile-shape lattice's P axis), the device scan masks out steps
+        past each query's rank-safety threshold, survivors re-score
+        exactly, and any query whose safety verdict fails — or any batch
+        touching dense-tier terms, which the streaming-matmul tier
+        already serves — re-dispatches through the eager kernel. Exact
+        on every input by construction."""
+        if self.blockmax is None:
+            raise RuntimeError("plane has no block-max tier")
+        t0 = time.perf_counter()
+        tier = self.blockmax
+        BS = tier.block
+        B = len(queries)
+        n_repl = self.mesh.shape[AXIS_REPLICA]
+        B_pad = -(-B // n_repl) * n_repl
+        queries = list(queries) + [[] for _ in range(B_pad - B)]
+        needed_q = max(max((len(set(q)) for q in queries), default=1), 1)
+        Q = max(self.SERVING_Q_MIN, round_up_pow2(needed_q))
+        (starts, lengths, idfw, _rid, dense_hit, _ml,
+         any_dense) = self._lookup(queries, Q, extra_docs=extra_docs,
+                                   extra_df=extra_df)
+        if any_dense:
+            # Zipf-head terms live in the dense streaming-matmul tier —
+            # already the device's fast path for exactly those postings.
+            # Dispatch at the pre-warmed serving shapes (ladder L, Q
+            # floor): a raw pow2 L here would compile off-lattice
+            # mid-traffic
+            return self.search(queries[:B], k=k, tiered=True, Q=Q,
+                               L=self.ladder_L(
+                                   self.max_run_len(queries[:B])),
+                               with_totals=with_totals,
+                               stages=stages, extra_docs=extra_docs,
+                               extra_df=extra_df)
+        S = self.n_shards
+        NB = tier.n_blocks
+        P_need = 1
+        per_qs: List[List[tuple]] = []
+        for bi, terms in enumerate(queries):
+            idfw_of = self._query_idfw(terms, extra_docs, extra_df)
+            rows = []
+            for si, sh in enumerate(self.shards):
+                term_rows = [(int(sh["term_ids"][t]), w)
+                             for t, w in idfw_of.items()
+                             if t in sh["term_ids"]]
+                blk, wblk, rho, _tpos, slack = tier.schedule(
+                    si, term_rows)
+                rows.append((blk, wblk, rho, slack))
+                P_need = max(P_need, blk.shape[0])
+            per_qs.append(rows)
+        P_sched = round_up_pow2(P_need)
+        sched = np.full((B_pad, S, P_sched), NB, np.int32)
+        w_arr = np.zeros((B_pad, S, P_sched), np.float32)
+        rho_arr = np.zeros((B_pad, S, P_sched), np.float32)
+        slack_arr = np.zeros((B_pad, S), np.float32)
+        sched_lens = np.zeros((B_pad, S), np.int64)
+        for bi, rows in enumerate(per_qs):
+            for si, (blk, wblk, rho, slack) in enumerate(rows):
+                n = blk.shape[0]
+                sched[bi, si, :n] = blk
+                w_arr[bi, si, :n] = wblk
+                rho_arr[bi, si, :n] = rho
+                slack_arr[bi, si] = slack
+                sched_lens[bi, si] = n
+        kk = min(k, self.n_pad)
+        W = min(round_up_pow2(max(k * Q, 1)), LEX_THETA_WINDOW)
+        R = min(round_up_pow2(max(self.prune_rerank * kk, 64)),
+                self.n_pad)
+        step = self._get_pruned_step(Q, k, P_sched, W, R)
+        dev = tier.device_arrays(self.mesh)
+        repl = NamedSharding(self.mesh, P(AXIS_REPLICA, None))
+        repl2 = NamedSharding(self.mesh, P(AXIS_REPLICA, AXIS_SHARD))
+        repl3 = NamedSharding(self.mesh, P(AXIS_REPLICA, AXIS_SHARD, None))
+        t1 = time.perf_counter()
+        out = step(self.docs_dev, self.impacts_dev,
+                   dev["docs"], dev["codes"], dev["scale"], dev["off"],
+                   jax.device_put(sched, repl3),
+                   jax.device_put(w_arr, repl3),
+                   jax.device_put(rho_arr, repl3),
+                   jax.device_put(slack_arr, repl2),
+                   jax.device_put(starts, repl3),
+                   jax.device_put(lengths, repl3),
+                   jax.device_put(idfw, repl))
+        if stages is not None:
+            jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        self.n_dispatches += 1
+        from ..common import telemetry as _tm
+        compiled = _tm.last_call_compiled()
+        gvals = np.asarray(out[0])[:B]
+        gdocs = np.asarray(out[1])[:B]
+        matched = np.asarray(out[2])[:B]
+        unsafe = np.asarray(out[3])[:B] > 0
+        pruned = np.asarray(out[4])[:B] > 0
+        n_sc = np.asarray(out[5])[:B]
+        h2d = sched.nbytes + w_arr.nbytes + rho_arr.nbytes + \
+            slack_arr.nbytes + starts.nbytes + lengths.nbytes + idfw.nbytes
+        d2h = gvals.nbytes + gdocs.nbytes + matched.nbytes * 4
+        _tm.record_transfer(h2d_bytes=h2d, d2h_bytes=d2h)
+        vals_out = np.full((B, k), NEG_INF, np.float32)
+        wk = min(k, gvals.shape[1])
+        vals_out[:, :wk] = gvals[:, :wk]
+        hits_out: List[List[Tuple[int, int]]] = []
+        totals: List = []
+        for bi in range(B):
+            row = []
+            for v, g in zip(vals_out[bi], gdocs[bi]):
+                if v == NEG_INF:
+                    break
+                row.append((int(g) // self.n_pad, int(g) % self.n_pad))
+            hits_out.append(row)
+            totals.append((int(matched[bi]), "gte") if pruned[bi]
+                          else int(matched[bi]))
+        # rank-safety fallback: queries whose survivor window could not
+        # certify the top-k re-serve through the eager kernel (pruned
+        # results are bit-exact BY CONSTRUCTION, not by luck)
+        bad = np.flatnonzero(unsafe)
+        if bad.size:
+            bad_q = [queries[i] for i in bad]
+            ev = self.search(bad_q, k=k, Q=Q,
+                             L=self.ladder_L(self.max_run_len(bad_q)),
+                             tiered=self.T_pad > 0 or None,
+                             with_totals=True, extra_docs=extra_docs,
+                             extra_df=extra_df)
+            for j, i in enumerate(bad):
+                src = np.asarray(ev[0][j], np.float32)[:k]
+                vals_out[i] = NEG_INF
+                vals_out[i, :src.shape[0]] = src
+                hits_out[i] = list(ev[1][j])[:k]
+                totals[i] = int(ev[2][j])
+        blocks_scored = int(n_sc.sum())
+        blocks_total = int(sched_lens[:B].sum())
+        q_bytes = blocks_scored * BS * 5 + blocks_total * 4
+        x_bytes = B * R * Q * 8 * S
+        _tm.record_lex(blocks_scored=blocks_scored,
+                       blocks_skipped=blocks_total - blocks_scored,
+                       quantized_bytes=q_bytes, exact_bytes=x_bytes)
+        if stages is not None:
+            stages["prep_ms"] = (t1 - t0) * 1e3
+            stages["dispatch_ms"] = (t2 - t1) * 1e3
+            stages["fetch_ms"] = (time.perf_counter() - t2) * 1e3
+            stages["compile_cache"] = "miss" if compiled else "hit"
+            stages["h2d_bytes"] = h2d
+            stages["d2h_bytes"] = d2h
+            stages["docs_scanned"] = blocks_scored * BS // max(B, 1)
+            stages["lex_blocks_scored"] = blocks_scored
+            stages["lex_blocks_total"] = blocks_total
+        if with_totals:
+            return vals_out, hits_out, totals
+        return vals_out, hits_out
+
+    def _get_pruned_step(self, Q: int, k: int, P_sched: int, W: int,
+                         R: int):
+        key = ("bmx", Q, k, P_sched, W, R)
+        with self._steps_lock:
+            fn = self._steps.get(key)
+            if fn is None:
+                fn = build_pruned_bm25_step(
+                    self.mesh, n_pad=self.n_pad, Q=Q, k=k,
+                    P_sched=P_sched, W=W, R=R, BS=self.blockmax.block,
+                    NB=self.blockmax.n_blocks, n_shards=self.n_shards)
+                from ..common.telemetry import instrument_step
+                fn = instrument_step(fn, site="text_plane_pruned")
+                self._steps[key] = fn
+        return fn
 
     def _get_step(self, Q: int, L: int, k: int, *, tiered: bool = False,
                   with_count: bool = False, U: Optional[int] = None):
@@ -2063,10 +3109,10 @@ class EagerDeltaScorer:
                 if with_totals:
                     total += int(np.count_nonzero(scores > 0))
                 kk = min(k, csr["n_docs"])
-                top = np.argpartition(-scores, kk - 1)[:kk]
-                sel = top[scores[top] > 0]
-                order = np.lexsort((sel, -scores[sel]))
-                sel = sel[order]
+                # tie-stable bounded cut (see search_eager): the k-th-
+                # boundary tie must resolve doc-ascending for delta-merge
+                # parity
+                sel = tie_stable_topk_docs(scores, kk)
                 rows.extend((float(scores[d]), gseg, int(d)) for d in sel)
             rows.sort(key=lambda r: (-r[0], r[1], r[2]))
             rows_out.append(rows[:k])

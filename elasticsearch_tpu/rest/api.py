@@ -6349,7 +6349,7 @@ class RestAPI:
         "track_total_hits", "track_scores", "min_score", "post_filter",
         "knn", "pit", "profile", "indices_boost", "stats", "timeout",
         "terminate_after", "runtime_mappings", "slice", "rank", "ext",
-        "indices_options"}
+        "indices_options", "prune"}
 
     def _validate_search(self, search_body: dict, params: dict,
                          names: List[str], scroll: bool = False) -> None:
@@ -6396,6 +6396,12 @@ class RestAPI:
                 f"the scroll api for a more efficient way to request "
                 f"large data sets. This limit can be set by changing the "
                 f"[index.max_result_window] index level setting.")
+        # lexical block-max pruning knob (see shard_search.search):
+        # reject malformed values at the edge, like from/size above
+        pr = search_body.get("prune")
+        if pr is not None and not isinstance(pr, bool):
+            raise IllegalArgumentError(
+                f"[prune] must be a boolean, got [{pr}]")
         for kspec in _as_list(search_body.get("knn")):
             if not isinstance(kspec, dict):
                 continue

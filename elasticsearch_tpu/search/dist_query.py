@@ -309,6 +309,12 @@ class DistributedSearcher:
         if isinstance(track_total_hits, int) and not isinstance(
                 track_total_hits, bool):
             shard_body["track_total_hits"] = True   # cap at the coordinator
+            # the integer threshold means the caller accepts approximate
+            # totals — preserve that intent for the shards' block-max
+            # prune gating (the rewrite above would otherwise read as
+            # "exact totals required" and force every shard eager); the
+            # coordinator already merges per-shard "gte" relations
+            shard_body.setdefault("prune", True)
         # shards append the implicit trailing _doc tiebreak themselves
         # (ShardSearcher._field_sorted_page) and return n_user+1 values
         n_user_sort = len(clauses) if clauses else 0

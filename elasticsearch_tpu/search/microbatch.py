@@ -517,8 +517,12 @@ class PlaneMicroBatcher:
                   stages: Optional[dict] = None, view=None, params=None):
         """One device dispatch over the coalesced batch → (vals, hits,
         totals) aligned with ``queries``. Runs on a dispatcher thread,
-        never under the queue lock. ``params`` is unused on the text
-        plane (lexical dispatches have no kernel knobs)."""
+        never under the queue lock. ``params`` on the text plane is the
+        bucketed block-max ``("prune", bool)`` knob — co-batching
+        already split on it, so the whole batch shares one value."""
+        kw = {}
+        if params is not None and params[0] == "prune":
+            kw["prune"] = params[1]
         if view is not None:
             sv = getattr(self.plane, "serve_view", None)
             if sv is not None:
@@ -526,13 +530,14 @@ class PlaneMicroBatcher:
                 # the batch's segment view, so hit coordinates match the
                 # callers' snapshot even if a refresh landed meanwhile
                 return sv(queries, k=k, view=view, with_totals=True,
-                          stages=stages)
+                          stages=stages, **kw)
         serve = getattr(self.plane, "serve", None)
         if serve is not None:
             # the plane's serving entry picks the backend path (eager
             # CSR scorer on CPU, ladder-shaped jitted step on TPU) and
             # refines the stage timings
-            return serve(queries, k=k, with_totals=True, stages=stages)
+            return serve(queries, k=k, with_totals=True, stages=stages,
+                         **kw)
         # legacy/raw planes: size L through the ladder here
         L = None
         if hasattr(self.plane, "max_run_len"):
@@ -603,11 +608,22 @@ class KnnPlaneMicroBatcher(PlaneMicroBatcher):
 
 def batched_search(plane, terms: Sequence[str], k: int,
                    stages: Optional[dict] = None,
-                   info: Optional[dict] = None, view=None):
+                   info: Optional[dict] = None, view=None,
+                   prune: Optional[bool] = None):
     """Module entry: route one query through the plane's micro-batcher
     (created lazily on first use; plane rebuilds get a fresh one).
     ``view`` is the caller's segment-list snapshot — hit coordinates
-    come back in that list's space."""
+    come back in that list's space.
+
+    ``prune`` (block-max pruned scan, rank-safe): bucketed into the
+    compile-shape lattice via the slot's ``params`` — co-batching splits
+    on it, so a prune=off straggler never forces a whole batch eager.
+    On a plane without a block-max tier the knob is inert and every
+    request shares the knob-less dispatch; ``None`` resolves to the
+    tier default (pruned when the tier exists)."""
+    params = None
+    if getattr(plane, "blockmax", None) is not None:
+        params = ("prune", prune is not False)
     batcher = getattr(plane, "_microbatcher", None)
     if batcher is None:
         with _CREATE_LOCK:
@@ -615,7 +631,8 @@ def batched_search(plane, terms: Sequence[str], k: int,
             if batcher is None:
                 batcher = PlaneMicroBatcher(plane)
                 plane._microbatcher = batcher
-    return batcher.search(terms, k, stages=stages, info=info, view=view)
+    return batcher.search(terms, k, stages=stages, info=info, view=view,
+                          params=params)
 
 
 def batched_knn_search(plane, query_vector, k: int, view=None,

@@ -372,28 +372,36 @@ class TextServingGeneration(_ServingGeneration):
 
     def serve_view(self, queries, k: int = 10, *, view,
                    with_totals: bool = False,
-                   stages: Optional[dict] = None):
+                   stages: Optional[dict] = None,
+                   prune: Optional[bool] = None):
         """Micro-batcher dispatch hook: base dispatch (idf widened by the
         delta's df/doc mass) + eager delta scan + host top-k merge, with
-        the delta resolved for the batch's exact segment view."""
+        the delta resolved for the batch's exact segment view. The BASE
+        dispatch may be block-max pruned (``prune``); the delta tier
+        always scores eagerly — appended segments are small and
+        exactness there keeps the merge honest for fresh docs."""
         delta, base_pos = self._delta_for_view(view)
         return self._serve_merged(queries, k, delta, base_pos,
-                                  with_totals=with_totals, stages=stages)
+                                  with_totals=with_totals, stages=stages,
+                                  prune=prune)
 
     def serve(self, queries, k: int = 10, *, with_totals: bool = False,
-              stages: Optional[dict] = None):
+              stages: Optional[dict] = None,
+              prune: Optional[bool] = None):
         """Viewless entry (tests / direct callers): serve against the
         generation's CURRENT delta snapshot."""
         delta, base_pos = self._snapshot()
         return self._serve_merged(queries, k, delta, base_pos,
-                                  with_totals=with_totals, stages=stages)
+                                  with_totals=with_totals, stages=stages,
+                                  prune=prune)
 
     def _serve_merged(self, queries, k, delta, base_pos, *,
                       with_totals: bool = False,
-                      stages: Optional[dict] = None):
+                      stages: Optional[dict] = None,
+                      prune: Optional[bool] = None):
         if delta is None:
             return self.base.serve(queries, k=k, with_totals=with_totals,
-                                   stages=stages)
+                                   stages=stages, prune=prune)
         # one shared stat set: the delta's term dfs fold into the base
         # dispatch's idf weights, and the delta scores under the same
         # combined idf — parity with a full repack at the frozen avgdl
@@ -404,7 +412,7 @@ class TextServingGeneration(_ServingGeneration):
                     extra_df[t] = delta.df(t)
         vals, hits, totals = self.base.serve(
             queries, k=k, with_totals=True, stages=stages,
-            extra_docs=delta.n_docs, extra_df=extra_df)
+            extra_docs=delta.n_docs, extra_df=extra_df, prune=prune)
         t1 = time.perf_counter()
         from ..ops.bm25 import idf_weight
         n_total = self.base.n_docs_total + delta.n_docs
@@ -419,7 +427,9 @@ class TextServingGeneration(_ServingGeneration):
                 idf_cache[t] = v
             return v
 
-        from ..parallel.dist_search import merge_topk_rows
+        from ..parallel.dist_search import (merge_topk_rows,
+                                            total_is_lower_bound,
+                                            total_value)
         drows, dtotals = delta.score(queries, k, idf_of, with_totals=True)
         vals_out, hits_out, totals_out = [], [], []
         for bi in range(len(queries)):
@@ -428,7 +438,11 @@ class TextServingGeneration(_ServingGeneration):
             merged = merge_topk_rows(base_rows, drows[bi], k)
             vals_out.append(np.asarray([r[0] for r in merged], np.float32))
             hits_out.append([(r[1], r[2]) for r in merged])
-            totals_out.append(int(totals[bi] or 0) + int(dtotals[bi]))
+            # a pruned base dispatch reports (value, "gte") lower-bound
+            # totals — the delta's exact count adds on, relation sticks
+            tv = total_value(totals[bi]) + int(dtotals[bi])
+            totals_out.append((tv, "gte")
+                              if total_is_lower_bound(totals[bi]) else tv)
         delta_ms = (time.perf_counter() - t1) * 1e3
         if stages is not None:
             stages["dispatch_ms"] = stages.get("dispatch_ms", 0.0) \
@@ -562,6 +576,14 @@ class ServingPlaneCache:
     KNN_IVF_MIN_DOCS = int(os.environ.get(
         "ES_TPU_KNN_IVF_MIN_DOCS", str(1 << 16)))
 
+    #: corpus size above which a text base pack also builds the
+    #: block-max pruning tier (impact-ordered int8 blocks + bound
+    #: table — rank-safe WAND-as-a-scan serving via the ``prune``
+    #: knob). Below it eager scoring wins outright (the BM25S bet) and
+    #: the tier would only cost pack time and bytes.
+    LEX_PRUNE_MIN_DOCS = int(os.environ.get(
+        "ES_TPU_LEX_PRUNE_MIN_DOCS", str(1 << 17)))
+
     def __init__(self, mesh_factory=None, min_docs: int = _MIN_DOCS_DEFAULT):
         self._mesh_factory = mesh_factory
         self._mesh = None
@@ -582,6 +604,9 @@ class ServingPlaneCache:
         #: instance override of :attr:`KNN_IVF_MIN_DOCS` (tests force
         #: IVF on tiny corpora by lowering it)
         self.knn_ivf_min_docs = self.KNN_IVF_MIN_DOCS
+        #: instance override of :attr:`LEX_PRUNE_MIN_DOCS` (tests force
+        #: the block-max tier on tiny corpora by lowering it)
+        self.lex_prune_min_docs = self.LEX_PRUNE_MIN_DOCS
         #: delta-tier serving on/off (off = the old rebuild-every-refresh
         #: behavior; the live-indexing bench uses this as its baseline)
         self.delta_enabled = os.environ.get(
@@ -914,9 +939,20 @@ class ServingPlaneCache:
                     default=0)
         nbytes = round_up_multiple(max(t_est, 1), 16) * n_pad * 2 * \
             len(shards) if t_est else 0
+        # past the prune threshold the pack also builds the block-max
+        # tier (impact-ordered int8 blocks ≈ docs i32 + codes i8 +
+        # 12 B/block of bound metadata) and serves the rank-safe pruned
+        # scan by default; the delta tier stays eager
+        total_docs = sum(int(s["doc_len"].shape[0]) for s in shards)
+        n_postings = sum(int(np.asarray(s["docs"]).shape[0])
+                         for s in shards)
+        bmx_kw = None
+        if total_docs >= max(self.lex_prune_min_docs, 1):
+            bmx_kw = {}
+            nbytes += int(n_postings * 5.2) + 4096
         acct.add_estimate(nbytes, f"<serving plane [{field}]>")
         try:
-            plane = _P(self._get_mesh(), shards, field)
+            plane = _P(self._get_mesh(), shards, field, blockmax=bmx_kw)
         except Exception:
             acct.release(nbytes)
             raise

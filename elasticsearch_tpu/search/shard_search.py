@@ -373,6 +373,15 @@ class ShardSearcher:
         track_total_hits = body.get("track_total_hits", track_total_hits)
         query_spec = body.get("query")
         knn_spec = body.get("knn")
+        # block-max pruning knob (rank-safe WAND-as-a-scan on the plane
+        # route): absent → pruned only when totals are already
+        # approximate (Lucene disables WAND under exact total tracking);
+        # true → force pruned (totals become "gte" lower bounds under an
+        # early exit); false → force the eager scan
+        prune_opt = body.get("prune")
+        if prune_opt is not None and not isinstance(prune_opt, bool):
+            raise IllegalArgumentError(
+                f"[prune] must be a boolean, got [{prune_opt}]")
         query = parse_query(query_spec) if query_spec else MatchAllQuery()
         aggs_spec = body.get("aggs") or body.get("aggregations")
         aggs = parse_aggs(aggs_spec) if aggs_spec else None
@@ -446,6 +455,7 @@ class ShardSearcher:
         need_host_mask = use_field_sort
         serving_stages: Optional[Dict[str, float]] = None
         serving_info: Optional[Dict[str, object]] = None
+        plane_total_gte = False
         if plane_route is not None:
             plane, bag_terms = plane_route
             # concurrent eligible queries coalesce into one device dispatch
@@ -455,13 +465,30 @@ class ShardSearcher:
             from .microbatch import batched_search
             serving_stages = {}
             serving_info = {}
+            # prune resolution: an explicit body knob wins; the default
+            # prunes only when the request does not demand exact totals
+            # (track_total_hits true = Lucene's complete-collection
+            # mode, which disables WAND there too). An explicit
+            # prune=false on a tier-bearing plane is benched-default
+            # drift — counted for the plane_serving health indicator.
+            if prune_opt is None:
+                prune_eff = False if track_total_hits is True else None
+            else:
+                prune_eff = prune_opt
+            if prune_opt is False and \
+                    getattr(plane, "blockmax", None) is not None:
+                from ..common.telemetry import record_lex
+                record_lex(prune_off=True)
             # view=self.segments: hit coordinates must decode against
             # THIS searcher's snapshot even if a refresh mutates the
             # generation's delta while the request sits in the queue
             pvals0, phits0, ptotal0 = batched_search(
                 plane, bag_terms, k=max(window, 1), stages=serving_stages,
-                info=serving_info, view=self.segments)
-            total = int(ptotal0)
+                info=serving_info, view=self.segments, prune=prune_eff)
+            from ..parallel.dist_search import (total_is_lower_bound,
+                                                total_value)
+            plane_total_gte = total_is_lower_bound(ptotal0)
+            total = total_value(ptotal0)
             candidates = [(float(v), si, d)
                           for v, (si, d) in zip(pvals0, phits0)]
             # trace: the micro-batch dispatch as one leaf span under the
@@ -633,6 +660,11 @@ class ShardSearcher:
         elif isinstance(track_total_hits, int) and not isinstance(
                 track_total_hits, bool) and total > track_total_hits:
             total = track_total_hits
+            total_relation = "gte"
+        elif plane_total_gte:
+            # block-max pruned dispatch early-exited: the skipped
+            # blocks' docs were never counted — the total is an honest
+            # lower bound (Lucene's WAND total semantics)
             total_relation = "gte"
 
         # --- fetch phase ---------------------------------------------------

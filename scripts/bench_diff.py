@@ -64,8 +64,15 @@ def _metrics(doc: dict):
 #: correctness budget — 1% absolute is already a visible quality change)
 RECALL_DROP_MAX = 0.01
 
+#: relative p99 increase that fails the diff for p99-gated configs
+#: (configs carrying ``p99_gate: true`` — lexical_10m_prune opts in:
+#: its whole point is a latency profile, so throughput alone can't
+#: certify it)
+P99_RISE_MAX = 0.25
 
-def diff(old: dict, new: dict, threshold: float):
+
+def diff(old: dict, new: dict, threshold: float,
+         p99_threshold: float = P99_RISE_MAX):
     """Returns (report lines, regression names)."""
     lines = []
     regressions = []
@@ -108,6 +115,18 @@ def diff(old: dict, new: dict, threshold: float):
         if isinstance(o.get("p99_ms"), (int, float)) and \
                 isinstance(n.get("p99_ms"), (int, float)):
             p99 = f"  p99 {o['p99_ms']:.1f} -> {n['p99_ms']:.1f} ms"
+            # p99-latency gate: only configs that opted in on BOTH
+            # sides (p99_gate: true) — a throughput-only config's p99
+            # is too noisy to gate on
+            if o.get("p99_gate") and n.get("p99_gate") and \
+                    float(o["p99_ms"]) > 0:
+                rise = (float(n["p99_ms"]) - float(o["p99_ms"])) \
+                    / float(o["p99_ms"])
+                if rise > p99_threshold:
+                    flag = "  << P99 REGRESSION"
+                    regressions.append(
+                        f"{name} (p99 {o['p99_ms']:.1f} -> "
+                        f"{n['p99_ms']:.1f} ms, {rise:+.0%})")
         lines.append(f"  {name:40s} {ov:>10.1f} -> {nv:>10.1f} "
                      f"{n.get('unit', ''):12s} {delta:+7.1%}{rec}{p99}"
                      f"{flag}")
@@ -123,27 +142,34 @@ def main(argv=None) -> int:
     ap.add_argument("--threshold", type=float, default=0.10,
                     help="relative throughput drop that fails the diff "
                          "(default 0.10 = 10%%)")
+    ap.add_argument("--p99-threshold", type=float, default=P99_RISE_MAX,
+                    help="relative p99 rise that fails p99-gated configs "
+                         "(default 0.25 = 25%%)")
     args = ap.parse_args(argv)
     with open(args.old) as f:
         old = _unwrap(json.load(f))
     with open(args.new) as f:
         new = _unwrap(json.load(f))
     print(f"bench diff: {args.old} -> {args.new} "
-          f"(threshold {args.threshold:.0%})")
+          f"(threshold {args.threshold:.0%}, p99 "
+          f"{args.p99_threshold:.0%})")
     if old.get("backend") != new.get("backend"):
         print(f"  NOTE: backends differ ({old.get('backend')} -> "
               f"{new.get('backend')}) — deltas are not apples-to-apples")
-    lines, regressions = diff(old, new, args.threshold)
+    lines, regressions = diff(old, new, args.threshold,
+                              args.p99_threshold)
     for ln in lines:
         print(ln)
     if regressions:
         print(f"FAIL: {len(regressions)} regression(s) (throughput past "
-              f"{args.threshold:.0%} or recall_at_k past "
-              f"{RECALL_DROP_MAX}):")
+              f"{args.threshold:.0%}, recall_at_k past "
+              f"{RECALL_DROP_MAX}, or gated p99 past "
+              f"{args.p99_threshold:.0%}):")
         for r in regressions:
             print(f"  - {r}")
         return 1
-    print("OK: no throughput or recall regression past the thresholds")
+    print("OK: no throughput, recall, or gated-p99 regression past the "
+          "thresholds")
     return 0
 
 

@@ -76,10 +76,26 @@ def runtime_families() -> set:
         # delta tier + sync repack path (delta-serve + rebuild families)
         svc = api.indices.get("lint")
         svc.plane_cache.repack_mode = "sync"
+        # force the block-max tier onto the repacked generation so the
+        # es_lex_* families register: a pruned dispatch (track_total_hits
+        # bounded → prune defaults on) and an explicit prune=off (the
+        # drift counter the plane_serving health indicator reads)
+        svc.plane_cache.lex_prune_min_docs = 1
         api.handle("PUT", "/lint/_doc/2", "refresh=true", json.dumps(
             {"body": "quick red fox"}).encode())
         api.handle("POST", "/lint/_search", "", json.dumps(
             {"query": {"match": {"body": "quick"}}}).encode())
+        # second delta doc pushes past REPACK_DELTA_FRACTION: the sync
+        # repack folds the delta into a fresh base that now carries the
+        # block-max tier (lex_prune_min_docs=1 above)
+        api.handle("PUT", "/lint/_doc/3", "refresh=true", json.dumps(
+            {"body": "quick blue fox"}).encode())
+        api.handle("POST", "/lint/_search", "request_cache=false",
+                   json.dumps({"query": {"match": {"body": "quick"}},
+                               "track_total_hits": 10}).encode())
+        api.handle("POST", "/lint/_search", "request_cache=false",
+                   json.dumps({"query": {"match": {"body": "quick"}},
+                               "prune": False}).encode())
         # forced jitted dispatch so the XLA compile/transfer families
         # register even on the CPU test backend (host-eager otherwise)
         import numpy as np

@@ -1,0 +1,491 @@
+"""Block-max lexical pruning tiers: rank-safe WAND-as-a-scan for BM25
+(``parallel/dist_search.py`` BlockMaxTier / build_pruned_bm25_step /
+search_pruned_eager, the serving route's ``prune`` knob, telemetry and
+health satellites).
+
+Invariants under test:
+- PROPERTY: pruned results are BIT-IDENTICAL to the eager scan — values,
+  hits, and the (score desc, doc asc) tie order — across random Zipf
+  corpora, multi-shard planes, adversarial near-tie impacts that
+  collapse under int8 quantization, single-term and stopword-heavy
+  queries (quantized partials only choose the candidate window; the
+  exact re-score from the f32 CSR decides the ranking);
+- the jitted device step agrees with the eager jitted kernel, and its
+  safety verdict routes window-overflow queries through the eager
+  fallback (rank-safe by construction, not by luck);
+- totals under an early exit are honest ``(value, "gte")`` lower bounds
+  (Lucene's WAND total semantics) and exact when the scan completed;
+- delta-merge parity at prune-on and repacks folding delta docs into a
+  fresh impact-ordered layout;
+- REST edge validation of the ``prune`` knob, the micro-batcher
+  bucketing it into the compile-shape lattice, the es_lex_* telemetry
+  families, the plane_serving pruning-drift health signal, and the
+  bench_diff p99 gate.
+"""
+
+import json
+import tempfile
+
+import numpy as np
+import pytest
+import jax
+
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.parallel import make_search_mesh
+from elasticsearch_tpu.parallel.dist_search import (
+    BlockMaxTier, DistributedSearchPlane, total_is_lower_bound,
+    total_value)
+from elasticsearch_tpu.search.plane_route import ServingPlaneCache
+from elasticsearch_tpu.utils.synth import (split_csr_shards,
+                                           synthetic_csr_corpus_fast)
+
+MAPPING = {"properties": {"body": {"type": "text"}}}
+
+
+def _mesh():
+    return make_search_mesh(n_shards=1, n_replicas=1,
+                            devices=jax.devices()[:1])
+
+
+def _zipf_plane(seed=0, n_docs=4096, vocab=512, avgdl=12, n_shards=1,
+                **kw):
+    rng = np.random.RandomState(seed)
+    corpus = synthetic_csr_corpus_fast(rng, n_docs, vocab, avgdl,
+                                       zipf_s=1.2)
+    corpus["term_ids"] = {f"t{t}": t for t in range(vocab)}
+    shards = split_csr_shards(corpus, n_shards) if n_shards > 1 \
+        else [corpus]
+    for s in shards:
+        s["term_ids"] = corpus["term_ids"]
+    plane = DistributedSearchPlane(_mesh(), shards, field="body",
+                                   blockmax={}, **kw)
+    return rng, corpus, plane
+
+
+def _freq_queries(rng, corpus, n, terms=4):
+    df = corpus["df"].astype(np.float64)
+    elig = np.flatnonzero(df >= 2)
+    p = df[elig] / df[elig].sum()
+    return [[f"t{t}" for t in rng.choice(elig, terms, p=p)]
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# rank-safety property: pruned == eager bit-for-bit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", (0, 1, 2))
+def test_pruned_equals_eager_property(seed):
+    rng, corpus, plane = _zipf_plane(seed=seed)
+    qs = _freq_queries(rng, corpus, 24)
+    # single-term, duplicate-weighted, stopword-heavy (max-df term),
+    # absent-term, and empty queries ride along
+    top = int(np.argmax(corpus["df"]))
+    qs += [["t3"], ["t0", "t0", "t1"], [f"t{top}"] * 6,
+           ["missing-term"], []]
+    ev, eh, et = plane.search_eager(qs, k=10, with_totals=True)
+    pv, ph, pt = plane.search_pruned_eager(qs, k=10, with_totals=True)
+    assert np.array_equal(ev, pv)
+    assert eh == ph
+    for e, p in zip(et, pt):
+        assert total_value(p) == e or (total_is_lower_bound(p)
+                                       and total_value(p) <= e)
+
+
+def test_pruned_equals_eager_multi_shard():
+    rng, corpus, plane = _zipf_plane(seed=9, n_docs=8192, n_shards=2)
+    qs = _freq_queries(rng, corpus, 16)
+    ev, eh = plane.search_eager(qs, k=7)
+    pv, ph = plane.search_pruned_eager(qs, k=7)
+    assert np.array_equal(ev, pv)
+    assert eh == ph
+
+
+def test_adversarial_near_ties_survive_quantization():
+    """Impacts that differ by far less than one int8 step: the quantized
+    scan cannot order them — only the exact re-score can, and the k-th
+    boundary tie must break doc-ascending."""
+    rng = np.random.RandomState(3)
+    n_docs, V, run = 4096, 64, 512
+    docs, tf, offsets = [], [], [0]
+    for t in range(V):
+        d = np.sort(rng.choice(n_docs, run, replace=False))
+        docs.append(d)
+        # tf constant except tiny perturbations: impacts collapse to the
+        # same int8 code but differ in f32
+        f = np.ones(run, np.float32)
+        f[::7] += 1e-4
+        tf.append(f)
+        offsets.append(offsets[-1] + run)
+    corpus = dict(offsets=np.asarray(offsets, np.int64),
+                  docs=np.concatenate(docs).astype(np.int32),
+                  tf=np.concatenate(tf),
+                  doc_len=np.full(n_docs, 16.0, np.float32),
+                  df=np.full(V, run, np.int32),
+                  term_ids={f"t{t}": t for t in range(V)})
+    plane = DistributedSearchPlane(_mesh(), [corpus], field="body",
+                                   blockmax={})
+    qs = [[f"t{t}" for t in rng.choice(V, 4, replace=False)]
+          for _ in range(12)] + [["t0"]]
+    ev, eh = plane.search_eager(qs, k=10)
+    pv, ph = plane.search_pruned_eager(qs, k=10)
+    assert np.array_equal(ev, pv)
+    assert eh == ph
+
+
+def test_totals_exact_without_early_exit_gte_with():
+    # tiny corpus: the schedule completes → totals exact ints
+    rng, corpus, plane = _zipf_plane(seed=4, n_docs=1024, vocab=128)
+    qs = _freq_queries(rng, corpus, 8)
+    _, _, et = plane.search_eager(qs, k=10, with_totals=True)
+    _, _, pt = plane.search_pruned_eager(qs, k=10, with_totals=True)
+    for e, p in zip(et, pt):
+        if not total_is_lower_bound(p):
+            assert total_value(p) == e
+    # larger Zipf corpus at k=1: early exit engages for some query →
+    # a gte lower bound no larger than the true total
+    rng2, corpus2, plane2 = _zipf_plane(seed=5, n_docs=1 << 15,
+                                        vocab=1 << 12, avgdl=16)
+    qs2 = _freq_queries(rng2, corpus2, 16)
+    st: dict = {}
+    _, _, et2 = plane2.search_eager(qs2, k=1, with_totals=True)
+    _, _, pt2 = plane2.search_pruned_eager(qs2, k=1, with_totals=True,
+                                           stages=st)
+    assert st["lex_blocks_scored"] < st["lex_blocks_total"], \
+        "no blocks were skipped on a 32k-doc Zipf corpus"
+    assert any(total_is_lower_bound(p) for p in pt2)
+    for e, p in zip(et2, pt2):
+        assert total_value(p) <= e
+
+
+def test_serve_routes_prune_knob():
+    rng, corpus, plane = _zipf_plane(seed=6)
+    qs = _freq_queries(rng, corpus, 4)
+    ev, eh, et = plane.serve(qs, k=5, with_totals=True, prune=False)
+    pv, ph, pt = plane.serve(qs, k=5, with_totals=True)  # default: on
+    assert np.array_equal(ev, pv) and eh == ph
+    # eager path returns plain ints always
+    assert all(not total_is_lower_bound(t) for t in et)
+
+
+# ---------------------------------------------------------------------------
+# tier layout + quantization
+# ---------------------------------------------------------------------------
+
+
+def test_tier_impact_ordered_layout_and_bytes():
+    _rng, corpus, plane = _zipf_plane(seed=7, n_docs=2048, vocab=128)
+    tier = plane.blockmax
+    sh = tier.shards[0]
+    offs = sh["blk_offsets"]
+    V = offs.shape[0] - 1
+    for t in range(V):
+        b0, b1 = int(offs[t]), int(offs[t + 1])
+        if b1 > b0:
+            # bounds descend within a term (impact-ordered blocks)
+            b = sh["bound"][b0:b1]
+            assert np.all(np.diff(b) <= 1e-9)
+    # dequantization error bounded by half a step everywhere
+    real = sh["docs"] < tier.n_pad
+    recon = sh["scale"][:, None] * sh["codes"].astype(np.float32) \
+        + sh["off"][:, None]
+    # reconstruct the original impacts via the schedule inverse: just
+    # check the bound slot (slot 0 = block max) reconstructs tightly
+    err = np.abs(recon[:, 0] - sh["bound"])
+    assert np.all(err <= sh["scale"] * 0.5 + 1e-6)
+    assert real[:, 0].all()
+    # the acceptance byte claim: int8 impacts cut the resident impact
+    # payload >= 2x vs the f32 column
+    assert tier.impact_bytes_f32() >= 2 * tier.impact_bytes_int8()
+
+
+# ---------------------------------------------------------------------------
+# device step: parity + safety fallback
+# ---------------------------------------------------------------------------
+
+
+def test_device_step_matches_eager_jitted():
+    rng, corpus, plane = _zipf_plane(seed=8, n_docs=2048, vocab=256,
+                                     avgdl=10, dense_threshold=1 << 30)
+    plane._host_csr = None                 # force the jitted paths
+    qs = _freq_queries(rng, corpus, 8) + [["t3"], []]
+    ev, eh, et = plane.search(qs, k=10, with_totals=True)
+    pv, ph, pt = plane.search_pruned(qs, k=10, with_totals=True)
+    assert np.array_equal(ev, pv)
+    assert eh == ph
+    for e, p in zip(et, pt):
+        assert total_value(p) == e or (total_is_lower_bound(p)
+                                       and total_value(p) <= e)
+
+
+def test_device_unsafe_fallback_stays_exact(monkeypatch):
+    """A survivor window too small to certify the top-k must re-serve
+    through the eager kernel — results stay exact either way."""
+    rng, corpus, plane = _zipf_plane(seed=10, n_docs=2048, vocab=64,
+                                     avgdl=24, dense_threshold=1 << 30)
+    plane._host_csr = None
+    plane.prune_rerank = 1                 # R floors at 64 — overflows
+    calls = {"eager": 0}
+    real = plane.search
+
+    def counting_search(*a, **kw):
+        calls["eager"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(plane, "search", counting_search)
+    top = int(np.argmax(corpus["df"]))
+    qs = [[f"t{top}", f"t{(top + 1) % 64}"] for _ in range(4)]
+    ev, eh = real(qs, k=10)
+    pv, ph = plane.search_pruned(qs, k=10)
+    assert np.array_equal(np.asarray(ev), np.asarray(pv))
+    assert eh == ph
+    assert calls["eager"] >= 1, "expected the safety fallback to fire"
+
+
+def test_device_dense_tier_batches_fall_back_to_tiered():
+    """Queries touching dense-tier terms serve through the streaming
+    matmul kernel (the device's head-term fast path) — still exact."""
+    rng, corpus, plane = _zipf_plane(seed=11, n_docs=4096, vocab=256,
+                                     avgdl=16, dense_threshold=64)
+    if not plane.T_pad:
+        pytest.skip("corpus produced no dense tier at threshold 64")
+    plane._host_csr = None
+    top = int(np.argmax(corpus["df"]))
+    qs = [[f"t{top}", "t3"], ["t5"]]
+    ev, eh = plane.search(qs, k=10, tiered=True)
+    pv, ph = plane.search_pruned(qs, k=10)
+    assert np.array_equal(ev, pv)
+    assert eh == ph
+
+
+# ---------------------------------------------------------------------------
+# serving generations: delta-merge parity + repack layout fold
+# ---------------------------------------------------------------------------
+
+
+def _mk_segments(svc, n_segs, per, seed=7, start=0, prefix="s"):
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    words = ["quick", "brown", "fox", "dog", "lazy", "jump", "search",
+             "engine", "rank", "doc", "the", "of"]
+    rng = np.random.RandomState(seed)
+    segs = []
+    doc = start
+    for si in range(n_segs):
+        b = SegmentBuilder(f"{prefix}{si}")
+        for _ in range(per):
+            toks = [words[min(rng.zipf(1.5) - 1, len(words) - 1)]
+                    for _ in range(5)]
+            b.add(svc.parse_document(str(doc),
+                                     {"body": " ".join(toks)}),
+                  seq_no=doc)
+            doc += 1
+        segs.append(b.build())
+    return segs
+
+
+def test_delta_merge_parity_at_prune_on():
+    svc = MapperService(MAPPING)
+    base = _mk_segments(svc, 2, 30)
+    cache = ServingPlaneCache()
+    cache.lex_prune_min_docs = 1
+    cache.REPACK_DELTA_FRACTION = 10.0     # keep the delta live
+    gen = cache.plane_for(base, svc, "body")
+    assert gen is not None and gen.base.blockmax is not None
+    segs = base + _mk_segments(svc, 1, 5, seed=42, start=500, prefix="d")
+    gen = cache.plane_for(segs, svc, "body")
+    assert gen.delta is not None
+    qs = [["quick", "dog"], ["the", "search", "engine"], ["fox"]]
+    ev, eh, et = gen.serve(qs, k=10, with_totals=True, prune=False)
+    pv, ph, pt = gen.serve(qs, k=10, with_totals=True, prune=True)
+    assert all(np.array_equal(a, b) for a, b in zip(ev, pv))
+    assert eh == ph
+    assert [total_value(a) for a in et] == [total_value(b) for b in pt]
+
+
+def test_repack_folds_delta_into_fresh_impact_ordered_layout():
+    svc = MapperService(MAPPING)
+    base = _mk_segments(svc, 2, 20)
+    cache = ServingPlaneCache()
+    cache.lex_prune_min_docs = 1
+    cache.repack_mode = "sync"
+    gen0 = cache.plane_for(base, svc, "body")
+    assert gen0.base.blockmax is not None
+    n0 = gen0.base.n_docs_total
+    # a delta past the threshold triggers the sync repack: the swapped-in
+    # generation's base re-packed the impact-ordered tier over base+delta
+    segs = base + _mk_segments(svc, 1, 20, seed=42, start=500, prefix="d")
+    gen1 = cache.plane_for(segs, svc, "body")
+    assert gen1 is not gen0
+    assert gen1.base.blockmax is not None
+    assert gen1.base.n_docs_total == n0 + 20
+    assert gen1.delta is None
+    # fresh layout still serves rank-safe
+    qs = [["quick", "dog"], ["fox", "the"]]
+    ev, eh = gen1.serve(qs, k=10, prune=False)
+    pv, ph = gen1.serve(qs, k=10, prune=True)
+    assert all(np.array_equal(a, b) for a, b in zip(ev, pv))
+    assert eh == ph
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher: prune bucketed into the compile-shape lattice
+# ---------------------------------------------------------------------------
+
+
+def test_microbatcher_splits_prune_params():
+    from elasticsearch_tpu.search.microbatch import (PlaneMicroBatcher,
+                                                     _Slot)
+    b = PlaneMicroBatcher.__new__(PlaneMicroBatcher)
+    on = _Slot(["a"], 10, params=("prune", True))
+    off = _Slot(["a"], 10, params=("prune", False))
+    assert b._bucket_key(on) != b._bucket_key(off)
+    assert b._bucket_key(on) == b._bucket_key(
+        _Slot(["b"], 9, params=("prune", True)))
+
+
+def test_batched_search_resolves_params():
+    from elasticsearch_tpu.search import microbatch as mb
+    rng, corpus, plane = _zipf_plane(seed=12, n_docs=1024, vocab=128)
+    qs = _freq_queries(rng, corpus, 1)[0]
+    vals, hits, total = mb.batched_search(plane, qs, 5, prune=True)
+    assert len(hits) <= 5
+    vals2, hits2, total2 = mb.batched_search(plane, qs, 5, prune=False)
+    assert hits == hits2
+    assert total_value(total) <= total_value(total2) \
+        or total_value(total) == total_value(total2)
+
+
+# ---------------------------------------------------------------------------
+# REST edge + telemetry + health
+# ---------------------------------------------------------------------------
+
+
+def _rest_index(n_docs=600):
+    from elasticsearch_tpu.node.indices_service import IndicesService
+    from elasticsearch_tpu.rest.api import RestAPI
+    api = RestAPI(IndicesService(tempfile.mkdtemp(prefix="lexprune_")))
+    lines = []
+    for i in range(n_docs):
+        lines.append(json.dumps({"index": {"_id": str(i)}}))
+        lines.append(json.dumps(
+            {"body": f"w{i % 16} w{(i * 3) % 16} w{(i * 7) % 16}"}))
+    api.handle("POST", "/lex/_bulk", "refresh=true",
+               ("\n".join(lines) + "\n").encode())
+    svc = api.indices.get("lex")
+    svc.plane_cache.lex_prune_min_docs = 1
+    return api, svc
+
+
+def test_rest_prune_knob_validation_and_parity():
+    api, svc = _rest_index()
+    st, _, p = api.handle(
+        "POST", "/lex/_search", "request_cache=false",
+        json.dumps({"query": {"match": {"body": "w3 w5"}}}).encode())
+    assert st == 200
+    base = json.loads(p)
+    gen = svc.plane_cache._planes.get("body")
+    assert gen is not None and gen.base.blockmax is not None
+    # explicit prune=true: identical hits and scores (rank-safe)
+    st2, _, p2 = api.handle(
+        "POST", "/lex/_search", "request_cache=false",
+        json.dumps({"query": {"match": {"body": "w3 w5"}},
+                    "prune": True}).encode())
+    assert st2 == 200
+    d2 = json.loads(p2)
+    assert [h["_id"] for h in d2["hits"]["hits"]] == \
+        [h["_id"] for h in base["hits"]["hits"]]
+    assert [h["_score"] for h in d2["hits"]["hits"]] == \
+        [h["_score"] for h in base["hits"]["hits"]]
+    # totals relation stays honest
+    assert d2["hits"]["total"]["relation"] in ("eq", "gte")
+    if d2["hits"]["total"]["relation"] == "eq":
+        assert d2["hits"]["total"]["value"] == \
+            base["hits"]["total"]["value"]
+    # bounded track_total_hits prunes by default and keeps hit parity
+    st3, _, p3 = api.handle(
+        "POST", "/lex/_search", "request_cache=false",
+        json.dumps({"query": {"match": {"body": "w3 w5"}},
+                    "track_total_hits": 50}).encode())
+    assert st3 == 200
+    d3 = json.loads(p3)
+    assert [h["_id"] for h in d3["hits"]["hits"]] == \
+        [h["_id"] for h in base["hits"]["hits"]]
+    # malformed knob → 400 at the edge
+    st4, _, _p4 = api.handle(
+        "POST", "/lex/_search", "",
+        json.dumps({"query": {"match": {"body": "w3"}},
+                    "prune": "yes"}).encode())
+    assert st4 == 400
+
+
+def test_lex_telemetry_families_and_health_drift():
+    from elasticsearch_tpu.common import telemetry as tm
+    api, svc = _rest_index()
+    api.handle("POST", "/lex/_search", "request_cache=false",
+               json.dumps({"query": {"match": {"body": "w3"}},
+                           "track_total_hits": 10}).encode())
+    snap = tm.DEFAULT.stats_doc()
+    for fam in ("es_lex_blocks_scored_total",
+                "es_lex_blocks_skipped_total",
+                "es_lex_bytes_read_total"):
+        assert fam in snap, fam
+    # consume any pending drift window, then force prune=off → yellow
+    api.handle("GET", "/_health_report/plane_serving", "", b"")
+    drift0 = tm.lex_prune_off_count()
+    api.handle("POST", "/lex/_search", "request_cache=false",
+               json.dumps({"query": {"match": {"body": "w3"}},
+                           "prune": False}).encode())
+    assert tm.lex_prune_off_count() == drift0 + 1
+    st, _, p = api.handle("GET", "/_health_report/plane_serving", "", b"")
+    doc = json.loads(p)["indicators"]["plane_serving"]
+    assert doc["status"] == "yellow"
+    assert any(d["id"] == "plane_serving:lex_prune_off"
+               for d in doc.get("diagnosis", []))
+    # the window is consumed: next evaluation reports green again
+    st, _, p = api.handle("GET", "/_health_report/plane_serving", "", b"")
+    assert json.loads(p)["indicators"]["plane_serving"]["status"] != \
+        "yellow"
+
+
+def test_below_threshold_planes_stay_eager():
+    svc = MapperService(MAPPING)
+    segs = _mk_segments(svc, 1, 10)
+    cache = ServingPlaneCache()           # default threshold = 131072
+    gen = cache.plane_for(segs, svc, "body")
+    assert gen is not None and gen.base.blockmax is None
+
+
+# ---------------------------------------------------------------------------
+# bench_diff p99 gate
+# ---------------------------------------------------------------------------
+
+
+def test_bench_diff_p99_gate():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(os.path.dirname(__file__), "..",
+                                   "scripts", "bench_diff.py"))
+    bd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bd)
+    old = {"configs": {"lexical_10m_prune": {
+        "value": 100.0, "unit": "queries/s", "p99_ms": 100.0,
+        "p99_gate": True}}}
+    ok_new = {"configs": {"lexical_10m_prune": {
+        "value": 101.0, "unit": "queries/s", "p99_ms": 110.0,
+        "p99_gate": True}}}
+    bad_new = {"configs": {"lexical_10m_prune": {
+        "value": 101.0, "unit": "queries/s", "p99_ms": 140.0,
+        "p99_gate": True}}}
+    _lines, regs = bd.diff(old, ok_new, 0.10)
+    assert not regs
+    _lines, regs = bd.diff(old, bad_new, 0.10)
+    assert regs and "p99" in regs[0]
+    # ungated configs never p99-fail
+    ungated_old = {"configs": {"knn": {
+        "value": 100.0, "unit": "queries/s", "p99_ms": 100.0}}}
+    ungated_new = {"configs": {"knn": {
+        "value": 100.0, "unit": "queries/s", "p99_ms": 400.0}}}
+    _lines, regs = bd.diff(ungated_old, ungated_new, 0.10)
+    assert not regs
