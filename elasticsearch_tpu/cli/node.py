@@ -17,6 +17,14 @@ import asyncio
 import os
 import signal
 
+# Opt-in runtime lockdep witness (ES_TPU_LOCKDEP=1): install BEFORE the
+# node stack imports create their module/instance locks, so a live node
+# serves with observed lock-order checking and exports the es_lockdep_*
+# evidence families (see STATIC_ANALYSIS.md). Inert otherwise.
+from ..common import lockdep as _lockdep
+
+_lockdep.install()
+
 
 def _wrap_handler(handle, owner=None):
     """Adapt a REST ``handle`` to the HttpServer's 4-tuple form: collect

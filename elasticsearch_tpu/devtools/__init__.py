@@ -1,0 +1,31 @@
+"""estpulint — project-wide static analysis for jit-boundary hygiene,
+lock-order safety, and telemetry-catalogue discipline.
+
+The engine is a heavily threaded serving system layered over jitted JAX
+hot paths, and its two recurring failure modes — accidental host
+synchronization inside the dispatch path, and compile churn from
+unbucketed static shapes — were until now caught only after the fact by
+the compile-ratchet and stage timings. This package machine-checks those
+invariants before merge (the way Anserini ships rank-regression gates
+instead of hoping reviewers notice), plus the lock discipline the
+dispatcher/repack/ledger threads depend on.
+
+Three rule families (see STATIC_ANALYSIS.md for the full catalogue):
+
+- ``rules_jit`` (ESTP-J*) — host-sync constructs reachable from device
+  hot paths, impure host calls inside jit-compiled code, mutable default
+  captures, and unbucketed static-shape arguments at step call sites.
+- ``rules_locks`` (ESTP-L*) — the package-wide lock-acquisition graph
+  must be cycle-free, and telemetry/tracing must never execute under a
+  serving lock. Cross-checked at runtime by the opt-in lockdep witness
+  (``common/lockdep.py``, ``ES_TPU_LOCKDEP=1``).
+- ``rules_catalogue`` (ESTP-C*) — registry families, TELEMETRY.md rows,
+  and health-indicator diagnoses stay three-way consistent (the
+  generalization of the old ``scripts/telemetry_lint.py``).
+
+Entry point: ``scripts/estpulint.py`` (CLI with ``--diff`` and a
+checked-in zero-new-findings baseline, ``ESTPULINT_BASELINE.json``);
+the full-package scan rides tier-1 via ``tests/test_static_analysis.py``.
+"""
+
+from .analyzer import Finding, Project, scan_project  # noqa: F401

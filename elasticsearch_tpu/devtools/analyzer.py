@@ -1,0 +1,537 @@
+"""estpulint core: project model, call graph, findings, baseline.
+
+Everything here is plain ``ast`` — no imports of the analyzed modules
+(the jit rules must be able to judge a file that would crash on import),
+no third-party dependencies. The model is deliberately *resolution
+conservative*: a call edge exists only when the callee can be named with
+reasonable confidence (same-scope functions, ``self.``/``cls.`` methods
+through the project MRO, imported names, or a project-unique private
+method name whose defining module the caller imports). Unresolvable
+calls simply contribute no edges — rules built on the graph
+under-approximate rather than hallucinate.
+
+Finding identity is (rule, file, symbol, detail) — line numbers are
+reported but excluded from identity so the checked-in baseline
+(``ESTPULINT_BASELINE.json``) survives unrelated edits to the same file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: package source roots scanned by default (repo-relative)
+DEFAULT_SCAN_DIRS = ("elasticsearch_tpu",)
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:   # noqa: BLE001 — display-only fallback
+        return f"<{type(node).__name__}>"
+
+
+def scoped_walk(node: ast.AST):
+    """``ast.walk`` confined to one function's own execution scope:
+    nested function/class bodies and lambda bodies are NOT descended
+    into (they are separate FunctionInfos / deferred execution), while
+    comprehensions — which execute inline — are."""
+    todo = list(ast.iter_child_nodes(node))
+    while todo:
+        cur = todo.pop()
+        yield cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef, ast.Lambda)):
+            continue
+        todo.extend(ast.iter_child_nodes(cur))
+
+
+# ---------------------------------------------------------------------------
+# Findings + baseline
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation. ``detail`` is the stable machine-readable core
+    (baseline identity); ``message`` is the human rendering."""
+
+    rule: str
+    file: str
+    line: int
+    symbol: str
+    detail: str
+    message: str
+
+    @property
+    def identity(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.file, self.symbol, self.detail)
+
+    def doc(self) -> dict:
+        return {"rule": self.rule, "file": self.file, "symbol": self.symbol,
+                "detail": self.detail}
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}: [{self.rule}] {self.symbol}: "
+                f"{self.message}")
+
+
+def load_baseline(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        doc = json.load(f)
+    return list(doc.get("findings", ()))
+
+
+def save_baseline(path: str, findings: Sequence[Finding],
+                  justifications: Optional[Dict[Tuple, str]] = None) -> None:
+    docs = []
+    for f in sorted(findings, key=lambda x: (x.file, x.rule, x.symbol,
+                                             x.detail)):
+        d = f.doc()
+        just = (justifications or {}).get(f.identity)
+        d["justification"] = just or "TODO: justify or fix"
+        docs.append(d)
+    with open(path, "w") as fh:
+        json.dump({"comment": "estpulint zero-new-findings baseline: every "
+                              "entry is an intentionally-kept finding with "
+                              "a one-line justification. Regenerate with "
+                              "scripts/estpulint.py --update-baseline.",
+                   "findings": docs}, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+
+
+def compare_with_baseline(findings: Sequence[Finding],
+                          baseline: Sequence[dict]):
+    """→ (new_findings, matched_findings, stale_baseline_entries)."""
+    base_keys = {(d.get("rule"), d.get("file"), d.get("symbol", ""),
+                  d.get("detail", "")) for d in baseline}
+    new = [f for f in findings if f.identity not in base_keys]
+    matched = [f for f in findings if f.identity in base_keys]
+    live = {f.identity for f in findings}
+    stale = [d for d in baseline
+             if (d.get("rule"), d.get("file"), d.get("symbol", ""),
+                 d.get("detail", "")) not in live]
+    return new, matched, stale
+
+
+# ---------------------------------------------------------------------------
+# Project model
+# ---------------------------------------------------------------------------
+
+
+class ModuleInfo:
+    __slots__ = ("relpath", "dotted", "tree", "source",
+                 "imports", "imported_modules")
+
+    def __init__(self, relpath: str, dotted: str, tree: ast.Module,
+                 source: str):
+        self.relpath = relpath
+        self.dotted = dotted
+        self.tree = tree
+        self.source = source
+        #: local name -> fully dotted target ("pkg.mod" or "pkg.mod.attr")
+        self.imports: Dict[str, str] = {}
+        #: dotted module names this module imports anything from
+        self.imported_modules: Set[str] = set()
+
+
+class CallSite:
+    __slots__ = ("node", "line", "text")
+
+    def __init__(self, node: ast.Call):
+        self.node = node
+        self.line = node.lineno
+        self.text = _unparse(node.func)
+
+
+class FunctionInfo:
+    __slots__ = ("fqn", "qual", "name", "node", "module", "class_fqn",
+                 "jitted", "static_argnames", "returns_jitted", "calls")
+
+    def __init__(self, fqn: str, qual: str, node, module: ModuleInfo,
+                 class_fqn: Optional[str]):
+        self.fqn = fqn
+        self.qual = qual
+        self.name = node.name
+        self.node = node
+        self.module = module
+        self.class_fqn = class_fqn
+        self.jitted = False
+        self.static_argnames: Tuple[str, ...] = ()
+        self.returns_jitted = False
+        self.calls: List[CallSite] = []
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+class ClassInfo:
+    __slots__ = ("fqn", "name", "node", "module", "bases", "methods")
+
+    def __init__(self, fqn: str, node: ast.ClassDef, module: ModuleInfo):
+        self.fqn = fqn
+        self.name = node.name
+        self.node = node
+        self.module = module
+        self.bases: List[str] = [_unparse(b) for b in node.bases]
+        #: method name -> function fqn
+        self.methods: Dict[str, str] = {}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` (any attribute path ending in .jit)."""
+    return (isinstance(node, ast.Name) and node.id == "jit") or \
+        (isinstance(node, ast.Attribute) and node.attr == "jit")
+
+
+def _static_argnames_of(call: ast.Call) -> Tuple[str, ...]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant))
+    return ()
+
+
+class _FunctionCollector(ast.NodeVisitor):
+    """Collect functions/classes with qualified names; attach each Call
+    to its *immediately* enclosing function (nested defs own their
+    bodies; lambda bodies attach to the enclosing function)."""
+
+    def __init__(self, project: "Project", module: ModuleInfo):
+        self.project = project
+        self.module = module
+        self.qual_stack: List[str] = []
+        self.class_stack: List[ClassInfo] = []
+        self.fn_stack: List[FunctionInfo] = []
+
+    # -- scoping -------------------------------------------------------------
+
+    def _enter_function(self, node):
+        qual = ".".join(self.qual_stack + [node.name])
+        fqn = f"{self.module.dotted}:{qual}"
+        cls = self.class_stack[-1] if self.class_stack else None
+        # a method belongs to the class only when the class is the direct
+        # parent scope (not a function nested inside a method)
+        direct_method = bool(cls) and \
+            ".".join(self.qual_stack) == cls.fqn.split(":", 1)[1]
+        fn = FunctionInfo(fqn, qual, node, self.module,
+                          cls.fqn if direct_method else None)
+        self.project.functions[fqn] = fn
+        if direct_method:
+            cls.methods[node.name] = fqn
+        self._mark_decorators(fn)
+        self.qual_stack.append(node.name)
+        self.fn_stack.append(fn)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+        self.qual_stack.pop()
+
+    def visit_FunctionDef(self, node):     # noqa: N802 — ast API
+        self._enter_function(node)
+
+    def visit_AsyncFunctionDef(self, node):   # noqa: N802
+        self._enter_function(node)
+
+    def visit_ClassDef(self, node):        # noqa: N802
+        qual = ".".join(self.qual_stack + [node.name])
+        fqn = f"{self.module.dotted}:{qual}"
+        ci = ClassInfo(fqn, node, self.module)
+        self.project.classes[fqn] = ci
+        self.class_stack.append(ci)
+        self.qual_stack.append(node.name)
+        self.generic_visit(node)
+        self.qual_stack.pop()
+        self.class_stack.pop()
+
+    # -- per-function facts --------------------------------------------------
+
+    def _mark_decorators(self, fn: FunctionInfo) -> None:
+        for dec in fn.node.decorator_list:
+            if _is_jit_expr(dec):
+                fn.jitted = True
+            elif isinstance(dec, ast.Call):
+                if _is_jit_expr(dec.func):
+                    fn.jitted = True
+                    fn.static_argnames = _static_argnames_of(dec)
+                elif isinstance(dec.func, (ast.Name, ast.Attribute)) and \
+                        (getattr(dec.func, "id", None) == "partial" or
+                         getattr(dec.func, "attr", None) == "partial") and \
+                        dec.args and _is_jit_expr(dec.args[0]):
+                    fn.jitted = True
+                    fn.static_argnames = _static_argnames_of(dec)
+
+    def visit_Call(self, node):            # noqa: N802
+        if self.fn_stack:
+            self.fn_stack[-1].calls.append(CallSite(node))
+        self.generic_visit(node)
+
+    def visit_Import(self, node):          # noqa: N802
+        for alias in node.names:
+            local = alias.asname or alias.name.split(".")[0]
+            self.module.imports[local] = alias.name
+            self.module.imported_modules.add(alias.name)
+
+    def visit_ImportFrom(self, node):      # noqa: N802
+        base = node.module or ""
+        if node.level:
+            parts = self.module.dotted.split(".")
+            parts = parts[: -node.level] if node.level <= len(parts) else []
+            base = ".".join(parts + ([node.module] if node.module else []))
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            local = alias.asname or alias.name
+            self.module.imports[local] = f"{base}.{alias.name}" if base \
+                else alias.name
+            if base:
+                self.module.imported_modules.add(base)
+
+
+class Project:
+    """Parsed project: modules, functions, classes, and a conservative
+    call graph."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self._method_index: Optional[Dict[str, List[str]]] = None
+        self._call_targets: Dict[str, Set[str]] = {}
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_root(cls, root: str,
+                  files: Optional[Sequence[str]] = None) -> "Project":
+        """``files``: repo-relative .py paths; default = every .py under
+        :data:`DEFAULT_SCAN_DIRS`."""
+        proj = cls(root)
+        if files is None:
+            files = []
+            for d in DEFAULT_SCAN_DIRS:
+                top = os.path.join(root, d)
+                for dirpath, _dirnames, names in os.walk(top):
+                    for n in sorted(names):
+                        if n.endswith(".py"):
+                            files.append(os.path.relpath(
+                                os.path.join(dirpath, n), root))
+        for rel in sorted(files):
+            proj.add_file(rel)
+        proj._link_jit_wrappers()
+        return proj
+
+    def add_file(self, relpath: str) -> Optional[ModuleInfo]:
+        path = os.path.join(self.root, relpath)
+        try:
+            with open(path) as f:
+                source = f.read()
+            tree = ast.parse(source, filename=relpath)
+        except (OSError, SyntaxError):
+            return None
+        dotted = relpath[:-3].replace(os.sep, "/").replace("/", ".")
+        if dotted.endswith(".__init__"):
+            dotted = dotted[: -len(".__init__")]
+        mod = ModuleInfo(relpath, dotted, tree, source)
+        self.modules[dotted] = mod
+        _FunctionCollector(self, mod).visit(tree)
+        return mod
+
+    def _link_jit_wrappers(self) -> None:
+        """``X = jax.jit(f)`` / ``return jax.jit(f)`` marks ``f`` jitted
+        (the dominant pattern here: ``build_*_step`` closes over shapes
+        and returns ``jax.jit(step)``)."""
+        for fn in list(self.functions.values()):
+            for stmt in ast.walk(fn.node):
+                val = None
+                if isinstance(stmt, (ast.Return, ast.Assign)):
+                    val = stmt.value
+                if not (isinstance(val, ast.Call) and _is_jit_expr(val.func)
+                        and val.args and isinstance(val.args[0], ast.Name)):
+                    continue
+                inner = self.functions.get(
+                    f"{fn.module.dotted}:{fn.qual}.{val.args[0].id}")
+                if inner is not None:
+                    inner.jitted = True
+                    inner.static_argnames = inner.static_argnames or \
+                        _static_argnames_of(val)
+                if isinstance(stmt, ast.Return):
+                    fn.returns_jitted = True
+        for mod in self.modules.values():
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.value, ast.Call) and \
+                        _is_jit_expr(stmt.value.func) and stmt.value.args \
+                        and isinstance(stmt.value.args[0], ast.Name):
+                    inner = self.functions.get(
+                        f"{mod.dotted}:{stmt.value.args[0].id}")
+                    if inner is not None:
+                        inner.jitted = True
+        # step getters return cached jitted steps
+        for fn in self.functions.values():
+            if fn.name == "_get_step" or (
+                    fn.name.startswith("build_") and
+                    fn.name.endswith("_step")):
+                fn.returns_jitted = True
+
+    # -- resolution ----------------------------------------------------------
+
+    @property
+    def method_index(self) -> Dict[str, List[str]]:
+        if self._method_index is None:
+            idx: Dict[str, List[str]] = {}
+            for ci in self.classes.values():
+                for name, fqn in ci.methods.items():
+                    idx.setdefault(name, []).append(fqn)
+            self._method_index = idx
+        return self._method_index
+
+    def _resolve_class(self, name: str, mod: ModuleInfo) \
+            -> Optional[ClassInfo]:
+        ci = self.classes.get(f"{mod.dotted}:{name}")
+        if ci is not None:
+            return ci
+        tgt = mod.imports.get(name)
+        if tgt and "." in tgt:
+            m, _, attr = tgt.rpartition(".")
+            return self.classes.get(f"{m}:{attr}")
+        return None
+
+    def _mro_methods(self, ci: ClassInfo, seen=None) -> Dict[str, str]:
+        """name -> fqn over the class and its project-resolvable bases."""
+        seen = seen if seen is not None else set()
+        if ci.fqn in seen:
+            return {}
+        seen.add(ci.fqn)
+        out: Dict[str, str] = {}
+        for base in ci.bases:
+            bci = self._resolve_class(base.split(".")[-1], ci.module)
+            if bci is not None:
+                out.update(self._mro_methods(bci, seen))
+        out.update(ci.methods)
+        return out
+
+    def resolve_call(self, fn: FunctionInfo, call: ast.Call) -> Set[str]:
+        callee = call.func
+        out: Set[str] = set()
+        if isinstance(callee, ast.Name):
+            name = callee.id
+            parts = fn.qual.split(".")
+            for i in range(len(parts), -1, -1):
+                if i and f"{fn.module.dotted}:" + ".".join(parts[:i]) \
+                        in self.classes:
+                    continue      # class scope is invisible to bare names
+                cand = f"{fn.module.dotted}:" + \
+                    ".".join(parts[:i] + [name]) if i else \
+                    f"{fn.module.dotted}:{name}"
+                if cand in self.functions:
+                    return {cand}
+            ci = self._resolve_class(name, fn.module)
+            if ci is not None:
+                init = self._mro_methods(ci).get("__init__")
+                return {init} if init else set()
+            tgt = fn.module.imports.get(name)
+            if tgt and "." in tgt:
+                m, _, attr = tgt.rpartition(".")
+                cand = f"{m}:{attr}"
+                if cand in self.functions:
+                    return {cand}
+            return out
+        if not isinstance(callee, ast.Attribute):
+            return out
+        base, attr = callee.value, callee.attr
+        if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                and fn.class_fqn:
+            ci = self.classes.get(fn.class_fqn)
+            if ci is not None:
+                m = self._mro_methods(ci).get(attr)
+                if m:
+                    return {m}
+            return out
+        if isinstance(base, ast.Name):
+            tgt = fn.module.imports.get(base.id)
+            if tgt and tgt in self.modules:
+                cand = f"{tgt}:{attr}"
+                if cand in self.functions:
+                    return {cand}
+                ci = self.classes.get(f"{tgt}:{attr}")
+                if ci is not None:
+                    init = self._mro_methods(ci).get("__init__")
+                    return {init} if init else set()
+        # last resort: a project-unique method name, accepted only when
+        # private-ish or defined in a module the caller imports — keeps
+        # `t.start()` from resolving into an unrelated project `start`
+        cands = self.method_index.get(attr, ())
+        if len(cands) == 1:
+            cand_fn = self.functions[cands[0]]
+            if cand_fn.module is fn.module or \
+                    cand_fn.module.dotted in fn.module.imported_modules:
+                return {cands[0]}
+        return out
+
+    def call_targets(self, fqn: str) -> Set[str]:
+        hit = self._call_targets.get(fqn)
+        if hit is not None:
+            return hit
+        fn = self.functions[fqn]
+        out: Set[str] = set()
+        for cs in fn.calls:
+            out |= self.resolve_call(fn, cs.node)
+        self._call_targets[fqn] = out
+        return out
+
+    def reachable_from(self, roots: Iterable[str]) -> Set[str]:
+        seen: Set[str] = set()
+        todo = [r for r in roots if r in self.functions]
+        while todo:
+            cur = todo.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            todo.extend(t for t in self.call_targets(cur) if t not in seen)
+        return seen
+
+
+# ---------------------------------------------------------------------------
+# Scan driver
+# ---------------------------------------------------------------------------
+
+
+def scan_project(root: str, files: Optional[Sequence[str]] = None,
+                 rules: Optional[Iterable[str]] = None,
+                 runtime: bool = True,
+                 report_files: Optional[Set[str]] = None) -> List[Finding]:
+    """Run every selected rule family over the project at ``root``.
+
+    ``rules``: rule-id prefixes to keep (``{"ESTP-J"}``, ``{"ESTP-L01"}``;
+    default all). ``runtime=False`` skips the catalogue family's live
+    registry workload (its static cross-checks still run).
+    ``report_files``: when given (``--diff`` mode), only findings in
+    those repo-relative files are reported — the project model is still
+    built whole so cross-module rules see the full graph."""
+    from . import rules_catalogue, rules_jit, rules_locks
+    project = Project.from_root(root, files)
+    prefixes = tuple(rules) if rules is not None else None
+    if prefixes and not any(p.startswith("ESTP-C") or
+                            "ESTP-C".startswith(p) for p in prefixes):
+        runtime = False       # no C rule selected: skip the workload
+    findings: List[Finding] = []
+    findings += rules_jit.check(project)
+    findings += rules_locks.check(project)
+    findings += rules_catalogue.check(project, runtime=runtime)
+    if prefixes is not None:
+        findings = [f for f in findings if f.rule.startswith(prefixes)]
+    if report_files is not None:
+        findings = [f for f in findings if f.file in report_files]
+    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.detail))
+    return findings

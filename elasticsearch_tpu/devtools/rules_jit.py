@@ -1,0 +1,357 @@
+"""Rule family 1 — jit-boundary hygiene (ESTP-J*).
+
+The serving hot path is a pipeline of host prep feeding jitted device
+dispatches; its two recurring regressions are (a) an accidental host
+synchronization (``.item()``, ``float()`` on a device array, a stray
+``np.asarray`` or implicit ``__bool__``) serializing the pipeline from
+inside the dispatch path, and (b) compile churn from static arguments
+that bypass the shape-lattice bucketing helpers. Until now both were
+caught only at runtime (the PR 3 compile-ratchet, stage timings); these
+rules catch them at the AST.
+
+- **ESTP-J01 host-sync-in-hot-path** — host-synchronizing constructs
+  (``.item()``, ``jax.device_get``, ``jax.block_until_ready``, and
+  ``float()/int()/bool()``/``np.asarray``/implicit-``bool`` branching on
+  names assigned from a jitted step call) inside functions reachable
+  from device hot-path roots (``build_*_step``, ``serve``/``serve_view``,
+  the dispatcher loops). An *intentional* sync (the one batched result
+  fetch; a stage-timing fence) belongs in the baseline with its
+  justification.
+- **ESTP-J02 impure-host-call-in-jit** — ``time.*``/``random.*``/
+  ``np.random.*``/``datetime.*``/``print``/``open`` calls and host-sync
+  constructs inside jit-compiled code (decorated, or wrapped via
+  ``jax.jit(f)``): they burn into the trace as constants or crash on
+  tracers.
+- **ESTP-J03 mutable-default-in-jit** — list/dict/set defaults on a
+  jit-compiled function: mutated state is invisible to the trace cache.
+- **ESTP-J04 unbucketed-static-arg** — step call sites (``_get_step``,
+  ``build_*_step``, jitted functions with ``static_argnames``) fed a raw
+  data-dependent size (``len(...)``, ``x.shape[i]``) that never passed
+  through a bucketing helper (``round_up_pow2``/``bucket_length``/
+  ``_k_bucket``/``ladder_L``…): every distinct value is a fresh XLA
+  compile.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .analyzer import (Finding, FunctionInfo, Project, _unparse,
+                       scoped_walk)
+
+RULE_J01 = "ESTP-J01"
+RULE_J02 = "ESTP-J02"
+RULE_J03 = "ESTP-J03"
+RULE_J04 = "ESTP-J04"
+
+#: device hot-path roots: plane serving entries + dispatcher loops
+HOT_ROOT_NAMES = {"serve", "serve_view", "_dispatch_loop", "_run_batch"}
+HOT_ROOT_RE = re.compile(r"^build_\w+_step$")
+
+#: the shape-lattice bucketing helpers static shapes must flow through
+BUCKET_HELPERS = {"round_up_pow2", "round_up_multiple", "bucket_length",
+                  "ladder_L", "ladder_rungs", "_k_bucket", "min", "max"}
+
+#: step-getter call targets whose arguments are compile-shape static
+STEP_CALLEE_RE = re.compile(r"^(_?get_step|build_\w+_step)$")
+
+
+def _short(node: ast.AST, cap: int = 64) -> str:
+    s = _unparse(node)
+    return s if len(s) <= cap else s[: cap - 1] + "…"
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _assign_targets(node: ast.Assign) -> List[str]:
+    out: List[str] = []
+    for t in node.targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                if isinstance(e, ast.Name):
+                    out.append(e.id)
+                elif isinstance(e, ast.Starred) and \
+                        isinstance(e.value, ast.Name):
+                    out.append(e.value.id)
+    return out
+
+
+def _hot_reach(project: Project):
+    """BFS from the hot roots, keeping one parent per reached function so
+    findings can name their root chain."""
+    roots = [fqn for fqn, fn in project.functions.items()
+             if fn.name in HOT_ROOT_NAMES or HOT_ROOT_RE.match(fn.name)]
+    parent: Dict[str, Optional[str]] = {r: None for r in roots}
+    todo = list(roots)
+    while todo:
+        cur = todo.pop()
+        for tgt in project.call_targets(cur):
+            if tgt not in parent:
+                parent[tgt] = cur
+                todo.append(tgt)
+    return parent
+
+
+def _root_chain(parent: Dict[str, Optional[str]], fqn: str) -> str:
+    chain = [fqn]
+    while parent.get(chain[-1]) is not None:
+        chain.append(parent[chain[-1]])
+    names = [c.split(":", 1)[1] for c in reversed(chain)]
+    return " -> ".join(names[:4] + (["…"] if len(names) > 4 else []))
+
+
+def _tainted_names(project: Project, fn: FunctionInfo) -> Set[str]:
+    """Names in ``fn`` bound (directly or through a step-callable local)
+    to the result of a jitted call — device-array-typed values whose
+    host conversion is a sync."""
+    step_locals: Set[str] = set()
+    tainted: Set[str] = set()
+    assigns = sorted(
+        (n for n in scoped_walk(fn.node)
+         if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call)),
+        key=lambda n: n.lineno)
+    # pass 1: which locals hold a jitted callable (step getters)
+    for node in assigns:
+        targets = _assign_targets(node)
+        if targets and any(project.functions[t].returns_jitted
+                           for t in project.resolve_call(fn, node.value)):
+            step_locals.update(targets)
+    # pass 2: which locals hold a jitted call's RESULT (device arrays)
+    for node in assigns:
+        call = node.value
+        targets = _assign_targets(node)
+        if not targets:
+            continue
+        resolved = project.resolve_call(fn, call)
+        if any(project.functions[t].returns_jitted for t in resolved):
+            continue
+        is_jit_result = any(project.functions[t].jitted for t in resolved)
+        if not is_jit_result and isinstance(call.func, ast.Name) and \
+                call.func.id in step_locals:
+            is_jit_result = True
+        if is_jit_result:
+            tainted.update(targets)
+    return tainted
+
+
+def _host_sync_detail(node: ast.AST, tainted: Set[str]) -> Optional[str]:
+    """The host-sync classification of one AST node, or None."""
+    if isinstance(node, ast.Call):
+        name = _callee_name(node)
+        if name == "item" and isinstance(node.func, ast.Attribute) \
+                and not node.args:
+            return f".item() [{_short(node)}]"
+        if name in ("device_get", "block_until_ready"):
+            return f"{name}() [{_short(node)}]"
+        if name in ("float", "int", "bool") and len(node.args) == 1 and \
+                _names_in(node.args[0]) & tainted:
+            return f"{name}() on step output [{_short(node)}]"
+        if name in ("asarray", "array") and node.args and \
+                _names_in(node.args[0]) & tainted:
+            return f"np.{name}() on step output [{_short(node)}]"
+    if isinstance(node, (ast.If, ast.While)):
+        test = node.test
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            test = test.operand
+        if isinstance(test, ast.Name) and test.id in tainted:
+            return f"implicit bool() on step output [{test.id}]"
+    return None
+
+
+def _check_hot_path(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    parent = _hot_reach(project)
+    for fqn in parent:
+        fn = project.functions.get(fqn)
+        if fn is None or fn.jitted:
+            continue      # inside-jit constructs are ESTP-J02's concern
+        tainted = _tainted_names(project, fn)
+        for node in scoped_walk(fn.node):
+            detail = _host_sync_detail(node, tainted)
+            if detail is None:
+                continue
+            findings.append(Finding(
+                RULE_J01, fn.module.relpath, node.lineno, fn.qual, detail,
+                f"host synchronization {detail} on the device hot path "
+                f"(reached via {_root_chain(parent, fqn)}); a sync here "
+                f"serializes the dispatch pipeline"))
+    return findings
+
+
+_IMPURE_MODULES = {"time", "random", "datetime", "os"}
+
+
+def _check_in_jit(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in project.functions.values():
+        if not fn.jitted:
+            continue
+        # J03: mutable defaults
+        args = fn.node.args
+        for d in list(args.defaults) + [d for d in args.kw_defaults if d]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call) and
+                    isinstance(d.func, ast.Name) and
+                    d.func.id in ("list", "dict", "set")):
+                findings.append(Finding(
+                    RULE_J03, fn.module.relpath, d.lineno, fn.qual,
+                    f"mutable default [{_short(d)}]",
+                    "jit-compiled function carries a mutable default "
+                    "argument — mutations are invisible to the trace "
+                    "cache and resurrect stale state across calls"))
+        # J02: impure host calls + host syncs inside the traced body
+        for node in scoped_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            impure = None
+            if isinstance(f, ast.Attribute):
+                base = f.value
+                if isinstance(base, ast.Name) and \
+                        base.id in _IMPURE_MODULES:
+                    impure = f"{base.id}.{f.attr}()"
+                elif isinstance(base, ast.Attribute) and \
+                        base.attr == "random":
+                    impure = f"np.random.{f.attr}()"
+                elif f.attr == "item" and not node.args:
+                    impure = ".item()"
+                elif f.attr in ("device_get", "block_until_ready"):
+                    impure = f"{f.attr}()"
+                elif f.attr == "asarray" and isinstance(base, ast.Name) \
+                        and base.id in ("np", "numpy"):
+                    impure = "np.asarray()"
+            elif isinstance(f, ast.Name) and f.id in ("print", "open"):
+                impure = f"{f.id}()"
+            if impure:
+                findings.append(Finding(
+                    RULE_J02, fn.module.relpath, node.lineno, fn.qual,
+                    f"{impure} in jit [{_short(node)}]",
+                    f"{impure} inside a jit-compiled function: traces to "
+                    f"a burned-in constant (or crashes on a tracer) — "
+                    f"hoist it to the host side of the boundary"))
+    return findings
+
+
+def _last_assignments(fn: FunctionInfo) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+    for node in scoped_walk(fn.node):
+        if isinstance(node, ast.Assign):
+            for name in _assign_targets(node):
+                out[name] = node.value
+    return out
+
+
+def _is_raw_size(expr: ast.AST, assigns: Dict[str, ast.AST],
+                 depth: int = 0) -> bool:
+    """True when ``expr`` is a data-dependent size that never passed a
+    bucketing helper: ``len(...)``, ``x.shape[i]``, or a name whose last
+    assignment is one of those. Anything passing through a helper — or
+    not provably raw — is accepted (the rule under-approximates)."""
+    if isinstance(expr, ast.Call):
+        name = _callee_name(expr)
+        if name in BUCKET_HELPERS:
+            return False
+    if isinstance(expr, ast.Name) and depth < 3:
+        src = assigns.get(expr.id)
+        return _is_raw_size(src, assigns, depth + 1) if src is not None \
+            else False
+    has_helper = any(isinstance(n, ast.Call) and
+                     _callee_name(n) in BUCKET_HELPERS
+                     for n in ast.walk(expr))
+    if has_helper:
+        return False
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and \
+                n.func.id == "len":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "shape":
+            return True
+    return False
+
+
+def _is_opaque_call_size(expr: ast.AST, assigns: Dict[str, ast.AST],
+                         depth: int = 0) -> bool:
+    """True when ``expr`` is (or a name last assigned from) a call that
+    is not a bucketing helper — a data-derived value with no visible
+    shape-lattice provenance."""
+    if isinstance(expr, ast.Name) and depth < 3:
+        src = assigns.get(expr.id)
+        return _is_opaque_call_size(src, assigns, depth + 1) \
+            if src is not None else False
+    if isinstance(expr, ast.Call):
+        return _callee_name(expr) not in BUCKET_HELPERS
+    return False
+
+
+def _static_args_at(project: Project, fn: FunctionInfo, call: ast.Call):
+    """(arg expr, display name, strict) triples that are compile-shape
+    static at this call site. ``strict`` marks sites where the callee is
+    *declared* jit-static (``static_argnames``) — there even an opaque
+    data-derived provenance is flagged, not just provably-raw sizes."""
+    name = _callee_name(call)
+    if name and STEP_CALLEE_RE.match(name):
+        out = [(a, f"arg{idx}", False) for idx, a in enumerate(call.args)]
+        out += [(kw.value, kw.arg, False) for kw in call.keywords
+                if kw.arg]
+        return out
+    resolved = project.resolve_call(fn, call)
+    for tgt in resolved:
+        tfn = project.functions[tgt]
+        if tfn.jitted and tfn.static_argnames:
+            statics = set(tfn.static_argnames)
+            posnames = [a.arg for a in tfn.node.args.args]
+            out = []
+            for idx, a in enumerate(call.args):
+                if idx < len(posnames) and posnames[idx] in statics:
+                    out.append((a, posnames[idx], True))
+            out += [(kw.value, kw.arg, True) for kw in call.keywords
+                    if kw.arg in statics]
+            return out
+    return []
+
+
+def _check_static_args(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in project.functions.values():
+        assigns = None
+        for cs in fn.calls:
+            pairs = _static_args_at(project, fn, cs.node)
+            if not pairs:
+                continue
+            if assigns is None:
+                assigns = _last_assignments(fn)
+            for expr, argname, strict in pairs:
+                raw = _is_raw_size(expr, assigns)
+                if not raw and strict:
+                    raw = _is_opaque_call_size(expr, assigns)
+                if raw:
+                    findings.append(Finding(
+                        RULE_J04, fn.module.relpath, cs.line, fn.qual,
+                        f"raw static arg {argname}=[{_short(expr)}] at "
+                        f"{_short(cs.node.func)}()",
+                        f"static argument [{argname}] at a jit step call "
+                        f"site is a raw data-dependent size — route it "
+                        f"through the shape-lattice helpers "
+                        f"(utils/shapes.py) or every distinct value "
+                        f"compiles a fresh XLA program"))
+    return findings
+
+
+def check(project: Project) -> List[Finding]:
+    return (_check_hot_path(project) + _check_in_jit(project) +
+            _check_static_args(project))

@@ -1,0 +1,402 @@
+"""Rule family 2 — lock-order safety (ESTP-L*).
+
+Sixteen modules hold locks: dispatcher threads (``search/microbatch``),
+the background repack thread (``search/plane_route``), refresh
+listeners, the task ledger (``node/task_manager``)… A lock-order
+inversion between any two of them is a deadlock that only fires under
+production interleavings. These rules extract the package-wide
+lock-acquisition graph syntactically and keep it cycle-free at the AST;
+the opt-in runtime witness (``common/lockdep.py``, ``ES_TPU_LOCKDEP=1``)
+cross-checks the same property against *observed* acquisition order at
+test time, so the static graph and the runtime evidence must agree.
+
+- **ESTP-L01 lock-order-cycle** — a cycle in the "held → acquired"
+  graph: lock B is ever taken while A is held *and* (possibly through
+  call edges and other locks) A while B is held. Every edge is
+  annotated with the acquisition site that witnesses it.
+- **ESTP-L02 telemetry-under-serving-lock** — code reachable while a
+  serving lock is held (dispatcher queue lock, generation registry,
+  delta swap, task ledger) must never call into ``common/telemetry`` /
+  ``common/tracing``: a collector snapshot or exposition scrape
+  contending a metric lock must not be able to stall a dispatch, and a
+  telemetry-layer slowdown must never back up the serving path.
+
+Lock identity is per *declaration site* (``module:Class.attr``,
+``module:var``), not per instance — the same granularity the runtime
+witness uses, so their graphs line up. Two conditions built over one
+underlying lock (the microbatcher's ``_cond``/``_work``) collapse into
+that lock's node. Resolution is conservative: ``self.X`` resolves
+through the project MRO; a bare ``obj.X`` resolves only when the
+attribute name is project-unique; everything else contributes no node
+(documented limitation — see STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .analyzer import Finding, FunctionInfo, Project, _unparse
+
+RULE_L01 = "ESTP-L01"
+RULE_L02 = "ESTP-L02"
+
+#: modules whose locks guard the serving path (family-2 rule L02);
+#: matched as a dotted suffix so fixture packages work unprefixed
+SERVING_LOCK_MODULES = re.compile(
+    r"(^|\.)(search\.(microbatch|plane_route)|parallel\.dist_search"
+    r"|node\.(task_manager|indices_service))$")
+
+#: attrs excluded from the serving set even in serving modules (metric
+#: bookkeeping locks are telemetry-side by design)
+_NON_SERVING_ATTR = re.compile(r"metric")
+
+TELEMETRY_MODULES = re.compile(r"(^|\.)common\.(telemetry|tracing)$")
+
+_LOCK_CTORS = {"Lock", "RLock"}
+
+
+def _is_lock_ctor(call: ast.Call) -> Optional[str]:
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else None
+    return name if name in _LOCK_CTORS or name == "Condition" else None
+
+
+class LockTable:
+    """Every lock declaration in the project, with resolution maps."""
+
+    def __init__(self):
+        #: (module_dotted, varname) -> node  (module-level locks)
+        self.module_locks: Dict[Tuple[str, str], str] = {}
+        #: class_fqn -> {attr: node}
+        self.class_attrs: Dict[str, Dict[str, str]] = {}
+        #: fn_fqn -> {varname: node}  (function-local locks, closures)
+        self.fn_locals: Dict[str, Dict[str, str]] = {}
+        #: attr -> {node}  (unique-name fallback for non-self receivers)
+        self.attr_index: Dict[str, Set[str]] = {}
+        #: node -> module_dotted
+        self.node_module: Dict[str, str] = {}
+
+    def _add(self, node: str, module: str, attr: Optional[str]) -> None:
+        self.node_module[node] = module
+        if attr:
+            self.attr_index.setdefault(attr, set()).add(node)
+
+
+def build_lock_table(project: Project) -> LockTable:
+    table = LockTable()
+    for mod in project.modules.values():
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    _is_lock_ctor(stmt.value) and \
+                    len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                node = f"{mod.dotted}:{name}"
+                table.module_locks[(mod.dotted, name)] = node
+                table._add(node, mod.dotted, None)
+    for fn in project.functions.values():
+        cls = fn.class_fqn
+        cls_qual = cls.split(":", 1)[1] if cls else None
+        local_locks: Dict[str, str] = {}
+        for stmt in ast.walk(fn.node):
+            if not (isinstance(stmt, ast.Assign) and
+                    isinstance(stmt.value, ast.Call)):
+                continue
+            kind = _is_lock_ctor(stmt.value)
+            if kind is None or len(stmt.targets) != 1:
+                continue
+            tgt = stmt.targets[0]
+            mod = fn.module.dotted
+            if isinstance(tgt, ast.Name):
+                if kind == "Condition":
+                    # Condition over an existing lock is an alias, a
+                    # bare Condition() is its own (hidden RLock) node
+                    node = None
+                    args = stmt.value.args
+                    if args and isinstance(args[0], ast.Name):
+                        node = local_locks.get(args[0].id)
+                    if node is None:
+                        node = f"{mod}:{fn.qual}.{tgt.id}"
+                        table._add(node, mod, None)
+                    local_locks[tgt.id] = node
+                else:
+                    node = f"{mod}:{cls_qual}.{tgt.id}" if cls_qual \
+                        else f"{mod}:{fn.qual}.{tgt.id}"
+                    table._add(node, mod, None)
+                    local_locks[tgt.id] = node
+                table.fn_locals.setdefault(fn.fqn, {})[tgt.id] = node
+            elif isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self" and cls:
+                attr = tgt.attr
+                node = None
+                if kind == "Condition":
+                    args = stmt.value.args
+                    if args and isinstance(args[0], ast.Name):
+                        node = local_locks.get(args[0].id)
+                    elif args and isinstance(args[0], ast.Attribute) and \
+                            isinstance(args[0].value, ast.Name) and \
+                            args[0].value.id == "self":
+                        node = table.class_attrs.get(cls, {}).get(
+                            args[0].attr)
+                if node is None:
+                    node = f"{mod}:{cls_qual}.{attr}"
+                table.class_attrs.setdefault(cls, {})[attr] = node
+                table._add(node, mod, attr)
+    return table
+
+
+def _class_lock_attr(project: Project, table: LockTable,
+                     class_fqn: str, attr: str,
+                     seen: Optional[set] = None) -> Optional[str]:
+    seen = seen if seen is not None else set()
+    if class_fqn in seen:
+        return None
+    seen.add(class_fqn)
+    hit = table.class_attrs.get(class_fqn, {}).get(attr)
+    if hit:
+        return hit
+    ci = project.classes.get(class_fqn)
+    if ci is None:
+        return None
+    for base in ci.bases:
+        bci = project._resolve_class(base.split(".")[-1], ci.module)
+        if bci is not None:
+            hit = _class_lock_attr(project, table, bci.fqn, attr, seen)
+            if hit:
+                return hit
+    return None
+
+
+def resolve_lock_expr(project: Project, table: LockTable,
+                      fn: FunctionInfo, expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        qual_parts = fn.qual.split(".")
+        for i in range(len(qual_parts), 0, -1):
+            owner = f"{fn.module.dotted}:" + ".".join(qual_parts[:i])
+            hit = table.fn_locals.get(owner, {}).get(expr.id)
+            if hit:
+                return hit
+        return table.module_locks.get((fn.module.dotted, expr.id))
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls") and fn.class_fqn:
+            return _class_lock_attr(project, table, fn.class_fqn,
+                                    expr.attr)
+        cands = table.attr_index.get(expr.attr, ())
+        if len(cands) == 1:
+            return next(iter(cands))
+    return None
+
+
+class _FnLockFacts:
+    __slots__ = ("direct_edges", "calls_under", "acquires")
+
+    def __init__(self):
+        #: (held_node, acquired_node, line)
+        self.direct_edges: List[Tuple[str, str, int]] = []
+        #: (held_nodes tuple, ast.Call)
+        self.calls_under: List[Tuple[Tuple[str, ...], ast.Call]] = []
+        self.acquires: Set[str] = set()
+
+
+def _scan_function(project: Project, table: LockTable,
+                   fn: FunctionInfo) -> _FnLockFacts:
+    facts = _FnLockFacts()
+
+    def rec(node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return    # separate scope / deferred execution
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            newly: List[str] = []
+            for item in node.items:
+                rec(item.context_expr, held)     # evaluated pre-acquire
+                lk = resolve_lock_expr(project, table, fn,
+                                       item.context_expr)
+                if lk is not None:
+                    for h in held + tuple(newly):
+                        facts.direct_edges.append((h, lk, node.lineno))
+                    newly.append(lk)
+                    facts.acquires.add(lk)
+            inner = held + tuple(newly)
+            for stmt in node.body:
+                rec(stmt, inner)
+            return
+        if isinstance(node, ast.Call):
+            facts.calls_under.append((held, node))
+        for child in ast.iter_child_nodes(node):
+            rec(child, held)
+
+    for stmt in fn.node.body:
+        rec(stmt, ())
+    return facts
+
+
+def build_lock_graph(project: Project):
+    """→ (edges, facts, acq_star): ``edges[(a, b)] = (file, line, via)``
+    meaning lock ``b`` is (possibly transitively) acquired while ``a``
+    is held, first witnessed at that site."""
+    table = build_lock_table(project)
+    facts: Dict[str, _FnLockFacts] = {
+        fqn: _scan_function(project, table, fn)
+        for fqn, fn in project.functions.items()}
+    # transitive acquisitions per function
+    acq_star: Dict[str, Set[str]] = {
+        fqn: set(f.acquires) for fqn, f in facts.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fqn in facts:
+            cur = acq_star[fqn]
+            before = len(cur)
+            for tgt in project.call_targets(fqn):
+                cur |= acq_star.get(tgt, set())
+            if len(cur) != before:
+                changed = True
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for fqn, f in facts.items():
+        fn = project.functions[fqn]
+        for a, b, line in f.direct_edges:
+            if a != b:
+                edges.setdefault((a, b), (fn.module.relpath, line,
+                                          fn.qual))
+        for held, call in f.calls_under:
+            if not held:
+                continue
+            for tgt in project.resolve_call(fn, call):
+                for b in acq_star.get(tgt, ()):
+                    for a in held:
+                        if a != b:
+                            edges.setdefault(
+                                (a, b),
+                                (fn.module.relpath, call.lineno,
+                                 f"{fn.qual} -> "
+                                 f"{tgt.split(':', 1)[1]}"))
+    return edges, facts, acq_star, table
+
+
+def find_cycles(edges: Dict[Tuple[str, str], Tuple]) -> List[List[str]]:
+    """Elementary cycles in the lock graph (each reported once, rotated
+    to start at its smallest node)."""
+    adj: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    out: List[List[str]] = []
+
+    def dfs(start: str, cur: str, path: List[str],
+            on_path: Set[str]) -> None:
+        for nxt in sorted(adj.get(cur, ())):
+            if nxt == start:
+                rot = min(range(len(path)),
+                          key=lambda i: path[i])
+                canon = tuple(path[rot:] + path[:rot])
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    out.append(list(canon))
+            elif nxt not in on_path and nxt > start:
+                # only expand nodes > start: each cycle is found from
+                # its smallest node exactly once
+                path.append(nxt)
+                on_path.add(nxt)
+                dfs(start, nxt, path, on_path)
+                on_path.discard(nxt)
+                path.pop()
+
+    for n in sorted(adj):
+        dfs(n, n, [n], {n})
+    return out
+
+
+def _check_cycles(project: Project, edges) -> List[Finding]:
+    findings = []
+    for cycle in find_cycles(edges):
+        hops = []
+        first_site = None
+        for i, a in enumerate(cycle):
+            b = cycle[(i + 1) % len(cycle)]
+            site = edges.get((a, b))
+            if site and first_site is None:
+                first_site = site
+            hops.append(f"{a} -> {b}"
+                        + (f" ({site[0]}:{site[1]} in {site[2]})"
+                           if site else ""))
+        file, line = (first_site[0], first_site[1]) if first_site \
+            else ("<unknown>", 0)
+        findings.append(Finding(
+            RULE_L01, file, line, "lock-graph",
+            "cycle: " + " ; ".join(f"{a} -> {cycle[(i + 1) % len(cycle)]}"
+                                   for i, a in enumerate(cycle)),
+            "lock-order cycle (deadlock under the right interleaving): "
+            + " ; ".join(hops)))
+    return findings
+
+
+def _serving_locks(table: LockTable) -> Set[str]:
+    out = set()
+    for node, mod in table.node_module.items():
+        attr = node.rsplit(".", 1)[-1]
+        if SERVING_LOCK_MODULES.search(mod) and \
+                not _NON_SERVING_ATTR.search(attr):
+            out.add(node)
+    return out
+
+
+def _check_telemetry_under_lock(project: Project, facts,
+                                table: LockTable) -> List[Finding]:
+    # which functions (transitively) execute telemetry/tracing code
+    in_telem = {fqn for fqn, fn in project.functions.items()
+                if TELEMETRY_MODULES.search(fn.module.dotted)}
+    reaches: Dict[str, bool] = {fqn: False for fqn in project.functions}
+    changed = True
+    while changed:
+        changed = False
+        for fqn in project.functions:
+            if reaches[fqn]:
+                continue
+            for tgt in project.call_targets(fqn):
+                if tgt in in_telem or reaches.get(tgt):
+                    reaches[fqn] = True
+                    changed = True
+                    break
+    serving = _serving_locks(table)
+    findings = []
+    seen = set()
+    for fqn, f in facts.items():
+        fn = project.functions[fqn]
+        if TELEMETRY_MODULES.search(fn.module.dotted):
+            continue        # telemetry's own internals are exempt
+        for held, call in f.calls_under:
+            s_held = [h for h in held if h in serving]
+            if not s_held:
+                continue
+            for tgt in project.resolve_call(fn, call):
+                if tgt in in_telem or reaches.get(tgt):
+                    key = (fqn, call.lineno, s_held[0])
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(Finding(
+                        RULE_L02, fn.module.relpath, call.lineno,
+                        fn.qual,
+                        f"telemetry call [{_unparse(call.func)}] under "
+                        f"serving lock [{s_held[0]}]",
+                        f"telemetry/tracing executes while serving lock "
+                        f"[{s_held[0]}] is held (via "
+                        f"{tgt.split(':', 1)[1]}): a slow scrape or "
+                        f"collector must never stall the dispatch path "
+                        f"— move the call outside the critical "
+                        f"section"))
+                    break
+    return findings
+
+
+def check(project: Project) -> List[Finding]:
+    edges, facts, _acq_star, table = build_lock_graph(project)
+    return _check_cycles(project, edges) + \
+        _check_telemetry_under_lock(project, facts, table)

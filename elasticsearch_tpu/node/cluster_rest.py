@@ -1455,8 +1455,13 @@ class ClusterRestService:
                 names = sorted(self.api.indices.resolve(index_expr)) \
                     if index_expr else sorted(self.api.indices.indices)
             except _errors.ElasticsearchError:
-                return self._local(method, path, query, body)
-        if not any(n in routing for n in names):
+                names = None
+        if names is None or not any(n in routing for n in names):
+            # local fallback OUTSIDE self.lock (ESTP-L01): _local runs
+            # the full dispatcher (api.handle + _after_local, whose
+            # write path takes _meta_mutex/_apply_ops_mutex) — calling
+            # it under self.lock opposes the apply_ops/h_meta_op order
+            # (mutex first, then self.lock) and closes a deadlock cycle
             return self._local(method, path, query, body)
         params = _parse_query(query)
         remote = self._remote_shard_stats(names, sections={"docs"})
@@ -1511,8 +1516,10 @@ class ClusterRestService:
                 names = sorted(self.api.indices.resolve(index_expr)) \
                     if index_expr else sorted(self.api.indices.indices)
             except _errors.ElasticsearchError:
-                return self._local(method, path, query, body)
-        if not any(n in routing for n in names):
+                names = None
+        if names is None or not any(n in routing for n in names):
+            # OUTSIDE self.lock — same lock-order reasoning as
+            # _cat_shards (ESTP-L01)
             return self._local(method, path, query, body)
         params = _parse_query(query)
         rows = []

@@ -2440,14 +2440,19 @@ class DistributedSearchPlane:
         key = ("bmx", Q, k, P_sched, W, R)
         with self._steps_lock:
             fn = self._steps.get(key)
-            if fn is None:
-                fn = build_pruned_bm25_step(
-                    self.mesh, n_pad=self.n_pad, Q=Q, k=k,
-                    P_sched=P_sched, W=W, R=R, BS=self.blockmax.block,
-                    NB=self.blockmax.n_blocks, n_shards=self.n_shards)
-                from ..common.telemetry import instrument_step
-                fn = instrument_step(fn, site="text_plane_pruned")
-                self._steps[key] = fn
+        if fn is None:
+            # build + instrument OUTSIDE the lock (ESTP-L02): telemetry
+            # code must never run under a serving lock, and concurrent
+            # distinct-shape builds must not serialize; setdefault keeps
+            # the first copy if two threads raced the same key
+            fn = build_pruned_bm25_step(
+                self.mesh, n_pad=self.n_pad, Q=Q, k=k,
+                P_sched=P_sched, W=W, R=R, BS=self.blockmax.block,
+                NB=self.blockmax.n_blocks, n_shards=self.n_shards)
+            from ..common.telemetry import instrument_step
+            fn = instrument_step(fn, site="text_plane_pruned")
+            with self._steps_lock:
+                fn = self._steps.setdefault(key, fn)
         return fn
 
     def _get_step(self, Q: int, L: int, k: int, *, tiered: bool = False,
@@ -2455,22 +2460,25 @@ class DistributedSearchPlane:
         key = (Q, L, k, tiered, with_count, U)
         with self._steps_lock:
             fn = self._steps.get(key)
-            if fn is None:
-                if tiered:
-                    fn = build_tiered_bm25_step(
-                        self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
-                        T_pad=self.T_pad, C=self.dense_block,
-                        n_shards=self.n_shards, with_count=with_count, U=U)
-                else:
-                    fn = build_bm25_topk_step(
-                        self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
-                        n_shards=self.n_shards, with_count=with_count)
-                # telemetry: each new input-shape signature through the
-                # jitted step is one XLA compile — counted per shape so
-                # compile churn is attributable (common/telemetry.py)
-                from ..common.telemetry import instrument_step
-                fn = instrument_step(fn, site="text_plane")
-                self._steps[key] = fn
+        if fn is None:
+            # build + instrument OUTSIDE the lock (ESTP-L02; see
+            # _get_pruned_step)
+            if tiered:
+                fn = build_tiered_bm25_step(
+                    self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
+                    T_pad=self.T_pad, C=self.dense_block,
+                    n_shards=self.n_shards, with_count=with_count, U=U)
+            else:
+                fn = build_bm25_topk_step(
+                    self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
+                    n_shards=self.n_shards, with_count=with_count)
+            # telemetry: each new input-shape signature through the
+            # jitted step is one XLA compile — counted per shape so
+            # compile churn is attributable (common/telemetry.py)
+            from ..common.telemetry import instrument_step
+            fn = instrument_step(fn, site="text_plane")
+            with self._steps_lock:
+                fn = self._steps.setdefault(key, fn)
         return fn
 
 
@@ -2603,15 +2611,18 @@ class DistributedKnnPlane:
     def _get_step(self, k: int):
         with self._steps_lock:
             fn = self._steps.get(k)
-            if fn is None:
-                fn = build_knn_step(
-                    self.mesh, n_pad=self.n_pad, dim=max(self.dim, 1), k=k,
-                    n_shards=self.n_shards, similarity=self.similarity,
-                    block=self.block)
-                from ..common.telemetry import instrument_step
-                fn = instrument_step(fn, site="knn_plane")
-                self._steps[k] = fn
-            return fn
+        if fn is None:
+            # build + instrument OUTSIDE the lock (ESTP-L02; see the
+            # text plane's _get_pruned_step)
+            fn = build_knn_step(
+                self.mesh, n_pad=self.n_pad, dim=max(self.dim, 1), k=k,
+                n_shards=self.n_shards, similarity=self.similarity,
+                block=self.block)
+            from ..common.telemetry import instrument_step
+            fn = instrument_step(fn, site="knn_plane")
+            with self._steps_lock:
+                fn = self._steps.setdefault(k, fn)
+        return fn
 
     def search(self, query_vectors, k: int = 10,
                stages: Optional[dict] = None):
@@ -2867,17 +2878,20 @@ class DistributedKnnPlane:
         key = ("ivf", k, nprobe, r_cand, Pw)
         with self._steps_lock:
             fn = self._steps.get(key)
-            if fn is None:
-                fn = build_ivf_knn_step(
-                    self.mesh, n_pad=self.n_pad, dim=max(self.dim, 1),
-                    k=k, n_shards=self.n_shards,
-                    similarity=self.similarity, nprobe=nprobe,
-                    r_cand=r_cand, p_blocks=Pw, blk=self.ivf.block,
-                    quant=self.ivf.quant)
-                from ..common.telemetry import instrument_step
-                fn = instrument_step(fn, site="knn_ivf_plane")
-                self._steps[key] = fn
-            return fn
+        if fn is None:
+            # build + instrument OUTSIDE the lock (ESTP-L02; see the
+            # text plane's _get_pruned_step)
+            fn = build_ivf_knn_step(
+                self.mesh, n_pad=self.n_pad, dim=max(self.dim, 1),
+                k=k, n_shards=self.n_shards,
+                similarity=self.similarity, nprobe=nprobe,
+                r_cand=r_cand, p_blocks=Pw, blk=self.ivf.block,
+                quant=self.ivf.quant)
+            from ..common.telemetry import instrument_step
+            fn = instrument_step(fn, site="knn_ivf_plane")
+            with self._steps_lock:
+                fn = self._steps.setdefault(key, fn)
+        return fn
 
     def search_ivf_host(self, query_vectors, k: int = 10, *, nprobe: int,
                         rerank: int, stages: Optional[dict] = None):

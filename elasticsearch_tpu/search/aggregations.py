@@ -1411,9 +1411,16 @@ class HistogramAgg(BucketAggregator):
                 ops_aggs.histogram_bucket_ids(seg, self.field, self.interval,
                                               self.offset)
             if ids_dev is not None and n_buckets:
+                # static kernel shape rounds up through the shape
+                # lattice (ESTP-J04): n_buckets is data-dependent (value
+                # span / interval), and an unbucketed value compiles a
+                # fresh one-hot kernel per distinct histogram width; the
+                # padding buckets count nothing and are sliced off
+                from ..utils.shapes import round_up_pow2
+                nb_pad = round_up_pow2(n_buckets, 8)
                 counts = np.asarray(ops_aggs.masked_bucket_counts(
                     ids_dev, pdocs_dev, _device_mask(seg, mask),
-                    n_buckets=n_buckets))
+                    n_buckets=nb_pad))[:n_buckets]
                 out = {}
                 for bid in np.flatnonzero(counts):
                     key = (base + bid) * self.interval + self.offset

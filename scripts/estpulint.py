@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""estpulint — project-wide static analysis gate.
+
+Three rule families over ``elasticsearch_tpu/`` (see STATIC_ANALYSIS.md
+for the full rule catalogue):
+
+- ESTP-J* jit-boundary hygiene (host syncs on the device hot path,
+  impure calls inside jit, mutable defaults, unbucketed static shapes);
+- ESTP-L* lock-order safety (acquisition-graph cycles, telemetry under
+  serving locks) — cross-checked at runtime by the lockdep witness
+  (``ES_TPU_LOCKDEP=1``, ``elasticsearch_tpu/common/lockdep.py``);
+- ESTP-C* telemetry-catalogue discipline (registry ↔ TELEMETRY.md ↔
+  health-indicator three-way consistency; the old telemetry_lint).
+
+The gate is ZERO NEW FINDINGS: every finding must either be fixed or
+appear in the checked-in baseline (``ESTPULINT_BASELINE.json``) with a
+one-line justification. Stale baseline entries (fixed findings whose
+entry lingers) warn but do not fail.
+
+Usage:
+  python scripts/estpulint.py                 # full-package scan, gate
+  python scripts/estpulint.py --diff main     # only files changed vs ref
+  python scripts/estpulint.py --rules ESTP-L  # one family
+  python scripts/estpulint.py --no-runtime    # skip the live-registry
+                                              # workload (C01/C02)
+  python scripts/estpulint.py --update-baseline   # rewrite the baseline
+                                                  # from current findings
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO_ROOT, "ESTPULINT_BASELINE.json")
+
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def _changed_files(ref: str):
+    out = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"],
+        cwd=REPO_ROOT, capture_output=True, text=True, check=True).stdout
+    # brand-new files are part of "what changed" for pre-commit purposes
+    # but invisible to `git diff REF` until tracked
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=REPO_ROOT, capture_output=True, text=True, check=True).stdout
+    return {line.strip() for line in (out + untracked).splitlines()
+            if line.strip()}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=BASELINE,
+                    help="baseline file (default ESTPULINT_BASELINE.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(existing justifications are preserved)")
+    ap.add_argument("--diff", metavar="REF",
+                    help="report only findings in files changed vs the "
+                         "git ref (the project model is still built "
+                         "whole); skips the runtime catalogue workload "
+                         "unless telemetry surfaces changed")
+    ap.add_argument("--rules", action="append", default=None,
+                    metavar="PREFIX",
+                    help="rule-id prefix filter (repeatable), e.g. "
+                         "ESTP-J or ESTP-L01")
+    ap.add_argument("--no-runtime", action="store_true",
+                    help="skip the live-registry catalogue workload "
+                         "(ESTP-C01/C02); static rules still run")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also list baselined (matched) findings")
+    args = ap.parse_args(argv)
+
+    from elasticsearch_tpu.devtools import analyzer
+
+    if args.update_baseline and (args.diff or args.rules or
+                                 args.no_runtime):
+        # a filtered scan sees only a SUBSET of findings; rewriting the
+        # baseline from it would silently erase every out-of-scope
+        # entry (and its justification)
+        print("--update-baseline requires a full unfiltered scan "
+              "(drop --diff/--rules/--no-runtime)", file=sys.stderr)
+        return 2
+
+    report_files = None
+    runtime = not args.no_runtime
+    if args.diff:
+        changed = _changed_files(args.diff)
+        report_files = {p for p in changed if p.endswith(".py")}
+        # the runtime workload only gates telemetry surfaces — skip it
+        # in diff mode unless one of those (or the catalogue itself)
+        # changed; when it does run, its findings anchor to
+        # TELEMETRY.md, which must then be in the report set or they
+        # would be filtered out unseen
+        telem_surfaces = {"elasticsearch_tpu/common/telemetry.py",
+                          "elasticsearch_tpu/common/health.py",
+                          "elasticsearch_tpu/common/lockdep.py",
+                          "elasticsearch_tpu/devtools/rules_catalogue.py",
+                          "TELEMETRY.md"}
+        if runtime:
+            runtime = bool(changed & telem_surfaces)
+        if runtime:
+            report_files.add("TELEMETRY.md")
+
+    findings = analyzer.scan_project(
+        REPO_ROOT, rules=tuple(args.rules) if args.rules else None,
+        runtime=runtime, report_files=report_files)
+
+    baseline = analyzer.load_baseline(args.baseline)
+    new, matched, stale = analyzer.compare_with_baseline(findings, baseline)
+
+    if args.update_baseline:
+        justs = {(d.get("rule"), d.get("file"), d.get("symbol", ""),
+                  d.get("detail", "")): d.get("justification")
+                 for d in baseline}
+        analyzer.save_baseline(args.baseline, findings, justs)
+        print(f"baseline rewritten: {len(findings)} findings -> "
+              f"{os.path.relpath(args.baseline, REPO_ROOT)}")
+        return 0
+
+    for f in new:
+        print(f"NEW {f.render()}")
+    if args.verbose:
+        for f in matched:
+            print(f"baselined {f.render()}")
+    if stale and report_files is None and not args.rules:
+        # a stale entry only means something when every rule ran over
+        # the whole tree — under --diff/--rules the filtered-out
+        # entries all look stale
+        print(f"note: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (finding fixed; run "
+              f"--update-baseline to drop):", file=sys.stderr)
+        for d in stale:
+            print(f"  [{d.get('rule')}] {d.get('file')} "
+                  f"{d.get('symbol')}: {d.get('detail')}", file=sys.stderr)
+    if new:
+        print(f"estpulint: {len(new)} NEW finding"
+              f"{'' if len(new) == 1 else 's'} "
+              f"({len(matched)} baselined). Fix them or justify in "
+              f"{os.path.relpath(args.baseline, REPO_ROOT)}.",
+              file=sys.stderr)
+        return 1
+    scope = f"{len(report_files)} changed files" if report_files is not None \
+        else "full package"
+    print(f"estpulint OK ({scope}): 0 new findings, "
+          f"{len(matched)} baselined")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,164 +1,32 @@
 #!/usr/bin/env python
-"""Telemetry catalogue lint: runtime registry vs TELEMETRY.md.
+"""Telemetry catalogue lint — thin shim over estpulint rule family 3.
 
-The TELEMETRY.md metric catalogue drifted once already (the
-``es_plane_swap_ms`` row shipped without its ``kind`` label). This lint
-makes drift a CI failure instead of a doc bug:
-
-1. drives a miniature workload through the real stack (RestAPI + index
-   + plane search + forced jitted dispatch + repack) so every metric
-   family the engine can register at runtime actually registers;
-2. snapshots the process registry (``telemetry.DEFAULT.stats_doc()``);
-3. parses every backticked ``es_*`` family name out of TELEMETRY.md;
-4. fails when a runtime family is undocumented, or a documented family
-   can neither be produced by the workload nor explained by the
-   CONDITIONAL allowlist below.
-
-Run directly (``python scripts/telemetry_lint.py``) or through the
-tier-1 suite (``tests/test_task_resources.py::test_telemetry_lint``).
+The original standalone lint grew into the analyzer's catalogue rules
+(``elasticsearch_tpu/devtools/rules_catalogue.py``, ESTP-C01/C02/C03 —
+run them all via ``scripts/estpulint.py``). This entry point survives
+for operator muscle memory and for the tier-1 test that invokes it
+(``tests/test_task_resources.py::test_telemetry_lint``): same workload,
+same output contract, same exit code.
 """
 
 from __future__ import annotations
 
-import json
 import os
-import re
 import sys
-import tempfile
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from elasticsearch_tpu.devtools.rules_catalogue import (     # noqa: E402
+    CONDITIONAL, documented_families, runtime_families)
+from elasticsearch_tpu.devtools.rules_catalogue import main as _main  # noqa: E402,E501
+
 TELEMETRY_MD = os.path.join(REPO_ROOT, "TELEMETRY.md")
-
-#: documented families the lint workload cannot produce, with the reason
-#: they are still correct documentation
-CONDITIONAL = {
-    # registered only on cluster fronts (ARS EWMAs need peers)
-    "es_adaptive_selection_response_seconds":
-        "cluster fronts only (adaptive replica selection)",
-}
-
-_NAME_RE = re.compile(r"`(es_[a-z0-9_]+)`")
-
-
-def documented_families(path: str = TELEMETRY_MD) -> set:
-    with open(path) as f:
-        text = f.read()
-    return set(_NAME_RE.findall(text))
-
-
-def runtime_families() -> set:
-    """Register every producible family by exercising the real stack."""
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    if REPO_ROOT not in sys.path:
-        sys.path.insert(0, REPO_ROOT)
-    from elasticsearch_tpu.common import telemetry
-    from elasticsearch_tpu.node.indices_service import IndicesService
-    from elasticsearch_tpu.rest.api import RestAPI
-
-    with tempfile.TemporaryDirectory() as d:
-        api = RestAPI(IndicesService(d))
-        api.handle("PUT", "/lint", "", json.dumps(
-            {"mappings": {"properties": {
-                "body": {"type": "text"},
-                "vec": {"type": "dense_vector", "dims": 4}}}}).encode())
-        api.handle("PUT", "/lint/_doc/1", "refresh=true", json.dumps(
-            {"body": "quick brown fox", "vec": [1, 0, 0, 0]}).encode())
-        # text plane dispatch (+ latency family with exemplar)
-        api.handle("POST", "/lint/_search", "", json.dumps(
-            {"query": {"match": {"body": "quick"}}}).encode())
-        # plane-path request cache hit/miss counters
-        api.handle("POST", "/lint/_search", "", json.dumps(
-            {"query": {"match": {"body": "quick"}}}).encode())
-        # kNN plane dispatch
-        api.handle("POST", "/lint/_search", "", json.dumps(
-            {"knn": {"field": "vec", "query_vector": [1, 0, 0, 0],
-                     "k": 1, "num_candidates": 5}}).encode())
-        # delta tier + sync repack path (delta-serve + rebuild families)
-        svc = api.indices.get("lint")
-        svc.plane_cache.repack_mode = "sync"
-        # force the block-max tier onto the repacked generation so the
-        # es_lex_* families register: a pruned dispatch (track_total_hits
-        # bounded → prune defaults on) and an explicit prune=off (the
-        # drift counter the plane_serving health indicator reads)
-        svc.plane_cache.lex_prune_min_docs = 1
-        api.handle("PUT", "/lint/_doc/2", "refresh=true", json.dumps(
-            {"body": "quick red fox"}).encode())
-        api.handle("POST", "/lint/_search", "", json.dumps(
-            {"query": {"match": {"body": "quick"}}}).encode())
-        # second delta doc pushes past REPACK_DELTA_FRACTION: the sync
-        # repack folds the delta into a fresh base that now carries the
-        # block-max tier (lex_prune_min_docs=1 above)
-        api.handle("PUT", "/lint/_doc/3", "refresh=true", json.dumps(
-            {"body": "quick blue fox"}).encode())
-        api.handle("POST", "/lint/_search", "request_cache=false",
-                   json.dumps({"query": {"match": {"body": "quick"}},
-                               "track_total_hits": 10}).encode())
-        api.handle("POST", "/lint/_search", "request_cache=false",
-                   json.dumps({"query": {"match": {"body": "quick"}},
-                               "prune": False}).encode())
-        # forced jitted dispatch so the XLA compile/transfer families
-        # register even on the CPU test backend (host-eager otherwise)
-        import numpy as np
-        from elasticsearch_tpu.parallel import (DistributedSearchPlane,
-                                                make_search_mesh)
-        from elasticsearch_tpu.utils.synth import synthetic_csr_corpus_fast
-        import jax
-        rng = np.random.RandomState(7)
-        corpus = synthetic_csr_corpus_fast(rng, 128, 64, 8, zipf_s=1.2)
-        corpus["term_ids"] = {f"t{t}": t for t in range(64)}
-        mesh = make_search_mesh(n_shards=1, n_replicas=1,
-                                devices=jax.devices()[:1])
-        plane = DistributedSearchPlane(mesh, [corpus], field="body")
-        plane._host_csr = None
-        plane.serve([["t1"]], k=4, with_totals=True)
-        # IVF (cluster-pruned ANN) dispatch: registers the es_ann_*
-        # families (clusters probed / candidates re-ranked / bytes per
-        # tier), plus the nprobe-below-default drift counter the
-        # plane_serving health indicator reads
-        from elasticsearch_tpu.parallel.dist_search import \
-            DistributedKnnPlane
-        kvecs = rng.randn(256, 8).astype(np.float32)
-        kplane = DistributedKnnPlane(
-            mesh, [dict(vectors=kvecs)], similarity="cosine",
-            ivf=dict(nlist=8, seed=0))
-        kplane.serve(np.zeros((2, 8), np.float32), k=3)
-        kplane.serve(np.zeros((1, 8), np.float32), k=3, nprobe=1)
-
-        snap = telemetry.DEFAULT.stats_doc()
-        return {name for name in snap if name.startswith("es_")}
 
 
 def main() -> int:
-    documented = documented_families()
-    runtime = runtime_families()
-    rc = 0
-    undocumented = sorted(runtime - documented)
-    if undocumented:
-        rc = 1
-        print("UNDOCUMENTED runtime families (add TELEMETRY.md rows):",
-              file=sys.stderr)
-        for n in undocumented:
-            print(f"  {n}", file=sys.stderr)
-    stale = sorted(documented - runtime - set(CONDITIONAL))
-    if stale:
-        rc = 1
-        print("STALE documented families (never registered by the lint "
-              "workload; remove the row or add a CONDITIONAL entry with "
-              "a reason):", file=sys.stderr)
-        for n in stale:
-            print(f"  {n}", file=sys.stderr)
-    phantom = sorted(set(CONDITIONAL) & runtime)
-    if phantom:
-        # informational only: the process-scoped registry may carry
-        # families from OTHER stacks in this process (a cluster test
-        # that ran earlier in the same pytest session) — documented +
-        # registered is never drift
-        print("note: CONDITIONAL families present in this process: "
-              + ", ".join(phantom))
-    if rc == 0:
-        print(f"telemetry lint OK: {len(runtime)} runtime families "
-              f"match TELEMETRY.md ({len(CONDITIONAL)} conditional)")
-    return rc
+    return _main(REPO_ROOT)
 
 
 if __name__ == "__main__":

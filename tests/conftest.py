@@ -20,6 +20,16 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Opt-in runtime lockdep witness (ES_TPU_LOCKDEP=1): wrap the package's
+# lock factories BEFORE any package module creates its module-level
+# locks, so the whole tier-1 suite runs under observed lock-order
+# checking and any inversion raises at the acquisition site (see
+# STATIC_ANALYSIS.md — the runtime half of the ESTP-L01 cross-check).
+if os.environ.get("ES_TPU_LOCKDEP", "0").lower() in ("1", "true"):
+    from elasticsearch_tpu.common import lockdep as _lockdep
+
+    _lockdep.install()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,316 @@
+"""Runtime lockdep witness (``common/lockdep.py``): seeded inversions
+must raise, clean hierarchies must not, the Condition protocol must
+survive wrapping, and the evidence must land in the ``es_lockdep_*``
+telemetry families. The ES_TPU_LOCKDEP=1 end-to-end path (factory
+install at conftest time + package-created locks) runs in a
+subprocess so patching the threading factories never leaks into the
+suite's own process."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from elasticsearch_tpu.common import lockdep                 # noqa: E402
+
+
+def _pair(w):
+    return (lockdep.WitnessLock(w, "lock-A"),
+            lockdep.WitnessLock(w, "lock-B"))
+
+
+def test_seeded_inversion_raises():
+    w = lockdep.Witness(raise_on_inversion=True)
+    a, b = _pair(w)
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(lockdep.LockOrderInversion) as ei:
+            a.acquire()
+    msg = str(ei.value)
+    assert "lock-A" in msg and "lock-B" in msg
+    # the failed acquisition must not leave the underlying lock held
+    assert not a.locked()
+    rep = w.report()
+    assert len(rep["inversions"]) == 1
+    assert rep["inversions"][0]["while_holding"] == "lock-B"
+
+
+def test_record_mode_collects_without_raising():
+    w = lockdep.Witness(raise_on_inversion=False)
+    a, b = _pair(w)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:          # inverted, but only recorded
+            pass
+    assert len(w.report()["inversions"]) == 1
+
+
+def test_record_mode_recurring_pair_counts_without_flooding():
+    """A hot recurring inversion pair must bump the monotonic counter on
+    every detection but occupy ONE evidence slot — a second distinct
+    inversion found later must still fit in the ring."""
+    w = lockdep.Witness(raise_on_inversion=False)
+    a, b = _pair(w)
+    with a:
+        with b:
+            pass
+    for _ in range(5):
+        with b:
+            with a:
+                pass
+    c = lockdep.WitnessLock(w, "lock-C")
+    with b:
+        with c:
+            pass
+    with c:
+        with b:          # a second, distinct inverting pair
+            pass
+    rep = w.report()
+    assert rep["inversion_count"] == 6
+    assert len(rep["inversions"]) == 2
+    pairs = {(d["acquiring"], d["while_holding"]): d["count"]
+             for d in rep["inversions"]}
+    assert pairs[("lock-A", "lock-B")] == 5
+    assert pairs[("lock-B", "lock-C")] == 1
+
+
+def test_transitive_inversion_through_third_lock():
+    w = lockdep.Witness(raise_on_inversion=True)
+    a = lockdep.WitnessLock(w, "A")
+    b = lockdep.WitnessLock(w, "B")
+    c = lockdep.WitnessLock(w, "C")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with pytest.raises(lockdep.LockOrderInversion):
+            a.acquire()      # C -> A closes A -> B -> C
+
+
+def test_consistent_order_and_same_name_nesting_pass():
+    w = lockdep.Witness(raise_on_inversion=True)
+    a, b = _pair(w)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    # same-node nesting (two instances of one lock class) is a
+    # hierarchy, not an inversion — neither the static rule nor the
+    # witness can order instances
+    x1 = lockdep.WitnessLock(w, "same-class")
+    x2 = lockdep.WitnessLock(w, "same-class")
+    with x1:
+        with x2:
+            pass
+    assert not w.report()["inversions"]
+
+
+def test_cross_thread_order_is_global():
+    """The order graph is process-global: thread 1 establishes A→B,
+    thread 2's B→A attempt must trip."""
+    w = lockdep.Witness(raise_on_inversion=True)
+    a, b = _pair(w)
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join()
+    caught = []
+
+    def t2():
+        try:
+            with b:
+                with a:
+                    pass
+        except lockdep.LockOrderInversion as e:
+            caught.append(e)
+
+    th2 = threading.Thread(target=t2)
+    th2.start()
+    th2.join()
+    assert len(caught) == 1
+
+
+def test_rlock_reentrancy_no_false_edges():
+    w = lockdep.Witness(raise_on_inversion=True)
+    r = lockdep.WitnessRLock(w, "R")
+    with r:
+        with r:                      # reentrant: no self-edge
+            pass
+    assert not w.edges
+    assert w.report()["max_held_depth"] == 1
+
+
+def test_condition_over_witnessed_lock_wait_notify():
+    """The microbatcher pattern: two Conditions over one witnessed Lock;
+    wait() must drop and re-take the witness bookkeeping with the
+    lock."""
+    w = lockdep.Witness(raise_on_inversion=True)
+    lk = lockdep.WitnessLock(w, "shared")
+    cond = threading.Condition(lk)
+    work = threading.Condition(lk)
+    hit = []
+
+    def consumer():
+        with cond:
+            while not hit:
+                cond.wait(timeout=2.0)
+
+    th = threading.Thread(target=consumer)
+    th.start()
+    time.sleep(0.05)
+    with work:
+        hit.append(1)
+        cond.notify_all()
+    th.join(timeout=3)
+    assert not th.is_alive()
+    # waiting released the hold: the main thread could acquire, and no
+    # thread still holds it
+    assert not lk.locked()
+    assert not w.report()["inversions"]
+
+
+def test_condition_over_witnessed_rlock():
+    w = lockdep.Witness()
+    r = lockdep.WitnessRLock(w, "R")
+    cond = threading.Condition(r)
+
+    def waker():
+        time.sleep(0.05)
+        with cond:
+            cond.notify()
+
+    th = threading.Thread(target=waker)
+    th.start()
+    with cond:
+        cond.wait(timeout=2.0)
+    th.join()
+    assert not w.report()["inversions"]
+
+
+def test_hold_depth_and_hold_time_evidence():
+    w = lockdep.Witness()
+    a = lockdep.WitnessLock(w, "A")
+    b = lockdep.WitnessLock(w, "B")
+    c = lockdep.WitnessLock(w, "C")
+    with a:
+        with b:
+            with c:
+                time.sleep(0.02)
+    rep = w.report()
+    assert rep["max_held_depth"] == 3
+    assert rep["longest_hold_ms"] >= 15.0
+    assert rep["acquisitions"] == 3
+    assert rep["locks_witnessed"] == 3
+
+
+def test_telemetry_families_register():
+    """Satellite: the witness stamps depth/hold/inversion evidence into
+    the registry (es_lockdep_*, TELEMETRY.md-catalogued, and therefore
+    covered by estpulint rule family 3)."""
+    from elasticsearch_tpu.common import telemetry
+    outer = lockdep.witness_lock("tele-outer")
+    inner = lockdep.witness_lock("tele-inner")
+    with outer:
+        with inner:
+            pass
+    snap = telemetry.DEFAULT.stats_doc()
+    for fam in ("es_lockdep_locks_witnessed",
+                "es_lockdep_acquisitions_total",
+                "es_lockdep_max_held_depth",
+                "es_lockdep_longest_hold_millis",
+                "es_lockdep_inversions_total"):
+        assert fam in snap, f"missing {fam}"
+    depth = snap["es_lockdep_max_held_depth"]["series"][0]["value"]
+    assert depth >= 2
+    acqs = snap["es_lockdep_acquisitions_total"]["series"][0]["value"]
+    assert acqs >= 2
+
+
+_E2E_SNIPPET = """
+    import os, sys, threading
+    sys.path.insert(0, {root!r})
+    os.environ["ES_TPU_LOCKDEP"] = "1"
+    from elasticsearch_tpu.common import lockdep
+    assert lockdep.install()
+
+    # locks created by PACKAGE code get witnessed: reimport a module
+    # with a module-level lock under the installed factories
+    for m in [m for m in sys.modules if m.startswith(
+            "elasticsearch_tpu.search")]:
+        del sys.modules[m]
+    from elasticsearch_tpu.search import microbatch
+    assert type(microbatch._CREATE_LOCK).__name__ == "WitnessLock"
+    # stdlib callers stay on the real primitive
+    assert type(threading.Lock()).__name__ == "lock"
+
+    # seeded inversion through package-created locks
+    from elasticsearch_tpu.node.task_manager import TaskResources
+    r1 = TaskResources()
+    r2 = TaskResources()
+    a, b = r1._lock, r2._lock
+    assert type(a).__name__ == "WitnessLock"
+    other = lockdep.witness_lock("seed-peer")
+    with a:
+        with other:
+            pass
+    try:
+        with other:
+            with b:      # same node as a: other->node vs node->other
+                pass
+    except lockdep.LockOrderInversion:
+        print("E2E_INVERSION_CAUGHT")
+    else:
+        print("E2E_NO_RAISE")
+"""
+
+
+def test_e2e_install_catches_seeded_inversion_under_env():
+    """ES_TPU_LOCKDEP=1 end to end: install at bootstrap, witness locks
+    created by real package modules, raise on a seeded inversion."""
+    code = textwrap.dedent(_E2E_SNIPPET).format(root=REPO_ROOT)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=dict(os.environ, ES_TPU_LOCKDEP="1", JAX_PLATFORMS="cpu"),
+        timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "E2E_INVERSION_CAUGHT" in proc.stdout, \
+        f"witness missed the seeded inversion:\n{proc.stdout}\n" \
+        f"{proc.stderr}"
+
+
+def test_install_respects_env_gate():
+    code = textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, {root!r})
+        os.environ.pop("ES_TPU_LOCKDEP", None)
+        from elasticsearch_tpu.common import lockdep
+        assert lockdep.install() is False
+        assert not lockdep.installed()
+        print("GATED_OK")
+    """).format(root=REPO_ROOT)
+    env = {k: v for k, v in os.environ.items() if k != "ES_TPU_LOCKDEP"}
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, env=env,
+                          timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "GATED_OK" in proc.stdout

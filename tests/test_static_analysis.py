@@ -1,0 +1,529 @@
+"""estpulint: fixture-driven per-rule tests + the tier-1 full-package
+gate.
+
+Each rule family gets known-bad snippets that MUST flag and known-good
+twins that MUST NOT (the analyzer is conservative by design — a rule
+that can't tell stays silent). The full-package scan runs as a
+subprocess so its registry workload sees a clean process (the in-suite
+process registry carries families from every test that ran before it),
+mirroring how operators run ``scripts/estpulint.py``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from elasticsearch_tpu.devtools import analyzer, rules_catalogue, \
+    rules_jit, rules_locks                                  # noqa: E402
+
+
+def _project(tmp_path, files):
+    """Build a Project from {relpath: source} fixture files."""
+    rels = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        rels.append(rel)
+    return analyzer.Project.from_root(str(tmp_path), rels)
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# ESTP-J01: host sync reachable from a device hot path
+# ---------------------------------------------------------------------------
+
+
+def test_j01_host_sync_in_hot_path_flags(tmp_path):
+    proj = _project(tmp_path, {"plane.py": """
+        import jax
+
+        def _helper(x):
+            return x.item()
+
+        def serve(queries):
+            out = _helper(queries)
+            return out
+    """})
+    fs = rules_jit.check(proj)
+    j01 = [f for f in fs if f.rule == "ESTP-J01"]
+    assert len(j01) == 1
+    assert j01[0].symbol == "_helper"
+    assert "serve" in j01[0].message          # names the root chain
+
+
+def test_j01_tainted_step_output_conversions(tmp_path):
+    proj = _project(tmp_path, {"plane.py": """
+        import jax
+        import numpy as np
+
+        def build_foo_step(k):
+            def step(x):
+                return x
+            return jax.jit(step)
+
+        def serve(xs, k):
+            step = build_foo_step(k)
+            out = step(xs)
+            if out:                      # implicit __bool__ on tracer-typed
+                pass
+            v = float(out)               # elementwise host sync
+            a = np.asarray(out)          # d2h fetch
+            return v, a
+    """})
+    j01 = [f for f in rules_jit.check(proj) if f.rule == "ESTP-J01"]
+    details = " | ".join(f.detail for f in j01)
+    assert "implicit bool()" in details
+    assert "float() on step output" in details
+    assert "np.asarray() on step output" in details
+
+
+def test_j01_quiet_off_hot_path(tmp_path):
+    proj = _project(tmp_path, {"codec.py": """
+        def encode(o):
+            return o.item()              # REST edge, not a hot path
+    """})
+    assert not [f for f in rules_jit.check(proj)
+                if f.rule == "ESTP-J01"]
+
+
+# ---------------------------------------------------------------------------
+# ESTP-J02/J03: impure calls + mutable defaults inside jit
+# ---------------------------------------------------------------------------
+
+
+def test_j02_impure_calls_in_jit_flag(tmp_path):
+    proj = _project(tmp_path, {"kern.py": """
+        import time, random
+        import jax
+
+        @jax.jit
+        def step(x):
+            t = time.time()
+            r = random.random()
+            return x + t + r
+
+        def good(x):
+            return time.time()           # host side: fine
+    """})
+    j02 = [f for f in rules_jit.check(proj) if f.rule == "ESTP-J02"]
+    assert {f.symbol for f in j02} == {"step"}
+    assert len(j02) == 2
+
+
+def test_j02_jit_wrapped_function_detected(tmp_path):
+    proj = _project(tmp_path, {"kern.py": """
+        import time
+        import jax
+
+        def build_x_step():
+            def step(x):
+                time.sleep(0.1)
+                return x
+            return jax.jit(step)
+    """})
+    j02 = [f for f in rules_jit.check(proj) if f.rule == "ESTP-J02"]
+    assert len(j02) == 1 and j02[0].symbol == "build_x_step.step"
+
+
+def test_j03_mutable_default_in_jit(tmp_path):
+    proj = _project(tmp_path, {"kern.py": """
+        import jax
+
+        @jax.jit
+        def bad(x, acc=[]):
+            return x
+
+        def plain(x, acc=[]):            # not jitted: out of scope
+            return x
+    """})
+    j03 = [f for f in rules_jit.check(proj) if f.rule == "ESTP-J03"]
+    assert len(j03) == 1 and j03[0].symbol == "bad"
+
+
+# ---------------------------------------------------------------------------
+# ESTP-J04: unbucketed static shapes at step call sites
+# ---------------------------------------------------------------------------
+
+
+def test_j04_raw_len_flags_and_bucketed_passes(tmp_path):
+    proj = _project(tmp_path, {"caller.py": """
+        from shapes import round_up_pow2
+
+        def _get_step(Q, k):
+            pass
+
+        def bad(xs):
+            return _get_step(len(xs), 10)
+
+        def good(xs):
+            q = round_up_pow2(len(xs))
+            return _get_step(q, 10)
+    """, "shapes.py": """
+        def round_up_pow2(n, minimum=8):
+            return n
+    """})
+    j04 = [f for f in rules_jit.check(proj) if f.rule == "ESTP-J04"]
+    assert len(j04) == 1 and j04[0].symbol == "bad"
+
+
+def test_j04_opaque_static_argnames_provenance(tmp_path):
+    # the pre-fix aggregations shape: n_buckets tuple-unpacked from a
+    # data-dependent call, fed to a static_argnames kernel unbucketed
+    proj = _project(tmp_path, {"aggs.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n_buckets",))
+        def bucket_counts(ids, *, n_buckets):
+            return ids
+
+        def histogram_bucket_ids(seg):
+            return None, None, 7, 0.0
+    """, "collect.py": """
+        import aggs
+        from shapes import round_up_pow2
+
+        def bad(seg):
+            ids, docs, n_buckets, base = aggs.histogram_bucket_ids(seg)
+            return aggs.bucket_counts(ids, n_buckets=n_buckets)
+
+        def good(seg):
+            ids, docs, n_buckets, base = aggs.histogram_bucket_ids(seg)
+            nb = round_up_pow2(n_buckets)
+            return aggs.bucket_counts(ids, n_buckets=nb)
+    """, "shapes.py": """
+        def round_up_pow2(n, minimum=8):
+            return n
+    """})
+    j04 = [f for f in rules_jit.check(proj) if f.rule == "ESTP-J04"]
+    assert len(j04) == 1 and j04[0].symbol == "bad"
+    assert "n_buckets" in j04[0].detail
+
+
+# ---------------------------------------------------------------------------
+# ESTP-L01: lock-order cycles
+# ---------------------------------------------------------------------------
+
+
+def test_l01_direct_cycle_flags(tmp_path):
+    proj = _project(tmp_path, {"mod.py": """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with B:
+                with A:
+                    pass
+    """})
+    l01 = [f for f in rules_locks.check(proj) if f.rule == "ESTP-L01"]
+    assert len(l01) == 1
+    assert "mod:A" in l01[0].detail and "mod:B" in l01[0].detail
+
+
+def test_l01_cycle_through_call_edge(tmp_path):
+    proj = _project(tmp_path, {"mod.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._x = threading.Lock()
+                self._y = threading.Lock()
+
+            def takes_y(self):
+                with self._y:
+                    pass
+
+            def f(self):
+                with self._x:
+                    self.takes_y()      # x -> y via call edge
+
+            def g(self):
+                with self._y:
+                    with self._x:       # y -> x directly
+                        pass
+    """})
+    l01 = [f for f in rules_locks.check(proj) if f.rule == "ESTP-L01"]
+    assert len(l01) == 1
+
+
+def test_l01_consistent_order_passes(tmp_path):
+    proj = _project(tmp_path, {"mod.py": """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with A:
+                with B:
+                    pass
+    """})
+    assert not [f for f in rules_locks.check(proj)
+                if f.rule == "ESTP-L01"]
+
+
+def test_l01_condition_aliases_to_shared_lock(tmp_path):
+    # two Conditions over ONE lock are the same node — nesting them via
+    # their attribute names must NOT fabricate a 2-lock cycle
+    proj = _project(tmp_path, {"mod.py": """
+        import threading
+
+        class B:
+            def __init__(self):
+                _lock = threading.Lock()
+                self._cond = threading.Condition(_lock)
+                self._work = threading.Condition(_lock)
+
+            def f(self):
+                with self._cond:
+                    pass
+
+            def g(self):
+                with self._work:
+                    pass
+    """})
+    edges, _facts, _acq, table = rules_locks.build_lock_graph(proj)
+    n_cond = table.class_attrs["mod:B"]["_cond"]
+    n_work = table.class_attrs["mod:B"]["_work"]
+    assert n_cond == n_work            # one underlying node
+    assert not rules_locks.find_cycles(edges)
+
+
+# ---------------------------------------------------------------------------
+# ESTP-L02: telemetry under a serving lock
+# ---------------------------------------------------------------------------
+
+
+_L02_FILES = {
+    "search/microbatch.py": """
+        import threading
+        from common.telemetry import record_compile
+
+        class Batcher:
+            def __init__(self):
+                self._gen_lock = threading.Lock()
+                self._metric_lock = threading.Lock()
+
+            def bad(self):
+                with self._gen_lock:
+                    record_compile("s", (1,), 1.0)
+
+            def good(self):
+                with self._gen_lock:
+                    x = 1
+                record_compile("s", (1,), 1.0)
+
+            def metric_side(self):
+                with self._metric_lock:      # metric locks are exempt
+                    record_compile("s", (1,), 1.0)
+    """,
+    "common/telemetry.py": """
+        def record_compile(site, shape, ms):
+            pass
+    """,
+}
+
+
+def test_l02_telemetry_under_serving_lock(tmp_path):
+    proj = _project(tmp_path, _L02_FILES)
+    l02 = [f for f in rules_locks.check(proj) if f.rule == "ESTP-L02"]
+    assert len(l02) == 1 and l02[0].symbol == "Batcher.bad"
+
+
+def test_l02_transitive_through_helper(tmp_path):
+    files = dict(_L02_FILES)
+    files["search/microbatch.py"] = """
+        import threading
+        from common.telemetry import record_compile
+
+        def _emit():
+            record_compile("s", (1,), 1.0)
+
+        class Batcher:
+            def __init__(self):
+                self._gen_lock = threading.Lock()
+
+            def bad(self):
+                with self._gen_lock:
+                    _emit()              # reaches telemetry transitively
+    """
+    proj = _project(tmp_path, files)
+    l02 = [f for f in rules_locks.check(proj) if f.rule == "ESTP-L02"]
+    assert len(l02) == 1 and l02[0].symbol == "Batcher.bad"
+
+
+# ---------------------------------------------------------------------------
+# ESTP-C03 (static catalogue rule)
+# ---------------------------------------------------------------------------
+
+
+def test_c03_unknown_family_in_health_text(tmp_path):
+    (tmp_path / "TELEMETRY.md").write_text(
+        "| `es_real_family_total` | counter |\n")
+    proj = _project(tmp_path, {"common/health.py": """
+        KNOWN = "watch es_real_family_total for trouble"
+        BROKEN = "watch es_phantom_family_total instead"
+    """})
+    c03 = [f for f in rules_catalogue.check(proj, runtime=False)
+           if f.rule == "ESTP-C03"]
+    assert len(c03) == 1
+    assert "es_phantom_family_total" in c03[0].detail
+
+
+def test_c03_quiet_when_documented(tmp_path):
+    (tmp_path / "TELEMETRY.md").write_text("`es_a_total` `es_b_total`\n")
+    proj = _project(tmp_path, {"common/health.py": """
+        MSG = "es_a_total and es_b_total"
+    """})
+    assert not rules_catalogue.check(proj, runtime=False)
+
+
+# ---------------------------------------------------------------------------
+# Baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_identity_survives_line_drift(tmp_path):
+    f = analyzer.Finding("ESTP-J01", "a.py", 10, "f", "d", "msg")
+    moved = analyzer.Finding("ESTP-J01", "a.py", 99, "f", "d", "msg")
+    base = [f.doc()]
+    new, matched, stale = analyzer.compare_with_baseline([moved], base)
+    assert not new and not stale and matched == [moved]
+
+
+def test_baseline_new_and_stale(tmp_path):
+    known = analyzer.Finding("ESTP-L01", "a.py", 1, "g", "cycle", "m")
+    fresh = analyzer.Finding("ESTP-L02", "b.py", 2, "h", "tele", "m")
+    base = [known.doc(),
+            {"rule": "ESTP-J03", "file": "gone.py", "symbol": "x",
+             "detail": "fixed"}]
+    new, matched, stale = analyzer.compare_with_baseline(
+        [known, fresh], base)
+    assert new == [fresh]
+    assert matched == [known]
+    assert len(stale) == 1 and stale[0]["file"] == "gone.py"
+
+
+# ---------------------------------------------------------------------------
+# The real package: lock graph + the tier-1 gate
+# ---------------------------------------------------------------------------
+
+
+def test_serving_lock_graph_is_cycle_free():
+    """The acceptance invariant: the static lock-order graph over the
+    whole package — microbatch dispatchers, plane_route repack/swap,
+    the task ledger included — has no cycle."""
+    proj = analyzer.Project.from_root(REPO_ROOT)
+    edges, _facts, _acq, table = rules_locks.build_lock_graph(proj)
+    cycles = rules_locks.find_cycles(edges)
+    assert cycles == [], f"lock-order cycles: {cycles}"
+    # sanity: the model is not vacuous — the graph has real edges
+    # (cluster_rest's mutex hierarchy at minimum) and the lock table
+    # covers the serving modules; their critical sections being
+    # edge-free (leaf-level, nothing nested inside) is exactly the
+    # healthy state this test pins
+    assert edges, "lock graph is empty — extraction broke"
+    node_mods = set(table.node_module.values())
+    for mod in ("elasticsearch_tpu.search.microbatch",
+                "elasticsearch_tpu.search.plane_route",
+                "elasticsearch_tpu.node.task_manager"):
+        assert mod in node_mods, f"no locks modeled in {mod}"
+
+
+def test_known_serving_locks_are_modeled():
+    """The lock table must see the locks the ISSUE names — dispatcher
+    bucket locks, repack/swap locks, the ledger locks — or the
+    cycle-free assertion above proves nothing."""
+    proj = analyzer.Project.from_root(REPO_ROOT)
+    table = rules_locks.build_lock_table(proj)
+    mb = table.class_attrs[
+        "elasticsearch_tpu.search.microbatch:PlaneMicroBatcher"]
+    assert mb["_cond"] == mb["_work"]        # conditions share one lock
+    pr = table.class_attrs[
+        "elasticsearch_tpu.search.plane_route:ServingPlaneCache"]
+    assert "_gen_lock" in pr and "_metric_lock" in pr
+    tm = table.class_attrs[
+        "elasticsearch_tpu.node.task_manager:TaskManager"]
+    assert "lock" in tm and "_res_lock" in tm
+
+
+def test_full_package_scan_matches_baseline():
+    """Tier-1 gate: the full scan (runtime catalogue workload included)
+    exits 0 against the checked-in baseline — zero new findings."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "estpulint.py")],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=600)
+    assert proc.returncode == 0, \
+        f"estpulint drifted:\n{proc.stdout}\n{proc.stderr}"
+    assert "0 new findings" in proc.stdout
+
+
+def test_baseline_entries_are_justified():
+    with open(os.path.join(REPO_ROOT, "ESTPULINT_BASELINE.json")) as f:
+        doc = json.load(f)
+    assert doc["findings"], "baseline exists and is non-trivial"
+    for entry in doc["findings"]:
+        just = entry.get("justification", "")
+        assert just and "TODO" not in just, \
+            f"unjustified baseline entry: {entry}"
+
+
+def test_diff_mode_restricts_report(tmp_path):
+    """--diff semantics at the API level: whole-project model, findings
+    filtered to the changed-file set."""
+    proj_files = {
+        "mod_a.py": """
+            import threading
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def f():
+                with A:
+                    with B:
+                        pass
+        """,
+        "mod_b.py": """
+            import threading
+            from mod_a import A, B
+
+            def g():
+                with B:
+                    with A:
+                        pass
+        """,
+    }
+    for rel, src in proj_files.items():
+        (tmp_path / rel).write_text(textwrap.dedent(src))
+    all_f = analyzer.scan_project(
+        str(tmp_path), files=list(proj_files), runtime=False)
+    only_a = analyzer.scan_project(
+        str(tmp_path), files=list(proj_files), runtime=False,
+        report_files={"mod_a.py"})
+    assert {f.file for f in only_a} <= {"mod_a.py"}
+    assert len(only_a) <= len(all_f)
