@@ -91,12 +91,18 @@ class CircuitBreaker:
         return _R()
 
     def stats(self) -> dict:
+        with self.lock:
+            # snapshot under the breaker's own lock: used/trip_count
+            # are read-modify-written under it, and an off-lock stats
+            # read is a torn view during a concurrent add/release
+            # (ESTP-R01)
+            used, tripped = self.used, self.trip_count
         return {"limit_size_in_bytes": self.limit,
                 "limit_size": _h(self.limit),
-                "estimated_size_in_bytes": self.used,
-                "estimated_size": _h(self.used),
+                "estimated_size_in_bytes": used,
+                "estimated_size": _h(used),
                 "overhead": self.overhead,
-                "tripped": self.trip_count}
+                "tripped": tripped}
 
 
 class ParentBreaker:
@@ -105,15 +111,25 @@ class ParentBreaker:
     def __init__(self, limit: int):
         self.limit = limit
         self.trip_count = 0
+        #: guards trip_count — the children guard their own `used`;
+        #: check() is called from every allocating thread concurrently
+        #: and `trip_count += 1` is a lost-update race without it
+        #: (ESTP-R01)
+        self.lock = threading.Lock()
         self.children: Dict[str, CircuitBreaker] = {}
 
     def total_used(self) -> int:
-        return sum(c.used for c in self.children.values())
+        total = 0
+        for c in list(self.children.values()):
+            with c.lock:        # sequential per-child, never nested
+                total += c.used
+        return total
 
     def check(self, label: str) -> None:
         total = self.total_used()
         if total > self.limit:
-            self.trip_count += 1
+            with self.lock:
+                self.trip_count += 1
             raise CircuitBreakingError(
                 f"[parent] Data too large, data for [{label}] would be "
                 f"[{total}/{_h(total)}], which is larger than the limit "
@@ -121,12 +137,15 @@ class ParentBreaker:
                 f"[{total}], new bytes reserved: [0]")
 
     def stats(self) -> dict:
+        with self.lock:
+            tripped = self.trip_count
+        total = self.total_used()
         return {"limit_size_in_bytes": self.limit,
                 "limit_size": _h(self.limit),
-                "estimated_size_in_bytes": self.total_used(),
-                "estimated_size": _h(self.total_used()),
+                "estimated_size_in_bytes": total,
+                "estimated_size": _h(total),
                 "overhead": 1.0,
-                "tripped": self.trip_count}
+                "tripped": tripped}
 
 
 class BreakerService:

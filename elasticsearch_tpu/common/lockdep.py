@@ -63,6 +63,13 @@ _REAL_RLOCK = threading.RLock
 #: bounded inversion evidence ring
 _MAX_INVERSIONS = 64
 
+#: (on_acquire(name), on_release(name)) observer pairs — the racedep
+#: happens-before witness (``common/racedep.py``) registers here so one
+#: set of wrapped factories feeds both witnesses. Hooks fire on EVERY
+#: acquire/release call (including reentrant ones) so observers see a
+#: balanced event stream; they must never raise.
+RACE_HOOKS: List[Tuple] = []
+
 
 class LockOrderInversion(RuntimeError):
     """Observed acquisition closes a cycle in the lock-order graph."""
@@ -116,6 +123,11 @@ class Witness:
         return st
 
     def on_acquire(self, lock: "_WitnessBase") -> None:
+        for acq, _rel in RACE_HOOKS:
+            try:
+                acq(lock.name)
+            except Exception:   # noqa: BLE001 — observers are evidence,
+                pass            # never control flow
         st = self._stack()
         lid = id(lock)
         for h in st:
@@ -134,6 +146,11 @@ class Witness:
             self.max_held_depth = len(st)
 
     def on_release(self, lock: "_WitnessBase") -> None:
+        for _acq, rel in RACE_HOOKS:
+            try:
+                rel(lock.name)
+            except Exception:   # noqa: BLE001
+                pass
         st = self._stack()
         lid = id(lock)
         for i in range(len(st) - 1, -1, -1):

@@ -34,6 +34,23 @@ def _unparse(node: ast.AST) -> str:
         return f"<{type(node).__name__}>"
 
 
+def assign_target_names(target: ast.AST) -> List[str]:
+    """Every plain name bound by an assignment target, through
+    arbitrarily nested tuple/list/starred destructuring
+    (``(a, b), *rest = ...``)."""
+    out: List[str] = []
+    todo = [target]
+    while todo:
+        t = todo.pop()
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            todo.extend(t.elts)
+        elif isinstance(t, ast.Starred):
+            todo.append(t.value)
+    return out
+
+
 def scoped_walk(node: ast.AST):
     """``ast.walk`` confined to one function's own execution scope:
     nested function/class bodies and lambda bodies are NOT descended
@@ -313,9 +330,12 @@ class Project:
 
     @classmethod
     def from_root(cls, root: str,
-                  files: Optional[Sequence[str]] = None) -> "Project":
+                  files: Optional[Sequence[str]] = None,
+                  cache=None) -> "Project":
         """``files``: repo-relative .py paths; default = every .py under
-        :data:`DEFAULT_SCAN_DIRS`."""
+        :data:`DEFAULT_SCAN_DIRS`. ``cache``: an optional
+        :class:`model_cache.ModelCache` — unchanged files (same
+        mtime/size) skip re-parsing."""
         proj = cls(root)
         if files is None:
             files = []
@@ -327,18 +347,30 @@ class Project:
                             files.append(os.path.relpath(
                                 os.path.join(dirpath, n), root))
         for rel in sorted(files):
-            proj.add_file(rel)
+            proj.add_file(rel, cache=cache)
         proj._link_jit_wrappers()
         return proj
 
-    def add_file(self, relpath: str) -> Optional[ModuleInfo]:
+    def add_file(self, relpath: str, cache=None) -> Optional[ModuleInfo]:
         path = os.path.join(self.root, relpath)
-        try:
-            with open(path) as f:
-                source = f.read()
-            tree = ast.parse(source, filename=relpath)
-        except (OSError, SyntaxError):
-            return None
+        cached = cache.load(self.root, relpath) if cache is not None \
+            else None
+        if cached is not None:
+            source, tree = cached
+        else:
+            # stat BEFORE reading: a write landing mid-parse then keys
+            # the entry to the old stat, which the next scan misses —
+            # never a stale tree served under the new file's key
+            stat = cache.stat_key(self.root, relpath) \
+                if cache is not None else None
+            try:
+                with open(path) as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=relpath)
+            except (OSError, SyntaxError):
+                return None
+            if cache is not None and stat is not None:
+                cache.store(self.root, relpath, source, tree, key=stat)
         dotted = relpath[:-3].replace(os.sep, "/").replace("/", ".")
         if dotted.endswith(".__init__"):
             dotted = dotted[: -len(".__init__")]
@@ -510,7 +542,8 @@ class Project:
 def scan_project(root: str, files: Optional[Sequence[str]] = None,
                  rules: Optional[Iterable[str]] = None,
                  runtime: bool = True,
-                 report_files: Optional[Set[str]] = None) -> List[Finding]:
+                 report_files: Optional[Set[str]] = None,
+                 cache=None) -> List[Finding]:
     """Run every selected rule family over the project at ``root``.
 
     ``rules``: rule-id prefixes to keep (``{"ESTP-J"}``, ``{"ESTP-L01"}``;
@@ -518,9 +551,12 @@ def scan_project(root: str, files: Optional[Sequence[str]] = None,
     registry workload (its static cross-checks still run).
     ``report_files``: when given (``--diff`` mode), only findings in
     those repo-relative files are reported — the project model is still
-    built whole so cross-module rules see the full graph."""
-    from . import rules_catalogue, rules_jit, rules_locks
-    project = Project.from_root(root, files)
+    built whole so cross-module rules see the full graph. ``cache``: an
+    optional :class:`model_cache.ModelCache` so unchanged files skip
+    re-parsing (cached and cold scans are asserted identical in
+    tests)."""
+    from . import rules_catalogue, rules_jit, rules_locks, rules_races
+    project = Project.from_root(root, files, cache=cache)
     prefixes = tuple(rules) if rules is not None else None
     if prefixes and not any(p.startswith("ESTP-C") or
                             "ESTP-C".startswith(p) for p in prefixes):
@@ -528,6 +564,7 @@ def scan_project(root: str, files: Optional[Sequence[str]] = None,
     findings: List[Finding] = []
     findings += rules_jit.check(project)
     findings += rules_locks.check(project)
+    findings += rules_races.check(project)
     findings += rules_catalogue.check(project, runtime=runtime)
     if prefixes is not None:
         findings = [f for f in findings if f.rule.startswith(prefixes)]

@@ -1,0 +1,100 @@
+"""Parsed-model cache for estpulint: skip re-parsing unchanged files.
+
+A full scan parses ~180 files; pre-commit ``--diff`` runs re-parse all
+of them to rebuild the cross-module call graph even when two files
+changed. This cache keys each file's parsed ``ast.Module`` (plus its
+source text) on ``(mtime_ns, size)`` and stores it pickled under
+``.estpulint_cache/`` — a warm scan re-parses only files whose stat
+changed. Correctness is pinned by
+``tests/test_static_analysis.py::test_model_cache_scan_identical``:
+the cold and cached scans must produce identical findings.
+
+The cache holds PARSE artifacts only — the project model (functions,
+classes, call graph) is rebuilt from the trees every scan, so a rule or
+model change never reads stale analysis through a warm cache; bumping
+:data:`CACHE_VERSION` invalidates everything when the *parse* contract
+itself changes. Unreadable/corrupt entries fall back to a plain parse.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import pickle
+from typing import Optional, Tuple
+
+#: bump to invalidate every cached entry (pickle layout / parse contract)
+CACHE_VERSION = 1
+
+CACHE_DIR_NAME = ".estpulint_cache"
+
+
+class ModelCache:
+    """One directory of ``<sha1(relpath)>.pkl`` entries, each
+    ``(CACHE_VERSION, mtime_ns, size, source, tree)``."""
+
+    def __init__(self, cache_dir: str):
+        self.cache_dir = cache_dir
+        self.hits = 0
+        self.misses = 0
+
+    def _entry_path(self, relpath: str) -> str:
+        h = hashlib.sha1(relpath.encode()).hexdigest()
+        return os.path.join(self.cache_dir, f"{h}.pkl")
+
+    @staticmethod
+    def _stat_key(path: str) -> Optional[Tuple[int, int]]:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return (st.st_mtime_ns, st.st_size)
+
+    def load(self, root: str, relpath: str) \
+            -> Optional[Tuple[str, ast.Module]]:
+        """(source, tree) when the cached entry matches the file's
+        current stat, else None."""
+        key = self._stat_key(os.path.join(root, relpath))
+        if key is None:
+            return None
+        try:
+            with open(self._entry_path(relpath), "rb") as f:
+                ver, mtime_ns, size, source, tree = pickle.load(f)
+        except Exception:   # noqa: BLE001 — any corrupt/absent entry
+            self.misses += 1        # is just a cold parse
+            return None
+        if ver != CACHE_VERSION or (mtime_ns, size) != key:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return source, tree
+
+    def stat_key(self, root: str, relpath: str) -> Optional[Tuple[int, int]]:
+        """The (mtime_ns, size) key for ``relpath`` NOW — callers grab it
+        BEFORE reading the file and pass it to :meth:`store`, so a write
+        landing between read and store can only produce a key mismatch
+        (a harmless warm-scan miss), never a stale entry served under
+        the new file's key."""
+        return self._stat_key(os.path.join(root, relpath))
+
+    def store(self, root: str, relpath: str, source: str,
+              tree: ast.Module,
+              key: Optional[Tuple[int, int]] = None) -> None:
+        if key is None:
+            key = self._stat_key(os.path.join(root, relpath))
+        if key is None:
+            return
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            tmp = self._entry_path(relpath) + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump((CACHE_VERSION, key[0], key[1], source, tree),
+                            f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self._entry_path(relpath))
+        except Exception:   # noqa: BLE001 — a read-only checkout must
+            pass            # still scan; the cache is best-effort
+
+
+def default_cache(root: str) -> ModelCache:
+    return ModelCache(os.path.join(root, CACHE_DIR_NAME))

@@ -146,6 +146,13 @@ def runtime_families() -> Set[str]:
         with outer:
             with inner:
                 pass
+        # racedep witness: register the es_racedep_* evidence families
+        # the same deterministic way — collector + one tracked access
+        # pair (single-threaded: records evidence, never a candidate)
+        from elasticsearch_tpu.common import racedep
+        racedep.ensure_collector()
+        racedep.WITNESS.access(("lint-race-key", 0), write=True)
+        racedep.WITNESS.access(("lint-race-key", 0), write=False)
 
         snap = telemetry.DEFAULT.stats_doc()
         return {name for name in snap if name.startswith("es_")}

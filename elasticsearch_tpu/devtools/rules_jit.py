@@ -39,7 +39,7 @@ import re
 from typing import Dict, List, Optional, Set
 
 from .analyzer import (Finding, FunctionInfo, Project, _unparse,
-                       scoped_walk)
+                       assign_target_names, scoped_walk)
 
 RULE_J01 = "ESTP-J01"
 RULE_J02 = "ESTP-J02"
@@ -79,15 +79,7 @@ def _names_in(node: ast.AST) -> Set[str]:
 def _assign_targets(node: ast.Assign) -> List[str]:
     out: List[str] = []
     for t in node.targets:
-        if isinstance(t, ast.Name):
-            out.append(t.id)
-        elif isinstance(t, (ast.Tuple, ast.List)):
-            for e in t.elts:
-                if isinstance(e, ast.Name):
-                    out.append(e.id)
-                elif isinstance(e, ast.Starred) and \
-                        isinstance(e.value, ast.Name):
-                    out.append(e.value.id)
+        out.extend(assign_target_names(t))
     return out
 
 
@@ -115,36 +107,61 @@ def _root_chain(parent: Dict[str, Optional[str]], fqn: str) -> str:
     return " -> ".join(names[:4] + (["…"] if len(names) > 4 else []))
 
 
+def _mentions_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    """True when ``expr`` is a pure re-binding of tainted data: a
+    tainted name, a subscript/starred of one, or a tuple/list of those
+    (``scores, idx = out``; ``scores = out[0]``). Calls are deliberately
+    excluded — ``len(out)`` yields a host int, not a device array."""
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(expr, ast.Subscript):
+        return _mentions_tainted(expr.value, tainted)
+    if isinstance(expr, ast.Starred):
+        return _mentions_tainted(expr.value, tainted)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return any(_mentions_tainted(e, tainted) for e in expr.elts)
+    return False
+
+
 def _tainted_names(project: Project, fn: FunctionInfo) -> Set[str]:
     """Names in ``fn`` bound (directly or through a step-callable local)
     to the result of a jitted call — device-array-typed values whose
-    host conversion is a sync."""
+    host conversion is a sync. Taint flows through tuple/starred
+    destructuring (including nested targets) and plain re-bindings:
+    ``out = step(xs); scores, idx = out; s0 = scores[0]`` taints all
+    four names."""
     step_locals: Set[str] = set()
     tainted: Set[str] = set()
     assigns = sorted(
-        (n for n in scoped_walk(fn.node)
-         if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call)),
+        (n for n in scoped_walk(fn.node) if isinstance(n, ast.Assign)),
         key=lambda n: n.lineno)
     # pass 1: which locals hold a jitted callable (step getters)
     for node in assigns:
+        if not isinstance(node.value, ast.Call):
+            continue
         targets = _assign_targets(node)
         if targets and any(project.functions[t].returns_jitted
                            for t in project.resolve_call(fn, node.value)):
             step_locals.update(targets)
-    # pass 2: which locals hold a jitted call's RESULT (device arrays)
+    # pass 2 (in program order): locals holding a jitted call's RESULT
+    # (device arrays), plus re-bindings/destructurings of those
     for node in assigns:
-        call = node.value
         targets = _assign_targets(node)
         if not targets:
             continue
-        resolved = project.resolve_call(fn, call)
-        if any(project.functions[t].returns_jitted for t in resolved):
-            continue
-        is_jit_result = any(project.functions[t].jitted for t in resolved)
-        if not is_jit_result and isinstance(call.func, ast.Name) and \
-                call.func.id in step_locals:
-            is_jit_result = True
-        if is_jit_result:
+        val = node.value
+        if isinstance(val, ast.Call):
+            resolved = project.resolve_call(fn, val)
+            if any(project.functions[t].returns_jitted for t in resolved):
+                continue        # a step getter, not a step result
+            is_jit_result = any(project.functions[t].jitted
+                                for t in resolved)
+            if not is_jit_result and isinstance(val.func, ast.Name) and \
+                    val.func.id in step_locals:
+                is_jit_result = True
+            if is_jit_result:
+                tainted.update(targets)
+        elif _mentions_tainted(val, tainted):
             tainted.update(targets)
     return tainted
 

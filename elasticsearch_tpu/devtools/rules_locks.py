@@ -56,12 +56,24 @@ TELEMETRY_MODULES = re.compile(r"(^|\.)common\.(telemetry|tracing)$")
 
 _LOCK_CTORS = {"Lock", "RLock"}
 
+#: saved-real-factory aliases (``_REAL_LOCK``/``_REAL_RLOCK``,
+#: ``_thread.allocate_lock``) — the witness modules deliberately build
+#: their own mutexes from the unwrapped primitives; they are still
+#: locks to the analysis
+_LOCK_ALIAS_RE = re.compile(r"(?:^|_)R?LOCK$", re.IGNORECASE)
+
 
 def _is_lock_ctor(call: ast.Call) -> Optional[str]:
     f = call.func
     name = f.attr if isinstance(f, ast.Attribute) else \
         f.id if isinstance(f, ast.Name) else None
-    return name if name in _LOCK_CTORS or name == "Condition" else None
+    if name is None:
+        return None
+    if name in _LOCK_CTORS or name == "Condition":
+        return name
+    if _LOCK_ALIAS_RE.search(name):
+        return "RLock"
+    return None
 
 
 class LockTable:

@@ -1,0 +1,688 @@
+"""Rule family 4 — lockset data-race analysis (ESTP-R*/T*).
+
+PR 8's lock rules keep the acquisition graph cycle-free — lock
+*ordering*. Nothing checked lock *coverage*: the package now has at
+least six long-lived thread roots (micro-batch dispatcher threads, the
+background repack/warmup threads, engine refresh listeners, the health
+fan-in executor, the monitoring collector, REST handler threads)
+sharing mutable plane/cache/stats state, and a data race there corrupts
+results silently instead of deadlocking loudly. This family is the
+Eraser-style static half (the runtime half is ``common/racedep.py``,
+the happens-before witness under ``ES_TPU_RACEDEP=record|raise``):
+
+- **ESTP-R01 unguarded-shared-state** — an attribute (``self.<attr>``
+  with a declaration site, or a ``global``-declared module var)
+  reachable from ≥2 distinct thread roots, written outside
+  ``__init__``, whose access sites have an EMPTY lockset intersection:
+  no single lock protects every access, so two roots can interleave
+  mid-update.
+- **ESTP-R02 check-then-act** — guarded state read under lock L inside
+  one function, then written later in the same function after L was
+  released: the decision made under the lock is stale by the time the
+  write lands (the classic lost-update shape).
+- **ESTP-T01 unjoined-thread-lifecycle** — a thread/executor started in
+  ``__init__``/``start``/``open`` of a class that has no
+  close/stop/shutdown/release-like method joining or shutting it down:
+  the thread outlives its owner and keeps touching freed state.
+
+Thread-root discovery walks the project model for
+``threading.Thread(target=...)``, ``<executor>.submit(fn, ...)``,
+listener registrations (``*listener*.append(self._cb)``) and telemetry
+collector registrations (``register_collector``/
+``register_object_collector``), plus the synthetic REQUEST root (every
+function named ``handle`` — the REST edge, served by a thread pool).
+Each root's reachable set comes from the shared conservative call graph.
+
+Lockset inference reuses the ESTP-L lock table (declaration-site lock
+nodes, ``module:Class.attr`` identity, Condition aliasing) and adds
+entry-lockset propagation: the locks a function is guaranteed to hold
+on entry are the INTERSECTION over all its static call sites of (locks
+held at the site ∪ the caller's own entry set) — a lock counts as
+covering an access only when it is held on EVERY path, so the rule
+under-approximates coverage and over-approximates races; benign races
+(monotonic flags, double-checked creation) are baselined with
+justifications rather than silenced in code.
+
+Known limits (conservative, documented): accesses through unresolvable
+receivers contribute no site; lambdas are invisible roots; per-instance
+disjointness (two instances never shared) is not modeled — instance
+identity is the declaration site, same as the lock rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .analyzer import Finding, FunctionInfo, Project
+from .rules_locks import LockTable, build_lock_table, resolve_lock_expr
+
+RULE_R01 = "ESTP-R01"
+RULE_R02 = "ESTP-R02"
+RULE_T01 = "ESTP-T01"
+
+#: the synthetic request root: REST handler threads all enter here
+REQUEST_ROOT_NAMES = {"handle"}
+
+#: spawn method names ESTP-T01 treats as owner lifecycle starts
+_T01_SPAWN_METHODS = {"__init__", "start", "open"}
+
+#: method-name prefixes that count as the owner's teardown surface
+_T01_CLOSE_RE = re.compile(
+    r"^(close|stop|shutdown|release|drain|join|__exit__|__del__|retire)")
+
+#: attribute method calls that MUTATE the receiver (a write access)
+_MUTATORS = {
+    "append", "extend", "add", "update", "pop", "popitem", "clear",
+    "remove", "discard", "insert", "setdefault", "move_to_end",
+    "appendleft", "popleft", "sort", "reverse",
+}
+
+#: receiver attrs that look like listener/callback registries
+_LISTENER_ATTR_RE = re.compile(r"listener|callback|hook")
+
+_COLLECTOR_REG_NAMES = {"register_collector", "register_object_collector"}
+
+
+# ---------------------------------------------------------------------------
+# Shared-state table (mirror of rules_locks.LockTable for plain attrs)
+# ---------------------------------------------------------------------------
+
+
+class StateTable:
+    """Every mutable-state declaration site: ``self.<attr> = ...``
+    anywhere in a class (excluding lock/Condition attrs — those are the
+    guards, not the guarded) and module globals rebound through a
+    ``global`` statement."""
+
+    def __init__(self):
+        #: class_fqn -> {attr: state_id}
+        self.class_attrs: Dict[str, Dict[str, str]] = {}
+        #: (module_dotted, var) -> state_id
+        self.module_vars: Dict[Tuple[str, str], str] = {}
+        #: attr -> {state_id} (unique-name fallback for non-self receivers)
+        self.attr_index: Dict[str, Set[str]] = {}
+
+
+def owner_class(project: Project, fn: FunctionInfo) -> Optional[str]:
+    """The class whose instance ``self`` names inside ``fn`` — the
+    direct class for methods, the ENCLOSING method's class for closures
+    nested in a method (``self`` is a closure cell there: the repack
+    thread bodies, the warmup thunk)."""
+    if fn.class_fqn:
+        return fn.class_fqn
+    parts = fn.qual.split(".")
+    for i in range(len(parts) - 1, 0, -1):
+        cand = f"{fn.module.dotted}:" + ".".join(parts[:i])
+        if cand in project.classes:
+            return cand
+    return None
+
+
+def build_state_table(project: Project, locks: LockTable) -> StateTable:
+    table = StateTable()
+    lock_ids: Set[str] = set(locks.node_module)
+    for fn in project.functions.values():
+        cls = owner_class(project, fn)
+        if cls is None:
+            continue
+        cls_qual = cls.split(":", 1)[1]
+        lock_attrs = locks.class_attrs.get(cls, {})
+        for node in ast.walk(fn.node):
+            tgt = None
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        tgt = t
+                        break
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) and \
+                    isinstance(node.target, ast.Attribute) and \
+                    isinstance(node.target.value, ast.Name) and \
+                    node.target.value.id == "self":
+                tgt = node.target
+            if tgt is None:
+                continue
+            attr = tgt.attr
+            if attr in lock_attrs:
+                continue        # guards are not guarded state
+            sid = f"{fn.module.dotted}:{cls_qual}.{attr}"
+            if sid in lock_ids:
+                continue
+            table.class_attrs.setdefault(cls, {})[attr] = sid
+            table.attr_index.setdefault(attr, set()).add(sid)
+    for mod in project.modules.values():
+        module_names = {
+            s.targets[0].id for s in mod.tree.body
+            if isinstance(s, ast.Assign) and len(s.targets) == 1 and
+            isinstance(s.targets[0], ast.Name)}
+        for fn in project.functions.values():
+            if fn.module is not mod:
+                continue
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Global):
+                    for name in node.names:
+                        if name in module_names and \
+                                (mod.dotted, name) not in locks.module_locks:
+                            sid = f"{mod.dotted}:{name}"
+                            table.module_vars[(mod.dotted, name)] = sid
+                            table.attr_index.setdefault(name, set()) \
+                                .add(sid)
+    return table
+
+
+def _attr_of(node: ast.AST) -> Optional[Tuple[ast.AST, str]]:
+    """(receiver expr, attr name) for an attribute access — plain
+    ``x.attr`` or ``getattr(x, "attr"[, default])``."""
+    if isinstance(node, ast.Attribute):
+        return node.value, node.attr
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "getattr" and len(node.args) >= 2 and \
+            isinstance(node.args[1], ast.Constant) and \
+            isinstance(node.args[1].value, str):
+        return node.args[0], node.args[1].value
+    return None
+
+
+def resolve_state_expr(project: Project, table: StateTable,
+                       fn: FunctionInfo, receiver: ast.AST,
+                       attr: str) -> Optional[str]:
+    """State id of ``receiver.attr`` — ``self`` through the (possibly
+    enclosing) class, everything else through the unique-attr fallback,
+    mirroring lock resolution so the two tables line up."""
+    if isinstance(receiver, ast.Name) and receiver.id in ("self", "cls"):
+        cls = owner_class(project, fn)
+        seen: Set[str] = set()
+        while cls is not None and cls not in seen:
+            seen.add(cls)
+            hit = table.class_attrs.get(cls, {}).get(attr)
+            if hit:
+                return hit
+            ci = project.classes.get(cls)
+            if ci is None or not ci.bases:
+                return None
+            bci = project._resolve_class(ci.bases[0].split(".")[-1],
+                                         ci.module)
+            cls = bci.fqn if bci is not None else None
+        return None
+    # unique-attr fallback, PRIVATE attrs only: a public name like
+    # ``used`` collides with foreign objects (shutil's disk_usage) and
+    # would invent cross-class races
+    if attr.startswith("_"):
+        cands = table.attr_index.get(attr, ())
+        if len(cands) == 1:
+            return next(iter(cands))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Thread-root discovery
+# ---------------------------------------------------------------------------
+
+
+def _resolve_func_ref(project: Project, fn: FunctionInfo,
+                      expr: ast.AST) -> Optional[str]:
+    """A function REFERENCE (not a call): ``target=_run``,
+    ``pool.submit(self._apply)``, ``listeners.append(self._on_refresh)``."""
+    if isinstance(expr, ast.Name):
+        parts = fn.qual.split(".")
+        for i in range(len(parts), -1, -1):
+            cand = f"{fn.module.dotted}:" + \
+                ".".join(parts[:i] + [expr.id]) if i else \
+                f"{fn.module.dotted}:{expr.id}"
+            if cand in project.functions:
+                return cand
+        tgt = fn.module.imports.get(expr.id)
+        if tgt and "." in tgt:
+            m, _, attr = tgt.rpartition(".")
+            cand = f"{m}:{attr}"
+            if cand in project.functions:
+                return cand
+        return None
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+            cls = owner_class(project, fn)
+            if cls is not None:
+                m = project._mro_methods(project.classes[cls]) \
+                    if cls in project.classes else {}
+                return m.get(expr.attr)
+            return None
+        if isinstance(base, ast.Name):
+            # Class.method (register_object_collector style) or module.fn
+            ci = project._resolve_class(base.id, fn.module)
+            if ci is not None:
+                return project._mro_methods(ci).get(expr.attr)
+            tgt = fn.module.imports.get(base.id)
+            if tgt and tgt in project.modules:
+                cand = f"{tgt}:{expr.attr}"
+                if cand in project.functions:
+                    return cand
+    return None
+
+
+class ThreadRoot:
+    __slots__ = ("fqn", "kind", "site")
+
+    def __init__(self, fqn: str, kind: str, site: str):
+        self.fqn = fqn          # entry function
+        self.kind = kind        # thread | executor | listener | request
+        self.site = site        # "file:line" of the spawn/registration
+
+    @property
+    def display(self) -> str:
+        return f"{self.kind}:{self.fqn.split(':', 1)[1]}"
+
+
+def discover_thread_roots(project: Project) -> List[ThreadRoot]:
+    roots: Dict[str, ThreadRoot] = {}
+
+    def add(fqn: Optional[str], kind: str, fn: FunctionInfo,
+            line: int) -> None:
+        if fqn is None or fqn in roots:
+            return
+        roots[fqn] = ThreadRoot(fqn, kind,
+                                f"{fn.module.relpath}:{line}")
+
+    for fn in project.functions.values():
+        for cs in fn.calls:
+            call = cs.node
+            callee = call.func
+            name = callee.attr if isinstance(callee, ast.Attribute) else \
+                callee.id if isinstance(callee, ast.Name) else None
+            if name == "Thread":
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        add(_resolve_func_ref(project, fn, kw.value),
+                            "thread", fn, call.lineno)
+            elif name == "submit" and isinstance(callee, ast.Attribute) \
+                    and call.args:
+                add(_resolve_func_ref(project, fn, call.args[0]),
+                    "executor", fn, call.lineno)
+            elif name == "append" and isinstance(callee, ast.Attribute) \
+                    and isinstance(callee.value, ast.Attribute) and \
+                    _LISTENER_ATTR_RE.search(callee.value.attr) and \
+                    call.args:
+                add(_resolve_func_ref(project, fn, call.args[0]),
+                    "listener", fn, call.lineno)
+            elif name in _COLLECTOR_REG_NAMES and call.args:
+                # last arg is the producer (fn for register_collector,
+                # Class.method for register_object_collector)
+                add(_resolve_func_ref(project, fn, call.args[-1]),
+                    "listener", fn, call.lineno)
+    for fqn, fn in project.functions.items():
+        if fn.name in REQUEST_ROOT_NAMES and fqn not in roots:
+            roots[fqn] = ThreadRoot(fqn, "request",
+                                    f"{fn.module.relpath}:{fn.line}")
+    return list(roots.values())
+
+
+def roots_reaching(project: Project, roots: List[ThreadRoot]) \
+        -> Dict[str, Set[str]]:
+    """fn fqn → set of root fqns whose reachable set contains it."""
+    out: Dict[str, Set[str]] = {}
+    for r in roots:
+        for fqn in project.reachable_from([r.fqn]):
+            out.setdefault(fqn, set()).add(r.fqn)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Access-site scan + entry-lockset propagation
+# ---------------------------------------------------------------------------
+
+
+class AccessSite:
+    __slots__ = ("state", "kind", "held", "fn", "line")
+
+    def __init__(self, state: str, kind: str, held: Tuple[str, ...],
+                 fn: FunctionInfo, line: int):
+        self.state = state
+        self.kind = kind        # "r" | "w"
+        self.held = held        # locally-held lock nodes (static path)
+        self.fn = fn
+        self.line = line
+
+
+class _FnRaceFacts:
+    __slots__ = ("accesses", "calls")
+
+    def __init__(self):
+        self.accesses: List[AccessSite] = []
+        #: (held lock tuple, ast.Call) — EVERY call, for entry-lockset
+        #: propagation (unlike rules_locks, empty-held calls matter here)
+        self.calls: List[Tuple[Tuple[str, ...], ast.Call]] = []
+
+
+def _scan_accesses(project: Project, locks: LockTable, states: StateTable,
+                   fn: FunctionInfo) -> _FnRaceFacts:
+    facts = _FnRaceFacts()
+
+    def state_of(expr: ast.AST) -> Optional[str]:
+        pair = _attr_of(expr)
+        if pair is None:
+            if isinstance(expr, ast.Name):
+                return states.module_vars.get(
+                    (fn.module.dotted, expr.id))
+            return None
+        return resolve_state_expr(project, states, fn, pair[0], pair[1])
+
+    def record(expr: ast.AST, kind: str, held: Tuple[str, ...],
+               line: int) -> None:
+        sid = state_of(expr)
+        if sid is not None:
+            facts.accesses.append(AccessSite(sid, kind, held, fn, line))
+
+    def rec(node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            newly: List[str] = []
+            for item in node.items:
+                rec(item.context_expr, held)
+                lk = resolve_lock_expr(project, locks, fn,
+                                       item.context_expr)
+                if lk is not None:
+                    newly.append(lk)
+            inner = held + tuple(newly)
+            for stmt in node.body:
+                rec(stmt, inner)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                _walk_target(t, held, node.lineno)
+            rec(node.value, held)
+            return
+        if isinstance(node, ast.AugAssign):
+            record(node.target, "w", held, node.lineno)
+            rec(node.value, held)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                tgt = t.value if isinstance(t, ast.Subscript) else t
+                record(tgt, "w", held, node.lineno)
+            return
+        if isinstance(node, ast.Call):
+            facts.calls.append((held, node))
+            pair = _attr_of(node.func) if isinstance(node.func,
+                                                     ast.Attribute) \
+                else None
+            if pair is not None and node.func.attr in _MUTATORS:
+                # self.attr.append(x): mutates the attr's value
+                record(pair[0], "w", held, node.lineno)
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id == "getattr":
+                record(node, "r", held, node.lineno)
+        elif isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, ast.Load):
+            record(node, "r", held, node.lineno)
+        elif isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load):
+            record(node, "r", held, node.lineno)
+        for child in ast.iter_child_nodes(node):
+            rec(child, held)
+
+    def _walk_target(t: ast.AST, held: Tuple[str, ...],
+                     line: int) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                _walk_target(e, held, line)
+            return
+        if isinstance(t, ast.Starred):
+            _walk_target(t.value, held, line)
+            return
+        if isinstance(t, ast.Subscript):
+            # self.attr[k] = v mutates attr's value; also scan the index
+            record(t.value, "w", held, line)
+            rec(t.slice, held)
+            return
+        if isinstance(t, (ast.Attribute, ast.Name)):
+            record(t, "w", held, line)
+
+    for stmt in fn.node.body:
+        rec(stmt, ())
+    return facts
+
+
+def entry_locksets(project: Project,
+                   facts: Dict[str, _FnRaceFacts],
+                   roots: List[ThreadRoot]) -> Dict[str, Set[str]]:
+    """Locks guaranteed held on ENTRY to each function: the intersection
+    over all static call sites of (site-held ∪ caller's entry set).
+    Roots enter with nothing held. Fixpoint from ⊤ (None = not yet
+    constrained)."""
+    entry: Dict[str, Optional[Set[str]]] = {
+        fqn: None for fqn in project.functions}
+    for r in roots:
+        entry[r.fqn] = set()
+    # resolve each call once; the fixpoint then only re-walks tuples
+    resolved: Dict[str, List[Tuple[Tuple[str, ...], Tuple[str, ...]]]] = {}
+    for fqn, f in facts.items():
+        fn = project.functions[fqn]
+        rows = []
+        for held, call in f.calls:
+            tgts = tuple(project.resolve_call(fn, call))
+            if tgts:
+                rows.append((held, tgts))
+        resolved[fqn] = rows
+    changed = True
+    while changed:
+        changed = False
+        for fqn, rows in resolved.items():
+            base = entry.get(fqn)
+            caller_entry = base if base is not None else set()
+            for held, tgts in rows:
+                eff = set(held) | caller_entry
+                for tgt in tgts:
+                    cur = entry.get(tgt)
+                    new = eff if cur is None else (cur & eff)
+                    if new != cur:
+                        entry[tgt] = new
+                        changed = True
+    return {fqn: (s if s is not None else set())
+            for fqn, s in entry.items()}
+
+
+# ---------------------------------------------------------------------------
+# ESTP-R01: empty lockset intersection on multi-root shared state
+# ---------------------------------------------------------------------------
+
+
+def _check_shared_state(project: Project, roots: List[ThreadRoot],
+                        reach: Dict[str, Set[str]],
+                        facts: Dict[str, _FnRaceFacts],
+                        entry: Dict[str, Set[str]]) -> List[Finding]:
+    by_root = {r.fqn: r for r in roots}
+    per_state: Dict[str, List[Tuple[AccessSite, Set[str], Set[str]]]] = {}
+    for fqn, f in facts.items():
+        fn_roots = reach.get(fqn)
+        if not fn_roots:
+            continue
+        fn_entry = entry.get(fqn, set())
+        for a in f.accesses:
+            if a.fn.name in ("__init__", "__new__"):
+                continue        # pre-publication: the object isn't
+            # shared until the constructor returns
+            lockset = set(a.held) | fn_entry
+            per_state.setdefault(a.state, []).append(
+                (a, lockset, fn_roots))
+    findings: List[Finding] = []
+    for state, sites in sorted(per_state.items()):
+        writes = [s for s in sites if s[0].kind == "w"]
+        if not writes:
+            continue
+        all_roots: Set[str] = set()
+        for _, _, rs in sites:
+            all_roots |= rs
+        if len(all_roots) < 2:
+            continue
+        # a race needs a write and another access from a DIFFERENT root
+        write_roots: Set[str] = set()
+        for _, _, rs in writes:
+            write_roots |= rs
+        if len(write_roots) < 2 and \
+                not any(rs - write_roots for _, _, rs in sites):
+            continue
+        common = None
+        for _, lockset, _ in sites:
+            common = lockset if common is None else (common & lockset)
+            if not common:
+                break
+        if common:
+            continue            # every access shares ≥1 lock: guarded
+        w = writes[0][0]
+        unlocked = next((s for s in sites if not s[1]), None)
+        witness = unlocked[0] if unlocked is not None else w
+        root_names = sorted(by_root[r].display for r in all_roots)[:4]
+        findings.append(Finding(
+            RULE_R01, w.fn.module.relpath, w.line, state,
+            "unguarded shared state (empty lockset intersection)",
+            f"shared mutable state [{state}] is reachable from "
+            f"{len(all_roots)} thread roots ({', '.join(root_names)}"
+            f"{', …' if len(all_roots) > 4 else ''}) with ≥1 write but "
+            f"no lock held across every access (e.g. "
+            f"{witness.fn.qual}:{witness.line} accesses it "
+            f"{'unlocked' if unlocked is not None else 'under a disjoint lockset'}) "
+            f"— two roots can interleave mid-update and corrupt it "
+            f"silently; guard every access with one lock or baseline "
+            f"with a benign-race justification"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# ESTP-R02: check-then-act on guarded state
+# ---------------------------------------------------------------------------
+
+
+def _check_check_then_act(project: Project,
+                          facts: Dict[str, _FnRaceFacts],
+                          reach: Dict[str, Set[str]]) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str, str]] = set()
+    for fqn, f in facts.items():
+        if not reach.get(fqn):
+            continue            # single-threaded helpers can't lose the race
+        fn = project.functions[fqn]
+        if fn.name in ("__init__", "__new__"):
+            continue
+        reads = [a for a in f.accesses if a.kind == "r" and a.held]
+        if not reads:
+            continue
+        writes = [a for a in f.accesses if a.kind == "w"]
+        for r in reads:
+            for w in writes:
+                if w.state != r.state or w.line <= r.line:
+                    continue
+                if any(lk in w.held for lk in r.held):
+                    continue    # still holding (or re-holding) the guard
+                key = (fqn, r.state, r.held[0])
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    RULE_R02, fn.module.relpath, w.line, fn.qual,
+                    f"check-then-act on [{r.state}] guarded by "
+                    f"[{r.held[0]}]",
+                    f"[{r.state}] is read under [{r.held[0]}] at line "
+                    f"{r.line} but written at line {w.line} after the "
+                    f"lock is released — the decision is stale by the "
+                    f"time the write lands; widen the critical section "
+                    f"or re-validate under the lock"))
+                break
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# ESTP-T01: thread/executor lifecycle
+# ---------------------------------------------------------------------------
+
+
+def _class_teardown_joins(project: Project, ci) -> bool:
+    """True when any close/stop/shutdown-like method of the class
+    (transitively through same-class calls) calls ``.join()`` /
+    ``.shutdown()`` / ``.cancel()`` or sets a retire/stop flag."""
+    methods = project._mro_methods(ci)
+    todo = [methods[name] for name in methods
+            if _T01_CLOSE_RE.match(name)]
+    seen: Set[str] = set()
+    while todo:
+        cur = todo.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        fn = project.functions.get(cur)
+        if fn is None:
+            continue
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("join", "shutdown", "cancel",
+                                       "retire", "stop", "close"):
+                return True
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            re.search(r"retired|stop|closed|shutdown",
+                                      t.attr):
+                        return True
+        for tgt in project.call_targets(cur):
+            tfn = project.functions.get(tgt)
+            if tfn is not None and tfn.class_fqn == ci.fqn:
+                todo.append(tgt)
+    return False
+
+
+def _check_lifecycle(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str]] = set()
+    for fn in project.functions.values():
+        if fn.name not in _T01_SPAWN_METHODS or not fn.class_fqn:
+            continue
+        spawn = None
+        for cs in fn.calls:
+            callee = cs.node.func
+            name = callee.attr if isinstance(callee, ast.Attribute) else \
+                callee.id if isinstance(callee, ast.Name) else None
+            if name == "Thread" and any(kw.arg == "target"
+                                        for kw in cs.node.keywords):
+                spawn = ("thread", cs.line)
+            elif name and name.endswith("PoolExecutor"):
+                spawn = ("executor", cs.line)
+            if spawn:
+                break
+        if spawn is None:
+            continue
+        ci = project.classes.get(fn.class_fqn)
+        if ci is None or _class_teardown_joins(project, ci):
+            continue
+        key = (fn.class_fqn, spawn[0])
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(Finding(
+            RULE_T01, fn.module.relpath, spawn[1],
+            fn.qual.rsplit(".", 1)[0] or fn.qual,
+            f"{spawn[0]} started in {fn.name} with no join/shutdown "
+            f"on close",
+            f"{ci.name}.{fn.name} starts a {spawn[0]} but no "
+            f"close/stop/shutdown-like method of the class joins or "
+            f"shuts it down — the {spawn[0]} outlives its owner and "
+            f"keeps touching released state; add a teardown that joins "
+            f"(or signals and bounds) it"))
+    return findings
+
+
+def check(project: Project) -> List[Finding]:
+    locks = build_lock_table(project)
+    states = build_state_table(project, locks)
+    roots = discover_thread_roots(project)
+    reach = roots_reaching(project, roots)
+    facts = {fqn: _scan_accesses(project, locks, states, fn)
+             for fqn, fn in project.functions.items()}
+    entry = entry_locksets(project, facts, roots)
+    return (_check_shared_state(project, roots, reach, facts, entry) +
+            _check_check_then_act(project, facts, reach) +
+            _check_lifecycle(project))

@@ -1,0 +1,101 @@
+"""SARIF 2.1.0 export for estpulint findings.
+
+One run, one driver (``estpulint``), one result per finding. Baselined
+findings are emitted with a ``suppressions`` entry (kind
+``external``, justification attached) so CI annotators and editors show
+them struck-through instead of hiding them — the reviewed-intentional
+list stays visible at the line it covers.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .analyzer import Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+#: one-line rule help (the catalogue lives in STATIC_ANALYSIS.md)
+RULE_HELP = {
+    "ESTP-J01": "host synchronization on the device hot path",
+    "ESTP-J02": "impure host call inside jit-compiled code",
+    "ESTP-J03": "mutable default argument on a jit-compiled function",
+    "ESTP-J04": "unbucketed data-dependent static shape at a step call",
+    "ESTP-L01": "lock-order cycle (deadlock under some interleaving)",
+    "ESTP-L02": "telemetry/tracing reachable under a serving lock",
+    "ESTP-R01": "shared mutable state with empty lockset intersection",
+    "ESTP-R02": "check-then-act on guarded state across a lock release",
+    "ESTP-T01": "thread/executor started with no join/shutdown on close",
+    "ESTP-C01": "runtime telemetry family without a TELEMETRY.md row",
+    "ESTP-C02": "documented telemetry family never registered",
+    "ESTP-C03": "health diagnosis references an undocumented family",
+}
+
+
+def to_sarif(findings: Sequence[Finding],
+             baselined: Sequence[Finding],
+             justifications: Optional[Dict[Tuple, str]] = None) -> dict:
+    """``findings`` are NEW (gate-failing) results; ``baselined`` are
+    matched-suppressed ones. Both are emitted — suppressed results carry
+    their baseline justification."""
+    rule_ids = sorted({f.rule for f in list(findings) + list(baselined)})
+    rules = [{"id": rid,
+              "shortDescription": {
+                  "text": RULE_HELP.get(rid, rid)},
+              "helpUri": "STATIC_ANALYSIS.md"}
+             for rid in rule_ids]
+    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
+
+    def result(f: Finding, suppressed: bool) -> dict:
+        doc = {
+            "ruleId": f.rule,
+            "ruleIndex": rule_index[f.rule],
+            "level": "warning" if suppressed else "error",
+            "message": {"text": f"{f.symbol}: {f.message}"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.file,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1)},
+                }}],
+            "partialFingerprints": {
+                # the baseline identity, so re-runs dedupe stably even
+                # as line numbers drift
+                "estpulint/v1": f"{f.rule}|{f.file}|{f.symbol}|{f.detail}",
+            },
+        }
+        if suppressed:
+            just = (justifications or {}).get(f.identity, "")
+            sup = {"kind": "external", "status": "accepted"}
+            if just:
+                sup["justification"] = just
+            doc["suppressions"] = [sup]
+        return doc
+
+    results = [result(f, False) for f in findings] + \
+        [result(f, True) for f in baselined]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "estpulint",
+                "informationUri": "STATIC_ANALYSIS.md",
+                "rules": rules,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:./"}},
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path: str, findings: Sequence[Finding],
+                baselined: Sequence[Finding],
+                justifications: Optional[Dict[Tuple, str]] = None) -> None:
+    with open(path, "w") as f:
+        json.dump(to_sarif(findings, baselined, justifications), f,
+                  indent=1)
+        f.write("\n")

@@ -658,18 +658,11 @@ class IndexService:
         section."""
         from ..search.microbatch import empty_serving_stats
         out = empty_serving_stats()
-        batchers = []
-        for gen in list(getattr(self.plane_cache, "_planes",
-                                {}).values()):
-            b = getattr(gen, "_microbatcher", None)
-            if b is not None:
-                batchers.append(b)
-        for gen in list(getattr(self.plane_cache, "_knn_planes",
-                                {}).values()):
-            b = getattr(gen, "_microbatcher", None)
-            if b is not None:
-                batchers.append(b)
-        for b in batchers:
+        # locked generation snapshot: iterating the registry dicts raw
+        # races the background repack thread's atomic swap — a scrape
+        # mid-swap would die with "dictionary changed size during
+        # iteration" (ESTP-R01, found by the first full race scan)
+        for b in self.plane_cache.serving_batchers():
             doc = b.stats_doc()
             for k, v in doc.items():
                 out[k] = max(out[k], v) if k == "max_batch" else out[k] + v

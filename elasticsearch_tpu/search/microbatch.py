@@ -52,6 +52,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common import racedep
+
 #: upper bound on queries per dispatch — past this the dispatch itself is
 #: long enough that splitting reduces tail latency
 MAX_BATCH = 64
@@ -373,6 +375,7 @@ class PlaneMicroBatcher:
             batch_info["delta_docs"] = int(
                 plane_stages.get("delta_docs", 0))
         with self._cond:
+            racedep.note_write("microbatch.stats", self)
             fetch_ms = fetch_base_ms + \
                 (time.perf_counter() - t_done) * 1e3
             for s in batch:
@@ -422,6 +425,7 @@ class PlaneMicroBatcher:
                 except Exception:   # noqa: BLE001 — warmup must never
                     break           # take down serving
             with self._cond:
+                racedep.note_write("microbatch.stats", self)
                 self.warmed_shapes += n
                 self.warmup_ms += (time.perf_counter() - t0) * 1e3
 
@@ -430,7 +434,11 @@ class PlaneMicroBatcher:
             return None
         t = threading.Thread(target=_run,
                              name=f"plane-warmup-{id(self):x}", daemon=True)
-        self._warmup_thread = t
+        with self._cond:
+            # the handle is written by whichever thread triggers warmup
+            # (request-thread cold build or the repack thread) and read
+            # by stats/tests — same lock as the other batcher state
+            self._warmup_thread = t
         t.start()
         return t
 
@@ -470,6 +478,7 @@ class PlaneMicroBatcher:
     def stats_doc(self) -> Dict[str, int]:
         """Aggregate serving stats (nodes stats ``plane_serving``)."""
         with self._cond:
+            racedep.note_read("microbatch.stats", self)
             out = empty_serving_stats()
             out.update(
                 dispatches=self.n_dispatches, queries=self.n_queries,

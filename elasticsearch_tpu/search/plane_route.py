@@ -65,6 +65,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common import racedep
 from ..index.mapping import MapperService, TextFieldType
 from ..index.segment import Segment
 
@@ -267,6 +268,7 @@ class _ServingGeneration:
     def _swap_delta(self, scorer, key: tuple, base_positions: List[int],
                     ver: int) -> None:
         with self._delta_lock:
+            racedep.note_write("generation.delta", self)
             if ver < self._delta_ver:
                 return          # a newer segment list already swapped in
             self.delta = scorer
@@ -275,11 +277,16 @@ class _ServingGeneration:
             self._base_positions = base_positions
 
     def delta_docs(self) -> int:
-        d = self.delta
+        # under _delta_lock: the repack thread swaps (delta, positions)
+        # as a pair, and a torn read here would size the repack
+        # threshold off a half-swapped generation (ESTP-R01)
+        with self._delta_lock:
+            d = self.delta
         return d.n_docs if d is not None else 0
 
     def _snapshot(self):
         with self._delta_lock:
+            racedep.note_read("generation.delta", self)
             return self.delta, self._base_positions
 
     def _build_delta(self, delta_segs: Sequence[Segment],
@@ -616,6 +623,11 @@ class ServingPlaneCache:
         self.repack_mode = os.environ.get(
             "ES_TPU_PLANE_REPACK_MODE", "background")
         self._gen_lock = threading.RLock()
+        #: guards the lazy mesh singleton — its OWN leaf lock, not
+        #: _gen_lock: the cold build (jax import + device enumeration,
+        #: or an arbitrary user factory) can take seconds and must not
+        #: stall stats scrapes / refresh reconciles on the registry lock
+        self._mesh_lock = threading.Lock()
         self._gen_ver = 0
         self._repacking: set = set()
         self._repack_threads: List[threading.Thread] = []
@@ -695,6 +707,26 @@ class ServingPlaneCache:
 
     # -- shared plumbing -----------------------------------------------------
 
+    def generations(self) -> list:
+        """Locked snapshot of every live serving generation (lexical +
+        kNN). Stats/health surfaces iterate THIS, never the raw dicts —
+        a nodes-stats scrape racing the repack thread's swap would
+        otherwise walk a dict mid-mutation (ESTP-R01, found by the
+        first full scan)."""
+        with self._gen_lock:
+            racedep.note_read("plane_cache.generations", self)
+            return list(self._planes.values()) + \
+                list(self._knn_planes.values())
+
+    def serving_batchers(self) -> list:
+        """The micro-batchers of every live generation (stats rollup)."""
+        out = []
+        for gen in self.generations():
+            b = getattr(gen, "_microbatcher", None)
+            if b is not None:
+                out.append(b)
+        return out
+
     @staticmethod
     def _attach_batcher(plane, knn: bool = False):
         """Pre-create the plane's micro-batcher at plane-build time and
@@ -733,17 +765,27 @@ class ServingPlaneCache:
         self._retire(gen)
 
     def _get_mesh(self):
-        if self._mesh is None:
-            if self._mesh_factory is not None:
-                self._mesh = self._mesh_factory()
-            else:
-                # serving default: the local device. Multi-chip serving uses
-                # a factory wired by the node (mesh over its chips).
-                import jax
-                from .. import parallel as par
-                self._mesh = par.make_search_mesh(
-                    n_shards=1, n_replicas=1, devices=jax.devices()[:1])
-        return self._mesh
+        # under _mesh_lock: a cold request-thread build racing the
+        # background repack would otherwise both see None and build two
+        # meshes (ESTP-R01). Every read goes through the lock too — a
+        # lock-free fast path would empty the static lockset
+        # intersection, and one uncontended acquire is noise next to a
+        # plane build. Leaf lock: nothing inside takes _gen_lock, so
+        # build paths holding _gen_lock nest safely (gen -> mesh only).
+        with self._mesh_lock:
+            if self._mesh is None:
+                if self._mesh_factory is not None:
+                    self._mesh = self._mesh_factory()
+                else:
+                    # serving default: the local device. Multi-chip
+                    # serving uses a factory wired by the node (mesh
+                    # over its chips).
+                    import jax
+                    from .. import parallel as par
+                    self._mesh = par.make_search_mesh(
+                        n_shards=1, n_replicas=1,
+                        devices=jax.devices()[:1])
+            return self._mesh
 
     def _next_ver(self) -> int:
         with self._gen_lock:
@@ -828,12 +870,15 @@ class ServingPlaneCache:
         delta, so another shard's corpus is never mistaken for this
         generation's delta tier (which would schedule repacks onto a
         pooled list no per-shard probe can ever match)."""
-        if self._closed:
-            return
         segments = [s for s in segments if s.n_docs > 0]
         if not segments:
             return
         with self._gen_lock:
+            # _closed is read under the lock it is written under —
+            # release() racing a refresh listener must not see a torn
+            # view of (closed, registry) (ESTP-R01)
+            if self._closed:
+                return
             text_fields = list(self._planes)
         for field in text_fields:
             sig = self._signature(segments, field)
@@ -960,6 +1005,7 @@ class ServingPlaneCache:
         gen = TextServingGeneration(plane, segments, field, avgdl, self)
         self._attach_batcher(gen)
         with self._gen_lock:
+            racedep.note_write("plane_cache.generations", self)
             if self._closed:
                 self._release_gen(gen)
                 return gen
@@ -1103,7 +1149,11 @@ class ServingPlaneCache:
             # legacy mode: fall through to a full rebuild
         if not allow_build:
             return None
-        if self._knn_build_streak >= self.KNN_PLANE_CACHE_MAX:
+        with self._gen_lock:
+            # read under the lock: the streak is reset/bumped under it,
+            # and an off-lock read races the repack thread (ESTP-R01)
+            build_streak = self._knn_build_streak
+        if build_streak >= self.KNN_PLANE_CACHE_MAX:
             # every recent probe missed: building would evict entries the
             # same request needs again (O(corpus) repack per query) — the
             # per-segment fallback is the cheaper correct path
@@ -1191,6 +1241,7 @@ class ServingPlaneCache:
         # transiently holds old+new, same as the lexical path.
         new_ids = set(key[1])
         with self._gen_lock:
+            racedep.note_write("plane_cache.generations", self)
             raced = self._knn_planes.get(key)
             if raced is not None:
                 # another thread built the same base meanwhile: keep the
@@ -1223,12 +1274,17 @@ class ServingPlaneCache:
     def release(self) -> None:
         """Release every generation's breaker reservation (the owning
         index is closing or being deleted); in-flight repacks see
-        ``_closed`` and drop their build instead of swapping it in."""
+        ``_closed`` and drop their build instead of swapping it in,
+        and are then JOINED so no repack thread outlives its cache
+        (ESTP-T01 lifecycle discipline: a late swap into a released
+        registry would leak the new plane's breaker bytes)."""
         with self._gen_lock:
             self._closed = True
+            racedep.note_write("plane_cache.generations", self)
             gens = list(self._planes.values()) + \
                 list(self._knn_planes.values())
             self._planes.clear()
             self._knn_planes.clear()
         for gen in gens:
             self._release_gen(gen)
+        self.drain_repacks(timeout=5.0)

@@ -17,6 +17,7 @@ injectable ``tick(now_ms)`` shared by ILM/SLM/watcher.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -46,6 +47,11 @@ class MonitoringService:
         self.interval_ms = self.DEFAULT_INTERVAL_MS
         self._next_due: Optional[int] = None
         self.collected_count = 0
+        #: guards the tick schedule + collected_count: the collector
+        #: runs on the node ticker thread while REST/stats threads read
+        #: the rollup, and two ticker callers racing _next_due would
+        #: double-collect a round (ESTP-R01/R02)
+        self._tick_lock = threading.Lock()
 
     # -- collectors ------------------------------------------------------
     def collect(self, now_ms: Optional[int] = None) -> int:
@@ -127,19 +133,24 @@ class MonitoringService:
             lines.append(d)
         if lines:
             self.bulk_fn(_index_for(now), lines)
-        self.collected_count += len(docs)
+        with self._tick_lock:
+            self.collected_count += len(docs)
         return len(docs)
 
     def tick(self, now_ms: Optional[int] = None) -> bool:
         if not self.enabled:
             return False
         now = now_ms if now_ms is not None else _now_ms()
-        if self._next_due is None:
+        with self._tick_lock:
+            # decide-and-advance atomically: the check and the schedule
+            # write must not straddle the lock or two racing tickers
+            # both pass the due check and collect twice (ESTP-R02)
+            if self._next_due is None:
+                self._next_due = now + self.interval_ms
+                return False
+            if now < self._next_due:
+                return False
             self._next_due = now + self.interval_ms
-            return False
-        if now < self._next_due:
-            return False
-        self._next_due = now + self.interval_ms
         self.collect(now)
         return True
 

@@ -9,6 +9,10 @@ for the full rule catalogue):
 - ESTP-L* lock-order safety (acquisition-graph cycles, telemetry under
   serving locks) — cross-checked at runtime by the lockdep witness
   (``ES_TPU_LOCKDEP=1``, ``elasticsearch_tpu/common/lockdep.py``);
+- ESTP-R*/T* lockset data-race analysis (unguarded multi-thread-root
+  state, check-then-act, unjoined thread lifecycle) — cross-checked at
+  runtime by the racedep happens-before witness
+  (``ES_TPU_RACEDEP=record|raise``, ``elasticsearch_tpu/common/racedep.py``);
 - ESTP-C* telemetry-catalogue discipline (registry ↔ TELEMETRY.md ↔
   health-indicator three-way consistency; the old telemetry_lint).
 
@@ -25,6 +29,10 @@ Usage:
                                               # workload (C01/C02)
   python scripts/estpulint.py --update-baseline   # rewrite the baseline
                                                   # from current findings
+  python scripts/estpulint.py --sarif out.sarif   # SARIF 2.1.0 for CI /
+                                                  # editor annotation
+  python scripts/estpulint.py --no-cache          # bypass the parsed-
+                                                  # model cache
 """
 
 from __future__ import annotations
@@ -75,6 +83,14 @@ def main(argv=None) -> int:
                          "(ESTP-C01/C02); static rules still run")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also list baselined (matched) findings")
+    ap.add_argument("--sarif", metavar="PATH",
+                    help="also write findings as SARIF 2.1.0 (new "
+                         "findings as errors, baselined ones as "
+                         "suppressed warnings with their "
+                         "justifications)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the parsed-model cache "
+                         "(.estpulint_cache/, keyed on file mtimes)")
     args = ap.parse_args(argv)
 
     from elasticsearch_tpu.devtools import analyzer
@@ -108,12 +124,26 @@ def main(argv=None) -> int:
         if runtime:
             report_files.add("TELEMETRY.md")
 
+    cache = None
+    if not args.no_cache:
+        from elasticsearch_tpu.devtools import model_cache
+        cache = model_cache.default_cache(REPO_ROOT)
+
     findings = analyzer.scan_project(
         REPO_ROOT, rules=tuple(args.rules) if args.rules else None,
-        runtime=runtime, report_files=report_files)
+        runtime=runtime, report_files=report_files, cache=cache)
 
     baseline = analyzer.load_baseline(args.baseline)
     new, matched, stale = analyzer.compare_with_baseline(findings, baseline)
+
+    if args.sarif:
+        from elasticsearch_tpu.devtools import sarif
+        justs = {(d.get("rule"), d.get("file"), d.get("symbol", ""),
+                  d.get("detail", "")): d.get("justification", "")
+                 for d in baseline}
+        sarif.write_sarif(args.sarif, new, matched, justs)
+        print(f"sarif written: {len(new)} new + {len(matched)} "
+              f"suppressed -> {args.sarif}")
 
     if args.update_baseline:
         justs = {(d.get("rule"), d.get("file"), d.get("symbol", ""),

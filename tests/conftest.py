@@ -30,6 +30,19 @@ if os.environ.get("ES_TPU_LOCKDEP", "0").lower() in ("1", "true"):
 
     _lockdep.install()
 
+# Opt-in runtime race witness (ES_TPU_RACEDEP=record|raise): installed
+# BEFORE package module-level locks exist, same as lockdep (it
+# force-installs lockdep to see lock events, and wraps Thread start/
+# run/join for fork/join happens-before edges). Under `record`, the
+# whole tier-1 suite runs with candidate-race collection on and
+# tests/test_racedep.py::test_no_candidate_races_recorded fails the
+# run if any access pair raced (see STATIC_ANALYSIS.md, ESTP-R rules).
+if os.environ.get("ES_TPU_RACEDEP", "").lower() in ("1", "true",
+                                                    "record", "raise"):
+    from elasticsearch_tpu.common import racedep as _racedep
+
+    _racedep.install()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,464 @@
+"""Runtime race witness (``common/racedep.py``): the happens-before +
+lockset hybrid must flag genuinely unordered lock-disjoint access pairs
+and stay silent for every ordering mechanism package code actually uses
+(a common lock, a release→acquire edge, fork/join edges). The
+ES_TPU_RACEDEP end-to-end paths (factory install at conftest time,
+Thread wrapping, the seeded race, the serving-stack stress run) execute
+in subprocesses so patching ``threading.Thread`` never leaks into the
+suite's own process.
+
+``test_no_candidate_races_recorded`` is the tier-1 CI hook: when the
+suite runs under ``ES_TPU_RACEDEP=record`` (conftest installs the
+witness before package module-level locks exist), it fails on any
+candidate race the instrumented serving surfaces recorded in the tests
+that ran before it.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from elasticsearch_tpu.common import racedep                 # noqa: E402
+
+
+def _run_threads(*fns):
+    """Run each fn in its own (stdlib-created, fork-edge-free) thread,
+    the fns SEQUENCED by events — the witness must convict on the
+    evidence (clocks + locksets), not on an exercised interleaving. All
+    threads are kept alive simultaneously (a start barrier) so the OS
+    never recycles a thread ident mid-test: the witness keys per-thread
+    history on ``get_ident()``, and a recycled ident conflates two
+    logical threads into one (a documented false-negative direction)."""
+    n = len(fns)
+    barrier = threading.Barrier(n + 1)
+    events = [threading.Event() for _ in range(n)]
+
+    def runner(i, fn):
+        barrier.wait()
+        if i:
+            events[i - 1].wait()
+        try:
+            fn()
+        finally:
+            events[i].set()
+
+    threads = [threading.Thread(target=runner, args=(i, fn))
+               for i, fn in enumerate(fns)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    for t in threads:
+        t.join()
+
+
+# ---------------------------------------------------------------------------
+# core semantics: what is (and is not) a candidate race
+# ---------------------------------------------------------------------------
+
+
+def test_unordered_lock_free_writes_are_a_candidate():
+    w = racedep.RaceWitness(raise_on_race=False)
+    _run_threads(lambda: w.access("k", write=True),
+                 lambda: w.access("k", write=True))
+    rep = w.report()
+    assert rep["candidate_count"] == 1
+    doc = rep["candidates"][0]
+    assert doc["kind"] == "write/write"
+    # evidence: both access stacks, both (empty) locksets
+    assert doc["first"]["stack"] and doc["second"]["stack"]
+    assert doc["first"]["lockset"] == [] == doc["second"]["lockset"]
+
+
+def test_read_write_candidate_kind():
+    w = racedep.RaceWitness(raise_on_race=False)
+    _run_threads(lambda: w.access("k", write=False),
+                 lambda: w.access("k", write=True))
+    rep = w.report()
+    assert rep["candidate_count"] == 1
+    assert rep["candidates"][0]["kind"] == "read/write"
+
+
+def test_read_read_is_never_a_race():
+    w = racedep.RaceWitness(raise_on_race=False)
+    _run_threads(lambda: w.access("k", write=False),
+                 lambda: w.access("k", write=False))
+    assert w.report()["candidate_count"] == 0
+
+
+def test_common_lock_suppresses_unordered_accesses():
+    """Both threads hold L at the access (no release between them, so
+    no HB edge orders the pair): the lockset intersection alone must
+    clear it — the Eraser half."""
+    w = racedep.RaceWitness(raise_on_race=False)
+
+    def t1():
+        w.on_acquire("L")
+        w.access("k", write=True)
+
+    def t2():
+        w.on_acquire("L")
+        w.access("k", write=True)
+
+    _run_threads(t1, t2)
+    assert w.report()["candidate_count"] == 0
+
+
+def test_release_acquire_edge_orders_lock_free_accesses():
+    """t1 writes WITHOUT a lock, then releases L; t2 acquires L and
+    writes. The accesses share no lock — only the happens-before edge
+    through L's release→acquire orders them. The pure-lockset verdict
+    would be a false positive; the hybrid must stay silent."""
+    w = racedep.RaceWitness(raise_on_race=False)
+
+    def t1():
+        w.access("k", write=True)
+        w.on_acquire("L")
+        w.on_release("L")
+
+    def t2():
+        w.on_acquire("L")
+        w.access("k", write=True)
+        w.on_release("L")
+
+    _run_threads(t1, t2)
+    assert w.report()["candidate_count"] == 0
+
+
+def test_fork_edge_orders_parent_init_before_child_access():
+    """The publication pattern: parent initialises state, forks the
+    worker, the worker reads it lock-free. The fork edge (child starts
+    with the parent's clock) must order the pair."""
+    w = racedep.RaceWitness(raise_on_race=False)
+    w.access("k", write=True)
+    child = threading.Thread(target=lambda: w.access("k", write=False))
+    w.on_fork(w.thread_clock(), child)
+    child.start()
+    child.join()
+    rep = w.report()
+    assert rep["candidate_count"] == 0
+    assert rep["fork_edges"] == 1
+
+
+def test_join_edge_orders_child_write_before_parent_read():
+    """The collect pattern: worker writes its result lock-free, parent
+    joins it, then reads. The join edge (child's final clock merges into
+    the joiner) must order the pair."""
+    w = racedep.RaceWitness(raise_on_race=False)
+    final = {}
+
+    def child():
+        w.access("k", write=True)
+        final["clock"] = w.thread_clock()
+
+    t = threading.Thread(target=child)
+    t.start()
+    t.join()
+    w.on_join(final["clock"])
+    w.access("k", write=False)
+    assert w.report()["candidate_count"] == 0
+
+
+def test_distinct_keys_never_cross_contaminate():
+    w = racedep.RaceWitness(raise_on_race=False)
+    _run_threads(lambda: w.access(("stats", 1), write=True),
+                 lambda: w.access(("stats", 2), write=True))
+    assert w.report()["candidate_count"] == 0
+
+
+def test_one_report_per_key_no_flooding():
+    """A hot racing key occupies ONE evidence slot however many racy
+    accesses follow; a second distinct key still gets its own report."""
+    w = racedep.RaceWitness(raise_on_race=False)
+    fns = [lambda: w.access("k", write=True) for _ in range(5)]
+    _run_threads(*fns)
+    assert w.report()["candidate_count"] == 1
+    _run_threads(lambda: w.access("k2", write=True),
+                 lambda: w.access("k2", write=True))
+    rep = w.report()
+    assert rep["candidate_count"] == 2
+    assert len(rep["candidates"]) == 2
+
+
+def test_raise_mode_raises_at_second_access():
+    w = racedep.RaceWitness(raise_on_race=True)
+    caught = []
+
+    def t1():
+        w.access("k", write=True)
+
+    def t2():
+        try:
+            w.access("k", write=True)
+        except racedep.CandidateDataRace as e:
+            caught.append(e)
+
+    _run_threads(t1, t2)
+    assert len(caught) == 1
+    msg = str(caught[0])
+    assert "'k'" in msg and "write/write" in msg
+    assert "first stack" in msg and "second stack" in msg
+
+
+def test_reset_drops_candidates_keeps_clocks():
+    w = racedep.RaceWitness(raise_on_race=False)
+    _run_threads(lambda: w.access("k", write=True),
+                 lambda: w.access("k", write=True))
+    assert w.report()["candidate_count"] == 1
+    w.reset()
+    rep = w.report()
+    assert rep["candidate_count"] == 0 and rep["tracked_keys"] == 0
+    assert rep["threads_witnessed"] >= 2       # clocks survive reset
+
+
+def test_note_helpers_are_noops_when_not_installed():
+    """The serving-path contract: microbatch/plane_route call
+    note_read/note_write unconditionally — without the witness they must
+    record nothing (and cost one module load + a truth test)."""
+    if racedep.installed():
+        pytest.skip("witness installed for this run (ES_TPU_RACEDEP)")
+    before = racedep.WITNESS.report()["accesses"]
+    racedep.note_write("microbatch.stats", object())
+    racedep.note_read("microbatch.stats", object())
+    assert racedep.WITNESS.report()["accesses"] == before
+
+
+def test_telemetry_families_register():
+    """The es_racedep_* evidence families land in the registry
+    (TELEMETRY.md-catalogued, covered by estpulint rule family 3)."""
+    from elasticsearch_tpu.common import telemetry
+    racedep.ensure_collector()
+    snap = telemetry.DEFAULT.stats_doc()
+    for fam in ("es_racedep_tracked_keys",
+                "es_racedep_accesses_total",
+                "es_racedep_threads_witnessed",
+                "es_racedep_candidate_races_total"):
+        assert fam in snap, f"missing {fam}"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: env-gated install, Thread wrapping, the seeded race
+# ---------------------------------------------------------------------------
+
+
+_E2E_SNIPPET = """
+    import os, sys, threading
+    sys.path.insert(0, {root!r})
+    os.environ["ES_TPU_RACEDEP"] = "record"
+    from elasticsearch_tpu.common import lockdep, racedep
+    assert racedep.install()
+    assert racedep.installed()
+    # racedep force-installs the lockdep witness to see lock events
+    assert lockdep.installed()
+    # package-frame Thread starts get fork edges; this test file's
+    # don't (stdlib/test frames are untouched)
+    assert threading.Thread.start is racedep._start
+
+    from elasticsearch_tpu.search import microbatch  # package import
+    racedep.note_write("seeded.publication", owner=None)
+
+    def run_two(fn1, fn2):
+        # both threads alive simultaneously (distinct idents), fn2
+        # sequenced after fn1 — conviction comes from the evidence,
+        # not the interleaving
+        barrier = threading.Barrier(3)
+        done1 = threading.Event()
+        def r1():
+            barrier.wait(); fn1(); done1.set()
+        def r2():
+            barrier.wait(); done1.wait(); fn2()
+        t1 = threading.Thread(target=r1)
+        t2 = threading.Thread(target=r2)
+        t1.start(); t2.start(); barrier.wait()
+        t1.join(); t2.join()
+
+    # seeded TRUE race: two lock-free writer threads, no fork edge
+    # between them (stdlib-frame starts) and no common lock
+    def racer():
+        racedep.WITNESS.access("seeded.race", write=True)
+    run_two(racer, racer)
+    rep = racedep.report()
+    assert rep["fork_edges"] == 0, rep      # test frames fork no edges
+    assert rep["candidate_count"] == 1, rep
+    assert rep["candidates"][0]["kind"] == "write/write"
+    print("E2E_RACE_CAUGHT")
+
+    # raise mode on the global witness
+    racedep.WITNESS.raise_on_race = True
+    racedep.reset()
+    caught = []
+    def racer_catching():
+        try:
+            racedep.WITNESS.access("seeded.race", write=True)
+        except racedep.CandidateDataRace as e:
+            caught.append(e)
+    run_two(racer_catching, racer_catching)
+    assert caught, "raise mode did not raise"
+    print("E2E_RAISE_OK")
+
+    racedep.uninstall()
+    assert threading.Thread.start is racedep._REAL_START
+    print("E2E_UNINSTALL_OK")
+"""
+
+
+def test_e2e_install_wraps_threads_and_catches_seeded_race():
+    code = textwrap.dedent(_E2E_SNIPPET).format(root=REPO_ROOT)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=dict(os.environ, ES_TPU_RACEDEP="record",
+                 JAX_PLATFORMS="cpu"), timeout=180)
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    for marker in ("E2E_RACE_CAUGHT", "E2E_RAISE_OK",
+                   "E2E_UNINSTALL_OK"):
+        assert marker in proc.stdout, proc.stdout
+
+
+def test_install_respects_env_gate():
+    code = textwrap.dedent("""
+        import os, sys, threading
+        sys.path.insert(0, {root!r})
+        os.environ.pop("ES_TPU_RACEDEP", None)
+        from elasticsearch_tpu.common import racedep
+        assert racedep.install() is False
+        assert not racedep.installed()
+        assert threading.Thread.start is racedep._REAL_START
+        print("GATED_OK")
+    """).format(root=REPO_ROOT)
+    env = {k: v for k, v in os.environ.items() if k != "ES_TPU_RACEDEP"}
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, env=env,
+                          timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "GATED_OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# the serving-stack stress run (the ISSUE's acceptance invariant)
+# ---------------------------------------------------------------------------
+
+
+_STRESS_SNIPPET = """
+    import os, sys, threading, time
+    sys.path.insert(0, {root!r})
+    os.environ["ES_TPU_RACEDEP"] = "record"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from elasticsearch_tpu.common import racedep
+    assert racedep.install()      # BEFORE package locks exist
+
+    import numpy as np
+    from elasticsearch_tpu.index.mapping import MapperService
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.search.plane_route import ServingPlaneCache
+    from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+    MAPPING = {{"properties": {{"body": {{"type": "text"}}}}}}
+    WORDS = ["quick", "brown", "fox", "dog", "lazy", "jump", "search",
+             "engine", "rank", "doc", "the", "of"]
+
+    def mk_segments(svc, n_segs, per, seed=7, start=0, prefix="s"):
+        rng = np.random.RandomState(seed)
+        segs, doc = [], start
+        for si in range(n_segs):
+            b = SegmentBuilder(f"{{prefix}}{{si}}")
+            for _ in range(per):
+                toks = [WORDS[min(rng.zipf(1.5) - 1, len(WORDS) - 1)]
+                        for _ in range(5)]
+                b.add(svc.parse_document(str(doc),
+                                         {{"body": " ".join(toks)}}),
+                      seq_no=doc)
+                doc += 1
+            segs.append(b.build())
+        return segs
+
+    svc = MapperService(MAPPING)
+    base = mk_segments(svc, 2, 30, seed=4)
+    cache = ServingPlaneCache()
+    cache.REPACK_DELTA_FRACTION = 0.01    # force background repacks
+    cache.plane_for(base, svc, "body")
+    segs = base + mk_segments(svc, 1, 12, seed=12, start=600, prefix="d")
+    searcher = ShardSearcher(
+        segs, svc, plane_provider=lambda s, f: cache.plane_for(s, svc, f))
+
+    errs, lock = [], threading.Lock()
+
+    def client():
+        try:
+            for _ in range(6):
+                searcher.search(
+                    {{"query": {{"match": {{"body": "quick"}}}}}})
+                time.sleep(0.001)
+        except Exception as e:               # noqa: BLE001
+            with lock:
+                errs.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(6)]
+    for t in threads:
+        t.start()
+    # stats/health scrapes off the request threads, racing the repack
+    for _ in range(10):
+        for b in cache.serving_batchers():
+            b.stats_doc()
+        time.sleep(0.002)
+    for t in threads:
+        t.join()
+    cache.drain_repacks()
+    cache.release()
+    assert not errs, errs
+
+    rep = racedep.report()
+    # the witness actually watched the contended surfaces...
+    assert rep["accesses"] > 0, rep
+    assert rep["tracked_keys"] >= 2, rep
+    assert rep["threads_witnessed"] >= 7, rep
+    # ...and post-fix they carry ZERO candidate races
+    assert rep["candidate_count"] == 0, rep["candidates"]
+    print("STRESS_ZERO_RACES accesses=%d keys=%d threads=%d"
+          % (rep["accesses"], rep["tracked_keys"],
+             rep["threads_witnessed"]))
+"""
+
+
+@pytest.mark.slow
+def test_stress_concurrent_search_and_repack_records_zero_races():
+    """ES_TPU_RACEDEP=record under real contention: concurrent search
+    clients against a repacking plane plus stats scrapes, asserting the
+    instrumented serving state (generation registry, delta swaps,
+    batcher stats) records ZERO candidate races after the tentpole
+    fixes."""
+    code = textwrap.dedent(_STRESS_SNIPPET).format(root=REPO_ROOT)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=dict(os.environ, ES_TPU_RACEDEP="record",
+                 JAX_PLATFORMS="cpu"), timeout=600)
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    assert "STRESS_ZERO_RACES" in proc.stdout, proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 CI hook (active only under ES_TPU_RACEDEP)
+# ---------------------------------------------------------------------------
+
+
+def test_no_candidate_races_recorded():
+    """When the suite runs under ES_TPU_RACEDEP=record (conftest
+    installs the witness before any package lock exists), every
+    instrumented access the tests before this one drove must be
+    race-free. Skips when the witness is off — the plain tier-1 run."""
+    if not racedep.installed():
+        pytest.skip("ES_TPU_RACEDEP not set for this run")
+    rep = racedep.report()
+    assert rep["candidate_count"] == 0, (
+        "candidate data races recorded during the tier-1 run:\n"
+        + "\n".join(f"- {c['key']} ({c['kind']}): "
+                    f"{c['first']['thread']} vs {c['second']['thread']}"
+                    f"\n  first: {c['first']['stack']}"
+                    f"\n  second: {c['second']['stack']}"
+                    for c in rep["candidates"]))

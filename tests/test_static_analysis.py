@@ -21,8 +21,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
-from elasticsearch_tpu.devtools import analyzer, rules_catalogue, \
-    rules_jit, rules_locks                                  # noqa: E402
+from elasticsearch_tpu.devtools import analyzer, model_cache, \
+    rules_catalogue, rules_jit, rules_locks, rules_races, \
+    sarif                                                   # noqa: E402
 
 
 def _project(tmp_path, files):
@@ -210,6 +211,75 @@ def test_j04_opaque_static_argnames_provenance(tmp_path):
     j04 = [f for f in rules_jit.check(proj) if f.rule == "ESTP-J04"]
     assert len(j04) == 1 and j04[0].symbol == "bad"
     assert "n_buckets" in j04[0].detail
+
+
+def test_j01_taint_through_tuple_unpack(tmp_path):
+    """Satellite regression: step outputs unpacked via tuple assignment
+    used to escape taint — ``scores, idx = step(xs)`` then a host
+    conversion on ``scores`` must flag."""
+    proj = _project(tmp_path, {"plane.py": """
+        import jax
+
+        def build_topk_step(k):
+            def step(x):
+                return x, x
+            return jax.jit(step)
+
+        def serve(xs, k):
+            step = build_topk_step(k)
+            scores, idx = step(xs)
+            return float(scores[0])          # host sync on step output
+    """})
+    j01 = [f for f in rules_jit.check(proj) if f.rule == "ESTP-J01"]
+    assert len(j01) == 1 and j01[0].symbol == "serve"
+    assert "float() on step output" in j01[0].detail
+
+
+def test_j01_taint_through_nested_targets_and_rebinding(tmp_path):
+    proj = _project(tmp_path, {"plane.py": """
+        import jax
+
+        def build_x_step(k):
+            def step(x):
+                return x
+            return jax.jit(step)
+
+        def serve(xs, k):
+            step = build_x_step(k)
+            out = step(xs)
+            (scores, idx), *rest = out       # nested + starred
+            first = scores[0]                # subscript re-binding
+            return first.item()
+    """})
+    j01 = [f for f in rules_jit.check(proj) if f.rule == "ESTP-J01"]
+    assert len(j01) == 1
+    assert ".item()" in j01[0].detail and "first.item()" in j01[0].detail
+
+
+def test_j01_tuple_unpack_of_host_call_stays_clean(tmp_path):
+    """The known-good twin: tuple unpacking a HOST call's result (and
+    len() of a step output — a host int, not a device array) must not
+    taint."""
+    proj = _project(tmp_path, {"plane.py": """
+        import jax
+
+        def build_x_step(k):
+            def step(x):
+                return x
+            return jax.jit(step)
+
+        def host_pair(xs):
+            return xs, len(xs)
+
+        def serve(xs, k):
+            step = build_x_step(k)
+            out = step(xs)
+            n = len(out)                     # host int: not tainted
+            a, b = host_pair(xs)             # host results: not tainted
+            return float(a[0]) + n
+    """})
+    assert not [f for f in rules_jit.check(proj)
+                if f.rule == "ESTP-J01"]
 
 
 # ---------------------------------------------------------------------------
@@ -527,3 +597,533 @@ def test_diff_mode_restricts_report(tmp_path):
         report_files={"mod_a.py"})
     assert {f.file for f in only_a} <= {"mod_a.py"}
     assert len(only_a) <= len(all_f)
+
+
+# ---------------------------------------------------------------------------
+# ESTP-R01: unguarded multi-root shared state
+# ---------------------------------------------------------------------------
+
+
+_R01_BAD = {"svc.py": """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self._stats = {}
+            self._t = threading.Thread(target=self._loop)
+            self._t.start()
+
+        def close(self):
+            self._t.join()
+
+        def _loop(self):
+            self._stats["n"] = 1             # dispatcher write, no lock
+
+        def handle(self, req):
+            return dict(self._stats)         # REST read, no lock
+"""}
+
+
+def test_r01_unguarded_shared_state_flags(tmp_path):
+    proj = _project(tmp_path, _R01_BAD)
+    r01 = [f for f in rules_races.check(proj) if f.rule == "ESTP-R01"]
+    assert len(r01) == 1
+    assert r01[0].symbol == "svc:Svc._stats"
+    # the finding names the roots that can interleave
+    assert "thread:Svc._loop" in r01[0].message
+    assert "request:Svc.handle" in r01[0].message
+
+
+def test_r01_guarded_twin_passes(tmp_path):
+    files = {"svc.py": _R01_BAD["svc.py"]
+             .replace('self._stats["n"] = 1             '
+                      '# dispatcher write, no lock',
+                      'with self.lock:\n'
+                      '                self._stats["n"] = 1')
+             .replace('return dict(self._stats)         '
+                      '# REST read, no lock',
+                      'with self.lock:\n'
+                      '                return dict(self._stats)')}
+    proj = _project(tmp_path, files)
+    assert not [f for f in rules_races.check(proj)
+                if f.rule == "ESTP-R01"]
+
+
+def test_r01_single_root_state_passes(tmp_path):
+    """State touched by ONE thread root needs no lock — the rule must
+    require ≥2 roots with ≥1 write."""
+    proj = _project(tmp_path, {"svc.py": """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._ticks = 0
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def close(self):
+                self._t.join()
+
+            def _loop(self):
+                self._ticks += 1             # only root touching it
+    """})
+    assert not [f for f in rules_races.check(proj)
+                if f.rule == "ESTP-R01"]
+
+
+def test_r01_entry_lockset_covers_helper_accesses(tmp_path):
+    """Entry-lockset propagation: a helper ALWAYS called under the lock
+    is covered even though the helper itself takes none."""
+    proj = _project(tmp_path, {"svc.py": """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self._stats = {}
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def close(self):
+                self._t.join()
+
+            def _bump(self):
+                self._stats["n"] = 1         # lock held by every caller
+
+            def _loop(self):
+                with self.lock:
+                    self._bump()
+
+            def handle(self, req):
+                with self.lock:
+                    self._bump()
+                    return dict(self._stats)
+    """})
+    assert not [f for f in rules_races.check(proj)
+                if f.rule == "ESTP-R01"]
+
+
+def test_r01_module_global_across_roots(tmp_path):
+    proj = _project(tmp_path, {"mod.py": """
+        import threading
+
+        _CACHE = None
+
+        def _refresh():
+            global _CACHE
+            _CACHE = {}
+
+        def spawn():
+            t = threading.Thread(target=_refresh)
+            t.start()
+            return t
+
+        def handle(req):
+            global _CACHE
+            _CACHE = dict(_CACHE or {})
+    """})
+    r01 = [f for f in rules_races.check(proj) if f.rule == "ESTP-R01"]
+    assert len(r01) == 1 and r01[0].symbol == "mod:_CACHE"
+
+
+# ---------------------------------------------------------------------------
+# ESTP-R02: check-then-act across a lock release
+# ---------------------------------------------------------------------------
+
+
+def test_r02_check_then_act_flags(tmp_path):
+    proj = _project(tmp_path, {"svc.py": """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self._due = 0
+                self._t = threading.Thread(target=self.tick)
+                self._t.start()
+
+            def close(self):
+                self._t.join()
+
+            def tick(self):
+                with self.lock:
+                    due = self._due          # decide under the lock...
+                if due:
+                    with self.lock:
+                        pass                 # (re-taken for other state)
+                    self._due = due + 1      # ...act after release
+
+            def handle(self, r):
+                self.tick()
+    """})
+    r02 = [f for f in rules_races.check(proj) if f.rule == "ESTP-R02"]
+    assert len(r02) == 1
+    assert r02[0].symbol == "Svc.tick"
+    assert "svc:Svc._due" in r02[0].detail
+
+
+def test_r02_write_under_same_lock_passes(tmp_path):
+    proj = _project(tmp_path, {"svc.py": """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self._due = 0
+                self._t = threading.Thread(target=self.tick)
+                self._t.start()
+
+            def close(self):
+                self._t.join()
+
+            def tick(self):
+                with self.lock:
+                    due = self._due
+                    if due:
+                        self._due = due + 1  # decide-and-act atomically
+
+            def handle(self, r):
+                self.tick()
+    """})
+    assert not [f for f in rules_races.check(proj)
+                if f.rule == "ESTP-R02"]
+
+
+# ---------------------------------------------------------------------------
+# ESTP-T01: thread/executor lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_t01_unjoined_thread_flags(tmp_path):
+    proj = _project(tmp_path, {"svc.py": """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                pass
+    """})
+    t01 = [f for f in rules_races.check(proj) if f.rule == "ESTP-T01"]
+    assert len(t01) == 1 and t01[0].symbol == "Svc"
+    assert "no join/shutdown" in t01[0].detail
+
+
+def test_t01_executor_without_shutdown_flags(tmp_path):
+    proj = _project(tmp_path, {"svc.py": """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Svc:
+            def __init__(self):
+                self._pool = ThreadPoolExecutor(max_workers=2)
+    """})
+    t01 = [f for f in rules_races.check(proj) if f.rule == "ESTP-T01"]
+    assert len(t01) == 1 and "executor" in t01[0].detail
+
+
+def test_t01_joined_on_close_passes(tmp_path):
+    proj = _project(tmp_path, {"svc.py": """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Svc:
+            def __init__(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+                self._pool = ThreadPoolExecutor(max_workers=2)
+
+            def _loop(self):
+                pass
+
+            def close(self):
+                self._t.join()
+                self._pool.shutdown()
+    """})
+    assert not [f for f in rules_races.check(proj)
+                if f.rule == "ESTP-T01"]
+
+
+def test_t01_teardown_through_helper_passes(tmp_path):
+    """Teardown reached transitively (close -> _stop -> join) counts."""
+    proj = _project(tmp_path, {"svc.py": """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                pass
+
+            def _halt(self):
+                self._t.join()
+
+            def close(self):
+                self._halt()
+    """})
+    assert not [f for f in rules_races.check(proj)
+                if f.rule == "ESTP-T01"]
+
+
+# ---------------------------------------------------------------------------
+# thread-root discovery
+# ---------------------------------------------------------------------------
+
+
+def test_thread_root_discovery_kinds(tmp_path):
+    proj = _project(tmp_path, {"roots.py": """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Svc:
+            def __init__(self, registry):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+                self._pool = ThreadPoolExecutor(max_workers=1)
+                self._pool.submit(self._collect)
+                self.refresh_listeners = []
+                self.refresh_listeners.append(self._on_refresh)
+                registry.register_collector("svc", self._emit)
+
+            def close(self):
+                self._t.join()
+                self._pool.shutdown()
+
+            def _loop(self):
+                pass
+
+            def _collect(self):
+                pass
+
+            def _on_refresh(self):
+                pass
+
+            def _emit(self):
+                pass
+
+        def handle(req):
+            pass
+    """})
+    roots = {r.display: r.kind
+             for r in rules_races.discover_thread_roots(proj)}
+    assert roots == {
+        "thread:Svc._loop": "thread",
+        "executor:Svc._collect": "executor",
+        "listener:Svc._on_refresh": "listener",
+        "listener:Svc._emit": "listener",
+        "request:handle": "request",
+    }
+
+
+def test_package_thread_roots_cover_known_serving_roots():
+    """The real package: root discovery must see the serving roots the
+    ISSUE names — dispatcher threads, the repack/warmup threads, the
+    monitoring collector, the REST edge — or the R-rules prove
+    nothing."""
+    proj = analyzer.Project.from_root(REPO_ROOT)
+    roots = {r.display for r in rules_races.discover_thread_roots(proj)}
+    for expected_frag in ("_dispatch_loop", "_repack", "warmup",
+                          "_on_shard_refresh", "_metrics_doc", "handle"):
+        assert any(expected_frag in r for r in roots), \
+            f"no thread root matching {expected_frag!r} in {sorted(roots)}"
+
+
+# ---------------------------------------------------------------------------
+# SARIF export
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_export_shape_and_suppressions():
+    new = analyzer.Finding("ESTP-R01", "a.py", 10, "mod:C._x",
+                           "unguarded", "two roots interleave")
+    base = analyzer.Finding("ESTP-J01", "b.py", 20, "f", "fence",
+                            "sanctioned sync")
+    doc = sarif.to_sarif([new], [base],
+                         {base.identity: "intentional stage fence"})
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "estpulint"
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rule_ids == ["ESTP-J01", "ESTP-R01"]
+    results = run["results"]
+    assert len(results) == 2
+    by_rule = {r["ruleId"]: r for r in results}
+    fresh = by_rule["ESTP-R01"]
+    assert fresh["level"] == "error" and "suppressions" not in fresh
+    loc = fresh["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "a.py"
+    assert loc["region"]["startLine"] == 10
+    assert fresh["partialFingerprints"]["estpulint/v1"] == \
+        "ESTP-R01|a.py|mod:C._x|unguarded"
+    sup = by_rule["ESTP-J01"]
+    assert sup["level"] == "warning"
+    assert sup["suppressions"][0]["kind"] == "external"
+    assert sup["suppressions"][0]["justification"] == \
+        "intentional stage fence"
+    # ruleIndex must point back into the rules array
+    for r in results:
+        assert rule_ids[r["ruleIndex"]] == r["ruleId"]
+
+
+def test_sarif_cli_writes_file(tmp_path):
+    """--sarif PATH through the real CLI on a --rules-restricted scan
+    (ESTP-J only: static rules, no runtime workload needed)."""
+    out = tmp_path / "findings.sarif"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "estpulint.py"),
+         "--rules", "ESTP-J", "--sarif", str(out)],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=600)
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    # the sanctioned J01 fences are baselined -> suppressed warnings
+    assert results and all(r["level"] == "warning" and r["suppressions"]
+                           for r in results)
+
+
+# ---------------------------------------------------------------------------
+# parsed-model cache
+# ---------------------------------------------------------------------------
+
+
+def _finding_docs(findings):
+    return sorted((f.doc() for f in findings), key=json.dumps)
+
+
+def test_model_cache_scan_identical(tmp_path):
+    """Satellite acceptance: the cached and cold scans produce IDENTICAL
+    findings — on the warm run every file comes from the cache."""
+    proj_dir = tmp_path / "proj"
+    proj_dir.mkdir()
+    files = dict(_R01_BAD)
+    files["cyc.py"] = """
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with B:
+                with A:
+                    pass
+    """
+    for rel, src in files.items():
+        (proj_dir / rel).write_text(textwrap.dedent(src))
+    cold = analyzer.scan_project(str(proj_dir), files=list(files),
+                                 runtime=False)
+    cache = model_cache.ModelCache(str(tmp_path / "cache"))
+    first = analyzer.scan_project(str(proj_dir), files=list(files),
+                                  runtime=False, cache=cache)
+    assert cache.misses == len(files) and cache.hits == 0
+    warm_cache = model_cache.ModelCache(str(tmp_path / "cache"))
+    warm = analyzer.scan_project(str(proj_dir), files=list(files),
+                                 runtime=False, cache=warm_cache)
+    assert warm_cache.hits == len(files) and warm_cache.misses == 0
+    assert _finding_docs(cold) == _finding_docs(first) == \
+        _finding_docs(warm)
+    assert cold, "fixture scan found nothing — the assertion is vacuous"
+
+
+def test_model_cache_invalidates_on_edit(tmp_path):
+    """An edited file must re-parse (stat key changed) and the scan must
+    reflect the edit, not the cached tree."""
+    proj_dir = tmp_path / "proj"
+    proj_dir.mkdir()
+    (proj_dir / "svc.py").write_text(
+        textwrap.dedent(_R01_BAD["svc.py"]))
+    cache = model_cache.ModelCache(str(tmp_path / "cache"))
+    bad = analyzer.scan_project(str(proj_dir), files=["svc.py"],
+                                runtime=False, cache=cache)
+    assert any(f.rule == "ESTP-R01" for f in bad)
+    fixed = textwrap.dedent(_R01_BAD["svc.py"]).replace(
+        'self._stats["n"] = 1             # dispatcher write, no lock',
+        'with self.lock:\n'
+        '            self._stats["n"] = 1')
+    fixed = fixed.replace(
+        'return dict(self._stats)         # REST read, no lock',
+        'with self.lock:\n'
+        '            return dict(self._stats)')
+    (proj_dir / "svc.py").write_text(fixed)
+    os.utime(proj_dir / "svc.py", ns=(1, 1))   # force a distinct mtime
+    good = analyzer.scan_project(str(proj_dir), files=["svc.py"],
+                                 runtime=False, cache=cache)
+    assert not [f for f in good if f.rule == "ESTP-R01"]
+
+
+def test_model_cache_corrupt_entry_falls_back(tmp_path):
+    proj_dir = tmp_path / "proj"
+    proj_dir.mkdir()
+    (proj_dir / "m.py").write_text("x = 1\n")
+    cache = model_cache.ModelCache(str(tmp_path / "cache"))
+    assert cache.load(str(proj_dir), "m.py") is None       # cold miss
+    src = "x = 1\n"
+    import ast as _ast
+    cache.store(str(proj_dir), "m.py", src, _ast.parse(src))
+    hit = cache.load(str(proj_dir), "m.py")
+    assert hit is not None and hit[0] == src
+    # corrupt the entry on disk: load must miss, not raise
+    entry = cache._entry_path("m.py")
+    with open(entry, "wb") as f:
+        f.write(b"not a pickle")
+    assert cache.load(str(proj_dir), "m.py") is None
+
+
+# ---------------------------------------------------------------------------
+# --diff covers the race family
+# ---------------------------------------------------------------------------
+
+
+def test_diff_mode_covers_race_rules(tmp_path):
+    """--diff semantics for ESTP-R: the model is whole-project (roots in
+    one file reach state in another) and the finding reports at the
+    write site's file, so a diff touching that file surfaces it."""
+    files = {
+        "state.py": """
+            import threading
+
+            class Shared:
+                def __init__(self):
+                    self._stats = {}
+                    t = threading.Thread(target=self.loop)
+                    t.start()
+                    self._t = t
+
+                def close(self):
+                    self._t.join()
+
+                def loop(self):
+                    self._stats["n"] = 1
+        """,
+        "edge.py": """
+            from state import Shared
+
+            SVC = Shared()
+
+            def handle(req):
+                return dict(SVC._stats)
+        """,
+    }
+    for rel, src in files.items():
+        (tmp_path / rel).write_text(textwrap.dedent(src))
+    full = analyzer.scan_project(str(tmp_path), files=list(files),
+                                 runtime=False)
+    assert any(f.rule == "ESTP-R01" for f in full)
+    r01_file = next(f.file for f in full if f.rule == "ESTP-R01")
+    hit = analyzer.scan_project(str(tmp_path), files=list(files),
+                                runtime=False, report_files={r01_file})
+    assert any(f.rule == "ESTP-R01" for f in hit)
+    other = {"state.py", "edge.py"} - {r01_file}
+    miss = analyzer.scan_project(str(tmp_path), files=list(files),
+                                 runtime=False, report_files=other)
+    assert not [f for f in miss if f.rule == "ESTP-R01"]
