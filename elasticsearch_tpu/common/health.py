@@ -198,10 +198,15 @@ class HealthService:
             lseen = getattr(self.api, "_lex_drift_seen", 0)
             lex_drift = max(lex_total - lseen, 0)
             self.api._lex_drift_seen = lex_total
+        # mesh under-utilization: the serving mesh left devices out of
+        # the slice (TELEMETRY.md es_mesh_devices{state="idle"}) — paid
+        # chips stream zero corpus bytes. A gauge, not a window: idle
+        # devices stay idle until the mesh knobs change.
+        idle_devices = _tm.mesh_idle_devices()
         if storm >= self.SYNC_REBUILD_RED:
             status = RED
         elif storm >= self.SYNC_REBUILD_YELLOW or ann_drift > 0 \
-                or lex_drift > 0:
+                or lex_drift > 0 or idle_devices > 0:
             status = YELLOW
         else:
             status = GREEN
@@ -214,6 +219,9 @@ class HealthService:
         elif lex_drift > 0:
             symptom = (f"{lex_drift} lexical dispatches forced prune=off "
                        f"on a block-max plane (pruning drift).")
+        elif idle_devices > 0:
+            symptom = (f"{idle_devices} device(s) stranded idle outside "
+                       f"the serving mesh (under-utilization).")
         else:
             symptom = "Serving planes are maintained off the request path."
         doc = {
@@ -227,6 +235,7 @@ class HealthService:
                         "ann_below_default_total": ann_total,
                         "lex_prune_off_dispatches": lex_drift,
                         "lex_prune_off_total": lex_total,
+                        "idle_mesh_devices": idle_devices,
                         "storming_indices": per_index},
         }
         if status != GREEN:
@@ -278,6 +287,20 @@ class HealthService:
                     "eager latency profile; watch "
                     "es_lex_blocks_skipped_total and "
                     "es_lex_prune_off_total."))
+            if idle_devices > 0:
+                doc["impacts"].append(_impact(
+                    "plane_serving:mesh_underutilization", 3,
+                    "Devices outside the serving mesh hold no corpus "
+                    "partition and serve no queries — per-chip corpus "
+                    "bytes and throughput are worse than the slice "
+                    "could deliver.", ["search"]))
+                doc["diagnosis"].append(_diagnosis(
+                    "plane_serving:idle_mesh_devices",
+                    "ES_TPU_MESH_SHARDS x ES_TPU_MESH_REPLICAS covers "
+                    "fewer devices than the slice provides.",
+                    "Raise ES_TPU_MESH_SHARDS (corpus capacity) or "
+                    "ES_TPU_MESH_REPLICAS (query throughput) to cover "
+                    "the slice; watch es_mesh_devices{state=\"idle\"}."))
         return doc
 
     def _ind_compile_churn(self) -> dict:

@@ -49,6 +49,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 __all__ = [
     "Counter", "Gauge", "Histogram", "TelemetryRegistry", "DEFAULT",
     "record_compile", "record_transfer", "record_ann", "record_lex",
+    "record_mesh_dispatch", "mesh_idle_devices",
     "instrument_step", "device_stats_doc", "ann_drift_count",
     "lex_prune_off_count",
 ]
@@ -539,6 +540,37 @@ def record_lex(blocks_scored: int = 0, blocks_skipped: int = 0,
                 help="lexical dispatches that forced prune=off on a "
                      "block-max plane (benched-default drift)").inc(
                          1 if prune_off else 0)
+
+
+def record_mesh_dispatch(n_shard_devices: int, n_replica_devices: int,
+                         registry: Optional[TelemetryRegistry]
+                         = None) -> None:
+    """One device-program dispatch over the serving mesh: counts the
+    dispatch's device fan-out per mesh axis (``es_mesh_dispatch_total
+    {axis="shard"|"replica"}`` grows by that axis's extent), so the
+    corpus-partition vs query-replication work split is visible per
+    scrape interval. A 1×1 mesh grows both axes by 1 per dispatch —
+    the single-device baseline."""
+    reg = registry or DEFAULT
+    reg.counter("es_mesh_dispatch_total", {"axis": "shard"},
+                help="mesh dispatches weighted by axis extent "
+                     "(devices the dispatch fanned out over)").inc(
+                         max(int(n_shard_devices), 1))
+    reg.counter("es_mesh_dispatch_total", {"axis": "replica"}).inc(
+        max(int(n_replica_devices), 1))
+
+
+def mesh_idle_devices(registry: Optional[TelemetryRegistry]
+                      = None) -> int:
+    """Devices the most recent search mesh left stranded
+    (``es_mesh_devices{state="idle"}``) — the plane_serving health
+    indicator's under-utilization signal."""
+    reg = registry or DEFAULT
+    doc = reg.metrics_doc().get("es_mesh_devices")
+    if not doc:
+        return 0
+    return int(sum(s["value"] for s in doc["series"]
+                   if s["labels"].get("state") == "idle"))
 
 
 def lex_prune_off_count(registry: Optional[TelemetryRegistry]

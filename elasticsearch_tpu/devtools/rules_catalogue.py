@@ -123,6 +123,11 @@ def runtime_families() -> Set[str]:
         corpus["term_ids"] = {f"t{t}": t for t in range(64)}
         mesh = make_search_mesh(n_shards=1, n_replicas=1,
                                 devices=jax.devices()[:1])
+        # register the serving-owner gauge family for the catalogue
+        # cross-check (make_search_mesh itself deliberately doesn't
+        # write it — only serving-mesh owners do)
+        from elasticsearch_tpu.parallel.mesh import record_mesh_devices
+        record_mesh_devices(1, 0)
         plane = DistributedSearchPlane(mesh, [corpus], field="body")
         plane._host_csr = None
         plane.serve([["t1"]], k=4, with_totals=True)

@@ -662,10 +662,13 @@ class IndexService:
         # races the background repack thread's atomic swap — a scrape
         # mid-swap would die with "dictionary changed size during
         # iteration" (ESTP-R01, found by the first full race scan)
+        # topology keys describe the shared serving mesh, not per-batcher
+        # work — max-merge them; everything else is additive
+        _topo = ("max_batch", "mesh_shard_devices", "mesh_replica_devices")
         for b in self.plane_cache.serving_batchers():
             doc = b.stats_doc()
             for k, v in doc.items():
-                out[k] = max(out[k], v) if k == "max_batch" else out[k] + v
+                out[k] = max(out[k], v) if k in _topo else out[k] + v
         out["cache_hit_count"] = self.plane_cache_stats["hit_count"]
         out["cache_miss_count"] = self.plane_cache_stats["miss_count"]
         try:

@@ -15,13 +15,13 @@ parallelism axes map onto a ``jax.sharding.Mesh``:
   the mesh analogue of routing different searches to different copies.
 """
 
-from .mesh import make_search_mesh, search_mesh_axes
+from .mesh import make_search_mesh, mesh_from_env, search_mesh_axes
 from .dist_search import (DistributedKnnPlane, DistributedSearchPlane,
                           build_bm25_topk_step, build_knn_step,
                           prepare_knn_corpus)
 
 __all__ = [
-    "make_search_mesh", "search_mesh_axes",
+    "make_search_mesh", "mesh_from_env", "search_mesh_axes",
     "DistributedSearchPlane", "build_bm25_topk_step", "build_knn_step",
     "DistributedKnnPlane", "prepare_knn_corpus",
 ]

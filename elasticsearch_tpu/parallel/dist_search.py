@@ -56,6 +56,51 @@ from .mesh import AXIS_REPLICA, AXIS_SHARD
 NEG_INF = float("-inf")
 
 
+#: XLA:CPU runs the in-process collective rendezvous (all_gather/psum
+#: over the virtual-device mesh) without a hardware stream order:
+#: two threads executing multi-device programs concurrently — the
+#: micro-batcher's PIPELINE_DEPTH=2 dispatchers, or a text and a kNN
+#: dispatcher in different batchers — can interleave participants
+#: across programs and deadlock (both threads park inside execute;
+#: seen on the 2-device serving bench). Serialize multi-device
+#: executions process-wide on CPU, holding the lock THROUGH completion
+#: so the collective epoch finishes before the next program starts.
+#: Real accelerator backends order collectives on device streams, and
+#: single-device programs have no collectives — both skip the lock, so
+#: production TPU serving keeps concurrent dispatch. Host prep still
+#: pipelines with device execution (the lock covers only the XLA call).
+_CPU_COLLECTIVE_LOCK = threading.Lock()
+
+
+def _run_step(serial: bool, step, *args):
+    """Execute a jitted step; under ``serial`` (multi-device mesh on a
+    CPU backend) the dispatch is serialized process-wide and synced
+    before the lock releases — see ``_CPU_COLLECTIVE_LOCK``."""
+    if serial:
+        with _CPU_COLLECTIVE_LOCK:
+            out = step(*args)
+            jax.block_until_ready(out)
+        return out
+    return step(*args)
+
+
+def _serial_dispatch_required(mesh: Mesh) -> bool:
+    return (int(mesh.devices.size) > 1
+            and jax.devices()[0].platform == "cpu")
+
+
+def host_serve_enabled() -> bool:
+    """CPU backends keep a host-native serving path (eager CSR scorer /
+    BLAS blocked scan) by default — it beats XLA:CPU outright.
+    ``ES_TPU_PLANE_HOST_SERVE=0`` disables that fallback so serving runs
+    the jitted SPMD path even on a CPU backend: the MULTICHIP bench (and
+    the mesh-parity tests) measure the sharded device path itself, which
+    the host scorers would otherwise bypass."""
+    import os
+    return os.environ.get("ES_TPU_PLANE_HOST_SERVE", "1").lower() \
+        not in ("0", "false")
+
+
 # ---------------------------------------------------------------------------
 # SPMD step builders
 # ---------------------------------------------------------------------------
@@ -1364,11 +1409,18 @@ class DistributedSearchPlane:
         self.mesh = mesh
         self.field = field
         self.k1, self.b = k1, b
+        # the mesh partitions the leading corpus dim over the shard axis:
+        # absorb non-dividing shard counts with EMPTY pad shards (no
+        # postings, no docs) — they can never match a term, so results
+        # and hit coordinates are bit-identical to the same shard list on
+        # any other mesh shape. Real shard indices are unchanged (pads
+        # append), so callers decoding gdoc // n_pad are unaffected.
+        shards = list(shards)
+        for _ in range((-len(shards)) % mesh.shape[AXIS_SHARD]):
+            shards.append(self.empty_pad_shard())
         self.n_shards = len(shards)
         #: dispatches through a compiled step (tests assert the plane ran)
         self.n_dispatches = 0
-        if self.n_shards % mesh.shape[AXIS_SHARD]:
-            raise ValueError("shard count must divide mesh shard axis")
 
         self.n_pad = round_up_pow2(max(max(s["doc_len"].shape[0] for s in shards), 1))
         if dense_threshold is None:
@@ -1453,7 +1505,7 @@ class DistributedSearchPlane:
         # :meth:`search_eager` instead. Only retained on CPU — on TPU this
         # would duplicate the corpus in host RAM for nothing.
         self._host_csr = None
-        if jax.devices()[0].platform == "cpu":
+        if jax.devices()[0].platform == "cpu" and host_serve_enabled():
             self._host_csr = [
                 dict(offsets=s["offsets"], docs=s["docs"], impacts=imp,
                      n_docs=int(s["doc_len"].shape[0]))
@@ -1473,6 +1525,41 @@ class DistributedSearchPlane:
         self._steps: Dict[Tuple, callable] = {}
         # dispatcher threads + the warmup thread build steps concurrently
         self._steps_lock = threading.Lock()
+        self._serial_dispatch = _serial_dispatch_required(mesh)
+
+    @staticmethod
+    def empty_pad_shard(avgdl: Optional[float] = None) -> dict:
+        """Inert mesh-pad shard (no postings, no docs): absorbs shard
+        counts that don't divide the mesh's shard axis — it can never
+        match a term or emit a hit. The ONE definition of the pad-shard
+        schema, appended by both this constructor and the serving
+        cache's pack paths (which pass the generation's frozen
+        ``avgdl``, a no-op for a shard with no postings but kept
+        uniform with its real shard dicts)."""
+        sh = dict(term_ids={}, df=np.zeros(0, np.int32),
+                  offsets=np.zeros(1, np.int64),
+                  docs=np.zeros(0, np.int32), tf=np.zeros(0, np.float32),
+                  doc_len=np.zeros(0, np.float32))
+        if avgdl is not None:
+            sh["avgdl"] = avgdl
+        return sh
+
+    def device_corpus_bytes(self) -> int:
+        """Packed-corpus bytes RESIDENT PER DEVICE: the corpus arrays are
+        sharded over the ``shard`` axis (each device holds 1/s_dev of the
+        rows; replica groups hold full copies), so this is the per-chip
+        HBM cost the MULTICHIP bench asserts scales ~1/n_shards."""
+        s_dev = self.mesh.shape[AXIS_SHARD]
+        total = int(self.docs_dev.nbytes) + int(self.impacts_dev.nbytes)
+        if self.dense_dev is not None:
+            total += int(self.dense_dev.nbytes)
+        if self.blockmax is not None:
+            # the block-major device tier incl. its sentinel pad block:
+            # docs i32 + codes i8 per posting slot, scale/off per block
+            bmx = self.blockmax
+            nb1 = bmx.n_blocks + 1
+            total += len(bmx.shards) * nb1 * (bmx.block * 5 + 8)
+        return total // max(s_dev, 1)
 
     @classmethod
     def from_segments(cls, mesh: Mesh, segments: Sequence, field: str, **kw):
@@ -1764,7 +1851,7 @@ class DistributedSearchPlane:
                 jax.device_put(starts, repl3), jax.device_put(lengths, repl3),
                 jax.device_put(idfw, repl))
         t1 = time.perf_counter()
-        out = step(*step_args)
+        out = _run_step(self._serial_dispatch, step, *step_args)
         if stages is not None:
             # sync here so device time lands in dispatch_ms, not in the
             # first np.asarray of the fetch below
@@ -1772,6 +1859,8 @@ class DistributedSearchPlane:
         t2 = time.perf_counter()
         self.n_dispatches += 1
         from ..common import telemetry as _tm
+        _tm.record_mesh_dispatch(self.mesh.shape[AXIS_SHARD],
+                                 self.mesh.shape[AXIS_REPLICA])
         if stages is not None:
             # per-dispatch compile-cache verdict: profile's serving
             # section distinguishes a first-shape compile from steady state
@@ -2358,20 +2447,24 @@ class DistributedSearchPlane:
         repl2 = NamedSharding(self.mesh, P(AXIS_REPLICA, AXIS_SHARD))
         repl3 = NamedSharding(self.mesh, P(AXIS_REPLICA, AXIS_SHARD, None))
         t1 = time.perf_counter()
-        out = step(self.docs_dev, self.impacts_dev,
-                   dev["docs"], dev["codes"], dev["scale"], dev["off"],
-                   jax.device_put(sched, repl3),
-                   jax.device_put(w_arr, repl3),
-                   jax.device_put(rho_arr, repl3),
-                   jax.device_put(slack_arr, repl2),
-                   jax.device_put(starts, repl3),
-                   jax.device_put(lengths, repl3),
-                   jax.device_put(idfw, repl))
+        out = _run_step(
+            self._serial_dispatch, step,
+            self.docs_dev, self.impacts_dev,
+            dev["docs"], dev["codes"], dev["scale"], dev["off"],
+            jax.device_put(sched, repl3),
+            jax.device_put(w_arr, repl3),
+            jax.device_put(rho_arr, repl3),
+            jax.device_put(slack_arr, repl2),
+            jax.device_put(starts, repl3),
+            jax.device_put(lengths, repl3),
+            jax.device_put(idfw, repl))
         if stages is not None:
             jax.block_until_ready(out)
         t2 = time.perf_counter()
         self.n_dispatches += 1
         from ..common import telemetry as _tm
+        _tm.record_mesh_dispatch(self.mesh.shape[AXIS_SHARD],
+                                 self.mesh.shape[AXIS_REPLICA])
         compiled = _tm.last_call_compiled()
         gvals = np.asarray(out[0])[:B]
         gdocs = np.asarray(out[1])[:B]
@@ -2504,10 +2597,17 @@ class DistributedKnnPlane:
         self.mesh = mesh
         self.similarity = similarity
         self.block = block
+        # same padding rule as DistributedSearchPlane: empty pad shards
+        # (zero rows, exists all-False) absorb shard counts that don't
+        # divide the mesh's shard axis; their rows score NEG_INF exactly
+        # like within-shard pad rows, so results are mesh-shape-invariant
+        shards = list(shards)
+        _dim0 = next((int(s["vectors"].shape[1]) for s in shards
+                      if s["vectors"].size), 1)
+        for _ in range((-len(shards)) % mesh.shape[AXIS_SHARD]):
+            shards.append(self.empty_pad_shard(_dim0))
         self.n_shards = len(shards)
         self.n_dispatches = 0
-        if self.n_shards % mesh.shape[AXIS_SHARD]:
-            raise ValueError("shard count must divide mesh shard axis")
         dims = {int(s["vectors"].shape[1]) for s in shards
                 if s["vectors"].size}
         if len(dims) > 1:
@@ -2550,6 +2650,7 @@ class DistributedKnnPlane:
         # transiently hold 2x the corpus in HBM, and the _packed release
         # below must not race a concurrent reader)
         self._steps_lock = threading.Lock()
+        self._serial_dispatch = _serial_dispatch_required(mesh)
         # CPU fallback (same pattern as DistributedSearchPlane._host_csr):
         # XLA:CPU's dot/top_k run far below BLAS+introselect, so a CPU
         # backend serves through :meth:`search_host` — the same blocked
@@ -2557,7 +2658,17 @@ class DistributedKnnPlane:
         # numpy. Only set on CPU; serving never uploads a second (device)
         # corpus copy there, keeping the breaker estimate one-copy honest.
         self._host_pack = self._packed \
-            if jax.devices()[0].platform == "cpu" else None
+            if (jax.devices()[0].platform == "cpu"
+                and host_serve_enabled()) else None
+
+    @staticmethod
+    def empty_pad_shard(dim: int) -> dict:
+        """Inert mesh-pad shard (zero rows, ``exists`` all-False): its
+        rows score NEG_INF exactly like within-shard pad rows, so
+        results are mesh-shape-invariant. The one pad-shard schema for
+        both this constructor and the serving cache's kNN pack."""
+        return dict(vectors=np.zeros((0, max(int(dim), 1)), np.float32),
+                    exists=np.zeros(0, bool))
 
     def _device_arrays(self):
         with self._steps_lock:
@@ -2573,6 +2684,23 @@ class DistributedKnnPlane:
                     # a second copy in host RAM for the plane's lifetime
                     self._packed = None
             return self._dev
+
+    def device_corpus_bytes(self) -> int:
+        """Packed-corpus bytes RESIDENT PER DEVICE (vectors + invariants
+        + the IVF quantized tier when present), shard-axis-sharded — the
+        vector mirror of the text plane's accessor; the MULTICHIP bench
+        asserts it scales ~1/n_shards."""
+        s_dev = self.mesh.shape[AXIS_SHARD]
+        dim = max(self.dim, 1)
+        # vecs f32 + vnorm2 f32 + exists bool per padded row
+        total = self.n_shards * self.n_pad * (dim * 4 + 4 + 1)
+        if self.ivf is not None:
+            # block-major quantized tier incl. the sentinel pad block:
+            # codes + scale/off/rowid/rcl rows per slot
+            nb1 = self.ivf.n_blocks + 1
+            total += self.n_shards * nb1 * self.ivf.block * \
+                (dim * self.ivf.quant_bytes_per_dim() + 16)
+        return total // max(s_dev, 1)
 
     def resolve_ann(self, nprobe: Optional[int],
                     rerank: Optional[int]):
@@ -2648,13 +2776,16 @@ class DistributedKnnPlane:
         q_dev = jax.device_put(q, NamedSharding(self.mesh,
                                                 P(AXIS_REPLICA, None)))
         t1 = time.perf_counter()
-        out = step(vecs_dev, vnorm2_dev, exists_dev, q_dev)
+        out = _run_step(self._serial_dispatch, step,
+                        vecs_dev, vnorm2_dev, exists_dev, q_dev)
         if stages is not None:
             jax.block_until_ready(out)
         t2 = time.perf_counter()
         vals, gdocs = out
         self.n_dispatches += 1
         from ..common import telemetry as _tm
+        _tm.record_mesh_dispatch(self.mesh.shape[AXIS_SHARD],
+                                 self.mesh.shape[AXIS_REPLICA])
         compiled = _tm.last_call_compiled()
         vals = np.asarray(vals)[:B]
         gdocs = np.asarray(gdocs)[:B]
@@ -2840,15 +2971,19 @@ class DistributedKnnPlane:
         probed_dev = jax.device_put(probed, repl)
         u_dev = jax.device_put(u_blocks, shard2)
         t1 = time.perf_counter()
-        out = step(dev["codes"], dev["scale"], dev["off"], dev["rowid"],
-                   dev["rcl"], vecs_dev, vnorm2_dev, q_dev, probed_dev,
-                   u_dev)
+        out = _run_step(
+            self._serial_dispatch, step,
+            dev["codes"], dev["scale"], dev["off"], dev["rowid"],
+            dev["rcl"], vecs_dev, vnorm2_dev, q_dev, probed_dev,
+            u_dev)
         if stages is not None:
             jax.block_until_ready(out)
         t2 = time.perf_counter()
         vals, gdocs = out
         self.n_dispatches += 1
         from ..common import telemetry as _tm
+        _tm.record_mesh_dispatch(self.mesh.shape[AXIS_SHARD],
+                                 self.mesh.shape[AXIS_REPLICA])
         compiled = _tm.last_call_compiled()
         vals = np.asarray(vals)[:B]
         gdocs = np.asarray(gdocs)[:B]

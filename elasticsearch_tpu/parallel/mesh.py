@@ -15,6 +15,8 @@ fastest-ICI dimension of the slice since global top-k reduction rides it.
 
 from __future__ import annotations
 
+import logging
+import os
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -24,9 +26,26 @@ from jax.sharding import Mesh
 AXIS_REPLICA = "replica"
 AXIS_SHARD = "shard"
 
+logger = logging.getLogger("elasticsearch_tpu.mesh")
+
 
 def search_mesh_axes() -> Tuple[str, str]:
     return (AXIS_REPLICA, AXIS_SHARD)
+
+
+def record_mesh_devices(used: int, idle: int) -> None:
+    """Export the SERVING topology as ``es_mesh_devices{state=used|idle}``
+    gauges so health/``plane_serving`` can surface under-utilization.
+    Called only by the serving-mesh owners (``mesh_from_env`` and
+    ``ServingPlaneCache._get_mesh``'s factory path) — NOT by every
+    ``make_search_mesh``: auxiliary mesh builds (a bench's 1x1 reference
+    plane, the lint workload, tests) must not clobber the health signal
+    for the mesh that is actually serving."""
+    from ..common import telemetry as _tm
+    _tm.DEFAULT.gauge("es_mesh_devices", {"state": "used"},
+                      help="devices in (used) / left out of (idle) the "
+                           "serving search mesh").set(used)
+    _tm.DEFAULT.gauge("es_mesh_devices", {"state": "idle"}).set(idle)
 
 
 def make_search_mesh(n_shards: Optional[int] = None, n_replicas: int = 1,
@@ -36,7 +55,10 @@ def make_search_mesh(n_shards: Optional[int] = None, n_replicas: int = 1,
     Defaults: all local devices, one replica group. ``n_shards`` defaults to
     ``len(devices) // n_replicas``. When both axes are given explicitly the
     first ``n_replicas * n_shards`` devices are used and any excess devices
-    are left idle; raises if fewer are available.
+    are left idle (logged; the SERVING-mesh owners additionally export
+    ``es_mesh_devices{state=idle}`` via :func:`record_mesh_devices` so
+    under-utilization is visible to health/stats — auxiliary mesh builds
+    deliberately don't touch that gauge); raises if fewer are available.
     """
     devices = list(devices if devices is not None else jax.devices())
     if n_shards is None:
@@ -49,5 +71,38 @@ def make_search_mesh(n_shards: Optional[int] = None, n_replicas: int = 1,
         raise ValueError(
             f"mesh {n_replicas}x{n_shards} needs {need} devices, "
             f"have {len(devices)}")
+    idle = len(devices) - need
+    if idle:
+        logger.warning(
+            "search mesh %dx%d (replica x shard) uses %d of %d devices; "
+            "%d device(s) stranded idle — raise ES_TPU_MESH_SHARDS/"
+            "ES_TPU_MESH_REPLICAS to cover the slice",
+            n_replicas, n_shards, need, len(devices), idle)
     grid = np.asarray(devices[:need]).reshape(n_replicas, n_shards)
     return Mesh(grid, (AXIS_REPLICA, AXIS_SHARD))
+
+
+def mesh_from_env(devices: Optional[Sequence] = None) -> Mesh:
+    """The serving mesh per the ``ES_TPU_MESH_SHARDS`` /
+    ``ES_TPU_MESH_REPLICAS`` env knobs.
+
+    Default (neither set): every available device on the ``shard`` axis —
+    corpus capacity scales first, and per ``make_search_mesh``'s own doc
+    the shard axis should own the fastest-ICI dim since the global top-k
+    reduce rides it. ``ES_TPU_MESH_REPLICAS`` alone splits the devices
+    into that many full corpus copies; ``ES_TPU_MESH_SHARDS`` alone caps
+    the shard axis (excess devices idle, warned + gauged above).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    raw_sh = os.environ.get("ES_TPU_MESH_SHARDS", "").strip()
+    raw_rp = os.environ.get("ES_TPU_MESH_REPLICAS", "").strip()
+    n_replicas = max(int(raw_rp), 1) if raw_rp else 1
+    if raw_sh:
+        n_shards: Optional[int] = max(int(raw_sh), 1)
+    else:
+        n_shards = max(len(devices) // n_replicas, 1)
+    mesh = make_search_mesh(n_shards=n_shards, n_replicas=n_replicas,
+                            devices=devices)
+    record_mesh_devices(int(mesh.devices.size),
+                        len(devices) - int(mesh.devices.size))
+    return mesh

@@ -76,6 +76,9 @@ def empty_serving_stats() -> Dict[str, int]:
         "warmed_shapes": 0, "warmup_time_in_millis": 0,
         "queue_time_in_millis": 0, "prep_time_in_millis": 0,
         "dispatch_time_in_millis": 0, "fetch_time_in_millis": 0,
+        # serving-mesh topology (max-merged across batchers — every
+        # generation of one cache shares the cache's mesh)
+        "mesh_shard_devices": 0, "mesh_replica_devices": 0,
     }
 
 
@@ -159,6 +162,20 @@ class PlaneMicroBatcher:
         self.stage_totals_ms: Dict[str, float] = {s: 0.0 for s in STAGES}
         self.stage_samples: Dict[str, deque] = {
             s: deque(maxlen=STAGE_SAMPLE_CAP) for s in STAGES}
+        # serving-mesh fan-out, resolved once (the plane's mesh never
+        # changes under a batcher — a repack swaps the whole generation
+        # AND its batcher): replica axis sizes the co-batched block's
+        # pad, shard axis splits docs-scanned attribution per device
+        mesh = getattr(plane, "mesh", None)
+        self.mesh_shard_devices = 1
+        self.mesh_replica_devices = 1
+        if mesh is not None:
+            try:
+                from ..parallel.mesh import AXIS_REPLICA, AXIS_SHARD
+                self.mesh_shard_devices = int(mesh.shape[AXIS_SHARD])
+                self.mesh_replica_devices = int(mesh.shape[AXIS_REPLICA])
+            except Exception:   # noqa: BLE001 — foreign mesh-less plane
+                pass
 
     # -- client entry -------------------------------------------------------
 
@@ -313,8 +330,16 @@ class PlaneMicroBatcher:
         # pad the batch to a power of two: every distinct traced B shape is
         # a fresh XLA compile — ragged arrival sizes would otherwise
         # compile dozens of programs (padding slots score as no-op
-        # queries, same as the plane's own replica padding)
+        # queries). Then pad on to a REPLICA-axis multiple: the mesh
+        # partitions the batch dim over replica groups (the pad at
+        # dist_search.search would add it anyway), and filling the
+        # per-replica sub-batches here keeps the batcher's co-batched
+        # block equal to the traced block — warm-lattice shapes ARE the
+        # serving shapes at every mesh.
         b_pad = 1 << max(0, (len(uniq) - 1).bit_length())
+        rm = self.mesh_replica_devices
+        if rm > 1:
+            b_pad = -(-b_pad // rm) * rm
         queries = uniq + [self._pad_slot()
                           for _ in range(b_pad - len(uniq))]
         plane_stages: Dict[str, float] = {}
@@ -366,6 +391,13 @@ class PlaneMicroBatcher:
         batch_info["docs_scanned"] = int(
             (base_docs if scanned is None else scanned)
             + plane_stages.get("delta_docs", 0))
+        # per-DEVICE share of the scan: the shard axis partitions the
+        # corpus, so each chip streams ~1/s_dev of the scanned rows (the
+        # delta tier is host-side and excluded) — task attribution and
+        # plane_serving report both views
+        sdev = max(self.mesh_shard_devices, 1)
+        base_scan = int(base_docs if scanned is None else scanned)
+        batch_info["docs_scanned_per_device"] = -(-base_scan // sdev)
         delta_ms = plane_stages.get("delta_ms")
         if delta_ms is not None:
             # this dispatch merged the base plane with a live delta tier:
@@ -489,7 +521,9 @@ class PlaneMicroBatcher:
                 delta_queries=self.n_delta_queries,
                 delta_time_in_millis=int(self.delta_ms),
                 warmed_shapes=self.warmed_shapes,
-                warmup_time_in_millis=int(self.warmup_ms))
+                warmup_time_in_millis=int(self.warmup_ms),
+                mesh_shard_devices=self.mesh_shard_devices,
+                mesh_replica_devices=self.mesh_replica_devices)
             for name in STAGES:
                 out[f"{name}_time_in_millis"] = int(
                     self.stage_totals_ms[name])

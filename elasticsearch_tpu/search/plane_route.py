@@ -658,6 +658,22 @@ class ServingPlaneCache:
                   for (k, t, m), c in self._rebuild_counts.items()]
             ds = [({"kind": k}, c.value)
                   for k, c in self._delta_serve_counts.items()]
+        # per-device resident plane bytes: every generation's base plane
+        # reports its per-chip share (shard-axis sharding divides the
+        # corpus; replica rows hold full copies), summed per device id —
+        # the HBM-budget view of multichip serving. Outside _metric_lock
+        # (generations() takes _gen_lock; keep the two independent).
+        per_dev: Dict[int, int] = {}
+        for gen in self.generations():
+            base = gen.__dict__.get("base", gen)
+            try:
+                share = int(base.device_corpus_bytes())
+                devices = list(base.mesh.devices.flat)
+            except Exception:   # noqa: BLE001 — foreign/legacy planes
+                continue
+            for d in devices:
+                did = int(getattr(d, "id", 0))
+                per_dev[did] = per_dev.get(did, 0) + share
         return {
             "es_plane_rebuild_total": {
                 "type": "counter",
@@ -672,6 +688,13 @@ class ServingPlaneCache:
                 "help": "background repack build+swap wall ms by kind",
                 "samples": [({"kind": k}, h.snapshot())
                             for k, h in self._swap_ms.items()]},
+            "es_plane_hbm_bytes": {
+                "type": "gauge",
+                "help": "packed serving-plane bytes resident per device "
+                        "(estimate; shard-sharded corpus / replica "
+                        "copies)",
+                "samples": [({"device": str(did)}, b)
+                            for did, b in sorted(per_dev.items())]},
         }
 
     def _record_rebuild(self, kind: str, trigger: str, mode: str) -> None:
@@ -765,27 +788,52 @@ class ServingPlaneCache:
         self._retire(gen)
 
     def _get_mesh(self):
-        # under _mesh_lock: a cold request-thread build racing the
-        # background repack would otherwise both see None and build two
-        # meshes (ESTP-R01). Every read goes through the lock too — a
-        # lock-free fast path would empty the static lockset
-        # intersection, and one uncontended acquire is noise next to a
-        # plane build. Leaf lock: nothing inside takes _gen_lock, so
-        # build paths holding _gen_lock nest safely (gen -> mesh only).
+        # every read goes through _mesh_lock — a lock-free fast path
+        # would empty the static lockset intersection (ESTP-R01), and
+        # one uncontended acquire is noise next to a plane build. Leaf
+        # lock: nothing inside takes _gen_lock, so build paths holding
+        # _gen_lock nest safely (gen -> mesh only).
+        with self._mesh_lock:
+            mesh = self._mesh
+        if mesh is not None:
+            return mesh
+        # build OUTSIDE the lock: the cold build (jax import + device
+        # enumeration + the es_mesh_devices gauge registration, or an
+        # arbitrary user factory) can take seconds and must not stall
+        # stats scrapes on the lock — and telemetry must never run
+        # under a serving lock (ESTP-L02). Concurrent cold builders
+        # race benignly: the first swap wins, the loser's mesh is
+        # dropped (meshes hold no device memory).
+        if self._mesh_factory is not None:
+            mesh = self._mesh_factory()
+            # the factory mesh IS the serving mesh: own the idle-device
+            # health gauge the same way mesh_from_env does for the
+            # default path (auxiliary make_search_mesh builds don't)
+            import jax
+            from ..parallel.mesh import record_mesh_devices
+            used = int(mesh.devices.size)
+            record_mesh_devices(used,
+                                max(len(jax.devices()) - used, 0))
+        else:
+            # serving default: the (replica, shard) mesh over EVERY
+            # available device — all devices on the shard axis unless
+            # ES_TPU_MESH_SHARDS / ES_TPU_MESH_REPLICAS say otherwise
+            # (parallel/mesh.mesh_from_env) — so per-device corpus
+            # bytes scale ~1/n_shards out of the box.
+            from .. import parallel as par
+            mesh = par.mesh_from_env()
         with self._mesh_lock:
             if self._mesh is None:
-                if self._mesh_factory is not None:
-                    self._mesh = self._mesh_factory()
-                else:
-                    # serving default: the local device. Multi-chip
-                    # serving uses a factory wired by the node (mesh
-                    # over its chips).
-                    import jax
-                    from .. import parallel as par
-                    self._mesh = par.make_search_mesh(
-                        n_shards=1, n_replicas=1,
-                        devices=jax.devices()[:1])
+                self._mesh = mesh
             return self._mesh
+
+    def _mesh_fanout(self):
+        """(shard-axis devices, replica-axis devices) of the serving
+        mesh — pack paths pad shard lists to a shard-axis multiple and
+        scale breaker estimates by the replica fan-out."""
+        from ..parallel.mesh import AXIS_REPLICA, AXIS_SHARD
+        mesh = self._get_mesh()
+        return mesh.shape[AXIS_SHARD], mesh.shape[AXIS_REPLICA]
 
     def _next_ver(self) -> int:
         with self._gen_lock:
@@ -969,6 +1017,15 @@ class ServingPlaneCache:
         batcher + warmup, atomic swap (releasing the old generation)."""
         from ..parallel.dist_search import DistributedSearchPlane as _P
         shards, avgdl = self._pack_text_shards(segments, field)
+        # pad the shard list to a shard-axis multiple with empty shards
+        # (no postings, no docs): the mesh partitions the leading corpus
+        # dim over the shard axis, and a segment count that doesn't
+        # divide it must not bounce the route back to the per-segment
+        # path. Padding shards score nothing (no postings) and never
+        # emit hits, so base_pos decoding only ever sees real shards.
+        s_dev, n_repl = self._mesh_fanout()
+        for _ in range((-len(shards)) % s_dev):
+            shards.append(_P.empty_pad_shard(avgdl))
         # the dense tier is the big persistent allocation (T_pad × n_pad
         # bf16 per shard): reserve its estimate against the accounting
         # breaker BEFORE building, so an overfull node 429s instead of
@@ -995,7 +1052,14 @@ class ServingPlaneCache:
         if total_docs >= max(self.lex_prune_min_docs, 1):
             bmx_kw = {}
             nbytes += int(n_postings * 5.2) + 4096
-        acct.add_estimate(nbytes, f"<serving plane [{field}]>")
+        # device arrays replicate across the replica axis (each replica
+        # group holds a full corpus copy), so the reservation scales by
+        # the replica fan-out; the label records the per-DEVICE share
+        # (shard-axis partitioning divides the bytes each chip holds)
+        nbytes *= max(n_repl, 1)
+        acct.add_estimate(
+            nbytes, f"<serving plane [{field}] mesh {n_repl}x{s_dev}, "
+                    f"~{nbytes // max(s_dev * n_repl, 1)} B/device>")
         try:
             plane = _P(self._get_mesh(), shards, field, blockmax=bmx_kw)
         except Exception:
@@ -1205,6 +1269,12 @@ class ServingPlaneCache:
             if not s["exists"].any():
                 s["vectors"] = np.zeros((s["exists"].shape[0], dim),
                                         np.float32)
+        # pad the shard list to a shard-axis multiple with empty shards
+        # (exists all-False — they score NEG_INF and never emit hits),
+        # same as the lexical pack: the corpus dim must divide the mesh
+        s_dev, n_repl = self._mesh_fanout()
+        for _ in range((-len(shards)) % s_dev):
+            shards.append(DistributedKnnPlane.empty_pad_shard(dim))
         # the packed corpus (f32[S, n_pad, dim] + invariants) is the big
         # persistent allocation: reserve it against the accounting breaker
         # before building, like the lexical plane's dense tier
@@ -1223,7 +1293,11 @@ class ServingPlaneCache:
             ivf_kw = {}
             nbytes += len(shards) * n_pad * (dim + 12)
         key = (field, tuple(id(s) for s in segments))
-        acct.add_estimate(nbytes, f"<knn serving plane [{field}]>")
+        # replica groups hold full corpus copies (see the lexical pack)
+        nbytes *= max(n_repl, 1)
+        acct.add_estimate(
+            nbytes, f"<knn serving plane [{field}] mesh {n_repl}x{s_dev},"
+                    f" ~{nbytes // max(s_dev * n_repl, 1)} B/device>")
         try:
             plane = DistributedKnnPlane(self._get_mesh(), shards,
                                         similarity=similarity,

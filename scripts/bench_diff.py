@@ -1,11 +1,24 @@
 #!/usr/bin/env python3
-"""Diff two ``BENCH_*.json`` result files per config and gate on
-throughput regressions.
+"""Diff two ``BENCH_*.json`` (or ``MULTICHIP_*.json``) result files per
+config and gate on throughput regressions.
 
 Usage::
 
     python scripts/bench_diff.py BENCH_r05.json BENCH_r06.json
     python scripts/bench_diff.py old.json new.json --threshold 0.10
+    python scripts/bench_diff.py MULTICHIP_r05.json MULTICHIP_r06.json
+
+``MULTICHIP_*.json`` files (the driver's dryrun record: ``{"n_devices",
+"rc", "ok", "tail"}`` where ``tail`` holds ``dryrun_multichip``'s sweep
+summary line) are detected by shape and their 1/2/4/8-device sweep
+points become per-device-count configs (``multichip_4dev`` …): the
+usual >threshold throughput gate applies per device count, per-device
+packed-corpus bytes must not GROW past the threshold, and the new
+file's own sweep must still show ~1/n_shards bytes scaling. Device
+counts present on only one side are SKIPPED with a note (a machine
+with fewer cores sweeps fewer points — that is not a regression).
+Legacy empty-``tail`` shells contribute no configs, so every new point
+is one-sided and the diff passes with notes.
 
 Prints one line per comparable metric — the headline plus every entry in
 ``configs`` that carries a throughput ``value`` (unit ``*/s``) — with the
@@ -40,11 +53,75 @@ def _is_throughput(doc) -> bool:
 
 def _unwrap(doc: dict) -> dict:
     """Accept both the raw bench line and the driver's wrapper (the
-    ``BENCH_r*.json`` files nest the bench JSON under ``parsed``)."""
+    ``BENCH_r*.json`` files nest the bench JSON under ``parsed``;
+    ``MULTICHIP_r*.json`` files carry the sweep summary inside
+    ``tail``)."""
     if isinstance(doc.get("parsed"), dict) and (
             "value" in doc["parsed"] or "configs" in doc["parsed"]):
         return doc["parsed"]
+    # the MULTICHIP record is keyed by n_devices (BENCH wrappers carry
+    # rc/tail TOO, but nest the bench doc under parsed — handled above)
+    if "tail" in doc and "n_devices" in doc:
+        return _multichip_configs(doc)
     return doc
+
+
+def _multichip_configs(doc: dict) -> dict:
+    """MULTICHIP record -> configs-shaped doc: one throughput config per
+    swept device count, carrying the per-device corpus bytes so the diff
+    can gate bytes growth and scaling. An empty/unparseable ``tail``
+    (the pre-sweep shells) yields zero configs."""
+    sweep = None
+    for line in reversed(str(doc.get("tail", "")).strip().splitlines()):
+        try:
+            cand = json.loads(line)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(cand, dict) and isinstance(cand.get("sweep"), list):
+            sweep = cand["sweep"]
+            break
+    configs = {}
+    for pt in sweep or []:
+        c = int(pt.get("devices", 0))
+        configs[f"multichip_{c}dev"] = {
+            "value": pt.get("qps"), "unit": "queries/s",
+            "p99_ms": pt.get("p99_ms"), "devices": c,
+            "mesh": pt.get("mesh"),
+            "steady_compiles": pt.get("steady_compiles"),
+            "text_device_bytes": pt.get("text_device_bytes"),
+            "knn_device_bytes": pt.get("knn_device_bytes"),
+        }
+    return {"backend": "cpu-virtual", "multichip": True,
+            "configs": configs}
+
+
+def _multichip_scaling_check(new: dict, tol_lo: float = 0.7,
+                             tol_hi: float = 1.35):
+    """Intra-file gate on the NEW sweep: per-device packed-corpus bytes
+    at c devices must sit within [tol_lo, tol_hi] of the 1/n_shards
+    ideal extrapolated from the sweep's smallest device count — the
+    whole point of sharding the planes. Returns failure strings."""
+    cfgs = {c["devices"]: c for c in (new.get("configs") or {}).values()
+            if isinstance(c.get("devices"), int)}
+    if not cfgs:
+        # empty new sweep: a regression ONLY when the old side had one
+        # (the caller checks); two legacy shells diff clean with notes
+        return []
+    base_c = min(cfgs)
+    out = []
+    for kind in ("text_device_bytes", "knn_device_bytes"):
+        b0 = cfgs[base_c].get(kind)
+        if not isinstance(b0, (int, float)) or b0 <= 0:
+            continue
+        for c, cfg in sorted(cfgs.items()):
+            got = cfg.get(kind)
+            ideal = b0 * base_c / c
+            if not isinstance(got, (int, float)) or \
+                    not (tol_lo * ideal <= got <= tol_hi * ideal):
+                out.append(
+                    f"multichip_{c}dev {kind}={got} breaks ~1/n_shards "
+                    f"scaling (ideal ~{ideal:.0f} from {base_c}dev)")
+    return out
 
 
 def _metrics(doc: dict):
@@ -69,6 +146,12 @@ RECALL_DROP_MAX = 0.01
 #: its whole point is a latency profile, so throughput alone can't
 #: certify it)
 P99_RISE_MAX = 0.25
+
+#: per-device packed-bytes growth that fails a MULTICHIP diff — fixed,
+#: never widened with the qps threshold: packed bytes are deterministic
+#: (measured from live buffers over a seeded corpus), so any growth is
+#: a real packing/sharding change, not noise
+DEVICE_BYTES_GROW_MAX = 0.10
 
 
 def diff(old: dict, new: dict, threshold: float,
@@ -127,9 +210,26 @@ def diff(old: dict, new: dict, threshold: float,
                     regressions.append(
                         f"{name} (p99 {o['p99_ms']:.1f} -> "
                         f"{n['p99_ms']:.1f} ms, {rise:+.0%})")
+        dbytes = ""
+        for bk in ("text_device_bytes", "knn_device_bytes"):
+            ob, nb = o.get(bk), n.get(bk)
+            if isinstance(ob, (int, float)) and ob > 0 and \
+                    isinstance(nb, (int, float)):
+                grow = (float(nb) - float(ob)) / float(ob)
+                dbytes += f"  {bk.split('_')[0]} B/dev {int(ob)} -> " \
+                          f"{int(nb)}"
+                # per-device HBM footprint is the multichip capacity
+                # budget — growing it at the same device count is a
+                # regression even if qps held (fixed gate: bytes are
+                # deterministic, unlike virtual-device qps)
+                if grow > DEVICE_BYTES_GROW_MAX:
+                    flag = "  << DEVICE-BYTES REGRESSION"
+                    regressions.append(
+                        f"{name} ({bk} {int(ob)} -> {int(nb)}, "
+                        f"{grow:+.0%})")
         lines.append(f"  {name:40s} {ov:>10.1f} -> {nv:>10.1f} "
                      f"{n.get('unit', ''):12s} {delta:+7.1%}{rec}{p99}"
-                     f"{flag}")
+                     f"{dbytes}{flag}")
     return lines, regressions
 
 
@@ -145,11 +245,19 @@ def main(argv=None) -> int:
     ap.add_argument("--p99-threshold", type=float, default=P99_RISE_MAX,
                     help="relative p99 rise that fails p99-gated configs "
                          "(default 0.25 = 25%%)")
+    ap.add_argument("--multichip-threshold", type=float, default=0.35,
+                    help="throughput threshold used instead when BOTH "
+                         "sides are MULTICHIP sweeps (default 0.35: "
+                         "virtual-device CPU qps carries ~30%% "
+                         "run-to-run scheduler noise — the bytes and "
+                         "scaling gates stay exact)")
     args = ap.parse_args(argv)
     with open(args.old) as f:
         old = _unwrap(json.load(f))
     with open(args.new) as f:
         new = _unwrap(json.load(f))
+    if old.get("multichip") and new.get("multichip"):
+        args.threshold = max(args.threshold, args.multichip_threshold)
     print(f"bench diff: {args.old} -> {args.new} "
           f"(threshold {args.threshold:.0%}, p99 "
           f"{args.p99_threshold:.0%})")
@@ -160,6 +268,17 @@ def main(argv=None) -> int:
                               args.p99_threshold)
     for ln in lines:
         print(ln)
+    if new.get("multichip"):
+        # the new sweep must hold its own ~1/n_shards bytes scaling
+        # regardless of what the old side measured
+        fails = _multichip_scaling_check(new)
+        if not (new.get("configs") or {}) and (old.get("configs") or {}):
+            fails.append("multichip sweep is empty (no per-device "
+                         "configs in tail) but the old side had one — "
+                         "the harness regressed to the empty shell")
+        for fail in fails:
+            print(f"  {fail}")
+            regressions.append(fail)
     if regressions:
         print(f"FAIL: {len(regressions)} regression(s) (throughput past "
               f"{args.threshold:.0%}, recall_at_k past "

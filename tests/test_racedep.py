@@ -350,6 +350,13 @@ _STRESS_SNIPPET = """
     sys.path.insert(0, {root!r})
     os.environ["ES_TPU_RACEDEP"] = "record"
     os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    # repack swap under a 2-D serving mesh: the generation double-buffer
+    # swaps a whole per-device array SET across both axes — the witness
+    # must stay race-free there too (multichip tentpole)
+    os.environ["ES_TPU_MESH_SHARDS"] = "4"
+    os.environ["ES_TPU_MESH_REPLICAS"] = "2"
     from elasticsearch_tpu.common import racedep
     assert racedep.install()      # BEFORE package locks exist
 
