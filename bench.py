@@ -852,6 +852,132 @@ def bench_hybrid_rrf(rng, mesh, on_cpu):
         "n_docs": n_hy, "window": window, "cpu_ref_qps": round(cpu_qps, 1)})
 
 
+def bench_hybrid_rrf_fused(rng, on_cpu):
+    """Config: hybrid RRF through the PRODUCT serving path — the
+    one-dispatch fused planner (``search/query_planner.py``: lexical +
+    kNN + rank fusion as ONE dispatch over the serving generations) vs
+    the legacy two-dispatch flow (text query phase + knn plane dispatch
+    + host-side RRF) on the SAME plane generations, same segments, same
+    queries — apples-to-apples down to the micro-batcher.
+
+    Correctness is asserted in-bench BEFORE any timing: fused results
+    must be bit-identical to the legacy path (ids, scores, tie order,
+    totals) on shared eval bodies — a fusion bug fails the bench, it
+    never reports a healthy speedup. The fused:legacy throughput ratio
+    is GATED at >= 1.5x (the PR 11 acceptance bar), and the fused timed
+    window asserts ZERO steady-state XLA compiles (the (B, k, L,
+    params) lattice absorbed every shape during warmup)."""
+    from elasticsearch_tpu.common import telemetry as _tm
+    from elasticsearch_tpu.index.mapping import MapperService
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.search.plane_route import ServingPlaneCache
+    from elasticsearch_tpu.search.shard_search import ShardSearcher
+    n_docs = int(os.environ.get("BENCH_FUSED_N_DOCS", 0)) or \
+        ((1 << 15) if on_cpu else (1 << 17))
+    dim, window, k_out = 64, 100, 10
+    vocab_n = 4096
+    mapper = MapperService({"properties": {
+        "body": {"type": "text"},
+        "vec": {"type": "dense_vector", "dims": dim,
+                "similarity": "dot_product"}}})
+    vocab = [f"w{i}" for i in range(vocab_n)]
+    zipf = np.minimum(rng.zipf(1.3, size=(n_docs, 12)) - 1, vocab_n - 1)
+    vecs = rng.randn(n_docs, dim).astype(np.float32)
+    t_build = time.perf_counter()
+    sb = SegmentBuilder("s0")
+    for i in range(n_docs):
+        sb.add(mapper.parse_document(
+            str(i), {"body": " ".join(vocab[t] for t in zipf[i]),
+                     "vec": vecs[i].tolist()}), seq_no=i)
+    segs = [sb.build()]
+    build_s = time.perf_counter() - t_build
+    cache = ServingPlaneCache()
+
+    def searcher(fused):
+        return ShardSearcher(
+            segs, mapper,
+            plane_provider=lambda s, f: cache.plane_for(s, mapper, f),
+            knn_plane_provider=lambda s, f:
+                cache.knn_plane_for(s, mapper, f),
+            fused_provider=(lambda s, tf, kf:
+                            cache.fused_runner_for(s, mapper, tf, kf))
+            if fused else None)
+
+    def body_of(i):
+        r2 = np.random.RandomState(1000 + i)
+        terms = " ".join(vocab[min(r2.zipf(1.3) - 1, vocab_n - 1)]
+                         for _ in range(N_TERMS))
+        return {"query": {"match": {"body": terms}},
+                "knn": {"field": "vec",
+                        "query_vector": [float(x) for x in
+                                         r2.randn(dim)],
+                        "k": k_out, "num_candidates": window},
+                "rank": {"rrf": {"rank_window_size": window}},
+                "size": k_out}
+
+    n_eval, n_timed = 6, 24
+    bodies = [body_of(i) for i in range(n_timed)]
+    s_fused, s_legacy = searcher(True), searcher(False)
+    # warm both paths (plane builds + batch shapes land here)
+    s_legacy.search(dict(bodies[0]))
+    s_fused.search(dict(bodies[0]))
+    # bit-identity gate on the shared eval bodies
+    for i in range(n_eval):
+        rf = s_fused.search(dict(bodies[i]))
+        rl = s_legacy.search(dict(bodies[i]))
+        same = ([h.doc_id for h in rf.hits] ==
+                [h.doc_id for h in rl.hits]
+                and [h.score for h in rf.hits] ==
+                [h.score for h in rl.hits]
+                and (rf.total, rf.total_relation) ==
+                (rl.total, rl.total_relation))
+        if not same:
+            raise SystemExit(
+                "hybrid_rrf_fused parity violated: fused != two-dispatch")
+    ts_leg = []
+    for bdy in bodies:
+        t0 = time.perf_counter()
+        s_legacy.search(dict(bdy))
+        ts_leg.append(time.perf_counter() - t0)
+    compiles_before = _tm.compile_count()
+    ts_fus = []
+    for bdy in bodies:
+        t0 = time.perf_counter()
+        s_fused.search(dict(bdy))
+        ts_fus.append(time.perf_counter() - t0)
+    steady_compiles = _tm.compile_count() - compiles_before
+    if steady_compiles:
+        raise SystemExit(
+            f"hybrid_rrf_fused: {steady_compiles} steady-state compiles "
+            f"in the fused window (warm lattice failed)")
+    ts_fus = np.asarray(ts_fus)
+    fused_qps = n_timed / ts_fus.sum()
+    legacy_qps = n_timed / sum(ts_leg)
+    ratio = fused_qps / legacy_qps
+    if ratio < 1.5:
+        raise SystemExit(
+            f"hybrid_rrf_fused below the 1.5x acceptance bar: "
+            f"{ratio:.2f}x ({legacy_qps:.1f} -> {fused_qps:.1f} q/s)")
+    planner = _tm.DEFAULT.metrics_doc().get("es_planner_lowered_total")
+    fused_served = int(sum(
+        s["value"] for s in (planner or {}).get("series", [])
+        if s["labels"].get("outcome") == "fused"))
+    cache.release()
+    return _emit("hybrid_rrf_fused", {
+        "value": round(fused_qps, 1), "unit": "queries/s",
+        "vs_two_dispatch": round(ratio, 2),
+        "two_dispatch_qps": round(legacy_qps, 1),
+        "p99_ms": round(float(np.percentile(ts_fus, 99) * 1e3), 2),
+        "two_dispatch_p99_ms": round(
+            float(np.percentile(ts_leg, 99) * 1e3), 2),
+        "p99_gate": True,
+        "parity": "asserted-bit-identical",
+        "steady_compiles": steady_compiles,
+        "planner_fused_requests": fused_served,
+        "n_docs": n_docs, "window": window, "k": k_out,
+        "index_build_s": round(build_s, 1)})
+
+
 def bench_serving(rng):
     """REST serving under concurrency: 32 client threads through
     ``RestAPI.handle`` → dispatcher-thread micro-batching queue. The
@@ -1305,6 +1431,7 @@ def main(mode: str = "accel"):
         # not pruning
         run("lexical_10m_prune", bench_lexical_prune, rng, mesh, on_cpu)
     run("hybrid_rrf", bench_hybrid_rrf, rng, mesh, on_cpu)
+    run("hybrid_rrf_fused", bench_hybrid_rrf_fused, rng, on_cpu)
     run("serving", bench_serving, rng)
     run("live_indexing", bench_live_indexing, rng)
 

@@ -20,7 +20,11 @@ report can never disagree:
   (every refresh repacking the serving plane on request threads).
 - ``compile_churn`` — steady-state XLA compiles: compiles recorded past
   what the warmup lattice pre-compiled mean first-hit compiles are
-  landing mid-traffic (the multi-second p99 signature).
+  landing mid-traffic (the multi-second p99 signature). Windowed per
+  evaluator since the previous health evaluation (the compile counter
+  is process-cumulative while warmed credits die with retired
+  batchers; judging all of process history against live batchers only
+  would accumulate phantom excess).
 - ``breakers`` — circuit-breaker trips (parent trip → red).
 - ``indexing_pressure`` — 429 rejections + current bytes vs the budget.
 - ``task_backlog`` — live registered tasks and the oldest task's age.
@@ -306,12 +310,38 @@ class HealthService:
     def _ind_compile_churn(self) -> dict:
         from . import telemetry as _tm
         compiles = _tm.compile_count()
-        warmed = 0
+        live_warmed = 0
         doc_reg = _tm.DEFAULT.stats_doc().get(
             "es_plane_serving_warmed_shapes_total")
         if doc_reg:
-            warmed = int(sum(s["value"] for s in doc_reg["series"]))
-        excess = max(compiles - warmed, 0)
+            live_warmed = int(sum(s["value"]
+                                  for s in doc_reg["series"]))
+        # warmed credit comes from the PROCESS-CUMULATIVE counter
+        # (telemetry.record_warmed_shapes), not the live batchers'
+        # rollup: per-batcher credits die with their weakref'd
+        # collectors when a generation retires, so a repack inside one
+        # window would otherwise cancel its replacement's warmup credit
+        # and read as phantom churn.
+        warmed = max(_tm.warmed_shapes_count(), live_warmed)
+        # windowed against the previous health evaluation (watermark on
+        # the api object, the ann-drift pattern above): both counters
+        # are monotone, so churn is judged on compiles SINCE the last
+        # evaluation vs warmed since the last evaluation; the first
+        # evaluation baselines the watermark (process history has no
+        # matching warmed history).
+        if self.api is not None:
+            with _ANN_DRIFT_LOCK:
+                seen_c = getattr(self.api, "_compile_seen", None)
+                seen_w = getattr(self.api, "_warmed_seen", 0)
+                self.api._compile_seen = compiles
+                self.api._warmed_seen = warmed
+            if seen_c is None:
+                excess = 0
+            else:
+                excess = max((compiles - seen_c)
+                             - max(warmed - seen_w, 0), 0)
+        else:
+            excess = max(compiles - warmed, 0)
         if excess > self.COMPILE_RED:
             status = RED
         elif excess > self.COMPILE_SLACK:

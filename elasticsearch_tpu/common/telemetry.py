@@ -49,6 +49,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 __all__ = [
     "Counter", "Gauge", "Histogram", "TelemetryRegistry", "DEFAULT",
     "record_compile", "record_transfer", "record_ann", "record_lex",
+    "record_planner", "record_planner_dispatch",
+    "record_warmed_shapes", "warmed_shapes_count",
     "record_mesh_dispatch", "mesh_idle_devices",
     "instrument_step", "device_stats_doc", "ann_drift_count",
     "lex_prune_off_count",
@@ -540,6 +542,59 @@ def record_lex(blocks_scored: int = 0, blocks_skipped: int = 0,
                 help="lexical dispatches that forced prune=off on a "
                      "block-max plane (benched-default drift)").inc(
                          1 if prune_off else 0)
+
+
+def record_warmed_shapes(n: int,
+                         registry: Optional[TelemetryRegistry]
+                         = None) -> None:
+    """Warmup-lattice shape pre-compiles, PROCESS-CUMULATIVE — unlike
+    the per-batcher ``warmed_shapes`` stat (which dies with its
+    batcher's weakref'd collector when a generation retires), this
+    counter survives repacks, so the ``compile_churn`` health window
+    can credit a new generation's warmup compiles even after the old
+    batcher's credit was garbage-collected. Recorded with n=0 at every
+    warmup START so the family's presence is deterministic."""
+    reg = registry or DEFAULT
+    reg.counter("es_warmup_shapes_total",
+                help="serving shapes pre-compiled by warmup lattices "
+                     "(cumulative across retired generations)").inc(n)
+
+
+def warmed_shapes_count(registry: Optional[TelemetryRegistry]
+                        = None) -> int:
+    reg = registry or DEFAULT
+    doc = reg.metrics_doc().get("es_warmup_shapes_total")
+    if not doc:
+        return 0
+    return int(sum(s["value"] for s in doc["series"]))
+
+
+def record_planner(outcome: str,
+                   registry: Optional[TelemetryRegistry] = None) -> None:
+    """One request through the one-dispatch query planner
+    (``search/query_planner.py``): ``outcome="fused"`` when the lowered
+    plan actually served as a single fused dispatch, ``"fallback"``
+    when the body was not lowerable or its runner could not serve it
+    and the legacy two-dispatch + host-fusion path served instead."""
+    reg = registry or DEFAULT
+    # both label values are pre-created so the family's label space is
+    # stable for the telemetry lint on nodes that only ever see one
+    for oc in ("fused", "fallback"):
+        reg.counter("es_planner_lowered_total", {"outcome": oc},
+                    help="query-planner routing verdicts per request"
+                    ).inc(1 if oc == outcome else 0)
+
+
+def record_planner_dispatch(stages_n: int,
+                            registry: Optional[TelemetryRegistry]
+                            = None) -> None:
+    """One FUSED serving dispatch: how many pipeline stages (lexical
+    scan, knn scan, rank fusion, rescore reorder) it folded into the
+    single program — the planner's fusion-depth distribution."""
+    reg = registry or DEFAULT
+    reg.histogram("es_planner_stages_per_dispatch",
+                  help="retrieval stages folded into one fused "
+                       "dispatch").observe(float(stages_n))
 
 
 def record_mesh_dispatch(n_shard_devices: int, n_replica_devices: int,

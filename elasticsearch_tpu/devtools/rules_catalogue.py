@@ -88,6 +88,14 @@ def runtime_families() -> Set[str]:
         api.handle("POST", "/lint/_search", "", json.dumps(
             {"knn": {"field": "vec", "query_vector": [1, 0, 0, 0],
                      "k": 1, "num_candidates": 5}}).encode())
+        # fused one-dispatch planner: a lowerable hybrid RRF body runs
+        # lexical + knn + fusion as ONE dispatch and registers the
+        # es_planner_* families (lowered counter + stage histogram)
+        api.handle("POST", "/lint/_search", "", json.dumps(
+            {"query": {"match": {"body": "quick"}},
+             "knn": {"field": "vec", "query_vector": [1, 0, 0, 0],
+                     "k": 1, "num_candidates": 5},
+             "rank": {"rrf": {"rank_window_size": 5}}}).encode())
         # delta tier + sync repack path (delta-serve + rebuild families)
         svc = api.indices.get("lint")
         svc.plane_cache.repack_mode = "sync"

@@ -306,7 +306,10 @@ class IndexService:
             plane_provider=lambda segs, field:
                 self.plane_cache.plane_for(segs, self.mapper, field),
             knn_plane_provider=lambda segs, field:
-                self.plane_cache.knn_plane_for(segs, self.mapper, field))
+                self.plane_cache.knn_plane_for(segs, self.mapper, field),
+            fused_provider=lambda segs, tf, kf:
+                self.plane_cache.fused_runner_for(segs, self.mapper,
+                                                  tf, kf))
         mao = self.settings.get("index.highlight.max_analyzed_offset")
         if mao is not None:
             sr.max_analyzed_offset = int(mao)
@@ -322,7 +325,10 @@ class IndexService:
             plane_provider=lambda segs, field:
                 self.plane_cache.plane_for(segs, self.mapper, field),
             knn_plane_provider=lambda segs, field:
-                self.plane_cache.knn_plane_for(segs, self.mapper, field))
+                self.plane_cache.knn_plane_for(segs, self.mapper, field),
+            fused_provider=lambda segs, tf, kf:
+                self.plane_cache.fused_runner_for(segs, self.mapper,
+                                                  tf, kf))
 
     #: request-cache entry cap per index (reference sizes by bytes —
     #: indices.requests.cache.size 1%; entries are simpler and safe here)

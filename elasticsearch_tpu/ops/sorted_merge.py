@@ -51,7 +51,7 @@ def make_impacts(tf: np.ndarray, docs: np.ndarray, doc_len: np.ndarray,
 
 
 def bm25_merge_candidates(postings_docs, postings_impact, starts, lengths,
-                          idfw, *, n_pad: int, L: int):
+                          idfw, *, n_pad: int, L: int, slot_bits=None):
     """Sorted-merge candidate stage shared by the plain top-k kernel and the
     tiered kernel (``ops/tiered_bm25.py``).
 
@@ -59,6 +59,14 @@ def bm25_merge_candidates(postings_docs, postings_impact, starts, lengths,
     is_last bool[Q*L])``: candidates sorted by doc id with each doc group's
     summed score/match-count materialized at its *last* slot (other slots
     hold partial prefixes — mask with ``is_last``).
+
+    ``slot_bits`` (optional int32[Q]): a per-slot tag bitmask carried
+    through the merge and OR-reduced per doc group — the bool-tree fused
+    kernel (``ops/fused_query.py``) tags each term slot with its owning
+    clause's bit so per-doc clause membership falls out of the same
+    merge that sums scores. When given, a fifth output ``gbits
+    int32[Q*L]`` is appended (group OR at the group's last slot, like
+    ``gscore``).
     """
     Q = starts.shape[0]
 
@@ -71,6 +79,10 @@ def bm25_merge_candidates(postings_docs, postings_impact, starts, lengths,
     valid = pos < lengths[:, None]
     docs = jnp.where(valid, docs, n_pad)
     contrib = jnp.where(valid, imps * idfw[:, None], 0.0)
+    bits = None
+    if slot_bits is not None:
+        bits = jnp.where(valid, slot_bits[:, None],
+                         jnp.int32(0))                       # [Q, L]
 
     # Combine the Q runs into one doc-ascending sequence. Each run is
     # ALREADY sorted (postings are doc-ordered; masked tails hold the
@@ -86,19 +98,23 @@ def bm25_merge_candidates(postings_docs, postings_impact, starts, lengths,
     # The valid flag needs no channel of its own: real doc ids are
     # strictly below the n_pad sentinel, so validity is recomputed from
     # the merged doc ids (saves one scatter in three).
-    items = [(docs[q], contrib[q]) for q in range(Q)]
+    items = [(docs[q], contrib[q]) + ((bits[q],) if bits is not None
+                                      else ()) for q in range(Q)]
     while len(items) > 1:
         merged = []
         for i in range(0, len(items) - 1, 2):
-            da, va = items[i]
-            db, vb = items[i + 1]
+            da, va = items[i][0], items[i][1]
+            db, vb = items[i + 1][0], items[i + 1][1]
             n, m = da.shape[0], db.shape[0]
             pa = jnp.arange(n, dtype=jnp.int32) + \
                 jnp.searchsorted(db, da, side="left").astype(jnp.int32)
             pb = jnp.arange(m, dtype=jnp.int32) + \
                 jnp.searchsorted(da, db, side="right").astype(jnp.int32)
             out = []
-            for xa, xb in ((da, db), (va, vb)):
+            pairs = [(da, db), (va, vb)]
+            if bits is not None:
+                pairs.append((items[i][2], items[i + 1][2]))
+            for xa, xb in pairs:
                 o = jnp.zeros((n + m,), xa.dtype)
                 o = o.at[pa].set(xa, unique_indices=True,
                                  indices_are_sorted=True)
@@ -109,7 +125,8 @@ def bm25_merge_candidates(postings_docs, postings_impact, starts, lengths,
         if len(items) % 2:
             merged.append(items[-1])
         items = merged
-    sdocs, scontrib = items[0]
+    sdocs, scontrib = items[0][0], items[0][1]
+    sbits = items[0][2] if bits is not None else None
     svalid = (sdocs < n_pad).astype(jnp.float32)
 
     # Segment-reduce groups of equal doc id (contiguous after the sort).
@@ -122,6 +139,7 @@ def bm25_merge_candidates(postings_docs, postings_impact, starts, lengths,
     is_last = sdocs != nxt
     gscore = scontrib
     gcount = svalid
+    gbits = sbits
     for j in range(1, Q):
         shifted_docs = jnp.concatenate(
             [jnp.full((j,), -1, sdocs.dtype), sdocs[:-j]])
@@ -132,6 +150,12 @@ def bm25_merge_candidates(postings_docs, postings_impact, starts, lengths,
         gcount = gcount + jnp.where(
             same, jnp.concatenate([jnp.zeros((j,), svalid.dtype),
                                    svalid[:-j]]), 0.0)
+        if gbits is not None:
+            gbits = gbits | jnp.where(
+                same, jnp.concatenate([jnp.zeros((j,), sbits.dtype),
+                                       sbits[:-j]]), jnp.int32(0))
+    if sbits is not None:
+        return sdocs, gscore, gcount, is_last, gbits
     return sdocs, gscore, gcount, is_last
 
 

@@ -46,6 +46,9 @@ except ImportError:  # pragma: no cover
                                  out_specs=out_specs, check_rep=check_vma)
 
 from ..ops.bm25 import DEFAULT_B, DEFAULT_K1, idf_weight
+from ..ops.fused_query import (bisect_exact_scores, bool_bm25_topk_body,
+                               knn_raw_to_score, rescore_reorder_body,
+                               rrf_fuse_body, sum_fuse_body)
 from ..ops.sorted_merge import bm25_topk_merge_body, make_impacts
 from ..ops.tiered_bm25 import (build_dense_rows, split_tiers,
                                tiered_bm25_topk)
@@ -107,7 +110,7 @@ def host_serve_enabled() -> bool:
 
 
 def _global_topk_reduce(vals, idx, *, s_loc: int, kk: int, n_pad: int,
-                        out_k: Optional[int] = None):
+                        out_k: Optional[int] = None, payload=()):
     """Shared ICI reduce: globalize local doc ids, merge the device's own
     shards, then all_gather + top_k over the shard axis. vals/idx are
     [B_loc, S_loc, kk]; returns ([B_loc, out_k], [B_loc, out_k]).
@@ -115,7 +118,12 @@ def _global_topk_reduce(vals, idx, *, s_loc: int, kk: int, n_pad: int,
     ``out_k`` (default ``kk``) is the GLOBAL result width: per-shard lists
     cap at that shard's pad (kk ≤ n_pad) but the union across shards can
     satisfy a larger k, so intermediate merges keep min(out_k, available)
-    candidates instead of collapsing to the per-shard cap."""
+    candidates instead of collapsing to the per-shard cap.
+
+    ``payload``: optional tuple of [B_loc, S_loc, kk] per-candidate
+    channels (e.g. the fused step's rescore secondaries) gathered along
+    the same selections; when non-empty the return grows a third
+    element, a tuple of [B_loc, out_k] arrays."""
     out_k = kk if out_k is None else out_k
     b_loc = vals.shape[0]
     shard0 = lax.axis_index(AXIS_SHARD) * s_loc
@@ -123,13 +131,20 @@ def _global_topk_reduce(vals, idx, *, s_loc: int, kk: int, n_pad: int,
     gidx = idx + sid[None, :, None] * n_pad
     vals = vals.reshape(b_loc, s_loc * kk)
     gidx = gidx.reshape(b_loc, s_loc * kk)
+    pls = [p.reshape(b_loc, s_loc * kk) for p in payload]
     if s_loc > 1 and s_loc * kk > out_k:
         vals, sel = lax.top_k(vals, out_k)
         gidx = jnp.take_along_axis(gidx, sel, axis=1)
+        pls = [jnp.take_along_axis(p, sel, axis=1) for p in pls]
     av_all = lax.all_gather(vals, AXIS_SHARD, axis=1, tiled=True)
     ai_all = lax.all_gather(gidx, AXIS_SHARD, axis=1, tiled=True)
+    pl_all = [lax.all_gather(p, AXIS_SHARD, axis=1, tiled=True)
+              for p in pls]
     gvals, gsel = lax.top_k(av_all, min(out_k, av_all.shape[1]))
     gdocs = jnp.take_along_axis(ai_all, gsel, axis=1)
+    if payload:
+        gpl = tuple(jnp.take_along_axis(p, gsel, axis=1) for p in pl_all)
+        return gvals, gdocs, gpl
     return gvals, gdocs
 
 
@@ -305,6 +320,74 @@ def prepare_knn_corpus(vecs: np.ndarray, similarity: str):
     return vecs, vnorm2
 
 
+def _knn_shard_scan(vecs_s, vn_s, exists_s, qq, qn, *, similarity: str,
+                    n_pad: int, dim: int, kk: int, blk: int,
+                    use_blocks: bool):
+    """One shard partition's blocked kNN top-k — the traced scoring
+    STAGE shared by :func:`build_knn_step` and the fused one-dispatch
+    program (``build_fused_hybrid_step``): [B,D]×[block,D]ᵀ matmuls
+    streamed over the corpus with a ``lax.scan``-carried running top-k.
+    ``qq`` is the packed-convention query batch (unit rows for cosine),
+    ``qn`` the cached ``Σq²`` rows (l2 only). Returns
+    (vals f32[B, kk], local idx i32[B, kk])."""
+
+    def score_block(vecs_b, vn_b, exists_b):
+        dots = jnp.einsum("bd,nd->bn", qq, vecs_b,
+                          preferred_element_type=jnp.float32)
+        if similarity == "l2_norm":
+            # -||q - v||² expanded to ride the MXU; ||v||² is the
+            # cached pack-time column, never recomputed per query
+            scores = 2.0 * dots - vn_b[None, :] - qn[:, None]
+        else:
+            scores = dots
+        return jnp.where(exists_b[None, :], scores, NEG_INF)
+
+    if not use_blocks:
+        vals, idx = batched_blockwise_topk(
+            score_block(vecs_s, vn_s, exists_s), kk)
+        return vals, idx.astype(jnp.int32)
+    nb = n_pad // blk
+    vecs_blk = vecs_s.reshape(nb, blk, dim)
+    vn_blk = vn_s.reshape(nb, blk)
+    exists_blk = exists_s.reshape(nb, blk)
+    # seed the accumulator from block 0 so every carried entry is
+    # a real (value, global index) pair: merges then keep the
+    # lowest global index among equal values — identical tie
+    # order (and identical -inf padding indices) to the one-shot
+    # full-matrix top_k
+    v0, i0 = batched_blockwise_topk(
+        score_block(vecs_blk[0], vn_blk[0], exists_blk[0]), kk)
+
+    def step_blk(carry, xs):
+        acc_v, acc_i = carry
+        b_idx, vecs_b, vn_b, exists_b = xs
+        bv, bi = batched_blockwise_topk(
+            score_block(vecs_b, vn_b, exists_b), kk)
+        gi = bi.astype(jnp.int32) + b_idx * blk
+        cat_v = jnp.concatenate([acc_v, bv], axis=1)
+        cat_i = jnp.concatenate([acc_i, gi], axis=1)
+        # earlier blocks sit first: top_k's lowest-position tie
+        # preference keeps doc-ascending tie order
+        nv, sel = lax.top_k(cat_v, kk)
+        ni = jnp.take_along_axis(cat_i, sel, axis=1)
+        return (nv, ni), None
+
+    (vals, idx), _ = lax.scan(
+        step_blk, (v0, i0.astype(jnp.int32)),
+        (jnp.arange(1, nb, dtype=jnp.int32), vecs_blk[1:],
+         vn_blk[1:], exists_blk[1:]))
+    return vals, idx
+
+
+def _knn_blocking(block: Optional[int], n_pad: int, kk: int):
+    """(blk, use_blocks) under the shared engagement guard: blocking
+    only when it divides the corpus cleanly and the per-block top-k can
+    hold kk candidates."""
+    use_blocks = (block is not None and block > 0 and n_pad % block == 0
+                  and n_pad // block >= 2 and kk <= block)
+    return (block if use_blocks else n_pad), use_blocks
+
+
 def build_knn_step(mesh: Mesh, *, n_pad: int, dim: int, k: int,
                    n_shards: int, similarity: str = "dot_product",
                    block: Optional[int] = KNN_BLOCK):
@@ -340,9 +423,7 @@ def build_knn_step(mesh: Mesh, *, n_pad: int, dim: int, k: int,
     # blocking engages only when it divides the corpus cleanly and the
     # per-block top-k can hold kk candidates (same guard style as
     # ops/topk.py); n_pad is pow2 so any pow2 block ≤ n_pad divides it
-    use_blocks = (block is not None and block > 0 and n_pad % block == 0
-                  and n_pad // block >= 2 and kk <= block)
-    blk = block if use_blocks else n_pad
+    blk, use_blocks = _knn_blocking(block, n_pad, kk)
 
     def body(vecs, vnorm2, exists, q):
         if similarity == "cosine":
@@ -352,53 +433,11 @@ def build_knn_step(mesh: Mesh, *, n_pad: int, dim: int, k: int,
             qq = q
         qn = jnp.sum(q * q, axis=-1)
 
-        def score_block(vecs_b, vn_b, exists_b):
-            dots = jnp.einsum("bd,nd->bn", qq, vecs_b,
-                              preferred_element_type=jnp.float32)
-            if similarity == "l2_norm":
-                # -||q - v||² expanded to ride the MXU; ||v||² is the
-                # cached pack-time column, never recomputed per query
-                scores = 2.0 * dots - vn_b[None, :] - qn[:, None]
-            else:
-                scores = dots
-            return jnp.where(exists_b[None, :], scores, NEG_INF)
-
         def per_shard(vecs_s, vn_s, exists_s):
-            if not use_blocks:
-                vals, idx = batched_blockwise_topk(
-                    score_block(vecs_s, vn_s, exists_s), kk)
-                return vals, idx.astype(jnp.int32)
-            nb = n_pad // blk
-            vecs_blk = vecs_s.reshape(nb, blk, dim)
-            vn_blk = vn_s.reshape(nb, blk)
-            exists_blk = exists_s.reshape(nb, blk)
-            # seed the accumulator from block 0 so every carried entry is
-            # a real (value, global index) pair: merges then keep the
-            # lowest global index among equal values — identical tie
-            # order (and identical -inf padding indices) to the one-shot
-            # full-matrix top_k
-            v0, i0 = batched_blockwise_topk(
-                score_block(vecs_blk[0], vn_blk[0], exists_blk[0]), kk)
-
-            def step_blk(carry, xs):
-                acc_v, acc_i = carry
-                b_idx, vecs_b, vn_b, exists_b = xs
-                bv, bi = batched_blockwise_topk(
-                    score_block(vecs_b, vn_b, exists_b), kk)
-                gi = bi.astype(jnp.int32) + b_idx * blk
-                cat_v = jnp.concatenate([acc_v, bv], axis=1)
-                cat_i = jnp.concatenate([acc_i, gi], axis=1)
-                # earlier blocks sit first: top_k's lowest-position tie
-                # preference keeps doc-ascending tie order
-                nv, sel = lax.top_k(cat_v, kk)
-                ni = jnp.take_along_axis(cat_i, sel, axis=1)
-                return (nv, ni), None
-
-            (vals, idx), _ = lax.scan(
-                step_blk, (v0, i0.astype(jnp.int32)),
-                (jnp.arange(1, nb, dtype=jnp.int32), vecs_blk[1:],
-                 vn_blk[1:], exists_blk[1:]))
-            return vals, idx
+            return _knn_shard_scan(vecs_s, vn_s, exists_s, qq, qn,
+                                   similarity=similarity, n_pad=n_pad,
+                                   dim=dim, kk=kk, blk=blk,
+                                   use_blocks=use_blocks)
 
         vals, idx = jax.vmap(per_shard, out_axes=1)(vecs, vnorm2, exists)
         return _global_topk_reduce(vals, idx, s_loc=s_loc, kk=kk, n_pad=n_pad,
@@ -1173,6 +1212,109 @@ def tie_stable_topk_docs(scores: np.ndarray, kk: int) -> np.ndarray:
     return sel[order]
 
 
+def tie_stable_topk_masked(scores: np.ndarray, pool: np.ndarray,
+                           kk: int) -> np.ndarray:
+    """Doc ids of the top-``kk`` of an ELIGIBLE pool in (score desc, doc
+    asc) order with the k-th-boundary tie resolved doc-ascending — the
+    bool-tree twin of :func:`tie_stable_topk_docs`, where eligibility is
+    a clause-mask verdict rather than ``score > 0`` (a doc matching only
+    filter clauses is a legitimate 0.0-score hit)."""
+    if pool.size > kk:
+        sub = scores[pool]
+        kth = -np.partition(-sub, kk - 1)[kk - 1]
+        strict = pool[sub > kth]
+        need = kk - strict.size
+        ties = pool[sub == kth]
+        if need > 0 and ties.size > need:
+            ties = np.partition(ties, need - 1)[:need]
+        sel = np.concatenate([strict, ties[:max(need, 0)]])
+    else:
+        sel = pool
+    order = np.lexsort((sel, -scores[sel]))[:kk]
+    return sel[order]
+
+
+#: popcount LUT for the bool clause bitmask (≤ 8 clauses fit one byte)
+_POPCNT8 = np.array([bin(i).count("1") for i in range(256)], np.uint8)
+
+
+def bool_role_masks(clauses) -> Tuple[int, int, int]:
+    """(required, prohibited, should) clause bitmasks of a lowered bool
+    tree — clause ci owns bit ``1 << ci``; must/filter are required,
+    must_not prohibited, should optional (counted against msm)."""
+    req = neg = shd = 0
+    for ci, (role, _terms) in enumerate(clauses):
+        bit = 1 << ci
+        if role in ("must", "filter"):
+            req |= bit
+        elif role == "must_not":
+            neg |= bit
+        else:
+            shd |= bit
+    return req, neg, shd
+
+
+def bool_clause_rows(clauses, idf_of):
+    """Per-clause ``[(term, idf·weight)]`` in first-appearance order
+    under ``idf_of`` stats. Scoring clauses (must/should) drop zero-idf
+    terms (they contribute nothing, matching the bag paths'
+    ``idfw_of``); filter/must_not clauses keep every term with weight
+    0.0 (membership needs the posting run, never the weight). ONE copy
+    for the base plane, the delta tier and the device assembly — clause
+    semantics can never drift between tiers."""
+    out = []
+    for role, terms in clauses:
+        weights: Dict[str, float] = {}
+        for t in terms:
+            weights[t] = weights.get(t, 0.0) + 1.0
+        if role in ("must", "should"):
+            rows = [(t, idf_of(t) * w) for t, w in weights.items()
+                    if idf_of(t) > 0.0]
+        else:
+            rows = [(t, 0.0) for t in weights]
+        out.append((role, rows))
+    return out
+
+
+def _bool_csr_shard_pool(term_ids, csr, per_clause, req: int, neg: int,
+                         shd: int, msm: int):
+    """Score ONE CSR shard for a lowered bool tree: scatter-add the
+    scoring clauses' impacts, OR clause bits per doc, then the bitmask
+    eligibility verdict (must/filter all present, must_not absent,
+    ≥ msm should clauses). Returns (scores f32[n_docs], eligible doc
+    pool) or None when no clause term touched the shard. THE shared
+    core of ``DistributedSearchPlane.search_bool_eager`` and
+    ``EagerDeltaScorer.score_bool`` — base and delta tiers score bool
+    trees through this one function."""
+    n_docs = csr["n_docs"]
+    scores = np.zeros(n_docs, np.float32)
+    bits = np.zeros(n_docs, np.uint8)
+    touched = False
+    for ci, (role, rows) in enumerate(per_clause):
+        scoring = role in ("must", "should")
+        bit = np.uint8(1 << ci)
+        for t, idfw in rows:
+            tid = term_ids.get(t)
+            if tid is None:
+                continue
+            st = int(csr["offsets"][tid])
+            en = int(csr["offsets"][tid + 1])
+            if en > st:
+                run = csr["docs"][st:en]
+                if scoring:
+                    scores[run] += idfw * csr["impacts"][st:en]
+                bits[run] |= bit
+                touched = True
+    if not touched:
+        return None
+    ok = (bits & req) == req
+    if neg:
+        ok &= (bits & neg) == 0
+    if msm > 0:
+        ok &= _POPCNT8[bits & shd] >= msm
+    return scores, np.flatnonzero(ok & (bits != 0))
+
+
 def total_value(t) -> int:
     """Value of a per-query totals entry — plain int (exact count) or a
     ``(value, "gte")`` tuple from a pruned dispatch (the count is a
@@ -1225,9 +1367,6 @@ def build_pruned_bm25_step(mesh: Mesh, *, n_pad: int, Q: int, k: int,
     kq_idx = min(kq, W) - 1
 
     def body(pd, pi, td, tc, ts, to, sched, w, rho, slack, st, ln, idfw):
-        p_table = pd.shape[-1]
-        bisect_iters = max(int(np.ceil(np.log2(p_table + 1))) + 1, 1)
-
         def per_shard(pd_s, pi_s, td_s, tc_s, ts_s, to_s, sched_s, w_s,
                       rho_s, slack_s, st_s, ln_s):
             def per_query(sched_q, w_q, rho_q, slack_q, st_q, ln_q, iw_q):
@@ -1286,33 +1425,16 @@ def build_pruned_bm25_step(mesh: Mesh, *, n_pad: int, Q: int, k: int,
                                  >= theta_end))
                 # exact re-score: candidates sorted doc-ascending so the
                 # final top_k's lowest-position tie preference restores
-                # the eager kernel's (score desc, doc asc) order
+                # the eager kernel's (score desc, doc asc) order. The
+                # bisect + highest-slot-first f32 summation live in the
+                # shared stage (``ops/fused_query.bisect_exact_scores``)
+                # the fused rescore kernel also composes.
                 ci = jnp.where(cv == NEG_INF, n_pad, ci)
                 order = jnp.argsort(ci)
                 ci = jnp.take(ci, order)
                 cvs = jnp.take(cv, order)
-                doc = ci[:, None]                           # [R, 1]
-                lo = jnp.broadcast_to(st_q[None, :], (rr, Q))
-                hi = lo + ln_q[None, :]
-                for _ in range(bisect_iters):
-                    cont = lo < hi
-                    mid = (lo + hi) // 2
-                    dv = jnp.take(pd_s, mid, mode="clip")
-                    go = dv < doc
-                    lo = jnp.where(cont & go, mid + 1, lo)
-                    hi = jnp.where(cont & ~go, mid, hi)
-                found = (lo < st_q[None, :] + ln_q[None, :]) & \
-                    (jnp.take(pd_s, lo, mode="clip") == doc)
-                c = jnp.where(
-                    found,
-                    iw_q[None, :] * jnp.take(pi_s, lo, mode="clip"),
-                    0.0)
-                # f32 summation in the sorted-merge kernel's order
-                # (highest term slot first — bit-parity with the eager
-                # step's shifted-add group reduction)
-                score = c[:, Q - 1]
-                for qslot in range(Q - 2, -1, -1):
-                    score = score + c[:, qslot]
+                score, _found = bisect_exact_scores(
+                    pd_s, pi_s, st_q, ln_q, iw_q, ci, n_pad=n_pad)
                 score = jnp.where(cvs == NEG_INF, NEG_INF, score)
                 vals, sel = lax.top_k(score, kk)
                 docs = jnp.take(ci, sel)
@@ -1359,8 +1481,334 @@ def build_pruned_bm25_step(mesh: Mesh, *, n_pad: int, Q: int, k: int,
 
 
 # ---------------------------------------------------------------------------
+# One-dispatch fused query steps (the planner's device programs)
+# ---------------------------------------------------------------------------
+#
+# A hybrid request historically cost two serving dispatches (text plane,
+# knn plane) plus host-side fusion, and bool trees never reached the
+# plane at all. These builders lower a PLANNED request
+# (``search/query_planner.py``) into one jitted SPMD program over both
+# planes' resident tensors: per-clause partial scores combined in-device
+# (the bool merge body's clause-bit channel), the lexical sorted-merge
+# and the kNN blocked scan sharing one program (XLA overlaps the two
+# pipelines; two dispatches serialize them), RRF/linear rank fusion and
+# the rescore-window reorder as final fused stages, and ONE result
+# fetch. Shapes are bucketed into the same (B, k, L, params) lattice as
+# every other serving step, so the fused path compiles per request
+# SHAPE, never per query.
+
+
+def build_bool_bm25_step(mesh: Mesh, *, n_pad: int, Q: int, L: int,
+                         k: int, nc: int, n_shards: int,
+                         with_count: bool = False, Q2: int = 0,
+                         rescore_mode: str = "total"):
+    """Jitted bool-tree BM25 dispatch (+ optional fused rescore stage).
+
+    Global shapes beyond :func:`build_bm25_topk_step`'s: ``cbits``
+    i32[B, Q] per-slot owning-clause bit, ``req``/``neg``/``shd``/
+    ``msm`` i32[B] per-query clause-role masks. With ``Q2 > 0`` the
+    rescore query rides along (``st2``/``ln2`` i32[B, S, Q2], ``iw2``
+    f32[B, Q2], ``qw``/``rw`` f32[B], ``rwin`` i32[B]): per-shard
+    candidates carry exact bisect secondaries through the reduce and
+    the window reorders in-device."""
+    s_dev = mesh.shape[AXIS_SHARD]
+    if n_shards % s_dev:
+        raise ValueError(f"{n_shards} shards not divisible over {s_dev} devices")
+    s_loc = n_shards // s_dev
+    kk = min(k, n_pad)
+    out_k = min(k, n_shards * n_pad)
+    pad_id = n_shards * n_pad
+    rescore = Q2 > 0
+
+    def body(pd, pi, st, ln, idfw, cbits, req, neg, shd, msm, *rest):
+        if rescore:
+            st2, ln2, iw2, qw, rw, rwin = rest
+        else:
+            st2 = ln2 = iw2 = qw = rw = rwin = None
+
+        def per_shard(pd_s, pi_s, st_s, ln_s, st2_s, ln2_s):
+            def per_query(st_q, ln_q, iw_q, cb_q, req_q, neg_q, shd_q,
+                          msm_q, st2_q, ln2_q, iw2_q):
+                vals, docs, cnt = bool_bm25_topk_body(
+                    pd_s, pi_s, st_q, ln_q, iw_q, cb_q, req_q, neg_q,
+                    shd_q, msm_q, n_pad=n_pad, L=L, k=kk,
+                    with_count=True, nc=nc)
+                if rescore:
+                    sec, fnd = bisect_exact_scores(
+                        pd_s, pi_s, st2_q, ln2_q, iw2_q, docs,
+                        n_pad=n_pad)
+                    return (vals, docs, cnt, sec,
+                            fnd.astype(jnp.float32))
+                return vals, docs, cnt
+
+            if rescore:
+                return jax.vmap(per_query)(
+                    st_s, ln_s, idfw, cbits, req, neg, shd, msm,
+                    st2_s, ln2_s, iw2)
+            z2 = jnp.zeros((1,), jnp.int32)
+            zf = jnp.zeros((1,), jnp.float32)
+            return jax.vmap(lambda a, b, c, d, e, f, g, h: per_query(
+                a, b, c, d, e, f, g, h, z2, z2, zf))(
+                st_s, ln_s, idfw, cbits, req, neg, shd, msm)
+
+        if rescore:
+            out = jax.vmap(per_shard, in_axes=(0, 0, 1, 1, 1, 1),
+                           out_axes=1)(pd, pi, st, ln, st2, ln2)
+            vals, idx, cnt, sec, fnd = out
+            gvals, gdocs, (gsec, gfnd) = _global_topk_reduce(
+                vals, idx, s_loc=s_loc, kk=kk, n_pad=n_pad,
+                out_k=out_k, payload=(sec, fnd))
+        else:
+            z = jnp.zeros((st.shape[0], s_loc, st.shape[-1]), jnp.int32)
+            out = jax.vmap(per_shard, in_axes=(0, 0, 1, 1, 1, 1),
+                           out_axes=1)(pd, pi, st, ln, z, z)
+            vals, idx, cnt = out
+            gvals, gdocs = _global_topk_reduce(
+                vals, idx, s_loc=s_loc, kk=kk, n_pad=n_pad, out_k=out_k)
+        counts = lax.psum(jnp.sum(cnt, axis=1), AXIS_SHARD)
+        if rescore:
+            def finish(v_q, g_q, sec_q, fnd_q, qw_q, rw_q, rwin_q):
+                g_q = jnp.where(v_q > NEG_INF, g_q, pad_id)
+                return rescore_reorder_body(
+                    v_q, g_q, sec_q, fnd_q > 0.0, qw_q, rw_q, rwin_q,
+                    mode=rescore_mode, k=out_k, pad_id=pad_id)
+
+            gvals, gdocs = jax.vmap(finish)(gvals, gdocs, gsec, gfnd,
+                                            qw, rw, rwin)
+        if with_count:
+            return gvals, gdocs, counts
+        return gvals, gdocs
+
+    shard_corpus = P(AXIS_SHARD, None)
+    repl3 = P(AXIS_REPLICA, AXIS_SHARD, None)
+    repl2 = P(AXIS_REPLICA, None)
+    repl1 = P(AXIS_REPLICA)
+    in_specs = [shard_corpus, shard_corpus, repl3, repl3, repl2, repl2,
+                repl1, repl1, repl1, repl1]
+    if rescore:
+        in_specs += [repl3, repl3, repl2, repl1, repl1, repl1]
+    out_specs = (repl2, repl2) + ((repl1,) if with_count else ())
+    step = shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                     out_specs=out_specs, check_vma=False)
+    return jax.jit(step)
+
+
+def build_fused_hybrid_step(mesh: Mesh, *, n_pad_t: int, Q: int, L: int,
+                            W_text: int, nc: int, n_pad_k: int, dim: int,
+                            similarity: str, W_knn: int, k: int,
+                            fusion: str, n_shards: int, Q2: int = 0,
+                            rescore_mode: str = "total",
+                            block: Optional[int] = KNN_BLOCK):
+    """THE one-dispatch hybrid program: lexical bool-tree scoring +
+    blocked kNN scan + in-device rank fusion (+ optional fused rescore)
+    over both planes' resident tensors, with one ICI reduce per
+    retriever and the fusion/rescore stages running in replica space.
+
+    The two candidate streams share one program, so XLA schedules the
+    MXU kNN blocks against the VPU sorted-merge instead of serializing
+    two dispatches through the host. Unified candidate ids are
+    ``shard * UP + doc`` with ``UP = max(n_pad_t, n_pad_k)`` (both
+    planes serve one segment per shard, so shard indices agree);
+    ``pad = n_shards * UP``.
+
+    Runtime (non-compile) per-query knobs: ``rc`` f32[B] RRF rank
+    constant, ``wt``/``wk`` i32[B] per-list rank windows, ``kboost``
+    f32[B], and the rescore ``qw``/``rw``/``rwin``. Returns
+    (fused_vals f32[B, k], fused_ids i32[B, k], text_counts i32[B],
+    text_vals f32[B, W_text], text_ids i32[B, W_text],
+    knn_vals f32[B, W_knn], knn_ids i32[B, W_knn]) — the raw rankings
+    ride along so generation-level serving can re-merge a live delta
+    tier without a second dispatch."""
+    if fusion not in ("rrf", "sum"):
+        raise ValueError(f"unknown fusion [{fusion}]")
+    s_dev = mesh.shape[AXIS_SHARD]
+    if n_shards % s_dev:
+        raise ValueError(f"{n_shards} shards not divisible over {s_dev} devices")
+    s_loc = n_shards // s_dev
+    kk_t = min(W_text, n_pad_t)
+    out_t = min(W_text, n_shards * n_pad_t)
+    kk_k = min(W_knn, n_pad_k)
+    out_kn = min(W_knn, n_shards * n_pad_k)
+    UP = max(n_pad_t, n_pad_k)
+    pad_id = n_shards * UP
+    blk, use_blocks = _knn_blocking(block, n_pad_k, kk_k)
+    rescore = Q2 > 0
+
+    def body(pd, pi, kvecs, kvn, kex, st, ln, idfw, cbits, req, neg,
+             shd, msm, qv, kboost, rc, wt, wk, *rest):
+        if rescore:
+            st2, ln2, iw2, qw, rw, rwin = rest
+        else:
+            st2 = ln2 = iw2 = qw = rw = rwin = None
+        if similarity == "cosine":
+            qq = qv / jnp.maximum(
+                jnp.linalg.norm(qv, axis=-1, keepdims=True), 1e-12)
+        else:
+            qq = qv
+        qn = jnp.sum(qv * qv, axis=-1)
+
+        def per_shard(pd_s, pi_s, kv_s, kn_s, ke_s, st_s, ln_s,
+                      st2_s, ln2_s):
+            def per_query(st_q, ln_q, iw_q, cb_q, req_q, neg_q, shd_q,
+                          msm_q, st2_q, ln2_q, iw2_q):
+                return bool_bm25_topk_body(
+                    pd_s, pi_s, st_q, ln_q, iw_q, cb_q, req_q, neg_q,
+                    shd_q, msm_q, n_pad=n_pad_t, L=L, k=kk_t,
+                    with_count=True, nc=nc)
+
+            if rescore:
+                tv, td, cnt = jax.vmap(per_query)(
+                    st_s, ln_s, idfw, cbits, req, neg, shd, msm,
+                    st2_s, ln2_s, iw2)
+            else:
+                z2 = jnp.zeros((1,), jnp.int32)
+                zf = jnp.zeros((1,), jnp.float32)
+                tv, td, cnt = jax.vmap(
+                    lambda a, b, c, d, e, f, g, h: per_query(
+                        a, b, c, d, e, f, g, h, z2, z2, zf))(
+                    st_s, ln_s, idfw, cbits, req, neg, shd, msm)
+            kv, kd = _knn_shard_scan(kv_s, kn_s, ke_s, qq, qn,
+                                     similarity=similarity,
+                                     n_pad=n_pad_k, dim=dim, kk=kk_k,
+                                     blk=blk, use_blocks=use_blocks)
+            if rescore:
+                def sec_of(st2_q, ln2_q, iw2_q, docs):
+                    s, f = bisect_exact_scores(
+                        pd_s, pi_s, st2_q, ln2_q, iw2_q, docs,
+                        n_pad=n_pad_t)
+                    return s, f.astype(jnp.float32)
+
+                sec_t, fnd_t = jax.vmap(sec_of)(st2_s, ln2_s, iw2, td)
+                # kNN candidates live in the kNN pad space; their doc
+                # ids are valid text-CSR doc ids (same segment), only
+                # the pad sentinel differs — clamp cross-space
+                kd_t = jnp.where((kv > NEG_INF) & (kd < n_pad_t),
+                                 kd, n_pad_t)
+                sec_k, fnd_k = jax.vmap(sec_of)(st2_s, ln2_s, iw2, kd_t)
+                return (tv, td, cnt, kv, kd, sec_t, fnd_t, sec_k,
+                        fnd_k)
+            return tv, td, cnt, kv, kd
+
+        zT = jnp.zeros((st.shape[0], s_loc, 1), jnp.int32)
+        if rescore:
+            out = jax.vmap(per_shard, in_axes=(0, 0, 0, 0, 0, 1, 1,
+                                               1, 1),
+                           out_axes=1)(pd, pi, kvecs, kvn, kex, st, ln,
+                                       st2, ln2)
+            (tv, td, cnt, kv, kd, sec_t, fnd_t, sec_k, fnd_k) = out
+            tvals, tids, (tsec, tfnd) = _global_topk_reduce(
+                tv, td, s_loc=s_loc, kk=kk_t, n_pad=n_pad_t,
+                out_k=out_t, payload=(sec_t, fnd_t))
+            kvals, kids, (ksec, kfnd) = _global_topk_reduce(
+                kv, kd, s_loc=s_loc, kk=kk_k, n_pad=n_pad_k,
+                out_k=out_kn, payload=(sec_k, fnd_k))
+        else:
+            out = jax.vmap(per_shard, in_axes=(0, 0, 0, 0, 0, 1, 1,
+                                               1, 1),
+                           out_axes=1)(pd, pi, kvecs, kvn, kex, st, ln,
+                                       zT, zT)
+            tv, td, cnt, kv, kd = out
+            tvals, tids = _global_topk_reduce(
+                tv, td, s_loc=s_loc, kk=kk_t, n_pad=n_pad_t, out_k=out_t)
+            kvals, kids = _global_topk_reduce(
+                kv, kd, s_loc=s_loc, kk=kk_k, n_pad=n_pad_k,
+                out_k=out_kn)
+            tsec = tfnd = ksec = kfnd = None
+        counts = lax.psum(jnp.sum(cnt, axis=1), AXIS_SHARD)
+
+        n_f = out_t + out_kn
+
+        def finish(tv_q, tg_q, kv_q, kg_q, kb_q, rc_q, wt_q, wk_q,
+                   tsec_q, tfnd_q, ksec_q, kfnd_q, qw_q, rw_q, rwin_q):
+            pos_t = jnp.arange(out_t, dtype=jnp.int32)
+            pos_k = jnp.arange(out_kn, dtype=jnp.int32)
+            # unify ids into the shared (shard, doc) space and apply the
+            # per-request rank windows (entries past the window leave
+            # the fusion, exactly like the host truncating its lists)
+            t_ok = (tv_q > NEG_INF) & (pos_t < wt_q)
+            k_ok = (kv_q > NEG_INF) & (pos_k < wk_q)
+            tug = jnp.where(t_ok, (tg_q // n_pad_t) * UP
+                            + tg_q % n_pad_t, pad_id)
+            kug = jnp.where(k_ok, (kg_q // n_pad_k) * UP
+                            + kg_q % n_pad_k, pad_id)
+            if fusion == "rrf":
+                fv, fi, sel = rrf_fuse_body(tug, kug, rc_q, k=n_f,
+                                            pad_id=pad_id)
+            else:
+                ks = jnp.where(k_ok,
+                               knn_raw_to_score(similarity, kv_q)
+                               * kb_q, NEG_INF)
+                ts = jnp.where(t_ok, tv_q, NEG_INF)
+                fv, fi, sel = sum_fuse_body(tug, ts, kug, ks, k=n_f,
+                                            pad_id=pad_id)
+            if rescore:
+                sec_cat = jnp.concatenate([tsec_q, ksec_q])
+                fnd_cat = jnp.concatenate([tfnd_q, kfnd_q])
+                sec_f = jnp.take(sec_cat, sel, mode="clip")
+                fnd_f = jnp.take(fnd_cat, sel, mode="clip") > 0.0
+                fv, fi = rescore_reorder_body(
+                    fv, fi, sec_f, fnd_f, qw_q, rw_q, rwin_q,
+                    mode=rescore_mode, k=k, pad_id=pad_id)
+            else:
+                fv, fi = fv[:k], fi[:k]
+                if fv.shape[0] < k:
+                    fv = jnp.pad(fv, (0, k - fv.shape[0]),
+                                 constant_values=NEG_INF)
+                    fi = jnp.pad(fi, (0, k - fi.shape[0]),
+                                 constant_values=pad_id)
+            return fv, fi
+
+        zB = jnp.zeros(tvals.shape[:2], jnp.float32)
+        zB1 = jnp.zeros((tvals.shape[0],), jnp.float32)
+        zBk = jnp.zeros(kvals.shape[:2], jnp.float32)
+        zBi = jnp.zeros((tvals.shape[0],), jnp.int32)
+        fvals, fids = jax.vmap(finish)(
+            tvals, tids, kvals, kids, kboost, rc, wt, wk,
+            tsec if rescore else zB, tfnd if rescore else zB,
+            ksec if rescore else zBk, kfnd if rescore else zBk,
+            qw if rescore else zB1, rw if rescore else zB1,
+            rwin if rescore else zBi)
+        return fvals, fids, counts, tvals, tids, kvals, kids
+
+    shard_corpus = P(AXIS_SHARD, None)
+    shard3 = P(AXIS_SHARD, None, None)
+    repl3 = P(AXIS_REPLICA, AXIS_SHARD, None)
+    repl2 = P(AXIS_REPLICA, None)
+    repl1 = P(AXIS_REPLICA)
+    in_specs = [shard_corpus, shard_corpus, shard3,
+                P(AXIS_SHARD, None), P(AXIS_SHARD, None),
+                repl3, repl3, repl2, repl2, repl1, repl1, repl1, repl1,
+                repl2, repl1, repl1, repl1, repl1]
+    if rescore:
+        in_specs += [repl3, repl3, repl2, repl1, repl1, repl1]
+    out_specs = (repl2, repl2, repl1, repl2, repl2, repl2, repl2)
+    step = shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                     out_specs=out_specs, check_vma=False)
+    return jax.jit(step)
+
+
+# ---------------------------------------------------------------------------
 # Host-side plane: shard packing + query dispatch
 # ---------------------------------------------------------------------------
+
+
+def _plane_cached_step(self, key: Tuple, builder, site: str):
+    """Get-or-build a jitted step in a plane's per-shape cache: read
+    under the lock, build + instrument OUTSIDE it (ESTP-L02 —
+    telemetry never under a serving lock, concurrent distinct-shape
+    builds never serialize), then ``setdefault`` so the first copy wins
+    a race. ONE copy of the dance for every step family on BOTH planes
+    (eager/tiered/pruned/bool/fused/knn/ivf) — bound as
+    ``cached_step`` on each plane class."""
+    with self._steps_lock:
+        fn = self._steps.get(key)
+    if fn is None:
+        fn = builder()
+        from ..common.telemetry import instrument_step
+        fn = instrument_step(fn, site=site)
+        with self._steps_lock:
+            fn = self._steps.setdefault(key, fn)
+    return fn
 
 
 class DistributedSearchPlane:
@@ -2530,49 +2978,291 @@ class DistributedSearchPlane:
 
     def _get_pruned_step(self, Q: int, k: int, P_sched: int, W: int,
                          R: int):
-        key = ("bmx", Q, k, P_sched, W, R)
-        with self._steps_lock:
-            fn = self._steps.get(key)
-        if fn is None:
-            # build + instrument OUTSIDE the lock (ESTP-L02): telemetry
-            # code must never run under a serving lock, and concurrent
-            # distinct-shape builds must not serialize; setdefault keeps
-            # the first copy if two threads raced the same key
-            fn = build_pruned_bm25_step(
+        return self.cached_step(
+            ("bmx", Q, k, P_sched, W, R),
+            lambda: build_pruned_bm25_step(
                 self.mesh, n_pad=self.n_pad, Q=Q, k=k,
                 P_sched=P_sched, W=W, R=R, BS=self.blockmax.block,
-                NB=self.blockmax.n_blocks, n_shards=self.n_shards)
-            from ..common.telemetry import instrument_step
-            fn = instrument_step(fn, site="text_plane_pruned")
-            with self._steps_lock:
-                fn = self._steps.setdefault(key, fn)
-        return fn
+                NB=self.blockmax.n_blocks, n_shards=self.n_shards),
+            "text_plane_pruned")
+
+    # -- bool-tree serving stages (the fused planner's lexical stage) --------
+
+    def _bool_clause_idfw(self, clauses, extra_docs: int,
+                          extra_df: Optional[Dict[str, int]]):
+        """Per-clause ``[(term, idf·weight)]`` under this plane's global
+        stats (+ any delta-tier mass) — :func:`bool_clause_rows` with
+        the same cached idf closure :meth:`_query_idfw` uses."""
+        idf_cache: Dict[str, float] = {}
+
+        def idf_of(t: str) -> float:
+            v = idf_cache.get(t)
+            if v is None:
+                gdf = sum(int(s2["df"][s2["term_ids"][t]])
+                          for s2 in self.shards if t in s2["term_ids"])
+                if extra_df:
+                    gdf += int(extra_df.get(t, 0))
+                v = float(idf_weight(self.n_docs_total + extra_docs,
+                                     np.int64(gdf))) if gdf else 0.0
+                idf_cache[t] = v
+            return v
+
+        return bool_clause_rows(clauses, idf_of)
+
+    def search_bool_eager(self, bool_queries, k: int = 10, *,
+                          with_totals: bool = False,
+                          stages: Optional[dict] = None,
+                          extra_docs: int = 0,
+                          extra_df: Optional[Dict[str, int]] = None):
+        """CPU-native bool-tree serving: one scatter-add pass per
+        scoring clause plus a clause-bit pass per matching clause, then
+        a bitmask eligibility verdict (must/filter all present, must_not
+        absent, ≥ msm should clauses) — Lucene's BooleanWeight as a
+        data-parallel pass over the plane's precomputed impacts. Each
+        query is ``{"clauses": [(role, [terms...])...], "msm": int}``
+        (msm already resolved by the planner). Degenerates bit-exactly
+        to :meth:`search_eager` for a single should clause."""
+        if self._host_csr is None:
+            raise RuntimeError(
+                "search_bool_eager requires a CPU-backend plane")
+        t0 = time.perf_counter()
+        B = len(bool_queries)
+        vals_out = np.full((B, k), NEG_INF, np.float32)
+        hits_out: List[List[Tuple[int, int]]] = []
+        totals: List[int] = []
+        for bi, bq in enumerate(bool_queries):
+            clauses = bq.get("clauses") or []
+            msm = int(bq.get("msm", 0))
+            req, neg, shd = bool_role_masks(clauses)
+            per_clause = self._bool_clause_idfw(clauses, extra_docs,
+                                                extra_df)
+            cand_v: List[np.ndarray] = []
+            cand_g: List[np.ndarray] = []
+            total = 0
+            for si, (sh, csr) in enumerate(zip(self.shards,
+                                               self._host_csr)):
+                got = _bool_csr_shard_pool(sh["term_ids"], csr,
+                                           per_clause, req, neg, shd,
+                                           msm)
+                if got is None:
+                    continue
+                scores, pool = got
+                if with_totals:
+                    total += int(pool.size)
+                if not pool.size:
+                    continue
+                kk = min(k, csr["n_docs"])
+                sel = tie_stable_topk_masked(scores, pool, kk)
+                cand_v.append(scores[sel])
+                cand_g.append(sel.astype(np.int64) + si * self.n_pad)
+            row: List[Tuple[int, int]] = []
+            if cand_v:
+                v = np.concatenate(cand_v)
+                g = np.concatenate(cand_g)
+                order = np.lexsort((g, -v))[:k]
+                vals_out[bi, :order.size] = v[order]
+                row = [(int(g[j]) // self.n_pad, int(g[j]) % self.n_pad)
+                       for j in order]
+            hits_out.append(row)
+            totals.append(total)
+        self.n_dispatches += 1
+        if stages is not None:
+            stages["prep_ms"] = 0.0
+            stages["dispatch_ms"] = (time.perf_counter() - t0) * 1e3
+            stages["fetch_ms"] = 0.0
+            stages["compile_cache"] = "host"
+        if with_totals:
+            return vals_out, hits_out, totals
+        return vals_out, hits_out
+
+    def has_dense_terms(self, terms) -> bool:
+        """True when any term lives in some shard's dense matmul tier —
+        the jitted bool/fused steps slice only the SPARSE table, so such
+        batches must fall back (the host paths carry the full CSR)."""
+        for t in set(terms):
+            for sh in self.shards:
+                tid = sh["term_ids"].get(t)
+                if tid is not None and sh["dense_row_of"] and \
+                        int(tid) in sh["dense_row_of"]:
+                    return True
+        return False
+
+    def bool_inputs(self, bool_queries, Q: int, *, extra_docs: int = 0,
+                    extra_df: Optional[Dict[str, int]] = None):
+        """Device-input assembly for a bool-query batch: slot-per-
+        (clause, unique term) runs over the SPARSE table plus the
+        per-query clause-role masks. Returns (starts, lengths, idfw,
+        cbits, req, neg, shd, msm, max_len, any_dense)."""
+        B, S = len(bool_queries), self.n_shards
+        starts = np.zeros((B, S, Q), np.int32)
+        lengths = np.zeros((B, S, Q), np.int32)
+        idfw = np.zeros((B, Q), np.float32)
+        cbits = np.zeros((B, Q), np.int32)
+        req = np.zeros(B, np.int32)
+        neg = np.zeros(B, np.int32)
+        shd = np.zeros(B, np.int32)
+        msm = np.zeros(B, np.int32)
+        max_len = 1
+        any_dense = False
+        for bi, bq in enumerate(bool_queries):
+            clauses = bq.get("clauses") or []
+            msm[bi] = int(bq.get("msm", 0))
+            r, n, s = bool_role_masks(clauses)
+            req[bi], neg[bi], shd[bi] = r, n, s
+            per_clause = self._bool_clause_idfw(clauses, extra_docs,
+                                                extra_df)
+            qi = 0
+            for ci, (role, rows) in enumerate(per_clause):
+                for t, w in rows:
+                    if qi >= Q:
+                        continue
+                    idfw[bi, qi] = w
+                    cbits[bi, qi] = 1 << ci
+                    for si, sh in enumerate(self.shards):
+                        tid = sh["term_ids"].get(t)
+                        if tid is None:
+                            continue
+                        if sh["dense_row_of"] and \
+                                int(tid) in sh["dense_row_of"]:
+                            any_dense = True
+                            continue
+                        st = int(sh["sparse_offsets"][tid])
+                        ln = int(sh["sparse_offsets"][tid + 1]) - st
+                        starts[bi, si, qi] = st
+                        lengths[bi, si, qi] = ln
+                        max_len = max(max_len, ln)
+                    qi += 1
+        return (starts, lengths, idfw, cbits, req, neg, shd, msm,
+                max_len, any_dense)
+
+    @staticmethod
+    def bool_slot_count(bool_queries) -> int:
+        """Slots a bool-query batch needs (one per (clause, unique
+        term)) — the Q shape axis of the bool/fused steps."""
+        out = 1
+        for bq in bool_queries:
+            n = 0
+            for _role, terms in (bq.get("clauses") or []):
+                n += len(set(terms))
+            out = max(out, n)
+        return out
+
+    def search_bool(self, bool_queries, k: int = 10, *,
+                    with_totals: bool = False,
+                    stages: Optional[dict] = None, extra_docs: int = 0,
+                    extra_df: Optional[Dict[str, int]] = None):
+        """Jitted bool-tree dispatch at the serving shapes (Q floor,
+        ladder L, fixed NC unroll). Dense-tier terms cannot ride the
+        sparse slice — callers check :meth:`has_dense_terms` first."""
+        from ..ops.fused_query import MAX_BOOL_CLAUSES
+        t0 = time.perf_counter()
+        B = len(bool_queries)
+        n_repl = self.mesh.shape[AXIS_REPLICA]
+        B_pad = -(-B // n_repl) * n_repl
+        bool_queries = list(bool_queries) + [
+            {"clauses": [], "msm": 0} for _ in range(B_pad - B)]
+        Q = max(self.SERVING_Q_MIN,
+                round_up_pow2(self.bool_slot_count(bool_queries)))
+        (starts, lengths, idfw, cbits, req, neg, shd, msm, max_len,
+         any_dense) = self.bool_inputs(bool_queries, Q,
+                                       extra_docs=extra_docs,
+                                       extra_df=extra_df)
+        if any_dense:
+            raise ValueError(
+                "bool batch touches dense-tier terms; the sparse-slice "
+                "bool step cannot serve it (fall back)")
+        L = min(self.ladder_L(max_len), self.L_cap)
+        np.minimum(lengths, L, out=lengths)
+        step = self._get_bool_step(Q, L, k, with_count=True,
+                                   nc=MAX_BOOL_CLAUSES)
+        repl = NamedSharding(self.mesh, P(AXIS_REPLICA, None))
+        repl1 = NamedSharding(self.mesh, P(AXIS_REPLICA))
+        repl3 = NamedSharding(self.mesh, P(AXIS_REPLICA, AXIS_SHARD,
+                                           None))
+        t1 = time.perf_counter()
+        out = _run_step(
+            self._serial_dispatch, step, self.docs_dev,
+            self.impacts_dev,
+            jax.device_put(starts, repl3), jax.device_put(lengths, repl3),
+            jax.device_put(idfw, repl), jax.device_put(cbits, repl),
+            jax.device_put(req, repl1), jax.device_put(neg, repl1),
+            jax.device_put(shd, repl1), jax.device_put(msm, repl1))
+        if stages is not None:
+            jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        self.n_dispatches += 1
+        from ..common import telemetry as _tm
+        _tm.record_mesh_dispatch(self.mesh.shape[AXIS_SHARD],
+                                 self.mesh.shape[AXIS_REPLICA])
+        compiled = _tm.last_call_compiled()
+        vals = np.asarray(out[0])[:B]
+        gdocs = np.asarray(out[1])[:B]
+        counts = np.asarray(out[2])[:B]
+        h2d = starts.nbytes + lengths.nbytes + idfw.nbytes + \
+            cbits.nbytes + 16 * B_pad
+        d2h = vals.nbytes + gdocs.nbytes + counts.nbytes
+        _tm.record_transfer(h2d_bytes=h2d, d2h_bytes=d2h)
+        hits = []
+        for bi in range(B):
+            row = []
+            for v, g in zip(vals[bi], gdocs[bi]):
+                if v == NEG_INF:
+                    break
+                row.append((int(g) // self.n_pad, int(g) % self.n_pad))
+            hits.append(row)
+        if stages is not None:
+            stages["prep_ms"] = (t1 - t0) * 1e3
+            stages["dispatch_ms"] = (t2 - t1) * 1e3
+            stages["fetch_ms"] = (time.perf_counter() - t2) * 1e3
+            stages["compile_cache"] = "miss" if compiled else "hit"
+            stages["h2d_bytes"] = h2d
+            stages["d2h_bytes"] = d2h
+        if with_totals:
+            return vals, hits, [int(c) for c in counts]
+        return vals, hits
+
+    def serve_bool(self, bool_queries, k: int = 10, *,
+                   with_totals: bool = False,
+                   stages: Optional[dict] = None, extra_docs: int = 0,
+                   extra_df: Optional[Dict[str, int]] = None):
+        """Serving entry for lowered bool trees: CPU-native eager pass
+        on a CPU-backend plane, else the jitted bool step."""
+        if self._host_csr is not None:
+            return self.search_bool_eager(
+                bool_queries, k=k, with_totals=with_totals,
+                stages=stages, extra_docs=extra_docs, extra_df=extra_df)
+        return self.search_bool(bool_queries, k=k,
+                                with_totals=with_totals, stages=stages,
+                                extra_docs=extra_docs, extra_df=extra_df)
+
+    cached_step = _plane_cached_step
+
+    def _get_bool_step(self, Q: int, L: int, k: int, *,
+                       with_count: bool, nc: int):
+        return self.cached_step(
+            ("bool", Q, L, k, with_count, nc),
+            lambda: build_bool_bm25_step(
+                self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k, nc=nc,
+                n_shards=self.n_shards, with_count=with_count),
+            "text_plane_bool")
 
     def _get_step(self, Q: int, L: int, k: int, *, tiered: bool = False,
                   with_count: bool = False, U: Optional[int] = None):
-        key = (Q, L, k, tiered, with_count, U)
-        with self._steps_lock:
-            fn = self._steps.get(key)
-        if fn is None:
-            # build + instrument OUTSIDE the lock (ESTP-L02; see
-            # _get_pruned_step)
+        def build():
             if tiered:
-                fn = build_tiered_bm25_step(
+                return build_tiered_bm25_step(
                     self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
                     T_pad=self.T_pad, C=self.dense_block,
                     n_shards=self.n_shards, with_count=with_count, U=U)
-            else:
-                fn = build_bm25_topk_step(
-                    self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
-                    n_shards=self.n_shards, with_count=with_count)
-            # telemetry: each new input-shape signature through the
-            # jitted step is one XLA compile — counted per shape so
-            # compile churn is attributable (common/telemetry.py)
-            from ..common.telemetry import instrument_step
-            fn = instrument_step(fn, site="text_plane")
-            with self._steps_lock:
-                fn = self._steps.setdefault(key, fn)
-        return fn
+            return build_bm25_topk_step(
+                self.mesh, n_pad=self.n_pad, Q=Q, L=L, k=k,
+                n_shards=self.n_shards, with_count=with_count)
+
+        # each new input-shape signature through the jitted step is one
+        # XLA compile — counted per (site, shape) by the instrumentation
+        # cached_step wraps on, so compile churn stays attributable
+        return self.cached_step((Q, L, k, tiered, with_count, U), build,
+                                "text_plane")
 
 
 class DistributedKnnPlane:
@@ -2736,21 +3426,16 @@ class DistributedKnnPlane:
             return self.search_host(query_vectors, k=k, stages=stages)
         return self.search(query_vectors, k=k, stages=stages)
 
+    cached_step = _plane_cached_step
+
     def _get_step(self, k: int):
-        with self._steps_lock:
-            fn = self._steps.get(k)
-        if fn is None:
-            # build + instrument OUTSIDE the lock (ESTP-L02; see the
-            # text plane's _get_pruned_step)
-            fn = build_knn_step(
+        return self.cached_step(
+            (k,),
+            lambda: build_knn_step(
                 self.mesh, n_pad=self.n_pad, dim=max(self.dim, 1), k=k,
                 n_shards=self.n_shards, similarity=self.similarity,
-                block=self.block)
-            from ..common.telemetry import instrument_step
-            fn = instrument_step(fn, site="knn_plane")
-            with self._steps_lock:
-                fn = self._steps.setdefault(k, fn)
-        return fn
+                block=self.block),
+            "knn_plane")
 
     def search(self, query_vectors, k: int = 10,
                stages: Optional[dict] = None):
@@ -3010,23 +3695,15 @@ class DistributedKnnPlane:
         return vals, hits
 
     def _get_ivf_step(self, k: int, nprobe: int, r_cand: int, Pw: int):
-        key = ("ivf", k, nprobe, r_cand, Pw)
-        with self._steps_lock:
-            fn = self._steps.get(key)
-        if fn is None:
-            # build + instrument OUTSIDE the lock (ESTP-L02; see the
-            # text plane's _get_pruned_step)
-            fn = build_ivf_knn_step(
+        return self.cached_step(
+            ("ivf", k, nprobe, r_cand, Pw),
+            lambda: build_ivf_knn_step(
                 self.mesh, n_pad=self.n_pad, dim=max(self.dim, 1),
                 k=k, n_shards=self.n_shards,
                 similarity=self.similarity, nprobe=nprobe,
                 r_cand=r_cand, p_blocks=Pw, blk=self.ivf.block,
-                quant=self.ivf.quant)
-            from ..common.telemetry import instrument_step
-            fn = instrument_step(fn, site="knn_ivf_plane")
-            with self._steps_lock:
-                fn = self._steps.setdefault(key, fn)
-        return fn
+                quant=self.ivf.quant),
+            "knn_ivf_plane")
 
     def search_ivf_host(self, query_vectors, k: int = 10, *, nprobe: int,
                         rerank: int, stages: Optional[dict] = None):
@@ -3143,6 +3820,165 @@ class DistributedKnnPlane:
             stages["compile_cache"] = "host"
             stages["docs_scanned"] = self._ivf_probed_docs(probed)
         return vals_out, hits_out
+
+
+# ---------------------------------------------------------------------------
+# One-dispatch fused serving entry (device): both planes, one program
+# ---------------------------------------------------------------------------
+
+
+def fused_search_device(text_plane: "DistributedSearchPlane",
+                        knn_plane: "DistributedKnnPlane", fqs, *,
+                        fusion: str, rescore_mode: Optional[str] = None,
+                        stages: Optional[dict] = None,
+                        extra_docs: int = 0,
+                        extra_df: Optional[Dict[str, int]] = None):
+    """Serve a batch of planned hybrid queries through ONE jitted
+    program over both planes' tensors (:func:`build_fused_hybrid_step`).
+
+    ``fqs``: one dict per query — ``clauses``/``msm`` (the lowered bool
+    tree), ``qv`` (query vector), ``kboost``, ``rc`` (RRF constant),
+    ``wt``/``wk`` (text/knn rank windows), ``k`` (final size) and an
+    optional ``rescore`` dict (``terms``/``qw``/``rw``/``window``).
+    Every query in the batch shares ``fusion`` and ``rescore_mode``
+    (the micro-batcher co-batches only within one plan shape).
+
+    Returns (rows, totals, text_rows, knn_rows): ``rows[bi]`` is the
+    fused [(score, shard, doc)] ranking trimmed to that query's ``k``;
+    the raw per-retriever rankings ride along for delta-merge and
+    parity callers."""
+    if text_plane.mesh is not knn_plane.mesh:
+        raise ValueError("fused dispatch needs both planes on one mesh")
+    if text_plane.n_shards != knn_plane.n_shards:
+        raise ValueError("fused dispatch needs aligned shard counts")
+    t0 = time.perf_counter()
+    mesh = text_plane.mesh
+    B = len(fqs)
+    n_repl = mesh.shape[AXIS_REPLICA]
+    B_pad = -(-B // n_repl) * n_repl
+    dim = max(knn_plane.dim, 1)
+    pad_fq = {"clauses": [], "msm": 0,
+              "qv": np.zeros(dim, np.float32), "kboost": 1.0,
+              "rc": 60.0, "wt": 0, "wk": 0, "k": 0,
+              "rescore": {"terms": [], "qw": 1.0, "rw": 1.0,
+                          "window": 0} if rescore_mode else None}
+    fqs = list(fqs) + [pad_fq] * (B_pad - B)
+    bool_queries = [{"clauses": fq["clauses"], "msm": fq["msm"]}
+                    for fq in fqs]
+    Q = max(text_plane.SERVING_Q_MIN, round_up_pow2(
+        text_plane.bool_slot_count(bool_queries)))
+    (starts, lengths, idfw, cbits, req, neg, shd, msm, max_len,
+     any_dense) = text_plane.bool_inputs(bool_queries, Q,
+                                         extra_docs=extra_docs,
+                                         extra_df=extra_df)
+    if any_dense:
+        raise ValueError("fused batch touches dense-tier terms; the "
+                         "sparse-slice fused step cannot serve it")
+    L = min(text_plane.ladder_L(max_len), text_plane.L_cap)
+    np.minimum(lengths, L, out=lengths)
+    qv = np.stack([np.asarray(fq["qv"], np.float32) for fq in fqs])
+    kboost = np.asarray([fq.get("kboost", 1.0) for fq in fqs],
+                        np.float32)
+    rc = np.asarray([fq.get("rc", 60.0) for fq in fqs], np.float32)
+    wt = np.asarray([fq.get("wt", 0) for fq in fqs], np.int32)
+    wk = np.asarray([fq.get("wk", 0) for fq in fqs], np.int32)
+    W_text = round_up_pow2(max(int(wt.max()), 1))
+    W_knn = round_up_pow2(max(int(wk.max()), 1))
+    from ..ops.fused_query import MAX_BOOL_CLAUSES
+    Q2 = 0
+    rescore_args = ()
+    if rescore_mode is not None:
+        bags2 = [list(fq["rescore"]["terms"]) for fq in fqs]
+        Q2 = max(8, round_up_pow2(max(
+            max((len(set(b)) for b in bags2), default=1), 1)))
+        (st2, ln2, iw2, _dr, _dh, _ml2, dense2) = text_plane._lookup(
+            bags2, Q2, extra_docs=extra_docs, extra_df=extra_df)
+        if dense2:
+            raise ValueError("fused rescore touches dense-tier terms")
+        qw = np.asarray([fq["rescore"]["qw"] for fq in fqs], np.float32)
+        rw = np.asarray([fq["rescore"]["rw"] for fq in fqs], np.float32)
+        rwin = np.asarray([fq["rescore"]["window"] for fq in fqs],
+                          np.int32)
+    step = text_plane.cached_step(
+        ("fused", Q, L, W_text, W_knn, fusion, Q2, rescore_mode,
+         knn_plane.n_pad, dim, knn_plane.similarity),
+        lambda: build_fused_hybrid_step(
+            mesh, n_pad_t=text_plane.n_pad, Q=Q, L=L, W_text=W_text,
+            nc=MAX_BOOL_CLAUSES, n_pad_k=knn_plane.n_pad, dim=dim,
+            similarity=knn_plane.similarity, W_knn=W_knn,
+            k=W_text + W_knn, fusion=fusion,
+            n_shards=text_plane.n_shards, Q2=Q2,
+            rescore_mode=rescore_mode or "total",
+            block=knn_plane.block),
+        "fused_plane")
+    kvecs_dev, kvn_dev, kex_dev = knn_plane._device_arrays()
+    repl = NamedSharding(mesh, P(AXIS_REPLICA, None))
+    repl1 = NamedSharding(mesh, P(AXIS_REPLICA))
+    repl3 = NamedSharding(mesh, P(AXIS_REPLICA, AXIS_SHARD, None))
+    args = [text_plane.docs_dev, text_plane.impacts_dev,
+            kvecs_dev, kvn_dev, kex_dev,
+            jax.device_put(starts, repl3), jax.device_put(lengths, repl3),
+            jax.device_put(idfw, repl), jax.device_put(cbits, repl),
+            jax.device_put(req, repl1), jax.device_put(neg, repl1),
+            jax.device_put(shd, repl1), jax.device_put(msm, repl1),
+            jax.device_put(qv, repl), jax.device_put(kboost, repl1),
+            jax.device_put(rc, repl1), jax.device_put(wt, repl1),
+            jax.device_put(wk, repl1)]
+    h2d = starts.nbytes + lengths.nbytes + idfw.nbytes + cbits.nbytes \
+        + qv.nbytes + 24 * B_pad
+    if Q2:
+        args += [jax.device_put(st2, repl3), jax.device_put(ln2, repl3),
+                 jax.device_put(iw2, repl), jax.device_put(qw, repl1),
+                 jax.device_put(rw, repl1), jax.device_put(rwin, repl1)]
+        h2d += st2.nbytes + ln2.nbytes + iw2.nbytes + 12 * B_pad
+    t1 = time.perf_counter()
+    out = _run_step(text_plane._serial_dispatch, step, *args)
+    if stages is not None:
+        jax.block_until_ready(out)
+    t2 = time.perf_counter()
+    text_plane.n_dispatches += 1
+    knn_plane.n_dispatches += 1
+    from ..common import telemetry as _tm
+    _tm.record_mesh_dispatch(mesh.shape[AXIS_SHARD],
+                             mesh.shape[AXIS_REPLICA])
+    compiled = _tm.last_call_compiled()
+    fvals = np.asarray(out[0])[:B]
+    fids = np.asarray(out[1])[:B]
+    counts = np.asarray(out[2])[:B]
+    tvals = np.asarray(out[3])[:B]
+    tids = np.asarray(out[4])[:B]
+    kvals = np.asarray(out[5])[:B]
+    kids = np.asarray(out[6])[:B]
+    d2h = fvals.nbytes + fids.nbytes + counts.nbytes + tvals.nbytes \
+        + tids.nbytes + kvals.nbytes + kids.nbytes
+    _tm.record_transfer(h2d_bytes=h2d, d2h_bytes=d2h)
+    UP = max(text_plane.n_pad, knn_plane.n_pad)
+
+    def decode(vrow, grow, npad, kq):
+        rows = []
+        for v, g in zip(vrow, grow):
+            if v == NEG_INF or len(rows) >= kq:
+                break
+            rows.append((float(v), int(g) // npad, int(g) % npad))
+        return rows
+
+    rows = [decode(fvals[bi], fids[bi], UP, fqs[bi].get("k") or
+                   (W_text + W_knn)) for bi in range(B)]
+    text_rows = [decode(tvals[bi], tids[bi], text_plane.n_pad,
+                        int(wt[bi])) for bi in range(B)]
+    knn_rows = [decode(kvals[bi], kids[bi], knn_plane.n_pad,
+                       int(wk[bi])) for bi in range(B)]
+    totals = [int(c) for c in counts]
+    if stages is not None:
+        stages["prep_ms"] = (t1 - t0) * 1e3
+        stages["dispatch_ms"] = (t2 - t1) * 1e3
+        stages["fetch_ms"] = (time.perf_counter() - t2) * 1e3
+        stages["compile_cache"] = "miss" if compiled else "hit"
+        stages["h2d_bytes"] = h2d
+        stages["d2h_bytes"] = d2h
+        stages["docs_scanned"] = text_plane.n_docs_total \
+            + knn_plane.n_docs_total
+    return rows, totals, text_rows, knn_rows
 
 
 # ---------------------------------------------------------------------------
@@ -3263,6 +4099,42 @@ class EagerDeltaScorer:
                 # parity
                 sel = tie_stable_topk_docs(scores, kk)
                 rows.extend((float(scores[d]), gseg, int(d)) for d in sel)
+            rows.sort(key=lambda r: (-r[0], r[1], r[2]))
+            rows_out.append(rows[:k])
+            totals.append(total)
+        return rows_out, totals
+
+
+    def score_bool(self, bool_queries, k: int, idf_of,
+                   with_totals: bool = False):
+        """Bool-tree twin of :meth:`score` for the fused planner: the
+        same clause-bit eligibility pass as
+        :meth:`DistributedSearchPlane.search_bool_eager`, over the delta
+        segments' CSR, under the COMBINED-stats idf (``idf_of``)."""
+        rows_out: List[List[Tuple[float, int, int]]] = []
+        totals: List[int] = []
+        for bq in bool_queries:
+            clauses = bq.get("clauses") or []
+            msm = int(bq.get("msm", 0))
+            req, neg, shd = bool_role_masks(clauses)
+            per_clause = bool_clause_rows(clauses, idf_of)
+            rows: List[Tuple[float, int, int]] = []
+            total = 0
+            for gseg, csr in zip(self.seg_positions, self._csr):
+                got = _bool_csr_shard_pool(csr["term_ids"], csr,
+                                           per_clause, req, neg, shd,
+                                           msm)
+                if got is None:
+                    continue
+                scores, pool = got
+                if with_totals:
+                    total += int(pool.size)
+                if not pool.size:
+                    continue
+                sel = tie_stable_topk_masked(scores, pool,
+                                             min(k, csr["n_docs"]))
+                rows.extend((float(scores[d]), gseg, int(d))
+                            for d in sel)
             rows.sort(key=lambda r: (-r[0], r[1], r[2]))
             rows_out.append(rows[:k])
             totals.append(total)

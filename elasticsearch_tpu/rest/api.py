@@ -6420,6 +6420,38 @@ class RestAPI:
                 raise IllegalArgumentError(
                     f"[knn] [rerank] must be a positive integer, "
                     f"got [{rr}]")
+        rank = search_body.get("rank")
+        if rank is not None:
+            # rank method validation (RankBuilder parse): one method,
+            # rrf only, positive integer knobs — the fused planner and
+            # the pooled RRF path both rely on these invariants
+            if not isinstance(rank, dict) or len(rank) != 1:
+                raise IllegalArgumentError(
+                    "[rank] must specify exactly one rank method")
+            (method, rbody), = rank.items()
+            if method != "rrf":
+                raise IllegalArgumentError(
+                    f"unknown rank method [{method}]")
+            rbody = rbody or {}
+            if not isinstance(rbody, dict) or \
+                    set(rbody) - {"rank_constant", "rank_window_size"}:
+                raise IllegalArgumentError(
+                    "[rrf] supports [rank_constant] and "
+                    "[rank_window_size]")
+            rc = rbody.get("rank_constant", 60)
+            if isinstance(rc, bool) or not isinstance(rc, int) or rc < 1:
+                raise IllegalArgumentError(
+                    f"[rank_constant] must be greater or equal to [1] "
+                    f"for [rrf], got [{rc}]")
+            rws = rbody.get("rank_window_size", 10)
+            if isinstance(rws, bool) or not isinstance(rws, int) \
+                    or rws < 1:
+                raise IllegalArgumentError(
+                    f"[rank_window_size] must be greater or equal to "
+                    f"[1] for [rrf], got [{rws}]")
+            if search_body.get("sort") or search_body.get("collapse"):
+                raise IllegalArgumentError(
+                    "[rank] cannot be used with [sort] or [collapse]")
         for resc in _as_list(search_body.get("rescore")):
             w = int((resc or {}).get("window_size", 10))
             if w > 10000:

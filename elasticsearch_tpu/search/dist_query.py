@@ -222,12 +222,13 @@ class DistributedSearcher:
 
     def __init__(self, shard_segment_lists: List[list],
                  mapper: MapperService, plane_provider=None,
-                 knn_plane_provider=None):
+                 knn_plane_provider=None, fused_provider=None):
         all_segments = [s for segs in shard_segment_lists for s in segs]
         self._global_ctx = ShardContext(all_segments, mapper)
         self.mapper = mapper
         self.plane_provider = plane_provider
         self.knn_plane_provider = knn_plane_provider
+        self.fused_provider = fused_provider
         self.shards: List[ShardSearcher] = []
         # flattened-filtered segment index -> (shard, shard-local filtered
         # segment): the pooled plane route returns hits in global-segment
@@ -253,10 +254,13 @@ class DistributedSearcher:
         nodes without re-executing the query phase."""
         body = body or {}
         if body.get("rank") and "rrf" in body["rank"]:
-            # global-rank fusion: run pooled (see module docstring)
+            # global-rank fusion: run pooled (see module docstring);
+            # the fused provider rides along so a lowerable hybrid RRF
+            # body serves as ONE planned dispatch over the pooled list
             pooled = ShardSearcher(
                 self._global_ctx.segments, self.mapper,
-                knn_plane_provider=self.knn_plane_provider)
+                knn_plane_provider=self.knn_plane_provider,
+                fused_provider=self.fused_provider)
             pooled.ctx = self._global_ctx
             return pooled.search(body)
 

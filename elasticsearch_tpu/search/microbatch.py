@@ -438,6 +438,10 @@ class PlaneMicroBatcher:
         minutes of compiles); ``sync=True`` blocks (tests). Host-serving
         planes (CPU backend → eager/BLAS paths) compile nothing and
         return immediately."""
+        from ..common import telemetry as _tm
+        # n=0 up front: the cumulative family's presence is
+        # deterministic even when nothing compiles (host planes below)
+        _tm.record_warmed_shapes(0)
         if self._serves_host():
             return None
         shapes = list(self._warm_lattice(ks, max_b or self.max_batch))
@@ -460,6 +464,10 @@ class PlaneMicroBatcher:
                 racedep.note_write("microbatch.stats", self)
                 self.warmed_shapes += n
                 self.warmup_ms += (time.perf_counter() - t0) * 1e3
+            # process-cumulative credit: survives this batcher's
+            # retirement, so compile_churn windows stay honest across
+            # generation swaps (see telemetry.record_warmed_shapes)
+            _tm.record_warmed_shapes(n)
 
         if sync:
             _run()
@@ -649,6 +657,102 @@ class KnnPlaneMicroBatcher(PlaneMicroBatcher):
         return vals, hits, [None] * len(queries)
 
 
+class FusedPlaneMicroBatcher(PlaneMicroBatcher):
+    """Micro-batcher over a ``query_planner.FusedPlanRunner``: planned
+    hybrid/bool requests coalesce into ONE fused dispatch (lexical scan
+    + kNN scan + fusion + rescore), exactly like bag queries coalesce
+    through the per-plane batchers. Slots carry plan items
+    (``query_planner.make_item``); co-batching splits on the plan's
+    SHAPE via ``params`` (fusion kind, rescore mode, windows,
+    bag-vs-bool route, knn knobs), so one dispatch always runs one
+    compiled program."""
+
+    def _pad_slot(self):
+        return {"bag": [], "clauses": [], "msm": 0, "qv": None,
+                "kboost": 1.0, "knn_k": 0, "knn_nc": 0,
+                "nprobe": None, "rerank": None, "fusion": None,
+                "rc": 60, "wt": 0, "k": 0, "rescore": None,
+                "n_stages": 1, "key": ("pad",)}
+
+    @staticmethod
+    def _query_key(item):
+        return item["key"]
+
+    def _serves_host(self) -> bool:
+        return self.plane.serves_host()
+
+    def _warm_lattice(self, ks, max_b):
+        # fused shapes warm on first dispatch per shape; the lattice is
+        # bounded by (B-pow2 × plan shape) and the bench asserts zero
+        # steady-state compiles after that first window
+        return iter(())
+
+    def _dispatch(self, queries, k: int, stages: Optional[dict] = None,
+                  view=None, params=None):
+        prune = None
+        if params is not None:
+            for p in params:
+                if isinstance(p, tuple) and p and p[0] == "prune":
+                    prune = p[1]
+        return self.plane.serve_view(queries, view=view, stages=stages,
+                                     prune=prune)
+
+
+def knn_dispatch_params(plane, nprobe: Optional[int],
+                        rerank: Optional[int]):
+    """Bucketed IVF (nprobe, rerank) dispatch params for one kNN plane
+    — pow2-rounded UP (extra probes only improve recall) so co-batched
+    queries share one compile shape. None when the plane has no IVF
+    tier (the knobs are inert there)."""
+    ivf = getattr(plane, "ivf", None)
+    if ivf is None:
+        return None
+    if nprobe == 0:
+        return (0, 0)             # exact scan explicitly requested
+    from ..utils.shapes import round_up_pow2
+    from ..parallel.dist_search import IVF_DEFAULT_RERANK
+    want = ivf.default_nprobe if nprobe is None else max(1, int(nprobe))
+    rr = IVF_DEFAULT_RERANK if not rerank else max(1, int(rerank))
+    return (min(round_up_pow2(want, 1), ivf.nlist),
+            round_up_pow2(rr, 1))
+
+
+def batched_fused_search(runner, item: dict, *, view=None,
+                         stages: Optional[dict] = None,
+                         info: Optional[dict] = None,
+                         prune: Optional[bool] = None):
+    """Route one PLANNED request through the fused runner's
+    micro-batcher. ``item`` is ``query_planner.make_item`` output;
+    ``prune`` rides the lexical stage exactly like the text plane's
+    knob. Returns (scores np.f32[k], hits [(shard, doc)...], total)."""
+    from ..utils.shapes import round_up_pow2
+    kbase = runner._knn_base()
+    knn_params = knn_dispatch_params(kbase, item.get("nprobe"),
+                                     item.get("rerank")) \
+        if kbase is not None else None
+    tbase = runner._text_base()
+    prune_param = None
+    if item.get("bag") is not None and \
+            getattr(tbase, "blockmax", None) is not None:
+        prune_param = ("prune", prune is not False)
+    params = ("fused",
+              item["bag"] is not None,
+              item["fusion"],
+              item["rescore"]["mode"] if item.get("rescore") else None,
+              round_up_pow2(max(item["wt"], 1)),
+              round_up_pow2(max(item["knn_nc"], 1)),
+              knn_params, prune_param)
+    batcher = getattr(runner, "_microbatcher", None)
+    if batcher is None:
+        with _CREATE_LOCK:
+            batcher = getattr(runner, "_microbatcher", None)
+            if batcher is None:
+                batcher = FusedPlaneMicroBatcher(runner)
+                runner._microbatcher = batcher
+    return batcher.search(item, item["k"], stages=stages, info=info,
+                          view=view, params=params)
+
+
 def batched_search(plane, terms: Sequence[str], k: int,
                    stages: Optional[dict] = None,
                    info: Optional[dict] = None, view=None,
@@ -692,19 +796,7 @@ def batched_knn_search(plane, query_vector, k: int, view=None,
     compile shape and the warmup lattice covers live traffic. On a plane
     without an IVF tier the knobs are inert (exact brute force) and
     every request shares the knob-less dispatch."""
-    params = None
-    ivf = getattr(plane, "ivf", None)
-    if ivf is not None:
-        if nprobe == 0:
-            params = (0, 0)         # exact scan explicitly requested
-        else:
-            from ..utils.shapes import round_up_pow2
-            from ..parallel.dist_search import IVF_DEFAULT_RERANK
-            want = ivf.default_nprobe if nprobe is None \
-                else max(1, int(nprobe))
-            rr = IVF_DEFAULT_RERANK if not rerank else max(1, int(rerank))
-            params = (min(round_up_pow2(want, 1), ivf.nlist),
-                      round_up_pow2(rr, 1))
+    params = knn_dispatch_params(plane, nprobe, rerank)
     batcher = getattr(plane, "_microbatcher", None)
     if batcher is None:
         with _CREATE_LOCK:

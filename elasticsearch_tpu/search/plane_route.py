@@ -591,10 +591,18 @@ class ServingPlaneCache:
     LEX_PRUNE_MIN_DOCS = int(os.environ.get(
         "ES_TPU_LEX_PRUNE_MIN_DOCS", str(1 << 17)))
 
+    #: max cached fused-plan runners (generation pairs; runners hold no
+    #: corpus bytes of their own — only batcher state)
+    FUSED_RUNNER_CACHE_MAX = 8
+
     def __init__(self, mesh_factory=None, min_docs: int = _MIN_DOCS_DEFAULT):
         self._mesh_factory = mesh_factory
         self._mesh = None
         self._planes: Dict[str, TextServingGeneration] = {}
+        #: (text gen id, knn gen id) → query_planner.FusedPlanRunner —
+        #: the one-dispatch planner's executor per generation pair;
+        #: entries die with either generation (see _release_gen)
+        self._fused_runners: "OrderedDict[tuple, object]" = OrderedDict()
         # kNN generations key on (field, base segment identity): the
         # distributed searcher probes one plane per index shard (distinct
         # segment lists), and field-only keying would rebuild on every
@@ -742,13 +750,58 @@ class ServingPlaneCache:
                 list(self._knn_planes.values())
 
     def serving_batchers(self) -> list:
-        """The micro-batchers of every live generation (stats rollup)."""
+        """The micro-batchers of every live generation AND fused-plan
+        runner (stats rollup)."""
+        with self._gen_lock:
+            runners = list(self._fused_runners.values())
         out = []
-        for gen in self.generations():
+        for gen in self.generations() + runners:
             b = getattr(gen, "_microbatcher", None)
             if b is not None:
                 out.append(b)
         return out
+
+    def fused_runner_for(self, segments: Sequence[Segment],
+                         mapper: MapperService, text_field: str,
+                         knn_field: Optional[str] = None):
+        """The one-dispatch planner's executor for this segment list —
+        a ``query_planner.FusedPlanRunner`` over the (text, knn)
+        serving-generation pair — or None when either generation is
+        unavailable (route ineligible / mid-repack): the caller falls
+        back to the legacy two-dispatch path."""
+        segments = [s for s in segments if s.n_docs > 0]
+        if not segments:
+            return None
+        tgen = self.plane_for(segments, mapper, text_field)
+        if tgen is None:
+            return None
+        kgen = None
+        if knn_field is not None:
+            kgen = self.knn_plane_for(segments, mapper, knn_field)
+            if kgen is None:
+                return None
+        key = (id(tgen), id(kgen) if kgen is not None else None)
+        with self._gen_lock:
+            r = self._fused_runners.get(key)
+            if r is not None:
+                self._fused_runners.move_to_end(key)
+                return r
+        from .query_planner import FusedPlanRunner
+        r = FusedPlanRunner(tgen, kgen, cache=self)
+        doomed = []
+        with self._gen_lock:
+            raced = self._fused_runners.get(key)
+            if raced is not None:
+                return raced
+            if self._closed:
+                return None
+            self._fused_runners[key] = r
+            while len(self._fused_runners) > self.FUSED_RUNNER_CACHE_MAX:
+                _k, old = self._fused_runners.popitem(last=False)
+                doomed.append(old)
+        for old in doomed:
+            self._retire(old)
+        return r
 
     @staticmethod
     def _attach_batcher(plane, knn: bool = False):
@@ -781,11 +834,18 @@ class ServingPlaneCache:
 
     def _release_gen(self, gen) -> None:
         """Release a generation's (or bare plane's) breaker reservation
-        and retire its batcher."""
+        and retire its batcher — plus any fused-plan runner built over
+        it (a stale runner would pin the superseded corpus)."""
         from ..common.breakers import DEFAULT as _breakers
         acct = _breakers.breaker("accounting")
         acct.release(getattr(gen, "_acct_bytes", 0))
         self._retire(gen)
+        with self._gen_lock:
+            doomed = [k for k, r in self._fused_runners.items()
+                      if r.text_gen is gen or r.knn_gen is gen]
+            runners = [self._fused_runners.pop(k) for k in doomed]
+        for r in runners:
+            self._retire(r)
 
     def _get_mesh(self):
         # every read goes through _mesh_lock — a lock-free fast path
@@ -1359,6 +1419,10 @@ class ServingPlaneCache:
                 list(self._knn_planes.values())
             self._planes.clear()
             self._knn_planes.clear()
+            runners = list(self._fused_runners.values())
+            self._fused_runners.clear()
+        for r in runners:
+            self._retire(r)
         for gen in gens:
             self._release_gen(gen)
         self.drain_repacks(timeout=5.0)

@@ -164,7 +164,8 @@ class ShardSearcher:
     (fetch, error shapes, pagination) is shared."""
 
     def __init__(self, segments: List[Segment], mapper: MapperService,
-                 plane_provider=None, knn_plane_provider=None):
+                 plane_provider=None, knn_plane_provider=None,
+                 fused_provider=None):
         self.segments = [s for s in segments if s.n_docs > 0]
         self.mapper = mapper
         self.ctx = ShardContext(self.segments, mapper)
@@ -174,6 +175,13 @@ class ShardSearcher:
         #: (pack-time invariants + streaming top-k) with query_vector
         #: micro-batching across concurrent requests
         self.knn_plane_provider = knn_plane_provider
+        #: optional ``(segments, text_field, knn_field|None) ->
+        #: FusedPlanRunner | None`` hook
+        #: (``plane_route.ServingPlaneCache.fused_runner_for``): bodies
+        #: the query planner can lower (bool tree + knn + rescore) run
+        #: as ONE fused dispatch over both serving generations instead
+        #: of two dispatches + host fusion
+        self.fused_provider = fused_provider
 
     # ------------------------------------------------------------------
     # knn
@@ -447,6 +455,51 @@ class ShardSearcher:
                     if plane is not None:
                         plane_route = (plane, ext[1])
 
+        # --- fused one-dispatch route (the query planner) -----------------
+        # A lowerable bool tree / hybrid knn / rescore pipeline executes
+        # as ONE fused dispatch over the serving generations
+        # (search/query_planner.py) instead of two dispatches + host
+        # fusion; anything the planner or its runner cannot serve falls
+        # through to the existing paths below unchanged.
+        fused_result = None
+        fused_plan = None
+        planner_consulted = False
+        if (self.fused_provider is not None and query_spec
+                and knn_override is None and window > 0
+                and min_score is None and search_after is None
+                and not use_field_sort and not collect_agg_inputs):
+            from . import query_planner as qp
+            if qp.planner_enabled():
+                planner_consulted = True
+                fused_plan = qp.lower_body(body, self.mapper)
+                runner = None
+                if fused_plan is not None:
+                    runner = self.fused_provider(
+                        self.segments, fused_plan.field,
+                        fused_plan.knn.field
+                        if fused_plan.knn is not None else None)
+                if fused_plan is not None and runner is not None and \
+                        runner.can_serve(fused_plan):
+                    if prune_opt is None:
+                        fprune = False if track_total_hits is True \
+                            else None
+                    else:
+                        fprune = prune_opt
+                    from .microbatch import batched_fused_search
+                    fstages: Dict[str, float] = {}
+                    finfo: Dict[str, object] = {}
+                    try:
+                        fused_result = batched_fused_search(
+                            runner, qp.make_item(fused_plan),
+                            view=self.segments, stages=fstages,
+                            info=finfo, prune=fprune)
+                    except qp.FusedFallback:
+                        fused_result = None
+                from ..common import telemetry as _tm
+                _tm.record_planner(
+                    "fused" if fused_result is not None
+                    else "fallback")
+
         # --- query phase (device) -----------------------------------------
         pending = []
         agg_pending = []
@@ -456,7 +509,34 @@ class ShardSearcher:
         serving_stages: Optional[Dict[str, float]] = None
         serving_info: Optional[Dict[str, object]] = None
         plane_total_gte = False
-        if plane_route is not None:
+        if fused_result is not None:
+            # the fused dispatch already ran the whole retrieval
+            # pipeline (bool scoring, knn, fusion, rescore): its rows
+            # ARE the candidates, its lexical count the total, and the
+            # knn/rescore sections below must not run again
+            fvals, fhits, ftotal = fused_result
+            serving_stages = fstages
+            serving_info = finfo
+            from ..parallel.dist_search import (total_is_lower_bound,
+                                                total_value)
+            plane_total_gte = total_is_lower_bound(ftotal)
+            total = total_value(ftotal)
+            candidates = [(float(v), si, d)
+                          for v, (si, d) in zip(fvals, fhits)]
+            knn_spec = None
+            rescore_spec = None
+            rank_spec = None
+            from ..common import tracing as _tracing
+            _tracing.record_point(
+                "fused_dispatch",
+                took_ms=sum(v for v in serving_stages.values()
+                            if isinstance(v, (int, float))),
+                attrs={**{s: round(ms, 3)
+                          for s, ms in serving_stages.items()
+                          if isinstance(ms, (int, float))},
+                       **serving_info})
+            _attribute_dispatch(serving_stages, serving_info)
+        elif plane_route is not None:
             plane, bag_terms = plane_route
             # concurrent eligible queries coalesce into one device dispatch
             # (search/microbatch.py — the search-thread-pool analog); the
@@ -574,30 +654,20 @@ class ShardSearcher:
 
         max_score: Optional[float] = None
         if knn_rankings:
+            # ONE copy of the fusion arithmetic, shared with the fused
+            # planner's host runner (query_planner) — the fused path's
+            # bit-parity with this section holds by shared code
+            from .query_planner import rrf_fuse_rows, sum_fuse_rows
             if rank_spec and "rrf" in rank_spec:
                 rc = int(rank_spec["rrf"].get("rank_constant", 60))
                 rankings = ([candidates[:window]] if query_spec else []) \
                     + knn_rankings
-                rrf: Dict[Tuple[int, int], float] = {}
-                for ranking in rankings:
-                    for rank_i, (_, si, d) in enumerate(ranking):
-                        rrf[(si, d)] = rrf.get((si, d), 0.0) + \
-                            1.0 / (rc + rank_i + 1)
-                candidates = sorted(
-                    ((sc, si, d) for (si, d), sc in rrf.items()),
-                    key=lambda c: (-c[0], c[1], c[2]))
+                candidates = rrf_fuse_rows(rankings, rc)
             else:
                 # hybrid: sum scores for docs in both result sets
-                combined: Dict[Tuple[int, int], float] = {}
-                if query_spec:
-                    for sc, si, d in candidates:
-                        combined[(si, d)] = combined.get((si, d), 0.0) + sc
-                for ranking in knn_rankings:
-                    for sc, si, d in ranking:
-                        combined[(si, d)] = combined.get((si, d), 0.0) + sc
-                candidates = sorted(
-                    ((sc, si, d) for (si, d), sc in combined.items()),
-                    key=lambda c: (-c[0], c[1], c[2]))
+                rankings = ([candidates] if query_spec else []) \
+                    + knn_rankings
+                candidates = sum_fuse_rows(rankings)
             if not query_spec:
                 total = len(candidates)
             if use_field_sort:
@@ -812,6 +882,23 @@ class ShardSearcher:
                     "stages_ms": {s: round(ms, 3)
                                   for s, ms in serving_stages.items()},
                     **(serving_info or {})}
+            if planner_consulted:
+                # the one-dispatch planner's verdict + lowering cost:
+                # operators bisecting a fused-path regression see which
+                # route served and what the compile step of the request
+                # (host-side lowering) cost
+                shard_prof["planner"] = {
+                    "outcome": ("fused" if fused_result is not None
+                                else "fallback"),
+                    "lower_ms": round(fused_plan.lower_ms, 3)
+                    if fused_plan is not None else None,
+                    "stages_per_dispatch": fused_plan.n_stages()
+                    if fused_plan is not None else None,
+                }
+                if serving_stages is not None and \
+                        fused_result is not None:
+                    shard_prof["serving"]["planner"] = \
+                        shard_prof["planner"]
             profile_out = {"shards": [shard_prof]}
 
         return ShardSearchResult(total=total, total_relation=total_relation,
