@@ -57,13 +57,18 @@ class AllocationContext:
     def __init__(self, nodes: List[str], routing: dict, meta: dict,
                  node_attrs: Optional[Dict[str, dict]] = None,
                  disk_used: Optional[Dict[str, float]] = None,
-                 moves_in_flight: int = 0):
+                 moves_in_flight: int = 0,
+                 plane_storms: Optional[Dict[str, int]] = None):
         self.nodes = nodes
         self.routing = routing
         self.meta = meta
         self.node_attrs = node_attrs or {}
         self.disk_used = disk_used or {}
         self.moves_in_flight = moves_in_flight
+        #: per-node sync non-cold serving-plane rebuild counts (the
+        #: plane_serving health indicator's storm signature, learned
+        #: from master ping piggybacks — telemetry DRIVING placement)
+        self.plane_storms = plane_storms or {}
 
     def copies_on(self, node: str) -> List[Tuple[str, int]]:
         out = []
@@ -199,6 +204,32 @@ class ThrottlingDecider:
         return Decision(YES, self.name, "below recovery throttle")
 
 
+#: sync non-cold rebuilds per node past which the node counts as being
+#: in an active rebuild storm (mirrors HealthService.SYNC_REBUILD_RED:
+#: the plane_serving indicator turns red at the same count)
+STORM_THRESHOLD = 8
+
+
+class ServingStormDecider:
+    """Health-driven placement: a node in an active serving-plane
+    rebuild storm (``es_plane_rebuild_total{mode="sync"}`` beyond cold
+    builds — the red ``plane_serving`` signature, piggybacked on master
+    ping responses) takes no NEW shard copies: every copy placed there
+    lands its searches behind request-thread repacks. The health signal
+    drives allocation instead of only paging an operator."""
+
+    name = "serving_storm"
+
+    def can_allocate(self, index, sid, node, ctx) -> Decision:
+        storms = int((ctx.plane_storms or {}).get(node, 0))
+        if storms >= STORM_THRESHOLD:
+            return Decision(NO, self.name,
+                            f"node [{node}] is in a serving-plane "
+                            f"rebuild storm ({storms} sync non-cold "
+                            f"rebuilds); not placing new copies there")
+        return Decision(YES, self.name, "no active rebuild storm")
+
+
 class MaxRetryDecider:
     """Stop retrying a copy that keeps failing
     (``MaxRetryAllocationDecider``); a manual reroute with retry_failed
@@ -220,7 +251,7 @@ class MaxRetryDecider:
 
 ALL_DECIDERS = (SameShardDecider(), FilterDecider(), AwarenessDecider(),
                 DiskThresholdDecider(), ThrottlingDecider(),
-                MaxRetryDecider())
+                ServingStormDecider(), MaxRetryDecider())
 
 
 def decide(index, sid, node, ctx,

@@ -1,0 +1,195 @@
+"""Shared RPC timeout classes + jittered-backoff retry for the cluster.
+
+Before this module every cluster RPC call site carried its own literal
+timeout (2.0 / 5.0 / 10.0 / 15.0 scattered through ``node/cluster_rest``
+and ``node/cluster_node``) and its own ad-hoc retry loop, so tuning the
+cluster for chaos-induced slowness (fault-injected delay, a GC-stalled
+peer) meant editing call sites. The reference keys every transport
+request to a named timeout setting (``TransportRequestOptions`` /
+``cluster.*.timeout`` settings); this is that discipline reduced to the
+four lanes this codebase actually has:
+
+- ``fast``    — liveness-class metadata probes (ping follow-ups,
+  shard:insync, shard:refresh): cheap, retried elsewhere, fail fast.
+- ``data``    — routed document ops and replica-channel fan-out.
+- ``meta``    — master metadata ops / whole-request forwarding: these
+  wait on publications, so they get the long lane.
+- ``search``  — per-ATTEMPT budget of one ``search:shards`` /
+  ``search:stats`` RPC; the coordinator's copy-failover loop spends
+  several of these, each against a different shard copy.
+
+Every value is settings-driven (``cluster.rpc.timeout.*``, registered in
+:mod:`~elasticsearch_tpu.common.settings`) with environment overrides
+(``ES_TPU_RPC_TIMEOUT_<LANE>``) so the chaos bench can tighten the
+cluster without code edits.
+
+The retry half is ONE shared jittered-backoff policy
+(:func:`backoff_delays` — full jitter over an exponentially growing cap,
+the AWS-architecture-blog shape that avoids retry synchronization after
+a node death) consumed by the search failover loop, recovery chunk
+transfer, and the agg-partials fan-out, instead of three hand-rolled
+sleep loops.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Iterator, Optional
+
+from .settings import CLUSTER_SETTINGS, Setting
+
+#: registered cluster-scope settings (dynamic: a reroute/chaos harness
+#: may retune a live cluster)
+SETTING_RPC_TIMEOUT_FAST = CLUSTER_SETTINGS.register(
+    Setting.float_setting("cluster.rpc.timeout.fast", 2.0,
+                          scope="cluster", dynamic=True))
+SETTING_RPC_TIMEOUT_DATA = CLUSTER_SETTINGS.register(
+    Setting.float_setting("cluster.rpc.timeout.data", 5.0,
+                          scope="cluster", dynamic=True))
+SETTING_RPC_TIMEOUT_META = CLUSTER_SETTINGS.register(
+    Setting.float_setting("cluster.rpc.timeout.meta", 10.0,
+                          scope="cluster", dynamic=True))
+SETTING_RPC_TIMEOUT_SEARCH = CLUSTER_SETTINGS.register(
+    Setting.float_setting("cluster.rpc.timeout.search", 15.0,
+                          scope="cluster", dynamic=True))
+SETTING_RPC_RETRY_ATTEMPTS = CLUSTER_SETTINGS.register(
+    Setting.int_setting("cluster.rpc.retry.attempts", 3,
+                        scope="cluster", dynamic=True, min_value=1))
+SETTING_RPC_RETRY_BACKOFF_BASE = CLUSTER_SETTINGS.register(
+    Setting.float_setting("cluster.rpc.retry.backoff_base", 0.05,
+                          scope="cluster", dynamic=True))
+SETTING_RPC_RETRY_BACKOFF_CAP = CLUSTER_SETTINGS.register(
+    Setting.float_setting("cluster.rpc.retry.backoff_cap", 0.5,
+                          scope="cluster", dynamic=True))
+
+
+class RpcTimeouts:
+    """The four timeout lanes + retry knobs, resolved once per process
+    from (env override, settings value, registered default) and
+    re-resolvable at runtime via :meth:`configure`."""
+
+    _LANES = ("fast", "data", "meta", "search")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values = {}
+        self.configure(None)
+
+    @staticmethod
+    def _env(lane: str) -> Optional[float]:
+        raw = os.environ.get(f"ES_TPU_RPC_TIMEOUT_{lane.upper()}")
+        if raw is None:
+            return None
+        try:
+            return float(raw)
+        except ValueError:
+            return None
+
+    def configure(self, settings=None) -> None:
+        """Re-resolve every lane. ``settings`` is a
+        :class:`~elasticsearch_tpu.common.settings.Settings` (or None for
+        registered defaults); env overrides always win — the chaos bench
+        tunes per-process without threading a settings object through."""
+        from .settings import Settings
+        s = settings or Settings.EMPTY
+        by_lane = {
+            "fast": SETTING_RPC_TIMEOUT_FAST,
+            "data": SETTING_RPC_TIMEOUT_DATA,
+            "meta": SETTING_RPC_TIMEOUT_META,
+            "search": SETTING_RPC_TIMEOUT_SEARCH,
+        }
+        vals = {}
+        for lane, setting in by_lane.items():
+            env = self._env(lane)
+            vals[lane] = env if env is not None else float(setting.get(s))
+        vals["retry_attempts"] = int(
+            os.environ.get("ES_TPU_RPC_RETRY_ATTEMPTS",
+                           SETTING_RPC_RETRY_ATTEMPTS.get(s)))
+        vals["backoff_base"] = float(
+            os.environ.get("ES_TPU_RPC_BACKOFF_BASE",
+                           SETTING_RPC_RETRY_BACKOFF_BASE.get(s)))
+        vals["backoff_cap"] = float(
+            os.environ.get("ES_TPU_RPC_BACKOFF_CAP",
+                           SETTING_RPC_RETRY_BACKOFF_CAP.get(s)))
+        with self._lock:
+            self._values = vals
+
+    def _get(self, key: str) -> float:
+        with self._lock:
+            return self._values[key]
+
+    @property
+    def fast(self) -> float:
+        return self._get("fast")
+
+    @property
+    def data(self) -> float:
+        return self._get("data")
+
+    @property
+    def meta(self) -> float:
+        return self._get("meta")
+
+    @property
+    def search(self) -> float:
+        return self._get("search")
+
+    @property
+    def retry_attempts(self) -> int:
+        return int(self._get("retry_attempts"))
+
+    @property
+    def backoff_base(self) -> float:
+        return self._get("backoff_base")
+
+    @property
+    def backoff_cap(self) -> float:
+        return self._get("backoff_cap")
+
+
+#: process-wide instance every cluster call site reads
+TIMEOUTS = RpcTimeouts()
+
+
+def backoff_delays(attempts: Optional[int] = None,
+                   base: Optional[float] = None,
+                   cap: Optional[float] = None,
+                   rng: Optional[random.Random] = None
+                   ) -> Iterator[float]:
+    """Yield up to ``attempts`` jittered backoff delays (seconds): full
+    jitter over an exponentially growing window —
+    ``uniform(0, min(cap, base * 2**i))`` — so a fleet of coordinators
+    retrying into the copies of one dead node's shards never
+    synchronizes into a thundering herd. A seeded ``rng`` makes the
+    schedule deterministic (the chaos harness passes one)."""
+    n = attempts if attempts is not None else TIMEOUTS.retry_attempts
+    b = base if base is not None else TIMEOUTS.backoff_base
+    c = cap if cap is not None else TIMEOUTS.backoff_cap
+    r = rng or random
+    for i in range(n):
+        yield r.uniform(0.0, min(c, b * (2 ** i)))
+
+
+def retry_with_backoff(fn, attempts: Optional[int] = None,
+                       rng: Optional[random.Random] = None,
+                       sleep=None, on_retry=None):
+    """Call ``fn()`` up to ``attempts`` times with jittered backoff
+    between failures; re-raises the last exception. ``on_retry(i, e)``
+    observes each failed attempt (telemetry hooks). ``sleep`` is
+    injectable for tests."""
+    import time as _time
+    do_sleep = sleep or _time.sleep
+    n = attempts if attempts is not None else TIMEOUTS.retry_attempts
+    last: Optional[Exception] = None
+    for i, delay in enumerate(backoff_delays(n, rng=rng)):
+        try:
+            return fn()
+        except Exception as e:   # noqa: BLE001 — caller-scoped retry
+            last = e
+            if on_retry is not None:
+                on_retry(i, e)
+            if i + 1 < n:
+                do_sleep(delay)
+    raise last if last is not None else RuntimeError("no attempts")

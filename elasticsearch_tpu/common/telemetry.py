@@ -54,6 +54,8 @@ __all__ = [
     "record_mesh_dispatch", "mesh_idle_devices",
     "instrument_step", "device_stats_doc", "ann_drift_count",
     "lex_prune_off_count",
+    "record_search_retry", "record_shard_failover",
+    "record_recovery_bytes", "record_plane_handoff_ms",
 ]
 
 
@@ -637,6 +639,61 @@ def lex_prune_off_count(registry: Optional[TelemetryRegistry]
     if not doc:
         return 0
     return int(sum(s["value"] for s in doc["series"]))
+
+
+# ---------------------------------------------------------------------------
+# cluster failover / recovery instrumentation
+# ---------------------------------------------------------------------------
+
+def record_search_retry(outcome: str, n: int = 1,
+                        registry: Optional[TelemetryRegistry]
+                        = None) -> None:
+    """Coordinator-side copy-failover accounting for the cluster search
+    fan-out: ``outcome="retried"`` per shard-group RPC that failed and
+    was re-routed to another in-sync copy, ``"recovered"`` per group
+    that then answered from a fallback copy, ``"exhausted"`` per shard
+    whose every copy failed (it lands in the response's
+    ``_shards.failures``). Every label value is pre-created so the
+    family's label space is stable for the telemetry lint."""
+    reg = registry or DEFAULT
+    for oc in ("retried", "recovered", "exhausted"):
+        reg.counter("es_search_retries_total", {"outcome": oc},
+                    help="cluster search copy-failover events per "
+                         "outcome").inc(n if oc == outcome else 0)
+
+
+def record_shard_failover(n: int = 1,
+                          registry: Optional[TelemetryRegistry]
+                          = None) -> None:
+    """Master-side: ``n`` shards whose primary died were failed over
+    onto in-sync replica copies (routing-table promotion +
+    primary-term bump)."""
+    reg = registry or DEFAULT
+    reg.counter("es_shard_failovers_total",
+                help="primaries promoted onto in-sync replicas after "
+                     "node death").inc(n)
+
+
+def record_recovery_bytes(kind: str, n: int,
+                          registry: Optional[TelemetryRegistry]
+                          = None) -> None:
+    """Bytes shipped for one recovery transfer leg: ``kind="plane"``
+    for serialized serving-plane bundles (warm handoff),
+    ``kind="segment"`` for translog/segment op replay."""
+    reg = registry or DEFAULT
+    reg.counter("es_recovery_bytes_total", {"kind": kind},
+                help="recovery bytes shipped per transfer kind").inc(n)
+
+
+def record_plane_handoff_ms(ms: float,
+                            registry: Optional[TelemetryRegistry]
+                            = None) -> None:
+    """One completed warm plane handoff (chunked transfer + import +
+    generation swap) took ``ms`` end to end on the receiving node."""
+    reg = registry or DEFAULT
+    reg.histogram("es_plane_handoff_ms",
+                  help="warm plane handoff wall ms (transfer + import) "
+                       "on the receiving node").observe(float(ms))
 
 
 #: per-thread flag: did the LAST instrumented-step call on this thread

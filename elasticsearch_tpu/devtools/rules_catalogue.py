@@ -43,6 +43,19 @@ CONDITIONAL = {
     # registered only on cluster fronts (ARS EWMAs need peers)
     "es_adaptive_selection_response_seconds":
         "cluster fronts only (adaptive replica selection)",
+    # cluster failover/recovery families: written by the multi-node
+    # search fan-out, the master's failover update, and the
+    # recovery:plane_* warm-handoff transfer — none of which exist in
+    # the single-process lint workload (tests/test_chaos_failover.py
+    # and tests/test_plane_handoff.py exercise them on real clusters)
+    "es_search_retries_total":
+        "cluster coordinators only (search copy failover)",
+    "es_shard_failovers_total":
+        "cluster masters only (dead-node primary promotion)",
+    "es_recovery_bytes_total":
+        "cluster recovery only (plane handoff / translog replay)",
+    "es_plane_handoff_ms":
+        "cluster recovery only (warm plane handoff import)",
 }
 
 _DOC_NAME_RE = re.compile(r"`(es_[a-z0-9_]+)`")

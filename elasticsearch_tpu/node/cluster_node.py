@@ -43,6 +43,7 @@ from ..cluster.coordination import Coordinator, NotLeaderError
 from ..cluster.state import ClusterState
 from ..common.datacodec import dumps_b64 as _data64
 from ..common.datacodec import loads_b64 as _undata64
+from ..common.retry import TIMEOUTS, backoff_delays
 from ..common.errors import ElasticsearchError, IndexNotFoundError
 from ..index.engine import Engine
 from ..index.mapping import MapperService
@@ -72,7 +73,10 @@ class RpcReplicaChannel:
         self.shard_id = shard_id
         self.allocation_id = allocation_id
 
-    def _call(self, action: str, payload: dict, timeout: float = 3.0):
+    def _call(self, action: str, payload: dict,
+              timeout: Optional[float] = None):
+        if timeout is None:
+            timeout = TIMEOUTS.data
         payload = dict(payload, index=self.index_name, shard=self.shard_id)
         try:
             return self.node.rpc(self.target_node, action, payload,
@@ -159,9 +163,25 @@ class ClusterNode:
         # snapshots, safe off the single writer
         self._read_pool = ThreadPoolExecutor(
             max_workers=2, thread_name_prefix=f"{node_id}-read")
+        # recovery lane: warm-handoff transfer/import + donor-side
+        # bundle serialization are seconds-long — on the read lane they
+        # would starve live search:shards RPCs through exactly the
+        # recovery window serving must survive. Two workers so a pull
+        # and a donor-side manifest/chunk handler can overlap.
+        self._recovery_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix=f"{node_id}-recovery")
         #: allocation ids with a recovery task (incl. retry chain) in
         #: flight — state applications must not resubmit them
         self._recovering: set = set()
+        #: warm plane handoff (recovery:plane_* RPCs): prepared exports
+        #: by transfer id (chunked, resumable) + in-flight pulls, both
+        #: under one lock; ES_TPU_PLANE_HANDOFF=0 disables (the chaos
+        #: bench's repack baseline)
+        self.plane_handoff_enabled = os.environ.get(
+            "ES_TPU_PLANE_HANDOFF", "1").lower() not in ("0", "false")
+        self._plane_exports: Dict[str, dict] = {}
+        self._handoff_inflight: set = set()
+        self._plane_export_lock = threading.Lock()
         self._meta_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"{node_id}-meta")
         # full REST stack (node/cluster_rest.py): local IndicesService +
@@ -208,6 +228,7 @@ class ClusterNode:
         self._replica_pool.shutdown(wait=True, cancel_futures=True)
         self._meta_pool.shutdown(wait=True, cancel_futures=True)
         self._read_pool.shutdown(wait=True, cancel_futures=True)
+        self._recovery_pool.shutdown(wait=False, cancel_futures=True)
         if self._http_pool is not None:
             self._http_pool.shutdown(wait=False, cancel_futures=True)
         closed = set()
@@ -263,7 +284,8 @@ class ClusterNode:
         self.node_loop.call(self.http.start())
 
     def rpc_or_direct(self, dst: str, action: str, raw_fn, payload,
-                      timeout: float = 2.0, readonly: bool = False):
+                      timeout: Optional[float] = None,
+                      readonly: bool = False):
         """RPC — except self-calls that must not queue behind the data
         worker:
 
@@ -284,8 +306,13 @@ class ClusterNode:
             return raw_fn(self.node_id, payload)
         return self.rpc(dst, action, payload, timeout=timeout)
 
-    def rpc(self, dst: str, action: str, payload, timeout: float = 2.0):
-        """Synchronous RPC from any thread (test/client surface)."""
+    def rpc(self, dst: str, action: str, payload,
+            timeout: Optional[float] = None):
+        """Synchronous RPC from any thread (test/client surface).
+        ``timeout=None`` resolves to the settings-driven ``fast`` lane
+        (``cluster.rpc.timeout.fast``)."""
+        if timeout is None:
+            timeout = TIMEOUTS.fast
         done = threading.Event()
         box: Dict[str, Any] = {}
 
@@ -344,7 +371,7 @@ class ClusterNode:
                 continue
             try:
                 return self.rpc(leader, action, payload,
-                                timeout=min(2.0, timeout))
+                                timeout=min(TIMEOUTS.fast, timeout))
             except Exception as e:      # noqa: BLE001 — retry via new leader
                 last = e
                 time.sleep(0.05)
@@ -443,6 +470,13 @@ class ClusterNode:
                         group.engine.refresh()
                         self.primaries[key] = group
                         self._sync_replica_channels(key, entry, term)
+                        # promotion restores warm serving generations
+                        # too: pull plane bundles from any live copy
+                        # holder (off the data worker — recovery-class
+                        # work must not stall doc ops)
+                        if self.plane_handoff_enabled:
+                            self._recovery_pool.submit(
+                                self._request_plane_handoff, name)
                     else:
                         engine.primary_term = max(engine.primary_term, term)
                         group = PrimaryShardGroup(
@@ -459,6 +493,17 @@ class ClusterNode:
                         engine.primary_term = max(engine.primary_term, term)
                         self.replicas[key] = ReplicaShard(
                             f"{self.node_id}/{name}/{sid}", engine)
+                        # target-side warm-handoff trigger: this node
+                        # just became a copy holder — pull the
+                        # primary's packed planes (the donor's offer
+                        # may have raced ahead of our metadata replay;
+                        # the tracked pull dedupes)
+                        if self.plane_handoff_enabled and \
+                                entry.get("primary") and \
+                                entry["primary"] != self.node_id:
+                            self._recovery_pool.submit(
+                                self._pull_plane_bundles_tracked,
+                                name, entry["primary"])
                 else:
                     # copy moved away from this node: drop the wrappers
                     # (the local service keeps its engine; reads route
@@ -511,15 +556,22 @@ class ClusterNode:
                          attempts: int = 20) -> None:
         try:
             remote_ckpt = ch._call("replica:checkpoint", {},
-                                   timeout=1.0)["checkpoint"]
+                                   timeout=TIMEOUTS.fast)["checkpoint"]
             group.tracker.init_tracking(aid)
             group.tracker.add_lease(f"peer_recovery/{aid}",
                                     max(remote_ckpt + 1, 0),
                                     source="peer recovery")
             ops = group.engine.translog.read_ops(from_seq_no=remote_ckpt + 1)
             ckpt = remote_ckpt
+            import json as _json
+            from ..common import telemetry as _tm
             for op in ops:
                 ckpt = ch.translog_op(group.engine.primary_term, op)
+                try:
+                    _tm.record_recovery_bytes("segment", len(_json.dumps(
+                        op.to_dict(), default=str)))
+                except Exception:   # noqa: BLE001 — accounting only
+                    pass
             group.replicas[aid] = ch
             group.tracker.mark_in_sync(aid, ckpt)
             group.tracker.remove_lease(f"peer_recovery/{aid}")
@@ -527,7 +579,7 @@ class ClusterNode:
             # (finalize-refresh, like the reference's recovery finalize)
             try:
                 self.rpc(ch.target_node, "shard:refresh",
-                         {"index": ch.index_name}, timeout=2.0)
+                         {"index": ch.index_name}, timeout=TIMEOUTS.fast)
             except Exception:   # noqa: BLE001
                 pass
             # publish "shard started": until the master records the
@@ -536,6 +588,19 @@ class ClusterNode:
             # recovering replica is invisible to ARS)
             self._notify_shard_started(ch.index_name, ch.shard_id,
                                        ch.target_node)
+            # warm plane handoff: offer this node's packed serving
+            # planes to the freshly recovered copy — it pulls the
+            # bundles chunked and serves warm without re-packing
+            # (reference ``indices/recovery/`` chunked file transfer,
+            # but shipping plane tensors)
+            if self.plane_handoff_enabled:
+                try:
+                    self.rpc(ch.target_node, "recovery:plane_offer",
+                             {"index": ch.index_name,
+                              "donor": self.node_id},
+                             timeout=TIMEOUTS.fast)
+                except Exception:   # noqa: BLE001 — the copy serves
+                    pass            # cold; first search repacks
             self._recovering.discard(aid)
         except Exception:   # noqa: BLE001 — replica node not ready: retry
             group.tracker.remove_lease(f"peer_recovery/{aid}")
@@ -546,6 +611,182 @@ class ClusterNode:
                         attempts - 1))
             else:
                 self._recovering.discard(aid)
+
+    # ------------------------------------------------------------------
+    # warm plane handoff (recovery:plane_* — chunked, resumable)
+    # ------------------------------------------------------------------
+
+    #: serialized-bundle chunk size per recovery frame (b64 chars; the
+    #: transport's MAX_FRAME is 64 MiB)
+    PLANE_CHUNK_BYTES = 4 << 20
+    #: seconds a prepared export stays fetchable (the resume window)
+    PLANE_EXPORT_TTL = 120.0
+
+    def _h_recovery_plane_manifest(self, src, payload):
+        """Donor side: serialize every live serving generation of the
+        index into chunked, resumable transfers. Chunks are prepared
+        ONCE and fetched by id — a retried chunk re-reads the prepared
+        export instead of re-serializing the plane."""
+        import uuid
+        name = payload["index"]
+        svc = self.rest.indices.indices.get(name)
+        if svc is None or not self.plane_handoff_enabled:
+            return {"bundles": []}
+        from ..common.datacodec import dumps_b64
+        now = time.monotonic()
+        with self._plane_export_lock:
+            for xid in [x for x, e in self._plane_exports.items()
+                        if now - e["ts"] > self.PLANE_EXPORT_TTL]:
+                self._plane_exports.pop(xid)
+        entries = []
+        for bundle in svc.plane_cache.export_bundles():
+            blob = dumps_b64(bundle)
+            n = self.PLANE_CHUNK_BYTES
+            chunks = [blob[i: i + n] for i in range(0, len(blob), n)]
+            xid = uuid.uuid4().hex
+            with self._plane_export_lock:
+                self._plane_exports[xid] = {"chunks": chunks, "ts": now}
+            entries.append({"xfer_id": xid, "kind": bundle["kind"],
+                            "field": bundle["field"],
+                            "n_chunks": len(chunks),
+                            "nbytes": len(blob)})
+        return {"bundles": entries}
+
+    def _h_recovery_plane_chunk(self, src, payload):
+        now = time.monotonic()
+        with self._plane_export_lock:
+            # sweep stale exports on every chunk fetch too: on a donor
+            # that never receives another manifest request, the TTL
+            # sweep there would never run and abandoned transfers
+            # (puller died mid-pull) would pin serialized plane copies
+            # on the heap forever
+            for xid in [x for x, e in self._plane_exports.items()
+                        if now - e["ts"] > self.PLANE_EXPORT_TTL]:
+                self._plane_exports.pop(xid)
+            e = self._plane_exports.get(payload["xfer_id"])
+            if e is None:
+                raise ElasticsearchError(
+                    f"plane export [{payload['xfer_id']}] expired")
+            e["ts"] = now
+            return {"data": e["chunks"][int(payload["chunk"])]}
+
+    def _h_recovery_plane_done(self, src, payload):
+        """Puller-side completion ack: release the prepared export NOW
+        instead of waiting for the TTL sweep — a completed handoff must
+        not pin a serialized plane copy on the donor heap."""
+        with self._plane_export_lock:
+            self._plane_exports.pop(payload.get("xfer_id"), None)
+        return {"ok": True}
+
+    def _h_recovery_plane_offer(self, src, payload):
+        """Target side: a donor finished recovering one of our copies
+        and offers its warm planes — pull + import off this handler so
+        the offer RPC acks immediately."""
+        name, donor = payload["index"], payload.get("donor", src)
+        if not self.plane_handoff_enabled:
+            return {"accepted": False}
+        self._recovery_pool.submit(self._pull_plane_bundles_tracked,
+                                   name, donor)
+        return {"accepted": True}
+
+    def _pull_plane_bundles_tracked(self, name: str, donor: str
+                                    ) -> Optional[int]:
+        """Deduplicated pull: one in-flight transfer per (index, donor)
+        — per-shard recovery offers and the replica-wiring trigger
+        would otherwise race duplicate pulls of the same bundles.
+        Returns bundles imported, or None when another pull for this
+        (index, donor) was already in flight."""
+        key = (name, donor)
+        with self._plane_export_lock:
+            if key in self._handoff_inflight:
+                return None
+            self._handoff_inflight.add(key)
+        try:
+            return self._pull_plane_bundles(name, donor)
+        except Exception:   # noqa: BLE001 — cold serving still works
+            return 0
+        finally:
+            with self._plane_export_lock:
+                self._handoff_inflight.discard(key)
+
+    def _pull_plane_bundles(self, name: str, donor: str,
+                            import_deadline: float = 30.0) -> int:
+        """Fetch + import every plane bundle the donor offers for
+        ``name``. Chunk fetches retry with jittered backoff and RESUME:
+        chunks already received are never re-shipped. The IMPORT
+        retries against the local copies up to ``import_deadline``
+        seconds: the offer lands as soon as the donor finalizes one
+        shard's recovery, which can be before this node's metadata
+        replay has even recreated the index service (a rejoining node
+        replays the op log while recovery is already running). Returns
+        bundles imported (0 → every bundle fell back to the repack
+        path)."""
+        from ..common import telemetry as _tm
+        from ..common.datacodec import loads_b64
+        from ..common.retry import retry_with_backoff
+        t0 = time.perf_counter()
+        man = self.rpc(donor, "recovery:plane_manifest", {"index": name},
+                       timeout=TIMEOUTS.meta)
+        imported = 0
+        deadline = time.monotonic() + import_deadline
+        for entry in man.get("bundles", ()):
+            parts: List[Optional[str]] = [None] * int(entry["n_chunks"])
+            for i in range(len(parts)):
+                parts[i] = retry_with_backoff(
+                    lambda i=i: self.rpc(
+                        donor, "recovery:plane_chunk",
+                        {"xfer_id": entry["xfer_id"], "chunk": i},
+                        timeout=TIMEOUTS.meta)["data"])
+                _tm.record_recovery_bytes("plane", len(parts[i]))
+            blob = "".join(parts)
+            # release the donor's prepared export immediately (fire and
+            # forget; the TTL sweep is the backstop for a lost ack)
+            try:
+                self.rpc(donor, "recovery:plane_done",
+                         {"xfer_id": entry["xfer_id"]},
+                         timeout=TIMEOUTS.fast)
+            except Exception:   # noqa: BLE001
+                pass
+            bundle = loads_b64(blob)
+            while not self.stopped:
+                if self._import_plane_bundle(name, bundle):
+                    imported += 1
+                    break
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(0.25)
+        if imported:
+            _tm.record_plane_handoff_ms(
+                (time.perf_counter() - t0) * 1e3)
+        return imported
+
+    def _import_plane_bundle(self, name: str, bundle: dict) -> bool:
+        svc = self.rest.indices.indices.get(name)
+        if svc is None:
+            return False
+        segments = []
+        for eng in svc.shards:
+            segments.extend(eng.searchable_segments())
+        return svc.plane_cache.import_bundle(bundle, segments, svc.mapper)
+
+    def _request_plane_handoff(self, name: str) -> None:
+        """Promotion path: pull warm plane bundles for ``name`` from any
+        LIVE peer holding a copy — the deposed primary is usually dead
+        (that is why we were promoted), and trying it anyway would burn
+        a full manifest timeout before reaching a live donor."""
+        st = self.applied_state
+        table = (st.data.get("routing", {}) if st else {}).get(name) or {}
+        peers = {e.get("primary") for e in table.values()} | {
+            r for e in table.values() for r in e.get("replicas", ())}
+        peers.discard(self.node_id)
+        peers.discard(None)
+        live = self.live_nodes()
+        for donor in sorted(peers & live):
+            got = self._pull_plane_bundles_tracked(name, donor)
+            if got is None or got:
+                # imported, or another pull for this donor is already
+                # in flight — either way this trigger is done
+                return
 
     # ------------------------------------------------------------------
     # node failure watch (master only) — FollowersChecker consequence
@@ -568,6 +809,8 @@ class ClusterNode:
             self._live_nodes = None
             self._schedule_node_watch()
             return
+        self._plane_storms = getattr(self, "_plane_storms", {})
+        self._plane_storms[self.node_id] = self._plane_storm_count()
         state = self.coordinator.applied
         routing = state.data.get("routing", {})
         referenced: set = set()
@@ -587,6 +830,8 @@ class ClusterNode:
         def done():
             pending["n"] -= 1
             if pending["n"] == 0:
+                prev_alive = getattr(self, "_prev_alive", None)
+                self._prev_alive = set(alive)
                 self._live_nodes = set(alive)
                 # flap guard: a node must miss TWO consecutive rounds
                 # before failover strips its shards — one lost ping during
@@ -599,6 +844,14 @@ class ClusterNode:
                                      self._dead_streaks.items() if c >= 2}
                 if dead:
                     self._fail_over_dead_nodes(dead)
+                # node (re)join: reset allocation retry counters — a
+                # replica that exhausted MAX_RETRIES while NO eligible
+                # node existed (the whole copy set was dead) must be
+                # re-placed now that a holder is back, without a manual
+                # reroute (the reference re-evaluates unassigned shards
+                # on every node join)
+                if prev_alive is not None and alive - prev_alive:
+                    self._data_pool.submit(self._clear_failed_attempts)
                 # allocation runs on the data worker (it issues blocking
                 # in-sync RPCs for staged relocations); at most ONE round
                 # queued — ticks fire every 0.5s but a round with probes
@@ -612,6 +865,14 @@ class ClusterNode:
             alive.add(n)
             if isinstance(r, dict) and "disk_used_frac" in r:
                 self._disk_used[n] = float(r["disk_used_frac"])
+            if isinstance(r, dict) and "plane_storms" in r:
+                # plane_serving health signature piggybacked the same
+                # way disk usage is — the allocation round's
+                # ServingStormDecider consumes it
+                storms = getattr(self, "_plane_storms", None)
+                if storms is None:
+                    storms = self._plane_storms = {}
+                storms[n] = int(r["plane_storms"])
             done()
 
         for n in sorted(targets):
@@ -624,6 +885,21 @@ class ClusterNode:
     # allocation round (master, data worker) — BalancedShardsAllocator +
     # deciders + staged relocations (cluster/allocation.py)
     # ------------------------------------------------------------------
+
+    def _plane_storm_count(self) -> int:
+        """Sync non-cold serving-plane rebuilds on THIS node (the
+        plane_serving indicator's storm signature, from the same
+        cache-owned counters) — piggybacked on ping responses so the
+        master's allocation round can route copies away from storming
+        nodes. Cheap: one counter-dict walk per cache."""
+        total = 0
+        try:
+            for svc in list(self.rest.indices.indices.values()):
+                rb = svc.plane_cache.rebuild_stats()
+                total += max(rb.get("sync", 0) - rb.get("cold", 0), 0)
+        except Exception:   # noqa: BLE001 — liveness never fails on
+            pass            # a stats race
+        return total
 
     def live_nodes(self) -> set:
         """Nodes believed alive. Before the first watch round completes
@@ -686,7 +962,7 @@ class ClusterNode:
                     elif owner is not None:
                         r = self.rpc(owner, "shard:insync",
                                      {"index": index, "shard": int(sid_s),
-                                      "aid": aid}, timeout=2.0)
+                                      "aid": aid}, timeout=TIMEOUTS.fast)
                         ok = bool(r.get("in_sync"))
                 except Exception:   # noqa: BLE001 — probe later
                     ok = False
@@ -696,7 +972,8 @@ class ClusterNode:
         ctx = AllocationContext(
             live, routing, st.metadata["indices"],
             node_attrs=self.node_attrs, disk_used=dict(self._disk_used),
-            moves_in_flight=in_flight - len(completed))
+            moves_in_flight=in_flight - len(completed),
+            plane_storms=dict(getattr(self, "_plane_storms", {})))
         allocator = BalancedAllocator()
         plan = [] if completed else allocator.plan_rebalance(ctx)
         # replica deficits only: red shards (no primary) wait for a copy
@@ -743,7 +1020,8 @@ class ClusterNode:
                         if x in entry.get("replicas", ())]
             actx = AllocationContext(
                 live, r, meta, node_attrs=self.node_attrs,
-                disk_used=dict(self._disk_used))
+                disk_used=dict(self._disk_used),
+                plane_storms=dict(getattr(self, "_plane_storms", {})))
             allocator.allocate_unassigned(actx)
             for mv in plan:
                 entry = r.get(mv["index"], {}).get(str(mv["sid"]))
@@ -765,6 +1043,31 @@ class ClusterNode:
         except Exception:   # noqa: BLE001 — next tick retries
             pass
 
+    def _clear_failed_attempts(self) -> None:
+        """Master-side, on node join: clear per-shard allocation retry
+        counters so the next allocation round re-places copies that ran
+        out of retries while no eligible node existed."""
+        if self.stopped or self.coordinator.mode != "LEADER":
+            return
+        st = self.applied_state
+        if st is None or not any(
+                entry.get("failed_attempts")
+                for table in st.data.get("routing", {}).values()
+                for entry in table.values()):
+            return
+
+        def update(state: ClusterState) -> ClusterState:
+            new = state.updated()
+            for table in new.data.get("routing", {}).values():
+                for entry in table.values():
+                    entry.pop("failed_attempts", None)
+            return new
+
+        try:
+            self._submit_and_wait(update, timeout=5.0)
+        except Exception:   # noqa: BLE001 — the next join/reroute retries
+            pass
+
     def _fail_over_dead_nodes(self, dead: set) -> None:
         """Promote in-sync replicas of every shard primaried on a dead
         node and drop dead replicas from routing (RoutingNodes.failShard
@@ -776,6 +1079,13 @@ class ClusterNode:
             for table in routing.values() for entry in table.values())
         if not affected:
             return
+        promotions = sum(
+            1 for table in routing.values() for entry in table.values()
+            if entry["primary"] in dead and
+            any(r not in dead for r in entry["replicas"]))
+        if promotions:
+            from ..common import telemetry as _tm
+            _tm.record_shard_failover(promotions)
 
         def update(st: ClusterState) -> ClusterState:
             new = st.updated()
@@ -826,7 +1136,7 @@ class ClusterNode:
                    "source": source, "routing": routing}
         # always through the transport (loopback for self): the data
         # worker serializes every engine touch
-        return self.rpc(owner, "doc:index", payload, timeout=3.0)
+        return self.rpc(owner, "doc:index", payload, timeout=TIMEOUTS.data)
 
     def get_doc(self, index: str, doc_id: str,
                 routing: Optional[str] = None) -> dict:
@@ -842,12 +1152,13 @@ class ClusterNode:
         sid = shard_for(doc_id, routing, meta["num_shards"])
         owner = table[str(sid)]["primary"]
         payload = {"index": index, "shard": sid, "id": doc_id}
-        return self.rpc(owner, "doc:delete", payload, timeout=3.0)
+        return self.rpc(owner, "doc:delete", payload, timeout=TIMEOUTS.data)
 
     def refresh(self, index: str) -> None:
         for n in self.node_ids:
             try:
-                self.rpc(n, "shard:refresh", {"index": index}, timeout=2.0)
+                self.rpc(n, "shard:refresh", {"index": index},
+                         timeout=TIMEOUTS.fast)
             except Exception:   # noqa: BLE001 — dead nodes skip refresh
                 pass
 
@@ -891,6 +1202,92 @@ class ClusterNode:
                     "rank": f"{rec['ewma_s'] * 1e3:.1f}"}
                 for n, rec in getattr(self, "_ars_stats", {}).items()}
 
+    def _group_shards_by_copy(self, table: dict
+                              ) -> Tuple[Dict[str, List[int]],
+                                         Dict[int, List[str]]]:
+        """(by_node, copies_of) for a fan-out over ``table`` — adaptive
+        replica selection: each shard's copy set (primary + in-sync
+        replicas) ranks by the EWMA response time this coordinator has
+        observed per node (reference:
+        ``cluster/routing/OperationRouting.java:42`` +
+        ``node/ResponseCollectorService.java``); ties prefer the node
+        with the fewest shards already assigned in this request
+        (spreads load), then the primary. The FULL ranked copy list
+        per shard is retained so :meth:`_fanout_with_failover` can
+        re-route to the next copy when a node dies mid-request."""
+        by_node: Dict[str, List[int]] = {}
+        copies_of: Dict[int, List[str]] = {}
+        live = self.live_nodes()
+        for sid_s, entry in table.items():
+            # only STARTED (recovery-complete) replicas serve reads: a
+            # copy still replaying the translog would return stale or
+            # empty results (the 230_composite index-sorted visibility
+            # failure was exactly this)
+            in_sync = set(entry.get("in_sync") or ())
+            cands = [entry["primary"]] + [
+                r for r in entry.get("replicas", ()) if r in in_sync]
+            seen: set = set()
+            cands = [c for c in cands
+                     if not (c in seen or seen.add(c))]
+            # a dead primary must not head the list while a live in-sync
+            # copy exists — liveness outranks the EWMA (a freshly-dead
+            # node's EWMA still looks fast)
+            copies = [c for c in cands if c in live] or cands
+            best = min(copies, key=lambda n: (
+                self._ars_rank(n), len(by_node.get(n, ())),
+                0 if n == entry["primary"] else 1))
+            by_node.setdefault(best, []).append(int(sid_s))
+            copies_of[int(sid_s)] = sorted(copies, key=lambda n: (
+                self._ars_rank(n), 0 if n == entry["primary"] else 1, n))
+        return by_node, copies_of
+
+    def _fanout_with_failover(self, groups: List[tuple],
+                              copies_of: Dict[int, List[str]],
+                              send, on_exhausted) -> List[tuple]:
+        """The ONE copy-failover wave loop every shard fan-out shares
+        (search hits, DFS stats, agg partials). ``groups``: [(node,
+        shards, ctx)]; ``send(node, shards, ctx)`` performs the RPC
+        (raises on failure). A failed group re-routes each of its
+        shards to the next-ranked in-sync copy — the fallback is asked
+        ONLY for the shards it can serve — with one jittered pause per
+        retry wave (not per group: the wave retries into SURVIVING
+        nodes, and hammering them the same instant every coordinator
+        does is the herd the jitter exists to break up).
+        ``on_exhausted(sid, node, exc)`` fires per shard whose every
+        copy failed. Returns [(ctx, result)] for the groups that
+        answered."""
+        from ..common import telemetry as _tm
+        results: List[tuple] = []
+        queue = [(node, shards, ctx, frozenset())
+                 for node, shards, ctx in groups]
+        while queue:
+            next_wave: List[tuple] = []
+            for node_id, shards, ctx, tried in queue:
+                try:
+                    r = send(node_id, shards, ctx)
+                except Exception as e:   # noqa: BLE001 — copy failover
+                    _tm.record_search_retry("retried")
+                    tried2 = tried | {node_id}
+                    regroup: Dict[str, List[int]] = {}
+                    for sid in shards:
+                        nxt = next((c for c in copies_of.get(sid, ())
+                                    if c not in tried2), None)
+                        if nxt is None:
+                            _tm.record_search_retry("exhausted")
+                            on_exhausted(sid, node_id, e)
+                        else:
+                            regroup.setdefault(nxt, []).append(sid)
+                    for n2 in sorted(regroup):
+                        next_wave.append((n2, regroup[n2], ctx, tried2))
+                    continue
+                if tried:
+                    _tm.record_search_retry("recovered")
+                results.append((ctx, r))
+            queue = next_wave
+            if queue:
+                time.sleep(next(iter(backoff_delays(1))))
+        return results
+
     def search(self, index: str, body: Optional[dict] = None) -> dict:
         body = body or {}
         if "aggregations" in body and "aggs" not in body:
@@ -901,28 +1298,7 @@ class ClusterNode:
         from_ = int(body.get("from", 0))
         shard_body = dict(body, size=size + from_)
         shard_body["from"] = 0
-        # group shards by the node serving them — adaptive replica
-        # selection: each shard's copy set (primary + in-sync replicas)
-        # ranks by the EWMA response time this coordinator has observed
-        # per node (reference: ``cluster/routing/OperationRouting.java:42``
-        # + ``node/ResponseCollectorService.java``); ties prefer the
-        # node with the fewest shards already assigned in this request
-        # (spreads load), then the primary
-        by_node: Dict[str, List[int]] = {}
-        live = self.live_nodes()
-        for sid_s, entry in table.items():
-            # only STARTED (recovery-complete) replicas serve reads: a
-            # copy still replaying the translog would return stale or
-            # empty results (the 230_composite index-sorted visibility
-            # failure was exactly this)
-            in_sync = set(entry.get("in_sync") or ())
-            copies = [entry["primary"]] + [
-                r for r in entry.get("replicas", ())
-                if r in live and r in in_sync]
-            best = min(copies, key=lambda n: (
-                self._ars_rank(n), len(by_node.get(n, ())),
-                0 if n == entry["primary"] else 1))
-            by_node.setdefault(best, []).append(int(sid_s))
+        by_node, copies_of = self._group_shards_by_copy(table)
         node_order = sorted(by_node)
         # -- DFS stats round: cluster-wide term statistics. A node that
         # cannot answer in time degrades to partial stats (slightly-off
@@ -934,25 +1310,27 @@ class ClusterNode:
         from ..common.tracing import wire_headers
         trace_hdrs = wire_headers()
         stats = {"total_docs": 0, "fields": {}, "terms": {}}
-        for node_id in node_order:
-            s = None
-            for attempt in (15.0, 15.0):
-                try:
-                    s = self.rpc_or_direct(
-                        node_id, "search:stats", self._h_search_stats, {
-                            "index": index, "shards": by_node[node_id],
-                            "body": {"query": body.get("query")},
-                            "_trace": trace_hdrs},
-                        timeout=attempt, readonly=True)
-                    break
-                except Exception:   # noqa: BLE001 — retry once, then skip
-                    continue
-            if s is None:
-                import sys
-                print(f"[{self.node_id}] search:stats to [{node_id}] "
-                      f"failed twice; degrading to partial stats",
-                      file=sys.stderr)
-                continue
+
+        def send_stats(node_id, shards, _ctx):
+            return self.rpc_or_direct(
+                node_id, "search:stats", self._h_search_stats, {
+                    "index": index, "shards": shards,
+                    "body": {"query": body.get("query")},
+                    "_trace": trace_hdrs},
+                timeout=TIMEOUTS.search, readonly=True)
+
+        def stats_exhausted(sid, node_id, _e):
+            # a shard whose every copy failed degrades to partial stats
+            # (slightly-off idf), matching the reference's DFS-phase
+            # tolerance — the hits phase reports the real failure
+            import sys
+            print(f"[{self.node_id}] search:stats for shard [{sid}] "
+                  f"failed on every copy (last: [{node_id}]); degrading "
+                  f"to partial stats", file=sys.stderr)
+
+        for _ctx, s in self._fanout_with_failover(
+                [(n, by_node[n], None) for n in node_order], copies_of,
+                send_stats, stats_exhausted):
             stats["total_docs"] += s["total_docs"]
             for f, (sdl, dc) in s["fields"].items():
                 cur = stats["fields"].setdefault(f, [0.0, 0])
@@ -968,7 +1346,17 @@ class ClusterNode:
         use_field_sort = bool(clauses) and clauses[0]["field"] != "_score"
         n_user = len(clauses) if clauses else 0
         search_after = body.get("search_after")
-        results = []
+        shard_failures: List[dict] = []
+        # groups carry (original node ordinal, node-local body): the
+        # ordinal survives failover so cursor tiebreaks keep encoding
+        # the node_order position the NEXT request's
+        # ``_node_local_cursor`` translation decodes against — a
+        # results-list position would shift whenever a group re-routed
+        # mid-failure and corrupt cross-node pagination exactly in the
+        # window failover exists for. A shard whose every copy failed
+        # lands in the response's ES-shaped ``_shards.failures``
+        # instead of 500ing the request (ShardSearchFailure semantics).
+        groups = []
         for ni, node_id in enumerate(node_order):
             nb = shard_body
             if search_after is not None:
@@ -979,15 +1367,33 @@ class ClusterNode:
                     nb["search_after"] = cursor
                 else:
                     nb.pop("search_after", None)
-            payload = {"index": index, "shards": by_node[node_id],
+            groups.append((node_id, by_node[node_id], (ni, nb)))
+
+        def send_shards(node_id, shards, ctx):
+            _ni, nb = ctx
+            payload = {"index": index, "shards": shards,
                        "body": nb, "global_stats": stats,
                        "want_agg_partials": bool(body.get("aggs")),
                        "_trace": trace_hdrs}
             t_rpc = time.monotonic()
-            results.append(self.rpc_or_direct(
-                node_id, "search:shards", self._h_search_shards, payload,
-                timeout=15.0, readonly=True))
-            self._ars_observe(node_id, time.monotonic() - t_rpc)
+            try:
+                return self.rpc_or_direct(
+                    node_id, "search:shards", self._h_search_shards,
+                    payload, timeout=TIMEOUTS.search, readonly=True)
+            finally:
+                self._ars_observe(node_id, time.monotonic() - t_rpc)
+
+        def shards_exhausted(sid, node_id, e):
+            shard_failures.append({
+                "shard": int(sid), "node": node_id,
+                "reason": {"type": type(e).__name__, "reason": str(e)},
+                "status": 503})
+
+        tagged = self._fanout_with_failover(groups, copies_of,
+                                            send_shards,
+                                            shards_exhausted)
+        ordinals = [ni for (ni, _nb), _r in tagged]
+        results = [r for _ctx, r in tagged]
         # coordinator-side resource roll-up: every data node's shard-
         # phase ledger folds into THIS request's task, so a cluster
         # search reports one cpu/device/docs total across the fan-out
@@ -998,10 +1404,12 @@ class ClusterNode:
                 rd = r.get("_resources") if isinstance(r, dict) else None
                 if rd:
                     task_res.merge_doc(rd)
-        # merge (same comparator as the single-node coordinator), then lift
-        # tiebreaks into the node-global cursor space
+        # merge (same comparator as the single-node coordinator), then
+        # lift tiebreaks into the node-global cursor space — keyed by
+        # each result's ORIGINAL group ordinal (failover-stable), never
+        # its results-list position
         merged = []
-        for ni, r in enumerate(results):
+        for ni, r in zip(ordinals, results):
             for h in r["hits"]:
                 if use_field_sort:
                     key = (merge_sort_key(clauses, h["sort"] or []),
@@ -1046,8 +1454,8 @@ class ClusterNode:
             aggs_out = run_aggregations_multi(aggs, [],
                                               extra_partials=merged)
         out = {"total": total, "hits": hits}
-        all_failures = [f for r in results
-                        for f in (r.get("failures") or [])]
+        all_failures = shard_failures + [
+            f for r in results for f in (r.get("failures") or [])]
         if all_failures:
             def _has_partials(r):
                 try:
@@ -1055,9 +1463,12 @@ class ClusterNode:
                                .values())
                 except Exception:   # noqa: BLE001
                     return False
-            if all(not r.get("hits") for r in results) and \
-                    not any(_has_partials(r) for r in results):
-                # every data shard cluster-wide failed: raise the cause
+            if not results or (
+                    all(not r.get("hits") for r in results) and
+                    not any(_has_partials(r) for r in results)):
+                # every data shard cluster-wide failed (no surviving
+                # copy answered anything): raise the cause —
+                # SearchPhaseExecutionException carries its status
                 f0 = all_failures[0]["reason"]
                 err = ElasticsearchError(f0.get("reason", "shard failure"))
                 err.error_type = f0.get("type", "exception")
@@ -1160,7 +1571,8 @@ class ClusterNode:
             return on_worker(handler, self._read_pool)
 
         t.register(nid, "ping", lambda s, p: {
-            "ok": True, "disk_used_frac": _disk_used_frac(self.data_path)})
+            "ok": True, "disk_used_frac": _disk_used_frac(self.data_path),
+            "plane_storms": self._plane_storm_count()})
         t.register(nid, "shard:insync", on_worker(self._h_shard_insync))
         t.register(nid, "shard:started", on_meta(self._h_shard_started))
         t.register(nid, "alloc:reroute", on_worker(self._h_alloc_reroute))
@@ -1197,6 +1609,22 @@ class ClusterNode:
         t.register(nid, "snap:shard", on_worker(self._h_snap_shard))
         t.register(nid, "stats:shards", on_read(self.rest.h_stats_shards))
         t.register(nid, "search:canmatch", on_read(self._h_can_match))
+        # warm plane handoff: manifest/chunk on the donor, offer/done
+        # bookkeeping — all on the dedicated recovery lane (bundle
+        # serialization and chunked transfer are seconds-long and must
+        # never queue ahead of live search RPCs; the work itself reads
+        # immutable segment snapshots, never engine write state)
+        def on_recovery(handler):
+            return on_worker(handler, self._recovery_pool)
+
+        t.register(nid, "recovery:plane_manifest",
+                   on_recovery(self._h_recovery_plane_manifest))
+        t.register(nid, "recovery:plane_chunk",
+                   on_recovery(self._h_recovery_plane_chunk))
+        t.register(nid, "recovery:plane_offer",
+                   on_recovery(self._h_recovery_plane_offer))
+        t.register(nid, "recovery:plane_done",
+                   on_recovery(self._h_recovery_plane_done))
 
     def _h_snap_shard(self, src, payload):
         """Upload this node's primary copy of one shard into the shared
@@ -1553,7 +1981,7 @@ class ClusterNode:
                     self._h_shard_started(self.node_id, payload)
                 elif master is not None:
                     self.rpc(master, "shard:started", payload,
-                             timeout=5.0)
+                             timeout=TIMEOUTS.data)
             except Exception:   # noqa: BLE001 — reads stay on the
                 pass            # primary until a retry re-notifies
 

@@ -48,6 +48,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..common import errors as _errors
+from ..common.retry import TIMEOUTS, backoff_delays
 from ..index.engine import DeleteResult, GetResult, IndexResult
 from ..search.shard_search import ShardHit, ShardSearchResult
 from ..transport.tcp import RemoteTransportError
@@ -168,7 +169,8 @@ class RemoteShardProxy:
     def _call(self, action: str, payload: dict) -> dict:
         payload = dict(payload, index=self.index_name, shard=self.shard)
         try:
-            return self.node.rpc(self.owner, action, payload, timeout=5.0)
+            return self.node.rpc(self.owner, action, payload,
+                                 timeout=TIMEOUTS.data)
         except RemoteTransportError as e:
             raise _remote_error(e) from e
 
@@ -328,10 +330,19 @@ class ClusterHooks:
             q["query"] = body["query"]
         return node.search(index, q)["total"]
 
-    def agg_partials(self, index: str, body: dict):
+    def agg_partials(self, index: str, body: dict,
+                     failures_out: Optional[List[dict]] = None):
         """Aggregation partials for one cluster-routed index, collected on
         the owning nodes and shipped for ONE shared reduce (the cross-
-        index agg path). None → index is locally complete, collect here."""
+        index agg path). None → index is locally complete, collect here.
+
+        A dead owner no longer raises out of the whole cross-node agg
+        request (the old behavior: one unreachable node → 500): its
+        shards fail over to in-sync replica copies with jittered
+        backoff, and only shards whose EVERY copy is down land as
+        ES-shaped per-shard failures in ``failures_out`` (the caller
+        renders them under ``_shards.failures``) — the same
+        partial-result contract the search fan-out honors."""
         node = self.rest.node
         st = node.applied_state
         table = (st.data.get("routing", {}) if st else {}).get(index)
@@ -341,25 +352,40 @@ class ClusterHooks:
         if owners == {node.node_id}:
             return None
         from ..common.datacodec import loads_b64
-        by_node: Dict[str, List[int]] = {}
-        for sid_s, entry in table.items():
-            by_node.setdefault(entry["primary"], []).append(int(sid_s))
+        by_node, copies_of = node._group_shards_by_copy(table)
         shard_body = {"size": 0,
                       "aggs": body.get("aggs") or body.get("aggregations")}
         if body.get("query"):
             shard_body["query"] = body["query"]
+
+        def send(owner, sids, _ctx):
+            return node.rpc_or_direct(owner, "search:shards",
+                                      node._h_search_shards, {
+                                          "index": index,
+                                          "shards": sids,
+                                          "body": shard_body,
+                                          "want_agg_partials": True},
+                                      timeout=TIMEOUTS.search,
+                                      readonly=True)
+
+        def exhausted(sid, owner, e):
+            if failures_out is not None:
+                failures_out.append({
+                    "shard": int(sid), "node": owner,
+                    "reason": {"type": type(e).__name__,
+                               "reason": str(e)},
+                    "status": 503})
+
         partials: Dict[str, list] = {}
-        for owner in sorted(by_node):
-            r = node.rpc_or_direct(owner, "search:shards",
-                                   node._h_search_shards, {
-                                       "index": index,
-                                       "shards": by_node[owner],
-                                       "body": shard_body,
-                                       "want_agg_partials": True},
-                                   timeout=10.0, readonly=True)
+        for _ctx, r in node._fanout_with_failover(
+                [(owner, by_node[owner], None)
+                 for owner in sorted(by_node)],
+                copies_of, send, exhausted):
             got = loads_b64(r.get("agg_partials", ""))
             for name_, parts in got.items():
                 partials.setdefault(name_, []).extend(parts)
+            if failures_out is not None:
+                failures_out.extend(r.get("failures") or ())
         return partials
 
     def can_match(self, index: str, bounds) -> Optional[bool]:
@@ -377,7 +403,8 @@ class ClusterHooks:
             try:
                 r = node.rpc_or_direct(
                     owner, "search:canmatch", node._h_can_match,
-                    {"index": index, "bounds": bounds}, timeout=5.0,
+                    {"index": index, "bounds": bounds},
+                    timeout=TIMEOUTS.data,
                     readonly=True)
                 if r.get("can_match", True):
                     return True
@@ -403,7 +430,7 @@ class ClusterHooks:
         try:
             r = node.rpc(owner, "doc2:visible",
                          {"index": index, "shard": shard, "id": doc_id},
-                         timeout=5.0)
+                         timeout=TIMEOUTS.data)
             return bool(r["visible"])
         except RemoteTransportError as e:
             raise _remote_error(e) from e
@@ -448,7 +475,8 @@ class ClusterHooks:
                 continue
             try:
                 node.rpc(n, "shard:refresh",
-                         {"index": index, "shard": shard}, timeout=2.0)
+                         {"index": index, "shard": shard},
+                         timeout=TIMEOUTS.fast)
             except Exception:   # noqa: BLE001 — dead nodes skip
                 pass
         return True
@@ -651,7 +679,7 @@ class ClusterRestService:
             try:
                 r = self.node.rpc(target, "meta:history",
                                   {"from": lo, "to": hi},
-                                  timeout=min(2.0, budget))
+                                  timeout=min(TIMEOUTS.fast, budget))
                 for op in r.get("ops", []):
                     got.setdefault(op["seq"], op)
             except Exception:   # noqa: BLE001 — try the next peer
@@ -846,7 +874,7 @@ class ClusterRestService:
                     resp = self.h_meta_op(node.node_id, payload)
                 else:
                     resp = node.rpc(leader, "meta:op", payload,
-                                    timeout=10.0)
+                                    timeout=TIMEOUTS.meta)
             except Exception as e:   # noqa: BLE001 — catching-up master /
                 last = e              # leader change: retry until deadline
                 time.sleep(0.05)
@@ -1005,7 +1033,8 @@ class ClusterRestService:
                        "settings": settings}
             ctx = AllocationContext(
                 live, routing, meta, node_attrs=node.node_attrs,
-                disk_used=dict(getattr(node, "_disk_used", {})))
+                disk_used=dict(getattr(node, "_disk_used", {})),
+                plane_storms=dict(getattr(node, "_plane_storms", {})))
             allocator.allocate_index(n, shards, replicas, ctx)
         for n in list(meta):
             if n not in local:
@@ -1017,7 +1046,8 @@ class ClusterRestService:
         if meta:
             ctx = AllocationContext(
                 live, routing, meta, node_attrs=node.node_attrs,
-                disk_used=dict(getattr(node, "_disk_used", {})))
+                disk_used=dict(getattr(node, "_disk_used", {})),
+                plane_storms=dict(getattr(node, "_plane_storms", {})))
             allocator.allocate_unassigned(ctx)
 
     # ------------------------------------------------------------------
@@ -1248,7 +1278,7 @@ class ClusterRestService:
                     r = self.node.rpc(owner, "stats:shards",
                                       {"index": n, "shards": sids,
                                        "sections": sorted(sections or ())},
-                                      timeout=10.0)
+                                      timeout=TIMEOUTS.meta)
                 except Exception:   # noqa: BLE001 — a dead owner's shard
                     continue        # stats degrade to the local zeros
                 for sid_s, s in (r or {}).items():
@@ -1418,7 +1448,7 @@ class ClusterRestService:
                     r = self.node.rpc(peer, "stats:shards",
                                       {"index": n, "shards": sids,
                                        "sections": ["fielddata"]},
-                                      timeout=10.0)
+                                      timeout=TIMEOUTS.meta)
                 except Exception:   # noqa: BLE001 — dead peer: skip
                     continue
                 for _sid, s in (r or {}).items():
@@ -1819,7 +1849,7 @@ class ClusterRestService:
                 # (default 30s) — the RPC must outlive that wait
                 r = self.node.rpc(n, "rest:exec", {
                     "m": method, "p": path, "q": query, "b": _b64(body)},
-                    timeout=40.0 if is_by_id else 10.0)
+                    timeout=40.0 if is_by_id else TIMEOUTS.meta)
             except Exception:   # noqa: BLE001 — dead nodes skip
                 continue
             try:
@@ -1853,7 +1883,7 @@ class ClusterRestService:
             try:
                 self.node.rpc(n, "rest:exec", {
                     "m": method, "p": path, "q": query, "b": _b64(body)},
-                    timeout=10.0)
+                    timeout=TIMEOUTS.meta)
             except Exception:   # noqa: BLE001 — dead nodes skip
                 pass
         return self._local(method, path, query, body)
@@ -1976,7 +2006,7 @@ class ClusterRestService:
         def fetch_one(n):
             r = self.node.rpc(n, "rest:exec", {
                 "m": method, "p": path, "q": query, "b": _b64(body)},
-                timeout=5.0)
+                timeout=TIMEOUTS.data)
             if r["status"] == 200:
                 return n, json.loads(_unb64(r["out"]))
             return n, None
@@ -2143,7 +2173,8 @@ class ClusterRestService:
         ctx = AllocationContext(
             live, routing, st.metadata["indices"],
             node_attrs=node.node_attrs,
-            disk_used=dict(getattr(node, "_disk_used", {})))
+            disk_used=dict(getattr(node, "_disk_used", {})),
+            plane_storms=dict(getattr(node, "_plane_storms", {})))
         doc = explain(index, int(sid or 0), ctx, primary=primary,
                       force_unassigned=force_unassigned)
         if "include_disk_info=true" in (query or ""):

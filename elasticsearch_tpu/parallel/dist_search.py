@@ -2009,6 +2009,108 @@ class DistributedSearchPlane:
             total += len(bmx.shards) * nb1 * (bmx.block * 5 + 8)
         return total // max(s_dev, 1)
 
+    # -- warm-handoff packed state (the recovery artifact) -------------------
+
+    def export_packed(self) -> dict:
+        """Every post-pack tensor + invariant this plane computed, as a
+        host dict the data-only wire codec can ship: the sorted-merge
+        postings/impacts tables, the dense bf16 tier (shipped as exact
+        f32 — bf16→f32→bf16 round-trips bit-identically), the block-max
+        tier, the CPU host-CSR serving tier, and the per-shard lookup
+        state. :meth:`from_packed` reconstructs a serving-identical
+        plane WITHOUT re-running the pack (impacts, tier split,
+        impact-ordering lexsort, dense fill) — the packed plane IS the
+        recovery artifact (BM25S's eagerly-scored form)."""
+        out = dict(
+            field=self.field, k1=float(self.k1), b=float(self.b),
+            n_shards=int(self.n_shards), n_pad=int(self.n_pad),
+            p_pad=int(self.p_pad),
+            dense_threshold=int(self.dense_threshold),
+            n_docs_total=int(self.n_docs_total),
+            max_sparse_df=int(self.max_sparse_df),
+            L_cap=int(self.L_cap), n_dense=int(self.n_dense),
+            T_pad=int(self.T_pad),
+            dense_block=int(getattr(self, "dense_block", 0)),
+            docs=np.asarray(self.docs_dev),
+            impacts=np.asarray(self.impacts_dev),
+            dense=(np.asarray(self.dense_dev).astype(np.float32)
+                   if self.dense_dev is not None else None),
+            shards=[dict(term_ids=dict(sh["term_ids"]), df=sh["df"],
+                         sparse_offsets=sh["sparse_offsets"],
+                         sparse_df=sh["sparse_df"],
+                         dense_row_of=dict(sh["dense_row_of"]),
+                         doc_uids=(list(sh["doc_uids"])
+                                   if sh.get("doc_uids") is not None
+                                   else None))
+                    for sh in self.shards],
+            host_csr=self._host_csr, blockmax=None)
+        if self.blockmax is not None:
+            t = self.blockmax
+            out["blockmax"] = dict(block=int(t.block),
+                                   n_pad=int(t.n_pad),
+                                   n_blocks=int(t.n_blocks),
+                                   shards=t.shards)
+        return out
+
+    @classmethod
+    def from_packed(cls, mesh: Mesh, packed: dict
+                    ) -> "DistributedSearchPlane":
+        """Reconstruct a plane from :meth:`export_packed` state: only
+        the device uploads run — no pack work. Raises when the donor's
+        (padded) shard count does not divide THIS mesh's shard axis
+        (heterogeneous slices; the caller falls back to a local pack)."""
+        self = cls.__new__(cls)
+        self.mesh = mesh
+        self.field = str(packed["field"])
+        self.k1, self.b = float(packed["k1"]), float(packed["b"])
+        self.n_shards = int(packed["n_shards"])
+        if self.n_shards % mesh.shape[AXIS_SHARD]:
+            raise ValueError(
+                f"packed plane has {self.n_shards} shards; mesh shard "
+                f"axis {mesh.shape[AXIS_SHARD]} does not divide it")
+        self.n_pad = int(packed["n_pad"])
+        self.p_pad = int(packed["p_pad"])
+        self.dense_threshold = int(packed["dense_threshold"])
+        self.n_docs_total = int(packed["n_docs_total"])
+        self.max_sparse_df = int(packed["max_sparse_df"])
+        self.L_cap = int(packed["L_cap"])
+        self.n_dense = int(packed["n_dense"])
+        self.T_pad = int(packed["T_pad"])
+        self.n_dispatches = 0
+        self.shards = [dict(term_ids=sh["term_ids"], df=sh["df"],
+                            sparse_offsets=sh["sparse_offsets"],
+                            sparse_df=sh["sparse_df"],
+                            dense_row_of={int(k): int(v) for k, v in
+                                          sh["dense_row_of"].items()},
+                            doc_uids=sh.get("doc_uids"))
+                       for sh in packed["shards"]]
+        corpus_spec = NamedSharding(mesh, P(AXIS_SHARD, None))
+        self.docs_dev = jax.device_put(
+            np.asarray(packed["docs"], np.int32), corpus_spec)
+        self.impacts_dev = jax.device_put(
+            np.asarray(packed["impacts"], np.float32), corpus_spec)
+        self.dense_dev = None
+        if packed.get("dense") is not None and self.T_pad:
+            self.dense_block = int(packed["dense_block"])
+            self.dense_dev = jax.device_put(
+                np.asarray(packed["dense"]).astype(jnp.bfloat16),
+                NamedSharding(mesh, P(AXIS_SHARD, None, None, None)))
+        self.blockmax = None
+        bmx = packed.get("blockmax")
+        if bmx is not None:
+            t = BlockMaxTier(block=int(bmx["block"]))
+            t.n_pad = int(bmx["n_pad"])
+            t.n_blocks = int(bmx["n_blocks"])
+            t.shards = [dict(sh) for sh in bmx["shards"]]
+            self.blockmax = t
+        self._host_csr = None
+        if jax.devices()[0].platform == "cpu" and host_serve_enabled():
+            self._host_csr = packed.get("host_csr")
+        self._steps = {}
+        self._steps_lock = threading.Lock()
+        self._serial_dispatch = _serial_dispatch_required(mesh)
+        return self
+
     @classmethod
     def from_segments(cls, mesh: Mesh, segments: Sequence, field: str, **kw):
         """Build from one :class:`~elasticsearch_tpu.index.segment.Segment`
@@ -3391,6 +3493,85 @@ class DistributedKnnPlane:
             total += self.n_shards * nb1 * self.ivf.block * \
                 (dim * self.ivf.quant_bytes_per_dim() + 16)
         return total // max(s_dev, 1)
+
+    # -- warm-handoff packed state (the recovery artifact) -------------------
+
+    def export_packed(self) -> dict:
+        """Packed invariants (unit/norm² rows already computed) + the
+        IVF tier's centroids/codes, as a host dict for the wire codec —
+        :meth:`from_packed` restores a serving-identical plane without
+        re-running ``prepare_knn_corpus`` or the k-means pack."""
+        with self._steps_lock:
+            packed = self._packed or self._host_pack
+            dev = self._dev
+        if packed is None and dev is not None:
+            # accelerator path released the host copy after upload:
+            # read the (fully addressable) device arrays back once
+            packed = tuple(np.asarray(a) for a in dev)
+        vecs, vnorm2, exists = packed
+        out = dict(similarity=self.similarity, block=self.block,
+                   dim=int(self.dim), n_shards=int(self.n_shards),
+                   n_docs_total=int(self.n_docs_total),
+                   n_pad=int(self.n_pad), nbytes=int(self.nbytes),
+                   vecs=vecs, vnorm2=vnorm2, exists=exists, ivf=None)
+        if self.ivf is not None:
+            t = self.ivf
+            out["ivf"] = dict(
+                similarity=t.similarity, quant=t.quant,
+                block=int(t.block), nlist=int(t.nlist),
+                centroids=t.centroids,
+                default_nprobe=int(t.default_nprobe),
+                n_blocks=int(t.n_blocks),
+                cluster_sizes=t.cluster_sizes,
+                shards=t.shards)
+        return out
+
+    @classmethod
+    def from_packed(cls, mesh: Mesh, packed: dict
+                    ) -> "DistributedKnnPlane":
+        """Reconstruct from :meth:`export_packed` state — device upload
+        stays lazy exactly like the normal constructor. Raises on a
+        mesh whose shard axis does not divide the donor's padded shard
+        count (the caller falls back to a local pack)."""
+        self = cls.__new__(cls)
+        self.mesh = mesh
+        self.similarity = str(packed["similarity"])
+        self.block = packed["block"]
+        self.n_shards = int(packed["n_shards"])
+        if self.n_shards % mesh.shape[AXIS_SHARD]:
+            raise ValueError(
+                f"packed knn plane has {self.n_shards} shards; mesh "
+                f"shard axis {mesh.shape[AXIS_SHARD]} does not divide")
+        self.n_dispatches = 0
+        self.dim = int(packed["dim"])
+        self.n_docs_total = int(packed["n_docs_total"])
+        self.n_pad = int(packed["n_pad"])
+        self.nbytes = int(packed["nbytes"])
+        vecs = np.asarray(packed["vecs"], np.float32)
+        vnorm2 = np.asarray(packed["vnorm2"], np.float32)
+        exists = np.asarray(packed["exists"], bool)
+        self._packed = (vecs, vnorm2, exists)
+        self.ivf = None
+        ivf = packed.get("ivf")
+        if ivf is not None:
+            t = IvfKnnTier(str(ivf["similarity"]),
+                           quant=str(ivf["quant"]),
+                           block=int(ivf["block"]))
+            t.nlist = int(ivf["nlist"])
+            t.centroids = np.asarray(ivf["centroids"], np.float32)
+            t.default_nprobe = int(ivf["default_nprobe"])
+            t.n_blocks = int(ivf["n_blocks"])
+            t.cluster_sizes = np.asarray(ivf["cluster_sizes"])
+            t.shards = [dict(sh) for sh in ivf["shards"]]
+            self.ivf = t
+        self._dev = None
+        self._steps = {}
+        self._steps_lock = threading.Lock()
+        self._serial_dispatch = _serial_dispatch_required(mesh)
+        self._host_pack = self._packed \
+            if (jax.devices()[0].platform == "cpu"
+                and host_serve_enabled()) else None
+        return self
 
     def resolve_ann(self, nprobe: Optional[int],
                     rerank: Optional[int]):

@@ -6115,8 +6115,30 @@ class RestAPI:
             elif sa is not None:
                 body_n = dict(window_body, search_after=sa)
             svc = self.indices.indices[n]
-            results.append((n, svc.search(
-                body_n, request_cache=request_cache_flag)))
+            try:
+                r = svc.search(body_n,
+                               request_cache=request_cache_flag)
+            except ElasticsearchError as e:
+                # one index's EVERY shard copy failed inside a
+                # multi-index fan-out (a dead owner with no replicas):
+                # degrade that index to ES-shaped per-shard failures —
+                # the other indices' hits/aggs still answer. Request-
+                # level errors (4xx parse/validation) still raise.
+                if len(names) == 1 or \
+                        int(getattr(e, "status", 500)) < 500 or \
+                        getattr(e, "request_level", False):
+                    raise
+                from ..search.shard_search import ShardSearchResult
+                r = ShardSearchResult(
+                    total=0, total_relation="eq", hits=[],
+                    max_score=None,
+                    shard_failures=[{
+                        "shard": sid, "node": None,
+                        "reason": {"type": e.error_type,
+                                   "reason": str(e)},
+                        "status": int(getattr(e, "status", 500))}
+                        for sid in range(svc.num_shards)])
+            results.append((n, r))
         total = sum(r.total for _, r in results)
         relation = "eq"
         if any(r.total_relation == "gte" for _, r in results):
@@ -6188,17 +6210,31 @@ class RestAPI:
                     collapse_field, [None])[0])
         page = all_hits[from_: from_ + size]
         aggregations = None
+        agg_failures: List[dict] = []
         if len(names) == 1:
             aggregations = results[0][1].aggregations
         elif any(r.aggregations for _, r in results):
-            # cross-index agg reduce: re-run with partial collection
+            # cross-index agg reduce: re-run with partial collection;
+            # per-owner shard failures (a dead node's copies all down)
+            # surface under _shards.failures instead of 500ing
             aggregations = self._reduce_cross_index_aggs(
-                names, search_body)
+                names, search_body, failures_out=agg_failures)
         shards_total = sum(self.indices.indices[n].num_shards for n in names)
-        failures = []
+        failures = list(agg_failures)
         for n, r in results:
             for f in (r.shard_failures or []):
                 failures.append(dict(f, index=n))
+        # the hits phase and the agg-partials fan-out may both report
+        # the same dead shard — one failure entry per (index, shard)
+        seen_f: set = set()
+        deduped: List[dict] = []
+        for f in failures:
+            fk = (f.get("index"), f.get("shard"))
+            if fk in seen_f:
+                continue
+            seen_f.add(fk)
+            deduped.append(f)
+        failures = deduped
         shards_out = {"total": shards_total,
                       "successful": shards_total - len(failures),
                       "skipped": skipped_shards,
@@ -6251,7 +6287,9 @@ class RestAPI:
         return out
 
     def _reduce_cross_index_aggs(self, names: List[str],
-                                 search_body: dict) -> dict:
+                                 search_body: dict,
+                                 failures_out: Optional[List[dict]]
+                                 = None) -> dict:
         from ..search.aggregations import (AggregationContext, parse_aggs,
                                            run_aggregations_multi)
         from ..search.query_dsl import MatchAllQuery, parse_query
@@ -6264,8 +6302,14 @@ class RestAPI:
             svc = self.indices.indices[n]
             if svc.cluster_hooks is not None:
                 # cluster-routed index: the owning nodes collect partials
-                # and ship them into this one shared reduce
-                remote = svc.cluster_hooks.agg_partials(n, search_body)
+                # and ship them into this one shared reduce; per-shard
+                # failures come back ES-shaped with the index stamped
+                per_index: List[dict] = []
+                remote = svc.cluster_hooks.agg_partials(
+                    n, search_body, failures_out=per_index)
+                if failures_out is not None:
+                    failures_out.extend(
+                        dict(f, index=n) for f in per_index)
                 if remote is not None:
                     for name_, parts in remote.items():
                         extra_partials.setdefault(name_, []).extend(parts)

@@ -1127,6 +1127,13 @@ class ServingPlaneCache:
             raise
         plane._acct_bytes = nbytes
         gen = TextServingGeneration(plane, segments, field, avgdl, self)
+        return self._install_text_generation(gen, field, trigger, mode)
+
+    def _install_text_generation(self, gen: TextServingGeneration,
+                                 field: str, trigger: str,
+                                 mode: str) -> TextServingGeneration:
+        """Batcher + atomic swap, shared by the pack path and the
+        warm-handoff import."""
         self._attach_batcher(gen)
         with self._gen_lock:
             racedep.note_write("plane_cache.generations", self)
@@ -1289,6 +1296,32 @@ class ServingPlaneCache:
                 self._knn_build_streak += 1
         return gen
 
+    @staticmethod
+    def _pack_knn_shards(segments: Sequence[Segment], field: str):
+        """(plane shard dicts, dim) for a kNN base pack, or None when the
+        field's dims disagree across segments — shared by the build path
+        and the warm-handoff bundle export."""
+        shards = []
+        for seg in segments:
+            f = seg.vector_fields.get(field)
+            if f is None:
+                shards.append(dict(
+                    vectors=np.zeros((seg.n_docs, 1), np.float32),
+                    exists=np.zeros(seg.n_docs, bool)))
+            else:
+                ex = np.zeros(seg.n_docs, bool)
+                ex[: f.exists.shape[0]] = f.exists
+                shards.append(dict(vectors=f.matrix_host, exists=ex))
+        dims = {s["vectors"].shape[1] for s in shards if s["exists"].any()}
+        if len(dims) > 1:
+            return None
+        dim = dims.pop() if dims else 1
+        for s in shards:
+            if not s["exists"].any():
+                s["vectors"] = np.zeros((s["exists"].shape[0], dim),
+                                        np.float32)
+        return shards, dim
+
     def _build_knn_generation(self, segments, mapper, field: str, *,
                               trigger: str, mode: str):
         """Full kNN base pack + atomic swap into the LRU (superseded
@@ -1310,25 +1343,10 @@ class ServingPlaneCache:
                           getattr(ft, "similarity", "cosine"))
         if similarity is None:
             return None
-        shards = []
-        for seg in segments:
-            f = seg.vector_fields.get(field)
-            if f is None:
-                shards.append(dict(
-                    vectors=np.zeros((seg.n_docs, 1), np.float32),
-                    exists=np.zeros(seg.n_docs, bool)))
-            else:
-                ex = np.zeros(seg.n_docs, bool)
-                ex[: f.exists.shape[0]] = f.exists
-                shards.append(dict(vectors=f.matrix_host, exists=ex))
-        dims = {s["vectors"].shape[1] for s in shards if s["exists"].any()}
-        if len(dims) > 1:
+        got = self._pack_knn_shards(segments, field)
+        if got is None:
             return None
-        dim = dims.pop() if dims else 1
-        for s in shards:
-            if not s["exists"].any():
-                s["vectors"] = np.zeros((s["exists"].shape[0], dim),
-                                        np.float32)
+        shards, dim = got
         # pad the shard list to a shard-axis multiple with empty shards
         # (exists all-False — they score NEG_INF and never emit hits),
         # same as the lexical pack: the corpus dim must divide the mesh
@@ -1367,12 +1385,23 @@ class ServingPlaneCache:
             raise
         plane._acct_bytes = nbytes
         gen = KnnServingGeneration(plane, segments, field, self)
-        # evict ONLY at swap time, never before the build: the
-        # predecessor generations keep serving for the whole pack window
-        # (double-buffering — a pre-build eviction would leave a gap that
-        # concurrent probes fill with synchronous request-thread cold
-        # builds, the exact storm this module eliminates). The breaker
-        # transiently holds old+new, same as the lexical path.
+        return self._install_knn_generation(gen, key, nbytes, trigger,
+                                            mode)
+
+    def _install_knn_generation(self, gen: KnnServingGeneration,
+                                key: tuple, nbytes: int, trigger: str,
+                                mode: str):
+        """Atomic swap into the kNN LRU + batcher, shared by the pack
+        path and the warm-handoff import. Evicts ONLY at swap time,
+        never before the build: the predecessor generations keep
+        serving for the whole pack window (double-buffering — a
+        pre-build eviction would leave a gap that concurrent probes
+        fill with synchronous request-thread cold builds, the exact
+        storm this module eliminates). The breaker transiently holds
+        old+new, same as the lexical path."""
+        from ..common.breakers import DEFAULT as _breakers
+        acct = _breakers.breaker("accounting")
+        field = key[0]
         new_ids = set(key[1])
         with self._gen_lock:
             racedep.note_write("plane_cache.generations", self)
@@ -1402,6 +1431,176 @@ class ServingPlaneCache:
         self._attach_batcher(gen, knn=True)
         self._record_rebuild("knn", trigger, mode)
         return gen
+
+    # -- warm handoff: plane-bundle export / import --------------------------
+    #
+    # The packed base plane is a self-contained tensor bundle (CSR
+    # postings + frozen avgdl for text, vector matrices + similarity for
+    # kNN) keyed by the (seg_id, n_docs) signature of its base segment
+    # list. A recovering/rejoining node whose copies carry the same
+    # signature (file-based recovery ships the store wholesale;
+    # kill-and-rejoin reloads it) can install the donor's bundle as a
+    # live serving generation and serve warm immediately — no segment
+    # re-extraction, no request-thread cold pack (the rebuild-storm
+    # signature). Serialization is the data-only wire codec
+    # (common/datacodec): tensors in, tensors out, nothing executable.
+
+    def export_bundles(self) -> List[dict]:
+        """One handoff bundle per live serving generation, carrying the
+        plane's POST-pack tensors (``export_packed``: sorted-merge
+        tables, dense tier, block-max/IVF tiers, host-CSR) plus the
+        frozen invariants (avgdl) and the base segment signature — the
+        importer reconstructs bit-identical serving with zero pack
+        work."""
+        with self._gen_lock:
+            text_items = list(self._planes.values())
+            knn_items = list(self._knn_planes.values())
+        out: List[dict] = []
+        for gen in text_items:
+            try:
+                packed = gen.base.export_packed()
+            except Exception:   # noqa: BLE001 — foreign/legacy plane
+                continue
+            out.append({
+                "kind": "text", "field": gen.field,
+                "avgdl": float(gen.avgdl),
+                "signature": [(s.seg_id, int(s.n_docs))
+                              for s in gen.base_segments],
+                "packed": packed})
+        for gen in knn_items:
+            try:
+                packed = gen.base.export_packed()
+            except Exception:   # noqa: BLE001
+                continue
+            out.append({
+                "kind": "knn", "field": gen.field,
+                "signature": [(s.seg_id, int(s.n_docs))
+                              for s in gen.base_segments],
+                "packed": packed})
+        return out
+
+    def _match_signature(self, segments: Sequence[Segment],
+                         signature) -> Optional[List[Segment]]:
+        """Ordered-subsequence match of a bundle's base signature
+        against LOCAL segments by (seg_id, n_docs) — identity across
+        processes. None → the local copies diverged (ops-based recovery
+        re-segmented differently); the caller falls back to a repack."""
+        matched: List[Segment] = []
+        pos = 0
+        for want in signature or ():
+            wid, wnd = str(want[0]), int(want[1])
+            nxt = next((i for i in range(pos, len(segments))
+                        if segments[i].seg_id == wid
+                        and int(segments[i].n_docs) == wnd), None)
+            if nxt is None:
+                return None
+            matched.append(segments[nxt])
+            pos = nxt + 1
+        return matched if matched else None
+
+    def import_bundle(self, bundle: dict, segments: Sequence[Segment],
+                      mapper: MapperService) -> bool:
+        """Install one handoff bundle as a live serving generation over
+        the LOCAL segments matching its base signature. Returns False
+        (never raises) when the bundle cannot be adopted — signature
+        mismatch, route-ineligible local copies (deletes/nested), or a
+        failed build — so recovery degrades to the ordinary cold pack
+        instead of failing."""
+        try:
+            segments = [s for s in segments if s.n_docs > 0]
+            matched = self._match_signature(segments,
+                                            bundle.get("signature"))
+            if matched is None:
+                return False
+            field = str(bundle["field"])
+            if self._have_same_base(bundle.get("kind"), field,
+                                    bundle.get("signature")):
+                # idempotent: per-shard recovery offers and the
+                # replica-wiring trigger race duplicate pulls of the
+                # same bundles — a second import of an identical base
+                # would only churn generations (and retire the batcher
+                # a concurrent probe is using)
+                return True
+            if bundle.get("kind") == "text":
+                if self._signature(matched, field) is None:
+                    return False
+                return self._import_text_generation(
+                    matched, field, float(bundle["avgdl"]),
+                    bundle["packed"]) is not None
+            if bundle.get("kind") == "knn":
+                if self._knn_signature(matched, field) is None:
+                    return False
+                return self._import_knn_generation(
+                    matched, field, bundle["packed"]) is not None
+            return False
+        except Exception:   # noqa: BLE001 — a bad bundle must degrade
+            return False    # to the repack path, never break recovery
+
+    def _have_same_base(self, kind, field: str, signature) -> bool:
+        """True when a live generation of (kind, field) already covers
+        exactly this base signature."""
+        want = [(str(a), int(b)) for a, b in (signature or ())]
+        if kind == "text":
+            with self._gen_lock:
+                gen = self._planes.get(field)
+            gens = [gen] if gen is not None else []
+        else:
+            with self._gen_lock:
+                gens = [g for (f, _k), g in self._knn_planes.items()
+                        if f == field]
+        return any(
+            [(s.seg_id, int(s.n_docs)) for s in g.base_segments] == want
+            for g in gens)
+
+    def _import_text_generation(self, segments: Sequence[Segment],
+                                field: str, avgdl: float, packed: dict):
+        """Install a shipped text plane: breaker reservation from the
+        bundle's real tensor sizes, ``from_packed`` reconstruction
+        (device upload only — no pack), then the shared swap."""
+        from ..common.breakers import DEFAULT as _breakers
+        from ..parallel.dist_search import DistributedSearchPlane as _P
+        acct = _breakers.breaker("accounting")
+        nbytes = int(np.asarray(packed["docs"]).nbytes
+                     + np.asarray(packed["impacts"]).nbytes)
+        if packed.get("dense") is not None:
+            # shipped as exact f32; resident as bf16 (half)
+            nbytes += int(np.asarray(packed["dense"]).nbytes) // 2
+        acct.add_estimate(
+            nbytes, f"<serving plane [{field}] warm-handoff import, "
+                    f"{nbytes} B>")
+        try:
+            plane = _P.from_packed(self._get_mesh(), packed)
+        except Exception:
+            acct.release(nbytes)
+            raise
+        plane._acct_bytes = nbytes
+        gen = TextServingGeneration(plane, segments, field, avgdl, self)
+        return self._install_text_generation(gen, field, "handoff",
+                                             "import")
+
+    def _import_knn_generation(self, segments: Sequence[Segment],
+                               field: str, packed: dict):
+        from ..common.breakers import DEFAULT as _breakers
+        from ..parallel.dist_search import DistributedKnnPlane
+        acct = _breakers.breaker("accounting")
+        nbytes = int(packed.get("nbytes") or 0) or (
+            int(np.asarray(packed["vecs"]).nbytes)
+            + int(np.asarray(packed["vnorm2"]).nbytes)
+            + int(np.asarray(packed["exists"]).nbytes))
+        acct.add_estimate(
+            nbytes, f"<knn serving plane [{field}] warm-handoff "
+                    f"import, {nbytes} B>")
+        try:
+            plane = DistributedKnnPlane.from_packed(self._get_mesh(),
+                                                    packed)
+        except Exception:
+            acct.release(nbytes)
+            raise
+        plane._acct_bytes = nbytes
+        gen = KnnServingGeneration(plane, segments, field, self)
+        key = (field, tuple(id(s) for s in segments))
+        return self._install_knn_generation(gen, key, nbytes, "handoff",
+                                            "import")
 
     # -- lifecycle -----------------------------------------------------------
 

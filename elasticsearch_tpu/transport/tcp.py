@@ -70,6 +70,101 @@ class AsyncTaskQueue:
         return _Cancellable()
 
 
+class FaultInjector:
+    """Seeded, deterministic fault injection at the RPC send boundary —
+    the chaos harness's network (``scripts/bench_chaos.py``). One
+    injector is shared by every node's transport in a harness cluster;
+    each (src, dst) edge draws from its own ``Random(seed|src|dst)``
+    stream, so a fixed seed yields the same drop/delay schedule per
+    edge regardless of how other edges interleave.
+
+    Fault classes (kill-and-rejoin is harness-level: the harness stops
+    the real node object and constructs a new one on the same port):
+
+    - **drop**: the request never leaves the source — the caller sees
+      an immediate ``ConnectionError`` (a dropped SYN / RST).
+    - **delay**: the request waits ``delay_ms`` before dialing, with
+      the caller's timeout clock already running (queueing delay /
+      slow network), so injected slowness can push an RPC into its
+      timeout exactly like a real stall.
+    - **partition**: every send across a severed (a, b) pair drops,
+      both directions, until :meth:`heal`. ``isolate`` severs one node
+      from everyone.
+    """
+
+    def __init__(self, seed: int = 0, drop_rate: float = 0.0,
+                 delay_rate: float = 0.0,
+                 delay_ms: Tuple[float, float] = (0.0, 0.0)):
+        self.seed = seed
+        self.drop_rate = float(drop_rate)
+        self.delay_rate = float(delay_rate)
+        self.delay_ms = (float(delay_ms[0]), float(delay_ms[1]))
+        # one lock guards the edge-rng table, the partition sets, and
+        # the counters: plan() runs on every node's loop thread
+        self._lock = threading.Lock()
+        self._edge_rngs: Dict[Tuple[str, str], random.Random] = {}
+        self._severed: set = set()           # frozenset({a, b}) pairs
+        self._isolated: set = set()          # node ids cut from everyone
+        self.counts = {"dropped": 0, "delayed": 0, "partitioned": 0,
+                       "sent": 0}
+
+    # -- topology faults -----------------------------------------------------
+
+    def partition(self, a: str, b: str) -> None:
+        with self._lock:
+            self._severed.add(frozenset((a, b)))
+
+    def heal(self, a: Optional[str] = None,
+             b: Optional[str] = None) -> None:
+        """Heal one severed pair, or everything when called bare."""
+        with self._lock:
+            if a is None:
+                self._severed.clear()
+                self._isolated.clear()
+            elif b is None:
+                self._isolated.discard(a)
+                self._severed = {s for s in self._severed if a not in s}
+            else:
+                self._severed.discard(frozenset((a, b)))
+
+    def isolate(self, node: str) -> None:
+        with self._lock:
+            self._isolated.add(node)
+
+    # -- the send-time verdict ----------------------------------------------
+
+    def plan(self, src: str, dst: str, action: str
+             ) -> Tuple[str, float]:
+        """("ok"|"drop", delay_seconds) for one outgoing request."""
+        with self._lock:
+            self.counts["sent"] += 1
+            if src in self._isolated or dst in self._isolated or \
+                    frozenset((src, dst)) in self._severed:
+                self.counts["partitioned"] += 1
+                return "drop", 0.0
+            rng = self._edge_rngs.get((src, dst))
+            if rng is None:
+                rng = self._edge_rngs[(src, dst)] = random.Random(
+                    f"{self.seed}|{src}|{dst}")
+            # two independent draws per send keep the edge stream
+            # aligned whether or not a fault fires
+            u_drop, u_delay, u_len = (rng.random(), rng.random(),
+                                      rng.random())
+            if self.drop_rate and u_drop < self.drop_rate:
+                self.counts["dropped"] += 1
+                return "drop", 0.0
+            delay = 0.0
+            if self.delay_rate and u_delay < self.delay_rate:
+                lo, hi = self.delay_ms
+                delay = (lo + (hi - lo) * u_len) / 1e3
+                self.counts["delayed"] += 1
+            return "ok", delay
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counts)
+
+
 class TcpTransport:
     """One node's transport endpoint. ``send`` and handlers run on the
     node's loop thread; public ``send`` may be called from any thread."""
@@ -91,6 +186,9 @@ class TcpTransport:
         self.shared_secret = shared_secret
         self.ssl_server_ctx = ssl_server_ctx
         self.ssl_client_ctx = ssl_client_ctx
+        #: chaos seam: a shared :class:`FaultInjector` (or None) — every
+        #: outgoing non-loopback request consults it (see _send)
+        self.fault_injector: Optional[FaultInjector] = None
         self._handlers: Dict[str, Callable] = {}
         self._conns: Dict[str, Tuple[asyncio.StreamReader,
                                      asyncio.StreamWriter]] = {}
@@ -158,6 +256,17 @@ class TcpTransport:
                 finish_err(e)
             return
 
+        inj = self.fault_injector
+        verdict, fault_delay = inj.plan(self.node_id, dst, action) \
+            if inj is not None else ("ok", 0.0)
+        if verdict == "drop":
+            # a dropped/partitioned request fails like a refused dial:
+            # immediately, so callers exercise their real failover path
+            finish_err(ConnectionError(
+                f"[{action}] {self.node_id}->{dst} dropped "
+                f"(fault injection)"))
+            return
+
         self._req_id += 1
         req_id = self._req_id
         self._pending[req_id] = (finish_ok, finish_err, dst)
@@ -169,6 +278,11 @@ class TcpTransport:
 
         timer = self.loop.call_later(timeout, on_timeout)
         try:
+            if fault_delay > 0.0:
+                # injected slowness runs INSIDE the caller's timeout
+                # window (the timer above is already armed) — a delay
+                # past the timeout surfaces as a real timeout
+                await asyncio.sleep(fault_delay)
             writer = await self._connect(dst)
             frame = json.dumps({
                 "t": "req", "id": req_id, "action": action,

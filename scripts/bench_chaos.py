@@ -1,0 +1,456 @@
+#!/usr/bin/env python
+"""Seeded kill-and-rejoin chaos bench: failover under live traffic +
+the paired warm-handoff vs segment-re-pack time-to-warm comparison.
+
+Topology (one process, real TCP loopback cluster):
+
+- 3 nodes; the elected master is left alone (quorum survives every
+  kill), the ``chaos`` index (2 shards, 1 replica) is PINNED onto the
+  front + victim via the include._id allocation filter so the kill is
+  deterministic, not allocator luck.
+- A seeded :class:`FaultInjector` adds drop/delay noise on every edge
+  during the failover phase — the copy-failover retry machinery runs
+  under realistic weather, not a clean network.
+
+Phases:
+
+1. **build** — bulk-index ``BENCH_CHAOS_N_DOCS`` docs, refresh, flush
+   (both copies persist identical segments: replication is synchronous
+   and the refresh broadcast cuts the same segment on every copy).
+2. **failover** — search clients run against the front; the victim is
+   killed mid-traffic. Gate: ZERO failed searches after the routing
+   settles (the victim stripped). Reported: interactive p99 over the
+   recovery window (kill → settle + 5 s), gated by bench_diff.
+3. **rejoin (warm)** — the victim restarts on its persisted store;
+   recovery re-attaches it and the warm plane handoff imports the
+   donor's packed tensors. time_to_warm = first plane-served search
+   on the rejoined node, measured from recovery-settled.
+4. **rejoin (repack)** — same kill/rejoin with ES_TPU_PLANE_HANDOFF=0:
+   the first search pays the synchronous cold pack — the rebuild-storm
+   baseline. Gate (in-bench): time_to_repack / time_to_warm >=
+   BENCH_CHAOS_MIN_RATIO (default 5).
+
+Prints one JSON doc on stdout (last line), bench_diff-compatible
+(``configs`` with a p99-gated throughput entry + the time_to_warm
+fields bench_diff gates on growth).
+
+Usage:  python scripts/bench_chaos.py [--out CHAOS_rNN.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SEED = int(os.environ.get("BENCH_CHAOS_SEED", 42))
+N_DOCS = int(os.environ.get("BENCH_CHAOS_N_DOCS", 6000))
+N_CLIENTS = int(os.environ.get("BENCH_CHAOS_CLIENTS", 4))
+MIN_RATIO = float(os.environ.get("BENCH_CHAOS_MIN_RATIO", 5.0))
+BASE_PORT = int(os.environ.get("BENCH_CHAOS_PORT", 29300))
+#: realistic lexical shape: a 2000-term Zipf vocabulary (tiny word
+#: lists make every pack trivially cheap and the time-to-warm
+#: comparison meaningless) + a dense_vector field so the donor's kNN
+#: plane (IVF tier: k-means + quantized codes) rides the handoff too
+VOCAB_N = int(os.environ.get("BENCH_CHAOS_VOCAB", 2000))
+VEC_DIM = int(os.environ.get("BENCH_CHAOS_VEC_DIM", 64))
+#: corpus threshold at which the packs build their block-max/IVF tiers
+#: (production defaults need 128k+ docs; the bench corpus is smaller,
+#: so the knobs come down — the tier build IS the production pack cost
+#: the warm handoff exists to skip)
+TIER_MIN_DOCS = int(os.environ.get("BENCH_CHAOS_TIER_MIN_DOCS", 4096))
+
+
+def log(msg):
+    print(f"[chaos] {msg}", file=sys.stderr, flush=True)
+
+
+def wait_for(pred, timeout, msg):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise SystemExit(f"FAIL: timeout waiting for {msg}")
+
+
+def percentile(vals, q):
+    if not vals:
+        return 0.0
+    vals = sorted(vals)
+    i = min(int(len(vals) * q), len(vals) - 1)
+    return vals[i]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON doc to this path")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+    from elasticsearch_tpu.node.cluster_node import ClusterNode
+    from elasticsearch_tpu.transport.tcp import FaultInjector
+
+    tmp = tempfile.mkdtemp(prefix="bench_chaos_")
+    peers = {f"n{i}": ("127.0.0.1", BASE_PORT + i) for i in range(3)}
+    nodes = {nid: ClusterNode(nid, "127.0.0.1", port, peers,
+                              os.path.join(tmp, nid), seed=i)
+             for i, (nid, (_h, port)) in enumerate(peers.items())}
+    injector = FaultInjector(seed=SEED, drop_rate=0.01, delay_rate=0.05,
+                             delay_ms=(1.0, 15.0))
+
+    def install_injector():
+        for n in nodes.values():
+            n.transport.fault_injector = injector
+
+    t_bench0 = time.monotonic()
+    try:
+        # -- elect + pick roles -------------------------------------------
+        leader = None
+        deadline = time.monotonic() + 20.0
+        while leader is None and time.monotonic() < deadline:
+            ls = [n for n in nodes.values()
+                  if n.coordinator.mode == "LEADER"]
+            if len(ls) == 1:
+                leader = ls[0]
+            time.sleep(0.05)
+        if leader is None:
+            raise SystemExit("FAIL: no leader elected")
+        data_ids = sorted(set(nodes) - {leader.node_id})
+        front, victim_id = nodes[data_ids[0]], data_ids[1]
+        log(f"leader={leader.node_id} front={front.node_id} "
+            f"victim={victim_id}")
+
+        # -- build ---------------------------------------------------------
+        body = json.dumps({
+            "settings": {
+                "number_of_shards": 2, "number_of_replicas": 1,
+                "index.routing.allocation.include._id":
+                    f"{front.node_id},{victim_id}"},
+            "mappings": {"properties": {
+                "body": {"type": "text"}, "n": {"type": "integer"},
+                "vec": {"type": "dense_vector", "dims": VEC_DIM}}},
+        }).encode()
+        status, _ct, out = front.rest._meta_op("PUT", "/chaos", "", body)
+        if status >= 300:
+            raise SystemExit(f"FAIL: index create {out[:200]!r}")
+        # the cold pack must include the production tiers (block-max +
+        # IVF) at this corpus size — on every node that may pack
+        front.rest.indices.indices["chaos"].plane_cache \
+            .lex_prune_min_docs = TIER_MIN_DOCS
+        front.rest.indices.indices["chaos"].plane_cache \
+            .knn_ivf_min_docs = TIER_MIN_DOCS
+
+        def in_sync():
+            st = front.applied_state
+            t = (st.data.get("routing", {}) or {}).get("chaos") or {}
+            return t and all(
+                e.get("replicas") and
+                set(e.get("in_sync") or ()) >= set(e["replicas"])
+                for e in t.values())
+        wait_for(in_sync, 30.0, "replicas in sync")
+
+        rng = np.random.RandomState(SEED)
+        vocab = [f"w{i}" for i in range(VOCAB_N)]
+        zipf = np.clip(rng.zipf(1.1, (N_DOCS + 1000) * 16),
+                       1, VOCAB_N) - 1
+        t0 = time.monotonic()
+        for lo in range(0, N_DOCS, 500):
+            lines = []
+            for i in range(lo, min(lo + 500, N_DOCS)):
+                words = [vocab[zipf[(i * 16 + j) % zipf.size]]
+                         for j in range(16)]
+                lines.append(json.dumps(
+                    {"index": {"_index": "chaos", "_id": f"d{i}"}}))
+                lines.append(json.dumps({
+                    "body": " ".join(words), "n": i,
+                    "vec": [round(float(x), 4) for x in
+                            rng.randn(VEC_DIM)]}))
+            status, _ct, out = front.rest.handle(
+                "POST", "/_bulk", "", ("\n".join(lines) + "\n").encode())
+            if status >= 300:
+                raise SystemExit(f"FAIL: bulk {out[:200]!r}")
+        front.refresh("chaos")
+        front.rest.handle("POST", "/chaos/_flush", "", b"")
+        log(f"indexed {N_DOCS} docs in "
+            f"{time.monotonic() - t0:.1f}s; flushed")
+
+        # prime the donor's POOLED serving generations over the
+        # pre-kill base (the bundles the handoff will ship): text via
+        # the bag-of-terms plane, kNN via the IVF plane — both through
+        # the service's real plane providers
+        fsvc = front.rest.indices.indices["chaos"]
+        fsvc.searcher().search(
+            {"query": {"match": {"body": "w1"}}, "size": 10})
+        fsvc.searcher().search(
+            {"knn": {"field": "vec", "query_vector": [0.1] * VEC_DIM,
+                     "k": 10, "num_candidates": 50}})
+        rb0 = fsvc.plane_cache.rebuild_stats()
+        if rb0.get("cold", 0) < 2:
+            raise SystemExit(f"FAIL: donor generations missing: {rb0}")
+        log(f"donor plane generations primed: {rb0}")
+
+        # -- failover under live traffic ----------------------------------
+        install_injector()
+        reqlog = []           # (t, ok, latency_ms)
+        reqlock = threading.Lock()
+        stop_flag = threading.Event()
+        qbody = json.dumps({"query": {"match": {"body": "w1"}},
+                            "size": 10}).encode()
+
+        def client():
+            while not stop_flag.is_set():
+                t1 = time.monotonic()
+                try:
+                    st, _c, o = front.rest.handle(
+                        "POST", "/chaos/_search", "request_cache=false",
+                        qbody)
+                    doc = json.loads(o)
+                    ok = st == 200 and \
+                        doc.get("_shards", {}).get("failed", 0) == 0 \
+                        and doc.get("hits", {}).get("hits") is not None
+                except Exception:   # noqa: BLE001
+                    ok = False
+                with reqlock:
+                    reqlog.append(
+                        (t1, ok, (time.monotonic() - t1) * 1e3))
+                time.sleep(0.01)
+
+        wlog = {"ok": 0, "fail": 0}
+        wstop = threading.Event()
+
+        def writer():
+            i = N_DOCS
+            while not wstop.is_set():
+                try:
+                    front.index_doc("chaos", f"d{i}", {
+                        "body": " ".join(
+                            vocab[zipf[(i * 16 + j) % zipf.size]]
+                            for j in range(16)),
+                        "n": i,
+                        "vec": [0.01 * (i % 97)] * VEC_DIM})
+                    wlog["ok"] += 1
+                except Exception:   # noqa: BLE001 — a write hitting the
+                    wlog["fail"] += 1   # dead primary pre-failover
+                i += 1
+                time.sleep(0.02)
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(N_CLIENTS)]
+        wthread = threading.Thread(target=writer, daemon=True)
+        for t in threads:
+            t.start()
+        wthread.start()
+        time.sleep(2.0)
+        t_kill = time.monotonic()
+        nodes[victim_id].stop()
+        log("victim killed under live search + index traffic")
+
+        def victim_stripped():
+            st = front.applied_state
+            t = (st.data.get("routing", {}) or {}).get("chaos") or {}
+            return t and all(
+                e["primary"] == front.node_id and
+                victim_id not in e.get("replicas", ()) and
+                victim_id not in (e.get("in_sync") or ())
+                for e in t.values())
+        wait_for(victim_stripped, 30.0, "failover routing")
+        t_settle = time.monotonic()
+        time.sleep(5.0)       # post-settle window (plane builds here)
+        stop_flag.set()
+        wstop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        wthread.join(timeout=30.0)
+        injector.heal()
+        front.refresh("chaos")
+        log(f"live writes during failover: ok={wlog['ok']} "
+            f"failed={wlog['fail']}")
+
+        with reqlock:
+            entries = list(reqlog)
+        after = [(ok, ms) for (ts, ok, ms) in entries
+                 if ts > t_settle + 0.2]
+        during = [(ok, ms) for (ts, ok, ms) in entries
+                  if t_kill <= ts <= t_settle + 5.0]
+        failures_after = sum(1 for ok, _ in after if not ok)
+        settle_s = t_settle - t_kill
+        recovery_p99 = percentile([ms for _ok, ms in during], 0.99)
+        window_qps = len(during) / max(
+            (min(t_settle + 5.0, entries[-1][0]) - t_kill), 1e-9) \
+            if during else 0.0
+        log(f"failover: settle={settle_s:.2f}s "
+            f"failures_after_settle={failures_after} "
+            f"recovery_p99={recovery_p99:.1f}ms "
+            f"window_qps={window_qps:.1f} "
+            f"faults={injector.stats()}")
+        if failures_after:
+            raise SystemExit(
+                f"FAIL: {failures_after} client-visible search failures "
+                f"AFTER failover settled")
+
+
+        # -- rejoin legs ---------------------------------------------------
+        def rejoin_and_measure(handoff: bool, seed: int):
+            """Restart the victim; returns (recovery_s from ctor,
+            serve_warm_s from recovery-settled to first plane-served
+            search, node). The serving-warm window is the metric: both
+            legs pay identical metadata/ops recovery first."""
+            if not handoff:
+                os.environ["ES_TPU_PLANE_HANDOFF"] = "0"
+            try:
+                t_re = time.monotonic()
+                reborn = ClusterNode(
+                    victim_id, "127.0.0.1", peers[victim_id][1], peers,
+                    os.path.join(tmp, victim_id), seed=seed)
+            finally:
+                os.environ.pop("ES_TPU_PLANE_HANDOFF", None)
+            nodes[victim_id] = reborn
+
+            def recovered():
+                svc = reborn.rest.indices.indices.get("chaos")
+                if svc is None or not any(
+                        e.searchable_segments() for e in svc.shards):
+                    return False
+                st = front.applied_state
+                t = (st.data.get("routing", {}) or {}).get("chaos") or {}
+                return t and all(
+                    victim_id in (e.get("in_sync") or ())
+                    for e in t.values())
+            wait_for(recovered, 60.0, "rejoin recovery")
+            recovery_s = time.monotonic() - t_re
+            svc = reborn.rest.indices.indices["chaos"]
+            svc.plane_cache.lex_prune_min_docs = TIER_MIN_DOCS
+            svc.plane_cache.knn_ivf_min_docs = TIER_MIN_DOCS
+            # TIMED WINDOW: recovery-settled -> serving planes READY
+            # for the node's current pooled view. On the warm leg that
+            # is any handoff residue (the transfer/import overlap
+            # recovery) + O(delta) resolution; on the repack leg it is
+            # the synchronous cold packs (CSR sort-merge tables, dense
+            # tier, block-max lexsort, IVF k-means + quantize) — the
+            # exact work the first search would stall on. Measuring
+            # plane-readiness (not first-search wall) keeps unrelated
+            # process-wide XLA mask compiles (jnp.full per novel
+            # segment length — paid once per shape, order-biased
+            # between the legs) out of the paired comparison.
+            pooled = [sg for e in svc.shards
+                      for sg in e.searchable_segments()]
+            t_w = time.monotonic()
+            if handoff:
+                deadline = time.monotonic() + 30.0
+                while svc.plane_cache.rebuild_stats() \
+                        .get("handoff", 0) < 2:
+                    if time.monotonic() > deadline:
+                        raise SystemExit(
+                            "FAIL: warm handoff import incomplete: "
+                            f"{svc.plane_cache.rebuild_stats()}")
+                    time.sleep(0.005)
+            tgen = svc.plane_cache.plane_for(pooled, svc.mapper, "body")
+            kgen = svc.plane_cache.knn_plane_for(pooled, svc.mapper,
+                                                 "vec")
+            serve_warm_s = time.monotonic() - t_w
+            if tgen is None or kgen is None:
+                raise SystemExit("FAIL: serving planes unavailable "
+                                 "after rejoin")
+            # untimed verification: real plane-served searches answer
+            # through the providers (and the batcher) on the rejoined
+            # node
+            r = svc.searcher().search(
+                {"query": {"match": {"body": "w1"}}, "size": 10})
+            rk = svc.searcher().search(
+                {"knn": {"field": "vec",
+                         "query_vector": [0.1] * VEC_DIM, "k": 10,
+                         "num_candidates": 50}})
+            assert r.hits and rk.hits, "probe searches returned nothing"
+            log(f"rejoin segs={[(sg.seg_id, sg.n_docs) for sg in pooled]}"
+                f" planes_ready={serve_warm_s:.3f}s")
+            return recovery_s, serve_warm_s, reborn
+
+        # warm leg
+        rec_w, warm_s, reborn = rejoin_and_measure(True, seed=11)
+        rb_w = reborn.rest.indices.indices["chaos"] \
+            .plane_cache.rebuild_stats()
+        if rb_w.get("handoff", 0) < 2 or rb_w.get("cold", 0) != 0:
+            raise SystemExit(f"FAIL: warm leg did not serve from the "
+                             f"handoff import: {rb_w}")
+        log(f"warm leg: recovery={rec_w:.2f}s planes_ready={warm_s:.3f}s "
+            f"{rb_w}")
+
+        # repack leg: kill again, rejoin with the handoff disabled
+        reborn.stop()
+        wait_for(victim_stripped, 30.0, "second failover")
+        rec_r, repack_s, reborn2 = rejoin_and_measure(False, seed=12)
+        rb_r = reborn2.rest.indices.indices["chaos"] \
+            .plane_cache.rebuild_stats()
+        if rb_r.get("cold", 0) < 2 or rb_r.get("handoff", 0) != 0:
+            raise SystemExit(f"FAIL: repack leg did not cold-pack: "
+                             f"{rb_r}")
+        log(f"repack leg: recovery={rec_r:.2f}s "
+            f"planes_ready={repack_s:.3f}s {rb_r}")
+
+        ratio = repack_s / max(warm_s, 1e-4)
+        if ratio < MIN_RATIO:
+            raise SystemExit(
+                f"FAIL: warm handoff only {ratio:.1f}x faster than the "
+                f"segment re-pack path (gate {MIN_RATIO}x): "
+                f"warm={warm_s:.3f}s repack={repack_s:.3f}s")
+
+        from elasticsearch_tpu.common import telemetry as _tm
+        snap = _tm.DEFAULT.metrics_doc()
+        rec_bytes = {s["labels"]["kind"]: int(s["value"]) for s in
+                     snap.get("es_recovery_bytes_total",
+                              {}).get("series", ())}
+        doc = {
+            "metric": "chaos kill-and-rejoin (failover + warm handoff)",
+            "backend": "cpu", "chaos": True, "seed": SEED,
+            "n_docs": N_DOCS,
+            "wall_s": round(time.monotonic() - t_bench0, 1),
+            "recovery_bytes": rec_bytes,
+            "configs": {
+                "chaos_failover": {
+                    "value": round(window_qps, 1), "unit": "queries/s",
+                    "p99_ms": round(recovery_p99, 1), "p99_gate": True,
+                    "failures_after_settle": failures_after,
+                    "settle_s": round(settle_s, 2),
+                    "clients": N_CLIENTS,
+                    "faults": injector.stats()},
+                "chaos_rejoin_warm": {
+                    "value": round(ratio, 1), "unit": "x",
+                    "time_to_warm_s": round(warm_s, 3),
+                    "time_to_repack_s": round(repack_s, 3),
+                    "recovery_warm_s": round(rec_w, 2),
+                    "recovery_repack_s": round(rec_r, 2),
+                    "min_ratio_gate": MIN_RATIO},
+            },
+        }
+        line = json.dumps(doc)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        return 0
+    finally:
+        for n in list(nodes.values()):
+            try:
+                if not n.stopped:
+                    n.stop()
+            except Exception:   # noqa: BLE001
+                pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,315 @@
+"""Warm plane handoff: packed-plane bundle export/import parity, the
+chunked resumable ``recovery:plane_*`` transfer, and the end-to-end
+kill-and-rejoin flow where the rejoining node serves WARM from the
+donor's packed tensors instead of re-packing its segments.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.datacodec import dumps_b64, loads_b64
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.plane_route import ServingPlaneCache
+
+BASE_PORT = 29810
+
+WORDS = ["quick", "brown", "fox", "red", "blue", "dog", "cat", "bird"]
+
+
+def build_segments(mapper, seed=0, n_segs=2, docs=300, dim=4):
+    rng = np.random.RandomState(seed)
+    segs = []
+    for si in range(n_segs):
+        b = SegmentBuilder(f"_{si}")
+        for i in range(docs):
+            b.add(mapper.parse_document(f"d{si}_{i}", {
+                "body": " ".join(rng.choice(WORDS, 6)),
+                "vec": rng.randn(dim).tolist()}), seq_no=i)
+        segs.append(b.build())
+    return segs
+
+
+@pytest.fixture()
+def mapper():
+    return MapperService({"properties": {
+        "body": {"type": "text"},
+        "vec": {"type": "dense_vector", "dims": 4}}})
+
+
+# ---------------------------------------------------------------------------
+# bundle unit tier
+# ---------------------------------------------------------------------------
+
+def test_bundle_roundtrip_bit_parity(mapper):
+    """Export → datacodec wire → import on fresh (signature-matching)
+    segments: the imported generation must serve BIT-identical values,
+    hits, and totals — including the pruned path over a shipped
+    block-max tier and the kNN plane — with zero cold/sync packs on
+    the importer (``handoff`` rebuild trigger only)."""
+    segs_a = build_segments(mapper, seed=0)
+    cache_a = ServingPlaneCache()
+    cache_a.lex_prune_min_docs = 1       # force a block-max tier
+    gen = cache_a.plane_for(segs_a, mapper, "body")
+    kg = cache_a.knn_plane_for(segs_a, mapper, "vec")
+    assert gen is not None and kg is not None
+    bundles = loads_b64(dumps_b64(cache_a.export_bundles()))
+    assert {b["kind"] for b in bundles} == {"text", "knn"}
+
+    segs_b = build_segments(mapper, seed=0)    # same data, new objects
+    cache_b = ServingPlaneCache()
+    for b in bundles:
+        assert cache_b.import_bundle(b, segs_b, mapper), b["kind"]
+    rb = cache_b.rebuild_stats()
+    assert rb.get("handoff") == 2 and rb.get("cold", 0) == 0 \
+        and rb.get("sync", 0) == 0, rb
+
+    gen_b = cache_b.plane_for(segs_b, mapper, "body")
+    queries = [["quick", "fox"], ["blue"], ["dog", "cat", "bird"]]
+    va, ha, ta = gen.serve(queries, k=7, with_totals=True)
+    vb, hb, tb = gen_b.serve(queries, k=7, with_totals=True)
+    for i in range(len(queries)):
+        assert np.array_equal(va[i], vb[i])
+    assert ha == hb and ta == tb
+    vap, hap = gen.serve(queries, k=7, prune=True)
+    vbp, hbp = gen_b.serve(queries, k=7, prune=True)
+    assert hap == hbp
+    for i in range(len(queries)):
+        assert np.array_equal(vap[i], vbp[i])
+    kg_b = cache_b.knn_plane_for(segs_b, mapper, "vec")
+    q = np.asarray(np.random.RandomState(5).randn(3, 4), np.float32)
+    vka, hka = kg.serve(q, k=5)
+    vkb, hkb = kg_b.serve(q, k=5)
+    assert np.array_equal(np.asarray(vka), np.asarray(vkb))
+    assert hka == hkb
+
+
+def test_bundle_import_rejects_mismatched_segments(mapper):
+    """Diverged local copies (different doc counts / seg ids — an
+    ops-based recovery that re-segmented differently) must REJECT the
+    bundle and fall back, never serve foreign coordinates."""
+    segs_a = build_segments(mapper, seed=0)
+    cache_a = ServingPlaneCache()
+    assert cache_a.plane_for(segs_a, mapper, "body") is not None
+    bundle = cache_a.export_bundles()[0]
+    cache_b = ServingPlaneCache()
+    # different corpus: same seg count, different doc counts
+    other = build_segments(mapper, seed=1, docs=123)
+    assert not cache_b.import_bundle(bundle, other, mapper)
+    assert cache_b.rebuild_stats().get("handoff", 0) == 0
+
+
+def test_bundle_import_tolerates_extra_local_segments(mapper):
+    """The importer's pooled list may hold MORE segments than the
+    bundle's base (ops replayed after the donor packed): the base
+    matches as an ordered subsequence and the extras become the delta
+    tier — fresh docs still merge into every answer."""
+    segs = build_segments(mapper, seed=0)
+    cache_a = ServingPlaneCache()
+    gen_a = cache_a.plane_for(segs, mapper, "body")
+    bundle = next(b for b in cache_a.export_bundles()
+                  if b["kind"] == "text")
+
+    local = build_segments(mapper, seed=0)
+    extra = build_segments(mapper, seed=9, n_segs=1, docs=40)
+    cache_b = ServingPlaneCache()
+    assert cache_b.import_bundle(bundle, local + extra, mapper)
+    gen_b = cache_b.plane_for(local + extra, mapper, "body")
+    assert gen_b is not None
+    _vals, hits, totals = gen_b.serve([["quick"]], k=5,
+                                      with_totals=True)
+    _va, _ha, ta = gen_a.serve([["quick"]], k=5, with_totals=True)
+    # the delta tier's matches fold into the total on top of the base's
+    assert int(totals[0]) >= int(ta[0])
+    # hits may come from the delta segment (position == len(local))
+    assert all(0 <= si <= len(local) for si, _d in hits[0])
+
+
+# ---------------------------------------------------------------------------
+# cluster tier: chunked transfer + kill-and-rejoin
+# ---------------------------------------------------------------------------
+
+def _wait(pred, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def _mk_nodes(tmp_path, n, base_port, injector=None):
+    from elasticsearch_tpu.node.cluster_node import ClusterNode
+    peers = {f"n{i}": ("127.0.0.1", base_port + i) for i in range(n)}
+    nodes = [ClusterNode(f"n{i}", "127.0.0.1", base_port + i, peers,
+                         str(tmp_path / f"n{i}"), seed=i)
+             for i in range(n)]
+    if injector is not None:
+        for node in nodes:
+            node.transport.fault_injector = injector
+    return nodes, peers
+
+
+def test_chunked_transfer_and_resume(tmp_path):
+    """The recovery:plane_* RPCs ship a prepared export in chunks; a
+    seeded drop-y network loses individual chunk fetches, the puller
+    retries JUST those chunks (resume — fetched chunks never re-ship),
+    and the reassembled bundle imports cleanly."""
+    from elasticsearch_tpu.transport.tcp import FaultInjector
+    os.environ["ES_TPU_RPC_RETRY_ATTEMPTS"] = "8"
+    from elasticsearch_tpu.common.retry import TIMEOUTS
+    TIMEOUTS.configure(None)
+    inj = FaultInjector(seed=11, drop_rate=0.35)
+    nodes, _ = _mk_nodes(tmp_path, 2, 29830)
+    try:
+        from tests.test_chaos_failover import wait_leader
+        wait_leader(nodes)
+        donor, target = nodes
+        # seed the donor with a tiny chunk size so a multi-chunk
+        # transfer really happens
+        donor.PLANE_CHUNK_BYTES = 2048
+        donor.create_index("hx", num_shards=1, num_replicas=0)
+        svc = donor.rest.indices.indices["hx"]
+        for i in range(300):
+            svc.index_doc(f"d{i}", {"body": f"{WORDS[i % 8]} event"})
+        svc.refresh()
+        segs = [s for e in svc.shards
+                for s in e.searchable_segments()]
+        assert svc.plane_cache.plane_for(segs, svc.mapper, "body") \
+            is not None
+        man = target.rpc(donor.node_id, "recovery:plane_manifest",
+                         {"index": "hx"}, timeout=10.0)
+        assert man["bundles"] and man["bundles"][0]["n_chunks"] > 1, man
+        # drop-y network from here: chunk pulls must resume
+        for node in nodes:
+            node.transport.fault_injector = inj
+        got = target._pull_plane_bundles("hx", donor.node_id,
+                                         import_deadline=0.5)
+        # target has no matching local segments — the transfer itself
+        # must have completed (bytes recorded), import falls back
+        assert got == 0
+        from elasticsearch_tpu.common import telemetry as _tm
+        doc = _tm.DEFAULT.metrics_doc().get("es_recovery_bytes_total")
+        by_kind = {s["labels"]["kind"]: s["value"]
+                   for s in (doc or {}).get("series", ())}
+        assert by_kind.get("plane", 0) >= man["bundles"][0]["nbytes"]
+        assert inj.stats()["dropped"] > 0, "no chunk fetch ever dropped"
+    finally:
+        os.environ.pop("ES_TPU_RPC_RETRY_ATTEMPTS", None)
+        TIMEOUTS.configure(None)
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+
+def test_kill_and_rejoin_serves_warm(tmp_path):
+    """End to end: a data node dies under a replicated index, the
+    survivor serves (and packs its plane); the node REJOINS with its
+    persisted store, recovery re-attaches it, and the warm handoff
+    installs the donor's packed plane — the rejoined node serves
+    bit-identically to its own per-segment path with ZERO cold packs."""
+    from elasticsearch_tpu.node.cluster_node import ClusterNode
+    from tests.test_chaos_failover import (_create_pinned, stop_all,
+                                           wait_leader)
+    nodes, peers = _mk_nodes(tmp_path, 3, 29850)
+    try:
+        leader = wait_leader(nodes)
+        data_nodes = [n for n in nodes if n is not leader]
+        front, victim = data_nodes[0], data_nodes[1]
+        table = _create_pinned(front, "wh", 2, 1,
+                               [front.node_id, victim.node_id])
+
+        def in_sync():
+            st = front.applied_state
+            t = (st.data.get("routing", {}) or {}).get("wh") or {}
+            return t and all(
+                e.get("replicas") and
+                set(e.get("in_sync") or ()) >= set(e["replicas"])
+                for e in t.values())
+        _wait(in_sync, msg="replicas in sync")
+
+        rng = np.random.RandomState(0)
+        for i in range(600):
+            front.index_doc("wh", f"d{i}", {
+                "body": " ".join(rng.choice(WORDS, 6)), "n": i})
+        front.refresh("wh")
+        status, _ct, out = front.rest.handle("POST", "/wh/_flush",
+                                             "", b"")
+        assert status == 200, out
+
+        victim_id = victim.node_id
+        victim.stop()
+
+        def failed_over():
+            st = front.applied_state
+            t = (st.data.get("routing", {}) or {}).get("wh") or {}
+            return t and all(
+                e["primary"] == front.node_id and
+                victim_id not in e.get("replicas", ()) and
+                victim_id not in (e.get("in_sync") or ())
+                for e in t.values())
+        _wait(failed_over, timeout=25.0, msg="failover to the front")
+
+        # searches through the front now take the LOCAL serving path
+        # (owners == {front}) and pack the plane generation the donor
+        # will export
+        for _ in range(3):
+            status, _ct, out = front.rest.handle(
+                "POST", "/wh/_search", "request_cache=false",
+                json.dumps({"query": {"match": {"body": "quick"}},
+                            "size": 10}).encode())
+            assert status == 200, out
+        fsvc = front.rest.indices.indices["wh"]
+        _wait(lambda: fsvc.plane_cache.rebuild_stats()["cold"] >= 1,
+              msg="donor plane generation")
+
+        # rejoin with the SAME data path: the store reloads, recovery
+        # replays the (empty) op gap, the offer triggers the pull
+        reborn = ClusterNode(victim_id, "127.0.0.1",
+                             peers[victim_id][1], peers,
+                             str(tmp_path / victim_id), seed=9)
+        nodes.append(reborn)
+
+        def rejoined_in_sync():
+            if reborn.rest.indices.indices.get("wh") is None:
+                return False       # metadata replay still in flight
+            st = front.applied_state
+            t = (st.data.get("routing", {}) or {}).get("wh") or {}
+            return t and all(
+                victim_id in (e.get("in_sync") or ())
+                for e in t.values())
+        _wait(rejoined_in_sync, timeout=40.0, msg="rejoin + recovery")
+
+        rsvc = reborn.rest.indices.indices["wh"]
+        _wait(lambda: rsvc.plane_cache.rebuild_stats()
+              .get("handoff", 0) >= 1, timeout=30.0,
+              msg="warm handoff import")
+        rb = rsvc.plane_cache.rebuild_stats()
+        assert rb.get("cold", 0) == 0, rb
+
+        # the imported generation serves BIT-identically to the
+        # rejoined node's own per-segment scoring
+        from elasticsearch_tpu.search.shard_search import ShardSearcher
+        body = {"query": {"match": {"body": "quick"}}, "size": 10}
+        segs = [s for e in rsvc.shards
+                for s in e.searchable_segments()]
+        plane_res = rsvc.searcher().search(dict(body))
+        ref_res = ShardSearcher(segs, rsvc.mapper).search(dict(body))
+        assert [(h.doc_id, round(h.score, 6)) for h in plane_res.hits] \
+            == [(h.doc_id, round(h.score, 6)) for h in ref_res.hits]
+        assert rsvc.plane_cache.rebuild_stats().get("cold", 0) == 0
+        # handoff telemetry: transfer bytes + import wall time recorded
+        from elasticsearch_tpu.common import telemetry as _tm
+        snap = _tm.DEFAULT.metrics_doc()
+        assert "es_plane_handoff_ms" in snap
+        kinds = {s["labels"]["kind"]: s["value"] for s in
+                 snap["es_recovery_bytes_total"]["series"]}
+        assert kinds.get("plane", 0) > 0
+    finally:
+        stop_all(nodes)

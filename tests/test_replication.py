@@ -232,3 +232,72 @@ def test_deposed_primary_cannot_ack_writes(group):
         primary.index("zombie-2", {"body": "stale", "n": -2})
     # the promoted copy never saw the zombie writes
     assert "zombie-write" not in search_ids(r1.engine)
+
+
+def test_fence_under_concurrent_writes_never_acks_unreplicated(group):
+    """Satellite (chaos PR): promotion racing a writer on the stale
+    primary. Writer threads hammer the old primary while a replica is
+    promoted mid-stream; after the promotion fences the group, the old
+    primary must NEVER ack another write — every doc whose ack the
+    writer observed is present on the new primary, and every post-fence
+    attempt raises ReplicaFencedError."""
+    import threading
+
+    primary, r1, r2, _ = group
+    acked = []
+    fenced = []
+    stop = threading.Event()
+    start = threading.Barrier(3)
+
+    def writer(tag):
+        start.wait()
+        i = 0
+        while not stop.is_set():
+            doc = f"{tag}{i}"
+            try:
+                resp = primary.index(doc, {"body": "x", "n": i})
+                if not resp.failed:
+                    acked.append(doc)
+            except ReplicaFencedError:
+                fenced.append(doc)
+                return          # the group is deposed: no more writes
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in ("a", "b")]
+    for t in threads:
+        t.start()
+    start.wait()
+    import time as _t
+    _t.sleep(0.05)                 # let some writes through
+    new_primary = promote_to_primary(
+        r1, primary.engine.primary_term + 1)
+    _t.sleep(0.05)                 # racing writes now meet the fence
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+
+    # the old group is deposed and rejects everything from now on
+    assert primary.deposed
+    with pytest.raises(ReplicaFencedError):
+        primary.index("late", {"body": "x", "n": -1})
+    assert fenced, "no writer ever hit the fence (race never happened)"
+    # ZERO acked-write loss: every doc acked to a client exists on the
+    # new primary (it was an in-sync copy for every acked write)
+    new_ids = set(search_ids(new_primary.engine))
+    missing = [d for d in acked if d not in new_ids]
+    assert not missing, f"acked writes lost across promotion: {missing}"
+
+
+def test_stale_primary_direct_replica_call_is_fenced(group):
+    """A network-zombie old primary bypassing the group and calling the
+    replica channel directly is still rejected: the engine primary term
+    is the single fencing authority."""
+    primary, r1, r2, _ = group
+    primary.index("d0", {"body": "x", "n": 0})
+    promote_to_primary(r2, primary.engine.primary_term + 1)
+    with pytest.raises(ReplicaFencedError):
+        r2.apply_index(primary.engine.primary_term, 99, 1, "zombie",
+                       {"body": "z"}, None, 0)
+    # and the zombie's op is not visible on the promoted copy
+    assert "zombie" not in search_ids(r2.engine)
