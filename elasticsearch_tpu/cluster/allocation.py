@@ -356,19 +356,57 @@ class BalancedAllocator:
                             entry["primary"] = n
                             entry.pop("fresh", None)
                             placed += 1
+                            self._journal_verdict(index, sid, "placed",
+                                                  node=n, kind="primary")
                     continue                    # lost primary: red
                 missing = min(want_replicas, len(ctx.nodes) - 1) \
                     - len(entry.get("replicas", ()))
                 for _ in range(max(missing, 0)):
                     n = self.pick_node(index, sid, ctx)
                     if n is None:
-                        entry["failed_attempts"] = min(
-                            int(entry.get("failed_attempts", 0)) + 1,
-                            MAX_RETRIES)
+                        prev = int(entry.get("failed_attempts", 0))
+                        entry["failed_attempts"] = min(prev + 1,
+                                                       MAX_RETRIES)
+                        # journal only when the attempt count actually
+                        # TRANSITIONS to first-failure or exhaustion —
+                        # an allocation round runs every 0.5s, the
+                        # counter saturates at MAX_RETRIES, and a long
+                        # outage must not churn the ring with identical
+                        # verdicts (nor re-run every decider per node
+                        # per round just to rebuild the same reasons)
+                        if prev != entry["failed_attempts"] and \
+                                entry["failed_attempts"] in (1,
+                                                             MAX_RETRIES):
+                            self._journal_verdict(
+                                index, sid, "unplaceable", ctx=ctx,
+                                failed_attempts=entry["failed_attempts"])
                         break
                     entry.setdefault("replicas", []).append(n)
                     placed += 1
+                    self._journal_verdict(index, sid, "placed",
+                                          node=n, kind="replica")
         return placed
+
+    def _journal_verdict(self, index, sid, verdict, *, ctx=None,
+                         **attrs) -> None:
+        """Flight-recorder journal of one allocation verdict. For
+        ``unplaceable`` shards the per-node NO reasons ride along (the
+        allocation-explain view at the moment it mattered). Runs inside
+        a master state-update closure — a CAS retry may journal the same
+        placement twice; the journal is a record, not a ledger."""
+        from ..common import flightrec as _fr
+        if verdict == "unplaceable" and ctx is not None:
+            reasons = {}
+            for node in sorted(ctx.nodes):
+                v, decisions = decide(index, sid, node, ctx,
+                                      self.deciders)
+                if v != YES:
+                    reasons[node] = "; ".join(
+                        f"{d.decider}: {d.reason}" for d in decisions
+                        if d.verdict != YES)[:300]
+            attrs["reasons"] = reasons
+        _fr.record("alloc_verdict", index=index, shard=sid,
+                   verdict=verdict, **attrs)
 
     def plan_rebalance(self, ctx) -> List[dict]:
         """Staged moves from overweight to underweight nodes. Each move:

@@ -26,6 +26,19 @@ from .errors import CircuitBreakingError
 DEFAULT_BUDGET = 1 << 30
 
 
+def _journal_trip(breaker: str, label: str, wanted: int,
+                  limit: int) -> None:
+    """Flight-recorder journal of one breaker trip (lazy import: the
+    recorder depends on telemetry, which is built over this module)."""
+    try:
+        from . import flightrec
+        flightrec.record("breaker_trip", breaker=breaker,
+                         label=str(label)[:200], wanted_bytes=int(wanted),
+                         limit_bytes=int(limit))
+    except Exception:   # noqa: BLE001 — accounting only
+        pass
+
+
 def parse_bytes_or_pct(value, budget: int) -> int:
     s = str(value).strip()
     if s.endswith("%"):
@@ -56,13 +69,20 @@ class CircuitBreaker:
         add = int(nbytes * self.overhead)
         with self.lock:
             new = self.used + add
-            if new > self.limit:
+            tripped = new > self.limit
+            if tripped:
                 self.trip_count += 1
-                raise CircuitBreakingError(
-                    f"[{self.name}] Data too large, data for [{label}] "
-                    f"would be [{new}/{_h(new)}], which is larger than "
-                    f"the limit of [{self.limit}/{_h(self.limit)}]")
-            self.used = new
+            else:
+                self.used = new
+        if tripped:
+            # journal + raise OUTSIDE the breaker lock: a flight-recorder
+            # append must never run under a lock every allocating thread
+            # contends on
+            _journal_trip(self.name, label, new, self.limit)
+            raise CircuitBreakingError(
+                f"[{self.name}] Data too large, data for [{label}] "
+                f"would be [{new}/{_h(new)}], which is larger than "
+                f"the limit of [{self.limit}/{_h(self.limit)}]")
         if self.parent is not None:
             try:
                 self.parent.check(label)
@@ -130,6 +150,7 @@ class ParentBreaker:
         if total > self.limit:
             with self.lock:
                 self.trip_count += 1
+            _journal_trip("parent", label, total, self.limit)
             raise CircuitBreakingError(
                 f"[parent] Data too large, data for [{label}] would be "
                 f"[{total}/{_h(total)}], which is larger than the limit "

@@ -74,7 +74,8 @@ class HealthService:
     singletons are read directly."""
 
     INDICATORS = ("shards_availability", "plane_serving", "compile_churn",
-                  "breakers", "indexing_pressure", "task_backlog")
+                  "breakers", "indexing_pressure", "task_backlog",
+                  "slo_burn")
 
     #: sync non-cold rebuilds: first one turns yellow, a storm turns red
     SYNC_REBUILD_YELLOW = 1
@@ -443,6 +444,59 @@ class HealthService:
                 "indexing-pressure budget.",
                 "Reduce bulk concurrency/size or add indexing "
                 "capacity.")]
+        return doc
+
+    def _ind_slo_burn(self) -> dict:
+        """SLO burn-rate watchdog (``common/flightrec.py``): multi-window
+        burn over ``es_slo_burn_rate{window}`` — red means BOTH the fast
+        and slow windows burned past the threshold and an automatic
+        post-mortem capture fired (``GET /_flight_recorder/captures``);
+        yellow means one window is burning (onset, or the slow window
+        still draining through recovery)."""
+        from . import flightrec
+        wd = flightrec.get_watchdog()
+        if wd is None:
+            return {"status": GREEN,
+                    "symptom": "The SLO watchdog is disabled "
+                               "(ES_TPU_WATCHDOG=0).",
+                    "details": {"watchdog": "disabled"}}
+        st = wd.status_doc()
+        status = {flightrec.GREEN: GREEN, flightrec.YELLOW: YELLOW,
+                  flightrec.RED: RED}.get(st.get("status"), UNKNOWN)
+        rates = st.get("burn_rates") or {}
+        fast = (rates.get("fast") or {}).get("burn", 0.0)
+        slow = (rates.get("slow") or {}).get("burn", 0.0)
+        doc = {
+            "status": status,
+            "symptom": ("Error-budget burn is within the SLO."
+                        if status == GREEN else
+                        f"SLO burn rate fast={fast} slow={slow} "
+                        f"(red threshold {wd.engine.burn_red}); "
+                        f"{st.get('captures', 0)} post-mortem capture(s) "
+                        f"retained."),
+            "details": {"burn_rates": rates,
+                        "burn_red_threshold": wd.engine.burn_red,
+                        "latency_threshold_ms":
+                            wd.engine.latency_threshold_ms,
+                        "windows_s": {"fast": wd.engine.fast_s,
+                                      "slow": wd.engine.slow_s},
+                        "captures": st.get("captures", 0),
+                        "watchdog_running": st.get("running", False)},
+        }
+        if status not in (GREEN, UNKNOWN):
+            doc["impacts"] = [_impact(
+                "slo_burn:error_budget", 1 if status == RED else 2,
+                "Queries are breaching the latency/failure SLO fast "
+                "enough to exhaust the error budget; users are seeing "
+                "slow or failed searches now.", ["search"])]
+            doc["diagnosis"] = [_diagnosis(
+                "slo_burn:degradation",
+                "Sustained latency over the SLO threshold or elevated "
+                "search failover/retry rates across both burn windows.",
+                "Read the automatic capture (GET /_flight_recorder/"
+                "captures — hot threads, journal slice, batcher queue "
+                "depths taken AT the red transition) and watch "
+                "es_slo_burn_rate{window} + es_watchdog_captures_total.")]
         return doc
 
     def _ind_task_backlog(self) -> dict:

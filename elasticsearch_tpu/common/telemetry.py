@@ -316,6 +316,23 @@ class TelemetryRegistry:
 
     # -- exposition ----------------------------------------------------------
 
+    def family_values(self, name: str) -> List[Tuple[dict, float]]:
+        """[(labels, value)] for ONE registered counter/gauge family —
+        the cheap point read for pollers (the SLO watchdog samples two
+        counter families per tick; a full :meth:`metrics_doc` would
+        snapshot-sort every histogram ring in the registry each time).
+        Histogram families return their monotonic counts."""
+        name = _sanitize_name(name)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return []
+            series = [(dict(labels), m)
+                      for labels, m in fam["series"].values()]
+        return [(labels,
+                 float(m.count if isinstance(m, Histogram) else m.value))
+                for labels, m in series]
+
     def metrics_doc(self) -> dict:
         """JSON snapshot of the REGISTERED metrics only — no collector
         invocation (collectors may themselves read this snapshot, so the
@@ -685,15 +702,20 @@ def record_recovery_bytes(kind: str, n: int,
                 help="recovery bytes shipped per transfer kind").inc(n)
 
 
-def record_plane_handoff_ms(ms: float,
+def record_plane_handoff_ms(ms: float, exemplar: Optional[str] = None,
                             registry: Optional[TelemetryRegistry]
                             = None) -> None:
     """One completed warm plane handoff (chunked transfer + import +
-    generation swap) took ``ms`` end to end on the receiving node."""
+    generation swap) took ``ms`` end to end on the receiving node.
+    ``exemplar`` is the recovery trace id (the pull runs inside its own
+    root span), so a slow handoff on a scrape links straight to
+    ``GET /_trace/{id}`` — the PR 5 exemplar pattern."""
     reg = registry or DEFAULT
     reg.histogram("es_plane_handoff_ms",
                   help="warm plane handoff wall ms (transfer + import) "
-                       "on the receiving node").observe(float(ms))
+                       "on the receiving node (exemplars carry the "
+                       "recovery trace id)").observe(
+        float(ms), exemplar=exemplar)
 
 
 #: per-thread flag: did the LAST instrumented-step call on this thread

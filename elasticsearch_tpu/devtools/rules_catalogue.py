@@ -179,6 +179,17 @@ def runtime_families() -> Set[str]:
         racedep.ensure_collector()
         racedep.WITNESS.access(("lint-race-key", 0), write=True)
         racedep.WITNESS.access(("lint-race-key", 0), write=False)
+        # flight recorder + SLO watchdog: the searches above already
+        # journaled events (plane rebuilds); a thread-less watchdog
+        # instance ticks once (burn gauges + capture counter label
+        # space) and seeds one manual capture so es_flightrec_* /
+        # es_watchdog_* / es_slo_burn_rate register deterministically
+        from elasticsearch_tpu.common import flightrec
+        flightrec.record("lint_probe", source="telemetry-lint")
+        wd = flightrec.Watchdog()
+        wd.tick()
+        wd.capture("manual")
+        wd.close()
 
         snap = telemetry.DEFAULT.stats_doc()
         return {name for name in snap if name.startswith("es_")}

@@ -52,7 +52,11 @@ SERVING_LOCK_MODULES = re.compile(
 #: bookkeeping locks are telemetry-side by design)
 _NON_SERVING_ATTR = re.compile(r"metric")
 
-TELEMETRY_MODULES = re.compile(r"(^|\.)common\.(telemetry|tracing)$")
+#: flightrec counts as telemetry for L02: a flight-recorder journal
+#: write under a serving lock would back serving up behind the
+#: observability layer exactly like a registry write would
+TELEMETRY_MODULES = re.compile(
+    r"(^|\.)common\.(telemetry|tracing|flightrec)$")
 
 _LOCK_CTORS = {"Lock", "RLock"}
 

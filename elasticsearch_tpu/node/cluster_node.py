@@ -650,6 +650,10 @@ class ClusterNode:
                             "field": bundle["field"],
                             "n_chunks": len(chunks),
                             "nbytes": len(blob)})
+        from ..common import flightrec as _fr
+        _fr.record("handoff_manifest", node=self.node_id, index=name,
+                   to=src, bundles=len(entries),
+                   nbytes=sum(e["nbytes"] for e in entries))
         return {"bundles": entries}
 
     def _h_recovery_plane_chunk(self, src, payload):
@@ -721,43 +725,68 @@ class ClusterNode:
         replays the op log while recovery is already running). Returns
         bundles imported (0 → every bundle fell back to the repack
         path)."""
+        from ..common import flightrec as _fr
         from ..common import telemetry as _tm
+        from ..common import tracing as _tracing
         from ..common.datacodec import loads_b64
         from ..common.retry import retry_with_backoff
         t0 = time.perf_counter()
-        man = self.rpc(donor, "recovery:plane_manifest", {"index": name},
-                       timeout=TIMEOUTS.meta)
-        imported = 0
-        deadline = time.monotonic() + import_deadline
-        for entry in man.get("bundles", ()):
-            parts: List[Optional[str]] = [None] * int(entry["n_chunks"])
-            for i in range(len(parts)):
-                parts[i] = retry_with_backoff(
-                    lambda i=i: self.rpc(
-                        donor, "recovery:plane_chunk",
-                        {"xfer_id": entry["xfer_id"], "chunk": i},
-                        timeout=TIMEOUTS.meta)["data"])
-                _tm.record_recovery_bytes("plane", len(parts[i]))
-            blob = "".join(parts)
-            # release the donor's prepared export immediately (fire and
-            # forget; the TTL sweep is the backstop for a lost ack)
-            try:
-                self.rpc(donor, "recovery:plane_done",
-                         {"xfer_id": entry["xfer_id"]},
-                         timeout=TIMEOUTS.fast)
-            except Exception:   # noqa: BLE001
-                pass
-            bundle = loads_b64(blob)
-            while not self.stopped:
-                if self._import_plane_bundle(name, bundle):
-                    imported += 1
-                    break
-                if time.monotonic() >= deadline:
-                    break
-                time.sleep(0.25)
-        if imported:
-            _tm.record_plane_handoff_ms(
-                (time.perf_counter() - t0) * 1e3)
+        # the whole pull runs inside its own recovery trace: journal
+        # events carry its trace id, and es_plane_handoff_ms keeps it as
+        # an exemplar — a slow handoff on a scrape links straight to
+        # GET /_trace/{id} (the PR 5 exemplar pattern)
+        with _tracing.span(f"recovery[plane_handoff:{name}]",
+                           node=self.node_id, root=True,
+                           attrs={"index": name, "donor": donor}) as sp:
+            man = self.rpc(donor, "recovery:plane_manifest",
+                           {"index": name}, timeout=TIMEOUTS.meta)
+            imported = 0
+            deadline = time.monotonic() + import_deadline
+            for entry in man.get("bundles", ()):
+                parts: List[Optional[str]] = [None] * int(entry["n_chunks"])
+                for i in range(len(parts)):
+                    parts[i] = retry_with_backoff(
+                        lambda i=i: self.rpc(
+                            donor, "recovery:plane_chunk",
+                            {"xfer_id": entry["xfer_id"], "chunk": i},
+                            timeout=TIMEOUTS.meta)["data"])
+                    _tm.record_recovery_bytes("plane", len(parts[i]))
+                    # journal chunk MILESTONES (first, every 64th,
+                    # last), not every chunk: a multi-GB plane is
+                    # thousands of 4 MiB chunks, and per-chunk events
+                    # would evict the failure window this journal
+                    # exists to preserve from the bounded ring
+                    if i == 0 or i == len(parts) - 1 or i % 64 == 0:
+                        _fr.record("handoff_chunk", node=self.node_id,
+                                   index=name, donor=donor,
+                                   kind=entry.get("kind"), chunk=i,
+                                   n_chunks=len(parts),
+                                   nbytes=len(parts[i]))
+                blob = "".join(parts)
+                # release the donor's prepared export immediately (fire
+                # and forget; the TTL sweep backstops a lost ack)
+                try:
+                    self.rpc(donor, "recovery:plane_done",
+                             {"xfer_id": entry["xfer_id"]},
+                             timeout=TIMEOUTS.fast)
+                except Exception:   # noqa: BLE001
+                    pass
+                bundle = loads_b64(blob)
+                while not self.stopped:
+                    if self._import_plane_bundle(name, bundle):
+                        imported += 1
+                        break
+                    if time.monotonic() >= deadline:
+                        break
+                    time.sleep(0.25)
+            handoff_ms = (time.perf_counter() - t0) * 1e3
+            if imported:
+                _tm.record_plane_handoff_ms(handoff_ms,
+                                            exemplar=sp.trace_id)
+            _fr.record("handoff_done", node=self.node_id, index=name,
+                       donor=donor, imported=imported,
+                       bundles=len(man.get("bundles", ())),
+                       ms=round(handoff_ms, 3))
         return imported
 
     def _import_plane_bundle(self, name: str, bundle: dict) -> bool:
@@ -1084,8 +1113,11 @@ class ClusterNode:
             if entry["primary"] in dead and
             any(r not in dead for r in entry["replicas"]))
         if promotions:
+            from ..common import flightrec as _fr
             from ..common import telemetry as _tm
             _tm.record_shard_failover(promotions)
+            _fr.record("shard_failover", node=self.node_id,
+                       dead=sorted(dead), promotions=promotions)
 
         def update(st: ClusterState) -> ClusterState:
             new = st.updated()
@@ -1256,6 +1288,7 @@ class ClusterNode:
         ``on_exhausted(sid, node, exc)`` fires per shard whose every
         copy failed. Returns [(ctx, result)] for the groups that
         answered."""
+        from ..common import flightrec as _fr
         from ..common import telemetry as _tm
         results: List[tuple] = []
         queue = [(node, shards, ctx, frozenset())
@@ -1274,9 +1307,19 @@ class ClusterNode:
                                     if c not in tried2), None)
                         if nxt is None:
                             _tm.record_search_retry("exhausted")
+                            _fr.record("copy_exhausted",
+                                       node=self.node_id, failed=node_id,
+                                       shard=sid,
+                                       error=type(e).__name__)
                             on_exhausted(sid, node_id, e)
                         else:
                             regroup.setdefault(nxt, []).append(sid)
+                    _fr.record("failover_wave", node=self.node_id,
+                               failed=node_id, shards=list(shards),
+                               wave=len(tried2),
+                               rerouted={n: regroup[n]
+                                         for n in sorted(regroup)},
+                               error=type(e).__name__)
                     for n2 in sorted(regroup):
                         next_wave.append((n2, regroup[n2], ctx, tried2))
                     continue

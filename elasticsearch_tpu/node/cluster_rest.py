@@ -726,9 +726,13 @@ class ClusterRestService:
             return out
         except RemoteTransportError as e:
             status, payload = _error_payload(_remote_error(e))
+            # error replies echo a Trace-Id too (adopted or minted) — the
+            # 4xx/5xx paths flow through the same out-param as success
+            self.api._stamp_trace_echo(resp_headers, headers)
             return status, JSON_CT, json.dumps(payload).encode()
         except Exception as e:   # noqa: BLE001 — ES-shaped error replies
             status, payload = _error_payload(e)
+            self.api._stamp_trace_echo(resp_headers, headers)
             return status, JSON_CT, json.dumps(payload).encode()
 
     def _dispatch(self, method, path, query, body):
@@ -747,6 +751,8 @@ class ClusterRestService:
             return self._tasks_route(method, path, query, body)
         if path.startswith("/_health_report"):
             return self._health_report(method, path, query, body)
+        if path.startswith("/_flight_recorder"):
+            return self._flight_recorder(method, path, query, body, segs)
         if segs and segs[0] == "_nodes" and segs[-1] == "hot_threads":
             return self._hot_threads(method, path, query, body, segs)
         if method == "GET" and segs and (
@@ -2002,29 +2008,18 @@ class ClusterRestService:
                 "indicators" not in local_doc:
             return status, ct, out
         docs = {self.node.node_id: local_doc}
-
-        def fetch_one(n):
-            r = self.node.rpc(n, "rest:exec", {
-                "m": method, "p": path, "q": query, "b": _b64(body)},
-                timeout=TIMEOUTS.data)
-            if r["status"] == 200:
-                return n, json.loads(_unb64(r["out"]))
-            return n, None
-
-        # concurrent fan-out: the "is this node healthy" endpoint must
-        # not serialize per-node timeouts — one dead peer costs one
-        # timeout window total, not one per peer
+        # concurrent fan-out (shared rest:exec helper): the "is this
+        # node healthy" endpoint must not serialize per-node timeouts —
+        # one dead peer costs one timeout window total, not one per peer
         peers = [n for n in self.node.node_ids if n != self.node.node_id]
-        if peers:
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(max_workers=len(peers)) as pool:
-                for fut in [pool.submit(fetch_one, n) for n in peers]:
-                    try:
-                        n, doc_n = fut.result()
-                    except Exception:   # noqa: BLE001 — a dead node
-                        continue        # reports nothing; availability
-                    if doc_n:           # covers it below
-                        docs[n] = doc_n
+        for n, (st_n, payload) in self._fanout_rest_exec(
+                method, path, query, body, peers).items():
+            if st_n != 200:
+                continue            # a dead/degraded node reports
+            try:                    # nothing; availability covers it
+                docs[n] = json.loads(payload)
+            except ValueError:
+                continue
         from ..common.health import GREEN, merge_reports, worst_status
         merged = merge_reports(local_doc, docs)
         nodes = sorted(st.nodes)
@@ -2045,6 +2040,120 @@ class ClusterRestService:
                     f"{'s' if unassigned != 1 else ''}.")
             merged["status"] = worst_status(
                 d["status"] for d in merged["indicators"].values())
+        return 200, "application/json", json.dumps(merged).encode()
+
+    def _fanout_rest_exec(self, method, path, query, body, targets,
+                          timeout=None):
+        """The ONE concurrent rest:exec fan-out every cluster-merge view
+        shares (health report, hot threads, flight recorder): fetch
+        ``(status, bytes)`` from every target at once, so dead peers
+        cost one timeout window TOTAL, not one per peer. Peers that
+        error are absent from the result."""
+        out: Dict[str, tuple] = {}
+        if not targets:
+            return out
+
+        def fetch_one(n):
+            r = self.node.rpc(n, "rest:exec", {
+                "m": method, "p": path, "q": query, "b": _b64(body)},
+                timeout=timeout if timeout is not None else TIMEOUTS.data)
+            return n, r["status"], _unb64(r["out"])
+
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=len(targets)) as pool:
+            for fut in [pool.submit(fetch_one, n) for n in targets]:
+                try:
+                    n, st, payload = fut.result()
+                except Exception:   # noqa: BLE001 — a dead node
+                    continue        # contributes nothing
+                out[n] = (st, payload)
+        return out
+
+    def _flight_recorder(self, method, path, query, body, segs):
+        """Cluster ``GET /_flight_recorder[...]``: every node answers
+        from its local ring/capture store over ``rest:exec`` (the
+        health-report fan-in pattern — concurrent, one timeout window
+        total for dead peers) and the front merges. Events dedupe by
+        their process-unique ``seq`` (in-process test clusters share one
+        ring; production processes contribute disjoint events), sort by
+        wall time, and re-apply the request's ``limit`` after the merge;
+        captures dedupe by id. A capture fetched by id returns from
+        whichever node holds it."""
+        status, ct, out = self._local(method, path, query, body)
+        peers = [n for n in self.node.node_ids if n != self.node.node_id]
+        if not peers or method != "GET":
+            return status, ct, out
+
+        # capture-by-id: serve the first hit (local already checked;
+        # peers probed concurrently — this endpoint matters most when
+        # nodes are dead, so serial per-peer timeouts are unacceptable)
+        if len(segs) == 3 and segs[1] == "captures":
+            if status == 200:
+                return status, ct, out
+            for st_n, payload in self._fanout_rest_exec(
+                    method, path, query, body, peers).values():
+                if st_n == 200:
+                    return 200, "application/json", payload
+            return status, ct, out
+        if status != 200:
+            return status, ct, out
+        try:
+            local_doc = json.loads(out)
+        except ValueError:
+            return status, ct, out
+        docs = [local_doc]
+        for st_n, payload in self._fanout_rest_exec(
+                method, path, query, body, peers).values():
+            if st_n != 200:
+                continue
+            try:
+                doc_n = json.loads(payload)
+            except ValueError:
+                continue
+            if isinstance(doc_n, dict):
+                docs.append(doc_n)
+        if len(segs) == 2 and segs[1] == "captures":
+            seen_caps = set()
+            caps = []
+            for d in docs:
+                for c in d.get("captures", ()):
+                    if c.get("id") in seen_caps:
+                        continue
+                    seen_caps.add(c.get("id"))
+                    caps.append(c)
+            caps.sort(key=lambda c: c.get("ts_ms", 0))
+            merged = dict(local_doc, captures=caps)
+            return 200, "application/json", json.dumps(merged).encode()
+        seen_ev = set()
+        events = []
+        for d in docs:
+            for ev in d.get("events", ()):
+                # node joins the key: separate production processes
+                # restart their seq counters, and two nodes' seq-N
+                # events in the same millisecond must not conflate —
+                # in-process clusters (shared ring, same node stamp
+                # per event) still dedupe exactly
+                key = (ev.get("seq"), ev.get("ts_ms"), ev.get("type"),
+                       ev.get("node"))
+                if key in seen_ev:
+                    continue
+                seen_ev.add(key)
+                events.append(ev)
+        events.sort(key=lambda ev: (ev.get("ts_ms", 0),
+                                    ev.get("seq", 0)))
+        # re-apply the request's limit AFTER the merge (each node
+        # already truncated to its newest `limit`; without this the
+        # client would receive up to n_nodes x limit events) — keep the
+        # cluster-wide NEWEST slice
+        from urllib.parse import parse_qs
+        try:
+            limit = int((parse_qs(query).get("limit") or [256])[-1])
+        except ValueError:
+            limit = 256
+        if limit > 0:
+            events = events[-limit:]
+        merged = dict(local_doc, events=events,
+                      nodes_reporting=len(docs))
         return 200, "application/json", json.dumps(merged).encode()
 
     def _hot_threads(self, method, path, query, body, segs):
@@ -2072,31 +2181,38 @@ class ClusterRestService:
 
         bare = "/_nodes/hot_threads"      # target already selected
 
-        def sample_one(nid):
-            if nid == self.node.node_id:
-                return self._local(method, bare, query, body)
-            r = self.node.rpc(nid, "rest:exec", {
-                "m": method, "p": bare, "q": query,
-                "b": _b64(body)}, timeout=30.0)
-            return r["status"], None, _unb64(r["out"])
-
-        # concurrent sampling: each node's sampler runs a wall-clock
-        # snapshot window — serialized, a 3-node default request would
-        # take 3× the interval plus any dead-node timeout
+        # concurrent sampling (shared rest:exec helper): each node's
+        # sampler runs a wall-clock snapshot window — serialized, a
+        # 3-node default request would take 3× the interval plus any
+        # dead-node timeout
         targets = [nid for nid in sorted(self.node.node_ids)
                    if selected(nid)]
+        results: Dict[str, tuple] = {}
+        lt = None
+        if self.node.node_id in targets:
+            targets.remove(self.node.node_id)
+
+            def _local_sample():
+                try:
+                    st, _ct, out = self._local(method, bare, query, body)
+                    results[self.node.node_id] = (st, out)
+                except Exception:   # noqa: BLE001 — sample nothing
+                    pass
+
+            # the local sampler's wall-clock window runs CONCURRENTLY
+            # with the remote fan-out, like any other node's
+            lt = threading.Thread(target=_local_sample)
+            lt.start()
+        remote = self._fanout_rest_exec(
+            method, bare, query, body, targets, timeout=30.0)
+        if lt is not None:
+            lt.join()
+        results.update(remote)
         blocks: List[str] = []
-        if targets:
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(max_workers=len(targets)) as pool:
-                for fut in [pool.submit(sample_one, n) for n in targets]:
-                    try:
-                        st, _ct, out = fut.result()
-                    except Exception:   # noqa: BLE001 — dead nodes
-                        continue        # sample nothing
-                    if st == 200 and out:
-                        blocks.append(
-                            out.decode(errors="replace").rstrip("\n"))
+        for nid in sorted(results):
+            st, out = results[nid]
+            if st == 200 and out:
+                blocks.append(out.decode(errors="replace").rstrip("\n"))
         return (200, "text/plain; charset=UTF-8",
                 ("\n".join(blocks) + "\n").encode())
 

@@ -454,7 +454,8 @@ class IndexService:
             return None
 
     def _slowlog_record(self, kind: str, took_s: float,
-                        detail: str, stages: Optional[dict] = None) -> None:
+                        detail: str, stages: Optional[dict] = None,
+                        planner: Optional[dict] = None) -> None:
         worst = None
         for level in ("warn", "info", "debug", "trace"):
             thr = self._slowlog_threshold(kind, level)
@@ -481,6 +482,12 @@ class IndexService:
             entry["serving_stages"] = {
                 s: (round(ms, 3) if isinstance(ms, (int, float)) else ms)
                 for s, ms in stages.items()}
+        if planner:
+            # one-dispatch planner context (PR 11's fused route): which
+            # route served (fused vs fallback), the host-side lowering
+            # cost, and the stages folded into the dispatch — a slow
+            # fused query is bisectable from its slow-log line alone
+            entry["planner"] = planner
         from .task_manager import current_resources
         res = current_resources()
         if res is not None:
@@ -514,12 +521,17 @@ class IndexService:
             # SLO latency family: each sample may carry its trace id as
             # an OpenMetrics exemplar, so a p99 breach on the scrape
             # links straight to GET /_trace/{id} (O(1) on this path)
+            took_ms = (time.perf_counter() - t0) * 1e3
             _tm.DEFAULT.histogram(
                 "es_query_latency_ms", {"index": self.name},
                 help="per-index shard-phase query latency ms "
                      "(exemplars carry trace ids)").observe(
-                (time.perf_counter() - t0) * 1e3,
-                exemplar=_tracing.current_trace_id())
+                took_ms, exemplar=_tracing.current_trace_id())
+            # the same sample feeds the SLO burn-rate engine (one locked
+            # per-second bucket update — the watchdog evaluates windows
+            # off this path)
+            from ..common import flightrec as _fr
+            _fr.observe_query_latency(took_ms)
             return r
 
     def _search_traced(self, body: Optional[dict],
@@ -531,7 +543,10 @@ class IndexService:
                                           request_cache=request_cache)
             if r is not None:
                 self._slowlog_record("query", time.perf_counter() - t0,
-                                     str(body or {})[:1000])
+                                     str(body or {})[:1000],
+                                     stages=getattr(r, "serving_stages",
+                                                    None),
+                                     planner=getattr(r, "planner", None))
                 return r
         key = self._request_cache_key(body or {}, request_cache)
         plane_key = None
@@ -560,7 +575,8 @@ class IndexService:
             self.cache_put(plane_key, _copy_shard_result(r))
         self._slowlog_record("query", time.perf_counter() - t0,
                              str(body or {})[:1000],
-                             stages=getattr(r, "serving_stages", None))
+                             stages=getattr(r, "serving_stages", None),
+                             planner=getattr(r, "planner", None))
         return r
 
     def count(self, body: Optional[dict] = None) -> int:

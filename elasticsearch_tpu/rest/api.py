@@ -366,6 +366,12 @@ class RestAPI:
         from ..common import telemetry as _telemetry
         _telemetry.DEFAULT.register_object_collector(
             f"node:{self.node_id}", self, _node_telemetry_families)
+        # flight recorder: this node's serving surfaces are capture-able
+        # (weakref — a retired test node never pins itself) and the
+        # process SLO watchdog runs whenever any node does
+        from ..common import flightrec as _flightrec
+        _flightrec.register_node(self)
+        _flightrec.ensure_watchdog()
         self.stored_scripts: Dict[str, dict] = {}
         self.ingest = IngestService()
         self.snapshots = SnapshotsService(indices)
@@ -652,6 +658,10 @@ class RestAPI:
         add("GET", "/_prometheus/metrics", self.h_prometheus)
         add("GET", "/_trace", self.h_trace_list)
         add("GET", "/_trace/{trace_id}", self.h_trace_get)
+        add("GET", "/_flight_recorder", self.h_flight_recorder)
+        add("GET", "/_flight_recorder/captures", self.h_flight_captures)
+        add("GET", "/_flight_recorder/captures/{capture_id}",
+            self.h_flight_capture_get)
         add("GET", "/_health_report", self.h_health_report)
         add("GET", "/_health_report/{indicator}", self.h_health_report)
         add("GET", "/_nodes/stats", self.h_nodes_stats)
@@ -888,18 +898,12 @@ class RestAPI:
                     payload = {"error": {"type": e.error_type,
                                          "reason": str(e)},
                                "status": e.status}
+                    self._stamp_trace_echo(resp_headers, headers)
                     return (e.status, JSON_CT,
                             json.dumps(payload).encode())
         status, out_ct, payload = self._handle_json(
             method, path, query, body, headers)
-        if resp_headers is not None:
-            info = getattr(self._trace_tls, "value", None)
-            if info:
-                tid, opaque = info
-                if tid:
-                    resp_headers["Trace-Id"] = tid
-                if opaque:
-                    resp_headers["X-Opaque-Id"] = opaque
+        self._stamp_trace_echo(resp_headers, headers)
         if accept and payload:
             from ..common.xcontent import (UnsupportedContentType,
                                            encode_response)
@@ -911,6 +915,30 @@ class RestAPI:
                                  "reason": str(e)}, "status": e.status}
                 return e.status, JSON_CT, json.dumps(err).encode()
         return status, out_ct, payload
+
+    def _stamp_trace_echo(self, resp_headers: Optional[dict],
+                          headers: Optional[dict]) -> None:
+        """Echo ``Trace-Id``/``X-Opaque-Id`` into the response out-param.
+        Error paths that never entered a traced span (unknown-route
+        400/405, security 401/403, content-type 415) still echo: the
+        incoming trace id is adopted — or a fresh one minted — so EVERY
+        response, success or failure, is correlatable (the 4xx/5xx
+        regression the flight-recorder PR closed)."""
+        if resp_headers is None:
+            return
+        info = getattr(self._trace_tls, "value", None)
+        if not info or not info[0]:
+            from ..common import tracing as _tracing
+            tid, _parent = _tracing.parse_incoming(headers)
+            hmap = {str(k).lower(): v for k, v in (headers or {}).items()}
+            info = (tid or _tracing.new_trace_id(),
+                    (info[1] if info else None) or hmap.get("x-opaque-id"))
+            self._trace_tls.value = info
+        tid, opaque = info
+        if tid:
+            resp_headers["Trace-Id"] = tid
+        if opaque:
+            resp_headers["X-Opaque-Id"] = opaque
 
     def _handle_json(self, method: str, path: str, query: str,
                      body: bytes,
@@ -1002,6 +1030,11 @@ class RestAPI:
                     from ..node.task_manager import (bind_resources,
                                                      unbind_resources)
                     _res_token = bind_resources(task.resources)
+                    # flight-recorder ambient context: journal events on
+                    # this request's path stamp node + task id
+                    from ..common import flightrec as _flightrec
+                    _fr_token = _flightrec.bind_ambient(
+                        node=self.node_id, task=f"{task.node}:{task.id}")
                     task.resources.cpu_mark()
                     try:
                         result = fn(params, body, **kwargs)
@@ -1012,6 +1045,7 @@ class RestAPI:
                             json.dumps(payload).encode()
                     finally:
                         task.resources.cpu_release()
+                        _flightrec.reset_ambient(_fr_token)
                         unbind_resources(_res_token)
                         self._req_task.task = None
                         if task.running and \
@@ -1766,6 +1800,16 @@ class RestAPI:
                     self.cluster_settings[scope].pop(k, None)
                 else:
                     self.cluster_settings[scope][k] = v
+        if any(k.startswith(("slo.", "flightrec."))
+               for scope in ("persistent", "transient")
+               for k in (b0.get(scope) or {})):
+            # dynamic SLO-watchdog / flight-recorder knobs: re-resolve
+            # the live engine from the effective overlay (transient
+            # wins over persistent, env overrides win over both)
+            from ..common import flightrec as _flightrec
+            _flightrec.apply_cluster_settings({
+                **self.cluster_settings["persistent"],
+                **self.cluster_settings["transient"]})
         return {"acknowledged": True,
                 "persistent": self.cluster_settings["persistent"],
                 "transient": self.cluster_settings["transient"]}
@@ -2026,6 +2070,57 @@ class RestAPI:
                 f"ring of {DEFAULT_STORE.MAX_TRACES} traces; GET /_trace "
                 f"lists the ids still retained)")
         return doc
+
+    def h_flight_recorder(self, params, body):
+        """GET /_flight_recorder: the node's bounded event journal
+        (``common/flightrec.py``) with ``type`` (comma list), ``since``
+        (epoch ms, or a relative time value like ``30s`` meaning "the
+        last 30s"), ``trace_id`` and ``limit`` filters. The cluster
+        front fans this out per node and merges (``node/cluster_rest``)."""
+        from ..common import flightrec
+        since_ms = None
+        raw = params.get("since")
+        if raw:
+            try:
+                since_ms = float(raw)
+            except ValueError:
+                from ..common.settings import parse_time_millis
+                since_ms = time.time() * 1e3 - parse_time_millis(raw)
+        try:
+            limit = int(params.get("limit", 256))
+        except ValueError:
+            raise IllegalArgumentError(
+                f"[limit] must be an integer, got [{params.get('limit')}]")
+        doc = {"events": flightrec.DEFAULT.events(
+                   type_=params.get("type"), since_ms=since_ms,
+                   trace_id=params.get("trace_id"), limit=limit),
+               "journal": flightrec.DEFAULT.stats_doc()}
+        wd = flightrec.get_watchdog()
+        if wd is not None:
+            doc["watchdog"] = wd.status_doc()
+        return doc
+
+    def h_flight_captures(self, params, body):
+        """GET /_flight_recorder/captures: the watchdog's bounded
+        post-mortem capture store (summaries; fetch one by id for the
+        full hot-threads/telemetry/journal payload)."""
+        from ..common import flightrec
+        wd = flightrec.get_watchdog()
+        doc = {"captures": wd.captures() if wd is not None else []}
+        if wd is not None:
+            doc["watchdog"] = wd.status_doc()
+        return doc
+
+    def h_flight_capture_get(self, params, body, capture_id):
+        from ..common import flightrec
+        wd = flightrec.get_watchdog()
+        cap = wd.get_capture(capture_id) if wd is not None else None
+        if cap is None:
+            raise ResourceNotFoundError(
+                f"capture [{capture_id}] is not in the bounded capture "
+                f"store; GET /_flight_recorder/captures lists the ids "
+                f"still retained")
+        return cap
 
     def h_health_report(self, params, body, indicator=None):
         """GET /_health_report[/{indicator}] (reference: the 8.x health

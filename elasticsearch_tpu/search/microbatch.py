@@ -406,6 +406,20 @@ class PlaneMicroBatcher:
             batch_info["delta_ms"] = round(delta_ms, 3)
             batch_info["delta_docs"] = int(
                 plane_stages.get("delta_docs", 0))
+        # flight-recorder slow-dispatch journal: a dispatch whose device
+        # pipeline (prep + dispatch + base fetch) ran past the settings-
+        # driven threshold leaves a durable event. Emitted OUTSIDE the
+        # batcher lock (ESTP-L02: no recorder write under a serving lock)
+        from ..common import flightrec as _fr
+        slow_ms = prep_ms + dispatch_ms + fetch_base_ms
+        if err is None and slow_ms > _fr.slow_dispatch_threshold_ms():
+            _fr.record(
+                "slow_dispatch", plane=type(self.plane).__name__,
+                batch_size=len(batch), k_bucket=k,
+                prep_ms=round(prep_ms, 3),
+                dispatch_ms=round(dispatch_ms, 3),
+                fetch_ms=round(fetch_base_ms, 3),
+                compile_cache=batch_info.get("compile_cache"))
         with self._cond:
             racedep.note_write("microbatch.stats", self)
             fetch_ms = fetch_base_ms + \
@@ -514,6 +528,13 @@ class PlaneMicroBatcher:
             b <<= 1
 
     # -- stats --------------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        """Slots waiting for a dispatch right now (watchdog captures
+        snapshot this per batcher — a deep queue at capture time names
+        the convoy)."""
+        with self._cond:
+            return len(self._queue)
 
     def stats_doc(self) -> Dict[str, int]:
         """Aggregate serving stats (nodes stats ``plane_serving``)."""

@@ -713,6 +713,11 @@ class ServingPlaneCache:
                 c = self._rebuild_counts[(kind, trigger, mode)] = \
                     _tm.Counter()
         c.inc()
+        # flight-recorder journal: every generation install (cold pack,
+        # threshold/structural repack, warm-handoff import) is a durable
+        # event — emitted outside every cache lock (ESTP-L02)
+        from ..common import flightrec as _fr
+        _fr.record("plane_rebuild", kind=kind, trigger=trigger, mode=mode)
 
     def _record_delta_serve(self, kind: str, n: int) -> None:
         from ..common import telemetry as _tm
@@ -931,8 +936,11 @@ class ServingPlaneCache:
                     self._build_knn_generation(segments, mapper, field,
                                                trigger=trigger,
                                                mode="background")
-                self._swap_ms[kind].observe(
-                    (time.perf_counter() - t0) * 1e3)
+                swap_ms = (time.perf_counter() - t0) * 1e3
+                self._swap_ms[kind].observe(swap_ms)
+                from ..common import flightrec as _fr
+                _fr.record("plane_swap", kind=kind, field=field,
+                           trigger=trigger, ms=round(swap_ms, 3))
             except Exception:   # noqa: BLE001 — a failed repack must
                 pass            # never take down serving; retried later
             finally:

@@ -139,6 +139,11 @@ class ShardSearchResult:
     #: the per-segment path served. Slow-log entries carry this so a slow
     #: query is attributable to a stage.
     serving_stages: Optional[Dict[str, float]] = None
+    #: one-dispatch planner verdict for this request ({outcome,
+    #: lower_ms, stages_per_dispatch}); None when the planner was never
+    #: consulted. Slow-log entries carry this so a slow fused query
+    #: names its route without re-running with profile:true.
+    planner: Optional[dict] = None
 
 
 def _knn_score_transform(similarity: str, sim):
@@ -499,6 +504,20 @@ class ShardSearcher:
                 _tm.record_planner(
                     "fused" if fused_result is not None
                     else "fallback")
+        # the planner's verdict + lowering cost, shared by the Profile
+        # API section below and the slow-log entry (ShardSearchResult.
+        # planner): a slow fused dispatch is bisectable from its
+        # slow-log line alone
+        planner_doc = None
+        if planner_consulted:
+            planner_doc = {
+                "outcome": ("fused" if fused_result is not None
+                            else "fallback"),
+                "lower_ms": round(fused_plan.lower_ms, 3)
+                if fused_plan is not None else None,
+                "stages_per_dispatch": fused_plan.n_stages()
+                if fused_plan is not None else None,
+            }
 
         # --- query phase (device) -----------------------------------------
         pending = []
@@ -882,23 +901,15 @@ class ShardSearcher:
                     "stages_ms": {s: round(ms, 3)
                                   for s, ms in serving_stages.items()},
                     **(serving_info or {})}
-            if planner_consulted:
+            if planner_doc is not None:
                 # the one-dispatch planner's verdict + lowering cost:
                 # operators bisecting a fused-path regression see which
                 # route served and what the compile step of the request
                 # (host-side lowering) cost
-                shard_prof["planner"] = {
-                    "outcome": ("fused" if fused_result is not None
-                                else "fallback"),
-                    "lower_ms": round(fused_plan.lower_ms, 3)
-                    if fused_plan is not None else None,
-                    "stages_per_dispatch": fused_plan.n_stages()
-                    if fused_plan is not None else None,
-                }
+                shard_prof["planner"] = planner_doc
                 if serving_stages is not None and \
                         fused_result is not None:
-                    shard_prof["serving"]["planner"] = \
-                        shard_prof["planner"]
+                    shard_prof["serving"]["planner"] = planner_doc
             profile_out = {"shards": [shard_prof]}
 
         return ShardSearchResult(total=total, total_relation=total_relation,
@@ -906,7 +917,8 @@ class ShardSearcher:
                                  aggregations=agg_results,
                                  agg_inputs=agg_inputs,
                                  profile=profile_out, suggest=suggest_out,
-                                 serving_stages=serving_stages or None)
+                                 serving_stages=serving_stages or None,
+                                 planner=planner_doc)
 
     def _attach_nested_inner_hits(self, hits: List[ShardHit],
                                   ih_specs: List[dict]) -> None:

@@ -53,6 +53,18 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# SLO watchdog knobs, bench-scale: production windows (1m/10m) would
+# outlast the whole bench, so the kill is judged over 2s/8s windows
+# with a 100ms tick — the watchdog must go red INSIDE the failure
+# window and clear after the cluster heals (both gated below). Set
+# before the package imports so the process engine resolves them.
+os.environ.setdefault("ES_TPU_SLO_FAST_S", "2")
+os.environ.setdefault("ES_TPU_SLO_SLOW_S", "8")
+os.environ.setdefault("ES_TPU_SLO_BURN_RED", "2")
+os.environ.setdefault("ES_TPU_SLO_FAILURE_BUDGET", "0.005")
+os.environ.setdefault("ES_TPU_SLO_LATENCY_MS", "2000")
+os.environ.setdefault("ES_TPU_WATCHDOG_TICK_S", "0.1")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
@@ -133,7 +145,7 @@ def main(argv=None):
         data_ids = sorted(set(nodes) - {leader.node_id})
         front, victim_id = nodes[data_ids[0]], data_ids[1]
         log(f"leader={leader.node_id} front={front.node_id} "
-            f"victim={victim_id}")
+            f"victim={victim_id} (roles re-checked after allocation)")
 
         # -- build ---------------------------------------------------------
         body = json.dumps({
@@ -163,6 +175,24 @@ def main(argv=None):
                 set(e.get("in_sync") or ()) >= set(e["replicas"])
                 for e in t.values())
         wait_for(in_sync, 30.0, "replicas in sync")
+
+        # role re-check: the VICTIM must own at least one primary, so
+        # the kill forces a real routing-table promotion (the
+        # shard_failover journal event + es_shard_failovers_total the
+        # reconstruction gate reads); the survivor is the front/donor
+        table0 = (front.applied_state.data.get("routing", {})
+                  or {}).get("chaos") or {}
+        prim_count = {n: sum(1 for e in table0.values()
+                             if e.get("primary") == n)
+                      for n in data_ids}
+        if prim_count.get(victim_id, 0) == 0:
+            front, victim_id = nodes[victim_id], front.node_id
+            front.rest.indices.indices["chaos"].plane_cache \
+                .lex_prune_min_docs = TIER_MIN_DOCS
+            front.rest.indices.indices["chaos"].plane_cache \
+                .knn_ivf_min_docs = TIER_MIN_DOCS
+        log(f"roles: front={front.node_id} victim={victim_id} "
+            f"primaries={prim_count}")
 
         rng = np.random.RandomState(SEED)
         vocab = [f"w{i}" for i in range(VOCAB_N)]
@@ -230,6 +260,22 @@ def main(argv=None):
                         (t1, ok, (time.monotonic() - t1) * 1e3))
                 time.sleep(0.01)
 
+        def witness_client():
+            # journal witness: searches coordinated by the LEADER (which
+            # holds no chaos copies) must fan out over the wire, so the
+            # kill exercises the real copy-failover wave machinery the
+            # flight recorder journals and the SLO watchdog burns on.
+            # Unmeasured: the front-coordinated clients above stay
+            # apples-to-apples with CHAOS_r01.
+            while not stop_flag.is_set():
+                try:
+                    leader.rest.handle(
+                        "POST", "/chaos/_search", "request_cache=false",
+                        qbody)
+                except Exception:   # noqa: BLE001 — witness traffic
+                    pass            # tolerates the weather it records
+                time.sleep(0.01)
+
         wlog = {"ok": 0, "fail": 0}
         wstop = threading.Event()
 
@@ -251,12 +297,15 @@ def main(argv=None):
 
         threads = [threading.Thread(target=client, daemon=True)
                    for _ in range(N_CLIENTS)]
+        threads += [threading.Thread(target=witness_client, daemon=True)
+                    for _ in range(2)]
         wthread = threading.Thread(target=writer, daemon=True)
         for t in threads:
             t.start()
         wthread.start()
         time.sleep(2.0)
         t_kill = time.monotonic()
+        t_kill_wall = time.time() * 1e3
         nodes[victim_id].stop()
         log("victim killed under live search + index traffic")
 
@@ -270,13 +319,24 @@ def main(argv=None):
                 for e in t.values())
         wait_for(victim_stripped, 30.0, "failover routing")
         t_settle = time.monotonic()
+        t_settle_wall = time.time() * 1e3
         time.sleep(5.0)       # post-settle window (plane builds here)
+        fail_window_end_wall = time.time() * 1e3
+        injector.heal()
+        # clean-traffic drain: the watchdog's slow window must roll the
+        # kill's failure burn off so the red state CLEARS — the journal
+        # gate below asserts the cleared transition is recorded
+        from elasticsearch_tpu.common import flightrec as _fr
+        wd = _fr.get_watchdog()
+        if wd is None:
+            raise SystemExit("FAIL: SLO watchdog is not running")
+        wait_for(lambda: wd.status_doc()["status"] == "green", 30.0,
+                 "watchdog clear after heal")
         stop_flag.set()
         wstop.set()
         for t in threads:
             t.join(timeout=30.0)
         wthread.join(timeout=30.0)
-        injector.heal()
         front.refresh("chaos")
         log(f"live writes during failover: ok={wlog['ok']} "
             f"failed={wlog['fail']}")
@@ -409,6 +469,87 @@ def main(argv=None):
                 f"segment re-pack path (gate {MIN_RATIO}x): "
                 f"warm={warm_s:.3f}s repack={repack_s:.3f}s")
 
+        # -- journal reconstruction -----------------------------------
+        # The closing gate: the kill must be reconstructable END TO END
+        # from the flight-recorder journal alone — failover waves and
+        # the master's promotion inside the failure window, the
+        # watchdog's red transition + automatic capture inside that
+        # window, the cleared transition after the heal, and the warm
+        # handoff (manifest -> chunks -> done) after that, in order.
+        st, _c, jout = front.rest.handle(
+            "GET", "/_flight_recorder", "limit=4000", b"")
+        if st != 200:
+            raise SystemExit(f"FAIL: GET /_flight_recorder -> {st}")
+        jdoc = json.loads(jout)
+        events = jdoc["events"]
+
+        def sel(tname, lo=None, hi=None):
+            return [e for e in events if e["type"] == tname
+                    and (lo is None or e["ts_ms"] >= lo)
+                    and (hi is None or e["ts_ms"] <= hi)]
+
+        from collections import Counter
+        log(f"journal: {len(events)} events "
+            f"{dict(Counter(e['type'] for e in events))} "
+            f"window=[{t_kill_wall:.0f},{fail_window_end_wall:.0f}] "
+            f"span=[{events[0]['ts_ms']:.0f},{events[-1]['ts_ms']:.0f}]"
+            if events else "journal: EMPTY")
+        fw = sel("failover_wave", t_kill_wall, fail_window_end_wall)
+        sf = sel("shard_failover", t_kill_wall, fail_window_end_wall)
+        wdog = sel("watchdog", t_kill_wall, None)
+        red = [e for e in wdog
+               if e["ts_ms"] <= fail_window_end_wall and
+               str((e.get("attrs") or {}).get("transition", ""))
+               .endswith("->red")]
+        caps = sel("capture", t_kill_wall, fail_window_end_wall)
+        caps = [e for e in caps
+                if (e.get("attrs") or {}).get("trigger") == "slo_red"]
+        if not fw:
+            raise SystemExit("FAIL: journal holds no failover_wave "
+                             "events inside the failure window")
+        if not sf:
+            raise SystemExit("FAIL: journal holds no shard_failover "
+                             "(promotion) event inside the failure "
+                             "window")
+        if not red or not caps:
+            raise SystemExit(
+                f"FAIL: watchdog did not go red + capture inside the "
+                f"failure window (red={len(red)} captures={len(caps)}; "
+                f"watchdog events: "
+                f"{[(e.get('attrs') or {}).get('transition') for e in wdog]})")
+        cap_ts = caps[0]["ts_ms"]
+        cleared = [e for e in wdog if e["ts_ms"] > cap_ts and
+                   str((e.get("attrs") or {}).get("transition", ""))
+                   .startswith("red->")]
+        if not cleared:
+            raise SystemExit("FAIL: journal holds no red-> cleared "
+                             "watchdog transition after the capture")
+        cleared_ts = cleared[0]["ts_ms"]
+        hand = {t: sel(t, cleared_ts) for t in
+                ("handoff_manifest", "handoff_chunk", "handoff_done")}
+        if not all(hand.values()):
+            raise SystemExit(
+                f"FAIL: warm-handoff events missing after the clear: "
+                f"{ {t: len(v) for t, v in hand.items()} }")
+        # in-order: waves -> capture -> cleared -> handoff
+        order = (min(e["ts_ms"] for e in fw), cap_ts, cleared_ts,
+                 min(e["ts_ms"] for e in hand["handoff_manifest"]))
+        if list(order) != sorted(order):
+            raise SystemExit(f"FAIL: journal event order broken: "
+                             f"{order}")
+        journal_cfg = {
+            "failover_wave_events": len(fw),
+            "shard_failover_events": len(sf),
+            "handoff_manifest_events": len(hand["handoff_manifest"]),
+            "handoff_chunk_events": len(hand["handoff_chunk"]),
+            "handoff_done_events": len(hand["handoff_done"]),
+            "capture_in_window": True,
+            "watchdog_cleared": True,
+            "capture_lag_ms": round(cap_ts - t_kill_wall, 1),
+            "journal": jdoc.get("journal"),
+        }
+        log(f"journal reconstruction OK: {journal_cfg}")
+
         from elasticsearch_tpu.common import telemetry as _tm
         snap = _tm.DEFAULT.metrics_doc()
         rec_bytes = {s["labels"]["kind"]: int(s["value"]) for s in
@@ -435,6 +576,7 @@ def main(argv=None):
                     "recovery_warm_s": round(rec_w, 2),
                     "recovery_repack_s": round(rec_r, 2),
                     "min_ratio_gate": MIN_RATIO},
+                "chaos_journal": journal_cfg,
             },
         }
         line = json.dumps(doc)

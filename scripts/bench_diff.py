@@ -168,6 +168,49 @@ TIME_TO_WARM_FLOOR_S = 0.25
 #: (p99 jumping to seconds), not scheduler noise
 CHAOS_P99_RISE_MAX = 3.0
 
+#: throughput threshold used instead when BOTH sides are chaos runs
+#: (the multichip precedent): the recovery-window qps covers ~6 s of
+#: fault-injected loopback traffic sharing cores with the cluster, the
+#: witness clients and the watchdog — consecutive same-code runs
+#: measured 245 vs 225 q/s (~8%), so the default 10% gate flakes; the
+#: exact chaos gates (zero failures after settle, time_to_warm,
+#: p99 stall, journal reconstruction) are unaffected
+CHAOS_QPS_DROP_MAX = 0.25
+
+
+def _journal_check(new: dict):
+    """Intra-file gates on the NEW side's flight-recorder evidence.
+
+    - A chaos run's ``chaos_journal`` config must show the kill was
+      reconstructable from the journal: failover waves + the promotion
+      + the warm handoff present, the watchdog capture fired inside the
+      failure window, and the red state cleared after.
+    - A steady-state run carrying ``watchdog_steady_captures`` must
+      show ZERO automatic captures (the false-positive invariant: a
+      healthy bench never trips the SLO watchdog).
+    Returns failure strings."""
+    out = []
+    for name, cfg in (new.get("configs") or {}).items():
+        if not isinstance(cfg, dict) or "capture_in_window" not in cfg:
+            continue
+        if not cfg.get("capture_in_window"):
+            out.append(f"configs.{name}: watchdog capture did not fire "
+                       f"inside the failure window")
+        if not cfg.get("watchdog_cleared"):
+            out.append(f"configs.{name}: watchdog red state never "
+                       f"cleared after the heal")
+        for field in ("failover_wave_events", "shard_failover_events",
+                      "handoff_manifest_events", "handoff_done_events"):
+            if not cfg.get(field):
+                out.append(f"configs.{name}: {field}=0 — the kill is "
+                           f"not reconstructable from the journal")
+    steady = new.get("watchdog_steady_captures")
+    if isinstance(steady, (int, float)) and steady > 0:
+        out.append(f"watchdog_steady_captures={int(steady)} — the SLO "
+                   f"watchdog fired on a steady-state run (false-"
+                   f"positive invariant broke)")
+    return out
+
 
 def diff(old: dict, new: dict, threshold: float,
          p99_threshold: float = P99_RISE_MAX):
@@ -298,8 +341,10 @@ def main(argv=None) -> int:
     if old.get("chaos") and new.get("chaos"):
         # recovery-window p99 over a fault-injected window is several-x
         # noisy run to run; the widened gate still catches failover
-        # stalls (p99 jumping to seconds)
+        # stalls (p99 jumping to seconds) — and the window's qps gets
+        # the same treatment (see CHAOS_QPS_DROP_MAX)
         args.p99_threshold = max(args.p99_threshold, CHAOS_P99_RISE_MAX)
+        args.threshold = max(args.threshold, CHAOS_QPS_DROP_MAX)
     print(f"bench diff: {args.old} -> {args.new} "
           f"(threshold {args.threshold:.0%}, p99 "
           f"{args.p99_threshold:.0%})")
@@ -321,6 +366,12 @@ def main(argv=None) -> int:
         for fail in fails:
             print(f"  {fail}")
             regressions.append(fail)
+    # flight-recorder evidence gates (chaos journal reconstruction +
+    # steady-state zero-capture invariant) judge the NEW side's own
+    # record regardless of what the old side measured
+    for fail in _journal_check(new):
+        print(f"  {fail}")
+        regressions.append(fail)
     if regressions:
         print(f"FAIL: {len(regressions)} regression(s) (throughput past "
               f"{args.threshold:.0%}, recall_at_k past "

@@ -5,11 +5,18 @@ Usage:
     python scripts/trace_dump.py TRACE_ID [--host http://127.0.0.1:9200]
     python scripts/trace_dump.py --last [--host ...]   # newest trace
     python scripts/trace_dump.py --list [--host ...]   # recent trace ids
+    python scripts/trace_dump.py TRACE_ID --events     # + journal events
 
 ``--last`` reads the node's ``GET /_trace`` listing (newest-first trace
 index with root action + duration) and dumps the newest trace — no more
 probe-request guessing; if the store is empty it issues one probe
 request to mint a trace. ``--list`` prints the listing itself.
+
+``--events`` additionally fetches the flight-recorder journal
+(``GET /_flight_recorder?trace_id=...``) and interleaves each event into
+the span tree at the deepest span whose window contains the event's
+timestamp — a failover wave or breaker trip renders INSIDE the request
+that felt it.
 
 Output, one line per span, indented by tree depth:
 
@@ -17,6 +24,7 @@ Output, one line per span, indented by tree depth:
       coordinator[search]                       11.80ms  indices=logs
         shards[logs]                            11.02ms
           plane_dispatch                         9.13ms  compile_cache=hit
+          * failover_wave                        @+3.20ms  failed=n2
 """
 from __future__ import annotations
 
@@ -44,12 +52,61 @@ def _fmt_attrs(span: dict) -> str:
     return "  ".join(parts)
 
 
+def attach_events(tree: list, events: list) -> list:
+    """Hang each journal event off the DEEPEST span whose
+    [start, start+took] window contains the event's wall timestamp;
+    events outside every span surface at the root. Returns the events
+    that attached nowhere."""
+    def best_span(spans, ts):
+        for span in spans:
+            s0 = span.get("start_ms")
+            if s0 is None:
+                continue
+            if s0 <= ts <= s0 + max(span.get("took_ms", 0), 0):
+                deeper = best_span(span.get("children") or [], ts)
+                return deeper if deeper is not None else span
+        return None
+
+    orphans = []
+    for ev in events:
+        host = best_span(tree, ev.get("ts_ms", 0))
+        if host is None:
+            orphans.append(ev)
+        else:
+            host.setdefault("_events", []).append(ev)
+    return orphans
+
+
+def _print_event(ev: dict, depth: int, base_ms=None) -> None:
+    name = "  " * depth + "* " + ev.get("type", "?")
+    when = f"@{ev.get('ts_ms', 0):.0f}" if base_ms is None else \
+        f"@+{ev.get('ts_ms', 0) - base_ms:.2f}ms"
+    parts = [when]
+    if ev.get("node"):
+        parts.append(f"node={ev['node']}")
+    for k, v in (ev.get("attrs") or {}).items():
+        if isinstance(v, float):
+            v = round(v, 2)
+        parts.append(f"{k}={v}")
+    print(f"{name:<48}{'':>9}  {'  '.join(parts)}".rstrip())
+
+
 def print_tree(spans: list, depth: int = 0) -> None:
     for span in spans:
         name = "  " * depth + span.get("name", "?")
         took = f"{span.get('took_ms', 0):9.2f}ms"
         print(f"{name:<48}{took}  {_fmt_attrs(span)}".rstrip())
-        print_tree(span.get("children") or [], depth + 1)
+        base = span.get("start_ms")
+        # interleave children spans + attached events by start time
+        kids = [("span", c) for c in span.get("children") or []]
+        kids += [("event", e) for e in span.get("_events") or []]
+        kids.sort(key=lambda kv: kv[1].get("start_ms", kv[1].get(
+            "ts_ms", 0)))
+        for kind, item in kids:
+            if kind == "span":
+                print_tree([item], depth + 1)
+            else:
+                _print_event(item, depth + 1, base_ms=base)
 
 
 def main() -> int:
@@ -63,6 +120,10 @@ def main() -> int:
                     help="print the recent-trace listing and exit")
     ap.add_argument("--json", action="store_true",
                     help="raw JSON instead of the tree rendering")
+    ap.add_argument("--events", action="store_true",
+                    help="interleave flight-recorder journal events "
+                         "(GET /_flight_recorder?trace_id=...) into the "
+                         "span tree")
     args = ap.parse_args()
     tid = args.trace_id
 
@@ -104,14 +165,29 @@ def main() -> int:
               file=sys.stderr)
         return 1
     doc = json.loads(body)
+    events = []
+    if args.events:
+        status, _h, ebody = _get(
+            args.host, f"/_flight_recorder?trace_id={tid}&limit=512")
+        if status == 200:
+            events = json.loads(ebody).get("events") or []
+        else:
+            print(f"GET /_flight_recorder -> {status} (events omitted)",
+                  file=sys.stderr)
     if args.json:
+        if events:
+            doc["events"] = events
         json.dump(doc, sys.stdout, indent=2)
         print()
         return 0
     print(f"trace {doc['trace_id']} — {doc['span_count']} span(s)"
           + (f", {doc['dropped_spans']} dropped"
-             if doc.get("dropped_spans") else ""))
+             if doc.get("dropped_spans") else "")
+          + (f", {len(events)} journal event(s)" if events else ""))
+    orphans = attach_events(doc["tree"], events) if events else []
     print_tree(doc["tree"])
+    for ev in orphans:
+        _print_event(ev, 0)
     return 0
 
 

@@ -1,0 +1,717 @@
+"""Flight recorder + SLO burn-rate watchdog (``common/flightrec.py``).
+
+Covers the ISSUE-14 surfaces: the bounded ring journal (filters,
+eviction accounting), the multi-window burn-rate math on SYNTHETIC
+latency streams under a fake clock (step-function degradation trips
+fast-then-slow in order, recovery clears both, a single p99 spike never
+fires a capture), the watchdog's automatic red-transition capture +
+teardown, the ``GET /_flight_recorder`` REST surface with its error-path
+Trace-Id echo regression, the ``es_plane_handoff_ms`` exemplar, the
+``slo_burn`` health indicator, and the slow-log planner stamp.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common import flightrec
+from elasticsearch_tpu.common.flightrec import (
+    GREEN, RED, YELLOW, FlightRecorder, SloBurnEngine, Watchdog)
+from elasticsearch_tpu.common.telemetry import TelemetryRegistry
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _counter_value(reg: TelemetryRegistry, family: str,
+                   label: str = None, value: str = None) -> float:
+    doc = reg.metrics_doc().get(family)
+    if not doc:
+        return 0.0
+    total = 0.0
+    for s in doc["series"]:
+        if label is not None and s["labels"].get(label) != value:
+            continue
+        total += s["value"]
+    return total
+
+
+# ---------------------------------------------------------------------------
+# ring journal
+# ---------------------------------------------------------------------------
+
+def test_journal_emit_filters_and_stamps():
+    reg = TelemetryRegistry()
+    rec = FlightRecorder(cap=128, registry=reg)
+    rec.emit("plane_rebuild", node="n0", kind="text", trigger="cold")
+    rec.emit("failover_wave", node="n1", trace_id="t-abc", failed="n2")
+    rec.emit("plane_rebuild", node="n0", kind="knn", trigger="cold")
+
+    evs = rec.events()
+    assert [e["type"] for e in evs] == ["plane_rebuild", "failover_wave",
+                                       "plane_rebuild"]
+    # monotonically increasing process-unique seq + both timestamps
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 3
+    assert all("ts_ms" in e and "mono_ms" in e for e in evs)
+
+    assert len(rec.events(type_="plane_rebuild")) == 2
+    assert len(rec.events(type_="plane_rebuild,failover_wave")) == 3
+    assert [e["attrs"]["failed"]
+            for e in rec.events(trace_id="t-abc")] == ["n2"]
+    mid = evs[1]["ts_ms"]
+    assert all(e["ts_ms"] >= mid for e in rec.events(since_ms=mid))
+    assert _counter_value(reg, "es_flightrec_events_total",
+                          "type", "plane_rebuild") == 2
+
+
+def test_journal_ring_bounds_and_dropped_counter():
+    reg = TelemetryRegistry()
+    rec = FlightRecorder(cap=64, registry=reg)
+    for i in range(200):
+        rec.emit("spam", i=i)
+    assert len(rec.events(limit=0) or rec.events(limit=1000)) <= 64
+    doc = rec.stats_doc()
+    assert doc["retained"] == 64
+    assert doc["emitted"] == 200
+    assert doc["dropped"] == 200 - 64
+    assert _counter_value(reg, "es_flightrec_dropped_total") == 200 - 64
+    # the ring keeps the NEWEST events
+    kept = [e["attrs"]["i"] for e in rec.events(limit=1000)]
+    assert kept == list(range(200 - 64, 200))
+
+
+def test_journal_emit_never_raises_and_adopts_ambient():
+    rec = FlightRecorder(cap=64, registry=TelemetryRegistry())
+    token = flightrec.bind_ambient(node="nX", task="nX:7")
+    try:
+        ev = rec.emit("probe")
+    finally:
+        flightrec.reset_ambient(token)
+    assert ev["node"] == "nX" and ev["task"] == "nX:7"
+    # unstringifiable attrs must not break the append
+    ev2 = rec.emit("probe", weird=object())
+    assert ev2.get("type") == "probe"
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math on synthetic latency streams
+# ---------------------------------------------------------------------------
+
+def _engine(clock, **kw):
+    kw.setdefault("latency_threshold_ms", 100.0)
+    kw.setdefault("latency_budget", 0.01)
+    kw.setdefault("failure_budget", 0.01)
+    kw.setdefault("fast_s", 60.0)
+    kw.setdefault("slow_s", 600.0)
+    kw.setdefault("burn_red", 8.0)
+    return SloBurnEngine(clock=clock, **kw)
+
+
+def _drive(engine, clock, seconds, qps=10, latency_ms=10.0):
+    for _ in range(int(seconds)):
+        for _q in range(qps):
+            engine.observe(latency_ms)
+        clock.advance(1.0)
+
+
+def test_step_degradation_trips_fast_then_slow_then_red():
+    clock = FakeClock()
+    eng = _engine(clock)
+    # 600 s healthy baseline fills both windows
+    _drive(eng, clock, 600, latency_ms=10.0)
+    assert eng.status()[0] == GREEN
+
+    # step-function degradation: every query now breaches the threshold
+    trip_order = []
+    red_at = None
+    for s in range(120):
+        _drive(eng, clock, 1, latency_ms=500.0)
+        rates = eng.burn_rates()
+        if rates["fast"]["burn"] >= eng.burn_red and \
+                "fast" not in trip_order:
+            trip_order.append("fast")
+            # fast trips alone first -> YELLOW, never straight to RED
+            assert eng.status()[0] == YELLOW
+            assert rates["slow"]["burn"] < eng.burn_red
+        if rates["slow"]["burn"] >= eng.burn_red and \
+                "slow" not in trip_order:
+            trip_order.append("slow")
+        if eng.status()[0] == RED and red_at is None:
+            red_at = s
+    assert trip_order == ["fast", "slow"]
+    assert red_at is not None
+    # fast window (60 s at 8x burn over a 1% budget) arms within ~5 s;
+    # the slow window needs ~48 s of fully-bad traffic
+    assert 30 <= red_at <= 70
+
+
+def test_recovery_clears_fast_then_slow():
+    clock = FakeClock()
+    eng = _engine(clock)
+    _drive(eng, clock, 600, latency_ms=10.0)
+    _drive(eng, clock, 100, latency_ms=500.0)   # 100 s outage
+    assert eng.status()[0] == RED
+
+    clear_order = []
+    for _s in range(1300):
+        _drive(eng, clock, 1, latency_ms=10.0)
+        rates = eng.burn_rates()
+        if rates["fast"]["burn"] < eng.burn_red and \
+                "fast" not in clear_order:
+            clear_order.append("fast")
+            # leaving RED through YELLOW: the slow window still carries
+            # the outage until it rolls off
+            assert eng.status()[0] == YELLOW
+        if rates["slow"]["burn"] < eng.burn_red and \
+                "slow" not in clear_order:
+            clear_order.append("slow")
+        if eng.status()[0] == GREEN:
+            break
+    assert clear_order == ["fast", "slow"]
+    assert eng.status()[0] == GREEN
+
+
+def test_single_p99_spike_never_goes_red():
+    clock = FakeClock()
+    eng = _engine(clock)
+    _drive(eng, clock, 600, latency_ms=10.0)
+    # one catastic 10-second request among healthy traffic
+    eng.observe(10_000.0)
+    statuses = set()
+    for _s in range(120):
+        _drive(eng, clock, 1, latency_ms=10.0)
+        statuses.add(eng.status()[0])
+    assert statuses == {GREEN}
+
+    # even a one-second BURST of bad samples (a p99 spike, not a step)
+    # moves only the fast window and still never reaches RED
+    for _q in range(30):
+        eng.observe(5000.0)
+    for _s in range(120):
+        assert eng.status()[0] != RED
+        _drive(eng, clock, 1, latency_ms=10.0)
+
+
+def test_single_failure_on_idle_cluster_never_fires():
+    """Volume floor: one recovered RPC retry on a (near-)idle cluster
+    must not read as a 100% failure rate and trip both windows at once
+    — windows below min_window_queries carry no burn signal."""
+    clock = FakeClock()
+    eng = _engine(clock)
+    assert eng.min_window_queries > 1
+    # zero traffic + one failure event: no burn at all
+    eng.note_failures(1)
+    for _s in range(120):
+        assert eng.status()[0] == GREEN
+        clock.advance(1.0)
+    # roll the first blip fully out of the slow window, then a trickle
+    # below the floor + a failure: still green (queries + failures
+    # together stay under min_window_queries)
+    clock.advance(eng.slow_s + 5)
+    for _q in range(eng.min_window_queries - 2):
+        eng.observe(10.0)
+    eng.note_failures(1)
+    assert eng.status()[0] == GREEN
+    rates = eng.burn_rates()
+    assert rates["fast"]["burn"] == 0.0
+    assert rates["slow"]["burn"] == 0.0
+
+
+def test_total_outage_with_zero_completed_queries_goes_red():
+    """The outage denominator counts failures too: when EVERY search
+    fails (nothing completes, so no latency observations land), the
+    failure events alone must drive both windows red — the watchdog
+    must not stay green through the exact incident it exists to
+    capture."""
+    clock = FakeClock()
+    eng = _engine(clock)
+    _drive(eng, clock, 600, latency_ms=10.0)
+    assert eng.status()[0] == GREEN
+    # total outage: zero completed queries, a stream of failure events
+    for _s in range(300):
+        eng.note_failures(10)
+        clock.advance(1.0)
+    assert eng.status()[0] == RED
+    rates = eng.burn_rates()
+    assert rates["fast"]["queries"] == 0
+    assert rates["fast"]["failure_burn"] >= eng.burn_red
+    assert rates["slow"]["failure_burn"] >= eng.burn_red
+
+
+def test_failure_rate_burn_reaches_red():
+    clock = FakeClock()
+    eng = _engine(clock)
+    _drive(eng, clock, 600, latency_ms=10.0)
+    assert eng.status()[0] == GREEN
+    # healthy latencies, but sustained copy-failover: 2 failures per
+    # 10-query second = 20% failure rate against a 1% budget (the slow
+    # window needs 480 failure-events over its 600 s — ~240 s at 2/s)
+    for _s in range(300):
+        _drive(eng, clock, 1, latency_ms=10.0)
+        eng.note_failures(2)
+    assert eng.status()[0] == RED
+    rates = eng.burn_rates()
+    assert rates["fast"]["failure_burn"] >= eng.burn_red
+    assert rates["fast"]["latency_burn"] < eng.burn_red
+
+
+# ---------------------------------------------------------------------------
+# watchdog: transitions, captures, teardown
+# ---------------------------------------------------------------------------
+
+def _watchdog(clock, recorder=None, reg=None):
+    reg = reg or TelemetryRegistry()
+    rec = recorder or FlightRecorder(cap=256, registry=reg)
+    eng = _engine(clock)
+    return Watchdog(recorder=rec, engine=eng, registry=reg,
+                    interval_s=0.05, clock=clock), rec, eng, reg
+
+
+def test_watchdog_red_transition_fires_one_capture_and_clears():
+    clock = FakeClock()
+    wd, rec, eng, reg = _watchdog(clock)
+    _drive(eng, clock, 600, latency_ms=10.0)
+    assert wd.tick() == GREEN
+
+    # outage: tick through it — exactly ONE capture at the red
+    # transition, not one per red tick
+    for _s in range(100):
+        _drive(eng, clock, 1, latency_ms=500.0)
+        wd.tick()
+    assert wd.status_doc()["status"] == RED
+    caps = wd.captures()
+    assert len(caps) == 1 and caps[0]["trigger"] == "slo_red"
+    assert _counter_value(reg, "es_watchdog_captures_total",
+                          "trigger", "slo_red") == 1
+    # burn gauges published
+    assert _counter_value(reg, "es_slo_burn_rate", "window", "fast") \
+        >= eng.burn_red
+
+    # the capture carries the diagnostic payloads
+    full = wd.get_capture(caps[0]["id"])
+    assert "hot_threads" in full and isinstance(full["hot_threads"], str)
+    assert isinstance(full["telemetry"], dict)
+    assert isinstance(full["journal"], list)
+    assert "batcher_queues" in full and "device" in full
+    # journal records the transitions in order: ...->yellow, ->red,
+    # then the capture event
+    kinds = [(e["type"], (e.get("attrs") or {}).get("transition"))
+             for e in rec.events(type_="watchdog,capture")]
+    assert ("watchdog", "green->yellow") in kinds
+    assert ("watchdog", "yellow->red") in kinds
+    assert kinds[-1][0] == "capture" or \
+        any(k == "capture" for k, _t in kinds)
+
+    # recovery: clears through yellow back to green, no second capture
+    for _s in range(1400):
+        _drive(eng, clock, 1, latency_ms=10.0)
+        wd.tick()
+        if wd.status_doc()["status"] == GREEN:
+            break
+    assert wd.status_doc()["status"] == GREEN
+    assert len(wd.captures()) == 1
+    transitions = [(e.get("attrs") or {}).get("transition")
+                   for e in rec.events(type_="watchdog")]
+    assert transitions[-1] in ("yellow->green", "red->yellow",
+                               "red->green") or \
+        "yellow->green" in transitions
+
+
+def test_watchdog_capture_store_is_bounded():
+    clock = FakeClock()
+    reg = TelemetryRegistry()
+    rec = FlightRecorder(cap=256, registry=reg)
+    wd = Watchdog(recorder=rec, engine=_engine(clock), registry=reg,
+                  capture_cap=4, clock=clock)
+    for _i in range(10):
+        wd.capture("manual")
+    caps = wd.captures()
+    assert len(caps) == 4
+    ids = [c["id"] for c in caps]
+    assert len(ids) == len(set(ids))
+
+
+def test_watchdog_thread_teardown_joins():
+    """ESTP-T01 semantics at runtime: close() signals and joins — the
+    thread never outlives its owner."""
+    clock = FakeClock()
+    wd, _rec, _eng, _reg = _watchdog(clock)
+    wd.start()
+    t = wd._thread
+    assert t is not None and t.is_alive()
+    wd.close()
+    assert not t.is_alive()
+    # idempotent close, restartable
+    wd.close()
+    wd.start()
+    assert wd._thread.is_alive()
+    wd.close()
+    assert wd._thread is None
+
+
+def test_watchdog_feeds_failure_counter_deltas():
+    clock = FakeClock()
+    reg = TelemetryRegistry()
+    wd, rec, eng, reg = _watchdog(clock, reg=reg)
+    _drive(eng, clock, 600, latency_ms=10.0)
+    wd.tick()                                     # baseline the counter
+    c = reg.counter("es_search_retries_total", {"outcome": "retried"})
+    for _s in range(300):
+        _drive(eng, clock, 1, latency_ms=10.0)
+        c.inc(2)                                  # 20% failure rate
+        wd.tick()
+    assert wd.status_doc()["status"] == RED
+    assert wd.captures() and \
+        wd.captures()[0]["trigger"] == "slo_red"
+
+
+# ---------------------------------------------------------------------------
+# REST surface + error-path Trace-Id echo
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def api(tmp_path):
+    from elasticsearch_tpu.node.indices_service import IndicesService
+    from elasticsearch_tpu.rest.api import RestAPI
+    api = RestAPI(IndicesService(str(tmp_path)))
+    api.handle("PUT", "/frec", "", json.dumps(
+        {"mappings": {"properties": {
+            "body": {"type": "text"},
+            "vec": {"type": "dense_vector", "dims": 4}}}}).encode())
+    api.handle("PUT", "/frec/_doc/1", "refresh=true", json.dumps(
+        {"body": "quick brown fox", "vec": [1, 0, 0, 0]}).encode())
+    return api
+
+
+def test_rest_flight_recorder_filters(api):
+    st, _ct, out = api.handle("POST", "/frec/_search", "", json.dumps(
+        {"query": {"match": {"body": "quick"}}}).encode())
+    assert st == 200
+    st, _ct, out = api.handle("GET", "/_flight_recorder", "", b"")
+    assert st == 200
+    doc = json.loads(out)
+    assert doc["journal"]["cap"] >= 64
+    types = {e["type"] for e in doc["events"]}
+    assert "plane_rebuild" in types        # the cold pack journaled
+    # type filter
+    st, _ct, out = api.handle("GET", "/_flight_recorder",
+                              "type=plane_rebuild", b"")
+    evs = json.loads(out)["events"]
+    assert evs and all(e["type"] == "plane_rebuild" for e in evs)
+    # since filter: relative window (nothing is older than 1h)
+    st, _ct, out = api.handle("GET", "/_flight_recorder",
+                              "since=1h&type=plane_rebuild", b"")
+    assert json.loads(out)["events"]
+    st, _ct, out = api.handle(
+        "GET", "/_flight_recorder",
+        f"since={time.time() * 1e3 + 1e6:.0f}", b"")
+    assert json.loads(out)["events"] == []
+    # limit validation
+    st, _ct, _out = api.handle("GET", "/_flight_recorder", "limit=x", b"")
+    assert st == 400
+
+
+def test_rest_flight_recorder_trace_id_filter(api):
+    rh = {}
+    st, _ct, _out = api.handle(
+        "POST", "/frec/_search", "request_cache=false", json.dumps(
+            {"query": {"match": {"body": "brown"}}}).encode(),
+        headers={}, resp_headers=rh)
+    assert st == 200 and rh.get("Trace-Id")
+    tid = rh["Trace-Id"]
+    flightrec.record("probe_traced", trace_id=tid, hello=1)
+    st, _ct, out = api.handle("GET", "/_flight_recorder",
+                              f"trace_id={tid}", b"")
+    evs = json.loads(out)["events"]
+    assert evs and all(e.get("trace_id") == tid for e in evs)
+    assert any(e["type"] == "probe_traced" for e in evs)
+
+
+def test_rest_captures_and_404(api):
+    wd = flightrec.ensure_watchdog()
+    if wd is None:
+        pytest.skip("watchdog disabled via ES_TPU_WATCHDOG")
+    cap = wd.capture("manual")
+    st, _ct, out = api.handle("GET", "/_flight_recorder/captures", "",
+                              b"")
+    assert st == 200
+    ids = [c["id"] for c in json.loads(out)["captures"]]
+    assert cap["id"] in ids
+    st, _ct, out = api.handle(
+        "GET", f"/_flight_recorder/captures/{cap['id']}", "", b"")
+    assert st == 200
+    full = json.loads(out)
+    assert full["id"] == cap["id"] and "hot_threads" in full
+    st, _ct, _out = api.handle(
+        "GET", "/_flight_recorder/captures/cap-doesnotexist", "", b"")
+    assert st == 404
+
+
+def test_trace_id_echoed_on_error_responses(api):
+    """Satellite regression: the 4xx/5xx paths flow through the same
+    resp_headers out-param as success responses."""
+    # unknown route -> 400
+    rh = {}
+    st, _ct, _out = api.handle("GET", "/_no_such_route", "", b"",
+                               headers={}, resp_headers=rh)
+    assert st == 400 and rh.get("Trace-Id")
+    # wrong method -> 405
+    rh = {}
+    st, _ct, _out = api.handle("DELETE", "/_flight_recorder", "", b"",
+                               headers={}, resp_headers=rh)
+    assert st == 405 and rh.get("Trace-Id")
+    # handler exception -> 404 (missing index)
+    rh = {}
+    st, _ct, _out = api.handle("POST", "/missing-index/_search", "",
+                               b"", headers={}, resp_headers=rh)
+    assert st == 404 and rh.get("Trace-Id")
+    # incoming trace id is ADOPTED on the error echo, with opaque id
+    rh = {}
+    st, _ct, _out = api.handle(
+        "GET", "/_no_such_route", "", b"",
+        headers={"x-trace-id": "cafe" * 8, "X-Opaque-Id": "op-1"},
+        resp_headers=rh)
+    assert st == 400
+    assert rh.get("Trace-Id") == "cafe" * 8
+    assert rh.get("X-Opaque-Id") == "op-1"
+    # security 401 echoes too
+    from elasticsearch_tpu.security import SecurityService
+    api.security = SecurityService(enabled=True)
+    try:
+        rh = {}
+        st, _ct, _out = api.handle("GET", "/frec/_doc/1", "", b"",
+                                   headers={}, resp_headers=rh)
+        assert st == 401 and rh.get("Trace-Id")
+    finally:
+        api.security = SecurityService(enabled=False)
+
+
+def test_slow_dispatch_event_journaled(api, monkeypatch):
+    monkeypatch.setenv("ES_TPU_FLIGHTREC_SLOW_MS", "0.0")
+    st, _ct, _out = api.handle(
+        "POST", "/frec/_search", "request_cache=false", json.dumps(
+            {"query": {"match": {"body": "fox"}}}).encode())
+    assert st == 200
+    evs = flightrec.DEFAULT.events(type_="slow_dispatch", limit=16)
+    assert evs, "a 0ms threshold must journal every dispatch"
+    attrs = evs[-1]["attrs"]
+    assert attrs["batch_size"] >= 1 and "dispatch_ms" in attrs
+
+
+def test_slo_burn_health_indicator_tracks_watchdog(api, monkeypatch):
+    from elasticsearch_tpu.common.health import HealthService
+    clock = FakeClock()
+    wd, _rec, eng, _reg = _watchdog(clock)
+    monkeypatch.setattr(flightrec, "_WATCHDOG", wd)
+    svc = HealthService(api)
+    assert "slo_burn" in svc.INDICATORS
+    doc = svc.report(indicator="slo_burn")
+    assert doc["indicators"]["slo_burn"]["status"] == "green"
+    _drive(eng, clock, 600, latency_ms=10.0)
+    for _s in range(100):
+        _drive(eng, clock, 1, latency_ms=500.0)
+        wd.tick()
+    doc = svc.report(indicator="slo_burn")
+    ind = doc["indicators"]["slo_burn"]
+    assert ind["status"] == "red"
+    assert ind["details"]["captures"] == 1
+    assert ind["impacts"] and ind["diagnosis"]
+    assert "_flight_recorder" in ind["diagnosis"][0]["action"]
+
+
+def test_dynamic_cluster_settings_reconfigure_engine(api):
+    """PUT /_cluster/settings on the dynamic slo.*/flightrec.* knobs
+    re-resolves the LIVE engine (not just the echoed settings doc)."""
+    old_red = flightrec.ENGINE.burn_red
+    old_thr = flightrec.ENGINE.latency_threshold_ms
+    try:
+        st, _ct, _out = api.handle("PUT", "/_cluster/settings", "",
+                                   json.dumps({"transient": {
+                                       "slo.burn_rate.red": 3.5,
+                                       "slo.latency.threshold_ms": 250,
+                                       "flightrec.slow_dispatch_ms": 17,
+                                   }}).encode())
+        assert st == 200
+        assert flightrec.ENGINE.burn_red == 3.5
+        assert flightrec.ENGINE.latency_threshold_ms == 250
+        assert flightrec.slow_dispatch_threshold_ms() == 17
+    finally:
+        api.handle("PUT", "/_cluster/settings", "", json.dumps(
+            {"transient": {"slo.burn_rate.red": None,
+                           "slo.latency.threshold_ms": None,
+                           "flightrec.slow_dispatch_ms": None}}).encode())
+        flightrec.ENGINE.configure()
+        with flightrec._SETTINGS_LOCK:
+            flightrec._SETTINGS = None
+        assert flightrec.ENGINE.burn_red == old_red
+        assert flightrec.ENGINE.latency_threshold_ms == old_thr
+
+
+def test_handoff_histogram_exemplar_links_trace():
+    from elasticsearch_tpu.common import telemetry as _tm
+    reg = TelemetryRegistry()
+    _tm.record_plane_handoff_ms(123.4, exemplar="trace-xyz",
+                                registry=reg)
+    snap = reg.metrics_doc()["es_plane_handoff_ms"]["series"][0]["value"]
+    assert snap["exemplar"]["trace_id"] == "trace-xyz"
+    assert abs(snap["exemplar"]["value"] - 123.4) < 1e-6
+
+
+def test_slowlog_carries_planner_context(api):
+    """Satellite: fused dispatches slow-log with planner outcome +
+    per-stage timings (serving_stages predates the fused route)."""
+    api.indices.get("frec").settings[
+        "index.search.slowlog.threshold.query.warn"] = "0ms"
+    st, _ct, _out = api.handle(
+        "POST", "/frec/_search", "request_cache=false", json.dumps(
+            {"query": {"match": {"body": "quick"}},
+             "knn": {"field": "vec", "query_vector": [1, 0, 0, 0],
+                     "k": 1, "num_candidates": 5},
+             "rank": {"rrf": {"rank_window_size": 5}}}).encode())
+    assert st == 200
+    entries = [e for e in api.indices.get("frec").slow_log
+               if e["kind"] == "query" and "planner" in e]
+    assert entries, "fused dispatch must slow-log its planner context"
+    pl = entries[-1]["planner"]
+    assert pl["outcome"] in ("fused", "fallback")
+    if pl["outcome"] == "fused":
+        assert pl["stages_per_dispatch"] >= 1
+        assert entries[-1].get("serving_stages")
+    assert isinstance(pl.get("lower_ms"), (int, float, type(None)))
+
+
+def test_cluster_fan_in_merges_and_dedupes(tmp_path):
+    """The front fans ``GET /_flight_recorder`` out over rest:exec and
+    merges: in-process nodes share the ring, so every event must appear
+    exactly ONCE (seq dedup), wall-time sorted; a capture id resolves
+    through the front from whichever node holds it."""
+    from elasticsearch_tpu.node.cluster_node import ClusterNode
+    base = 29710
+    peers = {f"fr{i}": ("127.0.0.1", base + i) for i in range(2)}
+    nodes = [ClusterNode(f"fr{i}", "127.0.0.1", base + i, peers,
+                         str(tmp_path / f"fr{i}"), seed=i)
+             for i in range(2)]
+    try:
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if any(n.coordinator.mode == "LEADER" for n in nodes):
+                break
+            time.sleep(0.05)
+        marker = f"fanin-{time.time_ns()}"
+        for i in range(3):
+            flightrec.record("fanin_probe", marker=marker, i=i)
+        st, _ct, out = nodes[0].rest.handle(
+            "GET", "/_flight_recorder", "type=fanin_probe&limit=512",
+            b"")
+        assert st == 200
+        doc = json.loads(out)
+        assert doc.get("nodes_reporting") == 2
+        mine = [e for e in doc["events"]
+                if (e.get("attrs") or {}).get("marker") == marker]
+        assert [e["attrs"]["i"] for e in mine] == [0, 1, 2]
+        ts = [e["ts_ms"] for e in doc["events"]]
+        assert ts == sorted(ts)
+        # capture-by-id resolves through the front
+        wd = flightrec.ensure_watchdog()
+        if wd is not None:
+            cap = wd.capture("manual")
+            st, _ct, out = nodes[0].rest.handle(
+                "GET", f"/_flight_recorder/captures/{cap['id']}", "",
+                b"")
+            assert st == 200 and json.loads(out)["id"] == cap["id"]
+            st, _ct, _out = nodes[0].rest.handle(
+                "GET", "/_flight_recorder/captures/cap-missing", "", b"")
+            assert st == 404
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:   # noqa: BLE001
+                pass
+
+
+def _load_bench_diff():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(os.path.dirname(__file__), "..",
+                                   "scripts", "bench_diff.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_diff_journal_gates(tmp_path):
+    """The chaos journal-reconstruction gate and the steady-state
+    zero-capture gate both fail through scripts/bench_diff.py."""
+    bd = _load_bench_diff()
+
+    def run(old, new):
+        po, pn = tmp_path / "old.json", tmp_path / "new.json"
+        po.write_text(json.dumps(old))
+        pn.write_text(json.dumps(new))
+        return bd.main([str(po), str(pn)])
+
+    def chaos(journal=None):
+        cfg = {"failover_wave_events": 12, "shard_failover_events": 1,
+               "handoff_manifest_events": 1, "handoff_chunk_events": 3,
+               "handoff_done_events": 1, "capture_in_window": True,
+               "watchdog_cleared": True}
+        cfg.update(journal or {})
+        return {"backend": "cpu", "chaos": True,
+                "configs": {"chaos_journal": cfg}}
+
+    assert run(chaos(), chaos()) == 0
+    # the watchdog never captured inside the failure window
+    assert run(chaos(), chaos({"capture_in_window": False})) == 1
+    # red state never cleared
+    assert run(chaos(), chaos({"watchdog_cleared": False})) == 1
+    # the kill is not reconstructable (no failover waves / no handoff)
+    assert run(chaos(), chaos({"failover_wave_events": 0})) == 1
+    assert run(chaos(), chaos({"handoff_done_events": 0})) == 1
+
+    def steady(captures):
+        return {"backend": "cpu", "value": 100.0, "unit": "queries/s",
+                "watchdog_steady_captures": captures}
+
+    assert run(steady(0), steady(0)) == 0
+    # any automatic capture on a steady-state run breaks the
+    # false-positive invariant
+    assert run(steady(0), steady(2)) == 1
+
+
+def test_journal_emission_is_thread_safe():
+    rec = FlightRecorder(cap=512, registry=TelemetryRegistry())
+    errs = []
+
+    def spam(tag):
+        try:
+            for i in range(400):
+                rec.emit("race", tag=tag, i=i)
+        except BaseException as e:   # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=spam, args=(t,))
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    doc = rec.stats_doc()
+    assert doc["emitted"] == 8 * 400
+    assert doc["retained"] == 512
+    assert doc["dropped"] == 8 * 400 - 512

@@ -25,7 +25,12 @@ def make_engine(tmp_path, name):
 def search_ids(engine):
     engine.refresh()
     s = ShardSearcher(engine.searchable_segments(), engine.mapper)
-    r = s.search({"query": {"match_all": {}}, "size": 100})
+    # size must exceed anything a test can index: the fence test's
+    # writer threads ack however many docs 100 ms of scheduling allows,
+    # and a capped window silently truncates — acked docs beyond the
+    # cap then read as "lost across promotion" (a false positive that
+    # fired under suite load)
+    r = s.search({"query": {"match_all": {}}, "size": 10000})
     return sorted(h.doc_id for h in r.hits)
 
 
