@@ -345,6 +345,37 @@ def _watchdog_steady_captures() -> int:
         return 0
 
 
+def _efficiency_snapshot() -> dict:
+    """{kernel: (count, efficiency_sum)} from the roofline auditor's
+    ``es_dispatch_efficiency_pct`` families — monotone, so per-config
+    deltas are exact."""
+    try:
+        from elasticsearch_tpu.common.telemetry import DEFAULT
+        doc = DEFAULT.metrics_doc().get("es_dispatch_efficiency_pct")
+        out = {}
+        for s in (doc or {}).get("series", ()):
+            v = s["value"]
+            if isinstance(v, dict):
+                out[s["labels"].get("kernel", "?")] = (
+                    int(v.get("count", 0)), float(v.get("sum", 0.0)))
+        return out
+    except Exception:   # noqa: BLE001 — evidence only
+        return {}
+
+
+def _efficiency_delta(before: dict) -> dict:
+    """Per-kernel {n, mean_pct} audited since ``before`` — the
+    measured-vs-model summary each config embeds (scripts/bench_diff.py
+    gates a >20% drop per kernel on paired configs)."""
+    out = {}
+    for k, (c1, s1) in _efficiency_snapshot().items():
+        c0, s0 = before.get(k, (0, 0.0))
+        if c1 > c0:
+            out[k] = {"n": c1 - c0,
+                      "mean_pct": round((s1 - s0) / (c1 - c0), 3)}
+    return out
+
+
 def _telemetry_snapshot() -> dict:
     """Final telemetry registry rollup for the bench JSON: compile
     counts/ms per site, device bytes moved, live-memory watermark — a
@@ -1430,6 +1461,7 @@ def main(mode: str = "accel"):
     def run(name, fn, *args):
         if not want(name):
             return
+        eff0 = _efficiency_snapshot()
         try:
             configs[name] = fn(*args)
         except SystemExit:
@@ -1438,6 +1470,15 @@ def main(mode: str = "accel"):
             # secondary config must not cost the headline number
             configs[name] = {"error": repr(e)[:300]}
             print(f"# config {name} FAILED: {e!r}", file=sys.stderr)
+        if isinstance(configs.get(name), dict) and \
+                "error" not in configs[name]:
+            # roofline audit delta for THIS config's dispatches: per
+            # kernel family, how many were audited and their mean
+            # model-vs-achieved efficiency (bench_diff gates a >20%
+            # per-kernel drop on paired configs)
+            eff = _efficiency_delta(eff0)
+            if eff:
+                configs[name]["efficiency"] = eff
 
     if need_plane:
         run("batch_curve", bench_batch_curve, rng, corpus, plane, on_cpu)
@@ -1487,6 +1528,9 @@ def main(mode: str = "accel"):
         # trip the SLO watchdog (bench_diff gates nonzero as a
         # regression); manual/seeded captures are excluded
         "watchdog_steady_captures": _watchdog_steady_captures(),
+        # whole-run roofline audit rollup (model vs achieved per kernel
+        # family — the ROOFLINE.md measured-efficiency table's source)
+        "dispatch_efficiency": _efficiency_delta({}),
     }
     if kernel_cpu_qps is not None:
         doc["serving_path"] = "eager-cpu"

@@ -173,6 +173,14 @@ def reset_ambient(token) -> None:
     _AMBIENT.reset(token)
 
 
+def ambient_node() -> Optional[str]:
+    """The node id bound for the current request context, if any (the
+    dispatch profiler stamps it into slots at enqueue — dispatcher
+    threads carry no request context of their own)."""
+    amb = _AMBIENT.get()
+    return amb[0] if amb is not None else None
+
+
 # -- the ring journal -------------------------------------------------------
 
 _SEQ = itertools.count(1)
@@ -617,7 +625,36 @@ class Watchdog:
                 slow_burn=rates["slow"]["burn"])
             if status == RED:
                 self.capture("slo_red", rates=rates)
+        self._sample_batcher_queues()
         return status
+
+    def _sample_batcher_queues(self) -> None:
+        """Periodic ``es_batcher_queue_depth{index,kind}`` gauges —
+        queue depth was only visible inside watchdog CAPTURES before;
+        sampling it on the existing tick makes the convoy signal a
+        scrapeable time series with no new thread. Depths sum per
+        (index, kind) over a cache's live generations (several
+        generations of one index share the serving load)."""
+        reg = self._reg()
+        depths: Dict[tuple, int] = {}
+        for d in self._batcher_queues():
+            key = (d.get("index"), d.get("kind", "text"))
+            depths[key] = depths.get(key, 0) + int(d.get("depth", 0))
+        # series whose batcher disappeared (index deleted, cache torn
+        # down) zero out instead of freezing at their last sampled
+        # depth — a stale nonzero depth would alert forever on a
+        # nonexistent index (zeroed once; dropped from tracking after)
+        live = set(depths)
+        prev = getattr(self, "_queue_depth_keys", set())
+        for index, kind in prev - live:
+            depths[(index, kind)] = 0
+        self._queue_depth_keys = live
+        for (index, kind), depth in depths.items():
+            reg.gauge(
+                "es_batcher_queue_depth",
+                {"index": str(index), "kind": str(kind)},
+                help="micro-batcher slots waiting for a dispatch, "
+                     "sampled per watchdog tick").set(depth)
 
     # -- captures -----------------------------------------------------------
 
@@ -669,6 +706,7 @@ class Watchdog:
                         out.append({
                             "node": api.node_id, "index": name,
                             "plane": type(b.plane).__name__,
+                            "kind": getattr(b, "kind", "text"),
                             "depth": b.queue_depth(),
                             "dispatches": b.n_dispatches})
             except Exception:   # noqa: BLE001 — a mid-teardown node

@@ -75,7 +75,7 @@ class HealthService:
 
     INDICATORS = ("shards_availability", "plane_serving", "compile_churn",
                   "breakers", "indexing_pressure", "task_backlog",
-                  "slo_burn")
+                  "slo_burn", "dispatch_efficiency")
 
     #: sync non-cold rebuilds: first one turns yellow, a storm turns red
     SYNC_REBUILD_YELLOW = 1
@@ -497,6 +497,104 @@ class HealthService:
                 "captures — hot threads, journal slice, batcher queue "
                 "depths taken AT the red transition) and watch "
                 "es_slo_burn_rate{window} + es_watchdog_captures_total.")]
+        return doc
+
+    def _ind_dispatch_efficiency(self) -> dict:
+        """Continuous roofline audit (``common/roofline.py``): every
+        serving dispatch's achieved bandwidth is compared against the
+        ROOFLINE.md bytes model; this indicator judges the windowed
+        mean efficiency per kernel family SINCE the last evaluation
+        (the compile_churn windowed-watermark pattern — the underlying
+        accumulators are process-cumulative). Yellow means a kernel's
+        window drifted below the floor: an explicit
+        ``dispatch_efficiency.floor_pct`` / ``ES_TPU_DISPATCH_EFF_
+        FLOOR_PCT`` when set, else ``drift_fraction`` of the session's
+        best windowed mean for that kernel (auto mode — absolute
+        efficiency differs per backend, drift does not). Windows below
+        the ``min_dispatches`` volume floor carry no signal and are NOT
+        consumed, so trickle traffic accumulates until judgeable (the
+        SLO engine's min_window_queries shape). Status transitions are
+        journaled to the flight recorder."""
+        from . import flightrec as _fr
+        from . import roofline as _rl
+        totals = _rl.audit_totals()
+        floor = _rl.efficiency_floor_pct()
+        drift_frac = _rl.efficiency_drift_fraction()
+        min_d = _rl.efficiency_min_dispatches()
+        drifting: Dict[str, dict] = {}
+        kernels: Dict[str, dict] = {}
+        with _ANN_DRIFT_LOCK:
+            seen = dict(getattr(self.api, "_eff_seen", {}))
+            baselines = dict(getattr(self.api, "_eff_baseline", {}))
+            for kern, (n, s) in sorted(totals.items()):
+                n0, s0 = seen.get(kern, (0, 0.0))
+                wn, ws = n - n0, s - s0
+                if wn < min_d:
+                    # below the volume floor: no signal, window NOT
+                    # consumed (one slow dispatch on an idle node is a
+                    # blip, not drift)
+                    kernels[kern] = {"window_dispatches": wn,
+                                     "pending": True}
+                    continue
+                mean = ws / wn
+                seen[kern] = (n, s)
+                base = baselines.get(kern)
+                thr = floor if floor > 0 else (
+                    base * drift_frac if base is not None else None)
+                # watermark: the best windowed mean seen this session
+                # (a drifting window sits below it and never lowers it)
+                baselines[kern] = mean if base is None \
+                    else max(base, mean)
+                kernels[kern] = {
+                    "window_dispatches": wn,
+                    "window_mean_pct": round(mean, 3),
+                    "baseline_pct": round(baselines[kern], 3),
+                    "threshold_pct": round(thr, 3)
+                    if thr is not None else None}
+                if thr is not None and mean < thr:
+                    drifting[kern] = kernels[kern]
+            self.api._eff_seen = seen
+            self.api._eff_baseline = baselines
+            prev = getattr(self.api, "_eff_status", GREEN)
+            status = YELLOW if drifting else GREEN
+            self.api._eff_status = status
+        if status != prev:
+            _fr.record("dispatch_efficiency",
+                       transition=f"{prev}->{status}",
+                       kernels=sorted(drifting))
+        doc = {
+            "status": status,
+            "symptom": ("Dispatch bandwidth tracks the roofline model."
+                        if status == GREEN else
+                        f"Kernel(s) {', '.join(sorted(drifting))} ran "
+                        f"below the roofline efficiency floor over the "
+                        f"last window."),
+            "details": {"kernels": kernels,
+                        "floor_pct": floor,
+                        "drift_fraction": drift_frac,
+                        "min_window_dispatches": min_d,
+                        "peak_bandwidth_gbps":
+                            _rl.peak_bandwidth_gbps()},
+        }
+        if status != GREEN:
+            doc["impacts"] = [_impact(
+                "dispatch_efficiency:bandwidth_drift", 3,
+                "Dispatches are moving their modeled bytes slower than "
+                "this machine has demonstrated it can — latency and "
+                "throughput are degraded relative to the same "
+                "hardware's own recent baseline.", ["search"])]
+            doc["diagnosis"] = [_diagnosis(
+                "dispatch_efficiency:below_floor",
+                "Sustained per-dispatch bandwidth below the configured "
+                "floor (or the session's watermark): device/host "
+                "contention, a throttled container, or a kernel "
+                "regression.",
+                "Read GET /_profiler/timeline for the dispatch "
+                "timeline (queue/prep/execute/fetch overlap per "
+                "dispatcher thread) and watch "
+                "es_dispatch_efficiency_pct{kernel} / "
+                "es_dispatch_bandwidth_gbps{kernel}.",
+                {"kernels": sorted(drifting)})]
         return doc
 
     def _ind_task_backlog(self) -> dict:

@@ -91,9 +91,12 @@ def runtime_families() -> Set[str]:
                 "vec": {"type": "dense_vector", "dims": 4}}}}).encode())
         api.handle("PUT", "/lint/_doc/1", "refresh=true", json.dumps(
             {"body": "quick brown fox", "vec": [1, 0, 0, 0]}).encode())
-        # text plane dispatch (+ latency family with exemplar)
+        # text plane dispatch (+ latency family with exemplar); the
+        # X-Opaque-Id header registers the per-tenant es_tenant_*
+        # attribution rollup the same deterministic way
         api.handle("POST", "/lint/_search", "", json.dumps(
-            {"query": {"match": {"body": "quick"}}}).encode())
+            {"query": {"match": {"body": "quick"}}}).encode(),
+            headers={"X-Opaque-Id": "lint-tenant"})
         # plane-path request cache hit/miss counters
         api.handle("POST", "/lint/_search", "", json.dumps(
             {"query": {"match": {"body": "quick"}}}).encode())

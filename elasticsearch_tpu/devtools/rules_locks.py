@@ -54,9 +54,13 @@ _NON_SERVING_ATTR = re.compile(r"metric")
 
 #: flightrec counts as telemetry for L02: a flight-recorder journal
 #: write under a serving lock would back serving up behind the
-#: observability layer exactly like a registry write would
+#: observability layer exactly like a registry write would — as do the
+#: dispatch-timeline profiler ring (``search/dispatch_profile``) and
+#: the roofline auditor (``common/roofline``), both written once per
+#: dispatch from the dispatcher loop
 TELEMETRY_MODULES = re.compile(
-    r"(^|\.)common\.(telemetry|tracing|flightrec)$")
+    r"(^|\.)(common\.(telemetry|tracing|flightrec|roofline)"
+    r"|search\.dispatch_profile)$")
 
 _LOCK_CTORS = {"Lock", "RLock"}
 

@@ -753,6 +753,8 @@ class ClusterRestService:
             return self._health_report(method, path, query, body)
         if path.startswith("/_flight_recorder"):
             return self._flight_recorder(method, path, query, body, segs)
+        if path.startswith("/_profiler/timeline"):
+            return self._profiler_timeline(method, path, query, body)
         if segs and segs[0] == "_nodes" and segs[-1] == "hot_threads":
             return self._hot_threads(method, path, query, body, segs)
         if method == "GET" and segs and (
@@ -2153,6 +2155,68 @@ class ClusterRestService:
         if limit > 0:
             events = events[-limit:]
         merged = dict(local_doc, events=events,
+                      nodes_reporting=len(docs))
+        return 200, "application/json", json.dumps(merged).encode()
+
+    def _profiler_timeline(self, method, path, query, body):
+        """Cluster ``GET /_profiler/timeline``: every node renders its
+        local dispatch-profile ring (the flight-recorder fan-in
+        pattern — one concurrent ``rest:exec`` window, dead peers cost
+        one timeout total) and the front merges the Chrome trace-event
+        streams. Per-node dedup is by full event identity: in-process
+        test clusters share one ring, so two nodes report byte-identical
+        events (same deterministic pid from the (node, batcher) track
+        key) which must appear exactly once; production processes
+        contribute disjoint tracks."""
+        status, ct, out = self._local(method, path, query, body)
+        peers = [n for n in self.node.node_ids if n != self.node.node_id]
+        if not peers or method != "GET" or status != 200:
+            return status, ct, out
+        try:
+            local_doc = json.loads(out)
+        except ValueError:
+            return status, ct, out
+        docs = [local_doc]
+        for st_n, payload in self._fanout_rest_exec(
+                method, path, query, body, peers).values():
+            if st_n != 200:
+                continue
+            try:
+                doc_n = json.loads(payload)
+            except ValueError:
+                continue
+            if isinstance(doc_n, dict):
+                docs.append(doc_n)
+        seen = set()
+        meta, spans = [], []
+        for d in docs:
+            for ev in d.get("traceEvents", ()):
+                key = json.dumps(ev, sort_keys=True)
+                if key in seen:
+                    continue
+                seen.add(key)
+                (meta if ev.get("ph") == "M" else spans).append(ev)
+        spans.sort(key=lambda ev: (ev.get("ts", 0), ev.get("pid", 0)))
+        # re-apply the request's limit AFTER the merge, in RECORDS (the
+        # flight-recorder merge's lesson): each node already truncated
+        # to its newest `limit` records, so without this the client
+        # gets up to n_nodes x limit — and not the cluster-wide newest
+        # slice. A record's stage events share (pid, args.rec).
+        from urllib.parse import parse_qs
+        try:
+            limit = int((parse_qs(query).get("limit") or [256])[-1])
+        except ValueError:
+            limit = 256
+        if limit > 0:
+            newest: Dict[tuple, float] = {}
+            for ev in spans:
+                key = (ev.get("pid"), (ev.get("args") or {}).get("rec"))
+                newest[key] = max(newest.get(key, 0), ev.get("ts", 0))
+            keep = set(sorted(newest, key=lambda k: newest[k])[-limit:])
+            spans = [ev for ev in spans
+                     if (ev.get("pid"),
+                         (ev.get("args") or {}).get("rec")) in keep]
+        merged = dict(local_doc, traceEvents=meta + spans,
                       nodes_reporting=len(docs))
         return 200, "application/json", json.dumps(merged).encode()
 

@@ -238,17 +238,31 @@ class TaskManager:
         #: ledger at snapshot time, so the counters stay monotonic)
         self._res_lock = threading.Lock()
         self._action_totals: Dict[str, Dict[str, float]] = {}
+        #: X-Opaque-Id → folded per-tenant usage (the metering
+        #: prerequisite for multi-tenant QoS: request count, wall
+        #: latency, device-ms, docs scanned, cpu-ms). Bounded by the
+        #: registry's series-cardinality cap — tenants past it collapse
+        #: into one "overflow" row, the registry's own overflow shape.
+        self._tenant_totals: Dict[str, Dict[str, float]] = {}
         from ..common import telemetry as _tm
+        self.TENANT_MAX = _tm.TelemetryRegistry.MAX_SERIES
         _tm.DEFAULT.register_object_collector(
             f"tasks:{node_id}", self, TaskManager._task_families)
 
     _RES_KEYS = ("cpu_ms", "device_ms", "h2d_bytes", "d2h_bytes",
                  "docs_scanned", "delta_docs_scanned", "dispatches")
 
+    _TENANT_KEYS = ("requests", "latency_ms", "device_ms",
+                    "docs_scanned", "cpu_ms")
+
     def _fold_resources(self, task: Task) -> None:
         r = task.resources
         with r._lock:
             vals = {k: getattr(r, k) for k in self._RES_KEYS}
+        tenant = task.headers.get("X-Opaque-Id")
+        if tenant:
+            self._fold_tenant(str(tenant), task, vals,
+                              time.time() - task.start_time)
         if not any(vals.values()):
             return
         with self._res_lock:
@@ -257,6 +271,48 @@ class TaskManager:
             tot["count"] = tot.get("count", 0) + 1
             for k, v in vals.items():
                 tot[k] += v
+
+    def _fold_tenant(self, tenant: str, task: Task, vals: dict,
+                     wall_s: float) -> None:
+        with self._res_lock:
+            if tenant not in self._tenant_totals and \
+                    len(self._tenant_totals) >= self.TENANT_MAX:
+                tenant = "overflow"
+            tot = self._tenant_totals.setdefault(
+                tenant, {k: 0.0 for k in self._TENANT_KEYS})
+            tot["requests"] += 1
+            tot["latency_ms"] += wall_s * 1e3
+            tot["device_ms"] += vals.get("device_ms", 0.0)
+            tot["docs_scanned"] += vals.get("docs_scanned", 0)
+            tot["cpu_ms"] += vals.get("cpu_ms", 0.0)
+
+    def tenant_totals(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant (X-Opaque-Id) usage: completed tasks' folded
+        rollups plus every live opaque-labeled task's current ledger at
+        snapshot time (monotone, like :meth:`action_totals`)."""
+        with self._res_lock:
+            out = {t: dict(v) for t, v in self._tenant_totals.items()}
+        now = time.time()
+        with self.lock:
+            live = list(self.tasks.values())
+        for t in live:
+            tenant = t.headers.get("X-Opaque-Id")
+            if not tenant:
+                continue
+            tenant = str(tenant)
+            if tenant not in out and len(out) >= self.TENANT_MAX:
+                tenant = "overflow"
+            r = t.resources
+            with r._lock:
+                dev, docs, cpu = r.device_ms, r.docs_scanned, r.cpu_ms
+            tot = out.setdefault(
+                tenant, {k: 0.0 for k in self._TENANT_KEYS})
+            tot["requests"] += 1
+            tot["latency_ms"] += (now - t.start_time) * 1e3
+            tot["device_ms"] += dev
+            tot["docs_scanned"] += docs
+            tot["cpu_ms"] += cpu
+        return out
 
     def action_totals(self) -> Dict[str, Dict[str, float]]:
         """Per-action resource totals: completed tasks' folded ledgers
@@ -293,7 +349,38 @@ class TaskManager:
                          int(tot.get("d2h_bytes", 0))))
             docs.append((alb, int(tot.get("docs_scanned", 0))))
             count.append((alb, int(tot.get("count", 0))))
-        return {
+        # per-tenant (X-Opaque-Id) rollup — the metering prerequisite
+        # for multi-tenant QoS: who is burning the latency budget,
+        # device time and scan volume (bounded: tenants past the
+        # registry series cap fold into one "overflow" row)
+        t_req, t_lat, t_dev, t_docs = [], [], [], []
+        for tenant, tot in sorted(self.tenant_totals().items()):
+            tlb = dict(lbl, tenant=tenant)
+            t_req.append((tlb, int(tot.get("requests", 0))))
+            t_lat.append((tlb, round(tot.get("latency_ms", 0.0), 3)))
+            t_dev.append((tlb, round(tot.get("device_ms", 0.0), 3)))
+            t_docs.append((tlb, int(tot.get("docs_scanned", 0))))
+        out = {}
+        if t_req:
+            out.update({
+                "es_tenant_requests_total": {
+                    "type": "counter",
+                    "help": "requests attributed to X-Opaque-Id tenants",
+                    "samples": t_req},
+                "es_tenant_latency_millis_total": {
+                    "type": "counter",
+                    "help": "wall latency attributed to tenants",
+                    "samples": t_lat},
+                "es_tenant_device_millis_total": {
+                    "type": "counter",
+                    "help": "device dispatch-ms attributed to tenants",
+                    "samples": t_dev},
+                "es_tenant_docs_scanned_total": {
+                    "type": "counter",
+                    "help": "docs scanned attributed to tenants",
+                    "samples": t_docs},
+            })
+        out.update({
             "es_task_cpu_millis_total": {
                 "type": "counter",
                 "help": "host CPU-ms attributed to tasks by action",
@@ -314,7 +401,8 @@ class TaskManager:
                 "type": "counter",
                 "help": "tasks completed with non-zero resource usage",
                 "samples": count},
-        }
+        })
+        return out
 
     def register(self, action: str, description: str = "",
                  cancellable: bool = False,

@@ -2443,6 +2443,14 @@ class DistributedSearchPlane:
             stages["prep_ms"] = (t1 - t0) * 1e3
             stages["dispatch_ms"] = (t2 - t1) * 1e3
             stages["fetch_ms"] = (time.perf_counter() - t2) * 1e3
+            # roofline audit inputs (common/roofline.py): the dense-tier
+            # stream (U-gather working set when the batch gathered used
+            # rows) + the sparse sorted-merge tile — the ROOFLINE.md
+            # per-dispatch cost model for this exact dispatch's shapes
+            from ..common import roofline as _rl
+            stages["kernel"] = "bm25_eager"
+            stages["model_bytes"] = _rl.model_bytes_bm25_dense(
+                B_pad, Q, L, U if use_tiered else 0, self.n_pad)
         if with_totals:
             totals = [int(c) for c in np.asarray(out[2])[:B]]
             return vals, hits, totals
@@ -2473,6 +2481,7 @@ class DistributedSearchPlane:
         vals_out = np.full((len(queries), k), NEG_INF, np.float32)
         hits_out: List[List[Tuple[int, int]]] = []
         totals: List[int] = []
+        postings_touched = 0
         for bi, terms in enumerate(queries):
             weights: Dict[str, float] = {}
             for t in terms:
@@ -2507,6 +2516,7 @@ class DistributedSearchPlane:
                         scores[csr["docs"][st:en]] += \
                             idfw * csr["impacts"][st:en]
                         matched = True
+                        postings_touched += en - st
                 if not matched:
                     continue
                 if with_totals:
@@ -2535,6 +2545,13 @@ class DistributedSearchPlane:
             stages["dispatch_ms"] = (time.perf_counter() - t0) * 1e3
             stages["fetch_ms"] = 0.0
             stages["compile_cache"] = "host"
+            # roofline audit inputs: postings read + per-query N-wide
+            # score array (ROOFLINE.md block-max table, eager column)
+            from ..common import roofline as _rl
+            stages["kernel"] = "bm25_eager"
+            stages["postings_touched"] = postings_touched
+            stages["model_bytes"] = _rl.model_bytes_bm25_eager(
+                len(queries), postings_touched, self.n_docs_total)
         if with_totals:
             return vals_out, hits_out, totals
         return vals_out, hits_out
@@ -2903,6 +2920,10 @@ class DistributedSearchPlane:
             stages["lex_blocks_scored"] = blocks_scored
             stages["lex_blocks_total"] = blocks_total
             stages["lex_survivors"] = surv_total
+            from ..common import roofline as _rl
+            stages["kernel"] = "bm25_pruned"
+            stages["model_bytes"] = _rl.model_bytes_bm25_pruned(
+                q_bytes, x_bytes)
         if with_totals:
             return vals_out, hits_out, totals
         return vals_out, hits_out
@@ -3074,6 +3095,10 @@ class DistributedSearchPlane:
             stages["docs_scanned"] = blocks_scored * BS // max(B, 1)
             stages["lex_blocks_scored"] = blocks_scored
             stages["lex_blocks_total"] = blocks_total
+            from ..common import roofline as _rl
+            stages["kernel"] = "bm25_pruned"
+            stages["model_bytes"] = _rl.model_bytes_bm25_pruned(
+                q_bytes, x_bytes)
         if with_totals:
             return vals_out, hits_out, totals
         return vals_out, hits_out
@@ -3665,6 +3690,13 @@ class DistributedKnnPlane:
             stages["compile_cache"] = "miss" if compiled else "hit"
             stages["h2d_bytes"] = q.nbytes
             stages["d2h_bytes"] = vals.nbytes + gdocs.nbytes
+            # roofline audit inputs: the f32 corpus streams once per
+            # batch (ROOFLINE.md kNN bytes-moved model)
+            from ..common import roofline as _rl
+            stages["kernel"] = "knn_exact"
+            stages["model_bytes"] = _rl.model_bytes_knn_exact(
+                self.n_shards * self.n_pad, max(self.dim, 1),
+                l2=self.similarity == "l2_norm")
         return vals, hits
 
     def _decode_hits(self, vals, gdocs):
@@ -3770,6 +3802,10 @@ class DistributedKnnPlane:
             stages["dispatch_ms"] = (time.perf_counter() - t0) * 1e3
             stages["fetch_ms"] = 0.0
             stages["compile_cache"] = "host"
+            from ..common import roofline as _rl
+            stages["kernel"] = "knn_exact"
+            stages["model_bytes"] = _rl.model_bytes_knn_exact(
+                self.n_shards * self.n_pad, max(self.dim, 1), l2=l2)
         return best_v, self._decode_hits(best_v, best_g)
 
     # -- IVF: cluster-pruned quantized scan + exact re-rank ------------------
@@ -3801,6 +3837,10 @@ class DistributedKnnPlane:
         if stages is not None:
             stages["ann_quantized_bytes"] = q_bytes
             stages["ann_exact_bytes"] = x_bytes
+            from ..common import roofline as _rl
+            stages["kernel"] = "knn_ivf"
+            stages["model_bytes"] = _rl.model_bytes_knn_ivf(
+                q_bytes, x_bytes)
 
     def search_ivf(self, query_vectors, k: int = 10, *, nprobe: int,
                    rerank: int, stages: Optional[dict] = None):

@@ -658,6 +658,7 @@ class RestAPI:
         add("GET", "/_prometheus/metrics", self.h_prometheus)
         add("GET", "/_trace", self.h_trace_list)
         add("GET", "/_trace/{trace_id}", self.h_trace_get)
+        add("GET", "/_profiler/timeline", self.h_profiler_timeline)
         add("GET", "/_flight_recorder", self.h_flight_recorder)
         add("GET", "/_flight_recorder/captures", self.h_flight_captures)
         add("GET", "/_flight_recorder/captures/{capture_id}",
@@ -2069,6 +2070,38 @@ class RestAPI:
                 f"trace [{trace_id}] is not in the trace store (bounded "
                 f"ring of {DEFAULT_STORE.MAX_TRACES} traces; GET /_trace "
                 f"lists the ids still retained)")
+        return doc
+
+    def h_profiler_timeline(self, params, body):
+        """GET /_profiler/timeline: the per-dispatch timeline ring
+        (``search/dispatch_profile.py``) rendered as Chrome trace-event
+        JSON (perfetto-loadable — one process per batcher, one track
+        per dispatcher thread plus a ``queue`` track). ``since`` is an
+        epoch-ms floor (or a relative value like ``30s``), ``limit``
+        caps the record count. The cluster front fans this out per node
+        and merges with per-node dedup (``node/cluster_rest``)."""
+        from ..search import dispatch_profile as _dp
+        since_ms = None
+        raw = params.get("since")
+        if raw:
+            try:
+                since_ms = float(raw)
+            except ValueError:
+                from ..common.settings import parse_time_millis
+                since_ms = time.time() * 1e3 - parse_time_millis(raw)
+        try:
+            limit = int(params.get("limit", 256))
+        except ValueError:
+            raise IllegalArgumentError(
+                f"[limit] must be an integer, got [{params.get('limit')}]")
+        # records carry the node bound at slot enqueue; the renderer
+        # deliberately does NOT substitute this node's id for node-less
+        # records — in-process cluster nodes share the ring, and the
+        # fan-in's dedup needs every node to render a shared record
+        # IDENTICALLY
+        recs = _dp.RING.records(since_ms=since_ms, limit=limit)
+        doc = _dp.chrome_trace(recs)
+        doc["ring"] = _dp.RING.stats_doc()
         return doc
 
     def h_flight_recorder(self, params, body):

@@ -85,11 +85,22 @@ def empty_serving_stats() -> Dict[str, int]:
 class _Slot:
     __slots__ = ("terms", "k", "done", "vals", "hits", "total", "error",
                  "t_enq", "rounds_skipped", "stage_ms", "info",
-                 "view_segments", "view_key", "params")
+                 "view_segments", "view_key", "params", "trace_id",
+                 "node")
 
     def __init__(self, terms, k: int, view=None, params=None):
         self.terms = terms
         self.k = k
+        #: the enqueuing request's trace id + ambient node (captured
+        #: HERE, on the request thread — dispatcher threads carry no
+        #: request context): the dispatch profiler's record and the
+        #: roofline efficiency exemplar both link back through them,
+        #: and the node stamp keeps the cluster fan-in's per-node
+        #: dedup exact (in-process nodes share the ring)
+        from ..common import flightrec as _fr
+        from ..common import tracing as _tracing
+        self.trace_id = _tracing.current_trace_id()
+        self.node = _fr.ambient_node()
         #: extra dispatch parameters that shape the kernel (kNN IVF:
         #: bucketed (nprobe, rerank)) — co-batching only within one
         #: params tuple, so the compile-shape lattice stays warm
@@ -121,6 +132,9 @@ class _Slot:
 class PlaneMicroBatcher:
     """Batches ``plane.search`` dispatches for one plane behind a
     dedicated dispatcher thread."""
+
+    #: batcher kind label (timeline tracks, es_batcher_queue_depth)
+    kind = "text"
 
     #: concurrent dispatcher threads: 2 pipelines host prep of batch N+1
     #: with the device execution / result sync of batch N
@@ -370,7 +384,13 @@ class PlaneMicroBatcher:
         fetch_base_ms = plane_stages.get("fetch_ms", 0.0)
         batch_info = {"batch_size": len(batch), "k_bucket": k,
                       "compile_cache": plane_stages.get("compile_cache",
-                                                        "hit")}
+                                                        "hit"),
+                      # the dispatch's mesh topology, so profile:true
+                      # responses name the device fan-out next to the
+                      # per-device docs share below
+                      "mesh": {"shard_devices": self.mesh_shard_devices,
+                               "replica_devices":
+                                   self.mesh_replica_devices}}
         # task resource attribution (node/task_manager.TaskResources):
         # the dispatch's transfer bytes split across the batch's slots
         # (so per-task sums reconcile with es_device_transfer_bytes_total)
@@ -406,20 +426,6 @@ class PlaneMicroBatcher:
             batch_info["delta_ms"] = round(delta_ms, 3)
             batch_info["delta_docs"] = int(
                 plane_stages.get("delta_docs", 0))
-        # flight-recorder slow-dispatch journal: a dispatch whose device
-        # pipeline (prep + dispatch + base fetch) ran past the settings-
-        # driven threshold leaves a durable event. Emitted OUTSIDE the
-        # batcher lock (ESTP-L02: no recorder write under a serving lock)
-        from ..common import flightrec as _fr
-        slow_ms = prep_ms + dispatch_ms + fetch_base_ms
-        if err is None and slow_ms > _fr.slow_dispatch_threshold_ms():
-            _fr.record(
-                "slow_dispatch", plane=type(self.plane).__name__,
-                batch_size=len(batch), k_bucket=k,
-                prep_ms=round(prep_ms, 3),
-                dispatch_ms=round(dispatch_ms, 3),
-                fetch_ms=round(fetch_base_ms, 3),
-                compile_cache=batch_info.get("compile_cache"))
         with self._cond:
             racedep.note_write("microbatch.stats", self)
             fetch_ms = fetch_base_ms + \
@@ -441,6 +447,112 @@ class PlaneMicroBatcher:
                 self.delta_ms += delta_ms
             self.max_seen_batch = max(self.max_seen_batch, len(batch))
             self._cond.notify_all()
+        t_end = time.perf_counter()
+        # dispatch-timeline record + roofline audit, then the
+        # flight-recorder slow-dispatch journal — ALL outside the
+        # batcher lock (ESTP-L02: no profiler/telemetry/recorder write
+        # under a serving lock). The slow event carries the profile
+        # record's seq so the two journals cross-link.
+        rec = self._profile_dispatch(
+            batch, n_uniq=len(slot_of), k=k, b_pad=b_pad,
+            t_pick=t_pick, t_call=t_call, t_done=t_done, t_end=t_end,
+            plane_stages=plane_stages, batch_info=batch_info, err=err)
+        from ..common import flightrec as _fr
+        slow_ms = prep_ms + dispatch_ms + fetch_base_ms
+        if err is None and slow_ms > _fr.slow_dispatch_threshold_ms():
+            _fr.record(
+                "slow_dispatch", plane=type(self.plane).__name__,
+                batch_size=len(batch), k_bucket=k,
+                prep_ms=round(prep_ms, 3),
+                dispatch_ms=round(dispatch_ms, 3),
+                fetch_ms=round(fetch_base_ms, 3),
+                compile_cache=batch_info.get("compile_cache"),
+                profile_rec=rec.get("seq"))
+
+    def _kernel_family(self, params, plane_stages: dict) -> str:
+        """ROOFLINE.md kernel family of one dispatch (the serving path
+        stamps ``stages['kernel']`` when it knows better — e.g. a prune
+        request that routed eager past the θ-window cap)."""
+        k = plane_stages.get("kernel") if plane_stages else None
+        if k:
+            return str(k)
+        if params is not None and params[0] == "prune" and params[1] \
+                and getattr(self.plane, "blockmax", None) is not None:
+            return "bm25_pruned"
+        return "bm25_eager"
+
+    def _profile_dispatch(self, batch, *, n_uniq: int, k: int,
+                          b_pad: int, t_pick: float, t_call: float,
+                          t_done: float, t_end: float,
+                          plane_stages: dict, batch_info: dict,
+                          err) -> dict:
+        """Append this dispatch's timeline record (bounded ring,
+        ``search/dispatch_profile.py``) and audit it against the
+        ROOFLINE bytes model. Runs on the dispatcher thread, never
+        under a lock; O(1) and never raises."""
+        try:
+            from ..common import roofline as _rf
+            from . import dispatch_profile as _dp
+            mono_end = time.perf_counter()
+            wall_end = time.time()
+
+            def wall(t: float) -> float:
+                return (wall_end - (mono_end - t)) * 1e3
+
+            q_start = min(s.t_enq for s in batch)
+            stages = [
+                {"name": name,
+                 "start_ms": round(wall(a), 3),
+                 "end_ms": round(wall(b), 3),
+                 "mono_start_ms": round(a * 1e3, 3),
+                 "mono_end_ms": round(b * 1e3, 3)}
+                for name, a, b in (
+                    ("queue", q_start, t_pick), ("prep", t_pick, t_call),
+                    ("execute", t_call, t_done), ("fetch", t_done, t_end))]
+            kernel = self._kernel_family(batch[0].params, plane_stages)
+            model_b = plane_stages.get("model_bytes")
+            if model_b is None:
+                model_b = _rf.fallback_model_bytes(
+                    kernel, self.plane, n_uniq, k)
+            audit = None
+            if err is None:
+                exemplar = next(
+                    (s.trace_id for s in batch if s.trace_id), None)
+                # the plane's own refined device-execute wall when it
+                # reports one (the whole-call wall includes plane-side
+                # host prep + fetch decode — charging those as
+                # "bandwidth" would misattribute a host regression)
+                exec_ms = plane_stages.get(
+                    "dispatch_ms", (t_done - t_call) * 1e3)
+                audit = _rf.audit(kernel, model_b, exec_ms,
+                                  exemplar=exemplar)
+            me = threading.current_thread()
+            return _dp.record(
+                ts_ms=round(wall(q_start), 3),
+                mono_ms=round(q_start * 1e3, 3),
+                end_ms=round(wall(t_end), 3),
+                node=next((s.node for s in batch if s.node), None),
+                batcher=f"{self.kind}:{id(self):x}", kind=self.kind,
+                kernel=kernel, thread=me.ident, thread_name=me.name,
+                bucket={"k": k,
+                        "params": repr(batch[0].params)
+                        if batch[0].params is not None else None,
+                        "view": len(batch[0].view_segments)
+                        if batch[0].view_segments is not None else None},
+                batch={"requests": len(batch), "unique": n_uniq,
+                       "b_pad": b_pad,
+                       "mesh": batch_info.get("mesh")},
+                # dispatch TOTALS (batch_info carries the per-slot
+                # share for task attribution)
+                bytes={"h2d": int(plane_stages.get("h2d_bytes") or 0),
+                       "d2h": int(plane_stages.get("d2h_bytes") or 0),
+                       "model": int(model_b or 0)},
+                compile_cache=batch_info.get("compile_cache"),
+                docs_scanned=batch_info.get("docs_scanned"),
+                error=type(err).__name__ if err is not None else None,
+                stages=stages, audit=audit)
+        except Exception:   # noqa: BLE001 — the profiler must never
+            return {}       # take down the dispatch it observes
 
     # -- warmup (shape-lattice pre-compile) ---------------------------------
 
@@ -627,6 +739,16 @@ class KnnPlaneMicroBatcher(PlaneMicroBatcher):
     of how many requests share it. Slots carry query vectors instead of
     term bags; there is no totals concept (kNN always matches its k)."""
 
+    kind = "knn"
+
+    def _kernel_family(self, params, plane_stages: dict) -> str:
+        k = plane_stages.get("kernel") if plane_stages else None
+        if k:
+            return str(k)
+        if params is not None and params[0] > 0:
+            return "knn_ivf"
+        return "knn_exact"
+
     def _pad_slot(self):
         # zero vector: scores 0.0 everywhere (or -‖v‖² under l2), results
         # discarded with the slot
@@ -687,6 +809,11 @@ class FusedPlaneMicroBatcher(PlaneMicroBatcher):
     SHAPE via ``params`` (fusion kind, rescore mode, windows,
     bag-vs-bool route, knn knobs), so one dispatch always runs one
     compiled program."""
+
+    kind = "fused"
+
+    def _kernel_family(self, params, plane_stages: dict) -> str:
+        return "fused"
 
     def _pad_slot(self):
         return {"bag": [], "clauses": [], "msm": 0, "qv": None,

@@ -578,6 +578,14 @@ class FusedPlanRunner:
             stages["compile_cache"] = "host"
             if "docs_scanned" in t_stages:
                 stages["docs_scanned"] = t_stages["docs_scanned"]
+            # roofline audit: the fused dispatch's model bytes are the
+            # sum of its component stages' stamped models (the text
+            # side may be pruned — the coarse fused fallback would
+            # overcharge it a full eager scan)
+            mb = int(t_stages.get("model_bytes") or 0) + \
+                int(k_stages.get("model_bytes") or 0)
+            if mb:
+                stages["model_bytes"] = mb
         return vals_out, hits_out, totals_out
 
     def _text_bool_view(self, bqs, *, k, view, stages):

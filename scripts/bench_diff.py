@@ -153,6 +153,17 @@ P99_RISE_MAX = 0.25
 #: a real packing/sharding change, not noise
 DEVICE_BYTES_GROW_MAX = 0.10
 
+#: relative per-kernel roofline-efficiency drop that fails the diff on
+#: configs embedding an ``efficiency`` summary (bench.py's per-config
+#: roofline audit delta): a dispatch moving its modeled bytes >20%
+#: slower than the baseline run on the same machine is a kernel or
+#: pipeline regression even when batched throughput masks it. Kernels
+#: present on only one side SKIP with a note (config drift, not a
+#: regression); windows under the dispatch floor carry too little
+#: signal to gate.
+EFF_DROP_MAX = 0.20
+EFF_MIN_DISPATCHES = 4
+
 #: chaos-config time_to_warm gate: regression only when the new side
 #: BOTH grew past this relative threshold AND sits above the absolute
 #: noise floor — the warm import usually completes while recovery is
@@ -252,6 +263,37 @@ def diff(old: dict, new: dict, threshold: float,
                 regressions.append(
                     f"{name} (time_to_warm_s {ow:.3f} -> {nw:.3f})")
             lines.append(ln)
+        # roofline-efficiency gate: per-kernel mean model-vs-achieved
+        # efficiency embedded by bench.py's per-config audit delta
+        # (checked before the throughput filter so an error-shaped new
+        # side still reports its paired efficiency lines)
+        oe = o.get("efficiency") if isinstance(o, dict) else None
+        ne = n.get("efficiency") if isinstance(n, dict) else None
+        if isinstance(oe, dict) and isinstance(ne, dict):
+            for kern in sorted(set(oe) | set(ne)):
+                ok_, nk_ = oe.get(kern), ne.get(kern)
+                if not isinstance(ok_, dict) or \
+                        not isinstance(nk_, dict):
+                    lines.append(f"  {name:40s} efficiency[{kern}] "
+                                 f"SKIPPED (one-sided)")
+                    continue
+                ov_, nv_ = ok_.get("mean_pct"), nk_.get("mean_pct")
+                if not isinstance(ov_, (int, float)) or \
+                        not isinstance(nv_, (int, float)) or ov_ <= 0:
+                    continue
+                if min(int(ok_.get("n", 0)),
+                       int(nk_.get("n", 0))) < EFF_MIN_DISPATCHES:
+                    continue
+                drop = (float(ov_) - float(nv_)) / float(ov_)
+                eflag = ""
+                if drop > EFF_DROP_MAX:
+                    eflag = "  << EFFICIENCY REGRESSION"
+                    regressions.append(
+                        f"{name} (efficiency[{kern}] {ov_:.2f} -> "
+                        f"{nv_:.2f} %, {-drop:+.1%})")
+                lines.append(
+                    f"  {name:40s} efficiency[{kern}] {ov_:.2f} -> "
+                    f"{nv_:.2f} %  {-drop:+7.1%}{eflag}")
         if not _is_throughput(o):
             continue                     # nothing numeric to compare
         if not _is_throughput(n):

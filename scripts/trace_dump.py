@@ -18,6 +18,14 @@ the span tree at the deepest span whose window contains the event's
 timestamp — a failover wave or breaker trip renders INSIDE the request
 that felt it.
 
+``--chrome PATH`` writes the span tree (plus the ``--events`` journal
+interleave when requested) as Chrome trace-event JSON — the SAME format
+``GET /_profiler/timeline`` serves for dispatch timelines — so a
+request's span tree and the dispatch timeline that served it load
+side-by-side in perfetto/chrome://tracing: spans render as complete
+``X`` events (one process per node, nested by time containment),
+journal events as instant ``i`` marks.
+
 Output, one line per span, indented by tree depth:
 
     rest[indices:data/read/search]              12.41ms  node=n0
@@ -32,6 +40,7 @@ import argparse
 import json
 import sys
 import urllib.request
+import zlib
 
 
 def _get(host: str, path: str, headers=None):
@@ -109,6 +118,58 @@ def print_tree(spans: list, depth: int = 0) -> None:
                 _print_event(item, depth + 1, base_ms=base)
 
 
+def chrome_from_spans(doc: dict, events=None) -> dict:
+    """Span tree + journal events -> Chrome trace-event JSON.
+
+    One *process* per emitting node (pid derived from the node name the
+    same way ``search/dispatch_profile.chrome_trace`` derives batcher
+    pids, so a merged load never conflates nodes); spans become
+    complete ``X`` events that nest by time containment on one track,
+    journal events become instant ``i`` marks at their wall
+    timestamp."""
+    out = []
+    named = set()
+
+    def pid_of(node: str) -> int:
+        pid = (zlib.crc32(f"trace\x00{node}".encode()) & 0x3FFFFFFF) | 1
+        if pid not in named:
+            named.add(pid)
+            out.append({"ph": "M", "name": "process_name", "pid": pid,
+                        "ts": 0, "args": {"name": f"{node} trace"}})
+            out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                        "tid": 1, "ts": 0, "args": {"name": "spans"}})
+        return pid
+
+    def walk(spans):
+        for span in spans:
+            node = str(span.get("node") or "local")
+            args = {k: v for k, v in (span.get("attrs") or {}).items()}
+            if span.get("span_id"):
+                args["span_id"] = span["span_id"]
+            out.append({
+                "ph": "X", "name": str(span.get("name", "?")),
+                "cat": "span", "pid": pid_of(node), "tid": 1,
+                "ts": round(float(span.get("start_ms", 0)) * 1e3, 1),
+                "dur": round(max(float(span.get("took_ms", 0)), 0.0)
+                             * 1e3, 1),
+                "args": args})
+            walk(span.get("children") or [])
+
+    walk(doc.get("tree") or [])
+    for ev in events or []:
+        node = str(ev.get("node") or "local")
+        args = dict(ev.get("attrs") or {})
+        if ev.get("trace_id"):
+            args["trace_id"] = ev["trace_id"]
+        out.append({
+            "ph": "i", "name": str(ev.get("type", "?")), "cat": "journal",
+            "pid": pid_of(node), "tid": 1, "s": "p",
+            "ts": round(float(ev.get("ts_ms", 0)) * 1e3, 1),
+            "args": args})
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": {"trace_id": doc.get("trace_id")}}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace_id", nargs="?", help="trace id to dump")
@@ -124,6 +185,10 @@ def main() -> int:
                     help="interleave flight-recorder journal events "
                          "(GET /_flight_recorder?trace_id=...) into the "
                          "span tree")
+    ap.add_argument("--chrome", metavar="PATH", default=None,
+                    help="write the span tree (and --events journal) as "
+                         "Chrome trace-event JSON loadable in perfetto "
+                         "next to GET /_profiler/timeline output")
     args = ap.parse_args()
     tid = args.trace_id
 
@@ -174,6 +239,12 @@ def main() -> int:
         else:
             print(f"GET /_flight_recorder -> {status} (events omitted)",
                   file=sys.stderr)
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(chrome_from_spans(doc, events), f)
+        print(f"wrote {args.chrome} (load in ui.perfetto.dev or "
+              f"chrome://tracing)")
+        return 0
     if args.json:
         if events:
             doc["events"] = events

@@ -1,0 +1,571 @@
+"""Dispatch timeline profiler + continuous roofline auditor (ISSUE 15).
+
+Covers: the bounded per-dispatch ring (bounds, thread safety under
+concurrent dispatchers, record shape end to end through the real
+serving stack), Chrome trace-event rendering (schema validation —
+perfetto-loadable shape, per-thread track non-overlap), the
+``GET /_profiler/timeline`` REST surface with filters and the cluster
+fan-in's per-node dedup, the roofline audit math + Prometheus/
+OpenMetrics conformance of the new families (exemplar on the
+efficiency histogram), the ``dispatch_efficiency`` health indicator's
+drift window (yellow on a synthetically-throttled stream, green on
+steady — the false-positive invariant), the watchdog-sampled
+``es_batcher_queue_depth`` gauge, the flightrec ``slow_dispatch`` ↔
+timeline-record cross-link, the per-tenant ``es_tenant_*`` rollup and
+its cardinality bound, ``trace_dump.py --chrome``, and the bench_diff
+efficiency gate.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common import flightrec, roofline
+from elasticsearch_tpu.common.telemetry import TelemetryRegistry
+from elasticsearch_tpu.search import dispatch_profile as dp
+from elasticsearch_tpu.search.dispatch_profile import (DispatchProfileRing,
+                                                       chrome_trace)
+
+
+@pytest.fixture
+def api(tmp_path):
+    from elasticsearch_tpu.node.indices_service import IndicesService
+    from elasticsearch_tpu.rest.api import RestAPI
+    api = RestAPI(IndicesService(str(tmp_path)))
+    api.handle("PUT", "/dprof", "", json.dumps(
+        {"mappings": {"properties": {
+            "body": {"type": "text"},
+            "vec": {"type": "dense_vector", "dims": 4}}}}).encode())
+    api.handle("PUT", "/dprof/_doc/1", "refresh=true", json.dumps(
+        {"body": "quick brown fox", "vec": [1, 0, 0, 0]}).encode())
+    return api
+
+
+def _search(api, body, query="request_cache=false", headers=None):
+    st, _ct, out = api.handle("POST", "/dprof/_search", query,
+                              json.dumps(body).encode(),
+                              headers=headers or {})
+    assert st == 200, out
+    return json.loads(out)
+
+
+# ---------------------------------------------------------------------------
+# ring
+# ---------------------------------------------------------------------------
+
+def test_ring_bounds_and_dropped_accounting():
+    ring = DispatchProfileRing(cap=64)
+    for i in range(200):
+        assert ring.record(ts_ms=float(i), i=i).get("seq")
+    doc = ring.stats_doc()
+    assert doc["retained"] == 64 and doc["cap"] == 64
+    assert doc["emitted"] == 200 and doc["dropped"] == 136
+    recs = ring.records(limit=0) or ring.records(limit=64)
+    assert len(recs) == 64
+    # newest 64 retained, chronological, seq strictly increasing
+    assert [r["i"] for r in recs] == list(range(136, 200))
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 64
+    # since/limit filters
+    assert len(ring.records(limit=7)) == 7
+    floor = recs[-3]["ts_ms"]
+    assert [r["i"] for r in ring.records(since_ms=floor)] == \
+        [197, 198, 199]
+
+
+def test_ring_thread_safety_under_concurrent_writers():
+    ring = DispatchProfileRing(cap=256)
+    errs = []
+
+    def spam(tag):
+        try:
+            for i in range(500):
+                ring.record(ts_ms=float(i), tag=tag)
+        except Exception as e:   # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=spam, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert not errs
+    doc = ring.stats_doc()
+    assert doc["emitted"] == 4000 and doc["retained"] == 256
+    assert doc["dropped"] == 4000 - 256
+    seqs = [r["seq"] for r in ring.records(limit=256)]
+    assert len(set(seqs)) == 256
+
+
+# ---------------------------------------------------------------------------
+# record shape end to end (real serving stack)
+# ---------------------------------------------------------------------------
+
+def test_dispatch_record_shape_end_to_end(api):
+    mark = dp.RING.stats_doc()["emitted"]
+    _search(api, {"query": {"match": {"body": "quick"}}})
+    _search(api, {"knn": {"field": "vec", "query_vector": [1, 0, 0, 0],
+                          "k": 1, "num_candidates": 5}})
+    recs = [r for r in dp.RING.records(limit=0)
+            if r["seq"] > 0][- (dp.RING.stats_doc()["emitted"] - mark):]
+    assert recs, "serving dispatches must append timeline records"
+    kinds = {r["kind"] for r in recs}
+    assert {"text", "knn"} <= kinds
+    for r in recs:
+        assert r["kernel"] in roofline.KERNEL_FAMILIES
+        assert r["thread"] and r["thread_name"]
+        assert r["batch"]["requests"] >= 1
+        assert r["batch"]["mesh"]["shard_devices"] >= 1
+        names = [s["name"] for s in r["stages"]]
+        assert names == ["queue", "prep", "execute", "fetch"]
+        # stage windows are contiguous and ordered, wall and monotonic
+        for a, b in zip(r["stages"], r["stages"][1:]):
+            assert a["mono_end_ms"] == b["mono_start_ms"]
+            assert a["start_ms"] <= a["end_ms"]
+        assert r["bytes"]["model"] > 0
+        assert r["compile_cache"] in ("hit", "miss", "host")
+        # a 1-doc corpus's model bytes can round the audit to ~0 —
+        # presence and non-negativity are the invariants here
+        assert r["audit"] is not None
+        assert r["audit"]["efficiency_pct"] >= 0
+        assert r["audit"]["gbps"] >= 0
+        assert r["audit"]["peak_gbps"] > 0
+
+
+def test_profile_serving_section_carries_mesh_and_per_device_share(api):
+    doc = _search(api, {"query": {"match": {"body": "quick"}},
+                        "profile": True})
+    serving = doc["profile"]["shards"][0]["serving"]
+    assert serving["mesh"]["shard_devices"] >= 1
+    assert serving["mesh"]["replica_devices"] >= 1
+    assert serving["docs_scanned_per_device"] >= 1
+    assert serving["batch_size"] >= 1
+
+
+def test_slow_dispatch_event_cross_links_profile_record(api, monkeypatch):
+    monkeypatch.setenv("ES_TPU_FLIGHTREC_SLOW_MS", "0.0")
+    _search(api, {"query": {"match": {"body": "fox"}}})
+    evs = flightrec.DEFAULT.events(type_="slow_dispatch", limit=16)
+    assert evs
+    rec_id = evs[-1]["attrs"].get("profile_rec")
+    assert rec_id, "slow_dispatch must carry the timeline record's seq"
+    assert any(r["seq"] == rec_id for r in dp.RING.records(limit=0))
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event rendering + REST surface
+# ---------------------------------------------------------------------------
+
+def _validate_chrome(doc):
+    """Chrome trace-event JSON-object-format schema checks (what
+    perfetto's JSON importer requires)."""
+    assert isinstance(doc, dict) and isinstance(doc["traceEvents"], list)
+    json.loads(json.dumps(doc))       # round-trips as pure JSON
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "M", "i")
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert isinstance(ev["pid"], int)
+        if ev["ph"] == "M":
+            assert ev["name"] in ("process_name", "thread_name")
+            assert isinstance(ev["args"]["name"], str)
+        elif ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] > 0
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+            assert isinstance(ev["tid"], int)
+            assert isinstance(ev.get("args", {}), dict)
+
+
+def test_timeline_endpoint_chrome_schema_and_tracks(api):
+    for _i in range(3):
+        _search(api, {"query": {"match": {"body": "quick"}}})
+    st, _ct, out = api.handle("GET", "/_profiler/timeline", "", b"")
+    assert st == 200
+    doc = json.loads(out)
+    _validate_chrome(doc)
+    assert doc["ring"]["retained"] >= 1
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} >= {"queue", "prep", "execute",
+                                       "fetch"}
+    # queue spans live on the synthetic tid-0 track; dispatcher-thread
+    # tracks hold prep/execute/fetch and must not self-overlap (the
+    # trace viewer's nesting invariant)
+    assert all(e["tid"] == 0 for e in xs if e["name"] == "queue")
+    per_track = {}
+    for e in xs:
+        if e["tid"] != 0:
+            per_track.setdefault((e["pid"], e["tid"]), []).append(e)
+    for evs in per_track.values():
+        evs.sort(key=lambda e: e["ts"])
+        for a, b in zip(evs, evs[1:]):
+            assert a["ts"] + a["dur"] <= b["ts"] + 1.0   # 1 µs slack
+    # every process/thread referenced by an X event is named by an M
+    named = {(e["pid"], e.get("tid")) for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {(e["pid"], e["tid"]) for e in xs} <= named
+
+
+def test_timeline_endpoint_filters(api):
+    _search(api, {"query": {"match": {"body": "quick"}}})
+    st, _ct, out = api.handle(
+        "GET", "/_profiler/timeline",
+        f"since={time.time() * 1e3 + 1e6:.0f}", b"")
+    assert st == 200
+    assert [e for e in json.loads(out)["traceEvents"]
+            if e["ph"] == "X"] == []
+    st, _ct, out = api.handle("GET", "/_profiler/timeline", "limit=1",
+                              b"")
+    recs = {e["args"]["rec"] for e in json.loads(out)["traceEvents"]
+            if e["ph"] == "X"}
+    assert len(recs) == 1
+    st, _ct, _out = api.handle("GET", "/_profiler/timeline", "limit=x",
+                               b"")
+    assert st == 400
+
+
+def test_cluster_fan_in_dedupes_per_node(tmp_path):
+    """The front fans ``GET /_profiler/timeline`` out over rest:exec:
+    in-process nodes share the ring (and derive the same deterministic
+    pid per (node, batcher) track), so every record's stage events must
+    appear exactly ONCE after the merge."""
+    from elasticsearch_tpu.node.cluster_node import ClusterNode
+    base = 29850
+    peers = {f"dp{i}": ("127.0.0.1", base + i) for i in range(2)}
+    nodes = [ClusterNode(f"dp{i}", "127.0.0.1", base + i, peers,
+                         str(tmp_path / f"dp{i}"), seed=i)
+             for i in range(2)]
+    try:
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if any(n.coordinator.mode == "LEADER" for n in nodes):
+                break
+            time.sleep(0.05)
+        # a REAL dispatch through node 0's serving stack (the record
+        # captures the enqueuing node from the flightrec ambient), plus
+        # node-less synthetic records (rendered node-stably as "local")
+        # — BOTH must appear exactly once after the merge
+        nodes[0].rest.handle("PUT", "/dpfan", "", json.dumps(
+            {"mappings": {"properties": {
+                "body": {"type": "text"}}}}).encode())
+        nodes[0].rest.handle("PUT", "/dpfan/_doc/1", "refresh=true",
+                             json.dumps({"body": "fan out"}).encode())
+        st, _ct, _o = nodes[0].rest.handle(
+            "POST", "/dpfan/_search", "request_cache=false", json.dumps(
+                {"query": {"match": {"body": "fan"}}}).encode())
+        assert st == 200
+        marker = f"fanin:{time.time_ns():x}"
+        now_ms = time.time() * 1e3
+        for i in range(3):
+            dp.record(ts_ms=now_ms + i, end_ms=now_ms + i + 1.0,
+                      batcher=marker, kind="text", kernel="bm25_eager",
+                      thread=7, thread_name="dispatcher-7",
+                      batch={"requests": 1},
+                      stages=[{"name": "execute",
+                               "start_ms": now_ms + i,
+                               "end_ms": now_ms + i + 1.0}])
+        st, _ct, out = nodes[0].rest.handle(
+            "GET", "/_profiler/timeline", "limit=512", b"")
+        assert st == 200
+        doc = json.loads(out)
+        _validate_chrome(doc)
+        assert doc.get("nodes_reporting") == 2
+        marked = [e for e in doc["traceEvents"] if e["ph"] == "M"
+                  and e["name"] == "process_name"
+                  and marker in e["args"]["name"]]
+        assert len(marked) == 1        # one process track, both nodes
+        stage_keys = [(e["args"]["rec"], e["name"])
+                      for e in doc["traceEvents"]
+                      if e["ph"] == "X" and e["pid"] == marked[0]["pid"]]
+        assert len(stage_keys) == 3 and len(set(stage_keys)) == 3
+        # the real dispatch's record deduped too: every (rec, stage)
+        # pair in the merged stream is unique, and the serving node's
+        # own track is present exactly once
+        all_keys = [(e["args"]["rec"], e["name"], e["pid"])
+                    for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(all_keys) == len(set(all_keys))
+        served = [e for e in doc["traceEvents"] if e["ph"] == "M"
+                  and e["name"] == "process_name"
+                  and e["args"]["name"].startswith("dp0 text:")]
+        assert len(served) == 1
+        # the merged response re-applies the request's limit in
+        # RECORDS (each node already truncated to ITS newest `limit`;
+        # without this the client would get up to n_nodes x limit)
+        st, _ct, out = nodes[0].rest.handle(
+            "GET", "/_profiler/timeline", "limit=2", b"")
+        doc2 = json.loads(out)
+        rec_keys = {(e["pid"], e["args"]["rec"])
+                    for e in doc2["traceEvents"] if e["ph"] == "X"}
+        assert len(rec_keys) == 2
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:   # noqa: BLE001
+                pass
+
+
+# ---------------------------------------------------------------------------
+# roofline audit + exposition conformance
+# ---------------------------------------------------------------------------
+
+def test_audit_math_and_accumulators():
+    reg = TelemetryRegistry()
+    kern = f"testkern_math_{time.time_ns():x}"
+    # 2 GB moved in 2 ms -> 1000 GB/s achieved
+    doc = roofline.audit(kern, 2_000_000_000, 2.0, registry=reg)
+    assert doc["gbps"] == pytest.approx(1000.0)
+    peak = roofline.peak_bandwidth_gbps()
+    assert doc["efficiency_pct"] == pytest.approx(
+        100.0 * 1000.0 / peak, rel=1e-6)
+    n, s = roofline.audit_totals()[kern]
+    assert n == 1 and s == pytest.approx(doc["efficiency_pct"])
+    # no model bytes / no wall -> no audit, no accumulator movement
+    assert roofline.audit(kern, 0, 2.0, registry=reg) is None
+    assert roofline.audit(kern, 100, 0.0, registry=reg) is None
+    assert roofline.audit_totals()[kern][0] == 1
+
+
+def test_model_bytes_formulas():
+    # ROOFLINE.md formulas, spot-checked
+    assert roofline.model_bytes_bm25_eager(2, 100, 1000) == \
+        100 * 8 + 2 * 1000 * 8
+    assert roofline.model_bytes_bm25_dense(4, 8, 1024, 160, 4096) == \
+        160 * 4096 * 2 + 4 * 8 * 1024 * 8
+    assert roofline.model_bytes_bm25_pruned(500, 80) == 580
+    assert roofline.model_bytes_knn_exact(1024, 64) == 1024 * 64 * 4
+    assert roofline.model_bytes_knn_exact(1024, 64, l2=True) == \
+        1024 * 64 * 4 + 1024 * 4
+    assert roofline.model_bytes_knn_ivf(600, 40) == 640
+
+
+def test_prometheus_and_openmetrics_conformance_for_new_families():
+    reg = TelemetryRegistry()
+    for _i in range(6):
+        roofline.audit('kern"with\\esc', 1_000_000, 1.0,
+                       exemplar="trace-xyz", registry=reg)
+    reg.gauge("es_batcher_queue_depth",
+              {"index": "logs", "kind": "text"}).set(3)
+    text = reg.prometheus_text()
+    assert "# TYPE es_dispatch_bandwidth_gbps summary" in text
+    assert "# TYPE es_dispatch_efficiency_pct summary" in text
+    assert "# TYPE es_batcher_queue_depth gauge" in text
+    assert 'es_batcher_queue_depth{index="logs",kind="text"} 3' in text
+    # label-value escaping per the exposition format
+    assert 'kernel="kern\\"with\\\\esc"' in text
+    # strict 0.0.4 output carries NO exemplar suffixes
+    assert "# {trace_id=" not in text
+    # OpenMetrics rendering: the efficiency p99 line carries the
+    # dispatch's trace-id exemplar
+    om = reg.prometheus_text(exemplars=True)
+    p99_lines = [ln for ln in om.splitlines()
+                 if ln.startswith("es_dispatch_efficiency_pct{")
+                 and 'quantile="0.99"' in ln]
+    assert p99_lines and '# {trace_id="trace-xyz"}' in p99_lines[0]
+
+
+def test_queue_depth_gauge_sampled_by_watchdog_tick(api):
+    from elasticsearch_tpu.common.flightrec import (FlightRecorder,
+                                                    SloBurnEngine,
+                                                    Watchdog)
+    _search(api, {"query": {"match": {"body": "quick"}}})
+    reg = TelemetryRegistry()
+    wd = Watchdog(recorder=FlightRecorder(cap=64, registry=reg),
+                  engine=SloBurnEngine(), registry=reg)
+    wd.tick()
+    fam = reg.metrics_doc().get("es_batcher_queue_depth")
+    assert fam, "the tick must publish per-batcher queue depths"
+    labels = [s["labels"] for s in fam["series"]]
+    assert any(lb.get("index") == "dprof" and lb.get("kind") == "text"
+               for lb in labels)
+    # a vanished batcher's series zeroes out instead of freezing at its
+    # last sampled depth (stale-alert regression)
+    reg.gauge("es_batcher_queue_depth",
+              {"index": "dprof", "kind": "text"}).set(37)
+    api.handle("DELETE", "/dprof", "", b"")
+    wd.tick()
+    vals = {tuple(sorted(s["labels"].items())): s["value"]
+            for s in reg.metrics_doc()["es_batcher_queue_depth"][
+                "series"]}
+    assert vals[(("index", "dprof"), ("kind", "text"))] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# dispatch_efficiency health indicator
+# ---------------------------------------------------------------------------
+
+def _eval(api, name="dispatch_efficiency"):
+    from elasticsearch_tpu.common.health import HealthService
+    svc = HealthService(api)
+    return svc.report(indicator=name)["indicators"][name]
+
+
+def test_efficiency_indicator_drift_window(api):
+    kern = f"testkern_drift_{time.time_ns():x}"
+    # first evaluation consumes process history and baselines
+    assert _eval(api)["status"] in ("green", "yellow")
+    # steady window: 10 fast dispatches (high efficiency)
+    for _i in range(10):
+        roofline.audit(kern, 1_000_000_000, 1.0)
+    ind = _eval(api)
+    assert ind["status"] == "green"
+    assert ind["details"]["kernels"][kern]["window_dispatches"] == 10
+    base = ind["details"]["kernels"][kern]["baseline_pct"]
+    # second steady window stays green (false-positive invariant)
+    for _i in range(10):
+        roofline.audit(kern, 1_000_000_000, 1.0)
+    assert _eval(api)["status"] == "green"
+    # below the volume floor: no signal AND the window is not consumed
+    for _i in range(3):
+        roofline.audit(kern, 1_000_000_000, 10.0)
+    ind = _eval(api)
+    assert ind["status"] == "green"
+    assert ind["details"]["kernels"][kern]["pending"] is True
+    # throttled stream completes the window -> sustained drift, yellow,
+    # and a journaled transition
+    for _i in range(7):
+        roofline.audit(kern, 1_000_000_000, 10.0)
+    ind = _eval(api)
+    assert ind["status"] == "yellow"
+    k = ind["details"]["kernels"][kern]
+    assert k["window_mean_pct"] < 0.5 * base
+    assert ind["impacts"] and ind["diagnosis"]
+    assert "_profiler/timeline" in ind["diagnosis"][0]["action"]
+    evs = flightrec.DEFAULT.events(type_="dispatch_efficiency", limit=8)
+    assert evs and evs[-1]["attrs"]["transition"] == "green->yellow"
+    assert kern in evs[-1]["attrs"]["kernels"]
+    # recovery window clears it, and the recovery transition journals
+    for _i in range(10):
+        roofline.audit(kern, 1_000_000_000, 1.0)
+    assert _eval(api)["status"] == "green"
+    evs = flightrec.DEFAULT.events(type_="dispatch_efficiency", limit=8)
+    assert evs[-1]["attrs"]["transition"] == "yellow->green"
+
+
+def test_efficiency_indicator_absolute_floor(api, monkeypatch):
+    monkeypatch.setenv("ES_TPU_DISPATCH_EFF_FLOOR_PCT", "99.9")
+    kern = f"testkern_floor_{time.time_ns():x}"
+    _eval(api)                        # baseline evaluation
+    for _i in range(10):
+        roofline.audit(kern, 1_000, 1000.0)     # ~zero efficiency
+    ind = _eval(api)
+    assert ind["status"] == "yellow"
+    assert kern in {k for k in ind["details"]["kernels"]
+                    if ind["details"]["kernels"][k].get(
+                        "window_mean_pct") is not None}
+
+
+# ---------------------------------------------------------------------------
+# per-tenant attribution
+# ---------------------------------------------------------------------------
+
+def test_tenant_rollup_rides_the_ledger_fold(api):
+    _search(api, {"query": {"match": {"body": "quick"}}},
+            headers={"X-Opaque-Id": "tenant-a"})
+    _search(api, {"query": {"match": {"body": "quick"}}},
+            headers={"X-Opaque-Id": "tenant-a"})
+    _search(api, {"query": {"match": {"body": "fox"}}},
+            headers={"X-Opaque-Id": "tenant-b"})
+    _search(api, {"query": {"match": {"body": "fox"}}})   # no tenant
+    tot = api.task_manager.tenant_totals()
+    assert tot["tenant-a"]["requests"] == 2
+    assert tot["tenant-b"]["requests"] == 1
+    assert tot["tenant-a"]["latency_ms"] > 0
+    assert tot["tenant-a"]["docs_scanned"] >= 1
+    fams = api.task_manager._task_families()
+    samples = fams["es_tenant_requests_total"]["samples"]
+    by_tenant = {lb["tenant"]: v for lb, v in samples}
+    assert by_tenant["tenant-a"] == 2 and by_tenant["tenant-b"] == 1
+    for fam in ("es_tenant_latency_millis_total",
+                "es_tenant_device_millis_total",
+                "es_tenant_docs_scanned_total"):
+        assert fams[fam]["samples"]
+
+
+def test_tenant_cardinality_is_bounded(api):
+    tm = api.task_manager
+    tm.TENANT_MAX = 4
+    for i in range(10):
+        t = tm.register("indices:data/read/search",
+                        headers={"X-Opaque-Id": f"cap-tenant-{i}"})
+        t.resources.add(docs_scanned=1)
+        tm.unregister(t)
+    tot = tm.tenant_totals()
+    caps = [k for k in tot if k.startswith("cap-tenant-")]
+    assert len(caps) <= 4
+    assert tot["overflow"]["requests"] >= 6
+
+
+# ---------------------------------------------------------------------------
+# trace_dump --chrome + bench_diff efficiency gate
+# ---------------------------------------------------------------------------
+
+def _load_script(name):
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(__file__), "..",
+                           "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_dump_chrome_export():
+    td = _load_script("trace_dump")
+    doc = {"trace_id": "t-1", "tree": [{
+        "name": "rest[search]", "node": "n0", "start_ms": 1000.0,
+        "took_ms": 10.0, "span_id": "s1",
+        "children": [{"name": "shards[logs]", "node": "n0",
+                      "start_ms": 1002.0, "took_ms": 6.0,
+                      "attrs": {"index": "logs"}}]}]}
+    events = [{"type": "failover_wave", "node": "n1", "ts_ms": 1004.0,
+               "trace_id": "t-1", "attrs": {"failed": "n2"}}]
+    out = td.chrome_from_spans(doc, events)
+    _validate_chrome(out)
+    xs = {e["name"]: e for e in out["traceEvents"] if e["ph"] == "X"}
+    assert xs["rest[search]"]["ts"] == 1000.0 * 1e3
+    assert xs["rest[search]"]["dur"] == 10.0 * 1e3
+    # the child nests inside the parent's window (time containment)
+    par, kid = xs["rest[search]"], xs["shards[logs]"]
+    assert par["ts"] <= kid["ts"] and \
+        kid["ts"] + kid["dur"] <= par["ts"] + par["dur"]
+    inst = [e for e in out["traceEvents"] if e["ph"] == "i"]
+    assert inst and inst[0]["name"] == "failover_wave"
+    # distinct nodes render as distinct processes
+    assert xs["rest[search]"]["pid"] != inst[0]["pid"]
+
+
+def test_bench_diff_gates_efficiency_regression(tmp_path, capsys):
+    bd = _load_script("bench_diff")
+
+    def run(old, new):
+        po, pn = tmp_path / "o.json", tmp_path / "n.json"
+        po.write_text(json.dumps(old))
+        pn.write_text(json.dumps(new))
+        rc = bd.main([str(po), str(pn)])
+        return rc, capsys.readouterr().out
+
+    def doc(eff):
+        return {"backend": "cpu", "configs": {
+            "serving": {"value": 100.0, "unit": "req/s",
+                        "efficiency": eff}}}
+
+    # >20% per-kernel drop fails
+    rc, out = run(doc({"bm25_eager": {"n": 10, "mean_pct": 10.0}}),
+                  doc({"bm25_eager": {"n": 10, "mean_pct": 7.0}}))
+    assert rc == 1 and "EFFICIENCY REGRESSION" in out
+    # within 20% passes
+    rc, out = run(doc({"bm25_eager": {"n": 10, "mean_pct": 10.0}}),
+                  doc({"bm25_eager": {"n": 10, "mean_pct": 9.0}}))
+    assert rc == 0
+    # one-sided kernels SKIP with a note, never gate
+    rc, out = run(doc({"bm25_eager": {"n": 10, "mean_pct": 10.0}}),
+                  doc({"knn_exact": {"n": 10, "mean_pct": 1.0}}))
+    assert rc == 0 and "SKIPPED (one-sided)" in out
+    # under the dispatch floor there is too little signal to gate
+    rc, out = run(doc({"bm25_eager": {"n": 2, "mean_pct": 10.0}}),
+                  doc({"bm25_eager": {"n": 2, "mean_pct": 1.0}}))
+    assert rc == 0

@@ -229,7 +229,8 @@ def test_health_report_green_shape(api_with_index):
     assert doc["status"] in ("green", "yellow")
     assert set(doc["indicators"]) == {
         "shards_availability", "plane_serving", "compile_churn",
-        "breakers", "indexing_pressure", "task_backlog", "slo_burn"}
+        "breakers", "indexing_pressure", "task_backlog", "slo_burn",
+        "dispatch_efficiency"}
     for ind in doc["indicators"].values():
         assert ind["status"] in ("green", "yellow", "red", "unknown")
         assert ind["symptom"]
