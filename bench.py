@@ -1033,6 +1033,156 @@ def bench_hybrid_rrf_fused(rng, on_cpu):
         "index_build_s": round(build_s, 1)})
 
 
+def bench_analytics_fused(rng, on_cpu):
+    """Config: device-resident analytics through the fused planner —
+    mixed query+agg traffic (plain match queries, query+agg-tree
+    requests, and size:0 pure-analytics requests, the live-serving
+    client mix) against the SAME searcher with the fused provider
+    withheld, where agg-carrying bodies fall back to the per-segment
+    two-pass path (retrieval, then per-segment query re-execution for
+    agg masks).
+
+    Correctness is asserted in-bench BEFORE any timing: on shared eval
+    bodies the fused route's hits AND aggregation trees must equal the
+    host two-pass path exactly (int counts bitwise, the
+    lexical_10m_prune rank-safety pattern applied to analytics). The
+    fused:unfused throughput ratio is GATED at >= 2x on the mixed
+    traffic, and the fused timed window asserts ZERO steady-state XLA
+    compiles."""
+    from elasticsearch_tpu.common import telemetry as _tm
+    from elasticsearch_tpu.index.mapping import MapperService
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.search.plane_route import ServingPlaneCache
+    from elasticsearch_tpu.search.shard_search import ShardSearcher
+    n_docs = int(os.environ.get("BENCH_AGG_N_DOCS", 0)) or \
+        ((1 << 15) if on_cpu else (1 << 17))
+    vocab_n, n_tags = 2048, 32
+    mapper = MapperService({"properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "price": {"type": "double"},
+        "ts": {"type": "date"}}})
+    vocab = [f"w{i}" for i in range(vocab_n)]
+    zipf = np.minimum(rng.zipf(1.3, size=(n_docs, 10)) - 1, vocab_n - 1)
+    prices = rng.randint(0, 10_000, n_docs)
+    t_build = time.perf_counter()
+    segs = []
+    per_seg = n_docs // 2
+    for si in range(2):
+        sb = SegmentBuilder(f"s{si}")
+        for i in range(si * per_seg, (si + 1) * per_seg):
+            sb.add(mapper.parse_document(str(i), {
+                "body": " ".join(vocab[t] for t in zipf[i]),
+                "tag": f"k{i % n_tags}",
+                "price": float(prices[i]),
+                "ts": int(1_700_000_000_000 + i * 60_000)}), seq_no=i)
+        segs.append(sb.build())
+    build_s = time.perf_counter() - t_build
+    cache = ServingPlaneCache()
+
+    def searcher(fused):
+        return ShardSearcher(
+            segs, mapper,
+            plane_provider=lambda s, f: cache.plane_for(s, mapper, f),
+            fused_provider=(lambda s, tf, kf:
+                            cache.fused_runner_for(s, mapper, tf, kf))
+            if fused else None)
+
+    aggs_tree = {
+        "tags": {"terms": {"field": "tag", "size": n_tags},
+                 "aggs": {"p": {"avg": {"field": "price"}}}},
+        "per_hour": {"date_histogram": {"field": "ts",
+                                        "fixed_interval": "1h"}},
+        "price_stats": {"stats": {"field": "price"}},
+        "n_prices": {"cardinality": {"field": "price",
+                                     "precision_threshold": 100}},
+    }
+
+    def body_of(i):
+        r2 = np.random.RandomState(3000 + i)
+        terms = " ".join(vocab[min(r2.zipf(1.3) - 1, vocab_n - 1)]
+                         for _ in range(4))
+        body = {"query": {"match": {"body": terms}}, "size": 10}
+        if i % 4 == 1:
+            return body                      # plain search traffic
+        body["aggs"] = aggs_tree
+        if i % 4 == 3:
+            body["size"] = 0                 # pure analytics
+        return body
+
+    n_eval, n_timed = 8, 24
+    bodies = [body_of(i) for i in range(n_timed)]
+    s_fused, s_unfused = searcher(True), searcher(False)
+    for w in (0, 1, 3):                      # warm every traffic class
+        s_unfused.search(dict(bodies[w]))
+        s_fused.search(dict(bodies[w]))
+    # exactness gate on the shared eval bodies: hits, totals AND the
+    # full aggregation trees (int counts are bitwise; sums/avgs run the
+    # same reduce code on both routes)
+    for i in range(n_eval):
+        rf = s_fused.search(dict(bodies[i]))
+        ru = s_unfused.search(dict(bodies[i]))
+        same = ([h.doc_id for h in rf.hits] ==
+                [h.doc_id for h in ru.hits]
+                and rf.aggregations == ru.aggregations
+                and (rf.total, rf.total_relation) ==
+                (ru.total, ru.total_relation))
+        if not same:
+            raise SystemExit(
+                f"analytics_fused exactness violated on body {i}: "
+                f"fused != host two-pass")
+    ts_unf = []
+    for bdy in bodies:
+        t0 = time.perf_counter()
+        s_unfused.search(dict(bdy))
+        ts_unf.append(time.perf_counter() - t0)
+    compiles_before = _tm.compile_count()
+    ts_fus = []
+    for bdy in bodies:
+        t0 = time.perf_counter()
+        s_fused.search(dict(bdy))
+        ts_fus.append(time.perf_counter() - t0)
+    steady_compiles = _tm.compile_count() - compiles_before
+    if steady_compiles:
+        raise SystemExit(
+            f"analytics_fused: {steady_compiles} steady-state compiles "
+            f"in the fused window (agg plan lattice failed to warm)")
+    ts_fus = np.asarray(ts_fus)
+    fused_qps = n_timed / ts_fus.sum()
+    unfused_qps = n_timed / sum(ts_unf)
+    ratio = fused_qps / unfused_qps
+    if ratio < 2.0:
+        raise SystemExit(
+            f"analytics_fused below the 2x acceptance bar: "
+            f"{ratio:.2f}x ({unfused_qps:.1f} -> {fused_qps:.1f} q/s)")
+    doc = _tm.DEFAULT.metrics_doc()
+    planner = doc.get("es_planner_lowered_total")
+    fused_served = int(sum(
+        s["value"] for s in (planner or {}).get("series", [])
+        if s["labels"].get("outcome") == "fused"))
+    if not fused_served:
+        raise SystemExit("analytics_fused: the planner never served — "
+                         "the bench measured legacy vs legacy")
+    agg_hist = doc.get("es_agg_stages_per_dispatch", {}).get("series")
+    agg_dispatches = int(agg_hist[0]["value"]["count"]) if agg_hist \
+        else 0
+    dev_pairs = doc.get("es_agg_device_pairs_total", {}).get("series")
+    cache.release()
+    return _emit("analytics_fused", {
+        "value": round(fused_qps, 1), "unit": "queries/s",
+        "vs_unfused": round(ratio, 2),
+        "unfused_qps": round(unfused_qps, 1),
+        "p99_ms": round(float(np.percentile(ts_fus, 99) * 1e3), 2),
+        "unfused_p99_ms": round(
+            float(np.percentile(ts_unf, 99) * 1e3), 2),
+        "exactness": "asserted-host-equal",
+        "steady_compiles": steady_compiles,
+        "agg_dispatches": agg_dispatches,
+        "device_pairs": int(dev_pairs[0]["value"]) if dev_pairs else 0,
+        "n_docs": n_docs, "n_segments": len(segs),
+        "index_build_s": round(build_s, 1)})
+
+
 def bench_serving(rng):
     """REST serving under concurrency: 32 client threads through
     ``RestAPI.handle`` → dispatcher-thread micro-batching queue. The
@@ -1497,6 +1647,7 @@ def main(mode: str = "accel"):
         run("lexical_10m_prune", bench_lexical_prune, rng, mesh, on_cpu)
     run("hybrid_rrf", bench_hybrid_rrf, rng, mesh, on_cpu)
     run("hybrid_rrf_fused", bench_hybrid_rrf_fused, rng, on_cpu)
+    run("analytics_fused", bench_analytics_fused, rng, on_cpu)
     run("serving", bench_serving, rng)
     run("live_indexing", bench_live_indexing, rng)
 

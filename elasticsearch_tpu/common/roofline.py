@@ -54,7 +54,7 @@ __all__ = [
     "KERNEL_FAMILIES", "peak_bandwidth_gbps", "audit", "audit_totals",
     "model_bytes_bm25_eager", "model_bytes_bm25_dense",
     "model_bytes_bm25_pruned", "model_bytes_knn_exact",
-    "model_bytes_knn_ivf", "fallback_model_bytes",
+    "model_bytes_knn_ivf", "model_bytes_agg", "fallback_model_bytes",
     "efficiency_floor_pct", "efficiency_drift_fraction",
     "efficiency_min_dispatches",
 ]
@@ -186,6 +186,15 @@ def model_bytes_knn_ivf(quantized_bytes: int, exact_bytes: int) -> int:
     """IVF: probed-union quantized scan + exact re-rank gather — the
     two terms ``record_ann`` already accounts."""
     return int(quantized_bytes) + int(exact_bytes)
+
+
+def model_bytes_agg(n_pairs: int, n_pad: int, out_vals: int) -> int:
+    """One aggregation stage over one segment (ROOFLINE agg-stage table):
+    every touched doc-values pair streams docs i32 + value/rho payload
+    (12 B), the query's doc mask is re-read per stage (1 B/slot), and the
+    bucket/register output array writes back f32/i32 rows (8 B covers the
+    count+sum pair of the common kernels)."""
+    return int(n_pairs) * 12 + int(n_pad) + int(out_vals) * 8
 
 
 def fallback_model_bytes(kernel: str, plane, B: int, k: int) -> int:

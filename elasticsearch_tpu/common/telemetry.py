@@ -50,6 +50,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "TelemetryRegistry", "DEFAULT",
     "record_compile", "record_transfer", "record_ann", "record_lex",
     "record_planner", "record_planner_dispatch",
+    "record_agg_dispatch", "record_agg_pairs", "record_agg_sketch_merge",
     "record_warmed_shapes", "warmed_shapes_count",
     "record_mesh_dispatch", "mesh_idle_devices",
     "instrument_step", "device_stats_doc", "ann_drift_count",
@@ -614,6 +615,43 @@ def record_planner_dispatch(stages_n: int,
     reg.histogram("es_planner_stages_per_dispatch",
                   help="retrieval stages folded into one fused "
                        "dispatch").observe(float(stages_n))
+
+
+def record_agg_dispatch(stages_n: int,
+                        registry: Optional[TelemetryRegistry]
+                        = None) -> None:
+    """One fused serving dispatch that carried aggregation stages: how
+    many aggregator nodes (terms, sub-metrics, sketches, ...) rode the
+    device program alongside the scoring scan."""
+    reg = registry or DEFAULT
+    reg.histogram("es_agg_stages_per_dispatch",
+                  help="aggregation tree nodes folded into one fused "
+                       "dispatch").observe(float(stages_n))
+
+
+def record_agg_pairs(n: int,
+                     registry: Optional[TelemetryRegistry] = None) -> None:
+    """Doc-values pairs pushed through a DEVICE aggregation kernel
+    (masked ordinal/bucket/register reduces) — the agg analogue of the
+    postings counters on the lexical side."""
+    reg = registry or DEFAULT
+    reg.counter("es_agg_device_pairs_total",
+                help="doc-values pairs reduced by device agg "
+                     "kernels").inc(int(n))
+
+
+def record_agg_sketch_merge(kind: str,
+                            registry: Optional[TelemetryRegistry]
+                            = None) -> None:
+    """One cardinality partial folded at reduce: ``kind="hll"`` for a
+    register-maximum sketch merge, ``"exact"`` for an exact value-set
+    union below the precision threshold."""
+    reg = registry or DEFAULT
+    # pre-create both label values so the family's label space is stable
+    for k in ("hll", "exact"):
+        reg.counter("es_agg_sketch_merges_total", {"kind": k},
+                    help="cardinality partials merged at reduce, by "
+                         "representation").inc(1 if k == kind else 0)
 
 
 def record_mesh_dispatch(n_shard_devices: int, n_replica_devices: int,

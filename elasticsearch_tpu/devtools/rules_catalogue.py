@@ -88,9 +88,12 @@ def runtime_families() -> Set[str]:
         api.handle("PUT", "/lint", "", json.dumps(
             {"mappings": {"properties": {
                 "body": {"type": "text"},
+                "tag": {"type": "keyword"},
+                "price": {"type": "double"},
                 "vec": {"type": "dense_vector", "dims": 4}}}}).encode())
         api.handle("PUT", "/lint/_doc/1", "refresh=true", json.dumps(
-            {"body": "quick brown fox", "vec": [1, 0, 0, 0]}).encode())
+            {"body": "quick brown fox", "tag": "a", "price": 3.0,
+             "vec": [1, 0, 0, 0]}).encode())
         # text plane dispatch (+ latency family with exemplar); the
         # X-Opaque-Id header registers the per-tenant es_tenant_*
         # attribution rollup the same deterministic way
@@ -112,6 +115,24 @@ def runtime_families() -> Set[str]:
              "knn": {"field": "vec", "query_vector": [1, 0, 0, 0],
                      "k": 1, "num_candidates": 5},
              "rank": {"rrf": {"rank_window_size": 5}}}).encode())
+        # fused AGG stages: an agg-carrying lowerable body rides the
+        # same planner dispatch and registers the es_agg_* families
+        # (stage histogram + sketch-merge kinds); DEVICE_MIN_PAIRS is
+        # shrunk for the call so the device kernel call sites register
+        # es_agg_device_pairs_total on this one-doc corpus too
+        from elasticsearch_tpu.ops import aggs as _ops_aggs
+        _mp = _ops_aggs.DEVICE_MIN_PAIRS
+        _ops_aggs.DEVICE_MIN_PAIRS = 1
+        try:
+            api.handle("POST", "/lint/_search", "request_cache=false",
+                       json.dumps(
+                           {"query": {"match": {"body": "quick"}},
+                            "size": 0, "aggs": {
+                                "tags": {"terms": {"field": "tag"}},
+                                "n": {"cardinality": {
+                                    "field": "price"}}}}).encode())
+        finally:
+            _ops_aggs.DEVICE_MIN_PAIRS = _mp
         # delta tier + sync repack path (delta-serve + rebuild families)
         svc = api.indices.get("lint")
         svc.plane_cache.repack_mode = "sync"

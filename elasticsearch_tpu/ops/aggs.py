@@ -243,6 +243,193 @@ def ordinal_csr(seg, field: str):
     return hit
 
 
+HLL_P = 14  #: register precision: m = 2^p registers, ~1.04/sqrt(m) error
+
+_U64 = np.uint64
+_MIX_1 = _U64(0xFF51AFD7ED558CCD)
+_MIX_2 = _U64(0xC4CEB9FE1A85EC53)
+
+
+def _mix64_u64(z: np.ndarray) -> np.ndarray:
+    """Stafford mix13 finalizer over uint64 (vectorized, wrap-around)."""
+    with np.errstate(over="ignore"):
+        z = (z ^ (z >> _U64(33))) * _MIX_1
+        z = (z ^ (z >> _U64(33))) * _MIX_2
+        return z ^ (z >> _U64(33))
+
+
+def _clz64(x: np.ndarray) -> np.ndarray:
+    """Leading-zero count of uint64 (vectorized; returns 63 for 0 —
+    callers special-case zero words)."""
+    x = x.astype(np.uint64, copy=True)
+    n = np.zeros(x.shape, np.int32)
+    for s in (32, 16, 8, 4, 2, 1):
+        small = x < (_U64(1) << _U64(64 - s))
+        n[small] += s
+        with np.errstate(over="ignore"):
+            x[small] = x[small] << _U64(s)
+    return n
+
+
+def _fnv64_bytes(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def value_hash_u64(value):
+    """Deterministic 64-bit hash of a doc value (str via mix13-finalized
+    FNV-1a — FNV alone leaves the top bits poorly mixed on short strings
+    and the register index is the top ``p`` bits; numeric via mix13 of
+    the f64 bit pattern). The scalar twin of the pair-cache hashing —
+    CardinalityAgg folds exact sets into sketches with it."""
+    if isinstance(value, str):
+        bits = np.array(_fnv64_bytes(value.encode("utf-8")), np.uint64)
+    else:
+        bits = np.array(float(value), np.float64).view(np.uint64)
+    return int(_mix64_u64(bits.reshape(1))[0])
+
+
+def _hll_reg_rho(h: np.ndarray, p: int):
+    """Split hashes into (register id, rho): top ``p`` bits pick the
+    register, rho = leading-zero count of the remaining bits + 1
+    (``64 - p + 1`` when they are all zero)."""
+    reg = (h >> _U64(64 - p)).astype(np.int32)
+    with np.errstate(over="ignore"):
+        w = h << _U64(p)
+    rho = np.where(w == 0, np.int32(64 - p + 1),
+                   _clz64(w) + 1).astype(np.int32)
+    return reg, rho
+
+
+def hll_sketch_pairs(seg, field: str, p: int = HLL_P):
+    """Lazy per-(segment, field, p) hashed doc-values pairs for the HLL++
+    cardinality sketch: pairs sorted by (register, rho) so the masked
+    per-register max is the LAST masked element of each ascending-rho run
+    (same cumsum+searchsorted shape as the percentile kernel).
+
+    Returns a dict with device arrays (``off_dev``, ``docs_dev``,
+    ``rhos_dev``) and their host twins (``reg``, ``rho``, ``docs``) plus
+    ``m`` (register count) and ``n_pairs``.
+    """
+    cache = _seg_cache(seg)
+    key = ("hll", field, p)
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    if field in getattr(seg, "keyword_fields", {}):
+        f = seg.keyword_fields[field]
+        term_h = _mix64_u64(np.fromiter(
+            (_fnv64_bytes(str(t).encode("utf-8")) for t in f.ord_terms),
+            np.uint64, count=len(f.ord_terms)))
+        h = term_h[f.dv_ords_host]
+        docs = f.dv_docs_host
+    else:
+        f = seg.numeric_fields[field]
+        h = _mix64_u64(f.vals_host.astype(np.float64).view(np.uint64))
+        docs = f.docs_host
+    reg, rho = _hll_reg_rho(h, p)
+    order = np.lexsort((rho, reg))
+    reg_s, rho_s, docs_s = reg[order], rho[order], docs[order]
+    m = 1 << p
+    offsets = np.zeros(m + 1, np.int32)
+    np.cumsum(np.bincount(reg_s, minlength=m).astype(np.int32),
+              out=offsets[1:])
+    hit = {
+        "off_dev": jnp.asarray(_pad_pow2(offsets, offsets[-1])),
+        "docs_dev": jnp.asarray(_pad_pow2(docs_s.astype(np.int32),
+                                          np.int32(seg.n_pad))),
+        "rhos_dev": jnp.asarray(_pad_pow2(rho_s, np.int32(0))),
+        "reg": reg_s, "rho": rho_s, "docs": docs_s.astype(np.int32),
+        "m": m, "n_pairs": int(docs_s.shape[0]),
+    }
+    cache[key] = hit
+    return hit
+
+
+def distinct_count(seg, field: str) -> int:
+    """Cached per-(segment, field) distinct value count — the regime
+    trigger for exact-set vs HLL cardinality (route-independent: both the
+    fused and the legacy path consult the same cached number)."""
+    cache = _seg_cache(seg)
+    key = ("distinct", field)
+    hit = cache.get(key)
+    if hit is None:
+        if field in getattr(seg, "keyword_fields", {}):
+            hit = len(seg.keyword_fields[field].ord_terms)
+        else:
+            hit = int(np.unique(
+                seg.numeric_fields[field].vals_host).size)
+        cache[key] = hit
+    return hit
+
+
+@jax.jit
+def masked_register_max(offsets, pair_docs, pair_rhos, mask):
+    """Masked per-register rho max over (register, rho)-sorted pairs.
+
+    Within each register's run rhos ascend, so the last *masked* pair of
+    the run carries the max masked rho; its index is recovered from the
+    monotone masked-count prefix by one searchsorted (no scatter-max).
+    Returns int32[len(offsets) - 1] registers (0 where nothing matched).
+    Segment/shard merge of two register arrays is one elementwise
+    ``maximum`` — ICI-friendly like the top-k payload reduce.
+    """
+    m = jnp.take(mask, pair_docs, mode="fill", fill_value=False)
+    c = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                         jnp.cumsum(m.astype(jnp.int32))])
+    st = jnp.take(c, offsets[:-1])
+    cnt = jnp.take(c, offsets[1:]) - st
+    idx = jnp.searchsorted(c, st + cnt, side="left") - 1
+    idx = jnp.clip(idx, 0, pair_rhos.shape[0] - 1)
+    return jnp.where(cnt > 0, jnp.take(pair_rhos, idx), 0)
+
+
+def host_register_max(pairs: dict, mask: np.ndarray) -> np.ndarray:
+    """Host numpy twin of :func:`masked_register_max` — integer max is
+    order-independent, so this is bitwise-identical to the device kernel
+    over the same cached pairs."""
+    regs = np.zeros(pairs["m"], np.int32)
+    pm = mask[pairs["docs"]]
+    np.maximum.at(regs, pairs["reg"][pm], pairs["rho"][pm])
+    return regs
+
+
+def hll_merge(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Sketch merge = elementwise register maximum."""
+    return np.maximum(a, b)
+
+
+def hll_add_values(regs: np.ndarray, values, p: int) -> np.ndarray:
+    """Fold raw values (an exact-set partial) into a register array —
+    used when a reduce mixes exact and sketch partials across segments."""
+    for v in values:
+        h = value_hash_u64(v)
+        reg = h >> (64 - p)
+        w = (h << p) & 0xFFFFFFFFFFFFFFFF
+        rho = (64 - p + 1) if w == 0 else (64 - w.bit_length()) + 1
+        if rho > regs[reg]:
+            regs[reg] = rho
+    return regs
+
+
+def hll_estimate(regs: np.ndarray) -> int:
+    """Deterministic HLL estimate with linear-counting small-range
+    correction (reference: ``metrics/HyperLogLogPlusPlus.java``; this
+    repro uses the classic bias-corrected form — deterministic and
+    identical across the fused and legacy routes, which share this code)."""
+    regs = np.asarray(regs, np.int64)
+    m = regs.size
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    est = alpha * m * m / float(np.sum(np.exp2(-regs.astype(np.float64))))
+    if est <= 2.5 * m:
+        zeros = int(np.count_nonzero(regs == 0))
+        if zeros:
+            est = m * float(np.log(m / zeros))
+    return int(est + 0.5)
+
+
 def histogram_bucket_ids(seg, field: str, interval: float, offset: float):
     """Lazy per-(segment, field, interval, offset) device bucket-id arrays
     for numeric histograms. Bucket ids are computed host-side in exact f64

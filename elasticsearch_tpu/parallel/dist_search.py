@@ -1315,6 +1315,23 @@ def _bool_csr_shard_pool(term_ids, csr, per_clause, req: int, neg: int,
     return scores, np.flatnonzero(ok & (bits != 0))
 
 
+def bool_csr_doc_mask(term_ids, csr, per_clause, req: int, neg: int,
+                      shd: int, msm: int, n_slots: int) -> np.ndarray:
+    """Eligible-doc mask of one CSR shard for a lowered bool tree —
+    the fused planner's aggregation stages pool their per-segment doc
+    masks through this (``search/agg_planner.py``), so agg matching is
+    the SAME scatter/bitmask verdict as scoring, on both the base tier
+    and the eager delta twin. ``n_slots`` sizes the returned mask (the
+    segment's padded slot count); docs past ``csr["n_docs"]`` stay
+    False. Returns bool[n_slots]."""
+    mask = np.zeros(n_slots, bool)
+    pooled = _bool_csr_shard_pool(term_ids, csr, per_clause, req, neg,
+                                  shd, msm)
+    if pooled is not None:
+        mask[pooled[1]] = True
+    return mask
+
+
 def total_value(t) -> int:
     """Value of a per-query totals entry — plain int (exact count) or a
     ``(value, "gte")`` tuple from a pruned dispatch (the count is a

@@ -1,0 +1,232 @@
+"""Aggregation trees as planner stages of the one-dispatch pipeline.
+
+The legacy analytics path runs aggregations as a host-side per-query
+side pass AFTER retrieval: ``shard_search.py`` executes the query tree
+per segment a second time to get doc masks, then walks
+``search/aggregations.py`` collect/reduce. This module folds the agg
+tree into the :class:`~.query_planner.FusedPlan` instead:
+
+- :func:`lower_aggs` compiles an ``aggs`` body into an :class:`AggPlan`
+  when every node of the tree is one the planes can serve as a masked
+  segment-reduce stage (terms, histogram/date_histogram with nested
+  sub-agg trees, the numeric metrics, percentiles, cardinality at both
+  the exact-set and HLL++ regimes, and field-sorted top_hits). Anything
+  else — pipelines, scripted metrics, score-sorted top_hits — returns
+  None and the request keeps the legacy path unchanged.
+- :func:`serve_agg_stages` executes the agg stages of a fused dispatch:
+  the query's doc mask per view segment comes from the SAME host CSR
+  pool the scoring stage used (base tier + eager delta twin, exactly
+  merged), and the per-segment reductions run through the SAME
+  ``Aggregator.collect``/``reduce`` tree as the legacy path — so
+  int-count parity with the two-pass route is bitwise BY SHARED CODE,
+  and the f32/f64 sum precision contract is inherited, not re-stated.
+
+Regime choices that change representations (exact set vs HLL registers
+in cardinality) key off per-(segment, field) ``distinct_count`` — a
+route-independent property — so fused and legacy answers stay
+identical. ``ES_TPU_FUSED_AGGS=0`` turns agg lowering off (the
+bisection knob, same pattern as ``ES_TPU_FUSED_PLANNER``)."""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops import aggs as ops_aggs
+from .aggregations import (
+    AggregationContext, Aggregator, AvgAgg, CardinalityAgg,
+    DateHistogramAgg, ExtendedStatsAgg, HistogramAgg, MaxAgg, MinAgg,
+    PercentileRanksAgg, PercentilesAgg, StatsAgg, SumAgg, TermsAgg,
+    TopHitsAgg, ValueCountAgg, parse_aggs, run_aggregations_multi)
+
+#: aggregator types the planner can run as fused stages — exact-type
+#: membership on purpose: subclasses registered by the extension modules
+#: (significant_terms, auto_date_histogram, ...) carry semantics the
+#: stage executor has not been audited against
+_LOWERABLE = frozenset({
+    AvgAgg, SumAgg, MinAgg, MaxAgg, ValueCountAgg, StatsAgg,
+    ExtendedStatsAgg, CardinalityAgg, PercentilesAgg, PercentileRanksAgg,
+    TermsAgg, HistogramAgg, DateHistogramAgg, TopHitsAgg,
+})
+
+
+@dataclass
+class AggPlan:
+    """A lowered ``aggs`` body: the planner IR for the analytics stages.
+
+    ``shape`` is the name-independent tree signature the micro-batcher
+    co-batches on (same discipline as the (B, k, L, params) lattice:
+    requests that differ only in bucket VALUES share a dispatch;
+    requests with different tree structure do not). ``spec_key`` is the
+    canonical spec serialization used for in-flight dedup."""
+
+    spec_key: str
+    aggs: Dict[str, Aggregator]
+    mapper: Any
+    shape: Tuple
+    n_stages: int
+
+
+def _tree_shape(parsed: Dict[str, Aggregator]) -> Optional[Tuple]:
+    """Lowerability walk: the tree's (kind, field, sub-shape) signature,
+    or None when any node falls outside the fused fragment."""
+    out = []
+    for _name, agg in sorted(parsed.items()):
+        if type(agg) not in _LOWERABLE:
+            return None
+        if type(agg) is TopHitsAgg:
+            # the fused dispatch computes masks, not per-doc scores:
+            # only field-sorted top_hits is score-independent
+            if not agg._sorts or any(f == "_score"
+                                     for f, _, _ in agg._sorts):
+                return None
+        subs = getattr(agg, "subs", None) or {}
+        sub_shape: Optional[Tuple] = ()
+        if subs:
+            sub_shape = _tree_shape(subs)
+            if sub_shape is None:
+                return None
+        out.append((agg.kind, getattr(agg, "field", None), sub_shape))
+    return tuple(out)
+
+
+def _count_nodes(parsed: Dict[str, Aggregator]) -> int:
+    n = 0
+    for agg in parsed.values():
+        n += 1
+        subs = getattr(agg, "subs", None)
+        if subs:
+            n += _count_nodes(subs)
+    return n
+
+
+def fused_aggs_enabled() -> bool:
+    """The agg-lowering on/off env gate (bisection knob): default on."""
+    import os
+    return os.environ.get("ES_TPU_FUSED_AGGS", "1").lower() \
+        not in ("0", "false")
+
+
+def lower_aggs(spec, mapper) -> Optional[AggPlan]:
+    """``aggs`` body → :class:`AggPlan`, or None when the tree is not
+    fully lowerable (the caller then keeps the legacy path — including
+    for malformed specs, so parse errors surface where they always
+    did)."""
+    if not isinstance(spec, dict) or not spec:
+        return None
+    try:
+        parsed = parse_aggs(spec)
+    except Exception:                    # noqa: BLE001
+        return None
+    shape = _tree_shape(parsed)
+    if shape is None:
+        return None
+    return AggPlan(
+        spec_key=json.dumps(spec, sort_keys=True, default=str),
+        aggs=parsed, mapper=mapper, shape=shape,
+        n_stages=_count_nodes(parsed))
+
+
+def _plan_bytes(aggs: Dict[str, Aggregator], seg) -> int:
+    """Per-segment model bytes of one agg tree (the ROOFLINE agg-stage
+    bytes model): every node streams its field's doc-values pairs, the
+    mask, and its output rows; cardinality's HLL regime adds the
+    register array."""
+    from ..common.roofline import model_bytes_agg
+    total = 0
+    for agg in aggs.values():
+        f = getattr(agg, "field", None)
+        pairs = 0
+        out_vals = 1
+        if f is not None:
+            kf = getattr(seg, "keyword_fields", {}).get(f)
+            nf = getattr(seg, "numeric_fields", {}).get(f)
+            if kf is not None and kf.dv_docs_host.shape[0] > 0:
+                pairs = int(kf.dv_docs_host.shape[0])
+                out_vals = len(kf.ord_terms)
+            elif nf is not None:
+                pairs = int(nf.docs_host.shape[0])
+        if isinstance(agg, CardinalityAgg) and pairs:
+            out_vals = 1 << ops_aggs.HLL_P
+        total += model_bytes_agg(pairs, seg.n_pad, out_vals)
+        subs = getattr(agg, "subs", None)
+        if subs:
+            total += _plan_bytes(subs, seg)
+    return total
+
+
+def serve_agg_stages(runner, items: Sequence[dict], *, view,
+                     stages: Optional[dict] = None
+                     ) -> List[Optional[dict]]:
+    """Run the aggregation stages of one fused dispatch.
+
+    For each item carrying an :class:`AggPlan`, the query's doc mask per
+    view segment is pooled from the planes' host CSR — the base tier via
+    ``_host_csr`` and delta segments via the eager delta twin's CSR,
+    positions resolved exactly like the rescore stage — then the shared
+    collect/reduce tree produces the item's aggregations dict. Returns a
+    list aligned with ``items`` (None for agg-free/pad slots)."""
+    t0 = time.perf_counter()
+    from ..parallel.dist_search import (bool_clause_rows,
+                                        bool_csr_doc_mask, bool_role_masks)
+    gen = runner.text_gen
+    base = runner._text_base()
+    delta, base_pos = gen._delta_for_view(view) \
+        if hasattr(gen, "_delta_for_view") \
+        else (None, list(range(base.n_shards)))
+    pos2base = {vp: bi for bi, vp in enumerate(base_pos)}
+    pos2delta: Dict[int, int] = {}
+    if delta is not None:
+        for di, vp in enumerate(delta.seg_positions):
+            pos2delta[vp] = di
+    out: List[Optional[dict]] = []
+    total_stages = 0
+    total_bytes = 0
+    for it in items:
+        plan = it.get("aggs")
+        if plan is None:
+            out.append(None)
+            continue
+        req, neg, shd = bool_role_masks(it["clauses"])
+        per_clause = bool_clause_rows(it["clauses"], lambda t: 1.0)
+        ctx = AggregationContext(plan.mapper)
+        triples = []
+        for si, seg in enumerate(view):
+            if si in pos2base:
+                bi = pos2base[si]
+                csr = base._host_csr[bi]
+                tids = base.shards[bi]["term_ids"]
+            elif si in pos2delta:
+                csr = delta._csr[pos2delta[si]]
+                tids = csr["term_ids"]
+            else:                        # empty segment: nothing matches
+                triples.append((ctx, seg, np.zeros(seg.n_pad, bool)))
+                continue
+            mask = bool_csr_doc_mask(tids, csr, per_clause, req, neg,
+                                     shd, it["msm"], seg.n_pad)
+            live = getattr(seg, "live", None)
+            if live is not None and not bool(live.all()):
+                mask[: seg.n_docs] &= live[: seg.n_docs]
+            triples.append((ctx, seg, mask))
+            total_bytes += _plan_bytes(plan.aggs, seg)
+        out.append(run_aggregations_multi(plan.aggs, triples))
+        total_stages += plan.n_stages
+    if total_stages:
+        from ..common import telemetry as _tm
+        _tm.record_agg_dispatch(total_stages)
+    if stages is not None:
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        stages["agg_ms"] = stages.get("agg_ms", 0.0) + elapsed_ms
+        if "dispatch_ms" in stages:
+            # the retrieval stages stamped their own refined wall — the
+            # agg stages ran in the same dispatch, so their time (and
+            # their model bytes below) joins the roofline-audited wall
+            stages["dispatch_ms"] += elapsed_ms
+        if total_bytes:
+            stages["model_bytes"] = int(stages.get("model_bytes") or 0) \
+                + total_bytes
+    return out

@@ -617,10 +617,18 @@ class ExtendedStatsAgg(_NumericMetricAgg):
 
 
 class CardinalityAgg(Aggregator):
-    """Distinct-value count. Exact per-shard via value sets (the reference
-    uses HLL++ above `precision_threshold` —
-    ``metrics/CardinalityAggregator.java``; an HLL sketch replaces the set
-    transparently in reduce once set sizes exceed the threshold)."""
+    """Distinct-value count. Exact per-shard via value sets below
+    ``precision_threshold``; above it the segment collects an HLL++
+    register sketch instead (reference:
+    ``metrics/CardinalityAggregator.java`` /
+    ``HyperLogLogPlusPlus.java``). The regime trigger is the SEGMENT's
+    cached distinct-value count (``ops/aggs.distinct_count``) — a
+    route-independent property, so the fused planner stages and the
+    legacy two-pass path always pick the same representation and
+    return identical values. Sketch merge is one elementwise register
+    ``maximum`` (ICI-friendly like the top-k payload reduce); mixed
+    set/sketch partials fold the raw values into the registers with the
+    same scalar hash."""
 
     PRECISION_DEFAULT = 3000
 
@@ -635,11 +643,37 @@ class CardinalityAgg(Aggregator):
         self.precision_threshold = int(
             body.get("precision_threshold", self.PRECISION_DEFAULT))
 
+    def _use_hll(self, ctx, seg) -> bool:
+        if self.missing is not None or self.precision_threshold <= 0:
+            return False
+        field = _concrete(ctx.mapper, self.field)
+        if field not in getattr(seg, "keyword_fields", {}) and \
+                field not in getattr(seg, "numeric_fields", {}):
+            return False             # runtime/absent fields: exact sets
+        return ops_aggs.distinct_count(seg, field) >= \
+            self.precision_threshold
+
     def collect(self, ctx, seg, mask):
         if getattr(self, "_pt_error", None) is not None:
             raise IllegalArgumentError(
                 f"[precisionThreshold] must be greater than or equal to "
                 f"0. Found [{self._pt_error}] in [{self.name}]")
+        if self._use_hll(ctx, seg):
+            field = _concrete(ctx.mapper, self.field)
+            pairs = ops_aggs.hll_sketch_pairs(seg, field)
+            if pairs["n_pairs"] >= ops_aggs.DEVICE_MIN_PAIRS:
+                # device register-max kernel over the cached sorted
+                # pairs; host twin below is bitwise-identical (integer
+                # max is order-independent)
+                from ..common.telemetry import record_agg_pairs
+                record_agg_pairs(pairs["n_pairs"])
+                regs = np.asarray(ops_aggs.masked_register_max(
+                    pairs["off_dev"], pairs["docs_dev"],
+                    pairs["rhos_dev"],
+                    _device_mask(seg, mask)))[: pairs["m"]]
+            else:
+                regs = ops_aggs.host_register_max(pairs, mask)
+            return {"hll": regs, "p": ops_aggs.HLL_P}
         kw = _keyword_pairs(seg, self.field, ctx.mapper)
         num = _numeric_pairs(seg, self.field, ctx.mapper) \
             if kw is None else None
@@ -658,10 +692,25 @@ class CardinalityAgg(Aggregator):
         return {"values": out}
 
     def reduce(self, partials):
-        u: set = set()
+        from ..common.telemetry import record_agg_sketch_merge
+        regs = None
+        sets: List[set] = []
         for p in partials:
-            u |= p["values"]
-        return {"value": len(u)}
+            if "hll" in p:
+                record_agg_sketch_merge("hll")
+                regs = p["hll"].copy() if regs is None \
+                    else ops_aggs.hll_merge(regs, p["hll"])
+            else:
+                record_agg_sketch_merge("exact")
+                sets.append(p["values"])
+        if regs is None:
+            u: set = set()
+            for s in sets:
+                u |= s
+            return {"value": len(u)}
+        for s in sets:
+            regs = ops_aggs.hll_add_values(regs, s, ops_aggs.HLL_P)
+        return {"value": ops_aggs.hll_estimate(regs)}
 
 
 def _hdr_quantize(chosen: np.ndarray, allv: np.ndarray,
@@ -1168,6 +1217,8 @@ class TermsAgg(BucketAggregator):
                     _doc_weights(seg) is None:
                 # device hot path: ordinal-CSR cumsum-diff counts (exact
                 # int32 — bitwise-identical to the numpy unique path)
+                from ..common.telemetry import record_agg_pairs
+                record_agg_pairs(docs.shape[0])
                 off_dev, pdocs_dev, V = ops_aggs.ordinal_csr(seg, self.field)
                 counts_all = np.asarray(ops_aggs.masked_ordinal_counts(
                     off_dev, pdocs_dev, _device_mask(seg, mask)))[:V]
@@ -1416,7 +1467,9 @@ class HistogramAgg(BucketAggregator):
                 # span / interval), and an unbucketed value compiles a
                 # fresh one-hot kernel per distinct histogram width; the
                 # padding buckets count nothing and are sliced off
+                from ..common.telemetry import record_agg_pairs
                 from ..utils.shapes import round_up_pow2
+                record_agg_pairs(docs.shape[0])
                 nb_pad = round_up_pow2(n_buckets, 8)
                 counts = np.asarray(ops_aggs.masked_bucket_counts(
                     ids_dev, pdocs_dev, _device_mask(seg, mask),
@@ -1662,6 +1715,32 @@ class DateHistogramAgg(BucketAggregator):
             sel = (vals >= self.hard_bounds[0]) & \
                   (vals <= self.hard_bounds[1])
             docs, vals = docs[sel], vals[sel]
+        if (self.fixed_ms is not None and not self.time_zone and
+                not self.subs and not self.hard_bounds and
+                docs.shape[0] >= ops_aggs.DEVICE_MIN_PAIRS and
+                _doc_weights(seg) is None):
+            # fixed-interval, no-tz date_histogram IS a histogram over
+            # epoch-millis: reuse the cached bucket-id plane. The key
+            # reconstruction (base + bid) * fixed_ms + offset_ms runs
+            # the same f64 floor/multiply as _keys_for, so bucket keys
+            # are bitwise-identical to the host path
+            ids_dev, pdocs_dev, n_buckets, base = \
+                ops_aggs.histogram_bucket_ids(seg, self.field,
+                                              self.fixed_ms,
+                                              self.offset_ms)
+            if ids_dev is not None and n_buckets:
+                from ..common.telemetry import record_agg_pairs
+                from ..utils.shapes import round_up_pow2
+                record_agg_pairs(docs.shape[0])
+                nb_pad = round_up_pow2(n_buckets, 8)
+                counts = np.asarray(ops_aggs.masked_bucket_counts(
+                    ids_dev, pdocs_dev, _device_mask(seg, mask),
+                    n_buckets=nb_pad))[:n_buckets]
+                out = {}
+                for bid in np.flatnonzero(counts):
+                    key = (base + bid) * self.fixed_ms + self.offset_ms
+                    out[float(key)] = (int(counts[bid]), {})
+                return out
         pm = mask[docs]
         keys = self._keys_for(vals[pm])
         w = _doc_weights(seg)

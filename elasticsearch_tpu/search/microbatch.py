@@ -83,8 +83,8 @@ def empty_serving_stats() -> Dict[str, int]:
 
 
 class _Slot:
-    __slots__ = ("terms", "k", "done", "vals", "hits", "total", "error",
-                 "t_enq", "rounds_skipped", "stage_ms", "info",
+    __slots__ = ("terms", "k", "done", "vals", "hits", "total", "aggs",
+                 "error", "t_enq", "rounds_skipped", "stage_ms", "info",
                  "view_segments", "view_key", "params", "trace_id",
                  "node")
 
@@ -118,6 +118,9 @@ class _Slot:
         self.vals = None
         self.hits: Optional[List[Tuple[int, int]]] = None
         self.total: Optional[int] = None
+        #: fused agg-stage result for THIS slot (dict), or None — set
+        #: only by dispatches whose plane returned a 4th output list
+        self.aggs = None
         self.error: Optional[BaseException] = None
         self.t_enq = time.perf_counter()
         #: dispatch rounds that passed this slot over (starvation bound)
@@ -360,9 +363,13 @@ class PlaneMicroBatcher:
         t_call = time.perf_counter()
         err: Optional[BaseException] = None
         try:
-            vals, hits, totals = self._dispatch(
+            out = self._dispatch(
                 queries, k, plane_stages,
                 view=batch[0].view_segments, params=batch[0].params)
+            vals, hits, totals = out[:3]
+            # fused agg stages: a plane that served analytics stages
+            # returns a 4th per-slot list of aggregations dicts
+            aggs_list = out[3] if len(out) > 3 else None
         except BaseException as e:          # noqa: BLE001 — fan the error
             err = e                         # out to every query in the batch
         t_done = time.perf_counter()
@@ -374,6 +381,8 @@ class PlaneMicroBatcher:
                 s.vals = vals[idx][:s.k]
                 s.hits = hits[idx][:s.k]
                 s.total = totals[idx]
+                if aggs_list is not None:
+                    s.aggs = aggs_list[idx]
         # stage attribution: queue wait is per-slot; prep / dispatch /
         # fetch are shared by the whole batch (one dispatch). The plane
         # refines its own call into prep/dispatch/fetch when it can;
@@ -435,6 +444,11 @@ class PlaneMicroBatcher:
                 s.stage_ms = {
                     "queue": (t_pick - s.t_enq) * 1e3, "prep": prep_ms,
                     "dispatch": dispatch_ms, "fetch": fetch_ms}
+                if "agg_ms" in plane_stages:
+                    # fused analytics stages ran inside this dispatch:
+                    # break their share out next to the pipeline stages
+                    # (profile:true serving section)
+                    s.stage_ms["agg"] = plane_stages["agg_ms"]
                 for name in STAGES:
                     self.stage_totals_ms[name] += s.stage_ms[name]
                     self.stage_samples[name].append(s.stage_ms[name])
@@ -820,11 +834,20 @@ class FusedPlaneMicroBatcher(PlaneMicroBatcher):
                 "kboost": 1.0, "knn_k": 0, "knn_nc": 0,
                 "nprobe": None, "rerank": None, "fusion": None,
                 "rc": 60, "wt": 0, "k": 0, "rescore": None,
-                "n_stages": 1, "key": ("pad",)}
+                "aggs": None, "n_stages": 1, "key": ("pad",)}
 
     @staticmethod
     def _query_key(item):
         return item["key"]
+
+    @staticmethod
+    def _result(slot):
+        if slot.error is not None:
+            raise slot.error
+        if slot.aggs is not None:
+            # agg-carrying dispatch: the caller gets the 4-tuple form
+            return slot.vals, slot.hits, slot.total, slot.aggs
+        return slot.vals, slot.hits, slot.total
 
     def _serves_host(self) -> bool:
         return self.plane.serves_host()
@@ -889,7 +912,12 @@ def batched_fused_search(runner, item: dict, *, view=None,
               item["rescore"]["mode"] if item.get("rescore") else None,
               round_up_pow2(max(item["wt"], 1)),
               round_up_pow2(max(item["knn_nc"], 1)),
-              knn_params, prune_param)
+              knn_params, prune_param,
+              # agg-plan tree shape: agg-carrying requests co-batch only
+              # with the same tree structure (and never with agg-free
+              # ones — the dispatch output arity differs)
+              item["aggs"].shape if item.get("aggs") is not None
+              else None)
     batcher = getattr(runner, "_microbatcher", None)
     if batcher is None:
         with _CREATE_LOCK:

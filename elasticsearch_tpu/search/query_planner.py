@@ -48,9 +48,10 @@ from ..ops.fused_query import MAX_BOOL_CLAUSES
 from .plane_route import extract_bag_of_terms
 
 #: body features the fused path cannot serve (same set the plane route
-#: excludes, minus the three the planner exists to fuse)
-_FUSED_INCOMPATIBLE = ("aggs", "aggregations", "sort", "collapse",
-                      "suggest", "search_after", "min_score")
+#: excludes, minus the three the planner exists to fuse; ``aggs`` left
+#: this list in PR 16 — agg trees lower via ``agg_planner.lower_aggs``)
+_FUSED_INCOMPATIBLE = ("sort", "collapse", "suggest", "search_after",
+                       "min_score")
 
 _RESCORE_MODES = ("total", "multiply", "avg", "max", "min")
 
@@ -99,6 +100,7 @@ class FusedPlan:
     rescore: Optional[RescorePlan] = None
     k: int = 10                           # size + from
     window_text: int = 10                 # lexical stage dispatch width
+    aggs: Optional[object] = None         # agg_planner.AggPlan
     lower_ms: float = 0.0
 
     def n_stages(self) -> int:
@@ -109,6 +111,8 @@ class FusedPlan:
             n += 2                         # knn scan + rank fusion
         if self.rescore is not None:
             n += 1
+        if self.aggs is not None:
+            n += self.aggs.n_stages        # one stage per tree node
         return n
 
 
@@ -256,15 +260,32 @@ def lower_body(body: dict, mapper: MapperService) -> Optional[FusedPlan]:
     t0 = time.perf_counter()
     if any(body.get(k) for k in _FUSED_INCOMPATIBLE):
         return None
+    agg_plan = None
+    agg_spec = body.get("aggs") or body.get("aggregations")
+    if agg_spec is not None:
+        from .agg_planner import fused_aggs_enabled, lower_aggs
+        if not fused_aggs_enabled():
+            return None
+        agg_plan = lower_aggs(agg_spec, mapper)
+        if agg_plan is None:
+            return None           # tree outside the fused fragment
     k = int(body.get("size", 10)) + int(body.get("from", 0))
     if k <= 0:
-        return None
+        if agg_plan is None:
+            return None
+        k = 0                     # size:0 analytics — agg stages only
     query_spec = body.get("query")
     knn_spec = body.get("knn")
     rank_spec = body.get("rank")
     rescore_spec = body.get("rescore")
     if query_spec is None:
         return None               # knn-only: the knn route serves it
+    if agg_plan is not None and knn_spec is not None:
+        # top-level knn widens the match set the aggs run over
+        # (hybrid hits participate in aggregations) — the agg stages
+        # pool text masks only, so hybrid analytics keeps the legacy
+        # path
+        return None
     lowered = _lower_bool_tree(query_spec, mapper)
     if lowered is None:
         return None
@@ -302,7 +323,8 @@ def lower_body(body: dict, mapper: MapperService) -> Optional[FusedPlan]:
         rescore = _lower_rescore(rescore_spec, field, mapper)
         if rescore is None:
             return None
-    if knn is None and rescore is None and bag is not None:
+    if knn is None and rescore is None and bag is not None and \
+            agg_plan is None:
         return None               # plain bag: existing plane route
     window_text = max(k, rank_window) if fusion == "rrf" else k
     if rescore is not None:
@@ -311,7 +333,7 @@ def lower_body(body: dict, mapper: MapperService) -> Optional[FusedPlan]:
                      knn=knn, fusion=fusion,
                      rank_constant=rank_constant,
                      rank_window=rank_window, rescore=rescore, k=k,
-                     window_text=window_text)
+                     window_text=window_text, aggs=agg_plan)
     plan.lower_ms = (time.perf_counter() - t0) * 1e3
     return plan
 
@@ -410,6 +432,10 @@ class FusedPlanRunner:
     def can_serve(self, plan: FusedPlan) -> bool:
         if plan.knn is not None and self.knn_gen is None:
             return False
+        if plan.aggs is not None and not self.serves_host():
+            # agg stages pool masks from the host CSR tier; a jitted-
+            # only plane keeps the legacy two-pass analytics path
+            return False
         if self.serves_host():
             return True
         # jitted path: the bool/fused steps slice only the sparse tier
@@ -444,13 +470,22 @@ class FusedPlanRunner:
         (vals, hits, totals) aligned with ``items``: ``vals[i]`` the
         fused scores np.f32[k_i], ``hits[i]`` the [(shard, doc)] rows
         in VIEW space, ``totals[i]`` the lexical total (possibly
-        ``(value, "gte")``)."""
+        ``(value, "gte")``). When any item carries agg stages the
+        return grows a fourth element: per-item aggregations dicts
+        (None on agg-free slots)."""
         t0 = time.perf_counter()
+        has_aggs = any(it.get("aggs") is not None for it in items)
+        if has_aggs and (view is None or not self.serves_host()):
+            raise FusedFallback("agg stages need a host CSR view")
         if self.serves_host():
             out = self._serve_host(items, view=view, stages=stages,
                                    prune=prune)
         else:
             out = self._serve_device(items, view=view, stages=stages)
+        if has_aggs:
+            from .agg_planner import serve_agg_stages
+            out = out + (serve_agg_stages(self, items, view=view,
+                                          stages=stages),)
         if stages is not None:
             stages.setdefault("dispatch_ms",
                               (time.perf_counter() - t0) * 1e3)
@@ -995,6 +1030,7 @@ def make_item(plan: FusedPlan, *, prune_param=None) -> dict:
         "wt": plan.window_text,
         "k": plan.k,
         "rescore": rescore,
+        "aggs": plan.aggs,
         "n_stages": plan.n_stages(),
     }
     item["key"] = (
@@ -1006,5 +1042,6 @@ def make_item(plan: FusedPlan, *, prune_param=None) -> dict:
         plan.window_text, plan.k,
         (tuple(rescore["terms"]), rescore["qw"], rescore["rw"],
          rescore["mode"], rescore["window"]) if rescore else None,
+        plan.aggs.spec_key if plan.aggs is not None else None,
         prune_param)
     return item

@@ -468,9 +468,11 @@ class ShardSearcher:
         # through to the existing paths below unchanged.
         fused_result = None
         fused_plan = None
+        fused_aggs = None
         planner_consulted = False
         if (self.fused_provider is not None and query_spec
-                and knn_override is None and window > 0
+                and knn_override is None
+                and (window > 0 or aggs is not None)
                 and min_score is None and search_after is None
                 and not use_field_sort and not collect_agg_inputs):
             from . import query_planner as qp
@@ -533,7 +535,11 @@ class ShardSearcher:
             # pipeline (bool scoring, knn, fusion, rescore): its rows
             # ARE the candidates, its lexical count the total, and the
             # knn/rescore sections below must not run again
-            fvals, fhits, ftotal = fused_result
+            fvals, fhits, ftotal = fused_result[:3]
+            # an agg-carrying fused dispatch returns its analytics
+            # stages' result as a 4th element (agg_planner.py)
+            if len(fused_result) > 3:
+                fused_aggs = fused_result[3]
             serving_stages = fstages
             serving_info = finfo
             from ..parallel.dist_search import (total_is_lower_bound,
@@ -846,6 +852,11 @@ class ShardSearcher:
             agg_inputs = [(seg, np.asarray(m),
                            np.asarray(sc) if need_scores else None)
                           for seg, m, sc in agg_pending]
+        elif fused_aggs is not None:
+            # the fused dispatch's agg stages already reduced this
+            # shard's tree (same collect/reduce code — agg_planner.py):
+            # the legacy second pass below must not run again
+            agg_results = fused_aggs
         elif aggs is not None:
             seg_scores = ({seg.seg_id: np.asarray(sc)
                            for seg, _, sc in agg_pending}

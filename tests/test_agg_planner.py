@@ -1,0 +1,222 @@
+"""Fused aggregation stages (search/agg_planner.py): lowering matrix,
+bitwise fused-vs-legacy parity over base+delta generations, mesh-shape
+transparency, device-kernel engagement and steady-state compiles."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.ops import aggs as ops_aggs
+from elasticsearch_tpu.search import query_planner as qp
+from elasticsearch_tpu.search.agg_planner import lower_aggs
+from elasticsearch_tpu.search.plane_route import ServingPlaneCache
+from elasticsearch_tpu.search.shard_search import ShardSearcher
+
+MAPPING = {"properties": {
+    "body": {"type": "text"},
+    "tag": {"type": "keyword"},
+    "price": {"type": "double"},
+    "ts": {"type": "date"},
+    "vec": {"type": "dense_vector", "dims": 4,
+            "similarity": "dot_product"},
+}}
+
+AGGS = {
+    "tags": {"terms": {"field": "tag"},
+             "aggs": {"avg_price": {"avg": {"field": "price"}}}},
+    "price_stats": {"stats": {"field": "price"}},
+    "per_hour": {"date_histogram": {"field": "ts",
+                                    "fixed_interval": "1h"}},
+    "n_tags": {"cardinality": {"field": "tag"}},
+    "n_prices": {"cardinality": {"field": "price",
+                                 "precision_threshold": 10}},
+    "pct": {"percentiles": {"field": "price"}},
+    "top": {"top_hits": {"size": 2, "sort": [{"price": "desc"}]}},
+}
+
+
+def _mk_fixture(n_base=(64, 48), n_delta=4, mesh_factory=None):
+    mapper = MapperService(MAPPING)
+    rng = np.random.RandomState(5)
+    words = [f"w{i}" for i in range(24)]
+    doc_no = [0]
+
+    def mk_seg(seg_id, n):
+        b = SegmentBuilder(seg_id)
+        for i in range(n):
+            body = " ".join(words[(i * 3 + j) % 24] for j in range(6))
+            b.add(mapper.parse_document(str(doc_no[0]), {
+                "body": body,
+                "tag": f"k{i % 7}",
+                "price": float(rng.randint(0, 100)),
+                "ts": int(1_700_000_000_000 + i * 3_600_000),
+                "vec": [float(x) for x in rng.randn(4)]}),
+                seq_no=doc_no[0])
+            doc_no[0] += 1
+        return b.build()
+
+    base_segs = [mk_seg(f"s{i}", n) for i, n in enumerate(n_base)]
+    cache = ServingPlaneCache(mesh_factory=mesh_factory)
+    cache.repack_mode = "sync"
+    assert cache.plane_for(base_segs, mapper, "body") is not None
+    segs = base_segs + [mk_seg("d", n_delta)] if n_delta else base_segs
+    if n_delta:
+        tgen = cache.plane_for(segs, mapper, "body")
+        assert tgen is not None and tgen.delta_docs() > 0
+    return mapper, segs, cache
+
+
+def _searcher(mapper, segs, cache, with_fused=True):
+    return ShardSearcher(
+        segs, mapper,
+        plane_provider=lambda s, f: cache.plane_for(s, mapper, f),
+        fused_provider=(lambda s, tf, kf:
+                        cache.fused_runner_for(s, mapper, tf, kf))
+        if with_fused else None)
+
+
+# ---------------------------------------------------------------------------
+# lowering matrix
+# ---------------------------------------------------------------------------
+
+
+def test_lower_aggs_matrix():
+    m = MapperService(MAPPING)
+    plan = lower_aggs(AGGS, m)
+    assert plan is not None and plan.n_stages == len(AGGS) + 1
+    assert len(plan.shape) == len(AGGS)
+    # shape is name-independent: renaming roots keeps the signature
+    renamed = {f"r_{k}": v for k, v in AGGS.items()}
+    assert lower_aggs(renamed, m).shape == plan.shape
+    # outside the fragment -> None (the legacy path keeps these)
+    assert lower_aggs({"x": {"significant_terms":
+                             {"field": "tag"}}}, m) is None
+    assert lower_aggs({"x": {"top_hits": {"size": 2}}}, m) is None
+    assert lower_aggs({"x": {"top_hits": {
+        "size": 2, "sort": [{"_score": "desc"}]}}}, m) is None
+    assert lower_aggs({"t": {"terms": {"field": "tag"}, "aggs": {
+        "s": {"scripted_metric": {}}}}}, m) is None
+    # malformed specs lower to None so parse errors surface on the
+    # legacy path exactly where they always did
+    assert lower_aggs({"x": {"terms": {}}}, m) is None
+    assert lower_aggs({}, m) is None
+
+
+def test_lower_body_agg_gating(monkeypatch):
+    m = MapperService(MAPPING)
+    body = {"query": {"match": {"body": "w1"}},
+            "aggs": {"t": {"terms": {"field": "tag"}}}}
+    plan = qp.lower_body(dict(body), m)
+    assert plan is not None and plan.aggs is not None
+    assert plan.aggs.n_stages == 1 and plan.k == 10
+    # size:0 analytics lowers with k=0 (agg stages only)
+    plan0 = qp.lower_body({**body, "size": 0}, m)
+    assert plan0 is not None and plan0.k == 0
+    # size:0 WITHOUT aggs has nothing to fuse
+    assert qp.lower_body({"query": {"match": {"body": "w1"}},
+                          "size": 0}, m) is None
+    # hybrid knn widens the agg match set -> legacy path
+    assert qp.lower_body({**body, "knn": {
+        "field": "vec", "query_vector": [1, 0, 0, 0]}}, m) is None
+    # the bisection knob turns agg lowering off entirely
+    monkeypatch.setenv("ES_TPU_FUSED_AGGS", "0")
+    assert qp.lower_body(dict(body), m) is None
+
+
+# ---------------------------------------------------------------------------
+# fused vs legacy: bitwise parity over base + delta generations
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("size", [0, 5])
+def test_fused_legacy_agg_parity_base_delta(size):
+    mapper, segs, cache = _mk_fixture()
+    body = {"query": {"match": {"body": "w1 w4 w7"}},
+            "aggs": AGGS, "size": size}
+    fused = _searcher(mapper, segs, cache).search(dict(body))
+    legacy = _searcher(mapper, segs, cache, False).search(dict(body))
+    assert [h.doc_id for h in fused.hits] == \
+        [h.doc_id for h in legacy.hits]
+    assert fused.aggregations == legacy.aggregations
+    assert (fused.total, fused.total_relation) == \
+        (legacy.total, legacy.total_relation)
+    # the fused searcher really served through the planner, and the
+    # dispatch accounted its agg stage count
+    from elasticsearch_tpu.common import telemetry as tm
+    doc = tm.DEFAULT.metrics_doc()
+    by = {s["labels"]["outcome"]: s["value"]
+          for s in doc["es_planner_lowered_total"]["series"]}
+    assert by.get("fused", 0) >= 1
+    assert doc["es_agg_stages_per_dispatch"]["series"][0][
+        "value"]["count"] >= 1
+    cache.release()
+
+
+def test_fused_agg_profile_and_roofline_stage():
+    """profile:true surfaces the agg stage timing next to the planner
+    serving stages, and the dispatch's model_bytes grew by the agg
+    bytes model (the roofline audit covers agg dispatches)."""
+    mapper, segs, cache = _mk_fixture()
+    body = {"query": {"match": {"body": "w1 w4 w7"}},
+            "aggs": {"t": {"terms": {"field": "tag"}}},
+            "size": 0, "profile": True}
+    res = _searcher(mapper, segs, cache).search(dict(body))
+    shard_prof = res.profile["shards"][0]
+    stages = shard_prof["serving"]["stages_ms"]
+    assert "agg" in stages and stages["agg"] >= 0.0
+    assert stages["agg"] <= stages["dispatch"] + 1e-6
+    assert "planner" in shard_prof
+    cache.release()
+
+
+def test_fused_agg_device_kernels_bitwise(monkeypatch):
+    """With DEVICE_MIN_PAIRS shrunk the fused route's agg stages run the
+    jitted segment-reduce kernels — results stay bitwise-equal to the
+    pure-host legacy pass (int counts exact, HLL registers identical)."""
+    mapper, segs, cache = _mk_fixture()
+    body = {"query": {"match": {"body": "w1 w4 w7"}},
+            "aggs": AGGS, "size": 0}
+    legacy = _searcher(mapper, segs, cache, False).search(dict(body))
+    monkeypatch.setattr(ops_aggs, "DEVICE_MIN_PAIRS", 1)
+    fused = _searcher(mapper, segs, cache).search(dict(body))
+    assert fused.aggregations == legacy.aggregations
+    cache.release()
+
+
+def test_fused_agg_mesh_transparency():
+    """Agg results are mesh-shape TRANSPARENT: a 2x4 (replica, shard)
+    serving mesh returns aggregations identical to the default mesh."""
+    from elasticsearch_tpu.parallel.mesh import make_search_mesh
+    body = {"query": {"match": {"body": "w1 w4 w7"}},
+            "aggs": AGGS, "size": 4}
+    out = {}
+    for name, factory in (
+            ("default", None),
+            ("2x4", lambda: make_search_mesh(n_shards=4, n_replicas=2))):
+        mapper, segs, cache = _mk_fixture(mesh_factory=factory)
+        res = _searcher(mapper, segs, cache).search(dict(body))
+        out[name] = ([h.doc_id for h in res.hits], res.aggregations)
+        cache.release()
+    assert out["2x4"] == out["default"]
+
+
+def test_fused_agg_zero_steady_state_compiles(monkeypatch):
+    """Repeated agg dispatches at one plan shape with varying queries
+    and bucket values compile nothing new after warmup."""
+    from elasticsearch_tpu.common import telemetry as tm
+    monkeypatch.setattr(ops_aggs, "DEVICE_MIN_PAIRS", 1)
+    mapper, segs, cache = _mk_fixture()
+    s = _searcher(mapper, segs, cache)
+
+    def one(i):
+        return s.search({"query": {"match": {"body": f"w{i} w{i + 3}"}},
+                         "aggs": AGGS, "size": 0}).aggregations
+
+    one(1)                                    # warm the kernel shapes
+    before = tm.compile_count()
+    for i in range(2, 7):
+        one(i)
+    assert tm.compile_count() == before, \
+        "steady-state fused agg dispatches recompiled"
+    cache.release()

@@ -13,6 +13,7 @@ from elasticsearch_tpu.search.shard_search import ShardSearcher
 MAPPING = {"properties": {
     "tag": {"type": "keyword"},
     "price": {"type": "double"},
+    "ts": {"type": "date"},
     "body": {"type": "text"},
 }}
 
@@ -29,6 +30,7 @@ def searcher():
             b.add(mapper.parse_document(str(did), {
                 "tag": f"k{rng.randint(12)}",
                 "price": float(rng.randint(100)),
+                "ts": 1_700_000_000_000 + did * 600_000,
                 "body": "common" if i % 3 else "rare",
             }), seq_no=did)
         segs.append(b.build())
@@ -143,6 +145,81 @@ def test_masked_ordinal_percentiles_exact_vs_numpy():
             frac = pos - lo
             ref = (1 - frac) * mv[lo] + frac * mv[hi]
             assert abs(out[o, qi] - ref) < 1e-3
+
+
+@pytest.mark.parametrize("query", [None, {"match": {"body": "common"}}])
+def test_date_histogram_device_matches_host(searcher, query, monkeypatch):
+    """Fixed-interval no-tz date_histogram reuses the histogram bucket-id
+    plane: device counts AND reconstructed epoch-millis keys are
+    bitwise-identical to the host floor/multiply path."""
+    spec = {"d": {"date_histogram": {"field": "ts",
+                                     "fixed_interval": "1h"}}}
+    host = _run(searcher, spec, query)
+    assert sum(b["doc_count"]
+               for b in host["d"]["buckets"]) > 0
+    monkeypatch.setattr(ops_aggs, "DEVICE_MIN_PAIRS", 1)
+    dev = _run(searcher, spec, query)
+    assert dev == host
+
+
+def test_hll_register_kernel_matches_host_twin(searcher):
+    """masked_register_max vs the numpy maximum.at twin over the same
+    cached (register, rho)-sorted pairs: integer max is
+    order-independent, so the register arrays are bitwise-equal."""
+    import jax.numpy as jnp
+    seg = searcher.segments[0]
+    rng = np.random.RandomState(11)
+    for field in ("price", "tag"):
+        pairs = ops_aggs.hll_sketch_pairs(seg, field)
+        assert pairs["n_pairs"] == seg.n_docs
+        for density in (0.0, 0.3, 1.0):
+            mask = np.zeros(seg.n_pad, bool)
+            mask[: seg.n_docs] = rng.rand(seg.n_docs) < density \
+                if density < 1.0 else True
+            dev = np.asarray(ops_aggs.masked_register_max(
+                pairs["off_dev"], pairs["docs_dev"], pairs["rhos_dev"],
+                jnp.asarray(mask)))[: pairs["m"]]
+            np.testing.assert_array_equal(
+                dev, ops_aggs.host_register_max(pairs, mask))
+
+
+def test_hll_merge_add_estimate():
+    """Register merge is max-commutative; folding raw values through the
+    scalar hash equals sketching them in one pass; the estimate tracks
+    the true distinct count in the linear-counting regime."""
+    m = 1 << ops_aggs.HLL_P
+    vals_a = [f"v{i}" for i in range(800)]
+    vals_b = [f"v{i}" for i in range(400, 1200)]
+    ra = ops_aggs.hll_add_values(np.zeros(m, np.int32), vals_a,
+                                 ops_aggs.HLL_P)
+    rb = ops_aggs.hll_add_values(np.zeros(m, np.int32), vals_b,
+                                 ops_aggs.HLL_P)
+    merged = ops_aggs.hll_merge(ra, rb)
+    np.testing.assert_array_equal(merged, ops_aggs.hll_merge(rb, ra))
+    one_pass = ops_aggs.hll_add_values(
+        np.zeros(m, np.int32), vals_a + vals_b, ops_aggs.HLL_P)
+    np.testing.assert_array_equal(merged, one_pass)
+    est = ops_aggs.hll_estimate(merged)
+    assert abs(est - 1200) <= 0.02 * 1200
+
+
+def test_cardinality_exact_and_hll_regimes(searcher, monkeypatch):
+    """Below precision_threshold cardinality stays an exact set union;
+    above it both segments collect HLL sketches (the regime keys off the
+    cached per-segment distinct count, so every route picks the same
+    representation) and the device register kernel changes nothing."""
+    exact = _run(searcher, {"c": {"cardinality": {"field": "tag"}}})
+    assert exact == {"c": {"value": 12}}
+    true_prices = _run(searcher, {"c": {"cardinality": {
+        "field": "price"}}})["c"]["value"]
+    spec = {"c": {"cardinality": {"field": "price",
+                                  "precision_threshold": 10}}}
+    host = _run(searcher, spec)
+    monkeypatch.setattr(ops_aggs, "DEVICE_MIN_PAIRS", 1)
+    dev = _run(searcher, spec)
+    assert dev == host
+    # ~100 distincts at m=2^14 sits in linear counting: near-exact
+    assert abs(host["c"]["value"] - true_prices) <= 3
 
 
 def test_batched_blockwise_topk_exact():

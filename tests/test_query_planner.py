@@ -115,7 +115,8 @@ def test_lower_body_shapes():
     assert plan is not None and plan.rescore.mode == "max"
     assert plan.rescore.window == 7 and plan.window_text == 10
     # rejections: cross-field bool, knn filter, unknown rank method,
-    # aggs body, percent msm
+    # aggs combined with knn (hybrid hits widen the agg match set),
+    # percent msm
     assert qp.lower_body({"query": {"bool": {"should": [
         {"match": {"body": "a"}}]}}, "aggs": {"x": {
             "terms": {"field": "body"}}}, "knn": {
