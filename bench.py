@@ -1446,6 +1446,141 @@ def bench_live_indexing(rng):
         "n_clients": n_clients, **out})
 
 
+def bench_tiered_capacity(rng):
+    """Tiered plane storage over-subscription: a per-field plane corpus
+    ~10x the configured HBM budget serves a Zipf-skewed query mix
+    through hot (device) / warm (host-streamed) / cold (pack-file)
+    tiers with demand promotion. Two windows, same planes:
+
+    - ``device``: unlimited budget, every plane hot — the baseline the
+      acceptance gate compares against.
+    - ``tiered``: ``hbm_budget ~= total/10`` (+ a host budget that
+      forces cold spills) — the hot-set (most-queried field) p99 must
+      stay within 1.25x of the device-resident p99, with ZERO
+      steady-state pack rebuilds (cold promotions ride the
+      handoff-import path, never re-pack) and zero new compiles.
+
+    ``scripts/bench_diff.py`` gates hot_p99_ratio, the steady-state
+    rebuild/journal invariants, and promotion-count drift between
+    rounds."""
+    import tempfile
+    from elasticsearch_tpu.common import flightrec
+    from elasticsearch_tpu.common.telemetry import device_stats_doc
+    from elasticsearch_tpu.node.indices_service import IndicesService
+    from elasticsearch_tpu.rest.api import RestAPI
+
+    api = RestAPI(IndicesService(tempfile.mkdtemp(prefix="bench_tier_")))
+    n_fields, n_docs = 12, 1024
+    fields = [f"f{i}" for i in range(n_fields)]
+    vocab = [f"w{i}" for i in range(64)]
+    lines = []
+    for i in range(n_docs):
+        doc = {f: " ".join(vocab[(i * 7 + j * 3 + fi) % 64]
+                           for j in range(6))
+               for fi, f in enumerate(fields)}
+        lines.append(json.dumps({"index": {"_id": str(i)}}))
+        lines.append(json.dumps(doc))
+    api.handle("POST", "/tier/_bulk", "refresh=true",
+               ("\n".join(lines) + "\n").encode())
+    svc = api.indices.get("tier")
+    svc.plane_cache.repack_mode = "sync"    # inline, deterministic
+    svc.plane_cache.lex_prune_min_docs = 1
+
+    def q(field, term):
+        st, _ct, payload = api.handle(
+            "POST", "/tier/_search", "request_cache=false", json.dumps(
+                {"query": {"match": {field: term}}}).encode())
+        doc = json.loads(payload)
+        assert st == 200 and doc["hits"]["total"]["value"] >= 0
+        return doc
+
+    for f in fields:                        # build every plane hot
+        q(f, "w3")
+    tiers = svc.plane_cache.tiers
+    per_plane = {g.field: int(g.base.device_corpus_bytes())
+                 for g in svc.plane_cache.generations()}
+    total_bytes = sum(per_plane.values())
+
+    # Zipf field mix: rank-1 field owns the head (the hot set), the
+    # tail cycles through the demoted planes
+    n_queries = 360
+    ranks = np.minimum(rng.zipf(1.4, size=n_queries), n_fields) - 1
+
+    def window():
+        lat_by_field = {f: [] for f in fields}
+        t0 = time.perf_counter()
+        for qi in range(n_queries):
+            f = fields[int(ranks[qi])]
+            t1 = time.perf_counter()
+            q(f, vocab[(qi * 5) % 64])
+            lat_by_field[f].append(time.perf_counter() - t1)
+        wall = time.perf_counter() - t0
+        hot = np.asarray(lat_by_field[fields[0]])
+        return {"qps": round(n_queries / wall, 1),
+                "hot_p99_ms":
+                    round(float(np.percentile(hot, 99) * 1e3), 3),
+                "hot_n": int(len(hot))}
+
+    device_win = window()                   # baseline: all planes hot
+
+    budget = max(total_bytes // 10, 1)
+    tiers.hbm_budget = budget
+    tiers.host_budget = max(total_bytes // 4, 1)
+    # anti-thrash residency floor (the ES_TPU_PLANE_TIER_MIN_RESIDENCY_S
+    # knob): the actively-served Zipf head must not be evicted by every
+    # tail promotion — tail planes serve warm/streamed instead
+    tiers.min_residency_s = 0.05
+    tiers.enforce_budget()                  # demote down to budget
+    q(fields[0], "w3")                      # head plane is MRU + hot
+    st0 = tiers.stats()
+    rb0 = svc.plane_cache.rebuild_stats()
+    compiles0 = sum(device_stats_doc().get("compiles", {}).values())
+    tiered_win = window()
+    st1 = tiers.stats()
+    rb1 = svc.plane_cache.rebuild_stats()
+    compiles1 = sum(device_stats_doc().get("compiles", {}).values())
+
+    # journal reconstruction: replay plane_tier events into a per-field
+    # tier map and cross-check it against the LIVE registry — the
+    # acceptance requires transitions be reconstructable from the
+    # flight recorder alone
+    derived = {}
+    for ev in flightrec.DEFAULT.events(type_="plane_tier", limit=4096):
+        a = ev.get("attrs", {})
+        if a.get("field") in per_plane:
+            derived[a["field"]] = a["to_tier"]
+    actual = {g.field: g.base.storage_tier
+              for g in svc.plane_cache.generations()}
+    for rec in tiers.cold_records():
+        actual[rec.field] = "cold"
+    journal_consistent = all(
+        derived.get(f, "hot") == actual.get(f, "hot") for f in fields)
+
+    steady_rebuilds = sum(rb1.get(k, 0) - rb0.get(k, 0)
+                          for k in ("cold", "sync", "threshold",
+                                    "structure"))
+    ratio = tiered_win["hot_p99_ms"] / max(device_win["hot_p99_ms"],
+                                           1e-9)
+    api.indices.close()
+    return _emit("tiered_capacity", {
+        "value": tiered_win["qps"], "unit": "queries/s",
+        "capacity_ratio": round(total_bytes / budget, 2),
+        "hbm_budget_bytes": int(budget),
+        "total_plane_bytes": int(total_bytes),
+        "hot_p99_ms": tiered_win["hot_p99_ms"],
+        "device_p99_ms": device_win["hot_p99_ms"],
+        "hot_p99_ratio": round(ratio, 3),
+        "hot_n": tiered_win["hot_n"],
+        "promotions": st1["promotions"] - st0["promotions"],
+        "demotions": st1["demotions"] - st0["demotions"],
+        "cold_planes": st1["cold_planes"],
+        "warm_planes": st1["warm_planes"],
+        "steady_state_rebuilds": int(steady_rebuilds),
+        "steady_state_compiles": int(compiles1 - compiles0),
+        "journal_consistent": bool(journal_consistent),
+        "device_qps": device_win["qps"]})
+
+
 def workload_L(plane, batches, Q=None):
     """One compile shape per config, sized to the WORKLOAD's largest
     sparse posting run instead of the table-wide L_cap — the merge cost
@@ -1650,6 +1785,7 @@ def main(mode: str = "accel"):
     run("analytics_fused", bench_analytics_fused, rng, on_cpu)
     run("serving", bench_serving, rng)
     run("live_indexing", bench_live_indexing, rng)
+    run("tiered_capacity", bench_tiered_capacity, rng)
 
     if not need_plane:
         # filtered run without the headline: promote the first selected

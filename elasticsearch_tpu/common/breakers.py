@@ -173,9 +173,14 @@ class BreakerService:
     """The node's breaker hierarchy (request / fielddata / in-flight /
     accounting under one parent), with dynamic limit updates."""
 
-    #: (name, default limit fraction of budget, overhead)
+    #: (name, default limit fraction of budget, overhead) —
+    #: ``accounting`` carries device-resident (hot-tier) plane bytes;
+    #: ``host_tier`` carries warm-tier host-pinned plane bytes, so a
+    #: demote-to-warm moves the estimate between ledgers instead of
+    #: double-charging the device budget
     CHILDREN = (("request", 0.6, 1.0), ("fielddata", 0.4, 1.03),
-                ("in_flight_requests", 1.0, 2.0), ("accounting", 1.0, 1.0))
+                ("in_flight_requests", 1.0, 2.0), ("accounting", 1.0, 1.0),
+                ("host_tier", 1.0, 1.0))
 
     def __init__(self, budget: int = DEFAULT_BUDGET):
         self.budget = budget

@@ -73,9 +73,9 @@ class HealthService:
     caches); the process telemetry registry and breaker/pressure
     singletons are read directly."""
 
-    INDICATORS = ("shards_availability", "plane_serving", "compile_churn",
-                  "breakers", "indexing_pressure", "task_backlog",
-                  "slo_burn", "dispatch_efficiency")
+    INDICATORS = ("shards_availability", "plane_serving", "plane_tiers",
+                  "compile_churn", "breakers", "indexing_pressure",
+                  "task_backlog", "slo_burn", "dispatch_efficiency")
 
     #: sync non-cold rebuilds: first one turns yellow, a storm turns red
     SYNC_REBUILD_YELLOW = 1
@@ -306,6 +306,85 @@ class HealthService:
                     "Raise ES_TPU_MESH_SHARDS (corpus capacity) or "
                     "ES_TPU_MESH_REPLICAS (query throughput) to cover "
                     "the slice; watch es_mesh_devices{state=\"idle\"}."))
+        return doc
+
+    #: tier transitions per health window that read as promotion churn
+    #: (planes ping-ponging between HBM and host — the working set does
+    #: not fit the configured budget)
+    TIER_CHURN_YELLOW = 8
+    TIER_CHURN_RED = 64
+
+    def _ind_plane_tiers(self) -> dict:
+        """Storage-tier pressure: per-tier resident bytes plus WINDOWED
+        promote/demote churn (the ann-drift watermark pattern — the
+        counters are cumulative, and latched yellow would make 'raise
+        the budget' unverifiable). Steady demotion under a budget is by
+        design; sustained promotion churn means the Zipf hot set is
+        larger than the HBM budget and every probe is paying a
+        host→device re-upload."""
+        promotions = demotions = 0
+        hot_b = warm_b = cold_b = 0
+        warm_planes = cold_planes = 0
+        budgeted = False
+        for _name, svc in list(self.api.indices.indices.items()):
+            try:
+                tiers = svc.plane_cache.tiers
+                st = tiers.stats()
+            except Exception:   # noqa: BLE001 — no plane cache: skip
+                continue
+            budgeted = budgeted or tiers.enabled()
+            promotions += st["promotions"]
+            demotions += st["demotions"]
+            hot_b += st["hot_bytes"]
+            warm_b += st["warm_bytes"]
+            cold_b += st["cold_bytes"]
+            warm_planes += st["warm_planes"]
+            cold_planes += st["cold_planes"]
+        with _ANN_DRIFT_LOCK:
+            seen = getattr(self.api, "_tier_churn_seen", None)
+            total = promotions + demotions
+            self.api._tier_churn_seen = total
+            churn = 0 if seen is None else max(total - seen, 0)
+        if churn >= self.TIER_CHURN_RED:
+            status = RED
+        elif churn >= self.TIER_CHURN_YELLOW:
+            status = YELLOW
+        else:
+            status = GREEN
+        doc = {
+            "status": status,
+            "symptom": (f"{churn} plane tier transitions since the last "
+                        f"evaluation (promotion churn)."
+                        if status != GREEN else
+                        ("Plane storage tiers are stable under the "
+                         "configured budgets." if budgeted else
+                         "Plane tiering is not budget-constrained "
+                         "(every plane device-resident).")),
+            "details": {"tier_transitions_window": churn,
+                        "promotions_total": promotions,
+                        "demotions_total": demotions,
+                        "hot_bytes": hot_b, "warm_bytes": warm_b,
+                        "cold_bytes": cold_b,
+                        "warm_planes": warm_planes,
+                        "cold_planes": cold_planes,
+                        "budgeted": budgeted},
+        }
+        if status != GREEN:
+            doc["impacts"] = [_impact(
+                "plane_tiers:promotion_churn", 2,
+                "Serving planes ping-pong between HBM and host tiers: "
+                "promoted planes are evicted before their next access, "
+                "so dispatches repeatedly pay host→device streaming and "
+                "re-upload instead of HBM-resident scans.", ["search"])]
+            doc["diagnosis"] = [_diagnosis(
+                "plane_tiers:working_set_over_budget",
+                "The query mix's hot set is larger than "
+                "ES_TPU_PLANE_HBM_BUDGET_BYTES: LRU demotion and demand "
+                "promotion are fighting over the same planes.",
+                "Raise ES_TPU_PLANE_HBM_BUDGET_BYTES (or add shard "
+                "devices to shrink per-device plane bytes); watch "
+                "es_plane_tier_promotions_total vs "
+                "es_plane_tier_bytes{tier=\"hot\"}.")]
         return doc
 
     def _ind_compile_churn(self) -> dict:

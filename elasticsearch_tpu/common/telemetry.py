@@ -57,6 +57,7 @@ __all__ = [
     "lex_prune_off_count",
     "record_search_retry", "record_shard_failover",
     "record_recovery_bytes", "record_plane_handoff_ms",
+    "record_tier_transition", "record_tier_stream_bytes",
 ]
 
 
@@ -754,6 +755,41 @@ def record_plane_handoff_ms(ms: float, exemplar: Optional[str] = None,
                        "on the receiving node (exemplars carry the "
                        "recovery trace id)").observe(
         float(ms), exemplar=exemplar)
+
+
+def record_tier_transition(op: str, to_tier: str,
+                           registry: Optional[TelemetryRegistry]
+                           = None) -> None:
+    """One plane-generation tier transition: ``op="promote"`` with
+    ``to_tier`` in (hot, warm) — a colder generation climbed a tier on
+    access pressure; ``op="demote"`` with ``to_tier`` in (warm, cold)
+    — the tier manager spilled a generation to fit the device/host
+    budgets. Every label value is pre-created so the families' label
+    spaces are stable for the telemetry lint."""
+    reg = registry or DEFAULT
+    for tt in ("hot", "warm"):
+        reg.counter("es_plane_tier_promotions_total", {"to": tt},
+                    help="plane generations promoted per destination "
+                         "tier (demand promotion on access "
+                         "pressure)").inc(
+            1 if op == "promote" and tt == to_tier else 0)
+    for tt in ("warm", "cold"):
+        reg.counter("es_plane_tier_demotions_total", {"to": tt},
+                    help="plane generations demoted per destination "
+                         "tier (budget-pressure spill)").inc(
+            1 if op == "demote" and tt == to_tier else 0)
+
+
+def record_tier_stream_bytes(n: int,
+                             registry: Optional[TelemetryRegistry]
+                             = None) -> None:
+    """Bytes streamed host→device for one warm-tier dispatch (the
+    per-dispatch corpus re-upload the ``*_streamed`` roofline families
+    model)."""
+    reg = registry or DEFAULT
+    reg.counter("es_plane_tier_stream_bytes_total",
+                help="host→device bytes streamed by warm-tier "
+                     "dispatches").inc(n)
 
 
 #: per-thread flag: did the LAST instrumented-step call on this thread

@@ -156,6 +156,15 @@ def runtime_families() -> Set[str]:
         api.handle("POST", "/lint/_search", "request_cache=false",
                    json.dumps({"query": {"match": {"body": "quick"}},
                                "prune": False}).encode())
+        # storage-tier cycle: demote the live text generation to warm
+        # and promote it straight back — one round trip registers the
+        # es_plane_tier_{promotions,demotions}_total counters (full
+        # label space is pre-created on first transition) while the
+        # es_plane_tier_bytes gauge rides the tier manager's object
+        # collector
+        _tgen = svc.plane_cache.generations()[0]
+        svc.plane_cache.tiers.demote_to_warm(_tgen, reason="lint")
+        svc.plane_cache.tiers._promote(_tgen)
         # forced jitted dispatch so the XLA compile/transfer families
         # register even on the CPU test backend (host-eager otherwise)
         import numpy as np
@@ -175,6 +184,12 @@ def runtime_families() -> Set[str]:
         record_mesh_devices(1, 0)
         plane = DistributedSearchPlane(mesh, [corpus], field="body")
         plane._host_csr = None
+        plane.serve([["t1"]], k=4, with_totals=True)
+        # warm-tier streamed dispatch: demote the jitted plane's corpus
+        # to host and re-serve — the per-dispatch device_put stream
+        # registers es_plane_tier_stream_bytes_total and the *_streamed
+        # roofline kernel family
+        plane.demote_to_warm()
         plane.serve([["t1"]], k=4, with_totals=True)
         # IVF (cluster-pruned ANN) dispatch: registers the es_ann_*
         # families (clusters probed / candidates re-ranked / bytes per

@@ -60,7 +60,7 @@ _NON_SERVING_ATTR = re.compile(r"metric")
 #: dispatch from the dispatcher loop
 TELEMETRY_MODULES = re.compile(
     r"(^|\.)(common\.(telemetry|tracing|flightrec|roofline)"
-    r"|search\.dispatch_profile)$")
+    r"|search\.(dispatch_profile|plane_tiers))$")
 
 _LOCK_CTORS = {"Lock", "RLock"}
 

@@ -632,22 +632,25 @@ class ClusterNode:
         svc = self.rest.indices.indices.get(name)
         if svc is None or not self.plane_handoff_enabled:
             return {"bundles": []}
-        from ..common.datacodec import dumps_b64
         now = time.monotonic()
         with self._plane_export_lock:
             for xid in [x for x, e in self._plane_exports.items()
                         if now - e["ts"] > self.PLANE_EXPORT_TTL]:
                 self._plane_exports.pop(xid)
         entries = []
-        for bundle in svc.plane_cache.export_bundles():
-            blob = dumps_b64(bundle)
+        # export_bundle_blobs ships pre-serialized payloads: live
+        # generations serialize here, COLD-tier planes hand their pack
+        # file's text over verbatim (the spilled plane IS the handoff
+        # artifact — no re-serialization on the donor offer)
+        for item in svc.plane_cache.export_bundle_blobs():
+            blob = item["blob"]
             n = self.PLANE_CHUNK_BYTES
             chunks = [blob[i: i + n] for i in range(0, len(blob), n)]
             xid = uuid.uuid4().hex
             with self._plane_export_lock:
                 self._plane_exports[xid] = {"chunks": chunks, "ts": now}
-            entries.append({"xfer_id": xid, "kind": bundle["kind"],
-                            "field": bundle["field"],
+            entries.append({"xfer_id": xid, "kind": item["kind"],
+                            "field": item["field"],
                             "n_chunks": len(chunks),
                             "nbytes": len(blob)})
         from ..common import flightrec as _fr

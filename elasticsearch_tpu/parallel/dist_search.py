@@ -1991,6 +1991,14 @@ class DistributedSearchPlane:
         # dispatcher threads + the warmup thread build steps concurrently
         self._steps_lock = threading.Lock()
         self._serial_dispatch = _serial_dispatch_required(mesh)
+        #: storage tier: "hot" = device-resident corpus arrays (today's
+        #: path); "warm" = corpus pulled to host (``_warm_host``) and
+        #: streamed to device per dispatch (the ``bm25_streamed``
+        #: roofline family). Transitions run through
+        #: :meth:`demote_to_warm` / :meth:`promote_to_hot` (the serving
+        #: cache's tier manager drives them on access pressure).
+        self.storage_tier = "hot"
+        self._warm_host: Optional[dict] = None
 
     @staticmethod
     def empty_pad_shard(avgdl: Optional[float] = None) -> dict:
@@ -2013,7 +2021,13 @@ class DistributedSearchPlane:
         """Packed-corpus bytes RESIDENT PER DEVICE: the corpus arrays are
         sharded over the ``shard`` axis (each device holds 1/s_dev of the
         rows; replica groups hold full copies), so this is the per-chip
-        HBM cost the MULTICHIP bench asserts scales ~1/n_shards."""
+        HBM cost the MULTICHIP bench asserts scales ~1/n_shards.
+
+        A demoted (warm/cold) generation holds NO resident device corpus
+        — reporting 0 here is what makes the ``es_plane_hbm_bytes``
+        gauge decrement on demotion."""
+        if self.storage_tier != "hot":
+            return 0
         s_dev = self.mesh.shape[AXIS_SHARD]
         total = int(self.docs_dev.nbytes) + int(self.impacts_dev.nbytes)
         if self.dense_dev is not None:
@@ -2026,6 +2040,94 @@ class DistributedSearchPlane:
             total += len(bmx.shards) * nb1 * (bmx.block * 5 + 8)
         return total // max(s_dev, 1)
 
+    # -- storage tiers (hot / warm) ------------------------------------------
+
+    def host_tier_bytes(self) -> int:
+        """Host bytes the warm tier holds (the host-memory breaker's
+        unit of account): the pulled corpus arrays only — the CPU
+        host-CSR serving copy exists on every tier and is accounted at
+        build time, not here."""
+        warm = self._warm_host
+        if warm is None:
+            return 0
+        total = int(warm["docs"].nbytes) + int(warm["impacts"].nbytes)
+        if warm.get("dense") is not None:
+            total += int(warm["dense"].nbytes)
+        return total
+
+    def demote_to_warm(self) -> int:
+        """Hot → warm: pull the corpus arrays to host, drop every device
+        reference (the HBM frees once in-flight dispatches release their
+        captured refs). Serving keeps working — :meth:`search` streams
+        the host copies to device per dispatch (``bm25_streamed``).
+        Returns the host bytes now held (the warm-tier breaker
+        estimate); 0 if the plane was not hot."""
+        if self.storage_tier != "hot":
+            return 0
+        # pull OUTSIDE the steps lock (a device→host sync must not stall
+        # concurrent step-cache readers), then swap refs under it
+        warm = dict(
+            docs=np.asarray(self.docs_dev),
+            impacts=np.asarray(self.impacts_dev),
+            dense=(np.asarray(self.dense_dev)
+                   if self.dense_dev is not None else None))
+        with self._steps_lock:
+            self._warm_host = warm
+            self.docs_dev = None
+            self.impacts_dev = None
+            self.dense_dev = None
+            self.storage_tier = "warm"
+        if self.blockmax is not None:
+            with self.blockmax._dev_lock:
+                self.blockmax._dev = None
+        return self.host_tier_bytes()
+
+    def promote_to_hot(self) -> int:
+        """Warm → hot: re-upload the host copies as resident sharded
+        device arrays and release the warm host tier. Returns the host
+        bytes released (the warm breaker estimate to free); 0 if the
+        plane was not warm."""
+        if self.storage_tier != "warm":
+            return 0
+        warm = self._warm_host
+        freed = self.host_tier_bytes()
+        corpus_spec = NamedSharding(self.mesh, P(AXIS_SHARD, None))
+        docs_dev = jax.device_put(warm["docs"], corpus_spec)
+        impacts_dev = jax.device_put(warm["impacts"], corpus_spec)
+        dense_dev = None
+        if warm.get("dense") is not None and self.T_pad:
+            dense_dev = jax.device_put(
+                np.asarray(warm["dense"]).astype(jnp.bfloat16),
+                NamedSharding(self.mesh, P(AXIS_SHARD, None, None, None)))
+        with self._steps_lock:
+            self.docs_dev = docs_dev
+            self.impacts_dev = impacts_dev
+            self.dense_dev = dense_dev
+            self._warm_host = None
+            self.storage_tier = "hot"
+        return freed
+
+    def _corpus_refs(self):
+        """``(docs, impacts, dense, stream_bytes)`` for one dispatch:
+        the resident device arrays (stream 0) when hot; fresh
+        per-dispatch uploads of the warm host tiers when warm — the
+        streamed bytes feed the ``bm25_streamed`` roofline model and
+        ``es_plane_tier_stream_bytes_total``."""
+        if self.storage_tier == "hot":
+            return self.docs_dev, self.impacts_dev, self.dense_dev, 0
+        warm = self._warm_host
+        corpus_spec = NamedSharding(self.mesh, P(AXIS_SHARD, None))
+        docs = jax.device_put(warm["docs"], corpus_spec)
+        impacts = jax.device_put(warm["impacts"], corpus_spec)
+        stream = int(warm["docs"].nbytes) + int(warm["impacts"].nbytes)
+        dense = None
+        if warm.get("dense") is not None and self.T_pad:
+            dense = jax.device_put(
+                np.asarray(warm["dense"]).astype(jnp.bfloat16),
+                NamedSharding(self.mesh, P(AXIS_SHARD, None, None, None)))
+            stream += int(warm["dense"].nbytes)
+        return docs, impacts, dense, stream
+
     # -- warm-handoff packed state (the recovery artifact) -------------------
 
     def export_packed(self) -> dict:
@@ -2037,7 +2139,20 @@ class DistributedSearchPlane:
         state. :meth:`from_packed` reconstructs a serving-identical
         plane WITHOUT re-running the pack (impacts, tier split,
         impact-ordering lexsort, dense fill) — the packed plane IS the
-        recovery artifact (BM25S's eagerly-scored form)."""
+        recovery artifact (BM25S's eagerly-scored form). Works from any
+        storage tier: a warm generation reads its host copies instead
+        of the (released) device arrays."""
+        warm = self._warm_host
+        if warm is not None:
+            docs_np = np.asarray(warm["docs"])
+            impacts_np = np.asarray(warm["impacts"])
+            dense_np = (np.asarray(warm["dense"]).astype(np.float32)
+                        if warm.get("dense") is not None else None)
+        else:
+            docs_np = np.asarray(self.docs_dev)
+            impacts_np = np.asarray(self.impacts_dev)
+            dense_np = (np.asarray(self.dense_dev).astype(np.float32)
+                        if self.dense_dev is not None else None)
         out = dict(
             field=self.field, k1=float(self.k1), b=float(self.b),
             n_shards=int(self.n_shards), n_pad=int(self.n_pad),
@@ -2048,10 +2163,9 @@ class DistributedSearchPlane:
             L_cap=int(self.L_cap), n_dense=int(self.n_dense),
             T_pad=int(self.T_pad),
             dense_block=int(getattr(self, "dense_block", 0)),
-            docs=np.asarray(self.docs_dev),
-            impacts=np.asarray(self.impacts_dev),
-            dense=(np.asarray(self.dense_dev).astype(np.float32)
-                   if self.dense_dev is not None else None),
+            docs=docs_np,
+            impacts=impacts_np,
+            dense=dense_np,
             shards=[dict(term_ids=dict(sh["term_ids"]), df=sh["df"],
                          sparse_offsets=sh["sparse_offsets"],
                          sparse_df=sh["sparse_df"],
@@ -2126,6 +2240,8 @@ class DistributedSearchPlane:
         self._steps = {}
         self._steps_lock = threading.Lock()
         self._serial_dispatch = _serial_dispatch_required(mesh)
+        self.storage_tier = "hot"
+        self._warm_host = None
         return self
 
     @classmethod
@@ -2319,7 +2435,15 @@ class DistributedSearchPlane:
         eager scan: pruning is provably inert there, and the pruned
         machinery would only add candidate bookkeeping on top of a full
         scan."""
-        if self.blockmax is not None and prune is not False:
+        # a warm plane serving the jitted path streams the f32 corpus
+        # per dispatch anyway — the block-max device tier would pin HBM
+        # back (its device cache is exactly what demotion dropped), so
+        # warm routes to the plain streamed scan (rank-safe: pruning is
+        # an optimization, never a result change). The host pruned path
+        # stays available — it touches no device memory.
+        warm_stream = self.storage_tier != "hot" and self._host_csr is None
+        if self.blockmax is not None and prune is not False \
+                and not warm_stream:
             needed_q = max(self.SERVING_Q_MIN, round_up_pow2(max(
                 max((len(set(q)) for q in queries), default=1), 1)))
             if k * needed_q <= LEX_THETA_WINDOW:
@@ -2396,6 +2520,7 @@ class DistributedSearchPlane:
         use_tiered = any_dense if tiered is None else (tiered and self.T_pad > 0)
         if tiered is False and any_dense:
             raise ValueError("tiered=False but the batch hits dense-tier terms")
+        docs_dev, impacts_dev, dense_dev, stream_b = self._corpus_refs()
         if use_tiered:
             U, u_ids, rid_slots, dense_w, W = self._dense_inputs(
                 idfw, dense_rid, dense_hit)
@@ -2403,7 +2528,7 @@ class DistributedSearchPlane:
                                   with_count=with_totals, U=U)
             shard2 = NamedSharding(self.mesh, P(AXIS_SHARD, None))
             step_args = (
-                self.docs_dev, self.impacts_dev, self.dense_dev,
+                docs_dev, impacts_dev, dense_dev,
                 jax.device_put(starts, repl3),
                 jax.device_put(lengths, repl3),
                 jax.device_put(idfw, repl),
@@ -2414,7 +2539,7 @@ class DistributedSearchPlane:
         else:
             step = self._get_step(Q, L, k, with_count=with_totals)
             step_args = (
-                self.docs_dev, self.impacts_dev,
+                docs_dev, impacts_dev,
                 jax.device_put(starts, repl3), jax.device_put(lengths, repl3),
                 jax.device_put(idfw, repl))
         t1 = time.perf_counter()
@@ -2436,13 +2561,16 @@ class DistributedSearchPlane:
         vals, gdocs = out[0], out[1]
         vals = np.asarray(vals)[:B]          # drop replica-padding slots
         gdocs = np.asarray(gdocs)[:B]
-        # device-transfer accounting: the per-dispatch uploads (corpus
-        # arrays are resident and excluded) + the fetched result rows
-        h2d = starts.nbytes + lengths.nbytes + idfw.nbytes + \
+        # device-transfer accounting: the per-dispatch uploads (resident
+        # hot corpus arrays excluded; a warm plane's per-dispatch corpus
+        # stream counted) + the fetched result rows
+        h2d = starts.nbytes + lengths.nbytes + idfw.nbytes + stream_b + \
             (rid_slots.nbytes + dense_w.nbytes + W.nbytes + u_ids.nbytes
              if use_tiered else 0)
         d2h = vals.nbytes + gdocs.nbytes
         _tm.record_transfer(h2d_bytes=h2d, d2h_bytes=d2h)
+        if stream_b:
+            _tm.record_tier_stream_bytes(stream_b)
         if stages is not None:
             # per-dispatch bytes for task resource attribution (the
             # micro-batcher shares them across the batch's slots)
@@ -2463,11 +2591,21 @@ class DistributedSearchPlane:
             # roofline audit inputs (common/roofline.py): the dense-tier
             # stream (U-gather working set when the batch gathered used
             # rows) + the sparse sorted-merge tile — the ROOFLINE.md
-            # per-dispatch cost model for this exact dispatch's shapes
+            # per-dispatch cost model for this exact dispatch's shapes.
+            # A warm plane's dispatch is dominated by the host→device
+            # corpus re-upload instead: the streamed-tier model, audited
+            # against the host-link ceiling.
             from ..common import roofline as _rl
-            stages["kernel"] = "bm25_eager"
-            stages["model_bytes"] = _rl.model_bytes_bm25_dense(
-                B_pad, Q, L, U if use_tiered else 0, self.n_pad)
+            if stream_b:
+                stages["kernel"] = "bm25_streamed"
+                stages["tier"] = "warm"
+                stages["stream_bytes"] = stream_b
+                stages["model_bytes"] = _rl.model_bytes_streamed(
+                    stream_b, B_pad, k)
+            else:
+                stages["kernel"] = "bm25_eager"
+                stages["model_bytes"] = _rl.model_bytes_bm25_dense(
+                    B_pad, Q, L, U if use_tiered else 0, self.n_pad)
         if with_totals:
             totals = [int(c) for c in np.asarray(out[2])[:B]]
             return vals, hits, totals
@@ -2971,6 +3109,18 @@ class DistributedSearchPlane:
         on every input by construction."""
         if self.blockmax is None:
             raise RuntimeError("plane has no block-max tier")
+        if self.storage_tier != "hot":
+            # warm plane: the block-max device tier was dropped on
+            # demotion and the corpus streams per dispatch anyway —
+            # serve through the (rank-identical) streamed eager scan
+            return self.search(
+                queries, k=k,
+                Q=max(self.SERVING_Q_MIN, round_up_pow2(max(
+                    max((len(set(q)) for q in queries), default=1), 1))),
+                L=self.ladder_L(self.max_run_len(queries)),
+                tiered=self.T_pad > 0 or None,
+                with_totals=with_totals, stages=stages,
+                extra_docs=extra_docs, extra_df=extra_df)
         t0 = time.perf_counter()
         tier = self.blockmax
         BS = tier.block
@@ -3319,14 +3469,26 @@ class DistributedSearchPlane:
         np.minimum(lengths, L, out=lengths)
         step = self._get_bool_step(Q, L, k, with_count=True,
                                    nc=MAX_BOOL_CLAUSES)
+        # warm plane: stream the sparse tables per dispatch (the bool
+        # step never reads the dense tier, so only docs/impacts ship)
+        if self.storage_tier == "hot":
+            docs_dev, impacts_dev, stream_b = \
+                self.docs_dev, self.impacts_dev, 0
+        else:
+            _warm = self._warm_host
+            _cs = NamedSharding(self.mesh, P(AXIS_SHARD, None))
+            docs_dev = jax.device_put(_warm["docs"], _cs)
+            impacts_dev = jax.device_put(_warm["impacts"], _cs)
+            stream_b = int(_warm["docs"].nbytes) + \
+                int(_warm["impacts"].nbytes)
         repl = NamedSharding(self.mesh, P(AXIS_REPLICA, None))
         repl1 = NamedSharding(self.mesh, P(AXIS_REPLICA))
         repl3 = NamedSharding(self.mesh, P(AXIS_REPLICA, AXIS_SHARD,
                                            None))
         t1 = time.perf_counter()
         out = _run_step(
-            self._serial_dispatch, step, self.docs_dev,
-            self.impacts_dev,
+            self._serial_dispatch, step, docs_dev,
+            impacts_dev,
             jax.device_put(starts, repl3), jax.device_put(lengths, repl3),
             jax.device_put(idfw, repl), jax.device_put(cbits, repl),
             jax.device_put(req, repl1), jax.device_put(neg, repl1),
@@ -3343,9 +3505,11 @@ class DistributedSearchPlane:
         gdocs = np.asarray(out[1])[:B]
         counts = np.asarray(out[2])[:B]
         h2d = starts.nbytes + lengths.nbytes + idfw.nbytes + \
-            cbits.nbytes + 16 * B_pad
+            cbits.nbytes + 16 * B_pad + stream_b
         d2h = vals.nbytes + gdocs.nbytes + counts.nbytes
         _tm.record_transfer(h2d_bytes=h2d, d2h_bytes=d2h)
+        if stream_b:
+            _tm.record_tier_stream_bytes(stream_b)
         hits = []
         for bi in range(B):
             row = []
@@ -3361,6 +3525,13 @@ class DistributedSearchPlane:
             stages["compile_cache"] = "miss" if compiled else "hit"
             stages["h2d_bytes"] = h2d
             stages["d2h_bytes"] = d2h
+            if stream_b:
+                from ..common import roofline as _rl
+                stages["kernel"] = "bm25_streamed"
+                stages["tier"] = "warm"
+                stages["stream_bytes"] = stream_b
+                stages["model_bytes"] = _rl.model_bytes_streamed(
+                    stream_b, B_pad, k)
         if with_totals:
             return vals, hits, [int(c) for c in counts]
         return vals, hits
@@ -3494,6 +3665,10 @@ class DistributedKnnPlane:
         self._host_pack = self._packed \
             if (jax.devices()[0].platform == "cpu"
                 and host_serve_enabled()) else None
+        #: storage tier (mirror of the text plane's): "hot" =
+        #: device-resident (lazily uploaded) corpus; "warm" = host-only
+        #: ``_packed``, streamed to device per dispatch (``knn_streamed``)
+        self.storage_tier = "hot"
 
     @staticmethod
     def empty_pad_shard(dim: int) -> dict:
@@ -3523,7 +3698,11 @@ class DistributedKnnPlane:
         """Packed-corpus bytes RESIDENT PER DEVICE (vectors + invariants
         + the IVF quantized tier when present), shard-axis-sharded — the
         vector mirror of the text plane's accessor; the MULTICHIP bench
-        asserts it scales ~1/n_shards."""
+        asserts it scales ~1/n_shards. A demoted (warm/cold) generation
+        reports 0: nothing is resident, so ``es_plane_hbm_bytes``
+        decrements on demotion."""
+        if self.storage_tier != "hot":
+            return 0
         s_dev = self.mesh.shape[AXIS_SHARD]
         dim = max(self.dim, 1)
         # vecs f32 + vnorm2 f32 + exists bool per padded row
@@ -3535,6 +3714,64 @@ class DistributedKnnPlane:
             total += self.n_shards * nb1 * self.ivf.block * \
                 (dim * self.ivf.quant_bytes_per_dim() + 16)
         return total // max(s_dev, 1)
+
+    # -- storage tiers (hot / warm) ------------------------------------------
+
+    def host_tier_bytes(self) -> int:
+        """Host bytes the warm tier holds — the packed invariants kept
+        host-side for per-dispatch streaming."""
+        if self.storage_tier != "warm":
+            return 0
+        with self._steps_lock:
+            packed = self._packed
+        if packed is None:
+            return 0
+        return sum(int(a.nbytes) for a in packed)
+
+    def demote_to_warm(self) -> int:
+        """Hot → warm: ensure a host copy of the packed invariants
+        exists (accelerators released it after the lazy upload — read
+        the device arrays back once), then drop every device reference
+        (corpus + IVF tier caches). Returns the host bytes now held."""
+        if self.storage_tier != "hot":
+            return 0
+        with self._steps_lock:
+            if self._packed is None and self._dev is not None:
+                self._packed = tuple(np.asarray(a) for a in self._dev)
+            self._dev = None
+            self.storage_tier = "warm"
+        if self.ivf is not None:
+            with self.ivf._dev_lock:
+                self.ivf._dev = None
+        return self.host_tier_bytes()
+
+    def promote_to_hot(self) -> int:
+        """Warm → hot: flip the tier back — the resident upload stays
+        lazy (:meth:`_device_arrays` on the next dispatch, exactly like
+        a fresh plane). Returns the host breaker bytes to release."""
+        if self.storage_tier != "warm":
+            return 0
+        freed = self.host_tier_bytes()
+        with self._steps_lock:
+            self.storage_tier = "hot"
+        return freed
+
+    def _corpus_refs(self):
+        """``(vecs, vnorm2, exists, stream_bytes)``: the cached resident
+        arrays when hot; fresh per-dispatch uploads of the host pack
+        when warm (``knn_streamed`` — no device caching, or demotion
+        would silently re-pin the HBM it just freed)."""
+        if self.storage_tier == "hot":
+            return self._device_arrays() + (0,)
+        with self._steps_lock:
+            vecs, vnorm2, exists = self._packed
+        corpus3 = NamedSharding(self.mesh, P(AXIS_SHARD, None, None))
+        corpus2 = NamedSharding(self.mesh, P(AXIS_SHARD, None))
+        stream = int(vecs.nbytes) + int(vnorm2.nbytes) + \
+            int(exists.nbytes)
+        return (jax.device_put(vecs, corpus3),
+                jax.device_put(vnorm2, corpus2),
+                jax.device_put(exists, corpus2), stream)
 
     # -- warm-handoff packed state (the recovery artifact) -------------------
 
@@ -3613,6 +3850,7 @@ class DistributedKnnPlane:
         self._host_pack = self._packed \
             if (jax.devices()[0].platform == "cpu"
                 and host_serve_enabled()) else None
+        self.storage_tier = "hot"
         return self
 
     def resolve_ann(self, nprobe: Optional[int],
@@ -3643,8 +3881,13 @@ class DistributedKnnPlane:
                 return self.search_ivf_host(query_vectors, k=k,
                                             nprobe=ann[0], rerank=ann[1],
                                             stages=stages)
-            return self.search_ivf(query_vectors, k=k, nprobe=ann[0],
-                                   rerank=ann[1], stages=stages)
+            if self.storage_tier == "hot":
+                return self.search_ivf(query_vectors, k=k, nprobe=ann[0],
+                                       rerank=ann[1], stages=stages)
+            # warm device plane: the IVF device tier was dropped on
+            # demotion, and cluster-pruning buys nothing when the whole
+            # corpus streams anyway — fall through to the (rank-safe
+            # superset) streamed exact scan
         if self._host_pack is not None:
             return self.search_host(query_vectors, k=k, stages=stages)
         return self.search(query_vectors, k=k, stages=stages)
@@ -3680,7 +3923,7 @@ class DistributedKnnPlane:
             q = np.concatenate(
                 [q, np.zeros((B_pad - B, q.shape[1]), np.float32)])
         step = self._get_step(k)
-        vecs_dev, vnorm2_dev, exists_dev = self._device_arrays()
+        vecs_dev, vnorm2_dev, exists_dev, stream_b = self._corpus_refs()
         q_dev = jax.device_put(q, NamedSharding(self.mesh,
                                                 P(AXIS_REPLICA, None)))
         t1 = time.perf_counter()
@@ -3697,23 +3940,34 @@ class DistributedKnnPlane:
         compiled = _tm.last_call_compiled()
         vals = np.asarray(vals)[:B]
         gdocs = np.asarray(gdocs)[:B]
-        _tm.record_transfer(h2d_bytes=q.nbytes,
+        _tm.record_transfer(h2d_bytes=q.nbytes + stream_b,
                             d2h_bytes=vals.nbytes + gdocs.nbytes)
+        if stream_b:
+            _tm.record_tier_stream_bytes(stream_b)
         hits = self._decode_hits(vals, gdocs)
         if stages is not None:
             stages["prep_ms"] = (t1 - t0) * 1e3
             stages["dispatch_ms"] = (t2 - t1) * 1e3
             stages["fetch_ms"] = (time.perf_counter() - t2) * 1e3
             stages["compile_cache"] = "miss" if compiled else "hit"
-            stages["h2d_bytes"] = q.nbytes
+            stages["h2d_bytes"] = q.nbytes + stream_b
             stages["d2h_bytes"] = vals.nbytes + gdocs.nbytes
             # roofline audit inputs: the f32 corpus streams once per
-            # batch (ROOFLINE.md kNN bytes-moved model)
+            # batch (ROOFLINE.md kNN bytes-moved model); a warm plane's
+            # dispatch is the host→device re-upload instead — the
+            # streamed-tier model against the host-link ceiling
             from ..common import roofline as _rl
-            stages["kernel"] = "knn_exact"
-            stages["model_bytes"] = _rl.model_bytes_knn_exact(
-                self.n_shards * self.n_pad, max(self.dim, 1),
-                l2=self.similarity == "l2_norm")
+            if stream_b:
+                stages["kernel"] = "knn_streamed"
+                stages["tier"] = "warm"
+                stages["stream_bytes"] = stream_b
+                stages["model_bytes"] = _rl.model_bytes_streamed(
+                    stream_b, B_pad, k)
+            else:
+                stages["kernel"] = "knn_exact"
+                stages["model_bytes"] = _rl.model_bytes_knn_exact(
+                    self.n_shards * self.n_pad, max(self.dim, 1),
+                    l2=self.similarity == "l2_norm")
         return vals, hits
 
     def _decode_hits(self, vals, gdocs):
@@ -3868,6 +4122,10 @@ class DistributedKnnPlane:
         tier. Same return convention as :meth:`search`."""
         if self.ivf is None:
             raise RuntimeError("plane has no IVF tier")
+        if self.storage_tier != "hot":
+            # warm plane: the IVF device tier was dropped on demotion —
+            # serve the streamed exact scan instead (rank-safe superset)
+            return self.search(query_vectors, k=k, stages=stages)
         t0 = time.perf_counter()
         tier = self.ivf
         q = np.asarray(query_vectors, np.float32)
@@ -4149,11 +4407,14 @@ def fused_search_device(text_plane: "DistributedSearchPlane",
             rescore_mode=rescore_mode or "total",
             block=knn_plane.block),
         "fused_plane")
-    kvecs_dev, kvn_dev, kex_dev = knn_plane._device_arrays()
+    kvecs_dev, kvn_dev, kex_dev, k_stream = knn_plane._corpus_refs()
+    tdocs_dev, timpacts_dev, _tdense, t_stream = \
+        text_plane._corpus_refs()
+    stream_b = k_stream + t_stream
     repl = NamedSharding(mesh, P(AXIS_REPLICA, None))
     repl1 = NamedSharding(mesh, P(AXIS_REPLICA))
     repl3 = NamedSharding(mesh, P(AXIS_REPLICA, AXIS_SHARD, None))
-    args = [text_plane.docs_dev, text_plane.impacts_dev,
+    args = [tdocs_dev, timpacts_dev,
             kvecs_dev, kvn_dev, kex_dev,
             jax.device_put(starts, repl3), jax.device_put(lengths, repl3),
             jax.device_put(idfw, repl), jax.device_put(cbits, repl),
@@ -4163,7 +4424,7 @@ def fused_search_device(text_plane: "DistributedSearchPlane",
             jax.device_put(rc, repl1), jax.device_put(wt, repl1),
             jax.device_put(wk, repl1)]
     h2d = starts.nbytes + lengths.nbytes + idfw.nbytes + cbits.nbytes \
-        + qv.nbytes + 24 * B_pad
+        + qv.nbytes + 24 * B_pad + stream_b
     if Q2:
         args += [jax.device_put(st2, repl3), jax.device_put(ln2, repl3),
                  jax.device_put(iw2, repl), jax.device_put(qw, repl1),
@@ -4190,6 +4451,8 @@ def fused_search_device(text_plane: "DistributedSearchPlane",
     d2h = fvals.nbytes + fids.nbytes + counts.nbytes + tvals.nbytes \
         + tids.nbytes + kvals.nbytes + kids.nbytes
     _tm.record_transfer(h2d_bytes=h2d, d2h_bytes=d2h)
+    if stream_b:
+        _tm.record_tier_stream_bytes(stream_b)
     UP = max(text_plane.n_pad, knn_plane.n_pad)
 
     def decode(vrow, grow, npad, kq):
@@ -4216,6 +4479,9 @@ def fused_search_device(text_plane: "DistributedSearchPlane",
         stages["d2h_bytes"] = d2h
         stages["docs_scanned"] = text_plane.n_docs_total \
             + knn_plane.n_docs_total
+        if stream_b:
+            stages["tier"] = "warm"
+            stages["stream_bytes"] = stream_b
     return rows, totals, text_rows, knn_rows
 
 

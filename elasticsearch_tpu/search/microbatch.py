@@ -427,6 +427,15 @@ class PlaneMicroBatcher:
         sdev = max(self.mesh_shard_devices, 1)
         base_scan = int(base_docs if scanned is None else scanned)
         batch_info["docs_scanned_per_device"] = -(-base_scan // sdev)
+        tier = plane_stages.get("tier")
+        if tier is not None:
+            # streamed-tier dispatch (warm plane): surface the storage
+            # tier + per-dispatch host→device stream bytes next to the
+            # transfer counters, so profile:true and the stats rollup
+            # show WHY this dispatch's byte model moved to the host link
+            batch_info["tier"] = tier
+            batch_info["stream_bytes"] = int(
+                plane_stages.get("stream_bytes", 0))
         delta_ms = plane_stages.get("delta_ms")
         if delta_ms is not None:
             # this dispatch merged the base plane with a live delta tier:

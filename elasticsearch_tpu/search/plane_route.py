@@ -406,6 +406,9 @@ class TextServingGeneration(_ServingGeneration):
                       with_totals: bool = False,
                       stages: Optional[dict] = None,
                       prune: Optional[bool] = None):
+        # tier bookkeeping BEFORE the dispatch (outside every lock):
+        # recency for the budget sweep, warm-hit hysteresis → promotion
+        self._cache.tiers.note_dispatch(self)
         if delta is None:
             return self.base.serve(queries, k=k, with_totals=with_totals,
                                    stages=stages, prune=prune)
@@ -524,6 +527,7 @@ class KnnServingGeneration(_ServingGeneration):
                       stages: Optional[dict] = None,
                       nprobe: Optional[int] = None,
                       rerank: Optional[int] = None):
+        self._cache.tiers.note_dispatch(self)
         # the base dispatch may be cluster-pruned (IVF tier at the
         # resolved nprobe/rerank); the DELTA tier always scores exact
         # brute-force — appended segments are small, and exactness there
@@ -654,9 +658,18 @@ class ServingPlaneCache:
         # their swap costs must be distinguishable
         self._swap_ms: Dict[str, _tm.Histogram] = {
             "text": _tm.Histogram(), "knn": _tm.Histogram()}
+        #: device ids that ever reported plane bytes — the gauge emits
+        #: explicit 0 samples for them once their planes demote/release
+        #: (a vanished sample reads as "last value" to most scrapers:
+        #: the PR 15 es_batcher_queue_depth stale-gauge class)
+        self._hbm_devices: set = set()
         _tm.DEFAULT.register_object_collector(
             f"plane_cache_{id(self):x}", self,
             ServingPlaneCache._metrics_doc)
+        #: storage-tier policy (hot/warm/cold budgets + demand
+        #: promotion); budgets default to 0 = unlimited, every plane hot
+        from .plane_tiers import PlaneTierManager
+        self.tiers = PlaneTierManager(self)
 
     # -- telemetry -----------------------------------------------------------
 
@@ -675,6 +688,9 @@ class ServingPlaneCache:
         for gen in self.generations():
             base = gen.__dict__.get("base", gen)
             try:
+                # warm/cold planes hold no HBM: device_corpus_bytes()
+                # reports 0 once demoted, so the gauge decrements on
+                # every demotion without tier-specific cases here
                 share = int(base.device_corpus_bytes())
                 devices = list(base.mesh.devices.flat)
             except Exception:   # noqa: BLE001 — foreign/legacy planes
@@ -682,6 +698,11 @@ class ServingPlaneCache:
             for d in devices:
                 did = int(getattr(d, "id", 0))
                 per_dev[did] = per_dev.get(did, 0) + share
+        # devices whose planes all demoted/released still emit explicit
+        # 0 samples (under _metric_lock: scrapes race each other)
+        with self._metric_lock:
+            self._hbm_devices |= set(per_dev)
+            hbm_devices = sorted(self._hbm_devices)
         return {
             "es_plane_rebuild_total": {
                 "type": "counter",
@@ -701,8 +722,8 @@ class ServingPlaneCache:
                 "help": "packed serving-plane bytes resident per device "
                         "(estimate; shard-sharded corpus / replica "
                         "copies)",
-                "samples": [({"device": str(did)}, b)
-                            for did, b in sorted(per_dev.items())]},
+                "samples": [({"device": str(did)}, per_dev.get(did, 0))
+                            for did in hbm_devices]},
         }
 
     def _record_rebuild(self, kind: str, trigger: str, mode: str) -> None:
@@ -840,10 +861,14 @@ class ServingPlaneCache:
     def _release_gen(self, gen) -> None:
         """Release a generation's (or bare plane's) breaker reservation
         and retire its batcher — plus any fused-plan runner built over
-        it (a stale runner would pin the superseded corpus)."""
+        it (a stale runner would pin the superseded corpus). Both tier
+        ledgers drain: a hot generation holds ``accounting`` (device)
+        bytes, a warm one ``host_tier`` bytes."""
         from ..common.breakers import DEFAULT as _breakers
         acct = _breakers.breaker("accounting")
         acct.release(getattr(gen, "_acct_bytes", 0))
+        _breakers.breaker("host_tier").release(
+            getattr(gen, "_host_acct_bytes", 0))
         self._retire(gen)
         with self._gen_lock:
             doomed = [k for k, r in self._fused_runners.items()
@@ -1155,6 +1180,10 @@ class ServingPlaneCache:
             # swap; drop its reservation and stop its warmup now
             self._release_gen(old)
         self._record_rebuild("text", trigger, mode)
+        # tier sweep OUTSIDE _gen_lock: the new resident plane may push
+        # the node past its HBM budget — spill the LRU ones
+        self.tiers.touch(gen)
+        self.tiers.enforce_budget()
         return gen
 
     def plane_for(self, segments: Sequence[Segment], mapper: MapperService,
@@ -1207,6 +1236,13 @@ class ServingPlaneCache:
                 return None
         if not allow_sync_build:
             return None
+        # the cold TIER beats a cold PACK: a demoted pack file matching
+        # this list promotes through the handoff import (chunked local
+        # read + device upload — no O(postings) re-pack)
+        promoted = self._promote_from_cold("text", field, segments,
+                                           mapper)
+        if promoted is not None:
+            return promoted
         # cold start (first build for this field) or legacy mode
         # (delta_enabled=False: rebuild-every-refresh, the pre-generation
         # behavior the live-indexing bench measures as its baseline)
@@ -1288,6 +1324,12 @@ class ServingPlaneCache:
             # legacy mode: fall through to a full rebuild
         if not allow_build:
             return None
+        # cold-tier probe before any build-vs-thrash reasoning: a
+        # spilled plane of this exact base is this probe's own data
+        promoted = self._promote_from_cold("knn", field, segments,
+                                           mapper)
+        if promoted is not None:
+            return promoted
         with self._gen_lock:
             # read under the lock: the streak is reset/bumped under it,
             # and an off-lock read races the repack thread (ESTP-R01)
@@ -1438,6 +1480,8 @@ class ServingPlaneCache:
             self._release_gen(g)
         self._attach_batcher(gen, knn=True)
         self._record_rebuild("knn", trigger, mode)
+        self.tiers.touch(gen)
+        self.tiers.enforce_budget()
         return gen
 
     # -- warm handoff: plane-bundle export / import --------------------------
@@ -1453,6 +1497,23 @@ class ServingPlaneCache:
     # signature). Serialization is the data-only wire codec
     # (common/datacodec): tensors in, tensors out, nothing executable.
 
+    def _bundle_for(self, gen) -> Optional[dict]:
+        """One generation → its self-contained handoff bundle (also the
+        cold-tier pack-file payload), or None for a foreign/legacy plane
+        that cannot export. ``export_packed`` is warm-safe: a demoted
+        plane serializes from its host copies without re-upload."""
+        try:
+            packed = gen.base.export_packed()
+        except Exception:   # noqa: BLE001 — foreign/legacy plane
+            return None
+        doc = {"kind": gen.kind, "field": gen.field,
+               "signature": [(s.seg_id, int(s.n_docs))
+                             for s in gen.base_segments],
+               "packed": packed}
+        if gen.kind == "text":
+            doc["avgdl"] = float(gen.avgdl)
+        return doc
+
     def export_bundles(self) -> List[dict]:
         """One handoff bundle per live serving generation, carrying the
         plane's POST-pack tensors (``export_packed``: sorted-merge
@@ -1460,32 +1521,84 @@ class ServingPlaneCache:
         frozen invariants (avgdl) and the base segment signature — the
         importer reconstructs bit-identical serving with zero pack
         work."""
-        with self._gen_lock:
-            text_items = list(self._planes.values())
-            knn_items = list(self._knn_planes.values())
         out: List[dict] = []
-        for gen in text_items:
-            try:
-                packed = gen.base.export_packed()
-            except Exception:   # noqa: BLE001 — foreign/legacy plane
-                continue
-            out.append({
-                "kind": "text", "field": gen.field,
-                "avgdl": float(gen.avgdl),
-                "signature": [(s.seg_id, int(s.n_docs))
-                              for s in gen.base_segments],
-                "packed": packed})
-        for gen in knn_items:
-            try:
-                packed = gen.base.export_packed()
-            except Exception:   # noqa: BLE001
-                continue
-            out.append({
-                "kind": "knn", "field": gen.field,
-                "signature": [(s.seg_id, int(s.n_docs))
-                              for s in gen.base_segments],
-                "packed": packed})
+        for gen in self.generations():
+            bundle = self._bundle_for(gen)
+            if bundle is not None:
+                out.append(bundle)
         return out
+
+    def export_bundle_blobs(self) -> List[dict]:
+        """Pre-serialized handoff payloads (``{kind, field, blob}``):
+        live generations serialize now; COLD-tier planes ship their
+        pack file's text as-is — a spilled plane is its own handoff
+        artifact, no re-serialization on the donor offer."""
+        from ..common.datacodec import dumps_b64
+        out: List[dict] = []
+        for bundle in self.export_bundles():
+            out.append({"kind": bundle["kind"], "field": bundle["field"],
+                        "blob": dumps_b64(bundle)})
+        for rec in self.tiers.cold_records():
+            try:
+                out.append({"kind": rec.kind, "field": rec.field,
+                            "blob": self.tiers.cold_blob(rec)})
+            except Exception:   # noqa: BLE001 — spill file vanished
+                continue
+        return out
+
+    def _evict_generation(self, gen) -> bool:
+        """Remove ONE generation from the serving registry (cold
+        demotion): registry pop under ``_gen_lock``, breaker release +
+        batcher retire outside it. False → the generation was no longer
+        registered (a racing swap/release already owns its teardown)."""
+        found = False
+        with self._gen_lock:
+            racedep.note_write("plane_cache.generations", self)
+            field = getattr(gen, "field", None)
+            if self._planes.get(field) is gen:
+                self._planes.pop(field)
+                found = True
+            else:
+                for k, g in list(self._knn_planes.items()):
+                    if g is gen:
+                        self._knn_planes.pop(k)
+                        found = True
+                        break
+        if not found:
+            return False
+        self._release_gen(gen)
+        return True
+
+    def _promote_from_cold(self, kind: str, field: str,
+                           segments: Sequence[Segment],
+                           mapper: MapperService):
+        """Probe the cold tier before a cold pack: a spilled plane whose
+        base signature still matches the local segment list promotes
+        through the SAME import path warm handoff uses (chunked mmap
+        read of the pack file → ``import_bundle``) — device upload only,
+        no re-pack. Returns the installed generation or None."""
+        for rec in self.tiers.cold_records(kind, field):
+            if self._match_signature(segments, rec.signature) is None:
+                continue
+            try:
+                bundle = self.tiers.cold_bundle(rec)
+            except Exception:   # noqa: BLE001 — unreadable pack file:
+                continue        # fall back to the ordinary cold build
+            if not self.import_bundle(bundle, segments, mapper):
+                continue
+            sig = [(str(a), int(b)) for a, b in rec.signature]
+            with self._gen_lock:
+                if kind == "text":
+                    gen = self._planes.get(field)
+                else:
+                    gen = next(
+                        (g for (f, _k), g in self._knn_planes.items()
+                         if f == field and [(s.seg_id, int(s.n_docs))
+                                            for s in g.base_segments]
+                         == sig), None)
+            self.tiers.on_cold_promoted(rec, gen)
+            return gen
+        return None
 
     def _match_signature(self, segments: Sequence[Segment],
                          signature) -> Optional[List[Segment]]:
@@ -1633,3 +1746,6 @@ class ServingPlaneCache:
         for gen in gens:
             self._release_gen(gen)
         self.drain_repacks(timeout=5.0)
+        # drop the cold tier's pack files; the next _metrics_doc scrape
+        # reports explicit per-device zeros (every generation is gone)
+        self.tiers.release()

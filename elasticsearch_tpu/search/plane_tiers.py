@@ -1,0 +1,579 @@
+"""Tiered plane storage: hot (HBM) / warm (host) / cold (pack file).
+
+Serving planes were wholly device-resident, so corpus capacity per node
+equaled HBM — the one hard wall between this engine and the reference's
+frozen-tier / searchable-snapshots story. This module adds the missing
+two tiers and the demand-promotion policy between them:
+
+- **hot** — device-resident, today's path (``DistributedSearchPlane`` /
+  ``DistributedKnnPlane`` arrays live in HBM; dispatches touch no host
+  memory).
+- **warm** — host-resident: the plane's packed corpus stays in numpy on
+  the host and every dispatch streams it to the device fresh
+  (``plane._corpus_refs``); the roofline auditor judges those dispatches
+  against the host→device link (``*_streamed`` kernel families), not HBM
+  bandwidth. Warm bytes are accounted against the ``host_tier`` breaker
+  ledger, NOT the device-side ``accounting`` ledger.
+- **cold** — an mmap'd pack file holding ``dumps_b64`` of the plane's
+  warm-handoff bundle (``export_packed`` + frozen invariants +
+  signature). Demotion is serialize-once + free; promotion is a chunked
+  local read through the SAME resumable import path the warm handoff
+  uses (``ServingPlaneCache.import_bundle``), and the file text IS the
+  handoff blob — a donor offer ships it without re-serializing.
+
+:class:`PlaneTierManager` owns the policy: per-generation access
+recency/frequency (``note_dispatch`` from the serving merge, outside
+every cache lock), a per-device HBM budget
+(``ES_TPU_PLANE_HBM_BUDGET_BYTES``) enforced by LRU demotion, a host
+budget (``ES_TPU_PLANE_HOST_BUDGET_BYTES``) that spills warm → cold, and
+hit-count hysteresis (``ES_TPU_PLANE_TIER_PROMOTE_HITS``) before a warm
+plane earns its HBM back. Every transition journals a ``plane_tier``
+flight-recorder event — the tier history of any plane is reconstructable
+from the journal alone — and bumps the
+``es_plane_tier_{promotions,demotions}_total`` counters; resident bytes
+per tier surface as the ``es_plane_tier_bytes{tier=...}`` gauge.
+
+Budgets default to 0 (unlimited): a node that never opts in serves
+exactly as before, every plane hot.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional
+
+__all__ = ["ColdPackStore", "PlaneTierRecord", "PlaneTierManager"]
+
+#: bytes per mmap read while reassembling a cold pack file — same order
+#: as the warm-handoff chunk size (cluster_node.PLANE_CHUNK_BYTES), so
+#: promotion exercises the same resumable chunked-read shape
+COLD_READ_CHUNK = 4 << 20
+
+
+class PlaneTierRecord:
+    """One cold-tier plane: pack-file path + the routing metadata needed
+    to match it against a segment list WITHOUT reading the file."""
+
+    __slots__ = ("kind", "field", "signature", "path", "nbytes", "ts")
+
+    def __init__(self, kind: str, field: str, signature, path: str,
+                 nbytes: int):
+        self.kind = kind
+        self.field = field
+        #: [(seg_id, n_docs), ...] of the bundle's base segment list
+        self.signature = [(str(a), int(b)) for a, b in signature]
+        self.path = path
+        self.nbytes = nbytes
+        self.ts = time.monotonic()
+
+
+class ColdPackStore:
+    """Directory of cold pack files. A pack file is the ascii
+    ``datacodec.dumps_b64`` text of one warm-handoff bundle dict
+    (``{"kind", "field", "avgdl", "signature", "packed"}``) — wire-exact
+    with what ``export_bundles`` ships, so the file doubles as the
+    recovery/handoff artifact and :meth:`read_blob` serves a donor offer
+    with zero re-serialization."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or os.environ.get("ES_TPU_PLANE_SPILL_DIR") or \
+            os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                         f"es_tpu_plane_spill_{os.getpid()}")
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def _next_path(self, kind: str, field: str) -> str:
+        os.makedirs(self.root, exist_ok=True)
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in field)[:48]
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        return os.path.join(self.root, f"{kind}_{safe}_{seq:06d}.espack")
+
+    def put(self, bundle: dict) -> PlaneTierRecord:
+        """Serialize one handoff bundle to a pack file (atomic: tmp +
+        rename) and return its record."""
+        from ..common.datacodec import dumps_b64
+        blob = dumps_b64(bundle)
+        path = self._next_path(str(bundle["kind"]), str(bundle["field"]))
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="ascii") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        return PlaneTierRecord(str(bundle["kind"]), str(bundle["field"]),
+                               bundle.get("signature") or (), path,
+                               len(blob))
+
+    def read_blob(self, record: PlaneTierRecord) -> str:
+        """The pack file's serialized text, chunk-read through an mmap —
+        exactly the blob a warm-handoff donor would ship (no
+        re-serialization on donor offer)."""
+        import mmap
+        with open(record.path, "rb") as f:
+            size = os.fstat(f.fileno()).st_size
+            if size == 0:
+                return ""
+            with mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as mm:
+                parts = [mm[i: i + COLD_READ_CHUNK]
+                         for i in range(0, size, COLD_READ_CHUNK)]
+        return b"".join(parts).decode("ascii")
+
+    def load(self, record: PlaneTierRecord) -> dict:
+        """Pack file → bundle dict (the promotion read path)."""
+        from ..common.datacodec import loads_b64
+        return loads_b64(self.read_blob(record))
+
+    def remove(self, record: PlaneTierRecord) -> None:
+        try:
+            os.unlink(record.path)
+        except OSError:
+            pass
+
+    def drop_all(self, records) -> None:
+        for r in records:
+            self.remove(r)
+
+
+class PlaneTierManager:
+    """Per-cache tier policy: access bookkeeping, budget enforcement,
+    promote/demote execution, and the tier telemetry/journal surfaces.
+
+    Locking: ``_lock`` is a LEAF lock guarding only the manager's own
+    bookkeeping (access stats, cold records, in-flight markers). Tier
+    transitions call back into the cache (registry eviction under
+    ``_gen_lock``, breaker moves, plane array shuffles) and journal to
+    the flight recorder — all of that runs OUTSIDE ``_lock`` (ESTP-L02:
+    no telemetry under a serving lock; ESTP-R01: no nested
+    manager-inside-cache lock order)."""
+
+    #: warm dispatches before a plane earns promotion back to HBM
+    PROMOTE_HITS = int(os.environ.get(
+        "ES_TPU_PLANE_TIER_PROMOTE_HITS", "2"))
+    #: seconds a freshly installed/promoted plane is immune to demotion
+    #: (anti-thrash: the budget sweep must not evict what the current
+    #: request just paid to promote)
+    MIN_RESIDENCY_S = float(os.environ.get(
+        "ES_TPU_PLANE_TIER_MIN_RESIDENCY_S", "0.0"))
+
+    def __init__(self, cache):
+        self._cache_ref = weakref.ref(cache)
+        self.hbm_budget = int(os.environ.get(
+            "ES_TPU_PLANE_HBM_BUDGET_BYTES", "0") or 0)
+        self.host_budget = int(os.environ.get(
+            "ES_TPU_PLANE_HOST_BUDGET_BYTES", "0") or 0)
+        self.promote_hits = self.PROMOTE_HITS
+        self.min_residency_s = self.MIN_RESIDENCY_S
+        self.cold_store = ColdPackStore()
+        self._lock = threading.Lock()
+        #: gen -> [warm_hit_count, last_access_monotonic]
+        self._access: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
+        #: generations with an in-flight background promotion
+        self._promoting: set = set()
+        self._cold: List[PlaneTierRecord] = []
+        self.promotions = 0
+        self.demotions = 0
+        from ..common import telemetry as _tm
+        _tm.DEFAULT.register_object_collector(
+            f"plane_tiers_{id(self):x}", self,
+            PlaneTierManager._metrics_doc)
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _base(gen):
+        return gen.__dict__.get("base", gen) \
+            if hasattr(gen, "__dict__") else gen
+
+    @staticmethod
+    def _tier(gen) -> str:
+        return getattr(PlaneTierManager._base(gen), "storage_tier", "hot")
+
+    def _cache(self):
+        return self._cache_ref()
+
+    def enabled(self) -> bool:
+        return self.hbm_budget > 0 or self.host_budget > 0
+
+    def _last_access(self, gen) -> float:
+        with self._lock:
+            st = self._access.get(gen)
+        return st[1] if st is not None else 0.0
+
+    # -- telemetry -----------------------------------------------------------
+
+    def _metrics_doc(self):
+        hot = warm = 0
+        cache = self._cache()
+        if cache is not None:
+            for gen in cache.generations():
+                base = self._base(gen)
+                try:
+                    if getattr(base, "storage_tier", "hot") == "hot":
+                        hot += int(base.device_corpus_bytes())
+                    else:
+                        warm += int(base.host_tier_bytes())
+                except Exception:   # noqa: BLE001 — foreign planes
+                    continue
+        with self._lock:
+            cold = sum(r.nbytes for r in self._cold)
+        return {
+            "es_plane_tier_bytes": {
+                "type": "gauge",
+                "help": "serving-plane bytes resident per storage tier "
+                        "(hot: per-device HBM share; warm: host copies; "
+                        "cold: pack-file bytes)",
+                "samples": [({"tier": "hot"}, hot),
+                            ({"tier": "warm"}, warm),
+                            ({"tier": "cold"}, cold)],
+            },
+        }
+
+    def _journal(self, op: str, gen_or_rec, from_tier: str, to_tier: str,
+                 nbytes: int, reason: str) -> None:
+        """One transition: flight-recorder event + telemetry counters —
+        called outside every lock. The event carries (kind, field,
+        from/to, bytes, reason): the tier history of any plane is
+        reconstructable from the journal alone."""
+        if isinstance(gen_or_rec, PlaneTierRecord):
+            kind, field = gen_or_rec.kind, gen_or_rec.field
+        else:
+            kind = getattr(gen_or_rec, "kind", "plane")
+            field = getattr(gen_or_rec, "field", "?")
+        from ..common import flightrec as _fr
+        from ..common import telemetry as _tm
+        _fr.record("plane_tier", op=op, kind=kind, field=field,
+                   from_tier=from_tier, to_tier=to_tier,
+                   bytes=int(nbytes), reason=reason)
+        _tm.record_tier_transition(op, to_tier)
+        with self._lock:
+            if op == "promote":
+                self.promotions += 1
+            else:
+                self.demotions += 1
+
+    def stats(self) -> dict:
+        """Rollup for benches/tests."""
+        cache = self._cache()
+        hot_b = warm_b = n_hot = n_warm = 0
+        for gen in (cache.generations() if cache is not None else ()):
+            base = self._base(gen)
+            try:
+                if getattr(base, "storage_tier", "hot") == "hot":
+                    n_hot += 1
+                    hot_b += int(base.device_corpus_bytes())
+                else:
+                    n_warm += 1
+                    warm_b += int(base.host_tier_bytes())
+            except Exception:   # noqa: BLE001
+                continue
+        with self._lock:
+            return {"promotions": self.promotions,
+                    "demotions": self.demotions,
+                    "hot_planes": n_hot, "warm_planes": n_warm,
+                    "cold_planes": len(self._cold),
+                    "hot_bytes": hot_b, "warm_bytes": warm_b,
+                    "cold_bytes": sum(r.nbytes for r in self._cold)}
+
+    # -- access bookkeeping (serving hot path) -------------------------------
+
+    def note_dispatch(self, gen) -> None:
+        """Serving-merge hook (outside every cache lock): refresh the
+        generation's recency, and after ``promote_hits`` consecutive
+        warm dispatches schedule its promotion OFF the request thread
+        (``repack_mode == "sync"`` runs it inline for deterministic
+        tests, same convention as the repack scheduler)."""
+        if not self.enabled():
+            return
+        tier = self._tier(gen)
+        promote = False
+        with self._lock:
+            st = self._access.get(gen)
+            if st is None:
+                st = self._access[gen] = [0, 0.0]
+            st[1] = time.monotonic()
+            if tier == "warm":
+                st[0] += 1
+                if st[0] >= self.promote_hits \
+                        and id(gen) not in self._promoting:
+                    self._promoting.add(id(gen))
+                    promote = True
+            else:
+                st[0] = 0
+        if not promote:
+            return
+        cache = self._cache()
+        if cache is not None and cache.repack_mode == "sync":
+            self._promote(gen)
+            return
+        threading.Thread(target=self._promote, args=(gen,), daemon=True,
+                         name="plane-tier-promote").start()
+
+    def touch(self, gen) -> None:
+        """Mark a generation as just-accessed (install/import paths) so
+        the budget sweep sees it as MRU, not never-used."""
+        with self._lock:
+            st = self._access.get(gen)
+            if st is None:
+                st = self._access[gen] = [0, 0.0]
+            st[1] = time.monotonic()
+
+    # -- transitions ---------------------------------------------------------
+
+    def _hot_share(self, gen) -> int:
+        """The per-device HBM bytes this generation holds (hot) or would
+        re-claim on promotion (warm — snapshotted at demote time)."""
+        base = self._base(gen)
+        if getattr(base, "storage_tier", "hot") == "hot":
+            try:
+                return int(base.device_corpus_bytes())
+            except Exception:   # noqa: BLE001
+                return 0
+        return int(getattr(base, "_tier_dev_bytes", 0))
+
+    def demote_to_warm(self, gen, reason: str = "hbm_budget") -> bool:
+        """Hot → warm: pull the corpus to host, free the device arrays,
+        and MOVE the breaker estimate from the device-side ``accounting``
+        ledger to ``host_tier``. A host-ledger trip means the node has no
+        room for another warm plane either — the demotion continues
+        straight to cold instead."""
+        from ..common.breakers import DEFAULT as _breakers
+        from ..common.errors import CircuitBreakingError
+        base = self._base(gen)
+        if getattr(base, "storage_tier", "hot") != "hot":
+            return False
+        dev_share = self._hot_share(gen)
+        acct_bytes = int(getattr(base, "_acct_bytes", 0))
+        try:
+            host_bytes = int(base.demote_to_warm())
+        except Exception:   # noqa: BLE001 — foreign/legacy plane
+            return False
+        base._tier_dev_bytes = dev_share
+        base._hot_acct_bytes = acct_bytes
+        host = _breakers.breaker("host_tier")
+        field = getattr(gen, "field", "?")
+        try:
+            host.add_estimate(
+                host_bytes, f"<warm plane tier [{field}], "
+                            f"{host_bytes} B host>")
+        except CircuitBreakingError:
+            # no host headroom: release the device ledger (the HBM is
+            # already freed) and spill the rest of the way to cold
+            _breakers.breaker("accounting").release(acct_bytes)
+            base._acct_bytes = 0
+            base._host_acct_bytes = 0
+            self._journal("demote", gen, "hot", "warm", host_bytes,
+                          reason)
+            self.demote_to_cold(gen, reason="host_breaker")
+            return True
+        _breakers.breaker("accounting").release(acct_bytes)
+        base._acct_bytes = 0
+        base._host_acct_bytes = host_bytes
+        self._journal("demote", gen, "hot", "warm", host_bytes, reason)
+        return True
+
+    def demote_to_cold(self, gen, reason: str = "host_budget") -> bool:
+        """Warm (or hot) → cold: serialize the generation's handoff
+        bundle ONCE into a pack file, drop it from the serving registry,
+        and release every breaker reservation. The next signature-
+        matching probe promotes it back through ``import_bundle`` — the
+        same path warm handoff uses."""
+        cache = self._cache()
+        if cache is None:
+            return False
+        from_tier = self._tier(gen)
+        bundle = cache._bundle_for(gen)
+        if bundle is None:
+            return False
+        try:
+            with self._lock:
+                record = self.cold_store.put(bundle)
+        except Exception:   # noqa: BLE001 — spill dir unwritable: the
+            return False    # plane simply stays resident
+        if not cache._evict_generation(gen):
+            # lost a race with a repack swap/release: the generation is
+            # no longer registered — don't keep a cold copy of it either
+            with self._lock:
+                self.cold_store.remove(record)
+            return False
+        with self._lock:
+            self._cold.append(record)
+        self._journal("demote", gen, from_tier, "cold", record.nbytes,
+                      reason)
+        return True
+
+    def _promote(self, gen) -> None:
+        """Warm → hot (background): re-reserve the device-side
+        ``accounting`` estimate (a trip leaves the plane warm — streamed
+        serving still works), make HBM headroom by demoting colder
+        planes, then re-upload."""
+        from ..common.breakers import DEFAULT as _breakers
+        from ..common.errors import CircuitBreakingError
+        try:
+            base = self._base(gen)
+            if getattr(base, "storage_tier", "hot") != "warm":
+                return
+            need = int(getattr(base, "_tier_dev_bytes", 0))
+            self._make_hot_room(need, keep=gen)
+            cache = self._cache()
+            if cache is None:
+                return
+            # anti-thrash: if the sweep could NOT make room (residency-
+            # protected hot planes — the actively-serving head), the
+            # promotion aborts and the plane keeps serving warm rather
+            # than evicting a hotter plane into a demote/promote loop.
+            # When nothing else is hot the budget is moot (serving
+            # floor): the working plane always gets HBM.
+            still_hot = sum(
+                self._hot_share(g) for g in cache.generations()
+                if self._tier(g) == "hot" and g is not gen)
+            if self.hbm_budget > 0 and still_hot > 0 \
+                    and still_hot + need > self.hbm_budget:
+                return
+            acct_bytes = int(getattr(base, "_hot_acct_bytes", 0))
+            acct = _breakers.breaker("accounting")
+            try:
+                field = getattr(gen, "field", "?")
+                acct.add_estimate(
+                    acct_bytes, f"<plane tier promote [{field}], "
+                                f"{acct_bytes} B>")
+            except CircuitBreakingError:
+                return          # stays warm; hysteresis retries later
+            try:
+                host_bytes = int(base.promote_to_hot())
+            except Exception:   # noqa: BLE001
+                acct.release(acct_bytes)
+                return
+            base._acct_bytes = acct_bytes
+            _breakers.breaker("host_tier").release(
+                int(getattr(base, "_host_acct_bytes", host_bytes)))
+            base._host_acct_bytes = 0
+            self._journal("promote", gen, "warm", "hot",
+                          int(getattr(base, "_tier_dev_bytes", 0)),
+                          "access")
+        finally:
+            with self._lock:
+                self._promoting.discard(id(gen))
+                st = self._access.get(gen)
+                if st is not None:
+                    st[0] = 0
+                    st[1] = time.monotonic()
+
+    def on_cold_promoted(self, record: PlaneTierRecord, gen) -> None:
+        """Bookkeeping after ``import_bundle`` installed a cold bundle
+        as a live (hot) generation: drop the pack file and journal the
+        promotion."""
+        with self._lock:
+            try:
+                self._cold.remove(record)
+            except ValueError:
+                pass
+            self.cold_store.remove(record)
+        self._journal("promote", record, "cold", "hot", record.nbytes,
+                      "access")
+        if gen is not None:
+            self.touch(gen)
+
+    # -- cold lookup ---------------------------------------------------------
+
+    def cold_blob(self, record: PlaneTierRecord) -> str:
+        """Pack-file text for a donor offer (locked accessor — the
+        store's record set is shared with the budget sweeps)."""
+        with self._lock:
+            return self.cold_store.read_blob(record)
+
+    def cold_bundle(self, record: PlaneTierRecord) -> dict:
+        """Deserialized bundle for the promotion path (locked
+        accessor)."""
+        with self._lock:
+            return self.cold_store.load(record)
+
+    def cold_records(self, kind: Optional[str] = None,
+                     field: Optional[str] = None
+                     ) -> List[PlaneTierRecord]:
+        with self._lock:
+            return [r for r in self._cold
+                    if (kind is None or r.kind == kind)
+                    and (field is None or r.field == field)]
+
+    # -- budget enforcement --------------------------------------------------
+
+    def _lru_order(self, gens) -> list:
+        return sorted(gens, key=self._last_access)
+
+    def _make_hot_room(self, need: int, keep=None) -> None:
+        """Demote LRU hot generations until ``need`` extra per-device
+        bytes fit under the HBM budget (no-op when unlimited)."""
+        if self.hbm_budget <= 0:
+            return
+        cache = self._cache()
+        if cache is None:
+            return
+        now = time.monotonic()
+        hot = [g for g in cache.generations()
+               if self._tier(g) == "hot" and g is not keep]
+        used = sum(self._hot_share(g) for g in hot) + \
+            (self._hot_share(keep) if keep is not None
+             and self._tier(keep) == "hot" else 0)
+        order = self._lru_order(hot)
+        if keep is None and order:
+            # serving floor: the MRU generation stays resident even when
+            # the budget is smaller than one plane — demoting the plane
+            # the current request just installed/used would churn every
+            # probe into a demote→re-import loop
+            order = order[:-1]
+        for g in order:
+            if used + need <= self.hbm_budget:
+                return
+            if now - self._last_access(g) < self.min_residency_s:
+                continue
+            share = self._hot_share(g)
+            if self.demote_to_warm(g):
+                used -= share
+
+    def enforce_budget(self) -> None:
+        """Post-install / post-promotion sweep: spill LRU hot planes to
+        warm past the HBM budget, then LRU warm planes to cold past the
+        host budget. Safe to call from any thread, outside every cache
+        lock."""
+        if not self.enabled():
+            return
+        cache = self._cache()
+        if cache is None:
+            return
+        self._make_hot_room(0)
+        if self.host_budget <= 0:
+            return
+        warm = [g for g in cache.generations()
+                if self._tier(g) == "warm"]
+        used = 0
+        for g in warm:
+            try:
+                used += int(self._base(g).host_tier_bytes())
+            except Exception:   # noqa: BLE001
+                continue
+        # same MRU serving floor as the hot sweep: an actively-serving
+        # warm plane must not cold-spill out from under its own requests
+        for g in self._lru_order(warm)[:-1]:
+            if used <= self.host_budget:
+                return
+            try:
+                share = int(self._base(g).host_tier_bytes())
+            except Exception:   # noqa: BLE001
+                continue
+            if self.demote_to_cold(g):
+                used -= share
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def release(self) -> None:
+        """Owning cache is closing: drop every cold pack file (the
+        records are meaningless once the registry is gone — recovery
+        re-imports from a donor, not from a dead node's spill dir)."""
+        with self._lock:
+            records, self._cold = self._cold, []
+            self.cold_store.drop_all(records)

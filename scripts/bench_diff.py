@@ -188,6 +188,49 @@ CHAOS_P99_RISE_MAX = 3.0
 #: p99 stall, journal reconstruction) are unaffected
 CHAOS_QPS_DROP_MAX = 0.25
 
+#: tiered_capacity acceptance: the hot-set (Zipf head) p99 under the
+#: 10x over-subscribed tier budget must stay within this ratio of the
+#: device-resident baseline measured in the SAME run; the absolute
+#: floor keeps sub-millisecond jitter from flaking the ratio
+HOT_P99_RATIO_MAX = 1.25
+HOT_P99_FLOOR_MS = 2.0
+
+#: promotion-count drift between rounds: tier transitions under the
+#: seeded Zipf mix are near-deterministic — the count doubling (plus
+#: slack) means the hysteresis/anti-thrash policy regressed into a
+#: demote/promote loop even if qps held
+PROMOTION_DRIFT_FACTOR = 2.0
+PROMOTION_DRIFT_SLACK = 10
+
+
+def _tier_check(new: dict):
+    """Intra-file gates on the NEW side's ``tiered_capacity`` evidence
+    (judged against the run's own device-resident baseline, so they
+    apply even on the first round with no old side)."""
+    out = []
+    for name, cfg in (new.get("configs") or {}).items():
+        if not isinstance(cfg, dict) or "hot_p99_ratio" not in cfg:
+            continue
+        ratio = cfg.get("hot_p99_ratio")
+        hot, dev = cfg.get("hot_p99_ms", 0), cfg.get("device_p99_ms", 0)
+        if isinstance(ratio, (int, float)) and \
+                ratio > HOT_P99_RATIO_MAX and \
+                float(hot) - float(dev) > HOT_P99_FLOOR_MS:
+            out.append(f"configs.{name}: hot-set p99 {hot} ms is "
+                       f"{ratio}x the device-resident baseline "
+                       f"({dev} ms) — past the {HOT_P99_RATIO_MAX}x "
+                       f"acceptance gate")
+        if cfg.get("steady_state_rebuilds"):
+            out.append(f"configs.{name}: steady_state_rebuilds="
+                       f"{cfg['steady_state_rebuilds']} — tier "
+                       f"promotions re-packed planes instead of riding "
+                       f"the handoff-import path")
+        if "journal_consistent" in cfg and not cfg["journal_consistent"]:
+            out.append(f"configs.{name}: tier transitions are NOT "
+                       f"reconstructable from the flight-recorder "
+                       f"journal (journal_consistent=false)")
+    return out
+
 
 def _journal_check(new: dict):
     """Intra-file gates on the NEW side's flight-recorder evidence.
@@ -262,6 +305,22 @@ def diff(old: dict, new: dict, threshold: float,
                 ln += "  << TIME-TO-WARM REGRESSION"
                 regressions.append(
                     f"{name} (time_to_warm_s {ow:.3f} -> {nw:.3f})")
+            lines.append(ln)
+        # tier promotion-count drift (tiered_capacity): the seeded Zipf
+        # mix makes transition counts near-deterministic, so a jump is
+        # the anti-thrash policy degrading into churn
+        op_ = o.get("promotions") if isinstance(o, dict) else None
+        np_ = n.get("promotions") if isinstance(n, dict) else None
+        if isinstance(op_, (int, float)) and \
+                isinstance(np_, (int, float)) and \
+                isinstance((o or {}).get("hot_p99_ratio"),
+                           (int, float)):
+            ln = f"  {name:40s} promotions {int(op_)} -> {int(np_)}"
+            if np_ > op_ * PROMOTION_DRIFT_FACTOR + PROMOTION_DRIFT_SLACK:
+                ln += "  << PROMOTION-CHURN REGRESSION"
+                regressions.append(
+                    f"{name} (promotions {int(op_)} -> {int(np_)} — "
+                    f"tier churn)")
             lines.append(ln)
         # roofline-efficiency gate: per-kernel mean model-vs-achieved
         # efficiency embedded by bench.py's per-config audit delta
@@ -412,6 +471,12 @@ def main(argv=None) -> int:
     # steady-state zero-capture invariant) judge the NEW side's own
     # record regardless of what the old side measured
     for fail in _journal_check(new):
+        print(f"  {fail}")
+        regressions.append(fail)
+    # tiered-capacity gates judge the NEW run against its own embedded
+    # device-resident baseline (hot-set p99 ratio, zero steady-state
+    # re-packs, journal reconstructability)
+    for fail in _tier_check(new):
         print(f"  {fail}")
         regressions.append(fail)
     if regressions:

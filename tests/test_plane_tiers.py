@@ -1,0 +1,330 @@
+"""Tiered plane storage: hot (HBM) / warm (host-streamed) / cold
+(mmap'd pack file) — demotion/promotion correctness, breaker-ledger
+moves between the device and host tiers, gauge hygiene, and the cold
+pack file doubling as the warm-handoff artifact.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.breakers import DEFAULT as BREAKERS
+from elasticsearch_tpu.common.datacodec import dumps_b64, loads_b64
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.plane_route import ServingPlaneCache
+from elasticsearch_tpu.search.plane_tiers import ColdPackStore
+
+WORDS = ["quick", "brown", "fox", "red", "blue", "dog", "cat", "bird"]
+
+
+def build_segments(mapper, seed=0, n_segs=2, docs=120, dim=4):
+    rng = np.random.RandomState(seed)
+    segs = []
+    for si in range(n_segs):
+        b = SegmentBuilder(f"_{si}")
+        for i in range(docs):
+            b.add(mapper.parse_document(f"d{si}_{i}", {
+                "body": " ".join(rng.choice(WORDS, 6)),
+                "title": " ".join(rng.choice(WORDS, 3)),
+                "abstract": " ".join(rng.choice(WORDS, 4)),
+                "vec": rng.randn(dim).tolist()}), seq_no=i)
+        segs.append(b.build())
+    return segs
+
+
+@pytest.fixture()
+def mapper():
+    return MapperService({"properties": {
+        "body": {"type": "text"},
+        "title": {"type": "text"},
+        "abstract": {"type": "text"},
+        "vec": {"type": "dense_vector", "dims": 4}}})
+
+
+@pytest.fixture()
+def cache(tmp_path):
+    c = ServingPlaneCache()
+    c.repack_mode = "sync"          # deterministic inline promotions
+    c.lex_prune_min_docs = 1        # block-max tier → nonzero breaker
+    c.tiers.cold_store.root = str(tmp_path / "spill")
+    yield c
+    c.release()
+
+
+def _deep_equal(a, b):
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        a, b = np.asarray(a), np.asarray(b)
+        return a.dtype == b.dtype and a.shape == b.shape \
+            and np.array_equal(a, b)
+    if isinstance(a, dict):
+        return isinstance(b, dict) and a.keys() == b.keys() \
+            and all(_deep_equal(a[k], b[k]) for k in a)
+    if isinstance(a, (list, tuple)):
+        return isinstance(b, (list, tuple)) and len(a) == len(b) \
+            and all(_deep_equal(x, y) for x, y in zip(a, b))
+    return a == b
+
+
+# ---------------------------------------------------------------------------
+# cold pack file
+# ---------------------------------------------------------------------------
+
+def test_cold_pack_roundtrip_bit_identical(cache, mapper):
+    """export_packed bundle → pack file → mmap chunked read → loads:
+    every array in the reassembled bundle is BIT-identical to the
+    in-memory bundle (dtype, shape, values), for text and kNN."""
+    segs = build_segments(mapper)
+    assert cache.plane_for(segs, mapper, "body") is not None
+    assert cache.knn_plane_for(segs, mapper, "vec") is not None
+    for bundle in cache.export_bundles():
+        rec = cache.tiers.cold_store.put(bundle)
+        assert os.path.exists(rec.path)
+        loaded = cache.tiers.cold_store.load(rec)
+        assert _deep_equal(bundle, loaded), bundle["kind"]
+
+
+def test_cold_pack_mmap_read_chunks(tmp_path):
+    """The mmap read path reassembles multi-chunk files correctly —
+    shrink the chunk size so a small pack crosses many boundaries."""
+    from elasticsearch_tpu.search import plane_tiers as pt
+    store = ColdPackStore(str(tmp_path))
+    bundle = {"kind": "text", "field": "body", "signature": [("_0", 3)],
+              "packed": {"x": np.arange(4096, dtype=np.float32)}}
+    rec = store.put(bundle)
+    old = pt.COLD_READ_CHUNK
+    pt.COLD_READ_CHUNK = 97
+    try:
+        blob = store.read_blob(rec)
+    finally:
+        pt.COLD_READ_CHUNK = old
+    assert blob == dumps_b64(bundle)
+    assert _deep_equal(loads_b64(blob), bundle)
+
+
+def test_cold_file_is_handoff_artifact(cache, mapper):
+    """A cold-tier plane's donor offer ships the pack-file TEXT
+    verbatim (no re-serialization): export_bundle_blobs returns exactly
+    the bytes on disk, and a peer imports that blob warm."""
+    segs = build_segments(mapper)
+    gen = cache.plane_for(segs, mapper, "body")
+    expected = dumps_b64(next(b for b in cache.export_bundles()
+                              if b["kind"] == "text"))
+    assert cache.tiers.demote_to_cold(gen, reason="test")
+    (rec,) = cache.tiers.cold_records("text", "body")
+    with open(rec.path, encoding="ascii") as f:
+        assert f.read() == expected
+    blobs = [b for b in cache.export_bundle_blobs()
+             if b["kind"] == "text" and b["field"] == "body"]
+    assert [b["blob"] for b in blobs] == [expected]
+
+    peer = ServingPlaneCache()
+    try:
+        peer_segs = build_segments(mapper)
+        assert peer.import_bundle(loads_b64(blobs[0]["blob"]),
+                                  peer_segs, mapper)
+        rb = peer.rebuild_stats()
+        assert rb.get("handoff") == 1 and rb.get("cold", 0) == 0, rb
+    finally:
+        peer.release()
+
+
+# ---------------------------------------------------------------------------
+# warm tier: breaker ledger + serving parity
+# ---------------------------------------------------------------------------
+
+def test_warm_demote_promote_moves_breaker_ledger(cache, mapper):
+    """Demote-to-warm MOVES the plane's estimate from the device-side
+    ``accounting`` ledger to ``host_tier``; promotion moves it back.
+    Warm serving stays bit-identical to hot serving throughout."""
+    segs = build_segments(mapper)
+    gen = cache.plane_for(segs, mapper, "body")
+    queries = [["quick", "fox"], ["blue"]]
+    v_hot, h_hot, t_hot = gen.serve(queries, k=5, with_totals=True)
+
+    acct, host = BREAKERS.breaker("accounting"), \
+        BREAKERS.breaker("host_tier")
+    acct0, host0 = acct.used, host.used
+    assert cache.tiers.demote_to_warm(gen, reason="test")
+    assert gen.base.storage_tier == "warm"
+    assert acct.used < acct0
+    assert host.used > host0
+    v_warm, h_warm, t_warm = gen.serve(queries, k=5, with_totals=True)
+    assert h_warm == h_hot and t_warm == t_hot
+    for i in range(len(queries)):
+        assert np.array_equal(v_warm[i], v_hot[i])
+
+    cache.tiers._promote(gen)
+    assert gen.base.storage_tier == "hot"
+    assert acct.used == acct0
+    assert host.used == host0
+    v_back, h_back, _ = gen.serve(queries, k=5, with_totals=True)
+    assert h_back == h_hot
+    for i in range(len(queries)):
+        assert np.array_equal(v_back[i], v_hot[i])
+
+
+def test_hbm_gauge_decrements_on_demotion_and_zeroes_on_release(
+        cache, mapper):
+    """Satellite: es_plane_hbm_bytes must fall when a plane leaves the
+    device and report EXPLICIT zeros after release() — a stuck gauge
+    was the original bug."""
+    segs = build_segments(mapper)
+    gen = cache.plane_for(segs, mapper, "body")
+
+    def hbm_samples():
+        fam = cache._metrics_doc()["es_plane_hbm_bytes"]
+        return {labels["device"]: v for labels, v in fam["samples"]}
+
+    hot = hbm_samples()
+    assert sum(hot.values()) > 0
+    tiers0 = cache.tiers._metrics_doc()["es_plane_tier_bytes"]
+    by_tier0 = {lbl["tier"]: v for lbl, v in tiers0["samples"]}
+    assert by_tier0["hot"] > 0 and by_tier0["warm"] == 0
+
+    assert cache.tiers.demote_to_warm(gen, reason="test")
+    warm = hbm_samples()
+    assert set(warm) == set(hot)        # devices stay enumerated
+    assert sum(warm.values()) == 0
+    tiers1 = cache.tiers._metrics_doc()["es_plane_tier_bytes"]
+    by_tier1 = {lbl["tier"]: v for lbl, v in tiers1["samples"]}
+    assert by_tier1["hot"] == 0 and by_tier1["warm"] > 0
+
+    cache.release()
+    released = hbm_samples()
+    assert set(released) == set(hot)
+    assert sum(released.values()) == 0
+    assert BREAKERS.breaker("host_tier").used == 0
+
+
+# ---------------------------------------------------------------------------
+# cold promotion rides the import path
+# ---------------------------------------------------------------------------
+
+def test_promote_from_cold_uses_import_path(cache, mapper):
+    """After a cold spill, the next signature-matching probe must
+    promote through import_bundle (handoff/import counters) — NOT
+    re-pack the segments — and serve bit-identical results."""
+    segs = build_segments(mapper)
+    gen = cache.plane_for(segs, mapper, "body")
+    queries = [["quick", "fox"], ["dog", "bird"]]
+    v0, h0, t0 = gen.serve(queries, k=5, with_totals=True)
+    before = cache.rebuild_stats()
+    assert cache.tiers.demote_to_cold(gen, reason="test")
+    assert cache.generations() == []
+    assert len(cache.tiers.cold_records()) == 1
+
+    gen2 = cache.plane_for(segs, mapper, "body")
+    assert gen2 is not None
+    after = cache.rebuild_stats()
+    delta = {k: after.get(k, 0) - before.get(k, 0)
+             for k in set(after) | set(before)}
+    assert delta.get("handoff", 0) == 1 and delta.get("import", 0) == 1
+    assert delta.get("cold", 0) == 0 and delta.get("sync", 0) == 0
+    assert cache.tiers.cold_records() == []     # pack file consumed
+    v1, h1, t1 = gen2.serve(queries, k=5, with_totals=True)
+    assert h1 == h0 and t1 == t0
+    for i in range(len(queries)):
+        assert np.array_equal(v1[i], v0[i])
+    assert cache.tiers.stats()["promotions"] == 1
+
+
+def test_cold_demote_journals_reconstructable_history(cache, mapper):
+    """Every transition lands in the flight recorder as a plane_tier
+    event carrying (op, kind, field, from/to, reason) — the plane's
+    tier history must be reconstructable from the journal alone."""
+    import time
+
+    from elasticsearch_tpu.common import flightrec
+    segs = build_segments(mapper)
+    gen = cache.plane_for(segs, mapper, "body")
+    t0 = time.time() * 1000.0
+    assert cache.tiers.demote_to_warm(gen, reason="test_sweep")
+    cache.tiers._promote(gen)
+    assert cache.tiers.demote_to_cold(gen, reason="test_spill")
+    assert cache.plane_for(segs, mapper, "body") is not None
+    evs = [e["attrs"] for e in
+           flightrec.DEFAULT.events(type_="plane_tier", since_ms=t0)]
+    hist = [(a["op"], a["from_tier"], a["to_tier"]) for a in evs
+            if a["field"] == "body"]
+    assert hist == [("demote", "hot", "warm"),
+                    ("promote", "warm", "hot"),
+                    ("demote", "hot", "cold"),
+                    ("promote", "cold", "hot")]
+    assert all(a["reason"] for a in evs)
+
+
+# ---------------------------------------------------------------------------
+# budget sweeps
+# ---------------------------------------------------------------------------
+
+def test_mru_floor_single_plane_never_self_demotes(cache, mapper):
+    """A budget smaller than one plane must NOT demote the plane the
+    current request just installed (demote→re-import churn); the MRU
+    generation is the serving floor."""
+    cache.tiers.hbm_budget = 1
+    segs = build_segments(mapper)
+    gen = cache.plane_for(segs, mapper, "body")
+    assert gen is not None and gen.base.storage_tier == "hot"
+    assert cache.tiers.stats()["demotions"] == 0
+    # repeated probes stay on the SAME hot generation — no churn
+    assert cache.plane_for(segs, mapper, "body") is gen
+    assert cache.tiers.stats()["demotions"] == 0
+
+
+def test_hbm_budget_demotes_lru_and_promotes_on_hits(cache, mapper):
+    """Two fields under a one-plane budget: installing the second
+    demotes the first (LRU) to warm; promote_hits warm dispatches
+    promote it back, demoting the other — tiers flip, nothing
+    rebuilds."""
+    cache.tiers.hbm_budget = 1
+    cache.tiers.promote_hits = 2
+    segs = build_segments(mapper)
+    g_body = cache.plane_for(segs, mapper, "body")
+    g_title = cache.plane_for(segs, mapper, "title")
+    assert g_title.base.storage_tier == "hot"
+    assert g_body.base.storage_tier == "warm"
+
+    before = cache.rebuild_stats()
+    g_body.serve([["quick"]], k=3)       # warm hit 1
+    g_body.serve([["quick"]], k=3)       # warm hit 2 → inline promote
+    assert g_body.base.storage_tier == "hot"
+    assert g_title.base.storage_tier == "warm"
+    assert cache.rebuild_stats() == before      # zero rebuilds
+    st = cache.tiers.stats()
+    assert st["promotions"] >= 1 and st["demotions"] >= 2
+
+
+def test_host_budget_spills_warm_to_cold(cache, mapper):
+    """Warm planes past ES_TPU_PLANE_HOST_BUDGET_BYTES spill to the
+    cold pack tier, LRU first (the MRU warm plane is the serving floor
+    and never cold-spills out from under its own requests)."""
+    cache.tiers.hbm_budget = 1
+    cache.tiers.host_budget = 1
+    segs = build_segments(mapper)
+    cache.plane_for(segs, mapper, "body")       # → warm (LRU)
+    cache.plane_for(segs, mapper, "title")      # → warm (MRU, exempt)
+    cache.plane_for(segs, mapper, "abstract")   # stays hot (MRU floor)
+    st = cache.tiers.stats()
+    assert st["cold_planes"] == 1
+    (rec,) = cache.tiers.cold_records("text")
+    assert rec.field == "body" and os.path.exists(rec.path)
+    # the spilled field still answers — promoted back via the pack file
+    g = cache.plane_for(segs, mapper, "body")
+    assert g is not None
+    v, h = g.serve([["quick", "fox"]], k=3)
+    assert len(h[0]) > 0
+
+
+def test_release_drops_spill_files(cache, mapper):
+    """Cache release removes every cold pack file (a dead node's spill
+    dir must not leak)."""
+    segs = build_segments(mapper)
+    gen = cache.plane_for(segs, mapper, "body")
+    assert cache.tiers.demote_to_cold(gen, reason="test")
+    (rec,) = cache.tiers.cold_records()
+    assert os.path.exists(rec.path)
+    cache.release()
+    assert not os.path.exists(rec.path)
+    assert cache.tiers.cold_records() == []

@@ -228,9 +228,9 @@ def test_health_report_green_shape(api_with_index):
     doc = json.loads(p)
     assert doc["status"] in ("green", "yellow")
     assert set(doc["indicators"]) == {
-        "shards_availability", "plane_serving", "compile_churn",
-        "breakers", "indexing_pressure", "task_backlog", "slo_burn",
-        "dispatch_efficiency"}
+        "shards_availability", "plane_serving", "plane_tiers",
+        "compile_churn", "breakers", "indexing_pressure",
+        "task_backlog", "slo_burn", "dispatch_efficiency"}
     for ind in doc["indicators"].values():
         assert ind["status"] in ("green", "yellow", "red", "unknown")
         assert ind["symptom"]
