@@ -1308,12 +1308,46 @@ def bench_serving(rng):
         svc.plane_cache_stats["hit_count"] - cache0["hit_count"]
     cached_win["miss_count"] = \
         svc.plane_cache_stats["miss_count"] - cache0["miss_count"]
+    # insights overhead: the same dispatch-path traffic with query
+    # fingerprinting + heavy-hitter sketches on vs off, interleaved
+    # ABBA (on/off/off/on) so linear run-order drift — consecutive
+    # identical windows swing >10% on a shared CPU — cancels out of
+    # the pair; ``scripts/bench_diff.py`` gates ``pct_off_vs_on`` at
+    # <= 2% (insights must be ~free on the hot path)
+    arms = {"on": [], "off": []}
+    prev_env = os.environ.get("ES_TPU_INSIGHTS")
+    try:
+        for arm in ("on", "off", "off", "on",
+                    "on", "off", "off", "on"):
+            os.environ["ES_TPU_INSIGHTS"] = \
+                "1" if arm == "on" else "0"
+            arms[arm].append(
+                run_window("request_cache=false", per_client))
+    finally:
+        if prev_env is None:
+            os.environ.pop("ES_TPU_INSIGHTS", None)
+        else:
+            os.environ["ES_TPU_INSIGHTS"] = prev_env
+
+    def _arm_qps(wins):
+        # total requests / total wall, not a mean of rates
+        return sum(w["n_requests"] for w in wins) / \
+            sum(w["n_requests"] / w["value"] for w in wins)
+
+    on_qps, off_qps = _arm_qps(arms["on"]), _arm_qps(arms["off"])
+    insights = {
+        "on_qps": round(on_qps, 1), "off_qps": round(off_qps, 1),
+        "on_p99_ms": round(max(w["p99_ms"] for w in arms["on"]), 2),
+        "off_p99_ms": round(max(w["p99_ms"] for w in arms["off"]), 2),
+        "pct_off_vs_on": round(
+            (off_qps - on_qps) / max(on_qps, 1e-9) * 100.0, 2)}
     return _emit("rest_serving_32_clients", {
         **dispatch_win, "n_clients": n_clients,
         "cold_first_request_ms": round(cold_first_ms, 2),
         "warm_first_request_ms": round(warm_first_ms, 2),
         "stages": stage_pcts,
         "cached": cached_win,
+        "insights": insights,
         "microbatch": batch_stats,
         "telemetry": _telemetry_snapshot()})
 

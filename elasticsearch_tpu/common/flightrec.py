@@ -68,6 +68,7 @@ from .settings import CLUSTER_SETTINGS, Setting
 __all__ = [
     "FlightRecorder", "SloBurnEngine", "Watchdog", "DEFAULT", "ENGINE",
     "record", "observe_query_latency", "bind_ambient", "reset_ambient",
+    "bind_shape", "reset_shape", "set_shape", "current_shape",
     "ensure_watchdog", "get_watchdog", "register_node",
     "slow_dispatch_threshold_ms",
 ]
@@ -181,6 +182,39 @@ def ambient_node() -> Optional[str]:
     return amb[0] if amb is not None else None
 
 
+#: query shape id ambient holder — a single-slot MUTABLE list so the
+#: shard layer can upgrade the id mid-request (the structural
+#: fingerprint bound at the REST/index edge becomes the plan-based one
+#: once the planner lowers the body) and every later reader — slow
+#: log, task ledger, dispatch-profile slots, journal events — sees the
+#: final id without re-binding the context
+_SHAPE: ContextVar = ContextVar("es_flightrec_shape", default=None)
+
+
+def bind_shape(shape_id: Optional[str] = None):
+    """Bind a fresh shape holder for the current request; returns the
+    reset token (``reset_shape`` in a finally, like ``bind_ambient``)."""
+    return _SHAPE.set([shape_id])
+
+
+def reset_shape(token) -> None:
+    _SHAPE.reset(token)
+
+
+def set_shape(shape_id: Optional[str]) -> None:
+    """Upgrade the bound holder's shape id in place (no-op when no
+    holder is bound — direct shard-level calls in tests)."""
+    holder = _SHAPE.get()
+    if holder is not None:
+        holder[0] = shape_id
+
+
+def current_shape() -> Optional[str]:
+    """The query shape id bound for the current request, if any."""
+    holder = _SHAPE.get()
+    return holder[0] if holder is not None else None
+
+
 # -- the ring journal -------------------------------------------------------
 
 _SEQ = itertools.count(1)
@@ -228,6 +262,7 @@ class FlightRecorder:
                 task = amb[1]
             if trace_id is None:
                 trace_id = _tracing.current_trace_id()
+            shape = current_shape()
             ev = {"seq": next(_SEQ), "type": str(type_),
                   "ts_ms": round(time.time() * 1e3, 3),
                   "mono_ms": round(time.monotonic() * 1e3, 3)}
@@ -237,6 +272,8 @@ class FlightRecorder:
                 ev["trace_id"] = trace_id
             if task:
                 ev["task"] = task
+            if shape:
+                ev["shape"] = shape
             if attrs:
                 ev["attrs"] = attrs
             with self._lock:
@@ -626,6 +663,11 @@ class Watchdog:
             if status == RED:
                 self.capture("slo_red", rates=rates)
         self._sample_batcher_queues()
+        # the same tick feeds the downsampling history ring — one poll
+        # cadence for every windowed consumer (lazy import: history is
+        # optional for watchdog-less embedders)
+        from . import metrics_history as _mh
+        _mh.record_tick()
         return status
 
     def _sample_batcher_queues(self) -> None:

@@ -75,7 +75,8 @@ class HealthService:
 
     INDICATORS = ("shards_availability", "plane_serving", "plane_tiers",
                   "compile_churn", "breakers", "indexing_pressure",
-                  "task_backlog", "slo_burn", "dispatch_efficiency")
+                  "task_backlog", "slo_burn", "dispatch_efficiency",
+                  "query_insights")
 
     #: sync non-cold rebuilds: first one turns yellow, a storm turns red
     SYNC_REBUILD_YELLOW = 1
@@ -576,6 +577,77 @@ class HealthService:
                 "captures — hot threads, journal slice, batcher queue "
                 "depths taken AT the red transition) and watch "
                 "es_slo_burn_rate{window} + es_watchdog_captures_total.")]
+        return doc
+
+    def _ind_query_insights(self) -> dict:
+        """Query-shape dominance (``search/query_insight.py``): yellow
+        when one query shape OR one tenant accounts for more than the
+        configured fraction (``insights.dominance_fraction`` /
+        ``ES_TPU_INSIGHTS_DOMINANCE``, default 0.5) of the windowed
+        device-ms on this node — the "one tenant's 10M-doc agg starves
+        point queries" signal, with the shape id and its retained
+        sample body in the diagnosis so the offending request is
+        reproducible without log archaeology. Windows below the
+        observation volume floor carry no signal (the SLO engine's
+        min_window_queries shape)."""
+        from ..search import query_insight as _qi
+        if not _qi.insights_enabled():
+            return {"status": GREEN,
+                    "symptom": "Query insights are disabled "
+                               "(ES_TPU_INSIGHTS=0).",
+                    "details": {"insights": "disabled"}}
+        store = _qi.store_for(getattr(self.api, "node_id", None))
+        dom = store.dominance()
+        frac_limit = _qi.dominance_fraction()
+        min_obs = _qi.min_window_observations()
+        obs = int(dom.get("observations", 0))
+        details = {"dominance": dom,
+                   "dominance_fraction_threshold": frac_limit,
+                   "min_window_observations": min_obs}
+        if obs < min_obs:
+            return {"status": GREEN,
+                    "symptom": f"Below the insight volume floor "
+                               f"({obs}/{min_obs} windowed "
+                               f"observations): no dominance signal.",
+                    "details": details}
+        offenders = []
+        for dim in ("shape", "tenant"):
+            ent = dom.get(dim)
+            if ent and float(ent.get("fraction", 0.0)) > frac_limit:
+                offenders.append((dim, ent))
+        if not offenders:
+            return {"status": GREEN,
+                    "symptom": "No query shape or tenant dominates the "
+                               "windowed device time.",
+                    "details": details}
+        dim, ent = offenders[0]
+        key = ent.get("key")
+        frac_pct = round(float(ent.get("fraction", 0.0)) * 100, 1)
+        doc = {
+            "status": YELLOW,
+            "symptom": (f"One {dim} [{key}] accounts for {frac_pct}% "
+                        f"of windowed device time (threshold "
+                        f"{round(frac_limit * 100, 1)}%)."),
+            "details": details,
+            "impacts": [_impact(
+                "query_insights:dominance", 2,
+                "A single query shape or tenant is consuming most of "
+                "the device budget; other tenants' queries queue "
+                "behind its dispatches.", ["search"])],
+        }
+        affected = {dim: [key] if key else []}
+        sample = ent.get("sample")
+        if sample is not None:
+            affected["sample_body"] = sample
+        doc["diagnosis"] = [_diagnosis(
+            "query_insights:dominance",
+            f"The {dim} [{key}] burned "
+            f"{ent.get('device_ms', 0)} device-ms of the recent "
+            f"insight windows — {frac_pct}% of the node total.",
+            "Inspect GET /_insights/top_queries (the shape's exemplar "
+            "trace id links to GET /_trace/{id}); throttle or rewrite "
+            "the offending request, or isolate the tenant.",
+            affected)]
         return doc
 
     def _ind_dispatch_efficiency(self) -> dict:

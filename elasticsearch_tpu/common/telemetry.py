@@ -124,7 +124,8 @@ class Histogram:
     ``# {trace_id="..."} value`` suffixes (see :meth:`TelemetryRegistry.
     prometheus_text`)."""
 
-    __slots__ = ("count", "sum", "_ring", "_exemplars", "_lock")
+    __slots__ = ("count", "sum", "_ring", "_exemplars", "_lock",
+                 "_sorted")
 
     CAP = 2048
     #: retained (value, exemplar) pairs — small: only the worst recent
@@ -137,12 +138,17 @@ class Histogram:
         self._ring: deque = deque(maxlen=cap)
         self._exemplars: deque = deque(maxlen=self.EXEMPLAR_CAP)
         self._lock = threading.Lock()
+        #: cached sorted view of the ring; invalidated on observe so a
+        #: scrape storm (N families x M pollers) sorts each ring at
+        #: most once per new observation instead of once per scrape
+        self._sorted: Optional[list] = None
 
     def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         with self._lock:
             self.count += 1
             self.sum += v
             self._ring.append(v)
+            self._sorted = None
             if exemplar:
                 self._exemplars.append((float(v), str(exemplar)))
 
@@ -164,7 +170,12 @@ class Histogram:
 
     def snapshot(self) -> dict:
         with self._lock:
-            vals = sorted(self._ring)
+            if self._sorted is None:
+                self._sorted = sorted(self._ring)
+            # the cached list is never mutated after creation (observe
+            # replaces it wholesale), so reading it outside the lock is
+            # safe
+            vals = self._sorted
             count, total = self.count, self.sum
         doc = {"count": count, "sum": round(total, 3)}
         if vals:

@@ -168,11 +168,16 @@ class TraceStore:
             doc["dropped_spans"] = dropped
         return doc
 
-    def recent(self, n: int = 50) -> List[dict]:
+    def recent(self, n: int = 50, min_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> List[dict]:
         """The newest-first trace index: one row per retained trace with
         its root span's name, start and duration (``GET /_trace`` — the
         listing that makes an evicted id's 404 explainable and lets
-        ``trace_dump.py --last`` stop guessing)."""
+        ``trace_dump.py --last`` stop guessing). ``min_ms`` keeps only
+        traces whose root took at least that long; ``tenant`` keeps
+        only traces whose root carries that X-Opaque-Id — both filter
+        BEFORE the ``n`` cap, so "the slowest tenant's last 50" works
+        on a busy store."""
         n = int(n)
         if n <= 0:
             return []
@@ -180,7 +185,7 @@ class TraceStore:
             items = [(tid, list(ent["spans"]))
                      for tid, ent in self._traces.items()]
         out: List[dict] = []
-        for tid, spans in reversed(items[-n:]):
+        for tid, spans in reversed(items):
             row = {"trace_id": tid, "span_count": len(spans)}
             if spans:
                 ids = {s.get("span_id") for s in spans}
@@ -194,7 +199,17 @@ class TraceStore:
                 node = root.get("node")
                 if node:
                     row["node"] = node
+                row_tenant = (root.get("attrs") or {}).get("tenant")
+                if row_tenant:
+                    row["tenant"] = row_tenant
+            if min_ms is not None and \
+                    float(row.get("took_ms") or 0.0) < float(min_ms):
+                continue
+            if tenant is not None and row.get("tenant") != tenant:
+                continue
             out.append(row)
+            if len(out) >= n:
+                break
         return out
 
     def stats_doc(self) -> dict:

@@ -229,6 +229,17 @@ def runtime_families() -> Set[str]:
         wd.tick()
         wd.capture("manual")
         wd.close()
+        # query-insights round: the searches above already folded into
+        # the heavy-hitter store (es_insight_* families); read both new
+        # observability endpoints so the whole insight surface — store,
+        # history ring (fed by the watchdog tick above:
+        # es_history_samples_total / es_history_series), REST layer —
+        # runs under the lint the same deterministic way
+        api.handle("GET", "/_insights/top_queries",
+                   "metric=device_ms", None)
+        api.handle("GET", "/_telemetry/history",
+                   "family=es_query_latency_ms&window=raw&rate=true",
+                   None)
 
         snap = telemetry.DEFAULT.stats_doc()
         return {name for name in snap if name.startswith("es_")}

@@ -59,8 +59,9 @@ _NON_SERVING_ATTR = re.compile(r"metric")
 #: the roofline auditor (``common/roofline``), both written once per
 #: dispatch from the dispatcher loop
 TELEMETRY_MODULES = re.compile(
-    r"(^|\.)(common\.(telemetry|tracing|flightrec|roofline)"
-    r"|search\.(dispatch_profile|plane_tiers))$")
+    r"(^|\.)(common\.(telemetry|tracing|flightrec|roofline"
+    r"|metrics_history)"
+    r"|search\.(dispatch_profile|plane_tiers|query_insight))$")
 
 _LOCK_CTORS = {"Lock", "RLock"}
 

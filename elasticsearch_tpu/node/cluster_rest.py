@@ -755,6 +755,8 @@ class ClusterRestService:
             return self._flight_recorder(method, path, query, body, segs)
         if path.startswith("/_profiler/timeline"):
             return self._profiler_timeline(method, path, query, body)
+        if path.startswith("/_insights/top_queries"):
+            return self._insights_top_queries(method, path, query, body)
         if segs and segs[0] == "_nodes" and segs[-1] == "hot_threads":
             return self._hot_threads(method, path, query, body, segs)
         if method == "GET" and segs and (
@@ -2218,6 +2220,46 @@ class ClusterRestService:
                          (ev.get("args") or {}).get("rec")) in keep]
         merged = dict(local_doc, traceEvents=meta + spans,
                       nodes_reporting=len(docs))
+        return 200, "application/json", json.dumps(merged).encode()
+
+    def _insights_top_queries(self, method, path, query, body):
+        """Cluster ``GET /_insights/top_queries``: every node answers
+        from its own heavy-hitter store (per-node stores, unlike the
+        shared flightrec/profile rings — no dedup needed) and the
+        front MERGES the sketches: per-key SUM of estimates across
+        nodes, re-rank by the requested metric, then re-apply the
+        request ``limit`` AFTER the merge — never concatenate per-node
+        top-N lists (the flight-recorder merge's n_nodes x limit
+        lesson, applied on day one)."""
+        status, ct, out = self._local(method, path, query, body)
+        peers = [n for n in self.node.node_ids if n != self.node.node_id]
+        if not peers or method != "GET" or status != 200:
+            return status, ct, out
+        try:
+            local_doc = json.loads(out)
+        except ValueError:
+            return status, ct, out
+        docs = [local_doc]
+        for st_n, payload in self._fanout_rest_exec(
+                method, path, query, body, peers).values():
+            if st_n != 200:
+                continue
+            try:
+                doc_n = json.loads(payload)
+            except ValueError:
+                continue
+            if isinstance(doc_n, dict):
+                docs.append(doc_n)
+        from urllib.parse import parse_qs
+        from ..search import query_insight as _qi
+        qs = parse_qs(query)
+        try:
+            limit = int((qs.get("limit") or [_qi.topn()])[-1])
+        except ValueError:
+            limit = _qi.topn()
+        metric = (qs.get("metric") or ["count"])[-1]
+        merged = _qi.merge_top_docs(docs, limit=limit, metric=metric)
+        merged["nodes_reporting"] = len(docs)
         return 200, "application/json", json.dumps(merged).encode()
 
     def _hot_threads(self, method, path, query, body, segs):

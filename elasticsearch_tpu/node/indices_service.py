@@ -476,6 +476,12 @@ class IndexService:
         opaque = _tracing.current_opaque_id()
         if opaque:
             entry["x_opaque_id"] = opaque
+        # the query shape id joins this line to /_insights/top_queries
+        # and flight-recorder events without replaying the source
+        from ..common import flightrec as _fr
+        shape = _fr.current_shape()
+        if shape:
+            entry["shape"] = shape
         if stages:
             # plane-served queries: which pipeline stage ate the time
             # (queue wait / host prep / device dispatch / fetch)
@@ -513,26 +519,68 @@ class IndexService:
         the coordinator's — the ``GET /_trace/{id}`` tree's shard tier."""
         from ..common import telemetry as _tm
         from ..common import tracing as _tracing
+        from ..common import flightrec as _fr
+        from ..search import query_insight as _qi
+        from .task_manager import current_resources
         t0 = time.perf_counter()
-        with _tracing.span(f"shards[{self.name}]",
-                           attrs={"index": self.name,
-                                  "shards": self.num_shards}):
-            r = self._search_traced(body, request_cache)
-            # SLO latency family: each sample may carry its trace id as
-            # an OpenMetrics exemplar, so a p99 breach on the scrape
-            # links straight to GET /_trace/{id} (O(1) on this path)
-            took_ms = (time.perf_counter() - t0) * 1e3
-            _tm.DEFAULT.histogram(
-                "es_query_latency_ms", {"index": self.name},
-                help="per-index shard-phase query latency ms "
-                     "(exemplars carry trace ids)").observe(
-                took_ms, exemplar=_tracing.current_trace_id())
-            # the same sample feeds the SLO burn-rate engine (one locked
-            # per-second bucket update — the watchdog evaluates windows
-            # off this path)
-            from ..common import flightrec as _fr
-            _fr.observe_query_latency(took_ms)
-            return r
+        insights = _qi.insights_enabled()
+        shape_token = None
+        res = cpu0 = dev0 = bytes0 = None
+        if insights:
+            # bind the structural fingerprint up front; the shard layer
+            # upgrades it in place to the plan-based id once the
+            # planner lowers the body (flightrec.set_shape), so slow
+            # log, ledger, dispatch records and this observation all
+            # end on the same id
+            shape_token = _fr.bind_shape(_qi.shape_of(body))
+            cpu0 = time.thread_time()
+            res = current_resources()
+            if res is not None:
+                dev0 = res.device_ms
+                bytes0 = res.h2d_bytes + res.d2h_bytes
+                # stamp the ledger NOW so a live _tasks?detailed poll
+                # sees the shape while the task runs; the post-search
+                # stamp below appends the plan-upgraded id if the
+                # planner changed it mid-flight
+                res.note_shape(_fr.current_shape())
+        try:
+            with _tracing.span(f"shards[{self.name}]",
+                               attrs={"index": self.name,
+                                      "shards": self.num_shards}):
+                r = self._search_traced(body, request_cache)
+                # SLO latency family: each sample may carry its trace
+                # id as an OpenMetrics exemplar, so a p99 breach on the
+                # scrape links straight to GET /_trace/{id} (O(1) on
+                # this path)
+                took_ms = (time.perf_counter() - t0) * 1e3
+                _tm.DEFAULT.histogram(
+                    "es_query_latency_ms", {"index": self.name},
+                    help="per-index shard-phase query latency ms "
+                         "(exemplars carry trace ids)").observe(
+                    took_ms, exemplar=_tracing.current_trace_id())
+                # the same sample feeds the SLO burn-rate engine (one
+                # locked per-second bucket update — the watchdog
+                # evaluates windows off this path)
+                _fr.observe_query_latency(took_ms)
+                if insights:
+                    dev_ms = (res.device_ms - dev0) \
+                        if res is not None else 0.0
+                    xfer = (res.h2d_bytes + res.d2h_bytes - bytes0) \
+                        if res is not None else 0.0
+                    shape = _fr.current_shape()
+                    if res is not None and shape:
+                        res.note_shape(shape)
+                    _qi.store_for(_fr.ambient_node()).observe(
+                        shape, _tracing.current_opaque_id(),
+                        latency_ms=took_ms,
+                        cpu_ms=(time.thread_time() - cpu0) * 1e3,
+                        device_ms=dev_ms, bytes_=xfer,
+                        trace_id=_tracing.current_trace_id(),
+                        sample_body=body)
+                return r
+        finally:
+            if shape_token is not None:
+                _fr.reset_shape(shape_token)
 
     def _search_traced(self, body: Optional[dict],
                        request_cache: Optional[bool]) -> ShardSearchResult:

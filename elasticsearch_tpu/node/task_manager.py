@@ -72,7 +72,12 @@ class TaskResources:
 
     __slots__ = ("_lock", "cpu_ms", "device_ms", "h2d_bytes", "d2h_bytes",
                  "docs_scanned", "delta_docs_scanned", "dispatches",
-                 "_cpu_marks")
+                 "_cpu_marks", "shapes")
+
+    #: retained distinct query shape ids per task — bounded: an msearch
+    #: with hundreds of bodies keeps the first few, which is enough to
+    #: join the ledger to /_insights/top_queries
+    SHAPES_MAX = 8
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -83,6 +88,8 @@ class TaskResources:
         self.docs_scanned = 0
         self.delta_docs_scanned = 0
         self.dispatches = 0
+        #: query shape ids observed under this task, insertion-ordered
+        self.shapes: List[str] = []
         #: thread ident -> last ``time.thread_time()`` mark — per-thread
         #: so an async task's worker and the request thread never mix
         self._cpu_marks: Dict[int, float] = {}
@@ -141,9 +148,19 @@ class TaskResources:
                  delta_docs_scanned=int(doc.get("delta_docs_scanned", 0)),
                  dispatches=int(doc.get("dispatches", 0)))
 
+    def note_shape(self, shape_id: str) -> None:
+        """Record a query shape id served under this task (bounded,
+        first-seen order)."""
+        if not shape_id:
+            return
+        with self._lock:
+            if shape_id not in self.shapes and \
+                    len(self.shapes) < self.SHAPES_MAX:
+                self.shapes.append(shape_id)
+
     def to_dict(self) -> dict:
         with self._lock:
-            return {
+            doc = {
                 "cpu_time_ms": round(self.cpu_ms, 3),
                 "device_time_ms": round(self.device_ms, 3),
                 "transfer_bytes": {"h2d": self.h2d_bytes,
@@ -152,6 +169,9 @@ class TaskResources:
                 "delta_docs_scanned": self.delta_docs_scanned,
                 "dispatches": self.dispatches,
             }
+            if self.shapes:
+                doc["shapes"] = list(self.shapes)
+            return doc
 
 
 class TaskCancelledError(ElasticsearchError):

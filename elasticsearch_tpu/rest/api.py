@@ -658,6 +658,9 @@ class RestAPI:
         add("GET", "/_prometheus/metrics", self.h_prometheus)
         add("GET", "/_trace", self.h_trace_list)
         add("GET", "/_trace/{trace_id}", self.h_trace_get)
+        add("GET", "/_insights/top_queries",
+            self.h_insights_top_queries)
+        add("GET", "/_telemetry/history", self.h_telemetry_history)
         add("GET", "/_profiler/timeline", self.h_profiler_timeline)
         add("GET", "/_flight_recorder", self.h_flight_recorder)
         add("GET", "/_flight_recorder/captures", self.h_flight_captures)
@@ -1011,10 +1014,15 @@ class RestAPI:
             if opaque:
                 desc += f" [x-opaque-id={opaque}]"
             _op_token = _tracing.set_opaque_id(opaque)
+            # the root span carries the tenant (X-Opaque-Id) so the
+            # GET /_trace listing's ?tenant= filter works off the store
+            root_attrs = {"action": action}
+            if opaque:
+                root_attrs["tenant"] = opaque
             try:
                 with _tracing.span(f"rest[{action}]", node=self.node_id,
                                    headers=headers, root=True,
-                                   attrs={"action": action}) as sp:
+                                   attrs=root_attrs) as sp:
                     task_headers = {"trace.id": sp.trace_id}
                     if opaque:
                         task_headers["X-Opaque-Id"] = opaque
@@ -2049,15 +2057,84 @@ class RestAPI:
     def h_trace_list(self, params, body):
         """GET /_trace: newest-first index of retained trace ids with
         each root span's action + duration — the listing that explains
-        an evicted id's 404 and feeds ``trace_dump.py --last``."""
+        an evicted id's 404 and feeds ``trace_dump.py --last``.
+        ``?min_ms=`` keeps only traces at least that slow; ``?tenant=``
+        keeps only one X-Opaque-Id's traces (both filter before the
+        ``size`` cap)."""
         from ..common.tracing import DEFAULT_STORE
         try:
             n = int(params.get("size", 50))
         except ValueError:
             raise IllegalArgumentError(
                 f"[size] must be an integer, got [{params.get('size')}]")
-        return {"traces": DEFAULT_STORE.recent(n),
+        min_ms = None
+        raw = params.get("min_ms")
+        if raw not in (None, ""):
+            try:
+                min_ms = float(raw)
+            except ValueError:
+                raise IllegalArgumentError(
+                    f"[min_ms] must be a number, got [{raw}]")
+        tenant = params.get("tenant") or None
+        return {"traces": DEFAULT_STORE.recent(n, min_ms=min_ms,
+                                               tenant=tenant),
                 "store": DEFAULT_STORE.stats_doc()}
+
+    def h_insights_top_queries(self, params, body):
+        """GET /_insights/top_queries: this node's heavy-hitter query
+        shapes and tenants by count/latency/cpu/device-ms/bytes
+        (``search/query_insight.py``), ranked by ``?metric=`` (default
+        ``count``), capped at ``?limit=``; ``?window=current|previous|
+        both`` picks the rotation window. Each shape row carries one
+        exemplar trace id and one verbatim sample body. The cluster
+        front fans this out per node and MERGES sketches
+        (``node/cluster_rest``)."""
+        from ..search import query_insight as _qi
+        try:
+            limit = int(params.get("limit", _qi.topn()))
+        except ValueError:
+            raise IllegalArgumentError(
+                f"[limit] must be an integer, got [{params.get('limit')}]")
+        metric = params.get("metric", "count")
+        if metric not in _qi.METRICS:
+            raise IllegalArgumentError(
+                f"[metric] must be one of {list(_qi.METRICS)}, got "
+                f"[{metric}]")
+        window = params.get("window", "current")
+        if window not in ("current", "previous", "both"):
+            raise IllegalArgumentError(
+                f"[window] must be current, previous or both, got "
+                f"[{window}]")
+        return _qi.store_for(self.node_id).top_doc(
+            limit=limit, metric=metric, window=window)
+
+    def h_telemetry_history(self, params, body):
+        """GET /_telemetry/history?family=&window=: the bounded
+        downsampling ring over selected ``es_*`` families
+        (``common/metrics_history.py``). ``window`` picks the tier
+        (``raw``/``10s``/``1m``), ``since`` is an epoch-seconds floor,
+        ``rate=true`` returns per-second derivatives instead of raw
+        points. Without ``family`` the response is the store's stats
+        doc (recorded families, tiers, series counts)."""
+        from ..common import metrics_history as _mh
+        family = params.get("family")
+        if not family:
+            return _mh.DEFAULT.stats_doc()
+        window = params.get("window", "raw")
+        if window not in {t[0] for t in _mh.TIERS}:
+            raise IllegalArgumentError(
+                f"[window] must be one of "
+                f"{[t[0] for t in _mh.TIERS]}, got [{window}]")
+        since = None
+        raw = params.get("since")
+        if raw not in (None, ""):
+            try:
+                since = float(raw)
+            except ValueError:
+                raise IllegalArgumentError(
+                    f"[since] must be epoch seconds, got [{raw}]")
+        return _mh.DEFAULT.doc(family, window=window, since=since,
+                               rate=_flag(params, "rate"))
 
     def h_trace_get(self, params, body, trace_id):
         """GET /_trace/{trace_id}: the recorded span tree for one

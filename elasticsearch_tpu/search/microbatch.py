@@ -86,7 +86,7 @@ class _Slot:
     __slots__ = ("terms", "k", "done", "vals", "hits", "total", "aggs",
                  "error", "t_enq", "rounds_skipped", "stage_ms", "info",
                  "view_segments", "view_key", "params", "trace_id",
-                 "node")
+                 "node", "shape")
 
     def __init__(self, terms, k: int, view=None, params=None):
         self.terms = terms
@@ -101,6 +101,10 @@ class _Slot:
         from ..common import tracing as _tracing
         self.trace_id = _tracing.current_trace_id()
         self.node = _fr.ambient_node()
+        #: the request's query shape id (dispatch-profile records join
+        #: /_insights/top_queries by it) — captured here for the same
+        #: reason as trace_id
+        self.shape = _fr.current_shape()
         #: extra dispatch parameters that shape the kernel (kNN IVF:
         #: bucketed (nprobe, rerank)) — co-batching only within one
         #: params tuple, so the compile-shape lattice stays warm
@@ -555,6 +559,7 @@ class PlaneMicroBatcher:
                 mono_ms=round(q_start * 1e3, 3),
                 end_ms=round(wall(t_end), 3),
                 node=next((s.node for s in batch if s.node), None),
+                shape=next((s.shape for s in batch if s.shape), None),
                 batcher=f"{self.kind}:{id(self):x}", kind=self.kind,
                 kernel=kernel, thread=me.ident, thread_name=me.name,
                 bucket={"k": k,

@@ -1,0 +1,580 @@
+"""Query insights: plan-shape fingerprinting and heavy-hitter top-N.
+
+The observability stack can say *that* the cluster is slow (SLO burn,
+roofline drift, queue depth) but not *which queries make it slow*:
+per-tenant rollups and the task ledger aggregate away the query shape.
+This module closes that gap:
+
+- :func:`shape_of` fingerprints a search/agg body into a normalized
+  **query shape id** — a short hash of the lowered :class:`FusedPlan`
+  (when the planner lowered the request) or of the legacy body
+  structure, with literals stripped: field names and clause roles are
+  kept, term *values* and query vectors dropped, and every size-ish
+  parameter (``k``, windows, ``num_candidates``) bucketed exactly as
+  the lattice buckets them (:func:`utils.shapes.round_up_pow2`), so
+  two requests that compile to the same dispatch shape share one id.
+
+- :class:`InsightStore` — per-node space-saving (Metwally) heavy-hitter
+  sketches of the top-N shapes AND tenants by count, latency, cpu-ms,
+  device-ms, and bytes. Bounded memory (capacity = top-N x
+  ``SLACK``), per-window rotation (current + previous window
+  retained), one exemplar trace id and one verbatim (truncated) sample
+  body per retained shape. ``GET /_insights/top_queries`` serves it;
+  the cluster front fans it in via ``rest:exec`` and MERGES sketches
+  (sums per-key estimates, then re-applies the request limit — the
+  PR 13/PR 15 limit-after-merge lesson).
+
+The shape id itself rides the request as ambient context
+(``common/flightrec.py``'s shape holder) so the slow log, the task
+ledger, dispatch-profile records, and flight-recorder events all join
+on it without argument plumbing.
+
+Writes here are O(1) dict/heap-free updates under this module's own
+lock — never under a serving lock (ESTP-L02 lists this module with
+``common/telemetry``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..common import telemetry
+from ..common.settings import CLUSTER_SETTINGS, Setting
+from ..utils.shapes import round_up_pow2
+
+__all__ = [
+    "shape_of", "fingerprint_plan", "fingerprint_body", "InsightStore",
+    "store_for", "merge_top_docs", "topn", "window_seconds",
+    "insights_enabled", "METRICS",
+]
+
+#: the five tracked cost metrics (one sketch each, per dimension)
+METRICS = ("count", "latency_ms", "cpu_ms", "device_ms", "bytes")
+
+#: sketch capacity per metric = topn() x SLACK — generous enough that
+#: a Zipf-heavy stream of a few dozen distinct shapes never evicts, so
+#: the space-saving top-N guarantee degenerates to exact counting
+SLACK = 8
+
+#: verbatim sample bodies are truncated to this many serialized chars
+SAMPLE_CAP = 2048
+
+SETTING_TOPN = CLUSTER_SETTINGS.register(
+    Setting.int_setting("insights.topn", 32,
+                        scope="cluster", dynamic=False, min_value=1))
+SETTING_WINDOW_S = CLUSTER_SETTINGS.register(
+    Setting.float_setting("insights.window_seconds", 60.0,
+                          scope="cluster", dynamic=False))
+SETTING_DOMINANCE = CLUSTER_SETTINGS.register(
+    Setting.float_setting("insights.dominance_fraction", 0.5,
+                          scope="cluster", dynamic=True))
+SETTING_MIN_OBS = CLUSTER_SETTINGS.register(
+    Setting.int_setting("insights.min_window_observations", 16,
+                        scope="cluster", dynamic=True, min_value=1))
+
+
+def insights_enabled() -> bool:
+    """Master on/off gate (``ES_TPU_INSIGHTS`` env; default on). The
+    bench's insights-off arm uses this to measure the overhead."""
+    return os.environ.get("ES_TPU_INSIGHTS", "1").lower() \
+        not in ("0", "false")
+
+
+def topn() -> int:
+    raw = os.environ.get("ES_TPU_INSIGHTS_TOPN")
+    if raw is not None:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    return int(SETTING_TOPN.default)
+
+
+def window_seconds() -> float:
+    raw = os.environ.get("ES_TPU_INSIGHTS_WINDOW_S")
+    if raw is not None:
+        try:
+            return max(1.0, float(raw))
+        except ValueError:
+            pass
+    return float(SETTING_WINDOW_S.default)
+
+
+def dominance_fraction() -> float:
+    raw = os.environ.get("ES_TPU_INSIGHTS_DOMINANCE")
+    if raw is not None:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return float(SETTING_DOMINANCE.default)
+
+
+def min_window_observations() -> int:
+    raw = os.environ.get("ES_TPU_INSIGHTS_MIN_OBS")
+    if raw is not None:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    return int(SETTING_MIN_OBS.default)
+
+
+# ---------------------------------------------------------------------------
+# Fingerprinting
+# ---------------------------------------------------------------------------
+
+def _digest(parts) -> str:
+    """Stable short id from a JSON-serializable normalized structure."""
+    blob = json.dumps(parts, sort_keys=True, default=str,
+                      separators=(",", ":")).encode()
+    return "qs-" + hashlib.sha1(blob).hexdigest()[:12]
+
+
+def fingerprint_plan(plan) -> str:
+    """Shape id of a lowered :class:`query_planner.FusedPlan`: literals
+    (term values, the query vector) stripped, clause roles and per-
+    clause term COUNTS kept, every size bucketed exactly as
+    ``make_item`` buckets the dispatch shape."""
+    clauses = tuple((role, round_up_pow2(len(terms), 1))
+                    for role, terms in plan.clauses)
+    knn = None
+    if plan.knn is not None:
+        knn = ("knn", plan.knn.field, round_up_pow2(plan.knn.k, 1),
+               round_up_pow2(plan.knn.num_candidates, 1),
+               plan.knn.nprobe, plan.knn.rerank)
+    rescore = None
+    if plan.rescore is not None:
+        rescore = ("rescore", plan.rescore.mode,
+                   round_up_pow2(len(plan.rescore.terms), 1),
+                   round_up_pow2(plan.rescore.window, 1))
+    aggs = None
+    if plan.aggs is not None:
+        aggs = _strip_literals(_agg_structure(plan.aggs))
+    return _digest(["fused", plan.field, clauses, plan.msm,
+                    plan.bag is not None, knn, plan.fusion,
+                    plan.rank_constant,
+                    round_up_pow2(plan.rank_window, 1),
+                    rescore, round_up_pow2(plan.k, 1),
+                    round_up_pow2(plan.window_text, 1), aggs])
+
+
+def _agg_structure(agg_plan):
+    """The agg plan's canonical spec (``spec_key`` is the sorted-JSON
+    spec the planner already canonicalizes on); parse it back so the
+    literal stripper can walk it."""
+    try:
+        return json.loads(agg_plan.spec_key)
+    except Exception:   # noqa: BLE001 — opaque plan: keep its key
+        return str(getattr(agg_plan, "spec_key", ""))
+
+
+_SIZE_KEYS = {"size", "from", "k", "num_candidates", "window_size",
+              "rank_window_size", "rank_constant", "shard_size",
+              "num_partitions", "precision_threshold", "nprobe",
+              "rerank"}
+#: keys whose values are literals (query text, vectors, ranges) — the
+#: shape keeps the KEY (the field name / clause kind) and drops values
+_LITERAL_DROP = {"query_vector", "query_vector_builder"}
+
+
+def _strip_literals(node):
+    """Normalize a body fragment: dict KEYS (query kinds, field names,
+    agg types, option names) survive; scalar VALUES become their type
+    tag except size-ish integers, which bucket pow2. Lists keep a
+    bucketed length plus the normalized first element (homogeneous
+    clause arrays collapse — ten should-terms and twelve hash the
+    same once the count buckets equal)."""
+    if isinstance(node, dict):
+        out = {}
+        for key, val in sorted(node.items()):
+            key = str(key)
+            if key in _LITERAL_DROP:
+                out[key] = "_"
+            elif key in _SIZE_KEYS and isinstance(val, (int, float)) \
+                    and not isinstance(val, bool):
+                out[key] = round_up_pow2(int(val), 1)
+            else:
+                out[key] = _strip_literals(val)
+        return out
+    if isinstance(node, list):
+        head = _strip_literals(node[0]) if node else None
+        return ["[]", round_up_pow2(len(node), 1), head]
+    if isinstance(node, bool) or node is None:
+        return node
+    if isinstance(node, (int, float)):
+        return "n"
+    return "s"
+
+
+def fingerprint_body(body: Optional[dict]) -> str:
+    """Shape id for a request the planner did NOT lower: a structural
+    walk keeping query kinds / field names / agg types, stripping
+    literal values, bucketing sizes."""
+    if not isinstance(body, dict):
+        return _digest(["legacy", None])
+    keep = {}
+    for section in ("query", "knn", "aggs", "aggregations", "rescore",
+                    "sort", "collapse", "suggest", "rank", "_source",
+                    "size", "from", "min_score", "search_after"):
+        if section in body:
+            keep[section] = body[section]
+    return _digest(["legacy", _strip_literals(keep)])
+
+
+def shape_of(body: Optional[dict], plan=None) -> str:
+    """The query shape id: plan-based when the request lowered to a
+    :class:`FusedPlan`, structural otherwise. Never raises — insight
+    must not fail the request it fingerprints."""
+    try:
+        if plan is not None:
+            return fingerprint_plan(plan)
+        return fingerprint_body(body)
+    except Exception:   # noqa: BLE001 — best-effort by contract
+        return "qs-error"
+
+
+# ---------------------------------------------------------------------------
+# Space-saving sketch
+# ---------------------------------------------------------------------------
+
+class SpaceSaving:
+    """Metwally et al. space-saving summary over a weighted stream:
+    at most ``cap`` tracked keys; an untracked arrival evicts the
+    current minimum and inherits its estimate as the new key's error
+    bound. ``est - err <= true <= est`` for every tracked key, and any
+    key whose true weight exceeds ``total / cap`` is guaranteed
+    tracked. Not thread-safe — the owning store serializes."""
+
+    __slots__ = ("cap", "items", "total")
+
+    def __init__(self, cap: int):
+        self.cap = max(1, int(cap))
+        # key -> [estimate, error]
+        self.items: Dict[str, list] = {}
+        self.total = 0.0
+
+    def offer(self, key: str, weight: float) -> None:
+        self.total += weight
+        ent = self.items.get(key)
+        if ent is not None:
+            ent[0] += weight
+            return
+        if len(self.items) < self.cap:
+            self.items[key] = [weight, 0.0]
+            return
+        mkey = min(self.items, key=lambda k: self.items[k][0])
+        mest = self.items.pop(mkey)[0]
+        self.items[key] = [mest + weight, mest]
+
+    def top(self, n: int) -> List[Tuple[str, float, float]]:
+        """``[(key, estimate, error)]`` sorted by estimate desc."""
+        rows = sorted(self.items.items(),
+                      key=lambda kv: (-kv[1][0], kv[0]))
+        return [(k, v[0], v[1]) for k, v in rows[:max(0, int(n))]]
+
+    def to_doc(self) -> dict:
+        return {"cap": self.cap, "total": round(self.total, 3),
+                "items": {k: [round(v[0], 3), round(v[1], 3)]
+                          for k, v in self.items.items()}}
+
+
+# ---------------------------------------------------------------------------
+# Per-node store
+# ---------------------------------------------------------------------------
+
+class _Window:
+    """One rotation window: per-dimension, per-metric sketches plus
+    bounded shape metadata (exemplar trace id + sample body)."""
+
+    __slots__ = ("start", "sketches", "meta", "observations")
+
+    def __init__(self, start: float, cap: int):
+        self.start = start
+        self.observations = 0
+        # dimension -> metric -> SpaceSaving
+        self.sketches = {
+            dim: {m: SpaceSaving(cap) for m in METRICS}
+            for dim in ("shape", "tenant")}
+        # shape_id -> {"trace_id", "sample"}
+        self.meta: Dict[str, dict] = {}
+
+
+class InsightStore:
+    """Per-node query-insight accumulator: bounded sketches with
+    current + previous window retained, rotated lazily off the
+    injectable clock."""
+
+    def __init__(self, node: Optional[str] = None,
+                 topn_: Optional[int] = None,
+                 window_s: Optional[float] = None,
+                 clock=time.monotonic,
+                 registry: Optional[telemetry.TelemetryRegistry] = None):
+        self.node = node or "local"
+        self.topn = topn_ if topn_ is not None else topn()
+        self.cap = self.topn * SLACK
+        self.window_s = window_s if window_s is not None \
+            else window_seconds()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._cur = _Window(self._clock(), self.cap)
+        self._prev: Optional[_Window] = None
+        self._reg = registry or telemetry.DEFAULT
+        # pre-create the families so the catalogue lint always sees them
+        self._reg.counter("es_insight_observations_total",
+                          help="query-insight observations folded into "
+                               "the heavy-hitter sketches")
+        self._reg.counter("es_insight_window_rotations_total",
+                          help="insight window rotations (current -> "
+                               "previous)")
+        self._reg.gauge("es_insight_shapes_tracked",
+                        help="distinct shapes tracked in the current "
+                             "insight window (count sketch)").set(0)
+
+    # -- write path ---------------------------------------------------------
+
+    def _rotate_locked(self, now: float) -> None:
+        if now - self._cur.start < self.window_s:
+            return
+        self._prev = self._cur
+        self._cur = _Window(now, self.cap)
+        self._reg.counter("es_insight_window_rotations_total").inc()
+
+    def observe(self, shape_id: Optional[str], tenant: Optional[str],
+                latency_ms: float = 0.0, cpu_ms: float = 0.0,
+                device_ms: float = 0.0, bytes_: float = 0.0,
+                trace_id: Optional[str] = None,
+                sample_body: Optional[dict] = None) -> None:
+        """Fold one finished search into the sketches. O(topn) worst
+        case (a min() scan on eviction), O(1) typically; never
+        raises."""
+        if not shape_id:
+            return
+        try:
+            vals = {"count": 1.0, "latency_ms": float(latency_ms),
+                    "cpu_ms": float(cpu_ms),
+                    "device_ms": float(device_ms),
+                    "bytes": float(bytes_)}
+            now = self._clock()
+            with self._lock:
+                self._rotate_locked(now)
+                win = self._cur
+                win.observations += 1
+                for metric, v in vals.items():
+                    win.sketches["shape"][metric].offer(shape_id, v)
+                    if tenant:
+                        win.sketches["tenant"][metric].offer(
+                            str(tenant), v)
+                if shape_id not in win.meta:
+                    if len(win.meta) >= 2 * self.cap:
+                        # keep only shapes the count sketch still tracks
+                        live = win.sketches["shape"]["count"].items
+                        for dead in [k for k in win.meta
+                                     if k not in live]:
+                            win.meta.pop(dead, None)
+                    if len(win.meta) < 2 * self.cap:
+                        win.meta[shape_id] = {
+                            "trace_id": trace_id,
+                            "sample": _truncate_sample(sample_body)}
+                shapes_tracked = len(
+                    win.sketches["shape"]["count"].items)
+            self._reg.counter("es_insight_observations_total").inc()
+            self._reg.gauge("es_insight_shapes_tracked") \
+                .set(shapes_tracked)
+        except Exception:   # noqa: BLE001 — insight must not fail serving
+            pass
+
+    # -- read path ----------------------------------------------------------
+
+    def _windows_locked(self, window: str) -> List[_Window]:
+        if window == "previous":
+            return [self._prev] if self._prev is not None else []
+        if window == "both":
+            return [w for w in (self._cur, self._prev) if w is not None]
+        return [self._cur]
+
+    def top_doc(self, limit: Optional[int] = None,
+                metric: str = "count",
+                window: str = "current") -> dict:
+        """The per-node ``GET /_insights/top_queries`` document: rows
+        ranked by ``metric``'s sketch, each enriched with every other
+        metric's estimate for the same key plus the retained exemplar
+        trace id and sample body."""
+        if metric not in METRICS:
+            metric = "count"
+        n = limit if limit is not None else self.topn
+        with self._lock:
+            self._rotate_locked(self._clock())
+            wins = self._windows_locked(window)
+            doc = {"node": self.node, "metric": metric,
+                   "window_seconds": self.window_s,
+                   "observations": sum(w.observations for w in wins),
+                   "shapes": self._rows_locked("shape", wins, metric, n),
+                   "tenants": self._rows_locked("tenant", wins, metric,
+                                                n)}
+        return doc
+
+    def _rows_locked(self, dim: str, wins: List[_Window], metric: str,
+                     n: int) -> List[dict]:
+        # merge the selected windows' sketches per metric (sum of
+        # estimates — same rule the cluster fan-in applies per node)
+        merged: Dict[str, dict] = {}
+        for win in wins:
+            for m in METRICS:
+                for key, est, err in win.sketches[dim][m].top(
+                        win.sketches[dim][m].cap):
+                    row = merged.setdefault(
+                        key, {m2: 0.0 for m2 in METRICS})
+                    row[m] = row.get(m, 0.0) + est
+                    if m == metric:
+                        row["error"] = row.get("error", 0.0) + err
+        rows = sorted(merged.items(),
+                      key=lambda kv: (-kv[1].get(metric, 0.0), kv[0]))
+        out = []
+        for key, vals in rows[:max(0, int(n))]:
+            row = {("shape" if dim == "shape" else "tenant"): key}
+            for m in METRICS:
+                row[m] = round(vals.get(m, 0.0), 3) if m != "count" \
+                    else int(vals.get(m, 0))
+            row["error"] = round(vals.get("error", 0.0), 3)
+            if dim == "shape":
+                for win in wins:
+                    meta = win.meta.get(key)
+                    if meta is not None:
+                        if meta.get("trace_id"):
+                            row["exemplar_trace_id"] = meta["trace_id"]
+                        if meta.get("sample") is not None:
+                            row["sample"] = meta["sample"]
+                        break
+            out.append(row)
+        return out
+
+    def dominance(self) -> dict:
+        """The health indicator's read: the top shape's and tenant's
+        fraction of windowed (current + previous) device-ms, with the
+        shape's retained sample for the diagnosis."""
+        with self._lock:
+            self._rotate_locked(self._clock())
+            wins = self._windows_locked("both")
+            obs = sum(w.observations for w in wins)
+            out = {"observations": obs}
+            for dim in ("shape", "tenant"):
+                total = sum(w.sketches[dim]["device_ms"].total
+                            for w in wins)
+                agg: Dict[str, float] = {}
+                for w in wins:
+                    for key, est, _err in \
+                            w.sketches[dim]["device_ms"].top(self.cap):
+                        agg[key] = agg.get(key, 0.0) + est
+                if agg and total > 0:
+                    key = max(agg, key=lambda k: agg[k])
+                    out[dim] = {"key": key,
+                                "device_ms": round(agg[key], 3),
+                                "fraction": round(agg[key] / total, 4)}
+                    if dim == "shape":
+                        for w in wins:
+                            meta = w.meta.get(key)
+                            if meta is not None:
+                                out[dim]["sample"] = meta.get("sample")
+                                break
+        return out
+
+
+def _truncate_sample(body: Optional[dict]):
+    """One verbatim sample body per shape, truncated so a pathological
+    10k-term request cannot bloat the store."""
+    if body is None:
+        return None
+    try:
+        blob = json.dumps(body, default=str)
+    except Exception:   # noqa: BLE001 — unserializable body
+        return None
+    if len(blob) <= SAMPLE_CAP:
+        return body
+    return {"_truncated": blob[:SAMPLE_CAP]}
+
+
+# ---------------------------------------------------------------------------
+# Per-node registry (in-process clusters share the module, not a store)
+# ---------------------------------------------------------------------------
+
+_STORES_LOCK = threading.Lock()
+_STORES: Dict[str, InsightStore] = {}
+_STORES_CAP = 64
+
+
+def store_for(node: Optional[str]) -> InsightStore:
+    """The node's insight store, created on first touch. Bounded:
+    test suites spin up many short-lived in-process nodes; oldest
+    entries fall off past ``_STORES_CAP``."""
+    key = node or "local"
+    with _STORES_LOCK:
+        store = _STORES.get(key)
+        if store is None:
+            while len(_STORES) >= _STORES_CAP:
+                _STORES.pop(next(iter(_STORES)))
+            store = _STORES[key] = InsightStore(node=key)
+        return store
+
+
+# ---------------------------------------------------------------------------
+# Cluster fan-in merge
+# ---------------------------------------------------------------------------
+
+def merge_top_docs(docs: List[dict], limit: int,
+                   metric: str = "count") -> dict:
+    """Merge per-node ``top_doc`` payloads: per-key SUM of sketch
+    estimates across nodes (space-saving summaries merge by adding
+    estimates and error bounds), re-rank by the requested metric, then
+    re-apply the request ``limit`` AFTER the merge — never concatenate
+    per-node top-N lists (the n_nodes x limit bug)."""
+    if metric not in METRICS:
+        metric = "count"
+    out = {"metric": metric, "nodes": [], "observations": 0,
+           "shapes": [], "tenants": []}
+    merged = {"shapes": {}, "tenants": {}}
+    for doc in docs:
+        if not isinstance(doc, dict):
+            continue
+        out["nodes"].append(doc.get("node", "?"))
+        out["observations"] += int(doc.get("observations", 0))
+        if "window_seconds" in doc:
+            out["window_seconds"] = doc["window_seconds"]
+        for section, keyname in (("shapes", "shape"),
+                                 ("tenants", "tenant")):
+            for row in doc.get(section) or []:
+                key = row.get(keyname)
+                if not key:
+                    continue
+                ent = merged[section].setdefault(
+                    key, {m: 0.0 for m in METRICS} | {"error": 0.0})
+                for m in METRICS:
+                    ent[m] += float(row.get(m, 0.0))
+                ent["error"] += float(row.get("error", 0.0))
+                if "exemplar_trace_id" in row and \
+                        "exemplar_trace_id" not in ent:
+                    ent["exemplar_trace_id"] = row["exemplar_trace_id"]
+                if "sample" in row and "sample" not in ent:
+                    ent["sample"] = row["sample"]
+    for section, keyname in (("shapes", "shape"), ("tenants", "tenant")):
+        rows = sorted(merged[section].items(),
+                      key=lambda kv: (-kv[1].get(metric, 0.0), kv[0]))
+        sect = []
+        for key, vals in rows[:max(0, int(limit))]:
+            row = {keyname: key}
+            for m in METRICS:
+                row[m] = int(vals[m]) if m == "count" \
+                    else round(vals[m], 3)
+            row["error"] = round(vals.get("error", 0.0), 3)
+            for extra in ("exemplar_trace_id", "sample"):
+                if extra in vals:
+                    row[extra] = vals[extra]
+            sect.append(row)
+        out[section] = sect
+    out["nodes"] = sorted(set(out["nodes"]))
+    return out

@@ -470,6 +470,7 @@ class ShardSearcher:
         fused_plan = None
         fused_aggs = None
         planner_consulted = False
+        shape_id = None
         if (self.fused_provider is not None and query_spec
                 and knn_override is None
                 and (window > 0 or aggs is not None)
@@ -479,6 +480,16 @@ class ShardSearcher:
             if qp.planner_enabled():
                 planner_consulted = True
                 fused_plan = qp.lower_body(body, self.mapper)
+                if fused_plan is not None:
+                    # upgrade the request's ambient shape id from the
+                    # structural fingerprint (bound at the index-service
+                    # edge) to the plan-based one BEFORE any dispatch
+                    # enqueues, so micro-batch slots and journal events
+                    # carry the same id the slow log will
+                    from . import query_insight as _qi
+                    from ..common import flightrec as _fr
+                    shape_id = _qi.shape_of(body, plan=fused_plan)
+                    _fr.set_shape(shape_id)
                 runner = None
                 if fused_plan is not None:
                     runner = self.fused_provider(
@@ -519,6 +530,7 @@ class ShardSearcher:
                 if fused_plan is not None else None,
                 "stages_per_dispatch": fused_plan.n_stages()
                 if fused_plan is not None else None,
+                "shape": shape_id,
             }
 
         # --- query phase (device) -----------------------------------------
@@ -912,6 +924,12 @@ class ShardSearcher:
                     "stages_ms": {s: round(ms, 3)
                                   for s, ms in serving_stages.items()},
                     **(serving_info or {})}
+                # the query shape id joins this profile to its
+                # /_insights/top_queries row and flight-recorder events
+                from ..common import flightrec as _fr
+                prof_shape = shape_id or _fr.current_shape()
+                if prof_shape:
+                    shard_prof["serving"]["shape"] = prof_shape
             if planner_doc is not None:
                 # the one-dispatch planner's verdict + lowering cost:
                 # operators bisecting a fused-path regression see which

@@ -203,6 +203,47 @@ PROMOTION_DRIFT_FACTOR = 2.0
 PROMOTION_DRIFT_SLACK = 10
 
 
+#: insights overhead acceptance: the serving bench's back-to-back
+#: insights-on vs insights-off windows (``configs.rest_serving_32_
+#: clients.insights``) must show fingerprinting + heavy-hitter sketches
+#: costing <= this much headline throughput. ``pct_off_vs_on`` is
+#: (off_qps - on_qps) / on_qps * 100 — positive means insights cost
+#: something. One-sided on the FIRST landing (old side has no
+#: ``insights`` pair): SKIP with a note, gate from the next diff on.
+INSIGHTS_OVERHEAD_MAX_PCT = 2.0
+
+
+def _insights_check(old: dict, new: dict):
+    """Insights-overhead gate over the NEW side's own paired on/off
+    windows; the old side's presence only decides gate-vs-skip (a
+    pairwise diff can't judge a measurement the baseline never made).
+    Returns (report lines, failure strings)."""
+    lines, fails = [], []
+    for name, cfg in (new.get("configs") or {}).items():
+        ins = cfg.get("insights") if isinstance(cfg, dict) else None
+        if not isinstance(ins, dict) or \
+                not isinstance(ins.get("pct_off_vs_on"), (int, float)):
+            continue
+        pct = float(ins["pct_off_vs_on"])
+        ocfg = (old.get("configs") or {}).get(name)
+        oins = ocfg.get("insights") if isinstance(ocfg, dict) else None
+        label = (f"  configs.{name:33s} insights on "
+                 f"{ins.get('on_qps')} vs off {ins.get('off_qps')} "
+                 f"req/s  overhead {pct:+.2f}%")
+        if not isinstance(oins, dict):
+            lines.append(label + "  SKIPPED gate (first landing — no "
+                                 "insights pair in old)")
+            continue
+        if pct > INSIGHTS_OVERHEAD_MAX_PCT:
+            lines.append(label + "  << INSIGHTS-OVERHEAD REGRESSION")
+            fails.append(f"configs.{name} (insights overhead "
+                         f"{pct:+.2f}% past "
+                         f"{INSIGHTS_OVERHEAD_MAX_PCT:.0f}%)")
+        else:
+            lines.append(label)
+    return lines, fails
+
+
 def _tier_check(new: dict):
     """Intra-file gates on the NEW side's ``tiered_capacity`` evidence
     (judged against the run's own device-resident baseline, so they
@@ -479,6 +520,12 @@ def main(argv=None) -> int:
     for fail in _tier_check(new):
         print(f"  {fail}")
         regressions.append(fail)
+    # insights-overhead gate: the serving bench's paired on/off windows
+    # (skip with a note on the first landing — old side has no pair)
+    ins_lines, ins_fails = _insights_check(old, new)
+    for ln in ins_lines:
+        print(ln)
+    regressions.extend(ins_fails)
     if regressions:
         print(f"FAIL: {len(regressions)} regression(s) (throughput past "
               f"{args.threshold:.0%}, recall_at_k past "

@@ -4,13 +4,16 @@
 Usage:
     python scripts/trace_dump.py TRACE_ID [--host http://127.0.0.1:9200]
     python scripts/trace_dump.py --last [--host ...]   # newest trace
-    python scripts/trace_dump.py --list [--host ...]   # recent trace ids
+    python scripts/trace_dump.py --list [--min-ms 100] [--tenant T]
     python scripts/trace_dump.py TRACE_ID --events     # + journal events
 
 ``--last`` reads the node's ``GET /_trace`` listing (newest-first trace
 index with root action + duration) and dumps the newest trace — no more
 probe-request guessing; if the store is empty it issues one probe
-request to mint a trace. ``--list`` prints the listing itself.
+request to mint a trace. ``--list`` prints the listing itself;
+``--min-ms`` and ``--tenant`` pass through to the server-side
+``GET /_trace?min_ms=&tenant=`` filters (applied BEFORE the listing
+cap, so they surface the newest matching traces).
 
 ``--events`` additionally fetches the flight-recorder journal
 (``GET /_flight_recorder?trace_id=...``) and interleaves each event into
@@ -39,6 +42,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import urllib.parse
 import urllib.request
 import zlib
 
@@ -179,6 +183,13 @@ def main() -> int:
                          "listing")
     ap.add_argument("--list", action="store_true", dest="list_traces",
                     help="print the recent-trace listing and exit")
+    ap.add_argument("--min-ms", type=float, default=None,
+                    help="with --list/--last: keep only traces at least "
+                         "this slow (server-side GET /_trace?min_ms=)")
+    ap.add_argument("--tenant", default=None,
+                    help="with --list/--last: keep only one tenant's "
+                         "traces (server-side GET /_trace?tenant=, the "
+                         "X-Opaque-Id stamped on the root span)")
     ap.add_argument("--json", action="store_true",
                     help="raw JSON instead of the tree rendering")
     ap.add_argument("--events", action="store_true",
@@ -193,9 +204,15 @@ def main() -> int:
     tid = args.trace_id
 
     def _listing():
-        status, _h, body = _get(args.host, "/_trace")
+        qs = []
+        if args.min_ms is not None:
+            qs.append(f"min_ms={args.min_ms:g}")
+        if args.tenant:
+            qs.append("tenant=" + urllib.parse.quote(args.tenant))
+        path = "/_trace" + ("?" + "&".join(qs) if qs else "")
+        status, _h, body = _get(args.host, path)
         if status != 200:
-            print(f"GET /_trace -> {status}: {body[:300]!r}",
+            print(f"GET {path} -> {status}: {body[:300]!r}",
                   file=sys.stderr)
             return None
         return json.loads(body).get("traces") or []
@@ -205,10 +222,13 @@ def main() -> int:
         if rows is None:
             return 1
         for row in rows:
-            print(f"{row['trace_id']}  "
-                  f"{row.get('took_ms', 0):9.2f}ms  "
-                  f"{row.get('root', '?')}  "
-                  f"spans={row.get('span_count', 0)}")
+            line = (f"{row['trace_id']}  "
+                    f"{row.get('took_ms', 0):9.2f}ms  "
+                    f"{row.get('root', '?')}  "
+                    f"spans={row.get('span_count', 0)}")
+            if row.get("tenant"):
+                line += f"  tenant={row['tenant']}"
+            print(line)
         return 0
     if args.last:
         rows = _listing()

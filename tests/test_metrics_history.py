@@ -1,0 +1,209 @@
+"""Metrics history ring (PR 18): raw/10s/1m downsampling tiers over
+selected registry families, rate derivatives, windowed deltas, the
+SLO-engine parity acceptance gate, the ``GET /_telemetry/history``
+REST surface, and the Histogram sorted-snapshot cache fix."""
+
+import json
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.common import metrics_history as mh
+from elasticsearch_tpu.common.telemetry import TelemetryRegistry
+
+
+# ---------------------------------------------------------------------------
+# tiers + rates + windowed deltas
+# ---------------------------------------------------------------------------
+
+def test_tier_rollup_and_rate():
+    reg = TelemetryRegistry()
+    c = reg.counter("es_hist_t_total", help="t")
+    hist = mh.MetricsHistory(registry=reg,
+                             families=("es_hist_t_total",))
+    for sec in range(30):
+        c.inc(2)
+        hist.record(now=1000.0 + sec)
+    raw = hist.doc("es_hist_t_total", window="raw")["series"][0]
+    assert len(raw["points"]) == 30
+    assert raw["points"][0] == [1000.0, 2.0]
+    assert raw["points"][-1] == [1029.0, 60.0]
+    # the 10s tier keeps the LAST value per aligned bucket
+    ten = hist.doc("es_hist_t_total", window="10s")["series"][0]
+    assert ten["points"] == [[1000.0, 20.0], [1010.0, 40.0],
+                             [1020.0, 60.0]]
+    # rate = per-second derivative between consecutive retained points
+    rate = hist.doc("es_hist_t_total", window="raw",
+                    rate=True)["series"][0]
+    assert all(v == pytest.approx(2.0) for _ts, v in rate["points"])
+    # a counter reset clamps to 0, never a negative rate
+    c.value = 0.0
+    hist.record(now=1030.0)
+    rate = hist.doc("es_hist_t_total", window="raw",
+                    rate=True)["series"][0]
+    assert rate["points"][-1][1] == 0.0
+
+
+def test_windowed_delta_and_since_filter():
+    reg = TelemetryRegistry()
+    c = reg.counter("es_hist_w_total", help="t")
+    hist = mh.MetricsHistory(registry=reg,
+                             families=("es_hist_w_total",))
+    for sec in range(20):
+        c.inc(3)
+        hist.record(now=2000.0 + sec)
+    # last 5 seconds: ticks at 2015..2019 -> 5 ticks x 3
+    assert hist.windowed_delta("es_hist_w_total", 5.0,
+                               now=2019.0) == pytest.approx(15.0)
+    doc = hist.doc("es_hist_w_total", window="raw", since=2018.0)
+    assert [ts for ts, _v in doc["series"][0]["points"]] == [2018.0,
+                                                             2019.0]
+
+
+def test_labelled_series_and_caps():
+    reg = TelemetryRegistry()
+    reg.counter("es_hist_l_total", {"kind": "a"}, help="t").inc(1)
+    reg.counter("es_hist_l_total", {"kind": "b"}, help="t").inc(5)
+    hist = mh.MetricsHistory(registry=reg,
+                             families=("es_hist_l_total",),
+                             caps={"raw": 4, "10s": 4, "1m": 4})
+    for sec in range(10):
+        hist.record(now=3000.0 + sec)
+    doc = hist.doc("es_hist_l_total", window="raw")
+    assert len(doc["series"]) == 2
+    for series in doc["series"]:
+        assert len(series["points"]) == 4          # ring cap honored
+    only_a = hist.doc("es_hist_l_total", labels={"kind": "a"})
+    assert len(only_a["series"]) == 1
+    assert only_a["series"][0]["labels"] == {"kind": "a"}
+    stats = hist.stats_doc()
+    assert stats["series"] == 2 and stats["ticks"] == 10
+
+
+# ---------------------------------------------------------------------------
+# SLO parity (acceptance gate)
+# ---------------------------------------------------------------------------
+
+def test_history_reproduces_slo_failure_fractions():
+    """GET /_telemetry/history must reproduce the SLO engine's
+    fast/slow-window failure fractions within one bucket on the SAME
+    synthetic stream (fake clock): the engine buckets per second; the
+    history's raw tier covers the fast window exactly and its 10s tier
+    covers the slow window within one 10s bucket."""
+    from elasticsearch_tpu.common.flightrec import SloBurnEngine
+    reg = TelemetryRegistry()
+    q_ctr = reg.counter("es_par_queries_total", help="t")
+    f_ctr = reg.counter("es_par_failures_total", help="t")
+    hist = mh.MetricsHistory(
+        registry=reg,
+        families=("es_par_queries_total", "es_par_failures_total"))
+    engine = SloBurnEngine(latency_threshold_ms=100.0,
+                           latency_budget=0.1, failure_budget=0.01,
+                           fast_s=60.0, slow_s=600.0)
+
+    t0 = 10_000.0
+    q_per_s, f_per_s = 5, 2
+    for sec in range(700):
+        ts = t0 + sec
+        for _ in range(q_per_s):
+            engine.observe(1.0, now=ts)
+        q_ctr.inc(q_per_s)
+        if 640 <= sec < 695:                       # a failure burst
+            engine.note_failures(f_per_s, now=ts)
+            f_ctr.inc(f_per_s)
+        hist.record(now=ts)
+
+    now = t0 + 699
+    rates = engine.burn_rates(now=now)
+    for window, span, tier, tol_q, tol_f in (
+            ("fast", 60.0, "raw", q_per_s, f_per_s),
+            ("slow", 600.0, "10s", 10 * q_per_s, 10 * f_per_s)):
+        eng_q = rates[window]["queries"]
+        eng_f = rates[window]["failures"]
+        h_q = hist.windowed_delta("es_par_queries_total", span,
+                                  now=now, window=tier)
+        h_f = hist.windowed_delta("es_par_failures_total", span,
+                                  now=now, window=tier)
+        # counts agree within one bucket of stream on each side
+        assert abs(h_q - eng_q) <= tol_q, (window, h_q, eng_q)
+        assert abs(h_f - eng_f) <= tol_f, (window, h_f, eng_f)
+        # and so do the failure fractions (denominator = q + fails,
+        # the engine's outage-proof rule)
+        eng_frac = eng_f / (eng_q + eng_f)
+        h_frac = h_f / (h_q + h_f)
+        one_bucket = tol_f / (eng_q + eng_f)
+        assert abs(h_frac - eng_frac) <= one_bucket + 1e-9, (
+            window, h_frac, eng_frac)
+        assert eng_frac > 0                      # the burst registered
+
+
+# ---------------------------------------------------------------------------
+# REST surface
+# ---------------------------------------------------------------------------
+
+def test_rest_history_endpoint():
+    from elasticsearch_tpu.node.indices_service import IndicesService
+    from elasticsearch_tpu.rest.api import RestAPI
+    api = RestAPI(IndicesService(tempfile.mkdtemp(prefix="mh_rest_")))
+    # no family -> the stats doc (recorded families + tier layout)
+    st, _ct, out = api.handle("GET", "/_telemetry/history", "", b"")
+    assert st == 200
+    stats = json.loads(out)
+    assert "es_query_latency_ms" in stats["families"]
+    assert stats["tiers"]["10s"]["bucket_seconds"] == 10.0
+    # a real recording round through the module singleton
+    mh.record_tick()
+    st, _ct, out = api.handle(
+        "GET", "/_telemetry/history",
+        "family=es_tasks_running&window=raw", b"")
+    assert st == 200
+    doc = json.loads(out)
+    assert doc["family"] == "es_tasks_running"
+    assert doc["window"] == "raw" and doc["rate"] is False
+    st, _ct, out = api.handle(
+        "GET", "/_telemetry/history",
+        "family=es_tasks_running&window=bogus", b"")
+    assert st == 400
+    st, _ct, out = api.handle(
+        "GET", "/_telemetry/history",
+        "family=es_tasks_running&since=bogus", b"")
+    assert st == 400
+
+
+def test_watchdog_tick_records_history():
+    """The history ring rides the existing watchdog tick — no new
+    thread, one poll cadence."""
+    from elasticsearch_tpu.common import flightrec
+    before = mh.DEFAULT.stats_doc()["ticks"]
+    wd = flightrec.Watchdog(interval_s=3600.0)
+    try:
+        wd.tick()
+    finally:
+        wd.close()
+    assert mh.DEFAULT.stats_doc()["ticks"] == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Histogram sorted-snapshot cache (the satellite fix)
+# ---------------------------------------------------------------------------
+
+def test_histogram_snapshot_caches_sorted_ring():
+    reg = TelemetryRegistry()
+    h = reg.histogram("es_hist_cache_ms", help="t")
+    for v in (5.0, 1.0, 9.0, 3.0):
+        h.observe(v)
+    snap1 = h.snapshot()
+    assert snap1["count"] == 4
+    assert snap1["p50"] == pytest.approx(3.0, abs=2.0)
+    # the sorted view is cached between scrapes...
+    cached = h._sorted
+    assert cached is not None and cached == sorted(cached)
+    assert h.snapshot() == snap1
+    assert h._sorted is cached                 # no re-sort, same list
+    # ...and invalidated by the next observe
+    h.observe(100.0)
+    assert h._sorted is None
+    snap2 = h.snapshot()
+    assert snap2["count"] == 5
+    assert snap2["max"] == pytest.approx(100.0)
+    assert h._sorted is not None and h._sorted[-1] == 100.0

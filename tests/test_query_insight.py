@@ -1,0 +1,381 @@
+"""Query insights (PR 18): plan-shape fingerprinting, the space-saving
+heavy-hitter sketches behind ``GET /_insights/top_queries``, the
+cluster fan-in MERGE (never concatenation), the shape id stamped into
+the slow log / ``profile:true`` / task ledger, the ``/_trace``
+``min_ms``/``tenant`` filters, and the ``query_insights`` health
+indicator."""
+
+import json
+import random
+import tempfile
+import time
+
+import pytest
+
+from elasticsearch_tpu.search import query_insight as qi
+from elasticsearch_tpu.common.telemetry import TelemetryRegistry
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_strips_literals_keeps_structure():
+    a = {"query": {"bool": {"must": [
+        {"match": {"body": "kibana dashboards"}}],
+        "filter": [{"term": {"level": "error"}}]}}, "size": 10}
+    b = {"query": {"bool": {"must": [
+        {"match": {"body": "entirely different words"}}],
+        "filter": [{"term": {"level": "warn"}}]}}, "size": 13}
+    # same structure, different literals, sizes in the same pow2 bucket
+    assert qi.shape_of(a) == qi.shape_of(b)
+    assert qi.shape_of(a).startswith("qs-")
+    # a structurally different request gets a different id
+    c = {"query": {"match": {"body": "kibana dashboards"}}, "size": 10}
+    assert qi.shape_of(c) != qi.shape_of(a)
+    # a size crossing its pow2 bucket changes the shape
+    d = dict(a, size=300)
+    assert qi.shape_of(d) != qi.shape_of(a)
+    # fields are part of the shape
+    e = {"query": {"match": {"title": "kibana dashboards"}}, "size": 10}
+    assert qi.shape_of(e) != qi.shape_of(c)
+
+
+def test_fingerprint_drops_query_vectors_and_never_raises():
+    k1 = {"knn": {"field": "vec", "query_vector": [0.1] * 8, "k": 5,
+                  "num_candidates": 50}}
+    k2 = {"knn": {"field": "vec", "query_vector": [0.9] * 8, "k": 6,
+                  "num_candidates": 60}}
+    assert qi.shape_of(k1) == qi.shape_of(k2)
+    # garbage never raises (insight must not fail the request)
+    assert qi.shape_of(None).startswith("qs-")
+    assert qi.shape_of({"query": object()}).startswith("qs-")
+
+
+def test_fingerprint_plan_based_for_lowered_requests():
+    """The planner route hashes the lowered FusedPlan, so two bodies
+    compiling to the same dispatch shape share one id."""
+    from elasticsearch_tpu.search import query_planner as qp
+    from elasticsearch_tpu.index.mapping import MapperService
+    mapper = MapperService({"properties": {"body": {"type": "text"}}})
+
+    def lower(words, size):
+        # match + rescore is inside the fused fragment (plain bags
+        # deliberately stay on the legacy plane route)
+        return qp.lower_body({
+            "query": {"match": {"body": words}},
+            "rescore": {"window_size": 50, "query": {
+                "rescore_query": {"match": {"body": words}}}},
+            "size": size}, mapper)
+
+    p1 = lower("hello world", 10)
+    p2 = lower("other words", 12)
+    if p1 is None or p2 is None:
+        pytest.skip("planner did not lower the match body")
+    assert qi.fingerprint_plan(p1) == qi.fingerprint_plan(p2)
+    assert qi.shape_of({}, plan=p1) == qi.fingerprint_plan(p1)
+
+
+# ---------------------------------------------------------------------------
+# space-saving sketch
+# ---------------------------------------------------------------------------
+
+def test_space_saving_error_bound_holds_under_eviction():
+    true = {}
+    rng = random.Random(7)
+    stream = []
+    for i in range(40):
+        key, w = f"k{i}", (40 - i) ** 2
+        true[key] = float(w)
+        stream.extend([key] * w)
+    rng.shuffle(stream)
+    sk = qi.SpaceSaving(cap=8)
+    for key in stream:
+        sk.offer(key, 1.0)
+    assert len(sk.items) <= 8
+    for key, est, err in sk.top(8):
+        t = true[key]
+        # the Metwally invariant: est - err <= true <= est
+        assert est - err <= t + 1e-9
+        assert t <= est + 1e-9
+    # any key past total/cap weight is guaranteed tracked
+    total = sum(true.values())
+    for key, w in true.items():
+        if w > total / 8:
+            assert key in sk.items
+
+
+def test_zipf_adversarial_topn_exact_with_tenants(monkeypatch):
+    """The acceptance gate: a Zipf(1.2) stream of 64 distinct shapes
+    against ES_TPU_INSIGHTS_TOPN=16 must report the true top-8 shapes
+    by device-ms EXACTLY (the 8x slack keeps the sketch exact until
+    the tracked-key budget is genuinely exceeded), with the per-tenant
+    dimension populated."""
+    monkeypatch.setenv("ES_TPU_INSIGHTS_TOPN", "16")
+    clock = [100.0]
+    store = qi.InsightStore(node="zipf", window_s=1e9,
+                            clock=lambda: clock[0],
+                            registry=TelemetryRegistry())
+    assert store.topn == 16 and store.cap == 16 * qi.SLACK
+
+    n_shapes = 64
+    weights = [1.0 / (i + 1) ** 1.2 for i in range(n_shapes)]
+    tot_w = sum(weights)
+    rng = random.Random(42)
+    tenants = [f"tenant-{i}" for i in range(4)]
+    true_dev = {}
+    true_tenant_dev = {}
+    events = []
+    for _ in range(20000):
+        r, acc, idx = rng.random() * tot_w, 0.0, 0
+        for i, w in enumerate(weights):
+            acc += w
+            if r <= acc:
+                idx = i
+                break
+        shape = f"qs-{idx:012d}"
+        tenant = tenants[idx % 4]
+        dev = 0.1 + (idx % 7) * 0.035
+        true_dev[shape] = true_dev.get(shape, 0.0) + dev
+        true_tenant_dev[tenant] = true_tenant_dev.get(tenant, 0.0) + dev
+        events.append((shape, tenant, dev))
+    rng.shuffle(events)
+    for shape, tenant, dev in events:
+        store.observe(shape, tenant, latency_ms=dev * 2, cpu_ms=dev,
+                      device_ms=dev, bytes_=128.0,
+                      trace_id=f"tr-{shape}",
+                      sample_body={"query": {"match": {"body": shape}}})
+
+    doc = store.top_doc(limit=8, metric="device_ms")
+    got = [row["shape"] for row in doc["shapes"]]
+    want = sorted(true_dev, key=lambda k: -true_dev[k])[:8]
+    assert got == want
+    for row in doc["shapes"]:
+        assert row["device_ms"] == pytest.approx(
+            true_dev[row["shape"]], rel=1e-3)
+        assert row["error"] == 0.0          # no eviction at 64 < 128
+        assert row["exemplar_trace_id"] == f"tr-{row['shape']}"
+        assert row["sample"]["query"]["match"]["body"] == row["shape"]
+    # the per-tenant dimension rides the same observations
+    trows = {r["tenant"]: r["device_ms"] for r in doc["tenants"]}
+    assert set(trows) == set(tenants)
+    top_tenant = max(true_tenant_dev, key=lambda k: true_tenant_dev[k])
+    assert doc["tenants"][0]["tenant"] == top_tenant
+
+
+def test_window_rotation_current_previous_both():
+    clock = [0.0]
+    store = qi.InsightStore(node="rot", topn_=4, window_s=60.0,
+                            clock=lambda: clock[0],
+                            registry=TelemetryRegistry())
+    store.observe("qs-old", "t0", device_ms=5.0)
+    clock[0] = 61.0                      # past the window: rotation
+    store.observe("qs-new", "t0", device_ms=7.0)
+    cur = store.top_doc(metric="device_ms", window="current")
+    prev = store.top_doc(metric="device_ms", window="previous")
+    both = store.top_doc(metric="device_ms", window="both")
+    assert [r["shape"] for r in cur["shapes"]] == ["qs-new"]
+    assert [r["shape"] for r in prev["shapes"]] == ["qs-old"]
+    assert {r["shape"] for r in both["shapes"]} == {"qs-old", "qs-new"}
+    assert both["observations"] == 2
+    # a second rotation drops the oldest window entirely
+    clock[0] = 130.0
+    store.observe("qs-third", "t0", device_ms=1.0)
+    prev2 = store.top_doc(metric="device_ms", window="previous")
+    assert [r["shape"] for r in prev2["shapes"]] == ["qs-new"]
+
+
+# ---------------------------------------------------------------------------
+# cluster fan-in merge
+# ---------------------------------------------------------------------------
+
+def _node_doc(node, shapes):
+    """A per-node top_doc-shaped payload: shapes = {key: count}."""
+    return {"node": node, "metric": "count", "window_seconds": 60.0,
+            "observations": sum(shapes.values()),
+            "shapes": [
+                {"shape": k, "count": v, "latency_ms": v * 2.0,
+                 "cpu_ms": 0.0, "device_ms": float(v), "bytes": 0.0,
+                 "error": 0.0, "exemplar_trace_id": f"tr-{node}-{k}"}
+                for k, v in shapes.items()],
+            "tenants": []}
+
+
+def test_merge_top_docs_sums_then_limits():
+    """The shared shape (5 per node) must beat the per-node singletons
+    (8 and 7) after the merge — a concatenate-then-truncate merge
+    ranks it LAST; summing first ranks it FIRST."""
+    docs = [_node_doc("n0", {"qs-shared": 5, "qs-a": 8}),
+            _node_doc("n1", {"qs-shared": 5, "qs-b": 7})]
+    merged = qi.merge_top_docs(docs, limit=2, metric="count")
+    keys = [r["shape"] for r in merged["shapes"]]
+    assert keys == ["qs-shared", "qs-a"]
+    assert merged["shapes"][0]["count"] == 10
+    assert len(merged["shapes"]) == 2          # limit AFTER the merge
+    assert merged["observations"] == 25
+    assert sorted(merged["nodes"]) == ["n0", "n1"]
+
+
+def test_cluster_fan_in_merges_sketches(tmp_path):
+    """2-node regression: the front's /_insights/top_queries response
+    must merge per-node sketches and re-apply the request limit after
+    the merge — per-node stores are DISJOINT (keyed by node id), so a
+    concatenation would both double-count nothing and over-return."""
+    from elasticsearch_tpu.node.cluster_node import ClusterNode
+    base = 29940
+    peers = {f"if{i}": ("127.0.0.1", base + i) for i in range(2)}
+    nodes = [ClusterNode(f"if{i}", "127.0.0.1", base + i, peers,
+                         str(tmp_path / f"if{i}"), seed=i)
+             for i in range(2)]
+    try:
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if any(n.coordinator.mode == "LEADER" for n in nodes):
+                break
+            time.sleep(0.05)
+        plan = {"if0": {"qs-shared": 5, "qs-a": 8},
+                "if1": {"qs-shared": 5, "qs-b": 7}}
+        for node_id, shapes in plan.items():
+            store = qi.store_for(node_id)
+            for key, n in shapes.items():
+                for _ in range(n):
+                    store.observe(key, "tenant-x", latency_ms=1.0,
+                                  device_ms=1.0)
+        st, _ct, out = nodes[0].rest.handle(
+            "GET", "/_insights/top_queries", "limit=2&metric=count", b"")
+        assert st == 200
+        doc = json.loads(out)
+        assert doc.get("nodes_reporting") == 2
+        keys = [r["shape"] for r in doc["shapes"]]
+        assert keys == ["qs-shared", "qs-a"]     # summed, then ranked
+        assert doc["shapes"][0]["count"] == 10
+        assert len(doc["shapes"]) == 2           # limit after merge
+        # the tenant dimension merged too (5+8 and 5+7 observations)
+        trow = next(r for r in doc["tenants"]
+                    if r["tenant"] == "tenant-x")
+        assert trow["count"] == 25
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:   # noqa: BLE001
+                pass
+
+
+# ---------------------------------------------------------------------------
+# REST end-to-end: stamps + endpoint + trace filters + health
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def api():
+    from elasticsearch_tpu.node.indices_service import IndicesService
+    from elasticsearch_tpu.rest.api import RestAPI
+    api = RestAPI(IndicesService(tempfile.mkdtemp(prefix="qi_rest_")))
+    api.handle("PUT", "/logs", "", json.dumps({
+        "settings": {
+            "index.search.slowlog.threshold.query.trace": "0ms"},
+        "mappings": {"properties": {
+            "body": {"type": "text"}}}}).encode())
+    api.handle("PUT", "/logs/_doc/1", "refresh=true",
+               json.dumps({"body": "hello world"}).encode())
+    return api
+
+
+def _search(api, body, query="", headers=None):
+    st, _ct, out = api.handle("POST", "/logs/_search", query,
+                              json.dumps(body).encode(),
+                              headers or {})
+    assert st == 200, out
+    return json.loads(out)
+
+
+def test_rest_top_queries_and_shape_stamps(api):
+    body = {"query": {"match": {"body": "hello"}}}
+    for _ in range(3):
+        _search(api, body, headers={"X-Opaque-Id": "tenant-a"})
+    st, _ct, out = api.handle("GET", "/_insights/top_queries",
+                              "metric=count", b"")
+    assert st == 200
+    doc = json.loads(out)
+    assert doc["node"] == api.node_id
+    row = doc["shapes"][0]
+    assert row["shape"].startswith("qs-") and row["count"] == 3
+    assert row["latency_ms"] > 0
+    # verbatim sample body (the serving path folds in from/size
+    # defaults before the observation — the query itself is untouched)
+    assert row["sample"]["query"] == body["query"]
+    assert row.get("exemplar_trace_id")
+    assert doc["tenants"][0]["tenant"] == "tenant-a"
+
+    # the slow log and profile:true carry the SAME shape id
+    svc = api.indices.get("logs")
+    entries = [e for e in svc.slow_log if "shape" in e]
+    assert entries and entries[-1]["shape"] == row["shape"]
+    prof = _search(api, dict(body, profile=True))
+    shards = prof["profile"]["shards"][0]
+    assert shards["serving"]["shape"].startswith("qs-")
+
+    # bad metric -> 400, not a crash
+    st, _ct, out = api.handle("GET", "/_insights/top_queries",
+                              "metric=bogus", b"")
+    assert st == 400
+
+
+def test_rest_trace_min_ms_and_tenant_filters(api):
+    _search(api, {"query": {"match": {"body": "hello"}}},
+            headers={"X-Opaque-Id": "tenant-a"})
+    _search(api, {"query": {"match": {"body": "world"}}},
+            headers={"X-Opaque-Id": "tenant-b"})
+    st, _ct, out = api.handle("GET", "/_trace", "tenant=tenant-a", b"")
+    assert st == 200
+    rows = json.loads(out)["traces"]
+    assert rows and all(r["tenant"] == "tenant-a" for r in rows)
+    st, _ct, out = api.handle("GET", "/_trace", "min_ms=1e9", b"")
+    assert json.loads(out)["traces"] == []
+    # the filter runs BEFORE the size cap: size=1 still finds a
+    # tenant-a row even when newer tenant-b traces exist
+    st, _ct, out = api.handle("GET", "/_trace",
+                              "size=1&tenant=tenant-a", b"")
+    rows = json.loads(out)["traces"]
+    assert len(rows) == 1 and rows[0]["tenant"] == "tenant-a"
+    st, _ct, out = api.handle("GET", "/_trace", "min_ms=bogus", b"")
+    assert st == 400
+
+
+def test_health_indicator_dominance(api, monkeypatch):
+    monkeypatch.setenv("ES_TPU_INSIGHTS_MIN_OBS", "4")
+    store = qi.store_for(api.node_id)
+    for _ in range(8):
+        store.observe("qs-hog", "tenant-hog", device_ms=50.0,
+                      sample_body={"query": {"match_all": {}}})
+    store.observe("qs-small", "tenant-b", device_ms=1.0)
+    st, _ct, out = api.handle("GET", "/_health_report/query_insights",
+                              "", b"")
+    assert st == 200
+    ind = json.loads(out)["indicators"]["query_insights"]
+    assert ind["status"] == "yellow"
+    assert "qs-hog" in ind["symptom"]
+    diag = ind["diagnosis"][0]
+    assert diag["affected_resources"]["shape"] == ["qs-hog"]
+    assert diag["affected_resources"]["sample_body"] == {
+        "query": {"match_all": {}}}
+
+
+def test_task_ledger_carries_shapes(api):
+    """TaskResources.note_shape: bounded, first-seen order, surfaced
+    in to_dict for _tasks?detailed."""
+    from elasticsearch_tpu.node.task_manager import TaskResources
+    res = TaskResources()
+    for i in range(12):
+        res.note_shape(f"qs-{i % 10:03d}")
+    doc = res.to_dict()
+    assert doc["shapes"][:2] == ["qs-000", "qs-001"]
+    assert len(doc["shapes"]) <= TaskResources.SHAPES_MAX
+
+
+def test_insights_disabled_skips_observation(api, monkeypatch):
+    monkeypatch.setenv("ES_TPU_INSIGHTS", "0")
+    before = qi.store_for(api.node_id).top_doc()["observations"]
+    _search(api, {"query": {"match": {"body": "hello"}}})
+    after = qi.store_for(api.node_id).top_doc()["observations"]
+    assert after == before
