@@ -1615,6 +1615,226 @@ def bench_tiered_capacity(rng):
         "device_qps": device_win["qps"]})
 
 
+def bench_qos_overload(rng):
+    """Multi-tenant QoS under an abusive tenant (PR 19): one tenant
+    floods heavy bulk-class searches from 24 threads while 8 interactive
+    tenants keep issuing light point queries through the same
+    ``RestAPI.handle`` edge. Three windows:
+
+    - ``unloaded``: interactive tenants alone — the latency baseline.
+    - ``protected`` (QoS on, tight per-tenant budget): the abuser's
+      post-paid ledger charges drive its bucket into debt → 429s; a
+      signal pump feeds REAL batcher queue depth into the shed
+      hysteresis so engagement/clear ride actual pressure.
+    - ``unprotected`` (``ES_TPU_QOS=0``): same flood with admission
+      control off — the collapse the tentpole exists to prevent.
+
+    ``scripts/bench_diff.py`` gates the embedded ``qos`` dict:
+    interactive p99 protected ≤ 3× unloaded, shed engaged AND cleared
+    per the flight-recorder journal, zero steady-state compiles (the
+    priority class must never become a jit shape key)."""
+    import tempfile
+    import threading
+    from elasticsearch_tpu.common import flightrec as _fr
+    from elasticsearch_tpu.common import qos as _qos
+    from elasticsearch_tpu.common import telemetry as _tm
+    from elasticsearch_tpu.node.indices_service import IndicesService
+    from elasticsearch_tpu.rest.api import RestAPI
+    api = RestAPI(IndicesService(tempfile.mkdtemp(prefix="bench_qos_")))
+    vocab = [f"w{i}" for i in range(64)]
+    n_docs, lines = 2048, []
+    for i in range(n_docs):
+        body = " ".join(vocab[(i * 7 + j * 3) % 64] for j in range(8))
+        lines.append(json.dumps({"index": {"_id": str(i)}}))
+        lines.append(json.dumps({"body": body}))
+    api.handle("POST", "/qos/_bulk", "refresh=true",
+               ("\n".join(lines) + "\n").encode())
+    svc = api.indices.get("qos")
+
+    n_interactive, n_abuser = 8, 24
+    lock = threading.Lock()
+
+    def _queue_depth() -> int:
+        depth = 0
+        for gen in getattr(svc.plane_cache, "_planes", {}).values():
+            b = getattr(gen, "_microbatcher", None)
+            if b is not None:
+                depth += sum(b.queue_depth_by_class().values())
+        return depth
+
+    def interactive_client(tid, per, lat, outcomes):
+        tenant = f"int-{tid}"
+        for j in range(per):
+            q = {"query": {"match": {
+                "body": vocab[(tid * per + j) % 64]}}}
+            t0 = time.perf_counter()
+            st, _ct, _payload = api.handle(
+                "POST", "/qos/_search", "request_cache=false",
+                json.dumps(q).encode(),
+                headers={"X-Opaque-Id": tenant})
+            dt = time.perf_counter() - t0
+            with lock:
+                outcomes[st] = outcomes.get(st, 0) + 1
+                if st == 200:
+                    lat.append(dt)
+
+    def abuser_client(tid, stop_evt, outcomes):
+        # heavy bulk-class flood until told to stop: disjunction over 12
+        # terms, explicit priority override so the batcher's
+        # weighted-deficit picker and the shed verdict both see the bulk
+        # class; a 429 backs off briefly (a real client would honor
+        # Retry-After — hammering with zero sleep would measure spin
+        # contention, not admission control)
+        j = 0
+        while not stop_evt.is_set():
+            j += 1
+            q = {"query": {"bool": {"should": [
+                {"match": {"body": vocab[(tid + j + s) % 64]}}
+                for s in range(12)]}}}
+            st, _ct, _payload = api.handle(
+                "POST", "/qos/_search", "request_cache=false",
+                json.dumps(q).encode(),
+                headers={"X-Opaque-Id": "abuser",
+                         "x-es-priority": "bulk"})
+            with lock:
+                outcomes[st] = outcomes.get(st, 0) + 1
+            if st == 429:
+                time.sleep(0.02)
+
+    def run_window(per_interactive, flood=False, pump=False,
+                   wait_debt=False):
+        lat, int_out, ab_out = [], {}, {}
+        stop_pump, stop_flood = threading.Event(), threading.Event()
+
+        def signal_pump():
+            ctl = _qos.controller()
+            while not stop_pump.is_set():
+                ctl.note_signals(queue_depth=_queue_depth())
+                time.sleep(0.001)
+
+        pump_t = None
+        if pump:
+            pump_t = threading.Thread(target=signal_pump, daemon=True)
+            pump_t.start()
+        ab_threads = [threading.Thread(target=abuser_client,
+                                       args=(t, stop_flood, ab_out))
+                      for t in range(n_abuser)] if flood else []
+        for t in ab_threads:
+            t.start()
+        if wait_debt:
+            # untimed flood preamble: wait for the abuser's post-paid
+            # ledger charges to drive its bucket into debt, so the timed
+            # interactive window measures STEADY-STATE protection (the
+            # burst the bucket legitimately admits is not "overload");
+            # the pump meanwhile sees the pre-debt queue pressure
+            ctl = _qos.controller()
+            deadline = time.perf_counter() + 10.0
+            while ctl.tokens("abuser") >= 0.0 \
+                    and time.perf_counter() < deadline:
+                time.sleep(0.002)
+        threads = [threading.Thread(target=interactive_client,
+                                    args=(t, per_interactive, lat,
+                                          int_out))
+                   for t in range(n_interactive)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        stop_flood.set()
+        for t in ab_threads:
+            t.join()
+        if pump_t is not None:
+            # flood is over: let the pump observe the drained queue so
+            # the clear transition lands in the journal, then stop it
+            time.sleep(0.05)
+            stop_pump.set()
+            pump_t.join(timeout=1.0)
+        a = np.asarray(lat) if lat else np.asarray([0.0])
+        return {"interactive_qps": round(len(lat) / wall, 1),
+                "p50_ms": round(float(np.percentile(a, 50) * 1e3), 2),
+                "p99_ms": round(float(np.percentile(a, 99) * 1e3), 2),
+                "interactive_by_status": dict(sorted(int_out.items())),
+                "abuser_by_status": dict(sorted(ab_out.items()))}
+
+    # per-tenant budget sized so burst alone covers one interactive
+    # tenant's whole window (~500 cost units) — interactive tenants
+    # never throttle — while the abuser's ACTUAL ledger charges
+    # (cpu-ms + weighted device-ms, post-paid at task completion) blow
+    # through burst during the flood preamble; the small refill keeps
+    # the post-debt abuser to a trickle so re-admission bursts (and the
+    # severe-shed oscillation they cause) stay rare; shed threshold low
+    # enough that real queue pressure from the pre-debt burst trips it
+    knobs = {"ES_TPU_QOS_REFILL_PER_S": "60",
+             "ES_TPU_QOS_BURST": "800",
+             "ES_TPU_QOS_SHED_QUEUE_DEPTH": "4",
+             "ES_TPU_QOS_RETRY_AFTER_S": "0.05"}
+    prev = {k: os.environ.get(k) for k in list(knobs) + ["ES_TPU_QOS"]}
+    try:
+        os.environ.update(knobs)
+        # warm round with the EXACT timed mix (both tenants, both
+        # priority classes, same concurrency) and QoS OFF so the
+        # unthrottled flood compiles every pow2 batch bucket both query
+        # shapes can produce — any compile after this is a shape leak
+        os.environ["ES_TPU_QOS"] = "0"
+        run_window(4, flood=True)
+
+        os.environ["ES_TPU_QOS"] = "1"
+        _qos.reset_controller()
+        compiles0 = _tm.compile_count()
+        unloaded = run_window(24)
+
+        _qos.reset_controller()
+        evs = _fr.DEFAULT.events(type_="qos_shed", limit=0)
+        seq0 = evs[-1]["seq"] if evs else 0
+        protected = run_window(24, flood=True, pump=True,
+                               wait_debt=True)
+        ctl_doc = _qos.controller().status_doc()
+        evs = [e for e in _fr.DEFAULT.events(type_="qos_shed", limit=0)
+               if e["seq"] > seq0]
+        transitions = [e["attrs"].get("transition") for e in evs
+                       if "transition" in e["attrs"]]
+
+        os.environ["ES_TPU_QOS"] = "0"
+        _qos.reset_controller()
+        unprotected = run_window(24, flood=True)
+        steady_compiles = _tm.compile_count() - compiles0
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _qos.reset_controller()
+    api.indices.close()
+
+    ratio = protected["p99_ms"] / max(unloaded["p99_ms"], 1e-9)
+    return _emit("qos_overload", {
+        "value": protected["interactive_qps"], "unit": "requests/s",
+        "p99_ms": protected["p99_ms"],
+        "n_interactive_clients": n_interactive,
+        "n_abuser_clients": n_abuser,
+        "unloaded": unloaded,
+        "protected": protected,
+        "unprotected": unprotected,
+        "qos": {
+            "interactive_p99_unloaded_ms": unloaded["p99_ms"],
+            "interactive_p99_protected_ms": protected["p99_ms"],
+            "interactive_p99_unprotected_ms": unprotected["p99_ms"],
+            "protected_over_unloaded": round(ratio, 3),
+            "shed_engaged": "engage" in transitions,
+            "shed_cleared": transitions[-1] == "clear"
+            if transitions else False,
+            "engagements": ctl_doc["engagements"],
+            "cleared_total": ctl_doc["cleared_total"],
+            "sheds_total": ctl_doc["sheds_total"],
+            "throttled_total": ctl_doc["throttled_total"],
+            "admitted_total": ctl_doc["admitted_total"],
+            "steady_compiles": int(steady_compiles),
+        }})
+
+
 def workload_L(plane, batches, Q=None):
     """One compile shape per config, sized to the WORKLOAD's largest
     sparse posting run instead of the table-wide L_cap — the merge cost
@@ -1820,6 +2040,7 @@ def main(mode: str = "accel"):
     run("serving", bench_serving, rng)
     run("live_indexing", bench_live_indexing, rng)
     run("tiered_capacity", bench_tiered_capacity, rng)
+    run("qos_overload", bench_qos_overload, rng)
 
     if not need_plane:
         # filtered run without the headline: promote the first selected

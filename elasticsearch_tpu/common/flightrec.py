@@ -547,10 +547,19 @@ class Watchdog:
                  registry=None,
                  interval_s: Optional[float] = None,
                  capture_cap: Optional[int] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 qos_controller=None):
         self.recorder = recorder or DEFAULT
         self.engine = engine or ENGINE
         self._registry = registry
+        #: QoS controller to push overload signals to each tick (queue
+        #: depth, burn status, breaker fraction → common/qos.py shed
+        #: hysteresis). Injected, NOT defaulted to the process
+        #: controller: test/lint watchdogs that drive synthetic RED
+        #: burns must not engage shedding for every other test in the
+        #: process — only ensure_watchdog's serving singleton (and
+        #: benches that opt in) feed the real controller.
+        self.qos_controller = qos_controller
         # default tick 5s: the windows are ~1m/~10m, so 5s still
         # samples the fast window 12x while keeping the always-on
         # thread near-inert (benches with second-scale windows set
@@ -662,7 +671,17 @@ class Watchdog:
                 slow_burn=rates["slow"]["burn"])
             if status == RED:
                 self.capture("slo_red", rates=rates)
-        self._sample_batcher_queues()
+        total_depth = self._sample_batcher_queues()
+        if self.qos_controller is not None:
+            # push this tick's overload evidence into the QoS shed
+            # hysteresis — the edge then reads O(1) state per request
+            # instead of walking batchers itself
+            try:
+                self.qos_controller.note_signals(
+                    queue_depth=total_depth, burn_status=status,
+                    breaker_fraction=self._breaker_fraction())
+            except Exception:   # noqa: BLE001 — QoS must not kill the
+                pass            # tick that feeds it
         # the same tick feeds the downsampling history ring — one poll
         # cadence for every windowed consumer (lazy import: history is
         # optional for watchdog-less embedders)
@@ -670,33 +689,55 @@ class Watchdog:
         _mh.record_tick()
         return status
 
-    def _sample_batcher_queues(self) -> None:
-        """Periodic ``es_batcher_queue_depth{index,kind}`` gauges —
-        queue depth was only visible inside watchdog CAPTURES before;
+    def _sample_batcher_queues(self) -> int:
+        """Periodic ``es_batcher_queue_depth{index,kind,class}`` gauges
+        — queue depth was only visible inside watchdog CAPTURES before;
         sampling it on the existing tick makes the convoy signal a
         scrapeable time series with no new thread. Depths sum per
-        (index, kind) over a cache's live generations (several
-        generations of one index share the serving load)."""
+        (index, kind, priority class) over a cache's live generations
+        (several generations of one index share the serving load).
+        Returns the TOTAL depth across all series — the QoS shed
+        signal."""
         reg = self._reg()
         depths: Dict[tuple, int] = {}
         for d in self._batcher_queues():
-            key = (d.get("index"), d.get("kind", "text"))
-            depths[key] = depths.get(key, 0) + int(d.get("depth", 0))
+            by_class = d.get("by_class") or {"interactive":
+                                             int(d.get("depth", 0))}
+            for cls, n in by_class.items():
+                key = (d.get("index"), d.get("kind", "text"), str(cls))
+                depths[key] = depths.get(key, 0) + int(n)
+        total = sum(depths.values())
         # series whose batcher disappeared (index deleted, cache torn
         # down) zero out instead of freezing at their last sampled
         # depth — a stale nonzero depth would alert forever on a
         # nonexistent index (zeroed once; dropped from tracking after)
         live = set(depths)
         prev = getattr(self, "_queue_depth_keys", set())
-        for index, kind in prev - live:
-            depths[(index, kind)] = 0
+        for index, kind, cls in prev - live:
+            depths[(index, kind, cls)] = 0
         self._queue_depth_keys = live
-        for (index, kind), depth in depths.items():
+        for (index, kind, cls), depth in depths.items():
             reg.gauge(
                 "es_batcher_queue_depth",
-                {"index": str(index), "kind": str(kind)},
-                help="micro-batcher slots waiting for a dispatch, "
-                     "sampled per watchdog tick").set(depth)
+                {"index": str(index), "kind": str(kind),
+                 "class": str(cls)},
+                help="micro-batcher slots waiting for a dispatch by "
+                     "priority class, sampled per watchdog "
+                     "tick").set(depth)
+        return total
+
+    @staticmethod
+    def _breaker_fraction() -> float:
+        """Parent-breaker memory pressure as a 0..1 fraction (the third
+        QoS shed signal, next to queue depth and burn status)."""
+        try:
+            from .breakers import DEFAULT as _brk
+            limit = float(_brk.parent.limit)
+            if limit <= 0:
+                return 0.0
+            return float(_brk.parent.total_used()) / limit
+        except Exception:   # noqa: BLE001 — breaker-less embedder
+            return 0.0
 
     # -- captures -----------------------------------------------------------
 
@@ -745,12 +786,20 @@ class Watchdog:
             try:
                 for name, svc in list(api.indices.indices.items()):
                     for b in svc.plane_cache.serving_batchers():
-                        out.append({
+                        doc = {
                             "node": api.node_id, "index": name,
                             "plane": type(b.plane).__name__,
                             "kind": getattr(b, "kind", "text"),
                             "depth": b.queue_depth(),
-                            "dispatches": b.n_dispatches})
+                            "dispatches": b.n_dispatches}
+                        by_cls = getattr(b, "queue_depth_by_class",
+                                         None)
+                        if by_cls is not None:
+                            # per-priority-class split (foreign
+                            # batchers without it fold into
+                            # class="interactive" at sampling)
+                            doc["by_class"] = by_cls()
+                        out.append(doc)
             except Exception:   # noqa: BLE001 — a mid-teardown node
                 continue        # contributes nothing
         return out
@@ -796,7 +845,14 @@ def ensure_watchdog() -> Optional[Watchdog]:
     global _WATCHDOG
     with _WATCHDOG_LOCK:
         if _WATCHDOG is None:
-            _WATCHDOG = Watchdog()
+            # the serving singleton feeds the process QoS controller
+            # (test-constructed Watchdogs don't — see __init__)
+            try:
+                from . import qos as _qos
+                ctl = _qos.controller()
+            except Exception:   # noqa: BLE001
+                ctl = None
+            _WATCHDOG = Watchdog(qos_controller=ctl)
             _WATCHDOG.start()
         return _WATCHDOG
 

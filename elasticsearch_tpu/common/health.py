@@ -76,7 +76,7 @@ class HealthService:
     INDICATORS = ("shards_availability", "plane_serving", "plane_tiers",
                   "compile_churn", "breakers", "indexing_pressure",
                   "task_backlog", "slo_burn", "dispatch_efficiency",
-                  "query_insights")
+                  "query_insights", "qos")
 
     #: sync non-cold rebuilds: first one turns yellow, a storm turns red
     SYNC_REBUILD_YELLOW = 1
@@ -649,6 +649,68 @@ class HealthService:
             "the offending request, or isolate the tenant.",
             affected)]
         return doc
+
+    def _ind_qos(self) -> dict:
+        """Multi-tenant QoS (``common/qos.py``): green while the edge
+        admits everything, yellow while load shedding is engaged (the
+        cluster is deliberately bouncing non-interactive traffic with
+        429s), red when shedding has stayed engaged past
+        ``qos.shed.sustained_seconds`` — sustained shedding means the
+        overload is not draining and interactive traffic is next. The
+        diagnosis names the dominant shed tenant so the abusive
+        workload is actionable, and the trigger evidence (queue depth,
+        breaker fraction, SLO burn) rides in the details — the same
+        evidence each ``qos_shed`` flight-recorder event carries."""
+        from . import qos as _qos
+        doc = _qos.controller().status_doc()
+        details = {"qos": doc}
+        if not doc.get("enabled", True):
+            return {"status": GREEN,
+                    "symptom": "QoS admission control is disabled "
+                               "(ES_TPU_QOS=0).",
+                    "details": details}
+        if not doc.get("engaged"):
+            return {"status": GREEN,
+                    "symptom": "No load shedding: all tenants within "
+                               "their token budgets.",
+                    "details": details}
+        sheds = doc.get("sheds_by_tenant") or {}
+        top_tenant = max(sheds, key=lambda t: sheds[t]) if sheds else None
+        sustained = bool(doc.get("sustained"))
+        engaged_for = doc.get("engaged_for_s", 0.0)
+        status = RED if sustained else YELLOW
+        severity = 1 if sustained else 2
+        out = {
+            "status": status,
+            "symptom": (f"Load shedding has been engaged for "
+                        f"{engaged_for}s"
+                        + (" (sustained past the "
+                           "qos.shed.sustained_seconds bound)."
+                           if sustained else ".")),
+            "details": details,
+            "impacts": [_impact(
+                "qos:shedding", severity,
+                "The REST edge is rejecting bulk/analytics traffic "
+                "with 429s to protect interactive latency"
+                + ("; sustained shedding means the overload is not "
+                   "draining and interactive requests shed next."
+                   if sustained else "."),
+                ["search", "ingest"])],
+        }
+        affected = {"tenants": [top_tenant] if top_tenant else []}
+        cause = (f"Overload signals tripped the shed state machine: "
+                 f"{doc.get('signals')}.")
+        if top_tenant is not None:
+            cause += (f" Tenant [{top_tenant}] absorbed the most sheds "
+                      f"({sheds[top_tenant]}).")
+        out["diagnosis"] = [_diagnosis(
+            "qos:shedding", cause,
+            "Inspect GET /_flight_recorder?type=qos_shed for the "
+            "engage evidence and GET /_insights/top_queries for the "
+            "shed-heavy shapes; throttle the dominant tenant "
+            "(qos.tenant.refill_per_s) or raise capacity.",
+            affected)]
+        return out
 
     def _ind_dispatch_efficiency(self) -> dict:
         """Continuous roofline audit (``common/roofline.py``): every

@@ -240,6 +240,26 @@ def runtime_families() -> Set[str]:
         api.handle("GET", "/_telemetry/history",
                    "family=es_query_latency_ms&window=raw&rate=true",
                    None)
+        # multi-tenant QoS round: the searches above were all ADMITTED
+        # (es_qos_admitted_total / es_qos_tokens); drive both rejection
+        # paths too — charge the lint tenant into token debt so its
+        # next request throttles 429, then trip the shed state machine
+        # so an analytics-class request sheds 429 — and reset the
+        # process controller so the synthetic debt/engagement cannot
+        # leak into other suites sharing this process
+        from elasticsearch_tpu.common import qos as _qos
+        ctl = _qos.controller()
+        ctl.charge("lint-tenant", cpu_ms=0.0, device_ms=1e9, bytes_=0)
+        api.handle("POST", "/lint/_search", "", json.dumps(
+            {"query": {"match": {"body": "quick"}}}).encode(),
+            headers={"X-Opaque-Id": "lint-tenant"})
+        ctl.note_signals(queue_depth=10 ** 6, burn_status="red",
+                         breaker_fraction=1.0)
+        api.handle("POST", "/lint/_search", "", json.dumps(
+            {"query": {"match": {"body": "quick"}},
+             "size": 0}).encode(),
+            headers={"X-Opaque-Id": "lint-shed-tenant"})
+        _qos.reset_controller()
 
         snap = telemetry.DEFAULT.stats_doc()
         return {name for name in snap if name.startswith("es_")}

@@ -283,6 +283,19 @@ class TaskManager:
         if tenant:
             self._fold_tenant(str(tenant), task, vals,
                               time.time() - task.start_time)
+            # QoS charge point: the tenant's token bucket pays for the
+            # task's ACTUAL cpu-ms / device-ms / transfer bytes (post-
+            # paid — debt blocks the tenant's next admission), not a
+            # flat per-request cost
+            try:
+                from ..common import qos as _qos
+                _qos.controller().charge(
+                    str(tenant), cpu_ms=vals.get("cpu_ms", 0.0),
+                    device_ms=vals.get("device_ms", 0.0),
+                    bytes_=vals.get("h2d_bytes", 0)
+                    + vals.get("d2h_bytes", 0))
+            except Exception:   # noqa: BLE001 — QoS must not fail
+                pass            # task teardown
         if not any(vals.values()):
             return
         with self._res_lock:

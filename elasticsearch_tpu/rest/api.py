@@ -360,6 +360,10 @@ class RestAPI:
         #: handle() echoes them as response headers (reference:
         #: X-Opaque-Id echo + APM trace.id)
         self._trace_tls = threading.local()
+        #: extra response headers an error on this thread wants promoted
+        #: to the wire (QoS 429 Retry-After, security WWW-Authenticate)
+        #: — handle() merges them into resp_headers after dispatch
+        self._extra_hdr_tls = threading.local()
         # node-scoped telemetry producers register against the process
         # registry via weakref (pruned when this API is collected):
         # plane serving rollup, running tasks, adaptive selection
@@ -888,6 +892,7 @@ class RestAPI:
         opaque id is echoed on every response; the trace id is the
         ``GET /_trace/{id}`` handle)."""
         self._trace_tls.value = None
+        self._extra_hdr_tls.value = None
         accept = None
         if headers:
             hmap = {k.lower(): v for k, v in headers.items()}
@@ -908,6 +913,12 @@ class RestAPI:
         status, out_ct, payload = self._handle_json(
             method, path, query, body, headers)
         self._stamp_trace_echo(resp_headers, headers)
+        # error-declared response headers (QoS Retry-After, security
+        # WWW-Authenticate) reach the wire, not just the error body
+        extra = getattr(self._extra_hdr_tls, "value", None)
+        if resp_headers is not None and extra:
+            for k, v in extra.items():
+                resp_headers.setdefault(k, v)
         if accept and payload:
             from ..common.xcontent import (UnsupportedContentType,
                                            encode_response)
@@ -944,6 +955,47 @@ class RestAPI:
         if opaque:
             resp_headers["X-Opaque-Id"] = opaque
 
+    def _error_response(self, e: Exception) -> Tuple[int, str, bytes]:
+        """ES-shaped error body; ``header`` metadata on the error
+        (Retry-After, WWW-Authenticate) is additionally stashed for
+        promotion to REAL response headers by :meth:`handle`."""
+        status, payload = _error_payload(e)
+        hdr = payload.get("error", {}).get("header")
+        if hdr:
+            stash = getattr(self._extra_hdr_tls, "value", None) or {}
+            for k, v in hdr.items():
+                stash[str(k)] = v[0] if isinstance(v, (list, tuple)) \
+                    and v else v
+            self._extra_hdr_tls.value = stash
+        return status, JSON_CT, json.dumps(payload).encode()
+
+    @staticmethod
+    def _qos_body(body) -> Optional[dict]:
+        """Best-effort parse of the request body for QoS priority
+        classification (aggs / size:0 → analytics). NDJSON (bulk) and
+        junk parse to None — those classify from the action alone."""
+        if not body or not isinstance(body, (bytes, bytearray, str)):
+            return None
+        try:
+            doc = json.loads(body)
+            return doc if isinstance(doc, dict) else None
+        except Exception:   # noqa: BLE001 — classification is advisory
+            return None
+
+    def _note_shed(self, body: Optional[dict], tenant, trace_id) -> None:
+        """Fold one rejected (429) request into the query-insight
+        sketches so a throttled tenant's rows distinguish served from
+        shed traffic."""
+        try:
+            from ..search import query_insight as _qi
+            if not _qi.insights_enabled():
+                return
+            _qi.store_for(self.node_id).observe(
+                _qi.shape_of(body), tenant, shed=1.0,
+                trace_id=trace_id, sample_body=body)
+        except Exception:   # noqa: BLE001 — insight must not fail
+            pass            # the rejection path either
+
     def _handle_json(self, method: str, path: str, query: str,
                      body: bytes,
                      headers: Optional[dict] = None) \
@@ -967,8 +1019,7 @@ class RestAPI:
                     self.security.rbac.authorize(
                         self._principal_tls.value, method, path)
             except Exception as e:   # noqa: BLE001 — 401/403 ES body
-                status, payload = _error_payload(e)
-                return status, JSON_CT, json.dumps(payload).encode()
+                return self._error_response(e)
         if not getattr(self._internal_tls, "active", False):
             # fresh warning scope per EXTERNAL request only — internal
             # re-dispatches (SQL/transform/ML seams) keep accumulating
@@ -1027,6 +1078,39 @@ class RestAPI:
                     if opaque:
                         task_headers["X-Opaque-Id"] = opaque
                     self._trace_tls.value = (sp.trace_id, opaque)
+                    # QoS edge: classify + admission-check data-path
+                    # actions INSIDE the span (the 429 carries the
+                    # trace id; the journal event inherits the ambient
+                    # trace) but BEFORE task registration — a shed
+                    # request must cost O(1)
+                    _pri_token = None
+                    if action.startswith("indices:data/"):
+                        from ..common import qos as _qos
+                        if _qos.qos_enabled():
+                            override = hmap2.get("x-es-priority")
+                            qbody = None
+                            if not override and \
+                                    action.startswith("indices:data/read"):
+                                qbody = self._qos_body(body)
+                            pri = _qos.classify(action=action,
+                                                body=qbody,
+                                                override=override)
+                            decision = _qos.controller().admit(
+                                tenant=opaque, priority=pri,
+                                action=action)
+                            if not decision.allowed:
+                                sp.attrs["error"] = "QosRejectedError"
+                                self._note_shed(qbody, opaque,
+                                                sp.trace_id)
+                                what = ("request throttled: tenant "
+                                        "token bucket in debt"
+                                        if decision.kind == "throttle"
+                                        else "request shed: cluster "
+                                        "overloaded")
+                                return self._error_response(
+                                    _qos.QosRejectedError(
+                                        what, decision, tenant=opaque))
+                            _pri_token = _qos.bind_priority(pri)
                     task = self.task_manager.register(
                         action,
                         description=desc + f" [trace.id={sp.trace_id}]",
@@ -1049,10 +1133,11 @@ class RestAPI:
                         result = fn(params, body, **kwargs)
                     except Exception as e:  # noqa: BLE001 — ES-shaped
                         sp.attrs["error"] = type(e).__name__
-                        status, payload = _error_payload(e)
-                        return status, JSON_CT, \
-                            json.dumps(payload).encode()
+                        return self._error_response(e)
                     finally:
+                        if _pri_token is not None:
+                            from ..common import qos as _qos
+                            _qos.unbind_priority(_pri_token)
                         task.resources.cpu_release()
                         _flightrec.reset_ambient(_fr_token)
                         unbind_resources(_res_token)
@@ -1817,6 +1902,15 @@ class RestAPI:
             # wins over persistent, env overrides win over both)
             from ..common import flightrec as _flightrec
             _flightrec.apply_cluster_settings({
+                **self.cluster_settings["persistent"],
+                **self.cluster_settings["transient"]})
+        if any(k.startswith("qos.")
+               for scope in ("persistent", "transient")
+               for k in (b0.get(scope) or {})):
+            # dynamic QoS knobs (tenant refill/burst, shed thresholds)
+            # re-resolve live, same overlay precedence as slo.*
+            from ..common import qos as _qos
+            _qos.apply_cluster_settings({
                 **self.cluster_settings["persistent"],
                 **self.cluster_settings["transient"]})
         return {"acknowledged": True,

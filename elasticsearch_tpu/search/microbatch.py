@@ -25,6 +25,16 @@ slots; when the queue runs deeper than one full batch it coalesces
 across buckets at the max-k shape instead (one bigger dispatch beats two
 half-empty ones); and any slot skipped STARVATION_ROUNDS times forces
 its own bucket next, so no bucket waits unboundedly behind a popular one.
+Under multi-tenant contention the pick is **priority-weighted**
+(``common/qos.py`` classes: interactive / bulk / analytics): each
+queued class accrues deficit by its weight every round and the
+highest-deficit class seeds the bucket choice, so interactive point
+queries win most rounds while bulk/analytics still drain — and co-batch
+into interactive dispatches whenever they share the dispatch shape. The
+class is a SELECTION key only, never part of the bucket/jit shape key,
+so the compile lattice is untouched; the per-slot STARVATION_ROUNDS
+bound applies to every slot regardless of class, which bounds each
+class's wait independently.
 
 Observability: every request is stamped with per-stage timings — queue
 wait, host prep, device dispatch, result fetch — aggregated per batcher
@@ -52,6 +62,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common import qos as _qos
 from ..common import racedep
 
 #: upper bound on queries per dispatch — past this the dispatch itself is
@@ -86,7 +97,7 @@ class _Slot:
     __slots__ = ("terms", "k", "done", "vals", "hits", "total", "aggs",
                  "error", "t_enq", "rounds_skipped", "stage_ms", "info",
                  "view_segments", "view_key", "params", "trace_id",
-                 "node", "shape")
+                 "node", "shape", "priority")
 
     def __init__(self, terms, k: int, view=None, params=None):
         self.terms = terms
@@ -105,6 +116,11 @@ class _Slot:
         #: /_insights/top_queries by it) — captured here for the same
         #: reason as trace_id
         self.shape = _fr.current_shape()
+        #: the request's QoS priority class (interactive/bulk/analytics)
+        #: — bound by the REST edge, captured on the request thread; a
+        #: SELECTION key for the weighted-deficit pick, never part of
+        #: the dispatch/jit shape
+        self.priority = _qos.current_priority()
         #: extra dispatch parameters that shape the kernel (kNN IVF:
         #: bucketed (nprobe, rerank)) — co-batching only within one
         #: params tuple, so the compile-shape lattice stays warm
@@ -162,6 +178,9 @@ class PlaneMicroBatcher:
         self._cond = threading.Condition(_lock)
         self._work = threading.Condition(_lock)
         self._queue: List[_Slot] = []
+        #: priority class -> accrued weighted deficit (mutated only
+        #: under the lock inside _take_batch_locked)
+        self._deficit: Dict[str, float] = {}
         self._dispatchers: List[threading.Thread] = []
         self._warmup_thread: Optional[threading.Thread] = None
         # observability (nodes stats / serving bench) — mutated ONLY under
@@ -279,16 +298,44 @@ class PlaneMicroBatcher:
         coordinate space."""
         return (self._k_bucket(s.k), s.view_key, s.params)
 
+    def _pick_class_locked(self, q: List[_Slot]) -> List[_Slot]:
+        """Weighted-deficit class selection (caller holds the lock):
+        every class with queued slots accrues deficit by its QoS weight
+        each round; the highest-deficit class's slots seed the bucket
+        choice and its deficit resets. The batch itself still takes
+        EVERY queued slot sharing the chosen dispatch shape — bulk /
+        analytics co-batch behind interactive for free — and the class
+        never enters the bucket key, so the compile lattice is
+        untouched. Classes with nothing queued drop their banked
+        deficit (no unbounded credit)."""
+        by_class: Dict[str, List[_Slot]] = {}
+        for s in q:
+            by_class.setdefault(s.priority, []).append(s)
+        if len(by_class) == 1:
+            return q
+        for c in by_class:
+            self._deficit[c] = self._deficit.get(c, 0.0) \
+                + _qos.priority_weight(c)
+        for c in list(self._deficit):
+            if c not in by_class:
+                self._deficit.pop(c)
+        win = max(by_class, key=lambda c: (self._deficit.get(c, 0.0), c))
+        self._deficit[win] = 0.0
+        return by_class[win]
+
     def _take_batch_locked(self) -> List[_Slot]:
         """Pick the next batch (caller holds the lock; queue non-empty).
 
         Priority: (1) any slot skipped STARVATION_ROUNDS times gets its
         bucket dispatched now — a queued slot whose bucket never matches
         the popular one is still served within a bounded number of
-        rounds; (2) a queue deeper than one full batch coalesces across
-        k-buckets (within one view) at the max-k shape; (3) otherwise
-        the largest ready bucket goes (ties resolve to the oldest
-        slot's bucket)."""
+        rounds, whatever its class; otherwise the weighted-deficit
+        class pick (:meth:`_pick_class_locked`) chooses whose slots
+        seed the shape, then (2) a queue deeper than one full batch
+        coalesces across k-buckets (within one view) at the max-k
+        shape; (3) otherwise the largest ready bucket goes (ties
+        resolve to the oldest slot's bucket). Steps 2–3 take matching
+        slots from the WHOLE queue, not just the winning class."""
         q = self._queue
         starved = next((s for s in q
                         if s.rounds_skipped >= self.STARVATION_ROUNDS), None)
@@ -297,31 +344,34 @@ class PlaneMicroBatcher:
             batch = [s for s in q
                      if self._bucket_key(s) == bk][: self.max_batch]
             self.n_starved_dispatches += 1
-        elif len(q) > self.max_batch:
-            # coalesce across k-buckets but never across views (a view
-            # boundary is a refresh boundary — coordinates differ) or
-            # params (different kernel knobs = different compile shape)
-            vcounts: Dict = {}
-            for s in q:
-                vp = (s.view_key, s.params)
-                vcounts[vp] = vcounts.get(vp, 0) + 1
-            vbest = max(vcounts.values())
-            vk = next((s.view_key, s.params) for s in q
-                      if vcounts[(s.view_key, s.params)] == vbest)
-            batch = [s for s in q
-                     if (s.view_key, s.params) == vk][: self.max_batch]
-            if len({self._k_bucket(s.k) for s in batch}) > 1:
-                self.n_coalesced_dispatches += 1
         else:
-            counts: Dict = {}
-            for s in q:
-                bk = self._bucket_key(s)
-                counts[bk] = counts.get(bk, 0) + 1
-            best = max(counts.values())
-            bk = next(self._bucket_key(s) for s in q
-                      if counts[self._bucket_key(s)] == best)
-            batch = [s for s in q
-                     if self._bucket_key(s) == bk][: self.max_batch]
+            pool = self._pick_class_locked(q)
+            if len(q) > self.max_batch:
+                # coalesce across k-buckets but never across views (a
+                # view boundary is a refresh boundary — coordinates
+                # differ) or params (different kernel knobs = different
+                # compile shape)
+                vcounts: Dict = {}
+                for s in pool:
+                    vp = (s.view_key, s.params)
+                    vcounts[vp] = vcounts.get(vp, 0) + 1
+                vbest = max(vcounts.values())
+                vk = next((s.view_key, s.params) for s in pool
+                          if vcounts[(s.view_key, s.params)] == vbest)
+                batch = [s for s in q
+                         if (s.view_key, s.params) == vk][: self.max_batch]
+                if len({self._k_bucket(s.k) for s in batch}) > 1:
+                    self.n_coalesced_dispatches += 1
+            else:
+                counts: Dict = {}
+                for s in pool:
+                    bk = self._bucket_key(s)
+                    counts[bk] = counts.get(bk, 0) + 1
+                best = max(counts.values())
+                bk = next(self._bucket_key(s) for s in pool
+                          if counts[self._bucket_key(s)] == best)
+                batch = [s for s in q
+                         if self._bucket_key(s) == bk][: self.max_batch]
         taken = set(map(id, batch))
         self._queue = [s for s in q if id(s) not in taken]
         for s in self._queue:
@@ -675,6 +725,16 @@ class PlaneMicroBatcher:
         the convoy)."""
         with self._cond:
             return len(self._queue)
+
+    def queue_depth_by_class(self) -> Dict[str, int]:
+        """Queued slots per QoS priority class — the watchdog samples
+        this into ``es_batcher_queue_depth{index,kind,class}`` so a
+        convoy is attributable to the class causing it."""
+        with self._cond:
+            out: Dict[str, int] = {}
+            for s in self._queue:
+                out[s.priority] = out.get(s.priority, 0) + 1
+            return out
 
     def stats_doc(self) -> Dict[str, int]:
         """Aggregate serving stats (nodes stats ``plane_serving``)."""

@@ -53,8 +53,13 @@ __all__ = [
     "insights_enabled", "METRICS",
 ]
 
-#: the five tracked cost metrics (one sketch each, per dimension)
-METRICS = ("count", "latency_ms", "cpu_ms", "device_ms", "bytes")
+#: the tracked cost metrics (one sketch each, per dimension); ``count``
+#: covers all observed traffic, ``shed`` the QoS-rejected subset, so
+#: served = count - shed per shape/tenant
+METRICS = ("count", "latency_ms", "cpu_ms", "device_ms", "bytes", "shed")
+
+#: metrics formatted as integers in rows (the rest round to 3 places)
+_INT_METRICS = ("count", "shed")
 
 #: sketch capacity per metric = topn() x SLACK — generous enough that
 #: a Zipf-heavy stream of a few dozen distinct shapes never evicts, so
@@ -349,7 +354,8 @@ class InsightStore:
                 latency_ms: float = 0.0, cpu_ms: float = 0.0,
                 device_ms: float = 0.0, bytes_: float = 0.0,
                 trace_id: Optional[str] = None,
-                sample_body: Optional[dict] = None) -> None:
+                sample_body: Optional[dict] = None,
+                shed: float = 0.0) -> None:
         """Fold one finished search into the sketches. O(topn) worst
         case (a min() scan on eviction), O(1) typically; never
         raises."""
@@ -359,7 +365,7 @@ class InsightStore:
             vals = {"count": 1.0, "latency_ms": float(latency_ms),
                     "cpu_ms": float(cpu_ms),
                     "device_ms": float(device_ms),
-                    "bytes": float(bytes_)}
+                    "bytes": float(bytes_), "shed": float(shed)}
             now = self._clock()
             with self._lock:
                 self._rotate_locked(now)
@@ -439,8 +445,8 @@ class InsightStore:
         for key, vals in rows[:max(0, int(n))]:
             row = {("shape" if dim == "shape" else "tenant"): key}
             for m in METRICS:
-                row[m] = round(vals.get(m, 0.0), 3) if m != "count" \
-                    else int(vals.get(m, 0))
+                row[m] = int(vals.get(m, 0)) if m in _INT_METRICS \
+                    else round(vals.get(m, 0.0), 3)
             row["error"] = round(vals.get("error", 0.0), 3)
             if dim == "shape":
                 for win in wins:
@@ -473,9 +479,14 @@ class InsightStore:
                         agg[key] = agg.get(key, 0.0) + est
                 if agg and total > 0:
                     key = max(agg, key=lambda k: agg[k])
+                    shed = sum(est for w in wins
+                               for k, est, _e in
+                               w.sketches[dim]["shed"].top(self.cap)
+                               if k == key)
                     out[dim] = {"key": key,
                                 "device_ms": round(agg[key], 3),
-                                "fraction": round(agg[key] / total, 4)}
+                                "fraction": round(agg[key] / total, 4),
+                                "shed": int(shed)}
                     if dim == "shape":
                         for w in wins:
                             meta = w.meta.get(key)
@@ -568,7 +579,7 @@ def merge_top_docs(docs: List[dict], limit: int,
         for key, vals in rows[:max(0, int(limit))]:
             row = {keyname: key}
             for m in METRICS:
-                row[m] = int(vals[m]) if m == "count" \
+                row[m] = int(vals[m]) if m in _INT_METRICS \
                     else round(vals[m], 3)
             row["error"] = round(vals.get("error", 0.0), 3)
             for extra in ("exemplar_trace_id", "sample"):

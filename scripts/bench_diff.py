@@ -212,6 +212,14 @@ PROMOTION_DRIFT_SLACK = 10
 #: ``insights`` pair): SKIP with a note, gate from the next diff on.
 INSIGHTS_OVERHEAD_MAX_PCT = 2.0
 
+#: multi-tenant QoS acceptance (``configs.qos_overload.qos``): with
+#: admission control on, the interactive tenants' p99 under the abusive
+#: flood must stay within this ratio of the same run's unloaded
+#: baseline — the enforcement gap the tentpole closes. One-sided on the
+#: FIRST landing (old side has no ``qos`` dict): SKIP with a note, gate
+#: from the next diff on.
+QOS_PROTECTED_P99_RATIO_MAX = 3.0
+
 
 def _insights_check(old: dict, new: dict):
     """Insights-overhead gate over the NEW side's own paired on/off
@@ -241,6 +249,50 @@ def _insights_check(old: dict, new: dict):
                          f"{INSIGHTS_OVERHEAD_MAX_PCT:.0f}%)")
         else:
             lines.append(label)
+    return lines, fails
+
+
+def _qos_check(old: dict, new: dict):
+    """QoS-enforcement gates over the NEW side's ``qos_overload``
+    evidence (each run carries its own unloaded baseline); the old
+    side's presence only decides gate-vs-skip, matching the insights
+    pattern. Returns (report lines, failure strings)."""
+    lines, fails = [], []
+    for name, cfg in (new.get("configs") or {}).items():
+        q = cfg.get("qos") if isinstance(cfg, dict) else None
+        if not isinstance(q, dict) or \
+                not isinstance(q.get("protected_over_unloaded"),
+                               (int, float)):
+            continue
+        ratio = float(q["protected_over_unloaded"])
+        label = (f"  configs.{name:33s} interactive p99 "
+                 f"{q.get('interactive_p99_protected_ms')} ms under "
+                 f"flood vs {q.get('interactive_p99_unloaded_ms')} ms "
+                 f"unloaded ({ratio:.2f}x)")
+        ocfg = (old.get("configs") or {}).get(name)
+        oq = ocfg.get("qos") if isinstance(ocfg, dict) else None
+        if not isinstance(oq, dict):
+            lines.append(label + "  SKIPPED gate (first landing — no "
+                                 "qos pair in old)")
+            continue
+        lines.append(label)
+        if ratio > QOS_PROTECTED_P99_RATIO_MAX:
+            fails.append(f"configs.{name} (interactive p99 {ratio:.2f}x "
+                         f"the unloaded baseline under flood — past the "
+                         f"{QOS_PROTECTED_P99_RATIO_MAX:.0f}x "
+                         f"protection gate)")
+        if not q.get("shed_engaged"):
+            fails.append(f"configs.{name} (load shedding never engaged "
+                         f"during the overload window per the "
+                         f"flight-recorder journal)")
+        if not q.get("shed_cleared"):
+            fails.append(f"configs.{name} (load shedding engaged but "
+                         f"never cleared after the flood — hysteresis "
+                         f"stuck)")
+        if q.get("steady_compiles"):
+            fails.append(f"configs.{name} (steady_compiles="
+                         f"{q['steady_compiles']} — a priority class "
+                         f"leaked into a jit shape key)")
     return lines, fails
 
 
@@ -526,6 +578,13 @@ def main(argv=None) -> int:
     for ln in ins_lines:
         print(ln)
     regressions.extend(ins_fails)
+    # multi-tenant QoS gates: the overload bench's own three windows
+    # (protection ratio, shed engage/clear, zero class-shape compiles) —
+    # skip with a note on the first landing, like the insights pair
+    qos_lines, qos_fails = _qos_check(old, new)
+    for ln in qos_lines:
+        print(ln)
+    regressions.extend(qos_fails)
     if regressions:
         print(f"FAIL: {len(regressions)} regression(s) (throughput past "
               f"{args.threshold:.0%}, recall_at_k past "

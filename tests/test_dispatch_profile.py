@@ -383,13 +383,15 @@ def test_queue_depth_gauge_sampled_by_watchdog_tick(api):
     # a vanished batcher's series zeroes out instead of freezing at its
     # last sampled depth (stale-alert regression)
     reg.gauge("es_batcher_queue_depth",
-              {"index": "dprof", "kind": "text"}).set(37)
+              {"index": "dprof", "kind": "text",
+               "class": "interactive"}).set(37)
     api.handle("DELETE", "/dprof", "", b"")
     wd.tick()
     vals = {tuple(sorted(s["labels"].items())): s["value"]
             for s in reg.metrics_doc()["es_batcher_queue_depth"][
                 "series"]}
-    assert vals[(("index", "dprof"), ("kind", "text"))] == 0.0
+    assert vals[(("class", "interactive"), ("index", "dprof"),
+                 ("kind", "text"))] == 0.0
 
 
 # ---------------------------------------------------------------------------

@@ -134,3 +134,86 @@ def test_batched_search_entry_creates_one_batcher_per_plane():
     b1 = plane._microbatcher
     batched_search(plane, [6], k=1)
     assert plane._microbatcher is b1
+
+
+# -- priority-weighted selection (common/qos.py classes) --------------------
+
+def _slot(i, k=1, priority="interactive"):
+    from elasticsearch_tpu.search.microbatch import _Slot
+    s = _Slot([i], k)
+    s.priority = priority
+    return s
+
+
+def test_slot_captures_bound_priority_on_the_request_thread():
+    from elasticsearch_tpu.common import qos
+    from elasticsearch_tpu.search.microbatch import _Slot
+    tok = qos.bind_priority("analytics")
+    try:
+        s = _Slot([1], 1)
+    finally:
+        qos.unbind_priority(tok)
+    assert s.priority == "analytics"
+    assert _Slot([1], 1).priority == "interactive"
+
+
+def test_priority_class_never_enters_the_bucket_key():
+    # the compile-lattice invariant: two slots identical except for
+    # class share one dispatch shape — class is a selection key only
+    b = PlaneMicroBatcher(FakePlane())
+    s1 = _slot(1, k=4, priority="interactive")
+    s2 = _slot(2, k=4, priority="analytics")
+    assert b._bucket_key(s1) == b._bucket_key(s2)
+
+
+def test_mixed_classes_cobatch_into_one_dispatch():
+    b = PlaneMicroBatcher(FakePlane())
+    slots = [_slot(i, k=2, priority=p) for i, p in enumerate(
+        ("interactive", "bulk", "analytics", "interactive"))]
+    with b._cond:
+        b._queue.extend(slots)
+        batch = b._take_batch_locked()
+    # same dispatch shape -> the whole queue rides one batch whatever
+    # the class mix (the winner only SEEDS the bucket choice)
+    assert len(batch) == 4
+
+
+def test_weighted_deficit_prefers_interactive_but_drains_bulk():
+    b = PlaneMicroBatcher(FakePlane())
+    wins = {"interactive": 0, "bulk": 0}
+    with b._cond:
+        for _ in range(60):
+            # two persistent classes in DIFFERENT k-buckets, refreshed
+            # each round (no starvation interference)
+            b._queue = [_slot(1, k=1, priority="interactive"),
+                        _slot(8, k=8, priority="bulk")]
+            batch = b._take_batch_locked()
+            wins[batch[0].priority] += 1
+    assert wins["bulk"] > 0, "bulk must still drain under contention"
+    # interactive accrues deficit 4x as fast -> ~4 of 5 rounds
+    assert wins["interactive"] >= 3 * wins["bulk"]
+
+
+def test_per_class_starvation_bound_under_interactive_flood():
+    b = PlaneMicroBatcher(FakePlane())
+    analytics = _slot(99, k=16, priority="analytics")
+    with b._cond:
+        b._queue.append(analytics)
+        rounds = 0
+        while True:
+            rounds += 1
+            assert rounds <= b.STARVATION_ROUNDS + 1, \
+                "analytics slot starved past the per-class bound"
+            # sustained interactive pressure: fresh slots every round
+            b._queue.extend(_slot(i, k=1) for i in range(4))
+            if analytics in b._take_batch_locked():
+                break
+    assert b.n_starved_dispatches >= 1
+
+
+def test_queue_depth_by_class():
+    b = PlaneMicroBatcher(FakePlane())
+    with b._cond:
+        b._queue.extend([_slot(1), _slot(2, priority="bulk"),
+                         _slot(3, priority="bulk")])
+    assert b.queue_depth_by_class() == {"interactive": 1, "bulk": 2}

@@ -1,0 +1,330 @@
+"""Multi-tenant QoS (common/qos.py): token-bucket admission control,
+priority classification, the shed state machine's hysteresis, and the
+REST edge's 429 + Retry-After path."""
+
+import json
+import os
+
+import pytest
+
+from elasticsearch_tpu.common import qos
+
+
+class Clock:
+    """Injected monotonic clock so refill math is deterministic."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(autouse=True)
+def _fresh_qos_state():
+    """Tests must not leak buckets/debt/engagement into the process
+    controller other suites share — nor inherit any. conftest defaults
+    ``ES_TPU_QOS=0`` for suite hermeticity; THIS file tests the
+    enforcement, so turn it on."""
+    prev = os.environ.get("ES_TPU_QOS")
+    os.environ["ES_TPU_QOS"] = "1"
+    qos.reset_controller()
+    qos.apply_cluster_settings({})
+    yield
+    if prev is None:
+        os.environ.pop("ES_TPU_QOS", None)
+    else:
+        os.environ["ES_TPU_QOS"] = prev
+    qos.reset_controller()
+    qos.apply_cluster_settings({})
+
+
+# -- token buckets ----------------------------------------------------------
+
+def test_cold_tenant_starts_at_burst_and_admits():
+    c = qos.QosController(clock=Clock())
+    assert c.tokens("a") == pytest.approx(qos.burst())
+    d = c.admit(tenant="a", priority="interactive")
+    assert d.allowed and d.reason == "ok"
+
+
+def test_charge_into_debt_throttles_until_refill_pays_it_back():
+    clk = Clock()
+    c = qos.QosController(clock=clk)
+    c.charge("a", cpu_ms=2 * qos.burst())           # burst -> -burst
+    assert c.tokens("a") < 0
+    d = c.admit(tenant="a")
+    assert not d.allowed and d.kind == "throttle" and d.reason == "tokens"
+    # Retry-After is sized to the debt / refill rate, floored
+    assert d.retry_after_s >= qos.retry_after_seconds()
+    # other tenants are untouched
+    assert c.admit(tenant="b").allowed
+    # refill pays the debt back and the tenant flows again
+    clk.t += qos.burst() / qos.refill_per_s() + 1.0
+    assert c.admit(tenant="a").allowed
+
+
+def test_anonymous_traffic_skips_the_token_check():
+    c = qos.QosController(clock=Clock())
+    c.charge(None, cpu_ms=1e9)                      # no-op by contract
+    assert c.admit(tenant=None).allowed
+
+
+def test_cost_units_weight_device_time_and_bytes():
+    assert qos.cost_units(cpu_ms=10.0) == pytest.approx(10.0)
+    assert qos.cost_units(device_ms=10.0) == \
+        pytest.approx(10.0 * qos.device_weight())
+    assert qos.cost_units(bytes_=qos.bytes_per_unit()) == pytest.approx(1.0)
+
+
+def test_bucket_cap_evicts_the_fullest_tenant():
+    c = qos.QosController(clock=Clock())
+    c.MAX_TENANTS = 4
+    for i in range(4):
+        c.charge(f"t{i}", cpu_ms=float(i))          # t0 is the fullest
+    c.charge("t-new", cpu_ms=1.0)
+    with c._lock:
+        assert "t0" not in c._buckets and "t-new" in c._buckets
+
+
+# -- priority classification ------------------------------------------------
+
+def test_classify_priority_inference():
+    assert qos.classify(action="indices:data/read/search",
+                        body={"query": {"match_all": {}}}) == "interactive"
+    assert qos.classify(action="indices:data/read/search",
+                        body={"aggs": {"t": {"terms": {"field": "x"}}}}) \
+        == "analytics"
+    assert qos.classify(action="indices:data/read/search",
+                        body={"size": 0}) == "analytics"
+    assert qos.classify(action="indices:data/write/bulk") == "bulk"
+    assert qos.classify(action="indices:data/write/reindex") == "bulk"
+    assert qos.classify(action="indices:data/read/scroll") == "bulk"
+    # the explicit x-es-priority override beats inference
+    assert qos.classify(action="indices:data/write/bulk",
+                        override="interactive") == "interactive"
+    # junk overrides fall through to inference
+    assert qos.classify(override="bogus") == "interactive"
+
+
+def test_priority_contextvar_bind_unbind():
+    assert qos.current_priority() == "interactive"
+    tok = qos.bind_priority("analytics")
+    try:
+        assert qos.current_priority() == "analytics"
+    finally:
+        qos.unbind_priority(tok)
+    assert qos.current_priority() == "interactive"
+
+
+# -- shed state machine -----------------------------------------------------
+
+def test_shed_hysteresis_engages_and_clears():
+    c = qos.QosController(clock=Clock())
+    qd = qos.shed_queue_depth()
+    c.note_signals(queue_depth=qd, burn_status="green",
+                   breaker_fraction=0.0)
+    assert c.engaged
+    # ordinary engagement: interactive flows, analytics/bulk shed
+    assert c.admit(tenant="t", priority="interactive").allowed
+    d = c.admit(tenant="t", priority="analytics")
+    assert not d.allowed and d.kind == "shed" and d.reason == "overload"
+    assert not c.admit(tenant="t", priority="bulk").allowed
+    # hysteresis: dropping below trip but above clear keeps it engaged
+    c.note_signals(queue_depth=int(qd * qos.shed_clear_fraction()) + 1)
+    assert c.engaged
+    # below the clear fraction: disengages
+    c.note_signals(queue_depth=0)
+    assert not c.engaged
+    assert c.admit(tenant="t", priority="analytics").allowed
+    doc = c.status_doc()
+    assert doc["engagements"] == 1 and doc["cleared_total"] == 1
+    assert doc["sheds_by_tenant"].get("t") == 2
+
+
+def test_severe_overload_sheds_interactive_too():
+    c = qos.QosController(clock=Clock())
+    c.note_signals(queue_depth=2 * qos.shed_queue_depth())
+    assert not c.admit(tenant="t", priority="interactive").allowed
+
+
+def test_red_burn_and_breaker_pressure_each_trip_shedding():
+    c = qos.QosController(clock=Clock())
+    c.note_signals(burn_status="red")
+    assert c.engaged
+    c.note_signals(burn_status="green")
+    assert not c.engaged
+    c.note_signals(breaker_fraction=qos.shed_breaker_fraction())
+    assert c.engaged
+
+
+def test_sustained_shedding_is_reported():
+    clk = Clock()
+    c = qos.QosController(clock=clk)
+    c.note_signals(queue_depth=10 ** 6)
+    assert not c.status_doc()["sustained"]
+    clk.t += qos.shed_sustained_seconds() + 1.0
+    assert c.status_doc()["sustained"]
+
+
+def test_shed_transitions_journal_flightrec_events():
+    from elasticsearch_tpu.common import flightrec
+    n0 = len(flightrec.DEFAULT.events(type_="qos_shed", limit=0))
+    c = qos.QosController(clock=Clock())
+    c.note_signals(queue_depth=10 ** 6)
+    c.note_signals(queue_depth=0)
+    evs = flightrec.DEFAULT.events(type_="qos_shed", limit=0)
+    transitions = [e["attrs"].get("transition") for e in evs[n0:]
+                   if e["attrs"].get("transition")]
+    assert transitions[-2:] == ["engage", "clear"]
+    # the engage event carries the trigger evidence
+    engage = next(e for e in reversed(evs)
+                  if e["attrs"].get("transition") == "engage")
+    assert engage["attrs"]["queue_depth"] == 10 ** 6
+
+
+def test_disabled_qos_admits_everything(monkeypatch):
+    monkeypatch.setenv("ES_TPU_QOS", "0")
+    c = qos.QosController(clock=Clock())
+    c.note_signals(queue_depth=10 ** 6)
+    c.charge("t", cpu_ms=1e12)                      # no-op while disabled
+    assert c.admit(tenant="t", priority="analytics").allowed
+
+
+def test_rejected_error_shapes_the_retry_after_header():
+    e = qos.QosRejectedError(
+        "nope", qos.Decision(False, "tokens", 2.3, "throttle", {}),
+        tenant="t")
+    assert e.status == 429
+    d = e.to_dict()
+    assert d["error"]["header"]["Retry-After"] == ["3"]   # ceil(2.3)
+    assert d["error"]["qos"]["tenant"] == "t"
+
+
+# -- the REST edge ----------------------------------------------------------
+
+def _mk_api(tmp_path):
+    from elasticsearch_tpu.node.indices_service import IndicesService
+    from elasticsearch_tpu.rest.api import RestAPI
+    api = RestAPI(IndicesService(str(tmp_path)))
+    api.handle("PUT", "/qt", "", json.dumps(
+        {"mappings": {"properties": {
+            "body": {"type": "text"}}}}).encode())
+    api.handle("PUT", "/qt/_doc/1", "refresh=true",
+               json.dumps({"body": "hello world"}).encode())
+    return api
+
+
+def _search(api, tenant, body=None, rh=None):
+    return api.handle(
+        "POST", "/qt/_search", "",
+        json.dumps(body or {"query": {"match": {"body": "hello"}}}
+                   ).encode(),
+        headers={"X-Opaque-Id": tenant}, resp_headers=rh)
+
+
+def test_rest_edge_throttles_with_retry_after_and_trace_id(tmp_path):
+    api = _mk_api(tmp_path)
+    qos.controller().charge("debtor", device_ms=1e9)
+    rh = {}
+    status, _ct, payload = _search(api, "debtor", rh=rh)
+    assert status == 429
+    doc = json.loads(payload)
+    assert doc["error"]["type"] == "qos_rejected_exception"
+    assert "throttled" in doc["error"]["reason"]
+    # Retry-After / Trace-Id / X-Opaque-Id are REAL response headers
+    assert int(rh["Retry-After"]) >= 1
+    assert rh.get("Trace-Id") and rh.get("X-Opaque-Id") == "debtor"
+    # an innocent tenant is unaffected
+    st2, _, _ = _search(api, "innocent")
+    assert st2 == 200
+
+
+def test_rest_edge_sheds_and_insights_count_shed_traffic(tmp_path):
+    api = _mk_api(tmp_path)
+    ctl = qos.controller()
+    ctl.note_signals(queue_depth=10 ** 6)           # severe overload
+    try:
+        status, _ct, payload = _search(api, "shed-me")
+        assert status == 429
+        assert "shed" in json.loads(payload)["error"]["reason"]
+    finally:
+        ctl.note_signals(queue_depth=0)
+    # served traffic flows again, and the rejection is distinguishable
+    # from served traffic in the insight sketches (shed column)
+    assert _search(api, "shed-me")[0] == 200
+    st, _, body = api.handle("GET", "/_insights/top_queries",
+                             "metric=shed", None)
+    assert st == 200
+    rows = {r["tenant"]: r for r in json.loads(body)["tenants"]}
+    assert rows["shed-me"]["shed"] >= 1
+    assert rows["shed-me"]["count"] >= rows["shed-me"]["shed"] + 1
+
+
+def test_priority_override_header_reaches_the_batcher_context(tmp_path):
+    api = _mk_api(tmp_path)
+    seen = {}
+    orig = qos.QosController.admit
+
+    def spy(self, tenant=None, priority="interactive", action=""):
+        seen["priority"] = priority
+        return orig(self, tenant=tenant, priority=priority, action=action)
+
+    qos.QosController.admit = spy
+    try:
+        api.handle("POST", "/qt/_search", "", json.dumps(
+            {"query": {"match": {"body": "hello"}}}).encode(),
+            headers={"x-es-priority": "bulk"})
+    finally:
+        qos.QosController.admit = orig
+    assert seen["priority"] == "bulk"
+
+
+def test_analytics_body_classified_at_the_edge(tmp_path):
+    api = _mk_api(tmp_path)
+    seen = {}
+    orig = qos.QosController.admit
+
+    def spy(self, tenant=None, priority="interactive", action=""):
+        seen["priority"] = priority
+        return orig(self, tenant=tenant, priority=priority, action=action)
+
+    qos.QosController.admit = spy
+    try:
+        api.handle("POST", "/qt/_search", "", json.dumps(
+            {"query": {"match": {"body": "hello"}}, "size": 0,
+             "aggs": {"n": {"value_count": {"field": "body"}}}}).encode())
+    finally:
+        qos.QosController.admit = orig
+    assert seen["priority"] == "analytics"
+
+
+def test_qos_settings_reconfigure_live_via_cluster_settings(tmp_path):
+    api = _mk_api(tmp_path)
+    assert qos.refill_per_s() == pytest.approx(500.0)
+    st, _, _ = api.handle("PUT", "/_cluster/settings", "", json.dumps(
+        {"transient": {"qos.tenant.refill_per_s": 50.0}}).encode())
+    assert st == 200
+    assert qos.refill_per_s() == pytest.approx(50.0)
+    # clearing the override restores the default
+    api.handle("PUT", "/_cluster/settings", "", json.dumps(
+        {"transient": {"qos.tenant.refill_per_s": None}}).encode())
+    assert qos.refill_per_s() == pytest.approx(500.0)
+
+
+def test_qos_health_indicator_reports_shedding(tmp_path):
+    api = _mk_api(tmp_path)
+    st, _, body = api.handle("GET", "/_health_report", "", None)
+    assert st == 200
+    doc = json.loads(body)
+    assert doc["indicators"]["qos"]["status"] == "green"
+    ctl = qos.controller()
+    ctl.note_signals(queue_depth=10 ** 6)
+    _search(api, "noisy")                           # one shed on record
+    try:
+        st, _, body = api.handle("GET", "/_health_report", "", None)
+        ind = json.loads(body)["indicators"]["qos"]
+        assert ind["status"] == "yellow"
+        assert "noisy" in ind["diagnosis"][0]["cause"]
+    finally:
+        ctl.note_signals(queue_depth=0)

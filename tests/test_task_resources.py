@@ -231,7 +231,7 @@ def test_health_report_green_shape(api_with_index):
         "shards_availability", "plane_serving", "plane_tiers",
         "compile_churn", "breakers", "indexing_pressure",
         "task_backlog", "slo_burn", "query_insights",
-        "dispatch_efficiency"}
+        "dispatch_efficiency", "qos"}
     for ind in doc["indicators"].values():
         assert ind["status"] in ("green", "yellow", "red", "unknown")
         assert ind["symptom"]
