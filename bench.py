@@ -1341,6 +1341,35 @@ def bench_serving(rng):
         "off_p99_ms": round(max(w["p99_ms"] for w in arms["off"]), 2),
         "pct_off_vs_on": round(
             (off_qps - on_qps) / max(on_qps, 1e-9) * 100.0, 2)}
+    # continuous-profiler overhead: same ABBA discipline over the
+    # always-on flamegraph sampler (ES_TPU_CONTPROF) — ensure_profiler()
+    # actually starts/stops the sampler thread per arm, so the off arm
+    # measures a truly sampler-free process; ``scripts/bench_diff.py``
+    # gates ``pct_off_vs_on`` at <= 2% like the insights gate
+    from elasticsearch_tpu.common import contprof as _contprof
+    cp_arms = {"on": [], "off": []}
+    prev_cp = os.environ.get("ES_TPU_CONTPROF")
+    try:
+        for arm in ("on", "off", "off", "on",
+                    "on", "off", "off", "on"):
+            os.environ["ES_TPU_CONTPROF"] = \
+                "1" if arm == "on" else "0"
+            _contprof.ensure_profiler()
+            cp_arms[arm].append(
+                run_window("request_cache=false", per_client))
+    finally:
+        if prev_cp is None:
+            os.environ.pop("ES_TPU_CONTPROF", None)
+        else:
+            os.environ["ES_TPU_CONTPROF"] = prev_cp
+        _contprof.ensure_profiler()
+    cp_on, cp_off = _arm_qps(cp_arms["on"]), _arm_qps(cp_arms["off"])
+    contprof = {
+        "on_qps": round(cp_on, 1), "off_qps": round(cp_off, 1),
+        "on_p99_ms": round(max(w["p99_ms"] for w in cp_arms["on"]), 2),
+        "off_p99_ms": round(max(w["p99_ms"] for w in cp_arms["off"]), 2),
+        "pct_off_vs_on": round(
+            (cp_off - cp_on) / max(cp_on, 1e-9) * 100.0, 2)}
     return _emit("rest_serving_32_clients", {
         **dispatch_win, "n_clients": n_clients,
         "cold_first_request_ms": round(cold_first_ms, 2),
@@ -1348,6 +1377,7 @@ def bench_serving(rng):
         "stages": stage_pcts,
         "cached": cached_win,
         "insights": insights,
+        "contprof": contprof,
         "microbatch": batch_stats,
         "telemetry": _telemetry_snapshot()})
 

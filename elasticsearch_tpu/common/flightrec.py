@@ -194,7 +194,17 @@ _SHAPE: ContextVar = ContextVar("es_flightrec_shape", default=None)
 def bind_shape(shape_id: Optional[str] = None):
     """Bind a fresh shape holder for the current request; returns the
     reset token (``reset_shape`` in a finally, like ``bind_ambient``)."""
-    return _SHAPE.set([shape_id])
+    holder = [shape_id]
+    try:
+        # the continuous profiler samples from a foreign thread, so it
+        # cannot read this contextvar — publish the MUTABLE holder into
+        # its thread->attribution map (mid-request set_shape upgrades
+        # stay visible with no further hooks)
+        from . import contprof as _contprof
+        _contprof.note_shape_holder(holder)
+    except Exception:   # noqa: BLE001 — profiling must never break
+        pass            # the request binding it
+    return _SHAPE.set(holder)
 
 
 def reset_shape(token) -> None:
@@ -206,6 +216,14 @@ def set_shape(shape_id: Optional[str]) -> None:
     holder is bound — direct shard-level calls in tests)."""
     holder = _SHAPE.get()
     if holder is not None:
+        if holder[0] != shape_id:
+            try:
+                # profile samples folded under the early structural id
+                # converge onto this final id at render time
+                from . import contprof as _contprof
+                _contprof.note_shape_alias(holder[0], shape_id)
+            except Exception:   # noqa: BLE001 — profiling must never
+                pass            # break the request
         holder[0] = shape_id
 
 
@@ -213,6 +231,13 @@ def current_shape() -> Optional[str]:
     """The query shape id bound for the current request, if any."""
     holder = _SHAPE.get()
     return holder[0] if holder is not None else None
+
+
+def has_shape_holder() -> bool:
+    """True when a shape holder is already bound on this context (the
+    REST edge binds one per search; inner layers then upgrade it in
+    place rather than shadowing it with a second scope)."""
+    return _SHAPE.get() is not None
 
 
 # -- the ring journal -------------------------------------------------------
@@ -608,7 +633,7 @@ class Watchdog:
             if self._thread is None or not self._thread.is_alive():
                 self._stop.clear()
                 t = threading.Thread(target=self._run,
-                                     name="slo-watchdog", daemon=True)
+                                     name="es-watchdog-slo", daemon=True)
                 self._thread = t
                 t.start()
         return self
@@ -763,6 +788,14 @@ class Watchdog:
             doc["telemetry"] = {}
         doc["journal"] = self.recorder.events(limit=128)
         doc["batcher_queues"] = self._batcher_queues()
+        try:
+            # attributed CPU profile slice: the live sampler's windows,
+            # or a short burst when the always-on thread is gated off —
+            # SLO-red post-mortems answer "where was the CPU going"
+            from . import contprof as _contprof
+            doc["profile"] = _contprof.capture_doc()
+        except Exception:   # noqa: BLE001 — partial captures beat none
+            doc["profile"] = {}
         try:
             from . import telemetry as _tm
             doc["device"] = _tm.device_stats_doc()

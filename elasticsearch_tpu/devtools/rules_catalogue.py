@@ -229,6 +229,17 @@ def runtime_families() -> Set[str]:
         wd.tick()
         wd.capture("manual")
         wd.close()
+        # continuous-profiler round: a thread-less sampler drives one
+        # sampled window synchronously (es_contprof_* families register
+        # deterministically — no cadence race) and the endpoint read
+        # exercises the REST surface the same way as insights below
+        from elasticsearch_tpu.common import contprof
+        prof = contprof.ContinuousProfiler(interval_ms_=1.0)
+        prof.sample_once()
+        prof.sample_once()
+        prof.top_doc(window="both")
+        api.handle("GET", "/_profiler/flamegraph",
+                   "window=both&limit=8", None)
         # query-insights round: the searches above already folded into
         # the heavy-hitter store (es_insight_* families); read both new
         # observability endpoints so the whole insight surface — store,

@@ -60,7 +60,7 @@ _NON_SERVING_ATTR = re.compile(r"metric")
 #: dispatch from the dispatcher loop
 TELEMETRY_MODULES = re.compile(
     r"(^|\.)(common\.(telemetry|tracing|flightrec|roofline"
-    r"|metrics_history)"
+    r"|metrics_history|contprof)"
     r"|search\.(dispatch_profile|plane_tiers|query_insight))$")
 
 _LOCK_CTORS = {"Lock", "RLock"}

@@ -150,26 +150,26 @@ class ClusterNode:
         # serialized, and (unlike the transport loop) the worker may issue
         # synchronous RPCs — the loop stays free to deliver the responses
         self._data_pool = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix=f"{node_id}-data")
+            max_workers=1, thread_name_prefix=f"es-data-{node_id}")
         # separate single-thread lanes so one class of work never queues
         # behind another class blocked on a cross-node RPC (the reference
         # runs 17 purpose-specific pools — threadpool/ThreadPool.java):
         # replica-apply ops never wait behind a doc op fanning out to THIS
         # node's peer, and metadata ops never wait behind either.
         self._replica_pool = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix=f"{node_id}-replica")
+            max_workers=1, thread_name_prefix=f"es-replica-{node_id}")
         # read-only metadata lane (search:stats / search:shards /
         # can_match / stats:shards): reads over immutable searcher
         # snapshots, safe off the single writer
         self._read_pool = ThreadPoolExecutor(
-            max_workers=2, thread_name_prefix=f"{node_id}-read")
+            max_workers=2, thread_name_prefix=f"es-read-{node_id}")
         # recovery lane: warm-handoff transfer/import + donor-side
         # bundle serialization are seconds-long — on the read lane they
         # would starve live search:shards RPCs through exactly the
         # recovery window serving must survive. Two workers so a pull
         # and a donor-side manifest/chunk handler can overlap.
         self._recovery_pool = ThreadPoolExecutor(
-            max_workers=2, thread_name_prefix=f"{node_id}-recovery")
+            max_workers=2, thread_name_prefix=f"es-recovery-{node_id}")
         #: allocation ids with a recovery task (incl. retry chain) in
         #: flight — state applications must not resubmit them
         self._recovering: set = set()
@@ -183,7 +183,7 @@ class ClusterNode:
         self._handoff_inflight: set = set()
         self._plane_export_lock = threading.Lock()
         self._meta_pool = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix=f"{node_id}-meta")
+            max_workers=1, thread_name_prefix=f"es-meta-{node_id}")
         # full REST stack (node/cluster_rest.py): local IndicesService +
         # RestAPI + cluster dispatch; metadata replicates via the op log
         from .cluster_rest import ClusterHooks, ClusterRestService
@@ -260,7 +260,7 @@ class ClusterNode:
         import asyncio
         from ..rest.http_server import HttpServer
         self._http_pool = ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix=f"{self.node_id}-http")
+            max_workers=4, thread_name_prefix=f"es-rest-http-{self.node_id}")
 
         async def handler(method, path, query, body, headers=None):
             loop = asyncio.get_running_loop()
@@ -302,7 +302,7 @@ class ClusterNode:
           (segment lists swap atomically; segments are immutable)."""
         if dst == self.node_id and (
                 readonly or threading.current_thread().name
-                .startswith(f"{self.node_id}-data")):
+                .startswith(f"es-data-{self.node_id}")):
             return raw_fn(self.node_id, payload)
         return self.rpc(dst, action, payload, timeout=timeout)
 

@@ -755,6 +755,8 @@ class ClusterRestService:
             return self._flight_recorder(method, path, query, body, segs)
         if path.startswith("/_profiler/timeline"):
             return self._profiler_timeline(method, path, query, body)
+        if path.startswith("/_profiler/flamegraph"):
+            return self._profiler_flamegraph(method, path, query, body)
         if path.startswith("/_insights/top_queries"):
             return self._insights_top_queries(method, path, query, body)
         if segs and segs[0] == "_nodes" and segs[-1] == "hot_threads":
@@ -897,7 +899,7 @@ class ClusterRestService:
         # mapping-update visibility through write acks
         self._last_meta_seq_tls.value = seq
         on_data_worker = threading.current_thread().name.startswith(
-            f"{node.node_id}-data")
+            f"es-data-{node.node_id}")
         if seq and not on_data_worker:
             # wait until locally applied so follow-up reads observe the op
             # (skip on the data worker: application is queued behind us)
@@ -2064,7 +2066,9 @@ class ClusterRestService:
             return n, r["status"], _unb64(r["out"])
 
         from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=len(targets)) as pool:
+        with ThreadPoolExecutor(max_workers=len(targets),
+                                thread_name_prefix="es-rest-fanout"
+                                ) as pool:
             for fut in [pool.submit(fetch_one, n) for n in targets]:
                 try:
                     n, st, payload = fut.result()
@@ -2262,6 +2266,52 @@ class ClusterRestService:
         merged["nodes_reporting"] = len(docs)
         return 200, "application/json", json.dumps(merged).encode()
 
+    def _profiler_flamegraph(self, method, path, query, body):
+        """Cluster ``GET /_profiler/flamegraph``: every node answers
+        from its own sampler windows over ``rest:exec`` and the front
+        MERGES rows — per-path SUM of self-samples across nodes, re-rank,
+        then re-apply the request ``limit`` AFTER the merge (the
+        insights limit-after-truncate lesson). ``format=collapsed``
+        renders the MERGED rows at the front, so the fan-out always
+        carries JSON."""
+        from urllib.parse import parse_qs, urlencode
+        qs = parse_qs(query)
+        fmt = (qs.get("format") or ["json"])[-1]
+        fan_query = urlencode([(k, v) for k, vs in qs.items()
+                               if k != "format" for v in vs])
+        status, ct, out = self._local(method, path, fan_query, body)
+        peers = [n for n in self.node.node_ids if n != self.node.node_id]
+        if method != "GET" or status != 200:
+            return status, ct, out
+        try:
+            local_doc = json.loads(out)
+        except ValueError:
+            return status, ct, out
+        docs = [local_doc]
+        for st_n, payload in self._fanout_rest_exec(
+                method, path, fan_query, body, peers).values():
+            if st_n != 200:
+                continue
+            try:
+                doc_n = json.loads(payload)
+            except ValueError:
+                continue
+            if isinstance(doc_n, dict):
+                docs.append(doc_n)
+        from ..common import contprof as _contprof
+        try:
+            limit = int((qs.get("limit") or
+                         [_contprof.DEFAULT_LIMIT])[-1])
+        except ValueError:
+            limit = _contprof.DEFAULT_LIMIT
+        merged = _contprof.merge_docs(docs, limit=limit)
+        merged["nodes_reporting"] = len(docs)
+        merged["window"] = local_doc.get("window", "current")
+        if fmt == "collapsed":
+            return (200, "text/plain; charset=UTF-8",
+                    _contprof.collapsed_text(merged["rows"]).encode())
+        return 200, "application/json", json.dumps(merged).encode()
+
     def _hot_threads(self, method, path, query, body, segs):
         """Cluster ``GET /_nodes[/{node_id}]/hot_threads``: fan the
         sampler out to every selected node (each samples ITS process)
@@ -2307,7 +2357,8 @@ class ClusterRestService:
 
             # the local sampler's wall-clock window runs CONCURRENTLY
             # with the remote fan-out, like any other node's
-            lt = threading.Thread(target=_local_sample)
+            lt = threading.Thread(target=_local_sample,
+                                  name="es-monitoring-hotthreads")
             lt.start()
         remote = self._fanout_rest_exec(
             method, bare, query, body, targets, timeout=30.0)

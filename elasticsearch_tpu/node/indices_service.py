@@ -532,7 +532,12 @@ class IndexService:
             # planner lowers the body (flightrec.set_shape), so slow
             # log, ledger, dispatch records and this observation all
             # end on the same id
-            shape_token = _fr.bind_shape(_qi.shape_of(body))
+            if _fr.has_shape_holder():
+                # the REST edge already bound a holder — upgrade it in
+                # place so the whole request converges on one id
+                _fr.set_shape(_qi.shape_of(body))
+            else:
+                shape_token = _fr.bind_shape(_qi.shape_of(body))
             cpu0 = time.thread_time()
             res = current_resources()
             if res is not None:

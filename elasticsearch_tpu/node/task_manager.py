@@ -538,4 +538,4 @@ class TaskManager:
                 self.unregister(task, retain=True)
 
         threading.Thread(target=runner, daemon=True,
-                         name=f"task-{task.tid}").start()
+                         name=f"es-task-{task.tid}").start()

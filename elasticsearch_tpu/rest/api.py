@@ -36,9 +36,11 @@ from ..search.shard_search import ShardHit, ShardSearcher
 JSON_CT = "application/json"
 
 
-def _json_body(body: bytes) -> dict:
+def _json_body(body) -> dict:
     if not body:
         return {}
+    if isinstance(body, dict):      # already parsed upstream
+        return body
     try:
         return json.loads(body)
     except json.JSONDecodeError as e:
@@ -376,6 +378,11 @@ class RestAPI:
         from ..common import flightrec as _flightrec
         _flightrec.register_node(self)
         _flightrec.ensure_watchdog()
+        # continuous profiler: the always-on flamegraph sampler runs
+        # whenever any node does, like the watchdog (ES_TPU_CONTPROF=0
+        # gates it off)
+        from ..common import contprof as _contprof
+        _contprof.ensure_profiler()
         self.stored_scripts: Dict[str, dict] = {}
         self.ingest = IngestService()
         self.snapshots = SnapshotsService(indices)
@@ -666,6 +673,7 @@ class RestAPI:
             self.h_insights_top_queries)
         add("GET", "/_telemetry/history", self.h_telemetry_history)
         add("GET", "/_profiler/timeline", self.h_profiler_timeline)
+        add("GET", "/_profiler/flamegraph", self.h_profiler_flamegraph)
         add("GET", "/_flight_recorder", self.h_flight_recorder)
         add("GET", "/_flight_recorder/captures", self.h_flight_captures)
         add("GET", "/_flight_recorder/captures/{capture_id}",
@@ -1128,6 +1136,13 @@ class RestAPI:
                     from ..common import flightrec as _flightrec
                     _fr_token = _flightrec.bind_ambient(
                         node=self.node_id, task=f"{task.node}:{task.id}")
+                    # continuous-profiler attribution: this thread
+                    # samples into the "rest" pool under this tenant
+                    # for the request's lifetime (the shape holder is
+                    # published by flightrec.bind_shape on the search
+                    # path) — nest-safe for internal re-dispatches
+                    from ..common import contprof as _contprof
+                    _cp_token = _contprof.bind_request_thread(opaque)
                     task.resources.cpu_mark()
                     try:
                         result = fn(params, body, **kwargs)
@@ -1139,6 +1154,7 @@ class RestAPI:
                             from ..common import qos as _qos
                             _qos.unbind_priority(_pri_token)
                         task.resources.cpu_release()
+                        _contprof.unbind_request_thread(_cp_token)
                         _flightrec.reset_ambient(_fr_token)
                         unbind_resources(_res_token)
                         self._req_task.task = None
@@ -2201,6 +2217,39 @@ class RestAPI:
                 f"[{window}]")
         return _qi.store_for(self.node_id).top_doc(
             limit=limit, metric=metric, window=window)
+
+    def h_profiler_flamegraph(self, params, body):
+        """GET /_profiler/flamegraph: this node's continuous-profiler
+        windows (``common/contprof.py``) as attributed flamegraph rows
+        + a d3-flamegraph tree. ``?window=current|previous|both`` picks
+        the rotation window, ``?pool=``/``?tenant=`` filter the
+        attribution subtree, ``?limit=`` caps the row count and
+        ``?format=collapsed`` renders Brendan-Gregg collapsed-stack
+        text instead of JSON. The cluster front fans this out per node
+        and MERGES rows (``node/cluster_rest``)."""
+        from ..common import contprof as _contprof
+        try:
+            limit = int(params.get("limit", _contprof.DEFAULT_LIMIT))
+        except ValueError:
+            raise IllegalArgumentError(
+                f"[limit] must be an integer, got [{params.get('limit')}]")
+        window = params.get("window", "current")
+        if window not in ("current", "previous", "both"):
+            raise IllegalArgumentError(
+                f"[window] must be current, previous or both, got "
+                f"[{window}]")
+        fmt = params.get("format", "json")
+        if fmt not in ("json", "collapsed"):
+            raise IllegalArgumentError(
+                f"[format] must be json or collapsed, got [{fmt}]")
+        doc = _contprof.profile_doc(
+            window=window, pool=params.get("pool"),
+            tenant=params.get("tenant"), limit=limit)
+        doc["node"] = self.node_id
+        if fmt == "collapsed":
+            return (200, "text/plain; charset=UTF-8",
+                    _contprof.collapsed_text(doc["rows"]))
+        return doc
 
     def h_telemetry_history(self, params, body):
         """GET /_telemetry/history?family=&window=: the bounded
@@ -4370,7 +4419,9 @@ class RestAPI:
         # the reference fans out per cluster concurrently — a slow remote
         # must cost max(latency), not sum
         from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=1 + len(remote_parts)) as ex:
+        with ThreadPoolExecutor(max_workers=1 + len(remote_parts),
+                                thread_name_prefix="es-rest-remote"
+                                ) as ex:
             futs = []
             if local_parts:
                 futs.append(ex.submit(run_local))
@@ -7157,6 +7208,23 @@ class RestAPI:
                 "responses": responses}
 
     def h_search(self, params, body, index=None):
+        """Shape attribution opens at the REST boundary: the structural
+        fingerprint binds as soon as the body parses, so validation,
+        security filtering and response serialization all profile (and
+        slow-log) under the query's shape — the shard layer upgrades
+        the bound holder to the plan-based id in place."""
+        from ..common import flightrec as _fr
+        from ..search import query_insight as _qi
+        body = _json_body(body)
+        tok = _fr.bind_shape(_qi.shape_of(body)) \
+            if _qi.insights_enabled() else None
+        try:
+            return self._h_search_parsed(params, body, index=index)
+        finally:
+            if tok is not None:
+                _fr.reset_shape(tok)
+
+    def _h_search_parsed(self, params, body, index=None):
         brs_p = params.get("batched_reduce_size")
         if brs_p is not None and int(brs_p) < 2:
             raise IllegalArgumentError("batchedReduceSize must be >= 2")

@@ -97,7 +97,7 @@ class _Slot:
     __slots__ = ("terms", "k", "done", "vals", "hits", "total", "aggs",
                  "error", "t_enq", "rounds_skipped", "stage_ms", "info",
                  "view_segments", "view_key", "params", "trace_id",
-                 "node", "shape", "priority")
+                 "node", "shape", "priority", "tenant")
 
     def __init__(self, terms, k: int, view=None, params=None):
         self.terms = terms
@@ -116,6 +116,11 @@ class _Slot:
         #: /_insights/top_queries by it) — captured here for the same
         #: reason as trace_id
         self.shape = _fr.current_shape()
+        #: the request's tenant (X-Opaque-Id) — captured on the request
+        #: thread so the dispatcher can stamp the batch's dominant
+        #: (tenant, shape) into the continuous profiler's attribution
+        #: map around each dispatch (common/contprof.py)
+        self.tenant = _tracing.current_opaque_id()
         #: the request's QoS priority class (interactive/bulk/analytics)
         #: — bound by the REST edge, captured on the request thread; a
         #: SELECTION key for the weighted-deficit pick, never part of
@@ -264,7 +269,7 @@ class PlaneMicroBatcher:
         if self._queue and len(self._dispatchers) < self.PIPELINE_DEPTH:
             t = threading.Thread(
                 target=self._dispatch_loop,
-                name=f"plane-dispatch-{id(self):x}", daemon=True)
+                name=f"es-dispatcher-{id(self):x}", daemon=True)
             self._dispatchers.append(t)
             t.start()
 
@@ -281,6 +286,18 @@ class PlaneMicroBatcher:
                         return
                     self._work.wait(rem)
                 batch = self._take_batch_locked()
+            # stamp this dispatcher with the batch's dominant
+            # (tenant, shape) — captured per-slot on the request thread
+            # at enqueue — so the continuous profiler attributes the
+            # host-prep + dispatch CPU burned here. OUTSIDE the batcher
+            # lock: contprof is telemetry-side (ESTP-L02)
+            from ..common import contprof as _contprof
+            counts: Dict = {}
+            for s in batch:
+                key = (s.tenant, s.shape)
+                counts[key] = counts.get(key, 0) + 1
+            dom = max(counts.items(), key=lambda kv: kv[1])[0]
+            _cp_token = _contprof.bind_dispatch(dom[0], dom[1])
             try:
                 self._run_batch(batch)
             except BaseException as e:   # noqa: BLE001 — the loop must
@@ -291,6 +308,8 @@ class PlaneMicroBatcher:
                             s.error = e
                             s.done = True
                     self._cond.notify_all()
+            finally:
+                _contprof.unbind_dispatch(_cp_token)
 
     def _bucket_key(self, s: _Slot):
         """One dispatch = one (k shape, segment view, params): k and
@@ -677,7 +696,7 @@ class PlaneMicroBatcher:
             _run()
             return None
         t = threading.Thread(target=_run,
-                             name=f"plane-warmup-{id(self):x}", daemon=True)
+                             name=f"es-warmup-{id(self):x}", daemon=True)
         with self._cond:
             # the handle is written by whichever thread triggers warmup
             # (request-thread cold build or the repack thread) and read

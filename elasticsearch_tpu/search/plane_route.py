@@ -976,7 +976,7 @@ class ServingPlaneCache:
             _run()
             return
         t = threading.Thread(target=_run, daemon=True,
-                             name=f"plane-repack-{kind}-{field}")
+                             name=f"es-repack-{kind}-{field}")
         with self._gen_lock:
             self._repack_threads.append(t)
         t.start()

@@ -311,7 +311,7 @@ class PlaneTierManager:
             self._promote(gen)
             return
         threading.Thread(target=self._promote, args=(gen,), daemon=True,
-                         name="plane-tier-promote").start()
+                         name="es-recovery-tier-promote").start()
 
     def touch(self, gen) -> None:
         """Mark a generation as just-accessed (install/import paths) so

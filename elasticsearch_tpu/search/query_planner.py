@@ -562,7 +562,7 @@ class FusedPlanRunner:
         if self.knn_gen is not None and len(items) > 0 and any(
                 it.get("qv") is not None for it in items):
             kt = threading.Thread(target=run_knn_guarded,
-                                  name="fused-knn-stage")
+                                  name="es-dispatcher-knn-stage")
             kt.start()
             run_text()
             kt.join()

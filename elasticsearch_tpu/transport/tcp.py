@@ -469,7 +469,8 @@ class NodeLoop:
 
     def __init__(self):
         self.loop = asyncio.new_event_loop()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="es-transport-loop")
         self._started = threading.Event()
         self._thread.start()
         self._started.wait()

@@ -8,28 +8,28 @@ analog, so busyness here is the fraction of snapshots in which a thread
 was runnable outside known-idle frames (waiter/selector/sleep) — the same
 ranking signal, sampled rather than counted. The output text follows the
 reference's format so ``_nodes/hot_threads`` consumers parse unchanged.
+
+The stack walk and the idle/busy classifier are shared with the
+continuous profiler (``common/contprof.py``) — one sampling core, so
+the on-demand snapshot and the always-on flamegraph can never disagree
+about what "parked" means.
 """
 from __future__ import annotations
 
-import sys
 import threading
 import time
 import traceback
 from typing import Dict, List, Tuple
 
-#: frames that mean "parked, not burning cpu"
-_IDLE_HINTS = ("threading.py", "queue.py", "selectors.py",
-               "socket.py", "ssl.py", "concurrent/futures",
-               "asyncio/base_events.py", "wait", "select", "epoll",
-               "hot_threads.py")
+from ..common.contprof import IDLE_HINTS, classify_idle, sample_stacks
+
+#: frames that mean "parked, not burning cpu" (re-exported from the
+#: shared classifier for backward compatibility)
+_IDLE_HINTS = IDLE_HINTS
 
 
 def _is_idle(stack: List[traceback.FrameSummary]) -> bool:
-    if not stack:
-        return True
-    top = stack[-1]
-    probe = f"{top.filename}:{top.name}"
-    return any(h in probe for h in _IDLE_HINTS)
+    return classify_idle(stack)
 
 
 def hot_threads(threads: int = 3, interval_ms: float = 500.0,
@@ -42,8 +42,7 @@ def hot_threads(threads: int = 3, interval_ms: float = 500.0,
     seen: Dict[int, int] = {}
     step = max(interval_ms / 1e3 / max(snapshots, 1), 0.001)
     for _ in range(snapshots):
-        for tid, frame in sys._current_frames().items():
-            stack = traceback.extract_stack(frame)
+        for tid, stack in sample_stacks().items():
             seen[tid] = seen.get(tid, 0) + 1
             if ignore_idle and _is_idle(stack):
                 continue

@@ -212,6 +212,14 @@ PROMOTION_DRIFT_SLACK = 10
 #: ``insights`` pair): SKIP with a note, gate from the next diff on.
 INSIGHTS_OVERHEAD_MAX_PCT = 2.0
 
+#: continuous-profiler overhead acceptance: the serving bench's ABBA
+#: sampler-on vs sampler-off windows (``configs.rest_serving_32_
+#: clients.contprof``) must show the always-on flamegraph sampler
+#: costing <= this much headline throughput. Same one-sided discipline
+#: as the insights gate: SKIP with a note on the FIRST landing (old
+#: side has no ``contprof`` pair), gate from the next diff on.
+CONTPROF_OVERHEAD_MAX_PCT = 2.0
+
 #: multi-tenant QoS acceptance (``configs.qos_overload.qos``): with
 #: admission control on, the interactive tenants' p99 under the abusive
 #: flood must stay within this ratio of the same run's unloaded
@@ -247,6 +255,37 @@ def _insights_check(old: dict, new: dict):
             fails.append(f"configs.{name} (insights overhead "
                          f"{pct:+.2f}% past "
                          f"{INSIGHTS_OVERHEAD_MAX_PCT:.0f}%)")
+        else:
+            lines.append(label)
+    return lines, fails
+
+
+def _contprof_check(old: dict, new: dict):
+    """Continuous-profiler overhead gate over the NEW side's own paired
+    on/off windows; the old side's presence only decides gate-vs-skip
+    (the ``_insights_check`` pattern). Returns (report lines, failure
+    strings)."""
+    lines, fails = [], []
+    for name, cfg in (new.get("configs") or {}).items():
+        cp = cfg.get("contprof") if isinstance(cfg, dict) else None
+        if not isinstance(cp, dict) or \
+                not isinstance(cp.get("pct_off_vs_on"), (int, float)):
+            continue
+        pct = float(cp["pct_off_vs_on"])
+        ocfg = (old.get("configs") or {}).get(name)
+        ocp = ocfg.get("contprof") if isinstance(ocfg, dict) else None
+        label = (f"  configs.{name:33s} contprof on "
+                 f"{cp.get('on_qps')} vs off {cp.get('off_qps')} "
+                 f"req/s  overhead {pct:+.2f}%")
+        if not isinstance(ocp, dict):
+            lines.append(label + "  SKIPPED gate (first landing — no "
+                                 "contprof pair in old)")
+            continue
+        if pct > CONTPROF_OVERHEAD_MAX_PCT:
+            lines.append(label + "  << CONTPROF-OVERHEAD REGRESSION")
+            fails.append(f"configs.{name} (contprof overhead "
+                         f"{pct:+.2f}% past "
+                         f"{CONTPROF_OVERHEAD_MAX_PCT:.0f}%)")
         else:
             lines.append(label)
     return lines, fails
@@ -578,6 +617,12 @@ def main(argv=None) -> int:
     for ln in ins_lines:
         print(ln)
     regressions.extend(ins_fails)
+    # continuous-profiler overhead gate: the serving bench's paired
+    # sampler-on/off windows (same first-landing SKIP discipline)
+    cp_lines, cp_fails = _contprof_check(old, new)
+    for ln in cp_lines:
+        print(ln)
+    regressions.extend(cp_fails)
     # multi-tenant QoS gates: the overload bench's own three windows
     # (protection ratio, shed engage/clear, zero class-shape compiles) —
     # skip with a note on the first landing, like the insights pair

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Render a ``/_profiler/flamegraph`` doc as collapsed stacks or HTML.
+
+Usage:
+    python scripts/flame_dump.py PROFILE.json                # collapsed
+    python scripts/flame_dump.py PROFILE.json --html out.html
+    python scripts/flame_dump.py --host http://127.0.0.1:9200 \
+        [--window both] [--pool dispatcher] [--tenant T] [--html out]
+    python scripts/flame_dump.py CAPTURE.json   # a watchdog capture —
+                                                # its embedded "profile"
+                                                # slice is used
+
+The input is whatever ``GET /_profiler/flamegraph`` returned (single
+node or cluster-merged), OR a watchdog capture doc (from
+``GET /_flight_recorder/captures/{id}``) whose ``profile`` key embeds
+the same row shape. Collapsed output is sorted heaviest-first,
+``pool;tenant;shape;frame;... N`` per line — feed it straight to any
+flamegraph.pl-compatible tool. ``--html`` writes a SELF-CONTAINED page
+(no external JS/CSS): nested proportional-width blocks with hover
+titles, one color lane per pool.
+"""
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import sys
+import urllib.parse
+import urllib.request
+
+#: stable fill colors per pool lane (anything else hashes into the tail)
+_POOL_COLORS = {
+    "dispatcher": "#e4573d", "rest": "#4a90d9", "repack": "#e8a33d",
+    "warmup": "#8e6bbf", "recovery": "#3db572", "watchdog": "#b05c7a",
+    "monitoring": "#6b8f9c", "sampler": "#999999", "main": "#5c6bc0",
+}
+
+
+def load_rows(doc: dict) -> list:
+    """Rows from an endpoint doc or a watchdog capture's embedded
+    profile slice."""
+    if "rows" not in doc and isinstance(doc.get("profile"), dict):
+        doc = doc["profile"]
+    return list(doc.get("rows") or [])
+
+
+def collapsed_text(rows: list) -> str:
+    from elasticsearch_tpu.common.contprof import collapsed_text as ct
+    return ct(rows)
+
+
+def _flame_tree(rows: list) -> dict:
+    from elasticsearch_tpu.common.contprof import flame_json
+    return flame_json(rows)
+
+
+def _render_node(node: dict, total: int, depth: int, out: list) -> None:
+    width = 100.0 * node["value"] / max(total, 1)
+    if width < 0.1:
+        return
+    color = _POOL_COLORS.get(node["name"]) if depth == 1 else None
+    if color is None:
+        color = f"hsl({(hash(node['name']) % 360)}, 45%, 70%)"
+    label = html.escape(str(node["name"]))
+    out.append(
+        f'<div class="fr" style="width:{width:.2f}%">'
+        f'<div class="fc" style="background:{color}" '
+        f'title="{label} — {node["value"]} samples">{label}</div>')
+    kids = node.get("children") or []
+    if kids:
+        out.append('<div class="fk">')
+        for c in kids:
+            _render_node(c, node["value"], depth + 1, out)
+        out.append("</div>")
+    out.append("</div>")
+
+
+def render_html(rows: list, title: str = "flamegraph") -> str:
+    """A self-contained HTML flamegraph: nested blocks sized by sample
+    share, rooted at pool -> tenant -> shape -> frames."""
+    tree = _flame_tree(rows)
+    body: list = []
+    for c in tree.get("children") or []:
+        _render_node(c, tree["value"], 1, body)
+    return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            f"<title>{html.escape(title)}</title><style>"
+            "body{font:12px monospace;margin:8px}"
+            ".fr{display:inline-block;vertical-align:top;"
+            "box-sizing:border-box}"
+            ".fc{overflow:hidden;white-space:nowrap;border:1px solid "
+            "#fff;padding:1px 2px;box-sizing:border-box}"
+            ".fk{width:100%}"
+            "</style></head><body>"
+            f"<h3>{html.escape(title)} — {tree['value']} samples</h3>"
+            f"<div style='width:100%'>{''.join(body)}</div>"
+            "</body></html>")
+
+
+def _fetch(host: str, args) -> dict:
+    q = {"window": args.window, "limit": str(args.limit)}
+    if args.pool:
+        q["pool"] = args.pool
+    if args.tenant:
+        q["tenant"] = args.tenant
+    url = (host.rstrip("/") + "/_profiler/flamegraph?" +
+           urllib.parse.urlencode(q))
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?", help="profile/capture JSON file")
+    ap.add_argument("--host", help="fetch live from a node instead")
+    ap.add_argument("--window", default="both")
+    ap.add_argument("--pool")
+    ap.add_argument("--tenant")
+    ap.add_argument("--limit", type=int, default=256)
+    ap.add_argument("--html", help="write a self-contained HTML "
+                                   "flamegraph here")
+    args = ap.parse_args(argv)
+    if args.host:
+        doc = _fetch(args.host, args)
+    elif args.path:
+        with open(args.path) as f:
+            doc = json.load(f)
+    else:
+        ap.error("need a JSON file or --host")
+        return 2
+    rows = load_rows(doc)
+    if args.pool:
+        rows = [r for r in rows if r.get("pool") == args.pool]
+    if args.tenant:
+        rows = [r for r in rows if r.get("tenant") == args.tenant]
+    if args.html:
+        with open(args.html, "w") as f:
+            f.write(render_html(rows, title=args.html))
+        print(f"wrote {args.html} ({len(rows)} rows)")
+    else:
+        sys.stdout.write(collapsed_text(rows))
+    dom = (doc.get("profile") or doc).get("dominant") \
+        if isinstance(doc, dict) else None
+    if dom:
+        print(f"# dominant: pool={dom['pool']} tenant={dom['tenant']} "
+              f"shape={dom['shape']} samples={dom['samples']}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

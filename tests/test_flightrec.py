@@ -306,6 +306,7 @@ def test_watchdog_red_transition_fires_one_capture_and_clears():
     assert isinstance(full["telemetry"], dict)
     assert isinstance(full["journal"], list)
     assert "batcher_queues" in full and "device" in full
+    assert "profile" in full and isinstance(full["profile"], dict)
     # journal records the transitions in order: ...->yellow, ->red,
     # then the capture event
     kinds = [(e["type"], (e.get("attrs") or {}).get("transition"))
@@ -328,6 +329,45 @@ def test_watchdog_red_transition_fires_one_capture_and_clears():
     assert transitions[-1] in ("yellow->green", "red->yellow",
                                "red->green") or \
         "yellow->green" in transitions
+
+
+def test_watchdog_capture_embeds_profile_with_dominant_pool(monkeypatch):
+    """An SLO-red capture embeds a non-empty profile slice whose
+    dominant pool names the seeded CPU burner's pool — the continuous
+    profiler's capture integration."""
+    from elasticsearch_tpu.common import contprof
+
+    # gate the singleton off so capture_doc takes the synchronous burst
+    # path and samples only THIS test's seeded burner
+    monkeypatch.setenv("ES_TPU_CONTPROF", "0")
+    contprof.close_profiler()
+    clock = FakeClock()
+    wd, rec, eng, reg = _watchdog(clock)
+    _drive(eng, clock, 600, latency_ms=10.0)
+    spin = {"on": True}
+
+    def burner():
+        while spin["on"]:
+            sum(i * i for i in range(4000))
+
+    t = threading.Thread(target=burner, name="es-dispatcher-capburner",
+                         daemon=True)
+    t.start()
+    try:
+        for _s in range(100):
+            _drive(eng, clock, 1, latency_ms=500.0)
+            wd.tick()
+            if wd.captures():
+                break
+    finally:
+        spin["on"] = False
+    t.join(timeout=2)
+    caps = wd.captures()
+    assert caps and caps[0]["trigger"] == "slo_red"
+    prof = wd.get_capture(caps[0]["id"])["profile"]
+    assert prof.get("burst") is True
+    assert prof["rows"], "capture profile slice must be non-empty"
+    assert prof["dominant"]["pool"] == "dispatcher"
 
 
 def test_watchdog_capture_store_is_bounded():

@@ -189,7 +189,7 @@ def test_threshold_crossing_repacks_in_background_and_swaps(monkeypatch):
     cache.drain_repacks()
     assert counter.builds == 2
     # the repack ran OFF the request thread
-    assert any(t.startswith("plane-repack") for t in counter.build_threads)
+    assert any(t.startswith("es-repack") for t in counter.build_threads)
     gen2 = cache.plane_for(segs, svc, "body")
     assert gen2 is not gen1
     assert gen2.delta is None                # delta folded into the base
